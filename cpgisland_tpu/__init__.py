@@ -1,0 +1,25 @@
+"""cpgisland_tpu — a TPU-native CpG-island-finding framework.
+
+A ground-up JAX / XLA / Pallas re-design of the capabilities of the reference
+(ErangaD/CpGIsland: a Hadoop-MapReduce Baum-Welch HMM trainer plus a sequential
+Viterbi CpG-island caller, /root/reference/CpGIslandFinder.java):
+
+- DNA codec + chunk framing        (reference: CpGIslandFinder.java:112-147, 238-259)
+- 8-state CpG HMM model core       (reference: CpGIslandFinder.java:155-173)
+- Baum-Welch EM with a mapper/reducer contract whose distributed backend is
+  `shard_map` + `psum` over a TPU mesh instead of Hadoop shuffle+reduce
+                                   (reference: CpGIslandFinder.java:200-201)
+- Viterbi decode as a parallel max-plus scan
+                                   (reference: CpGIslandFinder.java:256-260)
+- Island calling post-processor    (reference: CpGIslandFinder.java:262-339)
+- Model serialization (reference text format + npz checkpoints)
+                                   (reference: CpGIslandFinder.java:207-224)
+"""
+
+from cpgisland_tpu.models.hmm import HmmParams
+from cpgisland_tpu.models import presets
+from cpgisland_tpu.utils import codec, chunking
+
+__version__ = "0.1.0"
+
+__all__ = ["HmmParams", "presets", "codec", "chunking", "__version__"]

@@ -1,0 +1,148 @@
+"""HMM model core: the (pi, A, B) parameter pytree, kept in log space.
+
+Replaces the reference's Mahout ``HmmModel`` (initial-prob Vector, transition
+Matrix, emission Matrix; accessed at CpGIslandFinder.java:204-206).  We store
+log-probabilities because every TPU dynamic program (Viterbi max-plus scan,
+forward-backward log-semiring scan) consumes them directly; probability-space
+views are computed on demand.
+
+Serialization:
+- ``dump_text`` / ``load_text`` reproduce the reference's plain-text model dump
+  byte layout (per state: one pi line, one transition row, one emission row;
+  CpGIslandFinder.java:207-224).
+- npz round-trip lives in ``cpgisland_tpu.utils.checkpoint``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import IO, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# log(0) stand-in. Finite so that (-inf) - (-inf) never produces NaNs inside
+# jitted log-semiring arithmetic; exp(LOG_ZERO) underflows to exactly 0.0f.
+LOG_ZERO = -1e30
+
+
+def _log(p: jnp.ndarray) -> jnp.ndarray:
+    return jnp.where(p > 0, jnp.log(jnp.maximum(p, 1e-300)), LOG_ZERO)
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class HmmParams:
+    """HMM parameters in log space.
+
+    log_pi: [K]    initial state log-probabilities
+    log_A:  [K, K] transition log-probabilities, rows sum (in prob space) to 1
+    log_B:  [K, M] emission log-probabilities, rows sum to 1
+    """
+
+    log_pi: jnp.ndarray
+    log_A: jnp.ndarray
+    log_B: jnp.ndarray
+
+    @property
+    def n_states(self) -> int:
+        return self.log_pi.shape[-1]
+
+    @property
+    def n_symbols(self) -> int:
+        return self.log_B.shape[-1]
+
+    @property
+    def pi(self) -> jnp.ndarray:
+        return jnp.exp(self.log_pi)
+
+    @property
+    def A(self) -> jnp.ndarray:
+        return jnp.exp(self.log_A)
+
+    @property
+    def B(self) -> jnp.ndarray:
+        return jnp.exp(self.log_B)
+
+    @classmethod
+    def from_probs(cls, pi, A, B, dtype=jnp.float32) -> "HmmParams":
+        pi = jnp.asarray(pi, dtype=dtype)
+        A = jnp.asarray(A, dtype=dtype)
+        B = jnp.asarray(B, dtype=dtype)
+        if A.shape != (pi.shape[0], pi.shape[0]) or B.shape[0] != pi.shape[0]:
+            raise ValueError(f"inconsistent shapes pi={pi.shape} A={A.shape} B={B.shape}")
+        return cls(log_pi=_log(pi), log_A=_log(A), log_B=_log(B))
+
+    def astype(self, dtype) -> "HmmParams":
+        return HmmParams(
+            log_pi=self.log_pi.astype(dtype),
+            log_A=self.log_A.astype(dtype),
+            log_B=self.log_B.astype(dtype),
+        )
+
+    def max_abs_diff(self, other: "HmmParams") -> jnp.ndarray:
+        """Max absolute difference in probability space — the convergence metric
+        (the reference's MR driver stops when |model_t+1 - model_t| < epsilon,
+        CpGIslandFinder.java:96,200-201)."""
+        return jnp.maximum(
+            jnp.max(jnp.abs(self.pi - other.pi)),
+            jnp.maximum(
+                jnp.max(jnp.abs(self.A - other.A)),
+                jnp.max(jnp.abs(self.B - other.B)),
+            ),
+        )
+
+    def validate(self, atol: float = 1e-4) -> None:
+        """Raise if any distribution row is not (approximately) stochastic."""
+        for name, row_sums in (
+            ("pi", np.asarray(jnp.sum(self.pi))),
+            ("A", np.asarray(jnp.sum(self.A, axis=-1))),
+            ("B", np.asarray(jnp.sum(self.B, axis=-1))),
+        ):
+            if not np.allclose(row_sums, 1.0, atol=atol):
+                raise ValueError(f"{name} rows not stochastic: sums={row_sums}")
+
+
+def dump_text(params: HmmParams, fp: Union[str, IO[str]]) -> None:
+    """Write the reference's plain-text model dump.
+
+    Layout (CpGIslandFinder.java:207-224): for each hidden state i, three lines —
+    pi(i); the 8 transition probs A[i, :] space-separated with a trailing space;
+    the 4 emission probs B[i, :] likewise.  Numbers use repr-style shortest float
+    formatting like Java's ``Double.toString``.
+    """
+    own = isinstance(fp, str)
+    f = open(fp, "w") if own else fp
+    try:
+        pi = np.asarray(params.pi, dtype=np.float64)
+        A = np.asarray(params.A, dtype=np.float64)
+        B = np.asarray(params.B, dtype=np.float64)
+        for i in range(params.n_states):
+            f.write(repr(float(pi[i])))
+            f.write("\n")
+            f.write("".join(repr(float(v)) + " " for v in A[i]))
+            f.write("\n")
+            f.write("".join(repr(float(v)) + " " for v in B[i]))
+            f.write("\n")
+    finally:
+        if own:
+            f.close()
+
+
+def load_text(fp: Union[str, IO[str]], dtype=jnp.float32) -> HmmParams:
+    """Parse a model dump written by :func:`dump_text`."""
+    own = isinstance(fp, str)
+    f = open(fp) if own else fp
+    try:
+        lines = [ln.strip() for ln in f.read().splitlines() if ln.strip()]
+    finally:
+        if own:
+            f.close()
+    if len(lines) % 3 != 0:
+        raise ValueError(f"model text has {len(lines)} non-empty lines, not a multiple of 3")
+    k = len(lines) // 3
+    pi = np.array([float(lines[3 * i]) for i in range(k)])
+    A = np.array([[float(v) for v in lines[3 * i + 1].split()] for i in range(k)])
+    B = np.array([[float(v) for v in lines[3 * i + 2].split()] for i in range(k)])
+    return HmmParams.from_probs(pi, A, B, dtype=dtype)

@@ -1,0 +1,91 @@
+"""Named model presets.
+
+``durbin_cpg8`` is the flagship: the 8-state CpG+/CpG- model the reference
+hardcodes as its Baum-Welch initialization (numeric tables at
+CpGIslandFinder.java:155-173; the transition probabilities within each +/- block
+are the Durbin et al. "Biological Sequence Analysis" CpG tables, with 0.0025
+uniform cross-block leakage so each row sums to exactly 1.0).
+
+State ids match the reference's hidden-state map (CpGIslandFinder.java:182-189):
+0..3 = A+ C+ G+ T+ (inside a CpG island), 4..7 = A- C- G- T- (outside).
+Emissions are deterministic one-hot (state X+- emits x with p=1), which makes the
+emission matrix a fixed point of EM: structural zeros stay zero through
+Baum-Welch, so training only ever updates transitions and initials.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from cpgisland_tpu.models.hmm import HmmParams
+
+HIDDEN_STATE_NAMES = ("A+", "C+", "G+", "T+", "A-", "C-", "G-", "T-")
+EMITTED_STATE_NAMES = ("a", "c", "g", "t")
+
+# Initial distribution: islands are rarer than background
+# (CpGIslandFinder.java:155).
+_DURBIN_PI = np.array([0.05, 0.05, 0.05, 0.05, 0.2, 0.2, 0.2, 0.2])
+
+# Within-block rows are the Durbin et al. CpG-island (+) and background (-)
+# dinucleotide tables; 0.0025 per-entry cross-block leakage
+# (CpGIslandFinder.java:157-164).
+_LEAK = 0.0025
+_DURBIN_PLUS = np.array(
+    [
+        [0.170, 0.274, 0.426, 0.120],
+        [0.170, 0.358, 0.274, 0.188],
+        [0.161, 0.329, 0.375, 0.125],
+        [0.079, 0.345, 0.384, 0.182],
+    ]
+)
+_DURBIN_MINUS = np.array(
+    [
+        [0.300, 0.205, 0.275, 0.210],
+        [0.393, 0.137, 0.088, 0.372],
+        [0.248, 0.246, 0.288, 0.208],
+        [0.177, 0.239, 0.282, 0.292],
+    ]
+)
+
+
+def durbin_cpg8(dtype=jnp.float32) -> HmmParams:
+    """The 8-state A+-C+-G+-T+- CpG model (reference init, java:155-173)."""
+    A = np.full((8, 8), _LEAK)
+    A[:4, :4] = _DURBIN_PLUS
+    A[4:, 4:] = _DURBIN_MINUS
+    B = np.zeros((8, 4))
+    B[np.arange(8), np.arange(8) % 4] = 1.0  # one-hot: X+- emits x
+    return HmmParams.from_probs(_DURBIN_PI, A, B, dtype=dtype)
+
+
+def two_state_cpg(p_stay_island: float = 0.999, p_stay_bg: float = 0.9995, dtype=jnp.float32) -> HmmParams:
+    """A minimal 2-state island/background model (BASELINE.md config 1).
+
+    State 0 = island (GC-rich emissions), state 1 = background (uniform-ish).
+    """
+    pi = np.array([0.1, 0.9])
+    A = np.array(
+        [
+            [p_stay_island, 1.0 - p_stay_island],
+            [1.0 - p_stay_bg, p_stay_bg],
+        ]
+    )
+    B = np.array(
+        [
+            [0.15, 0.35, 0.35, 0.15],  # island: C/G enriched
+            [0.30, 0.20, 0.20, 0.30],  # background: A/T enriched
+        ]
+    )
+    return HmmParams.from_probs(pi, A, B, dtype=dtype)
+
+
+def random_hmm(key: jax.Array, n_states: int, n_symbols: int, dtype=jnp.float32) -> HmmParams:
+    """Random row-stochastic model (the reference's commented-out
+    ``buildRandomModel`` alternative, CpGIslandFinder.java:153)."""
+    k_pi, k_a, k_b = jax.random.split(key, 3)
+    pi = jax.random.dirichlet(k_pi, jnp.ones(n_states))
+    A = jax.random.dirichlet(k_a, jnp.ones(n_states), shape=(n_states,))
+    B = jax.random.dirichlet(k_b, jnp.ones(n_symbols), shape=(n_states,))
+    return HmmParams.from_probs(pi, A, B, dtype=dtype)

@@ -1,0 +1,81 @@
+"""Checkpoint / resume for training state.
+
+The reference persists the model to HDFS every EM iteration (the MR driver's
+modelIn/modelOut paths, CpGIslandFinder.java:64-89,200-203) but has no resume
+logic in the driver.  Here checkpoints are a first-class subsystem (SURVEY.md
+§5): each EM iteration can snapshot (pi, A, B, iteration, log-likelihood
+history) to a single ``.npz``, and training can resume from any snapshot.  The
+reference's plain-text dump (models.hmm.dump_text) is kept alongside for format
+compatibility.
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+from dataclasses import dataclass, field
+from typing import Optional, Sequence
+
+import numpy as np
+
+from cpgisland_tpu.models.hmm import HmmParams
+
+
+@dataclass
+class TrainState:
+    """Everything needed to resume Baum-Welch mid-run."""
+
+    params: HmmParams
+    iteration: int = 0
+    logliks: list = field(default_factory=list)
+
+
+def save(path: str, state: TrainState) -> None:
+    """Atomically write a TrainState snapshot as .npz (write temp + rename)."""
+    d = os.path.dirname(os.path.abspath(path)) or "."
+    fd, tmp = tempfile.mkstemp(dir=d, suffix=".npz.tmp")
+    try:
+        with os.fdopen(fd, "wb") as f:
+            np.savez(
+                f,
+                pi=np.asarray(state.params.pi, dtype=np.float64),
+                A=np.asarray(state.params.A, dtype=np.float64),
+                B=np.asarray(state.params.B, dtype=np.float64),
+                iteration=np.int64(state.iteration),
+                logliks=np.asarray(state.logliks, dtype=np.float64),
+            )
+        os.replace(tmp, path)
+    except BaseException:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+        raise
+
+
+def load(path: str) -> TrainState:
+    with np.load(path) as z:
+        params = HmmParams.from_probs(z["pi"], z["A"], z["B"])
+        return TrainState(
+            params=params,
+            iteration=int(z["iteration"]),
+            logliks=list(z["logliks"]),
+        )
+
+
+def latest(directory: str, prefix: str = "ckpt_") -> Optional[str]:
+    """Path of the highest-iteration checkpoint in a directory, or None."""
+    if not os.path.isdir(directory):
+        return None
+    best: tuple[int, Optional[str]] = (-1, None)
+    for name in os.listdir(directory):
+        if name.startswith(prefix) and name.endswith(".npz"):
+            try:
+                it = int(name[len(prefix) : -len(".npz")])
+            except ValueError:
+                continue
+            if it > best[0]:
+                best = (it, os.path.join(directory, name))
+    return best[1]
+
+
+def checkpoint_path(directory: str, iteration: int, prefix: str = "ckpt_") -> str:
+    return os.path.join(directory, f"{prefix}{iteration:06d}.npz")

@@ -1,0 +1,85 @@
+"""Chunk framing: long symbol streams -> fixed-size [num_chunks, chunk_size] batches.
+
+Reference framing (both with silent remainder drop):
+- training shards of 0x10000 = 65,536 symbols (CpGIslandFinder.java:130-141)
+- decode chunks of 0x100000 = 1,048,576 symbols (CpGIslandFinder.java:256-259)
+
+The reference drops any trailing remainder (< one chunk) on the floor in both
+paths — that is the ``drop_remainder=True`` compat mode.  The clean mode pads
+the final chunk with a PAD sentinel and returns true lengths so no data is lost;
+downstream ops mask padded positions.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+TRAIN_CHUNK = 0x10000  # CpGIslandFinder.java:130
+DECODE_CHUNK = 0x100000  # CpGIslandFinder.java:256
+PAD_SYMBOL = 4  # one past the 4 real symbols; ops treat it as "no observation"
+
+
+@dataclass(frozen=True)
+class Chunked:
+    """A framed batch of symbol chunks.
+
+    chunks:  [num_chunks, chunk_size] uint8 (PAD_SYMBOL in padded tail positions)
+    lengths: [num_chunks] int32 true lengths (== chunk_size except possibly last)
+    total:   total number of real symbols framed (sum of lengths)
+    """
+
+    chunks: np.ndarray
+    lengths: np.ndarray
+    total: int
+
+    @property
+    def num_chunks(self) -> int:
+        return int(self.chunks.shape[0])
+
+    @property
+    def chunk_size(self) -> int:
+        return int(self.chunks.shape[1])
+
+
+def frame(symbols: np.ndarray, chunk_size: int, *, drop_remainder: bool = False) -> Chunked:
+    """Frame a 1-D symbol array into fixed-size chunks.
+
+    drop_remainder=True reproduces the reference's silent drop of the trailing
+    partial chunk (CpGIslandFinder.java:130 `count % 0x10000 == 0` gate with no
+    final flush; same pattern at :256).
+    """
+    symbols = np.ascontiguousarray(symbols, dtype=np.uint8)
+    n = symbols.shape[0]
+    n_full, rem = divmod(n, chunk_size)
+    if drop_remainder or rem == 0:
+        chunks = symbols[: n_full * chunk_size].reshape(n_full, chunk_size)
+        lengths = np.full(n_full, chunk_size, dtype=np.int32)
+        return Chunked(chunks=chunks, lengths=lengths, total=n_full * chunk_size)
+    chunks = np.full((n_full + 1, chunk_size), PAD_SYMBOL, dtype=np.uint8)
+    chunks[:n_full] = symbols[: n_full * chunk_size].reshape(n_full, chunk_size)
+    chunks[n_full, :rem] = symbols[n_full * chunk_size :]
+    lengths = np.full(n_full + 1, chunk_size, dtype=np.int32)
+    lengths[n_full] = rem
+    return Chunked(chunks=chunks, lengths=lengths, total=n)
+
+
+def pad_to_multiple(chunked: Chunked, multiple: int) -> Chunked:
+    """Pad the batch dim with empty (all-PAD, length-0) chunks to a multiple.
+
+    Needed to shard a chunk batch evenly over a device mesh axis: empty chunks
+    contribute zero sufficient statistics, so results are unchanged.
+    """
+    n = chunked.num_chunks
+    target = ((n + multiple - 1) // multiple) * multiple
+    if target == n:
+        return chunked
+    extra = target - n
+    pad_chunks = np.full((extra, chunked.chunk_size), PAD_SYMBOL, dtype=np.uint8)
+    pad_lengths = np.zeros(extra, dtype=np.int32)
+    return Chunked(
+        chunks=np.concatenate([chunked.chunks, pad_chunks]),
+        lengths=np.concatenate([chunked.lengths, pad_lengths]),
+        total=chunked.total,
+    )

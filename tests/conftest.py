@@ -1,0 +1,31 @@
+"""Test configuration: force an 8-device virtual CPU platform BEFORE jax imports.
+
+This stands in for a TPU pod slice in CI (SURVEY.md §4 "Distributed tests
+without a cluster"): `shard_map`/`psum` code paths run unchanged on 8 fake CPU
+devices here and on real chips in production.
+"""
+
+import os
+
+# Unconditional: the environment may pre-set JAX_PLATFORMS to a TPU platform
+# (and the axon plugin overrides the env var), but the test suite is defined to
+# run on the virtual CPU mesh (override with CPGISLAND_TEST_PLATFORM to test on
+# real hardware).  XLA_FLAGS must be set before jax initializes its backends;
+# jax.config wins over the plugin's platform selection.
+_platform = os.environ.get("CPGISLAND_TEST_PLATFORM", "cpu")
+os.environ["JAX_PLATFORMS"] = _platform
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax
+
+jax.config.update("jax_platforms", _platform)
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(0)

@@ -1,0 +1,149 @@
+"""Slow, obviously-correct NumPy oracles for HMM algorithms and island calling.
+
+These implement textbook definitions (Rabiner 1989 / Durbin et al.) directly,
+with no vectorization tricks, to pin down the semantics the JAX/Pallas code must
+match (SURVEY.md §4 "Golden-model unit tests").  The island-caller oracle is a
+faithful state machine with the reference's exact quirks (see
+``islands_oracle`` docstring).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def viterbi_oracle(pi, A, B, obs):
+    """Most-likely state path via textbook log-space Viterbi DP."""
+    with np.errstate(divide="ignore"):
+        lp, lA, lB = np.log(pi), np.log(A), np.log(B)
+    T = len(obs)
+    K = len(pi)
+    delta = np.zeros((T, K))
+    psi = np.zeros((T, K), dtype=np.int64)
+    delta[0] = lp + lB[:, obs[0]]
+    for t in range(1, T):
+        for j in range(K):
+            scores = delta[t - 1] + lA[:, j]
+            psi[t, j] = np.argmax(scores)
+            delta[t, j] = scores[psi[t, j]] + lB[j, obs[t]]
+    path = np.zeros(T, dtype=np.int64)
+    path[-1] = np.argmax(delta[-1])
+    for t in range(T - 2, -1, -1):
+        path[t] = psi[t + 1, path[t + 1]]
+    return path, float(np.max(delta[-1]))
+
+
+def forward_backward_oracle(pi, A, B, obs):
+    """Scaled-space forward-backward (Rabiner scaling).
+
+    Returns (gamma [T,K], xi_sum [K,K], loglik).
+    """
+    T = len(obs)
+    K = len(pi)
+    alpha = np.zeros((T, K))
+    scale = np.zeros(T)
+    alpha[0] = pi * B[:, obs[0]]
+    scale[0] = alpha[0].sum()
+    alpha[0] /= scale[0]
+    for t in range(1, T):
+        alpha[t] = (alpha[t - 1] @ A) * B[:, obs[t]]
+        scale[t] = alpha[t].sum()
+        alpha[t] /= scale[t]
+    beta = np.zeros((T, K))
+    beta[-1] = 1.0
+    for t in range(T - 2, -1, -1):
+        beta[t] = A @ (B[:, obs[t + 1]] * beta[t + 1])
+        beta[t] /= scale[t + 1]
+    gamma = alpha * beta
+    gamma /= gamma.sum(axis=1, keepdims=True)
+    xi_sum = np.zeros((K, K))
+    for t in range(T - 1):
+        xi = np.outer(alpha[t], B[:, obs[t + 1]] * beta[t + 1]) * A / scale[t + 1]
+        xi_sum += xi
+    return gamma, xi_sum, float(np.log(scale).sum())
+
+
+def em_step_oracle(pi, A, B, sequences):
+    """One Baum-Welch step over a list of independent sequences.
+
+    Mirrors the Mahout MR contract (SURVEY.md C8): each sequence contributes
+    expected initial/transition/emission counts (the mapper); counts are summed
+    and row-normalized (the reducer).  Rows with zero expected count keep their
+    previous distribution.
+    """
+    K, M = B.shape
+    init_c = np.zeros(K)
+    trans_c = np.zeros((K, K))
+    emit_c = np.zeros((K, M))
+    total_ll = 0.0
+    for obs in sequences:
+        gamma, xi_sum, ll = forward_backward_oracle(pi, A, B, obs)
+        total_ll += ll
+        init_c += gamma[0]
+        trans_c += xi_sum
+        for s in range(M):
+            emit_c[:, s] += gamma[np.asarray(obs) == s].sum(axis=0)
+    new_pi = init_c / init_c.sum() if init_c.sum() > 0 else pi
+    new_A = A.copy()
+    new_B = B.copy()
+    for i in range(K):
+        if trans_c[i].sum() > 0:
+            new_A[i] = trans_c[i] / trans_c[i].sum()
+        if emit_c[i].sum() > 0:
+            new_B[i] = emit_c[i] / emit_c[i].sum()
+    return new_pi, new_A, new_B, total_ll
+
+
+def islands_oracle(path, chunk=0, chunk_size=0x100000):
+    """Island calls from a state path — faithful port of the reference's inner
+    state machine semantics (CpGIslandFinder.java:262-339), including quirks:
+
+    - an island still open at the end of the path is never emitted (:269-339);
+    - ``atC`` is NOT cleared when an island opens on a non-C state, so a CpG
+      from the tail of the previous island can leak one spurious count (:325-331);
+    - filters GC > 0.5 and O/E > 0.6; the len > 200 filter is commented out (:285).
+
+    Returns list of (beg1, end1, length, gc_content, oe_ratio) with 1-based
+    global coordinates beg + chunk*chunk_size + 1.
+    """
+    calls = []
+    in_island = False
+    beg = c_count = g_count = cg_count = island_len = 0
+    at_c = False
+    for i, val in enumerate(np.asarray(path)):
+        if in_island:
+            if val >= 4:
+                in_island = False
+                end = i - 1
+                gc = (c_count + g_count) / island_len
+                oe = 0.0
+                if c_count != 0 and g_count != 0:
+                    oe = (cg_count * island_len) / (c_count * g_count)
+                if gc > 0.5 and oe > 0.6:
+                    calls.append(
+                        (beg + chunk * chunk_size + 1, end + chunk * chunk_size + 1, island_len, gc, oe)
+                    )
+            else:
+                island_len += 1
+                if val == 2:
+                    g_count += 1
+                    if at_c:
+                        cg_count += 1
+                if val == 1:
+                    c_count += 1
+                    at_c = True
+                else:
+                    at_c = False
+        else:
+            if val <= 3:
+                in_island = True
+                island_len = 1
+                cg_count = 0
+                beg = i
+                if val == 1:
+                    c_count = 1
+                    at_c = True  # NB: at_c deliberately NOT reset otherwise (:325-331)
+                else:
+                    c_count = 0
+                g_count = 1 if val == 2 else 0
+    return calls

@@ -1,0 +1,48 @@
+"""Chunk framing tests incl. the reference drop-remainder quirk (java:130,256)."""
+
+import numpy as np
+import pytest
+
+from cpgisland_tpu.utils import chunking
+
+
+def test_exact_multiple():
+    syms = np.arange(12, dtype=np.uint8) % 4
+    ck = chunking.frame(syms, 4)
+    assert ck.num_chunks == 3 and ck.total == 12
+    assert (ck.lengths == 4).all()
+    np.testing.assert_array_equal(ck.chunks.reshape(-1), syms)
+
+
+def test_drop_remainder_compat():
+    syms = np.arange(10, dtype=np.uint8) % 4
+    ck = chunking.frame(syms, 4, drop_remainder=True)
+    assert ck.num_chunks == 2 and ck.total == 8  # trailing 2 symbols dropped
+
+
+def test_pad_remainder_clean():
+    syms = np.arange(10, dtype=np.uint8) % 4
+    ck = chunking.frame(syms, 4)
+    assert ck.num_chunks == 3 and ck.total == 10
+    assert ck.lengths.tolist() == [4, 4, 2]
+    assert (ck.chunks[2, 2:] == chunking.PAD_SYMBOL).all()
+
+
+def test_all_dropped():
+    ck = chunking.frame(np.zeros(3, dtype=np.uint8), 4, drop_remainder=True)
+    assert ck.num_chunks == 0 and ck.total == 0
+
+
+def test_pad_to_multiple():
+    syms = np.zeros(12, dtype=np.uint8)
+    ck = chunking.pad_to_multiple(chunking.frame(syms, 4), 8)
+    assert ck.num_chunks == 8
+    assert ck.lengths.tolist() == [4, 4, 4, 0, 0, 0, 0, 0]
+    assert ck.total == 12
+    # already a multiple -> unchanged
+    assert chunking.pad_to_multiple(ck, 4).num_chunks == 8
+
+
+def test_reference_constants():
+    assert chunking.TRAIN_CHUNK == 0x10000
+    assert chunking.DECODE_CHUNK == 0x100000

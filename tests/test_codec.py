@@ -1,0 +1,61 @@
+"""Codec tests: reference skip rules (CpGIslandFinder.java:112-128) + FASTA mode."""
+
+import numpy as np
+
+from cpgisland_tpu.utils import codec
+
+
+def test_basic_mapping():
+    assert codec.encode("ACGT").tolist() == [0, 1, 2, 3]
+    assert codec.encode("acgt").tolist() == [0, 1, 2, 3]
+    assert codec.encode("AaCcGgTt").tolist() == [0, 0, 1, 1, 2, 2, 3, 3]
+
+
+def test_skips_everything_else():
+    # N bases, digits, whitespace, punctuation are skipped like the reference.
+    assert codec.encode("A\nC N G\t5 T!").tolist() == [0, 1, 2, 3]
+    assert codec.encode("NNNN").size == 0
+    assert codec.encode("").size == 0
+
+
+def test_compat_mode_encodes_header_bases():
+    # Reference quirk: no FASTA handling — 'c','a','t' inside a header encode.
+    text = ">cat chr1\nACGT"  # header contributes c,a,t and the c in "chr1"
+    assert codec.encode(text).tolist() == [1, 0, 3, 1, 0, 1, 2, 3]
+
+
+def test_fasta_mode_strips_headers(tmp_path):
+    p = tmp_path / "x.fa"
+    p.write_text(">cat chr1 description acgt\nACGT\n>another g c\nGG\n")
+    compat = codec.encode_file(str(p), skip_headers=False)
+    clean = codec.encode_file(str(p), skip_headers=True)
+    assert clean.tolist() == [0, 1, 2, 3, 2, 2]
+    assert len(compat) > len(clean)
+
+
+def test_streaming_matches_onehot(tmp_path, rng):
+    # Large-ish file with headers crossing read boundaries.
+    lines = []
+    for i in range(50):
+        lines.append(f">seq{i} with acgt junk")
+        lines.append("".join(rng.choice(list("ACGTNacgtn"), size=997)))
+    p = tmp_path / "big.fa"
+    p.write_text("\n".join(lines) + "\n")
+    data = p.read_bytes()
+
+    whole = codec.encode_bytes(codec.strip_fasta_headers(data))
+    streamed = np.concatenate(
+        list(codec.iter_encoded_blocks(str(p), skip_headers=True, read_size=257))
+    )
+    np.testing.assert_array_equal(whole, streamed)
+
+    compat_whole = codec.encode_bytes(data)
+    compat_streamed = np.concatenate(
+        list(codec.iter_encoded_blocks(str(p), skip_headers=False, read_size=311))
+    )
+    np.testing.assert_array_equal(compat_whole, compat_streamed)
+
+
+def test_roundtrip():
+    syms = np.array([0, 1, 2, 3, 3, 2, 1, 0], dtype=np.uint8)
+    assert codec.encode(codec.decode_symbols(syms)).tolist() == syms.tolist()

@@ -1,0 +1,88 @@
+"""Model-core tests: presets, stochasticity, text dump round-trip (java:207-224)."""
+
+import io
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cpgisland_tpu.models import presets
+from cpgisland_tpu.models.hmm import HmmParams, dump_text, load_text
+
+
+def test_durbin_preset_matches_reference_tables():
+    m = presets.durbin_cpg8()
+    m.validate()
+    pi = np.asarray(m.pi)
+    A = np.asarray(m.A)
+    B = np.asarray(m.B)
+    # Spot values from CpGIslandFinder.java:155-173.
+    assert pi[0] == pytest.approx(0.05, rel=1e-4) and pi[4] == pytest.approx(0.2, rel=1e-4)
+    assert A[0, 2] == pytest.approx(0.426, rel=1e-4)  # A+ -> G+
+    assert A[5, 0] == pytest.approx(0.0025, rel=1e-4)  # C- -> A+ leakage
+    assert A[5, 4] == pytest.approx(0.393, rel=1e-4)  # C- -> A-
+    # Rows sum to exactly 1 by construction.
+    np.testing.assert_allclose(A.sum(axis=1), 1.0, atol=1e-5)
+    # One-hot emissions: X+- emits x.
+    np.testing.assert_allclose(B[np.arange(8), np.arange(8) % 4], 1.0)
+    assert np.count_nonzero(B) == 8
+
+
+def test_state_names():
+    assert presets.HIDDEN_STATE_NAMES == ("A+", "C+", "G+", "T+", "A-", "C-", "G-", "T-")
+    assert presets.EMITTED_STATE_NAMES == ("a", "c", "g", "t")
+
+
+def test_two_state_and_random_are_stochastic():
+    presets.two_state_cpg().validate()
+    presets.random_hmm(jax.random.key(0), 5, 4).validate()
+
+
+def test_pytree_registration():
+    m = presets.durbin_cpg8()
+    leaves = jax.tree_util.tree_leaves(m)
+    assert len(leaves) == 3
+    m2 = jax.tree_util.tree_map(lambda x: x, m)
+    assert isinstance(m2, HmmParams)
+
+
+def test_log_zero_is_finite():
+    m = presets.durbin_cpg8()
+    assert np.isfinite(np.asarray(m.log_B)).all()
+    np.testing.assert_allclose(np.asarray(m.B), np.where(np.asarray(m.B) > 0, np.asarray(m.B), 0.0))
+
+
+def test_text_dump_roundtrip(tmp_path):
+    m = presets.durbin_cpg8()
+    p = tmp_path / "model.txt"
+    dump_text(m, str(p))
+    m2 = load_text(str(p))
+    np.testing.assert_allclose(np.asarray(m2.pi), np.asarray(m.pi), atol=1e-5)
+    np.testing.assert_allclose(np.asarray(m2.A), np.asarray(m.A), atol=1e-5)
+    np.testing.assert_allclose(np.asarray(m2.B), np.asarray(m.B), atol=1e-5)
+    # Reference layout: 3 lines per state (pi / transition row / emission row).
+    lines = p.read_text().splitlines()
+    assert len(lines) == 24
+    assert len(lines[1].split()) == 8 and len(lines[2].split()) == 4
+
+
+def test_dump_text_accepts_file_object():
+    buf = io.StringIO()
+    dump_text(presets.two_state_cpg(), buf)
+    buf.seek(0)
+    m2 = load_text(io.StringIO(buf.read()))
+    assert m2.n_states == 2 and m2.n_symbols == 4
+
+
+def test_max_abs_diff():
+    a = presets.durbin_cpg8()
+    b = presets.durbin_cpg8()
+    assert float(a.max_abs_diff(b)) == 0.0
+    c = HmmParams.from_probs(np.asarray(a.pi), np.asarray(a.A), np.asarray(a.B) * 0 + 0.25)
+    assert float(a.max_abs_diff(c)) == pytest.approx(0.75)
+
+
+def test_from_probs_shape_validation():
+    with pytest.raises(ValueError):
+        HmmParams.from_probs(np.ones(3) / 3, np.eye(4), np.ones((3, 4)) / 4)

@@ -1,0 +1,98 @@
+"""Sanity tests for the NumPy oracles themselves, against hand-computed values.
+
+The oracles are the golden models every JAX/Pallas implementation is pinned
+against (SURVEY.md §4), so they get their own hand-checkable fixtures.
+"""
+
+import numpy as np
+import pytest
+
+from tests import oracle
+
+
+# A tiny 2-state weather-style HMM, worked by hand.
+PI = np.array([0.6, 0.4])
+A2 = np.array([[0.7, 0.3], [0.4, 0.6]])
+B2 = np.array([[0.5, 0.4, 0.1, 0.0], [0.1, 0.3, 0.6, 0.0]])
+
+
+def test_viterbi_oracle_hand_checked():
+    # obs = [0, 2]:
+    # delta0 = [.6*.5, .4*.1] = [.30, .04]
+    # t=1 state0: max(.30*.7, .04*.4)*.1 = .021 (from 0)
+    #     state1: max(.30*.3, .04*.6)*.6 = .054 (from 0)
+    path, score = oracle.viterbi_oracle(PI, A2, B2, [0, 2])
+    assert path.tolist() == [0, 1]
+    assert np.exp(score) == pytest.approx(0.054)
+
+
+def test_forward_backward_oracle_loglik_matches_brute_force():
+    obs = [0, 2, 1]
+    # Brute-force marginal likelihood over all 2^3 paths.
+    total = 0.0
+    for s0 in range(2):
+        for s1 in range(2):
+            for s2 in range(2):
+                total += (
+                    PI[s0] * B2[s0, obs[0]] * A2[s0, s1] * B2[s1, obs[1]] * A2[s1, s2] * B2[s2, obs[2]]
+                )
+    gamma, xi_sum, ll = oracle.forward_backward_oracle(PI, A2, B2, obs)
+    assert ll == pytest.approx(np.log(total))
+    np.testing.assert_allclose(gamma.sum(axis=1), 1.0, atol=1e-12)
+    # xi_sum totals T-1 expected transitions.
+    assert xi_sum.sum() == pytest.approx(len(obs) - 1)
+
+
+def test_em_step_oracle_increases_likelihood():
+    rng = np.random.default_rng(1)
+    seqs = [rng.integers(0, 4, size=200) for _ in range(3)]
+    pi, A, B = PI, A2, np.array([[0.4, 0.3, 0.2, 0.1], [0.1, 0.2, 0.3, 0.4]])
+    _, _, _, ll0 = oracle.em_step_oracle(pi, A, B, seqs)
+    pi1, A1, B1, _ = oracle.em_step_oracle(pi, A, B, seqs)
+    _, _, _, ll1 = oracle.em_step_oracle(pi1, A1, B1, seqs)
+    assert ll1 > ll0  # EM monotonicity
+    np.testing.assert_allclose(A1.sum(axis=1), 1.0, atol=1e-12)
+    np.testing.assert_allclose(B1.sum(axis=1), 1.0, atol=1e-12)
+
+
+def test_islands_oracle_basic_call():
+    # 10 in-island states flanked by background; C=1,G=2 alternating -> high GC
+    # and high CpG observed/expected.
+    path = [4] * 3 + [1, 2] * 5 + [4] * 3
+    calls = oracle.islands_oracle(path)
+    assert len(calls) == 1
+    beg, end, length, gc, oe = calls[0]
+    assert (beg, end, length) == (4, 13, 10)  # 1-based inclusive
+    assert gc == pytest.approx(1.0)
+    assert oe == pytest.approx((5 * 10) / (5 * 5))
+
+
+def test_islands_oracle_open_island_never_emitted():
+    path = [4] * 3 + [1, 2] * 5  # island runs to end of path -> dropped
+    assert oracle.islands_oracle(path) == []
+
+
+def test_islands_oracle_filters():
+    # All-A island: gc = 0 -> filtered.
+    assert oracle.islands_oracle([0] * 10 + [4]) == []
+
+
+def test_islands_oracle_stale_atc_quirk():
+    # Island 1 ends on C+ (state 1). Island 2 opens on A+ (state 0, which does
+    # NOT reset atC per the reference, java:325-331) then G+ -> the G is counted
+    # as a CpG even though no C precedes it in island 2.
+    path = [1, 1, 1, 1] + [4] + [0, 2, 1, 2, 1, 2] + [4]
+    calls = oracle.islands_oracle(path)
+    # island 1 (all C, no G) has oe=0 -> filtered; island 2 emitted with
+    # cg counted = (stale)1 + 2 real = 3.
+    assert len(calls) == 1
+    _, _, length, gc, oe = calls[0]
+    assert length == 6
+    assert gc == pytest.approx(5 / 6)
+    assert oe == pytest.approx(3 * 6 / (2 * 3))
+
+
+def test_islands_oracle_chunk_offset():
+    path = [4] + [1, 2] * 4 + [4]
+    calls = oracle.islands_oracle(path, chunk=2, chunk_size=100)
+    assert calls[0][0] == 1 + 200 + 1  # beg=1 + chunk*size + 1
