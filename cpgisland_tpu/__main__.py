@@ -1,0 +1,3 @@
+from cpgisland_tpu.cli import main
+
+raise SystemExit(main())

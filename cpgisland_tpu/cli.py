@@ -1,0 +1,158 @@
+"""Command-line interface.
+
+Two forms:
+
+1. **Reference-compatible positional form** (CpGIslandFinder.java:346-357):
+
+       python -m cpgisland_tpu TRAIN TEST ISLANDS_OUT MODEL_OUT CONVERGENCE NUM_ITERS
+
+   Six positional args exactly like the reference's ``main``: train on TRAIN
+   with the Durbin 8-state init, dump the trained model text to MODEL_OUT,
+   decode TEST and write island records to ISLANDS_OUT (the reference calls
+   this file "stateSeqFile" but writes island calls to it).  Full compat
+   semantics (header bases encoded, remainders dropped, per-chunk island reset).
+
+2. **Subcommand form** with explicit flags:
+
+       python -m cpgisland_tpu train  FILE --model-out m.txt --iters 10 ...
+       python -m cpgisland_tpu decode FILE --model m.txt --islands-out i.txt ...
+       python -m cpgisland_tpu run    TRAIN TEST --islands-out i.txt ...
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import sys
+from typing import Optional, Sequence
+
+from cpgisland_tpu.models import presets
+from cpgisland_tpu.models.hmm import load_text
+from cpgisland_tpu import pipeline
+
+log = logging.getLogger(__name__)
+
+_SUBCOMMANDS = ("train", "decode", "run")
+
+
+def _common_flags(p: argparse.ArgumentParser) -> None:
+    p.add_argument("--backend", choices=("local", "spmd"), default="local")
+    p.add_argument("--numerics", choices=("log", "rescaled"), default="log", dest="mode")
+    p.add_argument(
+        "--clean",
+        action="store_true",
+        help="FASTA-aware encoding, no dropped remainders, no island clipping "
+        "(default is reference-compatible behavior)",
+    )
+    p.add_argument("-v", "--verbose", action="store_true")
+
+
+def build_parser() -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser(prog="cpgisland", description=__doc__)
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    t = sub.add_parser("train", help="Baum-Welch EM training")
+    t.add_argument("training_file")
+    t.add_argument("--model-out", required=True)
+    t.add_argument("--iters", type=int, default=10)
+    t.add_argument("--convergence", type=float, default=0.005)
+    t.add_argument("--init-model", help="start from a model text file instead of the Durbin preset")
+    t.add_argument("--checkpoint-dir")
+    _common_flags(t)
+
+    d = sub.add_parser("decode", help="Viterbi decode + island calling")
+    d.add_argument("test_file")
+    d.add_argument("--model", help="model text file (default: Durbin preset)")
+    d.add_argument("--islands-out", required=True)
+    d.add_argument("--min-len", type=int, default=None, help="clean mode only")
+    _common_flags(d)
+
+    r = sub.add_parser("run", help="train then decode (the reference main())")
+    r.add_argument("training_file")
+    r.add_argument("test_file")
+    r.add_argument("--islands-out", required=True)
+    r.add_argument("--model-out", required=True)
+    r.add_argument("--iters", type=int, default=10)
+    r.add_argument("--convergence", type=float, default=0.005)
+    _common_flags(r)
+
+    return ap
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+
+    # Reference-compatible 6-positional-arg form.
+    if len(argv) == 6 and argv[0] not in _SUBCOMMANDS:
+        logging.basicConfig(level=logging.INFO, format="%(levelname)s %(name)s: %(message)s")
+        train_f, test_f, islands_out, model_out, convergence, num_iters = argv
+        pipeline.run(
+            train_f,
+            test_f,
+            islands_out,
+            model_out,
+            convergence=float(convergence),
+            num_iters=int(num_iters),
+        )
+        return 0
+
+    args = build_parser().parse_args(argv)
+    logging.basicConfig(
+        level=logging.DEBUG if args.verbose else logging.INFO,
+        format="%(levelname)s %(name)s: %(message)s",
+    )
+    compat = not args.clean
+
+    if args.cmd == "train":
+        params = load_text(args.init_model) if args.init_model else presets.durbin_cpg8()
+        res = pipeline.train_file(
+            args.training_file,
+            params=params,
+            num_iters=args.iters,
+            convergence=args.convergence,
+            backend=args.backend,
+            mode=args.mode,
+            compat=compat,
+            checkpoint_dir=args.checkpoint_dir,
+            model_out=args.model_out,
+        )
+        print(
+            f"trained: iters={res.iterations} converged={res.converged} "
+            f"final_loglik={res.logliks[-1] if res.logliks else float('nan'):.4f}"
+        )
+        return 0
+
+    if args.cmd == "decode":
+        if args.min_len is not None and compat:
+            build_parser().error("--min-len requires --clean (the reference has no length filter)")
+        params = load_text(args.model) if args.model else presets.durbin_cpg8()
+        res = pipeline.decode_file(
+            args.test_file,
+            params,
+            islands_out=args.islands_out,
+            compat=compat,
+            min_len=args.min_len,
+        )
+        print(f"decoded {res.n_symbols} symbols in {res.n_chunks} chunks; {len(res.calls)} islands")
+        return 0
+
+    if args.cmd == "run":
+        res = pipeline.run(
+            args.training_file,
+            args.test_file,
+            args.islands_out,
+            args.model_out,
+            convergence=args.convergence,
+            num_iters=args.iters,
+            backend=args.backend,
+            mode=args.mode,
+            compat=compat,
+        )
+        print(f"{len(res.calls)} islands -> {args.islands_out}")
+        return 0
+
+    raise AssertionError("unreachable")
+
+
+if __name__ == "__main__":
+    sys.exit(main())

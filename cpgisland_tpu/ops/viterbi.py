@@ -1,0 +1,103 @@
+"""Viterbi decoding as JAX scans.
+
+Replaces the reference's ``HmmEvaluator.decode(model, seq, logScaled=true)``
+call (CpGIslandFinder.java:260) — Mahout's sequential log-space Viterbi DP run
+one 1 MiB chunk at a time on the driver JVM.  Here:
+
+- :func:`viterbi` — log-space DP as a single `lax.scan` with int8 backpointers
+  and a reverse-scan backtrace.  Exact, O(T) sequential steps; the baseline and
+  the per-chunk compat path.  `vmap`-able over a batch of chunks.
+- :func:`viterbi_padded` — same, but observation values >= n_symbols (the
+  chunking PAD sentinel) are treated as "no observation": the DP state passes
+  through unchanged, so padded tails never affect the decoded prefix.
+
+This sequential decoder is the semantic baseline the parallel (blockwise
+max-plus scan) decoder is tested against.
+
+All scores use float32 log space with the finite LOG_ZERO stand-in from
+``models.hmm`` so -inf arithmetic can never produce NaNs on TPU.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from cpgisland_tpu.models.hmm import HmmParams
+
+
+@partial(jax.jit, static_argnames=("return_score",))
+def viterbi(params: HmmParams, obs: jnp.ndarray, return_score: bool = True):
+    """Most-likely hidden-state path for one observation sequence.
+
+    obs: [T] integer symbols in [0, n_symbols).
+    Returns (path [T] int32, score float32): path the argmax state sequence,
+    score its joint log-probability (what Mahout's decode maximizes).
+    """
+    return _viterbi_impl(params, obs, None, return_score)
+
+
+@partial(jax.jit, static_argnames=("return_score",))
+def viterbi_padded(params: HmmParams, obs: jnp.ndarray, length: jnp.ndarray, return_score: bool = True):
+    """Viterbi over a padded chunk: positions >= length are pass-through.
+
+    The returned path is only meaningful for t < length (padded tail positions
+    repeat the final state).
+    """
+    return _viterbi_impl(params, obs, length, return_score)
+
+
+def _viterbi_impl(params, obs, length, return_score):
+    K = params.n_states
+    obs = obs.astype(jnp.int32)
+    T = obs.shape[0]
+    # Emission log-prob rows indexed by symbol: [M, K]; padded symbols (>= M)
+    # contribute 0 so they cannot perturb scores even before masking.
+    emit_t = params.log_B.T  # [M, K]
+    if length is not None:
+        emit_t = jnp.concatenate([emit_t, jnp.zeros((1, K), emit_t.dtype)], axis=0)
+        obs_clipped = jnp.minimum(obs, params.n_symbols)
+    else:
+        obs_clipped = obs
+
+    delta0 = params.log_pi + emit_t[obs_clipped[0]]
+
+    def step(delta, inputs):
+        o_t, t = inputs
+        scores = delta[:, None] + params.log_A  # [K_from, K_to]
+        bp = jnp.argmax(scores, axis=0).astype(jnp.int32)  # [K_to]
+        new_delta = jnp.max(scores, axis=0) + emit_t[o_t]
+        if length is not None:
+            is_pad = t >= length
+            new_delta = jnp.where(is_pad, delta, new_delta)
+            bp = jnp.where(is_pad, jnp.arange(K, dtype=jnp.int32), bp)
+        return new_delta, bp
+
+    ts = jnp.arange(1, T)
+    delta_final, bps = jax.lax.scan(step, delta0, (obs_clipped[1:], ts))
+
+    last_state = jnp.argmax(delta_final).astype(jnp.int32)
+
+    def back(state, bp):
+        prev = bp[state]
+        return prev, state
+
+    # path_tail[t] is the chosen state at time t+1; the final carry is time 0.
+    carry0, path_tail = jax.lax.scan(back, last_state, bps, reverse=True)
+    path = jnp.concatenate([carry0[None], path_tail])
+    if not return_score:
+        return path
+    return path, jnp.max(delta_final)
+
+
+@partial(jax.jit, static_argnames=("return_score",))
+def viterbi_batch(params: HmmParams, chunks: jnp.ndarray, lengths: jnp.ndarray, return_score: bool = True):
+    """Decode a [N, T] batch of padded chunks in parallel via vmap.
+
+    This is the batched replacement for the reference's serial per-chunk decode
+    loop (CpGIslandFinder.java:256-260).
+    """
+    fn = lambda o, l: viterbi_padded(params, o, l, return_score=return_score)
+    return jax.vmap(fn)(chunks, lengths)

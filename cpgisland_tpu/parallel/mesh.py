@@ -1,0 +1,33 @@
+"""Device-mesh construction helpers.
+
+The framework's distribution substrate is a `jax.sharding.Mesh` over which XLA
+collectives run on ICI (and DCN across hosts) — the TPU-native replacement for
+the reference's Hadoop cluster (SURVEY.md §5).  A 1-D ``data`` axis carries
+chunk-parallel training (C8); ``SEQ_AXIS`` names the axis used for
+sequence-parallel decoding.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+DATA_AXIS = "data"
+SEQ_AXIS = "seq"
+
+
+def make_mesh(n_devices: Optional[int] = None, axis: str = DATA_AXIS) -> Mesh:
+    """A 1-D mesh over the first ``n_devices`` devices (default: all)."""
+    devs = jax.devices()
+    if n_devices is not None:
+        if n_devices > len(devs):
+            raise ValueError(f"requested {n_devices} devices, have {len(devs)}")
+        devs = devs[:n_devices]
+    return Mesh(np.asarray(devs), (axis,))
+
+
+def local_device_count() -> int:
+    return len(jax.devices())

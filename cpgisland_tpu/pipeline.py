@@ -1,0 +1,180 @@
+"""High-level driver: file in -> trained model / island calls out.
+
+This is the application layer of the reference (``trainModel`` and ``testModel``,
+CpGIslandFinder.java:102-225 and :227-344) rebuilt over the TPU stack:
+
+- :func:`train_file`  — encode + shard + Baum-Welch EM + reference text dump.
+- :func:`decode_file` — encode + chunk + batched Viterbi + island calling,
+  writing the reference's ``beg end len gc oe`` record lines.
+
+``compat=True`` reproduces the reference end to end: headers encoded as bases,
+remainder chunks dropped, 1 MiB decode chunks processed independently (islands
+clipped at chunk boundaries and reset, CpGIslandFinder.java:256,262-268), the
+stale-atC quirk.  ``compat=False`` is the clean path: FASTA-aware, no dropped
+symbols, islands called over the stitched global path so chunk boundaries don't
+clip them, optional min-length filter.
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass
+from typing import IO, Optional, Union
+
+import jax.numpy as jnp
+import numpy as np
+
+from cpgisland_tpu.models import presets
+from cpgisland_tpu.models.hmm import HmmParams, dump_text
+from cpgisland_tpu.ops import islands as islands_mod
+from cpgisland_tpu.ops.islands import IslandCalls
+from cpgisland_tpu.ops.viterbi import viterbi_batch
+from cpgisland_tpu.train import baum_welch
+from cpgisland_tpu.train.backends import EStepBackend
+from cpgisland_tpu.utils import chunking, codec
+
+log = logging.getLogger(__name__)
+
+
+def train_file(
+    training_path: str,
+    *,
+    params: Optional[HmmParams] = None,
+    num_iters: int = 10,
+    convergence: float = 0.005,
+    backend: Union[EStepBackend, str] = "local",
+    mode: str = "log",
+    compat: bool = True,
+    chunk_size: int = chunking.TRAIN_CHUNK,
+    checkpoint_dir: Optional[str] = None,
+    model_out: Optional[str] = None,
+) -> baum_welch.FitResult:
+    """Train the CpG HMM on a sequence file (reference ``trainModel``)."""
+    if params is None:
+        params = presets.durbin_cpg8()
+    symbols = codec.encode_file(training_path, skip_headers=not compat)
+    log.info("training input: %d symbols", symbols.size)
+    chunked = chunking.frame(symbols, chunk_size, drop_remainder=compat)
+    result = baum_welch.fit(
+        params,
+        chunked,
+        num_iters=num_iters,
+        convergence=convergence,
+        backend=backend,
+        mode=mode,
+        checkpoint_dir=checkpoint_dir,
+    )
+    if model_out is not None:
+        dump_text(result.params, model_out)
+    return result
+
+
+@dataclass
+class DecodeResult:
+    calls: IslandCalls
+    n_symbols: int
+    n_chunks: int
+
+
+def decode_file(
+    test_path: str,
+    params: HmmParams,
+    *,
+    islands_out: Optional[Union[str, IO[str]]] = None,
+    state_path_out: Optional[str] = None,
+    compat: bool = True,
+    chunk_size: int = chunking.DECODE_CHUNK,
+    device_batch: int = 8,
+    min_len: Optional[int] = None,
+) -> DecodeResult:
+    """Viterbi-decode a sequence file and call CpG islands (reference
+    ``testModel``).
+
+    compat mode decodes each chunk independently and resets the island caller
+    per chunk (the reference's boundary-clipping behavior); clean mode stitches
+    chunk paths into one global path before island calling.  (Until the
+    sequence-parallel decoder, chunk boundaries still restart the DP itself in
+    both modes; clean mode removes the island-call clipping.)
+    """
+    symbols = codec.encode_file(test_path, skip_headers=not compat)
+    chunked = chunking.frame(symbols, chunk_size, drop_remainder=compat)
+    chunks, lengths = chunked.chunks, chunked.lengths
+    n = chunked.num_chunks
+
+    parts: list[IslandCalls] = []
+    paths_np: list[np.ndarray] = []
+    for lo in range(0, n, device_batch):
+        hi = min(lo + device_batch, n)
+        batch_paths = viterbi_batch(
+            params,
+            jnp.asarray(chunks[lo:hi]),
+            jnp.asarray(lengths[lo:hi]),
+            return_score=False,
+        )
+        batch_paths = np.asarray(batch_paths)
+        for i in range(hi - lo):
+            L = int(lengths[lo + i])
+            path = batch_paths[i][:L]
+            if compat:
+                parts.append(
+                    islands_mod.call_islands(
+                        path, chunk=lo + i, chunk_size=chunk_size, compat=True
+                    )
+                )
+            else:
+                paths_np.append(path)
+
+    if compat:
+        calls = IslandCalls.concatenate(parts)
+    else:
+        full = np.concatenate(paths_np) if paths_np else np.zeros(0, dtype=np.int32)
+        calls = islands_mod.call_islands(full, chunk=0, compat=False, min_len=min_len)
+        if state_path_out is not None:
+            np.save(state_path_out, full.astype(np.int8))
+
+    if islands_out is not None:
+        own = isinstance(islands_out, str)
+        f = open(islands_out, "w") if own else islands_out
+        try:
+            f.write(calls.format_lines())
+        finally:
+            if own:
+                f.close()
+    return DecodeResult(calls=calls, n_symbols=int(chunked.total), n_chunks=n)
+
+
+def run(
+    training_path: str,
+    test_path: str,
+    islands_out: str,
+    model_out: str,
+    convergence: float = 0.005,
+    num_iters: int = 10,
+    *,
+    params: Optional[HmmParams] = None,
+    backend: Union[EStepBackend, str] = "local",
+    mode: str = "log",
+    compat: bool = True,
+    checkpoint_dir: Optional[str] = None,
+    min_len: Optional[int] = None,
+) -> DecodeResult:
+    """The reference's full main(): train, dump model, decode, write islands
+    (CpGIslandFinder.java:346-357)."""
+    fit = train_file(
+        training_path,
+        params=params,
+        num_iters=num_iters,
+        convergence=convergence,
+        model_out=model_out,
+        backend=backend,
+        mode=mode,
+        compat=compat,
+        checkpoint_dir=checkpoint_dir,
+    )
+    return decode_file(
+        test_path,
+        fit.params,
+        islands_out=islands_out,
+        compat=compat,
+        min_len=min_len,
+    )

@@ -1,0 +1,127 @@
+"""E-step execution backends behind the reference's mapper/reducer contract.
+
+The reference trains by submitting one Hadoop MR job per EM iteration: mappers
+run forward-backward over 65,536-symbol chunks and emit expected-count
+statistics, the shuffle+reduce phase sums them, and the driver loops
+(BaumWelchDriver.runBaumWelchMR, CpGIslandFinder.java:200-201).  That contract —
+*map chunks to SuffStats, reduce by summation* — survives here as
+:class:`EStepBackend`, with two implementations selected by a flag:
+
+- ``local`` — one device: `vmap` the mapper over the chunk batch, `sum` reduce.
+- ``spmd``  — a `jax.sharding.Mesh`: chunks are sharded over the ``data`` axis,
+  each device maps its shard, and the reduce is a single `psum` over ICI —
+  the all-reduce that replaces Hadoop's shuffle+reduce, with model replication
+  replacing the distributed cache (SURVEY.md §5 "Distributed comms backend").
+
+Both backends produce bit-identical statistics up to float reduction order.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from cpgisland_tpu.models.hmm import HmmParams
+from cpgisland_tpu.ops.forward_backward import SuffStats, batch_stats, chunk_stats
+from cpgisland_tpu.parallel.mesh import make_mesh
+from cpgisland_tpu.utils import chunking
+
+
+class EStepBackend:
+    """Protocol: __call__(params, chunks [N,T], lengths [N]) -> SuffStats."""
+
+    def __call__(self, params: HmmParams, chunks, lengths) -> SuffStats:
+        raise NotImplementedError
+
+    def prepare(self, chunked: chunking.Chunked) -> chunking.Chunked:
+        """Adjust a chunk batch to the backend's layout requirements."""
+        return chunked
+
+    def place(self, chunks, lengths):
+        """Device-place a chunk batch once, before the iteration loop.
+
+        Training data never changes across EM iterations, so the trainer calls
+        this once and then reuses the placed arrays — one host->device (and
+        cross-device shard) transfer per run, not per iteration.
+        """
+        return jnp.asarray(chunks), jnp.asarray(lengths)
+
+
+class LocalBackend(EStepBackend):
+    """Single-device vmap mapper + sum reducer."""
+
+    def __init__(self, mode: str = "log"):
+        self.mode = mode
+
+    def __call__(self, params, chunks, lengths):
+        return batch_stats(params, jnp.asarray(chunks), jnp.asarray(lengths), mode=self.mode)
+
+
+class SpmdBackend(EStepBackend):
+    """Mesh-sharded mapper + `psum` reducer over the ``data`` axis.
+
+    The chunk batch [N, T] is sharded N-ways over the mesh's data axis (N must
+    be a multiple of the axis size — use :meth:`prepare`, which pads with
+    zero-length chunks contributing exactly-zero statistics).  The model is
+    replicated, mirroring the reference's distributed-cache broadcast.
+    """
+
+    def __init__(self, mesh: Optional[Mesh] = None, mode: str = "log", axis: str = "data"):
+        self.mesh = mesh if mesh is not None else make_mesh()
+        self.mode = mode
+        self.axis = axis
+
+        mapper = partial(chunk_stats, mode=self.mode)
+
+        def estep(params, chunks, lengths):
+            per = jax.vmap(lambda o, l: mapper(params, o, l))(chunks, lengths)
+            local = jax.tree_util.tree_map(lambda x: jnp.sum(x, axis=0), per)
+            return jax.lax.psum(local, axis_name=self.axis)
+
+        self._estep = jax.jit(
+            jax.shard_map(
+                estep,
+                mesh=self.mesh,
+                in_specs=(P(), P(self.axis), P(self.axis)),
+                out_specs=P(),
+            )
+        )
+
+    def prepare(self, chunked: chunking.Chunked) -> chunking.Chunked:
+        return chunking.pad_to_multiple(chunked, self.mesh.shape[self.axis])
+
+    def place(self, chunks, lengths):
+        self._check_divisible(chunks)
+        sharding = NamedSharding(self.mesh, P(self.axis))
+        return (
+            jax.device_put(jnp.asarray(chunks), sharding),
+            jax.device_put(jnp.asarray(lengths), sharding),
+        )
+
+    def _check_divisible(self, chunks):
+        n_dev = self.mesh.shape[self.axis]
+        if chunks.shape[0] % n_dev != 0:
+            raise ValueError(
+                f"chunk count {chunks.shape[0]} not divisible by mesh axis "
+                f"'{self.axis}' size {n_dev}; call prepare() first"
+            )
+
+    def __call__(self, params, chunks, lengths):
+        self._check_divisible(chunks)
+        # Already-placed arrays (from place()) pass through; anything else is
+        # resharded by jit according to the shard_map in_specs.
+        return self._estep(params, chunks, lengths)
+
+
+def get_backend(name: str = "local", *, mode: str = "log", mesh: Optional[Mesh] = None) -> EStepBackend:
+    """Backend factory — the runtime flag the north star asks for."""
+    if name == "local":
+        return LocalBackend(mode=mode)
+    if name == "spmd":
+        return SpmdBackend(mesh=mesh, mode=mode)
+    raise ValueError(f"unknown backend {name!r} (expected 'local' or 'spmd')")

@@ -1,0 +1,152 @@
+"""Baum-Welch EM: M-step and the convergence-driven training loop.
+
+The reference's trainer is Mahout's Hadoop Baum-Welch driver: per iteration one
+MR job (mappers: forward-backward counts; reducers: sum + normalize), looping
+until |model_{t+1} - model_t| < convergence or numIter is reached
+(BaumWelchDriver.runBaumWelchMR, CpGIslandFinder.java:200-201; convergence
+".005" at :96).  Here the E-step runs through an
+:class:`~cpgisland_tpu.train.backends.EStepBackend` (local vmap or mesh-sharded
+`psum`), the M-step is a normalize on replicated [K]/[K,K]/[K,M] tensors, and
+the loop is host-side Python (one device sync per iteration — exactly the
+reference's one-job-per-iteration cadence, minus the JVM startup).
+
+Structural zeros (e.g. the one-hot emission rows of the CpG model,
+CpGIslandFinder.java:166-173) are preserved automatically: a zero-probability
+emission accumulates exactly zero expected count, so EM is a fixed point in
+those coordinates (SURVEY.md C5).  Rows with zero total count keep their
+previous distribution rather than dividing by zero.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import time
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from cpgisland_tpu.models.hmm import HmmParams
+from cpgisland_tpu.ops.forward_backward import SuffStats
+from cpgisland_tpu.train.backends import EStepBackend, get_backend
+from cpgisland_tpu.utils import checkpoint as ckpt
+from cpgisland_tpu.utils import chunking
+
+log = logging.getLogger(__name__)
+
+
+@jax.jit
+def mstep(params: HmmParams, stats: SuffStats) -> HmmParams:
+    """Normalize expected counts into the next model (the reducer's normalize).
+
+    Zero-count rows retain the previous distribution.
+    """
+
+    def normalize(counts, prev_probs):
+        row = jnp.sum(counts, axis=-1, keepdims=True)
+        safe = jnp.where(row > 0, counts / jnp.maximum(row, 1e-30), prev_probs)
+        return safe
+
+    pi = normalize(stats.init, jnp.exp(params.log_pi))
+    A = normalize(stats.trans, jnp.exp(params.log_A))
+    B = normalize(stats.emit, jnp.exp(params.log_B))
+    return HmmParams.from_probs(pi, A, B)
+
+
+@dataclasses.dataclass
+class FitResult:
+    params: HmmParams
+    iterations: int
+    logliks: list
+    converged: bool
+    deltas: list
+
+
+def fit(
+    params: HmmParams,
+    chunked: chunking.Chunked,
+    *,
+    num_iters: int = 10,
+    convergence: float = 0.005,
+    backend: EStepBackend | str = "local",
+    mode: str = "log",
+    checkpoint_dir: Optional[str] = None,
+    callback: Optional[Callable[[int, float, float], None]] = None,
+    start_iteration: int = 0,
+) -> FitResult:
+    """Run Baum-Welch EM until convergence or ``num_iters``.
+
+    Matches the reference driver-loop semantics: stop when the max-abs change in
+    any model probability drops below ``convergence`` (the MR driver's model
+    delta check) or after ``num_iters`` jobs.  Each iteration optionally writes
+    an npz checkpoint (the reference persists the model to HDFS per iteration,
+    CpGIslandFinder.java:64-89).
+    """
+    if isinstance(backend, str):
+        backend = get_backend(backend, mode=mode)
+    chunked = backend.prepare(chunked)
+    chunks, lengths = backend.place(chunked.chunks, chunked.lengths)
+
+    logliks: list[float] = []
+    deltas: list[float] = []
+    converged = False
+    it = 0
+    for it in range(start_iteration + 1, start_iteration + num_iters + 1):
+        t0 = time.perf_counter()
+        stats = backend(params, chunks, lengths)
+        new_params = mstep(params, stats)
+        delta = float(new_params.max_abs_diff(params))
+        ll = float(stats.loglik)
+        params = new_params
+        logliks.append(ll)
+        deltas.append(delta)
+        dt = time.perf_counter() - t0
+        log.info("em iter=%d loglik=%.4f delta=%.6f wall=%.3fs", it, ll, delta, dt)
+        if callback is not None:
+            callback(it, ll, delta)
+        if checkpoint_dir is not None:
+            ckpt.save(
+                ckpt.checkpoint_path(checkpoint_dir, it),
+                ckpt.TrainState(params=params, iteration=it, logliks=logliks),
+            )
+        if delta < convergence:
+            converged = True
+            break
+    return FitResult(
+        params=params, iterations=it, logliks=logliks, converged=converged, deltas=deltas
+    )
+
+
+def resume(
+    checkpoint_dir: str,
+    chunked: chunking.Chunked,
+    *,
+    num_iters: int = 10,
+    convergence: float = 0.005,
+    backend: EStepBackend | str = "local",
+    mode: str = "log",
+) -> FitResult:
+    """Resume training from the latest checkpoint in a directory.
+
+    The reference has no resume path (its per-iteration HDFS model dumps are
+    write-only); this makes the natural EM restart point first-class
+    (SURVEY.md §5 failure detection / elastic recovery).
+    """
+    path = ckpt.latest(checkpoint_dir)
+    if path is None:
+        raise FileNotFoundError(f"no checkpoints under {checkpoint_dir}")
+    state = ckpt.load(path)
+    remaining = max(0, num_iters - state.iteration)
+    result = fit(
+        state.params,
+        chunked,
+        num_iters=remaining,
+        convergence=convergence,
+        backend=backend,
+        mode=mode,
+        checkpoint_dir=checkpoint_dir,
+        start_iteration=state.iteration,
+    )
+    return dataclasses.replace(result, logliks=list(state.logliks) + result.logliks)

@@ -1,0 +1,124 @@
+"""EM trainer: oracle parity, monotonicity, convergence, checkpoints, backends."""
+
+import numpy as np
+import pytest
+
+from cpgisland_tpu.models import presets
+from cpgisland_tpu.models.hmm import HmmParams
+from cpgisland_tpu.train import backends, baum_welch
+from cpgisland_tpu.utils import chunking
+from tests import oracle
+
+
+def _chunked(rng, n=4, t=64):
+    syms = rng.integers(0, 4, size=n * t).astype(np.uint8)
+    return chunking.frame(syms, t)
+
+
+def _random_model(rng, k=3, m=4):
+    pi = rng.dirichlet(np.ones(k))
+    A = rng.dirichlet(np.ones(k), size=k)
+    B = rng.dirichlet(np.ones(m), size=k)
+    return pi, A, B
+
+
+def test_single_em_step_matches_oracle(rng):
+    pi, A, B = _random_model(rng)
+    params = HmmParams.from_probs(pi, A, B)
+    ck = _chunked(rng, n=3, t=50)
+    res = baum_welch.fit(params, ck, num_iters=1, convergence=0.0)
+    opi, oA, oB, _ = oracle.em_step_oracle(pi, A, B, list(ck.chunks))
+    np.testing.assert_allclose(np.asarray(res.params.pi), opi, atol=1e-3)
+    np.testing.assert_allclose(np.asarray(res.params.A), oA, atol=1e-3)
+    np.testing.assert_allclose(np.asarray(res.params.B), oB, atol=1e-3)
+
+
+def test_loglik_monotone_nondecreasing(rng):
+    pi, A, B = _random_model(rng, k=4)
+    params = HmmParams.from_probs(pi, A, B)
+    ck = _chunked(rng, n=4, t=128)
+    res = baum_welch.fit(params, ck, num_iters=8, convergence=0.0)
+    lls = res.logliks
+    assert all(b >= a - 1e-2 for a, b in zip(lls, lls[1:])), lls
+
+
+def test_convergence_stops_early(rng):
+    params = presets.durbin_cpg8()
+    ck = _chunked(rng, n=2, t=256)
+    res = baum_welch.fit(params, ck, num_iters=50, convergence=0.01)
+    assert res.converged
+    assert res.iterations < 50
+    assert res.deltas[-1] < 0.01
+
+
+def test_structural_zeros_preserved_through_training(rng):
+    params = presets.durbin_cpg8()
+    ck = _chunked(rng, n=2, t=256)
+    res = baum_welch.fit(params, ck, num_iters=3, convergence=0.0)
+    B = np.asarray(res.params.B)
+    B0 = np.asarray(params.B)
+    assert (B[B0 == 0] == 0).all()
+    np.testing.assert_allclose(B[B0 == 1.0], 1.0, atol=1e-6)
+
+
+def test_spmd_backend_matches_local(rng):
+    import jax
+
+    assert len(jax.devices()) == 8, "conftest must provide 8 virtual devices"
+    pi, A, B = _random_model(rng)
+    params = HmmParams.from_probs(pi, A, B)
+    ck = _chunked(rng, n=16, t=64)
+    local = baum_welch.fit(params, ck, num_iters=2, convergence=0.0, backend="local")
+    spmd = baum_welch.fit(params, ck, num_iters=2, convergence=0.0, backend="spmd")
+    np.testing.assert_allclose(
+        np.asarray(spmd.params.A), np.asarray(local.params.A), atol=1e-4
+    )
+    assert spmd.logliks[0] == pytest.approx(local.logliks[0], rel=1e-5)
+
+
+def test_spmd_backend_pads_uneven_batches(rng):
+    pi, A, B = _random_model(rng)
+    params = HmmParams.from_probs(pi, A, B)
+    ck = _chunked(rng, n=5, t=64)  # 5 chunks over 8 devices -> padded to 8
+    local = baum_welch.fit(params, ck, num_iters=1, convergence=0.0, backend="local")
+    spmd = baum_welch.fit(params, ck, num_iters=1, convergence=0.0, backend="spmd")
+    np.testing.assert_allclose(
+        np.asarray(spmd.params.A), np.asarray(local.params.A), atol=1e-4
+    )
+
+
+def test_rescaled_mode_training_agrees_with_log(rng):
+    pi, A, B = _random_model(rng)
+    params = HmmParams.from_probs(pi, A, B)
+    ck = _chunked(rng, n=3, t=128)
+    a = baum_welch.fit(params, ck, num_iters=2, convergence=0.0, mode="log")
+    b = baum_welch.fit(params, ck, num_iters=2, convergence=0.0, mode="rescaled")
+    np.testing.assert_allclose(np.asarray(a.params.A), np.asarray(b.params.A), atol=1e-3)
+
+
+def test_checkpoint_and_resume(tmp_path, rng):
+    pi, A, B = _random_model(rng)
+    params = HmmParams.from_probs(pi, A, B)
+    ck = _chunked(rng, n=3, t=64)
+    full = baum_welch.fit(params, ck, num_iters=4, convergence=0.0)
+    partial = baum_welch.fit(
+        params, ck, num_iters=2, convergence=0.0, checkpoint_dir=str(tmp_path)
+    )
+    assert len(list(tmp_path.glob("ckpt_*.npz"))) == 2
+    resumed = baum_welch.resume(str(tmp_path), ck, num_iters=4, convergence=0.0)
+    assert resumed.iterations == 4
+    assert len(resumed.logliks) == 4
+    np.testing.assert_allclose(
+        np.asarray(resumed.params.A), np.asarray(full.params.A), atol=2e-4
+    )
+
+
+def test_mstep_zero_count_rows_keep_previous():
+    from cpgisland_tpu.ops.forward_backward import SuffStats
+    import jax.numpy as jnp
+
+    params = presets.two_state_cpg()
+    stats = SuffStats.zeros(2, 4)
+    new = baum_welch.mstep(params, stats)
+    np.testing.assert_allclose(np.asarray(new.A), np.asarray(params.A), atol=1e-6)
+    np.testing.assert_allclose(np.asarray(new.B), np.asarray(params.B), atol=1e-6)

@@ -1,0 +1,132 @@
+"""End-to-end: synthetic genome with planted islands -> train -> decode -> calls.
+
+SURVEY.md §4: "synthetic genome generated from a known HMM -> train -> decode ->
+island calls must recover planted islands above threshold precision/recall."
+Also exercises the two CLI forms end to end.
+"""
+
+import numpy as np
+import pytest
+
+from cpgisland_tpu import cli, pipeline
+from cpgisland_tpu.models import presets
+from cpgisland_tpu.models.hmm import load_text
+from cpgisland_tpu.utils import codec
+
+
+def synth_genome(rng, n_islands=8, island_len=600, bg_len=3000):
+    """Background AT-rich sequence with planted CG-rich islands; returns
+    (text, list of (start, end) 0-based inclusive island spans)."""
+    parts = []
+    spans = []
+    pos = 0
+    bases_bg = np.array(list("acgt"))
+    p_bg = [0.32, 0.18, 0.18, 0.32]
+    p_isl = [0.12, 0.38, 0.38, 0.12]
+    for i in range(n_islands):
+        bg = rng.choice(bases_bg, size=bg_len, p=p_bg)
+        parts.append("".join(bg))
+        pos += bg_len
+        isl = rng.choice(bases_bg, size=island_len, p=p_isl)
+        # Boost explicit CpG dinucleotides so O/E clears 0.6.
+        isl_s = "".join(isl)
+        isl_s = isl_s.replace("ca", "cg").replace("ta", "cg")
+        parts.append(isl_s)
+        spans.append((pos, pos + len(isl_s) - 1))
+        pos += len(isl_s)
+    tail = rng.choice(bases_bg, size=bg_len, p=p_bg)
+    parts.append("".join(tail))
+    return "".join(parts), spans
+
+
+def _recall(calls, spans):
+    hits = 0
+    for s, e in spans:
+        for b, en in zip(calls.beg, calls.end):
+            b0, e0 = b - 1, en - 1  # back to 0-based
+            inter = max(0, min(e, e0) - max(s, b0) + 1)
+            if inter >= 0.5 * (e - s + 1):
+                hits += 1
+                break
+    return hits / len(spans)
+
+
+def test_train_decode_recovers_planted_islands(tmp_path, rng):
+    text, spans = synth_genome(rng)
+    fa = tmp_path / "genome.txt"
+    fa.write_text(text)
+
+    fit = pipeline.train_file(
+        str(fa), num_iters=3, convergence=0.0, chunk_size=4096, model_out=str(tmp_path / "m.txt")
+    )
+    assert len(fit.logliks) == 3
+    # Training must not have destroyed the two-block structure.
+    m = load_text(str(tmp_path / "m.txt"))
+    assert m.n_states == 8
+
+    res = pipeline.decode_file(
+        str(fa),
+        fit.params,
+        islands_out=str(tmp_path / "islands.txt"),
+        compat=False,
+        chunk_size=8192,
+    )
+    assert res.n_symbols == len(text)
+    assert _recall(res.calls, spans) >= 0.8
+    lines = (tmp_path / "islands.txt").read_text().splitlines()
+    assert len(lines) == len(res.calls)
+    cols = lines[0].split()
+    assert len(cols) == 5 and int(cols[0]) < int(cols[1])
+
+
+def test_compat_decode_resets_at_chunk_boundaries(tmp_path, rng):
+    # An island straddling a chunk boundary is split in compat mode.
+    text, _ = synth_genome(rng, n_islands=2, island_len=400, bg_len=1000)
+    fa = tmp_path / "g.txt"
+    fa.write_text(text)
+    params = presets.durbin_cpg8()
+    compat = pipeline.decode_file(str(fa), params, compat=True, chunk_size=1200)
+    clean = pipeline.decode_file(str(fa), params, compat=False, chunk_size=1200)
+    # Compat drops the remainder; clean sees every symbol.
+    assert compat.n_symbols <= clean.n_symbols
+    assert clean.n_symbols == len(text)
+
+
+def test_cli_compat_six_arg_form(tmp_path, rng):
+    text, spans = synth_genome(rng, n_islands=3, island_len=400, bg_len=1500)
+    train_f = tmp_path / "train.txt"
+    test_f = tmp_path / "test.txt"
+    train_f.write_text(text)
+    test_f.write_text(text)
+    islands_f = tmp_path / "islands.out"
+    model_f = tmp_path / "model.out"
+    rc = cli.main([str(train_f), str(test_f), str(islands_f), str(model_f), "0.005", "2"])
+    assert rc == 0
+    model_lines = model_f.read_text().splitlines()
+    assert len(model_lines) == 24  # 8 states x 3 lines, reference layout
+    assert islands_f.exists()
+
+
+def test_cli_subcommands(tmp_path, rng, capsys):
+    text, _ = synth_genome(rng, n_islands=2, island_len=300, bg_len=800)
+    fa = tmp_path / "g.txt"
+    fa.write_text(text)
+    m = tmp_path / "m.txt"
+    rc = cli.main(["train", str(fa), "--model-out", str(m), "--iters", "2"])
+    assert rc == 0
+    assert "trained:" in capsys.readouterr().out
+
+    out = tmp_path / "i.txt"
+    rc = cli.main(["decode", str(fa), "--model", str(m), "--islands-out", str(out), "--clean"])
+    assert rc == 0
+    assert "islands" in capsys.readouterr().out
+    assert out.exists()
+
+
+def test_cli_spmd_backend(tmp_path, rng):
+    text, _ = synth_genome(rng, n_islands=2, island_len=300, bg_len=800)
+    fa = tmp_path / "g.txt"
+    fa.write_text(text)
+    m = tmp_path / "m.txt"
+    rc = cli.main(["train", str(fa), "--model-out", str(m), "--iters", "1", "--backend", "spmd"])
+    assert rc == 0

@@ -1,0 +1,104 @@
+"""E-step statistics vs the NumPy oracle, both numerics modes, pad handling."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cpgisland_tpu.models.hmm import HmmParams
+from cpgisland_tpu.ops import forward_backward as FB
+from tests import oracle
+
+
+def _random_model(rng, k=3, m=4):
+    pi = rng.dirichlet(np.ones(k))
+    A = rng.dirichlet(np.ones(k), size=k)
+    B = rng.dirichlet(np.ones(m), size=k)
+    return pi, A, B
+
+
+def _oracle_stats(pi, A, B, obs):
+    gamma, xi_sum, ll = oracle.forward_backward_oracle(pi, A, B, obs)
+    emit = np.zeros_like(B)
+    for s in range(B.shape[1]):
+        emit[:, s] = gamma[np.asarray(obs) == s].sum(axis=0)
+    return gamma[0], xi_sum, emit, ll
+
+
+@pytest.mark.parametrize("mode", ["log", "rescaled"])
+@pytest.mark.parametrize("T", [1, 2, 5, 64])
+def test_chunk_stats_matches_oracle(rng, mode, T):
+    for _ in range(4):
+        pi, A, B = _random_model(rng)
+        obs = rng.integers(0, 4, size=T)
+        params = HmmParams.from_probs(pi, A, B)
+        st = FB.chunk_stats(params, jnp.asarray(obs), jnp.int32(T), mode=mode)
+        g0, xi, emit, ll = _oracle_stats(pi, A, B, obs)
+        np.testing.assert_allclose(np.asarray(st.init), g0, atol=2e-4)
+        np.testing.assert_allclose(np.asarray(st.trans), xi, atol=2e-3)
+        np.testing.assert_allclose(np.asarray(st.emit), emit, atol=2e-3)
+        assert float(st.loglik) == pytest.approx(ll, abs=2e-2, rel=1e-4)
+        assert int(st.n_seqs) == 1
+
+
+@pytest.mark.parametrize("mode", ["log", "rescaled"])
+def test_padded_equals_truncated(rng, mode):
+    pi, A, B = _random_model(rng)
+    params = HmmParams.from_probs(pi, A, B)
+    obs = rng.integers(0, 4, size=40)
+    full = FB.chunk_stats(params, jnp.asarray(obs), jnp.int32(40), mode=mode)
+    padded = np.concatenate([obs, np.full(24, 4)]).astype(np.int32)
+    part = FB.chunk_stats(params, jnp.asarray(padded), jnp.int32(40), mode=mode)
+    for a, b in zip(
+        (full.init, full.trans, full.emit, full.loglik),
+        (part.init, part.trans, part.emit, part.loglik),
+    ):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4)
+
+
+@pytest.mark.parametrize("mode", ["log", "rescaled"])
+def test_zero_length_chunk_contributes_nothing(mode, rng):
+    pi, A, B = _random_model(rng)
+    params = HmmParams.from_probs(pi, A, B)
+    empty = jnp.full(16, 4, dtype=jnp.int32)
+    st = FB.chunk_stats(params, empty, jnp.int32(0), mode=mode)
+    assert float(jnp.sum(st.init)) == 0.0
+    assert float(jnp.sum(st.trans)) == 0.0
+    assert float(jnp.sum(st.emit)) == 0.0
+    assert float(st.loglik) == 0.0
+    assert int(st.n_seqs) == 0
+
+
+def test_log_vs_rescaled_agree(rng):
+    pi, A, B = _random_model(rng, k=4)
+    params = HmmParams.from_probs(pi, A, B)
+    obs = jnp.asarray(rng.integers(0, 4, size=256))
+    a = FB.chunk_stats(params, obs, jnp.int32(256), mode="log")
+    b = FB.chunk_stats(params, obs, jnp.int32(256), mode="rescaled")
+    np.testing.assert_allclose(np.asarray(a.trans), np.asarray(b.trans), rtol=1e-3, atol=1e-2)
+    np.testing.assert_allclose(np.asarray(a.emit), np.asarray(b.emit), rtol=1e-3, atol=1e-2)
+    assert float(a.loglik) == pytest.approx(float(b.loglik), rel=1e-4)
+
+
+def test_batch_stats_sums_chunks(rng):
+    pi, A, B = _random_model(rng)
+    params = HmmParams.from_probs(pi, A, B)
+    chunks = rng.integers(0, 4, size=(6, 32)).astype(np.int32)
+    lengths = np.full(6, 32, dtype=np.int32)
+    batched = FB.batch_stats(params, jnp.asarray(chunks), jnp.asarray(lengths))
+    total = FB.SuffStats.zeros(3, 4)
+    for i in range(6):
+        total = total + FB.chunk_stats(params, jnp.asarray(chunks[i]), jnp.int32(32))
+    np.testing.assert_allclose(np.asarray(batched.trans), np.asarray(total.trans), atol=1e-3)
+    assert int(batched.n_seqs) == 6
+
+
+def test_one_hot_emissions_are_fixed_point(rng):
+    """Structural zeros must accumulate exactly zero count (SURVEY.md C5)."""
+    from cpgisland_tpu.models import presets
+
+    params = presets.durbin_cpg8()
+    obs = jnp.asarray(rng.integers(0, 4, size=128))
+    st = FB.chunk_stats(params, obs, jnp.int32(128))
+    emit = np.asarray(st.emit)
+    B = np.asarray(params.B)
+    assert (emit[B == 0] == 0).all()

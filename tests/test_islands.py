@@ -1,0 +1,105 @@
+"""Vectorized island caller vs the reference-semantics oracle state machine."""
+
+import numpy as np
+import pytest
+
+from cpgisland_tpu.ops import islands as I
+from tests import oracle
+
+
+def _random_paths(rng, n=200, maxlen=400):
+    for _ in range(n):
+        T = int(rng.integers(1, maxlen))
+        # Mix of regimes to generate many island open/close events.
+        p = rng.integers(0, 8, size=T)
+        yield p
+
+
+def test_fuzz_matches_oracle(rng):
+    checked = emitted = 0
+    for path in _random_paths(rng):
+        got = I.call_islands(path, chunk=0).as_tuples()
+        want = oracle.islands_oracle(path)
+        assert len(got) == len(want), f"count mismatch on path len {len(path)}"
+        for g, w in zip(got, want):
+            assert g[0] == w[0] and g[1] == w[1] and g[2] == w[2]
+            assert g[3] == pytest.approx(w[3])
+            assert g[4] == pytest.approx(w[4])
+        checked += 1
+        emitted += len(got)
+    assert emitted > 50  # the fuzz actually exercised emissions
+
+
+def test_structured_runs_match_oracle(rng):
+    # Longer runs (islands of length ~50) rather than white noise.
+    for _ in range(30):
+        segs = []
+        for _s in range(rng.integers(2, 10)):
+            state = int(rng.integers(0, 8))
+            segs.append(np.full(rng.integers(1, 60), state))
+        path = np.concatenate(segs)
+        got = I.call_islands(path).as_tuples()
+        want = oracle.islands_oracle(path)
+        assert len(got) == len(want)
+        for g, w in zip(got, want):
+            assert g[:3] == w[:3]
+            assert g[3] == pytest.approx(w[3])
+            assert g[4] == pytest.approx(w[4])
+
+
+def test_chunk_offset_matches_oracle(rng):
+    path = np.asarray([4, 1, 2, 1, 2, 4])
+    got = I.call_islands(path, chunk=3).as_tuples()
+    want = oracle.islands_oracle(path, chunk=3)
+    assert got == [
+        (w[0], w[1], w[2], pytest.approx(w[3]), pytest.approx(w[4])) for w in want
+    ]
+    assert got[0][0] == 1 + 3 * 0x100000 + 1
+
+
+def test_stale_atc_quirk_compat_vs_clean():
+    # C+-island closes, new island opens on A+ then G+: compat counts a stale
+    # CpG (java:325-331 never clears atC on non-C opening); clean must not.
+    path = np.asarray([1, 1, 2, 1] + [4] + [0, 2, 2, 1, 2] + [4])
+    compat = I.call_islands(path, compat=True)
+    clean = I.call_islands(path, compat=False)
+    want = oracle.islands_oracle(path)
+    assert compat.as_tuples()[-1][4] == pytest.approx(want[-1][4])
+    # island 2: len 5, c=1, g=3; compat cg = stale(1)+real(1)=2, clean cg=1.
+    assert compat.oe_ratio[-1] == pytest.approx(2 * 5 / (1 * 3))
+    assert clean.oe_ratio[-1] == pytest.approx(1 * 5 / (1 * 3))
+
+
+def test_open_at_end_compat_vs_clean():
+    path = np.asarray([4, 4] + [1, 2] * 30)
+    assert len(I.call_islands(path, compat=True)) == 0  # reference drops it
+    clean = I.call_islands(path, compat=False)
+    assert len(clean) == 1
+    assert clean.end[0] == len(path)  # 1-based inclusive end == T
+
+
+def test_min_len_filter_clean_only():
+    path = np.asarray([4] + [1, 2] * 10 + [4])  # 20 bp island
+    assert len(I.call_islands(path, compat=False, min_len=200)) == 0
+    assert len(I.call_islands(path, compat=False, min_len=None)) == 1
+    # compat ignores min_len (reference has it commented out, java:285)
+    assert len(I.call_islands(path, compat=True)) == 1
+
+
+def test_format_lines_reference_format():
+    path = np.asarray([4] + [1, 2] * 10 + [4])
+    out = I.call_islands(path).format_lines()
+    assert out == "2 21 20 1.000000 2.000000\n"
+
+
+def test_empty_and_all_background():
+    assert len(I.call_islands(np.zeros(0, dtype=np.int64))) == 0
+    assert len(I.call_islands(np.full(100, 5))) == 0
+
+
+def test_concatenate():
+    a = I.call_islands(np.asarray([4, 1, 2, 1, 2, 4]), chunk=0, chunk_size=10)
+    b = I.call_islands(np.asarray([4, 1, 2, 1, 2, 4]), chunk=1, chunk_size=10)
+    cat = I.IslandCalls.concatenate([a, b])
+    assert len(cat) == 2 and cat.beg[1] == cat.beg[0] + 10
+    assert len(I.IslandCalls.concatenate([])) == 0
