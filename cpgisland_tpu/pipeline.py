@@ -28,7 +28,8 @@ from cpgisland_tpu.models import presets
 from cpgisland_tpu.models.hmm import HmmParams, dump_text
 from cpgisland_tpu.ops import islands as islands_mod
 from cpgisland_tpu.ops.islands import IslandCalls
-from cpgisland_tpu.ops.viterbi import viterbi_batch
+from cpgisland_tpu.ops.viterbi_parallel import viterbi_parallel_batch
+from cpgisland_tpu.parallel.decode import viterbi_sharded
 from cpgisland_tpu.train import baum_welch
 from cpgisland_tpu.train.backends import EStepBackend
 from cpgisland_tpu.utils import chunking, codec
@@ -76,6 +77,13 @@ class DecodeResult:
     n_chunks: int
 
 
+# Largest sequence decoded as one exact global decode in clean mode.  256 Mi
+# symbols (int32 on device plus int8 backpointers) fits one v5e chip's HBM and
+# covers every human chromosome; longer inputs fall back to span-wise decoding
+# with a DP restart at span boundaries (logged).
+CLEAN_DECODE_SPAN = 1 << 28
+
+
 def decode_file(
     test_path: str,
     params: HmmParams,
@@ -86,52 +94,70 @@ def decode_file(
     chunk_size: int = chunking.DECODE_CHUNK,
     device_batch: int = 8,
     min_len: Optional[int] = None,
+    span: int = CLEAN_DECODE_SPAN,
 ) -> DecodeResult:
     """Viterbi-decode a sequence file and call CpG islands (reference
     ``testModel``).
 
-    compat mode decodes each chunk independently and resets the island caller
-    per chunk (the reference's boundary-clipping behavior); clean mode stitches
-    chunk paths into one global path before island calling.  (Until the
-    sequence-parallel decoder, chunk boundaries still restart the DP itself in
-    both modes; clean mode removes the island-call clipping.)
+    compat mode decodes 1 MiB chunks independently and resets the island
+    caller per chunk (the reference's boundary behavior,
+    CpGIslandFinder.java:256,262-268).  clean mode runs ONE exact global
+    decode (sequence-parallel over all local devices) and calls islands over
+    the whole path — no DP restarts, no island clipping.
     """
     symbols = codec.encode_file(test_path, skip_headers=not compat)
-    chunked = chunking.frame(symbols, chunk_size, drop_remainder=compat)
-    chunks, lengths = chunked.chunks, chunked.lengths
-    n = chunked.num_chunks
-
-    parts: list[IslandCalls] = []
-    paths_np: list[np.ndarray] = []
-    for lo in range(0, n, device_batch):
-        hi = min(lo + device_batch, n)
-        batch_paths = viterbi_batch(
-            params,
-            jnp.asarray(chunks[lo:hi]),
-            jnp.asarray(lengths[lo:hi]),
-            return_score=False,
-        )
-        batch_paths = np.asarray(batch_paths)
-        for i in range(hi - lo):
-            L = int(lengths[lo + i])
-            path = batch_paths[i][:L]
-            if compat:
-                parts.append(
-                    islands_mod.call_islands(
-                        path, chunk=lo + i, chunk_size=chunk_size, compat=True
-                    )
-                )
-            else:
-                paths_np.append(path)
 
     if compat:
-        calls = IslandCalls.concatenate(parts)
-    else:
-        full = np.concatenate(paths_np) if paths_np else np.zeros(0, dtype=np.int32)
-        calls = islands_mod.call_islands(full, chunk=0, compat=False, min_len=min_len)
-        if state_path_out is not None:
-            np.save(state_path_out, full.astype(np.int8))
+        chunked = chunking.frame(symbols, chunk_size, drop_remainder=True)
+        chunks, lengths = chunked.chunks, chunked.lengths
+        n = chunked.num_chunks
+        parts: list[IslandCalls] = []
+        for lo in range(0, n, device_batch):
+            hi = min(lo + device_batch, n)
+            batch_paths = np.asarray(
+                viterbi_parallel_batch(
+                    params,
+                    jnp.asarray(chunks[lo:hi]),
+                    jnp.asarray(lengths[lo:hi]),
+                    return_score=False,
+                )
+            )
+            parts.extend(
+                islands_mod.call_islands(
+                    batch_paths[i][: int(lengths[lo + i])],
+                    chunk=lo + i,
+                    chunk_size=chunk_size,
+                    compat=True,
+                )
+                for i in range(hi - lo)
+            )
+        return _finish_decode(
+            IslandCalls.concatenate(parts), chunked.total, n, islands_out
+        )
 
+    # Clean path: exact global decode, span-wise only if the input exceeds the
+    # device-memory span budget.
+    n_spans = max(1, -(-symbols.size // span))
+    if n_spans > 1:
+        log.warning(
+            "input (%d symbols) exceeds the exact-decode span (%d); decoding "
+            "%d spans with a DP restart at each span boundary",
+            symbols.size,
+            span,
+            n_spans,
+        )
+    pieces = [
+        viterbi_sharded(params, symbols[lo : lo + span])
+        for lo in range(0, symbols.size, span)
+    ] or [np.zeros(0, dtype=np.int32)]
+    full = np.concatenate(pieces)
+    calls = islands_mod.call_islands(full, chunk=0, compat=False, min_len=min_len)
+    if state_path_out is not None:
+        np.save(state_path_out, full.astype(np.int8))
+    return _finish_decode(calls, symbols.size, n_spans, islands_out)
+
+
+def _finish_decode(calls, n_symbols, n_chunks, islands_out) -> DecodeResult:
     if islands_out is not None:
         own = isinstance(islands_out, str)
         f = open(islands_out, "w") if own else islands_out
@@ -140,7 +166,7 @@ def decode_file(
         finally:
             if own:
                 f.close()
-    return DecodeResult(calls=calls, n_symbols=int(chunked.total), n_chunks=n)
+    return DecodeResult(calls=calls, n_symbols=int(n_symbols), n_chunks=int(n_chunks))
 
 
 def run(

@@ -1,0 +1,90 @@
+"""Sequence-parallel sharded Viterbi vs single-device decoders (8-dev CPU mesh)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cpgisland_tpu.models import presets
+from cpgisland_tpu.models.hmm import HmmParams
+from cpgisland_tpu.ops import viterbi as V
+from cpgisland_tpu.ops import viterbi_parallel as VP
+from cpgisland_tpu.parallel import decode as PD
+from cpgisland_tpu.parallel.mesh import make_mesh
+
+
+def _path_score(params, obs, path):
+    lp, lA, lB = (np.asarray(x, np.float64) for x in (params.log_pi, params.log_A, params.log_B))
+    s = lp[path[0]] + lB[path[0], obs[0]]
+    for t in range(1, len(obs)):
+        s += lA[path[t - 1], path[t]] + lB[path[t], obs[t]]
+    return s
+
+
+def test_eight_devices_present():
+    assert len(jax.devices()) == 8
+
+
+def test_sharded_matches_single_device_durbin(rng):
+    params = presets.durbin_cpg8()
+    bg = rng.choice([0, 3], size=3000)
+    island = np.tile([1, 2], 400)
+    obs = np.concatenate([bg, island, bg]).astype(np.int32)
+    single = np.asarray(VP.viterbi_parallel(params, jnp.asarray(obs), return_score=False))
+    sharded = PD.viterbi_sharded(params, obs, block_size=64)
+    np.testing.assert_array_equal(single, sharded)
+
+
+def test_sharded_achieves_optimal_score_random_model(rng):
+    pi = rng.dirichlet(np.ones(4))
+    A = rng.dirichlet(np.ones(4), size=4)
+    B = rng.dirichlet(np.ones(4), size=4)
+    params = HmmParams.from_probs(pi, A, B)
+    obs = rng.integers(0, 4, size=2048).astype(np.int32)
+    _, s_opt = V.viterbi(params, jnp.asarray(obs))
+    path = PD.viterbi_sharded(params, obs, block_size=32)
+    assert _path_score(params, obs, path) == pytest.approx(float(s_opt), abs=2e-2, rel=1e-5)
+
+
+def test_sharded_pads_uneven_lengths(rng):
+    params = presets.durbin_cpg8()
+    obs = rng.integers(0, 4, size=1234).astype(np.int32)  # not divisible by 8*64
+    path = PD.viterbi_sharded(params, obs, block_size=64)
+    assert path.shape == (1234,)
+    single = np.asarray(VP.viterbi_parallel(params, jnp.asarray(obs), return_score=False))
+    # Same achieved score (ties may reorder path choices).
+    assert _path_score(params, obs, path) == pytest.approx(
+        _path_score(params, obs, single), abs=2e-2
+    )
+
+
+def test_island_not_clipped_across_shard_boundary(rng):
+    """An island spanning a shard boundary must come out contiguous —
+    the artifact the reference exhibits at 1 MiB chunk boundaries."""
+    from cpgisland_tpu.ops import islands as I
+
+    params = presets.durbin_cpg8()
+    n_dev = 8
+    block = 32
+    # Total 8 shards of 512: put one island exactly straddling shards 3|4.
+    L = 512
+    T = n_dev * L
+    obs = np.asarray(rng.choice([0, 3], size=T), dtype=np.int32)
+    mid = 4 * L
+    island = np.tile([1, 2], 300)
+    obs[mid - 300 : mid + 300] = island
+    path = PD.viterbi_sharded(params, obs, block_size=block)
+    calls = I.call_islands(path, compat=False)
+    assert len(calls) == 1
+    assert calls.beg[0] <= mid - 250 and calls.end[0] >= mid + 250
+
+
+def test_explicit_small_mesh(rng):
+    params = presets.durbin_cpg8()
+    mesh = make_mesh(4, axis="seq")
+    obs = rng.integers(0, 4, size=1024).astype(np.int32)
+    path = PD.viterbi_sharded(params, obs, mesh=mesh, block_size=32)
+    single = np.asarray(VP.viterbi_parallel(params, jnp.asarray(obs), return_score=False))
+    assert _path_score(params, obs, path) == pytest.approx(
+        _path_score(params, obs, single), abs=1e-2
+    )

@@ -1,0 +1,99 @@
+"""Parallel blockwise Viterbi vs the sequential scan decoder (exactness)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cpgisland_tpu.models import presets
+from cpgisland_tpu.models.hmm import HmmParams
+from cpgisland_tpu.ops import viterbi as V
+from cpgisland_tpu.ops import viterbi_parallel as VP
+
+
+def _random_model(rng, k=3, m=4):
+    pi = rng.dirichlet(np.ones(k))
+    A = rng.dirichlet(np.ones(k), size=k)
+    B = rng.dirichlet(np.ones(m), size=k)
+    return HmmParams.from_probs(pi, A, B)
+
+
+def _path_score(params, obs, path):
+    lp = np.asarray(params.log_pi)
+    lA = np.asarray(params.log_A)
+    lB = np.asarray(params.log_B)
+    s = lp[path[0]] + lB[path[0], obs[0]]
+    for t in range(1, len(obs)):
+        s += lA[path[t - 1], path[t]] + lB[path[t], obs[t]]
+    return s
+
+
+@pytest.mark.parametrize("T,block", [(1, 4), (2, 4), (5, 4), (16, 4), (17, 4), (64, 8), (100, 16), (257, 32)])
+def test_matches_sequential_scores_and_validity(rng, T, block):
+    for _ in range(3):
+        params = _random_model(rng)
+        obs = jnp.asarray(rng.integers(0, 4, size=T))
+        p_seq, s_seq = V.viterbi(params, obs)
+        p_par, s_par = VP.viterbi_parallel(params, obs, block_size=block)
+        assert float(s_par) == pytest.approx(float(s_seq), abs=2e-2, rel=1e-5)
+        # The parallel path must achieve the optimal score too.
+        got = _path_score(params, np.asarray(obs), np.asarray(p_par))
+        assert got == pytest.approx(float(s_seq), abs=2e-2, rel=1e-5)
+
+
+def test_durbin_exact_path_agreement(rng):
+    # One-hot emissions make the Durbin model effectively tie-free on
+    # CG-structured input; paths should agree exactly.
+    params = presets.durbin_cpg8()
+    bg = rng.choice([0, 3], size=500)
+    island = np.tile([1, 2], 150)
+    obs = jnp.asarray(np.concatenate([bg, island, bg]).astype(np.int32))
+    p_seq = np.asarray(V.viterbi(params, obs, return_score=False))
+    p_par = np.asarray(VP.viterbi_parallel(params, obs, block_size=64, return_score=False))
+    np.testing.assert_array_equal(p_seq, p_par)
+
+
+def test_pad_passthrough(rng):
+    params = _random_model(rng)
+    obs = rng.integers(0, 4, size=70)
+    full, s_full = VP.viterbi_parallel(params, jnp.asarray(obs), block_size=16)
+    padded = np.concatenate([obs, np.full(30, 4)]).astype(np.int32)
+    p, s = VP.viterbi_parallel(params, jnp.asarray(padded), block_size=16)
+    assert float(s) == pytest.approx(float(s_full), abs=1e-3)
+    got = _path_score(params, obs, np.asarray(p)[:70])
+    assert got == pytest.approx(float(s_full), abs=1e-3)
+
+
+def test_batch_matches_single(rng):
+    params = presets.durbin_cpg8()
+    chunks = rng.integers(0, 4, size=(4, 96)).astype(np.int32)
+    chunks[3, 50:] = 4  # padded tail
+    lengths = np.array([96, 96, 96, 50], dtype=np.int32)
+    batch = VP.viterbi_parallel_batch(
+        params, jnp.asarray(chunks), jnp.asarray(lengths), block_size=16, return_score=False
+    )
+    for i in range(4):
+        single = VP.viterbi_parallel(params, jnp.asarray(chunks[i]), block_size=16, return_score=False)
+        np.testing.assert_array_equal(np.asarray(batch[i]), np.asarray(single))
+
+
+def test_block_size_invariance(rng):
+    params = _random_model(rng, k=5)
+    obs = jnp.asarray(rng.integers(0, 4, size=200))
+    ref, s_ref = VP.viterbi_parallel(params, obs, block_size=8)
+    for b in (16, 32, 200, 512):
+        p, s = VP.viterbi_parallel(params, obs, block_size=b)
+        assert float(s) == pytest.approx(float(s_ref), abs=2e-2)
+        got = _path_score(params, np.asarray(obs), np.asarray(p))
+        assert got == pytest.approx(float(s_ref), abs=2e-2)
+
+
+def test_long_sequence_smoke(rng):
+    params = presets.durbin_cpg8()
+    obs = jnp.asarray(rng.integers(0, 4, size=1 << 16))
+    p_par, s_par = VP.viterbi_parallel(params, obs)
+    p_seq, s_seq = V.viterbi(params, obs)
+    # f32 reduction order differs between the two algorithms; exact path
+    # equality below is the strong check.
+    assert float(s_par) == pytest.approx(float(s_seq), rel=1e-4)
+    # On genuinely random input ties are astronomically unlikely with this model.
+    assert (np.asarray(p_par) == np.asarray(p_seq)).mean() > 0.999
