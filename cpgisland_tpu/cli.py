@@ -23,21 +23,53 @@ from __future__ import annotations
 
 import argparse
 import logging
+import os
 import sys
 from typing import Optional, Sequence
-
-from cpgisland_tpu.models import presets
-from cpgisland_tpu.models.hmm import load_text
-from cpgisland_tpu import pipeline
 
 log = logging.getLogger(__name__)
 
 _SUBCOMMANDS = ("train", "decode", "run")
 
 
+def _select_platform(argv: list) -> list:
+    """Apply --platform/-P (or $CPGISLAND_PLATFORM) before any jax use.
+
+    The axon TPU plugin ignores the JAX_PLATFORMS env var, so forcing CPU must
+    go through jax.config — and that must happen before the backend
+    initializes, hence this pre-parse step ahead of the pipeline imports.
+    """
+    platform = os.environ.get("CPGISLAND_PLATFORM", "")
+    out = []
+    i = 0
+    while i < len(argv):
+        a = argv[i]
+        if a in ("--platform", "-P") and i + 1 < len(argv):
+            platform = argv[i + 1]
+            i += 2
+            continue
+        if a.startswith("--platform="):
+            platform = a.split("=", 1)[1]
+            i += 1
+            continue
+        out.append(a)
+        i += 1
+    if platform and platform != "auto":
+        import jax
+
+        jax.config.update("jax_platforms", platform)
+    return out
+
+
 def _common_flags(p: argparse.ArgumentParser) -> None:
     p.add_argument("--backend", choices=("local", "spmd"), default="local")
-    p.add_argument("--numerics", choices=("log", "rescaled"), default="log", dest="mode")
+    p.add_argument("--numerics", choices=("log", "rescaled"), default="rescaled", dest="mode")
+    p.add_argument(
+        "--engine",
+        choices=("auto", "xla", "pallas"),
+        default="auto",
+        help="Viterbi block-pass lowering (auto: Pallas kernels on TPU)",
+    )
     p.add_argument(
         "--clean",
         action="store_true",
@@ -80,7 +112,11 @@ def build_parser() -> argparse.ArgumentParser:
 
 
 def main(argv: Optional[Sequence[str]] = None) -> int:
-    argv = list(sys.argv[1:] if argv is None else argv)
+    argv = _select_platform(list(sys.argv[1:] if argv is None else argv))
+    # Deferred: importing the pipeline pulls in jax; platform choice must win.
+    from cpgisland_tpu import pipeline
+    from cpgisland_tpu.models import presets
+    from cpgisland_tpu.models.hmm import load_text
 
     # Reference-compatible 6-positional-arg form.
     if len(argv) == 6 and argv[0] not in _SUBCOMMANDS:
@@ -132,6 +168,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             islands_out=args.islands_out,
             compat=compat,
             min_len=args.min_len,
+            engine=args.engine,
         )
         print(f"decoded {res.n_symbols} symbols in {res.n_chunks} chunks; {len(res.calls)} islands")
         return 0
@@ -147,6 +184,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             backend=args.backend,
             mode=args.mode,
             compat=compat,
+            engine=args.engine,
         )
         print(f"{len(res.calls)} islands -> {args.islands_out}")
         return 0

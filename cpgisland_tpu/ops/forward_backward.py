@@ -7,10 +7,20 @@ chunk and emit expected initial/transition/emission counts
 "rescaling" numerics flag at :92).  Here a chunk's statistics are computed by
 two `lax.scan` passes fused with the accumulation, in either numerics mode:
 
-- ``mode="log"``     — log-semiring scans (logsumexp recurrences); the default.
 - ``mode="rescaled"``— Rabiner per-timestep rescaling in probability space,
-  matching the reference's configured numerics.  Both modes agree to float
-  tolerance (tested) and both are EM-exact.
+  matching the reference's configured numerics — **the default**.
+- ``mode="log"``     — log-semiring scans (logsumexp recurrences); kept for
+  parity testing and as the template for the max-plus decode scans.
+
+Why rescaled is the default: in float32, log-space gammas come from
+``exp(alpha + beta - loglik)`` where all three terms are O(-1.3·T) — for a
+65,536-symbol chunk that is a ~-85,000 + -85,000 cancellation whose f32
+rounding error (observed: several nats on 46 Kbp) is big enough to break EM's
+monotone-loglik guarantee near convergence.  The rescaled recurrences only
+ever combine O(1) normalized quantities, so f32 stats track a float64 oracle
+to ~0.1 nat over full-size chunks (tested:
+tests/test_baum_welch.py::test_long_chunk_loglik_monotone_rescaled).  TPUs
+have no fast f64 to hide behind — the numerics choice is the fix.
 
 Memory: the forward pass stores alphas ([T, K] — 2 MB for a 64Ki x 8 chunk);
 the backward pass consumes them streamingly and accumulates the [K], [K, K],

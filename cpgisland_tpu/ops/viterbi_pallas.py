@@ -1,0 +1,316 @@
+"""Pallas TPU kernels for the blockwise-parallel Viterbi decode.
+
+Same three-pass algorithm as ops.viterbi_parallel (products -> backpointers ->
+backtrace; see that module's docstring for the math and the reference citation
+CpGIslandFinder.java:256-260), but with the hot per-step loops as fused Pallas
+kernels instead of `lax.scan` over XLA HLO:
+
+- **Lane layout**: decode lanes (sequence blocks) ride the 128-wide TPU lane
+  dimension; the K<=8 state dimension rides sublanes.  Every per-step op is a
+  full-width VPU op — no [8,8] matrices rattling around in padded (8,128)
+  tiles the way the XLA scan lays them out.
+- **Fused step matrices**: M_t[i,j] = logA[i,j] + logB[j, o_t] is built in
+  registers from the symbol byte each step — the [S+1, K, K] table gather /
+  one-hot matmul of the XLA path disappears.
+- **Bit-packed backpointers**: all K argmax pointers of a step pack into one
+  int32 (3 bits x 8 states), so the backtrace state machine is
+  ``state = (packed >> 3*state) & 7`` — 4 bytes/symbol of HBM traffic instead
+  of 8, and the exit->entry composition table threads through the same packing.
+
+The kernels are exact: same scores, same first-argmax tie-breaking as the XLA
+path.  On non-TPU backends `interpret=True` runs them through the Pallas
+interpreter so CI on the virtual CPU mesh exercises identical code.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:  # pltpu imports fail on some non-TPU builds; interpret mode needs only pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    _VMEM = pltpu.VMEM
+except Exception:  # pragma: no cover
+    pltpu = None
+    _VMEM = None
+
+from cpgisland_tpu.models.hmm import LOG_ZERO, HmmParams
+from cpgisland_tpu.ops.viterbi_parallel import maxplus_matmul
+
+LANE_TILE = 128  # lanes per kernel instance = one TPU vreg width
+DEFAULT_BLOCK = 512  # symbols per lane (bk); VMEM per instance stays ~1 MiB
+
+MAX_PACK_STATES = 8  # 3-bit packing: state ids 0..7 -> one int32 per step
+
+
+def _vspec(block_shape=None, index_map=None):
+    if _VMEM is None:
+        return pl.BlockSpec(block_shape, index_map)
+    return pl.BlockSpec(block_shape, index_map, memory_space=_VMEM)
+
+
+def supports(params: HmmParams) -> bool:
+    """Kernel eligibility: the 3-bit backpointer packing needs K <= 8."""
+    return params.n_states <= MAX_PACK_STATES
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _step_mats_const(params: HmmParams):
+    """Kernel operands: log transition/emission matrices as f32 (passed as
+    pallas inputs — kernels may not close over traced values)."""
+    K, S = params.n_states, params.n_symbols
+    logA = jnp.asarray(params.log_A, jnp.float32)
+    logB = jnp.asarray(params.log_B, jnp.float32)
+    return K, S, logA, logB
+
+
+def _eye_log(K: int, lt: int) -> jnp.ndarray:
+    """[K, K, lt] broadcast max-plus identity, built from iota in-kernel."""
+    i = jax.lax.broadcasted_iota(jnp.int32, (K, K, lt), 0)
+    j = jax.lax.broadcasted_iota(jnp.int32, (K, K, lt), 1)
+    return jnp.where(i == j, 0.0, LOG_ZERO).astype(jnp.float32)
+
+
+def _emit_sel(logB, syms, K, S):
+    """Bsel[j, :] = logB[j, syms[:]] via an unrolled compare-select tree.
+
+    syms: [LT] int32 (PAD >= S allowed — caller masks separately).
+    Returns [K, LT] f32.
+    """
+    out = jnp.zeros((K, syms.shape[-1]), jnp.float32)
+    for s in range(S):
+        out = jnp.where((syms == s)[None, :], logB[:, s][:, None], out)
+    return out
+
+
+def _products_kernel(steps_ref, logA_ref, logB_ref, out_ref, *, K, S, bk):
+    """Pass A: max-plus product of the lane's bk step matrices -> [K*K, LT]."""
+    lt = steps_ref.shape[1]
+    logA = logA_ref[:, :]
+    logB = logB_ref[:, :]
+    eye_b = _eye_log(K, lt)
+    C0 = eye_b
+
+    def body(t, C):
+        syms = steps_ref[t, :]
+        is_pad = (syms >= S)[None, None, :]
+        Bsel = _emit_sel(logB, syms, K, S)  # [K, LT]
+        M = jnp.where(is_pad, eye_b, logA[:, :, None] + Bsel[None, :, :])
+        # new_C[i, j] = max_m C[i, m] + M[m, j]
+        return jnp.max(C[:, :, None, :] + M[None, :, :, :], axis=1)
+
+    C = jax.lax.fori_loop(0, bk, body, C0)
+    out_ref[:, :] = C.reshape(K * K, lt)
+
+
+def _backpointers_kernel(
+    steps_ref, venter_ref, logA_ref, logB_ref, bp_ref, dexit_ref, ftab_ref, *, K, S, bk
+):
+    """Pass B: forward delta recursion with true entering vectors.
+
+    Emits per-step bit-packed backpointers, the block's exit score vector, and
+    the packed exit->entry composition table.
+    """
+    lt = steps_ref.shape[1]
+    logA = logA_ref[:, :]
+    logB = logB_ref[:, :]
+    delta0 = venter_ref[:, :]  # [K, LT]
+    # E_packed[lane] holds E[j] (3 bits each): entry state reached from exit j.
+    e0 = jnp.zeros((lt,), jnp.int32)
+    for j in range(K):
+        e0 = e0 | (j << (3 * j))
+
+    def body(t, carry):
+        delta, E = carry
+        syms = steps_ref[t, :]
+        is_pad = syms >= S
+        Bsel = _emit_sel(logB, syms, K, S)
+        # scores[i, j, :] = delta[i, :] + M[i, j, :] with the emission folded
+        # into M before the max — bit-exact with the XLA twin's rounding and
+        # tie-breaking (viterbi_parallel._pass_backpointers).
+        scores = delta[:, None, :] + (logA[:, :, None] + Bsel[None, :, :])
+        bp = jnp.argmax(scores, axis=0).astype(jnp.int32)  # [K_to, LT]
+        new_delta = jnp.max(scores, axis=0)
+        # PAD -> identity step: delta unchanged, bp[j] = j.
+        jj = jax.lax.broadcasted_iota(jnp.int32, (K, lt), 0)
+        bp = jnp.where(is_pad[None, :], jj, bp)
+        new_delta = jnp.where(is_pad[None, :], delta, new_delta)
+        # Pack this step's K pointers into one int32 per lane.
+        packed = jnp.zeros((lt,), jnp.int32)
+        for j in range(K):
+            packed = packed | (bp[j] << (3 * j))
+        bp_ref[t, :] = packed
+        # Compose: E'[j] = E[bp[j]]  (unpack at a variable offset, repack).
+        newE = jnp.zeros((lt,), jnp.int32)
+        for j in range(K):
+            ej = jnp.right_shift(E, 3 * bp[j]) & 7
+            newE = newE | (ej << (3 * j))
+        return new_delta, newE
+
+    delta, E = jax.lax.fori_loop(0, bk, body, (delta0, e0))
+    dexit_ref[:, :] = delta
+    ftab_ref[0, :] = E
+
+
+def _backtrace_kernel(bp_ref, exit_ref, path_ref, *, bk):
+    """Pass C: walk packed backpointers from the anchored exit state."""
+
+    def body(i, state):
+        t = bk - 1 - i
+        path_ref[t, :] = state.astype(jnp.int8)
+        return jnp.right_shift(bp_ref[t, :], 3 * state) & 7
+
+    jax.lax.fori_loop(0, bk, body, exit_ref[0, :])
+
+
+def _pad_lanes(x, nb_pad, fill):
+    pad = nb_pad - x.shape[-1]
+    if pad == 0:
+        return x
+    return jnp.pad(x, [(0, 0)] * (x.ndim - 1) + [(0, pad)], constant_values=fill)
+
+
+# --- Pass-level API (same contracts as the XLA twins in ops.viterbi_parallel,
+# so parallel.decode can swap engines under shard_map).  Lane counts that are
+# not multiples of LANE_TILE are padded internally with identity blocks and
+# sliced back off.
+
+
+def pass_products(params: HmmParams, steps2: jnp.ndarray):
+    """Pallas twin of viterbi_parallel._pass_products: (incl [nb,K,K], total)."""
+    K, S, logA, logB = _step_mats_const(params)
+    bk, nb = steps2.shape
+    nb_pad = -(-nb // LANE_TILE) * LANE_TILE
+    steps2 = _pad_lanes(steps2, nb_pad, jnp.int32(S))
+    P_flat = pl.pallas_call(
+        functools.partial(_products_kernel, K=K, S=S, bk=bk),
+        grid=(nb_pad // LANE_TILE,),
+        in_specs=[
+            _vspec((bk, LANE_TILE), lambda i: (0, i)),
+            _vspec((K, K), lambda i: (0, 0)),
+            _vspec((K, S), lambda i: (0, 0)),
+        ],
+        out_specs=_vspec((K * K, LANE_TILE), lambda i: (0, i)),
+        out_shape=jax.ShapeDtypeStruct((K * K, nb_pad), jnp.float32),
+        interpret=_interpret(),
+    )(steps2, logA, logB)
+    P = P_flat.T.reshape(nb_pad, K, K)[:nb]
+    incl = jax.lax.associative_scan(maxplus_matmul, P, axis=0)
+    return incl, incl[-1]
+
+
+def pass_backpointers(params: HmmParams, v_enter: jnp.ndarray, steps2: jnp.ndarray):
+    """Pallas twin of viterbi_parallel._pass_backpointers.
+
+    Returns (delta_blocks [nb, K], F [nb, K], bp_packed [bk, nb] int32) — the
+    backpointer blob is bit-packed, consumed only by :func:`pass_backtrace`.
+    """
+    K, S, logA, logB = _step_mats_const(params)
+    bk, nb = steps2.shape
+    nb_pad = -(-nb // LANE_TILE) * LANE_TILE
+    steps2 = _pad_lanes(steps2, nb_pad, jnp.int32(S))
+    v_enter2 = _pad_lanes(v_enter.T, nb_pad, 0.0)
+    bp_packed, dexit, ftab_packed = pl.pallas_call(
+        functools.partial(_backpointers_kernel, K=K, S=S, bk=bk),
+        grid=(nb_pad // LANE_TILE,),
+        in_specs=[
+            _vspec((bk, LANE_TILE), lambda i: (0, i)),
+            _vspec((K, LANE_TILE), lambda i: (0, i)),
+            _vspec((K, K), lambda i: (0, 0)),
+            _vspec((K, S), lambda i: (0, 0)),
+        ],
+        out_specs=[
+            _vspec((bk, LANE_TILE), lambda i: (0, i)),
+            _vspec((K, LANE_TILE), lambda i: (0, i)),
+            _vspec((1, LANE_TILE), lambda i: (0, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bk, nb_pad), jnp.int32),
+            jax.ShapeDtypeStruct((K, nb_pad), jnp.float32),
+            jax.ShapeDtypeStruct((1, nb_pad), jnp.int32),
+        ],
+        interpret=_interpret(),
+    )(steps2, v_enter2, logA, logB)
+    shifts = 3 * jnp.arange(K, dtype=jnp.int32)
+    F = (jnp.right_shift(ftab_packed[0, :nb, None], shifts[None, :]) & 7).astype(jnp.int32)
+    # bp_packed stays lane-padded — it is the dominant buffer (~4 B/symbol) and
+    # pass_backtrace consumes it as-is, deriving nb from len(exits); slicing it
+    # here would materialize an extra HBM copy just to re-pad it there.
+    return dexit.T[:nb], F, bp_packed
+
+
+def pass_backtrace(bp_packed: jnp.ndarray, exits: jnp.ndarray) -> jnp.ndarray:
+    """Pallas twin of viterbi_parallel._pass_backtrace -> [bk*nb] path.
+
+    bp_packed: [bk, >=nb] (possibly lane-padded by pass_backpointers);
+    exits: [nb] — the real lane count.
+    """
+    bk = bp_packed.shape[0]
+    nb = exits.shape[0]
+    nb_pad = -(-bp_packed.shape[1] // LANE_TILE) * LANE_TILE
+    bp_packed = _pad_lanes(bp_packed, nb_pad, 0)
+    exits2 = _pad_lanes(exits[None, :], nb_pad, 0)
+    path2 = pl.pallas_call(
+        functools.partial(_backtrace_kernel, bk=bk),
+        grid=(nb_pad // LANE_TILE,),
+        in_specs=[
+            _vspec((bk, LANE_TILE), lambda i: (0, i)),
+            _vspec((1, LANE_TILE), lambda i: (0, i)),
+        ],
+        out_specs=_vspec((bk, LANE_TILE), lambda i: (0, i)),
+        out_shape=jax.ShapeDtypeStruct((bk, nb_pad), jnp.int8),
+        interpret=_interpret(),
+    )(bp_packed, exits2)
+    return path2[:, :nb].T.reshape(-1).astype(jnp.int32)
+
+
+def _require_support(params):
+    if not supports(params):
+        raise ValueError(
+            f"viterbi_pallas packs backpointers 3 bits/state: needs "
+            f"n_states <= {MAX_PACK_STATES}, got {params.n_states}"
+        )
+
+
+def viterbi_pallas(
+    params: HmmParams,
+    obs: jnp.ndarray,
+    block_size: int = DEFAULT_BLOCK,
+    return_score: bool = True,
+):
+    """Exact Viterbi path via the fused Pallas block kernels (single device).
+
+    Thin front-end over ops.viterbi_parallel.viterbi_parallel(engine="pallas")
+    — one shared wrapper owns the padding / T==1 / entry-state logic for both
+    lowerings, so they cannot drift.  Same PAD semantics, same tie-breaking.
+    """
+    _require_support(params)
+    from cpgisland_tpu.ops.viterbi_parallel import viterbi_parallel
+
+    return viterbi_parallel(
+        params, obs, block_size=block_size, return_score=return_score, engine="pallas"
+    )
+
+
+def viterbi_pallas_batch(
+    params: HmmParams,
+    chunks: jnp.ndarray,
+    lengths: jnp.ndarray,
+    block_size: int = DEFAULT_BLOCK,
+    return_score: bool = True,
+):
+    """Batched decode through the Pallas engine (see viterbi_parallel_batch)."""
+    _require_support(params)
+    from cpgisland_tpu.ops.viterbi_parallel import viterbi_parallel_batch
+
+    return viterbi_parallel_batch(
+        params, chunks, lengths, block_size=block_size, return_score=return_score,
+        engine="pallas",
+    )

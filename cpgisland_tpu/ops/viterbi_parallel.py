@@ -176,12 +176,34 @@ def _pass_backtrace(bps: jnp.ndarray, exits: jnp.ndarray) -> jnp.ndarray:
     return path2.T.reshape(-1)  # global step order
 
 
+def get_passes(engine: str):
+    """Resolve a block-pass engine triple (products, backpointers, backtrace).
+
+    'xla' — the lax.scan implementations in this module; 'pallas' — the fused
+    TPU kernels (ops.viterbi_pallas; imported lazily to avoid a cycle).  The
+    backpointer blob returned by backpointers() is engine-specific and flows
+    opaquely into backtrace().
+    """
+    if engine == "xla":
+        return _pass_products, _pass_backpointers, _pass_backtrace
+    if engine == "pallas":
+        from cpgisland_tpu.ops import viterbi_pallas
+
+        return (
+            viterbi_pallas.pass_products,
+            viterbi_pallas.pass_backpointers,
+            viterbi_pallas.pass_backtrace,
+        )
+    raise ValueError(f"unknown engine {engine!r}; expected xla|pallas")
+
+
 def _block_passes(
     params: HmmParams,
     v_enter0: jnp.ndarray,
     steps: jnp.ndarray,
     block_size: int,
     anchor: jnp.ndarray | None = None,
+    engine: str = "xla",
 ) -> BlockDecode:
     """Run the three block passes over ``steps`` (transition symbols), with
     ``v_enter0`` the score vector entering the first step.
@@ -191,6 +213,7 @@ def _block_passes(
     anchored at the segment end to ``anchor`` if given (sequence-parallel
     callers pass the globally-stitched exit state), else to the local argmax.
     """
+    _pass_products, _pass_backpointers, _pass_backtrace = get_passes(engine)
     nb = steps.shape[0] // block_size
     steps2 = steps.reshape(nb, block_size).T  # [bk, nb] — scan over bk
 
@@ -208,17 +231,20 @@ def _block_passes(
     return BlockDecode(path=path, delta_exit=delta_exit, total=total, ftable=Gsuf[0])
 
 
-@partial(jax.jit, static_argnames=("block_size", "return_score"))
+@partial(jax.jit, static_argnames=("block_size", "return_score", "engine"))
 def viterbi_parallel(
     params: HmmParams,
     obs: jnp.ndarray,
     block_size: int = DEFAULT_BLOCK,
     return_score: bool = True,
+    engine: str = "xla",
 ):
     """Exact Viterbi path via the blockwise parallel scan (single device).
 
     Drop-in equivalent of ops.viterbi.viterbi; PAD symbols (>= n_symbols) are
-    pass-through identity steps, so it also subsumes viterbi_padded.
+    pass-through identity steps, so it also subsumes viterbi_padded.  The
+    ``engine`` selects the block-pass lowering (see :func:`get_passes`); both
+    engines produce identical paths (same rounding, same tie-breaking).
     """
     _, emit_ext = _step_tables(params)
     obs = obs.astype(jnp.int32)
@@ -235,7 +261,7 @@ def viterbi_parallel(
     bk = min(block_size, max(8, S))
     nb = -(-S // bk)
     padded = jnp.concatenate([obs_c[1:], jnp.full(nb * bk - S, pad_sym, jnp.int32)])
-    dec = _block_passes(params, v0, padded, bk)
+    dec = _block_passes(params, v0, padded, bk, engine=engine)
 
     # path[0] (time 0) = entry state of the whole segment.
     s0 = dec.ftable[jnp.argmax(dec.delta_exit)]
@@ -245,13 +271,14 @@ def viterbi_parallel(
     return path, jnp.max(dec.delta_exit)
 
 
-@partial(jax.jit, static_argnames=("block_size", "return_score"))
+@partial(jax.jit, static_argnames=("block_size", "return_score", "engine"))
 def viterbi_parallel_batch(
     params: HmmParams,
     chunks: jnp.ndarray,
     lengths: jnp.ndarray,
     block_size: int = DEFAULT_BLOCK,
     return_score: bool = True,
+    engine: str = "xla",
 ):
     """vmap of viterbi_parallel over a [N, T] batch of padded chunks.
 
@@ -265,5 +292,7 @@ def viterbi_parallel_batch(
         params.n_symbols,
         chunks.astype(jnp.int32),
     )
-    fn = lambda o: viterbi_parallel(params, o, block_size=block_size, return_score=return_score)
+    fn = lambda o: viterbi_parallel(
+        params, o, block_size=block_size, return_score=return_score, engine=engine
+    )
     return jax.vmap(fn)(chunks)

@@ -28,22 +28,37 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from cpgisland_tpu.models.hmm import HmmParams
+from cpgisland_tpu.ops import viterbi_pallas
 from cpgisland_tpu.ops.viterbi_parallel import (
     DEFAULT_BLOCK,
     _enter_vectors,
     _identity_logmat,
-    _pass_backpointers,
-    _pass_backtrace,
-    _pass_products,
     _step_tables,
     _suffix_compositions,
+    get_passes,
     maxplus_matmul,
 )
 from cpgisland_tpu.parallel.mesh import SEQ_AXIS, make_mesh
 
 
-def _shard_body(block_size: int, axis: str):
+def resolve_engine(engine: str, params: HmmParams) -> str:
+    """'auto' picks the Pallas kernels on TPU when the model fits their 3-bit
+    backpointer packing, the XLA scans otherwise (incl. the CPU test mesh,
+    where Pallas would run interpreted)."""
+    if engine == "auto":
+        if jax.default_backend() == "tpu" and viterbi_pallas.supports(params):
+            return "pallas"
+        return "xla"
+    if engine not in ("xla", "pallas"):
+        raise ValueError(f"unknown engine {engine!r}; expected auto|xla|pallas")
+    if engine == "pallas" and not viterbi_pallas.supports(params):
+        raise ValueError(f"pallas engine needs n_states <= 8, got {params.n_states}")
+    return engine
+
+
+def _shard_body(block_size: int, axis: str, engine: str = "xla"):
     """Per-device decode body (runs under shard_map).  obs_shard: [L]."""
+    products, backpointers, backtrace = get_passes(engine)
 
     def body(params: HmmParams, obs_shard: jnp.ndarray) -> jnp.ndarray:
         K = params.n_states
@@ -61,7 +76,7 @@ def _shard_body(block_size: int, axis: str):
         nb = steps.shape[0] // block_size
         steps2 = steps.reshape(nb, block_size).T
 
-        incl, total = _pass_products(params, steps2)
+        incl, total = products(params, steps2)
 
         # Forward stitch: v_enter(shard d) = v0 (x) prod of earlier shards.
         totals = jax.lax.all_gather(total, axis)  # [D, K, K]
@@ -75,7 +90,7 @@ def _shard_body(block_size: int, axis: str):
         v_shard = jnp.max(v0[:, None] + my_prefix, axis=0)  # [K]
 
         v_enter = _enter_vectors(v_shard, incl)
-        delta_blocks, F, bps = _pass_backpointers(params, v_enter, steps2)
+        delta_blocks, F, bps = backpointers(params, v_enter, steps2)
 
         # Backward stitch: global argmax composed through later shards' maps.
         Gsuf = _suffix_compositions(F)
@@ -94,19 +109,26 @@ def _shard_body(block_size: int, axis: str):
 
         # Per-block exits anchored at my_exit, then the light backtrace.
         block_exits = jnp.concatenate([Gsuf[1:, :][:, my_exit], my_exit[None]])
-        return _pass_backtrace(bps, block_exits)
+        return backtrace(bps, block_exits)
 
     return body
 
 
 @functools.lru_cache(maxsize=32)
-def _sharded_fn(mesh: Mesh, block_size: int):
-    """Compile the sharded decode once per (mesh, block_size); params are a
-    traced argument, so model updates never trigger recompilation."""
+def _sharded_fn(mesh: Mesh, block_size: int, engine: str = "xla"):
+    """Compile the sharded decode once per (mesh, block_size, engine); params
+    are a traced argument, so model updates never trigger recompilation."""
     axis = mesh.axis_names[0]
-    body = _shard_body(block_size, axis)
+    body = _shard_body(block_size, axis, engine)
+    # check_vma can't see through pallas_call out_shapes; disable for that engine.
     return jax.jit(
-        jax.shard_map(body, mesh=mesh, in_specs=(P(), P(axis)), out_specs=P(axis))
+        jax.shard_map(
+            body,
+            mesh=mesh,
+            in_specs=(P(), P(axis)),
+            out_specs=P(axis),
+            check_vma=engine != "pallas",
+        )
     )
 
 
@@ -116,6 +138,7 @@ def viterbi_sharded(
     *,
     mesh: Optional[Mesh] = None,
     block_size: int = DEFAULT_BLOCK,
+    engine: str = "auto",
 ) -> np.ndarray:
     """Decode one long sequence sharded over a mesh's devices.
 
@@ -132,6 +155,6 @@ def viterbi_sharded(
     if rem:
         obs = np.concatenate([obs, np.full(rem, pad_sym, dtype=obs.dtype)])
 
-    fn = _sharded_fn(mesh, block_size)
+    fn = _sharded_fn(mesh, block_size, resolve_engine(engine, params))
     arr = jax.device_put(jnp.asarray(obs), NamedSharding(mesh, P(mesh.axis_names[0])))
     return np.asarray(fn(params, arr))[:T]

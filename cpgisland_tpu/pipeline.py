@@ -28,8 +28,9 @@ from cpgisland_tpu.models import presets
 from cpgisland_tpu.models.hmm import HmmParams, dump_text
 from cpgisland_tpu.ops import islands as islands_mod
 from cpgisland_tpu.ops.islands import IslandCalls
+from cpgisland_tpu.ops.viterbi_pallas import viterbi_pallas_batch
 from cpgisland_tpu.ops.viterbi_parallel import viterbi_parallel_batch
-from cpgisland_tpu.parallel.decode import viterbi_sharded
+from cpgisland_tpu.parallel.decode import resolve_engine, viterbi_sharded
 from cpgisland_tpu.train import baum_welch
 from cpgisland_tpu.train.backends import EStepBackend
 from cpgisland_tpu.utils import chunking, codec
@@ -44,7 +45,7 @@ def train_file(
     num_iters: int = 10,
     convergence: float = 0.005,
     backend: Union[EStepBackend, str] = "local",
-    mode: str = "log",
+    mode: str = "rescaled",
     compat: bool = True,
     chunk_size: int = chunking.TRAIN_CHUNK,
     checkpoint_dir: Optional[str] = None,
@@ -95,6 +96,7 @@ def decode_file(
     device_batch: int = 8,
     min_len: Optional[int] = None,
     span: int = CLEAN_DECODE_SPAN,
+    engine: str = "auto",
 ) -> DecodeResult:
     """Viterbi-decode a sequence file and call CpG islands (reference
     ``testModel``).
@@ -106,6 +108,11 @@ def decode_file(
     the whole path — no DP restarts, no island clipping.
     """
     symbols = codec.encode_file(test_path, skip_headers=not compat)
+    batch_decode = (
+        viterbi_pallas_batch
+        if resolve_engine(engine, params) == "pallas"
+        else viterbi_parallel_batch
+    )
 
     if compat:
         chunked = chunking.frame(symbols, chunk_size, drop_remainder=True)
@@ -115,7 +122,7 @@ def decode_file(
         for lo in range(0, n, device_batch):
             hi = min(lo + device_batch, n)
             batch_paths = np.asarray(
-                viterbi_parallel_batch(
+                batch_decode(
                     params,
                     jnp.asarray(chunks[lo:hi]),
                     jnp.asarray(lengths[lo:hi]),
@@ -147,7 +154,7 @@ def decode_file(
             n_spans,
         )
     pieces = [
-        viterbi_sharded(params, symbols[lo : lo + span])
+        viterbi_sharded(params, symbols[lo : lo + span], engine=engine)
         for lo in range(0, symbols.size, span)
     ] or [np.zeros(0, dtype=np.int32)]
     full = np.concatenate(pieces)
@@ -179,10 +186,11 @@ def run(
     *,
     params: Optional[HmmParams] = None,
     backend: Union[EStepBackend, str] = "local",
-    mode: str = "log",
+    mode: str = "rescaled",
     compat: bool = True,
     checkpoint_dir: Optional[str] = None,
     min_len: Optional[int] = None,
+    engine: str = "auto",
 ) -> DecodeResult:
     """The reference's full main(): train, dump model, decode, write islands
     (CpGIslandFinder.java:346-357)."""
@@ -203,4 +211,5 @@ def run(
         islands_out=islands_out,
         compat=compat,
         min_len=min_len,
+        engine=engine,
     )

@@ -55,7 +55,7 @@ class EStepBackend:
 class LocalBackend(EStepBackend):
     """Single-device vmap mapper + sum reducer."""
 
-    def __init__(self, mode: str = "log"):
+    def __init__(self, mode: str = "rescaled"):
         self.mode = mode
 
     def __call__(self, params, chunks, lengths):
@@ -71,7 +71,7 @@ class SpmdBackend(EStepBackend):
     replicated, mirroring the reference's distributed-cache broadcast.
     """
 
-    def __init__(self, mesh: Optional[Mesh] = None, mode: str = "log", axis: str = "data"):
+    def __init__(self, mesh: Optional[Mesh] = None, mode: str = "rescaled", axis: str = "data"):
         self.mesh = mesh if mesh is not None else make_mesh()
         self.mode = mode
         self.axis = axis
@@ -118,7 +118,7 @@ class SpmdBackend(EStepBackend):
         return self._estep(params, chunks, lengths)
 
 
-def get_backend(name: str = "local", *, mode: str = "log", mesh: Optional[Mesh] = None) -> EStepBackend:
+def get_backend(name: str = "local", *, mode: str = "rescaled", mesh: Optional[Mesh] = None) -> EStepBackend:
     """Backend factory — the runtime flag the north star asks for."""
     if name == "local":
         return LocalBackend(mode=mode)

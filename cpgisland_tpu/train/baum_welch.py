@@ -71,7 +71,7 @@ def fit(
     num_iters: int = 10,
     convergence: float = 0.005,
     backend: EStepBackend | str = "local",
-    mode: str = "log",
+    mode: str = "rescaled",
     checkpoint_dir: Optional[str] = None,
     callback: Optional[Callable[[int, float, float], None]] = None,
     start_iteration: int = 0,
@@ -126,7 +126,7 @@ def resume(
     num_iters: int = 10,
     convergence: float = 0.005,
     backend: EStepBackend | str = "local",
-    mode: str = "log",
+    mode: str = "rescaled",
 ) -> FitResult:
     """Resume training from the latest checkpoint in a directory.
 

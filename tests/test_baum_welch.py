@@ -122,3 +122,18 @@ def test_mstep_zero_count_rows_keep_previous():
     new = baum_welch.mstep(params, stats)
     np.testing.assert_allclose(np.asarray(new.A), np.asarray(params.A), atol=1e-6)
     np.testing.assert_allclose(np.asarray(new.B), np.asarray(params.B), atol=1e-6)
+
+
+def test_long_chunk_loglik_monotone_rescaled(rng):
+    """Regression: f32 log-mode E-step loses monotonicity on long chunks (the
+    alpha+beta-loglik cancellation); the rescaled default must not.  46 Kbp of
+    island/background mixture, full EM run, loglik strictly non-decreasing."""
+    bg = rng.choice(4, size=40000, p=[0.3, 0.2, 0.2, 0.3])
+    isl = rng.choice(4, size=6000, p=[0.1, 0.4, 0.4, 0.1])
+    syms = np.concatenate([bg[:20000], isl, bg[20000:]]).astype(np.uint8)
+    ck = chunking.frame(syms, 0x10000, drop_remainder=False)
+    res = baum_welch.fit(
+        presets.durbin_cpg8(), ck, num_iters=8, convergence=0.0, mode="rescaled"
+    )
+    lls = res.logliks
+    assert all(b >= a - 1e-2 for a, b in zip(lls, lls[1:])), lls

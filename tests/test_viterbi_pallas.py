@@ -1,0 +1,109 @@
+"""Pallas Viterbi kernels vs. the XLA blockwise decoder and the oracle.
+
+On the CPU test platform the kernels run through the Pallas interpreter
+(identical math, same code path that compiles on TPU).
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cpgisland_tpu.models import presets
+from cpgisland_tpu.models.hmm import HmmParams
+from cpgisland_tpu.ops.viterbi import viterbi
+from cpgisland_tpu.ops.viterbi_pallas import (
+    supports,
+    viterbi_pallas,
+    viterbi_pallas_batch,
+)
+from cpgisland_tpu.ops.viterbi_parallel import viterbi_parallel, viterbi_parallel_batch
+
+from tests.oracle import viterbi_oracle
+
+
+def _tie_free_params(rng, K=8, S=4):
+    """Random dense params with iid-perturbed logits — argmax ties have
+    probability ~0, so exact path comparison is meaningful."""
+    pi = rng.dirichlet(np.ones(K))
+    A = rng.dirichlet(np.ones(K), size=K)
+    B = rng.dirichlet(np.ones(S), size=K)
+    return HmmParams.from_probs(pi, A, B)
+
+
+def test_matches_oracle_small(rng):
+    params = _tie_free_params(rng)
+    obs = rng.integers(0, 4, size=301)
+    path, score = viterbi_pallas(params, jnp.asarray(obs), block_size=16)
+    o_path, o_score = viterbi_oracle(
+        np.asarray(params.pi), np.asarray(params.A), np.asarray(params.B), obs
+    )
+    np.testing.assert_allclose(float(score), o_score, rtol=1e-5)
+    np.testing.assert_array_equal(np.asarray(path), o_path)
+
+
+def test_matches_xla_parallel_exactly(rng):
+    params = _tie_free_params(rng)
+    obs = jnp.asarray(rng.integers(0, 4, size=8192))
+    p1, s1 = viterbi_parallel(params, obs, block_size=64)
+    p2, s2 = viterbi_pallas(params, obs, block_size=64)
+    np.testing.assert_allclose(float(s1), float(s2), rtol=1e-5)
+    np.testing.assert_array_equal(np.asarray(p1), np.asarray(p2))
+
+
+def test_durbin_preset_score_parity(rng):
+    # The flagship one-hot-emission model: exact ties are possible, so compare
+    # achieved path scores (both must be optimal) and island-relevant strand.
+    params = presets.durbin_cpg8()
+    obs = jnp.asarray(rng.integers(0, 4, size=4096))
+    p_seq, s_seq = viterbi(params, obs)
+    p_pal, s_pal = viterbi_pallas(params, obs, block_size=128)
+    np.testing.assert_allclose(float(s_seq), float(s_pal), rtol=1e-5)
+    # One-hot emissions force state ≡ symbol (mod 4) everywhere on any optimal path.
+    np.testing.assert_array_equal(np.asarray(p_pal) % 4, np.asarray(obs) % 4)
+
+
+def test_pad_symbols_are_identity_steps(rng):
+    params = _tie_free_params(rng)
+    base = rng.integers(0, 4, size=500)
+    padded = np.concatenate([base, np.full(124, 4)])
+    p_base = viterbi_pallas(params, jnp.asarray(base), block_size=32, return_score=False)
+    p_pad = viterbi_pallas(params, jnp.asarray(padded), block_size=32, return_score=False)
+    np.testing.assert_array_equal(np.asarray(p_pad)[:500], np.asarray(p_base))
+
+
+def test_batch_matches_xla_batch(rng):
+    params = _tie_free_params(rng)
+    chunks = jnp.asarray(rng.integers(0, 4, size=(3, 1024)))
+    lengths = jnp.asarray([1024, 700, 1])
+    p1 = viterbi_parallel_batch(params, chunks, lengths, block_size=64, return_score=False)
+    p2 = viterbi_pallas_batch(params, chunks, lengths, block_size=64, return_score=False)
+    for i, n in enumerate([1024, 700, 1]):
+        np.testing.assert_array_equal(np.asarray(p1)[i, :n], np.asarray(p2)[i, :n])
+
+
+def test_non_multiple_block_sizes(rng):
+    params = _tie_free_params(rng)
+    obs = jnp.asarray(rng.integers(0, 4, size=997))  # prime length
+    p_ref = viterbi_parallel(params, obs, block_size=64, return_score=False)
+    p_pal = viterbi_pallas(params, obs, block_size=64, return_score=False)
+    np.testing.assert_array_equal(np.asarray(p_ref), np.asarray(p_pal))
+
+
+def test_rejects_large_state_spaces(rng):
+    params = _tie_free_params(rng, K=9)
+    assert not supports(params)
+    with pytest.raises(ValueError, match="n_states"):
+        viterbi_pallas(params, jnp.zeros(16, jnp.int32))
+
+
+def test_sharded_decode_pallas_engine(rng):
+    """Pallas passes under shard_map on the 8-device mesh == XLA engine."""
+    from cpgisland_tpu.parallel.decode import viterbi_sharded
+    from cpgisland_tpu.parallel.mesh import make_mesh
+
+    params = _tie_free_params(rng)
+    obs = rng.integers(0, 4, size=8 * 512 + 77).astype(np.int32)
+    mesh = make_mesh(8, axis="seq")
+    p_xla = viterbi_sharded(params, obs, mesh=mesh, block_size=64, engine="xla")
+    p_pal = viterbi_sharded(params, obs, mesh=mesh, block_size=64, engine="pallas")
+    np.testing.assert_array_equal(p_xla, p_pal)
