@@ -10,7 +10,8 @@ vs_baseline = 60 s / projected_s: the north star is "< 60 s on one v5e-8", so
 vs_baseline > 1.0 means the target is beaten, and by how much.  (The reference
 itself publishes no numbers — BASELINE.md — so the north star is the bar.)
 
-Usage: python bench.py [--decode-mib 64] [--em-chunks 128] [--json-only]
+Usage: python bench.py [--decode-mib 64] [--em-chunks 128] [--engine auto]
+       [--platform auto]
 """
 
 from __future__ import annotations
@@ -33,18 +34,20 @@ def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
 
 
-def bench_decode(n_symbols: int) -> float:
+def bench_decode(n_symbols: int, engine: str = "auto") -> float:
     """Measure single-chip blockwise-parallel Viterbi throughput (sym/s)."""
     import jax
     import jax.numpy as jnp
 
     from cpgisland_tpu.models import presets
     from cpgisland_tpu.ops.viterbi_parallel import viterbi_parallel
+    from cpgisland_tpu.parallel.decode import resolve_engine
 
     params = presets.durbin_cpg8()
+    eng = resolve_engine(engine, params)
     rng = np.random.default_rng(0)
     obs = jnp.asarray(rng.integers(0, 4, size=n_symbols, dtype=np.int32))
-    fn = jax.jit(lambda o: viterbi_parallel(params, o, return_score=False))
+    fn = jax.jit(lambda o: viterbi_parallel(params, o, return_score=False, engine=eng))
     path = fn(obs)
     path.block_until_ready()  # compile + warm
     best = float("inf")
@@ -53,7 +56,7 @@ def bench_decode(n_symbols: int) -> float:
         fn(obs).block_until_ready()
         best = min(best, time.perf_counter() - t0)
     tput = n_symbols / best
-    log(f"decode: {tput/1e6:.1f} Msym/s ({best*1e3:.0f} ms / {n_symbols/2**20:.0f} MiB)")
+    log(f"decode[{eng}]: {tput/1e6:.1f} Msym/s ({best*1e3:.0f} ms / {n_symbols/2**20:.0f} MiB)")
     return tput
 
 
@@ -73,7 +76,7 @@ def bench_em(n_chunks: int, chunk_size: int = 0x10000) -> float:
 
     @jax.jit
     def em_iter(p):
-        return mstep(p, batch_stats(p, chunks, lengths))
+        return mstep(p, batch_stats(p, chunks, lengths, mode="rescaled"))
 
     p = em_iter(params)
     jax.block_until_ready(p)  # compile + warm
@@ -92,13 +95,17 @@ def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--decode-mib", type=int, default=64)
     ap.add_argument("--em-chunks", type=int, default=128)
+    ap.add_argument("--engine", default="auto", choices=("auto", "xla", "pallas"))
+    ap.add_argument("--platform", default="auto", help="auto|cpu|tpu (axon ignores JAX_PLATFORMS)")
     args = ap.parse_args()
 
     import jax
 
+    if args.platform != "auto":
+        jax.config.update("jax_platforms", args.platform)
     log(f"devices: {jax.devices()}")
 
-    decode_tput = bench_decode(args.decode_mib * (1 << 20))
+    decode_tput = bench_decode(args.decode_mib * (1 << 20), engine=args.engine)
     em_tput = bench_em(args.em_chunks)
 
     projected = GRCH38_SYMBOLS / (decode_tput * N_CHIPS) + EM_ITERS * EM_TRAIN_SYMBOLS / (

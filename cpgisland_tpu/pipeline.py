@@ -34,6 +34,7 @@ from cpgisland_tpu.parallel.decode import resolve_engine, viterbi_sharded
 from cpgisland_tpu.train import baum_welch
 from cpgisland_tpu.train.backends import EStepBackend
 from cpgisland_tpu.utils import chunking, codec
+from cpgisland_tpu.utils import profiling
 
 log = logging.getLogger(__name__)
 
@@ -50,6 +51,7 @@ def train_file(
     chunk_size: int = chunking.TRAIN_CHUNK,
     checkpoint_dir: Optional[str] = None,
     model_out: Optional[str] = None,
+    metrics: Optional[profiling.MetricsLogger] = None,
 ) -> baum_welch.FitResult:
     """Train the CpG HMM on a sequence file (reference ``trainModel``)."""
     if params is None:
@@ -65,6 +67,7 @@ def train_file(
         backend=backend,
         mode=mode,
         checkpoint_dir=checkpoint_dir,
+        metrics=metrics,
     )
     if model_out is not None:
         dump_text(result.params, model_out)
@@ -97,6 +100,8 @@ def decode_file(
     min_len: Optional[int] = None,
     span: int = CLEAN_DECODE_SPAN,
     engine: str = "auto",
+    metrics: Optional[profiling.MetricsLogger] = None,
+    timer: Optional[profiling.PhaseTimer] = None,
 ) -> DecodeResult:
     """Viterbi-decode a sequence file and call CpG islands (reference
     ``testModel``).
@@ -107,7 +112,10 @@ def decode_file(
     decode (sequence-parallel over all local devices) and calls islands over
     the whole path — no DP restarts, no island clipping.
     """
-    symbols = codec.encode_file(test_path, skip_headers=not compat)
+    timer = timer if timer is not None else profiling.PhaseTimer()
+    with timer.phase("encode", unit="sym"):
+        symbols = codec.encode_file(test_path, skip_headers=not compat)
+    timer.phases["encode"].items += symbols.size
     batch_decode = (
         viterbi_pallas_batch
         if resolve_engine(engine, params) == "pallas"
@@ -119,28 +127,38 @@ def decode_file(
         chunks, lengths = chunked.chunks, chunked.lengths
         n = chunked.num_chunks
         parts: list[IslandCalls] = []
-        for lo in range(0, n, device_batch):
-            hi = min(lo + device_batch, n)
-            batch_paths = np.asarray(
-                batch_decode(
-                    params,
-                    jnp.asarray(chunks[lo:hi]),
-                    jnp.asarray(lengths[lo:hi]),
-                    return_score=False,
+        with timer.phase("decode+islands", items=float(chunked.total), unit="sym"):
+            for lo in range(0, n, device_batch):
+                hi = min(lo + device_batch, n)
+                batch_paths = np.asarray(
+                    batch_decode(
+                        params,
+                        jnp.asarray(chunks[lo:hi]),
+                        jnp.asarray(lengths[lo:hi]),
+                        return_score=False,
+                    )
                 )
-            )
-            parts.extend(
-                islands_mod.call_islands(
-                    batch_paths[i][: int(lengths[lo + i])],
-                    chunk=lo + i,
-                    chunk_size=chunk_size,
-                    compat=True,
+                parts.extend(
+                    islands_mod.call_islands(
+                        batch_paths[i][: int(lengths[lo + i])],
+                        chunk=lo + i,
+                        chunk_size=chunk_size,
+                        compat=True,
+                    )
+                    for i in range(hi - lo)
                 )
-                for i in range(hi - lo)
+        calls = IslandCalls.concatenate(parts)
+        if metrics is not None:
+            metrics.log(
+                "decode",
+                mode="compat",
+                n_symbols=int(chunked.total),
+                n_chunks=int(n),
+                n_islands=len(calls),
+                **timer.as_dict(),
             )
-        return _finish_decode(
-            IslandCalls.concatenate(parts), chunked.total, n, islands_out
-        )
+        log.info("decode phases:\n%s", timer.report())
+        return _finish_decode(calls, chunked.total, n, islands_out)
 
     # Clean path: exact global decode, span-wise only if the input exceeds the
     # device-memory span budget.
@@ -153,12 +171,24 @@ def decode_file(
             span,
             n_spans,
         )
-    pieces = [
-        viterbi_sharded(params, symbols[lo : lo + span], engine=engine)
-        for lo in range(0, symbols.size, span)
-    ] or [np.zeros(0, dtype=np.int32)]
-    full = np.concatenate(pieces)
-    calls = islands_mod.call_islands(full, chunk=0, compat=False, min_len=min_len)
+    with timer.phase("decode", items=float(symbols.size), unit="sym"):
+        pieces = [
+            viterbi_sharded(params, symbols[lo : lo + span], engine=engine)
+            for lo in range(0, symbols.size, span)
+        ] or [np.zeros(0, dtype=np.int32)]
+        full = np.concatenate(pieces)
+    with timer.phase("islands", items=float(symbols.size), unit="sym"):
+        calls = islands_mod.call_islands(full, chunk=0, compat=False, min_len=min_len)
+    if metrics is not None:
+        metrics.log(
+            "decode",
+            mode="clean",
+            n_symbols=int(symbols.size),
+            n_spans=int(n_spans),
+            n_islands=len(calls),
+            **timer.as_dict(),
+        )
+    log.info("decode phases:\n%s", timer.report())
     if state_path_out is not None:
         np.save(state_path_out, full.astype(np.int8))
     return _finish_decode(calls, symbols.size, n_spans, islands_out)

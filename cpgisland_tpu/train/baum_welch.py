@@ -33,6 +33,7 @@ from cpgisland_tpu.ops.forward_backward import SuffStats
 from cpgisland_tpu.train.backends import EStepBackend, get_backend
 from cpgisland_tpu.utils import checkpoint as ckpt
 from cpgisland_tpu.utils import chunking
+from cpgisland_tpu.utils import profiling
 
 log = logging.getLogger(__name__)
 
@@ -75,6 +76,7 @@ def fit(
     checkpoint_dir: Optional[str] = None,
     callback: Optional[Callable[[int, float, float], None]] = None,
     start_iteration: int = 0,
+    metrics: Optional[profiling.MetricsLogger] = None,
 ) -> FitResult:
     """Run Baum-Welch EM until convergence or ``num_iters``.
 
@@ -104,6 +106,15 @@ def fit(
         deltas.append(delta)
         dt = time.perf_counter() - t0
         log.info("em iter=%d loglik=%.4f delta=%.6f wall=%.3fs", it, ll, delta, dt)
+        # Failure detection (SURVEY.md §5): a numerics blowup surfaces here as
+        # a clear error instead of silently corrupting later iterations; the
+        # per-iteration checkpoint below is the matching restart point.
+        profiling.check_finite(
+            {"pi": params.log_pi, "A": params.log_A, "B": params.log_B, "loglik": ll},
+            where=f"em iter {it}",
+        )
+        if metrics is not None:
+            metrics.log("em_iter", iteration=it, loglik=ll, delta=delta, wall_s=dt)
         if callback is not None:
             callback(it, ll, delta)
         if checkpoint_dir is not None:

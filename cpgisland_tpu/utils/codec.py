@@ -9,7 +9,11 @@ header lines specially, so the a/c/g/t characters inside a header such as
 
 The implementation is a vectorized 256-entry lookup table over raw bytes rather
 than a per-character loop: encoding whole chromosomes is memory-bandwidth bound
-and runs at GB/s in NumPy; a streaming variant bounds peak host memory.
+and runs at GB/s in NumPy; a streaming variant bounds peak host memory.  When
+the native runtime library is available (utils.native, built from
+native/codec.cpp), the streaming path uses its fused single-pass
+strip-and-encode kernel instead; both paths are parity-tested against each
+other (tests/test_native_codec.py).
 """
 
 from __future__ import annotations
@@ -18,6 +22,8 @@ import io
 from typing import Iterator, Union
 
 import numpy as np
+
+from cpgisland_tpu.utils import native
 
 # Symbol ids (match the reference's emitted-state map, CpGIslandFinder.java:191-194).
 A, C, G, T = 0, 1, 2, 3
@@ -66,19 +72,25 @@ def iter_encoded_blocks(
     ``skip_headers=False`` reproduces the reference exactly (headers encoded as
     bases, CpGIslandFinder.java:112-128); ``True`` is the fixed FASTA-aware mode.
     Header lines may span read boundaries, so a small carry tracks whether we are
-    inside a header and whether the next byte starts a line.
+    inside a header and whether the next byte starts a line.  Uses the native
+    fused kernel when available (identical semantics, parity-tested).
     """
+    fasta_enc = native.FastaEncoder() if skip_headers else None
+    use_native = fasta_enc.available if skip_headers else native.available()
     in_header, at_line_start = False, True
     with open(path, "rb", buffering=0) as f:
         while True:
             data = f.read(read_size)
             if not data:
                 return
-            if skip_headers:
-                data, in_header, at_line_start = _strip_headers_stateful(
-                    data, in_header, at_line_start
-                )
-            syms = encode_bytes(data)
+            if use_native:
+                syms = fasta_enc.feed(data) if skip_headers else native.encode(data)
+            else:
+                if skip_headers:
+                    data, in_header, at_line_start = _strip_headers_stateful(
+                        data, in_header, at_line_start
+                    )
+                syms = encode_bytes(data)
             if syms.size:
                 yield syms
 

@@ -1,0 +1,162 @@
+"""ctypes loader for the native runtime library (native/codec.cpp).
+
+Loads ``native/libcpgnative.so``, building it with the in-tree Makefile on
+first use if a C++ toolchain is present.  Everything degrades gracefully: if
+the library can't be built or loaded (or ``CPGISLAND_NATIVE=0``), callers get
+``None`` and fall back to the NumPy implementations — the native path is a
+throughput optimization, never a requirement.  pybind11 isn't in this image,
+hence ctypes (SURVEY.md §0: the reference has no native components at all;
+ours replaces its JVM stream IO, CpGIslandFinder.java:112-128).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+import threading
+from typing import Optional
+
+import numpy as np
+
+log = logging.getLogger(__name__)
+
+_ABI = 1
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+_NATIVE_DIR = os.path.join(_REPO_ROOT, "native")
+_SO_PATH = os.path.join(_NATIVE_DIR, "libcpgnative.so")
+
+# FASTA streaming-state bits (must match native/codec.cpp).
+IN_HEADER = 1
+AT_LINE_START = 2
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+
+def _build() -> bool:
+    src = os.path.join(_NATIVE_DIR, "codec.cpp")
+    if not os.path.exists(src):
+        return False
+    try:
+        subprocess.run(
+            ["make", "-C", _NATIVE_DIR],
+            check=True,
+            capture_output=True,
+            timeout=120,
+        )
+        return os.path.exists(_SO_PATH)
+    except (OSError, subprocess.SubprocessError) as e:
+        log.debug("native build failed: %s", e)
+        return False
+
+
+def load() -> Optional[ctypes.CDLL]:
+    """The shared library, or None if unavailable/disabled."""
+    global _lib, _tried
+    if _lib is not None or _tried:
+        return _lib
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        if os.environ.get("CPGISLAND_NATIVE", "1") == "0":
+            return None
+        needs_build = not os.path.exists(_SO_PATH) or (
+            os.path.getmtime(_SO_PATH)
+            < os.path.getmtime(os.path.join(_NATIVE_DIR, "codec.cpp"))
+        )
+        if needs_build and not _build():
+            return None
+        try:
+            lib = ctypes.CDLL(_SO_PATH)
+            lib.cpg_native_abi.restype = ctypes.c_uint32
+            if lib.cpg_native_abi() != _ABI:
+                log.warning("stale native library (abi mismatch); rebuilding")
+                # dlclose the stale image first: dlopen matches by pathname and
+                # would otherwise hand the old mapping straight back.
+                import _ctypes
+
+                handle = lib._handle
+                del lib
+                _ctypes.dlclose(handle)
+                os.unlink(_SO_PATH)
+                if not _build():
+                    return None
+                lib = ctypes.CDLL(_SO_PATH)
+                lib.cpg_native_abi.restype = ctypes.c_uint32
+                if lib.cpg_native_abi() != _ABI:
+                    log.warning("rebuilt native library still abi-mismatched; disabling")
+                    return None
+            lib.cpg_encode.restype = ctypes.c_size_t
+            lib.cpg_encode.argtypes = [
+                ctypes.c_char_p,
+                ctypes.c_size_t,
+                ctypes.POINTER(ctypes.c_uint8),
+            ]
+            lib.cpg_encode_fasta.restype = ctypes.c_size_t
+            lib.cpg_encode_fasta.argtypes = [
+                ctypes.c_char_p,
+                ctypes.c_size_t,
+                ctypes.POINTER(ctypes.c_uint8),
+                ctypes.POINTER(ctypes.c_uint32),
+            ]
+            _lib = lib
+        except OSError as e:
+            log.debug("native load failed: %s", e)
+            _lib = None
+    return _lib
+
+
+def available() -> bool:
+    return load() is not None
+
+
+def _compact(out: np.ndarray, n: int) -> np.ndarray:
+    """Slice the encode output, copying when the slack is large.
+
+    A bare ``out[:n]`` view pins the whole input-sized buffer; for
+    skip-dominated blocks (FASTA N-runs span tens of Mbp in GRCh38) that
+    inflates peak memory to raw-bytes-read instead of symbols-kept.  Dense
+    blocks (newlines only, ~1.5% slack) keep the view to skip the memcpy.
+    """
+    if n < (out.size // 8) * 7:
+        return out[:n].copy()
+    return out[:n]
+
+
+def encode(data: bytes) -> Optional[np.ndarray]:
+    """Native twin of codec.encode_bytes; None when the library is absent."""
+    lib = load()
+    if lib is None:
+        return None
+    out = np.empty(len(data), dtype=np.uint8)
+    n = lib.cpg_encode(
+        data, len(data), out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
+    )
+    return _compact(out, n)
+
+
+class FastaEncoder:
+    """Stateful fused header-strip + encode for streaming blocks."""
+
+    def __init__(self) -> None:
+        self._state = ctypes.c_uint32(AT_LINE_START)
+        self._lib = load()
+
+    @property
+    def available(self) -> bool:
+        return self._lib is not None
+
+    def feed(self, data: bytes) -> np.ndarray:
+        assert self._lib is not None
+        out = np.empty(len(data), dtype=np.uint8)
+        n = self._lib.cpg_encode_fasta(
+            data,
+            len(data),
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+            ctypes.byref(self._state),
+        )
+        return _compact(out, n)

@@ -1,0 +1,147 @@
+"""Tracing, phase timing, and structured metrics (SURVEY.md §5).
+
+The reference has no tracing or metrics at all — two SLF4J lines total
+(CpGIslandFinder.java:147,228).  Here:
+
+- :func:`trace` — context manager around ``jax.profiler.trace`` producing a
+  TensorBoard-loadable XPlane trace of device execution.
+- :class:`PhaseTimer` — wall-clock + throughput accounting per pipeline phase
+  (encode, train, decode, islands), printable and exportable.
+- :class:`MetricsLogger` — append-only JSONL event stream (one object per
+  line: ts, event, fields) for per-iteration EM stats, decode throughput,
+  island counts; `None`-safe so call sites never branch.
+
+NaN policy: JAX purity already rules out data races (SURVEY.md §5); numeric
+health is guarded by :func:`check_finite` on small model tensors (cheap) and
+by ``jax.config.update("jax_debug_nans", True)`` for deep debugging.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import json
+import logging
+import os
+import time
+from typing import IO, Iterator, Optional, Union
+
+import numpy as np
+
+log = logging.getLogger(__name__)
+
+
+@contextlib.contextmanager
+def trace(log_dir: str, enabled: bool = True) -> Iterator[None]:
+    """Capture a jax.profiler device trace into ``log_dir`` (TensorBoard format)."""
+    if not enabled:
+        yield
+        return
+    import jax
+
+    os.makedirs(log_dir, exist_ok=True)
+    with jax.profiler.trace(log_dir):
+        yield
+    log.info("profiler trace written to %s", log_dir)
+
+
+@dataclasses.dataclass
+class Phase:
+    name: str
+    seconds: float = 0.0
+    items: float = 0.0  # symbols, chunks, ... caller-defined unit
+    unit: str = "items"
+
+    @property
+    def throughput(self) -> float:
+        return self.items / self.seconds if self.seconds > 0 else 0.0
+
+
+class PhaseTimer:
+    """Accumulates wall-clock and throughput per named phase.
+
+    >>> pt = PhaseTimer()
+    >>> with pt.phase("decode", items=1 << 20, unit="sym"):
+    ...     pass
+    """
+
+    def __init__(self) -> None:
+        self.phases: dict[str, Phase] = {}
+
+    @contextlib.contextmanager
+    def phase(self, name: str, items: float = 0.0, unit: str = "items") -> Iterator[None]:
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            dt = time.perf_counter() - t0
+            p = self.phases.setdefault(name, Phase(name, unit=unit))
+            p.seconds += dt
+            p.items += items
+            p.unit = unit
+
+    def report(self) -> str:
+        lines = []
+        for p in self.phases.values():
+            tp = f" ({p.throughput / 1e6:.2f} M{p.unit}/s)" if p.items else ""
+            lines.append(f"{p.name}: {p.seconds:.3f}s{tp}")
+        return "\n".join(lines)
+
+    def as_dict(self) -> dict:
+        return {
+            p.name: {"seconds": p.seconds, p.unit: p.items, "throughput": p.throughput}
+            for p in self.phases.values()
+        }
+
+
+class MetricsLogger:
+    """Append-only JSONL metrics stream.
+
+    Every record: ``{"ts": <unix float>, "event": <str>, ...fields}``.
+    ``MetricsLogger(None)`` (or the module-level :func:`null`) swallows events,
+    so instrumented code never needs None checks.
+    """
+
+    def __init__(self, sink: Optional[Union[str, IO[str]]] = None) -> None:
+        self._own = isinstance(sink, str)
+        self._f: Optional[IO[str]] = open(sink, "a") if self._own else sink
+
+    def log(self, event: str, **fields) -> None:
+        if self._f is None:
+            return
+        rec = {"ts": time.time(), "event": event}
+        rec.update(fields)
+        self._f.write(json.dumps(rec, default=float) + "\n")
+        self._f.flush()
+
+    def close(self) -> None:
+        if self._own and self._f is not None:
+            self._f.close()
+            self._f = None
+
+    def __enter__(self) -> "MetricsLogger":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+def null() -> MetricsLogger:
+    return MetricsLogger(None)
+
+
+def check_finite(tree, where: str = "") -> None:
+    """Raise FloatingPointError if any leaf of a (small) pytree is NaN/inf.
+
+    Intended for model-sized tensors (pi, A, B, loglik) after each EM
+    iteration — O(K^2) work, so safe to leave on in production.
+    """
+    import jax
+
+    bad = []
+    for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+        arr = np.asarray(leaf)
+        if arr.dtype.kind == "f" and not np.isfinite(arr).all():
+            bad.append(jax.tree_util.keystr(path))
+    if bad:
+        raise FloatingPointError(f"non-finite values{' in ' + where if where else ''}: {bad}")

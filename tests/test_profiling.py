@@ -1,0 +1,71 @@
+"""Profiling/metrics subsystem (SURVEY.md §5 tracing + metrics + NaN guards)."""
+
+import json
+
+import numpy as np
+import pytest
+
+from cpgisland_tpu.models import presets
+from cpgisland_tpu.train import baum_welch
+from cpgisland_tpu.utils import chunking, profiling
+
+
+def test_phase_timer_accumulates():
+    pt = profiling.PhaseTimer()
+    with pt.phase("a", items=100, unit="sym"):
+        pass
+    with pt.phase("a", items=100, unit="sym"):
+        pass
+    assert pt.phases["a"].items == 200
+    assert pt.phases["a"].seconds > 0
+    assert "a:" in pt.report()
+    assert pt.as_dict()["a"]["sym"] == 200
+
+
+def test_metrics_logger_jsonl(tmp_path):
+    p = tmp_path / "m.jsonl"
+    with profiling.MetricsLogger(str(p)) as m:
+        m.log("em_iter", iteration=1, loglik=-12.5)
+        m.log("decode", n_islands=3)
+    recs = [json.loads(ln) for ln in p.read_text().splitlines()]
+    assert [r["event"] for r in recs] == ["em_iter", "decode"]
+    assert recs[0]["loglik"] == -12.5
+    assert all("ts" in r for r in recs)
+
+
+def test_null_metrics_swallow():
+    profiling.null().log("anything", x=1)  # must not raise
+
+
+def test_check_finite_raises_on_nan():
+    profiling.check_finite({"ok": np.ones(3)})
+    with pytest.raises(FloatingPointError, match="bad"):
+        profiling.check_finite({"bad": np.array([1.0, np.nan])})
+
+
+def test_fit_emits_metrics(tmp_path, rng):
+    p = tmp_path / "train.jsonl"
+    syms = rng.integers(0, 4, size=1024).astype(np.uint8)
+    ck = chunking.frame(syms, 256)
+    with profiling.MetricsLogger(str(p)) as m:
+        baum_welch.fit(presets.durbin_cpg8(), ck, num_iters=2, convergence=0.0, metrics=m)
+    recs = [json.loads(ln) for ln in p.read_text().splitlines()]
+    iters = [r for r in recs if r["event"] == "em_iter"]
+    assert len(iters) == 2
+    assert iters[0]["iteration"] == 1 and "loglik" in iters[0]
+
+
+def test_decode_emits_metrics(tmp_path, rng):
+    from cpgisland_tpu import pipeline
+    from cpgisland_tpu.utils import codec
+
+    fa = tmp_path / "g.fa"
+    fa.write_text(">t\n" + codec.decode_symbols(rng.integers(0, 4, size=4096)) + "\n")
+    p = tmp_path / "decode.jsonl"
+    with profiling.MetricsLogger(str(p)) as m:
+        pipeline.decode_file(str(fa), presets.durbin_cpg8(), compat=False, metrics=m)
+    recs = [json.loads(ln) for ln in p.read_text().splitlines()]
+    dec = [r for r in recs if r["event"] == "decode"]
+    assert len(dec) == 1
+    assert dec[0]["n_symbols"] == 4096
+    assert "decode" in dec[0] and dec[0]["decode"]["seconds"] > 0
