@@ -27,7 +27,8 @@ filters GC > 0.5 and observed/expected CpG > 0.6 (java:285).
 
 from __future__ import annotations
 
-from dataclasses import dataclass
+from dataclasses import dataclass, replace
+from typing import Optional
 
 import numpy as np
 
@@ -49,9 +50,15 @@ class IslandCalls:
     length: np.ndarray  # int64 [n]
     gc_content: np.ndarray  # float64 [n]
     oe_ratio: np.ndarray  # float64 [n]
+    # Optional record (chromosome) names, one per call — set by the clean
+    # path's per-record decode; None keeps the reference's bare format.
+    names: Optional[np.ndarray] = None  # object [n]
 
     def __len__(self) -> int:
         return int(self.beg.shape[0])
+
+    def with_names(self, name: str) -> "IslandCalls":
+        return replace(self, names=np.full(len(self), name, dtype=object))
 
     def as_tuples(self):
         return list(
@@ -65,22 +72,40 @@ class IslandCalls:
         )
 
     def format_lines(self) -> str:
-        """Reference output format: '%d %d %d %f %f\\n' (java:287-288)."""
+        """Reference output format: '%d %d %d %f %f\\n' (java:287-288); a
+        record-name column is prefixed when per-record names are present."""
+        if self.names is None:
+            return "".join(
+                "%d %d %d %f %f\n" % rec
+                for rec in zip(self.beg, self.end, self.length, self.gc_content, self.oe_ratio)
+            )
         return "".join(
-            "%d %d %d %f %f\n" % rec
-            for rec in zip(self.beg, self.end, self.length, self.gc_content, self.oe_ratio)
+            "%s %d %d %d %f %f\n" % rec
+            for rec in zip(
+                self.names, self.beg, self.end, self.length, self.gc_content, self.oe_ratio
+            )
         )
 
     @staticmethod
     def concatenate(parts: list["IslandCalls"]) -> "IslandCalls":
         if not parts:
             return _empty_calls()
+        named = [p.names is not None for p in parts]
+        names = None
+        if any(named):
+            names = np.concatenate(
+                [
+                    p.names if p.names is not None else np.full(len(p), "", dtype=object)
+                    for p in parts
+                ]
+            )
         return IslandCalls(
             beg=np.concatenate([p.beg for p in parts]),
             end=np.concatenate([p.end for p in parts]),
             length=np.concatenate([p.length for p in parts]),
             gc_content=np.concatenate([p.gc_content for p in parts]),
             oe_ratio=np.concatenate([p.oe_ratio for p in parts]),
+            names=names,
         )
 
 

@@ -11,12 +11,14 @@ CpGIslandFinder.java:102-225 and :227-344) rebuilt over the TPU stack:
 remainder chunks dropped, 1 MiB decode chunks processed independently (islands
 clipped at chunk boundaries and reset, CpGIslandFinder.java:256,262-268), the
 stale-atC quirk.  ``compat=False`` is the clean path: FASTA-aware, no dropped
-symbols, islands called over the stitched global path so chunk boundaries don't
-clip them, optional min-length filter.
+symbols, per-record (chromosome) exact decode so neither 1 MiB chunk
+boundaries nor record boundaries clip or merge islands, optional min-length
+filter, record-name column when the file has multiple records.
 """
 
 from __future__ import annotations
 
+import dataclasses
 import logging
 from dataclasses import dataclass
 from typing import IO, Optional, Union
@@ -108,14 +110,11 @@ def decode_file(
 
     compat mode decodes 1 MiB chunks independently and resets the island
     caller per chunk (the reference's boundary behavior,
-    CpGIslandFinder.java:256,262-268).  clean mode runs ONE exact global
-    decode (sequence-parallel over all local devices) and calls islands over
-    the whole path — no DP restarts, no island clipping.
+    CpGIslandFinder.java:256,262-268).  clean mode decodes each FASTA record
+    exactly (sequence-parallel over all local devices) and calls islands per
+    record — no DP restarts, no island clipping, no cross-chromosome islands.
     """
     timer = timer if timer is not None else profiling.PhaseTimer()
-    with timer.phase("encode", unit="sym"):
-        symbols = codec.encode_file(test_path, skip_headers=not compat)
-    timer.phases["encode"].items += symbols.size
     batch_decode = (
         viterbi_pallas_batch
         if resolve_engine(engine, params) == "pallas"
@@ -123,6 +122,9 @@ def decode_file(
     )
 
     if compat:
+        with timer.phase("encode", unit="sym"):
+            symbols = codec.encode_file(test_path, skip_headers=False)
+        timer.phases["encode"].items += symbols.size
         chunked = chunking.frame(symbols, chunk_size, drop_remainder=True)
         chunks, lengths = chunked.chunks, chunked.lengths
         n = chunked.num_chunks
@@ -160,38 +162,64 @@ def decode_file(
         log.info("decode phases:\n%s", timer.report())
         return _finish_decode(calls, chunked.total, n, islands_out)
 
-    # Clean path: exact global decode, span-wise only if the input exceeds the
-    # device-memory span budget.
-    n_spans = max(1, -(-symbols.size // span))
-    if n_spans > 1:
-        log.warning(
-            "input (%d symbols) exceeds the exact-decode span (%d); decoding "
-            "%d spans with a DP restart at each span boundary",
-            symbols.size,
-            span,
-            n_spans,
-        )
-    with timer.phase("decode", items=float(symbols.size), unit="sym"):
-        pieces = [
-            viterbi_sharded(params, symbols[lo : lo + span], engine=engine)
-            for lo in range(0, symbols.size, span)
-        ] or [np.zeros(0, dtype=np.int32)]
-        full = np.concatenate(pieces)
-    with timer.phase("islands", items=float(symbols.size), unit="sym"):
-        calls = islands_mod.call_islands(full, chunk=0, compat=False, min_len=min_len)
+    # Clean path: stream FASTA records (chromosomes) and decode each one
+    # exactly — sequence-parallel over the mesh, span-wise only beyond the
+    # device-memory budget — calling islands per record with per-record
+    # 1-based coordinates, so an island can never span a chromosome boundary
+    # (the reference concatenates the whole char stream, java:238-254).
+    parts: list[IslandCalls] = []
+    paths_out: list[np.ndarray] = []
+    n_sym = 0
+    n_records = 0
+    n_spans_total = 0
+    for rec_name, symbols in codec.iter_fasta_records(test_path):
+        n_records += 1
+        n_sym += symbols.size
+        n_spans = max(1, -(-symbols.size // span))
+        n_spans_total += n_spans
+        if n_spans > 1:
+            log.warning(
+                "record %r (%d symbols) exceeds the exact-decode span (%d); "
+                "decoding %d spans with a DP restart at each span boundary",
+                rec_name,
+                symbols.size,
+                span,
+                n_spans,
+            )
+        with timer.phase("decode", items=float(symbols.size), unit="sym"):
+            pieces = [
+                viterbi_sharded(params, symbols[lo : lo + span], engine=engine)
+                for lo in range(0, symbols.size, span)
+            ] or [np.zeros(0, dtype=np.int32)]
+            full = np.concatenate(pieces)
+        with timer.phase("islands", items=float(symbols.size), unit="sym"):
+            calls = islands_mod.call_islands(full, chunk=0, compat=False, min_len=min_len)
+        # "." = headerless leading sequence: keeps the name column parseable
+        # (a bare "" would emit a leading space and split into 5 fields).
+        parts.append(calls.with_names(rec_name or "."))
+        if state_path_out is not None:
+            paths_out.append(full.astype(np.int8))
+    calls = IslandCalls.concatenate(parts)
+    if n_records <= 1:
+        # Single-record files keep the reference's bare 5-column format.
+        calls = dataclasses.replace(calls, names=None)
     if metrics is not None:
         metrics.log(
             "decode",
             mode="clean",
-            n_symbols=int(symbols.size),
-            n_spans=int(n_spans),
+            n_symbols=n_sym,
+            n_records=n_records,
+            n_spans=n_spans_total,
             n_islands=len(calls),
             **timer.as_dict(),
         )
     log.info("decode phases:\n%s", timer.report())
     if state_path_out is not None:
-        np.save(state_path_out, full.astype(np.int8))
-    return _finish_decode(calls, symbols.size, n_spans, islands_out)
+        np.save(
+            state_path_out,
+            np.concatenate(paths_out) if paths_out else np.zeros(0, np.int8),
+        )
+    return _finish_decode(calls, n_sym, n_spans_total, islands_out)
 
 
 def _finish_decode(calls, n_symbols, n_chunks, islands_out) -> DecodeResult:

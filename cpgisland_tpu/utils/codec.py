@@ -19,7 +19,7 @@ other (tests/test_native_codec.py).
 from __future__ import annotations
 
 import io
-from typing import Iterator, Union
+from typing import Iterator, Optional, Union
 
 import numpy as np
 
@@ -135,6 +135,95 @@ def encode_file(path: str, *, skip_headers: bool = False) -> np.ndarray:
     if not blocks:
         return np.zeros(0, dtype=np.uint8)
     return np.concatenate(blocks)
+
+
+def iter_fasta_records(
+    path: str, *, read_size: int = 1 << 24
+) -> Iterator[tuple[str, np.ndarray]]:
+    """Stream (name, symbols) per FASTA record in bounded memory per block.
+
+    The record name is the header token up to the first whitespace (">chr21
+    GRCh38 alt" -> "chr21").  Leading sequence before any header yields a
+    record named "".  The reference has no notion of records at all — it
+    encodes the whole char stream including headers (CpGIslandFinder.java
+    :112-128); this iterator powers the clean path's per-chromosome decode so
+    islands can never span a chromosome boundary.
+
+    Blocks without a '>' take a bulk-encode fast path (native kernel when
+    available), so multi-GiB single-chromosome files stream at codec speed.
+    """
+    name = ""
+    bufs: list[np.ndarray] = []
+    have_record = False
+    in_header = False
+    header_frag = b""
+    at_line_start = True
+
+    def _bulk(seg: Union[bytes, memoryview]) -> Optional[np.ndarray]:
+        if isinstance(seg, memoryview):
+            seg = bytes(seg)
+        out = native.encode(seg)
+        return out if out is not None else encode_bytes(seg)
+
+    with open(path, "rb", buffering=0) as f:
+        while True:
+            data = f.read(read_size)
+            if not data:
+                break
+            if not in_header and b">" not in data:
+                syms = _bulk(data)
+                if syms.size:
+                    bufs.append(syms)
+                    have_record = True
+                at_line_start = data.endswith(b"\n")
+                continue
+            i, n = 0, len(data)
+            while i < n:
+                if in_header:
+                    nl = data.find(b"\n", i)
+                    if nl == -1:
+                        header_frag += data[i:]
+                        i = n
+                        continue
+                    header_frag += data[i:nl]
+                    name = header_frag.decode("ascii", "replace").split()[0] if header_frag.strip() else ""
+                    header_frag = b""
+                    in_header = False
+                    at_line_start = True
+                    i = nl + 1
+                    continue
+                if at_line_start and data[i : i + 1] == b">":
+                    if have_record:
+                        yield name, _concat(bufs)
+                        bufs = []
+                    have_record = True
+                    in_header = True
+                    header_frag = b""
+                    i += 1
+                    continue
+                nxt = data.find(b">", i)
+                nl_end = n if nxt == -1 else nxt
+                # '>' only opens a header at a line start; scan to the last
+                # newline before it so a mid-line '>' stays in sequence data.
+                if nxt != -1 and data[nxt - 1 : nxt] != b"\n":
+                    nl = data.find(b"\n", nxt)
+                    nl_end = n if nl == -1 else nl + 1
+                syms = _bulk(memoryview(data)[i:nl_end])
+                if syms.size:
+                    bufs.append(syms)
+                    have_record = True
+                at_line_start = data[nl_end - 1 : nl_end] == b"\n"
+                i = nl_end
+    if in_header and header_frag.strip():
+        name = header_frag.decode("ascii", "replace").split()[0]
+    if have_record:
+        yield name, _concat(bufs)
+
+
+def _concat(bufs: list) -> np.ndarray:
+    if not bufs:
+        return np.zeros(0, dtype=np.uint8)
+    return np.concatenate(bufs)
 
 
 def decode_symbols(symbols: np.ndarray) -> str:

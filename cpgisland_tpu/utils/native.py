@@ -71,7 +71,15 @@ def load() -> Optional[ctypes.CDLL]:
         if needs_build and not _build():
             return None
         try:
-            lib = ctypes.CDLL(_SO_PATH)
+            try:
+                lib = ctypes.CDLL(_SO_PATH)
+            except OSError:
+                # Stale or foreign-platform artifact (e.g. built elsewhere):
+                # rebuild for this platform and retry once.
+                os.unlink(_SO_PATH)
+                if not _build():
+                    return None
+                lib = ctypes.CDLL(_SO_PATH)
             lib.cpg_native_abi.restype = ctypes.c_uint32
             if lib.cpg_native_abi() != _ABI:
                 log.warning("stale native library (abi mismatch); rebuilding")

@@ -59,3 +59,52 @@ def test_streaming_matches_onehot(tmp_path, rng):
 def test_roundtrip():
     syms = np.array([0, 1, 2, 3, 3, 2, 1, 0], dtype=np.uint8)
     assert codec.encode(codec.decode_symbols(syms)).tolist() == syms.tolist()
+
+
+class TestFastaRecords:
+    def _roundtrip(self, tmp_path, text, read_size=1 << 24):
+        p = tmp_path / "g.fa"
+        p.write_bytes(text if isinstance(text, bytes) else text.encode())
+        return list(codec.iter_fasta_records(str(p), read_size=read_size))
+
+    def test_multi_record(self, tmp_path):
+        recs = self._roundtrip(tmp_path, ">chr1 desc here\nACGT\nAC\n>chr2\nGGTT\n")
+        assert [(n, s.tolist()) for n, s in recs] == [
+            ("chr1", [0, 1, 2, 3, 0, 1]),
+            ("chr2", [2, 2, 3, 3]),
+        ]
+
+    def test_headerless_leading_sequence(self, tmp_path):
+        recs = self._roundtrip(tmp_path, "ACG\n>chrX\nTT\n")
+        assert [(n, s.tolist()) for n, s in recs] == [("", [0, 1, 2]), ("chrX", [3, 3])]
+
+    def test_empty_record_preserved(self, tmp_path):
+        recs = self._roundtrip(tmp_path, ">a\n>b\nAC\n")
+        assert [(n, s.tolist()) for n, s in recs] == [("a", []), ("b", [0, 1])]
+
+    def test_midline_gt_is_not_header(self, tmp_path):
+        recs = self._roundtrip(tmp_path, ">a\nAC>GT\nTT\n")
+        assert [(n, s.tolist()) for n, s in recs] == [("a", [0, 1, 2, 3, 3, 3])]
+
+    def test_block_split_boundaries(self, tmp_path, rng):
+        body = "".join(
+            f">rec{i} junk\n" + codec.decode_symbols(rng.integers(0, 4, size=97)) + "\n"
+            for i in range(23)
+        )
+        want = self._roundtrip(tmp_path, body)
+        for rs in (1, 3, 64, 1024):
+            got = self._roundtrip(tmp_path, body, read_size=rs)
+            assert [n for n, _ in got] == [n for n, _ in want], rs
+            for (_, a), (_, b) in zip(got, want):
+                np.testing.assert_array_equal(a, b, err_msg=f"read_size={rs}")
+
+    def test_matches_encode_file(self, tmp_path, rng):
+        body = "".join(
+            f">c{i}\n" + codec.decode_symbols(rng.integers(0, 4, size=1000)) + "\n"
+            for i in range(5)
+        )
+        recs = self._roundtrip(tmp_path, body)
+        merged = np.concatenate([s for _, s in recs])
+        np.testing.assert_array_equal(
+            merged, codec.encode_file(str(tmp_path / "g.fa"), skip_headers=True)
+        )

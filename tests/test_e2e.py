@@ -130,3 +130,48 @@ def test_cli_spmd_backend(tmp_path, rng):
     m = tmp_path / "m.txt"
     rc = cli.main(["train", str(fa), "--model-out", str(m), "--iters", "1", "--backend", "spmd"])
     assert rc == 0
+
+
+def test_clean_decode_per_record_islands(tmp_path, rng):
+    """Multi-chromosome FASTA: clean mode decodes per record — an island-like
+    run crossing the record boundary must be split, and output lines carry
+    the record name."""
+    from cpgisland_tpu import pipeline
+    from cpgisland_tpu.models import presets
+    from cpgisland_tpu.utils import codec
+
+    bg = codec.decode_symbols(rng.choice(4, size=3000, p=[0.35, 0.15, 0.15, 0.35]))
+    cg = codec.decode_symbols(rng.choice(4, size=800, p=[0.05, 0.45, 0.45, 0.05]))
+    # chrA ends with CG-rich tail; chrB starts CG-rich: must be 2 islands.
+    fa = tmp_path / "multi.fa"
+    fa.write_text(f">chrA x\n{bg}{cg}\n>chrB y\n{cg}{bg}\n")
+    out = tmp_path / "islands.out"
+    res = pipeline.decode_file(
+        str(fa), presets.durbin_cpg8(), islands_out=str(out), compat=False
+    )
+    assert res.n_symbols == 2 * 3800
+    lines = out.read_text().splitlines()
+    assert len(lines) == len(res.calls) >= 2
+    by_rec = {}
+    for ln in lines:
+        name, beg, end, ln_, gc, oe = ln.split()
+        by_rec.setdefault(name, []).append((int(beg), int(end)))
+    assert set(by_rec) == {"chrA", "chrB"}
+    # chrA's island sits at its tail, chrB's at its head — both within-record.
+    assert all(e <= 3800 for _, e in by_rec["chrA"])
+    assert any(b <= 10 for b, _ in by_rec["chrB"])
+
+
+def test_clean_decode_single_record_keeps_bare_format(tmp_path, rng):
+    from cpgisland_tpu import pipeline
+    from cpgisland_tpu.models import presets
+    from cpgisland_tpu.utils import codec
+
+    cg = codec.decode_symbols(rng.choice(4, size=900, p=[0.05, 0.45, 0.45, 0.05]))
+    bg = codec.decode_symbols(rng.choice(4, size=2000, p=[0.35, 0.15, 0.15, 0.35]))
+    fa = tmp_path / "one.fa"
+    fa.write_text(f">only\n{bg}{cg}{bg}\n")
+    out = tmp_path / "islands.out"
+    pipeline.decode_file(str(fa), presets.durbin_cpg8(), islands_out=str(out), compat=False)
+    lines = out.read_text().splitlines()
+    assert lines and all(len(ln.split()) == 5 for ln in lines)
