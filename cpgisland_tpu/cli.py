@@ -148,6 +148,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             convergence=args.convergence,
             backend=args.backend,
             mode=args.mode,
+            engine=args.engine,
             compat=compat,
             checkpoint_dir=args.checkpoint_dir,
             model_out=args.model_out,

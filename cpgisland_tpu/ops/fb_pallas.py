@@ -1,0 +1,285 @@
+"""Pallas TPU kernels for the forward-backward E-step (rescaled numerics).
+
+The XLA E-step (ops.forward_backward._chunk_stats_rescaled) vmaps a
+[K]-carry `lax.scan` over the chunk batch; with K=8 riding the minor dimension
+that leaves the VPU lanes mostly idle.  These kernels put the chunk batch on
+the 128-wide lane dimension (one chunk per lane, like ops.viterbi_pallas) and
+fuse the per-step emission select, normalize, and statistics accumulation:
+
+- **forward kernel** — per t-tile: alpha recurrence with Rabiner per-step
+  rescaling; streams alphas [T, K, lanes] and normalizers [T, lanes] to HBM
+  (36 B/symbol — far under HBM bandwidth at these op intensities; no
+  checkpoint/recompute needed at K=8).
+- **backward kernel** — walks t-tiles in reverse (reversed index_map),
+  consuming the stored alphas and accumulating the [K,K] transition and
+  [K,S] emission expected counts in VMEM scratch; per-tile boundary values
+  (o_{t+1}, c_{t+1}) carry through scratch.
+
+Grid order note: the t-tile dimension is the innermost grid axis, so each
+lane-tile's t-tiles run consecutively and VMEM scratch carries state between
+them (the canonical multi-pass reduction pattern).
+
+Semantics match the rescaled XLA path to float tolerance (same masking rules:
+invalid steps are identity, empty chunks contribute exactly-zero statistics).
+The reference equivalent is Mahout's Hadoop Baum-Welch mapper
+(CpGIslandFinder.java:200-201, the "rescaling" numerics at :92).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from cpgisland_tpu.models.hmm import HmmParams
+from cpgisland_tpu.ops.forward_backward import SuffStats
+from cpgisland_tpu.ops.viterbi_pallas import MAX_PACK_STATES, _interpret, _vspec
+
+LANE_TILE = 128
+DEFAULT_T_TILE = 512
+
+
+def supports(params: HmmParams) -> bool:
+    # No packing constraint here, but keep the same "small state space on
+    # sublanes" envelope as the decode kernels.
+    return params.n_states <= MAX_PACK_STATES
+
+
+def _emit_sel(B, syms, K, S):
+    """Bsel[k, :] = B[k, syms[:]] via an unrolled compare-select tree."""
+    out = jnp.zeros((K, syms.shape[-1]), jnp.float32)
+    for s in range(S):
+        out = jnp.where((syms == s)[None, :], B[:, s][:, None], out)
+    return out
+
+
+def _fwd_kernel(steps_ref, lens_ref, alpha0_ref, c0_ref, A_ref, B_ref,
+                alphas_ref, cs_ref, carry_ref, *, K, S, Tt):
+    j = pl.program_id(1)
+    A = A_ref[:, :]
+    B = B_ref[:, :]
+    lens = lens_ref[0, :]
+    alpha_in = jnp.where(j == 0, alpha0_ref[:, :], carry_ref[:, :])
+
+    def body(tl, alpha):
+        t = j * Tt + tl
+        o_t = steps_ref[tl, :]
+        v_t = t < lens
+        raw = jnp.sum(alpha[:, None, :] * A[:, :, None], axis=0) * _emit_sel(B, o_t, K, S)
+        c = jnp.sum(raw, axis=0)
+        new = raw / c
+        new = jnp.where(v_t[None, :], new, alpha)
+        c = jnp.where(v_t, c, 1.0)
+        # t == 0 has no incoming transition: its (alpha, c) come precomputed.
+        new = jnp.where(t == 0, alpha0_ref[:, :], new)
+        c = jnp.where(t == 0, c0_ref[0, :], c)
+        alphas_ref[tl, :, :] = new
+        cs_ref[tl, :] = c
+        return new
+
+    carry_ref[:, :] = jax.lax.fori_loop(0, Tt, body, alpha_in)
+
+
+def _bwd_kernel(steps_ref, lens_ref, A_ref, B_ref, alphas_ref, cs_ref,
+                trans_ref, emit_ref, beta0_ref,
+                beta_scr, onext_scr, cnext_scr,
+                *, K, S, Tt, T):
+    j = pl.program_id(1)
+    n_t = pl.num_programs(1)
+    lt = steps_ref.shape[1]
+    A = A_ref[:, :]
+    B = B_ref[:, :]
+    lens = lens_ref[0, :]
+    t0 = (n_t - 1 - j) * Tt
+
+    @pl.when(j == 0)
+    def _init():
+        beta_scr[:, :] = jnp.ones((K, lt), jnp.float32)
+        trans_ref[:, :] = jnp.zeros((K * K, lt), jnp.float32)
+        emit_ref[:, :] = jnp.zeros((K * S, lt), jnp.float32)
+        onext_scr[0, :] = jnp.zeros((lt,), jnp.int32)
+        cnext_scr[0, :] = jnp.ones((lt,), jnp.float32)
+
+    def body(tl_rev, carry):
+        beta_next, trans, emit = carry
+        tl = Tt - 1 - tl_rev
+        t = t0 + tl
+        # The XLA bstep covers t in [0, T-2]; position T-1 only seeds carries.
+        active = t <= T - 2
+        o_t = steps_ref[tl, :]
+        alpha_t = alphas_ref[tl, :, :]
+        at_edge = tl == Tt - 1
+        tl_n = jnp.minimum(tl + 1, Tt - 1)
+        o_next = jnp.where(at_edge, onext_scr[0, :], steps_ref[tl_n, :])
+        c_next = jnp.where(at_edge, cnext_scr[0, :], cs_ref[tl_n, :])
+        v_t = t < lens
+        v_next = (t + 1) < lens
+
+        w = _emit_sel(B, o_next, K, S) * beta_next / c_next  # [K, lt]
+        xi = alpha_t[:, None, :] * (A[:, :, None] * w[None, :, :])
+        trans = trans + jnp.where((active & v_next)[None, None, :], xi, 0.0)
+        beta_t = jnp.sum(A[:, :, None] * w[None, :, :], axis=1)
+        beta_t = jnp.where((active & v_next)[None, :], beta_t, beta_next)
+        gamma_t = alpha_t * beta_t
+        gamma_t = gamma_t / jnp.maximum(jnp.sum(gamma_t, axis=0), 1e-30)
+        gamma_t = jnp.where((active & v_t)[None, :], gamma_t, 0.0)
+        sel = jnp.stack([(o_t == s).astype(jnp.float32) for s in range(S)], axis=0)
+        emit = emit + gamma_t[:, None, :] * sel[None, :, :]  # [K, S, lt]
+        return beta_t, trans, emit
+
+    beta, trans, emit = jax.lax.fori_loop(
+        0,
+        Tt,
+        body,
+        (
+            beta_scr[:, :],
+            trans_ref[:, :].reshape(K, K, lt),
+            emit_ref[:, :].reshape(K, S, lt),
+        ),
+    )
+    beta_scr[:, :] = beta
+    trans_ref[:, :] = trans.reshape(K * K, lt)
+    emit_ref[:, :] = emit.reshape(K * S, lt)
+    onext_scr[0, :] = steps_ref[0, :]
+    cnext_scr[0, :] = cs_ref[0, :]
+
+    @pl.when(j == n_t - 1)
+    def _finish():
+        beta0_ref[:, :] = beta
+
+
+def _pad_axis(x, size, axis, fill):
+    pad = size - x.shape[axis]
+    if pad == 0:
+        return x
+    widths = [(0, 0)] * x.ndim
+    widths[axis] = (0, pad)
+    return jnp.pad(x, widths, constant_values=fill)
+
+
+@functools.partial(jax.jit, static_argnames=("t_tile",))
+def batch_stats_pallas(
+    params: HmmParams,
+    chunks: jnp.ndarray,
+    lengths: jnp.ndarray,
+    t_tile: int = DEFAULT_T_TILE,
+) -> SuffStats:
+    """Pallas twin of ops.forward_backward.batch_stats(mode="rescaled").
+
+    chunks: [N, T] (padded), lengths: [N].  Returns batch-summed SuffStats.
+    """
+    K, S = params.n_states, params.n_symbols
+    N, T = chunks.shape
+    A = jnp.exp(params.log_A).astype(jnp.float32)
+    B = jnp.exp(params.log_B).astype(jnp.float32)
+    pi = jnp.exp(params.log_pi).astype(jnp.float32)
+
+    lengths = lengths.astype(jnp.int32)
+    obs_c = jnp.where(
+        jnp.arange(T)[None, :] < lengths[:, None],
+        jnp.minimum(chunks.astype(jnp.int32), S - 1),
+        0,
+    )
+
+    NL = -(-N // LANE_TILE) * LANE_TILE
+    Tt = min(t_tile, T)
+    n_t = -(-T // Tt)
+    Tp = n_t * Tt
+    steps2 = _pad_axis(_pad_axis(obs_c.T, Tp, 0, 0), NL, 1, 0)  # [Tp, NL]
+    lens2 = _pad_axis(lengths[None, :], NL, 1, 0)  # [1, NL]
+    valid0 = lens2[0] > 0  # [NL]
+
+    # alpha0 in JAX (one position; the kernels handle t >= 1).
+    B0 = _emit_sel(B, steps2[0, :], K, S)  # [K, NL]
+    a0_raw = jnp.where(valid0[None, :], pi[:, None] * B0, jnp.ones((K, NL)) / K)
+    c0 = jnp.sum(a0_raw, axis=0)
+    alpha0 = a0_raw / c0
+
+    n_lt = NL // LANE_TILE
+    grid = (n_lt, n_t)
+    interpret = _interpret()
+    mat_spec = _vspec((K, K), lambda i, j: (0, 0))
+    emitmat_spec = _vspec((K, S), lambda i, j: (0, 0))
+    lane_spec = _vspec((1, LANE_TILE), lambda i, j: (0, i))
+    klane_spec = _vspec((K, LANE_TILE), lambda i, j: (0, i))
+    step_spec = _vspec((Tt, LANE_TILE), lambda i, j: (j, i))
+
+    alphas, cs = pl.pallas_call(
+        functools.partial(_fwd_kernel, K=K, S=S, Tt=Tt),
+        grid=grid,
+        in_specs=[step_spec, lane_spec, klane_spec, lane_spec, mat_spec, emitmat_spec],
+        out_specs=[
+            _vspec((Tt, K, LANE_TILE), lambda i, j: (j, 0, i)),
+            step_spec,
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((Tp, K, NL), jnp.float32),
+            jax.ShapeDtypeStruct((Tp, NL), jnp.float32),
+        ],
+        scratch_shapes=[pltpu.VMEM((K, LANE_TILE), jnp.float32)],
+        interpret=interpret,
+    )(steps2, lens2, alpha0, c0[None, :], A, B)
+
+    # Reversed t-walk: input/output t-blocks indexed by (n_t-1-j).
+    rev_step_spec = _vspec((Tt, LANE_TILE), lambda i, j: (n_t - 1 - j, i))
+    trans_l, emit_l, beta0 = pl.pallas_call(
+        functools.partial(_bwd_kernel, K=K, S=S, Tt=Tt, T=T),
+        grid=grid,
+        in_specs=[
+            rev_step_spec,
+            lane_spec,
+            mat_spec,
+            emitmat_spec,
+            _vspec((Tt, K, LANE_TILE), lambda i, j: (n_t - 1 - j, 0, i)),
+            rev_step_spec,
+        ],
+        out_specs=[
+            _vspec((K * K, LANE_TILE), lambda i, j: (0, i)),
+            _vspec((K * S, LANE_TILE), lambda i, j: (0, i)),
+            klane_spec,
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((K * K, NL), jnp.float32),
+            jax.ShapeDtypeStruct((K * S, NL), jnp.float32),
+            jax.ShapeDtypeStruct((K, NL), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((K, LANE_TILE), jnp.float32),
+            pltpu.VMEM((1, LANE_TILE), jnp.int32),
+            pltpu.VMEM((1, LANE_TILE), jnp.float32),
+        ],
+        interpret=interpret,
+    )(steps2, lens2, A, B, alphas, cs)
+
+    # Assembly in JAX (cheap, [NL]-sized): loglik, gamma0, tail-emission fix,
+    # empty-lane zeroing, lane-sum reduction.
+    tmask = jnp.arange(Tp)[:, None] < lens2  # [Tp, NL]
+    loglik = jnp.sum(jnp.where(tmask & valid0[None, :], jnp.log(cs), 0.0))
+
+    gamma0 = alpha0 * beta0
+    gamma0 = gamma0 / jnp.maximum(jnp.sum(gamma0, axis=0), 1e-30)
+    init_l = jnp.where(valid0[None, :], gamma0, 0.0)  # [K, NL]
+
+    # Final-position emission: the backward walk stops at T-2; position
+    # length-1 is covered there for padded chunks (identity pad steps give it
+    # beta = beta_next), so only unpadded chunks (length == T) need the fix —
+    # mirroring the XLA path's (length == T) correction.
+    alphaT = alphas[T - 1]  # [K, NL] — alpha at the last real row
+    gl = alphaT / jnp.maximum(jnp.sum(alphaT, axis=0), 1e-30)
+    is_full = (lens2[0] == T) & valid0
+    oT = steps2[T - 1, :]
+    selT = _emit_sel(jnp.eye(S, dtype=jnp.float32), oT, S, S)  # [S, NL] one-hot
+    emit_l = emit_l.reshape(K, S, NL) + (
+        gl[:, None, :] * selT[None, :, :] * is_full[None, None, :]
+    )
+
+    return SuffStats(
+        init=jnp.sum(init_l, axis=1),
+        trans=jnp.sum(trans_l.reshape(K, K, NL), axis=2),
+        emit=jnp.sum(emit_l, axis=2),
+        loglik=loglik,
+        n_seqs=jnp.sum(valid0.astype(jnp.int32)),
+    )

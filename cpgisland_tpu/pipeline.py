@@ -49,6 +49,7 @@ def train_file(
     convergence: float = 0.005,
     backend: Union[EStepBackend, str] = "local",
     mode: str = "rescaled",
+    engine: str = "auto",
     compat: bool = True,
     chunk_size: int = chunking.TRAIN_CHUNK,
     checkpoint_dir: Optional[str] = None,
@@ -68,6 +69,7 @@ def train_file(
         convergence=convergence,
         backend=backend,
         mode=mode,
+        engine=engine,
         checkpoint_dir=checkpoint_dir,
         metrics=metrics,
     )

@@ -27,9 +27,35 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from cpgisland_tpu.models.hmm import HmmParams
+from cpgisland_tpu.ops import fb_pallas
 from cpgisland_tpu.ops.forward_backward import SuffStats, batch_stats, chunk_stats
 from cpgisland_tpu.parallel.mesh import make_mesh
 from cpgisland_tpu.utils import chunking
+
+
+def resolve_fb_engine(engine: str, params: HmmParams, mode: str) -> str:
+    """'auto' picks the Pallas E-step kernels on TPU for rescaled numerics
+    (the only mode they implement), the XLA scans otherwise."""
+    if engine == "auto":
+        if (
+            jax.default_backend() == "tpu"
+            and mode == "rescaled"
+            and fb_pallas.supports(params)
+        ):
+            return "pallas"
+        return "xla"
+    if engine not in ("xla", "pallas"):
+        raise ValueError(f"unknown engine {engine!r}; expected auto|xla|pallas")
+    if engine == "pallas" and mode != "rescaled":
+        raise ValueError("pallas E-step implements rescaled numerics only")
+    return engine
+
+
+def _local_stats_fn(engine: str, mode: str):
+    """(params, chunks, lengths) -> batch-summed SuffStats, engine-lowered."""
+    if engine == "pallas":
+        return fb_pallas.batch_stats_pallas
+    return partial(batch_stats, mode=mode)
 
 
 class EStepBackend:
@@ -55,11 +81,13 @@ class EStepBackend:
 class LocalBackend(EStepBackend):
     """Single-device vmap mapper + sum reducer."""
 
-    def __init__(self, mode: str = "rescaled"):
+    def __init__(self, mode: str = "rescaled", engine: str = "auto"):
         self.mode = mode
+        self.engine = engine
 
     def __call__(self, params, chunks, lengths):
-        return batch_stats(params, jnp.asarray(chunks), jnp.asarray(lengths), mode=self.mode)
+        fn = _local_stats_fn(resolve_fb_engine(self.engine, params, self.mode), self.mode)
+        return fn(params, jnp.asarray(chunks), jnp.asarray(lengths))
 
 
 class SpmdBackend(EStepBackend):
@@ -71,26 +99,41 @@ class SpmdBackend(EStepBackend):
     replicated, mirroring the reference's distributed-cache broadcast.
     """
 
-    def __init__(self, mesh: Optional[Mesh] = None, mode: str = "rescaled", axis: str = "data"):
+    def __init__(
+        self,
+        mesh: Optional[Mesh] = None,
+        mode: str = "rescaled",
+        axis: str = "data",
+        engine: str = "auto",
+    ):
         self.mesh = mesh if mesh is not None else make_mesh()
         self.mode = mode
         self.axis = axis
+        self.engine = engine
+        self._estep_cache = {}
 
-        mapper = partial(chunk_stats, mode=self.mode)
+    def _estep_for(self, params):
+        engine = resolve_fb_engine(self.engine, params, self.mode)
+        if engine not in self._estep_cache:
+            local_fn = _local_stats_fn(engine, self.mode)
 
-        def estep(params, chunks, lengths):
-            per = jax.vmap(lambda o, l: mapper(params, o, l))(chunks, lengths)
-            local = jax.tree_util.tree_map(lambda x: jnp.sum(x, axis=0), per)
-            return jax.lax.psum(local, axis_name=self.axis)
+            def estep(params, chunks, lengths):
+                # mapper (per-shard batch stats) + the psum all-reduce that
+                # replaces Hadoop's shuffle+reduce.
+                return jax.lax.psum(
+                    local_fn(params, chunks, lengths), axis_name=self.axis
+                )
 
-        self._estep = jax.jit(
-            jax.shard_map(
-                estep,
-                mesh=self.mesh,
-                in_specs=(P(), P(self.axis), P(self.axis)),
-                out_specs=P(),
+            self._estep_cache[engine] = jax.jit(
+                jax.shard_map(
+                    estep,
+                    mesh=self.mesh,
+                    in_specs=(P(), P(self.axis), P(self.axis)),
+                    out_specs=P(),
+                    check_vma=engine != "pallas",
+                )
             )
-        )
+        return self._estep_cache[engine]
 
     def prepare(self, chunked: chunking.Chunked) -> chunking.Chunked:
         return chunking.pad_to_multiple(chunked, self.mesh.shape[self.axis])
@@ -115,13 +158,19 @@ class SpmdBackend(EStepBackend):
         self._check_divisible(chunks)
         # Already-placed arrays (from place()) pass through; anything else is
         # resharded by jit according to the shard_map in_specs.
-        return self._estep(params, chunks, lengths)
+        return self._estep_for(params)(params, chunks, lengths)
 
 
-def get_backend(name: str = "local", *, mode: str = "rescaled", mesh: Optional[Mesh] = None) -> EStepBackend:
+def get_backend(
+    name: str = "local",
+    *,
+    mode: str = "rescaled",
+    mesh: Optional[Mesh] = None,
+    engine: str = "auto",
+) -> EStepBackend:
     """Backend factory — the runtime flag the north star asks for."""
     if name == "local":
-        return LocalBackend(mode=mode)
+        return LocalBackend(mode=mode, engine=engine)
     if name == "spmd":
-        return SpmdBackend(mesh=mesh, mode=mode)
+        return SpmdBackend(mesh=mesh, mode=mode, engine=engine)
     raise ValueError(f"unknown backend {name!r} (expected 'local' or 'spmd')")

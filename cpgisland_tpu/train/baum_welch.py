@@ -73,6 +73,7 @@ def fit(
     convergence: float = 0.005,
     backend: EStepBackend | str = "local",
     mode: str = "rescaled",
+    engine: str = "auto",
     checkpoint_dir: Optional[str] = None,
     callback: Optional[Callable[[int, float, float], None]] = None,
     start_iteration: int = 0,
@@ -87,7 +88,7 @@ def fit(
     CpGIslandFinder.java:64-89).
     """
     if isinstance(backend, str):
-        backend = get_backend(backend, mode=mode)
+        backend = get_backend(backend, mode=mode, engine=engine)
     chunked = backend.prepare(chunked)
     chunks, lengths = backend.place(chunked.chunks, chunked.lengths)
 

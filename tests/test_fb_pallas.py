@@ -1,0 +1,104 @@
+"""Pallas forward-backward E-step vs. the XLA rescaled path and the oracle."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cpgisland_tpu.models import presets
+from cpgisland_tpu.models.hmm import HmmParams
+from cpgisland_tpu.ops.fb_pallas import batch_stats_pallas
+from cpgisland_tpu.ops.forward_backward import batch_stats
+from cpgisland_tpu.train import baum_welch, backends
+from cpgisland_tpu.utils import chunking
+
+
+def _random_model(rng, k=8, m=4):
+    return HmmParams.from_probs(
+        rng.dirichlet(np.ones(k)),
+        rng.dirichlet(np.ones(k), size=k),
+        rng.dirichlet(np.ones(m), size=k),
+    )
+
+
+def _assert_stats_close(a, b, atol=2e-3):
+    np.testing.assert_allclose(np.asarray(a.init), np.asarray(b.init), atol=atol)
+    np.testing.assert_allclose(np.asarray(a.trans), np.asarray(b.trans), atol=atol * np.asarray(b.trans).max())
+    np.testing.assert_allclose(np.asarray(a.emit), np.asarray(b.emit), atol=atol * np.asarray(b.emit).max())
+    np.testing.assert_allclose(float(a.loglik), float(b.loglik), rtol=1e-4)
+    assert int(a.n_seqs) == int(b.n_seqs)
+
+
+def test_matches_xla_rescaled_full_chunks(rng):
+    params = _random_model(rng)
+    chunks = jnp.asarray(rng.integers(0, 4, size=(5, 256)))
+    lengths = jnp.full(5, 256, jnp.int32)
+    a = batch_stats_pallas(params, chunks, lengths, t_tile=64)
+    b = batch_stats(params, chunks, lengths, mode="rescaled")
+    _assert_stats_close(a, b)
+
+
+def test_matches_xla_padded_and_empty(rng):
+    params = _random_model(rng)
+    chunks = jnp.asarray(rng.integers(0, 4, size=(4, 200)))
+    lengths = jnp.asarray([200, 130, 1, 0], jnp.int32)
+    a = batch_stats_pallas(params, chunks, lengths, t_tile=64)
+    b = batch_stats(params, chunks, lengths, mode="rescaled")
+    _assert_stats_close(a, b)
+
+
+def test_durbin_preset_structural_zeros(rng):
+    params = presets.durbin_cpg8()
+    chunks = jnp.asarray(rng.integers(0, 4, size=(3, 192)))
+    lengths = jnp.full(3, 192, jnp.int32)
+    a = batch_stats_pallas(params, chunks, lengths, t_tile=64)
+    B0 = np.asarray(params.B)
+    assert (np.asarray(a.emit)[B0 == 0] == 0).all()
+    b = batch_stats(params, chunks, lengths, mode="rescaled")
+    _assert_stats_close(a, b)
+
+
+def test_uneven_t_tiling(rng):
+    params = _random_model(rng)
+    chunks = jnp.asarray(rng.integers(0, 4, size=(2, 250)))  # not a tile multiple
+    lengths = jnp.asarray([250, 250], jnp.int32)
+    a = batch_stats_pallas(params, chunks, lengths, t_tile=64)
+    b = batch_stats(params, chunks, lengths, mode="rescaled")
+    _assert_stats_close(a, b)
+
+
+def test_local_backend_pallas_engine_trains(rng):
+    syms = rng.integers(0, 4, size=2048).astype(np.uint8)
+    ck = chunking.frame(syms, 256)
+    res_x = baum_welch.fit(
+        presets.durbin_cpg8(), ck, num_iters=2, convergence=0.0,
+        backend=backends.LocalBackend(engine="xla"),
+    )
+    res_p = baum_welch.fit(
+        presets.durbin_cpg8(), ck, num_iters=2, convergence=0.0,
+        backend=backends.LocalBackend(engine="pallas"),
+    )
+    np.testing.assert_allclose(
+        np.asarray(res_p.params.A), np.asarray(res_x.params.A), atol=1e-3
+    )
+
+
+def test_spmd_backend_pallas_engine(rng):
+    params = _random_model(rng)
+    chunks = rng.integers(0, 4, size=(16, 128)).astype(np.uint8)
+    ck = chunking.Chunked(
+        chunks=chunks, lengths=np.full(16, 128, np.int64), total=16 * 128
+    )
+    spmd_p = backends.SpmdBackend(engine="pallas")
+    spmd_x = backends.SpmdBackend(engine="xla")
+    cp, lp = spmd_p.place(ck.chunks, ck.lengths)
+    a = spmd_p(params, cp, lp)
+    b = spmd_x(params, cp, lp)
+    _assert_stats_close(a, b)
+
+
+def test_engine_validation():
+    params = presets.durbin_cpg8()
+    with pytest.raises(ValueError, match="rescaled"):
+        backends.resolve_fb_engine("pallas", params, "log")
+    with pytest.raises(ValueError, match="unknown engine"):
+        backends.resolve_fb_engine("bogus", params, "rescaled")
