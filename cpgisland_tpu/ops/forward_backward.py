@@ -191,7 +191,10 @@ def _chunk_stats_rescaled(params, obs, length):
 
     def fstep(alpha, inp):
         o_t, v_t = inp
-        raw = (alpha @ A) * B_t[o_t]
+        # HIGHEST: TPU's default matmul precision would round the f32
+        # probabilities to bf16 on the MXU (~4e-3 relative), breaking
+        # CPU/TPU agreement on E-step stats.
+        raw = jnp.matmul(alpha, A, precision=jax.lax.Precision.HIGHEST) * B_t[o_t]
         c = jnp.sum(raw)
         new = raw / c
         new = jnp.where(v_t, new, alpha)
@@ -212,7 +215,7 @@ def _chunk_stats_rescaled(params, obs, length):
         w = B_t[o_next] * beta_next / c_next  # [K]
         xi = alpha_t[:, None] * A * w[None, :]
         trans_acc = trans_acc + jnp.where(v_next, xi, 0.0)
-        beta_t = A @ w
+        beta_t = jnp.matmul(A, w, precision=jax.lax.Precision.HIGHEST)
         beta_t = jnp.where(v_next, beta_t, beta_next)
         gamma_t = alpha_t * beta_t
         gamma_t = gamma_t / jnp.maximum(jnp.sum(gamma_t), 1e-30)

@@ -44,7 +44,20 @@ from cpgisland_tpu.ops.viterbi_parallel import maxplus_matmul
 LANE_TILE = 128  # lanes per kernel instance = one TPU vreg width
 DEFAULT_BLOCK = 512  # symbols per lane (bk); VMEM per instance stays ~1 MiB
 
+# All in-kernel dynamic row offsets are multiples of ROW_TILE: Mosaic requires
+# statically-provable sublane alignment for dynamic VMEM loads/stores of
+# (8,128)-tiled i32/f32, so the per-step loops work on 8-row tiles with the
+# per-row work unrolled.  Block lengths are padded up to a multiple internally
+# (PAD rows are identity steps, so padding is semantics-free).
+ROW_TILE = 8
+
 MAX_PACK_STATES = 8  # 3-bit packing: state ids 0..7 -> one int32 per step
+
+# Identity exit->entry table, 3-bit packed: bits [3j, 3j+3) hold j.
+PACKED_IDENTITY = 0
+for _j in range(MAX_PACK_STATES):
+    PACKED_IDENTITY |= _j << (3 * _j)
+del _j
 
 
 def _vspec(block_shape=None, index_map=None):
@@ -64,54 +77,80 @@ def _interpret() -> bool:
 
 def _step_mats_const(params: HmmParams):
     """Kernel operands: log transition/emission matrices as f32 (passed as
-    pallas inputs — kernels may not close over traced values)."""
+    pallas inputs — kernels may not close over traced values).  The transition
+    matrix is passed TRANSPOSED (logAT[j, i] = logA[i, j]) so kernels can take
+    its columns as [K, 1] slices without an in-kernel relayout."""
     K, S = params.n_states, params.n_symbols
-    logA = jnp.asarray(params.log_A, jnp.float32)
+    logAT = jnp.asarray(params.log_A, jnp.float32).T
     logB = jnp.asarray(params.log_B, jnp.float32)
-    return K, S, logA, logB
+    return K, S, logAT, logB
 
 
-def _eye_log(K: int, lt: int) -> jnp.ndarray:
-    """[K, K, lt] broadcast max-plus identity, built from iota in-kernel."""
-    i = jax.lax.broadcasted_iota(jnp.int32, (K, K, lt), 0)
-    j = jax.lax.broadcasted_iota(jnp.int32, (K, K, lt), 1)
-    return jnp.where(i == j, 0.0, LOG_ZERO).astype(jnp.float32)
+def _id_col(K: int, m: int) -> jnp.ndarray:
+    """[K, 1] max-plus identity column m: 0 at row m, LOG_ZERO elsewhere."""
+    i = jax.lax.broadcasted_iota(jnp.int32, (K, 1), 0)
+    return jnp.where(i == m, 0.0, LOG_ZERO).astype(jnp.float32)
 
 
 def _emit_sel(logB, syms, K, S):
     """Bsel[j, :] = logB[j, syms[:]] via an unrolled compare-select tree.
 
-    syms: [LT] int32 (PAD >= S allowed — caller masks separately).
+    syms: [1, LT] int32 (PAD >= S allowed — caller masks separately).
     Returns [K, LT] f32.
+
+    Everything in these kernels stays rank 2 with shapes (sublane, lane):
+    Mosaic's vector layout assigns the last two dims to (sublane, lane) and
+    this toolchain both rejects some rank-1 values outright and mis-lowers
+    broadcast/reduce over the leading dims of rank-3/4 arrays (observed:
+    duplicated rows in the max-plus contraction).  Hence the unrolled loops
+    over the tiny K<=8 state dimension instead of batched rank-3/4 ops.
     """
     out = jnp.zeros((K, syms.shape[-1]), jnp.float32)
     for s in range(S):
-        out = jnp.where((syms == s)[None, :], logB[:, s][:, None], out)
+        out = jnp.where(syms == s, logB[:, s : s + 1], out)
     return out
 
 
-def _products_kernel(steps_ref, logA_ref, logB_ref, out_ref, *, K, S, bk):
-    """Pass A: max-plus product of the lane's bk step matrices -> [K*K, LT]."""
+def _products_kernel(steps_ref, logAT_ref, logB_ref, out_ref, *, K, S, bk):
+    """Pass A: max-plus product of the lane's bk step matrices -> [K*K, LT].
+
+    C is carried as a tuple of K rank-2 rows: C[i] is [K, LT] with
+    C[i][m, lane] = product[i, m] for that lane's block prefix.
+    """
     lt = steps_ref.shape[1]
-    logA = logA_ref[:, :]
+    logAT = logAT_ref[:, :]
     logB = logB_ref[:, :]
-    eye_b = _eye_log(K, lt)
-    C0 = eye_b
+    C0 = tuple(jnp.broadcast_to(_id_col(K, i), (K, lt)) for i in range(K))
 
-    def body(t, C):
-        syms = steps_ref[t, :]
-        is_pad = (syms >= S)[None, None, :]
-        Bsel = _emit_sel(logB, syms, K, S)  # [K, LT]
-        M = jnp.where(is_pad, eye_b, logA[:, :, None] + Bsel[None, :, :])
-        # new_C[i, j] = max_m C[i, m] + M[m, j]
-        return jnp.max(C[:, :, None, :] + M[None, :, :, :], axis=1)
+    def body(c, C):
+        tile = steps_ref[pl.ds(c * ROW_TILE, ROW_TILE), :]  # aligned [8, LT]
+        for r in range(ROW_TILE):
+            syms = tile[r : r + 1, :]  # [1, LT]
+            is_pad = syms >= S
+            Bsel = _emit_sel(logB, syms, K, S)  # [K, LT]
+            # M_m[j, lane] = logA[m, j] + logB[j, sym] (identity col for PAD),
+            # computed once per m; same add order as the XLA twin (M first).
+            Ms = tuple(
+                jnp.where(is_pad, _id_col(K, m), logAT[:, m : m + 1] + Bsel)
+                for m in range(K)
+            )
+            # new_C[i][j] = max_m C[i][m] + M_m[j]
+            C = tuple(
+                functools.reduce(
+                    jnp.maximum,
+                    [Ci[m : m + 1, :] + Ms[m] for m in range(K)],
+                )
+                for Ci in C
+            )
+        return C
 
-    C = jax.lax.fori_loop(0, bk, body, C0)
-    out_ref[:, :] = C.reshape(K * K, lt)
+    C = jax.lax.fori_loop(0, bk // ROW_TILE, body, C0)
+    for i in range(K):
+        out_ref[i * K : (i + 1) * K, :] = C[i]
 
 
 def _backpointers_kernel(
-    steps_ref, venter_ref, logA_ref, logB_ref, bp_ref, dexit_ref, ftab_ref, *, K, S, bk
+    steps_ref, venter_ref, logAT_ref, logB_ref, bp_ref, dexit_ref, ftab_ref, *, K, S, bk
 ):
     """Pass B: forward delta recursion with true entering vectors.
 
@@ -119,55 +158,69 @@ def _backpointers_kernel(
     the packed exit->entry composition table.
     """
     lt = steps_ref.shape[1]
-    logA = logA_ref[:, :]
+    logAT = logAT_ref[:, :]
     logB = logB_ref[:, :]
     delta0 = venter_ref[:, :]  # [K, LT]
     # E_packed[lane] holds E[j] (3 bits each): entry state reached from exit j.
-    e0 = jnp.zeros((lt,), jnp.int32)
-    for j in range(K):
-        e0 = e0 | (j << (3 * j))
+    e0 = jnp.full((1, lt), PACKED_IDENTITY, jnp.int32)
 
-    def body(t, carry):
+    def body(c, carry):
         delta, E = carry
-        syms = steps_ref[t, :]
-        is_pad = syms >= S
-        Bsel = _emit_sel(logB, syms, K, S)
-        # scores[i, j, :] = delta[i, :] + M[i, j, :] with the emission folded
-        # into M before the max — bit-exact with the XLA twin's rounding and
-        # tie-breaking (viterbi_parallel._pass_backpointers).
-        scores = delta[:, None, :] + (logA[:, :, None] + Bsel[None, :, :])
-        bp = jnp.argmax(scores, axis=0).astype(jnp.int32)  # [K_to, LT]
-        new_delta = jnp.max(scores, axis=0)
-        # PAD -> identity step: delta unchanged, bp[j] = j.
-        jj = jax.lax.broadcasted_iota(jnp.int32, (K, lt), 0)
-        bp = jnp.where(is_pad[None, :], jj, bp)
-        new_delta = jnp.where(is_pad[None, :], delta, new_delta)
-        # Pack this step's K pointers into one int32 per lane.
-        packed = jnp.zeros((lt,), jnp.int32)
-        for j in range(K):
-            packed = packed | (bp[j] << (3 * j))
-        bp_ref[t, :] = packed
-        # Compose: E'[j] = E[bp[j]]  (unpack at a variable offset, repack).
-        newE = jnp.zeros((lt,), jnp.int32)
-        for j in range(K):
-            ej = jnp.right_shift(E, 3 * bp[j]) & 7
-            newE = newE | (ej << (3 * j))
-        return new_delta, newE
+        tile = steps_ref[pl.ds(c * ROW_TILE, ROW_TILE), :]  # aligned [8, LT]
+        rows = []
+        for r in range(ROW_TILE):
+            syms = tile[r : r + 1, :]  # [1, LT]
+            is_pad = syms >= S
+            Bsel = _emit_sel(logB, syms, K, S)
+            # scores_m[j, :] = delta[m, :] + M[m, j, :] with the emission
+            # folded into M before the max — bit-exact with the XLA twin's
+            # rounding (viterbi_parallel._pass_backpointers); the strict >
+            # ascending-m sweep reproduces argmax's first-max tie-breaking.
+            best = jnp.full((K, lt), LOG_ZERO, jnp.float32)
+            bp = jnp.zeros((K, lt), jnp.int32)
+            for m in range(K):
+                cand = delta[m : m + 1, :] + (logAT[:, m : m + 1] + Bsel)
+                take = cand > best
+                bp = jnp.where(take, m, bp)
+                best = jnp.where(take, cand, best)
+            # PAD -> identity step: delta unchanged, bp[j] = j.
+            jj = jax.lax.broadcasted_iota(jnp.int32, (K, lt), 0)
+            bp = jnp.where(is_pad, jj, bp)
+            delta = jnp.where(is_pad, delta, best)
+            # Pack this step's K pointers into one int32 per lane.
+            packed = jnp.zeros((1, lt), jnp.int32)
+            for j in range(K):
+                packed = packed | (bp[j : j + 1, :] << (3 * j))
+            rows.append(packed)
+            # Compose: E'[j] = E[bp[j]]  (unpack at a variable offset, repack).
+            newE = jnp.zeros((1, lt), jnp.int32)
+            for j in range(K):
+                ej = jnp.right_shift(E, 3 * bp[j : j + 1, :]) & 7
+                newE = newE | (ej << (3 * j))
+            E = newE
+        bp_ref[pl.ds(c * ROW_TILE, ROW_TILE), :] = jnp.concatenate(rows, axis=0)
+        return delta, E
 
-    delta, E = jax.lax.fori_loop(0, bk, body, (delta0, e0))
+    delta, E = jax.lax.fori_loop(0, bk // ROW_TILE, body, (delta0, e0))
     dexit_ref[:, :] = delta
-    ftab_ref[0, :] = E
+    ftab_ref[:, :] = E
 
 
 def _backtrace_kernel(bp_ref, exit_ref, path_ref, *, bk):
     """Pass C: walk packed backpointers from the anchored exit state."""
+    nc = bk // ROW_TILE
 
     def body(i, state):
-        t = bk - 1 - i
-        path_ref[t, :] = state.astype(jnp.int8)
-        return jnp.right_shift(bp_ref[t, :], 3 * state) & 7
+        c = nc - 1 - i
+        tile = bp_ref[pl.ds(c * ROW_TILE, ROW_TILE), :]  # aligned [8, LT]
+        rows = [None] * ROW_TILE
+        for r in range(ROW_TILE - 1, -1, -1):
+            rows[r] = state  # [1, LT]
+            state = jnp.right_shift(tile[r : r + 1, :], 3 * state) & 7
+        path_ref[pl.ds(c * ROW_TILE, ROW_TILE), :] = jnp.concatenate(rows, axis=0)
+        return state
 
-    jax.lax.fori_loop(0, bk, body, exit_ref[0, :])
+    jax.lax.fori_loop(0, nc, body, exit_ref[:, :])
 
 
 def _pad_lanes(x, nb_pad, fill):
@@ -175,6 +228,18 @@ def _pad_lanes(x, nb_pad, fill):
     if pad == 0:
         return x
     return jnp.pad(x, [(0, 0)] * (x.ndim - 1) + [(0, pad)], constant_values=fill)
+
+
+def _pad_rows(steps2, S):
+    """Pad the step axis to a multiple of ROW_TILE with PAD (identity) steps."""
+    bk = steps2.shape[0]
+    bk_pad = -(-bk // ROW_TILE) * ROW_TILE
+    if bk_pad == bk:
+        return steps2, bk_pad
+    return (
+        jnp.pad(steps2, [(0, bk_pad - bk), (0, 0)], constant_values=jnp.int32(S)),
+        bk_pad,
+    )
 
 
 # --- Pass-level API (same contracts as the XLA twins in ops.viterbi_parallel,
@@ -185,10 +250,11 @@ def _pad_lanes(x, nb_pad, fill):
 
 def pass_products(params: HmmParams, steps2: jnp.ndarray):
     """Pallas twin of viterbi_parallel._pass_products: (incl [nb,K,K], total)."""
-    K, S, logA, logB = _step_mats_const(params)
-    bk, nb = steps2.shape
+    K, S, logAT, logB = _step_mats_const(params)
+    nb = steps2.shape[1]
     nb_pad = -(-nb // LANE_TILE) * LANE_TILE
     steps2 = _pad_lanes(steps2, nb_pad, jnp.int32(S))
+    steps2, bk = _pad_rows(steps2, S)
     P_flat = pl.pallas_call(
         functools.partial(_products_kernel, K=K, S=S, bk=bk),
         grid=(nb_pad // LANE_TILE,),
@@ -200,7 +266,7 @@ def pass_products(params: HmmParams, steps2: jnp.ndarray):
         out_specs=_vspec((K * K, LANE_TILE), lambda i: (0, i)),
         out_shape=jax.ShapeDtypeStruct((K * K, nb_pad), jnp.float32),
         interpret=_interpret(),
-    )(steps2, logA, logB)
+    )(steps2, logAT, logB)
     P = P_flat.T.reshape(nb_pad, K, K)[:nb]
     incl = jax.lax.associative_scan(maxplus_matmul, P, axis=0)
     return incl, incl[-1]
@@ -209,13 +275,14 @@ def pass_products(params: HmmParams, steps2: jnp.ndarray):
 def pass_backpointers(params: HmmParams, v_enter: jnp.ndarray, steps2: jnp.ndarray):
     """Pallas twin of viterbi_parallel._pass_backpointers.
 
-    Returns (delta_blocks [nb, K], F [nb, K], bp_packed [bk, nb] int32) — the
-    backpointer blob is bit-packed, consumed only by :func:`pass_backtrace`.
+    Returns (delta_blocks [nb, K], F [nb, K], blob) — the backpointer blob is
+    bit-packed and row/lane-padded, consumed only by :func:`pass_backtrace`.
     """
-    K, S, logA, logB = _step_mats_const(params)
-    bk, nb = steps2.shape
+    K, S, logAT, logB = _step_mats_const(params)
+    bk_real, nb = steps2.shape
     nb_pad = -(-nb // LANE_TILE) * LANE_TILE
     steps2 = _pad_lanes(steps2, nb_pad, jnp.int32(S))
+    steps2, bk = _pad_rows(steps2, S)
     v_enter2 = _pad_lanes(v_enter.T, nb_pad, 0.0)
     bp_packed, dexit, ftab_packed = pl.pallas_call(
         functools.partial(_backpointers_kernel, K=K, S=S, bk=bk),
@@ -237,25 +304,27 @@ def pass_backpointers(params: HmmParams, v_enter: jnp.ndarray, steps2: jnp.ndarr
             jax.ShapeDtypeStruct((1, nb_pad), jnp.int32),
         ],
         interpret=_interpret(),
-    )(steps2, v_enter2, logA, logB)
+    )(steps2, v_enter2, logAT, logB)
     shifts = 3 * jnp.arange(K, dtype=jnp.int32)
     F = (jnp.right_shift(ftab_packed[0, :nb, None], shifts[None, :]) & 7).astype(jnp.int32)
-    # bp_packed stays lane-padded — it is the dominant buffer (~4 B/symbol) and
-    # pass_backtrace consumes it as-is, deriving nb from len(exits); slicing it
-    # here would materialize an extra HBM copy just to re-pad it there.
-    return dexit.T[:nb], F, bp_packed
+    # bp_packed stays row- and lane-padded — it is the dominant buffer
+    # (~4 B/symbol) and pass_backtrace consumes it as-is (padded rows are
+    # identity tables, so walking them is a no-op); slicing here would
+    # materialize an extra HBM copy just to re-pad it there.
+    return dexit.T[:nb], F, (bp_packed, bk_real)
 
 
-def pass_backtrace(bp_packed: jnp.ndarray, exits: jnp.ndarray) -> jnp.ndarray:
+def pass_backtrace(blob, exits: jnp.ndarray) -> jnp.ndarray:
     """Pallas twin of viterbi_parallel._pass_backtrace -> [bk*nb] path.
 
-    bp_packed: [bk, >=nb] (possibly lane-padded by pass_backpointers);
+    blob: (bp_packed [bk_pad, >=nb], bk) from pass_backpointers;
     exits: [nb] — the real lane count.
     """
+    bp_packed, bk_real = blob
     bk = bp_packed.shape[0]
     nb = exits.shape[0]
     nb_pad = -(-bp_packed.shape[1] // LANE_TILE) * LANE_TILE
-    bp_packed = _pad_lanes(bp_packed, nb_pad, 0)
+    bp_packed = _pad_lanes(bp_packed, nb_pad, PACKED_IDENTITY)
     exits2 = _pad_lanes(exits[None, :], nb_pad, 0)
     path2 = pl.pallas_call(
         functools.partial(_backtrace_kernel, bk=bk),
@@ -265,10 +334,10 @@ def pass_backtrace(bp_packed: jnp.ndarray, exits: jnp.ndarray) -> jnp.ndarray:
             _vspec((1, LANE_TILE), lambda i: (0, i)),
         ],
         out_specs=_vspec((bk, LANE_TILE), lambda i: (0, i)),
-        out_shape=jax.ShapeDtypeStruct((bk, nb_pad), jnp.int8),
+        out_shape=jax.ShapeDtypeStruct((bk, nb_pad), jnp.int32),
         interpret=_interpret(),
     )(bp_packed, exits2)
-    return path2[:, :nb].T.reshape(-1).astype(jnp.int32)
+    return path2[:bk_real, :nb].T.reshape(-1)
 
 
 def _require_support(params):

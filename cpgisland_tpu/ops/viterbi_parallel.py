@@ -83,9 +83,16 @@ def _compose(earlier: jnp.ndarray, later: jnp.ndarray) -> jnp.ndarray:
 
 
 def _select_step_mats(syms: jnp.ndarray, M_flat: jnp.ndarray, K: int) -> jnp.ndarray:
-    """One-hot-select per-lane step matrices: [nb] syms -> [nb, K, K]."""
+    """One-hot-select per-lane step matrices: [nb] syms -> [nb, K, K].
+
+    HIGHEST precision: on TPU the default matmul precision rounds f32 operands
+    to bf16 on the MXU — a pure selection contraction must not perturb the
+    selected log-probs (the Pallas engine selects exactly; keeping this exact
+    keeps the engines bit-identical).
+    """
     oh = jax.nn.one_hot(syms, M_flat.shape[0], dtype=M_flat.dtype)
-    return (oh @ M_flat).reshape(syms.shape[0], K, K)
+    sel = jnp.matmul(oh, M_flat, precision=jax.lax.Precision.HIGHEST)
+    return sel.reshape(syms.shape[0], K, K)
 
 
 class BlockDecode(NamedTuple):
