@@ -60,23 +60,30 @@ def bench_decode(n_symbols: int, engine: str = "auto") -> float:
     return tput
 
 
-def bench_em(n_chunks: int, chunk_size: int = 0x10000) -> float:
-    """Measure single-chip E-step+M-step throughput (sym/s per EM iteration)."""
+def bench_em(n_chunks: int, chunk_size: int = 0x10000, engine: str = "auto") -> float:
+    """Measure single-chip E-step+M-step throughput (sym/s per EM iteration).
+
+    Default n_chunks=512 ~= the per-chip share of the chr1-scale EM workload on
+    a v5e-8 (250e6 / 65536 / 8 chips ~= 477 chunks), so the measured batch is
+    representative of what each chip actually processes.
+    """
     import jax
     import jax.numpy as jnp
 
     from cpgisland_tpu.models import presets
-    from cpgisland_tpu.ops.forward_backward import batch_stats
+    from cpgisland_tpu.train.backends import LocalBackend, resolve_fb_engine
     from cpgisland_tpu.train.baum_welch import mstep
 
     params = presets.durbin_cpg8()
+    eng = resolve_fb_engine(engine, params, "rescaled")
+    backend = LocalBackend(mode="rescaled", engine=eng)
     rng = np.random.default_rng(1)
     chunks = jnp.asarray(rng.integers(0, 4, size=(n_chunks, chunk_size), dtype=np.int32).astype(np.uint8))
     lengths = jnp.full(n_chunks, chunk_size, dtype=jnp.int32)
 
     @jax.jit
     def em_iter(p):
-        return mstep(p, batch_stats(p, chunks, lengths, mode="rescaled"))
+        return mstep(p, backend(p, chunks, lengths))
 
     p = em_iter(params)
     jax.block_until_ready(p)  # compile + warm
@@ -87,14 +94,14 @@ def bench_em(n_chunks: int, chunk_size: int = 0x10000) -> float:
         best = min(best, time.perf_counter() - t0)
     n_sym = n_chunks * chunk_size
     tput = n_sym / best
-    log(f"em: {tput/1e6:.1f} Msym/s/iter ({best*1e3:.0f} ms / {n_sym/2**20:.0f} MiB)")
+    log(f"em[{eng}]: {tput/1e6:.1f} Msym/s/iter ({best*1e3:.0f} ms / {n_sym/2**20:.0f} MiB)")
     return tput
 
 
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--decode-mib", type=int, default=64)
-    ap.add_argument("--em-chunks", type=int, default=128)
+    ap.add_argument("--em-chunks", type=int, default=512)
     ap.add_argument("--engine", default="auto", choices=("auto", "xla", "pallas"))
     ap.add_argument("--platform", default="auto", help="auto|cpu|tpu (axon ignores JAX_PLATFORMS)")
     args = ap.parse_args()
@@ -106,7 +113,7 @@ def main() -> int:
     log(f"devices: {jax.devices()}")
 
     decode_tput = bench_decode(args.decode_mib * (1 << 20), engine=args.engine)
-    em_tput = bench_em(args.em_chunks)
+    em_tput = bench_em(args.em_chunks, engine=args.engine)
 
     projected = GRCH38_SYMBOLS / (decode_tput * N_CHIPS) + EM_ITERS * EM_TRAIN_SYMBOLS / (
         em_tput * N_CHIPS
