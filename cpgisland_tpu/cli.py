@@ -62,7 +62,13 @@ def _select_platform(argv: list) -> list:
 
 
 def _common_flags(p: argparse.ArgumentParser) -> None:
-    p.add_argument("--backend", choices=("local", "spmd"), default="local")
+    p.add_argument(
+        "--backend",
+        choices=("local", "spmd", "seq"),
+        default="local",
+        help="E-step backend: one device / chunk-sharded mesh psum / exact "
+        "whole-sequence sequence-parallel (no chunk-boundary approximation)",
+    )
     p.add_argument("--numerics", choices=("log", "rescaled"), default="rescaled", dest="mode")
     p.add_argument(
         "--engine",

@@ -1,0 +1,312 @@
+"""Sequence-parallel forward-backward: exact whole-sequence E-step over a mesh.
+
+The reference's trainer APPROXIMATES one long genome as independent
+65,536-symbol chunks — every chunk restarts from pi and no expected transition
+count crosses a chunk boundary (the Mahout mapper contract,
+CpGIslandFinder.java:130-141,200-201).  This module computes the EXACT
+sufficient statistics of the undivided sequence, sharded along time across the
+mesh (SURVEY.md §5 "Long-sequence scaling": forward-backward as a (+,x)
+semiring scan with boundary-message exchange over ICI).
+
+Structure per device (mirroring ops.viterbi_parallel's blockwise layout — a
+`lax.scan` of ``block_size`` sequential steps over ``n_blocks`` parallel
+lanes):
+
+1. **Pass A (operators)** — each lane forms the probability-space product of
+   its block's step matrices S_t = A * B[:, o_t] (one [nb,K]x[K,K] batched
+   matmul per step, normalized per step to stay in f32 range).  An
+   `associative_scan` over lane products + a tiny cross-device `all_gather` of
+   the [K, K] per-device totals give every lane its EXACT (normalized)
+   entering alpha — the forward boundary message.
+2. **Pass B (forward)** — lanes re-run the scaled forward recurrence from
+   their true entering vectors, storing normalized alphas and the per-step
+   scale factors whose logs sum (via `psum`) to the exact sequence
+   log-likelihood.
+3. **Pass C (backward + stats)** — suffix operator products (lane-level scan
+   + the same gathered device totals) give every lane its exact entering beta
+   DIRECTION from the right; a reverse scan fuses the beta recurrence with
+   gamma/xi accumulation.  Scale-free trick: true gamma_t and xi_t each sum
+   to 1 over their indices, so normalizing the per-step outer products
+   reconstructs them exactly from the beta direction alone — no scale chain
+   has to cross device boundaries.
+
+Total cross-device communication per E-step: one all_gather of [K, K] totals
+and one of [K] init vectors — independent of sequence length, riding ICI.
+
+Boundary pairs (the expected transition counts the reference DROPS at chunk
+boundaries) are owned by the later block/device: its lane-0 xi uses the
+entering alpha message, so every adjacent pair in the genome is counted
+exactly once.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from cpgisland_tpu.models.hmm import HmmParams
+from cpgisland_tpu.ops.forward_backward import SuffStats
+from cpgisland_tpu.parallel.mesh import SEQ_AXIS, make_mesh
+
+DEFAULT_BLOCK = 1024
+_HI = jax.lax.Precision.HIGHEST
+_TINY = 1e-30
+
+
+def _nrm_v(v):
+    return v / jnp.maximum(jnp.sum(v, axis=-1, keepdims=True), _TINY)
+
+
+def _nrm_m(m):
+    return m / jnp.maximum(jnp.sum(m, axis=(-2, -1), keepdims=True), _TINY)
+
+
+def _prob_tables(params: HmmParams):
+    """Probability-space step tables with a trailing identity PAD row.
+
+    Sp_ext[s] = A * B[:, s] (column-scaled transition matrix) for s < M;
+    Sp_ext[M] = I so PAD steps are exact pass-throughs.  B_ext[s] = B[:, s],
+    with B_ext[M] = 1 (emission identity).
+    """
+    K = params.n_states
+    A = jnp.exp(params.log_A)
+    B = jnp.exp(params.log_B)  # [K, M]
+    Sp = A[None, :, :] * B.T[:, None, :]  # [M, K, K]
+    Sp_ext = jnp.concatenate([Sp, jnp.eye(K, dtype=A.dtype)[None]], axis=0)
+    B_ext = jnp.concatenate([B.T, jnp.ones((1, K), A.dtype)], axis=0)
+    return Sp_ext, B_ext
+
+
+def _select(table_flat: jnp.ndarray, syms: jnp.ndarray) -> jnp.ndarray:
+    """Exact one-hot row selection (TPU gathers are slow; see viterbi_parallel)."""
+    oh = jax.nn.one_hot(syms, table_flat.shape[0], dtype=table_flat.dtype)
+    return jnp.matmul(oh, table_flat, precision=_HI)
+
+
+def _matmul_combine(a, b):
+    """Normalized batched matrix product — the (+,x) semiring combine."""
+    return _nrm_m(jnp.einsum("...ij,...jk->...ik", a, b, precision=_HI))
+
+
+def _shard_stats_body(block_size: int, axis: str):
+    """Per-device E-step body (runs under shard_map).
+
+    obs_shard: [L] symbols (PAD >= n_symbols allowed in the trailing pad);
+    len_shard: [1] count of real symbols in this shard.  Real symbols must be
+    a contiguous global prefix (pads only trail the sequence).
+    """
+
+    def body(params: HmmParams, obs_shard: jnp.ndarray, len_shard: jnp.ndarray) -> SuffStats:
+        K, M = params.n_states, params.n_symbols
+        L = obs_shard.shape[0]
+        nb = L // block_size
+        d = jax.lax.axis_index(axis)
+
+        A = jnp.exp(params.log_A)
+        Sp_ext, B_ext = _prob_tables(params)
+        Sp_flat = Sp_ext.reshape(M + 1, K * K)
+
+        length = len_shard[0]
+        obs_c = jnp.minimum(obs_shard.astype(jnp.int32), M)  # clamp stray values to PAD
+        pos_valid = jnp.arange(L) < length
+        # The global init's emission folds into v0, so its step is identity
+        # (exactly the viterbi_parallel / parallel.decode trick).
+        is_init = (jnp.arange(L) == 0) & (d == 0)
+        step_valid = pos_valid & ~is_init
+        sel_sym = jnp.where(step_valid, jnp.where(pos_valid, obs_c, M), M)
+        emit_sym = jnp.where(pos_valid, jnp.minimum(obs_c, M - 1), 0)
+
+        # [bs, nb] block layout: lane b covers positions [b*bs, (b+1)*bs).
+        def to2(x):
+            return x.reshape(nb, block_size).T
+
+        sel2, emit2 = to2(sel_sym), to2(emit_sym)
+        sv2, pv2 = to2(step_valid), to2(pos_valid)
+
+        # --- forward boundary messages -----------------------------------
+        v0_local = jnp.exp(params.log_pi) * B_ext[jnp.minimum(obs_c[0], M - 1)]
+        v0_raw = jax.lax.all_gather(v0_local, axis)[0]  # device 0's init vector
+        v0n = _nrm_v(v0_raw)
+
+        # Pass A: per-lane operator products (normalized each step).
+        eye_b = jnp.broadcast_to(
+            jnp.eye(K, dtype=A.dtype)[None] + (sel2[0, :, None, None] * 0).astype(A.dtype),
+            (nb, K, K),
+        )
+
+        def passA(C, syms_k):
+            sel = _select(Sp_flat, syms_k).reshape(nb, K, K)
+            return _nrm_m(jnp.einsum("nij,njk->nik", C, sel, precision=_HI)), None
+
+        P_lane, _ = jax.lax.scan(passA, eye_b, sel2)  # [nb, K, K]
+        incl = jax.lax.associative_scan(_matmul_combine, P_lane, axis=0)
+
+        total_dev = incl[-1]
+        totals = jax.lax.all_gather(total_dev, axis)  # [D, K, K]
+
+        def pstep(v, Tk):
+            return _nrm_v(jnp.matmul(v, Tk, precision=_HI)), v
+
+        _, enters_dev = jax.lax.scan(pstep, v0n, totals)
+        v_enter_dev = enters_dev[d]  # exact normalized alpha entering this shard
+
+        excl = jnp.concatenate([eye_b[:1], incl[:-1]], axis=0)
+        enters = _nrm_v(jnp.einsum("k,nkj->nj", v_enter_dev, excl, precision=_HI))
+
+        # --- Pass B: scaled forward from true entering vectors -----------
+        def passB(alpha, inp):
+            syms_k, sv_k = inp
+            bcol = _select(B_ext, syms_k)  # [nb, K]
+            raw = jnp.einsum("nk,kj->nj", alpha, A, precision=_HI) * bcol
+            c = jnp.sum(raw, axis=-1)
+            new = raw / jnp.maximum(c, _TINY)[:, None]
+            alpha = jnp.where(sv_k[:, None], new, alpha)
+            c = jnp.where(sv_k, c, 1.0)
+            return alpha, (alpha, c)
+
+        _, (alphas, cs) = jax.lax.scan(passB, enters, (sel2, sv2))  # [bs, nb, K], [bs, nb]
+        # The init's folded-emission scale belongs to device 0 — and only when
+        # it actually observed a symbol (an all-padding stream has loglik 0).
+        loglik = jnp.sum(jnp.where(sv2, jnp.log(cs), 0.0)) + jnp.where(
+            (d == 0) & (length > 0), jnp.log(jnp.maximum(jnp.sum(v0_raw), _TINY)), 0.0
+        )
+
+        # --- backward boundary messages -----------------------------------
+        ones_dir = jnp.full((K,), 1.0 / K, A.dtype) + v0n * 0.0
+
+        def sstep(b, Tk):
+            return _nrm_v(jnp.matmul(Tk, b, precision=_HI)), b
+
+        _, exits_dev = jax.lax.scan(sstep, ones_dir, totals, reverse=True)
+        beta_exit_dev = exits_dev[d]  # beta direction at this shard's last position
+
+        # Lane-level suffix products P_b @ P_{b+1} @ ... (flip-scan-flip: the
+        # combine sees flipped operands, so apply them flipped back).
+        Rsuf = jax.lax.associative_scan(
+            lambda a, b: _matmul_combine(b, a), P_lane, axis=0, reverse=True
+        )
+        beta_exits = jnp.concatenate(
+            [
+                _nrm_v(jnp.einsum("nij,j->ni", Rsuf[1:], beta_exit_dev, precision=_HI)),
+                beta_exit_dev[None],
+            ],
+            axis=0,
+        )  # [nb, K]
+
+        # --- Pass C: fused backward + gamma/xi accumulation ---------------
+        a_prev = jnp.concatenate([enters[None], alphas[:-1]], axis=0)  # [bs, nb, K]
+        sel_next2 = jnp.concatenate([sel2[1:], jnp.full((1, nb), M, sel2.dtype)], axis=0)
+        svn2 = jnp.concatenate([sv2[1:], jnp.zeros((1, nb), bool)], axis=0)
+        last2 = jnp.zeros((block_size, nb), bool).at[-1].set(True)
+
+        trans0 = jnp.zeros((nb, K, K), A.dtype) + eye_b * 0.0
+        emit0 = jnp.zeros((nb, K, M), A.dtype) + enters[:, :, None] * 0.0
+
+        def passC(carry, inp):
+            beta_next, trans_acc, emit_acc = carry
+            alpha_t, aprev_t, sym_t, sym_next, sv_t, pv_t, svn_t, last_t = inp
+            w = _select(B_ext, sym_next) * beta_next  # [nb, K]
+            beta_rec = _nrm_v(jnp.einsum("nk,jk->nj", w, A, precision=_HI))
+            beta_t = jnp.where(
+                last_t[:, None],
+                beta_exits,
+                jnp.where(svn_t[:, None], beta_rec, beta_next),
+            )
+            # gamma_t: true value sums to 1 -> normalize reconstructs scale.
+            gamma = _nrm_v(alpha_t * beta_t)
+            oh = jax.nn.one_hot(sym_t, M, dtype=A.dtype)  # emit2 is pre-clamped to < M
+            emit_acc = emit_acc + jnp.where(
+                pv_t[:, None, None], gamma[:, :, None] * oh[:, None, :], 0.0
+            )
+            # xi for the (t-1 -> t) pair, owned by position t; lane-0 pairs use
+            # the entering-alpha boundary message (aprev_t == enters there).
+            bcol_t = _select(B_ext, sym_t)
+            xr = aprev_t[:, :, None] * A[None] * (bcol_t * beta_t)[:, None, :]
+            xi = xr / jnp.maximum(jnp.sum(xr, axis=(-2, -1), keepdims=True), _TINY)
+            trans_acc = trans_acc + jnp.where(sv_t[:, None, None], xi, 0.0)
+            return (beta_t, trans_acc, emit_acc), None
+
+        # emission one-hot uses the REAL symbol layout (emit2), not sel2.
+        (beta_first, trans_l, emit_l), _ = jax.lax.scan(
+            passC,
+            (beta_exits, trans0, emit0),
+            (alphas, a_prev, emit2, sel_next2, sv2, pv2, svn2, last2),
+            reverse=True,
+        )
+
+        gamma0 = _nrm_v(alphas[0, 0] * beta_first[0])
+        at_init = (d == 0) & (length > 0)
+        stats = SuffStats(
+            init=jnp.where(at_init, gamma0, jnp.zeros_like(gamma0)),
+            trans=jnp.sum(trans_l, axis=0),
+            emit=jnp.sum(emit_l, axis=0),
+            loglik=loglik,
+            n_seqs=jnp.where(at_init, 1, 0).astype(jnp.int32),
+        )
+        return jax.lax.psum(stats, axis)
+
+    return body
+
+
+@functools.lru_cache(maxsize=32)
+def sharded_stats_fn(mesh: Mesh, block_size: int):
+    """Compiled placed-array entry point: fn(params, obs_flat, lengths).
+
+    obs_flat: [D * L] symbols placed with P(axis) (L a multiple of
+    block_size); lengths: [D] int32 placed with P(axis) — the layout
+    :func:`shard_sequence` + a NamedSharding device_put produce.  Cached per
+    (mesh, block_size); params stay traced so model updates never recompile.
+    """
+    axis = mesh.axis_names[0]
+    body = _shard_stats_body(block_size, axis)
+    return jax.jit(
+        jax.shard_map(
+            body,
+            mesh=mesh,
+            in_specs=(P(), P(axis), P(axis)),
+            out_specs=P(),
+        )
+    )
+
+
+def shard_sequence(obs: np.ndarray, n_shards: int, block_size: int = DEFAULT_BLOCK, pad_value: int = 4):
+    """Split one symbol stream into per-device shards (padded, with lengths).
+
+    Returns (obs_padded [n_shards * L] uint8, lengths [n_shards] int32).
+    """
+    obs = np.ascontiguousarray(obs, dtype=np.uint8)
+    T = obs.shape[0]
+    quantum = n_shards * block_size
+    padded_T = max(quantum, ((T + quantum - 1) // quantum) * quantum)
+    if padded_T != T:
+        obs = np.concatenate([obs, np.full(padded_T - T, pad_value, dtype=np.uint8)])
+    L = padded_T // n_shards
+    lengths = np.clip(T - np.arange(n_shards) * L, 0, L).astype(np.int32)
+    return obs, lengths
+
+
+def seq_stats_sharded(
+    params: HmmParams,
+    obs,
+    *,
+    mesh: Mesh | None = None,
+    block_size: int = DEFAULT_BLOCK,
+) -> SuffStats:
+    """Exact whole-sequence sufficient statistics, sequence-parallel over a mesh.
+
+    The drop-in "one long genome" alternative to chunked
+    ops.forward_backward.batch_stats: identical SuffStats contract, but with no
+    independence approximation at 65,536-symbol boundaries.
+    """
+    if mesh is None:
+        mesh = make_mesh(axis=SEQ_AXIS)
+    n_dev = mesh.shape[mesh.axis_names[0]]
+    obs_p, lengths = shard_sequence(np.asarray(obs), n_dev, block_size, params.n_symbols)
+    axis = mesh.axis_names[0]
+    arr = jax.device_put(jnp.asarray(obs_p), NamedSharding(mesh, P(axis)))
+    lens = jax.device_put(jnp.asarray(lengths), NamedSharding(mesh, P(axis)))
+    return sharded_stats_fn(mesh, block_size)(params, arr, lens)

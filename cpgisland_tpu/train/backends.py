@@ -29,6 +29,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from cpgisland_tpu.models.hmm import HmmParams
 from cpgisland_tpu.ops import fb_pallas
 from cpgisland_tpu.ops.forward_backward import SuffStats, batch_stats, chunk_stats
+from cpgisland_tpu.parallel import fb_sharded
 from cpgisland_tpu.parallel.mesh import make_mesh
 from cpgisland_tpu.utils import chunking
 
@@ -161,6 +162,62 @@ class SpmdBackend(EStepBackend):
         return self._estep_for(params)(params, chunks, lengths)
 
 
+class SeqBackend(EStepBackend):
+    """Exact whole-sequence E-step, sequence-parallel over the mesh.
+
+    Treats the ENTIRE training input as ONE contiguous sequence (n_seqs == 1),
+    sharded along time across devices with boundary-message exchange
+    (parallel.fb_sharded) — no 65,536-symbol independence approximation and no
+    dropped boundary transition pairs, unlike the reference's chunked mapper
+    contract (CpGIslandFinder.java:130-141).  Numerics are rescaled
+    probability-space (the scale-free boundary trick needs them); ``mode`` /
+    ``engine`` knobs of the chunked backends don't apply.
+    """
+
+    def __init__(
+        self,
+        mesh: Optional[Mesh] = None,
+        block_size: Optional[int] = None,
+        axis: str = "seq",
+    ):
+        self.mesh = mesh if mesh is not None else make_mesh(axis=axis)
+        self.block_size = block_size if block_size is not None else fb_sharded.DEFAULT_BLOCK
+        self.axis = self.mesh.axis_names[0]
+
+    def prepare(self, chunked: chunking.Chunked) -> chunking.Chunked:
+        """Re-frame any chunk batch as one stream sharded across the mesh."""
+        stream = np.concatenate(
+            [np.asarray(c[:l]) for c, l in zip(chunked.chunks, chunked.lengths)]
+        ) if chunked.num_chunks else np.zeros(0, np.uint8)
+        n_dev = self.mesh.shape[self.axis]
+        obs_p, lengths = fb_sharded.shard_sequence(stream, n_dev, self.block_size)
+        return chunking.Chunked(
+            chunks=obs_p.reshape(n_dev, -1), lengths=lengths, total=int(stream.shape[0])
+        )
+
+    def place(self, chunks, lengths):
+        sharding = NamedSharding(self.mesh, P(self.axis))
+        return (
+            jax.device_put(jnp.asarray(np.asarray(chunks).reshape(-1)), sharding),
+            jax.device_put(jnp.asarray(lengths), sharding),
+        )
+
+    def __call__(self, params, obs_flat, lengths):
+        n_dev = self.mesh.shape[self.axis]
+        if getattr(obs_flat, "ndim", 1) != 1:
+            raise ValueError(
+                f"SeqBackend expects a flat placed [D*L] stream, got shape "
+                f"{obs_flat.shape}; run prepare() + place() first"
+            )
+        if obs_flat.shape[0] % (n_dev * self.block_size) != 0:
+            raise ValueError(
+                f"stream length {obs_flat.shape[0]} not a multiple of "
+                f"devices*block_size = {n_dev}*{self.block_size}; run prepare() first"
+            )
+        fn = fb_sharded.sharded_stats_fn(self.mesh, self.block_size)
+        return fn(params, obs_flat, lengths)
+
+
 def get_backend(
     name: str = "local",
     *,
@@ -173,4 +230,12 @@ def get_backend(
         return LocalBackend(mode=mode, engine=engine)
     if name == "spmd":
         return SpmdBackend(mesh=mesh, mode=mode, engine=engine)
-    raise ValueError(f"unknown backend {name!r} (expected 'local' or 'spmd')")
+    if name == "seq":
+        # The whole-sequence backend has fixed rescaled numerics and its own
+        # lowering — reject knobs it would otherwise silently ignore.
+        if mode != "rescaled":
+            raise ValueError("backend 'seq' implements rescaled numerics only")
+        if engine not in ("auto", "xla"):
+            raise ValueError(f"backend 'seq' does not take engine {engine!r}")
+        return SeqBackend(mesh=mesh)
+    raise ValueError(f"unknown backend {name!r} (expected 'local', 'spmd', or 'seq')")

@@ -1,0 +1,121 @@
+"""Sequence-parallel exact whole-sequence E-step (parallel/fb_sharded.py).
+
+Anchors: the float64 NumPy oracle (tests/oracle.py) on the UNDIVIDED sequence —
+the sharded statistics must match it, unlike the chunked backends whose
+per-chunk independence approximation drops boundary pairs.  Runs on the 8-device
+virtual CPU mesh from conftest.
+"""
+
+import numpy as np
+import pytest
+
+import oracle
+from cpgisland_tpu.models import presets
+from cpgisland_tpu.models.hmm import HmmParams
+from cpgisland_tpu.parallel.fb_sharded import seq_stats_sharded, shard_sequence
+from cpgisland_tpu.parallel.mesh import make_mesh
+from cpgisland_tpu.train import baum_welch
+from cpgisland_tpu.train.backends import SeqBackend
+from cpgisland_tpu.utils import chunking
+
+
+def _random_params(rng, K=3, M=4):
+    pi = rng.dirichlet(np.ones(K))
+    A = rng.dirichlet(np.ones(K), size=K)
+    B = rng.dirichlet(np.ones(M), size=K)
+    return pi, A, B, HmmParams.from_probs(pi, A, B)
+
+
+def _oracle_stats(pi, A, B, obs):
+    K, M = B.shape
+    gamma, xi_sum, ll = oracle.forward_backward_oracle(pi, A, B, obs)
+    emit = np.zeros((K, M))
+    np.add.at(emit.T, obs, gamma)
+    return gamma[0], xi_sum, emit, ll
+
+
+@pytest.fixture
+def mesh():
+    return make_mesh(8, axis="seq")
+
+
+def test_matches_oracle_whole_sequence(rng, mesh):
+    pi, A, B, params = _random_params(rng)
+    obs = rng.integers(0, 4, size=5003).astype(np.uint8)  # ragged vs 8*64
+    init_o, trans_o, emit_o, ll_o = _oracle_stats(pi, A, B, obs)
+
+    stats = seq_stats_sharded(params, obs, mesh=mesh, block_size=64)
+    assert float(stats.loglik) == pytest.approx(ll_o, abs=0.01)
+    np.testing.assert_allclose(np.asarray(stats.init), init_o, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(stats.trans), trans_o, rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(stats.emit), emit_o, rtol=1e-4, atol=1e-4)
+    assert int(stats.n_seqs) == 1
+
+
+def test_counts_boundary_pairs_chunked_path_drops(rng, mesh):
+    """Total expected transition count == T-1 exactly (every adjacent pair
+    counted once, across all block and device boundaries)."""
+    _, _, _, params = _random_params(rng)
+    T = 4096
+    obs = rng.integers(0, 4, size=T).astype(np.uint8)
+    stats = seq_stats_sharded(params, obs, mesh=mesh, block_size=64)
+    assert float(np.asarray(stats.trans).sum()) == pytest.approx(T - 1, rel=1e-4)
+    assert float(np.asarray(stats.emit).sum()) == pytest.approx(T, rel=1e-4)
+
+
+def test_durbin_preset_and_block_size_invariance(rng, mesh):
+    params = presets.durbin_cpg8()
+    obs = rng.integers(0, 4, size=2048 + 131).astype(np.uint8)
+    s64 = seq_stats_sharded(params, obs, mesh=mesh, block_size=64)
+    s256 = seq_stats_sharded(params, obs, mesh=mesh, block_size=256)
+    np.testing.assert_allclose(np.asarray(s64.trans), np.asarray(s256.trans), rtol=2e-4, atol=2e-4)
+    np.testing.assert_allclose(np.asarray(s64.emit), np.asarray(s256.emit), rtol=2e-4, atol=2e-4)
+    assert float(s64.loglik) == pytest.approx(float(s256.loglik), abs=0.05)
+
+
+def test_tiny_sequence_mostly_padding(rng, mesh):
+    """T far below n_devices * block_size: later shards are pure padding."""
+    pi, A, B, params = _random_params(rng, K=2)
+    obs = rng.integers(0, 4, size=37).astype(np.uint8)
+    init_o, trans_o, emit_o, ll_o = _oracle_stats(pi, A, B, obs)
+    stats = seq_stats_sharded(params, obs, mesh=mesh, block_size=64)
+    assert float(stats.loglik) == pytest.approx(ll_o, abs=1e-3)
+    np.testing.assert_allclose(np.asarray(stats.trans), trans_o, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(stats.emit), emit_o, rtol=1e-4, atol=1e-5)
+
+
+def test_shard_sequence_layout():
+    obs = np.arange(100, dtype=np.uint8) % 4
+    padded, lengths = shard_sequence(obs, 8, block_size=16)
+    assert padded.shape[0] % (8 * 16) == 0
+    L = padded.shape[0] // 8
+    assert int(lengths.sum()) == 100
+    # real symbols form a contiguous global prefix
+    reassembled = np.concatenate([padded[d * L : d * L + lengths[d]] for d in range(8)])
+    np.testing.assert_array_equal(reassembled, obs)
+
+
+def test_em_step_matches_oracle_single_sequence(rng, mesh):
+    """One full EM step through SeqBackend == oracle EM on the whole sequence."""
+    pi, A, B, params = _random_params(rng)
+    obs = rng.integers(0, 4, size=3000).astype(np.uint8)
+    pi_o, A_o, B_o, _ = oracle.em_step_oracle(pi, A, B, [obs])
+
+    backend = SeqBackend(mesh=mesh, block_size=64)
+    chunked = chunking.frame(obs, 512)  # deliberately chunk-framed input
+    res = baum_welch.fit(params, chunked, num_iters=1, convergence=0.0, backend=backend)
+    got = res.params
+    np.testing.assert_allclose(np.asarray(got.pi), pi_o, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(got.A), A_o, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(got.B), B_o, rtol=1e-4, atol=1e-5)
+
+
+def test_em_loglik_monotone_seq_backend(rng, mesh):
+    _, _, _, params = _random_params(rng, K=2)
+    obs = rng.integers(0, 4, size=8192).astype(np.uint8)
+    backend = SeqBackend(mesh=mesh, block_size=128)
+    res = baum_welch.fit(
+        params, chunking.frame(obs, 1024), num_iters=6, convergence=0.0, backend=backend
+    )
+    lls = res.logliks
+    assert all(b >= a - 1e-2 for a, b in zip(lls, lls[1:])), lls
