@@ -179,10 +179,15 @@ class SeqBackend(EStepBackend):
         mesh: Optional[Mesh] = None,
         block_size: Optional[int] = None,
         axis: str = "seq",
+        pad_value: int = chunking.PAD_SYMBOL,
     ):
         self.mesh = mesh if mesh is not None else make_mesh(axis=axis)
         self.block_size = block_size if block_size is not None else fb_sharded.DEFAULT_BLOCK
         self.axis = self.mesh.axis_names[0]
+        # Must be >= the model's n_symbols (fb_sharded's PAD contract); the
+        # default matches the 4-symbol DNA alphabet — pass n_symbols for
+        # bigger alphabets.
+        self.pad_value = pad_value
 
     def prepare(self, chunked: chunking.Chunked) -> chunking.Chunked:
         """Re-frame any chunk batch as one stream sharded across the mesh."""
@@ -190,7 +195,9 @@ class SeqBackend(EStepBackend):
             [np.asarray(c[:l]) for c, l in zip(chunked.chunks, chunked.lengths)]
         ) if chunked.num_chunks else np.zeros(0, np.uint8)
         n_dev = self.mesh.shape[self.axis]
-        obs_p, lengths = fb_sharded.shard_sequence(stream, n_dev, self.block_size)
+        obs_p, lengths = fb_sharded.shard_sequence(
+            stream, n_dev, self.block_size, pad_value=self.pad_value
+        )
         return chunking.Chunked(
             chunks=obs_p.reshape(n_dev, -1), lengths=lengths, total=int(stream.shape[0])
         )

@@ -63,6 +63,9 @@ class FitResult:
     logliks: list
     converged: bool
     deltas: list
+    # (iteration, reason) records of mid-training recoveries (SURVEY.md §5
+    # failure detection); empty on a clean run.
+    recoveries: list = dataclasses.field(default_factory=list)
 
 
 def fit(
@@ -78,6 +81,7 @@ def fit(
     callback: Optional[Callable[[int, float, float], None]] = None,
     start_iteration: int = 0,
     metrics: Optional[profiling.MetricsLogger] = None,
+    fallback_backend: Optional[EStepBackend] = None,
 ) -> FitResult:
     """Run Baum-Welch EM until convergence or ``num_iters``.
 
@@ -86,19 +90,52 @@ def fit(
     delta check) or after ``num_iters`` jobs.  Each iteration optionally writes
     an npz checkpoint (the reference persists the model to HDFS per iteration,
     CpGIslandFinder.java:64-89).
+
+    Failure recovery (SURVEY.md §5): if an iteration's statistics come back
+    non-finite (numerics blowup) or the E-step raises a runtime error
+    (device fault), the iteration is retried once on the same backend; if it
+    fails again and ``fallback_backend`` is given (e.g. a log-numerics
+    LocalBackend, or an :class:`~cpgisland_tpu.train.elastic.ElasticEStep`),
+    training switches to it for the remaining iterations — the model is never
+    updated from corrupt statistics.  Without a fallback the error propagates
+    after the retry.
     """
     if isinstance(backend, str):
         backend = get_backend(backend, mode=mode, engine=engine)
-    chunked = backend.prepare(chunked)
+    chunked0 = chunked
+    chunked = backend.prepare(chunked0)
     chunks, lengths = backend.place(chunked.chunks, chunked.lengths)
 
     logliks: list[float] = []
     deltas: list[float] = []
+    recoveries: list[tuple[int, str]] = []
     converged = False
     it = 0
     for it in range(start_iteration + 1, start_iteration + num_iters + 1):
         t0 = time.perf_counter()
-        stats = backend(params, chunks, lengths)
+        stats = None
+        for attempt in range(3):
+            try:
+                cand = backend(params, chunks, lengths)
+                profiling.check_finite(cand, where=f"E-step iter {it}")
+                stats = cand
+                break
+            except Exception as e:
+                reason = f"iter {it} attempt {attempt + 1}: {e}"
+                log.warning("E-step failed (%s)", reason)
+                if metrics is not None:
+                    metrics.log("em_estep_failure", iteration=it, attempt=attempt + 1,
+                                error=str(e))
+                if attempt == 0:
+                    continue  # transient-fault retry on the same backend
+                if attempt == 1 and fallback_backend is not None:
+                    log.warning("switching to fallback E-step backend at iter %d", it)
+                    recoveries.append((it, reason))
+                    backend = fallback_backend
+                    chunked = backend.prepare(chunked0)
+                    chunks, lengths = backend.place(chunked.chunks, chunked.lengths)
+                    continue
+                raise
         new_params = mstep(params, stats)
         delta = float(new_params.max_abs_diff(params))
         ll = float(stats.loglik)
@@ -107,9 +144,8 @@ def fit(
         deltas.append(delta)
         dt = time.perf_counter() - t0
         log.info("em iter=%d loglik=%.4f delta=%.6f wall=%.3fs", it, ll, delta, dt)
-        # Failure detection (SURVEY.md §5): a numerics blowup surfaces here as
-        # a clear error instead of silently corrupting later iterations; the
-        # per-iteration checkpoint below is the matching restart point.
+        # A blowup in the normalize itself (not the stats) still surfaces as a
+        # hard error — the model is the restart point, so it must stay clean.
         profiling.check_finite(
             {"pi": params.log_pi, "A": params.log_A, "B": params.log_B, "loglik": ll},
             where=f"em iter {it}",
@@ -127,7 +163,8 @@ def fit(
             converged = True
             break
     return FitResult(
-        params=params, iterations=it, logliks=logliks, converged=converged, deltas=deltas
+        params=params, iterations=it, logliks=logliks, converged=converged,
+        deltas=deltas, recoveries=recoveries,
     )
 
 

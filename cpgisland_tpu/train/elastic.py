@@ -1,0 +1,134 @@
+"""Failure detection and elastic re-execution for the E-step (SURVEY.md §5).
+
+The reference inherits all fault tolerance from Hadoop MapReduce: a failed map
+task is re-executed up to mapreduce.map.maxattempts times, and the job can be
+configured to skip bad records (nothing in the driver itself,
+CpGIslandFinder.java:200-201).  JAX has no such substrate, so this module
+provides the TPU-native equivalent as a wrapper around any chunked
+:class:`~cpgisland_tpu.train.backends.EStepBackend`:
+
+- the chunk batch is split into ``micro_batches`` independent slices (the
+  "tasks"); sufficient statistics are additive, so the reduce is a plain sum;
+- each slice is synced to host and checked finite — a device-side numerics
+  blowup or runtime error (OOM, preemption, interconnect fault surfaces as an
+  exception from `block_until_ready`) is detected per-slice, not per-epoch;
+- failed slices are retried up to ``max_retries`` times (task re-execution);
+  with ``on_failure="skip"`` a persistently failing slice is dropped and
+  recorded (skip-bad-records) instead of killing the run — EM degrades
+  gracefully to the statistics of the surviving shards.
+
+Recovery above the E-step (numerics fallback mid-training) lives in
+``train.baum_welch.fit(fallback_backend=...)``.
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from cpgisland_tpu.ops.forward_backward import SuffStats
+from cpgisland_tpu.train.backends import EStepBackend
+from cpgisland_tpu.utils import chunking, profiling
+
+log = logging.getLogger(__name__)
+
+
+@dataclass
+class SliceFailure:
+    """Record of one micro-batch that exhausted its retries."""
+
+    batch_index: int
+    start: int
+    stop: int
+    attempts: int
+    error: str
+
+
+@dataclass
+class ElasticEStep(EStepBackend):
+    """Micro-batched, retrying E-step runner (Hadoop task-retry equivalent).
+
+    Wraps a chunked backend (Local or Spmd).  ``prepare``/``place`` keep the
+    batch on host so each micro-batch is placed independently — a slice that
+    kills a device buffer cannot take the whole epoch's input with it.
+    """
+
+    inner: EStepBackend
+    micro_batches: int = 8
+    max_retries: int = 2
+    on_failure: str = "raise"  # or "skip"
+    metrics: Optional[profiling.MetricsLogger] = None
+    failures: List[SliceFailure] = field(default_factory=list)
+
+    def __post_init__(self):
+        if self.on_failure not in ("raise", "skip"):
+            raise ValueError(f"on_failure must be 'raise' or 'skip', got {self.on_failure!r}")
+
+    def prepare(self, chunked: chunking.Chunked) -> chunking.Chunked:
+        return chunked
+
+    def place(self, chunks, lengths):
+        # Host-side on purpose: slices are placed per micro-batch call.
+        return np.asarray(chunks), np.asarray(lengths)
+
+    def __call__(self, params, chunks, lengths) -> SuffStats:
+        chunks = np.asarray(chunks)
+        lengths = np.asarray(lengths)
+        n = chunks.shape[0]
+        micro = max(1, -(-n // self.micro_batches))
+        total: Optional[SuffStats] = None
+        for i, start in enumerate(range(0, n, micro)):
+            stop = min(start + micro, n)
+            stats = self._run_slice(params, chunks[start:stop], lengths[start:stop], i, start, stop)
+            if stats is not None:
+                total = stats if total is None else total + stats
+        if total is None:
+            raise RuntimeError(
+                f"all {self.micro_batches} E-step micro-batches failed; see .failures"
+            )
+        return total
+
+    def _run_slice(self, params, chunks, lengths, idx, start, stop) -> Optional[SuffStats]:
+        sub = chunking.Chunked(
+            chunks=chunks, lengths=lengths, total=int(np.asarray(lengths).sum())
+        )
+        sub = self.inner.prepare(sub)
+        last_err: Exception = RuntimeError("unreachable")
+        for attempt in range(1, self.max_retries + 2):
+            try:
+                stats = self.inner(params, jnp.asarray(sub.chunks), jnp.asarray(sub.lengths))
+                # Sync to host: surfaces asynchronous device errors here, and
+                # makes the finite-check see real values.
+                host = jax.tree_util.tree_map(np.asarray, stats)
+                profiling.check_finite(host, where=f"E-step slice {idx}")
+                if attempt > 1 and self.metrics is not None:
+                    self.metrics.log("estep_slice_recovered", slice=idx, attempts=attempt)
+                return host
+            except Exception as e:  # XlaRuntimeError, FloatingPointError, ...
+                last_err = e
+                log.warning(
+                    "E-step slice %d (chunks %d:%d) attempt %d/%d failed: %s",
+                    idx, start, stop, attempt, self.max_retries + 1, e,
+                )
+                if self.metrics is not None:
+                    self.metrics.log(
+                        "estep_slice_failure", slice=idx, attempt=attempt, error=str(e)
+                    )
+        failure = SliceFailure(
+            batch_index=idx, start=start, stop=stop,
+            attempts=self.max_retries + 1, error=str(last_err),
+        )
+        self.failures.append(failure)
+        if self.on_failure == "raise":
+            raise RuntimeError(
+                f"E-step slice {idx} (chunks {start}:{stop}) failed "
+                f"{failure.attempts} times: {last_err}"
+            ) from last_err
+        log.error("dropping E-step slice %d after %d attempts (on_failure='skip')",
+                  idx, failure.attempts)
+        return None

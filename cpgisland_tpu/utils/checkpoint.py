@@ -33,6 +33,7 @@ class TrainState:
 def save(path: str, state: TrainState) -> None:
     """Atomically write a TrainState snapshot as .npz (write temp + rename)."""
     d = os.path.dirname(os.path.abspath(path)) or "."
+    os.makedirs(d, exist_ok=True)
     fd, tmp = tempfile.mkstemp(dir=d, suffix=".npz.tmp")
     try:
         with os.fdopen(fd, "wb") as f:

@@ -1,0 +1,166 @@
+"""Failure detection / elastic recovery (train/elastic.py + fit fallback).
+
+The reference delegates all of this to Hadoop (task retry, skip-bad-records);
+here it's first-class and testable: fault-injecting backends simulate device
+failures and numerics blowups, and the recovered statistics must equal the
+clean full-batch result exactly (statistics are additive, so micro-batching is
+lossless).
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from cpgisland_tpu.models import presets
+from cpgisland_tpu.ops.forward_backward import SuffStats
+from cpgisland_tpu.train import baum_welch
+from cpgisland_tpu.train.backends import EStepBackend, LocalBackend
+from cpgisland_tpu.train.elastic import ElasticEStep
+from cpgisland_tpu.utils import chunking
+
+
+@pytest.fixture
+def data(rng):
+    syms = rng.integers(0, 4, size=16 * 256).astype(np.uint8)
+    return chunking.frame(syms, 256)
+
+
+class FlakyBackend(EStepBackend):
+    """Delegates to LocalBackend but raises on the first ``n_failures`` calls."""
+
+    def __init__(self, n_failures, exc=RuntimeError("injected device fault")):
+        self.inner = LocalBackend(mode="rescaled", engine="xla")
+        self.remaining = n_failures
+        self.exc = exc
+        self.calls = 0
+
+    def __call__(self, params, chunks, lengths):
+        self.calls += 1
+        if self.remaining > 0:
+            self.remaining -= 1
+            raise self.exc
+        return self.inner(params, chunks, lengths)
+
+
+class NaNBackend(EStepBackend):
+    """Returns NaN-poisoned statistics on the first ``n_bad`` calls."""
+
+    def __init__(self, n_bad):
+        self.inner = LocalBackend(mode="rescaled", engine="xla")
+        self.remaining = n_bad
+
+    def __call__(self, params, chunks, lengths):
+        stats = self.inner(params, chunks, lengths)
+        if self.remaining > 0:
+            self.remaining -= 1
+            return SuffStats(
+                init=stats.init, trans=stats.trans * jnp.nan, emit=stats.emit,
+                loglik=stats.loglik, n_seqs=stats.n_seqs,
+            )
+        return stats
+
+
+def _clean_stats(params, data):
+    b = LocalBackend(mode="rescaled", engine="xla")
+    return b(params, jnp.asarray(data.chunks), jnp.asarray(data.lengths))
+
+
+def assert_stats_close(a, b, tol=1e-5):
+    np.testing.assert_allclose(np.asarray(a.trans), np.asarray(b.trans), rtol=tol, atol=tol)
+    np.testing.assert_allclose(np.asarray(a.emit), np.asarray(b.emit), rtol=tol, atol=tol)
+    np.testing.assert_allclose(np.asarray(a.init), np.asarray(b.init), rtol=tol, atol=tol)
+    assert float(a.loglik) == pytest.approx(float(b.loglik), abs=0.01)
+
+
+def test_micro_batched_sum_equals_full_batch(data):
+    params = presets.durbin_cpg8()
+    el = ElasticEStep(LocalBackend(mode="rescaled", engine="xla"), micro_batches=4)
+    got = el(params, data.chunks, data.lengths)
+    assert_stats_close(got, _clean_stats(params, data))
+    assert el.failures == []
+
+
+def test_retry_recovers_from_transient_faults(data):
+    params = presets.durbin_cpg8()
+    flaky = FlakyBackend(n_failures=2)
+    el = ElasticEStep(flaky, micro_batches=4, max_retries=2)
+    got = el(params, data.chunks, data.lengths)
+    assert_stats_close(got, _clean_stats(params, data))
+    assert el.failures == []
+    assert flaky.calls > 4  # retries actually happened
+
+
+def test_nan_stats_detected_and_retried(data):
+    params = presets.durbin_cpg8()
+    el = ElasticEStep(NaNBackend(n_bad=1), micro_batches=4, max_retries=1)
+    got = el(params, data.chunks, data.lengths)
+    assert_stats_close(got, _clean_stats(params, data))
+
+
+def test_persistent_failure_raises_by_default(data):
+    params = presets.durbin_cpg8()
+    el = ElasticEStep(FlakyBackend(n_failures=100), micro_batches=4, max_retries=1)
+    with pytest.raises(RuntimeError, match="failed"):
+        el(params, data.chunks, data.lengths)
+    assert len(el.failures) == 1
+
+
+def test_skip_mode_drops_bad_slice_and_continues(data):
+    params = presets.durbin_cpg8()
+
+    class FailsOnce(EStepBackend):
+        """Fails every attempt of exactly one slice (the first one called)."""
+
+        def __init__(self):
+            self.inner = LocalBackend(mode="rescaled", engine="xla")
+            self.poisoned = None
+
+        def __call__(self, params, chunks, lengths):
+            key = int(np.asarray(chunks[0, :8]).sum())
+            if self.poisoned is None:
+                self.poisoned = key
+            if key == self.poisoned:
+                raise RuntimeError("bad shard")
+            return self.inner(params, chunks, lengths)
+
+    el = ElasticEStep(FailsOnce(), micro_batches=4, max_retries=0, on_failure="skip")
+    got = el(params, data.chunks, data.lengths)
+    assert len(el.failures) == 1
+    # surviving slices only: 12 of 16 chunks
+    micro = 4
+    keep = np.ones(16, bool)
+    keep[el.failures[0].start : el.failures[0].stop] = False
+    sub = chunking.Chunked(data.chunks[keep], data.lengths[keep], total=int(data.lengths[keep].sum()))
+    assert_stats_close(got, _clean_stats(params, sub))
+
+
+def test_fit_switches_to_fallback_backend(data):
+    params = presets.durbin_cpg8()
+    bad = NaNBackend(n_bad=100)  # never recovers on its own
+    res = baum_welch.fit(
+        params, data, num_iters=3, convergence=0.0,
+        backend=bad, fallback_backend=LocalBackend(mode="log", engine="xla"),
+    )
+    assert res.iterations == 3
+    assert len(res.recoveries) == 1 and res.recoveries[0][0] == 1
+    assert all(np.isfinite(res.logliks))
+    clean = baum_welch.fit(
+        params, data, num_iters=3, convergence=0.0,
+        backend=LocalBackend(mode="log", engine="xla"),
+    )
+    np.testing.assert_allclose(np.asarray(res.params.A), np.asarray(clean.params.A), atol=1e-5)
+
+
+def test_fit_raises_without_fallback(data):
+    params = presets.durbin_cpg8()
+    with pytest.raises(FloatingPointError):
+        baum_welch.fit(params, data, num_iters=2, convergence=0.0, backend=NaNBackend(100))
+
+
+def test_fit_transient_fault_single_retry(data):
+    params = presets.durbin_cpg8()
+    flaky = FlakyBackend(n_failures=1)
+    res = baum_welch.fit(params, data, num_iters=2, convergence=0.0, backend=flaky)
+    assert res.iterations == 2
+    assert res.recoveries == []  # same-backend retry is not a backend switch
