@@ -37,6 +37,12 @@ Boundary pairs (the expected transition counts the reference DROPS at chunk
 boundaries) are owned by the later block/device: its lane-0 xi uses the
 entering alpha message, so every adjacent pair in the genome is counted
 exactly once.
+
+**2-D mesh (data x seq)**: :func:`sharded_stats2d_fn` runs a BATCH of
+sequences (e.g. chromosomes) with sequences sharded over the ``data`` axis
+and each sequence's time dimension over the ``seq`` axis — dp x sp on one
+mesh, the composition SURVEY.md §2 lists as the scale-out shape.  Collectives
+stay per-row (seq axis) plus one final psum over both axes.
 """
 
 from __future__ import annotations
@@ -92,162 +98,197 @@ def _matmul_combine(a, b):
     return _nrm_m(jnp.einsum("...ij,...jk->...ik", a, b, precision=_HI))
 
 
-def _shard_stats_body(block_size: int, axis: str):
-    """Per-device E-step body (runs under shard_map).
+def _one_seq_local_stats(
+    params: HmmParams,
+    obs_shard: jnp.ndarray,
+    length: jnp.ndarray,
+    *,
+    axis: str,
+    block_size: int,
+) -> SuffStats:
+    """This device's (un-psummed) statistics for one time-sharded sequence.
 
     obs_shard: [L] symbols (PAD >= n_symbols allowed in the trailing pad);
-    len_shard: [1] count of real symbols in this shard.  Real symbols must be
-    a contiguous global prefix (pads only trail the sequence).
+    length: [] count of real symbols in this shard.  Real symbols must be a
+    contiguous global prefix (pads only trail the sequence).  Collectives run
+    over ``axis``; the caller psums the result over the mesh.
     """
+    K, M = params.n_states, params.n_symbols
+    L = obs_shard.shape[0]
+    nb = L // block_size
+    d = jax.lax.axis_index(axis)
+
+    A = jnp.exp(params.log_A)
+    Sp_ext, B_ext = _prob_tables(params)
+    Sp_flat = Sp_ext.reshape(M + 1, K * K)
+
+    obs_c = jnp.minimum(obs_shard.astype(jnp.int32), M)  # clamp stray values to PAD
+    pos_valid = jnp.arange(L) < length
+    # The global init's emission folds into v0, so its step is identity
+    # (exactly the viterbi_parallel / parallel.decode trick).
+    is_init = (jnp.arange(L) == 0) & (d == 0)
+    step_valid = pos_valid & ~is_init
+    sel_sym = jnp.where(step_valid, jnp.where(pos_valid, obs_c, M), M)
+    emit_sym = jnp.where(pos_valid, jnp.minimum(obs_c, M - 1), 0)
+
+    # [bs, nb] block layout: lane b covers positions [b*bs, (b+1)*bs).
+    def to2(x):
+        return x.reshape(nb, block_size).T
+
+    sel2, emit2 = to2(sel_sym), to2(emit_sym)
+    sv2, pv2 = to2(step_valid), to2(pos_valid)
+
+    # --- forward boundary messages -----------------------------------
+    v0_local = jnp.exp(params.log_pi) * B_ext[jnp.minimum(obs_c[0], M - 1)]
+    v0_raw = jax.lax.all_gather(v0_local, axis)[0]  # device 0's init vector
+    v0n = _nrm_v(v0_raw)
+
+    # Pass A: per-lane operator products (normalized each step).
+    eye_b = jnp.broadcast_to(
+        jnp.eye(K, dtype=A.dtype)[None] + (sel2[0, :, None, None] * 0).astype(A.dtype),
+        (nb, K, K),
+    )
+
+    def passA(C, syms_k):
+        sel = _select(Sp_flat, syms_k).reshape(nb, K, K)
+        return _nrm_m(jnp.einsum("nij,njk->nik", C, sel, precision=_HI)), None
+
+    P_lane, _ = jax.lax.scan(passA, eye_b, sel2)  # [nb, K, K]
+    incl = jax.lax.associative_scan(_matmul_combine, P_lane, axis=0)
+
+    total_dev = incl[-1]
+    totals = jax.lax.all_gather(total_dev, axis)  # [D, K, K]
+
+    def pstep(v, Tk):
+        return _nrm_v(jnp.matmul(v, Tk, precision=_HI)), v
+
+    _, enters_dev = jax.lax.scan(pstep, v0n, totals)
+    v_enter_dev = enters_dev[d]  # exact normalized alpha entering this shard
+
+    excl = jnp.concatenate([eye_b[:1], incl[:-1]], axis=0)
+    enters = _nrm_v(jnp.einsum("k,nkj->nj", v_enter_dev, excl, precision=_HI))
+
+    # --- Pass B: scaled forward from true entering vectors -----------
+    def passB(alpha, inp):
+        syms_k, sv_k = inp
+        bcol = _select(B_ext, syms_k)  # [nb, K]
+        raw = jnp.einsum("nk,kj->nj", alpha, A, precision=_HI) * bcol
+        c = jnp.sum(raw, axis=-1)
+        new = raw / jnp.maximum(c, _TINY)[:, None]
+        alpha = jnp.where(sv_k[:, None], new, alpha)
+        c = jnp.where(sv_k, c, 1.0)
+        return alpha, (alpha, c)
+
+    _, (alphas, cs) = jax.lax.scan(passB, enters, (sel2, sv2))  # [bs, nb, K], [bs, nb]
+    # The init's folded-emission scale belongs to device 0 — and only when
+    # it actually observed a symbol (an all-padding stream has loglik 0).
+    loglik = jnp.sum(jnp.where(sv2, jnp.log(cs), 0.0)) + jnp.where(
+        (d == 0) & (length > 0), jnp.log(jnp.maximum(jnp.sum(v0_raw), _TINY)), 0.0
+    )
+
+    # --- backward boundary messages -----------------------------------
+    ones_dir = jnp.full((K,), 1.0 / K, A.dtype) + v0n * 0.0
+
+    def sstep(b, Tk):
+        return _nrm_v(jnp.matmul(Tk, b, precision=_HI)), b
+
+    _, exits_dev = jax.lax.scan(sstep, ones_dir, totals, reverse=True)
+    beta_exit_dev = exits_dev[d]  # beta direction at this shard's last position
+
+    # Lane-level suffix products P_b @ P_{b+1} @ ... (flip-scan-flip: the
+    # combine sees flipped operands, so apply them flipped back).
+    Rsuf = jax.lax.associative_scan(
+        lambda a, b: _matmul_combine(b, a), P_lane, axis=0, reverse=True
+    )
+    beta_exits = jnp.concatenate(
+        [
+            _nrm_v(jnp.einsum("nij,j->ni", Rsuf[1:], beta_exit_dev, precision=_HI)),
+            beta_exit_dev[None],
+        ],
+        axis=0,
+    )  # [nb, K]
+
+    # --- Pass C: fused backward + gamma/xi accumulation ---------------
+    a_prev = jnp.concatenate([enters[None], alphas[:-1]], axis=0)  # [bs, nb, K]
+    sel_next2 = jnp.concatenate([sel2[1:], jnp.full((1, nb), M, sel2.dtype)], axis=0)
+    svn2 = jnp.concatenate([sv2[1:], jnp.zeros((1, nb), bool)], axis=0)
+    last2 = jnp.zeros((block_size, nb), bool).at[-1].set(True)
+
+    trans0 = jnp.zeros((nb, K, K), A.dtype) + eye_b * 0.0
+    emit0 = jnp.zeros((nb, K, M), A.dtype) + enters[:, :, None] * 0.0
+
+    def passC(carry, inp):
+        beta_next, trans_acc, emit_acc = carry
+        alpha_t, aprev_t, sym_t, sym_next, sv_t, pv_t, svn_t, last_t = inp
+        w = _select(B_ext, sym_next) * beta_next  # [nb, K]
+        beta_rec = _nrm_v(jnp.einsum("nk,jk->nj", w, A, precision=_HI))
+        beta_t = jnp.where(
+            last_t[:, None],
+            beta_exits,
+            jnp.where(svn_t[:, None], beta_rec, beta_next),
+        )
+        # gamma_t: true value sums to 1 -> normalize reconstructs scale.
+        gamma = _nrm_v(alpha_t * beta_t)
+        oh = jax.nn.one_hot(sym_t, M, dtype=A.dtype)  # emit2 is pre-clamped to < M
+        emit_acc = emit_acc + jnp.where(
+            pv_t[:, None, None], gamma[:, :, None] * oh[:, None, :], 0.0
+        )
+        # xi for the (t-1 -> t) pair, owned by position t; lane-0 pairs use
+        # the entering-alpha boundary message (aprev_t == enters there).
+        bcol_t = _select(B_ext, sym_t)
+        xr = aprev_t[:, :, None] * A[None] * (bcol_t * beta_t)[:, None, :]
+        xi = xr / jnp.maximum(jnp.sum(xr, axis=(-2, -1), keepdims=True), _TINY)
+        trans_acc = trans_acc + jnp.where(sv_t[:, None, None], xi, 0.0)
+        return (beta_t, trans_acc, emit_acc), None
+
+    # emission one-hot uses the REAL symbol layout (emit2), not sel2.
+    (beta_first, trans_l, emit_l), _ = jax.lax.scan(
+        passC,
+        (beta_exits, trans0, emit0),
+        (alphas, a_prev, emit2, sel_next2, sv2, pv2, svn2, last2),
+        reverse=True,
+    )
+
+    gamma0 = _nrm_v(alphas[0, 0] * beta_first[0])
+    at_init = (d == 0) & (length > 0)
+    return SuffStats(
+        init=jnp.where(at_init, gamma0, jnp.zeros_like(gamma0)),
+        trans=jnp.sum(trans_l, axis=0),
+        emit=jnp.sum(emit_l, axis=0),
+        loglik=loglik,
+        n_seqs=jnp.where(at_init, 1, 0).astype(jnp.int32),
+    )
+
+
+def _shard_stats_body(block_size: int, axis: str):
+    """1-D per-device E-step body (one sequence over the whole mesh)."""
 
     def body(params: HmmParams, obs_shard: jnp.ndarray, len_shard: jnp.ndarray) -> SuffStats:
-        K, M = params.n_states, params.n_symbols
-        L = obs_shard.shape[0]
-        nb = L // block_size
-        d = jax.lax.axis_index(axis)
-
-        A = jnp.exp(params.log_A)
-        Sp_ext, B_ext = _prob_tables(params)
-        Sp_flat = Sp_ext.reshape(M + 1, K * K)
-
-        length = len_shard[0]
-        obs_c = jnp.minimum(obs_shard.astype(jnp.int32), M)  # clamp stray values to PAD
-        pos_valid = jnp.arange(L) < length
-        # The global init's emission folds into v0, so its step is identity
-        # (exactly the viterbi_parallel / parallel.decode trick).
-        is_init = (jnp.arange(L) == 0) & (d == 0)
-        step_valid = pos_valid & ~is_init
-        sel_sym = jnp.where(step_valid, jnp.where(pos_valid, obs_c, M), M)
-        emit_sym = jnp.where(pos_valid, jnp.minimum(obs_c, M - 1), 0)
-
-        # [bs, nb] block layout: lane b covers positions [b*bs, (b+1)*bs).
-        def to2(x):
-            return x.reshape(nb, block_size).T
-
-        sel2, emit2 = to2(sel_sym), to2(emit_sym)
-        sv2, pv2 = to2(step_valid), to2(pos_valid)
-
-        # --- forward boundary messages -----------------------------------
-        v0_local = jnp.exp(params.log_pi) * B_ext[jnp.minimum(obs_c[0], M - 1)]
-        v0_raw = jax.lax.all_gather(v0_local, axis)[0]  # device 0's init vector
-        v0n = _nrm_v(v0_raw)
-
-        # Pass A: per-lane operator products (normalized each step).
-        eye_b = jnp.broadcast_to(
-            jnp.eye(K, dtype=A.dtype)[None] + (sel2[0, :, None, None] * 0).astype(A.dtype),
-            (nb, K, K),
+        local = _one_seq_local_stats(
+            params, obs_shard, len_shard[0], axis=axis, block_size=block_size
         )
+        return jax.lax.psum(local, axis)
 
-        def passA(C, syms_k):
-            sel = _select(Sp_flat, syms_k).reshape(nb, K, K)
-            return _nrm_m(jnp.einsum("nij,njk->nik", C, sel, precision=_HI)), None
+    return body
 
-        P_lane, _ = jax.lax.scan(passA, eye_b, sel2)  # [nb, K, K]
-        incl = jax.lax.associative_scan(_matmul_combine, P_lane, axis=0)
 
-        total_dev = incl[-1]
-        totals = jax.lax.all_gather(total_dev, axis)  # [D, K, K]
+def _shard_stats2d_body(block_size: int, data_axis: str, seq_axis: str):
+    """2-D per-device E-step body: sequences over ``data``, time over ``seq``.
 
-        def pstep(v, Tk):
-            return _nrm_v(jnp.matmul(v, Tk, precision=_HI)), v
+    obs_tile: [R, L] — R local sequences' shards; len_tile: [R, 1].  The R
+    loop is a static unroll (R = sequences per data row, small — e.g.
+    chromosomes); every iteration's collectives involve only this device's
+    seq row.
+    """
 
-        _, enters_dev = jax.lax.scan(pstep, v0n, totals)
-        v_enter_dev = enters_dev[d]  # exact normalized alpha entering this shard
-
-        excl = jnp.concatenate([eye_b[:1], incl[:-1]], axis=0)
-        enters = _nrm_v(jnp.einsum("k,nkj->nj", v_enter_dev, excl, precision=_HI))
-
-        # --- Pass B: scaled forward from true entering vectors -----------
-        def passB(alpha, inp):
-            syms_k, sv_k = inp
-            bcol = _select(B_ext, syms_k)  # [nb, K]
-            raw = jnp.einsum("nk,kj->nj", alpha, A, precision=_HI) * bcol
-            c = jnp.sum(raw, axis=-1)
-            new = raw / jnp.maximum(c, _TINY)[:, None]
-            alpha = jnp.where(sv_k[:, None], new, alpha)
-            c = jnp.where(sv_k, c, 1.0)
-            return alpha, (alpha, c)
-
-        _, (alphas, cs) = jax.lax.scan(passB, enters, (sel2, sv2))  # [bs, nb, K], [bs, nb]
-        # The init's folded-emission scale belongs to device 0 — and only when
-        # it actually observed a symbol (an all-padding stream has loglik 0).
-        loglik = jnp.sum(jnp.where(sv2, jnp.log(cs), 0.0)) + jnp.where(
-            (d == 0) & (length > 0), jnp.log(jnp.maximum(jnp.sum(v0_raw), _TINY)), 0.0
-        )
-
-        # --- backward boundary messages -----------------------------------
-        ones_dir = jnp.full((K,), 1.0 / K, A.dtype) + v0n * 0.0
-
-        def sstep(b, Tk):
-            return _nrm_v(jnp.matmul(Tk, b, precision=_HI)), b
-
-        _, exits_dev = jax.lax.scan(sstep, ones_dir, totals, reverse=True)
-        beta_exit_dev = exits_dev[d]  # beta direction at this shard's last position
-
-        # Lane-level suffix products P_b @ P_{b+1} @ ... (flip-scan-flip: the
-        # combine sees flipped operands, so apply them flipped back).
-        Rsuf = jax.lax.associative_scan(
-            lambda a, b: _matmul_combine(b, a), P_lane, axis=0, reverse=True
-        )
-        beta_exits = jnp.concatenate(
-            [
-                _nrm_v(jnp.einsum("nij,j->ni", Rsuf[1:], beta_exit_dev, precision=_HI)),
-                beta_exit_dev[None],
-            ],
-            axis=0,
-        )  # [nb, K]
-
-        # --- Pass C: fused backward + gamma/xi accumulation ---------------
-        a_prev = jnp.concatenate([enters[None], alphas[:-1]], axis=0)  # [bs, nb, K]
-        sel_next2 = jnp.concatenate([sel2[1:], jnp.full((1, nb), M, sel2.dtype)], axis=0)
-        svn2 = jnp.concatenate([sv2[1:], jnp.zeros((1, nb), bool)], axis=0)
-        last2 = jnp.zeros((block_size, nb), bool).at[-1].set(True)
-
-        trans0 = jnp.zeros((nb, K, K), A.dtype) + eye_b * 0.0
-        emit0 = jnp.zeros((nb, K, M), A.dtype) + enters[:, :, None] * 0.0
-
-        def passC(carry, inp):
-            beta_next, trans_acc, emit_acc = carry
-            alpha_t, aprev_t, sym_t, sym_next, sv_t, pv_t, svn_t, last_t = inp
-            w = _select(B_ext, sym_next) * beta_next  # [nb, K]
-            beta_rec = _nrm_v(jnp.einsum("nk,jk->nj", w, A, precision=_HI))
-            beta_t = jnp.where(
-                last_t[:, None],
-                beta_exits,
-                jnp.where(svn_t[:, None], beta_rec, beta_next),
+    def body(params: HmmParams, obs_tile: jnp.ndarray, len_tile: jnp.ndarray) -> SuffStats:
+        total = None
+        for r in range(obs_tile.shape[0]):
+            s = _one_seq_local_stats(
+                params, obs_tile[r], len_tile[r, 0], axis=seq_axis, block_size=block_size
             )
-            # gamma_t: true value sums to 1 -> normalize reconstructs scale.
-            gamma = _nrm_v(alpha_t * beta_t)
-            oh = jax.nn.one_hot(sym_t, M, dtype=A.dtype)  # emit2 is pre-clamped to < M
-            emit_acc = emit_acc + jnp.where(
-                pv_t[:, None, None], gamma[:, :, None] * oh[:, None, :], 0.0
-            )
-            # xi for the (t-1 -> t) pair, owned by position t; lane-0 pairs use
-            # the entering-alpha boundary message (aprev_t == enters there).
-            bcol_t = _select(B_ext, sym_t)
-            xr = aprev_t[:, :, None] * A[None] * (bcol_t * beta_t)[:, None, :]
-            xi = xr / jnp.maximum(jnp.sum(xr, axis=(-2, -1), keepdims=True), _TINY)
-            trans_acc = trans_acc + jnp.where(sv_t[:, None, None], xi, 0.0)
-            return (beta_t, trans_acc, emit_acc), None
-
-        # emission one-hot uses the REAL symbol layout (emit2), not sel2.
-        (beta_first, trans_l, emit_l), _ = jax.lax.scan(
-            passC,
-            (beta_exits, trans0, emit0),
-            (alphas, a_prev, emit2, sel_next2, sv2, pv2, svn2, last2),
-            reverse=True,
-        )
-
-        gamma0 = _nrm_v(alphas[0, 0] * beta_first[0])
-        at_init = (d == 0) & (length > 0)
-        stats = SuffStats(
-            init=jnp.where(at_init, gamma0, jnp.zeros_like(gamma0)),
-            trans=jnp.sum(trans_l, axis=0),
-            emit=jnp.sum(emit_l, axis=0),
-            loglik=loglik,
-            n_seqs=jnp.where(at_init, 1, 0).astype(jnp.int32),
-        )
-        return jax.lax.psum(stats, axis)
+            total = s if total is None else total + s
+        return jax.lax.psum(total, (data_axis, seq_axis))
 
     return body
 
@@ -273,6 +314,26 @@ def sharded_stats_fn(mesh: Mesh, block_size: int):
     )
 
 
+@functools.lru_cache(maxsize=32)
+def sharded_stats2d_fn(mesh: Mesh, block_size: int):
+    """Compiled 2-D entry point: fn(params, obs [N, T], lengths [N, sp]).
+
+    ``mesh`` must be 2-D (data, seq).  obs rows are whole padded sequences
+    placed with P(data, seq); lengths[n, s] is sequence n's real-symbol count
+    in seq-shard s, placed with P(data, seq).
+    """
+    data_axis, seq_axis = mesh.axis_names
+    body = _shard_stats2d_body(block_size, data_axis, seq_axis)
+    return jax.jit(
+        jax.shard_map(
+            body,
+            mesh=mesh,
+            in_specs=(P(), P(data_axis, seq_axis), P(data_axis, seq_axis)),
+            out_specs=P(),
+        )
+    )
+
+
 def shard_sequence(obs: np.ndarray, n_shards: int, block_size: int = DEFAULT_BLOCK, pad_value: int = 4):
     """Split one symbol stream into per-device shards (padded, with lengths).
 
@@ -287,6 +348,13 @@ def shard_sequence(obs: np.ndarray, n_shards: int, block_size: int = DEFAULT_BLO
     L = padded_T // n_shards
     lengths = np.clip(T - np.arange(n_shards) * L, 0, L).astype(np.int32)
     return obs, lengths
+
+
+def shard_lengths(seq_lengths: np.ndarray, T_padded: int, sp: int) -> np.ndarray:
+    """Per-(sequence, seq-shard) real-symbol counts: [N] -> [N, sp]."""
+    L = T_padded // sp
+    starts = np.arange(sp) * L
+    return np.clip(np.asarray(seq_lengths)[:, None] - starts[None, :], 0, L).astype(np.int32)
 
 
 def seq_stats_sharded(
@@ -310,3 +378,80 @@ def seq_stats_sharded(
     arr = jax.device_put(jnp.asarray(obs_p), NamedSharding(mesh, P(axis)))
     lens = jax.device_put(jnp.asarray(lengths), NamedSharding(mesh, P(axis)))
     return sharded_stats_fn(mesh, block_size)(params, arr, lens)
+
+
+def pad_batch2d(
+    chunks: np.ndarray,
+    lengths: np.ndarray,
+    dp: int,
+    sp: int,
+    block_size: int,
+    pad_value: int,
+):
+    """Pad an [N, T] sequence batch for a dp x sp mesh.
+
+    Rows (sequences) pad to a multiple of dp with zero-length rows; columns
+    pad to a multiple of sp * block_size with ``pad_value``.  The single
+    source of truth for the 2-D layout — both Seq2DBackend and the standalone
+    helper go through here.
+    """
+    chunks = np.asarray(chunks)
+    lengths = np.asarray(lengths)
+    n, T = chunks.shape
+    quantum = sp * block_size
+    T_pad = max(quantum, -(-T // quantum) * quantum)
+    n_pad = -(-n // dp) * dp
+    if (n_pad, T_pad) == (n, T):
+        return chunks, lengths.astype(np.int32)
+    obs = np.full((n_pad, T_pad), pad_value, dtype=np.uint8)
+    obs[:n, :T] = chunks
+    out_lengths = np.zeros(n_pad, np.int32)
+    out_lengths[:n] = lengths
+    return obs, out_lengths
+
+
+def place_batch2d(mesh: Mesh, chunks, lengths):
+    """Device-place a padded [N, T] batch + [N] lengths on a 2-D mesh.
+
+    Returns (obs P(data, seq), per-shard lengths [N, sp] P(data, seq)) — the
+    exact input layout of :func:`sharded_stats2d_fn`.
+    """
+    da, sa = mesh.axis_names
+    chunks = np.asarray(chunks)
+    lengths2d = shard_lengths(np.asarray(lengths), chunks.shape[1], mesh.shape[sa])
+    sharding = NamedSharding(mesh, P(da, sa))
+    return (
+        jax.device_put(jnp.asarray(chunks), sharding),
+        jax.device_put(jnp.asarray(lengths2d), sharding),
+    )
+
+
+def batch_seq_stats_sharded(
+    params: HmmParams,
+    sequences,
+    *,
+    mesh: Mesh,
+    block_size: int = DEFAULT_BLOCK,
+) -> SuffStats:
+    """Exact statistics for a batch of independent sequences on a 2-D mesh.
+
+    ``sequences`` is a list of 1-D symbol arrays (e.g. one per chromosome).
+    Sequences are distributed over the mesh's first (data) axis; each
+    sequence's time dimension is sharded over the second (seq) axis.  The
+    result equals the SUM of per-sequence exact whole-sequence statistics.
+    """
+    if len(mesh.axis_names) != 2:
+        raise ValueError(f"need a 2-D (data, seq) mesh, got axes {mesh.axis_names}")
+    if not sequences:
+        raise ValueError("no sequences")
+    da, sa = mesh.axis_names
+    dp, sp = mesh.shape[da], mesh.shape[sa]
+    pad = params.n_symbols
+    T = max(len(s) for s in sequences)
+    rows = np.full((len(sequences), T), pad, dtype=np.uint8)
+    for i, s in enumerate(sequences):
+        rows[i, : len(s)] = np.asarray(s, dtype=np.uint8)
+    seq_lengths = np.array([len(s) for s in sequences], dtype=np.int32)
+    obs, lengths = pad_batch2d(rows, seq_lengths, dp, sp, block_size, pad)
+    arr, lens = place_batch2d(mesh, obs, lengths)
+    return sharded_stats2d_fn(mesh, block_size)(params, arr, lens)

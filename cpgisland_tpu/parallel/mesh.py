@@ -29,5 +29,24 @@ def make_mesh(n_devices: Optional[int] = None, axis: str = DATA_AXIS) -> Mesh:
     return Mesh(np.asarray(devs), (axis,))
 
 
+def make_mesh2d(
+    dp: int,
+    sp: Optional[int] = None,
+    axes: Sequence[str] = (DATA_AXIS, SEQ_AXIS),
+) -> Mesh:
+    """A 2-D (data x seq) mesh: sequences over ``dp`` rows, time over ``sp``
+    columns.  On real hardware XLA maps the trailing (seq) axis to the
+    fastest ICI neighbours, so the per-step boundary all_gathers stay local
+    to a row."""
+    devs = jax.devices()
+    if sp is None:
+        if len(devs) % dp != 0:
+            raise ValueError(f"{len(devs)} devices not divisible by dp={dp}")
+        sp = len(devs) // dp
+    if dp * sp > len(devs):
+        raise ValueError(f"requested {dp}x{sp} devices, have {len(devs)}")
+    return Mesh(np.asarray(devs[: dp * sp]).reshape(dp, sp), tuple(axes))
+
+
 def local_device_count() -> int:
     return len(jax.devices())

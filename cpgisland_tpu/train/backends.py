@@ -225,6 +225,57 @@ class SeqBackend(EStepBackend):
         return fn(params, obs_flat, lengths)
 
 
+class Seq2DBackend(EStepBackend):
+    """Batch-of-sequences E-step on a 2-D (data x seq) mesh.
+
+    Each input chunk row is treated as ONE whole sequence (e.g. one
+    chromosome): rows are distributed over the ``data`` axis and each row's
+    time dimension is sharded over the ``seq`` axis — dp x sp composed on one
+    mesh.  Statistics are the exact per-sequence whole-sequence counts,
+    summed; like SeqBackend there is no within-sequence chunk-independence
+    approximation.
+    """
+
+    def __init__(
+        self,
+        mesh: Mesh,
+        block_size: Optional[int] = None,
+        pad_value: int = chunking.PAD_SYMBOL,
+    ):
+        if len(mesh.axis_names) != 2:
+            raise ValueError(f"Seq2DBackend needs a 2-D mesh, got axes {mesh.axis_names}")
+        self.mesh = mesh
+        self.block_size = block_size if block_size is not None else fb_sharded.DEFAULT_BLOCK
+        self.data_axis, self.seq_axis = mesh.axis_names
+        self.pad_value = pad_value
+
+    def prepare(self, chunked: chunking.Chunked) -> chunking.Chunked:
+        """Pad rows (sequences) to dp multiples and columns to sp*block."""
+        obs, lengths = fb_sharded.pad_batch2d(
+            chunked.chunks,
+            chunked.lengths,
+            self.mesh.shape[self.data_axis],
+            self.mesh.shape[self.seq_axis],
+            self.block_size,
+            self.pad_value,
+        )
+        if obs is chunked.chunks:
+            return chunked
+        return chunking.Chunked(chunks=obs, lengths=lengths, total=chunked.total)
+
+    def place(self, chunks, lengths):
+        return fb_sharded.place_batch2d(self.mesh, chunks, lengths)
+
+    def __call__(self, params, chunks, lengths):
+        if getattr(chunks, "ndim", 0) != 2 or getattr(lengths, "ndim", 0) != 2:
+            raise ValueError(
+                "Seq2DBackend expects placed [N, T] sequences and [N, sp] shard "
+                "lengths; run prepare() + place() first"
+            )
+        fn = fb_sharded.sharded_stats2d_fn(self.mesh, self.block_size)
+        return fn(params, chunks, lengths)
+
+
 def get_backend(
     name: str = "local",
     *,

@@ -120,7 +120,11 @@ def fit(
                 profiling.check_finite(cand, where=f"E-step iter {it}")
                 stats = cand
                 break
-            except Exception as e:
+            # Only fault-shaped errors are retried/recovered: RuntimeError
+            # covers jaxlib's XlaRuntimeError (OOM, preemption, interconnect),
+            # FloatingPointError is check_finite.  Programming errors
+            # (ValueError/TypeError) must surface, not reroute to a fallback.
+            except (RuntimeError, FloatingPointError) as e:
                 reason = f"iter {it} attempt {attempt + 1}: {e}"
                 log.warning("E-step failed (%s)", reason)
                 if metrics is not None:

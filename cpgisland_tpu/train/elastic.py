@@ -68,6 +68,10 @@ class ElasticEStep(EStepBackend):
     def __post_init__(self):
         if self.on_failure not in ("raise", "skip"):
             raise ValueError(f"on_failure must be 'raise' or 'skip', got {self.on_failure!r}")
+        # (start, stop) ranges that exhausted retries in skip mode — like
+        # Hadoop's skip-bad-records blacklist, they are never re-attempted in
+        # later EM iterations.
+        self._blacklist: set = set()
 
     def prepare(self, chunked: chunking.Chunked) -> chunking.Chunked:
         return chunked
@@ -81,15 +85,18 @@ class ElasticEStep(EStepBackend):
         lengths = np.asarray(lengths)
         n = chunks.shape[0]
         micro = max(1, -(-n // self.micro_batches))
+        n_slices = -(-n // micro)
         total: Optional[SuffStats] = None
         for i, start in enumerate(range(0, n, micro)):
             stop = min(start + micro, n)
+            if (start, stop) in self._blacklist:
+                continue  # skip-bad-records: known-bad range, don't re-attempt
             stats = self._run_slice(params, chunks[start:stop], lengths[start:stop], i, start, stop)
             if stats is not None:
                 total = stats if total is None else total + stats
         if total is None:
             raise RuntimeError(
-                f"all {self.micro_batches} E-step micro-batches failed; see .failures"
+                f"all {n_slices} E-step micro-batches failed; see .failures"
             )
         return total
 
@@ -124,6 +131,7 @@ class ElasticEStep(EStepBackend):
             attempts=self.max_retries + 1, error=str(last_err),
         )
         self.failures.append(failure)
+        self._blacklist.add((start, stop))
         if self.on_failure == "raise":
             raise RuntimeError(
                 f"E-step slice {idx} (chunks {start}:{stop}) failed "

@@ -135,6 +135,51 @@ def test_skip_mode_drops_bad_slice_and_continues(data):
     assert_stats_close(got, _clean_stats(params, sub))
 
 
+def test_skip_mode_blacklists_across_iterations(data):
+    """A permanently-bad slice is attempted once (with retries) and then
+    blacklisted — later EM iterations don't waste re-attempts on it."""
+    params = presets.durbin_cpg8()
+
+    class CountingPoison(EStepBackend):
+        def __init__(self):
+            self.inner = LocalBackend(mode="rescaled", engine="xla")
+            self.poisoned = None
+            self.poison_calls = 0
+
+        def __call__(self, params, chunks, lengths):
+            key = int(np.asarray(chunks[0, :8]).sum())
+            if self.poisoned is None:
+                self.poisoned = key
+            if key == self.poisoned:
+                self.poison_calls += 1
+                raise RuntimeError("bad shard")
+            return self.inner(params, chunks, lengths)
+
+    poison = CountingPoison()
+    el = ElasticEStep(poison, micro_batches=4, max_retries=1, on_failure="skip")
+    el(params, data.chunks, data.lengths)
+    el(params, data.chunks, data.lengths)
+    el(params, data.chunks, data.lengths)
+    assert poison.poison_calls == 2  # retries of call 1 only; then blacklisted
+    assert len(el.failures) == 1
+
+
+def test_fit_does_not_recover_programming_errors(data):
+    """ValueError from a misconfigured backend surfaces immediately (no
+    retry, no fallback reroute)."""
+    params = presets.durbin_cpg8()
+
+    class Misconfigured(EStepBackend):
+        def __call__(self, params, chunks, lengths):
+            raise ValueError("wrong input layout")
+
+    with pytest.raises(ValueError, match="wrong input layout"):
+        baum_welch.fit(
+            params, data, num_iters=2, convergence=0.0,
+            backend=Misconfigured(), fallback_backend=LocalBackend(),
+        )
+
+
 def test_fit_switches_to_fallback_backend(data):
     params = presets.durbin_cpg8()
     bad = NaNBackend(n_bad=100)  # never recovers on its own

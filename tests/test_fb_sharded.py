@@ -110,6 +110,58 @@ def test_em_step_matches_oracle_single_sequence(rng, mesh):
     np.testing.assert_allclose(np.asarray(got.B), B_o, rtol=1e-4, atol=1e-5)
 
 
+@pytest.mark.parametrize("dp,sp", [(4, 2), (2, 4)])
+def test_batch_2d_mesh_matches_per_sequence_oracle(rng, dp, sp):
+    """dp x sp on one mesh: stats == sum of exact per-sequence oracle stats."""
+    from cpgisland_tpu.parallel.fb_sharded import batch_seq_stats_sharded
+    from cpgisland_tpu.parallel.mesh import make_mesh2d
+
+    pi, A, B, params = _random_params(rng)
+    seqs = [rng.integers(0, 4, size=n).astype(np.uint8) for n in (701, 1203, 402)]
+    init_o = np.zeros(3)
+    trans_o = np.zeros((3, 3))
+    emit_o = np.zeros((3, 4))
+    ll_o = 0.0
+    for s in seqs:
+        i0, t0, e0, l0 = _oracle_stats(pi, A, B, s)
+        init_o += i0
+        trans_o += t0
+        emit_o += e0
+        ll_o += l0
+
+    mesh2d = make_mesh2d(dp, sp)
+    stats = batch_seq_stats_sharded(params, seqs, mesh=mesh2d, block_size=64)
+    assert float(stats.loglik) == pytest.approx(ll_o, abs=0.02)
+    np.testing.assert_allclose(np.asarray(stats.init), init_o, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(stats.trans), trans_o, rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(stats.emit), emit_o, rtol=1e-4, atol=1e-4)
+    assert int(stats.n_seqs) == 3
+
+
+def test_seq2d_backend_em_step_matches_oracle(rng):
+    """fit() through Seq2DBackend: rows are whole sequences, dp x seq sharded."""
+    from cpgisland_tpu.parallel.mesh import make_mesh2d
+    from cpgisland_tpu.train.backends import Seq2DBackend
+
+    pi, A, B, params = _random_params(rng)
+    seqs = [rng.integers(0, 4, size=n).astype(np.uint8) for n in (800, 650)]
+    pi_o, A_o, B_o, _ = oracle.em_step_oracle(pi, A, B, seqs)
+
+    T = max(len(s) for s in seqs)
+    rows = np.full((2, T), 4, np.uint8)
+    for i, s in enumerate(seqs):
+        rows[i, : len(s)] = s
+    chunked = chunking.Chunked(
+        chunks=rows, lengths=np.array([len(s) for s in seqs], np.int32),
+        total=sum(len(s) for s in seqs),
+    )
+    backend = Seq2DBackend(make_mesh2d(2, 4), block_size=64)
+    res = baum_welch.fit(params, chunked, num_iters=1, convergence=0.0, backend=backend)
+    np.testing.assert_allclose(np.asarray(res.params.pi), pi_o, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(res.params.A), A_o, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(res.params.B), B_o, rtol=1e-4, atol=1e-5)
+
+
 def test_em_loglik_monotone_seq_backend(rng, mesh):
     _, _, _, params = _random_params(rng, K=2)
     obs = rng.integers(0, 4, size=8192).astype(np.uint8)
