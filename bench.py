@@ -34,7 +34,7 @@ def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
 
 
-def bench_decode(n_symbols: int, engine: str = "auto") -> float:
+def bench_decode(n_symbols: int, engine: str = "auto", params=None, tag: str = "") -> float:
     """Measure single-chip blockwise-parallel Viterbi throughput (sym/s)."""
     import jax
     import jax.numpy as jnp
@@ -43,7 +43,8 @@ def bench_decode(n_symbols: int, engine: str = "auto") -> float:
     from cpgisland_tpu.ops.viterbi_parallel import viterbi_parallel
     from cpgisland_tpu.parallel.decode import resolve_engine
 
-    params = presets.durbin_cpg8()
+    if params is None:
+        params = presets.durbin_cpg8()
     eng = resolve_engine(engine, params)
     rng = np.random.default_rng(0)
     obs = jnp.asarray(rng.integers(0, 4, size=n_symbols, dtype=np.int32))
@@ -56,7 +57,7 @@ def bench_decode(n_symbols: int, engine: str = "auto") -> float:
         fn(obs).block_until_ready()
         best = min(best, time.perf_counter() - t0)
     tput = n_symbols / best
-    log(f"decode[{eng}]: {tput/1e6:.1f} Msym/s ({best*1e3:.0f} ms / {n_symbols/2**20:.0f} MiB)")
+    log(f"decode{tag}[{eng}]: {tput/1e6:.1f} Msym/s ({best*1e3:.0f} ms / {n_symbols/2**20:.0f} MiB)")
     return tput
 
 
@@ -98,12 +99,81 @@ def bench_em(n_chunks: int, chunk_size: int = 0x10000, engine: str = "auto") -> 
     return tput
 
 
+def bench_batched_decode(n_seqs: int, seq_len: int, engine: str = "auto") -> float:
+    """Batched (vmap) multi-genome decode throughput in sym/s (BASELINE.md
+    config 5): N independent sequences decoded as one [N, T] batch."""
+    import jax
+    import jax.numpy as jnp
+
+    from cpgisland_tpu.models import presets
+    from cpgisland_tpu.ops.viterbi_parallel import viterbi_parallel_batch
+    from cpgisland_tpu.parallel.decode import resolve_engine
+
+    params = presets.durbin_cpg8()
+    eng = resolve_engine(engine, params)
+    rng = np.random.default_rng(2)
+    chunks = jnp.asarray(rng.integers(0, 4, size=(n_seqs, seq_len), dtype=np.int32))
+    lengths = jnp.full(n_seqs, seq_len, dtype=jnp.int32)
+    fn = jax.jit(
+        lambda c, l: viterbi_parallel_batch(params, c, l, return_score=False, engine=eng)
+    )
+    fn(chunks, lengths).block_until_ready()
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        fn(chunks, lengths).block_until_ready()
+        best = min(best, time.perf_counter() - t0)
+    n_sym = n_seqs * seq_len
+    tput = n_sym / best
+    log(
+        f"batched-decode[{eng}]: {tput/1e6:.1f} Msym/s "
+        f"({n_seqs} x {seq_len/2**20:.0f} MiB in {best*1e3:.0f} ms)"
+    )
+    return tput
+
+
+def bench_em_2state(n_chunks: int, chunk_size: int = 0x10000) -> float:
+    """2-state model EM throughput in sym/s/iter (BASELINE.md config 2)."""
+    import jax
+    import jax.numpy as jnp
+
+    from cpgisland_tpu.models import presets
+    from cpgisland_tpu.train.backends import LocalBackend
+    from cpgisland_tpu.train.baum_welch import mstep
+
+    params = presets.two_state_cpg()
+    backend = LocalBackend(mode="rescaled", engine="xla")  # pallas kernels are 8-state
+    rng = np.random.default_rng(3)
+    chunks = jnp.asarray(rng.integers(0, 4, size=(n_chunks, chunk_size), dtype=np.int32).astype(np.uint8))
+    lengths = jnp.full(n_chunks, chunk_size, dtype=jnp.int32)
+
+    @jax.jit
+    def em_iter(p):
+        return mstep(p, backend(p, chunks, lengths))
+
+    jax.block_until_ready(em_iter(params))
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        jax.block_until_ready(em_iter(params))
+        best = min(best, time.perf_counter() - t0)
+    tput = n_chunks * chunk_size / best
+    log(f"em-2state[xla]: {tput/1e6:.1f} Msym/s/iter ({best*1e3:.0f} ms)")
+    return tput
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--decode-mib", type=int, default=64)
     ap.add_argument("--em-chunks", type=int, default=512)
     ap.add_argument("--engine", default="auto", choices=("auto", "xla", "pallas"))
     ap.add_argument("--platform", default="auto", help="auto|cpu|tpu (axon ignores JAX_PLATFORMS)")
+    ap.add_argument(
+        "--extended",
+        action="store_true",
+        help="also measure BASELINE.md configs (batched multi-genome decode, "
+        "2-state EM); extra results go to stderr, stdout stays one JSON line",
+    )
     args = ap.parse_args()
 
     import jax
@@ -114,6 +184,37 @@ def main() -> int:
 
     decode_tput = bench_decode(args.decode_mib * (1 << 20), engine=args.engine)
     em_tput = bench_em(args.em_chunks, engine=args.engine)
+
+    if args.extended:
+        from cpgisland_tpu.models import presets as _presets
+
+        CHR21, CHR1 = 46.7e6, 248e6
+        batched_tput = bench_batched_decode(16, 4 << 20, engine=args.engine)
+        em2_tput = bench_em_2state(256)
+        decode2_tput = bench_decode(
+            args.decode_mib * (1 << 20), engine=args.engine,
+            params=_presets.two_state_cpg(), tag="-2state",
+        )
+        extras = {
+            "chr21_2state_decode_projected_s": round(CHR21 / decode2_tput, 3),
+            "chr1_8state_decode_plus_islands_projected_v5e8_s": round(
+                CHR1 / (decode_tput * N_CHIPS), 3
+            ),
+            "em_2state_chr1_iters_per_sec_v5e8": round(
+                em2_tput * N_CHIPS / EM_TRAIN_SYMBOLS, 2
+            ),
+            "em_8state_chr1_iters_per_sec_v5e8": round(
+                em_tput * N_CHIPS / EM_TRAIN_SYMBOLS, 2
+            ),
+            "grch38_decode_projected_v5e8_s": round(
+                GRCH38_SYMBOLS / (decode_tput * N_CHIPS), 3
+            ),
+            "batched_decode_genomes_per_sec_v5e8": round(
+                batched_tput * N_CHIPS / GRCH38_SYMBOLS, 3
+            ),
+            "batched_decode_msym_per_sec_chip": round(batched_tput / 1e6, 1),
+        }
+        log("extended: " + json.dumps(extras))
 
     projected = GRCH38_SYMBOLS / (decode_tput * N_CHIPS) + EM_ITERS * EM_TRAIN_SYMBOLS / (
         em_tput * N_CHIPS

@@ -282,12 +282,24 @@ def _shard_stats2d_body(block_size: int, data_axis: str, seq_axis: str):
     """
 
     def body(params: HmmParams, obs_tile: jnp.ndarray, len_tile: jnp.ndarray) -> SuffStats:
-        total = None
-        for r in range(obs_tile.shape[0]):
+        K, M = params.n_states, params.n_symbols
+
+        def scan_body(acc, inp):
+            obs_row, len_row = inp
             s = _one_seq_local_stats(
-                params, obs_tile[r], len_tile[r, 0], axis=seq_axis, block_size=block_size
+                params, obs_row, len_row[0], axis=seq_axis, block_size=block_size
             )
-            total = s if total is None else total + s
+            return acc + s, None
+
+        # lax.scan (not a Python loop) so the three-pass program is traced
+        # once, not R times — R can be dozens of chromosomes per row.  The
+        # device-varying zero keeps the carry's type consistent with the body
+        # output under shard_map.
+        dv = obs_tile[0, 0] * 0
+        init = jax.tree_util.tree_map(
+            lambda z: z + dv.astype(z.dtype), SuffStats.zeros(K, M)
+        )
+        total, _ = jax.lax.scan(scan_body, init, (obs_tile, len_tile))
         return jax.lax.psum(total, (data_axis, seq_axis))
 
     return body

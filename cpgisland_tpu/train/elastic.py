@@ -131,7 +131,10 @@ class ElasticEStep(EStepBackend):
             attempts=self.max_retries + 1, error=str(last_err),
         )
         self.failures.append(failure)
-        self._blacklist.add((start, stop))
+        if self.on_failure == "skip":
+            # Only skip mode may drop data; raise mode must keep failing on
+            # every retry so training never silently runs on partial stats.
+            self._blacklist.add((start, stop))
         if self.on_failure == "raise":
             raise RuntimeError(
                 f"E-step slice {idx} (chunks {start}:{stop}) failed "
