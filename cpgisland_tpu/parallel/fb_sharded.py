@@ -276,8 +276,8 @@ def _shard_stats2d_body(block_size: int, data_axis: str, seq_axis: str):
     """2-D per-device E-step body: sequences over ``data``, time over ``seq``.
 
     obs_tile: [R, L] — R local sequences' shards; len_tile: [R, 1].  The R
-    loop is a static unroll (R = sequences per data row, small — e.g.
-    chromosomes); every iteration's collectives involve only this device's
+    sequences run through one lax.scan (the three-pass program is traced
+    once, whatever R is); every step's collectives involve only this device's
     seq row.
     """
 

@@ -19,6 +19,7 @@ other (tests/test_native_codec.py).
 from __future__ import annotations
 
 import io
+import os
 from typing import Iterator, Optional, Union
 
 import numpy as np
@@ -129,8 +130,28 @@ def _strip_headers_stateful(
     return bytes(out), in_header, at_line_start
 
 
-def encode_file(path: str, *, skip_headers: bool = False) -> np.ndarray:
-    """Encode an entire file into one symbol array."""
+# Above this size the parallel whole-buffer native path wins over streaming;
+# below it, thread spawn + the extra count pass cost more than they save.
+_MT_THRESHOLD = 8 << 20
+
+
+def encode_file(path: str, *, skip_headers: bool = False, threads: int = 0) -> np.ndarray:
+    """Encode an entire file into one symbol array.
+
+    Large files take the multithreaded native path (native/codec.cpp
+    cpg_encode_mt: parallel count + write-at-exact-offsets, so peak memory is
+    file size + symbol count); small files and library-less environments
+    stream through :func:`iter_encoded_blocks`.
+    """
+    try:
+        size = os.path.getsize(path)
+    except OSError:
+        size = 0
+    if size >= _MT_THRESHOLD and native.available():
+        data = np.fromfile(path, dtype=np.uint8)
+        out = native.encode_mt(data, fasta=skip_headers, threads=threads)
+        if out is not None:
+            return out
     blocks = list(iter_encoded_blocks(path, skip_headers=skip_headers))
     if not blocks:
         return np.zeros(0, dtype=np.uint8)

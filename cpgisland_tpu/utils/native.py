@@ -22,7 +22,7 @@ import numpy as np
 
 log = logging.getLogger(__name__)
 
-_ABI = 1
+_ABI = 2
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 _NATIVE_DIR = os.path.join(_REPO_ROOT, "native")
 _SO_PATH = os.path.join(_NATIVE_DIR, "libcpgnative.so")
@@ -111,6 +111,21 @@ def load() -> Optional[ctypes.CDLL]:
                 ctypes.POINTER(ctypes.c_uint8),
                 ctypes.POINTER(ctypes.c_uint32),
             ]
+            lib.cpg_count_mt.restype = ctypes.c_size_t
+            lib.cpg_count_mt.argtypes = [
+                ctypes.c_char_p,
+                ctypes.c_size_t,
+                ctypes.c_int,
+                ctypes.c_int,
+            ]
+            lib.cpg_encode_mt.restype = ctypes.c_size_t
+            lib.cpg_encode_mt.argtypes = [
+                ctypes.c_char_p,
+                ctypes.c_size_t,
+                ctypes.POINTER(ctypes.c_uint8),
+                ctypes.c_int,
+                ctypes.c_int,
+            ]
             _lib = lib
         except OSError as e:
             log.debug("native load failed: %s", e)
@@ -145,6 +160,37 @@ def encode(data: bytes) -> Optional[np.ndarray]:
         data, len(data), out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
     )
     return _compact(out, n)
+
+
+def encode_mt(
+    data, *, fasta: bool = False, threads: int = 0
+) -> Optional[np.ndarray]:
+    """Parallel whole-buffer fused (strip+)encode; None if library absent.
+
+    Two native passes (count, then write at exact per-thread offsets), so the
+    output allocation is exactly the symbol count — no input-sized scratch.
+    ``data`` must be a complete buffer starting at a line start (bytes or a
+    uint8 array); ``threads<=0`` = auto (hardware concurrency, ~4 MiB/thread
+    floor).
+    """
+    lib = load()
+    if lib is None:
+        return None
+    if isinstance(data, np.ndarray):
+        data = np.ascontiguousarray(data, dtype=np.uint8)
+        buf = data.ctypes.data_as(ctypes.c_char_p)
+        n = data.size
+    else:
+        buf = data
+        n = len(data)
+    count = lib.cpg_count_mt(buf, n, int(fasta), threads)
+    out = np.empty(count, dtype=np.uint8)
+    written = lib.cpg_encode_mt(
+        buf, n, out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)), int(fasta), threads
+    )
+    if written != count:
+        raise RuntimeError(f"native encode_mt wrote {written}, counted {count}")
+    return out
 
 
 class FastaEncoder:

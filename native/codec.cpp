@@ -102,7 +102,155 @@ size_t cpg_encode_fasta(const uint8_t* in, size_t n, uint8_t* out, uint32_t* sta
     return w;
 }
 
+}  // extern "C"
+
+// ---------------------------------------------------------------------------
+// Parallel whole-buffer encode.
+//
+// The streaming kernels above are single-threaded (bounded memory, arbitrary
+// block boundaries).  For whole-file encodes the host is the bottleneck at
+// GRCh38 scale (~3 GiB), so this path fans out across threads in two phases:
+// each thread counts its segment's symbols (phase 1), a tiny serial prefix
+// sum fixes every segment's exact output offset, then each thread re-scans
+// and writes (phase 2).  Output is dense with no compaction pass, and the
+// caller can allocate exactly count bytes via cpg_count_mt.
+//
+// FASTA mode requires segment-local header state, so segments are aligned to
+// line starts (headers never span lines); byte-aligned otherwise.
+
+#include <algorithm>
+#include <thread>
+#include <vector>
+
+namespace {
+
+// One segment's fused strip+encode, counting always, writing when out != nullptr.
+// Segment must begin at a line start in FASTA mode.
+template <bool Fasta>
+size_t segment_pass(const uint8_t* in, size_t begin, size_t end, uint8_t* out) {
+    size_t w = 0;
+    size_t i = begin;
+    bool in_header = false;
+    while (i < end) {
+        if (Fasta) {
+            if (in_header) {
+                const void* nl = memchr(in + i, '\n', end - i);
+                if (!nl) break;
+                i = static_cast<size_t>(static_cast<const uint8_t*>(nl) - in) + 1;
+                in_header = false;
+                continue;
+            }
+            if (in[i] == '>') {  // loop invariant: i is at a line start here
+                in_header = true;
+                continue;
+            }
+        }
+        const void* nl = memchr(in + i, '\n', end - i);
+        size_t stop = nl ? static_cast<size_t>(static_cast<const uint8_t*>(nl) - in) : end;
+        for (size_t j = i; j < stop; ++j) {
+            uint8_t v = kLut.t[in[j]];
+            // NOT the streaming kernels' speculative store: segments here are
+            // exactly sized, so a sentinel written at out[w] would land in the
+            // next thread's region (or past the buffer on the last segment).
+            if (v != 0xFF) {
+                if (out) out[w] = v;
+                ++w;
+            }
+        }
+        i = nl ? stop + 1 : end;
+    }
+    return w;
+}
+
+// Non-FASTA mode has no line structure to respect: one tight loop.
+size_t segment_pass_raw(const uint8_t* in, size_t begin, size_t end, uint8_t* out) {
+    size_t w = 0;
+    for (size_t i = begin; i < end; ++i) {
+        uint8_t v = kLut.t[in[i]];
+        if (v != 0xFF) {  // no speculative store: exact-sized segment regions
+            if (out) out[w] = v;
+            ++w;
+        }
+    }
+    return w;
+}
+
+std::vector<size_t> segment_bounds(const uint8_t* in, size_t n, int fasta, int nthreads) {
+    size_t k = static_cast<size_t>(nthreads);
+    std::vector<size_t> b;
+    b.push_back(0);
+    for (size_t t = 1; t < k; ++t) {
+        size_t pos = n * t / k;
+        if (pos <= b.back()) continue;
+        if (fasta) {
+            // Align to the next line start so header state is segment-local.
+            const void* nl = memchr(in + pos, '\n', n - pos);
+            if (!nl) break;
+            pos = static_cast<size_t>(static_cast<const uint8_t*>(nl) - in) + 1;
+            if (pos <= b.back() || pos >= n) continue;
+        }
+        b.push_back(pos);
+    }
+    b.push_back(n);
+    return b;
+}
+
+int resolve_threads(int nthreads, size_t n) {
+    if (nthreads <= 0) {
+        unsigned hw = std::thread::hardware_concurrency();
+        nthreads = hw ? static_cast<int>(hw) : 4;
+    }
+    // Below ~4 MiB per thread the spawn/join overhead beats the win.
+    size_t cap = std::max<size_t>(1, n / (4u << 20));
+    return static_cast<int>(std::min<size_t>(static_cast<size_t>(nthreads), cap));
+}
+
+size_t run_mt(const uint8_t* in, size_t n, uint8_t* out, int fasta, int nthreads) {
+    if (n == 0) return 0;
+    nthreads = resolve_threads(nthreads, n);
+    std::vector<size_t> bounds = segment_bounds(in, n, fasta, nthreads);
+    size_t nseg = bounds.size() - 1;
+    std::vector<size_t> counts(nseg, 0);
+
+    auto pass = [&](size_t s, uint8_t* dst) -> size_t {
+        if (fasta) return segment_pass<true>(in, bounds[s], bounds[s + 1], dst);
+        return segment_pass_raw(in, bounds[s], bounds[s + 1], dst);
+    };
+    auto fan_out = [&](auto fn) {
+        std::vector<std::thread> ts;
+        ts.reserve(nseg);
+        for (size_t s = 1; s < nseg; ++s) ts.emplace_back(fn, s);
+        fn(0);
+        for (auto& t : ts) t.join();
+    };
+
+    fan_out([&](size_t s) { counts[s] = pass(s, nullptr); });
+    std::vector<size_t> offsets(nseg, 0);
+    for (size_t s = 1; s < nseg; ++s) offsets[s] = offsets[s - 1] + counts[s - 1];
+    size_t total = offsets[nseg - 1] + counts[nseg - 1];
+    if (out) fan_out([&](size_t s) { pass(s, out + offsets[s]); });
+    return total;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Symbol count of a complete buffer (exact-allocation helper for the MT
+// encode).  fasta != 0 strips header lines; the buffer must start at a line
+// start.  nthreads <= 0 = auto.
+size_t cpg_count_mt(const uint8_t* in, size_t n, int fasta, int nthreads) {
+    return run_mt(in, n, nullptr, fasta, nthreads);
+}
+
+// Parallel fused (strip+)encode of a complete buffer into out, which needs
+// capacity for exactly the symbol count (cpg_count_mt with the same args).
+// Returns symbols written.  Semantics match cpg_encode / cpg_encode_fasta.
+size_t cpg_encode_mt(const uint8_t* in, size_t n, uint8_t* out, int fasta, int nthreads) {
+    return run_mt(in, n, out, fasta, nthreads);
+}
+
 // ABI version guard so a stale .so is rejected by the loader.
-uint32_t cpg_native_abi(void) { return 1; }
+uint32_t cpg_native_abi(void) { return 2; }
 
 }  // extern "C"

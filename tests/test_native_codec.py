@@ -74,3 +74,98 @@ def test_native_can_be_disabled(tmp_path, monkeypatch, rng):
     np.testing.assert_array_equal(
         codec.encode_file(str(p), skip_headers=True), [0, 1, 2, 3]
     )
+
+
+# ---------------------------------------------------------------------------
+# Multithreaded whole-buffer encode (cpg_count_mt / cpg_encode_mt, ABI 2)
+
+
+def _fasta_oracle(data: bytes) -> np.ndarray:
+    return codec.encode_bytes(codec.strip_fasta_headers(data))
+
+
+def _random_fasta(rng, n_records=5, seq_len=50000) -> bytes:
+    parts = []
+    for i in range(n_records):
+        parts.append(f">chr{i} some description acgt\n".encode())
+        seq = rng.choice(list(b"ACGTacgtNnX\n"), size=seq_len).astype(np.uint8).tobytes()
+        parts.append(seq + b"\n")
+    return b"".join(parts)
+
+
+@pytest.mark.skipif(not native.available(), reason="native library unavailable")
+@pytest.mark.parametrize("threads", [1, 3, 0])
+def test_encode_mt_raw_parity(rng, threads):
+    data = rng.choice(list(b"ACGTacgtNnX>\n \t0"), size=300001).astype(np.uint8).tobytes()
+    got = native.encode_mt(data, fasta=False, threads=threads)
+    np.testing.assert_array_equal(got, codec.encode_bytes(data))
+
+
+@pytest.mark.skipif(not native.available(), reason="native library unavailable")
+@pytest.mark.parametrize("threads", [1, 3, 0])
+def test_encode_mt_fasta_parity(rng, threads):
+    data = _random_fasta(rng)
+    got = native.encode_mt(data, fasta=True, threads=threads)
+    np.testing.assert_array_equal(got, _fasta_oracle(data))
+
+
+@pytest.mark.skipif(not native.available(), reason="native library unavailable")
+def test_encode_mt_edge_cases():
+    assert native.encode_mt(b"", fasta=True).size == 0
+    assert native.encode_mt(b">only a header no newline", fasta=True).size == 0
+    np.testing.assert_array_equal(
+        native.encode_mt(b">h\nACGT", fasta=True), np.array([0, 1, 2, 3], np.uint8)
+    )
+    # trailing data without newline; header token mid-sequence is not a header
+    data = b">h\nAC>GT\nacg"
+    np.testing.assert_array_equal(native.encode_mt(data, fasta=True), _fasta_oracle(data))
+
+
+@pytest.mark.skipif(not native.available(), reason="native library unavailable")
+def test_encode_mt_header_spans_segment_boundary(rng):
+    # one huge header line (> typical segment size at threads=8) must strip fully
+    data = b">" + bytes(rng.choice(list(b"abcdefgh ACGT"), size=200000).astype(np.uint8)) + b"\nACGTN\n"
+    got = native.encode_mt(data, fasta=True, threads=8)
+    np.testing.assert_array_equal(got, _fasta_oracle(data))
+
+
+@pytest.mark.skipif(not native.available(), reason="native library unavailable")
+def test_encode_file_mt_path(tmp_path, rng, monkeypatch):
+    data = _random_fasta(rng, n_records=3, seq_len=40000)
+    p = tmp_path / "g.fa"
+    p.write_bytes(data)
+    monkeypatch.setattr(codec, "_MT_THRESHOLD", 1024)  # force the MT path
+    got = codec.encode_file(str(p), skip_headers=True)
+    np.testing.assert_array_equal(got, _fasta_oracle(data))
+    got_compat = codec.encode_file(str(p), skip_headers=False)
+    np.testing.assert_array_equal(got_compat, codec.encode_bytes(data))
+
+
+@pytest.mark.skipif(not native.available(), reason="native library unavailable")
+def test_encode_mt_multi_segment_parity(rng):
+    """Buffers past the 4 MiB/thread floor so multiple segments ACTUALLY run:
+    exercises segment offsets, boundary-adjacent skips, and concurrent writes
+    (the single-threaded clamp hid a segment-boundary write race once)."""
+    # ~16 MiB with non-bases adjacent to segment boundaries
+    data = (b"ACGT" * 1000 + b"NN\n") * 4200
+    oracle = codec.encode_bytes(data)
+    for threads in (2, 4, 8):
+        got = native.encode_mt(data, fasta=False, threads=threads)
+        np.testing.assert_array_equal(got, oracle)
+    # FASTA flavour with headers sprinkled through all segments
+    rec = b">r fasta header line\n" + (b"acgtNRYK" * 1000 + b"\n") * 250
+    fdata = rec * 8  # ~16 MiB
+    foracle = codec.encode_bytes(codec.strip_fasta_headers(fdata))
+    for threads in (2, 4, 8):
+        got = native.encode_mt(fdata, fasta=True, threads=threads)
+        np.testing.assert_array_equal(got, foracle)
+
+
+@pytest.mark.skipif(not native.available(), reason="native library unavailable")
+def test_encode_mt_giant_header_spans_segments(rng):
+    """A >4 MiB header line must strip fully even when it spans the nominal
+    segment boundaries of a genuinely multi-threaded run."""
+    header = b">" + bytes(rng.choice(list(b"acgt ACGT_"), size=6 << 20).astype(np.uint8)) + b"\n"
+    data = header + (b"ACGTacgt" * 1000 + b"\n") * 1200  # ~15 MiB total
+    got = native.encode_mt(data, fasta=True, threads=8)
+    np.testing.assert_array_equal(got, codec.encode_bytes(codec.strip_fasta_headers(data)))
