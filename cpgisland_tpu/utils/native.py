@@ -22,7 +22,7 @@ import numpy as np
 
 log = logging.getLogger(__name__)
 
-_ABI = 2
+_ABI = 3
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 _NATIVE_DIR = os.path.join(_REPO_ROOT, "native")
 _SO_PATH = os.path.join(_NATIVE_DIR, "libcpgnative.so")
@@ -126,6 +126,25 @@ def load() -> Optional[ctypes.CDLL]:
                 ctypes.c_int,
                 ctypes.c_int,
             ]
+            lib.cpg_count_segments.restype = ctypes.c_size_t
+            lib.cpg_count_segments.argtypes = [
+                ctypes.c_char_p,
+                ctypes.c_size_t,
+                ctypes.c_int,
+                ctypes.c_int,
+                ctypes.POINTER(ctypes.c_size_t),
+                ctypes.POINTER(ctypes.c_size_t),
+                ctypes.c_size_t,
+            ]
+            lib.cpg_encode_segments.restype = ctypes.c_size_t
+            lib.cpg_encode_segments.argtypes = [
+                ctypes.c_char_p,
+                ctypes.POINTER(ctypes.c_size_t),
+                ctypes.POINTER(ctypes.c_size_t),
+                ctypes.c_size_t,
+                ctypes.c_int,
+                ctypes.POINTER(ctypes.c_uint8),
+            ]
             _lib = lib
         except OSError as e:
             log.debug("native load failed: %s", e)
@@ -183,13 +202,24 @@ def encode_mt(
     else:
         buf = data
         n = len(data)
-    count = lib.cpg_count_mt(buf, n, int(fasta), threads)
-    out = np.empty(count, dtype=np.uint8)
-    written = lib.cpg_encode_mt(
-        buf, n, out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)), int(fasta), threads
+    if n == 0:
+        return np.zeros(0, dtype=np.uint8)
+    # Segments API: one count fan-out, one write fan-out — the input is
+    # scanned exactly twice regardless of size.
+    max_seg = 256
+    bounds = (ctypes.c_size_t * (max_seg + 1))()
+    counts = (ctypes.c_size_t * max_seg)()
+    nseg = lib.cpg_count_segments(buf, n, int(fasta), threads, bounds, counts, max_seg)
+    if nseg == 0:
+        return np.zeros(0, dtype=np.uint8)
+    total = sum(counts[:nseg])
+    out = np.empty(total, dtype=np.uint8)
+    written = lib.cpg_encode_segments(
+        buf, bounds, counts, nseg, int(fasta),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
     )
-    if written != count:
-        raise RuntimeError(f"native encode_mt wrote {written}, counted {count}")
+    if written != total:
+        raise RuntimeError(f"native encode_mt wrote {written}, counted {total}")
     return out
 
 

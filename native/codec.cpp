@@ -250,7 +250,56 @@ size_t cpg_encode_mt(const uint8_t* in, size_t n, uint8_t* out, int fasta, int n
     return run_mt(in, n, out, fasta, nthreads);
 }
 
+// Split count/write so the exact-allocation flow scans the input exactly
+// twice (count fan-out, write fan-out) instead of count + count + write.
+//
+// Phase 1: compute segment bounds and per-segment symbol counts.  bounds_out
+// needs max_seg + 1 entries, counts_out max_seg; returns the segment count
+// (0 when n == 0 or max_seg is too small for even one segment).
+size_t cpg_count_segments(const uint8_t* in, size_t n, int fasta, int nthreads,
+                          size_t* bounds_out, size_t* counts_out, size_t max_seg) {
+    if (n == 0 || max_seg == 0) return 0;
+    nthreads = resolve_threads(nthreads, n);
+    if (static_cast<size_t>(nthreads) > max_seg) nthreads = static_cast<int>(max_seg);
+    std::vector<size_t> bounds = segment_bounds(in, n, fasta, nthreads);
+    size_t nseg = bounds.size() - 1;
+    if (nseg > max_seg) return 0;
+    std::vector<size_t> counts(nseg, 0);
+    std::vector<std::thread> ts;
+    auto count_one = [&](size_t s) {
+        counts[s] = fasta ? segment_pass<true>(in, bounds[s], bounds[s + 1], nullptr)
+                          : segment_pass_raw(in, bounds[s], bounds[s + 1], nullptr);
+    };
+    for (size_t s = 1; s < nseg; ++s) ts.emplace_back(count_one, s);
+    count_one(0);
+    for (auto& t : ts) t.join();
+    for (size_t s = 0; s <= nseg; ++s) bounds_out[s] = bounds[s];
+    for (size_t s = 0; s < nseg; ++s) counts_out[s] = counts[s];
+    return nseg;
+}
+
+// Phase 2: write using phase 1's bounds/counts; out needs capacity for
+// exactly sum(counts).  Returns symbols written.
+size_t cpg_encode_segments(const uint8_t* in, const size_t* bounds, const size_t* counts,
+                           size_t nseg, int fasta, uint8_t* out) {
+    if (nseg == 0) return 0;
+    std::vector<size_t> offsets(nseg, 0);
+    for (size_t s = 1; s < nseg; ++s) offsets[s] = offsets[s - 1] + counts[s - 1];
+    std::vector<std::thread> ts;
+    auto write_one = [&](size_t s) {
+        if (fasta) {
+            segment_pass<true>(in, bounds[s], bounds[s + 1], out + offsets[s]);
+        } else {
+            segment_pass_raw(in, bounds[s], bounds[s + 1], out + offsets[s]);
+        }
+    };
+    for (size_t s = 1; s < nseg; ++s) ts.emplace_back(write_one, s);
+    write_one(0);
+    for (auto& t : ts) t.join();
+    return offsets[nseg - 1] + counts[nseg - 1];
+}
+
 // ABI version guard so a stale .so is rejected by the loader.
-uint32_t cpg_native_abi(void) { return 2; }
+uint32_t cpg_native_abi(void) { return 3; }
 
 }  // extern "C"

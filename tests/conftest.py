@@ -29,3 +29,12 @@ import pytest
 @pytest.fixture
 def rng():
     return np.random.default_rng(0)
+
+
+def require_devices(n: int) -> None:
+    """Skip the calling test when fewer than n devices exist — the suite
+    normally runs on the 8-device virtual CPU mesh, but can be pointed at
+    real hardware (CPGISLAND_TEST_PLATFORM=axon) where a single chip is the
+    common case."""
+    if len(jax.devices()) < n:
+        pytest.skip(f"needs {n} devices, have {len(jax.devices())}")

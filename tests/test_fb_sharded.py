@@ -34,8 +34,12 @@ def _oracle_stats(pi, A, B, obs):
     return gamma[0], xi_sum, emit, ll
 
 
+from conftest import require_devices
+
+
 @pytest.fixture
 def mesh():
+    require_devices(8)
     return make_mesh(8, axis="seq")
 
 
@@ -116,6 +120,7 @@ def test_batch_2d_mesh_matches_per_sequence_oracle(rng, dp, sp):
     from cpgisland_tpu.parallel.fb_sharded import batch_seq_stats_sharded
     from cpgisland_tpu.parallel.mesh import make_mesh2d
 
+    require_devices(8)
     pi, A, B, params = _random_params(rng)
     seqs = [rng.integers(0, 4, size=n).astype(np.uint8) for n in (701, 1203, 402)]
     init_o = np.zeros(3)
@@ -143,6 +148,7 @@ def test_seq2d_backend_em_step_matches_oracle(rng):
     from cpgisland_tpu.parallel.mesh import make_mesh2d
     from cpgisland_tpu.train.backends import Seq2DBackend
 
+    require_devices(8)
     pi, A, B, params = _random_params(rng)
     seqs = [rng.integers(0, 4, size=n).astype(np.uint8) for n in (800, 650)]
     pi_o, A_o, B_o, _ = oracle.em_step_oracle(pi, A, B, seqs)
@@ -160,6 +166,18 @@ def test_seq2d_backend_em_step_matches_oracle(rng):
     np.testing.assert_allclose(np.asarray(res.params.pi), pi_o, atol=1e-5)
     np.testing.assert_allclose(np.asarray(res.params.A), A_o, rtol=1e-4, atol=1e-5)
     np.testing.assert_allclose(np.asarray(res.params.B), B_o, rtol=1e-4, atol=1e-5)
+
+
+def test_em_loglik_monotone_seq_backend_any_devices(rng):
+    """SeqBackend on however many devices exist (1 real chip included)."""
+    _, _, _, params = _random_params(rng, K=2)
+    obs = rng.integers(0, 4, size=4096).astype(np.uint8)
+    backend = SeqBackend(block_size=128)  # default mesh: all devices
+    res = baum_welch.fit(
+        params, chunking.frame(obs, 1024), num_iters=3, convergence=0.0, backend=backend
+    )
+    lls = res.logliks
+    assert all(b >= a - 1e-2 for a, b in zip(lls, lls[1:])), lls
 
 
 def test_em_loglik_monotone_seq_backend(rng, mesh):

@@ -22,6 +22,12 @@ def _path_score(params, obs, path):
 
 
 def test_eight_devices_present():
+    """The CI environment contract (virtual CPU mesh); real single-chip
+    hardware runs are exempt."""
+    import os
+
+    if os.environ.get("CPGISLAND_TEST_PLATFORM", "cpu") != "cpu":
+        pytest.skip("device-count contract applies to the virtual CPU mesh")
     assert len(jax.devices()) == 8
 
 
@@ -80,6 +86,9 @@ def test_island_not_clipped_across_shard_boundary(rng):
 
 
 def test_explicit_small_mesh(rng):
+    from conftest import require_devices
+
+    require_devices(4)
     params = presets.durbin_cpg8()
     mesh = make_mesh(4, axis="seq")
     obs = rng.integers(0, 4, size=1024).astype(np.int32)

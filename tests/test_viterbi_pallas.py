@@ -98,9 +98,12 @@ def test_rejects_large_state_spaces(rng):
 
 def test_sharded_decode_pallas_engine(rng):
     """Pallas passes under shard_map on the 8-device mesh == XLA engine."""
+    from conftest import require_devices
+
     from cpgisland_tpu.parallel.decode import viterbi_sharded
     from cpgisland_tpu.parallel.mesh import make_mesh
 
+    require_devices(8)
     params = _tie_free_params(rng)
     obs = rng.integers(0, 4, size=8 * 512 + 77).astype(np.int32)
     mesh = make_mesh(8, axis="seq")
