@@ -64,10 +64,11 @@ def _select_platform(argv: list) -> list:
 def _common_flags(p: argparse.ArgumentParser) -> None:
     p.add_argument(
         "--backend",
-        choices=("local", "spmd", "seq"),
+        choices=("local", "spmd", "seq", "seq2d"),
         default="local",
         help="E-step backend: one device / chunk-sharded mesh psum / exact "
-        "whole-sequence sequence-parallel (no chunk-boundary approximation)",
+        "whole-sequence sequence-parallel / per-record 2-D data x seq mesh "
+        "(the last two have no chunk-boundary approximation; seq2d needs --clean)",
     )
     p.add_argument("--numerics", choices=("log", "rescaled"), default="rescaled", dest="mode")
     p.add_argument(

@@ -438,6 +438,27 @@ def place_batch2d(mesh: Mesh, chunks, lengths):
     )
 
 
+def pack_ragged(sequences, pad_value: int, *, consume: bool = False):
+    """Pack ragged 1-D symbol arrays into a padded [N, T_max] matrix + lengths.
+
+    ``consume=True`` drops each source array right after its row is copied
+    (entries become None), so peak memory is the matrix plus ONE record
+    instead of matrix plus all records — matters when the records are
+    chromosomes.  The single source of truth for ragged packing; both the
+    standalone 2-D helper and pipeline.train_file use it.
+    """
+    if len(sequences) == 0:
+        raise ValueError("no sequences")
+    lengths = np.array([len(s) for s in sequences], dtype=np.int32)
+    rows = np.full((len(sequences), max(1, int(lengths.max()))), pad_value, dtype=np.uint8)
+    for i in range(len(sequences)):
+        s = sequences[i]
+        rows[i, : len(s)] = np.asarray(s, dtype=np.uint8)
+        if consume:
+            sequences[i] = None
+    return rows, lengths
+
+
 def batch_seq_stats_sharded(
     params: HmmParams,
     sequences,
@@ -454,16 +475,10 @@ def batch_seq_stats_sharded(
     """
     if len(mesh.axis_names) != 2:
         raise ValueError(f"need a 2-D (data, seq) mesh, got axes {mesh.axis_names}")
-    if not sequences:
-        raise ValueError("no sequences")
     da, sa = mesh.axis_names
     dp, sp = mesh.shape[da], mesh.shape[sa]
     pad = params.n_symbols
-    T = max(len(s) for s in sequences)
-    rows = np.full((len(sequences), T), pad, dtype=np.uint8)
-    for i, s in enumerate(sequences):
-        rows[i, : len(s)] = np.asarray(s, dtype=np.uint8)
-    seq_lengths = np.array([len(s) for s in sequences], dtype=np.int32)
+    rows, seq_lengths = pack_ragged(list(sequences), pad)
     obs, lengths = pad_batch2d(rows, seq_lengths, dp, sp, block_size, pad)
     arr, lens = place_batch2d(mesh, obs, lengths)
     return sharded_stats2d_fn(mesh, block_size)(params, arr, lens)

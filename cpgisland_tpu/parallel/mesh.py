@@ -48,5 +48,16 @@ def make_mesh2d(
     return Mesh(np.asarray(devs[: dp * sp]).reshape(dp, sp), tuple(axes))
 
 
+def auto_mesh2d(n_sequences: int, axes: Sequence[str] = (DATA_AXIS, SEQ_AXIS)) -> Mesh:
+    """Pick a balanced dp x sp split of all devices for ``n_sequences``.
+
+    dp is the largest divisor of the device count not exceeding the sequence
+    count, so no data row idles; remaining devices go to sequence
+    parallelism (e.g. 8 devices, 3 chromosomes -> 2 x 4)."""
+    n = len(jax.devices())
+    dp = max(d for d in range(1, n + 1) if n % d == 0 and d <= max(1, n_sequences))
+    return make_mesh2d(dp, n // dp, axes=axes)
+
+
 def local_device_count() -> int:
     return len(jax.devices())

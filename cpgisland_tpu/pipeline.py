@@ -56,12 +56,38 @@ def train_file(
     model_out: Optional[str] = None,
     metrics: Optional[profiling.MetricsLogger] = None,
 ) -> baum_welch.FitResult:
-    """Train the CpG HMM on a sequence file (reference ``trainModel``)."""
+    """Train the CpG HMM on a sequence file (reference ``trainModel``).
+
+    ``backend="seq2d"`` trains on whole FASTA records (one sequence per
+    chromosome, EXACT statistics — no 64 Ki chunk-independence approximation)
+    distributed over an automatic 2-D data x seq mesh; it requires
+    ``compat=False`` since compat mode has no notion of records.  All other
+    backends see the reference's chunk framing.
+    """
     if params is None:
         params = presets.durbin_cpg8()
-    symbols = codec.encode_file(training_path, skip_headers=not compat)
-    log.info("training input: %d symbols", symbols.size)
-    chunked = chunking.frame(symbols, chunk_size, drop_remainder=compat)
+    if backend == "seq2d":
+        if compat:
+            raise ValueError(
+                "backend 'seq2d' trains per FASTA record; compat mode has no "
+                "records — use compat=False (--clean)"
+            )
+        from cpgisland_tpu.parallel.fb_sharded import pack_ragged
+
+        seqs = [syms for _, syms in codec.iter_fasta_records(training_path)]
+        if not seqs:
+            raise ValueError(f"no sequence records in {training_path}")
+        # consume=True: each chromosome is freed as soon as its row is
+        # copied, so host peak is the padded matrix + one record.
+        rows, lengths = pack_ragged(seqs, params.n_symbols, consume=True)
+        log.info("training input: %d records, %d symbols", len(lengths), int(lengths.sum()))
+        chunked = chunking.Chunked(chunks=rows, lengths=lengths, total=int(lengths.sum()))
+        # The string flows through to fit() -> get_backend('seq2d'), which
+        # validates mode/engine and builds the auto 2-D mesh at prepare().
+    else:
+        symbols = codec.encode_file(training_path, skip_headers=not compat)
+        log.info("training input: %d symbols", symbols.size)
+        chunked = chunking.frame(symbols, chunk_size, drop_remainder=compat)
     result = baum_welch.fit(
         params,
         chunked,

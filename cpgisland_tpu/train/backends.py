@@ -238,19 +238,32 @@ class Seq2DBackend(EStepBackend):
 
     def __init__(
         self,
-        mesh: Mesh,
+        mesh: Optional[Mesh] = None,
         block_size: Optional[int] = None,
         pad_value: int = chunking.PAD_SYMBOL,
     ):
-        if len(mesh.axis_names) != 2:
+        if mesh is not None and len(mesh.axis_names) != 2:
             raise ValueError(f"Seq2DBackend needs a 2-D mesh, got axes {mesh.axis_names}")
+        # mesh=None defers the dp x sp split to prepare(), which knows the
+        # sequence count (parallel.mesh.auto_mesh2d).
         self.mesh = mesh
         self.block_size = block_size if block_size is not None else fb_sharded.DEFAULT_BLOCK
-        self.data_axis, self.seq_axis = mesh.axis_names
         self.pad_value = pad_value
+
+    @property
+    def data_axis(self) -> str:
+        return self.mesh.axis_names[0]
+
+    @property
+    def seq_axis(self) -> str:
+        return self.mesh.axis_names[1]
 
     def prepare(self, chunked: chunking.Chunked) -> chunking.Chunked:
         """Pad rows (sequences) to dp multiples and columns to sp*block."""
+        if self.mesh is None:
+            from cpgisland_tpu.parallel.mesh import auto_mesh2d
+
+            self.mesh = auto_mesh2d(chunked.num_chunks)
         obs, lengths = fb_sharded.pad_batch2d(
             chunked.chunks,
             chunked.lengths,
@@ -267,7 +280,7 @@ class Seq2DBackend(EStepBackend):
         return fb_sharded.place_batch2d(self.mesh, chunks, lengths)
 
     def __call__(self, params, chunks, lengths):
-        if getattr(chunks, "ndim", 0) != 2 or getattr(lengths, "ndim", 0) != 2:
+        if self.mesh is None or getattr(chunks, "ndim", 0) != 2 or getattr(lengths, "ndim", 0) != 2:
             raise ValueError(
                 "Seq2DBackend expects placed [N, T] sequences and [N, sp] shard "
                 "lengths; run prepare() + place() first"
@@ -288,12 +301,16 @@ def get_backend(
         return LocalBackend(mode=mode, engine=engine)
     if name == "spmd":
         return SpmdBackend(mesh=mesh, mode=mode, engine=engine)
-    if name == "seq":
-        # The whole-sequence backend has fixed rescaled numerics and its own
-        # lowering — reject knobs it would otherwise silently ignore.
+    if name in ("seq", "seq2d"):
+        # The whole-sequence backends have fixed rescaled numerics and their
+        # own lowering — reject knobs they would otherwise silently ignore.
         if mode != "rescaled":
-            raise ValueError("backend 'seq' implements rescaled numerics only")
+            raise ValueError(f"backend {name!r} implements rescaled numerics only")
         if engine not in ("auto", "xla"):
-            raise ValueError(f"backend 'seq' does not take engine {engine!r}")
-        return SeqBackend(mesh=mesh)
-    raise ValueError(f"unknown backend {name!r} (expected 'local', 'spmd', or 'seq')")
+            raise ValueError(f"backend {name!r} does not take engine {engine!r}")
+        if name == "seq":
+            return SeqBackend(mesh=mesh)
+        return Seq2DBackend(mesh=mesh)
+    raise ValueError(
+        f"unknown backend {name!r} (expected 'local', 'spmd', 'seq', or 'seq2d')"
+    )

@@ -139,9 +139,9 @@ def encode_file(path: str, *, skip_headers: bool = False, threads: int = 0) -> n
     """Encode an entire file into one symbol array.
 
     Large files take the multithreaded native path (native/codec.cpp
-    cpg_encode_mt: parallel count + write-at-exact-offsets, so peak memory is
-    file size + symbol count); small files and library-less environments
-    stream through :func:`iter_encoded_blocks`.
+    segments API: parallel per-segment count, then write at exact offsets, so
+    peak memory is file size + symbol count); small files and library-less
+    environments stream through :func:`iter_encoded_blocks`.
     """
     try:
         size = os.path.getsize(path)

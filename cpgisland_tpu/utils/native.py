@@ -111,21 +111,6 @@ def load() -> Optional[ctypes.CDLL]:
                 ctypes.POINTER(ctypes.c_uint8),
                 ctypes.POINTER(ctypes.c_uint32),
             ]
-            lib.cpg_count_mt.restype = ctypes.c_size_t
-            lib.cpg_count_mt.argtypes = [
-                ctypes.c_char_p,
-                ctypes.c_size_t,
-                ctypes.c_int,
-                ctypes.c_int,
-            ]
-            lib.cpg_encode_mt.restype = ctypes.c_size_t
-            lib.cpg_encode_mt.argtypes = [
-                ctypes.c_char_p,
-                ctypes.c_size_t,
-                ctypes.POINTER(ctypes.c_uint8),
-                ctypes.c_int,
-                ctypes.c_int,
-            ]
             lib.cpg_count_segments.restype = ctypes.c_size_t
             lib.cpg_count_segments.argtypes = [
                 ctypes.c_char_p,
@@ -211,7 +196,9 @@ def encode_mt(
     counts = (ctypes.c_size_t * max_seg)()
     nseg = lib.cpg_count_segments(buf, n, int(fasta), threads, bounds, counts, max_seg)
     if nseg == 0:
-        return np.zeros(0, dtype=np.uint8)
+        # n > 0 was handled above, so 0 is the C API's capacity-error
+        # sentinel (more segments than max_seg) — never a silent empty result.
+        raise RuntimeError(f"native cpg_count_segments needed more than {max_seg} segments")
     total = sum(counts[:nseg])
     out = np.empty(total, dtype=np.uint8)
     written = lib.cpg_encode_segments(

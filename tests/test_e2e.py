@@ -175,3 +175,31 @@ def test_clean_decode_single_record_keeps_bare_format(tmp_path, rng):
     pipeline.decode_file(str(fa), presets.durbin_cpg8(), islands_out=str(out), compat=False)
     lines = out.read_text().splitlines()
     assert lines and all(len(ln.split()) == 5 for ln in lines)
+
+
+def test_train_file_seq2d_per_record(tmp_path, rng):
+    """backend='seq2d': whole-chromosome exact EM on an auto 2-D mesh."""
+    from cpgisland_tpu import pipeline
+
+    fa = tmp_path / "multi.fa"
+    with open(fa, "w") as f:
+        for name, n in (("chrA", 6000), ("chrB", 4000), ("chrC", 2000)):
+            f.write(f">{name}\n")
+            s = "".join(rng.choice(list("acgt"), size=n))
+            for i in range(0, n, 70):
+                f.write(s[i : i + 70] + "\n")
+    res = pipeline.train_file(str(fa), backend="seq2d", compat=False, num_iters=3,
+                              convergence=0.0)
+    lls = res.logliks
+    assert len(lls) == 3
+    assert all(b >= a - 1e-2 for a, b in zip(lls, lls[1:])), lls
+    res.params.validate()
+
+
+def test_train_file_seq2d_requires_clean(tmp_path):
+    from cpgisland_tpu import pipeline
+
+    fa = tmp_path / "x.fa"
+    fa.write_text(">h\nacgt\n")
+    with pytest.raises(ValueError, match="seq2d"):
+        pipeline.train_file(str(fa), backend="seq2d", compat=True)

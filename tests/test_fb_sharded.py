@@ -168,6 +168,17 @@ def test_seq2d_backend_em_step_matches_oracle(rng):
     np.testing.assert_allclose(np.asarray(res.params.B), B_o, rtol=1e-4, atol=1e-5)
 
 
+def test_get_backend_rejects_mismatched_knobs():
+    from cpgisland_tpu.train.backends import get_backend
+
+    for name in ("seq", "seq2d"):
+        with pytest.raises(ValueError, match="rescaled"):
+            get_backend(name, mode="log")
+        with pytest.raises(ValueError, match="engine"):
+            get_backend(name, engine="pallas")
+        assert get_backend(name) is not None
+
+
 def test_em_loglik_monotone_seq_backend_any_devices(rng):
     """SeqBackend on however many devices exist (1 real chip included)."""
     _, _, _, params = _random_params(rng, K=2)

@@ -77,7 +77,7 @@ def test_native_can_be_disabled(tmp_path, monkeypatch, rng):
 
 
 # ---------------------------------------------------------------------------
-# Multithreaded whole-buffer encode (cpg_count_mt / cpg_encode_mt, ABI 2)
+# Multithreaded whole-buffer encode (cpg_count_segments / cpg_encode_segments)
 
 
 def _fasta_oracle(data: bytes) -> np.ndarray:
