@@ -39,10 +39,14 @@ except Exception:  # pragma: no cover
     _VMEM = None
 
 from cpgisland_tpu.models.hmm import LOG_ZERO, HmmParams
-from cpgisland_tpu.ops.viterbi_parallel import maxplus_matmul
+
+# One shared block-size default for both lowerings (the sweep that set it
+# lives at viterbi_parallel.DEFAULT_BLOCK) — a separate pallas default once
+# silently pinned the production batch path at 512 while benches measured
+# the retuned value.
+from cpgisland_tpu.ops.viterbi_parallel import DEFAULT_BLOCK, maxplus_matmul
 
 LANE_TILE = 128  # lanes per kernel instance = one TPU vreg width
-DEFAULT_BLOCK = 512  # symbols per lane (bk); VMEM per instance stays ~1 MiB
 
 # All in-kernel dynamic row offsets are multiples of ROW_TILE: Mosaic requires
 # statically-provable sublane alignment for dynamic VMEM loads/stores of

@@ -438,24 +438,19 @@ def place_batch2d(mesh: Mesh, chunks, lengths):
     )
 
 
-def pack_ragged(sequences, pad_value: int, *, consume: bool = False):
+def pack_ragged(sequences, pad_value: int):
     """Pack ragged 1-D symbol arrays into a padded [N, T_max] matrix + lengths.
 
-    ``consume=True`` drops each source array right after its row is copied
-    (entries become None), so peak memory is the matrix plus ONE record
-    instead of matrix plus all records — matters when the records are
-    chromosomes.  The single source of truth for ragged packing; both the
-    standalone 2-D helper and pipeline.train_file use it.
+    Peak memory is the matrix plus the input arrays — callers with
+    chromosome-scale records that can re-stream their source should build the
+    matrix record-by-record instead (pipeline.train_file's two-pass load).
     """
     if len(sequences) == 0:
         raise ValueError("no sequences")
     lengths = np.array([len(s) for s in sequences], dtype=np.int32)
     rows = np.full((len(sequences), max(1, int(lengths.max()))), pad_value, dtype=np.uint8)
-    for i in range(len(sequences)):
-        s = sequences[i]
+    for i, s in enumerate(sequences):
         rows[i, : len(s)] = np.asarray(s, dtype=np.uint8)
-        if consume:
-            sequences[i] = None
     return rows, lengths
 
 

@@ -72,14 +72,20 @@ def train_file(
                 "backend 'seq2d' trains per FASTA record; compat mode has no "
                 "records — use compat=False (--clean)"
             )
-        from cpgisland_tpu.parallel.fb_sharded import pack_ragged
-
-        seqs = [syms for _, syms in codec.iter_fasta_records(training_path)]
-        if not seqs:
+        # Two streaming passes over the file so host peak is the padded
+        # matrix + ONE record (a single pass would hold every chromosome AND
+        # the matrix at allocation time — double the footprint at GRCh38
+        # scale; re-encoding the file once is much cheaper than that).
+        lengths = np.array(
+            [s.size for _, s in codec.iter_fasta_records(training_path)], np.int32
+        )
+        if lengths.size == 0:
             raise ValueError(f"no sequence records in {training_path}")
-        # consume=True: each chromosome is freed as soon as its row is
-        # copied, so host peak is the padded matrix + one record.
-        rows, lengths = pack_ragged(seqs, params.n_symbols, consume=True)
+        rows = np.full(
+            (lengths.size, max(1, int(lengths.max()))), params.n_symbols, np.uint8
+        )
+        for i, (_, s) in enumerate(codec.iter_fasta_records(training_path)):
+            rows[i, : s.size] = s
         log.info("training input: %d records, %d symbols", len(lengths), int(lengths.sum()))
         chunked = chunking.Chunked(chunks=rows, lengths=lengths, total=int(lengths.sum()))
         # The string flows through to fit() -> get_backend('seq2d'), which

@@ -113,7 +113,8 @@ size_t cpg_encode_fasta(const uint8_t* in, size_t n, uint8_t* out, uint32_t* sta
 // each thread counts its segment's symbols (phase 1), a tiny serial prefix
 // sum fixes every segment's exact output offset, then each thread re-scans
 // and writes (phase 2).  Output is dense with no compaction pass, and the
-// caller can allocate exactly count bytes via cpg_count_mt.
+// caller allocates exactly sum(counts) bytes between the phases
+// (cpg_count_segments / cpg_encode_segments).
 //
 // FASTA mode requires segment-local header state, so segments are aligned to
 // line starts (headers never span lines); byte-aligned otherwise.
@@ -205,54 +206,10 @@ int resolve_threads(int nthreads, size_t n) {
     return static_cast<int>(std::min<size_t>(static_cast<size_t>(nthreads), cap));
 }
 
-size_t run_mt(const uint8_t* in, size_t n, uint8_t* out, int fasta, int nthreads) {
-    if (n == 0) return 0;
-    nthreads = resolve_threads(nthreads, n);
-    std::vector<size_t> bounds = segment_bounds(in, n, fasta, nthreads);
-    size_t nseg = bounds.size() - 1;
-    std::vector<size_t> counts(nseg, 0);
-
-    auto pass = [&](size_t s, uint8_t* dst) -> size_t {
-        if (fasta) return segment_pass<true>(in, bounds[s], bounds[s + 1], dst);
-        return segment_pass_raw(in, bounds[s], bounds[s + 1], dst);
-    };
-    auto fan_out = [&](auto fn) {
-        std::vector<std::thread> ts;
-        ts.reserve(nseg);
-        for (size_t s = 1; s < nseg; ++s) ts.emplace_back(fn, s);
-        fn(0);
-        for (auto& t : ts) t.join();
-    };
-
-    fan_out([&](size_t s) { counts[s] = pass(s, nullptr); });
-    std::vector<size_t> offsets(nseg, 0);
-    for (size_t s = 1; s < nseg; ++s) offsets[s] = offsets[s - 1] + counts[s - 1];
-    size_t total = offsets[nseg - 1] + counts[nseg - 1];
-    if (out) fan_out([&](size_t s) { pass(s, out + offsets[s]); });
-    return total;
-}
-
 }  // namespace
 
 extern "C" {
 
-// Symbol count of a complete buffer (exact-allocation helper for the MT
-// encode).  fasta != 0 strips header lines; the buffer must start at a line
-// start.  nthreads <= 0 = auto.
-size_t cpg_count_mt(const uint8_t* in, size_t n, int fasta, int nthreads) {
-    return run_mt(in, n, nullptr, fasta, nthreads);
-}
-
-// Parallel fused (strip+)encode of a complete buffer into out, which needs
-// capacity for exactly the symbol count (cpg_count_mt with the same args).
-// Returns symbols written.  Semantics match cpg_encode / cpg_encode_fasta.
-size_t cpg_encode_mt(const uint8_t* in, size_t n, uint8_t* out, int fasta, int nthreads) {
-    return run_mt(in, n, out, fasta, nthreads);
-}
-
-// Split count/write so the exact-allocation flow scans the input exactly
-// twice (count fan-out, write fan-out) instead of count + count + write.
-//
 // Phase 1: compute segment bounds and per-segment symbol counts.  bounds_out
 // needs max_seg + 1 entries, counts_out max_seg; returns the segment count
 // (0 when n == 0 or max_seg is too small for even one segment).
