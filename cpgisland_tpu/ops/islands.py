@@ -115,6 +115,81 @@ def _empty_calls() -> IslandCalls:
     return IslandCalls(z, z, z, f, f)
 
 
+def _adjacency(in_mask: np.ndarray):
+    """(prev_in, opening, continuing) boundary masks for island runs."""
+    T = in_mask.shape[0]
+    prev_in = np.empty(T, dtype=bool)
+    prev_in[0] = False
+    prev_in[1:] = in_mask[:-1]
+    return prev_in, in_mask & ~prev_in, in_mask & prev_in
+
+
+def _runs_to_calls(
+    in_mask: np.ndarray,
+    is_c: np.ndarray,
+    is_g: np.ndarray,
+    cg_event: np.ndarray,
+    *,
+    drop_open_at_end: bool,
+    min_len: int | None,
+    gc_threshold: float,
+    oe_threshold: float,
+    offset: int,
+) -> IslandCalls:
+    """Shared run accounting: masks -> filtered (beg,end,len,gc,oe) records.
+
+    The single source of truth for run boundaries, prefix-sum counting, the
+    gc/oe formulas, and the thresholds — both the 8-state caller and the
+    observation-based caller feed it their mode-specific masks.
+    """
+    T = in_mask.shape[0]
+    prev_in, opening, _ = _adjacency(in_mask)
+    starts = np.flatnonzero(opening)
+    if starts.size == 0:
+        return _empty_calls()
+    next_in = np.empty(T, dtype=bool)
+    next_in[-1] = False
+    next_in[:-1] = in_mask[1:]
+    last = np.flatnonzero(in_mask & ~next_in)  # last in-island index per run
+
+    if drop_open_at_end:
+        # Reference quirk (a): a run reaching the end of the path is never
+        # closed, so it is never emitted (java:269-339).
+        open_at_end = last == T - 1
+        starts, last = starts[~open_at_end], last[~open_at_end]
+        if starts.size == 0:
+            return _empty_calls()
+
+    def run_sums(events: np.ndarray) -> np.ndarray:
+        cum = np.concatenate([[0], np.cumsum(events, dtype=np.int64)])
+        return cum[last + 1] - cum[starts]
+
+    c_count = run_sums(is_c)
+    g_count = run_sums(is_g)
+    cg_count = run_sums(cg_event)
+    length = last - starts + 1
+
+    gc = (c_count + g_count) / length
+    with np.errstate(divide="ignore", invalid="ignore"):
+        oe = np.where(
+            (c_count > 0) & (g_count > 0),
+            cg_count.astype(np.float64) * length / (c_count.astype(np.float64) * g_count),
+            0.0,
+        )
+
+    keep = (gc > gc_threshold) & (oe > oe_threshold)
+    if min_len is not None:
+        keep &= length > min_len
+
+    return IslandCalls(
+        beg=(starts[keep] + offset + 1).astype(np.int64),
+        end=(last[keep] + offset + 1).astype(np.int64),
+        length=length[keep].astype(np.int64),
+        gc_content=gc[keep].astype(np.float64),
+        oe_ratio=oe[keep].astype(np.float64),
+    )
+
+
 def call_islands(
     path: np.ndarray,
     *,
@@ -132,26 +207,7 @@ def call_islands(
         return _empty_calls()
 
     in_mask = path < N_ISLAND_STATES
-    prev_in = np.empty(T, dtype=bool)
-    prev_in[0] = False
-    prev_in[1:] = in_mask[:-1]
-    opening = in_mask & ~prev_in
-    continuing = in_mask & prev_in
-
-    starts = np.flatnonzero(opening)
-    if starts.size == 0:
-        return _empty_calls()
-    next_in = np.empty(T, dtype=bool)
-    next_in[-1] = False
-    next_in[:-1] = in_mask[1:]
-    last = np.flatnonzero(in_mask & ~next_in)  # last in-island index per run
-
-    if compat:
-        # Quirk (a): a run reaching the end of the path is never closed/emitted.
-        open_at_end = last == T - 1
-        starts, last = starts[~open_at_end], last[~open_at_end]
-        if starts.size == 0:
-            return _empty_calls()
+    prev_in, opening, continuing = _adjacency(in_mask)
 
     is_c = in_mask & (path == C_STATE)
     is_g = in_mask & (path == G_STATE)
@@ -172,32 +228,58 @@ def call_islands(
     else:
         cg_event = continuing & is_g & np.concatenate([[False], is_c[:-1]])
 
-    def run_sums(events: np.ndarray) -> np.ndarray:
-        cum = np.concatenate([[0], np.cumsum(events, dtype=np.int64)])
-        return cum[last + 1] - cum[starts]
+    return _runs_to_calls(
+        in_mask, is_c, is_g, cg_event,
+        drop_open_at_end=compat,
+        min_len=None if compat else min_len,
+        gc_threshold=gc_threshold,
+        oe_threshold=oe_threshold,
+        offset=chunk * chunk_size,
+    )
 
-    c_count = run_sums(is_c)
-    g_count = run_sums(is_g)
-    cg_count = run_sums(cg_event)
-    length = last - starts + 1
 
-    gc = (c_count + g_count) / length
-    with np.errstate(divide="ignore", invalid="ignore"):
-        oe = np.where(
-            (c_count > 0) & (g_count > 0),
-            cg_count.astype(np.float64) * length / (c_count.astype(np.float64) * g_count),
-            0.0,
-        )
+def call_islands_obs(
+    path: np.ndarray,
+    obs: np.ndarray,
+    *,
+    island_states,
+    min_len: int | None = None,
+    gc_threshold: float = 0.5,
+    oe_threshold: float = 0.6,
+    offset: int = 0,
+) -> IslandCalls:
+    """Island calling for ARBITRARY state sets (clean semantics only).
 
-    keep = (gc > gc_threshold) & (oe > oe_threshold)
-    if not compat and min_len is not None:
-        keep &= length > min_len
+    The 8-state caller above reads base identity out of the state ids (state
+    1 = C+, state 2 = G+ — a property of the reference's A+-T- labeling,
+    CpGIslandFinder.java:182-189).  Models whose states don't encode bases
+    (e.g. presets.two_state_cpg, or any user HMM) need membership from the
+    decoded PATH but composition from the OBSERVATIONS — which is what this
+    does: a position is in an island iff path[t] is in ``island_states``;
+    C/G/CpG counts come from obs[t] (symbol ids 0..3 = acgt).
 
-    offset = chunk * chunk_size + 1
-    return IslandCalls(
-        beg=(starts[keep] + offset).astype(np.int64),
-        end=(last[keep] + offset).astype(np.int64),
-        length=length[keep].astype(np.int64),
-        gc_content=gc[keep].astype(np.float64),
-        oe_ratio=oe[keep].astype(np.float64),
+    Emits the same (beg, end, length, gc, oe) records and thresholds; run
+    coordinates are 1-based with ``offset`` added (pass the record's global
+    start for multi-span files).
+    """
+    path = np.asarray(path)
+    obs = np.asarray(obs)
+    if path.shape != obs.shape:
+        raise ValueError(f"path {path.shape} and obs {obs.shape} differ")
+    if path.shape[0] == 0:
+        return _empty_calls()
+
+    in_mask = np.isin(path, np.asarray(list(island_states)))
+    prev_in, _, _ = _adjacency(in_mask)
+    is_c = in_mask & (obs == 1)  # codec.C
+    is_g = in_mask & (obs == 2)  # codec.G
+    cg_event = in_mask & prev_in & (obs == 2) & np.concatenate([[False], obs[:-1] == 1])
+
+    return _runs_to_calls(
+        in_mask, is_c, is_g, cg_event,
+        drop_open_at_end=False,
+        min_len=min_len,
+        gc_threshold=gc_threshold,
+        oe_threshold=oe_threshold,
+        offset=offset,
     )

@@ -136,6 +136,7 @@ def decode_file(
     min_len: Optional[int] = None,
     span: int = CLEAN_DECODE_SPAN,
     engine: str = "auto",
+    island_states=None,
     metrics: Optional[profiling.MetricsLogger] = None,
     timer: Optional[profiling.PhaseTimer] = None,
 ) -> DecodeResult:
@@ -147,7 +148,15 @@ def decode_file(
     CpGIslandFinder.java:256,262-268).  clean mode decodes each FASTA record
     exactly (sequence-parallel over all local devices) and calls islands per
     record — no DP restarts, no island clipping, no cross-chromosome islands.
+
+    ``island_states`` (clean mode only): decode with a model whose states
+    don't encode bases — e.g. presets.two_state_cpg with island_states=(0,)
+    — and call islands with membership from the path but base composition
+    from the observations (ops.islands.call_islands_obs).
     """
+    if island_states is not None and compat:
+        raise ValueError("island_states needs clean mode (compat=False); the "
+                         "reference caller is 8-state-specific")
     timer = timer if timer is not None else profiling.PhaseTimer()
     batch_decode = (
         viterbi_pallas_batch
@@ -227,7 +236,12 @@ def decode_file(
             ] or [np.zeros(0, dtype=np.int32)]
             full = np.concatenate(pieces)
         with timer.phase("islands", items=float(symbols.size), unit="sym"):
-            calls = islands_mod.call_islands(full, chunk=0, compat=False, min_len=min_len)
+            if island_states is not None:
+                calls = islands_mod.call_islands_obs(
+                    full, symbols, island_states=island_states, min_len=min_len
+                )
+            else:
+                calls = islands_mod.call_islands(full, chunk=0, compat=False, min_len=min_len)
         # "." = headerless leading sequence: keeps the name column parseable
         # (a bare "" would emit a leading space and split into 5 fields).
         parts.append(calls.with_names(rec_name or "."))

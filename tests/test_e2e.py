@@ -203,3 +203,24 @@ def test_train_file_seq2d_requires_clean(tmp_path):
     fa.write_text(">h\nacgt\n")
     with pytest.raises(ValueError, match="seq2d"):
         pipeline.train_file(str(fa), backend="seq2d", compat=True)
+
+
+def test_decode_file_two_state_island_states(tmp_path, rng):
+    """End-to-end with a non-base-encoding model: 2-state HMM decode + the
+    observation-based island caller."""
+    fa = tmp_path / "g.fa"
+    with open(fa, "w") as f:
+        f.write(">chr\n")
+        parts = []
+        for _ in range(4):
+            parts.append(rng.choice(list("acgt"), size=3000, p=[0.35, 0.15, 0.15, 0.35]))
+            parts.append(rng.choice(list("acgt"), size=800, p=[0.08, 0.42, 0.42, 0.08]))
+        s = "".join(np.concatenate(parts))
+        for i in range(0, len(s), 70):
+            f.write(s[i : i + 70] + "\n")
+    params = presets.two_state_cpg()
+    res = pipeline.decode_file(str(fa), params, compat=False, island_states=(0,))
+    assert 3 <= len(res.calls) <= 6  # the 4 planted islands (merges tolerated)
+    assert all(g > 0.5 for g in res.calls.gc_content)
+    with pytest.raises(ValueError, match="clean mode"):
+        pipeline.decode_file(str(fa), params, compat=True, island_states=(0,))

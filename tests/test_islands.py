@@ -103,3 +103,47 @@ def test_concatenate():
     cat = I.IslandCalls.concatenate([a, b])
     assert len(cat) == 2 and cat.beg[1] == cat.beg[0] + 10
     assert len(I.IslandCalls.concatenate([])) == 0
+
+
+# ---------------------------------------------------------------------------
+# Generic-state-set caller (call_islands_obs)
+
+
+def test_obs_caller_matches_8state_caller_on_consistent_paths(rng):
+    """With the Durbin one-hot emissions, state X+- implies obs x, so the
+    obs-based caller over island_states={0..3} must agree with the clean
+    8-state caller on any consistent (path, obs) pair."""
+    path = rng.integers(0, 8, size=20000).astype(np.int64)
+    obs = (path % 4).astype(np.uint8)  # consistent: state X+- emitted x
+    a = I.call_islands(path, compat=False)
+    b = I.call_islands_obs(path, obs, island_states=range(4))
+    np.testing.assert_array_equal(a.beg, b.beg)
+    np.testing.assert_array_equal(a.end, b.end)
+    np.testing.assert_allclose(a.gc_content, b.gc_content)
+    np.testing.assert_allclose(a.oe_ratio, b.oe_ratio)
+
+
+def test_obs_caller_two_state_model():
+    """2-state model: island membership from the path, composition from obs."""
+    # one island of 8 GC-rich positions (cgcgcgcg) in an AT background
+    path = np.array([1] * 5 + [0] * 8 + [1] * 5)
+    obs = np.array([0, 3, 0, 3, 0] + [1, 2, 1, 2, 1, 2, 1, 2] + [3, 0, 3, 0, 3], dtype=np.uint8)
+    calls = I.call_islands_obs(path, obs, island_states=(0,))
+    assert len(calls) == 1
+    assert calls.beg[0] == 6 and calls.end[0] == 13
+    assert calls.gc_content[0] == 1.0
+    # 4 CpG dinucleotides in 8 bases with 4 C and 4 G: oe = 4*8/(4*4) = 2.0
+    assert calls.oe_ratio[0] == 2.0
+
+
+def test_obs_caller_open_at_end_and_offset():
+    path = np.array([1, 1, 0, 0, 0, 0])
+    obs = np.array([0, 0, 1, 2, 1, 2], dtype=np.uint8)
+    calls = I.call_islands_obs(path, obs, island_states=(0,), offset=100)
+    assert len(calls) == 1  # clean semantics: emitted even though open at end
+    assert calls.beg[0] == 103 and calls.end[0] == 106
+
+
+def test_obs_caller_shape_mismatch():
+    with pytest.raises(ValueError):
+        I.call_islands_obs(np.zeros(3, int), np.zeros(4, np.uint8), island_states=(0,))
