@@ -83,7 +83,24 @@ def _common_flags(p: argparse.ArgumentParser) -> None:
         help="FASTA-aware encoding, no dropped remainders, no island clipping "
         "(default is reference-compatible behavior)",
     )
+    p.add_argument(
+        "--preset",
+        choices=("durbin8", "two_state"),
+        default="durbin8",
+        help="initial model preset (durbin8: the reference's 8-state CpG+- "
+        "table; two_state: minimal island/background model — decode needs "
+        "--island-states 0 with it)",
+    )
+    p.add_argument(
+        "--trace-dir",
+        help="capture a jax.profiler device trace into this directory "
+        "(TensorBoard format; SURVEY.md §5 tracing)",
+    )
     p.add_argument("-v", "--verbose", action="store_true")
+
+
+def _preset_params(presets, name: str):
+    return presets.two_state_cpg() if name == "two_state" else presets.durbin_cpg8()
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -101,9 +118,10 @@ def build_parser() -> argparse.ArgumentParser:
 
     d = sub.add_parser("decode", help="Viterbi decode + island calling")
     d.add_argument("test_file")
-    d.add_argument("--model", help="model text file (default: Durbin preset)")
+    d.add_argument("--model", help="model text file (default: the --preset model)")
     d.add_argument("--islands-out", required=True)
     d.add_argument("--min-len", type=int, default=None, help="clean mode only")
+    _add_island_states_flag(d)
     _common_flags(d)
 
     r = sub.add_parser("run", help="train then decode (the reference main())")
@@ -113,9 +131,32 @@ def build_parser() -> argparse.ArgumentParser:
     r.add_argument("--model-out", required=True)
     r.add_argument("--iters", type=int, default=10)
     r.add_argument("--convergence", type=float, default=0.005)
+    _add_island_states_flag(r)
     _common_flags(r)
 
     return ap
+
+
+def _add_island_states_flag(p: argparse.ArgumentParser) -> None:
+    p.add_argument(
+        "--island-states",
+        help="comma-separated island state ids for models whose states don't "
+        "encode bases (e.g. '0' for the two_state preset); composition then "
+        "comes from the observations; clean mode only",
+    )
+
+
+def _parse_island_states(parser: argparse.ArgumentParser, args, compat: bool):
+    if not getattr(args, "island_states", None):
+        return None
+    if compat:
+        parser.error("--island-states requires --clean")
+    try:
+        return tuple(int(s) for s in args.island_states.split(","))
+    except ValueError:
+        parser.error(
+            f"--island-states must be comma-separated integers, got {args.island_states!r}"
+        )
 
 
 def main(argv: Optional[Sequence[str]] = None) -> int:
@@ -146,8 +187,20 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     )
     compat = not args.clean
 
+    import contextlib
+
+    from cpgisland_tpu.utils import profiling
+
+    trace_ctx = (
+        profiling.trace(args.trace_dir) if args.trace_dir else contextlib.nullcontext()
+    )
+    with trace_ctx:
+        return _run_command(args, compat, pipeline, presets, load_text)
+
+
+def _run_command(args, compat, pipeline, presets, load_text) -> int:
     if args.cmd == "train":
-        params = load_text(args.init_model) if args.init_model else presets.durbin_cpg8()
+        params = load_text(args.init_model) if args.init_model else _preset_params(presets, args.preset)
         res = pipeline.train_file(
             args.training_file,
             params=params,
@@ -169,7 +222,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     if args.cmd == "decode":
         if args.min_len is not None and compat:
             build_parser().error("--min-len requires --clean (the reference has no length filter)")
-        params = load_text(args.model) if args.model else presets.durbin_cpg8()
+        island_states = _parse_island_states(build_parser(), args, compat)
+        params = load_text(args.model) if args.model else _preset_params(presets, args.preset)
         res = pipeline.decode_file(
             args.test_file,
             params,
@@ -177,6 +231,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             compat=compat,
             min_len=args.min_len,
             engine=args.engine,
+            island_states=island_states,
         )
         print(f"decoded {res.n_symbols} symbols in {res.n_chunks} chunks; {len(res.calls)} islands")
         return 0
@@ -189,10 +244,12 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             args.model_out,
             convergence=args.convergence,
             num_iters=args.iters,
+            params=_preset_params(presets, args.preset),
             backend=args.backend,
             mode=args.mode,
             compat=compat,
             engine=args.engine,
+            island_states=_parse_island_states(build_parser(), args, compat),
         )
         print(f"{len(res.calls)} islands -> {args.islands_out}")
         return 0

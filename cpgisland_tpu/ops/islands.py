@@ -126,6 +126,7 @@ def _adjacency(in_mask: np.ndarray):
 
 def _runs_to_calls(
     in_mask: np.ndarray,
+    opening: np.ndarray,
     is_c: np.ndarray,
     is_g: np.ndarray,
     cg_event: np.ndarray,
@@ -140,10 +141,10 @@ def _runs_to_calls(
 
     The single source of truth for run boundaries, prefix-sum counting, the
     gc/oe formulas, and the thresholds — both the 8-state caller and the
-    observation-based caller feed it their mode-specific masks.
+    observation-based caller feed it their mode-specific masks (``opening``
+    comes from the caller's _adjacency pass; no mask is recomputed here).
     """
     T = in_mask.shape[0]
-    prev_in, opening, _ = _adjacency(in_mask)
     starts = np.flatnonzero(opening)
     if starts.size == 0:
         return _empty_calls()
@@ -229,7 +230,7 @@ def call_islands(
         cg_event = continuing & is_g & np.concatenate([[False], is_c[:-1]])
 
     return _runs_to_calls(
-        in_mask, is_c, is_g, cg_event,
+        in_mask, opening, is_c, is_g, cg_event,
         drop_open_at_end=compat,
         min_len=None if compat else min_len,
         gc_threshold=gc_threshold,
@@ -270,13 +271,13 @@ def call_islands_obs(
         return _empty_calls()
 
     in_mask = np.isin(path, np.asarray(list(island_states)))
-    prev_in, _, _ = _adjacency(in_mask)
+    prev_in, opening, _ = _adjacency(in_mask)
     is_c = in_mask & (obs == 1)  # codec.C
     is_g = in_mask & (obs == 2)  # codec.G
     cg_event = in_mask & prev_in & (obs == 2) & np.concatenate([[False], obs[:-1] == 1])
 
     return _runs_to_calls(
-        in_mask, is_c, is_g, cg_event,
+        in_mask, opening, is_c, is_g, cg_event,
         drop_open_at_end=False,
         min_len=min_len,
         gc_threshold=gc_threshold,

@@ -157,6 +157,17 @@ def decode_file(
     if island_states is not None and compat:
         raise ValueError("island_states needs clean mode (compat=False); the "
                          "reference caller is 8-state-specific")
+    if island_states is None and params.n_states != 2 * params.n_symbols:
+        # The built-in caller reads base identity out of state ids, which is
+        # only meaningful for the reference's 2M-state X+/X- labeling
+        # (CpGIslandFinder.java:182-189).  Anything else would silently emit
+        # garbage islands — require the observation-based caller instead.
+        raise ValueError(
+            f"model has {params.n_states} states / {params.n_symbols} symbols, "
+            "not the 2M-state X+/X- labeling the built-in island caller "
+            "assumes — pass island_states=(...) (clean mode) to use the "
+            "observation-based caller"
+        )
     timer = timer if timer is not None else profiling.PhaseTimer()
     batch_decode = (
         viterbi_pallas_batch
@@ -297,6 +308,7 @@ def run(
     checkpoint_dir: Optional[str] = None,
     min_len: Optional[int] = None,
     engine: str = "auto",
+    island_states=None,
 ) -> DecodeResult:
     """The reference's full main(): train, dump model, decode, write islands
     (CpGIslandFinder.java:346-357)."""
@@ -318,4 +330,5 @@ def run(
         compat=compat,
         min_len=min_len,
         engine=engine,
+        island_states=island_states,
     )

@@ -224,3 +224,60 @@ def test_decode_file_two_state_island_states(tmp_path, rng):
     assert all(g > 0.5 for g in res.calls.gc_content)
     with pytest.raises(ValueError, match="clean mode"):
         pipeline.decode_file(str(fa), params, compat=True, island_states=(0,))
+
+
+def test_cli_two_state_preset_island_states(tmp_path, rng):
+    fa = tmp_path / "g.fa"
+    with open(fa, "w") as f:
+        f.write(">c\n")
+        parts = []
+        for _ in range(3):
+            parts.append(rng.choice(list("acgt"), size=3000, p=[0.35, 0.15, 0.15, 0.35]))
+            parts.append(rng.choice(list("acgt"), size=700, p=[0.08, 0.42, 0.42, 0.08]))
+        s = "".join(np.concatenate(parts))
+        for i in range(0, len(s), 70):
+            f.write(s[i : i + 70] + "\n")
+    out = tmp_path / "i.txt"
+    rc = cli.main(
+        ["decode", str(fa), "--islands-out", str(out), "--clean",
+         "--preset", "two_state", "--island-states", "0", "--min-len", "200"]
+    )
+    assert rc == 0
+    lines = out.read_text().splitlines()
+    assert 2 <= len(lines) <= 4  # the 3 planted islands
+    # --island-states without --clean must be rejected
+    with pytest.raises(SystemExit):
+        cli.main(["decode", str(fa), "--islands-out", str(out), "--island-states", "0"])
+
+
+def test_decode_file_rejects_non_8state_without_island_states(tmp_path):
+    fa = tmp_path / "x.fa"
+    fa.write_text(">h\nacgtacgtacgt\n")
+    with pytest.raises(ValueError, match="island_states"):
+        pipeline.decode_file(str(fa), presets.two_state_cpg(), compat=False)
+    with pytest.raises(ValueError, match="island_states"):
+        pipeline.decode_file(str(fa), presets.two_state_cpg(), compat=True)
+
+
+def test_cli_run_two_state_full_loop(tmp_path, rng):
+    fa = tmp_path / "g.fa"
+    with open(fa, "w") as f:
+        f.write(">c\n")
+        parts = []
+        for _ in range(3):
+            parts.append(rng.choice(list("acgt"), size=3000, p=[0.35, 0.15, 0.15, 0.35]))
+            parts.append(rng.choice(list("acgt"), size=700, p=[0.08, 0.42, 0.42, 0.08]))
+        s = "".join(np.concatenate(parts))
+        for i in range(0, len(s), 70):
+            f.write(s[i : i + 70] + "\n")
+    out, m = tmp_path / "i.txt", tmp_path / "m.txt"
+    rc = cli.main(
+        ["run", str(fa), str(fa), "--islands-out", str(out), "--model-out", str(m),
+         "--clean", "--preset", "two_state", "--island-states", "0", "--iters", "2"]
+    )
+    assert rc == 0
+    assert 2 <= len(out.read_text().splitlines()) <= 4
+    # malformed ids -> argparse error, not a traceback
+    with pytest.raises(SystemExit):
+        cli.main(["decode", str(fa), "--islands-out", str(out), "--clean",
+                  "--island-states", "0,"])
