@@ -56,37 +56,68 @@ def _emit_sel(B, syms, K, S):
     return out
 
 
+def _emit_sel_cols(B, syms, K):
+    """Bsel[t, k, n] = B[k, syms[t, n]] — the [Tp, NL] batch variant."""
+    out = jnp.zeros((syms.shape[0], K, syms.shape[1]), jnp.float32)
+    for s in range(B.shape[1]):
+        out = jnp.where((syms == s)[:, None, :], B[:, s][None, :, None], out)
+    return out
+
+
+ROW_TILE = 8  # sublane count of an (8, 128) f32/i32 VMEM tile
+
+
 def _fwd_kernel(steps_ref, lens_ref, alpha0_ref, c0_ref, A_ref, B_ref,
                 alphas_ref, cs_ref, carry_ref, *, K, S, Tt):
+    # Row-tiled walk: dynamic sublane offsets into (8,128)-tiled VMEM must be
+    # 8-aligned for Mosaic's fast path (see the ROW_TILE note in
+    # viterbi_pallas.py), so steps/cs move as aligned [8, lt] tiles with the
+    # per-row recurrence unrolled — the per-step misaligned row load/store
+    # was >3x the arithmetic cost of the recurrence itself.
     j = pl.program_id(1)
+    lt = steps_ref.shape[1]
     A = A_ref[:, :]
     B = B_ref[:, :]
     lens = lens_ref[0, :]
     alpha_in = jnp.where(j == 0, alpha0_ref[:, :], carry_ref[:, :])
 
-    def body(tl, alpha):
-        t = j * Tt + tl
-        o_t = steps_ref[tl, :]
-        v_t = t < lens
-        raw = jnp.sum(alpha[:, None, :] * A[:, :, None], axis=0) * _emit_sel(B, o_t, K, S)
-        c = jnp.sum(raw, axis=0)
-        new = raw / c
-        new = jnp.where(v_t[None, :], new, alpha)
-        c = jnp.where(v_t, c, 1.0)
-        # t == 0 has no incoming transition: its (alpha, c) come precomputed.
-        new = jnp.where(t == 0, alpha0_ref[:, :], new)
-        c = jnp.where(t == 0, c0_ref[0, :], c)
-        alphas_ref[tl, :, :] = new
-        cs_ref[tl, :] = c
-        return new
+    def body(tile_i, alpha):
+        base = tile_i * ROW_TILE
+        o_tile = steps_ref[pl.ds(base, ROW_TILE), :]  # aligned [8, lt]
+        cs_rows = []
+        for r in range(ROW_TILE):
+            t = j * Tt + base + r
+            o_t = o_tile[r, :]
+            v_t = t < lens
+            raw = jnp.sum(alpha[:, None, :] * A[:, :, None], axis=0) * _emit_sel(B, o_t, K, S)
+            c = jnp.sum(raw, axis=0)
+            new = raw / c
+            new = jnp.where(v_t[None, :], new, alpha)
+            c = jnp.where(v_t, c, 1.0)
+            # t == 0 has no incoming transition: its (alpha, c) are precomputed.
+            new = jnp.where(t == 0, alpha0_ref[:, :], new)
+            c = jnp.where(t == 0, c0_ref[0, :], c)
+            alphas_ref[base + r, :, :] = new  # [K, lt] = one full tile row
+            cs_rows.append(c)
+            alpha = new
+        cs_ref[pl.ds(base, ROW_TILE), :] = jnp.stack(cs_rows, axis=0)
+        return alpha
 
-    carry_ref[:, :] = jax.lax.fori_loop(0, Tt, body, alpha_in)
+    carry_ref[:, :] = jax.lax.fori_loop(0, Tt // ROW_TILE, body, alpha_in)
 
 
-def _bwd_kernel(steps_ref, lens_ref, A_ref, B_ref, alphas_ref, cs_ref,
-                trans_ref, emit_ref, beta0_ref,
+def _bwd_kernel(steps_ref, lens_ref, A_ref, B_ref, cs_ref,
+                betas_ref,
                 beta_scr, onext_scr, cnext_scr,
                 *, K, S, Tt, T):
+    """Reverse t-walk storing ONLY the scaled beta vectors.
+
+    The count tensors are NOT accumulated here (an earlier version did and
+    spent ~60 vreg ops/step on xi/gamma outer products inside the sequential
+    loop) — they become time-parallel contractions over the stored
+    alphas/betas in the JAX assembly below, where the MXU/VPU can batch them.
+    Per-step work is just the beta recurrence, comparable to the forward.
+    """
     j = pl.program_id(1)
     n_t = pl.num_programs(1)
     lt = steps_ref.shape[1]
@@ -98,57 +129,34 @@ def _bwd_kernel(steps_ref, lens_ref, A_ref, B_ref, alphas_ref, cs_ref,
     @pl.when(j == 0)
     def _init():
         beta_scr[:, :] = jnp.ones((K, lt), jnp.float32)
-        trans_ref[:, :] = jnp.zeros((K * K, lt), jnp.float32)
-        emit_ref[:, :] = jnp.zeros((K * S, lt), jnp.float32)
         onext_scr[0, :] = jnp.zeros((lt,), jnp.int32)
         cnext_scr[0, :] = jnp.ones((lt,), jnp.float32)
 
-    def body(tl_rev, carry):
-        beta_next, trans, emit = carry
+    # NOTE: not row-tiled like the forward — the 8-row reversed unroll with
+    # cross-row (o_next, c_next) carries hits a TPU compiler abort (SIGABRT
+    # in the Mosaic pipeline); the per-step dynamic row reads here cost ~25%
+    # of the pass, acceptable until the toolchain moves.
+    def body(tl_rev, beta_next):
         tl = Tt - 1 - tl_rev
         t = t0 + tl
-        # The XLA bstep covers t in [0, T-2]; position T-1 only seeds carries.
+        # beta_{T-1} = 1 (the init); the recurrence covers t <= T-2.
         active = t <= T - 2
-        o_t = steps_ref[tl, :]
-        alpha_t = alphas_ref[tl, :, :]
         at_edge = tl == Tt - 1
         tl_n = jnp.minimum(tl + 1, Tt - 1)
         o_next = jnp.where(at_edge, onext_scr[0, :], steps_ref[tl_n, :])
         c_next = jnp.where(at_edge, cnext_scr[0, :], cs_ref[tl_n, :])
-        v_t = t < lens
         v_next = (t + 1) < lens
 
         w = _emit_sel(B, o_next, K, S) * beta_next / c_next  # [K, lt]
-        xi = alpha_t[:, None, :] * (A[:, :, None] * w[None, :, :])
-        trans = trans + jnp.where((active & v_next)[None, None, :], xi, 0.0)
         beta_t = jnp.sum(A[:, :, None] * w[None, :, :], axis=1)
         beta_t = jnp.where((active & v_next)[None, :], beta_t, beta_next)
-        gamma_t = alpha_t * beta_t
-        gamma_t = gamma_t / jnp.maximum(jnp.sum(gamma_t, axis=0), 1e-30)
-        gamma_t = jnp.where((active & v_t)[None, :], gamma_t, 0.0)
-        sel = jnp.stack([(o_t == s).astype(jnp.float32) for s in range(S)], axis=0)
-        emit = emit + gamma_t[:, None, :] * sel[None, :, :]  # [K, S, lt]
-        return beta_t, trans, emit
+        betas_ref[tl, :, :] = beta_t
+        return beta_t
 
-    beta, trans, emit = jax.lax.fori_loop(
-        0,
-        Tt,
-        body,
-        (
-            beta_scr[:, :],
-            trans_ref[:, :].reshape(K, K, lt),
-            emit_ref[:, :].reshape(K, S, lt),
-        ),
-    )
+    beta = jax.lax.fori_loop(0, Tt, body, beta_scr[:, :])
     beta_scr[:, :] = beta
-    trans_ref[:, :] = trans.reshape(K * K, lt)
-    emit_ref[:, :] = emit.reshape(K * S, lt)
     onext_scr[0, :] = steps_ref[0, :]
     cnext_scr[0, :] = cs_ref[0, :]
-
-    @pl.when(j == n_t - 1)
-    def _finish():
-        beta0_ref[:, :] = beta
 
 
 def _pad_axis(x, size, axis, fill):
@@ -185,7 +193,10 @@ def batch_stats_pallas(
     )
 
     NL = -(-N // LANE_TILE) * LANE_TILE
-    Tt = min(t_tile, T)
+    # Round the t-tile up to a ROW_TILE multiple: the row-tiled forward walks
+    # whole 8-row tiles, and Tp-padding (pad rows are invalid -> identity /
+    # masked) absorbs the excess when T itself is not a multiple.
+    Tt = -(-min(t_tile, T) // ROW_TILE) * ROW_TILE
     n_t = -(-T // Tt)
     Tp = n_t * Tt
     steps2 = _pad_axis(_pad_axis(obs_c.T, Tp, 0, 0), NL, 1, 0)  # [Tp, NL]
@@ -225,7 +236,7 @@ def batch_stats_pallas(
 
     # Reversed t-walk: input/output t-blocks indexed by (n_t-1-j).
     rev_step_spec = _vspec((Tt, LANE_TILE), lambda i, j: (n_t - 1 - j, i))
-    trans_l, emit_l, beta0 = pl.pallas_call(
+    (betas,) = pl.pallas_call(
         functools.partial(_bwd_kernel, K=K, S=S, Tt=Tt, T=T),
         grid=grid,
         in_specs=[
@@ -233,18 +244,13 @@ def batch_stats_pallas(
             lane_spec,
             mat_spec,
             emitmat_spec,
-            _vspec((Tt, K, LANE_TILE), lambda i, j: (n_t - 1 - j, 0, i)),
             rev_step_spec,
         ],
         out_specs=[
-            _vspec((K * K, LANE_TILE), lambda i, j: (0, i)),
-            _vspec((K * S, LANE_TILE), lambda i, j: (0, i)),
-            klane_spec,
+            _vspec((Tt, K, LANE_TILE), lambda i, j: (n_t - 1 - j, 0, i)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((K * K, NL), jnp.float32),
-            jax.ShapeDtypeStruct((K * S, NL), jnp.float32),
-            jax.ShapeDtypeStruct((K, NL), jnp.float32),
+            jax.ShapeDtypeStruct((Tp, K, NL), jnp.float32),
         ],
         scratch_shapes=[
             pltpu.VMEM((K, LANE_TILE), jnp.float32),
@@ -252,34 +258,42 @@ def batch_stats_pallas(
             pltpu.VMEM((1, LANE_TILE), jnp.float32),
         ],
         interpret=interpret,
-    )(steps2, lens2, A, B, alphas, cs)
+    )(steps2, lens2, A, B, cs)
 
-    # Assembly in JAX (cheap, [NL]-sized): loglik, gamma0, tail-emission fix,
-    # empty-lane zeroing, lane-sum reduction.
+    # Count-tensor assembly: TIME-PARALLEL contractions over the streamed
+    # alphas/betas — the expensive per-step outer products the old backward
+    # kernel accumulated sequentially are now two einsums and S masked sums
+    # that XLA batches over all (t, lane) at once.
     tmask = jnp.arange(Tp)[:, None] < lens2  # [Tp, NL]
-    loglik = jnp.sum(jnp.where(tmask & valid0[None, :], jnp.log(cs), 0.0))
+    vmask = tmask & valid0[None, :]
+    loglik = jnp.sum(jnp.where(vmask, jnp.log(cs), 0.0))
 
-    gamma0 = alpha0 * beta0
-    gamma0 = gamma0 / jnp.maximum(jnp.sum(gamma0, axis=0), 1e-30)
-    init_l = jnp.where(valid0[None, :], gamma0, 0.0)  # [K, NL]
+    # gamma_t = normalize(alpha_t * beta_t) at every valid position; the
+    # stored beta at the last valid position is exactly 1 (pass-through from
+    # the init), so position length-1's emission needs no special casing.
+    graw = alphas * betas  # [Tp, K, NL]
+    gamma = graw / jnp.maximum(jnp.sum(graw, axis=1, keepdims=True), 1e-30)
+    gamma = jnp.where(vmask[:, None, :], gamma, 0.0)
 
-    # Final-position emission: the backward walk stops at T-2; position
-    # length-1 is covered there for padded chunks (identity pad steps give it
-    # beta = beta_next), so only unpadded chunks (length == T) need the fix —
-    # mirroring the XLA path's (length == T) correction.
-    alphaT = alphas[T - 1]  # [K, NL] — alpha at the last real row
-    gl = alphaT / jnp.maximum(jnp.sum(alphaT, axis=0), 1e-30)
-    is_full = (lens2[0] == T) & valid0
-    oT = steps2[T - 1, :]
-    selT = _emit_sel(jnp.eye(S, dtype=jnp.float32), oT, S, S)  # [S, NL] one-hot
-    emit_l = emit_l.reshape(K, S, NL) + (
-        gl[:, None, :] * selT[None, :, :] * is_full[None, None, :]
-    )
+    emit = jnp.stack(
+        [jnp.sum(gamma * (steps2 == s)[:, None, :], axis=(0, 2)) for s in range(S)],
+        axis=1,
+    )  # [K, S]
+
+    # xi(pair t-1 -> t) = alpha_{t-1} (x) (B[:,o_t] * beta_t / c_t) elementwise A:
+    # summing the outer products over (t, lane) is one [K, T*N] x [T*N, K] dot.
+    # Shifted SLICES (not a concatenated copy) — position 0 has no incoming
+    # transition, so pairs are (alphas[t-1], w[t]) for t >= 1 masked by v_t.
+    w = _emit_sel_cols(B, steps2, K) * betas / cs[:, None, :]  # [Tp, K, NL]
+    a_prev = jnp.where(vmask[1:, None, :], alphas[:-1], 0.0)
+    trans = A * jnp.einsum("tin,tjn->ij", a_prev, w[1:], precision=jax.lax.Precision.HIGHEST)
+
+    init_l = jnp.where(valid0[None, :], gamma[0], 0.0)  # [K, NL]
 
     return SuffStats(
         init=jnp.sum(init_l, axis=1),
-        trans=jnp.sum(trans_l.reshape(K, K, NL), axis=2),
-        emit=jnp.sum(emit_l, axis=2),
+        trans=trans,
+        emit=emit,
         loglik=loglik,
         n_seqs=jnp.sum(valid0.astype(jnp.int32)),
     )

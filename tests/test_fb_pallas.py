@@ -102,3 +102,17 @@ def test_engine_validation():
         backends.resolve_fb_engine("pallas", params, "log")
     with pytest.raises(ValueError, match="unknown engine"):
         backends.resolve_fb_engine("bogus", params, "rescaled")
+
+
+def test_t_not_multiple_of_row_tile(rng):
+    """T below the t-tile and not a multiple of 8: the row-tiled forward must
+    cover every position (a truncating tile loop once dropped T % 8 rows)."""
+    params = presets.durbin_cpg8()
+    for T in (250, 7, 63):
+        chunks = jnp.asarray(rng.integers(0, 4, size=(4, T), dtype=np.int32).astype(np.uint8))
+        lengths = jnp.asarray(rng.integers(1, T + 1, size=4), dtype=jnp.int32)
+        got = batch_stats_pallas(params, chunks, lengths)
+        want = batch_stats(params, chunks, lengths, mode="rescaled")
+        np.testing.assert_allclose(np.asarray(got.trans), np.asarray(want.trans), rtol=2e-4, atol=2e-4)
+        np.testing.assert_allclose(np.asarray(got.emit), np.asarray(want.emit), rtol=2e-4, atol=2e-4)
+        assert float(got.loglik) == pytest.approx(float(want.loglik), abs=0.01)
