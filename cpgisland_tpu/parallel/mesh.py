@@ -5,15 +5,25 @@ collectives run on ICI (and DCN across hosts) — the TPU-native replacement for
 the reference's Hadoop cluster (SURVEY.md §5).  A 1-D ``data`` axis carries
 chunk-parallel training (C8); ``SEQ_AXIS`` names the axis used for
 sequence-parallel decoding.
+
+Multi-host: every helper here builds meshes from ``jax.devices()``, which is
+the GLOBAL device list once :func:`initialize_multihost` (or
+``jax.distributed.initialize``) has run on each host of a pod — the same
+`shard_map`/`psum` programs then span hosts with XLA routing collectives over
+ICI within a slice and DCN across slices, no code changes.  This replaces the
+reference's Hadoop cluster membership; there is no NCCL/MPI layer to manage.
 """
 
 from __future__ import annotations
 
+import logging
 from typing import Optional, Sequence
 
 import jax
 import numpy as np
 from jax.sharding import Mesh
+
+log = logging.getLogger(__name__)
 
 DATA_AXIS = "data"
 SEQ_AXIS = "seq"
@@ -59,5 +69,76 @@ def auto_mesh2d(n_sequences: int, axes: Sequence[str] = (DATA_AXIS, SEQ_AXIS)) -
     return make_mesh2d(dp, n // dp, axes=axes)
 
 
-def local_device_count() -> int:
+def initialize_multihost(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> int:
+    """Join this process to a multi-host run (the DCN membership step).
+
+    Thin wrapper over ``jax.distributed.initialize``: on TPU pods the
+    arguments default from the cluster environment (TPU metadata /
+    JAX_COORDINATOR_ADDRESS etc.), so a bare ``initialize_multihost()`` on
+    every host is enough; no-ops when already initialized or when explicitly
+    told this is a single-process run (all args None AND no cluster env).
+    Returns the global device count afterwards.
+
+    After this, :func:`make_mesh` / :func:`make_mesh2d` / :func:`auto_mesh2d`
+    build GLOBAL meshes and the training/decode entry points run unchanged —
+    each host feeds its shard of the input (jax.process_index() selects it).
+    """
+    import os
+
+    import jax.distributed as jd
+
+    explicit = any(a is not None for a in (coordinator_address, num_processes, process_id))
+    try:
+        jd.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+    except RuntimeError as e:
+        msg = str(e).lower()
+        if "already initialized" in msg or "should only be called once" in msg:
+            pass  # idempotent re-entry
+        elif "must be called before" in msg and not explicit and not _cluster_env():
+            # The XLA backend is already up, no cluster was requested
+            # explicitly, and nothing in the environment says this is a pod:
+            # a single-process run that called this late — fine.  On a real
+            # pod (cluster env present) this stays a hard error, because
+            # silently degrading would have every host train alone.
+            log.info("backend already initialized; continuing single-process")
+        else:
+            raise
+    except ValueError:
+        if explicit:
+            raise  # explicit args that still don't work are a real error
+        # No cluster environment to auto-detect from: single-process run.
+        log.info("no multi-host cluster environment detected; running single-process")
     return len(jax.devices())
+
+
+# Environment markers jax.distributed's auto-detection feeds on — if any is
+# set, this process is part of a cluster and must never silently degrade.
+_CLUSTER_ENV_VARS = (
+    "JAX_COORDINATOR_ADDRESS",
+    "MEGASCALE_COORDINATOR_ADDRESS",
+    "SLURM_JOB_NUM_NODES",
+    "OMPI_COMM_WORLD_SIZE",
+)
+
+
+def _cluster_env() -> bool:
+    import os
+
+    if any(os.environ.get(v) for v in _CLUSTER_ENV_VARS):
+        return True
+    # TPU plugins set TPU_WORKER_HOSTNAMES even on one host ("localhost");
+    # only a multi-entry list means an actual pod.
+    return "," in os.environ.get("TPU_WORKER_HOSTNAMES", "")
+
+
+def local_device_count() -> int:
+    """Devices attached to THIS process (not the global pod count)."""
+    return jax.local_device_count()

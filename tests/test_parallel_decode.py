@@ -97,3 +97,13 @@ def test_explicit_small_mesh(rng):
     assert _path_score(params, obs, path) == pytest.approx(
         _path_score(params, obs, single), abs=1e-2
     )
+
+
+def test_initialize_multihost_single_process_noop():
+    """Without a cluster environment (and no explicit args) this is a no-op
+    that reports the device count; explicit-but-broken args still raise."""
+    from cpgisland_tpu.parallel.mesh import initialize_multihost
+
+    assert initialize_multihost() == len(jax.devices())
+    with pytest.raises(Exception):
+        initialize_multihost(num_processes=2, process_id=0)  # no coordinator
