@@ -17,6 +17,7 @@ reference's Hadoop cluster membership; there is no NCCL/MPI layer to manage.
 from __future__ import annotations
 
 import logging
+import os
 from typing import Optional, Sequence
 
 import jax
@@ -87,8 +88,6 @@ def initialize_multihost(
     build GLOBAL meshes and the training/decode entry points run unchanged —
     each host feeds its shard of the input (jax.process_index() selects it).
     """
-    import os
-
     import jax.distributed as jd
 
     explicit = any(a is not None for a in (coordinator_address, num_processes, process_id))
@@ -112,8 +111,11 @@ def initialize_multihost(
         else:
             raise
     except ValueError:
-        if explicit:
-            raise  # explicit args that still don't work are a real error
+        if explicit or _cluster_env():
+            # Explicit-but-broken args, or a cluster environment whose
+            # auto-detection failed: silently degrading would have every
+            # host train alone — stay a hard error.
+            raise
         # No cluster environment to auto-detect from: single-process run.
         log.info("no multi-host cluster environment detected; running single-process")
     return len(jax.devices())
@@ -130,8 +132,6 @@ _CLUSTER_ENV_VARS = (
 
 
 def _cluster_env() -> bool:
-    import os
-
     if any(os.environ.get(v) for v in _CLUSTER_ENV_VARS):
         return True
     # TPU plugins set TPU_WORKER_HOSTNAMES even on one host ("localhost");

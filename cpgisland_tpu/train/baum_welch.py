@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import dataclasses
 import logging
+import os
 import time
 from typing import Callable, Optional
 
@@ -82,6 +83,7 @@ def fit(
     start_iteration: int = 0,
     metrics: Optional[profiling.MetricsLogger] = None,
     fallback_backend: Optional[EStepBackend] = None,
+    checkpoint_format: str = "npz",
 ) -> FitResult:
     """Run Baum-Welch EM until convergence or ``num_iters``.
 
@@ -100,6 +102,10 @@ def fit(
     updated from corrupt statistics.  Without a fallback the error propagates
     after the retry.
     """
+    if checkpoint_format not in ("npz", "orbax"):
+        # Validate up front — failing at the first save would waste a full
+        # EM iteration first.
+        raise ValueError(f"unknown checkpoint_format {checkpoint_format!r} (npz|orbax)")
     if isinstance(backend, str):
         backend = get_backend(backend, mode=mode, engine=engine)
     chunked0 = chunked
@@ -160,8 +166,9 @@ def fit(
             callback(it, ll, delta)
         if checkpoint_dir is not None:
             ckpt.save(
-                ckpt.checkpoint_path(checkpoint_dir, it),
+                ckpt.checkpoint_path(checkpoint_dir, it, format=checkpoint_format),
                 ckpt.TrainState(params=params, iteration=it, logliks=logliks),
+                format=checkpoint_format,
             )
         if delta < convergence:
             converged = True
@@ -201,5 +208,8 @@ def resume(
         mode=mode,
         checkpoint_dir=checkpoint_dir,
         start_iteration=state.iteration,
+        # Continue in the format the run was using (Orbax snapshots are
+        # directories) — a resumed Orbax run must not switch to npz.
+        checkpoint_format="orbax" if os.path.isdir(path) else "npz",
     )
     return dataclasses.replace(result, logliks=list(state.logliks) + result.logliks)

@@ -4,9 +4,17 @@ The reference persists the model to HDFS every EM iteration (the MR driver's
 modelIn/modelOut paths, CpGIslandFinder.java:64-89,200-203) but has no resume
 logic in the driver.  Here checkpoints are a first-class subsystem (SURVEY.md
 §5): each EM iteration can snapshot (pi, A, B, iteration, log-likelihood
-history) to a single ``.npz``, and training can resume from any snapshot.  The
-reference's plain-text dump (models.hmm.dump_text) is kept alongside for format
-compatibility.
+history), and training can resume from any snapshot.  Two storage formats:
+
+- ``.npz`` (default) — one atomic file per snapshot, no extra deps in the
+  loop; right-sized for a model of 8 + 64 + 32 parameters.
+- Orbax (``format="orbax"``) — `orbax.checkpoint.StandardCheckpointer`
+  directories; the ecosystem-standard format when checkpoints must
+  interoperate with other JAX tooling or move to cloud storage.
+
+:func:`load` and :func:`latest` auto-detect the format, so ``resume`` works
+over a directory containing either.  The reference's plain-text dump
+(models.hmm.dump_text) is kept alongside for format compatibility.
 """
 
 from __future__ import annotations
@@ -30,21 +38,45 @@ class TrainState:
     logliks: list = field(default_factory=list)
 
 
-def save(path: str, state: TrainState) -> None:
-    """Atomically write a TrainState snapshot as .npz (write temp + rename)."""
+def _state_tree(state: TrainState) -> dict:
+    return {
+        "pi": np.asarray(state.params.pi, dtype=np.float64),
+        "A": np.asarray(state.params.A, dtype=np.float64),
+        "B": np.asarray(state.params.B, dtype=np.float64),
+        "iteration": np.int64(state.iteration),
+        "logliks": np.asarray(state.logliks, dtype=np.float64),
+    }
+
+
+def _state_from_tree(z) -> TrainState:
+    return TrainState(
+        params=HmmParams.from_probs(z["pi"], z["A"], z["B"]),
+        iteration=int(z["iteration"]),
+        logliks=list(np.atleast_1d(np.asarray(z["logliks"]))),
+    )
+
+
+def save(path: str, state: TrainState, format: str = "npz") -> None:
+    """Write a TrainState snapshot — atomic .npz or an Orbax directory."""
+    if format == "orbax":
+        import orbax.checkpoint as ocp
+
+        with ocp.StandardCheckpointer() as ckptr:
+            # Orbax wants an absolute, non-existing target dir; its own
+            # tmp-then-rename gives atomicity.  Strip the npz suffix so the
+            # two formats share checkpoint_path().
+            target = os.path.abspath(path[: -len(".npz")] if path.endswith(".npz") else path)
+            os.makedirs(os.path.dirname(target), exist_ok=True)
+            ckptr.save(target, _state_tree(state), force=True)
+        return
+    if format != "npz":
+        raise ValueError(f"unknown checkpoint format {format!r} (npz|orbax)")
     d = os.path.dirname(os.path.abspath(path)) or "."
     os.makedirs(d, exist_ok=True)
     fd, tmp = tempfile.mkstemp(dir=d, suffix=".npz.tmp")
     try:
         with os.fdopen(fd, "wb") as f:
-            np.savez(
-                f,
-                pi=np.asarray(state.params.pi, dtype=np.float64),
-                A=np.asarray(state.params.A, dtype=np.float64),
-                B=np.asarray(state.params.B, dtype=np.float64),
-                iteration=np.int64(state.iteration),
-                logliks=np.asarray(state.logliks, dtype=np.float64),
-            )
+            np.savez(f, **_state_tree(state))
         os.replace(tmp, path)
     except BaseException:
         if os.path.exists(tmp):
@@ -53,30 +85,40 @@ def save(path: str, state: TrainState) -> None:
 
 
 def load(path: str) -> TrainState:
+    """Load a snapshot; the format is auto-detected (npz file / Orbax dir)."""
+    if os.path.isdir(path):
+        import orbax.checkpoint as ocp
+
+        with ocp.StandardCheckpointer() as ckptr:
+            return _state_from_tree(ckptr.restore(os.path.abspath(path)))
     with np.load(path) as z:
-        params = HmmParams.from_probs(z["pi"], z["A"], z["B"])
-        return TrainState(
-            params=params,
-            iteration=int(z["iteration"]),
-            logliks=list(z["logliks"]),
-        )
+        return _state_from_tree(z)
 
 
 def latest(directory: str, prefix: str = "ckpt_") -> Optional[str]:
-    """Path of the highest-iteration checkpoint in a directory, or None."""
+    """Path of the highest-iteration checkpoint in a directory (either
+    format), or None."""
     if not os.path.isdir(directory):
         return None
     best: tuple[int, Optional[str]] = (-1, None)
     for name in os.listdir(directory):
-        if name.startswith(prefix) and name.endswith(".npz"):
-            try:
-                it = int(name[len(prefix) : -len(".npz")])
-            except ValueError:
-                continue
-            if it > best[0]:
-                best = (it, os.path.join(directory, name))
+        if not name.startswith(prefix):
+            continue
+        stem = name[: -len(".npz")] if name.endswith(".npz") else name
+        try:
+            it = int(stem[len(prefix):])
+        except ValueError:
+            continue
+        full = os.path.join(directory, name)
+        if not (name.endswith(".npz") or os.path.isdir(full)):
+            continue
+        if it > best[0]:
+            best = (it, full)
     return best[1]
 
 
-def checkpoint_path(directory: str, iteration: int, prefix: str = "ckpt_") -> str:
-    return os.path.join(directory, f"{prefix}{iteration:06d}.npz")
+def checkpoint_path(
+    directory: str, iteration: int, prefix: str = "ckpt_", format: str = "npz"
+) -> str:
+    name = f"{prefix}{iteration:06d}"
+    return os.path.join(directory, name + (".npz" if format == "npz" else ""))

@@ -1,5 +1,7 @@
 """EM trainer: oracle parity, monotonicity, convergence, checkpoints, backends."""
 
+import os
+
 import numpy as np
 import pytest
 
@@ -137,3 +139,55 @@ def test_long_chunk_loglik_monotone_rescaled(rng):
     )
     lls = res.logliks
     assert all(b >= a - 1e-2 for a, b in zip(lls, lls[1:])), lls
+
+
+def test_orbax_checkpoint_roundtrip_and_resume(tmp_path, rng):
+    """Orbax-format checkpoints: save per iteration, auto-detected load,
+    resume over a directory of orbax snapshots (SURVEY.md §5)."""
+    from cpgisland_tpu.utils import checkpoint as ckpt
+
+    params = presets.durbin_cpg8()
+    syms = rng.integers(0, 4, size=8 * 512).astype(np.uint8)
+    ck = chunking.frame(syms, 512)
+    res = baum_welch.fit(
+        params, ck, num_iters=2, convergence=0.0,
+        checkpoint_dir=str(tmp_path), checkpoint_format="orbax",
+    )
+    path = ckpt.latest(str(tmp_path))
+    assert path is not None and os.path.isdir(path)  # orbax = directory
+    state = ckpt.load(path)
+    assert state.iteration == 2
+    np.testing.assert_allclose(np.asarray(state.params.A), np.asarray(res.params.A), atol=1e-6)
+    assert state.logliks == pytest.approx(res.logliks)
+
+    res2 = baum_welch.resume(str(tmp_path), ck, num_iters=4, convergence=0.0)
+    assert res2.iterations == 4
+    assert len(res2.logliks) == 4
+
+
+def test_latest_prefers_highest_across_formats(tmp_path):
+    from cpgisland_tpu.utils import checkpoint as ckpt
+
+    params = presets.durbin_cpg8()
+    ckpt.save(ckpt.checkpoint_path(str(tmp_path), 1), ckpt.TrainState(params, 1, [-5.0]))
+    ckpt.save(
+        ckpt.checkpoint_path(str(tmp_path), 2, format="orbax"),
+        ckpt.TrainState(params, 2, [-5.0, -4.0]),
+        format="orbax",
+    )
+    assert ckpt.load(ckpt.latest(str(tmp_path))).iteration == 2
+
+
+def test_resume_preserves_orbax_format(tmp_path, rng):
+    from cpgisland_tpu.utils import checkpoint as ckpt
+
+    params = presets.durbin_cpg8()
+    ck = chunking.frame(rng.integers(0, 4, size=4 * 512).astype(np.uint8), 512)
+    baum_welch.fit(params, ck, num_iters=1, convergence=0.0,
+                   checkpoint_dir=str(tmp_path), checkpoint_format="orbax")
+    baum_welch.resume(str(tmp_path), ck, num_iters=2, convergence=0.0)
+    latest = ckpt.latest(str(tmp_path))
+    assert os.path.isdir(latest)  # iteration 2 written in orbax, not npz
+    with pytest.raises(ValueError, match="checkpoint_format"):
+        baum_welch.fit(params, ck, num_iters=1, checkpoint_dir=str(tmp_path),
+                       checkpoint_format="orbx")
