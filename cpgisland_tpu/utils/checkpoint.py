@@ -90,6 +90,9 @@ def load(path: str) -> TrainState:
         import orbax.checkpoint as ocp
 
         with ocp.StandardCheckpointer() as ckptr:
+            # Target-less restore: orbax logs an unsafe-topology warning, but
+            # these are host-only numpy trees whose shapes _state_from_tree
+            # validates implicitly (from_probs checks pi/A/B consistency).
             return _state_from_tree(ckptr.restore(os.path.abspath(path)))
     with np.load(path) as z:
         return _state_from_tree(z)
