@@ -6,10 +6,12 @@ that leaves the VPU lanes mostly idle.  These kernels put the chunk batch on
 the 128-wide lane dimension (one chunk per lane, like ops.viterbi_pallas) and
 fuse the per-step emission select, normalize, and statistics accumulation:
 
-- **forward kernel** — per t-tile: alpha recurrence with Rabiner per-step
-  rescaling; streams alphas [T, K, lanes] and normalizers [T, lanes] to HBM
-  (36 B/symbol — far under HBM bandwidth at these op intensities; no
-  checkpoint/recompute needed at K=8).
+- **forward kernel** — per t-tile: alpha recurrence with DEFERRED Rabiner
+  rescaling (stored v_t = alpha-hat_t * c_t; each step divides by the
+  previous step's sum, so the sum computes off the sequential critical
+  path); streams only the v's [T, K, lanes] to HBM (32 B/symbol — far under
+  HBM bandwidth at these op intensities; no checkpoint/recompute needed at
+  K=8).  The scale factors come back as time-parallel row sums in JAX.
 - **backward kernel** — walks t-tiles in reverse (reversed index_map),
   storing ONLY the scaled beta vectors; per-tile boundary values
   (o_{t+1}, c_{t+1}) carry through scratch.  The [K,K]/[K,S] expected-count
@@ -69,43 +71,44 @@ def _emit_sel_cols(B, syms, K):
 ROW_TILE = 8  # sublane count of an (8, 128) f32/i32 VMEM tile
 
 
-def _fwd_kernel(steps_ref, lens_ref, alpha0_ref, c0_ref, A_ref, B_ref,
-                alphas_ref, cs_ref, carry_ref, *, K, S, Tt):
+def _fwd_kernel(steps_ref, lens_ref, alpha0raw_ref, A_ref, B_ref,
+                alphas_ref, carry_ref, *, K, S, Tt):
     # Row-tiled walk: dynamic sublane offsets into (8,128)-tiled VMEM must be
     # 8-aligned for Mosaic's fast path (see the ROW_TILE note in
-    # viterbi_pallas.py), so steps/cs move as aligned [8, lt] tiles with the
+    # viterbi_pallas.py), so steps move as aligned [8, lt] tiles with the
     # per-row recurrence unrolled — the per-step misaligned row load/store
     # was >3x the arithmetic cost of the recurrence itself.
+    #
+    # Deferred normalization: the stored value is v_t = raw_t / sum(v_{t-1}),
+    # i.e. alpha-hat_t SCALED BY the Rabiner factor c_t (v_0 = pi*B[:,o_0]
+    # unnormalized, so sum(v_0) = c_0; inductively sum(v_t) = c_t).  Values
+    # stay O(1), the JAX assembly recovers cs as plain row sums, and the
+    # step's own sum leaves the sequential dependency chain: 1/sum(v_{t-1})
+    # computes concurrently with step t's multiply-add tree instead of
+    # serializing normalize -> next step.
     j = pl.program_id(1)
-    lt = steps_ref.shape[1]
     A = A_ref[:, :]
     B = B_ref[:, :]
     lens = lens_ref[0, :]
-    alpha_in = jnp.where(j == 0, alpha0_ref[:, :], carry_ref[:, :])
+    v_in = jnp.where(j == 0, alpha0raw_ref[:, :], carry_ref[:, :])
 
-    def body(tile_i, alpha):
+    def body(tile_i, v):
         base = tile_i * ROW_TILE
         o_tile = steps_ref[pl.ds(base, ROW_TILE), :]  # aligned [8, lt]
-        cs_rows = []
         for r in range(ROW_TILE):
             t = j * Tt + base + r
             o_t = o_tile[r, :]
             v_t = t < lens
-            raw = jnp.sum(alpha[:, None, :] * A[:, :, None], axis=0) * _emit_sel(B, o_t, K, S)
-            c = jnp.sum(raw, axis=0)
-            new = raw / c
-            new = jnp.where(v_t[None, :], new, alpha)
-            c = jnp.where(v_t, c, 1.0)
-            # t == 0 has no incoming transition: its (alpha, c) are precomputed.
-            new = jnp.where(t == 0, alpha0_ref[:, :], new)
-            c = jnp.where(t == 0, c0_ref[0, :], c)
+            raw = jnp.sum(v[:, None, :] * A[:, :, None], axis=0) * _emit_sel(B, o_t, K, S)
+            new = raw * (1.0 / jnp.sum(v, axis=0))
+            new = jnp.where(v_t[None, :], new, v)
+            # t == 0 has no incoming transition: v_0 is the precomputed init.
+            new = jnp.where(t == 0, alpha0raw_ref[:, :], new)
             alphas_ref[base + r, :, :] = new  # [K, lt] = one full tile row
-            cs_rows.append(c)
-            alpha = new
-        cs_ref[pl.ds(base, ROW_TILE), :] = jnp.stack(cs_rows, axis=0)
-        return alpha
+            v = new
+        return v
 
-    carry_ref[:, :] = jax.lax.fori_loop(0, Tt // ROW_TILE, body, alpha_in)
+    carry_ref[:, :] = jax.lax.fori_loop(0, Tt // ROW_TILE, body, v_in)
 
 
 def _bwd_kernel(steps_ref, lens_ref, A_ref, B_ref, cs_ref,
@@ -205,11 +208,10 @@ def batch_stats_pallas(
     lens2 = _pad_axis(lengths[None, :], NL, 1, 0)  # [1, NL]
     valid0 = lens2[0] > 0  # [NL]
 
-    # alpha0 in JAX (one position; the kernels handle t >= 1).
+    # v_0 in JAX (one position, UNnormalized so sum(v_0) = c_0; the kernel
+    # handles t >= 1 with deferred normalization — see _fwd_kernel).
     B0 = _emit_sel(B, steps2[0, :], K, S)  # [K, NL]
     a0_raw = jnp.where(valid0[None, :], pi[:, None] * B0, jnp.ones((K, NL)) / K)
-    c0 = jnp.sum(a0_raw, axis=0)
-    alpha0 = a0_raw / c0
 
     n_lt = NL // LANE_TILE
     grid = (n_lt, n_t)
@@ -220,21 +222,24 @@ def batch_stats_pallas(
     klane_spec = _vspec((K, LANE_TILE), lambda i, j: (0, i))
     step_spec = _vspec((Tt, LANE_TILE), lambda i, j: (j, i))
 
-    alphas, cs = pl.pallas_call(
+    (alphas,) = pl.pallas_call(
         functools.partial(_fwd_kernel, K=K, S=S, Tt=Tt),
         grid=grid,
-        in_specs=[step_spec, lane_spec, klane_spec, lane_spec, mat_spec, emitmat_spec],
+        in_specs=[step_spec, lane_spec, klane_spec, mat_spec, emitmat_spec],
         out_specs=[
             _vspec((Tt, K, LANE_TILE), lambda i, j: (j, 0, i)),
-            step_spec,
         ],
         out_shape=[
             jax.ShapeDtypeStruct((Tp, K, NL), jnp.float32),
-            jax.ShapeDtypeStruct((Tp, NL), jnp.float32),
         ],
         scratch_shapes=[pltpu.VMEM((K, LANE_TILE), jnp.float32)],
         interpret=interpret,
-    )(steps2, lens2, alpha0, c0[None, :], A, B)
+    )(steps2, lens2, a0_raw, A, B)
+
+    # The stored v_t = alpha-hat_t * c_t, so the Rabiner scale factors are
+    # plain (time-parallel) row sums — they never sat on the kernel's
+    # sequential critical path.
+    cs = jnp.sum(alphas, axis=1)  # [Tp, NL]
 
     # Reversed t-walk: input/output t-blocks indexed by (n_t-1-j).
     rev_step_spec = _vspec((Tt, LANE_TILE), lambda i, j: (n_t - 1 - j, i))
@@ -282,12 +287,16 @@ def batch_stats_pallas(
         axis=1,
     )  # [K, S]
 
-    # xi(pair t-1 -> t) = alpha_{t-1} (x) (B[:,o_t] * beta_t / c_t) elementwise A:
-    # summing the outer products over (t, lane) is one [K, T*N] x [T*N, K] dot.
-    # Shifted SLICES (not a concatenated copy) — position 0 has no incoming
-    # transition, so pairs are (alphas[t-1], w[t]) for t >= 1 masked by v_t.
+    # xi(pair t-1 -> t) = alpha-hat_{t-1} (x) (B[:,o_t] * beta_t / c_t)
+    # elementwise A: summing the outer products over (t, lane) is one
+    # [K, T*N] x [T*N, K] dot.  Shifted SLICES (not a concatenated copy) —
+    # position 0 has no incoming transition, so pairs are (t-1, t) for t >= 1
+    # masked by v_t.  The stored v's carry a c_t scale, so a_prev divides it
+    # back out (w's own /c_t is the formula's, not a descaling).
     w = _emit_sel_cols(B, steps2, K) * betas / cs[:, None, :]  # [Tp, K, NL]
-    a_prev = jnp.where(vmask[1:, None, :], alphas[:-1], 0.0)
+    a_prev = jnp.where(
+        vmask[1:, None, :], alphas[:-1] / cs[:-1, None, :], 0.0
+    )
     trans = A * jnp.einsum("tin,tjn->ij", a_prev, w[1:], precision=jax.lax.Precision.HIGHEST)
 
     init_l = jnp.where(valid0[None, :], gamma[0], 0.0)  # [K, NL]
