@@ -104,6 +104,27 @@ class HmmParams:
                 raise ValueError(f"{name} rows not stochastic: sums={row_sums}")
 
 
+def sample_sequence(params: HmmParams, key, length: int):
+    """Generate (states [T], observations [T]) from the model.
+
+    The generative twin of decoding (Mahout's HmmEvaluator exposes the same
+    pair of operations; the reference driver only ever decodes,
+    CpGIslandFinder.java:260).  Used for synthetic-genome fixtures and
+    planted-island recovery tests.
+    """
+    k_init, k_scan = jax.random.split(key)
+    s0 = jax.random.categorical(k_init, params.log_pi)
+
+    def step(state, k):
+        k_trans, k_emit = jax.random.split(k)
+        obs = jax.random.categorical(k_emit, params.log_B[state])
+        nxt = jax.random.categorical(k_trans, params.log_A[state])
+        return nxt, (state, obs)
+
+    _, (states, obs) = jax.lax.scan(step, s0, jax.random.split(k_scan, length))
+    return states.astype(jnp.int32), obs.astype(jnp.uint8)
+
+
 def dump_text(params: HmmParams, fp: Union[str, IO[str]]) -> None:
     """Write the reference's plain-text model dump.
 

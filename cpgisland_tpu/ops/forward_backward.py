@@ -175,12 +175,14 @@ def _chunk_stats_log(params, obs, length):
     return jax.tree_util.tree_map(lambda z, g: jnp.where(nonempty, g, z), zero, got)
 
 
-def _chunk_stats_rescaled(params, obs, length):
-    """Rabiner per-step rescaling in probability space (reference numerics,
-    CpGIslandFinder.java:92 'rescaling')."""
-    K, M = params.n_states, params.n_symbols
-    obs_c, valid = _masks(params, obs, length)
-    T = obs_c.shape[0]
+def _rescaled_forward(params, obs_c, valid):
+    """Shared Rabiner-rescaled forward pass: (alphas [T,K], cs [T]).
+
+    Pad steps (valid False) are identity (alpha pass-through, c = 1).  The
+    single copy of the alpha recurrence — the E-step and the posterior
+    entry points both scan through here.
+    """
+    K = params.n_states
     A = jnp.exp(params.log_A)
     B_t = jnp.exp(params.log_B).T  # [M, K]
     pi = jnp.exp(params.log_pi)
@@ -201,12 +203,26 @@ def _chunk_stats_rescaled(params, obs, length):
         c = jnp.where(v_t, c, 1.0)
         return new, (new, c)
 
-    alphaT, (alphas_tail, cs_tail) = jax.lax.scan(fstep, alpha0, (obs_c[1:], valid[1:]))
+    _, (alphas_tail, cs_tail) = jax.lax.scan(fstep, alpha0, (obs_c[1:], valid[1:]))
     alphas = jnp.concatenate([alpha0[None], alphas_tail])
     cs = jnp.concatenate([c0[None], cs_tail])  # [T]
+    return alphas, cs
+
+
+def _chunk_stats_rescaled(params, obs, length):
+    """Rabiner per-step rescaling in probability space (reference numerics,
+    CpGIslandFinder.java:92 'rescaling')."""
+    K, M = params.n_states, params.n_symbols
+    obs_c, valid = _masks(params, obs, length)
+    T = obs_c.shape[0]
+    A = jnp.exp(params.log_A)
+    B_t = jnp.exp(params.log_B).T  # [M, K]
+
+    alphas, cs = _rescaled_forward(params, obs_c, valid)
+    alphaT = alphas[-1]
     loglik = jnp.sum(jnp.where(valid, jnp.log(cs), 0.0))
 
-    zK = alpha0 * 0.0
+    zK = alphas[0] * 0.0
     beta_T = zK + 1.0
 
     def bstep(carry, inp):
@@ -236,7 +252,7 @@ def _chunk_stats_rescaled(params, obs, length):
     gamma_last = alphaT / jnp.maximum(jnp.sum(alphaT), 1e-30)
     emit = emit + (length == T) * gamma_last[:, None] * jax.nn.one_hot(obs_c[T - 1], M)
 
-    gamma0 = alpha0 * beta_0
+    gamma0 = alphas[0] * beta_0
     gamma0 = gamma0 / jnp.maximum(jnp.sum(gamma0), 1e-30)
 
     nonempty = length > 0
@@ -245,6 +261,57 @@ def _chunk_stats_rescaled(params, obs, length):
         init=gamma0, trans=trans, emit=emit, loglik=loglik, n_seqs=jnp.ones((), jnp.int32)
     )
     return jax.tree_util.tree_map(lambda z, g: jnp.where(nonempty, g, z), zero, got)
+
+
+@jax.jit
+def posterior_marginals(params: HmmParams, obs: jnp.ndarray, length=None):
+    """Per-position state posteriors gamma[t, k] = P(s_t = k | o_0..o_{T-1}).
+
+    The reference's Mahout dependency exposes only Viterbi
+    (HmmEvaluator.decode, CpGIslandFinder.java:260); posteriors are the
+    soft-decoding completion of that surface — argmax(gamma) is
+    max-posterior-marginal decoding, and gamma itself gives per-position
+    island confidence.  Rescaled numerics, the SAME forward recurrence as
+    the E-step (_rescaled_forward).  ``length`` masks a padded tail exactly
+    like chunk_stats (gamma rows there are 0); omitted = all T positions
+    real.  Returns (gamma [T, K], loglik).
+    """
+    K = params.n_states
+    T = obs.shape[0]
+    if length is None:
+        length = T
+    obs_c, valid = _masks(params, obs, length)
+    A = jnp.exp(params.log_A)
+    B_t = jnp.exp(params.log_B).T  # [M, K]
+
+    alphas, cs = _rescaled_forward(params, obs_c, valid)
+    loglik = jnp.sum(jnp.where(valid, jnp.log(cs), 0.0))
+
+    def bstep(beta_next, inp):
+        o_next, v_next, c_next = inp
+        beta = jnp.matmul(A, B_t[o_next] * beta_next, precision=jax.lax.Precision.HIGHEST)
+        beta = beta / c_next
+        return jnp.where(v_next, beta, beta_next), None
+
+    # Emit beta BEFORE each reverse step so betas[t] pairs with alphas[t];
+    # pad steps pass through, leaving beta = 1 at the last valid position.
+    def bstep_emit(beta_next, inp):
+        new, _ = bstep(beta_next, inp)
+        return new, new
+
+    _, betas_front = jax.lax.scan(
+        bstep_emit, jnp.ones(K), (obs_c[1:], valid[1:], cs[1:]), reverse=True
+    )
+    betas = jnp.concatenate([betas_front, jnp.ones((1, K))])
+    graw = alphas * betas
+    gamma = graw / jnp.maximum(jnp.sum(graw, axis=-1, keepdims=True), 1e-30)
+    return jnp.where(valid[:, None], gamma, 0.0), loglik
+
+
+def posterior_decode(params: HmmParams, obs: jnp.ndarray, length=None) -> jnp.ndarray:
+    """Max-posterior-marginal state path: argmax_k gamma[t, k] per position."""
+    gamma, _ = posterior_marginals(params, obs, length)
+    return jnp.argmax(gamma, axis=-1).astype(jnp.int32)
 
 
 @partial(jax.jit, static_argnames=("mode",))

@@ -102,3 +102,58 @@ def test_one_hot_emissions_are_fixed_point(rng):
     emit = np.asarray(st.emit)
     B = np.asarray(params.B)
     assert (emit[B == 0] == 0).all()
+
+
+def test_posterior_marginals_match_oracle(rng):
+    from cpgisland_tpu.ops.forward_backward import posterior_decode, posterior_marginals
+
+    pi = rng.dirichlet(np.ones(3))
+    A = rng.dirichlet(np.ones(3), size=3)
+    B = rng.dirichlet(np.ones(4), size=3)
+    params = HmmParams.from_probs(pi, A, B)
+    obs = rng.integers(0, 4, size=400).astype(np.uint8)
+    gamma_o, _, ll_o = oracle.forward_backward_oracle(pi, A, B, obs)
+    gamma, ll = posterior_marginals(params, jnp.asarray(obs))
+    np.testing.assert_allclose(np.asarray(gamma), gamma_o, atol=1e-5)
+    assert float(ll) == pytest.approx(ll_o, abs=1e-3)
+    path = np.asarray(posterior_decode(params, jnp.asarray(obs)))
+    np.testing.assert_array_equal(path, np.argmax(gamma_o, axis=1))
+
+
+def test_sample_sequence_statistics(rng):
+    import jax
+
+    from cpgisland_tpu.models.hmm import sample_sequence
+    from cpgisland_tpu.models import presets
+
+    params = presets.durbin_cpg8()
+    states, obs = sample_sequence(params, jax.random.PRNGKey(0), 50000)
+    assert states.shape == obs.shape == (50000,)
+    # one-hot emissions: observation == state % 4 always
+    np.testing.assert_array_equal(np.asarray(obs), np.asarray(states) % 4)
+    # empirical transition rows approximate A for visited states
+    s = np.asarray(states)
+    A = np.asarray(params.A)
+    for i in range(8):
+        idx = np.flatnonzero(s[:-1] == i)
+        if idx.size > 1000:
+            emp = np.bincount(s[idx + 1], minlength=8) / idx.size
+            np.testing.assert_allclose(emp, A[i], atol=0.05)
+
+
+def test_posterior_marginals_padded_tail(rng):
+    """length masks a padded tail: gamma rows beyond it are 0 and the valid
+    prefix matches the unpadded computation."""
+    from cpgisland_tpu.ops.forward_backward import posterior_marginals
+
+    pi = rng.dirichlet(np.ones(3))
+    A = rng.dirichlet(np.ones(3), size=3)
+    B = rng.dirichlet(np.ones(4), size=3)
+    params = HmmParams.from_probs(pi, A, B)
+    obs = rng.integers(0, 4, size=300).astype(np.uint8)
+    padded = np.concatenate([obs, np.full(50, 4, np.uint8)])  # PAD sentinel tail
+    g_plain, ll_plain = posterior_marginals(params, jnp.asarray(obs))
+    g_pad, ll_pad = posterior_marginals(params, jnp.asarray(padded), length=300)
+    np.testing.assert_allclose(np.asarray(g_pad[:300]), np.asarray(g_plain), atol=1e-6)
+    np.testing.assert_array_equal(np.asarray(g_pad[300:]), 0.0)
+    assert float(ll_pad) == pytest.approx(float(ll_plain), abs=1e-3)
