@@ -111,7 +111,52 @@ def _fwd_kernel(steps_ref, lens_ref, alpha0raw_ref, A_ref, B_ref,
     carry_ref[:, :] = jax.lax.fori_loop(0, Tt // ROW_TILE, body, v_in)
 
 
-def _bwd_kernel(steps_ref, lens_ref, A_ref, B_ref, cs_ref,
+def _prod_kernel(steps_ref, A_ref, B_ref, out_ref, *, K, S, bk):
+    """(+,x) product of each lane's bk step matrices -> [K*K, LT], normalized.
+
+    The probability-space twin of viterbi_pallas._products_kernel: C carried
+    as a tuple of K rank-2 rows (C[i] is [K, LT], row i of the product — the
+    Mosaic rank-2 constraint, see _emit_sel there).  Products shrink ~e^-1.3
+    per step, so every ROW_TILE steps the whole matrix renormalizes by one
+    per-lane scalar (relative row scales preserved); only DIRECTIONS leave
+    this kernel — the boundary-message consumers renormalize anyway.
+    """
+    lt = steps_ref.shape[1]
+    A = A_ref[:, :]
+    B = B_ref[:, :]
+    C0 = tuple(
+        jnp.broadcast_to((jnp.arange(K) == i).astype(jnp.float32)[:, None], (K, lt))
+        for i in range(K)
+    )
+
+    def body(c, C):
+        tile = steps_ref[pl.ds(c * ROW_TILE, ROW_TILE), :]  # aligned [8, LT]
+        for r in range(ROW_TILE):
+            syms = tile[r : r + 1, :]  # [1, LT]
+            is_pad = syms >= S
+            Bsel = _emit_sel(B, syms[0, :], K, S)  # [K, LT]
+            # M_m[j, lane] = A[m, j] * B[j, sym]; identity column for PAD.
+            Ms = tuple(
+                jnp.where(
+                    is_pad,
+                    (jnp.arange(K) == m).astype(jnp.float32)[:, None],
+                    A[m : m + 1, :].T * Bsel,
+                )
+                for m in range(K)
+            )
+            C = tuple(
+                sum(Ci[m : m + 1, :] * Ms[m] for m in range(K)) for Ci in C
+            )
+        tot = sum(jnp.sum(Ci, axis=0, keepdims=True) for Ci in C)  # [1, LT]
+        inv = 1.0 / jnp.maximum(tot, 1e-30)
+        return tuple(Ci * inv for Ci in C)
+
+    C = jax.lax.fori_loop(0, bk // ROW_TILE, body, C0)
+    for i in range(K):
+        out_ref[i * K : (i + 1) * K, :] = C[i]
+
+
+def _bwd_kernel(steps_ref, lens_ref, A_ref, B_ref, cs_ref, beta0_ref,
                 betas_ref,
                 beta_scr, onext_scr, cnext_scr,
                 *, K, S, Tt, T):
@@ -133,7 +178,9 @@ def _bwd_kernel(steps_ref, lens_ref, A_ref, B_ref, cs_ref,
 
     @pl.when(j == 0)
     def _init():
-        beta_scr[:, :] = jnp.ones((K, lt), jnp.float32)
+        # Per-lane entering beta: ones for independent chunks, the suffix
+        # boundary message for lanes continuing a longer sequence.
+        beta_scr[:, :] = beta0_ref[:, :]
         onext_scr[0, :] = jnp.zeros((lt,), jnp.int32)
         cnext_scr[0, :] = jnp.ones((lt,), jnp.float32)
 
@@ -162,6 +209,93 @@ def _bwd_kernel(steps_ref, lens_ref, A_ref, B_ref, cs_ref,
     beta_scr[:, :] = beta
     onext_scr[0, :] = steps_ref[0, :]
     cnext_scr[0, :] = cs_ref[0, :]
+
+
+def _run_fb_kernels(A, B, steps2, lens2, a0_raw, beta0, K, S, Tt, T):
+    """The forward + backward kernel pair over a [Tp, NL] lane layout.
+
+    a0_raw: [K, NL] per-lane UNnormalized v_0 (sum = that position's c);
+    beta0: [K, NL] per-lane entering beta (ones for independent chunks,
+    suffix boundary messages for lanes of one long sequence).
+    Returns (alphas [Tp,K,NL] with v_t = alpha-hat_t * c_t, cs [Tp,NL],
+    betas [Tp,K,NL]).
+    """
+    Tp, NL = steps2.shape
+    n_t = Tp // Tt
+    n_lt = NL // LANE_TILE
+    grid = (n_lt, n_t)
+    interpret = _interpret()
+    mat_spec = _vspec((K, K), lambda i, j: (0, 0))
+    emitmat_spec = _vspec((K, S), lambda i, j: (0, 0))
+    lane_spec = _vspec((1, LANE_TILE), lambda i, j: (0, i))
+    klane_spec = _vspec((K, LANE_TILE), lambda i, j: (0, i))
+    step_spec = _vspec((Tt, LANE_TILE), lambda i, j: (j, i))
+
+    (alphas,) = pl.pallas_call(
+        functools.partial(_fwd_kernel, K=K, S=S, Tt=Tt),
+        grid=grid,
+        in_specs=[step_spec, lane_spec, klane_spec, mat_spec, emitmat_spec],
+        out_specs=[
+            _vspec((Tt, K, LANE_TILE), lambda i, j: (j, 0, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((Tp, K, NL), jnp.float32),
+        ],
+        scratch_shapes=[pltpu.VMEM((K, LANE_TILE), jnp.float32)],
+        interpret=interpret,
+    )(steps2, lens2, a0_raw, A, B)
+
+    # The stored v_t = alpha-hat_t * c_t, so the Rabiner scale factors are
+    # plain (time-parallel) row sums — they never sat on the kernel's
+    # sequential critical path.
+    cs = jnp.sum(alphas, axis=1)  # [Tp, NL]
+
+    # Reversed t-walk: input/output t-blocks indexed by (n_t-1-j).
+    rev_step_spec = _vspec((Tt, LANE_TILE), lambda i, j: (n_t - 1 - j, i))
+    (betas,) = pl.pallas_call(
+        functools.partial(_bwd_kernel, K=K, S=S, Tt=Tt, T=T),
+        grid=grid,
+        in_specs=[
+            rev_step_spec,
+            lane_spec,
+            mat_spec,
+            emitmat_spec,
+            rev_step_spec,
+            klane_spec,
+        ],
+        out_specs=[
+            _vspec((Tt, K, LANE_TILE), lambda i, j: (n_t - 1 - j, 0, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((Tp, K, NL), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((K, LANE_TILE), jnp.float32),
+            pltpu.VMEM((1, LANE_TILE), jnp.int32),
+            pltpu.VMEM((1, LANE_TILE), jnp.float32),
+        ],
+        interpret=interpret,
+    )(steps2, lens2, A, B, cs, beta0)
+    return alphas, cs, betas
+
+
+def _gamma_emit_loglik(alphas, betas, cs, steps2, vmask, S):
+    """Shared time-parallel assembly: (gamma, emit, loglik) from the streams.
+
+    gamma_t = normalize(alpha_t * beta_t) at every valid position (the
+    stored beta at the last valid position is exactly the entering-beta /
+    ones init passed through, so no tail special-casing); emit is S masked
+    sums; loglik sums log of the recovered Rabiner factors.
+    """
+    loglik = jnp.sum(jnp.where(vmask, jnp.log(jnp.maximum(cs, 1e-30)), 0.0))
+    graw = alphas * betas  # [Tp, K, NL]
+    gamma = graw / jnp.maximum(jnp.sum(graw, axis=1, keepdims=True), 1e-30)
+    gamma = jnp.where(vmask[:, None, :], gamma, 0.0)
+    emit = jnp.stack(
+        [jnp.sum(gamma * (steps2 == s)[:, None, :], axis=(0, 2)) for s in range(S)],
+        axis=1,
+    )  # [K, S]
+    return gamma, emit, loglik
 
 
 def _pad_axis(x, size, axis, fill):
@@ -213,59 +347,8 @@ def batch_stats_pallas(
     B0 = _emit_sel(B, steps2[0, :], K, S)  # [K, NL]
     a0_raw = jnp.where(valid0[None, :], pi[:, None] * B0, jnp.ones((K, NL)) / K)
 
-    n_lt = NL // LANE_TILE
-    grid = (n_lt, n_t)
-    interpret = _interpret()
-    mat_spec = _vspec((K, K), lambda i, j: (0, 0))
-    emitmat_spec = _vspec((K, S), lambda i, j: (0, 0))
-    lane_spec = _vspec((1, LANE_TILE), lambda i, j: (0, i))
-    klane_spec = _vspec((K, LANE_TILE), lambda i, j: (0, i))
-    step_spec = _vspec((Tt, LANE_TILE), lambda i, j: (j, i))
-
-    (alphas,) = pl.pallas_call(
-        functools.partial(_fwd_kernel, K=K, S=S, Tt=Tt),
-        grid=grid,
-        in_specs=[step_spec, lane_spec, klane_spec, mat_spec, emitmat_spec],
-        out_specs=[
-            _vspec((Tt, K, LANE_TILE), lambda i, j: (j, 0, i)),
-        ],
-        out_shape=[
-            jax.ShapeDtypeStruct((Tp, K, NL), jnp.float32),
-        ],
-        scratch_shapes=[pltpu.VMEM((K, LANE_TILE), jnp.float32)],
-        interpret=interpret,
-    )(steps2, lens2, a0_raw, A, B)
-
-    # The stored v_t = alpha-hat_t * c_t, so the Rabiner scale factors are
-    # plain (time-parallel) row sums — they never sat on the kernel's
-    # sequential critical path.
-    cs = jnp.sum(alphas, axis=1)  # [Tp, NL]
-
-    # Reversed t-walk: input/output t-blocks indexed by (n_t-1-j).
-    rev_step_spec = _vspec((Tt, LANE_TILE), lambda i, j: (n_t - 1 - j, i))
-    (betas,) = pl.pallas_call(
-        functools.partial(_bwd_kernel, K=K, S=S, Tt=Tt, T=T),
-        grid=grid,
-        in_specs=[
-            rev_step_spec,
-            lane_spec,
-            mat_spec,
-            emitmat_spec,
-            rev_step_spec,
-        ],
-        out_specs=[
-            _vspec((Tt, K, LANE_TILE), lambda i, j: (n_t - 1 - j, 0, i)),
-        ],
-        out_shape=[
-            jax.ShapeDtypeStruct((Tp, K, NL), jnp.float32),
-        ],
-        scratch_shapes=[
-            pltpu.VMEM((K, LANE_TILE), jnp.float32),
-            pltpu.VMEM((1, LANE_TILE), jnp.int32),
-            pltpu.VMEM((1, LANE_TILE), jnp.float32),
-        ],
-        interpret=interpret,
-    )(steps2, lens2, A, B, cs)
+    beta0 = jnp.ones((K, NL), jnp.float32)  # independent chunks end free
+    alphas, cs, betas = _run_fb_kernels(A, B, steps2, lens2, a0_raw, beta0, K, S, Tt, T)
 
     # Count-tensor assembly: TIME-PARALLEL contractions over the streamed
     # alphas/betas — the expensive per-step outer products the old backward
@@ -273,19 +356,7 @@ def batch_stats_pallas(
     # that XLA batches over all (t, lane) at once.
     tmask = jnp.arange(Tp)[:, None] < lens2  # [Tp, NL]
     vmask = tmask & valid0[None, :]
-    loglik = jnp.sum(jnp.where(vmask, jnp.log(cs), 0.0))
-
-    # gamma_t = normalize(alpha_t * beta_t) at every valid position; the
-    # stored beta at the last valid position is exactly 1 (pass-through from
-    # the init), so position length-1's emission needs no special casing.
-    graw = alphas * betas  # [Tp, K, NL]
-    gamma = graw / jnp.maximum(jnp.sum(graw, axis=1, keepdims=True), 1e-30)
-    gamma = jnp.where(vmask[:, None, :], gamma, 0.0)
-
-    emit = jnp.stack(
-        [jnp.sum(gamma * (steps2 == s)[:, None, :], axis=(0, 2)) for s in range(S)],
-        axis=1,
-    )  # [K, S]
+    gamma, emit, loglik = _gamma_emit_loglik(alphas, betas, cs, steps2, vmask, S)
 
     # xi(pair t-1 -> t) = alpha-hat_{t-1} (x) (B[:,o_t] * beta_t / c_t)
     # elementwise A: summing the outer products over (t, lane) is one
@@ -307,4 +378,142 @@ def batch_stats_pallas(
         emit=emit,
         loglik=loglik,
         n_seqs=jnp.sum(valid0.astype(jnp.int32)),
+    )
+
+
+def _norm_rows(v):
+    return v / jnp.maximum(jnp.sum(v, axis=-1, keepdims=True), 1e-30)
+
+
+@functools.partial(jax.jit, static_argnames=("lane_T", "t_tile"))
+def seq_stats_pallas(
+    params: HmmParams,
+    obs: jnp.ndarray,
+    length,
+    lane_T: int = 8192,  # swept on v5e: 4096 -> 126, 8192 -> ~170 Msym/s, 16384 exceeds VMEM
+    t_tile: int = DEFAULT_T_TILE,
+) -> SuffStats:
+    """EXACT whole-sequence statistics on one device via the fused kernels.
+
+    The sequence splits into lanes of ``lane_T``; the (+,x) products kernel
+    computes each lane's [K, K] transfer operator, an associative scan turns
+    those into every lane's exact entering-alpha / exiting-beta boundary
+    message (directions — scales are reconstructed scale-free below), and
+    the same forward/backward kernels as the chunked E-step run with those
+    messages instead of pi/ones.  Statistics equal
+    parallel.fb_sharded.seq_stats_sharded (no chunk-independence
+    approximation) at fused-kernel speed.
+
+    Working set is ~64 B/symbol of HBM (alphas, betas, and two assembly
+    tensors), so per-device sequences up to ~50 M symbols are comfortable —
+    chromosome shards on a pod; longer single-device inputs should use the
+    chunked path or a mesh.
+    """
+    K, S = params.n_states, params.n_symbols
+    A = jnp.exp(params.log_A).astype(jnp.float32)
+    B = jnp.exp(params.log_B).astype(jnp.float32)
+    pi = jnp.exp(params.log_pi).astype(jnp.float32)
+
+    T = obs.shape[0]
+    length = jnp.asarray(length, jnp.int32)
+    nb = -(-T // lane_T)
+    NL = -(-nb // LANE_TILE) * LANE_TILE
+    Tp_all = NL * lane_T
+
+    if lane_T % ROW_TILE:
+        raise ValueError(f"lane_T={lane_T} must be a multiple of {ROW_TILE}")
+    valid_flat = jnp.arange(T) < length
+    obs_flat = jnp.where(valid_flat, jnp.minimum(obs.astype(jnp.int32), S - 1), 0)
+    # PAD (== S) marks invalid steps for the products kernel (identity).
+    # Global position 0 is ALSO padded out there: its step is the init
+    # (a0_dir already contains pi * B[:, o_0]), so lane 0's transfer product
+    # must cover steps 1.. only — including M_0 would double-apply it.
+    sel_flat = jnp.where(valid_flat, obs_flat, S).at[0].set(S)
+    pad = Tp_all - T
+    obs_l = jnp.pad(obs_flat, (0, pad)).reshape(NL, lane_T)
+    sel_l = jnp.pad(sel_flat, (0, pad), constant_values=S).reshape(NL, lane_T)
+    lane_lens = jnp.clip(length - jnp.arange(NL) * lane_T, 0, lane_T)
+
+    # --- lane transfer operators (pallas) -> boundary messages (XLA) ------
+    n_lt = NL // LANE_TILE
+    (prod_flat,) = pl.pallas_call(
+        functools.partial(_prod_kernel, K=K, S=S, bk=lane_T),
+        grid=(n_lt,),
+        in_specs=[
+            _vspec((lane_T, LANE_TILE), lambda i: (0, i)),
+            _vspec((K, K), lambda i: (0, 0)),
+            _vspec((K, S), lambda i: (0, 0)),
+        ],
+        out_specs=[_vspec((K * K, LANE_TILE), lambda i: (0, i))],
+        out_shape=[jax.ShapeDtypeStruct((K * K, NL), jnp.float32)],
+        interpret=_interpret(),
+    )(sel_l.T, A, B)
+    P = prod_flat.T.reshape(NL, K, K)  # P[lane, i, m]
+
+    def combine(a, b):
+        m = jnp.einsum("...ij,...jk->...ik", a, b, precision=jax.lax.Precision.HIGHEST)
+        return m / jnp.maximum(jnp.sum(m, axis=(-2, -1), keepdims=True), 1e-30)
+
+    incl = jax.lax.associative_scan(combine, P, axis=0)
+    eyeK = jnp.broadcast_to(jnp.eye(K, dtype=jnp.float32), (1, K, K))
+    excl = jnp.concatenate([eyeK, incl[:-1]], axis=0)  # prefix products
+
+    a0_dir = _norm_rows(pi * B[:, obs_flat[0]])  # [K]
+    enters = _norm_rows(jnp.einsum("k,nkj->nj", a0_dir, excl))  # [NL, K]
+
+    Rsuf = jax.lax.associative_scan(lambda a, b: combine(b, a), P, axis=0, reverse=True)
+    ones_dir = jnp.full((K,), 1.0 / K, jnp.float32)
+    beta_exits = jnp.concatenate(
+        [_norm_rows(jnp.einsum("nij,j->ni", Rsuf[1:], ones_dir)), ones_dir[None]], axis=0
+    )  # [NL, K]
+
+    # --- per-lane v_0 (unnormalized: sum == that position's Rabiner c) ----
+    o_first = obs_l[:, 0]  # [NL]
+    Bf = B[:, o_first].T  # [NL, K]
+    v0_cont = jnp.einsum("nk,kj->nj", enters, A, precision=jax.lax.Precision.HIGHEST) * Bf
+    v0 = jnp.where(
+        (lane_lens > 0)[:, None],
+        jnp.where(jnp.arange(NL)[:, None] == 0, (pi * B[:, obs_flat[0]])[None, :], v0_cont),
+        jnp.ones((NL, K)) / K,
+    )
+
+    Tt = -(-min(t_tile, lane_T) // ROW_TILE) * ROW_TILE
+    if lane_T % Tt:
+        raise ValueError(
+            f"lane_T={lane_T} must be a multiple of the t-tile ({Tt}); a "
+            "floor-divided grid would silently skip each lane's tail rows"
+        )
+    steps2 = obs_l.T  # [lane_T, NL] — within-lens symbols (kernels mask by lens)
+    lens2 = lane_lens[None, :]
+    alphas, cs, betas = _run_fb_kernels(
+        A, B, steps2, lens2, v0.T, beta_exits.T, K, S, Tt, lane_T
+    )
+
+    # --- scale-free assembly ---------------------------------------------
+    Tp = steps2.shape[0]
+    vmask = jnp.arange(Tp)[:, None] < lens2  # [Tp, NL]
+    gamma, emit, loglik = _gamma_emit_loglik(alphas, betas, cs, steps2, vmask, S)
+
+    # xi per pair, scale-free: true xi sums to 1, so dividing each pair's
+    # outer product by its own total reconstructs the exact counts from the
+    # beta DIRECTIONS — no scale chain crosses lane boundaries.  Lane-0 rows
+    # use the entering-alpha message (the pairs the chunked path drops).
+    w = _emit_sel_cols(B, steps2, K) * betas  # [Tp, K, NL] (no /c — scale-free)
+    a_hat = alphas / jnp.maximum(cs[:, None, :], 1e-30)
+    a_prev = jnp.concatenate([enters.T[None], a_hat[:-1]], axis=0)  # [Tp, K, NL]
+    pair = vmask.at[0].set(vmask[0] & (jnp.arange(NL) != 0))  # global init has no pair
+    a_prev = jnp.where(pair[:, None, :], a_prev, 0.0)
+    Aw = jnp.einsum("jk,tkn->tjn", A, w, precision=jax.lax.Precision.HIGHEST)
+    z = jnp.sum(a_prev * Aw, axis=1)  # [Tp, NL] — per-pair xi total
+    a_scaled = a_prev / jnp.maximum(z, 1e-30)[:, None, :]
+    trans = A * jnp.einsum("tin,tjn->ij", a_scaled, w, precision=jax.lax.Precision.HIGHEST)
+
+    init = jnp.where(length > 0, gamma[0, :, 0], jnp.zeros(K))
+
+    return SuffStats(
+        init=init,
+        trans=trans,
+        emit=emit,
+        loglik=loglik,
+        n_seqs=(length > 0).astype(jnp.int32),
     )

@@ -116,3 +116,112 @@ def test_t_not_multiple_of_row_tile(rng):
         np.testing.assert_allclose(np.asarray(got.trans), np.asarray(want.trans), rtol=2e-4, atol=2e-4)
         np.testing.assert_allclose(np.asarray(got.emit), np.asarray(want.emit), rtol=2e-4, atol=2e-4)
         assert float(got.loglik) == pytest.approx(float(want.loglik), abs=0.01)
+
+
+# ---------------------------------------------------------------------------
+# Whole-sequence fused-kernel path (seq_stats_pallas)
+
+
+def _oracle_seq_stats(pi, A, B, obs):
+    import oracle
+
+    K, M = B.shape
+    gamma, xi_sum, ll = oracle.forward_backward_oracle(pi, A, B, obs)
+    emit = np.zeros((K, M))
+    np.add.at(emit.T, obs, gamma)
+    return gamma[0], xi_sum, emit, ll
+
+
+def test_seq_stats_pallas_matches_oracle(rng):
+    """Exact whole-sequence stats with lane-boundary messages == float64
+    oracle on the UNDIVIDED sequence (pairs crossing every lane counted)."""
+    from cpgisland_tpu.ops.fb_pallas import seq_stats_pallas
+
+    pi = rng.dirichlet(np.ones(3))
+    A = rng.dirichlet(np.ones(3), size=3)
+    B = rng.dirichlet(np.ones(4), size=3)
+    params = HmmParams.from_probs(pi, A, B)
+    for T in (3203, 257, 64):  # ragged vs the 256-symbol test lanes
+        obs = rng.integers(0, 4, size=T).astype(np.uint8)
+        g0, xi, emit, ll = _oracle_seq_stats(pi, A, B, obs)
+        st = seq_stats_pallas(params, jnp.asarray(obs), T, lane_T=256, t_tile=64)
+        np.testing.assert_allclose(np.asarray(st.init), g0, atol=5e-5)  # TPU exp/log
+        np.testing.assert_allclose(np.asarray(st.trans), xi, rtol=2e-4, atol=2e-4)
+        np.testing.assert_allclose(np.asarray(st.emit), emit, rtol=2e-4, atol=2e-4)
+        # loglik error grows with T on TPU (~2e-5-relative exp/log per term)
+        assert float(st.loglik) == pytest.approx(ll, abs=max(0.02, 5e-5 * T))
+        assert int(st.n_seqs) == 1
+
+
+def test_seq_stats_pallas_durbin_em_step(rng):
+    """One EM step through the fused whole-sequence path == chunk-free oracle."""
+    import oracle
+
+    from cpgisland_tpu.ops.fb_pallas import seq_stats_pallas
+    from cpgisland_tpu.train.baum_welch import mstep
+
+    params = presets.durbin_cpg8()
+    obs = rng.integers(0, 4, size=5000).astype(np.uint8)
+    pi_o, A_o, B_o, _ = oracle.em_step_oracle(
+        np.asarray(params.pi, np.float64),
+        np.asarray(params.A, np.float64),
+        np.asarray(params.B, np.float64),
+        [obs],
+    )
+    st = seq_stats_pallas(params, jnp.asarray(obs), 5000, lane_T=512, t_tile=64)
+    got = mstep(params, st)
+    np.testing.assert_allclose(np.asarray(got.pi), pi_o, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(got.A), A_o, rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(got.B), B_o, rtol=1e-4, atol=1e-4)
+
+
+def test_seq_stats_pallas_padded_and_empty(rng):
+    from cpgisland_tpu.ops.fb_pallas import seq_stats_pallas
+
+    pi = rng.dirichlet(np.ones(2))
+    A = rng.dirichlet(np.ones(2), size=2)
+    B = rng.dirichlet(np.ones(4), size=2)
+    params = HmmParams.from_probs(pi, A, B)
+    obs = rng.integers(0, 4, size=1000).astype(np.uint8)
+    # length < buffer: the tail must contribute nothing
+    g0, xi, emit, ll = _oracle_seq_stats(pi, A, B, obs[:700])
+    st = seq_stats_pallas(params, jnp.asarray(obs), 700, lane_T=256, t_tile=64)
+    np.testing.assert_allclose(np.asarray(st.trans), xi, rtol=2e-4, atol=2e-4)
+    assert float(st.loglik) == pytest.approx(ll, abs=0.05)
+    # empty
+    st0 = seq_stats_pallas(params, jnp.asarray(obs), 0, lane_T=256, t_tile=64)
+    assert float(st0.loglik) == 0.0
+    assert int(st0.n_seqs) == 0
+    np.testing.assert_array_equal(np.asarray(st0.trans), 0.0)
+
+
+def test_seq_stats_pallas_slow_mixing_boundary_exactness(rng):
+    """Adversarial slow-mixing model: lane-boundary messages must be EXACT —
+    an off-by-one in the lane-0 transfer product once cost 0.08 absolute
+    transition error here (vs ~1e-5 float noise)."""
+    import oracle
+
+    from cpgisland_tpu.ops.fb_pallas import seq_stats_pallas
+
+    pi = np.array([0.99, 0.01])
+    A = np.array([[0.9, 0.1], [0.1, 0.9]])
+    B = np.array([[0.26, 0.24, 0.25, 0.25], [0.24, 0.26, 0.25, 0.25]])
+    params = HmmParams.from_probs(pi, A, B)
+    obs = rng.integers(0, 4, size=64).astype(np.uint8)
+    _, xi, ll = oracle.forward_backward_oracle(pi, A, B, obs)
+    st = seq_stats_pallas(params, jnp.asarray(obs), 64, lane_T=8, t_tile=8)
+    # 5e-4: TPU exp/log noise on counts of magnitude ~30; the bug this
+    # guards against was 0.08
+    np.testing.assert_allclose(np.asarray(st.trans), xi, atol=5e-4)
+    assert float(st.loglik) == pytest.approx(ll, abs=1e-3)
+
+
+def test_seq_stats_pallas_rejects_misaligned_lane_T():
+    from cpgisland_tpu.ops.fb_pallas import seq_stats_pallas
+
+    params = presets.durbin_cpg8()
+    obs = jnp.zeros(960, jnp.uint8)
+    with pytest.raises(ValueError, match="multiple"):
+        seq_stats_pallas(params, obs, 960, lane_T=96, t_tile=64)
+    with pytest.raises(ValueError, match="multiple"):
+        seq_stats_pallas(params, obs, 960, lane_T=100, t_tile=64)
