@@ -44,6 +44,10 @@ from cpgisland_tpu.ops.viterbi_pallas import MAX_PACK_STATES, _interpret, _vspec
 
 LANE_TILE = 128
 DEFAULT_T_TILE = 512
+# Whole-sequence lane length, swept on v5e: 4096 -> 126, 8192 -> ~170
+# Msym/s, 16384 exceeds the products kernel's VMEM.  Shared by the
+# single-device and shard_map entry points.
+DEFAULT_LANE_T = 8192
 
 
 def supports(params: HmmParams) -> bool:
@@ -390,7 +394,7 @@ def seq_stats_pallas(
     params: HmmParams,
     obs: jnp.ndarray,
     length,
-    lane_T: int = 8192,  # swept on v5e: 4096 -> 126, 8192 -> ~170 Msym/s, 16384 exceeds VMEM
+    lane_T: int = DEFAULT_LANE_T,
     t_tile: int = DEFAULT_T_TILE,
 ) -> SuffStats:
     """EXACT whole-sequence statistics on one device via the fused kernels.
@@ -409,10 +413,32 @@ def seq_stats_pallas(
     chromosome shards on a pod; longer single-device inputs should use the
     chunked path or a mesh.
     """
+    return _seq_stats_core(params, obs, length, lane_T, t_tile, axis=None)
+
+
+def _seq_stats_core(
+    params: HmmParams,
+    obs: jnp.ndarray,
+    length,
+    lane_T: int,
+    t_tile: int,
+    axis,
+) -> SuffStats:
+    """The fused whole-sequence E-step over THIS device's time shard.
+
+    axis=None is the single-device case; with an axis name (under
+    shard_map) the per-device [K, K] transfer totals are all_gathered so
+    every device gets its exact entering-alpha / exiting-beta boundary
+    message, exactly the fb_sharded message scheme — the result is the
+    ALREADY-psummed global statistics.
+    """
     K, S = params.n_states, params.n_symbols
     A = jnp.exp(params.log_A).astype(jnp.float32)
     B = jnp.exp(params.log_B).astype(jnp.float32)
     pi = jnp.exp(params.log_pi).astype(jnp.float32)
+
+    d = jax.lax.axis_index(axis) if axis is not None else 0
+    is_first = d == 0
 
     T = obs.shape[0]
     length = jnp.asarray(length, jnp.int32)
@@ -425,10 +451,12 @@ def seq_stats_pallas(
     valid_flat = jnp.arange(T) < length
     obs_flat = jnp.where(valid_flat, jnp.minimum(obs.astype(jnp.int32), S - 1), 0)
     # PAD (== S) marks invalid steps for the products kernel (identity).
-    # Global position 0 is ALSO padded out there: its step is the init
-    # (a0_dir already contains pi * B[:, o_0]), so lane 0's transfer product
-    # must cover steps 1.. only — including M_0 would double-apply it.
-    sel_flat = jnp.where(valid_flat, obs_flat, S).at[0].set(S)
+    # The GLOBAL position 0 is ALSO padded out there: its step is the init
+    # (the base direction already contains pi * B[:, o_0]), so the first
+    # lane's transfer product must cover steps 1.. only — including M_0
+    # would double-apply it.  Only device 0 holds that position.
+    sel_flat = jnp.where(valid_flat, obs_flat, S)
+    sel_flat = sel_flat.at[0].set(jnp.where(is_first, S, sel_flat[0]))
     pad = Tp_all - T
     obs_l = jnp.pad(obs_flat, (0, pad)).reshape(NL, lane_T)
     sel_l = jnp.pad(sel_flat, (0, pad), constant_values=S).reshape(NL, lane_T)
@@ -458,22 +486,33 @@ def seq_stats_pallas(
     eyeK = jnp.broadcast_to(jnp.eye(K, dtype=jnp.float32), (1, K, K))
     excl = jnp.concatenate([eyeK, incl[:-1]], axis=0)  # prefix products
 
-    a0_dir = _norm_rows(pi * B[:, obs_flat[0]])  # [K]
-    enters = _norm_rows(jnp.einsum("k,nkj->nj", a0_dir, excl))  # [NL, K]
+    a0_dir = _norm_rows(pi * B[:, obs_flat[0]])  # [K] — meaningful on device 0
+    if axis is not None:
+        # Cross-device boundary messages: the ONE shared implementation
+        # (parallel.fb_sharded.device_boundary_messages) — both the XLA lane
+        # path and this fused path exchange messages identically.
+        from cpgisland_tpu.parallel.fb_sharded import device_boundary_messages
+
+        _, base_dir, anchor = device_boundary_messages(a0_dir, incl[-1], d, axis)
+    else:
+        base_dir = a0_dir
+        anchor = jnp.full((K,), 1.0 / K, jnp.float32)
+
+    enters = _norm_rows(jnp.einsum("k,nkj->nj", base_dir, excl))  # [NL, K]
 
     Rsuf = jax.lax.associative_scan(lambda a, b: combine(b, a), P, axis=0, reverse=True)
-    ones_dir = jnp.full((K,), 1.0 / K, jnp.float32)
     beta_exits = jnp.concatenate(
-        [_norm_rows(jnp.einsum("nij,j->ni", Rsuf[1:], ones_dir)), ones_dir[None]], axis=0
+        [_norm_rows(jnp.einsum("nij,j->ni", Rsuf[1:], anchor)), anchor[None]], axis=0
     )  # [NL, K]
 
     # --- per-lane v_0 (unnormalized: sum == that position's Rabiner c) ----
     o_first = obs_l[:, 0]  # [NL]
     Bf = B[:, o_first].T  # [NL, K]
     v0_cont = jnp.einsum("nk,kj->nj", enters, A, precision=jax.lax.Precision.HIGHEST) * Bf
+    lane0_is_init = (jnp.arange(NL)[:, None] == 0) & is_first
     v0 = jnp.where(
         (lane_lens > 0)[:, None],
-        jnp.where(jnp.arange(NL)[:, None] == 0, (pi * B[:, obs_flat[0]])[None, :], v0_cont),
+        jnp.where(lane0_is_init, (pi * B[:, obs_flat[0]])[None, :], v0_cont),
         jnp.ones((NL, K)) / K,
     )
 
@@ -496,24 +535,30 @@ def seq_stats_pallas(
 
     # xi per pair, scale-free: true xi sums to 1, so dividing each pair's
     # outer product by its own total reconstructs the exact counts from the
-    # beta DIRECTIONS — no scale chain crosses lane boundaries.  Lane-0 rows
-    # use the entering-alpha message (the pairs the chunked path drops).
+    # beta DIRECTIONS — no scale chain crosses lane or device boundaries.
+    # Lane-0 rows use the entering-alpha message (the pairs the chunked
+    # path drops); the device-crossing pair is lane 0 of device d > 0.
     w = _emit_sel_cols(B, steps2, K) * betas  # [Tp, K, NL] (no /c — scale-free)
     a_hat = alphas / jnp.maximum(cs[:, None, :], 1e-30)
     a_prev = jnp.concatenate([enters.T[None], a_hat[:-1]], axis=0)  # [Tp, K, NL]
-    pair = vmask.at[0].set(vmask[0] & (jnp.arange(NL) != 0))  # global init has no pair
+    pair0 = vmask[0] & ~((jnp.arange(NL) == 0) & is_first)  # global init: no pair
+    pair = vmask.at[0].set(pair0)
     a_prev = jnp.where(pair[:, None, :], a_prev, 0.0)
     Aw = jnp.einsum("jk,tkn->tjn", A, w, precision=jax.lax.Precision.HIGHEST)
     z = jnp.sum(a_prev * Aw, axis=1)  # [Tp, NL] — per-pair xi total
     a_scaled = a_prev / jnp.maximum(z, 1e-30)[:, None, :]
     trans = A * jnp.einsum("tin,tjn->ij", a_scaled, w, precision=jax.lax.Precision.HIGHEST)
 
-    init = jnp.where(length > 0, gamma[0, :, 0], jnp.zeros(K))
+    at_init = is_first & (length > 0)
+    init = jnp.where(at_init, gamma[0, :, 0], jnp.zeros(K))
 
-    return SuffStats(
+    stats = SuffStats(
         init=init,
         trans=trans,
         emit=emit,
         loglik=loglik,
-        n_seqs=(length > 0).astype(jnp.int32),
+        n_seqs=at_init.astype(jnp.int32),
     )
+    if axis is not None:
+        stats = jax.lax.psum(stats, axis)
+    return stats

@@ -98,6 +98,35 @@ def _matmul_combine(a, b):
     return _nrm_m(jnp.einsum("...ij,...jk->...ik", a, b, precision=_HI))
 
 
+def device_boundary_messages(a0_local, total_dev, d, axis):
+    """Cross-device boundary-message exchange (the ONE implementation).
+
+    One all_gather of the raw local init vectors and one of the [K, K]
+    per-device transfer totals; tiny prefix/suffix scans then pick THIS
+    device's entering-alpha direction and exiting-beta direction.  Used by
+    both the XLA lane path (_one_seq_local_stats) and the fused-kernel path
+    (ops.fb_pallas._seq_stats_core) so the numerics cannot diverge.
+
+    Returns (a0_raw_dev0 [K], enter_dir [K], exit_dir [K]).
+    """
+    a0_raw = jax.lax.all_gather(a0_local, axis)[0]  # device 0's init vector
+    a0n = _nrm_v(a0_raw)
+    totals = jax.lax.all_gather(total_dev, axis)  # [D, K, K]
+
+    def pstep(v, Tk):
+        return _nrm_v(jnp.matmul(v, Tk, precision=_HI)), v
+
+    _, enters_dev = jax.lax.scan(pstep, a0n, totals)
+
+    ones_dir = jnp.full(a0n.shape, 1.0, a0n.dtype) / a0n.shape[-1] + a0n * 0.0
+
+    def sstep(b, Tk):
+        return _nrm_v(jnp.matmul(Tk, b, precision=_HI)), b
+
+    _, exits_dev = jax.lax.scan(sstep, ones_dir, totals, reverse=True)
+    return a0_raw, enters_dev[d], exits_dev[d]
+
+
 def _one_seq_local_stats(
     params: HmmParams,
     obs_shard: jnp.ndarray,
@@ -140,8 +169,6 @@ def _one_seq_local_stats(
 
     # --- forward boundary messages -----------------------------------
     v0_local = jnp.exp(params.log_pi) * B_ext[jnp.minimum(obs_c[0], M - 1)]
-    v0_raw = jax.lax.all_gather(v0_local, axis)[0]  # device 0's init vector
-    v0n = _nrm_v(v0_raw)
 
     # Pass A: per-lane operator products (normalized each step).
     eye_b = jnp.broadcast_to(
@@ -156,14 +183,9 @@ def _one_seq_local_stats(
     P_lane, _ = jax.lax.scan(passA, eye_b, sel2)  # [nb, K, K]
     incl = jax.lax.associative_scan(_matmul_combine, P_lane, axis=0)
 
-    total_dev = incl[-1]
-    totals = jax.lax.all_gather(total_dev, axis)  # [D, K, K]
-
-    def pstep(v, Tk):
-        return _nrm_v(jnp.matmul(v, Tk, precision=_HI)), v
-
-    _, enters_dev = jax.lax.scan(pstep, v0n, totals)
-    v_enter_dev = enters_dev[d]  # exact normalized alpha entering this shard
+    v0_raw, v_enter_dev, beta_exit_dev = device_boundary_messages(
+        v0_local, incl[-1], d, axis
+    )
 
     excl = jnp.concatenate([eye_b[:1], incl[:-1]], axis=0)
     enters = _nrm_v(jnp.einsum("k,nkj->nj", v_enter_dev, excl, precision=_HI))
@@ -186,15 +208,7 @@ def _one_seq_local_stats(
         (d == 0) & (length > 0), jnp.log(jnp.maximum(jnp.sum(v0_raw), _TINY)), 0.0
     )
 
-    # --- backward boundary messages -----------------------------------
-    ones_dir = jnp.full((K,), 1.0 / K, A.dtype) + v0n * 0.0
-
-    def sstep(b, Tk):
-        return _nrm_v(jnp.matmul(Tk, b, precision=_HI)), b
-
-    _, exits_dev = jax.lax.scan(sstep, ones_dir, totals, reverse=True)
-    beta_exit_dev = exits_dev[d]  # beta direction at this shard's last position
-
+    # --- backward boundary messages: beta_exit_dev from the exchange above.
     # Lane-level suffix products P_b @ P_{b+1} @ ... (flip-scan-flip: the
     # combine sees flipped operands, so apply them flipped back).
     Rsuf = jax.lax.associative_scan(
@@ -342,6 +356,32 @@ def sharded_stats2d_fn(mesh: Mesh, block_size: int):
             mesh=mesh,
             in_specs=(P(), P(data_axis, seq_axis), P(data_axis, seq_axis)),
             out_specs=P(),
+        )
+    )
+
+
+@functools.lru_cache(maxsize=32)
+def sharded_stats_pallas_fn(mesh: Mesh, lane_T: int, t_tile: int):
+    """Fused-kernel twin of :func:`sharded_stats_fn` (same placed-array
+    contract): per-device lane products + boundary-message exchange run the
+    chunked Pallas forward/backward kernels on each shard — exact
+    whole-sequence statistics at kernel speed across the mesh."""
+    from cpgisland_tpu.ops import fb_pallas
+
+    axis = mesh.axis_names[0]
+
+    def body(params, obs_shard, len_shard):
+        return fb_pallas._seq_stats_core(
+            params, obs_shard, len_shard[0], lane_T, t_tile, axis=axis
+        )
+
+    return jax.jit(
+        jax.shard_map(
+            body,
+            mesh=mesh,
+            in_specs=(P(), P(axis), P(axis)),
+            out_specs=P(),
+            check_vma=False,  # pallas_call output types are opaque to vma
         )
     )
 

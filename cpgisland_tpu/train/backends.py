@@ -221,20 +221,23 @@ class SeqBackend(EStepBackend):
                 f"stream length {obs_flat.shape[0]} not a multiple of "
                 f"devices*block_size = {n_dev}*{self.block_size}; run prepare() first"
             )
-        # Single-device mesh on TPU: the fused-kernel whole-sequence path
-        # (exact boundary messages from the products kernel) runs ~15x the
-        # XLA lane machinery; multi-device meshes keep the shard_map path
-        # whose collectives exchange the boundary messages across chips.
-        # Streams under ~1M symbols skip it — the kernels always pay for a
-        # full 128-lane padded pass, which dwarfs tiny inputs.
+        # On TPU the fused-kernel whole-sequence path (exact boundary
+        # messages from the lane-products kernel) runs ~15x the XLA lane
+        # machinery: single-device directly, multi-device through the
+        # shard_map twin whose collectives exchange the messages across
+        # chips.  Shards under ~1M symbols skip it — the kernels always pay
+        # for a full 128-lane padded pass, which dwarfs tiny inputs.
         if (
-            n_dev == 1
-            and obs_flat.shape[0] >= (1 << 20)
+            obs_flat.shape[0] // n_dev >= (1 << 20)
             and jax.default_backend() == "tpu"
             and fb_pallas.supports(params)
         ):
-            length = jnp.sum(lengths)
-            return fb_pallas.seq_stats_pallas(params, obs_flat, length)
+            if n_dev == 1:
+                return fb_pallas.seq_stats_pallas(params, obs_flat, jnp.sum(lengths))
+            fn = fb_sharded.sharded_stats_pallas_fn(
+                    self.mesh, fb_pallas.DEFAULT_LANE_T, fb_pallas.DEFAULT_T_TILE
+                )
+            return fn(params, obs_flat, lengths)
         fn = fb_sharded.sharded_stats_fn(self.mesh, self.block_size)
         return fn(params, obs_flat, lengths)
 
