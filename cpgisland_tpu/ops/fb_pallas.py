@@ -423,6 +423,7 @@ def _seq_stats_core(
     lane_T: int,
     t_tile: int,
     axis,
+    reduce: bool = True,
 ) -> SuffStats:
     """The fused whole-sequence E-step over THIS device's time shard.
 
@@ -430,7 +431,9 @@ def _seq_stats_core(
     shard_map) the per-device [K, K] transfer totals are all_gathered so
     every device gets its exact entering-alpha / exiting-beta boundary
     message, exactly the fb_sharded message scheme — the result is the
-    ALREADY-psummed global statistics.
+    ALREADY-psummed global statistics when ``reduce`` (callers composing
+    several sequences per device, like the 2-D mesh body, pass
+    reduce=False and psum once themselves).
     """
     K, S = params.n_states, params.n_symbols
     A = jnp.exp(params.log_A).astype(jnp.float32)
@@ -559,6 +562,6 @@ def _seq_stats_core(
         loglik=loglik,
         n_seqs=at_init.astype(jnp.int32),
     )
-    if axis is not None:
+    if axis is not None and reduce:
         stats = jax.lax.psum(stats, axis)
     return stats

@@ -286,24 +286,39 @@ def _shard_stats_body(block_size: int, axis: str):
     return body
 
 
-def _shard_stats2d_body(block_size: int, data_axis: str, seq_axis: str):
+def _shard_stats2d_body(block_size: int, data_axis: str, seq_axis: str, engine: str = "xla"):
     """2-D per-device E-step body: sequences over ``data``, time over ``seq``.
 
     obs_tile: [R, L] — R local sequences' shards; len_tile: [R, 1].  The R
     sequences run through one lax.scan (the three-pass program is traced
     once, whatever R is); every step's collectives involve only this device's
-    seq row.
+    seq row.  ``engine="pallas"`` lowers each sequence's shard through the
+    fused kernels (ops.fb_pallas._seq_stats_core with reduce=False — each
+    device returns its LOCAL partial and the single psum over both axes at
+    the end reduces everything once, same as the XLA branch).
     """
 
     def body(params: HmmParams, obs_tile: jnp.ndarray, len_tile: jnp.ndarray) -> SuffStats:
         K, M = params.n_states, params.n_symbols
 
+        if engine == "pallas":
+            from cpgisland_tpu.ops import fb_pallas
+
+            def one_seq(obs_row, length):
+                return fb_pallas._seq_stats_core(
+                    params, obs_row, length,
+                    fb_pallas.DEFAULT_LANE_T, fb_pallas.DEFAULT_T_TILE,
+                    axis=seq_axis, reduce=False,
+                )
+        else:
+            def one_seq(obs_row, length):
+                return _one_seq_local_stats(
+                    params, obs_row, length, axis=seq_axis, block_size=block_size
+                )
+
         def scan_body(acc, inp):
             obs_row, len_row = inp
-            s = _one_seq_local_stats(
-                params, obs_row, len_row[0], axis=seq_axis, block_size=block_size
-            )
-            return acc + s, None
+            return acc + one_seq(obs_row, len_row[0]), None
 
         # lax.scan (not a Python loop) so the three-pass program is traced
         # once, not R times — R can be dozens of chromosomes per row.  The
@@ -341,21 +356,26 @@ def sharded_stats_fn(mesh: Mesh, block_size: int):
 
 
 @functools.lru_cache(maxsize=32)
-def sharded_stats2d_fn(mesh: Mesh, block_size: int):
+def sharded_stats2d_fn(mesh: Mesh, block_size: int, engine: str = "xla"):
     """Compiled 2-D entry point: fn(params, obs [N, T], lengths [N, sp]).
 
     ``mesh`` must be 2-D (data, seq).  obs rows are whole padded sequences
     placed with P(data, seq); lengths[n, s] is sequence n's real-symbol count
-    in seq-shard s, placed with P(data, seq).
+    in seq-shard s, placed with P(data, seq).  ``engine="pallas"`` lowers
+    each per-row shard through the fused kernels (TPU).
     """
     data_axis, seq_axis = mesh.axis_names
-    body = _shard_stats2d_body(block_size, data_axis, seq_axis)
+    body = _shard_stats2d_body(block_size, data_axis, seq_axis, engine)
     return jax.jit(
         jax.shard_map(
             body,
             mesh=mesh,
             in_specs=(P(), P(data_axis, seq_axis), P(data_axis, seq_axis)),
             out_specs=P(),
+            # pallas_call output types are opaque to the varying-axes
+            # checker — the project-wide pattern for pallas-under-shard_map
+            # (see parallel.decode, SpmdBackend).
+            check_vma=engine != "pallas",
         )
     )
 
@@ -516,4 +536,4 @@ def batch_seq_stats_sharded(
     rows, seq_lengths = pack_ragged(list(sequences), pad)
     obs, lengths = pad_batch2d(rows, seq_lengths, dp, sp, block_size, pad)
     arr, lens = place_batch2d(mesh, obs, lengths)
-    return sharded_stats2d_fn(mesh, block_size)(params, arr, lens)
+    return sharded_stats2d_fn(mesh, block_size, "xla")(params, arr, lens)

@@ -258,14 +258,18 @@ class Seq2DBackend(EStepBackend):
         mesh: Optional[Mesh] = None,
         block_size: Optional[int] = None,
         pad_value: int = chunking.PAD_SYMBOL,
+        engine: str = "auto",
     ):
         if mesh is not None and len(mesh.axis_names) != 2:
             raise ValueError(f"Seq2DBackend needs a 2-D mesh, got axes {mesh.axis_names}")
+        if engine not in ("auto", "xla"):
+            raise ValueError(f"Seq2DBackend engine must be auto|xla, got {engine!r}")
         # mesh=None defers the dp x sp split to prepare(), which knows the
         # sequence count (parallel.mesh.auto_mesh2d).
         self.mesh = mesh
         self.block_size = block_size if block_size is not None else fb_sharded.DEFAULT_BLOCK
         self.pad_value = pad_value
+        self.engine = engine
 
     @property
     def data_axis(self) -> str:
@@ -302,7 +306,21 @@ class Seq2DBackend(EStepBackend):
                 "Seq2DBackend expects placed [N, T] sequences and [N, sp] shard "
                 "lengths; run prepare() + place() first"
             )
-        fn = fb_sharded.sharded_stats2d_fn(self.mesh, self.block_size)
+        # Same routing policy as SeqBackend: big-enough TPU shards take the
+        # fused-kernel lowering of each per-row sequence shard; an explicit
+        # engine="xla" always wins (the knob get_backend accepts).
+        sp = self.mesh.shape[self.seq_axis]
+        engine = (
+            "pallas"
+            if (
+                self.engine == "auto"
+                and chunks.shape[1] // sp >= (1 << 20)
+                and jax.default_backend() == "tpu"
+                and fb_pallas.supports(params)
+            )
+            else "xla"
+        )
+        fn = fb_sharded.sharded_stats2d_fn(self.mesh, self.block_size, engine)
         return fn(params, chunks, lengths)
 
 

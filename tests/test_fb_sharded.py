@@ -200,3 +200,28 @@ def test_em_loglik_monotone_seq_backend(rng, mesh):
     )
     lls = res.logliks
     assert all(b >= a - 1e-2 for a, b in zip(lls, lls[1:])), lls
+
+
+@pytest.mark.parametrize("dp,sp", [(2, 4), (4, 2)])
+def test_batch_2d_pallas_engine_matches_xla(rng, dp, sp):
+    """The fused-kernel lowering of the 2-D body == the XLA lanes body
+    (kernels interpreted on the virtual mesh)."""
+    from cpgisland_tpu.parallel.fb_sharded import pad_batch2d, place_batch2d, sharded_stats2d_fn
+    from cpgisland_tpu.parallel.mesh import make_mesh2d
+
+    require_devices(8)
+    pi, A, B, params = _random_params(rng)
+    seqs = [rng.integers(0, 4, size=n).astype(np.uint8) for n in (901, 1203, 402)]
+    from cpgisland_tpu.parallel.fb_sharded import pack_ragged
+
+    rows, lengths = pack_ragged(list(seqs), 4)
+    mesh = make_mesh2d(dp, sp)
+    obs, lens = pad_batch2d(rows, lengths, dp, sp, 64, 4)
+    arr, l2 = place_batch2d(mesh, obs, lens)
+    st_xla = sharded_stats2d_fn(mesh, 64, "xla")(params, arr, l2)
+    st_pal = sharded_stats2d_fn(mesh, 64, "pallas")(params, arr, l2)
+    np.testing.assert_allclose(np.asarray(st_pal.trans), np.asarray(st_xla.trans), rtol=2e-4, atol=2e-4)
+    np.testing.assert_allclose(np.asarray(st_pal.emit), np.asarray(st_xla.emit), rtol=2e-4, atol=2e-4)
+    np.testing.assert_allclose(np.asarray(st_pal.init), np.asarray(st_xla.init), atol=1e-4)
+    assert float(st_pal.loglik) == pytest.approx(float(st_xla.loglik), abs=0.05)
+    assert int(st_pal.n_seqs) == int(st_xla.n_seqs) == 3
