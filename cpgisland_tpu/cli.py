@@ -237,6 +237,13 @@ def _run_command(args, compat, pipeline, presets, load_text) -> int:
         return 0
 
     if args.cmd == "run":
+        island_states = _parse_island_states(build_parser(), args, compat)
+        params = _preset_params(presets, args.preset)
+        # Same pairing check decode_file performs (the one shared predicate) —
+        # but at parse time, not after an hours-long training run completes.
+        err = pipeline.island_layout_error(params, island_states)
+        if err:
+            build_parser().error(f"--preset {args.preset}: {err}")
         res = pipeline.run(
             args.training_file,
             args.test_file,
@@ -244,12 +251,12 @@ def _run_command(args, compat, pipeline, presets, load_text) -> int:
             args.model_out,
             convergence=args.convergence,
             num_iters=args.iters,
-            params=_preset_params(presets, args.preset),
+            params=params,
             backend=args.backend,
             mode=args.mode,
             compat=compat,
             engine=args.engine,
-            island_states=_parse_island_states(build_parser(), args, compat),
+            island_states=island_states,
         )
         print(f"{len(res.calls)} islands -> {args.islands_out}")
         return 0

@@ -286,7 +286,14 @@ def _shard_stats_body(block_size: int, axis: str):
     return body
 
 
-def _shard_stats2d_body(block_size: int, data_axis: str, seq_axis: str, engine: str = "xla"):
+def _shard_stats2d_body(
+    block_size: int,
+    data_axis: str,
+    seq_axis: str,
+    engine: str = "xla",
+    lane_T: int | None = None,
+    t_tile: int | None = None,
+):
     """2-D per-device E-step body: sequences over ``data``, time over ``seq``.
 
     obs_tile: [R, L] — R local sequences' shards; len_tile: [R, 1].  The R
@@ -304,10 +311,12 @@ def _shard_stats2d_body(block_size: int, data_axis: str, seq_axis: str, engine: 
         if engine == "pallas":
             from cpgisland_tpu.ops import fb_pallas
 
+            lt = lane_T if lane_T is not None else fb_pallas.DEFAULT_LANE_T
+            tt = t_tile if t_tile is not None else fb_pallas.DEFAULT_T_TILE
+
             def one_seq(obs_row, length):
                 return fb_pallas._seq_stats_core(
-                    params, obs_row, length,
-                    fb_pallas.DEFAULT_LANE_T, fb_pallas.DEFAULT_T_TILE,
+                    params, obs_row, length, lt, tt,
                     axis=seq_axis, reduce=False,
                 )
         else:
@@ -356,16 +365,23 @@ def sharded_stats_fn(mesh: Mesh, block_size: int):
 
 
 @functools.lru_cache(maxsize=32)
-def sharded_stats2d_fn(mesh: Mesh, block_size: int, engine: str = "xla"):
+def sharded_stats2d_fn(
+    mesh: Mesh,
+    block_size: int,
+    engine: str = "xla",
+    lane_T: int | None = None,
+    t_tile: int | None = None,
+):
     """Compiled 2-D entry point: fn(params, obs [N, T], lengths [N, sp]).
 
     ``mesh`` must be 2-D (data, seq).  obs rows are whole padded sequences
     placed with P(data, seq); lengths[n, s] is sequence n's real-symbol count
     in seq-shard s, placed with P(data, seq).  ``engine="pallas"`` lowers
-    each per-row shard through the fused kernels (TPU).
+    each per-row shard through the fused kernels (TPU; interpreted
+    elsewhere), with ``lane_T``/``t_tile`` overriding the kernel defaults.
     """
     data_axis, seq_axis = mesh.axis_names
-    body = _shard_stats2d_body(block_size, data_axis, seq_axis, engine)
+    body = _shard_stats2d_body(block_size, data_axis, seq_axis, engine, lane_T, t_tile)
     return jax.jit(
         jax.shard_map(
             body,

@@ -91,25 +91,27 @@ def initialize_multihost(
     import jax.distributed as jd
 
     explicit = any(a is not None for a in (coordinator_address, num_processes, process_id))
+
+    # State queries, not error-message matching: jd.initialize raises
+    # RuntimeError both for re-entry and for late calls, and its wording is
+    # not a stable API.  Query the two states directly instead.
+    if getattr(jd, "is_initialized", lambda: False)():
+        return len(jax.devices())  # idempotent re-entry
+    if _backends_initialized() and not explicit and not _cluster_env():
+        # The XLA backend is already up, no cluster was requested explicitly,
+        # and nothing in the environment says this is a pod: a single-process
+        # run that called this late — fine.  On a real pod (cluster env
+        # present) we fall through and let jd.initialize raise, because
+        # silently degrading would have every host train alone.
+        log.info("backend already initialized; continuing single-process")
+        return len(jax.devices())
+
     try:
         jd.initialize(
             coordinator_address=coordinator_address,
             num_processes=num_processes,
             process_id=process_id,
         )
-    except RuntimeError as e:
-        msg = str(e).lower()
-        if "already initialized" in msg or "should only be called once" in msg:
-            pass  # idempotent re-entry
-        elif "must be called before" in msg and not explicit and not _cluster_env():
-            # The XLA backend is already up, no cluster was requested
-            # explicitly, and nothing in the environment says this is a pod:
-            # a single-process run that called this late — fine.  On a real
-            # pod (cluster env present) this stays a hard error, because
-            # silently degrading would have every host train alone.
-            log.info("backend already initialized; continuing single-process")
-        else:
-            raise
     except ValueError:
         if explicit or _cluster_env():
             # Explicit-but-broken args, or a cluster environment whose
@@ -119,6 +121,20 @@ def initialize_multihost(
         # No cluster environment to auto-detect from: single-process run.
         log.info("no multi-host cluster environment detected; running single-process")
     return len(jax.devices())
+
+
+def _backends_initialized() -> bool:
+    """Has any XLA backend already been created in this process?
+
+    Uses the xla_bridge state query when present (jax>=0.4-era private API,
+    stable in practice); conservatively reports False otherwise, which routes
+    through jd.initialize and surfaces its own error."""
+    try:
+        from jax._src import xla_bridge
+
+        return bool(xla_bridge.backends_are_initialized())
+    except Exception:
+        return False
 
 
 # Environment markers jax.distributed's auto-detection feeds on — if any is

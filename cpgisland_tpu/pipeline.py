@@ -110,6 +110,26 @@ def train_file(
     return result
 
 
+def island_layout_error(params: HmmParams, island_states=None) -> Optional[str]:
+    """The K=2*M island-caller pairing check, shared by decode_file and the
+    CLI's parse-time validation so the two can't drift.
+
+    The built-in caller reads base identity out of state ids, which is only
+    meaningful for the reference's 2M-state X+/X- labeling
+    (CpGIslandFinder.java:182-189).  Anything else would silently emit
+    garbage islands — require the observation-based caller instead.  Returns
+    an error message, or None when the pairing is valid.
+    """
+    if island_states is None and params.n_states != 2 * params.n_symbols:
+        return (
+            f"model has {params.n_states} states / {params.n_symbols} symbols, "
+            "not the 2M-state X+/X- labeling the built-in island caller "
+            "assumes — pass island_states=(...) (clean mode) to use the "
+            "observation-based caller"
+        )
+    return None
+
+
 @dataclass
 class DecodeResult:
     calls: IslandCalls
@@ -157,17 +177,9 @@ def decode_file(
     if island_states is not None and compat:
         raise ValueError("island_states needs clean mode (compat=False); the "
                          "reference caller is 8-state-specific")
-    if island_states is None and params.n_states != 2 * params.n_symbols:
-        # The built-in caller reads base identity out of state ids, which is
-        # only meaningful for the reference's 2M-state X+/X- labeling
-        # (CpGIslandFinder.java:182-189).  Anything else would silently emit
-        # garbage islands — require the observation-based caller instead.
-        raise ValueError(
-            f"model has {params.n_states} states / {params.n_symbols} symbols, "
-            "not the 2M-state X+/X- labeling the built-in island caller "
-            "assumes — pass island_states=(...) (clean mode) to use the "
-            "observation-based caller"
-        )
+    err = island_layout_error(params, island_states)
+    if err:
+        raise ValueError(err)
     timer = timer if timer is not None else profiling.PhaseTimer()
     batch_decode = (
         viterbi_pallas_batch

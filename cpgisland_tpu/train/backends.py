@@ -162,6 +162,36 @@ class SpmdBackend(EStepBackend):
         return self._estep_for(params)(params, chunks, lengths)
 
 
+def _check_seq_engine(engine: str) -> None:
+    if engine not in ("auto", "xla", "pallas"):
+        raise ValueError(
+            f"sequence-parallel engine must be auto|xla|pallas, got {engine!r}"
+        )
+
+
+def _use_fused_seq(engine: str, params: HmmParams, shard_len: int) -> bool:
+    """Route a whole-sequence E-step to the fused Pallas lowering?
+
+    auto gates on TPU + a big-enough per-device shard; an explicit "pallas"
+    always takes the fused path (interpreted off-TPU), erroring on models the
+    kernels don't support rather than silently falling back.
+    """
+    if engine == "xla":
+        return False
+    if engine == "pallas":
+        if not fb_pallas.supports(params):
+            raise ValueError(
+                f"engine='pallas' but the fused kernels do not support "
+                f"{params.n_states} states"
+            )
+        return True
+    return (
+        shard_len >= (1 << 20)
+        and jax.default_backend() == "tpu"
+        and fb_pallas.supports(params)
+    )
+
+
 class SeqBackend(EStepBackend):
     """Exact whole-sequence E-step, sequence-parallel over the mesh.
 
@@ -170,8 +200,9 @@ class SeqBackend(EStepBackend):
     (parallel.fb_sharded) — no 65,536-symbol independence approximation and no
     dropped boundary transition pairs, unlike the reference's chunked mapper
     contract (CpGIslandFinder.java:130-141).  Numerics are rescaled
-    probability-space (the scale-free boundary trick needs them); ``mode`` /
-    ``engine`` knobs of the chunked backends don't apply.
+    probability-space (the scale-free boundary trick needs them — no ``mode``
+    knob); ``engine`` picks the block-pass lowering (auto / xla / pallas, see
+    __init__).
     """
 
     def __init__(
@@ -180,7 +211,11 @@ class SeqBackend(EStepBackend):
         block_size: Optional[int] = None,
         axis: str = "seq",
         pad_value: int = chunking.PAD_SYMBOL,
+        engine: str = "auto",
+        lane_T: Optional[int] = None,
+        t_tile: Optional[int] = None,
     ):
+        _check_seq_engine(engine)
         self.mesh = mesh if mesh is not None else make_mesh(axis=axis)
         self.block_size = block_size if block_size is not None else fb_sharded.DEFAULT_BLOCK
         self.axis = self.mesh.axis_names[0]
@@ -188,6 +223,12 @@ class SeqBackend(EStepBackend):
         # default matches the 4-symbol DNA alphabet — pass n_symbols for
         # bigger alphabets.
         self.pad_value = pad_value
+        # auto: fused kernels on big-enough TPU shards, XLA lanes otherwise;
+        # xla / pallas force one lowering.  lane_T / t_tile tune the fused
+        # kernels (defaults: fb_pallas.DEFAULT_LANE_T / DEFAULT_T_TILE).
+        self.engine = engine
+        self.lane_T = lane_T if lane_T is not None else fb_pallas.DEFAULT_LANE_T
+        self.t_tile = t_tile if t_tile is not None else fb_pallas.DEFAULT_T_TILE
 
     def prepare(self, chunked: chunking.Chunked) -> chunking.Chunked:
         """Re-frame any chunk batch as one stream sharded across the mesh."""
@@ -225,18 +266,16 @@ class SeqBackend(EStepBackend):
         # messages from the lane-products kernel) runs ~15x the XLA lane
         # machinery: single-device directly, multi-device through the
         # shard_map twin whose collectives exchange the messages across
-        # chips.  Shards under ~1M symbols skip it — the kernels always pay
-        # for a full 128-lane padded pass, which dwarfs tiny inputs.
-        if (
-            obs_flat.shape[0] // n_dev >= (1 << 20)
-            and jax.default_backend() == "tpu"
-            and fb_pallas.supports(params)
-        ):
+        # chips.  auto gates on shard size (under ~1M symbols the kernels'
+        # full 128-lane padded pass dwarfs tiny inputs) — an explicit
+        # engine always wins.
+        if _use_fused_seq(self.engine, params, obs_flat.shape[0] // n_dev):
             if n_dev == 1:
-                return fb_pallas.seq_stats_pallas(params, obs_flat, jnp.sum(lengths))
-            fn = fb_sharded.sharded_stats_pallas_fn(
-                    self.mesh, fb_pallas.DEFAULT_LANE_T, fb_pallas.DEFAULT_T_TILE
+                return fb_pallas.seq_stats_pallas(
+                    params, obs_flat, jnp.sum(lengths),
+                    lane_T=self.lane_T, t_tile=self.t_tile,
                 )
+            fn = fb_sharded.sharded_stats_pallas_fn(self.mesh, self.lane_T, self.t_tile)
             return fn(params, obs_flat, lengths)
         fn = fb_sharded.sharded_stats_fn(self.mesh, self.block_size)
         return fn(params, obs_flat, lengths)
@@ -259,17 +298,20 @@ class Seq2DBackend(EStepBackend):
         block_size: Optional[int] = None,
         pad_value: int = chunking.PAD_SYMBOL,
         engine: str = "auto",
+        lane_T: Optional[int] = None,
+        t_tile: Optional[int] = None,
     ):
         if mesh is not None and len(mesh.axis_names) != 2:
             raise ValueError(f"Seq2DBackend needs a 2-D mesh, got axes {mesh.axis_names}")
-        if engine not in ("auto", "xla"):
-            raise ValueError(f"Seq2DBackend engine must be auto|xla, got {engine!r}")
+        _check_seq_engine(engine)
         # mesh=None defers the dp x sp split to prepare(), which knows the
         # sequence count (parallel.mesh.auto_mesh2d).
         self.mesh = mesh
         self.block_size = block_size if block_size is not None else fb_sharded.DEFAULT_BLOCK
         self.pad_value = pad_value
         self.engine = engine
+        self.lane_T = lane_T
+        self.t_tile = t_tile
 
     @property
     def data_axis(self) -> str:
@@ -306,21 +348,21 @@ class Seq2DBackend(EStepBackend):
                 "Seq2DBackend expects placed [N, T] sequences and [N, sp] shard "
                 "lengths; run prepare() + place() first"
             )
-        # Same routing policy as SeqBackend: big-enough TPU shards take the
-        # fused-kernel lowering of each per-row sequence shard; an explicit
-        # engine="xla" always wins (the knob get_backend accepts).
+        # Same routing policy as SeqBackend (_use_fused_seq): auto gates on
+        # big-enough TPU shards; an explicit engine always wins.
         sp = self.mesh.shape[self.seq_axis]
         engine = (
             "pallas"
-            if (
-                self.engine == "auto"
-                and chunks.shape[1] // sp >= (1 << 20)
-                and jax.default_backend() == "tpu"
-                and fb_pallas.supports(params)
-            )
+            if _use_fused_seq(self.engine, params, chunks.shape[1] // sp)
             else "xla"
         )
-        fn = fb_sharded.sharded_stats2d_fn(self.mesh, self.block_size, engine)
+        # The XLA body ignores the kernel tile knobs — normalize them out of
+        # the compile-cache key so differently-tuned backends share one
+        # compiled program.
+        lane_T, t_tile = (self.lane_T, self.t_tile) if engine == "pallas" else (None, None)
+        fn = fb_sharded.sharded_stats2d_fn(
+            self.mesh, self.block_size, engine, lane_T, t_tile
+        )
         return fn(params, chunks, lengths)
 
 
@@ -337,15 +379,14 @@ def get_backend(
     if name == "spmd":
         return SpmdBackend(mesh=mesh, mode=mode, engine=engine)
     if name in ("seq", "seq2d"):
-        # The whole-sequence backends have fixed rescaled numerics and their
-        # own lowering — reject knobs they would otherwise silently ignore.
+        # The whole-sequence backends have fixed rescaled numerics — reject
+        # the knob they would otherwise silently ignore; engine passes
+        # through (auto / xla / pallas, validated by the backend).
         if mode != "rescaled":
             raise ValueError(f"backend {name!r} implements rescaled numerics only")
-        if engine not in ("auto", "xla"):
-            raise ValueError(f"backend {name!r} does not take engine {engine!r}")
         if name == "seq":
-            return SeqBackend(mesh=mesh)
-        return Seq2DBackend(mesh=mesh)
+            return SeqBackend(mesh=mesh, engine=engine)
+        return Seq2DBackend(mesh=mesh, engine=engine)
     raise ValueError(
         f"unknown backend {name!r} (expected 'local', 'spmd', 'seq', or 'seq2d')"
     )

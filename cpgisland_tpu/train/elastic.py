@@ -116,7 +116,13 @@ class ElasticEStep(EStepBackend):
                 if attempt > 1 and self.metrics is not None:
                     self.metrics.log("estep_slice_recovered", slice=idx, attempts=attempt)
                 return host
-            except Exception as e:  # XlaRuntimeError, FloatingPointError, ...
+            except (RuntimeError, FloatingPointError) as e:
+                # Fault-shaped errors only (XlaRuntimeError subclasses
+                # RuntimeError; check_finite raises FloatingPointError) —
+                # matches baum_welch.fit's retry policy.  Deterministic
+                # programming errors (ValueError/TypeError from a shape bug)
+                # propagate immediately instead of being retried or silently
+                # dropped as "bad records".
                 last_err = e
                 log.warning(
                     "E-step slice %d (chunks %d:%d) attempt %d/%d failed: %s",

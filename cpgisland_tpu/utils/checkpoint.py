@@ -29,6 +29,18 @@ import numpy as np
 from cpgisland_tpu.models.hmm import HmmParams
 
 
+def _import_orbax():
+    try:
+        import orbax.checkpoint as ocp
+    except ImportError as e:
+        raise ImportError(
+            "the 'orbax' checkpoint format needs orbax-checkpoint — install "
+            "with `pip install cpgisland-tpu[orbax]` (or use the default "
+            "'npz' format, which has no extra dependencies)"
+        ) from e
+    return ocp
+
+
 @dataclass
 class TrainState:
     """Everything needed to resume Baum-Welch mid-run."""
@@ -59,7 +71,7 @@ def _state_from_tree(z) -> TrainState:
 def save(path: str, state: TrainState, format: str = "npz") -> None:
     """Write a TrainState snapshot — atomic .npz or an Orbax directory."""
     if format == "orbax":
-        import orbax.checkpoint as ocp
+        ocp = _import_orbax()
 
         with ocp.StandardCheckpointer() as ckptr:
             # Orbax wants an absolute, non-existing target dir; its own
@@ -87,7 +99,7 @@ def save(path: str, state: TrainState, format: str = "npz") -> None:
 def load(path: str) -> TrainState:
     """Load a snapshot; the format is auto-detected (npz file / Orbax dir)."""
     if os.path.isdir(path):
-        import orbax.checkpoint as ocp
+        ocp = _import_orbax()
 
         with ocp.StandardCheckpointer() as ckptr:
             # Target-less restore: orbax logs an unsafe-topology warning, but

@@ -281,3 +281,15 @@ def test_cli_run_two_state_full_loop(tmp_path, rng):
     with pytest.raises(SystemExit):
         cli.main(["decode", str(fa), "--islands-out", str(out), "--clean",
                   "--island-states", "0,"])
+
+
+def test_cli_run_two_state_without_island_states_fails_at_parse_time(tmp_path):
+    """`run --preset two_state` without --island-states must error before any
+    training happens, not hours later in decode_file (ADVICE r1)."""
+    fa = tmp_path / "g.fa"
+    fa.write_text(">c\nacgtacgtacgt\n")
+    out, m = tmp_path / "i.txt", tmp_path / "m.txt"
+    with pytest.raises(SystemExit):
+        cli.main(["run", str(fa), str(fa), "--islands-out", str(out),
+                  "--model-out", str(m), "--clean", "--preset", "two_state"])
+    assert not m.exists()  # training never started

@@ -6,6 +6,7 @@ per-chunk independence approximation drops boundary pairs.  Runs on the 8-device
 virtual CPU mesh from conftest.
 """
 
+import jax.numpy as jnp
 import numpy as np
 import pytest
 
@@ -175,7 +176,10 @@ def test_get_backend_rejects_mismatched_knobs():
         with pytest.raises(ValueError, match="rescaled"):
             get_backend(name, mode="log")
         with pytest.raises(ValueError, match="engine"):
-            get_backend(name, engine="pallas")
+            get_backend(name, engine="bogus")
+        # engine="pallas" is a valid explicit lowering for both (r1 had
+        # Seq2DBackend inconsistently rejecting it).
+        assert get_backend(name, engine="pallas") is not None
         assert get_backend(name) is not None
 
 
@@ -225,3 +229,62 @@ def test_batch_2d_pallas_engine_matches_xla(rng, dp, sp):
     np.testing.assert_allclose(np.asarray(st_pal.init), np.asarray(st_xla.init), atol=1e-4)
     assert float(st_pal.loglik) == pytest.approx(float(st_xla.loglik), abs=0.05)
     assert int(st_pal.n_seqs) == int(st_xla.n_seqs) == 3
+
+
+def test_seq2d_backend_explicit_pallas_engine_parity(rng):
+    """Seq2DBackend(engine='pallas') — the knob, not just the underlying fn —
+    matches engine='xla' through a full fit() on the 2-D mesh."""
+    from cpgisland_tpu.parallel.mesh import make_mesh2d
+    from cpgisland_tpu.train.backends import Seq2DBackend
+
+    require_devices(8)
+    _, _, _, params = _random_params(rng)
+    seqs = [rng.integers(0, 4, size=n).astype(np.uint8) for n in (800, 650)]
+    T = max(len(s) for s in seqs)
+    rows = np.full((2, T), 4, np.uint8)
+    for i, s in enumerate(seqs):
+        rows[i, : len(s)] = s
+    chunked = chunking.Chunked(
+        chunks=rows, lengths=np.array([len(s) for s in seqs], np.int32),
+        total=sum(len(s) for s in seqs),
+    )
+    kw = dict(block_size=64, lane_T=64, t_tile=64)
+    res_p = baum_welch.fit(
+        params, chunked, num_iters=1, convergence=0.0,
+        backend=Seq2DBackend(make_mesh2d(2, 4), engine="pallas", **kw),
+    )
+    res_x = baum_welch.fit(
+        params, chunked, num_iters=1, convergence=0.0,
+        backend=Seq2DBackend(make_mesh2d(2, 4), engine="xla", **kw),
+    )
+    np.testing.assert_allclose(np.asarray(res_p.params.A), np.asarray(res_x.params.A), rtol=2e-4, atol=2e-4)
+    np.testing.assert_allclose(np.asarray(res_p.params.B), np.asarray(res_x.params.B), rtol=2e-4, atol=2e-4)
+    np.testing.assert_allclose(np.asarray(res_p.params.pi), np.asarray(res_x.params.pi), atol=1e-4)
+
+
+def test_seq_backend_explicit_engines(rng):
+    """SeqBackend's new engine knob: explicit pallas == explicit xla, and an
+    unsupported model errors instead of silently falling back."""
+    require_devices(8)
+    _, _, _, params = _random_params(rng)
+    obs = rng.integers(0, 4, size=3000).astype(np.uint8)
+    chunked = chunking.frame(obs, 512)
+    mesh = make_mesh(8, axis="seq")
+    kw = dict(mesh=mesh, block_size=64, lane_T=64, t_tile=64)
+    res_p = baum_welch.fit(
+        params, chunked, num_iters=1, convergence=0.0,
+        backend=SeqBackend(engine="pallas", **kw),
+    )
+    res_x = baum_welch.fit(
+        params, chunked, num_iters=1, convergence=0.0,
+        backend=SeqBackend(engine="xla", **kw),
+    )
+    np.testing.assert_allclose(np.asarray(res_p.params.A), np.asarray(res_x.params.A), rtol=2e-4, atol=2e-4)
+
+    big = HmmParams.from_probs(
+        np.full(9, 1 / 9), np.full((9, 9), 1 / 9), np.full((9, 4), 0.25)
+    )
+    with pytest.raises(ValueError, match="support"):
+        SeqBackend(engine="pallas", mesh=mesh, block_size=64)(
+            big, jnp.asarray(obs[:2048]), jnp.asarray(np.full(8, 256, np.int32))
+        )
