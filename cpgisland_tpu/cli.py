@@ -121,6 +121,13 @@ def build_parser() -> argparse.ArgumentParser:
     d.add_argument("--model", help="model text file (default: the --preset model)")
     d.add_argument("--islands-out", required=True)
     d.add_argument("--min-len", type=int, default=None, help="clean mode only")
+    d.add_argument(
+        "--island-engine",
+        choices=("auto", "host", "device"),
+        default="auto",
+        help="island caller placement (clean mode): device keeps the decoded "
+        "path on-chip and returns only the call records (auto: device on TPU)",
+    )
     _add_island_states_flag(d)
     _common_flags(d)
 
@@ -232,6 +239,7 @@ def _run_command(args, compat, pipeline, presets, load_text) -> int:
             min_len=args.min_len,
             engine=args.engine,
             island_states=island_states,
+            island_engine=args.island_engine,
         )
         print(f"decoded {res.n_symbols} symbols in {res.n_chunks} chunks; {len(res.calls)} islands")
         return 0

@@ -12,10 +12,13 @@ fuse the per-step emission select, normalize, and statistics accumulation:
   path); streams only the v's [T, K, lanes] to HBM (32 B/symbol — far under
   HBM bandwidth at these op intensities; no checkpoint/recompute needed at
   K=8).  The scale factors come back as time-parallel row sums in JAX.
-- **backward kernel** — walks t-tiles in reverse (reversed index_map),
-  storing ONLY the scaled beta vectors; per-tile boundary values
-  (o_{t+1}, c_{t+1}) carry through scratch.  The [K,K]/[K,S] expected-count
-  tensors are then TIME-PARALLEL contractions over the streamed
+- **backward kernel** — row-tiled reverse walk over t-tiles (reversed
+  index_map), storing ONLY the scaled beta vectors; the o_{t+1}/c_{t+1} each
+  step needs arrive as TIME-SHIFTED inputs (steps_next/cs_next, one cheap
+  XLA pass) so every read is an aligned static-offset tile and the emission
+  select + 1/c reciprocals hoist off the sequential chain — this took the
+  backward from ~3x the forward's cost to parity.  The [K,K]/[K,S]
+  expected-count tensors are TIME-PARALLEL contractions over the streamed
   alphas/betas in the JAX assembly (two einsums + S masked sums) — moving
   them out of the sequential per-step loop bought ~17% end to end.
 
@@ -44,9 +47,10 @@ from cpgisland_tpu.ops.viterbi_pallas import MAX_PACK_STATES, _interpret, _vspec
 
 LANE_TILE = 128
 DEFAULT_T_TILE = 512
-# Whole-sequence lane length, swept on v5e: 4096 -> 126, 8192 -> ~170
-# Msym/s, 16384 exceeds the products kernel's VMEM.  Shared by the
-# single-device and shard_map entry points.
+# Whole-sequence lane length, swept on v5e with chained (dispatch-latency-
+# free) timing: 8192 -> 378 Msym/s, 16384 -> 365.  Any multiple of the
+# t-tile compiles now that the products kernel streams t in tiles; 8192
+# stays the sweet spot.  Shared by the single-device and shard_map entries.
 DEFAULT_LANE_T = 8192
 
 
@@ -115,8 +119,8 @@ def _fwd_kernel(steps_ref, lens_ref, alpha0raw_ref, A_ref, B_ref,
     carry_ref[:, :] = jax.lax.fori_loop(0, Tt // ROW_TILE, body, v_in)
 
 
-def _prod_kernel(steps_ref, A_ref, B_ref, out_ref, *, K, S, bk):
-    """(+,x) product of each lane's bk step matrices -> [K*K, LT], normalized.
+def _prod_kernel(steps_ref, A_ref, B_ref, out_ref, C_scr, *, K, S, bk):
+    """(+,x) product of each lane's step matrices -> [K*K, LT], normalized.
 
     The probability-space twin of viterbi_pallas._products_kernel: C carried
     as a tuple of K rank-2 rows (C[i] is [K, LT], row i of the product — the
@@ -124,14 +128,26 @@ def _prod_kernel(steps_ref, A_ref, B_ref, out_ref, *, K, S, bk):
     per step, so every ROW_TILE steps the whole matrix renormalizes by one
     per-lane scalar (relative row scales preserved); only DIRECTIONS leave
     this kernel — the boundary-message consumers renormalize anyway.
+
+    The t dimension is tiled over the inner grid axis (``bk`` steps per
+    tile), with the running product carried in VMEM scratch between tiles —
+    the full-lane input block of the untiled version capped lane_T at 8192
+    (a 16384-lane block is 8 MiB, 16 MiB double-buffered, the whole VMEM).
     """
+    j = pl.program_id(1)
+    n_t = pl.num_programs(1)
     lt = steps_ref.shape[1]
     A = A_ref[:, :]
     B = B_ref[:, :]
-    C0 = tuple(
-        jnp.broadcast_to((jnp.arange(K) == i).astype(jnp.float32)[:, None], (K, lt))
-        for i in range(K)
-    )
+
+    @pl.when(j == 0)
+    def _init():
+        for i in range(K):
+            C_scr[i * K : (i + 1) * K, :] = jnp.broadcast_to(
+                (jnp.arange(K) == i).astype(jnp.float32)[:, None], (K, lt)
+            )
+
+    C0 = tuple(C_scr[i * K : (i + 1) * K, :] for i in range(K))
 
     def body(c, C):
         tile = steps_ref[pl.ds(c * ROW_TILE, ROW_TILE), :]  # aligned [8, LT]
@@ -157,24 +173,35 @@ def _prod_kernel(steps_ref, A_ref, B_ref, out_ref, *, K, S, bk):
 
     C = jax.lax.fori_loop(0, bk // ROW_TILE, body, C0)
     for i in range(K):
-        out_ref[i * K : (i + 1) * K, :] = C[i]
+        C_scr[i * K : (i + 1) * K, :] = C[i]
+
+    @pl.when(j == n_t - 1)
+    def _flush():
+        for i in range(K):
+            out_ref[i * K : (i + 1) * K, :] = C_scr[i * K : (i + 1) * K, :]
 
 
-def _bwd_kernel(steps_ref, lens_ref, A_ref, B_ref, cs_ref, beta0_ref,
+def _bwd_kernel(steps_next_ref, lens_ref, A_ref, B_ref, cs_next_ref, beta0_ref,
                 betas_ref,
-                beta_scr, onext_scr, cnext_scr,
+                beta_scr,
                 *, K, S, Tt, T):
-    """Reverse t-walk storing ONLY the scaled beta vectors.
+    """Row-tiled reverse t-walk storing ONLY the scaled beta vectors.
 
     The count tensors are NOT accumulated here (an earlier version did and
     spent ~60 vreg ops/step on xi/gamma outer products inside the sequential
     loop) — they become time-parallel contractions over the stored
     alphas/betas in the JAX assembly below, where the MXU/VPU can batch them.
-    Per-step work is just the beta recurrence, comparable to the forward.
+
+    The inputs are TIME-SHIFTED in JAX (steps_next[t] = o_{t+1},
+    cs_next[t] = c_{t+1}) so every row the recurrence needs lives at its own
+    aligned tile position: no per-step dynamic sublane reads (which cost
+    ~3x the recurrence arithmetic) and no cross-row carries (whose 8-row
+    reversed unroll hit a Mosaic compiler abort).  The per-tile emission
+    select and 1/c reciprocals also hoist out of the sequential chain —
+    per-step work is one multiply, the K-term contraction, and two selects.
     """
     j = pl.program_id(1)
     n_t = pl.num_programs(1)
-    lt = steps_ref.shape[1]
     A = A_ref[:, :]
     B = B_ref[:, :]
     lens = lens_ref[0, :]
@@ -185,34 +212,32 @@ def _bwd_kernel(steps_ref, lens_ref, A_ref, B_ref, cs_ref, beta0_ref,
         # Per-lane entering beta: ones for independent chunks, the suffix
         # boundary message for lanes continuing a longer sequence.
         beta_scr[:, :] = beta0_ref[:, :]
-        onext_scr[0, :] = jnp.zeros((lt,), jnp.int32)
-        cnext_scr[0, :] = jnp.ones((lt,), jnp.float32)
 
-    # NOTE: not row-tiled like the forward — the 8-row reversed unroll with
-    # cross-row (o_next, c_next) carries hits a TPU compiler abort (SIGABRT
-    # in the Mosaic pipeline); the per-step dynamic row reads here cost ~25%
-    # of the pass, acceptable until the toolchain moves.
-    def body(tl_rev, beta_next):
-        tl = Tt - 1 - tl_rev
-        t = t0 + tl
-        # beta_{T-1} = 1 (the init); the recurrence covers t <= T-2.
-        active = t <= T - 2
-        at_edge = tl == Tt - 1
-        tl_n = jnp.minimum(tl + 1, Tt - 1)
-        o_next = jnp.where(at_edge, onext_scr[0, :], steps_ref[tl_n, :])
-        c_next = jnp.where(at_edge, cnext_scr[0, :], cs_ref[tl_n, :])
-        v_next = (t + 1) < lens
+    def body(tile_rev, beta_next):
+        base = Tt - ROW_TILE - tile_rev * ROW_TILE
+        on_tile = steps_next_ref[pl.ds(base, ROW_TILE), :]  # aligned [8, lt]
+        cn_tile = cs_next_ref[pl.ds(base, ROW_TILE), :]
+        # Off-chain per-tile precompute: w_scale[r] = B[:, o_{t+1}] / c_{t+1}
+        # for all 8 rows — vectorized, independent of the beta carry.
+        inv_cn = 1.0 / cn_tile  # [8, lt]
+        wscale = tuple(
+            _emit_sel(B, on_tile[r, :], K, S) * inv_cn[r, :][None, :]
+            for r in range(ROW_TILE)
+        )
+        for rr in range(ROW_TILE):
+            r = ROW_TILE - 1 - rr
+            t = t0 + base + r
+            # beta_{T-1} = 1 (the init); the recurrence covers t <= T-2.
+            active = t <= T - 2
+            v_next = (t + 1) < lens
+            w = wscale[r] * beta_next  # [K, lt]
+            beta_t = jnp.sum(A[:, :, None] * w[None, :, :], axis=1)
+            beta_t = jnp.where((active & v_next)[None, :], beta_t, beta_next)
+            betas_ref[base + r, :, :] = beta_t
+            beta_next = beta_t
+        return beta_next
 
-        w = _emit_sel(B, o_next, K, S) * beta_next / c_next  # [K, lt]
-        beta_t = jnp.sum(A[:, :, None] * w[None, :, :], axis=1)
-        beta_t = jnp.where((active & v_next)[None, :], beta_t, beta_next)
-        betas_ref[tl, :, :] = beta_t
-        return beta_t
-
-    beta = jax.lax.fori_loop(0, Tt, body, beta_scr[:, :])
-    beta_scr[:, :] = beta
-    onext_scr[0, :] = steps_ref[0, :]
-    cnext_scr[0, :] = cs_ref[0, :]
+    beta_scr[:, :] = jax.lax.fori_loop(0, Tt // ROW_TILE, body, beta_scr[:, :])
 
 
 def _run_fb_kernels(A, B, steps2, lens2, a0_raw, beta0, K, S, Tt, T):
@@ -254,6 +279,13 @@ def _run_fb_kernels(A, B, steps2, lens2, a0_raw, beta0, K, S, Tt, T):
     # sequential critical path.
     cs = jnp.sum(alphas, axis=1)  # [Tp, NL]
 
+    # Time-shifted views for the row-tiled backward: o_{t+1} / c_{t+1} land
+    # at aligned tile position t, so the kernel does only static-offset tile
+    # reads.  One cheap XLA pass (~1 ms at bench shapes) buys the removal of
+    # per-step dynamic sublane reads from the 2x-longer sequential walk.
+    steps_next = jnp.concatenate([steps2[1:], jnp.zeros((1, NL), steps2.dtype)], axis=0)
+    cs_next = jnp.concatenate([cs[1:], jnp.ones((1, NL), cs.dtype)], axis=0)
+
     # Reversed t-walk: input/output t-blocks indexed by (n_t-1-j).
     rev_step_spec = _vspec((Tt, LANE_TILE), lambda i, j: (n_t - 1 - j, i))
     (betas,) = pl.pallas_call(
@@ -275,11 +307,9 @@ def _run_fb_kernels(A, B, steps2, lens2, a0_raw, beta0, K, S, Tt, T):
         ],
         scratch_shapes=[
             pltpu.VMEM((K, LANE_TILE), jnp.float32),
-            pltpu.VMEM((1, LANE_TILE), jnp.int32),
-            pltpu.VMEM((1, LANE_TILE), jnp.float32),
         ],
         interpret=interpret,
-    )(steps2, lens2, A, B, cs, beta0)
+    )(steps_next, lens2, A, B, cs_next, beta0)
     return alphas, cs, betas
 
 
@@ -466,17 +496,28 @@ def _seq_stats_core(
     lane_lens = jnp.clip(length - jnp.arange(NL) * lane_T, 0, lane_T)
 
     # --- lane transfer operators (pallas) -> boundary messages (XLA) ------
+    # t tiled over the inner grid axis (scratch-carried running product), so
+    # lane_T is VMEM-unconstrained — 16 Ki+ lanes stream in t_tile blocks.
+    # The tile honors the caller's t_tile knob (rounded to ROW_TILE), same as
+    # the forward/backward kernels, so any lane_T divisible by it works.
     n_lt = NL // LANE_TILE
+    prod_Tt = min(lane_T, -(-min(t_tile, lane_T) // ROW_TILE) * ROW_TILE)
+    if lane_T % prod_Tt:
+        raise ValueError(
+            f"lane_T={lane_T} must be a multiple of the products t-tile "
+            f"({prod_Tt}, from t_tile={t_tile})"
+        )
     (prod_flat,) = pl.pallas_call(
-        functools.partial(_prod_kernel, K=K, S=S, bk=lane_T),
-        grid=(n_lt,),
+        functools.partial(_prod_kernel, K=K, S=S, bk=prod_Tt),
+        grid=(n_lt, lane_T // prod_Tt),
         in_specs=[
-            _vspec((lane_T, LANE_TILE), lambda i: (0, i)),
-            _vspec((K, K), lambda i: (0, 0)),
-            _vspec((K, S), lambda i: (0, 0)),
+            _vspec((prod_Tt, LANE_TILE), lambda i, j: (j, i)),
+            _vspec((K, K), lambda i, j: (0, 0)),
+            _vspec((K, S), lambda i, j: (0, 0)),
         ],
-        out_specs=[_vspec((K * K, LANE_TILE), lambda i: (0, i))],
+        out_specs=[_vspec((K * K, LANE_TILE), lambda i, j: (0, i))],
         out_shape=[jax.ShapeDtypeStruct((K * K, NL), jnp.float32)],
+        scratch_shapes=[pltpu.VMEM((K * K, LANE_TILE), jnp.float32)],
         interpret=_interpret(),
     )(sel_l.T, A, B)
     P = prod_flat.T.reshape(NL, K, K)  # P[lane, i, m]

@@ -1,0 +1,191 @@
+"""Device-side CpG island calling (clean semantics) — XLA cumulative ops.
+
+The host caller (ops.islands) is vectorized NumPy, but feeding it means
+shipping the whole decoded path (4 B/symbol) device->host and then scanning it
+on the host: at GRCh38 scale that is ~12 GB of PCIe traffic plus an O(T) host
+pass — together far more wall-clock than the sharded decode itself.  This
+module keeps the reduction ON DEVICE: the path goes in, only the compact
+(beg, end, length, gc, oe) records come out (a few hundred KiB), so the
+decode -> islands pipeline is one fused XLA program with no large transfer.
+
+Mechanics — all TPU-native cumulative/elementwise ops, chosen for O(1)
+compile scaling (an associative_scan ffill and a size-bounded flatnonzero
+both made XLA:TPU compile time grow superlinearly in T; cummax and one
+scatter do not):
+
+- island membership, run boundaries, and C/G/CpG event masks exactly as the
+  clean-mode host caller computes them;
+- per-run aggregates via cumulative sums plus a forward-fill of each run's
+  opening index and pre-opening cumsums.  Every filled quantity is
+  NONDECREASING in t, so `lax.cummax(where(opening, value, -1))` IS the
+  forward-fill of the last opening's value — no gathers, no segmented scan;
+- the <= ``cap`` surviving calls are compacted with one cumsum-indexed
+  scatter (`.at[target].set(..., mode="drop")` with an overflow dump slot).
+
+Only CLEAN semantics (compat quirk reproduction stays on the host path — it
+exists for byte-fidelity, not throughput).  Parity with
+ops.islands.call_islands(compat=False) is tested on random and adversarial
+paths (tests/test_islands_device.py).
+
+Reference scope: the island state machine, CpGIslandFinder.java:262-339.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from cpgisland_tpu.ops.islands import (
+    C_STATE,
+    G_STATE,
+    IslandCalls,
+    N_ISLAND_STATES,
+    _empty_calls,
+)
+
+# Default maximum number of emitted calls per invocation.  Real genomes carry
+# ~25-45k CpG islands TOTAL; 128 Ki per call site is a deep safety margin and
+# costs only ~5 MB of device output buffers.
+DEFAULT_CAP = 1 << 17
+
+
+def _ffill_at_openings(vals, opening):
+    """Forward-fill each val to the latest opening position's value.
+
+    Correct ONLY for vals nondecreasing in t (indices and cumsums are): the
+    running max over opening positions equals the value at the LAST opening.
+    Positions before the first opening fill with -1 (never read: a closing
+    position always has an opening at or before it).
+    """
+    return tuple(
+        jax.lax.cummax(jnp.where(opening, v, jnp.int32(-1))) for v in vals
+    )
+
+
+def _compact(keep, cols, cap):
+    """Pack cols[i][keep] into [cap] slots, in order; overflow drops."""
+    kpos = jnp.cumsum(keep.astype(jnp.int32)) - 1
+    tgt = jnp.where(keep, kpos, cap)  # cap = dump slot, dropped by mode
+    return tuple(
+        jnp.zeros(cap, c.dtype).at[tgt].set(c, mode="drop") for c in cols
+    )
+
+
+@functools.partial(
+    jax.jit, static_argnames=("cap", "min_len", "gc_threshold", "oe_threshold")
+)
+def _device_calls(
+    path,
+    cap: int,
+    min_len: Optional[int],
+    gc_threshold: float,
+    oe_threshold: float,
+):
+    """Jitted core: [T] path -> fixed-size call columns + true count."""
+    T = path.shape[0]
+    idx = jnp.arange(T, dtype=jnp.int32)
+    path = path.astype(jnp.int32)
+
+    in_mask = path < N_ISLAND_STATES
+    prev_in = jnp.concatenate([jnp.zeros(1, bool), in_mask[:-1]])
+    opening = in_mask & ~prev_in
+    next_in = jnp.concatenate([in_mask[1:], jnp.zeros(1, bool)])
+    closing = in_mask & ~next_in  # clean mode: a run at the end still closes
+
+    is_c = in_mask & (path == C_STATE)
+    is_g = in_mask & (path == G_STATE)
+    prev_c = jnp.concatenate([jnp.zeros(1, bool), is_c[:-1]])
+    cg_event = in_mask & prev_in & is_g & prev_c
+
+    cum_c = jnp.cumsum(is_c.astype(jnp.int32))
+    cum_g = jnp.cumsum(is_g.astype(jnp.int32))
+    cum_cg = jnp.cumsum(cg_event.astype(jnp.int32))
+
+    # Propagate each run's opening index and PRE-opening cumsums to every
+    # position of the run (so in particular to its closing position).
+    start_idx, c0, g0, cg0 = _ffill_at_openings(
+        (
+            idx,
+            cum_c - is_c.astype(jnp.int32),
+            cum_g - is_g.astype(jnp.int32),
+            cum_cg,  # cg_event is False at openings (prev_in is False there)
+        ),
+        opening,
+    )
+
+    length = idx - start_idx + 1
+    c_cnt = cum_c - c0
+    g_cnt = cum_g - g0
+    cg_cnt = cum_cg - cg0
+
+    lengthf = length.astype(jnp.float32)
+    gc = (c_cnt + g_cnt).astype(jnp.float32) / lengthf
+    both = (c_cnt > 0) & (g_cnt > 0)
+    # c*g in float32, not int32: a ~92k-symbol GC-rich run overflows the
+    # int32 product and would silently fail the oe filter.
+    cgprod = c_cnt.astype(jnp.float32) * g_cnt.astype(jnp.float32)
+    oe = jnp.where(
+        both,
+        cg_cnt.astype(jnp.float32) * lengthf / jnp.where(both, cgprod, 1.0),
+        0.0,
+    )
+
+    # The default gc cut evaluates integer-exactly (2*(C+G) > len avoids the
+    # f32-vs-f64 rounding flips the host caller can't see; the oe cut stays
+    # f32 — without x64 there is no wider type — which can flip calls whose
+    # oe sits within ~1e-7 of the threshold).
+    if gc_threshold == 0.5:
+        gc_pass = 2 * (c_cnt + g_cnt) > length
+    else:
+        gc_pass = gc > gc_threshold
+    keep = closing & gc_pass & (oe > oe_threshold)
+    if min_len is not None:
+        keep &= length > min_len
+
+    n = jnp.sum(keep.astype(jnp.int32))
+    starts_o, lasts_o, len_o, gc_o, oe_o = _compact(
+        keep, (start_idx, idx, length, gc, oe), cap
+    )
+    return starts_o, lasts_o, len_o, gc_o, oe_o, n
+
+
+def call_islands_device(
+    path,
+    *,
+    min_len: Optional[int] = None,
+    cap: int = DEFAULT_CAP,
+    gc_threshold: float = 0.5,
+    oe_threshold: float = 0.6,
+    offset: int = 0,
+) -> IslandCalls:
+    """Clean-mode island calls computed on device; returns host IslandCalls.
+
+    ``path`` may be a device array (stays resident — only the <= ``cap``
+    records move to host) or anything jnp.asarray accepts.  Raises if more
+    than ``cap`` calls survive the filters (raise the cap; each slot costs
+    ~40 bytes of device output).
+    """
+    path = jnp.asarray(path)
+    if path.shape[0] == 0:
+        return _empty_calls()
+    starts, lasts, length, gc, oe, n = _device_calls(
+        path, cap, min_len, float(gc_threshold), float(oe_threshold)
+    )
+    n = int(n)
+    if n > cap:
+        raise ValueError(
+            f"{n} island calls exceed cap={cap}; pass a larger cap "
+            "(each slot costs ~40 B of device output)"
+        )
+    sl = slice(0, n)
+    return IslandCalls(
+        beg=np.asarray(starts[sl]).astype(np.int64) + offset + 1,
+        end=np.asarray(lasts[sl]).astype(np.int64) + offset + 1,
+        length=np.asarray(length[sl]).astype(np.int64),
+        gc_content=np.asarray(gc[sl]).astype(np.float64),
+        oe_ratio=np.asarray(oe[sl]).astype(np.float64),
+    )

@@ -139,11 +139,15 @@ def viterbi_sharded(
     mesh: Optional[Mesh] = None,
     block_size: int = DEFAULT_BLOCK,
     engine: str = "auto",
-) -> np.ndarray:
+    return_device: bool = False,
+):
     """Decode one long sequence sharded over a mesh's devices.
 
     Pads with the PAD sentinel to a multiple of (devices * block_size) — PAD
-    steps are identity, so the result is exact.  Returns the [T] decoded path.
+    steps are identity, so the result is exact.  Returns the [T] decoded path
+    as host ndarray, or as a device-resident array with ``return_device=True``
+    (so a fused consumer — e.g. the device island caller — avoids the
+    4 B/symbol device->host transfer entirely).
     """
     if mesh is None:
         mesh = make_mesh(axis=SEQ_AXIS)
@@ -157,4 +161,7 @@ def viterbi_sharded(
 
     fn = _sharded_fn(mesh, block_size, resolve_engine(engine, params))
     arr = jax.device_put(jnp.asarray(obs), NamedSharding(mesh, P(mesh.axis_names[0])))
-    return np.asarray(fn(params, arr))[:T]
+    path = fn(params, arr)
+    if return_device:
+        return path[:T]
+    return np.asarray(path)[:T]

@@ -85,8 +85,11 @@ def initialize_multihost(
     Returns the global device count afterwards.
 
     After this, :func:`make_mesh` / :func:`make_mesh2d` / :func:`auto_mesh2d`
-    build GLOBAL meshes and the training/decode entry points run unchanged —
-    each host feeds its shard of the input (jax.process_index() selects it).
+    build GLOBAL meshes and the training entry points run unchanged — each
+    host feeds only its input shard: SpmdBackend.place selects this process's
+    contiguous chunk block (utils.chunking.process_shard) and assembles the
+    global array via jax.make_array_from_process_local_data, mirroring the
+    reference's HDFS input splits (CpGIslandFinder.java:108-147).
     """
     import jax.distributed as jd
 

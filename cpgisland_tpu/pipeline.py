@@ -23,6 +23,7 @@ import logging
 from dataclasses import dataclass
 from typing import IO, Optional, Union
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -157,6 +158,7 @@ def decode_file(
     span: int = CLEAN_DECODE_SPAN,
     engine: str = "auto",
     island_states=None,
+    island_engine: str = "auto",
     metrics: Optional[profiling.MetricsLogger] = None,
     timer: Optional[profiling.PhaseTimer] = None,
 ) -> DecodeResult:
@@ -173,6 +175,13 @@ def decode_file(
     don't encode bases — e.g. presets.two_state_cpg with island_states=(0,)
     — and call islands with membership from the path but base composition
     from the observations (ops.islands.call_islands_obs).
+
+    ``island_engine``: where the island caller runs in clean mode.  "device"
+    keeps the decoded path on device and reduces it there
+    (ops.islands_device) so only the compact call records cross to the host —
+    at genome scale the 4 B/symbol path transfer otherwise rivals the decode
+    itself.  "host" is the NumPy caller; "auto" picks device on TPU when the
+    8-state caller applies and no state-path dump is requested.
     """
     if island_states is not None and compat:
         raise ValueError("island_states needs clean mode (compat=False); the "
@@ -180,6 +189,22 @@ def decode_file(
     err = island_layout_error(params, island_states)
     if err:
         raise ValueError(err)
+    if island_engine not in ("auto", "host", "device"):
+        raise ValueError(f"island_engine must be auto|host|device, got {island_engine!r}")
+    device_eligible = (
+        not compat and island_states is None and state_path_out is None
+    )
+    if island_engine == "device" and not device_eligible:
+        raise ValueError(
+            "island_engine='device' implements clean-mode 8-state calling "
+            "without a state-path dump (compat quirks and the "
+            "observation-based caller are host-only)"
+        )
+    use_device_islands = island_engine == "device" or (
+        island_engine == "auto"
+        and device_eligible
+        and jax.default_backend() == "tpu"
+    )
     timer = timer if timer is not None else profiling.PhaseTimer()
     batch_decode = (
         viterbi_pallas_batch
@@ -254,12 +279,22 @@ def decode_file(
             )
         with timer.phase("decode", items=float(symbols.size), unit="sym"):
             pieces = [
-                viterbi_sharded(params, symbols[lo : lo + span], engine=engine)
+                viterbi_sharded(
+                    params, symbols[lo : lo + span], engine=engine,
+                    return_device=use_device_islands,
+                )
                 for lo in range(0, symbols.size, span)
             ] or [np.zeros(0, dtype=np.int32)]
-            full = np.concatenate(pieces)
+            if use_device_islands:
+                full = pieces[0] if len(pieces) == 1 else jnp.concatenate(pieces)
+            else:
+                full = np.concatenate(pieces)
         with timer.phase("islands", items=float(symbols.size), unit="sym"):
-            if island_states is not None:
+            if use_device_islands:
+                from cpgisland_tpu.ops.islands_device import call_islands_device
+
+                calls = call_islands_device(full, min_len=min_len)
+            elif island_states is not None:
                 calls = islands_mod.call_islands_obs(
                     full, symbols, island_states=island_states, min_len=min_len
                 )

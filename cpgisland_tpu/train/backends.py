@@ -140,8 +140,35 @@ class SpmdBackend(EStepBackend):
         return chunking.pad_to_multiple(chunked, self.mesh.shape[self.axis])
 
     def place(self, chunks, lengths):
+        """Device-place a prepared GLOBAL batch on the mesh.
+
+        Single-process: one device_put with the data-axis sharding.
+        Multi-host (jax.process_count() > 1, after initialize_multihost):
+        every process passes the same global batch; this host keeps only its
+        contiguous block (utils.chunking.process_shard — the HDFS-input-split
+        equivalent, CpGIslandFinder.java:108-147) and assembles the global
+        array from the local shard, so no host uploads rows it doesn't own.
+        """
         self._check_divisible(chunks)
         sharding = NamedSharding(self.mesh, P(self.axis))
+        if jax.process_count() > 1:
+            chunks = np.asarray(chunks)
+            lengths = np.asarray(lengths)
+            local = chunking.process_shard(
+                chunking.Chunked(
+                    chunks=chunks, lengths=lengths, total=int(lengths.sum())
+                ),
+                jax.process_index(),
+                jax.process_count(),
+            )
+            return (
+                jax.make_array_from_process_local_data(
+                    sharding, local.chunks, chunks.shape
+                ),
+                jax.make_array_from_process_local_data(
+                    sharding, local.lengths, lengths.shape
+                ),
+            )
         return (
             jax.device_put(jnp.asarray(chunks), sharding),
             jax.device_put(jnp.asarray(lengths), sharding),

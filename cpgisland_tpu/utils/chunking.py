@@ -65,6 +65,36 @@ def frame(symbols: np.ndarray, chunk_size: int, *, drop_remainder: bool = False)
     return Chunked(chunks=chunks, lengths=lengths, total=n)
 
 
+def process_shard(
+    chunked: Chunked,
+    process_index: int,
+    process_count: int,
+) -> Chunked:
+    """THIS host's contiguous block of a globally-framed chunk batch.
+
+    The multi-host input-sharding step (SURVEY.md §5 DCN role), mirroring the
+    reference's HDFS input splits (CpGIslandFinder.java:108-147): the global
+    batch is padded with empty chunks to a process_count multiple and process
+    p takes rows [p*n_local, (p+1)*n_local).  Contiguous blocks — not strided
+    rows — so the local block lines up with the process's addressable devices
+    under a NamedSharding over the data axis (global device order enumerates
+    process 0's devices first), which is what
+    ``jax.make_array_from_process_local_data`` assumes in SpmdBackend.place.
+
+    ``total`` in the result is the LOCAL real-symbol count (this shard's
+    contribution); the union of all shards covers every global chunk exactly
+    once.
+    """
+    if not (0 <= process_index < process_count):
+        raise ValueError(f"process_index {process_index} not in [0, {process_count})")
+    padded = pad_to_multiple(chunked, process_count)
+    n_local = padded.num_chunks // process_count
+    lo = process_index * n_local
+    chunks = padded.chunks[lo : lo + n_local]
+    lengths = padded.lengths[lo : lo + n_local]
+    return Chunked(chunks=chunks, lengths=lengths, total=int(lengths.sum()))
+
+
 def pad_to_multiple(chunked: Chunked, multiple: int) -> Chunked:
     """Pad the batch dim with empty (all-PAD, length-0) chunks to a multiple.
 

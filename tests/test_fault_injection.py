@@ -1,0 +1,122 @@
+"""Fault injection with REAL in-jit failures.
+
+tests/test_elastic.py injects faults by raising from Python wrappers around
+the backend call; these tests instead provoke errors from INSIDE a jitted
+computation (a jax.pure_callback that raises during device execution), which
+surfaces as jaxlib's XlaRuntimeError — the exact error class fit()'s recovery
+policy claims to catch (train/baum_welch.py: "RuntimeError covers jaxlib's
+XlaRuntimeError (OOM, preemption, interconnect)").  This closes the r1 gap
+where the retry path was only ever exercised against hand-raised Python
+exceptions.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cpgisland_tpu.models import presets
+from cpgisland_tpu.ops.forward_backward import SuffStats, batch_stats
+from cpgisland_tpu.train import backends, baum_welch
+from cpgisland_tpu.train.elastic import ElasticEStep
+from cpgisland_tpu.utils import chunking
+
+
+class InJitFaultBackend(backends.EStepBackend):
+    """E-step whose jitted computation fails on device for the first
+    ``fail_times`` executions, then succeeds — a deterministic stand-in for
+    a transient device fault (preemption, interconnect hiccup)."""
+
+    def __init__(self, fail_times: int):
+        self.fail_times = fail_times
+        self.executions = 0
+
+        def guard(ll):
+            self.executions += 1
+            if self.executions <= self.fail_times:
+                raise RuntimeError("injected device fault")
+            return ll
+
+        @jax.jit
+        def estep(params, chunks, lengths):
+            st = batch_stats(params, chunks, lengths, mode="rescaled")
+            # Thread the loglik through a host callback that raises: the
+            # failure happens during device-side execution of the jitted
+            # program, not in Python around it.
+            poked = jax.pure_callback(
+                guard, jax.ShapeDtypeStruct((), st.loglik.dtype), st.loglik
+            )
+            return SuffStats(
+                init=st.init, trans=st.trans, emit=st.emit,
+                loglik=poked, n_seqs=st.n_seqs,
+            )
+
+        self._estep = estep
+
+    def __call__(self, params, chunks, lengths):
+        return self._estep(params, jnp.asarray(chunks), jnp.asarray(lengths))
+
+
+def _chunked(rng):
+    return chunking.frame(rng.integers(0, 4, size=2048).astype(np.uint8), 256)
+
+
+def test_injit_fault_is_xla_runtime_error(rng):
+    """Precondition for everything below: the injected failure really is an
+    XlaRuntimeError (RuntimeError subclass) raised at materialization."""
+    bad = InJitFaultBackend(fail_times=10)
+    ck = _chunked(rng)
+    with pytest.raises(RuntimeError, match="injected device fault"):
+        st = bad(presets.durbin_cpg8(), ck.chunks, ck.lengths)
+        np.asarray(st.loglik)
+
+
+def test_fit_retries_through_injit_fault(rng):
+    """One in-jit failure -> the same-backend retry recovers; training
+    completes with no fallback and no recovery record."""
+    bad = InJitFaultBackend(fail_times=1)
+    res = baum_welch.fit(
+        presets.durbin_cpg8(), _chunked(rng), num_iters=2, convergence=0.0,
+        backend=bad,
+    )
+    assert res.iterations == 2
+    assert all(np.isfinite(ll) for ll in res.logliks)
+    assert res.recoveries == []
+    assert bad.executions >= 3  # 1 failed + 2 good iterations
+
+
+def test_fit_falls_back_after_injit_faults(rng):
+    """Two consecutive in-jit failures -> fit switches to the fallback
+    backend and records the recovery."""
+    bad = InJitFaultBackend(fail_times=10**9)  # never recovers
+    res = baum_welch.fit(
+        presets.durbin_cpg8(), _chunked(rng), num_iters=2, convergence=0.0,
+        backend=bad, fallback_backend=backends.LocalBackend(engine="xla"),
+    )
+    assert res.iterations == 2
+    assert all(np.isfinite(ll) for ll in res.logliks)
+    assert len(res.recoveries) == 1
+    assert "injected device fault" in res.recoveries[0][1]
+
+
+def test_fit_raises_after_exhausted_injit_retries(rng):
+    bad = InJitFaultBackend(fail_times=10**9)
+    with pytest.raises(RuntimeError, match="injected device fault"):
+        baum_welch.fit(
+            presets.durbin_cpg8(), _chunked(rng), num_iters=1, convergence=0.0,
+            backend=bad,
+        )
+
+
+def test_elastic_skips_injit_faulting_slice(rng):
+    """ElasticEStep against a backend whose jitted program fails on device
+    for its first attempts: the slice retries, then drops under
+    on_failure='skip', and the surviving statistics stay usable."""
+    ck = _chunked(rng)
+    bad = InJitFaultBackend(fail_times=10**9)
+    el = ElasticEStep(bad, micro_batches=2, max_retries=1, on_failure="skip")
+    el_ck = el.prepare(ck)
+    with pytest.raises(RuntimeError, match="all .* micro-batches failed"):
+        el(presets.durbin_cpg8(), el_ck.chunks, el_ck.lengths)
+    assert len(el.failures) == 2
+    assert all("injected device fault" in f.error for f in el.failures)

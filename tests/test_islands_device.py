@@ -1,0 +1,139 @@
+"""Device island caller == clean-mode host caller, on every edge shape."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cpgisland_tpu.ops import islands as host_islands
+from cpgisland_tpu.ops.islands_device import call_islands_device
+
+
+def _assert_same(dev, host):
+    np.testing.assert_array_equal(dev.beg, host.beg)
+    np.testing.assert_array_equal(dev.end, host.end)
+    np.testing.assert_array_equal(dev.length, host.length)
+    np.testing.assert_allclose(dev.gc_content, host.gc_content, rtol=2e-6)
+    np.testing.assert_allclose(dev.oe_ratio, host.oe_ratio, rtol=2e-6)
+
+
+def _host(path, **kw):
+    return host_islands.call_islands(path, compat=False, **kw)
+
+
+def test_matches_host_random_paths(rng):
+    for T in (1, 2, 7, 1000, 4097):
+        path = rng.integers(0, 8, size=T).astype(np.int32)
+        _assert_same(call_islands_device(path), _host(path))
+
+
+def test_matches_host_islandy_paths(rng):
+    """CpG-dense paths: long + runs rich in C/G states."""
+    parts = []
+    for _ in range(30):
+        parts.append(rng.integers(4, 8, size=rng.integers(1, 300)))
+        parts.append(rng.choice([1, 2], size=rng.integers(1, 400)))
+    path = np.concatenate(parts).astype(np.int32)
+    _assert_same(call_islands_device(path), _host(path))
+
+
+def test_edge_runs(rng):
+    # open at start, open at end, whole-path island, no islands, alternating
+    cases = [
+        np.array([1, 2, 1, 2, 4, 4], np.int32),
+        np.array([4, 4, 1, 2, 1, 2], np.int32),  # open at end: clean emits it
+        np.array([2, 1, 2, 1], np.int32),
+        np.array([4, 5, 6, 7], np.int32),
+        np.tile([1, 4], 50).astype(np.int32),
+    ]
+    for path in cases:
+        _assert_same(call_islands_device(path), _host(path))
+
+
+def test_min_len_and_offset(rng):
+    path = np.concatenate(
+        [rng.choice([1, 2], size=300), [4], rng.choice([1, 2], size=150), [4]]
+    ).astype(np.int32)
+    _assert_same(
+        call_islands_device(path, min_len=200),
+        _host(path, min_len=200, chunk=0),
+    )
+    # offset shifts 1-based coordinates
+    base = call_islands_device(path, min_len=200)
+    dev = call_islands_device(path, min_len=200, offset=1000)
+    np.testing.assert_array_equal(dev.beg, base.beg + 1000)
+    np.testing.assert_array_equal(dev.end, base.end + 1000)
+
+
+def test_cap_overflow_raises(rng):
+    path = np.tile([1, 2, 4], 100).astype(np.int32)  # many 2-long islands
+    with pytest.raises(ValueError, match="cap"):
+        call_islands_device(path, cap=4)
+
+
+def test_device_array_input(rng):
+    path = rng.integers(0, 8, size=2048).astype(np.int32)
+    _assert_same(call_islands_device(jnp.asarray(path)), _host(path))
+
+
+def test_empty_path():
+    out = call_islands_device(np.zeros(0, np.int32))
+    assert len(out) == 0
+
+
+def test_long_island_no_int32_overflow(rng):
+    """A 120k-symbol GC-rich run has c*g ~ 3.6e9 > 2^31: the oe product must
+    not wrap negative and silently drop the island (r2 review finding)."""
+    path = np.concatenate(
+        [[4], np.tile([1, 2], 60_000), [4]]
+    ).astype(np.int32)
+    dev = call_islands_device(path)
+    host = _host(path)
+    assert len(host) == 1
+    assert len(dev) == 1
+    np.testing.assert_array_equal(dev.beg, host.beg)
+    np.testing.assert_allclose(dev.oe_ratio, host.oe_ratio, rtol=1e-5)
+
+
+def test_decode_file_island_engine_parity(tmp_path, rng):
+    """decode_file(island_engine='device') == 'host' on a planted-island file."""
+    from cpgisland_tpu import pipeline
+    from cpgisland_tpu.models import presets
+
+    fa = tmp_path / "g.fa"
+    with open(fa, "w") as f:
+        f.write(">c\n")
+        parts = []
+        for _ in range(3):
+            parts.append(rng.choice(list("acgt"), size=3000, p=[0.35, 0.15, 0.15, 0.35]))
+            parts.append(rng.choice(list("acgt"), size=700, p=[0.08, 0.42, 0.42, 0.08]))
+        s = "".join(np.concatenate(parts))
+        for i in range(0, len(s), 70):
+            f.write(s[i : i + 70] + "\n")
+    host = pipeline.decode_file(str(fa), presets.durbin_cpg8(), compat=False,
+                                island_engine="host")
+    dev = pipeline.decode_file(str(fa), presets.durbin_cpg8(), compat=False,
+                               island_engine="device")
+    assert len(dev.calls) == len(host.calls) > 0
+    np.testing.assert_array_equal(dev.calls.beg, host.calls.beg)
+    np.testing.assert_array_equal(dev.calls.end, host.calls.end)
+    np.testing.assert_allclose(dev.calls.gc_content, host.calls.gc_content, rtol=2e-6)
+    np.testing.assert_allclose(dev.calls.oe_ratio, host.calls.oe_ratio, rtol=2e-6)
+
+
+def test_decode_file_island_engine_validation(tmp_path):
+    from cpgisland_tpu import pipeline
+    from cpgisland_tpu.models import presets
+
+    fa = tmp_path / "g.fa"
+    fa.write_text(">c\nacgtacgt\n")
+    with pytest.raises(ValueError, match="island_engine"):
+        pipeline.decode_file(str(fa), presets.durbin_cpg8(), island_engine="gpu")
+    # device caller can't reproduce compat quirks or dump the state path
+    with pytest.raises(ValueError, match="clean-mode"):
+        pipeline.decode_file(str(fa), presets.durbin_cpg8(), compat=True,
+                             island_engine="device")
+    with pytest.raises(ValueError, match="clean-mode"):
+        pipeline.decode_file(
+            str(fa), presets.durbin_cpg8(), compat=False,
+            island_engine="device", state_path_out=str(tmp_path / "p.npy"),
+        )
