@@ -59,7 +59,7 @@ def _best_wall(fn, reps: int = 3) -> float:
 
 
 def bench_decode(
-    n_symbols: int, engine: str = "auto", params=None, tag: str = "", chain: int = 4
+    n_symbols: int, engine: str = "auto", params=None, tag: str = "", chain: int = 6
 ) -> float:
     """Steady-state single-chip blockwise-parallel Viterbi throughput (sym/s).
 
@@ -106,7 +106,7 @@ def bench_decode(
 
 
 def bench_em(
-    n_chunks: int, chunk_size: int = 0x10000, engine: str = "auto", chain: int = 8
+    n_chunks: int, chunk_size: int = 0x10000, engine: str = "auto", chain: int = 24
 ) -> float:
     """Steady-state single-chip E-step+M-step throughput (sym/s per EM iter).
 
@@ -166,7 +166,7 @@ def bench_em(
 
 
 def bench_batched_decode(
-    n_seqs: int, seq_len: int, engine: str = "auto", chain: int = 4
+    n_seqs: int, seq_len: int, engine: str = "auto", chain: int = 6
 ) -> float:
     """Batched (vmap) multi-genome decode throughput in sym/s (BASELINE.md
     config 5): N independent sequences decoded as one [N, T] batch."""
@@ -208,7 +208,7 @@ def bench_batched_decode(
     return tput
 
 
-def bench_em_2state(n_chunks: int, chunk_size: int = 0x10000, chain: int = 8) -> float:
+def bench_em_2state(n_chunks: int, chunk_size: int = 0x10000, chain: int = 24) -> float:
     """2-state model EM throughput in sym/s/iter (BASELINE.md config 2)."""
     import jax
     import jax.numpy as jnp

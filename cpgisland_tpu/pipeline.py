@@ -144,6 +144,12 @@ class DecodeResult:
 # with a DP restart at span boundaries (logged).
 CLEAN_DECODE_SPAN = 1 << 28
 
+# Records at or below this size batch together into one vmap decode (clean
+# mode): real assemblies carry hundreds of small scaffolds beside the ~24
+# chromosomes, and decoding them one dispatch at a time leaves the chip idle
+# between launches.  4 Mi covers every GRCh38 non-chromosome scaffold.
+SMALL_RECORD_MAX = 4 << 20
+
 
 def decode_file(
     test_path: str,
@@ -263,19 +269,16 @@ def decode_file(
     n_sym = 0
     n_records = 0
     n_spans_total = 0
-    for rec_name, symbols in codec.iter_fasta_records(test_path):
-        n_records += 1
-        n_sym += symbols.size
+
+    def decode_one(rec_name: str, symbols: np.ndarray) -> None:
+        nonlocal n_spans_total
         n_spans = max(1, -(-symbols.size // span))
         n_spans_total += n_spans
         if n_spans > 1:
             log.warning(
                 "record %r (%d symbols) exceeds the exact-decode span (%d); "
                 "decoding %d spans with a DP restart at each span boundary",
-                rec_name,
-                symbols.size,
-                span,
-                n_spans,
+                rec_name, symbols.size, span, n_spans,
             )
         with timer.phase("decode", items=float(symbols.size), unit="sym"):
             pieces = [
@@ -287,6 +290,10 @@ def decode_file(
             ] or [np.zeros(0, dtype=np.int32)]
             if use_device_islands:
                 full = pieces[0] if len(pieces) == 1 else jnp.concatenate(pieces)
+                # Async dispatch would land the decode's device time in the
+                # islands phase — block here so the per-phase stats the bench
+                # publishes attribute work where it happened.
+                jax.block_until_ready(full)
             else:
                 full = np.concatenate(pieces)
         with timer.phase("islands", items=float(symbols.size), unit="sym"):
@@ -304,7 +311,43 @@ def decode_file(
         # (a bare "" would emit a leading space and split into 5 fields).
         parts.append(calls.with_names(rec_name or "."))
         if state_path_out is not None:
-            paths_out.append(full.astype(np.int8))
+            paths_out.append(np.asarray(full).astype(np.int8))
+
+    def flush_small(batch: list) -> None:
+        nonlocal n_spans_total
+        if not batch:
+            return
+        if len(batch) == 1:
+            decode_one(*batch[0])
+            return
+        n_spans_total_add, batch_parts, batch_paths = _decode_small_batch(
+            params, batch, batch_decode=batch_decode, min_len=min_len,
+            island_states=island_states,
+            use_device_islands=use_device_islands,
+            want_paths=state_path_out is not None,
+            timer=timer,
+        )
+        n_spans_total += n_spans_total_add
+        parts.extend(batch_parts)
+        paths_out.extend(batch_paths)
+
+    # Small records (scaffolds) batch into one vmap decode per device_batch;
+    # large records go through the sequence-parallel sharded decode.  Order
+    # is preserved: a large record flushes the pending batch first.
+    pending: list = []
+    for rec_name, symbols in codec.iter_fasta_records(test_path):
+        n_records += 1
+        n_sym += symbols.size
+        if symbols.size <= SMALL_RECORD_MAX:
+            pending.append((rec_name, symbols))
+            if len(pending) >= device_batch:
+                flush_small(pending)
+                pending = []
+        else:
+            flush_small(pending)
+            pending = []
+            decode_one(rec_name, symbols)
+    flush_small(pending)
     calls = IslandCalls.concatenate(parts)
     if n_records <= 1:
         # Single-record files keep the reference's bare 5-column format.
@@ -326,6 +369,100 @@ def decode_file(
             np.concatenate(paths_out) if paths_out else np.zeros(0, np.int8),
         )
     return _finish_decode(calls, n_sym, n_spans_total, islands_out)
+
+
+def _round_pow2(n: int, floor: int = 1 << 16) -> int:
+    p = floor
+    while p < n:
+        p <<= 1
+    return p
+
+
+def _decode_small_batch(
+    params: HmmParams,
+    batch: list,
+    *,
+    batch_decode,
+    min_len,
+    island_states,
+    use_device_islands: bool,
+    want_paths: bool,
+    timer: profiling.PhaseTimer,
+):
+    """Decode a batch of small records as vmap lanes; islands per record.
+
+    Rows pad to a power-of-two time bucket and a fixed row count so the
+    compile cache stays small across many scaffold shapes.  With device
+    islands the whole padded batch flattens into ONE island call: masked
+    tail positions become background state, plus one separator column, so
+    runs can never cross records and each call's record is recovered from
+    its coordinate.  Returns (n_spans, [IslandCalls per record], [paths]).
+    """
+    from cpgisland_tpu.ops.islands import N_ISLAND_STATES
+
+    B = len(batch)
+    sizes = [s.size for _, s in batch]
+    Tpad = _round_pow2(max(sizes + [1]))
+    Bp = _round_pow2(B, floor=8)
+    rows = np.full((Bp, Tpad), chunking.PAD_SYMBOL, np.uint8)
+    for i, (_, s) in enumerate(batch):
+        rows[i, : s.size] = s
+    lengths = np.zeros(Bp, np.int32)
+    lengths[:B] = sizes
+
+    total = float(sum(sizes))
+    with timer.phase("decode", items=total, unit="sym"):
+        paths = batch_decode(
+            params, jnp.asarray(rows.astype(np.int32)), jnp.asarray(lengths),
+            return_score=False,
+        )
+        if use_device_islands:
+            # Block so per-phase stats attribute the decode where it happened
+            # (async dispatch would bill it to the islands phase).
+            jax.block_until_ready(paths)
+        else:
+            paths = np.asarray(paths)
+
+    parts: list[IslandCalls] = []
+    paths_out: list[np.ndarray] = []
+    with timer.phase("islands", items=total, unit="sym"):
+        if use_device_islands:
+            from cpgisland_tpu.ops.islands_device import call_islands_device
+
+            stride = Tpad + 1
+            mask = jnp.arange(Tpad)[None, :] < jnp.asarray(lengths)[:, None]
+            masked = jnp.where(mask, paths, N_ISLAND_STATES)
+            sep = jnp.full((Bp, 1), N_ISLAND_STATES, masked.dtype)
+            flat = jnp.concatenate([masked, sep], axis=1).reshape(-1)
+            all_calls = call_islands_device(flat, min_len=min_len)
+            rec_of = (all_calls.beg - 1) // stride
+            for i, (name, _) in enumerate(batch):
+                sel = rec_of == i
+                parts.append(
+                    IslandCalls(
+                        beg=all_calls.beg[sel] - i * stride,
+                        end=all_calls.end[sel] - i * stride,
+                        length=all_calls.length[sel],
+                        gc_content=all_calls.gc_content[sel],
+                        oe_ratio=all_calls.oe_ratio[sel],
+                    ).with_names(name or ".")
+                )
+        else:
+            for i, (name, symbols) in enumerate(batch):
+                row = paths[i, : symbols.size]
+                if island_states is not None:
+                    calls = islands_mod.call_islands_obs(
+                        row, symbols, island_states=island_states, min_len=min_len
+                    )
+                else:
+                    calls = islands_mod.call_islands(
+                        row, chunk=0, compat=False, min_len=min_len
+                    )
+                parts.append(calls.with_names(name or "."))
+    if want_paths:
+        host = np.asarray(paths)
+        paths_out = [host[i, : s.size].astype(np.int8) for i, (_, s) in enumerate(batch)]
+    return B, parts, paths_out
 
 
 def _finish_decode(calls, n_symbols, n_chunks, islands_out) -> DecodeResult:

@@ -120,6 +120,64 @@ def test_decode_file_island_engine_parity(tmp_path, rng):
     np.testing.assert_allclose(dev.calls.oe_ratio, host.calls.oe_ratio, rtol=2e-6)
 
 
+def _write_multiscaffold(tmp_path, rng, sizes):
+    fa = tmp_path / "multi.fa"
+    with open(fa, "w") as f:
+        for i, n in enumerate(sizes):
+            f.write(f">scaf{i}\n")
+            parts = [rng.choice(list("acgt"), size=max(1, n - 700), p=[0.35, 0.15, 0.15, 0.35])]
+            if n > 700:
+                parts.append(rng.choice(list("acgt"), size=700, p=[0.08, 0.42, 0.42, 0.08]))
+            s = "".join(np.concatenate(parts))[:n]
+            for j in range(0, len(s), 70):
+                f.write(s[j : j + 70] + "\n")
+    return fa
+
+
+def test_decode_file_small_record_batching_parity(tmp_path, rng):
+    """Many small scaffolds take the batched vmap path; records must keep
+    their order, names, per-record coordinates, and exactly the calls the
+    one-record-at-a-time path produces (device and host island engines)."""
+    from cpgisland_tpu import pipeline
+    from cpgisland_tpu.models import presets
+
+    sizes = [1500, 4100, 900, 2300, 3700, 1100, 2900, 1700, 2100, 1300, 999]
+    fa = _write_multiscaffold(tmp_path, rng, sizes)
+    params = presets.durbin_cpg8()
+
+    batched_host = pipeline.decode_file(str(fa), params, compat=False,
+                                        island_engine="host")
+    batched_dev = pipeline.decode_file(str(fa), params, compat=False,
+                                       island_engine="device")
+    # Reference: force the serial path by making every record "large".
+    serial = pipeline.decode_file(str(fa), params, compat=False,
+                                  island_engine="host", device_batch=1)
+    for got in (batched_host, batched_dev):
+        assert len(got.calls) == len(serial.calls) > 0
+        np.testing.assert_array_equal(got.calls.names, serial.calls.names)
+        np.testing.assert_array_equal(got.calls.beg, serial.calls.beg)
+        np.testing.assert_array_equal(got.calls.end, serial.calls.end)
+        np.testing.assert_allclose(got.calls.gc_content, serial.calls.gc_content, rtol=2e-6)
+        np.testing.assert_allclose(got.calls.oe_ratio, serial.calls.oe_ratio, rtol=2e-6)
+
+
+def test_decode_file_mixed_large_small_preserves_order(tmp_path, rng, monkeypatch):
+    """A large record between small ones must flush the pending batch first
+    so the output record order matches the file order."""
+    from cpgisland_tpu import pipeline
+    from cpgisland_tpu.models import presets
+
+    # Shrink the 'large' threshold so the middle record takes the sharded path.
+    monkeypatch.setattr(pipeline, "SMALL_RECORD_MAX", 2000)
+    sizes = [1500, 900, 5000, 1100, 1300]
+    fa = _write_multiscaffold(tmp_path, rng, sizes)
+    res = pipeline.decode_file(str(fa), presets.durbin_cpg8(), compat=False,
+                               island_engine="host")
+    names = list(dict.fromkeys(res.calls.names))
+    expect = [f"scaf{i}" for i in range(5) if f"scaf{i}" in set(res.calls.names)]
+    assert names == expect
+
+
 def test_decode_file_island_engine_validation(tmp_path):
     from cpgisland_tpu import pipeline
     from cpgisland_tpu.models import presets
