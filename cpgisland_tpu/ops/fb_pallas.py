@@ -48,9 +48,10 @@ from cpgisland_tpu.ops.viterbi_pallas import MAX_PACK_STATES, _interpret, _vspec
 LANE_TILE = 128
 DEFAULT_T_TILE = 512
 # Whole-sequence lane length, swept on v5e with chained (dispatch-latency-
-# free) timing: 8192 -> 378 Msym/s, 16384 -> 365.  Any multiple of the
-# t-tile compiles now that the products kernel streams t in tiles; 8192
-# stays the sweet spot.  Shared by the single-device and shard_map entries.
+# free) timing: 8192 -> ~500 Msym/s with the 256-lane fwd/bwd tiles
+# (16384 measured no better; widening the products kernel's lanes measured
+# flat — it is op-bound).  Any multiple of the t-tile compiles now that the
+# products kernel streams t in tiles.  Shared by single-device + shard_map.
 DEFAULT_LANE_T = 8192
 
 

@@ -164,4 +164,16 @@ def viterbi_sharded(
     path = fn(params, arr)
     if return_device:
         return path[:T]
+    if not path.is_fully_addressable:
+        # Multi-host global mesh: the sharded output spans non-addressable
+        # devices, so a plain fetch raises; gather every host a full copy
+        # over DCN (the host-side path is for island calling / dumps, which
+        # every process replicates anyway).  Gating on addressability — not
+        # process_count — keeps per-host meshes in multi-process jobs on the
+        # direct fetch, where a gather would splice other hosts' unrelated
+        # decodes.  Device-resident consumers should prefer
+        # return_device=True and reduce on device instead.
+        from jax.experimental import multihost_utils
+
+        return np.asarray(multihost_utils.process_allgather(path, tiled=True))[:T]
     return np.asarray(path)[:T]

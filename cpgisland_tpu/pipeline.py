@@ -165,6 +165,7 @@ def decode_file(
     engine: str = "auto",
     island_states=None,
     island_engine: str = "auto",
+    island_cap: Optional[int] = None,
     metrics: Optional[profiling.MetricsLogger] = None,
     timer: Optional[profiling.PhaseTimer] = None,
 ) -> DecodeResult:
@@ -181,6 +182,10 @@ def decode_file(
     don't encode bases — e.g. presets.two_state_cpg with island_states=(0,)
     — and call islands with membership from the path but base composition
     from the observations (ops.islands.call_islands_obs).
+
+    ``island_cap``: maximum island calls per device invocation (device
+    engine only; default ops.islands_device.DEFAULT_CAP).  Batched small
+    records share one cap per flush — raise it for island-saturated inputs.
 
     ``island_engine``: where the island caller runs in clean mode.  "device"
     keeps the decoded path on device and reduces it there
@@ -211,6 +216,10 @@ def decode_file(
         and device_eligible
         and jax.default_backend() == "tpu"
     )
+    if island_cap is None:
+        from cpgisland_tpu.ops.islands_device import DEFAULT_CAP
+
+        island_cap = DEFAULT_CAP
     timer = timer if timer is not None else profiling.PhaseTimer()
     batch_decode = (
         viterbi_pallas_batch
@@ -300,7 +309,7 @@ def decode_file(
             if use_device_islands:
                 from cpgisland_tpu.ops.islands_device import call_islands_device
 
-                calls = call_islands_device(full, min_len=min_len)
+                calls = call_islands_device(full, min_len=min_len, cap=island_cap)
             elif island_states is not None:
                 calls = islands_mod.call_islands_obs(
                     full, symbols, island_states=island_states, min_len=min_len
@@ -324,6 +333,7 @@ def decode_file(
             params, batch, batch_decode=batch_decode, min_len=min_len,
             island_states=island_states,
             use_device_islands=use_device_islands,
+            island_cap=island_cap,
             want_paths=state_path_out is not None,
             timer=timer,
         )
@@ -386,6 +396,7 @@ def _decode_small_batch(
     min_len,
     island_states,
     use_device_islands: bool,
+    island_cap: int,
     want_paths: bool,
     timer: profiling.PhaseTimer,
 ):
@@ -412,8 +423,10 @@ def _decode_small_batch(
 
     total = float(sum(sizes))
     with timer.phase("decode", items=total, unit="sym"):
+        # uint8 upload (the decoders cast on device): the host->device
+        # transfer is the measured end-to-end bottleneck — don't 4x it.
         paths = batch_decode(
-            params, jnp.asarray(rows.astype(np.int32)), jnp.asarray(lengths),
+            params, jnp.asarray(rows), jnp.asarray(lengths),
             return_score=False,
         )
         if use_device_islands:
@@ -434,7 +447,7 @@ def _decode_small_batch(
             masked = jnp.where(mask, paths, N_ISLAND_STATES)
             sep = jnp.full((Bp, 1), N_ISLAND_STATES, masked.dtype)
             flat = jnp.concatenate([masked, sep], axis=1).reshape(-1)
-            all_calls = call_islands_device(flat, min_len=min_len)
+            all_calls = call_islands_device(flat, min_len=min_len, cap=island_cap)
             rec_of = (all_calls.beg - 1) // stride
             for i, (name, _) in enumerate(batch):
                 sel = rec_of == i
