@@ -25,6 +25,16 @@ jax.config.update("jax_platforms", _platform)
 import numpy as np
 import pytest
 
+# On the DEFAULT (virtual CPU) platform the 8-device mesh is a hard
+# requirement: if it silently came up with fewer devices, every
+# require_devices(8) test would skip and CI would go green with the entire
+# SPMD/shard_map path unexercised.  Fail loudly here instead.
+if _platform == "cpu" and len(jax.devices()) < 8:
+    raise RuntimeError(
+        f"virtual CPU mesh broken: expected >= 8 devices, got "
+        f"{len(jax.devices())} (XLA_FLAGS={os.environ.get('XLA_FLAGS')!r})"
+    )
+
 
 @pytest.fixture
 def rng():
@@ -32,9 +42,16 @@ def rng():
 
 
 def require_devices(n: int) -> None:
-    """Skip the calling test when fewer than n devices exist — the suite
-    normally runs on the 8-device virtual CPU mesh, but can be pointed at
-    real hardware (CPGISLAND_TEST_PLATFORM=axon) where a single chip is the
-    common case."""
+    """Skip the calling test when fewer than n devices exist — real-hardware
+    runs (CPGISLAND_TEST_PLATFORM=axon) commonly have a single chip.  On the
+    default virtual CPU platform a short mesh is a hard import-time error
+    above, so this never silently skips there."""
     if len(jax.devices()) < n:
         pytest.skip(f"needs {n} devices, have {len(jax.devices())}")
+
+
+def tpu_atol(tight: float, tpu: float = 5e-5) -> float:
+    """Platform-keyed absolute tolerance: exact-ish on CPU (keeps regression
+    sensitivity in CI), widened on TPU whose transcendentals are ~2e-5
+    relative."""
+    return tpu if jax.default_backend() == "tpu" else tight

@@ -64,9 +64,9 @@ def test_structural_zeros_preserved_through_training(rng):
 
 
 def test_spmd_backend_matches_local(rng):
-    import jax
+    from conftest import require_devices
 
-    assert len(jax.devices()) == 8, "conftest must provide 8 virtual devices"
+    require_devices(8)
     pi, A, B = _random_model(rng)
     params = HmmParams.from_probs(pi, A, B)
     ck = _chunked(rng, n=16, t=64)
@@ -121,9 +121,12 @@ def test_mstep_zero_count_rows_keep_previous():
 
     params = presets.two_state_cpg()
     stats = SuffStats.zeros(2, 4)
+    from conftest import tpu_atol
+
     new = baum_welch.mstep(params, stats)
-    np.testing.assert_allclose(np.asarray(new.A), np.asarray(params.A), atol=1e-6)
-    np.testing.assert_allclose(np.asarray(new.B), np.asarray(params.B), atol=1e-6)
+    # TPU's approximate exp/log round trip costs ~2e-5 relative; CPU stays tight.
+    np.testing.assert_allclose(np.asarray(new.A), np.asarray(params.A), atol=tpu_atol(1e-6))
+    np.testing.assert_allclose(np.asarray(new.B), np.asarray(params.B), atol=tpu_atol(1e-6))
 
 
 def test_long_chunk_loglik_monotone_rescaled(rng):
@@ -157,7 +160,11 @@ def test_orbax_checkpoint_roundtrip_and_resume(tmp_path, rng):
     assert path is not None and os.path.isdir(path)  # orbax = directory
     state = ckpt.load(path)
     assert state.iteration == 2
-    np.testing.assert_allclose(np.asarray(state.params.A), np.asarray(res.params.A), atol=1e-6)
+    # The save path materializes exp(log_A) on device, so the values pass
+    # through TPU's approximate transcendentals once more than res's.
+    from conftest import tpu_atol
+
+    np.testing.assert_allclose(np.asarray(state.params.A), np.asarray(res.params.A), atol=tpu_atol(1e-6))
     assert state.logliks == pytest.approx(res.logliks)
 
     res2 = baum_welch.resume(str(tmp_path), ck, num_iters=4, convergence=0.0)

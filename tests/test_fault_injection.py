@@ -10,6 +10,8 @@ where the retry path was only ever exercised against hand-raised Python
 exceptions.
 """
 
+import functools
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -20,6 +22,33 @@ from cpgisland_tpu.ops.forward_backward import SuffStats, batch_stats
 from cpgisland_tpu.train import backends, baum_welch
 from cpgisland_tpu.train.elastic import ElasticEStep
 from cpgisland_tpu.utils import chunking
+
+
+@functools.cache
+def _host_callback_probe() -> str:
+    """Probe host-callback support; '' means supported, else the reason.
+
+    Some PJRT plugins (e.g. the axon TPU tunnel) implement no host send/recv
+    callbacks at all — the injection mechanism itself cannot run there.  The
+    coverage these tests provide (fit()'s recovery against a REAL
+    XlaRuntimeError raised from device execution) holds on any backend with
+    callback support; CI's CPU platform always has it.  The probe's actual
+    exception goes into the skip reason so an unrelated probe failure (jax
+    API change, transient backend error) is distinguishable from genuine
+    lack of support."""
+    try:
+        out = jax.jit(
+            lambda x: jax.pure_callback(lambda v: v, jax.ShapeDtypeStruct((), jnp.float32), x)
+        )(jnp.float32(1.0))
+        return "" if float(out) == 1.0 else f"probe returned {out!r}"
+    except Exception as e:
+        return f"{type(e).__name__}: {e}"
+
+
+pytestmark = pytest.mark.skipif(
+    bool(_host_callback_probe()),
+    reason=f"host-callback probe failed: {_host_callback_probe()[:300]}",
+)
 
 
 class InJitFaultBackend(backends.EStepBackend):

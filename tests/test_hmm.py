@@ -22,10 +22,13 @@ def test_durbin_preset_matches_reference_tables():
     assert A[0, 2] == pytest.approx(0.426, rel=1e-4)  # A+ -> G+
     assert A[5, 0] == pytest.approx(0.0025, rel=1e-4)  # C- -> A+ leakage
     assert A[5, 4] == pytest.approx(0.393, rel=1e-4)  # C- -> A-
-    # Rows sum to exactly 1 by construction.
-    np.testing.assert_allclose(A.sum(axis=1), 1.0, atol=1e-5)
+    from conftest import tpu_atol
+
+    # Rows sum to 1 by construction; TPU's exp(log(.)) round trip costs
+    # ~2e-5 relative, CPU stays tight.
+    np.testing.assert_allclose(A.sum(axis=1), 1.0, atol=tpu_atol(1e-5, 1e-4))
     # One-hot emissions: X+- emits x.
-    np.testing.assert_allclose(B[np.arange(8), np.arange(8) % 4], 1.0)
+    np.testing.assert_allclose(B[np.arange(8), np.arange(8) % 4], 1.0, atol=tpu_atol(1e-7, 1e-4))
     assert np.count_nonzero(B) == 8
 
 
@@ -58,9 +61,13 @@ def test_text_dump_roundtrip(tmp_path):
     p = tmp_path / "model.txt"
     dump_text(m, str(p))
     m2 = load_text(str(p))
-    np.testing.assert_allclose(np.asarray(m2.pi), np.asarray(m.pi), atol=1e-5)
-    np.testing.assert_allclose(np.asarray(m2.A), np.asarray(m.A), atol=1e-5)
-    np.testing.assert_allclose(np.asarray(m2.B), np.asarray(m.B), atol=1e-5)
+    from conftest import tpu_atol
+
+    # Text round trip; TPU adds its approximate exp/log on top.
+    atol = tpu_atol(1e-5, 1e-4)
+    np.testing.assert_allclose(np.asarray(m2.pi), np.asarray(m.pi), atol=atol)
+    np.testing.assert_allclose(np.asarray(m2.A), np.asarray(m.A), atol=atol)
+    np.testing.assert_allclose(np.asarray(m2.B), np.asarray(m.B), atol=atol)
     # Reference layout: 3 lines per state (pi / transition row / emission row).
     lines = p.read_text().splitlines()
     assert len(lines) == 24

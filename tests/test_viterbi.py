@@ -27,7 +27,11 @@ def test_matches_oracle_random_models(rng, T):
         path, score = V.viterbi(params, jnp.asarray(obs))
         opath, oscore = oracle.viterbi_oracle(pi, A, B, obs)
         # Score must match; path must achieve it (argmax ties may differ).
-        assert score == pytest.approx(oscore, abs=1e-3)
+        # On TPU the bound grows with T: every log A / log B term carries
+        # ~2e-5 relative transcendental error.
+        from conftest import tpu_atol
+
+        assert score == pytest.approx(oscore, abs=tpu_atol(1e-3, max(1e-3, 1e-4 * T)))
         _assert_path_score(pi, A, B, obs, np.asarray(path), oscore)
 
 
@@ -37,7 +41,11 @@ def _assert_path_score(pi, A, B, obs, path, expected):
     s = lp[path[0]] + lB[path[0], obs[0]]
     for t in range(1, len(obs)):
         s += lA[path[t - 1], path[t]] + lB[path[t], obs[t]]
-    assert s == pytest.approx(expected, abs=1e-3)
+    # The device may pick a near-tie path under its approximate scores; its
+    # exact (f64) score then trails the oracle's by the same T-scaled bound.
+    from conftest import tpu_atol
+
+    assert s == pytest.approx(expected, abs=tpu_atol(1e-3, max(1e-3, 1e-4 * len(obs))))
 
 
 def test_durbin_model_decodes_planted_islands(rng):
