@@ -17,10 +17,13 @@ fuse the per-step emission select, normalize, and statistics accumulation:
   step needs arrive as TIME-SHIFTED inputs (steps_next/cs_next, one cheap
   XLA pass) so every read is an aligned static-offset tile and the emission
   select + 1/c reciprocals hoist off the sequential chain — this took the
-  backward from ~3x the forward's cost to parity.  The [K,K]/[K,S]
-  expected-count tensors are TIME-PARALLEL contractions over the streamed
-  alphas/betas in the JAX assembly (two einsums + S masked sums) — moving
-  them out of the sequential per-step loop bought ~17% end to end.
+  backward from ~3x the forward's cost to parity.
+- **stats kernel** — ONE fused streaming pass over the stored alphas/betas
+  producing per-lane [K,K]/[K,S] count partials, loglik included
+  (_stats_kernel).  It has no sequential dependency (each position's work is
+  independent), so unlike the old in-backward accumulation it is
+  throughput-bound; replacing the XLA einsum assembly with it cut the
+  E-step ~30%.
 
 Grid order note: the t-tile dimension is the innermost grid axis, so each
 lane-tile's t-tiles run consecutively and VMEM scratch carries state between
@@ -190,8 +193,9 @@ def _bwd_kernel(steps_next_ref, lens_ref, A_ref, B_ref, cs_next_ref, beta0_ref,
 
     The count tensors are NOT accumulated here (an earlier version did and
     spent ~60 vreg ops/step on xi/gamma outer products inside the sequential
-    loop) — they become time-parallel contractions over the stored
-    alphas/betas in the JAX assembly below, where the MXU/VPU can batch them.
+    loop) — the chunked path reduces them in the separate throughput-bound
+    _stats_kernel pass; the whole-sequence path still uses the time-parallel
+    XLA contractions in _seq_stats_core.
 
     The inputs are TIME-SHIFTED in JAX (steps_next[t] = o_{t+1},
     cs_next[t] = c_{t+1}) so every row the recurrence needs lives at its own
@@ -325,6 +329,132 @@ def _run_fb_kernels(A, B, steps2, lens2, a0_raw, beta0, K, S, Tt, T):
     return alphas, cs, betas
 
 
+def _stats_kernel(alphas_ref, betas_ref, steps_ref, lens_ref, B_ref,
+                  macc_ref, emit_ref, ll_ref,
+                  macc_scr, emit_scr, ll_scr, aprev_scr,
+                  *, K, S, Tt):
+    """Fused per-lane reduction of the count tensors from the streamed
+    alphas/betas — the XLA assembly's einsums/masked-sums as ONE pass.
+
+    No sequential dependency (each row's work is independent given the
+    loaded tiles), so unlike the old in-backward accumulation this is
+    throughput-, not latency-bound.  Two-level summation keeps f32 error
+    down: rows accumulate into register tiles inside the fori carry (<= Tt
+    terms), each grid cell adds its total into VMEM scratch (<= n_t terms),
+    and the final cross-lane reduction happens as an XLA tree sum.
+
+    Outputs per lane: macc[j*K+k] = sum_t a_hat_{t-1}[j] * w_t[k] (trans
+    before the elementwise A), emit[s*K+k] = sum_{t: o_t=s} gamma_t[k],
+    ll = sum_t log c_t.
+    """
+    j = pl.program_id(1)
+    n_t = pl.num_programs(1)
+    lt = steps_ref.shape[1]
+    B = B_ref[:, :]
+    lens = lens_ref[0, :]
+
+    @pl.when(j == 0)
+    def _init():
+        macc_scr[:, :] = jnp.zeros((K * K, lt), jnp.float32)
+        emit_scr[:, :] = jnp.zeros((K * S, lt), jnp.float32)
+        ll_scr[:, :] = jnp.zeros((1, lt), jnp.float32)
+        # t=0 has no incoming pair (masked below), so the initial a_prev
+        # value is never read.
+        aprev_scr[:, :] = jnp.zeros((K, lt), jnp.float32)
+
+    def body(tile_i, carry):
+        aprev, macc, emit, ll = carry
+        base = tile_i * ROW_TILE
+        o_tile = steps_ref[pl.ds(base, ROW_TILE), :]
+        macc = list(macc)
+        emit = list(emit)
+        for r in range(ROW_TILE):
+            t = j * Tt + base + r
+            o_t = o_tile[r, :]
+            valid = (t < lens)[None, :]  # [1, lt]
+            a_row = alphas_ref[base + r, :, :]  # [K, lt]
+            b_row = betas_ref[base + r, :, :]
+            cs = jnp.sum(a_row, axis=0, keepdims=True)  # [1, lt]
+            inv_cs = 1.0 / jnp.maximum(cs, 1e-30)
+            graw = a_row * b_row
+            gsum = jnp.sum(graw, axis=0, keepdims=True)
+            gamma = jnp.where(
+                valid, graw * (1.0 / jnp.maximum(gsum, 1e-30)), 0.0
+            )
+            for s in range(S):
+                emit[s] = emit[s] + jnp.where((o_t == s)[None, :], gamma, 0.0)
+            ll = ll + jnp.where(valid, jnp.log(jnp.maximum(cs, 1e-30)), 0.0)
+            # Pair (t-1 -> t): w carries B[:, o_t] * beta_t / c_t; a_prev is
+            # the previous row's alpha-hat.  t == 0 has no incoming pair.
+            w = _emit_sel(B, o_t, K, S) * b_row * inv_cs
+            wm = jnp.where(jnp.logical_and(valid, t >= 1), w, 0.0)
+            for jj in range(K):
+                macc[jj] = macc[jj] + aprev[jj : jj + 1, :] * wm
+            aprev = a_row * inv_cs
+        return aprev, tuple(macc), tuple(emit), ll
+
+    zero = jnp.zeros((K, lt), jnp.float32)
+    carry0 = (
+        aprev_scr[:, :],
+        tuple(zero for _ in range(K)),
+        tuple(zero for _ in range(S)),
+        jnp.zeros((1, lt), jnp.float32),
+    )
+    aprev, macc, emit, ll = jax.lax.fori_loop(0, Tt // ROW_TILE, body, carry0)
+    aprev_scr[:, :] = aprev
+    for jj in range(K):
+        sl = slice(jj * K, (jj + 1) * K)
+        macc_scr[sl, :] = macc_scr[sl, :] + macc[jj]
+    for s in range(S):
+        sl = slice(s * K, (s + 1) * K)
+        emit_scr[sl, :] = emit_scr[sl, :] + emit[s]
+    ll_scr[:, :] = ll_scr[:, :] + ll
+
+    @pl.when(j == n_t - 1)
+    def _flush():
+        macc_ref[:, :] = macc_scr[:, :]
+        emit_ref[:, :] = emit_scr[:, :]
+        ll_ref[:, :] = ll_scr[:, :]
+
+
+def _run_stats_kernel(B, alphas, betas, steps2, lens2, K, S, Tt):
+    """Per-lane count reductions: returns (macc [K*K,NL], emitf [K*S,NL],
+    ll [1,NL]).  Fixed 128-lane tiles — the kernel has no serial chain to
+    hide latency for, and the alphas+betas input blocks already fill VMEM."""
+    Tp, _, NL = alphas.shape
+    n_t = Tp // Tt
+    lt = LANE_TILE
+    grid = (NL // lt, n_t)
+    return pl.pallas_call(
+        functools.partial(_stats_kernel, K=K, S=S, Tt=Tt),
+        grid=grid,
+        in_specs=[
+            _vspec((Tt, K, lt), lambda i, j: (j, 0, i)),
+            _vspec((Tt, K, lt), lambda i, j: (j, 0, i)),
+            _vspec((Tt, lt), lambda i, j: (j, i)),
+            _vspec((1, lt), lambda i, j: (0, i)),
+            _vspec((K, S), lambda i, j: (0, 0)),
+        ],
+        out_specs=[
+            _vspec((K * K, lt), lambda i, j: (0, i)),
+            _vspec((K * S, lt), lambda i, j: (0, i)),
+            _vspec((1, lt), lambda i, j: (0, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((K * K, NL), jnp.float32),
+            jax.ShapeDtypeStruct((K * S, NL), jnp.float32),
+            jax.ShapeDtypeStruct((1, NL), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((K * K, lt), jnp.float32),
+            pltpu.VMEM((K * S, lt), jnp.float32),
+            pltpu.VMEM((1, lt), jnp.float32),
+            pltpu.VMEM((K, lt), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(alphas, betas, steps2, lens2, B)
+
+
 def _gamma_emit_loglik(alphas, betas, cs, steps2, vmask, S):
     """Shared time-parallel assembly: (gamma, emit, loglik) from the streams.
 
@@ -396,27 +526,18 @@ def batch_stats_pallas(
     beta0 = jnp.ones((K, NL), jnp.float32)  # independent chunks end free
     alphas, cs, betas = _run_fb_kernels(A, B, steps2, lens2, a0_raw, beta0, K, S, Tt, T)
 
-    # Count-tensor assembly: TIME-PARALLEL contractions over the streamed
-    # alphas/betas — the expensive per-step outer products the old backward
-    # kernel accumulated sequentially are now two einsums and S masked sums
-    # that XLA batches over all (t, lane) at once.
-    tmask = jnp.arange(Tp)[:, None] < lens2  # [Tp, NL]
-    vmask = tmask & valid0[None, :]
-    gamma, emit, loglik = _gamma_emit_loglik(alphas, betas, cs, steps2, vmask, S)
+    # Count-tensor assembly: ONE fused streaming pass over alphas/betas
+    # (_stats_kernel) — the XLA-einsum formulation of the same math read the
+    # big tensors several times and cost ~30% of the E-step.
+    macc, emitf, ll = _run_stats_kernel(B, alphas, betas, steps2, lens2, K, S, Tt)
+    trans = A * jnp.sum(macc, axis=1).reshape(K, K)
+    emit = jnp.sum(emitf, axis=1).reshape(S, K).T
+    loglik = jnp.sum(ll)
 
-    # xi(pair t-1 -> t) = alpha-hat_{t-1} (x) (B[:,o_t] * beta_t / c_t)
-    # elementwise A: summing the outer products over (t, lane) is one
-    # [K, T*N] x [T*N, K] dot.  Shifted SLICES (not a concatenated copy) —
-    # position 0 has no incoming transition, so pairs are (t-1, t) for t >= 1
-    # masked by v_t.  The stored v's carry a c_t scale, so a_prev divides it
-    # back out (w's own /c_t is the formula's, not a descaling).
-    w = _emit_sel_cols(B, steps2, K) * betas / cs[:, None, :]  # [Tp, K, NL]
-    a_prev = jnp.where(
-        vmask[1:, None, :], alphas[:-1] / cs[:-1, None, :], 0.0
-    )
-    trans = A * jnp.einsum("tin,tjn->ij", a_prev, w[1:], precision=jax.lax.Precision.HIGHEST)
-
-    init_l = jnp.where(valid0[None, :], gamma[0], 0.0)  # [K, NL]
+    # init = gamma_0 on valid lanes — one row of the posterior, tiny in XLA.
+    g0raw = alphas[0] * betas[0]  # [K, NL]
+    gamma0 = g0raw / jnp.maximum(jnp.sum(g0raw, axis=0, keepdims=True), 1e-30)
+    init_l = jnp.where(valid0[None, :], gamma0, 0.0)
 
     return SuffStats(
         init=jnp.sum(init_l, axis=1),
