@@ -178,6 +178,27 @@ def test_decode_file_mixed_large_small_preserves_order(tmp_path, rng, monkeypatc
     assert names == expect
 
 
+def test_decode_file_state_path_out_through_batching(tmp_path, rng):
+    """state_path_out forces the host island engine, but small records still
+    take the batched vmap decode — the dumped path must equal the serial
+    per-record decode concatenation."""
+    from cpgisland_tpu import pipeline
+    from cpgisland_tpu.models import presets
+
+    sizes = [1500, 900, 2300, 1100]
+    fa = _write_multiscaffold(tmp_path, rng, sizes)
+    params = presets.durbin_cpg8()
+    p_batched = tmp_path / "batched.npy"
+    p_serial = tmp_path / "serial.npy"
+    pipeline.decode_file(str(fa), params, compat=False,
+                         state_path_out=str(p_batched))
+    pipeline.decode_file(str(fa), params, compat=False,
+                         state_path_out=str(p_serial), device_batch=1)
+    a, b = np.load(p_batched), np.load(p_serial)
+    assert a.shape == b.shape == (sum(sizes),)
+    np.testing.assert_array_equal(a, b)
+
+
 def test_decode_file_island_engine_validation(tmp_path):
     from cpgisland_tpu import pipeline
     from cpgisland_tpu.models import presets
