@@ -29,7 +29,7 @@ from typing import Optional, Sequence
 
 log = logging.getLogger(__name__)
 
-_SUBCOMMANDS = ("train", "decode", "run")
+_SUBCOMMANDS = ("train", "decode", "posterior", "run")
 
 
 def _select_platform(argv: list) -> list:
@@ -131,6 +131,32 @@ def build_parser() -> argparse.ArgumentParser:
     _add_island_states_flag(d)
     _common_flags(d)
 
+    po = sub.add_parser(
+        "posterior",
+        help="soft decoding: per-position island confidence (forward-backward "
+        "posteriors; the soft counterpart of `decode`)",
+    )
+    po.add_argument("test_file")
+    po.add_argument("--model", help="model text file (default: the --preset model)")
+    po.add_argument(
+        "--confidence-out", required=True,
+        help=".npy of float32 P(in island) per symbol",
+    )
+    po.add_argument(
+        "--mpm-path-out",
+        help=".npy int8 max-posterior-marginal state path (soft state_path_out)",
+    )
+    _add_island_states_flag(po)
+    # Only the flags posterior honors (it is always clean/FASTA-aware and has
+    # one lowering) — NOT _common_flags, whose --backend/--numerics/--engine/
+    # --clean would be silently ignored here.
+    po.add_argument(
+        "--preset", choices=("durbin8", "two_state"), default="durbin8",
+        help="initial model preset (two_state needs --island-states 0)",
+    )
+    po.add_argument("--trace-dir", help="capture a jax.profiler device trace")
+    po.add_argument("-v", "--verbose", action="store_true")
+
     r = sub.add_parser("run", help="train then decode (the reference main())")
     r.add_argument("training_file")
     r.add_argument("test_file")
@@ -192,7 +218,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         level=logging.DEBUG if args.verbose else logging.INFO,
         format="%(levelname)s %(name)s: %(message)s",
     )
-    compat = not args.clean
+    # Subcommands without a --clean flag (posterior) are always clean.
+    compat = not getattr(args, "clean", True)
 
     import contextlib
 
@@ -242,6 +269,26 @@ def _run_command(args, compat, pipeline, presets, load_text) -> int:
             island_engine=args.island_engine,
         )
         print(f"decoded {res.n_symbols} symbols in {res.n_chunks} chunks; {len(res.calls)} islands")
+        return 0
+
+    if args.cmd == "posterior":
+        island_states = _parse_island_states(build_parser(), args, compat=False)
+        params = load_text(args.model) if args.model else _preset_params(presets, args.preset)
+        if island_states is None:
+            err = pipeline.island_layout_error(params, island_states)
+            if err:
+                build_parser().error(f"--preset {args.preset}: {err}")
+        res = pipeline.posterior_file(
+            args.test_file,
+            params,
+            confidence_out=args.confidence_out,
+            mpm_path_out=args.mpm_path_out,
+            island_states=island_states,
+        )
+        print(
+            f"posterior: {res.n_symbols} symbols in {res.n_records} records; "
+            f"mean island confidence {res.mean_island_confidence:.4f}"
+        )
         return 0
 
     if args.cmd == "run":

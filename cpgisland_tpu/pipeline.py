@@ -478,6 +478,106 @@ def _decode_small_batch(
     return B, parts, paths_out
 
 
+# Posterior spans are smaller than decode spans: gamma materializes [T, K]
+# f32 on device (32 B/symbol at K=8 vs the decode path's 4), so 32 Mi spans
+# keep the working set ~2 GB.
+POSTERIOR_SPAN = 1 << 25
+
+
+@dataclass
+class PosteriorResult:
+    n_symbols: int
+    n_records: int
+    mean_island_confidence: float
+
+
+def posterior_file(
+    test_path: str,
+    params: HmmParams,
+    *,
+    confidence_out: str,
+    mpm_path_out: Optional[str] = None,
+    island_states=None,
+    span: int = POSTERIOR_SPAN,
+    metrics: Optional[profiling.MetricsLogger] = None,
+) -> PosteriorResult:
+    """Soft decoding of a FASTA file: per-position island confidence.
+
+    The reference's Mahout surface exposes only hard Viterbi decoding
+    (HmmEvaluator.decode, CpGIslandFinder.java:260); this is its soft
+    completion — P(position is in an island | whole record) = the summed
+    posterior marginal over the island states, written as one float32 per
+    symbol (.npy).  ``mpm_path_out`` additionally writes the
+    max-posterior-marginal state path (int8), the soft counterpart of
+    decode_file's ``state_path_out``.
+
+    ``island_states``: which states count as "island" (same contract as
+    decode_file's flag); default = the first n_symbols states, the
+    reference's 2M-state X+/X- labeling, which the model must then match.
+
+    Clean semantics only (FASTA-aware, per-record); records longer than
+    ``span`` process in spans with a forward-recurrence restart at span
+    boundaries (same compromise as decode_file's CLEAN_DECODE_SPAN, logged).
+    """
+    from cpgisland_tpu.ops.forward_backward import posterior_marginals
+
+    if island_states is None:
+        err = island_layout_error(params, island_states)
+        if err:
+            raise ValueError(f"island confidence: {err}")
+        island_states = tuple(range(params.n_symbols))
+    island_idx = jnp.asarray(sorted(island_states), jnp.int32)
+    conf_parts: list[np.ndarray] = []
+    path_parts: list[np.ndarray] = []
+    n_sym = 0
+    n_records = 0
+    for rec_name, symbols in codec.iter_fasta_records(test_path):
+        n_records += 1
+        n_sym += symbols.size
+        if symbols.size > span:
+            log.warning(
+                "record %r (%d symbols) exceeds the posterior span (%d); "
+                "processing spans with a DP restart at each boundary",
+                rec_name, symbols.size, span,
+            )
+        for lo in range(0, symbols.size, span):
+            piece = symbols[lo : lo + span]
+            n = piece.size
+            # Pad to power-of-two buckets (posterior_marginals masks by
+            # length) so scaffold-heavy files don't compile once per
+            # distinct record size.
+            Tpad = _round_pow2(n, floor=1 << 14)
+            padded = np.zeros(Tpad, piece.dtype)
+            padded[:n] = piece
+            gamma, _ = posterior_marginals(
+                params, jnp.asarray(padded), jnp.int32(n)
+            )
+            conf = jnp.sum(gamma[:, island_idx], axis=1)[:n]
+            conf_parts.append(np.asarray(conf, dtype=np.float32))
+            if mpm_path_out is not None:
+                path_parts.append(
+                    np.asarray(jnp.argmax(gamma[:n], axis=-1), dtype=np.int8)
+                )
+    conf_all = (
+        np.concatenate(conf_parts) if conf_parts else np.zeros(0, np.float32)
+    )
+    np.save(confidence_out, conf_all)
+    if mpm_path_out is not None:
+        np.save(
+            mpm_path_out,
+            np.concatenate(path_parts) if path_parts else np.zeros(0, np.int8),
+        )
+    mean_conf = float(conf_all.mean()) if conf_all.size else 0.0
+    if metrics is not None:
+        metrics.log(
+            "posterior", n_symbols=n_sym, n_records=n_records,
+            mean_island_confidence=mean_conf,
+        )
+    return PosteriorResult(
+        n_symbols=n_sym, n_records=n_records, mean_island_confidence=mean_conf
+    )
+
+
 def _finish_decode(calls, n_symbols, n_chunks, islands_out) -> DecodeResult:
     if islands_out is not None:
         own = isinstance(islands_out, str)
