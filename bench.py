@@ -208,6 +208,77 @@ def bench_batched_decode(
     return tput
 
 
+def bench_posterior(n_symbols: int, engine: str = "auto", chain: int = 6) -> float:
+    """Steady-state posterior (soft) decoding throughput in sym/s: per-position
+    island confidence through the lane-parallel FB machinery (VERDICT r2 #1 —
+    the soft path must ride the same kernels as the hard decode).
+
+    Pallas engine: the fused single-device core.  XLA engine (CPU runs): the
+    blockwise lane path sharded over every local device.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from cpgisland_tpu.models import presets
+    from cpgisland_tpu.parallel.posterior import resolve_fb_engine
+
+    params = presets.durbin_cpg8()
+    eng = resolve_fb_engine(engine, params)
+    rng = np.random.default_rng(5)
+    obs = jnp.asarray(rng.integers(0, 4, size=n_symbols, dtype=np.int32).astype(np.uint8))
+    mask = jnp.asarray((np.arange(params.n_states) < params.n_symbols).astype(np.float32))
+
+    if eng == "pallas":
+        from cpgisland_tpu.ops import fb_pallas
+
+        def one(o):
+            conf, _ = fb_pallas._seq_posterior_core(
+                params, o, o.shape[0], mask,
+                fb_pallas.DEFAULT_LANE_T, fb_pallas.DEFAULT_T_TILE, axis=None,
+            )
+            return conf
+    else:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from cpgisland_tpu.parallel.fb_sharded import _one_seq_local_posterior
+        from cpgisland_tpu.parallel.mesh import make_mesh
+
+        mesh = make_mesh(len(jax.devices()), axis="seq")
+        axis = mesh.axis_names[0]
+
+        def body(p, o):
+            return _one_seq_local_posterior(
+                p, o, jnp.int32(o.shape[0]), mask, axis=axis, block_size=1024
+            )[0]
+
+        smap = jax.shard_map(
+            body, mesh=mesh, in_specs=(P(), P(axis)), out_specs=P(axis)
+        )
+        obs = jax.device_put(obs, NamedSharding(mesh, P(axis)))
+
+        def one(o):
+            return smap(params, o)
+
+    @jax.jit
+    def chained(c, obs):
+        def step(c, _):
+            conf = one(obs.at[0].set((c % 4).astype(obs.dtype)))
+            return (jnp.min(conf) * 4.0).astype(jnp.int32) % 4, None
+
+        c, _ = jax.lax.scan(step, c, None, length=chain)
+        return c
+
+    c0 = jnp.int32(0)
+    jax.block_until_ready(chained(c0, obs))  # compile + warm
+    best = _best_wall(lambda: jax.block_until_ready(chained(c0, obs))) / chain
+    tput = n_symbols / best
+    log(
+        f"posterior[{eng}]: {tput/1e6:.1f} Msym/s "
+        f"({best*1e3:.0f} ms / {n_symbols/2**20:.0f} MiB, chained x{chain})"
+    )
+    return tput
+
+
 def bench_em_2state(n_chunks: int, chunk_size: int = 0x10000, chain: int = 24) -> float:
     """2-state model EM throughput in sym/s/iter (BASELINE.md config 2)."""
     import jax
@@ -450,6 +521,11 @@ def main() -> int:
 
         CHR21, CHR1 = 46.7e6, 248e6
         batched_tput = bench_batched_decode(16, 4 << 20, engine=args.engine)
+        # Posterior working set is ~72 B/symbol (alpha+beta streams), so it
+        # benches at half the decode size to stay well inside HBM.
+        posterior_tput = bench_posterior(
+            args.decode_mib * (1 << 19), engine=args.engine
+        )
         em2_tput = bench_em_2state(256)
         decode2_tput = bench_decode(
             args.decode_mib * (1 << 20), engine=args.engine,
@@ -477,6 +553,11 @@ def main() -> int:
                 batched_tput * N_CHIPS / GRCH38_SYMBOLS, 3
             ),
             "batched_decode_msym_per_sec_chip": round(batched_tput / 1e6, 1),
+            "posterior_msym_per_sec_chip": round(posterior_tput / 1e6, 1),
+            "grch38_posterior_projected_v5e8_s": round(
+                GRCH38_SYMBOLS / (posterior_tput * N_CHIPS), 3
+            ),
+            "posterior_vs_decode": round(posterior_tput / decode_tput, 2),
             "host_encode_vs_8chip_decode": round(
                 e2e.get("encode_msym_per_s", 0.0) * 1e6 / (decode_tput * N_CHIPS), 2
             ),

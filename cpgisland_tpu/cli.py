@@ -147,9 +147,15 @@ def build_parser() -> argparse.ArgumentParser:
         help=".npy int8 max-posterior-marginal state path (soft state_path_out)",
     )
     _add_island_states_flag(po)
-    # Only the flags posterior honors (it is always clean/FASTA-aware and has
-    # one lowering) — NOT _common_flags, whose --backend/--numerics/--engine/
-    # --clean would be silently ignored here.
+    # Only the flags posterior honors (it is always clean/FASTA-aware) — NOT
+    # _common_flags, whose --backend/--numerics/--clean would be silently
+    # ignored here.
+    po.add_argument(
+        "--engine",
+        choices=("auto", "xla", "pallas"),
+        default="auto",
+        help="forward-backward lowering (auto: fused Pallas kernels on TPU)",
+    )
     po.add_argument(
         "--preset", choices=("durbin8", "two_state"), default="durbin8",
         help="initial model preset (two_state needs --island-states 0)",
@@ -284,6 +290,7 @@ def _run_command(args, compat, pipeline, presets, load_text) -> int:
             confidence_out=args.confidence_out,
             mpm_path_out=args.mpm_path_out,
             island_states=island_states,
+            engine=args.engine,
         )
         print(
             f"posterior: {res.n_symbols} symbols in {res.n_records} records; "

@@ -579,6 +579,172 @@ def seq_stats_pallas(
     return _seq_stats_core(params, obs, length, lane_T, t_tile, axis=None)
 
 
+def _lane_combine(a, b):
+    """Normalized probability-space matrix combine (the (+,x) semiring)."""
+    m = jnp.einsum("...ij,...jk->...ik", a, b, precision=jax.lax.Precision.HIGHEST)
+    return m / jnp.maximum(jnp.sum(m, axis=(-2, -1), keepdims=True), 1e-30)
+
+
+def _lane_layout(obs, length, S, lane_T, t_tile, mask_first):
+    """The ONE copy of the lane packing/masking math (Mosaic-sensitive —
+    see the alignment notes in CLAUDE.md): pad/reshape one sequence into
+    [NL, lane_T] lanes with PAD-masked selection symbols.
+
+    ``mask_first``: global position 0's step becomes identity (its emission
+    is folded into the base direction by the consumer) — traced bool or
+    Python bool.  Returns (obs_l, sel_l, lane_lens, obs_flat, Tt, NL).
+    """
+    T = obs.shape[0]
+    length = jnp.asarray(length, jnp.int32)
+    nb = -(-T // lane_T)
+    NL = -(-nb // LANE_TILE) * LANE_TILE
+    Tp_all = NL * lane_T
+    if lane_T % ROW_TILE:
+        raise ValueError(f"lane_T={lane_T} must be a multiple of {ROW_TILE}")
+    # ONE t-tile derivation for all three kernels (products + fwd/bwd).
+    Tt = -(-min(t_tile, lane_T) // ROW_TILE) * ROW_TILE
+    if lane_T % Tt:
+        raise ValueError(
+            f"lane_T={lane_T} must be a multiple of the t-tile ({Tt}); a "
+            "floor-divided grid would silently skip each lane's tail rows"
+        )
+    valid_flat = jnp.arange(T) < length
+    obs_flat = jnp.where(valid_flat, jnp.minimum(obs.astype(jnp.int32), S - 1), 0)
+    # PAD (== S) marks invalid steps for the products kernel (identity).
+    sel_flat = jnp.where(valid_flat, obs_flat, S)
+    sel_flat = sel_flat.at[0].set(jnp.where(mask_first, S, sel_flat[0]))
+    pad = Tp_all - T
+    obs_l = jnp.pad(obs_flat, (0, pad)).reshape(NL, lane_T)
+    sel_l = jnp.pad(sel_flat, (0, pad), constant_values=S).reshape(NL, lane_T)
+    lane_lens = jnp.clip(length - jnp.arange(NL) * lane_T, 0, lane_T)
+    return obs_l, sel_l, lane_lens, obs_flat, Tt, NL
+
+
+def _run_products_kernel(A, B, sel_l, lane_T, Tt, K, S):
+    """Per-lane probability-space transfer products via _prod_kernel.
+
+    t tiled over the inner grid axis (scratch-carried running product), so
+    lane_T is VMEM-unconstrained — 16 Ki+ lanes stream in t_tile blocks.
+    Returns P [NL, K, K] (P[lane, i, m])."""
+    NL = sel_l.shape[0]
+    (prod_flat,) = pl.pallas_call(
+        functools.partial(_prod_kernel, K=K, S=S, bk=Tt),
+        grid=(NL // LANE_TILE, lane_T // Tt),
+        in_specs=[
+            _vspec((Tt, LANE_TILE), lambda i, j: (j, i)),
+            _vspec((K, K), lambda i, j: (0, 0)),
+            _vspec((K, S), lambda i, j: (0, 0)),
+        ],
+        out_specs=[_vspec((K * K, LANE_TILE), lambda i, j: (0, i))],
+        out_shape=[jax.ShapeDtypeStruct((K * K, NL), jnp.float32)],
+        scratch_shapes=[pltpu.VMEM((K * K, LANE_TILE), jnp.float32)],
+        interpret=_interpret(),
+    )(sel_l.T, A, B)
+    return prod_flat.T.reshape(NL, K, K)
+
+
+def _lane_streams(
+    params: HmmParams,
+    obs: jnp.ndarray,
+    length,
+    lane_T: int,
+    t_tile: int,
+    axis,
+    enter_dir=None,
+    exit_dir=None,
+    first: bool = True,
+):
+    """Shared lane setup for the fused whole-sequence paths: lane transfer
+    products -> boundary messages -> forward/backward kernel streams.
+
+    ``first`` (static): this span starts the sequence — global position 0 is
+    the init (its emission folds into the base direction).  ``enter_dir``
+    ([K], used when not ``first``): the entering-alpha direction from the
+    previous span; ``exit_dir`` ([K], optional): the exiting-beta direction
+    from the next span (None = free end, the uniform direction).  Together
+    these let a host driver thread EXACT messages across sequential spans of
+    a record too large for one pass (pipeline.posterior_file), exactly like
+    the cross-device exchange does across the mesh.
+
+    Returns (alphas, cs, betas, steps2, lens2, enters, is_first, Tt) where
+    is_first is the traced "this device holds the sequence init" flag.
+    """
+    K, S = params.n_states, params.n_symbols
+    A = jnp.exp(params.log_A).astype(jnp.float32)
+    B = jnp.exp(params.log_B).astype(jnp.float32)
+    pi = jnp.exp(params.log_pi).astype(jnp.float32)
+
+    if not first and enter_dir is None:
+        raise ValueError(
+            "continuation spans (first=False) need enter_dir — the "
+            "entering-alpha direction from the previous span"
+        )
+    d = jax.lax.axis_index(axis) if axis is not None else 0
+    is_first = (d == 0) if first else jnp.asarray(False)
+
+    # The GLOBAL position 0's step is padded out of the products when this
+    # device/span holds the init: the base direction already contains
+    # pi * B[:, o_0], so including M_0 would double-apply it.
+    obs_l, sel_l, lane_lens, obs_flat, Tt, NL = _lane_layout(
+        obs, length, S, lane_T, t_tile, is_first
+    )
+    length = jnp.asarray(length, jnp.int32)
+
+    # --- lane transfer operators (pallas) -> boundary messages (XLA) ------
+    P = _run_products_kernel(A, B, sel_l, lane_T, Tt, K, S)  # P[lane, i, m]
+
+    incl = jax.lax.associative_scan(_lane_combine, P, axis=0)
+    eyeK = jnp.broadcast_to(jnp.eye(K, dtype=jnp.float32), (1, K, K))
+    excl = jnp.concatenate([eyeK, incl[:-1]], axis=0)  # prefix products
+
+    a0_dir = _norm_rows(pi * B[:, obs_flat[0]])  # [K] — meaningful on device 0
+    if axis is not None:
+        # Cross-device boundary messages: the ONE shared implementation
+        # (parallel.fb_sharded.device_boundary_messages) — both the XLA lane
+        # path and this fused path exchange messages identically.
+        from cpgisland_tpu.parallel.fb_sharded import device_boundary_messages
+
+        _, base_dir, anchor = device_boundary_messages(
+            a0_dir, incl[-1], d, axis,
+            start_dir=None if first else enter_dir,
+            end_dir=exit_dir,
+        )
+    else:
+        base_dir = a0_dir if first else _norm_rows(enter_dir)
+        anchor = (
+            jnp.full((K,), 1.0 / K, jnp.float32)
+            if exit_dir is None
+            else _norm_rows(exit_dir)
+        )
+
+    enters = _norm_rows(jnp.einsum("k,nkj->nj", base_dir, excl))  # [NL, K]
+
+    Rsuf = jax.lax.associative_scan(
+        lambda a, b: _lane_combine(b, a), P, axis=0, reverse=True
+    )
+    beta_exits = jnp.concatenate(
+        [_norm_rows(jnp.einsum("nij,j->ni", Rsuf[1:], anchor)), anchor[None]], axis=0
+    )  # [NL, K]
+
+    # --- per-lane v_0 (unnormalized: sum == that position's Rabiner c) ----
+    o_first = obs_l[:, 0]  # [NL]
+    Bf = B[:, o_first].T  # [NL, K]
+    v0_cont = jnp.einsum("nk,kj->nj", enters, A, precision=jax.lax.Precision.HIGHEST) * Bf
+    lane0_is_init = (jnp.arange(NL)[:, None] == 0) & is_first
+    v0 = jnp.where(
+        (lane_lens > 0)[:, None],
+        jnp.where(lane0_is_init, (pi * B[:, obs_flat[0]])[None, :], v0_cont),
+        jnp.ones((NL, K)) / K,
+    )
+
+    steps2 = obs_l.T  # [lane_T, NL] — within-lens symbols (kernels mask by lens)
+    lens2 = lane_lens[None, :]
+    alphas, cs, betas = _run_fb_kernels(
+        A, B, steps2, lens2, v0.T, beta_exits.T, K, S, Tt, lane_T
+    )
+    return alphas, cs, betas, steps2, lens2, enters, is_first, Tt
+
+
 def _seq_stats_core(
     params: HmmParams,
     obs: jnp.ndarray,
@@ -601,103 +767,12 @@ def _seq_stats_core(
     K, S = params.n_states, params.n_symbols
     A = jnp.exp(params.log_A).astype(jnp.float32)
     B = jnp.exp(params.log_B).astype(jnp.float32)
-    pi = jnp.exp(params.log_pi).astype(jnp.float32)
-
-    d = jax.lax.axis_index(axis) if axis is not None else 0
-    is_first = d == 0
-
-    T = obs.shape[0]
     length = jnp.asarray(length, jnp.int32)
-    nb = -(-T // lane_T)
-    NL = -(-nb // LANE_TILE) * LANE_TILE
-    Tp_all = NL * lane_T
 
-    if lane_T % ROW_TILE:
-        raise ValueError(f"lane_T={lane_T} must be a multiple of {ROW_TILE}")
-    # ONE t-tile derivation for all three kernels (products + fwd/bwd).
-    Tt = -(-min(t_tile, lane_T) // ROW_TILE) * ROW_TILE
-    if lane_T % Tt:
-        raise ValueError(
-            f"lane_T={lane_T} must be a multiple of the t-tile ({Tt}); a "
-            "floor-divided grid would silently skip each lane's tail rows"
-        )
-    valid_flat = jnp.arange(T) < length
-    obs_flat = jnp.where(valid_flat, jnp.minimum(obs.astype(jnp.int32), S - 1), 0)
-    # PAD (== S) marks invalid steps for the products kernel (identity).
-    # The GLOBAL position 0 is ALSO padded out there: its step is the init
-    # (the base direction already contains pi * B[:, o_0]), so the first
-    # lane's transfer product must cover steps 1.. only — including M_0
-    # would double-apply it.  Only device 0 holds that position.
-    sel_flat = jnp.where(valid_flat, obs_flat, S)
-    sel_flat = sel_flat.at[0].set(jnp.where(is_first, S, sel_flat[0]))
-    pad = Tp_all - T
-    obs_l = jnp.pad(obs_flat, (0, pad)).reshape(NL, lane_T)
-    sel_l = jnp.pad(sel_flat, (0, pad), constant_values=S).reshape(NL, lane_T)
-    lane_lens = jnp.clip(length - jnp.arange(NL) * lane_T, 0, lane_T)
-
-    # --- lane transfer operators (pallas) -> boundary messages (XLA) ------
-    # t tiled over the inner grid axis (scratch-carried running product, the
-    # shared Tt above), so lane_T is VMEM-unconstrained — 16 Ki+ lanes stream
-    # in t_tile blocks.
-    n_lt = NL // LANE_TILE
-    (prod_flat,) = pl.pallas_call(
-        functools.partial(_prod_kernel, K=K, S=S, bk=Tt),
-        grid=(n_lt, lane_T // Tt),
-        in_specs=[
-            _vspec((Tt, LANE_TILE), lambda i, j: (j, i)),
-            _vspec((K, K), lambda i, j: (0, 0)),
-            _vspec((K, S), lambda i, j: (0, 0)),
-        ],
-        out_specs=[_vspec((K * K, LANE_TILE), lambda i, j: (0, i))],
-        out_shape=[jax.ShapeDtypeStruct((K * K, NL), jnp.float32)],
-        scratch_shapes=[pltpu.VMEM((K * K, LANE_TILE), jnp.float32)],
-        interpret=_interpret(),
-    )(sel_l.T, A, B)
-    P = prod_flat.T.reshape(NL, K, K)  # P[lane, i, m]
-
-    def combine(a, b):
-        m = jnp.einsum("...ij,...jk->...ik", a, b, precision=jax.lax.Precision.HIGHEST)
-        return m / jnp.maximum(jnp.sum(m, axis=(-2, -1), keepdims=True), 1e-30)
-
-    incl = jax.lax.associative_scan(combine, P, axis=0)
-    eyeK = jnp.broadcast_to(jnp.eye(K, dtype=jnp.float32), (1, K, K))
-    excl = jnp.concatenate([eyeK, incl[:-1]], axis=0)  # prefix products
-
-    a0_dir = _norm_rows(pi * B[:, obs_flat[0]])  # [K] — meaningful on device 0
-    if axis is not None:
-        # Cross-device boundary messages: the ONE shared implementation
-        # (parallel.fb_sharded.device_boundary_messages) — both the XLA lane
-        # path and this fused path exchange messages identically.
-        from cpgisland_tpu.parallel.fb_sharded import device_boundary_messages
-
-        _, base_dir, anchor = device_boundary_messages(a0_dir, incl[-1], d, axis)
-    else:
-        base_dir = a0_dir
-        anchor = jnp.full((K,), 1.0 / K, jnp.float32)
-
-    enters = _norm_rows(jnp.einsum("k,nkj->nj", base_dir, excl))  # [NL, K]
-
-    Rsuf = jax.lax.associative_scan(lambda a, b: combine(b, a), P, axis=0, reverse=True)
-    beta_exits = jnp.concatenate(
-        [_norm_rows(jnp.einsum("nij,j->ni", Rsuf[1:], anchor)), anchor[None]], axis=0
-    )  # [NL, K]
-
-    # --- per-lane v_0 (unnormalized: sum == that position's Rabiner c) ----
-    o_first = obs_l[:, 0]  # [NL]
-    Bf = B[:, o_first].T  # [NL, K]
-    v0_cont = jnp.einsum("nk,kj->nj", enters, A, precision=jax.lax.Precision.HIGHEST) * Bf
-    lane0_is_init = (jnp.arange(NL)[:, None] == 0) & is_first
-    v0 = jnp.where(
-        (lane_lens > 0)[:, None],
-        jnp.where(lane0_is_init, (pi * B[:, obs_flat[0]])[None, :], v0_cont),
-        jnp.ones((NL, K)) / K,
+    alphas, cs, betas, steps2, lens2, enters, is_first, _ = _lane_streams(
+        params, obs, length, lane_T, t_tile, axis
     )
-
-    steps2 = obs_l.T  # [lane_T, NL] — within-lens symbols (kernels mask by lens)
-    lens2 = lane_lens[None, :]
-    alphas, cs, betas = _run_fb_kernels(
-        A, B, steps2, lens2, v0.T, beta_exits.T, K, S, Tt, lane_T
-    )
+    NL = steps2.shape[1]
 
     # --- scale-free assembly ---------------------------------------------
     Tp = steps2.shape[0]
@@ -733,3 +808,107 @@ def _seq_stats_core(
     if axis is not None and reduce:
         stats = jax.lax.psum(stats, axis)
     return stats
+
+
+def _seq_posterior_core(
+    params: HmmParams,
+    obs: jnp.ndarray,
+    length,
+    island_mask: jnp.ndarray,
+    lane_T: int,
+    t_tile: int,
+    axis,
+    enter_dir=None,
+    exit_dir=None,
+    first: bool = True,
+    want_path: bool = False,
+):
+    """Per-position island confidence over THIS device's time shard, fused.
+
+    The soft-decoding twin of the sharded Viterbi: the SAME forward/backward
+    kernel streams as the E-step (boundary messages make them exact across
+    lanes, devices, and — via enter_dir/exit_dir — sequential spans), with
+    the per-position gamma reduced on device to one float per symbol:
+    conf[t] = sum_{k in islands} gamma[t, k].  gamma is scale-free
+    (normalize(alpha_t * beta_t)), so working from beta DIRECTIONS is exact.
+
+    island_mask: [K] f32 0/1 — which states count as "island" (traced, so
+    changing the set never recompiles).  ``want_path`` additionally returns
+    the max-posterior-marginal state path (int32).  The reference's Mahout
+    surface exposes only hard Viterbi (CpGIslandFinder.java:260); this is
+    its soft completion at full kernel speed.
+
+    Returns (conf [T] f32, path [T] int32 — zeros unless want_path).
+    """
+    T = obs.shape[0]
+    alphas, cs, betas, steps2, lens2, _, _, _ = _lane_streams(
+        params, obs, length, lane_T, t_tile, axis,
+        enter_dir=enter_dir, exit_dir=exit_dir, first=first,
+    )
+    Tp, NL = steps2.shape
+    vmask = jnp.arange(Tp)[:, None] < lens2  # [Tp, NL]
+    graw = alphas * betas  # [Tp, K, NL]
+    gsum = jnp.maximum(jnp.sum(graw, axis=1), 1e-30)  # [Tp, NL]
+    gisl = jnp.sum(graw * island_mask[None, :, None], axis=1)
+    conf2 = jnp.where(vmask, gisl / gsum, 0.0)
+    # Lane n covers global positions [n*lane_T, (n+1)*lane_T): transpose the
+    # [lane_T, NL] lane layout back to global order and slice the pad.
+    conf = conf2.T.reshape(-1)[:T]
+    if want_path:
+        path2 = jnp.where(vmask, jnp.argmax(graw, axis=1), 0).astype(jnp.int32)
+        path = path2.T.reshape(-1)[:T]
+    else:
+        path = jnp.zeros((T,), jnp.int32)
+    return conf, path
+
+
+@functools.partial(
+    jax.jit, static_argnames=("lane_T", "t_tile", "first", "want_path")
+)
+def seq_posterior_pallas(
+    params: HmmParams,
+    obs: jnp.ndarray,
+    length,
+    island_mask: jnp.ndarray,
+    enter_dir=None,
+    exit_dir=None,
+    first: bool = True,
+    want_path: bool = False,
+    lane_T: int = DEFAULT_LANE_T,
+    t_tile: int = DEFAULT_T_TILE,
+):
+    """Single-device fused posterior: (conf [T], mpm path [T]).
+
+    Drop-in fast path for ops.forward_backward.posterior_marginals'
+    island-confidence reduction (bit-compatible to f32 tolerance); spans of
+    longer records thread enter_dir/exit_dir (see _seq_posterior_core).
+    """
+    return _seq_posterior_core(
+        params, obs, length, island_mask, lane_T, t_tile, axis=None,
+        enter_dir=enter_dir, exit_dir=exit_dir, first=first,
+        want_path=want_path,
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("lane_T", "t_tile", "first"))
+def seq_transfer_total_pallas(
+    params: HmmParams,
+    obs: jnp.ndarray,
+    length,
+    first: bool = True,
+    lane_T: int = DEFAULT_LANE_T,
+    t_tile: int = DEFAULT_T_TILE,
+) -> jnp.ndarray:
+    """Normalized probability-space transfer operator of one span (products
+    kernel only — the cheap forward sweep of span-threaded processing).
+
+    Returns [K, K] M with alpha_dir_out ∝ alpha_dir_in @ M.  ``first`` masks
+    global position 0 (its step is the init, folded into the base direction
+    by the consumer) — pass True only for the sequence's first span.
+    """
+    K, S = params.n_states, params.n_symbols
+    A = jnp.exp(params.log_A).astype(jnp.float32)
+    B = jnp.exp(params.log_B).astype(jnp.float32)
+    _, sel_l, _, _, Tt, _ = _lane_layout(obs, length, S, lane_T, t_tile, first)
+    P = _run_products_kernel(A, B, sel_l, lane_T, Tt, K, S)
+    return jax.lax.associative_scan(_lane_combine, P, axis=0)[-1]

@@ -44,7 +44,7 @@ from cpgisland_tpu.models.hmm import LOG_ZERO, HmmParams
 # lives at viterbi_parallel.DEFAULT_BLOCK) — a separate pallas default once
 # silently pinned the production batch path at 512 while benches measured
 # the retuned value.
-from cpgisland_tpu.ops.viterbi_parallel import DEFAULT_BLOCK, maxplus_matmul
+from cpgisland_tpu.ops.viterbi_parallel import DEFAULT_BLOCK, scan_block_products
 
 LANE_TILE = 128  # lanes per kernel instance = one TPU vreg width
 
@@ -253,7 +253,7 @@ def _pad_rows(steps2, S):
 
 
 def pass_products(params: HmmParams, steps2: jnp.ndarray):
-    """Pallas twin of viterbi_parallel._pass_products: (incl [nb,K,K], total)."""
+    """Pallas twin of viterbi_parallel._pass_products: (incl, offs, total)."""
     K, S, logAT, logB = _step_mats_const(params)
     nb = steps2.shape[1]
     nb_pad = -(-nb // LANE_TILE) * LANE_TILE
@@ -272,8 +272,10 @@ def pass_products(params: HmmParams, steps2: jnp.ndarray):
         interpret=_interpret(),
     )(steps2, logAT, logB)
     P = P_flat.T.reshape(nb_pad, K, K)[:nb]
-    incl = jax.lax.associative_scan(maxplus_matmul, P, axis=0)
-    return incl, incl[-1]
+    # The prefix scan + f32-range normalization is the SHARED implementation
+    # (viterbi_parallel.scan_block_products) so both engines stay bit-identical.
+    incl, offs = scan_block_products(P)
+    return incl, offs, incl[-1]
 
 
 def pass_backpointers(params: HmmParams, v_enter: jnp.ndarray, steps2: jnp.ndarray):

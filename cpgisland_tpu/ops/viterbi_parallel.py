@@ -77,6 +77,49 @@ def maxplus_matmul(x: jnp.ndarray, y: jnp.ndarray) -> jnp.ndarray:
     return jnp.max(x[..., :, :, None] + y[..., None, :, :], axis=-2)
 
 
+def nrm_maxplus(m: jnp.ndarray) -> jnp.ndarray:
+    """Shift a max-plus matrix so its max entry is 0 (f32 range guard).
+
+    Max-plus scores grow ~-1.3 nat/symbol, so an unnormalized product chain
+    reaches magnitude ~3e8 on a chromosome — where the f32 ulp (~32) is
+    larger than the O(1) per-state score differences every argmax decision
+    depends on.  Subtracting the (per-lane scalar) max is decision-invariant:
+    it cancels in every within-lane comparison.  Offsets are tracked
+    separately only where a true score must be returned.
+    """
+    return jnp.maximum(
+        m - jnp.max(m, axis=(-2, -1), keepdims=True), LOG_ZERO
+    )
+
+
+def nrm_maxplus_vec(v: jnp.ndarray) -> jnp.ndarray:
+    """The [K] score-vector twin of :func:`nrm_maxplus`."""
+    return jnp.maximum(v - jnp.max(v, axis=-1, keepdims=True), LOG_ZERO)
+
+
+def scan_block_products(P: jnp.ndarray):
+    """Inclusive prefix of per-block max-plus products, NORMALIZED per combine.
+
+    The one shared implementation for both engines (the XLA scan and the
+    Pallas kernels hand their per-block products here), so their prefixes are
+    bit-identical.  Returns (incl [nb, K, K] with per-matrix max 0,
+    offs [nb] the subtracted offsets — true incl[b] = incl[b] + offs[b]).
+    """
+    mx0 = jnp.max(P, axis=(-2, -1))
+    P0 = jnp.maximum(P - mx0[..., None, None], LOG_ZERO)
+
+    def comb(a, b):
+        m = maxplus_matmul(a[0], b[0])
+        mx = jnp.max(m, axis=(-2, -1))
+        return (
+            jnp.maximum(m - mx[..., None, None], LOG_ZERO),
+            a[1] + b[1] + mx,
+        )
+
+    incl, offs = jax.lax.associative_scan(comb, (P0, mx0), axis=0)
+    return incl, offs
+
+
 def _compose(earlier: jnp.ndarray, later: jnp.ndarray) -> jnp.ndarray:
     """Composition of state->state lookup tables: out[s] = earlier[later[s]].
 
@@ -103,15 +146,17 @@ class BlockDecode(NamedTuple):
     """Everything segment-stitching layers need from a blockwise decode."""
 
     path: jnp.ndarray  # [S] int32 — state after each step
-    delta_exit: jnp.ndarray  # [K] final score vector
-    total: jnp.ndarray  # [K, K] max-plus product of ALL step matrices
+    delta_exit: jnp.ndarray  # [K] final score vector (normalized; see offset)
+    total: jnp.ndarray  # [K, K] NORMALIZED max-plus product of ALL step matrices
     ftable: jnp.ndarray  # [K] int32 — maps segment exit state -> entry state
+    score_offset: jnp.ndarray  # [] add to delta_exit for true (global) scores
 
 
 def _pass_products(params: HmmParams, steps2: jnp.ndarray):
-    """Pass A: per-block max-plus products + their inclusive prefix.
+    """Pass A: per-block max-plus products + their normalized inclusive prefix.
 
-    steps2: [bk, nb].  Returns (incl [nb, K, K], total [K, K]).
+    steps2: [bk, nb].  Returns (incl [nb, K, K] normalized per block,
+    offs [nb] subtracted offsets, total [K, K] = incl[-1]).
     """
     K = params.n_states
     M_ext, _ = _step_tables(params)
@@ -126,17 +171,28 @@ def _pass_products(params: HmmParams, steps2: jnp.ndarray):
         return maxplus_matmul(carry, _select_step_mats(syms_k, M_flat, K)), None
 
     P, _ = jax.lax.scan(passA, eye_b, steps2)  # [nb, K, K]
-    incl = jax.lax.associative_scan(maxplus_matmul, P, axis=0)
-    return incl, incl[-1]
+    incl, offs = scan_block_products(P)
+    return incl, offs, incl[-1]
 
 
-def _enter_vectors(v_enter0: jnp.ndarray, incl: jnp.ndarray) -> jnp.ndarray:
-    """Exact entering score vector per block from the exclusive prefix."""
+def _enter_vectors(v_enter0: jnp.ndarray, incl: jnp.ndarray, offs=None):
+    """Exact entering score vector per block from the exclusive prefix.
+
+    Returns NORMALIZED per-block entering vectors (max 0 — the f32-range
+    guard, see :func:`nrm_maxplus`) plus, when ``offs`` (the prefix-scan
+    offsets) is given, the per-block true-score offsets that were dropped.
+    """
     K = v_enter0.shape[0]
     excl = jnp.concatenate(
         [_identity_logmat(K)[None] + v_enter0[None, :, None] * 0.0, incl[:-1]], axis=0
     )
-    return jnp.max(v_enter0[None, :, None] + excl, axis=1)  # [nb, K]
+    v = jnp.max(v_enter0[None, :, None] + excl, axis=1)  # [nb, K]
+    vmax = jnp.max(v, axis=-1)
+    v = jnp.maximum(v - vmax[:, None], LOG_ZERO)
+    if offs is None:
+        return v
+    excl_off = jnp.concatenate([jnp.zeros_like(offs[:1]), offs[:-1]])
+    return v, vmax + excl_off
 
 
 def _pass_backpointers(params: HmmParams, v_enter: jnp.ndarray, steps2: jnp.ndarray):
@@ -228,8 +284,8 @@ def _block_passes(
     nb = steps.shape[0] // block_size
     steps2 = steps.reshape(nb, block_size).T  # [bk, nb] — scan over bk
 
-    incl, total = _pass_products(params, steps2)
-    v_enter = _enter_vectors(v_enter0, incl)
+    incl, offs, total = _pass_products(params, steps2)
+    v_enter, enter_offs = _enter_vectors(v_enter0, incl, offs)
     delta_blocks, F, bps = _pass_backpointers(params, v_enter, steps2)
     delta_exit = delta_blocks[-1]
 
@@ -239,7 +295,12 @@ def _block_passes(
     exits = jnp.concatenate([Gsuf[1:, :][:, s_exit], s_exit[None]])
     path = _pass_backtrace(bps, exits)
 
-    return BlockDecode(path=path, delta_exit=delta_exit, total=total, ftable=Gsuf[0])
+    # Block b's delta rides the normalized v_enter[b]; the dropped true-score
+    # offset for the exit block is enter_offs[-1].
+    return BlockDecode(
+        path=path, delta_exit=delta_exit, total=total, ftable=Gsuf[0],
+        score_offset=enter_offs[-1],
+    )
 
 
 @partial(jax.jit, static_argnames=("block_size", "return_score", "engine"))
@@ -279,7 +340,7 @@ def viterbi_parallel(
     path = jnp.concatenate([s0[None], dec.path[:S]])
     if not return_score:
         return path
-    return path, jnp.max(dec.delta_exit)
+    return path, jnp.max(dec.delta_exit) + dec.score_offset
 
 
 @partial(jax.jit, static_argnames=("block_size", "return_score", "engine"))

@@ -98,7 +98,8 @@ def _matmul_combine(a, b):
     return _nrm_m(jnp.einsum("...ij,...jk->...ik", a, b, precision=_HI))
 
 
-def device_boundary_messages(a0_local, total_dev, d, axis):
+def device_boundary_messages(a0_local, total_dev, d, axis,
+                             start_dir=None, end_dir=None):
     """Cross-device boundary-message exchange (the ONE implementation).
 
     One all_gather of the raw local init vectors and one of the [K, K]
@@ -106,6 +107,13 @@ def device_boundary_messages(a0_local, total_dev, d, axis):
     device's entering-alpha direction and exiting-beta direction.  Used by
     both the XLA lane path (_one_seq_local_stats) and the fused-kernel path
     (ops.fb_pallas._seq_stats_core) so the numerics cannot diverge.
+
+    ``start_dir``/``end_dir`` generalize the endpoints for span threading
+    (pipeline-level processing of records larger than one pass): the prefix
+    scan seeds from ``start_dir`` instead of device 0's local init direction
+    (the entering-alpha message from the PREVIOUS span) and the suffix scan
+    from ``end_dir`` instead of the free-end uniform direction (the
+    exiting-beta message from the NEXT span).
 
     Returns (a0_raw_dev0 [K], enter_dir [K], exit_dir [K]).
     """
@@ -116,32 +124,33 @@ def device_boundary_messages(a0_local, total_dev, d, axis):
     def pstep(v, Tk):
         return _nrm_v(jnp.matmul(v, Tk, precision=_HI)), v
 
-    _, enters_dev = jax.lax.scan(pstep, a0n, totals)
+    seed = a0n if start_dir is None else _nrm_v(start_dir + a0n * 0.0)
+    _, enters_dev = jax.lax.scan(pstep, seed, totals)
 
-    ones_dir = jnp.full(a0n.shape, 1.0, a0n.dtype) / a0n.shape[-1] + a0n * 0.0
+    if end_dir is None:
+        end_dir = jnp.full(a0n.shape, 1.0, a0n.dtype) / a0n.shape[-1]
+    anchor = _nrm_v(end_dir + a0n * 0.0)
 
     def sstep(b, Tk):
         return _nrm_v(jnp.matmul(Tk, b, precision=_HI)), b
 
-    _, exits_dev = jax.lax.scan(sstep, ones_dir, totals, reverse=True)
+    _, exits_dev = jax.lax.scan(sstep, anchor, totals, reverse=True)
     return a0_raw, enters_dev[d], exits_dev[d]
 
 
-def _one_seq_local_stats(
+def _lane_pass_products(
     params: HmmParams,
     obs_shard: jnp.ndarray,
     length: jnp.ndarray,
     *,
     axis: str,
     block_size: int,
-) -> SuffStats:
-    """This device's (un-psummed) statistics for one time-sharded sequence.
-
-    obs_shard: [L] symbols (PAD >= n_symbols allowed in the trailing pad);
-    length: [] count of real symbols in this shard.  Real symbols must be a
-    contiguous global prefix (pads only trail the sequence).  Collectives run
-    over ``axis``; the caller psums the result over the mesh.
-    """
+    first: bool = True,
+):
+    """Pass A + the lane layout for one device shard (the ONE XLA copy of
+    the packing/masking math): per-lane normalized operator products and
+    their inclusive prefix.  Consumed by _one_seq_lane_setup and by
+    parallel.posterior's span transfer-total sweep."""
     K, M = params.n_states, params.n_symbols
     L = obs_shard.shape[0]
     nb = L // block_size
@@ -155,7 +164,7 @@ def _one_seq_local_stats(
     pos_valid = jnp.arange(L) < length
     # The global init's emission folds into v0, so its step is identity
     # (exactly the viterbi_parallel / parallel.decode trick).
-    is_init = (jnp.arange(L) == 0) & (d == 0)
+    is_init = (jnp.arange(L) == 0) & (d == 0) & first
     step_valid = pos_valid & ~is_init
     sel_sym = jnp.where(step_valid, jnp.where(pos_valid, obs_c, M), M)
     emit_sym = jnp.where(pos_valid, jnp.minimum(obs_c, M - 1), 0)
@@ -167,7 +176,6 @@ def _one_seq_local_stats(
     sel2, emit2 = to2(sel_sym), to2(emit_sym)
     sv2, pv2 = to2(step_valid), to2(pos_valid)
 
-    # --- forward boundary messages -----------------------------------
     v0_local = jnp.exp(params.log_pi) * B_ext[jnp.minimum(obs_c[0], M - 1)]
 
     # Pass A: per-lane operator products (normalized each step).
@@ -182,9 +190,46 @@ def _one_seq_local_stats(
 
     P_lane, _ = jax.lax.scan(passA, eye_b, sel2)  # [nb, K, K]
     incl = jax.lax.associative_scan(_matmul_combine, P_lane, axis=0)
+    return dict(
+        K=K, M=M, nb=nb, d=d, A=A, B_ext=B_ext, eye_b=eye_b,
+        sel2=sel2, emit2=emit2, sv2=sv2, pv2=pv2,
+        P_lane=P_lane, incl=incl, v0_local=v0_local,
+    )
+
+
+def _one_seq_lane_setup(
+    params: HmmParams,
+    obs_shard: jnp.ndarray,
+    length: jnp.ndarray,
+    *,
+    axis: str,
+    block_size: int,
+    enter_dir=None,
+    exit_dir=None,
+    first: bool = True,
+):
+    """Shared passes A/B for one time-sharded sequence: lane products ->
+    boundary messages -> stored alphas/scales + per-lane exiting-beta
+    directions.  Consumed by the stats pass (_one_seq_local_stats) and the
+    posterior pass (_one_seq_local_posterior).
+
+    ``first`` (static) marks the sequence's first span: global position 0 is
+    the init (identity step, emission folded into v0).  ``enter_dir`` /
+    ``exit_dir`` thread span-boundary messages exactly like
+    device_boundary_messages threads device boundaries.
+    """
+    lay = _lane_pass_products(
+        params, obs_shard, length, axis=axis, block_size=block_size, first=first
+    )
+    K, M, nb, d = lay["K"], lay["M"], lay["nb"], lay["d"]
+    A, B_ext, eye_b = lay["A"], lay["B_ext"], lay["eye_b"]
+    sel2, emit2, sv2, pv2 = lay["sel2"], lay["emit2"], lay["sv2"], lay["pv2"]
+    P_lane, incl, v0_local = lay["P_lane"], lay["incl"], lay["v0_local"]
 
     v0_raw, v_enter_dev, beta_exit_dev = device_boundary_messages(
-        v0_local, incl[-1], d, axis
+        v0_local, incl[-1], d, axis,
+        start_dir=None if first else enter_dir,
+        end_dir=exit_dir,
     )
 
     excl = jnp.concatenate([eye_b[:1], incl[:-1]], axis=0)
@@ -204,8 +249,12 @@ def _one_seq_local_stats(
     _, (alphas, cs) = jax.lax.scan(passB, enters, (sel2, sv2))  # [bs, nb, K], [bs, nb]
     # The init's folded-emission scale belongs to device 0 — and only when
     # it actually observed a symbol (an all-padding stream has loglik 0).
+    # Span-threading callers get DIRECTION-relative logliks only (the scale
+    # of a continuation span's entering message is unknown by design).
     loglik = jnp.sum(jnp.where(sv2, jnp.log(cs), 0.0)) + jnp.where(
-        (d == 0) & (length > 0), jnp.log(jnp.maximum(jnp.sum(v0_raw), _TINY)), 0.0
+        (d == 0) & first & (length > 0),
+        jnp.log(jnp.maximum(jnp.sum(v0_raw), _TINY)),
+        0.0,
     )
 
     # --- backward boundary messages: beta_exit_dev from the exchange above.
@@ -221,6 +270,38 @@ def _one_seq_local_stats(
         ],
         axis=0,
     )  # [nb, K]
+    return dict(
+        K=K, M=M, nb=nb, d=d, A=A, B_ext=B_ext, eye_b=eye_b,
+        sel2=sel2, emit2=emit2, sv2=sv2, pv2=pv2,
+        enters=enters, alphas=alphas, cs=cs, loglik=loglik,
+        beta_exits=beta_exits,
+    )
+
+
+def _one_seq_local_stats(
+    params: HmmParams,
+    obs_shard: jnp.ndarray,
+    length: jnp.ndarray,
+    *,
+    axis: str,
+    block_size: int,
+) -> SuffStats:
+    """This device's (un-psummed) statistics for one time-sharded sequence.
+
+    obs_shard: [L] symbols (PAD >= n_symbols allowed in the trailing pad);
+    length: [] count of real symbols in this shard.  Real symbols must be a
+    contiguous global prefix (pads only trail the sequence).  Collectives run
+    over ``axis``; the caller psums the result over the mesh.
+    """
+    s = _one_seq_lane_setup(
+        params, obs_shard, length, axis=axis, block_size=block_size
+    )
+    K, M, nb, d = s["K"], s["M"], s["nb"], s["d"]
+    A, B_ext, eye_b = s["A"], s["B_ext"], s["eye_b"]
+    sel2, emit2, sv2, pv2 = s["sel2"], s["emit2"], s["sv2"], s["pv2"]
+    enters, alphas, loglik = s["enters"], s["alphas"], s["loglik"]
+    beta_exits = s["beta_exits"]
+    block_size = sel2.shape[0]
 
     # --- Pass C: fused backward + gamma/xi accumulation ---------------
     a_prev = jnp.concatenate([enters[None], alphas[:-1]], axis=0)  # [bs, nb, K]
@@ -272,6 +353,66 @@ def _one_seq_local_stats(
         loglik=loglik,
         n_seqs=jnp.where(at_init, 1, 0).astype(jnp.int32),
     )
+
+
+def _one_seq_local_posterior(
+    params: HmmParams,
+    obs_shard: jnp.ndarray,
+    length: jnp.ndarray,
+    island_mask: jnp.ndarray,
+    *,
+    axis: str,
+    block_size: int,
+    enter_dir=None,
+    exit_dir=None,
+    first: bool = True,
+    want_path: bool = False,
+):
+    """This device's per-position island confidence (XLA lane path).
+
+    The posterior twin of _one_seq_local_stats: same passes A/B and boundary
+    messages, but pass C emits conf[t] = sum_{k in islands} gamma[t, k] (and
+    optionally the max-posterior-marginal state) per position instead of
+    accumulating count tensors.  gamma is scale-free (normalized
+    alpha_t * beta_t), so beta DIRECTIONS give exact posteriors across lane,
+    device, and span boundaries.  Returns (conf [L] f32, path [L] int32).
+    """
+    s = _one_seq_lane_setup(
+        params, obs_shard, length, axis=axis, block_size=block_size,
+        enter_dir=enter_dir, exit_dir=exit_dir, first=first,
+    )
+    nb, A, B_ext = s["nb"], s["A"], s["B_ext"]
+    sel2, sv2, pv2 = s["sel2"], s["sv2"], s["pv2"]
+    alphas, beta_exits = s["alphas"], s["beta_exits"]
+    M = s["M"]
+    bs = sel2.shape[0]
+
+    sel_next2 = jnp.concatenate([sel2[1:], jnp.full((1, nb), M, sel2.dtype)], axis=0)
+    svn2 = jnp.concatenate([sv2[1:], jnp.zeros((1, nb), bool)], axis=0)
+    last2 = jnp.zeros((bs, nb), bool).at[-1].set(True)
+    mask = island_mask.astype(A.dtype)
+
+    def passP(beta_next, inp):
+        alpha_t, sym_next, sv_next, last_t, pv_t = inp
+        w = _select(B_ext, sym_next) * beta_next  # [nb, K]
+        beta_rec = _nrm_v(jnp.einsum("nk,jk->nj", w, A, precision=_HI))
+        beta_t = jnp.where(
+            last_t[:, None],
+            beta_exits,
+            jnp.where(sv_next[:, None], beta_rec, beta_next),
+        )
+        gamma = _nrm_v(alpha_t * beta_t)
+        conf_t = jnp.where(pv_t, jnp.sum(gamma * mask[None, :], axis=-1), 0.0)
+        path_t = jnp.where(pv_t, jnp.argmax(gamma, axis=-1), 0).astype(jnp.int32)
+        return beta_t, (conf_t, path_t)
+
+    _, (conf2, path2) = jax.lax.scan(
+        passP, beta_exits, (alphas, sel_next2, svn2, last2, pv2), reverse=True
+    )
+    # [bs, nb] lane layout back to global order.
+    conf = conf2.T.reshape(-1)
+    path = path2.T.reshape(-1) if want_path else jnp.zeros(conf.shape, jnp.int32)
+    return conf, path
 
 
 def _shard_stats_body(block_size: int, axis: str):

@@ -161,3 +161,26 @@ def _cluster_env() -> bool:
 def local_device_count() -> int:
     """Devices attached to THIS process (not the global pod count)."""
     return jax.local_device_count()
+
+
+def fetch_sharded_prefix(x, T: int, return_device: bool):
+    """Return the first T elements of a P(axis)-sharded per-position array —
+    on device (``return_device=True``) or as a host ndarray.
+
+    The ONE implementation of the multi-host subtlety (parallel.decode and
+    parallel.posterior both fetch through here): on a multi-host global mesh
+    the sharded output spans non-addressable devices, so a plain fetch
+    raises; gather every host a full copy over DCN (the host-side result is
+    for island calling / dumps, which every process replicates anyway).
+    Gating on addressability — not process_count — keeps per-host meshes in
+    multi-process jobs on the direct fetch, where a gather would splice
+    other hosts' unrelated results.  Device-resident consumers should prefer
+    ``return_device=True`` and reduce on device instead.
+    """
+    if return_device:
+        return x[:T]
+    if not x.is_fully_addressable:
+        from jax.experimental import multihost_utils
+
+        return np.asarray(multihost_utils.process_allgather(x, tiled=True))[:T]
+    return np.asarray(x)[:T]

@@ -1,0 +1,256 @@
+"""Sequence-parallel posterior (soft) decoding over a device mesh.
+
+The soft twin of parallel.decode: per-position island confidence
+P(position in island | whole record) computed through the SAME lane-parallel
+forward-backward machinery as the E-step — fused Pallas kernels on TPU
+(ops.fb_pallas._seq_posterior_core), the blockwise XLA lane path elsewhere
+(parallel.fb_sharded._one_seq_local_posterior) — with boundary messages
+making the result exact across lanes, devices, and (via enter/exit
+directions threaded by pipeline.posterior_file) sequential spans of records
+larger than one pass.
+
+The reference's Mahout surface exposes only hard Viterbi decoding
+(HmmEvaluator.decode, CpGIslandFinder.java:260); this module is its soft
+completion at decode-class throughput.  Cross-device communication per pass:
+one all_gather of [K] init directions and one of [K, K] transfer totals —
+independent of sequence length, identical to the E-step's exchange
+(parallel.fb_sharded.device_boundary_messages).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from cpgisland_tpu.models.hmm import HmmParams
+from cpgisland_tpu.ops import fb_pallas
+from cpgisland_tpu.parallel.fb_sharded import (
+    DEFAULT_BLOCK,
+    _lane_pass_products,
+    _nrm_m,
+    _one_seq_local_posterior,
+    shard_sequence,
+)
+from cpgisland_tpu.parallel.mesh import (
+    SEQ_AXIS,
+    fetch_sharded_prefix,
+    make_mesh,
+)
+
+_HI = jax.lax.Precision.HIGHEST
+
+
+def resolve_fb_engine(engine: str, params: HmmParams) -> str:
+    """'auto' picks the fused FB kernels on TPU when the model fits their
+    lane packing, the XLA lane path otherwise (incl. the CPU test mesh)."""
+    if engine == "auto":
+        if jax.default_backend() == "tpu" and fb_pallas.supports(params):
+            return "pallas"
+        return "xla"
+    if engine not in ("xla", "pallas"):
+        raise ValueError(f"unknown engine {engine!r}; expected auto|xla|pallas")
+    if engine == "pallas" and not fb_pallas.supports(params):
+        raise ValueError(
+            f"pallas FB kernels need n_states <= 8, got {params.n_states}"
+        )
+    return engine
+
+
+@functools.lru_cache(maxsize=32)
+def _posterior_fn(
+    mesh: Mesh,
+    block_size: int,
+    engine: str,
+    first: bool,
+    want_path: bool,
+    lane_T: int,
+    t_tile: int,
+):
+    """Compiled sharded posterior: fn(params, obs, lens, mask, enter, exit)
+    -> (conf P(axis), path P(axis)).  enter/exit are always arrays — the
+    uniform direction IS the free-end anchor, and enter is ignored when
+    ``first`` — so one cache entry serves every span of a record."""
+    axis = mesh.axis_names[0]
+
+    def body(params, obs_shard, len_shard, island_mask, enter_dir, exit_dir):
+        if engine == "pallas":
+            return fb_pallas._seq_posterior_core(
+                params, obs_shard, len_shard[0], island_mask, lane_T, t_tile,
+                axis=axis, enter_dir=enter_dir, exit_dir=exit_dir,
+                first=first, want_path=want_path,
+            )
+        return _one_seq_local_posterior(
+            params, obs_shard, len_shard[0], island_mask,
+            axis=axis, block_size=block_size,
+            enter_dir=enter_dir, exit_dir=exit_dir,
+            first=first, want_path=want_path,
+        )
+
+    return jax.jit(
+        jax.shard_map(
+            body,
+            mesh=mesh,
+            in_specs=(P(), P(axis), P(axis), P(), P(), P()),
+            out_specs=(P(axis), P(axis)),
+            check_vma=engine != "pallas",
+        )
+    )
+
+
+@functools.lru_cache(maxsize=32)
+def _transfer_total_fn(mesh: Mesh, block_size: int, first: bool):
+    """Compiled sharded span transfer operator (probability space): the
+    cheap products-only forward sweep of span threading (XLA lane path;
+    single-device TPU callers use fb_pallas.seq_transfer_total_pallas).
+    Returns the replicated [K, K] normalized operator of the whole span."""
+    axis = mesh.axis_names[0]
+
+    def body(params: HmmParams, obs_shard: jnp.ndarray, len_shard: jnp.ndarray):
+        K = params.n_states
+        incl = _lane_pass_products(
+            params, obs_shard, len_shard[0],
+            axis=axis, block_size=block_size, first=first,
+        )["incl"]
+        totals = jax.lax.all_gather(incl[-1], axis)  # [D, K, K]
+
+        def comp(C, Tk):
+            return _nrm_m(jnp.matmul(C, Tk, precision=_HI)), None
+
+        total, _ = jax.lax.scan(
+            comp, jnp.eye(K, dtype=incl.dtype) + incl[-1] * 0.0, totals
+        )
+        # Identical on every device; pmax makes replication provable.
+        return jax.lax.pmax(total, axis)
+
+    return jax.jit(
+        jax.shard_map(
+            body,
+            mesh=mesh,
+            in_specs=(P(), P(axis), P(axis)),
+            out_specs=P(),
+        )
+    )
+
+
+def _place(mesh: Mesh, obs: np.ndarray, block_size: int, pad_sym: int,
+           length: Optional[int] = None, pad_to: Optional[int] = None):
+    """PAD-pad and device_put one sequence with P(axis) + per-shard lengths.
+
+    ``pad_to`` bucket-pads the sequence before sharding (the compiled fns
+    specialize on the padded shape — scaffold-heavy files would otherwise
+    compile once per distinct record size); ``length`` is the real symbol
+    count (default: the input size), which the cores mask by.
+    """
+    axis = mesh.axis_names[0]
+    n_dev = mesh.shape[axis]
+    obs = np.asarray(obs)
+    n = obs.shape[0] if length is None else int(length)
+    if pad_to is not None and pad_to > obs.shape[0]:
+        obs = np.concatenate(
+            [obs, np.full(pad_to - obs.shape[0], pad_sym, obs.dtype)]
+        )
+    obs_p, _ = shard_sequence(obs, n_dev, block_size, pad_sym)
+    L = obs_p.shape[0] // n_dev
+    lengths = np.clip(n - np.arange(n_dev) * L, 0, L).astype(np.int32)
+    sharding = NamedSharding(mesh, P(axis))
+    return (
+        jax.device_put(jnp.asarray(obs_p), sharding),
+        jax.device_put(jnp.asarray(lengths), sharding),
+    )
+
+
+def island_mask(params: HmmParams, island_states) -> np.ndarray:
+    mask = np.zeros(params.n_states, np.float32)
+    mask[list(island_states)] = 1.0
+    return mask
+
+
+def posterior_sharded(
+    params: HmmParams,
+    obs,
+    island_states,
+    *,
+    mesh: Optional[Mesh] = None,
+    block_size: int = DEFAULT_BLOCK,
+    engine: str = "auto",
+    lane_T: Optional[int] = None,
+    t_tile: Optional[int] = None,
+    enter_dir=None,
+    exit_dir=None,
+    first: bool = True,
+    want_path: bool = False,
+    return_device: bool = False,
+    pad_to: Optional[int] = None,
+):
+    """Island confidence (and optional MPM path) for one sequence, sharded
+    along time over the mesh.
+
+    enter_dir/exit_dir ([K] direction vectors) thread span-boundary messages
+    for records processed in multiple spans (pipeline.posterior_file);
+    defaults are the sequence start (``first=True``) and the free end.
+    ``pad_to`` bucket-pads the input so varied record sizes share compiled
+    shapes.  Returns (conf [T] f32, path [T] int32 or None).
+    """
+    if mesh is None:
+        mesh = make_mesh(axis=SEQ_AXIS)
+    eng = resolve_fb_engine(engine, params)
+    lt = lane_T if lane_T is not None else fb_pallas.DEFAULT_LANE_T
+    tt = t_tile if t_tile is not None else fb_pallas.DEFAULT_T_TILE
+    obs = np.asarray(obs)
+    T = obs.shape[0]
+    K = params.n_states
+    arr, lens = _place(mesh, obs, block_size, params.n_symbols, pad_to=pad_to)
+    mask = jnp.asarray(island_mask(params, island_states))
+    enter = (
+        jnp.zeros(K, jnp.float32) if enter_dir is None
+        else jnp.asarray(enter_dir, jnp.float32)
+    )
+    exit_ = (
+        jnp.full(K, 1.0 / K, jnp.float32) if exit_dir is None
+        else jnp.asarray(exit_dir, jnp.float32)
+    )
+    fn = _posterior_fn(mesh, block_size, eng, first, want_path, lt, tt)
+    conf, path = fn(params, arr, lens, mask, enter, exit_)
+    conf = fetch_sharded_prefix(conf, T, return_device)
+    path = fetch_sharded_prefix(path, T, return_device) if want_path else None
+    return conf, path
+
+
+def transfer_total_sharded(
+    params: HmmParams,
+    obs,
+    *,
+    mesh: Optional[Mesh] = None,
+    block_size: int = DEFAULT_BLOCK,
+    engine: str = "auto",
+    first: bool = True,
+    pad_to: Optional[int] = None,
+) -> np.ndarray:
+    """One span's normalized [K, K] probability-space transfer operator
+    (sweep A of span-threaded posterior processing)."""
+    if mesh is None:
+        mesh = make_mesh(axis=SEQ_AXIS)
+    n_dev = mesh.shape[mesh.axis_names[0]]
+    if n_dev == 1 and resolve_fb_engine(engine, params) == "pallas":
+        # Single-chip TPU: the products Pallas kernel is much faster than
+        # the XLA lane scan for this sweep.
+        obs = np.asarray(obs)
+        n = obs.shape[0]
+        if pad_to is not None and pad_to > n:
+            obs = np.concatenate(
+                [obs, np.full(pad_to - n, params.n_symbols, obs.dtype)]
+            )
+        return np.asarray(
+            fb_pallas.seq_transfer_total_pallas(
+                params, jnp.asarray(obs), n, first=first
+            )
+        )
+    arr, lens = _place(
+        mesh, np.asarray(obs), block_size, params.n_symbols, pad_to=pad_to
+    )
+    return np.asarray(_transfer_total_fn(mesh, block_size, first)(params, arr, lens))

@@ -33,7 +33,11 @@ from cpgisland_tpu.ops import islands as islands_mod
 from cpgisland_tpu.ops.islands import IslandCalls
 from cpgisland_tpu.ops.viterbi_pallas import viterbi_pallas_batch
 from cpgisland_tpu.ops.viterbi_parallel import viterbi_parallel_batch
-from cpgisland_tpu.parallel.decode import resolve_engine, viterbi_sharded
+from cpgisland_tpu.parallel.decode import (
+    resolve_engine,
+    viterbi_sharded,
+    viterbi_sharded_spans,
+)
 from cpgisland_tpu.train import baum_welch
 from cpgisland_tpu.train.backends import EStepBackend
 from cpgisland_tpu.utils import chunking, codec
@@ -138,10 +142,12 @@ class DecodeResult:
     n_chunks: int
 
 
-# Largest sequence decoded as one exact global decode in clean mode.  256 Mi
-# symbols (int32 on device plus int8 backpointers) fits one v5e chip's HBM and
-# covers every human chromosome; longer inputs fall back to span-wise decoding
-# with a DP restart at span boundaries (logged).
+# Largest sequence decoded in one sequence-parallel pass in clean mode.
+# 256 Mi symbols (int32 on device plus packed backpointers) fits one v5e
+# chip's HBM and covers every human chromosome; longer inputs decode
+# span-wise with boundary messages threaded between spans
+# (parallel.decode.viterbi_sharded_spans) — still exact, the span size only
+# bounds peak device memory.
 CLEAN_DECODE_SPAN = 1 << 28
 
 # Records at or below this size batch together into one vmap decode (clean
@@ -211,10 +217,20 @@ def decode_file(
             "without a state-path dump (compat quirks and the "
             "observation-based caller are host-only)"
         )
+    if island_engine == "device" and jax.process_count() > 1:
+        # viterbi_sharded(return_device=True) on a multi-host global mesh
+        # yields a non-fully-addressable path array whose [cap] record-column
+        # fetch (islands_device) is not certified there — only the host path
+        # got the process_allgather treatment.
+        raise ValueError(
+            "island_engine='device' is single-process only for now; use "
+            "'host' (or 'auto') in multi-host jobs"
+        )
     use_device_islands = island_engine == "device" or (
         island_engine == "auto"
         and device_eligible
         and jax.default_backend() == "tpu"
+        and jax.process_count() == 1
     )
     if island_cap is None:
         from cpgisland_tpu.ops.islands_device import DEFAULT_CAP
@@ -274,7 +290,12 @@ def decode_file(
     # 1-based coordinates, so an island can never span a chromosome boundary
     # (the reference concatenates the whole char stream, java:238-254).
     parts: list[IslandCalls] = []
-    paths_out: list[np.ndarray] = []
+    if state_path_out is not None:
+        from cpgisland_tpu.utils.npystream import NpyStreamWriter
+
+        path_writer = NpyStreamWriter(state_path_out, np.int8)
+    else:
+        path_writer = None
     n_sym = 0
     n_records = 0
     n_spans_total = 0
@@ -284,19 +305,27 @@ def decode_file(
         n_spans = max(1, -(-symbols.size // span))
         n_spans_total += n_spans
         if n_spans > 1:
-            log.warning(
-                "record %r (%d symbols) exceeds the exact-decode span (%d); "
-                "decoding %d spans with a DP restart at each span boundary",
+            log.info(
+                "record %r (%d symbols) exceeds the single-pass decode span "
+                "(%d); decoding %d spans with boundary messages threaded "
+                "between them (exact — no DP restart)",
                 rec_name, symbols.size, span, n_spans,
             )
         with timer.phase("decode", items=float(symbols.size), unit="sym"):
-            pieces = [
-                viterbi_sharded(
-                    params, symbols[lo : lo + span], engine=engine,
+            if symbols.size == 0:
+                pieces = [np.zeros(0, dtype=np.int32)]
+            elif n_spans > 1:
+                pieces = viterbi_sharded_spans(
+                    params, symbols, span=span, engine=engine,
                     return_device=use_device_islands,
                 )
-                for lo in range(0, symbols.size, span)
-            ] or [np.zeros(0, dtype=np.int32)]
+            else:
+                pieces = [
+                    viterbi_sharded(
+                        params, symbols, engine=engine,
+                        return_device=use_device_islands,
+                    )
+                ]
             if use_device_islands:
                 full = pieces[0] if len(pieces) == 1 else jnp.concatenate(pieces)
                 # Async dispatch would land the decode's device time in the
@@ -319,8 +348,8 @@ def decode_file(
         # "." = headerless leading sequence: keeps the name column parseable
         # (a bare "" would emit a leading space and split into 5 fields).
         parts.append(calls.with_names(rec_name or "."))
-        if state_path_out is not None:
-            paths_out.append(np.asarray(full).astype(np.int8))
+        if path_writer is not None:
+            path_writer.write(np.asarray(full).astype(np.int8))
 
     def flush_small(batch: list) -> None:
         nonlocal n_spans_total
@@ -334,30 +363,37 @@ def decode_file(
             island_states=island_states,
             use_device_islands=use_device_islands,
             island_cap=island_cap,
-            want_paths=state_path_out is not None,
+            want_paths=path_writer is not None,
             timer=timer,
         )
         n_spans_total += n_spans_total_add
         parts.extend(batch_parts)
-        paths_out.extend(batch_paths)
+        for p in batch_paths:
+            path_writer.write(p)
 
     # Small records (scaffolds) batch into one vmap decode per device_batch;
     # large records go through the sequence-parallel sharded decode.  Order
-    # is preserved: a large record flushes the pending batch first.
-    pending: list = []
-    for rec_name, symbols in codec.iter_fasta_records(test_path):
-        n_records += 1
-        n_sym += symbols.size
-        if symbols.size <= SMALL_RECORD_MAX:
-            pending.append((rec_name, symbols))
-            if len(pending) >= device_batch:
+    # is preserved: a large record flushes the pending batch first.  The
+    # finally keeps the state-path dump loadable (partial but valid) if a
+    # record fails mid-file.
+    try:
+        pending: list = []
+        for rec_name, symbols in codec.iter_fasta_records(test_path):
+            n_records += 1
+            n_sym += symbols.size
+            if symbols.size <= SMALL_RECORD_MAX:
+                pending.append((rec_name, symbols))
+                if len(pending) >= device_batch:
+                    flush_small(pending)
+                    pending = []
+            else:
                 flush_small(pending)
                 pending = []
-        else:
-            flush_small(pending)
-            pending = []
-            decode_one(rec_name, symbols)
-    flush_small(pending)
+                decode_one(rec_name, symbols)
+        flush_small(pending)
+    finally:
+        if path_writer is not None:
+            path_writer.close()
     calls = IslandCalls.concatenate(parts)
     if n_records <= 1:
         # Single-record files keep the reference's bare 5-column format.
@@ -373,11 +409,6 @@ def decode_file(
             **timer.as_dict(),
         )
     log.info("decode phases:\n%s", timer.report())
-    if state_path_out is not None:
-        np.save(
-            state_path_out,
-            np.concatenate(paths_out) if paths_out else np.zeros(0, np.int8),
-        )
     return _finish_decode(calls, n_sym, n_spans_total, islands_out)
 
 
@@ -478,10 +509,12 @@ def _decode_small_batch(
     return B, parts, paths_out
 
 
-# Posterior spans are smaller than decode spans: gamma materializes [T, K]
-# f32 on device (32 B/symbol at K=8 vs the decode path's 4), so 32 Mi spans
-# keep the working set ~2 GB.
-POSTERIOR_SPAN = 1 << 25
+# One posterior pass materializes the alpha/beta kernel streams on device
+# (~72 B/symbol at K=8), so 64 Mi spans keep the working set under ~5 GB of
+# HBM.  Longer records process span-wise with boundary-message threading
+# (EXACT — the span size only bounds peak device memory, like
+# CLEAN_DECODE_SPAN for the hard decode).
+POSTERIOR_SPAN = 1 << 26
 
 
 @dataclass
@@ -499,7 +532,9 @@ def posterior_file(
     mpm_path_out: Optional[str] = None,
     island_states=None,
     span: int = POSTERIOR_SPAN,
+    engine: str = "auto",
     metrics: Optional[profiling.MetricsLogger] = None,
+    timer: Optional[profiling.PhaseTimer] = None,
 ) -> PosteriorResult:
     """Soft decoding of a FASTA file: per-position island confidence.
 
@@ -507,71 +542,128 @@ def posterior_file(
     (HmmEvaluator.decode, CpGIslandFinder.java:260); this is its soft
     completion — P(position is in an island | whole record) = the summed
     posterior marginal over the island states, written as one float32 per
-    symbol (.npy).  ``mpm_path_out`` additionally writes the
-    max-posterior-marginal state path (int8), the soft counterpart of
-    decode_file's ``state_path_out``.
+    symbol (.npy, streamed record by record).  ``mpm_path_out`` additionally
+    writes the max-posterior-marginal state path (int8), the soft
+    counterpart of decode_file's ``state_path_out``.
 
     ``island_states``: which states count as "island" (same contract as
     decode_file's flag); default = the first n_symbols states, the
     reference's 2M-state X+/X- labeling, which the model must then match.
 
-    Clean semantics only (FASTA-aware, per-record); records longer than
-    ``span`` process in spans with a forward-recurrence restart at span
-    boundaries (same compromise as decode_file's CLEAN_DECODE_SPAN, logged).
+    Clean semantics only (FASTA-aware, per-record).  Every record runs
+    through the lane-parallel forward-backward machinery
+    (parallel.posterior.posterior_sharded: fused Pallas kernels on TPU, the
+    blockwise XLA lane path elsewhere, sequence-parallel over the mesh).
+    Records longer than ``span`` process in spans with enter/exit boundary
+    directions threaded between them — EXACT posteriors at any length; the
+    span only bounds peak device memory.
     """
-    from cpgisland_tpu.ops.forward_backward import posterior_marginals
+    from cpgisland_tpu.parallel.posterior import (
+        posterior_sharded,
+        transfer_total_sharded,
+    )
+    from cpgisland_tpu.utils.npystream import NpyStreamWriter
 
     if island_states is None:
         err = island_layout_error(params, island_states)
         if err:
             raise ValueError(f"island confidence: {err}")
         island_states = tuple(range(params.n_symbols))
-    island_idx = jnp.asarray(sorted(island_states), jnp.int32)
-    conf_parts: list[np.ndarray] = []
-    path_parts: list[np.ndarray] = []
+    island_states = tuple(sorted(island_states))
+    timer = timer if timer is not None else profiling.PhaseTimer()
+    want_path = mpm_path_out is not None
+    # Writers open INSIDE the try: a failure opening the second must still
+    # close (finalize) the first, not leave a corrupt header slot behind.
+    conf_w = None
+    path_w = None
     n_sym = 0
     n_records = 0
-    for rec_name, symbols in codec.iter_fasta_records(test_path):
-        n_records += 1
-        n_sym += symbols.size
-        if symbols.size > span:
-            log.warning(
+    conf_total = 0.0
+
+    def emit(conf, path) -> None:
+        nonlocal conf_total
+        conf = np.asarray(conf)
+        conf_total += float(conf.sum())
+        conf_w.write(conf)
+        if path_w is not None:
+            path_w.write(np.asarray(path).astype(np.int8))
+
+    try:
+        conf_w = NpyStreamWriter(confidence_out, np.float32)
+        if want_path:
+            path_w = NpyStreamWriter(mpm_path_out, np.int8)
+        for rec_name, symbols in codec.iter_fasta_records(test_path):
+            n_records += 1
+            n_sym += symbols.size
+            if symbols.size == 0:
+                continue
+            n_spans = -(-symbols.size // span)
+            if n_spans == 1:
+                with timer.phase("posterior", items=float(symbols.size), unit="sym"):
+                    conf, path = posterior_sharded(
+                        params, symbols, island_states,
+                        engine=engine, want_path=want_path,
+                        # Power-of-two buckets: scaffold-heavy files must not
+                        # compile once per distinct record size.
+                        pad_to=_round_pow2(symbols.size, floor=1 << 14),
+                    )
+                emit(conf, path)
+                continue
+            log.info(
                 "record %r (%d symbols) exceeds the posterior span (%d); "
-                "processing spans with a DP restart at each boundary",
-                rec_name, symbols.size, span,
+                "processing %d spans with boundary messages threaded "
+                "between them (exact — no DP restart)",
+                rec_name, symbols.size, span, n_spans,
             )
-        for lo in range(0, symbols.size, span):
-            piece = symbols[lo : lo + span]
-            n = piece.size
-            # Pad to power-of-two buckets (posterior_marginals masks by
-            # length) so scaffold-heavy files don't compile once per
-            # distinct record size.
-            Tpad = _round_pow2(n, floor=1 << 14)
-            padded = np.zeros(Tpad, piece.dtype)
-            padded[:n] = piece
-            gamma, _ = posterior_marginals(
-                params, jnp.asarray(padded), jnp.int32(n)
-            )
-            conf = jnp.sum(gamma[:, island_idx], axis=1)[:n]
-            conf_parts.append(np.asarray(conf, dtype=np.float32))
-            if mpm_path_out is not None:
-                path_parts.append(
-                    np.asarray(jnp.argmax(gamma[:n], axis=-1), dtype=np.int8)
-                )
-    conf_all = (
-        np.concatenate(conf_parts) if conf_parts else np.zeros(0, np.float32)
-    )
-    np.save(confidence_out, conf_all)
-    if mpm_path_out is not None:
-        np.save(
-            mpm_path_out,
-            np.concatenate(path_parts) if path_parts else np.zeros(0, np.int8),
-        )
-    mean_conf = float(conf_all.mean()) if conf_all.size else 0.0
+            # Sweep A: each span's [K, K] transfer operator (products only).
+            # pad_to=span: every span (incl. the ragged tail) shares ONE
+            # compiled shape.
+            with timer.phase("span-totals", items=float(symbols.size), unit="sym"):
+                totals = [
+                    transfer_total_sharded(
+                        params, symbols[lo : lo + span], engine=engine,
+                        first=lo == 0, pad_to=span,
+                    )
+                    for lo in range(0, symbols.size, span)
+                ]
+            # Host threading: entering-alpha / exiting-beta directions per
+            # span (tiny [K]x[K,K] chains, f32 on normalized operators).
+            pi = np.exp(np.asarray(params.log_pi, np.float64))
+            B = np.exp(np.asarray(params.log_B, np.float64))
+            v = pi * B[:, int(symbols[0])]
+            enters = [(v / v.sum()).astype(np.float32)]
+            for s in range(n_spans - 1):
+                v = enters[-1] @ totals[s]
+                enters.append((v / v.sum()).astype(np.float32))
+            exits: list = [None] * n_spans
+            e = np.full(params.n_states, 1.0 / params.n_states, np.float32)
+            for s in range(n_spans - 2, -1, -1):
+                e = totals[s + 1] @ e
+                e = (e / e.sum()).astype(np.float32)
+                exits[s] = e
+            # Sweep B: full posterior per span with the threaded messages.
+            for s in range(n_spans):
+                lo = s * span
+                piece = symbols[lo : lo + span]
+                with timer.phase("posterior", items=float(piece.size), unit="sym"):
+                    conf, path = posterior_sharded(
+                        params, piece, island_states, engine=engine,
+                        enter_dir=None if s == 0 else enters[s],
+                        exit_dir=exits[s], first=s == 0,
+                        want_path=want_path, pad_to=span,
+                    )
+                emit(conf, path)
+    finally:
+        if conf_w is not None:
+            conf_w.close()
+        if path_w is not None:
+            path_w.close()
+    mean_conf = conf_total / n_sym if n_sym else 0.0
+    log.info("posterior phases:\n%s", timer.report())
     if metrics is not None:
         metrics.log(
             "posterior", n_symbols=n_sym, n_records=n_records,
-            mean_island_confidence=mean_conf,
+            mean_island_confidence=mean_conf, **timer.as_dict(),
         )
     return PosteriorResult(
         n_symbols=n_sym, n_records=n_records, mean_island_confidence=mean_conf

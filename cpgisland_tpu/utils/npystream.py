@@ -1,0 +1,67 @@
+"""Streaming one-pass .npy writer (header patched with the final length).
+
+Genome-scale per-position outputs (posterior confidence, state-path dumps)
+are written record by record as they are computed; accumulating them in host
+RAM to hand numpy.save one big array would peak at O(genome) twice over
+(the list of parts plus the concatenation).  The total length is unknown
+until the FASTA stream ends, so the writer reserves a fixed-size header slot
+up front, streams raw element bytes, and rewrites the real npy 1.0 header on
+close — the result is byte-compatible with numpy.save / numpy.load
+(including mmap_mode) for 1-D arrays.
+"""
+
+from __future__ import annotations
+
+import struct
+
+import numpy as np
+
+# npy 1.0: magic (6) + version (2) + header-length uint16 (2) + header text.
+_SLOT = 128
+_MAGIC = b"\x93NUMPY\x01\x00"
+
+
+class NpyStreamWriter:
+    """Append-only 1-D .npy writer; use as a context manager or call close().
+
+    The final header must fit the reserved slot: dtype descr plus up to a
+    ~19-digit element count — comfortably within 128 bytes.
+    """
+
+    def __init__(self, path: str, dtype):
+        self.dtype = np.dtype(dtype)
+        self._n = 0
+        self._f = open(path, "wb")
+        self._f.write(b"\x00" * _SLOT)
+
+    def write(self, arr) -> None:
+        arr = np.ascontiguousarray(arr, dtype=self.dtype)
+        arr.tofile(self._f)
+        self._n += arr.size
+
+    @property
+    def count(self) -> int:
+        return self._n
+
+    def close(self) -> None:
+        if self._f.closed:
+            return
+        header = (
+            "{'descr': %r, 'fortran_order': False, 'shape': (%d,), }"
+            % (np.lib.format.dtype_to_descr(self.dtype), self._n)
+        ).encode("latin1")
+        pad = _SLOT - len(_MAGIC) - 2 - len(header) - 1
+        if pad < 0:  # pragma: no cover — needs a >100-char dtype descr
+            raise ValueError("npy header slot overflow")
+        header += b" " * pad + b"\n"
+        self._f.seek(0)
+        self._f.write(_MAGIC)
+        self._f.write(struct.pack("<H", len(header)))
+        self._f.write(header)
+        self._f.close()
+
+    def __enter__(self) -> "NpyStreamWriter":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

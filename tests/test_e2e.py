@@ -92,6 +92,23 @@ def test_compat_decode_resets_at_chunk_boundaries(tmp_path, rng):
     assert clean.n_symbols == len(text)
 
 
+def test_clean_decode_spanwise_identical_to_onepass(tmp_path, rng):
+    """A record forced through the span-wise decode (span smaller than the
+    record) must produce IDENTICAL island calls to the one-pass decode —
+    boundary messages thread between spans, no DP restart (VERDICT r2 #3)."""
+    text, spans = synth_genome(rng, n_islands=4, island_len=400, bg_len=2000)
+    fa = tmp_path / "g.txt"
+    fa.write_text(text)
+    params = presets.durbin_cpg8()
+    one = pipeline.decode_file(str(fa), params, compat=False)
+    spanned = pipeline.decode_file(str(fa), params, compat=False, span=3000)
+    assert spanned.n_chunks > 1  # actually exercised the span path
+    np.testing.assert_array_equal(one.calls.beg, spanned.calls.beg)
+    np.testing.assert_array_equal(one.calls.end, spanned.calls.end)
+    np.testing.assert_allclose(one.calls.gc_content, spanned.calls.gc_content)
+    assert _recall(spanned.calls, spans) >= 0.75
+
+
 def test_cli_compat_six_arg_form(tmp_path, rng):
     text, spans = synth_genome(rng, n_islands=3, island_len=400, bg_len=1500)
     train_f = tmp_path / "train.txt"

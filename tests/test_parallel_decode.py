@@ -99,6 +99,49 @@ def test_explicit_small_mesh(rng):
     )
 
 
+def test_spanwise_decode_bit_identical_to_oneshot(rng):
+    """viterbi_sharded_spans threads boundary messages across spans, so a
+    record decoded in 5 spans must equal the one-shot sharded decode exactly
+    (VERDICT r2 item 3: CLEAN_DECODE_SPAN stops being an exactness boundary)."""
+    params = presets.durbin_cpg8()
+    T = 5 * 4096 + 777  # 6 spans incl. a ragged tail
+    bg = rng.choice([0, 3], size=T).astype(np.int32)
+    obs = bg.copy()
+    # Plant islands straddling two span boundaries (4096, 8192) so the old
+    # restart artifact would have flipped positions there.
+    for mid in (4096, 8192, 3 * 4096 + 100):
+        obs[mid - 200 : mid + 200] = np.tile([1, 2], 200)
+    oneshot = PD.viterbi_sharded(params, obs, block_size=64)
+    spans = PD.viterbi_sharded_spans(params, obs, span=4096, block_size=64)
+    assert [p.shape[0] for p in spans] == [4096] * 5 + [777]
+    np.testing.assert_array_equal(np.concatenate(spans), oneshot)
+
+
+def test_spanwise_decode_short_input_delegates(rng):
+    params = presets.durbin_cpg8()
+    obs = rng.integers(0, 4, size=1000).astype(np.int32)
+    spans = PD.viterbi_sharded_spans(params, obs, span=4096, block_size=32)
+    assert len(spans) == 1
+    np.testing.assert_array_equal(
+        spans[0], PD.viterbi_sharded(params, obs, block_size=32)
+    )
+
+
+def test_spanwise_decode_random_model_matches_f64_dp(rng):
+    """Span stitching on a tie-prone random model still achieves the f64-DP
+    optimal score."""
+    pi = rng.dirichlet(np.ones(4))
+    A = rng.dirichlet(np.ones(4), size=4)
+    B = rng.dirichlet(np.ones(4), size=4)
+    params = HmmParams.from_probs(pi, A, B)
+    obs = rng.integers(0, 4, size=3000).astype(np.int32)
+    _, s_opt = V.viterbi(params, jnp.asarray(obs))
+    spans = PD.viterbi_sharded_spans(params, obs, span=1024, block_size=32)
+    assert _path_score(params, obs, np.concatenate(spans)) == pytest.approx(
+        float(s_opt), abs=2e-2, rel=1e-5
+    )
+
+
 def test_initialize_multihost_single_process_noop():
     """Without a cluster environment (and no explicit args) this is a no-op
     that reports the device count; explicit-but-broken args still raise."""

@@ -114,8 +114,9 @@ def test_posterior_cli(tmp_path, rng):
 def test_posterior_multi_record_and_span(tmp_path, rng):
     """Two records, one forced through the span path (span passed explicitly,
     smaller than the first record): outputs concatenate in order with
-    per-record lengths, and the non-boundary positions match the unspanned
-    computation."""
+    per-record lengths, and EVERY position — including span boundaries —
+    matches the unspanned computation (boundary messages are threaded
+    between spans; no DP restart, VERDICT r2 #1)."""
     fa = tmp_path / "m.fa"
     with open(fa, "w") as f:
         for i, nlen in enumerate((2100, 900)):
@@ -134,7 +135,60 @@ def test_posterior_multi_record_and_span(tmp_path, rng):
         str(fa), presets.durbin_cpg8(), confidence_out=str(conf_p)
     )
     full = np.load(conf_p)
-    # Away from the record-1 span boundary at 1500, the restart's effect
-    # decays — interior positions agree with the exact computation.
-    np.testing.assert_allclose(spanned[:1400], full[:1400], atol=1e-4)
-    np.testing.assert_allclose(spanned[2100:], full[2100:], atol=1e-4)
+    np.testing.assert_allclose(spanned, full, atol=2e-5)
+
+
+def test_posterior_sharded_matches_oracle(rng):
+    """posterior_sharded (XLA lane path, 8-device CPU mesh) vs the
+    single-scan posterior_marginals oracle, incl. MPM path."""
+    from cpgisland_tpu.parallel.posterior import posterior_sharded
+
+    params = presets.durbin_cpg8()
+    obs = rng.choice([0, 1, 2, 3], size=5000, p=[0.3, 0.2, 0.2, 0.3]).astype(np.uint8)
+    conf, path = posterior_sharded(
+        params, obs, (0, 1, 2, 3), block_size=64, want_path=True
+    )
+    gamma, _ = posterior_marginals(params, jnp.asarray(obs))
+    np.testing.assert_allclose(
+        conf, np.asarray(gamma[:, :4].sum(axis=1)), atol=2e-5
+    )
+    np.testing.assert_array_equal(path, np.asarray(jnp.argmax(gamma, axis=1)))
+
+
+def test_posterior_pallas_engine_matches_oracle(rng):
+    """The fused-kernel posterior core (interpret mode off-TPU) vs oracle."""
+    from cpgisland_tpu.ops import fb_pallas
+
+    params = presets.durbin_cpg8()
+    obs = rng.choice([0, 1, 2, 3], size=2000, p=[0.3, 0.2, 0.2, 0.3]).astype(np.uint8)
+    mask = jnp.asarray((np.arange(8) < 4).astype(np.float32))
+    conf, path = fb_pallas.seq_posterior_pallas(
+        params, jnp.asarray(obs), obs.size, mask, want_path=True,
+        lane_T=256, t_tile=64,
+    )
+    gamma, _ = posterior_marginals(params, jnp.asarray(obs))
+    np.testing.assert_allclose(
+        np.asarray(conf), np.asarray(gamma[:, :4].sum(axis=1)), atol=2e-5
+    )
+    np.testing.assert_array_equal(
+        np.asarray(path), np.asarray(jnp.argmax(gamma, axis=1))
+    )
+
+
+def test_npy_stream_writer(tmp_path):
+    from cpgisland_tpu.utils.npystream import NpyStreamWriter
+
+    p = tmp_path / "x.npy"
+    with NpyStreamWriter(str(p), np.float32) as w:
+        w.write(np.arange(5, dtype=np.float32))
+        w.write(np.arange(5, 12, dtype=np.float64))  # cast on write
+    got = np.load(p)
+    np.testing.assert_array_equal(got, np.arange(12, dtype=np.float32))
+    assert got.dtype == np.float32
+    # Empty writer still produces a loadable (0,) array.
+    q = tmp_path / "e.npy"
+    NpyStreamWriter(str(q), np.int8).close()
+    assert np.load(q).shape == (0,)
+    # mmap load works (header is spec-conformant).
+    m = np.load(p, mmap_mode="r")
+    assert m[7] == 7.0

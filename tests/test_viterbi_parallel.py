@@ -87,13 +87,71 @@ def test_block_size_invariance(rng):
         assert got == pytest.approx(float(s_ref), abs=2e-2)
 
 
+def test_f32_range_normalization_survives_huge_magnitudes(rng):
+    """Regression for the genome-scale f32 range bug: score chains grow
+    ~-1.3/symbol, so an UNnormalized prefix-product chain reaches magnitudes
+    where the f32 ulp dwarfs the O(1) per-state differences argmax decisions
+    ride on.  This model makes every step cost ~-5e3, so 20k steps reach
+    -1e8 (ulp 8) — without scan_block_products' per-combine normalization
+    the cross-block entering vectors quantize and the path is garbage; with
+    it the decode must match the float64 DP exactly (structure is tie-free)."""
+    K, M, T, block = 3, 4, 20_000, 128
+    pref = rng.normal(size=(K, M)) * 2.0
+    params = HmmParams(
+        log_pi=jnp.asarray(rng.normal(size=K), jnp.float32),
+        log_A=jnp.asarray(np.log(rng.dirichlet(np.ones(K), size=K)), jnp.float32),
+        log_B=jnp.asarray(pref - 5000.0, jnp.float32),
+    )
+    obs = rng.integers(0, M, size=T).astype(np.int32)
+    p_par = np.asarray(
+        VP.viterbi_parallel(params, jnp.asarray(obs), block_size=block,
+                            return_score=False)
+    )
+    # float64 DP oracle with backpointers.
+    lp = np.asarray(params.log_pi, np.float64)
+    lA = np.asarray(params.log_A, np.float64)
+    lB = np.asarray(params.log_B, np.float64)
+    delta = lp + lB[:, obs[0]]
+    bps = np.zeros((T, K), np.int64)
+    for t in range(1, T):
+        scores = delta[:, None] + lA
+        bps[t] = scores.argmax(axis=0)
+        delta = scores.max(axis=0) + lB[:, obs[t]]
+    path = np.zeros(T, np.int64)
+    path[-1] = delta.argmax()
+    for t in range(T - 1, 0, -1):
+        path[t - 1] = bps[t, path[t]]
+    np.testing.assert_array_equal(p_par, path)
+
+
+def _path_score_f64(params, obs, path):
+    """Exact (float64) log-score of a decoded path — the ground-truth judge
+    when the two f32 engines disagree on near-ties."""
+    lp = np.asarray(params.log_pi, np.float64)
+    lA = np.asarray(params.log_A, np.float64)
+    lB = np.asarray(params.log_B, np.float64)
+    return lp[path[0]] + lB[path, obs].sum() + lA[path[:-1], path[1:]].sum()
+
+
 def test_long_sequence_smoke(rng):
     params = presets.durbin_cpg8()
     obs = jnp.asarray(rng.integers(0, 4, size=1 << 16))
     p_par, s_par = VP.viterbi_parallel(params, obs)
     p_seq, s_seq = V.viterbi(params, obs)
-    # f32 reduction order differs between the two algorithms; exact path
-    # equality below is the strong check.
+    # f32 reduction order differs between the two algorithms; the f64
+    # re-score below is the strong check.
     assert float(s_par) == pytest.approx(float(s_seq), rel=1e-4)
-    # On genuinely random input ties are astronomically unlikely with this model.
-    assert (np.asarray(p_par) == np.asarray(p_seq)).mean() > 0.999
+    p_par, p_seq, obs_np = np.asarray(p_par), np.asarray(p_seq), np.asarray(obs)
+    # Both f32 engines resolve near-ties differently (~0.1% of positions at
+    # this length); the strong check is that each path's f64 score sits at
+    # the f64-DP optimum to within the engines' accumulated f32 error.
+    assert (p_par == p_seq).mean() > 0.99
+    lp = np.asarray(params.log_pi, np.float64)
+    lA = np.asarray(params.log_A, np.float64)
+    lB = np.asarray(params.log_B, np.float64)
+    delta = lp + lB[:, obs_np[0]]
+    for t in range(1, obs_np.size):
+        delta = (delta[:, None] + lA).max(axis=0) + lB[:, obs_np[t]]
+    s_opt = delta.max()
+    assert _path_score_f64(params, obs_np, p_par) == pytest.approx(s_opt, abs=0.05)
+    assert _path_score_f64(params, obs_np, p_seq) == pytest.approx(s_opt, abs=0.05)
