@@ -75,31 +75,25 @@ def _compact(keep, cols, cap):
     )
 
 
-@functools.partial(
-    jax.jit, static_argnames=("cap", "min_len", "gc_threshold", "oe_threshold")
-)
-def _device_calls(
-    path,
+def _calls_from_masks(
+    in_mask,
+    is_c,
+    is_g,
+    cg_event,
     cap: int,
     min_len: Optional[int],
     gc_threshold: float,
     oe_threshold: float,
 ):
-    """Jitted core: [T] path -> fixed-size call columns + true count."""
-    T = path.shape[0]
+    """Shared device-side run accounting: membership/event masks -> call
+    columns.  The ONE copy of the cummax-ffill aggregation and thresholds —
+    the 8-state path caller and the observation-based caller both feed it."""
+    T = in_mask.shape[0]
     idx = jnp.arange(T, dtype=jnp.int32)
-    path = path.astype(jnp.int32)
-
-    in_mask = path < N_ISLAND_STATES
     prev_in = jnp.concatenate([jnp.zeros(1, bool), in_mask[:-1]])
     opening = in_mask & ~prev_in
     next_in = jnp.concatenate([in_mask[1:], jnp.zeros(1, bool)])
     closing = in_mask & ~next_in  # clean mode: a run at the end still closes
-
-    is_c = in_mask & (path == C_STATE)
-    is_g = in_mask & (path == G_STATE)
-    prev_c = jnp.concatenate([jnp.zeros(1, bool), is_c[:-1]])
-    cg_event = in_mask & prev_in & is_g & prev_c
 
     cum_c = jnp.cumsum(is_c.astype(jnp.int32))
     cum_g = jnp.cumsum(is_g.astype(jnp.int32))
@@ -153,6 +147,70 @@ def _device_calls(
     return starts_o, lasts_o, len_o, gc_o, oe_o, n
 
 
+@functools.partial(
+    jax.jit, static_argnames=("cap", "min_len", "gc_threshold", "oe_threshold")
+)
+def _device_calls(
+    path,
+    cap: int,
+    min_len: Optional[int],
+    gc_threshold: float,
+    oe_threshold: float,
+):
+    """Jitted 8-state core: [T] path -> fixed-size call columns + count.
+
+    Base identity comes from the state ids (the reference's X+/X- labeling,
+    CpGIslandFinder.java:182-189): state 1 = C+, state 2 = G+.
+    """
+    path = path.astype(jnp.int32)
+    in_mask = path < N_ISLAND_STATES
+    prev_in = jnp.concatenate([jnp.zeros(1, bool), in_mask[:-1]])
+    is_c = in_mask & (path == C_STATE)
+    is_g = in_mask & (path == G_STATE)
+    prev_c = jnp.concatenate([jnp.zeros(1, bool), is_c[:-1]])
+    cg_event = in_mask & prev_in & is_g & prev_c
+    return _calls_from_masks(
+        in_mask, is_c, is_g, cg_event, cap, min_len, gc_threshold, oe_threshold
+    )
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("island_states", "cap", "min_len", "gc_threshold", "oe_threshold"),
+)
+def _device_calls_obs(
+    path,
+    obs,
+    island_states: tuple,
+    cap: int,
+    min_len: Optional[int],
+    gc_threshold: float,
+    oe_threshold: float,
+):
+    """Jitted generic core: membership from ``path`` in ``island_states``
+    (static tuple — unrolled compares, no gather), base composition from the
+    OBSERVATIONS (symbol ids 0..3 = acgt) — the device twin of
+    ops.islands.call_islands_obs for models whose states don't encode bases
+    (e.g. presets.two_state_cpg)."""
+    path = path.astype(jnp.int32)
+    obs = obs.astype(jnp.int32)
+    in_mask = jnp.zeros(path.shape, bool)
+    for s in island_states:
+        in_mask = in_mask | (path == s)
+    prev_in = jnp.concatenate([jnp.zeros(1, bool), in_mask[:-1]])
+    obs_c = obs == 1  # codec.C
+    obs_g = obs == 2  # codec.G
+    is_c = in_mask & obs_c
+    is_g = in_mask & obs_g
+    cg_event = (
+        in_mask & prev_in & obs_g
+        & jnp.concatenate([jnp.zeros(1, bool), obs_c[:-1]])
+    )
+    return _calls_from_masks(
+        in_mask, is_c, is_g, cg_event, cap, min_len, gc_threshold, oe_threshold
+    )
+
+
 def call_islands_device(
     path,
     *,
@@ -172,9 +230,46 @@ def call_islands_device(
     path = jnp.asarray(path)
     if path.shape[0] == 0:
         return _empty_calls()
-    starts, lasts, length, gc, oe, n = _device_calls(
+    cols = _device_calls(
         path, cap, min_len, float(gc_threshold), float(oe_threshold)
     )
+    return _fetch_calls(cols, cap, offset)
+
+
+def call_islands_device_obs(
+    path,
+    obs,
+    *,
+    island_states,
+    min_len: Optional[int] = None,
+    cap: int = DEFAULT_CAP,
+    gc_threshold: float = 0.5,
+    oe_threshold: float = 0.6,
+    offset: int = 0,
+) -> IslandCalls:
+    """Device-side island calling for ARBITRARY state sets (clean semantics).
+
+    Membership comes from the decoded ``path`` (in ``island_states``), base
+    composition from the aligned ``obs`` symbols — the on-device counterpart
+    of ops.islands.call_islands_obs, so clean decoding with e.g. the
+    two_state preset keeps the path on device and ships only the compact
+    call records to the host (same economics as the 8-state device caller).
+    """
+    path = jnp.asarray(path)
+    obs = jnp.asarray(obs)
+    if path.shape[0] != obs.shape[0]:
+        raise ValueError(f"path {path.shape} and obs {obs.shape} differ")
+    if path.shape[0] == 0:
+        return _empty_calls()
+    cols = _device_calls_obs(
+        path, obs, tuple(sorted(island_states)), cap, min_len,
+        float(gc_threshold), float(oe_threshold),
+    )
+    return _fetch_calls(cols, cap, offset)
+
+
+def _fetch_calls(cols, cap: int, offset: int) -> IslandCalls:
+    starts, lasts, length, gc, oe, n = cols
     n = int(n)
     if n > cap:
         raise ValueError(

@@ -77,24 +77,24 @@ def train_file(
                 "backend 'seq2d' trains per FASTA record; compat mode has no "
                 "records — use compat=False (--clean)"
             )
-        # Two streaming passes over the file so host peak is the padded
-        # matrix + ONE record (a single pass would hold every chromosome AND
-        # the matrix at allocation time — double the footprint at GRCh38
-        # scale; re-encoding the file once is much cheaper than that).
-        lengths = np.array(
-            [s.size for _, s in codec.iter_fasta_records(training_path)], np.int32
-        )
-        if lengths.size == 0:
+        # Stream records into power-of-two length buckets: host peak is
+        # bounded by the bucket budget (~2x the raw input overall), not the
+        # O(records x max_len) dense matrix a global pad would cost (~113 GB
+        # for a GRCh38 assembly).  Each bucket group later gets its own
+        # dp x sp mesh split (Seq2DBackend.prepare).
+        try:
+            chunked = chunking.bucket_records(
+                (s for _, s in codec.iter_fasta_records(training_path)),
+                pad_value=params.n_symbols,
+            )
+        except ValueError:
             raise ValueError(f"no sequence records in {training_path}")
-        rows = np.full(
-            (lengths.size, max(1, int(lengths.max()))), params.n_symbols, np.uint8
+        log.info(
+            "training input: %d records in %d size groups, %d symbols",
+            chunked.num_chunks, chunked.num_groups, chunked.total,
         )
-        for i, (_, s) in enumerate(codec.iter_fasta_records(training_path)):
-            rows[i, : s.size] = s
-        log.info("training input: %d records, %d symbols", len(lengths), int(lengths.sum()))
-        chunked = chunking.Chunked(chunks=rows, lengths=lengths, total=int(lengths.sum()))
         # The string flows through to fit() -> get_backend('seq2d'), which
-        # validates mode/engine and builds the auto 2-D mesh at prepare().
+        # validates mode/engine and builds the auto 2-D meshes at prepare().
     else:
         symbols = codec.encode_file(training_path, skip_headers=not compat)
         log.info("training input: %d symbols", symbols.size)
@@ -197,8 +197,10 @@ def decode_file(
     keeps the decoded path on device and reduces it there
     (ops.islands_device) so only the compact call records cross to the host —
     at genome scale the 4 B/symbol path transfer otherwise rivals the decode
-    itself.  "host" is the NumPy caller; "auto" picks device on TPU when the
-    8-state caller applies and no state-path dump is requested.
+    itself.  Both the 8-state labeling and observation-based
+    ``island_states`` sets run on device (the latter via
+    call_islands_device_obs).  "host" is the NumPy caller; "auto" picks
+    device on TPU (single-process) when no state-path dump is requested.
     """
     if island_states is not None and compat:
         raise ValueError("island_states needs clean mode (compat=False); the "
@@ -208,14 +210,12 @@ def decode_file(
         raise ValueError(err)
     if island_engine not in ("auto", "host", "device"):
         raise ValueError(f"island_engine must be auto|host|device, got {island_engine!r}")
-    device_eligible = (
-        not compat and island_states is None and state_path_out is None
-    )
+    device_eligible = not compat and state_path_out is None
     if island_engine == "device" and not device_eligible:
         raise ValueError(
-            "island_engine='device' implements clean-mode 8-state calling "
-            "without a state-path dump (compat quirks and the "
-            "observation-based caller are host-only)"
+            "island_engine='device' implements clean-mode calling without a "
+            "state-path dump (compat quirk reproduction and path dumps are "
+            "host-side)"
         )
     if island_engine == "device" and jax.process_count() > 1:
         # viterbi_sharded(return_device=True) on a multi-host global mesh
@@ -335,7 +335,14 @@ def decode_file(
             else:
                 full = np.concatenate(pieces)
         with timer.phase("islands", items=float(symbols.size), unit="sym"):
-            if use_device_islands:
+            if use_device_islands and island_states is not None:
+                from cpgisland_tpu.ops.islands_device import call_islands_device_obs
+
+                calls = call_islands_device_obs(
+                    full, jnp.asarray(symbols), island_states=island_states,
+                    min_len=min_len, cap=island_cap,
+                )
+            elif use_device_islands:
                 from cpgisland_tpu.ops.islands_device import call_islands_device
 
                 calls = call_islands_device(full, min_len=min_len, cap=island_cap)
@@ -471,14 +478,34 @@ def _decode_small_batch(
     paths_out: list[np.ndarray] = []
     with timer.phase("islands", items=total, unit="sym"):
         if use_device_islands:
-            from cpgisland_tpu.ops.islands_device import call_islands_device
+            from cpgisland_tpu.ops.islands_device import (
+                call_islands_device,
+                call_islands_device_obs,
+            )
 
             stride = Tpad + 1
             mask = jnp.arange(Tpad)[None, :] < jnp.asarray(lengths)[:, None]
-            masked = jnp.where(mask, paths, N_ISLAND_STATES)
-            sep = jnp.full((Bp, 1), N_ISLAND_STATES, masked.dtype)
+            # Masked tails/separators become a non-island state so runs can
+            # never cross records: the background sentinel is
+            # N_ISLAND_STATES for the 8-state labeling, n_states (an id no
+            # model state uses) for arbitrary island_states sets.
+            fill = (
+                N_ISLAND_STATES if island_states is None else params.n_states
+            )
+            masked = jnp.where(mask, paths, fill)
+            sep = jnp.full((Bp, 1), fill, masked.dtype)
             flat = jnp.concatenate([masked, sep], axis=1).reshape(-1)
-            all_calls = call_islands_device(flat, min_len=min_len, cap=island_cap)
+            if island_states is not None:
+                obs_dev = jnp.asarray(rows)
+                obs_flat = jnp.concatenate(
+                    [obs_dev, jnp.zeros((Bp, 1), obs_dev.dtype)], axis=1
+                ).reshape(-1)
+                all_calls = call_islands_device_obs(
+                    flat, obs_flat, island_states=island_states,
+                    min_len=min_len, cap=island_cap,
+                )
+            else:
+                all_calls = call_islands_device(flat, min_len=min_len, cap=island_cap)
             rec_of = (all_calls.beg - 1) // stride
             for i, (name, _) in enumerate(batch):
                 sel = rec_of == i

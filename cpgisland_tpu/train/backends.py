@@ -348,8 +348,36 @@ class Seq2DBackend(EStepBackend):
     def seq_axis(self) -> str:
         return self.mesh.axis_names[1]
 
-    def prepare(self, chunked: chunking.Chunked) -> chunking.Chunked:
-        """Pad rows (sequences) to dp multiples and columns to sp*block."""
+    def prepare(self, chunked):
+        """Pad rows (sequences) to dp multiples and columns to sp*block.
+
+        A :class:`~cpgisland_tpu.utils.chunking.Bucketed` input (the
+        host-memory-bounded layout pipeline.train_file builds) keeps its
+        groups separate, and EACH group gets its own dp x sp mesh split
+        sized to its row count — many-row scaffold groups run data-parallel,
+        single-row chromosome groups run fully sequence-parallel.
+        """
+        if isinstance(chunked, chunking.Bucketed):
+            from cpgisland_tpu.parallel.mesh import auto_mesh2d
+
+            self._group_meshes = []
+            groups_c = []
+            groups_l = []
+            for rows, lens in zip(chunked.chunks, chunked.lengths):
+                mesh_g = auto_mesh2d(rows.shape[0]) if self.mesh is None else self.mesh
+                self._group_meshes.append(mesh_g)
+                obs, lengths = fb_sharded.pad_batch2d(
+                    rows, lens,
+                    mesh_g.shape[mesh_g.axis_names[0]],
+                    mesh_g.shape[mesh_g.axis_names[1]],
+                    self.block_size, self.pad_value,
+                )
+                groups_c.append(obs)
+                groups_l.append(lengths)
+            return chunking.Bucketed(
+                chunks=tuple(groups_c), lengths=tuple(groups_l),
+                total=chunked.total,
+            )
         if self.mesh is None:
             from cpgisland_tpu.parallel.mesh import auto_mesh2d
 
@@ -366,18 +394,28 @@ class Seq2DBackend(EStepBackend):
             return chunked
         return chunking.Chunked(chunks=obs, lengths=lengths, total=chunked.total)
 
+    def _meshes_for(self, chunks: tuple) -> list:
+        meshes = getattr(self, "_group_meshes", None)
+        if meshes is None or len(meshes) != len(chunks):
+            raise ValueError(
+                "bucketed input: run prepare() + place() on THIS backend "
+                "instance first (per-group meshes are assigned at prepare)"
+            )
+        return meshes
+
     def place(self, chunks, lengths):
+        if isinstance(chunks, tuple):
+            placed = [
+                fb_sharded.place_batch2d(mesh_g, c, l)
+                for mesh_g, c, l in zip(self._meshes_for(chunks), chunks, lengths)
+            ]
+            return tuple(p[0] for p in placed), tuple(p[1] for p in placed)
         return fb_sharded.place_batch2d(self.mesh, chunks, lengths)
 
-    def __call__(self, params, chunks, lengths):
-        if self.mesh is None or getattr(chunks, "ndim", 0) != 2 or getattr(lengths, "ndim", 0) != 2:
-            raise ValueError(
-                "Seq2DBackend expects placed [N, T] sequences and [N, sp] shard "
-                "lengths; run prepare() + place() first"
-            )
+    def _group_stats(self, params, mesh, chunks, lengths):
         # Same routing policy as SeqBackend (_use_fused_seq): auto gates on
         # big-enough TPU shards; an explicit engine always wins.
-        sp = self.mesh.shape[self.seq_axis]
+        sp = mesh.shape[mesh.axis_names[1]]
         engine = (
             "pallas"
             if _use_fused_seq(self.engine, params, chunks.shape[1] // sp)
@@ -388,9 +426,23 @@ class Seq2DBackend(EStepBackend):
         # compiled program.
         lane_T, t_tile = (self.lane_T, self.t_tile) if engine == "pallas" else (None, None)
         fn = fb_sharded.sharded_stats2d_fn(
-            self.mesh, self.block_size, engine, lane_T, t_tile
+            mesh, self.block_size, engine, lane_T, t_tile
         )
         return fn(params, chunks, lengths)
+
+    def __call__(self, params, chunks, lengths):
+        if isinstance(chunks, tuple):
+            total = None
+            for mesh_g, c, l in zip(self._meshes_for(chunks), chunks, lengths):
+                st = self._group_stats(params, mesh_g, c, l)
+                total = st if total is None else total + st
+            return total
+        if self.mesh is None or getattr(chunks, "ndim", 0) != 2 or getattr(lengths, "ndim", 0) != 2:
+            raise ValueError(
+                "Seq2DBackend expects placed [N, T] sequences and [N, sp] shard "
+                "lengths; run prepare() + place() first"
+            )
+        return self._group_stats(params, self.mesh, chunks, lengths)
 
 
 def get_backend(

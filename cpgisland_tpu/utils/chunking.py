@@ -65,6 +65,93 @@ def frame(symbols: np.ndarray, chunk_size: int, *, drop_remainder: bool = False)
     return Chunked(chunks=chunks, lengths=lengths, total=n)
 
 
+@dataclass(frozen=True)
+class Bucketed:
+    """A length-bucketed batch of whole sequences (the seq2d training input).
+
+    Padding every record to the GLOBAL maximum length — the reference-shaped
+    dense [n_records, max_len] matrix — costs O(records x max_len) host RAM
+    (~113 GB for a GRCh38 assembly: ~455 records, max 249 Mbp).  Bucketing
+    pads each record only to its power-of-two size class and bounds each
+    group's total symbols, so host peak is ~2x the raw input and each group
+    can pick its own dp x sp mesh split (many-rows scaffold groups go
+    data-parallel, single-row chromosome groups go sequence-parallel).
+
+    chunks:  tuple of [N_g, T_g] uint8 group matrices (PAD in tails)
+    lengths: tuple of [N_g] int32 true lengths
+    total:   total real symbols across all groups
+    """
+
+    chunks: tuple
+    lengths: tuple
+    total: int
+
+    @property
+    def num_chunks(self) -> int:
+        return int(sum(c.shape[0] for c in self.chunks))
+
+    @property
+    def num_groups(self) -> int:
+        return len(self.chunks)
+
+
+def bucket_records(
+    records,
+    *,
+    floor: int = 1 << 16,
+    budget: int = 1 << 28,
+    pad_value: int = PAD_SYMBOL,
+) -> Bucketed:
+    """Stream whole records into power-of-two length buckets.
+
+    ``records`` is an iterable of 1-D symbol arrays (e.g. one per FASTA
+    record — pipeline.train_file streams them so the raw records are never
+    all resident).  Each record pads to the next power of two >= ``floor``;
+    groups within a size class close when they reach ``budget`` total
+    symbols, so no single allocation exceeds max(budget, one record's padded
+    size).  Group order follows first-record arrival order; rows within a
+    group follow file order.
+    """
+    open_groups: dict[int, list] = {}  # T -> list of pending raw records
+    sealed: list[tuple[np.ndarray, np.ndarray]] = []
+    total = 0
+
+    def seal(T: int) -> None:
+        recs = open_groups.pop(T)
+        if not recs:
+            return
+        mat = np.full((len(recs), T), pad_value, np.uint8)
+        lens = np.empty(len(recs), np.int32)
+        for i, r in enumerate(recs):
+            mat[i, : r.shape[0]] = r
+            lens[i] = r.shape[0]
+        sealed.append((mat, lens))
+
+    for rec in records:
+        rec = np.ascontiguousarray(rec, dtype=np.uint8)
+        n = rec.shape[0]
+        total += n
+        T = floor
+        while T < n:
+            T <<= 1
+        # Buffer RAW records and assemble the padded matrix only at seal:
+        # peak host RAM stays proportional to content (one group's records
+        # plus its padded matrix), never an eager budget-sized allocation
+        # per open size class.
+        open_groups.setdefault(T, []).append(rec)
+        if len(open_groups[T]) >= max(1, budget // T):
+            seal(T)
+    for T in list(open_groups):
+        seal(T)
+    if not sealed:
+        raise ValueError("no records to bucket")
+    return Bucketed(
+        chunks=tuple(c for c, _ in sealed),
+        lengths=tuple(l for _, l in sealed),
+        total=total,
+    )
+
+
 def process_shard(
     chunked: Chunked,
     process_index: int,

@@ -46,3 +46,48 @@ def test_pad_to_multiple():
 def test_reference_constants():
     assert chunking.TRAIN_CHUNK == 0x10000
     assert chunking.DECODE_CHUNK == 0x100000
+
+
+def test_bucket_records_shapes_and_budget():
+    """bucket_records: pow2 size classes, per-group allocation bounded by
+    max(budget, one padded record) — NOT records x max_len (VERDICT r2 #2)."""
+    from cpgisland_tpu.utils.chunking import bucket_records
+
+    rng = np.random.default_rng(0)
+    sizes = [100, 900, 1000, 70_000, 200, 300_000, 50]
+    records = [rng.integers(0, 4, size=n).astype(np.uint8) for n in sizes]
+    budget = 4096
+    b = bucket_records(iter(records), floor=1024, budget=budget, pad_value=4)
+    assert b.total == sum(sizes)
+    assert b.num_chunks == len(sizes)
+    # No allocation is records x max_len; each group obeys the budget (or is
+    # a single over-budget record padded to its own pow2).
+    for c in b.chunks:
+        assert c.shape[0] * c.shape[1] <= max(budget, c.shape[1])
+        assert (c.shape[1] & (c.shape[1] - 1)) == 0 and c.shape[1] >= 1024
+    # Every record is recoverable from its bucket row (order within a size
+    # class follows arrival order).
+    seen = []
+    for c, l in zip(b.chunks, b.lengths):
+        for i, n in enumerate(l):
+            seen.append((c.shape[1], np.asarray(c[i, :n])))
+    by_class: dict = {}
+    for n, r in zip(sizes, records):
+        T = 1024
+        while T < n:
+            T <<= 1
+        by_class.setdefault(T, []).append(r)
+    got_by_class: dict = {}
+    for T, row in seen:
+        got_by_class.setdefault(T, []).append(row)
+    for T, rows in by_class.items():
+        assert len(got_by_class[T]) == len(rows)
+        for a, g in zip(rows, got_by_class[T]):
+            np.testing.assert_array_equal(a, g)
+
+
+def test_bucket_records_empty_raises():
+    from cpgisland_tpu.utils.chunking import bucket_records
+
+    with pytest.raises(ValueError):
+        bucket_records(iter([]))

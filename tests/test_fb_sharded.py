@@ -288,3 +288,38 @@ def test_seq_backend_explicit_engines(rng):
         SeqBackend(engine="pallas", mesh=mesh, block_size=64)(
             big, jnp.asarray(obs[:2048]), jnp.asarray(np.full(8, 256, np.int32))
         )
+
+
+def test_seq2d_bucketed_matches_dense(rng):
+    """Bucketed (host-memory-bounded) seq2d input produces the same
+    statistics / fit trajectory as the dense [n_records, max_len] layout —
+    per-group dp x sp meshes included (VERDICT r2 #2)."""
+    from cpgisland_tpu.train import baum_welch
+    from cpgisland_tpu.train.backends import Seq2DBackend
+    from cpgisland_tpu.utils import chunking
+
+    params = presets.durbin_cpg8()
+    sizes = [900, 700, 2300, 150, 150, 150, 150, 400]
+    records = [rng.integers(0, 4, size=n).astype(np.uint8) for n in sizes]
+
+    rows = np.full((len(sizes), max(sizes)), 4, np.uint8)
+    for i, r in enumerate(records):
+        rows[i, : r.size] = r
+    dense = chunking.Chunked(
+        chunks=rows, lengths=np.asarray(sizes, np.int32), total=sum(sizes)
+    )
+    bucketed = chunking.bucket_records(
+        iter(records), floor=256, budget=1024, pad_value=4
+    )
+    kw = dict(num_iters=2, convergence=0.0)
+    r_dense = baum_welch.fit(
+        params, dense, backend=Seq2DBackend(block_size=64), **kw
+    )
+    r_bucket = baum_welch.fit(
+        params, bucketed, backend=Seq2DBackend(block_size=64), **kw
+    )
+    np.testing.assert_allclose(r_bucket.logliks, r_dense.logliks, rtol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(r_bucket.params.log_A), np.asarray(r_dense.params.log_A),
+        atol=1e-5,
+    )
