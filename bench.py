@@ -347,12 +347,23 @@ def bench_end_to_end(n_mbases: int, engine: str = "auto") -> dict:
     out = os.path.join(tmpdir, "islands.txt")
 
     # Host-side encode rate, measured standalone (clean-mode decode_file
-    # streams records internally without a separate encode phase timer).
+    # streams records internally without a separate encode phase timer) —
+    # plus the symbol-cache repeat-run path (VERDICT r2 #4: the named fix
+    # for the encode bottleneck), measured as a warm second read.
     from cpgisland_tpu.utils import codec
 
     t0 = time.perf_counter()
     enc_syms = sum(s.size for _, s in codec.iter_fasta_records(fa))
     encode_s = time.perf_counter() - t0
+    cache_prefix = fa  # sidecar files in the bench tmpdir
+    codec.write_symbol_cache(fa, cache_prefix)
+    t0 = time.perf_counter()
+    cached_total = 0
+    for _, s in codec.iter_fasta_records_cached(fa, cache_prefix):
+        # Touch the bytes (sum) so the memmap pages actually stream.
+        cached_total += s.size + int(np.asarray(s).sum(dtype=np.int64)) * 0
+    cached_s = time.perf_counter() - t0
+    assert cached_total == enc_syms
 
     # Steady state: first pass pays jit compiles (one per record shape — real
     # workloads reuse the fixed 256 Mi span shape), second pass is measured.
@@ -375,13 +386,16 @@ def bench_end_to_end(n_mbases: int, engine: str = "auto") -> dict:
         "end_to_end_s": round(wall, 3),
         "end_to_end_msym_per_s": round(res.n_symbols / wall / 1e6, 1),
         "encode_msym_per_s": round(enc_syms / max(encode_s, 1e-9) / 1e6, 1),
+        "cached_encode_msym_per_s": round(
+            enc_syms / max(cached_s, 1e-9) / 1e6, 1
+        ),
         "n_islands": len(res.calls),
     }
     for name, ph in timer.phases.items():
         stats[f"{name.replace('+', '_')}_msym_per_s"] = round(
             ph.items / max(ph.seconds, 1e-9) / 1e6, 1
         )
-    for p in (fa, out):
+    for p in (fa, out, *codec.symbol_cache_paths(cache_prefix)):
         os.unlink(p)
     os.rmdir(tmpdir)
     log(f"end-to-end ({n_mbases} Mbase file): " + json.dumps(stats))

@@ -96,7 +96,17 @@ def _common_flags(p: argparse.ArgumentParser) -> None:
         help="capture a jax.profiler device trace into this directory "
         "(TensorBoard format; SURVEY.md §5 tracing)",
     )
+    _add_symbol_cache_flag(p)
     p.add_argument("-v", "--verbose", action="store_true")
+
+
+def _add_symbol_cache_flag(p: argparse.ArgumentParser) -> None:
+    p.add_argument(
+        "--symbol-cache",
+        help="pre-encoded symbol cache prefix (clean mode): built on first "
+        "use, repeat runs over the same FASTA skip the host text parse — "
+        "the measured end-to-end bottleneck (BASELINE.md)",
+    )
 
 
 def _preset_params(presets, name: str):
@@ -161,6 +171,7 @@ def build_parser() -> argparse.ArgumentParser:
         help="initial model preset (two_state needs --island-states 0)",
     )
     po.add_argument("--trace-dir", help="capture a jax.profiler device trace")
+    _add_symbol_cache_flag(po)
     po.add_argument("-v", "--verbose", action="store_true")
 
     r = sub.add_parser("run", help="train then decode (the reference main())")
@@ -252,6 +263,7 @@ def _run_command(args, compat, pipeline, presets, load_text) -> int:
             compat=compat,
             checkpoint_dir=args.checkpoint_dir,
             model_out=args.model_out,
+            symbol_cache=args.symbol_cache,
         )
         print(
             f"trained: iters={res.iterations} converged={res.converged} "
@@ -273,6 +285,7 @@ def _run_command(args, compat, pipeline, presets, load_text) -> int:
             engine=args.engine,
             island_states=island_states,
             island_engine=args.island_engine,
+            symbol_cache=args.symbol_cache,
         )
         print(f"decoded {res.n_symbols} symbols in {res.n_chunks} chunks; {len(res.calls)} islands")
         return 0
@@ -291,6 +304,7 @@ def _run_command(args, compat, pipeline, presets, load_text) -> int:
             mpm_path_out=args.mpm_path_out,
             island_states=island_states,
             engine=args.engine,
+            symbol_cache=args.symbol_cache,
         )
         print(
             f"posterior: {res.n_symbols} symbols in {res.n_records} records; "
@@ -319,6 +333,7 @@ def _run_command(args, compat, pipeline, presets, load_text) -> int:
             compat=compat,
             engine=args.engine,
             island_states=island_states,
+            symbol_cache=args.symbol_cache,
         )
         print(f"{len(res.calls)} islands -> {args.islands_out}")
         return 0

@@ -59,6 +59,7 @@ def train_file(
     chunk_size: int = chunking.TRAIN_CHUNK,
     checkpoint_dir: Optional[str] = None,
     model_out: Optional[str] = None,
+    symbol_cache: Optional[str] = None,
     metrics: Optional[profiling.MetricsLogger] = None,
 ) -> baum_welch.FitResult:
     """Train the CpG HMM on a sequence file (reference ``trainModel``).
@@ -68,9 +69,15 @@ def train_file(
     distributed over an automatic 2-D data x seq mesh; it requires
     ``compat=False`` since compat mode has no notion of records.  All other
     backends see the reference's chunk framing.
+
+    ``symbol_cache``: pre-encoded symbol cache prefix (utils.codec) — repeat
+    runs over the same FASTA skip the host text parse entirely (clean mode
+    only; the measured end-to-end bottleneck, BASELINE.md).
     """
     if params is None:
         params = presets.durbin_cpg8()
+    if symbol_cache is not None and compat:
+        raise ValueError("symbol_cache is FASTA-aware — use compat=False (--clean)")
     if backend == "seq2d":
         if compat:
             raise ValueError(
@@ -84,7 +91,12 @@ def train_file(
         # dp x sp mesh split (Seq2DBackend.prepare).
         try:
             chunked = chunking.bucket_records(
-                (s for _, s in codec.iter_fasta_records(training_path)),
+                (
+                    s
+                    for _, s in codec.iter_fasta_records_cached(
+                        training_path, symbol_cache
+                    )
+                ),
                 pad_value=params.n_symbols,
             )
         except ValueError:
@@ -96,7 +108,9 @@ def train_file(
         # The string flows through to fit() -> get_backend('seq2d'), which
         # validates mode/engine and builds the auto 2-D meshes at prepare().
     else:
-        symbols = codec.encode_file(training_path, skip_headers=not compat)
+        symbols = codec.encode_file_cached(
+            training_path, symbol_cache, skip_headers=not compat
+        )
         log.info("training input: %d symbols", symbols.size)
         chunked = chunking.frame(symbols, chunk_size, drop_remainder=compat)
     result = baum_welch.fit(
@@ -172,6 +186,7 @@ def decode_file(
     island_states=None,
     island_engine: str = "auto",
     island_cap: Optional[int] = None,
+    symbol_cache: Optional[str] = None,
     metrics: Optional[profiling.MetricsLogger] = None,
     timer: Optional[profiling.PhaseTimer] = None,
 ) -> DecodeResult:
@@ -205,6 +220,8 @@ def decode_file(
     if island_states is not None and compat:
         raise ValueError("island_states needs clean mode (compat=False); the "
                          "reference caller is 8-state-specific")
+    if symbol_cache is not None and compat:
+        raise ValueError("symbol_cache is FASTA-aware — use compat=False (--clean)")
     err = island_layout_error(params, island_states)
     if err:
         raise ValueError(err)
@@ -385,7 +402,9 @@ def decode_file(
     # record fails mid-file.
     try:
         pending: list = []
-        for rec_name, symbols in codec.iter_fasta_records(test_path):
+        for rec_name, symbols in codec.iter_fasta_records_cached(
+            test_path, symbol_cache
+        ):
             n_records += 1
             n_sym += symbols.size
             if symbols.size <= SMALL_RECORD_MAX:
@@ -560,6 +579,7 @@ def posterior_file(
     island_states=None,
     span: int = POSTERIOR_SPAN,
     engine: str = "auto",
+    symbol_cache: Optional[str] = None,
     metrics: Optional[profiling.MetricsLogger] = None,
     timer: Optional[profiling.PhaseTimer] = None,
 ) -> PosteriorResult:
@@ -619,7 +639,9 @@ def posterior_file(
         conf_w = NpyStreamWriter(confidence_out, np.float32)
         if want_path:
             path_w = NpyStreamWriter(mpm_path_out, np.int8)
-        for rec_name, symbols in codec.iter_fasta_records(test_path):
+        for rec_name, symbols in codec.iter_fasta_records_cached(
+            test_path, symbol_cache
+        ):
             n_records += 1
             n_sym += symbols.size
             if symbols.size == 0:
@@ -725,6 +747,7 @@ def run(
     min_len: Optional[int] = None,
     engine: str = "auto",
     island_states=None,
+    symbol_cache: Optional[str] = None,
 ) -> DecodeResult:
     """The reference's full main(): train, dump model, decode, write islands
     (CpGIslandFinder.java:346-357)."""
@@ -738,6 +761,7 @@ def run(
         mode=mode,
         compat=compat,
         checkpoint_dir=checkpoint_dir,
+        symbol_cache=symbol_cache,
     )
     return decode_file(
         test_path,
@@ -747,4 +771,5 @@ def run(
         min_len=min_len,
         engine=engine,
         island_states=island_states,
+        symbol_cache=symbol_cache,
     )

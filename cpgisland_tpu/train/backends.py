@@ -136,7 +136,21 @@ class SpmdBackend(EStepBackend):
             )
         return self._estep_cache[engine]
 
-    def prepare(self, chunked: chunking.Chunked) -> chunking.Chunked:
+    def prepare(self, chunked):
+        if isinstance(chunked, chunking.LocalShard):
+            # Per-process pre-sharded input (chunking.distributed_chunked —
+            # no host ever held the global batch).  Row padding already
+            # matches this mesh when pad_multiple was the axis size.
+            n_dev = self.mesh.shape[self.axis]
+            if chunked.global_rows % n_dev:
+                raise ValueError(
+                    f"LocalShard global_rows {chunked.global_rows} not "
+                    f"divisible by mesh axis size {n_dev}; build it with "
+                    f"pad_multiple={n_dev}"
+                )
+            self._local_rows = (chunked.num_chunks, chunked.global_rows)
+            return chunked
+        self._local_rows = None
         return chunking.pad_to_multiple(chunked, self.mesh.shape[self.axis])
 
     def place(self, chunks, lengths):
@@ -148,7 +162,29 @@ class SpmdBackend(EStepBackend):
         contiguous block (utils.chunking.process_shard — the HDFS-input-split
         equivalent, CpGIslandFinder.java:108-147) and assembles the global
         array from the local shard, so no host uploads rows it doesn't own.
+        A prepared LocalShard (each host built ONLY its block from its byte
+        range of the file) goes straight to the global-array assembly.
         """
+        local = getattr(self, "_local_rows", None)
+        if local is not None:
+            n_local, global_rows = local
+            chunks = np.asarray(chunks)
+            lengths = np.asarray(lengths)
+            if chunks.shape[0] != n_local:
+                raise ValueError(
+                    f"placed rows {chunks.shape[0]} != prepared LocalShard "
+                    f"rows {n_local}; prepare() and place() must see the "
+                    "same shard"
+                )
+            sharding = NamedSharding(self.mesh, P(self.axis))
+            return (
+                jax.make_array_from_process_local_data(
+                    sharding, chunks, (global_rows, chunks.shape[1])
+                ),
+                jax.make_array_from_process_local_data(
+                    sharding, lengths, (global_rows,)
+                ),
+            )
         self._check_divisible(chunks)
         sharding = NamedSharding(self.mesh, P(self.axis))
         if jax.process_count() > 1:

@@ -67,23 +67,36 @@ def iter_encoded_blocks(
     *,
     skip_headers: bool = False,
     read_size: int = 1 << 24,
+    start: int = 0,
+    end: Optional[int] = None,
 ) -> Iterator[np.ndarray]:
-    """Stream-encode a file in bounded-memory blocks of symbols.
+    """Stream-encode a file (or a byte range of it) in bounded-memory blocks.
 
     ``skip_headers=False`` reproduces the reference exactly (headers encoded as
     bases, CpGIslandFinder.java:112-128); ``True`` is the fixed FASTA-aware mode.
     Header lines may span read boundaries, so a small carry tracks whether we are
     inside a header and whether the next byte starts a line.  Uses the native
     fused kernel when available (identical semantics, parity-tested).
+
+    ``start``/``end`` bound the byte range (the multi-host sharded-encode
+    path, :func:`encode_byte_range`); ``start`` MUST be a line start so the
+    header state machine begins clean.
     """
     fasta_enc = native.FastaEncoder() if skip_headers else None
     use_native = fasta_enc.available if skip_headers else native.available()
     in_header, at_line_start = False, True
     with open(path, "rb", buffering=0) as f:
-        while True:
-            data = f.read(read_size)
+        if start:
+            f.seek(start)
+        remaining = None if end is None else end - start
+        while remaining is None or remaining > 0:
+            data = f.read(
+                read_size if remaining is None else min(read_size, remaining)
+            )
             if not data:
                 return
+            if remaining is not None:
+                remaining -= len(data)
             if use_native:
                 syms = fasta_enc.feed(data) if skip_headers else native.encode(data)
             else:
@@ -245,6 +258,194 @@ def _concat(bufs: list) -> np.ndarray:
     if not bufs:
         return np.zeros(0, dtype=np.uint8)
     return np.concatenate(bufs)
+
+
+def _line_boundary(f, pos: int, size: int) -> int:
+    """The canonical cut point at-or-after ``pos``: just past the first
+    newline at offset >= pos-1 (so a cut already at a line start stays put).
+
+    Both sides of a shared cut evaluate this identically, so byte ranges
+    tile the file exactly.  Returns ``size`` when no newline remains.
+    """
+    if pos <= 0:
+        return 0
+    if pos >= size:
+        return size
+    f.seek(pos - 1)
+    scan_from = pos - 1
+    while True:
+        block = f.read(1 << 20)
+        if not block:
+            return size
+        nl = block.find(b"\n")
+        if nl != -1:
+            return scan_from + nl + 1
+        scan_from += len(block)
+
+
+def encode_byte_range(
+    path: str,
+    part: int,
+    n_parts: int,
+    *,
+    skip_headers: bool = True,
+    read_size: int = 1 << 24,
+) -> np.ndarray:
+    """Encode only this part's line-aligned byte range of the file.
+
+    The multi-host input-sharding primitive (SURVEY.md §5 DCN role): process
+    p encodes ~1/P of the file instead of all of it — the reference gets the
+    same effect from HDFS input splits (CpGIslandFinder.java:108-147).
+    Ranges cut at line starts, so the FASTA header state machine starts
+    clean in every part and the concatenation over parts equals the
+    whole-file encode exactly (tested).
+    """
+    if not 0 <= part < n_parts:
+        raise ValueError(f"part {part} not in [0, {n_parts})")
+    size = os.path.getsize(path)
+    with open(path, "rb", buffering=0) as f:
+        lo = _line_boundary(f, part * size // n_parts, size)
+        hi = (
+            size
+            if part == n_parts - 1
+            else _line_boundary(f, (part + 1) * size // n_parts, size)
+        )
+    # One shared streaming-encode loop (iter_encoded_blocks) — the header
+    # carry / native dispatch must not fork between whole-file and ranged use.
+    return _concat(
+        list(
+            iter_encoded_blocks(
+                path, skip_headers=skip_headers, read_size=read_size,
+                start=lo, end=hi,
+            )
+        )
+    )
+
+
+# ---------------------------------------------------------------------------
+# Pre-encoded symbol cache
+#
+# BASELINE.md measures host encode as the end-to-end bottleneck next to
+# multi-chip decode (host_encode_vs_8chip_decode < 0.1): re-runs of decode /
+# posterior / training over the same FASTA pay the full text parse every
+# time.  The cache stores the encode ONCE — symbols as a streamed .npy
+# (memmap-loadable: repeat runs read pages straight from the OS cache, no
+# parse, no copy), plus record names/offsets and a source fingerprint.
+# Clean-mode (FASTA-aware) semantics only: the compat path exists for
+# byte-fidelity with the reference, not throughput.
+
+_CACHE_VERSION = 1
+
+
+def _source_fingerprint(path: str) -> dict:
+    st = os.stat(path)
+    return {"size": st.st_size, "mtime_ns": st.st_mtime_ns}
+
+
+def symbol_cache_paths(cache: str) -> tuple[str, str]:
+    """(symbols .npy path, metadata .npz path) for a cache prefix."""
+    return cache + ".symbols.npy", cache + ".meta.npz"
+
+
+def write_symbol_cache(path: str, cache: str) -> int:
+    """Encode ``path`` (FASTA-aware) into a symbol cache at prefix ``cache``.
+
+    Returns the total symbol count.  Writing is atomic enough for repeat-run
+    use: the metadata file (which validation requires) is written last.
+    """
+    from cpgisland_tpu.utils.npystream import NpyStreamWriter
+
+    sym_p, meta_p = symbol_cache_paths(cache)
+    # Fingerprint BEFORE the parse: a source replaced mid-encode must leave
+    # a cache that validates as STALE (old fingerprint vs new file), never
+    # one that matches the new file while holding the old file's symbols.
+    fp = _source_fingerprint(path)
+    names: list[str] = []
+    offsets: list[int] = [0]
+    with NpyStreamWriter(sym_p, np.uint8) as w:
+        for name, syms in iter_fasta_records(path):
+            names.append(name)
+            w.write(syms)
+            offsets.append(w.count)
+        total = w.count
+    np.savez(
+        meta_p,
+        version=_CACHE_VERSION,
+        names=np.asarray(names, dtype=object),
+        offsets=np.asarray(offsets, dtype=np.int64),
+        **fp,
+    )
+    return total
+
+
+def open_symbol_cache(path: str, cache: str):
+    """(names, offsets, symbols-memmap) if a VALID cache exists, else None.
+
+    Validity = matching cache version and source size/mtime fingerprint —
+    an edited FASTA silently invalidates its stale cache.
+    """
+    sym_p, meta_p = symbol_cache_paths(cache)
+    if not (os.path.exists(sym_p) and os.path.exists(meta_p)):
+        return None
+    try:
+        meta = np.load(meta_p, allow_pickle=True)
+        fp = _source_fingerprint(path)
+        if (
+            int(meta["version"]) != _CACHE_VERSION
+            or int(meta["size"]) != fp["size"]
+            or int(meta["mtime_ns"]) != fp["mtime_ns"]
+        ):
+            return None
+        symbols = np.load(sym_p, mmap_mode="r")
+        offsets = np.asarray(meta["offsets"], np.int64)
+        if symbols.shape[0] != int(offsets[-1]):
+            return None
+        return list(meta["names"]), offsets, symbols
+    except Exception:
+        return None
+
+
+def encode_file_cached(
+    path: str, cache: Optional[str], *, skip_headers: bool
+) -> np.ndarray:
+    """encode_file with an optional read-through symbol cache.
+
+    Cache semantics are FASTA-aware (headers stripped), so only
+    ``skip_headers=True`` (clean mode) can be served from it; the compat
+    encoding falls through to a direct parse.
+    """
+    if cache is None or not skip_headers:
+        return encode_file(path, skip_headers=skip_headers)
+    hit = open_symbol_cache(path, cache)
+    if hit is None:
+        write_symbol_cache(path, cache)
+        hit = open_symbol_cache(path, cache)
+        if hit is None:  # pragma: no cover — racing writer or unwritable dir
+            return encode_file(path, skip_headers=True)
+    return hit[2]
+
+
+def iter_fasta_records_cached(path: str, cache: Optional[str] = None):
+    """iter_fasta_records with an optional read-through symbol cache.
+
+    ``cache`` is a file prefix (e.g. the FASTA path itself): a valid cache
+    yields memmap slices (no parse, no copy — the repeat-run fast path); a
+    missing/stale one is built first, then served.  ``cache=None`` streams
+    the file directly.
+    """
+    if cache is None:
+        yield from iter_fasta_records(path)
+        return
+    hit = open_symbol_cache(path, cache)
+    if hit is None:
+        write_symbol_cache(path, cache)
+        hit = open_symbol_cache(path, cache)
+        if hit is None:  # pragma: no cover — racing writer or unwritable dir
+            yield from iter_fasta_records(path)
+            return
+    names, offsets, symbols = hit
+    for i, name in enumerate(names):
+        yield name, symbols[offsets[i] : offsets[i + 1]]
 
 
 def decode_symbols(symbols: np.ndarray) -> str:

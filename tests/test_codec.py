@@ -108,3 +108,76 @@ class TestFastaRecords:
         np.testing.assert_array_equal(
             merged, codec.encode_file(str(tmp_path / "g.fa"), skip_headers=True)
         )
+
+
+def _write_fasta(path, rng, specs):
+    with open(path, "w") as f:
+        for name, nlen in specs:
+            f.write(f">{name}\n")
+            s = "".join(rng.choice(list("acgtN"), size=nlen))
+            for i in range(0, len(s), 63):
+                f.write(s[i : i + 63] + "\n")
+
+
+def test_encode_byte_range_tiles_exactly(tmp_path, rng):
+    """Concatenating every part's range encode equals the whole-file encode
+    for any part count (line-aligned cuts; VERDICT r2 #4b)."""
+    fa = tmp_path / "g.fa"
+    _write_fasta(fa, rng, [("chrA", 50_000), ("chrB longer desc", 12_345), ("s", 777)])
+    whole = codec.encode_file(str(fa), skip_headers=True)
+    for P in (1, 2, 3, 7):
+        parts = [codec.encode_byte_range(str(fa), q, P) for q in range(P)]
+        np.testing.assert_array_equal(np.concatenate(parts), whole)
+    # Compat (headers encoded) tiles too.
+    whole_c = codec.encode_file(str(fa), skip_headers=False)
+    parts_c = [
+        codec.encode_byte_range(str(fa), q, 3, skip_headers=False) for q in range(3)
+    ]
+    np.testing.assert_array_equal(np.concatenate(parts_c), whole_c)
+
+
+def test_symbol_cache_roundtrip_and_invalidation(tmp_path, rng):
+    """Cache serves identical records from a memmap; editing the source
+    invalidates it (VERDICT r2 #4a)."""
+    import os
+    import time
+
+    fa = tmp_path / "g.fa"
+    _write_fasta(fa, rng, [("r1", 3000), ("r2", 50)])
+    cache = str(tmp_path / "g.fa")  # prefix beside the source
+    direct = list(codec.iter_fasta_records(str(fa)))
+    cached1 = list(codec.iter_fasta_records_cached(str(fa), cache))
+    assert [n for n, _ in cached1] == [n for n, _ in direct]
+    for (_, a), (_, b) in zip(direct, cached1):
+        np.testing.assert_array_equal(a, b)
+    # Second read is a pure cache hit (memmap-backed).
+    hit = codec.open_symbol_cache(str(fa), cache)
+    assert hit is not None
+    cached2 = list(codec.iter_fasta_records_cached(str(fa), cache))
+    assert isinstance(cached2[0][1], np.memmap)
+    # Editing the source invalidates the cache.
+    time.sleep(0.01)
+    _write_fasta(fa, rng, [("r1", 3001), ("r2", 50)])
+    os.utime(fa)
+    assert codec.open_symbol_cache(str(fa), cache) is None
+    cached3 = list(codec.iter_fasta_records_cached(str(fa), cache))
+    direct3 = list(codec.iter_fasta_records(str(fa)))
+    np.testing.assert_array_equal(cached3[0][1], direct3[0][1])
+
+
+def test_encode_file_cached(tmp_path, rng):
+    fa = tmp_path / "g.fa"
+    _write_fasta(fa, rng, [("r1", 4000)])
+    cache = str(tmp_path / "c")
+    whole = codec.encode_file(str(fa), skip_headers=True)
+    np.testing.assert_array_equal(
+        codec.encode_file_cached(str(fa), cache, skip_headers=True), whole
+    )
+    np.testing.assert_array_equal(
+        codec.encode_file_cached(str(fa), cache, skip_headers=True), whole
+    )
+    # Compat encoding never goes through the FASTA-aware cache.
+    np.testing.assert_array_equal(
+        codec.encode_file_cached(str(fa), cache, skip_headers=False),
+        codec.encode_file(str(fa), skip_headers=False),
+    )

@@ -109,3 +109,119 @@ def test_spmd_place_single_process_unchanged(rng):
         presets.durbin_cpg8(), ck, num_iters=1, convergence=0.0, backend=backend
     )
     assert np.isfinite(res.logliks[0])
+
+
+def test_distributed_chunked_single_process_parity(tmp_path, rng):
+    """distributed_chunked == frame + pad_to_multiple when P == 1."""
+    from cpgisland_tpu.utils import chunking, codec
+
+    fa = tmp_path / "g.fa"
+    with open(fa, "w") as f:
+        f.write(">r\n")
+        s = "".join(rng.choice(list("acgt"), size=30_000))
+        for i in range(0, len(s), 70):
+            f.write(s[i : i + 70] + "\n")
+    whole = codec.encode_file(str(fa), skip_headers=True)
+    ls = chunking.distributed_chunked(
+        str(fa), 4096, pad_multiple=8, process_index=0, process_count=1
+    )
+    ref = chunking.pad_to_multiple(chunking.frame(whole, 4096), 8)
+    np.testing.assert_array_equal(ls.chunks, ref.chunks)
+    np.testing.assert_array_equal(ls.lengths, ref.lengths)
+    assert ls.global_rows == ref.num_chunks
+
+
+def test_distributed_chunked_multi_part_assembly(tmp_path, rng):
+    """Simulated P-process assembly (injected gather): the per-process blocks
+    concatenate to EXACTLY the global framing, for part counts that force
+    boundary spills in both directions."""
+    from cpgisland_tpu.utils import chunking, codec
+
+    fa = tmp_path / "g.fa"
+    with open(fa, "w") as f:
+        for name, nlen in (("a", 20_000), ("b", 7_000), ("c", 15_000)):
+            f.write(f">{name} desc\n")
+            s = "".join(rng.choice(list("acgtN"), size=nlen))
+            for i in range(0, len(s), 61):
+                f.write(s[i : i + 61] + "\n")
+    whole = codec.encode_file(str(fa), skip_headers=True)
+    C = 1024
+    for P in (2, 3, 5):
+        parts = [
+            codec.encode_byte_range(str(fa), q, P) for q in range(P)
+        ]
+        counts = np.asarray([p.size for p in parts], np.int64)
+        N = -(-whole.size // C)
+        gr = -(-N // (2 * P)) * (2 * P)
+        n_local = gr // P
+        width = max(
+            max(h1 - h0, t1 - t0)
+            for q in range(P)
+            for (h0, h1), (t0, t1) in [
+                chunking._spill_ranges(q, counts, n_local, C)
+            ]
+        )
+        spills = (
+            np.stack(
+                [
+                    chunking._spill_buffer(parts[q], q, counts, n_local, C, width)
+                    for q in range(P)
+                ]
+            )
+            if width
+            else np.zeros((P, 2, 0), np.uint8)
+        )
+        blocks = []
+        for p in range(P):
+            calls = iter([counts.reshape(P, 1), spills])
+            blocks.append(
+                chunking.distributed_chunked(
+                    str(fa), C, pad_multiple=2 * P, process_index=p,
+                    process_count=P, gather=lambda x, it=calls: next(it),
+                )
+            )
+        ref = chunking.pad_to_multiple(chunking.frame(whole, C), 2 * P)
+        np.testing.assert_array_equal(
+            np.concatenate([b.chunks for b in blocks]), ref.chunks
+        )
+        np.testing.assert_array_equal(
+            np.concatenate([b.lengths for b in blocks]), ref.lengths
+        )
+        assert all(b.global_rows == ref.num_chunks for b in blocks)
+
+
+def test_spmd_backend_local_shard_single_process(tmp_path, rng):
+    """fit() through SpmdBackend on a LocalShard (P=1 degenerate) matches
+    fit() on the equivalent globally-framed batch."""
+    import jax
+
+    from conftest import require_devices
+    from cpgisland_tpu.models import presets
+    from cpgisland_tpu.parallel.mesh import make_mesh
+    from cpgisland_tpu.train import backends, baum_welch
+    from cpgisland_tpu.utils import chunking, codec
+
+    require_devices(8)
+    fa = tmp_path / "g.fa"
+    with open(fa, "w") as f:
+        f.write(">r\n")
+        s = "".join(rng.choice(list("acgt"), size=16 * 256))
+        for i in range(0, len(s), 70):
+            f.write(s[i : i + 70] + "\n")
+    shard = chunking.distributed_chunked(
+        str(fa), 256, pad_multiple=8, process_index=0, process_count=1
+    )
+    r_shard = baum_welch.fit(
+        presets.durbin_cpg8(), shard, num_iters=2, convergence=0.0,
+        backend=backends.SpmdBackend(mesh=make_mesh(8, axis="data")),
+    )
+    whole = codec.encode_file(str(fa), skip_headers=True)
+    r_ref = baum_welch.fit(
+        presets.durbin_cpg8(), chunking.frame(whole, 256), num_iters=2,
+        convergence=0.0,
+        backend=backends.SpmdBackend(mesh=make_mesh(8, axis="data")),
+    )
+    np.testing.assert_allclose(r_shard.logliks, r_ref.logliks, rtol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(r_shard.params.A), np.asarray(r_ref.params.A), rtol=1e-6
+    )

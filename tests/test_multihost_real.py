@@ -53,29 +53,48 @@ WORKER = textwrap.dedent(
     from cpgisland_tpu.train import backends, baum_welch
     from cpgisland_tpu.utils import chunking
 
-    coordinator, pid = sys.argv[1], int(sys.argv[2])
+    coordinator, pid, fa_path = sys.argv[1], int(sys.argv[2]), sys.argv[3]
     n_global = initialize_multihost(
         coordinator_address=coordinator, num_processes=2, process_id=pid
     )
     assert n_global == 8, n_global
     assert jax.process_count() == 2
 
-    # Every process constructs the same GLOBAL logical batch (same seed);
-    # place() keeps only this process's shard on its devices.
-    rng = np.random.default_rng(42)
-    syms = rng.integers(0, 4, size=16 * 256).astype(np.uint8)
-    chunked = chunking.frame(syms, 256)
+    # Byte-range-sharded input: THIS process encodes only its ~half of the
+    # file and assembles only its own chunk rows (a tiny count + boundary
+    # spill exchange over the distributed client) — no process ever holds
+    # the global batch (the file layer of the HDFS-input-split contract).
+    shard = chunking.distributed_chunked(fa_path, 256, pad_multiple=8)
+    assert shard.num_chunks * 2 == shard.global_rows
     backend = backends.SpmdBackend(mesh=make_mesh(8, axis="data"))
     res = baum_welch.fit(
-        presets.durbin_cpg8(), chunked, num_iters=2, convergence=0.0,
+        presets.durbin_cpg8(), shard, num_iters=2, convergence=0.0,
         backend=backend,
     )
+
+    # The ORIGINAL global-batch path stays certified too: every process
+    # holds the same global Chunked and place() keeps only its shard
+    # (chunking.process_shard + make_array_from_process_local_data).
+    from cpgisland_tpu.utils import codec
+
+    chunked_global = chunking.frame(
+        codec.encode_file(fa_path, skip_headers=True), 256
+    )
+    res_global = baum_welch.fit(
+        presets.durbin_cpg8(), chunked_global, num_iters=2, convergence=0.0,
+        backend=backends.SpmdBackend(mesh=make_mesh(8, axis="data")),
+    )
+    assert np.allclose(
+        np.asarray(res_global.params.A), np.asarray(res.params.A),
+        rtol=1e-6, atol=1e-8,
+    ), "global-batch and byte-range-sharded inputs diverged"
 
     # Sequence-parallel decode across BOTH processes' devices: the host
     # materialization goes through process_allgather, so each process gets
     # the identical full path.
     from cpgisland_tpu.parallel.decode import viterbi_sharded
 
+    rng = np.random.default_rng(42)
     obs = rng.integers(0, 4, size=8 * 512).astype(np.int32)
     path = viterbi_sharded(
         presets.durbin_cpg8(), obs, mesh=make_mesh(8, axis="seq"), block_size=128
@@ -103,12 +122,20 @@ def test_two_process_distributed_fit_matches_single_process(tmp_path):
     require_devices(8)
     worker = tmp_path / "worker.py"
     worker.write_text(WORKER)
+    # The shared training FASTA both workers byte-range-shard.
+    rng_fa = np.random.default_rng(7)
+    fa = tmp_path / "train.fa"
+    with open(fa, "w") as f:
+        f.write(">train\n")
+        s = "".join(np.array(list("acgt"))[rng_fa.integers(0, 4, size=16 * 256)])
+        for i in range(0, len(s), 70):
+            f.write(s[i : i + 70] + "\n")
     coordinator = f"127.0.0.1:{_free_port()}"
     env = dict(os.environ)
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
     procs = [
         subprocess.Popen(
-            [sys.executable, str(worker), coordinator, str(pid)],
+            [sys.executable, str(worker), coordinator, str(pid), str(fa)],
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True, env=env,
         )
         for pid in (0, 1)
@@ -127,9 +154,11 @@ def test_two_process_distributed_fit_matches_single_process(tmp_path):
     assert results[0]["path_sum"] == results[1]["path_sum"]
     np.testing.assert_array_equal(results[0]["path_head"], results[1]["path_head"])
 
-    # And match a single-process 8-device run on the identical input.
-    rng = np.random.default_rng(42)
-    syms = rng.integers(0, 4, size=16 * 256).astype(np.uint8)
+    # And match a single-process 8-device run on the identical input (the
+    # file encoded whole — the layout the byte-range shards must reproduce).
+    from cpgisland_tpu.utils import codec
+
+    syms = codec.encode_file(str(fa), skip_headers=True)
     chunked = chunking.frame(syms, 256)
     from cpgisland_tpu.parallel.mesh import make_mesh
 
@@ -144,11 +173,12 @@ def test_two_process_distributed_fit_matches_single_process(tmp_path):
         np.asarray(results[0]["logliks"]), ref.logliks, rtol=1e-6
     )
 
-    # The distributed decode equals the single-process sharded decode too.
+    # The distributed decode equals the single-process sharded decode too
+    # (same rng stream position as the workers' draw).
     from cpgisland_tpu.parallel.decode import viterbi_sharded
     from cpgisland_tpu.parallel.mesh import make_mesh as mk
 
-    obs = rng.integers(0, 4, size=8 * 512).astype(np.int32)
+    obs = np.random.default_rng(42).integers(0, 4, size=8 * 512).astype(np.int32)
     ref_path = viterbi_sharded(
         presets.durbin_cpg8(), obs, mesh=mk(8, axis="seq"), block_size=128
     )
