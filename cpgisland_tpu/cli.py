@@ -250,6 +250,10 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
 
 
 def _run_command(args, compat, pipeline, presets, load_text) -> int:
+    if getattr(args, "symbol_cache", None) and compat:
+        build_parser().error(
+            "--symbol-cache is FASTA-aware and requires --clean"
+        )
     if args.cmd == "train":
         params = load_text(args.init_model) if args.init_model else _preset_params(presets, args.preset)
         res = pipeline.train_file(

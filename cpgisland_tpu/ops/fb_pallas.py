@@ -248,6 +248,68 @@ def _bwd_kernel(steps_next_ref, lens_ref, A_ref, B_ref, cs_next_ref, beta0_ref,
     beta_scr[:, :] = jax.lax.fori_loop(0, Tt // ROW_TILE, body, beta_scr[:, :])
 
 
+def _bwd_conf_kernel(steps_next_ref, lens_ref, A_ref, B_ref, cs_next_ref,
+                     beta0_ref, alphas_ref, mask_ref,
+                     conf_ref,
+                     beta_scr,
+                     *, K, S, Tt, T):
+    """The backward walk EMITTING island confidence instead of beta streams.
+
+    The posterior path's hot variant of _bwd_kernel: betas never reach HBM —
+    each step reads the aligned alphas tile (off the sequential chain, like
+    the time-shifted inputs) and writes one float per position,
+    conf[t] = sum_isl(alpha_t * beta_t) / sum(alpha_t * beta_t).  The conf
+    math hangs OFF the beta recurrence (nothing feeds the next step), so it
+    pipelines against the chain; HBM traffic drops from write-32 + read-64
+    + write-4 B/symbol (betas out, XLA assembly in) to read-32 + write-4.
+    Scale-free: the stored alphas carry v_t = alpha-hat_t * c_t, and any
+    per-position scale cancels in the ratio.
+    """
+    j = pl.program_id(1)
+    n_t = pl.num_programs(1)
+    A = A_ref[:, :]
+    B = B_ref[:, :]
+    lens = lens_ref[0, :]
+    mask = mask_ref[:, :]  # [K, 1] island indicator
+    t0 = (n_t - 1 - j) * Tt
+
+    @pl.when(j == 0)
+    def _init():
+        beta_scr[:, :] = beta0_ref[:, :]
+
+    def body(tile_rev, beta_next):
+        base = (Tt // ROW_TILE - 1 - tile_rev) * ROW_TILE
+        on_tile = steps_next_ref[pl.ds(base, ROW_TILE), :]  # aligned [8, lt]
+        cn_tile = cs_next_ref[pl.ds(base, ROW_TILE), :]
+        inv_cn = 1.0 / cn_tile  # [8, lt]
+        wscale = tuple(
+            _emit_sel(B, on_tile[r, :], K, S) * inv_cn[r, :][None, :]
+            for r in range(ROW_TILE)
+        )
+        conf_rows = [None] * ROW_TILE
+        for rr in range(ROW_TILE):
+            r = ROW_TILE - 1 - rr
+            t = t0 + base + r
+            active = t <= T - 2
+            v_next = (t + 1) < lens
+            w = wscale[r] * beta_next  # [K, lt]
+            beta_t = jnp.sum(A[:, :, None] * w[None, :, :], axis=1)
+            beta_t = jnp.where((active & v_next)[None, :], beta_t, beta_next)
+            a_row = alphas_ref[base + r, :, :]  # [K, lt] aligned tile row
+            g = a_row * beta_t
+            tot = jnp.sum(g, axis=0, keepdims=True)
+            isl = jnp.sum(g * mask, axis=0, keepdims=True)
+            valid = (t < lens)[None, :]
+            conf_rows[r] = jnp.where(
+                valid, isl * (1.0 / jnp.maximum(tot, 1e-30)), 0.0
+            )
+            beta_next = beta_t
+        conf_ref[pl.ds(base, ROW_TILE), :] = jnp.concatenate(conf_rows, axis=0)
+        return beta_next
+
+    beta_scr[:, :] = jax.lax.fori_loop(0, Tt // ROW_TILE, body, beta_scr[:, :])
+
+
 def _fb_lane_tile(NL: int) -> int:
     """Lanes per kernel instance: 2 vregs wide when the (already 128-padded)
     lane count allows — the wider tile interleaves two independent dependency
@@ -255,14 +317,17 @@ def _fb_lane_tile(NL: int) -> int:
     return 256 if NL % 256 == 0 else LANE_TILE
 
 
-def _run_fb_kernels(A, B, steps2, lens2, a0_raw, beta0, K, S, Tt, T):
+def _run_fb_kernels(A, B, steps2, lens2, a0_raw, beta0, K, S, Tt, T,
+                    conf_mask=None):
     """The forward + backward kernel pair over a [Tp, NL] lane layout.
 
     a0_raw: [K, NL] per-lane UNnormalized v_0 (sum = that position's c);
     beta0: [K, NL] per-lane entering beta (ones for independent chunks,
     suffix boundary messages for lanes of one long sequence).
     Returns (alphas [Tp,K,NL] with v_t = alpha-hat_t * c_t, cs [Tp,NL],
-    betas [Tp,K,NL]).
+    betas [Tp,K,NL]) — or, with ``conf_mask`` ([K] island indicator), the
+    third element is instead the per-position island confidence [Tp, NL]
+    from the fused _bwd_conf_kernel (betas never reach HBM).
     """
     Tp, NL = steps2.shape
     n_t = Tp // Tt
@@ -304,6 +369,29 @@ def _run_fb_kernels(A, B, steps2, lens2, a0_raw, beta0, K, S, Tt, T):
 
     # Reversed t-walk: input/output t-blocks indexed by (n_t-1-j).
     rev_step_spec = _vspec((Tt, lt), lambda i, j: (n_t - 1 - j, i))
+    if conf_mask is not None:
+        (conf,) = pl.pallas_call(
+            functools.partial(_bwd_conf_kernel, K=K, S=S, Tt=Tt, T=T),
+            grid=grid,
+            in_specs=[
+                rev_step_spec,
+                lane_spec,
+                mat_spec,
+                emitmat_spec,
+                rev_step_spec,
+                klane_spec,
+                _vspec((Tt, K, lt), lambda i, j: (n_t - 1 - j, 0, i)),
+                _vspec((K, 1), lambda i, j: (0, 0)),
+            ],
+            out_specs=[rev_step_spec],
+            out_shape=[jax.ShapeDtypeStruct((Tp, NL), jnp.float32)],
+            scratch_shapes=[pltpu.VMEM((K, lt), jnp.float32)],
+            interpret=interpret,
+        )(
+            steps_next, lens2, A, B, cs_next, beta0, alphas,
+            conf_mask.astype(jnp.float32).reshape(K, 1),
+        )
+        return alphas, cs, conf
     (betas,) = pl.pallas_call(
         functools.partial(_bwd_kernel, K=K, S=S, Tt=Tt, T=T),
         grid=grid,
@@ -653,9 +741,15 @@ def _lane_streams(
     enter_dir=None,
     exit_dir=None,
     first: bool = True,
+    conf_mask=None,
 ):
     """Shared lane setup for the fused whole-sequence paths: lane transfer
     products -> boundary messages -> forward/backward kernel streams.
+
+    With ``conf_mask`` ([K] island indicator) the backward kernel emits the
+    per-position island confidence in the betas slot of the return tuple
+    ([Tp, NL] instead of [Tp, K, NL]) and beta streams never reach HBM —
+    the posterior fast path.
 
     ``first`` (static): this span starts the sequence — global position 0 is
     the init (its emission folds into the base direction).  ``enter_dir``
@@ -739,10 +833,11 @@ def _lane_streams(
 
     steps2 = obs_l.T  # [lane_T, NL] — within-lens symbols (kernels mask by lens)
     lens2 = lane_lens[None, :]
-    alphas, cs, betas = _run_fb_kernels(
-        A, B, steps2, lens2, v0.T, beta_exits.T, K, S, Tt, lane_T
+    alphas, cs, third = _run_fb_kernels(
+        A, B, steps2, lens2, v0.T, beta_exits.T, K, S, Tt, lane_T,
+        conf_mask=conf_mask,
     )
-    return alphas, cs, betas, steps2, lens2, enters, is_first, Tt
+    return alphas, cs, third, steps2, lens2, enters, is_first, Tt
 
 
 def _seq_stats_core(
@@ -841,6 +936,17 @@ def _seq_posterior_core(
     Returns (conf [T] f32, path [T] int32 — zeros unless want_path).
     """
     T = obs.shape[0]
+    if not want_path:
+        # Fast path: the backward kernel emits confidence directly (betas
+        # never reach HBM — see _bwd_conf_kernel).
+        _, _, conf2, steps2, _, _, _, _ = _lane_streams(
+            params, obs, length, lane_T, t_tile, axis,
+            enter_dir=enter_dir, exit_dir=exit_dir, first=first,
+            conf_mask=island_mask,
+        )
+        # Lane n covers global positions [n*lane_T, (n+1)*lane_T): transpose
+        # the [lane_T, NL] lane layout back to global order, slice the pad.
+        return conf2.T.reshape(-1)[:T], jnp.zeros((T,), jnp.int32)
     alphas, cs, betas, steps2, lens2, _, _, _ = _lane_streams(
         params, obs, length, lane_T, t_tile, axis,
         enter_dir=enter_dir, exit_dir=exit_dir, first=first,
@@ -851,14 +957,9 @@ def _seq_posterior_core(
     gsum = jnp.maximum(jnp.sum(graw, axis=1), 1e-30)  # [Tp, NL]
     gisl = jnp.sum(graw * island_mask[None, :, None], axis=1)
     conf2 = jnp.where(vmask, gisl / gsum, 0.0)
-    # Lane n covers global positions [n*lane_T, (n+1)*lane_T): transpose the
-    # [lane_T, NL] lane layout back to global order and slice the pad.
     conf = conf2.T.reshape(-1)[:T]
-    if want_path:
-        path2 = jnp.where(vmask, jnp.argmax(graw, axis=1), 0).astype(jnp.int32)
-        path = path2.T.reshape(-1)[:T]
-    else:
-        path = jnp.zeros((T,), jnp.int32)
+    path2 = jnp.where(vmask, jnp.argmax(graw, axis=1), 0).astype(jnp.int32)
+    path = path2.T.reshape(-1)[:T]
     return conf, path
 
 

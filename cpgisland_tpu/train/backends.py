@@ -66,7 +66,20 @@ class EStepBackend:
         raise NotImplementedError
 
     def prepare(self, chunked: chunking.Chunked) -> chunking.Chunked:
-        """Adjust a chunk batch to the backend's layout requirements."""
+        """Adjust a chunk batch to the backend's layout requirements.
+
+        The specialized containers are rejected here so a backend that does
+        not understand them can never silently mistrain — a LocalShard is
+        1/P of the data (only SpmdBackend assembles the global array from
+        it), and a Bucketed batch needs the per-group meshes only
+        Seq2DBackend builds.  This matters for fit()'s fallback-backend
+        switch: the fallback re-prepares the ORIGINAL input.
+        """
+        if isinstance(chunked, (chunking.LocalShard, chunking.Bucketed)):
+            raise ValueError(
+                f"{type(self).__name__} does not support "
+                f"{type(chunked).__name__} input ({'SpmdBackend' if isinstance(chunked, chunking.LocalShard) else 'Seq2DBackend'} does)"
+            )
         return chunked
 
     def place(self, chunks, lengths):
@@ -137,6 +150,10 @@ class SpmdBackend(EStepBackend):
         return self._estep_cache[engine]
 
     def prepare(self, chunked):
+        if isinstance(chunked, chunking.Bucketed):
+            raise ValueError(
+                "SpmdBackend does not support Bucketed input (Seq2DBackend does)"
+            )
         if isinstance(chunked, chunking.LocalShard):
             # Per-process pre-sharded input (chunking.distributed_chunked —
             # no host ever held the global batch).  Row padding already
@@ -295,6 +312,10 @@ class SeqBackend(EStepBackend):
 
     def prepare(self, chunked: chunking.Chunked) -> chunking.Chunked:
         """Re-frame any chunk batch as one stream sharded across the mesh."""
+        if isinstance(chunked, (chunking.LocalShard, chunking.Bucketed)):
+            raise ValueError(
+                f"SeqBackend does not support {type(chunked).__name__} input"
+            )
         stream = np.concatenate(
             [np.asarray(c[:l]) for c, l in zip(chunked.chunks, chunked.lengths)]
         ) if chunked.num_chunks else np.zeros(0, np.uint8)
@@ -393,6 +414,10 @@ class Seq2DBackend(EStepBackend):
         sized to its row count — many-row scaffold groups run data-parallel,
         single-row chromosome groups run fully sequence-parallel.
         """
+        if isinstance(chunked, chunking.LocalShard):
+            raise ValueError(
+                "Seq2DBackend does not support LocalShard input (SpmdBackend does)"
+            )
         if isinstance(chunked, chunking.Bucketed):
             from cpgisland_tpu.parallel.mesh import auto_mesh2d
 

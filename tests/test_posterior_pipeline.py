@@ -156,23 +156,29 @@ def test_posterior_sharded_matches_oracle(rng):
 
 
 def test_posterior_pallas_engine_matches_oracle(rng):
-    """The fused-kernel posterior core (interpret mode off-TPU) vs oracle."""
+    """The fused-kernel posterior core (interpret mode off-TPU) vs oracle —
+    BOTH branches: want_path=True (alphas*betas assembly) and the production
+    want_path=False fast path through _bwd_conf_kernel (betas never stored)."""
     from cpgisland_tpu.ops import fb_pallas
 
     params = presets.durbin_cpg8()
     obs = rng.choice([0, 1, 2, 3], size=2000, p=[0.3, 0.2, 0.2, 0.3]).astype(np.uint8)
     mask = jnp.asarray((np.arange(8) < 4).astype(np.float32))
+    gamma, _ = posterior_marginals(params, jnp.asarray(obs))
+    ref = np.asarray(gamma[:, :4].sum(axis=1))
     conf, path = fb_pallas.seq_posterior_pallas(
         params, jnp.asarray(obs), obs.size, mask, want_path=True,
         lane_T=256, t_tile=64,
     )
-    gamma, _ = posterior_marginals(params, jnp.asarray(obs))
-    np.testing.assert_allclose(
-        np.asarray(conf), np.asarray(gamma[:, :4].sum(axis=1)), atol=2e-5
-    )
+    np.testing.assert_allclose(np.asarray(conf), ref, atol=2e-5)
     np.testing.assert_array_equal(
         np.asarray(path), np.asarray(jnp.argmax(gamma, axis=1))
     )
+    conf_fast, _ = fb_pallas.seq_posterior_pallas(
+        params, jnp.asarray(obs), obs.size, mask, want_path=False,
+        lane_T=256, t_tile=64,
+    )
+    np.testing.assert_allclose(np.asarray(conf_fast), ref, atol=2e-5)
 
 
 def test_npy_stream_writer(tmp_path):

@@ -1,0 +1,44 @@
+"""Published figures cannot drift from the captured bench artifact.
+
+VERDICT r2 #6: README/BASELINE headline figures must derive from a captured
+machine-readable artifact, not hand-copying.  tools/pubnum.py owns the
+parse + marker check; this test runs it, and additionally cross-checks the
+north-star seconds against the LATEST driver BENCH_r*.json within a variance
+band (run-to-run TPU noise is real — CLAUDE.md notes transient slowdowns —
+but a figure drifting by >35% means the docs describe a different build).
+"""
+
+import glob
+import json
+import os
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+
+def test_docs_match_captured_artifact():
+    import pubnum
+
+    vals = pubnum.parse_captured(REPO)
+    problems = pubnum.check_docs(vals, REPO)
+    assert not problems, "\n".join(problems)
+
+
+def test_northstar_agrees_with_latest_driver_record():
+    import pubnum
+
+    vals = pubnum.parse_captured(REPO)
+    bench_files = sorted(glob.glob(os.path.join(REPO, "BENCH_r*.json")))
+    if not bench_files:
+        pytest.skip("no driver BENCH_r*.json present")
+    with open(bench_files[-1]) as f:
+        driver = json.load(f)
+    driver_val = driver["parsed"]["value"]
+    doc_val = vals["northstar_value"]
+    assert abs(driver_val - doc_val) / driver_val < 0.35, (
+        f"doc north star {doc_val}s vs driver {bench_files[-1]} "
+        f"{driver_val}s — re-capture the artifact (tools/pubnum.py --write)"
+    )
