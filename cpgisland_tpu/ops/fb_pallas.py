@@ -571,16 +571,12 @@ def _pad_axis(x, size, axis, fill):
     return jnp.pad(x, widths, constant_values=fill)
 
 
-@functools.partial(jax.jit, static_argnames=("t_tile",))
-def batch_stats_pallas(
-    params: HmmParams,
-    chunks: jnp.ndarray,
-    lengths: jnp.ndarray,
-    t_tile: int = DEFAULT_T_TILE,
-) -> SuffStats:
-    """Pallas twin of ops.forward_backward.batch_stats(mode="rescaled").
+def _batch_lane_setup(params: HmmParams, chunks, lengths, t_tile: int):
+    """Chunked lane layout shared by the batched E-step and the batched
+    posterior: one INDEPENDENT record/chunk per lane, pi init, free end.
 
-    chunks: [N, T] (padded), lengths: [N].  Returns batch-summed SuffStats.
+    Returns (A, B, pi, steps2 [Tp, NL], lens2 [1, NL], a0_raw [K, NL],
+    beta0 [K, NL], valid0 [NL], Tt).
     """
     K, S = params.n_states, params.n_symbols
     N, T = chunks.shape
@@ -610,8 +606,39 @@ def batch_stats_pallas(
     # handles t >= 1 with deferred normalization — see _fwd_kernel).
     B0 = _emit_sel(B, steps2[0, :], K, S)  # [K, NL]
     a0_raw = jnp.where(valid0[None, :], pi[:, None] * B0, jnp.ones((K, NL)) / K)
-
     beta0 = jnp.ones((K, NL), jnp.float32)  # independent chunks end free
+    return A, B, pi, steps2, lens2, a0_raw, beta0, valid0, Tt
+
+
+def _conf_path_from_streams(alphas, betas, lens2, island_mask):
+    """Shared gamma assembly: (conf2 [Tp, NL], path2 [Tp, NL]) from stored
+    alpha/beta streams — the want_path branch of both posterior layouts."""
+    Tp = alphas.shape[0]
+    vmask = jnp.arange(Tp)[:, None] < lens2
+    graw = alphas * betas
+    gsum = jnp.maximum(jnp.sum(graw, axis=1), 1e-30)
+    gisl = jnp.sum(graw * island_mask[None, :, None], axis=1)
+    conf2 = jnp.where(vmask, gisl / gsum, 0.0)
+    path2 = jnp.where(vmask, jnp.argmax(graw, axis=1), 0).astype(jnp.int32)
+    return conf2, path2
+
+
+@functools.partial(jax.jit, static_argnames=("t_tile",))
+def batch_stats_pallas(
+    params: HmmParams,
+    chunks: jnp.ndarray,
+    lengths: jnp.ndarray,
+    t_tile: int = DEFAULT_T_TILE,
+) -> SuffStats:
+    """Pallas twin of ops.forward_backward.batch_stats(mode="rescaled").
+
+    chunks: [N, T] (padded), lengths: [N].  Returns batch-summed SuffStats.
+    """
+    K, S = params.n_states, params.n_symbols
+    T = chunks.shape[1]
+    A, B, pi, steps2, lens2, a0_raw, beta0, valid0, Tt = _batch_lane_setup(
+        params, chunks, lengths, t_tile
+    )
     alphas, cs, betas = _run_fb_kernels(A, B, steps2, lens2, a0_raw, beta0, K, S, Tt, T)
 
     # Count-tensor assembly: ONE fused streaming pass over alphas/betas
@@ -951,16 +978,8 @@ def _seq_posterior_core(
         params, obs, length, lane_T, t_tile, axis,
         enter_dir=enter_dir, exit_dir=exit_dir, first=first,
     )
-    Tp, NL = steps2.shape
-    vmask = jnp.arange(Tp)[:, None] < lens2  # [Tp, NL]
-    graw = alphas * betas  # [Tp, K, NL]
-    gsum = jnp.maximum(jnp.sum(graw, axis=1), 1e-30)  # [Tp, NL]
-    gisl = jnp.sum(graw * island_mask[None, :, None], axis=1)
-    conf2 = jnp.where(vmask, gisl / gsum, 0.0)
-    conf = conf2.T.reshape(-1)[:T]
-    path2 = jnp.where(vmask, jnp.argmax(graw, axis=1), 0).astype(jnp.int32)
-    path = path2.T.reshape(-1)[:T]
-    return conf, path
+    conf2, path2 = _conf_path_from_streams(alphas, betas, lens2, island_mask)
+    return conf2.T.reshape(-1)[:T], path2.T.reshape(-1)[:T]
 
 
 @functools.partial(
@@ -989,6 +1008,42 @@ def seq_posterior_pallas(
         enter_dir=enter_dir, exit_dir=exit_dir, first=first,
         want_path=want_path,
     )
+
+
+@functools.partial(jax.jit, static_argnames=("t_tile", "want_path"))
+def batch_posterior_pallas(
+    params: HmmParams,
+    chunks: jnp.ndarray,
+    lengths: jnp.ndarray,
+    island_mask: jnp.ndarray,
+    t_tile: int = DEFAULT_T_TILE,
+    want_path: bool = False,
+):
+    """Posterior island confidence for a [N, T] batch of INDEPENDENT records.
+
+    The soft twin of viterbi_*_batch: each record rides one VPU lane in the
+    chunked kernel layout (batch_stats_pallas), with pi-init and free-end
+    betas — EXACT per record since every record fits its lane whole.  This
+    is how scaffold-heavy assemblies avoid one dispatch (and one
+    mostly-idle lane pass) per tiny record.  Returns (conf [N, T] f32,
+    path [N, T] int32 — zeros unless want_path).
+    """
+    K, S = params.n_states, params.n_symbols
+    N, T = chunks.shape
+    A, B, _, steps2, lens2, a0_raw, beta0, _, Tt = _batch_lane_setup(
+        params, chunks, lengths, t_tile
+    )
+    if not want_path:
+        _, _, conf2 = _run_fb_kernels(
+            A, B, steps2, lens2, a0_raw, beta0, K, S, Tt, T,
+            conf_mask=island_mask,
+        )
+        return conf2.T[:N, :T], jnp.zeros((N, T), jnp.int32)
+    alphas, _, betas = _run_fb_kernels(
+        A, B, steps2, lens2, a0_raw, beta0, K, S, Tt, T
+    )
+    conf2, path2 = _conf_path_from_streams(alphas, betas, lens2, island_mask)
+    return conf2.T[:N, :T], path2.T[:N, :T]
 
 
 @functools.partial(jax.jit, static_argnames=("lane_T", "t_tile", "first"))

@@ -562,6 +562,13 @@ def _decode_small_batch(
 # CLEAN_DECODE_SPAN for the hard decode).
 POSTERIOR_SPAN = 1 << 26
 
+# Records at or below this size batch into ONE chunked-layout kernel pass on
+# the pallas engine (fb_pallas.batch_posterior_pallas: one record per VPU
+# lane — exact, since each record fits its lane whole).  512 Ki keeps the
+# padded alpha stream of a 128-lane batch ~2 GB; bigger records already fill
+# >=64 lanes of the sequence-parallel path on their own.
+POSTERIOR_BATCH_MAX = 1 << 19
+
 
 @dataclass
 class PosteriorResult:
@@ -606,7 +613,9 @@ def posterior_file(
     span only bounds peak device memory.
     """
     from cpgisland_tpu.parallel.posterior import (
+        island_mask,
         posterior_sharded,
+        resolve_fb_engine,
         transfer_total_sharded,
     )
     from cpgisland_tpu.utils.npystream import NpyStreamWriter
@@ -619,6 +628,9 @@ def posterior_file(
     island_states = tuple(sorted(island_states))
     timer = timer if timer is not None else profiling.PhaseTimer()
     want_path = mpm_path_out is not None
+    # Small records batch into one chunked-layout kernel pass (pallas only;
+    # the XLA lane path serves one record at a time).
+    batch_small = resolve_fb_engine(engine, params) == "pallas"
     # Writers open INSIDE the try: a failure opening the second must still
     # close (finalize) the first, not leave a corrupt header slot behind.
     conf_w = None
@@ -635,6 +647,62 @@ def posterior_file(
         if path_w is not None:
             path_w.write(np.asarray(path).astype(np.int8))
 
+    pending: list[np.ndarray] = []
+
+    def flush_small() -> None:
+        if not pending:
+            return
+        batch = list(pending)
+        pending.clear()
+        if len(batch) == 1:
+            one_record(batch[0])
+            return
+        from cpgisland_tpu.ops.fb_pallas import batch_posterior_pallas
+
+        # One kernel call per power-of-two size class: padding every record
+        # to the batch maximum would inflate the walk by the size spread
+        # (one ~400Ki record among 1Ki scaffolds = ~400x wasted steps).
+        # Results are emitted back in FILE order regardless of class.
+        by_class: dict[int, list[tuple[int, np.ndarray]]] = {}
+        for i, s in enumerate(batch):
+            by_class.setdefault(_round_pow2(s.size, floor=1 << 14), []).append((i, s))
+        results: list = [None] * len(batch)
+        for Tpad in sorted(by_class):
+            group = by_class[Tpad]
+            Bp = _round_pow2(len(group), floor=8)
+            rows = np.full((Bp, Tpad), chunking.PAD_SYMBOL, np.uint8)
+            lens = np.zeros(Bp, np.int32)
+            for g, (_, s) in enumerate(group):
+                rows[g, : s.size] = s
+                lens[g] = s.size
+            total = float(sum(s.size for _, s in group))
+            with timer.phase("posterior", items=total, unit="sym"):
+                conf2, path2 = batch_posterior_pallas(
+                    params, jnp.asarray(rows), jnp.asarray(lens),
+                    jnp.asarray(island_mask(params, island_states)),
+                    want_path=want_path,
+                )
+                conf2 = np.asarray(conf2)
+                path2 = np.asarray(path2) if want_path else None
+            for g, (i, s) in enumerate(group):
+                results[i] = (
+                    conf2[g, : s.size],
+                    path2[g, : s.size] if want_path else None,
+                )
+        for conf, path in results:
+            emit(conf, path)
+
+    def one_record(symbols: np.ndarray) -> None:
+        with timer.phase("posterior", items=float(symbols.size), unit="sym"):
+            conf, path = posterior_sharded(
+                params, symbols, island_states,
+                engine=engine, want_path=want_path,
+                # Power-of-two buckets: scaffold-heavy files must not
+                # compile once per distinct record size.
+                pad_to=_round_pow2(symbols.size, floor=1 << 14),
+            )
+        emit(conf, path)
+
     try:
         conf_w = NpyStreamWriter(confidence_out, np.float32)
         if want_path:
@@ -646,17 +714,15 @@ def posterior_file(
             n_sym += symbols.size
             if symbols.size == 0:
                 continue
+            if batch_small and symbols.size <= POSTERIOR_BATCH_MAX:
+                pending.append(np.asarray(symbols))
+                if len(pending) >= 128:
+                    flush_small()
+                continue
+            flush_small()  # preserve record order around a large record
             n_spans = -(-symbols.size // span)
             if n_spans == 1:
-                with timer.phase("posterior", items=float(symbols.size), unit="sym"):
-                    conf, path = posterior_sharded(
-                        params, symbols, island_states,
-                        engine=engine, want_path=want_path,
-                        # Power-of-two buckets: scaffold-heavy files must not
-                        # compile once per distinct record size.
-                        pad_to=_round_pow2(symbols.size, floor=1 << 14),
-                    )
-                emit(conf, path)
+                one_record(symbols)
                 continue
             log.info(
                 "record %r (%d symbols) exceeds the posterior span (%d); "
@@ -702,6 +768,7 @@ def posterior_file(
                         want_path=want_path, pad_to=span,
                     )
                 emit(conf, path)
+        flush_small()
     finally:
         if conf_w is not None:
             conf_w.close()

@@ -100,6 +100,16 @@ WORKER = textwrap.dedent(
         presets.durbin_cpg8(), obs, mesh=make_mesh(8, axis="seq"), block_size=128
     )
 
+    # Sharded posterior across both processes' devices (soft decoding over
+    # the DCN-path collectives; fetch uses the multi-host-safe gather).
+    from cpgisland_tpu.parallel.posterior import posterior_sharded
+
+    conf, _ = posterior_sharded(
+        presets.durbin_cpg8(), obs.astype(np.uint8), (0, 1, 2, 3),
+        mesh=make_mesh(8, axis="seq"), block_size=128,
+    )
+    assert conf.shape == obs.shape
+
     print("RESULT " + json.dumps({
         "pid": pid,
         "A": np.asarray(res.params.A).tolist(),
@@ -107,6 +117,7 @@ WORKER = textwrap.dedent(
         "logliks": [float(x) for x in res.logliks],
         "path_sum": int(np.asarray(path).sum()),
         "path_head": np.asarray(path)[:32].tolist(),
+        "conf_sum": float(np.asarray(conf, np.float64).sum()),
     }), flush=True)
     """
 )
@@ -184,3 +195,16 @@ def test_two_process_distributed_fit_matches_single_process(tmp_path):
     )
     assert results[0]["path_sum"] == int(ref_path.sum())
     np.testing.assert_array_equal(results[0]["path_head"], ref_path[:32])
+
+    # The distributed posterior agrees across processes and with the
+    # single-process sharded run.
+    from cpgisland_tpu.parallel.posterior import posterior_sharded
+
+    assert results[0]["conf_sum"] == pytest.approx(results[1]["conf_sum"], rel=1e-9)
+    ref_conf, _ = posterior_sharded(
+        presets.durbin_cpg8(), obs.astype(np.uint8), (0, 1, 2, 3),
+        mesh=mk(8, axis="seq"), block_size=128,
+    )
+    assert results[0]["conf_sum"] == pytest.approx(
+        float(np.asarray(ref_conf, np.float64).sum()), rel=1e-5
+    )
