@@ -156,6 +156,13 @@ def build_parser() -> argparse.ArgumentParser:
         "--mpm-path-out",
         help=".npy int8 max-posterior-marginal state path (soft state_path_out)",
     )
+    po.add_argument(
+        "--islands-out",
+        help="also call CpG islands from the MPM path (clean semantics, "
+        "decode-format records) — the soft counterpart of `decode`",
+    )
+    po.add_argument("--min-len", type=int, default=None,
+                    help="minimum island length for --islands-out")
     _add_island_states_flag(po)
     # Only the flags posterior honors (it is always clean/FASTA-aware) — NOT
     # _common_flags, whose --backend/--numerics/--clean would be silently
@@ -306,13 +313,20 @@ def _run_command(args, compat, pipeline, presets, load_text) -> int:
             params,
             confidence_out=args.confidence_out,
             mpm_path_out=args.mpm_path_out,
+            islands_out=args.islands_out,
+            min_len=args.min_len,
             island_states=island_states,
             engine=args.engine,
             symbol_cache=args.symbol_cache,
         )
+        extra = (
+            f"; {len(res.calls)} islands -> {args.islands_out}"
+            if res.calls is not None
+            else ""
+        )
         print(
             f"posterior: {res.n_symbols} symbols in {res.n_records} records; "
-            f"mean island confidence {res.mean_island_confidence:.4f}"
+            f"mean island confidence {res.mean_island_confidence:.4f}{extra}"
         )
         return 0
 

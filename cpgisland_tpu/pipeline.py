@@ -575,6 +575,7 @@ class PosteriorResult:
     n_symbols: int
     n_records: int
     mean_island_confidence: float
+    calls: Optional[IslandCalls] = None
 
 
 def posterior_file(
@@ -583,6 +584,8 @@ def posterior_file(
     *,
     confidence_out: str,
     mpm_path_out: Optional[str] = None,
+    islands_out: Optional[Union[str, IO[str]]] = None,
+    min_len: Optional[int] = None,
     island_states=None,
     span: int = POSTERIOR_SPAN,
     engine: str = "auto",
@@ -598,7 +601,11 @@ def posterior_file(
     posterior marginal over the island states, written as one float32 per
     symbol (.npy, streamed record by record).  ``mpm_path_out`` additionally
     writes the max-posterior-marginal state path (int8), the soft
-    counterpart of decode_file's ``state_path_out``.
+    counterpart of decode_file's ``state_path_out``; ``islands_out`` calls
+    CpG islands from that MPM path (clean semantics, per record, same
+    ``beg end len gc oe`` format as decode_file) — the full soft
+    counterpart of the reference's Viterbi -> island-caller pipeline
+    (CpGIslandFinder.java:260-339), with ``min_len`` available.
 
     ``island_states``: which states count as "island" (same contract as
     decode_file's flag); default = the first n_symbols states, the
@@ -620,6 +627,7 @@ def posterior_file(
     )
     from cpgisland_tpu.utils.npystream import NpyStreamWriter
 
+    obs_based_calls = island_states is not None  # user-named island states
     if island_states is None:
         err = island_layout_error(params, island_states)
         if err:
@@ -627,7 +635,8 @@ def posterior_file(
         island_states = tuple(range(params.n_symbols))
     island_states = tuple(sorted(island_states))
     timer = timer if timer is not None else profiling.PhaseTimer()
-    want_path = mpm_path_out is not None
+    want_islands = islands_out is not None
+    want_path = mpm_path_out is not None or want_islands
     # Small records batch into one chunked-layout kernel pass (pallas only;
     # the XLA lane path serves one record at a time).
     batch_small = resolve_fb_engine(engine, params) == "pallas"
@@ -647,7 +656,25 @@ def posterior_file(
         if path_w is not None:
             path_w.write(np.asarray(path).astype(np.int8))
 
-    pending: list[np.ndarray] = []
+    call_parts: list[IslandCalls] = []
+
+    def call_rec(rec_name: str, symbols: np.ndarray, path) -> None:
+        """MPM-path island calls for one whole record (clean semantics)."""
+        if not want_islands:
+            return
+        path = np.asarray(path)
+        if obs_based_calls:
+            calls = islands_mod.call_islands_obs(
+                path, np.asarray(symbols), island_states=island_states,
+                min_len=min_len,
+            )
+        else:
+            calls = islands_mod.call_islands(
+                path, chunk=0, compat=False, min_len=min_len
+            )
+        call_parts.append(calls.with_names(rec_name or "."))
+
+    pending: list[tuple[str, np.ndarray]] = []
 
     def flush_small() -> None:
         if not pending:
@@ -655,7 +682,7 @@ def posterior_file(
         batch = list(pending)
         pending.clear()
         if len(batch) == 1:
-            one_record(batch[0])
+            one_record(*batch[0])
             return
         from cpgisland_tpu.ops.fb_pallas import batch_posterior_pallas
 
@@ -663,36 +690,46 @@ def posterior_file(
         # to the batch maximum would inflate the walk by the size spread
         # (one ~400Ki record among 1Ki scaffolds = ~400x wasted steps).
         # Results are emitted back in FILE order regardless of class.
-        by_class: dict[int, list[tuple[int, np.ndarray]]] = {}
-        for i, s in enumerate(batch):
-            by_class.setdefault(_round_pow2(s.size, floor=1 << 14), []).append((i, s))
+        by_class: dict[int, list[int]] = {}
+        for i, (_, s) in enumerate(batch):
+            by_class.setdefault(_round_pow2(s.size, floor=1 << 14), []).append(i)
         results: list = [None] * len(batch)
+        # Device-memory budget per kernel call, in PADDED symbols: the fused
+        # conf path streams ~36 B/padded-symbol; want_path materializes both
+        # alpha AND beta streams (~72 B), so it gets half the budget.
+        budget = (1 << 26) // (2 if want_path else 1)
         for Tpad in sorted(by_class):
-            group = by_class[Tpad]
-            Bp = _round_pow2(len(group), floor=8)
-            rows = np.full((Bp, Tpad), chunking.PAD_SYMBOL, np.uint8)
-            lens = np.zeros(Bp, np.int32)
-            for g, (_, s) in enumerate(group):
-                rows[g, : s.size] = s
-                lens[g] = s.size
-            total = float(sum(s.size for _, s in group))
-            with timer.phase("posterior", items=total, unit="sym"):
-                conf2, path2 = batch_posterior_pallas(
-                    params, jnp.asarray(rows), jnp.asarray(lens),
-                    jnp.asarray(island_mask(params, island_states)),
-                    want_path=want_path,
-                )
-                conf2 = np.asarray(conf2)
-                path2 = np.asarray(path2) if want_path else None
-            for g, (i, s) in enumerate(group):
-                results[i] = (
-                    conf2[g, : s.size],
-                    path2[g, : s.size] if want_path else None,
-                )
-        for conf, path in results:
+            group_all = by_class[Tpad]
+            max_rows = max(1, budget // Tpad)
+            for lo in range(0, len(group_all), max_rows):
+                group = group_all[lo : lo + max_rows]
+                Bp = _round_pow2(len(group), floor=8)
+                rows = np.full((Bp, Tpad), chunking.PAD_SYMBOL, np.uint8)
+                lens = np.zeros(Bp, np.int32)
+                for g, i in enumerate(group):
+                    s = batch[i][1]
+                    rows[g, : s.size] = s
+                    lens[g] = s.size
+                total = float(sum(batch[i][1].size for i in group))
+                with timer.phase("posterior", items=total, unit="sym"):
+                    conf2, path2 = batch_posterior_pallas(
+                        params, jnp.asarray(rows), jnp.asarray(lens),
+                        jnp.asarray(island_mask(params, island_states)),
+                        want_path=want_path,
+                    )
+                    conf2 = np.asarray(conf2)
+                    path2 = np.asarray(path2) if want_path else None
+                for g, i in enumerate(group):
+                    n = batch[i][1].size
+                    results[i] = (
+                        conf2[g, :n],
+                        path2[g, :n] if want_path else None,
+                    )
+        for (name, s), (conf, path) in zip(batch, results):
             emit(conf, path)
+            call_rec(name, s, path)
 
-    def one_record(symbols: np.ndarray) -> None:
+    def one_record(rec_name: str, symbols: np.ndarray) -> None:
         with timer.phase("posterior", items=float(symbols.size), unit="sym"):
             conf, path = posterior_sharded(
                 params, symbols, island_states,
@@ -702,10 +739,11 @@ def posterior_file(
                 pad_to=_round_pow2(symbols.size, floor=1 << 14),
             )
         emit(conf, path)
+        call_rec(rec_name, symbols, path)
 
     try:
         conf_w = NpyStreamWriter(confidence_out, np.float32)
-        if want_path:
+        if mpm_path_out is not None:
             path_w = NpyStreamWriter(mpm_path_out, np.int8)
         for rec_name, symbols in codec.iter_fasta_records_cached(
             test_path, symbol_cache
@@ -714,15 +752,17 @@ def posterior_file(
             n_sym += symbols.size
             if symbols.size == 0:
                 continue
-            if batch_small and symbols.size <= POSTERIOR_BATCH_MAX:
-                pending.append(np.asarray(symbols))
+            # Batch eligibility respects a user-narrowed span: a record the
+            # span contract would split must take the span-threaded path.
+            if batch_small and symbols.size <= min(span, POSTERIOR_BATCH_MAX):
+                pending.append((rec_name, np.asarray(symbols)))
                 if len(pending) >= 128:
                     flush_small()
                 continue
             flush_small()  # preserve record order around a large record
             n_spans = -(-symbols.size // span)
             if n_spans == 1:
-                one_record(symbols)
+                one_record(rec_name, symbols)
                 continue
             log.info(
                 "record %r (%d symbols) exceeds the posterior span (%d); "
@@ -757,6 +797,7 @@ def posterior_file(
                 e = (e / e.sum()).astype(np.float32)
                 exits[s] = e
             # Sweep B: full posterior per span with the threaded messages.
+            rec_path_parts: list[np.ndarray] = []
             for s in range(n_spans):
                 lo = s * span
                 piece = symbols[lo : lo + span]
@@ -768,6 +809,12 @@ def posterior_file(
                         want_path=want_path, pad_to=span,
                     )
                 emit(conf, path)
+                if want_islands:
+                    rec_path_parts.append(np.asarray(path).astype(np.int8))
+            if want_islands:
+                # Islands are called over the WHOLE record's MPM path so a
+                # run crossing a span boundary is never clipped.
+                call_rec(rec_name, symbols, np.concatenate(rec_path_parts))
         flush_small()
     finally:
         if conf_w is not None:
@@ -775,26 +822,42 @@ def posterior_file(
         if path_w is not None:
             path_w.close()
     mean_conf = conf_total / n_sym if n_sym else 0.0
+    calls_all = None
+    if want_islands:
+        calls_all = IslandCalls.concatenate(call_parts)
+        if n_records <= 1:
+            # Single-record files keep the reference's bare 5-column format.
+            calls_all = dataclasses.replace(calls_all, names=None)
+        _write_calls(calls_all, islands_out)
     log.info("posterior phases:\n%s", timer.report())
     if metrics is not None:
         metrics.log(
             "posterior", n_symbols=n_sym, n_records=n_records,
-            mean_island_confidence=mean_conf, **timer.as_dict(),
+            mean_island_confidence=mean_conf,
+            **({"n_islands": len(calls_all)} if calls_all is not None else {}),
+            **timer.as_dict(),
         )
     return PosteriorResult(
-        n_symbols=n_sym, n_records=n_records, mean_island_confidence=mean_conf
+        n_symbols=n_sym, n_records=n_records, mean_island_confidence=mean_conf,
+        calls=calls_all,
     )
+
+
+def _write_calls(calls: IslandCalls, islands_out: Union[str, IO[str]]) -> None:
+    """Write island records (reference line format) to a path or open file —
+    the ONE copy of the str-vs-IO ownership rule (decode + posterior)."""
+    own = isinstance(islands_out, str)
+    f = open(islands_out, "w") if own else islands_out
+    try:
+        f.write(calls.format_lines())
+    finally:
+        if own:
+            f.close()
 
 
 def _finish_decode(calls, n_symbols, n_chunks, islands_out) -> DecodeResult:
     if islands_out is not None:
-        own = isinstance(islands_out, str)
-        f = open(islands_out, "w") if own else islands_out
-        try:
-            f.write(calls.format_lines())
-        finally:
-            if own:
-                f.close()
+        _write_calls(calls, islands_out)
     return DecodeResult(calls=calls, n_symbols=int(n_symbols), n_chunks=int(n_chunks))
 
 

@@ -264,3 +264,70 @@ def test_posterior_file_batches_small_records(tmp_path, rng):
     assert r1.n_records == r2.n_records == len(sizes)
     np.testing.assert_allclose(np.load(c1), np.load(c2), atol=2e-5)
     np.testing.assert_array_equal(np.load(p1), np.load(p2))
+
+
+def test_posterior_islands_out(tmp_path, rng):
+    """--islands-out: island calls from the MPM path — the soft counterpart
+    of decode.  On a cleanly separable planted-island file the calls must
+    essentially agree with the Viterbi-path calls."""
+    fa, n = _island_fasta(tmp_path, rng)
+    params = presets.durbin_cpg8()
+    isl_p = tmp_path / "isl.txt"
+    res = pipeline.posterior_file(
+        str(fa), params, confidence_out=str(tmp_path / "c.npy"),
+        islands_out=str(isl_p),
+    )
+    assert res.calls is not None and len(res.calls) >= 2
+    lines = isl_p.read_text().splitlines()
+    assert len(lines) == len(res.calls)
+    assert len(lines[0].split()) == 5  # single record: bare reference format
+    hard = pipeline.decode_file(str(fa), params, compat=False)
+    # Planted islands are unambiguous: same call count, boundaries within a
+    # few positions (MPM and Viterbi may disagree at fuzzy edges).
+    assert len(res.calls) == len(hard.calls)
+    np.testing.assert_allclose(res.calls.beg, hard.calls.beg, atol=8)
+    np.testing.assert_allclose(res.calls.end, hard.calls.end, atol=8)
+
+    # two_state + island_states goes through the observation-based caller.
+    res2 = pipeline.posterior_file(
+        str(fa), presets.two_state_cpg(),
+        confidence_out=str(tmp_path / "c2.npy"),
+        islands_out=str(tmp_path / "isl2.txt"), island_states=(0,),
+    )
+    assert res2.calls is not None and len(res2.calls) >= 2
+
+    # CLI surface.
+    rc = cli.main([
+        "posterior", str(fa), "--confidence-out", str(tmp_path / "c3.npy"),
+        "--islands-out", str(tmp_path / "isl3.txt"), "--min-len", "200",
+    ])
+    assert rc == 0
+    assert (tmp_path / "isl3.txt").exists()
+
+
+def test_posterior_islands_span_not_clipped(tmp_path, rng):
+    """An island straddling a posterior span boundary comes out whole (the
+    record's MPM path is assembled before calling)."""
+    fa = tmp_path / "g.fa"
+    with open(fa, "w") as f:
+        f.write(">c\n")
+        bg = rng.choice(list("acgt"), size=2000, p=[0.35, 0.15, 0.15, 0.35])
+        isl = rng.choice(list("acgt"), size=800, p=[0.08, 0.42, 0.42, 0.08])
+        bg2 = rng.choice(list("acgt"), size=1800, p=[0.35, 0.15, 0.15, 0.35])
+        s = "".join(np.concatenate([bg, isl, bg2]))
+        for i in range(0, len(s), 70):
+            f.write(s[i : i + 70] + "\n")
+    # span=2400 cuts through the island at [2000, 2800).
+    res = pipeline.posterior_file(
+        str(fa), presets.durbin_cpg8(),
+        confidence_out=str(tmp_path / "c.npy"),
+        islands_out=str(tmp_path / "i.txt"), span=2400,
+    )
+    full = pipeline.posterior_file(
+        str(fa), presets.durbin_cpg8(),
+        confidence_out=str(tmp_path / "c2.npy"),
+        islands_out=str(tmp_path / "i2.txt"),
+    )
+    np.testing.assert_array_equal(res.calls.beg, full.calls.beg)
+    np.testing.assert_array_equal(res.calls.end, full.calls.end)
+    assert any(b <= 2400 <= e for b, e in zip(res.calls.beg, res.calls.end))
