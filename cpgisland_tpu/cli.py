@@ -302,6 +302,8 @@ def _run_command(args, compat, pipeline, presets, load_text) -> int:
         return 0
 
     if args.cmd == "posterior":
+        if args.min_len is not None and not args.islands_out:
+            build_parser().error("--min-len only applies with --islands-out")
         island_states = _parse_island_states(build_parser(), args, compat=False)
         params = load_text(args.model) if args.model else _preset_params(presets, args.preset)
         if island_states is None:

@@ -64,19 +64,42 @@ def _viterbi_impl(params, obs, length, return_score):
 
     delta0 = params.log_pi + emit_t[obs_clipped[0]]
 
-    def step(delta, inputs):
+    # The carry is (delta normalized to max 0, accumulated offset): scores
+    # grow ~-1.3/symbol, and unnormalized f32 deltas at genome length reach
+    # magnitudes where the ulp dwarfs the O(1) per-state differences every
+    # argmax decision rides on (the same f32-range guard the parallel
+    # engines apply per combine, viterbi_parallel.nrm_maxplus).  Subtracting
+    # the per-step max is decision-invariant; the offset restores the true
+    # score at the end.
+    off0 = jnp.max(delta0)
+    delta0 = delta0 - off0
+
+    def step(carry, inputs):
+        delta, off, comp = carry
         o_t, t = inputs
         scores = delta[:, None] + params.log_A  # [K_from, K_to]
         bp = jnp.argmax(scores, axis=0).astype(jnp.int32)  # [K_to]
         new_delta = jnp.max(scores, axis=0) + emit_t[o_t]
+        step_off = jnp.max(new_delta)
+        new_delta = new_delta - step_off
+        # Kahan-compensated offset sum: T scalar adds at growing magnitude
+        # would otherwise drift the returned score by ~1e-5/step.
+        y = step_off - comp
+        tsum = off + y
+        new_comp = (tsum - off) - y
+        new_off = tsum
         if length is not None:
             is_pad = t >= length
             new_delta = jnp.where(is_pad, delta, new_delta)
+            new_off = jnp.where(is_pad, off, new_off)
+            new_comp = jnp.where(is_pad, comp, new_comp)
             bp = jnp.where(is_pad, jnp.arange(K, dtype=jnp.int32), bp)
-        return new_delta, bp
+        return (new_delta, new_off, new_comp), bp
 
     ts = jnp.arange(1, T)
-    delta_final, bps = jax.lax.scan(step, delta0, (obs_clipped[1:], ts))
+    (delta_final, off_final, _), bps = jax.lax.scan(
+        step, (delta0, off0, jnp.zeros((), delta0.dtype)), (obs_clipped[1:], ts)
+    )
 
     last_state = jnp.argmax(delta_final).astype(jnp.int32)
 
@@ -89,7 +112,7 @@ def _viterbi_impl(params, obs, length, return_score):
     path = jnp.concatenate([carry0[None], path_tail])
     if not return_score:
         return path
-    return path, jnp.max(delta_final)
+    return path, jnp.max(delta_final) + off_final
 
 
 @partial(jax.jit, static_argnames=("return_score",))

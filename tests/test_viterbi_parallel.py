@@ -122,6 +122,11 @@ def test_f32_range_normalization_survives_huge_magnitudes(rng):
     for t in range(T - 1, 0, -1):
         path[t - 1] = bps[t, path[t]]
     np.testing.assert_array_equal(p_par, path)
+    # The sequential decoder (per-step normalized delta + Kahan offset) must
+    # survive the same magnitudes.
+    p_seq, s_seq = V.viterbi(params, jnp.asarray(obs))
+    np.testing.assert_array_equal(np.asarray(p_seq), path)
+    assert float(s_seq) == pytest.approx(float(delta.max()), rel=1e-6)
 
 
 def _path_score_f64(params, obs, path):
