@@ -310,3 +310,19 @@ def test_cli_run_two_state_without_island_states_fails_at_parse_time(tmp_path):
         cli.main(["run", str(fa), str(fa), "--islands-out", str(out),
                   "--model-out", str(m), "--clean", "--preset", "two_state"])
     assert not m.exists()  # training never started
+
+
+def test_spanwise_state_path_dump_identical(tmp_path, rng):
+    """state_path_out through the span-wise decode equals the one-pass dump
+    byte for byte (the dump is the concatenated per-record MPM of the hard
+    path; spans must not perturb it)."""
+    text, _ = synth_genome(rng, n_islands=3, island_len=300, bg_len=1500)
+    fa = tmp_path / "g.txt"
+    fa.write_text(text)
+    params = presets.durbin_cpg8()
+    p1, p2 = tmp_path / "p1.npy", tmp_path / "p2.npy"
+    pipeline.decode_file(str(fa), params, compat=False, state_path_out=str(p1))
+    pipeline.decode_file(
+        str(fa), params, compat=False, state_path_out=str(p2), span=2000
+    )
+    np.testing.assert_array_equal(np.load(p1), np.load(p2))
