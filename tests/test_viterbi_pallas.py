@@ -38,7 +38,26 @@ def test_matches_oracle_small(rng):
         np.asarray(params.pi), np.asarray(params.A), np.asarray(params.B), obs
     )
     np.testing.assert_allclose(float(score), o_score, rtol=1e-5)
-    np.testing.assert_array_equal(np.asarray(path), o_path)
+    path = np.asarray(path)
+    if not np.array_equal(path, o_path):
+        # "Tie-free" holds for the f64 dirichlet draw, but the kernel runs on
+        # f32-QUANTIZED log tables, which can create exact ties the draw
+        # doesn't have (observed on TPU: a 4-position detour with bit-equal
+        # f64 score under the quantized tables).  Equal-scoring alternatives
+        # are correct Viterbi output; judge by f64 path score, not identity.
+        lp, lA, lB = (
+            np.asarray(x, np.float64)
+            for x in (params.log_pi, params.log_A, params.log_B)
+        )
+
+        def f64_score(p):
+            return (
+                lp[p[0]] + lB[p[0], obs[0]]
+                + (lA[p[:-1], p[1:]] + lB[p[1:], obs[1:]]).sum()
+            )
+
+        assert f64_score(path) == pytest.approx(f64_score(o_path), abs=1e-9)
+        assert (path == o_path).mean() > 0.9
 
 
 def test_matches_xla_parallel_exactly(rng):
