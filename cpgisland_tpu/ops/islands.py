@@ -115,6 +115,26 @@ def _empty_calls() -> IslandCalls:
     return IslandCalls(z, z, z, f, f)
 
 
+def counts_to_gc_oe(c_count, g_count, cg_count, length):
+    """(gc_content, oe_ratio) in f64 from per-run int64 counts.
+
+    THE one copy of the reference's two formulas (CpGIslandFinder.java:
+    281-283): the host caller uses it directly and the device caller's host
+    refine (islands_device._fetch_calls) uses it on compacted counts, so
+    device/host bit-identity holds by construction, not by parallel edits.
+    """
+    gc = (c_count + g_count) / length
+    both = (c_count > 0) & (g_count > 0)
+    with np.errstate(divide="ignore", invalid="ignore"):
+        oe = np.where(
+            both,
+            cg_count.astype(np.float64) * length
+            / np.where(both, c_count.astype(np.float64) * g_count, 1.0),
+            0.0,
+        )
+    return gc, oe
+
+
 def _adjacency(in_mask: np.ndarray):
     """(prev_in, opening, continuing) boundary masks for island runs."""
     T = in_mask.shape[0]
@@ -170,13 +190,7 @@ def _runs_to_calls(
     cg_count = run_sums(cg_event)
     length = last - starts + 1
 
-    gc = (c_count + g_count) / length
-    with np.errstate(divide="ignore", invalid="ignore"):
-        oe = np.where(
-            (c_count > 0) & (g_count > 0),
-            cg_count.astype(np.float64) * length / (c_count.astype(np.float64) * g_count),
-            0.0,
-        )
+    gc, oe = counts_to_gc_oe(c_count, g_count, cg_count, length)
 
     keep = (gc > gc_threshold) & (oe > oe_threshold)
     if min_len is not None:

@@ -45,12 +45,37 @@ from cpgisland_tpu.ops.islands import (
     IslandCalls,
     N_ISLAND_STATES,
     _empty_calls,
+    counts_to_gc_oe,
 )
 
 # Default maximum number of emitted calls per invocation.  Real genomes carry
 # ~25-45k CpG islands TOTAL; 128 Ki per call site is a deep safety margin and
 # costs only ~5 MB of device output buffers.
 DEFAULT_CAP = 1 << 17
+
+class IslandCapOverflow(ValueError):
+    """More island calls survived the filters than ``cap`` output slots.
+
+    Carries the true count so a caller can retry with a sufficient cap —
+    the decoded path is typically still device-resident, so the retry
+    re-runs only the (cheap) calling reduction, not the decode.
+    """
+
+    def __init__(self, n: int, cap: int):
+        super().__init__(
+            f"{n} island calls exceed cap={cap}; pass a larger cap "
+            "(each slot costs ~40 B of device output)"
+        )
+        self.n = n
+        self.cap = cap
+
+
+# Relative width of the conservative device-side band around each float
+# threshold (see _calls_from_masks): f32 gc/oe rounding is bounded by ~6e-7
+# relative, so 1e-5 is a 16x safety margin — wide enough that no true call
+# can be lost on device, narrow enough that essentially no extra compaction
+# slots are spent on borderline runs.
+_F32_BAND = 1e-5
 
 
 def _ffill_at_openings(vals, opening):
@@ -128,23 +153,29 @@ def _calls_from_masks(
         0.0,
     )
 
-    # The default gc cut evaluates integer-exactly (2*(C+G) > len avoids the
-    # f32-vs-f64 rounding flips the host caller can't see; the oe cut stays
-    # f32 — without x64 there is no wider type — which can flip calls whose
-    # oe sits within ~1e-7 of the threshold).
+    # The float cuts here are CONSERVATIVE, not final: without x64 there is
+    # no f64 on device, and f32 gc/oe carry up to ~6e-7 relative rounding
+    # (int->f32 conversions at 2^28 magnitudes plus 3 arithmetic ops).  The
+    # device keeps everything within a 1e-5 relative band of each threshold;
+    # _fetch_calls re-evaluates the survivors exactly in f64 on the host
+    # from the compacted integer counts, so the emitted set (and the
+    # published gc/oe values) are bit-identical to ops.islands.  The default
+    # gc cut evaluates integer-exactly on device (2*(C+G) > len), so it
+    # needs no band at all.
     if gc_threshold == 0.5:
         gc_pass = 2 * (c_cnt + g_cnt) > length
     else:
-        gc_pass = gc > gc_threshold
-    keep = closing & gc_pass & (oe > oe_threshold)
+        gc_pass = gc > gc_threshold - _F32_BAND * abs(gc_threshold)
+    oe_pass = oe > oe_threshold - _F32_BAND * abs(oe_threshold)
+    keep = closing & gc_pass & oe_pass
     if min_len is not None:
         keep &= length > min_len
 
     n = jnp.sum(keep.astype(jnp.int32))
-    starts_o, lasts_o, len_o, gc_o, oe_o = _compact(
-        keep, (start_idx, idx, length, gc, oe), cap
+    starts_o, lasts_o, len_o, c_o, g_o, cg_o = _compact(
+        keep, (start_idx, idx, length, c_cnt, g_cnt, cg_cnt), cap
     )
-    return starts_o, lasts_o, len_o, gc_o, oe_o, n
+    return starts_o, lasts_o, len_o, c_o, g_o, cg_o, n
 
 
 @functools.partial(
@@ -223,9 +254,12 @@ def call_islands_device(
     """Clean-mode island calls computed on device; returns host IslandCalls.
 
     ``path`` may be a device array (stays resident — only the <= ``cap``
-    records move to host) or anything jnp.asarray accepts.  Raises if more
-    than ``cap`` calls survive the filters (raise the cap; each slot costs
-    ~40 bytes of device output).
+    records move to host) or anything jnp.asarray accepts.  Raises
+    IslandCapOverflow if more than ``cap`` calls survive the filters (the
+    exception carries the true count; each slot costs ~40 bytes of device
+    output).  Emitted calls and their gc/oe values are bit-identical to
+    ops.islands.call_islands(compat=False): the float thresholds are
+    enforced in f64 on the host over the compact integer counts.
     """
     path = jnp.asarray(path)
     if path.shape[0] == 0:
@@ -233,7 +267,7 @@ def call_islands_device(
     cols = _device_calls(
         path, cap, min_len, float(gc_threshold), float(oe_threshold)
     )
-    return _fetch_calls(cols, cap, offset)
+    return _fetch_calls(cols, cap, offset, gc_threshold, oe_threshold)
 
 
 def call_islands_device_obs(
@@ -265,22 +299,39 @@ def call_islands_device_obs(
         path, obs, tuple(sorted(island_states)), cap, min_len,
         float(gc_threshold), float(oe_threshold),
     )
-    return _fetch_calls(cols, cap, offset)
+    return _fetch_calls(cols, cap, offset, gc_threshold, oe_threshold)
 
 
-def _fetch_calls(cols, cap: int, offset: int) -> IslandCalls:
-    starts, lasts, length, gc, oe, n = cols
+def _fetch_calls(
+    cols, cap: int, offset: int, gc_threshold: float, oe_threshold: float
+) -> IslandCalls:
+    """Compact device columns -> exact host IslandCalls.
+
+    The device kept every run within the conservative f32 band of the
+    thresholds; here the survivors' integer counts are re-evaluated in f64
+    with exactly ops.islands._runs_to_calls' formulas, so both the emitted
+    set and the gc/oe values match the host caller bit-for-bit (the device
+    path adds no float error of its own — only exact int32 counts cross).
+    ONE batched device_get fetches every column: seven sequential blocking
+    fetches would pay seven relay round-trips (~50-100 ms each on a
+    tunneled TPU) for ~3 MB of data."""
+    starts, lasts, length, c_cnt, g_cnt, cg_cnt, n = jax.device_get(cols)
     n = int(n)
     if n > cap:
-        raise ValueError(
-            f"{n} island calls exceed cap={cap}; pass a larger cap "
-            "(each slot costs ~40 B of device output)"
-        )
+        raise IslandCapOverflow(n, cap)
     sl = slice(0, n)
+    starts = starts[sl].astype(np.int64)
+    lasts = lasts[sl].astype(np.int64)
+    length = length[sl].astype(np.int64)
+    c_cnt = c_cnt[sl].astype(np.int64)
+    g_cnt = g_cnt[sl].astype(np.int64)
+    cg_cnt = cg_cnt[sl].astype(np.int64)
+    gc, oe = counts_to_gc_oe(c_cnt, g_cnt, cg_cnt, length)
+    keep = (gc > gc_threshold) & (oe > oe_threshold)
     return IslandCalls(
-        beg=np.asarray(starts[sl]).astype(np.int64) + offset + 1,
-        end=np.asarray(lasts[sl]).astype(np.int64) + offset + 1,
-        length=np.asarray(length[sl]).astype(np.int64),
-        gc_content=np.asarray(gc[sl]).astype(np.float64),
-        oe_ratio=np.asarray(oe[sl]).astype(np.float64),
+        beg=starts[keep] + offset + 1,
+        end=lasts[keep] + offset + 1,
+        length=length[keep],
+        gc_content=np.asarray(gc[keep], np.float64),
+        oe_ratio=np.asarray(oe[keep], np.float64),
     )

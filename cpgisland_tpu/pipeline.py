@@ -206,7 +206,10 @@ def decode_file(
 
     ``island_cap``: maximum island calls per device invocation (device
     engine only; default ops.islands_device.DEFAULT_CAP).  Batched small
-    records share one cap per flush — raise it for island-saturated inputs.
+    records share one cap per flush.  Overflow never aborts the run: the
+    pipeline retries the (cheap, device-resident) calling pass with the cap
+    raised to fit the true count, logging a warning — the default only sets
+    the initial output-buffer size.
 
     ``island_engine``: where the island caller runs in clean mode.  "device"
     keeps the decoded path on device and reduces it there
@@ -253,6 +256,9 @@ def decode_file(
         from cpgisland_tpu.ops.islands_device import DEFAULT_CAP
 
         island_cap = DEFAULT_CAP
+    # Shared across all records/flushes so a cap raised by one overflow is
+    # learned for the rest of the file (see _device_calls_retry).
+    cap_box = [island_cap]
     timer = timer if timer is not None else profiling.PhaseTimer()
     batch_decode = (
         viterbi_pallas_batch
@@ -355,14 +361,17 @@ def decode_file(
             if use_device_islands and island_states is not None:
                 from cpgisland_tpu.ops.islands_device import call_islands_device_obs
 
-                calls = call_islands_device_obs(
+                calls = _device_calls_retry(
+                    call_islands_device_obs,
                     full, jnp.asarray(symbols), island_states=island_states,
-                    min_len=min_len, cap=island_cap,
+                    min_len=min_len, cap_box=cap_box,
                 )
             elif use_device_islands:
                 from cpgisland_tpu.ops.islands_device import call_islands_device
 
-                calls = call_islands_device(full, min_len=min_len, cap=island_cap)
+                calls = _device_calls_retry(
+                    call_islands_device, full, min_len=min_len, cap_box=cap_box
+                )
             elif island_states is not None:
                 calls = islands_mod.call_islands_obs(
                     full, symbols, island_states=island_states, min_len=min_len
@@ -386,7 +395,7 @@ def decode_file(
             params, batch, batch_decode=batch_decode, min_len=min_len,
             island_states=island_states,
             use_device_islands=use_device_islands,
-            island_cap=island_cap,
+            cap_box=cap_box,
             want_paths=path_writer is not None,
             timer=timer,
         )
@@ -445,6 +454,42 @@ def _round_pow2(n: int, floor: int = 1 << 16) -> int:
     return p
 
 
+# Auto-retry never raises the cap past this: 4 Mi call slots = ~96 MB of
+# device output columns.  Real genomes carry ~25-45k islands total; a count
+# beyond 4 Mi per invocation means a degenerate input where unbounded
+# escalation would trade a clear cap error for an opaque device OOM.
+ISLAND_CAP_CEILING = 1 << 22
+
+
+def _device_calls_retry(fn, *args, cap_box: list, **kwargs):
+    """Device island calling that SURVIVES cap overflow.
+
+    IslandCapOverflow carries the true surviving-call count, so the retry
+    jumps straight to a sufficient (next-pow2) cap instead of aborting a
+    multi-minute decode with re-run advice.  The decoded path is still
+    device-resident when the overflow surfaces — only the cheap calling
+    reduction re-runs (one recompile at the new static cap), never the
+    decode itself.  ``cap_box`` is a one-element list: the grown cap is
+    written back so later records/flushes of an island-dense file start at
+    the learned size instead of re-overflowing every time.
+    """
+    from cpgisland_tpu.ops.islands_device import IslandCapOverflow
+
+    while True:
+        try:
+            return fn(*args, cap=cap_box[0], **kwargs)
+        except IslandCapOverflow as e:
+            if e.n > ISLAND_CAP_CEILING:
+                raise IslandCapOverflow(e.n, cap_box[0]) from None
+            new_cap = _round_pow2(e.n + 1, floor=2 * cap_box[0])
+            log.warning(
+                "island calls (%d) overflowed cap=%d; retrying the on-device "
+                "calling pass with cap=%d (decode not re-run)",
+                e.n, cap_box[0], new_cap,
+            )
+            cap_box[0] = new_cap
+
+
 def _decode_small_batch(
     params: HmmParams,
     batch: list,
@@ -453,7 +498,7 @@ def _decode_small_batch(
     min_len,
     island_states,
     use_device_islands: bool,
-    island_cap: int,
+    cap_box: list,
     want_paths: bool,
     timer: profiling.PhaseTimer,
 ):
@@ -519,12 +564,15 @@ def _decode_small_batch(
                 obs_flat = jnp.concatenate(
                     [obs_dev, jnp.zeros((Bp, 1), obs_dev.dtype)], axis=1
                 ).reshape(-1)
-                all_calls = call_islands_device_obs(
+                all_calls = _device_calls_retry(
+                    call_islands_device_obs,
                     flat, obs_flat, island_states=island_states,
-                    min_len=min_len, cap=island_cap,
+                    min_len=min_len, cap_box=cap_box,
                 )
             else:
-                all_calls = call_islands_device(flat, min_len=min_len, cap=island_cap)
+                all_calls = _device_calls_retry(
+                    call_islands_device, flat, min_len=min_len, cap_box=cap_box
+                )
             rec_of = (all_calls.beg - 1) // stride
             for i, (name, _) in enumerate(batch):
                 sel = rec_of == i
@@ -651,7 +699,8 @@ def posterior_file(
     def emit(conf, path) -> None:
         nonlocal conf_total
         conf = np.asarray(conf)
-        conf_total += float(conf.sum())
+        # f64 accumulation: float32 partial sums drift ~1e-5 at multi-Gbase.
+        conf_total += float(conf.sum(dtype=np.float64))
         conf_w.write(conf)
         if path_w is not None:
             path_w.write(np.asarray(path).astype(np.int8))
@@ -785,7 +834,14 @@ def posterior_file(
             # span (tiny [K]x[K,K] chains, f32 on normalized operators).
             pi = np.exp(np.asarray(params.log_pi, np.float64))
             B = np.exp(np.asarray(params.log_B, np.float64))
-            v = pi * B[:, int(symbols[0])]
+            # Emission folded in only for in-range first symbols, mirroring
+            # the decode twin (viterbi_sharded_spans) — robustness only;
+            # clean-mode FASTA symbols are always 0..3.
+            v = (
+                pi * B[:, int(symbols[0])]
+                if int(symbols[0]) < params.n_symbols
+                else pi
+            )
             enters = [(v / v.sum()).astype(np.float32)]
             for s in range(n_spans - 1):
                 v = enters[-1] @ totals[s]

@@ -350,31 +350,47 @@ def symbol_cache_paths(cache: str) -> tuple[str, str]:
 def write_symbol_cache(path: str, cache: str) -> int:
     """Encode ``path`` (FASTA-aware) into a symbol cache at prefix ``cache``.
 
-    Returns the total symbol count.  Writing is atomic enough for repeat-run
-    use: the metadata file (which validation requires) is written last.
+    Returns the total symbol count.  Both sidecars are built under temp
+    names and ``os.rename``d into place (symbols first, metadata last): a
+    concurrent reader that already validated the cache keeps its open memmap
+    of the OLD symbols file (the rename unlinks the name, not the inode),
+    and validation can never observe a metadata file whose symbols aren't
+    fully in place.  Multi-process jobs sharing a cache prefix on one FS are
+    therefore safe without external locking.
     """
     from cpgisland_tpu.utils.npystream import NpyStreamWriter
 
     sym_p, meta_p = symbol_cache_paths(cache)
+    # Temp names keep the real extensions (np.savez appends ".npz" to names
+    # without it) and carry the pid so concurrent builders never collide.
+    sym_tmp = f"{cache}.tmp.{os.getpid()}.symbols.npy"
+    meta_tmp = f"{cache}.tmp.{os.getpid()}.meta.npz"
     # Fingerprint BEFORE the parse: a source replaced mid-encode must leave
     # a cache that validates as STALE (old fingerprint vs new file), never
     # one that matches the new file while holding the old file's symbols.
     fp = _source_fingerprint(path)
     names: list[str] = []
     offsets: list[int] = [0]
-    with NpyStreamWriter(sym_p, np.uint8) as w:
-        for name, syms in iter_fasta_records(path):
-            names.append(name)
-            w.write(syms)
-            offsets.append(w.count)
-        total = w.count
-    np.savez(
-        meta_p,
-        version=_CACHE_VERSION,
-        names=np.asarray(names, dtype=object),
-        offsets=np.asarray(offsets, dtype=np.int64),
-        **fp,
-    )
+    try:
+        with NpyStreamWriter(sym_tmp, np.uint8) as w:
+            for name, syms in iter_fasta_records(path):
+                names.append(name)
+                w.write(syms)
+                offsets.append(w.count)
+            total = w.count
+        np.savez(
+            meta_tmp,
+            version=_CACHE_VERSION,
+            names=np.asarray(names, dtype=object),
+            offsets=np.asarray(offsets, dtype=np.int64),
+            **fp,
+        )
+        os.rename(sym_tmp, sym_p)
+        os.rename(meta_tmp, meta_p)
+    finally:
+        for p in (sym_tmp, meta_tmp):
+            if os.path.exists(p):
+                os.unlink(p)
     return total
 
 
