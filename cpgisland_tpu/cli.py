@@ -149,8 +149,9 @@ def build_parser() -> argparse.ArgumentParser:
     po.add_argument("test_file")
     po.add_argument("--model", help="model text file (default: the --preset model)")
     po.add_argument(
-        "--confidence-out", required=True,
-        help=".npy of float32 P(in island) per symbol",
+        "--confidence-out",
+        help=".npy of float32 P(in island) per symbol (optional: an "
+        "--islands-out-only run writes no per-symbol file at all)",
     )
     po.add_argument(
         "--mpm-path-out",
@@ -158,11 +159,20 @@ def build_parser() -> argparse.ArgumentParser:
     )
     po.add_argument(
         "--islands-out",
-        help="also call CpG islands from the MPM path (clean semantics, "
-        "decode-format records) — the soft counterpart of `decode`",
+        help="call CpG islands from the MPM path (clean semantics, "
+        "decode-format records) — the soft counterpart of `decode`; may be "
+        "the ONLY output (island-only runs skip the confidence dump and, "
+        "on TPU, reduce the path to call records on device)",
     )
     po.add_argument("--min-len", type=int, default=None,
                     help="minimum island length for --islands-out")
+    po.add_argument(
+        "--island-engine",
+        choices=("auto", "host", "device"),
+        default="auto",
+        help="island caller placement: device keeps the MPM path on-chip and "
+        "returns only the call records (auto: device on TPU when eligible)",
+    )
     _add_island_states_flag(po)
     # Only the flags posterior honors (it is always clean/FASTA-aware) — NOT
     # _common_flags, whose --backend/--numerics/--clean would be silently
@@ -304,6 +314,11 @@ def _run_command(args, compat, pipeline, presets, load_text) -> int:
     if args.cmd == "posterior":
         if args.min_len is not None and not args.islands_out:
             build_parser().error("--min-len only applies with --islands-out")
+        if not (args.confidence_out or args.mpm_path_out or args.islands_out):
+            build_parser().error(
+                "nothing to do: pass --confidence-out, --mpm-path-out, "
+                "and/or --islands-out"
+            )
         island_states = _parse_island_states(build_parser(), args, compat=False)
         params = load_text(args.model) if args.model else _preset_params(presets, args.preset)
         if island_states is None:
@@ -319,6 +334,7 @@ def _run_command(args, compat, pipeline, presets, load_text) -> int:
             min_len=args.min_len,
             island_states=island_states,
             engine=args.engine,
+            island_engine=args.island_engine,
             symbol_cache=args.symbol_cache,
         )
         extra = (

@@ -228,37 +228,16 @@ def decode_file(
     err = island_layout_error(params, island_states)
     if err:
         raise ValueError(err)
-    if island_engine not in ("auto", "host", "device"):
-        raise ValueError(f"island_engine must be auto|host|device, got {island_engine!r}")
-    device_eligible = not compat and state_path_out is None
-    if island_engine == "device" and not device_eligible:
-        raise ValueError(
+    use_device_islands, cap_box = _resolve_island_engine(
+        island_engine,
+        device_eligible=not compat and state_path_out is None,
+        ineligible_msg=(
             "island_engine='device' implements clean-mode calling without a "
             "state-path dump (compat quirk reproduction and path dumps are "
             "host-side)"
-        )
-    if island_engine == "device" and jax.process_count() > 1:
-        # viterbi_sharded(return_device=True) on a multi-host global mesh
-        # yields a non-fully-addressable path array whose [cap] record-column
-        # fetch (islands_device) is not certified there — only the host path
-        # got the process_allgather treatment.
-        raise ValueError(
-            "island_engine='device' is single-process only for now; use "
-            "'host' (or 'auto') in multi-host jobs"
-        )
-    use_device_islands = island_engine == "device" or (
-        island_engine == "auto"
-        and device_eligible
-        and jax.default_backend() == "tpu"
-        and jax.process_count() == 1
+        ),
+        island_cap=island_cap,
     )
-    if island_cap is None:
-        from cpgisland_tpu.ops.islands_device import DEFAULT_CAP
-
-        island_cap = DEFAULT_CAP
-    # Shared across all records/flushes so a cap raised by one overflow is
-    # learned for the rest of the file (see _device_calls_retry).
-    cap_box = [island_cap]
     timer = timer if timer is not None else profiling.PhaseTimer()
     batch_decode = (
         viterbi_pallas_batch
@@ -461,6 +440,47 @@ def _round_pow2(n: int, floor: int = 1 << 16) -> int:
 ISLAND_CAP_CEILING = 1 << 22
 
 
+def _resolve_island_engine(
+    island_engine: str,
+    *,
+    device_eligible: bool,
+    ineligible_msg: str,
+    island_cap: Optional[int],
+):
+    """(use_device_islands, cap_box) — THE island-engine policy, shared by
+    decode_file and posterior_file so the two pipelines cannot diverge.
+
+    Multi-host note: a device path on a multi-host global mesh is
+    non-fully-addressable and its [cap] record-column fetch (islands_device)
+    is not certified there — only the host path got the process_allgather
+    treatment — hence the single-process restriction.
+    """
+    if island_engine not in ("auto", "host", "device"):
+        raise ValueError(
+            f"island_engine must be auto|host|device, got {island_engine!r}"
+        )
+    if island_engine == "device" and not device_eligible:
+        raise ValueError(ineligible_msg)
+    if island_engine == "device" and jax.process_count() > 1:
+        raise ValueError(
+            "island_engine='device' is single-process only for now; use "
+            "'host' (or 'auto') in multi-host jobs"
+        )
+    use_device_islands = island_engine == "device" or (
+        island_engine == "auto"
+        and device_eligible
+        and jax.default_backend() == "tpu"
+        and jax.process_count() == 1
+    )
+    if island_cap is None:
+        from cpgisland_tpu.ops.islands_device import DEFAULT_CAP
+
+        island_cap = DEFAULT_CAP
+    # The cap_box is shared across all records/flushes of one run so a cap
+    # raised by one overflow is learned for the rest (_device_calls_retry).
+    return use_device_islands, [island_cap]
+
+
 def _device_calls_retry(fn, *args, cap_box: list, **kwargs):
     """Device island calling that SURVIVES cap overflow.
 
@@ -490,6 +510,72 @@ def _device_calls_retry(fn, *args, cap_box: list, **kwargs):
             cap_box[0] = new_cap
 
 
+def _batched_device_calls(
+    params: HmmParams,
+    paths,
+    rows: np.ndarray,
+    lengths: np.ndarray,
+    batch: list,
+    *,
+    island_states,
+    min_len,
+    cap_box: list,
+) -> list:
+    """ONE device island call over a padded [Bp, Tpad] batch of paths.
+
+    Masked tail positions and one separator column become a non-island
+    state so runs can never cross records; each emitted call's record is
+    recovered from its coordinate.  The shared kernel of the batched decode
+    AND batched posterior paths — only the compact call records cross to
+    the host.  Returns per-record IslandCalls in batch order.
+    """
+    from cpgisland_tpu.ops.islands import N_ISLAND_STATES
+    from cpgisland_tpu.ops.islands_device import (
+        call_islands_device,
+        call_islands_device_obs,
+    )
+
+    Bp, Tpad = paths.shape
+    stride = Tpad + 1
+    mask = jnp.arange(Tpad)[None, :] < jnp.asarray(lengths)[:, None]
+    # Masked tails/separators become a non-island state so runs can never
+    # cross records: the background sentinel is N_ISLAND_STATES for the
+    # 8-state labeling, n_states (an id no model state uses) for arbitrary
+    # island_states sets.
+    fill = N_ISLAND_STATES if island_states is None else params.n_states
+    masked = jnp.where(mask, paths, fill)
+    sep = jnp.full((Bp, 1), fill, masked.dtype)
+    flat = jnp.concatenate([masked, sep], axis=1).reshape(-1)
+    if island_states is not None:
+        obs_dev = jnp.asarray(rows)
+        obs_flat = jnp.concatenate(
+            [obs_dev, jnp.zeros((Bp, 1), obs_dev.dtype)], axis=1
+        ).reshape(-1)
+        all_calls = _device_calls_retry(
+            call_islands_device_obs,
+            flat, obs_flat, island_states=island_states,
+            min_len=min_len, cap_box=cap_box,
+        )
+    else:
+        all_calls = _device_calls_retry(
+            call_islands_device, flat, min_len=min_len, cap_box=cap_box
+        )
+    rec_of = (all_calls.beg - 1) // stride
+    parts = []
+    for i, (name, _) in enumerate(batch):
+        sel = rec_of == i
+        parts.append(
+            IslandCalls(
+                beg=all_calls.beg[sel] - i * stride,
+                end=all_calls.end[sel] - i * stride,
+                length=all_calls.length[sel],
+                gc_content=all_calls.gc_content[sel],
+                oe_ratio=all_calls.oe_ratio[sel],
+            ).with_names(name or ".")
+        )
+    return parts
+
+
 def _decode_small_batch(
     params: HmmParams,
     batch: list,
@@ -506,13 +592,10 @@ def _decode_small_batch(
 
     Rows pad to a power-of-two time bucket and a fixed row count so the
     compile cache stays small across many scaffold shapes.  With device
-    islands the whole padded batch flattens into ONE island call: masked
-    tail positions become background state, plus one separator column, so
-    runs can never cross records and each call's record is recovered from
-    its coordinate.  Returns (n_spans, [IslandCalls per record], [paths]).
+    islands the whole padded batch flattens into ONE island call
+    (_batched_device_calls).  Returns (n_spans, [IslandCalls per record],
+    [paths]).
     """
-    from cpgisland_tpu.ops.islands import N_ISLAND_STATES
-
     B = len(batch)
     sizes = [s.size for _, s in batch]
     Tpad = _round_pow2(max(sizes + [1]))
@@ -542,49 +625,10 @@ def _decode_small_batch(
     paths_out: list[np.ndarray] = []
     with timer.phase("islands", items=total, unit="sym"):
         if use_device_islands:
-            from cpgisland_tpu.ops.islands_device import (
-                call_islands_device,
-                call_islands_device_obs,
+            parts = _batched_device_calls(
+                params, paths, rows, lengths, batch,
+                island_states=island_states, min_len=min_len, cap_box=cap_box,
             )
-
-            stride = Tpad + 1
-            mask = jnp.arange(Tpad)[None, :] < jnp.asarray(lengths)[:, None]
-            # Masked tails/separators become a non-island state so runs can
-            # never cross records: the background sentinel is
-            # N_ISLAND_STATES for the 8-state labeling, n_states (an id no
-            # model state uses) for arbitrary island_states sets.
-            fill = (
-                N_ISLAND_STATES if island_states is None else params.n_states
-            )
-            masked = jnp.where(mask, paths, fill)
-            sep = jnp.full((Bp, 1), fill, masked.dtype)
-            flat = jnp.concatenate([masked, sep], axis=1).reshape(-1)
-            if island_states is not None:
-                obs_dev = jnp.asarray(rows)
-                obs_flat = jnp.concatenate(
-                    [obs_dev, jnp.zeros((Bp, 1), obs_dev.dtype)], axis=1
-                ).reshape(-1)
-                all_calls = _device_calls_retry(
-                    call_islands_device_obs,
-                    flat, obs_flat, island_states=island_states,
-                    min_len=min_len, cap_box=cap_box,
-                )
-            else:
-                all_calls = _device_calls_retry(
-                    call_islands_device, flat, min_len=min_len, cap_box=cap_box
-                )
-            rec_of = (all_calls.beg - 1) // stride
-            for i, (name, _) in enumerate(batch):
-                sel = rec_of == i
-                parts.append(
-                    IslandCalls(
-                        beg=all_calls.beg[sel] - i * stride,
-                        end=all_calls.end[sel] - i * stride,
-                        length=all_calls.length[sel],
-                        gc_content=all_calls.gc_content[sel],
-                        oe_ratio=all_calls.oe_ratio[sel],
-                    ).with_names(name or ".")
-                )
         else:
             for i, (name, symbols) in enumerate(batch):
                 row = paths[i, : symbols.size]
@@ -630,13 +674,15 @@ def posterior_file(
     test_path: str,
     params: HmmParams,
     *,
-    confidence_out: str,
+    confidence_out: Optional[str] = None,
     mpm_path_out: Optional[str] = None,
     islands_out: Optional[Union[str, IO[str]]] = None,
     min_len: Optional[int] = None,
     island_states=None,
     span: int = POSTERIOR_SPAN,
     engine: str = "auto",
+    island_engine: str = "auto",
+    island_cap: Optional[int] = None,
     symbol_cache: Optional[str] = None,
     metrics: Optional[profiling.MetricsLogger] = None,
     timer: Optional[profiling.PhaseTimer] = None,
@@ -647,17 +693,27 @@ def posterior_file(
     (HmmEvaluator.decode, CpGIslandFinder.java:260); this is its soft
     completion — P(position is in an island | whole record) = the summed
     posterior marginal over the island states, written as one float32 per
-    symbol (.npy, streamed record by record).  ``mpm_path_out`` additionally
-    writes the max-posterior-marginal state path (int8), the soft
-    counterpart of decode_file's ``state_path_out``; ``islands_out`` calls
-    CpG islands from that MPM path (clean semantics, per record, same
-    ``beg end len gc oe`` format as decode_file) — the full soft
-    counterpart of the reference's Viterbi -> island-caller pipeline
-    (CpGIslandFinder.java:260-339), with ``min_len`` available.
+    symbol (.npy, streamed record by record) when ``confidence_out`` is
+    given.  ``mpm_path_out`` additionally writes the
+    max-posterior-marginal state path (int8), the soft counterpart of
+    decode_file's ``state_path_out``; ``islands_out`` calls CpG islands
+    from that MPM path (clean semantics, per record, same ``beg end len gc
+    oe`` format as decode_file) — the full soft counterpart of the
+    reference's Viterbi -> island-caller pipeline
+    (CpGIslandFinder.java:260-339), with ``min_len`` available.  At least
+    one of the three outputs must be requested; an island-only run
+    (``islands_out`` alone) writes NO per-symbol file and — with the
+    device island engine — transfers no per-symbol array to the host
+    either, so its I/O cost is the compact call records, not 4 B/symbol.
 
     ``island_states``: which states count as "island" (same contract as
     decode_file's flag); default = the first n_symbols states, the
     reference's 2M-state X+/X- labeling, which the model must then match.
+
+    ``island_engine``/``island_cap``: same contract as decode_file —
+    "device" reduces the MPM path to compact call records on device
+    (requires ``islands_out`` without ``mpm_path_out``); "auto" picks
+    device on single-process TPU when eligible; cap overflow auto-retries.
 
     Clean semantics only (FASTA-aware, per-record).  Every record runs
     through the lane-parallel forward-backward machinery
@@ -683,8 +739,26 @@ def posterior_file(
         island_states = tuple(range(params.n_symbols))
     island_states = tuple(sorted(island_states))
     timer = timer if timer is not None else profiling.PhaseTimer()
+    want_conf = confidence_out is not None
     want_islands = islands_out is not None
     want_path = mpm_path_out is not None or want_islands
+    if not (want_conf or want_path):
+        raise ValueError(
+            "posterior: nothing to do — request confidence_out, "
+            "mpm_path_out, and/or islands_out"
+        )
+    use_device_islands, cap_box = _resolve_island_engine(
+        island_engine,
+        # The MPM path can stay device-resident only when nothing else
+        # needs it on the host (the int8 dump is host-side).
+        device_eligible=want_islands and mpm_path_out is None,
+        ineligible_msg=(
+            "island_engine='device' reduces the MPM path on device — it "
+            "needs islands_out and no mpm_path_out (the path dump is "
+            "host-side)"
+        ),
+        island_cap=island_cap,
+    )
     # Small records batch into one chunked-layout kernel pass (pallas only;
     # the XLA lane path serves one record at a time).
     batch_small = resolve_fb_engine(engine, params) == "pallas"
@@ -697,29 +771,61 @@ def posterior_file(
     conf_total = 0.0
 
     def emit(conf, path) -> None:
+        """Book host-side per-symbol outputs.  ``conf=None`` means the
+        confidence stayed on device (island-only device runs) and was
+        already accumulated by accum_conf_device."""
         nonlocal conf_total
-        conf = np.asarray(conf)
-        # f64 accumulation: float32 partial sums drift ~1e-5 at multi-Gbase.
-        conf_total += float(conf.sum(dtype=np.float64))
-        conf_w.write(conf)
-        if path_w is not None:
+        if conf is not None:
+            conf = np.asarray(conf)
+            # f64 accumulation: float32 partials drift ~1e-5 at multi-Gbase.
+            conf_total += float(conf.sum(dtype=np.float64))
+            if conf_w is not None:
+                conf_w.write(conf)
+        if path_w is not None and path is not None:
             path_w.write(np.asarray(path).astype(np.int8))
+
+    conf_dev_acc = None  # device-resident f32 running sum (island-only mode)
+
+    def accum_conf_device(conf) -> None:
+        """Mean-confidence contribution of a device-resident conf array.
+        The sum accumulates ON DEVICE (async dispatch, no blocking fetch per
+        span/record); ONE scalar crosses to the host at end of file."""
+        nonlocal conf_dev_acc
+        s = jnp.sum(conf)
+        conf_dev_acc = s if conf_dev_acc is None else conf_dev_acc + s
 
     call_parts: list[IslandCalls] = []
 
     def call_rec(rec_name: str, symbols: np.ndarray, path) -> None:
-        """MPM-path island calls for one whole record (clean semantics)."""
+        """MPM-path island calls for one whole record (clean semantics).
+        With the device engine ``path`` is a device array and only the
+        compact call records cross to the host."""
         if not want_islands:
             return
-        path = np.asarray(path)
-        if obs_based_calls:
+        if use_device_islands:
+            from cpgisland_tpu.ops.islands_device import (
+                call_islands_device,
+                call_islands_device_obs,
+            )
+
+            if obs_based_calls:
+                calls = _device_calls_retry(
+                    call_islands_device_obs,
+                    path, jnp.asarray(symbols), island_states=island_states,
+                    min_len=min_len, cap_box=cap_box,
+                )
+            else:
+                calls = _device_calls_retry(
+                    call_islands_device, path, min_len=min_len, cap_box=cap_box
+                )
+        elif obs_based_calls:
             calls = islands_mod.call_islands_obs(
-                path, np.asarray(symbols), island_states=island_states,
-                min_len=min_len,
+                np.asarray(path), np.asarray(symbols),
+                island_states=island_states, min_len=min_len,
             )
         else:
             calls = islands_mod.call_islands(
-                path, chunk=0, compat=False, min_len=min_len
+                np.asarray(path), chunk=0, compat=False, min_len=min_len
             )
         call_parts.append(calls.with_names(rec_name or "."))
 
@@ -743,6 +849,7 @@ def posterior_file(
         for i, (_, s) in enumerate(batch):
             by_class.setdefault(_round_pow2(s.size, floor=1 << 14), []).append(i)
         results: list = [None] * len(batch)
+        rec_calls: list = [None] * len(batch)
         # Device-memory budget per kernel call, in PADDED symbols: the fused
         # conf path streams ~36 B/padded-symbol; want_path materializes both
         # alpha AND beta streams (~72 B), so it gets half the budget.
@@ -766,32 +873,73 @@ def posterior_file(
                         jnp.asarray(island_mask(params, island_states)),
                         want_path=want_path,
                     )
-                    conf2 = np.asarray(conf2)
-                    path2 = np.asarray(path2) if want_path else None
-                for g, i in enumerate(group):
-                    n = batch[i][1].size
-                    results[i] = (
-                        conf2[g, :n],
-                        path2[g, :n] if want_path else None,
-                    )
-        for (name, s), (conf, path) in zip(batch, results):
+                    if use_device_islands:
+                        # conf/path stay device-resident; block so the
+                        # kernel time is billed to this phase.
+                        jax.block_until_ready(path2)
+                    else:
+                        conf2 = np.asarray(conf2)
+                        path2 = np.asarray(path2) if want_path else None
+                if use_device_islands:
+                    with timer.phase("islands", items=total, unit="sym"):
+                        g_calls = _batched_device_calls(
+                            params, path2, rows, lens,
+                            [batch[i] for i in group],
+                            island_states=(
+                                island_states if obs_based_calls else None
+                            ),
+                            min_len=min_len, cap_box=cap_box,
+                        )
+                    if want_conf:
+                        conf_host = np.asarray(conf2)
+                    else:
+                        in_rec = (
+                            jnp.arange(Tpad)[None, :]
+                            < jnp.asarray(lens)[:, None]
+                        )
+                        accum_conf_device(jnp.where(in_rec, conf2, 0.0))
+                    for g, i in enumerate(group):
+                        n = batch[i][1].size
+                        results[i] = (
+                            conf_host[g, :n] if want_conf else None, None
+                        )
+                        rec_calls[i] = g_calls[g]
+                else:
+                    for g, i in enumerate(group):
+                        n = batch[i][1].size
+                        results[i] = (
+                            conf2[g, :n],
+                            path2[g, :n] if want_path else None,
+                        )
+        for i, ((name, s), (conf, path)) in enumerate(zip(batch, results)):
             emit(conf, path)
-            call_rec(name, s, path)
+            if use_device_islands:
+                call_parts.append(rec_calls[i])
+            else:
+                call_rec(name, s, path)
 
     def one_record(rec_name: str, symbols: np.ndarray) -> None:
         with timer.phase("posterior", items=float(symbols.size), unit="sym"):
             conf, path = posterior_sharded(
                 params, symbols, island_states,
                 engine=engine, want_path=want_path,
+                return_device=use_device_islands,
                 # Power-of-two buckets: scaffold-heavy files must not
                 # compile once per distinct record size.
                 pad_to=_round_pow2(symbols.size, floor=1 << 14),
             )
-        emit(conf, path)
+        if use_device_islands:
+            if want_conf:
+                emit(np.asarray(conf), None)
+            else:
+                accum_conf_device(conf)
+        else:
+            emit(conf, path)
         call_rec(rec_name, symbols, path)
 
     try:
-        conf_w = NpyStreamWriter(confidence_out, np.float32)
+        if confidence_out is not None:
+            conf_w = NpyStreamWriter(confidence_out, np.float32)
         if mpm_path_out is not None:
             path_w = NpyStreamWriter(mpm_path_out, np.int8)
         for rec_name, symbols in codec.iter_fasta_records_cached(
@@ -853,7 +1001,7 @@ def posterior_file(
                 e = (e / e.sum()).astype(np.float32)
                 exits[s] = e
             # Sweep B: full posterior per span with the threaded messages.
-            rec_path_parts: list[np.ndarray] = []
+            rec_path_parts: list = []
             for s in range(n_spans):
                 lo = s * span
                 piece = symbols[lo : lo + span]
@@ -863,20 +1011,37 @@ def posterior_file(
                         enter_dir=None if s == 0 else enters[s],
                         exit_dir=exits[s], first=s == 0,
                         want_path=want_path, pad_to=span,
+                        return_device=use_device_islands,
                     )
-                emit(conf, path)
-                if want_islands:
-                    rec_path_parts.append(np.asarray(path).astype(np.int8))
+                if use_device_islands:
+                    if want_conf:
+                        emit(np.asarray(conf), None)
+                    else:
+                        accum_conf_device(conf)
+                    if want_islands:
+                        rec_path_parts.append(path)
+                else:
+                    emit(conf, path)
+                    if want_islands:
+                        rec_path_parts.append(np.asarray(path).astype(np.int8))
             if want_islands:
                 # Islands are called over the WHOLE record's MPM path so a
-                # run crossing a span boundary is never clipped.
-                call_rec(rec_name, symbols, np.concatenate(rec_path_parts))
+                # run crossing a span boundary is never clipped (device
+                # engine: spans concatenate ON device, like decode's span
+                # path, and only compact calls cross to the host).
+                full_path = (
+                    jnp.concatenate(rec_path_parts) if use_device_islands
+                    else np.concatenate(rec_path_parts)
+                )
+                call_rec(rec_name, symbols, full_path)
         flush_small()
     finally:
         if conf_w is not None:
             conf_w.close()
         if path_w is not None:
             path_w.close()
+    if conf_dev_acc is not None:
+        conf_total += float(conf_dev_acc)  # the one end-of-file scalar fetch
     mean_conf = conf_total / n_sym if n_sym else 0.0
     calls_all = None
     if want_islands:
