@@ -302,6 +302,23 @@ def call_islands_device_obs(
     return _fetch_calls(cols, cap, offset, gc_threshold, oe_threshold)
 
 
+def _cols_to_host(cols):
+    """One batched host fetch of the device call columns.
+
+    Multi-host: columns computed from a global-mesh path carry the global
+    device assignment (non-fully-addressable), so a plain fetch raises;
+    gather every process a full replica in ONE collective — the columns are
+    ~3 MB and every process needs the same call records for its own output
+    anyway.  This is the [cap]-record-column twin of
+    parallel.mesh.fetch_sharded_prefix's multi-host rule.
+    """
+    if any(not getattr(c, "is_fully_addressable", True) for c in cols):
+        from jax.experimental import multihost_utils
+
+        return multihost_utils.process_allgather(tuple(cols), tiled=True)
+    return jax.device_get(cols)
+
+
 def _fetch_calls(
     cols, cap: int, offset: int, gc_threshold: float, oe_threshold: float
 ) -> IslandCalls:
@@ -312,10 +329,10 @@ def _fetch_calls(
     with exactly ops.islands._runs_to_calls' formulas, so both the emitted
     set and the gc/oe values match the host caller bit-for-bit (the device
     path adds no float error of its own — only exact int32 counts cross).
-    ONE batched device_get fetches every column: seven sequential blocking
-    fetches would pay seven relay round-trips (~50-100 ms each on a
-    tunneled TPU) for ~3 MB of data."""
-    starts, lasts, length, c_cnt, g_cnt, cg_cnt, n = jax.device_get(cols)
+    ONE batched fetch moves every column: seven sequential blocking fetches
+    would pay seven relay round-trips (~50-100 ms each on a tunneled TPU)
+    for ~3 MB of data."""
+    starts, lasts, length, c_cnt, g_cnt, cg_cnt, n = _cols_to_host(cols)
     n = int(n)
     if n > cap:
         raise IslandCapOverflow(n, cap)

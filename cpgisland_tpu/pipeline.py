@@ -218,7 +218,9 @@ def decode_file(
     itself.  Both the 8-state labeling and observation-based
     ``island_states`` sets run on device (the latter via
     call_islands_device_obs).  "host" is the NumPy caller; "auto" picks
-    device on TPU (single-process) when no state-path dump is requested.
+    device on TPU when no state-path dump is requested.  Multi-host: the
+    compact call columns are gathered to every process in one collective
+    (certified by the 2-process test).
     """
     if island_states is not None and compat:
         raise ValueError("island_states needs clean mode (compat=False); the "
@@ -450,10 +452,10 @@ def _resolve_island_engine(
     """(use_device_islands, cap_box) — THE island-engine policy, shared by
     decode_file and posterior_file so the two pipelines cannot diverge.
 
-    Multi-host note: a device path on a multi-host global mesh is
-    non-fully-addressable and its [cap] record-column fetch (islands_device)
-    is not certified there — only the host path got the process_allgather
-    treatment — hence the single-process restriction.
+    Works multi-host: a device path on a multi-host global mesh reduces to
+    non-fully-addressable [cap] record columns, which islands_device
+    gathers to every process in one collective (_cols_to_host) — certified
+    by the real 2-process test (tests/test_multihost_real.py).
     """
     if island_engine not in ("auto", "host", "device"):
         raise ValueError(
@@ -461,16 +463,10 @@ def _resolve_island_engine(
         )
     if island_engine == "device" and not device_eligible:
         raise ValueError(ineligible_msg)
-    if island_engine == "device" and jax.process_count() > 1:
-        raise ValueError(
-            "island_engine='device' is single-process only for now; use "
-            "'host' (or 'auto') in multi-host jobs"
-        )
     use_device_islands = island_engine == "device" or (
         island_engine == "auto"
         and device_eligible
         and jax.default_backend() == "tpu"
-        and jax.process_count() == 1
     )
     if island_cap is None:
         from cpgisland_tpu.ops.islands_device import DEFAULT_CAP
@@ -713,7 +709,8 @@ def posterior_file(
     ``island_engine``/``island_cap``: same contract as decode_file —
     "device" reduces the MPM path to compact call records on device
     (requires ``islands_out`` without ``mpm_path_out``); "auto" picks
-    device on single-process TPU when eligible; cap overflow auto-retries.
+    device on TPU when eligible (multi-host included); cap overflow
+    auto-retries.
 
     Clean semantics only (FASTA-aware, per-record).  Every record runs
     through the lane-parallel forward-backward machinery
@@ -723,6 +720,7 @@ def posterior_file(
     directions threaded between them — EXACT posteriors at any length; the
     span only bounds peak device memory.
     """
+    from cpgisland_tpu.parallel.mesh import fetch_sharded_prefix
     from cpgisland_tpu.parallel.posterior import (
         island_mask,
         posterior_sharded,
@@ -730,6 +728,14 @@ def posterior_file(
         transfer_total_sharded,
     )
     from cpgisland_tpu.utils.npystream import NpyStreamWriter
+
+    def conf_to_host(conf) -> np.ndarray:
+        """Host-fetch a device-resident conf array (already length-trimmed)
+        under the multi-host rule: a global-mesh array spanning
+        non-addressable devices gathers via process_allgather, a local one
+        fetches directly — the same rule fetch_sharded_prefix applies on
+        the host-return path."""
+        return fetch_sharded_prefix(conf, conf.shape[0], False)
 
     obs_based_calls = island_states is not None  # user-named island states
     if island_states is None:
@@ -930,7 +936,7 @@ def posterior_file(
             )
         if use_device_islands:
             if want_conf:
-                emit(np.asarray(conf), None)
+                emit(conf_to_host(conf), None)
             else:
                 accum_conf_device(conf)
         else:
@@ -1015,11 +1021,15 @@ def posterior_file(
                     )
                 if use_device_islands:
                     if want_conf:
-                        emit(np.asarray(conf), None)
+                        emit(conf_to_host(conf), None)
                     else:
                         accum_conf_device(conf)
                     if want_islands:
-                        rec_path_parts.append(path)
+                        # int8 on device, like the host twin below: a
+                        # multi-span record accumulates its whole path —
+                        # 4x matters exactly at the long-record scale the
+                        # span exists to bound (state ids are 0..K-1 < 128).
+                        rec_path_parts.append(path.astype(jnp.int8))
                 else:
                     emit(conf, path)
                     if want_islands:

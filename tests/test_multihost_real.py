@@ -110,6 +110,66 @@ WORKER = textwrap.dedent(
     )
     assert conf.shape == obs.shape
 
+    # DEVICE island calling on the multi-host global mesh (r4: the
+    # single-process refusal is gone): the decoded path stays a
+    # non-fully-addressable global array; only the compact [cap] call
+    # columns are gathered — and they must equal the host caller run on
+    # the (allgathered) same path.
+    from jax.experimental import multihost_utils
+
+    from cpgisland_tpu.ops import islands as host_islands
+    from cpgisland_tpu.ops.islands_device import call_islands_device
+
+    unit = np.array(([1] * 40 + [6] * 24) * 64, np.int32)  # planted runs
+    obs_isl = np.where(unit == 1,
+                       rng.integers(1, 3, size=unit.size),
+                       rng.integers(0, 4, size=unit.size)).astype(np.int32)
+    dev_path = viterbi_sharded(
+        presets.durbin_cpg8(), obs_isl,
+        mesh=make_mesh(8, axis="seq"), block_size=128, return_device=True,
+    )
+    assert not dev_path.is_fully_addressable  # really the global-mesh case
+    dev_calls = call_islands_device(dev_path)
+    host_calls = host_islands.call_islands(
+        multihost_utils.process_allgather(dev_path, tiled=True), compat=False
+    )
+    assert len(dev_calls) > 0
+    assert np.array_equal(dev_calls.beg, host_calls.beg)
+    assert np.array_equal(dev_calls.end, host_calls.end)
+    assert np.array_equal(dev_calls.oe_ratio, host_calls.oe_ratio)
+
+    # posterior_file END-TO-END on the multi-host mesh with the device
+    # island engine, confidence dump, and span threading all at once (r4
+    # review: device engine + confidence_out used to crash fetching a
+    # non-addressable conf array; spans exercise the transfer-total fetch
+    # and the on-device int8 span concat too).
+    import tempfile
+
+    from cpgisland_tpu import pipeline as pl
+
+    tdir = tempfile.mkdtemp()
+    fa2 = os.path.join(tdir, "p.fa")
+    nl = chr(10)
+    with open(fa2, "w") as f:
+        f.write(">c" + nl)
+        s = ("cg" * 40 + "ta" * 40) * 30  # 4800 syms, unambiguous islands
+        for i in range(0, len(s), 70):
+            f.write(s[i : i + 70] + nl)
+    outs = {k: os.path.join(tdir, k) for k in
+            ("cd.npy", "id.txt", "ch.npy", "ih.txt")}
+    pl.posterior_file(fa2, presets.durbin_cpg8(),
+                      confidence_out=outs["cd.npy"],
+                      islands_out=outs["id.txt"],
+                      island_engine="device", span=2048)
+    pl.posterior_file(fa2, presets.durbin_cpg8(),
+                      confidence_out=outs["ch.npy"],
+                      islands_out=outs["ih.txt"],
+                      island_engine="host", span=2048)
+    isl_text = open(outs["id.txt"]).read()
+    assert isl_text == open(outs["ih.txt"]).read()
+    assert isl_text.count(nl) >= 2
+    assert np.array_equal(np.load(outs["cd.npy"]), np.load(outs["ch.npy"]))
+
     print("RESULT " + json.dumps({
         "pid": pid,
         "A": np.asarray(res.params.A).tolist(),
@@ -118,6 +178,9 @@ WORKER = textwrap.dedent(
         "path_sum": int(np.asarray(path).sum()),
         "path_head": np.asarray(path)[:32].tolist(),
         "conf_sum": float(np.asarray(conf, np.float64).sum()),
+        "n_dev_calls": len(dev_calls),
+        "dev_beg": dev_calls.beg.tolist()[:16],
+        "posterior_islands": isl_text.splitlines()[:4],
     }), flush=True)
     """
 )
@@ -208,3 +271,8 @@ def test_two_process_distributed_fit_matches_single_process(tmp_path):
     assert results[0]["conf_sum"] == pytest.approx(
         float(np.asarray(ref_conf, np.float64).sum()), rel=1e-5
     )
+
+    # Device island calling on the global mesh: both processes fetched the
+    # same compact call records (worker already asserted host parity).
+    assert results[0]["n_dev_calls"] == results[1]["n_dev_calls"] > 0
+    np.testing.assert_array_equal(results[0]["dev_beg"], results[1]["dev_beg"])
