@@ -68,7 +68,10 @@ def _common_flags(p: argparse.ArgumentParser) -> None:
         default="local",
         help="E-step backend: one device / chunk-sharded mesh psum / exact "
         "whole-sequence sequence-parallel / per-record 2-D data x seq mesh "
-        "(the last two have no chunk-boundary approximation; seq2d needs --clean)",
+        "(the last two have no chunk-boundary approximation; seq2d needs "
+        "--clean).  In a multi-process job, spmd --clean builds its input "
+        "by byte-range sharded encoding: each host parses only ~1/P of the "
+        "training file (HDFS-input-split equivalent)",
     )
     p.add_argument("--numerics", choices=("log", "rescaled"), default="rescaled", dest="mode")
     p.add_argument(

@@ -46,6 +46,20 @@ from cpgisland_tpu.utils import profiling
 log = logging.getLogger(__name__)
 
 
+def _spmd_data_axis_size(backend) -> Optional[int]:
+    """Data-axis size of an spmd-capable backend — the ``pad_multiple`` a
+    byte-range LocalShard must be built with — or None when the backend
+    cannot accept per-process LocalShard input (then multi-host train_file
+    keeps the whole-file parse)."""
+    from cpgisland_tpu.train.backends import SpmdBackend
+
+    if isinstance(backend, SpmdBackend):
+        return backend.mesh.shape[backend.axis]
+    if backend == "spmd":
+        return jax.device_count()  # get_backend('spmd') meshes all devices
+    return None
+
+
 def train_file(
     training_path: str,
     *,
@@ -73,6 +87,16 @@ def train_file(
     ``symbol_cache``: pre-encoded symbol cache prefix (utils.codec) — repeat
     runs over the same FASTA skip the host text parse entirely (clean mode
     only; the measured end-to-end bottleneck, BASELINE.md).
+
+    Multi-host (``jax.process_count() > 1``, after
+    parallel.mesh.initialize_multihost): with an spmd backend in clean
+    mode, the input is built by BYTE-RANGE SHARDED encoding
+    (chunking.distributed_chunked) — each host parses only its ~1/P of the
+    file and assembles only its own chunk rows, the equivalent of the
+    reference's HDFS input splits (CpGIslandFinder.java:108-147).  No host
+    ever holds the global batch, and ``symbol_cache`` caches per-host byte
+    ranges.  Other backends (and compat mode, whose drop-remainder framing
+    is host-global by definition) keep the whole-file parse.
     """
     if params is None:
         params = presets.durbin_cpg8()
@@ -107,6 +131,22 @@ def train_file(
         )
         # The string flows through to fit() -> get_backend('seq2d'), which
         # validates mode/engine and builds the auto 2-D meshes at prepare().
+    elif _spmd_data_axis_size(backend) is not None and not compat and (
+        jax.process_count() > 1
+    ):
+        # Pod job: byte-range sharded encode — this host parses only its
+        # ~1/P of the file and assembles only its own rows (see docstring).
+        chunked = chunking.distributed_chunked(
+            training_path, chunk_size,
+            pad_multiple=_spmd_data_axis_size(backend),
+            symbol_cache=symbol_cache,
+        )
+        log.info(
+            "training input (byte-range sharded): process %d/%d assembled "
+            "%d of %d global rows (%d local symbols)",
+            jax.process_index(), jax.process_count(),
+            chunked.num_chunks, chunked.global_rows, chunked.total,
+        )
     else:
         symbols = codec.encode_file_cached(
             training_path, symbol_cache, skip_headers=not compat

@@ -218,6 +218,7 @@ def distributed_chunked(
     skip_headers: bool = True,
     process_index: int | None = None,
     process_count: int | None = None,
+    symbol_cache: str | None = None,
     gather=None,
 ) -> LocalShard:
     """Build THIS process's block of the global chunk framing of a file,
@@ -239,8 +240,10 @@ def distributed_chunked(
     ``pad_multiple``: the mesh data-axis size — global rows pad to it (with
     zero-length rows), matching SpmdBackend.prepare's padding of the
     single-host path bit for bit.  Clean framing only (the remainder row is
-    kept, padded).  ``gather`` injects the collective for tests; the default
-    is identity for one process and multihost_utils.process_allgather
+    kept, padded).  ``symbol_cache``: per-host byte-range encode cache
+    prefix (codec.encode_byte_range_cached) — pod repeat-runs skip the text
+    parse.  ``gather`` injects the collective for tests; the default is
+    identity for one process and multihost_utils.process_allgather
     otherwise.
     """
     import jax
@@ -259,7 +262,9 @@ def distributed_chunked(
 
     from cpgisland_tpu.utils import codec
 
-    syms = codec.encode_byte_range(path, p, P, skip_headers=skip_headers)
+    syms = codec.encode_byte_range_cached(
+        path, p, P, symbol_cache, skip_headers=skip_headers
+    )
     counts = gather(np.asarray([syms.size], np.int64)).reshape(-1)
     offsets = np.concatenate([[0], np.cumsum(counts)])
     total = int(offsets[-1])

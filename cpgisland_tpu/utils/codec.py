@@ -464,6 +464,50 @@ def iter_fasta_records_cached(path: str, cache: Optional[str] = None):
         yield name, symbols[offsets[i] : offsets[i + 1]]
 
 
+def encode_byte_range_cached(
+    path: str,
+    part: int,
+    n_parts: int,
+    cache: Optional[str],
+    *,
+    skip_headers: bool = True,
+) -> np.ndarray:
+    """encode_byte_range with an optional per-host read-through cache.
+
+    The multi-host twin of encode_file_cached: each process caches ONLY its
+    own byte range (sidecar ``{cache}.range{part}of{n_parts}.npz``), so pod
+    repeat-runs skip the text parse without any host ever touching the
+    whole file.  Atomic temp+rename write; the cache key includes the
+    (part, n_parts) split so a resized pod rebuilds automatically, and the
+    source fingerprint invalidates on edit like the whole-file cache.
+    """
+    if cache is None or not skip_headers:
+        return encode_byte_range(path, part, n_parts, skip_headers=skip_headers)
+    side = f"{cache}.range{part}of{n_parts}.npz"
+    fp = _source_fingerprint(path)
+    try:
+        got = np.load(side)
+        if (
+            int(got["version"]) == _CACHE_VERSION
+            and int(got["size"]) == fp["size"]
+            and int(got["mtime_ns"]) == fp["mtime_ns"]
+        ):
+            return np.asarray(got["symbols"], np.uint8)
+    except Exception:
+        pass
+    syms = encode_byte_range(path, part, n_parts, skip_headers=True)
+    tmp = f"{cache}.tmp.{os.getpid()}.range.npz"
+    try:
+        np.savez(tmp, version=_CACHE_VERSION, symbols=syms, **fp)
+        os.rename(tmp, side)
+    except OSError:  # unwritable cache dir: serve the encode anyway
+        pass
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+    return syms
+
+
 def decode_symbols(symbols: np.ndarray) -> str:
     """Inverse mapping (0..3 -> 'acgt') for debugging and test fixtures."""
     return _BASE_CHARS[np.asarray(symbols, dtype=np.uint8)].tobytes().decode("ascii")

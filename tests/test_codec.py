@@ -181,3 +181,43 @@ def test_encode_file_cached(tmp_path, rng):
         codec.encode_file_cached(str(fa), cache, skip_headers=False),
         codec.encode_file(str(fa), skip_headers=False),
     )
+
+
+def test_encode_byte_range_cached(tmp_path, rng):
+    """Per-host byte-range cache: hit equals direct encode, editing the
+    source invalidates, and a (part, n_parts) change never serves a stale
+    split (VERDICT r3 #1's per-host symbol cache)."""
+    import os
+    import time
+
+    fa = tmp_path / "g.fa"
+    _write_fasta(fa, rng, [("chrA", 9000), ("s", 500)])
+    cache = str(tmp_path / "c")
+    for q in range(2):
+        direct = codec.encode_byte_range(str(fa), q, 2)
+        np.testing.assert_array_equal(
+            codec.encode_byte_range_cached(str(fa), q, 2, cache), direct
+        )
+        assert os.path.exists(f"{cache}.range{q}of2.npz")
+        # hit path
+        np.testing.assert_array_equal(
+            codec.encode_byte_range_cached(str(fa), q, 2, cache), direct
+        )
+    # A different split keys a different sidecar — no stale reuse.
+    np.testing.assert_array_equal(
+        codec.encode_byte_range_cached(str(fa), 0, 3, cache),
+        codec.encode_byte_range(str(fa), 0, 3),
+    )
+    # Source edit invalidates.
+    time.sleep(0.01)
+    _write_fasta(fa, rng, [("chrA", 9001), ("s", 500)])
+    os.utime(fa)
+    np.testing.assert_array_equal(
+        codec.encode_byte_range_cached(str(fa), 0, 2, cache),
+        codec.encode_byte_range(str(fa), 0, 2),
+    )
+    # cache=None passes through; no sidecar appears.
+    np.testing.assert_array_equal(
+        codec.encode_byte_range_cached(str(fa), 1, 2, None),
+        codec.encode_byte_range(str(fa), 1, 2),
+    )

@@ -129,6 +129,46 @@ def test_distributed_chunked_single_process_parity(tmp_path, rng):
     np.testing.assert_array_equal(ls.chunks, ref.chunks)
     np.testing.assert_array_equal(ls.lengths, ref.lengths)
     assert ls.global_rows == ref.num_chunks
+    # With a symbol cache: identical shard, sidecar created, hit served.
+    cache = str(tmp_path / "c")
+    for _ in range(2):
+        ls_c = chunking.distributed_chunked(
+            str(fa), 4096, pad_multiple=8, process_index=0, process_count=1,
+            symbol_cache=cache,
+        )
+        np.testing.assert_array_equal(ls_c.chunks, ref.chunks)
+    import os
+
+    assert os.path.exists(f"{cache}.range0of1.npz")
+
+
+def test_train_file_single_process_keeps_whole_file_parse(tmp_path, rng, monkeypatch):
+    """The byte-range-sharded input path activates ONLY in multi-process
+    jobs: a single-process spmd train_file still encodes the whole file
+    (the shard path would be pure overhead at P=1)."""
+    from cpgisland_tpu import pipeline
+    from cpgisland_tpu.utils import chunking, codec
+
+    fa = tmp_path / "g.fa"
+    with open(fa, "w") as f:
+        f.write(">r\n")
+        s = "".join(rng.choice(list("acgt"), size=10_000))
+        for i in range(0, len(s), 70):
+            f.write(s[i : i + 70] + "\n")
+    called = {"dc": 0}
+    orig = chunking.distributed_chunked
+
+    def spy(*a, **kw):
+        called["dc"] += 1
+        return orig(*a, **kw)
+
+    monkeypatch.setattr(chunking, "distributed_chunked", spy)
+    res = pipeline.train_file(
+        str(fa), compat=False, backend="spmd", num_iters=1, convergence=0.0,
+        chunk_size=1024,
+    )
+    assert called["dc"] == 0
+    assert np.isfinite(res.logliks[0])
 
 
 def test_distributed_chunked_multi_part_assembly(tmp_path, rng):

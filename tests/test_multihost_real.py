@@ -89,6 +89,46 @@ WORKER = textwrap.dedent(
         rtol=1e-6, atol=1e-8,
     ), "global-batch and byte-range-sharded inputs diverged"
 
+    # USER ENTRY POINT routing (VERDICT r3 #1): pipeline.train_file itself —
+    # not a hand-built LocalShard — must take the byte-range-sharded input
+    # path in a multi-process job.  Instrumented: the whole-file encoders
+    # are forbidden during the call, and THIS worker must have encoded at
+    # most ~60% of the file (its own ~half plus line-boundary slack).
+    from cpgisland_tpu import pipeline as pl
+    from cpgisland_tpu.utils import codec as codec_mod
+
+    total_syms = codec_mod.encode_file(fa_path, skip_headers=True).size
+    encoded = []
+    orig_ebr = codec_mod.encode_byte_range
+
+    def spy_ebr(path, part, n_parts, **kw):
+        out = orig_ebr(path, part, n_parts, **kw)
+        encoded.append(out.size)
+        return out
+
+    def forbid(*a, **kw):
+        raise AssertionError(
+            "whole-file encode called in multi-host spmd train_file"
+        )
+
+    codec_mod.encode_byte_range = spy_ebr
+    orig_ef, orig_efc = codec_mod.encode_file, codec_mod.encode_file_cached
+    codec_mod.encode_file = codec_mod.encode_file_cached = forbid
+    try:
+        res_tf = pl.train_file(
+            fa_path, compat=False, num_iters=2, convergence=0.0,
+            backend=backends.SpmdBackend(mesh=make_mesh(8, axis="data")),
+            chunk_size=256,
+        )
+    finally:
+        codec_mod.encode_byte_range = orig_ebr
+        codec_mod.encode_file, codec_mod.encode_file_cached = orig_ef, orig_efc
+    assert sum(encoded) <= 0.6 * total_syms, (sum(encoded), total_syms)
+    assert np.allclose(
+        np.asarray(res_tf.params.A), np.asarray(res.params.A),
+        rtol=1e-6, atol=1e-8,
+    ), "train_file byte-range input diverged from the LocalShard fit"
+
     # Sequence-parallel decode across BOTH processes' devices: the host
     # materialization goes through process_allgather, so each process gets
     # the identical full path.
