@@ -48,13 +48,47 @@ def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
 
 
+# No single-chip path on this hardware exceeds ~2.2 Gsym/s; anything past
+# this ceiling is a phantom result (see _best_wall), not a measurement.
+PLAUSIBLE_MAX_SYM_PER_S = 20e9
+
+
+def _check_plausible(tput: float, name: str) -> float:
+    if tput > PLAUSIBLE_MAX_SYM_PER_S:
+        raise RuntimeError(
+            f"{name}: {tput/1e6:.1f} Msym/s exceeds the plausibility ceiling "
+            f"({PLAUSIBLE_MAX_SYM_PER_S/1e6:.0f}) — phantom relay result; "
+            "re-run this phase in a fresh process"
+        )
+    return tput
+
+
 def _best_wall(fn, reps: int = 3) -> float:
-    """Min wall-clock of fn() over reps (fn must block internally)."""
+    """Min wall-clock of fn(seed) over reps with DISTINCT seeds (fn must
+    block internally and fold the seed into its input data).
+
+    Byte-identical repeated executions have been observed coming back from
+    the TPU relay in ~0 ms (a phantom result, not a measurement); a unique
+    seed per rep makes every execution a distinct request.  Any rep under
+    100 us is still treated as a phantom and retried with a fresh seed;
+    persistent phantoms raise rather than publish a fantasy number.
+    """
     best = float("inf")
-    for _ in range(reps):
+    seed, done, phantoms = 1, 0, 0
+    while done < reps:
         t0 = time.perf_counter()
-        fn()
-        best = min(best, time.perf_counter() - t0)
+        fn(seed)
+        dt = time.perf_counter() - t0
+        seed += 1
+        if dt < 1e-4:
+            phantoms += 1
+            if phantoms > 4:
+                raise RuntimeError(
+                    f"persistent ~0 ms phantom timings ({dt*1e6:.0f} us rep)"
+                )
+            continue
+        best = min(best, dt)
+        done += 1
     return best
 
 
@@ -94,10 +128,15 @@ def bench_decode(
         c, _ = jax.lax.scan(body, c, None, length=chain)
         return c
 
-    c0 = jnp.int32(0)
-    jax.block_until_ready(chained(c0, obs))  # compile + warm
-    best = _best_wall(lambda: jax.block_until_ready(chained(c0, obs))) / chain
-    tput = n_symbols / best
+    jax.block_until_ready(chained(jnp.int32(0), obs))  # compile + warm
+    # Timing FETCHES the scalar output: block_until_ready alone has been
+    # observed returning without execution on the degraded relay (phantom
+    # ~0 ms reps); a fetch cannot complete until the result exists.  Cost:
+    # one extra RTT per rep, amortized over the chain.
+    best = _best_wall(
+        lambda s: int(jax.device_get(chained(jnp.int32(s), obs)))
+    ) / chain
+    tput = _check_plausible(n_symbols / best, f"decode{tag}")
     log(
         f"decode{tag}[{eng}]: {tput/1e6:.1f} Msym/s "
         f"({best*1e3:.0f} ms / {n_symbols/2**20:.0f} MiB, chained x{chain})"
@@ -133,16 +172,19 @@ def bench_em(
     lengths = jnp.full(n_chunks, chunk_size, dtype=jnp.int32)
 
     @jax.jit
-    def chained(p, chunks, lengths):
+    def chained(p, chunks, lengths, s):
+        chunks = chunks.at[0, 0].set((s % 4).astype(chunks.dtype))
         def body(p, _):
             return mstep(p, backend(p, chunks, lengths)), None
 
         p, _ = jax.lax.scan(body, p, None, length=chain)
         return p
 
-    jax.block_until_ready(chained(params, chunks, lengths))  # compile + warm
+    jax.block_until_ready(chained(params, chunks, lengths, jnp.int32(0)))
     best = _best_wall(
-        lambda: jax.block_until_ready(chained(params, chunks, lengths))
+        lambda s: np.asarray(
+            jax.device_get(chained(params, chunks, lengths, jnp.int32(s)).log_pi)
+        ).sum()
     ) / chain
 
     # One blocking call for the latency-transparency line.
@@ -156,7 +198,7 @@ def bench_em(
     blocking = time.perf_counter() - t0
 
     n_sym = n_chunks * chunk_size
-    tput = n_sym / best
+    tput = _check_plausible(n_sym / best, "em")
     log(
         f"em[{eng}]: {tput/1e6:.1f} Msym/s/iter ({best*1e3:.0f} ms / "
         f"{n_sym/2**20:.0f} MiB, chained x{chain}; blocking single call "
@@ -194,13 +236,12 @@ def bench_batched_decode(
         c, _ = jax.lax.scan(body, c, None, length=chain)
         return c
 
-    c0 = jnp.int32(0)
-    jax.block_until_ready(chained(c0, chunks, lengths))
+    jax.block_until_ready(chained(jnp.int32(0), chunks, lengths))
     best = _best_wall(
-        lambda: jax.block_until_ready(chained(c0, chunks, lengths))
+        lambda s: int(jax.device_get(chained(jnp.int32(s), chunks, lengths)))
     ) / chain
     n_sym = n_seqs * seq_len
-    tput = n_sym / best
+    tput = _check_plausible(n_sym / best, "batched-decode")
     log(
         f"batched-decode[{eng}]: {tput/1e6:.1f} Msym/s "
         f"({n_seqs} x {seq_len/2**20:.0f} MiB in {best*1e3:.0f} ms, chained x{chain})"
@@ -268,10 +309,11 @@ def bench_posterior(n_symbols: int, engine: str = "auto", chain: int = 6) -> flo
         c, _ = jax.lax.scan(step, c, None, length=chain)
         return c
 
-    c0 = jnp.int32(0)
-    jax.block_until_ready(chained(c0, obs))  # compile + warm
-    best = _best_wall(lambda: jax.block_until_ready(chained(c0, obs))) / chain
-    tput = n_symbols / best
+    jax.block_until_ready(chained(jnp.int32(0), obs))  # compile + warm
+    best = _best_wall(
+        lambda s: int(jax.device_get(chained(jnp.int32(s), obs)))
+    ) / chain
+    tput = _check_plausible(n_symbols / best, "posterior")
     log(
         f"posterior[{eng}]: {tput/1e6:.1f} Msym/s "
         f"({best*1e3:.0f} ms / {n_symbols/2**20:.0f} MiB, chained x{chain})"
@@ -300,20 +342,351 @@ def bench_em_2state(n_chunks: int, chunk_size: int = 0x10000, chain: int = 24) -
     lengths = jnp.full(n_chunks, chunk_size, dtype=jnp.int32)
 
     @jax.jit
-    def chained(p, chunks, lengths):
+    def chained(p, chunks, lengths, s):
+        chunks = chunks.at[0, 0].set((s % 4).astype(chunks.dtype))
         def body(p, _):
             return mstep(p, backend(p, chunks, lengths)), None
 
         p, _ = jax.lax.scan(body, p, None, length=chain)
         return p
 
-    jax.block_until_ready(chained(params, chunks, lengths))
+    jax.block_until_ready(chained(params, chunks, lengths, jnp.int32(0)))
     best = _best_wall(
-        lambda: jax.block_until_ready(chained(params, chunks, lengths))
+        lambda s: np.asarray(
+            jax.device_get(chained(params, chunks, lengths, jnp.int32(s)).log_pi)
+        ).sum()
     ) / chain
-    tput = n_chunks * chunk_size / best
+    tput = _check_plausible(n_chunks * chunk_size / best, "em-2state")
     log(f"em-2state[{eng}]: {tput/1e6:.1f} Msym/s/iter ({best*1e3:.0f} ms, chained x{chain})")
     return tput
+
+
+def bench_em_seq(n_symbols: int, engine: str = "auto", chain: int = 8) -> float:
+    """EXACT whole-sequence EM throughput (sym/s per iter) — the flagship
+    beyond-the-reference training capability (SeqBackend: no 64 Ki
+    chunk-independence approximation).  Chained like the other configs:
+    ``chain`` iterations in one jit, params feeding forward through the
+    M-step, so the figure is steady-state on-chip rate (VERDICT r3 #3 — this
+    number was previously only a code comment)."""
+    import jax
+    import jax.numpy as jnp
+
+    from cpgisland_tpu.models import presets
+    from cpgisland_tpu.parallel.mesh import make_mesh
+    from cpgisland_tpu.train.backends import SeqBackend
+    from cpgisland_tpu.train.baum_welch import mstep
+    from cpgisland_tpu.utils import chunking
+
+    params = presets.durbin_cpg8()
+    backend = SeqBackend(mesh=make_mesh(len(jax.devices()), axis="seq"), engine=engine)
+    rng = np.random.default_rng(6)
+    stream = rng.integers(0, 4, size=n_symbols, dtype=np.int32).astype(np.uint8)
+    prepared = backend.prepare(
+        chunking.Chunked(
+            chunks=stream[None, :], lengths=np.asarray([n_symbols], np.int32),
+            total=n_symbols,
+        )
+    )
+    obs, lens = backend.place(prepared.chunks, prepared.lengths)
+
+    @jax.jit
+    def chained(p, obs, lens, s):
+        obs = obs.at[0].set((s % 4).astype(obs.dtype))
+        def body(p, _):
+            return mstep(p, backend(p, obs, lens)), None
+
+        p, _ = jax.lax.scan(body, p, None, length=chain)
+        return p
+
+    jax.block_until_ready(chained(params, obs, lens, jnp.int32(0)))
+    best = _best_wall(
+        lambda s: np.asarray(
+            jax.device_get(chained(params, obs, lens, jnp.int32(s)).log_pi)
+        ).sum()
+    ) / chain
+    tput = _check_plausible(n_symbols / best, "em-seq")
+    log(
+        f"em-seq[{backend.engine}]: {tput/1e6:.1f} Msym/s/iter "
+        f"({best*1e3:.0f} ms / {n_symbols/2**20:.0f} MiB whole-sequence, "
+        f"chained x{chain})"
+    )
+    return tput
+
+
+def bench_em_seq2d(engine: str = "auto", chain: int = 8, scale: float = 1.0) -> float:
+    """EXACT bucketed per-record EM throughput (sym/s per iter): a
+    chromosome-plus-scaffolds shaped input through Seq2DBackend's per-group
+    dp x sp meshes.  Each group's stats fn is chained separately (groups run
+    back-to-back on device in a real iteration; chaining amortizes the relay
+    dispatch exactly like every other config) and the iteration time is the
+    sum over groups."""
+    import jax
+    import jax.numpy as jnp
+
+    from cpgisland_tpu.models import presets
+    from cpgisland_tpu.train.backends import Seq2DBackend
+    from cpgisland_tpu.train.baum_welch import mstep
+    from cpgisland_tpu.utils import chunking
+
+    params = presets.durbin_cpg8()
+    backend = Seq2DBackend(engine=engine)
+    rng = np.random.default_rng(8)
+    # One "chromosome" group + one scaffold group (pow2 size classes, like
+    # chunking.bucket_records builds): 32 Mi + 8 x 2 Mi at scale=1.
+    groups = [(1, int((32 << 20) * scale)), (8, int((2 << 20) * scale))]
+    chunks_t, lens_t = [], []
+    for rows, ln in groups:
+        chunks_t.append(
+            rng.integers(0, 4, size=(rows, ln), dtype=np.int32).astype(np.uint8)
+        )
+        lens_t.append(np.full(rows, ln, np.int32))
+    total = sum(r * ln for r, ln in groups)
+    bucketed = chunking.Bucketed(
+        chunks=tuple(chunks_t), lengths=tuple(lens_t), total=total
+    )
+    prepared = backend.prepare(bucketed)
+    obs_t, len_t = backend.place(prepared.chunks, prepared.lengths)
+
+    per_iter = 0.0
+    for g, (mesh_g, obs, lens) in enumerate(
+        zip(backend._group_meshes, obs_t, len_t)
+    ):
+        @jax.jit
+        def chained(p, obs, lens, s):
+            obs = obs.at[0, 0].set((s % 4).astype(obs.dtype))
+            def body(p, _):
+                return mstep(p, backend._group_stats(p, mesh_g, obs, lens)), None
+
+            p, _ = jax.lax.scan(body, p, None, length=chain)
+            return p
+
+        jax.block_until_ready(chained(params, obs, lens, jnp.int32(0)))
+        per_iter += _best_wall(
+            lambda s, c=chained, o=obs, l=lens: np.asarray(
+                jax.device_get(c(params, o, l, jnp.int32(s)).log_pi)
+            ).sum()
+        ) / chain
+    tput = _check_plausible(total / per_iter, "em-seq2d")
+    log(
+        f"em-seq2d[{backend.engine}]: {tput/1e6:.1f} Msym/s/iter "
+        f"({per_iter*1e3:.0f} ms / {total/2**20:.0f} MiB in {len(groups)} "
+        f"bucket groups, chained x{chain})"
+    )
+    return tput
+
+
+def _planted_record(n: int, boundary: int, rng) -> np.ndarray:
+    """AT-rich DNA (the e2e bench's human-like composition — uniform ACGT
+    is 50% GC and decodes to ~500k spurious micro-islands at 320 Mi) with a
+    strong CG island straddling ``boundary`` and a few elsewhere, as
+    symbols — for the span-continuity configs."""
+    obs = rng.choice(
+        np.arange(4, dtype=np.uint8), size=n, p=[0.32, 0.18, 0.18, 0.32]
+    )
+    spots = [boundary - 2000] + [
+        int(x) for x in rng.integers(0, n - 4000, size=8)
+    ]
+    cg = rng.choice(np.array([1, 2], np.uint8), size=4000)
+    for lo in spots:
+        obs[lo : lo + 4000] = cg[: max(0, min(4000, n - lo))]
+    return obs
+
+
+def bench_span_decode(n_symbols: int, span: int, engine: str = "auto") -> dict:
+    """Span-threaded EXACT decode at beyond-one-pass scale (VERDICT r3 #2):
+    one record larger than the decode span runs viterbi_sharded_spans (>= 2
+    spans with boundary messages threaded), device island calling included,
+    with a planted island straddling the span boundary asserted to come out
+    WHOLE.  Wall-clock includes the real host-side span threading — the
+    overhead the span constants' memory budgets trade against."""
+    import jax
+    import jax.numpy as jnp
+
+    from cpgisland_tpu.models import presets
+    from cpgisland_tpu.ops.islands_device import call_islands_device
+    from cpgisland_tpu.parallel.decode import viterbi_sharded_spans
+
+    params = presets.durbin_cpg8()
+    rng = np.random.default_rng(9)
+    obs = _planted_record(n_symbols, span, rng)
+    n_spans = -(-n_symbols // span)
+    assert n_spans >= 2, "config must force the span path"
+
+    def run():
+        # Decode AND device island calling inside the timed window — the
+        # published row claims the full decode->islands span pipeline.
+        pieces = viterbi_sharded_spans(
+            params, obs, span=span, engine=engine, return_device=True
+        )
+        full = pieces[0] if len(pieces) == 1 else jnp.concatenate(pieces)
+        return call_islands_device(full)
+
+    def run_single():
+        # The SAME user path at one-pass scale: a span-sized prefix decoded
+        # in one span + device island call.  Its per-symbol wall is the
+        # denominator of the span-overhead ratio — both runs pay the same
+        # relay upload per byte (the dominant cost on this dev setup), so
+        # the ratio isolates the true span-threading overhead robustly.
+        pieces = viterbi_sharded_spans(
+            params, obs[:span], span=span, engine=engine, return_device=True
+        )
+        return call_islands_device(pieces[0])
+
+    run()  # compile + warm (spans share one padded shape)
+    run_single()  # warm the one-pass shapes too (distinct compiled fns)
+    # One-symbol perturbation: the measured pass must not be byte-identical
+    # to the warm pass (the relay can phantom-serve repeated requests).
+    obs[0] = (obs[0] + 1) % 4
+    t0 = time.perf_counter()
+    calls = run()
+    wall = time.perf_counter() - t0
+    obs[0] = (obs[0] + 1) % 4
+    t0 = time.perf_counter()
+    run_single()
+    wall1 = time.perf_counter() - t0
+    tput = n_symbols / wall
+    overhead = (wall / n_symbols) / (wall1 / span)
+    crossing = [
+        (b, e) for b, e in zip(calls.beg, calls.end) if b <= span < e
+    ]
+    assert crossing, (
+        f"no island call crosses the span boundary at {span} — continuity "
+        f"machinery not exercised ({len(calls)} calls)"
+    )
+    mem = _device_memory_gb()
+    stats = {
+        "span_decode_msym_per_s": round(tput / 1e6, 1),
+        "span_decode_overhead": round(overhead, 2),
+        "n_spans": n_spans,
+        "n_islands": len(calls),
+        "boundary_island": [int(crossing[0][0]), int(crossing[0][1])],
+        **mem,
+    }
+    log(
+        f"span-decode[{engine}]: {tput/1e6:.1f} Msym/s user-path wall "
+        f"({wall:.2f}s for a {n_symbols/2**20:.0f} MiB record in {n_spans} "
+        f"spans of {span/2**20:.0f} MiB incl. host boundary threading; "
+        f"{overhead:.2f}x the per-symbol wall of the one-pass user path at "
+        f"{span/2**20:.0f} MiB, which pays the same per-byte input upload — "
+        f"upload-bound on this relayed dev setup, compute-bound on PCIe; "
+        f"cross-boundary island {crossing[0][0]}-{crossing[0][1]} emitted "
+        f"whole) " + json.dumps(mem)
+    )
+    return stats
+
+
+def bench_span_posterior(n_symbols: int, span: int, engine: str = "auto") -> dict:
+    """Span-threaded EXACT posterior at beyond-one-pass scale through the
+    REAL user path — pipeline.posterior_file in island-only device mode (no
+    per-symbol outputs; VERDICT r3 #2 + #4 together): enter/exit directions
+    threaded between >= 2 POSTERIOR_SPAN spans, islands called over the
+    whole record's device-resident MPM path."""
+    import jax
+
+    from cpgisland_tpu import pipeline
+    from cpgisland_tpu.models import presets
+    from cpgisland_tpu.utils import profiling
+
+    params = presets.durbin_cpg8()
+    rng = np.random.default_rng(10)
+    obs = _planted_record(n_symbols, span, rng)
+    n_spans = -(-n_symbols // span)
+    assert n_spans >= 2
+    tmpdir = tempfile.mkdtemp(prefix="cpg_span_")
+    fa = os.path.join(tmpdir, "span.fa")
+    acgt = np.frombuffer(b"acgt", np.uint8)
+    text = acgt[obs]
+    with open(fa, "wb") as f:
+        f.write(b">spanrec\n")
+        rows = text[: (n_symbols // 80) * 80].reshape(-1, 80)
+        f.write(b"\n".join(bytes(r) for r in rows) + b"\n")
+    out = os.path.join(tmpdir, "islands.txt")
+    island_engine = "device" if jax.default_backend() == "tpu" else "auto"
+
+    def run(tag):
+        timer = profiling.PhaseTimer()
+        t0 = time.perf_counter()
+        res = pipeline.posterior_file(
+            fa, params, islands_out=out, engine=engine,
+            island_engine=island_engine, span=span, timer=timer,
+        )
+        return time.perf_counter() - t0, res, timer
+
+    # A one-pass twin at span size through the SAME user path (single
+    # record of ``span`` symbols): per-symbol wall denominator for the
+    # span-overhead ratio (both pay the same per-byte upload + parse).
+    fa1 = os.path.join(tmpdir, "single.fa")
+    with open(fa1, "wb") as f:
+        f.write(b">single\n")
+        rows1 = text[: (span // 80) * 80].reshape(-1, 80)
+        f.write(b"\n".join(bytes(r) for r in rows1) + b"\n")
+
+    def run_single():
+        t0 = time.perf_counter()
+        pipeline.posterior_file(
+            fa1, params, islands_out=out, engine=engine,
+            island_engine=island_engine, span=span,
+        )
+        return time.perf_counter() - t0
+
+    run("warm")  # compiles (spans share one padded shape)
+    run_single()  # warm the single-span shapes (same compiled fns)
+    # De-duplicate the measured pass from the warm pass (phantom guard).
+    with open(fa, "r+b") as f:
+        f.seek(len(">spanrec\n"))
+        f.write(b"t")
+    with open(fa1, "r+b") as f:
+        f.seek(len(">single\n"))
+        f.write(b"t")
+    wall, res, timer = run("measured")
+    wall1 = run_single()
+    dev_s = sum(ph.seconds for ph in timer.phases.values())
+    tput = n_symbols / wall
+    overhead = (wall / n_symbols) / (wall1 / span)
+    crossing = [
+        (b, e) for b, e in zip(res.calls.beg, res.calls.end) if b <= span < e
+    ]
+    assert crossing, "no island crosses the posterior span boundary"
+    mem = _device_memory_gb()
+    for p in (fa, fa1, out):
+        os.unlink(p)
+    os.rmdir(tmpdir)
+    stats = {
+        "span_posterior_msym_per_s": round(tput / 1e6, 1),
+        "span_posterior_overhead": round(overhead, 2),
+        "n_spans": n_spans,
+        "n_islands": len(res.calls),
+        **mem,
+    }
+    log(
+        f"span-posterior[{engine}]: {tput/1e6:.1f} Msym/s user-path wall "
+        f"({wall:.2f}s end-to-end incl. FASTA parse for a "
+        f"{n_symbols/2**20:.0f} MiB record in {n_spans} spans of "
+        f"{span/2**20:.0f} MiB, island-only device mode, device phases "
+        f"{dev_s:.2f}s; {overhead:.2f}x the per-symbol wall of the one-pass "
+        f"user path at {span/2**20:.0f} MiB — upload-bound on this relayed "
+        f"dev setup, compute-bound on PCIe; cross-boundary island "
+        f"{crossing[0][0]}-{crossing[0][1]} emitted whole) " + json.dumps(mem)
+    )
+    return stats
+
+
+def _device_memory_gb() -> dict:
+    """Peak/in-use HBM if the backend exposes it (guarded: the relay plugin
+    may not) — the span configs exist to validate the span constants'
+    device-memory budgets, so report the headroom when we can see it."""
+    import jax
+
+    try:
+        ms = jax.devices()[0].memory_stats() or {}
+        out = {}
+        if "peak_bytes_in_use" in ms:
+            out["peak_hbm_gb"] = round(ms["peak_bytes_in_use"] / 2**30, 2)
+        if "bytes_limit" in ms:
+            out["hbm_limit_gb"] = round(ms["bytes_limit"] / 2**30, 2)
+        return out
+    except Exception:
+        return {}
 
 
 def bench_end_to_end(n_mbases: int, engine: str = "auto") -> dict:
@@ -370,6 +743,9 @@ def bench_end_to_end(n_mbases: int, engine: str = "auto") -> dict:
     pipeline.decode_file(
         fa, presets.durbin_cpg8(), islands_out=out, compat=False, engine=engine
     )
+    with open(fa, "r+b") as f:  # de-dup the measured pass (phantom guard)
+        f.seek(len(">bench\n"))
+        f.write(b"t")
     timer = profiling.PhaseTimer()
     t0 = time.perf_counter()
     res = pipeline.decode_file(
@@ -504,7 +880,22 @@ def main() -> int:
         help="internal: run only the sharded-path validation (used by the "
         "virtual-CPU-mesh subprocess when the parent has a single device)",
     )
+    ap.add_argument(
+        "--phase",
+        default=None,
+        choices=("core", "ext1", "ext2", "ext3"),
+        help="internal: run ONE capture phase and print its results as JSON "
+        "(the --extended parent orchestrates phases as subprocesses — the "
+        "relay tunnel degrades into phantom ~0 ms results after ~15 min of "
+        "one process's use, and a fresh process resets it)",
+    )
     args = ap.parse_args()
+
+    if args.extended and args.phase is None:
+        # Parent: never initializes the TPU itself (children own the tunnel
+        # claim one at a time); relays every child's stderr verbatim so the
+        # captured artifact stays ONE stream.
+        return _orchestrate(args)
 
     import jax
 
@@ -522,18 +913,22 @@ def main() -> int:
     if args.decode_mib is None:
         args.decode_mib = 256 if on_tpu else 16
 
-    decode_tput = bench_decode(args.decode_mib * (1 << 20), engine=args.engine)
-    em_tput = bench_em(args.em_chunks, engine=args.engine)
+    if args.phase in (None, "core"):
+        decode_tput = bench_decode(args.decode_mib * (1 << 20), engine=args.engine)
+        em_tput = bench_em(args.em_chunks, engine=args.engine)
+        try:
+            validate_sharded_paths()
+        except Exception as e:  # never let validation sink the headline number
+            log(f"sharded-validation: FAILED {type(e).__name__}: {e}")
+        if args.phase == "core":
+            print(json.dumps({"decode_tput": decode_tput, "em_tput": em_tput}))
+            return 0
+        _print_northstar(decode_tput, em_tput)
+        return 0
 
-    try:
-        validate_sharded_paths()
-    except Exception as e:  # never let validation sink the headline number
-        log(f"sharded-validation: FAILED {type(e).__name__}: {e}")
-
-    if args.extended:
+    if args.phase == "ext1":
         from cpgisland_tpu.models import presets as _presets
 
-        CHR21, CHR1 = 46.7e6, 248e6
         batched_tput = bench_batched_decode(16, 4 << 20, engine=args.engine)
         # Posterior working set is ~72 B/symbol (alpha+beta streams), so it
         # benches at half the decode size to stay well inside HBM.
@@ -545,39 +940,56 @@ def main() -> int:
             args.decode_mib * (1 << 20), engine=args.engine,
             params=_presets.two_state_cpg(), tag="-2state",
         )
+        print(json.dumps({
+            "batched_tput": batched_tput, "posterior_tput": posterior_tput,
+            "em2_tput": em2_tput, "decode2_tput": decode2_tput,
+        }))
+        return 0
+
+    if args.phase == "ext2":
+        # EXACT whole-sequence EM (seq / bucketed seq2d) — the flagship
+        # beyond-the-reference training numbers (VERDICT r3 #3) — plus the
+        # span-scale decode (VERDICT r3 #2): on TPU the production span
+        # constant forces >= 2 spans (320 Mi record > CLEAN_DECODE_SPAN =
+        # 256 Mi); CPU smoke-scales the same code path.
+        from cpgisland_tpu.pipeline import CLEAN_DECODE_SPAN
+
+        em_seq_tput = bench_em_seq(
+            (64 << 20) if on_tpu else (2 << 20), engine=args.engine
+        )
+        em_seq2d_tput = bench_em_seq2d(
+            engine=args.engine, scale=1.0 if on_tpu else 1 / 16
+        )
+        span_d = (
+            bench_span_decode(320 << 20, CLEAN_DECODE_SPAN, engine=args.engine)
+            if on_tpu
+            else bench_span_decode(6 << 20, 4 << 20, engine=args.engine)
+        )
+        print(json.dumps({
+            "em_seq_tput": em_seq_tput, "em_seq2d_tput": em_seq2d_tput,
+            "span_d": span_d,
+        }))
+        return 0
+
+    if args.phase == "ext3":
+        from cpgisland_tpu.pipeline import POSTERIOR_SPAN
+
+        span_p = (
+            bench_span_posterior(128 << 20, POSTERIOR_SPAN, engine=args.engine)
+            if on_tpu
+            else bench_span_posterior(3 << 20, 1 << 21, engine=args.engine)
+        )
         e2e = bench_end_to_end(
             args.e2e_mbases if args.e2e_mbases else (64 if on_tpu else 4),
             engine=args.engine,
         )
-        extras = {
-            "chr21_2state_decode_projected_s": round(CHR21 / decode2_tput, 3),
-            "chr1_8state_decode_plus_islands_projected_v5e8_s": round(
-                CHR1 / (decode_tput * N_CHIPS), 3
-            ),
-            "em_2state_chr1_iters_per_sec_v5e8": round(
-                em2_tput * N_CHIPS / EM_TRAIN_SYMBOLS, 2
-            ),
-            "em_8state_chr1_iters_per_sec_v5e8": round(
-                em_tput * N_CHIPS / EM_TRAIN_SYMBOLS, 2
-            ),
-            "grch38_decode_projected_v5e8_s": round(
-                GRCH38_SYMBOLS / (decode_tput * N_CHIPS), 3
-            ),
-            "batched_decode_genomes_per_sec_v5e8": round(
-                batched_tput * N_CHIPS / GRCH38_SYMBOLS, 3
-            ),
-            "batched_decode_msym_per_sec_chip": round(batched_tput / 1e6, 1),
-            "posterior_msym_per_sec_chip": round(posterior_tput / 1e6, 1),
-            "grch38_posterior_projected_v5e8_s": round(
-                GRCH38_SYMBOLS / (posterior_tput * N_CHIPS), 3
-            ),
-            "posterior_vs_decode": round(posterior_tput / decode_tput, 2),
-            "host_encode_vs_8chip_decode": round(
-                e2e.get("encode_msym_per_s", 0.0) * 1e6 / (decode_tput * N_CHIPS), 2
-            ),
-        }
-        log("extended: " + json.dumps(extras))
+        print(json.dumps({"span_p": span_p, "e2e": e2e}))
+        return 0
 
+    raise AssertionError(f"unhandled phase {args.phase!r}")
+
+
+def _print_northstar(decode_tput: float, em_tput: float) -> None:
     projected = GRCH38_SYMBOLS / (decode_tput * N_CHIPS) + EM_ITERS * EM_TRAIN_SYMBOLS / (
         em_tput * N_CHIPS
     )
@@ -596,6 +1008,113 @@ def main() -> int:
             }
         )
     )
+
+
+def _orchestrate(args) -> int:
+    """--extended parent: run each capture phase in a FRESH process.
+
+    The relay tunnel has been observed degrading into phantom ~0 ms results
+    after ~15 minutes of one process's use (every run so far started healthy
+    and degraded late); short per-phase subprocesses keep each session well
+    under that, the per-config plausibility ceiling turns any residual
+    phantom into a loud phase failure, and the parent relays all child
+    stderr verbatim so the captured artifact is still one stream.
+    """
+    import subprocess
+
+    base = [
+        sys.executable, os.path.abspath(__file__),
+        "--platform", args.platform, "--engine", args.engine,
+        "--em-chunks", str(args.em_chunks),
+    ]
+    if args.decode_mib is not None:
+        base += ["--decode-mib", str(args.decode_mib)]
+    if args.e2e_mbases is not None:
+        base += ["--e2e-mbases", str(args.e2e_mbases)]
+    carry: dict = {}
+    results: dict = {}
+    for phase in ("core", "ext1", "ext2", "ext3"):
+        for attempt in range(3):
+            # NO subprocess timeout: killing a child mid-TPU-execution
+            # wedges the relay's tunnel claim (CLAUDE.md) — a hung phase is
+            # recoverable by the operator, a wedged tunnel is not.
+            proc = subprocess.run(
+                base + ["--phase", phase],
+                capture_output=True, text=True,
+                cwd=os.path.dirname(os.path.abspath(__file__)),
+            )
+            if proc.returncode == 0:
+                break
+            # Phantom results / transient relay failures raise inside the
+            # phase; cool the tunnel down and retry the WHOLE phase fresh
+            # (its stderr is discarded — only a clean pass enters the
+            # captured artifact).
+            err_lines = proc.stderr.strip().splitlines() or ["<no stderr>"]
+            log(
+                f"phase {phase} attempt {attempt + 1} failed "
+                f"(rc={proc.returncode}): ...{err_lines[-1][:200]}"
+            )
+            if attempt < 2:
+                log("cooling down 90 s, then retrying in a fresh process")
+                time.sleep(90)
+        else:
+            raise RuntimeError(
+                f"phase {phase} failed 3 attempts: {proc.stderr[-500:]}"
+            )
+        sys.stderr.write(proc.stderr)
+        sys.stderr.flush()
+        results[phase] = json.loads(proc.stdout.strip().splitlines()[-1])
+        carry.update(
+            {k: v for k, v in results[phase].items() if not isinstance(v, dict)}
+        )
+
+    CHR21, CHR1 = 46.7e6, 248e6
+    decode_tput, em_tput = carry["decode_tput"], carry["em_tput"]
+    span_d, span_p = results["ext2"]["span_d"], results["ext3"]["span_p"]
+    e2e = results["ext3"]["e2e"]
+    extras = {
+        "em_seq_msym_per_sec_chip": round(carry["em_seq_tput"] / 1e6, 1),
+        "em_seq2d_msym_per_sec_chip": round(carry["em_seq2d_tput"] / 1e6, 1),
+        "em_seq_chr1_iters_per_sec_v5e8": round(
+            carry["em_seq_tput"] * N_CHIPS / EM_TRAIN_SYMBOLS, 2
+        ),
+        "span_decode_msym_per_sec_chip": span_d["span_decode_msym_per_s"],
+        "span_decode_overhead_vs_one_pass": span_d["span_decode_overhead"],
+        "span_posterior_msym_per_sec_chip": span_p["span_posterior_msym_per_s"],
+        "span_posterior_overhead_vs_one_pass": span_p[
+            "span_posterior_overhead"
+        ],
+        **{f"span_{k}": v for k, v in span_d.items() if k.startswith("peak_")},
+        "chr21_2state_decode_projected_s": round(
+            CHR21 / carry["decode2_tput"], 3
+        ),
+        "chr1_8state_decode_plus_islands_projected_v5e8_s": round(
+            CHR1 / (decode_tput * N_CHIPS), 3
+        ),
+        "em_2state_chr1_iters_per_sec_v5e8": round(
+            carry["em2_tput"] * N_CHIPS / EM_TRAIN_SYMBOLS, 2
+        ),
+        "em_8state_chr1_iters_per_sec_v5e8": round(
+            em_tput * N_CHIPS / EM_TRAIN_SYMBOLS, 2
+        ),
+        "grch38_decode_projected_v5e8_s": round(
+            GRCH38_SYMBOLS / (decode_tput * N_CHIPS), 3
+        ),
+        "batched_decode_genomes_per_sec_v5e8": round(
+            carry["batched_tput"] * N_CHIPS / GRCH38_SYMBOLS, 3
+        ),
+        "batched_decode_msym_per_sec_chip": round(carry["batched_tput"] / 1e6, 1),
+        "posterior_msym_per_sec_chip": round(carry["posterior_tput"] / 1e6, 1),
+        "grch38_posterior_projected_v5e8_s": round(
+            GRCH38_SYMBOLS / (carry["posterior_tput"] * N_CHIPS), 3
+        ),
+        "posterior_vs_decode": round(carry["posterior_tput"] / decode_tput, 2),
+        "host_encode_vs_8chip_decode": round(
+            e2e.get("encode_msym_per_s", 0.0) * 1e6 / (decode_tput * N_CHIPS), 2
+        ),
+    }
+    log("extended: " + json.dumps(extras))
+    _print_northstar(decode_tput, em_tput)
     return 0
 
 

@@ -51,10 +51,13 @@ from cpgisland_tpu.ops.viterbi_pallas import MAX_PACK_STATES, _interpret, _vspec
 LANE_TILE = 128
 DEFAULT_T_TILE = 512
 # Whole-sequence lane length, swept on v5e with chained (dispatch-latency-
-# free) timing: 8192 -> ~500 Msym/s with the 256-lane fwd/bwd tiles
-# (16384 measured no better; widening the products kernel's lanes measured
-# flat — it is op-bound).  Any multiple of the t-tile compiles now that the
-# products kernel streams t in tiles.  Shared by single-device + shard_map.
+# free) timing: 8192 beat 16384 (no better) and narrower tiles; widening
+# the products kernel's lanes measured flat — it is op-bound.  Any multiple
+# of the t-tile compiles now that the products kernel streams t in tiles.
+# Shared by single-device + shard_map.  The whole-sequence EM throughput
+# this yields is a PUBLISHED, enforced figure now — see the em-seq row in
+# BASELINE.md (bench.py bench_em_seq; tests/test_published_numbers.py keeps
+# it honest), not a comment.
 DEFAULT_LANE_T = 8192
 
 

@@ -11,16 +11,27 @@ decode -> islands pipeline is one fused XLA program with no large transfer.
 Mechanics — all TPU-native cumulative/elementwise ops, chosen for O(1)
 compile scaling (an associative_scan ffill and a size-bounded flatnonzero
 both made XLA:TPU compile time grow superlinearly in T; cummax and one
-scatter do not):
+scatter do not), BLOCKED over time so device temp memory is O(block), not
+O(T) (a whole-record formulation at 320 Mi symbols allocated ~15 GB of
+s32[T] cumsum temporaries and OOMed a v5e chip — found by the r4 span-scale
+bench):
 
-- island membership, run boundaries, and C/G/CpG event masks exactly as the
-  clean-mode host caller computes them;
-- per-run aggregates via cumulative sums plus a forward-fill of each run's
-  opening index and pre-opening cumsums.  Every filled quantity is
-  NONDECREASING in t, so `lax.cummax(where(opening, value, -1))` IS the
-  forward-fill of the last opening's value — no gathers, no segmented scan;
-- the <= ``cap`` surviving calls are compacted with one cumsum-indexed
-  scatter (`.at[target].set(..., mode="drop")` with an overflow dump slot).
+- the path is reshaped to [n_blocks, BLOCK_W] (padded with one background
+  sentinel past the end, which also closes a run at the true end — clean
+  semantics) and reduced by ONE `lax.scan` whose carry threads the run
+  state across blocks: previous position's membership/C flags, cumulative
+  C/G/CpG totals, the open run's anchor (opening index + pre-opening
+  cumsums), the emitted-call count, and the [cap] output columns;
+- within a block: island membership, run boundaries, and C/G/CpG event
+  masks exactly as the clean-mode host caller computes them; per-run
+  aggregates via block cumsums + carried bases, with the open-run anchor
+  forward-filled by `lax.cummax(where(opening, value, -1))` falling back
+  to the carried anchor (every filled quantity is NONDECREASING in t, so
+  the running max over opening positions IS the last opening's value);
+- a run is emitted at its LEAVING position (first background position
+  after it) and compacted into the carried [cap] columns with one
+  cumsum-indexed scatter (`.at[target].set(..., mode="drop")` with an
+  overflow dump slot) at the carried cursor.
 
 Only CLEAN semantics (compat quirk reproduction stays on the host path — it
 exists for byte-fidelity, not throughput).  Parity with
@@ -78,108 +89,170 @@ class IslandCapOverflow(ValueError):
 _F32_BAND = 1e-5
 
 
-def _ffill_at_openings(vals, opening):
-    """Forward-fill each val to the latest opening position's value.
+# Time-block width of the scanned calling reduction: device temp memory is
+# ~40 B x BLOCK_W (~160 MB at 4 Mi) regardless of record length, and a
+# 256 Mi-symbol chromosome takes 64 scan steps of pure elementwise/cumsum
+# work.  Shorter inputs use one block rounded to their size.
+DEFAULT_BLOCK_W = 1 << 22
+
+
+def _ffill_at_openings(vals, opening, carries):
+    """Forward-fill each val to the latest opening position's value, falling
+    back to the carried value from previous blocks before the block's first
+    opening.
 
     Correct ONLY for vals nondecreasing in t (indices and cumsums are): the
     running max over opening positions equals the value at the LAST opening.
-    Positions before the first opening fill with -1 (never read: a closing
-    position always has an opening at or before it).
+    Positions before the first opening anywhere fill with the initial -1
+    carry (never read: a leaving position always has an opening at or
+    before it, whose values are then either block-local or carried).
     """
     return tuple(
-        jax.lax.cummax(jnp.where(opening, v, jnp.int32(-1))) for v in vals
+        jnp.where(
+            (local := jax.lax.cummax(jnp.where(opening, v, jnp.int32(-1))))
+            >= 0,
+            local,
+            c,
+        )
+        for v, c in zip(vals, carries)
     )
 
 
-def _compact(keep, cols, cap):
-    """Pack cols[i][keep] into [cap] slots, in order; overflow drops."""
-    kpos = jnp.cumsum(keep.astype(jnp.int32)) - 1
-    tgt = jnp.where(keep, kpos, cap)  # cap = dump slot, dropped by mode
-    return tuple(
-        jnp.zeros(cap, c.dtype).at[tgt].set(c, mode="drop") for c in cols
-    )
-
-
-def _calls_from_masks(
-    in_mask,
-    is_c,
-    is_g,
-    cg_event,
+def _scan_calls(
+    p2,
+    o2,
+    mask_fn,
+    W: int,
     cap: int,
     min_len: Optional[int],
     gc_threshold: float,
     oe_threshold: float,
 ):
-    """Shared device-side run accounting: membership/event masks -> call
-    columns.  The ONE copy of the cummax-ffill aggregation and thresholds —
-    the 8-state path caller and the observation-based caller both feed it."""
-    T = in_mask.shape[0]
-    idx = jnp.arange(T, dtype=jnp.int32)
-    prev_in = jnp.concatenate([jnp.zeros(1, bool), in_mask[:-1]])
-    opening = in_mask & ~prev_in
-    next_in = jnp.concatenate([in_mask[1:], jnp.zeros(1, bool)])
-    closing = in_mask & ~next_in  # clean mode: a run at the end still closes
+    """Blocked run accounting: [nB, W] path (+obs) blocks -> call columns.
 
-    cum_c = jnp.cumsum(is_c.astype(jnp.int32))
-    cum_g = jnp.cumsum(is_g.astype(jnp.int32))
-    cum_cg = jnp.cumsum(cg_event.astype(jnp.int32))
-
-    # Propagate each run's opening index and PRE-opening cumsums to every
-    # position of the run (so in particular to its closing position).
-    start_idx, c0, g0, cg0 = _ffill_at_openings(
-        (
-            idx,
-            cum_c - is_c.astype(jnp.int32),
-            cum_g - is_g.astype(jnp.int32),
-            cum_cg,  # cg_event is False at openings (prev_in is False there)
-        ),
-        opening,
+    The ONE copy of the aggregation and thresholds — the 8-state path caller
+    and the observation-based caller differ only in ``mask_fn``, which maps
+    a (path block, obs block) to elementwise (in_mask, is_c, is_g, cgp)
+    where ``cgp`` is the "this position is a C" indicator whose SHIFT gates
+    the CpG event (is_c for the 8-state labeling, raw obs==C for the
+    observation-based caller — matching ops.islands exactly).
+    """
+    nB = p2.shape[0]
+    idx0 = jnp.arange(W, dtype=jnp.int32)
+    carry0 = (
+        jnp.asarray(False),  # prev_in: membership of the previous position
+        jnp.asarray(False),  # prev_cgp
+        jnp.int32(0), jnp.int32(0), jnp.int32(0),  # C/G/CpG cum bases
+        jnp.int32(-1), jnp.int32(-1), jnp.int32(-1), jnp.int32(-1),  # anchor
+        jnp.int32(0),  # emitted-call cursor
+        tuple(jnp.zeros(cap, jnp.int32) for _ in range(6)),  # output columns
     )
 
-    length = idx - start_idx + 1
-    c_cnt = cum_c - c0
-    g_cnt = cum_g - g0
-    cg_cnt = cum_cg - cg0
+    def body(carry, inp):
+        (prev_in, prev_cgp, c_base, g_base, cg_base,
+         o_start, o_c0, o_g0, o_cg0, n, bufs) = carry
+        b_i, p, o = inp
+        in_mask, is_c, is_g, cgp = mask_fn(p, o)
+        gidx = b_i * W + idx0
+        prev_in_v = jnp.concatenate([prev_in[None], in_mask[:-1]])
+        prev_cgp_v = jnp.concatenate([prev_cgp[None], cgp[:-1]])
+        # is_g implies in_mask, so this is the host caller's
+        # in_mask & prev_in & is_g & prev_c.
+        cg_event = is_g & prev_in_v & prev_cgp_v
+        opening = in_mask & ~prev_in_v
+        # A run is EMITTED at its leaving position (first background
+        # position after it): the one-past-the-end padding guarantees every
+        # run — including one at the true end of the record — leaves.
+        leaving = prev_in_v & ~in_mask
 
-    lengthf = length.astype(jnp.float32)
-    gc = (c_cnt + g_cnt).astype(jnp.float32) / lengthf
-    both = (c_cnt > 0) & (g_cnt > 0)
-    # c*g in float32, not int32: a ~92k-symbol GC-rich run overflows the
-    # int32 product and would silently fail the oe filter.
-    cgprod = c_cnt.astype(jnp.float32) * g_cnt.astype(jnp.float32)
-    oe = jnp.where(
-        both,
-        cg_cnt.astype(jnp.float32) * lengthf / jnp.where(both, cgprod, 1.0),
-        0.0,
+        cum_c = c_base + jnp.cumsum(is_c.astype(jnp.int32))
+        cum_g = g_base + jnp.cumsum(is_g.astype(jnp.int32))
+        cum_cg = cg_base + jnp.cumsum(cg_event.astype(jnp.int32))
+
+        # Propagate the open run's anchor (opening index + PRE-opening
+        # cumsums) to every position; carried across blocks for runs that
+        # span them.  cg_event is False at openings (prev_in is False).
+        start_f, c0_f, g0_f, cg0_f = _ffill_at_openings(
+            (
+                gidx,
+                cum_c - is_c.astype(jnp.int32),
+                cum_g - is_g.astype(jnp.int32),
+                cum_cg,
+            ),
+            opening,
+            (o_start, o_c0, o_g0, o_cg0),
+        )
+
+        # At a leaving position t the run's last index is t-1, and the
+        # position itself contributes no counts (it is background).
+        length = gidx - start_f
+        c_cnt = cum_c - c0_f
+        g_cnt = cum_g - g0_f
+        cg_cnt = cum_cg - cg0_f
+
+        lengthf = length.astype(jnp.float32)
+        gc = (c_cnt + g_cnt).astype(jnp.float32) / jnp.maximum(lengthf, 1.0)
+        both = (c_cnt > 0) & (g_cnt > 0)
+        # c*g in float32, not int32: a ~92k-symbol GC-rich run overflows the
+        # int32 product and would silently fail the oe filter.
+        cgprod = c_cnt.astype(jnp.float32) * g_cnt.astype(jnp.float32)
+        oe = jnp.where(
+            both,
+            cg_cnt.astype(jnp.float32) * lengthf / jnp.where(both, cgprod, 1.0),
+            0.0,
+        )
+
+        # The float cuts here are CONSERVATIVE, not final: without x64
+        # there is no f64 on device, and f32 gc/oe carry up to ~6e-7
+        # relative rounding.  The device keeps everything within a 1e-5
+        # relative band of each threshold; _fetch_calls re-evaluates the
+        # survivors exactly in f64 on the host from the compacted integer
+        # counts, so the emitted set (and the published gc/oe values) are
+        # bit-identical to ops.islands.  The default gc cut evaluates
+        # integer-exactly on device (2*(C+G) > len) — no band at all.
+        if gc_threshold == 0.5:
+            gc_pass = 2 * (c_cnt + g_cnt) > length
+        else:
+            gc_pass = gc > gc_threshold - _F32_BAND * abs(gc_threshold)
+        oe_pass = oe > oe_threshold - _F32_BAND * abs(oe_threshold)
+        keep = leaving & gc_pass & oe_pass
+        if min_len is not None:
+            keep &= length > min_len
+
+        # Compact this block's survivors at the carried cursor (cap = dump
+        # slot, dropped by mode="drop"; kpos is unique within the block).
+        kpos = jnp.cumsum(keep.astype(jnp.int32)) - 1
+        tgt = jnp.where(keep, n + kpos, cap)
+        cols = (start_f, gidx - 1, length, c_cnt, g_cnt, cg_cnt)
+        bufs = tuple(
+            b.at[tgt].set(v, mode="drop") for b, v in zip(bufs, cols)
+        )
+        carry = (
+            in_mask[-1], cgp[-1], cum_c[-1], cum_g[-1], cum_cg[-1],
+            start_f[-1], c0_f[-1], g0_f[-1], cg0_f[-1],
+            n + jnp.sum(keep.astype(jnp.int32)), bufs,
+        )
+        return carry, None
+
+    carry, _ = jax.lax.scan(
+        body, carry0, (jnp.arange(nB, dtype=jnp.int32), p2, o2)
     )
+    return (*carry[-1], carry[-2])
 
-    # The float cuts here are CONSERVATIVE, not final: without x64 there is
-    # no f64 on device, and f32 gc/oe carry up to ~6e-7 relative rounding
-    # (int->f32 conversions at 2^28 magnitudes plus 3 arithmetic ops).  The
-    # device keeps everything within a 1e-5 relative band of each threshold;
-    # _fetch_calls re-evaluates the survivors exactly in f64 on the host
-    # from the compacted integer counts, so the emitted set (and the
-    # published gc/oe values) are bit-identical to ops.islands.  The default
-    # gc cut evaluates integer-exactly on device (2*(C+G) > len), so it
-    # needs no band at all.
-    if gc_threshold == 0.5:
-        gc_pass = 2 * (c_cnt + g_cnt) > length
-    else:
-        gc_pass = gc > gc_threshold - _F32_BAND * abs(gc_threshold)
-    oe_pass = oe > oe_threshold - _F32_BAND * abs(oe_threshold)
-    keep = closing & gc_pass & oe_pass
-    if min_len is not None:
-        keep &= length > min_len
 
-    n = jnp.sum(keep.astype(jnp.int32))
-    starts_o, lasts_o, len_o, c_o, g_o, cg_o = _compact(
-        keep, (start_idx, idx, length, c_cnt, g_cnt, cg_cnt), cap
-    )
-    return starts_o, lasts_o, len_o, c_o, g_o, cg_o, n
+def _block_layout(T: int, block_w: int) -> tuple:
+    """(n_blocks, W, pad): pad >= 1 so the final position is background and
+    every run leaves (the clean-mode a-run-at-the-end-still-closes rule)."""
+    W = 1 << 10
+    while W < min(block_w, T + 1):
+        W <<= 1
+    nB = -(-(T + 1) // W)
+    return nB, W, nB * W - T
 
 
 @functools.partial(
-    jax.jit, static_argnames=("cap", "min_len", "gc_threshold", "oe_threshold")
+    jax.jit,
+    static_argnames=("cap", "min_len", "gc_threshold", "oe_threshold", "block_w"),
 )
 def _device_calls(
     path,
@@ -187,27 +260,40 @@ def _device_calls(
     min_len: Optional[int],
     gc_threshold: float,
     oe_threshold: float,
+    block_w: int = DEFAULT_BLOCK_W,
 ):
     """Jitted 8-state core: [T] path -> fixed-size call columns + count.
 
     Base identity comes from the state ids (the reference's X+/X- labeling,
-    CpGIslandFinder.java:182-189): state 1 = C+, state 2 = G+.
+    CpGIslandFinder.java:182-189): state 1 = C+, state 2 = G+.  The input
+    keeps its storage dtype (int8 span paths stay int8); each block casts
+    on the fly.
     """
-    path = path.astype(jnp.int32)
-    in_mask = path < N_ISLAND_STATES
-    prev_in = jnp.concatenate([jnp.zeros(1, bool), in_mask[:-1]])
-    is_c = in_mask & (path == C_STATE)
-    is_g = in_mask & (path == G_STATE)
-    prev_c = jnp.concatenate([jnp.zeros(1, bool), is_c[:-1]])
-    cg_event = in_mask & prev_in & is_g & prev_c
-    return _calls_from_masks(
-        in_mask, is_c, is_g, cg_event, cap, min_len, gc_threshold, oe_threshold
+    T = path.shape[0]
+    nB, W, pad = _block_layout(T, block_w)
+    p2 = jnp.concatenate(
+        [path, jnp.full(pad, N_ISLAND_STATES, path.dtype)]
+    ).reshape(nB, W)
+
+    def mask_fn(p, _o):
+        p = p.astype(jnp.int32)
+        in_mask = p < N_ISLAND_STATES
+        is_c = in_mask & (p == C_STATE)
+        is_g = in_mask & (p == G_STATE)
+        return in_mask, is_c, is_g, is_c
+
+    # o2 = p2: unused by mask_fn, same buffer — no second [T] allocation.
+    return _scan_calls(
+        p2, p2, mask_fn, W, cap, min_len, gc_threshold, oe_threshold
     )
 
 
 @functools.partial(
     jax.jit,
-    static_argnames=("island_states", "cap", "min_len", "gc_threshold", "oe_threshold"),
+    static_argnames=(
+        "island_states", "cap", "min_len", "gc_threshold", "oe_threshold",
+        "block_w",
+    ),
 )
 def _device_calls_obs(
     path,
@@ -217,28 +303,35 @@ def _device_calls_obs(
     min_len: Optional[int],
     gc_threshold: float,
     oe_threshold: float,
+    block_w: int = DEFAULT_BLOCK_W,
 ):
     """Jitted generic core: membership from ``path`` in ``island_states``
     (static tuple — unrolled compares, no gather), base composition from the
     OBSERVATIONS (symbol ids 0..3 = acgt) — the device twin of
     ops.islands.call_islands_obs for models whose states don't encode bases
     (e.g. presets.two_state_cpg)."""
-    path = path.astype(jnp.int32)
-    obs = obs.astype(jnp.int32)
-    in_mask = jnp.zeros(path.shape, bool)
-    for s in island_states:
-        in_mask = in_mask | (path == s)
-    prev_in = jnp.concatenate([jnp.zeros(1, bool), in_mask[:-1]])
-    obs_c = obs == 1  # codec.C
-    obs_g = obs == 2  # codec.G
-    is_c = in_mask & obs_c
-    is_g = in_mask & obs_g
-    cg_event = (
-        in_mask & prev_in & obs_g
-        & jnp.concatenate([jnp.zeros(1, bool), obs_c[:-1]])
-    )
-    return _calls_from_masks(
-        in_mask, is_c, is_g, cg_event, cap, min_len, gc_threshold, oe_threshold
+    T = path.shape[0]
+    nB, W, pad = _block_layout(T, block_w)
+    p2 = jnp.concatenate(
+        # n_states: an id no model state uses -> padding is background for
+        # every island_states set.
+        [path, jnp.full(pad, len(island_states) and max(island_states) + 1, path.dtype)]
+    ).reshape(nB, W)
+    o2 = jnp.concatenate([obs, jnp.zeros(pad, obs.dtype)]).reshape(nB, W)
+
+    def mask_fn(p, o):
+        p = p.astype(jnp.int32)
+        o = o.astype(jnp.int32)
+        in_mask = jnp.zeros(p.shape, bool)
+        for s in island_states:
+            in_mask = in_mask | (p == s)
+        obs_c = o == 1  # codec.C
+        is_c = in_mask & obs_c
+        is_g = in_mask & (o == 2)  # codec.G
+        return in_mask, is_c, is_g, obs_c
+
+    return _scan_calls(
+        p2, o2, mask_fn, W, cap, min_len, gc_threshold, oe_threshold
     )
 
 

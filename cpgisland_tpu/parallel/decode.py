@@ -305,25 +305,12 @@ def viterbi_sharded_spans(
     pad_sym = params.n_symbols
     n_spans = -(-T // span)
 
-    # Sweep A (forward): normalized span transfer operators -> every span's
-    # exact entering score vector, composed on host (tiny [K]x[K,K] max-plus).
-    # A PAD first symbol contributes no emission (the pass-through contract,
-    # matching emit_ext's zero pad row in the one-shot decode).
-    v = np.asarray(params.log_pi, np.float32)
-    if int(obs[0]) < params.n_symbols:
-        v = v + np.asarray(params.log_B, np.float32)[:, int(obs[0])]
-    enters = [v - v.max()]
-    for s in range(n_spans - 1):
-        arr = _place_span(mesh, obs[s * span : (s + 1) * span], block_size, pad_sym)
-        total = np.asarray(_span_total_fn(mesh, block_size, eng, s > 0)(params, arr))
-        v = (enters[-1][:, None] + total).max(axis=0)
-        enters.append((v - v.max()).astype(np.float32))
-
-    # Sweep B (reverse): decode each span anchored at the following span's
-    # entry state; prev_exit threads the anchor to the earlier span.
-    paths: list = [None] * n_spans
-    anchor = -1  # last span: local argmax
-    for s in reversed(range(n_spans)):
+    # Each span's symbols are device-placed ONCE and reused by both sweeps:
+    # the host->device upload is the dominant cost of the span path on any
+    # interconnect (PCIe or this dev setup's HTTP relay), and sweep A + B
+    # would otherwise pay it twice.  Holding every span = the record's own
+    # size in HBM (uint8), freed span by span as sweep B consumes them.
+    def place(s: int):
         lo = s * span
         real = min(span, T - lo)
         piece = obs[lo : lo + real]
@@ -334,11 +321,38 @@ def viterbi_sharded_spans(
             piece = np.concatenate(
                 [piece, np.full(span - real, pad_sym, piece.dtype)]
             )
-        arr = _place_span(mesh, piece, block_size, pad_sym)
+        return _place_span(mesh, piece, block_size, pad_sym)
+
+    placed: dict = {}
+
+    # Sweep A (forward): normalized span transfer operators -> every span's
+    # exact entering score vector, composed on host (tiny [K]x[K,K] max-plus).
+    # A PAD first symbol contributes no emission (the pass-through contract,
+    # matching emit_ext's zero pad row in the one-shot decode).
+    v = np.asarray(params.log_pi, np.float32)
+    if int(obs[0]) < params.n_symbols:
+        v = v + np.asarray(params.log_B, np.float32)[:, int(obs[0])]
+    enters = [v - v.max()]
+    for s in range(n_spans - 1):
+        placed[s] = place(s)
+        total = np.asarray(
+            _span_total_fn(mesh, block_size, eng, s > 0)(params, placed[s])
+        )
+        v = (enters[-1][:, None] + total).max(axis=0)
+        enters.append((v - v.max()).astype(np.float32))
+
+    # Sweep B (reverse): decode each span anchored at the following span's
+    # entry state; prev_exit threads the anchor to the earlier span.
+    paths: list = [None] * n_spans
+    anchor = -1  # last span: local argmax
+    for s in reversed(range(n_spans)):
+        arr = placed.pop(s, None)
+        if arr is None:  # the tail span — sweep A never placed it
+            arr = place(s)
         fn = _sharded_fn(mesh, block_size, eng, s > 0)
         path, prev_exit = fn(
             params, arr, jnp.asarray(enters[s]), jnp.int32(anchor)
         )
         anchor = int(jax.device_get(prev_exit))
-        paths[s] = _fetch_path(path, real, return_device)
+        paths[s] = _fetch_path(path, min(span, T - s * span), return_device)
     return paths

@@ -170,6 +170,29 @@ def island_mask(params: HmmParams, island_states) -> np.ndarray:
     return mask
 
 
+def place_record_span(
+    params: HmmParams,
+    piece,
+    *,
+    mesh: Optional[Mesh] = None,
+    block_size: int = DEFAULT_BLOCK,
+    pad_to: Optional[int] = None,
+):
+    """Device-place one span's symbols ONCE for both span sweeps.
+
+    The span-threaded posterior uploads each span for the transfer-total
+    sweep and again for the posterior sweep unless the caller pre-places it
+    here and passes the result as ``placed=`` to transfer_total_sharded and
+    posterior_sharded — halving the host->device transfer, the dominant
+    span-path cost on any interconnect.
+    """
+    if mesh is None:
+        mesh = make_mesh(axis=SEQ_AXIS)
+    return _place(
+        mesh, np.asarray(piece), block_size, params.n_symbols, pad_to=pad_to
+    )
+
+
 def posterior_sharded(
     params: HmmParams,
     obs,
@@ -186,6 +209,7 @@ def posterior_sharded(
     want_path: bool = False,
     return_device: bool = False,
     pad_to: Optional[int] = None,
+    placed=None,
 ):
     """Island confidence (and optional MPM path) for one sequence, sharded
     along time over the mesh.
@@ -194,17 +218,25 @@ def posterior_sharded(
     for records processed in multiple spans (pipeline.posterior_file);
     defaults are the sequence start (``first=True``) and the free end.
     ``pad_to`` bucket-pads the input so varied record sizes share compiled
-    shapes.  Returns (conf [T] f32, path [T] int32 or None).
+    shapes.  ``placed`` (from place_record_span) reuses an already-uploaded
+    (arr, lens) pair instead of re-placing ``obs`` — ``obs`` then only
+    supplies the true length.  Returns (conf [T] f32, path [T] int32 or
+    None).
     """
     if mesh is None:
         mesh = make_mesh(axis=SEQ_AXIS)
     eng = resolve_fb_engine(engine, params)
     lt = lane_T if lane_T is not None else fb_pallas.DEFAULT_LANE_T
     tt = t_tile if t_tile is not None else fb_pallas.DEFAULT_T_TILE
-    obs = np.asarray(obs)
-    T = obs.shape[0]
+    T = int(np.asarray(obs).shape[0]) if placed is None else int(obs.shape[0])
     K = params.n_states
-    arr, lens = _place(mesh, obs, block_size, params.n_symbols, pad_to=pad_to)
+    arr, lens = (
+        placed
+        if placed is not None
+        else _place(
+            mesh, np.asarray(obs), block_size, params.n_symbols, pad_to=pad_to
+        )
+    )
     mask = jnp.asarray(island_mask(params, island_states))
     enter = (
         jnp.zeros(K, jnp.float32) if enter_dir is None
@@ -230,15 +262,24 @@ def transfer_total_sharded(
     engine: str = "auto",
     first: bool = True,
     pad_to: Optional[int] = None,
+    placed=None,
 ) -> np.ndarray:
     """One span's normalized [K, K] probability-space transfer operator
-    (sweep A of span-threaded posterior processing)."""
+    (sweep A of span-threaded posterior processing).  ``placed`` (from
+    place_record_span) reuses an already-uploaded span; ``obs`` then only
+    supplies the true length."""
     if mesh is None:
         mesh = make_mesh(axis=SEQ_AXIS)
     n_dev = mesh.shape[mesh.axis_names[0]]
     if n_dev == 1 and resolve_fb_engine(engine, params) == "pallas":
         # Single-chip TPU: the products Pallas kernel is much faster than
         # the XLA lane scan for this sweep.
+        if placed is not None:
+            return np.asarray(
+                fb_pallas.seq_transfer_total_pallas(
+                    params, placed[0], int(obs.shape[0]), first=first
+                )
+            )
         obs = np.asarray(obs)
         n = obs.shape[0]
         if pad_to is not None and pad_to > n:
@@ -250,7 +291,11 @@ def transfer_total_sharded(
                 params, jnp.asarray(obs), n, first=first
             )
         )
-    arr, lens = _place(
-        mesh, np.asarray(obs), block_size, params.n_symbols, pad_to=pad_to
+    arr, lens = (
+        placed
+        if placed is not None
+        else _place(
+            mesh, np.asarray(obs), block_size, params.n_symbols, pad_to=pad_to
+        )
     )
     return np.asarray(_transfer_total_fn(mesh, block_size, first)(params, arr, lens))

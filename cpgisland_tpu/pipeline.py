@@ -763,6 +763,7 @@ def posterior_file(
     from cpgisland_tpu.parallel.mesh import fetch_sharded_prefix
     from cpgisland_tpu.parallel.posterior import (
         island_mask,
+        place_record_span,
         posterior_sharded,
         resolve_fb_engine,
         transfer_total_sharded,
@@ -1015,15 +1016,24 @@ def posterior_file(
             )
             # Sweep A: each span's [K, K] transfer operator (products only).
             # pad_to=span: every span (incl. the ragged tail) shares ONE
-            # compiled shape.
+            # compiled shape.  Each span is device-placed ONCE here and
+            # reused by sweep B (popped as consumed): the upload is the
+            # dominant span-path cost on any interconnect, and the two
+            # sweeps would otherwise pay it twice.
+            span_placed: dict = {}
             with timer.phase("span-totals", items=float(symbols.size), unit="sym"):
-                totals = [
-                    transfer_total_sharded(
-                        params, symbols[lo : lo + span], engine=engine,
-                        first=lo == 0, pad_to=span,
+                totals = []
+                for si, lo in enumerate(range(0, symbols.size, span)):
+                    piece = symbols[lo : lo + span]
+                    span_placed[si] = place_record_span(
+                        params, piece, pad_to=span
                     )
-                    for lo in range(0, symbols.size, span)
-                ]
+                    totals.append(
+                        transfer_total_sharded(
+                            params, piece, engine=engine, first=lo == 0,
+                            pad_to=span, placed=span_placed[si],
+                        )
+                    )
             # Host threading: entering-alpha / exiting-beta directions per
             # span (tiny [K]x[K,K] chains, f32 on normalized operators).
             pi = np.exp(np.asarray(params.log_pi, np.float64))
@@ -1058,6 +1068,7 @@ def posterior_file(
                         exit_dir=exits[s], first=s == 0,
                         want_path=want_path, pad_to=span,
                         return_device=use_device_islands,
+                        placed=span_placed.pop(s),
                     )
                 if use_device_islands:
                     if want_conf:

@@ -206,6 +206,62 @@ def test_gc_threshold_nondefault_band_refine(rng):
         _assert_same(dev, host)
 
 
+def test_blocked_scan_boundaries(rng):
+    """The calling reduction is BLOCKED (lax.scan over time-blocks, r4: a
+    whole-record formulation OOMed at 320 Mi symbols); with a tiny block
+    width, runs and CpG pairs straddling block boundaries — including runs
+    spanning several whole blocks — must come out identical to the host
+    caller, for both the 8-state and the observation-based engines."""
+    from cpgisland_tpu.ops.islands_device import (
+        _device_calls,
+        call_islands_device_obs,
+    )
+
+    # Random islandy path: many runs of random lengths around the 1 Ki
+    # minimum block width would not cross blocks, so drive _device_calls
+    # directly at block_w=1024 with multi-Ki runs.
+    parts = []
+    for _ in range(40):
+        parts.append(rng.integers(4, 8, size=rng.integers(1, 700)))
+        parts.append(rng.choice([1, 2], size=rng.integers(500, 3000)))
+    path = np.concatenate(parts).astype(np.int32)
+    cols = _device_calls(path, 1 << 17, None, 0.5, 0.6, block_w=1024)
+    from cpgisland_tpu.ops.islands_device import _fetch_calls
+
+    dev = _fetch_calls(cols, 1 << 17, 0, 0.5, 0.6)
+    _assert_same(dev, _host(path))
+
+    # A C at the last position of one block followed by G at the first of
+    # the next must still count as ONE CpG event: build an exact fixture.
+    W = 1024
+    p = np.full(3 * W, 4, np.int32)
+    p[W - 300 : W + 300] = 1  # C+ run crossing the 1st boundary
+    p[W + 300 : W + 600] = 2  # then G+ (CG pair exactly inside the run)
+    p[W - 1] = 1
+    p[W] = 2  # explicit C|G straddling the boundary (inside the run)
+    cols = _device_calls(p, 1 << 17, None, 0.5, 0.6, block_w=W)
+    dev = _fetch_calls(cols, 1 << 17, 0, 0.5, 0.6)
+    _assert_same(dev, _host(p))
+
+    # Observation-based engine with runs >> block width (spanning multiple
+    # whole blocks).
+    T = 6000
+    path2 = np.zeros(T, np.int32)
+    path2[:200] = 1
+    path2[5800:] = 1  # background heads/tails; 5600-long island run
+    obs = rng.integers(0, 4, size=T).astype(np.uint8)
+    from cpgisland_tpu.ops import islands as host_islands
+    from cpgisland_tpu.ops.islands_device import _device_calls_obs
+
+    cols = _device_calls_obs(
+        jnp.asarray(path2), jnp.asarray(obs), (0,), 1 << 17, None, 0.5, 0.6,
+        block_w=1024,
+    )
+    dev = _fetch_calls(cols, 1 << 17, 0, 0.5, 0.6)
+    host = host_islands.call_islands_obs(path2, obs, island_states=(0,))
+    _assert_same(dev, host)
+
+
 def test_decode_file_island_engine_parity(tmp_path, rng):
     """decode_file(island_engine='device') == 'host' on a planted-island file."""
     from cpgisland_tpu import pipeline

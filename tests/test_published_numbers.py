@@ -1,22 +1,35 @@
 """Published figures cannot drift from the captured bench artifact.
 
-VERDICT r2 #6: README/BASELINE headline figures must derive from a captured
-machine-readable artifact, not hand-copying.  tools/pubnum.py owns the
-parse + marker check; this test runs it, and additionally cross-checks the
-north-star seconds against the LATEST driver BENCH_r*.json within a variance
-band (run-to-run TPU noise is real — CLAUDE.md notes transient slowdowns —
-but a figure drifting by >35% means the docs describe a different build).
+VERDICT r2 #6 + r3 #8: README/BASELINE headline figures must derive from a
+captured machine-readable artifact, not hand-copying.  tools/pubnum.py owns
+the parse + marker check; this test runs it, and additionally:
+
+- cross-checks EVERY per-kernel figure the latest driver BENCH_r*.json tail
+  carries against the captured artifact within 20% (run-to-run TPU noise is
+  real — CLAUDE.md notes transient slowdowns — but a figure off by >20%
+  means the docs describe a different build);
+- fails when the captured artifact's round suffix LAGS the newest driver
+  BENCH_r*.json — a stale capture can't keep certifying newer code.
 """
 
 import glob
 import json
 import os
+import re
 import sys
 
 import pytest
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, os.path.join(REPO, "tools"))
+
+
+def _latest_driver():
+    bench_files = sorted(glob.glob(os.path.join(REPO, "BENCH_r*.json")))
+    if not bench_files:
+        return None, None
+    with open(bench_files[-1]) as f:
+        return bench_files[-1], json.load(f)
 
 
 def test_docs_match_captured_artifact():
@@ -27,18 +40,48 @@ def test_docs_match_captured_artifact():
     assert not problems, "\n".join(problems)
 
 
-def test_northstar_agrees_with_latest_driver_record():
+def test_captured_artifact_not_stale():
+    """The capture's round suffix must not lag the newest driver record:
+    bench_captured_r{N} with BENCH_r{M}.json present and N < M means the
+    published figures certify a build at least one round old."""
+    import pubnum
+
+    _, _, cap_round = pubnum.capture_paths(REPO)
+    path, _ = _latest_driver()
+    if path is None:
+        pytest.skip("no driver BENCH_r*.json present")
+    driver_round = int(re.search(r"BENCH_r(\d+)\.json$", path).group(1))
+    assert cap_round >= driver_round, (
+        f"captured artifact is r{cap_round:02d} but the newest driver record "
+        f"is r{driver_round:02d} — re-run `python bench.py --extended` with "
+        f"captures to bench_captured_r{driver_round:02d}.* and then "
+        "`python tools/pubnum.py --write`"
+    )
+
+
+def test_driver_tail_figures_agree_with_capture():
+    """EVERY figure the latest driver tail carries (decode/em Msym/s, the
+    north-star split) must agree with the captured artifact within 20% —
+    not just the headline seconds (VERDICT r3 #8)."""
     import pubnum
 
     vals = pubnum.parse_captured(REPO)
-    bench_files = sorted(glob.glob(os.path.join(REPO, "BENCH_r*.json")))
-    if not bench_files:
+    path, driver = _latest_driver()
+    if path is None:
         pytest.skip("no driver BENCH_r*.json present")
-    with open(bench_files[-1]) as f:
-        driver = json.load(f)
-    driver_val = driver["parsed"]["value"]
-    doc_val = vals["northstar_value"]
-    assert abs(driver_val - doc_val) / driver_val < 0.35, (
-        f"doc north star {doc_val}s vs driver {bench_files[-1]} "
-        f"{driver_val}s — re-capture the artifact (tools/pubnum.py --write)"
-    )
+    tail_vals = pubnum.parse_lines(driver["tail"].splitlines())
+    tail_vals["northstar_value"] = driver["parsed"]["value"]
+    checked = 0
+    problems = []
+    for key, dv in tail_vals.items():
+        if key not in vals or not isinstance(dv, (int, float)) or dv == 0:
+            continue
+        cv = vals[key]
+        checked += 1
+        if abs(dv - cv) / abs(dv) >= 0.20:
+            problems.append(
+                f"{key}: driver {path} says {dv}, captured artifact says "
+                f"{cv} (>20% apart) — re-capture (tools/pubnum.py --write)"
+            )
+    assert checked >= 3, f"driver tail carried too few figures ({checked})"
+    assert not problems, "\n".join(problems)

@@ -20,6 +20,7 @@ with the docs within a variance band.
 
 from __future__ import annotations
 
+import glob
 import json
 import os
 import re
@@ -27,9 +28,21 @@ import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
-CAPTURE_STDERR = "bench_captured_r03.stderr.txt"
-CAPTURE_STDOUT = "bench_captured_r03.stdout.json"
 DOCS = ("README.md", "BASELINE.md")
+
+
+def capture_paths(repo: str = REPO) -> tuple:
+    """(stderr_path, stdout_path, round) of the NEWEST captured artifact.
+
+    Discovered, not hardcoded: tests/test_published_numbers.py additionally
+    fails when this round lags the newest driver BENCH_r*.json — a stale
+    capture can't silently keep certifying new code (VERDICT r3 #8)."""
+    cands = sorted(glob.glob(os.path.join(repo, "bench_captured_r*.stderr.txt")))
+    if not cands:
+        raise FileNotFoundError("no bench_captured_r*.stderr.txt artifact")
+    stderr_p = cands[-1]
+    rnd = int(re.search(r"_r(\d+)\.stderr\.txt$", stderr_p).group(1))
+    return stderr_p, stderr_p.replace(".stderr.txt", ".stdout.json"), rnd
 
 _LINE_PATTERNS = {
     "decode_msym": r"^decode\[\w+\]:\s+([\d.]+) Msym/s",
@@ -38,6 +51,10 @@ _LINE_PATTERNS = {
     "em2_msym": r"^em-2state\[\w+\]:\s+([\d.]+) Msym/s/iter",
     "batched_msym": r"^batched-decode\[\w+\]:\s+([\d.]+) Msym/s",
     "posterior_msym": r"^posterior\[\w+\]:\s+([\d.]+) Msym/s",
+    "em_seq_msym": r"^em-seq\[\w+\]:\s+([\d.]+) Msym/s/iter",
+    "em_seq2d_msym": r"^em-seq2d\[\w+\]:\s+([\d.]+) Msym/s/iter",
+    "span_decode_msym": r"^span-decode\[\w+\]:\s+([\d.]+) Msym/s",
+    "span_posterior_msym": r"^span-posterior\[\w+\]:\s+([\d.]+) Msym/s",
     "northstar_s": r"^projected v5e-8 north-star workload:\s+([\d.]+) s",
     "northstar_decode_s": r"north-star workload:.*\(decode ([\d.]+) s",
     "northstar_em_s": r"north-star workload:.*10 EM iters ([\d.]+) s\)",
@@ -46,24 +63,33 @@ _LINE_PATTERNS = {
 _NUM_RE = re.compile(r"<!--num:([\w.]+)-->([-\d.]+)<!--/num-->")
 
 
+def parse_lines(lines) -> dict:
+    """Figure dict from bench stderr lines (shared by the captured-artifact
+    parse and the driver-tail cross-check in test_published_numbers.py)."""
+    vals: dict = {}
+    for line in lines:
+        line = line.strip()
+        for key, pat in _LINE_PATTERNS.items():
+            m = re.search(pat, line)
+            if m:
+                vals[key] = float(m.group(1))
+        if line.startswith("extended: "):
+            vals.update(json.loads(line[len("extended: "):]))
+        m = re.match(r"end-to-end \([\d]+ Mbase file\): (\{.*\})", line)
+        if m:
+            vals.update(
+                {f"e2e_{k}": v for k, v in json.loads(m.group(1)).items()}
+            )
+    return vals
+
+
 def parse_captured(repo: str = REPO) -> dict:
     """Canonical figure dict from the captured artifact pair."""
-    vals: dict = {}
-    with open(os.path.join(repo, CAPTURE_STDERR)) as f:
-        for line in f:
-            line = line.strip()
-            for key, pat in _LINE_PATTERNS.items():
-                m = re.search(pat, line)
-                if m:
-                    vals[key] = float(m.group(1))
-            if line.startswith("extended: "):
-                vals.update(json.loads(line[len("extended: "):]))
-            m = re.match(r"end-to-end \([\d]+ Mbase file\): (\{.*\})", line)
-            if m:
-                vals.update(
-                    {f"e2e_{k}": v for k, v in json.loads(m.group(1)).items()}
-                )
-    with open(os.path.join(repo, CAPTURE_STDOUT)) as f:
+    stderr_p, stdout_p, rnd = capture_paths(repo)
+    with open(stderr_p) as f:
+        vals = parse_lines(f)
+    vals["capture_round"] = rnd
+    with open(stdout_p) as f:
         out = json.loads(f.read().strip())
     vals["northstar_value"] = out["value"]
     vals["vs_baseline"] = out["vs_baseline"]
