@@ -141,6 +141,7 @@ def build_parser() -> argparse.ArgumentParser:
         help="island caller placement (clean mode): device keeps the decoded "
         "path on-chip and returns only the call records (auto: device on TPU)",
     )
+    _add_island_cap_flag(d)
     _add_island_states_flag(d)
     _common_flags(d)
 
@@ -176,6 +177,7 @@ def build_parser() -> argparse.ArgumentParser:
         help="island caller placement: device keeps the MPM path on-chip and "
         "returns only the call records (auto: device on TPU when eligible)",
     )
+    _add_island_cap_flag(po)
     _add_island_states_flag(po)
     # Only the flags posterior honors (it is always clean/FASTA-aware) — NOT
     # _common_flags, whose --backend/--numerics/--clean would be silently
@@ -205,6 +207,23 @@ def build_parser() -> argparse.ArgumentParser:
     _common_flags(r)
 
     return ap
+
+
+def _positive_int(s: str) -> int:
+    v = int(s)
+    if v < 1:
+        raise argparse.ArgumentTypeError(f"must be >= 1, got {v}")
+    return v
+
+
+def _add_island_cap_flag(p: argparse.ArgumentParser) -> None:
+    p.add_argument(
+        "--island-cap", type=_positive_int, default=None,
+        help="initial device-side output-buffer size in island calls "
+        "(device engine; default 128 Ki). Overflow retries the calling "
+        "pass at the true count (up to a 4 Mi ceiling against degenerate "
+        "inputs) — this only tunes the starting allocation",
+    )
 
 
 def _add_island_states_flag(p: argparse.ArgumentParser) -> None:
@@ -309,6 +328,7 @@ def _run_command(args, compat, pipeline, presets, load_text) -> int:
             engine=args.engine,
             island_states=island_states,
             island_engine=args.island_engine,
+            island_cap=args.island_cap,
             symbol_cache=args.symbol_cache,
         )
         print(f"decoded {res.n_symbols} symbols in {res.n_chunks} chunks; {len(res.calls)} islands")
@@ -338,6 +358,7 @@ def _run_command(args, compat, pipeline, presets, load_text) -> int:
             island_states=island_states,
             engine=args.engine,
             island_engine=args.island_engine,
+            island_cap=args.island_cap,
             symbol_cache=args.symbol_cache,
         )
         extra = (
