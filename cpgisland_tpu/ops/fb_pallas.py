@@ -50,15 +50,39 @@ from cpgisland_tpu.ops.viterbi_pallas import MAX_PACK_STATES, _interpret, _vspec
 
 LANE_TILE = 128
 DEFAULT_T_TILE = 512
-# Whole-sequence lane length, swept on v5e with chained (dispatch-latency-
-# free) timing: 8192 beat 16384 (no better) and narrower tiles; widening
-# the products kernel's lanes measured flat — it is op-bound.  Any multiple
-# of the t-tile compiles now that the products kernel streams t in tiles.
-# Shared by single-device + shard_map.  The whole-sequence EM throughput
-# this yields is a PUBLISHED, enforced figure now — see the em-seq row in
-# BASELINE.md (bench.py bench_em_seq; tests/test_published_numbers.py keeps
-# it honest), not a comment.
+# Whole-sequence lane length for SMALL inputs; pick_lane_T upgrades big
+# ones.  Any multiple of the t-tile compiles now that the products kernel
+# streams t in tiles.  Shared by single-device + shard_map.  The
+# whole-sequence EM and posterior throughputs are PUBLISHED, enforced
+# figures — see the em-seq / posterior rows in BASELINE.md (bench.py;
+# tests/test_published_numbers.py keeps them honest).
 DEFAULT_LANE_T = 8192
+
+
+# Relative per-padded-symbol kernel rates by lane length, measured on v5e
+# (r4 re-sweep at 64 Mi — the r3 "16384 no better" note predated the
+# tiled-products/bwd-conf kernel reshapes): whole-sequence E-step
+# 354 -> 433 -> 452 Msym/s/iter at 8192/16384/32768 (65536 within noise of
+# 32768; 131072 regressed), fused posterior 520 -> 712 -> 726.
+_LANE_RATE = {8192: 1.0, 16384: 1.25, 32768: 1.30}
+
+
+def pick_lane_T(n: int) -> int:
+    """Lane length for an ``n``-symbol (per-shard) input.
+
+    Minimizes estimated pass time = padded work / measured lane rate: the
+    input pads to a full 128-lane grid of ``lane_T``-long lanes
+    (_lane_layout), so a long lane just past a grid boundary can cost more
+    in padding than its faster rate buys — gating on raw size alone made
+    inputs just above each boundary ~20% slower than the short-lane
+    default.  Ties prefer the longer lane.
+    """
+    def est_cost(lt: int) -> float:
+        n_lanes = -(-max(n, 1) // lt)
+        grid = -(-n_lanes // LANE_TILE) * LANE_TILE
+        return grid * lt / _LANE_RATE[lt]
+
+    return min((32768, 16384, DEFAULT_LANE_T), key=est_cost)
 
 
 def supports(params: HmmParams) -> bool:

@@ -452,7 +452,11 @@ def _shard_stats2d_body(
         if engine == "pallas":
             from cpgisland_tpu.ops import fb_pallas
 
-            lt = lane_T if lane_T is not None else fb_pallas.DEFAULT_LANE_T
+            lt = (
+                lane_T
+                if lane_T is not None
+                else fb_pallas.pick_lane_T(obs_tile.shape[1])
+            )
             tt = t_tile if t_tile is not None else fb_pallas.DEFAULT_T_TILE
 
             def one_seq(obs_row, length):

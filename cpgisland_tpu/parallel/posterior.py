@@ -226,7 +226,6 @@ def posterior_sharded(
     if mesh is None:
         mesh = make_mesh(axis=SEQ_AXIS)
     eng = resolve_fb_engine(engine, params)
-    lt = lane_T if lane_T is not None else fb_pallas.DEFAULT_LANE_T
     tt = t_tile if t_tile is not None else fb_pallas.DEFAULT_T_TILE
     T = int(np.asarray(obs).shape[0]) if placed is None else int(obs.shape[0])
     K = params.n_states
@@ -236,6 +235,13 @@ def posterior_sharded(
         else _place(
             mesh, np.asarray(obs), block_size, params.n_symbols, pad_to=pad_to
         )
+    )
+    # Lane length by PER-SHARD size (r4 sweep: long lanes are much faster
+    # once they fill the 128-lane grid; short inputs keep short lanes).
+    lt = (
+        lane_T
+        if lane_T is not None
+        else fb_pallas.pick_lane_T(arr.shape[0] // mesh.shape[mesh.axis_names[0]])
     )
     mask = jnp.asarray(island_mask(params, island_states))
     enter = (

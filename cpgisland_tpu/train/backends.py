@@ -305,9 +305,10 @@ class SeqBackend(EStepBackend):
         self.pad_value = pad_value
         # auto: fused kernels on big-enough TPU shards, XLA lanes otherwise;
         # xla / pallas force one lowering.  lane_T / t_tile tune the fused
-        # kernels (defaults: fb_pallas.DEFAULT_LANE_T / DEFAULT_T_TILE).
+        # kernels (default: fb_pallas.pick_lane_T by shard size /
+        # DEFAULT_T_TILE).
         self.engine = engine
-        self.lane_T = lane_T if lane_T is not None else fb_pallas.DEFAULT_LANE_T
+        self.lane_T = lane_T
         self.t_tile = t_tile if t_tile is not None else fb_pallas.DEFAULT_T_TILE
 
     def prepare(self, chunked: chunking.Chunked) -> chunking.Chunked:
@@ -354,12 +355,17 @@ class SeqBackend(EStepBackend):
         # full 128-lane padded pass dwarfs tiny inputs) — an explicit
         # engine always wins.
         if _use_fused_seq(self.engine, params, obs_flat.shape[0] // n_dev):
+            lane_T = (
+                self.lane_T
+                if self.lane_T is not None
+                else fb_pallas.pick_lane_T(obs_flat.shape[0] // n_dev)
+            )
             if n_dev == 1:
                 return fb_pallas.seq_stats_pallas(
                     params, obs_flat, jnp.sum(lengths),
-                    lane_T=self.lane_T, t_tile=self.t_tile,
+                    lane_T=lane_T, t_tile=self.t_tile,
                 )
-            fn = fb_sharded.sharded_stats_pallas_fn(self.mesh, self.lane_T, self.t_tile)
+            fn = fb_sharded.sharded_stats_pallas_fn(self.mesh, lane_T, self.t_tile)
             return fn(params, obs_flat, lengths)
         fn = fb_sharded.sharded_stats_fn(self.mesh, self.block_size)
         return fn(params, obs_flat, lengths)
