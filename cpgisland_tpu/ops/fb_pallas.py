@@ -82,7 +82,9 @@ def pick_lane_T(n: int) -> int:
         grid = -(-n_lanes // LANE_TILE) * LANE_TILE
         return grid * lt / _LANE_RATE[lt]
 
-    return min((32768, 16384, DEFAULT_LANE_T), key=est_cost)
+    # Candidates ARE the rate table (one source of truth for the next
+    # re-sweep); sorted longest-first so cost ties prefer the longer lane.
+    return min(sorted(_LANE_RATE, reverse=True), key=est_cost)
 
 
 def supports(params: HmmParams) -> bool:

@@ -283,7 +283,8 @@ def transfer_total_sharded(
         if placed is not None:
             return np.asarray(
                 fb_pallas.seq_transfer_total_pallas(
-                    params, placed[0], int(obs.shape[0]), first=first
+                    params, placed[0], int(obs.shape[0]), first=first,
+                    lane_T=fb_pallas.pick_lane_T(placed[0].shape[0]),
                 )
             )
         obs = np.asarray(obs)
@@ -294,7 +295,8 @@ def transfer_total_sharded(
             )
         return np.asarray(
             fb_pallas.seq_transfer_total_pallas(
-                params, jnp.asarray(obs), n, first=first
+                params, jnp.asarray(obs), n, first=first,
+                lane_T=fb_pallas.pick_lane_T(obs.shape[0]),
             )
         )
     arr, lens = (

@@ -512,6 +512,15 @@ def _resolve_island_engine(
         from cpgisland_tpu.ops.islands_device import DEFAULT_CAP
 
         island_cap = DEFAULT_CAP
+    if island_cap > ISLAND_CAP_CEILING:
+        # The ceiling exists to prevent gigabyte-scale [cap] output buffers
+        # dying in an opaque device OOM — a user-supplied starting cap must
+        # not bypass it (e.g. a value thought of in bytes).
+        log.warning(
+            "island_cap %d exceeds the %d ceiling; clamping",
+            island_cap, ISLAND_CAP_CEILING,
+        )
+        island_cap = ISLAND_CAP_CEILING
     # The cap_box is shared across all records/flushes of one run so a cap
     # raised by one overflow is learned for the rest (_device_calls_retry).
     return use_device_islands, [island_cap]
