@@ -546,7 +546,12 @@ def _device_calls_retry(fn, *args, cap_box: list, **kwargs):
         except IslandCapOverflow as e:
             if e.n > ISLAND_CAP_CEILING:
                 raise IslandCapOverflow(e.n, cap_box[0]) from None
-            new_cap = _round_pow2(e.n + 1, floor=2 * cap_box[0])
+            # Clamp at the ceiling: n == ceiling exactly fits cap == n
+            # slots, and the retry must not outgrow the bound the user
+            # clamp enforces.
+            new_cap = min(
+                _round_pow2(e.n + 1, floor=2 * cap_box[0]), ISLAND_CAP_CEILING
+            )
             log.warning(
                 "island calls (%d) overflowed cap=%d; retrying the on-device "
                 "calling pass with cap=%d (decode not re-run)",

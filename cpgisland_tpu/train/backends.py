@@ -249,6 +249,33 @@ def _check_seq_engine(engine: str) -> None:
         )
 
 
+# Largest per-shard whole-sequence E-step a single 16 GB v5e chip can
+# compile and run (the fused path streams ~36 B/symbol of alpha/products
+# state): measured r4 — 120 Mi compiled and ran, 128 Mi failed remote
+# compile, and the XLA lane path at 128 Mi did not finish compiling in
+# 10 min.  112 Mi keeps a safety margin.  This is PER SHARD: a v5e-8 mesh
+# trains an 8x longer sequence, and seq2d's per-record rows shard each
+# record's time axis the same way.
+SEQ_SHARD_BUDGET = 112 << 20
+
+
+def _check_seq_shard(shard_len: int, what: str) -> None:
+    """Fail oversize whole-sequence shards with advice, not an opaque
+    compiler HTTP 500 after minutes of upload."""
+    if shard_len > SEQ_SHARD_BUDGET:
+        alt = (
+            "a bigger seq axis in the group meshes"
+            if what == "Seq2DBackend"
+            else "a bigger mesh, or per-record rows with backend='seq2d'"
+        )
+        raise ValueError(
+            f"{what}: per-device shard of {shard_len} symbols exceeds the "
+            f"~{SEQ_SHARD_BUDGET >> 20} Mi single-chip whole-sequence "
+            f"E-step budget — shard time across more devices ({alt}), or "
+            "use the chunked 'spmd' backend (the reference's own framing)"
+        )
+
+
 def _use_fused_seq(engine: str, params: HmmParams, shard_len: int) -> bool:
     """Route a whole-sequence E-step to the fused Pallas lowering?
 
@@ -347,6 +374,7 @@ class SeqBackend(EStepBackend):
                 f"stream length {obs_flat.shape[0]} not a multiple of "
                 f"devices*block_size = {n_dev}*{self.block_size}; run prepare() first"
             )
+        _check_seq_shard(obs_flat.shape[0] // n_dev, "SeqBackend")
         # On TPU the fused-kernel whole-sequence path (exact boundary
         # messages from the lane-products kernel) runs ~15x the XLA lane
         # machinery: single-device directly, multi-device through the
@@ -483,6 +511,7 @@ class Seq2DBackend(EStepBackend):
         # Same routing policy as SeqBackend (_use_fused_seq): auto gates on
         # big-enough TPU shards; an explicit engine always wins.
         sp = mesh.shape[mesh.axis_names[1]]
+        _check_seq_shard(chunks.shape[1] // sp, "Seq2DBackend")
         engine = (
             "pallas"
             if _use_fused_seq(self.engine, params, chunks.shape[1] // sp)

@@ -198,3 +198,20 @@ def test_resume_preserves_orbax_format(tmp_path, rng):
     with pytest.raises(ValueError, match="checkpoint_format"):
         baum_welch.fit(params, ck, num_iters=1, checkpoint_dir=str(tmp_path),
                        checkpoint_format="orbx")
+
+
+def test_seq_shard_budget_guard():
+    """Oversize whole-sequence shards fail FAST with advice (r4: a 128 Mi
+    single-chip shard died in an opaque remote-compile HTTP 500 after the
+    upload; a 16 GB chip's measured budget is ~120 Mi)."""
+    import jax.numpy as jnp
+
+    from cpgisland_tpu.parallel.mesh import make_mesh
+
+    backend = backends.SeqBackend(mesh=make_mesh(1, axis="seq"))
+    # The guard fires on SHAPE alone, before any kernel work.
+    n = backends.SEQ_SHARD_BUDGET + backend.block_size
+    obs = jnp.zeros(n, jnp.uint8)
+    lens = jnp.zeros(1, jnp.int32)
+    with pytest.raises(ValueError, match="seq2d"):
+        backend(presets.durbin_cpg8(), obs, lens)
