@@ -1,0 +1,595 @@
+"""One-hot-emission reduced Viterbi engine: 2x2 max-plus Pallas kernels.
+
+The flagship 8-state CpG model (models.presets.durbin_cpg8, the reference's
+hardcoded tables at CpGIslandFinder.java:155-173) has ONE-HOT emissions: state
+X+/X- emits exactly symbol x (`:166-173`), and one-hot rows are EM fixed
+points, so trained models keep the structure.  That structure collapses the
+Viterbi DP: at time t the score vector is LOG_ZERO outside the (at most
+G = K / n_symbols) states whose emission supports o_t, so the K-state
+recurrence is EXACTLY a G-state recurrence whose per-step transition matrix is
+the [G, G] slice of log A between the previous symbol's state group and the
+current symbol's group.  For the 8-state model G = 2: the per-step work drops
+from ~K^2 max/add lanes to 2x2, and backpointers pack 2 bits/step instead of
+3 bits x 8 states — the "cheaper exact boundary-message scheme" the roofline
+analysis in BASELINE.md calls for.
+
+This module is the third `get_passes` engine ("onehot", next to "xla" and
+"pallas").  Same three-pass contract as ops.viterbi_parallel — the kernels
+run in the reduced space and tiny per-block scatters rebuild the full-K
+interfaces (block products [nb, K, K], exit vectors [nb, K], composition
+tables [nb, K]), so the shared stitching (`scan_block_products`,
+`_enter_vectors`, `_suffix_compositions`, the shard_map bodies in
+parallel.decode) is untouched.  Exactness vs the generic engines: the
+reduced arithmetic performs the same f32 adds/maxes on the same values in
+the same order and skips only candidates the generic engine computes at
+~-1e30 and then discards — but the generic block products also carry finite
+ANY-PREDECESSOR rows outside the entry group (irrelevant once composed with
+an in-group entering vector, yet able to set the per-block normalizer), so
+cross-engine results agree exactly as REAL numbers while f32 rounding of
+the normalizer subtraction can differ in the last ulp.  Consequence: scores
+match to ~1e-7 relative and paths match except where two path scores tie
+within that rounding (both then being true argmaxes); the parity tests pin
+exactly this contract.
+
+Exactness domain (enforced by callers, see `supports` / resolve_engine):
+- emissions one-hot with EQUAL group size G == 2 (each symbol emitted by
+  exactly two states);
+- the symbol BEFORE each segment's first step is known and real (`prev0`).
+  Mid-sequence and tail PAD symbols are fully supported (identity steps, the
+  forward-fill below); only a segment whose very first position has no real
+  emission is outside the reduced representation — host entry points route
+  those records to the generic engine.
+
+Layout notes (the Mosaic constraints recorded in CLAUDE.md): all in-kernel
+values are rank-2 (sublane, lane); dynamic row offsets are multiples of 8 —
+backpointer words pack 8 steps each, and the packed-row loops work in
+64-step outer tiles so every dynamic store lands 8-aligned.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+try:  # pragma: no cover - mirrors ops.viterbi_pallas
+    from jax.experimental.pallas import tpu as pltpu
+
+    _VMEM = pltpu.VMEM
+except Exception:  # pragma: no cover
+    pltpu = None
+    _VMEM = None
+
+from cpgisland_tpu.models.hmm import LOG_ZERO, HmmParams
+from cpgisland_tpu.ops.viterbi_parallel import scan_block_products
+
+LANE_TILE = 128
+ROW_TILE = 8  # steps per packed backpointer word
+OUTER_TILE = 64  # steps per aligned packed-row store (8 words of 8 steps)
+GROUP = 2  # reduced state dimension; 2 bits of backpointer per step
+
+
+def _vspec(block_shape=None, index_map=None):
+    if _VMEM is None:
+        return pl.BlockSpec(block_shape, index_map)
+    return pl.BlockSpec(block_shape, index_map, memory_space=_VMEM)
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+# ---------------------------------------------------------------------------
+# Structure detection
+
+
+def supports(params: HmmParams) -> bool:
+    """Host-side eligibility: emissions one-hot with exactly GROUP states per
+    symbol.  Requires concrete params (returns False under tracing — engine
+    selection is a host decision; see parallel.decode.resolve_engine)."""
+    try:
+        logB = np.asarray(params.log_B)
+    except Exception:
+        return False
+    if not np.all(np.isfinite(logB) | (logB <= LOG_ZERO / 2)):
+        return False
+    support = logB > LOG_ZERO / 2
+    if not np.all(support.sum(axis=1) == 1):
+        return False
+    sym = np.argmax(support, axis=1)
+    counts = np.bincount(sym, minlength=params.n_symbols)
+    return bool(np.all(counts == GROUP))
+
+
+def _groups(params: HmmParams) -> jnp.ndarray:
+    """[S, GROUP] int32 group table (traced-params safe): gt[s] = the two
+    state ids whose emission supports symbol s, ascending — the order that
+    reproduces the generic engines' first-max tie-breaking."""
+    K, S = params.n_states, params.n_symbols
+    sym = jnp.argmax(params.log_B, axis=1)  # [K]
+    ar = jnp.arange(K, dtype=jnp.int32)
+    low = jnp.min(jnp.where(sym[None, :] == jnp.arange(S)[:, None], ar[None, :], K), axis=1)
+    high = jnp.max(jnp.where(sym[None, :] == jnp.arange(S)[:, None], ar[None, :], -1), axis=1)
+    return jnp.stack([low, high], axis=1).astype(jnp.int32)
+
+
+def _pair_table(params: HmmParams, gt: jnp.ndarray):
+    """Per-pair reduced step matrices, flattened for the in-kernel select tree.
+
+    Row p < S*S (p = s_prev * S + s_cur) holds the real-step matrix
+    [T00, T01, T10, T11] with T[a, c] = logA[gt[s_prev, a], gt[s_cur, c]] +
+    logB[gt[s_cur, c], s_cur] — the same two-term sum the generic kernels
+    compute per lane, so values are bit-identical.  Rows S*S + e (one per
+    carried symbol e) are the max-plus identity: PAD steps encode the carried
+    symbol in their pair index so the backtrace can map bits to state ids at
+    PAD positions too.
+
+    Returns (tab [S*S + S, 4] f32, idtab [S*S + S, GROUP] i32) — idtab maps a
+    pair index to the state ids of its EXIT group (the symbol emitted after
+    the step), consumed by the backtrace kernel.
+    """
+    S = params.n_symbols
+    A_red = params.log_A[gt[:, :, None, None], gt[None, None, :, :]]  # [S,2,S,2]
+    B_red = params.log_B[gt, jnp.arange(S)[:, None]]  # [S, 2]
+    M = A_red + B_red[None, None, :, :]  # [sp, a, sc, c]
+    real = jnp.transpose(M, (0, 2, 1, 3)).reshape(S * S, 4).astype(jnp.float32)
+    ident = jnp.broadcast_to(
+        jnp.asarray([0.0, LOG_ZERO, LOG_ZERO, 0.0], jnp.float32), (S, 4)
+    )
+    tab = jnp.concatenate([real, ident], axis=0)
+    exit_sym = jnp.concatenate(
+        [jnp.tile(jnp.arange(S, dtype=jnp.int32), (S,)), jnp.arange(S, dtype=jnp.int32)]
+    )
+    idtab = gt[exit_sym]  # [S*S + S, GROUP]
+    return tab, idtab
+
+
+# ---------------------------------------------------------------------------
+# Pair-stream glue (shared by all three passes; identical HLO -> CSE in-jit)
+
+
+def _pair_stream(params: HmmParams, steps2: jnp.ndarray, prev0: jnp.ndarray):
+    """Per-step pair indices + per-block boundary symbols.
+
+    steps2: [bk, nb] int32 transition symbols in block layout (global step
+    b*bk + k at [k, b]); prev0: [] int32, the symbol emitted before step 0.
+
+    Returns (pair2 [bk, nb] i32, e_in [nb], e_out [nb]) where e_in[b]/e_out[b]
+    are the symbols emitted by the states entering/exiting block b (PADs
+    resolved by forward-fill).  The fill is two-level so nothing T-sized and
+    sequential is built: a cummax along the block axis resolves in-block PAD
+    runs, and a tiny [nb] cummax threads the last real symbol across blocks.
+    """
+    S = params.n_symbols
+    bk, nb = steps2.shape
+    real = steps2 < S
+    iota = jax.lax.broadcasted_iota(jnp.int32, (bk, nb), 0)
+    key = jnp.where(real, iota * S + steps2, -1)
+    ckey = jax.lax.cummax(key, axis=0)
+    in_sym = ckey - (ckey // S) * S  # valid where ckey >= 0
+    # Cross-block seed: last real symbol of any earlier block, else prev0.
+    last_key = jnp.where(ckey[-1] >= 0, in_sym[-1], -1)  # [nb]
+    prev_blocks = jnp.concatenate([jnp.full((1,), -1, jnp.int32), last_key[:-1]])
+    seed_key = jnp.where(
+        prev_blocks >= 0, jnp.arange(nb, dtype=jnp.int32) * (S + 1) + prev_blocks, -1
+    )
+    seed_c = jax.lax.cummax(seed_key, axis=0)
+    # prev0 is clamped so an out-of-domain PAD prev0 (first position has no
+    # real emission — callers demote those records, see
+    # parallel.decode._engine_for_record) still indexes inside the pair
+    # table: behavior is then deterministic-but-approximate, never UB.
+    seed = jnp.where(
+        seed_c >= 0,
+        seed_c - (seed_c // (S + 1)) * (S + 1),
+        jnp.minimum(prev0, S - 1),
+    )  # [nb]
+    esym = jnp.where(ckey >= 0, in_sym, seed[None, :])  # [bk, nb]
+    prev_esym = jnp.concatenate([seed[None, :], esym[:-1]], axis=0)
+    pair2 = jnp.where(real, prev_esym * S + steps2, S * S + esym)
+    return pair2.astype(jnp.int32), seed.astype(jnp.int32), esym[-1].astype(jnp.int32)
+
+
+def _pad_lanes(x, nb_pad, fill):
+    pad = nb_pad - x.shape[-1]
+    if pad == 0:
+        return x
+    return jnp.pad(x, [(0, 0)] * (x.ndim - 1) + [(0, pad)], constant_values=fill)
+
+
+def _pad_pair_rows(pair2: jnp.ndarray, e_out: jnp.ndarray, S: int):
+    """Pad the step axis to a multiple of OUTER_TILE with per-lane identity
+    pairs (S*S + carried symbol), so padded steps stay PAD semantics AND keep
+    the carried symbol decodable."""
+    bk, nb = pair2.shape
+    bk_pad = -(-bk // OUTER_TILE) * OUTER_TILE
+    if bk_pad == bk:
+        return pair2, bk_pad
+    tail = jnp.broadcast_to((S * S + e_out)[None, :], (bk_pad - bk, nb))
+    return jnp.concatenate([pair2, tail], axis=0), bk_pad
+
+
+def _select4(tile, tab_ref, nreal):
+    """In-kernel select tree: pair tile [8, LT] -> the 4 matrix-entry tiles.
+
+    ``tab_ref`` is the lane-broadcast table [(nreal)*4, LANE_TILE] (row
+    p*4 + j holds matrix entry j of pair p replicated across lanes — Mosaic
+    supports [1, LT] sublane broadcasts but not [1, 1] scalar broadcasts).
+    One compare per table row shared by all four selects; PAD pairs
+    (p >= S*S) all carry the identity, so they fold into the defaults.
+    """
+    t00 = jnp.full(tile.shape, 0.0, jnp.float32)
+    t01 = jnp.full(tile.shape, LOG_ZERO, jnp.float32)
+    t10 = jnp.full(tile.shape, LOG_ZERO, jnp.float32)
+    t11 = jnp.full(tile.shape, 0.0, jnp.float32)
+    for p in range(nreal):
+        cmp = tile == p
+        t00 = jnp.where(cmp, tab_ref[4 * p : 4 * p + 1, :], t00)
+        t01 = jnp.where(cmp, tab_ref[4 * p + 1 : 4 * p + 2, :], t01)
+        t10 = jnp.where(cmp, tab_ref[4 * p + 2 : 4 * p + 3, :], t10)
+        t11 = jnp.where(cmp, tab_ref[4 * p + 3 : 4 * p + 4, :], t11)
+    return t00, t01, t10, t11
+
+
+def _bcast_tab(tab: jnp.ndarray) -> jnp.ndarray:
+    """[n, m] table -> [n*m, LANE_TILE] lane-broadcast kernel operand."""
+    flat = tab.reshape(-1)
+    return jnp.broadcast_to(flat[:, None], (flat.shape[0], LANE_TILE))
+
+
+# ---------------------------------------------------------------------------
+# Kernels
+
+
+def _oh_products_kernel(pair_ref, tab_ref, out_ref, *, nreal, bk):
+    """Pass A: reduced max-plus product of the lane's steps -> [4, LT]
+    (rows C00, C01, C10, C11 of the 2x2 block product)."""
+    lt = pair_ref.shape[1]
+    z = jnp.zeros((1, lt), jnp.float32)
+    lz = jnp.full((1, lt), LOG_ZERO, jnp.float32)
+    C = (z, lz, lz, z)  # identity
+
+    def body(c, C):
+        c00, c01, c10, c11 = C
+        tile = pair_ref[pl.ds(c * ROW_TILE, ROW_TILE), :]
+        t00, t01, t10, t11 = _select4(tile, tab_ref, nreal)
+        for r in range(ROW_TILE):
+            a00 = t00[r : r + 1, :]
+            a01 = t01[r : r + 1, :]
+            a10 = t10[r : r + 1, :]
+            a11 = t11[r : r + 1, :]
+            # new[i, c] = max(C[i, 0] + T[0, c], C[i, 1] + T[1, c]); the
+            # jnp.maximum(first, second) order matches the generic kernels'
+            # ascending-m reduce, preserving bit-identical rounding.
+            n00 = jnp.maximum(c00 + a00, c01 + a10)
+            n01 = jnp.maximum(c00 + a01, c01 + a11)
+            n10 = jnp.maximum(c10 + a00, c11 + a10)
+            n11 = jnp.maximum(c10 + a01, c11 + a11)
+            c00, c01, c10, c11 = n00, n01, n10, n11
+        return c00, c01, c10, c11
+
+    c00, c01, c10, c11 = jax.lax.fori_loop(0, bk // ROW_TILE, body, C)
+    out_ref[0:1, :] = c00
+    out_ref[1:2, :] = c01
+    out_ref[2:3, :] = c10
+    out_ref[3:4, :] = c11
+
+
+def _oh_backpointers_kernel(
+    pair_ref, venter_ref, tab_ref, bp_ref, dexit_ref, ebits_ref, *, nreal, bk
+):
+    """Pass B: reduced forward delta recursion with true entering vectors.
+
+    Per step, 2 bits of backpointer (entry index per exit index) pack 8 steps
+    to an int32 word; the exit->entry composition E packs GROUP bits."""
+    lt = pair_ref.shape[1]
+    d0 = venter_ref[0:1, :]
+    d1 = venter_ref[1:2, :]
+    E = jnp.full((1, lt), 0b10, jnp.int32)  # identity: exit c -> entry c
+
+    def body(c, carry):
+        d0, d1, E = carry
+        words = []
+        for t8 in range(OUTER_TILE // ROW_TILE):
+            tile = pair_ref[pl.ds(c * OUTER_TILE + t8 * ROW_TILE, ROW_TILE), :]
+            t00, t01, t10, t11 = _select4(tile, tab_ref, nreal)
+            word = jnp.zeros((1, lt), jnp.int32)
+            for r in range(ROW_TILE):
+                a0 = d0 + t00[r : r + 1, :]
+                a1 = d1 + t10[r : r + 1, :]
+                b0 = d0 + t01[r : r + 1, :]
+                b1 = d1 + t11[r : r + 1, :]
+                # Strict > reproduces argmax first-max tie-breaking (prefer
+                # the lower in-group state id, = the generic engines' choice).
+                bp0 = (a1 > a0).astype(jnp.int32)
+                bp1 = (b1 > b0).astype(jnp.int32)
+                d0 = jnp.maximum(a0, a1)
+                d1 = jnp.maximum(b0, b1)
+                word = word | ((bp0 | (bp1 << 1)) << (2 * r))
+                E = (jnp.right_shift(E, bp0) & 1) | (
+                    ((jnp.right_shift(E, bp1) & 1)) << 1
+                )
+            words.append(word)
+        bp_ref[pl.ds(c * (OUTER_TILE // ROW_TILE), OUTER_TILE // ROW_TILE), :] = (
+            jnp.concatenate(words, axis=0)
+        )
+        return d0, d1, E
+
+    d0, d1, E = jax.lax.fori_loop(0, bk // OUTER_TILE, body, (d0, d1, E))
+    dexit_ref[0:1, :] = d0
+    dexit_ref[1:2, :] = d1
+    ebits_ref[:, :] = E
+
+
+def _oh_backtrace_kernel(bp_ref, pair_ref, idtab_ref, exit_ref, path_ref, *, nP, bk):
+    """Pass C: walk 2-bit backpointers from the anchored exit bit, emitting
+    full STATE IDS (the pair index decodes the per-position exit group)."""
+    nc = bk // OUTER_TILE
+
+    def body(i, bit):
+        c = nc - 1 - i
+        words = bp_ref[pl.ds(c * (OUTER_TILE // ROW_TILE), OUTER_TILE // ROW_TILE), :]
+        for t8 in range(OUTER_TILE // ROW_TILE - 1, -1, -1):
+            tile = pair_ref[pl.ds(c * OUTER_TILE + t8 * ROW_TILE, ROW_TILE), :]
+            # Per-position exit-group state ids via the lane-broadcast
+            # pair->ids table (rows 2p / 2p+1 = low/high id of pair p).
+            glow = jnp.zeros(tile.shape, jnp.int32)
+            ghigh = jnp.zeros(tile.shape, jnp.int32)
+            for p in range(nP):
+                cmp = tile == p
+                glow = jnp.where(cmp, idtab_ref[2 * p : 2 * p + 1, :], glow)
+                ghigh = jnp.where(cmp, idtab_ref[2 * p + 1 : 2 * p + 2, :], ghigh)
+            word = words[t8 : t8 + 1, :]
+            rows = [None] * ROW_TILE
+            for r in range(ROW_TILE - 1, -1, -1):
+                rows[r] = jnp.where(bit == 0, glow[r : r + 1, :], ghigh[r : r + 1, :])
+                bit = jnp.right_shift(word, 2 * r + bit) & 1
+            path_ref[pl.ds(c * OUTER_TILE + t8 * ROW_TILE, ROW_TILE), :] = (
+                jnp.concatenate(rows, axis=0)
+            )
+        return bit
+
+    jax.lax.fori_loop(0, nc, body, exit_ref[:, :])
+
+
+# ---------------------------------------------------------------------------
+# Scatter glue: reduced block results -> full-K interfaces
+
+
+def _scatter_products(red, gt, e_in, e_out, K):
+    """[nb, 2, 2] reduced block products -> [nb, K, K] full (LOG_ZERO fill)."""
+    nb = red.shape[0]
+    gin = gt[e_in]  # [nb, 2]
+    gout = gt[e_out]  # [nb, 2]
+    iK = jnp.arange(K, dtype=jnp.int32)
+    full = jnp.full((nb, K, K), LOG_ZERO, jnp.float32)
+    for a in range(GROUP):
+        for c in range(GROUP):
+            mask = (iK[None, :, None] == gin[:, a, None, None]) & (
+                iK[None, None, :] == gout[:, c, None, None]
+            )
+            full = jnp.where(mask, red[:, a, c][:, None, None], full)
+    return full
+
+
+def _scatter_vec(red, gt, e_out, K):
+    """[nb, 2] reduced exit vectors -> [nb, K] full (LOG_ZERO fill)."""
+    gout = gt[e_out]
+    iK = jnp.arange(K, dtype=jnp.int32)
+    full = jnp.full((red.shape[0], K), LOG_ZERO, jnp.float32)
+    for c in range(GROUP):
+        full = jnp.where(iK[None, :] == gout[:, c, None], red[:, c, None], full)
+    return full
+
+
+def _scatter_ftab(ebits, gt, e_in, e_out, K):
+    """Packed exit->entry bits -> [nb, K] state-id composition tables.
+
+    Out-of-exit-group rows get the entry group's low state — they are never
+    read (compositions only chase states that are valid exits; see the
+    stitching in ops.viterbi_parallel / parallel.decode)."""
+    gin = gt[e_in]  # [nb, 2]
+    gout = gt[e_out]
+    e0 = (ebits & 1).astype(jnp.int32)  # entry index reached from exit 0
+    e1 = ((ebits >> 1) & 1).astype(jnp.int32)
+    val0 = jnp.take_along_axis(gin, e0[:, None], axis=1)[:, 0]  # [nb]
+    val1 = jnp.take_along_axis(gin, e1[:, None], axis=1)[:, 0]
+    iK = jnp.arange(K, dtype=jnp.int32)
+    full = jnp.broadcast_to(gin[:, 0, None], (gin.shape[0], K)).astype(jnp.int32)
+    full = jnp.where(iK[None, :] == gout[:, 0, None], val0[:, None], full)
+    full = jnp.where(iK[None, :] == gout[:, 1, None], val1[:, None], full)
+    return full
+
+
+# ---------------------------------------------------------------------------
+# XLA lowering of the reduced passes (non-TPU backends).
+#
+# The Pallas interpreter executes these kernels pathologically slowly (the
+# per-step select-derived backpointer chains blow up its evaluation; measured
+# minutes for a 2000-symbol toy decode on CPU), so off-TPU the same reduced
+# recurrences run as lax.scan over the lane axis instead.  The two lowerings
+# are bit-identical: the one-hot table contraction at HIGHEST precision is an
+# exact selection, and every add/max happens on the same values in the same
+# order as in the kernels — the CPU suite certifies the algorithm against the
+# generic engines, the TPU suite run certifies the kernels against the same
+# tests.
+
+
+def _sel_rows(tab: jnp.ndarray, pk: jnp.ndarray) -> jnp.ndarray:
+    """Exact row selection tab[pk] as a one-hot contraction ([n] -> [n, m])."""
+    oh = jax.nn.one_hot(pk, tab.shape[0], dtype=tab.dtype)
+    return jnp.matmul(oh, tab, precision=jax.lax.Precision.HIGHEST)
+
+
+def _xla_products(tab: jnp.ndarray, pair2: jnp.ndarray) -> jnp.ndarray:
+    """Reduced per-block products [nb, 2, 2] via lax.scan over steps."""
+    nb = pair2.shape[1]
+    C0 = jnp.broadcast_to(
+        jnp.asarray([0.0, LOG_ZERO, LOG_ZERO, 0.0], jnp.float32), (nb, 4)
+    ) + (pair2[0, :, None] * 0).astype(jnp.float32)
+
+    def step(C, pk):
+        T = _sel_rows(tab, pk)  # [nb, 4] = (T00, T01, T10, T11)
+        n00 = jnp.maximum(C[:, 0] + T[:, 0], C[:, 1] + T[:, 2])
+        n01 = jnp.maximum(C[:, 0] + T[:, 1], C[:, 1] + T[:, 3])
+        n10 = jnp.maximum(C[:, 2] + T[:, 0], C[:, 3] + T[:, 2])
+        n11 = jnp.maximum(C[:, 2] + T[:, 1], C[:, 3] + T[:, 3])
+        return jnp.stack([n00, n01, n10, n11], axis=1), None
+
+    C, _ = jax.lax.scan(step, C0, pair2)
+    return C.reshape(nb, GROUP, GROUP)
+
+
+def _xla_backpointers(tab: jnp.ndarray, v_red: jnp.ndarray, pair2: jnp.ndarray):
+    """Reduced delta recursion; returns (dexit [nb, 2], ebits [nb], bp2
+    [bk, nb] int32 rows of bp0 | bp1 << 1)."""
+    nb = pair2.shape[1]
+    E0 = jnp.full((nb,), 0b10, jnp.int32)
+
+    def step(carry, pk):
+        d0, d1, E = carry
+        T = _sel_rows(tab, pk)
+        a0 = d0 + T[:, 0]
+        a1 = d1 + T[:, 2]
+        b0 = d0 + T[:, 1]
+        b1 = d1 + T[:, 3]
+        bp0 = (a1 > a0).astype(jnp.int32)
+        bp1 = (b1 > b0).astype(jnp.int32)
+        E = (jnp.right_shift(E, bp0) & 1) | ((jnp.right_shift(E, bp1) & 1) << 1)
+        return (jnp.maximum(a0, a1), jnp.maximum(b0, b1), E), bp0 | (bp1 << 1)
+
+    (d0, d1, E), bp2 = jax.lax.scan(step, (v_red[:, 0], v_red[:, 1], E0), pair2)
+    return jnp.stack([d0, d1], axis=1), E, bp2
+
+
+def _xla_backtrace(bp2, pair2, idtab, exit_bits):
+    """Walk the 2-bit rows from the exit bits, emitting state ids [bk, nb]."""
+    glow2 = jnp.take(idtab[:, 0], pair2)
+    ghigh2 = jnp.take(idtab[:, 1], pair2)
+
+    def back(bit, row):
+        return jnp.right_shift(row, bit) & 1, bit
+
+    _, bits = jax.lax.scan(back, exit_bits, bp2, reverse=True)
+    return jnp.where(bits == 0, glow2, ghigh2)
+
+
+# ---------------------------------------------------------------------------
+# Pass-level API (the "onehot" engine for viterbi_parallel.get_passes)
+
+
+def _prepared(params: HmmParams, steps2: jnp.ndarray, prev0):
+    if prev0 is None:
+        raise ValueError("the onehot engine requires prev0 (the symbol before step 0)")
+    S = params.n_symbols
+    gt = _groups(params)
+    tab, idtab = _pair_table(params, gt)
+    pair2, e_in, e_out = _pair_stream(
+        params, steps2.astype(jnp.int32), jnp.asarray(prev0, jnp.int32)
+    )
+    return S, gt, tab, idtab, pair2, e_in, e_out
+
+
+def pass_products(params: HmmParams, steps2: jnp.ndarray, prev0=None):
+    """Onehot twin of viterbi_parallel._pass_products: (incl, offs, total)."""
+    K = params.n_states
+    S, gt, tab, _, pair2, e_in, e_out = _prepared(params, steps2, prev0)
+    nb = steps2.shape[1]
+    if _interpret():
+        red = _xla_products(tab, pair2)
+    else:
+        nb_pad = -(-nb // LANE_TILE) * LANE_TILE
+        pair2 = _pad_lanes(pair2, nb_pad, jnp.int32(S * S))
+        pair2, bk = _pad_pair_rows(pair2, _pad_lanes(e_out, nb_pad, 0), S)
+        tabb = _bcast_tab(tab[: S * S])
+        red_flat = pl.pallas_call(
+            functools.partial(_oh_products_kernel, nreal=S * S, bk=bk),
+            grid=(nb_pad // LANE_TILE,),
+            in_specs=[
+                _vspec((bk, LANE_TILE), lambda i: (0, i)),
+                _vspec(tabb.shape, lambda i: (0, 0)),
+            ],
+            out_specs=_vspec((4, LANE_TILE), lambda i: (0, i)),
+            out_shape=jax.ShapeDtypeStruct((4, nb_pad), jnp.float32),
+        )(pair2, tabb)
+        red = red_flat.T.reshape(nb_pad, GROUP, GROUP)[:nb]
+    P = _scatter_products(red, gt, e_in, e_out, K)
+    incl, offs = scan_block_products(P)
+    return incl, offs, incl[-1]
+
+
+def pass_backpointers(params: HmmParams, v_enter: jnp.ndarray, steps2: jnp.ndarray, prev0=None):
+    """Onehot twin of viterbi_parallel._pass_backpointers.
+
+    Returns (delta_blocks [nb, K], F [nb, K], blob); the blob carries the
+    packed 2-bit pointers plus the pair stream for the backtrace's bit->state
+    mapping."""
+    K = params.n_states
+    S, gt, tab, idtab, pair2, e_in, e_out = _prepared(params, steps2, prev0)
+    bk_real, nb = steps2.shape
+    v_red = jnp.take_along_axis(v_enter, gt[e_in], axis=1)  # [nb, 2]
+    ghigh_end = gt[e_out, 1]  # [nb] — exit-bit anchor conversion
+    if _interpret():
+        dexit_red, ebits_nb, bp2 = _xla_backpointers(
+            tab, v_red.astype(jnp.float32), pair2
+        )
+        delta_exit = _scatter_vec(dexit_red, gt, e_out, K)
+        F = _scatter_ftab(ebits_nb, gt, e_in, e_out, K)
+        blob = ("xla", bp2, pair2, idtab, ghigh_end, bk_real, nb)
+        return delta_exit, F, blob
+    nb_pad = -(-nb // LANE_TILE) * LANE_TILE
+    pair2 = _pad_lanes(pair2, nb_pad, jnp.int32(S * S))
+    pair2, bk = _pad_pair_rows(pair2, _pad_lanes(e_out, nb_pad, 0), S)
+    v_red2 = _pad_lanes(v_red.T.astype(jnp.float32), nb_pad, 0.0)
+    tabb = _bcast_tab(tab[: S * S])
+    bp_packed, dexit_red, ebits = pl.pallas_call(
+        functools.partial(_oh_backpointers_kernel, nreal=S * S, bk=bk),
+        grid=(nb_pad // LANE_TILE,),
+        in_specs=[
+            _vspec((bk, LANE_TILE), lambda i: (0, i)),
+            _vspec((GROUP, LANE_TILE), lambda i: (0, i)),
+            _vspec(tabb.shape, lambda i: (0, 0)),
+        ],
+        out_specs=[
+            _vspec((bk // ROW_TILE, LANE_TILE), lambda i: (0, i)),
+            _vspec((GROUP, LANE_TILE), lambda i: (0, i)),
+            _vspec((1, LANE_TILE), lambda i: (0, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bk // ROW_TILE, nb_pad), jnp.int32),
+            jax.ShapeDtypeStruct((GROUP, nb_pad), jnp.float32),
+            jax.ShapeDtypeStruct((1, nb_pad), jnp.int32),
+        ],
+    )(pair2, v_red2, tabb)
+    delta_exit = _scatter_vec(dexit_red.T[:nb], gt, e_out, K)
+    F = _scatter_ftab(ebits[0, :nb], gt, e_in, e_out, K)
+    blob = ("pallas", bp_packed, pair2, idtab, ghigh_end, bk_real, nb)
+    return delta_exit, F, blob
+
+
+def pass_backtrace(blob, exits: jnp.ndarray) -> jnp.ndarray:
+    """Onehot twin of viterbi_parallel._pass_backtrace -> [bk*nb] state ids."""
+    kind, bp, pair2, idtab, ghigh_end, bk_real, nb = blob
+    exit_bits = (exits == ghigh_end).astype(jnp.int32)
+    if kind == "xla":
+        return _xla_backtrace(bp, pair2, idtab, exit_bits).T.reshape(-1)
+    bk = pair2.shape[0]
+    nb_pad = pair2.shape[1]
+    nP = idtab.shape[0]
+    exits2 = _pad_lanes(exit_bits[None, :], nb_pad, 0)
+    idtabb = _bcast_tab(idtab)
+    path2 = pl.pallas_call(
+        functools.partial(_oh_backtrace_kernel, nP=nP, bk=bk),
+        grid=(nb_pad // LANE_TILE,),
+        in_specs=[
+            _vspec((bk // ROW_TILE, LANE_TILE), lambda i: (0, i)),
+            _vspec((bk, LANE_TILE), lambda i: (0, i)),
+            _vspec(idtabb.shape, lambda i: (0, 0)),
+            _vspec((1, LANE_TILE), lambda i: (0, i)),
+        ],
+        out_specs=_vspec((bk, LANE_TILE), lambda i: (0, i)),
+        out_shape=jax.ShapeDtypeStruct((bk, nb_pad), jnp.int32),
+    )(bp, pair2, idtabb, exits2)
+    return path2[:bk_real, :nb].T.reshape(-1)

@@ -252,7 +252,7 @@ def _pad_rows(steps2, S):
 # sliced back off.
 
 
-def pass_products(params: HmmParams, steps2: jnp.ndarray):
+def pass_products(params: HmmParams, steps2: jnp.ndarray, prev0=None):
     """Pallas twin of viterbi_parallel._pass_products: (incl, offs, total)."""
     K, S, logAT, logB = _step_mats_const(params)
     nb = steps2.shape[1]
@@ -278,7 +278,7 @@ def pass_products(params: HmmParams, steps2: jnp.ndarray):
     return incl, offs, incl[-1]
 
 
-def pass_backpointers(params: HmmParams, v_enter: jnp.ndarray, steps2: jnp.ndarray):
+def pass_backpointers(params: HmmParams, v_enter: jnp.ndarray, steps2: jnp.ndarray, prev0=None):
     """Pallas twin of viterbi_parallel._pass_backpointers.
 
     Returns (delta_blocks [nb, K], F [nb, K], blob) — the backpointer blob is

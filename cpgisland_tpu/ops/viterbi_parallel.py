@@ -152,11 +152,13 @@ class BlockDecode(NamedTuple):
     score_offset: jnp.ndarray  # [] add to delta_exit for true (global) scores
 
 
-def _pass_products(params: HmmParams, steps2: jnp.ndarray):
+def _pass_products(params: HmmParams, steps2: jnp.ndarray, prev0=None):
     """Pass A: per-block max-plus products + their normalized inclusive prefix.
 
     steps2: [bk, nb].  Returns (incl [nb, K, K] normalized per block,
-    offs [nb] subtracted offsets, total [K, K] = incl[-1]).
+    offs [nb] subtracted offsets, total [K, K] = incl[-1]).  ``prev0`` (the
+    symbol emitted before step 0) is consumed only by the onehot engine —
+    the dense engines ignore it.
     """
     K = params.n_states
     M_ext, _ = _step_tables(params)
@@ -195,7 +197,7 @@ def _enter_vectors(v_enter0: jnp.ndarray, incl: jnp.ndarray, offs=None):
     return v, vmax + excl_off
 
 
-def _pass_backpointers(params: HmmParams, v_enter: jnp.ndarray, steps2: jnp.ndarray):
+def _pass_backpointers(params: HmmParams, v_enter: jnp.ndarray, steps2: jnp.ndarray, prev0=None):
     """Pass B: re-scan with true entering vectors; emit int8 backpointers and
     carry the within-block exit->entry composition E (E'[j] = E[bp[j]]).
 
@@ -247,7 +249,9 @@ def get_passes(engine: str):
     """Resolve a block-pass engine triple (products, backpointers, backtrace).
 
     'xla' — the lax.scan implementations in this module; 'pallas' — the fused
-    TPU kernels (ops.viterbi_pallas; imported lazily to avoid a cycle).  The
+    TPU kernels (ops.viterbi_pallas; imported lazily to avoid a cycle);
+    'onehot' — the reduced 2x2 kernels for one-hot-emission models
+    (ops.viterbi_onehot; requires the caller to thread prev0).  The
     backpointer blob returned by backpointers() is engine-specific and flows
     opaquely into backtrace().
     """
@@ -261,7 +265,15 @@ def get_passes(engine: str):
             viterbi_pallas.pass_backpointers,
             viterbi_pallas.pass_backtrace,
         )
-    raise ValueError(f"unknown engine {engine!r}; expected xla|pallas")
+    if engine == "onehot":
+        from cpgisland_tpu.ops import viterbi_onehot
+
+        return (
+            viterbi_onehot.pass_products,
+            viterbi_onehot.pass_backpointers,
+            viterbi_onehot.pass_backtrace,
+        )
+    raise ValueError(f"unknown engine {engine!r}; expected xla|pallas|onehot")
 
 
 def _block_passes(
@@ -271,6 +283,7 @@ def _block_passes(
     block_size: int,
     anchor: jnp.ndarray | None = None,
     engine: str = "xla",
+    prev0: jnp.ndarray | None = None,
 ) -> BlockDecode:
     """Run the three block passes over ``steps`` (transition symbols), with
     ``v_enter0`` the score vector entering the first step.
@@ -284,9 +297,9 @@ def _block_passes(
     nb = steps.shape[0] // block_size
     steps2 = steps.reshape(nb, block_size).T  # [bk, nb] — scan over bk
 
-    incl, offs, total = _pass_products(params, steps2)
+    incl, offs, total = _pass_products(params, steps2, prev0)
     v_enter, enter_offs = _enter_vectors(v_enter0, incl, offs)
-    delta_blocks, F, bps = _pass_backpointers(params, v_enter, steps2)
+    delta_blocks, F, bps = _pass_backpointers(params, v_enter, steps2, prev0)
     delta_exit = delta_blocks[-1]
 
     s_exit = jnp.argmax(delta_exit).astype(jnp.int32) if anchor is None else anchor
@@ -315,8 +328,13 @@ def viterbi_parallel(
 
     Drop-in equivalent of ops.viterbi.viterbi; PAD symbols (>= n_symbols) are
     pass-through identity steps, so it also subsumes viterbi_padded.  The
-    ``engine`` selects the block-pass lowering (see :func:`get_passes`); both
-    engines produce identical paths (same rounding, same tie-breaking).
+    ``engine`` selects the block-pass lowering (see :func:`get_passes`); the
+    dense engines produce identical paths (same rounding, same tie-breaking).
+    Caveat: engine="onehot" additionally requires obs[0] < n_symbols (a PAD
+    FIRST symbol has no entry group for the reduced chain; results are then
+    deterministic but approximate).  Host-level entry points
+    (parallel.decode, the pipeline) demote such records to a dense engine
+    automatically — only direct jitted calls can reach the caveat.
     """
     _, emit_ext = _step_tables(params)
     obs = obs.astype(jnp.int32)
@@ -333,7 +351,7 @@ def viterbi_parallel(
     bk = min(block_size, max(8, S))
     nb = -(-S // bk)
     padded = jnp.concatenate([obs_c[1:], jnp.full(nb * bk - S, pad_sym, jnp.int32)])
-    dec = _block_passes(params, v0, padded, bk, engine=engine)
+    dec = _block_passes(params, v0, padded, bk, engine=engine, prev0=obs_c[0])
 
     # path[0] (time 0) = entry state of the whole segment.
     s0 = dec.ftable[jnp.argmax(dec.delta_exit)]

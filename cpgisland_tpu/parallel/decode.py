@@ -28,7 +28,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from cpgisland_tpu.models.hmm import HmmParams
-from cpgisland_tpu.ops import viterbi_pallas
+from cpgisland_tpu.ops import viterbi_onehot, viterbi_pallas
 from cpgisland_tpu.ops.viterbi_parallel import (
     DEFAULT_BLOCK,
     _enter_vectors,
@@ -44,25 +44,76 @@ from cpgisland_tpu.parallel.mesh import SEQ_AXIS, fetch_sharded_prefix, make_mes
 
 
 def resolve_engine(engine: str, params: HmmParams) -> str:
-    """'auto' picks the Pallas kernels on TPU when the model fits their 3-bit
-    backpointer packing, the XLA scans otherwise (incl. the CPU test mesh,
-    where Pallas would run interpreted)."""
+    """'auto' picks the reduced one-hot kernels on TPU when the model's
+    emission structure supports them (ops.viterbi_onehot — the flagship
+    8-state model does), else the dense Pallas kernels when the model fits
+    their 3-bit backpointer packing, else the XLA scans (incl. the CPU test
+    mesh, where Pallas would run interpreted)."""
     if engine == "auto":
+        if jax.default_backend() == "tpu":
+            if viterbi_onehot.supports(params):
+                return "onehot"
+            if viterbi_pallas.supports(params):
+                return "pallas"
+        return "xla"
+    if engine not in ("xla", "pallas", "onehot"):
+        raise ValueError(f"unknown engine {engine!r}; expected auto|xla|pallas|onehot")
+    if engine == "pallas" and not viterbi_pallas.supports(params):
+        raise ValueError(f"pallas engine needs n_states <= 8, got {params.n_states}")
+    if engine == "onehot" and not viterbi_onehot.supports(params):
+        raise ValueError(
+            "onehot engine needs one-hot emissions with 2 states per symbol "
+            "(concrete params)"
+        )
+    return engine
+
+
+def _engine_for_record(eng: str, obs: np.ndarray, params: HmmParams) -> str:
+    """Demote 'onehot' to a dense engine for records outside its exactness
+    domain (first position has no real emission — the reduced chain has no
+    entry group there; see ops.viterbi_onehot's module docstring).  The
+    demotion target honors the dense engines' own eligibility: the Pallas
+    kernels only on TPU and only when the 3-bit backpointer packing fits."""
+    if eng == "onehot" and (obs.shape[0] == 0 or int(obs[0]) >= params.n_symbols):
         if jax.default_backend() == "tpu" and viterbi_pallas.supports(params):
             return "pallas"
         return "xla"
-    if engine not in ("xla", "pallas"):
-        raise ValueError(f"unknown engine {engine!r}; expected auto|xla|pallas")
-    if engine == "pallas" and not viterbi_pallas.supports(params):
-        raise ValueError(f"pallas engine needs n_states <= 8, got {params.n_states}")
-    return engine
+    return eng
+
+
+def _prev_real_symbol(obs: np.ndarray, lo: int, n_symbols: int) -> int:
+    """Last real symbol strictly before obs[lo] (host scan; O(PAD run))."""
+    i = lo - 1
+    while i >= 0 and int(obs[i]) >= n_symbols:
+        i -= 1
+    return int(obs[i]) if i >= 0 else 0
+
+
+def _device_entry_sym(obs_c: jnp.ndarray, pad_sym: int, axis: str,
+                      prev0: jnp.ndarray) -> jnp.ndarray:
+    """Symbol emitted by the state entering THIS device's shard: the last
+    real symbol on any earlier device, else the segment-level ``prev0``.
+    Consumed only by the onehot engine (its reduced chain is conditioned on
+    the entering symbol's state group); one tiny scalar all_gather."""
+    L = obs_c.shape[0]
+    iota = jnp.arange(L, dtype=jnp.int32)
+    keyloc = jnp.max(jnp.where(obs_c < pad_sym, iota * pad_sym + obs_c, -1))
+    keys = jax.lax.all_gather(keyloc, axis)  # [D] scalars
+    didx = jnp.arange(keys.shape[0], dtype=jnp.int32)
+    d = jax.lax.axis_index(axis)
+    sym = keys - (keys // pad_sym) * pad_sym
+    gkey = jnp.where((didx < d) & (keys >= 0), didx * (pad_sym + 1) + sym, -1)
+    m = jnp.max(gkey)
+    return jnp.where(
+        m >= 0, m - (m // (pad_sym + 1)) * (pad_sym + 1), prev0
+    ).astype(jnp.int32)
 
 
 def _shard_body(block_size: int, axis: str, engine: str = "xla",
                 continuation: bool = False):
     """Per-device decode body (runs under shard_map).
 
-    body(params, obs_shard [L], v_entry [K], exit_anchor []) ->
+    body(params, obs_shard [L], v_entry [K], exit_anchor [], prev0 []) ->
     (path [L] sharded, prev_exit [] replicated).
 
     ``continuation=False`` is the standalone decode: the segment starts the
@@ -78,7 +129,7 @@ def _shard_body(block_size: int, axis: str, engine: str = "xla",
     products, backpointers, backtrace = get_passes(engine)
 
     def body(params: HmmParams, obs_shard: jnp.ndarray, v_entry: jnp.ndarray,
-             exit_anchor: jnp.ndarray):
+             exit_anchor: jnp.ndarray, prev0: jnp.ndarray):
         K = params.n_states
         pad_sym = params.n_symbols
         _, emit_ext = _step_tables(params)
@@ -86,6 +137,10 @@ def _shard_body(block_size: int, axis: str, engine: str = "xla",
         n_dev = jax.lax.axis_size(axis)
         obs_c = jnp.minimum(obs_shard.astype(jnp.int32), pad_sym)
 
+        prev_d = (
+            _device_entry_sym(obs_c, pad_sym, axis, prev0)
+            if engine == "onehot" else None
+        )
         if continuation:
             v0_local = v_entry
             steps = obs_c
@@ -99,7 +154,7 @@ def _shard_body(block_size: int, axis: str, engine: str = "xla",
         nb = steps.shape[0] // block_size
         steps2 = steps.reshape(nb, block_size).T
 
-        incl, _, total = products(params, steps2)
+        incl, _, total = products(params, steps2, prev_d)
 
         # Forward stitch: v_enter(shard d) = v0 (x) prod of earlier shards.
         # Device totals/prefixes are normalized (nrm_maxplus): scores must
@@ -115,7 +170,7 @@ def _shard_body(block_size: int, axis: str, engine: str = "xla",
         v_shard = nrm_maxplus_vec(jnp.max(v0[:, None] + my_prefix, axis=0))  # [K]
 
         v_enter = _enter_vectors(v_shard, incl)
-        delta_blocks, F, bps = backpointers(params, v_enter, steps2)
+        delta_blocks, F, bps = backpointers(params, v_enter, steps2, prev_d)
 
         # Backward stitch: global argmax composed through later shards' maps.
         Gsuf = _suffix_compositions(F)
@@ -157,9 +212,9 @@ def _sharded_fn(mesh: Mesh, block_size: int, engine: str = "xla",
         jax.shard_map(
             body,
             mesh=mesh,
-            in_specs=(P(), P(axis), P(), P()),
+            in_specs=(P(), P(axis), P(), P(), P()),
             out_specs=(P(axis), P()),
-            check_vma=engine != "pallas",
+            check_vma=engine == "xla",
         )
     )
 
@@ -173,11 +228,16 @@ def _span_total_body(block_size: int, axis: str, engine: str,
     """
     products, _, _ = get_passes(engine)
 
-    def body(params: HmmParams, obs_shard: jnp.ndarray) -> jnp.ndarray:
+    def body(params: HmmParams, obs_shard: jnp.ndarray,
+             prev0: jnp.ndarray) -> jnp.ndarray:
         K = params.n_states
         pad_sym = params.n_symbols
         d = jax.lax.axis_index(axis)
         obs_c = jnp.minimum(obs_shard.astype(jnp.int32), pad_sym)
+        prev_d = (
+            _device_entry_sym(obs_c, pad_sym, axis, prev0)
+            if engine == "onehot" else None
+        )
         if continuation:
             steps = obs_c
         else:
@@ -185,7 +245,7 @@ def _span_total_body(block_size: int, axis: str, engine: str,
             # the decode body), so its step is identity here too.
             steps = obs_c.at[0].set(jnp.where(d == 0, pad_sym, obs_c[0]))
         steps2 = steps.reshape(steps.shape[0] // block_size, block_size).T
-        _, _, total = products(params, steps2)
+        _, _, total = products(params, steps2, prev_d)
         totals = jax.lax.all_gather(total, axis)  # [D, K, K]
 
         def fwd(carry, t):
@@ -209,9 +269,9 @@ def _span_total_fn(mesh: Mesh, block_size: int, engine: str,
         jax.shard_map(
             body,
             mesh=mesh,
-            in_specs=(P(), P(axis)),
+            in_specs=(P(), P(axis), P()),
             out_specs=P(),
-            check_vma=engine != "pallas",
+            check_vma=engine == "xla",
         )
     )
 
@@ -237,12 +297,14 @@ def viterbi_sharded(
         mesh = make_mesh(axis=SEQ_AXIS)
     obs = np.asarray(obs)
     T = obs.shape[0]
+    eng = _engine_for_record(resolve_engine(engine, params), obs, params)
+    prev0 = jnp.int32(int(obs[0]) if T and int(obs[0]) < params.n_symbols else 0)
     arr = _place_span(mesh, obs, block_size, params.n_symbols)
     # Positional args throughout: lru_cache keys positional vs keyword calls
     # differently, and a mixed style would compile the same fn twice.
-    fn = _sharded_fn(mesh, block_size, resolve_engine(engine, params), False)
+    fn = _sharded_fn(mesh, block_size, eng, False)
     path, _ = fn(params, arr, jnp.zeros(params.n_states, jnp.float32),
-                 jnp.int32(-1))
+                 jnp.int32(-1), prev0)
     return _fetch_path(path, T, return_device)
 
 
@@ -292,8 +354,8 @@ def viterbi_sharded_spans(
     """
     if mesh is None:
         mesh = make_mesh(axis=SEQ_AXIS)
-    eng = resolve_engine(engine, params)
     obs = np.asarray(obs)
+    eng = _engine_for_record(resolve_engine(engine, params), obs, params)
     T = obs.shape[0]
     if T <= span:
         return [
@@ -333,10 +395,22 @@ def viterbi_sharded_spans(
     if int(obs[0]) < params.n_symbols:
         v = v + np.asarray(params.log_B, np.float32)[:, int(obs[0])]
     enters = [v - v.max()]
+
+    def span_prev0(s: int) -> jnp.ndarray:
+        """The symbol before span s (the onehot engine's entry group; other
+        engines ignore it).  Span 0's entry is its own position 0."""
+        lo = s * span
+        return jnp.int32(
+            _prev_real_symbol(obs, lo, params.n_symbols)
+            if lo else (int(obs[0]) if int(obs[0]) < params.n_symbols else 0)
+        )
+
     for s in range(n_spans - 1):
         placed[s] = place(s)
         total = np.asarray(
-            _span_total_fn(mesh, block_size, eng, s > 0)(params, placed[s])
+            _span_total_fn(mesh, block_size, eng, s > 0)(
+                params, placed[s], span_prev0(s)
+            )
         )
         v = (enters[-1][:, None] + total).max(axis=0)
         enters.append((v - v.max()).astype(np.float32))
@@ -351,7 +425,8 @@ def viterbi_sharded_spans(
             arr = place(s)
         fn = _sharded_fn(mesh, block_size, eng, s > 0)
         path, prev_exit = fn(
-            params, arr, jnp.asarray(enters[s]), jnp.int32(anchor)
+            params, arr, jnp.asarray(enters[s]), jnp.int32(anchor),
+            span_prev0(s)
         )
         anchor = int(jax.device_get(prev_exit))
         paths[s] = _fetch_path(path, min(span, T - s * span), return_device)

@@ -19,6 +19,7 @@ filter, record-name column when the file has multiple records.
 from __future__ import annotations
 
 import dataclasses
+import functools
 import logging
 from dataclasses import dataclass
 from typing import IO, Optional, Union
@@ -281,11 +282,16 @@ def decode_file(
         island_cap=island_cap,
     )
     timer = timer if timer is not None else profiling.PhaseTimer()
-    batch_decode = (
-        viterbi_pallas_batch
-        if resolve_engine(engine, params) == "pallas"
-        else viterbi_parallel_batch
-    )
+    _eng = resolve_engine(engine, params)
+    if _eng == "pallas":
+        batch_decode = viterbi_pallas_batch
+    elif _eng == "onehot":
+        # Reduced one-hot kernels under vmap.  Zero-length lanes fall outside
+        # the engine's exactness domain (no real first emission) but their
+        # paths are sliced to nothing by every consumer.
+        batch_decode = functools.partial(viterbi_parallel_batch, engine="onehot")
+    else:
+        batch_decode = viterbi_parallel_batch
 
     if compat:
         with timer.phase("encode", unit="sym"):

@@ -1,0 +1,214 @@
+"""The reduced one-hot Viterbi engine vs the generic engines (exactness).
+
+The "onehot" engine (ops.viterbi_onehot) collapses one-hot-emission models
+(the flagship Durbin 8-state preset, CpGIslandFinder.java:166-173) to a
+2-state conditional chain.  Contract pinned here: paths identical to the
+generic engines on tie-free inputs, achieved scores equal to f32-rounding
+tolerance (the engines' per-block normalizers can differ in the last ulp —
+see the module docstring), PAD handling (mid-sequence and tail) exact, and
+the sharded / span / batch drivers agree engine-for-engine.
+
+On non-TPU backends the engine runs its XLA lowering; the TPU suite run
+(CPGISLAND_TEST_PLATFORM=axon) exercises the Pallas kernels against these
+same tests — both lowerings implement identical arithmetic.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cpgisland_tpu.models import presets
+from cpgisland_tpu.models.hmm import HmmParams, sample_sequence
+from cpgisland_tpu.ops import viterbi_onehot as OH
+from cpgisland_tpu.ops.viterbi_parallel import viterbi_parallel, viterbi_parallel_batch
+from cpgisland_tpu.parallel import decode as pdec
+
+
+def _onehot_model(rng, S=4, perm=None):
+    """Random one-hot-emission model: K = 2*S states, state k emits exactly
+    one symbol; ``perm`` scrambles which states group under which symbol
+    (non-contiguous groups must work too)."""
+    K = 2 * S
+    if perm is None:
+        perm = rng.permutation(K)
+    sym_of_state = np.empty(K, dtype=np.int64)
+    for s in range(S):
+        sym_of_state[perm[2 * s]] = s
+        sym_of_state[perm[2 * s + 1]] = s
+    pi = rng.dirichlet(np.ones(K))
+    A = rng.dirichlet(np.ones(K), size=K)
+    B = np.zeros((K, S))
+    B[np.arange(K), sym_of_state] = 1.0
+    # iid logit perturbation -> argmax ties have probability ~0.
+    A = A * np.exp(rng.normal(scale=1e-3, size=A.shape))
+    A = A / A.sum(axis=1, keepdims=True)
+    return HmmParams.from_probs(pi, A, B)
+
+
+def _path_score(params, obs, path):
+    lp = np.asarray(params.log_pi)
+    lA = np.asarray(params.log_A)
+    lB = np.asarray(params.log_B)
+    S = lB.shape[1]
+    first = next((i for i, o in enumerate(obs) if o < S), None)
+    s = lp[path[0]] + (lB[path[0], obs[0]] if obs[0] < S else 0.0)
+    for t in range(1, len(obs)):
+        if obs[t] >= S:  # PAD: identity step
+            assert path[t] == path[t - 1]
+            continue
+        s += lA[path[t - 1], path[t]] + lB[path[t], obs[t]]
+    return s
+
+
+def test_supports():
+    assert OH.supports(presets.durbin_cpg8())
+    rng = np.random.default_rng(0)
+    dense = HmmParams.from_probs(
+        rng.dirichlet(np.ones(4)),
+        rng.dirichlet(np.ones(4), size=4),
+        rng.dirichlet(np.ones(4), size=4),
+    )
+    assert not OH.supports(dense)
+    # One-hot but 4 states on one symbol / 0 on another: unequal groups.
+    B = np.zeros((4, 2))
+    B[:, 0] = 1.0
+    skew = HmmParams.from_probs(
+        rng.dirichlet(np.ones(4)), rng.dirichlet(np.ones(4), size=4), B
+    )
+    assert not OH.supports(skew)
+
+
+def test_groups_table_matches_support(rng):
+    params = _onehot_model(rng)
+    gt = np.asarray(OH._groups(params))
+    B = np.asarray(params.B)
+    for s in range(params.n_symbols):
+        members = np.nonzero(B[:, s] > 0)[0]
+        assert gt[s].tolist() == sorted(members.tolist())
+
+
+@pytest.mark.parametrize("T,block", [(5, 4), (64, 8), (257, 32), (2000, 256), (5000, 512)])
+def test_matches_generic_engine(rng, T, block):
+    params = _onehot_model(rng)
+    obs = jnp.asarray(rng.integers(0, 4, size=T))
+    p_x, s_x = viterbi_parallel(params, obs, block_size=block, engine="xla")
+    p_o, s_o = viterbi_parallel(params, obs, block_size=block, engine="onehot")
+    assert np.array_equal(np.asarray(p_x), np.asarray(p_o))
+    assert float(s_o) == pytest.approx(float(s_x), rel=1e-5, abs=2e-2)
+
+
+def test_flagship_model_long(rng):
+    params = presets.durbin_cpg8()
+    _, obs = sample_sequence(params, jax.random.PRNGKey(3), 30000)
+    p_x, s_x = viterbi_parallel(params, obs, block_size=1024, engine="xla")
+    p_o, s_o = viterbi_parallel(params, obs, block_size=1024, engine="onehot")
+    assert np.array_equal(np.asarray(p_x), np.asarray(p_o))
+    assert float(s_o) == pytest.approx(float(s_x), rel=1e-5, abs=2e-2)
+
+
+def test_tail_and_mid_pads(rng):
+    """PAD symbols are identity steps anywhere after position 0."""
+    params = _onehot_model(rng)
+    obs = np.asarray(rng.integers(0, 4, size=600), dtype=np.int32)
+    obs[200:230] = 4  # mid-sequence PAD run
+    obs[580:] = 4  # tail PADs
+    p_x, s_x = viterbi_parallel(params, jnp.asarray(obs), block_size=64, engine="xla")
+    p_o, s_o = viterbi_parallel(params, jnp.asarray(obs), block_size=64, engine="onehot")
+    assert np.array_equal(np.asarray(p_x), np.asarray(p_o))
+    assert float(s_o) == pytest.approx(float(s_x), rel=1e-5, abs=2e-2)
+    # Both achieve the score they report (identity steps hold state).
+    got = _path_score(params, obs, np.asarray(p_o))
+    assert got == pytest.approx(float(s_x), rel=1e-5, abs=2e-2)
+
+
+def test_pad_run_across_block_boundary(rng):
+    """A PAD run spanning a block boundary exercises the cross-block
+    forward-fill seed (the [nb]-level cummax in _pair_stream)."""
+    params = _onehot_model(rng)
+    obs = np.asarray(rng.integers(0, 4, size=512), dtype=np.int32)
+    obs[120:200] = 4  # covers the 128-boundary for block=64
+    p_x = viterbi_parallel(params, jnp.asarray(obs), block_size=64, engine="xla",
+                           return_score=False)
+    p_o = viterbi_parallel(params, jnp.asarray(obs), block_size=64, engine="onehot",
+                           return_score=False)
+    assert np.array_equal(np.asarray(p_x), np.asarray(p_o))
+
+
+def test_batch_parity(rng):
+    params = _onehot_model(rng)
+    N, T = 5, 700
+    chunks = rng.integers(0, 4, size=(N, T)).astype(np.int32)
+    lengths = np.asarray([700, 650, 1, 300, 700], dtype=np.int32)
+    p_x = viterbi_parallel_batch(
+        params, jnp.asarray(chunks), jnp.asarray(lengths), block_size=128,
+        return_score=False, engine="xla",
+    )
+    p_o = viterbi_parallel_batch(
+        params, jnp.asarray(chunks), jnp.asarray(lengths), block_size=128,
+        return_score=False, engine="onehot",
+    )
+    for i in range(N):
+        L = int(lengths[i])
+        assert np.array_equal(np.asarray(p_x)[i, :L], np.asarray(p_o)[i, :L])
+
+
+def test_sharded_parity(rng):
+    """Sequence-parallel decode over the 8-device mesh, engine-for-engine."""
+    params = _onehot_model(rng)
+    obs = rng.integers(0, 4, size=8 * 64 * 3 + 17).astype(np.uint8)
+    p_x = pdec.viterbi_sharded(params, obs, block_size=64, engine="xla")
+    p_o = pdec.viterbi_sharded(params, obs, block_size=64, engine="onehot")
+    assert np.array_equal(np.asarray(p_x), np.asarray(p_o))
+
+
+def test_span_parity(rng):
+    """Span-threaded decode (multiple spans, boundary messages) matches the
+    one-shot decode with the onehot engine on both sides."""
+    params = _onehot_model(rng)
+    T = 8 * 64 * 4 + 9
+    obs = rng.integers(0, 4, size=T).astype(np.uint8)
+    one = pdec.viterbi_sharded(params, obs, block_size=64, engine="onehot")
+    spans = pdec.viterbi_sharded_spans(
+        params, obs, span=8 * 64 * 2, block_size=64, engine="onehot"
+    )
+    stitched = np.concatenate([np.asarray(p) for p in spans])
+    assert np.array_equal(np.asarray(one), stitched)
+    # And against the generic engine end to end.
+    spans_x = pdec.viterbi_sharded_spans(
+        params, obs, span=8 * 64 * 2, block_size=64, engine="xla"
+    )
+    assert np.array_equal(stitched, np.concatenate([np.asarray(p) for p in spans_x]))
+
+
+def test_engine_for_record_demotes_pad_first():
+    params = presets.durbin_cpg8()
+    obs_bad = np.asarray([7, 0, 1], dtype=np.uint8)
+    obs_ok = np.asarray([0, 7, 1], dtype=np.uint8)
+    # Demotion honors the dense engines' own eligibility (Pallas: TPU-only).
+    dense = "pallas" if jax.default_backend() == "tpu" else "xla"
+    assert pdec._engine_for_record("onehot", obs_bad, params) == dense
+    assert pdec._engine_for_record("onehot", obs_ok, params) == "onehot"
+    assert pdec._engine_for_record("onehot", obs_bad[:0], params) == dense
+    assert pdec._engine_for_record("xla", obs_bad, params) == "xla"
+
+
+def test_resolve_engine_validation():
+    rng = np.random.default_rng(1)
+    dense = HmmParams.from_probs(
+        rng.dirichlet(np.ones(4)),
+        rng.dirichlet(np.ones(4), size=4),
+        rng.dirichlet(np.ones(4), size=4),
+    )
+    with pytest.raises(ValueError, match="onehot"):
+        pdec.resolve_engine("onehot", dense)
+    # 'auto' lands on onehot exactly when the Pallas kernels are available.
+    expected = "onehot" if jax.default_backend() == "tpu" else "xla"
+    assert pdec.resolve_engine("auto", presets.durbin_cpg8()) == expected
+
+
+def test_prev0_required():
+    params = presets.durbin_cpg8()
+    steps2 = jnp.zeros((8, 1), jnp.int32)
+    with pytest.raises(ValueError, match="prev0"):
+        OH.pass_products(params, steps2, None)
