@@ -269,14 +269,14 @@ def bench_posterior(n_symbols: int, engine: str = "auto", chain: int = 6) -> flo
     obs = jnp.asarray(rng.integers(0, 4, size=n_symbols, dtype=np.int32).astype(np.uint8))
     mask = jnp.asarray((np.arange(params.n_states) < params.n_symbols).astype(np.float32))
 
-    if eng == "pallas":
+    if eng in ("pallas", "onehot"):
         from cpgisland_tpu.ops import fb_pallas
 
         def one(o):
             conf, _ = fb_pallas._seq_posterior_core(
                 params, o, o.shape[0], mask,
                 fb_pallas.pick_lane_T(o.shape[0]), fb_pallas.DEFAULT_T_TILE,
-                axis=None,
+                axis=None, onehot=eng == "onehot",
             )
             return conf
     else:

@@ -1,0 +1,208 @@
+"""One-hot-emission reduced kernels for the forward-backward E-step.
+
+The probability-space twin of ops.viterbi_onehot: for one-hot-emission
+models (the flagship 8-state preset — emissions at CpGIslandFinder.java:
+166-173; one-hot rows are EM fixed points, so trained models keep the
+structure) the alpha/beta vectors are EXACTLY ZERO outside the 2-state
+group of the position's symbol, so the K-state recurrences reduce to
+2-state recurrences whose per-step 2x2 transition is A (times the emission
+probability) sliced between the previous symbol's group and the current
+symbol's group.
+
+Unlike the max-plus case, the reduction here is exact WITHOUT caveats about
+out-of-group candidates: in (+, x) the dropped terms are multiplications by
+exact f32 zeros, so the reduced sums equal the dense sums bit-for-bit; the
+only cross-engine differences are the per-tile renormalization scalars of
+the products kernel (dense normalizes over all K^2 entries, reduced over
+its 4 — directions, which are all that leave the kernel, agree to ~1 ulp).
+
+Pieces (wired into ops.fb_pallas behind its ``onehot`` static flags):
+- `_oh_prod_kernel` — per-lane 2x2 transfer products, t-tiled with the
+  running product in VMEM scratch (mirrors fb_pallas._prod_kernel).
+- `_oh_fwd_kernel` / `_oh_bwd_kernel` / `_oh_bwd_conf_kernel` — the reduced
+  recurrences with the same deferred-Rabiner / time-shifted-input structure
+  as their dense twins; streams shrink from 32 to 8 B/symbol per direction.
+- XLA twins for non-TPU backends (the Pallas interpreter evaluates these
+  select-derived carried chains pathologically slowly — same workaround as
+  ops.viterbi_onehot, same bit-level arithmetic).
+
+Shared with the decode engine: group detection (`viterbi_onehot._groups`),
+the pair stream with two-level forward-fill (`viterbi_onehot._pair_stream`),
+and the lane-broadcast table trick (`_bcast_tab` — Mosaic supports [1, LT]
+sublane broadcasts but not [1, 1] scalar broadcasts).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:  # pragma: no cover - mirrors ops.viterbi_pallas
+    from jax.experimental.pallas import tpu as pltpu
+except Exception:  # pragma: no cover
+    pltpu = None
+
+from cpgisland_tpu.models.hmm import HmmParams
+from cpgisland_tpu.ops.viterbi_onehot import (
+    GROUP,
+    LANE_TILE,
+    ROW_TILE,
+    _bcast_tab,
+    _groups,
+    _interpret,
+    _pair_stream,
+    _vspec,
+    supports,
+    supports_concrete,
+)
+
+__all__ = [
+    "supports", "supports_concrete", "prob_pair_table", "run_products_onehot",
+]
+
+
+def prob_pair_table(params: HmmParams, gt: jnp.ndarray):
+    """Probability-space pair tables.
+
+    tab[p] for p = s_prev * S + s_cur holds [T00, T01, T10, T11] with
+    T[a, c] = A[gt[s_prev, a], gt[s_cur, c]] * B[gt[s_cur, c], s_cur] — the
+    same product the dense kernels compute per lane (A row times the
+    emission select), so values are bit-identical.  PAD pairs (p >= S*S)
+    carry the identity and are handled by the select-tree defaults.
+    """
+    S = params.n_symbols
+    A = jnp.exp(params.log_A).astype(jnp.float32)
+    B = jnp.exp(params.log_B).astype(jnp.float32)
+    A_red = A[gt[:, :, None, None], gt[None, None, :, :]]  # [S, 2, S, 2]
+    B_red = B[gt, jnp.arange(S)[:, None]]  # [S, 2]
+    M = A_red * B_red[None, None, :, :]
+    return jnp.transpose(M, (0, 2, 1, 3)).reshape(S * S, 4).astype(jnp.float32)
+
+
+PROB_IDENT = (1.0, 0.0, 0.0, 1.0)  # the (+, x) identity matrix entries
+
+
+def _select4_prob(tile, tab_ref, nreal):
+    """Pair select with probability identity defaults (shared select tree —
+    viterbi_onehot._select4 parametrized by the semiring identity)."""
+    from cpgisland_tpu.ops.viterbi_onehot import _select4
+
+    return _select4(tile, tab_ref, nreal, ident=PROB_IDENT)
+
+
+def _oh_prod_kernel(pair_ref, tab_ref, out_ref, C_scr, *, nreal, bk):
+    """(+,x) product of each lane's reduced step matrices -> [4, LT].
+
+    Mirrors fb_pallas._prod_kernel: t tiled over the inner grid axis with
+    the running product carried in VMEM scratch; every ROW_TILE steps the
+    2x2 renormalizes by its own total (directions only leave the kernel).
+    """
+    j = pl.program_id(1)
+    n_t = pl.num_programs(1)
+    lt = pair_ref.shape[1]
+
+    @pl.when(j == 0)
+    def _init():
+        C_scr[0:1, :] = jnp.ones((1, lt), jnp.float32)
+        C_scr[1:2, :] = jnp.zeros((1, lt), jnp.float32)
+        C_scr[2:3, :] = jnp.zeros((1, lt), jnp.float32)
+        C_scr[3:4, :] = jnp.ones((1, lt), jnp.float32)
+
+    C0 = tuple(C_scr[i : i + 1, :] for i in range(4))
+
+    def body(c, C):
+        c00, c01, c10, c11 = C
+        tile = pair_ref[pl.ds(c * ROW_TILE, ROW_TILE), :]
+        t00, t01, t10, t11 = _select4_prob(tile, tab_ref, nreal)
+        for r in range(ROW_TILE):
+            a00 = t00[r : r + 1, :]
+            a01 = t01[r : r + 1, :]
+            a10 = t10[r : r + 1, :]
+            a11 = t11[r : r + 1, :]
+            n00 = c00 * a00 + c01 * a10
+            n01 = c00 * a01 + c01 * a11
+            n10 = c10 * a00 + c11 * a10
+            n11 = c10 * a01 + c11 * a11
+            c00, c01, c10, c11 = n00, n01, n10, n11
+        tot = c00 + c01 + c10 + c11
+        inv = 1.0 / jnp.maximum(tot, 1e-30)
+        return c00 * inv, c01 * inv, c10 * inv, c11 * inv
+
+    C = jax.lax.fori_loop(0, bk // ROW_TILE, body, C0)
+    for i in range(4):
+        C_scr[i : i + 1, :] = C[i]
+
+    @pl.when(j == n_t - 1)
+    def _flush():
+        for i in range(4):
+            out_ref[i : i + 1, :] = C_scr[i : i + 1, :]
+
+
+def _xla_products_prob(tab: jnp.ndarray, pair2: jnp.ndarray) -> jnp.ndarray:
+    """XLA twin of the reduced products (non-TPU): per-step renorm instead of
+    per-tile (directions identical; only the internal scalar differs)."""
+    nP = tab.shape[0]
+    NL = pair2.shape[1]
+    ident = jnp.asarray([1.0, 0.0, 0.0, 1.0], jnp.float32)
+    tab_ext = jnp.concatenate([tab, jnp.broadcast_to(ident, (1, 4))], axis=0)
+    C0 = jnp.broadcast_to(ident, (NL, 4)) + (pair2[0, :, None] * 0).astype(jnp.float32)
+
+    def step(C, pk):
+        oh = jax.nn.one_hot(jnp.minimum(pk, nP), nP + 1, dtype=tab.dtype)
+        T = jnp.matmul(oh, tab_ext, precision=jax.lax.Precision.HIGHEST)
+        n00 = C[:, 0] * T[:, 0] + C[:, 1] * T[:, 2]
+        n01 = C[:, 0] * T[:, 1] + C[:, 1] * T[:, 3]
+        n10 = C[:, 2] * T[:, 0] + C[:, 3] * T[:, 2]
+        n11 = C[:, 2] * T[:, 1] + C[:, 3] * T[:, 3]
+        C = jnp.stack([n00, n01, n10, n11], axis=1)
+        return C / jnp.maximum(jnp.sum(C, axis=1, keepdims=True), 1e-30), None
+
+    C, _ = jax.lax.scan(step, C0, pair2)
+    return C.reshape(NL, GROUP, GROUP)
+
+
+def _scatter_products_prob(red, gt, e_in, e_out, K):
+    """[NL, 2, 2] reduced products -> [NL, K, K] dense (zero fill) — exact:
+    the dense product's out-of-group entries are multiplied by exact zeros
+    in every consumer (entering directions / anchor compositions)."""
+    from cpgisland_tpu.ops.viterbi_onehot import _scatter_products
+
+    return _scatter_products(red, gt, e_in, e_out, K, fill=0.0)
+
+
+def run_products_onehot(
+    params: HmmParams, sel_t: jnp.ndarray, prev0, Tt: int
+) -> jnp.ndarray:
+    """Reduced per-lane transfer products, scattered to dense [NL, K, K].
+
+    sel_t: [lane_T, NL] int32 selection symbols (PAD >= S marks identity
+    steps, exactly _run_products_kernel's input transposed); prev0: [] the
+    symbol emitted before this segment's first position (entry group of
+    lane 0).  Drop-in replacement for fb_pallas._run_products_kernel for
+    one-hot models.
+    """
+    K, S = params.n_states, params.n_symbols
+    gt = _groups(params)
+    tab = prob_pair_table(params, gt)
+    pair2, e_in, e_out = _pair_stream(params, sel_t, jnp.asarray(prev0, jnp.int32))
+    NL = sel_t.shape[1]
+    if _interpret():
+        red = _xla_products_prob(tab, pair2)
+    else:
+        tabb = _bcast_tab(tab)
+        (red_flat,) = pl.pallas_call(
+            functools.partial(_oh_prod_kernel, nreal=S * S, bk=Tt),
+            grid=(NL // LANE_TILE, sel_t.shape[0] // Tt),
+            in_specs=[
+                _vspec((Tt, LANE_TILE), lambda i, j: (j, i)),
+                _vspec(tabb.shape, lambda i, j: (0, 0)),
+            ],
+            out_specs=[_vspec((4, LANE_TILE), lambda i, j: (0, i))],
+            out_shape=[jax.ShapeDtypeStruct((4, NL), jnp.float32)],
+            scratch_shapes=[pltpu.VMEM((4, LANE_TILE), jnp.float32)],
+        )(pair2, tabb)
+        red = red_flat.T.reshape(NL, GROUP, GROUP)
+    return _scatter_products_prob(red, gt, e_in, e_out, K)

@@ -696,13 +696,14 @@ def _norm_rows(v):
     return v / jnp.maximum(jnp.sum(v, axis=-1, keepdims=True), 1e-30)
 
 
-@functools.partial(jax.jit, static_argnames=("lane_T", "t_tile"))
+@functools.partial(jax.jit, static_argnames=("lane_T", "t_tile", "onehot"))
 def seq_stats_pallas(
     params: HmmParams,
     obs: jnp.ndarray,
     length,
     lane_T: int = DEFAULT_LANE_T,
     t_tile: int = DEFAULT_T_TILE,
+    onehot: bool = False,
 ) -> SuffStats:
     """EXACT whole-sequence statistics on one device via the fused kernels.
 
@@ -720,7 +721,9 @@ def seq_stats_pallas(
     chromosome shards on a pod; longer single-device inputs should use the
     chunked path or a mesh.
     """
-    return _seq_stats_core(params, obs, length, lane_T, t_tile, axis=None)
+    return _seq_stats_core(
+        params, obs, length, lane_T, t_tile, axis=None, onehot=onehot
+    )
 
 
 def _lane_combine(a, b):
@@ -798,6 +801,8 @@ def _lane_streams(
     exit_dir=None,
     first: bool = True,
     conf_mask=None,
+    onehot: bool = False,
+    prev_sym=None,
 ):
     """Shared lane setup for the fused whole-sequence paths: lane transfer
     products -> boundary messages -> forward/backward kernel streams.
@@ -841,7 +846,29 @@ def _lane_streams(
     length = jnp.asarray(length, jnp.int32)
 
     # --- lane transfer operators (pallas) -> boundary messages (XLA) ------
-    P = _run_products_kernel(A, B, sel_l, lane_T, Tt, K, S)  # P[lane, i, m]
+    if onehot:
+        # Reduced 2x2 products for one-hot-emission models (ops.fb_onehot):
+        # exact — the dense product entries outside the boundary symbol
+        # groups are multiplied by exact zeros in every consumer below.
+        from cpgisland_tpu.ops import fb_onehot, viterbi_onehot
+
+        if not first and prev_sym is None:
+            raise ValueError(
+                "onehot continuation spans (first=False) need prev_sym — "
+                "the symbol emitted before this span's first position"
+            )
+        prev_seg = jnp.asarray(
+            obs_flat[0] if first else prev_sym, jnp.int32
+        )
+        T_in = obs.shape[0]
+        seed_syms = jnp.where(jnp.arange(T_in) < length, obs_flat, S)
+        prev_dev = (
+            viterbi_onehot.device_entry_sym(seed_syms, S, axis, prev_seg)
+            if axis is not None else prev_seg
+        )
+        P = fb_onehot.run_products_onehot(params, sel_l.T, prev_dev, Tt)
+    else:
+        P = _run_products_kernel(A, B, sel_l, lane_T, Tt, K, S)  # P[lane, i, m]
 
     incl = jax.lax.associative_scan(_lane_combine, P, axis=0)
     eyeK = jnp.broadcast_to(jnp.eye(K, dtype=jnp.float32), (1, K, K))
@@ -904,6 +931,7 @@ def _seq_stats_core(
     t_tile: int,
     axis,
     reduce: bool = True,
+    onehot: bool = False,
 ) -> SuffStats:
     """The fused whole-sequence E-step over THIS device's time shard.
 
@@ -921,7 +949,7 @@ def _seq_stats_core(
     length = jnp.asarray(length, jnp.int32)
 
     alphas, cs, betas, steps2, lens2, enters, is_first, _ = _lane_streams(
-        params, obs, length, lane_T, t_tile, axis
+        params, obs, length, lane_T, t_tile, axis, onehot=onehot
     )
     NL = steps2.shape[1]
 
@@ -973,6 +1001,8 @@ def _seq_posterior_core(
     exit_dir=None,
     first: bool = True,
     want_path: bool = False,
+    onehot: bool = False,
+    prev_sym=None,
 ):
     """Per-position island confidence over THIS device's time shard, fused.
 
@@ -998,7 +1028,7 @@ def _seq_posterior_core(
         _, _, conf2, steps2, _, _, _, _ = _lane_streams(
             params, obs, length, lane_T, t_tile, axis,
             enter_dir=enter_dir, exit_dir=exit_dir, first=first,
-            conf_mask=island_mask,
+            conf_mask=island_mask, onehot=onehot, prev_sym=prev_sym,
         )
         # Lane n covers global positions [n*lane_T, (n+1)*lane_T): transpose
         # the [lane_T, NL] lane layout back to global order, slice the pad.
@@ -1006,13 +1036,14 @@ def _seq_posterior_core(
     alphas, cs, betas, steps2, lens2, _, _, _ = _lane_streams(
         params, obs, length, lane_T, t_tile, axis,
         enter_dir=enter_dir, exit_dir=exit_dir, first=first,
+        onehot=onehot, prev_sym=prev_sym,
     )
     conf2, path2 = _conf_path_from_streams(alphas, betas, lens2, island_mask)
     return conf2.T.reshape(-1)[:T], path2.T.reshape(-1)[:T]
 
 
 @functools.partial(
-    jax.jit, static_argnames=("lane_T", "t_tile", "first", "want_path")
+    jax.jit, static_argnames=("lane_T", "t_tile", "first", "want_path", "onehot")
 )
 def seq_posterior_pallas(
     params: HmmParams,
@@ -1025,6 +1056,8 @@ def seq_posterior_pallas(
     want_path: bool = False,
     lane_T: int = DEFAULT_LANE_T,
     t_tile: int = DEFAULT_T_TILE,
+    onehot: bool = False,
+    prev_sym=None,
 ):
     """Single-device fused posterior: (conf [T], mpm path [T]).
 
@@ -1035,7 +1068,7 @@ def seq_posterior_pallas(
     return _seq_posterior_core(
         params, obs, length, island_mask, lane_T, t_tile, axis=None,
         enter_dir=enter_dir, exit_dir=exit_dir, first=first,
-        want_path=want_path,
+        want_path=want_path, onehot=onehot, prev_sym=prev_sym,
     )
 
 
@@ -1075,7 +1108,9 @@ def batch_posterior_pallas(
     return conf2.T[:N, :T], path2.T[:N, :T]
 
 
-@functools.partial(jax.jit, static_argnames=("lane_T", "t_tile", "first"))
+@functools.partial(
+    jax.jit, static_argnames=("lane_T", "t_tile", "first", "onehot")
+)
 def seq_transfer_total_pallas(
     params: HmmParams,
     obs: jnp.ndarray,
@@ -1083,6 +1118,8 @@ def seq_transfer_total_pallas(
     first: bool = True,
     lane_T: int = DEFAULT_LANE_T,
     t_tile: int = DEFAULT_T_TILE,
+    onehot: bool = False,
+    prev_sym=None,
 ) -> jnp.ndarray:
     """Normalized probability-space transfer operator of one span (products
     kernel only — the cheap forward sweep of span-threaded processing).
@@ -1090,10 +1127,21 @@ def seq_transfer_total_pallas(
     Returns [K, K] M with alpha_dir_out ∝ alpha_dir_in @ M.  ``first`` masks
     global position 0 (its step is the init, folded into the base direction
     by the consumer) — pass True only for the sequence's first span.
+    ``onehot`` (one-hot-emission models) swaps in the reduced 2x2 products
+    kernel; continuation spans then need ``prev_sym`` (the symbol before the
+    span — it conditions the reduced chain's entry group).
     """
     K, S = params.n_states, params.n_symbols
-    A = jnp.exp(params.log_A).astype(jnp.float32)
-    B = jnp.exp(params.log_B).astype(jnp.float32)
-    _, sel_l, _, _, Tt, _ = _lane_layout(obs, length, S, lane_T, t_tile, first)
-    P = _run_products_kernel(A, B, sel_l, lane_T, Tt, K, S)
+    _, sel_l, _, obs_flat, Tt, _ = _lane_layout(obs, length, S, lane_T, t_tile, first)
+    if onehot:
+        from cpgisland_tpu.ops import fb_onehot
+
+        if not first and prev_sym is None:
+            raise ValueError("onehot continuation spans need prev_sym")
+        prev_seg = jnp.asarray(obs_flat[0] if first else prev_sym, jnp.int32)
+        P = fb_onehot.run_products_onehot(params, sel_l.T, prev_seg, Tt)
+    else:
+        A = jnp.exp(params.log_A).astype(jnp.float32)
+        B = jnp.exp(params.log_B).astype(jnp.float32)
+        P = _run_products_kernel(A, B, sel_l, lane_T, Tt, K, S)
     return jax.lax.associative_scan(_lane_combine, P, axis=0)[-1]

@@ -86,14 +86,14 @@ def _interpret() -> bool:
 # Structure detection
 
 
-def supports(params: HmmParams) -> bool:
-    """Host-side eligibility: emissions one-hot with exactly GROUP states per
-    symbol.  Requires concrete params (returns False under tracing — engine
-    selection is a host decision; see parallel.decode.resolve_engine)."""
+def supports_concrete(params: HmmParams):
+    """Tri-state eligibility: True/False on concrete params, None when the
+    params are traced (undecidable at trace time — validation sites treat
+    None as "trust the caller", auto-selection sites as "don't upgrade")."""
     try:
         logB = np.asarray(params.log_B)
     except Exception:
-        return False
+        return None
     if not np.all(np.isfinite(logB) | (logB <= LOG_ZERO / 2)):
         return False
     support = logB > LOG_ZERO / 2
@@ -102,6 +102,13 @@ def supports(params: HmmParams) -> bool:
     sym = np.argmax(support, axis=1)
     counts = np.bincount(sym, minlength=params.n_symbols)
     return bool(np.all(counts == GROUP))
+
+
+def supports(params: HmmParams) -> bool:
+    """Host-side eligibility: emissions one-hot with exactly GROUP states per
+    symbol.  Requires concrete params (False under tracing — engine
+    selection is a host decision; see parallel.decode.resolve_engine)."""
+    return supports_concrete(params) is True
 
 
 def _groups(params: HmmParams) -> jnp.ndarray:
@@ -145,6 +152,28 @@ def _pair_table(params: HmmParams, gt: jnp.ndarray):
     )
     idtab = gt[exit_sym]  # [S*S + S, GROUP]
     return tab, idtab
+
+
+def device_entry_sym(obs_c: jnp.ndarray, pad_sym: int, axis: str,
+                     prev0: jnp.ndarray) -> jnp.ndarray:
+    """Symbol emitted by the state entering THIS device's shard (shard_map).
+
+    The last real symbol on any earlier device, else the segment-level
+    ``prev0``.  One tiny scalar all_gather; used by every reduced engine
+    (max-plus decode and probability-space FB) — the reduced chains are
+    conditioned on the entering symbol's state group."""
+    L = obs_c.shape[0]
+    iota = jnp.arange(L, dtype=jnp.int32)
+    keyloc = jnp.max(jnp.where(obs_c < pad_sym, iota * pad_sym + obs_c, -1))
+    keys = jax.lax.all_gather(keyloc, axis)  # [D] scalars
+    didx = jnp.arange(keys.shape[0], dtype=jnp.int32)
+    d = jax.lax.axis_index(axis)
+    sym = keys - (keys // pad_sym) * pad_sym
+    gkey = jnp.where((didx < d) & (keys >= 0), didx * (pad_sym + 1) + sym, -1)
+    m = jnp.max(gkey)
+    return jnp.where(
+        m >= 0, m - (m // (pad_sym + 1)) * (pad_sym + 1), prev0
+    ).astype(jnp.int32)
 
 
 # ---------------------------------------------------------------------------
@@ -211,19 +240,21 @@ def _pad_pair_rows(pair2: jnp.ndarray, e_out: jnp.ndarray, S: int):
     return jnp.concatenate([pair2, tail], axis=0), bk_pad
 
 
-def _select4(tile, tab_ref, nreal):
+def _select4(tile, tab_ref, nreal, ident=(0.0, LOG_ZERO, LOG_ZERO, 0.0)):
     """In-kernel select tree: pair tile [8, LT] -> the 4 matrix-entry tiles.
 
     ``tab_ref`` is the lane-broadcast table [(nreal)*4, LANE_TILE] (row
     p*4 + j holds matrix entry j of pair p replicated across lanes — Mosaic
     supports [1, LT] sublane broadcasts but not [1, 1] scalar broadcasts).
     One compare per table row shared by all four selects; PAD pairs
-    (p >= S*S) all carry the identity, so they fold into the defaults.
+    (p >= S*S) all carry the identity, so they fold into the ``ident``
+    defaults — the max-plus identity here, the (+, x) identity (1, 0, 0, 1)
+    for the probability-space twin (ops.fb_onehot).
     """
-    t00 = jnp.full(tile.shape, 0.0, jnp.float32)
-    t01 = jnp.full(tile.shape, LOG_ZERO, jnp.float32)
-    t10 = jnp.full(tile.shape, LOG_ZERO, jnp.float32)
-    t11 = jnp.full(tile.shape, 0.0, jnp.float32)
+    t00 = jnp.full(tile.shape, ident[0], jnp.float32)
+    t01 = jnp.full(tile.shape, ident[1], jnp.float32)
+    t10 = jnp.full(tile.shape, ident[2], jnp.float32)
+    t11 = jnp.full(tile.shape, ident[3], jnp.float32)
     for p in range(nreal):
         cmp = tile == p
         t00 = jnp.where(cmp, tab_ref[4 * p : 4 * p + 1, :], t00)
@@ -358,13 +389,16 @@ def _oh_backtrace_kernel(bp_ref, pair_ref, idtab_ref, exit_ref, path_ref, *, nP,
 # Scatter glue: reduced block results -> full-K interfaces
 
 
-def _scatter_products(red, gt, e_in, e_out, K):
-    """[nb, 2, 2] reduced block products -> [nb, K, K] full (LOG_ZERO fill)."""
+def _scatter_products(red, gt, e_in, e_out, K, fill=LOG_ZERO):
+    """[nb, 2, 2] reduced block products -> [nb, K, K] full.
+
+    ``fill`` is the semiring zero: LOG_ZERO for max-plus, 0.0 for the
+    probability-space twin (ops.fb_onehot)."""
     nb = red.shape[0]
     gin = gt[e_in]  # [nb, 2]
     gout = gt[e_out]  # [nb, 2]
     iK = jnp.arange(K, dtype=jnp.int32)
-    full = jnp.full((nb, K, K), LOG_ZERO, jnp.float32)
+    full = jnp.full((nb, K, K), fill, jnp.float32)
     for a in range(GROUP):
         for c in range(GROUP):
             mask = (iK[None, :, None] == gin[:, a, None, None]) & (

@@ -89,24 +89,9 @@ def _prev_real_symbol(obs: np.ndarray, lo: int, n_symbols: int) -> int:
     return int(obs[i]) if i >= 0 else 0
 
 
-def _device_entry_sym(obs_c: jnp.ndarray, pad_sym: int, axis: str,
-                      prev0: jnp.ndarray) -> jnp.ndarray:
-    """Symbol emitted by the state entering THIS device's shard: the last
-    real symbol on any earlier device, else the segment-level ``prev0``.
-    Consumed only by the onehot engine (its reduced chain is conditioned on
-    the entering symbol's state group); one tiny scalar all_gather."""
-    L = obs_c.shape[0]
-    iota = jnp.arange(L, dtype=jnp.int32)
-    keyloc = jnp.max(jnp.where(obs_c < pad_sym, iota * pad_sym + obs_c, -1))
-    keys = jax.lax.all_gather(keyloc, axis)  # [D] scalars
-    didx = jnp.arange(keys.shape[0], dtype=jnp.int32)
-    d = jax.lax.axis_index(axis)
-    sym = keys - (keys // pad_sym) * pad_sym
-    gkey = jnp.where((didx < d) & (keys >= 0), didx * (pad_sym + 1) + sym, -1)
-    m = jnp.max(gkey)
-    return jnp.where(
-        m >= 0, m - (m // (pad_sym + 1)) * (pad_sym + 1), prev0
-    ).astype(jnp.int32)
+# The per-device entry-symbol helper lives with the reduced engines
+# (ops.viterbi_onehot.device_entry_sym) — shared by decode and FB.
+_device_entry_sym = viterbi_onehot.device_entry_sym
 
 
 def _shard_body(block_size: int, axis: str, engine: str = "xla",

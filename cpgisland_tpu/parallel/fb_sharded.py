@@ -449,7 +449,7 @@ def _shard_stats2d_body(
     def body(params: HmmParams, obs_tile: jnp.ndarray, len_tile: jnp.ndarray) -> SuffStats:
         K, M = params.n_states, params.n_symbols
 
-        if engine == "pallas":
+        if engine in ("pallas", "onehot"):
             from cpgisland_tpu.ops import fb_pallas
 
             lt = (
@@ -462,7 +462,7 @@ def _shard_stats2d_body(
             def one_seq(obs_row, length):
                 return fb_pallas._seq_stats_core(
                     params, obs_row, length, lt, tt,
-                    axis=seq_axis, reduce=False,
+                    axis=seq_axis, reduce=False, onehot=engine == "onehot",
                 )
         else:
             def one_seq(obs_row, length):
@@ -536,24 +536,27 @@ def sharded_stats2d_fn(
             # pallas_call output types are opaque to the varying-axes
             # checker — the project-wide pattern for pallas-under-shard_map
             # (see parallel.decode, SpmdBackend).
-            check_vma=engine != "pallas",
+            check_vma=engine == "xla",
         )
     )
 
 
 @functools.lru_cache(maxsize=32)
-def sharded_stats_pallas_fn(mesh: Mesh, lane_T: int, t_tile: int):
+def sharded_stats_pallas_fn(mesh: Mesh, lane_T: int, t_tile: int,
+                            onehot: bool = False):
     """Fused-kernel twin of :func:`sharded_stats_fn` (same placed-array
     contract): per-device lane products + boundary-message exchange run the
     chunked Pallas forward/backward kernels on each shard — exact
-    whole-sequence statistics at kernel speed across the mesh."""
+    whole-sequence statistics at kernel speed across the mesh.  ``onehot``
+    routes the reduced kernels for one-hot-emission models."""
     from cpgisland_tpu.ops import fb_pallas
 
     axis = mesh.axis_names[0]
 
     def body(params, obs_shard, len_shard):
         return fb_pallas._seq_stats_core(
-            params, obs_shard, len_shard[0], lane_T, t_tile, axis=axis
+            params, obs_shard, len_shard[0], lane_T, t_tile, axis=axis,
+            onehot=onehot,
         )
 
     return jax.jit(

@@ -46,17 +46,32 @@ _HI = jax.lax.Precision.HIGHEST
 
 
 def resolve_fb_engine(engine: str, params: HmmParams) -> str:
-    """'auto' picks the fused FB kernels on TPU when the model fits their
-    lane packing, the XLA lane path otherwise (incl. the CPU test mesh)."""
+    """'auto' picks the reduced one-hot FB kernels on TPU when the model's
+    emission structure supports them (ops.fb_onehot — the flagship 8-state
+    preset does), else the dense fused kernels when the model fits their
+    lane packing, else the XLA lane path (incl. the CPU test mesh)."""
+    from cpgisland_tpu.ops import fb_onehot
+
     if engine == "auto":
         if jax.default_backend() == "tpu" and fb_pallas.supports(params):
+            if fb_onehot.supports(params):
+                return "onehot"
             return "pallas"
         return "xla"
-    if engine not in ("xla", "pallas"):
-        raise ValueError(f"unknown engine {engine!r}; expected auto|xla|pallas")
+    if engine not in ("xla", "pallas", "onehot"):
+        raise ValueError(
+            f"unknown engine {engine!r}; expected auto|xla|pallas|onehot"
+        )
     if engine == "pallas" and not fb_pallas.supports(params):
         raise ValueError(
             f"pallas FB kernels need n_states <= 8, got {params.n_states}"
+        )
+    if engine == "onehot" and not (
+        fb_pallas.supports(params) and fb_onehot.supports(params)
+    ):
+        raise ValueError(
+            "onehot FB kernels need one-hot emissions with 2 states per "
+            "symbol (concrete params)"
         )
     return engine
 
@@ -77,12 +92,14 @@ def _posterior_fn(
     ``first`` — so one cache entry serves every span of a record."""
     axis = mesh.axis_names[0]
 
-    def body(params, obs_shard, len_shard, island_mask, enter_dir, exit_dir):
-        if engine == "pallas":
+    def body(params, obs_shard, len_shard, island_mask, enter_dir, exit_dir,
+             prev_sym):
+        if engine in ("pallas", "onehot"):
             return fb_pallas._seq_posterior_core(
                 params, obs_shard, len_shard[0], island_mask, lane_T, t_tile,
                 axis=axis, enter_dir=enter_dir, exit_dir=exit_dir,
                 first=first, want_path=want_path,
+                onehot=engine == "onehot", prev_sym=prev_sym,
             )
         return _one_seq_local_posterior(
             params, obs_shard, len_shard[0], island_mask,
@@ -95,9 +112,9 @@ def _posterior_fn(
         jax.shard_map(
             body,
             mesh=mesh,
-            in_specs=(P(), P(axis), P(axis), P(), P(), P()),
+            in_specs=(P(), P(axis), P(axis), P(), P(), P(), P()),
             out_specs=(P(axis), P(axis)),
-            check_vma=engine != "pallas",
+            check_vma=engine == "xla",
         )
     )
 
@@ -210,6 +227,7 @@ def posterior_sharded(
     return_device: bool = False,
     pad_to: Optional[int] = None,
     placed=None,
+    prev_sym: int = 0,
 ):
     """Island confidence (and optional MPM path) for one sequence, sharded
     along time over the mesh.
@@ -253,7 +271,9 @@ def posterior_sharded(
         else jnp.asarray(exit_dir, jnp.float32)
     )
     fn = _posterior_fn(mesh, block_size, eng, first, want_path, lt, tt)
-    conf, path = fn(params, arr, lens, mask, enter, exit_)
+    conf, path = fn(
+        params, arr, lens, mask, enter, exit_, jnp.int32(prev_sym)
+    )
     conf = fetch_sharded_prefix(conf, T, return_device)
     path = fetch_sharded_prefix(path, T, return_device) if want_path else None
     return conf, path
@@ -269,22 +289,28 @@ def transfer_total_sharded(
     first: bool = True,
     pad_to: Optional[int] = None,
     placed=None,
+    prev_sym: int = 0,
 ) -> np.ndarray:
     """One span's normalized [K, K] probability-space transfer operator
     (sweep A of span-threaded posterior processing).  ``placed`` (from
     place_record_span) reuses an already-uploaded span; ``obs`` then only
-    supplies the true length."""
+    supplies the true length.  ``prev_sym``: the symbol before the span
+    (consumed by the reduced onehot kernels on continuation spans)."""
     if mesh is None:
         mesh = make_mesh(axis=SEQ_AXIS)
     n_dev = mesh.shape[mesh.axis_names[0]]
-    if n_dev == 1 and resolve_fb_engine(engine, params) == "pallas":
+    eng = resolve_fb_engine(engine, params)
+    if n_dev == 1 and eng in ("pallas", "onehot"):
         # Single-chip TPU: the products Pallas kernel is much faster than
         # the XLA lane scan for this sweep.
+        oh = eng == "onehot"
+        ps = jnp.int32(prev_sym)
         if placed is not None:
             return np.asarray(
                 fb_pallas.seq_transfer_total_pallas(
                     params, placed[0], int(obs.shape[0]), first=first,
                     lane_T=fb_pallas.pick_lane_T(placed[0].shape[0]),
+                    onehot=oh, prev_sym=ps,
                 )
             )
         obs = np.asarray(obs)
@@ -297,6 +323,7 @@ def transfer_total_sharded(
             fb_pallas.seq_transfer_total_pallas(
                 params, jnp.asarray(obs), n, first=first,
                 lane_T=fb_pallas.pick_lane_T(obs.shape[0]),
+                onehot=oh, prev_sym=ps,
             )
         )
     arr, lens = (

@@ -780,6 +780,7 @@ def posterior_file(
     directions threaded between them — EXACT posteriors at any length; the
     span only bounds peak device memory.
     """
+    from cpgisland_tpu.parallel.decode import _prev_real_symbol
     from cpgisland_tpu.parallel.mesh import fetch_sharded_prefix
     from cpgisland_tpu.parallel.posterior import (
         island_mask,
@@ -828,7 +829,7 @@ def posterior_file(
     )
     # Small records batch into one chunked-layout kernel pass (pallas only;
     # the XLA lane path serves one record at a time).
-    batch_small = resolve_fb_engine(engine, params) == "pallas"
+    batch_small = resolve_fb_engine(engine, params) in ("pallas", "onehot")
     # Writers open INSIDE the try: a failure opening the second must still
     # close (finalize) the first, not leave a corrupt header slot behind.
     conf_w = None
@@ -1052,6 +1053,14 @@ def posterior_file(
                         transfer_total_sharded(
                             params, piece, engine=engine, first=lo == 0,
                             pad_to=span, placed=span_placed[si],
+                            # The symbol before the span conditions the
+                            # reduced onehot kernels' entry group.
+                            prev_sym=(
+                                0 if lo == 0
+                                else _prev_real_symbol(
+                                    symbols, lo, params.n_symbols
+                                )
+                            ),
                         )
                     )
             # Host threading: entering-alpha / exiting-beta directions per
@@ -1089,6 +1098,10 @@ def posterior_file(
                         want_path=want_path, pad_to=span,
                         return_device=use_device_islands,
                         placed=span_placed.pop(s),
+                        prev_sym=(
+                            0 if s == 0
+                            else _prev_real_symbol(symbols, lo, params.n_symbols)
+                        ),
                     )
                 if use_device_islands:
                     if want_conf:

@@ -243,9 +243,10 @@ class SpmdBackend(EStepBackend):
 
 
 def _check_seq_engine(engine: str) -> None:
-    if engine not in ("auto", "xla", "pallas"):
+    if engine not in ("auto", "xla", "pallas", "onehot"):
         raise ValueError(
-            f"sequence-parallel engine must be auto|xla|pallas, got {engine!r}"
+            f"sequence-parallel engine must be auto|xla|pallas|onehot, "
+            f"got {engine!r}"
         )
 
 
@@ -285,18 +286,40 @@ def _use_fused_seq(engine: str, params: HmmParams, shard_len: int) -> bool:
     """
     if engine == "xla":
         return False
-    if engine == "pallas":
+    if engine in ("pallas", "onehot"):
         if not fb_pallas.supports(params):
             raise ValueError(
-                f"engine='pallas' but the fused kernels do not support "
+                f"engine={engine!r} but the fused kernels do not support "
                 f"{params.n_states} states"
             )
+        if engine == "onehot":
+            from cpgisland_tpu.ops import fb_onehot
+
+            # None = traced params (undecidable): trust the explicit choice.
+            if fb_onehot.supports_concrete(params) is False:
+                raise ValueError(
+                    "engine='onehot' needs one-hot emissions with 2 states "
+                    "per symbol"
+                )
         return True
     return (
         shard_len >= (1 << 20)
         and jax.default_backend() == "tpu"
         and fb_pallas.supports(params)
     )
+
+
+def _seq_onehot(engine: str, params: HmmParams) -> bool:
+    """Route a fused whole-sequence E-step through the reduced one-hot
+    kernels?  Explicit 'onehot' always (validated in _use_fused_seq);
+    'auto' when the model's emission structure supports them."""
+    if engine == "onehot":
+        return True
+    if engine == "auto":
+        from cpgisland_tpu.ops import fb_onehot
+
+        return fb_onehot.supports(params)
+    return False
 
 
 class SeqBackend(EStepBackend):
@@ -388,12 +411,15 @@ class SeqBackend(EStepBackend):
                 if self.lane_T is not None
                 else fb_pallas.pick_lane_T(obs_flat.shape[0] // n_dev)
             )
+            oh = _seq_onehot(self.engine, params)
             if n_dev == 1:
                 return fb_pallas.seq_stats_pallas(
                     params, obs_flat, jnp.sum(lengths),
-                    lane_T=lane_T, t_tile=self.t_tile,
+                    lane_T=lane_T, t_tile=self.t_tile, onehot=oh,
                 )
-            fn = fb_sharded.sharded_stats_pallas_fn(self.mesh, lane_T, self.t_tile)
+            fn = fb_sharded.sharded_stats_pallas_fn(
+                self.mesh, lane_T, self.t_tile, oh
+            )
             return fn(params, obs_flat, lengths)
         fn = fb_sharded.sharded_stats_fn(self.mesh, self.block_size)
         return fn(params, obs_flat, lengths)
@@ -513,7 +539,7 @@ class Seq2DBackend(EStepBackend):
         sp = mesh.shape[mesh.axis_names[1]]
         _check_seq_shard(chunks.shape[1] // sp, "Seq2DBackend")
         engine = (
-            "pallas"
+            ("onehot" if _seq_onehot(self.engine, params) else "pallas")
             if _use_fused_seq(self.engine, params, chunks.shape[1] // sp)
             else "xla"
         )

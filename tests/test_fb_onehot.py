@@ -1,0 +1,206 @@
+"""The reduced one-hot FB kernels vs the dense fused path (exactness).
+
+ops.fb_onehot reduces the probability-space boundary-message products (and,
+with it, the whole-sequence posterior / exact-EM paths that consume them)
+to 2x2 for one-hot-emission models.  Unlike the max-plus case the reduction
+is exact without caveats — dropped terms are multiplications by exact f32
+zeros — so parity here is tight: conf/stat outputs agree with the dense
+engine to f32 rounding of the renormalization scalars (~1e-6), and the
+consumed DIRECTION of a transfer operator agrees even though the raw
+matrices differ in never-consumed out-of-group rows.
+
+Off-TPU these run the XLA twins; the TPU suite run exercises the Pallas
+kernels against the same assertions.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cpgisland_tpu.models import presets
+from cpgisland_tpu.models.hmm import HmmParams, sample_sequence
+from cpgisland_tpu.ops import fb_onehot, fb_pallas
+from cpgisland_tpu.parallel.posterior import posterior_sharded, resolve_fb_engine
+from cpgisland_tpu.train.backends import SeqBackend
+from cpgisland_tpu.utils import chunking
+
+MASK8 = jnp.asarray(np.r_[np.ones(4), np.zeros(4)].astype(np.float32))
+
+
+def _obs(rng, n):
+    params = presets.durbin_cpg8()
+    _, obs = sample_sequence(params, jax.random.PRNGKey(int(rng.integers(1 << 30))), n)
+    return params, obs
+
+
+def test_supports():
+    assert fb_onehot.supports(presets.durbin_cpg8())
+    rng = np.random.default_rng(0)
+    dense = HmmParams.from_probs(
+        rng.dirichlet(np.ones(4)),
+        rng.dirichlet(np.ones(4), size=4),
+        rng.dirichlet(np.ones(4), size=4),
+    )
+    assert not fb_onehot.supports(dense)
+
+
+def test_posterior_conf_parity(rng):
+    params, obs = _obs(rng, 30000)
+    c_d, _ = fb_pallas.seq_posterior_pallas(
+        params, obs, obs.shape[0], MASK8, lane_T=4096, t_tile=512
+    )
+    c_o, _ = fb_pallas.seq_posterior_pallas(
+        params, obs, obs.shape[0], MASK8, lane_T=4096, t_tile=512, onehot=True
+    )
+    np.testing.assert_allclose(np.asarray(c_d), np.asarray(c_o), atol=2e-5)
+
+
+def test_posterior_want_path_parity(rng):
+    params, obs = _obs(rng, 20000)
+    c_d, p_d = fb_pallas.seq_posterior_pallas(
+        params, obs, obs.shape[0], MASK8, lane_T=4096, t_tile=512, want_path=True
+    )
+    c_o, p_o = fb_pallas.seq_posterior_pallas(
+        params, obs, obs.shape[0], MASK8, lane_T=4096, t_tile=512,
+        want_path=True, onehot=True,
+    )
+    np.testing.assert_allclose(np.asarray(c_d), np.asarray(c_o), atol=2e-5)
+    assert np.array_equal(np.asarray(p_d), np.asarray(p_o))
+
+
+def test_continuation_span_parity(rng):
+    """first=False spans with threaded enter/exit directions and prev_sym."""
+    params, obs = _obs(rng, 24000)
+    span = 12000
+    piece = obs[span:]
+    enter = np.abs(np.random.default_rng(1).normal(size=8)).astype(np.float32)
+    enter /= enter.sum()
+    kwargs = dict(
+        enter_dir=jnp.asarray(enter), exit_dir=None, first=False,
+        lane_T=4096, t_tile=512,
+    )
+    c_d, _ = fb_pallas.seq_posterior_pallas(
+        params, piece, piece.shape[0], MASK8, **kwargs
+    )
+    c_o, _ = fb_pallas.seq_posterior_pallas(
+        params, piece, piece.shape[0], MASK8,
+        onehot=True, prev_sym=jnp.int32(int(obs[span - 1])), **kwargs
+    )
+    np.testing.assert_allclose(np.asarray(c_d), np.asarray(c_o), atol=2e-5)
+
+
+def test_transfer_total_consumed_direction(rng):
+    """Raw operators differ in never-consumed rows; the consumed direction
+    (in-group entering dir @ total) must agree — first AND continuation."""
+    params, obs = _obs(rng, 16000)
+    pi = np.exp(np.asarray(params.log_pi))
+    B = np.exp(np.asarray(params.log_B))
+    for first, prev in ((True, 0), (False, int(obs[4095]))):
+        piece = obs if first else obs[4096:]
+        t_d = np.asarray(
+            fb_pallas.seq_transfer_total_pallas(
+                params, piece, piece.shape[0], first=first, lane_T=4096
+            )
+        )
+        t_o = np.asarray(
+            fb_pallas.seq_transfer_total_pallas(
+                params, piece, piece.shape[0], first=first, lane_T=4096,
+                onehot=True, prev_sym=jnp.int32(prev),
+            )
+        )
+        v = pi * B[:, int(piece[0])] if first else pi * B[:, prev]
+        v = (v / v.sum()).astype(np.float32)
+        d_d = v @ t_d
+        d_o = v @ t_o
+        np.testing.assert_allclose(
+            d_d / d_d.sum(), d_o / d_o.sum(), atol=2e-6
+        )
+
+
+def test_seq_stats_parity(rng):
+    params, obs = _obs(rng, 40000)
+    s_d = fb_pallas.seq_stats_pallas(params, obs, obs.shape[0], lane_T=4096)
+    s_o = fb_pallas.seq_stats_pallas(
+        params, obs, obs.shape[0], lane_T=4096, onehot=True
+    )
+    np.testing.assert_allclose(np.asarray(s_d.init), np.asarray(s_o.init), atol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(s_d.trans), np.asarray(s_o.trans), rtol=1e-5, atol=1e-3
+    )
+    np.testing.assert_allclose(
+        np.asarray(s_d.emit), np.asarray(s_o.emit), rtol=1e-5, atol=1e-3
+    )
+    assert float(s_d.loglik) == pytest.approx(float(s_o.loglik), rel=1e-6)
+
+
+def test_seq_backend_onehot(rng):
+    """SeqBackend(engine='onehot') over the 8-device mesh matches 'xla'."""
+    params, obs = _obs(rng, 8 * 4096)
+    chunked = chunking.Chunked(
+        chunks=np.asarray(obs)[None, :],
+        lengths=np.asarray([obs.shape[0]], np.int32),
+        total=obs.shape[0],
+    )
+    stats = {}
+    for eng in ("xla", "onehot"):
+        backend = SeqBackend(engine=eng, lane_T=512, t_tile=256)
+        prepared = backend.prepare(chunked)
+        o, l = backend.place(prepared.chunks, prepared.lengths)
+        stats[eng] = backend(params, o, l)
+    for f in ("init", "trans", "emit"):
+        np.testing.assert_allclose(
+            np.asarray(getattr(stats["xla"], f)),
+            np.asarray(getattr(stats["onehot"], f)),
+            rtol=1e-4, atol=1e-3,
+        )
+
+
+def test_posterior_sharded_onehot(rng):
+    """Sharded posterior over the 8-device mesh, onehot vs xla engines."""
+    params, obs = _obs(rng, 8 * 2048 + 77)
+    c_x, _ = posterior_sharded(
+        params, np.asarray(obs), (0, 1, 2, 3), engine="xla", block_size=256
+    )
+    c_o, _ = posterior_sharded(
+        params, np.asarray(obs), (0, 1, 2, 3), engine="onehot",
+        lane_T=512, t_tile=256,
+    )
+    np.testing.assert_allclose(np.asarray(c_x), np.asarray(c_o), atol=2e-5)
+
+
+def test_posterior_file_span_onehot(tmp_path, rng):
+    """End-to-end: posterior_file's span threading (prev_sym included) with
+    the onehot engine matches the dense engine and the unspanned run."""
+    from cpgisland_tpu import pipeline
+
+    params, obs = _obs(rng, 3000)
+    seq = "".join("ACGT"[s] for s in np.asarray(obs))
+    fa = tmp_path / "t.fa"
+    fa.write_text(f">r1\n{seq}\n")
+    outs = {}
+    for eng, span in (("pallas", 1500), ("onehot", 1500), ("onehot", 1 << 20)):
+        conf_p = tmp_path / f"c_{eng}_{span}.npy"
+        pipeline.posterior_file(
+            str(fa), params, confidence_out=str(conf_p), span=span, engine=eng
+        )
+        outs[(eng, span)] = np.load(conf_p)
+    np.testing.assert_allclose(
+        outs[("onehot", 1500)], outs[("pallas", 1500)], atol=2e-5
+    )
+    np.testing.assert_allclose(
+        outs[("onehot", 1500)], outs[("onehot", 1 << 20)], atol=2e-5
+    )
+
+
+def test_resolve_fb_engine_validation():
+    rng = np.random.default_rng(1)
+    dense = HmmParams.from_probs(
+        rng.dirichlet(np.ones(4)),
+        rng.dirichlet(np.ones(4), size=4),
+        rng.dirichlet(np.ones(4), size=4),
+    )
+    with pytest.raises(ValueError, match="onehot"):
+        resolve_fb_engine("onehot", dense)
+    expected = "onehot" if jax.default_backend() == "tpu" else "xla"
+    assert resolve_fb_engine("auto", presets.durbin_cpg8()) == expected
