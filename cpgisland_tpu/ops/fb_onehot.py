@@ -173,6 +173,370 @@ def _scatter_products_prob(red, gt, e_in, e_out, K):
     return _scatter_products(red, gt, e_in, e_out, K, fill=0.0)
 
 
+# ---------------------------------------------------------------------------
+# Reduced forward / backward kernels (the dense twins: fb_pallas._fwd_kernel,
+# _bwd_kernel, _bwd_conf_kernel — same deferred-Rabiner / time-shifted-input
+# structure, 2-component carries, 8 B/symbol streams instead of 32).
+
+
+def _oh_fwd_kernel(pair_ref, lens_ref, a0raw_ref, tab_ref, alphas_ref,
+                   carry_ref, *, nreal, Tt):
+    j = pl.program_id(1)
+    lens = lens_ref[0, :]
+    v0 = jnp.where(j == 0, a0raw_ref[0:1, :], carry_ref[0:1, :])
+    v1 = jnp.where(j == 0, a0raw_ref[1:2, :], carry_ref[1:2, :])
+
+    def body(tile_i, carry):
+        v0, v1 = carry
+        base = tile_i * ROW_TILE
+        tile = pair_ref[pl.ds(base, ROW_TILE), :]
+        t00, t01, t10, t11 = _select4_prob(tile, tab_ref, nreal)
+        for r in range(ROW_TILE):
+            t = j * Tt + base + r
+            v_t = (t < lens)[None, :]
+            # Deferred Rabiner: stored v_t = raw_t / sum(v_{t-1}); the sum
+            # and reciprocal hang off the previous step, not the chain.
+            inv = 1.0 / (v0 + v1)
+            raw0 = v0 * t00[r : r + 1, :] + v1 * t10[r : r + 1, :]
+            raw1 = v0 * t01[r : r + 1, :] + v1 * t11[r : r + 1, :]
+            n0 = jnp.where(v_t, raw0 * inv, v0)
+            n1 = jnp.where(v_t, raw1 * inv, v1)
+            n0 = jnp.where(t == 0, a0raw_ref[0:1, :], n0)
+            n1 = jnp.where(t == 0, a0raw_ref[1:2, :], n1)
+            alphas_ref[base + r, :, :] = jnp.concatenate([n0, n1], axis=0)
+            v0, v1 = n0, n1
+        return v0, v1
+
+    v0, v1 = jax.lax.fori_loop(0, Tt // ROW_TILE, body, (v0, v1))
+    carry_ref[0:1, :] = v0
+    carry_ref[1:2, :] = v1
+
+
+def _oh_bwd_kernel(pairnext_ref, lens_ref, tab_ref, csnext_ref, beta0_ref,
+                   betas_ref, beta_scr, *, nreal, Tt, T):
+    j = pl.program_id(1)
+    n_t = pl.num_programs(1)
+    lens = lens_ref[0, :]
+    t0 = (n_t - 1 - j) * Tt
+
+    @pl.when(j == 0)
+    def _init():
+        beta_scr[:, :] = beta0_ref[:, :]
+
+    def body(tile_rev, carry):
+        bn0, bn1 = carry
+        base = (Tt // ROW_TILE - 1 - tile_rev) * ROW_TILE
+        tile = pairnext_ref[pl.ds(base, ROW_TILE), :]
+        cn = csnext_ref[pl.ds(base, ROW_TILE), :]
+        t00, t01, t10, t11 = _select4_prob(tile, tab_ref, nreal)
+        # Off-chain per-tile precompute: the next step's matrix rows scaled
+        # by 1/c_{t+1} (the time-shifted inputs, like the dense twin).
+        inv_cn = 1.0 / cn
+        s00 = t00 * inv_cn
+        s01 = t01 * inv_cn
+        s10 = t10 * inv_cn
+        s11 = t11 * inv_cn
+        for rr in range(ROW_TILE):
+            r = ROW_TILE - 1 - rr
+            t = t0 + base + r
+            active = t <= T - 2
+            v_next = (t + 1) < lens
+            b0 = s00[r : r + 1, :] * bn0 + s01[r : r + 1, :] * bn1
+            b1 = s10[r : r + 1, :] * bn0 + s11[r : r + 1, :] * bn1
+            keep = (active & v_next)[None, :]
+            b0 = jnp.where(keep, b0, bn0)
+            b1 = jnp.where(keep, b1, bn1)
+            betas_ref[base + r, :, :] = jnp.concatenate([b0, b1], axis=0)
+            bn0, bn1 = b0, b1
+        return bn0, bn1
+
+    bn0, bn1 = jax.lax.fori_loop(
+        0, Tt // ROW_TILE, body, (beta_scr[0:1, :], beta_scr[1:2, :])
+    )
+    beta_scr[0:1, :] = bn0
+    beta_scr[1:2, :] = bn1
+
+
+def _sel_mask2(tile, mtab_ref, nP):
+    """Per-position island-mask components from the lane-broadcast
+    [nP*2, LANE] mask table (rows 2p / 2p+1 = mask of the exit group's
+    low/high state for pair p)."""
+    m0 = jnp.zeros(tile.shape, jnp.float32)
+    m1 = jnp.zeros(tile.shape, jnp.float32)
+    for p in range(nP):
+        cmp = tile == p
+        m0 = jnp.where(cmp, mtab_ref[2 * p : 2 * p + 1, :], m0)
+        m1 = jnp.where(cmp, mtab_ref[2 * p + 1 : 2 * p + 2, :], m1)
+    return m0, m1
+
+
+def _oh_bwd_conf_kernel(pairnext_ref, pair_ref, lens_ref, tab_ref, csnext_ref,
+                        beta0_ref, alphas_ref, mtab_ref, conf_ref, beta_scr,
+                        *, nreal, nP, Tt, T):
+    """The reduced backward walk EMITTING island confidence (dense twin:
+    fb_pallas._bwd_conf_kernel) — betas never reach HBM; the island mask is
+    selected PER POSITION from the pair stream (the islandness of the 2
+    live states depends on the position's symbol group)."""
+    j = pl.program_id(1)
+    n_t = pl.num_programs(1)
+    lens = lens_ref[0, :]
+    t0 = (n_t - 1 - j) * Tt
+
+    @pl.when(j == 0)
+    def _init():
+        beta_scr[:, :] = beta0_ref[:, :]
+
+    def body(tile_rev, carry):
+        bn0, bn1 = carry
+        base = (Tt // ROW_TILE - 1 - tile_rev) * ROW_TILE
+        tile_n = pairnext_ref[pl.ds(base, ROW_TILE), :]
+        tile_c = pair_ref[pl.ds(base, ROW_TILE), :]
+        cn = csnext_ref[pl.ds(base, ROW_TILE), :]
+        t00, t01, t10, t11 = _select4_prob(tile_n, tab_ref, nreal)
+        m0, m1 = _sel_mask2(tile_c, mtab_ref, nP)
+        inv_cn = 1.0 / cn
+        s00 = t00 * inv_cn
+        s01 = t01 * inv_cn
+        s10 = t10 * inv_cn
+        s11 = t11 * inv_cn
+        conf_rows = [None] * ROW_TILE
+        for rr in range(ROW_TILE):
+            r = ROW_TILE - 1 - rr
+            t = t0 + base + r
+            active = t <= T - 2
+            v_next = (t + 1) < lens
+            b0 = s00[r : r + 1, :] * bn0 + s01[r : r + 1, :] * bn1
+            b1 = s10[r : r + 1, :] * bn0 + s11[r : r + 1, :] * bn1
+            keep = (active & v_next)[None, :]
+            b0 = jnp.where(keep, b0, bn0)
+            b1 = jnp.where(keep, b1, bn1)
+            a_row = alphas_ref[base + r, :, :]  # [2, lt]
+            g0 = a_row[0:1, :] * b0
+            g1 = a_row[1:2, :] * b1
+            tot = g0 + g1
+            isl = m0[r : r + 1, :] * g0 + m1[r : r + 1, :] * g1
+            valid = (t < lens)[None, :]
+            conf_rows[r] = jnp.where(
+                valid, isl * (1.0 / jnp.maximum(tot, 1e-30)), 0.0
+            )
+            bn0, bn1 = b0, b1
+        conf_ref[pl.ds(base, ROW_TILE), :] = jnp.concatenate(conf_rows, axis=0)
+        return bn0, bn1
+
+    bn0, bn1 = jax.lax.fori_loop(
+        0, Tt // ROW_TILE, body, (beta_scr[0:1, :], beta_scr[1:2, :])
+    )
+    beta_scr[0:1, :] = bn0
+    beta_scr[1:2, :] = bn1
+
+
+# --- XLA twins (non-TPU backends; same arithmetic, scan lowering) ----------
+
+
+def _tab_sel_nl(tab_ext, pk):
+    """Exact per-lane row select [NL] -> [NL, m] (one-hot contraction)."""
+    oh = jax.nn.one_hot(pk, tab_ext.shape[0], dtype=tab_ext.dtype)
+    return jnp.matmul(oh, tab_ext, precision=jax.lax.Precision.HIGHEST)
+
+
+def _xla_fwd_onehot(tab_ext, pair2, lens2, a0_red):
+    """Reduced forward scan: returns alphas2 [Tp, 2, NL] (deferred-scale)."""
+    Tp = pair2.shape[0]
+    lens = lens2[0]
+
+    def step(carry, x):
+        v0, v1 = carry
+        pk, t = x
+        T4 = _tab_sel_nl(tab_ext, pk)
+        inv = 1.0 / (v0 + v1)
+        raw0 = v0 * T4[:, 0] + v1 * T4[:, 2]
+        raw1 = v0 * T4[:, 1] + v1 * T4[:, 3]
+        v_t = t < lens
+        n0 = jnp.where(v_t, raw0 * inv, v0)
+        n1 = jnp.where(v_t, raw1 * inv, v1)
+        n0 = jnp.where(t == 0, a0_red[:, 0], n0)
+        n1 = jnp.where(t == 0, a0_red[:, 1], n1)
+        return (n0, n1), jnp.stack([n0, n1], axis=0)
+
+    _, alphas2 = jax.lax.scan(
+        step, (a0_red[:, 0], a0_red[:, 1]),
+        (pair2, jnp.arange(Tp, dtype=jnp.int32)),
+    )
+    return alphas2  # [Tp, 2, NL]
+
+
+def _xla_bwd_onehot(tab_ext, pair_next, lens2, cs_next, beta0_red, T):
+    Tp = pair_next.shape[0]
+    lens = lens2[0]
+
+    def step(carry, x):
+        bn0, bn1 = carry
+        pk, cn, t = x
+        T4 = _tab_sel_nl(tab_ext, pk)
+        inv_cn = 1.0 / cn
+        b0 = (T4[:, 0] * bn0 + T4[:, 1] * bn1) * inv_cn
+        b1 = (T4[:, 2] * bn0 + T4[:, 3] * bn1) * inv_cn
+        keep = (t <= T - 2) & ((t + 1) < lens)
+        b0 = jnp.where(keep, b0, bn0)
+        b1 = jnp.where(keep, b1, bn1)
+        return (b0, b1), jnp.stack([b0, b1], axis=0)
+
+    _, betas2 = jax.lax.scan(
+        step, (beta0_red[:, 0], beta0_red[:, 1]),
+        (pair_next, cs_next, jnp.arange(Tp, dtype=jnp.int32)),
+        reverse=True,
+    )
+    return betas2
+
+
+# --- runner + scatter glue -------------------------------------------------
+
+
+def decode_esym(pair2: jnp.ndarray, S: int) -> jnp.ndarray:
+    """Per-position emitted symbol (PADs forward-filled) from pair indices:
+    p < S*S encodes (prev, cur) with cur = p mod S; p >= S*S is a PAD
+    carrying symbol p - S*S."""
+    cur = pair2 - (pair2 // S) * S
+    return jnp.where(pair2 < S * S, cur, pair2 - S * S).astype(jnp.int32)
+
+
+def scatter_streams(x2: jnp.ndarray, gt: jnp.ndarray, esym2: jnp.ndarray,
+                    K: int) -> jnp.ndarray:
+    """[Tp, 2, NL] reduced streams -> [Tp, K, NL] dense (zero fill) — exact
+    for every consumer (out-of-group entries are exact zeros in the dense
+    alphas, and the dense betas' nonzero out-of-group entries are only ever
+    multiplied by those zeros or by one-hot emission zeros)."""
+    glow = jnp.take(gt[:, 0], esym2)  # [Tp, NL]
+    ghigh = jnp.take(gt[:, 1], esym2)
+    iK = jnp.arange(K, dtype=jnp.int32)
+    full = jnp.where(
+        iK[None, :, None] == glow[:, None, :], x2[:, 0:1, :], 0.0
+    )
+    # The two group members are distinct states, so add-compose is exact.
+    return full + jnp.where(
+        iK[None, :, None] == ghigh[:, None, :], x2[:, 1:2, :], 0.0
+    )
+
+
+def run_fb_kernels_onehot(
+    params: HmmParams,
+    sel_t: jnp.ndarray,
+    prev_dev,
+    lens2: jnp.ndarray,
+    a0_raw: jnp.ndarray,
+    beta0: jnp.ndarray,
+    Tt: int,
+    T: int,
+    conf_mask=None,
+):
+    """Reduced forward + backward pair over the [Tp, NL] lane layout.
+
+    Mirrors fb_pallas._run_fb_kernels: a0_raw/beta0 arrive FULL-K [K, NL]
+    and are projected onto each lane's entry/exit group here.  Returns
+    (alphas2 [Tp, 2, NL], cs [Tp, NL], betas2 [Tp, 2, NL] — or conf2
+    [Tp, NL] with ``conf_mask`` — and esym2 [Tp, NL] for scatter-back).
+    """
+    K, S = params.n_states, params.n_symbols
+    gt = _groups(params)
+    tab = prob_pair_table(params, gt)
+    pair2, _, _ = _pair_stream(params, sel_t, jnp.asarray(prev_dev, jnp.int32))
+    esym2 = decode_esym(pair2, S)
+    Tp, NL = pair2.shape
+
+    a0_red = jnp.take_along_axis(a0_raw.T, gt[esym2[0]], axis=1)  # [NL, 2]
+    beta0_red = jnp.take_along_axis(beta0.T, gt[esym2[-1]], axis=1)
+    pair_next = jnp.concatenate(
+        [pair2[1:], jnp.full((1, NL), S * S, jnp.int32)], axis=0
+    )
+    ident = jnp.asarray([PROB_IDENT], jnp.float32)
+    tab_ext = jnp.concatenate([tab, ident], axis=0)
+    pair_c = jnp.minimum(pair2, S * S)  # clamp PAD pairs onto the identity row
+    pairn_c = jnp.minimum(pair_next, S * S)
+
+    if _interpret():
+        alphas2 = _xla_fwd_onehot(tab_ext, pair_c, lens2, a0_red)
+        cs = jnp.sum(alphas2, axis=1)
+        cs_next = jnp.concatenate([cs[1:], jnp.ones((1, NL), cs.dtype)], axis=0)
+        betas2 = _xla_bwd_onehot(
+            tab_ext, pairn_c, lens2, cs_next, beta0_red, T
+        )
+        if conf_mask is None:
+            return alphas2, cs, betas2, esym2
+        m2 = conf_mask[gt[esym2]]  # [Tp, NL, 2]
+        graw0 = alphas2[:, 0] * betas2[:, 0]
+        graw1 = alphas2[:, 1] * betas2[:, 1]
+        tot = jnp.maximum(graw0 + graw1, 1e-30)
+        vmask = jnp.arange(Tp)[:, None] < lens2
+        conf2 = jnp.where(
+            vmask, (m2[..., 0] * graw0 + m2[..., 1] * graw1) / tot, 0.0
+        )
+        return alphas2, cs, conf2, esym2
+
+    from cpgisland_tpu.ops.fb_pallas import _fb_lane_tile
+
+    lt = _fb_lane_tile(NL)
+    n_t = Tp // Tt
+    grid = (NL // lt, n_t)
+    lane_spec = _vspec((1, lt), lambda i, j: (0, i))
+    glane_spec = _vspec((GROUP, lt), lambda i, j: (0, i))
+    step_spec = _vspec((Tt, lt), lambda i, j: (j, i))
+    tabb = _bcast_tab(tab, lt)
+    (alphas2,) = pl.pallas_call(
+        functools.partial(_oh_fwd_kernel, nreal=S * S, Tt=Tt),
+        grid=grid,
+        in_specs=[step_spec, lane_spec, glane_spec, _vspec(tabb.shape, lambda i, j: (0, 0))],
+        out_specs=[_vspec((Tt, GROUP, lt), lambda i, j: (j, 0, i))],
+        out_shape=[jax.ShapeDtypeStruct((Tp, GROUP, NL), jnp.float32)],
+        scratch_shapes=[pltpu.VMEM((GROUP, lt), jnp.float32)],
+    )(pair2, lens2, a0_red.T, tabb)
+    cs = jnp.sum(alphas2, axis=1)
+    cs_next = jnp.concatenate([cs[1:], jnp.ones((1, NL), cs.dtype)], axis=0)
+    rev_step_spec = _vspec((Tt, lt), lambda i, j: (n_t - 1 - j, i))
+    if conf_mask is not None:
+        # Per-pair island-mask components (traced values — changing the
+        # island set never recompiles).
+        from cpgisland_tpu.ops.viterbi_onehot import pair_exit_syms
+
+        mtab = conf_mask[gt[pair_exit_syms(S)]].astype(jnp.float32)  # [nP, 2]
+        mtabb = _bcast_tab(mtab, lt)
+        nP = S * S + S
+        (conf2,) = pl.pallas_call(
+            functools.partial(
+                _oh_bwd_conf_kernel, nreal=S * S, nP=nP, Tt=Tt, T=T
+            ),
+            grid=grid,
+            in_specs=[
+                rev_step_spec,
+                rev_step_spec,
+                lane_spec,
+                _vspec(tabb.shape, lambda i, j: (0, 0)),
+                rev_step_spec,
+                glane_spec,
+                _vspec((Tt, GROUP, lt), lambda i, j: (n_t - 1 - j, 0, i)),
+                _vspec(mtabb.shape, lambda i, j: (0, 0)),
+            ],
+            out_specs=[rev_step_spec],
+            out_shape=[jax.ShapeDtypeStruct((Tp, NL), jnp.float32)],
+            scratch_shapes=[pltpu.VMEM((GROUP, lt), jnp.float32)],
+        )(pair_next, pair2, lens2, tabb, cs_next, beta0_red.T, alphas2, mtabb)
+        return alphas2, cs, conf2, esym2
+    (betas2,) = pl.pallas_call(
+        functools.partial(_oh_bwd_kernel, nreal=S * S, Tt=Tt, T=T),
+        grid=grid,
+        in_specs=[
+            rev_step_spec,
+            lane_spec,
+            _vspec(tabb.shape, lambda i, j: (0, 0)),
+            rev_step_spec,
+            glane_spec,
+        ],
+        out_specs=[_vspec((Tt, GROUP, lt), lambda i, j: (n_t - 1 - j, 0, i))],
+        out_shape=[jax.ShapeDtypeStruct((Tp, GROUP, NL), jnp.float32)],
+        scratch_shapes=[pltpu.VMEM((GROUP, lt), jnp.float32)],
+    )(pair_next, lens2, tabb, cs_next, beta0_red.T)
+    return alphas2, cs, betas2, esym2
+
+
 def run_products_onehot(
     params: HmmParams, sel_t: jnp.ndarray, prev0, Tt: int
 ) -> jnp.ndarray:

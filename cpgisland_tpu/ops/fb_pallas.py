@@ -66,8 +66,16 @@ DEFAULT_LANE_T = 8192
 # 32768; 131072 regressed), fused posterior 520 -> 712 -> 726.
 _LANE_RATE = {8192: 1.0, 16384: 1.25, 32768: 1.30}
 
+# The reduced one-hot kernels (ops.fb_onehot) keep gaining from longer
+# serial chains well past the dense knee (their per-step work and VMEM
+# footprint are ~4x smaller): fused posterior 507 -> 908 -> 1162 -> 1224
+# Msym/s at 8192/16384/32768/65536 (131072: +4% more but the exact-EM
+# assembly fails to compile there — the table is shared by both consumers,
+# so it caps at the longest lane BOTH support).
+_LANE_RATE_ONEHOT = {8192: 1.0, 16384: 1.79, 32768: 2.29, 65536: 2.41}
 
-def pick_lane_T(n: int) -> int:
+
+def pick_lane_T(n: int, onehot: bool = False) -> int:
     """Lane length for an ``n``-symbol (per-shard) input.
 
     Minimizes estimated pass time = padded work / measured lane rate: the
@@ -75,16 +83,19 @@ def pick_lane_T(n: int) -> int:
     (_lane_layout), so a long lane just past a grid boundary can cost more
     in padding than its faster rate buys — gating on raw size alone made
     inputs just above each boundary ~20% slower than the short-lane
-    default.  Ties prefer the longer lane.
+    default.  Ties prefer the longer lane.  ``onehot`` selects the reduced
+    kernels' rate table (different knee — see _LANE_RATE_ONEHOT).
     """
+    rates = _LANE_RATE_ONEHOT if onehot else _LANE_RATE
+
     def est_cost(lt: int) -> float:
         n_lanes = -(-max(n, 1) // lt)
         grid = -(-n_lanes // LANE_TILE) * LANE_TILE
-        return grid * lt / _LANE_RATE[lt]
+        return grid * lt / rates[lt]
 
     # Candidates ARE the rate table (one source of truth for the next
     # re-sweep); sorted longest-first so cost ties prefer the longer lane.
-    return min(sorted(_LANE_RATE, reverse=True), key=est_cost)
+    return min(sorted(rates, reverse=True), key=est_cost)
 
 
 def supports(params: HmmParams) -> bool:
@@ -916,6 +927,26 @@ def _lane_streams(
 
     steps2 = obs_l.T  # [lane_T, NL] — within-lens symbols (kernels mask by lens)
     lens2 = lane_lens[None, :]
+    if onehot:
+        # Reduced 2-component forward/backward streams (ops.fb_onehot),
+        # scattered back to the dense [Tp, K, NL] contract for the
+        # assembly consumers — exact (out-of-group entries are exact
+        # zeros wherever they are ever multiplied in); the conf fast path
+        # consumes the reduced streams directly and the scatters are
+        # dead-code-eliminated.
+        from cpgisland_tpu.ops import fb_onehot
+
+        al2, cs, third2, esym2 = fb_onehot.run_fb_kernels_onehot(
+            params, sel_l.T, prev_dev, lens2, v0.T, beta_exits.T, Tt,
+            lane_T, conf_mask=conf_mask,
+        )
+        gt = fb_onehot._groups(params)
+        alphas = fb_onehot.scatter_streams(al2, gt, esym2, K)
+        third = (
+            third2 if conf_mask is not None
+            else fb_onehot.scatter_streams(third2, gt, esym2, K)
+        )
+        return alphas, cs, third, steps2, lens2, enters, is_first, Tt
     alphas, cs, third = _run_fb_kernels(
         A, B, steps2, lens2, v0.T, beta_exits.T, K, S, Tt, lane_T,
         conf_mask=conf_mask,

@@ -123,6 +123,18 @@ def _groups(params: HmmParams) -> jnp.ndarray:
     return jnp.stack([low, high], axis=1).astype(jnp.int32)
 
 
+def pair_exit_syms(S: int) -> jnp.ndarray:
+    """[S*S + S] exit symbol per pair index — THE pair-index encoding
+    (p = s_prev * S + s_cur for real steps; S*S + carried symbol for PADs).
+    Shared by the max-plus backtrace id table, the probability-space
+    conf-mask table (ops.fb_onehot), and any future pair-indexed table, so
+    the encoding cannot drift between them."""
+    return jnp.concatenate(
+        [jnp.tile(jnp.arange(S, dtype=jnp.int32), (S,)),
+         jnp.arange(S, dtype=jnp.int32)]
+    )
+
+
 def _pair_table(params: HmmParams, gt: jnp.ndarray):
     """Per-pair reduced step matrices, flattened for the in-kernel select tree.
 
@@ -147,10 +159,7 @@ def _pair_table(params: HmmParams, gt: jnp.ndarray):
         jnp.asarray([0.0, LOG_ZERO, LOG_ZERO, 0.0], jnp.float32), (S, 4)
     )
     tab = jnp.concatenate([real, ident], axis=0)
-    exit_sym = jnp.concatenate(
-        [jnp.tile(jnp.arange(S, dtype=jnp.int32), (S,)), jnp.arange(S, dtype=jnp.int32)]
-    )
-    idtab = gt[exit_sym]  # [S*S + S, GROUP]
+    idtab = gt[pair_exit_syms(S)]  # [S*S + S, GROUP]
     return tab, idtab
 
 
@@ -264,10 +273,11 @@ def _select4(tile, tab_ref, nreal, ident=(0.0, LOG_ZERO, LOG_ZERO, 0.0)):
     return t00, t01, t10, t11
 
 
-def _bcast_tab(tab: jnp.ndarray) -> jnp.ndarray:
-    """[n, m] table -> [n*m, LANE_TILE] lane-broadcast kernel operand."""
+def _bcast_tab(tab: jnp.ndarray, width: int = LANE_TILE) -> jnp.ndarray:
+    """[n, m] table -> [n*m, width] lane-broadcast kernel operand (width =
+    the consuming kernel's lane-tile size)."""
     flat = tab.reshape(-1)
-    return jnp.broadcast_to(flat[:, None], (flat.shape[0], LANE_TILE))
+    return jnp.broadcast_to(flat[:, None], (flat.shape[0], width))
 
 
 # ---------------------------------------------------------------------------

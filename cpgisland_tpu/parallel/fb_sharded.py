@@ -455,7 +455,9 @@ def _shard_stats2d_body(
             lt = (
                 lane_T
                 if lane_T is not None
-                else fb_pallas.pick_lane_T(obs_tile.shape[1])
+                else fb_pallas.pick_lane_T(
+                    obs_tile.shape[1], onehot=engine == "onehot"
+                )
             )
             tt = t_tile if t_tile is not None else fb_pallas.DEFAULT_T_TILE
 

@@ -259,7 +259,9 @@ def posterior_sharded(
     lt = (
         lane_T
         if lane_T is not None
-        else fb_pallas.pick_lane_T(arr.shape[0] // mesh.shape[mesh.axis_names[0]])
+        else fb_pallas.pick_lane_T(
+            arr.shape[0] // mesh.shape[mesh.axis_names[0]], onehot=eng == "onehot"
+        )
     )
     mask = jnp.asarray(island_mask(params, island_states))
     enter = (
@@ -309,7 +311,7 @@ def transfer_total_sharded(
             return np.asarray(
                 fb_pallas.seq_transfer_total_pallas(
                     params, placed[0], int(obs.shape[0]), first=first,
-                    lane_T=fb_pallas.pick_lane_T(placed[0].shape[0]),
+                    lane_T=fb_pallas.pick_lane_T(placed[0].shape[0], onehot=oh),
                     onehot=oh, prev_sym=ps,
                 )
             )
@@ -322,7 +324,7 @@ def transfer_total_sharded(
         return np.asarray(
             fb_pallas.seq_transfer_total_pallas(
                 params, jnp.asarray(obs), n, first=first,
-                lane_T=fb_pallas.pick_lane_T(obs.shape[0]),
+                lane_T=fb_pallas.pick_lane_T(obs.shape[0], onehot=oh),
                 onehot=oh, prev_sym=ps,
             )
         )

@@ -406,12 +406,12 @@ class SeqBackend(EStepBackend):
         # full 128-lane padded pass dwarfs tiny inputs) — an explicit
         # engine always wins.
         if _use_fused_seq(self.engine, params, obs_flat.shape[0] // n_dev):
+            oh = _seq_onehot(self.engine, params)
             lane_T = (
                 self.lane_T
                 if self.lane_T is not None
-                else fb_pallas.pick_lane_T(obs_flat.shape[0] // n_dev)
+                else fb_pallas.pick_lane_T(obs_flat.shape[0] // n_dev, onehot=oh)
             )
-            oh = _seq_onehot(self.engine, params)
             if n_dev == 1:
                 return fb_pallas.seq_stats_pallas(
                     params, obs_flat, jnp.sum(lengths),

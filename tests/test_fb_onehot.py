@@ -204,3 +204,33 @@ def test_resolve_fb_engine_validation():
         resolve_fb_engine("onehot", dense)
     expected = "onehot" if jax.default_backend() == "tpu" else "xla"
     assert resolve_fb_engine("auto", presets.durbin_cpg8()) == expected
+
+
+def test_pick_lane_T_onehot_cost_model():
+    """Pin the reduced-kernel lane cost model at grid boundaries, like the
+    dense twin's test (test_fb_pallas) — a rate re-sweep must not silently
+    start over-padding small inputs or exceed the 65536 exact-EM compile
+    ceiling the table caps at."""
+    from cpgisland_tpu.ops.fb_pallas import (
+        LANE_TILE,
+        _LANE_RATE_ONEHOT,
+        pick_lane_T,
+    )
+
+    assert max(_LANE_RATE_ONEHOT) <= 65536  # exact-EM compile ceiling
+    assert pick_lane_T(1, onehot=True) == 8192
+    # exactly full grids pick the long lanes
+    assert pick_lane_T(65536 * LANE_TILE, onehot=True) == 65536
+    assert pick_lane_T(128 << 20, onehot=True) == 65536
+    # one symbol past a full grid must fall back to a less padded choice
+    assert pick_lane_T(65536 * LANE_TILE + 1, onehot=True) != 65536
+    # the pick is always the argmin of the explicit cost model
+    for n in (1, 1000, 1 << 20, 2 << 20, (2 << 20) + 1, 8 << 20,
+              (8 << 20) + 1, 48 << 20, 64 << 20, 128 << 20):
+        def cost(lt):
+            n_lanes = (n + lt - 1) // lt
+            grid = (n_lanes + LANE_TILE - 1) // LANE_TILE * LANE_TILE
+            return grid * lt / _LANE_RATE_ONEHOT[lt]
+        picked = pick_lane_T(n, onehot=True)
+        best = min(_LANE_RATE_ONEHOT, key=cost)
+        assert cost(picked) <= cost(best) * (1 + 1e-9), (n, picked, best)
