@@ -76,9 +76,10 @@ def _common_flags(p: argparse.ArgumentParser) -> None:
     p.add_argument("--numerics", choices=("log", "rescaled"), default="rescaled", dest="mode")
     p.add_argument(
         "--engine",
-        choices=("auto", "xla", "pallas"),
+        choices=("auto", "xla", "pallas", "onehot"),
         default="auto",
-        help="Viterbi block-pass lowering (auto: Pallas kernels on TPU)",
+        help="kernel lowering (auto: on TPU, the reduced one-hot kernels "
+        "for eligible models, else the dense Pallas kernels)",
     )
     p.add_argument(
         "--clean",
@@ -184,9 +185,10 @@ def build_parser() -> argparse.ArgumentParser:
     # ignored here.
     po.add_argument(
         "--engine",
-        choices=("auto", "xla", "pallas"),
+        choices=("auto", "xla", "pallas", "onehot"),
         default="auto",
-        help="forward-backward lowering (auto: fused Pallas kernels on TPU)",
+        help="forward-backward lowering (auto: on TPU, the reduced one-hot "
+        "kernels for eligible models, else the dense fused kernels)",
     )
     po.add_argument(
         "--preset", choices=("durbin8", "two_state"), default="durbin8",

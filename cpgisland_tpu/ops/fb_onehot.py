@@ -257,22 +257,28 @@ def _oh_bwd_kernel(pairnext_ref, lens_ref, tab_ref, csnext_ref, beta0_ref,
     beta_scr[1:2, :] = bn1
 
 
-def _sel_mask2(tile, mtab_ref, nP):
-    """Per-position island-mask components from the lane-broadcast
-    [nP*2, LANE] mask table (rows 2p / 2p+1 = mask of the exit group's
-    low/high state for pair p)."""
+def _sel_mask2(tile, mtab_ref, n, by_sym, S):
+    """Per-position island-mask components from the lane-broadcast mask
+    table (rows 2k / 2k+1 = mask of the exit group's low/high state).
+
+    The exit symbol of ANY pair index is p mod S (real pairs p = prev*S +
+    cur; PAD pairs p = S*S + sym, and S | S*S), so when S is a power of two
+    the table keys on ``tile & (S-1)`` — S rows and S compares instead of
+    S*S + S (this kernel family is VPU-issue-bound).  Other S fall back to
+    the full per-pair table."""
+    key = tile & (S - 1) if by_sym else tile
     m0 = jnp.zeros(tile.shape, jnp.float32)
     m1 = jnp.zeros(tile.shape, jnp.float32)
-    for p in range(nP):
-        cmp = tile == p
-        m0 = jnp.where(cmp, mtab_ref[2 * p : 2 * p + 1, :], m0)
-        m1 = jnp.where(cmp, mtab_ref[2 * p + 1 : 2 * p + 2, :], m1)
+    for k in range(n):
+        cmp = key == k
+        m0 = jnp.where(cmp, mtab_ref[2 * k : 2 * k + 1, :], m0)
+        m1 = jnp.where(cmp, mtab_ref[2 * k + 1 : 2 * k + 2, :], m1)
     return m0, m1
 
 
 def _oh_bwd_conf_kernel(pairnext_ref, pair_ref, lens_ref, tab_ref, csnext_ref,
                         beta0_ref, alphas_ref, mtab_ref, conf_ref, beta_scr,
-                        *, nreal, nP, Tt, T):
+                        *, nreal, nM, mask_by_sym, S, Tt, T):
     """The reduced backward walk EMITTING island confidence (dense twin:
     fb_pallas._bwd_conf_kernel) — betas never reach HBM; the island mask is
     selected PER POSITION from the pair stream (the islandness of the 2
@@ -293,7 +299,7 @@ def _oh_bwd_conf_kernel(pairnext_ref, pair_ref, lens_ref, tab_ref, csnext_ref,
         tile_c = pair_ref[pl.ds(base, ROW_TILE), :]
         cn = csnext_ref[pl.ds(base, ROW_TILE), :]
         t00, t01, t10, t11 = _select4_prob(tile_n, tab_ref, nreal)
-        m0, m1 = _sel_mask2(tile_c, mtab_ref, nP)
+        m0, m1 = _sel_mask2(tile_c, mtab_ref, nM, mask_by_sym, S)
         inv_cn = 1.0 / cn
         s00 = t00 * inv_cn
         s01 = t01 * inv_cn
@@ -497,12 +503,15 @@ def run_fb_kernels_onehot(
         # island set never recompiles).
         from cpgisland_tpu.ops.viterbi_onehot import pair_exit_syms
 
-        mtab = conf_mask[gt[pair_exit_syms(S)]].astype(jnp.float32)  # [nP, 2]
+        mask_by_sym = S & (S - 1) == 0  # exit symbol = pair & (S-1)
+        mtab = conf_mask[
+            gt if mask_by_sym else gt[pair_exit_syms(S)]
+        ].astype(jnp.float32)
         mtabb = _bcast_tab(mtab, lt)
-        nP = S * S + S
         (conf2,) = pl.pallas_call(
             functools.partial(
-                _oh_bwd_conf_kernel, nreal=S * S, nP=nP, Tt=Tt, T=T
+                _oh_bwd_conf_kernel, nreal=S * S, nM=mtab.shape[0],
+                mask_by_sym=mask_by_sym, S=S, Tt=Tt, T=T
             ),
             grid=grid,
             in_specs=[

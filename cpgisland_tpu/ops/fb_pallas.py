@@ -615,7 +615,8 @@ def _batch_lane_setup(params: HmmParams, chunks, lengths, t_tile: int):
     """Chunked lane layout shared by the batched E-step and the batched
     posterior: one INDEPENDENT record/chunk per lane, pi init, free end.
 
-    Returns (A, B, pi, steps2 [Tp, NL], lens2 [1, NL], a0_raw [K, NL],
+    Returns (A, B, pi, steps2 [Tp, NL], sel2 [Tp, NL] (PAD-marked steps for
+    the reduced one-hot kernels), lens2 [1, NL], a0_raw [K, NL],
     beta0 [K, NL], valid0 [NL], Tt).
     """
     K, S = params.n_states, params.n_symbols
@@ -641,13 +642,20 @@ def _batch_lane_setup(params: HmmParams, chunks, lengths, t_tile: int):
     steps2 = _pad_axis(_pad_axis(obs_c.T, Tp, 0, 0), NL, 1, 0)  # [Tp, NL]
     lens2 = _pad_axis(lengths[None, :], NL, 1, 0)  # [1, NL]
     valid0 = lens2[0] > 0  # [NL]
+    # PAD-marked steps for the reduced one-hot kernels' pair stream (their
+    # beyond-length positions must be identity steps; the dense kernels
+    # mask by lens instead).  Lanes are INDEPENDENT records here, but the
+    # pair stream's cross-lane seeding is still harmless: each lane's
+    # position-0 pair is never consumed (the t == 0 init override) and its
+    # real positions' pairs are within-lane.
+    sel2 = jnp.where(jnp.arange(Tp)[:, None] < lens2, steps2, S)
 
     # v_0 in JAX (one position, UNnormalized so sum(v_0) = c_0; the kernel
     # handles t >= 1 with deferred normalization — see _fwd_kernel).
     B0 = _emit_sel(B, steps2[0, :], K, S)  # [K, NL]
     a0_raw = jnp.where(valid0[None, :], pi[:, None] * B0, jnp.ones((K, NL)) / K)
     beta0 = jnp.ones((K, NL), jnp.float32)  # independent chunks end free
-    return A, B, pi, steps2, lens2, a0_raw, beta0, valid0, Tt
+    return A, B, pi, steps2, sel2, lens2, a0_raw, beta0, valid0, Tt
 
 
 def _conf_path_from_streams(alphas, betas, lens2, island_mask):
@@ -663,23 +671,38 @@ def _conf_path_from_streams(alphas, betas, lens2, island_mask):
     return conf2, path2
 
 
-@functools.partial(jax.jit, static_argnames=("t_tile",))
+@functools.partial(jax.jit, static_argnames=("t_tile", "onehot"))
 def batch_stats_pallas(
     params: HmmParams,
     chunks: jnp.ndarray,
     lengths: jnp.ndarray,
     t_tile: int = DEFAULT_T_TILE,
+    onehot: bool = False,
 ) -> SuffStats:
     """Pallas twin of ops.forward_backward.batch_stats(mode="rescaled").
 
     chunks: [N, T] (padded), lengths: [N].  Returns batch-summed SuffStats.
+    ``onehot`` routes the reduced 2-component kernels (one-hot-emission
+    models); the streams scatter back to dense for the stats pass — exact.
     """
     K, S = params.n_states, params.n_symbols
     T = chunks.shape[1]
-    A, B, pi, steps2, lens2, a0_raw, beta0, valid0, Tt = _batch_lane_setup(
+    A, B, pi, steps2, sel2, lens2, a0_raw, beta0, valid0, Tt = _batch_lane_setup(
         params, chunks, lengths, t_tile
     )
-    alphas, cs, betas = _run_fb_kernels(A, B, steps2, lens2, a0_raw, beta0, K, S, Tt, T)
+    if onehot:
+        from cpgisland_tpu.ops import fb_onehot
+
+        al2, cs, b2, esym2 = fb_onehot.run_fb_kernels_onehot(
+            params, sel2, jnp.int32(0), lens2, a0_raw, beta0, Tt, T
+        )
+        gt = fb_onehot._groups(params)
+        alphas = fb_onehot.scatter_streams(al2, gt, esym2, K)
+        betas = fb_onehot.scatter_streams(b2, gt, esym2, K)
+    else:
+        alphas, cs, betas = _run_fb_kernels(
+            A, B, steps2, lens2, a0_raw, beta0, K, S, Tt, T
+        )
 
     # Count-tensor assembly: ONE fused streaming pass over alphas/betas
     # (_stats_kernel) — the XLA-einsum formulation of the same math read the
@@ -1103,7 +1126,7 @@ def seq_posterior_pallas(
     )
 
 
-@functools.partial(jax.jit, static_argnames=("t_tile", "want_path"))
+@functools.partial(jax.jit, static_argnames=("t_tile", "want_path", "onehot"))
 def batch_posterior_pallas(
     params: HmmParams,
     chunks: jnp.ndarray,
@@ -1111,6 +1134,7 @@ def batch_posterior_pallas(
     island_mask: jnp.ndarray,
     t_tile: int = DEFAULT_T_TILE,
     want_path: bool = False,
+    onehot: bool = False,
 ):
     """Posterior island confidence for a [N, T] batch of INDEPENDENT records.
 
@@ -1123,18 +1147,34 @@ def batch_posterior_pallas(
     """
     K, S = params.n_states, params.n_symbols
     N, T = chunks.shape
-    A, B, _, steps2, lens2, a0_raw, beta0, _, Tt = _batch_lane_setup(
+    A, B, _, steps2, sel2, lens2, a0_raw, beta0, _, Tt = _batch_lane_setup(
         params, chunks, lengths, t_tile
     )
-    if not want_path:
+    if onehot:
+        from cpgisland_tpu.ops import fb_onehot
+
+        if not want_path:
+            _, _, conf2, _ = fb_onehot.run_fb_kernels_onehot(
+                params, sel2, jnp.int32(0), lens2, a0_raw, beta0, Tt, T,
+                conf_mask=island_mask,
+            )
+            return conf2.T[:N, :T], jnp.zeros((N, T), jnp.int32)
+        al2, _, b2, esym2 = fb_onehot.run_fb_kernels_onehot(
+            params, sel2, jnp.int32(0), lens2, a0_raw, beta0, Tt, T
+        )
+        gt = fb_onehot._groups(params)
+        alphas = fb_onehot.scatter_streams(al2, gt, esym2, K)
+        betas = fb_onehot.scatter_streams(b2, gt, esym2, K)
+    elif not want_path:
         _, _, conf2 = _run_fb_kernels(
             A, B, steps2, lens2, a0_raw, beta0, K, S, Tt, T,
             conf_mask=island_mask,
         )
         return conf2.T[:N, :T], jnp.zeros((N, T), jnp.int32)
-    alphas, _, betas = _run_fb_kernels(
-        A, B, steps2, lens2, a0_raw, beta0, K, S, Tt, T
-    )
+    else:
+        alphas, _, betas = _run_fb_kernels(
+            A, B, steps2, lens2, a0_raw, beta0, K, S, Tt, T
+        )
     conf2, path2 = _conf_path_from_streams(alphas, betas, lens2, island_mask)
     return conf2.T[:N, :T], path2.T[:N, :T]
 

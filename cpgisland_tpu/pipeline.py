@@ -829,7 +829,8 @@ def posterior_file(
     )
     # Small records batch into one chunked-layout kernel pass (pallas only;
     # the XLA lane path serves one record at a time).
-    batch_small = resolve_fb_engine(engine, params) in ("pallas", "onehot")
+    _fb_eng = resolve_fb_engine(engine, params)
+    batch_small = _fb_eng in ("pallas", "onehot")
     # Writers open INSIDE the try: a failure opening the second must still
     # close (finalize) the first, not leave a corrupt header slot behind.
     conf_w = None
@@ -939,7 +940,7 @@ def posterior_file(
                     conf2, path2 = batch_posterior_pallas(
                         params, jnp.asarray(rows), jnp.asarray(lens),
                         jnp.asarray(island_mask(params, island_states)),
-                        want_path=want_path,
+                        want_path=want_path, onehot=_fb_eng == "onehot",
                     )
                     if use_device_islands:
                         # conf/path stay device-resident; block so the

@@ -43,12 +43,35 @@ def resolve_fb_engine(engine: str, params: HmmParams, mode: str) -> str:
             and mode == "rescaled"
             and fb_pallas.supports(params)
         ):
+            # auto stays on the DENSE kernels for the chunked E-step: the
+            # reduced one-hot path must scatter its streams back to dense
+            # for the fused stats pass, and that costs more than the
+            # short-chain savings here (measured 923 -> 809 Msym/s/iter at
+            # the bench's 64 Ki chunk framing).  'onehot' remains available
+            # explicitly; the whole-sequence backends (SeqBackend/Seq2D,
+            # where stats assembly is XLA anyway) and the posterior paths
+            # auto-select it where it measured faster.
             return "pallas"
         return "xla"
-    if engine not in ("xla", "pallas"):
-        raise ValueError(f"unknown engine {engine!r}; expected auto|xla|pallas")
-    if engine == "pallas" and mode != "rescaled":
-        raise ValueError("pallas E-step implements rescaled numerics only")
+    if engine not in ("xla", "pallas", "onehot"):
+        raise ValueError(
+            f"unknown engine {engine!r}; expected auto|xla|pallas|onehot"
+        )
+    if engine in ("pallas", "onehot") and mode != "rescaled":
+        raise ValueError(f"{engine} E-step implements rescaled numerics only")
+    if engine == "onehot":
+        from cpgisland_tpu.ops import fb_onehot
+
+        if not fb_pallas.supports(params):
+            raise ValueError(
+                f"onehot E-step kernels need n_states <= 8, got "
+                f"{params.n_states}"
+            )
+        if fb_onehot.supports_concrete(params) is False:
+            raise ValueError(
+                "engine='onehot' needs one-hot emissions with 2 states per "
+                "symbol"
+            )
     return engine
 
 
@@ -56,6 +79,8 @@ def _local_stats_fn(engine: str, mode: str):
     """(params, chunks, lengths) -> batch-summed SuffStats, engine-lowered."""
     if engine == "pallas":
         return fb_pallas.batch_stats_pallas
+    if engine == "onehot":
+        return partial(fb_pallas.batch_stats_pallas, onehot=True)
     return partial(batch_stats, mode=mode)
 
 
@@ -144,7 +169,7 @@ class SpmdBackend(EStepBackend):
                     mesh=self.mesh,
                     in_specs=(P(), P(self.axis), P(self.axis)),
                     out_specs=P(),
-                    check_vma=engine != "pallas",
+                    check_vma=engine == "xla",
                 )
             )
         return self._estep_cache[engine]

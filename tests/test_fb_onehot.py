@@ -234,3 +234,61 @@ def test_pick_lane_T_onehot_cost_model():
         picked = pick_lane_T(n, onehot=True)
         best = min(_LANE_RATE_ONEHOT, key=cost)
         assert cost(picked) <= cost(best) * (1 + 1e-9), (n, picked, best)
+
+
+def test_batch_stats_parity(rng):
+    """Chunked-path batch_stats_pallas(onehot=True) vs dense — available
+    explicitly (auto keeps dense here: the stats-pass scatter outweighs the
+    short-chain savings, see train.backends.resolve_fb_engine)."""
+    params = presets.durbin_cpg8()
+    N, T = 5, 3000
+    chunks = np.zeros((N, T), np.uint8)
+    lengths = np.asarray([3000, 2500, 1, 0, 3000], np.int32)
+    for i in range(N):
+        if lengths[i]:
+            _, o = sample_sequence(params, jax.random.PRNGKey(i), int(lengths[i]))
+            chunks[i, : lengths[i]] = np.asarray(o)
+    s_d = fb_pallas.batch_stats_pallas(
+        params, jnp.asarray(chunks), jnp.asarray(lengths), t_tile=512
+    )
+    s_o = fb_pallas.batch_stats_pallas(
+        params, jnp.asarray(chunks), jnp.asarray(lengths), t_tile=512, onehot=True
+    )
+    np.testing.assert_allclose(np.asarray(s_d.init), np.asarray(s_o.init), atol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(s_d.trans), np.asarray(s_o.trans), rtol=1e-5, atol=1e-3
+    )
+    np.testing.assert_allclose(
+        np.asarray(s_d.emit), np.asarray(s_o.emit), rtol=1e-5, atol=1e-3
+    )
+    assert float(s_d.loglik) == pytest.approx(float(s_o.loglik), rel=1e-6)
+    assert int(s_d.n_seqs) == int(s_o.n_seqs)
+
+
+def test_batch_posterior_parity(rng):
+    """Batched small-record posterior, onehot vs dense, conf AND path."""
+    params = presets.durbin_cpg8()
+    N, T = 4, 2000
+    chunks = np.zeros((N, T), np.uint8)
+    lengths = np.asarray([2000, 1500, 1, 2000], np.int32)
+    for i in range(N):
+        _, o = sample_sequence(params, jax.random.PRNGKey(10 + i), int(lengths[i]))
+        chunks[i, : lengths[i]] = np.asarray(o)
+    for want_path in (False, True):
+        c_d, p_d = fb_pallas.batch_posterior_pallas(
+            params, jnp.asarray(chunks), jnp.asarray(lengths), MASK8,
+            want_path=want_path,
+        )
+        c_o, p_o = fb_pallas.batch_posterior_pallas(
+            params, jnp.asarray(chunks), jnp.asarray(lengths), MASK8,
+            want_path=want_path, onehot=True,
+        )
+        for i in range(N):
+            L = int(lengths[i])
+            np.testing.assert_allclose(
+                np.asarray(c_d)[i, :L], np.asarray(c_o)[i, :L], atol=2e-5
+            )
+            if want_path:
+                assert np.array_equal(
+                    np.asarray(p_d)[i, :L], np.asarray(p_o)[i, :L]
+                )
