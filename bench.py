@@ -363,7 +363,7 @@ def bench_em_2state(n_chunks: int, chunk_size: int = 0x10000, chain: int = 24) -
     return tput
 
 
-def _seq_engine_for_bench(engine: str, params) -> str:
+def _seq_engine_for_bench(engine: str, params, shard_len: int) -> str:
     """Pre-resolve the seq-backend engine with CONCRETE params.
 
     The chained harness calls the backend INSIDE one jit, where its auto
@@ -371,10 +371,12 @@ def _seq_engine_for_bench(engine: str, params) -> str:
     (a concrete-params structural test).  Real training (fit()) routes per
     iteration in Python with concrete params and DOES auto-select the
     reduced kernels — so the bench pre-resolves here to measure what real
-    training runs."""
+    training runs, keeping auto's own fused-path gate (shard >= 1 Mi, see
+    backends._use_fused_seq) so small configs still measure the route real
+    auto training would take."""
     import jax
 
-    if engine != "auto" or jax.default_backend() != "tpu":
+    if engine != "auto" or jax.default_backend() != "tpu" or shard_len < (1 << 20):
         return engine
     from cpgisland_tpu.ops import fb_onehot
 
@@ -400,7 +402,9 @@ def bench_em_seq(n_symbols: int, engine: str = "auto", chain: int = 8) -> float:
     params = presets.durbin_cpg8()
     backend = SeqBackend(
         mesh=make_mesh(len(jax.devices()), axis="seq"),
-        engine=_seq_engine_for_bench(engine, params),
+        engine=_seq_engine_for_bench(
+            engine, params, n_symbols // len(jax.devices())
+        ),
     )
     rng = np.random.default_rng(6)
     stream = rng.integers(0, 4, size=n_symbols, dtype=np.int32).astype(np.uint8)
@@ -452,7 +456,10 @@ def bench_em_seq2d(engine: str = "auto", chain: int = 8, scale: float = 1.0) -> 
     from cpgisland_tpu.utils import chunking
 
     params = presets.durbin_cpg8()
-    backend = Seq2DBackend(engine=_seq_engine_for_bench(engine, params))
+    # Gate on the SMALLEST group's row length — auto routes per group.
+    backend = Seq2DBackend(
+        engine=_seq_engine_for_bench(engine, params, int((2 << 20) * scale))
+    )
     rng = np.random.default_rng(8)
     # One "chromosome" group + one scaffold group (pow2 size classes, like
     # chunking.bucket_records builds): 32 Mi + 8 x 2 Mi at scale=1.

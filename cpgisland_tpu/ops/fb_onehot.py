@@ -336,6 +336,219 @@ def _oh_bwd_conf_kernel(pairnext_ref, pair_ref, lens_ref, tab_ref, csnext_ref,
     beta_scr[1:2, :] = bn1
 
 
+def _oh_stats_kernel(alphas_ref, betas_ref, pair_ref, lens_ref, brtab_ref,
+                     gttab_ref, macc_ref, emit_ref, ll_ref,
+                     macc_scr, emit_scr, ll_scr, aprev_scr,
+                     *, K, S, Tt):
+    """Reduced-stream twin of fb_pallas._stats_kernel (chunked semantics).
+
+    Reads the 2-component alpha/beta streams (16 B/symbol vs the dense
+    pass's 64 — the dense stats pass is streaming-bound) and rebuilds the
+    dense [K, lt] alpha-hat / w rows IN REGISTERS from the per-position
+    group ids, so the accumulator math (and its output contract) is
+    identical to the dense kernel with no HBM scatter anywhere.  Emission
+    counts accumulate in reduced [S*GROUP] buckets (gamma is zero outside
+    the emitted symbol's group); macc keeps the dense [K*K] layout.
+
+    brtab: lane-broadcast B_red ([S, GROUP] — B[gt[s,c], s]); gttab:
+    lane-broadcast gt as int32 ([S, GROUP] state ids).  Lowers only for
+    power-of-two S (the symbol of any pair index is then p & (S-1));
+    run_stats_onehot raises for other S and its callers fall back to the
+    dense stats pass.
+    """
+    j = pl.program_id(1)
+    n_t = pl.num_programs(1)
+    lt = pair_ref.shape[1]
+    lens = lens_ref[0, :]
+
+    @pl.when(j == 0)
+    def _init():
+        macc_scr[:, :] = jnp.zeros((K * K, lt), jnp.float32)
+        emit_scr[:, :] = jnp.zeros((S * GROUP, lt), jnp.float32)
+        ll_scr[:, :] = jnp.zeros((1, lt), jnp.float32)
+        aprev_scr[:, :] = jnp.zeros((K, lt), jnp.float32)
+
+    iK = jax.lax.broadcasted_iota(jnp.int32, (K, lt), 0)
+
+    def sel_sym_tables(tile):
+        """(b0, b1, glow, ghigh) [8, lt] tiles from the pair tile."""
+        key = tile & (S - 1)
+        b0 = jnp.zeros(tile.shape, jnp.float32)
+        b1 = jnp.zeros(tile.shape, jnp.float32)
+        gl = jnp.zeros(tile.shape, jnp.int32)
+        gh = jnp.zeros(tile.shape, jnp.int32)
+        for k in range(S):
+            cmp = key == k
+            b0 = jnp.where(cmp, brtab_ref[2 * k : 2 * k + 1, :], b0)
+            b1 = jnp.where(cmp, brtab_ref[2 * k + 1 : 2 * k + 2, :], b1)
+            gl = jnp.where(cmp, gttab_ref[2 * k : 2 * k + 1, :], gl)
+            gh = jnp.where(cmp, gttab_ref[2 * k + 1 : 2 * k + 2, :], gh)
+        return b0, b1, gl, gh
+
+    def body(tile_i, carry):
+        aprev, macc, emit, ll = carry
+        base = tile_i * ROW_TILE
+        p_tile = pair_ref[pl.ds(base, ROW_TILE), :]
+        b0t, b1t, glt, ght = sel_sym_tables(p_tile)
+        esym = p_tile & (S - 1)
+        macc = list(macc)
+        emit = list(emit)
+        for r in range(ROW_TILE):
+            t = j * Tt + base + r
+            valid = (t < lens)[None, :]  # [1, lt]
+            a_row = alphas_ref[base + r, :, :]  # [2, lt]
+            b_row = betas_ref[base + r, :, :]
+            a0 = a_row[0:1, :]
+            a1 = a_row[1:2, :]
+            be0 = b_row[0:1, :]
+            be1 = b_row[1:2, :]
+            cs = a0 + a1
+            inv_cs = 1.0 / jnp.maximum(cs, 1e-30)
+            g0 = a0 * be0
+            g1 = a1 * be1
+            inv_g = 1.0 / jnp.maximum(g0 + g1, 1e-30)
+            gm0 = jnp.where(valid, g0 * inv_g, 0.0)
+            gm1 = jnp.where(valid, g1 * inv_g, 0.0)
+            # Reduced emission buckets: bucket = the emitted symbol itself.
+            sym_r = esym[r : r + 1, :]
+            for s in range(S):
+                m = sym_r == s
+                emit[2 * s] = emit[2 * s] + jnp.where(m, gm0, 0.0)
+                emit[2 * s + 1] = emit[2 * s + 1] + jnp.where(m, gm1, 0.0)
+            ll = ll + jnp.where(valid, jnp.log(jnp.maximum(cs, 1e-30)), 0.0)
+            # Dense rows rebuilt in registers: w = B[:, o_t] * beta / c.
+            glow = glt[r : r + 1, :]
+            ghigh = ght[r : r + 1, :]
+            w0 = b0t[r : r + 1, :] * be0 * inv_cs
+            w1 = b1t[r : r + 1, :] * be1 * inv_cs
+            w_full = jnp.where(iK == glow, w0, 0.0) + jnp.where(
+                iK == ghigh, w1, 0.0
+            )
+            wm = jnp.where(jnp.logical_and(valid, t >= 1), w_full, 0.0)
+            for jj in range(K):
+                macc[jj] = macc[jj] + aprev[jj : jj + 1, :] * wm
+            ah0 = a0 * inv_cs
+            ah1 = a1 * inv_cs
+            aprev = jnp.where(iK == glow, ah0, 0.0) + jnp.where(
+                iK == ghigh, ah1, 0.0
+            )
+        return aprev, tuple(macc), tuple(emit), ll
+
+    zeroK = jnp.zeros((K, lt), jnp.float32)
+    zero1 = jnp.zeros((1, lt), jnp.float32)
+    carry0 = (
+        aprev_scr[:, :],
+        tuple(zeroK for _ in range(K)),
+        tuple(zero1 for _ in range(S * GROUP)),
+        jnp.zeros((1, lt), jnp.float32),
+    )
+    aprev, macc, emit, ll = jax.lax.fori_loop(0, Tt // ROW_TILE, body, carry0)
+    aprev_scr[:, :] = aprev
+    for jj in range(K):
+        sl = slice(jj * K, (jj + 1) * K)
+        macc_scr[sl, :] = macc_scr[sl, :] + macc[jj]
+    for i in range(S * GROUP):
+        emit_scr[i : i + 1, :] = emit_scr[i : i + 1, :] + emit[i]
+    ll_scr[:, :] = ll_scr[:, :] + ll
+
+    @pl.when(j == n_t - 1)
+    def _flush():
+        macc_ref[:, :] = macc_scr[:, :]
+        emit_ref[:, :] = emit_scr[:, :]
+        ll_ref[:, :] = ll_scr[:, :]
+
+
+def run_stats_onehot(params, alphas2, betas2, pair2, lens2, gt, Tt):
+    """Per-lane count reductions from REDUCED streams — (macc [K*K, NL],
+    emit_red [S*GROUP, NL], ll [1, NL]).  emit_red buckets are
+    (symbol, group member): emit_full[gt[s, c], s] = emit_red[2s + c].
+    Lowers to the kernel only for power-of-two S (the flagship S=4);
+    other S raise on TPU — callers fall back to the dense stats pass
+    (the XLA twin for non-TPU backends is S-generic)."""
+    K, S = params.n_states, params.n_symbols
+    Tp, _, NL = alphas2.shape
+    by_sym = S & (S - 1) == 0
+    if not by_sym and not _interpret():
+        raise ValueError(
+            "run_stats_onehot lowers only for power-of-two n_symbols; "
+            "callers fall back to the dense stats pass otherwise"
+        )
+    B = jnp.exp(params.log_B).astype(jnp.float32)
+    B_red = B[gt, jnp.arange(S)[:, None]]  # [S, GROUP]
+    gt_tab = gt.astype(jnp.int32)
+    if _interpret():
+        # XLA twin: identical math over the reduced streams.
+        esym2 = decode_esym(pair2, S)
+        a0, a1 = alphas2[:, 0], alphas2[:, 1]
+        be0, be1 = betas2[:, 0], betas2[:, 1]
+        cs = a0 + a1
+        inv_cs = 1.0 / jnp.maximum(cs, 1e-30)
+        vmask = jnp.arange(Tp)[:, None] < lens2
+        g0, g1 = a0 * be0, a1 * be1
+        inv_g = 1.0 / jnp.maximum(g0 + g1, 1e-30)
+        gm0 = jnp.where(vmask, g0 * inv_g, 0.0)
+        gm1 = jnp.where(vmask, g1 * inv_g, 0.0)
+        emit_rows = []
+        for s in range(S):
+            m = esym2 == s
+            emit_rows.append(jnp.sum(jnp.where(m, gm0, 0.0), axis=0))
+            emit_rows.append(jnp.sum(jnp.where(m, gm1, 0.0), axis=0))
+        emit_red = jnp.stack(emit_rows, axis=0)  # [S*GROUP, NL]
+        ll = jnp.sum(
+            jnp.where(vmask, jnp.log(jnp.maximum(cs, 1e-30)), 0.0), axis=0
+        )[None, :]
+        Bsel0 = B_red[esym2, 0]
+        Bsel1 = B_red[esym2, 1]
+        w_full = scatter_streams(
+            jnp.stack([Bsel0 * be0 * inv_cs, Bsel1 * be1 * inv_cs], axis=1),
+            gt, esym2, K,
+        )
+        a_hat = scatter_streams(
+            jnp.stack([a0 * inv_cs, a1 * inv_cs], axis=1), gt, esym2, K
+        )
+        pairm = vmask & (jnp.arange(Tp)[:, None] >= 1)
+        aprev = jnp.concatenate([jnp.zeros((1, K, NL)), a_hat[:-1]], axis=0)
+        aprev = jnp.where(pairm[:, None, :], aprev, 0.0)
+        wq = jnp.where(pairm[:, None, :], w_full, 0.0)
+        macc = jnp.einsum(
+            "tin,tjn->ijn", aprev, wq, precision=jax.lax.Precision.HIGHEST
+        ).reshape(K * K, NL)
+        return macc, emit_red, ll
+    lt = LANE_TILE
+    n_t = Tp // Tt
+    grid = (NL // lt, n_t)
+    brtabb = _bcast_tab(B_red, lt)
+    gttabb = _bcast_tab(gt_tab, lt)
+    return pl.pallas_call(
+        functools.partial(_oh_stats_kernel, K=K, S=S, Tt=Tt),
+        grid=grid,
+        in_specs=[
+            _vspec((Tt, GROUP, lt), lambda i, j: (j, 0, i)),
+            _vspec((Tt, GROUP, lt), lambda i, j: (j, 0, i)),
+            _vspec((Tt, lt), lambda i, j: (j, i)),
+            _vspec((1, lt), lambda i, j: (0, i)),
+            _vspec(brtabb.shape, lambda i, j: (0, 0)),
+            _vspec(gttabb.shape, lambda i, j: (0, 0)),
+        ],
+        out_specs=[
+            _vspec((K * K, lt), lambda i, j: (0, i)),
+            _vspec((S * GROUP, lt), lambda i, j: (0, i)),
+            _vspec((1, lt), lambda i, j: (0, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((K * K, NL), jnp.float32),
+            jax.ShapeDtypeStruct((S * GROUP, NL), jnp.float32),
+            jax.ShapeDtypeStruct((1, NL), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((K * K, lt), jnp.float32),
+            pltpu.VMEM((S * GROUP, lt), jnp.float32),
+            pltpu.VMEM((1, lt), jnp.float32),
+            pltpu.VMEM((K, lt), jnp.float32),
+        ],
+    )(alphas2, betas2, pair2, lens2, brtabb, gttabb)
+
+
 # --- XLA twins (non-TPU backends; same arithmetic, scan lowering) ----------
 
 

@@ -683,7 +683,10 @@ def batch_stats_pallas(
 
     chunks: [N, T] (padded), lengths: [N].  Returns batch-summed SuffStats.
     ``onehot`` routes the reduced 2-component kernels (one-hot-emission
-    models); the streams scatter back to dense for the stats pass — exact.
+    models); for power-of-two n_symbols (the flagship S=4 — the only case
+    auto routes here) the count tensors come from the reduced-stream stats
+    kernel with NO scatter anywhere, else the streams scatter back to dense
+    for the dense stats pass — both exact.
     """
     K, S = params.n_states, params.n_symbols
     T = chunks.shape[1]
@@ -697,6 +700,39 @@ def batch_stats_pallas(
             params, sel2, jnp.int32(0), lens2, a0_raw, beta0, Tt, T
         )
         gt = fb_onehot._groups(params)
+        if S & (S - 1) == 0:
+            # Reduced-stream stats: 16 B/symbol read instead of 64, dense
+            # rows rebuilt in registers — no HBM scatter anywhere.
+            pair2, _, _ = _pair_stream_for_stats(params, sel2)
+            macc, emit_red, ll = fb_onehot.run_stats_onehot(
+                params, al2, b2, pair2, lens2, gt, Tt
+            )
+            trans = A * jnp.sum(macc, axis=1).reshape(K, K)
+            iS = jnp.arange(S)
+            emit = (
+                jnp.zeros((K, S), jnp.float32)
+                .at[gt[:, 0], iS].add(jnp.sum(emit_red[0::2], axis=1))
+                .at[gt[:, 1], iS].add(jnp.sum(emit_red[1::2], axis=1))
+            )
+            loglik = jnp.sum(ll)
+            g0raw2 = al2[0] * b2[0]  # [GROUP, NL]
+            gamma0_2 = g0raw2 / jnp.maximum(
+                jnp.sum(g0raw2, axis=0, keepdims=True), 1e-30
+            )
+            init_l = jnp.where(
+                valid0[None, :],
+                fb_onehot.scatter_streams(
+                    gamma0_2[None], gt, esym2[0:1], K
+                )[0],
+                0.0,
+            )
+            return SuffStats(
+                init=jnp.sum(init_l, axis=1),
+                trans=trans,
+                emit=emit,
+                loglik=loglik,
+                n_seqs=jnp.sum(valid0.astype(jnp.int32)),
+            )
         alphas = fb_onehot.scatter_streams(al2, gt, esym2, K)
         betas = fb_onehot.scatter_streams(b2, gt, esym2, K)
     else:
@@ -724,6 +760,14 @@ def batch_stats_pallas(
         loglik=loglik,
         n_seqs=jnp.sum(valid0.astype(jnp.int32)),
     )
+
+
+def _pair_stream_for_stats(params, sel2):
+    """The same pair stream run_fb_kernels_onehot builds internally —
+    identical HLO, so XLA CSEs the two within one jit."""
+    from cpgisland_tpu.ops.viterbi_onehot import _pair_stream
+
+    return _pair_stream(params, sel2, jnp.int32(0))
 
 
 def _norm_rows(v):

@@ -43,14 +43,21 @@ def resolve_fb_engine(engine: str, params: HmmParams, mode: str) -> str:
             and mode == "rescaled"
             and fb_pallas.supports(params)
         ):
-            # auto stays on the DENSE kernels for the chunked E-step: the
-            # reduced one-hot path must scatter its streams back to dense
-            # for the fused stats pass, and that costs more than the
-            # short-chain savings here (measured 923 -> 809 Msym/s/iter at
-            # the bench's 64 Ki chunk framing).  'onehot' remains available
-            # explicitly; the whole-sequence backends (SeqBackend/Seq2D,
-            # where stats assembly is XLA anyway) and the posterior paths
-            # auto-select it where it measured faster.
+            from cpgisland_tpu.ops import fb_onehot
+
+            # The reduced one-hot path needed its own stats kernel to win
+            # here: with the dense stats pass (streams scattered back to
+            # dense) it REGRESSED 923 -> 809 Msym/s/iter, and with the
+            # reduced-stream stats kernel (fb_onehot._oh_stats_kernel,
+            # 16 B/symbol read, in-register scatter) it measured
+            # 977 -> 1340.  That kernel lowers only for power-of-two
+            # n_symbols, which the one-hot eligibility (2 states/symbol,
+            # K <= 8 => S <= 4) does not itself guarantee — gate on both.
+            if (
+                fb_onehot.supports(params)
+                and params.n_symbols & (params.n_symbols - 1) == 0
+            ):
+                return "onehot"
             return "pallas"
         return "xla"
     if engine not in ("xla", "pallas", "onehot"):

@@ -237,9 +237,11 @@ def test_pick_lane_T_onehot_cost_model():
 
 
 def test_batch_stats_parity(rng):
-    """Chunked-path batch_stats_pallas(onehot=True) vs dense — available
-    explicitly (auto keeps dense here: the stats-pass scatter outweighs the
-    short-chain savings, see train.backends.resolve_fb_engine)."""
+    """Chunked-path batch_stats_pallas(onehot=True) vs dense.
+
+    auto routes the chunked E-step here too (train.backends.resolve_fb_engine)
+    since the reduced-stream stats kernel landed — the scatter+dense-stats
+    variant this path briefly used had regressed, see the resolver comment."""
     params = presets.durbin_cpg8()
     N, T = 5, 3000
     chunks = np.zeros((N, T), np.uint8)
