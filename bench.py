@@ -275,7 +275,10 @@ def bench_posterior(n_symbols: int, engine: str = "auto", chain: int = 6) -> flo
         def one(o):
             conf, _ = fb_pallas._seq_posterior_core(
                 params, o, o.shape[0], mask,
-                fb_pallas.pick_lane_T(o.shape[0], onehot=eng == "onehot"),
+                fb_pallas.pick_lane_T(
+                    o.shape[0], onehot=eng == "onehot",
+                    long_lanes=eng == "onehot",
+                ),
                 fb_pallas.DEFAULT_T_TILE,
                 axis=None, onehot=eng == "onehot",
             )

@@ -336,6 +336,24 @@ def _oh_bwd_conf_kernel(pairnext_ref, pair_ref, lens_ref, tab_ref, csnext_ref,
     beta_scr[1:2, :] = bn1
 
 
+def _sel_sym_tables(tile, brtab_ref, gttab_ref, S):
+    """(b0, b1, glow, ghigh) [8, lt] tiles keyed on the pair tile's exit
+    symbol (tile & (S-1); pow2 S only — the ONE copy shared by both stats
+    kernels, whose parity-twin relationship must not drift)."""
+    key = tile & (S - 1)
+    b0 = jnp.zeros(tile.shape, jnp.float32)
+    b1 = jnp.zeros(tile.shape, jnp.float32)
+    gl = jnp.zeros(tile.shape, jnp.int32)
+    gh = jnp.zeros(tile.shape, jnp.int32)
+    for k in range(S):
+        cmp = key == k
+        b0 = jnp.where(cmp, brtab_ref[2 * k : 2 * k + 1, :], b0)
+        b1 = jnp.where(cmp, brtab_ref[2 * k + 1 : 2 * k + 2, :], b1)
+        gl = jnp.where(cmp, gttab_ref[2 * k : 2 * k + 1, :], gl)
+        gh = jnp.where(cmp, gttab_ref[2 * k + 1 : 2 * k + 2, :], gh)
+    return b0, b1, gl, gh
+
+
 def _oh_stats_kernel(alphas_ref, betas_ref, pair_ref, lens_ref, brtab_ref,
                      gttab_ref, macc_ref, emit_ref, ll_ref,
                      macc_scr, emit_scr, ll_scr, aprev_scr,
@@ -371,19 +389,7 @@ def _oh_stats_kernel(alphas_ref, betas_ref, pair_ref, lens_ref, brtab_ref,
     iK = jax.lax.broadcasted_iota(jnp.int32, (K, lt), 0)
 
     def sel_sym_tables(tile):
-        """(b0, b1, glow, ghigh) [8, lt] tiles from the pair tile."""
-        key = tile & (S - 1)
-        b0 = jnp.zeros(tile.shape, jnp.float32)
-        b1 = jnp.zeros(tile.shape, jnp.float32)
-        gl = jnp.zeros(tile.shape, jnp.int32)
-        gh = jnp.zeros(tile.shape, jnp.int32)
-        for k in range(S):
-            cmp = key == k
-            b0 = jnp.where(cmp, brtab_ref[2 * k : 2 * k + 1, :], b0)
-            b1 = jnp.where(cmp, brtab_ref[2 * k + 1 : 2 * k + 2, :], b1)
-            gl = jnp.where(cmp, gttab_ref[2 * k : 2 * k + 1, :], gl)
-            gh = jnp.where(cmp, gttab_ref[2 * k + 1 : 2 * k + 2, :], gh)
-        return b0, b1, gl, gh
+        return _sel_sym_tables(tile, brtab_ref, gttab_ref, S)
 
     def body(tile_i, carry):
         aprev, macc, emit, ll = carry
@@ -547,6 +553,184 @@ def run_stats_onehot(params, alphas2, betas2, pair2, lens2, gt, Tt):
             pltpu.VMEM((K, lt), jnp.float32),
         ],
     )(alphas2, betas2, pair2, lens2, brtabb, gttabb)
+
+
+def _oh_seq_stats_kernel(alphas_ref, betas_ref, pair_ref, lens_ref, tab_ref,
+                         brtab_ref, gttab_ref, enters_full_ref, enters_red_ref,
+                         pair0m_ref, macc_ref, emit_ref, ll_ref,
+                         macc_scr, emit_scr, ll_scr, aprev_scr, aprev2_scr,
+                         *, K, S, nreal, Tt):
+    """Reduced-stream stats for the WHOLE-SEQUENCE (direction-beta) path.
+
+    The chunked kernel's macc math needs true-scaled betas; the seq path's
+    betas are per-lane DIRECTIONS, so this variant normalizes each pair's
+    xi by its own total (z_t = sum_ac aprev2[a] * T[p_t][a, c] * beta2[c] —
+    the pair table supplies A*B, betas supply the rest), exactly the
+    scale-free scheme of fb_pallas._seq_stats_core's XLA assembly, which
+    remains the off-TPU lowering and the parity twin.  Per-lane boundary
+    pairs are owned by the lane: at within-lane t == 0 the previous-alpha
+    is the ENTERING message (enters_full / enters_red inputs, living on the
+    entering group = the pair stream's per-lane seed symbol, which is also
+    what T[p_0] maps from); ``pair0m`` masks only the global-init lane.
+    """
+    j = pl.program_id(1)
+    n_t = pl.num_programs(1)
+    lt = pair_ref.shape[1]
+    lens = lens_ref[0, :]
+    pair0m = pair0m_ref[:, :]  # [1, lt] f32 0/1
+
+    @pl.when(j == 0)
+    def _init():
+        macc_scr[:, :] = jnp.zeros((K * K, lt), jnp.float32)
+        emit_scr[:, :] = jnp.zeros((S * GROUP, lt), jnp.float32)
+        ll_scr[:, :] = jnp.zeros((1, lt), jnp.float32)
+        aprev_scr[:, :] = jnp.zeros((K, lt), jnp.float32)
+        aprev2_scr[:, :] = jnp.zeros((GROUP, lt), jnp.float32)
+
+    iK = jax.lax.broadcasted_iota(jnp.int32, (K, lt), 0)
+
+    def sel_sym_tables(tile):
+        return _sel_sym_tables(tile, brtab_ref, gttab_ref, S)
+
+    def body(tile_i, carry):
+        aprev, ap2_0, ap2_1, macc, emit, ll = carry
+        base = tile_i * ROW_TILE
+        p_tile = pair_ref[pl.ds(base, ROW_TILE), :]
+        t00, t01, t10, t11 = _select4_prob(p_tile, tab_ref, nreal)
+        b0t, b1t, glt, ght = sel_sym_tables(p_tile)
+        esym = p_tile & (S - 1)
+        macc = list(macc)
+        emit = list(emit)
+        for r in range(ROW_TILE):
+            t = j * Tt + base + r
+            valid = (t < lens)[None, :]  # [1, lt]
+            a_row = alphas_ref[base + r, :, :]  # [2, lt]
+            b_row = betas_ref[base + r, :, :]
+            a0 = a_row[0:1, :]
+            a1 = a_row[1:2, :]
+            be0 = b_row[0:1, :]
+            be1 = b_row[1:2, :]
+            cs = a0 + a1
+            inv_cs = 1.0 / jnp.maximum(cs, 1e-30)
+            g0 = a0 * be0
+            g1 = a1 * be1
+            inv_g = 1.0 / jnp.maximum(g0 + g1, 1e-30)
+            gm0 = jnp.where(valid, g0 * inv_g, 0.0)
+            gm1 = jnp.where(valid, g1 * inv_g, 0.0)
+            sym_r = esym[r : r + 1, :]
+            for s in range(S):
+                m = sym_r == s
+                emit[2 * s] = emit[2 * s] + jnp.where(m, gm0, 0.0)
+                emit[2 * s + 1] = emit[2 * s + 1] + jnp.where(m, gm1, 0.0)
+            ll = ll + jnp.where(valid, jnp.log(jnp.maximum(cs, 1e-30)), 0.0)
+            # Within-lane t == 0: the previous alpha is the entering message.
+            is0 = t == 0
+            apf = jnp.where(is0, enters_full_ref[:, :], aprev)
+            ap0 = jnp.where(is0, enters_red_ref[0:1, :], ap2_0)
+            ap1 = jnp.where(is0, enters_red_ref[1:2, :], ap2_1)
+            pairm = jnp.where(is0, valid * pair0m, valid.astype(jnp.float32))
+            # Scale-free xi: z = sum_ac aprev2[a] T[a,c] beta2[c].
+            z = ap0 * (t00[r : r + 1, :] * be0 + t01[r : r + 1, :] * be1) + \
+                ap1 * (t10[r : r + 1, :] * be0 + t11[r : r + 1, :] * be1)
+            inv_z = pairm * (1.0 / jnp.maximum(z, 1e-30))
+            glow = glt[r : r + 1, :]
+            ghigh = ght[r : r + 1, :]
+            w_full = jnp.where(iK == glow, b0t[r : r + 1, :] * be0, 0.0) + \
+                jnp.where(iK == ghigh, b1t[r : r + 1, :] * be1, 0.0)
+            wz = w_full * inv_z
+            for jj in range(K):
+                macc[jj] = macc[jj] + apf[jj : jj + 1, :] * wz
+            ah0 = a0 * inv_cs
+            ah1 = a1 * inv_cs
+            aprev = jnp.where(iK == glow, ah0, 0.0) + jnp.where(
+                iK == ghigh, ah1, 0.0
+            )
+            ap2_0, ap2_1 = ah0, ah1
+        return aprev, ap2_0, ap2_1, tuple(macc), tuple(emit), ll
+
+    zeroK = jnp.zeros((K, lt), jnp.float32)
+    zero1 = jnp.zeros((1, lt), jnp.float32)
+    carry0 = (
+        aprev_scr[:, :],
+        aprev2_scr[0:1, :],
+        aprev2_scr[1:2, :],
+        tuple(zeroK for _ in range(K)),
+        tuple(zero1 for _ in range(S * GROUP)),
+        jnp.zeros((1, lt), jnp.float32),
+    )
+    aprev, ap2_0, ap2_1, macc, emit, ll = jax.lax.fori_loop(
+        0, Tt // ROW_TILE, body, carry0
+    )
+    aprev_scr[:, :] = aprev
+    aprev2_scr[0:1, :] = ap2_0
+    aprev2_scr[1:2, :] = ap2_1
+    for jj in range(K):
+        sl = slice(jj * K, (jj + 1) * K)
+        macc_scr[sl, :] = macc_scr[sl, :] + macc[jj]
+    for i in range(S * GROUP):
+        emit_scr[i : i + 1, :] = emit_scr[i : i + 1, :] + emit[i]
+    ll_scr[:, :] = ll_scr[:, :] + ll
+
+    @pl.when(j == n_t - 1)
+    def _flush():
+        macc_ref[:, :] = macc_scr[:, :]
+        emit_ref[:, :] = emit_scr[:, :]
+        ll_ref[:, :] = ll_scr[:, :]
+
+
+def run_seq_stats_onehot(params, alphas2, betas2, pair2, lens2, gt,
+                         enters_red, enters_full, pair0_mask, Tt):
+    """Whole-sequence stats from REDUCED streams (TPU only; power-of-two S —
+    callers keep the scatter + XLA assembly off-TPU / for other S, which is
+    also this kernel's parity twin).  Returns (macc [K*K, NL] — trans =
+    A * macc-sum, the z-normalized scale-free scheme; emit_red
+    [S*GROUP, NL]; ll [1, NL])."""
+    K, S = params.n_states, params.n_symbols
+    if S & (S - 1) or _interpret():
+        raise ValueError("run_seq_stats_onehot: TPU + power-of-two S only")
+    Tp, _, NL = alphas2.shape
+    tab = prob_pair_table(params, gt)
+    B = jnp.exp(params.log_B).astype(jnp.float32)
+    B_red = B[gt, jnp.arange(S)[:, None]]
+    lt = LANE_TILE
+    grid = (NL // lt, Tp // Tt)
+    tabb = _bcast_tab(tab, lt)
+    brtabb = _bcast_tab(B_red, lt)
+    gttabb = _bcast_tab(gt.astype(jnp.int32), lt)
+    return pl.pallas_call(
+        functools.partial(_oh_seq_stats_kernel, K=K, S=S, nreal=S * S, Tt=Tt),
+        grid=grid,
+        in_specs=[
+            _vspec((Tt, GROUP, lt), lambda i, j: (j, 0, i)),
+            _vspec((Tt, GROUP, lt), lambda i, j: (j, 0, i)),
+            _vspec((Tt, lt), lambda i, j: (j, i)),
+            _vspec((1, lt), lambda i, j: (0, i)),
+            _vspec(tabb.shape, lambda i, j: (0, 0)),
+            _vspec(brtabb.shape, lambda i, j: (0, 0)),
+            _vspec(gttabb.shape, lambda i, j: (0, 0)),
+            _vspec((K, lt), lambda i, j: (0, i)),
+            _vspec((GROUP, lt), lambda i, j: (0, i)),
+            _vspec((1, lt), lambda i, j: (0, i)),
+        ],
+        out_specs=[
+            _vspec((K * K, lt), lambda i, j: (0, i)),
+            _vspec((S * GROUP, lt), lambda i, j: (0, i)),
+            _vspec((1, lt), lambda i, j: (0, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((K * K, NL), jnp.float32),
+            jax.ShapeDtypeStruct((S * GROUP, NL), jnp.float32),
+            jax.ShapeDtypeStruct((1, NL), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((K * K, lt), jnp.float32),
+            pltpu.VMEM((S * GROUP, lt), jnp.float32),
+            pltpu.VMEM((1, lt), jnp.float32),
+            pltpu.VMEM((K, lt), jnp.float32),
+            pltpu.VMEM((GROUP, lt), jnp.float32),
+        ],
+    )(alphas2, betas2, pair2, lens2, tabb, brtabb, gttabb,
+      enters_full, enters_red, pair0_mask)
 
 
 # --- XLA twins (non-TPU backends; same arithmetic, scan lowering) ----------

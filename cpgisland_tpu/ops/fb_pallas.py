@@ -69,13 +69,16 @@ _LANE_RATE = {8192: 1.0, 16384: 1.25, 32768: 1.30}
 # The reduced one-hot kernels (ops.fb_onehot) keep gaining from longer
 # serial chains well past the dense knee (their per-step work and VMEM
 # footprint are ~4x smaller): fused posterior 507 -> 908 -> 1162 -> 1224
-# Msym/s at 8192/16384/32768/65536 (131072: +4% more but the exact-EM
-# assembly fails to compile there — the table is shared by both consumers,
-# so it caps at the longest lane BOTH support).
-_LANE_RATE_ONEHOT = {8192: 1.0, 16384: 1.79, 32768: 2.29, 65536: 2.41}
+# Msym/s at 8192/16384/32768/65536, ~+4% more at 131072.  The 131072 entry
+# became possible when the seq-stats kernel replaced the XLA assembly on
+# TPU (the assembly failed to compile there); the off-TPU XLA twins have
+# no Mosaic constraint.
+_LANE_RATE_ONEHOT = {
+    8192: 1.0, 16384: 1.79, 32768: 2.29, 65536: 2.41, 131072: 2.50,
+}
 
 
-def pick_lane_T(n: int, onehot: bool = False) -> int:
+def pick_lane_T(n: int, onehot: bool = False, long_lanes: bool = False) -> int:
     """Lane length for an ``n``-symbol (per-shard) input.
 
     Minimizes estimated pass time = padded work / measured lane rate: the
@@ -84,9 +87,16 @@ def pick_lane_T(n: int, onehot: bool = False) -> int:
     in padding than its faster rate buys — gating on raw size alone made
     inputs just above each boundary ~20% slower than the short-lane
     default.  Ties prefer the longer lane.  ``onehot`` selects the reduced
-    kernels' rate table (different knee — see _LANE_RATE_ONEHOT).
+    kernels' rate table (different knee — see _LANE_RATE_ONEHOT);
+    ``long_lanes`` additionally admits the 131072 entry, which is safe ONLY
+    for paths that stay on reduced kernels end to end (the seq-stats kernel
+    / the conf kernel) — the XLA assemblies over [Tp, K, NL] streams fail
+    to remote-compile at that lane length, so callers opt in exactly where
+    the kernelized path is guaranteed.
     """
     rates = _LANE_RATE_ONEHOT if onehot else _LANE_RATE
+    if onehot and not long_lanes:
+        rates = {k: v for k, v in rates.items() if k <= 65536}
 
     def est_cost(lt: int) -> float:
         n_lanes = -(-max(n, 1) // lt)
@@ -707,24 +717,11 @@ def batch_stats_pallas(
             macc, emit_red, ll = fb_onehot.run_stats_onehot(
                 params, al2, b2, pair2, lens2, gt, Tt
             )
-            trans = A * jnp.sum(macc, axis=1).reshape(K, K)
-            iS = jnp.arange(S)
-            emit = (
-                jnp.zeros((K, S), jnp.float32)
-                .at[gt[:, 0], iS].add(jnp.sum(emit_red[0::2], axis=1))
-                .at[gt[:, 1], iS].add(jnp.sum(emit_red[1::2], axis=1))
-            )
-            loglik = jnp.sum(ll)
-            g0raw2 = al2[0] * b2[0]  # [GROUP, NL]
-            gamma0_2 = g0raw2 / jnp.maximum(
-                jnp.sum(g0raw2, axis=0, keepdims=True), 1e-30
+            trans, emit, loglik = _assemble_reduced_stats(
+                params, A, gt, macc, emit_red, ll
             )
             init_l = jnp.where(
-                valid0[None, :],
-                fb_onehot.scatter_streams(
-                    gamma0_2[None], gt, esym2[0:1], K
-                )[0],
-                0.0,
+                valid0[None, :], _gamma0_full(al2, b2, gt, esym2, K), 0.0
             )
             return SuffStats(
                 init=jnp.sum(init_l, axis=1),
@@ -760,6 +757,30 @@ def batch_stats_pallas(
         loglik=loglik,
         n_seqs=jnp.sum(valid0.astype(jnp.int32)),
     )
+
+
+def _assemble_reduced_stats(params, A, gt, macc, emit_red, ll):
+    """(trans, emit, loglik) from the reduced stats kernels' outputs — the
+    ONE copy shared by the chunked (batch_stats_pallas) and whole-sequence
+    (_seq_stats_core) consumers."""
+    K, S = params.n_states, params.n_symbols
+    trans = A * jnp.sum(macc, axis=1).reshape(K, K)
+    iS = jnp.arange(S)
+    emit = (
+        jnp.zeros((K, S), jnp.float32)
+        .at[gt[:, 0], iS].add(jnp.sum(emit_red[0::2], axis=1))
+        .at[gt[:, 1], iS].add(jnp.sum(emit_red[1::2], axis=1))
+    )
+    return trans, emit, jnp.sum(ll)
+
+
+def _gamma0_full(al2, b2, gt, esym2, K):
+    """Dense gamma at within-lane position 0 from the reduced streams."""
+    from cpgisland_tpu.ops import fb_onehot
+
+    g02 = al2[0] * b2[0]  # [GROUP, NL]
+    gamma02 = g02 / jnp.maximum(jnp.sum(g02, axis=0, keepdims=True), 1e-30)
+    return fb_onehot.scatter_streams(gamma02[None], gt, esym2[0:1], K)[0]
 
 
 def _pair_stream_for_stats(params, sel2):
@@ -881,6 +902,7 @@ def _lane_streams(
     conf_mask=None,
     onehot: bool = False,
     prev_sym=None,
+    return_reduced: bool = False,
 ):
     """Shared lane setup for the fused whole-sequence paths: lane transfer
     products -> boundary messages -> forward/backward kernel streams.
@@ -1008,6 +1030,15 @@ def _lane_streams(
             lane_T, conf_mask=conf_mask,
         )
         gt = fb_onehot._groups(params)
+        if return_reduced and conf_mask is None:
+            # Raw reduced streams for the seq-stats kernel consumer (the
+            # pair stream recomputes with identical args — CSE'd in-jit
+            # with the FB runner's internal one).
+            from cpgisland_tpu.ops.viterbi_onehot import _pair_stream
+
+            pair2, e_in_l, _ = _pair_stream(params, sel_l.T, prev_dev)
+            reduced = (al2, third2, esym2, pair2, e_in_l, gt)
+            return reduced, cs, None, steps2, lens2, enters, is_first, Tt
         alphas = fb_onehot.scatter_streams(al2, gt, esym2, K)
         third = (
             third2 if conf_mask is not None
@@ -1046,10 +1077,47 @@ def _seq_stats_core(
     B = jnp.exp(params.log_B).astype(jnp.float32)
     length = jnp.asarray(length, jnp.int32)
 
-    alphas, cs, betas, steps2, lens2, enters, is_first, _ = _lane_streams(
-        params, obs, length, lane_T, t_tile, axis, onehot=onehot
+    use_kernel_stats = (
+        onehot and not _interpret() and S & (S - 1) == 0
+    )
+    alphas, cs, betas, steps2, lens2, enters, is_first, Tt_used = _lane_streams(
+        params, obs, length, lane_T, t_tile, axis, onehot=onehot,
+        return_reduced=use_kernel_stats,
     )
     NL = steps2.shape[1]
+    if use_kernel_stats:
+        # Reduced-stream seq stats kernel (z-normalized scale-free xi; the
+        # scatter + XLA assembly below is its off-TPU twin).
+        from cpgisland_tpu.ops import fb_onehot
+
+        al2, b2, esym2, pair2, e_in_l, gt = alphas
+        enters_red = jnp.take_along_axis(enters, gt[e_in_l], axis=1)  # [NL,2]
+        ent_full = fb_onehot.scatter_streams(
+            enters_red.T[None], gt, e_in_l[None, :], K
+        )[0]  # [K, NL]
+        pair0_mask = (
+            ~((jnp.arange(NL) == 0) & is_first)
+        ).astype(jnp.float32)[None, :]
+        macc, emit_red, ll = fb_onehot.run_seq_stats_onehot(
+            params, al2, b2, pair2, lens2, gt, enters_red.T, ent_full,
+            pair0_mask, Tt_used,
+        )
+        trans, emit, loglik = _assemble_reduced_stats(
+            params, A, gt, macc, emit_red, ll
+        )
+        g0f = _gamma0_full(al2, b2, gt, esym2, K)
+        at_init = is_first & (length > 0)
+        init = jnp.where(at_init, g0f[:, 0], jnp.zeros(K))
+        stats = SuffStats(
+            init=init,
+            trans=trans,
+            emit=emit,
+            loglik=loglik,
+            n_seqs=at_init.astype(jnp.int32),
+        )
+        if axis is not None and reduce:
+            stats = jax.lax.psum(stats, axis)
+        return stats
 
     # --- scale-free assembly ---------------------------------------------
     Tp = steps2.shape[0]

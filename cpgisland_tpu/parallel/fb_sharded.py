@@ -456,7 +456,9 @@ def _shard_stats2d_body(
                 lane_T
                 if lane_T is not None
                 else fb_pallas.pick_lane_T(
-                    obs_tile.shape[1], onehot=engine == "onehot"
+                    obs_tile.shape[1], onehot=engine == "onehot",
+                    long_lanes=engine == "onehot"
+                    and params.n_symbols & (params.n_symbols - 1) == 0,
                 )
             )
             tt = t_tile if t_tile is not None else fb_pallas.DEFAULT_T_TILE

@@ -260,7 +260,11 @@ def posterior_sharded(
         lane_T
         if lane_T is not None
         else fb_pallas.pick_lane_T(
-            arr.shape[0] // mesh.shape[mesh.axis_names[0]], onehot=eng == "onehot"
+            arr.shape[0] // mesh.shape[mesh.axis_names[0]], onehot=eng == "onehot",
+            # The conf kernel path handles long lanes; the want_path branch
+            # runs XLA passes over scattered [Tp, K, NL] streams, which do
+            # not compile at 131072.
+            long_lanes=eng == "onehot" and not want_path,
         )
     )
     mask = jnp.asarray(island_mask(params, island_states))

@@ -439,10 +439,15 @@ class SeqBackend(EStepBackend):
         # engine always wins.
         if _use_fused_seq(self.engine, params, obs_flat.shape[0] // n_dev):
             oh = _seq_onehot(self.engine, params)
+            # 131072 lanes are safe only when the kernelized seq stats runs
+            # (power-of-two n_symbols — n_symbols is static shape info).
+            long_ok = oh and params.n_symbols & (params.n_symbols - 1) == 0
             lane_T = (
                 self.lane_T
                 if self.lane_T is not None
-                else fb_pallas.pick_lane_T(obs_flat.shape[0] // n_dev, onehot=oh)
+                else fb_pallas.pick_lane_T(
+                    obs_flat.shape[0] // n_dev, onehot=oh, long_lanes=long_ok
+                )
             )
             if n_dev == 1:
                 return fb_pallas.seq_stats_pallas(

@@ -125,13 +125,17 @@ def test_seq_stats_parity(rng):
         params, obs, obs.shape[0], lane_T=4096, onehot=True
     )
     np.testing.assert_allclose(np.asarray(s_d.init), np.asarray(s_o.init), atol=1e-5)
+    # 5e-5 rel: on TPU the reduced path's stats come from the in-kernel
+    # two-level summation while the dense path reduces via XLA einsums —
+    # different f32 accumulation orders over T terms (and ~2e-5-rel TPU
+    # transcendentals) put agreement at tolerance level, not bit level.
     np.testing.assert_allclose(
-        np.asarray(s_d.trans), np.asarray(s_o.trans), rtol=1e-5, atol=1e-3
+        np.asarray(s_d.trans), np.asarray(s_o.trans), rtol=5e-5, atol=1e-3
     )
     np.testing.assert_allclose(
-        np.asarray(s_d.emit), np.asarray(s_o.emit), rtol=1e-5, atol=1e-3
+        np.asarray(s_d.emit), np.asarray(s_o.emit), rtol=5e-5, atol=1e-3
     )
-    assert float(s_d.loglik) == pytest.approx(float(s_o.loglik), rel=1e-6)
+    assert float(s_d.loglik) == pytest.approx(float(s_o.loglik), rel=1e-5)
 
 
 def test_seq_backend_onehot(rng):
@@ -217,23 +221,31 @@ def test_pick_lane_T_onehot_cost_model():
         pick_lane_T,
     )
 
-    assert max(_LANE_RATE_ONEHOT) <= 65536  # exact-EM compile ceiling
     assert pick_lane_T(1, onehot=True) == 8192
     # exactly full grids pick the long lanes
     assert pick_lane_T(65536 * LANE_TILE, onehot=True) == 65536
-    assert pick_lane_T(128 << 20, onehot=True) == 65536
+    # the 131072 entry needs the explicit long_lanes opt-in: it is safe only
+    # for paths that stay on reduced kernels end to end — the XLA
+    # assemblies over [Tp, K, NL] streams fail to remote-compile there.
+    assert pick_lane_T(131072 * LANE_TILE, onehot=True) == 65536
+    assert pick_lane_T(131072 * LANE_TILE, onehot=True, long_lanes=True) == 131072
     # one symbol past a full grid must fall back to a less padded choice
     assert pick_lane_T(65536 * LANE_TILE + 1, onehot=True) != 65536
     # the pick is always the argmin of the explicit cost model
-    for n in (1, 1000, 1 << 20, 2 << 20, (2 << 20) + 1, 8 << 20,
-              (8 << 20) + 1, 48 << 20, 64 << 20, 128 << 20):
-        def cost(lt):
-            n_lanes = (n + lt - 1) // lt
-            grid = (n_lanes + LANE_TILE - 1) // LANE_TILE * LANE_TILE
-            return grid * lt / _LANE_RATE_ONEHOT[lt]
-        picked = pick_lane_T(n, onehot=True)
-        best = min(_LANE_RATE_ONEHOT, key=cost)
-        assert cost(picked) <= cost(best) * (1 + 1e-9), (n, picked, best)
+    for long_lanes in (False, True):
+        table = {
+            k: v for k, v in _LANE_RATE_ONEHOT.items()
+            if long_lanes or k <= 65536
+        }
+        for n in (1, 1000, 1 << 20, 2 << 20, (2 << 20) + 1, 8 << 20,
+                  (8 << 20) + 1, 48 << 20, 64 << 20, 128 << 20):
+            def cost(lt):
+                n_lanes = (n + lt - 1) // lt
+                grid = (n_lanes + LANE_TILE - 1) // LANE_TILE * LANE_TILE
+                return grid * lt / table[lt]
+            picked = pick_lane_T(n, onehot=True, long_lanes=long_lanes)
+            best = min(table, key=cost)
+            assert cost(picked) <= cost(best) * (1 + 1e-9), (n, picked, best)
 
 
 def test_batch_stats_parity(rng):
