@@ -125,13 +125,49 @@ def sample_sequence(params: HmmParams, key, length: int):
     return states.astype(jnp.int32), obs.astype(jnp.uint8)
 
 
+def java_double_str(d: float) -> str:
+    """Format ``d`` exactly as Java ``Double.toString(double)`` would.
+
+    The reference's model dump concatenates Double.toString values
+    (CpGIslandFinder.java:209-222), whose grammar differs from Python repr:
+    decimal form iff 1e-3 <= |d| < 1e7, otherwise ``d.dddE±x`` scientific
+    notation with an unpadded exponent and no '+' (so 2.5e-4 prints
+    "2.5E-4", not "0.00025"); a fraction part is always present ("1.0",
+    "1.0E7").  Digits are the shortest sequence that round-trips — Python
+    repr's contract, which matches Double.toString as specified (and as
+    implemented exactly since JDK 19's Ryu rewrite).
+    """
+    import math
+    from decimal import Decimal
+
+    if math.isnan(d):
+        return "NaN"
+    if math.isinf(d):
+        return "Infinity" if d > 0 else "-Infinity"
+    sign = "-" if math.copysign(1.0, d) < 0 else ""
+    if d == 0.0:
+        return sign + "0.0"
+    _, digits, exp = Decimal(repr(abs(d))).as_tuple()
+    ds = "".join(map(str, digits)).rstrip("0") or "0"
+    E = len(digits) + exp - 1  # value = ds[0].ds[1:] * 10**E
+    if -3 <= E <= 6:
+        if E < 0:
+            return sign + "0." + "0" * (-E - 1) + ds
+        ip = ds[: E + 1].ljust(E + 1, "0")
+        return sign + ip + "." + (ds[E + 1 :] or "0")
+    return sign + ds[0] + "." + (ds[1:] or "0") + "E" + str(E)
+
+
 def dump_text(params: HmmParams, fp: Union[str, IO[str]]) -> None:
-    """Write the reference's plain-text model dump.
+    """Write the reference's plain-text model dump, byte-identical.
 
     Layout (CpGIslandFinder.java:207-224): for each hidden state i, three lines —
     pi(i); the 8 transition probs A[i, :] space-separated with a trailing space;
-    the 4 emission probs B[i, :] likewise.  Numbers use repr-style shortest float
-    formatting like Java's ``Double.toString``.
+    the 4 emission probs B[i, :] likewise.  Numbers are formatted with
+    :func:`java_double_str` (Java ``Double.toString`` semantics — the
+    reference writes `Double.toString(model.get(i, j))` values, and trained
+    cross-block leakage probs fall below 1e-3 where Java switches to
+    scientific notation).
     """
     own = isinstance(fp, str)
     f = open(fp, "w") if own else fp
@@ -140,11 +176,11 @@ def dump_text(params: HmmParams, fp: Union[str, IO[str]]) -> None:
         A = np.asarray(params.A, dtype=np.float64)
         B = np.asarray(params.B, dtype=np.float64)
         for i in range(params.n_states):
-            f.write(repr(float(pi[i])))
+            f.write(java_double_str(float(pi[i])))
             f.write("\n")
-            f.write("".join(repr(float(v)) + " " for v in A[i]))
+            f.write("".join(java_double_str(float(v)) + " " for v in A[i]))
             f.write("\n")
-            f.write("".join(repr(float(v)) + " " for v in B[i]))
+            f.write("".join(java_double_str(float(v)) + " " for v in B[i]))
             f.write("\n")
     finally:
         if own:

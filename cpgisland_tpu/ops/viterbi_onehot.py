@@ -173,12 +173,16 @@ def device_entry_sym(obs_c: jnp.ndarray, pad_sym: int, axis: str,
     conditioned on the entering symbol's state group."""
     L = obs_c.shape[0]
     iota = jnp.arange(L, dtype=jnp.int32)
-    keyloc = jnp.max(jnp.where(obs_c < pad_sym, iota * pad_sym + obs_c, -1))
-    keys = jax.lax.all_gather(keyloc, axis)  # [D] scalars
-    didx = jnp.arange(keys.shape[0], dtype=jnp.int32)
+    # Position and symbol tracked SEPARATELY: a combined iota*S+sym int32
+    # key silently overflows for shards past 2**31/S (~537 Mi) symbols.
+    pos = jnp.max(jnp.where(obs_c < pad_sym, iota, -1))
+    symloc = jnp.where(
+        pos >= 0, obs_c[jnp.maximum(pos, 0)].astype(jnp.int32), -1
+    )
+    syms = jax.lax.all_gather(symloc, axis)  # [D] scalars, -1 = all-PAD shard
+    didx = jnp.arange(syms.shape[0], dtype=jnp.int32)
     d = jax.lax.axis_index(axis)
-    sym = keys - (keys // pad_sym) * pad_sym
-    gkey = jnp.where((didx < d) & (keys >= 0), didx * (pad_sym + 1) + sym, -1)
+    gkey = jnp.where((didx < d) & (syms >= 0), didx * (pad_sym + 1) + syms, -1)
     m = jnp.max(gkey)
     return jnp.where(
         m >= 0, m - (m // (pad_sym + 1)) * (pad_sym + 1), prev0

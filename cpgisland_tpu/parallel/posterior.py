@@ -187,6 +187,25 @@ def island_mask(params: HmmParams, island_states) -> np.ndarray:
     return mask
 
 
+def _prev_sym_arg(engine: str, first: bool, prev_sym) -> jnp.ndarray:
+    """Validate/convert the public wrappers' ``prev_sym`` argument.
+
+    The reduced onehot kernels condition a continuation span's entry group
+    on the symbol BEFORE the span; a caller who forgets it would get
+    silently wrong (clamped-seed) conditioning, so onehot + first=False +
+    None raises here — the in-kernel _lane_streams check cannot fire once a
+    wrapper has already converted None to an array.
+    """
+    if prev_sym is None:
+        if not first and engine == "onehot":
+            raise ValueError(
+                "onehot continuation spans (first=False) need prev_sym — "
+                "the symbol immediately before this span"
+            )
+        return jnp.int32(0)
+    return jnp.asarray(prev_sym, jnp.int32)
+
+
 def place_record_span(
     params: HmmParams,
     piece,
@@ -227,7 +246,7 @@ def posterior_sharded(
     return_device: bool = False,
     pad_to: Optional[int] = None,
     placed=None,
-    prev_sym: int = 0,
+    prev_sym: Optional[int] = None,
 ):
     """Island confidence (and optional MPM path) for one sequence, sharded
     along time over the mesh.
@@ -278,7 +297,7 @@ def posterior_sharded(
     )
     fn = _posterior_fn(mesh, block_size, eng, first, want_path, lt, tt)
     conf, path = fn(
-        params, arr, lens, mask, enter, exit_, jnp.int32(prev_sym)
+        params, arr, lens, mask, enter, exit_, _prev_sym_arg(eng, first, prev_sym)
     )
     conf = fetch_sharded_prefix(conf, T, return_device)
     path = fetch_sharded_prefix(path, T, return_device) if want_path else None
@@ -295,13 +314,14 @@ def transfer_total_sharded(
     first: bool = True,
     pad_to: Optional[int] = None,
     placed=None,
-    prev_sym: int = 0,
+    prev_sym: Optional[int] = None,
 ) -> np.ndarray:
     """One span's normalized [K, K] probability-space transfer operator
     (sweep A of span-threaded posterior processing).  ``placed`` (from
     place_record_span) reuses an already-uploaded span; ``obs`` then only
-    supplies the true length.  ``prev_sym``: the symbol before the span
-    (consumed by the reduced onehot kernels on continuation spans)."""
+    supplies the true length.  ``prev_sym``: the symbol before the span —
+    REQUIRED for onehot continuation spans (first=False), where it
+    conditions the reduced chain's entry group."""
     if mesh is None:
         mesh = make_mesh(axis=SEQ_AXIS)
     n_dev = mesh.shape[mesh.axis_names[0]]
@@ -310,7 +330,7 @@ def transfer_total_sharded(
         # Single-chip TPU: the products Pallas kernel is much faster than
         # the XLA lane scan for this sweep.
         oh = eng == "onehot"
-        ps = jnp.int32(prev_sym)
+        ps = _prev_sym_arg(eng, first, prev_sym)
         if placed is not None:
             return np.asarray(
                 fb_pallas.seq_transfer_total_pallas(

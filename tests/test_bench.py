@@ -53,6 +53,20 @@ def test_plausibility_ceiling():
         bench._check_plausible(1e12, "x")
 
 
+def test_per_path_plausibility_ceiling():
+    """VERDICT r4 #6: every benched path has a tight ceiling (2.5x its
+    enforced BASELINE.md figure) so a phantom 5x inflation raises."""
+    ceilings = bench._path_ceilings()
+    for path in bench._BASELINE_KEY_BY_PATH:
+        assert path in ceilings, f"no BASELINE.md marker resolved for {path}"
+        # Tighter than the global net, looser than the published figure.
+        assert ceilings[path] < bench.PLAUSIBLE_MAX_SYM_PER_S
+        enforced = ceilings[path] / bench.PATH_CEILING_FACTOR
+        with pytest.raises(RuntimeError, match="phantom"):
+            bench._check_plausible(5.0 * enforced, path)
+        assert bench._check_plausible(1.2 * enforced, path) == 1.2 * enforced
+
+
 def test_capture_paths_newest_round(tmp_path):
     import pubnum
 

@@ -74,6 +74,68 @@ def test_text_dump_roundtrip(tmp_path):
     assert len(lines[1].split()) == 8 and len(lines[2].split()) == 4
 
 
+def test_java_double_str_golden():
+    """Java Double.toString semantics, golden values (VERDICT r4 #7).
+
+    The sub-1e-3 cases are the load-bearing ones: trained cross-block
+    leakage probs sit exactly in the range where Java switches to
+    scientific notation and Python repr does not.
+    """
+    from cpgisland_tpu.models.hmm import java_double_str
+
+    cases = [  # pairs, not a dict: 0.0 and -0.0 are equal as dict keys
+        (0.0, "0.0"),
+        (-0.0, "-0.0"),
+        (1.0, "1.0"),
+        (-1.0, "-1.0"),
+        (0.05, "0.05"),
+        (0.2, "0.2"),
+        (0.001, "0.001"),  # boundary: still decimal form
+        (0.00025, "2.5E-4"),  # the reference's leakage-prob range
+        (0.0009999, "9.999E-4"),
+        (2.5e-7, "2.5E-7"),
+        (1.25e-10, "1.25E-10"),
+        (123.456, "123.456"),
+        (100.0, "100.0"),
+        (9999999.0, "9999999.0"),  # boundary: < 1e7 stays decimal
+        (1e7, "1.0E7"),
+        (1.5e300, "1.5E300"),
+        (float("inf"), "Infinity"),
+        (float("-inf"), "-Infinity"),
+        (float("nan"), "NaN"),
+        (0.9765624999999999, "0.9765624999999999"),  # shortest round-trip
+    ]
+    for v, want in cases:
+        assert java_double_str(v) == want, (v, java_double_str(v), want)
+    # Every formatted value must parse back exactly (load_text round-trip).
+    for v, _ in cases:
+        s = java_double_str(v)
+        if s != "NaN":
+            assert float(s) == v
+
+
+def test_dump_text_sub_milli_scientific(tmp_path):
+    """A model with probs in Double.toString's scientific range dumps them
+    as Java would (d.dddE-4 scientific, never 0.000ddd) and round-trips.
+    String asserts are format-level, not digit-level — the f32 parameter
+    pipeline (exp∘log) perturbs 0.00025 by ~1 ulp before formatting."""
+    pi = np.asarray([0.99975, 0.00025])
+    A = np.asarray([[0.99975, 0.00025], [0.00025, 0.99975]])
+    B = np.asarray([[0.9995, 0.0005, 0.0, 0.0], [0.0, 0.0, 0.5, 0.5]])
+    m = HmmParams.from_probs(pi, A, B)
+    p = tmp_path / "m.txt"
+    dump_text(m, str(p))
+    tokens = [t for line in p.read_text().splitlines() for t in line.split()]
+    sub_milli = [t for t in tokens if 0 < float(t) < 1e-3]
+    assert len(sub_milli) >= 3  # the 2.5e-4 / 5e-4 entries
+    for t in sub_milli:
+        assert "E-" in t, f"sub-1e-3 value {t!r} not in Java scientific form"
+    for t in tokens:
+        assert "e" not in t, f"{t!r} uses Python-style lowercase exponent"
+    m2 = load_text(str(p))
+    np.testing.assert_allclose(np.asarray(m2.A), A, atol=1e-6)
+
+
 def test_dump_text_accepts_file_object():
     buf = io.StringIO()
     dump_text(presets.two_state_cpg(), buf)
