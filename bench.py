@@ -867,6 +867,187 @@ def bench_end_to_end(n_mbases: int, engine: str = "auto") -> dict:
     return stats
 
 
+def _achieved_score(params, obs: np.ndarray, path: np.ndarray) -> float:
+    """f64 host re-scoring of a decoded path (no PADs in bench inputs):
+    log pi(s0) + log B(s0,o0) + sum_t log A(s_{t-1},s_t) + log B(s_t,o_t)."""
+    lp = np.asarray(params.log_pi, np.float64)
+    lA = np.asarray(params.log_A, np.float64)
+    lB = np.asarray(params.log_B, np.float64)
+    s = lp[path[0]] + lB[path[0], obs[0]]
+    return float(s + (lA[path[:-1], path[1:]] + lB[path[1:], obs[1:]]).sum())
+
+
+def bench_parity(n_mib: int = 4) -> dict:
+    """On-chip dense-vs-reduced certification gate (VERDICT r4 #1a).
+
+    The reduced onehot kernels are TPU-only lowerings: the CPU suite runs
+    their XLA scan twins, so until this gate the numbers captured on the
+    chip were produced by kernels whose on-chip correctness no artifact
+    attested.  This phase runs BOTH lowerings on the same few-MiB inputs on
+    whatever backend the bench runs on and asserts:
+
+    - decode: exact path equality on a tie-free one-hot model, and on the
+      flagship Durbin model the pinned tie contract (scores to ~1e-6
+      relative; any path mismatch must re-score f64-identically — ties);
+    - posterior: island-confidence allclose (atol 5e-5);
+    - EM: chunked E-step SuffStats and the whole-sequence (z-normalized)
+      stats kernel allclose against the dense kernels.
+
+    Raises on any violation (the orchestrator records only clean passes);
+    returns the measured deltas for the captured artifact.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from cpgisland_tpu.models import presets
+    from cpgisland_tpu.models.hmm import HmmParams
+    from cpgisland_tpu.ops import fb_pallas
+    from cpgisland_tpu.ops.viterbi_parallel import viterbi_parallel
+    from cpgisland_tpu.train.backends import LocalBackend
+
+    on_tpu = jax.default_backend() == "tpu"
+    # Off-TPU the dense DECODE twin is the XLA engine: the Pallas viterbi
+    # kernels' select-derived backpointer chains are pathologically slow
+    # under the interpreter (CLAUDE.md).  The dense FB cores below
+    # (conf(False)/seq_stats(False)) DO run interpreted off-TPU — measured
+    # tolerable (~1 min total at the 1 MiB CPU gate size), and on TPU
+    # (where this gate matters) everything runs the real kernels.
+    dense_dec, dense_fb = ("pallas", "pallas") if on_tpu else ("xla", "xla")
+    n = n_mib << 20
+    rng = np.random.default_rng(11)
+    obs = rng.integers(0, 4, size=n, dtype=np.int32)
+    obs_j = jnp.asarray(obs)
+    out: dict = {"n_mib": n_mib, "backend": jax.default_backend()}
+
+    # --- decode, tie-free model: paths must be EXACTLY equal.
+    pi8 = rng.dirichlet(np.ones(8))
+    A8 = rng.dirichlet(np.ones(8), size=8)
+    A8 = A8 * np.exp(rng.normal(scale=1e-3, size=A8.shape))  # break ties
+    A8 = A8 / A8.sum(axis=1, keepdims=True)
+    B8 = np.zeros((8, 4))
+    B8[np.arange(8), np.arange(8) % 4] = 1.0
+    tie_free = HmmParams.from_probs(pi8, A8, B8)
+
+    def paths(params, eng):
+        fn = jax.jit(
+            lambda o: viterbi_parallel(params, o, return_score=True, engine=eng)
+        )
+        path, score = fn(obs_j)
+        return np.asarray(path), float(score)
+
+    def check_decode(params, what):
+        """The pinned engine contract (PARITY.md C10): scores to ~1e-6
+        relative, and any path mismatch must be a rounding tie — both paths
+        re-score f64-identically.  (Even the perturbed tie-free model can
+        produce f32 NEAR-ties at the ~1e-7 normalizer-rounding level on
+        multi-Mi inputs, so the tie escape applies to both models — a
+        deterministic benign tie must not abort the whole capture.)"""
+        p_d, s_d = paths(params, dense_dec)
+        p_o, s_o = paths(params, "onehot")
+        rel = abs(s_o - s_d) / max(abs(s_d), 1.0)
+        mism = int((p_d != p_o).sum())
+        if rel > 2e-6:
+            raise AssertionError(f"parity-gate decode({what}): score rel {rel:.2e}")
+        if mism:
+            a_d = _achieved_score(params, obs, p_d)
+            a_o = _achieved_score(params, obs, p_o)
+            if abs(a_d - a_o) > 1e-6 * abs(a_d):
+                raise AssertionError(
+                    f"parity-gate decode({what}): {mism} mismatches NOT ties "
+                    f"(f64 re-scores {a_d:.6f} vs {a_o:.6f})"
+                )
+        out[f"decode_{what}_mismatches"] = mism
+        out[f"decode_{what}_score_reldiff"] = rel
+
+    check_decode(tie_free, "tiefree")
+
+    # --- decode, flagship model (the one the published numbers run).
+    flag = presets.durbin_cpg8()
+    check_decode(flag, "flagship")
+
+    # --- posterior confidence.
+    mask = jnp.asarray((np.arange(8) < 4).astype(np.float32))
+    obs_u8 = jnp.asarray(obs[: n // 2].astype(np.uint8))
+
+    def conf(onehot):
+        lt = fb_pallas.pick_lane_T(
+            obs_u8.shape[0], onehot=onehot, long_lanes=onehot
+        )
+        fn = jax.jit(
+            lambda o: fb_pallas._seq_posterior_core(
+                flag, o, o.shape[0], mask, lt, fb_pallas.DEFAULT_T_TILE,
+                axis=None, onehot=onehot,
+            )[0]
+        )
+        return np.asarray(fn(obs_u8))
+
+    c_d = conf(False)
+    c_o = conf(True)
+    conf_max = float(np.abs(c_d - c_o).max())
+    if conf_max > 5e-5:
+        raise AssertionError(f"parity-gate posterior: max conf diff {conf_max:.2e}")
+    out["posterior_conf_maxdiff"] = conf_max
+
+    # --- EM chunked E-step stats.
+    n_chunks = 64 if on_tpu else 8
+    chunks = jnp.asarray(
+        rng.integers(0, 4, size=(n_chunks, 0x10000), dtype=np.int32).astype(np.uint8)
+    )
+    lengths = jnp.full(n_chunks, 0x10000, dtype=jnp.int32)
+
+    def em_stats(eng):
+        backend = LocalBackend(mode="rescaled", engine=eng)
+        st = jax.jit(lambda c, l: backend(flag, c, l))(chunks, lengths)
+        return jax.tree_util.tree_map(np.asarray, st)
+
+    st_d = em_stats(dense_fb)
+    st_o = em_stats("onehot")
+    out["em_stats_maxrel"] = _stats_maxrel(st_d, st_o, "em chunked")
+
+    # --- EXACT whole-sequence stats (the z-normalized kernel path).
+    seq_obs = jnp.asarray(obs[: n // 2].astype(np.uint8))
+
+    def seq_stats(onehot):
+        lt = fb_pallas.pick_lane_T(
+            seq_obs.shape[0], onehot=onehot, long_lanes=onehot
+        )
+        st = jax.jit(
+            lambda o: fb_pallas.seq_stats_pallas(
+                flag, o, o.shape[0], lane_T=lt, onehot=onehot
+            )
+        )(seq_obs)
+        return jax.tree_util.tree_map(np.asarray, st)
+
+    if on_tpu or fb_pallas.supports(flag):
+        sq_d = seq_stats(False)
+        sq_o = seq_stats(True)
+        out["em_seq_stats_maxrel"] = _stats_maxrel(sq_d, sq_o, "em seq")
+
+    log(
+        "parity-gate: OK — dense and reduced lowerings agree on this "
+        f"backend ({jax.default_backend()}): " + json.dumps(out)
+    )
+    return out
+
+
+def _stats_maxrel(st_d, st_o, what: str) -> float:
+    """Max relative difference across SuffStats count tensors + loglik;
+    raises past tolerance (counts: different f32 accumulation orders over
+    millions of terms put agreement at ~1e-4 rel, not bit level)."""
+    worst = 0.0
+    for name in ("init", "trans", "emit"):
+        a, b = getattr(st_d, name), getattr(st_o, name)
+        denom = np.maximum(np.abs(a), 1e-2 * max(float(np.abs(a).max()), 1e-9))
+        worst = max(worst, float((np.abs(a - b) / denom).max()))
+    ll_rel = abs(float(st_d.loglik) - float(st_o.loglik)) / max(
+        abs(float(st_d.loglik)), 1.0
+    )
+    worst = max(worst, ll_rel)
+    if worst > 2e-3:
+        raise AssertionError(f"parity-gate {what}: stats max rel diff {worst:.2e}")
+    return worst
+
+
 def validate_sharded_paths() -> None:
     """Run the sharded E-step configs on whatever devices exist and check the
     linear-scaling assumption structurally: count the collectives in the
@@ -972,7 +1153,7 @@ def main() -> int:
     ap.add_argument(
         "--phase",
         default=None,
-        choices=("core", "ext1", "ext2", "ext3"),
+        choices=("parity", "core", "ext1", "ext2", "ext3"),
         help="internal: run ONE capture phase and print its results as JSON "
         "(the --extended parent orchestrates phases as subprocesses — the "
         "relay tunnel degrades into phantom ~0 ms results after ~15 min of "
@@ -1001,6 +1182,11 @@ def main() -> int:
     on_tpu = jax.default_backend() == "tpu"
     if args.decode_mib is None:
         args.decode_mib = 256 if on_tpu else 16
+
+    if args.phase == "parity":
+        out = bench_parity(4 if on_tpu else 1)
+        print(json.dumps({"parity": out}))
+        return 0
 
     if args.phase in (None, "core"):
         decode_tput = bench_decode(args.decode_mib * (1 << 20), engine=args.engine)
@@ -1122,7 +1308,9 @@ def _orchestrate(args) -> int:
         base += ["--e2e-mbases", str(args.e2e_mbases)]
     carry: dict = {}
     results: dict = {}
-    for phase in ("core", "ext1", "ext2", "ext3"):
+    # parity runs FIRST: the capture certifies the reduced kernels' on-chip
+    # correctness before publishing any number they produce (VERDICT r4 #1).
+    for phase in ("parity", "core", "ext1", "ext2", "ext3"):
         for attempt in range(3):
             # NO subprocess timeout: killing a child mid-TPU-execution
             # wedges the relay's tunnel claim (CLAUDE.md) — a hung phase is
@@ -1201,6 +1389,7 @@ def _orchestrate(args) -> int:
         "host_encode_vs_8chip_decode": round(
             e2e.get("encode_msym_per_s", 0.0) * 1e6 / (decode_tput * N_CHIPS), 2
         ),
+        "parity_gate": results["parity"]["parity"],
     }
     log("extended: " + json.dumps(extras))
     _print_northstar(decode_tput, em_tput)
