@@ -83,15 +83,15 @@ def _path_ceilings() -> dict:
 
             with open(os.path.join(root, "BASELINE.md")) as f:
                 nums = dict(pubnum._NUM_RE.findall(f.read()))
-        except (OSError, ImportError):
-            nums = {}  # degrade to the global net, don't sink the bench
+            _PATH_CEILINGS = {
+                path: PATH_CEILING_FACTOR * float(nums[key]) * 1e6
+                for path, key in _BASELINE_KEY_BY_PATH.items()
+                if key in nums
+            }
+        except (OSError, ImportError, ValueError):
+            _PATH_CEILINGS = {}  # degrade to the global net, don't sink the bench
         finally:
             sys.path.pop(0)
-        _PATH_CEILINGS = {
-            path: PATH_CEILING_FACTOR * float(nums[key]) * 1e6
-            for path, key in _BASELINE_KEY_BY_PATH.items()
-            if key in nums
-        }
     return _PATH_CEILINGS
 
 
@@ -1005,7 +1005,9 @@ def bench_parity(n_mib: int = 4) -> dict:
     out["em_stats_maxrel"] = _stats_maxrel(st_d, st_o, "em chunked")
 
     # --- EXACT whole-sequence stats (the z-normalized kernel path).
-    seq_obs = jnp.asarray(obs[: n // 2].astype(np.uint8))
+    # Reuses the posterior section's device-resident array: the relay's
+    # host->device upload is slow enough that a duplicate upload matters.
+    seq_obs = obs_u8
 
     def seq_stats(onehot):
         lt = fb_pallas.pick_lane_T(

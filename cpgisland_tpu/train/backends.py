@@ -582,8 +582,13 @@ class Seq2DBackend(EStepBackend):
         )
         # The XLA body ignores the kernel tile knobs — normalize them out of
         # the compile-cache key so differently-tuned backends share one
-        # compiled program.
-        lane_T, t_tile = (self.lane_T, self.t_tile) if engine == "pallas" else (None, None)
+        # compiled program.  (Both fused engines consume them; r4 dropped
+        # them for 'onehot', making the seq2d tile knobs untunable.)
+        lane_T, t_tile = (
+            (self.lane_T, self.t_tile)
+            if engine in ("pallas", "onehot")
+            else (None, None)
+        )
         fn = fb_sharded.sharded_stats2d_fn(
             mesh, self.block_size, engine, lane_T, t_tile
         )

@@ -133,7 +133,10 @@ def test_dump_text_sub_milli_scientific(tmp_path):
     for t in tokens:
         assert "e" not in t, f"{t!r} uses Python-style lowercase exponent"
     m2 = load_text(str(p))
-    np.testing.assert_allclose(np.asarray(m2.A), A, atol=1e-6)
+    from conftest import tpu_atol
+
+    # exp(log(.)) round trip: tight on CPU, ~2e-5 relative on TPU.
+    np.testing.assert_allclose(np.asarray(m2.A), A, atol=tpu_atol(1e-6, 5e-5))
 
 
 def test_dump_text_accepts_file_object():

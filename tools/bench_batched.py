@@ -1,0 +1,123 @@
+"""Diagnose the batched-decode gap (VERDICT r4 #2): 923 vs 1987.6 Msym/s.
+
+Sweeps the knobs that differ between the batched (16 x 4 MiB vmap) and
+single-stream (1 x 256 MiB) configs, on the same total symbol count:
+
+  - block_size: the batched path inherits DEFAULT_BLOCK=4096; per record
+    that is 1024 blocks whose [K,K] stitching scans are vmapped 16x.
+  - batch geometry: 16 x 4 MiB vs 4 x 16 MiB vs 64 x 1 MiB at fixed total.
+  - single-stream reference at the same 64 MiB total.
+
+Prints Msym/s per config (chained timing, distinct seeds, fetch-per-rep —
+the bench.py phantom defenses).
+
+Usage: python tools/bench_batched.py [--platform auto] [--engine onehot]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--platform", default="auto")
+    ap.add_argument("--engine", default="auto")
+    ap.add_argument("--chain", type=int, default=6)
+    args = ap.parse_args()
+
+    import jax
+
+    if args.platform != "auto":
+        jax.config.update("jax_platforms", args.platform)
+    import jax.numpy as jnp
+
+    from cpgisland_tpu.models import presets
+    from cpgisland_tpu.ops.viterbi_parallel import (
+        viterbi_parallel,
+        viterbi_parallel_batch,
+    )
+    from cpgisland_tpu.parallel.decode import resolve_engine
+
+    on_tpu = jax.default_backend() == "tpu"
+    print(f"devices: {jax.devices()}", file=sys.stderr)
+    params = presets.durbin_cpg8()
+    eng = resolve_engine(args.engine, params)
+    total = (64 << 20) if on_tpu else (2 << 20)
+    rng = np.random.default_rng(2)
+    stream = rng.integers(0, 4, size=total, dtype=np.int32)
+
+    def timed(fn, arg, n_sym, name, chain):
+        @jax.jit
+        def chained(c, x):
+            def body(c, _):
+                out = fn(x, c)
+                return jnp.min(out).astype(jnp.int32), None
+
+            c, _ = jax.lax.scan(body, c, None, length=chain)
+            return c
+
+        jax.block_until_ready(chained(jnp.int32(0), arg))
+        best = float("inf")
+        s, done, phantoms = 1, 0, 0
+        while done < 3:
+            t0 = time.perf_counter()
+            int(jax.device_get(chained(jnp.int32(s), arg)))
+            dt = time.perf_counter() - t0
+            s += 1
+            if dt < 1e-4:
+                phantoms += 1
+                if phantoms > 4:
+                    raise RuntimeError("persistent phantom timings")
+                continue
+            best = min(best, dt)
+            done += 1
+        best /= chain
+        rate = n_sym / best
+        print(f"{name}: {rate/1e6:.1f} Msym/s ({best*1e3:.1f} ms)", file=sys.stderr)
+        return rate / 1e6
+
+    results = {}
+
+    # Single-stream reference at the same total.
+    def single(x, c):
+        return viterbi_parallel(
+            params, x.at[0].set(c % 4), return_score=False, engine=eng
+        )
+
+    results["single-64MiB"] = timed(
+        single, jnp.asarray(stream), total, "single-64MiB", args.chain
+    )
+
+    # Batched geometries x block sizes.
+    geoms = [(16, total // 16), (4, total // 4), (64, total // 64)]
+    blocks = [4096, 8192, 16384, 32768] if on_tpu else [4096, 16384]
+    for n_seqs, seq_len in geoms:
+        chunks = jnp.asarray(stream.reshape(n_seqs, seq_len))
+        lengths = jnp.full(n_seqs, seq_len, dtype=jnp.int32)
+        for bk in blocks:
+            if bk * 2 > seq_len:
+                continue
+
+            def batched(x, c, bk=bk, lengths=lengths):
+                return viterbi_parallel_batch(
+                    params, x.at[0, 0].set(c % 4), lengths,
+                    block_size=bk, return_score=False, engine=eng,
+                )
+
+            name = f"batch{n_seqs}x{seq_len >> 20}MiB-bk{bk}"
+            results[name] = timed(batched, chunks, total, name, args.chain)
+
+    print(json.dumps(results))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,115 @@
+"""Diagnose the em-seq2d gap (VERDICT r4 #3): 725 vs 989.6 Msym/s/iter.
+
+Measures each bucket group of the bench's seq2d config SEPARATELY (the
+32 Mi chromosome group and the 8 x 2 Mi scaffold group), plus lane_T /
+t_tile sweeps per group, so the composite gap decomposes into per-group
+causes before any code changes.
+
+Usage: python tools/bench_seq2d.py [--platform auto]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--platform", default="auto")
+    ap.add_argument("--chain", type=int, default=8)
+    ap.add_argument("--scale", type=float, default=None)
+    args = ap.parse_args()
+
+    import jax
+
+    if args.platform != "auto":
+        jax.config.update("jax_platforms", args.platform)
+    import jax.numpy as jnp
+
+    from cpgisland_tpu.models import presets
+    from cpgisland_tpu.train.backends import Seq2DBackend
+    from cpgisland_tpu.train.baum_welch import mstep
+    from cpgisland_tpu.utils import chunking
+
+    on_tpu = jax.default_backend() == "tpu"
+    scale = args.scale if args.scale is not None else (1.0 if on_tpu else 1 / 32)
+    print(f"devices: {jax.devices()}", file=sys.stderr)
+    params = presets.durbin_cpg8()
+    rng = np.random.default_rng(8)
+    groups = [(1, int((32 << 20) * scale)), (8, int((2 << 20) * scale))]
+
+    def timed_group(rows, ln, engine, lane_T, t_tile, chain):
+        backend = Seq2DBackend(engine=engine, lane_T=lane_T, t_tile=t_tile)
+        chunks = rng.integers(0, 4, size=(rows, ln), dtype=np.int32).astype(np.uint8)
+        lens = np.full(rows, ln, np.int32)
+        bucketed = chunking.Bucketed(
+            chunks=(chunks,), lengths=(lens,), total=rows * ln
+        )
+        prepared = backend.prepare(bucketed)
+        obs_t, len_t = backend.place(prepared.chunks, prepared.lengths)
+        mesh_g, obs, lens_p = backend._group_meshes[0], obs_t[0], len_t[0]
+
+        @jax.jit
+        def chained(p, obs, lens, s):
+            obs = obs.at[0, 0].set((s % 4).astype(obs.dtype))
+
+            def body(p, _):
+                return mstep(p, backend._group_stats(p, mesh_g, obs, lens)), None
+
+            p, _ = jax.lax.scan(body, p, None, length=chain)
+            return p
+
+        jax.block_until_ready(chained(params, obs, lens_p, jnp.int32(0)))
+        best = float("inf")
+        s, done = 1, 0
+        while done < 3:
+            t0 = time.perf_counter()
+            float(
+                np.asarray(
+                    jax.device_get(chained(params, obs, lens_p, jnp.int32(s)).log_pi)
+                ).sum()
+            )
+            dt = time.perf_counter() - t0
+            s += 1
+            if dt < 1e-4:
+                continue
+            best = min(best, dt)
+            done += 1
+        return rows * ln / (best / chain)
+
+    eng = "onehot" if on_tpu else "xla"
+    results = {}
+    for rows, ln in groups:
+        name = f"{rows}x{ln >> 20}MiB"
+        r = timed_group(rows, ln, eng, None, None, args.chain)
+        results[f"{name}-default"] = round(r / 1e6, 1)
+        print(f"{name} default: {r/1e6:.1f} Msym/s", file=sys.stderr)
+        if on_tpu:
+            for lt in (16384, 32768, 65536):
+                if lt > ln:
+                    continue
+                r = timed_group(rows, ln, eng, lt, None, args.chain)
+                results[f"{name}-lt{lt}"] = round(r / 1e6, 1)
+                print(f"{name} lane_T={lt}: {r/1e6:.1f} Msym/s", file=sys.stderr)
+
+    # Composite (the bench's metric shape): time-weighted over both groups.
+    tot = sum(r * ln for r, ln in groups)
+    t = sum(
+        (r * ln) / (results[f"{r}x{ln >> 20}MiB-default"] * 1e6)
+        for r, ln in groups
+    )
+    results["composite-default"] = round(tot / t / 1e6, 1)
+    print(f"composite default: {tot / t / 1e6:.1f} Msym/s", file=sys.stderr)
+    print(json.dumps(results))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
