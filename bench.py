@@ -266,8 +266,10 @@ def bench_em(
 def bench_batched_decode(
     n_seqs: int, seq_len: int, engine: str = "auto", chain: int = 6
 ) -> float:
-    """Batched (vmap) multi-genome decode throughput in sym/s (BASELINE.md
-    config 5): N independent sequences decoded as one [N, T] batch."""
+    """Batched multi-genome decode throughput in sym/s (BASELINE.md config
+    5): N independent sequences decoded as one [N, T] batch — the onehot
+    engine runs them as ONE flat stream with record-reset steps
+    (viterbi_onehot.decode_batch_flat); dense engines vmap."""
     import jax
     import jax.numpy as jnp
 

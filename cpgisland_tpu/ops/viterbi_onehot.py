@@ -163,6 +163,21 @@ def _pair_table(params: HmmParams, gt: jnp.ndarray):
     return tab, idtab
 
 
+def _reset_rows(params: HmmParams, gt: jnp.ndarray):
+    """RESET step matrices, one per record-start symbol o (the flat batch
+    decoder, decode_batch_flat): T[a, c] = log_pi[gt[o, c]] +
+    log_B[gt[o, c], o] for EVERY entering a — rank-one in max-plus, so
+    (v ⊗ T)[c] = max(v) + v0red[c]: the chain restarts at record o's initial
+    scores up to an additive constant, which argmax paths never see, and the
+    backpointer compare a1 > a0 reduces to d1 > d0 — the previous record's
+    true exit argmax.  Appended at pair indices S*S + S + o.
+    """
+    S = params.n_symbols
+    v0red = params.log_pi[gt] + params.log_B[gt, jnp.arange(S)[:, None]]  # [S, 2]
+    rows = jnp.concatenate([v0red, v0red], axis=1).astype(jnp.float32)  # [S, 4]
+    return rows, gt  # idtab rows: exit group of symbol o = gt[o]
+
+
 def device_entry_sym(obs_c: jnp.ndarray, pad_sym: int, axis: str,
                      prev0: jnp.ndarray) -> jnp.ndarray:
     """Symbol emitted by the state entering THIS device's shard (shard_map).
@@ -241,15 +256,16 @@ def _pad_lanes(x, nb_pad, fill):
     return jnp.pad(x, [(0, 0)] * (x.ndim - 1) + [(0, pad)], constant_values=fill)
 
 
-def _pad_pair_rows(pair2: jnp.ndarray, e_out: jnp.ndarray, S: int):
+def _pad_pair_rows(pair2: jnp.ndarray, e_out: jnp.ndarray, ident_base: int):
     """Pad the step axis to a multiple of OUTER_TILE with per-lane identity
-    pairs (S*S + carried symbol), so padded steps stay PAD semantics AND keep
-    the carried symbol decodable."""
+    pairs (ident_base + carried symbol — ident_base is S*S, or S*S + S for
+    batch streams whose RESET rows occupy [S*S, S*S + S)), so padded steps
+    stay PAD semantics AND keep the carried symbol decodable."""
     bk, nb = pair2.shape
     bk_pad = -(-bk // OUTER_TILE) * OUTER_TILE
     if bk_pad == bk:
         return pair2, bk_pad
-    tail = jnp.broadcast_to((S * S + e_out)[None, :], (bk_pad - bk, nb))
+    tail = jnp.broadcast_to((ident_base + e_out)[None, :], (bk_pad - bk, nb))
     return jnp.concatenate([pair2, tail], axis=0), bk_pad
 
 
@@ -528,32 +544,59 @@ def _xla_backtrace(bp2, pair2, idtab, exit_bits):
 # Pass-level API (the "onehot" engine for viterbi_parallel.get_passes)
 
 
-def _prepared(params: HmmParams, steps2: jnp.ndarray, prev0):
+def _prepared(params: HmmParams, steps2: jnp.ndarray, prev0, resets=None):
+    """Tables + pair stream for the passes.
+
+    ``resets`` (flat batch decoding): (kidx, bidx, sym) arrays — step
+    (kidx[i], bidx[i]) becomes the RESET step into a record starting with
+    symbol sym[i] (see _reset_rows), and the tables extend with the S reset
+    rows so nreal covers them in the select tree.
+    """
     if prev0 is None:
         raise ValueError("the onehot engine requires prev0 (the symbol before step 0)")
     S = params.n_symbols
     gt = _groups(params)
     tab, idtab = _pair_table(params, gt)
+    steps2 = steps2.astype(jnp.int32)
     pair2, e_in, e_out = _pair_stream(
-        params, steps2.astype(jnp.int32), jnp.asarray(prev0, jnp.int32)
+        params, steps2, jnp.asarray(prev0, jnp.int32)
     )
-    return S, gt, tab, idtab, pair2, e_in, e_out
+    nreal = S * S
+    if resets is not None:
+        # Batch layout: RESET pairs renumber to [S*S, S*S + S) so they sit
+        # INSIDE the select tree's nreal range while PAD carries move up to
+        # [S*S + S, S*S + 2S) and stay tree DEFAULTS — 20 compares, not 24.
+        # ``resets`` is a [bk, nb] bool mask (elementwise, fuses into the
+        # pair-stream computation — an .at[].set scatter here copied the
+        # whole 4 B/step stream and measured ~19% of the batch decode).
+        rrows, rgt = _reset_rows(params, gt)
+        tab = jnp.concatenate([tab[: S * S], rrows, tab[S * S :]], axis=0)
+        idtab = jnp.concatenate([idtab[: S * S], rgt, idtab[S * S :]], axis=0)
+        is_pad = pair2 >= S * S
+        pair2 = jnp.where(is_pad, pair2 + S, pair2)
+        pair2 = jnp.where(
+            resets, S * S + jnp.minimum(steps2, S - 1), pair2
+        )
+        nreal = S * S + S
+    return S, gt, tab, idtab, pair2, e_in, e_out, nreal
 
 
-def pass_products(params: HmmParams, steps2: jnp.ndarray, prev0=None):
+def pass_products(params: HmmParams, steps2: jnp.ndarray, prev0=None, resets=None):
     """Onehot twin of viterbi_parallel._pass_products: (incl, offs, total)."""
     K = params.n_states
-    S, gt, tab, _, pair2, e_in, e_out = _prepared(params, steps2, prev0)
+    S, gt, tab, _, pair2, e_in, e_out, nreal = _prepared(
+        params, steps2, prev0, resets
+    )
     nb = steps2.shape[1]
     if _interpret():
         red = _xla_products(tab, pair2)
     else:
         nb_pad = -(-nb // LANE_TILE) * LANE_TILE
-        pair2 = _pad_lanes(pair2, nb_pad, jnp.int32(S * S))
-        pair2, bk = _pad_pair_rows(pair2, _pad_lanes(e_out, nb_pad, 0), S)
-        tabb = _bcast_tab(tab[: S * S])
+        pair2 = _pad_lanes(pair2, nb_pad, jnp.int32(nreal))
+        pair2, bk = _pad_pair_rows(pair2, _pad_lanes(e_out, nb_pad, 0), nreal)
+        tabb = _bcast_tab(tab[:nreal])
         red_flat = pl.pallas_call(
-            functools.partial(_oh_products_kernel, nreal=S * S, bk=bk),
+            functools.partial(_oh_products_kernel, nreal=nreal, bk=bk),
             grid=(nb_pad // LANE_TILE,),
             in_specs=[
                 _vspec((bk, LANE_TILE), lambda i: (0, i)),
@@ -568,14 +611,17 @@ def pass_products(params: HmmParams, steps2: jnp.ndarray, prev0=None):
     return incl, offs, incl[-1]
 
 
-def pass_backpointers(params: HmmParams, v_enter: jnp.ndarray, steps2: jnp.ndarray, prev0=None):
+def pass_backpointers(params: HmmParams, v_enter: jnp.ndarray, steps2: jnp.ndarray,
+                      prev0=None, resets=None):
     """Onehot twin of viterbi_parallel._pass_backpointers.
 
     Returns (delta_blocks [nb, K], F [nb, K], blob); the blob carries the
     packed 2-bit pointers plus the pair stream for the backtrace's bit->state
     mapping."""
     K = params.n_states
-    S, gt, tab, idtab, pair2, e_in, e_out = _prepared(params, steps2, prev0)
+    S, gt, tab, idtab, pair2, e_in, e_out, nreal = _prepared(
+        params, steps2, prev0, resets
+    )
     bk_real, nb = steps2.shape
     v_red = jnp.take_along_axis(v_enter, gt[e_in], axis=1)  # [nb, 2]
     ghigh_end = gt[e_out, 1]  # [nb] — exit-bit anchor conversion
@@ -588,12 +634,12 @@ def pass_backpointers(params: HmmParams, v_enter: jnp.ndarray, steps2: jnp.ndarr
         blob = ("xla", bp2, pair2, idtab, ghigh_end, bk_real, nb)
         return delta_exit, F, blob
     nb_pad = -(-nb // LANE_TILE) * LANE_TILE
-    pair2 = _pad_lanes(pair2, nb_pad, jnp.int32(S * S))
-    pair2, bk = _pad_pair_rows(pair2, _pad_lanes(e_out, nb_pad, 0), S)
+    pair2 = _pad_lanes(pair2, nb_pad, jnp.int32(nreal))
+    pair2, bk = _pad_pair_rows(pair2, _pad_lanes(e_out, nb_pad, 0), nreal)
     v_red2 = _pad_lanes(v_red.T.astype(jnp.float32), nb_pad, 0.0)
-    tabb = _bcast_tab(tab[: S * S])
+    tabb = _bcast_tab(tab[:nreal])
     bp_packed, dexit_red, ebits = pl.pallas_call(
-        functools.partial(_oh_backpointers_kernel, nreal=S * S, bk=bk),
+        functools.partial(_oh_backpointers_kernel, nreal=nreal, bk=bk),
         grid=(nb_pad // LANE_TILE,),
         in_specs=[
             _vspec((bk, LANE_TILE), lambda i: (0, i)),
@@ -641,3 +687,71 @@ def pass_backtrace(blob, exits: jnp.ndarray) -> jnp.ndarray:
         out_shape=jax.ShapeDtypeStruct((bk, nb_pad), jnp.int32),
     )(bp, pair2, idtabb, exits2)
     return path2[:bk_real, :nb].T.reshape(-1)
+
+
+# ---------------------------------------------------------------------------
+# Flat batched decode (one kernel grid for N records — no vmap-of-pallas)
+
+
+def decode_batch_flat(
+    params: HmmParams, chunks: jnp.ndarray, lengths: jnp.ndarray,
+    block_size: int = 4096,
+):
+    """Decode an [N, T] batch as ONE flat stream with RESET steps.
+
+    The r4 batched path vmapped viterbi_parallel over records, and
+    vmap-of-pallas loads batch-wide operand slabs into VMEM (measured 1004
+    vs 1635 Msym/s single-stream at the same 64 MiB total; block sizes
+    >= 8192 fail scoped-VMEM compile outright).  Instead the records
+    concatenate into one sequence whose step into each record's position 0
+    is a rank-one RESET matrix (_reset_rows): in max-plus, (v ⊗ reset)[c]
+    = max(v) + v0red[c] — the chain restarts at the record's initial
+    scores up to an additive constant that argmax paths cannot see, and
+    the backpointer at the reset is the previous record's true exit
+    argmax.  Every kernel then runs at single-stream occupancy.
+
+    Path-only (scores accumulate cross-record reset constants — callers
+    needing per-record scores use the vmap path).  Same first-symbol
+    contract as the engine: records whose position 0 is PAD decode
+    approximately (host entry points demote those to a dense engine).
+    Returns paths [N, T] (positions >= lengths[r] carry the exit state,
+    like viterbi_padded).
+    """
+    from cpgisland_tpu.ops.viterbi_parallel import _block_passes, _step_tables
+
+    S = params.n_symbols
+    N, T = chunks.shape
+    if T < 2:
+        raise ValueError("decode_batch_flat needs records of at least 2 symbols")
+    obs_c = jnp.where(
+        jnp.arange(T)[None, :] >= lengths[:, None],
+        S,
+        jnp.minimum(chunks.astype(jnp.int32), S),
+    )
+    concat = obs_c.reshape(-1)
+    Np = N * T
+    _, emit_ext = _step_tables(params)
+    v0 = params.log_pi + emit_ext[concat[0]]
+    n_steps = Np - 1
+    bk = min(block_size, max(8, n_steps))
+    nb = -(-n_steps // bk)
+    padded = jnp.concatenate(
+        [concat[1:], jnp.full(nb * bk - n_steps, S, jnp.int32)]
+    )
+    # Step r*T - 1 is the reset entering record r's position 0 — expressed
+    # as an iota mask (elementwise; an index scatter on the [bk, nb] pair
+    # stream copied 4 B/step and measured ~19% of the batch decode).  The
+    # reset pair needs the record's FIRST symbol, which IS that step's own
+    # symbol, so the mask alone is enough.  Layout matches _block_passes's
+    # steps.reshape(nb, bk).T: entry [k, b] is global step b*bk + k.
+    kk = jax.lax.broadcasted_iota(jnp.int32, (bk, nb), 0)
+    bb = jax.lax.broadcasted_iota(jnp.int32, (bk, nb), 1)
+    gstep = bb * bk + kk
+    resets = ((gstep + 1) % T == 0) & (gstep + 1 < Np)
+
+    dec = _block_passes(
+        params, v0, padded, bk, engine="onehot", prev0=concat[0], resets=resets
+    )
+    s0 = dec.ftable[jnp.argmax(dec.delta_exit)]
+    full = jnp.concatenate([s0[None], dec.path[:n_steps]])
+    return full.reshape(N, T)

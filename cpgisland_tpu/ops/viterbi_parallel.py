@@ -284,6 +284,7 @@ def _block_passes(
     anchor: jnp.ndarray | None = None,
     engine: str = "xla",
     prev0: jnp.ndarray | None = None,
+    resets: jnp.ndarray | None = None,
 ) -> BlockDecode:
     """Run the three block passes over ``steps`` (transition symbols), with
     ``v_enter0`` the score vector entering the first step.
@@ -292,14 +293,22 @@ def _block_passes(
     multiple of block_size (caller pads).  path[k] = state after step k,
     anchored at the segment end to ``anchor`` if given (sequence-parallel
     callers pass the globally-stitched exit state), else to the local argmax.
+    ``resets`` ([bk, nb] bool; onehot engine only): marks steps that RESTART
+    the chain at a new record's initial scores — the flat batch decoder
+    (viterbi_onehot.decode_batch_flat).
     """
     _pass_products, _pass_backpointers, _pass_backtrace = get_passes(engine)
     nb = steps.shape[0] // block_size
     steps2 = steps.reshape(nb, block_size).T  # [bk, nb] — scan over bk
 
-    incl, offs, total = _pass_products(params, steps2, prev0)
+    extra = {}
+    if resets is not None:
+        if engine != "onehot":
+            raise ValueError("record-reset steps need the onehot engine")
+        extra = {"resets": resets}
+    incl, offs, total = _pass_products(params, steps2, prev0, **extra)
     v_enter, enter_offs = _enter_vectors(v_enter0, incl, offs)
-    delta_blocks, F, bps = _pass_backpointers(params, v_enter, steps2, prev0)
+    delta_blocks, F, bps = _pass_backpointers(params, v_enter, steps2, prev0, **extra)
     delta_exit = delta_blocks[-1]
 
     s_exit = jnp.argmax(delta_exit).astype(jnp.int32) if anchor is None else anchor
@@ -370,13 +379,24 @@ def viterbi_parallel_batch(
     return_score: bool = True,
     engine: str = "xla",
 ):
-    """vmap of viterbi_parallel over a [N, T] batch of padded chunks.
+    """Batched decode of a [N, T] batch of padded chunks.
 
     Keeps viterbi_batch's masking contract: positions >= lengths[i] are
     force-masked to the PAD sentinel, so arbitrary tail content (zero-filled
     buffers etc.) cannot leak into the global argmax.
+
+    Path-only onehot batches run FLAT (viterbi_onehot.decode_batch_flat):
+    records concatenate into one stream with rank-one RESET steps at record
+    boundaries, so every kernel runs at single-stream occupancy —
+    vmap-of-pallas loads batch-wide VMEM slabs and measured 1004 vs 1635
+    Msym/s at the same total (r5; block sizes >= 8192 fail to compile under
+    vmap).  Score-returning calls and the dense engines keep the vmap path.
     """
     T = chunks.shape[1]
+    if engine == "onehot" and not return_score and T >= 2:
+        from cpgisland_tpu.ops.viterbi_onehot import decode_batch_flat
+
+        return decode_batch_flat(params, chunks, lengths, block_size=block_size)
     chunks = jnp.where(
         jnp.arange(T)[None, :] >= lengths[:, None],
         params.n_symbols,

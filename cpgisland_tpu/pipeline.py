@@ -286,9 +286,12 @@ def decode_file(
     if _eng == "pallas":
         batch_decode = viterbi_pallas_batch
     elif _eng == "onehot":
-        # Reduced one-hot kernels under vmap.  Zero-length lanes fall outside
-        # the engine's exactness domain (no real first emission) but their
-        # paths are sliced to nothing by every consumer.
+        # Path-only calls run the FLAT reset-step batch decoder (one kernel
+        # grid for all records, viterbi_onehot.decode_batch_flat); score-
+        # returning calls keep vmap.  Zero-length lanes fall outside the
+        # engine's exactness domain (no real first emission — their reset
+        # confines them to carried states) but their paths are sliced to
+        # nothing by every consumer.
         batch_decode = functools.partial(viterbi_parallel_batch, engine="onehot")
     else:
         batch_decode = viterbi_parallel_batch

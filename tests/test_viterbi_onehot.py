@@ -212,3 +212,45 @@ def test_prev0_required():
     steps2 = jnp.zeros((8, 1), jnp.int32)
     with pytest.raises(ValueError, match="prev0"):
         OH.pass_products(params, steps2, None)
+
+
+def test_batch_flat_parity(rng):
+    """decode_batch_flat (reset-step concatenation) vs per-record decode:
+    paths identical on a tie-free model, ragged lengths, mid-record PADs,
+    record boundaries off block boundaries (T=700, bk=128)."""
+    params = _onehot_model(rng)
+    N, T = 5, 700
+    chunks = rng.integers(0, 4, size=(N, T)).astype(np.int32)
+    chunks[2, 300:320] = 7  # mid-record PAD run (carried states)
+    lengths = np.asarray([700, 650, 700, 2, 700], dtype=np.int32)
+    flat = OH.decode_batch_flat(
+        params, jnp.asarray(chunks), jnp.asarray(lengths), block_size=128
+    )
+    for i in range(N):
+        L = int(lengths[i])
+        ref = viterbi_parallel(
+            params,
+            jnp.asarray(np.where(np.arange(T) >= L, 4, chunks[i])),
+            block_size=128, return_score=False, engine="onehot",
+        )
+        assert np.array_equal(np.asarray(flat)[i, :L], np.asarray(ref)[:L]), i
+
+
+def test_batch_flat_is_the_batch_api_route(rng):
+    """viterbi_parallel_batch(engine='onehot', return_score=False) routes
+    through the flat path and matches the vmap route record-for-record."""
+    params = _onehot_model(rng)
+    N, T = 4, 520
+    chunks = rng.integers(0, 4, size=(N, T)).astype(np.int32)
+    lengths = np.asarray([520, 300, 1, 520], dtype=np.int32)
+    got = viterbi_parallel_batch(
+        params, jnp.asarray(chunks), jnp.asarray(lengths), block_size=128,
+        return_score=False, engine="onehot",
+    )
+    want = viterbi_parallel_batch(
+        params, jnp.asarray(chunks), jnp.asarray(lengths), block_size=128,
+        return_score=False, engine="xla",
+    )
+    for i in range(N):
+        L = int(lengths[i])
+        assert np.array_equal(np.asarray(got)[i, :L], np.asarray(want)[i, :L]), i

@@ -113,7 +113,11 @@ def main() -> int:
                 )
 
             name = f"batch{n_seqs}x{seq_len >> 20}MiB-bk{bk}"
-            results[name] = timed(batched, chunks, total, name, args.chain)
+            try:
+                results[name] = timed(batched, chunks, total, name, args.chain)
+            except Exception as e:
+                results[name] = f"FAIL: {str(e)[:120]}"
+                print(f"{name}: FAILED ({str(e)[:200]})", file=sys.stderr)
 
     print(json.dumps(results))
     return 0
