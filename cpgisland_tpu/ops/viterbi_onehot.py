@@ -170,7 +170,10 @@ def _reset_rows(params: HmmParams, gt: jnp.ndarray):
     (v ⊗ T)[c] = max(v) + v0red[c]: the chain restarts at record o's initial
     scores up to an additive constant, which argmax paths never see, and the
     backpointer compare a1 > a0 reduces to d1 > d0 — the previous record's
-    true exit argmax.  Appended at pair indices S*S + S + o.
+    true exit argmax.  _prepared inserts these at pair indices
+    [S*S, S*S + S) — INSIDE the select tree's nreal range — and renumbers
+    the PAD carries up to [S*S + S, S*S + 2S), where they stay select-tree
+    defaults.
     """
     S = params.n_symbols
     v0red = params.log_pi[gt] + params.log_B[gt, jnp.arange(S)[:, None]]  # [S, 2]

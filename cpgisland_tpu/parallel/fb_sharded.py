@@ -457,8 +457,12 @@ def _shard_stats2d_body(
                 if lane_T is not None
                 else fb_pallas.pick_lane_T(
                     obs_tile.shape[1], onehot=engine == "onehot",
-                    long_lanes=engine == "onehot"
-                    and params.n_symbols & (params.n_symbols - 1) == 0,
+                    # NO long lanes in the 2-D body: 131072 measured 800
+                    # vs 864 (65536) / 867 (16384) Msym/s on the 32 Mi
+                    # single-row group (r5 sweep, tools/bench_seq2d.py) —
+                    # the standalone seq path's 131072 win does not carry
+                    # over to the per-row scan.
+                    long_lanes=False,
                 )
             )
             tt = t_tile if t_tile is not None else fb_pallas.DEFAULT_T_TILE
@@ -540,6 +544,47 @@ def sharded_stats2d_fn(
             # pallas_call output types are opaque to the varying-axes
             # checker — the project-wide pattern for pallas-under-shard_map
             # (see parallel.decode, SpmdBackend).
+            check_vma=engine == "xla",
+        )
+    )
+
+
+@functools.lru_cache(maxsize=32)
+def sharded_stats2d_rows_fn(mesh: Mesh, engine: str, t_tile: int = 512):
+    """Whole-record chunked-kernel fast path for SMALL-record 2-D groups.
+
+    A record that fits ONE kernel lane needs none of the sequence-parallel
+    machinery: the chunked E-step kernels already treat each lane as an
+    independent sequence, and with a whole record per lane their stats are
+    EXACT (the 64 Ki chunk-independence approximation only exists when a
+    record spans chunks).  Rows shard over ``data``; requires the group's
+    seq axis to be trivial (sp == 1 — auto_mesh2d's layout whenever rows
+    >= devices).  Replaces a per-row lax.scan of full three-pass
+    sequence-parallel programs — the scan serialized R tiny programs per
+    iteration, the dominant seq2d cost for many-scaffold inputs.
+    """
+    data_axis, seq_axis = mesh.axis_names
+
+    def body(params: HmmParams, obs_tile: jnp.ndarray, len_tile: jnp.ndarray) -> SuffStats:
+        if engine in ("pallas", "onehot"):
+            from cpgisland_tpu.ops import fb_pallas
+
+            st = fb_pallas.batch_stats_pallas(
+                params, obs_tile, len_tile[:, 0], t_tile=t_tile,
+                onehot=engine == "onehot",
+            )
+        else:
+            from cpgisland_tpu.ops.forward_backward import batch_stats
+
+            st = batch_stats(params, obs_tile, len_tile[:, 0], mode="rescaled")
+        return jax.lax.psum(st, (data_axis, seq_axis))
+
+    return jax.jit(
+        jax.shard_map(
+            body,
+            mesh=mesh,
+            in_specs=(P(), P(data_axis, seq_axis), P(data_axis, seq_axis)),
+            out_specs=P(),
             check_vma=engine == "xla",
         )
     )

@@ -291,6 +291,11 @@ def _check_seq_engine(engine: str) -> None:
 # record's time axis the same way.
 SEQ_SHARD_BUDGET = 112 << 20
 
+# Largest record class the 2-D backend routes to the whole-record-per-lane
+# chunked fast path (sharded_stats2d_rows_fn): 64 Ki is the chunked
+# kernels' production lane shape (the reference's own chunk size).
+SMALL_RECORD_ROWS_MAX = 1 << 16
+
 
 def _check_seq_shard(shard_len: int, what: str) -> None:
     """Fail oversize whole-sequence shards with advice, not an opaque
@@ -575,6 +580,18 @@ class Seq2DBackend(EStepBackend):
         # big-enough TPU shards; an explicit engine always wins.
         sp = mesh.shape[mesh.axis_names[1]]
         _check_seq_shard(chunks.shape[1] // sp, "Seq2DBackend")
+        if sp == 1 and chunks.shape[1] <= SMALL_RECORD_ROWS_MAX:
+            # Whole records fit single kernel lanes: the chunked kernels are
+            # already EXACT per record and skip the per-row scan of
+            # sequence-parallel programs (fb_sharded.sharded_stats2d_rows_fn).
+            # Engine routing/validation = LocalBackend's resolver (this IS a
+            # chunked path — the whole-seq 1 Mi fused gate does not apply).
+            eng = resolve_fb_engine(self.engine, params, "rescaled")
+            fn = fb_sharded.sharded_stats2d_rows_fn(
+                mesh, eng,
+                self.t_tile if self.t_tile is not None else fb_pallas.DEFAULT_T_TILE,
+            )
+            return fn(params, chunks, lengths)
         engine = (
             ("onehot" if _seq_onehot(self.engine, params) else "pallas")
             if _use_fused_seq(self.engine, params, chunks.shape[1] // sp)

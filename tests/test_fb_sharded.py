@@ -323,3 +323,41 @@ def test_seq2d_bucketed_matches_dense(rng):
         np.asarray(r_bucket.params.log_A), np.asarray(r_dense.params.log_A),
         atol=1e-5,
     )
+
+
+def test_seq2d_small_record_rows_fast_path(rng):
+    """Records that fit one kernel lane route to the whole-record-per-lane
+    chunked fast path (fb_sharded.sharded_stats2d_rows_fn) on sp == 1
+    meshes — exact vs the oracle (a whole record in one lane has no
+    chunk-boundary approximation), agreeing with the generic
+    sequence-parallel path."""
+    from cpgisland_tpu.parallel.mesh import make_mesh2d
+    from cpgisland_tpu.train.backends import SMALL_RECORD_ROWS_MAX, Seq2DBackend
+
+    require_devices(8)
+    pi, A, B, params = _random_params(rng)
+    lens = (800, 650, 512, 333, 804, 100, 640, 720)
+    seqs = [rng.integers(0, 4, size=n).astype(np.uint8) for n in lens]
+    pi_o, A_o, B_o, _ = oracle.em_step_oracle(pi, A, B, seqs)
+
+    T = max(lens)
+    assert T <= SMALL_RECORD_ROWS_MAX
+    rows = np.full((8, T), 4, np.uint8)
+    for i, s in enumerate(seqs):
+        rows[i, : len(s)] = s
+    chunked = chunking.Chunked(
+        chunks=rows, lengths=np.array(lens, np.int32), total=sum(lens)
+    )
+    res = baum_welch.fit(
+        params, chunked, num_iters=1, convergence=0.0,
+        backend=Seq2DBackend(make_mesh2d(8, 1)),
+    )
+    np.testing.assert_allclose(np.asarray(res.params.pi), pi_o, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(res.params.A), A_o, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(res.params.B), B_o, rtol=1e-4, atol=1e-5)
+    # The generic sequence-parallel route (sp > 1 forces it) agrees.
+    res2 = baum_welch.fit(
+        params, chunked, num_iters=1, convergence=0.0,
+        backend=Seq2DBackend(make_mesh2d(2, 4), block_size=64),
+    )
+    assert res.logliks[0] == pytest.approx(res2.logliks[0], rel=1e-5)
