@@ -390,7 +390,11 @@ def viterbi_parallel_batch(
     boundaries, so every kernel runs at single-stream occupancy —
     vmap-of-pallas loads batch-wide VMEM slabs and measured 1004 vs 1635
     Msym/s at the same total (r5; block sizes >= 8192 fail to compile under
-    vmap).  Score-returning calls and the dense engines keep the vmap path.
+    vmap).  Score-returning calls and the dense engines keep the vmap path;
+    its per-record VMEM slabs bound practical record size to ~4 MiB on a
+    16 GB chip (a 4 x 16 MiB score-returning batch fails scoped-VMEM
+    compile) — batches of larger records should decode per record through
+    viterbi_parallel / viterbi_sharded_spans, which have no such bound.
     """
     T = chunks.shape[1]
     if engine == "onehot" and not return_score and T >= 2:

@@ -254,3 +254,56 @@ def test_batch_flat_is_the_batch_api_route(rng):
     for i in range(N):
         L = int(lengths[i])
         assert np.array_equal(np.asarray(got)[i, :L], np.asarray(want)[i, :L]), i
+
+
+def test_batch_flat_block_aligned_boundaries(rng):
+    """Record boundaries exactly ON block boundaries (T a multiple of bk):
+    the reset step is then the LAST step of a block — the stitching case
+    the off-boundary test cannot reach."""
+    params = _onehot_model(rng)
+    N, T, bk = 4, 512, 128  # 512 = 4 blocks exactly
+    chunks = rng.integers(0, 4, size=(N, T)).astype(np.int32)
+    lengths = np.full(N, T, dtype=np.int32)
+    flat = OH.decode_batch_flat(
+        params, jnp.asarray(chunks), jnp.asarray(lengths), block_size=bk
+    )
+    for i in range(N):
+        ref = viterbi_parallel(
+            params, jnp.asarray(chunks[i]), block_size=bk,
+            return_score=False, engine="onehot",
+        )
+        assert np.array_equal(np.asarray(flat)[i], np.asarray(ref)), i
+
+
+def test_batch_flat_fuzz_geometries(rng):
+    """Randomized geometries / raggedness: every record's path must equal
+    its standalone decode (achieved-score equality would also hold, but the
+    model is tie-free so exact path equality is the stronger check).
+
+    CPU-only: each random geometry is a fresh compile, which costs ~15 min
+    of remote-compile round-trips on the relayed chip while exercising only
+    the shared stream-assembly logic — the chip run certifies the kernels
+    through the deterministic-geometry tests above (all green on TPU,
+    2026-08-01)."""
+    if jax.default_backend() == "tpu":
+        pytest.skip("compile-diversity fuzz is CPU-suite coverage")
+    params = _onehot_model(rng)
+    for trial in range(6):
+        N = int(rng.integers(1, 7))
+        T = int(rng.integers(2, 900))
+        bk = int(2 ** rng.integers(3, 8))
+        chunks = rng.integers(0, 4, size=(N, T)).astype(np.int32)
+        lengths = rng.integers(1, T + 1, size=N).astype(np.int32)
+        flat = OH.decode_batch_flat(
+            params, jnp.asarray(chunks), jnp.asarray(lengths), block_size=bk
+        )
+        for i in range(N):
+            L = int(lengths[i])
+            masked = np.where(np.arange(T) >= L, 4, chunks[i])
+            ref = viterbi_parallel(
+                params, jnp.asarray(masked), block_size=bk,
+                return_score=False, engine="onehot",
+            )
+            assert np.array_equal(
+                np.asarray(flat)[i, :L], np.asarray(ref)[:L]
+            ), (trial, i, N, T, bk)
