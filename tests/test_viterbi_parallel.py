@@ -1,6 +1,5 @@
 """Parallel blockwise Viterbi vs the sequential scan decoder (exactness)."""
 
-import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
@@ -10,17 +9,6 @@ from cpgisland_tpu.models.hmm import HmmParams
 from cpgisland_tpu.ops import viterbi as V
 from cpgisland_tpu.ops import viterbi_parallel as VP
 
-
-@pytest.fixture(scope="module", autouse=True)
-def _fresh_compile_caches():
-    """Full-suite runs (~400 tests of live executables in one single-core
-    process) segfaulted INSIDE XLA:CPU's backend_compile at this module's
-    65536-step sequential-scan compile (r5, twice, same spot; every file
-    passes standalone).  Dropping the accumulated jit caches before this
-    module's heavy compiles sidesteps the compiler-state crash at the cost
-    of a few in-module recompiles."""
-    jax.clear_caches()
-    yield
 
 
 def _random_model(rng, k=3, m=4):
