@@ -50,48 +50,42 @@ def log(msg: str) -> None:
 
 # No single-chip path on this hardware exceeds ~2.2 Gsym/s; anything past
 # this outer net is a phantom result (see _best_wall), not a measurement.
-PLAUSIBLE_MAX_SYM_PER_S = 20e9
+# The value lives in cpgisland_tpu.obs.watchdog (the library generalization
+# of this bench's plausibility discipline) — imported so there is ONE source.
+# Importing the library here does not initialize any jax backend; --platform
+# still takes effect in main() before first device use.
+try:
+    from cpgisland_tpu.obs.watchdog import PLAUSIBLE_MAX_SYM_PER_S
+except Exception:  # degraded checkout: keep the bench self-sufficient
+    PLAUSIBLE_MAX_SYM_PER_S = 20e9
 
 # Per-path ceilings are much tighter (VERDICT r4 #6): 2.5x the enforced
 # BASELINE.md figure for that metric, so a phantom that inflates one path
 # 5x raises instead of sailing under the global net.  Parsed from the
 # marker-wrapped BASELINE.md rows so they track the published numbers.
 PATH_CEILING_FACTOR = 2.5
-_BASELINE_KEY_BY_PATH = {
-    "decode": "decode_msym",
-    "decode-2state": "decode2_msym",
-    "em": "em_msym",
-    "em-2state": "em2_msym",
-    "em-seq": "em_seq_msym",
-    "em-seq2d": "em_seq2d_msym",
-    "posterior": "posterior_msym",
-    "batched-decode": "batched_msym",
-}
 _PATH_CEILINGS: dict | None = None
+
+
+def _baseline_key_by_path() -> dict:
+    from cpgisland_tpu.obs import watchdog
+
+    return watchdog.PATH_BASELINE_KEY
 
 
 def _path_ceilings() -> dict:
     global _PATH_CEILINGS
     if _PATH_CEILINGS is None:
-        # One marker parser for the whole repo: tools/pubnum.py owns the
-        # <!--num:key--> format (its writer/checker must agree with this
-        # reader, so duplicating the regex here would be a drift hazard).
-        root = os.path.dirname(os.path.abspath(__file__))
-        sys.path.insert(0, os.path.join(root, "tools"))
+        # The marker parsing lives in cpgisland_tpu.obs.watchdog (the
+        # library-wide plausibility watchdog this bench's checks graduated
+        # into); tools/pubnum.py still owns the <!--num:key--> format and a
+        # test pins the two regexes equal so they cannot drift.
         try:
-            import pubnum
+            from cpgisland_tpu.obs import watchdog
 
-            with open(os.path.join(root, "BASELINE.md")) as f:
-                nums = dict(pubnum._NUM_RE.findall(f.read()))
-            _PATH_CEILINGS = {
-                path: PATH_CEILING_FACTOR * float(nums[key]) * 1e6
-                for path, key in _BASELINE_KEY_BY_PATH.items()
-                if key in nums
-            }
-        except (OSError, ImportError, ValueError):
+            _PATH_CEILINGS = watchdog.path_ceilings(factor=PATH_CEILING_FACTOR)
+        except Exception:
             _PATH_CEILINGS = {}  # degrade to the global net, don't sink the bench
-        finally:
-            sys.path.pop(0)
     return _PATH_CEILINGS
 
 
@@ -111,7 +105,7 @@ def _check_plausible(tput: float, name: str) -> float:
             f"{name}: {tput/1e6:.1f} Msym/s exceeds its per-path ceiling "
             f"({per_path/1e6:.0f} Msym/s = PATH_CEILING_FACTOR "
             f"{PATH_CEILING_FACTOR} x the enforced BASELINE.md "
-            f"'{_BASELINE_KEY_BY_PATH.get(name)}' figure). Either a phantom "
+            f"'{_baseline_key_by_path().get(name)}' figure). Either a phantom "
             "relay result (re-run this phase in a fresh process) or a real "
             ">2.5x improvement — if reproducible, update BASELINE.md via "
             "tools/pubnum.py --write from a fresh capture"
@@ -1155,6 +1149,15 @@ def main() -> int:
         "virtual-CPU-mesh subprocess when the parent has a single device)",
     )
     ap.add_argument(
+        "--metrics-out",
+        default=None,
+        help="append a runtime-telemetry JSONL sidecar (cpgisland_tpu.obs "
+        "spans + engine decisions + dispatch/compile ledger) to this path; "
+        "stdout stays the ONE result JSON line.  The --extended parent "
+        "passes it through to every phase subprocess, so one sidecar file "
+        "accompanies the whole captured artifact",
+    )
+    ap.add_argument(
         "--phase",
         default=None,
         choices=("parity", "core", "ext1", "ext2", "ext3"),
@@ -1187,6 +1190,19 @@ def main() -> int:
     if args.decode_mib is None:
         args.decode_mib = 256 if on_tpu else 16
 
+    if args.metrics_out:
+        # Telemetry sidecar: spans/engine decisions/ledger go to the JSONL
+        # file (MetricsLogger appends, so the per-phase subprocesses of an
+        # --extended run share ONE sidecar); stdout remains one JSON line.
+        from cpgisland_tpu import obs as obs_mod
+
+        with obs_mod.observe(metrics=args.metrics_out) as ob:
+            ob.emit_event("bench_phase", phase=args.phase or "core")
+            return _run_phase(args, on_tpu)
+    return _run_phase(args, on_tpu)
+
+
+def _run_phase(args, on_tpu: bool) -> int:
     if args.phase == "parity":
         out = bench_parity(4 if on_tpu else 1)
         print(json.dumps({"parity": out}))
@@ -1310,6 +1326,8 @@ def _orchestrate(args) -> int:
         base += ["--decode-mib", str(args.decode_mib)]
     if args.e2e_mbases is not None:
         base += ["--e2e-mbases", str(args.e2e_mbases)]
+    if args.metrics_out is not None:
+        base += ["--metrics-out", args.metrics_out]
     carry: dict = {}
     results: dict = {}
     # parity runs FIRST: the capture certifies the reduced kernels' on-chip

@@ -16,6 +16,10 @@ Viterbi CpG-island caller, /root/reference/CpGIslandFinder.java):
                                    (reference: CpGIslandFinder.java:207-224)
 """
 
+from cpgisland_tpu.utils import compat as _compat
+
+_compat.install()  # jax version shims (jax.shard_map on older 0.4.x)
+
 from cpgisland_tpu.models.hmm import HmmParams
 from cpgisland_tpu.models import presets
 from cpgisland_tpu.utils import codec, chunking

@@ -98,10 +98,27 @@ def _common_flags(p: argparse.ArgumentParser) -> None:
     p.add_argument(
         "--trace-dir",
         help="capture a jax.profiler device trace into this directory "
-        "(TensorBoard format; SURVEY.md §5 tracing)",
+        "(TensorBoard format; SURVEY.md §5 tracing) plus, with the obs "
+        "subsystem, a Chrome-trace/Perfetto span trace (trace.json)",
     )
+    _add_obs_flags(p)
     _add_symbol_cache_flag(p)
     p.add_argument("-v", "--verbose", action="store_true")
+
+
+def _add_obs_flags(p: argparse.ArgumentParser) -> None:
+    p.add_argument(
+        "--metrics",
+        help="write a JSONL runtime-telemetry stream (spans, engine "
+        "decisions, dispatch/compile ledger) to this path; render it later "
+        "with tools/obs_report.py",
+    )
+    p.add_argument(
+        "--obs-report",
+        action="store_true",
+        help="print an end-of-run observability table (per-phase wall, "
+        "dispatches, compiles, transfer bytes, engine choices)",
+    )
 
 
 def _add_symbol_cache_flag(p: argparse.ArgumentParser) -> None:
@@ -195,6 +212,7 @@ def build_parser() -> argparse.ArgumentParser:
         help="initial model preset (two_state needs --island-states 0)",
     )
     po.add_argument("--trace-dir", help="capture a jax.profiler device trace")
+    _add_obs_flags(po)
     _add_symbol_cache_flag(po)
     po.add_argument("-v", "--verbose", action="store_true")
 
@@ -281,16 +299,35 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
 
     import contextlib
 
+    from cpgisland_tpu import obs as obs_mod
     from cpgisland_tpu.utils import profiling
 
     trace_ctx = (
         profiling.trace(args.trace_dir) if args.trace_dir else contextlib.nullcontext()
     )
-    with trace_ctx:
-        return _run_command(args, compat, pipeline, presets, load_text)
+    # The obs subsystem is off unless asked for: any of --metrics,
+    # --obs-report, --trace-dir turns it on (a trace-dir run gets the
+    # Chrome-trace span export alongside the jax.profiler capture).
+    observer = (
+        obs_mod.Observer(
+            metrics=getattr(args, "metrics", None), trace_dir=args.trace_dir
+        )
+        if (
+            getattr(args, "metrics", None)
+            or getattr(args, "obs_report", False)
+            or args.trace_dir
+        )
+        else None
+    )
+    with trace_ctx, (observer if observer is not None else contextlib.nullcontext()):
+        rc = _run_command(args, compat, pipeline, presets, load_text, observer)
+    if observer is not None and getattr(args, "obs_report", False):
+        print(observer.report())
+    return rc
 
 
-def _run_command(args, compat, pipeline, presets, load_text) -> int:
+def _run_command(args, compat, pipeline, presets, load_text, observer=None) -> int:
+    metrics = observer.metrics if observer is not None else None
     if getattr(args, "symbol_cache", None) and compat:
         build_parser().error(
             "--symbol-cache is FASTA-aware and requires --clean"
@@ -309,6 +346,7 @@ def _run_command(args, compat, pipeline, presets, load_text) -> int:
             checkpoint_dir=args.checkpoint_dir,
             model_out=args.model_out,
             symbol_cache=args.symbol_cache,
+            metrics=metrics,
         )
         print(
             f"trained: iters={res.iterations} converged={res.converged} "
@@ -332,6 +370,7 @@ def _run_command(args, compat, pipeline, presets, load_text) -> int:
             island_engine=args.island_engine,
             island_cap=args.island_cap,
             symbol_cache=args.symbol_cache,
+            metrics=metrics,
         )
         print(f"decoded {res.n_symbols} symbols in {res.n_chunks} chunks; {len(res.calls)} islands")
         return 0
@@ -362,6 +401,7 @@ def _run_command(args, compat, pipeline, presets, load_text) -> int:
             island_engine=args.island_engine,
             island_cap=args.island_cap,
             symbol_cache=args.symbol_cache,
+            metrics=metrics,
         )
         extra = (
             f"; {len(res.calls)} islands -> {args.islands_out}"

@@ -1,0 +1,284 @@
+"""Runtime telemetry subsystem (spans + ledger + engine decisions + watchdog).
+
+The reference program has two log lines of observability total
+(CpGIslandFinder.java:147,228); production-scale runs here need to answer
+"where did the time, the round trips, and the compiles go, and which engine
+actually ran" from a single metrics file.  This package is the layer the
+whole stack reports through:
+
+- :mod:`~cpgisland_tpu.obs.trace` — hierarchical span tracer (JSONL events +
+  Chrome-trace/Perfetto export);
+- :mod:`~cpgisland_tpu.obs.ledger` — dispatch & compile ledger (JAX hooks)
+  and the :func:`no_new_compiles` recompile sentinel;
+- :mod:`~cpgisland_tpu.obs.watchdog` — plausibility ceilings generalizing
+  bench.py's ``_check_plausible`` into the library;
+- engine-decision events: every ``resolve_*_engine`` choice, ``pick_lane_T``
+  geometry, SEQ_SHARD_BUDGET rejection, pad-FIRST dense demotion, and island
+  cap-overflow retry reports through :func:`event`, so a run's routing is
+  reconstructable from its metrics stream.
+
+**Off by default, zero device cost.**  Library call sites use the
+module-level :func:`span` / :func:`event` / :func:`note_fetch` /
+:func:`note_upload` helpers, which are no-ops (one global ``None`` check)
+until an :class:`Observer` is installed — via :func:`observe`, the CLI's
+``--metrics`` / ``--obs-report`` / ``--trace-dir`` flags, or bench.py's
+``--metrics-out``.  Even when enabled, the subsystem only counts work that
+already happens (it piggybacks on existing fetches and sync points) and
+never issues a device dispatch of its own.
+
+No jax import at module level: the CLI imports this before platform
+selection.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import os
+from typing import Iterator, Optional
+
+from cpgisland_tpu.obs.ledger import (  # noqa: F401  (public re-exports)
+    Ledger,
+    RecompileError,
+    no_new_compiles,
+)
+from cpgisland_tpu.obs.trace import SpanRecord, Tracer, process_index
+from cpgisland_tpu.obs.watchdog import Watchdog
+
+log = logging.getLogger(__name__)
+
+_ACTIVE: Optional["Observer"] = None
+
+
+def current() -> Optional["Observer"]:
+    return _ACTIVE
+
+
+def enabled() -> bool:
+    return _ACTIVE is not None
+
+
+class Observer:
+    """One observed region: tracer + ledger + metrics sink + watchdog.
+
+    Use as a context manager (or through :func:`observe`).  Installing sets
+    the module-level active observer that the no-op helpers route to;
+    exiting uninstalls the JAX hooks, writes the Chrome trace (when
+    ``trace_dir`` is given), and emits an ``obs_summary`` event with ledger
+    totals, engine-decision counts, and watchdog violations.
+    """
+
+    def __init__(
+        self,
+        metrics=None,
+        trace_dir: Optional[str] = None,
+        watchdog: str = "warn",
+    ) -> None:
+        from cpgisland_tpu.utils import profiling
+
+        if isinstance(metrics, str):
+            metrics = profiling.MetricsLogger(metrics)
+            self._own_metrics = True
+        else:
+            self._own_metrics = False
+        self.metrics = metrics if metrics is not None else profiling.null()
+        self.trace_dir = trace_dir
+        self.ledger = Ledger()
+        self.tracer = Tracer(ledger=self.ledger, on_end=self._on_span_end)
+        self.watchdog = Watchdog(mode=watchdog)
+        self.events: list[dict] = []
+        self._event_counts: dict = {}
+        self._dropped_events = 0
+        self._uninstall = None
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def __enter__(self) -> "Observer":
+        global _ACTIVE
+        if _ACTIVE is not None:
+            raise RuntimeError("an Observer is already active (no nesting)")
+        from cpgisland_tpu.obs import ledger as ledger_mod
+
+        self._uninstall = ledger_mod.install(self.ledger)
+        _ACTIVE = self
+        self.metrics.log("obs_start", process_index=process_index())
+        return self
+
+    def __exit__(self, *exc) -> None:
+        global _ACTIVE
+        _ACTIVE = None
+        if self._uninstall is not None:
+            self._uninstall()
+            self._uninstall = None
+        self.metrics.log("obs_summary", **self.summary())
+        if self.trace_dir:
+            os.makedirs(self.trace_dir, exist_ok=True)
+            path = os.path.join(self.trace_dir, "trace.json")
+            self.tracer.write_chrome_trace(path)
+            log.info("chrome trace written to %s (open in Perfetto)", path)
+        if self._own_metrics:
+            self.metrics.close()
+
+    # -- emission -----------------------------------------------------------
+
+    def _on_span_end(self, sp: SpanRecord) -> None:
+        self.metrics.log(
+            "span",
+            name=sp.name,
+            span_id=sp.span_id,
+            parent_id=sp.parent_id,
+            depth=sp.depth,
+            wall_s=round(sp.wall_s, 6),
+            items=sp.items,
+            unit=sp.unit,
+            **sp.attrs,
+            **sp.counters,
+        )
+        if sp.unit == "sym":
+            self.watchdog.check(sp.name, sp.items, sp.wall_s)
+
+    # Memory bounds for degenerate inputs (spans have trace.MAX_SPANS):
+    # distinct deduped payloads and retained non-deduped events are capped,
+    # with overflow counted in the summary rather than growing unbounded.
+    MAX_EVENTS = 10_000
+    MAX_DISTINCT_DECISIONS = 10_000
+
+    def emit_event(self, name: str, dedupe: bool = False, **fields) -> None:
+        """Log a structured event, attributed to the innermost open span.
+
+        ``dedupe=True`` (engine decisions, lane geometry) logs only the
+        FIRST occurrence of an identical payload and counts the rest — a
+        100k-scaffold file must not write 100k identical routing lines; the
+        counts surface in ``obs_summary``.  Call sites must key deduped
+        payloads on BOUNDED values (e.g. pow2 buckets, not raw lengths).
+        """
+        if dedupe:
+            key = (name, tuple(sorted(fields.items())))
+            n = self._event_counts.get(key)
+            if n is None and len(self._event_counts) >= self.MAX_DISTINCT_DECISIONS:
+                self._dropped_events += 1
+                return
+            self._event_counts[key] = (n or 0) + 1
+            if n:
+                return
+        cur = self.tracer.current
+        rec = {"span": cur.name if cur else None, **fields}
+        if len(self.events) < self.MAX_EVENTS:
+            self.events.append({"event": name, **rec})
+        else:
+            self._dropped_events += 1
+        self.metrics.log(name, **rec)
+
+    # -- summary / report ---------------------------------------------------
+
+    def _span_aggregate(self) -> dict:
+        agg: dict = {}
+        for sp in self.tracer.spans:
+            a = agg.setdefault(
+                sp.name,
+                {
+                    "count": 0,
+                    "wall_s": 0.0,
+                    "items": 0.0,
+                    "unit": sp.unit,
+                    "compiles": 0,
+                    "compile_s": 0.0,
+                    "dispatches": 0,
+                    "fetch_bytes": 0,
+                    "upload_bytes": 0,
+                },
+            )
+            a["count"] += 1
+            a["wall_s"] += sp.wall_s
+            a["items"] += sp.items
+            for k in ("compiles", "compile_s", "dispatches", "fetch_bytes", "upload_bytes"):
+                a[k] += sp.counters.get(k, 0)
+        for a in agg.values():
+            a["wall_s"] = round(a["wall_s"], 4)
+            a["compile_s"] = round(a["compile_s"], 4)
+        return agg
+
+    def _decision_counts(self) -> dict:
+        out: dict = {}
+        for (name, fields), n in self._event_counts.items():
+            label = name + "{" + ", ".join(f"{k}={v}" for k, v in fields) + "}"
+            out[label] = n
+        return out
+
+    def summary(self) -> dict:
+        return {
+            "process_index": process_index(),
+            "spans": self._span_aggregate(),
+            "dropped_spans": self.tracer.dropped,
+            "dropped_events": self._dropped_events,
+            "ledger": self.ledger.totals(),
+            "decisions": self._decision_counts(),
+            "watchdog_violations": self.watchdog.violations,
+        }
+
+    def report(self) -> str:
+        """End-of-run report table (the CLI's ``--obs-report``)."""
+        from cpgisland_tpu.obs import report as report_mod
+
+        return report_mod.render_summary(self.summary())
+
+
+@contextlib.contextmanager
+def observe(
+    metrics=None, trace_dir: Optional[str] = None, watchdog: str = "warn"
+) -> Iterator[Observer]:
+    """Install an Observer for a region: ``with obs.observe("m.jsonl"):``."""
+    ob = Observer(metrics=metrics, trace_dir=trace_dir, watchdog=watchdog)
+    with ob:
+        yield ob
+
+
+# -- zero-cost module-level helpers (the API library code calls) ------------
+
+
+@contextlib.contextmanager
+def span(name: str, items: float = 0.0, unit: str = "items", **attrs):
+    ob = _ACTIVE
+    if ob is None:
+        yield None
+        return
+    with ob.tracer.span(name, items=items, unit=unit, **attrs) as sp:
+        yield sp
+
+
+def event(name: str, _dedupe: bool = False, **fields) -> None:
+    ob = _ACTIVE
+    if ob is None:
+        return
+    ob.emit_event(name, dedupe=_dedupe, **fields)
+
+
+def engine_decision(site: str, choice: str, **fields) -> None:
+    """Structured routing event — deduped (see Observer.emit_event)."""
+    ob = _ACTIVE
+    if ob is None:
+        return
+    ob.emit_event("engine_decision", dedupe=True, site=site, choice=choice, **fields)
+
+
+def note_fetch(x):
+    """Piggyback accounting for a device->host fetch that the caller is
+    already performing (e.g. an ``np.asarray`` on a device array).  Returns
+    its argument; adds NO dispatch of its own."""
+    ob = _ACTIVE
+    if ob is not None:
+        from cpgisland_tpu.obs.ledger import _tree_nbytes
+
+        ob.ledger.count_fetch(_tree_nbytes(x))
+    return x
+
+
+def note_upload(x):
+    """Piggyback accounting for a host->device upload the caller is already
+    performing (e.g. a ``jnp.asarray`` on a host array)."""
+    ob = _ACTIVE
+    if ob is not None:
+        from cpgisland_tpu.obs.ledger import _tree_nbytes
+
+        ob.ledger.count_upload(_tree_nbytes(x))
+    return x

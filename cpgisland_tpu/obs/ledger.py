@@ -1,0 +1,275 @@
+"""Dispatch & compile ledger: count what crosses the host/device boundary.
+
+On this project's dev setup every blocking dispatch pays a ~50-100 ms relay
+round trip and every cache-miss compile ships program bytes over HTTP
+(CLAUDE.md), so "how many dispatches / compiles / uploaded bytes did this
+phase cost" is the first question any slow run raises.  The ledger answers
+it without adding any device work of its own:
+
+- **compiles** — a wrapper around ``jax._src.compiler.backend_compile`` (the
+  single funnel every true cache-miss XLA compile passes through; in-memory
+  jit cache hits and persistent-cache hits never reach it) records one
+  entry per fresh executable with the MLIR module name, its abstract input
+  types (the shapes — what you need to diagnose shape-driven recompiles),
+  and compile wall time.  A ``jax.monitoring`` listener counts persistent
+  compilation-cache hits alongside.
+- **dispatches / bytes** — counting wrappers over the public blocking APIs
+  (``jax.block_until_ready``, ``jax.device_get``, ``jax.device_put``) plus
+  the ``count_fetch``/``count_upload`` piggyback hooks the pipeline calls at
+  its existing ``np.asarray`` fetch sites.  Transfers routed through other
+  entry points (e.g. ``jnp.asarray`` inside library internals) are NOT
+  counted — the ledger is a lower bound by design, attributed where the
+  pipeline already blocks, never a new sync point.
+
+The :func:`no_new_compiles` recompile sentinel asserts a steady-state region
+(e.g. EM iterations 2..N over fixed shapes) triggers zero fresh compiles,
+reporting the offending module names + abstract shapes when it fires.
+
+Everything installs/uninstalls explicitly; nothing is patched at import.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Iterator, Optional
+
+_MAX_COMPILE_RECORDS = 4096
+
+
+class RecompileError(RuntimeError):
+    """A region asserted compile-free saw fresh XLA compiles."""
+
+    def __init__(self, msg: str, records: list):
+        super().__init__(msg)
+        self.records = records
+
+
+class Ledger:
+    """Plain host-side counters (no locks: host pipeline code is
+    single-threaded; compile callbacks run on the dispatching thread)."""
+
+    def __init__(self) -> None:
+        self.compiles = 0
+        self.compile_s = 0.0
+        self.cache_hits = 0  # persistent compilation-cache hits
+        self.dispatches = 0  # blocking host<->device round trips
+        self.fetch_bytes = 0  # device -> host
+        self.upload_bytes = 0  # host -> device
+        self.compile_records: list[dict] = []
+
+    # -- recording ----------------------------------------------------------
+
+    def record_compile(self, name: str, arg_types: list, secs: float) -> None:
+        self.compiles += 1
+        self.compile_s += secs
+        if len(self.compile_records) < _MAX_COMPILE_RECORDS:
+            self.compile_records.append(
+                {"name": name, "arg_types": arg_types, "secs": round(secs, 4)}
+            )
+
+    def count_dispatch(self) -> None:
+        self.dispatches += 1
+
+    def count_fetch(self, nbytes: int) -> None:
+        self.dispatches += 1
+        self.fetch_bytes += int(nbytes)
+
+    def count_upload(self, nbytes: int) -> None:
+        # An upload IS a round trip on the relay (and the docstring promises
+        # device_put is a counted sync point) — count it as a dispatch too.
+        self.dispatches += 1
+        self.upload_bytes += int(nbytes)
+
+    # -- span attribution ---------------------------------------------------
+
+    def snapshot(self) -> tuple:
+        return (
+            self.compiles,
+            self.compile_s,
+            self.dispatches,
+            self.fetch_bytes,
+            self.upload_bytes,
+        )
+
+    def delta(self, snap: tuple) -> dict:
+        return {
+            "compiles": self.compiles - snap[0],
+            "compile_s": round(self.compile_s - snap[1], 4),
+            "dispatches": self.dispatches - snap[2],
+            "fetch_bytes": self.fetch_bytes - snap[3],
+            "upload_bytes": self.upload_bytes - snap[4],
+        }
+
+    def totals(self) -> dict:
+        return {
+            "compiles": self.compiles,
+            "compile_s": round(self.compile_s, 4),
+            "cache_hits": self.cache_hits,
+            "dispatches": self.dispatches,
+            "fetch_bytes": self.fetch_bytes,
+            "upload_bytes": self.upload_bytes,
+        }
+
+
+def _tree_nbytes(x) -> int:
+    try:
+        import jax
+
+        return sum(
+            getattr(leaf, "nbytes", 0) or 0
+            for leaf in jax.tree_util.tree_leaves(x)
+        )
+    except Exception:
+        return getattr(x, "nbytes", 0) or 0
+
+
+def _module_info(args: tuple, kwargs: dict) -> tuple[str, list]:
+    """(module name, abstract input types) of the MLIR module in a
+    backend_compile call — best-effort, never raises (observability must not
+    sink a compile)."""
+    name, types = "<unknown>", []
+    try:
+        from jax._src.lib.mlir import ir
+
+        mod = None
+        for x in list(args) + list(kwargs.values()):
+            if hasattr(x, "operation") and hasattr(x, "body"):
+                mod = x
+                break
+        if mod is None:
+            return name, types
+        name = ir.StringAttr(mod.operation.attributes["sym_name"]).value
+        for op in mod.body.operations:
+            try:
+                ftype = ir.FunctionType(
+                    ir.TypeAttr(op.attributes["function_type"]).value
+                )
+                types = [str(t) for t in ftype.inputs[:16]]
+                break
+            except Exception:
+                continue
+    except Exception:
+        pass
+    return name, types
+
+
+_installed_uninstall = None  # module-level: at most one ledger installed
+
+
+def install(ledger: Ledger, compile_only: bool = False):
+    """Install the JAX hooks feeding ``ledger``; returns an uninstall
+    callable.  At most one ledger can be installed at a time (the Observer
+    enforces a single active observer; the standalone sentinel installs only
+    when no observer is active)."""
+    global _installed_uninstall
+    if _installed_uninstall is not None:
+        raise RuntimeError("an obs Ledger is already installed")
+
+    import jax
+    from jax._src import compiler as _compiler
+
+    state = {"live": True}
+    orig_bc = _compiler.backend_compile
+
+    def _backend_compile(*a, **k):
+        t0 = time.perf_counter()
+        out = orig_bc(*a, **k)
+        secs = time.perf_counter() - t0
+        if state["live"]:
+            name, types = _module_info(a, k)
+            ledger.record_compile(name, types, secs)
+        return out
+
+    _compiler.backend_compile = _backend_compile
+
+    def _on_event(event: str, **kw) -> None:
+        if state["live"] and event == "/jax/compilation_cache/cache_hits":
+            ledger.cache_hits += 1
+
+    jax.monitoring.register_event_listener(_on_event)
+
+    restores = []
+    if not compile_only:
+        orig_block = jax.block_until_ready
+        orig_get = jax.device_get
+        orig_put = jax.device_put
+
+        def block_until_ready(x):
+            if state["live"]:
+                ledger.count_dispatch()
+            return orig_block(x)
+
+        def device_get(x):
+            if state["live"]:
+                ledger.count_fetch(_tree_nbytes(x))
+            return orig_get(x)
+
+        def device_put(x, *a, **k):
+            if state["live"]:
+                ledger.count_upload(_tree_nbytes(x))
+            return orig_put(x, *a, **k)
+
+        jax.block_until_ready = block_until_ready
+        jax.device_get = device_get
+        jax.device_put = device_put
+        restores = [
+            ("block_until_ready", orig_block),
+            ("device_get", orig_get),
+            ("device_put", orig_put),
+        ]
+
+    def uninstall() -> None:
+        global _installed_uninstall
+        state["live"] = False
+        _compiler.backend_compile = orig_bc
+        for attr, orig in restores:
+            setattr(jax, attr, orig)
+        try:
+            from jax._src import monitoring as _mon
+
+            _mon._unregister_event_listener_by_callback(_on_event)
+        except Exception:
+            pass  # dead listener stays registered but inert (live flag)
+        _installed_uninstall = None
+
+    _installed_uninstall = uninstall
+    return uninstall
+
+
+@contextlib.contextmanager
+def no_new_compiles(tag: str = "steady-state", allow: int = 0) -> Iterator[Ledger]:
+    """Assert a region triggers no fresh XLA compiles (the recompile
+    sentinel).  Reuses the active observer's ledger when one is installed,
+    else installs a temporary compile-only hook.  Raises
+    :class:`RecompileError` naming each fresh module and its abstract input
+    shapes when more than ``allow`` compiles happen.
+    """
+    from cpgisland_tpu import obs
+
+    ob = obs.current()
+    if ob is not None:
+        led: Ledger = ob.ledger
+        un = None
+    else:
+        led = Ledger()
+        un = install(led, compile_only=True)
+    start = led.compiles
+    try:
+        yield led
+        new = led.compiles - start
+        if ob is not None:
+            ob.emit_event("recompile_sentinel", tag=tag, new_compiles=new)
+        if new > allow:
+            fresh = led.compile_records[-min(new, len(led.compile_records)):]
+            detail = "; ".join(
+                f"{r['name']}({', '.join(r['arg_types'][:6])})" for r in fresh
+            )
+            raise RecompileError(
+                f"recompile sentinel [{tag}]: {new} fresh XLA compile(s) in a "
+                f"region asserted compile-free (allow={allow}): {detail}",
+                fresh,
+            )
+    finally:
+        if un is not None:
+            un()

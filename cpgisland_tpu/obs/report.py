@@ -1,0 +1,154 @@
+"""Render obs metrics into the end-of-run report table.
+
+Two entry points share one renderer: :func:`render_summary` formats a live
+``Observer.summary()`` dict (the CLI's ``--obs-report``), and
+:func:`summarize_jsonl` rebuilds the same structure from a metrics JSONL
+file on disk (``tools/obs_report.py``) — so a production run's phase walls,
+dispatch/compile counts, transfer bytes, and per-phase engine choices are
+reconstructable from the metrics stream alone, with no live process.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import IO, Iterable, Union
+
+_LEDGER_KEYS = ("compiles", "compile_s", "dispatches", "fetch_bytes", "upload_bytes")
+
+
+def summarize_jsonl(source: Union[str, IO[str], Iterable[str]]) -> dict:
+    """Aggregate a metrics JSONL stream into an Observer.summary()-shaped
+    dict.  Span records aggregate by name; ``engine_decision`` (and other
+    deduped) events count by payload; a trailing ``obs_summary`` record, when
+    present, supplies authoritative ledger totals and dedupe counts (the
+    stream only carries first occurrences of deduped events)."""
+    own = isinstance(source, str)
+    f = open(source) if own else source
+    spans: dict = {}
+    decisions: dict = {}
+    engine_by_span: dict = {}
+    ledger: dict = {}
+    violations: list = []
+    summary_rec = None
+    try:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue  # a clipped tail line must not sink the report
+            ev = rec.get("event")
+            if ev == "span":
+                name = rec.get("name", "?")
+                a = spans.setdefault(
+                    name,
+                    {
+                        "count": 0, "wall_s": 0.0, "items": 0.0,
+                        "unit": rec.get("unit", "items"),
+                        "compiles": 0, "compile_s": 0.0, "dispatches": 0,
+                        "fetch_bytes": 0, "upload_bytes": 0,
+                    },
+                )
+                a["count"] += 1
+                a["wall_s"] += rec.get("wall_s", 0.0)
+                a["items"] += rec.get("items", 0.0)
+                for k in _LEDGER_KEYS:
+                    a[k] += rec.get(k, 0)
+            elif ev == "engine_decision":
+                label = "engine_decision{" + ", ".join(
+                    f"{k}={rec[k]}"
+                    for k in sorted(rec)
+                    if k not in ("ts", "event", "process_index")
+                ) + "}"
+                decisions[label] = decisions.get(label, 0) + 1
+                if rec.get("span") and rec.get("choice") is not None:
+                    engine_by_span.setdefault(rec["span"], set()).add(
+                        f"{rec.get('site')}->{rec.get('choice')}"
+                    )
+            elif ev == "obs_summary":
+                summary_rec = rec
+    finally:
+        if own:
+            f.close()
+    if summary_rec is not None:
+        ledger = summary_rec.get("ledger", {})
+        # The stream carries only first occurrences of deduped events; the
+        # summary has the true counts.
+        decisions = summary_rec.get("decisions", decisions)
+        violations = summary_rec.get("watchdog_violations", [])
+    out = {
+        "spans": spans,
+        "ledger": ledger,
+        "decisions": decisions,
+        "watchdog_violations": violations,
+        "engine_by_span": {k: sorted(v) for k, v in engine_by_span.items()},
+    }
+    if summary_rec is not None:
+        out["process_index"] = summary_rec.get("process_index", 0)
+    return out
+
+
+def _mb(n: float) -> str:
+    return f"{n / 2**20:.1f}"
+
+
+def render_summary(summary: dict) -> str:
+    """One fixed-width table: per-phase wall, items, throughput, dispatches,
+    compiles, transfer bytes — then engine decisions, ledger totals, and any
+    watchdog violations."""
+    lines = []
+    spans = summary.get("spans", {})
+    hdr = (
+        f"{'phase':<16}{'count':>6}{'wall_s':>9}{'items':>14}{'Msym/s':>9}"
+        f"{'disp':>6}{'comp':>6}{'comp_s':>8}{'fetchMB':>9}{'upMB':>8}"
+    )
+    lines.append(hdr)
+    lines.append("-" * len(hdr))
+    for name, a in spans.items():
+        tput = a["items"] / a["wall_s"] / 1e6 if a["wall_s"] > 0 and a["items"] else 0.0
+        lines.append(
+            f"{name:<16}{a['count']:>6}{a['wall_s']:>9.3f}{a['items']:>14.0f}"
+            f"{tput:>9.1f}{a['dispatches']:>6}{a['compiles']:>6}"
+            f"{a['compile_s']:>8.3f}{_mb(a['fetch_bytes']):>9}"
+            f"{_mb(a['upload_bytes']):>8}"
+        )
+    engine_by_span = summary.get("engine_by_span") or {}
+    if engine_by_span:
+        lines.append("")
+        lines.append("engine per phase:")
+        for name, choices in engine_by_span.items():
+            lines.append(f"  {name}: {'; '.join(choices)}")
+    decisions = summary.get("decisions", {})
+    if decisions:
+        lines.append("")
+        lines.append("decisions:")
+        for label, n in decisions.items():
+            lines.append(f"  {n:>6}x {label}")
+    ledger = summary.get("ledger", {})
+    if ledger:
+        lines.append("")
+        lines.append(
+            "ledger totals: "
+            f"compiles={ledger.get('compiles', 0)} "
+            f"({ledger.get('compile_s', 0.0):.2f}s), "
+            f"cache_hits={ledger.get('cache_hits', 0)}, "
+            f"dispatches={ledger.get('dispatches', 0)}, "
+            f"fetched {_mb(ledger.get('fetch_bytes', 0))} MB, "
+            f"uploaded {_mb(ledger.get('upload_bytes', 0))} MB"
+        )
+    viol = summary.get("watchdog_violations", [])
+    if viol:
+        lines.append("")
+        lines.append(f"WATCHDOG: {len(viol)} implausible-throughput flag(s):")
+        for v in viol:
+            lines.append(
+                f"  {v['name']}: {v['msym_per_s']} Msym/s "
+                f"(ceiling {v['ceiling_msym_per_s']})"
+            )
+    return "\n".join(lines)
+
+
+def render_file(path: str) -> str:
+    return render_summary(summarize_jsonl(path))

@@ -1,0 +1,162 @@
+"""Hierarchical span tracing (the Dapper model, host-side).
+
+A :class:`Tracer` records parent/child spans around pipeline phases (encode,
+EM iterations, decode span sweeps, island calling, multi-host gathers) with
+wall time, caller-defined item counts, and the owning process index.  Every
+completed span carries the :class:`~cpgisland_tpu.obs.ledger.Ledger` deltas
+accumulated while it was innermost-or-ancestor (children are included in
+their parents — spans nest, counters aggregate upward), so a metrics stream
+alone reconstructs where compiles, blocking dispatches, and transfer bytes
+went.
+
+Export targets:
+
+- JSONL ``span`` events through the owning Observer's MetricsLogger
+  (``cpgisland_tpu.obs.Observer`` wires this up);
+- a Chrome-trace / Perfetto-loadable JSON (``write_chrome_trace``): one
+  complete ("ph": "X") event per span, ``pid`` = JAX process index,
+  microsecond timestamps relative to tracer start.
+
+No jax import at module level: tracing must be constructible before platform
+selection (the CLI picks the backend after parsing flags).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import json
+import sys
+import time
+from typing import Iterator, Optional
+
+# Dropping spans beyond this bound trades perfect traces on degenerate
+# million-record inputs for bounded host memory; the drop count is reported.
+MAX_SPANS = 100_000
+
+
+def process_index_or_none():
+    """JAX process index WITHOUT triggering backend initialization, or None
+    while it is undecidable (jax not imported / backend not initialized yet).
+
+    Calling ``jax.process_index()`` eagerly would initialize the backend and
+    defeat the CLI's deferred platform selection, so this only reads it once
+    a backend exists.  Callers that demote on non-zero ranks must NOT cache
+    a None-as-0 answer: before ``jax.distributed.initialize`` every host
+    looks like process 0 (the MetricsLogger re-resolves until decidable).
+    """
+    jax = sys.modules.get("jax")
+    if jax is None:
+        return None
+    try:
+        from jax._src import xla_bridge
+
+        if not xla_bridge._backends:
+            return None
+        return jax.process_index()
+    except Exception:
+        return None
+
+
+def process_index() -> int:
+    """Like :func:`process_index_or_none` but 0 when undecidable."""
+    idx = process_index_or_none()
+    return 0 if idx is None else idx
+
+
+@dataclasses.dataclass
+class SpanRecord:
+    name: str
+    span_id: int
+    parent_id: int  # 0 = root
+    depth: int
+    t0_s: float  # relative to tracer start
+    wall_s: float = 0.0
+    items: float = 0.0
+    unit: str = "items"
+    attrs: dict = dataclasses.field(default_factory=dict)
+    counters: dict = dataclasses.field(default_factory=dict)  # ledger deltas
+
+
+class Tracer:
+    """Span stack + completed-span log.  Host code here is single-threaded
+    (the pipeline drivers), so a plain list stack suffices."""
+
+    def __init__(self, ledger=None, on_end=None) -> None:
+        self._ledger = ledger
+        self._on_end = on_end
+        self._t0 = time.perf_counter()
+        self._stack: list[SpanRecord] = []
+        self._next_id = 1
+        self.spans: list[SpanRecord] = []
+        self.dropped = 0
+
+    @property
+    def current(self) -> Optional[SpanRecord]:
+        return self._stack[-1] if self._stack else None
+
+    @contextlib.contextmanager
+    def span(
+        self, name: str, items: float = 0.0, unit: str = "items", **attrs
+    ) -> Iterator[SpanRecord]:
+        parent = self._stack[-1] if self._stack else None
+        sp = SpanRecord(
+            name=name,
+            span_id=self._next_id,
+            parent_id=parent.span_id if parent else 0,
+            depth=len(self._stack),
+            t0_s=time.perf_counter() - self._t0,
+            items=items,
+            unit=unit,
+            attrs=dict(attrs),
+        )
+        self._next_id += 1
+        snap = self._ledger.snapshot() if self._ledger is not None else None
+        self._stack.append(sp)
+        try:
+            yield sp
+        finally:
+            sp.wall_s = time.perf_counter() - self._t0 - sp.t0_s
+            if snap is not None:
+                sp.counters = self._ledger.delta(snap)
+            self._stack.pop()
+            if len(self.spans) < MAX_SPANS:
+                self.spans.append(sp)
+            else:
+                self.dropped += 1
+            if self._on_end is not None:
+                self._on_end(sp)
+
+    # -- Chrome-trace export ------------------------------------------------
+
+    def to_chrome_trace(self) -> dict:
+        """Chrome-trace JSON object (the ``traceEvents`` array form) loadable
+        by chrome://tracing and Perfetto."""
+        pid = process_index()
+        events: list[dict] = [
+            {
+                "name": "process_name",
+                "ph": "M",
+                "pid": pid,
+                "tid": 0,
+                "args": {"name": f"cpgisland host {pid}"},
+            }
+        ]
+        for sp in self.spans:
+            args = {"items": sp.items, "unit": sp.unit, **sp.attrs, **sp.counters}
+            events.append(
+                {
+                    "name": sp.name,
+                    "ph": "X",
+                    "ts": round(sp.t0_s * 1e6, 3),
+                    "dur": round(sp.wall_s * 1e6, 3),
+                    "pid": pid,
+                    "tid": 0,
+                    "args": args,
+                }
+            )
+        return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+    def write_chrome_trace(self, path: str) -> None:
+        with open(path, "w") as f:
+            json.dump(self.to_chrome_trace(), f)

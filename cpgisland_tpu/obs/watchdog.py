@@ -1,0 +1,197 @@
+"""Plausibility watchdog: flag relay-phantom throughputs in ANY run.
+
+bench.py learned the hard way (CLAUDE.md r4) that the dev relay can serve
+PHANTOM ~0 ms results — ``block_until_ready`` returning without execution —
+which inflate throughput 5-100x.  Its defense, ``_check_plausible``, only
+protected benchmarks; this module generalizes it into the library so any
+instrumented run (an Observer span with ``unit="sym"``) is checked against
+per-path ceilings derived from the enforced BASELINE.md marker figures.
+
+Ceiling = ``factor`` (default 2.5) x the published Msym/s for that path —
+tight enough that a phantom inflating one path 5x is flagged, loose enough
+that genuine run-to-run variance never is — with a global
+``PLAUSIBLE_MAX_SYM_PER_S`` net above everything.  A flagged span means the
+numbers (and possibly the RESULTS — a phantom dispatch never executed) of
+that region are suspect: re-run in a fresh process.
+
+BASELINE.md markers are parsed with the same ``<!--num:key-->`` format
+tools/pubnum.py owns (tests assert the two regexes agree so they cannot
+drift).  When the repo docs aren't present (installed package), ceilings
+degrade to the global net only.
+
+No jax import: pure host-side arithmetic on span (items, seconds).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import re
+from typing import Optional
+
+log = logging.getLogger(__name__)
+
+# Must stay textually identical to tools/pubnum.py::_NUM_RE (drift-guarded
+# by tests/test_obs.py).
+NUM_RE = re.compile(r"<!--num:([\w.]+)-->([-\d.]+)<!--/num-->")
+
+# No single-chip path on this hardware exceeds ~2.2 Gsym/s; anything past
+# this outer net is a phantom result, not a measurement.
+PLAUSIBLE_MAX_SYM_PER_S = 20e9
+
+DEFAULT_CEILING_FACTOR = 2.5
+
+# bench.py path name -> enforced BASELINE.md marker key.
+PATH_BASELINE_KEY = {
+    "decode": "decode_msym",
+    "decode-2state": "decode2_msym",
+    "em": "em_msym",
+    "em-2state": "em2_msym",
+    "em-seq": "em_seq_msym",
+    "em-seq2d": "em_seq2d_msym",
+    "posterior": "posterior_msym",
+    "batched-decode": "batched_msym",
+}
+
+# Observer span name -> bench path whose ceiling applies.  Pipeline spans
+# include host work the kernel figures don't, so real runs sit far BELOW
+# these ceilings — only a phantom (or a >2.5x breakthrough) crosses them.
+SPAN_PATH = {
+    "decode": "decode",
+    "decode+islands": "decode",
+    "posterior": "posterior",
+    "span-totals": "posterior",
+    "em_iter": "em",
+}
+
+
+def _repo_root() -> str:
+    return os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+
+
+def baseline_numbers(baseline_path: Optional[str] = None) -> dict:
+    """{marker key: float} parsed from BASELINE.md; {} when unavailable."""
+    if baseline_path is None:
+        baseline_path = os.path.join(_repo_root(), "BASELINE.md")
+    try:
+        with open(baseline_path) as f:
+            text = f.read()
+    except OSError:
+        return {}
+    out = {}
+    for key, val in NUM_RE.findall(text):
+        try:
+            out[key] = float(val)
+        except ValueError:
+            continue
+    return out
+
+
+def path_ceilings(
+    factor: float = DEFAULT_CEILING_FACTOR,
+    baseline_path: Optional[str] = None,
+) -> dict:
+    """{bench path: ceiling in sym/s} from the enforced marker figures."""
+    nums = baseline_numbers(baseline_path)
+    return {
+        path: factor * nums[key] * 1e6
+        for path, key in PATH_BASELINE_KEY.items()
+        if key in nums
+    }
+
+
+class ImplausibleThroughput(RuntimeError):
+    pass
+
+
+class Watchdog:
+    """Per-span plausibility checks.
+
+    mode: "off" — disabled; "warn" (library default) — log + count, the run
+    continues (production must not crash on a measurement anomaly, but the
+    metrics stream records it); "raise" — bench behavior, the phase aborts
+    so a phantom can never enter a captured artifact.
+    """
+
+    def __init__(
+        self,
+        mode: str = "warn",
+        factor: float = DEFAULT_CEILING_FACTOR,
+        baseline_path: Optional[str] = None,
+    ) -> None:
+        if mode not in ("off", "warn", "raise"):
+            raise ValueError(f"watchdog mode must be off|warn|raise, got {mode!r}")
+        self.mode = mode
+        self.factor = factor
+        self._baseline_path = baseline_path
+        self._ceilings: Optional[dict] = None
+        self.violations: list[dict] = []
+
+    def _path_ceiling(self, path: Optional[str]) -> float:
+        if self._ceilings is None:
+            self._ceilings = path_ceilings(self.factor, self._baseline_path)
+        return self._ceilings.get(path, float("inf")) if path else float("inf")
+
+    @staticmethod
+    def _n_devices() -> int:
+        """Local device count WITHOUT initializing a backend (1 when
+        undecidable).  The marker figures are SINGLE-CHIP rates; a pipeline
+        span legitimately sustains ~n_devices x that on a mesh, so per-path
+        ceilings scale by it — a relay phantom still lands orders of
+        magnitude above."""
+        import sys
+
+        jax = sys.modules.get("jax")
+        if jax is None:
+            return 1
+        try:
+            from jax._src import xla_bridge
+
+            if not xla_bridge._backends:
+                return 1
+            return max(1, jax.local_device_count())
+        except Exception:
+            return 1
+
+    def check(
+        self, name: str, items: float, seconds: float, path: Optional[str] = None
+    ) -> Optional[dict]:
+        """Check one measurement; returns the violation record (also kept in
+        ``self.violations``) or None.  ``path`` defaults to the SPAN_PATH
+        mapping for ``name``."""
+        if self.mode == "off" or items <= 0 or seconds <= 0:
+            return None
+        tput = items / seconds
+        path = path if path is not None else SPAN_PATH.get(name)
+        ceiling = min(
+            self._path_ceiling(path) * self._n_devices(),
+            PLAUSIBLE_MAX_SYM_PER_S,
+        )
+        if tput <= ceiling:
+            return None
+        rec = {
+            "name": name,
+            "path": path,
+            "msym_per_s": round(tput / 1e6, 1),
+            "ceiling_msym_per_s": round(ceiling / 1e6, 1),
+        }
+        self.violations.append(rec)
+        msg = (
+            f"implausible throughput in {name!r}: {tput/1e6:.1f} Msym/s exceeds "
+            f"the {ceiling/1e6:.0f} Msym/s ceiling "
+            f"({self.factor}x the enforced BASELINE.md figure for "
+            f"{path!r})" if ceiling < PLAUSIBLE_MAX_SYM_PER_S else
+            f"implausible throughput in {name!r}: {tput/1e6:.1f} Msym/s exceeds "
+            f"the global {PLAUSIBLE_MAX_SYM_PER_S/1e6:.0f} Msym/s net"
+        )
+        msg += (
+            " — likely a relay phantom result (a dispatch that never "
+            "executed); results from this region are suspect, re-run in a "
+            "fresh process"
+        )
+        if self.mode == "raise":
+            raise ImplausibleThroughput(msg)
+        log.warning("%s", msg)
+        return rec

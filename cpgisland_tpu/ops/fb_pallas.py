@@ -105,7 +105,18 @@ def pick_lane_T(n: int, onehot: bool = False, long_lanes: bool = False) -> int:
 
     # Candidates ARE the rate table (one source of truth for the next
     # re-sweep); sorted longest-first so cost ties prefer the longer lane.
-    return min(sorted(rates, reverse=True), key=est_cost)
+    lane_T = min(sorted(rates, reverse=True), key=est_cost)
+    from cpgisland_tpu import obs
+
+    # n is bucketed to its power-of-two class for the dedupe key: raw record
+    # lengths are near-unique on real assemblies, and a distinct payload per
+    # length would defeat the dedupe (one JSONL line per scaffold).
+    obs.event(
+        "lane_geometry", _dedupe=True,
+        n_pow2=1 << max(int(n) - 1, 0).bit_length(), lane_T=lane_T,
+        onehot=onehot, long_lanes=long_lanes,
+    )
+    return lane_T
 
 
 def supports(params: HmmParams) -> bool:

@@ -405,11 +405,16 @@ def _cols_to_host(cols):
     anyway.  This is the [cap]-record-column twin of
     parallel.mesh.fetch_sharded_prefix's multi-host rule.
     """
+    from cpgisland_tpu import obs
+
     if any(not getattr(c, "is_fully_addressable", True) for c in cols):
         from jax.experimental import multihost_utils
 
-        return multihost_utils.process_allgather(tuple(cols), tiled=True)
-    return jax.device_get(cols)
+        with obs.span("multihost-gather", gather="island-call-columns"):
+            return obs.note_fetch(
+                multihost_utils.process_allgather(tuple(cols), tiled=True)
+            )
+    return jax.device_get(cols)  # counted by the obs ledger's device_get hook
 
 
 def _fetch_calls(

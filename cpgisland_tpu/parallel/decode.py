@@ -27,6 +27,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from cpgisland_tpu import obs as obs_mod
 from cpgisland_tpu.models.hmm import HmmParams
 from cpgisland_tpu.ops import viterbi_onehot, viterbi_pallas
 from cpgisland_tpu.ops.viterbi_parallel import (
@@ -50,12 +51,16 @@ def resolve_engine(engine: str, params: HmmParams) -> str:
     their 3-bit backpointer packing, else the XLA scans (incl. the CPU test
     mesh, where Pallas would run interpreted)."""
     if engine == "auto":
+        resolved = "xla"
         if jax.default_backend() == "tpu":
             if viterbi_onehot.supports(params):
-                return "onehot"
-            if viterbi_pallas.supports(params):
-                return "pallas"
-        return "xla"
+                resolved = "onehot"
+            elif viterbi_pallas.supports(params):
+                resolved = "pallas"
+        obs_mod.engine_decision(
+            site="decode.resolve_engine", choice=resolved, requested=engine
+        )
+        return resolved
     if engine not in ("xla", "pallas", "onehot"):
         raise ValueError(f"unknown engine {engine!r}; expected auto|xla|pallas|onehot")
     if engine == "pallas" and not viterbi_pallas.supports(params):
@@ -65,6 +70,9 @@ def resolve_engine(engine: str, params: HmmParams) -> str:
             "onehot engine needs one-hot emissions with 2 states per symbol "
             "(concrete params)"
         )
+    obs_mod.engine_decision(
+        site="decode.resolve_engine", choice=engine, requested=engine
+    )
     return engine
 
 
@@ -76,8 +84,13 @@ def _engine_for_record(eng: str, obs: np.ndarray, params: HmmParams) -> str:
     kernels only on TPU and only when the 3-bit backpointer packing fits."""
     if eng == "onehot" and (obs.shape[0] == 0 or int(obs[0]) >= params.n_symbols):
         if jax.default_backend() == "tpu" and viterbi_pallas.supports(params):
-            return "pallas"
-        return "xla"
+            demoted = "pallas"
+        else:
+            demoted = "xla"
+        obs_mod.engine_decision(
+            site="decode.pad_first_demotion", choice=demoted, requested=eng
+        )
+        return demoted
     return eng
 
 

@@ -179,8 +179,13 @@ def fetch_sharded_prefix(x, T: int, return_device: bool):
     """
     if return_device:
         return x[:T]
+    from cpgisland_tpu import obs
+
     if not x.is_fully_addressable:
         from jax.experimental import multihost_utils
 
-        return np.asarray(multihost_utils.process_allgather(x, tiled=True))[:T]
-    return np.asarray(x)[:T]
+        with obs.span("multihost-gather", items=float(T), unit="sym"):
+            return obs.note_fetch(
+                np.asarray(multihost_utils.process_allgather(x, tiled=True))
+            )[:T]
+    return obs.note_fetch(np.asarray(x))[:T]

@@ -50,14 +50,17 @@ def resolve_fb_engine(engine: str, params: HmmParams) -> str:
     emission structure supports them (ops.fb_onehot — the flagship 8-state
     preset does), else the dense fused kernels when the model fits their
     lane packing, else the XLA lane path (incl. the CPU test mesh)."""
+    from cpgisland_tpu import obs as obs_mod
     from cpgisland_tpu.ops import fb_onehot
 
     if engine == "auto":
+        resolved = "xla"
         if jax.default_backend() == "tpu" and fb_pallas.supports(params):
-            if fb_onehot.supports(params):
-                return "onehot"
-            return "pallas"
-        return "xla"
+            resolved = "onehot" if fb_onehot.supports(params) else "pallas"
+        obs_mod.engine_decision(
+            site="posterior.resolve_fb_engine", choice=resolved, requested=engine
+        )
+        return resolved
     if engine not in ("xla", "pallas", "onehot"):
         raise ValueError(
             f"unknown engine {engine!r}; expected auto|xla|pallas|onehot"
@@ -73,6 +76,9 @@ def resolve_fb_engine(engine: str, params: HmmParams) -> str:
             "onehot FB kernels need one-hot emissions with 2 states per "
             "symbol (concrete params)"
         )
+    obs_mod.engine_decision(
+        site="posterior.resolve_fb_engine", choice=engine, requested=engine
+    )
     return engine
 
 

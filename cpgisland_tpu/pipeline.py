@@ -28,6 +28,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from cpgisland_tpu import obs
 from cpgisland_tpu.models import presets
 from cpgisland_tpu.models.hmm import HmmParams, dump_text
 from cpgisland_tpu.ops import islands as islands_mod
@@ -103,6 +104,38 @@ def train_file(
         params = presets.durbin_cpg8()
     if symbol_cache is not None and compat:
         raise ValueError("symbol_cache is FASTA-aware — use compat=False (--clean)")
+    with obs.span("encode", unit="sym") as _enc_span:
+        chunked = _train_input(
+            training_path, params, backend, compat, chunk_size, symbol_cache
+        )
+        if _enc_span is not None:
+            _enc_span.items = float(chunked.total)
+    result = baum_welch.fit(
+        params,
+        chunked,
+        num_iters=num_iters,
+        convergence=convergence,
+        backend=backend,
+        mode=mode,
+        engine=engine,
+        checkpoint_dir=checkpoint_dir,
+        metrics=metrics,
+    )
+    if model_out is not None:
+        dump_text(result.params, model_out)
+    return result
+
+
+def _train_input(
+    training_path: str,
+    params: HmmParams,
+    backend,
+    compat: bool,
+    chunk_size: int,
+    symbol_cache: Optional[str],
+):
+    """Build train_file's chunked input (encode + frame/bucket/shard) —
+    a Chunked, Bucketed, or LocalShard depending on backend/topology."""
     if backend == "seq2d":
         if compat:
             raise ValueError(
@@ -154,20 +187,7 @@ def train_file(
         )
         log.info("training input: %d symbols", symbols.size)
         chunked = chunking.frame(symbols, chunk_size, drop_remainder=compat)
-    result = baum_welch.fit(
-        params,
-        chunked,
-        num_iters=num_iters,
-        convergence=convergence,
-        backend=backend,
-        mode=mode,
-        engine=engine,
-        checkpoint_dir=checkpoint_dir,
-        metrics=metrics,
-    )
-    if model_out is not None:
-        dump_text(result.params, model_out)
-    return result
+    return chunked
 
 
 def island_layout_error(params: HmmParams, island_states=None) -> Optional[str]:
@@ -386,7 +406,7 @@ def decode_file(
                 # publishes attribute work where it happened.
                 jax.block_until_ready(full)
             else:
-                full = np.concatenate(pieces)
+                full = obs.note_fetch(np.concatenate(pieces))
         with timer.phase("islands", items=float(symbols.size), unit="sym"):
             if use_device_islands and island_states is not None:
                 from cpgisland_tpu.ops.islands_device import call_islands_device_obs
@@ -517,6 +537,11 @@ def _resolve_island_engine(
         and device_eligible
         and jax.default_backend() == "tpu"
     )
+    obs.engine_decision(
+        site="island_engine",
+        choice="device" if use_device_islands else "host",
+        requested=island_engine,
+    )
     if island_cap is None:
         from cpgisland_tpu.ops.islands_device import DEFAULT_CAP
 
@@ -560,6 +585,10 @@ def _device_calls_retry(fn, *args, cap_box: list, **kwargs):
             # clamp enforces.
             new_cap = min(
                 _round_pow2(e.n + 1, floor=2 * cap_box[0]), ISLAND_CAP_CEILING
+            )
+            obs.event(
+                "island_cap_retry", n_calls=int(e.n), old_cap=cap_box[0],
+                new_cap=new_cap,
             )
             log.warning(
                 "island calls (%d) overflowed cap=%d; retrying the on-device "
@@ -670,7 +699,7 @@ def _decode_small_batch(
         # uint8 upload (the decoders cast on device): the host->device
         # transfer is the measured end-to-end bottleneck — don't 4x it.
         paths = batch_decode(
-            params, jnp.asarray(rows), jnp.asarray(lengths),
+            params, jnp.asarray(obs.note_upload(rows)), jnp.asarray(lengths),
             return_score=False,
         )
         if use_device_islands:
@@ -678,7 +707,7 @@ def _decode_small_batch(
             # (async dispatch would bill it to the islands phase).
             jax.block_until_ready(paths)
         else:
-            paths = np.asarray(paths)
+            paths = obs.note_fetch(np.asarray(paths))
 
     parts: list[IslandCalls] = []
     paths_out: list[np.ndarray] = []
@@ -701,7 +730,7 @@ def _decode_small_batch(
                     )
                 parts.append(calls.with_names(name or "."))
     if want_paths:
-        host = np.asarray(paths)
+        host = obs.note_fetch(np.asarray(paths))
         paths_out = [host[i, : s.size].astype(np.int8) for i, (_, s) in enumerate(batch)]
     return B, parts, paths_out
 
@@ -950,8 +979,11 @@ def posterior_file(
                         # kernel time is billed to this phase.
                         jax.block_until_ready(path2)
                     else:
-                        conf2 = np.asarray(conf2)
-                        path2 = np.asarray(path2) if want_path else None
+                        conf2 = obs.note_fetch(np.asarray(conf2))
+                        path2 = (
+                            obs.note_fetch(np.asarray(path2))
+                            if want_path else None
+                        )
                 if use_device_islands:
                     with timer.phase("islands", items=total, unit="sym"):
                         g_calls = _batched_device_calls(
@@ -963,7 +995,7 @@ def posterior_file(
                             min_len=min_len, cap_box=cap_box,
                         )
                     if want_conf:
-                        conf_host = np.asarray(conf2)
+                        conf_host = obs.note_fetch(np.asarray(conf2))
                     else:
                         in_rec = (
                             jnp.arange(Tpad)[None, :]

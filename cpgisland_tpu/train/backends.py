@@ -26,6 +26,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from cpgisland_tpu import obs
 from cpgisland_tpu.models.hmm import HmmParams
 from cpgisland_tpu.ops import fb_pallas
 from cpgisland_tpu.ops.forward_backward import SuffStats, batch_stats, chunk_stats
@@ -38,6 +39,7 @@ def resolve_fb_engine(engine: str, params: HmmParams, mode: str) -> str:
     """'auto' picks the Pallas E-step kernels on TPU for rescaled numerics
     (the only mode they implement), the XLA scans otherwise."""
     if engine == "auto":
+        resolved = "xla"
         if (
             jax.default_backend() == "tpu"
             and mode == "rescaled"
@@ -57,9 +59,14 @@ def resolve_fb_engine(engine: str, params: HmmParams, mode: str) -> str:
                 fb_onehot.supports(params)
                 and params.n_symbols & (params.n_symbols - 1) == 0
             ):
-                return "onehot"
-            return "pallas"
-        return "xla"
+                resolved = "onehot"
+            else:
+                resolved = "pallas"
+        obs.engine_decision(
+            site="train.resolve_fb_engine", choice=resolved,
+            requested=engine, mode=mode,
+        )
+        return resolved
     if engine not in ("xla", "pallas", "onehot"):
         raise ValueError(
             f"unknown engine {engine!r}; expected auto|xla|pallas|onehot"
@@ -306,6 +313,10 @@ def _check_seq_shard(shard_len: int, what: str) -> None:
             if what == "Seq2DBackend"
             else "a bigger mesh, or per-record rows with backend='seq2d'"
         )
+        obs.event(
+            "seq_shard_budget_reject", shard_len=shard_len, backend=what,
+            budget=SEQ_SHARD_BUDGET,
+        )
         raise ValueError(
             f"{what}: per-device shard of {shard_len} symbols exceeds the "
             f"~{SEQ_SHARD_BUDGET >> 20} Mi single-chip whole-sequence "
@@ -444,6 +455,10 @@ class SeqBackend(EStepBackend):
         # engine always wins.
         if _use_fused_seq(self.engine, params, obs_flat.shape[0] // n_dev):
             oh = _seq_onehot(self.engine, params)
+            obs.engine_decision(
+                site="seq_backend", choice="onehot" if oh else "pallas",
+                requested=self.engine, n_dev=n_dev,
+            )
             # 131072 lanes are safe only when the kernelized seq stats runs
             # (power-of-two n_symbols — n_symbols is static shape info).
             long_ok = oh and params.n_symbols & (params.n_symbols - 1) == 0
@@ -463,6 +478,9 @@ class SeqBackend(EStepBackend):
                 self.mesh, lane_T, self.t_tile, oh
             )
             return fn(params, obs_flat, lengths)
+        obs.engine_decision(
+            site="seq_backend", choice="xla", requested=self.engine, n_dev=n_dev
+        )
         fn = fb_sharded.sharded_stats_fn(self.mesh, self.block_size)
         return fn(params, obs_flat, lengths)
 
@@ -587,6 +605,10 @@ class Seq2DBackend(EStepBackend):
             # Engine routing/validation = LocalBackend's resolver (this IS a
             # chunked path — the whole-seq 1 Mi fused gate does not apply).
             eng = resolve_fb_engine(self.engine, params, "rescaled")
+            obs.engine_decision(
+                site="seq2d_backend", choice=f"rows-chunked:{eng}",
+                requested=self.engine,
+            )
             fn = fb_sharded.sharded_stats2d_rows_fn(
                 mesh, eng,
                 self.t_tile if self.t_tile is not None else fb_pallas.DEFAULT_T_TILE,
@@ -596,6 +618,9 @@ class Seq2DBackend(EStepBackend):
             ("onehot" if _seq_onehot(self.engine, params) else "pallas")
             if _use_fused_seq(self.engine, params, chunks.shape[1] // sp)
             else "xla"
+        )
+        obs.engine_decision(
+            site="seq2d_backend", choice=engine, requested=self.engine, sp=sp
         )
         # The XLA body ignores the kernel tile knobs — normalize them out of
         # the compile-cache key so differently-tuned backends share one

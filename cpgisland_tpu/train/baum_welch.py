@@ -29,6 +29,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from cpgisland_tpu import obs
 from cpgisland_tpu.models.hmm import HmmParams
 from cpgisland_tpu.ops.forward_backward import SuffStats
 from cpgisland_tpu.train.backends import EStepBackend, get_backend
@@ -117,38 +118,41 @@ def fit(
     recoveries: list[tuple[int, str]] = []
     converged = False
     it = 0
+    n_sym = float(getattr(chunked, "total", 0.0))
     for it in range(start_iteration + 1, start_iteration + num_iters + 1):
         t0 = time.perf_counter()
         stats = None
-        for attempt in range(3):
-            try:
-                cand = backend(params, chunks, lengths)
-                profiling.check_finite(cand, where=f"E-step iter {it}")
-                stats = cand
-                break
-            # Only fault-shaped errors are retried/recovered: RuntimeError
-            # covers jaxlib's XlaRuntimeError (OOM, preemption, interconnect),
-            # FloatingPointError is check_finite.  Programming errors
-            # (ValueError/TypeError) must surface, not reroute to a fallback.
-            except (RuntimeError, FloatingPointError) as e:
-                reason = f"iter {it} attempt {attempt + 1}: {e}"
-                log.warning("E-step failed (%s)", reason)
-                if metrics is not None:
-                    metrics.log("em_estep_failure", iteration=it, attempt=attempt + 1,
-                                error=str(e))
-                if attempt == 0:
-                    continue  # transient-fault retry on the same backend
-                if attempt == 1 and fallback_backend is not None:
-                    log.warning("switching to fallback E-step backend at iter %d", it)
-                    recoveries.append((it, reason))
-                    backend = fallback_backend
-                    chunked = backend.prepare(chunked0)
-                    chunks, lengths = backend.place(chunked.chunks, chunked.lengths)
-                    continue
-                raise
-        new_params = mstep(params, stats)
-        delta = float(new_params.max_abs_diff(params))
-        ll = float(stats.loglik)
+        with obs.span("em_iter", items=n_sym, unit="sym", iteration=it):
+            for attempt in range(3):
+                try:
+                    cand = backend(params, chunks, lengths)
+                    profiling.check_finite(cand, where=f"E-step iter {it}")
+                    stats = cand
+                    break
+                # Only fault-shaped errors are retried/recovered: RuntimeError
+                # covers jaxlib's XlaRuntimeError (OOM, preemption,
+                # interconnect), FloatingPointError is check_finite.
+                # Programming errors (ValueError/TypeError) must surface, not
+                # reroute to a fallback.
+                except (RuntimeError, FloatingPointError) as e:
+                    reason = f"iter {it} attempt {attempt + 1}: {e}"
+                    log.warning("E-step failed (%s)", reason)
+                    if metrics is not None:
+                        metrics.log("em_estep_failure", iteration=it,
+                                    attempt=attempt + 1, error=str(e))
+                    if attempt == 0:
+                        continue  # transient-fault retry on the same backend
+                    if attempt == 1 and fallback_backend is not None:
+                        log.warning("switching to fallback E-step backend at iter %d", it)
+                        recoveries.append((it, reason))
+                        backend = fallback_backend
+                        chunked = backend.prepare(chunked0)
+                        chunks, lengths = backend.place(chunked.chunks, chunked.lengths)
+                        continue
+                    raise
+            new_params = mstep(params, stats)
+            delta = float(new_params.max_abs_diff(params))
+            ll = float(stats.loglik)
         params = new_params
         logliks.append(ll)
         deltas.append(delta)

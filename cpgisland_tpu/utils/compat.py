@@ -1,0 +1,40 @@
+"""JAX version shims.
+
+The framework targets the current jax API (``jax.shard_map`` with
+``check_vma``); CI images sometimes carry an older jax (0.4.x) where
+shard_map still lives at ``jax.experimental.shard_map.shard_map`` with the
+``check_rep`` spelling.  :func:`install` bridges the gap in-place so every
+call site can use the one modern spelling — a no-op on current jax.
+"""
+
+from __future__ import annotations
+
+import functools
+
+
+def install() -> None:
+    import jax
+
+    if not hasattr(jax.lax, "axis_size"):
+        def axis_size(axis_name):
+            from jax._src import core as _core
+
+            return _core.get_axis_env().axis_size(axis_name)
+
+        jax.lax.axis_size = axis_size
+
+    if hasattr(jax, "shard_map"):
+        return
+    try:
+        from jax.experimental.shard_map import shard_map as _legacy
+    except ImportError:  # pragma: no cover - no known jax lacks both
+        return
+
+    @functools.wraps(_legacy)
+    def shard_map(f, *, mesh, in_specs, out_specs, check_vma: bool = True, **kw):
+        return _legacy(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_rep=check_vma, **kw,
+        )
+
+    jax.shard_map = shard_map

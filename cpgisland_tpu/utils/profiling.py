@@ -70,15 +70,32 @@ class PhaseTimer:
 
     @contextlib.contextmanager
     def phase(self, name: str, items: float = 0.0, unit: str = "items") -> Iterator[None]:
+        # Mirror every phase as an obs span (no-op until an Observer is
+        # active): the pipeline's existing timing discipline IS the span
+        # instrumentation, so enabling telemetry adds no new sync points.
+        from cpgisland_tpu import obs
+
         t0 = time.perf_counter()
         try:
-            yield
+            with obs.span(name, items=items, unit=unit):
+                yield
         finally:
             dt = time.perf_counter() - t0
             p = self.phases.setdefault(name, Phase(name, unit=unit))
             p.seconds += dt
-            p.items += items
-            p.unit = unit
+            if unit == p.unit:
+                p.items += items
+            else:
+                # Keep the FIRST unit and DROP the mismatched items:
+                # last-writer-wins silently corrupted throughput math, and
+                # summing chunks into syms would corrupt it just as silently.
+                # Wall time still accumulates (it is unit-independent).
+                log.warning(
+                    "phase %r re-entered with unit %r; keeping first unit %r "
+                    "and dropping the %s mismatched items (summing mixed "
+                    "units would corrupt throughput)",
+                    name, unit, p.unit, items,
+                )
 
     def report(self) -> str:
         lines = []
@@ -93,23 +110,90 @@ class PhaseTimer:
             for p in self.phases.values()
         }
 
+    @staticmethod
+    def merge(dicts: list) -> dict:
+        """Aggregate :meth:`as_dict` outputs from several hosts into one.
+
+        Hosts run phases CONCURRENTLY in a pod job, so per-phase wall is the
+        MAX across hosts and items SUM; throughput is recomputed as
+        sum-items / max-wall — the meaningful cross-host rate.  Mismatched
+        units for the same phase raise (summing syms into chunks is the
+        corruption the unit fix above exists to prevent).
+        """
+        out: dict = {}
+        for d in dicts:
+            for name, rec in d.items():
+                unit_keys = [
+                    k for k in rec if k not in ("seconds", "throughput")
+                ]
+                unit = unit_keys[0] if unit_keys else "items"
+                if name not in out:
+                    out[name] = {"seconds": 0.0, unit: 0.0}
+                prev_units = [
+                    k for k in out[name] if k not in ("seconds", "throughput")
+                ]
+                if prev_units and unit != prev_units[0]:
+                    raise ValueError(
+                        f"phase {name!r}: unit mismatch across hosts "
+                        f"({prev_units[0]!r} vs {unit!r})"
+                    )
+                out[name]["seconds"] = max(out[name]["seconds"], rec["seconds"])
+                out[name][unit] += rec.get(unit, 0.0)
+        for name, rec in out.items():
+            unit = [k for k in rec if k not in ("seconds", "throughput")][0]
+            rec["throughput"] = (
+                rec[unit] / rec["seconds"] if rec["seconds"] > 0 else 0.0
+            )
+        return out
+
 
 class MetricsLogger:
     """Append-only JSONL metrics stream.
 
-    Every record: ``{"ts": <unix float>, "event": <str>, ...fields}``.
-    ``MetricsLogger(None)`` (or the module-level :func:`null`) swallows events,
-    so instrumented code never needs None checks.
+    Every record: ``{"ts": <unix float>, "event": <str>,
+    "process_index": <int>, ...fields}``.  ``MetricsLogger(None)`` (or the
+    module-level :func:`null`) swallows events, so instrumented code never
+    needs None checks.
+
+    Multi-host safety: in a pod job every process runs the same driver code,
+    so a path sink would be written P times (or clobbered on shared
+    filesystems).  By default only process 0 writes — non-zero processes
+    demote to a null sink at first use; pass ``all_processes=True`` to keep
+    every host writing (give each its own path) — records carry
+    ``process_index`` either way, so merged streams stay attributable.  The
+    check re-resolves on every :meth:`log` call until the JAX backend is
+    actually initialized (resolving must not itself initialize it, and
+    before ``jax.distributed.initialize`` EVERY host looks like process 0 —
+    caching that answer would defeat the demotion); records written during
+    that window carry ``process_index: 0``.
     """
 
-    def __init__(self, sink: Optional[Union[str, IO[str]]] = None) -> None:
+    def __init__(
+        self,
+        sink: Optional[Union[str, IO[str]]] = None,
+        all_processes: bool = False,
+    ) -> None:
         self._own = isinstance(sink, str)
         self._f: Optional[IO[str]] = open(sink, "a") if self._own else sink
+        self._all_processes = all_processes
+        self._pidx: Optional[int] = None  # None = undecidable so far
 
     def log(self, event: str, **fields) -> None:
         if self._f is None:
             return
-        rec = {"ts": time.time(), "event": event}
+        pidx = self._pidx
+        if pidx is None:
+            from cpgisland_tpu.obs.trace import process_index_or_none
+
+            pidx = process_index_or_none()
+            if pidx is not None:
+                self._pidx = pidx  # decidable now: cache forever
+                if pidx != 0 and not self._all_processes:
+                    self.close()
+                    self._f = None
+                    return
+        rec = {"ts": time.time(), "event": event,
+               "process_index": 0 if pidx is None else pidx}
         rec.update(fields)
         self._f.write(json.dumps(rec, default=float) + "\n")
         self._f.flush()

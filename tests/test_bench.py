@@ -57,7 +57,7 @@ def test_per_path_plausibility_ceiling():
     """VERDICT r4 #6: every benched path has a tight ceiling (2.5x its
     enforced BASELINE.md figure) so a phantom 5x inflation raises."""
     ceilings = bench._path_ceilings()
-    for path in bench._BASELINE_KEY_BY_PATH:
+    for path in bench._baseline_key_by_path():
         assert path in ceilings, f"no BASELINE.md marker resolved for {path}"
         # Tighter than the global net, looser than the published figure.
         assert ceilings[path] < bench.PLAUSIBLE_MAX_SYM_PER_S

@@ -1,0 +1,328 @@
+"""Runtime telemetry subsystem (obs/): spans, ledger, sentinel, watchdog,
+engine-decision events, report tooling."""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+
+from cpgisland_tpu import obs, pipeline
+from cpgisland_tpu.models import presets
+from cpgisland_tpu.obs import ledger as ledger_mod
+from cpgisland_tpu.obs import report as report_mod
+from cpgisland_tpu.obs import watchdog as watchdog_mod
+from cpgisland_tpu.train import baum_welch
+from cpgisland_tpu.utils import chunking, codec
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _write_fasta(path, rng, n=4096):
+    path.write_text(">t\n" + codec.decode_symbols(rng.integers(0, 4, size=n)) + "\n")
+    return str(path)
+
+
+# ---------------------------------------------------------------------------
+# off-by-default contract
+
+
+def test_disabled_helpers_are_noops():
+    assert obs.current() is None and not obs.enabled()
+    with obs.span("nothing", items=5, unit="sym") as sp:
+        assert sp is None
+    obs.event("anything", x=1)
+    obs.engine_decision(site="s", choice="c")
+    arr = np.ones(4)
+    assert obs.note_fetch(arr) is arr
+    assert obs.note_upload(arr) is arr
+
+
+def test_disabled_leaves_jax_unpatched():
+    orig_block = jax.block_until_ready
+    orig_put = jax.device_put
+    with obs.observe():
+        assert jax.block_until_ready is not orig_block
+        assert jax.device_put is not orig_put
+    # exiting restores the original functions exactly
+    assert jax.block_until_ready is orig_block
+    assert jax.device_put is orig_put
+
+
+def test_no_observer_nesting():
+    with obs.observe():
+        with pytest.raises(RuntimeError, match="already active"):
+            obs.Observer().__enter__()
+
+
+# ---------------------------------------------------------------------------
+# spans + chrome trace
+
+
+def test_spans_nest_and_chrome_trace_validates(tmp_path):
+    mpath = tmp_path / "m.jsonl"
+    with obs.observe(metrics=str(mpath), trace_dir=str(tmp_path)) as ob:
+        with obs.span("outer", items=10, unit="sym"):
+            with obs.span("inner", items=4, unit="sym", extra="x"):
+                pass
+        assert [s.name for s in ob.tracer.spans] == ["inner", "outer"]
+
+    # JSONL span events carry hierarchy + process index
+    recs = [json.loads(ln) for ln in mpath.read_text().splitlines()]
+    spans = {r["name"]: r for r in recs if r["event"] == "span"}
+    assert spans["inner"]["depth"] == 1 and spans["outer"]["depth"] == 0
+    assert spans["inner"]["parent_id"] == spans["outer"]["span_id"]
+    assert all("process_index" in r for r in recs)
+    assert recs[-1]["event"] == "obs_summary"
+
+    # Chrome trace parses, has ph/ts/pid, and the child nests inside the
+    # parent's [ts, ts+dur] window.
+    tr = json.load(open(tmp_path / "trace.json"))
+    evs = [e for e in tr["traceEvents"] if e["ph"] == "X"]
+    assert evs and all({"ph", "ts", "dur", "pid", "name"} <= set(e) for e in evs)
+    by = {e["name"]: e for e in evs}
+    inner, outer = by["inner"], by["outer"]
+    assert outer["ts"] <= inner["ts"]
+    assert inner["ts"] + inner["dur"] <= outer["ts"] + outer["dur"] + 1e-3
+
+
+def test_span_counters_attribute_compiles_and_dispatches():
+    import jax.numpy as jnp
+
+    with obs.observe() as ob:
+        with obs.span("work"):
+            x = jax.jit(lambda v: v * 3 + 1)(jnp.arange(7))
+            jax.block_until_ready(x)
+            jax.device_get(x)
+    sp = ob.tracer.spans[0]
+    assert sp.counters["compiles"] >= 1
+    assert sp.counters["dispatches"] >= 2  # block + get
+    assert sp.counters["fetch_bytes"] >= x.nbytes
+    # eager helper compiles (jit_iota for arange) are recorded too; the
+    # jitted lambda's record carries its abstract input types
+    recs = ob.ledger.compile_records
+    assert any(
+        r["name"].startswith("jit_") and r["arg_types"] for r in recs
+    )
+
+
+# ---------------------------------------------------------------------------
+# recompile sentinel
+
+
+def test_sentinel_steady_state_em_zero_recompiles(rng):
+    """>= 2 steady-state fit iterations over fixed shapes trigger ZERO fresh
+    compiles after iteration 1 (the warm run)."""
+    syms = rng.integers(0, 4, size=4096).astype(np.uint8)
+    ck = chunking.frame(syms, 256)
+    warm = baum_welch.fit(presets.durbin_cpg8(), ck, num_iters=1, convergence=0.0)
+    with obs.no_new_compiles("steady-em") as led:
+        res = baum_welch.fit(warm.params, ck, num_iters=2, convergence=0.0)
+    assert res.iterations == 2
+    assert led.compiles == 0
+
+
+def test_sentinel_fires_on_shape_change(rng):
+    syms = rng.integers(0, 4, size=4096).astype(np.uint8)
+    warm = baum_welch.fit(
+        presets.durbin_cpg8(), chunking.frame(syms, 256), num_iters=1,
+        convergence=0.0,
+    )
+    with pytest.raises(ledger_mod.RecompileError, match="fresh XLA compile"):
+        with obs.no_new_compiles("shape-change"):
+            baum_welch.fit(
+                warm.params, chunking.frame(syms, 512), num_iters=1,
+                convergence=0.0,
+            )
+    # the hooks are gone again: a fresh-shape compile outside raises nothing
+    import jax.numpy as jnp
+
+    jax.jit(lambda v: v + 2)(jnp.arange(3))
+
+
+def test_sentinel_records_name_and_shapes(rng):
+    import jax.numpy as jnp
+
+    try:
+        with obs.no_new_compiles("probe"):
+            jax.jit(lambda v: v * 5)(jnp.arange(11))
+        raise AssertionError("sentinel did not fire")
+    except ledger_mod.RecompileError as e:
+        assert e.records
+        assert any("tensor<" in "".join(r["arg_types"]) for r in e.records)
+
+
+# ---------------------------------------------------------------------------
+# watchdog
+
+
+def test_watchdog_regex_matches_pubnum():
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        import pubnum
+    finally:
+        sys.path.pop(0)
+    assert pubnum._NUM_RE.pattern == watchdog_mod.NUM_RE.pattern
+
+
+def test_watchdog_ceilings_from_baseline():
+    ceils = watchdog_mod.path_ceilings()
+    assert "decode" in ceils and "posterior" in ceils and "em" in ceils
+    nums = watchdog_mod.baseline_numbers()
+    assert ceils["decode"] == pytest.approx(2.5 * nums["decode_msym"] * 1e6)
+
+
+def test_watchdog_modes():
+    wd = watchdog_mod.Watchdog(mode="warn")
+    # plausible: well under any ceiling
+    assert wd.check("decode", items=1e6, seconds=1.0) is None
+    # phantom-grade: far over the decode ceiling
+    v = wd.check("decode", items=1e12, seconds=0.01)
+    assert v is not None and wd.violations == [v]
+    with pytest.raises(watchdog_mod.ImplausibleThroughput):
+        watchdog_mod.Watchdog(mode="raise").check("decode", items=1e12, seconds=0.01)
+    assert watchdog_mod.Watchdog(mode="off").check("decode", 1e12, 0.01) is None
+
+
+def test_watchdog_flags_phantom_span(tmp_path):
+    """An instrumented span whose wall is phantom-fast is flagged in the
+    metrics stream (the library generalization of bench._check_plausible)."""
+    mpath = tmp_path / "m.jsonl"
+    with obs.observe(metrics=str(mpath)) as ob:
+        with ob.tracer.span("decode", items=1e12, unit="sym"):
+            pass  # ~0 wall => absurd Msym/s
+    assert ob.watchdog.violations
+    summary = [
+        json.loads(ln) for ln in mpath.read_text().splitlines()
+    ][-1]
+    assert summary["watchdog_violations"]
+
+
+# ---------------------------------------------------------------------------
+# engine-decision events through the real pipelines (virtual mesh)
+
+
+def test_pipeline_decode_and_posterior_emit_events(tmp_path, rng):
+    fa = _write_fasta(tmp_path / "g.fa", rng)
+    mpath = tmp_path / "m.jsonl"
+    with obs.observe(metrics=str(mpath), trace_dir=str(tmp_path)) as ob:
+        pipeline.decode_file(
+            fa, presets.durbin_cpg8(), compat=False, metrics=ob.metrics
+        )
+        pipeline.posterior_file(
+            fa, presets.durbin_cpg8(), islands_out=str(tmp_path / "i.txt"),
+            metrics=ob.metrics,
+        )
+    recs = [json.loads(ln) for ln in mpath.read_text().splitlines()]
+    decisions = [r for r in recs if r["event"] == "engine_decision"]
+    sites = {r["site"]: r["choice"] for r in decisions}
+    # On the CPU virtual mesh auto resolves to the XLA lowerings everywhere.
+    assert sites["decode.resolve_engine"] == "xla"
+    assert sites["posterior.resolve_fb_engine"] == "xla"
+    assert sites["island_engine"] == "host"
+    span_names = {r["name"] for r in recs if r["event"] == "span"}
+    assert {"decode", "islands", "posterior"} <= span_names
+    # the chrome trace covers the pipeline spans too
+    tr = json.load(open(tmp_path / "trace.json"))
+    assert {"decode", "posterior"} <= {
+        e["name"] for e in tr["traceEvents"] if e["ph"] == "X"
+    }
+
+
+def test_seq_shard_budget_reject_event(rng):
+    from cpgisland_tpu.train import backends
+
+    with obs.observe() as ob:
+        with pytest.raises(ValueError, match="budget"):
+            backends._check_seq_shard(backends.SEQ_SHARD_BUDGET + 1, "SeqBackend")
+    assert any(e["event"] == "seq_shard_budget_reject" for e in ob.events)
+
+
+def test_fit_emits_em_iter_spans(rng):
+    syms = rng.integers(0, 4, size=2048).astype(np.uint8)
+    ck = chunking.frame(syms, 256)
+    with obs.observe() as ob:
+        baum_welch.fit(presets.durbin_cpg8(), ck, num_iters=2, convergence=0.0)
+    iters = [s for s in ob.tracer.spans if s.name == "em_iter"]
+    assert len(iters) == 2
+    assert iters[0].items == float(ck.total)
+    assert iters[0].attrs["iteration"] == 1
+
+
+# ---------------------------------------------------------------------------
+# report tooling
+
+
+def test_obs_report_reconstructs_run(tmp_path, rng):
+    """Acceptance: from the JSONL alone, tools/obs_report.py reconstructs
+    phase walls, compile count, dispatch count, and the engine per phase."""
+    fa = _write_fasta(tmp_path / "g.fa", rng)
+    mpath = tmp_path / "m.jsonl"
+    with obs.observe(metrics=str(mpath)) as ob:
+        pipeline.posterior_file(
+            fa, presets.durbin_cpg8(), islands_out=str(tmp_path / "i.txt"),
+            metrics=ob.metrics,
+        )
+    summary = report_mod.summarize_jsonl(str(mpath))
+    assert summary["spans"]["posterior"]["wall_s"] > 0
+    assert summary["spans"]["posterior"]["count"] >= 1
+    ledger = summary["ledger"]
+    # compile count is reconstructable (0 when a prior test warmed the
+    # in-process caches — the count is still the truth for THIS region)
+    assert isinstance(ledger["compiles"], int)
+    assert ledger["dispatches"] >= 1
+    assert any(
+        "posterior.resolve_fb_engine" in label and "choice=xla" in label
+        for label in summary["decisions"]
+    )
+    text = report_mod.render_file(str(mpath))
+    assert "posterior" in text and "ledger totals" in text
+
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "obs_report.py"), str(mpath)],
+        capture_output=True, text=True,
+    )
+    assert out.returncode == 0, out.stderr[-500:]
+    assert "posterior" in out.stdout and "compiles=" in out.stdout
+
+
+def test_cli_obs_flags(tmp_path, rng):
+    from cpgisland_tpu import cli
+
+    fa = _write_fasta(tmp_path / "g.fa", rng, n=2048)
+    mpath = tmp_path / "m.jsonl"
+    rc = cli.main([
+        "decode", fa, "--clean", "--islands-out", str(tmp_path / "i.txt"),
+        "--metrics", str(mpath), "--obs-report",
+    ])
+    assert rc == 0
+    recs = [json.loads(ln) for ln in mpath.read_text().splitlines()]
+    assert any(r["event"] == "span" and r["name"] == "decode" for r in recs)
+    assert recs[-1]["event"] == "obs_summary"
+
+
+def test_bench_metrics_sidecar_smoke(tmp_path):
+    """bench.py --metrics-out writes the telemetry sidecar while stdout stays
+    ONE JSON line (tiny CPU config; tier-1-safe)."""
+    side = tmp_path / "bench.jsonl"
+    out = subprocess.run(
+        [
+            sys.executable, os.path.join(REPO, "bench.py"),
+            "--phase", "core", "--platform", "cpu",
+            "--decode-mib", "1", "--em-chunks", "4",
+            "--metrics-out", str(side),
+        ],
+        capture_output=True, text=True, timeout=1200, cwd=REPO,
+    )
+    assert out.returncode == 0, out.stderr[-800:]
+    stdout_lines = [ln for ln in out.stdout.splitlines() if ln.strip()]
+    assert len(stdout_lines) == 1
+    assert "decode_tput" in json.loads(stdout_lines[0])
+    recs = [json.loads(ln) for ln in side.read_text().splitlines()]
+    assert any(r["event"] == "bench_phase" for r in recs)
+    assert recs[-1]["event"] == "obs_summary"
+    assert recs[-1]["ledger"]["compiles"] >= 1

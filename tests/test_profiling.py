@@ -22,6 +22,48 @@ def test_phase_timer_accumulates():
     assert pt.as_dict()["a"]["sym"] == 200
 
 
+def test_phase_timer_keeps_first_unit_on_mismatch(caplog):
+    """Re-entering a phase with a different unit must not silently overwrite
+    the unit (last-writer-wins corrupted throughput math) — the first unit
+    wins and a warning is logged."""
+    import logging
+
+    pt = profiling.PhaseTimer()
+    with pt.phase("p", items=100, unit="sym"):
+        pass
+    with caplog.at_level(logging.WARNING, logger="cpgisland_tpu.utils.profiling"):
+        with pt.phase("p", items=2, unit="chunks"):
+            pass
+    assert pt.phases["p"].unit == "sym"
+    # mismatched items are dropped, not summed into the first unit's count
+    assert pt.phases["p"].items == 100
+    assert any("unit" in r.message for r in caplog.records)
+
+
+def test_phase_timer_merge_across_hosts():
+    """Cross-host aggregation: concurrent hosts => max wall, summed items."""
+    h0 = {"decode": {"seconds": 2.0, "sym": 100.0, "throughput": 50.0}}
+    h1 = {"decode": {"seconds": 4.0, "sym": 300.0, "throughput": 75.0},
+          "islands": {"seconds": 1.0, "sym": 300.0, "throughput": 300.0}}
+    merged = profiling.PhaseTimer.merge([h0, h1])
+    assert merged["decode"]["seconds"] == 4.0
+    assert merged["decode"]["sym"] == 400.0
+    assert merged["decode"]["throughput"] == 100.0
+    assert merged["islands"]["sym"] == 300.0
+    with pytest.raises(ValueError, match="unit mismatch"):
+        profiling.PhaseTimer.merge(
+            [h0, {"decode": {"seconds": 1.0, "chunks": 5.0, "throughput": 5.0}}]
+        )
+
+
+def test_metrics_logger_tags_process_index(tmp_path):
+    p = tmp_path / "m.jsonl"
+    with profiling.MetricsLogger(str(p)) as m:
+        m.log("e")
+    (rec,) = [json.loads(ln) for ln in p.read_text().splitlines()]
+    assert rec["process_index"] == 0  # single-process test env
+
+
 def test_metrics_logger_jsonl(tmp_path):
     p = tmp_path / "m.jsonl"
     with profiling.MetricsLogger(str(p)) as m:

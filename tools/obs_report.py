@@ -1,0 +1,33 @@
+"""Render a cpgisland_tpu obs metrics JSONL into a per-phase summary table.
+
+    python tools/obs_report.py metrics.jsonl
+
+Output: one fixed-width table — per-phase wall, item counts, throughput,
+blocking dispatches, cache-miss compiles, transfer bytes — followed by the
+engine chosen per phase, the deduped decision counts, ledger totals, and any
+plausibility-watchdog flags.  The rendering lives in
+``cpgisland_tpu.obs.report`` (shared with the CLI's ``--obs-report``); this
+is the thin file-level entry point.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from cpgisland_tpu.obs import report  # noqa: E402
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("metrics_jsonl", help="JSONL written by --metrics / --metrics-out")
+    args = ap.parse_args(argv)
+    print(report.render_file(args.metrics_jsonl))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
