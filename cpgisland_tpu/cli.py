@@ -145,6 +145,7 @@ def build_parser() -> argparse.ArgumentParser:
     t.add_argument("--convergence", type=float, default=0.005)
     t.add_argument("--init-model", help="start from a model text file instead of the Durbin preset")
     t.add_argument("--checkpoint-dir")
+    _add_em_fuse_flag(t)
     _common_flags(t)
 
     d = sub.add_parser("decode", help="Viterbi decode + island calling")
@@ -161,6 +162,7 @@ def build_parser() -> argparse.ArgumentParser:
     )
     _add_island_cap_flag(d)
     _add_island_states_flag(d)
+    _add_prefetch_flag(d)
     _common_flags(d)
 
     po = sub.add_parser(
@@ -197,6 +199,7 @@ def build_parser() -> argparse.ArgumentParser:
     )
     _add_island_cap_flag(po)
     _add_island_states_flag(po)
+    _add_prefetch_flag(po)
     # Only the flags posterior honors (it is always clean/FASTA-aware) — NOT
     # _common_flags, whose --backend/--numerics/--clean would be silently
     # ignored here.
@@ -224,6 +227,8 @@ def build_parser() -> argparse.ArgumentParser:
     r.add_argument("--iters", type=int, default=10)
     r.add_argument("--convergence", type=float, default=0.005)
     _add_island_states_flag(r)
+    _add_em_fuse_flag(r)
+    _add_prefetch_flag(r)
     _common_flags(r)
 
     return ap
@@ -234,6 +239,36 @@ def _positive_int(s: str) -> int:
     if v < 1:
         raise argparse.ArgumentTypeError(f"must be >= 1, got {v}")
     return v
+
+
+def _add_em_fuse_flag(p: argparse.ArgumentParser) -> None:
+    p.add_argument(
+        "--em-fuse",
+        choices=("auto", "on", "off"),
+        default="auto",
+        help="EM loop execution: auto/on runs every iteration inside ONE "
+        "compiled program with the convergence test on device (K "
+        "steady-state iterations pay one blocking round trip instead of "
+        "K+); off keeps the reference's per-iteration host cadence.  auto "
+        "falls back to the host loop when --checkpoint-dir is given "
+        "(per-iteration snapshots need the model on the host)",
+    )
+
+
+def _add_prefetch_flag(p: argparse.ArgumentParser) -> None:
+    p.add_argument(
+        "--prefetch",
+        type=int,
+        default=0,
+        metavar="N",
+        help="clean mode: depth of the double-buffered streaming executor "
+        "— a background thread encodes record r+1 while the device "
+        "processes record r, and span uploads are issued ahead of the "
+        "sweep that consumes them; decode with the device island engine "
+        "additionally defers call-column fetches until the next dispatch "
+        "is in flight.  0 (default) = strictly serial; results are "
+        "bit-identical either way",
+    )
 
 
 def _add_island_cap_flag(p: argparse.ArgumentParser) -> None:
@@ -347,6 +382,7 @@ def _run_command(args, compat, pipeline, presets, load_text, observer=None) -> i
             model_out=args.model_out,
             symbol_cache=args.symbol_cache,
             metrics=metrics,
+            fuse=args.em_fuse,
         )
         print(
             f"trained: iters={res.iterations} converged={res.converged} "
@@ -357,6 +393,11 @@ def _run_command(args, compat, pipeline, presets, load_text, observer=None) -> i
     if args.cmd == "decode":
         if args.min_len is not None and compat:
             build_parser().error("--min-len requires --clean (the reference has no length filter)")
+        if args.prefetch and compat:
+            build_parser().error(
+                "--prefetch streams FASTA records and requires --clean "
+                "(the compat path encodes the whole file up front)"
+            )
         island_states = _parse_island_states(build_parser(), args, compat)
         params = load_text(args.model) if args.model else _preset_params(presets, args.preset)
         res = pipeline.decode_file(
@@ -371,6 +412,7 @@ def _run_command(args, compat, pipeline, presets, load_text, observer=None) -> i
             island_cap=args.island_cap,
             symbol_cache=args.symbol_cache,
             metrics=metrics,
+            prefetch=args.prefetch,
         )
         print(f"decoded {res.n_symbols} symbols in {res.n_chunks} chunks; {len(res.calls)} islands")
         return 0
@@ -402,6 +444,7 @@ def _run_command(args, compat, pipeline, presets, load_text, observer=None) -> i
             island_cap=args.island_cap,
             symbol_cache=args.symbol_cache,
             metrics=metrics,
+            prefetch=args.prefetch,
         )
         extra = (
             f"; {len(res.calls)} islands -> {args.islands_out}"
@@ -415,6 +458,10 @@ def _run_command(args, compat, pipeline, presets, load_text, observer=None) -> i
         return 0
 
     if args.cmd == "run":
+        if args.prefetch and compat:
+            build_parser().error(
+                "--prefetch streams FASTA records and requires --clean"
+            )
         island_states = _parse_island_states(build_parser(), args, compat)
         params = _preset_params(presets, args.preset)
         # Same pairing check decode_file performs (the one shared predicate) —
@@ -436,6 +483,8 @@ def _run_command(args, compat, pipeline, presets, load_text, observer=None) -> i
             engine=args.engine,
             island_states=island_states,
             symbol_cache=args.symbol_cache,
+            fuse=args.em_fuse,
+            prefetch=args.prefetch,
         )
         print(f"{len(res.calls)} islands -> {args.islands_out}")
         return 0

@@ -62,6 +62,10 @@ SPAN_PATH = {
     "posterior": "posterior",
     "span-totals": "posterior",
     "em_iter": "em",
+    # The fused trainer's one span covers K iterations; its items are
+    # iteration-scaled (n_sym * iters), so the per-iteration em ceiling
+    # applies to it directly.
+    "em_fused": "em",
 }
 
 

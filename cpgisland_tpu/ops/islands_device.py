@@ -335,6 +335,35 @@ def _device_calls_obs(
     )
 
 
+def call_islands_device_async(
+    path,
+    *,
+    min_len: Optional[int] = None,
+    cap: int = DEFAULT_CAP,
+    gc_threshold: float = 0.5,
+    oe_threshold: float = 0.6,
+    offset: int = 0,
+):
+    """Dispatch the calling reduction NOW; return a thunk that fetches.
+
+    The device work is queued immediately (async jit dispatch); invoking the
+    returned zero-arg callable performs the one blocking host fetch and the
+    exact f64 re-evaluation, raising IslandCapOverflow there if more than
+    ``cap`` calls survived.  This is the latency-hiding split the overlapped
+    pipeline uses: record r's compact columns are fetched only after record
+    r+1's decode is already in flight, so the relay round trip hides behind
+    device compute.  ``call_islands_device`` is exactly this thunk invoked
+    immediately — one implementation, two cadences.
+    """
+    path = jnp.asarray(path)
+    if path.shape[0] == 0:
+        return _empty_calls
+    cols = _device_calls(
+        path, cap, min_len, float(gc_threshold), float(oe_threshold)
+    )
+    return lambda: _fetch_calls(cols, cap, offset, gc_threshold, oe_threshold)
+
+
 def call_islands_device(
     path,
     *,
@@ -354,13 +383,37 @@ def call_islands_device(
     ops.islands.call_islands(compat=False): the float thresholds are
     enforced in f64 on the host over the compact integer counts.
     """
+    return call_islands_device_async(
+        path, min_len=min_len, cap=cap, gc_threshold=gc_threshold,
+        oe_threshold=oe_threshold, offset=offset,
+    )()
+
+
+def call_islands_device_obs_async(
+    path,
+    obs,
+    *,
+    island_states,
+    min_len: Optional[int] = None,
+    cap: int = DEFAULT_CAP,
+    gc_threshold: float = 0.5,
+    oe_threshold: float = 0.6,
+    offset: int = 0,
+):
+    """Deferred-fetch twin of :func:`call_islands_device_obs` — same
+    dispatch-now / fetch-at-the-thunk contract as
+    :func:`call_islands_device_async`."""
     path = jnp.asarray(path)
+    obs = jnp.asarray(obs)
+    if path.shape[0] != obs.shape[0]:
+        raise ValueError(f"path {path.shape} and obs {obs.shape} differ")
     if path.shape[0] == 0:
-        return _empty_calls()
-    cols = _device_calls(
-        path, cap, min_len, float(gc_threshold), float(oe_threshold)
+        return _empty_calls
+    cols = _device_calls_obs(
+        path, obs, tuple(sorted(island_states)), cap, min_len,
+        float(gc_threshold), float(oe_threshold),
     )
-    return _fetch_calls(cols, cap, offset, gc_threshold, oe_threshold)
+    return lambda: _fetch_calls(cols, cap, offset, gc_threshold, oe_threshold)
 
 
 def call_islands_device_obs(
@@ -382,17 +435,10 @@ def call_islands_device_obs(
     two_state preset keeps the path on device and ships only the compact
     call records to the host (same economics as the 8-state device caller).
     """
-    path = jnp.asarray(path)
-    obs = jnp.asarray(obs)
-    if path.shape[0] != obs.shape[0]:
-        raise ValueError(f"path {path.shape} and obs {obs.shape} differ")
-    if path.shape[0] == 0:
-        return _empty_calls()
-    cols = _device_calls_obs(
-        path, obs, tuple(sorted(island_states)), cap, min_len,
-        float(gc_threshold), float(oe_threshold),
-    )
-    return _fetch_calls(cols, cap, offset, gc_threshold, oe_threshold)
+    return call_islands_device_obs_async(
+        path, obs, island_states=island_states, min_len=min_len, cap=cap,
+        gc_threshold=gc_threshold, oe_threshold=oe_threshold, offset=offset,
+    )()
 
 
 def _cols_to_host(cols):

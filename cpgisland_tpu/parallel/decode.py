@@ -331,6 +331,7 @@ def viterbi_sharded_spans(
     block_size: int = DEFAULT_BLOCK,
     engine: str = "auto",
     return_device: bool = False,
+    prefetch: bool = False,
 ):
     """EXACT decode of a sequence longer than one pass's device-memory budget.
 
@@ -349,6 +350,14 @@ def viterbi_sharded_spans(
     span-independent decoding is the products-only forward sweep (~1/3 of a
     decode pass).  Returns the per-span paths in forward order (device
     arrays with ``return_device=True``).
+
+    ``prefetch=True`` double-buffers the span uploads: span s+1's pad +
+    async ``device_put`` is issued BEFORE blocking on span s's transfer
+    total, so the host->device transfer (the dominant span-path cost on any
+    interconnect) overlaps the device's products sweep.  Results are
+    bit-identical to the serial order — only dispatch timing changes; peak
+    HBM is unchanged (both orders hold every span until sweep B consumes
+    it, the tail span just arrives one sweep earlier).
     """
     if mesh is None:
         mesh = make_mesh(axis=SEQ_AXIS)
@@ -403,13 +412,21 @@ def viterbi_sharded_spans(
             if lo else (int(obs[0]) if int(obs[0]) < params.n_symbols else 0)
         )
 
+    if prefetch:
+        placed[0] = place(0)
     for s in range(n_spans - 1):
-        placed[s] = place(s)
-        total = np.asarray(
-            _span_total_fn(mesh, block_size, eng, s > 0)(
-                params, placed[s], span_prev0(s)
-            )
+        if s not in placed:
+            placed[s] = place(s)
+        total_dev = _span_total_fn(mesh, block_size, eng, s > 0)(
+            params, placed[s], span_prev0(s)
         )
+        if prefetch:
+            # Overlap: span s+1's upload is in flight while the device runs
+            # span s's products sweep (total_dev is an async dispatch; the
+            # np.asarray below is the blocking point).  This also pre-places
+            # the tail span, which sweep B otherwise uploads serially.
+            placed[s + 1] = place(s + 1)
+        total = np.asarray(total_dev)
         v = (enters[-1][:, None] + total).max(axis=0)
         enters.append((v - v.max()).astype(np.float32))
 

@@ -321,48 +321,52 @@ def transfer_total_sharded(
     pad_to: Optional[int] = None,
     placed=None,
     prev_sym: Optional[int] = None,
-) -> np.ndarray:
+    return_device: bool = False,
+):
     """One span's normalized [K, K] probability-space transfer operator
     (sweep A of span-threaded posterior processing).  ``placed`` (from
     place_record_span) reuses an already-uploaded span; ``obs`` then only
     supplies the true length.  ``prev_sym``: the symbol before the span —
     REQUIRED for onehot continuation spans (first=False), where it
-    conditions the reduced chain's entry group."""
+    conditions the reduced chain's entry group.  ``return_device=True``
+    skips the blocking host fetch and returns the (async-dispatched) device
+    [K, K] — the overlapped pipeline uploads the NEXT span while this one's
+    products sweep runs, fetching all totals afterwards."""
     if mesh is None:
         mesh = make_mesh(axis=SEQ_AXIS)
     n_dev = mesh.shape[mesh.axis_names[0]]
     eng = resolve_fb_engine(engine, params)
+    out = None
     if n_dev == 1 and eng in ("pallas", "onehot"):
         # Single-chip TPU: the products Pallas kernel is much faster than
         # the XLA lane scan for this sweep.
         oh = eng == "onehot"
         ps = _prev_sym_arg(eng, first, prev_sym)
         if placed is not None:
-            return np.asarray(
-                fb_pallas.seq_transfer_total_pallas(
-                    params, placed[0], int(obs.shape[0]), first=first,
-                    lane_T=fb_pallas.pick_lane_T(placed[0].shape[0], onehot=oh),
-                    onehot=oh, prev_sym=ps,
+            out = fb_pallas.seq_transfer_total_pallas(
+                params, placed[0], int(obs.shape[0]), first=first,
+                lane_T=fb_pallas.pick_lane_T(placed[0].shape[0], onehot=oh),
+                onehot=oh, prev_sym=ps,
+            )
+        else:
+            obs = np.asarray(obs)
+            n = obs.shape[0]
+            if pad_to is not None and pad_to > n:
+                obs = np.concatenate(
+                    [obs, np.full(pad_to - n, params.n_symbols, obs.dtype)]
                 )
-            )
-        obs = np.asarray(obs)
-        n = obs.shape[0]
-        if pad_to is not None and pad_to > n:
-            obs = np.concatenate(
-                [obs, np.full(pad_to - n, params.n_symbols, obs.dtype)]
-            )
-        return np.asarray(
-            fb_pallas.seq_transfer_total_pallas(
+            out = fb_pallas.seq_transfer_total_pallas(
                 params, jnp.asarray(obs), n, first=first,
                 lane_T=fb_pallas.pick_lane_T(obs.shape[0], onehot=oh),
                 onehot=oh, prev_sym=ps,
             )
+    else:
+        arr, lens = (
+            placed
+            if placed is not None
+            else _place(
+                mesh, np.asarray(obs), block_size, params.n_symbols, pad_to=pad_to
+            )
         )
-    arr, lens = (
-        placed
-        if placed is not None
-        else _place(
-            mesh, np.asarray(obs), block_size, params.n_symbols, pad_to=pad_to
-        )
-    )
-    return np.asarray(_transfer_total_fn(mesh, block_size, first)(params, arr, lens))
+        out = _transfer_total_fn(mesh, block_size, first)(params, arr, lens)
+    return out if return_device else np.asarray(out)

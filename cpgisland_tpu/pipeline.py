@@ -77,8 +77,14 @@ def train_file(
     model_out: Optional[str] = None,
     symbol_cache: Optional[str] = None,
     metrics: Optional[profiling.MetricsLogger] = None,
+    fuse: Union[bool, str] = "auto",
 ) -> baum_welch.FitResult:
     """Train the CpG HMM on a sequence file (reference ``trainModel``).
+
+    ``fuse``: EM loop execution (see :func:`baum_welch.fit`) — "auto" runs
+    every iteration inside one compiled program with the convergence test
+    on device (one blocking round trip per training run) and falls back to
+    the reference's host-loop cadence when checkpointing is requested.
 
     ``backend="seq2d"`` trains on whole FASTA records (one sequence per
     chromosome, EXACT statistics — no 64 Ki chunk-independence approximation)
@@ -120,6 +126,7 @@ def train_file(
         engine=engine,
         checkpoint_dir=checkpoint_dir,
         metrics=metrics,
+        fuse=fuse,
     )
     if model_out is not None:
         dump_text(result.params, model_out)
@@ -250,9 +257,21 @@ def decode_file(
     symbol_cache: Optional[str] = None,
     metrics: Optional[profiling.MetricsLogger] = None,
     timer: Optional[profiling.PhaseTimer] = None,
+    prefetch: int = 0,
 ) -> DecodeResult:
     """Viterbi-decode a sequence file and call CpG islands (reference
     ``testModel``).
+
+    ``prefetch`` (clean mode): depth of the double-buffered streaming
+    executor.  0 (default) is the strictly serial encode -> upload ->
+    compute -> fetch cadence; N >= 1 overlaps the phases — a background
+    thread parses/encodes record r+1 while the device decodes record r
+    (bounded queue of N records), multi-span records issue span k+1's
+    async upload before blocking on span k's sweep, and with the device
+    island engine record r's compact call-column fetch is deferred until
+    record r+1's decode is in flight.  Island calls are bit-identical to
+    the serial path (only dispatch/fetch timing changes); per-phase timer
+    attribution blurs across overlapped phases by design.
 
     compat mode decodes 1 MiB chunks independently and resets the island
     caller per chunk (the reference's boundary behavior,
@@ -362,7 +381,7 @@ def decode_file(
     # device-memory budget — calling islands per record with per-record
     # 1-based coordinates, so an island can never span a chromosome boundary
     # (the reference concatenates the whole char stream, java:238-254).
-    parts: list[IslandCalls] = []
+    parts: list = []
     if state_path_out is not None:
         from cpgisland_tpu.utils.npystream import NpyStreamWriter
 
@@ -372,6 +391,22 @@ def decode_file(
     n_sym = 0
     n_records = 0
     n_spans_total = 0
+
+    # Overlapped mode (prefetch > 0) with the device island engine defers
+    # each record's compact call-column fetch: the reduction is DISPATCHED
+    # with the record, but the blocking host fetch waits in `deferred`
+    # until the next record's decode is in flight — the relay round trip
+    # then hides behind device compute.  Entries are (parts index, thunk
+    # -> [IslandCalls]); settle fills the placeholders IN ORDER, so the
+    # emitted records are identical to the serial path.
+    defer_calls = prefetch > 0 and use_device_islands
+    deferred: list = []
+
+    def settle_deferred() -> None:
+        while deferred:
+            idx, thunk = deferred.pop(0)
+            out = thunk()
+            parts[idx : idx + len(out)] = out
 
     def decode_one(rec_name: str, symbols: np.ndarray) -> None:
         nonlocal n_spans_total
@@ -391,6 +426,7 @@ def decode_file(
                 pieces = viterbi_sharded_spans(
                     params, symbols, span=span, engine=engine,
                     return_device=use_device_islands,
+                    prefetch=prefetch > 0,
                 )
             else:
                 pieces = [
@@ -401,27 +437,43 @@ def decode_file(
                 ]
             if use_device_islands:
                 full = pieces[0] if len(pieces) == 1 else jnp.concatenate(pieces)
-                # Async dispatch would land the decode's device time in the
-                # islands phase — block here so the per-phase stats the bench
-                # publishes attribute work where it happened.
-                jax.block_until_ready(full)
+                if not defer_calls:
+                    # Async dispatch would land the decode's device time in
+                    # the islands phase — block here so the per-phase stats
+                    # the bench publishes attribute work where it happened.
+                    # The overlapped mode keeps the queue full instead
+                    # (attribution blurs by design, see the docstring).
+                    jax.block_until_ready(full)
             else:
                 full = obs.note_fetch(np.concatenate(pieces))
         with timer.phase("islands", items=float(symbols.size), unit="sym"):
-            if use_device_islands and island_states is not None:
-                from cpgisland_tpu.ops.islands_device import call_islands_device_obs
-
-                calls = _device_calls_retry(
-                    call_islands_device_obs,
-                    full, jnp.asarray(symbols), island_states=island_states,
-                    min_len=min_len, cap_box=cap_box,
+            if use_device_islands:
+                from cpgisland_tpu.ops.islands_device import (
+                    call_islands_device_async,
+                    call_islands_device_obs_async,
                 )
-            elif use_device_islands:
-                from cpgisland_tpu.ops.islands_device import call_islands_device
 
-                calls = _device_calls_retry(
-                    call_islands_device, full, min_len=min_len, cap_box=cap_box
-                )
+                if island_states is not None:
+                    get = _device_calls_deferred(
+                        call_islands_device_obs_async,
+                        full, jnp.asarray(symbols),
+                        island_states=island_states,
+                        min_len=min_len, cap_box=cap_box,
+                    )
+                else:
+                    get = _device_calls_deferred(
+                        call_islands_device_async, full,
+                        min_len=min_len, cap_box=cap_box,
+                    )
+                if defer_calls:
+                    # "." = headerless leading sequence (see below).
+                    name = rec_name or "."
+                    idx = len(parts)
+                    parts.append(None)
+                    settle_deferred()  # previous record — our work is queued
+                    deferred.append((idx, lambda: [get().with_names(name)]))
+                    return
+                calls = get()
             elif island_states is not None:
                 calls = islands_mod.call_islands_obs(
                     full, symbols, island_states=island_states, min_len=min_len
@@ -448,9 +500,16 @@ def decode_file(
             cap_box=cap_box,
             want_paths=path_writer is not None,
             timer=timer,
+            defer=defer_calls,
         )
         n_spans_total += n_spans_total_add
-        parts.extend(batch_parts)
+        if callable(batch_parts):  # deferred thunk -> per-record list
+            idx = len(parts)
+            parts.extend([None] * len(batch))
+            settle_deferred()  # previous flush — this one is dispatched
+            deferred.append((idx, batch_parts))
+        else:
+            parts.extend(batch_parts)
         for p in batch_paths:
             path_writer.write(p)
 
@@ -458,12 +517,16 @@ def decode_file(
     # large records go through the sequence-parallel sharded decode.  Order
     # is preserved: a large record flushes the pending batch first.  The
     # finally keeps the state-path dump loadable (partial but valid) if a
-    # record fails mid-file.
+    # record fails mid-file, and joins the prefetch thread deterministically.
+    from cpgisland_tpu.utils.prefetch import maybe_prefetch
+
+    rec_iter, close_prefetch = maybe_prefetch(
+        codec.iter_fasta_records_cached(test_path, symbol_cache),
+        prefetch, "decode-records",
+    )
     try:
         pending: list = []
-        for rec_name, symbols in codec.iter_fasta_records_cached(
-            test_path, symbol_cache
-        ):
+        for rec_name, symbols in rec_iter:
             n_records += 1
             n_sym += symbols.size
             if symbols.size <= SMALL_RECORD_MAX:
@@ -476,7 +539,9 @@ def decode_file(
                 pending = []
                 decode_one(rec_name, symbols)
         flush_small(pending)
+        settle_deferred()
     finally:
+        close_prefetch()
         if path_writer is not None:
             path_writer.close()
     calls = IslandCalls.concatenate(parts)
@@ -560,6 +625,32 @@ def _resolve_island_engine(
     return use_device_islands, [island_cap]
 
 
+def _grow_cap_or_raise(e, cap_box: list) -> None:
+    """The ONE overflow-cap policy (shared by the blocking retry and the
+    deferred-fetch retry): grow cap_box to the next sufficient pow2, or
+    re-raise when the true count exceeds the ceiling."""
+    from cpgisland_tpu.ops.islands_device import IslandCapOverflow
+
+    if e.n > ISLAND_CAP_CEILING:
+        raise IslandCapOverflow(e.n, cap_box[0]) from None
+    # Clamp at the ceiling: n == ceiling exactly fits cap == n
+    # slots, and the retry must not outgrow the bound the user
+    # clamp enforces.
+    new_cap = min(
+        _round_pow2(e.n + 1, floor=2 * cap_box[0]), ISLAND_CAP_CEILING
+    )
+    obs.event(
+        "island_cap_retry", n_calls=int(e.n), old_cap=cap_box[0],
+        new_cap=new_cap,
+    )
+    log.warning(
+        "island calls (%d) overflowed cap=%d; retrying the on-device "
+        "calling pass with cap=%d (decode not re-run)",
+        e.n, cap_box[0], new_cap,
+    )
+    cap_box[0] = new_cap
+
+
 def _device_calls_retry(fn, *args, cap_box: list, **kwargs):
     """Device island calling that SURVIVES cap overflow.
 
@@ -578,24 +669,36 @@ def _device_calls_retry(fn, *args, cap_box: list, **kwargs):
         try:
             return fn(*args, cap=cap_box[0], **kwargs)
         except IslandCapOverflow as e:
-            if e.n > ISLAND_CAP_CEILING:
-                raise IslandCapOverflow(e.n, cap_box[0]) from None
-            # Clamp at the ceiling: n == ceiling exactly fits cap == n
-            # slots, and the retry must not outgrow the bound the user
-            # clamp enforces.
-            new_cap = min(
-                _round_pow2(e.n + 1, floor=2 * cap_box[0]), ISLAND_CAP_CEILING
-            )
-            obs.event(
-                "island_cap_retry", n_calls=int(e.n), old_cap=cap_box[0],
-                new_cap=new_cap,
-            )
-            log.warning(
-                "island calls (%d) overflowed cap=%d; retrying the on-device "
-                "calling pass with cap=%d (decode not re-run)",
-                e.n, cap_box[0], new_cap,
-            )
-            cap_box[0] = new_cap
+            _grow_cap_or_raise(e, cap_box)
+
+
+def _device_calls_deferred(fn_async, *args, cap_box: list, **kwargs):
+    """Deferred twin of :func:`_device_calls_retry`.
+
+    ``fn_async`` (islands_device.call_islands_device_async /
+    ..._obs_async) dispatches the device reduction IMMEDIATELY and returns
+    a fetch thunk; this wraps it so the overflow retry (re-dispatch at the
+    grown cap, then fetch) happens at thunk-invocation time.  The
+    overlapped pipeline calls the returned thunk only after the NEXT
+    record's decode is in flight — the compact-column fetch round trip
+    then hides behind device compute.  Same args/cap_box contract as the
+    blocking retry; the device inputs stay referenced by the closure, so
+    an overflow can still re-run only the calling reduction.
+    """
+    from cpgisland_tpu.ops.islands_device import IslandCapOverflow
+
+    pending = fn_async(*args, cap=cap_box[0], **kwargs)
+
+    def get():
+        p = pending
+        while True:
+            try:
+                return p()
+            except IslandCapOverflow as e:
+                _grow_cap_or_raise(e, cap_box)
+                p = fn_async(*args, cap=cap_box[0], **kwargs)
+
+    return get
 
 
 def _batched_device_calls(
@@ -608,19 +711,24 @@ def _batched_device_calls(
     island_states,
     min_len,
     cap_box: list,
-) -> list:
+    deferred: bool = False,
+):
     """ONE device island call over a padded [Bp, Tpad] batch of paths.
 
     Masked tail positions and one separator column become a non-island
     state so runs can never cross records; each emitted call's record is
     recovered from its coordinate.  The shared kernel of the batched decode
     AND batched posterior paths — only the compact call records cross to
-    the host.  Returns per-record IslandCalls in batch order.
+    the host.  Returns per-record IslandCalls in batch order —
+    ``deferred=True`` instead returns a zero-arg thunk producing that list:
+    the device reduction is dispatched NOW, the column fetch happens when
+    the thunk runs (the overlapped pipeline invokes it after the next
+    batch's decode is in flight).
     """
     from cpgisland_tpu.ops.islands import N_ISLAND_STATES
     from cpgisland_tpu.ops.islands_device import (
-        call_islands_device,
-        call_islands_device_obs,
+        call_islands_device_async,
+        call_islands_device_obs_async,
     )
 
     Bp, Tpad = paths.shape
@@ -639,29 +747,34 @@ def _batched_device_calls(
         obs_flat = jnp.concatenate(
             [obs_dev, jnp.zeros((Bp, 1), obs_dev.dtype)], axis=1
         ).reshape(-1)
-        all_calls = _device_calls_retry(
-            call_islands_device_obs,
+        get = _device_calls_deferred(
+            call_islands_device_obs_async,
             flat, obs_flat, island_states=island_states,
             min_len=min_len, cap_box=cap_box,
         )
     else:
-        all_calls = _device_calls_retry(
-            call_islands_device, flat, min_len=min_len, cap_box=cap_box
+        get = _device_calls_deferred(
+            call_islands_device_async, flat, min_len=min_len, cap_box=cap_box
         )
-    rec_of = (all_calls.beg - 1) // stride
-    parts = []
-    for i, (name, _) in enumerate(batch):
-        sel = rec_of == i
-        parts.append(
-            IslandCalls(
-                beg=all_calls.beg[sel] - i * stride,
-                end=all_calls.end[sel] - i * stride,
-                length=all_calls.length[sel],
-                gc_content=all_calls.gc_content[sel],
-                oe_ratio=all_calls.oe_ratio[sel],
-            ).with_names(name or ".")
-        )
-    return parts
+
+    def finish() -> list:
+        all_calls = get()
+        rec_of = (all_calls.beg - 1) // stride
+        parts = []
+        for i, (name, _) in enumerate(batch):
+            sel = rec_of == i
+            parts.append(
+                IslandCalls(
+                    beg=all_calls.beg[sel] - i * stride,
+                    end=all_calls.end[sel] - i * stride,
+                    length=all_calls.length[sel],
+                    gc_content=all_calls.gc_content[sel],
+                    oe_ratio=all_calls.oe_ratio[sel],
+                ).with_names(name or ".")
+            )
+        return parts
+
+    return finish if deferred else finish()
 
 
 def _decode_small_batch(
@@ -675,6 +788,7 @@ def _decode_small_batch(
     cap_box: list,
     want_paths: bool,
     timer: profiling.PhaseTimer,
+    defer: bool = False,
 ):
     """Decode a batch of small records as vmap lanes; islands per record.
 
@@ -682,7 +796,8 @@ def _decode_small_batch(
     compile cache stays small across many scaffold shapes.  With device
     islands the whole padded batch flattens into ONE island call
     (_batched_device_calls).  Returns (n_spans, [IslandCalls per record],
-    [paths]).
+    [paths]) — with ``defer`` (overlapped pipeline, device islands) the
+    middle element is a thunk producing that list at fetch time.
     """
     B = len(batch)
     sizes = [s.size for _, s in batch]
@@ -703,9 +818,11 @@ def _decode_small_batch(
             return_score=False,
         )
         if use_device_islands:
-            # Block so per-phase stats attribute the decode where it happened
-            # (async dispatch would bill it to the islands phase).
-            jax.block_until_ready(paths)
+            if not defer:
+                # Block so per-phase stats attribute the decode where it
+                # happened (async dispatch would bill it to the islands
+                # phase); the overlapped mode keeps the queue full instead.
+                jax.block_until_ready(paths)
         else:
             paths = obs.note_fetch(np.asarray(paths))
 
@@ -716,6 +833,7 @@ def _decode_small_batch(
             parts = _batched_device_calls(
                 params, paths, rows, lengths, batch,
                 island_states=island_states, min_len=min_len, cap_box=cap_box,
+                deferred=defer,
             )
         else:
             for i, (name, symbols) in enumerate(batch):
@@ -774,8 +892,16 @@ def posterior_file(
     symbol_cache: Optional[str] = None,
     metrics: Optional[profiling.MetricsLogger] = None,
     timer: Optional[profiling.PhaseTimer] = None,
+    prefetch: int = 0,
 ) -> PosteriorResult:
     """Soft decoding of a FASTA file: per-position island confidence.
+
+    ``prefetch``: depth of the double-buffered streaming executor (same
+    contract as decode_file) — 0 is strictly serial; N >= 1 parses/encodes
+    record r+1 on a background thread while the device processes record r,
+    and multi-span records issue span k+1's async upload before blocking
+    on span k's transfer-total sweep.  Outputs are bit-identical to the
+    serial path.
 
     The reference's Mahout surface exposes only hard Viterbi decoding
     (HmmEvaluator.decode, CpGIslandFinder.java:260); this is its soft
@@ -1041,14 +1167,18 @@ def posterior_file(
             emit(conf, path)
         call_rec(rec_name, symbols, path)
 
+    from cpgisland_tpu.utils.prefetch import maybe_prefetch
+
+    rec_iter, close_prefetch = maybe_prefetch(
+        codec.iter_fasta_records_cached(test_path, symbol_cache),
+        prefetch, "posterior-records",
+    )
     try:
         if confidence_out is not None:
             conf_w = NpyStreamWriter(confidence_out, np.float32)
         if mpm_path_out is not None:
             path_w = NpyStreamWriter(mpm_path_out, np.int8)
-        for rec_name, symbols in codec.iter_fasta_records_cached(
-            test_path, symbol_cache
-        ):
+        for rec_name, symbols in rec_iter:
             n_records += 1
             n_sym += symbols.size
             if symbols.size == 0:
@@ -1076,7 +1206,11 @@ def posterior_file(
             # compiled shape.  Each span is device-placed ONCE here and
             # reused by sweep B (popped as consumed): the upload is the
             # dominant span-path cost on any interconnect, and the two
-            # sweeps would otherwise pay it twice.
+            # sweeps would otherwise pay it twice.  Overlapped mode
+            # (prefetch > 0): the totals stay device-resident through the
+            # loop (return_device) so nothing blocks between spans — span
+            # k+1's device_put is issued while span k's products sweep
+            # runs — and the tiny [K, K] fetches all happen at the end.
             span_placed: dict = {}
             with timer.phase("span-totals", items=float(symbols.size), unit="sym"):
                 totals = []
@@ -1097,8 +1231,11 @@ def posterior_file(
                                     symbols, lo, params.n_symbols
                                 )
                             ),
+                            return_device=prefetch > 0,
                         )
                     )
+                if prefetch > 0:
+                    totals = [np.asarray(t) for t in totals]
             # Host threading: entering-alpha / exiting-beta directions per
             # span (tiny [K]x[K,K] chains, f32 on normalized operators).
             pi = np.exp(np.asarray(params.log_pi, np.float64))
@@ -1166,6 +1303,7 @@ def posterior_file(
                 call_rec(rec_name, symbols, full_path)
         flush_small()
     finally:
+        close_prefetch()
         if conf_w is not None:
             conf_w.close()
         if path_w is not None:
@@ -1229,6 +1367,8 @@ def run(
     engine: str = "auto",
     island_states=None,
     symbol_cache: Optional[str] = None,
+    fuse: Union[bool, str] = "auto",
+    prefetch: int = 0,
 ) -> DecodeResult:
     """The reference's full main(): train, dump model, decode, write islands
     (CpGIslandFinder.java:346-357)."""
@@ -1243,6 +1383,7 @@ def run(
         compat=compat,
         checkpoint_dir=checkpoint_dir,
         symbol_cache=symbol_cache,
+        fuse=fuse,
     )
     return decode_file(
         test_path,
@@ -1253,4 +1394,5 @@ def run(
         engine=engine,
         island_states=island_states,
         symbol_cache=symbol_cache,
+        prefetch=prefetch,
     )

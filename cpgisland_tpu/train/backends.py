@@ -18,8 +18,9 @@ Both backends produce bit-identical statistics up to float reduction order.
 
 from __future__ import annotations
 
+import functools
 from functools import partial
-from typing import Optional
+from typing import Callable, Optional
 
 import jax
 import jax.numpy as jnp
@@ -89,8 +90,14 @@ def resolve_fb_engine(engine: str, params: HmmParams, mode: str) -> str:
     return engine
 
 
+@functools.lru_cache(maxsize=None)
 def _local_stats_fn(engine: str, mode: str):
-    """(params, chunks, lengths) -> batch-summed SuffStats, engine-lowered."""
+    """(params, chunks, lengths) -> batch-summed SuffStats, engine-lowered.
+
+    lru_cached so the SAME callable comes back for the same routing — the
+    fused EM driver (train.baum_welch._fused_em_fn) keys its compiled
+    K-iteration program on this object's identity.
+    """
     if engine == "pallas":
         return fb_pallas.batch_stats_pallas
     if engine == "onehot":
@@ -130,6 +137,24 @@ class EStepBackend:
         """
         return jnp.asarray(chunks), jnp.asarray(lengths)
 
+    def fused_stats_fn(
+        self, params: HmmParams, chunks, lengths
+    ) -> Optional[Callable]:
+        """A jit-traceable ``(params, chunks, lengths) -> SuffStats`` for the
+        fused multi-iteration EM loop, or None when the backend cannot fuse.
+
+        All host-side routing (engine resolution, shape validation) is
+        resolved HERE against the concrete initial ``params`` and the placed
+        arrays — the returned callable must be pure in its traced arguments
+        so K iterations can run inside one compiled ``lax.while_loop``
+        (train.baum_welch).  Resolving once is semantically safe: the
+        routing depends only on emission STRUCTURE (one-hot zero pattern),
+        which EM preserves (structural zeros are fixed points).  Contract
+        for implementers: return a STABLE callable (cached per routing) so
+        repeated ``fit`` calls reuse the compiled loop.
+        """
+        return None
+
 
 class LocalBackend(EStepBackend):
     """Single-device vmap mapper + sum reducer."""
@@ -141,6 +166,11 @@ class LocalBackend(EStepBackend):
     def __call__(self, params, chunks, lengths):
         fn = _local_stats_fn(resolve_fb_engine(self.engine, params, self.mode), self.mode)
         return fn(params, jnp.asarray(chunks), jnp.asarray(lengths))
+
+    def fused_stats_fn(self, params, chunks, lengths):
+        return _local_stats_fn(
+            resolve_fb_engine(self.engine, params, self.mode), self.mode
+        )
 
 
 class SpmdBackend(EStepBackend):
@@ -280,6 +310,13 @@ class SpmdBackend(EStepBackend):
         # resharded by jit according to the shard_map in_specs.
         return self._estep_for(params)(params, chunks, lengths)
 
+    def fused_stats_fn(self, params, chunks, lengths):
+        self._check_divisible(chunks)
+        # The cached jit(shard_map) estep traces inline under the fused
+        # loop; the psum all-reduce runs inside each while_loop iteration,
+        # so the multi-iteration program is still ONE dispatch per fit.
+        return self._estep_for(params)
+
 
 def _check_seq_engine(engine: str) -> None:
     if engine not in ("auto", "xla", "pallas", "onehot"):
@@ -370,6 +407,19 @@ def _seq_onehot(engine: str, params: HmmParams) -> bool:
     return False
 
 
+@functools.lru_cache(maxsize=32)
+def _seq_single_stats_fn(lane_T: int, t_tile: int, onehot: bool):
+    """Stable single-device whole-sequence stats fn (fused-EM cacheable)."""
+
+    def fn(params, obs_flat, lengths):
+        return fb_pallas.seq_stats_pallas(
+            params, obs_flat, jnp.sum(lengths),
+            lane_T=lane_T, t_tile=t_tile, onehot=onehot,
+        )
+
+    return fn
+
+
 class SeqBackend(EStepBackend):
     """Exact whole-sequence E-step, sequence-parallel over the mesh.
 
@@ -433,7 +483,15 @@ class SeqBackend(EStepBackend):
             jax.device_put(jnp.asarray(lengths), sharding),
         )
 
-    def __call__(self, params, obs_flat, lengths):
+    def _stats_fn_for(self, params, obs_flat) -> Callable:
+        """Validate a placed stream and resolve its traceable stats fn.
+
+        The ONE routing point behind __call__ and fused_stats_fn: engine
+        choice and shape checks run here (concrete params + placed shapes);
+        the returned callable is pure in (params, obs_flat, lengths) and
+        stable per routing (lru-cached factories), so the fused EM driver
+        can key its compiled loop on it.
+        """
         n_dev = self.mesh.shape[self.axis]
         if getattr(obs_flat, "ndim", 1) != 1:
             raise ValueError(
@@ -470,19 +528,20 @@ class SeqBackend(EStepBackend):
                 )
             )
             if n_dev == 1:
-                return fb_pallas.seq_stats_pallas(
-                    params, obs_flat, jnp.sum(lengths),
-                    lane_T=lane_T, t_tile=self.t_tile, onehot=oh,
-                )
-            fn = fb_sharded.sharded_stats_pallas_fn(
+                return _seq_single_stats_fn(lane_T, self.t_tile, oh)
+            return fb_sharded.sharded_stats_pallas_fn(
                 self.mesh, lane_T, self.t_tile, oh
             )
-            return fn(params, obs_flat, lengths)
         obs.engine_decision(
             site="seq_backend", choice="xla", requested=self.engine, n_dev=n_dev
         )
-        fn = fb_sharded.sharded_stats_fn(self.mesh, self.block_size)
-        return fn(params, obs_flat, lengths)
+        return fb_sharded.sharded_stats_fn(self.mesh, self.block_size)
+
+    def __call__(self, params, obs_flat, lengths):
+        return self._stats_fn_for(params, obs_flat)(params, obs_flat, lengths)
+
+    def fused_stats_fn(self, params, chunks, lengths):
+        return self._stats_fn_for(params, chunks)
 
 
 class Seq2DBackend(EStepBackend):
@@ -593,9 +652,11 @@ class Seq2DBackend(EStepBackend):
             return tuple(p[0] for p in placed), tuple(p[1] for p in placed)
         return fb_sharded.place_batch2d(self.mesh, chunks, lengths)
 
-    def _group_stats(self, params, mesh, chunks, lengths):
+    def _group_stats_fn(self, params, mesh, chunks) -> Callable:
         # Same routing policy as SeqBackend (_use_fused_seq): auto gates on
-        # big-enough TPU shards; an explicit engine always wins.
+        # big-enough TPU shards; an explicit engine always wins.  Resolves
+        # against concrete params/shapes and returns the (lru-cached,
+        # stable) traceable per-group stats fn.
         sp = mesh.shape[mesh.axis_names[1]]
         _check_seq_shard(chunks.shape[1] // sp, "Seq2DBackend")
         if sp == 1 and chunks.shape[1] <= SMALL_RECORD_ROWS_MAX:
@@ -609,11 +670,10 @@ class Seq2DBackend(EStepBackend):
                 site="seq2d_backend", choice=f"rows-chunked:{eng}",
                 requested=self.engine,
             )
-            fn = fb_sharded.sharded_stats2d_rows_fn(
+            return fb_sharded.sharded_stats2d_rows_fn(
                 mesh, eng,
                 self.t_tile if self.t_tile is not None else fb_pallas.DEFAULT_T_TILE,
             )
-            return fn(params, chunks, lengths)
         engine = (
             ("onehot" if _seq_onehot(self.engine, params) else "pallas")
             if _use_fused_seq(self.engine, params, chunks.shape[1] // sp)
@@ -631,10 +691,12 @@ class Seq2DBackend(EStepBackend):
             if engine in ("pallas", "onehot")
             else (None, None)
         )
-        fn = fb_sharded.sharded_stats2d_fn(
+        return fb_sharded.sharded_stats2d_fn(
             mesh, self.block_size, engine, lane_T, t_tile
         )
-        return fn(params, chunks, lengths)
+
+    def _group_stats(self, params, mesh, chunks, lengths):
+        return self._group_stats_fn(params, mesh, chunks)(params, chunks, lengths)
 
     def __call__(self, params, chunks, lengths):
         if isinstance(chunks, tuple):
@@ -649,6 +711,43 @@ class Seq2DBackend(EStepBackend):
                 "lengths; run prepare() + place() first"
             )
         return self._group_stats(params, self.mesh, chunks, lengths)
+
+    def fused_stats_fn(self, params, chunks, lengths):
+        if isinstance(chunks, tuple):
+            # Bucketed input: one composite fn summing the per-group stats.
+            # Cached per (group shapes x resolved fns) on THIS instance so
+            # repeated fit() calls hand the fused driver the same callable
+            # (= the same compiled K-iteration program).
+            meshes = self._meshes_for(chunks)
+            fns = tuple(
+                self._group_stats_fn(params, m, c)
+                for m, c in zip(meshes, chunks)
+            )
+            key = (tuple(c.shape for c in chunks), fns)
+            cache = getattr(self, "_fused_cache", None)
+            if cache is None:
+                cache = self._fused_cache = {}
+            if key not in cache:
+
+                def run(p, cs, ls):
+                    total = None
+                    for fn, c, l in zip(fns, cs, ls):
+                        st = fn(p, c, l)
+                        total = st if total is None else total + st
+                    return total
+
+                cache[key] = run
+            return cache[key]
+        if (
+            self.mesh is None
+            or getattr(chunks, "ndim", 0) != 2
+            or getattr(lengths, "ndim", 0) != 2
+        ):
+            raise ValueError(
+                "Seq2DBackend expects placed [N, T] sequences and [N, sp] shard "
+                "lengths; run prepare() + place() first"
+            )
+        return self._group_stats_fn(params, self.mesh, chunks)
 
 
 def get_backend(

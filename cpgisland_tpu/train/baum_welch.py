@@ -20,10 +20,11 @@ previous distribution rather than dividing by zero.
 from __future__ import annotations
 
 import dataclasses
+import functools
 import logging
 import os
 import time
-from typing import Callable, Optional
+from typing import Callable, Optional, Union
 
 import jax
 import jax.numpy as jnp
@@ -58,6 +59,133 @@ def mstep(params: HmmParams, stats: SuffStats) -> HmmParams:
     return HmmParams.from_probs(pi, A, B)
 
 
+@functools.lru_cache(maxsize=32)
+def _fused_em_fn(stats_fn, num_iters: int):
+    """ONE compiled program running up to ``num_iters`` EM iterations.
+
+    The host loop in :func:`fit` keeps the reference's one-job-per-iteration
+    cadence: every iteration blocks on the delta/loglik fetch, which on a
+    relayed TPU costs a 50-100 ms round trip — pure latency the device
+    spends idle.  EM iterations are data-independent (the chunk batch never
+    changes), so the whole convergence-checked loop is fusable: this wraps
+    the E-step + M-step + on-device model-delta convergence test in a
+    ``lax.while_loop``, carrying the model and the per-iteration
+    loglik/delta trajectories.  K steady-state iterations then cost ONE
+    blocking fetch (the final carry) instead of K+ round trips, and the
+    ~8-11 ms fixed in-graph cost per whole-sequence iteration (BASELINE.md)
+    amortizes across the loop.
+
+    Cache key = (stats_fn identity, num_iters): backends return STABLE
+    routing callables (see EStepBackend.fused_stats_fn), so repeated fits
+    reuse the compiled loop; params/convergence are traced arguments.
+    """
+
+    def run(params, chunks, lengths, convergence):
+        def cond(carry):
+            it, _p, converged, _lls, _dls = carry
+            return jnp.logical_and(it < num_iters, jnp.logical_not(converged))
+
+        def body(carry):
+            it, p, _, lls, dls = carry
+            stats = stats_fn(p, chunks, lengths)
+            new_p = mstep(p, stats)
+            delta = new_p.max_abs_diff(p)
+            lls = lls.at[it].set(stats.loglik.astype(jnp.float32))
+            dls = dls.at[it].set(delta.astype(jnp.float32))
+            return (it + jnp.int32(1), new_p, delta < convergence, lls, dls)
+
+        init = (
+            jnp.int32(0),
+            params,
+            jnp.asarray(False),
+            jnp.full((num_iters,), jnp.nan, jnp.float32),
+            jnp.full((num_iters,), jnp.nan, jnp.float32),
+        )
+        return jax.lax.while_loop(cond, body, init)
+
+    return jax.jit(run)
+
+
+def _fuse_blocked_reason(
+    checkpoint_dir, callback, fallback_backend, start_iteration
+) -> Optional[str]:
+    """Why the fused loop cannot serve this fit (None = eligible).
+
+    These are exactly the host-cadence features: per-iteration snapshots,
+    user callbacks, and the retry/fallback recovery path all need the model
+    on the host every iteration, which is the round trip fusing removes.
+    """
+    if checkpoint_dir is not None:
+        return "per-iteration checkpointing"
+    if callback is not None:
+        return "per-iteration callback"
+    if fallback_backend is not None:
+        return "fallback-backend recovery"
+    if start_iteration:
+        return "resumed iteration numbering"
+    return None
+
+
+def _fit_fused(
+    params: HmmParams,
+    stats_fn,
+    chunks,
+    lengths,
+    *,
+    num_iters: int,
+    convergence: float,
+    n_sym: float,
+    metrics,
+) -> "FitResult":
+    """Run the compiled K-iteration EM program and unpack its one fetch."""
+    t0 = time.perf_counter()
+    fn = _fused_em_fn(stats_fn, num_iters)
+    with obs.span("em_fused", items=n_sym, unit="sym", max_iters=num_iters) as sp:
+        out = fn(
+            # The loop carry is f32 (mstep output dtype); cast the entry so
+            # f64-initialized params don't fail the while_loop dtype check.
+            params.astype(jnp.float32),
+            chunks,
+            lengths,
+            jnp.float32(convergence),
+        )
+        # THE one blocking round trip of the whole loop (counted by the obs
+        # ledger's device_get hook).
+        it_a, p, converged_a, lls, dls = jax.device_get(out)
+        if sp is not None:
+            sp.items = float(n_sym) * float(it_a)
+    it = int(it_a)
+    logliks = [float(x) for x in lls[:it]]
+    deltas = [float(x) for x in dls[:it]]
+    dt = time.perf_counter() - t0
+    # The host loop validates per iteration; here corrupt statistics can
+    # only be detected after the fact — the fused path trades mid-loop
+    # recovery for latency, so a blowup is a hard error advising the
+    # host-cadence features (fit auto-selects the host loop when any of
+    # them is requested).
+    profiling.check_finite(
+        {"pi": p.log_pi, "A": p.log_A, "B": p.log_B,
+         "logliks": np.asarray(logliks, np.float64)},
+        where=f"fused em ({it} iterations)",
+    )
+    for i, (ll, d) in enumerate(zip(logliks, deltas)):
+        log.info("em iter=%d loglik=%.4f delta=%.6f (fused)", i + 1, ll, d)
+        if metrics is not None:
+            metrics.log("em_iter", iteration=i + 1, loglik=ll, delta=d)
+    log.info(
+        "em fused: %d iteration(s) in %.3fs (one blocking fetch), converged=%s",
+        it, dt, bool(converged_a),
+    )
+    if metrics is not None:
+        metrics.log(
+            "em_fused", iterations=it, wall_s=dt, converged=bool(converged_a),
+        )
+    return FitResult(
+        params=p, iterations=it, logliks=logliks,
+        converged=bool(converged_a), deltas=deltas, recoveries=[],
+    )
+
+
 @dataclasses.dataclass
 class FitResult:
     params: HmmParams
@@ -85,6 +213,7 @@ def fit(
     metrics: Optional[profiling.MetricsLogger] = None,
     fallback_backend: Optional[EStepBackend] = None,
     checkpoint_format: str = "npz",
+    fuse: Union[bool, str] = "auto",
 ) -> FitResult:
     """Run Baum-Welch EM until convergence or ``num_iters``.
 
@@ -93,6 +222,20 @@ def fit(
     delta check) or after ``num_iters`` jobs.  Each iteration optionally writes
     an npz checkpoint (the reference persists the model to HDFS per iteration,
     CpGIslandFinder.java:64-89).
+
+    ``fuse`` selects the EM loop execution:
+
+    - ``"auto"`` (default) — run ALL iterations inside one compiled
+      ``lax.while_loop`` with the convergence test on device
+      (:func:`_fused_em_fn`): K steady-state iterations pay ONE blocking
+      round trip instead of K+ (each worth 50-100 ms on a relayed TPU).
+      The host loop is kept automatically whenever a host-cadence feature
+      is requested (checkpointing, callback, fallback recovery, resumed
+      numbering) or the backend cannot provide a traceable stats fn.
+    - ``True`` — require the fused loop; raises ValueError when a
+      host-cadence feature or the backend makes it impossible.
+    - ``False`` — always the host loop (the reference's
+      one-job-per-iteration cadence).
 
     Failure recovery (SURVEY.md §5): if an iteration's statistics come back
     non-finite (numerics blowup) or the E-step raises a runtime error
@@ -107,11 +250,81 @@ def fit(
         # Validate up front — failing at the first save would waste a full
         # EM iteration first.
         raise ValueError(f"unknown checkpoint_format {checkpoint_format!r} (npz|orbax)")
+    if fuse not in (True, False, "auto", "on", "off"):
+        raise ValueError(f"fuse must be auto|True|False, got {fuse!r}")
+    fuse = {"on": True, "off": False}.get(fuse, fuse)
+    if not isinstance(fuse, str):
+        # Normalize int-ish flags: 0/1 pass the membership check via ==,
+        # but the cadence selection below uses identity (`is False` /
+        # `is True`) — bool() keeps fuse=0 meaning "host loop" and fuse=1
+        # meaning "require fused" rather than both degrading to auto.
+        fuse = bool(fuse)
     if isinstance(backend, str):
         backend = get_backend(backend, mode=mode, engine=engine)
     chunked0 = chunked
     chunked = backend.prepare(chunked0)
     chunks, lengths = backend.place(chunked.chunks, chunked.lengths)
+
+    if fuse is not False and num_iters > 0:
+        blocked = _fuse_blocked_reason(
+            checkpoint_dir, callback, fallback_backend, start_iteration
+        )
+        # getattr: a duck-typed backend that never subclassed EStepBackend
+        # simply keeps the host loop rather than crashing here.
+        fused_resolver = getattr(backend, "fused_stats_fn", None)
+        stats_fn = (
+            fused_resolver(params, chunks, lengths)
+            if blocked is None and fused_resolver is not None
+            else None
+        )
+        if fuse is True and blocked is not None:
+            raise ValueError(
+                f"fuse=True is incompatible with {blocked} (those need the "
+                "host-loop cadence; use fuse='auto' or False)"
+            )
+        if fuse is True and stats_fn is None:
+            raise ValueError(
+                f"{type(backend).__name__} does not provide a fused "
+                "(jit-traceable) E-step; use fuse='auto' or False"
+            )
+        obs.engine_decision(
+            site="train.em_loop",
+            choice="fused" if stats_fn is not None else "host",
+            requested=str(fuse),
+            **({} if blocked is None else {"blocked": blocked}),
+        )
+        if stats_fn is not None:
+            try:
+                return _fit_fused(
+                    params, stats_fn, chunks, lengths,
+                    num_iters=num_iters, convergence=convergence,
+                    n_sym=float(getattr(chunked, "total", 0.0)), metrics=metrics,
+                )
+            except (RuntimeError, FloatingPointError) as e:
+                # Fault-shaped failures only (XlaRuntimeError is a
+                # RuntimeError; FloatingPointError is the post-hoc
+                # check_finite) — the same set the host loop's recovery
+                # handles.  fuse='auto' must not cost callers that
+                # recovery: the model was never updated from the failed
+                # fused run (params are still the caller's), so falling
+                # through to the host loop below re-runs from scratch with
+                # per-iteration retry/validation intact.  Explicit
+                # fuse=True keeps the hard error (the caller asked for the
+                # one-program cadence specifically).
+                if fuse is True:
+                    raise
+                log.warning(
+                    "fused EM failed (%s: %s); falling back to the "
+                    "host-loop cadence with per-iteration recovery",
+                    type(e).__name__, e,
+                )
+                obs.event("em_fused_fallback", error=str(e)[:200])
+                if metrics is not None:
+                    metrics.log("em_fused_fallback", error=str(e))
+    else:
+        obs.engine_decision(
+            site="train.em_loop", choice="host", requested=str(fuse)
+        )
 
     logliks: list[float] = []
     deltas: list[float] = []
@@ -151,8 +364,12 @@ def fit(
                         continue
                     raise
             new_params = mstep(params, stats)
-            delta = float(new_params.max_abs_diff(params))
-            ll = float(stats.loglik)
+            # The float() materializations below are THE per-iteration host
+            # sync of the reference cadence (one blocking round trip per MR
+            # job); note_fetch makes the ledger see it, so a fused-vs-host
+            # dispatch comparison reads straight off the obs summary.
+            delta = float(obs.note_fetch(new_params.max_abs_diff(params)))
+            ll = float(obs.note_fetch(stats.loglik))
         params = new_params
         logliks.append(ll)
         deltas.append(delta)

@@ -51,11 +51,15 @@ class TrainState:
 
 
 def _state_tree(state: TrainState) -> dict:
+    # orbax_leaf: orbax 0.7 rejects numpy SCALAR leaves (np.int64) — 0-d
+    # ndarrays round-trip identically on every release (utils.compat).
+    from cpgisland_tpu.utils.compat import orbax_leaf
+
     return {
         "pi": np.asarray(state.params.pi, dtype=np.float64),
         "A": np.asarray(state.params.A, dtype=np.float64),
         "B": np.asarray(state.params.B, dtype=np.float64),
-        "iteration": np.int64(state.iteration),
+        "iteration": orbax_leaf(np.int64(state.iteration)),
         "logliks": np.asarray(state.logliks, dtype=np.float64),
     }
 

@@ -1,15 +1,64 @@
-"""JAX version shims.
+"""JAX-ecosystem version shims.
 
 The framework targets the current jax API (``jax.shard_map`` with
 ``check_vma``); CI images sometimes carry an older jax (0.4.x) where
 shard_map still lives at ``jax.experimental.shard_map.shard_map`` with the
 ``check_rep`` spelling.  :func:`install` bridges the gap in-place so every
 call site can use the one modern spelling — a no-op on current jax.
+
+Sibling shims for the rest of the ecosystem live here too:
+:func:`orbax_leaf` (checkpoint-tree leaf coercion across orbax's
+supported-type tightening) and :func:`cpu_multiprocess_collectives`
+(whether this jax can run cross-process computations on the CPU backend —
+the capability the real multi-host test needs).
 """
 
 from __future__ import annotations
 
 import functools
+
+
+def orbax_leaf(x):
+    """Coerce a checkpoint-tree leaf to a type every orbax release accepts.
+
+    orbax-checkpoint 0.7 tightened ``StandardCheckpointer``'s supported leaf
+    types to (int, float, np.ndarray, jax.Array): a numpy SCALAR such as
+    ``np.int64(3)`` — accepted by earlier releases — now raises
+    ``Unsupported type`` at save.  A 0-d ndarray round-trips identically on
+    every release, so scalars are wrapped as 0-d arrays here.
+    """
+    import numpy as np
+
+    if isinstance(x, np.generic):  # numpy scalar (np.int64, np.float64, ...)
+        return np.asarray(x)
+    return x
+
+
+def jax_version() -> tuple:
+    """(major, minor, patch) of the installed jax, zeros on parse failure."""
+    import jax
+
+    parts = []
+    for p in str(jax.__version__).split(".")[:3]:
+        digits = "".join(c for c in p if c.isdigit())
+        parts.append(int(digits) if digits else 0)
+    while len(parts) < 3:
+        parts.append(0)
+    return tuple(parts)
+
+
+def cpu_multiprocess_collectives() -> bool:
+    """Can this jax run multi-process computations on the CPU backend?
+
+    jax 0.4.x's XLA:CPU rejects any computation spanning processes
+    ("Multiprocess computations aren't implemented on the CPU backend"), so
+    ``process_allgather`` — and with it the byte-range-sharded input path —
+    only works across processes on TPU there.  jax >= 0.5 ships CPU
+    cross-process collectives (Gloo).  Callers (the real 2-process test)
+    use this to skip with a reason instead of failing on an environment
+    limitation.
+    """
+    return jax_version() >= (0, 5, 0)
 
 
 def install() -> None:

@@ -1,0 +1,197 @@
+"""Bounded background prefetch: overlap host encode with device compute.
+
+The serial pipeline runs encode -> upload -> compute -> fetch strictly in
+sequence per record, so at genome scale the wall clock is the SUM of host
+FASTA parsing and device work even though they use disjoint resources
+(BASELINE.md's end-to-end breakdown: the host encode rivals the 8-chip
+decode).  :class:`RecordPrefetcher` moves the record iterator onto a
+background thread with a BOUNDED queue: while the device decodes record r,
+the host is already parsing/encoding record r+1 (the producer's work is
+file I/O and NumPy byte ops, which release the GIL), and the queue bound
+keeps peak host memory at ``depth`` records instead of the whole file.
+
+Semantics are exactly the serial iterator's: items come out in order, a
+producer exception re-raises at the consumer's next() — the point where the
+serial loop would have raised — and close() joins the thread
+deterministically (no leaked threads across pytest modules).
+
+Telemetry (zero cost when the obs subsystem is off): the prefetcher tracks
+produce time, consumer stall time, and queue depth, and emits ONE
+``prefetch_stream`` event at close with the overlap ratio —
+``(produce_s - stall_s) / produce_s``, i.e. the fraction of host encode
+wall that was hidden behind device compute.
+"""
+
+from __future__ import annotations
+
+import logging
+import queue
+import threading
+import time
+from typing import Iterable, Iterator, Optional
+
+from cpgisland_tpu import obs
+
+log = logging.getLogger(__name__)
+
+_DONE = ("done", None)
+
+
+class RecordPrefetcher:
+    """Background-thread iterator wrapper with a bounded queue.
+
+    ``depth`` bounds both the lookahead and the host memory held in flight;
+    1 is classic double buffering (one item cooking while one is consumed).
+    Use as a context manager, or call :meth:`close` in a ``finally`` — the
+    producer thread is joined there, never abandoned.
+    """
+
+    def __init__(self, it: Iterable, depth: int = 2, name: str = "records"):
+        if depth < 1:
+            raise ValueError(f"prefetch depth must be >= 1, got {depth}")
+        self.name = name
+        self.depth = depth
+        self._q: queue.Queue = queue.Queue(maxsize=depth)
+        self._stop = threading.Event()
+        self._closed = False
+        self.records = 0
+        self.produce_s = 0.0  # producer time spent in next(it)
+        self.stall_s = 0.0  # consumer time spent waiting on an empty queue
+        self.max_depth = 0
+        self._depth_sum = 0
+        self._it = iter(it)
+        self._thread = threading.Thread(
+            target=self._produce,
+            args=(self._it,),
+            name=f"cpgisland-prefetch-{name}",
+            daemon=True,
+        )
+        self._thread.start()
+
+    # -- producer ------------------------------------------------------------
+
+    def _put(self, item) -> bool:
+        """Enqueue, yielding to a close() signal; False when closing."""
+        while not self._stop.is_set():
+            try:
+                self._q.put(item, timeout=0.05)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def _produce(self, it: Iterator) -> None:
+        try:
+            while not self._stop.is_set():
+                t0 = time.perf_counter()
+                try:
+                    item = next(it)
+                except StopIteration:
+                    self._put(_DONE)
+                    return
+                self.produce_s += time.perf_counter() - t0
+                if not self._put(("item", item)):
+                    return
+        except BaseException as e:  # re-raised at the consumer's next()
+            self._put(("exc", e))
+
+    # -- consumer ------------------------------------------------------------
+
+    def __iter__(self) -> "RecordPrefetcher":
+        return self
+
+    def __next__(self):
+        if self._closed:
+            raise StopIteration
+        d = self._q.qsize()
+        self._depth_sum += d
+        self.max_depth = max(self.max_depth, d)
+        t0 = time.perf_counter()
+        kind, payload = self._q.get()
+        self.stall_s += time.perf_counter() - t0
+        if kind == "item":
+            self.records += 1
+            return payload
+        if kind == "exc":
+            self._finish()
+            raise payload
+        self._finish()  # "done"
+        raise StopIteration
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def _finish(self) -> None:
+        """Stop + join the producer and emit the telemetry event once."""
+        if self._closed:
+            return
+        self._closed = True
+        self._stop.set()
+        # Unblock a producer waiting on a full queue, then join: close is
+        # deterministic — no daemon thread outlives the pipeline call.
+        while True:
+            try:
+                self._q.get_nowait()
+            except queue.Empty:
+                break
+        self._thread.join(timeout=30.0)
+        if self._thread.is_alive():
+            # The producer is stuck inside a long next(it) (e.g. a huge
+            # record's encode on a slow filesystem) and cannot observe the
+            # stop flag until it returns.  The daemon flag keeps it from
+            # blocking interpreter exit, but a later thread-hygiene check
+            # or cache-file reopen may trip over it — say so loudly
+            # instead of failing there with no diagnostic.
+            log.warning(
+                "prefetch producer %r still running after 30 s join "
+                "timeout (stuck in the underlying record iterator); "
+                "leaving the daemon thread to finish on its own",
+                self._thread.name,
+            )
+        else:
+            # Producer exited: release the wrapped generator's resources
+            # (file handles of an abandoned mid-file FASTA parse) now, not
+            # at GC time.  Safe only here — a generator cannot be closed
+            # while another thread is executing it.
+            close = getattr(self._it, "close", None)
+            if close is not None:
+                try:
+                    close()
+                except Exception:
+                    pass
+        overlap_s = max(0.0, self.produce_s - self.stall_s)
+        obs.event(
+            "prefetch_stream",
+            stream=self.name,
+            depth=self.depth,
+            records=self.records,
+            produce_s=round(self.produce_s, 4),
+            stall_s=round(self.stall_s, 4),
+            overlap_s=round(overlap_s, 4),
+            overlap_ratio=(
+                round(overlap_s / self.produce_s, 4) if self.produce_s else 1.0
+            ),
+            mean_depth=(
+                round(self._depth_sum / max(1, self.records + 1), 2)
+            ),
+            max_depth=self.max_depth,
+        )
+
+    def close(self) -> None:
+        self._finish()
+
+    def __enter__(self) -> "RecordPrefetcher":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+def maybe_prefetch(it: Iterable, depth: int, name: str):
+    """``depth > 0`` wraps ``it`` in a RecordPrefetcher, else returns it
+    unchanged — the one switch the pipeline entry points use.  Returns
+    (iterable, closer): ``closer()`` is a no-op in the serial case, so call
+    sites hold exactly one ``finally``."""
+    if depth and depth > 0:
+        pf = RecordPrefetcher(it, depth=depth, name=name)
+        return pf, pf.close
+    return it, lambda: None

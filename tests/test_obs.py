@@ -119,9 +119,16 @@ def test_sentinel_steady_state_em_zero_recompiles(rng):
     compiles after iteration 1 (the warm run)."""
     syms = rng.integers(0, 4, size=4096).astype(np.uint8)
     ck = chunking.frame(syms, 256)
-    warm = baum_welch.fit(presets.durbin_cpg8(), ck, num_iters=1, convergence=0.0)
+    # fuse=False: this certifies the HOST-loop cadence (the fused loop has
+    # its own sentinel test in tests/test_baum_welch.py — its compiled
+    # program is keyed on num_iters, so a 1-iter warm run would not warm it).
+    warm = baum_welch.fit(
+        presets.durbin_cpg8(), ck, num_iters=1, convergence=0.0, fuse=False
+    )
     with obs.no_new_compiles("steady-em") as led:
-        res = baum_welch.fit(warm.params, ck, num_iters=2, convergence=0.0)
+        res = baum_welch.fit(
+            warm.params, ck, num_iters=2, convergence=0.0, fuse=False
+        )
     assert res.iterations == 2
     assert led.compiles == 0
 
@@ -246,11 +253,21 @@ def test_fit_emits_em_iter_spans(rng):
     syms = rng.integers(0, 4, size=2048).astype(np.uint8)
     ck = chunking.frame(syms, 256)
     with obs.observe() as ob:
-        baum_welch.fit(presets.durbin_cpg8(), ck, num_iters=2, convergence=0.0)
+        baum_welch.fit(
+            presets.durbin_cpg8(), ck, num_iters=2, convergence=0.0,
+            fuse=False,  # per-iteration spans are the host-loop cadence
+        )
     iters = [s for s in ob.tracer.spans if s.name == "em_iter"]
     assert len(iters) == 2
     assert iters[0].items == float(ck.total)
     assert iters[0].attrs["iteration"] == 1
+    # The fused loop emits ONE em_fused span covering all iterations.
+    with obs.observe() as ob:
+        baum_welch.fit(presets.durbin_cpg8(), ck, num_iters=2, convergence=0.0)
+    fused = [s for s in ob.tracer.spans if s.name == "em_fused"]
+    assert len(fused) == 1
+    assert fused[0].items == 2.0 * ck.total
+    assert not any(s.name == "em_iter" for s in ob.tracer.spans)
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,241 @@
+"""Streaming/prefetch layer: parity with the serial path + thread hygiene.
+
+The overlapped pipeline (pipeline.decode_file / posterior_file with
+``prefetch > 0``) must change ONLY dispatch/fetch timing: island calls are
+bit-identical to the serial cadence, no prefetch thread outlives its
+pipeline call (the module-scoped clear_caches fixture must never see a
+stale producer), and with telemetry off the overlap adds zero device
+dispatches of its own.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from cpgisland_tpu import obs, pipeline
+from cpgisland_tpu.models import presets
+from cpgisland_tpu.utils.prefetch import RecordPrefetcher, maybe_prefetch
+
+
+def _prefetch_threads() -> list:
+    return [
+        t for t in threading.enumerate()
+        if t.name.startswith("cpgisland-prefetch")
+    ]
+
+
+def _write_fasta(path, rng, n_records=7, scale=1):
+    """Multi-record FASTA with planted CG-rich islands; record sizes spread
+    so both the batched small-record path and per-record decode run."""
+    bases = np.array(list("acgt"))
+    with open(path, "w") as f:
+        for r in range(n_records):
+            f.write(f">rec{r}\n")
+            n = (512 + 768 * r) * scale
+            bg = rng.choice(4, size=n, p=[0.3, 0.2, 0.2, 0.3])
+            bg[: n // 4] = rng.choice(4, size=n // 4, p=[0.1, 0.4, 0.4, 0.1])
+            s = "".join(bases[bg])
+            for i in range(0, len(s), 70):
+                f.write(s[i : i + 70] + "\n")
+    return str(path)
+
+
+# ---------------------------------------------------------------------------
+# RecordPrefetcher unit behavior
+
+
+def test_prefetcher_preserves_order_and_items():
+    items = [(f"r{i}", np.arange(i + 1)) for i in range(23)]
+    with RecordPrefetcher(iter(items), depth=3) as pf:
+        got = list(pf)
+    assert [g[0] for g in got] == [i[0] for i in items]
+    for (_, a), (_, b) in zip(got, items):
+        np.testing.assert_array_equal(a, b)
+    assert not _prefetch_threads()
+
+
+def test_prefetcher_propagates_producer_exception():
+    def gen():
+        yield ("a", 1)
+        yield ("b", 2)
+        raise RuntimeError("bad FASTA byte")
+
+    pf = RecordPrefetcher(gen(), depth=2)
+    assert next(pf)[0] == "a"
+    assert next(pf)[0] == "b"
+    with pytest.raises(RuntimeError, match="bad FASTA byte"):
+        next(pf)
+    assert not _prefetch_threads()
+
+
+def test_prefetcher_close_joins_thread_midstream():
+    """Abandoning the stream mid-file (e.g. a pipeline error) still joins
+    the producer — no daemon thread leaks into the next test module."""
+    produced = []
+
+    def gen():
+        for i in range(1000):
+            produced.append(i)
+            yield ("r", i)
+
+    pf = RecordPrefetcher(gen(), depth=2)
+    next(pf)
+    pf.close()
+    assert not _prefetch_threads()
+    # Bounded lookahead: the producer never ran far past the queue depth.
+    assert len(produced) <= 2 + 2
+
+
+def test_prefetcher_bounded_queue_blocks_producer():
+    def gen():
+        for i in range(100):
+            yield ("r", i)
+
+    pf = RecordPrefetcher(gen(), depth=2)
+    time.sleep(0.3)  # producer fills the queue, then must block
+    assert pf._q.qsize() <= 2
+    list(pf)
+    assert not _prefetch_threads()
+
+
+def test_maybe_prefetch_serial_passthrough():
+    it = iter([1, 2, 3])
+    out, close = maybe_prefetch(it, 0, "x")
+    assert out is it
+    close()  # no-op
+    assert not _prefetch_threads()
+
+
+def test_prefetcher_emits_obs_stream_event():
+    with obs.observe() as ob:
+        with RecordPrefetcher(iter([("a", 1), ("b", 2)]), depth=2, name="t") as pf:
+            list(pf)
+    ev = [e for e in ob.events if e["event"] == "prefetch_stream"]
+    assert len(ev) == 1
+    assert ev[0]["stream"] == "t"
+    assert ev[0]["records"] == 2
+    assert {"produce_s", "stall_s", "overlap_ratio", "max_depth"} <= set(ev[0])
+
+
+# ---------------------------------------------------------------------------
+# pipeline parity: overlapped vs serial
+
+
+@pytest.mark.parametrize("island_engine", ["host", "device"])
+def test_decode_overlapped_bit_identical(tmp_path, rng, island_engine):
+    """Overlapped decode (record prefetch + span double-buffering +
+    deferred call-column fetch) emits byte-identical island records."""
+    import io
+
+    fa = _write_fasta(tmp_path / "g.fa", rng)
+
+    def run(prefetch):
+        out = io.StringIO()
+        pipeline.decode_file(
+            fa, presets.durbin_cpg8(), islands_out=out, compat=False,
+            span=2048, island_engine=island_engine, prefetch=prefetch,
+        )
+        return out.getvalue()
+
+    serial = run(0)
+    overlapped = run(3)
+    assert serial == overlapped
+    assert serial.count("\n") >= 3  # the comparison is not vacuous
+    assert not _prefetch_threads()
+
+
+@pytest.mark.parametrize("island_engine", ["host", "device"])
+def test_posterior_overlapped_bit_identical(tmp_path, rng, island_engine):
+    import io
+
+    fa = _write_fasta(tmp_path / "p.fa", rng)
+
+    def run(prefetch):
+        out = io.StringIO()
+        res = pipeline.posterior_file(
+            fa, presets.durbin_cpg8(), islands_out=out, span=2048,
+            island_engine=island_engine, prefetch=prefetch,
+        )
+        return out.getvalue(), res.mean_island_confidence
+
+    (s_txt, s_conf) = run(0)
+    (o_txt, o_conf) = run(3)
+    assert s_txt == o_txt
+    assert s_conf == o_conf
+    assert not _prefetch_threads()
+
+
+def test_decode_overlapped_with_confidence_and_paths(tmp_path, rng):
+    """Host-islands clean decode with a state-path dump under prefetch:
+    per-symbol outputs match the serial run exactly."""
+    fa = _write_fasta(tmp_path / "s.fa", rng, n_records=4)
+    outs = {}
+    for tag, depth in (("serial", 0), ("overlapped", 2)):
+        p = tmp_path / f"{tag}.npy"
+        pipeline.decode_file(
+            fa, presets.durbin_cpg8(), compat=False, span=2048,
+            state_path_out=str(p), island_engine="host", prefetch=depth,
+        )
+        outs[tag] = np.load(p)
+    np.testing.assert_array_equal(outs["serial"], outs["overlapped"])
+    assert not _prefetch_threads()
+
+
+def test_overlapped_adds_no_dispatches_telemetry_off(tmp_path, rng):
+    """With telemetry OFF, the overlap machinery issues no device dispatch
+    of its own: a raw ledger (counting only the blocking jax APIs) sees the
+    overlapped run pay no more than the serial run."""
+    import io
+
+    from cpgisland_tpu.obs import ledger as ledger_mod
+
+    fa = _write_fasta(tmp_path / "d.fa", rng, n_records=5)
+
+    def run(prefetch):
+        out = io.StringIO()
+        pipeline.decode_file(
+            fa, presets.durbin_cpg8(), islands_out=out, compat=False,
+            span=2048, island_engine="device", prefetch=prefetch,
+        )
+        return out.getvalue()
+
+    run(0)  # warm compiles
+    counts = {}
+    for tag, depth in (("serial", 0), ("overlapped", 3)):
+        led = ledger_mod.Ledger()
+        un = ledger_mod.install(led)
+        try:
+            run(depth)
+        finally:
+            un()
+        counts[tag] = led.dispatches
+    # Deferring fetches can only REMOVE blocking calls (the per-record
+    # block_until_ready) — never add them.
+    assert counts["overlapped"] <= counts["serial"], counts
+    assert not _prefetch_threads()
+
+
+def test_decode_overlapped_cap_overflow_retry(tmp_path, rng):
+    """Cap overflow surfaces at the DEFERRED fetch; the retry re-dispatches
+    at the grown cap and the emitted calls still match the serial path."""
+    import io
+
+    fa = _write_fasta(tmp_path / "c.fa", rng, n_records=5)
+
+    def run(prefetch, cap):
+        out = io.StringIO()
+        pipeline.decode_file(
+            fa, presets.durbin_cpg8(), islands_out=out, compat=False,
+            span=2048, island_engine="device", island_cap=cap,
+            prefetch=prefetch,
+        )
+        return out.getvalue()
+
+    serial = run(0, None)
+    n_calls = serial.count("\n")
+    assert n_calls > 2
+    overlapped_tiny_cap = run(3, 1)  # every record overflows cap=1
+    assert overlapped_tiny_cap == serial
+    assert not _prefetch_threads()
