@@ -1,0 +1,32 @@
+"""graftcheck: static analysis enforcing this codebase's TPU invariants.
+
+Two layers (LINT.md is the rule catalogue):
+
+- **AST lint** (:mod:`~cpgisland_tpu.analysis.core` + the ``rules_*``
+  modules) — pure-``ast`` checkers for the project rules that otherwise
+  fail only at runtime, on real TPU, or at genome scale: jit closures over
+  array constants, Mosaic sublane alignment, hot-path host syncs, max-plus
+  normalization, stats-in-backward-chain, retrace hazards, plus two
+  hygiene rules.  No tracing, no devices (the analysis modules import no
+  jax of their own; the parent package import is the only cost) — the
+  whole package lints in well under a second.
+- **jaxpr contracts** (:mod:`~cpgisland_tpu.analysis.contracts`) — traces
+  the registered decode/posterior/EM entry points on abstract inputs (CPU,
+  no TPU needed) and asserts graph-level contracts: no f64 on device
+  paths, no callbacks in hot graphs, reduced/pallas engines stay
+  pallas-free off-TPU (the interpreter pathology), and dispatch-surface
+  stability via ``obs.no_new_compiles``.
+
+CLI: ``python -m cpgisland_tpu.analysis [paths...]`` (or
+``tools/graftcheck.py``); exits non-zero on violations.  Inline waivers:
+``# graftcheck: allow(<rule>) -- <reason>``.
+"""
+
+from cpgisland_tpu.analysis.core import (  # noqa: F401  (public re-exports)
+    FileContext,
+    Finding,
+    LintResult,
+    all_rules,
+    lint_file,
+    run_lint,
+)

@@ -1,0 +1,5 @@
+import sys
+
+from cpgisland_tpu.analysis.cli import main
+
+sys.exit(main())

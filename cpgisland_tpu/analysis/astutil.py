@@ -1,0 +1,287 @@
+"""Shared AST machinery for the graftcheck lint rules.
+
+Everything here is plain-``ast`` analysis — no jax import, no execution —
+so the whole lint layer runs on any host in milliseconds per file.  The
+helpers encode the small amount of semantic resolution the rules need:
+
+- import-alias canonicalization (``pl.pallas_call`` ->
+  ``jax.experimental.pallas.pallas_call``) so rules match call sites no
+  matter how a module spells its imports;
+- best-effort integer constant folding over module constants (``ROW_TILE``,
+  ``OUTER_TILE // ROW_TILE``) for the Mosaic alignment rule;
+- scope walks (bound vs free names, single-assignment maps) for the
+  closure and hot-path rules.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Optional
+
+
+def attach_parents(tree: ast.AST) -> ast.AST:
+    """Set ``node.parent`` on every node (rules walk upward for context)."""
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            child.parent = node  # type: ignore[attr-defined]
+    tree.parent = None  # type: ignore[attr-defined]
+    return tree
+
+
+def parents(node: ast.AST) -> Iterator[ast.AST]:
+    cur = getattr(node, "parent", None)
+    while cur is not None:
+        yield cur
+        cur = getattr(cur, "parent", None)
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """``a.b.c`` for a Name/Attribute chain, else None."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+class ImportMap:
+    """Alias -> canonical dotted path, from a module's import statements."""
+
+    def __init__(self, tree: ast.AST) -> None:
+        self.aliases: dict[str, str] = {}
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    self.aliases[a.asname or a.name.split(".")[0]] = (
+                        a.name if a.asname else a.name.split(".")[0]
+                    )
+            elif isinstance(node, ast.ImportFrom) and node.module and not node.level:
+                for a in node.names:
+                    if a.name == "*":
+                        continue
+                    self.aliases[a.asname or a.name] = f"{node.module}.{a.name}"
+
+    def canonical(self, node: ast.AST) -> Optional[str]:
+        """Canonical dotted path of a Name/Attribute expression, resolving
+        the leading alias through this module's imports."""
+        dn = dotted_name(node)
+        if dn is None:
+            return None
+        head, _, rest = dn.partition(".")
+        base = self.aliases.get(head, head)
+        return f"{base}.{rest}" if rest else base
+
+
+def call_name(imports: ImportMap, call: ast.Call) -> Optional[str]:
+    return imports.canonical(call.func)
+
+
+def matches(canonical: Optional[str], targets: frozenset[str] | set[str]) -> bool:
+    """True when ``canonical`` equals a target or ends with ``.<target>``
+    for single-segment targets (tolerates re-export paths like
+    ``jax.experimental.pallas`` vs ``jax._src.pallas``)."""
+    if canonical is None:
+        return False
+    if canonical in targets:
+        return True
+    tail = canonical.rsplit(".", 1)[-1]
+    return any("." not in t and t == tail for t in targets)
+
+
+# -- integer constant folding ------------------------------------------------
+
+
+def const_int(node: ast.AST, env: dict[str, int]) -> Optional[int]:
+    """Fold ``node`` to a Python int using ``env`` for Name lookups."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, int) \
+            and not isinstance(node.value, bool):
+        return node.value
+    if isinstance(node, ast.Name):
+        return env.get(node.id)
+    if isinstance(node, ast.UnaryOp) and isinstance(node.op, ast.USub):
+        v = const_int(node.operand, env)
+        return -v if v is not None else None
+    if isinstance(node, ast.BinOp):
+        a = const_int(node.left, env)
+        b = const_int(node.right, env)
+        if a is None or b is None:
+            return None
+        try:
+            if isinstance(node.op, ast.Add):
+                return a + b
+            if isinstance(node.op, ast.Sub):
+                return a - b
+            if isinstance(node.op, ast.Mult):
+                return a * b
+            if isinstance(node.op, ast.FloorDiv):
+                return a // b
+            if isinstance(node.op, ast.Mod):
+                return a % b
+            if isinstance(node.op, ast.LShift):
+                return a << b
+            if isinstance(node.op, ast.RShift):
+                return a >> b
+            if isinstance(node.op, ast.Pow):
+                return a**b
+        except (ZeroDivisionError, OverflowError, ValueError):
+            return None
+    return None
+
+
+def module_int_constants(tree: ast.Module) -> dict[str, int]:
+    """Top-level ``NAME = <int-foldable>`` assignments, folded in order."""
+    env: dict[str, int] = {}
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name):
+            v = const_int(node.value, env)
+            if v is not None:
+                env[node.targets[0].id] = v
+    return env
+
+
+_MODULE_INT_CACHE: dict[str, dict[str, int]] = {}
+
+
+def _module_ints_for_path(path: str, depth: int) -> dict[str, int]:
+    if path in _MODULE_INT_CACHE:
+        return _MODULE_INT_CACHE[path]
+    _MODULE_INT_CACHE[path] = {}  # cycle guard
+    try:
+        with open(path, "r", encoding="utf-8") as fh:
+            tree = ast.parse(fh.read(), filename=path)
+    except (OSError, SyntaxError):
+        return {}
+    env = module_int_constants(tree)
+    if depth > 0:
+        env = {**imported_int_constants(tree, ImportMap(tree), depth - 1), **env}
+    _MODULE_INT_CACHE[path] = env
+    return env
+
+
+def imported_int_constants(
+    tree: ast.Module, imports: ImportMap, depth: int = 2
+) -> dict[str, int]:
+    """Fold int constants imported from sibling cpgisland_tpu modules
+    (``from cpgisland_tpu.ops.viterbi_onehot import ROW_TILE`` -> {ROW_TILE:
+    8}) so the Mosaic alignment rule sees tile sizes across module lines.
+    Source files are located from the installed package, parsed once, and
+    cached; unresolvable imports are silently skipped."""
+    import os
+
+    pkg_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    out: dict[str, int] = {}
+    for node in tree.body:
+        if not (isinstance(node, ast.ImportFrom) and node.module
+                and node.module.startswith("cpgisland_tpu.")):
+            continue
+        rel = node.module.split(".", 1)[1].replace(".", os.sep) + ".py"
+        env = _module_ints_for_path(os.path.join(pkg_root, rel), depth)
+        for a in node.names:
+            if a.name in env:
+                out[a.asname or a.name] = env[a.name]
+    return out
+
+
+# -- scopes ------------------------------------------------------------------
+
+FunctionNode = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+
+
+def func_params(fn: ast.AST) -> list[ast.arg]:
+    a = fn.args
+    return [*a.posonlyargs, *a.args, *a.kwonlyargs] + (
+        [a.vararg] if a.vararg else []
+    ) + ([a.kwarg] if a.kwarg else [])
+
+
+def walk_scope(fn: ast.AST) -> Iterator[ast.AST]:
+    """Walk a function body WITHOUT descending into nested def/lambda."""
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        yield node
+        if not isinstance(node, FunctionNode):
+            stack.extend(ast.iter_child_nodes(node))
+
+
+def bound_names(fn: ast.AST) -> set[str]:
+    """Names bound in ``fn``'s own scope: params, assignments, loop/with/
+    comprehension targets, imports, nested def names."""
+    out = {p.arg for p in func_params(fn)}
+    for node in walk_scope(fn):
+        if isinstance(node, ast.Name) and isinstance(node.ctx, (ast.Store, ast.Del)):
+            out.add(node.id)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+            out.add(node.name)
+        elif isinstance(node, (ast.Import, ast.ImportFrom)):
+            for a in node.names:
+                out.add((a.asname or a.name).split(".")[0])
+        elif isinstance(node, (ast.Global, ast.Nonlocal)):
+            out.difference_update(node.names)
+    return out
+
+
+def free_loads(fn: ast.AST) -> dict[str, ast.Name]:
+    """Free variables of ``fn`` (loads not bound at any nesting level inside
+    it), mapped to one representative Name node.  Comprehension targets and
+    nested-function locals are treated as bound — this approximates Python
+    scoping closely enough for closure detection."""
+    bound: set[str] = set()
+    loads: dict[str, ast.Name] = {}
+
+    def visit(f: ast.AST, outer_bound: set[str]) -> None:
+        here = outer_bound | bound_names(f)
+        for node in walk_scope(f):
+            if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load):
+                if node.id not in here:
+                    loads.setdefault(node.id, node)
+            elif isinstance(node, FunctionNode):
+                visit(node, here)
+
+    visit(fn, set())
+    return loads
+
+
+def single_assignments(fn: ast.AST) -> dict[str, ast.expr]:
+    """Name -> value for names assigned exactly once by a plain ``=`` in
+    ``fn``'s own scope (and never augmented/deleted)."""
+    counts: dict[str, int] = {}
+    values: dict[str, ast.expr] = {}
+    for node in walk_scope(fn):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name):
+            name = node.targets[0].id
+            counts[name] = counts.get(name, 0) + 1
+            values[name] = node.value
+        elif isinstance(node, (ast.AugAssign, ast.AnnAssign)) \
+                and isinstance(getattr(node, "target", None), ast.Name):
+            counts[node.target.id] = counts.get(node.target.id, 0) + 2
+        elif isinstance(node, ast.Name) and isinstance(node.ctx, (ast.Store, ast.Del)):
+            parent = getattr(node, "parent", None)
+            if not (isinstance(parent, ast.Assign) and len(parent.targets) == 1
+                    and parent.targets[0] is node):
+                counts[node.id] = counts.get(node.id, 0) + 2
+    return {k: v for k, v in values.items() if counts.get(k) == 1}
+
+
+def enclosing_function(node: ast.AST) -> Optional[ast.AST]:
+    for p in parents(node):
+        if isinstance(p, FunctionNode):
+            return p
+    return None
+
+
+def enclosing_functions(node: ast.AST) -> list[ast.AST]:
+    return [p for p in parents(node) if isinstance(p, FunctionNode)]
+
+
+def top_level_defs(tree: ast.Module) -> dict[str, ast.AST]:
+    return {
+        n.name: n
+        for n in tree.body
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+    }

@@ -1,0 +1,151 @@
+"""graftcheck CLI: ``python -m cpgisland_tpu.analysis [paths...]``.
+
+Exit codes: 0 clean (waived findings allowed), 1 violations (lint findings
+or contract violations), 2 usage error.  The default run is the pure-AST
+lint layer (no tracing, no devices — sub-second past the package import);
+``--contracts`` adds the jaxpr contract pass, which traces the registered
+entry points on abstract inputs (CPU, seconds).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+
+def _default_paths() -> list[str]:
+    """The package itself, resolved from the installed location so the CLI
+    works from any cwd."""
+    pkg = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    return [pkg]
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m cpgisland_tpu.analysis",
+        description="graftcheck: project lint + jaxpr contract checker "
+        "enforcing the codebase's TPU invariants",
+    )
+    ap.add_argument("paths", nargs="*", help="files/dirs to lint "
+                    "(default: the cpgisland_tpu package)")
+    ap.add_argument("--rules", default=None,
+                    help="comma-separated rule subset (see --list-rules)")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="list rules with their origin stories and exit")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="machine-readable findings on stdout")
+    ap.add_argument("--show-waived", action="store_true",
+                    help="also print waived findings")
+    ap.add_argument("--strict-waivers", action="store_true",
+                    help="fail on waivers that cover nothing (stale waivers)")
+    ap.add_argument("--no-lint", action="store_true",
+                    help="skip the AST lint layer")
+    ap.add_argument("--contracts", action="store_true",
+                    help="also run the jaxpr contract pass (imports jax)")
+    ap.add_argument("--no-exec", action="store_true",
+                    help="contracts: trace only, skip the dispatch-stability "
+                    "execution checks")
+    ap.add_argument("--platform", default="cpu",
+                    help="contracts backend: cpu (default — the pass is "
+                    "designed to certify without a TPU) | tpu | auto "
+                    "(whatever jax picks)")
+    args = ap.parse_args(argv)
+
+    from cpgisland_tpu.analysis import core
+
+    if args.list_rules:
+        for rule in core.all_rules().values():
+            print(f"{rule.name}: {rule.description}")
+            if rule.origin:
+                print(f"    origin: {rule.origin}")
+        return 0
+
+    rc = 0
+    payload: dict = {}
+
+    if not args.no_lint:
+        paths = args.paths or _default_paths()
+        missing = [p for p in paths if not os.path.exists(p)]
+        if missing:
+            print(f"error: no such path(s): {missing}", file=sys.stderr)
+            return 2
+        rule_names = (
+            [r.strip() for r in args.rules.split(",") if r.strip()]
+            if args.rules else None
+        )
+        try:
+            result = core.run_lint(paths, rule_names=rule_names)
+        except ValueError as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 2
+        shown = result.findings if args.show_waived else result.unwaived
+        stale = result.unused_waivers
+        if args.as_json:
+            payload["findings"] = [f.as_dict() for f in result.findings]
+            payload["files_checked"] = result.files_checked
+            payload["unused_waivers"] = [
+                {"path": rel, "line": w.line, "rules": list(w.rules),
+                 "reason": w.reason}
+                for rel, w in stale
+            ]
+        else:
+            for f in shown:
+                print(f.format())
+            for rel, w in stale:
+                line = (
+                    f"{rel}:{w.line}:1: [waiver-unused] waiver for "
+                    f"{','.join(w.rules)} covers no finding"
+                )
+                # Advisory note by default; a first-class violation line
+                # under --strict-waivers.
+                print(line if args.strict_waivers else f"note: {line}",
+                      file=sys.stdout if args.strict_waivers else sys.stderr)
+        ok = result.ok and not (args.strict_waivers and stale)
+        if not args.as_json:
+            print(
+                f"graftcheck: {result.files_checked} file(s), "
+                f"{len(result.unwaived)} violation(s), "
+                f"{len(result.waived)} waived",
+                file=sys.stderr,
+            )
+        if not ok:
+            rc = 1
+
+    if args.contracts:
+        if args.platform != "auto":
+            # Pin via jax.config BEFORE backend init: this dev box's site
+            # plugin ignores the JAX_PLATFORMS env var (CLAUDE.md).
+            import jax
+
+            jax.config.update("jax_platforms", args.platform)
+        from cpgisland_tpu.analysis import contracts
+
+        results = contracts.run_contracts(execute=not args.no_exec)
+        bad = [r for r in results if not r.ok]
+        if args.as_json:
+            payload["contracts"] = [r.as_dict() for r in results]
+        else:
+            for r in results:
+                status = "ok" if r.ok else "VIOLATION"
+                print(f"contract {r.name}: {status}", file=sys.stderr)
+                for v in r.violations:
+                    print(f"    {v}")
+        if not args.as_json:
+            print(
+                f"graftcheck contracts: {len(results)} entry point(s), "
+                f"{len(bad)} violating",
+                file=sys.stderr,
+            )
+        if bad:
+            rc = 1
+
+    if args.as_json:
+        payload["ok"] = rc == 0
+        print(json.dumps(payload))
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

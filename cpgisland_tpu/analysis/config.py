@@ -1,0 +1,53 @@
+"""Central graftcheck configuration: the hot-path registry.
+
+``hot-path-host-sync`` (R3) only fires inside functions *registered* as hot
+paths — the decode/posterior/EM inner loops whose per-iteration host syncs
+each cost a 50-100 ms relay round trip (CLAUDE.md).  Registration is either
+central (here, keyed by module path suffix) or inline via a
+``# graftcheck: hot-path`` comment on/above the ``def``.
+
+The central list is deliberately the *driver loops*, not the jitted bodies:
+a host sync inside a jitted function is a trace error jax reports itself;
+the silent latency bugs live in the Python loops that orchestrate spans,
+records, and EM iterations.
+"""
+
+from __future__ import annotations
+
+# module-path suffix (posix-style) -> function names whose whole body
+# (including nested defs) is a hot path.
+HOT_PATHS: dict[str, frozenset[str]] = {
+    "parallel/decode.py": frozenset({
+        "viterbi_sharded",
+        "viterbi_sharded_spans",
+    }),
+    "parallel/posterior.py": frozenset({
+        "posterior_sharded",
+        "transfer_total_sharded",
+    }),
+    "parallel/mesh.py": frozenset({"fetch_sharded_prefix"}),
+    "train/baum_welch.py": frozenset({"_fit_fused", "fit"}),
+    "ops/islands_device.py": frozenset({
+        "call_islands_device",
+        "call_islands_device_obs",
+        "call_islands_device_async",
+        "call_islands_device_obs_async",
+        "_cols_to_host",
+    }),
+    "pipeline.py": frozenset({
+        "_batched_device_calls",
+        "_device_calls_retry",
+        "_device_calls_deferred",
+        "_decode_small_batch",
+        "posterior_file",
+        "decode_file",
+    }),
+}
+
+
+def hot_functions_for(relpath: str) -> frozenset[str]:
+    rel = relpath.replace("\\", "/")
+    for suffix, names in HOT_PATHS.items():
+        if rel.endswith(suffix):
+            return names
+    return frozenset()

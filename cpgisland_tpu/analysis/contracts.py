@@ -1,0 +1,384 @@
+"""Layer 2: jaxpr contracts over the registered decode/posterior/EM entries.
+
+The AST lint catches what source *spells*; this pass checks what the
+traced graphs *contain*.  Every registered entry point is traced with
+``jax.make_jaxpr`` on small abstract inputs — tracing needs no TPU, so the
+whole pass certifies on CPU in seconds — and asserted against:
+
+- **no-f64**: no float64/complex128 values anywhere in the graph (device
+  paths are f32/int; an f64 leak silently halves VPU throughput on chip
+  and usually means a stray numpy double crossed the trace boundary);
+- **no-callbacks**: no ``pure_callback``/``io_callback``/``debug_callback``
+  primitives in hot graphs (a callback is a host round trip per invocation
+  — 50-100 ms each over this setup's relay);
+- **pallas-free off-TPU**: the reduced (onehot) engines must trace to
+  their XLA scan twins off-TPU — the Pallas interpreter evaluates the
+  select-derived backpointer chains pathologically slowly (CLAUDE.md), so
+  a pallas_call in a CPU graph of these entries is a routing bug.  On TPU
+  the same entries must *contain* pallas_call (the kernels actually
+  engaged on the silicon that produces published numbers — bench.py's
+  parity phase re-checks this on the capturing backend);
+- **auto-routing off-TPU**: ``resolve_*_engine("auto")`` must never pick a
+  Pallas lowering off-TPU, and ``get_passes`` must resolve every engine —
+  i.e., every TPU kernel engine has a registered off-TPU twin;
+- **dispatch stability**: executing an entry twice on same-shape inputs
+  must not recompile (``obs.no_new_compiles`` — the recompile sentinel
+  from PR 1), so steady-state loops stay one-dispatch.
+
+Run via ``python -m cpgisland_tpu.analysis --contracts``, from
+``tests/test_graftcheck_self.py``, from ``bench.py --extended``'s parity
+phase, and from ``__graft_entry__.py``'s self-check.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Iterable, Optional
+
+CALLBACK_PRIMS = frozenset({
+    "pure_callback", "io_callback", "debug_callback", "callback",
+    "host_callback", "outside_call",
+})
+BANNED_DTYPES = ("float64", "complex128")
+
+
+@dataclasses.dataclass
+class Contract:
+    name: str
+    # () -> (fn, args, args2) — args2 is a same-shape/different-data input
+    # set for the dispatch-stability check (None skips it).
+    make: Callable[[], tuple]
+    allow_pallas_off_tpu: bool = False
+    expect_pallas_on_tpu: bool = False
+    stability: bool = False
+    allow_f64: bool = False
+
+
+@dataclasses.dataclass
+class ContractResult:
+    name: str
+    ok: bool
+    violations: list
+    notes: dict
+
+    def as_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+def _sub_jaxprs(value):
+    import jax
+
+    if isinstance(value, jax.core.ClosedJaxpr):
+        yield value.jaxpr
+    elif isinstance(value, jax.core.Jaxpr):
+        yield value
+    elif isinstance(value, (list, tuple)):
+        for v in value:
+            yield from _sub_jaxprs(v)
+    elif isinstance(value, dict):
+        for v in value.values():
+            yield from _sub_jaxprs(v)
+
+
+def _walk_eqns(jaxpr, seen=None):
+    seen = seen if seen is not None else set()
+    if id(jaxpr) in seen:
+        return
+    seen.add(id(jaxpr))
+    for eqn in jaxpr.eqns:
+        yield eqn
+        for v in eqn.params.values():
+            for sub in _sub_jaxprs(v):
+                yield from _walk_eqns(sub, seen)
+
+
+def inspect_jaxpr(closed) -> dict:
+    """Primitive counts + banned-dtype sightings for a ClosedJaxpr."""
+    prims: dict[str, int] = {}
+    bad_dtypes: list[str] = []
+    for eqn in _walk_eqns(closed.jaxpr):
+        name = eqn.primitive.name
+        prims[name] = prims.get(name, 0) + 1
+        for var in eqn.outvars:
+            aval = getattr(var, "aval", None)
+            dt = str(getattr(aval, "dtype", ""))
+            if dt in BANNED_DTYPES:
+                bad_dtypes.append(f"{name} -> {dt}")
+    return {"prims": prims, "bad_dtypes": bad_dtypes}
+
+
+def check_contract(c: Contract, execute: bool = True) -> ContractResult:
+    import jax
+
+    from cpgisland_tpu import obs as obs_mod
+
+    on_tpu = jax.default_backend() == "tpu"
+    violations: list[str] = []
+    notes: dict = {"backend": jax.default_backend()}
+    fn, args, args2 = c.make()
+    closed = jax.make_jaxpr(fn)(*args)
+    info = inspect_jaxpr(closed)
+    n_pallas = info["prims"].get("pallas_call", 0)
+    notes["pallas_calls"] = n_pallas
+    notes["n_eqns"] = sum(info["prims"].values())
+
+    for cb in sorted(set(info["prims"]) & CALLBACK_PRIMS):
+        violations.append(
+            f"callback primitive {cb!r} in hot graph "
+            f"(x{info['prims'][cb]}): each invocation is a host round trip"
+        )
+    if info["bad_dtypes"] and not c.allow_f64:
+        violations.append(
+            "f64 on the device path: " + ", ".join(info["bad_dtypes"][:5])
+        )
+    if not on_tpu and n_pallas and not c.allow_pallas_off_tpu:
+        violations.append(
+            f"{n_pallas} pallas_call(s) in the off-TPU graph: this entry "
+            "must route to its XLA twin off-TPU (interpreter pathology)"
+        )
+    if on_tpu and c.expect_pallas_on_tpu and not n_pallas:
+        violations.append(
+            "no pallas_call in the TPU graph: the kernels this entry "
+            "certifies did not engage"
+        )
+
+    if execute and c.stability and args2 is not None:
+        try:
+            jax.block_until_ready(fn(*args))  # warm the cache
+            with obs_mod.no_new_compiles(tag=f"contract:{c.name}"):
+                jax.block_until_ready(fn(*args2))
+        except obs_mod.RecompileError as e:
+            violations.append(f"dispatch surface unstable: {e}")
+        else:
+            notes["stability"] = "ok"
+
+    return ContractResult(
+        name=c.name, ok=not violations, violations=violations, notes=notes
+    )
+
+
+def _routing_contract() -> ContractResult:
+    """Off-TPU, 'auto' must resolve to non-Pallas engines, and get_passes
+    must resolve every engine name (every TPU engine has an off-TPU twin)."""
+    import jax
+
+    from cpgisland_tpu.models import presets
+    from cpgisland_tpu.ops.viterbi_parallel import get_passes
+    from cpgisland_tpu.parallel.decode import resolve_engine
+    from cpgisland_tpu.parallel.posterior import resolve_fb_engine as post_eng
+    from cpgisland_tpu.train.backends import resolve_fb_engine as train_eng
+
+    params = presets.durbin_cpg8()
+    on_tpu = jax.default_backend() == "tpu"
+    violations: list[str] = []
+    notes: dict = {"backend": jax.default_backend()}
+    picks = {
+        "decode": resolve_engine("auto", params),
+        "posterior": post_eng("auto", params),
+        "train": train_eng("auto", params, "rescaled"),
+    }
+    notes["auto_picks"] = picks
+    if not on_tpu:
+        for site, pick in picks.items():
+            if pick in ("pallas", "onehot"):
+                violations.append(
+                    f"{site} auto-routes engine {pick!r} off-TPU (Pallas "
+                    "lowerings are TPU-only; off-TPU must pick the XLA twin)"
+                )
+    for eng in ("xla", "pallas", "onehot"):
+        try:
+            passes = get_passes(eng)
+            if len(passes) != 3 or not all(callable(p) for p in passes):
+                raise TypeError("engine did not resolve to a pass triple")
+        except Exception as e:
+            violations.append(f"get_passes({eng!r}) has no registered twin: {e}")
+    return ContractResult(
+        name="engines.routing", ok=not violations, violations=violations,
+        notes=notes,
+    )
+
+
+# -- the entry-point registry ------------------------------------------------
+
+
+def _flagship():
+    from cpgisland_tpu.models import presets
+
+    return presets.durbin_cpg8()
+
+
+def _obs_pair(n: int, dtype, seeds=(0, 1)):
+    import jax.numpy as jnp
+    import numpy as np
+
+    rngs = [np.random.default_rng(s) for s in seeds]
+    return tuple(
+        jnp.asarray(r.integers(0, 4, size=n).astype(dtype)) for r in rngs
+    )
+
+
+def _decode_contract(engine: str, **kw) -> Contract:
+    def make():
+        from cpgisland_tpu.ops.viterbi_parallel import viterbi_parallel
+
+        params = _flagship()
+        o1, o2 = _obs_pair(2048, "int32")
+        fn = lambda o: viterbi_parallel(
+            params, o, block_size=256, return_score=True, engine=engine
+        )
+        return fn, (o1,), (o2,)
+
+    return Contract(name=f"decode.{engine}", make=make, **kw)
+
+
+def _decode_batch_flat_contract() -> Contract:
+    def make():
+        from cpgisland_tpu.ops.viterbi_parallel import viterbi_parallel_batch
+
+        params = _flagship()
+        o1, o2 = _obs_pair(4 * 512, "int32")
+        import jax.numpy as jnp
+
+        lengths = jnp.full(4, 512, jnp.int32)
+        fn = lambda c: viterbi_parallel_batch(
+            params, c.reshape(4, 512), lengths, block_size=256,
+            return_score=False, engine="onehot",
+        )
+        return fn, (o1,), (o2,)
+
+    return Contract(
+        name="decode.batch_flat.onehot", make=make, expect_pallas_on_tpu=True
+    )
+
+
+def _posterior_contract(onehot: bool, **kw) -> Contract:
+    def make():
+        import jax.numpy as jnp
+        import numpy as np
+
+        from cpgisland_tpu.ops import fb_pallas
+
+        params = _flagship()
+        o1, o2 = _obs_pair(4096, "uint8")
+        mask = jnp.asarray((np.arange(8) < 4).astype(np.float32))
+        fn = lambda o: fb_pallas._seq_posterior_core(
+            params, o, o.shape[0], mask, 512, 256, axis=None, onehot=onehot
+        )[0]
+        return fn, (o1,), (o2,)
+
+    tag = "onehot" if onehot else "dense"
+    return Contract(name=f"posterior.{tag}", make=make, **kw)
+
+
+def _em_chunked_contract(engine: str, **kw) -> Contract:
+    def make():
+        import jax.numpy as jnp
+
+        from cpgisland_tpu.train.backends import LocalBackend
+
+        params = _flagship()
+        o1, o2 = _obs_pair(8 * 1024, "uint8")
+        lengths = jnp.full(8, 1024, jnp.int32)
+        backend = LocalBackend(mode="rescaled", engine=engine)
+        fn = lambda c: backend(params, c.reshape(8, 1024), lengths)
+        return fn, (o1,), (o2,)
+
+    return Contract(name=f"em.chunked.{engine}", make=make, **kw)
+
+
+def _em_seq_contract(onehot: bool, **kw) -> Contract:
+    def make():
+        from cpgisland_tpu.ops import fb_pallas
+
+        params = _flagship()
+        o1, o2 = _obs_pair(8192, "uint8")
+        fn = lambda o: fb_pallas.seq_stats_pallas(
+            params, o, o.shape[0], lane_T=512, t_tile=256, onehot=onehot
+        )
+        return fn, (o1,), (o2,)
+
+    tag = "onehot" if onehot else "dense"
+    return Contract(name=f"em.seq.{tag}", make=make, **kw)
+
+
+def _mstep_contract() -> Contract:
+    def make():
+        import jax.numpy as jnp
+
+        from cpgisland_tpu.ops.forward_backward import SuffStats
+        from cpgisland_tpu.train.baum_welch import mstep
+
+        params = _flagship()
+        K, M = params.n_states, params.n_symbols
+
+        def stats(scale):
+            return SuffStats(
+                init=jnp.full((K,), scale), trans=jnp.full((K, K), scale),
+                emit=jnp.full((K, M), scale), loglik=jnp.float32(-scale),
+                n_seqs=jnp.float32(1.0),
+            )
+
+        return mstep, (params, stats(1.0)), (params, stats(2.0))
+
+    return Contract(name="em.mstep", make=make, stability=True)
+
+
+def default_contracts() -> list[Contract]:
+    """The registry: one entry per (path, engine) the published numbers and
+    the test suite rely on.  Expectations encode CLAUDE.md's routing rules:
+    dense Pallas engines MAY appear off-TPU only under the interpreter
+    (tests exercise them); the reduced onehot engines must trace to their
+    XLA twins off-TPU and to real kernels on TPU."""
+    return [
+        _decode_contract("xla", stability=True),
+        _decode_contract("pallas", allow_pallas_off_tpu=True,
+                         expect_pallas_on_tpu=True),
+        _decode_contract("onehot", expect_pallas_on_tpu=True),
+        _decode_batch_flat_contract(),
+        _posterior_contract(False, allow_pallas_off_tpu=True,
+                            expect_pallas_on_tpu=True),
+        _posterior_contract(True, expect_pallas_on_tpu=True),
+        _em_chunked_contract("xla", stability=True),
+        _em_chunked_contract("onehot", expect_pallas_on_tpu=True),
+        _em_seq_contract(True, expect_pallas_on_tpu=True),
+        _mstep_contract(),
+    ]
+
+
+def run_contracts(
+    names: Optional[Iterable[str]] = None, execute: bool = True
+) -> list[ContractResult]:
+    """Trace + check every registered contract (plus the routing check).
+
+    ``execute=False`` skips the dispatch-stability executions (pure
+    tracing — used where dispatches are expensive, e.g. a relayed TPU).
+    """
+    wanted = set(names) if names is not None else None
+    results: list[ContractResult] = []
+    if wanted is None or "engines.routing" in wanted:
+        results.append(_routing_contract())
+    for c in default_contracts():
+        if wanted is not None and c.name not in wanted:
+            continue
+        try:
+            results.append(check_contract(c, execute=execute))
+        except Exception as e:  # a contract that cannot even trace is a failure
+            results.append(
+                ContractResult(
+                    name=c.name, ok=False,
+                    violations=[f"trace failed: {type(e).__name__}: {e}"],
+                    notes={},
+                )
+            )
+    return results
+
+
+def summarize(results: list[ContractResult]) -> dict:
+    """Compact summary for bench extras / metrics sidecars."""
+    return {
+        "checked": len(results),
+        "ok": all(r.ok for r in results),
+        "violations": {
+            r.name: r.violations for r in results if not r.ok
+        },
+    }

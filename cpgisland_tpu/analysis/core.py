@@ -1,0 +1,302 @@
+"""graftcheck core: findings, inline waivers, file contexts, the rule run.
+
+The lint layer is pure ``ast`` — no tracing, no devices, and the analysis
+modules themselves import no jax (the parent package import does pull jax,
+a hard dependency, via its compat-shim installer; that one-time import is
+the whole cost).  Linting the full package takes well under a second, so
+the CLI works as a pre-commit/CI gate on any host with the package's deps.
+
+Waiver syntax (inline, reviewed like code; shown with a ``<rule>``
+placeholder so this docstring is not itself parsed as a waiver)::
+
+    x = big_table.item()  # graftcheck: allow(<rule>) -- <why>
+
+A waiver on a code line covers findings reported on that line; a waiver on
+a standalone comment line covers the next line (the first line of the
+statement below it).  The ``-- <reason>`` is REQUIRED: a waiver without a
+justification is itself a finding (``waiver-syntax``), so every exemption
+in the tree documents why the rule does not apply.
+
+Hot-path registration for the host-sync rule uses the same comment channel
+(``# graftcheck: hot-path`` on or directly above a ``def``) plus the central
+registry in :mod:`cpgisland_tpu.analysis.config`.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+import re
+from typing import Callable, Iterable, Iterator, Optional
+
+from cpgisland_tpu.analysis import astutil
+from cpgisland_tpu.analysis.config import hot_functions_for
+
+WAIVER_RE = re.compile(
+    r"#\s*graftcheck:\s*allow\(([\w\-, ]+)\)(?:\s*--\s*(?P<reason>.*\S))?"
+)
+HOT_MARK_RE = re.compile(r"#\s*graftcheck:\s*hot-path\b")
+
+
+@dataclasses.dataclass
+class Finding:
+    rule: str
+    path: str
+    line: int
+    col: int
+    message: str
+    waived: bool = False
+    waiver_reason: str = ""
+
+    def format(self) -> str:
+        tag = " (waived: %s)" % self.waiver_reason if self.waived else ""
+        return f"{self.path}:{self.line}:{self.col}: [{self.rule}] {self.message}{tag}"
+
+    def as_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+@dataclasses.dataclass
+class Waiver:
+    line: int  # line the waiver comment sits on (1-based)
+    rules: tuple[str, ...]
+    reason: str
+    applies_to: int  # line whose findings it covers
+    used: bool = False
+
+
+def source_comments(source: str) -> dict[int, tuple[str, bool]]:
+    """line -> (comment text, standalone?) via tokenize, so waiver/hot-path
+    markers inside string literals and docstrings are NOT parsed as live.
+    Falls back to a plain line scan if tokenization fails."""
+    import io
+    import tokenize
+
+    out: dict[int, tuple[str, bool]] = {}
+    try:
+        for tok in tokenize.generate_tokens(io.StringIO(source).readline):
+            if tok.type == tokenize.COMMENT:
+                out[tok.start[0]] = (tok.string, tok.line.lstrip().startswith("#"))
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        for i, text in enumerate(source.splitlines(), start=1):
+            if "#" in text:
+                _, _, comment = text.partition("#")
+                out[i] = ("#" + comment, text.lstrip().startswith("#"))
+    return out
+
+
+def parse_waivers(source: str) -> tuple[list[Waiver], list[tuple[int, str]]]:
+    """Returns (waivers, syntax_errors) for one file's comments."""
+    waivers: list[Waiver] = []
+    errors: list[tuple[int, str]] = []
+    for i, (text, standalone) in sorted(source_comments(source).items()):
+        m = WAIVER_RE.search(text)
+        if not m:
+            if re.search(r"graftcheck:\s*allow", text):
+                errors.append(
+                    (i, "malformed waiver; expected "
+                        "'# graftcheck: allow(<rule>) -- <reason>'")
+                )
+            continue
+        rules = tuple(r.strip() for r in m.group(1).split(",") if r.strip())
+        reason = (m.group("reason") or "").strip()
+        if not reason:
+            errors.append(
+                (i, "waiver missing its justification "
+                    "('# graftcheck: allow(<rule>) -- <reason>')")
+            )
+            continue
+        waivers.append(
+            Waiver(line=i, rules=rules, reason=reason,
+                   applies_to=i + 1 if standalone else i)
+        )
+    return waivers, errors
+
+
+class FileContext:
+    """Everything a rule needs about one source file, parsed once."""
+
+    def __init__(self, path: str, source: str, relpath: Optional[str] = None):
+        self.path = path
+        self.relpath = (relpath or path).replace(os.sep, "/")
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = astutil.attach_parents(ast.parse(source, filename=path))
+        self.imports = astutil.ImportMap(self.tree)
+        self.module_ints = {
+            **astutil.imported_int_constants(self.tree, self.imports),
+            **astutil.module_int_constants(self.tree),
+        }
+        self.comments = source_comments(source)
+        self.waivers, self.waiver_errors = parse_waivers(source)
+        self.hot_functions = self._collect_hot_functions()
+
+    def _collect_hot_functions(self) -> set[str]:
+        hot = set(hot_functions_for(self.relpath))
+        marked = {
+            ln for ln, (text, _) in self.comments.items()
+            if HOT_MARK_RE.search(text)
+        }
+        for node in ast.walk(self.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                deco_first = min(
+                    [d.lineno for d in node.decorator_list] or [node.lineno]
+                )
+                if marked & {node.lineno, node.lineno - 1, deco_first - 1}:
+                    hot.add(node.name)
+        return hot
+
+    def call_name(self, call: ast.Call) -> Optional[str]:
+        return astutil.call_name(self.imports, call)
+
+    def finding(self, rule: str, node: ast.AST, message: str) -> Finding:
+        return Finding(
+            rule=rule,
+            path=self.relpath,
+            line=getattr(node, "lineno", 1),
+            col=getattr(node, "col_offset", 0) + 1,
+            message=message,
+        )
+
+
+@dataclasses.dataclass
+class Rule:
+    name: str
+    description: str
+    check: Callable[[FileContext], Iterator[Finding]]
+    origin: str = ""  # the CLAUDE.md/BASELINE.md gotcha this encodes
+
+
+_REGISTRY: dict[str, Rule] = {}
+
+
+def register(name: str, description: str, origin: str = ""):
+    def deco(fn):
+        _REGISTRY[name] = Rule(name, description, fn, origin)
+        return fn
+
+    return deco
+
+
+def all_rules() -> dict[str, Rule]:
+    # Import for side effects exactly once; rule modules self-register.
+    from cpgisland_tpu.analysis import (  # noqa: F401
+        rules_hotpath,
+        rules_hygiene,
+        rules_jit,
+        rules_numerics,
+        rules_pallas,
+    )
+
+    return dict(_REGISTRY)
+
+
+@dataclasses.dataclass
+class LintResult:
+    findings: list[Finding]
+    files_checked: int
+    unused_waivers: list[tuple[str, Waiver]]
+
+    @property
+    def unwaived(self) -> list[Finding]:
+        return [f for f in self.findings if not f.waived]
+
+    @property
+    def waived(self) -> list[Finding]:
+        return [f for f in self.findings if f.waived]
+
+    @property
+    def ok(self) -> bool:
+        return not self.unwaived
+
+
+def discover_files(paths: Iterable[str]) -> list[str]:
+    out: list[str] = []
+    skip_dirs = {"__pycache__", ".git", "fixtures", "node_modules", ".venv"}
+    for p in paths:
+        if os.path.isfile(p):
+            out.append(p)
+            continue
+        for root, dirs, files in os.walk(p):
+            dirs[:] = sorted(d for d in dirs if d not in skip_dirs)
+            out.extend(
+                os.path.join(root, f) for f in sorted(files) if f.endswith(".py")
+            )
+    return out
+
+
+def _apply_waivers(ctx: FileContext, findings: list[Finding]) -> None:
+    for f in findings:
+        for w in ctx.waivers:
+            if f.line == w.applies_to and f.rule in w.rules:
+                f.waived = True
+                f.waiver_reason = w.reason
+                w.used = True
+                break
+
+
+def lint_file(
+    path: str,
+    rules: Optional[dict[str, Rule]] = None,
+    relpath: Optional[str] = None,
+    source: Optional[str] = None,
+) -> tuple[list[Finding], list[Waiver]]:
+    """Lint one file; returns (findings incl. waived, that file's waivers)."""
+    rules = rules if rules is not None else all_rules()
+    if source is None:
+        with open(path, "r", encoding="utf-8") as fh:
+            source = fh.read()
+    rel = (relpath or path).replace(os.sep, "/")
+    try:
+        ctx = FileContext(path, source, relpath=rel)
+    except SyntaxError as e:
+        return [
+            Finding("parse-error", rel, e.lineno or 1, (e.offset or 0) + 1,
+                    f"file does not parse: {e.msg}")
+        ], []
+    findings: list[Finding] = []
+    for line, msg in ctx.waiver_errors:
+        findings.append(Finding("waiver-syntax", ctx.relpath, line, 1, msg))
+    for rule in rules.values():
+        findings.extend(rule.check(ctx))
+    _apply_waivers(ctx, findings)
+    findings.sort(key=lambda f: (f.line, f.col, f.rule))
+    return findings, ctx.waivers
+
+
+def run_lint(
+    paths: Iterable[str],
+    rule_names: Optional[Iterable[str]] = None,
+    base: Optional[str] = None,
+) -> LintResult:
+    """Lint every ``*.py`` under ``paths``; ``rule_names`` restricts rules.
+
+    ``base`` (default: cwd) makes reported paths repo-relative.
+    """
+    rules = all_rules()
+    if rule_names is not None:
+        unknown = set(rule_names) - set(rules)
+        if unknown:
+            raise ValueError(f"unknown rule(s): {sorted(unknown)}")
+        rules = {k: v for k, v in rules.items() if k in set(rule_names)}
+    base = base or os.getcwd()
+    findings: list[Finding] = []
+    unused: list[tuple[str, Waiver]] = []
+    files = discover_files(paths)
+    for path in files:
+        rel = os.path.relpath(path, base)
+        if rel.startswith(".."):
+            rel = path
+        file_findings, waivers = lint_file(path, rules, relpath=rel)
+        findings.extend(file_findings)
+        # A waiver only counts as stale if a rule it names actually RAN
+        # this invocation — under --rules subsets, waivers for unselected
+        # rules are out of scope, not stale.
+        unused.extend(
+            (rel, w) for w in waivers
+            if not w.used and set(w.rules) & set(rules)
+        )
+    return LintResult(
+        findings=findings, files_checked=len(files), unused_waivers=unused
+    )

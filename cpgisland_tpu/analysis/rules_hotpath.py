@@ -1,0 +1,182 @@
+"""R3 ``hot-path-host-sync``: banned blocking fetches in registered hot paths.
+
+Every blocking device->host sync on this setup pays a 50-100 ms relay
+round trip (CLAUDE.md), so the decode/posterior/EM driver loops must
+either avoid host syncs or route the ones they genuinely need through
+``obs.note_fetch`` — which both documents the sync as intentional and
+makes the dispatch ledger count it (PR 1).  Inside a registered hot path
+(see :mod:`cpgisland_tpu.analysis.config` and the ``# graftcheck:
+hot-path`` marker) this rule flags:
+
+- ``x.item()``
+- ``float(x)`` / ``int(x)`` on a non-literal (implicit scalar fetch)
+- ``np.asarray(x)`` (the canonical fetch spelling)
+- ``jax.block_until_ready`` / ``jax.device_get``
+
+unless the call sits inside an ``obs.note_fetch(...)`` /
+``obs.note_upload(...)`` wrapper expression.  Intentional unrouted syncs
+carry an inline waiver naming why the round trip is unavoidable.
+
+Precision carve-outs (a linter nobody trusts is worse than none):
+
+- ``np.asarray(x)`` where ``x`` is rooted at a parameter of the hot
+  function is host-input coercion at the API boundary, not a device
+  fetch, and passes; so does ``np.asarray`` of a name assigned from a
+  list/tuple literal or comprehension (already-host data);
+- ``float()``/``int()`` flag only when the argument *itself computes on
+  device* — it contains a ``jnp.*``/``jax.*`` call or a method call like
+  ``x.min()`` — because ``float(already_fetched_scalar)`` is free and
+  pervasive after a routed fetch.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from cpgisland_tpu.analysis import astutil
+from cpgisland_tpu.analysis.core import FileContext, Finding, register
+
+BANNED_CALLS = frozenset({
+    "np.asarray", "numpy.asarray",
+    "jax.block_until_ready", "jax.device_get",
+})
+NOTE_WRAPPERS = ("note_fetch", "note_upload")
+SCALAR_CASTS = frozenset({"float", "int"})
+
+
+def _routed_through_note(node: ast.AST) -> bool:
+    for p in astutil.parents(node):
+        if isinstance(p, ast.Call):
+            fn = p.func
+            name = fn.attr if isinstance(fn, ast.Attribute) else (
+                fn.id if isinstance(fn, ast.Name) else None
+            )
+            if name in NOTE_WRAPPERS:
+                return True
+        elif isinstance(p, (ast.stmt,)) and not isinstance(p, ast.Expr):
+            # Stop at the enclosing statement boundary (assignments etc.
+            # still count as the same expression tree, so only break once
+            # we leave expression context entirely).
+            break
+    return False
+
+
+def _root_name(node: ast.AST):
+    """The root Name of an expression like ``obs[0]``, ``params.log_B``,
+    or ``conf.sum(...)`` (method calls unwrap to their receiver)."""
+    while True:
+        if isinstance(node, (ast.Subscript, ast.Attribute, ast.Starred)):
+            node = node.value
+        elif isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute):
+            node = node.func.value
+        else:
+            break
+    return node.id if isinstance(node, ast.Name) else None
+
+
+def _host_rooted(ctx: FileContext, use_site: ast.AST, arg: ast.AST) -> bool:
+    """Arg is rooted at a parameter of an enclosing function (input coercion
+    at an API/helper boundary — a device value crossing that boundary had
+    its sync counted at its producer) or at a name assigned from a
+    list/tuple/dict literal or comprehension (already-host data)."""
+    root = _root_name(arg)
+    if root is None:
+        return False
+    for fn in astutil.enclosing_functions(use_site):
+        if root in {p.arg for p in astutil.func_params(fn)}:
+            return True
+        v = astutil.single_assignments(fn).get(root)
+        if isinstance(
+            v, (ast.List, ast.Tuple, ast.Dict, ast.ListComp, ast.DictComp,
+                ast.GeneratorExp)
+        ):
+            return True
+        if root in astutil.bound_names(fn):
+            return False  # bound here to something non-literal: judged live
+    return False
+
+
+def _computes_on_device(ctx: FileContext, arg: ast.AST) -> bool:
+    """Does the cast argument itself do device work — a jnp./jax. call or a
+    method call (``x.min()``) anywhere inside it?"""
+    for node in ast.walk(arg):
+        if not isinstance(node, ast.Call):
+            continue
+        name = ctx.call_name(node) or ""
+        if name.startswith(("jnp.", "jax.", "jax.numpy.")):
+            return True
+        if isinstance(node.func, ast.Attribute) and node.func.attr in (
+            "min", "max", "sum", "mean", "prod", "argmax", "argmin", "all",
+            "any", "item",
+        ):
+            return True
+    return False
+
+
+def _arg_already_fetched(ctx: FileContext, arg: ast.AST) -> bool:
+    """float()/int() on a value that is ALREADY a host fetch result is free;
+    the inner fetch call is what gets judged (or flagged) on its own."""
+    if not isinstance(arg, ast.Call):
+        return False
+    fn = arg.func
+    name = fn.attr if isinstance(fn, ast.Attribute) else (
+        fn.id if isinstance(fn, ast.Name) else None
+    )
+    if name in NOTE_WRAPPERS:
+        return True
+    canonical = ctx.call_name(arg)
+    return canonical is not None and astutil.matches(canonical, BANNED_CALLS)
+
+
+def _hot_function_nodes(ctx: FileContext):
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and node.name in ctx.hot_functions:
+            yield node
+
+
+@register(
+    "hot-path-host-sync",
+    "no .item()/float()/np.asarray/block_until_ready/device_get inside "
+    "registered hot paths unless routed through obs.note_fetch",
+    origin="CLAUDE.md: each blocking dispatch pays ~50-100 ms relay RTT; "
+    "obs.note_fetch documents + ledger-counts the intentional ones",
+)
+def check_hot_path_host_sync(ctx: FileContext) -> Iterator[Finding]:
+    seen: set[int] = set()
+    for hot in _hot_function_nodes(ctx):
+        for node in ast.walk(hot):
+            if not isinstance(node, ast.Call) or id(node) in seen:
+                continue
+            msg = None
+            if isinstance(node.func, ast.Attribute) and node.func.attr == "item" \
+                    and not node.args:
+                msg = ".item() blocks on a device->host scalar fetch"
+            else:
+                name = ctx.call_name(node)
+                if name is not None and astutil.matches(name, BANNED_CALLS):
+                    short = name.rsplit(".", 1)[-1]
+                    if not (short == "asarray" and node.args
+                            and _host_rooted(ctx, node, node.args[0])):
+                        msg = f"{short}() is a blocking host sync"
+                elif isinstance(node.func, ast.Name) \
+                        and node.func.id in SCALAR_CASTS and node.args \
+                        and not isinstance(node.args[0], ast.Constant) \
+                        and not _arg_already_fetched(ctx, node.args[0]) \
+                        and not _host_rooted(ctx, node, node.args[0]) \
+                        and _computes_on_device(ctx, node.args[0]):
+                    msg = (
+                        f"{node.func.id}() on a device-computed value is an "
+                        "implicit blocking scalar fetch"
+                    )
+            if msg is None or _routed_through_note(node):
+                continue
+            seen.add(id(node))
+            yield ctx.finding(
+                "hot-path-host-sync",
+                node,
+                f"hot path {hot.name!r}: {msg}; route it through "
+                "obs.note_fetch(...) or waive with the reason the round "
+                "trip is unavoidable",
+            )

@@ -1,0 +1,89 @@
+"""Hygiene tier: ``unused-import`` and ``shadow-builtin``.
+
+The container this repo grows in has no ruff/mypy baked in (and the
+no-new-deps rule forbids installing them), so graftcheck carries the two
+hygiene checks the CI script would otherwise get from ruff — enough to
+keep import rot and builtin shadowing out of the tree.  ``tools/
+ci_checks.sh`` still runs the real ruff when one is on PATH; the
+``[tool.ruff]`` config in pyproject.toml is the richer source of truth.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from cpgisland_tpu.analysis import astutil
+from cpgisland_tpu.analysis.core import FileContext, Finding, register
+
+
+@register(
+    "unused-import",
+    "module-level imports must be referenced (or marked with noqa / "
+    "re-exported via __all__)",
+    origin="satellite: ruff-equivalent hygiene baked into graftcheck "
+    "(no ruff in the container)",
+)
+def check_unused_import(ctx: FileContext) -> Iterator[Finding]:
+    if ctx.relpath.endswith("__init__.py"):
+        return  # re-export surface: unused-looking imports are the point
+    used: set[str] = set()
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load):
+            used.add(node.id)
+        elif isinstance(node, ast.Attribute):
+            root = node
+            while isinstance(root, ast.Attribute):
+                root = root.value
+            if isinstance(root, ast.Name):
+                used.add(root.id)
+        elif isinstance(node, ast.Constant) and isinstance(node.value, str):
+            used.add(node.value)  # __all__ entries, getattr strings
+    for node in ctx.tree.body:
+        if not isinstance(node, (ast.Import, ast.ImportFrom)):
+            continue
+        if isinstance(node, ast.ImportFrom) and node.module == "__future__":
+            continue
+        line = ctx.lines[node.lineno - 1] if node.lineno <= len(ctx.lines) else ""
+        if "noqa" in line:
+            continue
+        for a in node.names:
+            if a.name == "*":
+                continue
+            bound = (a.asname or a.name).split(".")[0]
+            if bound not in used and f"{bound}." not in ctx.source:
+                yield Finding(
+                    "unused-import", ctx.relpath, node.lineno, node.col_offset + 1,
+                    f"import {bound!r} is never used",
+                )
+
+
+SHADOWABLE = frozenset({
+    "list", "dict", "set", "tuple", "type", "id", "input", "object", "print",
+    "len", "sum", "max", "min", "range", "filter", "map", "all", "any",
+    "bytes", "str", "int", "float", "bool", "hash", "next", "iter", "vars",
+})
+
+
+@register(
+    "shadow-builtin",
+    "function parameters and assignments must not shadow Python builtins",
+    origin="satellite: ruff-equivalent hygiene baked into graftcheck "
+    "(no ruff in the container)",
+)
+def check_shadow_builtin(ctx: FileContext) -> Iterator[Finding]:
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, astutil.FunctionNode):
+            for p in astutil.func_params(node):
+                if p.arg in SHADOWABLE:
+                    yield Finding(
+                        "shadow-builtin", ctx.relpath, p.lineno, p.col_offset + 1,
+                        f"parameter {p.arg!r} shadows a builtin",
+                    )
+        elif isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name) and t.id in SHADOWABLE:
+                    yield Finding(
+                        "shadow-builtin", ctx.relpath, t.lineno, t.col_offset + 1,
+                        f"assignment to {t.id!r} shadows a builtin",
+                    )

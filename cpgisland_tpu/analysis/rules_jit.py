@@ -1,0 +1,275 @@
+"""R1 ``jit-big-closure`` and R6 ``retrace-hazard``: the jit-wrapper rules.
+
+R1 — remote compile ships the program bytes over HTTP, and a jitted
+function that *closes over* an array constant bakes those bytes into the
+module (a 256 MiB baked constant = HTTP 413, CLAUDE.md).  Arrays must be
+traced ARGUMENTS.  The rule flags jit/pjit/pallas-wrapped functions whose
+free variables resolve to array-constructor expressions in module or
+enclosing-function scope.  Small literal tables (<= 64 elements written
+out in source) are exempt — they are the lane-broadcast constants kernels
+legitimately bake.
+
+R6 — a jitted callable taking a raw Python scalar retraces on every new
+value (and a shape-varying arg recompiles per shape).  Any parameter that
+is int/bool/str-annotated or int/bool/str-defaulted must appear in
+``static_argnums``/``static_argnames`` — or the call site must bucket it
+(pow2 record bucketing, chunking.bucket_records).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Optional
+
+from cpgisland_tpu.analysis import astutil
+from cpgisland_tpu.analysis.core import FileContext, Finding, register
+
+JIT_NAMES = frozenset({
+    "jax.jit", "jit", "pjit", "jax.pjit", "jax.experimental.pjit.pjit",
+})
+PALLAS_CALL_NAMES = frozenset({
+    "pl.pallas_call", "pallas_call", "jax.experimental.pallas.pallas_call",
+})
+PARTIAL_NAMES = frozenset({"functools.partial", "partial"})
+# Transparent combinators: jit(vmap(f)) etc. — analyze f.
+TRANSPARENT = frozenset({
+    "jax.vmap", "vmap", "jax.shard_map", "shard_map", "jax.pmap", "pmap",
+    "jax.named_call", "jax.checkpoint", "jax.remat",
+    "jax.experimental.shard_map.shard_map",
+})
+
+ARRAY_MAKERS = frozenset({
+    "array", "asarray", "zeros", "ones", "full", "empty", "eye", "arange",
+    "linspace", "load", "fromfile", "frombuffer", "loadtxt", "identity",
+    "tile", "repeat", "concatenate", "stack", "broadcast_to",
+})
+ARRAY_MODULES = ("np.", "numpy.", "jnp.", "jax.numpy.")
+
+SMALL_LITERAL_MAX = 64
+
+
+def _literal_size(node: ast.AST) -> Optional[int]:
+    """Element count of a nested literal list/tuple of constants, else None."""
+    if isinstance(node, ast.Constant):
+        return 1
+    if isinstance(node, (ast.List, ast.Tuple)):
+        total = 0
+        for el in node.elts:
+            n = _literal_size(el)
+            if n is None:
+                return None
+            total += n
+        return total
+    return None
+
+
+def _is_array_maker(ctx: FileContext, node: ast.AST) -> bool:
+    """Does this expression construct an ndarray (np.*/jnp.* factory call)?"""
+    if not isinstance(node, ast.Call):
+        return False
+    name = ctx.call_name(node)
+    if name is None:
+        return False
+    if not (name.startswith(ARRAY_MODULES) or name.startswith("jax.numpy")):
+        return False
+    tail = name.rsplit(".", 1)[-1]
+    if tail not in ARRAY_MAKERS:
+        return False
+    # Small literal tables written out in source are fine to bake.
+    if tail in ("array", "asarray") and node.args:
+        n = _literal_size(node.args[0])
+        if n is not None and n <= SMALL_LITERAL_MAX:
+            return False
+    return True
+
+
+def _unwrap_target(ctx: FileContext, node: ast.AST, depth: int = 0):
+    """Resolve the function object a jit wrapper wraps, through partial()
+    and transparent combinators.  Returns an ast node (def or Lambda) or
+    None when the target is opaque (a call result, an attribute, ...)."""
+    if depth > 4 or node is None:
+        return None
+    if isinstance(node, ast.Lambda):
+        return node
+    if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        return node
+    if isinstance(node, ast.Name):
+        # Innermost enclosing scope that binds the name to a def.
+        for fn in astutil.enclosing_functions(node):
+            for sub in astutil.walk_scope(fn):
+                if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                        and sub.name == node.id:
+                    return sub
+            assigns = astutil.single_assignments(fn)
+            if node.id in assigns:
+                return _unwrap_target(ctx, assigns[node.id], depth + 1)
+            if node.id in astutil.bound_names(fn):
+                return None  # bound to something opaque in this scope
+        return astutil.top_level_defs(ctx.tree).get(node.id)
+    if isinstance(node, ast.Call):
+        name = ctx.call_name(node)
+        if astutil.matches(name, PARTIAL_NAMES | TRANSPARENT):
+            return _unwrap_target(ctx, node.args[0] if node.args else None,
+                                  depth + 1)
+    return None
+
+
+def _jit_sites(ctx: FileContext):
+    """Yield (report_node, target_fn_node_or_None, static_names, static_nums)
+    for every jit/pjit wrapper in the file — decorators and call sites."""
+
+    def statics(call: Optional[ast.Call]) -> tuple[set, set]:
+        names: set[str] = set()
+        nums: set[int] = set()
+        if call is None:
+            return names, nums
+        for kw in call.keywords:
+            if kw.arg == "static_argnames":
+                for n in ast.walk(kw.value):
+                    if isinstance(n, ast.Constant) and isinstance(n.value, str):
+                        names.add(n.value)
+            elif kw.arg == "static_argnums":
+                for n in ast.walk(kw.value):
+                    if isinstance(n, ast.Constant) and isinstance(n.value, int):
+                        nums.add(n.value)
+        return names, nums
+
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for deco in node.decorator_list:
+                if astutil.matches(ctx.imports.canonical(deco), JIT_NAMES):
+                    yield deco, node, set(), set()
+                elif isinstance(deco, ast.Call):
+                    name = ctx.call_name(deco)
+                    if astutil.matches(name, JIT_NAMES):
+                        yield deco, node, *statics(deco)
+                    elif astutil.matches(name, PARTIAL_NAMES) and deco.args \
+                            and astutil.matches(
+                                ctx.imports.canonical(deco.args[0]), JIT_NAMES
+                            ):
+                        yield deco, node, *statics(deco)
+        elif isinstance(node, ast.Call):
+            if astutil.matches(ctx.call_name(node), JIT_NAMES) and node.args:
+                in_deco = any(
+                    isinstance(p, (ast.FunctionDef, ast.AsyncFunctionDef))
+                    and node in p.decorator_list
+                    for p in astutil.parents(node)
+                )
+                if not in_deco:
+                    target = _unwrap_target(ctx, node.args[0])
+                    yield node, target, *statics(node)
+
+
+@register(
+    "jit-big-closure",
+    "jit/pjit/pallas-wrapped functions must not close over array constants "
+    "(pass arrays as traced arguments)",
+    origin="CLAUDE.md: remote compile ships program bytes over HTTP; a "
+    "256 MiB baked constant = HTTP 413",
+)
+def check_jit_big_closure(ctx: FileContext) -> Iterator[Finding]:
+    targets = []
+    for report, target, _names, _nums in _jit_sites(ctx):
+        if target is not None:
+            targets.append((report, target))
+    # pallas_call kernels bake their closures into every program too.
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Call) and astutil.matches(
+            ctx.call_name(node), PALLAS_CALL_NAMES
+        ) and node.args:
+            target = _unwrap_target(ctx, node.args[0])
+            if target is not None:
+                targets.append((node, target))
+
+    module_assigns = {
+        t.targets[0].id: t.value
+        for t in ctx.tree.body
+        if isinstance(t, ast.Assign) and len(t.targets) == 1
+        and isinstance(t.targets[0], ast.Name)
+    }
+    seen: set[tuple[int, str]] = set()
+    for report, target in targets:
+        free = astutil.free_loads(target)
+        enclosing = astutil.enclosing_functions(target)
+        for name, load in free.items():
+            value = None
+            for fn in enclosing:
+                assigns = astutil.single_assignments(fn)
+                if name in assigns:
+                    value = assigns[name]
+                    break
+                if name in astutil.bound_names(fn):
+                    break  # rebound / parameter: can't prove, stay quiet
+            else:
+                value = module_assigns.get(name)
+            if value is not None and _is_array_maker(ctx, value):
+                key = (load.lineno, name)
+                if key in seen:
+                    continue
+                seen.add(key)
+                yield ctx.finding(
+                    "jit-big-closure",
+                    load,
+                    f"jitted function closes over array constant {name!r} "
+                    f"(built at line {value.lineno}); pass it as a traced "
+                    "argument — baked constants ship in the compiled module",
+                )
+
+
+SCALARISH = frozenset({"int", "bool", "str"})
+
+
+@register(
+    "retrace-hazard",
+    "jitted callables must declare raw Python scalar params as "
+    "static_argnums/static_argnames (or bucket shapes pow2)",
+    origin="CLAUDE.md: distinct tail lengths recompile per record; pad to "
+    "the span / bucket pow2 so shapes don't recompile",
+)
+def check_retrace_hazard(ctx: FileContext) -> Iterator[Finding]:
+    for report, target, static_names, static_nums in _jit_sites(ctx):
+        if target is None or isinstance(target, ast.Lambda):
+            continue
+        params = astutil.func_params(target)
+        for i, p in enumerate(params):
+            hazard = None
+            ann = p.annotation
+            if isinstance(ann, ast.Name) and ann.id in SCALARISH:
+                hazard = f"annotated {ann.id}"
+            elif isinstance(ann, ast.Constant) and isinstance(ann.value, str) \
+                    and ann.value in SCALARISH:
+                hazard = f"annotated {ann.value}"
+            if hazard is None:
+                default = _default_for(target, i, len(params))
+                if isinstance(default, ast.Constant) and isinstance(
+                    default.value, (int, bool, str)
+                ) and default.value is not None:
+                    hazard = f"defaulted to {default.value!r}"
+            if hazard and p.arg not in static_names and i not in static_nums:
+                # Anchor decorator-form findings at the def line: that is
+                # where a human reads the signature and writes the waiver
+                # (a decorator can span lines and predate the def).
+                anchor = (
+                    target
+                    if report in getattr(target, "decorator_list", [])
+                    else report
+                )
+                yield ctx.finding(
+                    "retrace-hazard",
+                    anchor,
+                    f"jitted {target.name!r} takes Python scalar "
+                    f"{p.arg!r} ({hazard}) without static_argnums/"
+                    "static_argnames: every new value retraces",
+                )
+
+
+def _default_for(fn: ast.AST, index: int, n_params: int) -> Optional[ast.AST]:
+    a = fn.args
+    pos = [*a.posonlyargs, *a.args]
+    if index < len(pos):
+        d_index = index - (len(pos) - len(a.defaults))
+        return a.defaults[d_index] if 0 <= d_index < len(a.defaults) else None
+    k_index = index - len(pos)
+    if k_index < len(a.kwonlyargs):
+        return a.kw_defaults[k_index]
+    return None

@@ -1,0 +1,166 @@
+"""R4 ``maxplus-normalize`` and R5 ``no-stats-in-bwd-chain``.
+
+R4 — max-plus scores drift ~-1.3 nat/symbol, so an unnormalized f32
+product chain reaches magnitudes where the ulp exceeds the O(1) per-state
+differences every argmax depends on (ops.viterbi_parallel.nrm_maxplus).
+Inside ``parallel/`` (the cross-device stitching layer, where a missed
+normalization silently corrupts genome-scale decodes), every
+``maxplus_matmul`` combine must flow straight into ``nrm_maxplus`` /
+``nrm_maxplus_vec`` / ``scan_block_products`` (or the probability-space
+``_nrm_m``/``_nrm_v`` twins).
+
+R5 — count-tensor accumulation inside the sequential backward walk is
+banned (CLAUDE.md: it serializes the stats reduction into the recurrence
+chain; the chunked path reduces counts in the separate throughput-bound
+stats pass).  The exemption is light per-position *emission* that never
+re-enters the carry — the ``_bwd_conf_kernel`` pattern — which this rule
+does not flag (it only looks at additive self-updates).  Genuinely needed
+carried sums take an inline waiver.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterator
+
+from cpgisland_tpu.analysis import astutil
+from cpgisland_tpu.analysis.core import FileContext, Finding, register
+
+MAXPLUS_COMBINES = frozenset({"maxplus_matmul"})
+NORMALIZERS = frozenset({
+    "nrm_maxplus", "nrm_maxplus_vec", "scan_block_products", "_nrm_m", "_nrm_v",
+})
+
+
+@register(
+    "maxplus-normalize",
+    "max-plus combines in parallel/ must flow through nrm_maxplus "
+    "(unnormalized f32 products quantize at genome length)",
+    origin="CLAUDE.md: viterbi_parallel.scan_block_products / nrm_maxplus — "
+    "f32 ulp exceeds per-state differences at chromosome magnitude",
+)
+def check_maxplus_normalize(ctx: FileContext) -> Iterator[Finding]:
+    if "/parallel/" not in f"/{ctx.relpath}":
+        return
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = ctx.call_name(node)
+        if not astutil.matches(name, MAXPLUS_COMBINES):
+            continue
+        parent = getattr(node, "parent", None)
+        if isinstance(parent, ast.Call) and astutil.matches(
+            ctx.call_name(parent), NORMALIZERS
+        ):
+            continue
+        yield ctx.finding(
+            "maxplus-normalize",
+            node,
+            "maxplus_matmul result is not normalized in place; wrap it as "
+            "nrm_maxplus(maxplus_matmul(...)) — unnormalized f32 max-plus "
+            "products quantize per-state differences at genome length",
+        )
+
+
+STATS_NAME_RE = re.compile(
+    r"(?i)(^|_)(xi|gamma|count|counts|stat|stats|trans|emit|init|acc|num|denom)"
+    r"($|_|s$)"
+)
+SCAN_NAMES = frozenset({"jax.lax.scan", "lax.scan", "scan"})
+FORI_NAMES = frozenset({"jax.lax.fori_loop", "lax.fori_loop", "fori_loop"})
+
+
+def _is_reverse_scan(ctx: FileContext, call: ast.Call) -> bool:
+    if not astutil.matches(ctx.call_name(call), SCAN_NAMES):
+        return False
+    for kw in call.keywords:
+        if kw.arg == "reverse" and isinstance(kw.value, ast.Constant):
+            return bool(kw.value.value)
+    return False
+
+
+def _body_functions(ctx: FileContext, call: ast.Call):
+    """The function-ish first argument of a scan/fori call, resolved."""
+    from cpgisland_tpu.analysis.rules_jit import _unwrap_target
+
+    args = call.args
+    if astutil.matches(ctx.call_name(call), FORI_NAMES):
+        cand = args[2] if len(args) >= 3 else None
+    else:
+        cand = args[0] if args else None
+    target = _unwrap_target(ctx, cand) if cand is not None else None
+    return [target] if target is not None else []
+
+
+def _bwd_contexts(ctx: FileContext):
+    """(context_node, label) pairs whose bodies form a sequential backward
+    walk: reverse=True scan bodies, and fori/loop bodies inside functions
+    whose name marks them as backward kernels/assemblies."""
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Call):
+            if _is_reverse_scan(ctx, node):
+                for body in _body_functions(ctx, node):
+                    yield body, "reverse scan body"
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if re.search(r"(^|_)(bwd|backward)(_|$)", node.name):
+                for sub in ast.walk(node):
+                    if isinstance(sub, ast.Call) and astutil.matches(
+                        ctx.call_name(sub), FORI_NAMES
+                    ):
+                        for body in _body_functions(ctx, sub):
+                            yield body, f"backward walk in {node.name!r}"
+
+
+def _accumulations(body: ast.AST):
+    """Additive self-updates onto stats-named targets inside ``body``."""
+    for node in ast.walk(body):
+        if isinstance(node, ast.AugAssign) and isinstance(node.op, ast.Add) \
+                and isinstance(node.target, ast.Name) \
+                and STATS_NAME_RE.search(node.target.id):
+            yield node, node.target.id
+        elif isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name) \
+                and STATS_NAME_RE.search(node.targets[0].id):
+            tname = node.targets[0].id
+            v = node.value
+            if isinstance(v, ast.BinOp) and isinstance(v.op, ast.Add) and any(
+                isinstance(n, ast.Name) and n.id == tname
+                for n in ast.walk(v)
+            ):
+                yield node, tname
+        elif isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute) \
+                and node.func.attr == "add":
+            # x.at[...].add(...) scatter-accumulate
+            base = node.func.value
+            if isinstance(base, ast.Subscript) and isinstance(
+                base.value, ast.Attribute
+            ) and base.value.attr == "at" and isinstance(
+                base.value.value, ast.Name
+            ) and STATS_NAME_RE.search(base.value.value.id):
+                yield node, base.value.value.id
+
+
+@register(
+    "no-stats-in-bwd-chain",
+    "no count-tensor accumulation inside sequential backward scan carries "
+    "(reduce counts in a separate throughput-bound pass)",
+    origin="CLAUDE.md: accumulating stats INSIDE the sequential backward "
+    "walk is banned; light per-position emission (_bwd_conf_kernel) is the "
+    "allowed exception",
+)
+def check_no_stats_in_bwd_chain(ctx: FileContext) -> Iterator[Finding]:
+    seen: set[int] = set()
+    for body, label in _bwd_contexts(ctx):
+        for node, name in _accumulations(body):
+            if id(node) in seen:
+                continue
+            seen.add(id(node))
+            yield ctx.finding(
+                "no-stats-in-bwd-chain",
+                node,
+                f"accumulation onto {name!r} inside a {label}: stats sums "
+                "serialize into the backward recurrence chain; emit "
+                "per-position values and reduce them in a separate pass "
+                "(the _bwd_conf_kernel pattern is emission, not accumulation)",
+            )

@@ -1,0 +1,234 @@
+"""R2 ``pallas-sublane-align``: the Mosaic kernel-shape rules.
+
+Encodes the constraints honed on this codebase (CLAUDE.md "Mosaic
+constraints"):
+
+- dynamic sublane offsets into (8, 128)-tiled VMEM must be *provably*
+  8-aligned — write them as ``i * ROW_TILE``, not ``Tt - 8 - i*8``.  A
+  dynamic start that mixes in an opaque term (a shape, a non-constant
+  parameter) is unprovable and flags;
+- kernel values are rank-2 (sublane, lane) only: explicit >=3-D shape
+  literals in ``reshape``/``broadcast_to``/``zeros``/... flag;
+- Mosaic cannot broadcast ``[1,1] -> [8,128]``: scalar-indexed table loads
+  (``tab_ref[i, j]``) fed to ``broadcast_to`` flag — tables must be
+  lane-broadcast OUTSIDE the kernel (``_bcast_tab``) and read as [1, LT]
+  rows.
+
+Kernel discovery: any function passed as the first argument to
+``pl.pallas_call`` (resolved through ``functools.partial``), plus any
+function whose name matches ``*_kernel`` and takes ``*_ref`` parameters.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Optional
+
+from cpgisland_tpu.analysis import astutil
+from cpgisland_tpu.analysis.core import FileContext, Finding, register
+from cpgisland_tpu.analysis.rules_jit import PALLAS_CALL_NAMES, _unwrap_target
+
+DS_NAMES = frozenset({"pl.ds", "ds", "pl.dslice", "dslice",
+                      "jax.experimental.pallas.ds",
+                      "jax.experimental.pallas.dslice"})
+SHAPE_CALLS = frozenset({"reshape", "broadcast_to", "zeros", "ones", "full",
+                         "empty"})
+
+# Alignment lattice values for sublane-offset expressions.
+CONST = "const"      # folds to a Python int at lint time (static offset)
+ALIGNED = "aligned"  # dynamic, but provably ≡ 0 (mod 8)
+STATIC = "static"    # trace-time Python value of unknown alignment
+DYN = "dyn"          # dynamic, not provably aligned
+
+
+def _find_kernels(ctx: FileContext) -> dict[str, ast.AST]:
+    kernels: dict[int, ast.AST] = {}
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Call) and astutil.matches(
+            ctx.call_name(node), PALLAS_CALL_NAMES
+        ) and node.args:
+            target = _unwrap_target(ctx, node.args[0])
+            if isinstance(target, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                   ast.Lambda)):
+                kernels[id(target)] = target
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if node.name.endswith("_kernel") and any(
+                p.arg.endswith("_ref") for p in astutil.func_params(node)
+            ):
+                kernels[id(node)] = node
+    return {str(k): v for k, v in kernels.items()}
+
+
+class _AlignChecker:
+    """Alignment lattice over one use site's scope chain.
+
+    The kernel's own parameters are Python-static at trace time (they come
+    in via functools.partial); parameters of functions NESTED in the kernel
+    are loop carries/counters (fori/scan bodies) and classify as dynamic.
+    Name lookups merge single-assignment maps outermost -> innermost.
+    """
+
+    def __init__(self, ctx: FileContext, kernel: ast.AST, use_site: ast.AST):
+        self.ctx = ctx
+        self.consts = ctx.module_ints
+        chain = [kernel]
+        for fn in reversed(astutil.enclosing_functions(use_site)):
+            # Only scopes inside the kernel matter (the walk starts there).
+            if fn is kernel or any(p is kernel for p in astutil.parents(fn)):
+                if fn is not kernel:
+                    chain.append(fn)
+        self.static_params = {p.arg for p in astutil.func_params(kernel)}
+        self.dyn_params = set()
+        self.env: dict[str, ast.expr] = {}
+        for fn in chain:
+            if fn is not kernel:
+                self.dyn_params |= {p.arg for p in astutil.func_params(fn)}
+            self.env.update(astutil.single_assignments(fn))
+
+    def classify(self, node: ast.AST, depth: int = 0) -> tuple[str, Optional[int]]:
+        if depth > 8:
+            return (DYN, None)
+        v = astutil.const_int(node, self.consts)
+        if v is not None:
+            return (CONST, v)
+        if isinstance(node, ast.Name):
+            if node.id in self.dyn_params:
+                return (DYN, None)
+            if node.id in self.env:
+                return self.classify(self.env[node.id], depth + 1)
+            if node.id in self.static_params:
+                return (STATIC, None)  # Python-static kernel parameter
+            # loop counters, for targets, program_id results: dynamic
+            return (DYN, None)
+        if isinstance(node, ast.BinOp):
+            a, av = self.classify(node.left, depth + 1)
+            b, bv = self.classify(node.right, depth + 1)
+            if isinstance(node.op, ast.Mult):
+                if (a == CONST and av is not None and av % 8 == 0 and av != 0) or (
+                    b == CONST and bv is not None and bv % 8 == 0 and bv != 0
+                ):
+                    return (ALIGNED, None)
+                if ALIGNED in (a, b) and DYN not in (a, b):
+                    return (ALIGNED, None)
+                if a == CONST and b == CONST:
+                    return (CONST, None)
+                if DYN in (a, b) or ALIGNED in (a, b):
+                    return (DYN, None)
+                return (STATIC, None)
+            if isinstance(node.op, (ast.Add, ast.Sub)):
+                kinds = {a, b}
+                if kinds <= {CONST}:
+                    return (CONST, None)
+                ok = lambda k, kv: k == ALIGNED or (
+                    k == CONST and kv is not None and kv % 8 == 0
+                )
+                if ok(a, av) and ok(b, bv):
+                    return (ALIGNED, None)
+                if DYN in kinds or ALIGNED in kinds:
+                    return (DYN, None)
+                return (STATIC, None)
+        if isinstance(node, ast.Call):
+            return (DYN, None)
+        return (STATIC, None)
+
+    def offset_misaligned(self, start: ast.AST) -> Optional[str]:
+        """None when fine; else a message describing why the start flags."""
+        kind, value = self.classify(start)
+        if kind == CONST:
+            return None  # static offset: Mosaic handles (or rejects) it at compile
+        if kind == ALIGNED:
+            return None
+        if kind == STATIC:
+            return None  # pure trace-time value, no dynamic component
+        expr = ast.unparse(start) if hasattr(ast, "unparse") else "<expr>"
+        return (
+            f"dynamic sublane offset `{expr}` is not provably 8-aligned; "
+            "write it as `i * ROW_TILE` (Mosaic's fast path needs dynamic "
+            "sublane starts ≡ 0 mod 8)"
+        )
+
+
+def _ds_start(ctx: FileContext, node: ast.AST) -> Optional[ast.AST]:
+    """The start expression when ``node`` is a pl.ds(...) call."""
+    if isinstance(node, ast.Call) and astutil.matches(
+        ctx.call_name(node), DS_NAMES
+    ) and node.args:
+        return node.args[0]
+    return None
+
+
+def _is_scalar_index(node: ast.AST) -> bool:
+    """True for an index element that selects a single row/element (not a
+    slice, not a pl.ds)."""
+    return not isinstance(node, (ast.Slice, ast.Call, ast.Tuple))
+
+
+@register(
+    "pallas-sublane-align",
+    "Pallas kernel refs: dynamic sublane offsets must be provably 8-aligned, "
+    "values rank-2 only, tables lane-broadcast outside the kernel",
+    origin="CLAUDE.md Mosaic constraints: write offsets as i * ROW_TILE, "
+    "not Tt - 8 - i*8; Mosaic cannot broadcast [1,1]->[8,128] (_bcast_tab)",
+)
+def check_pallas_sublane_align(ctx: FileContext) -> Iterator[Finding]:
+    for kernel in _find_kernels(ctx).values():
+        for node in ast.walk(kernel):
+            # (a) pl.ds sublane starts: ref[pl.ds(start, n), ...] — only the
+            # leading index of a 2-D subscript is the sublane axis (rank-3
+            # refs carry an untiled leading dim; their pl.ds use is rare and
+            # positionally ambiguous, so only the canonical form is checked).
+            if isinstance(node, ast.Subscript) and isinstance(
+                node.value, ast.Name
+            ) and node.value.id.endswith("_ref"):
+                idx = node.slice
+                elems = list(idx.elts) if isinstance(idx, ast.Tuple) else [idx]
+                if len(elems) <= 2:
+                    start = _ds_start(ctx, elems[0])
+                    if start is not None:
+                        checker = _AlignChecker(ctx, kernel, node)
+                        msg = checker.offset_misaligned(start)
+                        if msg:
+                            yield ctx.finding("pallas-sublane-align", node, msg)
+            # (b) explicit >= 3-D shape literals: rank-2 values only.
+            if isinstance(node, ast.Call):
+                name = ctx.call_name(node) or ""
+                tail = name.rsplit(".", 1)[-1]
+                if tail in SHAPE_CALLS or (
+                    isinstance(node.func, ast.Attribute)
+                    and node.func.attr in ("reshape", "broadcast_to")
+                ):
+                    for arg in list(node.args) + [
+                        kw.value for kw in node.keywords if kw.arg == "shape"
+                    ]:
+                        if isinstance(arg, ast.Tuple) and len(arg.elts) >= 3:
+                            yield ctx.finding(
+                                "pallas-sublane-align",
+                                node,
+                                f"rank-{len(arg.elts)} value constructed "
+                                "inside a Pallas kernel; Mosaic wants rank-2 "
+                                "(sublane, lane) values only",
+                            )
+                            break
+                # (c) broadcasting a scalar-indexed ref load: [1,1]->[8,128].
+                if tail == "broadcast_to" and node.args:
+                    src = node.args[0]
+                    if isinstance(src, ast.Subscript) and isinstance(
+                        src.value, ast.Name
+                    ) and src.value.id.endswith("_ref"):
+                        idx = src.slice
+                        elems = (
+                            list(idx.elts)
+                            if isinstance(idx, ast.Tuple)
+                            else [idx]
+                        )
+                        if len(elems) >= 2 and all(
+                            _is_scalar_index(e) for e in elems
+                        ):
+                            yield ctx.finding(
+                                "pallas-sublane-align",
+                                node,
+                                "broadcast of a scalar-indexed ref load "
+                                "([1,1] -> tile) — Mosaic cannot; "
+                                "lane-broadcast the table OUTSIDE the kernel "
+                                "(_bcast_tab) and read [1, LT] rows",
+                            )

@@ -32,7 +32,7 @@ from __future__ import annotations
 
 import contextlib
 import time
-from typing import Iterator, Optional
+from typing import Iterator
 
 _MAX_COMPILE_RECORDS = 4096
 

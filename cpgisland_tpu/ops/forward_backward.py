@@ -134,12 +134,14 @@ def _chunk_stats_log(params, obs, length):
         # xi_t[i,j] proportional to alpha_t[i] + A[i,j] + B[j,o_{t+1}] + beta_{t+1}[j]
         contrib = alpha_t[:, None] + params.log_A + (emit_t[o_next] + beta_next)[None, :] - loglik
         xi = jnp.where(v_next, jnp.exp(contrib), 0.0)
+        # graftcheck: allow(no-stats-in-bwd-chain) -- XLA scan assembly: XLA schedules the count sums off the recurrence critical path; the ban targets the Pallas kernels' serial chain (CLAUDE.md)
         trans_acc = trans_acc + xi
         # gamma_t from alpha_t + beta_t; beta_t via recurrence.
         beta_t = _logsumexp(params.log_A + (emit_t[o_next] + beta_next)[None, :], axis=1)
         beta_t = jnp.where(v_next, beta_t, beta_next)
         gamma_t = jnp.exp(alpha_t + beta_t - loglik)
         gamma_t = jnp.where(v_t, gamma_t, 0.0)
+        # graftcheck: allow(no-stats-in-bwd-chain) -- XLA scan assembly (see the trans_acc waiver above)
         emit_acc = emit_acc + gamma_t[:, None] * jax.nn.one_hot(o_t, M) * v_t
         return (beta_t, trans_acc, emit_acc), gamma_t
 
@@ -230,12 +232,14 @@ def _chunk_stats_rescaled(params, obs, length):
         alpha_t, o_next, v_next, c_next, o_t, v_t = inp
         w = B_t[o_next] * beta_next / c_next  # [K]
         xi = alpha_t[:, None] * A * w[None, :]
+        # graftcheck: allow(no-stats-in-bwd-chain) -- XLA scan assembly: XLA schedules the count sums off the recurrence critical path; the ban targets the Pallas kernels' serial chain (CLAUDE.md)
         trans_acc = trans_acc + jnp.where(v_next, xi, 0.0)
         beta_t = jnp.matmul(A, w, precision=jax.lax.Precision.HIGHEST)
         beta_t = jnp.where(v_next, beta_t, beta_next)
         gamma_t = alpha_t * beta_t
         gamma_t = gamma_t / jnp.maximum(jnp.sum(gamma_t), 1e-30)
         gamma_t = jnp.where(v_t, gamma_t, 0.0)
+        # graftcheck: allow(no-stats-in-bwd-chain) -- XLA scan assembly (see the trans_acc waiver above)
         emit_acc = emit_acc + gamma_t[:, None] * jax.nn.one_hot(o_t, M) * v_t
         return (beta_t, trans_acc, emit_acc), None
 

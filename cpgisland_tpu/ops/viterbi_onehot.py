@@ -550,10 +550,11 @@ def _xla_backtrace(bp2, pair2, idtab, exit_bits):
 def _prepared(params: HmmParams, steps2: jnp.ndarray, prev0, resets=None):
     """Tables + pair stream for the passes.
 
-    ``resets`` (flat batch decoding): (kidx, bidx, sym) arrays — step
-    (kidx[i], bidx[i]) becomes the RESET step into a record starting with
-    symbol sym[i] (see _reset_rows), and the tables extend with the S reset
-    rows so nreal covers them in the select tree.
+    ``resets`` (flat batch decoding): a [bk, nb] bool mask — step [k, b]
+    (global step b*bk + k) is a RESET step into a record whose start symbol
+    is steps2[k, b] (see _reset_rows), and the tables extend with the S
+    reset rows so nreal covers them in the select tree (reset pairs
+    renumber INSIDE the tree range; see the inline comment below).
     """
     if prev0 is None:
         raise ValueError("the onehot engine requires prev0 (the symbol before step 0)")
@@ -714,9 +715,14 @@ def decode_batch_flat(
     argmax.  Every kernel then runs at single-stream occupancy.
 
     Path-only (scores accumulate cross-record reset constants — callers
-    needing per-record scores use the vmap path).  Same first-symbol
-    contract as the engine: records whose position 0 is PAD decode
-    approximately (host entry points demote those to a dense engine).
+    needing per-record scores use the vmap path).  Paths equal the
+    standalone/vmap onehot route modulo the engine's pinned rounding-tie
+    contract (PARITY.md C10): the reset folds the previous record's max(v)
+    constant into later f32 additions, so a tie-prone model can round
+    near-ties differently — tie-free models decode identically, and any
+    mismatch re-scores f64-identically.  Same first-symbol contract as the
+    engine: records whose position 0 is PAD decode approximately (host
+    entry points demote those to a dense engine).
     Returns paths [N, T] (positions >= lengths[r] carry the exit state,
     like viterbi_padded).
     """

@@ -426,7 +426,7 @@ def viterbi_sharded_spans(
             # np.asarray below is the blocking point).  This also pre-places
             # the tail span, which sweep B otherwise uploads serially.
             placed[s + 1] = place(s + 1)
-        total = np.asarray(total_dev)
+        total = obs_mod.note_fetch(np.asarray(total_dev))
         v = (enters[-1][:, None] + total).max(axis=0)
         enters.append((v - v.max()).astype(np.float32))
 
@@ -443,6 +443,7 @@ def viterbi_sharded_spans(
             params, arr, jnp.asarray(enters[s]), jnp.int32(anchor),
             span_prev0(s)
         )
+        # graftcheck: allow(hot-path-host-sync) -- anchor threading between spans is inherently serial (one scalar per span); counted by the obs ledger's device_get hook
         anchor = int(jax.device_get(prev_exit))
         paths[s] = _fetch_path(path, min(span, T - s * span), return_device)
     return paths

@@ -27,6 +27,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from cpgisland_tpu import obs as obs_module
 from cpgisland_tpu.models.hmm import HmmParams
 from cpgisland_tpu.ops import fb_pallas
 from cpgisland_tpu.parallel.fb_sharded import (
@@ -50,14 +51,13 @@ def resolve_fb_engine(engine: str, params: HmmParams) -> str:
     emission structure supports them (ops.fb_onehot — the flagship 8-state
     preset does), else the dense fused kernels when the model fits their
     lane packing, else the XLA lane path (incl. the CPU test mesh)."""
-    from cpgisland_tpu import obs as obs_mod
     from cpgisland_tpu.ops import fb_onehot
 
     if engine == "auto":
         resolved = "xla"
         if jax.default_backend() == "tpu" and fb_pallas.supports(params):
             resolved = "onehot" if fb_onehot.supports(params) else "pallas"
-        obs_mod.engine_decision(
+        obs_module.engine_decision(
             site="posterior.resolve_fb_engine", choice=resolved, requested=engine
         )
         return resolved
@@ -76,7 +76,7 @@ def resolve_fb_engine(engine: str, params: HmmParams) -> str:
             "onehot FB kernels need one-hot emissions with 2 states per "
             "symbol (concrete params)"
         )
-    obs_mod.engine_decision(
+    obs_module.engine_decision(
         site="posterior.resolve_fb_engine", choice=engine, requested=engine
     )
     return engine
@@ -369,4 +369,6 @@ def transfer_total_sharded(
             )
         )
         out = _transfer_total_fn(mesh, block_size, first)(params, arr, lens)
-    return out if return_device else np.asarray(out)
+    if return_device:
+        return out
+    return obs_module.note_fetch(np.asarray(out))

@@ -346,14 +346,14 @@ def decode_file(
         with timer.phase("decode+islands", items=float(chunked.total), unit="sym"):
             for lo in range(0, n, device_batch):
                 hi = min(lo + device_batch, n)
-                batch_paths = np.asarray(
+                batch_paths = obs.note_fetch(np.asarray(
                     batch_decode(
                         params,
                         jnp.asarray(chunks[lo:hi]),
                         jnp.asarray(lengths[lo:hi]),
                         return_score=False,
                     )
-                )
+                ))
                 parts.extend(
                     islands_mod.call_islands(
                         batch_paths[i][: int(lengths[lo + i])],
@@ -443,6 +443,7 @@ def decode_file(
                     # the bench publishes attribute work where it happened.
                     # The overlapped mode keeps the queue full instead
                     # (attribution blurs by design, see the docstring).
+                    # graftcheck: allow(hot-path-host-sync) -- phase-attribution block (comment above); the obs ledger counts it via its block_until_ready hook
                     jax.block_until_ready(full)
             else:
                 full = obs.note_fetch(np.concatenate(pieces))
@@ -484,6 +485,7 @@ def decode_file(
         # (a bare "" would emit a leading space and split into 5 fields).
         parts.append(calls.with_names(rec_name or "."))
         if path_writer is not None:
+            # graftcheck: allow(hot-path-host-sync) -- `full` is host already except under --clean device islands, where the path dump's one fetch is the product being written
             path_writer.write(np.asarray(full).astype(np.int8))
 
     def flush_small(batch: list) -> None:
@@ -822,6 +824,7 @@ def _decode_small_batch(
                 # Block so per-phase stats attribute the decode where it
                 # happened (async dispatch would bill it to the islands
                 # phase); the overlapped mode keeps the queue full instead.
+                # graftcheck: allow(hot-path-host-sync) -- phase-attribution block (comment above); the obs ledger counts it via its block_until_ready hook
                 jax.block_until_ready(paths)
         else:
             paths = obs.note_fetch(np.asarray(paths))
@@ -1103,6 +1106,7 @@ def posterior_file(
                     if use_device_islands:
                         # conf/path stay device-resident; block so the
                         # kernel time is billed to this phase.
+                        # graftcheck: allow(hot-path-host-sync) -- phase-attribution block (comment above); the obs ledger counts it via its block_until_ready hook
                         jax.block_until_ready(path2)
                     else:
                         conf2 = obs.note_fetch(np.asarray(conf2))
@@ -1186,6 +1190,7 @@ def posterior_file(
             # Batch eligibility respects a user-narrowed span: a record the
             # span contract would split must take the span-threaded path.
             if batch_small and symbols.size <= min(span, POSTERIOR_BATCH_MAX):
+                # graftcheck: allow(hot-path-host-sync) -- record symbols are host np arrays from the codec record reader; copy, not a device fetch
                 pending.append((rec_name, np.asarray(symbols)))
                 if len(pending) >= 128:
                     flush_small()
@@ -1235,7 +1240,7 @@ def posterior_file(
                         )
                     )
                 if prefetch > 0:
-                    totals = [np.asarray(t) for t in totals]
+                    totals = [obs.note_fetch(np.asarray(t)) for t in totals]
             # Host threading: entering-alpha / exiting-beta directions per
             # span (tiny [K]x[K,K] chains, f32 on normalized operators).
             pi = np.exp(np.asarray(params.log_pi, np.float64))
@@ -1290,6 +1295,7 @@ def posterior_file(
                 else:
                     emit(conf, path)
                     if want_islands:
+                        # graftcheck: allow(hot-path-host-sync) -- `path` is host on this branch (its producer fetched through obs.note_fetch above); coercion only
                         rec_path_parts.append(np.asarray(path).astype(np.int8))
             if want_islands:
                 # Islands are called over the WHOLE record's MPM path so a

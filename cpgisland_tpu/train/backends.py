@@ -30,7 +30,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from cpgisland_tpu import obs
 from cpgisland_tpu.models.hmm import HmmParams
 from cpgisland_tpu.ops import fb_pallas
-from cpgisland_tpu.ops.forward_backward import SuffStats, batch_stats, chunk_stats
+from cpgisland_tpu.ops.forward_backward import SuffStats, batch_stats
 from cpgisland_tpu.parallel import fb_sharded
 from cpgisland_tpu.parallel.mesh import make_mesh
 from cpgisland_tpu.utils import chunking

@@ -151,6 +151,7 @@ def _fit_fused(
         )
         # THE one blocking round trip of the whole loop (counted by the obs
         # ledger's device_get hook).
+        # graftcheck: allow(hot-path-host-sync) -- the fused EM loop's single designed round trip; ledger-counted via the device_get hook (note_fetch would double-count)
         it_a, p, converged_a, lls, dls = jax.device_get(out)
         if sp is not None:
             sp.items = float(n_sym) * float(it_a)

@@ -18,7 +18,6 @@ other (tests/test_native_codec.py).
 
 from __future__ import annotations
 
-import io
 import os
 from typing import Iterator, Optional, Union
 

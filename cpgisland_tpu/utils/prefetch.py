@@ -28,7 +28,7 @@ import logging
 import queue
 import threading
 import time
-from typing import Iterable, Iterator, Optional
+from typing import Iterable, Iterator
 
 from cpgisland_tpu import obs
 

@@ -1,0 +1,11 @@
+"""Hygiene trigger: unused import + builtin shadowing."""
+
+import os
+import sys
+
+
+def compute(list, n):
+    sum = 0
+    for i in range(n):
+        sum += i
+    return sum + len(str(os.sep)) + list[0]

@@ -1,0 +1,13 @@
+"""maxplus-normalize clean: every combine flows straight through
+nrm_maxplus."""
+
+import jax
+
+from cpgisland_tpu.ops.viterbi_parallel import maxplus_matmul, nrm_maxplus
+
+
+def stitch(totals, eye):
+    def fwd(carry, t):
+        return nrm_maxplus(maxplus_matmul(carry, t)), carry
+
+    return jax.lax.scan(fwd, eye, totals)

@@ -1,0 +1,14 @@
+"""maxplus-normalize trigger: an unnormalized max-plus combine chain in a
+parallel/ module (fixture mirrors the stitching-layer layout)."""
+
+import jax
+import jax.numpy as jnp
+
+from cpgisland_tpu.ops.viterbi_parallel import maxplus_matmul, nrm_maxplus
+
+
+def stitch(totals, eye):
+    def fwd(carry, t):
+        return maxplus_matmul(carry, t), carry  # drifts ~-1.3 nat/symbol
+
+    return jax.lax.scan(fwd, eye, totals)

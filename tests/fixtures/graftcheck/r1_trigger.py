@@ -1,0 +1,17 @@
+"""jit-big-closure trigger: jitted functions closing over array constants."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+BIG_TABLE = np.zeros((1024, 1024), np.float32)  # module-scope baked constant
+
+
+@jax.jit
+def apply_table(x):
+    return x + BIG_TABLE
+
+
+def make_fn():
+    lut = jnp.arange(65536)  # enclosing-scope array constant
+    return jax.jit(lambda x: lut[x])

@@ -1,0 +1,19 @@
+"""pallas-sublane-align clean: aligned offsets, rank-2 values, tables
+lane-broadcast outside the kernel."""
+
+import jax
+from jax.experimental import pallas as pl
+
+ROW_TILE = 8
+OUTER_TILE = 64
+
+
+def _good_kernel(steps_ref, tab_ref, out_ref, *, Tt, bk):
+    def body(i, v):
+        base = i * ROW_TILE
+        tile = steps_ref[pl.ds(base, ROW_TILE), :]
+        row = tab_ref[0:1, :]  # [1, LT] row of a pre-broadcast table
+        out_ref[pl.ds(i * OUTER_TILE + 0 * ROW_TILE, ROW_TILE), :] = tile + row
+        return v
+
+    jax.lax.fori_loop(0, Tt // ROW_TILE, body, 0)

@@ -1,0 +1,21 @@
+"""pallas-sublane-align trigger: the exact anti-patterns from CLAUDE.md."""
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+ROW_TILE = 8
+
+
+def _bad_kernel(steps_ref, tab_ref, out_ref, *, Tt):
+    def body(i, v):
+        # The canonical bad form: Tt - 8 - i*8 is not provably 8-aligned.
+        tile = steps_ref[pl.ds(Tt - 8 - i * 8, ROW_TILE), :]
+        # Rank-3 value inside a kernel.
+        cube = jnp.reshape(tile, (2, 4, tile.shape[1]))
+        # [1,1] table load broadcast inside the kernel.
+        t = jnp.broadcast_to(tab_ref[0, 0], (8, 128))
+        out_ref[pl.ds(i * ROW_TILE, ROW_TILE), :] = tile + t + cube[0]
+        return v
+
+    jax.lax.fori_loop(0, Tt // ROW_TILE, body, 0)

@@ -1,0 +1,22 @@
+"""hot-path-host-sync clean: syncs routed through obs.note_fetch, host
+inputs coerced at the boundary."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from cpgisland_tpu import obs
+
+
+# graftcheck: hot-path
+def decode_loop(params, spans):
+    obs_arr = np.asarray(spans)  # param-rooted: host input coercion
+    totals = []
+    for s in obs_arr:
+        total_dev = jnp.dot(s, params)
+        totals.append(obs.note_fetch(np.asarray(total_dev)))
+    return totals
+
+
+def not_registered(x):
+    # Outside a hot path the rule does not apply at all.
+    return np.asarray(x)

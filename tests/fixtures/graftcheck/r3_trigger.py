@@ -1,0 +1,18 @@
+"""hot-path-host-sync trigger: unrouted blocking syncs in a marked hot path."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+# graftcheck: hot-path
+def decode_loop(params, spans):
+    totals = []
+    for s in spans:
+        total_dev = jnp.dot(s, params)
+        totals.append(np.asarray(total_dev))  # unrouted fetch
+        score = float(jnp.max(total_dev))  # inline device scalar fetch
+        jax.block_until_ready(total_dev)
+        anchor = jax.device_get(total_dev)
+        totals[-1].item()
+    return totals, score, anchor

@@ -1,0 +1,16 @@
+"""no-stats-in-bwd-chain clean: the backward walk only emits per-position
+values (the _bwd_conf_kernel pattern); reduction happens in a separate
+pass off the recurrence chain."""
+
+import jax
+import jax.numpy as jnp
+
+
+def backward_emit(A, emits, beta_T, mask):
+    def bstep(beta_next, b_next):
+        beta_t = jnp.matmul(A, b_next * beta_next)
+        conf_t = jnp.sum(beta_t * mask)  # light per-position emission
+        return beta_t, conf_t
+
+    beta_0, confs = jax.lax.scan(bstep, beta_T, emits, reverse=True)
+    return beta_0, jnp.sum(confs)  # the reduction lives OUTSIDE the chain

@@ -1,0 +1,17 @@
+"""no-stats-in-bwd-chain trigger: count tensors accumulated in a reverse
+scan carry (the serialization the Pallas kernels must never reintroduce)."""
+
+import jax
+import jax.numpy as jnp
+
+
+def backward_stats(A, emits, beta_T, zeros_kk):
+    def bstep(carry, inp):
+        beta_next, trans_acc = carry
+        alpha_t, b_next = inp
+        xi = alpha_t[:, None] * A * (b_next * beta_next)[None, :]
+        trans_acc = trans_acc + xi  # stats sum rides the recurrence carry
+        beta_t = jnp.matmul(A, b_next * beta_next)
+        return (beta_t, trans_acc), None
+
+    return jax.lax.scan(bstep, (beta_T, zeros_kk), emits, reverse=True)

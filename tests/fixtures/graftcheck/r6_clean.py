@@ -1,0 +1,17 @@
+"""retrace-hazard clean: Python scalars declared static."""
+
+from functools import partial
+
+import jax
+
+
+@partial(jax.jit, static_argnames=("block_size",))
+def decode(obs, block_size: int = 4096):
+    return obs.reshape(-1, block_size)
+
+
+def windowed(obs, width: int):
+    return obs[:width]
+
+
+windowed_jit = jax.jit(windowed, static_argnames=("width",))

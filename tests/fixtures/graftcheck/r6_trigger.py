@@ -1,0 +1,16 @@
+"""retrace-hazard trigger: jitted callables taking raw Python scalars
+without static_argnums/static_argnames."""
+
+import jax
+
+
+@jax.jit
+def decode(obs, block_size: int = 4096):
+    return obs.reshape(-1, block_size)
+
+
+def windowed(obs, width: int):
+    return obs[:width]
+
+
+windowed_jit = jax.jit(windowed)

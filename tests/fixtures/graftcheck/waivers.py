@@ -1,0 +1,29 @@
+"""Waiver-parsing fixture: one properly waived violation (inline and
+standalone-comment forms), one waiver missing its reason, one stale waiver
+covering nothing."""
+
+import jax
+import numpy as np
+
+BAKED = np.zeros((64, 64), np.float32)
+
+
+@jax.jit
+def waived_inline(x):
+    return x + BAKED  # graftcheck: allow(jit-big-closure) -- test-only 16 KiB table; the fixture exists to prove waivers parse
+
+
+@jax.jit
+def waived_standalone(x):
+    # graftcheck: allow(jit-big-closure) -- standalone-comment form covers the next line
+    return x + BAKED
+
+
+@jax.jit
+def missing_reason(x):
+    return x + BAKED  # graftcheck: allow(jit-big-closure)
+
+
+def stale():
+    # graftcheck: allow(maxplus-normalize) -- nothing here triggers it
+    return 0

@@ -1,0 +1,187 @@
+"""Per-rule graftcheck unit tests: one triggering and one clean fixture per
+rule, waiver parsing, hot-path registration, and the CLI exit-code contract.
+
+Pure-AST layer — nothing here touches jax, so the whole file runs in well
+under a second (tests/test_graftcheck_self.py covers the jaxpr contracts).
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+from cpgisland_tpu.analysis import all_rules, lint_file
+from cpgisland_tpu.analysis.config import hot_functions_for
+from cpgisland_tpu.analysis.core import parse_waivers
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "fixtures", "graftcheck")
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+RULES = [
+    ("jit-big-closure", "r1"),
+    ("pallas-sublane-align", "r2"),
+    ("hot-path-host-sync", "r3"),
+    ("maxplus-normalize", os.path.join("parallel", "r4")),
+    ("no-stats-in-bwd-chain", "r5"),
+    ("retrace-hazard", "r6"),
+]
+
+
+def _lint(name: str):
+    path = os.path.join(FIXTURES, f"{name}.py")
+    # relpath keeps the fixture's directory shape (the R4 rule scopes on
+    # parallel/ in the path).
+    findings, waivers = lint_file(path, relpath=os.path.relpath(path, REPO))
+    return findings, waivers
+
+
+@pytest.mark.parametrize("rule,stem", RULES, ids=[r for r, _ in RULES])
+def test_rule_fires_on_trigger(rule, stem):
+    findings, _ = _lint(f"{stem}_trigger")
+    hits = [f for f in findings if f.rule == rule and not f.waived]
+    assert hits, f"{rule} did not fire on its trigger fixture"
+
+
+@pytest.mark.parametrize("rule,stem", RULES, ids=[r for r, _ in RULES])
+def test_rule_quiet_on_clean(rule, stem):
+    findings, _ = _lint(f"{stem}_clean")
+    hits = [f for f in findings if f.rule == rule]
+    assert hits == [], [f.format() for f in hits]
+
+
+def test_r2_flags_each_mosaic_antipattern():
+    findings, _ = _lint("r2_trigger")
+    msgs = "\n".join(
+        f.message for f in findings if f.rule == "pallas-sublane-align"
+    )
+    assert "not provably 8-aligned" in msgs
+    assert "rank-3" in msgs
+    assert "_bcast_tab" in msgs
+
+
+def test_r3_flags_every_banned_spelling():
+    findings, _ = _lint("r3_trigger")
+    msgs = "\n".join(
+        f.message for f in findings if f.rule == "hot-path-host-sync"
+    )
+    for spelling in (".item()", "float()", "asarray", "block_until_ready",
+                     "device_get"):
+        assert spelling in msgs, f"missing {spelling} in:\n{msgs}"
+
+
+def test_r6_flags_both_wrapper_forms():
+    findings, _ = _lint("r6_trigger")
+    hits = [f for f in findings if f.rule == "retrace-hazard"]
+    assert len(hits) >= 2  # decorator form + jax.jit(fn) call form
+    assert any("block_size" in f.message for f in hits)
+    assert any("width" in f.message for f in hits)
+
+
+def test_hygiene_rules():
+    findings, _ = _lint("hygiene_trigger")
+    rules = {f.rule for f in findings}
+    assert "unused-import" in rules
+    assert "shadow-builtin" in rules
+
+
+# -- waivers -----------------------------------------------------------------
+
+
+def test_waiver_inline_and_standalone_forms():
+    findings, waivers = _lint("waivers")
+    r1 = [f for f in findings if f.rule == "jit-big-closure"]
+    waived = [f for f in r1 if f.waived]
+    unwaived = [f for f in r1 if not f.waived]
+    assert len(waived) == 2  # inline + standalone-comment forms
+    assert all(f.waiver_reason for f in waived)
+    assert len(unwaived) == 1  # the missing-reason waiver does NOT waive
+    assert any(f.rule == "waiver-syntax" for f in findings)
+    stale = [w for w in waivers if not w.used]
+    assert any("maxplus-normalize" in w.rules for w in stale)
+
+
+def test_waiver_only_covers_named_rule():
+    findings, _ = lint_file(
+        os.path.join(FIXTURES, "waivers.py"),
+        relpath="tests/fixtures/graftcheck/waivers.py",
+    )
+    # A jit-big-closure waiver must not suppress other rules on the line.
+    for f in findings:
+        if f.waived:
+            assert f.rule == "jit-big-closure"
+
+
+def test_waiver_regex_requires_reason():
+    waivers, errors = parse_waivers(
+        "x = 1  # graftcheck: allow(some-rule)\n"
+        "y = 2  # graftcheck: allow(other-rule) -- because measured\n"
+    )
+    assert len(waivers) == 1 and waivers[0].rules == ("other-rule",)
+    assert len(errors) == 1 and "justification" in errors[0][1]
+
+
+def test_waivers_in_docstrings_are_inert():
+    waivers, errors = parse_waivers(
+        '"""docs: # graftcheck: allow(some-rule) -- example"""\nx = 1\n'
+    )
+    assert waivers == [] and errors == []
+
+
+# -- registration ------------------------------------------------------------
+
+
+def test_hot_path_registry_matches_repo_layout():
+    assert "viterbi_sharded_spans" in hot_functions_for(
+        "cpgisland_tpu/parallel/decode.py"
+    )
+    assert "_fit_fused" in hot_functions_for("cpgisland_tpu/train/baum_welch.py")
+    assert hot_functions_for("cpgisland_tpu/models/hmm.py") == frozenset()
+
+
+def test_all_six_issue_rules_registered():
+    names = set(all_rules())
+    for rule, _ in RULES:
+        assert rule in names
+    assert {"unused-import", "shadow-builtin"} <= names
+
+
+# -- CLI ---------------------------------------------------------------------
+
+
+def _run_cli(*args):
+    return subprocess.run(
+        [sys.executable, "-m", "cpgisland_tpu.analysis", *args],
+        capture_output=True, text=True, cwd=REPO,
+    )
+
+
+def test_cli_exits_nonzero_on_each_trigger():
+    for _, stem in RULES:
+        proc = _run_cli(os.path.join(FIXTURES, f"{stem}_trigger.py"))
+        assert proc.returncode == 1, (stem, proc.stdout, proc.stderr)
+
+
+def test_cli_exits_zero_on_clean_fixture():
+    proc = _run_cli(os.path.join(FIXTURES, "r6_clean.py"))
+    assert proc.returncode == 0, (proc.stdout, proc.stderr)
+
+
+def test_cli_list_rules_and_json():
+    proc = _run_cli("--list-rules")
+    assert proc.returncode == 0
+    assert "jit-big-closure" in proc.stdout and "origin:" in proc.stdout
+
+    import json
+
+    proc = _run_cli("--json", os.path.join(FIXTURES, "r1_trigger.py"))
+    assert proc.returncode == 1
+    payload = json.loads(proc.stdout)
+    assert payload["ok"] is False
+    assert any(f["rule"] == "jit-big-closure" for f in payload["findings"])
+
+
+def test_cli_unknown_rule_is_usage_error():
+    proc = _run_cli("--rules", "no-such-rule",
+                    os.path.join(FIXTURES, "r1_clean.py"))
+    assert proc.returncode == 2

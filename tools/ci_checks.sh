@@ -1,0 +1,39 @@
+#!/usr/bin/env bash
+# CI gate: graftcheck (lint + jaxpr contracts) + ruff/mypy when available +
+# a tier-1 smoke slice.  Exits non-zero on any violation.  Runs entirely on
+# CPU — no TPU needed (the contract pass pins jax_platforms=cpu itself).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+echo "== graftcheck: AST lint (TPU invariants) =="
+python -m cpgisland_tpu.analysis cpgisland_tpu/
+
+echo "== graftcheck: jaxpr contract pass (CPU trace) =="
+python -m cpgisland_tpu.analysis --no-lint --contracts
+
+echo "== syntax gate =="
+python -m compileall -q cpgisland_tpu tools tests bench.py __graft_entry__.py
+
+# The container this repo grows in has neither ruff nor mypy baked in (and
+# installing deps is off-limits there); graftcheck's hygiene rules carry
+# the unused-import/shadowing checks meanwhile.  Both run here when the
+# host provides them, against the pyproject.toml baselines.
+if command -v ruff >/dev/null 2>&1; then
+  echo "== ruff =="
+  ruff check .
+else
+  echo "== ruff not on PATH: skipped (baseline config in [tool.ruff]) =="
+fi
+
+if command -v mypy >/dev/null 2>&1; then
+  echo "== mypy (basic) =="
+  mypy cpgisland_tpu
+else
+  echo "== mypy not on PATH: skipped (baseline config in [tool.mypy]) =="
+fi
+
+echo "== tier-1 smoke =="
+python -m pytest tests/test_graftcheck.py tests/test_graftcheck_self.py \
+  tests/test_hmm.py tests/test_viterbi.py -q
+
+echo "ci_checks: all gates green"

@@ -1,0 +1,18 @@
+#!/usr/bin/env python
+"""Repo-root launcher for graftcheck (``python tools/graftcheck.py``).
+
+Defaults to linting the whole checkout's package; equivalent to
+``python -m cpgisland_tpu.analysis`` once the package is importable.
+"""
+
+import os
+import sys
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _ROOT not in sys.path:
+    sys.path.insert(0, _ROOT)
+
+from cpgisland_tpu.analysis.cli import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())
