@@ -157,6 +157,94 @@ def check_contract(c: Contract, execute: bool = True) -> ContractResult:
     )
 
 
+# Symbol-stream prep markers: the reduced pair stream's two-level
+# forward-fill is the ONLY cummax on any EM path (viterbi_onehot.pair_stream
+# — the sequential symbol-only derivation ops.prepared hoists out of the
+# loop), so a cummax inside the fused EM while body means the prep was
+# re-materialized per iteration.
+PREP_MARKER_PRIMS = frozenset({"cummax"})
+
+
+def while_body_prims(closed) -> dict:
+    """Primitive counts restricted to while-loop BODY jaxprs (all nesting
+    levels) of a ClosedJaxpr — the fused EM loop's per-iteration cost."""
+    counts: dict[str, int] = {}
+    for eqn in _walk_eqns(closed.jaxpr):
+        if eqn.primitive.name != "while":
+            continue
+        for sub in _sub_jaxprs(eqn.params.get("body_jaxpr")):
+            for inner in _walk_eqns(sub):
+                counts[inner.primitive.name] = (
+                    counts.get(inner.primitive.name, 0) + 1
+                )
+    return counts
+
+
+def _em_body_contract() -> ContractResult:
+    """em.body.invariant-free: the fused EM while_loop body jaxpr must
+    contain NO symbol-stream prep primitives when prepared streams are
+    threaded (train.backends.*.fused_stats_with_prep -> baum_welch's
+    prepared-aware loop).  Self-proving: the SAME program traced WITHOUT
+    the prepared streams must show the markers — if it doesn't, the marker
+    set has rotted and the contract fails rather than passing vacuously.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from cpgisland_tpu.train import baum_welch
+    from cpgisland_tpu.train.backends import LocalBackend
+
+    params = _flagship()
+    o1, _ = _obs_pair(8 * 1024, "uint8")
+    chunks = jnp.asarray(o1).reshape(8, 1024)
+    lengths = jnp.full(8, 1024, jnp.int32)
+    backend = LocalBackend(mode="rescaled", engine="onehot")
+    violations: list[str] = []
+    notes: dict = {"backend": jax.default_backend()}
+    stats_fn, prep = backend.fused_stats_with_prep(params, chunks, lengths)
+    if prep is None:
+        violations.append(
+            "LocalBackend(engine='onehot') returned no prepared streams — "
+            "the fused EM loop would re-prepare per iteration"
+        )
+    else:
+        p32 = params.astype(jnp.float32)
+        fn = baum_welch._fused_em_fn(stats_fn, 3, True)
+        closed = jax.make_jaxpr(fn)(
+            p32, chunks, lengths, jnp.float32(0.0), prep
+        )
+        body = while_body_prims(closed)
+        notes["body_eqns"] = sum(body.values())
+        hits = sorted(set(body) & PREP_MARKER_PRIMS)
+        if not body:
+            violations.append(
+                "no while-loop body found in the fused EM trace (the fused "
+                "driver's structure changed under this contract)"
+            )
+        if hits:
+            violations.append(
+                "symbol-stream prep primitives inside the fused EM while "
+                f"body: {hits} — the prepared streams did not reach the loop"
+            )
+        # Detector self-proof on the synthetic violation: the inline-prep
+        # twin of the same loop MUST show the markers.
+        fn0 = baum_welch._fused_em_fn(stats_fn, 3, False)
+        closed0 = jax.make_jaxpr(fn0)(
+            p32, chunks, lengths, jnp.float32(0.0), None
+        )
+        body0 = while_body_prims(closed0)
+        notes["inline_markers"] = sorted(set(body0) & PREP_MARKER_PRIMS)
+        if not set(body0) & PREP_MARKER_PRIMS:
+            violations.append(
+                "detector self-proof failed: the inline-prep loop body "
+                "shows no prep markers (PREP_MARKER_PRIMS has rotted)"
+            )
+    return ContractResult(
+        name="em.body.invariant-free", ok=not violations,
+        violations=violations, notes=notes,
+    )
+
+
 def _routing_contract() -> ContractResult:
     """Off-TPU, 'auto' must resolve to non-Pallas engines, and get_passes
     must resolve every engine name (every TPU engine has an off-TPU twin)."""
@@ -357,6 +445,17 @@ def run_contracts(
     results: list[ContractResult] = []
     if wanted is None or "engines.routing" in wanted:
         results.append(_routing_contract())
+    if wanted is None or "em.body.invariant-free" in wanted:
+        try:
+            results.append(_em_body_contract())
+        except Exception as e:
+            results.append(
+                ContractResult(
+                    name="em.body.invariant-free", ok=False,
+                    violations=[f"trace failed: {type(e).__name__}: {e}"],
+                    notes={},
+                )
+            )
     for c in default_contracts():
         if wanted is not None and c.name not in wanted:
             continue

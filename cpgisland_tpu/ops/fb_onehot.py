@@ -60,7 +60,7 @@ from cpgisland_tpu.ops.viterbi_onehot import (
 )
 
 __all__ = [
-    "supports", "supports_concrete", "prob_pair_table", "run_products_onehot",
+    "supports", "supports_concrete", "prob_pair_table", "products_reduced",
 ]
 
 
@@ -831,6 +831,7 @@ def run_fb_kernels_onehot(
     Tt: int,
     T: int,
     conf_mask=None,
+    pair_esym=None,
 ):
     """Reduced forward + backward pair over the [Tp, NL] lane layout.
 
@@ -838,12 +839,19 @@ def run_fb_kernels_onehot(
     and are projected onto each lane's entry/exit group here.  Returns
     (alphas2 [Tp, 2, NL], cs [Tp, NL], betas2 [Tp, 2, NL] — or conf2
     [Tp, NL] with ``conf_mask`` — and esym2 [Tp, NL] for scatter-back).
+    ``pair_esym``: a prepared (pair2, esym2) pair-stream (esym2 may be
+    None — it rederives arithmetically); inline prep otherwise.
     """
     K, S = params.n_states, params.n_symbols
     gt = _groups(params)
     tab = prob_pair_table(params, gt)
-    pair2, _, _ = _pair_stream(params, sel_t, jnp.asarray(prev_dev, jnp.int32))
-    esym2 = decode_esym(pair2, S)
+    if pair_esym is None:
+        pair2, _, _ = _pair_stream(params, sel_t, jnp.asarray(prev_dev, jnp.int32))
+        esym2 = decode_esym(pair2, S)
+    else:
+        pair2, esym2 = pair_esym
+        if esym2 is None:
+            esym2 = decode_esym(pair2, S)
     Tp, NL = pair2.shape
 
     a0_red = jnp.take_along_axis(a0_raw.T, gt[esym2[0]], axis=1)  # [NL, 2]
@@ -943,36 +951,36 @@ def run_fb_kernels_onehot(
     return alphas2, cs, betas2, esym2
 
 
-def run_products_onehot(
-    params: HmmParams, sel_t: jnp.ndarray, prev0, Tt: int
-) -> jnp.ndarray:
-    """Reduced per-lane transfer products, scattered to dense [NL, K, K].
+def products_reduced(params: HmmParams, pair2: jnp.ndarray, Tt: int) -> jnp.ndarray:
+    """Per-lane REDUCED transfer products [NL, 2, 2] from a pair stream
+    ([lane_T, NL]; pallas kernel on TPU, the per-step-renorm XLA twin
+    elsewhere — directions identical, only the internal scalar differs).
 
-    sel_t: [lane_T, NL] int32 selection symbols (PAD >= S marks identity
-    steps, exactly _run_products_kernel's input transposed); prev0: [] the
-    symbol emitted before this segment's first position (entry group of
-    lane 0).  Drop-in replacement for fb_pallas._run_products_kernel for
-    one-hot models.
+    Adjacent lanes' reduced products COMPOSE directly: the pair stream's
+    forward-fill guarantees e_in[n+1] == e_out[n], so lane n's exit group
+    is lane n+1's entry group and a 2x2 chain over lanes equals the dense
+    scattered chain exactly (the dense product's out-of-group entries are
+    exact zeros in every consumer) — the boundary-message scans in
+    fb_pallas._lane_streams run in this reduced space.
     """
-    K, S = params.n_states, params.n_symbols
+    S = params.n_symbols
     gt = _groups(params)
     tab = prob_pair_table(params, gt)
-    pair2, e_in, e_out = _pair_stream(params, sel_t, jnp.asarray(prev0, jnp.int32))
-    NL = sel_t.shape[1]
+    NL = pair2.shape[1]
     if _interpret():
-        red = _xla_products_prob(tab, pair2)
-    else:
-        tabb = _bcast_tab(tab)
-        (red_flat,) = pl.pallas_call(
-            functools.partial(_oh_prod_kernel, nreal=S * S, bk=Tt),
-            grid=(NL // LANE_TILE, sel_t.shape[0] // Tt),
-            in_specs=[
-                _vspec((Tt, LANE_TILE), lambda i, j: (j, i)),
-                _vspec(tabb.shape, lambda i, j: (0, 0)),
-            ],
-            out_specs=[_vspec((4, LANE_TILE), lambda i, j: (0, i))],
-            out_shape=[jax.ShapeDtypeStruct((4, NL), jnp.float32)],
-            scratch_shapes=[pltpu.VMEM((4, LANE_TILE), jnp.float32)],
-        )(pair2, tabb)
-        red = red_flat.T.reshape(NL, GROUP, GROUP)
-    return _scatter_products_prob(red, gt, e_in, e_out, K)
+        return _xla_products_prob(tab, pair2)
+    tabb = _bcast_tab(tab)
+    (red_flat,) = pl.pallas_call(
+        functools.partial(_oh_prod_kernel, nreal=S * S, bk=Tt),
+        grid=(NL // LANE_TILE, pair2.shape[0] // Tt),
+        in_specs=[
+            _vspec((Tt, LANE_TILE), lambda i, j: (j, i)),
+            _vspec(tabb.shape, lambda i, j: (0, 0)),
+        ],
+        out_specs=[_vspec((4, LANE_TILE), lambda i, j: (0, i))],
+        out_shape=[jax.ShapeDtypeStruct((4, NL), jnp.float32)],
+        scratch_shapes=[pltpu.VMEM((4, LANE_TILE), jnp.float32)],
+    )(pair2, tabb)
+    return red_flat.T.reshape(NL, GROUP, GROUP)
+
+

@@ -632,51 +632,43 @@ def _pad_axis(x, size, axis, fill):
     return jnp.pad(x, widths, constant_values=fill)
 
 
-def _batch_lane_setup(params: HmmParams, chunks, lengths, t_tile: int):
+def _batch_lane_setup(params: HmmParams, chunks, lengths, t_tile: int,
+                      onehot: bool = False, prep=None):
     """Chunked lane layout shared by the batched E-step and the batched
     posterior: one INDEPENDENT record/chunk per lane, pi init, free end.
 
-    Returns (A, B, pi, steps2 [Tp, NL], sel2 [Tp, NL] (PAD-marked steps for
-    the reduced one-hot kernels), lens2 [1, NL], a0_raw [K, NL],
-    beta0 [K, NL], valid0 [NL], Tt).
+    The SYMBOL-ONLY half (lane reshapes, PAD-marked selection steps, the
+    reduced pair stream) lives in ops.prepared.prepare_chunked — built
+    inline here when no ``prep`` is passed, so prepared-vs-inline results
+    are bit-identical by construction.  The params-dependent half (tables,
+    the unnormalized v_0 init, free-end betas) is always computed here.
+
+    Returns (A, B, pi, prep, a0_raw [K, NL], beta0 [K, NL], valid0 [NL]).
     """
+    from cpgisland_tpu.ops import prepared as prep_mod
+
     K, S = params.n_states, params.n_symbols
     N, T = chunks.shape
     A = jnp.exp(params.log_A).astype(jnp.float32)
     B = jnp.exp(params.log_B).astype(jnp.float32)
     pi = jnp.exp(params.log_pi).astype(jnp.float32)
 
-    lengths = lengths.astype(jnp.int32)
-    obs_c = jnp.where(
-        jnp.arange(T)[None, :] < lengths[:, None],
-        jnp.minimum(chunks.astype(jnp.int32), S - 1),
-        0,
-    )
-
-    NL = -(-N // LANE_TILE) * LANE_TILE
-    # Round the t-tile up to a ROW_TILE multiple: the row-tiled forward walks
-    # whole 8-row tiles, and Tp-padding (pad rows are invalid -> identity /
-    # masked) absorbs the excess when T itself is not a multiple.
-    Tt = -(-min(t_tile, T) // ROW_TILE) * ROW_TILE
-    n_t = -(-T // Tt)
-    Tp = n_t * Tt
-    steps2 = _pad_axis(_pad_axis(obs_c.T, Tp, 0, 0), NL, 1, 0)  # [Tp, NL]
-    lens2 = _pad_axis(lengths[None, :], NL, 1, 0)  # [1, NL]
+    if prep is None:
+        prep = prep_mod.prepare_chunked(
+            S, chunks, lengths, t_tile=t_tile, onehot=onehot
+        )
+    else:
+        prep_mod.check_chunked(prep, S, N, T, t_tile, onehot)
+    steps2, lens2 = prep.steps2, prep.lens2
     valid0 = lens2[0] > 0  # [NL]
-    # PAD-marked steps for the reduced one-hot kernels' pair stream (their
-    # beyond-length positions must be identity steps; the dense kernels
-    # mask by lens instead).  Lanes are INDEPENDENT records here, but the
-    # pair stream's cross-lane seeding is still harmless: each lane's
-    # position-0 pair is never consumed (the t == 0 init override) and its
-    # real positions' pairs are within-lane.
-    sel2 = jnp.where(jnp.arange(Tp)[:, None] < lens2, steps2, S)
 
     # v_0 in JAX (one position, UNnormalized so sum(v_0) = c_0; the kernel
     # handles t >= 1 with deferred normalization — see _fwd_kernel).
+    NL = steps2.shape[1]
     B0 = _emit_sel(B, steps2[0, :], K, S)  # [K, NL]
     a0_raw = jnp.where(valid0[None, :], pi[:, None] * B0, jnp.ones((K, NL)) / K)
     beta0 = jnp.ones((K, NL), jnp.float32)  # independent chunks end free
-    return A, B, pi, steps2, sel2, lens2, a0_raw, beta0, valid0, Tt
+    return A, B, pi, prep, a0_raw, beta0, valid0
 
 
 def _conf_path_from_streams(alphas, betas, lens2, island_mask):
@@ -699,6 +691,7 @@ def batch_stats_pallas(
     lengths: jnp.ndarray,
     t_tile: int = DEFAULT_T_TILE,
     onehot: bool = False,
+    prepared=None,
 ) -> SuffStats:
     """Pallas twin of ops.forward_backward.batch_stats(mode="rescaled").
 
@@ -707,26 +700,30 @@ def batch_stats_pallas(
     models); for power-of-two n_symbols (the flagship S=4 — the only case
     auto routes here) the count tensors come from the reduced-stream stats
     kernel with NO scatter anywhere, else the streams scatter back to dense
-    for the dense stats pass — both exact.
+    for the dense stats pass — both exact.  ``prepared`` (an
+    ops.prepared.PreparedChunked, passed as an explicit jit argument): the
+    symbol-only lane layout + pair stream, amortized across EM iterations
+    and pipeline passes; inline prep (same code) otherwise.
     """
     K, S = params.n_states, params.n_symbols
     T = chunks.shape[1]
-    A, B, pi, steps2, sel2, lens2, a0_raw, beta0, valid0, Tt = _batch_lane_setup(
-        params, chunks, lengths, t_tile
+    A, B, pi, prep, a0_raw, beta0, valid0 = _batch_lane_setup(
+        params, chunks, lengths, t_tile, onehot=onehot, prep=prepared
     )
+    steps2, lens2, Tt = prep.steps2, prep.lens2, prep.Tt
     if onehot:
         from cpgisland_tpu.ops import fb_onehot
 
         al2, cs, b2, esym2 = fb_onehot.run_fb_kernels_onehot(
-            params, sel2, jnp.int32(0), lens2, a0_raw, beta0, Tt, T
+            params, prep.sel2, jnp.int32(0), lens2, a0_raw, beta0, Tt, T,
+            pair_esym=(prep.pair2, prep.esym2),
         )
         gt = fb_onehot._groups(params)
         if S & (S - 1) == 0:
             # Reduced-stream stats: 16 B/symbol read instead of 64, dense
             # rows rebuilt in registers — no HBM scatter anywhere.
-            pair2, _, _ = _pair_stream_for_stats(params, sel2)
             macc, emit_red, ll = fb_onehot.run_stats_onehot(
-                params, al2, b2, pair2, lens2, gt, Tt
+                params, al2, b2, prep.pair2, lens2, gt, Tt
             )
             trans, emit, loglik = _assemble_reduced_stats(
                 params, A, gt, macc, emit_red, ll
@@ -794,14 +791,6 @@ def _gamma0_full(al2, b2, gt, esym2, K):
     return fb_onehot.scatter_streams(gamma02[None], gt, esym2[0:1], K)[0]
 
 
-def _pair_stream_for_stats(params, sel2):
-    """The same pair stream run_fb_kernels_onehot builds internally —
-    identical HLO, so XLA CSEs the two within one jit."""
-    from cpgisland_tpu.ops.viterbi_onehot import _pair_stream
-
-    return _pair_stream(params, sel2, jnp.int32(0))
-
-
 def _norm_rows(v):
     return v / jnp.maximum(jnp.sum(v, axis=-1, keepdims=True), 1e-30)
 
@@ -814,6 +803,7 @@ def seq_stats_pallas(
     lane_T: int = DEFAULT_LANE_T,
     t_tile: int = DEFAULT_T_TILE,
     onehot: bool = False,
+    prepared=None,
 ) -> SuffStats:
     """EXACT whole-sequence statistics on one device via the fused kernels.
 
@@ -829,10 +819,13 @@ def seq_stats_pallas(
     Working set is ~64 B/symbol of HBM (alphas, betas, and two assembly
     tensors), so per-device sequences up to ~50 M symbols are comfortable —
     chromosome shards on a pod; longer single-device inputs should use the
-    chunked path or a mesh.
+    chunked path or a mesh.  ``prepared`` (ops.prepared.PreparedSeq): the
+    symbol-only lane layout + pair stream, amortized across EM iterations;
+    inline prep (same code) otherwise.
     """
     return _seq_stats_core(
-        params, obs, length, lane_T, t_tile, axis=None, onehot=onehot
+        params, obs, length, lane_T, t_tile, axis=None, onehot=onehot,
+        prepared=prepared,
     )
 
 
@@ -914,6 +907,7 @@ def _lane_streams(
     onehot: bool = False,
     prev_sym=None,
     return_reduced: bool = False,
+    prepared=None,
 ):
     """Shared lane setup for the fused whole-sequence paths: lane transfer
     products -> boundary messages -> forward/backward kernel streams.
@@ -932,6 +926,18 @@ def _lane_streams(
     a record too large for one pass (pipeline.posterior_file), exactly like
     the cross-device exchange does across the mesh.
 
+    ``prepared`` (ops.prepared.PreparedSeq; single-device spans only — the
+    sharded paths' collective prev-symbol threading stays inline): the
+    symbol-only lane layout + pair stream, amortized across iterations and
+    span sweeps.
+
+    One-hot models run their boundary-message combine REDUCED: lane
+    transfer products stay [NL, 2, 2] (adjacent lanes' groups compose by
+    the pair stream's forward-fill invariant e_in[n+1] == e_out[n]) through
+    both associative scans and the enter/exit einsums, scattering to dense
+    [K]-vectors only at the kernel interfaces — a 16x shrink of the
+    per-iteration boundary-glue fixed cost vs the dense [NL, K, K] scans.
+
     Returns (alphas, cs, betas, steps2, lens2, enters, is_first, Tt) where
     is_first is the traced "this device holds the sequence init" flag.
     """
@@ -945,55 +951,96 @@ def _lane_streams(
             "continuation spans (first=False) need enter_dir — the "
             "entering-alpha direction from the previous span"
         )
+    if prepared is not None and axis is not None:
+        raise ValueError(
+            "prepared seq streams serve single-device spans (axis=None); "
+            "sharded paths prep inline"
+        )
     d = jax.lax.axis_index(axis) if axis is not None else 0
     is_first = (d == 0) if first else jnp.asarray(False)
 
     # The GLOBAL position 0's step is padded out of the products when this
     # device/span holds the init: the base direction already contains
     # pi * B[:, o_0], so including M_0 would double-apply it.
-    obs_l, sel_l, lane_lens, obs_flat, Tt, NL = _lane_layout(
-        obs, length, S, lane_T, t_tile, is_first
-    )
+    if prepared is not None:
+        from cpgisland_tpu.ops import prepared as prep_mod
+
+        prep_mod.check_seq(
+            prepared, S, obs.shape[0], lane_T, t_tile, first, onehot,
+            prev_sym=prev_sym,
+        )
+        obs_l, sel_l, lane_lens = (
+            prepared.obs_l, prepared.sel_l, prepared.lane_lens
+        )
+        o0, Tt, NL = prepared.o0, prepared.Tt, prepared.obs_l.shape[0]
+        obs_flat = None
+    else:
+        obs_l, sel_l, lane_lens, obs_flat, Tt, NL = _lane_layout(
+            obs, length, S, lane_T, t_tile, is_first
+        )
+        o0 = obs_flat[0]
     length = jnp.asarray(length, jnp.int32)
 
     # --- lane transfer operators (pallas) -> boundary messages (XLA) ------
+    red = None
     if onehot:
         # Reduced 2x2 products for one-hot-emission models (ops.fb_onehot):
         # exact — the dense product entries outside the boundary symbol
         # groups are multiplied by exact zeros in every consumer below.
         from cpgisland_tpu.ops import fb_onehot, viterbi_onehot
 
-        if not first and prev_sym is None:
-            raise ValueError(
-                "onehot continuation spans (first=False) need prev_sym — "
-                "the symbol emitted before this span's first position"
+        if prepared is not None:
+            prev_dev = prepared.prev_dev
+            pair2, e_in_l, e_out_l = (
+                prepared.pair2, prepared.e_in, prepared.e_out
             )
-        prev_seg = jnp.asarray(
-            obs_flat[0] if first else prev_sym, jnp.int32
-        )
-        T_in = obs.shape[0]
-        seed_syms = jnp.where(jnp.arange(T_in) < length, obs_flat, S)
-        prev_dev = (
-            viterbi_onehot.device_entry_sym(seed_syms, S, axis, prev_seg)
-            if axis is not None else prev_seg
-        )
-        P = fb_onehot.run_products_onehot(params, sel_l.T, prev_dev, Tt)
+        else:
+            if not first and prev_sym is None:
+                raise ValueError(
+                    "onehot continuation spans (first=False) need prev_sym — "
+                    "the symbol emitted before this span's first position"
+                )
+            prev_seg = jnp.asarray(o0 if first else prev_sym, jnp.int32)
+            if axis is not None:
+                T_in = obs.shape[0]
+                seed_syms = jnp.where(jnp.arange(T_in) < length, obs_flat, S)
+                prev_dev = viterbi_onehot.device_entry_sym(
+                    seed_syms, S, axis, prev_seg
+                )
+            else:
+                prev_dev = prev_seg
+            pair2, e_in_l, e_out_l = viterbi_onehot.pair_stream(
+                S, sel_l.T, prev_dev
+            )
+        gt = fb_onehot._groups(params)
+        gin = gt[e_in_l]  # [NL, 2]
+        gout = gt[e_out_l]
+        red = fb_onehot.products_reduced(params, pair2, Tt)  # [NL, 2, 2]
+        incl_red = jax.lax.associative_scan(_lane_combine, red, axis=0)
     else:
         P = _run_products_kernel(A, B, sel_l, lane_T, Tt, K, S)  # P[lane, i, m]
+        incl = jax.lax.associative_scan(_lane_combine, P, axis=0)
 
-    incl = jax.lax.associative_scan(_lane_combine, P, axis=0)
-    eyeK = jnp.broadcast_to(jnp.eye(K, dtype=jnp.float32), (1, K, K))
-    excl = jnp.concatenate([eyeK, incl[:-1]], axis=0)  # prefix products
-
-    a0_dir = _norm_rows(pi * B[:, obs_flat[0]])  # [K] — meaningful on device 0
+    a0_dir = _norm_rows(pi * B[:, o0])  # [K] — meaningful on device 0
     if axis is not None:
         # Cross-device boundary messages: the ONE shared implementation
         # (parallel.fb_sharded.device_boundary_messages) — both the XLA lane
-        # path and this fused path exchange messages identically.
+        # path and this fused path exchange messages identically.  The
+        # reduced path scatters ONLY its [K, K] device total to dense for
+        # the exchange (the dense total's out-of-group entries are exact
+        # zeros, so the exchange numerics are unchanged).
+        from cpgisland_tpu.ops import fb_onehot as _fbo
         from cpgisland_tpu.parallel.fb_sharded import device_boundary_messages
 
+        total_dev = (
+            _fbo._scatter_products_prob(
+                incl_red[-1:], gt, e_in_l[:1], e_out_l[-1:], K
+            )[0]
+            if onehot
+            else incl[-1]
+        )
         _, base_dir, anchor = device_boundary_messages(
-            a0_dir, incl[-1], d, axis,
+            a0_dir, total_dev, d, axis,
             start_dir=None if first else enter_dir,
             end_dir=exit_dir,
         )
@@ -1005,14 +1052,57 @@ def _lane_streams(
             else _norm_rows(exit_dir)
         )
 
-    enters = _norm_rows(jnp.einsum("k,nkj->nj", base_dir, excl))  # [NL, K]
+    iK = jnp.arange(K, dtype=jnp.int32)
+    if onehot:
+        # Reduced boundary combine: entering-alpha / exiting-beta directions
+        # in the 2-component group space, scattered to the dense kernel
+        # interface rows (out-of-group entries were exact zeros in the dense
+        # formulation — one-hot emissions support base_dir/enters only on
+        # their boundary symbol's group, and the kernels re-slice the group
+        # components anyway).
+        from cpgisland_tpu.ops.viterbi_onehot import GROUP as _G
 
-    Rsuf = jax.lax.associative_scan(
-        lambda a, b: _lane_combine(b, a), P, axis=0, reverse=True
-    )
-    beta_exits = jnp.concatenate(
-        [_norm_rows(jnp.einsum("nij,j->ni", Rsuf[1:], anchor)), anchor[None]], axis=0
-    )  # [NL, K]
+        eye2 = jnp.broadcast_to(jnp.eye(_G, dtype=jnp.float32), (1, _G, _G))
+        excl_red = jnp.concatenate([eye2, incl_red[:-1]], axis=0)
+        base_red = jnp.take(base_dir, gin[0])  # [2]
+        enters_red = _norm_rows(jnp.einsum("k,nkj->nj", base_red, excl_red))
+        # Lane 0 enters with the FULL base direction: a span-threading
+        # enter_dir may carry out-of-group mass that reaches lane 0's v_0
+        # through A (the dense formulation's excl[0] = I row) — lanes >= 1
+        # see it only through group-supported products, where the
+        # restriction is exact.  enters_red row 0 carries the UNrenormalized
+        # group components, matching the dense take_along_axis contract of
+        # the seq-stats consumer.
+        enters_red = enters_red.at[0].set(base_red)
+        enters = (
+            jnp.where(iK[None, :] == gin[:, 0:1], enters_red[:, 0:1], 0.0)
+            + jnp.where(iK[None, :] == gin[:, 1:2], enters_red[:, 1:2], 0.0)
+        )  # [NL, K]
+        enters = enters.at[0].set(base_dir)
+        Rsuf_red = jax.lax.associative_scan(
+            lambda a, b: _lane_combine(b, a), red, axis=0, reverse=True
+        )
+        anchor_red = jnp.take(anchor, gout[-1])  # [2]
+        beta_exits_red = jnp.concatenate(
+            [_norm_rows(jnp.einsum("nij,j->ni", Rsuf_red[1:], anchor_red)),
+             anchor_red[None]],
+            axis=0,
+        )  # [NL, 2]
+        beta_exits = (
+            jnp.where(iK[None, :] == gout[:, 0:1], beta_exits_red[:, 0:1], 0.0)
+            + jnp.where(iK[None, :] == gout[:, 1:2], beta_exits_red[:, 1:2], 0.0)
+        )  # [NL, K]
+    else:
+        eyeK = jnp.broadcast_to(jnp.eye(K, dtype=jnp.float32), (1, K, K))
+        excl = jnp.concatenate([eyeK, incl[:-1]], axis=0)  # prefix products
+        enters = _norm_rows(jnp.einsum("k,nkj->nj", base_dir, excl))  # [NL, K]
+        Rsuf = jax.lax.associative_scan(
+            lambda a, b: _lane_combine(b, a), P, axis=0, reverse=True
+        )
+        beta_exits = jnp.concatenate(
+            [_norm_rows(jnp.einsum("nij,j->ni", Rsuf[1:], anchor)), anchor[None]],
+            axis=0,
+        )  # [NL, K]
 
     # --- per-lane v_0 (unnormalized: sum == that position's Rabiner c) ----
     o_first = obs_l[:, 0]  # [NL]
@@ -1021,7 +1111,7 @@ def _lane_streams(
     lane0_is_init = (jnp.arange(NL)[:, None] == 0) & is_first
     v0 = jnp.where(
         (lane_lens > 0)[:, None],
-        jnp.where(lane0_is_init, (pi * B[:, obs_flat[0]])[None, :], v0_cont),
+        jnp.where(lane0_is_init, (pi * B[:, o0])[None, :], v0_cont),
         jnp.ones((NL, K)) / K,
     )
 
@@ -1034,21 +1124,15 @@ def _lane_streams(
         # zeros wherever they are ever multiplied in); the conf fast path
         # consumes the reduced streams directly and the scatters are
         # dead-code-eliminated.
-        from cpgisland_tpu.ops import fb_onehot
-
         al2, cs, third2, esym2 = fb_onehot.run_fb_kernels_onehot(
             params, sel_l.T, prev_dev, lens2, v0.T, beta_exits.T, Tt,
-            lane_T, conf_mask=conf_mask,
+            lane_T, conf_mask=conf_mask, pair_esym=(pair2, None),
         )
-        gt = fb_onehot._groups(params)
         if return_reduced and conf_mask is None:
-            # Raw reduced streams for the seq-stats kernel consumer (the
-            # pair stream recomputes with identical args — CSE'd in-jit
-            # with the FB runner's internal one).
-            from cpgisland_tpu.ops.viterbi_onehot import _pair_stream
-
-            pair2, e_in_l, _ = _pair_stream(params, sel_l.T, prev_dev)
-            reduced = (al2, third2, esym2, pair2, e_in_l, gt)
+            # Raw reduced streams for the seq-stats kernel consumer — the
+            # pair stream and entering directions pass through ONCE (no
+            # recompute, no re-gather).
+            reduced = (al2, third2, esym2, pair2, e_in_l, gt, enters_red)
             return reduced, cs, None, steps2, lens2, enters, is_first, Tt
         alphas = fb_onehot.scatter_streams(al2, gt, esym2, K)
         third = (
@@ -1072,6 +1156,7 @@ def _seq_stats_core(
     axis,
     reduce: bool = True,
     onehot: bool = False,
+    prepared=None,
 ) -> SuffStats:
     """The fused whole-sequence E-step over THIS device's time shard.
 
@@ -1093,7 +1178,7 @@ def _seq_stats_core(
     )
     alphas, cs, betas, steps2, lens2, enters, is_first, Tt_used = _lane_streams(
         params, obs, length, lane_T, t_tile, axis, onehot=onehot,
-        return_reduced=use_kernel_stats,
+        return_reduced=use_kernel_stats, prepared=prepared,
     )
     NL = steps2.shape[1]
     if use_kernel_stats:
@@ -1101,8 +1186,7 @@ def _seq_stats_core(
         # scatter + XLA assembly below is its off-TPU twin).
         from cpgisland_tpu.ops import fb_onehot
 
-        al2, b2, esym2, pair2, e_in_l, gt = alphas
-        enters_red = jnp.take_along_axis(enters, gt[e_in_l], axis=1)  # [NL,2]
+        al2, b2, esym2, pair2, e_in_l, gt, enters_red = alphas
         ent_full = fb_onehot.scatter_streams(
             enters_red.T[None], gt, e_in_l[None, :], K
         )[0]  # [K, NL]
@@ -1180,6 +1264,7 @@ def _seq_posterior_core(
     want_path: bool = False,
     onehot: bool = False,
     prev_sym=None,
+    prepared=None,
 ):
     """Per-position island confidence over THIS device's time shard, fused.
 
@@ -1206,6 +1291,7 @@ def _seq_posterior_core(
             params, obs, length, lane_T, t_tile, axis,
             enter_dir=enter_dir, exit_dir=exit_dir, first=first,
             conf_mask=island_mask, onehot=onehot, prev_sym=prev_sym,
+            prepared=prepared,
         )
         # Lane n covers global positions [n*lane_T, (n+1)*lane_T): transpose
         # the [lane_T, NL] lane layout back to global order, slice the pad.
@@ -1213,7 +1299,7 @@ def _seq_posterior_core(
     alphas, cs, betas, steps2, lens2, _, _, _ = _lane_streams(
         params, obs, length, lane_T, t_tile, axis,
         enter_dir=enter_dir, exit_dir=exit_dir, first=first,
-        onehot=onehot, prev_sym=prev_sym,
+        onehot=onehot, prev_sym=prev_sym, prepared=prepared,
     )
     conf2, path2 = _conf_path_from_streams(alphas, betas, lens2, island_mask)
     return conf2.T.reshape(-1)[:T], path2.T.reshape(-1)[:T]
@@ -1235,17 +1321,21 @@ def seq_posterior_pallas(
     t_tile: int = DEFAULT_T_TILE,
     onehot: bool = False,
     prev_sym=None,
+    prepared=None,
 ):
     """Single-device fused posterior: (conf [T], mpm path [T]).
 
     Drop-in fast path for ops.forward_backward.posterior_marginals'
     island-confidence reduction (bit-compatible to f32 tolerance); spans of
     longer records thread enter_dir/exit_dir (see _seq_posterior_core).
+    ``prepared``: the same PreparedSeq the span's other sweeps use — one
+    symbol-only prep per placed span instead of one per sweep.
     """
     return _seq_posterior_core(
         params, obs, length, island_mask, lane_T, t_tile, axis=None,
         enter_dir=enter_dir, exit_dir=exit_dir, first=first,
         want_path=want_path, onehot=onehot, prev_sym=prev_sym,
+        prepared=prepared,
     )
 
 
@@ -1258,6 +1348,7 @@ def batch_posterior_pallas(
     t_tile: int = DEFAULT_T_TILE,
     want_path: bool = False,
     onehot: bool = False,
+    prepared=None,
 ):
     """Posterior island confidence for a [N, T] batch of INDEPENDENT records.
 
@@ -1266,24 +1357,28 @@ def batch_posterior_pallas(
     betas — EXACT per record since every record fits its lane whole.  This
     is how scaffold-heavy assemblies avoid one dispatch (and one
     mostly-idle lane pass) per tiny record.  Returns (conf [N, T] f32,
-    path [N, T] int32 — zeros unless want_path).
+    path [N, T] int32 — zeros unless want_path).  ``prepared``: same
+    contract as batch_stats_pallas — one PreparedChunked serves both
+    entries on the same batch (the pipeline's posterior -> EM reuse).
     """
     K, S = params.n_states, params.n_symbols
     N, T = chunks.shape
-    A, B, _, steps2, sel2, lens2, a0_raw, beta0, _, Tt = _batch_lane_setup(
-        params, chunks, lengths, t_tile
+    A, B, _, prep, a0_raw, beta0, _, = _batch_lane_setup(
+        params, chunks, lengths, t_tile, onehot=onehot, prep=prepared
     )
+    steps2, lens2, Tt = prep.steps2, prep.lens2, prep.Tt
     if onehot:
         from cpgisland_tpu.ops import fb_onehot
 
         if not want_path:
             _, _, conf2, _ = fb_onehot.run_fb_kernels_onehot(
-                params, sel2, jnp.int32(0), lens2, a0_raw, beta0, Tt, T,
-                conf_mask=island_mask,
+                params, prep.sel2, jnp.int32(0), lens2, a0_raw, beta0, Tt, T,
+                conf_mask=island_mask, pair_esym=(prep.pair2, prep.esym2),
             )
             return conf2.T[:N, :T], jnp.zeros((N, T), jnp.int32)
         al2, _, b2, esym2 = fb_onehot.run_fb_kernels_onehot(
-            params, sel2, jnp.int32(0), lens2, a0_raw, beta0, Tt, T
+            params, prep.sel2, jnp.int32(0), lens2, a0_raw, beta0, Tt, T,
+            pair_esym=(prep.pair2, prep.esym2),
         )
         gt = fb_onehot._groups(params)
         alphas = fb_onehot.scatter_streams(al2, gt, esym2, K)
@@ -1314,6 +1409,7 @@ def seq_transfer_total_pallas(
     t_tile: int = DEFAULT_T_TILE,
     onehot: bool = False,
     prev_sym=None,
+    prepared=None,
 ) -> jnp.ndarray:
     """Normalized probability-space transfer operator of one span (products
     kernel only — the cheap forward sweep of span-threaded processing).
@@ -1323,19 +1419,46 @@ def seq_transfer_total_pallas(
     by the consumer) — pass True only for the sequence's first span.
     ``onehot`` (one-hot-emission models) swaps in the reduced 2x2 products
     kernel; continuation spans then need ``prev_sym`` (the symbol before the
-    span — it conditions the reduced chain's entry group).
+    span — it conditions the reduced chain's entry group), and the
+    cross-lane combine runs REDUCED ([NL, 2, 2] — see _lane_streams),
+    scattering only the final [K, K] total.  ``prepared``
+    (ops.prepared.PreparedSeq): one symbol-only prep shared with the span's
+    posterior sweep (pipeline.posterior_file builds it once per placed
+    span).
     """
     K, S = params.n_states, params.n_symbols
-    _, sel_l, _, obs_flat, Tt, _ = _lane_layout(obs, length, S, lane_T, t_tile, first)
+    if prepared is not None:
+        from cpgisland_tpu.ops import prepared as prep_mod
+
+        prep_mod.check_seq(
+            prepared, S, obs.shape[0], lane_T, t_tile, first, onehot,
+            prev_sym=prev_sym,
+        )
+        sel_l, o0 = prepared.sel_l, prepared.o0
+        Tt = prepared.Tt
+    else:
+        _, sel_l, _, obs_flat, Tt, _ = _lane_layout(
+            obs, length, S, lane_T, t_tile, first
+        )
+        o0 = obs_flat[0]
     if onehot:
         from cpgisland_tpu.ops import fb_onehot
+        from cpgisland_tpu.ops.viterbi_onehot import pair_stream
 
-        if not first and prev_sym is None:
-            raise ValueError("onehot continuation spans need prev_sym")
-        prev_seg = jnp.asarray(obs_flat[0] if first else prev_sym, jnp.int32)
-        P = fb_onehot.run_products_onehot(params, sel_l.T, prev_seg, Tt)
-    else:
-        A = jnp.exp(params.log_A).astype(jnp.float32)
-        B = jnp.exp(params.log_B).astype(jnp.float32)
-        P = _run_products_kernel(A, B, sel_l, lane_T, Tt, K, S)
+        if prepared is not None:
+            pair2, e_in, e_out = prepared.pair2, prepared.e_in, prepared.e_out
+        else:
+            if not first and prev_sym is None:
+                raise ValueError("onehot continuation spans need prev_sym")
+            prev_seg = jnp.asarray(o0 if first else prev_sym, jnp.int32)
+            pair2, e_in, e_out = pair_stream(S, sel_l.T, prev_seg)
+        gt = fb_onehot._groups(params)
+        red = fb_onehot.products_reduced(params, pair2, Tt)
+        total_red = jax.lax.associative_scan(_lane_combine, red, axis=0)[-1:]
+        return fb_onehot._scatter_products_prob(
+            total_red, gt, e_in[:1], e_out[-1:], K
+        )[0]
+    A = jnp.exp(params.log_A).astype(jnp.float32)
+    B = jnp.exp(params.log_B).astype(jnp.float32)
+    P = _run_products_kernel(A, B, sel_l, lane_T, Tt, K, S)
     return jax.lax.associative_scan(_lane_combine, P, axis=0)[-1]

@@ -1,0 +1,529 @@
+"""Prepared symbol-stream artifacts: everything derivable from symbols alone.
+
+BASELINE.md's roofline leaves the EM paths bounded by per-iteration FIXED
+cost, not bandwidth — and a large slice of that fixed cost is symbol-only
+work re-materialized every iteration: the reduced pair streams and their
+two-level cummax forward-fill (viterbi_onehot._pair_stream), the lane
+layout pads/reshapes (fb_pallas._lane_layout / the chunked batch setup),
+PAD/entry-group encodings, and prev-symbol threading.  None of it depends
+on the model parameters, so inside the fused EM ``lax.while_loop`` (and
+across decode -> posterior -> EM on the same input) it is pure waste.
+
+This module factors that work into explicit, cacheable artifacts:
+
+- :class:`PreparedChunked` — the chunked/batched lane layout (one record
+  per VPU lane; ops.fb_pallas.batch_stats_pallas / batch_posterior_pallas).
+- :class:`PreparedSeq` — the whole-sequence lane layout (single-device
+  spans; ops.fb_pallas.seq_stats_pallas / seq_posterior_pallas /
+  seq_transfer_total_pallas).
+
+Both are registered dataclass pytrees: the arrays are DATA (so a prepared
+object is passed as an explicit jit argument — never closed over, which
+graftcheck's ``jit-big-closure`` rule bans) and the geometry ints are META
+(part of the jit cache key, so a mismatched-geometry prepared object can
+never silently retrace into wrong shapes — consumers also validate via
+:func:`check_chunked` / :func:`check_seq`).
+
+The builders (:func:`prepare_chunked` / :func:`prepare_seq`) are the SAME
+code the engine entries run inline when no prepared object is passed, so
+prepared-vs-inline results are bit-identical by construction.  The cached
+wrappers (:func:`for_chunked` / :func:`for_seq`) key on the *identity* of
+the placed input arrays plus the static geometry — weakref-validated, so a
+recycled ``id()`` can never alias a dead entry — and emit a
+``prepared_streams`` obs event per lookup (cache key, hit/miss, bytes
+resident, prep ms).  Invalidation is automatic: new arrays, a different
+lane geometry, or a different engine each produce a different key.
+
+Scope note: prepared objects serve the single-device / per-shard layouts.
+Backends that run under ``shard_map`` build their per-device prepared
+arrays through a sharded builder (train.backends.SpmdBackend) or fall back
+to inline prep (the collective-dependent whole-sequence exchange paths).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import time
+import weakref
+from collections import OrderedDict
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from cpgisland_tpu import obs as obs_mod
+
+__all__ = [
+    "PreparedChunked",
+    "PreparedSeq",
+    "PreparedStreams",
+    "prepare_chunked",
+    "prepare_seq",
+    "for_chunked",
+    "for_seq",
+    "check_chunked",
+    "check_seq",
+    "chunked_Tt",
+    "cache_stats",
+    "clear_cache",
+]
+
+
+@dataclasses.dataclass(frozen=True)
+class PreparedChunked:
+    """Symbol-only prep for the chunked lane layout (one record per lane).
+
+    steps2 [Tp, NL] clamped symbols; lens2 [1, NL]; sel2 [Tp, NL] PAD-marked
+    selection symbols, pair2/esym2 the reduced pair stream — the last three
+    only for the one-hot engines (None on dense preps).  ``Tt``/``S`` are
+    meta (jit-cache-keyed) so a stale prep can never retrace silently.
+    """
+
+    steps2: jnp.ndarray
+    lens2: jnp.ndarray
+    sel2: Optional[jnp.ndarray]
+    pair2: Optional[jnp.ndarray]
+    esym2: Optional[jnp.ndarray]
+    S: int
+    Tt: int
+    onehot: bool
+    # The builder's [N, T] batch shape: NL/Tp round up, so shapes alone
+    # cannot distinguish a prep built for a smaller batch (its pad lanes
+    # would silently drop the extra records) — check_chunked compares these.
+    N: int
+    T: int
+
+
+jax.tree_util.register_dataclass(
+    PreparedChunked,
+    data_fields=["steps2", "lens2", "sel2", "pair2", "esym2"],
+    meta_fields=["S", "Tt", "onehot", "N", "T"],
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class PreparedSeq:
+    """Symbol-only prep for the whole-sequence lane layout (one span,
+    single device).  obs_l/sel_l [NL, lane_T]; lane_lens [NL]; o0 [] the
+    first (clamped) symbol; prev_dev [] the symbol entering the span's
+    reduced chain and pair2/e_in/e_out its pair stream (one-hot only)."""
+
+    obs_l: jnp.ndarray
+    sel_l: jnp.ndarray
+    lane_lens: jnp.ndarray
+    o0: jnp.ndarray
+    prev_dev: Optional[jnp.ndarray]
+    pair2: Optional[jnp.ndarray]
+    e_in: Optional[jnp.ndarray]
+    e_out: Optional[jnp.ndarray]
+    S: int
+    lane_T: int
+    Tt: int
+    first: bool
+    onehot: bool
+    # The builder's padded input length (NL rounds to full 128-lane grids,
+    # so different T can share a lane shape) and — when the builder saw a
+    # CONCRETE continuation prev_sym — its value, so a prep reused with a
+    # different entering symbol raises instead of mis-conditioning the
+    # reduced chain's entry group (None = first span / traced prev).
+    T: int
+    prev_key: Optional[int]
+
+
+jax.tree_util.register_dataclass(
+    PreparedSeq,
+    data_fields=[
+        "obs_l", "sel_l", "lane_lens", "o0", "prev_dev",
+        "pair2", "e_in", "e_out",
+    ],
+    meta_fields=["S", "lane_T", "Tt", "first", "onehot", "T", "prev_key"],
+)
+
+
+def chunked_Tt(T: int, t_tile: int) -> int:
+    """The ONE t-tile derivation of the chunked layout (mirrors
+    fb_pallas._batch_lane_setup; ROW_TILE-aligned)."""
+    from cpgisland_tpu.ops import fb_pallas
+
+    return -(-min(t_tile, T) // fb_pallas.ROW_TILE) * fb_pallas.ROW_TILE
+
+
+def prepare_chunked(
+    S: int, chunks, lengths, *, t_tile: int, onehot: bool = False
+) -> PreparedChunked:
+    """Build the chunked-layout prep (traceable; the inline twin of what
+    batch_stats_pallas/batch_posterior_pallas run when no prep is passed —
+    the SAME code path, so prepared-vs-inline is bit-identical)."""
+    from cpgisland_tpu.ops import fb_pallas
+
+    chunks = jnp.asarray(chunks)
+    lengths = jnp.asarray(lengths).astype(jnp.int32)
+    N, T = chunks.shape
+    obs_c = jnp.where(
+        jnp.arange(T)[None, :] < lengths[:, None],
+        jnp.minimum(chunks.astype(jnp.int32), S - 1),
+        0,
+    )
+    NL = -(-N // fb_pallas.LANE_TILE) * fb_pallas.LANE_TILE
+    Tt = chunked_Tt(T, t_tile)
+    n_t = -(-T // Tt)
+    Tp = n_t * Tt
+    steps2 = fb_pallas._pad_axis(
+        fb_pallas._pad_axis(obs_c.T, Tp, 0, 0), NL, 1, 0
+    )  # [Tp, NL]
+    lens2 = fb_pallas._pad_axis(lengths[None, :], NL, 1, 0)  # [1, NL]
+    sel2 = pair2 = esym2 = None
+    if onehot:
+        from cpgisland_tpu.ops import fb_onehot
+        from cpgisland_tpu.ops.viterbi_onehot import pair_stream
+
+        # PAD-marked steps for the reduced kernels' pair stream; lanes are
+        # INDEPENDENT records, so the prev0=0 seed is inert (each lane's
+        # position-0 pair is never consumed — the t == 0 init override).
+        sel2 = jnp.where(jnp.arange(Tp)[:, None] < lens2, steps2, S)
+        pair2, _, _ = pair_stream(S, sel2, jnp.int32(0))
+        esym2 = fb_onehot.decode_esym(pair2, S)
+    return PreparedChunked(
+        steps2=steps2, lens2=lens2, sel2=sel2, pair2=pair2, esym2=esym2,
+        S=S, Tt=Tt, onehot=onehot, N=int(N), T=int(T),
+    )
+
+
+def prepare_seq(
+    S: int,
+    obs,
+    length,
+    *,
+    lane_T: int,
+    t_tile: int,
+    first: bool = True,
+    onehot: bool = False,
+    prev_sym=None,
+    prev_key: Optional[int] = None,
+) -> PreparedSeq:
+    """Build the whole-sequence-layout prep for ONE single-device span
+    (axis=None — the collective prev-symbol threading of the sharded paths
+    stays inline).  ``first``/``prev_sym`` follow _lane_streams' span
+    contract: continuation spans of one-hot models need the symbol emitted
+    before the span (it conditions the reduced chain's entry group)."""
+    from cpgisland_tpu.ops import fb_pallas
+
+    obs = jnp.asarray(obs)
+    obs_l, sel_l, lane_lens, obs_flat, Tt, _NL = fb_pallas._lane_layout(
+        obs, length, S, lane_T, t_tile, bool(first)
+    )
+    o0 = obs_flat[0]
+    prev_dev = pair2 = e_in = e_out = None
+    if onehot:
+        from cpgisland_tpu.ops.viterbi_onehot import pair_stream
+
+        if not first and prev_sym is None:
+            raise ValueError(
+                "onehot continuation spans (first=False) need prev_sym"
+            )
+        prev_dev = jnp.asarray(o0 if first else prev_sym, jnp.int32)
+        pair2, e_in, e_out = pair_stream(S, sel_l.T, prev_dev)
+    if prev_key is None and not first and isinstance(prev_sym, (int, np.integer)):
+        prev_key = int(prev_sym)
+    return PreparedSeq(
+        obs_l=obs_l, sel_l=sel_l, lane_lens=lane_lens, o0=o0,
+        prev_dev=prev_dev, pair2=pair2, e_in=e_in, e_out=e_out,
+        S=S, lane_T=lane_T, Tt=Tt, first=bool(first), onehot=onehot,
+        T=int(obs.shape[0]), prev_key=prev_key,
+    )
+
+
+# Jitted builder entries for the CACHE-MISS path: one dispatch per miss
+# (eagerly, each pad/where/cummax would be its own device program — ~8-10
+# relay round trips of launch latency per prep).  Inline in-graph prep
+# keeps calling the raw functions; under an outer trace the jit inlines,
+# so prepared-vs-inline stays the same HLO.
+_prepare_chunked_jit = functools.partial(
+    jax.jit, static_argnums=(0,), static_argnames=("t_tile", "onehot")
+)(prepare_chunked)
+_prepare_seq_jit = functools.partial(
+    jax.jit, static_argnums=(0,),
+    static_argnames=("lane_T", "t_tile", "first", "onehot", "prev_key"),
+)(prepare_seq)
+
+
+def check_chunked(
+    prep: PreparedChunked, S: int, N: int, T: int, t_tile: int, onehot: bool
+) -> None:
+    """Static consistency gate between a prepared object and its consumer's
+    geometry — a mismatch raises instead of silently computing on the wrong
+    layout (all checks are on meta fields / shapes, free under trace).
+    N/T are exact-matched: the lane/step paddings round up, so a prep for a
+    smaller batch would otherwise pass on shape and silently drop records.
+    """
+    if not isinstance(prep, PreparedChunked):
+        raise TypeError(
+            f"expected PreparedChunked, got {type(prep).__name__}"
+        )
+    want_Tt = chunked_Tt(T, t_tile)
+    if (
+        prep.S != S or prep.Tt != want_Tt
+        or prep.N != int(N) or prep.T != int(T)
+    ):
+        raise ValueError(
+            f"prepared chunked streams were built for S={prep.S}, "
+            f"N={prep.N}, T={prep.T}, Tt={prep.Tt}; this call needs S={S}, "
+            f"N={int(N)}, T={int(T)}, Tt={want_Tt} — rebuild the prep for "
+            "this input/geometry"
+        )
+    if onehot and prep.pair2 is None:
+        raise ValueError(
+            "this call needs a one-hot prep (pair2/esym2); the prepared "
+            "object was built with onehot=False"
+        )
+
+
+def check_seq(
+    prep: PreparedSeq, S: int, T: int, lane_T: int, t_tile: int, first: bool,
+    onehot: bool, prev_sym=None,
+) -> None:
+    """check_chunked's whole-sequence twin.  ``prev_sym``: when BOTH the
+    prep and this call carry a concrete continuation prev symbol, they must
+    agree (the reduced chain's entry group is conditioned on it)."""
+    if not isinstance(prep, PreparedSeq):
+        raise TypeError(f"expected PreparedSeq, got {type(prep).__name__}")
+    want_Tt = -(-min(t_tile, lane_T) // 8) * 8
+    if (
+        prep.S != S or prep.lane_T != lane_T or prep.Tt != want_Tt
+        or prep.first != bool(first) or prep.T != int(T)
+    ):
+        raise ValueError(
+            f"prepared seq streams were built for S={prep.S}, T={prep.T}, "
+            f"lane_T={prep.lane_T}, Tt={prep.Tt}, first={prep.first}; this "
+            f"call needs S={S}, T={int(T)}, lane_T={lane_T}, Tt={want_Tt}, "
+            f"first={bool(first)} — rebuild the prep for this geometry"
+        )
+    if onehot and prep.pair2 is None:
+        raise ValueError(
+            "this call needs a one-hot prep (pair stream); the prepared "
+            "object was built with onehot=False"
+        )
+    if (
+        prep.prev_key is not None
+        and isinstance(prev_sym, (int, np.integer))
+        and int(prev_sym) != prep.prev_key
+    ):
+        raise ValueError(
+            f"prepared seq streams were conditioned on prev_sym="
+            f"{prep.prev_key}; this call passes prev_sym={int(prev_sym)} — "
+            "rebuild the prep for this span"
+        )
+
+
+# --- identity-keyed cache ---------------------------------------------------
+#
+# Keyed on the *placed array identities* plus the static geometry: training
+# inputs are placed once and reused across iterations/fits, so identity is
+# the natural (and cheap) cache key; weakrefs validate each hit so a
+# recycled id() can never alias a dead entry, and content never needs
+# hashing.  Bounded FIFO — each entry pins its prep arrays (comparable in
+# size to the input) on device, so the bound is deliberately small.
+
+_CACHE_MAX = 8
+_cache: "OrderedDict[tuple, tuple]" = OrderedDict()
+_stats = {"hits": 0, "misses": 0}
+
+
+def cache_stats() -> dict:
+    """{'hits': n, 'misses': n} since process start (or clear_cache)."""
+    return dict(_stats)
+
+
+def clear_cache() -> None:
+    _cache.clear()
+    _stats["hits"] = 0
+    _stats["misses"] = 0
+
+
+def _sweep_dead() -> None:
+    """Drop entries whose keyed input arrays died: their prep trees (often
+    input-sized, device-resident) must not wait for capacity eviction."""
+    dead = [k for k, (refs, _) in _cache.items() if any(r() is None for r in refs)]
+    for k in dead:
+        del _cache[k]
+
+
+def _cached(kind: str, arrays: tuple, skey: tuple, build):
+    key = (kind, skey, tuple(id(a) for a in arrays))
+    ent = _cache.get(key)
+    if ent is not None and all(r() is a for r, a in zip(ent[0], arrays)):
+        _cache.move_to_end(key)
+        _stats["hits"] += 1
+        obs_mod.event("prepared_streams", kind=kind, hit=True)
+        return ent[1]
+    if ent is not None:  # id recycled onto a new array — stale entry
+        del _cache[key]
+    _sweep_dead()
+    t0 = time.perf_counter()
+    prep = build()
+    prep_ms = (time.perf_counter() - t0) * 1e3
+    nbytes = sum(
+        int(getattr(leaf, "nbytes", 0))
+        for leaf in jax.tree_util.tree_leaves(prep)
+    )
+    _stats["misses"] += 1
+    obs_mod.event(
+        "prepared_streams", kind=kind, hit=False,
+        bytes_resident=nbytes, prep_ms=round(prep_ms, 2), key=repr(skey),
+    )
+    _cache[key] = (tuple(weakref.ref(a) for a in arrays), prep)
+    while len(_cache) > _CACHE_MAX:
+        _cache.popitem(last=False)
+    return prep
+
+
+def cached_build(kind: str, arrays: tuple, skey: tuple, build):
+    """Public cache entry for custom builders (e.g. the shard_map prep
+    builders in train.backends): identity-keyed on ``arrays`` + ``skey``,
+    same hit/miss accounting and ``prepared_streams`` events as the
+    standard layouts."""
+    return _cached(kind, arrays, skey, build)
+
+
+def chunked_spec_tree(
+    S: int, N_local: int, T: int, t_tile: int, onehot: bool, lane_axis: str
+):
+    """A PreparedChunked of PartitionSpecs — the shard_map out_specs tree
+    for building per-device chunked preps in place (lane axis = the mesh
+    axis the record batch is sharded over; ``N_local`` = rows per device).
+    Meta fields mirror what the per-device :func:`prepare_chunked`
+    produces, so the spec tree and the output tree have identical
+    treedefs."""
+    from jax.sharding import PartitionSpec as P
+
+    sp = P(None, lane_axis)
+    return PreparedChunked(
+        steps2=sp, lens2=sp,
+        sel2=sp if onehot else None,
+        pair2=sp if onehot else None,
+        esym2=sp if onehot else None,
+        S=S, Tt=chunked_Tt(T, t_tile), onehot=onehot,
+        N=int(N_local), T=int(T),
+    )
+
+
+def sharded_chunked_builder(
+    mesh, lane_axis: str, in_specs, S: int, N_local: int, T: int,
+    t_tile: int, onehot: bool, lengths_2d: bool = False,
+):
+    """jit(shard_map(prepare_chunked)): build per-device chunked preps IN
+    PLACE over an already-placed batch (one dispatch, no host round trip of
+    the symbols).  The ONE copy shared by SpmdBackend, Seq2DBackend's rows
+    path, and any future sharded chunked consumer — their builder spec
+    trees cannot drift.  ``lengths_2d``: the lengths operand is the 2-D
+    [N, sp] per-shard layout (Seq2D) rather than [N]."""
+
+    def build(c, l):
+        return prepare_chunked(
+            S, c, l[:, 0] if lengths_2d else l, t_tile=t_tile, onehot=onehot
+        )
+
+    return jax.jit(
+        jax.shard_map(
+            build,
+            mesh=mesh,
+            in_specs=in_specs,
+            out_specs=chunked_spec_tree(
+                S, N_local, T, t_tile, onehot, lane_axis
+            ),
+            check_vma=False,
+        )
+    )
+
+
+def kw_prepared_shim(fn):
+    """Keyword-normalizing wrapper for shard_map-compiled stats fns: the
+    fused EM driver passes ``prepared=`` by name, which shard_map-wrapped
+    callables don't accept.  One shared shim so every prep-aware compiled
+    fn exposes the same signature."""
+
+    def call(params, a, b, prepared, _fn=fn):
+        return _fn(params, a, b, prepared)
+
+    return call
+
+
+def for_chunked(
+    S: int, chunks, lengths, *, t_tile: int, onehot: bool = False
+) -> PreparedChunked:
+    """Cached :func:`prepare_chunked` keyed on (chunks, lengths) identity +
+    geometry.  Call with PLACED device arrays (backends.place output) so
+    repeated fits/iterations on the same input hit."""
+    skey = (S, int(t_tile), bool(onehot), tuple(chunks.shape),
+            str(chunks.dtype))
+    return _cached(
+        "chunked", (chunks, lengths), skey,
+        # One jitted dispatch per miss (the eager builder would dispatch
+        # each pad/where/cummax as its own program over the relay).
+        lambda: _prepare_chunked_jit(
+            S, chunks, lengths, t_tile=t_tile, onehot=onehot
+        ),
+    )
+
+
+def for_seq(
+    S: int,
+    obs,
+    length: int,
+    *,
+    lane_T: int,
+    t_tile: int,
+    first: bool = True,
+    onehot: bool = False,
+    prev_sym=None,
+) -> PreparedSeq:
+    """Cached :func:`prepare_seq` (single-device spans).  ``length`` and
+    ``prev_sym`` must be concrete here — they are part of the cache key."""
+    skey = (
+        S, int(length), int(lane_T), int(t_tile), bool(first), bool(onehot),
+        None if prev_sym is None else int(prev_sym), tuple(obs.shape),
+        str(obs.dtype),
+    )
+    return _cached(
+        "seq", (obs,), skey,
+        lambda: _prepare_seq_jit(
+            S, obs, jnp.int32(length), lane_T=lane_T, t_tile=t_tile,
+            first=bool(first), onehot=onehot,
+            prev_sym=None if prev_sym is None else jnp.int32(prev_sym),
+            prev_key=(
+                None if (first or prev_sym is None) else int(prev_sym)
+            ),
+        ),
+    )
+
+
+class PreparedStreams:
+    """Host-side handle: ONE input's prepared artifacts across layouts.
+
+    pipeline-level flows (decode -> posterior -> EM over the same placed
+    arrays) hold one of these instead of three independent preps; each
+    layout builds lazily through the identity-keyed cache, so mixed
+    consumers (a chunked posterior and a chunked E-step, or two span
+    sweeps over one placed span) share the same device-resident artifact.
+    """
+
+    def __init__(self, n_symbols: int):
+        self.S = int(n_symbols)
+
+    def chunked(
+        self, chunks, lengths, *, t_tile: int, onehot: bool = False
+    ) -> PreparedChunked:
+        return for_chunked(
+            self.S, chunks, lengths, t_tile=t_tile, onehot=onehot
+        )
+
+    def seq(
+        self, obs, length: int, *, lane_T: int, t_tile: int,
+        first: bool = True, onehot: bool = False, prev_sym=None,
+    ) -> PreparedSeq:
+        return for_seq(
+            self.S, obs, length, lane_T=lane_T, t_tile=t_tile, first=first,
+            onehot=onehot, prev_sym=prev_sym,
+        )

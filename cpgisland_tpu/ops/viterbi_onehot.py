@@ -212,7 +212,14 @@ def device_entry_sym(obs_c: jnp.ndarray, pad_sym: int, axis: str,
 
 
 def _pair_stream(params: HmmParams, steps2: jnp.ndarray, prev0: jnp.ndarray):
-    """Per-step pair indices + per-block boundary symbols.
+    """Params-flavored wrapper of :func:`pair_stream` (kept for callers that
+    hold a model; the stream itself is SYMBOL-ONLY — it reads nothing from
+    the params but the alphabet size, which is static shape info)."""
+    return pair_stream(params.n_symbols, steps2, prev0)
+
+
+def pair_stream(S: int, steps2: jnp.ndarray, prev0: jnp.ndarray):
+    """Per-step pair indices + per-block boundary symbols (symbol-only).
 
     steps2: [bk, nb] int32 transition symbols in block layout (global step
     b*bk + k at [k, b]); prev0: [] int32, the symbol emitted before step 0.
@@ -222,8 +229,9 @@ def _pair_stream(params: HmmParams, steps2: jnp.ndarray, prev0: jnp.ndarray):
     resolved by forward-fill).  The fill is two-level so nothing T-sized and
     sequential is built: a cummax along the block axis resolves in-block PAD
     runs, and a tiny [nb] cummax threads the last real symbol across blocks.
+    Depends only on the symbols and the alphabet size — the piece
+    ops.prepared amortizes across EM iterations and pipeline passes.
     """
-    S = params.n_symbols
     bk, nb = steps2.shape
     real = steps2 < S
     iota = jax.lax.broadcasted_iota(jnp.int32, (bk, nb), 0)
@@ -547,49 +555,79 @@ def _xla_backtrace(bp2, pair2, idtab, exit_bits):
 # Pass-level API (the "onehot" engine for viterbi_parallel.get_passes)
 
 
-def _prepared(params: HmmParams, steps2: jnp.ndarray, prev0, resets=None):
-    """Tables + pair stream for the passes.
+def prepare_pairs(S: int, steps2: jnp.ndarray, prev0, resets=None):
+    """Symbol-only pair stream for the decode passes, reset-renumbered.
+
+    Returns (pair2, e_in, e_out, nreal) — everything `_prepared` derives
+    from the symbols alone, factored out so a caller (or ops.prepared's
+    cache) can amortize it across the three passes and across calls; the
+    params-dependent tables stay in `_prepared`.
 
     ``resets`` (flat batch decoding): a [bk, nb] bool mask — step [k, b]
     (global step b*bk + k) is a RESET step into a record whose start symbol
-    is steps2[k, b] (see _reset_rows), and the tables extend with the S
-    reset rows so nreal covers them in the select tree (reset pairs
-    renumber INSIDE the tree range; see the inline comment below).
+    is steps2[k, b] (see _reset_rows).  RESET pairs renumber to
+    [S*S, S*S + S) so they sit INSIDE the select tree's nreal range while
+    PAD carries move up to [S*S + S, S*S + 2S) and stay tree DEFAULTS — 20
+    compares, not 24.  ``resets`` is elementwise (fuses into the
+    pair-stream computation — an .at[].set scatter here copied the whole
+    4 B/step stream and measured ~19% of the batch decode).
     """
     if prev0 is None:
         raise ValueError("the onehot engine requires prev0 (the symbol before step 0)")
-    S = params.n_symbols
-    gt = _groups(params)
-    tab, idtab = _pair_table(params, gt)
     steps2 = steps2.astype(jnp.int32)
-    pair2, e_in, e_out = _pair_stream(
-        params, steps2, jnp.asarray(prev0, jnp.int32)
-    )
+    pair2, e_in, e_out = pair_stream(S, steps2, jnp.asarray(prev0, jnp.int32))
     nreal = S * S
     if resets is not None:
-        # Batch layout: RESET pairs renumber to [S*S, S*S + S) so they sit
-        # INSIDE the select tree's nreal range while PAD carries move up to
-        # [S*S + S, S*S + 2S) and stay tree DEFAULTS — 20 compares, not 24.
-        # ``resets`` is a [bk, nb] bool mask (elementwise, fuses into the
-        # pair-stream computation — an .at[].set scatter here copied the
-        # whole 4 B/step stream and measured ~19% of the batch decode).
-        rrows, rgt = _reset_rows(params, gt)
-        tab = jnp.concatenate([tab[: S * S], rrows, tab[S * S :]], axis=0)
-        idtab = jnp.concatenate([idtab[: S * S], rgt, idtab[S * S :]], axis=0)
         is_pad = pair2 >= S * S
         pair2 = jnp.where(is_pad, pair2 + S, pair2)
         pair2 = jnp.where(
             resets, S * S + jnp.minimum(steps2, S - 1), pair2
         )
         nreal = S * S + S
+    return pair2, e_in, e_out, nreal
+
+
+def _prepared(params: HmmParams, steps2: jnp.ndarray, prev0, resets=None,
+              pre=None):
+    """Tables + pair stream for the passes.
+
+    ``pre`` (from :func:`prepare_pairs`, possibly cached by ops.prepared):
+    the symbol-only (pair2, e_in, e_out, nreal) tuple — it must have been
+    built with the SAME ``resets`` mask, which still selects the reset-row
+    table extension here.
+    """
+    S = params.n_symbols
+    gt = _groups(params)
+    tab, idtab = _pair_table(params, gt)
+    if pre is None:
+        pre = prepare_pairs(S, steps2, prev0, resets)
+    pair2, e_in, e_out, nreal = pre
+    if resets is not None:
+        if nreal != S * S + S:
+            raise ValueError(
+                "prepared pair stream was built without the resets mask "
+                "this call passes (nreal mismatch)"
+            )
+        rrows, rgt = _reset_rows(params, gt)
+        tab = jnp.concatenate([tab[: S * S], rrows, tab[S * S :]], axis=0)
+        idtab = jnp.concatenate([idtab[: S * S], rgt, idtab[S * S :]], axis=0)
+    elif nreal != S * S:
+        raise ValueError(
+            "prepared pair stream carries reset renumbering but this call "
+            "passes no resets mask"
+        )
     return S, gt, tab, idtab, pair2, e_in, e_out, nreal
 
 
-def pass_products(params: HmmParams, steps2: jnp.ndarray, prev0=None, resets=None):
-    """Onehot twin of viterbi_parallel._pass_products: (incl, offs, total)."""
+def pass_products(params: HmmParams, steps2: jnp.ndarray, prev0=None, resets=None,
+                  pre=None):
+    """Onehot twin of viterbi_parallel._pass_products: (incl, offs, total).
+
+    ``pre``: a prepared (pair2, e_in, e_out, nreal) from :func:`prepare_pairs`
+    (optional — inline prep otherwise; the same contract on every pass)."""
     K = params.n_states
     S, gt, tab, _, pair2, e_in, e_out, nreal = _prepared(
-        params, steps2, prev0, resets
+        params, steps2, prev0, resets, pre
     )
     nb = steps2.shape[1]
     if _interpret():
@@ -616,7 +654,7 @@ def pass_products(params: HmmParams, steps2: jnp.ndarray, prev0=None, resets=Non
 
 
 def pass_backpointers(params: HmmParams, v_enter: jnp.ndarray, steps2: jnp.ndarray,
-                      prev0=None, resets=None):
+                      prev0=None, resets=None, pre=None):
     """Onehot twin of viterbi_parallel._pass_backpointers.
 
     Returns (delta_blocks [nb, K], F [nb, K], blob); the blob carries the
@@ -624,7 +662,7 @@ def pass_backpointers(params: HmmParams, v_enter: jnp.ndarray, steps2: jnp.ndarr
     mapping."""
     K = params.n_states
     S, gt, tab, idtab, pair2, e_in, e_out, nreal = _prepared(
-        params, steps2, prev0, resets
+        params, steps2, prev0, resets, pre
     )
     bk_real, nb = steps2.shape
     v_red = jnp.take_along_axis(v_enter, gt[e_in], axis=1)  # [nb, 2]
@@ -697,9 +735,49 @@ def pass_backtrace(blob, exits: jnp.ndarray) -> jnp.ndarray:
 # Flat batched decode (one kernel grid for N records — no vmap-of-pallas)
 
 
+def prepare_decode_flat(
+    S: int, chunks: jnp.ndarray, lengths: jnp.ndarray, block_size: int = 4096
+):
+    """Symbol-only prep of the flat batched decode.
+
+    Returns (concat [N*T] clamped symbols, padded [nb*bk] step stream,
+    resets [bk, nb] bool mask, bk, pre) where ``pre`` is the
+    reset-renumbered :func:`prepare_pairs` tuple — exactly what
+    :func:`decode_batch_flat` unpacks.  Mirrors its own derivation (it
+    delegates here), so prepared-vs-inline decodes are bit-identical."""
+    N, T = chunks.shape
+    obs_c = jnp.where(
+        jnp.arange(T)[None, :] >= lengths[:, None],
+        S,
+        jnp.minimum(chunks.astype(jnp.int32), S),
+    )
+    concat = obs_c.reshape(-1)
+    Np = N * T
+    n_steps = Np - 1
+    bk = min(block_size, max(8, n_steps))
+    nb = -(-n_steps // bk)
+    padded = jnp.concatenate(
+        [concat[1:], jnp.full(nb * bk - n_steps, S, jnp.int32)]
+    )
+    # Step r*T - 1 is the reset entering record r's position 0 — expressed
+    # as an iota mask (elementwise; an index scatter on the [bk, nb] pair
+    # stream copied 4 B/step and measured ~19% of the batch decode).  The
+    # reset pair needs the record's FIRST symbol, which IS that step's own
+    # symbol, so the mask alone is enough.  Layout matches _block_passes's
+    # steps.reshape(nb, bk).T: entry [k, b] is global step b*bk + k.
+    kk = jax.lax.broadcasted_iota(jnp.int32, (bk, nb), 0)
+    bb = jax.lax.broadcasted_iota(jnp.int32, (bk, nb), 1)
+    gstep = bb * bk + kk
+    resets = ((gstep + 1) % T == 0) & (gstep + 1 < Np)
+    steps2 = padded.reshape(nb, bk).T
+    pre = prepare_pairs(S, steps2, concat[0], resets)
+    return concat, padded, resets, bk, pre
+
+
 def decode_batch_flat(
     params: HmmParams, chunks: jnp.ndarray, lengths: jnp.ndarray,
     block_size: int = 4096,
+    prepared=None,
 ):
     """Decode an [N, T] batch as ONE flat stream with RESET steps.
 
@@ -724,7 +802,9 @@ def decode_batch_flat(
     engine: records whose position 0 is PAD decode approximately (host
     entry points demote those to a dense engine).
     Returns paths [N, T] (positions >= lengths[r] carry the exit state,
-    like viterbi_padded).
+    like viterbi_padded).  ``prepared`` (from :func:`prepare_decode_flat`):
+    the symbol-only stream/reset/pair prep — build it once per batch when
+    decoding the same placed batch repeatedly.
     """
     from cpgisland_tpu.ops.viterbi_parallel import _block_passes, _step_tables
 
@@ -732,34 +812,28 @@ def decode_batch_flat(
     N, T = chunks.shape
     if T < 2:
         raise ValueError("decode_batch_flat needs records of at least 2 symbols")
-    obs_c = jnp.where(
-        jnp.arange(T)[None, :] >= lengths[:, None],
-        S,
-        jnp.minimum(chunks.astype(jnp.int32), S),
-    )
-    concat = obs_c.reshape(-1)
+    if prepared is None:
+        prepared = prepare_decode_flat(S, chunks, lengths, block_size)
+    concat, padded, resets, bk, pre = prepared
     Np = N * T
+    n_steps = Np - 1
+    # A stale prep (different batch shape or block size) must raise, not
+    # silently decode with a mismatched reset layout — the same gate as
+    # ops.prepared.check_chunked for the other prepared consumers.
+    want_bk = min(block_size, max(8, n_steps))
+    if concat.shape[0] != Np or bk != want_bk:
+        raise ValueError(
+            f"prepared decode stream was built for {concat.shape[0]} "
+            f"symbols / bk={bk}; this call needs {Np} symbols / "
+            f"bk={want_bk} — rebuild it with prepare_decode_flat for this "
+            "batch and block_size"
+        )
     _, emit_ext = _step_tables(params)
     v0 = params.log_pi + emit_ext[concat[0]]
-    n_steps = Np - 1
-    bk = min(block_size, max(8, n_steps))
-    nb = -(-n_steps // bk)
-    padded = jnp.concatenate(
-        [concat[1:], jnp.full(nb * bk - n_steps, S, jnp.int32)]
-    )
-    # Step r*T - 1 is the reset entering record r's position 0 — expressed
-    # as an iota mask (elementwise; an index scatter on the [bk, nb] pair
-    # stream copied 4 B/step and measured ~19% of the batch decode).  The
-    # reset pair needs the record's FIRST symbol, which IS that step's own
-    # symbol, so the mask alone is enough.  Layout matches _block_passes's
-    # steps.reshape(nb, bk).T: entry [k, b] is global step b*bk + k.
-    kk = jax.lax.broadcasted_iota(jnp.int32, (bk, nb), 0)
-    bb = jax.lax.broadcasted_iota(jnp.int32, (bk, nb), 1)
-    gstep = bb * bk + kk
-    resets = ((gstep + 1) % T == 0) & (gstep + 1 < Np)
 
     dec = _block_passes(
-        params, v0, padded, bk, engine="onehot", prev0=concat[0], resets=resets
+        params, v0, padded, bk, engine="onehot", prev0=concat[0],
+        resets=resets, pre=pre,
     )
     s0 = dec.ftable[jnp.argmax(dec.delta_exit)]
     full = jnp.concatenate([s0[None], dec.path[:n_steps]])

@@ -285,6 +285,7 @@ def _block_passes(
     engine: str = "xla",
     prev0: jnp.ndarray | None = None,
     resets: jnp.ndarray | None = None,
+    pre=None,
 ) -> BlockDecode:
     """Run the three block passes over ``steps`` (transition symbols), with
     ``v_enter0`` the score vector entering the first step.
@@ -306,6 +307,13 @@ def _block_passes(
         if engine != "onehot":
             raise ValueError("record-reset steps need the onehot engine")
         extra = {"resets": resets}
+    if pre is not None:
+        # A prepared symbol-only pair stream (viterbi_onehot.prepare_pairs)
+        # shared by both pair-consuming passes — outside a jit the inline
+        # streams are separate dispatches that CSE cannot merge.
+        if engine != "onehot":
+            raise ValueError("prepared pair streams need the onehot engine")
+        extra["pre"] = pre
     incl, offs, total = _pass_products(params, steps2, prev0, **extra)
     v_enter, enter_offs = _enter_vectors(v_enter0, incl, offs)
     delta_blocks, F, bps = _pass_backpointers(params, v_enter, steps2, prev0, **extra)

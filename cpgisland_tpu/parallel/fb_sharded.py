@@ -552,7 +552,8 @@ def sharded_stats2d_fn(
 
 
 @functools.lru_cache(maxsize=32)
-def sharded_stats2d_rows_fn(mesh: Mesh, engine: str, t_tile: int = 512):
+def sharded_stats2d_rows_fn(mesh: Mesh, engine: str, t_tile: int = 512,
+                            prep_meta: tuple | None = None):
     """Whole-record chunked-kernel fast path for SMALL-record 2-D groups.
 
     A record that fits ONE kernel lane needs none of the sequence-parallel
@@ -564,16 +565,23 @@ def sharded_stats2d_rows_fn(mesh: Mesh, engine: str, t_tile: int = 512):
     >= devices).  Replaces a per-row lax.scan of full three-pass
     sequence-parallel programs — the scan serialized R tiny programs per
     iteration, the dominant seq2d cost for many-scaffold inputs.
+
+    ``prep_meta`` = (S, N_local, T, t_tile, onehot): the returned fn
+    additionally accepts per-device prepared chunked streams (ops.prepared,
+    built by Seq2DBackend's sharded prep builder) as a 4th ``prepared``
+    argument — the symbol-only lane/pair prep then never re-derives per EM
+    iteration.
     """
     data_axis, seq_axis = mesh.axis_names
 
-    def body(params: HmmParams, obs_tile: jnp.ndarray, len_tile: jnp.ndarray) -> SuffStats:
+    def body(params: HmmParams, obs_tile: jnp.ndarray, len_tile: jnp.ndarray,
+             prepared=None) -> SuffStats:
         if engine in ("pallas", "onehot"):
             from cpgisland_tpu.ops import fb_pallas
 
             st = fb_pallas.batch_stats_pallas(
                 params, obs_tile, len_tile[:, 0], t_tile=t_tile,
-                onehot=engine == "onehot",
+                onehot=engine == "onehot", prepared=prepared,
             )
         else:
             from cpgisland_tpu.ops.forward_backward import batch_stats
@@ -581,15 +589,37 @@ def sharded_stats2d_rows_fn(mesh: Mesh, engine: str, t_tile: int = 512):
             st = batch_stats(params, obs_tile, len_tile[:, 0], mode="rescaled")
         return jax.lax.psum(st, (data_axis, seq_axis))
 
-    return jax.jit(
+    row_specs = (P(), P(data_axis, seq_axis), P(data_axis, seq_axis))
+    if prep_meta is None:
+        def body3(params, obs_tile, len_tile):
+            return body(params, obs_tile, len_tile)
+
+        return jax.jit(
+            jax.shard_map(
+                body3,
+                mesh=mesh,
+                in_specs=row_specs,
+                out_specs=P(),
+                check_vma=engine == "xla",
+            )
+        )
+    from cpgisland_tpu.ops import prepared as prep_mod
+
+    S, N_local, T, tt, onehot = prep_meta
+    compiled = jax.jit(
         jax.shard_map(
             body,
             mesh=mesh,
-            in_specs=(P(), P(data_axis, seq_axis), P(data_axis, seq_axis)),
+            in_specs=row_specs + (
+                prep_mod.chunked_spec_tree(
+                    S, N_local, T, tt, onehot, data_axis
+                ),
+            ),
             out_specs=P(),
             check_vma=engine == "xla",
         )
     )
+    return prep_mod.kw_prepared_shim(compiled)
 
 
 @functools.lru_cache(maxsize=32)

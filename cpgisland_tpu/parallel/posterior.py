@@ -212,6 +212,62 @@ def _prev_sym_arg(engine: str, first: bool, prev_sym) -> jnp.ndarray:
     return jnp.asarray(prev_sym, jnp.int32)
 
 
+def prepare_record_span(
+    params: HmmParams,
+    placed,
+    length: int,
+    *,
+    engine: str = "auto",
+    first: bool = True,
+    prev_sym: Optional[int] = None,
+    want_path: bool = False,
+    t_tile: Optional[int] = None,
+    mesh: Optional[Mesh] = None,
+    streams=None,
+):
+    """One span's PreparedSeq (ops.prepared), shared by BOTH span sweeps.
+
+    ``streams``: the caller's ops.prepared.PreparedStreams handle (one per
+    input — pipeline.posterior_file holds one per record) so every span's
+    artifact books against the same handle/cache; a fresh cache lookup
+    otherwise.
+
+    The span-threaded posterior lane-lays-out and pair-streams the SAME
+    placed span twice — once for the transfer-total sweep (A) and once for
+    the posterior sweep (B).  This builds the symbol-only prep ONCE per
+    placed span (identity-cached, so repeated calls are free) for the
+    single-device fused engines; returns None when the mesh shards the
+    span (the sharded bodies' collective threading preps inline) or the
+    engine has no prepared form — callers then fall back to inline prep.
+
+    The prep's lane geometry is the POSTERIOR sweep's pick; the products-
+    only transfer sweep runs the same lanes (its reduced kernel has no
+    long-lane constraint), so one prep serves both.
+    """
+    if mesh is None:
+        mesh = make_mesh(axis=SEQ_AXIS)
+    if mesh.shape[mesh.axis_names[0]] != 1:
+        return None
+    eng = resolve_fb_engine(engine, params)
+    if eng not in ("pallas", "onehot"):
+        return None
+    from cpgisland_tpu.ops import prepared as prep_mod
+
+    oh = eng == "onehot"
+    arr = placed[0]
+    lane_T = fb_pallas.pick_lane_T(
+        arr.shape[0], onehot=oh, long_lanes=oh and not want_path
+    )
+    if streams is None:
+        streams = prep_mod.PreparedStreams(params.n_symbols)
+    return streams.seq(
+        arr, int(length), lane_T=lane_T,
+        t_tile=t_tile if t_tile is not None else fb_pallas.DEFAULT_T_TILE,
+        first=first, onehot=oh,
+        prev_sym=None if (first or prev_sym is None) else int(prev_sym),
+    )
+
+
 def place_record_span(
     params: HmmParams,
     piece,
@@ -253,9 +309,15 @@ def posterior_sharded(
     pad_to: Optional[int] = None,
     placed=None,
     prev_sym: Optional[int] = None,
+    prepared=None,
 ):
     """Island confidence (and optional MPM path) for one sequence, sharded
     along time over the mesh.
+
+    ``prepared`` (from :func:`prepare_record_span`; single-device fused
+    engines only): the span's symbol-only prep — the pass then runs the
+    fused core directly with it, skipping the per-sweep lane/pair-stream
+    rebuild; geometry (incl. lane_T) comes from the prep.
 
     enter_dir/exit_dir ([K] direction vectors) thread span-boundary messages
     for records processed in multiple spans (pipeline.posterior_file);
@@ -301,10 +363,29 @@ def posterior_sharded(
         jnp.full(K, 1.0 / K, jnp.float32) if exit_dir is None
         else jnp.asarray(exit_dir, jnp.float32)
     )
-    fn = _posterior_fn(mesh, block_size, eng, first, want_path, lt, tt)
-    conf, path = fn(
-        params, arr, lens, mask, enter, exit_, _prev_sym_arg(eng, first, prev_sym)
-    )
+    if (
+        prepared is not None
+        and mesh.shape[mesh.axis_names[0]] == 1
+        and eng in ("pallas", "onehot")
+    ):
+        # Single-device fused branch with the span's shared prep: the
+        # direct core is math-identical to the 1-device shard_map body
+        # (device_boundary_messages over one device degenerates to the
+        # axis=None seed/anchor), and the prep's geometry wins.
+        conf, path = fb_pallas.seq_posterior_pallas(
+            params, arr, T, mask,
+            enter_dir=None if first else enter, exit_dir=exit_,
+            first=first, want_path=want_path,
+            lane_T=prepared.lane_T, t_tile=tt, onehot=eng == "onehot",
+            prev_sym=_prev_sym_arg(eng, first, prev_sym),
+            prepared=prepared,
+        )
+    else:
+        fn = _posterior_fn(mesh, block_size, eng, first, want_path, lt, tt)
+        conf, path = fn(
+            params, arr, lens, mask, enter, exit_,
+            _prev_sym_arg(eng, first, prev_sym),
+        )
     conf = fetch_sharded_prefix(conf, T, return_device)
     path = fetch_sharded_prefix(path, T, return_device) if want_path else None
     return conf, path
@@ -322,6 +403,7 @@ def transfer_total_sharded(
     placed=None,
     prev_sym: Optional[int] = None,
     return_device: bool = False,
+    prepared=None,
 ):
     """One span's normalized [K, K] probability-space transfer operator
     (sweep A of span-threaded posterior processing).  ``placed`` (from
@@ -343,10 +425,16 @@ def transfer_total_sharded(
         oh = eng == "onehot"
         ps = _prev_sym_arg(eng, first, prev_sym)
         if placed is not None:
+            # ``prepared`` (prepare_record_span): reuse the span's shared
+            # symbol-only prep — its lane geometry wins so sweep A and
+            # sweep B run the same layout from one prep.
             out = fb_pallas.seq_transfer_total_pallas(
                 params, placed[0], int(obs.shape[0]), first=first,
-                lane_T=fb_pallas.pick_lane_T(placed[0].shape[0], onehot=oh),
-                onehot=oh, prev_sym=ps,
+                lane_T=(
+                    prepared.lane_T if prepared is not None
+                    else fb_pallas.pick_lane_T(placed[0].shape[0], onehot=oh)
+                ),
+                onehot=oh, prev_sym=ps, prepared=prepared,
             )
         else:
             obs = np.asarray(obs)

@@ -947,6 +947,7 @@ def posterior_file(
         island_mask,
         place_record_span,
         posterior_sharded,
+        prepare_record_span,
         resolve_fb_engine,
         transfer_total_sharded,
     )
@@ -1217,6 +1218,13 @@ def posterior_file(
             # k+1's device_put is issued while span k's products sweep
             # runs — and the tiny [K, K] fetches all happen at the end.
             span_placed: dict = {}
+            span_prep: dict = {}
+            # One PreparedStreams handle per record: every span's symbol-only
+            # artifact (lane layout + pair stream) books against it and is
+            # shared by the transfer-total and posterior sweeps below.
+            from cpgisland_tpu.ops.prepared import PreparedStreams
+
+            rec_streams = PreparedStreams(params.n_symbols)
             with timer.phase("span-totals", items=float(symbols.size), unit="sym"):
                 totals = []
                 for si, lo in enumerate(range(0, symbols.size, span)):
@@ -1224,19 +1232,28 @@ def posterior_file(
                     span_placed[si] = place_record_span(
                         params, piece, pad_to=span
                     )
+                    # The symbol before the span conditions the reduced
+                    # onehot kernels' entry group.
+                    prev = (
+                        0 if lo == 0
+                        else _prev_real_symbol(symbols, lo, params.n_symbols)
+                    )
+                    # ONE symbol-only prep (lane layout + pair stream) per
+                    # placed span, shared by this transfer-total sweep and
+                    # the posterior sweep below (ops.prepared; None when the
+                    # mesh/engine has no prepared form — inline prep then).
+                    span_prep[si] = prepare_record_span(
+                        params, span_placed[si], piece.size, engine=engine,
+                        first=lo == 0, prev_sym=prev, want_path=want_path,
+                        streams=rec_streams,
+                    )
                     totals.append(
                         transfer_total_sharded(
                             params, piece, engine=engine, first=lo == 0,
                             pad_to=span, placed=span_placed[si],
-                            # The symbol before the span conditions the
-                            # reduced onehot kernels' entry group.
-                            prev_sym=(
-                                0 if lo == 0
-                                else _prev_real_symbol(
-                                    symbols, lo, params.n_symbols
-                                )
-                            ),
+                            prev_sym=prev,
                             return_device=prefetch > 0,
+                            prepared=span_prep[si],
                         )
                     )
                 if prefetch > 0:
@@ -1280,6 +1297,7 @@ def posterior_file(
                             0 if s == 0
                             else _prev_real_symbol(symbols, lo, params.n_symbols)
                         ),
+                        prepared=span_prep.pop(s),
                     )
                 if use_device_islands:
                     if want_conf:

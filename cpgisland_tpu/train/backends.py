@@ -155,6 +155,28 @@ class EStepBackend:
         """
         return None
 
+    def prepare_streams(self, params: HmmParams, chunks, lengths):
+        """Symbol-only prepared streams (ops.prepared) for the PLACED input,
+        or None when the routing has no prepared form.
+
+        The result is passed to the stats fn as an EXPLICIT argument
+        (``prepared=``) — never closed over — so the fused EM while_loop
+        body performs zero symbol-stream prep per iteration (the
+        ``em.body.invariant-free`` graftcheck contract).  Implementations
+        must return None for traced inputs (prep is a host-side cache; a
+        tracer must fall back to inline prep in-graph).
+        """
+        return None
+
+    def fused_stats_with_prep(self, params: HmmParams, chunks, lengths):
+        """(stats_fn, prepared) for the fused EM driver.
+
+        When ``prepared`` is not None the returned callable accepts
+        ``(params, chunks, lengths, prepared=...)``; otherwise it has the
+        plain :meth:`fused_stats_fn` signature.  Default: no prep.
+        """
+        return self.fused_stats_fn(params, chunks, lengths), None
+
 
 class LocalBackend(EStepBackend):
     """Single-device vmap mapper + sum reducer."""
@@ -163,13 +185,39 @@ class LocalBackend(EStepBackend):
         self.mode = mode
         self.engine = engine
 
+    def prepare_streams(self, params, chunks, lengths):
+        if isinstance(chunks, jax.core.Tracer):
+            # Under an outer trace (e.g. bench's chained harness) there is
+            # nothing host-cacheable — the inline in-graph prep is the same
+            # HLO.
+            return None
+        engine = resolve_fb_engine(self.engine, params, self.mode)
+        if engine not in ("pallas", "onehot"):
+            return None
+        from cpgisland_tpu.ops import prepared as prep_mod
+
+        return prep_mod.for_chunked(
+            params.n_symbols, jnp.asarray(chunks), jnp.asarray(lengths),
+            t_tile=fb_pallas.DEFAULT_T_TILE, onehot=engine == "onehot",
+        )
+
     def __call__(self, params, chunks, lengths):
         fn = _local_stats_fn(resolve_fb_engine(self.engine, params, self.mode), self.mode)
-        return fn(params, jnp.asarray(chunks), jnp.asarray(lengths))
+        chunks, lengths = jnp.asarray(chunks), jnp.asarray(lengths)
+        prep = self.prepare_streams(params, chunks, lengths)
+        if prep is not None:
+            return fn(params, chunks, lengths, prepared=prep)
+        return fn(params, chunks, lengths)
 
     def fused_stats_fn(self, params, chunks, lengths):
         return _local_stats_fn(
             resolve_fb_engine(self.engine, params, self.mode), self.mode
+        )
+
+    def fused_stats_with_prep(self, params, chunks, lengths):
+        return (
+            self.fused_stats_fn(params, chunks, lengths),
+            self.prepare_streams(params, chunks, lengths),
         )
 
 
@@ -194,29 +242,97 @@ class SpmdBackend(EStepBackend):
         self.axis = axis
         self.engine = engine
         self._estep_cache = {}
+        self._prep_fn_cache = {}
 
-    def _estep_for(self, params):
+    def _estep_for(self, params, prep_meta=None):
         engine = resolve_fb_engine(self.engine, params, self.mode)
-        if engine not in self._estep_cache:
+        key = (engine, prep_meta)
+        if key not in self._estep_cache:
             local_fn = _local_stats_fn(engine, self.mode)
 
-            def estep(params, chunks, lengths):
-                # mapper (per-shard batch stats) + the psum all-reduce that
-                # replaces Hadoop's shuffle+reduce.
-                return jax.lax.psum(
-                    local_fn(params, chunks, lengths), axis_name=self.axis
-                )
+            if prep_meta is not None:
+                from cpgisland_tpu.ops import prepared as prep_mod
 
-            self._estep_cache[engine] = jax.jit(
+                S, N_local, T, t_tile, onehot = prep_meta
+
+                def estep(params, chunks, lengths, prepared):
+                    # Same mapper + psum, with this device's prepared block
+                    # arriving as a sharded ARGUMENT (resolved once outside
+                    # the fused loop, never re-derived per iteration).
+                    return jax.lax.psum(
+                        local_fn(params, chunks, lengths, prepared=prepared),
+                        axis_name=self.axis,
+                    )
+
+                in_specs = (
+                    P(), P(self.axis), P(self.axis),
+                    prep_mod.chunked_spec_tree(
+                        S, N_local, T, t_tile, onehot, self.axis
+                    ),
+                )
+            else:
+                def estep(params, chunks, lengths):
+                    # mapper (per-shard batch stats) + the psum all-reduce
+                    # that replaces Hadoop's shuffle+reduce.
+                    return jax.lax.psum(
+                        local_fn(params, chunks, lengths), axis_name=self.axis
+                    )
+
+                in_specs = (P(), P(self.axis), P(self.axis))
+
+            compiled = jax.jit(
                 jax.shard_map(
                     estep,
                     mesh=self.mesh,
-                    in_specs=(P(), P(self.axis), P(self.axis)),
+                    in_specs=in_specs,
                     out_specs=P(),
                     check_vma=engine == "xla",
                 )
             )
-        return self._estep_cache[engine]
+            if prep_meta is not None:
+                # Keyword-normalizing shim, cached here (not per fused
+                # call) so the fused driver's lru key stays stable.
+                from cpgisland_tpu.ops import prepared as prep_mod
+
+                compiled = prep_mod.kw_prepared_shim(compiled)
+            self._estep_cache[key] = compiled
+        return self._estep_cache[key]
+
+    def prepare_streams(self, params, chunks, lengths):
+        out = self._prepare_with_meta(params, chunks, lengths)
+        return None if out is None else out[0]
+
+    def _prepare_with_meta(self, params, chunks, lengths):
+        """Per-device prepared blocks, built IN PLACE by a sharded builder
+        (one jitted shard_map dispatch over the already-placed batch — no
+        host round trip of the symbols) and cached on the placed arrays'
+        identity like the single-device layouts.  Returns (prep, meta) —
+        meta keys the matching prep-aware estep's in_specs."""
+        if isinstance(chunks, jax.core.Tracer):
+            return None
+        engine = resolve_fb_engine(self.engine, params, self.mode)
+        if engine not in ("pallas", "onehot"):
+            return None
+        from cpgisland_tpu.ops import prepared as prep_mod
+
+        S = params.n_symbols
+        T = int(chunks.shape[1])
+        t_tile = fb_pallas.DEFAULT_T_TILE
+        onehot = engine == "onehot"
+        N_local = int(chunks.shape[0]) // self.mesh.shape[self.axis]
+        fkey = (S, N_local, T, t_tile, onehot)
+        if fkey not in self._prep_fn_cache:
+            self._prep_fn_cache[fkey] = prep_mod.sharded_chunked_builder(
+                self.mesh, self.axis, (P(self.axis), P(self.axis)),
+                S, N_local, T, t_tile, onehot,
+            )
+        builder = self._prep_fn_cache[fkey]
+        prep = prep_mod.cached_build(
+            "chunked-spmd", (chunks, lengths),
+            fkey + (str(self.mesh),),
+            lambda: builder(chunks, lengths),
+        )
+        return prep, fkey
 
     def prepare(self, chunked):
         if isinstance(chunked, chunking.Bucketed):
@@ -306,6 +422,10 @@ class SpmdBackend(EStepBackend):
 
     def __call__(self, params, chunks, lengths):
         self._check_divisible(chunks)
+        out = self._prepare_with_meta(params, chunks, lengths)
+        if out is not None:
+            prep, meta = out
+            return self._estep_for(params, meta)(params, chunks, lengths, prep)
         # Already-placed arrays (from place()) pass through; anything else is
         # resharded by jit according to the shard_map in_specs.
         return self._estep_for(params)(params, chunks, lengths)
@@ -316,6 +436,14 @@ class SpmdBackend(EStepBackend):
         # loop; the psum all-reduce runs inside each while_loop iteration,
         # so the multi-iteration program is still ONE dispatch per fit.
         return self._estep_for(params)
+
+    def fused_stats_with_prep(self, params, chunks, lengths):
+        self._check_divisible(chunks)
+        out = self._prepare_with_meta(params, chunks, lengths)
+        if out is None:
+            return self._estep_for(params), None
+        prep, meta = out
+        return self._estep_for(params, meta), prep
 
 
 def _check_seq_engine(engine: str) -> None:
@@ -411,10 +539,10 @@ def _seq_onehot(engine: str, params: HmmParams) -> bool:
 def _seq_single_stats_fn(lane_T: int, t_tile: int, onehot: bool):
     """Stable single-device whole-sequence stats fn (fused-EM cacheable)."""
 
-    def fn(params, obs_flat, lengths):
+    def fn(params, obs_flat, lengths, prepared=None):
         return fb_pallas.seq_stats_pallas(
             params, obs_flat, jnp.sum(lengths),
-            lane_T=lane_T, t_tile=t_tile, onehot=onehot,
+            lane_T=lane_T, t_tile=t_tile, onehot=onehot, prepared=prepared,
         )
 
     return fn
@@ -512,20 +640,10 @@ class SeqBackend(EStepBackend):
         # full 128-lane padded pass dwarfs tiny inputs) — an explicit
         # engine always wins.
         if _use_fused_seq(self.engine, params, obs_flat.shape[0] // n_dev):
-            oh = _seq_onehot(self.engine, params)
+            oh, lane_T = self._fused_geometry(params, obs_flat, n_dev)
             obs.engine_decision(
                 site="seq_backend", choice="onehot" if oh else "pallas",
                 requested=self.engine, n_dev=n_dev,
-            )
-            # 131072 lanes are safe only when the kernelized seq stats runs
-            # (power-of-two n_symbols — n_symbols is static shape info).
-            long_ok = oh and params.n_symbols & (params.n_symbols - 1) == 0
-            lane_T = (
-                self.lane_T
-                if self.lane_T is not None
-                else fb_pallas.pick_lane_T(
-                    obs_flat.shape[0] // n_dev, onehot=oh, long_lanes=long_ok
-                )
             )
             if n_dev == 1:
                 return _seq_single_stats_fn(lane_T, self.t_tile, oh)
@@ -537,11 +655,67 @@ class SeqBackend(EStepBackend):
         )
         return fb_sharded.sharded_stats_fn(self.mesh, self.block_size)
 
+    def _fused_geometry(self, params, obs_flat, n_dev):
+        """(onehot, lane_T) of the fused route — the ONE derivation shared
+        by the stats fn and prepare_streams so their geometries cannot
+        diverge."""
+        oh = _seq_onehot(self.engine, params)
+        # 131072 lanes are safe only when the kernelized seq stats runs
+        # (power-of-two n_symbols — n_symbols is static shape info).
+        long_ok = oh and params.n_symbols & (params.n_symbols - 1) == 0
+        lane_T = (
+            self.lane_T
+            if self.lane_T is not None
+            else fb_pallas.pick_lane_T(
+                obs_flat.shape[0] // n_dev, onehot=oh, long_lanes=long_ok
+            )
+        )
+        return oh, lane_T
+
+    def prepare_streams(self, params, obs_flat, lengths):
+        """Single-device PreparedSeq (the sharded seq paths keep inline
+        prep — their prev-symbol/boundary threading needs the mesh
+        collectives at build time)."""
+        if isinstance(obs_flat, jax.core.Tracer):
+            return None
+        n_dev = self.mesh.shape[self.axis]
+        if n_dev != 1 or getattr(obs_flat, "ndim", 1) != 1:
+            return None
+        if obs_flat.shape[0] % (n_dev * self.block_size) != 0:
+            return None
+        if not _use_fused_seq(self.engine, params, obs_flat.shape[0]):
+            return None
+        oh, lane_T = self._fused_geometry(params, obs_flat, n_dev)
+        from cpgisland_tpu.ops import prepared as prep_mod
+
+        # The prep key needs the concrete total length — one tiny scalar
+        # fetch, MEMOIZED on the placed lengths array's identity so the
+        # host-loop cadence pays the relay round trip once per placed
+        # input, not once per EM iteration (ledger-counted when it does).
+        length = prep_mod.cached_build(
+            "seq-length", (lengths,), (),
+            lambda: int(np.asarray(obs.note_fetch(lengths)).sum()),
+        )
+        return prep_mod.for_seq(
+            params.n_symbols, obs_flat, length, lane_T=lane_T,
+            t_tile=self.t_tile, onehot=oh,
+        )
+
     def __call__(self, params, obs_flat, lengths):
-        return self._stats_fn_for(params, obs_flat)(params, obs_flat, lengths)
+        fn = self._stats_fn_for(params, obs_flat)
+        prep = self.prepare_streams(params, obs_flat, lengths)
+        if prep is not None:
+            return fn(params, obs_flat, lengths, prepared=prep)
+        return fn(params, obs_flat, lengths)
 
     def fused_stats_fn(self, params, chunks, lengths):
         return self._stats_fn_for(params, chunks)
+
+    def fused_stats_with_prep(self, params, chunks, lengths):
+        return (
+            self._stats_fn_for(params, chunks),
+            self.prepare_streams(params, chunks, lengths),
+        )
 
 
 class Seq2DBackend(EStepBackend):
@@ -748,6 +922,71 @@ class Seq2DBackend(EStepBackend):
                 "lengths; run prepare() + place() first"
             )
         return self._group_stats_fn(params, self.mesh, chunks)
+
+    def _rows_prep_meta(self, params, chunks):
+        """(S, T, t_tile, onehot) when this (non-bucketed) input routes to
+        the whole-record-per-lane chunked fast path with a kernel engine —
+        the only seq2d route with a prepared form (the sequence-parallel
+        bodies' collective threading preps inline; bucketed groups keep
+        inline prep too)."""
+        if (
+            self.mesh is None
+            or isinstance(chunks, (tuple, jax.core.Tracer))
+            or getattr(chunks, "ndim", 0) != 2
+        ):
+            return None
+        sp = self.mesh.shape[self.seq_axis]
+        if not (sp == 1 and chunks.shape[1] <= SMALL_RECORD_ROWS_MAX):
+            return None
+        eng = resolve_fb_engine(self.engine, params, "rescaled")
+        if eng not in ("pallas", "onehot"):
+            return None
+        tt = self.t_tile if self.t_tile is not None else fb_pallas.DEFAULT_T_TILE
+        n_local = int(chunks.shape[0]) // self.mesh.shape[self.data_axis]
+        return (
+            params.n_symbols, n_local, int(chunks.shape[1]), tt,
+            eng == "onehot",
+        ), eng
+
+    def prepare_streams(self, params, chunks, lengths):
+        out = self._rows_prep_meta(params, chunks)
+        if out is None:
+            return None
+        (S, N_local, T, tt, onehot), _eng = out
+        from cpgisland_tpu.ops import prepared as prep_mod
+
+        da, sa = self.mesh.axis_names
+        fkey = (S, N_local, T, tt, onehot)
+        cache = getattr(self, "_prep_fn_cache", None)
+        if cache is None:
+            cache = self._prep_fn_cache = {}
+        if fkey not in cache:
+            cache[fkey] = prep_mod.sharded_chunked_builder(
+                self.mesh, da, (P(da, sa), P(da, sa)),
+                S, N_local, T, tt, onehot, lengths_2d=True,
+            )
+        builder = cache[fkey]
+        return prep_mod.cached_build(
+            "chunked-seq2d", (chunks, lengths),
+            fkey + (str(self.mesh),),
+            lambda: builder(chunks, lengths),
+        )
+
+    def fused_stats_with_prep(self, params, chunks, lengths):
+        out = self._rows_prep_meta(params, chunks)
+        if out is None:
+            return self.fused_stats_fn(params, chunks, lengths), None
+        prep = self.prepare_streams(params, chunks, lengths)
+        if prep is None:
+            return self.fused_stats_fn(params, chunks, lengths), None
+        meta, eng = out
+        obs.engine_decision(
+            site="seq2d_backend", choice=f"rows-chunked:{eng}",
+            requested=self.engine,
+        )
+        return fb_sharded.sharded_stats2d_rows_fn(
+            self.mesh, eng, meta[3], prep_meta=meta
+        ), prep
 
 
 def get_backend(

@@ -59,8 +59,25 @@ def mstep(params: HmmParams, stats: SuffStats) -> HmmParams:
     return HmmParams.from_probs(pi, A, B)
 
 
+@jax.jit
+def em_update(params: HmmParams, stats: SuffStats):
+    """Fused iteration epilogue: M-step normalize + convergence delta as ONE
+    compact section over model-sized tensors -> (new_params, delta).
+
+    The host loop previously dispatched the M-step and the max-abs-diff as
+    two separate programs per iteration (two relay round trips of launch
+    latency); inside the fused while_loop the same fusion keeps the whole
+    epilogue — normalize, probability reconstruction, delta reduction — in
+    registers between the E-step's one lane reduce and the convergence
+    test, with no intermediate HBM round trip of anything bigger than the
+    model.
+    """
+    new_params = mstep(params, stats)
+    return new_params, new_params.max_abs_diff(params)
+
+
 @functools.lru_cache(maxsize=32)
-def _fused_em_fn(stats_fn, num_iters: int):
+def _fused_em_fn(stats_fn, num_iters: int, with_prep: bool = False):
     """ONE compiled program running up to ``num_iters`` EM iterations.
 
     The host loop in :func:`fit` keeps the reference's one-job-per-iteration
@@ -75,21 +92,29 @@ def _fused_em_fn(stats_fn, num_iters: int):
     ~8-11 ms fixed in-graph cost per whole-sequence iteration (BASELINE.md)
     amortizes across the loop.
 
-    Cache key = (stats_fn identity, num_iters): backends return STABLE
-    routing callables (see EStepBackend.fused_stats_fn), so repeated fits
-    reuse the compiled loop; params/convergence are traced arguments.
+    Cache key = (stats_fn identity, num_iters, with_prep): backends return
+    STABLE routing callables (see EStepBackend.fused_stats_fn), so repeated
+    fits reuse the compiled loop; params/convergence/prepared are traced
+    arguments.  ``with_prep``: the stats fn takes the prepared symbol
+    streams (ops.prepared) as an explicit argument — resolved ONCE here,
+    outside the while_loop body, so no gather/one-hot/reshape of the
+    symbol stream executes per iteration (the ``em.body.invariant-free``
+    graftcheck contract traces exactly this program).
     """
 
-    def run(params, chunks, lengths, convergence):
+    def run(params, chunks, lengths, convergence, prepared):
         def cond(carry):
             it, _p, converged, _lls, _dls = carry
             return jnp.logical_and(it < num_iters, jnp.logical_not(converged))
 
         def body(carry):
             it, p, _, lls, dls = carry
-            stats = stats_fn(p, chunks, lengths)
-            new_p = mstep(p, stats)
-            delta = new_p.max_abs_diff(p)
+            stats = (
+                stats_fn(p, chunks, lengths, prepared=prepared)
+                if with_prep
+                else stats_fn(p, chunks, lengths)
+            )
+            new_p, delta = em_update(p, stats)
             lls = lls.at[it].set(stats.loglik.astype(jnp.float32))
             dls = dls.at[it].set(delta.astype(jnp.float32))
             return (it + jnp.int32(1), new_p, delta < convergence, lls, dls)
@@ -136,10 +161,11 @@ def _fit_fused(
     convergence: float,
     n_sym: float,
     metrics,
+    prepared=None,
 ) -> "FitResult":
     """Run the compiled K-iteration EM program and unpack its one fetch."""
     t0 = time.perf_counter()
-    fn = _fused_em_fn(stats_fn, num_iters)
+    fn = _fused_em_fn(stats_fn, num_iters, prepared is not None)
     with obs.span("em_fused", items=n_sym, unit="sym", max_iters=num_iters) as sp:
         out = fn(
             # The loop carry is f32 (mstep output dtype); cast the entry so
@@ -148,6 +174,7 @@ def _fit_fused(
             chunks,
             lengths,
             jnp.float32(convergence),
+            prepared,
         )
         # THE one blocking round trip of the whole loop (counted by the obs
         # ledger's device_get hook).
@@ -272,12 +299,17 @@ def fit(
         )
         # getattr: a duck-typed backend that never subclassed EStepBackend
         # simply keeps the host loop rather than crashing here.
+        prep_resolver = getattr(backend, "fused_stats_with_prep", None)
         fused_resolver = getattr(backend, "fused_stats_fn", None)
-        stats_fn = (
-            fused_resolver(params, chunks, lengths)
-            if blocked is None and fused_resolver is not None
-            else None
-        )
+        stats_fn, fused_prep = None, None
+        if blocked is None:
+            if prep_resolver is not None:
+                # Symbol-only stream prep resolves ONCE, against the placed
+                # arrays, and rides into the compiled loop as an explicit
+                # argument — zero per-iteration re-preparation.
+                stats_fn, fused_prep = prep_resolver(params, chunks, lengths)
+            elif fused_resolver is not None:
+                stats_fn = fused_resolver(params, chunks, lengths)
         if fuse is True and blocked is not None:
             raise ValueError(
                 f"fuse=True is incompatible with {blocked} (those need the "
@@ -300,6 +332,7 @@ def fit(
                     params, stats_fn, chunks, lengths,
                     num_iters=num_iters, convergence=convergence,
                     n_sym=float(getattr(chunked, "total", 0.0)), metrics=metrics,
+                    prepared=fused_prep,
                 )
             except (RuntimeError, FloatingPointError) as e:
                 # Fault-shaped failures only (XlaRuntimeError is a
@@ -364,12 +397,14 @@ def fit(
                         chunks, lengths = backend.place(chunked.chunks, chunked.lengths)
                         continue
                     raise
-            new_params = mstep(params, stats)
+            # Fused epilogue even on the host cadence: M-step + delta in one
+            # program (was two dispatches per iteration).
+            new_params, delta_dev = em_update(params, stats)
             # The float() materializations below are THE per-iteration host
             # sync of the reference cadence (one blocking round trip per MR
             # job); note_fetch makes the ledger see it, so a fused-vs-host
             # dispatch comparison reads straight off the obs summary.
-            delta = float(obs.note_fetch(new_params.max_abs_diff(params)))
+            delta = float(obs.note_fetch(delta_dev))
             ll = float(obs.note_fetch(stats.loglik))
         params = new_params
         logliks.append(ll)

@@ -264,7 +264,11 @@ def test_fused_em_spmd_backend(rng):
 def test_fused_em_ledger_dispatches_and_compiles(rng):
     """ACCEPTANCE (obs-ledger-asserted): 10 fused steady-state EM
     iterations compile once and pay <= 2 blocking dispatches, vs >= 10 on
-    the host loop — the latency contract the fused driver exists for."""
+    the host loop — the latency contract the fused driver exists for.
+    The prepared-streams half of the acceptance (zero stream
+    re-preparation in steady state) lives in
+    tests/test_prepared.py::test_fused_em_steady_state_zero_repreps, on
+    the reduced engine where a prepared form exists."""
     import jax.numpy as jnp
 
     from cpgisland_tpu import obs

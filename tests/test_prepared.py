@@ -1,0 +1,311 @@
+"""Prepared-stream caching (ops.prepared): parity, cache keys, dispatch.
+
+The contract under test (ISSUE 4 acceptance): prepared-vs-inline outputs
+are BIT-IDENTICAL on every engine (the builders are the same code the
+entries run inline), the identity-keyed cache invalidates on new arrays or
+new geometry, reusing one prepared object across posterior -> EM adds no
+fresh compiles, and the fused EM while_loop body contains no symbol-stream
+prep primitives (with the synthetic-violation proof that the detector
+actually detects).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cpgisland_tpu import obs as obs_mod
+from cpgisland_tpu.models import presets
+from cpgisland_tpu.ops import fb_pallas, prepared
+from cpgisland_tpu.ops.viterbi_onehot import decode_batch_flat, prepare_decode_flat
+from cpgisland_tpu.train import baum_welch
+from cpgisland_tpu.train.backends import LocalBackend
+from cpgisland_tpu.utils import chunking
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(7)
+
+
+@pytest.fixture
+def params():
+    return presets.durbin_cpg8()
+
+
+def _chunks(rng, n=8, t=1024):
+    chunks = jnp.asarray(rng.integers(0, 4, size=(n, t)).astype(np.uint8))
+    lengths = jnp.asarray(
+        rng.integers(t // 2, t + 1, size=n).astype(np.int32)
+    )
+    return chunks, lengths
+
+
+def _assert_tree_bitwise(a, b):
+    la, lb = jax.tree_util.tree_leaves(a), jax.tree_util.tree_leaves(b)
+    assert len(la) == len(lb)
+    for x, y in zip(la, lb):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+@pytest.mark.parametrize("onehot", [False, True])
+def test_chunked_prepared_vs_inline_bit_identity(rng, params, onehot):
+    chunks, lengths = _chunks(rng)
+    inline = fb_pallas.batch_stats_pallas(
+        params, chunks, lengths, t_tile=256, onehot=onehot
+    )
+    prep = prepared.for_chunked(4, chunks, lengths, t_tile=256, onehot=onehot)
+    with_prep = fb_pallas.batch_stats_pallas(
+        params, chunks, lengths, t_tile=256, onehot=onehot, prepared=prep
+    )
+    _assert_tree_bitwise(inline, with_prep)
+
+
+@pytest.mark.parametrize("onehot", [False, True])
+def test_seq_prepared_vs_inline_bit_identity(rng, params, onehot):
+    obs = jnp.asarray(rng.integers(0, 4, size=6000).astype(np.uint8))
+    kw = dict(lane_T=512, t_tile=256, onehot=onehot)
+    inline = fb_pallas.seq_stats_pallas(params, obs, 6000, **kw)
+    prep = prepared.for_seq(4, obs, 6000, **kw)
+    with_prep = fb_pallas.seq_stats_pallas(
+        params, obs, 6000, prepared=prep, **kw
+    )
+    _assert_tree_bitwise(inline, with_prep)
+
+
+@pytest.mark.parametrize("want_path", [False, True])
+def test_posterior_prepared_vs_inline(rng, params, want_path):
+    chunks, lengths = _chunks(rng, n=6, t=512)
+    mask = jnp.asarray((np.arange(8) < 4).astype(np.float32))
+    inline = fb_pallas.batch_posterior_pallas(
+        params, chunks, lengths, mask, t_tile=256, want_path=want_path,
+        onehot=True,
+    )
+    prep = prepared.for_chunked(4, chunks, lengths, t_tile=256, onehot=True)
+    with_prep = fb_pallas.batch_posterior_pallas(
+        params, chunks, lengths, mask, t_tile=256, want_path=want_path,
+        onehot=True, prepared=prep,
+    )
+    _assert_tree_bitwise(inline, with_prep)
+
+
+def test_seq_posterior_prepared_vs_inline(rng, params):
+    obs = jnp.asarray(rng.integers(0, 4, size=6000).astype(np.uint8))
+    mask = jnp.asarray((np.arange(8) < 4).astype(np.float32))
+    kw = dict(lane_T=512, t_tile=256, onehot=True)
+    c0, p0 = fb_pallas.seq_posterior_pallas(params, obs, 6000, mask, **kw)
+    prep = prepared.for_seq(4, obs, 6000, **kw)
+    c1, p1 = fb_pallas.seq_posterior_pallas(
+        params, obs, 6000, mask, prepared=prep, **kw
+    )
+    np.testing.assert_array_equal(np.asarray(c0), np.asarray(c1))
+    np.testing.assert_array_equal(np.asarray(p0), np.asarray(p1))
+
+
+def test_transfer_total_prepared_vs_inline(rng, params):
+    obs = jnp.asarray(rng.integers(0, 4, size=6000).astype(np.uint8))
+    kw = dict(lane_T=512, t_tile=256, onehot=True, first=True)
+    t0 = fb_pallas.seq_transfer_total_pallas(params, obs, 6000, **kw)
+    prep = prepared.for_seq(4, obs, 6000, lane_T=512, t_tile=256, onehot=True)
+    t1 = fb_pallas.seq_transfer_total_pallas(
+        params, obs, 6000, prepared=prep, **kw
+    )
+    np.testing.assert_array_equal(np.asarray(t0), np.asarray(t1))
+
+
+def test_decode_flat_prepared_vs_inline(rng, params):
+    chunks = jnp.asarray(rng.integers(0, 4, size=(4, 512)).astype(np.uint8))
+    lengths = jnp.full(4, 512, jnp.int32)
+    p0 = decode_batch_flat(params, chunks, lengths, block_size=256)
+    pre = prepare_decode_flat(4, chunks, lengths, block_size=256)
+    p1 = decode_batch_flat(
+        params, chunks, lengths, block_size=256, prepared=pre
+    )
+    np.testing.assert_array_equal(np.asarray(p0), np.asarray(p1))
+    # A stale prep (wrong block size or batch shape) must raise, not decode.
+    with pytest.raises(ValueError, match="rebuild"):
+        decode_batch_flat(
+            params, chunks, lengths, block_size=512, prepared=pre
+        )
+    with pytest.raises(ValueError, match="rebuild"):
+        decode_batch_flat(
+            params, chunks[:2], lengths[:2], block_size=256, prepared=pre
+        )
+
+
+def test_cache_hit_and_invalidation(rng):
+    prepared.clear_cache()
+    chunks, lengths = _chunks(rng)
+    p1 = prepared.for_chunked(4, chunks, lengths, t_tile=256, onehot=True)
+    assert prepared.cache_stats() == {"hits": 0, "misses": 1}
+    # Same arrays + geometry -> the SAME object (hit).
+    p2 = prepared.for_chunked(4, chunks, lengths, t_tile=256, onehot=True)
+    assert p2 is p1
+    assert prepared.cache_stats() == {"hits": 1, "misses": 1}
+    # New arrays (same content) -> miss: the key is placed-array identity.
+    chunks2 = jnp.asarray(np.asarray(chunks))
+    p3 = prepared.for_chunked(4, chunks2, lengths, t_tile=256, onehot=True)
+    assert p3 is not p1
+    assert prepared.cache_stats()["misses"] == 2
+    # New lane geometry -> miss even on the same arrays.
+    p4 = prepared.for_chunked(4, chunks, lengths, t_tile=512, onehot=True)
+    assert p4 is not p1 and p4.Tt != p1.Tt
+    assert prepared.cache_stats()["misses"] == 3
+
+
+def test_prepared_streams_event_emitted(rng, tmp_path):
+    prepared.clear_cache()
+    chunks, lengths = _chunks(rng, n=4, t=256)
+    path = str(tmp_path / "metrics.jsonl")
+    with obs_mod.observe(metrics=path):
+        prepared.for_chunked(4, chunks, lengths, t_tile=256, onehot=True)
+        prepared.for_chunked(4, chunks, lengths, t_tile=256, onehot=True)
+    import json
+
+    events = [
+        json.loads(line) for line in open(path)
+        if '"prepared_streams"' in line
+    ]
+    misses = [e for e in events if not e["hit"]]
+    hits = [e for e in events if e["hit"]]
+    assert len(misses) == 1 and len(hits) == 1
+    assert misses[0]["bytes_resident"] > 0
+    assert "prep_ms" in misses[0] and "key" in misses[0]
+
+
+def test_geometry_mismatch_raises(rng, params):
+    chunks, lengths = _chunks(rng)
+    prep = prepared.for_chunked(4, chunks, lengths, t_tile=256, onehot=False)
+    with pytest.raises(ValueError, match="rebuild"):
+        fb_pallas.batch_stats_pallas(
+            params, chunks, lengths, t_tile=512, onehot=False, prepared=prep
+        )
+    with pytest.raises(ValueError, match="onehot"):
+        fb_pallas.batch_stats_pallas(
+            params, chunks, lengths, t_tile=256, onehot=True, prepared=prep
+        )
+
+
+def test_no_new_compiles_across_posterior_then_em(rng, params):
+    """Reusing ONE prepared object across posterior -> EM on the same batch
+    adds no fresh compiles once each entry is warm (the pipeline-reuse
+    acceptance: same prep, new params, steady dispatch surface)."""
+    chunks, lengths = _chunks(rng, n=4, t=512)
+    mask = jnp.asarray((np.arange(8) < 4).astype(np.float32))
+    prep = prepared.for_chunked(4, chunks, lengths, t_tile=256, onehot=True)
+    # Warm both entries with the shared prep.
+    jax.block_until_ready(
+        fb_pallas.batch_posterior_pallas(
+            params, chunks, lengths, mask, t_tile=256, onehot=True,
+            prepared=prep,
+        )
+    )
+    jax.block_until_ready(
+        fb_pallas.batch_stats_pallas(
+            params, chunks, lengths, t_tile=256, onehot=True, prepared=prep
+        )
+    )
+    # New params (an M-step away), same prep: no recompiles anywhere.
+    stats = fb_pallas.batch_stats_pallas(
+        params, chunks, lengths, t_tile=256, onehot=True, prepared=prep
+    )
+    params2 = baum_welch.mstep(params, stats)
+    with obs_mod.no_new_compiles("prepared-posterior-em-reuse"):
+        jax.block_until_ready(
+            fb_pallas.batch_posterior_pallas(
+                params2, chunks, lengths, mask, t_tile=256, onehot=True,
+                prepared=prep,
+            )
+        )
+        jax.block_until_ready(
+            fb_pallas.batch_stats_pallas(
+                params2, chunks, lengths, t_tile=256, onehot=True,
+                prepared=prep,
+            )
+        )
+
+
+def _chunked_input(rng, n=8, t=1024):
+    raw = chunking.frame(
+        rng.integers(0, 4, size=n * t).astype(np.uint8), t
+    )
+    return chunking.Chunked(
+        chunks=jnp.asarray(raw.chunks), lengths=jnp.asarray(raw.lengths),
+        total=raw.total,
+    )
+
+
+def test_fused_em_prepared_matches_host_loop(rng, params):
+    """The prepared-aware fused loop reproduces the host loop bit-for-bit
+    on the reduced engine (trajectories, final model)."""
+    ck = _chunked_input(rng)
+    host = baum_welch.fit(
+        params, ck, num_iters=4, convergence=0.0,
+        backend=LocalBackend(engine="onehot"), fuse=False,
+    )
+    fused = baum_welch.fit(
+        params, ck, num_iters=4, convergence=0.0,
+        backend=LocalBackend(engine="onehot"), fuse=True,
+    )
+    assert fused.iterations == host.iterations == 4
+    np.testing.assert_allclose(fused.logliks, host.logliks, rtol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(fused.params.A), np.asarray(host.params.A), atol=1e-5
+    )
+
+
+def test_fused_em_steady_state_zero_repreps(rng, params):
+    """LEDGER ACCEPTANCE (extended): steady-state fused EM = 1 blocking
+    dispatch + ZERO stream re-preparations — the second fit on the same
+    placed input hits the prep cache (0 misses) and recompiles nothing."""
+    ck = _chunked_input(rng)
+    backend = LocalBackend(engine="onehot")
+
+    def fit():
+        return baum_welch.fit(
+            params, ck, num_iters=5, convergence=0.0, backend=backend,
+            fuse=True,
+        )
+
+    fit()  # warm: compiles the loop, builds the prep (a miss)
+    before = prepared.cache_stats()
+    with obs_mod.observe() as ob:
+        snap = ob.ledger.snapshot()
+        with obs_mod.no_new_compiles("fused-em-prep-steady"):
+            fit()
+        delta = ob.ledger.delta(snap)
+    after = prepared.cache_stats()
+    assert after["misses"] == before["misses"], (before, after)
+    assert after["hits"] > before["hits"]
+    assert delta["dispatches"] <= 2, delta
+
+
+def test_em_body_contract_and_synthetic_violation(rng, params):
+    """The em.body.invariant-free detector: clean on the prepared loop,
+    and PROVEN on the synthetic violation (the inline-prep loop body must
+    show the forward-fill marker primitives)."""
+    from cpgisland_tpu.analysis import contracts
+
+    res = contracts._em_body_contract()
+    assert res.ok, res.violations
+    assert res.notes["inline_markers"] == ["cummax"]
+
+    # Synthetic violation, explicitly: trace the UNprepared loop and run
+    # the detector by hand — the markers must be inside the while body.
+    chunks, lengths = _chunks(rng)
+    backend = LocalBackend(engine="onehot")
+    stats_fn, prep = backend.fused_stats_with_prep(params, chunks, lengths)
+    assert prep is not None
+    fn0 = baum_welch._fused_em_fn(stats_fn, 2, False)
+    closed0 = jax.make_jaxpr(fn0)(
+        params.astype(jnp.float32), chunks, lengths, jnp.float32(0.0), None
+    )
+    body0 = contracts.while_body_prims(closed0)
+    assert set(body0) & contracts.PREP_MARKER_PRIMS == {"cummax"}
+    # And the prepared twin is clean.
+    fn1 = baum_welch._fused_em_fn(stats_fn, 2, True)
+    closed1 = jax.make_jaxpr(fn1)(
+        params.astype(jnp.float32), chunks, lengths, jnp.float32(0.0), prep
+    )
+    body1 = contracts.while_body_prims(closed1)
+    assert not set(body1) & contracts.PREP_MARKER_PRIMS

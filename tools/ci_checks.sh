@@ -9,6 +9,8 @@ echo "== graftcheck: AST lint (TPU invariants) =="
 python -m cpgisland_tpu.analysis cpgisland_tpu/
 
 echo "== graftcheck: jaxpr contract pass (CPU trace) =="
+# Includes em.body.invariant-free: the fused EM while-body must contain no
+# symbol-stream prep primitives (prepared streams resolved outside the loop).
 python -m cpgisland_tpu.analysis --no-lint --contracts
 
 echo "== syntax gate =="
@@ -35,5 +37,8 @@ fi
 echo "== tier-1 smoke =="
 python -m pytest tests/test_graftcheck.py tests/test_graftcheck_self.py \
   tests/test_hmm.py tests/test_viterbi.py -q
+
+echo "== prepared-streams smoke (parity + cache + zero-reprep ledger) =="
+python -m pytest tests/test_prepared.py -q
 
 echo "ci_checks: all gates green"
