@@ -42,6 +42,12 @@ HOT_PATHS: dict[str, frozenset[str]] = {
         "posterior_file",
         "decode_file",
     }),
+    # The dispatch supervisor wraps every supervised serving fetch: a host
+    # sync written INSIDE it would silently multiply under retries, so any
+    # future sync there must route through obs.note_fetch (no unledgered
+    # retries) or carry a waiver.
+    "resilience/policy.py": frozenset({"run", "supervise"}),
+    "resilience/sentinel.py": frozenset({"verify", "_canary_value"}),
 }
 
 

@@ -146,6 +146,7 @@ def build_parser() -> argparse.ArgumentParser:
     t.add_argument("--init-model", help="start from a model text file instead of the Durbin preset")
     t.add_argument("--checkpoint-dir")
     _add_em_fuse_flag(t)
+    _add_invalid_symbols_flag(t)
     _common_flags(t)
 
     d = sub.add_parser("decode", help="Viterbi decode + island calling")
@@ -163,6 +164,8 @@ def build_parser() -> argparse.ArgumentParser:
     _add_island_cap_flag(d)
     _add_island_states_flag(d)
     _add_prefetch_flag(d)
+    _add_invalid_symbols_flag(d)
+    _add_resilience_flags(d)
     _common_flags(d)
 
     po = sub.add_parser(
@@ -200,6 +203,8 @@ def build_parser() -> argparse.ArgumentParser:
     _add_island_cap_flag(po)
     _add_island_states_flag(po)
     _add_prefetch_flag(po)
+    _add_invalid_symbols_flag(po)
+    _add_resilience_flags(po)
     # Only the flags posterior honors (it is always clean/FASTA-aware) — NOT
     # _common_flags, whose --backend/--numerics/--clean would be silently
     # ignored here.
@@ -268,6 +273,46 @@ def _add_prefetch_flag(p: argparse.ArgumentParser) -> None:
         "additionally defers call-column fetches until the next dispatch "
         "is in flight.  0 (default) = strictly serial; results are "
         "bit-identical either way",
+    )
+
+
+def _add_invalid_symbols_flag(p: argparse.ArgumentParser) -> None:
+    p.add_argument(
+        "--invalid-symbols",
+        choices=("skip", "mask", "fail"),
+        default="skip",
+        help="clean mode: what to do with bytes that are neither bases nor "
+        "whitespace (N runs, ambiguity codes...). skip drops them (the "
+        "reference's behavior), mask encodes them as the PAD sentinel "
+        "(identity DP step — island coordinates then match the original "
+        "FASTA), fail aborts on the first one (Hadoop's "
+        "skip-bad-records-off default). Counts surface as obs events",
+    )
+
+
+def _add_resilience_flags(p: argparse.ArgumentParser) -> None:
+    p.add_argument(
+        "--integrity-check",
+        action="store_true",
+        help="verify every supervised device dispatch with a canary fetch "
+        "(distinct seed fold) + plausibility ceilings, re-dispatching on a "
+        "phantom/stale result — bench.py's relay defenses as a production "
+        "guard; costs one tiny extra round trip per dispatch",
+    )
+    p.add_argument(
+        "--resume",
+        action="store_true",
+        help="clean mode: write a per-record completion manifest "
+        "(<islands-out>.manifest.jsonl unless --manifest names one) and "
+        "skip records it already marks complete — a killed run resumes "
+        "with byte-identical final output. Incompatible with per-symbol "
+        "stream outputs",
+    )
+    p.add_argument(
+        "--manifest",
+        metavar="PATH",
+        help="explicit manifest path for --resume (also enables manifest "
+        "WRITING without resuming when given alone)",
     )
 
 
@@ -383,6 +428,7 @@ def _run_command(args, compat, pipeline, presets, load_text, observer=None) -> i
             symbol_cache=args.symbol_cache,
             metrics=metrics,
             fuse=args.em_fuse,
+            invalid_symbols=args.invalid_symbols,
         )
         print(
             f"trained: iters={res.iterations} converged={res.converged} "
@@ -397,6 +443,15 @@ def _run_command(args, compat, pipeline, presets, load_text, observer=None) -> i
             build_parser().error(
                 "--prefetch streams FASTA records and requires --clean "
                 "(the compat path encodes the whole file up front)"
+            )
+        if (args.resume or args.manifest) and compat:
+            build_parser().error(
+                "--resume manifests are per-record and require --clean"
+            )
+        if args.invalid_symbols != "skip" and compat:
+            build_parser().error(
+                "--invalid-symbols mask|fail requires --clean (compat "
+                "reproduces the reference's skip-everything encode)"
             )
         island_states = _parse_island_states(build_parser(), args, compat)
         params = load_text(args.model) if args.model else _preset_params(presets, args.preset)
@@ -413,6 +468,10 @@ def _run_command(args, compat, pipeline, presets, load_text, observer=None) -> i
             symbol_cache=args.symbol_cache,
             metrics=metrics,
             prefetch=args.prefetch,
+            integrity_check=args.integrity_check,
+            resume=args.resume,
+            manifest_path=args.manifest,
+            invalid_symbols=args.invalid_symbols,
         )
         print(f"decoded {res.n_symbols} symbols in {res.n_chunks} chunks; {len(res.calls)} islands")
         return 0
@@ -420,6 +479,14 @@ def _run_command(args, compat, pipeline, presets, load_text, observer=None) -> i
     if args.cmd == "posterior":
         if args.min_len is not None and not args.islands_out:
             build_parser().error("--min-len only applies with --islands-out")
+        if (args.resume or args.manifest) and (
+            args.confidence_out or args.mpm_path_out or not args.islands_out
+        ):
+            build_parser().error(
+                "--resume needs an island-only run: --islands-out without "
+                "--confidence-out/--mpm-path-out (per-symbol streams are "
+                "not resumable)"
+            )
         if not (args.confidence_out or args.mpm_path_out or args.islands_out):
             build_parser().error(
                 "nothing to do: pass --confidence-out, --mpm-path-out, "
@@ -445,6 +512,10 @@ def _run_command(args, compat, pipeline, presets, load_text, observer=None) -> i
             symbol_cache=args.symbol_cache,
             metrics=metrics,
             prefetch=args.prefetch,
+            integrity_check=args.integrity_check,
+            resume=args.resume,
+            manifest_path=args.manifest,
+            invalid_symbols=args.invalid_symbols,
         )
         extra = (
             f"; {len(res.calls)} islands -> {args.islands_out}"

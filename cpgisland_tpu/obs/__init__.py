@@ -17,6 +17,14 @@ whole stack reports through:
   cap-overflow retry reports through :func:`event`, so a run's routing is
   reconstructable from its metrics stream.
 
+The resilience layer (``cpgisland_tpu/resilience/``) reports through the
+same stream: ``dispatch_fault`` / ``dispatch_slow`` (one per supervised
+attempt — no unledgered retries), ``engine_degraded`` / ``engine_restored``
+(circuit-breaker trips and recoveries, plus ``*.breaker_demotion``
+engine-decision events at routing time), ``integrity_violation`` (phantom
+sentinel detections), ``manifest_resume`` (records replayed from a resume
+manifest), and ``invalid_symbols`` (codec policy counts).
+
 **Off by default, zero device cost.**  Library call sites use the
 module-level :func:`span` / :func:`event` / :func:`note_fetch` /
 :func:`note_upload` helpers, which are no-ops (one global ``None`` check)

@@ -479,9 +479,30 @@ def _fetch_calls(
     for ~3 MB of data."""
     starts, lasts, length, c_cnt, g_cnt, cg_cnt, n = _cols_to_host(cols)
     n = int(n)
+    if n < 0:
+        # A negative count cannot come from the reduction (the cursor only
+        # increments) — it means the fetch returned corrupt/stale buffers
+        # (the degraded relay's phantom mode, CLAUDE.md).  RuntimeError on
+        # purpose: fault-shaped, so the dispatch supervisor re-dispatches
+        # instead of treating it as a sizing signal.
+        raise RuntimeError(
+            f"corrupt island-call columns: negative call count {n} "
+            "(stale/phantom device fetch?)"
+        )
     if n > cap:
         raise IslandCapOverflow(n, cap)
     sl = slice(0, n)
+    if n and (
+        np.any(np.asarray(length[sl]) <= 0)
+        or np.any(np.asarray(starts[sl]) < 0)
+    ):
+        # Same reasoning: every emitted run has length >= 1 and a
+        # non-negative start by construction — anything else is a corrupt
+        # fetch, not a result.
+        raise RuntimeError(
+            "corrupt island-call columns: non-positive lengths or negative "
+            "starts (stale/phantom device fetch?)"
+        )
     starts = starts[sl].astype(np.int64)
     lasts = lasts[sl].astype(np.int64)
     length = length[sl].astype(np.int64)

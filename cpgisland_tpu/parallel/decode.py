@@ -28,6 +28,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from cpgisland_tpu import obs as obs_mod
+from cpgisland_tpu import resilience
 from cpgisland_tpu.models.hmm import HmmParams
 from cpgisland_tpu.ops import viterbi_onehot, viterbi_pallas
 from cpgisland_tpu.ops.viterbi_parallel import (
@@ -44,12 +45,29 @@ from cpgisland_tpu.ops.viterbi_parallel import (
 from cpgisland_tpu.parallel.mesh import SEQ_AXIS, fetch_sharded_prefix, make_mesh
 
 
+def decode_engine_twin(engine: str, params: HmmParams) -> Optional[str]:
+    """Next rung of the decode engines' parity-twin ladder
+    (resilience.breaker.kernel_ladder with the DECODE eligibility: Pallas
+    needs TPU + the 3-bit backpointer packing).  Results stay exact across
+    a demotion because the twins are parity-pinned (PARITY.md C10)."""
+    from cpgisland_tpu.resilience.breaker import kernel_ladder
+
+    return kernel_ladder(
+        jax.default_backend() == "tpu" and viterbi_pallas.supports(params)
+    )(engine)
+
+
 def resolve_engine(engine: str, params: HmmParams) -> str:
     """'auto' picks the reduced one-hot kernels on TPU when the model's
     emission structure supports them (ops.viterbi_onehot — the flagship
     8-state model does), else the dense Pallas kernels when the model fits
     their 3-bit backpointer packing, else the XLA scans (incl. the CPU test
-    mesh, where Pallas would run interpreted)."""
+    mesh, where Pallas would run interpreted).  Under 'auto', engines
+    tripped by the resilience breaker (repeated dispatch faults) demote
+    down the parity-twin ladder for the cooldown window; an EXPLICIT
+    engine request is honored as-is — silently swapping a named engine
+    would mislabel bench/parity measurements that exist to certify that
+    specific lowering."""
     if engine == "auto":
         resolved = "xla"
         if jax.default_backend() == "tpu":
@@ -60,7 +78,9 @@ def resolve_engine(engine: str, params: HmmParams) -> str:
         obs_mod.engine_decision(
             site="decode.resolve_engine", choice=resolved, requested=engine
         )
-        return resolved
+        return resilience.get_breaker().degrade(
+            "decode", resolved, lambda e: decode_engine_twin(e, params)
+        )
     if engine not in ("xla", "pallas", "onehot"):
         raise ValueError(f"unknown engine {engine!r}; expected auto|xla|pallas|onehot")
     if engine == "pallas" and not viterbi_pallas.supports(params):
@@ -282,6 +302,7 @@ def viterbi_sharded(
     block_size: int = DEFAULT_BLOCK,
     engine: str = "auto",
     return_device: bool = False,
+    supervisor: Optional[resilience.DispatchSupervisor] = None,
 ):
     """Decode one long sequence sharded over a mesh's devices.
 
@@ -290,9 +311,16 @@ def viterbi_sharded(
     as host ndarray, or as a device-resident array with ``return_device=True``
     (so a fused consumer — e.g. the device island caller — avoids the
     4 B/symbol device->host transfer entirely).
+
+    The dispatch+fetch unit runs under the resilience supervisor (bounded
+    retries of fault-shaped errors; jit dispatch is pure, so re-running the
+    unit is always safe).  With ``return_device=True`` nothing blocks here
+    — the supervised blocking point is then the caller's (the pipeline's
+    record units).
     """
     if mesh is None:
         mesh = make_mesh(axis=SEQ_AXIS)
+    sup = supervisor if supervisor is not None else resilience.default_supervisor()
     obs = np.asarray(obs)
     T = obs.shape[0]
     eng = _engine_for_record(resolve_engine(engine, params), obs, params)
@@ -301,9 +329,20 @@ def viterbi_sharded(
     # Positional args throughout: lru_cache keys positional vs keyword calls
     # differently, and a mixed style would compile the same fn twice.
     fn = _sharded_fn(mesh, block_size, eng, False)
-    path, _ = fn(params, arr, jnp.zeros(params.n_states, jnp.float32),
-                 jnp.int32(-1), prev0)
-    return _fetch_path(path, T, return_device)
+
+    def unit():
+        path, _ = fn(params, arr, jnp.zeros(params.n_states, jnp.float32),
+                     jnp.int32(-1), prev0)
+        return _fetch_path(path, T, return_device)
+
+    # items gates the sentinel's throughput ceiling and must only be set on
+    # units that BLOCK internally: with return_device=True this unit is an
+    # async dispatch (the lazy [:T] slice), so items/dt would be a
+    # nonsense ~dispatch-latency rate that flags every healthy run.
+    return sup.run(
+        unit, what="decode.record", engine=f"decode.{eng}",
+        items=0.0 if return_device else float(T),
+    )
 
 
 def _place_span(mesh: Mesh, piece: np.ndarray, block_size: int, pad_sym: int):
@@ -332,6 +371,7 @@ def viterbi_sharded_spans(
     engine: str = "auto",
     return_device: bool = False,
     prefetch: bool = False,
+    supervisor: Optional[resilience.DispatchSupervisor] = None,
 ):
     """EXACT decode of a sequence longer than one pass's device-memory budget.
 
@@ -361,6 +401,7 @@ def viterbi_sharded_spans(
     """
     if mesh is None:
         mesh = make_mesh(axis=SEQ_AXIS)
+    sup = supervisor if supervisor is not None else resilience.default_supervisor()
     obs = np.asarray(obs)
     eng = _engine_for_record(resolve_engine(engine, params), obs, params)
     T = obs.shape[0]
@@ -368,7 +409,7 @@ def viterbi_sharded_spans(
         return [
             viterbi_sharded(
                 params, obs, mesh=mesh, block_size=block_size, engine=eng,
-                return_device=return_device,
+                return_device=return_device, supervisor=sup,
             )
         ]
     pad_sym = params.n_symbols
@@ -417,16 +458,27 @@ def viterbi_sharded_spans(
     for s in range(n_spans - 1):
         if s not in placed:
             placed[s] = place(s)
-        total_dev = _span_total_fn(mesh, block_size, eng, s > 0)(
-            params, placed[s], span_prev0(s)
+
+        def total_unit(s=s):
+            # Supervised dispatch+fetch: a retry re-runs the span's products
+            # sweep on its (still-placed) symbols, so a transient fault or
+            # phantom costs one span, never the record.
+            total_dev = _span_total_fn(mesh, block_size, eng, s > 0)(
+                params, placed[s], span_prev0(s)
+            )
+            if prefetch and s + 1 not in placed:
+                # Overlap: span s+1's upload is in flight while the device
+                # runs span s's products sweep (total_dev is an async
+                # dispatch; the np.asarray below is the blocking point).
+                # This also pre-places the tail span, which sweep B
+                # otherwise uploads serially.
+                placed[s + 1] = place(s + 1)
+            return obs_mod.note_fetch(np.asarray(total_dev))
+
+        total = sup.run(
+            total_unit, what="decode.span_total", engine=f"decode.{eng}",
+            items=float(span),
         )
-        if prefetch:
-            # Overlap: span s+1's upload is in flight while the device runs
-            # span s's products sweep (total_dev is an async dispatch; the
-            # np.asarray below is the blocking point).  This also pre-places
-            # the tail span, which sweep B otherwise uploads serially.
-            placed[s + 1] = place(s + 1)
-        total = obs_mod.note_fetch(np.asarray(total_dev))
         v = (enters[-1][:, None] + total).max(axis=0)
         enters.append((v - v.max()).astype(np.float32))
 
@@ -435,15 +487,24 @@ def viterbi_sharded_spans(
     paths: list = [None] * n_spans
     anchor = -1  # last span: local argmax
     for s in reversed(range(n_spans)):
-        arr = placed.pop(s, None)
+        arr = placed.get(s)
         if arr is None:  # the tail span — sweep A never placed it
             arr = place(s)
+            placed[s] = arr
         fn = _sharded_fn(mesh, block_size, eng, s > 0)
-        path, prev_exit = fn(
-            params, arr, jnp.asarray(enters[s]), jnp.int32(anchor),
-            span_prev0(s)
+
+        def span_unit(s=s, arr=arr, fn=fn, anchor=anchor):
+            path, prev_exit = fn(
+                params, arr, jnp.asarray(enters[s]), jnp.int32(anchor),
+                span_prev0(s)
+            )
+            # graftcheck: allow(hot-path-host-sync) -- anchor threading between spans is inherently serial (one scalar per span); counted by the obs ledger's device_get hook
+            a = int(jax.device_get(prev_exit))
+            return a, _fetch_path(path, min(span, T - s * span), return_device)
+
+        anchor, paths[s] = sup.run(
+            span_unit, what="decode.span", engine=f"decode.{eng}",
+            items=float(min(span, T - s * span)),
         )
-        # graftcheck: allow(hot-path-host-sync) -- anchor threading between spans is inherently serial (one scalar per span); counted by the obs ledger's device_get hook
-        anchor = int(jax.device_get(prev_exit))
-        paths[s] = _fetch_path(path, min(span, T - s * span), return_device)
+        placed.pop(s, None)
     return paths

@@ -46,11 +46,27 @@ from cpgisland_tpu.parallel.mesh import (
 _HI = jax.lax.Precision.HIGHEST
 
 
+def fb_engine_twin(engine: str, params: HmmParams) -> Optional[str]:
+    """Next rung of the FB engines' parity-twin ladder
+    (resilience.breaker.kernel_ladder with the FB eligibility).  The twins
+    are parity-pinned (2e-5 posterior parity, tests/test_fb_onehot.py /
+    test_fb_pallas.py)."""
+    from cpgisland_tpu.resilience.breaker import kernel_ladder
+
+    return kernel_ladder(
+        jax.default_backend() == "tpu" and fb_pallas.supports(params)
+    )(engine)
+
+
 def resolve_fb_engine(engine: str, params: HmmParams) -> str:
     """'auto' picks the reduced one-hot FB kernels on TPU when the model's
     emission structure supports them (ops.fb_onehot — the flagship 8-state
     preset does), else the dense fused kernels when the model fits their
-    lane packing, else the XLA lane path (incl. the CPU test mesh)."""
+    lane packing, else the XLA lane path (incl. the CPU test mesh).  Under
+    'auto', engines tripped by the resilience breaker demote down the
+    parity-twin ladder for the cooldown window; explicit requests are
+    honored as-is (see parallel.decode.resolve_engine)."""
+    from cpgisland_tpu import resilience
     from cpgisland_tpu.ops import fb_onehot
 
     if engine == "auto":
@@ -60,7 +76,9 @@ def resolve_fb_engine(engine: str, params: HmmParams) -> str:
         obs_module.engine_decision(
             site="posterior.resolve_fb_engine", choice=resolved, requested=engine
         )
-        return resolved
+        return resilience.get_breaker().degrade(
+            "fb", resolved, lambda e: fb_engine_twin(e, params)
+        )
     if engine not in ("xla", "pallas", "onehot"):
         raise ValueError(
             f"unknown engine {engine!r}; expected auto|xla|pallas|onehot"

@@ -21,6 +21,7 @@ from __future__ import annotations
 import dataclasses
 import functools
 import logging
+import os
 from dataclasses import dataclass
 from typing import IO, Optional, Union
 
@@ -29,6 +30,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from cpgisland_tpu import obs
+from cpgisland_tpu import resilience
 from cpgisland_tpu.models import presets
 from cpgisland_tpu.models.hmm import HmmParams, dump_text
 from cpgisland_tpu.ops import islands as islands_mod
@@ -78,8 +80,13 @@ def train_file(
     symbol_cache: Optional[str] = None,
     metrics: Optional[profiling.MetricsLogger] = None,
     fuse: Union[bool, str] = "auto",
+    invalid_symbols: str = "skip",
 ) -> baum_welch.FitResult:
     """Train the CpG HMM on a sequence file (reference ``trainModel``).
+
+    ``invalid_symbols``: the codec's skip/mask/fail policy for non-base,
+    non-whitespace bytes (clean mode; 'skip' = reference semantics; counts
+    surface as ``invalid_symbols`` obs events under mask/fail).
 
     ``fuse``: EM loop execution (see :func:`baum_welch.fit`) — "auto" runs
     every iteration inside one compiled program with the convergence test
@@ -110,9 +117,11 @@ def train_file(
         params = presets.durbin_cpg8()
     if symbol_cache is not None and compat:
         raise ValueError("symbol_cache is FASTA-aware — use compat=False (--clean)")
+    _check_invalid_symbols(invalid_symbols, compat)
     with obs.span("encode", unit="sym") as _enc_span:
         chunked = _train_input(
-            training_path, params, backend, compat, chunk_size, symbol_cache
+            training_path, params, backend, compat, chunk_size, symbol_cache,
+            invalid_symbols,
         )
         if _enc_span is not None:
             _enc_span.items = float(chunked.total)
@@ -140,6 +149,7 @@ def _train_input(
     compat: bool,
     chunk_size: int,
     symbol_cache: Optional[str],
+    invalid_symbols: str = "skip",
 ):
     """Build train_file's chunked input (encode + frame/bucket/shard) —
     a Chunked, Bucketed, or LocalShard depending on backend/topology."""
@@ -159,7 +169,7 @@ def _train_input(
                 (
                     s
                     for _, s in codec.iter_fasta_records_cached(
-                        training_path, symbol_cache
+                        training_path, symbol_cache, invalid=invalid_symbols
                     )
                 ),
                 pad_value=params.n_symbols,
@@ -175,6 +185,12 @@ def _train_input(
     elif _spmd_data_axis_size(backend) is not None and not compat and (
         jax.process_count() > 1
     ):
+        if invalid_symbols != "skip":
+            raise ValueError(
+                "invalid_symbols mask|fail is not supported on the "
+                "byte-range sharded (multi-process spmd) encode path yet — "
+                "use the default 'skip' policy there"
+            )
         # Pod job: byte-range sharded encode — this host parses only its
         # ~1/P of the file and assembles only its own rows (see docstring).
         chunked = chunking.distributed_chunked(
@@ -190,7 +206,8 @@ def _train_input(
         )
     else:
         symbols = codec.encode_file_cached(
-            training_path, symbol_cache, skip_headers=not compat
+            training_path, symbol_cache, skip_headers=not compat,
+            invalid=invalid_symbols,
         )
         log.info("training input: %d symbols", symbols.size)
         chunked = chunking.frame(symbols, chunk_size, drop_remainder=compat)
@@ -215,6 +232,76 @@ def island_layout_error(params: HmmParams, island_states=None) -> Optional[str]:
             "observation-based caller"
         )
     return None
+
+
+def _check_invalid_symbols(invalid_symbols: str, compat: bool) -> None:
+    """Shared validation of the codec policy flag: compat mode owes the
+    reference byte-fidelity (silently skip every non-base char), so only
+    clean mode may opt into mask/fail semantics."""
+    from cpgisland_tpu.utils.codec import INVALID_POLICIES
+
+    if invalid_symbols not in INVALID_POLICIES:
+        raise ValueError(
+            f"invalid_symbols must be one of {INVALID_POLICIES}, got "
+            f"{invalid_symbols!r}"
+        )
+    if invalid_symbols != "skip" and compat:
+        raise ValueError(
+            "invalid-symbol policies other than 'skip' need clean mode "
+            "(compat reproduces the reference's skip-everything encode)"
+        )
+
+
+def _open_manifest(
+    mode: str,
+    test_path: str,
+    params: HmmParams,
+    *,
+    resume: bool,
+    manifest_path: Optional[str],
+    islands_out,
+    compat: bool,
+    per_symbol_outputs: tuple = (),
+    config: Optional[dict] = None,
+):
+    """Build the run's resume manifest (or None when neither ``resume`` nor
+    ``manifest_path`` asked for one) — the shared decode/posterior policy.
+
+    Manifests are per-record, so they need clean mode, an ``islands_out``
+    path to anchor the default manifest name, and no per-symbol stream
+    outputs (those cannot be reconstructed record-by-record)."""
+    if not resume and manifest_path is None:
+        return None
+    if compat:
+        raise ValueError(
+            "resume manifests are per-record; compat mode has no records — "
+            "use compat=False (--clean)"
+        )
+    for flag, val in per_symbol_outputs:
+        if val is not None:
+            raise ValueError(
+                f"resume manifests cannot reproduce per-symbol streams; "
+                f"drop {flag} or run without resume/manifest"
+            )
+    mpath = manifest_path
+    if mpath is None:
+        if not isinstance(islands_out, str):
+            raise ValueError(
+                "resume needs islands_out as a file path (the manifest "
+                "defaults to '<islands_out>.manifest.jsonl') or an explicit "
+                "manifest_path"
+            )
+        mpath = islands_out + ".manifest.jsonl"
+    from cpgisland_tpu.resilience import manifest as manifest_mod
+
+    header = {
+        "mode": mode,
+        "source": os.path.abspath(test_path),
+        **manifest_mod.source_fingerprint(test_path),
+        "params": manifest_mod.params_digest(params),
+        **(config or {}),
+    }
+    return manifest_mod.RunManifest(mpath, header=header, resume=resume)
 
 
 @dataclass
@@ -258,9 +345,28 @@ def decode_file(
     metrics: Optional[profiling.MetricsLogger] = None,
     timer: Optional[profiling.PhaseTimer] = None,
     prefetch: int = 0,
+    integrity_check: bool = False,
+    resume: bool = False,
+    manifest_path: Optional[str] = None,
+    invalid_symbols: str = "skip",
 ) -> DecodeResult:
     """Viterbi-decode a sequence file and call CpG islands (reference
     ``testModel``).
+
+    Resilience (the serving-side fault-tolerance layer, ``resilience/``):
+    every blocking decode/island fetch runs under a dispatch supervisor
+    (bounded retries with backoff on fault-shaped errors; deferred fetches
+    carry a serial recompute fallback), repeated engine faults trip the
+    degradation ladder to the parity twins, and ``integrity_check=True``
+    adds the phantom-result sentinel (a canary fetch with a distinct seed
+    fold per supervised dispatch — one extra tiny round trip each, hence
+    opt-in).  ``resume=True`` (clean mode, no ``state_path_out``) replays
+    completed records from a per-record JSONL manifest
+    (``<islands_out>.manifest.jsonl`` unless ``manifest_path`` names one)
+    and the final output is byte-identical to an uninterrupted run; the
+    manifest is also WRITTEN whenever resume/manifest_path is given, so a
+    killed run can resume next time.  ``invalid_symbols`` is the codec's
+    skip/mask/fail policy (clean mode; 'skip' = reference semantics).
 
     ``prefetch`` (clean mode): depth of the double-buffered streaming
     executor.  0 (default) is the strictly serial encode -> upload ->
@@ -307,9 +413,27 @@ def decode_file(
                          "reference caller is 8-state-specific")
     if symbol_cache is not None and compat:
         raise ValueError("symbol_cache is FASTA-aware — use compat=False (--clean)")
+    _check_invalid_symbols(invalid_symbols, compat)
     err = island_layout_error(params, island_states)
     if err:
         raise ValueError(err)
+    sup = resilience.DispatchSupervisor(
+        name="decode",
+        sentinel=resilience.IntegritySentinel() if integrity_check else None,
+    )
+    manifest = _open_manifest(
+        "decode", test_path, params,
+        resume=resume, manifest_path=manifest_path, islands_out=islands_out,
+        compat=compat,
+        per_symbol_outputs=(("state_path_out", state_path_out),),
+        config={
+            "min_len": min_len,
+            "island_states": (
+                None if island_states is None else sorted(island_states)
+            ),
+            "invalid_symbols": invalid_symbols,
+        },
+    )
     use_device_islands, cap_box = _resolve_island_engine(
         island_engine,
         device_eligible=not compat and state_path_out is None,
@@ -346,14 +470,26 @@ def decode_file(
         with timer.phase("decode+islands", items=float(chunked.total), unit="sym"):
             for lo in range(0, n, device_batch):
                 hi = min(lo + device_batch, n)
-                batch_paths = obs.note_fetch(np.asarray(
-                    batch_decode(
-                        params,
-                        jnp.asarray(chunks[lo:hi]),
-                        jnp.asarray(lengths[lo:hi]),
-                        return_score=False,
-                    )
-                ))
+
+                def compat_unit(lo=lo, hi=hi):
+                    # Dispatch + fetch as ONE supervised unit: a retry
+                    # re-runs the (pure) jit dispatch, so a transient device
+                    # fault costs one batch, not the file.
+                    return obs.note_fetch(np.asarray(
+                        batch_decode(
+                            params,
+                            jnp.asarray(chunks[lo:hi]),
+                            jnp.asarray(lengths[lo:hi]),
+                            return_score=False,
+                        )
+                    ))
+
+                batch_total = lengths[lo:hi].sum()  # host array arithmetic
+                batch_paths = sup.run(
+                    compat_unit, what="decode.compat_batch",
+                    engine=f"decode.{_eng}",
+                    items=float(batch_total),
+                )
                 parts.extend(
                     islands_mod.call_islands(
                         batch_paths[i][: int(lengths[lo + i])],
@@ -391,6 +527,23 @@ def decode_file(
     n_sym = 0
     n_records = 0
     n_spans_total = 0
+    # One (name, n_symbols, n_spans) entry per record; parts index == record
+    # index (every record appends exactly one IslandCalls), so the manifest
+    # marks completions strictly in record order as parts fill in.
+    rec_meta: list = []
+    mark_cursor = 0
+
+    def mark_progress() -> None:
+        nonlocal mark_cursor
+        if manifest is None:
+            return
+        while mark_cursor < len(parts) and parts[mark_cursor] is not None:
+            name_, size_, spans_ = rec_meta[mark_cursor]
+            manifest.record_done(
+                mark_cursor, name_, size_,
+                calls=parts[mark_cursor], n_spans=spans_,
+            )
+            mark_cursor += 1
 
     # Overlapped mode (prefetch > 0) with the device island engine defers
     # each record's compact call-column fetch: the reduction is DISPATCHED
@@ -407,11 +560,13 @@ def decode_file(
             idx, thunk = deferred.pop(0)
             out = thunk()
             parts[idx : idx + len(out)] = out
+        mark_progress()
 
     def decode_one(rec_name: str, symbols: np.ndarray) -> None:
         nonlocal n_spans_total
         n_spans = max(1, -(-symbols.size // span))
         n_spans_total += n_spans
+        rec_meta[len(parts)][2] = n_spans
         if n_spans > 1:
             log.info(
                 "record %r (%d symbols) exceeds the single-pass decode span "
@@ -419,40 +574,78 @@ def decode_file(
                 "between them (exact — no DP restart)",
                 rec_name, symbols.size, span, n_spans,
             )
-        with timer.phase("decode", items=float(symbols.size), unit="sym"):
+
+        def dispatch(overlap: bool) -> list:
+            """Decode dispatch (the sharded calls supervise their own
+            blocking fetches; with device islands nothing blocks here)."""
             if symbols.size == 0:
-                pieces = [np.zeros(0, dtype=np.int32)]
-            elif n_spans > 1:
-                pieces = viterbi_sharded_spans(
+                return [np.zeros(0, dtype=np.int32)]
+            if n_spans > 1:
+                return viterbi_sharded_spans(
                     params, symbols, span=span, engine=engine,
                     return_device=use_device_islands,
-                    prefetch=prefetch > 0,
+                    prefetch=overlap, supervisor=sup,
                 )
-            else:
-                pieces = [
-                    viterbi_sharded(
-                        params, symbols, engine=engine,
-                        return_device=use_device_islands,
-                    )
-                ]
+            return [
+                viterbi_sharded(
+                    params, symbols, engine=engine,
+                    return_device=use_device_islands, supervisor=sup,
+                )
+            ]
+
+        with timer.phase("decode", items=float(symbols.size), unit="sym"):
             if use_device_islands:
-                full = pieces[0] if len(pieces) == 1 else jnp.concatenate(pieces)
-                if not defer_calls:
-                    # Async dispatch would land the decode's device time in
-                    # the islands phase — block here so the per-phase stats
-                    # the bench publishes attribute work where it happened.
-                    # The overlapped mode keeps the queue full instead
-                    # (attribution blurs by design, see the docstring).
-                    # graftcheck: allow(hot-path-host-sync) -- phase-attribution block (comment above); the obs ledger counts it via its block_until_ready hook
-                    jax.block_until_ready(full)
+                if defer_calls:
+                    pieces = dispatch(True)
+                    full = pieces[0] if len(pieces) == 1 else jnp.concatenate(pieces)
+                else:
+                    def record_unit():
+                        p = dispatch(False)
+                        f = p[0] if len(p) == 1 else jnp.concatenate(p)
+                        # Block INSIDE the supervised unit: per-phase stats
+                        # attribute the decode where it happened (async
+                        # dispatch would bill it to the islands phase), and
+                        # a device fault surfaces HERE — where the retry
+                        # re-dispatches — instead of poisoning the island
+                        # call downstream.  The overlapped mode keeps the
+                        # queue full instead (attribution blurs by design).
+                        # graftcheck: allow(hot-path-host-sync) -- phase-attribution + fault-surfacing block (comment above); the obs ledger counts it via its block_until_ready hook
+                        jax.block_until_ready(f)
+                        return f
+
+                    full = sup.run(
+                        record_unit, what="decode.record_block",
+                        engine=f"decode.{_eng}", items=float(symbols.size),
+                    )
             else:
+                pieces = dispatch(prefetch > 0)
                 full = obs.note_fetch(np.concatenate(pieces))
         with timer.phase("islands", items=float(symbols.size), unit="sym"):
             if use_device_islands:
                 from cpgisland_tpu.ops.islands_device import (
+                    call_islands_device,
                     call_islands_device_async,
+                    call_islands_device_obs,
                     call_islands_device_obs_async,
                 )
+
+                def recompute():
+                    """Serial last-resort recovery for the deferred fetch:
+                    the held device columns/path may be poisoned by an
+                    upstream fault, so re-decode this record (blocking) and
+                    re-run the island reduction from scratch."""
+                    p2 = dispatch(False)
+                    f2 = p2[0] if len(p2) == 1 else jnp.concatenate(p2)
+                    if island_states is not None:
+                        return _device_calls_retry(
+                            call_islands_device_obs, f2, jnp.asarray(symbols),
+                            island_states=island_states, min_len=min_len,
+                            cap_box=cap_box, supervisor=sup,
+                        )
+                    return _device_calls_retry(
+                        call_islands_device, f2, min_len=min_len,
+                        cap_box=cap_box, supervisor=sup,
+                    )
 
                 if island_states is not None:
                     get = _device_calls_deferred(
@@ -460,11 +653,13 @@ def decode_file(
                         full, jnp.asarray(symbols),
                         island_states=island_states,
                         min_len=min_len, cap_box=cap_box,
+                        supervisor=sup, recompute=recompute,
                     )
                 else:
                     get = _device_calls_deferred(
                         call_islands_device_async, full,
                         min_len=min_len, cap_box=cap_box,
+                        supervisor=sup, recompute=recompute,
                     )
                 if defer_calls:
                     # "." = headerless leading sequence (see below).
@@ -484,6 +679,7 @@ def decode_file(
         # "." = headerless leading sequence: keeps the name column parseable
         # (a bare "" would emit a leading space and split into 5 fields).
         parts.append(calls.with_names(rec_name or "."))
+        mark_progress()
         if path_writer is not None:
             # graftcheck: allow(hot-path-host-sync) -- `full` is host already except under --clean device islands, where the path dump's one fetch is the product being written
             path_writer.write(np.asarray(full).astype(np.int8))
@@ -503,6 +699,8 @@ def decode_file(
             want_paths=path_writer is not None,
             timer=timer,
             defer=defer_calls,
+            supervisor=sup,
+            engine_label=_eng,
         )
         n_spans_total += n_spans_total_add
         if callable(batch_parts):  # deferred thunk -> per-record list
@@ -512,6 +710,7 @@ def decode_file(
             deferred.append((idx, batch_parts))
         else:
             parts.extend(batch_parts)
+            mark_progress()
         for p in batch_paths:
             path_writer.write(p)
 
@@ -523,7 +722,9 @@ def decode_file(
     from cpgisland_tpu.utils.prefetch import maybe_prefetch
 
     rec_iter, close_prefetch = maybe_prefetch(
-        codec.iter_fasta_records_cached(test_path, symbol_cache),
+        codec.iter_fasta_records_cached(
+            test_path, symbol_cache, invalid=invalid_symbols
+        ),
         prefetch, "decode-records",
     )
     try:
@@ -531,6 +732,26 @@ def decode_file(
         for rec_name, symbols in rec_iter:
             n_records += 1
             n_sym += symbols.size
+            rec_meta.append([rec_name, int(symbols.size), 1])
+            if manifest is not None:
+                hit = manifest.completed(
+                    n_records - 1, rec_name, int(symbols.size)
+                )
+                if hit is not None:
+                    # Completed in a previous run: replay its calls from the
+                    # manifest (bit-exact wire format) and skip all compute.
+                    # Flush the pending batch first so parts stays in
+                    # record order.
+                    from cpgisland_tpu.resilience.manifest import calls_from_wire
+
+                    flush_small(pending)
+                    pending = []
+                    spans_ = int(hit.get("n_spans", 1))
+                    rec_meta[-1][2] = spans_
+                    n_spans_total += spans_
+                    parts.append(calls_from_wire(hit["calls"]))
+                    mark_progress()
+                    continue
             if symbols.size <= SMALL_RECORD_MAX:
                 pending.append((rec_name, symbols))
                 if len(pending) >= device_batch:
@@ -544,6 +765,8 @@ def decode_file(
         settle_deferred()
     finally:
         close_prefetch()
+        if manifest is not None:
+            manifest.close()
         if path_writer is not None:
             path_writer.close()
     calls = IslandCalls.concatenate(parts)
@@ -604,6 +827,17 @@ def _resolve_island_engine(
         and device_eligible
         and jax.default_backend() == "tpu"
     )
+    if use_device_islands and island_engine == "auto":
+        # Degradation ladder: a device island caller tripped by repeated
+        # dispatch faults falls back to its parity twin, the host NumPy
+        # caller (calls are bit-identical, ops/islands_device.py), for the
+        # breaker's cooldown window.  Auto-routing only — an EXPLICIT
+        # 'device' request is honored as-is (parity runs exist to exercise
+        # that specific engine; the supervisor still retries its faults).
+        choice = resilience.get_breaker().degrade(
+            "islands", "device", lambda e: "host" if e == "device" else None
+        )
+        use_device_islands = choice == "device"
     obs.engine_decision(
         site="island_engine",
         choice="device" if use_device_islands else "host",
@@ -653,8 +887,10 @@ def _grow_cap_or_raise(e, cap_box: list) -> None:
     cap_box[0] = new_cap
 
 
-def _device_calls_retry(fn, *args, cap_box: list, **kwargs):
-    """Device island calling that SURVIVES cap overflow.
+def _device_calls_retry(
+    fn, *args, cap_box: list, supervisor=None, recompute=None, **kwargs
+):
+    """Device island calling that SURVIVES cap overflow AND device faults.
 
     IslandCapOverflow carries the true surviving-call count, so the retry
     jumps straight to a sufficient (next-pow2) cap instead of aborting a
@@ -664,17 +900,30 @@ def _device_calls_retry(fn, *args, cap_box: list, **kwargs):
     decode itself.  ``cap_box`` is a one-element list: the grown cap is
     written back so later records/flushes of an island-dense file start at
     the learned size instead of re-overflowing every time.
+
+    Fault-shaped errors (XlaRuntimeError etc.) retry under the dispatch
+    supervisor; ``recompute`` (optional) is its serial fallback when the
+    held device path may itself be poisoned.  Cap overflow stays OUTSIDE
+    the supervisor (it is a sizing signal, not a fault — ValueError passes
+    straight through).
     """
     from cpgisland_tpu.ops.islands_device import IslandCapOverflow
 
+    sup = supervisor if supervisor is not None else resilience.default_supervisor()
     while True:
         try:
-            return fn(*args, cap=cap_box[0], **kwargs)
+            return sup.run(
+                functools.partial(fn, *args, cap=cap_box[0], **kwargs),
+                what="islands.call", engine="islands.device",
+                fallback=recompute,
+            )
         except IslandCapOverflow as e:
             _grow_cap_or_raise(e, cap_box)
 
 
-def _device_calls_deferred(fn_async, *args, cap_box: list, **kwargs):
+def _device_calls_deferred(
+    fn_async, *args, cap_box: list, supervisor=None, recompute=None, **kwargs
+):
     """Deferred twin of :func:`_device_calls_retry`.
 
     ``fn_async`` (islands_device.call_islands_device_async /
@@ -686,16 +935,26 @@ def _device_calls_deferred(fn_async, *args, cap_box: list, **kwargs):
     then hides behind device compute.  Same args/cap_box contract as the
     blocking retry; the device inputs stay referenced by the closure, so
     an overflow can still re-run only the calling reduction.
+
+    The fetch runs under the dispatch supervisor: fault-shaped errors
+    re-fetch/re-dispatch, and ``recompute`` (the caller's full serial
+    re-decode + re-call closure) takes over from the second attempt —
+    the held device buffers may be poisoned by an upstream fault the
+    deferred cadence never blocked on.
     """
     from cpgisland_tpu.ops.islands_device import IslandCapOverflow
 
+    sup = supervisor if supervisor is not None else resilience.default_supervisor()
     pending = fn_async(*args, cap=cap_box[0], **kwargs)
 
     def get():
         p = pending
         while True:
             try:
-                return p()
+                return sup.run(
+                    p, what="islands.columns", engine="islands.device",
+                    fallback=recompute,
+                )
             except IslandCapOverflow as e:
                 _grow_cap_or_raise(e, cap_box)
                 p = fn_async(*args, cap=cap_box[0], **kwargs)
@@ -714,6 +973,8 @@ def _batched_device_calls(
     min_len,
     cap_box: list,
     deferred: bool = False,
+    supervisor=None,
+    recompute_paths=None,
 ):
     """ONE device island call over a padded [Bp, Tpad] batch of paths.
 
@@ -726,37 +987,69 @@ def _batched_device_calls(
     the device reduction is dispatched NOW, the column fetch happens when
     the thunk runs (the overlapped pipeline invokes it after the next
     batch's decode is in flight).
+
+    ``recompute_paths`` (a blocking re-decode of the batch) is the
+    supervisor's serial fallback: if the held device paths were poisoned by
+    an upstream fault, the fetch retry re-derives them from host inputs and
+    re-runs the blocking island call.
     """
     from cpgisland_tpu.ops.islands import N_ISLAND_STATES
     from cpgisland_tpu.ops.islands_device import (
+        call_islands_device,
         call_islands_device_async,
+        call_islands_device_obs,
         call_islands_device_obs_async,
     )
 
     Bp, Tpad = paths.shape
     stride = Tpad + 1
-    mask = jnp.arange(Tpad)[None, :] < jnp.asarray(lengths)[:, None]
     # Masked tails/separators become a non-island state so runs can never
     # cross records: the background sentinel is N_ISLAND_STATES for the
     # 8-state labeling, n_states (an id no model state uses) for arbitrary
     # island_states sets.
     fill = N_ISLAND_STATES if island_states is None else params.n_states
-    masked = jnp.where(mask, paths, fill)
-    sep = jnp.full((Bp, 1), fill, masked.dtype)
-    flat = jnp.concatenate([masked, sep], axis=1).reshape(-1)
-    if island_states is not None:
+
+    def _flat(paths):
+        mask = jnp.arange(Tpad)[None, :] < jnp.asarray(lengths)[:, None]
+        masked = jnp.where(mask, paths, fill)
+        sep = jnp.full((Bp, 1), fill, masked.dtype)
+        flat = jnp.concatenate([masked, sep], axis=1).reshape(-1)
+        if island_states is None:
+            return flat, None
         obs_dev = jnp.asarray(rows)
         obs_flat = jnp.concatenate(
             [obs_dev, jnp.zeros((Bp, 1), obs_dev.dtype)], axis=1
         ).reshape(-1)
+        return flat, obs_flat
+
+    flat, obs_flat = _flat(paths)
+
+    recompute = None
+    if recompute_paths is not None:
+        def recompute():
+            f2, o2 = _flat(recompute_paths())
+            if island_states is not None:
+                return _device_calls_retry(
+                    call_islands_device_obs, f2, o2,
+                    island_states=island_states, min_len=min_len,
+                    cap_box=cap_box, supervisor=supervisor,
+                )
+            return _device_calls_retry(
+                call_islands_device, f2, min_len=min_len, cap_box=cap_box,
+                supervisor=supervisor,
+            )
+
+    if island_states is not None:
         get = _device_calls_deferred(
             call_islands_device_obs_async,
             flat, obs_flat, island_states=island_states,
             min_len=min_len, cap_box=cap_box,
+            supervisor=supervisor, recompute=recompute,
         )
     else:
         get = _device_calls_deferred(
-            call_islands_device_async, flat, min_len=min_len, cap_box=cap_box
+            call_islands_device_async, flat, min_len=min_len, cap_box=cap_box,
+            supervisor=supervisor, recompute=recompute,
         )
 
     def finish() -> list:
@@ -791,6 +1084,8 @@ def _decode_small_batch(
     want_paths: bool,
     timer: profiling.PhaseTimer,
     defer: bool = False,
+    supervisor=None,
+    engine_label: str = "xla",
 ):
     """Decode a batch of small records as vmap lanes; islands per record.
 
@@ -800,6 +1095,11 @@ def _decode_small_batch(
     (_batched_device_calls).  Returns (n_spans, [IslandCalls per record],
     [paths]) — with ``defer`` (overlapped pipeline, device islands) the
     middle element is a thunk producing that list at fetch time.
+
+    The decode dispatch + its blocking point run as one supervised unit
+    (retry re-runs the pure jit dispatch); the deferred cadence instead
+    hands ``_batched_device_calls`` a blocking re-decode closure as the
+    fetch-time recompute fallback.
     """
     B = len(batch)
     sizes = [s.size for _, s in batch]
@@ -810,24 +1110,40 @@ def _decode_small_batch(
         rows[i, : s.size] = s
     lengths = np.zeros(Bp, np.int32)
     lengths[:B] = sizes
+    sup = supervisor if supervisor is not None else resilience.default_supervisor()
 
-    total = float(sum(sizes))
-    with timer.phase("decode", items=total, unit="sym"):
+    def decode_unit(block: bool):
         # uint8 upload (the decoders cast on device): the host->device
         # transfer is the measured end-to-end bottleneck — don't 4x it.
         paths = batch_decode(
             params, jnp.asarray(obs.note_upload(rows)), jnp.asarray(lengths),
             return_score=False,
         )
+        if block:
+            # Block so per-phase stats attribute the decode where it
+            # happened (async dispatch would bill it to the islands
+            # phase) and so a device fault surfaces inside the supervised
+            # unit; the overlapped mode keeps the queue full instead.
+            # graftcheck: allow(hot-path-host-sync) -- phase-attribution + fault-surfacing block (comment above); the obs ledger counts it via its block_until_ready hook
+            jax.block_until_ready(paths)
+        return paths
+
+    total = float(sum(sizes))
+    with timer.phase("decode", items=total, unit="sym"):
         if use_device_islands:
-            if not defer:
-                # Block so per-phase stats attribute the decode where it
-                # happened (async dispatch would bill it to the islands
-                # phase); the overlapped mode keeps the queue full instead.
-                # graftcheck: allow(hot-path-host-sync) -- phase-attribution block (comment above); the obs ledger counts it via its block_until_ready hook
-                jax.block_until_ready(paths)
+            if defer:
+                paths = decode_unit(False)
+            else:
+                paths = sup.run(
+                    lambda: decode_unit(True), what="decode.batch",
+                    engine=f"decode.{engine_label}", items=total,
+                )
         else:
-            paths = obs.note_fetch(np.asarray(paths))
+            paths = sup.run(
+                lambda: obs.note_fetch(np.asarray(decode_unit(False))),
+                what="decode.batch", engine=f"decode.{engine_label}",
+                items=total,
+            )
 
     parts: list[IslandCalls] = []
     paths_out: list[np.ndarray] = []
@@ -837,6 +1153,8 @@ def _decode_small_batch(
                 params, paths, rows, lengths, batch,
                 island_states=island_states, min_len=min_len, cap_box=cap_box,
                 deferred=defer,
+                supervisor=sup,
+                recompute_paths=(lambda: decode_unit(True)) if defer else None,
             )
         else:
             for i, (name, symbols) in enumerate(batch):
@@ -896,8 +1214,23 @@ def posterior_file(
     metrics: Optional[profiling.MetricsLogger] = None,
     timer: Optional[profiling.PhaseTimer] = None,
     prefetch: int = 0,
+    integrity_check: bool = False,
+    resume: bool = False,
+    manifest_path: Optional[str] = None,
+    invalid_symbols: str = "skip",
 ) -> PosteriorResult:
     """Soft decoding of a FASTA file: per-position island confidence.
+
+    Resilience: same contract as :func:`decode_file` — supervised blocking
+    units with bounded retries, engine degradation to parity twins on
+    repeated faults, opt-in ``integrity_check`` phantom sentinel, and
+    ``resume``/``manifest_path`` per-record manifests.  Posterior manifests
+    need an island-only run (``islands_out`` without ``confidence_out`` /
+    ``mpm_path_out`` — per-symbol streams are not resumable); manifest
+    mode processes records one at a time (no small-record batching) and
+    accumulates the mean confidence from exact per-record sums recorded in
+    the manifest, so a resumed run's result is identical to an
+    uninterrupted manifest run.
 
     ``prefetch``: depth of the double-buffered streaming executor (same
     contract as decode_file) — 0 is strictly serial; N >= 1 parses/encodes
@@ -968,6 +1301,7 @@ def posterior_file(
             raise ValueError(f"island confidence: {err}")
         island_states = tuple(range(params.n_symbols))
     island_states = tuple(sorted(island_states))
+    _check_invalid_symbols(invalid_symbols, compat=False)
     timer = timer if timer is not None else profiling.PhaseTimer()
     want_conf = confidence_out is not None
     want_islands = islands_out is not None
@@ -976,6 +1310,29 @@ def posterior_file(
         raise ValueError(
             "posterior: nothing to do — request confidence_out, "
             "mpm_path_out, and/or islands_out"
+        )
+    sup = resilience.DispatchSupervisor(
+        name="posterior",
+        sentinel=resilience.IntegritySentinel() if integrity_check else None,
+    )
+    manifest = _open_manifest(
+        "posterior", test_path, params,
+        resume=resume, manifest_path=manifest_path, islands_out=islands_out,
+        compat=False,
+        per_symbol_outputs=(
+            ("confidence_out", confidence_out),
+            ("mpm_path_out", mpm_path_out),
+        ),
+        config={
+            "min_len": min_len,
+            "island_states": sorted(island_states),
+            "invalid_symbols": invalid_symbols,
+        },
+    )
+    if manifest is not None and not want_islands:
+        raise ValueError(
+            "posterior resume manifests need islands_out (the island-only "
+            "mode is the resumable one)"
         )
     use_device_islands, cap_box = _resolve_island_engine(
         island_engine,
@@ -990,9 +1347,11 @@ def posterior_file(
         island_cap=island_cap,
     )
     # Small records batch into one chunked-layout kernel pass (pallas only;
-    # the XLA lane path serves one record at a time).
+    # the XLA lane path serves one record at a time).  Manifest runs keep
+    # the one-record cadence: completion marks and per-record confidence
+    # sums then line up with record boundaries.
     _fb_eng = resolve_fb_engine(engine, params)
-    batch_small = _fb_eng in ("pallas", "onehot")
+    batch_small = _fb_eng in ("pallas", "onehot") and manifest is None
     # Writers open INSIDE the try: a failure opening the second must still
     # close (finalize) the first, not leave a corrupt header slot behind.
     conf_w = None
@@ -1027,10 +1386,12 @@ def posterior_file(
 
     call_parts: list[IslandCalls] = []
 
-    def call_rec(rec_name: str, symbols: np.ndarray, path) -> None:
+    def call_rec(rec_name: str, symbols: np.ndarray, path, recompute_path=None) -> None:
         """MPM-path island calls for one whole record (clean semantics).
         With the device engine ``path`` is a device array and only the
-        compact call records cross to the host."""
+        compact call records cross to the host.  ``recompute_path`` (a
+        blocking re-derivation of the MPM path) is the supervisor's serial
+        fallback if the held device path turns out poisoned."""
         if not want_islands:
             return
         if use_device_islands:
@@ -1039,16 +1400,24 @@ def posterior_file(
                 call_islands_device_obs,
             )
 
-            if obs_based_calls:
-                calls = _device_calls_retry(
-                    call_islands_device_obs,
-                    path, jnp.asarray(symbols), island_states=island_states,
-                    min_len=min_len, cap_box=cap_box,
+            def _call(p, recompute=None):
+                if obs_based_calls:
+                    return _device_calls_retry(
+                        call_islands_device_obs,
+                        p, jnp.asarray(symbols), island_states=island_states,
+                        min_len=min_len, cap_box=cap_box, supervisor=sup,
+                        recompute=recompute,
+                    )
+                return _device_calls_retry(
+                    call_islands_device, p, min_len=min_len, cap_box=cap_box,
+                    supervisor=sup, recompute=recompute,
                 )
-            else:
-                calls = _device_calls_retry(
-                    call_islands_device, path, min_len=min_len, cap_box=cap_box
-                )
+
+            recompute = (
+                None if recompute_path is None
+                else (lambda: _call(recompute_path()))
+            )
+            calls = _call(path, recompute)
         elif obs_based_calls:
             calls = islands_mod.call_islands_obs(
                 np.asarray(path), np.asarray(symbols),
@@ -1098,7 +1467,8 @@ def posterior_file(
                     rows[g, : s.size] = s
                     lens[g] = s.size
                 total = float(sum(batch[i][1].size for i in group))
-                with timer.phase("posterior", items=total, unit="sym"):
+
+                def batch_unit(rows=rows, lens=lens):
                     conf2, path2 = batch_posterior_pallas(
                         params, jnp.asarray(rows), jnp.asarray(lens),
                         jnp.asarray(island_mask(params, island_states)),
@@ -1106,8 +1476,11 @@ def posterior_file(
                     )
                     if use_device_islands:
                         # conf/path stay device-resident; block so the
-                        # kernel time is billed to this phase.
-                        # graftcheck: allow(hot-path-host-sync) -- phase-attribution block (comment above); the obs ledger counts it via its block_until_ready hook
+                        # kernel time is billed to this phase AND a device
+                        # fault surfaces inside the supervised unit (a
+                        # retry re-dispatches; poisoned outputs must not
+                        # reach the island caller / accumulator).
+                        # graftcheck: allow(hot-path-host-sync) -- phase-attribution + fault-surfacing block (comment above); the obs ledger counts it via its block_until_ready hook
                         jax.block_until_ready(path2)
                     else:
                         conf2 = obs.note_fetch(np.asarray(conf2))
@@ -1115,6 +1488,13 @@ def posterior_file(
                             obs.note_fetch(np.asarray(path2))
                             if want_path else None
                         )
+                    return conf2, path2
+
+                with timer.phase("posterior", items=total, unit="sym"):
+                    conf2, path2 = sup.run(
+                        batch_unit, what="posterior.batch",
+                        engine=f"fb.{_fb_eng}", items=total,
+                    )
                 if use_device_islands:
                     with timer.phase("islands", items=total, unit="sym"):
                         g_calls = _batched_device_calls(
@@ -1124,6 +1504,8 @@ def posterior_file(
                                 island_states if obs_based_calls else None
                             ),
                             min_len=min_len, cap_box=cap_box,
+                            supervisor=sup,
+                            recompute_paths=lambda: batch_unit()[1],
                         )
                     if want_conf:
                         conf_host = obs.note_fetch(np.asarray(conf2))
@@ -1153,8 +1535,13 @@ def posterior_file(
             else:
                 call_rec(name, s, path)
 
-    def one_record(rec_name: str, symbols: np.ndarray) -> None:
-        with timer.phase("posterior", items=float(symbols.size), unit="sym"):
+    def one_record(rec_name: str, symbols: np.ndarray) -> Optional[float]:
+        """Returns the record's exact f64 confidence sum in manifest mode
+        (recorded per record so a resumed run reproduces the mean), else
+        None (the cheaper aggregate accumulators)."""
+        nonlocal conf_total
+
+        def record_unit():
             conf, path = posterior_sharded(
                 params, symbols, island_states,
                 engine=engine, want_path=want_path,
@@ -1163,19 +1550,50 @@ def posterior_file(
                 # compile once per distinct record size.
                 pad_to=_round_pow2(symbols.size, floor=1 << 14),
             )
+            if use_device_islands:
+                # Fault-surfacing block (see decode_one): a poisoned
+                # conf/path must fail INSIDE the supervised unit — where
+                # the retry re-dispatches — not downstream in the device
+                # accumulator or island caller.
+                # graftcheck: allow(hot-path-host-sync) -- fault-surfacing + phase-attribution block (comment above); the obs ledger counts it via its block_until_ready hook
+                jax.block_until_ready(path if path is not None else conf)
+            return conf, path
+
+        with timer.phase("posterior", items=float(symbols.size), unit="sym"):
+            conf, path = sup.run(
+                record_unit, what="posterior.record",
+                engine=f"fb.{_fb_eng}", items=float(symbols.size),
+            )
+        rec_conf = None
         if use_device_islands:
             if want_conf:
                 emit(conf_to_host(conf), None)
+            elif manifest is not None:
+                rec_conf = float(obs.note_fetch(np.asarray(jnp.sum(conf))))
+                conf_total += rec_conf
             else:
                 accum_conf_device(conf)
+        elif manifest is not None:
+            # graftcheck: allow(hot-path-host-sync) -- conf is host on this branch (posterior_sharded fetched it through obs.note_fetch); exact-f64 coercion only
+            rec_conf = float(np.asarray(conf).sum(dtype=np.float64))
+            conf_total += rec_conf
+            emit(None, path)
         else:
             emit(conf, path)
-        call_rec(rec_name, symbols, path)
+
+        def recompute_path():
+            c2, p2 = record_unit()
+            return p2
+
+        call_rec(rec_name, symbols, path, recompute_path=recompute_path)
+        return rec_conf
 
     from cpgisland_tpu.utils.prefetch import maybe_prefetch
 
     rec_iter, close_prefetch = maybe_prefetch(
-        codec.iter_fasta_records_cached(test_path, symbol_cache),
+        codec.iter_fasta_records_cached(
+            test_path, symbol_cache, invalid=invalid_symbols
+        ),
         prefetch, "posterior-records",
     )
     try:
@@ -1184,9 +1602,27 @@ def posterior_file(
         if mpm_path_out is not None:
             path_w = NpyStreamWriter(mpm_path_out, np.int8)
         for rec_name, symbols in rec_iter:
+            rec_idx = n_records
             n_records += 1
             n_sym += symbols.size
+            if manifest is not None:
+                hit = manifest.completed(rec_idx, rec_name, int(symbols.size))
+                if hit is not None:
+                    # Completed in a previous run: replay calls + the exact
+                    # per-record confidence sum from the manifest.
+                    from cpgisland_tpu.resilience.manifest import calls_from_wire
+
+                    if hit.get("conf_sum") is not None:
+                        conf_total += float.fromhex(hit["conf_sum"])
+                    replay = calls_from_wire(hit["calls"])
+                    if replay is not None:
+                        call_parts.append(replay)
+                    continue
             if symbols.size == 0:
+                if manifest is not None:
+                    manifest.record_done(
+                        rec_idx, rec_name, 0, calls=None, conf_sum=0.0
+                    )
                 continue
             # Batch eligibility respects a user-narrowed span: a record the
             # span contract would split must take the span-threaded path.
@@ -1199,7 +1635,13 @@ def posterior_file(
             flush_small()  # preserve record order around a large record
             n_spans = -(-symbols.size // span)
             if n_spans == 1:
-                one_record(rec_name, symbols)
+                rec_conf = one_record(rec_name, symbols)
+                if manifest is not None:
+                    manifest.record_done(
+                        rec_idx, rec_name, int(symbols.size),
+                        calls=call_parts[-1] if want_islands else None,
+                        conf_sum=rec_conf,
+                    )
                 continue
             log.info(
                 "record %r (%d symbols) exceeds the posterior span (%d); "
@@ -1247,17 +1689,39 @@ def posterior_file(
                         first=lo == 0, prev_sym=prev, want_path=want_path,
                         streams=rec_streams,
                     )
-                    totals.append(
-                        transfer_total_sharded(
+
+                    def total_unit(si=si, piece=piece, lo=lo, prev=prev,
+                                   device=prefetch > 0):
+                        return transfer_total_sharded(
                             params, piece, engine=engine, first=lo == 0,
                             pad_to=span, placed=span_placed[si],
                             prev_sym=prev,
-                            return_device=prefetch > 0,
+                            return_device=device,
                             prepared=span_prep[si],
                         )
-                    )
+
+                    if prefetch > 0:
+                        # Async dispatch, no blocking here — faults surface
+                        # (and recover) at the supervised fetch below.
+                        totals.append((total_unit, total_unit()))
+                    else:
+                        totals.append(sup.run(
+                            total_unit, what="posterior.span_total",
+                            engine=f"fb.{_fb_eng}", items=float(piece.size),
+                        ))
                 if prefetch > 0:
-                    totals = [obs.note_fetch(np.asarray(t)) for t in totals]
+                    totals = [
+                        sup.run(
+                            lambda t=t: obs.note_fetch(np.asarray(t)),
+                            what="posterior.span_total_fetch",
+                            engine=f"fb.{_fb_eng}",
+                            # Serial fallback: re-dispatch THIS span's
+                            # products sweep (blocking) — the held device
+                            # total may be poisoned.
+                            fallback=lambda unit=unit_: unit(device=False),
+                        )
+                        for unit_, t in totals
+                    ]
             # Host threading: entering-alpha / exiting-beta directions per
             # span (tiny [K]x[K,K] chains, f32 on normalized operators).
             pi = np.exp(np.asarray(params.log_pi, np.float64))
@@ -1282,26 +1746,46 @@ def posterior_file(
                 exits[s] = e
             # Sweep B: full posterior per span with the threaded messages.
             rec_path_parts: list = []
+            rec_conf = 0.0  # exact per-record sum (manifest mode)
             for s in range(n_spans):
                 lo = s * span
                 piece = symbols[lo : lo + span]
-                with timer.phase("posterior", items=float(piece.size), unit="sym"):
+
+                def span_unit(s=s, lo=lo, piece=piece):
                     conf, path = posterior_sharded(
                         params, piece, island_states, engine=engine,
                         enter_dir=None if s == 0 else enters[s],
                         exit_dir=exits[s], first=s == 0,
                         want_path=want_path, pad_to=span,
                         return_device=use_device_islands,
-                        placed=span_placed.pop(s),
+                        placed=span_placed[s],
                         prev_sym=(
                             0 if s == 0
                             else _prev_real_symbol(symbols, lo, params.n_symbols)
                         ),
-                        prepared=span_prep.pop(s),
+                        prepared=span_prep[s],
                     )
+                    if use_device_islands:
+                        # Fault-surfacing block (see one_record): poisoned
+                        # outputs must fail inside the supervised unit.
+                        # graftcheck: allow(hot-path-host-sync) -- fault-surfacing + phase-attribution block (comment above); the obs ledger counts it via its block_until_ready hook
+                        jax.block_until_ready(path if path is not None else conf)
+                    return conf, path
+
+                with timer.phase("posterior", items=float(piece.size), unit="sym"):
+                    conf, path = sup.run(
+                        span_unit, what="posterior.span",
+                        engine=f"fb.{_fb_eng}", items=float(piece.size),
+                    )
+                span_placed.pop(s, None)
+                span_prep.pop(s, None)
                 if use_device_islands:
                     if want_conf:
                         emit(conf_to_host(conf), None)
+                    elif manifest is not None:
+                        c = float(obs.note_fetch(np.asarray(jnp.sum(conf))))
+                        rec_conf += c
+                        conf_total += c
                     else:
                         accum_conf_device(conf)
                     if want_islands:
@@ -1311,10 +1795,19 @@ def posterior_file(
                         # span exists to bound (state ids are 0..K-1 < 128).
                         rec_path_parts.append(path.astype(jnp.int8))
                 else:
-                    emit(conf, path)
+                    if manifest is not None:
+                        # graftcheck: allow(hot-path-host-sync) -- conf is host on this branch (posterior_sharded fetched it through obs.note_fetch); exact-f64 coercion only
+                        c = float(np.asarray(conf).sum(dtype=np.float64))
+                        rec_conf += c
+                        conf_total += c
+                        emit(None, path)
+                    else:
+                        emit(conf, path)
                     if want_islands:
                         # graftcheck: allow(hot-path-host-sync) -- `path` is host on this branch (its producer fetched through obs.note_fetch above); coercion only
                         rec_path_parts.append(np.asarray(path).astype(np.int8))
+                if manifest is not None:
+                    manifest.span_done(rec_idx, s)
             if want_islands:
                 # Islands are called over the WHOLE record's MPM path so a
                 # run crossing a span boundary is never clipped (device
@@ -1325,9 +1818,17 @@ def posterior_file(
                     else np.concatenate(rec_path_parts)
                 )
                 call_rec(rec_name, symbols, full_path)
+            if manifest is not None:
+                manifest.record_done(
+                    rec_idx, rec_name, int(symbols.size),
+                    calls=call_parts[-1] if want_islands else None,
+                    conf_sum=rec_conf, n_spans=n_spans,
+                )
         flush_small()
     finally:
         close_prefetch()
+        if manifest is not None:
+            manifest.close()
         if conf_w is not None:
             conf_w.close()
         if path_w is not None:

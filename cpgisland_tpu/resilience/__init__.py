@@ -1,0 +1,69 @@
+"""Resilient serving layer: supervision, integrity, degradation, resume.
+
+The reference delegated ALL fault tolerance to Hadoop — task retry,
+speculative re-execution, and skip-bad-records came for free from MapReduce
+(SURVEY.md; the Mahout ``BaumWelchDriver`` behind CpGIslandFinder.java).
+This TPU stack replaced that substrate, and the training loop rebuilt its
+own recovery (``train/elastic.py`` micro-batch retry, ``utils/checkpoint.py``,
+``fit``'s fused->host fault fallback) — but the SERVING paths
+(``pipeline.decode_file`` / ``posterior_file``, span streaming, deferred
+island-call fetches) ran bare against this hardware's documented failure
+modes (CLAUDE.md): phantom ~0 ms relay results, transient ~20x slowdowns,
+wedged tunnel claims, remote-compile rejections.  This package is the
+serving-side counterpart, four subsystems:
+
+- :mod:`~cpgisland_tpu.resilience.policy` — the **dispatch supervisor**:
+  bounded retries with exponential backoff + jitter around every blocking
+  fetch on the file-serving paths, obs-ledger events per attempt.  No
+  attempt is ever killed mid-execution (the never-kill rule, CLAUDE.md) —
+  "timeout" here is advisory telemetry (``dispatch_slow``), never a SIGKILL.
+- :mod:`~cpgisland_tpu.resilience.sentinel` — the **result-integrity
+  sentinel**: bench.py's phantom-result defenses (canary fetch of a small
+  derived output with a distinct per-dispatch seed fold, plausibility
+  ceilings) generalized into an opt-in production guard
+  (``--integrity-check``) that detects phantom/stale device results and has
+  the supervisor re-dispatch.
+- :mod:`~cpgisland_tpu.resilience.breaker` — the **engine degradation
+  ladder**: a per-engine circuit breaker; repeated faults in a
+  reduced/pallas engine trip a cooldown fallback to its parity twin
+  (onehot -> pallas -> xla, device island caller -> host caller), emitting
+  ``engine_degraded``/``engine_restored`` events.  Results stay exact:
+  the twins are already parity-pinned (PARITY.md).
+- :mod:`~cpgisland_tpu.resilience.manifest` — **resumable pipelines**: a
+  per-record JSONL manifest written by ``decode_file``/``posterior_file``
+  (``--resume``) so a killed or faulted run skips completed records and
+  produces byte-identical final output — the serving-side analogue of
+  training checkpoints.
+
+No jax import at module level (the CLI imports this before platform
+selection); device work is only touched lazily inside supervised thunks.
+"""
+
+from __future__ import annotations
+
+from cpgisland_tpu.resilience.breaker import (  # noqa: F401
+    EngineBreaker,
+    get_breaker,
+    set_breaker,
+)
+from cpgisland_tpu.resilience.manifest import RunManifest  # noqa: F401
+from cpgisland_tpu.resilience.policy import (  # noqa: F401
+    DispatchSupervisor,
+    RetryPolicy,
+    default_supervisor,
+    supervise,
+)
+from cpgisland_tpu.resilience.sentinel import (  # noqa: F401
+    IntegritySentinel,
+    PhantomResult,
+)
+
+
+def reset() -> None:
+    """Reset process-global resilience state (tests): the default
+    supervisor and the global engine breaker."""
+    from cpgisland_tpu.resilience import breaker as breaker_mod
+    from cpgisland_tpu.resilience import policy as policy_mod
+
+    policy_mod._DEFAULT = None
+    breaker_mod._BREAKER = None

@@ -1,0 +1,208 @@
+"""Engine degradation ladder: per-engine circuit breaker with cooldown.
+
+The fast engines here are TPU-shaped and have slower but parity-pinned
+twins: the reduced one-hot decode/FB kernels fall back to the dense Pallas
+kernels, those to the XLA scans, and the device island caller to the host
+NumPy caller (PARITY.md pins each pair bit-identical or within documented
+rounding).  When a fast engine faults REPEATEDLY — a Mosaic miscompile on a
+new driver, a kernel-shaped relay failure — retrying it forever turns every
+record into a retry storm.  The breaker instead trips that engine after
+``threshold`` consecutive faults: routing (``resolve_engine`` /
+``resolve_fb_engine`` / the island-engine policy) then demotes to the next
+rung for ``cooldown_s``, results stay exact, and an ``engine_degraded``
+obs event records the decision.  After the cooldown one probe is allowed
+through (half-open); success restores the engine (``engine_restored``),
+another fault re-trips it for a fresh cooldown.
+
+Engines are identified by namespaced keys — ``decode.onehot``,
+``fb.pallas``, ``islands.device`` — so a decode-side fault never degrades
+the training router.  State is process-global (one hardware reality per
+process) behind :func:`get_breaker`; tests install their own via
+:func:`set_breaker` or ``resilience.reset()``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import threading
+import time
+from typing import Callable, Dict, Optional
+
+from cpgisland_tpu import obs
+
+log = logging.getLogger(__name__)
+
+DEFAULT_THRESHOLD = 3
+DEFAULT_COOLDOWN_S = 60.0
+
+
+@dataclasses.dataclass
+class _EngineState:
+    consecutive_faults: int = 0
+    tripped_at: Optional[float] = None
+    half_open: bool = False
+    trips: int = 0
+
+
+class EngineBreaker:
+    """Consecutive-fault circuit breaker over namespaced engine keys.
+
+    ``clock`` is injectable (monotonic seconds) so cooldown expiry is
+    testable without sleeping.
+    """
+
+    def __init__(
+        self,
+        *,
+        threshold: int = DEFAULT_THRESHOLD,
+        cooldown_s: float = DEFAULT_COOLDOWN_S,
+        clock: Callable[[], float] = time.monotonic,
+    ) -> None:
+        if threshold < 1:
+            raise ValueError(f"threshold must be >= 1, got {threshold}")
+        self.threshold = threshold
+        self.cooldown_s = cooldown_s
+        self.clock = clock
+        self._state: Dict[str, _EngineState] = {}
+        # The supervisor may be driven from a deferred thunk while another
+        # record dispatches; keep the tiny state transitions atomic.
+        self._lock = threading.Lock()
+
+    def _st(self, engine: str) -> _EngineState:
+        return self._state.setdefault(engine, _EngineState())
+
+    # -- accounting (fed by the dispatch supervisor) -------------------------
+
+    def record_fault(self, engine: str, error: Optional[BaseException] = None) -> None:
+        with self._lock:
+            st = self._st(engine)
+            st.consecutive_faults += 1
+            if st.tripped_at is not None:
+                if st.half_open:
+                    # The post-cooldown probe failed: re-trip for a fresh
+                    # cooldown window.
+                    st.tripped_at = self.clock()
+                    st.half_open = False
+                    st.trips += 1
+                    self._emit_degraded(engine, st, error, probe_failed=True)
+                return
+            if st.consecutive_faults >= self.threshold:
+                st.tripped_at = self.clock()
+                st.half_open = False
+                st.trips += 1
+                self._emit_degraded(engine, st, error, probe_failed=False)
+
+    def record_success(self, engine: str) -> None:
+        with self._lock:
+            st = self._state.get(engine)
+            if st is None:
+                return
+            if st.tripped_at is not None and st.half_open:
+                st.tripped_at = None
+                st.half_open = False
+                st.consecutive_faults = 0
+                obs.event("engine_restored", engine=engine, trips=st.trips)
+                log.info(
+                    "engine %r restored after cooldown probe succeeded", engine
+                )
+                return
+            st.consecutive_faults = 0
+
+    def _emit_degraded(
+        self, engine: str, st: _EngineState, error, probe_failed: bool
+    ) -> None:
+        obs.event(
+            "engine_degraded",
+            engine=engine,
+            faults=st.consecutive_faults,
+            cooldown_s=self.cooldown_s,
+            probe_failed=probe_failed,
+            error=(f"{type(error).__name__}: {error}"[:200] if error else None),
+        )
+        log.warning(
+            "engine %r degraded after %d consecutive fault(s)%s; routing "
+            "falls back to its parity twin for %.0f s (results stay exact "
+            "— the twins are parity-pinned)",
+            engine, st.consecutive_faults,
+            " (cooldown probe failed)" if probe_failed else "",
+            self.cooldown_s,
+        )
+
+    # -- routing -------------------------------------------------------------
+
+    def allowed(self, engine: str) -> bool:
+        """May routing pick this engine now?  After the cooldown elapses the
+        first call flips the breaker half-open and admits ONE probe (whose
+        success/fault then restores or re-trips)."""
+        with self._lock:
+            st = self._state.get(engine)
+            if st is None or st.tripped_at is None:
+                return True
+            if st.half_open:
+                return True
+            if self.clock() - st.tripped_at >= self.cooldown_s:
+                st.half_open = True
+                return True
+            return False
+
+    def tripped(self, engine: str) -> bool:
+        """Currently tripped AND still cooling down (no probe admitted)."""
+        return not self.allowed(engine)
+
+    def degrade(
+        self, site: str, engine: str, ladder: Callable[[str], Optional[str]]
+    ) -> str:
+        """Walk ``engine`` down its parity-twin ladder past tripped rungs.
+
+        ``ladder(engine)`` returns the next rung or None at the bottom (the
+        last rung always runs — an exact-if-slow answer beats none).  Every
+        demotion step emits a deduped ``engine_decision`` routing event.
+        """
+        cur = engine
+        while not self.allowed(f"{site}.{cur}"):
+            nxt = ladder(cur)
+            if nxt is None:
+                break
+            obs.engine_decision(
+                site=f"{site}.breaker_demotion", choice=nxt, requested=cur
+            )
+            log.warning(
+                "%s engine %r is tripped (cooldown); demoting to parity "
+                "twin %r", site, cur, nxt,
+            )
+            cur = nxt
+        return cur
+
+
+def kernel_ladder(pallas_eligible: bool) -> Callable[[str], Optional[str]]:
+    """THE parity-twin ladder shared by the decode/FB/EM routers:
+    onehot -> pallas (when the dense kernels are eligible for this
+    model/backend) -> xla -> None.  One copy so a future rung change cannot
+    diverge per site; each router supplies its own eligibility predicate
+    (viterbi_pallas.supports vs fb_pallas.supports, on-TPU)."""
+
+    def twin(engine: str) -> Optional[str]:
+        if engine == "onehot":
+            return "pallas" if pallas_eligible else "xla"
+        if engine == "pallas":
+            return "xla"
+        return None
+
+    return twin
+
+
+_BREAKER: Optional[EngineBreaker] = None
+
+
+def get_breaker() -> EngineBreaker:
+    global _BREAKER
+    if _BREAKER is None:
+        _BREAKER = EngineBreaker()
+    return _BREAKER
+
+
+def set_breaker(breaker: Optional[EngineBreaker]) -> None:
+    """Install a process-global breaker (tests: inject a fake clock)."""
+    global _BREAKER
+    _BREAKER = breaker

@@ -1,0 +1,267 @@
+"""Resumable serving pipelines: per-record JSONL manifests.
+
+Training has checkpoints (``utils/checkpoint.py``); until now a killed
+decode of a 3 Gbase assembly restarted from symbol zero.  The manifest is
+the serving-side analogue: ``decode_file``/``posterior_file`` append one
+JSON line per COMPLETED record (its island calls serialized exactly, plus
+the per-record confidence contribution on the posterior path), flushed as
+each record lands.  A resumed run (``--resume``) validates the header
+(source fingerprint, model digest, output-affecting config), skips every
+completed record — reconstructing its calls from the manifest instead of
+recomputing — and produces byte-identical final output, because:
+
+- integers round-trip through JSON exactly;
+- the gc/oe floats are serialized as ``float.hex()`` (bit-exact f64
+  round-trip — ``%f`` re-formatting of a reconstructed value can therefore
+  never differ from the original run's);
+- records are the calling granularity (clean semantics call islands per
+  record), so skipping whole records cannot move any call.
+
+Crash tolerance: lines are appended + flushed per record, and the loader
+ignores a truncated final line — a kill mid-write costs at most the record
+being written.  A header that does not match the current run (edited
+source, different model, different ``min_len``/island states) discards the
+manifest with a warning and starts fresh: silently resuming across a
+semantic change would be corruption, recomputing is merely slower.
+
+Per-symbol streams (``state_path_out``, ``confidence_out``,
+``mpm_path_out``) are NOT resumable — the pipeline rejects manifests for
+runs that request them.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+import os
+from typing import Optional
+
+import numpy as np
+
+from cpgisland_tpu import obs
+
+log = logging.getLogger(__name__)
+
+MANIFEST_VERSION = 1
+
+
+def params_digest(params) -> str:
+    """Stable content digest of a model's tables (f64-normalized)."""
+    h = hashlib.sha256()
+    for leaf in (params.log_pi, params.log_A, params.log_B):
+        h.update(np.asarray(leaf, dtype=np.float64).tobytes())
+    return h.hexdigest()
+
+
+def source_fingerprint(path: str) -> dict:
+    st = os.stat(path)
+    return {"size": st.st_size, "mtime_ns": st.st_mtime_ns}
+
+
+def calls_to_wire(calls) -> Optional[dict]:
+    """IslandCalls -> JSON-safe dict with bit-exact float round-trip."""
+    if calls is None:
+        return None
+    return {
+        "beg": np.asarray(calls.beg).tolist(),
+        "end": np.asarray(calls.end).tolist(),
+        "length": np.asarray(calls.length).tolist(),
+        "gc": [float(v).hex() for v in np.asarray(calls.gc_content)],
+        "oe": [float(v).hex() for v in np.asarray(calls.oe_ratio)],
+        "names": (
+            None if calls.names is None else [str(n) for n in calls.names]
+        ),
+    }
+
+
+def calls_from_wire(wire: Optional[dict]):
+    """Inverse of :func:`calls_to_wire`; None stays None (a record that
+    contributed no IslandCalls entry)."""
+    if wire is None:
+        return None
+    from cpgisland_tpu.ops.islands import IslandCalls
+
+    return IslandCalls(
+        beg=np.asarray(wire["beg"], np.int64),
+        end=np.asarray(wire["end"], np.int64),
+        length=np.asarray(wire["length"], np.int64),
+        gc_content=np.asarray([float.fromhex(v) for v in wire["gc"]], np.float64),
+        oe_ratio=np.asarray([float.fromhex(v) for v in wire["oe"]], np.float64),
+        names=(
+            None if wire["names"] is None
+            else np.asarray(wire["names"], dtype=object)
+        ),
+    )
+
+
+class RunManifest:
+    """Append-only per-record completion log for one serving run.
+
+    ``header`` must contain every field that affects the output bytes
+    (mode, source path + fingerprint, model digest, min_len, island states,
+    invalid-symbol policy); a resumed run whose header differs starts
+    fresh.  Use as a context manager or ``close()`` in a ``finally``.
+    """
+
+    def __init__(self, path: str, *, header: dict, resume: bool) -> None:
+        self.path = path
+        self.header = {"kind": "run", "version": MANIFEST_VERSION, **header}
+        self._completed: dict[int, dict] = {}
+        self._valid_bytes = 0  # prefix of intact newline-terminated lines
+        self.skipped = 0  # records served from the manifest this run
+        loaded = bool(resume) and self._load()
+        d = os.path.dirname(os.path.abspath(path))
+        os.makedirs(d, exist_ok=True)
+        if loaded:
+            # Reconcile a truncated tail BEFORE appending: a kill mid-write
+            # leaves a partial final line, and appending straight after it
+            # would merge two lines into garbage that breaks the NEXT
+            # resume's parse (losing every record after it).
+            try:
+                if os.path.getsize(path) != self._valid_bytes:
+                    with open(path, "rb+") as f:
+                        f.truncate(self._valid_bytes)
+            except OSError:
+                loaded = False
+                self._completed.clear()
+        self._f = open(path, "a" if loaded else "w", encoding="utf-8")
+        if not loaded:
+            self._append(self.header)
+        else:
+            obs.event(
+                "manifest_resume", path=path,
+                records_completed=len(self._completed),
+            )
+            log.info(
+                "resuming from manifest %s: %d record(s) already complete",
+                path, len(self._completed),
+            )
+
+    # -- load ----------------------------------------------------------------
+
+    def _load(self) -> bool:
+        """Parse an existing manifest; False = absent/mismatched (start
+        fresh).  Tolerates a truncated final line (kill mid-append): the
+        intact newline-terminated prefix is kept (``_valid_bytes``, which
+        __init__ truncates to before appending — appending straight after a
+        partial line would merge two lines into garbage and break the NEXT
+        resume's parse)."""
+        try:
+            with open(self.path, encoding="utf-8") as f:
+                lines = f.read().splitlines(True)
+        except OSError:
+            return False
+        if not lines or not lines[0].endswith("\n"):
+            return False  # missing or truncated header: start fresh
+        try:
+            head = json.loads(lines[0])
+        except json.JSONDecodeError:
+            log.warning("manifest %s: unreadable header; starting fresh", self.path)
+            return False
+        if head != self.header:
+            diff = {
+                k for k in set(head) | set(self.header)
+                if head.get(k) != self.header.get(k)
+            }
+            log.warning(
+                "manifest %s does not match this run (differs in %s); "
+                "starting fresh — resuming across a semantic change would "
+                "corrupt the output", self.path, sorted(diff),
+            )
+            return False
+        self._valid_bytes = len(lines[0].encode("utf-8"))
+        for ln in lines[1:]:
+            if not ln.endswith("\n"):
+                # Killed mid-append: everything before this line is intact,
+                # which is the resume contract (the partial tail — even a
+                # complete JSON object missing only its newline — is
+                # dropped and recomputed).
+                log.warning(
+                    "manifest %s: discarding a truncated trailing line "
+                    "(killed mid-append)", self.path,
+                )
+                break
+            try:
+                rec = json.loads(ln)
+            except json.JSONDecodeError:
+                log.warning(
+                    "manifest %s: discarding an unparseable trailing line "
+                    "(killed mid-append)", self.path,
+                )
+                break
+            self._valid_bytes += len(ln.encode("utf-8"))
+            if rec.get("kind") == "record":
+                self._completed[int(rec["index"])] = rec
+        return True
+
+    # -- progress ------------------------------------------------------------
+
+    def completed(self, index: int, name: str, n_symbols: int) -> Optional[dict]:
+        """The completion record for this (index, name, size) — or None if
+        it must be (re)computed.  Identity mismatches (same index, different
+        record) discard the stale entry loudly."""
+        rec = self._completed.get(index)
+        if rec is None:
+            return None
+        if rec.get("name") != name or int(rec.get("n_symbols", -1)) != n_symbols:
+            log.warning(
+                "manifest %s: record %d is %r (%d symbols) on disk but %r "
+                "(%d symbols) in the input; recomputing it",
+                self.path, index, rec.get("name"), rec.get("n_symbols"),
+                name, n_symbols,
+            )
+            del self._completed[index]
+            return None
+        self.skipped += 1
+        return rec
+
+    def record_done(
+        self,
+        index: int,
+        name: str,
+        n_symbols: int,
+        *,
+        calls=None,
+        conf_sum: Optional[float] = None,
+        n_spans: int = 1,
+    ) -> None:
+        """Mark one record complete (idempotent for resumed entries)."""
+        if index in self._completed:
+            return
+        rec = {
+            "kind": "record",
+            "index": int(index),
+            "name": name,
+            "n_symbols": int(n_symbols),
+            "n_spans": int(n_spans),
+            "calls": calls_to_wire(calls),
+            "conf_sum": None if conf_sum is None else float(conf_sum).hex(),
+        }
+        self._completed[index] = rec
+        self._append(rec)
+
+    def span_done(self, index: int, span: int) -> None:
+        """Progress line for one span of a multi-span record (diagnostics
+        for killed runs; resume granularity stays the record)."""
+        self._append({"kind": "span", "index": int(index), "span": int(span)})
+
+    def _append(self, rec: dict) -> None:
+        self._f.write(json.dumps(rec) + "\n")
+        # Flush per line: a crash loses at most the line being written (the
+        # loader drops a truncated tail).  No fsync — per-record durability
+        # against OS crash is not worth a sync() per scaffold on network
+        # filesystems; a lost page just recomputes those records.
+        self._f.flush()
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def close(self) -> None:
+        if not self._f.closed:
+            self._f.close()
+
+    def __enter__(self) -> "RunManifest":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

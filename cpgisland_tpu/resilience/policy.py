@@ -1,0 +1,213 @@
+"""Dispatch supervision: bounded retries around blocking serving fetches.
+
+Every blocking dispatch on this setup crosses a relay that has been observed
+failing in fault shapes a production serving path must survive (CLAUDE.md):
+transient XlaRuntimeErrors (preemption, interconnect, remote-compile
+rejections), transient ~20x slowdowns, and phantom ~0 ms results.  The
+reference inherited retry + speculative re-execution from Hadoop's task
+runner; :class:`DispatchSupervisor` is that role here, scoped to ONE
+supervised unit = "(re)dispatch the device work and block on its fetch" —
+jit dispatch is pure, so re-running a unit is always safe.
+
+Rules of engagement:
+
+- **Never kill mid-execution.**  The relay wedges its tunnel claim if a JAX
+  process dies mid-TPU-execution (CLAUDE.md), so the supervisor NEVER
+  enforces a hard timeout on an attempt.  Attempts that exceed
+  ``slow_attempt_s`` are reported (``dispatch_slow`` event — the transient
+  ~20x-slowdown telemetry) but always allowed to finish.
+- **Fault-shaped errors only.**  ``RuntimeError`` (covers jaxlib's
+  XlaRuntimeError: OOM, preemption, interconnect — the same set
+  ``train.baum_welch.fit`` recovers from) and ``TimeoutError`` retry;
+  programming errors (ValueError/TypeError, incl. IslandCapOverflow, which
+  has its own dedicated retry) pass straight through, as does the obs
+  recompile sentinel's assertion error.
+- **Every attempt is ledgered.**  A ``dispatch_fault`` obs event per failed
+  attempt (what/engine/attempt/error/will_retry), so no retry is invisible
+  to the metrics stream; faults and successes also feed the engine breaker
+  (:mod:`~cpgisland_tpu.resilience.breaker`) when the unit names its engine.
+- **Recompute fallback.**  Deferred-fetch units (the overlapped pipeline's
+  dispatch-now/fetch-later split) may hold poisoned device buffers whose
+  fetch can never succeed; ``run(..., fallback=...)`` switches attempts
+  after the first failure to a caller-provided serial recompute closure
+  that re-derives the result from host inputs.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import random
+import time
+from typing import Callable, Optional
+
+from cpgisland_tpu import obs
+from cpgisland_tpu.obs.ledger import RecompileError
+
+log = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass(frozen=True)
+class RetryPolicy:
+    """Bounded-retry policy for one supervised dispatch unit.
+
+    Defaults are sized for the relay's observed fault profile: transient
+    faults clear within seconds, so 3 retries spanning ~0.2-3.2 s of
+    backoff recover them, while a persistent fault surfaces in < 5 s
+    instead of hanging a multi-hour genome run.
+    """
+
+    max_retries: int = 3
+    backoff_base_s: float = 0.2
+    backoff_factor: float = 4.0
+    backoff_max_s: float = 30.0
+    # Fraction of each delay randomized (+/-): herds of retrying workers
+    # must not re-slam a recovering relay in lockstep.
+    jitter: float = 0.25
+    # Advisory only (never-kill rule): attempts past this wall emit a
+    # dispatch_slow event but always run to completion.
+    slow_attempt_s: float = 300.0
+    retryable: tuple = (RuntimeError, TimeoutError)
+    # RecompileError is an assertion about a region, not a device fault —
+    # re-running the region would just compile again.
+    nonretryable: tuple = (RecompileError,)
+
+    def delay_s(self, attempt: int, rng: random.Random) -> float:
+        """Backoff before retry ``attempt`` (1-based), jittered."""
+        base = min(
+            self.backoff_base_s * self.backoff_factor ** (attempt - 1),
+            self.backoff_max_s,
+        )
+        if base <= 0.0:
+            return 0.0
+        return base * (1.0 + self.jitter * (2.0 * rng.random() - 1.0))
+
+
+class DispatchSupervisor:
+    """Retry wrapper for blocking serving-path dispatch units.
+
+    One instance per pipeline call (decode_file/posterior_file build their
+    own, optionally with an :class:`IntegritySentinel` attached); the
+    module-level :func:`default_supervisor` serves library entry points
+    invoked directly.  Thread-safe for the pipeline's single-consumer use
+    (the prefetch producer never dispatches).
+    """
+
+    def __init__(
+        self,
+        policy: Optional[RetryPolicy] = None,
+        *,
+        name: str = "serve",
+        sentinel=None,
+        breaker=None,
+    ) -> None:
+        from cpgisland_tpu.resilience import breaker as breaker_mod
+
+        self.policy = policy if policy is not None else RetryPolicy()
+        self.name = name
+        self.sentinel = sentinel
+        self.breaker = breaker if breaker is not None else breaker_mod.get_breaker()
+        self.retries = 0  # total retries performed (tests / telemetry)
+        # Deterministic per-supervisor jitter stream: reproducible runs,
+        # still decorrelated across workers (seeded by object identity).
+        self._rng = random.Random(id(self) & 0xFFFFFFFF)
+
+    # graftcheck: hot-path
+    def run(
+        self,
+        thunk: Callable[[], object],
+        *,
+        what: str,
+        engine: Optional[str] = None,
+        items: float = 0.0,
+        fallback: Optional[Callable[[], object]] = None,
+    ):
+        """Execute ``thunk`` (dispatch + blocking fetch) under the policy.
+
+        ``what`` labels the unit in obs events; ``engine`` (e.g.
+        ``"decode.onehot"``, ``"islands.device"``) additionally feeds the
+        engine breaker's fault/success accounting.  ``items`` (symbols)
+        lets the sentinel apply its throughput plausibility ceiling.
+        ``fallback``, when given, replaces the thunk from the second
+        attempt on (see module docstring).  The thunk's own host syncs must
+        route through ``obs.note_fetch`` like any hot-path fetch — the
+        supervisor adds no sync of its own.
+        """
+        pol = self.policy
+        attempt = 0
+        while True:
+            fn = thunk if attempt == 0 or fallback is None else fallback
+            t0 = time.perf_counter()
+            try:
+                out = fn()
+                dt = time.perf_counter() - t0
+                if self.sentinel is not None:
+                    # Raises PhantomResult (retryable) on a stale/phantom
+                    # or implausibly fast result.
+                    self.sentinel.verify(out, what=what, items=items, seconds=dt)
+                if self.breaker is not None and engine is not None:
+                    self.breaker.record_success(engine)
+                if dt > pol.slow_attempt_s:
+                    obs.event(
+                        "dispatch_slow", what=what, engine=engine,
+                        attempt=attempt, wall_s=round(dt, 3),
+                    )
+                    log.warning(
+                        "%s: dispatch unit %r took %.1f s (slow-attempt "
+                        "threshold %.0f s) — transient relay slowdown?",
+                        self.name, what, dt, pol.slow_attempt_s,
+                    )
+                return out
+            except pol.nonretryable:
+                raise
+            except pol.retryable as e:
+                dt = time.perf_counter() - t0
+                if self.breaker is not None and engine is not None:
+                    self.breaker.record_fault(engine, error=e)
+                attempt += 1
+                will_retry = attempt <= pol.max_retries
+                obs.event(
+                    "dispatch_fault",
+                    what=what,
+                    engine=engine,
+                    attempt=attempt,
+                    wall_s=round(dt, 3),
+                    error=f"{type(e).__name__}: {e}"[:200],
+                    will_retry=will_retry,
+                    recovery="recompute" if fallback is not None else "redispatch",
+                )
+                if not will_retry:
+                    log.error(
+                        "%s: dispatch unit %r failed %d times; giving up: %s",
+                        self.name, what, attempt, e,
+                    )
+                    raise
+                self.retries += 1
+                delay = pol.delay_s(attempt, self._rng)
+                log.warning(
+                    "%s: dispatch unit %r failed (attempt %d/%d): %s — "
+                    "%s in %.2f s",
+                    self.name, what, attempt, pol.max_retries + 1, e,
+                    "recomputing serially" if fallback is not None
+                    else "re-dispatching", delay,
+                )
+                if delay > 0.0:
+                    time.sleep(delay)
+
+
+_DEFAULT: Optional[DispatchSupervisor] = None
+
+
+def default_supervisor() -> DispatchSupervisor:
+    """The process-wide supervisor used when a library entry point is
+    called without one (pipeline calls construct their own so per-run
+    sentinels/policies apply)."""
+    global _DEFAULT
+    if _DEFAULT is None:
+        _DEFAULT = DispatchSupervisor(name="default")
+    return _DEFAULT
+
+
+def supervise(thunk: Callable[[], object], **kwargs):
+    """``default_supervisor().run(thunk, **kwargs)`` — convenience form."""
+    return default_supervisor().run(thunk, **kwargs)

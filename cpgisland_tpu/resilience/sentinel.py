@@ -1,0 +1,172 @@
+"""Result-integrity sentinel: bench.py's phantom defenses as a serving guard.
+
+The degraded relay has served PHANTOM results — ``block_until_ready``
+returning in ~0 ms without execution, even for fresh programs with distinct
+inputs (CLAUDE.md r4).  bench.py defends its measurements with a layered
+discipline (every timing rep fetches a small output, folds a distinct seed
+into its input, and plausibility ceilings raise on absurd rates) — but
+until now those defenses lived ONLY in the benchmark, while a production
+decode could silently emit islands from a path that never computed.
+
+:class:`IntegritySentinel` generalizes the same three defenses into an
+opt-in per-dispatch guard (``--integrity-check``) the dispatch supervisor
+invokes after every supervised unit:
+
+- **Canary fetch with a distinct seed fold** — a tiny FRESH program per
+  dispatch, data-dependent on the unit's result, whose expected output the
+  host computes independently (``seed * 2 + 1``).  A phantom/stale reply
+  cannot reproduce the fresh seed's fold, so the mismatch is deterministic;
+  a NaN-poisoned result poisons the canary and is caught the same way.
+- **Plausibility ceilings** — the unit's sym/s checked against
+  :mod:`cpgisland_tpu.obs.watchdog`'s per-path ceilings (2.5x the enforced
+  BASELINE.md figures, scaled by device count) and the global net.
+- **Re-dispatch on detection** — a violation raises :class:`PhantomResult`
+  (fault-shaped), so the supervisor re-dispatches the unit under its normal
+  bounded-retry policy instead of publishing a fantasy result.
+
+Cost when enabled: one scalar-shaped canary dispatch + fetch per supervised
+unit (a relay round trip) — which is exactly why it is opt-in rather than
+always on.  Off by default, zero dispatches added.
+"""
+
+from __future__ import annotations
+
+import itertools
+import logging
+from typing import Optional
+
+import numpy as np
+
+from cpgisland_tpu import obs
+
+log = logging.getLogger(__name__)
+
+
+class PhantomResult(RuntimeError):
+    """A supervised dispatch returned a result that failed integrity checks
+    (stale/phantom relay reply or implausible throughput).  Fault-shaped on
+    purpose: the supervisor's retry policy re-dispatches it."""
+
+
+# what-prefix -> watchdog path (BASELINE.md marker family) for the
+# throughput ceiling; prefixes without a marker get only the global net.
+_WHAT_PATH = {"decode": "decode", "posterior": "posterior"}
+
+_canary_seed = itertools.count(1)
+_CANARY_JIT = None
+
+
+def _canary_fn():
+    global _CANARY_JIT
+    if _CANARY_JIT is None:
+        import jax
+        import jax.numpy as jnp
+
+        def _impl(probe, seed):
+            p32 = probe.astype(jnp.float32)
+            # Data dependence on the supervised unit's result: a phantom
+            # dispatch cannot reproduce the fresh seed fold, and a
+            # NaN-poisoned result poisons the canary itself.
+            return jnp.where(jnp.isnan(p32), p32, seed * 2.0 + 1.0)
+
+        _CANARY_JIT = jax.jit(_impl)
+    return _CANARY_JIT
+
+
+def _probe_scalar(out):
+    """A 0-d element of the first non-empty array leaf of ``out`` (device
+    arrays index lazily — the canary program is the one that blocks), or
+    None when the result holds no checkable array."""
+    import jax
+
+    for leaf in jax.tree_util.tree_leaves(out):
+        shape = getattr(leaf, "shape", None)
+        if shape is None or getattr(leaf, "size", 0) == 0:
+            continue
+        dt = getattr(leaf, "dtype", None)
+        if dt is None or dt.kind not in "fiub":
+            continue
+        if not getattr(leaf, "is_fully_addressable", True):
+            # Multi-host global arrays: indexing would need a collective;
+            # the addressable paths cover the canary's purpose.
+            continue
+        return leaf[(0,) * len(shape)]
+    return None
+
+
+class IntegritySentinel:
+    """Per-dispatch phantom/stale-result detector (see module docstring).
+
+    ``canary=False`` keeps only the throughput ceilings (no extra dispatch);
+    ``factor`` is the per-path ceiling multiplier over the BASELINE.md
+    figures (bench parity: 2.5).
+    """
+
+    def __init__(
+        self, *, canary: bool = True, factor: Optional[float] = None
+    ) -> None:
+        from cpgisland_tpu.obs.watchdog import DEFAULT_CEILING_FACTOR, Watchdog
+
+        self.canary = canary
+        # mode="warn": the watchdog logs + records; the SENTINEL owns the
+        # raise (as PhantomResult, so the supervisor re-dispatches).
+        self.watchdog = Watchdog(
+            mode="warn",
+            factor=factor if factor is not None else DEFAULT_CEILING_FACTOR,
+        )
+        self.checks = 0
+        self.violations: list[dict] = []
+
+    # The indirection exists for tests: patching _canary_value simulates a
+    # stale relay reply without needing a degraded relay.
+    def _canary_value(self, probe, seed: int) -> float:
+        import jax.numpy as jnp
+
+        return float(
+            obs.note_fetch(np.asarray(_canary_fn()(probe, jnp.float32(seed))))
+        )
+
+    def verify(self, out, *, what: str, items: float = 0.0, seconds: float = 0.0) -> None:
+        """Check one supervised unit's result; raises :class:`PhantomResult`
+        on violation, returns None otherwise."""
+        self.checks += 1
+        path = _WHAT_PATH.get(what.split(".", 1)[0])
+        rec = self.watchdog.check(what, items, seconds, path=path)
+        if rec is not None:
+            self._violation(
+                what,
+                kind="implausible_throughput",
+                detail=(
+                    f"{rec['msym_per_s']} Msym/s exceeds the "
+                    f"{rec['ceiling_msym_per_s']} Msym/s ceiling"
+                ),
+            )
+        if not self.canary:
+            return
+        probe = _probe_scalar(out)
+        if probe is None:
+            return
+        seed = next(_canary_seed) % (1 << 20)
+        got = self._canary_value(probe, seed)
+        want = float(seed * 2 + 1)
+        if got != want:
+            self._violation(
+                what,
+                kind="canary_mismatch",
+                detail=(
+                    f"canary expected {want}, got {got} — stale/phantom "
+                    "device result (the fresh seed fold did not execute)"
+                    if got == got else
+                    f"canary returned NaN — the unit's result is poisoned"
+                ),
+            )
+
+    def _violation(self, what: str, *, kind: str, detail: str) -> None:
+        rec = {"what": what, "kind": kind, "detail": detail}
+        self.violations.append(rec)
+        obs.event("integrity_violation", **rec)
+        log.warning(
+            "integrity sentinel: %s in %r: %s — re-dispatching", kind, what,
+            detail,
+        )
+        raise PhantomResult(f"{kind} in {what!r}: {detail}")

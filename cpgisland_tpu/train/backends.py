@@ -36,9 +36,34 @@ from cpgisland_tpu.parallel.mesh import make_mesh
 from cpgisland_tpu.utils import chunking
 
 
+def _em_engine_twin(engine: str, params: HmmParams) -> "Optional[str]":
+    """Parity-twin ladder for the E-step engines (the resilience breaker's
+    fallback map, keyed ``em.<engine>`` — the shared
+    resilience.breaker.kernel_ladder with the E-step eligibility)."""
+    from cpgisland_tpu.resilience.breaker import kernel_ladder
+
+    return kernel_ladder(
+        jax.default_backend() == "tpu" and fb_pallas.supports(params)
+    )(engine)
+
+
 def resolve_fb_engine(engine: str, params: HmmParams, mode: str) -> str:
     """'auto' picks the Pallas E-step kernels on TPU for rescaled numerics
-    (the only mode they implement), the XLA scans otherwise."""
+    (the only mode they implement), the XLA scans otherwise.  Under
+    'auto', engines tripped by the resilience breaker demote down the
+    parity-twin ladder for the cooldown window — ``fit``'s host-loop
+    recovery records the faults, and backends re-resolve per call, so a
+    trip reroutes the NEXT iteration.  Explicit engine requests are
+    honored as-is (see parallel.decode.resolve_engine)."""
+    from cpgisland_tpu import resilience
+
+    def _degrade(resolved: str) -> str:
+        # xla implements both numerics modes, so the ladder is always
+        # mode-eligible (the tripped rungs are the rescaled-only kernels).
+        return resilience.get_breaker().degrade(
+            "em", resolved, lambda e: _em_engine_twin(e, params)
+        )
+
     if engine == "auto":
         resolved = "xla"
         if (
@@ -67,7 +92,7 @@ def resolve_fb_engine(engine: str, params: HmmParams, mode: str) -> str:
             site="train.resolve_fb_engine", choice=resolved,
             requested=engine, mode=mode,
         )
-        return resolved
+        return _degrade(resolved)
     if engine not in ("xla", "pallas", "onehot"):
         raise ValueError(
             f"unknown engine {engine!r}; expected auto|xla|pallas|onehot"

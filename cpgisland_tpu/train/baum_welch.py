@@ -131,6 +131,24 @@ def _fused_em_fn(stats_fn, num_iters: int, with_prep: bool = False):
     return jax.jit(run)
 
 
+def _em_breaker_key(backend, params: HmmParams) -> Optional[str]:
+    """Breaker key for the backend's currently-resolved E-step engine
+    (``em.onehot``/``em.pallas``/``em.xla``), or None for duck-typed
+    backends without routing attributes.  Resolved at FAULT time so a trip
+    attributes to the engine that actually ran — and since backends
+    re-resolve per call, the recorded trip reroutes the next iteration."""
+    eng = getattr(backend, "engine", None)
+    mode = getattr(backend, "mode", None)
+    if not isinstance(eng, str) or not isinstance(mode, str):
+        return None
+    try:
+        from cpgisland_tpu.train.backends import resolve_fb_engine
+
+        return f"em.{resolve_fb_engine(eng, params, mode)}"
+    except Exception:
+        return None
+
+
 def _fuse_blocked_reason(
     checkpoint_dir, callback, fallback_backend, start_iteration
 ) -> Optional[str]:
@@ -375,6 +393,11 @@ def fit(
                     cand = backend(params, chunks, lengths)
                     profiling.check_finite(cand, where=f"E-step iter {it}")
                     stats = cand
+                    key = _em_breaker_key(backend, params)
+                    if key is not None:
+                        from cpgisland_tpu import resilience
+
+                        resilience.get_breaker().record_success(key)
                     break
                 # Only fault-shaped errors are retried/recovered: RuntimeError
                 # covers jaxlib's XlaRuntimeError (OOM, preemption,
@@ -382,6 +405,14 @@ def fit(
                 # Programming errors (ValueError/TypeError) must surface, not
                 # reroute to a fallback.
                 except (RuntimeError, FloatingPointError) as e:
+                    # Feed the engine breaker: repeated kernel-shaped faults
+                    # trip the engine, and the per-call re-resolution above
+                    # then demotes the NEXT iteration to the parity twin.
+                    key = _em_breaker_key(backend, params)
+                    if key is not None:
+                        from cpgisland_tpu import resilience
+
+                        resilience.get_breaker().record_fault(key, error=e)
                     reason = f"iter {it} attempt {attempt + 1}: {e}"
                     log.warning("E-step failed (%s)", reason)
                     if metrics is not None:

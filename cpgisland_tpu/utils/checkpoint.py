@@ -19,6 +19,7 @@ over a directory containing either.  The reference's plain-text dump
 
 from __future__ import annotations
 
+import logging
 import os
 import tempfile
 from dataclasses import dataclass, field
@@ -27,6 +28,8 @@ from typing import Optional
 import numpy as np
 
 from cpgisland_tpu.models.hmm import HmmParams
+
+log = logging.getLogger(__name__)
 
 
 def _import_orbax():
@@ -93,6 +96,12 @@ def save(path: str, state: TrainState, format: str = "npz") -> None:
     try:
         with os.fdopen(fd, "wb") as f:
             np.savez(f, **_state_tree(state))
+            # fsync BEFORE the rename: os.replace is atomic against a
+            # process kill, but without the sync a machine crash can leave
+            # the renamed file with unwritten pages — exactly the truncated
+            # checkpoint latest() must then skip.
+            f.flush()
+            os.fsync(f.fileno())
         os.replace(tmp, path)
     except BaseException:
         if os.path.exists(tmp):
@@ -114,12 +123,23 @@ def load(path: str) -> TrainState:
         return _state_from_tree(z)
 
 
-def latest(directory: str, prefix: str = "ckpt_") -> Optional[str]:
-    """Path of the highest-iteration checkpoint in a directory (either
-    format), or None."""
+def latest(
+    directory: str, prefix: str = "ckpt_", validate: bool = True
+) -> Optional[str]:
+    """Path of the highest-iteration LOADABLE checkpoint in a directory
+    (either format), or None.
+
+    ``validate=True`` (default) actually loads each candidate, newest
+    first, and SKIPS corrupt or truncated files with a warning instead of
+    letting resume crash on them — a killed run's half-written snapshot
+    (or a machine crash's unsynced pages) must cost one iteration of
+    progress, not the whole resume.  The models here are ~100 parameters,
+    so a validation load is microseconds.  ``validate=False`` restores the
+    old name-only behavior.
+    """
     if not os.path.isdir(directory):
         return None
-    best: tuple[int, Optional[str]] = (-1, None)
+    candidates: list[tuple[int, str]] = []
     for name in os.listdir(directory):
         if not name.startswith(prefix):
             continue
@@ -131,9 +151,19 @@ def latest(directory: str, prefix: str = "ckpt_") -> Optional[str]:
         full = os.path.join(directory, name)
         if not (name.endswith(".npz") or os.path.isdir(full)):
             continue
-        if it > best[0]:
-            best = (it, full)
-    return best[1]
+        candidates.append((it, full))
+    for _, full in sorted(candidates, reverse=True):
+        if not validate:
+            return full
+        try:
+            load(full)
+            return full
+        except Exception as e:
+            log.warning(
+                "skipping corrupt/truncated checkpoint %s (%s: %s); trying "
+                "the previous snapshot", full, type(e).__name__, e,
+            )
+    return None
 
 
 def checkpoint_path(

@@ -37,23 +37,104 @@ for _ch, _val in ((b"Aa", A), (b"Cc", C), (b"Gg", G), (b"Tt", T)):
 
 _BASE_CHARS = np.array([ord("a"), ord("c"), ord("g"), ord("t")], dtype=np.uint8)
 
+# ---------------------------------------------------------------------------
+# Invalid-symbol policy (the Hadoop skip-bad-records parity knob).
+#
+# The reference silently drops EVERY non-base character (its char loop,
+# CpGIslandFinder.java:112-128) — that stays the default ("skip"), because
+# compat mode owes the reference byte-fidelity and clean mode inherits it
+# for backward compatibility.  The explicit policies make the behavior a
+# decision instead of an accident:
+#   - "skip": drop invalid bytes (reference semantics; Hadoop with
+#     skip-bad-records ENABLED);
+#   - "mask": encode invalid bytes as the PAD sentinel (N_SYMBOLS) — an
+#     identity DP step, so N runs decode through exactly and island
+#     coordinates keep matching the original FASTA positions;
+#   - "fail": raise InvalidSymbolError on the first invalid byte (Hadoop's
+#     DEFAULT — a bad record fails the job unless skipping is opted into).
+# Structural whitespace is never "invalid" — line breaks are file format,
+# not data.  Counts surface as one ``invalid_symbols`` obs event per file
+# whenever a non-default policy is engaged.
 
-def encode_bytes(data: Union[bytes, bytearray, memoryview, np.ndarray]) -> np.ndarray:
-    """Encode raw sequence bytes to a uint8 symbol array, dropping non-bases.
+INVALID_POLICIES = ("skip", "mask", "fail")
+MASK_SYMBOL = N_SYMBOLS  # == the chunking PAD sentinel: an identity DP step
 
-    Mirrors the reference's char loop (CpGIslandFinder.java:112-128) — every
-    character that is not one of ACGTacgt is skipped.
+_WS_LUT = np.zeros(256, dtype=bool)
+for _b in b" \t\r\n\v\f":
+    _WS_LUT[_b] = True
+
+
+class InvalidSymbolError(ValueError):
+    """A byte that is neither a base nor whitespace under ``invalid='fail'``."""
+
+    def __init__(self, count: int, first_byte: int, first_offset: int):
+        super().__init__(
+            f"{count} invalid symbol byte(s) in the input (first: "
+            f"{bytes([first_byte])!r} at buffer offset {first_offset}); "
+            "pass invalid='skip' to drop them (the reference's behavior) or "
+            "invalid='mask' to encode them as the PAD sentinel"
+        )
+        self.count = count
+        self.first_byte = first_byte
+        self.first_offset = first_offset
+
+
+def _check_policy(invalid: str) -> None:
+    if invalid not in INVALID_POLICIES:
+        raise ValueError(
+            f"invalid-symbol policy must be one of {INVALID_POLICIES}, "
+            f"got {invalid!r}"
+        )
+
+
+def _note_invalid(path: str, policy: str, count: int) -> None:
+    if count <= 0:
+        return
+    from cpgisland_tpu import obs
+
+    obs.event("invalid_symbols", path=path, policy=policy, count=int(count))
+
+
+def encode_bytes(
+    data: Union[bytes, bytearray, memoryview, np.ndarray],
+    *,
+    invalid: str = "skip",
+    _count=None,
+) -> np.ndarray:
+    """Encode raw sequence bytes to a uint8 symbol array.
+
+    ``invalid="skip"`` (default) mirrors the reference's char loop
+    (CpGIslandFinder.java:112-128) — every character that is not one of
+    ACGTacgt is dropped.  See the invalid-symbol policy block above for
+    "mask"/"fail".  ``_count`` (internal): one-element list accumulating
+    the invalid-byte count across streamed blocks.
     """
     raw = np.frombuffer(data, dtype=np.uint8) if not isinstance(data, np.ndarray) else data
+    if invalid == "skip" and _count is None:
+        coded = _LUT[raw]
+        return coded[coded != SKIP]
+    _check_policy(invalid)
     coded = _LUT[raw]
-    return coded[coded != SKIP]
+    is_base = coded != SKIP
+    inv = ~is_base & ~_WS_LUT[raw]
+    n_inv = int(inv.sum())
+    if _count is not None:
+        _count[0] += n_inv
+    if n_inv and invalid == "fail":
+        off = int(np.flatnonzero(inv)[0])
+        raise InvalidSymbolError(n_inv, int(raw[off]), off)
+    if invalid == "mask":
+        keep = is_base | inv
+        return np.where(inv, np.uint8(MASK_SYMBOL), coded)[keep]
+    return coded[is_base]
 
 
-def encode(text: Union[str, bytes]) -> np.ndarray:
-    """Encode a string (or bytes) of sequence text. Non-base characters skipped."""
+def encode(text: Union[str, bytes], *, invalid: str = "skip") -> np.ndarray:
+    """Encode a string (or bytes) of sequence text. Non-base characters skipped
+    (or masked/failed under an explicit ``invalid`` policy)."""
     if isinstance(text, str):
         text = text.encode("ascii", errors="replace")
-    return encode_bytes(text)
+    return encode_bytes(text, invalid=invalid)
 
 
 def strip_fasta_headers(data: bytes) -> bytes:
@@ -68,6 +149,7 @@ def iter_encoded_blocks(
     read_size: int = 1 << 24,
     start: int = 0,
     end: Optional[int] = None,
+    invalid: str = "skip",
 ) -> Iterator[np.ndarray]:
     """Stream-encode a file (or a byte range of it) in bounded-memory blocks.
 
@@ -80,32 +162,50 @@ def iter_encoded_blocks(
     ``start``/``end`` bound the byte range (the multi-host sharded-encode
     path, :func:`encode_byte_range`); ``start`` MUST be a line start so the
     header state machine begins clean.
+
+    A non-default ``invalid`` policy routes through the NumPy path (the
+    native kernel bakes in skip semantics) and emits one
+    ``invalid_symbols`` obs event for the file when bytes were affected.
     """
-    fasta_enc = native.FastaEncoder() if skip_headers else None
-    use_native = fasta_enc.available if skip_headers else native.available()
+    _check_policy(invalid)
+    fasta_enc = (
+        native.FastaEncoder() if skip_headers and invalid == "skip" else None
+    )
+    use_native = (
+        fasta_enc.available if fasta_enc is not None
+        else (native.available() and invalid == "skip")
+    )
+    count = [0]
     in_header, at_line_start = False, True
-    with open(path, "rb", buffering=0) as f:
-        if start:
-            f.seek(start)
-        remaining = None if end is None else end - start
-        while remaining is None or remaining > 0:
-            data = f.read(
-                read_size if remaining is None else min(read_size, remaining)
-            )
-            if not data:
-                return
-            if remaining is not None:
-                remaining -= len(data)
-            if use_native:
-                syms = fasta_enc.feed(data) if skip_headers else native.encode(data)
-            else:
-                if skip_headers:
-                    data, in_header, at_line_start = _strip_headers_stateful(
-                        data, in_header, at_line_start
+    try:
+        with open(path, "rb", buffering=0) as f:
+            if start:
+                f.seek(start)
+            remaining = None if end is None else end - start
+            while remaining is None or remaining > 0:
+                data = f.read(
+                    read_size if remaining is None else min(read_size, remaining)
+                )
+                if not data:
+                    return
+                if remaining is not None:
+                    remaining -= len(data)
+                if use_native:
+                    syms = fasta_enc.feed(data) if skip_headers else native.encode(data)
+                else:
+                    if skip_headers:
+                        data, in_header, at_line_start = _strip_headers_stateful(
+                            data, in_header, at_line_start
+                        )
+                    syms = encode_bytes(
+                        data, invalid=invalid,
+                        _count=count if invalid != "skip" else None,
                     )
-                syms = encode_bytes(data)
-            if syms.size:
-                yield syms
+                if syms.size:
+                    yield syms
+    finally:
+        if invalid != "skip":
+            _note_invalid(path, invalid, count[0])
 
 
 def _strip_headers_stateful(
@@ -147,31 +247,42 @@ def _strip_headers_stateful(
 _MT_THRESHOLD = 8 << 20
 
 
-def encode_file(path: str, *, skip_headers: bool = False, threads: int = 0) -> np.ndarray:
+def encode_file(
+    path: str,
+    *,
+    skip_headers: bool = False,
+    threads: int = 0,
+    invalid: str = "skip",
+) -> np.ndarray:
     """Encode an entire file into one symbol array.
 
     Large files take the multithreaded native path (native/codec.cpp
     segments API: parallel per-segment count, then write at exact offsets, so
     peak memory is file size + symbol count); small files and library-less
-    environments stream through :func:`iter_encoded_blocks`.
+    environments stream through :func:`iter_encoded_blocks`.  A non-default
+    ``invalid`` policy (mask/fail — see the policy block above) always
+    streams through the NumPy path.
     """
+    _check_policy(invalid)
     try:
         size = os.path.getsize(path)
     except OSError:
         size = 0
-    if size >= _MT_THRESHOLD and native.available():
+    if size >= _MT_THRESHOLD and native.available() and invalid == "skip":
         data = np.fromfile(path, dtype=np.uint8)
         out = native.encode_mt(data, fasta=skip_headers, threads=threads)
         if out is not None:
             return out
-    blocks = list(iter_encoded_blocks(path, skip_headers=skip_headers))
+    blocks = list(
+        iter_encoded_blocks(path, skip_headers=skip_headers, invalid=invalid)
+    )
     if not blocks:
         return np.zeros(0, dtype=np.uint8)
     return np.concatenate(blocks)
 
 
 def iter_fasta_records(
-    path: str, *, read_size: int = 1 << 24
+    path: str, *, read_size: int = 1 << 24, invalid: str = "skip"
 ) -> Iterator[tuple[str, np.ndarray]]:
     """Stream (name, symbols) per FASTA record in bounded memory per block.
 
@@ -184,17 +295,23 @@ def iter_fasta_records(
 
     Blocks without a '>' take a bulk-encode fast path (native kernel when
     available), so multi-GiB single-chromosome files stream at codec speed.
+    A non-default ``invalid`` policy (mask/fail) routes through the NumPy
+    encode and emits one ``invalid_symbols`` obs event for the file.
     """
+    _check_policy(invalid)
     name = ""
     bufs: list[np.ndarray] = []
     have_record = False
     in_header = False
     header_frag = b""
     at_line_start = True
+    count = [0]
 
     def _bulk(seg: Union[bytes, memoryview]) -> Optional[np.ndarray]:
         if isinstance(seg, memoryview):
             seg = bytes(seg)
+        if invalid != "skip":
+            return encode_bytes(seg, invalid=invalid, _count=count)
         out = native.encode(seg)
         return out if out is not None else encode_bytes(seg)
 
@@ -251,6 +368,8 @@ def iter_fasta_records(
         name = header_frag.decode("ascii", "replace").split()[0]
     if have_record:
         yield name, _concat(bufs)
+    if invalid != "skip":
+        _note_invalid(path, invalid, count[0])
 
 
 def _concat(bufs: list) -> np.ndarray:
@@ -421,14 +540,19 @@ def open_symbol_cache(path: str, cache: str):
 
 
 def encode_file_cached(
-    path: str, cache: Optional[str], *, skip_headers: bool
+    path: str, cache: Optional[str], *, skip_headers: bool,
+    invalid: str = "skip",
 ) -> np.ndarray:
     """encode_file with an optional read-through symbol cache.
 
     Cache semantics are FASTA-aware (headers stripped), so only
     ``skip_headers=True`` (clean mode) can be served from it; the compat
-    encoding falls through to a direct parse.
+    encoding falls through to a direct parse.  Caches store skip-encoded
+    symbols, so a non-default ``invalid`` policy bypasses them.
     """
+    if invalid != "skip":
+        _check_policy(invalid)
+        return encode_file(path, skip_headers=skip_headers, invalid=invalid)
     if cache is None or not skip_headers:
         return encode_file(path, skip_headers=skip_headers)
     hit = open_symbol_cache(path, cache)
@@ -440,14 +564,28 @@ def encode_file_cached(
     return hit[2]
 
 
-def iter_fasta_records_cached(path: str, cache: Optional[str] = None):
+def iter_fasta_records_cached(
+    path: str, cache: Optional[str] = None, *, invalid: str = "skip"
+):
     """iter_fasta_records with an optional read-through symbol cache.
 
     ``cache`` is a file prefix (e.g. the FASTA path itself): a valid cache
     yields memmap slices (no parse, no copy — the repeat-run fast path); a
     missing/stale one is built first, then served.  ``cache=None`` streams
-    the file directly.
+    the file directly.  Caches store skip-encoded symbols, so a
+    non-default ``invalid`` policy bypasses them (logged once).
     """
+    if invalid != "skip":
+        _check_policy(invalid)
+        if cache is not None:
+            import logging
+
+            logging.getLogger(__name__).info(
+                "symbol cache bypassed: invalid-symbol policy %r differs "
+                "from the cache's skip encoding", invalid,
+            )
+        yield from iter_fasta_records(path, invalid=invalid)
+        return
     if cache is None:
         yield from iter_fasta_records(path)
         return

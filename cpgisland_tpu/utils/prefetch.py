@@ -46,11 +46,18 @@ class RecordPrefetcher:
     producer thread is joined there, never abandoned.
     """
 
-    def __init__(self, it: Iterable, depth: int = 2, name: str = "records"):
+    def __init__(
+        self,
+        it: Iterable,
+        depth: int = 2,
+        name: str = "records",
+        join_timeout_s: float = 30.0,
+    ):
         if depth < 1:
             raise ValueError(f"prefetch depth must be >= 1, got {depth}")
         self.name = name
         self.depth = depth
+        self.join_timeout_s = join_timeout_s
         self._q: queue.Queue = queue.Queue(maxsize=depth)
         self._stop = threading.Event()
         self._closed = False
@@ -126,38 +133,49 @@ class RecordPrefetcher:
             return
         self._closed = True
         self._stop.set()
-        # Unblock a producer waiting on a full queue, then join: close is
-        # deterministic — no daemon thread outlives the pipeline call.
-        while True:
-            try:
-                self._q.get_nowait()
-            except queue.Empty:
+        # Join in short slices, draining the queue between them: a producer
+        # blocked on a FULL queue (its put slot could be re-filled between a
+        # single drain and the join) always finds room to observe the stop
+        # flag, so close is deterministic for every producer that is not
+        # stuck inside next(it) itself — consumer-side pipeline errors
+        # mid-stream included, not just clean exhaustion.
+        deadline = time.perf_counter() + self.join_timeout_s
+        while self._thread.is_alive():
+            while True:
+                try:
+                    self._q.get_nowait()
+                except queue.Empty:
+                    break
+            self._thread.join(timeout=0.05)
+            if time.perf_counter() >= deadline:
                 break
-        self._thread.join(timeout=30.0)
         if self._thread.is_alive():
             # The producer is stuck inside a long next(it) (e.g. a huge
             # record's encode on a slow filesystem) and cannot observe the
             # stop flag until it returns.  The daemon flag keeps it from
-            # blocking interpreter exit, but a later thread-hygiene check
-            # or cache-file reopen may trip over it — say so loudly
-            # instead of failing there with no diagnostic.
+            # blocking interpreter exit; a finalizer thread takes over the
+            # generator close the moment the producer does return, so the
+            # wrapped iterator's resources (open FASTA handles) are still
+            # released deterministically-on-exit rather than at GC time.
             log.warning(
-                "prefetch producer %r still running after 30 s join "
-                "timeout (stuck in the underlying record iterator); "
-                "leaving the daemon thread to finish on its own",
-                self._thread.name,
+                "prefetch producer %r still running after %.0f s join "
+                "timeout (stuck in the underlying record iterator); a "
+                "finalizer thread will close the wrapped iterator when it "
+                "returns",
+                self._thread.name, self.join_timeout_s,
             )
+            threading.Thread(
+                target=_join_then_close,
+                args=(self._thread, self._it),
+                name=f"{self._thread.name}-finalizer",
+                daemon=True,
+            ).start()
         else:
             # Producer exited: release the wrapped generator's resources
             # (file handles of an abandoned mid-file FASTA parse) now, not
             # at GC time.  Safe only here — a generator cannot be closed
             # while another thread is executing it.
-            close = getattr(self._it, "close", None)
-            if close is not None:
-                try:
-                    close()
-                except Exception:
-                    pass
+            _close_iter(self._it)
         overlap_s = max(0.0, self.produce_s - self.stall_s)
         obs.event(
             "prefetch_stream",
@@ -186,12 +204,34 @@ class RecordPrefetcher:
         self.close()
 
 
+def _close_iter(it) -> None:
+    """close() a wrapped generator if it has one; never raises (the close
+    runs on error paths that must keep the ORIGINAL exception)."""
+    close = getattr(it, "close", None)
+    if close is not None:
+        try:
+            close()
+        except Exception:
+            log.warning("closing the wrapped record iterator failed", exc_info=True)
+
+
+def _join_then_close(thread: threading.Thread, it) -> None:
+    """Finalizer-thread body: wait out a producer stuck in next(it), then
+    release the wrapped generator (a generator cannot be closed while
+    another thread is executing it)."""
+    thread.join()
+    _close_iter(it)
+
+
 def maybe_prefetch(it: Iterable, depth: int, name: str):
     """``depth > 0`` wraps ``it`` in a RecordPrefetcher, else returns it
     unchanged — the one switch the pipeline entry points use.  Returns
-    (iterable, closer): ``closer()`` is a no-op in the serial case, so call
-    sites hold exactly one ``finally``."""
+    (iterable, closer), so call sites hold exactly one ``finally``.  The
+    serial closer closes the wrapped generator: a consumer-side pipeline
+    error mid-stream must release the underlying FASTA handle
+    deterministically in BOTH modes, not only when the prefetch thread is
+    in play."""
     if depth and depth > 0:
         pf = RecordPrefetcher(it, depth=depth, name=name)
         return pf, pf.close
-    return it, lambda: None
+    return it, lambda: _close_iter(it)

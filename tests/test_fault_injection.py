@@ -8,15 +8,23 @@ policy claims to catch (train/baum_welch.py: "RuntimeError covers jaxlib's
 XlaRuntimeError (OOM, preemption, interconnect)").  This closes the r1 gap
 where the retry path was only ever exercised against hand-raised Python
 exceptions.
+
+The second half drives the SERVING paths the same way: the sharded
+decode/posterior programs are wrapped so their outputs flow through a
+raising callback, and ``decode_file``/``posterior_file`` must recover
+through the resilience dispatch supervisor with bit-identical final
+output — both island engines, span-threaded records, prefetch on and off.
 """
 
 import functools
+import io
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
 
+from cpgisland_tpu import pipeline, resilience
 from cpgisland_tpu.models import presets
 from cpgisland_tpu.ops.forward_backward import SuffStats, batch_stats
 from cpgisland_tpu.train import backends, baum_welch
@@ -135,6 +143,247 @@ def test_fit_raises_after_exhausted_injit_retries(rng):
             presets.durbin_cpg8(), _chunked(rng), num_iters=1, convergence=0.0,
             backend=bad,
         )
+
+
+@pytest.fixture(autouse=True)
+def _fresh_resilience_state():
+    """Injected faults feed the global engine breaker; they must not trip
+    engines for later tests."""
+    resilience.reset()
+    yield
+    resilience.reset()
+
+
+def _poke_through_callback(fail_times: int):
+    """A device-fault injector for real serving programs.
+
+    ``poke(x)`` runs a SCALAR jitted pure_callback that raises for its
+    first ``fail_times`` executions and folds the (zeroed) result back
+    into ``x`` — so the fault is a real in-jit failure raised during
+    device execution of the record's computation, surfacing as a
+    RuntimeError inside the supervised dispatch unit.  The callback
+    program is deliberately scalar/single-device: a raising callback
+    inside a multi-device gather of the sharded output wedges the other
+    seven virtual devices at the collective rendezvous forever (observed:
+    XLA:CPU AllReduce participants waiting on the failed rank)."""
+    state = {"execs": 0}
+
+    def guard(v):
+        state["execs"] += 1
+        if state["execs"] <= fail_times:
+            raise RuntimeError("injected device fault")
+        return v
+
+    @jax.jit
+    def gate(v):
+        return jax.pure_callback(
+            guard, jax.ShapeDtypeStruct((), jnp.float32), v
+        )
+
+    def poke(x):
+        g = gate(jnp.float32(state["execs"]))
+        return x + g.astype(x.dtype) * 0
+
+    return poke, state
+
+
+def _patch_decode_engines(monkeypatch, poke) -> None:
+    """Route every decode program's output (sharded + batched) through the
+    raising callback."""
+    from cpgisland_tpu.parallel import decode as decode_mod
+
+    real_fn = decode_mod._sharded_fn
+
+    def patched_sharded(mesh, block_size, engine, continuation):
+        fn = real_fn(mesh, block_size, engine, continuation)
+
+        def wrapped(params, arr, v, anchor, prev0):
+            path, prev_exit = fn(params, arr, v, anchor, prev0)
+            return poke(path), prev_exit
+
+        return wrapped
+
+    monkeypatch.setattr(decode_mod, "_sharded_fn", patched_sharded)
+
+    real_batch = pipeline.viterbi_parallel_batch
+
+    def patched_batch(params, chunks, lengths, **kw):
+        return poke(real_batch(params, chunks, lengths, **kw))
+
+    monkeypatch.setattr(pipeline, "viterbi_parallel_batch", patched_batch)
+
+
+def _patch_posterior_engine(monkeypatch, poke) -> None:
+    from cpgisland_tpu.parallel import posterior as posterior_mod
+
+    real_fn = posterior_mod._posterior_fn
+
+    def patched(mesh, block_size, engine, first, want_path, lane_T, t_tile):
+        fn = real_fn(mesh, block_size, engine, first, want_path, lane_T, t_tile)
+
+        def wrapped(params, arr, lens, mask, enter, exit_, prev):
+            conf, path = fn(params, arr, lens, mask, enter, exit_, prev)
+            return poke(conf), (poke(path) if path is not None else None)
+
+        return wrapped
+
+    monkeypatch.setattr(posterior_mod, "_posterior_fn", patched)
+
+
+def _write_fasta(path, rng, n_records=5):
+    """Multi-record FASTA spanning both the batched small-record path and
+    (with span=2048) the span-threaded per-record path."""
+    bases = np.array(list("acgt"))
+    with open(path, "w") as f:
+        for r in range(n_records):
+            f.write(f">rec{r}\n")
+            n = 512 + 900 * r
+            bg = rng.choice(4, size=n, p=[0.3, 0.2, 0.2, 0.3])
+            bg[: n // 4] = rng.choice(4, size=n // 4, p=[0.1, 0.4, 0.4, 0.1])
+            s = "".join(bases[bg])
+            for i in range(0, len(s), 70):
+                f.write(s[i : i + 70] + "\n")
+    return str(path)
+
+
+@pytest.mark.parametrize("island_engine", ["host", "device"])
+@pytest.mark.parametrize("prefetch", [0, 2])
+def test_decode_file_recovers_from_injit_fault(
+    tmp_path, rng, monkeypatch, island_engine, prefetch
+):
+    """A real in-jit device fault on the decode path (surfacing as
+    XlaRuntimeError at the supervised blocking point — or at the DEFERRED
+    column fetch under prefetch, where the serial recompute fallback takes
+    over) recovers automatically with bit-identical island output."""
+    fa = _write_fasta(tmp_path / "g.fa", rng)
+    params = presets.durbin_cpg8()
+
+    def run():
+        out = io.StringIO()
+        pipeline.decode_file(
+            fa, params, islands_out=out, compat=False, span=2048,
+            island_engine=island_engine, prefetch=prefetch,
+        )
+        return out.getvalue()
+
+    clean = run()
+    assert clean.count("\n") >= 2
+    poke, state = _poke_through_callback(fail_times=1)
+    _patch_decode_engines(monkeypatch, poke)
+    injected = run()
+    assert injected == clean
+    assert state["execs"] >= 2  # the fault really fired and was re-run
+
+
+@pytest.mark.parametrize("island_engine", ["host", "device"])
+@pytest.mark.parametrize("prefetch", [0, 2])
+def test_posterior_file_recovers_from_injit_fault(
+    tmp_path, rng, monkeypatch, island_engine, prefetch
+):
+    fa = _write_fasta(tmp_path / "p.fa", rng)
+    params = presets.durbin_cpg8()
+
+    def run():
+        out = io.StringIO()
+        res = pipeline.posterior_file(
+            fa, params, islands_out=out, span=2048,
+            island_engine=island_engine, prefetch=prefetch,
+        )
+        return out.getvalue(), res.mean_island_confidence
+
+    clean_txt, clean_conf = run()
+    assert clean_txt.count("\n") >= 2
+    poke, state = _poke_through_callback(fail_times=1)
+    _patch_posterior_engine(monkeypatch, poke)
+    inj_txt, inj_conf = run()
+    assert inj_txt == clean_txt
+    assert inj_conf == clean_conf
+    assert state["execs"] >= 2
+
+
+def test_decode_file_persistent_fault_raises(tmp_path, rng, monkeypatch):
+    """A fault that never clears exhausts the bounded retries and
+    propagates (no infinite loop, no silent wrong output)."""
+    fa = _write_fasta(tmp_path / "g.fa", rng, n_records=2)
+    poke, _state = _poke_through_callback(fail_times=10**9)
+    _patch_decode_engines(monkeypatch, poke)
+    with pytest.raises(RuntimeError, match="injected device fault"):
+        pipeline.decode_file(
+            fa, presets.durbin_cpg8(), islands_out=io.StringIO(),
+            compat=False, span=2048, island_engine="host",
+        )
+
+
+def test_decode_fault_feeds_breaker_and_ladder(tmp_path, rng, monkeypatch):
+    """Serving faults are ledgered per attempt AND feed the engine
+    breaker: enough consecutive faults trip the engine (engine_degraded),
+    and cooldown expiry + a healthy probe restores it (engine_restored) —
+    the degradation ladder proven against REAL in-jit faults."""
+    from cpgisland_tpu import obs
+
+    t = [0.0]
+    br = resilience.EngineBreaker(threshold=2, cooldown_s=30.0,
+                                  clock=lambda: t[0])
+    resilience.set_breaker(br)
+    fa = _write_fasta(tmp_path / "g.fa", rng, n_records=2)
+    poke, _state = _poke_through_callback(fail_times=2)
+    _patch_decode_engines(monkeypatch, poke)
+    with obs.observe() as ob:
+        out = io.StringIO()
+        pipeline.decode_file(
+            fa, presets.durbin_cpg8(), islands_out=out, compat=False,
+            span=2048, island_engine="host",
+        )
+        assert out.getvalue().count("\n") >= 1
+    faults = [e for e in ob.events if e["event"] == "dispatch_fault"]
+    assert len(faults) >= 2  # every attempt ledgered
+    degraded = [e for e in ob.events if e["event"] == "engine_degraded"]
+    assert degraded and degraded[0]["engine"] == "decode.xla"
+    # Tripped now; after the cooldown the next ROUTING consult admits a
+    # half-open probe, and a healthy supervised unit restores the engine.
+    assert br.tripped("decode.xla")
+    t[0] = 31.0
+    assert br.allowed("decode.xla")  # routing's probe admission
+    with obs.observe() as ob2:
+        sup = resilience.DispatchSupervisor(
+            resilience.RetryPolicy(backoff_base_s=0.0), breaker=br
+        )
+        sup.run(lambda: 1, what="decode.record", engine="decode.xla")
+    assert not br.tripped("decode.xla")
+    assert any(e["event"] == "engine_restored" for e in ob2.events)
+
+
+def test_fit_faults_feed_em_breaker(rng):
+    """The host-loop recovery records E-step faults/successes under the
+    backend's resolved ``em.<engine>`` key, so the train router's
+    degradation ladder is actually fed (a trip reroutes the next
+    iteration's per-call re-resolution)."""
+    events = []
+    br = resilience.EngineBreaker(threshold=10, cooldown_s=30.0)
+    real_fault, real_ok = br.record_fault, br.record_success
+    br.record_fault = lambda k, error=None: (events.append(("fault", k)),
+                                             real_fault(k, error=error))[1]
+    br.record_success = lambda k: (events.append(("ok", k)), real_ok(k))[1]
+    resilience.set_breaker(br)
+
+    class FlakyLocal(backends.LocalBackend):
+        def __init__(self):
+            super().__init__(engine="xla")
+            self.n = 0
+
+        def __call__(self, params, chunks, lengths):
+            self.n += 1
+            if self.n == 1:
+                raise RuntimeError("kernel-shaped fault")
+            return super().__call__(params, chunks, lengths)
+
+    res = baum_welch.fit(
+        presets.durbin_cpg8(), _chunked(rng), num_iters=1, convergence=0.0,
+        backend=FlakyLocal(), fuse=False,
+    )
+    assert res.iterations == 1
+    assert ("fault", "em.xla") in events
+    assert ("ok", "em.xla") in events
 
 
 def test_elastic_skips_injit_faulting_slice(rng):

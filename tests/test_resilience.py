@@ -1,0 +1,652 @@
+"""Resilient serving layer: supervisor, breaker, sentinel, manifest, and
+the satellite hardenings (checkpoint latest, prefetch shutdown, codec
+invalid-symbol policy).
+
+The in-jit fault-injection proofs against the decode/posterior FILE paths
+live in tests/test_fault_injection.py (they need the pure_callback probe);
+this file covers the resilience subsystems' own contracts plus the
+killed-then-resumed manifest byte-identity.
+"""
+
+import io
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from cpgisland_tpu import obs, pipeline, resilience
+from cpgisland_tpu.models import presets
+from cpgisland_tpu.resilience import (
+    DispatchSupervisor,
+    EngineBreaker,
+    IntegritySentinel,
+    PhantomResult,
+    RetryPolicy,
+)
+from cpgisland_tpu.resilience import manifest as manifest_mod
+
+
+@pytest.fixture(autouse=True)
+def _fresh_resilience_state():
+    """Breaker trips and default-supervisor state must not leak between
+    tests (or into other modules)."""
+    resilience.reset()
+    yield
+    resilience.reset()
+
+
+FAST = RetryPolicy(backoff_base_s=0.0)
+
+
+def _write_fasta(path, rng, n_records=6, scale=1):
+    bases = np.array(list("acgt"))
+    with open(path, "w") as f:
+        for r in range(n_records):
+            f.write(f">rec{r}\n")
+            n = (512 + 768 * r) * scale
+            bg = rng.choice(4, size=n, p=[0.3, 0.2, 0.2, 0.3])
+            bg[: n // 4] = rng.choice(4, size=n // 4, p=[0.1, 0.4, 0.4, 0.1])
+            s = "".join(bases[bg])
+            for i in range(0, len(s), 70):
+                f.write(s[i : i + 70] + "\n")
+    return str(path)
+
+
+# ---------------------------------------------------------------------------
+# Dispatch supervisor
+
+
+def test_supervisor_retries_transient_fault():
+    sup = DispatchSupervisor(FAST)
+    state = {"n": 0}
+
+    def flaky():
+        state["n"] += 1
+        if state["n"] <= 2:
+            raise RuntimeError("transient")
+        return 42
+
+    assert sup.run(flaky, what="t") == 42
+    assert sup.retries == 2
+
+
+def test_supervisor_gives_up_and_reraises():
+    sup = DispatchSupervisor(RetryPolicy(max_retries=2, backoff_base_s=0.0))
+    state = {"n": 0}
+
+    def always():
+        state["n"] += 1
+        raise RuntimeError("persistent")
+
+    with pytest.raises(RuntimeError, match="persistent"):
+        sup.run(always, what="t")
+    assert state["n"] == 3  # 1 attempt + 2 retries
+
+
+def test_supervisor_passes_programming_errors_through():
+    sup = DispatchSupervisor(FAST)
+    state = {"n": 0}
+
+    def bad():
+        state["n"] += 1
+        raise ValueError("not fault-shaped")
+
+    with pytest.raises(ValueError):
+        sup.run(bad, what="t")
+    assert state["n"] == 1  # no retry
+
+
+def test_supervisor_fallback_takes_over_after_first_failure():
+    sup = DispatchSupervisor(FAST)
+    calls = {"thunk": 0, "fb": 0}
+
+    def thunk():
+        calls["thunk"] += 1
+        raise RuntimeError("poisoned deferred buffer")
+
+    def fallback():
+        calls["fb"] += 1
+        return "recomputed"
+
+    assert sup.run(thunk, what="t", fallback=fallback) == "recomputed"
+    assert calls == {"thunk": 1, "fb": 1}
+
+
+def test_supervisor_emits_ledgered_fault_events():
+    with obs.observe() as ob:
+        sup = DispatchSupervisor(FAST)
+        state = {"n": 0}
+
+        def flaky():
+            state["n"] += 1
+            if state["n"] == 1:
+                raise RuntimeError("boom")
+            return 1
+
+        sup.run(flaky, what="decode.span", engine="decode.xla", items=100.0)
+    ev = [e for e in ob.events if e["event"] == "dispatch_fault"]
+    assert len(ev) == 1
+    assert ev[0]["what"] == "decode.span"
+    assert ev[0]["engine"] == "decode.xla"
+    assert ev[0]["will_retry"] is True
+    assert "boom" in ev[0]["error"]
+
+
+def test_supervisor_backoff_is_bounded_and_jittered():
+    pol = RetryPolicy(backoff_base_s=1.0, backoff_factor=4.0, backoff_max_s=5.0)
+    import random
+
+    rng = random.Random(0)
+    for attempt, base in ((1, 1.0), (2, 4.0), (3, 5.0), (9, 5.0)):
+        for _ in range(10):
+            d = pol.delay_s(attempt, rng)
+            assert base * (1 - pol.jitter) <= d <= base * (1 + pol.jitter)
+
+
+# ---------------------------------------------------------------------------
+# Engine breaker / degradation ladder
+
+
+def _clocked_breaker(threshold=2, cooldown_s=10.0):
+    t = [0.0]
+    br = EngineBreaker(
+        threshold=threshold, cooldown_s=cooldown_s, clock=lambda: t[0]
+    )
+    return br, t
+
+
+def test_breaker_trips_cools_down_and_restores():
+    with obs.observe() as ob:
+        br, t = _clocked_breaker()
+        br.record_fault("decode.onehot")
+        assert br.allowed("decode.onehot")  # below threshold
+        br.record_fault("decode.onehot")
+        assert not br.allowed("decode.onehot")  # tripped
+        t[0] = 11.0
+        assert br.allowed("decode.onehot")  # half-open probe admitted
+        br.record_success("decode.onehot")
+        assert br.allowed("decode.onehot")  # restored
+    names = [e["event"] for e in ob.events]
+    assert "engine_degraded" in names and "engine_restored" in names
+
+
+def test_breaker_failed_probe_retrips():
+    br, t = _clocked_breaker()
+    br.record_fault("x")
+    br.record_fault("x")
+    t[0] = 11.0
+    assert br.allowed("x")  # probe
+    br.record_fault("x")  # probe failed
+    assert not br.allowed("x")  # fresh cooldown from t=11
+    t[0] = 20.0
+    assert not br.allowed("x")
+    t[0] = 21.5
+    assert br.allowed("x")
+
+
+def test_breaker_success_resets_consecutive_count():
+    br, _ = _clocked_breaker(threshold=2)
+    br.record_fault("x")
+    br.record_success("x")
+    br.record_fault("x")
+    assert br.allowed("x")  # never reached 2 consecutive
+
+
+def test_degrade_walks_ladder_to_untripped_rung():
+    br, _ = _clocked_breaker(threshold=1)
+    ladder = {"onehot": "pallas", "pallas": "xla"}.get
+    br.record_fault("decode.onehot")
+    br.record_fault("decode.pallas")
+    assert br.degrade("decode", "onehot", ladder) == "xla"
+    # The last rung runs even when tripped (an exact answer beats none).
+    br.record_fault("decode.xla")
+    assert br.degrade("decode", "xla", ladder) == "xla"
+
+
+@pytest.fixture
+def fake_tpu(monkeypatch):
+    """Routing-only TPU impersonation: the resolve_* functions consult
+    jax.default_backend() and pure host-side supports() predicates — no
+    device work happens, so the auto-routing demotion paths (whose fast
+    rungs are TPU-only) are testable on the CPU mesh."""
+    import jax
+
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+
+
+def test_resolve_engine_demotes_tripped_auto_choice(fake_tpu):
+    from cpgisland_tpu.parallel import decode as decode_mod
+
+    params = presets.durbin_cpg8()
+    br, _ = _clocked_breaker(threshold=1)
+    resilience.set_breaker(br)
+    assert decode_mod.resolve_engine("auto", params) == "onehot"
+    br.record_fault("decode.onehot")
+    assert decode_mod.resolve_engine("auto", params) == "pallas"
+    br.record_fault("decode.pallas")
+    assert decode_mod.resolve_engine("auto", params) == "xla"
+    # EXPLICIT requests bypass the breaker: a named engine must actually
+    # run (bench/parity measurements certify that specific lowering).
+    assert decode_mod.resolve_engine("onehot", params) == "onehot"
+
+
+def test_resolve_fb_engine_demotes_tripped_auto_choice(fake_tpu):
+    from cpgisland_tpu.parallel import posterior as posterior_mod
+
+    params = presets.durbin_cpg8()
+    br, _ = _clocked_breaker(threshold=1)
+    resilience.set_breaker(br)
+    assert posterior_mod.resolve_fb_engine("auto", params) == "onehot"
+    br.record_fault("fb.onehot")
+    assert posterior_mod.resolve_fb_engine("auto", params) == "pallas"
+    assert posterior_mod.resolve_fb_engine("onehot", params) == "onehot"
+
+
+def test_train_resolve_fb_engine_demotes_tripped_auto_choice(fake_tpu):
+    from cpgisland_tpu.train import backends
+
+    params = presets.durbin_cpg8()
+    br, _ = _clocked_breaker(threshold=1)
+    resilience.set_breaker(br)
+    assert backends.resolve_fb_engine("auto", params, "rescaled") == "onehot"
+    br.record_fault("em.onehot")
+    assert backends.resolve_fb_engine("auto", params, "rescaled") == "pallas"
+    assert backends.resolve_fb_engine("pallas", params, "rescaled") == "pallas"
+
+
+def test_island_engine_demotes_to_host_when_tripped(fake_tpu):
+    br, _ = _clocked_breaker(threshold=1)
+    resilience.set_breaker(br)
+    use_dev, _cap = pipeline._resolve_island_engine(
+        "auto", device_eligible=True, ineligible_msg="x", island_cap=None
+    )
+    assert use_dev is True
+    br.record_fault("islands.device")
+    with obs.observe() as ob:
+        use_dev, _cap = pipeline._resolve_island_engine(
+            "auto", device_eligible=True, ineligible_msg="x", island_cap=None
+        )
+    assert use_dev is False  # parity twin: the host caller
+    decisions = [e for e in ob.events if e["event"] == "engine_decision"]
+    assert any(
+        e.get("site") == "islands.breaker_demotion" for e in decisions
+    )
+    # An explicit 'device' request is honored even while tripped.
+    use_dev, _cap = pipeline._resolve_island_engine(
+        "device", device_eligible=True, ineligible_msg="x", island_cap=None
+    )
+    assert use_dev is True
+
+
+# ---------------------------------------------------------------------------
+# Integrity sentinel
+
+
+def test_sentinel_passes_healthy_result():
+    s = IntegritySentinel()
+    s.verify(np.arange(8, dtype=np.float32), what="decode.record",
+             items=8.0, seconds=1.0)
+    assert s.checks == 1 and not s.violations
+
+
+def test_sentinel_canary_detects_stale_and_supervisor_redispatches(monkeypatch):
+    s = IntegritySentinel()
+    real = s._canary_value
+    state = {"n": 0}
+
+    def stale_once(probe, seed):
+        state["n"] += 1
+        if state["n"] == 1:
+            return -1.0  # a reply that cannot match the fresh seed fold
+        return real(probe, seed)
+
+    monkeypatch.setattr(s, "_canary_value", stale_once)
+    with obs.observe() as ob:
+        sup = DispatchSupervisor(FAST, sentinel=s)
+        out = sup.run(lambda: np.arange(3), what="decode.record")
+    np.testing.assert_array_equal(out, np.arange(3))
+    assert sup.retries == 1
+    assert s.violations and s.violations[0]["kind"] == "canary_mismatch"
+    assert any(e["event"] == "integrity_violation" for e in ob.events)
+
+
+def test_sentinel_flags_implausible_throughput():
+    s = IntegritySentinel(canary=False)
+    with pytest.raises(PhantomResult, match="implausible_throughput"):
+        s.verify(
+            np.zeros(4), what="decode.record", items=1e12, seconds=1e-6
+        )
+
+
+def test_sentinel_nan_result_is_flagged():
+    s = IntegritySentinel()
+    with pytest.raises(PhantomResult):
+        s.verify(
+            np.full(4, np.nan, np.float32), what="posterior.record",
+            items=4.0, seconds=1.0,
+        )
+
+
+def test_decode_file_integrity_check_runs_clean(tmp_path, rng):
+    """End-to-end: --integrity-check on a healthy run changes nothing but
+    performs one canary check per supervised unit."""
+    fa = _write_fasta(tmp_path / "g.fa", rng, n_records=4)
+    params = presets.durbin_cpg8()
+
+    def run(**kw):
+        out = io.StringIO()
+        pipeline.decode_file(
+            fa, params, islands_out=out, compat=False, span=2048, **kw
+        )
+        return out.getvalue()
+
+    plain = run()
+    checked = run(integrity_check=True)
+    assert plain == checked and plain.count("\n") >= 2
+
+
+# ---------------------------------------------------------------------------
+# Manifest: wire exactness + killed-then-resumed byte identity
+
+
+def test_calls_wire_roundtrip_bit_exact(rng):
+    from cpgisland_tpu.ops.islands import IslandCalls
+
+    n = 57
+    calls = IslandCalls(
+        beg=rng.integers(1, 1 << 40, n).astype(np.int64),
+        end=rng.integers(1, 1 << 40, n).astype(np.int64),
+        length=rng.integers(1, 1 << 20, n).astype(np.int64),
+        gc_content=rng.random(n),
+        oe_ratio=rng.random(n) * 3.0,
+    ).with_names("chrX")
+    back = manifest_mod.calls_from_wire(
+        json.loads(json.dumps(manifest_mod.calls_to_wire(calls)))
+    )
+    for f in ("beg", "end", "length", "gc_content", "oe_ratio"):
+        a, b = getattr(calls, f), getattr(back, f)
+        np.testing.assert_array_equal(a, b)
+        assert a.dtype == b.dtype
+    assert list(back.names) == list(calls.names)
+    assert manifest_mod.calls_from_wire(None) is None
+
+
+def test_manifest_header_mismatch_starts_fresh(tmp_path, caplog):
+    p = str(tmp_path / "m.jsonl")
+    with manifest_mod.RunManifest(p, header={"mode": "decode", "k": 1},
+                                  resume=False) as m:
+        m.record_done(0, "r0", 100, calls=None)
+    with manifest_mod.RunManifest(p, header={"mode": "decode", "k": 2},
+                                  resume=True) as m2:
+        assert m2.completed(0, "r0", 100) is None  # discarded, recompute
+
+
+def test_manifest_tolerates_truncated_tail(tmp_path):
+    p = str(tmp_path / "m.jsonl")
+    with manifest_mod.RunManifest(p, header={"mode": "decode"},
+                                  resume=False) as m:
+        m.record_done(0, "r0", 100, calls=None, conf_sum=1.5)
+        m.record_done(1, "r1", 200, calls=None)
+    with open(p, "a") as f:
+        f.write('{"kind": "record", "index": 2, "na')  # killed mid-append
+    with manifest_mod.RunManifest(p, header={"mode": "decode"},
+                                  resume=True) as m2:
+        assert m2.completed(0, "r0", 100) is not None
+        assert m2.completed(1, "r1", 200) is not None
+        assert m2.completed(2, "r2", 300) is None
+        # Identity mismatch on a completed index recomputes loudly.
+        assert m2.completed(0, "OTHER", 100) is None
+        # The resumed manifest TRUNCATED the partial tail before appending
+        # — a new record must start on its own line, not merge into the
+        # garbage and poison the NEXT resume's parse.
+        m2.record_done(2, "r2", 300, calls=None)
+    with manifest_mod.RunManifest(p, header={"mode": "decode"},
+                                  resume=True) as m3:
+        assert m3.completed(0, "r0", 100) is not None
+        assert m3.completed(1, "r1", 200) is not None
+        assert m3.completed(2, "r2", 300) is not None
+
+
+def test_decode_killed_then_resumed_is_byte_identical(tmp_path, rng, monkeypatch):
+    fa = _write_fasta(tmp_path / "g.fa", rng, n_records=6)
+    params = presets.durbin_cpg8()
+    man_full = str(tmp_path / "full.manifest.jsonl")
+
+    def run(islands_path, manifest_path, resume):
+        pipeline.decode_file(
+            fa, params, islands_out=str(islands_path), compat=False,
+            span=2048, device_batch=1, manifest_path=manifest_path,
+            resume=resume,
+        )
+        return islands_path.read_text()
+
+    full_txt = run(tmp_path / "full.txt", man_full, False)
+    assert full_txt.count("\n") >= 3
+
+    # Simulate a killed run: keep the header + the first 3 completed
+    # records of the manifest.
+    lines = open(man_full).read().splitlines(True)
+    head, recs = lines[0], [ln for ln in lines[1:]
+                            if json.loads(ln)["kind"] == "record"]
+    man_part = tmp_path / "part.manifest.jsonl"
+    man_part.write_text("".join([head] + recs[:3]))
+
+    # Count decode dispatches: completed records must not recompute.
+    from cpgisland_tpu.parallel import decode as decode_mod
+
+    calls = {"n": 0}
+    real_sharded = decode_mod.viterbi_sharded
+    real_spans = decode_mod.viterbi_sharded_spans
+
+    def count_sharded(*a, **k):
+        calls["n"] += 1
+        return real_sharded(*a, **k)
+
+    def count_spans(*a, **k):
+        calls["n"] += 1
+        return real_spans(*a, **k)
+
+    monkeypatch.setattr(pipeline, "viterbi_sharded", count_sharded)
+    monkeypatch.setattr(pipeline, "viterbi_sharded_spans", count_spans)
+    resumed_txt = run(tmp_path / "resumed.txt", str(man_part), True)
+    assert resumed_txt == full_txt
+    assert calls["n"] == 3  # only the 3 uncompleted records decoded
+    # The resumed manifest now marks everything complete: a second resume
+    # decodes nothing.
+    calls["n"] = 0
+    again = run(tmp_path / "again.txt", str(man_part), True)
+    assert again == full_txt and calls["n"] == 0
+
+
+def test_posterior_killed_then_resumed_identical(tmp_path, rng):
+    fa = _write_fasta(tmp_path / "p.fa", rng, n_records=5)
+    params = presets.durbin_cpg8()
+    man_full = str(tmp_path / "p.manifest.jsonl")
+
+    def run(manifest_path, resume):
+        out = io.StringIO()
+        res = pipeline.posterior_file(
+            fa, params, islands_out=out, span=2048,
+            manifest_path=manifest_path, resume=resume,
+        )
+        return out.getvalue(), res.mean_island_confidence
+
+    full_txt, full_conf = run(man_full, False)
+    lines = open(man_full).read().splitlines(True)
+    head, recs = lines[0], [ln for ln in lines[1:]
+                            if json.loads(ln)["kind"] == "record"]
+    man_part = tmp_path / "pp.manifest.jsonl"
+    man_part.write_text("".join([head] + recs[:2]))
+    resumed_txt, resumed_conf = run(str(man_part), True)
+    assert resumed_txt == full_txt
+    assert resumed_conf == full_conf  # exact: per-record f64 sums replayed
+
+
+def test_manifest_rejects_per_symbol_outputs(tmp_path, rng):
+    fa = _write_fasta(tmp_path / "g.fa", rng, n_records=2)
+    with pytest.raises(ValueError, match="per-symbol"):
+        pipeline.decode_file(
+            fa, presets.durbin_cpg8(), islands_out=str(tmp_path / "i.txt"),
+            compat=False, resume=True,
+            state_path_out=str(tmp_path / "p.npy"),
+        )
+    with pytest.raises(ValueError, match="per-symbol"):
+        pipeline.posterior_file(
+            fa, presets.durbin_cpg8(), islands_out=str(tmp_path / "i.txt"),
+            confidence_out=str(tmp_path / "c.npy"), resume=True,
+        )
+
+
+# ---------------------------------------------------------------------------
+# Satellite: checkpoint corruption tolerance
+
+
+def test_latest_skips_corrupt_checkpoints(tmp_path, caplog):
+    from cpgisland_tpu.utils import checkpoint as ckpt
+
+    st = ckpt.TrainState(params=presets.durbin_cpg8(), iteration=3,
+                         logliks=[-10.0, -9.0])
+    good = str(tmp_path / "ckpt_000003.npz")
+    ckpt.save(good, st)
+    # A newer but truncated snapshot (killed mid-write / unsynced pages).
+    (tmp_path / "ckpt_000007.npz").write_bytes(b"PK\x03\x04garbage")
+    import logging
+
+    with caplog.at_level(logging.WARNING):
+        assert ckpt.latest(str(tmp_path)) == good
+    assert any("corrupt" in r.message for r in caplog.records)
+    # Old name-only behavior stays available.
+    assert ckpt.latest(str(tmp_path), validate=False).endswith("000007.npz")
+    # All corrupt -> None (resume starts fresh instead of crashing).
+    (tmp_path / "ckpt_000003.npz").write_bytes(b"")
+    assert ckpt.latest(str(tmp_path)) is None
+
+
+# ---------------------------------------------------------------------------
+# Satellite: prefetch shutdown determinism
+
+
+def test_serial_closer_closes_generator_on_consumer_error():
+    from cpgisland_tpu.utils.prefetch import maybe_prefetch
+
+    closed = []
+
+    def gen():
+        try:
+            for i in range(100):
+                yield ("r", i)
+        finally:
+            closed.append(True)
+
+    it, close = maybe_prefetch(gen(), 0, "x")
+    assert next(iter(it))[1] == 0
+    close()  # the consumer-error finally path
+    assert closed
+
+
+def test_stuck_producer_finalizer_closes_generator():
+    from cpgisland_tpu.utils.prefetch import RecordPrefetcher
+
+    release = threading.Event()
+    closed = []
+
+    def gen():
+        try:
+            yield ("r", 1)
+            release.wait()  # producer stuck inside next(it)
+            yield ("r", 2)
+        finally:
+            closed.append(True)
+
+    pf = RecordPrefetcher(gen(), depth=1, join_timeout_s=0.3)
+    assert next(pf)[1] == 1
+    pf.close()  # join times out; a finalizer thread takes over
+    assert not closed  # cannot close a generator another thread is running
+    release.set()
+    for _ in range(200):
+        if closed:
+            break
+        time.sleep(0.02)
+    assert closed
+
+
+def test_prefetcher_close_drains_full_queue_producer():
+    """A producer blocked on a FULL queue at close time exits promptly (the
+    incremental drain+join), not via the timeout path."""
+    from cpgisland_tpu.utils.prefetch import RecordPrefetcher
+
+    def gen():
+        for i in range(10_000):
+            yield ("r", i)
+
+    pf = RecordPrefetcher(gen(), depth=1, join_timeout_s=5.0)
+    time.sleep(0.2)  # producer fills the queue and blocks on put
+    t0 = time.perf_counter()
+    pf.close()
+    assert time.perf_counter() - t0 < 2.0
+    assert not pf._thread.is_alive()
+
+
+# ---------------------------------------------------------------------------
+# Satellite: codec invalid-symbol policy
+
+
+def test_codec_policies():
+    from cpgisland_tpu.utils import codec
+
+    assert codec.encode("AC\nN5GT").tolist() == [0, 1, 2, 3]  # skip (default)
+    assert codec.encode("ACNGT", invalid="mask").tolist() == [0, 1, 4, 2, 3]
+    with pytest.raises(codec.InvalidSymbolError) as ei:
+        codec.encode("AC\nNGT", invalid="fail")
+    assert ei.value.count == 1 and ei.value.first_byte == ord("N")
+    # Whitespace is structural, never invalid.
+    assert codec.encode("AC \t\nGT", invalid="fail").tolist() == [0, 1, 2, 3]
+    with pytest.raises(ValueError, match="policy"):
+        codec.encode("ACGT", invalid="nope")
+
+
+def test_codec_policy_counts_surface_as_obs_event(tmp_path, rng):
+    from cpgisland_tpu.utils import codec
+
+    p = tmp_path / "n.fa"
+    p.write_text(">r1\nACGNNTACGT\nNN\n>r2\nACGT\n")
+    with obs.observe() as ob:
+        recs = list(codec.iter_fasta_records(str(p), invalid="mask"))
+    assert [n for n, _ in recs] == ["r1", "r2"]
+    assert recs[0][1].tolist() == [0, 1, 2, 4, 4, 3, 0, 1, 2, 3, 4, 4]
+    ev = [e for e in ob.events if e["event"] == "invalid_symbols"]
+    assert len(ev) == 1 and ev[0]["count"] == 4 and ev[0]["policy"] == "mask"
+
+
+def test_decode_file_invalid_symbol_policies(tmp_path, rng):
+    """mask preserves FASTA coordinates (N -> PAD identity steps); fail
+    aborts; compat rejects non-skip policies."""
+    from cpgisland_tpu.utils import codec
+
+    fa = tmp_path / "n.fa"
+    body = "acgt" * 400 + "nnnn" + "cgcg" * 400
+    fa.write_text(">chr\n" + "\n".join(
+        body[i : i + 70] for i in range(0, len(body), 70)
+    ) + "\n")
+    params = presets.durbin_cpg8()
+
+    def run(policy):
+        out = io.StringIO()
+        res = pipeline.decode_file(
+            fa.as_posix(), params, islands_out=out, compat=False,
+            invalid_symbols=policy,
+        )
+        return res, out.getvalue()
+
+    res_skip, _ = run("skip")
+    res_mask, _ = run("mask")
+    assert res_mask.n_symbols == res_skip.n_symbols + 4  # Ns kept as PAD
+    with pytest.raises(codec.InvalidSymbolError):
+        run("fail")
+    with pytest.raises(ValueError, match="clean mode"):
+        pipeline.decode_file(
+            fa.as_posix(), params, islands_out=io.StringIO(), compat=True,
+            invalid_symbols="mask",
+        )

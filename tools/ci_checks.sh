@@ -38,6 +38,14 @@ echo "== tier-1 smoke =="
 python -m pytest tests/test_graftcheck.py tests/test_graftcheck_self.py \
   tests/test_hmm.py tests/test_viterbi.py -q
 
+echo "== fault-injection & resilience slice =="
+# The recovery machinery is only trustworthy while its injected-fault tests
+# stay green: real in-jit XlaRuntimeErrors through fit() AND the serving
+# paths (decode/posterior supervision, breaker ladder, manifest resume,
+# elastic micro-batch retry).
+python -m pytest tests/test_fault_injection.py tests/test_elastic.py \
+  tests/test_resilience.py -q
+
 echo "== prepared-streams smoke (parity + cache + zero-reprep ledger) =="
 python -m pytest tests/test_prepared.py -q
 
