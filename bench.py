@@ -121,6 +121,18 @@ def _check_plausible(tput: float, name: str) -> float:
     return tput
 
 
+def armed_ceilings_record():
+    """What this process actually enforces per path: ``{path: Msym/s}`` or
+    the string ``"degraded-to-global"`` when the BASELINE.md markers failed
+    to parse.  Every phase emits this into its JSON so a silent ceiling
+    degradation is visible in the captured artifact instead of quietly
+    widening the phantom net to the global 20 Gsym/s (VERDICT r5 #7)."""
+    ceilings = _path_ceilings()
+    if not ceilings:
+        return "degraded-to-global"
+    return {k: round(v / 1e6, 1) for k, v in sorted(ceilings.items())}
+
+
 def _best_wall(fn, reps: int = 3) -> float:
     """Min wall-clock of fn(seed) over reps with DISTINCT seeds (fn must
     block internally and fold the seed into its input data).
@@ -1115,6 +1127,26 @@ def bench_parity(n_mib: int = 4) -> dict:
         },
     }
 
+    # --- graftcheck layer 3 on the capturing backend: diff live cost
+    # fingerprints against the COSTS.json lockfile.  Off-TPU this is the
+    # full pass (lockfile + quantitative cost contracts); on TPU the
+    # quantitative contracts pin CPU XLA-twin structure and are skipped,
+    # and the diff runs only if the lockfile carries a 'tpu' section —
+    # otherwise the capture records the skip note instead of vacuously
+    # passing.
+    from cpgisland_tpu.analysis import cost_contracts as graft_costs
+
+    creport = graft_costs.run_cost_pass()
+    if not creport["ok"]:
+        raise AssertionError(
+            "parity-gate costs: " + graft_costs.format_failure(creport)
+        )
+    out["costs"] = {
+        "entries_diffed": creport["diff"]["checked"],
+        "cost_contracts": len(creport["contracts"]),
+        "notes": creport["diff"]["notes"],
+    }
+
     log(
         "parity-gate: OK — dense and reduced lowerings agree on this "
         f"backend ({jax.default_backend()}): " + json.dumps(out)
@@ -1299,7 +1331,9 @@ def main() -> int:
 def _run_phase(args, on_tpu: bool) -> int:
     if args.phase == "parity":
         out = bench_parity(4 if on_tpu else 1)
-        print(json.dumps({"parity": out}))
+        print(json.dumps(
+            {"parity": out, "armed_ceilings": armed_ceilings_record()}
+        ))
         return 0
 
     if args.phase in (None, "core"):
@@ -1310,7 +1344,10 @@ def _run_phase(args, on_tpu: bool) -> int:
         except Exception as e:  # never let validation sink the headline number
             log(f"sharded-validation: FAILED {type(e).__name__}: {e}")
         if args.phase == "core":
-            print(json.dumps({"decode_tput": decode_tput, "em_tput": em_tput}))
+            print(json.dumps({
+                "decode_tput": decode_tput, "em_tput": em_tput,
+                "armed_ceilings": armed_ceilings_record(),
+            }))
             return 0
         _print_northstar(decode_tput, em_tput)
         return 0
@@ -1334,6 +1371,7 @@ def _run_phase(args, on_tpu: bool) -> int:
             "batched_tput": batched_tput, "posterior_tput": posterior_tput,
             "em2_tput": em2_tput, "decode2_tput": decode2_tput,
             "em_fused": em_fused,
+            "armed_ceilings": armed_ceilings_record(),
         }))
         return 0
 
@@ -1359,6 +1397,7 @@ def _run_phase(args, on_tpu: bool) -> int:
         print(json.dumps({
             "em_seq_tput": em_seq_tput, "em_seq2d_tput": em_seq2d_tput,
             "span_d": span_d,
+            "armed_ceilings": armed_ceilings_record(),
         }))
         return 0
 
@@ -1374,7 +1413,10 @@ def _run_phase(args, on_tpu: bool) -> int:
             args.e2e_mbases if args.e2e_mbases else (64 if on_tpu else 4),
             engine=args.engine,
         )
-        print(json.dumps({"span_p": span_p, "e2e": e2e}))
+        print(json.dumps({
+            "span_p": span_p, "e2e": e2e,
+            "armed_ceilings": armed_ceilings_record(),
+        }))
         return 0
 
     raise AssertionError(f"unhandled phase {args.phase!r}")
@@ -1464,6 +1506,13 @@ def _orchestrate(args) -> int:
         )
 
     CHR21, CHR1 = 46.7e6, 248e6
+    # Per-path plausibility ceilings as each capture phase ACTUALLY armed
+    # them: a BASELINE.md marker-parse failure in any child shows up here
+    # as "degraded-to-global" instead of silently widening the phantom net.
+    armed = {ph: r.get("armed_ceilings") for ph, r in results.items()}
+    degraded_phases = sorted(
+        ph for ph, v in armed.items() if not isinstance(v, dict)
+    )
     decode_tput, em_tput = carry["decode_tput"], carry["em_tput"]
     span_d, span_p = results["ext2"]["span_d"], results["ext3"]["span_p"]
     e2e = results["ext3"]["e2e"]
@@ -1521,6 +1570,14 @@ def _orchestrate(args) -> int:
         "contracts_checked_on_capture_backend": results["parity"]["parity"][
             "contracts"
         ]["checked"],
+        "costs_checked_on_capture_backend": results["parity"]["parity"][
+            "costs"
+        ],
+        "armed_path_ceilings": (
+            next((v for v in armed.values() if isinstance(v, dict)), None)
+            or "degraded-to-global"
+        ),
+        "ceilings_degraded_phases": degraded_phases,
     }
     log("extended: " + json.dumps(extras))
     _print_northstar(decode_tput, em_tput)

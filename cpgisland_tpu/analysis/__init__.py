@@ -16,6 +16,14 @@ Two layers (LINT.md is the rule catalogue):
   paths, no callbacks in hot graphs, reduced/pallas engines stay
   pallas-free off-TPU (the interpreter pathology), and dispatch-surface
   stability via ``obs.no_new_compiles``.
+- **cost contracts** (:mod:`~cpgisland_tpu.analysis.costmodel` +
+  :mod:`~cpgisland_tpu.analysis.cost_contracts`, "graftcost") — the same
+  traces measured: per-primitive FLOP/byte/serial-depth fingerprints at
+  two geometries, decomposed per-symbol vs fixed, locked in the committed
+  ``COSTS.json`` and diffed in CI (``--costs`` / ``--update-costs``),
+  plus quantitative contracts (no dense-pair ops on reduced paths,
+  bounded fused-EM fixed share, documented pass structure, lane-scaled
+  serial depth).
 
 CLI: ``python -m cpgisland_tpu.analysis [paths...]`` (or
 ``tools/graftcheck.py``); exits non-zero on violations.  Inline waivers:

@@ -4,7 +4,11 @@ Exit codes: 0 clean (waived findings allowed), 1 violations (lint findings
 or contract violations), 2 usage error.  The default run is the pure-AST
 lint layer (no tracing, no devices — sub-second past the package import);
 ``--contracts`` adds the jaxpr contract pass, which traces the registered
-entry points on abstract inputs (CPU, seconds).
+entry points on abstract inputs (CPU, seconds); ``--costs`` adds Layer 3 —
+the quantitative cost pass (COSTS.json lockfile diff + cost contracts),
+re-baselined with ``--update-costs`` after a verified change.
+``--cost-table ENTRY`` prints the per-group fixed-vs-per-symbol
+attribution table (the BASELINE.md size-curve decomposition).
 """
 
 from __future__ import annotations
@@ -47,6 +51,18 @@ def main(argv=None) -> int:
     ap.add_argument("--no-exec", action="store_true",
                     help="contracts: trace only, skip the dispatch-stability "
                     "execution checks")
+    ap.add_argument("--costs", action="store_true",
+                    help="run the Layer-3 cost pass: diff live cost "
+                    "fingerprints against COSTS.json and check the "
+                    "quantitative cost contracts (imports jax)")
+    ap.add_argument("--update-costs", action="store_true",
+                    help="re-baseline COSTS.json from the live traces and "
+                    "print a diff summary (implies --costs)")
+    ap.add_argument("--costs-file", default=None,
+                    help="lockfile path (default: <repo>/COSTS.json)")
+    ap.add_argument("--cost-table", default=None, metavar="ENTRY",
+                    help="print the fixed-vs-per-symbol attribution table "
+                    "for one cost entry (e.g. em.seq.onehot) and exit")
     ap.add_argument("--platform", default="cpu",
                     help="contracts backend: cpu (default — the pass is "
                     "designed to certify without a TPU) | tpu | auto "
@@ -60,10 +76,34 @@ def main(argv=None) -> int:
             print(f"{rule.name}: {rule.description}")
             if rule.origin:
                 print(f"    origin: {rule.origin}")
+        # Layer 3 (quantitative cost contracts) — listed without importing
+        # jax: the rule table is static metadata.
+        from cpgisland_tpu.analysis import cost_contracts
+
+        for name, desc in cost_contracts.quantitative_rules():
+            print(f"{name}: {desc}")
+            print("    origin: BASELINE.md size curve — ~8-11 ms fixed "
+                  "in-graph cost/iter bounds em-seq2d; cost regressions "
+                  "must fail statically, not on relay-TPU")
         return 0
 
     rc = 0
     payload: dict = {}
+
+    if args.cost_table:
+        _pin_platform(args.platform)
+        from cpgisland_tpu.analysis import cost_contracts, costmodel
+
+        entries = {c.name: c for c in cost_contracts.cost_entries()}
+        if args.cost_table not in entries:
+            print(
+                f"error: unknown cost entry {args.cost_table!r} "
+                f"(have: {sorted(entries)})", file=sys.stderr,
+            )
+            return 2
+        traced = costmodel.trace_entry(entries[args.cost_table])
+        print(costmodel.attribution_table(traced))
+        return 0
 
     if not args.no_lint:
         paths = args.paths or _default_paths()
@@ -114,12 +154,7 @@ def main(argv=None) -> int:
             rc = 1
 
     if args.contracts:
-        if args.platform != "auto":
-            # Pin via jax.config BEFORE backend init: this dev box's site
-            # plugin ignores the JAX_PLATFORMS env var (CLAUDE.md).
-            import jax
-
-            jax.config.update("jax_platforms", args.platform)
+        _pin_platform(args.platform)
         from cpgisland_tpu.analysis import contracts
 
         results = contracts.run_contracts(execute=not args.no_exec)
@@ -141,10 +176,52 @@ def main(argv=None) -> int:
         if bad:
             rc = 1
 
+    if args.costs or args.update_costs:
+        _pin_platform(args.platform)
+        from cpgisland_tpu.analysis import cost_contracts
+
+        report = cost_contracts.run_cost_pass(
+            lockfile_path=args.costs_file, update=args.update_costs
+        )
+        if args.as_json:
+            payload["costs"] = report
+        else:
+            if report["updated"]:
+                summary = report.get("summary") or ["(no changes)"]
+                print(f"costs: re-baselined {report['path']}", file=sys.stderr)
+                for line in summary:
+                    print(f"    {line}", file=sys.stderr)
+            for v in report["diff"]["violations"]:
+                print(f"cost drift: {v}")
+            for n in report["diff"]["notes"]:
+                print(f"note: {n}", file=sys.stderr)
+            for r in report["contracts"]:
+                status = "ok" if r["ok"] else "VIOLATION"
+                print(f"cost contract {r['name']}: {status}", file=sys.stderr)
+                for v in r["violations"]:
+                    print(f"    {v}")
+            print(
+                f"graftcost: {report['diff']['checked']} entry point(s) "
+                f"diffed, {len(report['contracts'])} cost contract(s), "
+                f"{'ok' if report['ok'] else 'VIOLATIONS'}",
+                file=sys.stderr,
+            )
+        if not report["ok"]:
+            rc = 1
+
     if args.as_json:
         payload["ok"] = rc == 0
         print(json.dumps(payload))
     return rc
+
+
+def _pin_platform(platform: str) -> None:
+    if platform != "auto":
+        # Pin via jax.config BEFORE backend init: this dev box's site
+        # plugin ignores the JAX_PLATFORMS env var (CLAUDE.md).
+        import jax
+
+        jax.config.update("jax_platforms", platform)
 
 
 if __name__ == "__main__":

@@ -45,13 +45,25 @@ BANNED_DTYPES = ("float64", "complex128")
 @dataclasses.dataclass
 class Contract:
     name: str
-    # () -> (fn, args, args2) — args2 is a same-shape/different-data input
-    # set for the dispatch-stability check (None skips it).
-    make: Callable[[], tuple]
+    # (scale=1) -> (fn, args, args2) — args2 is a same-shape/different-data
+    # input set for the dispatch-stability check (None skips it).  ``scale``
+    # multiplies the entry's time geometry (symbol count); the cost layer
+    # (analysis/costmodel.py) traces each entry at >=2 scales to decompose
+    # per-symbol vs fixed cost.  Entries with no time geometry (e.g. the
+    # model-sized M-step) set ``scalable=False`` and ignore ``scale``.
+    make: Callable[..., tuple]
     allow_pallas_off_tpu: bool = False
     expect_pallas_on_tpu: bool = False
     stability: bool = False
     allow_f64: bool = False
+    scalable: bool = True
+    base_symbols: int = 0  # symbols traced at scale=1 (0 = no time geometry)
+    # Geometry scales the cost layer traces at.  The FB/lane entries pad up
+    # to the 128-lane grid, so their scales must put BOTH geometries past
+    # the padding plateau (base 4096-8192 x 16/32 = 128/256 lanes at
+    # lane_T=512) or every metric reads as "fixed".  Tracing is abstract —
+    # a big geometry costs the same to trace as a small one.
+    cost_scales: tuple = (1, 2)
 
 
 @dataclasses.dataclass
@@ -180,6 +192,37 @@ def while_body_prims(closed) -> dict:
     return counts
 
 
+def fused_em_make(scale: int = 1, with_prep: bool = True):
+    """(fn, args) for the fused-EM while-loop program on the flagship
+    chunked onehot backend at a scaled geometry — shared by the
+    ``em.body.invariant-free`` contract and the cost layer's ``em.fused``
+    entry (analysis/costmodel.py).  Returns (fn, args, prep): ``prep`` is
+    the resolved PreparedStreams (None when the backend produced none —
+    itself a violation the caller reports)."""
+    import jax.numpy as jnp
+
+    from cpgisland_tpu.train import baum_welch
+    from cpgisland_tpu.train.backends import LocalBackend
+
+    params = _flagship()
+    n = 8 * scale
+    o1, _ = _obs_pair(n * 1024, "uint8")
+    chunks = jnp.asarray(o1).reshape(n, 1024)
+    lengths = jnp.full(n, 1024, jnp.int32)
+    backend = LocalBackend(mode="rescaled", engine="onehot")
+    if with_prep:
+        stats_fn, prep = backend.fused_stats_with_prep(params, chunks, lengths)
+    else:
+        # The inline-prep twin never consumes prepared streams — don't pay
+        # the prep build just to discard it.
+        stats_fn = backend.fused_stats_fn(params, chunks, lengths)
+        prep = None
+    p32 = params.astype(jnp.float32)
+    fn = baum_welch._fused_em_fn(stats_fn, 3, with_prep)
+    args = (p32, chunks, lengths, jnp.float32(0.0), prep)
+    return fn, args, prep
+
+
 def _em_body_contract() -> ContractResult:
     """em.body.invariant-free: the fused EM while_loop body jaxpr must
     contain NO symbol-stream prep primitives when prepared streams are
@@ -189,30 +232,17 @@ def _em_body_contract() -> ContractResult:
     set has rotted and the contract fails rather than passing vacuously.
     """
     import jax
-    import jax.numpy as jnp
 
-    from cpgisland_tpu.train import baum_welch
-    from cpgisland_tpu.train.backends import LocalBackend
-
-    params = _flagship()
-    o1, _ = _obs_pair(8 * 1024, "uint8")
-    chunks = jnp.asarray(o1).reshape(8, 1024)
-    lengths = jnp.full(8, 1024, jnp.int32)
-    backend = LocalBackend(mode="rescaled", engine="onehot")
     violations: list[str] = []
     notes: dict = {"backend": jax.default_backend()}
-    stats_fn, prep = backend.fused_stats_with_prep(params, chunks, lengths)
+    fn, args, prep = fused_em_make()
     if prep is None:
         violations.append(
             "LocalBackend(engine='onehot') returned no prepared streams — "
             "the fused EM loop would re-prepare per iteration"
         )
     else:
-        p32 = params.astype(jnp.float32)
-        fn = baum_welch._fused_em_fn(stats_fn, 3, True)
-        closed = jax.make_jaxpr(fn)(
-            p32, chunks, lengths, jnp.float32(0.0), prep
-        )
+        closed = jax.make_jaxpr(fn)(*args)
         body = while_body_prims(closed)
         notes["body_eqns"] = sum(body.values())
         hits = sorted(set(body) & PREP_MARKER_PRIMS)
@@ -228,10 +258,8 @@ def _em_body_contract() -> ContractResult:
             )
         # Detector self-proof on the synthetic violation: the inline-prep
         # twin of the same loop MUST show the markers.
-        fn0 = baum_welch._fused_em_fn(stats_fn, 3, False)
-        closed0 = jax.make_jaxpr(fn0)(
-            p32, chunks, lengths, jnp.float32(0.0), None
-        )
+        fn0, args0, _ = fused_em_make(with_prep=False)
+        closed0 = jax.make_jaxpr(fn0)(*args0)
         body0 = while_body_prims(closed0)
         notes["inline_markers"] = sorted(set(body0) & PREP_MARKER_PRIMS)
         if not set(body0) & PREP_MARKER_PRIMS:
@@ -306,48 +334,52 @@ def _obs_pair(n: int, dtype, seeds=(0, 1)):
 
 
 def _decode_contract(engine: str, **kw) -> Contract:
-    def make():
+    def make(scale: int = 1):
         from cpgisland_tpu.ops.viterbi_parallel import viterbi_parallel
 
         params = _flagship()
-        o1, o2 = _obs_pair(2048, "int32")
+        o1, o2 = _obs_pair(2048 * scale, "int32")
         fn = lambda o: viterbi_parallel(
             params, o, block_size=256, return_score=True, engine=engine
         )
         return fn, (o1,), (o2,)
 
-    return Contract(name=f"decode.{engine}", make=make, **kw)
+    return Contract(
+        name=f"decode.{engine}", make=make, base_symbols=2048, **kw
+    )
 
 
 def _decode_batch_flat_contract() -> Contract:
-    def make():
+    def make(scale: int = 1):
         from cpgisland_tpu.ops.viterbi_parallel import viterbi_parallel_batch
 
         params = _flagship()
-        o1, o2 = _obs_pair(4 * 512, "int32")
+        T = 512 * scale
+        o1, o2 = _obs_pair(4 * T, "int32")
         import jax.numpy as jnp
 
-        lengths = jnp.full(4, 512, jnp.int32)
+        lengths = jnp.full(4, T, jnp.int32)
         fn = lambda c: viterbi_parallel_batch(
-            params, c.reshape(4, 512), lengths, block_size=256,
+            params, c.reshape(4, T), lengths, block_size=256,
             return_score=False, engine="onehot",
         )
         return fn, (o1,), (o2,)
 
     return Contract(
-        name="decode.batch_flat.onehot", make=make, expect_pallas_on_tpu=True
+        name="decode.batch_flat.onehot", make=make, expect_pallas_on_tpu=True,
+        base_symbols=4 * 512,
     )
 
 
 def _posterior_contract(onehot: bool, **kw) -> Contract:
-    def make():
+    def make(scale: int = 1):
         import jax.numpy as jnp
         import numpy as np
 
         from cpgisland_tpu.ops import fb_pallas
 
         params = _flagship()
-        o1, o2 = _obs_pair(4096, "uint8")
+        o1, o2 = _obs_pair(4096 * scale, "uint8")
         mask = jnp.asarray((np.arange(8) < 4).astype(np.float32))
         fn = lambda o: fb_pallas._seq_posterior_core(
             params, o, o.shape[0], mask, 512, 256, axis=None, onehot=onehot
@@ -355,42 +387,54 @@ def _posterior_contract(onehot: bool, **kw) -> Contract:
         return fn, (o1,), (o2,)
 
     tag = "onehot" if onehot else "dense"
-    return Contract(name=f"posterior.{tag}", make=make, **kw)
+    return Contract(
+        name=f"posterior.{tag}", make=make, base_symbols=4096,
+        cost_scales=(16, 32), **kw
+    )
 
 
 def _em_chunked_contract(engine: str, **kw) -> Contract:
-    def make():
+    def make(scale: int = 1):
         import jax.numpy as jnp
 
         from cpgisland_tpu.train.backends import LocalBackend
 
         params = _flagship()
-        o1, o2 = _obs_pair(8 * 1024, "uint8")
-        lengths = jnp.full(8, 1024, jnp.int32)
+        # Scale the CHUNK COUNT (the per-symbol axis of this layout); chunk
+        # length is the reference's fixed 64 Ki-class geometry.
+        n = 8 * scale
+        o1, o2 = _obs_pair(n * 1024, "uint8")
+        lengths = jnp.full(n, 1024, jnp.int32)
         backend = LocalBackend(mode="rescaled", engine=engine)
-        fn = lambda c: backend(params, c.reshape(8, 1024), lengths)
+        fn = lambda c: backend(params, c.reshape(n, 1024), lengths)
         return fn, (o1,), (o2,)
 
-    return Contract(name=f"em.chunked.{engine}", make=make, **kw)
+    return Contract(
+        name=f"em.chunked.{engine}", make=make, base_symbols=8 * 1024,
+        cost_scales=(16, 32), **kw
+    )
 
 
 def _em_seq_contract(onehot: bool, **kw) -> Contract:
-    def make():
+    def make(scale: int = 1):
         from cpgisland_tpu.ops import fb_pallas
 
         params = _flagship()
-        o1, o2 = _obs_pair(8192, "uint8")
+        o1, o2 = _obs_pair(8192 * scale, "uint8")
         fn = lambda o: fb_pallas.seq_stats_pallas(
             params, o, o.shape[0], lane_T=512, t_tile=256, onehot=onehot
         )
         return fn, (o1,), (o2,)
 
     tag = "onehot" if onehot else "dense"
-    return Contract(name=f"em.seq.{tag}", make=make, **kw)
+    return Contract(
+        name=f"em.seq.{tag}", make=make, base_symbols=8192,
+        cost_scales=(16, 32), **kw
+    )
 
 
 def _mstep_contract() -> Contract:
-    def make():
+    def make(scale: int = 1):
         import jax.numpy as jnp
 
         from cpgisland_tpu.ops.forward_backward import SuffStats
@@ -408,7 +452,7 @@ def _mstep_contract() -> Contract:
 
         return mstep, (params, stats(1.0)), (params, stats(2.0))
 
-    return Contract(name="em.mstep", make=make, stability=True)
+    return Contract(name="em.mstep", make=make, stability=True, scalable=False)
 
 
 def default_contracts() -> list[Contract]:

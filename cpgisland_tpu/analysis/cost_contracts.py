@@ -1,0 +1,554 @@
+"""graftcheck Layer 3 — quantitative cost contracts + the COSTS.json lockfile.
+
+Built on :mod:`~cpgisland_tpu.analysis.costmodel`.  Two halves:
+
+**The lockfile** (``COSTS.json``, committed): per contract-registry entry,
+the cost fingerprint (per-geometry metrics, per-symbol/fixed fits, pass
+count, primitive histogram) captured on a platform, with per-metric
+tolerances.  ``python -m cpgisland_tpu.analysis --costs`` re-traces the
+registry and diffs against the lockfile — a drifted metric fails CI with
+the *named drifting primitives* (the histogram diff), so "a reintroduced
+dense op / doubled scan depth / grown epilogue" is a red build on CPU in
+seconds instead of a mystery regression on relay-TPU minutes.
+``--update-costs`` re-baselines after a verified change and prints what
+moved.  Entries that left the registry but linger in the lockfile are
+reported like stale waivers.
+
+**The quantitative contracts** — graph-cost assertions the boolean layer
+cannot express:
+
+- ``cost.reduced-no-dense-pair`` — reduced (onehot) engine graphs contain
+  ZERO equations materializing an O(T·S²) dense-pair tensor (>= S²/2
+  result elements per symbol).  The r4 reduction's whole win was deleting
+  these; one stray dense xi/products op silently re-pays the K²/4 factor.
+- ``cost.em-body-fixed-share`` — the fused EM while-body's FIXED cost
+  share (flops and bytes, from the linear fit) stays under
+  ``FIXED_SHARE_MAX`` at the 16 Mi reference geometry: the epilogue
+  (M-step, convergence delta, stats assembly) must stay model-sized.
+- ``cost.pass-structure`` — T-scaling sequential pass counts match the
+  BASELINE.md-documented pass structure (3-pass decode/posterior, 2-pass
+  chunked EM: fwd + bwd chains; the chunked stats reduction is a
+  throughput contraction, not a serial pass).
+- ``cost.serial-depth-lanes`` — serial-chain depth slope per symbol stays
+  under a per-family bound: depth must scale with LANES (T/lane_T), never
+  with T (a per-symbol sequential walk is the one structure every kernel
+  here was built to avoid).
+
+The quantitative contracts run on the CPU XLA twins (identical arithmetic
+to the chip kernels, CLAUDE.md); on a TPU backend the pass degrades to the
+lockfile diff against a ``tpu`` platform section when one exists, plus the
+live fingerprint capture (pallas_call bodies are opaque leaves there).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Optional
+
+from cpgisland_tpu.analysis import costmodel
+from cpgisland_tpu.analysis.contracts import (
+    Contract,
+    ContractResult,
+    default_contracts,
+    fused_em_make,
+)
+
+LOCKFILE_VERSION = 1
+LOCKFILE_NAME = "COSTS.json"
+
+# Fixed share of the fused EM while-body cost (flops AND bytes) allowed at
+# the reference geometry.  Measured today: ~7e-7 flops / ~1.6e-5 bytes —
+# the pin is ~600x headroom, sized so a genuinely fixed-cost epilogue
+# growth (>= ~100 MFLOP, e.g. an accidental model-cross-product in the
+# loop) trips it while model-sized drift is the lockfile's job.
+FIXED_SHARE_MAX = 0.01
+REFERENCE_T = 16 * 2**20  # the size-curve's 16 Mi knee (BASELINE.md)
+
+# T-scaling sequential pass counts, pinned to the documented pass
+# structure (BASELINE.md roofline: decode = products/backpointers/
+# backtrace, posterior = products/fwd/bwd+conf; chunked EM = fwd + bwd —
+# its stats pass is a throughput-bound contraction, not a serial chain).
+EXPECTED_PASSES = {
+    "decode.xla": 3,
+    "decode.onehot": 3,
+    "decode.batch_flat.onehot": 3,
+    "posterior.onehot": 3,
+    "em.seq.onehot": 3,
+    "em.chunked.xla": 2,
+    "em.chunked.onehot": 2,
+}
+
+# Serial-depth slope ceilings (critical-path steps per SYMBOL).  Lane
+# entries grow depth only via the lane count (1/lane_T per symbol times a
+# tiny boundary-combine body — measured <= 3e-4); decode grows via the
+# block combine (1/block_size x the combine depth — measured ~1.7e-2).  A
+# per-symbol sequential walk would measure >= 1.
+DEPTH_SLOPE_MAX = {
+    "decode.": 0.05,
+    "posterior.": 0.01,
+    "em.seq.": 0.01,
+    "em.chunked.": 0.01,
+}
+
+_QUANT_RULES = (
+    ("cost.lockfile", "live cost fingerprints match COSTS.json within "
+     "per-metric tolerances; drifts name the drifting primitives"),
+    ("cost.reduced-no-dense-pair", "reduced (onehot) engine graphs contain "
+     "zero O(T*S^2) dense-pair equations"),
+    ("cost.em-body-fixed-share", "fused EM while-body fixed cost share "
+     f"< {FIXED_SHARE_MAX} at the 16 Mi reference geometry"),
+    ("cost.pass-structure", "T-scaling sequential pass counts match the "
+     "documented pass structure (3-pass decode/posterior, 2-pass chunked)"),
+    ("cost.serial-depth-lanes", "serial depth scales with lanes, never "
+     "with T (per-symbol depth slope under the per-family ceiling)"),
+)
+
+
+def quantitative_rules() -> list:
+    """(name, description) pairs for --list-rules / the JSON payload."""
+    return list(_QUANT_RULES)
+
+
+DEFAULT_TOLERANCES = {
+    # Relative, on the fitted per_symbol/fixed values and raw totals.
+    # Tight: a trace is a deterministic function of (code, jax version),
+    # so drift means the GRAPH changed — the workflow is --update-costs
+    # after verifying, not widening the tolerance.
+    "flops": 0.02,
+    "bytes": 0.02,
+    "serial_depth": 0.02,
+    # Exact-integer structure: any change is a real graph change.
+    "n_eqns": 0,
+    "passes": 0,
+}
+
+
+def default_lockfile_path() -> str:
+    pkg = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    return os.path.join(os.path.dirname(pkg), LOCKFILE_NAME)
+
+
+def _fused_em_entry() -> Contract:
+    return Contract(
+        name="em.fused",
+        make=lambda scale=1: fused_em_make(scale),
+        base_symbols=8 * 1024,
+        cost_scales=(16, 32),
+    )
+
+
+def cost_entries() -> list:
+    """The cost registry: every boolean-layer contract entry + the fused
+    EM loop (whose while-body is the per-iteration cost the size curve
+    measures)."""
+    return default_contracts() + [_fused_em_entry()]
+
+
+def _n_states() -> int:
+    from cpgisland_tpu.models import presets
+
+    return presets.durbin_cpg8().n_states
+
+
+# -- fingerprints ------------------------------------------------------------
+
+
+def fingerprint(entry: costmodel.EntryCosts, while_body: Optional[dict] = None) -> dict:
+    fp = {
+        "geometries": list(entry.geometries),
+        "passes": entry.passes(),
+        "metrics": [m.as_dict() for m in entry.metrics],
+        "fits": {k: f.as_dict() for k, f in entry.fits().items()},
+    }
+    if while_body is not None:
+        fp["while_body"] = while_body
+    return fp
+
+
+def _while_body_fits(entry: costmodel.EntryCosts) -> Optional[dict]:
+    """Per-iteration while-body cost fits, from an already-traced entry's
+    retained jaxprs (no re-trace — the fused EM entry is the most
+    expensive trace in the registry)."""
+    points_f, points_b = [], []
+    for T, closed in zip(entry.geometries, entry.jaxprs):
+        bodies = costmodel.while_body_costs(closed)
+        if not bodies:
+            return None
+        body = bodies[0][1]
+        points_f.append((T, sum(c.flops for c in body)))
+        points_b.append((T, sum(c.bytes for c in body)))
+    return {
+        "flops": costmodel.fit_linear(points_f).as_dict(),
+        "bytes": costmodel.fit_linear(points_b).as_dict(),
+    }
+
+
+def trace_all() -> tuple:
+    """Trace every cost entry once; returns ({name: EntryCosts},
+    {name: while-body fits or None})."""
+    traced: dict[str, costmodel.EntryCosts] = {}
+    bodies: dict[str, Optional[dict]] = {}
+    for c in cost_entries():
+        traced[c.name] = costmodel.trace_entry(c)
+        if c.name == "em.fused":
+            bodies[c.name] = _while_body_fits(traced[c.name])
+    return traced, bodies
+
+
+def live_fingerprints(traced=None, bodies=None) -> dict:
+    if traced is None:
+        traced, bodies = trace_all()
+    return {
+        name: fingerprint(e, (bodies or {}).get(name))
+        for name, e in traced.items()
+    }
+
+
+# -- the lockfile ------------------------------------------------------------
+
+
+def load_lockfile(path: Optional[str] = None) -> Optional[dict]:
+    path = path or default_lockfile_path()
+    if not os.path.exists(path):
+        return None
+    with open(path, "r", encoding="utf-8") as fh:
+        return json.load(fh)
+
+
+def write_lockfile(
+    fingerprints: dict, path: Optional[str] = None,
+    platform: Optional[str] = None,
+) -> str:
+    import jax
+
+    path = path or default_lockfile_path()
+    platform = platform or jax.default_backend()
+    data = load_lockfile(path) or {
+        "version": LOCKFILE_VERSION,
+        "tolerances": dict(DEFAULT_TOLERANCES),
+        "platforms": {},
+    }
+    data["platforms"][platform] = {
+        "jax": jax.__version__,
+        "entries": fingerprints,
+    }
+    tmp = path + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as fh:
+        json.dump(data, fh, indent=1, sort_keys=True)
+        fh.write("\n")
+    os.replace(tmp, path)
+    return path
+
+
+@dataclasses.dataclass
+class CostDiff:
+    violations: list        # hard failures (metric drift, missing entries)
+    notes: list             # advisory (stale entries, absent platform)
+    stale: list             # lockfile entries no longer in the registry
+    checked: int = 0
+
+    @property
+    def ok(self) -> bool:
+        return not self.violations
+
+    def as_dict(self) -> dict:
+        return dataclasses.asdict(self) | {"ok": self.ok}
+
+
+def _rel_drift(live: float, locked: float) -> float:
+    denom = max(abs(locked), 1.0)
+    return abs(live - locked) / denom
+
+
+def _prim_drift(live_m: dict, locked_m: dict) -> str:
+    """The 'named drifting primitives': structural histogram deltas, and —
+    when counts are unchanged but a primitive's COST moved (the grown-
+    epilogue class) — the per-primitive flops deltas."""
+    live_prims, locked_prims = live_m["prims"], locked_m["prims"]
+    deltas = []
+    for p in sorted(set(live_prims) | set(locked_prims)):
+        d = live_prims.get(p, 0) - locked_prims.get(p, 0)
+        if d:
+            deltas.append(f"{p}{d:+d}")
+    lf = live_m.get("prim_flops", {})
+    kf = locked_m.get("prim_flops", {})
+    for p in sorted(set(lf) | set(kf)):
+        a, b = kf.get(p, 0), lf.get(p, 0)
+        if _rel_drift(b, a) > 0.02:
+            deltas.append(f"{p} flops {a:.3g}->{b:.3g}")
+    return ", ".join(deltas[:8]) if deltas else "(histogram unchanged)"
+
+
+def diff_costs(
+    live: dict, lock: Optional[dict], platform: str
+) -> CostDiff:
+    """Diff live fingerprints against the lockfile's platform section."""
+    diff = CostDiff(violations=[], notes=[], stale=[])
+    if lock is None:
+        diff.violations.append(
+            f"no {LOCKFILE_NAME} lockfile — run --update-costs to baseline"
+        )
+        return diff
+    section = lock.get("platforms", {}).get(platform)
+    if section is None:
+        diff.notes.append(
+            f"lockfile has no '{platform}' section (captured platforms: "
+            f"{sorted(lock.get('platforms', {}))}) — cost diff skipped; "
+            "run --update-costs on this platform to baseline it"
+        )
+        return diff
+    tol = {**DEFAULT_TOLERANCES, **lock.get("tolerances", {})}
+    locked_entries = section.get("entries", {})
+    diff.stale = sorted(set(locked_entries) - set(live))
+    for name in diff.stale:
+        diff.notes.append(
+            f"stale lockfile entry '{name}': no longer in the contract "
+            "registry (remove via --update-costs)"
+        )
+    for name in sorted(live):
+        if name not in locked_entries:
+            diff.violations.append(
+                f"{name}: not in the lockfile — new entries must be "
+                "baselined via --update-costs"
+            )
+            continue
+        diff.checked += 1
+        lv, lk = live[name], locked_entries[name]
+        prim_note = _prim_drift(lv["metrics"][-1], lk["metrics"][-1])
+        if lv["geometries"] != lk["geometries"]:
+            diff.violations.append(
+                f"{name}: traced geometries {lv['geometries']} != lockfile "
+                f"{lk['geometries']} (registry geometry changed — "
+                "--update-costs)"
+            )
+            continue
+        # Integer metrics: the tolerance is ABSOLUTE slack (0 = exact,
+        # 1 = +-1, ...) — never a disable switch.
+        if abs(lv["passes"] - lk["passes"]) > tol["passes"]:
+            diff.violations.append(
+                f"{name}: T-scaling pass count {lk['passes']} -> "
+                f"{lv['passes']}; drifting prims: {prim_note}"
+            )
+        for gi, (lm, km) in enumerate(zip(lv["metrics"], lk["metrics"])):
+            if abs(lm["n_eqns"] - km["n_eqns"]) > tol["n_eqns"]:
+                diff.violations.append(
+                    f"{name}@{lv['geometries'][gi]}: eqn count "
+                    f"{km['n_eqns']} -> {lm['n_eqns']}; drifting prims: "
+                    f"{_prim_drift(lm, km)}"
+                )
+                break  # one structural message per entry is enough
+        for metric in ("flops", "bytes", "serial_depth"):
+            for term in ("per_symbol", "fixed"):
+                lvv = lv["fits"][metric][term]
+                lkv = lk["fits"][metric][term]
+                d = _rel_drift(lvv, lkv)
+                if d > tol[metric]:
+                    diff.violations.append(
+                        f"{name}: {metric}.{term} {lkv:.6g} -> {lvv:.6g} "
+                        f"({d:+.1%} > tol {tol[metric]:.0%}); drifting "
+                        f"prims: {prim_note}"
+                    )
+        wb_l, wb_k = lv.get("while_body"), lk.get("while_body")
+        if (wb_l is None) != (wb_k is None):
+            diff.violations.append(
+                f"{name}: while-body fingerprint "
+                f"{'appeared' if wb_l else 'vanished'} vs lockfile"
+            )
+        elif wb_l and wb_k:
+            for metric in ("flops", "bytes"):
+                for term in ("per_symbol", "fixed"):
+                    d = _rel_drift(wb_l[metric][term], wb_k[metric][term])
+                    if d > tol[metric]:
+                        diff.violations.append(
+                            f"{name}: while_body.{metric}.{term} "
+                            f"{wb_k[metric][term]:.6g} -> "
+                            f"{wb_l[metric][term]:.6g} ({d:+.1%} > tol "
+                            f"{tol[metric]:.0%}); drifting prims: "
+                            f"{prim_note}"
+                        )
+    return diff
+
+
+def update_summary(live: dict, lock: Optional[dict], platform: str) -> list:
+    """Human-readable per-entry summary of what --update-costs changed."""
+    out = []
+    old = ((lock or {}).get("platforms", {}).get(platform, {})
+           .get("entries", {}))
+    for name in sorted(set(live) | set(old)):
+        if name not in old:
+            out.append(f"+ {name} (new entry)")
+        elif name not in live:
+            out.append(f"- {name} (stale entry removed)")
+        else:
+            lo, hi = old[name]["fits"]["flops"], live[name]["fits"]["flops"]
+            if old[name] == live[name]:
+                continue
+            out.append(
+                f"~ {name}: flops/sym {lo['per_symbol']:.4g} -> "
+                f"{hi['per_symbol']:.4g}, fixed {lo['fixed']:.4g} -> "
+                f"{hi['fixed']:.4g}; prims "
+                f"{_prim_drift(live[name]['metrics'][-1], old[name]['metrics'][-1])}"
+            )
+    return out
+
+
+# -- the quantitative contracts ----------------------------------------------
+
+
+def _dense_pair_contract(traced: dict) -> ContractResult:
+    violations, notes = [], {}
+    S = _n_states()
+    for name, e in traced.items():
+        if "onehot" not in name or len(e.geometries) < 2:
+            continue
+        bad = e.dense_pair_eqns(S)
+        for c in bad[:4]:
+            violations.append(
+                f"{name}: {c.prim} in {c.group} materializes "
+                f"{c.out_elems / e.geometries[-1]:.0f} result elems/symbol "
+                f">= S^2/2={S * S // 2} — an O(T*S^2) dense-pair tensor on "
+                "a reduced path (the r4 reduction exists to delete these)"
+            )
+    notes["reduced_entries_checked"] = sum(
+        1 for n, e in traced.items()
+        if "onehot" in n and len(e.geometries) >= 2
+    )
+    return ContractResult(
+        name="cost.reduced-no-dense-pair", ok=not violations,
+        violations=violations, notes=notes,
+    )
+
+
+def _fixed_share_contract(bodies: dict) -> ContractResult:
+    violations, notes = [], {}
+    wb = bodies.get("em.fused")
+    if wb is None:
+        violations.append(
+            "fused EM trace produced no while-loop body (the fused driver's "
+            "structure changed under this contract)"
+        )
+    else:
+        for metric in ("flops", "bytes"):
+            fit = costmodel.LinearFit(**wb[metric])
+            total = fit.at(REFERENCE_T)
+            share = max(fit.fixed, 0.0) / max(total, 1.0)
+            notes[f"{metric}_fixed_share_16Mi"] = round(share, 9)
+            if share > FIXED_SHARE_MAX:
+                violations.append(
+                    f"fused EM while-body fixed {metric} share at 16 Mi = "
+                    f"{share:.2%} > {FIXED_SHARE_MAX:.0%} (fixed "
+                    f"{fit.fixed:.3g} vs per-symbol {fit.per_symbol:.3g}) — "
+                    "the per-iteration epilogue grew beyond model-sized"
+                )
+    return ContractResult(
+        name="cost.em-body-fixed-share", ok=not violations,
+        violations=violations, notes=notes,
+    )
+
+
+def _pass_structure_contract(traced: dict) -> ContractResult:
+    violations, notes = [], {}
+    for name, expected in EXPECTED_PASSES.items():
+        e = traced.get(name)
+        if e is None:
+            violations.append(f"{name}: pinned entry missing from registry")
+            continue
+        got = e.passes()
+        notes[name] = got
+        if got != expected:
+            violations.append(
+                f"{name}: {got} T-scaling sequential passes, documented "
+                f"structure is {expected} (BASELINE.md pass accounting) — "
+                "a pass was added or fused; re-document or fix"
+            )
+    return ContractResult(
+        name="cost.pass-structure", ok=not violations, violations=violations,
+        notes=notes,
+    )
+
+
+def _depth_scaling_contract(traced: dict) -> ContractResult:
+    violations, notes = [], {}
+    for name, e in traced.items():
+        ceiling = next(
+            (v for k, v in DEPTH_SLOPE_MAX.items() if name.startswith(k)),
+            None,
+        )
+        if ceiling is None or len(e.geometries) < 2:
+            continue
+        slope = e.fits()["serial_depth"].per_symbol
+        notes[name] = round(slope, 7)
+        if slope > ceiling:
+            violations.append(
+                f"{name}: serial depth grows {slope:.4g} steps/symbol > "
+                f"{ceiling} — the sequential chain scales with T, not "
+                "lanes (a per-symbol serial walk re-entered this path)"
+            )
+    return ContractResult(
+        name="cost.serial-depth-lanes", ok=not violations,
+        violations=violations, notes=notes,
+    )
+
+
+def run_cost_contracts(traced=None, bodies=None) -> list:
+    """The quantitative contracts on live traces (CPU XLA twins)."""
+    if traced is None:
+        traced, bodies = trace_all()
+    return [
+        _dense_pair_contract(traced),
+        _fixed_share_contract(bodies or {}),
+        _pass_structure_contract(traced),
+        _depth_scaling_contract(traced),
+    ]
+
+
+# -- the full pass (CLI / CI / bench / driver entry) -------------------------
+
+
+def run_cost_pass(
+    lockfile_path: Optional[str] = None, update: bool = False
+) -> dict:
+    """Trace, diff against the lockfile, run the quantitative contracts.
+
+    Returns {"ok", "diff", "contracts", "updated", "summary"} — the CLI,
+    ci_checks.sh, __graft_entry__ and bench.py all consume this one shape.
+    On a TPU backend the quantitative contracts are skipped (they pin the
+    CPU XLA-twin structure; pallas bodies are opaque) and only the
+    lockfile diff runs, against a 'tpu' section when one exists.
+    """
+    import jax
+
+    platform = jax.default_backend()
+    traced, bodies = trace_all()
+    live = live_fingerprints(traced, bodies)
+    lock = load_lockfile(lockfile_path)
+    out: dict = {"platform": platform, "updated": False}
+    if update:
+        out["summary"] = update_summary(live, lock, platform)
+        path = write_lockfile(live, lockfile_path, platform)
+        out["updated"] = True
+        out["path"] = path
+        lock = load_lockfile(lockfile_path)
+    diff = diff_costs(live, lock, platform)
+    results = (
+        run_cost_contracts(traced, bodies) if platform != "tpu" else []
+    )
+    out["diff"] = diff.as_dict()
+    out["contracts"] = [r.as_dict() for r in results]
+    out["ok"] = diff.ok and all(r.ok for r in results)
+    return out
+
+
+def format_failure(report: dict) -> str:
+    """One-line JSON summary of a failing run_cost_pass report — the shared
+    formatting for every caller that raises on it (bench parity gate,
+    __graft_entry__ self-check)."""
+    return json.dumps({
+        "diff": report["diff"]["violations"],
+        "contracts": {
+            r["name"]: r["violations"]
+            for r in report["contracts"] if not r["ok"]
+        },
+    })

@@ -1,0 +1,611 @@
+"""graftcheck Layer 3 — the quantitative jaxpr cost model (graftcost).
+
+Layer 2 checks what a traced graph *contains* (booleans: no f64, no
+callbacks, pallas routing); this layer measures what it *costs*.  Every
+registered contract entry (:mod:`~cpgisland_tpu.analysis.contracts`) is
+traced at >=2 abstract geometries and each metric is linearly decomposed
+into a **per-symbol** slope and a **fixed** intercept — the static twin of
+BASELINE.md's measured size curve (the ~8-11 ms of fixed per-iteration
+in-graph cost that bounds em-seq2d).  The decomposition is what lets a CI
+diff say *which equations grew* when a regression lands, on CPU, in
+seconds, before any TPU run.
+
+Metrics per trace (deterministic functions of the jaxpr — fingerprints,
+not a profiler; the model is deliberately approximate but stable):
+
+- **flops** — per-primitive floating-op estimate (elementwise = out
+  elements, ``dot_general`` = 2·M·N·K, reductions = in elements, ``scan``
+  = trip count x body, ``cum*`` = 2n with log-depth, data movement = 0).
+- **bytes** — operand + result footprint per equation (HBM-traffic proxy;
+  ``scan`` bodies scale by trip count).
+- **serial_depth** — critical-path length through the dependency graph,
+  where a ``scan`` contributes trips x its body's critical path: the
+  static stand-in for "sequential chain latency", the measured bound on
+  every reduced path (BASELINE.md roofline).
+- **n_eqns / prims** — equation count and per-primitive histogram (the
+  names a drift report can print).
+- **passes** — number of T-scaling sequential loops (scan equations whose
+  total cost grows with the symbol count): the pass-sum structure
+  BASELINE.md documents (3-pass posterior, 3-pass decode).
+
+``while`` bodies are costed ONCE (trip counts are value-dependent); the
+fused-EM contract reads the body cost directly (`while_body_costs`), which
+is exactly the per-iteration cost the size curve measures.
+
+No TPU, no execution: everything here is ``jax.make_jaxpr`` on abstract
+inputs, so tracing a 16 Mi-symbol geometry costs the same as 16 Ki.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Iterable, Optional
+
+# Primitives that are pure data movement / metadata: zero flops, bytes only.
+_MOVEMENT_PRIMS = frozenset({
+    "broadcast_in_dim", "reshape", "transpose", "convert_element_type",
+    "slice", "dynamic_slice", "dynamic_update_slice", "concatenate", "pad",
+    "squeeze", "rev", "gather", "scatter", "copy", "iota", "split",
+    "device_put", "stop_gradient", "select_and_scatter_add",
+})
+
+# Reductions: flops = input elements (one combine per element).
+_REDUCE_PRIMS = frozenset({
+    "reduce_sum", "reduce_max", "reduce_min", "reduce_prod", "reduce_and",
+    "reduce_or", "argmax", "argmin", "reduce_precision",
+})
+
+# Cumulative ops: associative-scan lowering — ~2n work, log2(n) depth.
+_CUM_PRIMS = frozenset({"cummax", "cummin", "cumsum", "cumprod",
+                        "cumlogsumexp"})
+
+# Sub-jaxpr carrying primitives and how many times their body runs.
+_LOOP_PRIMS = frozenset({"scan", "while"})
+
+
+@dataclasses.dataclass
+class EqnCost:
+    """One equation's cost, multiplicity-scaled (loop bodies count trips)."""
+
+    prim: str
+    group: str       # "file:function" from source_info — the attribution key
+    flops: int
+    bytes: int       # operand + result footprint
+    out_elems: int   # result elements PER APPLICATION (x mult = total)
+    depth: int       # serial-depth contribution if on the critical path
+    path: str = ""   # nesting, e.g. "scan/scan" (loop bodies)
+    mult: int = 1    # applications (loop trip products folded in)
+
+    def as_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+@dataclasses.dataclass
+class CostMetrics:
+    """Aggregate fingerprint of one traced graph."""
+
+    flops: int
+    bytes: int
+    serial_depth: int
+    n_eqns: int
+    prims: dict          # primitive -> structural count
+    prim_flops: dict     # primitive -> multiplicity-scaled flops total
+    n_scan_eqns: int     # structural scan count (pass detection pairs these)
+
+    def as_dict(self) -> dict:
+        return {
+            "flops": self.flops, "bytes": self.bytes,
+            "serial_depth": self.serial_depth, "n_eqns": self.n_eqns,
+            "prims": dict(sorted(self.prims.items())),
+            "prim_flops": dict(sorted(self.prim_flops.items())),
+            "n_scan_eqns": self.n_scan_eqns,
+        }
+
+
+def _aval_elems(aval) -> int:
+    shape = getattr(aval, "shape", None)
+    if not shape:
+        return 1
+    n = 1
+    for d in shape:
+        n *= int(d)
+    return n
+
+
+def _aval_bytes(aval) -> int:
+    dt = getattr(aval, "dtype", None)
+    itemsize = getattr(dt, "itemsize", 4)
+    return _aval_elems(aval) * int(itemsize)
+
+
+def _eqn_group(eqn) -> str:
+    """'file:function' of the user frame that emitted this equation."""
+    try:
+        from jax._src import source_info_util
+
+        frame = source_info_util.user_frame(eqn.source_info)
+        if frame is None:
+            return "<jax>"
+        fname = frame.file_name.rsplit("/", 1)[-1]
+        return f"{fname}:{frame.function_name}"
+    except Exception:
+        return "<unknown>"
+
+
+def _dot_general_flops(eqn) -> int:
+    (contract, batch) = eqn.params["dimension_numbers"]
+    lhs = eqn.invars[0].aval
+    rhs = eqn.invars[1].aval
+    lhs_shape = lhs.shape
+    k = 1
+    for d in contract[0]:
+        k *= int(lhs_shape[d])
+    b = 1
+    for d in batch[0]:
+        b *= int(lhs_shape[d])
+    m = _aval_elems(lhs) // max(k * b, 1)
+    n = _aval_elems(rhs) // max(k * b, 1)
+    return 2 * b * m * n * k
+
+
+def _closed_of(value):
+    """Yield ClosedJaxpr/Jaxpr objects inside an eqn param value."""
+    import jax
+
+    if isinstance(value, jax.core.ClosedJaxpr):
+        yield value.jaxpr
+    elif isinstance(value, jax.core.Jaxpr):
+        yield value
+    elif isinstance(value, (list, tuple)):
+        for v in value:
+            yield from _closed_of(v)
+
+
+def _io_bytes(eqn) -> int:
+    import jax
+
+    total = 0
+    for v in eqn.invars:
+        if not isinstance(v, jax.core.Literal):
+            total += _aval_bytes(v.aval)
+    for v in eqn.outvars:
+        total += _aval_bytes(v.aval)
+    return total
+
+
+def _base_flops(eqn) -> int:
+    """Flops of one application of a LEAF primitive (no sub-jaxprs)."""
+    name = eqn.primitive.name
+    if name in _MOVEMENT_PRIMS:
+        return 0
+    if name == "dot_general":
+        return _dot_general_flops(eqn)
+    if name in _REDUCE_PRIMS:
+        return sum(
+            _aval_elems(v.aval) for v in eqn.invars if hasattr(v, "aval")
+        )
+    if name in _CUM_PRIMS:
+        return 2 * sum(_aval_elems(v.aval) for v in eqn.outvars)
+    if name == "sort":
+        n = sum(_aval_elems(v.aval) for v in eqn.outvars)
+        return n * max(1, int(math.log2(max(n, 2))))
+    # Default: elementwise — one op per output element.
+    return sum(_aval_elems(v.aval) for v in eqn.outvars)
+
+
+def _leaf_depth(eqn) -> int:
+    name = eqn.primitive.name
+    if name in _CUM_PRIMS or name == "sort":
+        n = max((_aval_elems(v.aval) for v in eqn.outvars), default=1)
+        return max(1, int(math.ceil(math.log2(max(n, 2)))))
+    return 1
+
+
+def _scan_trips(eqn) -> int:
+    return int(eqn.params.get("length", 1))
+
+
+def eqn_costs(closed, _mult: int = 1, _path: str = "") -> list:
+    """Flattened, multiplicity-scaled per-equation costs for a (Closed)Jaxpr.
+
+    Loop bodies are inlined with their trip count folded into every
+    contained equation (``while`` bodies count as ONE trip — the
+    per-iteration cost).  Deterministic order: jaxpr equation order,
+    depth-first into sub-jaxprs.
+    """
+    jaxpr = getattr(closed, "jaxpr", closed)
+    out: list[EqnCost] = []
+    for eqn in jaxpr.eqns:
+        name = eqn.primitive.name
+        subs = [s for v in eqn.params.values() for s in _closed_of(v)]
+        if name == "scan":
+            trips = _scan_trips(eqn)
+            for sub in _closed_of(eqn.params["jaxpr"]):
+                out.extend(
+                    eqn_costs(sub, _mult * trips, _path + name + "/")
+                )
+            continue
+        if name == "while":
+            # Trip counts are value-dependent: cost ONE iteration of the
+            # body (+ one cond evaluation) — the per-iteration cost the
+            # size-curve methodology measures.
+            for key in ("cond_jaxpr", "body_jaxpr"):
+                for sub in _closed_of(eqn.params[key]):
+                    out.extend(eqn_costs(sub, _mult, _path + name + "/"))
+            continue
+        if name == "cond":
+            # Upper bound: the most expensive branch.
+            branch_costs = [
+                eqn_costs(s, _mult, _path + name + "/")
+                for s in _closed_of(eqn.params["branches"])
+            ]
+            if branch_costs:
+                out.extend(
+                    max(branch_costs, key=lambda cs: sum(c.flops for c in cs))
+                )
+            continue
+        if subs and name not in ("pallas_call",):
+            # pjit / closed_call / custom_jvp / remat ... — transparent.
+            for sub in subs:
+                out.extend(eqn_costs(sub, _mult, _path))
+            continue
+        out.append(
+            EqnCost(
+                prim=name,
+                group=_eqn_group(eqn),
+                flops=_base_flops(eqn) * _mult,
+                bytes=_io_bytes(eqn) * _mult,
+                out_elems=sum(_aval_elems(v.aval) for v in eqn.outvars),
+                depth=_leaf_depth(eqn) * _mult,
+                path=_path,
+                mult=_mult,
+            )
+        )
+    return out
+
+
+def _jaxpr_depth(closed) -> int:
+    """Critical-path length (in leaf-equation applications) of a jaxpr.
+
+    scan contributes trips x body critical path; while contributes ONE
+    body critical path (per-iteration depth); transparent call prims
+    contribute their body's critical path."""
+    import jax
+
+    jaxpr = getattr(closed, "jaxpr", closed)
+    depth: dict[int, int] = {}
+
+    def var_depth(v) -> int:
+        if isinstance(v, jax.core.Literal):
+            return 0
+        return depth.get(id(v), 0)
+
+    for eqn in jaxpr.eqns:
+        name = eqn.primitive.name
+        base = max((var_depth(v) for v in eqn.invars), default=0)
+        if name == "scan":
+            body = max(
+                (_jaxpr_depth(s) for s in _closed_of(eqn.params["jaxpr"])),
+                default=1,
+            )
+            d = base + _scan_trips(eqn) * body
+        elif name == "while":
+            body = max(
+                (_jaxpr_depth(s) for s in _closed_of(eqn.params["body_jaxpr"])),
+                default=1,
+            )
+            d = base + body
+        elif name == "cond":
+            body = max(
+                (_jaxpr_depth(s) for s in _closed_of(eqn.params["branches"])),
+                default=1,
+            )
+            d = base + body
+        else:
+            subs = [s for v in eqn.params.values() for s in _closed_of(v)]
+            if subs and name != "pallas_call":
+                d = base + max(_jaxpr_depth(s) for s in subs)
+            else:
+                d = base + _leaf_depth(eqn)
+        for v in eqn.outvars:
+            depth[id(v)] = d
+    return max(
+        (var_depth(v) for v in jaxpr.outvars), default=0
+    )
+
+
+def cost_jaxpr(closed) -> CostMetrics:
+    """Aggregate CostMetrics for a ClosedJaxpr."""
+    costs = eqn_costs(closed)
+    prims: dict[str, int] = {}
+    prim_flops: dict[str, int] = {}
+    for c in costs:
+        prims[c.prim] = prims.get(c.prim, 0) + 1
+        prim_flops[c.prim] = prim_flops.get(c.prim, 0) + c.flops
+    n_scans = _count_scans(closed)
+    return CostMetrics(
+        flops=sum(c.flops for c in costs),
+        bytes=sum(c.bytes for c in costs),
+        serial_depth=_jaxpr_depth(closed),
+        n_eqns=len(costs),
+        prims=prims,
+        prim_flops=prim_flops,
+        n_scan_eqns=n_scans,
+    )
+
+
+def _scan_eqns(closed) -> list:
+    """All scan equations (recursively, deterministic order)."""
+    jaxpr = getattr(closed, "jaxpr", closed)
+    out = []
+    for eqn in jaxpr.eqns:
+        if eqn.primitive.name == "scan":
+            out.append(eqn)
+        for v in eqn.params.values():
+            for sub in _closed_of(v):
+                out.extend(_scan_eqns(sub))
+    return out
+
+
+def scan_costs(closed) -> list:
+    """[(group, trips, total body flops x trips)] per scan equation, in
+    deterministic order — the pass-structure detector pairs these across
+    geometries."""
+    out = []
+    for eqn in _scan_eqns(closed):
+        trips = _scan_trips(eqn)
+        body_flops = 0
+        for sub in _closed_of(eqn.params["jaxpr"]):
+            body_flops += sum(c.flops for c in eqn_costs(sub))
+        out.append((_eqn_group(eqn), trips, trips * body_flops))
+    return out
+
+
+def _count_scans(closed) -> int:
+    return len(_scan_eqns(closed))
+
+
+def while_body_costs(closed) -> list:
+    """[(while-eqn index, list[EqnCost] of its body)] — the fused-EM
+    fixed-share contract reads per-iteration body cost directly."""
+    import itertools
+
+    jaxpr = getattr(closed, "jaxpr", closed)
+    out = []
+    counter = itertools.count()
+
+    def walk(j):
+        for eqn in j.eqns:
+            if eqn.primitive.name == "while":
+                idx = next(counter)
+                body = []
+                for sub in _closed_of(eqn.params["body_jaxpr"]):
+                    body.extend(eqn_costs(sub))
+                out.append((idx, body))
+            for v in eqn.params.values():
+                for sub in _closed_of(v):
+                    walk(sub)
+
+    walk(jaxpr)
+    return out
+
+
+# -- linear decomposition over geometries ------------------------------------
+
+
+@dataclasses.dataclass
+class LinearFit:
+    """cost(T) ~= per_symbol * T + fixed, from the two extreme geometries."""
+
+    per_symbol: float
+    fixed: float
+
+    def at(self, n_symbols: float) -> float:
+        return self.per_symbol * n_symbols + self.fixed
+
+    def as_dict(self) -> dict:
+        return {"per_symbol": self.per_symbol, "fixed": self.fixed}
+
+
+def fit_linear(points: Iterable[tuple]) -> LinearFit:
+    """Fit (n_symbols, value) points; uses the extreme pair (the middle
+    points, when present, are linearity witnesses the caller can check)."""
+    pts = sorted(points)
+    (n1, v1), (n2, v2) = pts[0], pts[-1]
+    if n2 == n1:
+        return LinearFit(per_symbol=0.0, fixed=float(v1))
+    slope = (v2 - v1) / (n2 - n1)
+    return LinearFit(per_symbol=slope, fixed=float(v1) - slope * n1)
+
+
+@dataclasses.dataclass
+class EntryCosts:
+    """A contract entry traced at each geometry + the per-metric fits."""
+
+    name: str
+    geometries: list          # symbol counts
+    metrics: list             # CostMetrics per geometry (same order)
+    eqns: list                # list[EqnCost] per geometry
+    scans: list               # scan_costs() per geometry
+    matched: bool             # eqn lists pair positionally across geometries
+    jaxprs: list = dataclasses.field(default_factory=list)  # ClosedJaxprs
+
+    def fits(self) -> dict:
+        pts = list(zip(self.geometries, self.metrics))
+        return {
+            "flops": fit_linear([(n, m.flops) for n, m in pts]),
+            "bytes": fit_linear([(n, m.bytes) for n, m in pts]),
+            "serial_depth": fit_linear(
+                [(n, m.serial_depth) for n, m in pts]
+            ),
+        }
+
+    def passes(self) -> int:
+        """T-scaling sequential passes: scan equations whose total cost
+        grows with the symbol count (scan lists paired by position across
+        geometries — scan COUNT is structurally stable even where
+        associative-scan trees reshape).  Falls back to the structural
+        scan count when the lists don't pair."""
+        if len(self.scans) < 2 or len(self.scans[0]) != len(self.scans[-1]):
+            return self.metrics[0].n_scan_eqns
+        n = 0
+        for (g1, t1, f1), (g2, t2, f2) in zip(self.scans[0], self.scans[-1]):
+            if f2 > f1 or t2 > t1:
+                n += 1
+        return n
+
+    def dense_pair_eqns(self, n_states: int) -> list:
+        """Equations doing O(T·S²) dense-pair work at the max geometry:
+        TOTAL result footprint (out_elems x loop multiplicity, so a dense
+        per-step [S, S] op inside a T-trip scan is counted at its full
+        O(T·S²), not one application) >= (S²/2)·T elements.  Reduced
+        streams run [T, 2, 2] (4/sym) and fixed tables are O(1), so the
+        S²/2 threshold (32/sym for the flagship S=8) cleanly separates a
+        reintroduced dense pair op (64/sym) from everything legitimate."""
+        T = self.geometries[-1]
+        threshold = (n_states * n_states // 2) * T
+        return [
+            c for c in self.eqns[-1]
+            if c.out_elems * c.mult >= threshold
+            and c.prim not in _MOVEMENT_PRIMS
+        ]
+
+
+def trace_entry(
+    contract, scales: Optional[tuple] = None
+) -> EntryCosts:
+    """Trace one Contract at each geometry scale and package the costs.
+
+    Non-scalable entries (no time geometry) are traced once; their fits
+    degenerate to fixed-only."""
+    import jax
+
+    if scales is None:
+        scales = getattr(contract, "cost_scales", (1, 2))
+    if not getattr(contract, "scalable", True):
+        scales = (1,)
+    geometries, metrics, eqn_lists, scan_lists, jaxprs = [], [], [], [], []
+    for s in scales:
+        fn, args, *_rest = contract.make(s)
+        closed = jax.make_jaxpr(fn)(*args)
+        geometries.append(max(contract.base_symbols, 1) * s)
+        metrics.append(cost_jaxpr(closed))
+        eqn_lists.append(eqn_costs(closed))
+        scan_lists.append(scan_costs(closed))
+        jaxprs.append(closed)
+    matched = len(eqn_lists) >= 2 and all(
+        len(e) == len(eqn_lists[0]) for e in eqn_lists
+    ) and all(
+        a.prim == b.prim
+        for a, b in zip(eqn_lists[0], eqn_lists[-1])
+    )
+    return EntryCosts(
+        name=contract.name, geometries=geometries, metrics=metrics,
+        eqns=eqn_lists, scans=scan_lists, matched=matched, jaxprs=jaxprs,
+    )
+
+
+# -- fixed-cost attribution --------------------------------------------------
+
+
+def _group_agg(costs: list) -> dict:
+    """Sum flops/bytes/depth/out_elems per eqn group (file:function).
+
+    Group keys come from source functions, so the aggregation is robust to
+    associative-scan trees reshaping with geometry (where positional
+    eqn pairing is not)."""
+    agg: dict[str, dict] = {}
+    for c in costs:
+        g = agg.setdefault(
+            c.group,
+            {"prims": set(), "flops": 0, "bytes": 0, "depth": 0,
+             "n_eqns": 0},
+        )
+        g["prims"].add(c.prim)
+        g["n_eqns"] += 1
+        g["flops"] += c.flops
+        g["bytes"] += c.bytes
+        g["depth"] += c.depth
+    return agg
+
+
+def attribute(entry: EntryCosts, top: int = 12) -> dict:
+    """Decompose an entry's cost by eqn GROUP (file:function) into
+    per-symbol and fixed terms — the table that names which equations
+    carry the size-independent work.
+
+    Group-aggregated (lo and hi geometries summed per group, then fitted),
+    so it works even where the graph reshapes with geometry.  Returns
+    {"groups": [...], "totals": {...}}; groups sorted by fixed-flops
+    share, descending."""
+    if len(entry.eqns) < 2:
+        return {"groups": [], "totals": {}, "matched": entry.matched}
+    n_lo, n_hi = entry.geometries[0], entry.geometries[-1]
+    dn = max(n_hi - n_lo, 1)
+    lo, hi = _group_agg(entry.eqns[0]), _group_agg(entry.eqns[-1])
+    groups = []
+    for name in sorted(set(lo) | set(hi)):
+        a = lo.get(name, {"prims": set(), "flops": 0, "bytes": 0,
+                          "depth": 0, "n_eqns": 0})
+        b = hi.get(name, a)
+        row = {"group": name,
+               "prims": sorted(a["prims"] | b["prims"]),
+               "n_eqns": b["n_eqns"]}
+        for field in ("flops", "bytes", "depth"):
+            slope = (b[field] - a[field]) / dn
+            row[f"{field}_per_symbol"] = slope
+            row[f"{field}_fixed"] = a[field] - slope * n_lo
+        groups.append(row)
+    groups.sort(key=lambda g: g["flops_fixed"], reverse=True)
+    totals = {k: f.as_dict() for k, f in entry.fits().items()}
+    return {
+        "groups": groups[:top],
+        "n_groups": len(groups),
+        "totals": totals,
+        # Serial WORK totals over ALL groups (not just the top slice) —
+        # distinct from totals["serial_depth"], which is the critical path.
+        "depth_work_fixed": sum(g["depth_fixed"] for g in groups),
+        "matched": entry.matched,
+        "geometries": entry.geometries,
+    }
+
+
+def attribution_table(entry: EntryCosts, top: int = 12) -> str:
+    """Markdown attribution table for BASELINE.md / the CLI.
+
+    The depth column is per-group SERIAL WORK (summed chain-step
+    applications — how much sequential stepping the group contributes);
+    the graph's CRITICAL PATH (the latency bound, which overlapping chains
+    share) is a separate footer line, since the two are different metrics
+    and group serial work legitimately exceeds the critical path."""
+    att = attribute(entry, top=top)
+    if not att.get("groups"):
+        return (
+            f"(entry {entry.name}: single geometry — no fixed-vs-per-symbol "
+            "attribution)"
+        )
+    lines = [
+        f"| eqn group ({entry.name}) | prims | per-symbol flops | "
+        "fixed flops | fixed bytes | fixed serial work |",
+        "|---|---|---|---|---|---|",
+    ]
+    for g in att["groups"]:
+        prims = ",".join(g["prims"][:4]) + ("…" if len(g["prims"]) > 4 else "")
+        lines.append(
+            f"| `{g['group']}` | {prims} | {g['flops_per_symbol']:.2f} | "
+            f"{g['flops_fixed']:.0f} | {g['bytes_fixed']:.0f} | "
+            f"{g['depth_fixed']:.0f} |"
+        )
+    t = att["totals"]
+    lines.append(
+        f"| **total** | | {t['flops']['per_symbol']:.2f} | "
+        f"{t['flops']['fixed']:.0f} | {t['bytes']['fixed']:.0f} | "
+        f"{att['depth_work_fixed']:.0f} |"
+    )
+    lines.append(
+        f"\ncritical path (the serial-latency bound): fixed "
+        f"{t['serial_depth']['fixed']:.0f} steps, "
+        f"{t['serial_depth']['per_symbol']:.4g} steps/symbol"
+    )
+    return "\n".join(lines)

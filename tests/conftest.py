@@ -22,6 +22,8 @@ import jax
 
 jax.config.update("jax_platforms", _platform)
 
+import re
+
 import numpy as np
 import pytest
 
@@ -36,6 +38,39 @@ if _platform == "cpu" and len(jax.devices()) < 8:
     )
 
 
+# Count true cache-miss XLA compiles at the same funnel the obs ledger uses
+# (jax._src.compiler.backend_compile — in-memory cache hits never reach it).
+# Installed once at conftest import, never uninstalled; obs Ledgers that
+# install later wrap THIS wrapper and restore back to it, so the two
+# coexist.  The counter drives the thresholded cache clear below.
+_compiles_since_clear = [0]
+
+
+def _install_compile_counter() -> None:
+    from jax._src import compiler as _compiler
+
+    orig_bc = _compiler.backend_compile
+
+    def _counting_backend_compile(*a, **k):
+        _compiles_since_clear[0] += 1
+        return orig_bc(*a, **k)
+
+    _compiler.backend_compile = _counting_backend_compile
+
+
+_install_compile_counter()
+
+# Live-executable population past which the per-module clear fires.  The
+# r5 XLA:CPU segfault tracked ACCUMULATED executables (~400 tests' worth,
+# crashing at a late big compile; every file green standalone) — the r5
+# fix cleared at EVERY module boundary, costing ~2 min of suite wall
+# re-tracing/re-compiling warm fixtures (VERDICT r5 #6).  Thresholding
+# keeps the population bounded by (threshold + one module's compiles),
+# an order of magnitude under the crash regime, while light modules skip
+# the clear entirely and keep their warm caches.
+_CLEAR_CACHES_COMPILE_THRESHOLD = 40
+
+
 @pytest.fixture(scope="module", autouse=True)
 def _fresh_compile_caches_per_module():
     """Full-suite runs (~400 tests' live executables in one single-core
@@ -43,14 +78,63 @@ def _fresh_compile_caches_per_module():
     observed three times in r5, each at whatever large-program module ran
     ~90% in (test_viterbi_parallel twice, then test_viterbi_pallas after a
     single-module fixture moved the boundary); every file is green
-    standalone with 125 GB free.  Dropping the accumulated executables at
-    every module boundary keeps the in-process compile population small
-    enough that the roving compiler-state crash never triggers.  CPU-only:
-    the crash is XLA:CPU's, and on the relayed TPU every dropped executable
-    would re-pay a remote compile."""
-    if jax.default_backend() != "tpu":
+    standalone with 125 GB free.  Dropping the accumulated executables
+    keeps the in-process compile population small enough that the roving
+    compiler-state crash never triggers; the clear is THRESHOLDED on the
+    compile count since the last clear (r6) so light modules keep their
+    warm caches and the suite buys back most of the blanket-clear wall.
+    CPU-only: the crash is XLA:CPU's, and on the relayed TPU every dropped
+    executable would re-pay a remote compile."""
+    if (
+        jax.default_backend() != "tpu"
+        and _compiles_since_clear[0] >= _CLEAR_CACHES_COMPILE_THRESHOLD
+    ):
         jax.clear_caches()
+        _compiles_since_clear[0] = 0
     yield
+
+
+# On-TPU skips must be SELF-JUSTIFYING (VERDICT r5 #4): the TPU suite
+# artifact is captured with -q -rs (see CLAUDE.md), and every skip must
+# carry a reason from this registry of known-legitimate classes —
+# device-count guards, platform-scoped coverage, host capabilities, and
+# artifact presence.  Any other on-TPU skip FAILS the test, so "skipped:
+# TPU path quietly disabled" can never hide inside a green artifact.
+_TPU_SKIP_ALLOWED = tuple(re.compile(p) for p in (
+    r"needs \d+ devices?, have \d+",          # require_devices guards
+    r"off-TPU expectation test",              # CPU-twin contract fixtures
+    r"CPU-suite coverage",                    # compile-diversity fuzz
+    r"device-count contract applies to the virtual CPU mesh",
+    r"CPU backend lacks multi-process",       # host-jax capability
+    r"native library unavailable",            # no C++ toolchain on host
+    r"host-callback probe failed",            # jax host-callback capability
+    r"no driver BENCH_r\*\.json present",     # artifact presence
+    r"capture r\d+ is newer than the driver record",
+))
+
+
+@pytest.hookimpl(hookwrapper=True)
+def pytest_runtest_makereport(item, call):
+    outcome = yield
+    rep = outcome.get_result()
+    if (
+        rep.skipped
+        and not hasattr(rep, "wasxfail")
+        and jax.default_backend() == "tpu"
+    ):
+        reason = (
+            rep.longrepr[2] if isinstance(rep.longrepr, tuple)
+            else str(rep.longrepr)
+        )
+        if not any(p.search(reason) for p in _TPU_SKIP_ALLOWED):
+            rep.outcome = "failed"
+            rep.longrepr = (
+                f"unexplained on-TPU skip: {reason!r} — on-TPU skips must "
+                "match a pattern in tests/conftest.py::_TPU_SKIP_ALLOWED "
+                "(device-count / platform-scoped / host-capability / "
+                "artifact-presence); add the new class there with a "
+                "justification or unskip the test"
+            )
 
 
 @pytest.fixture

@@ -1,0 +1,57 @@
+"""Planted-regression twin set for the Layer-3 cost lockfile (graftcost).
+
+``cost_clean`` is the baseline: a miniature reduced-path program — a
+[T, 2, 2] pair-step stream driven through a sequential max-plus scan plus
+a model-sized epilogue — mirroring the shape of the real reduced engines.
+Each ``cost_*`` sibling plants exactly ONE of the regressions the lockfile
+diff exists to catch (dense pair op, doubled scan depth, grown fixed
+epilogue, f64 upcast).  tests/test_graftcheck_self.py baselines the clean
+twin and asserts every planted twin fails the diff with the drifting
+primitives named.
+
+Fixture contract: ``make(scale)`` returns ``(fn, (args,))`` with the time
+geometry multiplied by ``scale``; ``BASE_SYMBOLS`` is the scale-1 symbol
+count (the same shape ``analysis.contracts.Contract.make`` has).
+"""
+
+BASE_SYMBOLS = 1024
+
+
+def _steps(o):
+    import jax.numpy as jnp
+
+    # Reduced pair-step stream: [T, 2, 2], 4 elements per symbol.
+    return jnp.ones((o.shape[0], 2, 2), jnp.float32) * (
+        o[:, None, None].astype(jnp.float32)
+    )
+
+
+def _chain(steps):
+    import jax
+    import jax.numpy as jnp
+
+    def body(carry, step):
+        new = jnp.max(step + carry[None, :], axis=1)
+        return new, new[0]
+
+    return jax.lax.scan(body, jnp.zeros(2, jnp.float32), steps)
+
+
+def _epilogue(n: int = 8):
+    import jax.numpy as jnp
+
+    m = jnp.eye(n, dtype=jnp.float32)
+    return (m @ m).sum()
+
+
+def make(scale: int = 1):
+    import jax.numpy as jnp
+    import numpy as np
+
+    obs = jnp.asarray(np.arange(BASE_SYMBOLS * scale, dtype=np.int32) % 4)
+
+    def fn(o):
+        carry, ys = _chain(_steps(o))
+        return carry.sum() + ys.sum() + _epilogue()
+
+    return fn, (obs,)

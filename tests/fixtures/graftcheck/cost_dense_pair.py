@@ -1,0 +1,28 @@
+"""Planted regression: an O(T·S²) dense-pair op on the reduced path.
+
+Identical to ``cost_clean`` except a [T, 8, 8] dense pair tensor is
+materialized and folded in — the exact shape of a reintroduced dense
+xi/products op (64 result elements per symbol, vs the reduced stream's 4).
+Must be caught by (a) the lockfile diff (flops/bytes drift naming the new
+primitives) and (b) the ``cost.reduced-no-dense-pair`` contract.
+"""
+
+from cost_clean import BASE_SYMBOLS, _chain, _epilogue, _steps  # noqa: F401
+
+
+def make(scale: int = 1):
+    import jax.numpy as jnp
+    import numpy as np
+
+    obs = jnp.asarray(np.arange(BASE_SYMBOLS * scale, dtype=np.int32) % 4)
+
+    def fn(o):
+        carry, ys = _chain(_steps(o))
+        # The planted dense pair tensor: [T, S, S] with S=8.
+        dense = jnp.ones((o.shape[0], 8, 8), jnp.float32) * (
+            o[:, None, None].astype(jnp.float32)
+        )
+        xi = jnp.einsum("tij,tjk->tik", dense, dense)
+        return carry.sum() + ys.sum() + xi.sum() + _epilogue()
+
+    return fn, (obs,)

@@ -1,0 +1,24 @@
+"""Planted regression: doubled sequential scan trip count.
+
+Identical to ``cost_clean`` except the max-plus chain runs TWICE
+(chained), doubling the serial-depth slope and the scan flops — the
+static signature of an accidentally serialized second pass.  Must be
+caught by the lockfile diff (serial_depth / flops drift, scan named).
+"""
+
+from cost_clean import BASE_SYMBOLS, _chain, _epilogue, _steps  # noqa: F401
+
+
+def make(scale: int = 1):
+    import jax.numpy as jnp
+    import numpy as np
+
+    obs = jnp.asarray(np.arange(BASE_SYMBOLS * scale, dtype=np.int32) % 4)
+
+    def fn(o):
+        steps = _steps(o)
+        carry, ys = _chain(steps)
+        carry2, ys2 = _chain(steps + carry[None, None, :])
+        return carry2.sum() + ys.sum() + ys2.sum() + _epilogue()
+
+    return fn, (obs,)

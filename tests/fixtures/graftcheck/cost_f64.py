@@ -1,0 +1,29 @@
+"""Planted regression: f64 upcast on the device path.
+
+Identical to ``cost_clean`` except the pair-step stream is upcast to
+float64 — doubling every stream byte (the lockfile diff's ``bytes``
+drift names ``convert_element_type``) and tripping the boolean layer's
+no-f64 contract (``contracts.inspect_jaxpr``).
+"""
+
+from cost_clean import BASE_SYMBOLS, _chain, _epilogue, _steps  # noqa: F401
+
+
+def make(scale: int = 1):
+    import jax.numpy as jnp
+    import numpy as np
+
+    obs = jnp.asarray(np.arange(BASE_SYMBOLS * scale, dtype=np.int32) % 4)
+
+    def fn(o):
+        import jax
+
+        def body(carry, step):
+            new = jnp.max(step + carry[None, :], axis=1)
+            return new, new[0]
+
+        steps64 = _steps(o).astype(jnp.float64)
+        carry, ys = jax.lax.scan(body, jnp.zeros(2, jnp.float64), steps64)
+        return (carry.sum() + ys.sum()).astype(jnp.float32) + _epilogue()
+
+    return fn, (obs,)

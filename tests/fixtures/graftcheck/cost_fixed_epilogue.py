@@ -1,0 +1,23 @@
+"""Planted regression: grown fixed-cost (size-independent) epilogue.
+
+Identical to ``cost_clean`` except the model-sized 8x8 epilogue became a
+256x256 matmul — ~33 MFLOP of FIXED cost per invocation, invisible to
+any per-symbol throughput figure but exactly what the size curve pins
+(the ~8-11 ms class of regression).  Must be caught by the lockfile diff
+as ``flops.fixed`` drift with ``dot_general`` named.
+"""
+
+from cost_clean import BASE_SYMBOLS, _chain, _epilogue, _steps  # noqa: F401
+
+
+def make(scale: int = 1):
+    import jax.numpy as jnp
+    import numpy as np
+
+    obs = jnp.asarray(np.arange(BASE_SYMBOLS * scale, dtype=np.int32) % 4)
+
+    def fn(o):
+        carry, ys = _chain(_steps(o))
+        return carry.sum() + ys.sum() + _epilogue(256)
+
+    return fn, (obs,)

@@ -67,6 +67,23 @@ def test_per_path_plausibility_ceiling():
         assert bench._check_plausible(1.2 * enforced, path) == 1.2 * enforced
 
 
+def test_armed_ceilings_record_in_artifact():
+    """VERDICT r5 #7: every bench phase emits what it ACTUALLY armed —
+    the per-path ceilings, or an explicit degradation marker when the
+    BASELINE.md markers failed to parse (never a silent fallback)."""
+    rec = bench.armed_ceilings_record()
+    assert isinstance(rec, dict)
+    for path in bench._baseline_key_by_path():
+        assert path in rec
+        assert rec[path] == round(bench._path_ceilings()[path] / 1e6, 1)
+    old = bench._PATH_CEILINGS
+    try:
+        bench._PATH_CEILINGS = {}
+        assert bench.armed_ceilings_record() == "degraded-to-global"
+    finally:
+        bench._PATH_CEILINGS = old
+
+
 def test_capture_paths_newest_round(tmp_path):
     import pubnum
 

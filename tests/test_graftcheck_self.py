@@ -1,19 +1,31 @@
 """The self-scan gate: the repo is clean under its own linter (modulo
-justified inline waivers), and every registered jaxpr contract holds on
-the CPU backend — including the recompile sentinel and the
-callback/pallas-detection machinery itself.
+justified inline waivers), every registered jaxpr contract holds on the
+CPU backend — including the recompile sentinel and the
+callback/pallas-detection machinery itself — and the Layer-3 cost pass
+(COSTS.json lockfile + quantitative cost contracts) is green on the tree
+while each planted-regression fixture fails it with the drifting
+primitives named.
 """
 
 import os
+import sys
 
 import jax
 import jax.numpy as jnp
 import pytest
 
-from cpgisland_tpu.analysis import contracts, run_lint
+from cpgisland_tpu.analysis import (
+    contracts,
+    cost_contracts,
+    costmodel,
+    run_lint,
+)
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 PKG = os.path.join(REPO, "cpgisland_tpu")
+COST_FIXTURES = os.path.join(
+    os.path.dirname(__file__), "fixtures", "graftcheck"
+)
 
 
 def test_self_scan_clean():
@@ -105,3 +117,198 @@ def test_contract_pallas_expectation_is_platform_aware():
     res = contracts.check_contract(c, execute=False)
     assert not res.ok
     assert any("XLA twin" in v for v in res.violations)
+
+
+# -- Layer 3: the cost pass on the tree --------------------------------------
+
+
+@pytest.fixture(scope="module")
+def cost_report():
+    return cost_contracts.run_cost_pass()
+
+
+def test_cost_pass_green_on_tree(cost_report):
+    assert cost_report["ok"], {
+        "diff": cost_report["diff"]["violations"],
+        "contracts": [
+            (r["name"], r["violations"])
+            for r in cost_report["contracts"] if not r["ok"]
+        ],
+    }
+    # The committed lockfile covers the whole registry — no stale entries,
+    # nothing unbaselined.
+    assert cost_report["diff"]["stale"] == []
+    assert cost_report["diff"]["checked"] >= 11
+
+
+def test_cost_contracts_all_present(cost_report):
+    names = {r["name"] for r in cost_report["contracts"]}
+    assert names == {
+        "cost.reduced-no-dense-pair", "cost.em-body-fixed-share",
+        "cost.pass-structure", "cost.serial-depth-lanes",
+    }
+
+
+def test_pass_structure_matches_documented(cost_report):
+    byname = {r["name"]: r for r in cost_report["contracts"]}
+    notes = byname["cost.pass-structure"]["notes"]
+    # BASELINE.md's documented pass structure: 3-pass decode/posterior,
+    # 2-pass chunked EM.
+    assert notes["decode.onehot"] == 3
+    assert notes["posterior.onehot"] == 3
+    assert notes["em.seq.onehot"] == 3
+    assert notes["em.chunked.onehot"] == 2
+
+
+# -- Layer 3: planted-regression fixtures ------------------------------------
+
+
+def _fixture_entry(stem: str, name: str = "fixture.cost"):
+    sys.path.insert(0, COST_FIXTURES)
+    try:
+        mod = __import__(stem)
+    finally:
+        sys.path.pop(0)
+    return contracts.Contract(
+        name=name, make=mod.make, base_symbols=mod.BASE_SYMBOLS,
+        cost_scales=(1, 2),
+    )
+
+
+@pytest.fixture(scope="module")
+def clean_lock(tmp_path_factory):
+    """A lockfile baselined from the CLEAN fixture twin."""
+    entry = costmodel.trace_entry(_fixture_entry("cost_clean"))
+    fp = {"fixture.cost": cost_contracts.fingerprint(entry)}
+    path = str(tmp_path_factory.mktemp("costs") / "COSTS.json")
+    cost_contracts.write_lockfile(fp, path)
+    return path
+
+
+def _diff_fixture(stem: str, clean_lock: str, **trace_kw):
+    entry = costmodel.trace_entry(_fixture_entry(stem), **trace_kw)
+    live = {"fixture.cost": cost_contracts.fingerprint(entry)}
+    lock = cost_contracts.load_lockfile(clean_lock)
+    return entry, cost_contracts.diff_costs(live, lock, "cpu")
+
+
+def test_clean_fixture_round_trips(clean_lock):
+    _, diff = _diff_fixture("cost_clean", clean_lock)
+    assert diff.ok, diff.violations
+
+
+def test_planted_dense_pair_caught(clean_lock):
+    entry, diff = _diff_fixture("cost_dense_pair", clean_lock)
+    assert not diff.ok
+    # The diff names the drifting primitives (the planted einsum).
+    assert any("dot_general" in v for v in diff.violations), diff.violations
+    # And the quantitative contract sees the O(T*S^2) tensor directly.
+    bad = entry.dense_pair_eqns(n_states=8)
+    assert bad, "dense-pair detector missed the planted [T,8,8] op"
+    assert any(c.out_elems >= 32 * entry.geometries[-1] for c in bad)
+
+
+def test_clean_fixture_has_no_dense_pair():
+    entry = costmodel.trace_entry(_fixture_entry("cost_clean"))
+    assert entry.dense_pair_eqns(n_states=8) == []
+
+
+def test_dense_pair_detector_sees_inside_scan_bodies():
+    """A dense per-step [S, S] op hidden inside a T-trip scan does O(T*S^2)
+    total work while each application outputs only S^2 elements — the
+    detector must count it at full loop multiplicity, not one application."""
+    import numpy as np
+
+    def make(scale: int = 1):
+        T = 1024 * scale
+        obs = jnp.asarray(np.arange(T, dtype=np.int32) % 4)
+
+        def fn(o):
+            def body(carry, x):
+                step = jnp.ones((8, 8), jnp.float32) * x.astype(jnp.float32)
+                new = jnp.max(step + carry[None, :], axis=1)
+                return new, new[0]
+
+            carry, ys = jax.lax.scan(body, jnp.zeros(8, jnp.float32), o)
+            return carry.sum() + ys.sum()
+
+        return fn, (obs,)
+
+    c = contracts.Contract(
+        name="fixture.scan-dense", make=make, base_symbols=1024,
+        cost_scales=(1, 2),
+    )
+    entry = costmodel.trace_entry(c)
+    bad = entry.dense_pair_eqns(n_states=8)
+    assert bad, "per-step dense pair op inside the scan body was missed"
+    assert all(b.path.startswith("scan/") for b in bad)
+
+
+def test_planted_double_scan_caught(clean_lock):
+    _, diff = _diff_fixture("cost_double_scan", clean_lock)
+    assert not diff.ok
+    # Doubled trip count doubles the serial-depth slope AND the scan flops.
+    assert any("serial_depth" in v for v in diff.violations), diff.violations
+    assert any("scan" in v or "eqn count" in v for v in diff.violations)
+
+
+def test_planted_fixed_epilogue_caught(clean_lock):
+    _, diff = _diff_fixture("cost_fixed_epilogue", clean_lock)
+    assert not diff.ok
+    # The regression is FIXED cost: flops.fixed drifts, dot_general named.
+    assert any(
+        "flops.fixed" in v and "dot_general" in v for v in diff.violations
+    ), diff.violations
+
+
+def test_planted_f64_caught(clean_lock):
+    with jax.experimental.enable_x64():
+        entry, diff = _diff_fixture("cost_f64", clean_lock)
+        assert not diff.ok
+        # Doubled stream bytes, convert_element_type in the histogram diff.
+        assert any("bytes" in v for v in diff.violations), diff.violations
+        # The boolean layer catches the dtype itself on the same trace.
+        fn, args = _fixture_entry("cost_f64").make(1)[:2]
+        info = contracts.inspect_jaxpr(jax.make_jaxpr(fn)(*args))
+        assert info["bad_dtypes"], "no-f64 detector missed the planted upcast"
+
+
+def test_stale_lockfile_entry_reported(clean_lock):
+    # An empty live registry leaves the clean entry stale — reported like
+    # a stale waiver (note + stale list), not silently dropped.
+    lock = cost_contracts.load_lockfile(clean_lock)
+    diff = cost_contracts.diff_costs({}, lock, "cpu")
+    assert diff.stale == ["fixture.cost"]
+    assert any("stale lockfile entry" in n for n in diff.notes)
+
+
+def test_missing_lockfile_entry_is_violation(clean_lock):
+    entry = costmodel.trace_entry(_fixture_entry("cost_clean", "fixture.new"))
+    live = {"fixture.new": cost_contracts.fingerprint(entry)}
+    lock = cost_contracts.load_lockfile(clean_lock)
+    diff = cost_contracts.diff_costs(live, lock, "cpu")
+    assert not diff.ok
+    assert any("not in the lockfile" in v for v in diff.violations)
+
+
+def test_missing_platform_section_is_note_not_violation(clean_lock):
+    lock = cost_contracts.load_lockfile(clean_lock)
+    diff = cost_contracts.diff_costs({}, lock, "tpu")
+    assert diff.ok
+    assert any("no 'tpu' section" in n for n in diff.notes)
+
+
+def test_attribution_table_names_fixed_cost_groups():
+    # The em.seq.onehot attribution table is the BASELINE.md size-curve
+    # decomposition: it must name the boundary/prep/epilogue groups that
+    # carry the fixed cost.
+    entries = {c.name: c for c in cost_contracts.cost_entries()}
+    traced = costmodel.trace_entry(entries["em.seq.onehot"])
+    table = costmodel.attribution_table(traced)
+    assert "fb_onehot.py:step" in table
+    assert "| **total** |" in table
+    att = costmodel.attribute(traced)
+    assert att["groups"], "no attribution groups"
+    totals = att["totals"]
+    assert totals["flops"]["per_symbol"] > 100  # the real per-symbol work
+    assert totals["flops"]["fixed"] < totals["flops"]["per_symbol"] * 1e5

@@ -13,6 +13,13 @@ echo "== graftcheck: jaxpr contract pass (CPU trace) =="
 # symbol-stream prep primitives (prepared streams resolved outside the loop).
 python -m cpgisland_tpu.analysis --no-lint --contracts
 
+echo "== graftcost: quantitative cost contracts + COSTS.json diff (CPU trace) =="
+# Layer 3: live cost fingerprints (FLOPs/bytes/serial depth/pass counts at
+# >=2 geometries) must match the committed lockfile; a drift names the
+# drifting primitives.  Re-baseline with --update-costs after a VERIFIED
+# graph change.
+python -m cpgisland_tpu.analysis --no-lint --costs
+
 echo "== syntax gate =="
 python -m compileall -q cpgisland_tpu tools tests bench.py __graft_entry__.py
 
