@@ -67,17 +67,25 @@ FIXED_SHARE_MAX = 0.01
 REFERENCE_T = 16 * 2**20  # the size-curve's 16 Mi knee (BASELINE.md)
 
 # T-scaling sequential pass counts, pinned to the documented pass
-# structure (BASELINE.md roofline: decode = products/backpointers/
-# backtrace, posterior = products/fwd/bwd+conf; chunked EM = fwd + bwd —
-# its stats pass is a throughput-bound contraction, not a serial chain).
+# structure (BASELINE.md roofline + "Pass-count collapse" r9 section).
+# Decode keeps its 3 passes (products/backpointers/backtrace — pass B
+# needs pass A's entering vectors, pass C needs pass B's exits).  The
+# reduced probability-space paths run the r9 CO-SCHEDULED fwd/bwd pass
+# (fb_onehot._oh_fwdbwd_kernel / its one-scan XLA twin): posterior =
+# products + fused fwd/bwd (conf is an elementwise epilogue), exact-seq
+# EM = products + fused fwd/bwd (z-normalized stats are a throughput
+# contraction), chunked EM = ONE fused fwd/bwd pass.  The dense chunked
+# path keeps its split fwd + bwd (its cs-scaled stats need the split
+# backward's true Rabiner scaling).
 EXPECTED_PASSES = {
     "decode.xla": 3,
     "decode.onehot": 3,
     "decode.batch_flat.onehot": 3,
-    "posterior.onehot": 3,
-    "em.seq.onehot": 3,
+    "decode.batch_flat.scores.onehot": 3,
+    "posterior.onehot": 2,
+    "em.seq.onehot": 2,
     "em.chunked.xla": 2,
-    "em.chunked.onehot": 2,
+    "em.chunked.onehot": 1,
 }
 
 # Serial-depth slope ceilings (critical-path steps per SYMBOL).  Lane

@@ -257,6 +257,87 @@ def _oh_bwd_kernel(pairnext_ref, lens_ref, tab_ref, csnext_ref, beta0_ref,
     beta_scr[1:2, :] = bn1
 
 
+def _oh_fwdbwd_kernel(pair_ref, pairn_ref, lens_ref, a0raw_ref, beta0_ref,
+                      tab_ref, alphas_ref, betas_ref, fcarry, bcarry,
+                      *, nreal, Tt, T):
+    """CO-SCHEDULED forward + backward chains in ONE kernel launch.
+
+    The r8 cost attribution (BASELINE.md "Where the ~8-11 ms go") showed
+    the EM/posterior fixed cost is per-pass CHAIN DRAIN — three passes'
+    worth of sequential 2x2 recurrence that cannot overlap the next pass's
+    start.  Given the products-pass boundary messages, the forward and
+    backward chains are INDEPENDENT (the classic coupling — backward needs
+    the forward's Rabiner scales — is removed by self-normalizing the
+    backward with its own deferred previous-step sum; stored betas are
+    then per-position arbitrarily scaled DIRECTIONS, exactly what every
+    reduced consumer is already invariant to: the z-normalized stats
+    kernel, the conf ratio, the MPM argmax).  Grid cell j walks forward
+    tile j AND backward tile n_t-1-j, interleaving the two recurrences
+    per step so both chains fill VPU issue slots while either stalls —
+    one chain drain instead of two.
+
+    Outputs: alphas (deferred-Rabiner, = _oh_fwd_kernel bit-for-bit) and
+    SELF-NORMALIZED betas (per-position scale differs from _oh_bwd_kernel;
+    directions identical).  The XLA twin is :func:`_xla_fwdbwd_onehot` —
+    one scan computing both chains, same arithmetic in the same order.
+    """
+    j = pl.program_id(1)
+    n_t = pl.num_programs(1)
+    lens = lens_ref[0, :]
+    v0 = jnp.where(j == 0, a0raw_ref[0:1, :], fcarry[0:1, :])
+    v1 = jnp.where(j == 0, a0raw_ref[1:2, :], fcarry[1:2, :])
+    bn0 = jnp.where(j == 0, beta0_ref[0:1, :], bcarry[0:1, :])
+    bn1 = jnp.where(j == 0, beta0_ref[1:2, :], bcarry[1:2, :])
+    bt0 = (n_t - 1 - j) * Tt  # global base of this cell's backward tile
+
+    def body(tile_i, carry):
+        v0, v1, bn0, bn1 = carry
+        fbase = tile_i * ROW_TILE
+        bbase = (Tt // ROW_TILE - 1 - tile_i) * ROW_TILE
+        ftile = pair_ref[pl.ds(fbase, ROW_TILE), :]
+        btile = pairn_ref[pl.ds(bbase, ROW_TILE), :]
+        f00, f01, f10, f11 = _select4_prob(ftile, tab_ref, nreal)
+        g00, g01, g10, g11 = _select4_prob(btile, tab_ref, nreal)
+        for r in range(ROW_TILE):
+            # -- forward row r (ascending) — _oh_fwd_kernel arithmetic.
+            t = j * Tt + fbase + r
+            v_t = (t < lens)[None, :]
+            inv = 1.0 / (v0 + v1)
+            raw0 = v0 * f00[r : r + 1, :] + v1 * f10[r : r + 1, :]
+            raw1 = v0 * f01[r : r + 1, :] + v1 * f11[r : r + 1, :]
+            n0 = jnp.where(v_t, raw0 * inv, v0)
+            n1 = jnp.where(v_t, raw1 * inv, v1)
+            n0 = jnp.where(t == 0, a0raw_ref[0:1, :], n0)
+            n1 = jnp.where(t == 0, a0raw_ref[1:2, :], n1)
+            alphas_ref[fbase + r, :, :] = jnp.concatenate([n0, n1], axis=0)
+            v0, v1 = n0, n1
+            # -- backward row (descending) — independent chain; the VPU
+            # interleaves it with the forward's multiply-add tree.  Self-
+            # normalized: divide by the previous beta's own sum (off-chain
+            # reciprocal, the same deferred-Rabiner trick as the forward).
+            rr = ROW_TILE - 1 - r
+            tb = bt0 + bbase + rr
+            active = tb <= T - 2
+            v_next = (tb + 1) < lens
+            binv = 1.0 / (bn0 + bn1)
+            b0 = (g00[rr : rr + 1, :] * bn0 + g01[rr : rr + 1, :] * bn1) * binv
+            b1 = (g10[rr : rr + 1, :] * bn0 + g11[rr : rr + 1, :] * bn1) * binv
+            keep = (active & v_next)[None, :]
+            b0 = jnp.where(keep, b0, bn0)
+            b1 = jnp.where(keep, b1, bn1)
+            betas_ref[bbase + rr, :, :] = jnp.concatenate([b0, b1], axis=0)
+            bn0, bn1 = b0, b1
+        return v0, v1, bn0, bn1
+
+    v0, v1, bn0, bn1 = jax.lax.fori_loop(
+        0, Tt // ROW_TILE, body, (v0, v1, bn0, bn1)
+    )
+    fcarry[0:1, :] = v0
+    fcarry[1:2, :] = v1
+    bcarry[0:1, :] = bn0
+    bcarry[1:2, :] = bn1
+
+
 def _sel_mask2(tile, mtab_ref, n, by_sym, S):
     """Per-position island-mask components from the lane-broadcast mask
     table (rows 2k / 2k+1 = mask of the exit group's low/high state).
@@ -680,14 +761,23 @@ def _oh_seq_stats_kernel(alphas_ref, betas_ref, pair_ref, lens_ref, tab_ref,
 
 def run_seq_stats_onehot(params, alphas2, betas2, pair2, lens2, gt,
                          enters_red, enters_full, pair0_mask, Tt):
-    """Whole-sequence stats from REDUCED streams (TPU only; power-of-two S —
-    callers keep the scatter + XLA assembly off-TPU / for other S, which is
-    also this kernel's parity twin).  Returns (macc [K*K, NL] — trans =
-    A * macc-sum, the z-normalized scale-free scheme; emit_red
-    [S*GROUP, NL]; ll [1, NL])."""
+    """Z-normalized stats from REDUCED streams (power-of-two S; callers
+    keep the scatter + XLA assembly for other S).  Per-pair xi
+    normalization makes the scheme invariant to ANY per-position beta
+    scale — it serves the cs-scaled split streams, the self-normalized
+    fused streams, AND (with zero enters + an all-zero pair0_mask) the
+    chunked layout, whose lanes are independent records with no incoming
+    t==0 pair.  Returns (macc [K*K, NL] — trans = A * macc-sum; emit_red
+    [S*GROUP, NL]; ll [1, NL]).  Off-TPU lowering: the arithmetic twin
+    :func:`_xla_znorm_stats` (the kernel's parity reference)."""
     K, S = params.n_states, params.n_symbols
-    if S & (S - 1) or _interpret():
-        raise ValueError("run_seq_stats_onehot: TPU + power-of-two S only")
+    if S & (S - 1):
+        raise ValueError("run_seq_stats_onehot: power-of-two S only")
+    if _interpret():
+        return _xla_znorm_stats(
+            params, alphas2, betas2, pair2, lens2, gt, enters_red,
+            enters_full, pair0_mask,
+        )
     Tp, _, NL = alphas2.shape
     tab = prob_pair_table(params, gt)
     B = jnp.exp(params.log_B).astype(jnp.float32)
@@ -792,6 +882,134 @@ def _xla_bwd_onehot(tab_ext, pair_next, lens2, cs_next, beta0_red, T):
     return betas2
 
 
+def _xla_fwdbwd_onehot(tab_ext, pair2, pair_next, lens2, a0_red, beta0_red, T):
+    """XLA twin of :func:`_oh_fwdbwd_kernel`: ONE scan computing both the
+    forward chain (position k ascending) and the self-normalized backward
+    chain (position Tp-1-k descending) — the fused pass the cost contracts
+    count (posterior/em-seq drop to 2 T-scaling passes, chunked EM to 1).
+    Returns (alphas2 [Tp, 2, NL], betas2 [Tp, 2, NL] self-normalized)."""
+    Tp = pair2.shape[0]
+    lens = lens2[0]
+    pairn_rev = jnp.flip(pair_next, axis=0)
+
+    def step(carry, x):
+        v0, v1, bn0, bn1 = carry
+        pk, qk, t = x
+        T4 = _tab_sel_nl(tab_ext, pk)
+        G4 = _tab_sel_nl(tab_ext, qk)
+        # forward — _xla_fwd_onehot arithmetic, same order.
+        inv = 1.0 / (v0 + v1)
+        raw0 = v0 * T4[:, 0] + v1 * T4[:, 2]
+        raw1 = v0 * T4[:, 1] + v1 * T4[:, 3]
+        v_t = t < lens
+        n0 = jnp.where(v_t, raw0 * inv, v0)
+        n1 = jnp.where(v_t, raw1 * inv, v1)
+        n0 = jnp.where(t == 0, a0_red[:, 0], n0)
+        n1 = jnp.where(t == 0, a0_red[:, 1], n1)
+        # backward at tb = Tp-1-t — self-normalized (the kernel's order:
+        # raw contraction first, then the off-chain previous-sum scale).
+        tb = Tp - 1 - t
+        binv = 1.0 / (bn0 + bn1)
+        b0 = (G4[:, 0] * bn0 + G4[:, 1] * bn1) * binv
+        b1 = (G4[:, 2] * bn0 + G4[:, 3] * bn1) * binv
+        keep = (tb <= T - 2) & ((tb + 1) < lens)
+        b0 = jnp.where(keep, b0, bn0)
+        b1 = jnp.where(keep, b1, bn1)
+        return (n0, n1, b0, b1), (
+            jnp.stack([n0, n1], axis=0), jnp.stack([b0, b1], axis=0)
+        )
+
+    _, (alphas2, betas_rev) = jax.lax.scan(
+        step,
+        (a0_red[:, 0], a0_red[:, 1], beta0_red[:, 0], beta0_red[:, 1]),
+        (pair2, pairn_rev, jnp.arange(Tp, dtype=jnp.int32)),
+    )
+    return alphas2, jnp.flip(betas_rev, axis=0)
+
+
+def conf_from_reduced(alphas2, betas2, esym2, lens2, conf_mask, gt):
+    """Per-position island confidence from the reduced streams (elementwise
+    — no serial chain, so it is NOT a pass in the cost-contract sense; the
+    throughput epilogue of the fused fwd/bwd pass).  Scale-free: any
+    per-position scale on the betas cancels in the ratio, which is what
+    makes the self-normalized fused backward exact here.  The one
+    implementation shared by both platforms (TPU runs it as fused XLA
+    elementwise ops over the kernel outputs)."""
+    S = gt.shape[0]
+    mtab = conf_mask[gt].astype(jnp.float32)  # [S, GROUP]
+    m0 = jnp.zeros(esym2.shape, jnp.float32)
+    m1 = jnp.zeros(esym2.shape, jnp.float32)
+    for s in range(S):
+        cmp = esym2 == s
+        m0 = jnp.where(cmp, mtab[s, 0], m0)
+        m1 = jnp.where(cmp, mtab[s, 1], m1)
+    graw0 = alphas2[:, 0] * betas2[:, 0]
+    graw1 = alphas2[:, 1] * betas2[:, 1]
+    tot = jnp.maximum(graw0 + graw1, 1e-30)
+    vmask = jnp.arange(alphas2.shape[0])[:, None] < lens2
+    return jnp.where(vmask, (m0 * graw0 + m1 * graw1) / tot, 0.0)
+
+
+def _xla_znorm_stats(params, alphas2, betas2, pair2, lens2, gt, enters_red,
+                     enters_full, pair0_mask):
+    """XLA twin of :func:`_oh_seq_stats_kernel` on the REDUCED streams —
+    the off-TPU lowering of run_seq_stats_onehot (and, with zero enters +
+    an all-zero pair0_mask, of the fused chunked stats: every lane is an
+    independent record whose t==0 has no incoming pair).  Same per-pair
+    z-normalized scale-free xi, so it is exact for betas carrying ANY
+    per-position scale (cs-scaled split streams and self-normalized fused
+    streams alike)."""
+    K, S = params.n_states, params.n_symbols
+    Tp, _, NL = alphas2.shape
+    tab = prob_pair_table(params, gt)
+    ident = jnp.asarray([PROB_IDENT], jnp.float32)
+    tab_ext = jnp.concatenate([tab, ident], axis=0)
+    T4 = _tab_sel_nl(tab_ext, jnp.minimum(pair2, S * S).reshape(-1)).reshape(
+        Tp, NL, 4
+    )
+    esym2 = decode_esym(pair2, S)
+    B = jnp.exp(params.log_B).astype(jnp.float32)
+    B_red = B[gt, jnp.arange(S)[:, None]]  # [S, GROUP]
+    a0, a1 = alphas2[:, 0], alphas2[:, 1]
+    be0, be1 = betas2[:, 0], betas2[:, 1]
+    cs = a0 + a1
+    inv_cs = 1.0 / jnp.maximum(cs, 1e-30)
+    vmask = jnp.arange(Tp)[:, None] < lens2
+    g0, g1 = a0 * be0, a1 * be1
+    inv_g = 1.0 / jnp.maximum(g0 + g1, 1e-30)
+    gm0 = jnp.where(vmask, g0 * inv_g, 0.0)
+    gm1 = jnp.where(vmask, g1 * inv_g, 0.0)
+    emit_rows = []
+    for s in range(S):
+        m = esym2 == s
+        emit_rows.append(jnp.sum(jnp.where(m, gm0, 0.0), axis=0))
+        emit_rows.append(jnp.sum(jnp.where(m, gm1, 0.0), axis=0))
+    emit_red = jnp.stack(emit_rows, axis=0)  # [S*GROUP, NL]
+    ll = jnp.sum(
+        jnp.where(vmask, jnp.log(jnp.maximum(cs, 1e-30)), 0.0), axis=0
+    )[None, :]
+    # Previous-position a_hat (reduced + full-K scatter), entering messages
+    # at within-lane t == 0 — the kernel's is0 branch.
+    ah2 = jnp.stack([a0 * inv_cs, a1 * inv_cs], axis=1)  # [Tp, 2, NL]
+    ah_full = scatter_streams(ah2, gt, esym2, K)  # [Tp, K, NL]
+    ap2 = jnp.concatenate([enters_red[None], ah2[:-1]], axis=0)
+    apf = jnp.concatenate([enters_full[None], ah_full[:-1]], axis=0)
+    pairm = vmask.astype(jnp.float32)
+    pairm = pairm.at[0].set(pairm[0] * pair0_mask[0])
+    z = ap2[:, 0] * (T4[..., 0] * be0 + T4[..., 1] * be1) + \
+        ap2[:, 1] * (T4[..., 2] * be0 + T4[..., 3] * be1)
+    inv_z = pairm * (1.0 / jnp.maximum(z, 1e-30))
+    w_full = scatter_streams(
+        jnp.stack([B_red[esym2, 0] * be0, B_red[esym2, 1] * be1], axis=1),
+        gt, esym2, K,
+    )
+    wz = w_full * inv_z[:, None, :]
+    macc = jnp.einsum(
+        "tin,tjn->ijn", apf, wz, precision=jax.lax.Precision.HIGHEST
+    ).reshape(K * K, NL)
+    return macc, emit_red, ll
+
+
 # --- runner + scatter glue -------------------------------------------------
 
 
@@ -832,6 +1050,7 @@ def run_fb_kernels_onehot(
     T: int,
     conf_mask=None,
     pair_esym=None,
+    fused: bool = False,
 ):
     """Reduced forward + backward pair over the [Tp, NL] lane layout.
 
@@ -839,25 +1058,42 @@ def run_fb_kernels_onehot(
     and are projected onto each lane's entry/exit group here.  Returns
     (alphas2 [Tp, 2, NL], cs [Tp, NL], betas2 [Tp, 2, NL] — or conf2
     [Tp, NL] with ``conf_mask`` — and esym2 [Tp, NL] for scatter-back).
-    ``pair_esym``: a prepared (pair2, esym2) pair-stream (esym2 may be
-    None — it rederives arithmetically); inline prep otherwise.
+    ``pair_esym``: a prepared (pair2, esym2) or (pair2, esym2, pairn2)
+    pair-stream (esym2/pairn2 may be None — they rederive arithmetically);
+    inline prep otherwise.
+
+    ``fused`` (static) co-schedules both chains in ONE launch
+    (:func:`_oh_fwdbwd_kernel` / the one-scan XLA twin).  CONTRACT: the
+    fused betas are SELF-NORMALIZED per-position directions, not the
+    split path's cs-scaled betas — exact for every scale-free consumer
+    (conf ratio, z-normalized stats, gamma/MPM argmax), WRONG for the
+    chunked dense-stats kernel's cs-scaled macc (that caller must pass
+    fused=False).  conf_mask + fused computes the confidence as a
+    throughput-bound elementwise epilogue (:func:`conf_from_reduced`)
+    instead of the in-backward conf emission.
     """
     K, S = params.n_states, params.n_symbols
     gt = _groups(params)
     tab = prob_pair_table(params, gt)
+    pairn_pre = None
     if pair_esym is None:
         pair2, _, _ = _pair_stream(params, sel_t, jnp.asarray(prev_dev, jnp.int32))
         esym2 = decode_esym(pair2, S)
     else:
-        pair2, esym2 = pair_esym
+        pair2, esym2 = pair_esym[0], pair_esym[1]
+        pairn_pre = pair_esym[2] if len(pair_esym) > 2 else None
         if esym2 is None:
             esym2 = decode_esym(pair2, S)
     Tp, NL = pair2.shape
 
     a0_red = jnp.take_along_axis(a0_raw.T, gt[esym2[0]], axis=1)  # [NL, 2]
     beta0_red = jnp.take_along_axis(beta0.T, gt[esym2[-1]], axis=1)
-    pair_next = jnp.concatenate(
-        [pair2[1:], jnp.full((1, NL), S * S, jnp.int32)], axis=0
+    pair_next = (
+        pairn_pre
+        if pairn_pre is not None
+        else jnp.concatenate(
+            [pair2[1:], jnp.full((1, NL), S * S, jnp.int32)], axis=0
+        )
     )
     ident = jnp.asarray([PROB_IDENT], jnp.float32)
     tab_ext = jnp.concatenate([tab, ident], axis=0)
@@ -865,6 +1101,17 @@ def run_fb_kernels_onehot(
     pairn_c = jnp.minimum(pair_next, S * S)
 
     if _interpret():
+        if fused:
+            alphas2, betas2 = _xla_fwdbwd_onehot(
+                tab_ext, pair_c, pairn_c, lens2, a0_red, beta0_red, T
+            )
+            cs = jnp.sum(alphas2, axis=1)
+            if conf_mask is None:
+                return alphas2, cs, betas2, esym2
+            conf2 = conf_from_reduced(
+                alphas2, betas2, esym2, lens2, conf_mask, gt
+            )
+            return alphas2, cs, conf2, esym2
         alphas2 = _xla_fwd_onehot(tab_ext, pair_c, lens2, a0_red)
         cs = jnp.sum(alphas2, axis=1)
         cs_next = jnp.concatenate([cs[1:], jnp.ones((1, NL), cs.dtype)], axis=0)
@@ -892,6 +1139,37 @@ def run_fb_kernels_onehot(
     glane_spec = _vspec((GROUP, lt), lambda i, j: (0, i))
     step_spec = _vspec((Tt, lt), lambda i, j: (j, i))
     tabb = _bcast_tab(tab, lt)
+    if fused:
+        rev_spec = _vspec((Tt, lt), lambda i, j: (n_t - 1 - j, i))
+        alphas2, betas2 = pl.pallas_call(
+            functools.partial(_oh_fwdbwd_kernel, nreal=S * S, Tt=Tt, T=T),
+            grid=grid,
+            in_specs=[
+                step_spec,
+                rev_spec,
+                lane_spec,
+                glane_spec,
+                glane_spec,
+                _vspec(tabb.shape, lambda i, j: (0, 0)),
+            ],
+            out_specs=[
+                _vspec((Tt, GROUP, lt), lambda i, j: (j, 0, i)),
+                _vspec((Tt, GROUP, lt), lambda i, j: (n_t - 1 - j, 0, i)),
+            ],
+            out_shape=[
+                jax.ShapeDtypeStruct((Tp, GROUP, NL), jnp.float32),
+                jax.ShapeDtypeStruct((Tp, GROUP, NL), jnp.float32),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((GROUP, lt), jnp.float32),
+                pltpu.VMEM((GROUP, lt), jnp.float32),
+            ],
+        )(pair2, pair_next, lens2, a0_red.T, beta0_red.T, tabb)
+        cs = jnp.sum(alphas2, axis=1)
+        if conf_mask is None:
+            return alphas2, cs, betas2, esym2
+        conf2 = conf_from_reduced(alphas2, betas2, esym2, lens2, conf_mask, gt)
+        return alphas2, cs, conf2, esym2
     (alphas2,) = pl.pallas_call(
         functools.partial(_oh_fwd_kernel, nreal=S * S, Tt=Tt),
         grid=grid,

@@ -684,7 +684,7 @@ def _conf_path_from_streams(alphas, betas, lens2, island_mask):
     return conf2, path2
 
 
-@functools.partial(jax.jit, static_argnames=("t_tile", "onehot"))
+@functools.partial(jax.jit, static_argnames=("t_tile", "onehot", "fused"))
 def batch_stats_pallas(
     params: HmmParams,
     chunks: jnp.ndarray,
@@ -692,6 +692,7 @@ def batch_stats_pallas(
     t_tile: int = DEFAULT_T_TILE,
     onehot: bool = False,
     prepared=None,
+    fused: bool = True,
 ) -> SuffStats:
     """Pallas twin of ops.forward_backward.batch_stats(mode="rescaled").
 
@@ -704,6 +705,13 @@ def batch_stats_pallas(
     ops.prepared.PreparedChunked, passed as an explicit jit argument): the
     symbol-only lane layout + pair stream, amortized across EM iterations
     and pipeline passes; inline prep (same code) otherwise.
+
+    ``fused`` (pow2-S onehot only; static): co-schedule the fwd/bwd chains
+    in ONE launch and reduce counts with the z-normalized stats kernel —
+    the chunked E-step's serial structure drops from two chain drains to
+    ONE (the stats pass has no chain).  The split arm (fused=False, or any
+    non-pow2-S / dense routing) keeps the r4 3-kernel path: its cs-scaled
+    stats need the split backward's true Rabiner scaling.
     """
     K, S = params.n_states, params.n_symbols
     T = chunks.shape[1]
@@ -714,17 +722,35 @@ def batch_stats_pallas(
     if onehot:
         from cpgisland_tpu.ops import fb_onehot
 
+        can_znorm = S & (S - 1) == 0
+        use_fused = fused and can_znorm
         al2, cs, b2, esym2 = fb_onehot.run_fb_kernels_onehot(
             params, prep.sel2, jnp.int32(0), lens2, a0_raw, beta0, Tt, T,
-            pair_esym=(prep.pair2, prep.esym2),
+            pair_esym=(prep.pair2, prep.esym2, prep.pairn2),
+            fused=use_fused,
         )
         gt = fb_onehot._groups(params)
-        if S & (S - 1) == 0:
-            # Reduced-stream stats: 16 B/symbol read instead of 64, dense
-            # rows rebuilt in registers — no HBM scatter anywhere.
-            macc, emit_red, ll = fb_onehot.run_stats_onehot(
-                params, al2, b2, prep.pair2, lens2, gt, Tt
-            )
+        if can_znorm:
+            if use_fused:
+                # Z-normalized stats over the fused streams: per-pair xi
+                # normalization is invariant to the self-normalized betas;
+                # zero enters + an all-zero pair0 mask encode "every lane
+                # is an independent record with no incoming t==0 pair".
+                NL = al2.shape[2]
+                macc, emit_red, ll = fb_onehot.run_seq_stats_onehot(
+                    params, al2, b2, prep.pair2, lens2, gt,
+                    jnp.zeros((fb_onehot.GROUP, NL), jnp.float32),
+                    jnp.zeros((K, NL), jnp.float32),
+                    jnp.zeros((1, NL), jnp.float32),
+                    Tt,
+                )
+            else:
+                # Reduced-stream stats: 16 B/symbol read instead of 64,
+                # dense rows rebuilt in registers — no HBM scatter
+                # anywhere.  Needs the split backward's cs-scaled betas.
+                macc, emit_red, ll = fb_onehot.run_stats_onehot(
+                    params, al2, b2, prep.pair2, lens2, gt, Tt
+                )
             trans, emit, loglik = _assemble_reduced_stats(
                 params, A, gt, macc, emit_red, ll
             )
@@ -795,7 +821,9 @@ def _norm_rows(v):
     return v / jnp.maximum(jnp.sum(v, axis=-1, keepdims=True), 1e-30)
 
 
-@functools.partial(jax.jit, static_argnames=("lane_T", "t_tile", "onehot"))
+@functools.partial(
+    jax.jit, static_argnames=("lane_T", "t_tile", "onehot", "fused")
+)
 def seq_stats_pallas(
     params: HmmParams,
     obs: jnp.ndarray,
@@ -804,6 +832,7 @@ def seq_stats_pallas(
     t_tile: int = DEFAULT_T_TILE,
     onehot: bool = False,
     prepared=None,
+    fused: bool = True,
 ) -> SuffStats:
     """EXACT whole-sequence statistics on one device via the fused kernels.
 
@@ -825,7 +854,7 @@ def seq_stats_pallas(
     """
     return _seq_stats_core(
         params, obs, length, lane_T, t_tile, axis=None, onehot=onehot,
-        prepared=prepared,
+        prepared=prepared, fused=fused,
     )
 
 
@@ -908,9 +937,18 @@ def _lane_streams(
     prev_sym=None,
     return_reduced: bool = False,
     prepared=None,
+    fused: bool = True,
 ):
     """Shared lane setup for the fused whole-sequence paths: lane transfer
     products -> boundary messages -> forward/backward kernel streams.
+
+    ``fused`` (one-hot engines only): co-schedule the forward and backward
+    chains in ONE kernel launch (fb_onehot._oh_fwdbwd_kernel) — the betas
+    slot then carries SELF-NORMALIZED per-position directions, which every
+    consumer of this path is scale-free in (conf ratio, z-normalized seq
+    stats, the scale-free xi assembly, MPM argmax).  fused=False keeps the
+    split fwd/bwd passes — the A/B arm (tools/bench_passfusion.py) and the
+    r4-shaped 3-pass structure.
 
     With ``conf_mask`` ([K] island indicator) the backward kernel emits the
     per-position island confidence in the betas slot of the return tuple
@@ -1124,9 +1162,11 @@ def _lane_streams(
         # zeros wherever they are ever multiplied in); the conf fast path
         # consumes the reduced streams directly and the scatters are
         # dead-code-eliminated.
+        pairn_pre = prepared.pairn2 if prepared is not None else None
         al2, cs, third2, esym2 = fb_onehot.run_fb_kernels_onehot(
             params, sel_l.T, prev_dev, lens2, v0.T, beta_exits.T, Tt,
-            lane_T, conf_mask=conf_mask, pair_esym=(pair2, None),
+            lane_T, conf_mask=conf_mask, pair_esym=(pair2, None, pairn_pre),
+            fused=fused,
         )
         if return_reduced and conf_mask is None:
             # Raw reduced streams for the seq-stats kernel consumer — the
@@ -1157,6 +1197,7 @@ def _seq_stats_core(
     reduce: bool = True,
     onehot: bool = False,
     prepared=None,
+    fused: bool = True,
 ) -> SuffStats:
     """The fused whole-sequence E-step over THIS device's time shard.
 
@@ -1173,12 +1214,16 @@ def _seq_stats_core(
     B = jnp.exp(params.log_B).astype(jnp.float32)
     length = jnp.asarray(length, jnp.int32)
 
-    use_kernel_stats = (
-        onehot and not _interpret() and S & (S - 1) == 0
-    )
+    # Reduced-stream stats for power-of-two S on BOTH platforms now: the
+    # off-TPU lowering is the z-normalized XLA twin (fb_onehot.
+    # _xla_znorm_stats), arithmetic-identical to the chip kernel, so CPU
+    # runs certify the same scheme the silicon executes.  (Non-pow2 S
+    # keeps the scatter + dense scale-free assembly below — itself
+    # invariant to the fused path's self-normalized betas.)
+    use_kernel_stats = onehot and S & (S - 1) == 0
     alphas, cs, betas, steps2, lens2, enters, is_first, Tt_used = _lane_streams(
         params, obs, length, lane_T, t_tile, axis, onehot=onehot,
-        return_reduced=use_kernel_stats, prepared=prepared,
+        return_reduced=use_kernel_stats, prepared=prepared, fused=fused,
     )
     NL = steps2.shape[1]
     if use_kernel_stats:
@@ -1265,6 +1310,7 @@ def _seq_posterior_core(
     onehot: bool = False,
     prev_sym=None,
     prepared=None,
+    fused: bool = True,
 ):
     """Per-position island confidence over THIS device's time shard, fused.
 
@@ -1291,7 +1337,7 @@ def _seq_posterior_core(
             params, obs, length, lane_T, t_tile, axis,
             enter_dir=enter_dir, exit_dir=exit_dir, first=first,
             conf_mask=island_mask, onehot=onehot, prev_sym=prev_sym,
-            prepared=prepared,
+            prepared=prepared, fused=fused,
         )
         # Lane n covers global positions [n*lane_T, (n+1)*lane_T): transpose
         # the [lane_T, NL] lane layout back to global order, slice the pad.
@@ -1299,14 +1345,17 @@ def _seq_posterior_core(
     alphas, cs, betas, steps2, lens2, _, _, _ = _lane_streams(
         params, obs, length, lane_T, t_tile, axis,
         enter_dir=enter_dir, exit_dir=exit_dir, first=first,
-        onehot=onehot, prev_sym=prev_sym, prepared=prepared,
+        onehot=onehot, prev_sym=prev_sym, prepared=prepared, fused=fused,
     )
+    # With the fused backward the betas are per-position directions; the
+    # gamma normalize/argmax below is scale-free, so the branch is shared.
     conf2, path2 = _conf_path_from_streams(alphas, betas, lens2, island_mask)
     return conf2.T.reshape(-1)[:T], path2.T.reshape(-1)[:T]
 
 
 @functools.partial(
-    jax.jit, static_argnames=("lane_T", "t_tile", "first", "want_path", "onehot")
+    jax.jit,
+    static_argnames=("lane_T", "t_tile", "first", "want_path", "onehot", "fused"),
 )
 def seq_posterior_pallas(
     params: HmmParams,
@@ -1322,6 +1371,7 @@ def seq_posterior_pallas(
     onehot: bool = False,
     prev_sym=None,
     prepared=None,
+    fused: bool = True,
 ):
     """Single-device fused posterior: (conf [T], mpm path [T]).
 
@@ -1335,11 +1385,13 @@ def seq_posterior_pallas(
         params, obs, length, island_mask, lane_T, t_tile, axis=None,
         enter_dir=enter_dir, exit_dir=exit_dir, first=first,
         want_path=want_path, onehot=onehot, prev_sym=prev_sym,
-        prepared=prepared,
+        prepared=prepared, fused=fused,
     )
 
 
-@functools.partial(jax.jit, static_argnames=("t_tile", "want_path", "onehot"))
+@functools.partial(
+    jax.jit, static_argnames=("t_tile", "want_path", "onehot", "fused")
+)
 def batch_posterior_pallas(
     params: HmmParams,
     chunks: jnp.ndarray,
@@ -1349,6 +1401,7 @@ def batch_posterior_pallas(
     want_path: bool = False,
     onehot: bool = False,
     prepared=None,
+    fused: bool = True,
 ):
     """Posterior island confidence for a [N, T] batch of INDEPENDENT records.
 
@@ -1373,12 +1426,13 @@ def batch_posterior_pallas(
         if not want_path:
             _, _, conf2, _ = fb_onehot.run_fb_kernels_onehot(
                 params, prep.sel2, jnp.int32(0), lens2, a0_raw, beta0, Tt, T,
-                conf_mask=island_mask, pair_esym=(prep.pair2, prep.esym2),
+                conf_mask=island_mask,
+                pair_esym=(prep.pair2, prep.esym2, prep.pairn2), fused=fused,
             )
             return conf2.T[:N, :T], jnp.zeros((N, T), jnp.int32)
         al2, _, b2, esym2 = fb_onehot.run_fb_kernels_onehot(
             params, prep.sel2, jnp.int32(0), lens2, a0_raw, beta0, Tt, T,
-            pair_esym=(prep.pair2, prep.esym2),
+            pair_esym=(prep.pair2, prep.esym2, prep.pairn2), fused=fused,
         )
         gt = fb_onehot._groups(params)
         alphas = fb_onehot.scatter_streams(al2, gt, esym2, K)

@@ -76,9 +76,11 @@ class PreparedChunked:
     """Symbol-only prep for the chunked lane layout (one record per lane).
 
     steps2 [Tp, NL] clamped symbols; lens2 [1, NL]; sel2 [Tp, NL] PAD-marked
-    selection symbols, pair2/esym2 the reduced pair stream — the last three
-    only for the one-hot engines (None on dense preps).  ``Tt``/``S`` are
-    meta (jit-cache-keyed) so a stale prep can never retrace silently.
+    selection symbols, pair2/esym2/pairn2 the reduced pair stream (pairn2 =
+    the time-shifted next-step pairs the backward/fused kernels consume) —
+    the last four only for the one-hot engines (None on dense preps).
+    ``Tt``/``S`` are meta (jit-cache-keyed) so a stale prep can never
+    retrace silently.
     """
 
     steps2: jnp.ndarray
@@ -86,6 +88,7 @@ class PreparedChunked:
     sel2: Optional[jnp.ndarray]
     pair2: Optional[jnp.ndarray]
     esym2: Optional[jnp.ndarray]
+    pairn2: Optional[jnp.ndarray]
     S: int
     Tt: int
     onehot: bool
@@ -98,7 +101,7 @@ class PreparedChunked:
 
 jax.tree_util.register_dataclass(
     PreparedChunked,
-    data_fields=["steps2", "lens2", "sel2", "pair2", "esym2"],
+    data_fields=["steps2", "lens2", "sel2", "pair2", "esym2", "pairn2"],
     meta_fields=["S", "Tt", "onehot", "N", "T"],
 )
 
@@ -108,7 +111,9 @@ class PreparedSeq:
     """Symbol-only prep for the whole-sequence lane layout (one span,
     single device).  obs_l/sel_l [NL, lane_T]; lane_lens [NL]; o0 [] the
     first (clamped) symbol; prev_dev [] the symbol entering the span's
-    reduced chain and pair2/e_in/e_out its pair stream (one-hot only)."""
+    reduced chain and pair2/e_in/e_out/pairn2 its pair stream (pairn2 =
+    time-shifted next-step pairs for the backward/fused kernels; one-hot
+    only)."""
 
     obs_l: jnp.ndarray
     sel_l: jnp.ndarray
@@ -118,6 +123,7 @@ class PreparedSeq:
     pair2: Optional[jnp.ndarray]
     e_in: Optional[jnp.ndarray]
     e_out: Optional[jnp.ndarray]
+    pairn2: Optional[jnp.ndarray]
     S: int
     lane_T: int
     Tt: int
@@ -136,10 +142,21 @@ jax.tree_util.register_dataclass(
     PreparedSeq,
     data_fields=[
         "obs_l", "sel_l", "lane_lens", "o0", "prev_dev",
-        "pair2", "e_in", "e_out",
+        "pair2", "e_in", "e_out", "pairn2",
     ],
     meta_fields=["S", "lane_T", "Tt", "first", "onehot", "T", "prev_key"],
 )
+
+
+def _pair_next(pair2, S: int):
+    """Time-shifted next-step pair stream (the backward/fused kernels'
+    input) — the SAME derivation fb_onehot.run_fb_kernels_onehot performs
+    inline, hoisted here so the fused EM while-body does not re-shift the
+    4 B/symbol stream every iteration."""
+    NL = pair2.shape[1]
+    return jnp.concatenate(
+        [pair2[1:], jnp.full((1, NL), S * S, jnp.int32)], axis=0
+    )
 
 
 def chunked_Tt(T: int, t_tile: int) -> int:
@@ -174,7 +191,7 @@ def prepare_chunked(
         fb_pallas._pad_axis(obs_c.T, Tp, 0, 0), NL, 1, 0
     )  # [Tp, NL]
     lens2 = fb_pallas._pad_axis(lengths[None, :], NL, 1, 0)  # [1, NL]
-    sel2 = pair2 = esym2 = None
+    sel2 = pair2 = esym2 = pairn2 = None
     if onehot:
         from cpgisland_tpu.ops import fb_onehot
         from cpgisland_tpu.ops.viterbi_onehot import pair_stream
@@ -185,9 +202,10 @@ def prepare_chunked(
         sel2 = jnp.where(jnp.arange(Tp)[:, None] < lens2, steps2, S)
         pair2, _, _ = pair_stream(S, sel2, jnp.int32(0))
         esym2 = fb_onehot.decode_esym(pair2, S)
+        pairn2 = _pair_next(pair2, S)
     return PreparedChunked(
         steps2=steps2, lens2=lens2, sel2=sel2, pair2=pair2, esym2=esym2,
-        S=S, Tt=Tt, onehot=onehot, N=int(N), T=int(T),
+        pairn2=pairn2, S=S, Tt=Tt, onehot=onehot, N=int(N), T=int(T),
     )
 
 
@@ -215,7 +233,7 @@ def prepare_seq(
         obs, length, S, lane_T, t_tile, bool(first)
     )
     o0 = obs_flat[0]
-    prev_dev = pair2 = e_in = e_out = None
+    prev_dev = pair2 = e_in = e_out = pairn2 = None
     if onehot:
         from cpgisland_tpu.ops.viterbi_onehot import pair_stream
 
@@ -225,13 +243,14 @@ def prepare_seq(
             )
         prev_dev = jnp.asarray(o0 if first else prev_sym, jnp.int32)
         pair2, e_in, e_out = pair_stream(S, sel_l.T, prev_dev)
+        pairn2 = _pair_next(pair2, S)
     if prev_key is None and not first and isinstance(prev_sym, (int, np.integer)):
         prev_key = int(prev_sym)
     return PreparedSeq(
         obs_l=obs_l, sel_l=sel_l, lane_lens=lane_lens, o0=o0,
         prev_dev=prev_dev, pair2=pair2, e_in=e_in, e_out=e_out,
-        S=S, lane_T=lane_T, Tt=Tt, first=bool(first), onehot=onehot,
-        T=int(obs.shape[0]), prev_key=prev_key,
+        pairn2=pairn2, S=S, lane_T=lane_T, Tt=Tt, first=bool(first),
+        onehot=onehot, T=int(obs.shape[0]), prev_key=prev_key,
     )
 
 
@@ -404,6 +423,7 @@ def chunked_spec_tree(
         sel2=sp if onehot else None,
         pair2=sp if onehot else None,
         esym2=sp if onehot else None,
+        pairn2=sp if onehot else None,
         S=S, Tt=chunked_Tt(T, t_tile), onehot=onehot,
         N=int(N_local), T=int(T),
     )

@@ -395,6 +395,64 @@ def _oh_backpointers_kernel(
     ebits_ref[:, :] = E
 
 
+def _oh_backpointers_score_kernel(
+    pair_ref, venter_ref, tab_ref, bp_ref, dexit_ref, ebits_ref, dmax_ref,
+    *, nreal, bk
+):
+    """Pass B variant EMITTING the running chain max (score threading).
+
+    Identical delta recursion to :func:`_oh_backpointers_kernel` plus one
+    f32 row per step: dmax[k] = max(d0, d1) AFTER step k, relative to the
+    block's normalized entering vector.  The flat batch decoder reads it
+    back at each record's exit step — true chain max there = dmax +
+    enter_offs[block] — and recovers exact per-record scores as first
+    differences (the reset constants C_r telescope: C_r = sum of earlier
+    records' scores = the chain max just before record r's reset).
+    Score-only opt-in: the extra 4 B/step write is why the path-only
+    decode keeps the 2-bit-only kernel.
+    """
+    lt = pair_ref.shape[1]
+    d0 = venter_ref[0:1, :]
+    d1 = venter_ref[1:2, :]
+    E = jnp.full((1, lt), 0b10, jnp.int32)
+
+    def body(c, carry):
+        d0, d1, E = carry
+        words = []
+        for t8 in range(OUTER_TILE // ROW_TILE):
+            tile = pair_ref[pl.ds(c * OUTER_TILE + t8 * ROW_TILE, ROW_TILE), :]
+            t00, t01, t10, t11 = _select4(tile, tab_ref, nreal)
+            word = jnp.zeros((1, lt), jnp.int32)
+            drows = [None] * ROW_TILE
+            for r in range(ROW_TILE):
+                a0 = d0 + t00[r : r + 1, :]
+                a1 = d1 + t10[r : r + 1, :]
+                b0 = d0 + t01[r : r + 1, :]
+                b1 = d1 + t11[r : r + 1, :]
+                bp0 = (a1 > a0).astype(jnp.int32)
+                bp1 = (b1 > b0).astype(jnp.int32)
+                d0 = jnp.maximum(a0, a1)
+                d1 = jnp.maximum(b0, b1)
+                word = word | ((bp0 | (bp1 << 1)) << (2 * r))
+                E = (jnp.right_shift(E, bp0) & 1) | (
+                    ((jnp.right_shift(E, bp1) & 1)) << 1
+                )
+                drows[r] = jnp.maximum(d0, d1)
+            words.append(word)
+            dmax_ref[pl.ds(c * OUTER_TILE + t8 * ROW_TILE, ROW_TILE), :] = (
+                jnp.concatenate(drows, axis=0)
+            )
+        bp_ref[pl.ds(c * (OUTER_TILE // ROW_TILE), OUTER_TILE // ROW_TILE), :] = (
+            jnp.concatenate(words, axis=0)
+        )
+        return d0, d1, E
+
+    d0, d1, E = jax.lax.fori_loop(0, bk // OUTER_TILE, body, (d0, d1, E))
+    dexit_ref[0:1, :] = d0
+    dexit_ref[1:2, :] = d1
+    ebits_ref[:, :] = E
+
+
 def _oh_backtrace_kernel(bp_ref, pair_ref, idtab_ref, exit_ref, path_ref, *, nP, bk):
     """Pass C: walk 2-bit backpointers from the anchored exit bit, emitting
     full STATE IDS (the pair index decodes the per-position exit group)."""
@@ -539,6 +597,33 @@ def _xla_backpointers(tab: jnp.ndarray, v_red: jnp.ndarray, pair2: jnp.ndarray):
     return jnp.stack([d0, d1], axis=1), E, bp2
 
 
+def _xla_backpointers_scores(tab: jnp.ndarray, v_red: jnp.ndarray, pair2: jnp.ndarray):
+    """Score-threading twin of :func:`_xla_backpointers`: additionally emits
+    dmax2 [bk, nb] = max(d0, d1) after each step (same recursion, same
+    rounding — the extra max hangs off the chain)."""
+    nb = pair2.shape[1]
+    E0 = jnp.full((nb,), 0b10, jnp.int32)
+
+    def step(carry, pk):
+        d0, d1, E = carry
+        T = _sel_rows(tab, pk)
+        a0 = d0 + T[:, 0]
+        a1 = d1 + T[:, 2]
+        b0 = d0 + T[:, 1]
+        b1 = d1 + T[:, 3]
+        bp0 = (a1 > a0).astype(jnp.int32)
+        bp1 = (b1 > b0).astype(jnp.int32)
+        E = (jnp.right_shift(E, bp0) & 1) | ((jnp.right_shift(E, bp1) & 1) << 1)
+        d0n = jnp.maximum(a0, a1)
+        d1n = jnp.maximum(b0, b1)
+        return (d0n, d1n, E), (bp0 | (bp1 << 1), jnp.maximum(d0n, d1n))
+
+    (d0, d1, E), (bp2, dmax2) = jax.lax.scan(
+        step, (v_red[:, 0], v_red[:, 1], E0), pair2
+    )
+    return jnp.stack([d0, d1], axis=1), E, bp2, dmax2
+
+
 def _xla_backtrace(bp2, pair2, idtab, exit_bits):
     """Walk the 2-bit rows from the exit bits, emitting state ids [bk, nb]."""
     glow2 = jnp.take(idtab[:, 0], pair2)
@@ -653,13 +738,13 @@ def pass_products(params: HmmParams, steps2: jnp.ndarray, prev0=None, resets=Non
     return incl, offs, incl[-1]
 
 
-def pass_backpointers(params: HmmParams, v_enter: jnp.ndarray, steps2: jnp.ndarray,
-                      prev0=None, resets=None, pre=None):
-    """Onehot twin of viterbi_parallel._pass_backpointers.
-
-    Returns (delta_blocks [nb, K], F [nb, K], blob); the blob carries the
-    packed 2-bit pointers plus the pair stream for the backtrace's bit->state
-    mapping."""
+def _pass_backpointers_impl(params: HmmParams, v_enter: jnp.ndarray,
+                            steps2: jnp.ndarray, prev0, resets, pre,
+                            want_scores: bool):
+    """The ONE pass-B wrapper (prep unpack, lane/row padding, pallas
+    plumbing, scatter/blob assembly) behind both public variants —
+    ``want_scores`` selects the score-threading kernel and its extra
+    dmax2 [bk, nb] output (block-normalized per-step chain max)."""
     K = params.n_states
     S, gt, tab, idtab, pair2, e_in, e_out, nreal = _prepared(
         params, steps2, prev0, resets, pre
@@ -668,41 +753,81 @@ def pass_backpointers(params: HmmParams, v_enter: jnp.ndarray, steps2: jnp.ndarr
     v_red = jnp.take_along_axis(v_enter, gt[e_in], axis=1)  # [nb, 2]
     ghigh_end = gt[e_out, 1]  # [nb] — exit-bit anchor conversion
     if _interpret():
-        dexit_red, ebits_nb, bp2 = _xla_backpointers(
-            tab, v_red.astype(jnp.float32), pair2
-        )
+        if want_scores:
+            dexit_red, ebits_nb, bp2, dmax2 = _xla_backpointers_scores(
+                tab, v_red.astype(jnp.float32), pair2
+            )
+        else:
+            dexit_red, ebits_nb, bp2 = _xla_backpointers(
+                tab, v_red.astype(jnp.float32), pair2
+            )
+            dmax2 = None
         delta_exit = _scatter_vec(dexit_red, gt, e_out, K)
         F = _scatter_ftab(ebits_nb, gt, e_in, e_out, K)
         blob = ("xla", bp2, pair2, idtab, ghigh_end, bk_real, nb)
-        return delta_exit, F, blob
+        return delta_exit, F, blob, dmax2
     nb_pad = -(-nb // LANE_TILE) * LANE_TILE
     pair2 = _pad_lanes(pair2, nb_pad, jnp.int32(nreal))
     pair2, bk = _pad_pair_rows(pair2, _pad_lanes(e_out, nb_pad, 0), nreal)
     v_red2 = _pad_lanes(v_red.T.astype(jnp.float32), nb_pad, 0.0)
     tabb = _bcast_tab(tab[:nreal])
-    bp_packed, dexit_red, ebits = pl.pallas_call(
-        functools.partial(_oh_backpointers_kernel, nreal=nreal, bk=bk),
+    kernel = (
+        _oh_backpointers_score_kernel if want_scores else _oh_backpointers_kernel
+    )
+    out_specs = [
+        _vspec((bk // ROW_TILE, LANE_TILE), lambda i: (0, i)),
+        _vspec((GROUP, LANE_TILE), lambda i: (0, i)),
+        _vspec((1, LANE_TILE), lambda i: (0, i)),
+    ]
+    out_shape = [
+        jax.ShapeDtypeStruct((bk // ROW_TILE, nb_pad), jnp.int32),
+        jax.ShapeDtypeStruct((GROUP, nb_pad), jnp.float32),
+        jax.ShapeDtypeStruct((1, nb_pad), jnp.int32),
+    ]
+    if want_scores:
+        out_specs.append(_vspec((bk, LANE_TILE), lambda i: (0, i)))
+        out_shape.append(jax.ShapeDtypeStruct((bk, nb_pad), jnp.float32))
+    outs = pl.pallas_call(
+        functools.partial(kernel, nreal=nreal, bk=bk),
         grid=(nb_pad // LANE_TILE,),
         in_specs=[
             _vspec((bk, LANE_TILE), lambda i: (0, i)),
             _vspec((GROUP, LANE_TILE), lambda i: (0, i)),
             _vspec(tabb.shape, lambda i: (0, 0)),
         ],
-        out_specs=[
-            _vspec((bk // ROW_TILE, LANE_TILE), lambda i: (0, i)),
-            _vspec((GROUP, LANE_TILE), lambda i: (0, i)),
-            _vspec((1, LANE_TILE), lambda i: (0, i)),
-        ],
-        out_shape=[
-            jax.ShapeDtypeStruct((bk // ROW_TILE, nb_pad), jnp.int32),
-            jax.ShapeDtypeStruct((GROUP, nb_pad), jnp.float32),
-            jax.ShapeDtypeStruct((1, nb_pad), jnp.int32),
-        ],
+        out_specs=out_specs,
+        out_shape=out_shape,
     )(pair2, v_red2, tabb)
+    bp_packed, dexit_red, ebits = outs[:3]
     delta_exit = _scatter_vec(dexit_red.T[:nb], gt, e_out, K)
     F = _scatter_ftab(ebits[0, :nb], gt, e_in, e_out, K)
     blob = ("pallas", bp_packed, pair2, idtab, ghigh_end, bk_real, nb)
+    dmax2 = outs[3][:bk_real, :nb] if want_scores else None
+    return delta_exit, F, blob, dmax2
+
+
+def pass_backpointers(params: HmmParams, v_enter: jnp.ndarray, steps2: jnp.ndarray,
+                      prev0=None, resets=None, pre=None):
+    """Onehot twin of viterbi_parallel._pass_backpointers.
+
+    Returns (delta_blocks [nb, K], F [nb, K], blob); the blob carries the
+    packed 2-bit pointers plus the pair stream for the backtrace's bit->state
+    mapping."""
+    delta_exit, F, blob, _ = _pass_backpointers_impl(
+        params, v_enter, steps2, prev0, resets, pre, want_scores=False
+    )
     return delta_exit, F, blob
+
+
+def pass_backpointers_scores(params: HmmParams, v_enter: jnp.ndarray,
+                             steps2: jnp.ndarray, prev0=None, resets=None,
+                             pre=None):
+    """:func:`pass_backpointers` variant that also returns the per-step
+    chain max dmax2 [bk, nb] (block-normalized — add the block's
+    enter-offset for true values).  The flat batch decoder's score path."""
+    return _pass_backpointers_impl(
+        params, v_enter, steps2, prev0, resets, pre, want_scores=True
+    )
 
 
 def pass_backtrace(blob, exits: jnp.ndarray) -> jnp.ndarray:
@@ -778,6 +903,7 @@ def decode_batch_flat(
     params: HmmParams, chunks: jnp.ndarray, lengths: jnp.ndarray,
     block_size: int = 4096,
     prepared=None,
+    return_score: bool = False,
 ):
     """Decode an [N, T] batch as ONE flat stream with RESET steps.
 
@@ -792,19 +918,40 @@ def decode_batch_flat(
     the backpointer at the reset is the previous record's true exit
     argmax.  Every kernel then runs at single-stream occupancy.
 
-    Path-only (scores accumulate cross-record reset constants — callers
-    needing per-record scores use the vmap path).  Paths equal the
-    standalone/vmap onehot route modulo the engine's pinned rounding-tie
-    contract (PARITY.md C10): the reset folds the previous record's max(v)
-    constant into later f32 additions, so a tie-prone model can round
-    near-ties differently — tie-free models decode identically, and any
-    mismatch re-scores f64-identically.  Same first-symbol contract as the
-    engine: records whose position 0 is PAD decode approximately (host
-    entry points demote those to a dense engine).
+    ``return_score=True`` additionally returns EXACT per-record Viterbi
+    scores [N] from the flat stream itself (r6 — previously the vmap
+    route's job, with its bk>=8192 scoped-VMEM compile failure): the
+    reset constants TELESCOPE.  The true chain value inside record r is
+    the record's own delta plus C_r = sum of earlier records' scores (a
+    reset sets v = max(v_prev) + v0red, and max(v_prev) at record r-1's
+    exit is score_{r-1} + C_{r-1}), so with M_r = the true chain max at
+    record r's last position (its per-step block max from the
+    score-threading backpointers kernel + that block's entering-offset
+    from the normalized prefix scan), score_0 = M_0 and score_r =
+    M_r - M_{r-1}.  f32 precision caveat — WORSE than vmap for late
+    records: M_r carries the accumulated magnitude of ALL earlier records
+    (~1.4 nats/symbol of concatenated stream), so record r's score
+    quantizes at ulp(1.4 * sum of earlier lengths) — e.g. ~+-8 absolute
+    64 MiB into a stream — where the vmap route's offsets accumulate only
+    within the record (~ulp(1.4 * T_r)).  Exact in real arithmetic either
+    way; batches needing per-record-magnitude score precision deep into a
+    large batch should use the vmap opt-in (viterbi_parallel_batch's
+    vmap_records=True) or per-record decodes.  The parity tests bound the
+    flat error at the stream-ulp class.
+
+    Paths equal the standalone/vmap onehot route modulo the engine's
+    pinned rounding-tie contract (PARITY.md C10): the reset folds the
+    previous record's max(v) constant into later f32 additions, so a
+    tie-prone model can round near-ties differently — tie-free models
+    decode identically, and any mismatch re-scores f64-identically.  Same
+    first-symbol contract as the engine: records whose position 0 is PAD
+    decode approximately (host entry points demote those to a dense
+    engine).
     Returns paths [N, T] (positions >= lengths[r] carry the exit state,
-    like viterbi_padded).  ``prepared`` (from :func:`prepare_decode_flat`):
-    the symbol-only stream/reset/pair prep — build it once per batch when
-    decoding the same placed batch repeatedly.
+    like viterbi_padded), or (paths, scores [N]) with ``return_score``.
+    ``prepared`` (from :func:`prepare_decode_flat`): the symbol-only
+    stream/reset/pair prep — build it once per batch when decoding the
+    same placed batch repeatedly.
     """
     from cpgisland_tpu.ops.viterbi_parallel import _block_passes, _step_tables
 
@@ -833,8 +980,18 @@ def decode_batch_flat(
 
     dec = _block_passes(
         params, v0, padded, bk, engine="onehot", prev0=concat[0],
-        resets=resets, pre=pre,
+        resets=resets, pre=pre, want_scores=return_score,
     )
     s0 = dec.ftable[jnp.argmax(dec.delta_exit)]
     full = jnp.concatenate([s0[None], dec.path[:n_steps]])
-    return full.reshape(N, T)
+    if not return_score:
+        return full.reshape(N, T)
+
+    # Record r's last position = global step (r+1)*T - 2's output; its true
+    # chain max M_r = the block-relative running max + the block's entering
+    # offset.  Scores are first differences (the reset constants telescope).
+    e = (jnp.arange(N, dtype=jnp.int32) + 1) * T - 2
+    b = e // bk
+    M = dec.dmax2[e - b * bk, b] + dec.enter_offs[b]
+    scores = jnp.concatenate([M[:1], M[1:] - M[:-1]])
+    return full.reshape(N, T), scores

@@ -150,6 +150,10 @@ class BlockDecode(NamedTuple):
     total: jnp.ndarray  # [K, K] NORMALIZED max-plus product of ALL step matrices
     ftable: jnp.ndarray  # [K] int32 — maps segment exit state -> entry state
     score_offset: jnp.ndarray  # [] add to delta_exit for true (global) scores
+    # want_scores=True only (onehot engine): per-block entering offsets and the
+    # block-normalized per-step chain max — the flat batch decoder's score feed.
+    enter_offs: jnp.ndarray | None = None  # [nb]
+    dmax2: jnp.ndarray | None = None  # [bk, nb]
 
 
 def _pass_products(params: HmmParams, steps2: jnp.ndarray, prev0=None):
@@ -286,6 +290,7 @@ def _block_passes(
     prev0: jnp.ndarray | None = None,
     resets: jnp.ndarray | None = None,
     pre=None,
+    want_scores: bool = False,
 ) -> BlockDecode:
     """Run the three block passes over ``steps`` (transition symbols), with
     ``v_enter0`` the score vector entering the first step.
@@ -297,6 +302,9 @@ def _block_passes(
     ``resets`` ([bk, nb] bool; onehot engine only): marks steps that RESTART
     the chain at a new record's initial scores — the flat batch decoder
     (viterbi_onehot.decode_batch_flat).
+    ``want_scores`` (onehot engine only): run the score-threading
+    backpointers variant and populate ``enter_offs``/``dmax2`` so callers
+    can read true chain maxes at arbitrary steps (the flat score route).
     """
     _pass_products, _pass_backpointers, _pass_backtrace = get_passes(engine)
     nb = steps.shape[0] // block_size
@@ -316,7 +324,19 @@ def _block_passes(
         extra["pre"] = pre
     incl, offs, total = _pass_products(params, steps2, prev0, **extra)
     v_enter, enter_offs = _enter_vectors(v_enter0, incl, offs)
-    delta_blocks, F, bps = _pass_backpointers(params, v_enter, steps2, prev0, **extra)
+    dmax2 = None
+    if want_scores:
+        if engine != "onehot":
+            raise ValueError("want_scores needs the onehot engine")
+        from cpgisland_tpu.ops import viterbi_onehot
+
+        delta_blocks, F, bps, dmax2 = viterbi_onehot.pass_backpointers_scores(
+            params, v_enter, steps2, prev0, **extra
+        )
+    else:
+        delta_blocks, F, bps = _pass_backpointers(
+            params, v_enter, steps2, prev0, **extra
+        )
     delta_exit = delta_blocks[-1]
 
     s_exit = jnp.argmax(delta_exit).astype(jnp.int32) if anchor is None else anchor
@@ -330,6 +350,7 @@ def _block_passes(
     return BlockDecode(
         path=path, delta_exit=delta_exit, total=total, ftable=Gsuf[0],
         score_offset=enter_offs[-1],
+        enter_offs=enter_offs if want_scores else None, dmax2=dmax2,
     )
 
 
@@ -378,7 +399,10 @@ def viterbi_parallel(
     return path, jnp.max(dec.delta_exit) + dec.score_offset
 
 
-@partial(jax.jit, static_argnames=("block_size", "return_score", "engine"))
+@partial(
+    jax.jit,
+    static_argnames=("block_size", "return_score", "engine", "vmap_records"),
+)
 def viterbi_parallel_batch(
     params: HmmParams,
     chunks: jnp.ndarray,
@@ -386,6 +410,7 @@ def viterbi_parallel_batch(
     block_size: int = DEFAULT_BLOCK,
     return_score: bool = True,
     engine: str = "xla",
+    vmap_records: bool = False,
 ):
     """Batched decode of a [N, T] batch of padded chunks.
 
@@ -393,22 +418,31 @@ def viterbi_parallel_batch(
     force-masked to the PAD sentinel, so arbitrary tail content (zero-filled
     buffers etc.) cannot leak into the global argmax.
 
-    Path-only onehot batches run FLAT (viterbi_onehot.decode_batch_flat):
-    records concatenate into one stream with rank-one RESET steps at record
+    Onehot batches run FLAT (viterbi_onehot.decode_batch_flat): records
+    concatenate into one stream with rank-one RESET steps at record
     boundaries, so every kernel runs at single-stream occupancy —
     vmap-of-pallas loads batch-wide VMEM slabs and measured 1004 vs 1635
     Msym/s at the same total (r5; block sizes >= 8192 fail to compile under
-    vmap).  Score-returning calls and the dense engines keep the vmap path;
-    its per-record VMEM slabs bound practical record size to ~4 MiB on a
-    16 GB chip (a 4 x 16 MiB score-returning batch fails scoped-VMEM
-    compile) — batches of larger records should decode per record through
-    viterbi_parallel / viterbi_sharded_spans, which have no such bound.
+    vmap).  Since r6 ``return_score=True`` stays on the flat route too:
+    per-record scores come EXACTLY off the flat stream (the reset
+    constants telescope — decode_batch_flat's score path), so the vmap
+    lowering and its ~4 MiB-per-record scoped-VMEM bound are reachable
+    only by the explicit ``vmap_records=True`` opt-in — kept for parity
+    testing, as the dense engines' only batch lowering, and for score
+    consumers needing per-RECORD-magnitude f32 precision deep into a
+    large batch (the flat route's scores quantize at the accumulated
+    STREAM magnitude; see decode_batch_flat's precision caveat).
+    Batches of larger records should decode per record through
+    viterbi_parallel / viterbi_sharded_spans, which have no VMEM bound.
     """
     T = chunks.shape[1]
-    if engine == "onehot" and not return_score and T >= 2:
+    if engine == "onehot" and not vmap_records and T >= 2:
         from cpgisland_tpu.ops.viterbi_onehot import decode_batch_flat
 
-        return decode_batch_flat(params, chunks, lengths, block_size=block_size)
+        return decode_batch_flat(
+            params, chunks, lengths, block_size=block_size,
+            return_score=return_score,
+        )
     chunks = jnp.where(
         jnp.arange(T)[None, :] >= lengths[:, None],
         params.n_symbols,

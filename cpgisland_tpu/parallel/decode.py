@@ -483,9 +483,55 @@ def viterbi_sharded_spans(
         enters.append((v - v.max()).astype(np.float32))
 
     # Sweep B (reverse): decode each span anchored at the following span's
-    # entry state; prev_exit threads the anchor to the earlier span.
+    # entry state; prev_exit threads the anchor to the earlier span.  Only
+    # the ANCHOR (one scalar) is serially required between spans — the big
+    # per-span PATH drain is deferred one span (r6 backtrace/drain
+    # overlap): while span s's three passes execute, the PREVIOUS span's
+    # already-computed path starts its device->host copy asynchronously
+    # (copy_to_host_async between the dispatch and the anchor block), so
+    # the 4 B/symbol download hides behind device compute instead of
+    # serializing between span programs.  PR 5 deferred-fetch discipline:
+    # a poisoned buffer recomputes from the still-placed span symbols.
+    # Peak host-visible state grows by one span's int32 path; results are
+    # bit-identical to the serial order.
     paths: list = [None] * n_spans
     anchor = -1  # last span: local argmax
+    pending = None  # (span index, device path, recompute args)
+
+    def _start_host_copy(path_dev) -> None:
+        if return_device:
+            return  # caller keeps device arrays; nothing to drain
+        try:
+            path_dev.copy_to_host_async()
+        except (AttributeError, RuntimeError):
+            pass  # purely a latency hint; the blocking fetch still works
+
+    def _drain(pend):
+        ps, path_dev, re_args = pend
+        state = {"dev": path_dev}
+
+        def unit():
+            if state["dev"] is None:  # retry after a poisoned fetch
+                state["dev"], _ = _sharded_fn(mesh, block_size, eng, ps > 0)(
+                    params, *re_args
+                )
+            try:
+                return _fetch_path(
+                    state["dev"], min(span, T - ps * span), return_device
+                )
+            except Exception:
+                state["dev"] = None
+                raise
+
+        # items=0: with the async copy already issued this unit's blocking
+        # wall is ~transfer-remainder (possibly ~0 s) — a rate gate here
+        # would flag healthy runs (the r8 sentinel lesson on non-blocking
+        # units); the span_unit's rate gate covers the program itself.
+        paths[ps] = sup.run(
+            unit, what="decode.span_path", engine=f"decode.{eng}", items=0.0
+        )
+        placed.pop(ps, None)
+
     for s in reversed(range(n_spans)):
         arr = placed.get(s)
         if arr is None:  # the tail span — sweep A never placed it
@@ -493,18 +539,32 @@ def viterbi_sharded_spans(
             placed[s] = arr
         fn = _sharded_fn(mesh, block_size, eng, s > 0)
 
-        def span_unit(s=s, arr=arr, fn=fn, anchor=anchor):
+        def span_unit(s=s, arr=arr, fn=fn, anchor=anchor, pend=pending):
             path, prev_exit = fn(
                 params, arr, jnp.asarray(enters[s]), jnp.int32(anchor),
                 span_prev0(s)
             )
+            if pend is not None:
+                # This span's program is dispatched; overlap the previous
+                # span's path download with its execution.
+                _start_host_copy(pend[1])
             # graftcheck: allow(hot-path-host-sync) -- anchor threading between spans is inherently serial (one scalar per span); counted by the obs ledger's device_get hook
             a = int(jax.device_get(prev_exit))
-            return a, _fetch_path(path, min(span, T - s * span), return_device)
+            return a, path
 
-        anchor, paths[s] = sup.run(
+        prev_anchor = anchor
+        # The unit blocks on the program (the anchor fetch), so the rate
+        # gate stays armed; the deferred path drain is the next unit's job.
+        anchor, path_dev = sup.run(
             span_unit, what="decode.span", engine=f"decode.{eng}",
             items=float(min(span, T - s * span)),
         )
-        placed.pop(s, None)
+        if pending is not None:
+            _drain(pending)
+        pending = (
+            s, path_dev,
+            (arr, jnp.asarray(enters[s]), jnp.int32(prev_anchor),
+             span_prev0(s)),
+        )
+    _drain(pending)
     return paths

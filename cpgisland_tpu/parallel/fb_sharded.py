@@ -624,12 +624,15 @@ def sharded_stats2d_rows_fn(mesh: Mesh, engine: str, t_tile: int = 512,
 
 @functools.lru_cache(maxsize=32)
 def sharded_stats_pallas_fn(mesh: Mesh, lane_T: int, t_tile: int,
-                            onehot: bool = False):
+                            onehot: bool = False, fused: bool = True):
     """Fused-kernel twin of :func:`sharded_stats_fn` (same placed-array
     contract): per-device lane products + boundary-message exchange run the
     chunked Pallas forward/backward kernels on each shard — exact
     whole-sequence statistics at kernel speed across the mesh.  ``onehot``
-    routes the reduced kernels for one-hot-emission models."""
+    routes the reduced kernels for one-hot-emission models; ``fused``
+    co-schedules their fwd/bwd chains (False = the split r9 A/B arm —
+    SeqBackend threads its ``fuse_fb`` here so the chip A/B works on
+    multi-device meshes too)."""
     from cpgisland_tpu.ops import fb_pallas
 
     axis = mesh.axis_names[0]
@@ -637,7 +640,7 @@ def sharded_stats_pallas_fn(mesh: Mesh, lane_T: int, t_tile: int,
     def body(params, obs_shard, len_shard):
         return fb_pallas._seq_stats_core(
             params, obs_shard, len_shard[0], lane_T, t_tile, axis=axis,
-            onehot=onehot,
+            onehot=onehot, fused=fused,
         )
 
     return jax.jit(

@@ -109,11 +109,14 @@ def _posterior_fn(
     want_path: bool,
     lane_T: int,
     t_tile: int,
+    fused: bool = True,
 ):
     """Compiled sharded posterior: fn(params, obs, lens, mask, enter, exit)
     -> (conf P(axis), path P(axis)).  enter/exit are always arrays — the
     uniform direction IS the free-end anchor, and enter is ignored when
-    ``first`` — so one cache entry serves every span of a record."""
+    ``first`` — so one cache entry serves every span of a record.
+    ``fused``: the r9 co-scheduled fwd/bwd pass (False = the split 3-pass
+    A/B arm, kernel-engine paths only)."""
     axis = mesh.axis_names[0]
 
     def body(params, obs_shard, len_shard, island_mask, enter_dir, exit_dir,
@@ -123,7 +126,7 @@ def _posterior_fn(
                 params, obs_shard, len_shard[0], island_mask, lane_T, t_tile,
                 axis=axis, enter_dir=enter_dir, exit_dir=exit_dir,
                 first=first, want_path=want_path,
-                onehot=engine == "onehot", prev_sym=prev_sym,
+                onehot=engine == "onehot", prev_sym=prev_sym, fused=fused,
             )
         return _one_seq_local_posterior(
             params, obs_shard, len_shard[0], island_mask,
@@ -328,9 +331,13 @@ def posterior_sharded(
     placed=None,
     prev_sym: Optional[int] = None,
     prepared=None,
+    fused: bool = True,
 ):
     """Island confidence (and optional MPM path) for one sequence, sharded
     along time over the mesh.
+
+    ``fused`` (kernel engines): the r9 co-scheduled fwd/bwd pass; False
+    keeps the split 3-pass structure (the pass-fusion A/B arm).
 
     ``prepared`` (from :func:`prepare_record_span`; single-device fused
     engines only): the span's symbol-only prep — the pass then runs the
@@ -396,10 +403,12 @@ def posterior_sharded(
             first=first, want_path=want_path,
             lane_T=prepared.lane_T, t_tile=tt, onehot=eng == "onehot",
             prev_sym=_prev_sym_arg(eng, first, prev_sym),
-            prepared=prepared,
+            prepared=prepared, fused=fused,
         )
     else:
-        fn = _posterior_fn(mesh, block_size, eng, first, want_path, lt, tt)
+        fn = _posterior_fn(
+            mesh, block_size, eng, first, want_path, lt, tt, fused
+        )
         conf, path = fn(
             params, arr, lens, mask, enter, exit_,
             _prev_sym_arg(eng, first, prev_sym),

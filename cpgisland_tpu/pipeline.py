@@ -449,9 +449,10 @@ def decode_file(
     if _eng == "pallas":
         batch_decode = viterbi_pallas_batch
     elif _eng == "onehot":
-        # Path-only calls run the FLAT reset-step batch decoder (one kernel
-        # grid for all records, viterbi_onehot.decode_batch_flat); score-
-        # returning calls keep vmap.  Zero-length lanes fall outside the
+        # Batches run the FLAT reset-step decoder (one kernel grid for all
+        # records, viterbi_onehot.decode_batch_flat) — paths AND, since
+        # r9, exact per-record scores (the vmap route is the explicit
+        # vmap_records=True opt-in).  Zero-length lanes fall outside the
         # engine's exactness domain (no real first emission — their reset
         # confines them to carried states) but their paths are sliced to
         # nothing by every consumer.

@@ -116,17 +116,19 @@ def resolve_fb_engine(engine: str, params: HmmParams, mode: str) -> str:
 
 
 @functools.lru_cache(maxsize=None)
-def _local_stats_fn(engine: str, mode: str):
+def _local_stats_fn(engine: str, mode: str, fuse_fb: bool = True):
     """(params, chunks, lengths) -> batch-summed SuffStats, engine-lowered.
 
     lru_cached so the SAME callable comes back for the same routing — the
     fused EM driver (train.baum_welch._fused_em_fn) keys its compiled
-    K-iteration program on this object's identity.
+    K-iteration program on this object's identity.  ``fuse_fb``: the r9
+    co-scheduled fwd/bwd pass (onehot only; False = the split 3-kernel
+    A/B arm, tools/bench_passfusion.py).
     """
     if engine == "pallas":
         return fb_pallas.batch_stats_pallas
     if engine == "onehot":
-        return partial(fb_pallas.batch_stats_pallas, onehot=True)
+        return partial(fb_pallas.batch_stats_pallas, onehot=True, fused=fuse_fb)
     return partial(batch_stats, mode=mode)
 
 
@@ -204,11 +206,17 @@ class EStepBackend:
 
 
 class LocalBackend(EStepBackend):
-    """Single-device vmap mapper + sum reducer."""
+    """Single-device vmap mapper + sum reducer.
 
-    def __init__(self, mode: str = "rescaled", engine: str = "auto"):
+    ``fuse_fb=False`` keeps the split (r4) fwd/bwd kernel structure on the
+    onehot routing — the pass-fusion A/B arm; everything else is the r9
+    co-scheduled default."""
+
+    def __init__(self, mode: str = "rescaled", engine: str = "auto",
+                 fuse_fb: bool = True):
         self.mode = mode
         self.engine = engine
+        self.fuse_fb = bool(fuse_fb)
 
     def prepare_streams(self, params, chunks, lengths):
         if isinstance(chunks, jax.core.Tracer):
@@ -227,7 +235,10 @@ class LocalBackend(EStepBackend):
         )
 
     def __call__(self, params, chunks, lengths):
-        fn = _local_stats_fn(resolve_fb_engine(self.engine, params, self.mode), self.mode)
+        fn = _local_stats_fn(
+            resolve_fb_engine(self.engine, params, self.mode), self.mode,
+            self.fuse_fb,
+        )
         chunks, lengths = jnp.asarray(chunks), jnp.asarray(lengths)
         prep = self.prepare_streams(params, chunks, lengths)
         if prep is not None:
@@ -236,7 +247,8 @@ class LocalBackend(EStepBackend):
 
     def fused_stats_fn(self, params, chunks, lengths):
         return _local_stats_fn(
-            resolve_fb_engine(self.engine, params, self.mode), self.mode
+            resolve_fb_engine(self.engine, params, self.mode), self.mode,
+            self.fuse_fb,
         )
 
     def fused_stats_with_prep(self, params, chunks, lengths):
@@ -561,13 +573,15 @@ def _seq_onehot(engine: str, params: HmmParams) -> bool:
 
 
 @functools.lru_cache(maxsize=32)
-def _seq_single_stats_fn(lane_T: int, t_tile: int, onehot: bool):
+def _seq_single_stats_fn(lane_T: int, t_tile: int, onehot: bool,
+                         fuse_fb: bool = True):
     """Stable single-device whole-sequence stats fn (fused-EM cacheable)."""
 
     def fn(params, obs_flat, lengths, prepared=None):
         return fb_pallas.seq_stats_pallas(
             params, obs_flat, jnp.sum(lengths),
             lane_T=lane_T, t_tile=t_tile, onehot=onehot, prepared=prepared,
+            fused=fuse_fb,
         )
 
     return fn
@@ -595,8 +609,10 @@ class SeqBackend(EStepBackend):
         engine: str = "auto",
         lane_T: Optional[int] = None,
         t_tile: Optional[int] = None,
+        fuse_fb: bool = True,
     ):
         _check_seq_engine(engine)
+        self.fuse_fb = bool(fuse_fb)
         self.mesh = mesh if mesh is not None else make_mesh(axis=axis)
         self.block_size = block_size if block_size is not None else fb_sharded.DEFAULT_BLOCK
         self.axis = self.mesh.axis_names[0]
@@ -671,9 +687,9 @@ class SeqBackend(EStepBackend):
                 requested=self.engine, n_dev=n_dev,
             )
             if n_dev == 1:
-                return _seq_single_stats_fn(lane_T, self.t_tile, oh)
+                return _seq_single_stats_fn(lane_T, self.t_tile, oh, self.fuse_fb)
             return fb_sharded.sharded_stats_pallas_fn(
-                self.mesh, lane_T, self.t_tile, oh
+                self.mesh, lane_T, self.t_tile, oh, self.fuse_fb
             )
         obs.engine_decision(
             site="seq_backend", choice="xla", requested=self.engine, n_dev=n_dev

@@ -1,0 +1,39 @@
+"""Planted regression: a REGROWN third sequential pass.
+
+The r9 pass-count collapse fused the reduced paths' forward and backward
+chains into ONE co-scheduled scan (posterior/em-seq dropped 3 -> 2
+T-scaling passes, chunked EM 2 -> 1).  This twin models the regression
+that fusion exists to prevent: the same work as ``cost_clean`` (one
+max-plus chain + epilogue) plus a SECOND independent T-trip scan over the
+same steps — a de-fused backward re-materializing as its own pass.  Must
+be caught by (a) the lockfile diff (scan eqn count + serial depth, scan
+named) and (b) the pass-structure pin (passes 1 -> 2 vs the clean
+baseline).
+"""
+
+from cost_clean import BASE_SYMBOLS, _chain, _epilogue, _steps  # noqa: F401
+
+
+def make(scale: int = 1):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    obs = jnp.asarray(np.arange(BASE_SYMBOLS * scale, dtype=np.int32) % 4)
+
+    def fn(o):
+        steps = _steps(o)
+        carry, ys = _chain(steps)
+
+        # The regrown pass: an INDEPENDENT second chain over the same
+        # steps (reversed — the de-fused backward), its own scan eqn.
+        def bwd(c, step):
+            new = jnp.max(step + c[None, :], axis=1)
+            return new, new[1]
+
+        carry2, ys2 = jax.lax.scan(
+            bwd, jnp.zeros(2, jnp.float32), steps, reverse=True
+        )
+        return carry.sum() + ys.sum() + carry2.sum() + ys2.sum() + _epilogue()
+
+    return fn, (obs,)

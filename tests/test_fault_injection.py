@@ -218,8 +218,11 @@ def _patch_posterior_engine(monkeypatch, poke) -> None:
 
     real_fn = posterior_mod._posterior_fn
 
-    def patched(mesh, block_size, engine, first, want_path, lane_T, t_tile):
-        fn = real_fn(mesh, block_size, engine, first, want_path, lane_T, t_tile)
+    def patched(mesh, block_size, engine, first, want_path, lane_T, t_tile,
+                fused=True):
+        fn = real_fn(
+            mesh, block_size, engine, first, want_path, lane_T, t_tile, fused
+        )
 
         def wrapped(params, arr, lens, mask, enter, exit_, prev):
             conf, path = fn(params, arr, lens, mask, enter, exit_, prev)

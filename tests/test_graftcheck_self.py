@@ -152,12 +152,17 @@ def test_cost_contracts_all_present(cost_report):
 def test_pass_structure_matches_documented(cost_report):
     byname = {r["name"]: r for r in cost_report["contracts"]}
     notes = byname["cost.pass-structure"]["notes"]
-    # BASELINE.md's documented pass structure: 3-pass decode/posterior,
-    # 2-pass chunked EM.
+    # BASELINE.md's documented pass structure after the r9 pass-count
+    # collapse: decode keeps 3 (its passes are data-dependent), the
+    # reduced probability-space paths run the co-scheduled fwd/bwd pass —
+    # posterior/em-seq 2, chunked EM 1; the dense chunked twin keeps its
+    # split 2 (cs-scaled stats need the split backward).
     assert notes["decode.onehot"] == 3
-    assert notes["posterior.onehot"] == 3
-    assert notes["em.seq.onehot"] == 3
-    assert notes["em.chunked.onehot"] == 2
+    assert notes["decode.batch_flat.scores.onehot"] == 3
+    assert notes["posterior.onehot"] == 2
+    assert notes["em.seq.onehot"] == 2
+    assert notes["em.chunked.onehot"] == 1
+    assert notes["em.chunked.xla"] == 2
 
 
 # -- Layer 3: planted-regression fixtures ------------------------------------
@@ -242,6 +247,25 @@ def test_dense_pair_detector_sees_inside_scan_bodies():
     bad = entry.dense_pair_eqns(n_states=8)
     assert bad, "per-step dense pair op inside the scan body was missed"
     assert all(b.path.startswith("scan/") for b in bad)
+
+
+def test_planted_regrown_pass_caught(clean_lock):
+    """The r9 anti-regression: a de-fused backward re-appearing as its own
+    T-scaling pass must fail CI with the pass named — both through the
+    lockfile diff (scan count) and the pass-count pin."""
+    entry, diff = _diff_fixture("cost_regrown_pass", clean_lock)
+    assert not diff.ok
+    # The diff NAMES the regrown pass: the T-scaling pass count violation,
+    # with the drifting primitives attached.
+    assert any(
+        "pass count 1 -> 2" in v and "drifting prims" in v
+        for v in diff.violations
+    ), diff.violations
+    # And the pass counter itself sees 2 T-scaling passes where the clean
+    # (fused) baseline has 1 — the quantity EXPECTED_PASSES pins.
+    clean_entry = costmodel.trace_entry(_fixture_entry("cost_clean"))
+    assert clean_entry.passes() == 1
+    assert entry.passes() == 2
 
 
 def test_planted_double_scan_caught(clean_lock):

@@ -1,0 +1,351 @@
+"""The r9 pass-count collapse: co-scheduled fwd/bwd vs the 3-pass twins.
+
+The fused pass (fb_onehot._oh_fwdbwd_kernel / its one-scan XLA twin) runs
+both probability-space chains in ONE launch with a SELF-NORMALIZED
+backward; every consumer is scale-free in the betas, so results must match
+the split (r4) pass structure at f32-rounding tolerance — posterior conf,
+whole-sequence stats, chunked stats (z-normalized vs cs-scaled schemes),
+MPM paths, span-threaded continuations.  Also covered: the flat batched
+decode's EXACT per-record scores (the r9 satellite that retires the vmap
+route for return_score=True) and a bounded flat-batch geometry fuzz.
+
+Off-TPU these run the XLA twins; the TPU suite run (CPGISLAND_TEST_PLATFORM
+=axon) exercises the Pallas kernels against the same assertions.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cpgisland_tpu.models import presets
+from cpgisland_tpu.models.hmm import sample_sequence
+from cpgisland_tpu.ops import fb_pallas
+from cpgisland_tpu.ops import viterbi_onehot as OH
+from cpgisland_tpu.ops.viterbi_parallel import viterbi_parallel, viterbi_parallel_batch
+
+MASK8 = jnp.asarray(np.r_[np.ones(4), np.zeros(4)].astype(np.float32))
+
+
+def _onehot_model(rng, S=4):
+    """Tie-free random one-hot-emission model (the test_viterbi_onehot
+    construction): iid logit perturbation makes argmax ties probability-0,
+    so flat-vs-vmap path equality is exact."""
+    from cpgisland_tpu.models.hmm import HmmParams
+
+    K = 2 * S
+    perm = rng.permutation(K)
+    sym_of_state = np.empty(K, dtype=np.int64)
+    for s in range(S):
+        sym_of_state[perm[2 * s]] = s
+        sym_of_state[perm[2 * s + 1]] = s
+    pi = rng.dirichlet(np.ones(K))
+    A = rng.dirichlet(np.ones(K), size=K)
+    B = np.zeros((K, S))
+    B[np.arange(K), sym_of_state] = 1.0
+    A = A * np.exp(rng.normal(scale=1e-3, size=A.shape))
+    A = A / A.sum(axis=1, keepdims=True)
+    return HmmParams.from_probs(pi, A, B)
+
+
+def _obs(rng, n):
+    params = presets.durbin_cpg8()
+    _, obs = sample_sequence(
+        params, jax.random.PRNGKey(int(rng.integers(1 << 30))), n
+    )
+    return params, obs
+
+
+def _f64_path_score(params, obs, path):
+    """Achieved score of a state path in f64 — the engine tie contract's
+    arbiter (PARITY.md C10): routes may argmax-tie differently at f32
+    rounding; both choices must then be true argmaxes."""
+    lp = np.asarray(params.log_pi, np.float64)
+    lA = np.asarray(params.log_A, np.float64)
+    lB = np.asarray(params.log_B, np.float64)
+    S = lB.shape[1]
+    s = lp[path[0]] + (lB[path[0], obs[0]] if obs[0] < S else 0.0)
+    for t in range(1, len(obs)):
+        if obs[t] >= S:
+            continue
+        s += lA[path[t - 1], path[t]] + lB[path[t], obs[t]]
+    return s
+
+
+def _assert_paths_equivalent(params, masked_obs, got, want, ctx):
+    """Exact path equality, or — at an f32 rounding tie — identical f64
+    achieved scores (the pinned flat-stream tie contract: the reset folds
+    the previous record's constant into later additions)."""
+    if np.array_equal(got, want):
+        return
+    sa = _f64_path_score(params, masked_obs, got)
+    sb = _f64_path_score(params, masked_obs, want)
+    assert sa == pytest.approx(sb, rel=1e-12), (ctx, sa, sb)
+
+
+# --- posterior: fused vs split vs dense -------------------------------------
+
+
+def test_posterior_conf_fused_vs_split(rng):
+    params, obs = _obs(rng, 30000)
+    kw = dict(lane_T=4096, t_tile=512, onehot=True)
+    c_split, _ = fb_pallas.seq_posterior_pallas(
+        params, obs, obs.shape[0], MASK8, fused=False, **kw
+    )
+    c_fused, _ = fb_pallas.seq_posterior_pallas(
+        params, obs, obs.shape[0], MASK8, fused=True, **kw
+    )
+    c_dense, _ = fb_pallas.seq_posterior_pallas(
+        params, obs, obs.shape[0], MASK8, lane_T=4096, t_tile=512
+    )
+    np.testing.assert_allclose(np.asarray(c_fused), np.asarray(c_split), atol=2e-5)
+    np.testing.assert_allclose(np.asarray(c_fused), np.asarray(c_dense), atol=2e-5)
+
+
+def test_posterior_want_path_fused(rng):
+    params, obs = _obs(rng, 20000)
+    kw = dict(lane_T=4096, t_tile=512, onehot=True, want_path=True)
+    c_s, p_s = fb_pallas.seq_posterior_pallas(
+        params, obs, obs.shape[0], MASK8, fused=False, **kw
+    )
+    c_f, p_f = fb_pallas.seq_posterior_pallas(
+        params, obs, obs.shape[0], MASK8, fused=True, **kw
+    )
+    np.testing.assert_allclose(np.asarray(c_f), np.asarray(c_s), atol=2e-5)
+    assert np.array_equal(np.asarray(p_f), np.asarray(p_s))
+
+
+def test_posterior_continuation_span_fused(rng):
+    """Span-threaded continuation (enter/exit dirs + prev_sym) through the
+    fused pass matches the split pass — the pipeline.posterior_file span
+    contract is normalization-scheme-independent."""
+    params, obs = _obs(rng, 24000)
+    span = 12000
+    piece = obs[span:]
+    enter = np.abs(np.random.default_rng(1).normal(size=8)).astype(np.float32)
+    enter /= enter.sum()
+    kw = dict(
+        enter_dir=jnp.asarray(enter), exit_dir=None, first=False,
+        lane_T=4096, t_tile=512, onehot=True,
+        prev_sym=jnp.int32(int(obs[span - 1])),
+    )
+    c_s, _ = fb_pallas.seq_posterior_pallas(
+        params, piece, piece.shape[0], MASK8, fused=False, **kw
+    )
+    c_f, _ = fb_pallas.seq_posterior_pallas(
+        params, piece, piece.shape[0], MASK8, fused=True, **kw
+    )
+    np.testing.assert_allclose(np.asarray(c_f), np.asarray(c_s), atol=2e-5)
+
+
+# --- EM: fused vs split, both layouts ---------------------------------------
+
+
+def _assert_stats_close(a, b, rtol=5e-5, atol=1e-3):
+    np.testing.assert_allclose(np.asarray(a.init), np.asarray(b.init), atol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(a.trans), np.asarray(b.trans), rtol=rtol, atol=atol
+    )
+    np.testing.assert_allclose(
+        np.asarray(a.emit), np.asarray(b.emit), rtol=rtol, atol=atol
+    )
+    assert float(a.loglik) == pytest.approx(float(b.loglik), rel=1e-5)
+    assert int(a.n_seqs) == int(b.n_seqs)
+
+
+def test_seq_stats_fused_vs_split(rng):
+    params, obs = _obs(rng, 40000)
+    s_split = fb_pallas.seq_stats_pallas(
+        params, obs, obs.shape[0], lane_T=4096, onehot=True, fused=False
+    )
+    s_fused = fb_pallas.seq_stats_pallas(
+        params, obs, obs.shape[0], lane_T=4096, onehot=True, fused=True
+    )
+    s_dense = fb_pallas.seq_stats_pallas(params, obs, obs.shape[0], lane_T=4096)
+    _assert_stats_close(s_fused, s_split)
+    _assert_stats_close(s_fused, s_dense)
+
+
+def test_chunked_stats_fused_vs_split(rng):
+    """Chunked E-step: the fused single-drain pass + z-normalized stats vs
+    the split fwd/bwd + cs-scaled stats kernel vs the dense engine — all
+    one scheme's f32 rounding apart (ragged lengths, empty records)."""
+    params = presets.durbin_cpg8()
+    N, T = 5, 3000
+    chunks = np.zeros((N, T), np.uint8)
+    lengths = np.asarray([3000, 2500, 1, 0, 3000], np.int32)
+    for i in range(N):
+        if lengths[i]:
+            _, o = sample_sequence(params, jax.random.PRNGKey(i), int(lengths[i]))
+            chunks[i, : lengths[i]] = np.asarray(o)
+    args = (params, jnp.asarray(chunks), jnp.asarray(lengths))
+    s_split = fb_pallas.batch_stats_pallas(*args, t_tile=512, onehot=True, fused=False)
+    s_fused = fb_pallas.batch_stats_pallas(*args, t_tile=512, onehot=True, fused=True)
+    s_dense = fb_pallas.batch_stats_pallas(*args, t_tile=512)
+    _assert_stats_close(s_fused, s_split)
+    _assert_stats_close(s_fused, s_dense)
+
+
+def test_batch_posterior_fused(rng):
+    params = presets.durbin_cpg8()
+    N, T = 4, 2000
+    chunks = np.zeros((N, T), np.uint8)
+    lengths = np.asarray([2000, 1500, 1, 2000], np.int32)
+    for i in range(N):
+        _, o = sample_sequence(params, jax.random.PRNGKey(10 + i), int(lengths[i]))
+        chunks[i, : lengths[i]] = np.asarray(o)
+    for want_path in (False, True):
+        c_s, p_s = fb_pallas.batch_posterior_pallas(
+            params, jnp.asarray(chunks), jnp.asarray(lengths), MASK8,
+            want_path=want_path, onehot=True, fused=False,
+        )
+        c_f, p_f = fb_pallas.batch_posterior_pallas(
+            params, jnp.asarray(chunks), jnp.asarray(lengths), MASK8,
+            want_path=want_path, onehot=True, fused=True,
+        )
+        for i in range(N):
+            L = int(lengths[i])
+            np.testing.assert_allclose(
+                np.asarray(c_s)[i, :L], np.asarray(c_f)[i, :L], atol=2e-5
+            )
+            if want_path:
+                assert np.array_equal(
+                    np.asarray(p_s)[i, :L], np.asarray(p_f)[i, :L]
+                )
+
+
+def test_fused_em_fit_parity(rng):
+    """End-to-end: a fused-loop Baum-Welch fit through the co-scheduled
+    chunked pass reproduces the split pass's trajectory (the training-path
+    acceptance for the pass collapse)."""
+    from cpgisland_tpu.train import baum_welch
+    from cpgisland_tpu.train.backends import LocalBackend
+    from cpgisland_tpu.utils import chunking
+
+    params, obs = _obs(rng, 16 * 1024)
+    chunked = chunking.frame(np.asarray(obs).astype(np.uint8), 1024)
+    res = {}
+    for fuse_fb in (False, True):
+        backend = LocalBackend(engine="onehot", fuse_fb=fuse_fb)
+        res[fuse_fb] = baum_welch.fit(
+            params, chunked, num_iters=3, convergence=0.0, backend=backend
+        )
+    np.testing.assert_allclose(
+        np.asarray(res[True].logliks), np.asarray(res[False].logliks),
+        rtol=1e-5,
+    )
+
+
+# --- flat batched decode: exact per-record scores ---------------------------
+
+
+@pytest.mark.parametrize("seed", [0, 1])
+def test_batch_flat_scores_parity(rng, seed):
+    """Flat-stream per-record scores vs the vmap route AND the per-record
+    decoder at ragged geometries.  Tolerance: the engines' normalizer
+    offsets accumulate stream-magnitude f32 sums, so scores carry
+    ulp(|chain|)-scale absolute rounding (shared with the vmap route)."""
+    r = np.random.default_rng(100 + seed)
+    params = _onehot_model(r)
+    N, T = 5, 700
+    chunks = r.integers(0, 4, size=(N, T)).astype(np.int32)
+    chunks[2, 300:320] = 7  # mid-record PAD run
+    lengths = np.asarray([700, 650, 700, 2, 700], np.int32)
+    p_flat, s_flat = viterbi_parallel_batch(
+        params, jnp.asarray(chunks), jnp.asarray(lengths), block_size=128,
+        return_score=True, engine="onehot",
+    )
+    p_vmap, s_vmap = viterbi_parallel_batch(
+        params, jnp.asarray(chunks), jnp.asarray(lengths), block_size=128,
+        return_score=True, engine="onehot", vmap_records=True,
+    )
+    tol = 1e-3 * max(N * T, 1)  # ulp-class bound at chain magnitude
+    for i in range(N):
+        L = int(lengths[i])
+        o = np.where(np.arange(T) >= L, 4, chunks[i])
+        _assert_paths_equivalent(
+            params, o, np.asarray(p_flat)[i, :L], np.asarray(p_vmap)[i, :L],
+            ("flat-vs-vmap", seed, i),
+        )
+        _, s_ref = viterbi_parallel(
+            params, jnp.asarray(o), block_size=128, return_score=True,
+            engine="onehot",
+        )
+        assert abs(float(s_flat[i]) - float(s_ref)) <= tol, (
+            i, float(s_flat[i]), float(s_ref)
+        )
+    np.testing.assert_allclose(
+        np.asarray(s_flat), np.asarray(s_vmap), atol=tol
+    )
+
+
+def test_batch_flat_score_arm_paths_identical(rng):
+    """The score arm must not perturb the decoded paths (same passes, the
+    dmax emission hangs off the recursion)."""
+    params = _onehot_model(np.random.default_rng(7))
+    N, T = 4, 520
+    chunks = np.random.default_rng(8).integers(0, 4, size=(N, T)).astype(np.int32)
+    lengths = np.asarray([520, 300, 2, 520], np.int32)
+    p_only = OH.decode_batch_flat(
+        params, jnp.asarray(chunks), jnp.asarray(lengths), block_size=128
+    )
+    p_sc, _ = OH.decode_batch_flat(
+        params, jnp.asarray(chunks), jnp.asarray(lengths), block_size=128,
+        return_score=True,
+    )
+    assert np.array_equal(np.asarray(p_only), np.asarray(p_sc))
+
+
+def test_batch_flat_geometry_fuzz(rng):
+    """Bounded flat-batch geometry fuzz (sizes small enough for the TPU
+    suite run — r5's edge coverage must not stay CPU-only): random N/T/
+    block_size/ragged lengths, paths vs the per-record decoder and scores
+    vs the per-record chain, per seed."""
+    for seed in range(4):
+        r = np.random.default_rng(1000 + seed)
+        params = _onehot_model(r)
+        N = int(r.integers(2, 6))
+        T = int(r.integers(2, 400))
+        bk = int(r.choice([8, 64, 128, 256]))
+        chunks = r.integers(0, 4, size=(N, T)).astype(np.int32)
+        lengths = r.integers(1, T + 1, size=N).astype(np.int32)
+        p_flat, s_flat = OH.decode_batch_flat(
+            params, jnp.asarray(chunks), jnp.asarray(lengths), block_size=bk,
+            return_score=True,
+        )
+        tol = 1e-3 * max(N * T, 64)
+        for i in range(N):
+            L = int(lengths[i])
+            o = np.where(np.arange(T) >= L, 4, chunks[i])
+            ref_p, ref_s = viterbi_parallel(
+                params, jnp.asarray(o), block_size=bk, return_score=True,
+                engine="onehot",
+            )
+            _assert_paths_equivalent(
+                params, o, np.asarray(p_flat)[i, :L], np.asarray(ref_p)[:L],
+                (seed, i, N, T, bk),
+            )
+            assert abs(float(s_flat[i]) - float(ref_s)) <= tol, (
+                seed, i, N, T, bk, float(s_flat[i]), float(ref_s)
+            )
+
+
+# --- span decode with the deferred path drain -------------------------------
+
+
+def test_span_decode_deferred_drain_identical(rng):
+    """viterbi_sharded_spans' r9 deferred path drain (next span dispatched
+    before the previous span's path downloads) is bit-identical to the
+    one-shot decode."""
+    from cpgisland_tpu.parallel import decode as pdec
+
+    params = _onehot_model(np.random.default_rng(3))
+    T = 8 * 64 * 4 + 9
+    obs = np.random.default_rng(4).integers(0, 4, size=T).astype(np.uint8)
+    one = pdec.viterbi_sharded(params, obs, block_size=64, engine="onehot")
+    spans = pdec.viterbi_sharded_spans(
+        params, obs, span=8 * 64 * 2, block_size=64, engine="onehot"
+    )
+    assert np.array_equal(
+        np.asarray(one), np.concatenate([np.asarray(p) for p in spans])
+    )

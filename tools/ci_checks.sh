@@ -56,4 +56,12 @@ python -m pytest tests/test_fault_injection.py tests/test_elastic.py \
 echo "== prepared-streams smoke (parity + cache + zero-reprep ledger) =="
 python -m pytest tests/test_prepared.py -q
 
+echo "== pass-fusion smoke (co-scheduled fwd/bwd parity + A/B harness) =="
+# The r9 fused pass vs its split 3-pass twins (tests), then the A/B
+# harness's parity gates + one CPU timing rep per arm (--smoke; the
+# committed chip figures come from running it WITHOUT --smoke on the
+# capturing TPU).
+python -m pytest tests/test_passfusion.py -q
+python tools/bench_passfusion.py --platform cpu --smoke > /dev/null
+
 echo "ci_checks: all gates green"
