@@ -1172,6 +1172,113 @@ def _stats_maxrel(st_d, st_o, what: str) -> float:
     return worst
 
 
+def bench_serve(engine: str = "auto", n_decode: int = 16,
+                n_posterior: int = 8) -> dict:
+    """Sustained serving-broker throughput + queue->result latency.
+
+    Drives the serve subsystem the way the daemon does — a Session + an
+    in-process RequestBroker with a saturated mixed queue (decode +
+    posterior, two tenants) — and measures sustained flush throughput and
+    per-request queue->result latency (p50/p99).  The chained-timing rules
+    apply in adapted form: each FLUSH is one blocking dispatch unit (that
+    round trip IS the product's serving latency, so it belongs in the
+    number), every request carries distinct rng content (no two
+    submissions byte-identical — phantom defense), and the throughput is
+    gated by the plausibility ceiling.  A warmup pass compiles every
+    geometry first; the measured pass therefore also certifies the
+    flush program is dispatch-stable (the graftcheck serve contract pins
+    the zero-fresh-compile property itself).
+    """
+    import jax
+
+    from cpgisland_tpu.models import presets
+    from cpgisland_tpu.serve.broker import BrokerConfig, RequestBroker
+    from cpgisland_tpu.serve.session import Session
+
+    on_tpu = jax.default_backend() == "tpu"
+    params = presets.durbin_cpg8()
+    rec = (2 << 20) if on_tpu else (1 << 16)
+    flush = (8 << 20) if on_tpu else (1 << 18)
+    sess = Session(
+        params, engine=engine, name="bench-serve", private_breaker=True
+    )
+    broker = RequestBroker(
+        sess, BrokerConfig(flush_symbols=flush, flush_deadline_s=0.0)
+    )
+    rng = np.random.default_rng(11)
+
+    def make_requests(base: int):
+        out = []
+        for i in range(n_decode + n_posterior):
+            kind = "decode" if i < n_decode else "posterior"
+            n = rec if kind == "decode" else max(rec // 4, 1 << 14)
+            out.append(
+                (base + i, kind, rng.integers(0, 4, size=n).astype(np.uint8))
+            )
+        return out
+
+    def run(base: int):
+        reqs = make_requests(base)
+        t_submit = {}
+        t0 = time.perf_counter()
+        for rid, kind, syms in reqs:
+            broker.submit(
+                request_id=rid, tenant=f"t{rid % 2}", kind=kind,
+                symbols=syms, name=f"r{rid}",
+            )
+            t_submit[rid] = time.perf_counter()
+        lats = []
+        while broker.pending():
+            for r in broker.flush_once():
+                if not r.ok:
+                    raise RuntimeError(
+                        f"serve bench request {r.id} failed: {r.error}"
+                    )
+                lats.append(time.perf_counter() - t_submit[r.id])
+        wall = time.perf_counter() - t0
+        return float(sum(s.size for _, _, s in reqs)), wall, sorted(lats)
+
+    run(0)  # warmup: one compile per geometry
+    warm_flushes = broker.flushes
+    total, wall, lats = run(1000)
+    tput = _check_plausible(total / wall, "serve")
+    # No 'serve' marker exists in BASELINE.md until the first chip capture,
+    # so the per-path net above degrades to the global 20 Gsym/s ceiling —
+    # too wide to catch a phantom relay serving ~0 ms flushes.  Provisional
+    # tighter gate: the broker's flat flush path cannot outrun pure batched
+    # decode (it IS batched decode plus queueing, posterior records, and
+    # island calling), so the batched-decode ceiling bounds serve too.
+    serve_ceiling = _path_ceilings().get("batched-decode", float("inf"))
+    if tput > serve_ceiling:
+        raise RuntimeError(
+            f"serve: {tput/1e6:.1f} Msym/s exceeds the provisional ceiling "
+            f"({serve_ceiling/1e6:.0f} Msym/s = the batched-decode per-path "
+            "ceiling; a mixed serve queue cannot outrun pure batched "
+            "decode) — phantom relay result; re-run this phase in a fresh "
+            "process"
+        )
+
+    def pct(p: float) -> float:
+        return lats[min(len(lats) - 1, int(p * len(lats)))]
+
+    out = {
+        "serve_msym_per_s": round(tput / 1e6, 1),
+        "serve_p50_ms": round(pct(0.50) * 1e3, 2),
+        "serve_p99_ms": round(pct(0.99) * 1e3, 2),
+        "serve_requests": len(lats),
+        "serve_flushes": broker.flushes - warm_flushes,
+    }
+    log(
+        f"serve: {tput/1e6:.1f} Msym/s sustained over "
+        f"{out['serve_flushes']} flushes; queue->result p50 "
+        f"{out['serve_p50_ms']} ms / p99 {out['serve_p99_ms']} ms "
+        f"({len(lats)} requests); fresh-input user path — upload-bound "
+        f"on the relayed dev setup, compare via serve_vs_batched_decode, "
+        f"not this absolute"
+    )
+    return out
+
+
 def validate_sharded_paths() -> None:
     """Run the sharded E-step configs on whatever devices exist and check the
     linear-scaling assumption structurally: count the collectives in the
@@ -1286,7 +1393,7 @@ def main() -> int:
     ap.add_argument(
         "--phase",
         default=None,
-        choices=("parity", "core", "ext1", "ext2", "ext3"),
+        choices=("parity", "core", "ext1", "ext2", "ext3", "serve"),
         help="internal: run ONE capture phase and print its results as JSON "
         "(the --extended parent orchestrates phases as subprocesses — the "
         "relay tunnel degrades into phantom ~0 ms results after ~15 min of "
@@ -1401,6 +1508,13 @@ def _run_phase(args, on_tpu: bool) -> int:
         }))
         return 0
 
+    if args.phase == "serve":
+        out = bench_serve(engine=args.engine)
+        print(json.dumps(
+            {"serve": out, "armed_ceilings": armed_ceilings_record()}
+        ))
+        return 0
+
     if args.phase == "ext3":
         from cpgisland_tpu.pipeline import POSTERIOR_SPAN
 
@@ -1470,7 +1584,7 @@ def _orchestrate(args) -> int:
     results: dict = {}
     # parity runs FIRST: the capture certifies the reduced kernels' on-chip
     # correctness before publishing any number they produce (VERDICT r4 #1).
-    for phase in ("parity", "core", "ext1", "ext2", "ext3"):
+    for phase in ("parity", "core", "ext1", "ext2", "ext3", "serve"):
         for attempt in range(3):
             # NO subprocess timeout: killing a child mid-TPU-execution
             # wedges the relay's tunnel claim (CLAUDE.md) — a hung phase is
@@ -1573,6 +1687,20 @@ def _orchestrate(args) -> int:
         "costs_checked_on_capture_backend": results["parity"]["parity"][
             "costs"
         ],
+        # Sustained serving-broker throughput + queue->result latency on the
+        # capturing backend (the serve phase's in-process daemon run).
+        **results["serve"]["serve"],
+        # Serve is a fresh-input user path (every request uploads new
+        # symbols), so its absolute wall is upload-bound on this relayed
+        # dev setup and swings with relay bandwidth.  Publish the ratio
+        # against pure batched decode from THIS artifact — same per-byte
+        # upload on both sides, so the ratio isolates broker overhead
+        # (CLAUDE.md rule: ratios against a same-path baseline, never
+        # absolute upload-bound figures).
+        "serve_vs_batched_decode": round(
+            results["serve"]["serve"]["serve_msym_per_s"] * 1e6
+            / carry["batched_tput"], 2
+        ),
         "armed_path_ceilings": (
             next((v for v in armed.values() if isinstance(v, dict)), None)
             or "degraded-to-global"

@@ -39,6 +39,7 @@ HOT_PATHS: dict[str, frozenset[str]] = {
         "_device_calls_retry",
         "_device_calls_deferred",
         "_decode_small_batch",
+        "_posterior_record_unit",
         "posterior_file",
         "decode_file",
     }),
@@ -48,6 +49,18 @@ HOT_PATHS: dict[str, frozenset[str]] = {
     # retries) or carry a waiver.
     "resilience/policy.py": frozenset({"run", "supervise"}),
     "resilience/sentinel.py": frozenset({"verify", "_canary_value"}),
+    # The serving daemon's flush drivers: every request in a flush pays any
+    # stray sync here, multiplied by the flush rate — the single hottest
+    # host loop in a long-lived process.
+    "serve/broker.py": frozenset({
+        "flush_once",
+        "_run_flush",
+        "_decode_record",
+        "_posterior_record",
+        "_host_calls",
+        "_device_calls",
+    }),
+    "serve/worker.py": frozenset({"_run"}),
 }
 
 

@@ -273,6 +273,61 @@ def _em_body_contract() -> ContractResult:
     )
 
 
+def _serve_flush_contract() -> ContractResult:
+    """serve.flush.dispatch-stable: the broker's flush program must be
+    dispatch-stable across requests — after one warmup flush per geometry
+    (pow2-padded record shapes), further flushes of the SAME geometry must
+    trigger ZERO fresh XLA compiles (``obs.no_new_compiles``).  A daemon
+    that recompiles per request would pay the remote-compile HTTP round
+    trip on the serving path, which is exactly what the broker's pow2
+    padding discipline (shared with the batch pipelines) exists to prevent.
+    """
+    import numpy as np
+
+    from cpgisland_tpu import obs as obs_mod
+    from cpgisland_tpu.serve.broker import BrokerConfig, RequestBroker
+    from cpgisland_tpu.serve.session import Session
+
+    violations: list[str] = []
+    notes: dict = {}
+
+    def stream(broker: RequestBroker, seed: int, base: int) -> None:
+        # Mixed decode + posterior, two tenants, fixed length set (the
+        # geometry); content varies per seed so a stale-constant cache hit
+        # cannot masquerade as shape stability.
+        rng = np.random.default_rng(seed)
+        for i, n in enumerate((900, 1500, 2200, 3100)):
+            broker.submit(
+                request_id=base + i,
+                tenant="t0" if i % 2 == 0 else "t1",
+                kind="decode" if i % 2 == 0 else "posterior",
+                symbols=rng.integers(0, 4, size=n).astype(np.uint8),
+                name=f"r{base + i}",
+            )
+        broker.drain()
+
+    try:
+        sess = Session(_flagship(), name="contract", private_breaker=True)
+        broker = RequestBroker(
+            sess, BrokerConfig(flush_symbols=1 << 15, flush_deadline_s=0.0)
+        )
+        stream(broker, seed=0, base=0)  # warmup: compiles per geometry
+        notes["warm_flushes"] = broker.flushes
+        try:
+            with obs_mod.no_new_compiles("serve.flush") as led:
+                stream(broker, seed=1, base=100)
+            notes["steady_compiles"] = led.compiles
+        except obs_mod.RecompileError as e:
+            violations.append(str(e))
+        notes["flushes"] = broker.flushes
+    except Exception as e:  # a broker that cannot serve at all is a failure
+        violations.append(f"broker run failed: {type(e).__name__}: {e}")
+    return ContractResult(
+        name="serve.flush.dispatch-stable", ok=not violations,
+        violations=violations, notes=notes,
+    )
+
+
 def _routing_contract() -> ContractResult:
     """Off-TPU, 'auto' must resolve to non-Pallas engines, and get_passes
     must resolve every engine name (every TPU engine has an off-TPU twin)."""
@@ -504,6 +559,12 @@ def run_contracts(
                     notes={},
                 )
             )
+    # The serve contract EXECUTES flushes (that is the point — compile
+    # stability is a runtime property), so it follows the same
+    # execute-gating as the stability contracts: skipped where dispatches
+    # are expensive (execute=False, e.g. a relayed TPU).
+    if execute and (wanted is None or "serve.flush.dispatch-stable" in wanted):
+        results.append(_serve_flush_contract())
     for c in default_contracts():
         if wanted is not None and c.name not in wanted:
             continue

@@ -29,7 +29,7 @@ from typing import Optional, Sequence
 
 log = logging.getLogger(__name__)
 
-_SUBCOMMANDS = ("train", "decode", "posterior", "run")
+_SUBCOMMANDS = ("train", "decode", "posterior", "run", "serve")
 
 
 def _select_platform(argv: list) -> list:
@@ -223,6 +223,63 @@ def build_parser() -> argparse.ArgumentParser:
     _add_obs_flags(po)
     _add_symbol_cache_flag(po)
     po.add_argument("-v", "--verbose", action="store_true")
+
+    sv = sub.add_parser(
+        "serve",
+        help="persistent serving daemon: JSONL requests over stdin/stdout "
+        "(or --socket), heterogeneous decode/posterior requests coalesced "
+        "into flat-stream flushes against warm executables — see "
+        "cpgisland_tpu/serve/transport.py for the protocol",
+    )
+    sv.add_argument("--model", help="model text file (default: the --preset model)")
+    sv.add_argument(
+        "--preset", choices=("durbin8", "two_state"), default="durbin8",
+        help="model preset when no --model is given (two_state needs "
+        "--island-states 0)",
+    )
+    sv.add_argument(
+        "--engine", choices=("auto", "xla", "pallas", "onehot"),
+        default="auto",
+        help="kernel lowering for the session (auto: reduced one-hot "
+        "kernels on TPU for eligible models)",
+    )
+    sv.add_argument(
+        "--island-engine", choices=("auto", "host", "device"), default="auto",
+        help="island caller placement (auto: device on TPU)",
+    )
+    sv.add_argument("--min-len", type=int, default=None)
+    sv.add_argument(
+        "--flush-symbols", type=_positive_int, default=8 << 20,
+        help="flush budget: a flush closes when this many symbols are "
+        "queued (default 8 Mi)",
+    )
+    sv.add_argument(
+        "--flush-deadline-ms", type=float, default=50.0,
+        help="bounded latency: a flush also closes when the oldest queued "
+        "request has waited this long (default 50 ms)",
+    )
+    sv.add_argument(
+        "--tenant-max-requests", type=_positive_int, default=256,
+        help="per-tenant queued-request cap (admission past it is rejected "
+        "with a backpressure error)",
+    )
+    sv.add_argument(
+        "--tenant-max-symbols", type=_positive_int, default=512 << 20,
+        help="per-tenant queued-symbol cap",
+    )
+    sv.add_argument(
+        "--socket", metavar="PATH",
+        help="serve a local AF_UNIX socket instead of stdin/stdout "
+        "(JSONL, one client connection at a time; the broker stays warm "
+        "across connections)",
+    )
+    _add_island_cap_flag(sv)
+    _add_island_states_flag(sv)
+    _add_invalid_symbols_flag(sv)
+    _add_resilience_flags(sv)
+    _add_obs_flags(sv)
+    sv.add_argument("--trace-dir", help="capture a jax.profiler device trace")
+    sv.add_argument("-v", "--verbose", action="store_true")
 
     r = sub.add_parser("run", help="train then decode (the reference main())")
     r.add_argument("training_file")
@@ -527,6 +584,24 @@ def _run_command(args, compat, pipeline, presets, load_text, observer=None) -> i
             f"mean island confidence {res.mean_island_confidence:.4f}{extra}"
         )
         return 0
+
+    if args.cmd == "serve":
+        from cpgisland_tpu.serve import transport
+
+        if args.resume and not args.manifest:
+            build_parser().error(
+                "serve --resume needs --manifest PATH (there is no output "
+                "file to anchor a default manifest name)"
+            )
+        island_states = _parse_island_states(build_parser(), args, compat=False)
+        # transport._build_broker reads the PARSED tuple off args.
+        args.island_states = island_states
+        params = load_text(args.model) if args.model else _preset_params(presets, args.preset)
+        if island_states is None:
+            err = pipeline.island_layout_error(params, None)
+            if err:
+                build_parser().error(f"--preset {args.preset}: {err}")
+        return transport.serve_main(args, params)
 
     if args.cmd == "run":
         if args.prefetch and compat:

@@ -214,7 +214,7 @@ class Observer:
         return out
 
     def summary(self) -> dict:
-        return {
+        out = {
             "process_index": process_index(),
             "spans": self._span_aggregate(),
             "dropped_spans": self.tracer.dropped,
@@ -223,6 +223,17 @@ class Observer:
             "decisions": self._decision_counts(),
             "watchdog_violations": self.watchdog.violations,
         }
+        # Prepared-stream cache lifecycle (hits/misses/evictions/occupancy):
+        # long-lived serving processes watch resident_bytes/evictions here.
+        # Lazy + guarded: obs must stay importable before jax/platform
+        # selection, and a summary must never fail on telemetry.
+        try:
+            from cpgisland_tpu.ops.prepared import cache_stats
+
+            out["prepared_cache"] = cache_stats()
+        except Exception:
+            pass
+        return out
 
     def report(self) -> str:
         """End-of-run report table (the CLI's ``--obs-report``)."""

@@ -138,6 +138,19 @@ def render_summary(summary: dict) -> str:
             f"fetched {_mb(ledger.get('fetch_bytes', 0))} MB, "
             f"uploaded {_mb(ledger.get('upload_bytes', 0))} MB"
         )
+    pc = summary.get("prepared_cache")
+    if pc:
+        lines.append("")
+        lines.append(
+            "prepared cache: "
+            f"hits={pc.get('hits', 0)}, misses={pc.get('misses', 0)}, "
+            f"entries={pc.get('entries', 0)} "
+            f"({_mb(pc.get('resident_bytes', 0))} MB resident), "
+            f"evictions dead/capacity/explicit="
+            f"{pc.get('evictions_dead', 0)}/"
+            f"{pc.get('evictions_capacity', 0)}/"
+            f"{pc.get('evictions_explicit', 0)}"
+        )
     viol = summary.get("watchdog_violations", [])
     if viol:
         lines.append("")

@@ -68,6 +68,7 @@ __all__ = [
     "chunked_Tt",
     "cache_stats",
     "clear_cache",
+    "evict",
 ]
 
 
@@ -346,27 +347,73 @@ def check_seq(
 # size to the input) on device, so the bound is deliberately small.
 
 _CACHE_MAX = 8
+# key -> (weakrefs of keyed arrays, prep tree, resident bytes of the prep)
 _cache: "OrderedDict[tuple, tuple]" = OrderedDict()
-_stats = {"hits": 0, "misses": 0}
+_stats = {
+    "hits": 0,
+    "misses": 0,
+    # Eviction reasons (long-lived serving processes read these through
+    # cache_stats() in the obs report): dead = a keyed input array was
+    # garbage-collected (or its id recycled), capacity = FIFO bound,
+    # explicit = evict()/PreparedStreams.clear_session().
+    "evictions_dead": 0,
+    "evictions_capacity": 0,
+    "evictions_explicit": 0,
+}
 
 
 def cache_stats() -> dict:
-    """{'hits': n, 'misses': n} since process start (or clear_cache)."""
-    return dict(_stats)
+    """Hit/miss/eviction counters since process start (or clear_cache),
+    plus current occupancy: ``entries`` and ``resident_bytes`` (the summed
+    size of all cached prep trees — comparable to the inputs they were
+    built from, so a serving daemon watches this through the obs report)."""
+    out = dict(_stats)
+    out["entries"] = len(_cache)
+    out["resident_bytes"] = sum(ent[2] for ent in _cache.values())
+    return out
 
 
 def clear_cache() -> None:
     _cache.clear()
-    _stats["hits"] = 0
-    _stats["misses"] = 0
+    for k in _stats:
+        _stats[k] = 0
+
+
+def evict(*arrays) -> int:
+    """Explicitly drop every cache entry keyed on any of ``arrays``.
+
+    The automatic lifecycle (dead-ref sweep on miss + FIFO capacity bound)
+    eventually releases prep trees, but a long-lived daemon dropping a
+    tenant's placed inputs wants the input-sized device allocations gone
+    NOW, not at the next unrelated miss.  Returns the number of entries
+    evicted; emits one ``prepared_evict`` obs event when anything dropped.
+    """
+    # Entries whose keyed inputs already died can't be matched by id (a
+    # dropped tenant's arrays are usually GC'd BEFORE Session.close()
+    # calls here) — sweep them now rather than at the next unrelated
+    # miss, or a quiet daemon would hold their prep trees indefinitely.
+    _sweep_dead()
+    ids = {id(a) for a in arrays}
+    dead = [k for k in _cache if ids.intersection(k[2])]
+    nbytes = 0
+    for k in dead:
+        nbytes += _cache[k][2]
+        del _cache[k]
+    if dead:
+        _stats["evictions_explicit"] += len(dead)
+        obs_mod.event(
+            "prepared_evict", entries=len(dead), bytes_released=nbytes
+        )
+    return len(dead)
 
 
 def _sweep_dead() -> None:
     """Drop entries whose keyed input arrays died: their prep trees (often
     input-sized, device-resident) must not wait for capacity eviction."""
-    dead = [k for k, (refs, _) in _cache.items() if any(r() is None for r in refs)]
+    dead = [k for k, ent in _cache.items() if any(r() is None for r in ent[0])]
     for k in dead:
         del _cache[k]
+    _stats["evictions_dead"] += len(dead)
 
 
 def _cached(kind: str, arrays: tuple, skey: tuple, build):
@@ -379,6 +426,7 @@ def _cached(kind: str, arrays: tuple, skey: tuple, build):
         return ent[1]
     if ent is not None:  # id recycled onto a new array — stale entry
         del _cache[key]
+        _stats["evictions_dead"] += 1
     _sweep_dead()
     t0 = time.perf_counter()
     prep = build()
@@ -392,9 +440,10 @@ def _cached(kind: str, arrays: tuple, skey: tuple, build):
         "prepared_streams", kind=kind, hit=False,
         bytes_resident=nbytes, prep_ms=round(prep_ms, 2), key=repr(skey),
     )
-    _cache[key] = (tuple(weakref.ref(a) for a in arrays), prep)
+    _cache[key] = (tuple(weakref.ref(a) for a in arrays), prep, nbytes)
     while len(_cache) > _CACHE_MAX:
         _cache.popitem(last=False)
+        _stats["evictions_capacity"] += 1
     return prep
 
 
@@ -527,14 +576,45 @@ class PreparedStreams:
     layout builds lazily through the identity-keyed cache, so mixed
     consumers (a chunked posterior and a chunked E-step, or two span
     sweeps over one placed span) share the same device-resident artifact.
+
+    The handle also remembers (by weakref) every input array it keyed a
+    lookup on, so a long-lived owner — a serve Session dropping a tenant —
+    can release all of its prep trees at once via :meth:`clear_session`
+    instead of waiting for the dead-ref sweep or capacity eviction.
     """
 
     def __init__(self, n_symbols: int):
         self.S = int(n_symbols)
+        self._seen: dict[int, weakref.ref] = {}
+
+    def _note(self, arrays) -> None:
+        for a in arrays:
+            try:
+                self._seen[id(a)] = weakref.ref(a)
+            except TypeError:
+                pass  # unweakrefable input (host scalar etc.) — nothing cached
+        # Prune dead refs so a long-lived handle (a serve Session fielding
+        # requests for weeks) stays O(live inputs), not O(inputs ever seen)
+        # — dead entries' cache rows were already swept; only the bookkeeping
+        # would leak.
+        if len(self._seen) > 16:
+            self._seen = {
+                k: r for k, r in self._seen.items() if r() is not None
+            }
+
+    def clear_session(self) -> int:
+        """Explicitly evict every cache entry built through this handle
+        (live inputs only — dead ones already swept).  Returns the number
+        of entries released."""
+        live = [r() for r in self._seen.values()]
+        n = evict(*[a for a in live if a is not None])
+        self._seen.clear()
+        return n
 
     def chunked(
         self, chunks, lengths, *, t_tile: int, onehot: bool = False
     ) -> PreparedChunked:
+        self._note((chunks, lengths))
         return for_chunked(
             self.S, chunks, lengths, t_tile=t_tile, onehot=onehot
         )
@@ -543,6 +623,7 @@ class PreparedStreams:
         self, obs, length: int, *, lane_T: int, t_tile: int,
         first: bool = True, onehot: bool = False, prev_sym=None,
     ) -> PreparedSeq:
+        self._note((obs,))
         return for_seq(
             self.S, obs, length, lane_T=lane_T, t_tile=t_tile, first=first,
             onehot=onehot, prev_sym=prev_sym,

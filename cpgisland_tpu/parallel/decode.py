@@ -57,7 +57,7 @@ def decode_engine_twin(engine: str, params: HmmParams) -> Optional[str]:
     )(engine)
 
 
-def resolve_engine(engine: str, params: HmmParams) -> str:
+def resolve_engine(engine: str, params: HmmParams, *, breaker=None) -> str:
     """'auto' picks the reduced one-hot kernels on TPU when the model's
     emission structure supports them (ops.viterbi_onehot — the flagship
     8-state model does), else the dense Pallas kernels when the model fits
@@ -67,7 +67,9 @@ def resolve_engine(engine: str, params: HmmParams) -> str:
     down the parity-twin ladder for the cooldown window; an EXPLICIT
     engine request is honored as-is — silently swapping a named engine
     would mislabel bench/parity measurements that exist to certify that
-    specific lowering."""
+    specific lowering.  ``breaker``: which EngineBreaker gates the
+    demotion — a serve Session passes its own so one tenant's faults
+    cannot demote the whole process (default: the process-global one)."""
     if engine == "auto":
         resolved = "xla"
         if jax.default_backend() == "tpu":
@@ -78,7 +80,9 @@ def resolve_engine(engine: str, params: HmmParams) -> str:
         obs_mod.engine_decision(
             site="decode.resolve_engine", choice=resolved, requested=engine
         )
-        return resilience.get_breaker().degrade(
+        if breaker is None:
+            breaker = resilience.get_breaker()
+        return breaker.degrade(
             "decode", resolved, lambda e: decode_engine_twin(e, params)
         )
     if engine not in ("xla", "pallas", "onehot"):
@@ -323,7 +327,12 @@ def viterbi_sharded(
     sup = supervisor if supervisor is not None else resilience.default_supervisor()
     obs = np.asarray(obs)
     T = obs.shape[0]
-    eng = _engine_for_record(resolve_engine(engine, params), obs, params)
+    # Engine demotion is gated by the SUPERVISOR's breaker: a serve Session
+    # hands its per-session supervisor down here, so its faults demote this
+    # session's routing only (default supervisor = the process-global one).
+    eng = _engine_for_record(
+        resolve_engine(engine, params, breaker=sup.breaker), obs, params
+    )
     prev0 = jnp.int32(int(obs[0]) if T and int(obs[0]) < params.n_symbols else 0)
     arr = _place_span(mesh, obs, block_size, params.n_symbols)
     # Positional args throughout: lru_cache keys positional vs keyword calls
@@ -403,7 +412,11 @@ def viterbi_sharded_spans(
         mesh = make_mesh(axis=SEQ_AXIS)
     sup = supervisor if supervisor is not None else resilience.default_supervisor()
     obs = np.asarray(obs)
-    eng = _engine_for_record(resolve_engine(engine, params), obs, params)
+    # Breaker-gated demotion scoped to the supervisor's breaker (a serve
+    # Session's faults demote that session only — see viterbi_sharded).
+    eng = _engine_for_record(
+        resolve_engine(engine, params, breaker=sup.breaker), obs, params
+    )
     T = obs.shape[0]
     if T <= span:
         return [

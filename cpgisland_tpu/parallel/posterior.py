@@ -58,14 +58,16 @@ def fb_engine_twin(engine: str, params: HmmParams) -> Optional[str]:
     )(engine)
 
 
-def resolve_fb_engine(engine: str, params: HmmParams) -> str:
+def resolve_fb_engine(engine: str, params: HmmParams, *, breaker=None) -> str:
     """'auto' picks the reduced one-hot FB kernels on TPU when the model's
     emission structure supports them (ops.fb_onehot — the flagship 8-state
     preset does), else the dense fused kernels when the model fits their
     lane packing, else the XLA lane path (incl. the CPU test mesh).  Under
     'auto', engines tripped by the resilience breaker demote down the
     parity-twin ladder for the cooldown window; explicit requests are
-    honored as-is (see parallel.decode.resolve_engine)."""
+    honored as-is (see parallel.decode.resolve_engine).  ``breaker``: the
+    EngineBreaker gating the demotion (a serve Session passes its own;
+    default the process-global one)."""
     from cpgisland_tpu import resilience
     from cpgisland_tpu.ops import fb_onehot
 
@@ -76,7 +78,9 @@ def resolve_fb_engine(engine: str, params: HmmParams) -> str:
         obs_module.engine_decision(
             site="posterior.resolve_fb_engine", choice=resolved, requested=engine
         )
-        return resilience.get_breaker().degrade(
+        if breaker is None:
+            breaker = resilience.get_breaker()
+        return breaker.degrade(
             "fb", resolved, lambda e: fb_engine_twin(e, params)
         )
     if engine not in ("xla", "pallas", "onehot"):
@@ -245,6 +249,7 @@ def prepare_record_span(
     t_tile: Optional[int] = None,
     mesh: Optional[Mesh] = None,
     streams=None,
+    breaker=None,
 ):
     """One span's PreparedSeq (ops.prepared), shared by BOTH span sweeps.
 
@@ -269,7 +274,7 @@ def prepare_record_span(
         mesh = make_mesh(axis=SEQ_AXIS)
     if mesh.shape[mesh.axis_names[0]] != 1:
         return None
-    eng = resolve_fb_engine(engine, params)
+    eng = resolve_fb_engine(engine, params, breaker=breaker)
     if eng not in ("pallas", "onehot"):
         return None
     from cpgisland_tpu.ops import prepared as prep_mod
@@ -332,9 +337,13 @@ def posterior_sharded(
     prev_sym: Optional[int] = None,
     prepared=None,
     fused: bool = True,
+    breaker=None,
 ):
     """Island confidence (and optional MPM path) for one sequence, sharded
     along time over the mesh.
+
+    ``breaker``: the EngineBreaker gating auto-routing's parity-twin
+    demotion (a serve Session passes its own; default process-global).
 
     ``fused`` (kernel engines): the r9 co-scheduled fwd/bwd pass; False
     keeps the split 3-pass structure (the pass-fusion A/B arm).
@@ -355,7 +364,7 @@ def posterior_sharded(
     """
     if mesh is None:
         mesh = make_mesh(axis=SEQ_AXIS)
-    eng = resolve_fb_engine(engine, params)
+    eng = resolve_fb_engine(engine, params, breaker=breaker)
     tt = t_tile if t_tile is not None else fb_pallas.DEFAULT_T_TILE
     T = int(np.asarray(obs).shape[0]) if placed is None else int(obs.shape[0])
     K = params.n_states
@@ -431,6 +440,7 @@ def transfer_total_sharded(
     prev_sym: Optional[int] = None,
     return_device: bool = False,
     prepared=None,
+    breaker=None,
 ):
     """One span's normalized [K, K] probability-space transfer operator
     (sweep A of span-threaded posterior processing).  ``placed`` (from
@@ -444,7 +454,7 @@ def transfer_total_sharded(
     if mesh is None:
         mesh = make_mesh(axis=SEQ_AXIS)
     n_dev = mesh.shape[mesh.axis_names[0]]
-    eng = resolve_fb_engine(engine, params)
+    eng = resolve_fb_engine(engine, params, breaker=breaker)
     out = None
     if n_dev == 1 and eng in ("pallas", "onehot"):
         # Single-chip TPU: the products Pallas kernel is much faster than

@@ -35,10 +35,7 @@ from cpgisland_tpu.models import presets
 from cpgisland_tpu.models.hmm import HmmParams, dump_text
 from cpgisland_tpu.ops import islands as islands_mod
 from cpgisland_tpu.ops.islands import IslandCalls
-from cpgisland_tpu.ops.viterbi_pallas import viterbi_pallas_batch
-from cpgisland_tpu.ops.viterbi_parallel import viterbi_parallel_batch
 from cpgisland_tpu.parallel.decode import (
-    resolve_engine,
     viterbi_sharded,
     viterbi_sharded_spans,
 )
@@ -252,6 +249,34 @@ def _check_invalid_symbols(invalid_symbols: str, compat: bool) -> None:
         )
 
 
+def _session_for_call(
+    session,
+    params: HmmParams,
+    *,
+    name: str,
+    engine: str,
+    island_engine: str,
+    island_cap: Optional[int],
+    integrity_check: bool,
+):
+    """The serving-context policy shared by decode_file and posterior_file:
+    an explicit session (daemon/bench) is validated against the call's
+    routing kwargs and used as-is; otherwise an ephemeral Session is built
+    from them — the exact state the pre-session code assembled inline."""
+    from cpgisland_tpu.serve.session import Session
+
+    if session is None:
+        return Session(
+            params, engine=engine, island_engine=island_engine,
+            island_cap=island_cap, integrity_check=integrity_check, name=name,
+        )
+    session.check_call(
+        params, engine=engine, island_engine=island_engine,
+        island_cap=island_cap, integrity_check=integrity_check,
+    )
+    return session
+
+
 def _open_manifest(
     mode: str,
     test_path: str,
@@ -349,9 +374,20 @@ def decode_file(
     resume: bool = False,
     manifest_path: Optional[str] = None,
     invalid_symbols: str = "skip",
+    session=None,
 ) -> DecodeResult:
     """Viterbi-decode a sequence file and call CpG islands (reference
     ``testModel``).
+
+    ``session`` (serve.session.Session): the long-lived serving context —
+    supervisor, breaker-gated engine resolution, learned island cap,
+    prepared-stream handle.  The daemon and bench pass one so repeated
+    calls share warm state; when omitted an ephemeral session is built
+    from the routing kwargs (identical behavior to the pre-session code).
+    With an explicit session, ``params`` must be the session's own and the
+    routing kwargs (``engine``/``island_engine``/``island_cap``/
+    ``integrity_check``) must stay at their defaults — that config lives
+    on the session.
 
     Resilience (the serving-side fault-tolerance layer, ``resilience/``):
     every blocking decode/island fetch runs under a dispatch supervisor
@@ -417,10 +453,17 @@ def decode_file(
     err = island_layout_error(params, island_states)
     if err:
         raise ValueError(err)
-    sup = resilience.DispatchSupervisor(
-        name="decode",
-        sentinel=resilience.IntegritySentinel() if integrity_check else None,
+    session = _session_for_call(
+        session, params, name="decode", engine=engine,
+        island_engine=island_engine, island_cap=island_cap,
+        integrity_check=integrity_check,
     )
+    # The session owns the engine request: an explicit session's engine
+    # must reach EVERY dispatch below (check_call forced the kwarg to its
+    # 'auto' default), not just the batch lowering — raw string, not the
+    # resolved name, so 'auto' keeps re-resolving against the breaker.
+    engine = session.engine
+    sup = session.supervisor
     manifest = _open_manifest(
         "decode", test_path, params,
         resume=resume, manifest_path=manifest_path, islands_out=islands_out,
@@ -434,31 +477,20 @@ def decode_file(
             "invalid_symbols": invalid_symbols,
         },
     )
-    use_device_islands, cap_box = _resolve_island_engine(
-        island_engine,
+    use_device_islands, cap_box = session.island_policy(
         device_eligible=not compat and state_path_out is None,
         ineligible_msg=(
             "island_engine='device' implements clean-mode calling without a "
             "state-path dump (compat quirk reproduction and path dumps are "
             "host-side)"
         ),
-        island_cap=island_cap,
     )
     timer = timer if timer is not None else profiling.PhaseTimer()
-    _eng = resolve_engine(engine, params)
-    if _eng == "pallas":
-        batch_decode = viterbi_pallas_batch
-    elif _eng == "onehot":
-        # Batches run the FLAT reset-step decoder (one kernel grid for all
-        # records, viterbi_onehot.decode_batch_flat) — paths AND, since
-        # r9, exact per-record scores (the vmap route is the explicit
-        # vmap_records=True opt-in).  Zero-length lanes fall outside the
-        # engine's exactness domain (no real first emission — their reset
-        # confines them to carried states) but their paths are sliced to
-        # nothing by every consumer.
-        batch_decode = functools.partial(viterbi_parallel_batch, engine="onehot")
-    else:
-        batch_decode = viterbi_parallel_batch
+    # Engine + batch lowering resolved through the session (breaker-gated;
+    # the flat reset-step decoder for onehot batches — see
+    # Session.batch_decode_fn, the ONE copy of this choice).
+    _eng = session.decode_engine()
+    batch_decode = session.batch_decode_fn(_eng)
 
     if compat:
         with timer.phase("encode", unit="sym"):
@@ -808,9 +840,12 @@ def _resolve_island_engine(
     device_eligible: bool,
     ineligible_msg: str,
     island_cap: Optional[int],
+    breaker=None,
 ):
     """(use_device_islands, cap_box) — THE island-engine policy, shared by
-    decode_file and posterior_file so the two pipelines cannot diverge.
+    decode_file, posterior_file, and the serve Session so the pipelines
+    cannot diverge.  ``breaker``: the EngineBreaker gating auto-routing's
+    degradation (a serve Session passes its own; default process-global).
 
     Works multi-host: a device path on a multi-host global mesh reduces to
     non-fully-addressable [cap] record columns, which islands_device
@@ -835,7 +870,9 @@ def _resolve_island_engine(
         # breaker's cooldown window.  Auto-routing only — an EXPLICIT
         # 'device' request is honored as-is (parity runs exist to exercise
         # that specific engine; the supervisor still retries its faults).
-        choice = resilience.get_breaker().degrade(
+        choice = (
+            breaker if breaker is not None else resilience.get_breaker()
+        ).degrade(
             "islands", "device", lambda e: "host" if e == "device" else None
         )
         use_device_islands = choice == "device"
@@ -1198,6 +1235,55 @@ class PosteriorResult:
     calls: Optional[IslandCalls] = None
 
 
+def _posterior_record_unit(
+    params: HmmParams,
+    symbols: np.ndarray,
+    island_states,
+    *,
+    engine: str,
+    fb_eng: str,
+    want_path: bool,
+    return_device: bool,
+    sup,
+    supervised: bool = True,
+):
+    """ONE record's posterior dispatch+fetch — the shared core of
+    posterior_file's single-record path AND the serve broker's posterior
+    unit, so the daemon and the batch CLI cannot diverge (same discipline
+    as the decode/posterior shared-helper split).  Pads to a power-of-two
+    bucket (floor 16 Ki) so varied record sizes share compiled shapes.
+    ``supervised=False`` returns the raw unsupervised unit result (the
+    recompute-fallback closures re-derive through it without nesting a
+    second retry loop)."""
+    from cpgisland_tpu.parallel.posterior import posterior_sharded
+
+    def record_unit():
+        conf, path = posterior_sharded(
+            params, symbols, island_states,
+            engine=engine, want_path=want_path,
+            return_device=return_device,
+            # Power-of-two buckets: scaffold-heavy files must not
+            # compile once per distinct record size.
+            pad_to=_round_pow2(symbols.size, floor=1 << 14),
+            breaker=sup.breaker,
+        )
+        if return_device:
+            # Fault-surfacing block (see decode_one): a poisoned
+            # conf/path must fail INSIDE the supervised unit — where
+            # the retry re-dispatches — not downstream in the device
+            # accumulator or island caller.
+            # graftcheck: allow(hot-path-host-sync) -- fault-surfacing + phase-attribution block (comment above); the obs ledger counts it via its block_until_ready hook
+            jax.block_until_ready(path if path is not None else conf)
+        return conf, path
+
+    if not supervised:
+        return record_unit()
+    return sup.run(
+        record_unit, what="posterior.record",
+        engine=f"fb.{fb_eng}", items=float(symbols.size),
+    )
+
+
 def posterior_file(
     test_path: str,
     params: HmmParams,
@@ -1219,8 +1305,13 @@ def posterior_file(
     resume: bool = False,
     manifest_path: Optional[str] = None,
     invalid_symbols: str = "skip",
+    session=None,
 ) -> PosteriorResult:
     """Soft decoding of a FASTA file: per-position island confidence.
+
+    ``session``: the long-lived serving context (same contract as
+    :func:`decode_file` — an explicit session owns the routing config and
+    must match ``params``; omitted = ephemeral, pre-session behavior).
 
     Resilience: same contract as :func:`decode_file` — supervised blocking
     units with bounded retries, engine degradation to parity twins on
@@ -1282,7 +1373,6 @@ def posterior_file(
         place_record_span,
         posterior_sharded,
         prepare_record_span,
-        resolve_fb_engine,
         transfer_total_sharded,
     )
     from cpgisland_tpu.utils.npystream import NpyStreamWriter
@@ -1312,10 +1402,15 @@ def posterior_file(
             "posterior: nothing to do — request confidence_out, "
             "mpm_path_out, and/or islands_out"
         )
-    sup = resilience.DispatchSupervisor(
-        name="posterior",
-        sentinel=resilience.IntegritySentinel() if integrity_check else None,
+    session = _session_for_call(
+        session, params, name="posterior", engine=engine,
+        island_engine=island_engine, island_cap=island_cap,
+        integrity_check=integrity_check,
     )
+    # Session-owned engine request, raw string (see decode_file): an
+    # explicit session's engine reaches every span/record dispatch below.
+    engine = session.engine
+    sup = session.supervisor
     manifest = _open_manifest(
         "posterior", test_path, params,
         resume=resume, manifest_path=manifest_path, islands_out=islands_out,
@@ -1335,8 +1430,7 @@ def posterior_file(
             "posterior resume manifests need islands_out (the island-only "
             "mode is the resumable one)"
         )
-    use_device_islands, cap_box = _resolve_island_engine(
-        island_engine,
+    use_device_islands, cap_box = session.island_policy(
         # The MPM path can stay device-resident only when nothing else
         # needs it on the host (the int8 dump is host-side).
         device_eligible=want_islands and mpm_path_out is None,
@@ -1345,13 +1439,12 @@ def posterior_file(
             "needs islands_out and no mpm_path_out (the path dump is "
             "host-side)"
         ),
-        island_cap=island_cap,
     )
     # Small records batch into one chunked-layout kernel pass (pallas only;
     # the XLA lane path serves one record at a time).  Manifest runs keep
     # the one-record cadence: completion marks and per-record confidence
     # sums then line up with record boundaries.
-    _fb_eng = resolve_fb_engine(engine, params)
+    _fb_eng = session.fb_engine()
     batch_small = _fb_eng in ("pallas", "onehot") and manifest is None
     # Writers open INSIDE the try: a failure opening the second must still
     # close (finalize) the first, not leave a corrupt header slot behind.
@@ -1542,29 +1635,16 @@ def posterior_file(
         None (the cheaper aggregate accumulators)."""
         nonlocal conf_total
 
-        def record_unit():
-            conf, path = posterior_sharded(
-                params, symbols, island_states,
-                engine=engine, want_path=want_path,
-                return_device=use_device_islands,
-                # Power-of-two buckets: scaffold-heavy files must not
-                # compile once per distinct record size.
-                pad_to=_round_pow2(symbols.size, floor=1 << 14),
+        def unit(supervised: bool = True):
+            return _posterior_record_unit(
+                params, symbols, island_states, engine=engine,
+                fb_eng=_fb_eng, want_path=want_path,
+                return_device=use_device_islands, sup=sup,
+                supervised=supervised,
             )
-            if use_device_islands:
-                # Fault-surfacing block (see decode_one): a poisoned
-                # conf/path must fail INSIDE the supervised unit — where
-                # the retry re-dispatches — not downstream in the device
-                # accumulator or island caller.
-                # graftcheck: allow(hot-path-host-sync) -- fault-surfacing + phase-attribution block (comment above); the obs ledger counts it via its block_until_ready hook
-                jax.block_until_ready(path if path is not None else conf)
-            return conf, path
 
         with timer.phase("posterior", items=float(symbols.size), unit="sym"):
-            conf, path = sup.run(
-                record_unit, what="posterior.record",
-                engine=f"fb.{_fb_eng}", items=float(symbols.size),
-            )
+            conf, path = unit()
         rec_conf = None
         if use_device_islands:
             if want_conf:
@@ -1583,7 +1663,7 @@ def posterior_file(
             emit(conf, path)
 
         def recompute_path():
-            c2, p2 = record_unit()
+            c2, p2 = unit(supervised=False)
             return p2
 
         call_rec(rec_name, symbols, path, recompute_path=recompute_path)
@@ -1662,12 +1742,11 @@ def posterior_file(
             # runs — and the tiny [K, K] fetches all happen at the end.
             span_placed: dict = {}
             span_prep: dict = {}
-            # One PreparedStreams handle per record: every span's symbol-only
+            # The SESSION's PreparedStreams handle: every span's symbol-only
             # artifact (lane layout + pair stream) books against it and is
-            # shared by the transfer-total and posterior sweeps below.
-            from cpgisland_tpu.ops.prepared import PreparedStreams
-
-            rec_streams = PreparedStreams(params.n_symbols)
+            # shared by the transfer-total and posterior sweeps below — and,
+            # for a long-lived session, released by Session.close().
+            rec_streams = session.streams
             with timer.phase("span-totals", items=float(symbols.size), unit="sym"):
                 totals = []
                 for si, lo in enumerate(range(0, symbols.size, span)):
@@ -1688,7 +1767,7 @@ def posterior_file(
                     span_prep[si] = prepare_record_span(
                         params, span_placed[si], piece.size, engine=engine,
                         first=lo == 0, prev_sym=prev, want_path=want_path,
-                        streams=rec_streams,
+                        streams=rec_streams, breaker=session.breaker,
                     )
 
                     def total_unit(si=si, piece=piece, lo=lo, prev=prev,
@@ -1699,6 +1778,7 @@ def posterior_file(
                             prev_sym=prev,
                             return_device=device,
                             prepared=span_prep[si],
+                            breaker=session.breaker,
                         )
 
                     if prefetch > 0:
@@ -1765,6 +1845,7 @@ def posterior_file(
                             else _prev_real_symbol(symbols, lo, params.n_symbols)
                         ),
                         prepared=span_prep[s],
+                        breaker=session.breaker,
                     )
                     if use_device_islands:
                         # Fault-surfacing block (see one_record): poisoned

@@ -1,0 +1,44 @@
+"""Persistent serving subsystem: sessions, request broker, worker, transport.
+
+The reference is a one-shot Hadoop batch job; ROADMAP item 1 is the
+"millions of users" direction — a long-lived multi-tenant service that
+never re-pays process startup, jit compile, or stream prep per request.
+This package composes the ingredients earlier PRs built for exactly this:
+
+- :mod:`~cpgisland_tpu.serve.session` — the **session/engine layer**
+  extracted from ``pipeline.py``: a :class:`~cpgisland_tpu.serve.session.
+  Session` owns the model params, resolved engine state, the per-session
+  dispatch supervisor + circuit breaker, the prepared-stream cache handle,
+  and the learned island cap.  ``decode_file``/``posterior_file``, bench,
+  and the daemon all drive the same object, so the batch CLI paths and the
+  server cannot diverge.
+- :mod:`~cpgisland_tpu.serve.broker` — the **request broker**: admission
+  control with per-tenant queue caps, a bounded-latency flush policy
+  (symbol budget or deadline, whichever first), coalescing of heterogeneous
+  decode requests into the flat reset-step stream
+  (``viterbi_onehot.decode_batch_flat`` via the shared
+  ``pipeline._decode_small_batch``), per-tenant obs accounting, and
+  optional PR 5 manifest-backed replay for restarted daemons.
+- :mod:`~cpgisland_tpu.serve.worker` — the **worker loop**: a background
+  thread draining the broker so transport-side parse/encode of flush n+1
+  overlaps device compute of flush n (the RecordPrefetcher pattern, with
+  the admission caps as the bounded queue).
+- :mod:`~cpgisland_tpu.serve.transport` — the thin **wire layer**
+  (stdin/stdout or local-socket JSONL), kept separate from the broker so
+  tests (and the graftcheck contract) drive the broker in-process.
+
+Import note: this package pulls in jax via the pipeline — the CLI imports
+it lazily inside the ``serve`` subcommand, after platform selection.
+"""
+
+from __future__ import annotations
+
+from cpgisland_tpu.serve.broker import (  # noqa: F401
+    Backpressure,
+    BrokerConfig,
+    RequestBroker,
+    ServeRequest,
+    ServeResult,
+)
+from cpgisland_tpu.serve.session import Session  # noqa: F401
+from cpgisland_tpu.serve.worker import ServeLoop  # noqa: F401

@@ -1,0 +1,739 @@
+"""Request broker: admission control + continuous flat-stream batching.
+
+The broker is the daemon's core and is deliberately TRANSPORT-FREE: tests,
+the graftcheck dispatch-stability contract, and bench's serve phase all
+drive it in-process; ``serve/transport.py`` is a thin wire layer on top.
+
+Flow: clients :meth:`RequestBroker.submit` decode/posterior requests
+(already-encoded symbol arrays — the transport does parse/encode on ITS
+thread, which is what overlaps host work with device compute).  Admission
+enforces per-tenant queue caps and rejects with :class:`Backpressure`.
+Queued requests coalesce into a FLUSH under a bounded-latency policy:
+flush when the queued symbols reach ``flush_symbols`` OR the oldest
+request has waited ``flush_deadline_s``, whichever first.  One flush is
+one obs span and (for batch-eligible decode requests under the onehot
+engine) ONE flat reset-step decode stream — heterogeneous records
+concatenate with rank-one RESET steps via the shared
+``pipeline._decode_small_batch`` / ``viterbi_onehot.decode_batch_flat``
+machinery, so the daemon's batching is the SAME code the batch CLI runs
+and cannot diverge from it.  Records outside the flat path's domain route
+per the existing host-entry rules: pad-FIRST/empty records demote to the
+single-record dense path, and a record larger than the decode span
+processes span-wise (``viterbi_sharded_spans``) without starving the
+queue — it is one flush entry like any other.
+
+Fault domain: every blocking unit runs under the session's PR 5 dispatch
+supervisor, and faults feed the SESSION's breaker — one tenant session's
+kernel faults demote engines for that session only.
+
+Restart story: with ``manifest_path``, every completed request appends a
+PR 5 manifest line keyed by request id; a restarted daemon (``resume=True``)
+fed the same request stream replays completed results bit-identically
+without touching the device.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import logging
+import threading
+import time
+from typing import Optional
+
+import numpy as np
+
+from cpgisland_tpu import obs
+from cpgisland_tpu import pipeline
+from cpgisland_tpu.ops import islands as islands_mod
+from cpgisland_tpu.ops.islands import IslandCalls
+from cpgisland_tpu.serve.session import Session
+from cpgisland_tpu.utils import profiling
+
+log = logging.getLogger(__name__)
+
+KINDS = ("decode", "posterior")
+
+
+class Backpressure(RuntimeError):
+    """Admission rejected a request (queue caps).  ``reason`` is the
+    machine-readable cause the transport surfaces to the client."""
+
+    def __init__(self, msg: str, reason: str) -> None:
+        super().__init__(msg)
+        self.reason = reason
+
+
+@dataclasses.dataclass(frozen=True)
+class BrokerConfig:
+    """Flush policy + admission limits (all symbol counts in symbols).
+
+    ``flush_symbols``: the flush budget — a flush closes when the queued
+    symbols reach it.  ``flush_deadline_s``: bounded latency — a flush
+    also closes when the OLDEST queued request has waited this long, even
+    if the budget is not met.  A single request larger than the budget is
+    admitted (up to the span-path limits) and forms its own flush entry —
+    oversized records must not starve the queue.
+
+    ``tenant_max_requests`` / ``tenant_max_symbols``: per-tenant queue
+    caps; admission past either raises :class:`Backpressure`.
+
+    ``decode_span``: records beyond it decode span-wise (exact,
+    boundary-messaged — pipeline.CLEAN_DECODE_SPAN semantics).
+    ``posterior_span``: posterior requests beyond it are REJECTED at
+    admission (span-threaded soft decoding stays a batch-CLI workload).
+
+    ``min_len`` / ``island_states``: island-calling config, broker-wide
+    (the same knobs the decode/posterior CLIs take per run).
+    """
+
+    flush_symbols: int = 8 << 20
+    flush_deadline_s: float = 0.05
+    tenant_max_requests: int = 256
+    tenant_max_symbols: int = 512 << 20
+    decode_span: int = pipeline.CLEAN_DECODE_SPAN
+    posterior_span: int = pipeline.POSTERIOR_SPAN
+    min_len: Optional[int] = None
+    island_states: Optional[tuple] = None
+
+
+@dataclasses.dataclass
+class ServeRequest:
+    id: int
+    tenant: str
+    kind: str  # "decode" | "posterior"
+    name: str
+    symbols: np.ndarray  # uint8 encoded symbols (codec.encode output)
+    t_submit: float = 0.0
+
+
+@dataclasses.dataclass
+class ServeResult:
+    id: int
+    tenant: str
+    kind: str
+    ok: bool = True
+    calls: Optional[IslandCalls] = None
+    conf: Optional[np.ndarray] = None  # posterior only (float32 per symbol)
+    conf_sum: Optional[float] = None  # exact f64 sum of conf
+    n_symbols: int = 0
+    queue_s: float = 0.0  # submit -> taken into a flush
+    serve_s: float = 0.0  # the flush's wall (shared by its requests)
+    route: str = ""  # flat | record | span | posterior | replay
+    error: Optional[str] = None
+    replayed: bool = False
+
+
+@dataclasses.dataclass
+class _Tenant:
+    queued_requests: int = 0
+    queued_symbols: int = 0
+    requests: int = 0
+    symbols: int = 0
+    results: int = 0
+    rejected: int = 0
+    replayed: int = 0
+    queue_s: float = 0.0
+    wall_s: float = 0.0
+
+    def as_dict(self) -> dict:
+        return {k: getattr(self, k) for k in self.__dataclass_fields__}
+
+
+class RequestBroker:
+    """See module docstring.  Thread contract: any thread may ``submit``;
+    ONE consumer thread (the worker loop, or a test calling
+    :meth:`flush_once` / :meth:`drain`) executes flushes — same
+    single-dispatcher rule as the pipeline's supervisor."""
+
+    def __init__(
+        self,
+        session: Session,
+        config: Optional[BrokerConfig] = None,
+        *,
+        manifest_path: Optional[str] = None,
+        resume: bool = False,
+    ) -> None:
+        self.session = session
+        self.config = config if config is not None else BrokerConfig()
+        params = session.params
+        if self.config.island_states is None:
+            err = pipeline.island_layout_error(params, None)
+            if err:
+                raise ValueError(err)
+            self._post_states: tuple = tuple(range(params.n_symbols))
+        else:
+            self._post_states = tuple(sorted(self.config.island_states))
+        self._obs_based = self.config.island_states is not None
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self._queue: collections.deque = collections.deque()
+        self._queued_ids: set = set()
+        self._inflight_ids: set = set()
+        self._queued_symbols = 0
+        self._replayed: list[ServeResult] = []
+        self._tenants: dict[str, _Tenant] = {}
+        self._timer = profiling.PhaseTimer()
+        self.flushes = 0
+        self.flushed_symbols = 0
+        self._closed = False
+        self.manifest = None
+        self._seen_ids: set = set()
+        if manifest_path is not None:
+            from cpgisland_tpu.resilience import manifest as manifest_mod
+
+            # Same header discipline as the file pipelines: every field
+            # that affects result bytes (model digest + island config) —
+            # there is no source file, the request stream IS the input, so
+            # per-request identity lives in each line's (id, key, size).
+            self.manifest = manifest_mod.RunManifest(
+                manifest_path,
+                header={
+                    "mode": "serve",
+                    "params": manifest_mod.params_digest(params),
+                    "min_len": self.config.min_len,
+                    "island_states": (
+                        None if self.config.island_states is None
+                        else sorted(self.config.island_states)
+                    ),
+                },
+                resume=resume,
+            )
+
+    # -- admission -----------------------------------------------------------
+
+    def _manifest_key(self, req: ServeRequest) -> str:
+        # Tenant + kind are part of the identity: a decode completion must
+        # never replay for another tenant's (or a posterior) request.
+        return f"{req.kind}:{req.tenant}:{req.name}"
+
+    def submit(
+        self,
+        *,
+        request_id: int,
+        tenant: str,
+        kind: str,
+        symbols: np.ndarray,
+        name: str = "",
+    ) -> None:
+        """Admit one request (raises :class:`Backpressure` on queue caps,
+        ValueError on malformed requests).  Results are delivered by the
+        flush-executing consumer (:meth:`flush_once` / the worker loop)."""
+        if self._closed:
+            raise RuntimeError("broker is closed")
+        if kind not in KINDS:
+            raise ValueError(f"kind must be one of {KINDS}, got {kind!r}")
+        symbols = np.ascontiguousarray(symbols, dtype=np.uint8)
+        if kind == "posterior" and symbols.size > self.config.posterior_span:
+            raise ValueError(
+                f"posterior request of {symbols.size} symbols exceeds the "
+                f"posterior span ({self.config.posterior_span}); span-"
+                "threaded soft decoding is a batch workload — use the "
+                "posterior CLI"
+            )
+        req = ServeRequest(
+            id=int(request_id), tenant=str(tenant), kind=kind, name=name,
+            symbols=symbols, t_submit=time.monotonic(),
+        )
+        with self._cv:
+            t = self._tenants.setdefault(req.tenant, _Tenant())
+            if self.manifest is not None:
+                if req.id in self._seen_ids:
+                    raise ValueError(
+                        f"duplicate request id {req.id} (manifest mode needs "
+                        "unique ids — they key the completion log)"
+                    )
+                hit = self.manifest.completed(
+                    req.id, self._manifest_key(req), int(symbols.size)
+                )
+                if hit is not None:
+                    from cpgisland_tpu.resilience.manifest import calls_from_wire
+
+                    self._seen_ids.add(req.id)
+                    t.requests += 1
+                    t.replayed += 1
+                    self._replayed.append(ServeResult(
+                        id=req.id, tenant=req.tenant, kind=req.kind,
+                        calls=calls_from_wire(hit["calls"]),
+                        conf_sum=(
+                            None if hit.get("conf_sum") is None
+                            else float.fromhex(hit["conf_sum"])
+                        ),
+                        n_symbols=int(symbols.size),
+                        route="replay", replayed=True,
+                    ))
+                    self._cv.notify_all()
+                    return
+            if req.id in self._queued_ids or req.id in self._inflight_ids:
+                # Two same-id requests alive at once would collide in the
+                # per-flush results map or in the transport's per-id
+                # bookkeeping (one result delivered twice, the other lost,
+                # tenant ledger misattributed) — reject while the first is
+                # still queued OR executing in a flush; an id may be
+                # reused once its request completed.  (Manifest mode never
+                # reaches here: _seen_ids already covers every queued id.)
+                raise ValueError(
+                    f"request id {req.id} is already queued — ids must be "
+                    "unique among in-flight requests"
+                )
+            if t.queued_requests + 1 > self.config.tenant_max_requests:
+                t.rejected += 1
+                obs.event(
+                    "serve_rejected", tenant=req.tenant,
+                    reason="tenant_requests",
+                )
+                raise Backpressure(
+                    f"tenant {req.tenant!r} queue is full "
+                    f"({t.queued_requests} requests)", "tenant_requests",
+                )
+            if t.queued_symbols + symbols.size > self.config.tenant_max_symbols:
+                t.rejected += 1
+                obs.event(
+                    "serve_rejected", tenant=req.tenant,
+                    reason="tenant_symbols",
+                )
+                raise Backpressure(
+                    f"tenant {req.tenant!r} queued symbols would exceed "
+                    f"{self.config.tenant_max_symbols}", "tenant_symbols",
+                )
+            if self.manifest is not None:
+                self._seen_ids.add(req.id)
+            t.queued_requests += 1
+            t.queued_symbols += symbols.size
+            t.requests += 1
+            self._queue.append(req)
+            self._queued_ids.add(req.id)
+            self._queued_symbols += symbols.size
+            self._cv.notify_all()
+
+    def backpressure(self) -> bool:
+        """Soft backpressure signal: more than two flushes' worth of
+        admitted-but-unserved symbols are waiting.  The transport mirrors
+        this to clients so well-behaved ones slow down BEFORE hitting the
+        hard tenant caps."""
+        return self._queued_symbols > 2 * self.config.flush_symbols
+
+    # -- flush policy --------------------------------------------------------
+
+    def pending(self) -> int:
+        with self._lock:
+            return len(self._queue) + len(self._replayed)
+
+    def flush_ready(self) -> bool:
+        with self._lock:
+            return self._ready_locked()
+
+    def _ready_locked(self) -> bool:
+        if self._replayed:
+            return True
+        if not self._queue:
+            return False
+        if self._queued_symbols >= self.config.flush_symbols:
+            return True
+        oldest = self._queue[0].t_submit
+        return time.monotonic() - oldest >= self.config.flush_deadline_s
+
+    def next_deadline_s(self) -> Optional[float]:
+        """Seconds until the oldest queued request's deadline (<= 0 = now);
+        None when the queue is empty."""
+        with self._lock:
+            if not self._queue:
+                return None
+            return (
+                self._queue[0].t_submit + self.config.flush_deadline_s
+                - time.monotonic()
+            )
+
+    def wait_ready(self, timeout: Optional[float]) -> bool:
+        """Block until a flush is ready (or the broker closes / timeout).
+        The worker loop's wait primitive."""
+        with self._cv:
+            if self._ready_locked() or self._closed:
+                return self._ready_locked()
+            self._cv.wait(timeout)
+            return self._ready_locked()
+
+    def _take(self) -> tuple:
+        """Pop (replayed results, flush batch) under the flush budget, in
+        arrival order.  Always pops at least one queued request when any is
+        waiting — a single record larger than the budget forms its own
+        flush (it routes to the span path) instead of starving the queue."""
+        with self._lock:
+            replayed, self._replayed = self._replayed, []
+            batch: list[ServeRequest] = []
+            total = 0
+            now = time.monotonic()
+            while self._queue:
+                # Keep taking while the batch is still under budget: the
+                # budget is the CLOSE trigger, not a content cap — the
+                # request that fills it ships in this flush (leaving it
+                # queued would make it wait out the whole deadline after
+                # the budget already fired).
+                if batch and total >= self.config.flush_symbols:
+                    break
+                nxt = self._queue[0]
+                self._queue.popleft()
+                self._queued_ids.discard(nxt.id)
+                # In-flight until flush_once returns its result: submit
+                # keeps rejecting the id while the request executes.
+                self._inflight_ids.add(nxt.id)
+                batch.append(nxt)
+                total += nxt.symbols.size
+                t = self._tenants[nxt.tenant]
+                t.queued_requests -= 1
+                t.queued_symbols -= nxt.symbols.size
+                t.queue_s += now - nxt.t_submit
+            self._queued_symbols -= total
+            return replayed, batch, now
+
+    # -- flush execution -----------------------------------------------------
+
+    # graftcheck: hot-path
+    def flush_once(self) -> list:
+        """Take and execute ONE flush; returns its results (possibly empty
+        — a deadline firing on an empty queue is a no-op, not an error)."""
+        replayed, batch, t_taken = self._take()
+        results = list(replayed)
+        if batch:
+            results.extend(self._run_flush(batch, t_taken))
+        if self.manifest is not None:
+            for r in results:
+                if r.ok and not r.replayed:
+                    try:
+                        self.manifest.record_done(
+                            r.id,
+                            f"{r.kind}:{r.tenant}:"
+                            + self._name_of(batch, r.id),
+                            r.n_symbols, calls=r.calls, conf_sum=r.conf_sum,
+                        )
+                    except Exception:
+                        # Journaling must never eat computed results: the
+                        # clients still get their responses; the cost of a
+                        # lost completion line is re-execution on restart.
+                        log.exception(
+                            "serve: manifest append failed for request %d "
+                            "(result still delivered; a restarted daemon "
+                            "will re-execute it)", r.id,
+                        )
+                        break
+            # A FAILED request recorded nothing — free its id so the
+            # client can retry with the same id (the manifest keys replay
+            # by id, so minting a new one would break restart identity).
+            with self._lock:
+                for r in results:
+                    if not r.ok:
+                        self._seen_ids.discard(r.id)
+        with self._lock:
+            for r in results:
+                self._inflight_ids.discard(r.id)
+        for r in results:
+            t = self._tenants.setdefault(r.tenant, _Tenant())
+            t.results += 1
+            if not r.replayed:
+                t.symbols += r.n_symbols
+                t.wall_s += r.serve_s
+        return results
+
+    @staticmethod
+    def _name_of(batch: list, rid: int) -> str:
+        for req in batch:
+            if req.id == rid:
+                return req.name
+        return ""
+
+    def drain(self) -> list:
+        """Flush until the queue is empty (in-process driver for tests,
+        the smoke slice, and bench's serve phase)."""
+        out: list = []
+        while self.pending():
+            out.extend(self.flush_once())
+        return out
+
+    # graftcheck: hot-path
+    def _run_flush(self, batch: list, t_taken: float) -> list:
+        """Execute one coalesced flush: batch-eligible decode records run
+        as ONE flat reset-step stream through the shared pipeline helper;
+        everything else runs its per-record shared unit.  All supervised,
+        all against the session's breaker."""
+        sess = self.session
+        cfg = self.config
+        total = float(sum(r.symbols.size for r in batch))
+        t0 = time.perf_counter()
+        results: dict[int, ServeResult] = {}
+        with obs.span("serve.flush", items=total, unit="sym"):
+            eng = sess.decode_engine()
+            use_dev, cap_box = sess.island_policy(
+                device_eligible=True,
+                ineligible_msg="unreachable: serve requests no path dumps",
+            )
+            flat: list = []  # batch-eligible decode requests
+            singles: list = []  # decode requests for the per-record path
+            posts: list = []
+            S = sess.params.n_symbols
+            for req in batch:
+                if req.kind == "posterior":
+                    posts.append(req)
+                elif (
+                    0 < req.symbols.size <= pipeline.SMALL_RECORD_MAX
+                    and req.symbols.size <= cfg.flush_symbols
+                    # Pad-FIRST records fall outside the reduced flat
+                    # stream's exactness domain — demote to the per-record
+                    # path, whose _engine_for_record applies the existing
+                    # host-entry dense-demotion rule.
+                    and not (eng == "onehot" and int(req.symbols[0]) >= S)
+                ):
+                    flat.append(req)
+                else:
+                    singles.append(req)
+            if len(flat) == 1:
+                # Mirror decode_file's flush_small: a single record skips
+                # the batch layout and decodes through the record path.
+                singles.extend(flat)
+                flat = []
+            def fail(req, e: BaseException) -> None:
+                # The daemon outlives any one request: a unit whose
+                # supervisor gave up (or a malformed record) fails THAT
+                # request, loudly, and the flush continues.
+                log.error("serve: request %d (%s) failed: %s",
+                          req.id, req.kind, e)
+                results[req.id] = ServeResult(
+                    id=req.id, tenant=req.tenant, kind=req.kind,
+                    ok=False, error=f"{type(e).__name__}: {e}",
+                    n_symbols=int(req.symbols.size),
+                )
+
+            if flat:
+                try:
+                    _nsp, parts, _paths = pipeline._decode_small_batch(
+                        sess.params,
+                        [(r.name or ".", r.symbols) for r in flat],
+                        batch_decode=sess.batch_decode_fn(eng),
+                        min_len=cfg.min_len,
+                        island_states=cfg.island_states,
+                        use_device_islands=use_dev,
+                        cap_box=cap_box,
+                        want_paths=False,
+                        timer=self._timer,
+                        defer=False,
+                        supervisor=sess.supervisor,
+                        engine_label=eng,
+                    )
+                    for req, calls in zip(flat, parts):
+                        results[req.id] = ServeResult(
+                            id=req.id, tenant=req.tenant, kind=req.kind,
+                            calls=calls, n_symbols=int(req.symbols.size),
+                            route="flat",
+                        )
+                except Exception as e:
+                    for req in flat:
+                        fail(req, e)
+            for req in singles:
+                try:
+                    calls, route = self._decode_record(
+                        req, eng, use_dev, cap_box
+                    )
+                    results[req.id] = ServeResult(
+                        id=req.id, tenant=req.tenant, kind=req.kind,
+                        calls=calls, n_symbols=int(req.symbols.size),
+                        route=route,
+                    )
+                except Exception as e:
+                    fail(req, e)
+            fb_eng = sess.fb_engine() if posts else None
+            for req in posts:
+                try:
+                    conf, conf_sum, calls = self._posterior_record(
+                        req, fb_eng, use_dev, cap_box
+                    )
+                    results[req.id] = ServeResult(
+                        id=req.id, tenant=req.tenant, kind=req.kind,
+                        calls=calls, conf=conf, conf_sum=conf_sum,
+                        n_symbols=int(req.symbols.size), route="posterior",
+                    )
+                except Exception as e:
+                    fail(req, e)
+        wall = time.perf_counter() - t0
+        self.flushes += 1
+        self.flushed_symbols += int(total)
+        obs.event(
+            "serve_flush", n_requests=len(batch), n_flat=len(flat),
+            n_singles=len(singles), n_posterior=len(posts),
+            symbols=int(total), wall_s=round(wall, 4),
+        )
+        out = []
+        for req in batch:
+            r = results[req.id]
+            r.queue_s = t_taken - req.t_submit
+            r.serve_s = wall
+            out.append(r)
+        return out
+
+    # graftcheck: hot-path
+    def _decode_record(self, req: ServeRequest, eng: str, use_dev: bool,
+                       cap_box: list):
+        """One decode request outside the flat batch: the per-record shared
+        path (viterbi_sharded, span-threaded beyond the decode span) —
+        the same units decode_file's decode_one drives."""
+        from cpgisland_tpu.parallel import decode as par_decode
+
+        sess = self.session
+        symbols = req.symbols
+        span = self.config.decode_span
+        route = "span" if symbols.size > span else "record"
+
+        def dispatch():
+            # Raw session engine string, NOT the flush-resolved name (the
+            # same rule as decode_file): an explicit name would be honored
+            # as-is on retries, so a supervisor re-dispatch after a trip
+            # could never demote down the session breaker's ladder.
+            if symbols.size == 0:
+                return [np.zeros(0, dtype=np.int32)]
+            if symbols.size > span:
+                return par_decode.viterbi_sharded_spans(
+                    sess.params, symbols, span=span, engine=sess.engine,
+                    return_device=use_dev, supervisor=sess.supervisor,
+                )
+            return [
+                par_decode.viterbi_sharded(
+                    sess.params, symbols, engine=sess.engine,
+                    return_device=use_dev, supervisor=sess.supervisor,
+                )
+            ]
+
+        if use_dev:
+            import jax
+            import jax.numpy as jnp
+
+            def record_unit():
+                p = dispatch()
+                f = p[0] if len(p) == 1 else jnp.concatenate(p)
+                # Block INSIDE the supervised unit so a device fault
+                # surfaces where the retry re-dispatches (decode_one's
+                # contract).
+                # graftcheck: allow(hot-path-host-sync) -- fault-surfacing block (comment above); the obs ledger counts it via its block_until_ready hook
+                jax.block_until_ready(f)
+                return f
+
+            full = sess.supervisor.run(
+                record_unit, what="serve.decode_record",
+                engine=f"decode.{eng}", items=float(symbols.size),
+            )
+            calls = self._device_calls(
+                full, symbols, self.config.island_states, cap_box
+            )
+        else:
+            pieces = dispatch()
+            full = pieces[0] if len(pieces) == 1 else np.concatenate(pieces)
+            calls = self._host_calls(full, symbols, self.config.island_states)
+        return calls.with_names(req.name or "."), route
+
+    # graftcheck: hot-path
+    def _posterior_record(self, req: ServeRequest, fb_eng: str,
+                          use_dev: bool, cap_box: list):
+        """One posterior request: the SAME shared record unit
+        posterior_file's single-record path runs, then island calls from
+        the MPM path — (conf host array, exact f64 conf sum, calls)."""
+        sess = self.session
+        symbols = req.symbols
+        # engine = the raw session request (re-resolves per dispatch
+        # against the session breaker, like posterior_file); fb_eng = the
+        # flush-resolved name, labels only.
+        conf, path = pipeline._posterior_record_unit(
+            sess.params, symbols, self._post_states, engine=sess.engine,
+            fb_eng=fb_eng, want_path=True, return_device=use_dev,
+            sup=sess.supervisor,
+        )
+        if use_dev:
+            from cpgisland_tpu.parallel.mesh import fetch_sharded_prefix
+
+            conf = obs.note_fetch(
+                fetch_sharded_prefix(conf, conf.shape[0], False)
+            )
+            calls = self._device_calls(
+                path, symbols,
+                self._post_states if self._obs_based else None, cap_box,
+            )
+        else:
+            calls = self._host_calls(
+                path, symbols,
+                self._post_states if self._obs_based else None,
+            )
+        # graftcheck: allow(hot-path-host-sync) -- conf is host on both branches (the device branch fetched it through obs.note_fetch above; the host branch's posterior_sharded fetched internally); coercion only
+        conf = np.asarray(conf)
+        # graftcheck: allow(hot-path-host-sync) -- conf is a host ndarray here (coerced above); exact-f64 sum, no device fetch
+        conf_sum = float(conf.sum(dtype=np.float64))
+        return conf, conf_sum, calls.with_names(req.name or ".")
+
+    def _host_calls(self, path, symbols, island_states) -> IslandCalls:
+        """Host island calling — the pipelines' exact host branches
+        (``island_states=None`` = the built-in 2M-state caller, the
+        posterior default labeling included, like posterior_file.call_rec)."""
+        if island_states is not None:
+            return islands_mod.call_islands_obs(
+                np.asarray(path), np.asarray(symbols),
+                island_states=island_states, min_len=self.config.min_len,
+            )
+        return islands_mod.call_islands(
+            np.asarray(path), chunk=0, compat=False,
+            min_len=self.config.min_len,
+        )
+
+    def _device_calls(self, path, symbols, island_states,
+                      cap_box: list) -> IslandCalls:
+        """Device island calling with the learned-cap overflow retry — the
+        pipelines' serial device branch."""
+        import jax.numpy as jnp
+
+        from cpgisland_tpu.ops.islands_device import (
+            call_islands_device,
+            call_islands_device_obs,
+        )
+
+        sess = self.session
+        if island_states is not None:
+            return pipeline._device_calls_retry(
+                call_islands_device_obs, path, jnp.asarray(symbols),
+                island_states=island_states,
+                min_len=self.config.min_len, cap_box=cap_box,
+                supervisor=sess.supervisor,
+            )
+        return pipeline._device_calls_retry(
+            call_islands_device, path, min_len=self.config.min_len,
+            cap_box=cap_box, supervisor=sess.supervisor,
+        )
+
+    # -- introspection / lifecycle ------------------------------------------
+
+    def tenant_stats(self) -> dict:
+        with self._lock:
+            return {name: t.as_dict() for name, t in self._tenants.items()}
+
+    def stats(self) -> dict:
+        from cpgisland_tpu.ops import prepared
+
+        with self._lock:
+            queued = len(self._queue)
+            qsym = self._queued_symbols
+        return {
+            "flushes": self.flushes,
+            "flushed_symbols": self.flushed_symbols,
+            "queued_requests": queued,
+            "queued_symbols": qsym,
+            "backpressure": self.backpressure(),
+            "tenants": self.tenant_stats(),
+            "prepared_cache": prepared.cache_stats(),
+        }
+
+    def close(self) -> None:
+        """Stop admitting; release the manifest.  (The session is the
+        caller's — a daemon dropping a tenant also calls session.close()
+        to evict its prepared-stream entries.)"""
+        with self._cv:
+            self._closed = True
+            self._cv.notify_all()
+        if self.manifest is not None:
+            self.manifest.close()
+
+    @property
+    def closed(self) -> bool:
+        return self._closed

@@ -1,0 +1,192 @@
+"""Session/engine layer: the long-lived state every serving entry drives.
+
+Before this module, each ``pipeline.decode_file`` / ``posterior_file`` call
+rebuilt the full serving context from scratch: a fresh DispatchSupervisor,
+a fresh island cap box (re-learning overflow sizes every run), engine
+resolution against the process-global breaker, and no handle tying the
+prepared-stream cache to an owner.  A batch CLI run tolerates that; a
+daemon serving many requests must not — and two copies of the context
+logic (pipeline + server) would drift.  :class:`Session` is the ONE place
+that state lives:
+
+- the model params (placed implicitly by jit on first use — jax caches
+  executables per shape, so a session's repeat geometries are warm);
+- the requested engine strings and their resolution (walked down the
+  breaker's parity-twin ladder at routing time, so resolution stays
+  current with fault state);
+- a per-session :class:`~cpgisland_tpu.resilience.policy.DispatchSupervisor`
+  and optionally a PRIVATE :class:`~cpgisland_tpu.resilience.breaker.
+  EngineBreaker` (``private_breaker=True``): the daemon gives each session
+  its own, so one tenant's kernel-shaped faults demote engines for that
+  session only, not the whole process;
+- the :class:`~cpgisland_tpu.ops.prepared.PreparedStreams` handle (all
+  span/prep cache lookups book against it; ``close()`` releases the prep
+  trees promptly);
+- the learned island cap (one overflow teaches every later flush).
+
+``pipeline.decode_file`` / ``posterior_file`` accept ``session=`` and
+construct an ephemeral one when not given — byte-identical behavior to the
+pre-session code.  The broker (``serve/broker.py``) and bench's serve
+phase construct explicit long-lived ones.
+"""
+
+from __future__ import annotations
+
+import functools
+import threading
+from typing import Optional
+
+from cpgisland_tpu import resilience
+from cpgisland_tpu.models.hmm import HmmParams
+
+__all__ = ["Session"]
+
+
+class Session:
+    """Long-lived serving context for ONE model (see module docstring).
+
+    Thread-safety: engine resolution and the cap box are guarded by a lock
+    (the worker loop and a transport thread may both touch the session);
+    the supervisor itself is single-consumer like the pipeline's — only
+    the flush-executing thread dispatches.
+    """
+
+    def __init__(
+        self,
+        params: HmmParams,
+        *,
+        engine: str = "auto",
+        island_engine: str = "auto",
+        island_cap: Optional[int] = None,
+        integrity_check: bool = False,
+        name: str = "session",
+        private_breaker: bool = False,
+        breaker=None,
+        retry_policy=None,
+    ) -> None:
+        self.params = params
+        self.engine = engine
+        self.island_engine = island_engine
+        self.name = name
+        if breaker is None:
+            breaker = (
+                resilience.EngineBreaker() if private_breaker
+                else resilience.get_breaker()
+            )
+        self.breaker = breaker
+        self.supervisor = resilience.DispatchSupervisor(
+            retry_policy,
+            name=name,
+            sentinel=(
+                resilience.IntegritySentinel() if integrity_check else None
+            ),
+            breaker=breaker,
+        )
+        self._integrity_check = bool(integrity_check)
+        self._island_cap = island_cap
+        self._cap_box: Optional[list] = None
+        self._lock = threading.Lock()
+        from cpgisland_tpu.ops.prepared import PreparedStreams
+
+        self.streams = PreparedStreams(params.n_symbols)
+
+    # -- pipeline integration -----------------------------------------------
+
+    def check_call(
+        self,
+        params: HmmParams,
+        *,
+        engine: str = "auto",
+        island_engine: str = "auto",
+        island_cap: Optional[int] = None,
+        integrity_check: bool = False,
+    ) -> None:
+        """Gate a pipeline call made WITH an explicit session: the session
+        owns the model and the routing config, so per-call overrides that
+        silently disagreed with it would serve with the wrong state.  The
+        pipeline entries call this before using the session."""
+        if params is not None and params is not self.params:
+            raise ValueError(
+                "decode/posterior called with a session bound to different "
+                "params — one Session serves ONE model; build another "
+                "Session (or pass this session's params)"
+            )
+        for what, got, default in (
+            ("engine", engine, "auto"),
+            ("island_engine", island_engine, "auto"),
+            ("island_cap", island_cap, None),
+            ("integrity_check", integrity_check, False),
+        ):
+            if got != default:
+                raise ValueError(
+                    f"{what}={got!r} was passed alongside session=; routing "
+                    "config lives ON the session — construct the Session "
+                    f"with {what}={got!r} instead"
+                )
+
+    # -- engine resolution (breaker-aware, re-walked per flush) -------------
+
+    def decode_engine(self) -> str:
+        """The concrete decode engine for the next unit of work — resolved
+        now, against THIS session's breaker, so a mid-run trip demotes the
+        next flush without touching other sessions."""
+        from cpgisland_tpu.parallel.decode import resolve_engine
+
+        with self._lock:
+            return resolve_engine(self.engine, self.params, breaker=self.breaker)
+
+    def fb_engine(self) -> str:
+        """decode_engine's forward-backward twin."""
+        from cpgisland_tpu.parallel.posterior import resolve_fb_engine
+
+        with self._lock:
+            return resolve_fb_engine(
+                self.engine, self.params, breaker=self.breaker
+            )
+
+    def batch_decode_fn(self, eng: str):
+        """The batched-decode callable for a resolved engine — THE one copy
+        of decode_file's engine -> batch lowering choice (flat reset-step
+        stream for onehot, the dense batch entries otherwise)."""
+        from cpgisland_tpu.ops.viterbi_pallas import viterbi_pallas_batch
+        from cpgisland_tpu.ops.viterbi_parallel import viterbi_parallel_batch
+
+        if eng == "pallas":
+            return viterbi_pallas_batch
+        if eng == "onehot":
+            # Batches run the FLAT reset-step decoder (one kernel grid for
+            # all records, viterbi_onehot.decode_batch_flat); zero-length /
+            # pad-FIRST lanes are demoted by the host entry points before
+            # they reach it.
+            return functools.partial(viterbi_parallel_batch, engine="onehot")
+        return viterbi_parallel_batch
+
+    def island_policy(self, *, device_eligible: bool, ineligible_msg: str):
+        """(use_device_islands, cap_box) via the shared pipeline policy,
+        with this session's breaker and its PERSISTENT cap box — an island
+        cap grown by one request's overflow is learned for every later
+        flush of the session, not just one file run."""
+        from cpgisland_tpu import pipeline
+
+        with self._lock:
+            start_cap = (
+                self._cap_box[0] if self._cap_box is not None
+                else self._island_cap
+            )
+            use_device, cap_box = pipeline._resolve_island_engine(
+                self.island_engine,
+                device_eligible=device_eligible,
+                ineligible_msg=ineligible_msg,
+                island_cap=start_cap,
+                breaker=self.breaker,
+            )
+            if self._cap_box is None:
+                self._cap_box = cap_box
+            return use_device, self._cap_box
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def close(self) -> None:
+        """Release session-held prepared-stream cache entries promptly
+        (the daemon's drop-a-tenant hook; see ops.prepared.evict)."""
+        self.streams.clear_session()

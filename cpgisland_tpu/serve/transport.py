@@ -1,0 +1,315 @@
+"""Thin wire layer: JSONL over stdin/stdout or a local (Unix) socket.
+
+Kept deliberately separate from the broker so tests and the graftcheck
+contract drive the broker in-process; this module only parses lines,
+encodes sequence text to symbols (on the transport thread — that host
+work is exactly what overlaps the worker's device compute), and writes
+result lines.
+
+## Protocol (one JSON object per line)
+
+Requests::
+
+    {"id": 1, "kind": "decode",    "seq": "ACGT...", "tenant": "t0",
+     "name": "chr1", "want_conf": false}
+    {"id": 2, "kind": "posterior", "seq": "..."}
+    {"op": "stats"}
+    {"op": "shutdown"}
+
+``id`` must be a client-unique integer (it keys the resume manifest).
+``tenant`` defaults to ``"default"``; ``name`` defaults to ``req<id>``.
+``want_conf`` (posterior) includes the full per-symbol confidence list in
+the response — off by default (it is 4 B/symbol of JSON-escaped floats).
+A replayed manifest hit (daemon restarted with ``--resume``) cannot
+recover per-symbol conf (the manifest journals calls + conf_sum only):
+such a response carries ``"conf_unavailable": true`` instead of
+``"conf"``.
+
+Responses (completion order, not submission order)::
+
+    {"id": 1, "ok": true, "kind": "decode", "tenant": "t0",
+     "islands": {...bit-exact wire form...}, "islands_text": "beg end ...",
+     "n_symbols": 12345, "queue_s": 0.01, "serve_s": 0.2,
+     "route": "flat", "replayed": false, "backpressure": false}
+    {"id": 2, "ok": true, "kind": "posterior", "mean_conf": 0.123,
+     "conf_sum": "0x1.9p+3", ...}
+    {"id": 7, "ok": false, "error": "Backpressure: ...",
+     "backpressure": true}
+
+``islands`` uses the PR 5 manifest wire form (ints exact, floats as
+``float.hex()``), so a client can reconstruct calls bit-identically;
+``islands_text`` is the reference's ``beg end len gc oe`` line format.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+from typing import IO
+
+import numpy as np
+
+from cpgisland_tpu.serve.broker import Backpressure, RequestBroker, ServeResult
+from cpgisland_tpu.serve.worker import ServeLoop
+
+log = logging.getLogger(__name__)
+
+__all__ = ["result_to_wire", "serve_stream", "serve_main"]
+
+
+def result_to_wire(r: ServeResult, *, backpressure: bool = False,
+                   want_conf: bool = False) -> dict:
+    """ServeResult -> JSON-safe response dict (see module docstring)."""
+    from cpgisland_tpu.resilience.manifest import calls_to_wire
+
+    out: dict = {
+        "id": r.id, "ok": r.ok, "kind": r.kind, "tenant": r.tenant,
+        "n_symbols": r.n_symbols, "route": r.route, "replayed": r.replayed,
+        "queue_s": round(r.queue_s, 6), "serve_s": round(r.serve_s, 6),
+        "backpressure": backpressure,
+    }
+    if not r.ok:
+        out["error"] = r.error
+        return out
+    if r.calls is not None:
+        out["islands"] = calls_to_wire(r.calls)
+        out["islands_text"] = r.calls.format_lines()
+    if r.kind == "posterior":
+        if r.conf_sum is not None:
+            out["conf_sum"] = float(r.conf_sum).hex()
+            out["mean_conf"] = (
+                r.conf_sum / r.n_symbols if r.n_symbols else 0.0
+            )
+        if want_conf:
+            if r.conf is not None:
+                out["conf"] = [float(v) for v in np.asarray(r.conf)]
+            else:
+                # Replayed manifest hits carry calls + conf_sum only —
+                # per-symbol conf is not journaled.  Say so instead of
+                # silently dropping the key the client asked for.
+                out["conf_unavailable"] = True
+    return out
+
+
+def _parse_request(line: str) -> dict:
+    req = json.loads(line)
+    if not isinstance(req, dict):
+        raise ValueError("request must be a JSON object")
+    return req
+
+
+def serve_stream(
+    inp: IO[str],
+    out: IO[str],
+    broker: RequestBroker,
+    *,
+    use_worker: bool = True,
+    invalid_symbols: str = "skip",
+) -> int:
+    """Serve a line stream until EOF or ``{"op": "shutdown"}``.
+
+    ``use_worker=True`` runs flushes on a background :class:`ServeLoop`
+    (the daemon cadence: this thread's parse/encode overlaps the worker's
+    device compute).  ``use_worker=False`` is the deterministic in-process
+    mode (tests): flushes run inline on this thread whenever the broker
+    reports ready, and the stream drains at EOF.  Returns the number of
+    requests served.
+    """
+    from cpgisland_tpu.utils import codec
+
+    wlock = threading.Lock()
+    served = [0]
+    want_conf: dict[int, bool] = {}
+
+    def write(obj: dict) -> None:
+        with wlock:
+            out.write(json.dumps(obj) + "\n")
+            out.flush()
+
+    def on_result(r: ServeResult) -> None:
+        served[0] += 1
+        write(result_to_wire(
+            r, backpressure=broker.backpressure(),
+            want_conf=want_conf.pop(r.id, False),
+        ))
+
+    loop = ServeLoop(broker, on_result).start() if use_worker else None
+    try:
+        for line in inp:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                req = _parse_request(line)
+            except (ValueError, json.JSONDecodeError) as e:
+                write({"ok": False, "error": f"bad request line: {e}"})
+                continue
+            op = req.get("op")
+            if op == "shutdown":
+                # Stop admission now; everything already admitted is still
+                # served by the drain below.  Closing the broker is what
+                # the socket accept loop watches for.
+                broker.close()
+                break
+            if op == "stats":
+                write({"ok": True, "stats": broker.stats()})
+                continue
+            try:
+                rid = int(req["id"])
+                kind = req["kind"]
+                seq = req["seq"]
+                # Host-side encode on THIS thread — the work that overlaps
+                # the worker loop's device compute.
+                symbols = codec.encode(seq, invalid=invalid_symbols)
+                # Flag BEFORE submit (the worker thread may deliver the
+                # result immediately after submit returns), but roll back
+                # on rejection so a refused id can't leak the flag onto a
+                # later reuse of that id.  Only THIS request's flag is
+                # rolled back: a rejected duplicate id must not clobber
+                # the flag an earlier still-queued request set.
+                this_wants = bool(req.get("want_conf"))
+                had_flag = want_conf.get(rid, False)
+                if this_wants:
+                    want_conf[rid] = True
+                try:
+                    broker.submit(
+                        request_id=rid,
+                        tenant=str(req.get("tenant", "default")),
+                        kind=kind,
+                        symbols=symbols,
+                        name=str(req.get("name", f"req{rid}")),
+                    )
+                except BaseException:
+                    if this_wants and not had_flag:
+                        want_conf.pop(rid, None)
+                    raise
+            except Backpressure as e:
+                write({
+                    "id": req.get("id"), "ok": False,
+                    "error": f"Backpressure: {e}", "reason": e.reason,
+                    "backpressure": True,
+                })
+            except (KeyError, ValueError, TypeError) as e:
+                write({
+                    "id": req.get("id"), "ok": False,
+                    "error": f"{type(e).__name__}: {e}",
+                    "backpressure": broker.backpressure(),
+                })
+            if loop is None:
+                while broker.flush_ready():
+                    for r in broker.flush_once():
+                        on_result(r)
+    finally:
+        if loop is not None:
+            loop.stop()
+        # EOF / shutdown / connection death: serve everything already
+        # admitted.  Draining in the finally keeps the shared broker's
+        # queue empty even when THIS stream dies mid-read (socket mode
+        # reuses one broker across connections — a skipped drain would
+        # flush a dead client's requests into the NEXT client's stream).
+        # Results a dead stream can no longer accept are completed and
+        # dropped.
+        for r in broker.drain():
+            try:
+                on_result(r)
+            except (OSError, ValueError):
+                # Broken pipe / closed makefile: keep draining so no
+                # request leaks past this connection.
+                log.warning("serve: dropping result for request %s "
+                            "(client stream closed)", r.id)
+    return served[0]
+
+
+def _build_broker(args, params) -> RequestBroker:
+    """CLI args -> Session + RequestBroker (the ONE construction shared by
+    the stdio and socket servers)."""
+    from cpgisland_tpu.serve.broker import BrokerConfig
+    from cpgisland_tpu.serve.session import Session
+
+    session = Session(
+        params,
+        engine=args.engine,
+        island_engine=args.island_engine,
+        island_cap=args.island_cap,
+        integrity_check=args.integrity_check,
+        name="serve",
+        private_breaker=True,
+    )
+    config = BrokerConfig(
+        flush_symbols=args.flush_symbols,
+        flush_deadline_s=args.flush_deadline_ms / 1e3,
+        tenant_max_requests=args.tenant_max_requests,
+        tenant_max_symbols=args.tenant_max_symbols,
+        min_len=args.min_len,
+        island_states=args.island_states,
+    )
+    return RequestBroker(
+        session, config,
+        manifest_path=args.manifest, resume=args.resume,
+    )
+
+
+def serve_main(args, params) -> int:
+    """The ``cpgisland serve`` entry: stdio JSONL by default, a local
+    AF_UNIX socket server with ``--socket PATH`` (one JSONL connection at
+    a time per client thread, all feeding the one broker)."""
+    import sys
+
+    broker = _build_broker(args, params)
+    try:
+        if not args.socket:
+            n = serve_stream(
+                sys.stdin, sys.stdout, broker,
+                invalid_symbols=args.invalid_symbols,
+            )
+            log.info("serve: %d request(s) served", n)
+            return 0
+        return _serve_socket(args, broker)
+    finally:
+        broker.close()
+
+
+def _serve_socket(args, broker: RequestBroker) -> int:
+    """Sequential AF_UNIX JSONL server: one client connection at a time,
+    each served by :func:`serve_stream` against the ONE warm broker — the
+    broker's flush-executing consumer must stay single (same rule as the
+    pipeline supervisor), and serial connections keep that invariant
+    without a response-routing mux.  The daemon stays warm across
+    connections; ``{"op": "shutdown"}`` from any client stops the server
+    after its stream drains."""
+    import os
+    import socket
+
+    path = args.socket
+    if os.path.exists(path):
+        os.unlink(path)
+    srv = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    srv.bind(path)
+    srv.listen(8)
+    srv.settimeout(0.5)
+    log.info("serve: listening on %s (JSONL; send {\"op\": \"shutdown\"} "
+             "to stop)", path)
+    try:
+        while not broker.closed:
+            try:
+                conn, _ = srv.accept()
+            except socket.timeout:
+                continue
+            with conn:
+                rf = conn.makefile("r", encoding="utf-8")
+                wf = conn.makefile("w", encoding="utf-8")
+                try:
+                    serve_stream(
+                        rf, wf, broker, use_worker=True,
+                        invalid_symbols=args.invalid_symbols,
+                    )
+                except Exception:
+                    log.exception("serve: client connection failed")
+    except KeyboardInterrupt:
+        pass
+    finally:
+        srv.close()
+        if os.path.exists(path):
+            os.unlink(path)
+    return 0

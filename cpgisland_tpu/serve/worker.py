@@ -1,0 +1,87 @@
+"""Worker loop: the broker's single flush-executing consumer thread.
+
+The overlap story (the daemon's RecordPrefetcher pattern): the TRANSPORT
+thread parses and encodes incoming requests and runs admission — pure host
+work — while THIS thread executes flush n's device compute.  The broker's
+queue (bounded by the per-tenant admission caps) is the hand-off buffer,
+so host-side prep of flush n+1 naturally overlaps device compute of flush
+n without any extra machinery; stopping the loop drains nothing by itself
+(close the broker and call drain for an orderly shutdown).
+
+The loop's cadence is the broker's bounded-latency flush policy: it wakes
+when the symbol budget fills (submit notifies) or when the oldest queued
+request's deadline expires, whichever first.  A deadline firing on an
+empty queue is a no-op — the loop just re-arms.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Callable
+
+from cpgisland_tpu.serve.broker import RequestBroker, ServeResult
+
+log = logging.getLogger(__name__)
+
+__all__ = ["ServeLoop"]
+
+
+class ServeLoop:
+    """Daemon thread draining ``broker``; each result is handed to
+    ``on_result`` (the transport's writer — called on THIS thread, so the
+    writer must be thread-safe with respect to its own output stream)."""
+
+    # Idle re-arm bound: with an empty queue there is no deadline to wait
+    # for, so the loop parks on the condition variable up to this long
+    # (submit notifies it awake immediately — this only bounds staleness
+    # of the closed-flag check).
+    IDLE_WAIT_S = 0.5
+
+    def __init__(
+        self,
+        broker: RequestBroker,
+        on_result: Callable[[ServeResult], None],
+    ) -> None:
+        self.broker = broker
+        self.on_result = on_result
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, name="cpgisland-serve", daemon=True
+        )
+
+    def start(self) -> "ServeLoop":
+        self._thread.start()
+        return self
+
+    def stop(self, join: bool = True) -> None:
+        self._stop.set()
+        # Wake the loop if it is parked on the broker's condition.
+        with self.broker._cv:
+            self.broker._cv.notify_all()
+        if join and self._thread.is_alive():
+            self._thread.join()
+
+    def _run(self) -> None:
+        broker = self.broker
+        while not self._stop.is_set() and not broker.closed:
+            deadline = broker.next_deadline_s()
+            timeout = (
+                self.IDLE_WAIT_S if deadline is None
+                else max(0.0, min(deadline, self.IDLE_WAIT_S))
+            )
+            if not broker.wait_ready(timeout):
+                # Deadline may have just expired with work queued — let the
+                # broker decide; an empty queue is a no-op flush.
+                if broker.next_deadline_s() is None:
+                    continue
+                if not broker.flush_ready():
+                    continue
+            try:
+                for result in broker.flush_once():
+                    self.on_result(result)
+            except Exception:
+                # A flush-level failure (broker internals, not a request
+                # unit — those are caught per request) must not kill the
+                # daemon thread silently.
+                log.exception("serve loop: flush failed")

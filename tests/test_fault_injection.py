@@ -205,12 +205,18 @@ def _patch_decode_engines(monkeypatch, poke) -> None:
 
     monkeypatch.setattr(decode_mod, "_sharded_fn", patched_sharded)
 
-    real_batch = pipeline.viterbi_parallel_batch
+    # Patch at the SOURCE module: Session.batch_decode_fn imports the
+    # batch entry lazily per call, so this is the one spot every consumer
+    # (decode_file with or without an explicit session, the serve broker)
+    # reads through.
+    from cpgisland_tpu.ops import viterbi_parallel as vp_mod
+
+    real_batch = vp_mod.viterbi_parallel_batch
 
     def patched_batch(params, chunks, lengths, **kw):
         return poke(real_batch(params, chunks, lengths, **kw))
 
-    monkeypatch.setattr(pipeline, "viterbi_parallel_batch", patched_batch)
+    monkeypatch.setattr(vp_mod, "viterbi_parallel_batch", patched_batch)
 
 
 def _patch_posterior_engine(monkeypatch, poke) -> None:

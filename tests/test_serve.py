@@ -1,0 +1,866 @@
+"""Serving subsystem (PR 8): session layer, broker parity vs the batch
+pipelines, flush policy, admission control, per-session resilience,
+daemon restart from PR 5 manifests, and the JSONL transport.
+
+The headline test streams >= 16 heterogeneous records (mixed lengths,
+decode + posterior, two tenants) through the in-process broker and pins
+the results BIT-IDENTICAL to ``decode_file``/``posterior_file`` on the
+same records, with the obs ledger proving zero fresh compiles and zero
+prepared-cache re-preps after the first flush of each geometry.
+"""
+
+import io
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from cpgisland_tpu import obs, pipeline, resilience
+from cpgisland_tpu.models import presets
+from cpgisland_tpu.resilience import RetryPolicy
+from cpgisland_tpu.serve import (
+    Backpressure,
+    BrokerConfig,
+    RequestBroker,
+    ServeLoop,
+    Session,
+)
+
+FAST = RetryPolicy(backoff_base_s=0.0)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_resilience_state():
+    resilience.reset()
+    yield
+    resilience.reset()
+
+
+def _gen_symbols(rng, n: int) -> np.ndarray:
+    """CpG-island-ish content: a CG-rich head over an AT-rich background,
+    so the island caller has real work."""
+    bg = rng.choice(4, size=n, p=[0.3, 0.2, 0.2, 0.3])
+    k = max(1, n // 4)
+    bg[:k] = rng.choice(4, size=k, p=[0.1, 0.4, 0.4, 0.1])
+    return bg.astype(np.uint8)
+
+
+def _write_fasta(path, records) -> str:
+    bases = np.array(list("acgt"))
+    with open(path, "w") as f:
+        for name, syms in records:
+            f.write(f">{name}\n")
+            s = "".join(bases[syms])
+            for i in range(0, len(s), 70):
+                f.write(s[i : i + 70] + "\n")
+    return str(path)
+
+
+def _calls_by_name(calls) -> dict:
+    out: dict = {}
+    names = (
+        calls.names if calls.names is not None
+        else np.full(len(calls), ".", dtype=object)
+    )
+    for i in range(len(calls)):
+        out.setdefault(str(names[i]), []).append((
+            int(calls.beg[i]), int(calls.end[i]), int(calls.length[i]),
+            float(calls.gc_content[i]), float(calls.oe_ratio[i]),
+        ))
+    return out
+
+
+def _mixed_requests(rng, n=16):
+    """>= 16 heterogeneous records: mixed lengths, decode + posterior,
+    two tenants."""
+    lengths = [350, 800, 1200, 2000, 3000, 4500, 6000, 9000]
+    recs = []
+    for i in range(n):
+        kind = "decode" if i % 3 != 1 else "posterior"
+        recs.append((
+            f"rec{i}",
+            _gen_symbols(rng, lengths[i % len(lengths)] + i),
+            kind,
+            f"t{i % 2}",
+        ))
+    return recs
+
+
+# ---------------------------------------------------------------------------
+# The acceptance test: broker == batch pipelines, warm and compile-stable.
+
+
+@pytest.mark.slow
+def test_broker_bit_identical_to_batch_pipelines(tmp_path):
+    params = presets.durbin_cpg8()
+    rng = np.random.default_rng(7)
+    recs = _mixed_requests(rng, 16)
+    assert len({t for *_, t in recs}) == 2
+    decode_recs = [(nm, s) for nm, s, k, _ in recs if k == "decode"]
+    post_recs = [(nm, s) for nm, s, k, _ in recs if k == "posterior"]
+    assert len(decode_recs) >= 2 and len(post_recs) >= 2
+
+    # Batch-pipeline ground truth on the same records.
+    fa_d = _write_fasta(tmp_path / "d.fa", decode_recs)
+    fa_p = _write_fasta(tmp_path / "p.fa", post_recs)
+    dres = pipeline.decode_file(fa_d, params, compat=False)
+    conf_path = str(tmp_path / "conf.npy")
+    pres = pipeline.posterior_file(
+        fa_p, params, confidence_out=conf_path,
+        islands_out=str(tmp_path / "pi.txt"),
+    )
+    conf_all = np.load(conf_path)
+    want_decode = _calls_by_name(dres.calls)
+    want_post = _calls_by_name(pres.calls)
+    post_conf = {}
+    off = 0
+    for nm, s in post_recs:
+        post_conf[nm] = conf_all[off : off + s.size]
+        off += s.size
+
+    # The daemon's broker over the same records: small flush budget so the
+    # stream coalesces into MULTIPLE mixed flushes (flat batches AND
+    # single-record routes both exercised).
+    sess = Session(params, name="test-serve", private_breaker=True)
+    broker = RequestBroker(
+        sess, BrokerConfig(flush_symbols=12_000, flush_deadline_s=0.0)
+    )
+
+    def submit_all(base: int) -> None:
+        for i, (nm, s, k, ten) in enumerate(recs):
+            broker.submit(
+                request_id=base + i, tenant=ten, kind=k, symbols=s, name=nm
+            )
+
+    # Flush 1 of each geometry: compiles happen here.
+    submit_all(0)
+    warm = broker.drain()
+    assert all(r.ok for r in warm)
+    assert broker.flushes >= 2  # the stream really coalesced into flushes
+
+    # Steady state: same geometries again — the obs ledger must show ZERO
+    # fresh compiles and ZERO prepared-cache re-preps.
+    from cpgisland_tpu.ops import prepared
+
+    preps_before = prepared.cache_stats()["misses"]
+    with obs.no_new_compiles("serve-steady-state"):
+        submit_all(100)
+        results = {r.id - 100: r for r in broker.drain()}
+    assert prepared.cache_stats()["misses"] == preps_before
+    assert len(results) == len(recs)
+
+    # Bit-identical paths/calls/conf vs the batch pipelines.
+    for i, (nm, s, kind, ten) in enumerate(recs):
+        r = results[i]
+        assert r.ok, r.error
+        assert r.tenant == ten
+        got = _calls_by_name(r.calls)
+        want = (want_decode if kind == "decode" else want_post).get(nm, [])
+        assert got.get(nm, []) == want, f"{kind} calls differ for {nm}"
+        if kind == "posterior":
+            assert r.conf is not None and np.array_equal(r.conf, post_conf[nm])
+
+    # Multi-tenant accounting covered the whole stream.
+    stats = broker.stats()
+    assert set(stats["tenants"]) == {"t0", "t1"}
+    total = sum(s.size for _, s, _, _ in recs)
+    assert sum(t["symbols"] for t in stats["tenants"].values()) == 2 * total
+    assert stats["flushed_symbols"] == 2 * total
+
+
+# ---------------------------------------------------------------------------
+# Flush policy
+
+
+def test_flush_policy_budget_and_deadline():
+    params = presets.durbin_cpg8()
+    sess = Session(params, name="t", private_breaker=True)
+    broker = RequestBroker(
+        sess, BrokerConfig(flush_symbols=4096, flush_deadline_s=30.0)
+    )
+    rng = np.random.default_rng(0)
+    broker.submit(
+        request_id=0, tenant="a", kind="decode",
+        symbols=_gen_symbols(rng, 1000),
+    )
+    # Under budget, deadline far away: not ready.
+    assert not broker.flush_ready()
+    broker.submit(
+        request_id=1, tenant="a", kind="decode",
+        symbols=_gen_symbols(rng, 4000),
+    )
+    # Budget reached: ready without waiting for the deadline.
+    assert broker.flush_ready()
+    results = broker.flush_once()
+    assert [r.id for r in results] == [0, 1]
+    assert broker.pending() == 0
+
+
+def test_flush_deadline_fires_without_budget():
+    params = presets.durbin_cpg8()
+    sess = Session(params, name="t", private_breaker=True)
+    broker = RequestBroker(
+        sess, BrokerConfig(flush_symbols=1 << 30, flush_deadline_s=0.01)
+    )
+    rng = np.random.default_rng(0)
+    broker.submit(
+        request_id=0, tenant="a", kind="decode",
+        symbols=_gen_symbols(rng, 600),
+    )
+    time.sleep(0.02)
+    assert broker.flush_ready()  # deadline, not budget
+    assert [r.id for r in broker.flush_once()] == [0]
+
+
+def test_empty_flush_on_deadline_is_noop_and_loop_survives():
+    """A deadline firing on an empty queue must not crash the worker loop,
+    and the loop must still serve what arrives afterwards."""
+    params = presets.durbin_cpg8()
+    sess = Session(params, name="t", private_breaker=True)
+    broker = RequestBroker(
+        sess, BrokerConfig(flush_symbols=1 << 20, flush_deadline_s=0.005)
+    )
+    assert broker.flush_once() == []  # empty flush: no-op, not an error
+    results = []
+    got = threading.Event()
+
+    def on_result(r):
+        results.append(r)
+        got.set()
+
+    loop = ServeLoop(broker, on_result)
+    loop.IDLE_WAIT_S = 0.01
+    loop.start()
+    time.sleep(0.05)  # several empty deadline wakeups
+    broker.submit(
+        request_id=0, tenant="a", kind="decode",
+        symbols=_gen_symbols(np.random.default_rng(1), 900),
+    )
+    assert got.wait(timeout=120.0), "worker loop never delivered the result"
+    loop.stop()
+    assert results[0].ok and results[0].id == 0
+
+
+# ---------------------------------------------------------------------------
+# Admission control / oversized records
+
+
+def test_tenant_cap_rejection_and_accounting():
+    params = presets.durbin_cpg8()
+    sess = Session(params, name="t", private_breaker=True)
+    broker = RequestBroker(
+        sess,
+        BrokerConfig(
+            flush_symbols=1 << 20, flush_deadline_s=10.0,
+            tenant_max_requests=2,
+        ),
+    )
+    rng = np.random.default_rng(0)
+    for i in range(2):
+        broker.submit(
+            request_id=i, tenant="greedy", kind="decode",
+            symbols=_gen_symbols(rng, 500),
+        )
+    with pytest.raises(Backpressure) as ei:
+        broker.submit(
+            request_id=2, tenant="greedy", kind="decode",
+            symbols=_gen_symbols(rng, 500),
+        )
+    assert ei.value.reason == "tenant_requests"
+    # Another tenant is NOT blocked by the greedy one's cap.
+    broker.submit(
+        request_id=3, tenant="polite", kind="decode",
+        symbols=_gen_symbols(rng, 500),
+    )
+    stats = broker.stats()["tenants"]
+    assert stats["greedy"]["rejected"] == 1
+    assert stats["polite"]["rejected"] == 0
+    results = broker.drain()
+    assert sorted(r.id for r in results) == [0, 1, 3]
+
+
+def test_tenant_symbol_cap():
+    params = presets.durbin_cpg8()
+    sess = Session(params, name="t", private_breaker=True)
+    broker = RequestBroker(
+        sess,
+        BrokerConfig(
+            flush_symbols=1 << 20, flush_deadline_s=10.0,
+            tenant_max_symbols=1500,
+        ),
+    )
+    rng = np.random.default_rng(0)
+    broker.submit(
+        request_id=0, tenant="a", kind="decode",
+        symbols=_gen_symbols(rng, 1000),
+    )
+    with pytest.raises(Backpressure) as ei:
+        broker.submit(
+            request_id=1, tenant="a", kind="decode",
+            symbols=_gen_symbols(rng, 1000),
+        )
+    assert ei.value.reason == "tenant_symbols"
+    broker.drain()
+
+
+@pytest.mark.slow
+def test_oversized_record_routes_to_span_path_without_starving(tmp_path):
+    """A single record exceeding the flush budget is admitted, routes to
+    the span-threaded record path, and does NOT starve later requests."""
+    params = presets.durbin_cpg8()
+    rng = np.random.default_rng(3)
+    big = _gen_symbols(rng, 20_000)
+    small = _gen_symbols(rng, 700)
+    sess = Session(params, name="t", private_breaker=True)
+    broker = RequestBroker(
+        sess,
+        BrokerConfig(
+            flush_symbols=4096, flush_deadline_s=0.0, decode_span=8192
+        ),
+    )
+    broker.submit(request_id=0, tenant="a", kind="decode", symbols=big,
+                  name="big")
+    broker.submit(request_id=1, tenant="b", kind="decode", symbols=small,
+                  name="small")
+    results = {r.id: r for r in broker.drain()}
+    assert results[0].ok and results[0].route == "span"
+    assert results[1].ok  # the queue kept moving behind the oversized record
+    # Span-threaded serving result == the batch pipeline's one-shot decode.
+    fa = _write_fasta(tmp_path / "big.fa", [("big", big)])
+    want = _calls_by_name(pipeline.decode_file(fa, params, compat=False).calls)
+    got = _calls_by_name(results[0].calls)
+    assert got.get("big", []) == want.get("big", want.get(".", []))
+
+
+def test_posterior_over_span_rejected_at_admission():
+    params = presets.durbin_cpg8()
+    sess = Session(params, name="t", private_breaker=True)
+    broker = RequestBroker(
+        sess, BrokerConfig(posterior_span=4096)
+    )
+    with pytest.raises(ValueError, match="posterior span"):
+        broker.submit(
+            request_id=0, tenant="a", kind="posterior",
+            symbols=np.zeros(8192, np.uint8),
+        )
+
+
+# ---------------------------------------------------------------------------
+# Per-session resilience
+
+
+def test_breaker_trip_mid_flush_redispatches_and_stays_per_session(monkeypatch):
+    """A fault inside a flush's supervised unit re-dispatches (the request
+    still succeeds), feeds the SESSION's breaker — and the process-global
+    breaker stays untouched."""
+    params = presets.durbin_cpg8()
+    sess = Session(
+        params, name="t", retry_policy=FAST,
+        breaker=resilience.EngineBreaker(threshold=1, cooldown_s=60.0),
+    )
+    broker = RequestBroker(
+        sess, BrokerConfig(flush_symbols=1 << 14, flush_deadline_s=0.0)
+    )
+    orig_run = sess.supervisor.run
+    state = {"faults": 1}
+
+    def run(thunk, **kw):
+        def flaky():
+            if state["faults"] > 0:
+                state["faults"] -= 1
+                raise RuntimeError("injected transient fault")
+            return thunk()
+
+        return orig_run(flaky, **kw)
+
+    monkeypatch.setattr(sess.supervisor, "run", run)
+    broker.submit(
+        request_id=0, tenant="a", kind="decode",
+        symbols=_gen_symbols(np.random.default_rng(5), 1200), name="r0",
+    )
+    results = broker.drain()
+    assert results[0].ok  # the supervised unit re-dispatched mid-flush
+    assert sess.supervisor.retries >= 1
+    # threshold=1: the injected fault tripped the SESSION breaker...
+    assert sess.breaker.tripped("decode.xla")
+    # ...while the process-global breaker never saw it.
+    assert not resilience.get_breaker().tripped("decode.xla")
+
+
+def test_session_rejects_conflicting_call_config(tmp_path):
+    params = presets.durbin_cpg8()
+    fa = _write_fasta(
+        tmp_path / "a.fa",
+        [("r0", _gen_symbols(np.random.default_rng(0), 800))],
+    )
+    sess = Session(params, name="t", private_breaker=True)
+    with pytest.raises(ValueError, match="session"):
+        pipeline.decode_file(fa, params, compat=False, session=sess,
+                             engine="xla")
+    with pytest.raises(ValueError, match="Session"):
+        pipeline.decode_file(
+            fa, presets.two_state_cpg(), compat=False, session=sess,
+            island_states=(0,),
+        )
+
+
+@pytest.mark.slow
+def test_pipeline_drives_explicit_session(tmp_path):
+    """decode_file/posterior_file with an explicit Session produce the
+    same output as without (the session layer cannot diverge), and reuse
+    the session's supervisor."""
+    params = presets.durbin_cpg8()
+    rng = np.random.default_rng(9)
+    recs = [(f"r{i}", _gen_symbols(rng, 700 + 500 * i)) for i in range(3)]
+    fa = _write_fasta(tmp_path / "a.fa", recs)
+    sess = Session(params, name="t", private_breaker=True)
+    r_sess = pipeline.decode_file(fa, params, compat=False, session=sess)
+    r_none = pipeline.decode_file(fa, params, compat=False)
+    assert _calls_by_name(r_sess.calls) == _calls_by_name(r_none.calls)
+    p_sess = pipeline.posterior_file(
+        fa, params, islands_out=str(tmp_path / "i1.txt"), session=sess
+    )
+    p_none = pipeline.posterior_file(
+        fa, params, islands_out=str(tmp_path / "i2.txt")
+    )
+    assert p_sess.mean_island_confidence == p_none.mean_island_confidence
+    assert _calls_by_name(p_sess.calls) == _calls_by_name(p_none.calls)
+
+
+# ---------------------------------------------------------------------------
+# Daemon restart: resume from PR 5 manifests
+
+
+@pytest.mark.slow
+def test_restarted_daemon_replays_from_manifest(tmp_path):
+    params = presets.durbin_cpg8()
+    rng = np.random.default_rng(13)
+    recs = [
+        (i, f"rec{i}", "decode" if i % 2 == 0 else "posterior",
+         _gen_symbols(rng, 900 + 400 * i))
+        for i in range(4)
+    ]
+    mpath = str(tmp_path / "serve.manifest.jsonl")
+    cfg = BrokerConfig(flush_symbols=1 << 14, flush_deadline_s=0.0)
+
+    sess1 = Session(params, name="t1", private_breaker=True)
+    b1 = RequestBroker(sess1, cfg, manifest_path=mpath, resume=False)
+    for rid, nm, kind, syms in recs:
+        b1.submit(request_id=rid, tenant="a", kind=kind, symbols=syms,
+                  name=nm)
+    first = {r.id: r for r in b1.drain()}
+    assert all(r.ok for r in first.values())
+    b1.close()  # the "kill": the daemon goes away, the manifest survives
+
+    sess2 = Session(params, name="t2", private_breaker=True)
+    b2 = RequestBroker(sess2, cfg, manifest_path=mpath, resume=True)
+    for rid, nm, kind, syms in recs:
+        b2.submit(request_id=rid, tenant="a", kind=kind, symbols=syms,
+                  name=nm)
+    second = {r.id: r for r in b2.drain()}
+    assert b2.flushes == 0  # every request replayed, zero device work
+    for rid, nm, kind, syms in recs:
+        r1, r2 = first[rid], second[rid]
+        assert r2.replayed and r2.route == "replay"
+        assert _calls_by_name(r2.calls) == _calls_by_name(r1.calls)
+        # gc/oe floats round-trip bit-exactly through the manifest wire.
+        assert np.array_equal(r2.calls.gc_content, r1.calls.gc_content)
+        assert np.array_equal(r2.calls.oe_ratio, r1.calls.oe_ratio)
+        if kind == "posterior":
+            assert r2.conf_sum == r1.conf_sum
+    b2.close()
+
+
+def test_duplicate_queued_id_rejected_and_reusable_after_completion():
+    """Without a manifest, two same-id requests in flight would collide in
+    the per-flush results map — rejected at admission; the id is free
+    again once its request completed."""
+    params = presets.durbin_cpg8()
+    sess = Session(params, name="t", private_breaker=True)
+    broker = RequestBroker(
+        sess, BrokerConfig(flush_symbols=1 << 14, flush_deadline_s=0.0)
+    )
+    rng = np.random.default_rng(0)
+    broker.submit(request_id=5, tenant="a", kind="decode",
+                  symbols=_gen_symbols(rng, 500))
+    with pytest.raises(ValueError, match="already queued"):
+        broker.submit(request_id=5, tenant="b", kind="decode",
+                      symbols=_gen_symbols(rng, 500))
+    assert [r.id for r in broker.drain()] == [5]
+    # Completed: the id may be reused.
+    broker.submit(request_id=5, tenant="a", kind="decode",
+                  symbols=_gen_symbols(rng, 500))
+    assert [r.id for r in broker.drain()] == [5]
+
+
+def test_failed_request_id_retryable_in_manifest_mode(tmp_path, monkeypatch):
+    """A request whose unit gave up (ok=False) recorded nothing in the
+    manifest — its id must be free for a same-id retry (the manifest keys
+    replay by id, so a fresh id would break restart identity)."""
+    params = presets.durbin_cpg8()
+    sess = Session(params, name="t", retry_policy=FAST, private_breaker=True)
+    broker = RequestBroker(
+        sess, BrokerConfig(flush_symbols=1 << 14, flush_deadline_s=0.0),
+        manifest_path=str(tmp_path / "m.jsonl"),
+    )
+    orig_run = sess.supervisor.run
+    state = {"fail": True}
+
+    def run(thunk, **kw):
+        if state["fail"]:
+            raise RuntimeError("persistent injected fault")
+        return orig_run(thunk, **kw)
+
+    monkeypatch.setattr(sess.supervisor, "run", run)
+    syms = _gen_symbols(np.random.default_rng(2), 700)
+    broker.submit(request_id=3, tenant="a", kind="decode", symbols=syms,
+                  name="r3")
+    (failed,) = broker.drain()
+    assert not failed.ok
+    # Same-id retry after the fault clears: admitted and served.
+    state["fail"] = False
+    broker.submit(request_id=3, tenant="a", kind="decode", symbols=syms,
+                  name="r3")
+    (ok,) = broker.drain()
+    assert ok.ok and ok.id == 3 and not ok.replayed
+    broker.close()
+
+
+def test_manifest_mode_rejects_duplicate_ids(tmp_path):
+    params = presets.durbin_cpg8()
+    sess = Session(params, name="t", private_breaker=True)
+    broker = RequestBroker(
+        sess, BrokerConfig(flush_symbols=1 << 14, flush_deadline_s=0.0),
+        manifest_path=str(tmp_path / "m.jsonl"),
+    )
+    syms = _gen_symbols(np.random.default_rng(0), 600)
+    broker.submit(request_id=7, tenant="a", kind="decode", symbols=syms)
+    with pytest.raises(ValueError, match="duplicate request id"):
+        broker.submit(request_id=7, tenant="a", kind="decode", symbols=syms)
+    broker.drain()
+    broker.close()
+
+
+# ---------------------------------------------------------------------------
+# Prepared-cache lifecycle (satellite: serve daemons dropping tenants)
+
+
+def test_prepared_cache_lifecycle_counters_and_explicit_eviction():
+    import jax.numpy as jnp
+
+    from cpgisland_tpu.ops import prepared
+
+    prepared.clear_cache()
+    streams = prepared.PreparedStreams(4)
+    arr = jnp.asarray(
+        np.random.default_rng(0).integers(0, 4, size=4096).astype(np.uint8)
+    )
+    p1 = streams.seq(arr, 4096, lane_T=512, t_tile=256)
+    st = prepared.cache_stats()
+    assert st["entries"] == 1 and st["misses"] == 1
+    assert st["resident_bytes"] > 0
+    p2 = streams.seq(arr, 4096, lane_T=512, t_tile=256)
+    assert p2 is p1
+    assert prepared.cache_stats()["hits"] == 1
+    # The daemon's drop-a-tenant hook: explicit eviction, counted.
+    assert streams.clear_session() == 1
+    st = prepared.cache_stats()
+    assert st["entries"] == 0 and st["resident_bytes"] == 0
+    assert st["evictions_explicit"] == 1
+    # Re-prep after eviction is a fresh miss (no stale aliasing).
+    p3 = streams.seq(arr, 4096, lane_T=512, t_tile=256)
+    assert p3 is not p1
+    assert prepared.cache_stats()["misses"] == 2
+    streams.clear_session()
+    prepared.clear_cache()
+
+
+def test_cache_stats_surface_in_obs_summary():
+    with obs.observe(metrics=None) as ob:
+        pass
+    summary = ob.summary()
+    assert "prepared_cache" in summary
+    assert {"hits", "misses", "entries", "resident_bytes"} <= set(
+        summary["prepared_cache"]
+    )
+
+
+# ---------------------------------------------------------------------------
+# Transport
+
+
+def _seq_text(syms: np.ndarray) -> str:
+    return "".join("acgt"[s] for s in syms)
+
+
+@pytest.mark.slow
+def test_transport_jsonl_stream_roundtrip():
+    from cpgisland_tpu.serve import transport
+
+    params = presets.durbin_cpg8()
+    rng = np.random.default_rng(21)
+    d_syms = _gen_symbols(rng, 1100)
+    p_syms = _gen_symbols(rng, 900)
+    lines = [
+        json.dumps({"id": 0, "kind": "decode", "tenant": "t0",
+                    "name": "chrA", "seq": _seq_text(d_syms)}),
+        json.dumps({"id": 1, "kind": "posterior", "tenant": "t1",
+                    "name": "chrB", "seq": _seq_text(p_syms),
+                    "want_conf": True}),
+        json.dumps({"id": 2, "kind": "bogus", "seq": "acgt"}),
+        "this is not json",
+        json.dumps({"op": "stats"}),
+        json.dumps({"op": "shutdown"}),
+    ]
+    inp = io.StringIO("\n".join(lines) + "\n")
+    out = io.StringIO()
+    sess = Session(params, name="t", private_breaker=True)
+    broker = RequestBroker(
+        sess, BrokerConfig(flush_symbols=1 << 15, flush_deadline_s=0.0)
+    )
+    served = transport.serve_stream(inp, out, broker, use_worker=False)
+    assert served == 2
+    responses = [json.loads(ln) for ln in out.getvalue().splitlines()]
+    by_id = {r.get("id"): r for r in responses if r.get("ok") and "stats" not in r}
+    errors = [r for r in responses if not r.get("ok")]
+    stats = [r for r in responses if "stats" in r]
+    assert len(errors) == 2  # bogus kind + bad JSON line
+    assert len(stats) == 1 and stats[0]["stats"]["flushes"] >= 0
+    dec = by_id[0]
+    assert dec["kind"] == "decode" and "islands" in dec
+    # The wire form reconstructs calls bit-identically.
+    from cpgisland_tpu.resilience.manifest import calls_from_wire
+
+    calls = calls_from_wire(dec["islands"])
+    assert dec["islands_text"] == calls.format_lines()
+    post = by_id[1]
+    assert post["kind"] == "posterior"
+    assert len(post["conf"]) == p_syms.size
+    np.testing.assert_allclose(
+        sum(post["conf"]), float.fromhex(post["conf_sum"]), rtol=1e-5
+    )
+    assert broker.closed  # shutdown op closed admission
+
+
+def test_explicit_session_engine_reaches_dispatch(tmp_path, monkeypatch):
+    """An explicit session's engine request reaches the span/record
+    dispatches, not just the batch lowering: check_call forces the call
+    kwarg to its 'auto' default, so the pipeline must source the engine
+    from the session everywhere (a mismatch would mix lowerings in one
+    call and mislabel the obs/retry telemetry)."""
+    params = presets.durbin_cpg8()
+    fa = _write_fasta(
+        tmp_path / "a.fa",
+        [("r0", _gen_symbols(np.random.default_rng(3), 900))],
+    )
+
+    seen: list = []
+    real_vs = pipeline.viterbi_sharded
+
+    def rec_vs(*a, engine="auto", **k):
+        seen.append(engine)
+        return real_vs(*a, engine=engine, **k)
+
+    monkeypatch.setattr(pipeline, "viterbi_sharded", rec_vs)
+    sess = Session(params, engine="xla", name="t", private_breaker=True)
+    pipeline.decode_file(fa, params, compat=False, session=sess)
+    assert seen and all(e == "xla" for e in seen)
+
+    from cpgisland_tpu.parallel import posterior as post_mod
+
+    seen2: list = []
+    real_ps = post_mod.posterior_sharded
+
+    def rec_ps(*a, engine="auto", **k):
+        seen2.append(engine)
+        return real_ps(*a, engine=engine, **k)
+
+    monkeypatch.setattr(post_mod, "posterior_sharded", rec_ps)
+    sess2 = Session(params, engine="xla", name="t2", private_breaker=True)
+    pipeline.posterior_file(
+        fa, params, islands_out=str(tmp_path / "i.txt"), session=sess2
+    )
+    assert seen2 and all(e == "xla" for e in seen2)
+
+
+class _DyingStream:
+    """A line stream that dies (connection reset) after its lines."""
+
+    def __init__(self, lines):
+        self._it = iter(lines)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        for line in self._it:
+            return line
+        raise OSError("connection reset by peer")
+
+
+@pytest.mark.slow
+def test_dead_stream_drains_broker_no_cross_connection_leak():
+    """A connection dying mid-stream must not leave its admitted requests
+    queued in the shared broker: socket mode reuses ONE broker across
+    connections, and a skipped drain would flush the dead client's
+    requests into the NEXT client's stream."""
+    from cpgisland_tpu.serve import transport
+
+    params = presets.durbin_cpg8()
+    syms = _gen_symbols(np.random.default_rng(5), 1000)
+    sess = Session(params, name="t", private_breaker=True)
+    # Big budget + long deadline: the request stays queued when the
+    # stream dies, so only the finally-drain can serve it.
+    broker = RequestBroker(
+        sess, BrokerConfig(flush_symbols=1 << 22, flush_deadline_s=60.0)
+    )
+    out1 = io.StringIO()
+    with pytest.raises(OSError):
+        transport.serve_stream(
+            _DyingStream([json.dumps(
+                {"id": 0, "kind": "decode", "seq": _seq_text(syms)}
+            ) + "\n"]),
+            out1, broker, use_worker=False,
+        )
+    assert broker.pending() == 0  # drained despite the dead connection
+    r1 = [json.loads(ln) for ln in out1.getvalue().splitlines()]
+    assert [r["id"] for r in r1 if r.get("ok")] == [0]
+    # "Next client": a fresh stream sees none of the dead client's results.
+    out2 = io.StringIO()
+    transport.serve_stream(
+        io.StringIO(json.dumps({"op": "shutdown"}) + "\n"),
+        out2, broker, use_worker=False,
+    )
+    assert out2.getvalue() == ""
+
+
+@pytest.mark.slow
+def test_rejected_duplicate_keeps_want_conf_flag():
+    """A rejected duplicate id must not clobber the want_conf flag an
+    earlier still-queued request set."""
+    from cpgisland_tpu.serve import transport
+
+    params = presets.durbin_cpg8()
+    syms = _gen_symbols(np.random.default_rng(6), 800)
+    sess = Session(params, name="t", private_breaker=True)
+    broker = RequestBroker(
+        sess, BrokerConfig(flush_symbols=1 << 22, flush_deadline_s=60.0)
+    )
+    lines = [
+        json.dumps({"id": 7, "kind": "posterior",
+                    "seq": _seq_text(syms), "want_conf": True}),
+        # Duplicate id while the first is queued: rejected by the broker,
+        # and its (absent) want_conf must not erase the first's flag.
+        json.dumps({"id": 7, "kind": "posterior", "seq": _seq_text(syms)}),
+    ]
+    out = io.StringIO()
+    transport.serve_stream(
+        io.StringIO("\n".join(lines) + "\n"), out, broker, use_worker=False
+    )
+    responses = [json.loads(ln) for ln in out.getvalue().splitlines()]
+    ok = [r for r in responses if r.get("ok")]
+    errors = [r for r in responses if not r.get("ok")]
+    assert len(ok) == 1 and len(errors) == 1
+    assert "already queued" in errors[0]["error"]
+    assert len(ok[0]["conf"]) == syms.size  # the flag survived
+
+
+def test_broker_record_paths_dispatch_raw_session_engine(monkeypatch):
+    """The broker's per-record decode/posterior units must dispatch the
+    RAW session engine string (like decode_file/posterior_file), not the
+    flush-resolved name — an explicit resolved name is honored as-is, so
+    supervisor retries after a breaker trip could never demote down the
+    session's parity-twin ladder."""
+    from cpgisland_tpu.parallel import decode as par_decode
+    from cpgisland_tpu.parallel import posterior as post_mod
+
+    params = presets.durbin_cpg8()
+    rng = np.random.default_rng(17)
+
+    seen_d: list = []
+    real_vs = par_decode.viterbi_sharded
+
+    def rec_vs(*a, engine="auto", **k):
+        seen_d.append(engine)
+        return real_vs(*a, engine=engine, **k)
+
+    seen_p: list = []
+    real_ps = post_mod.posterior_sharded
+
+    def rec_ps(*a, engine="auto", **k):
+        seen_p.append(engine)
+        return real_ps(*a, engine=engine, **k)
+
+    monkeypatch.setattr(par_decode, "viterbi_sharded", rec_vs)
+    monkeypatch.setattr(post_mod, "posterior_sharded", rec_ps)
+    sess = Session(params, name="t", private_breaker=True)  # engine='auto'
+    broker = RequestBroker(
+        sess, BrokerConfig(flush_symbols=1 << 14, flush_deadline_s=0.0)
+    )
+    # A single decode request takes the record path (flush_small rule);
+    # the posterior request takes the shared record unit.
+    broker.submit(request_id=0, tenant="a", kind="decode",
+                  symbols=_gen_symbols(rng, 700), name="d")
+    broker.submit(request_id=1, tenant="a", kind="posterior",
+                  symbols=_gen_symbols(rng, 600), name="p")
+    assert all(r.ok for r in broker.drain())
+    assert seen_d and all(e == "auto" for e in seen_d)
+    assert seen_p and all(e == "auto" for e in seen_p)
+
+
+def test_duplicate_id_rejected_while_executing(monkeypatch):
+    """submit must reject a duplicate id while the first request is
+    EXECUTING in a flush (not just while queued), and free the id once
+    its result is returned."""
+    params = presets.durbin_cpg8()
+    rng = np.random.default_rng(19)
+    syms = _gen_symbols(rng, 600)
+    sess = Session(params, name="t", private_breaker=True)
+    broker = RequestBroker(
+        sess, BrokerConfig(flush_symbols=1 << 14, flush_deadline_s=0.0)
+    )
+    real_run = broker._run_flush
+
+    def run_and_probe(batch, t_taken):
+        # Mid-flush: the id left the queue but its result isn't back yet.
+        with pytest.raises(ValueError, match="already queued"):
+            broker.submit(request_id=batch[0].id, tenant="a",
+                          kind="decode", symbols=syms, name="dup")
+        return real_run(batch, t_taken)
+
+    monkeypatch.setattr(broker, "_run_flush", run_and_probe)
+    broker.submit(request_id=1, tenant="a", kind="decode", symbols=syms,
+                  name="r1")
+    assert [r.ok for r in broker.drain()] == [True]
+    # Completed: the id is reusable.
+    broker.submit(request_id=1, tenant="a", kind="decode", symbols=syms,
+                  name="r1b")
+    assert all(r.ok for r in broker.drain())
+
+
+def test_clear_session_sweeps_dead_keyed_entries():
+    """A dropped tenant's arrays usually die BEFORE Session.close() runs
+    its clear_session hook — the hook must release the dead-keyed prep
+    trees then, not at the next unrelated cache miss."""
+    import gc
+
+    import jax.numpy as jnp
+
+    from cpgisland_tpu.ops import prepared
+
+    prepared.clear_cache()
+    streams = prepared.PreparedStreams(4)
+    arr = jnp.asarray(
+        np.random.default_rng(1).integers(0, 4, size=4096).astype(np.uint8)
+    )
+    streams.seq(arr, 4096, lane_T=512, t_tile=256)
+    assert prepared.cache_stats()["entries"] == 1
+    del arr
+    gc.collect()
+    streams.clear_session()
+    st = prepared.cache_stats()
+    assert st["entries"] == 0 and st["resident_bytes"] == 0
+    assert st["evictions_dead"] >= 1
+    prepared.clear_cache()

@@ -64,4 +64,14 @@ echo "== pass-fusion smoke (co-scheduled fwd/bwd parity + A/B harness) =="
 python -m pytest tests/test_passfusion.py -q
 python tools/bench_passfusion.py --platform cpu --smoke > /dev/null
 
+echo "== serve smoke (broker vs batch pipelines, transport, restart) =="
+# The serving daemon's acceptance surface: an in-process broker streaming
+# mixed decode+posterior requests across two tenants, results BIT-IDENTICAL
+# to decode_file/posterior_file on the same records with zero fresh
+# compiles / zero prepared-cache re-preps after the first flush of each
+# geometry — plus flush policy, admission caps, per-session breaker,
+# manifest restart, and the JSONL transport.  (The contract pass above
+# already pins serve.flush.dispatch-stable.)
+python -m pytest tests/test_serve.py -q
+
 echo "ci_checks: all gates green"

@@ -1,0 +1,145 @@
+#!/usr/bin/env python
+"""Client for the `cpgisland serve` daemon: FASTA in, island calls out.
+
+Reads FASTA records, submits each as a JSONL request (decode by default,
+--posterior for soft decoding), and writes the returned island calls in
+the reference's `beg end len gc oe` line format (with a record-name
+column, like the batch CLI's multi-record output).
+
+Transport: --socket PATH connects to a running daemon's AF_UNIX socket;
+without it, the client SPAWNS `python -m cpgisland_tpu serve` as a
+subprocess and talks over its stdin/stdout — the zero-setup smoke path.
+
+Examples:
+
+    # one-shot: spawn a daemon, decode a file through it
+    python tools/serve_client.py genome.fa --islands-out i.txt --platform cpu
+
+    # against a running daemon
+    python -m cpgisland_tpu serve --socket /tmp/cpg.sock &
+    python tools/serve_client.py genome.fa --socket /tmp/cpg.sock \
+        --islands-out i.txt --shutdown
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def iter_fasta_text(path: str):
+    """(name, sequence-text) per FASTA record — text only, no encoding:
+    the DAEMON encodes on its transport thread (that is the overlap)."""
+    name, parts = None, []
+    seen_any = False
+    with open(path) as f:
+        for line in f:
+            if line.startswith(">"):
+                if seen_any:
+                    yield name or "", "".join(parts)
+                name = line[1:].strip().split()[0] if line[1:].strip() else ""
+                parts = []
+                seen_any = True
+            else:
+                s = line.strip()
+                if s:
+                    parts.append(s)
+                    seen_any = True
+    if seen_any:
+        yield name or "", "".join(parts)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("fasta")
+    ap.add_argument("--islands-out", default="-",
+                    help="output path ('-' = stdout)")
+    ap.add_argument("--posterior", action="store_true",
+                    help="soft decoding (MPM-path islands + mean confidence)")
+    ap.add_argument("--tenant", default="default")
+    ap.add_argument("--socket", help="connect to a running daemon's socket")
+    ap.add_argument("--shutdown", action="store_true",
+                    help="send {'op': 'shutdown'} after the last request "
+                    "(socket mode; spawned daemons always shut down)")
+    ap.add_argument("--platform", default=None,
+                    help="spawn mode: forwarded to the daemon (-P)")
+    ap.add_argument("--stats", action="store_true",
+                    help="also request and print broker stats at the end")
+    args = ap.parse_args()
+
+    kind = "posterior" if args.posterior else "decode"
+    requests = [
+        json.dumps({
+            "id": i, "kind": kind, "tenant": args.tenant,
+            "name": name or f"rec{i}", "seq": seq,
+        })
+        for i, (name, seq) in enumerate(iter_fasta_text(args.fasta))
+    ]
+    if args.stats:
+        requests.append(json.dumps({"op": "stats"}))
+
+    if args.socket:
+        import socket
+
+        conn = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        conn.connect(args.socket)
+        wf = conn.makefile("w", encoding="utf-8")
+        rf = conn.makefile("r", encoding="utf-8")
+        for line in requests:
+            wf.write(line + "\n")
+        if args.shutdown:
+            wf.write(json.dumps({"op": "shutdown"}) + "\n")
+        wf.flush()
+        conn.shutdown(socket.SHUT_WR)
+        out_lines = list(rf)
+        conn.close()
+    else:
+        cmd = [sys.executable, "-m", "cpgisland_tpu", "serve"]
+        if args.platform:
+            cmd += ["--platform", args.platform]
+        proc = subprocess.run(
+            cmd, input="\n".join(requests) + "\n",
+            capture_output=True, text=True, cwd=REPO,
+        )
+        if proc.returncode != 0:
+            sys.stderr.write(proc.stderr)
+            return proc.returncode
+        out_lines = proc.stdout.splitlines()
+
+    n_ok = 0
+    out = sys.stdout if args.islands_out == "-" else open(args.islands_out, "w")
+    try:
+        for line in out_lines:
+            line = line.strip()
+            if not line:
+                continue
+            resp = json.loads(line)
+            if "stats" in resp:
+                sys.stderr.write(json.dumps(resp["stats"]) + "\n")
+                continue
+            if not resp.get("ok"):
+                sys.stderr.write(f"request {resp.get('id')}: "
+                                 f"{resp.get('error')}\n")
+                continue
+            n_ok += 1
+            out.write(resp.get("islands_text", ""))
+            if resp.get("kind") == "posterior":
+                sys.stderr.write(
+                    f"# {resp.get('id')}: mean_conf="
+                    f"{resp.get('mean_conf', 0.0):.4f}\n"
+                )
+    finally:
+        if out is not sys.stdout:
+            out.close()
+    sys.stderr.write(f"# {n_ok}/{len([r for r in requests if 'op' not in json.loads(r)])} requests ok\n")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
