@@ -9,6 +9,9 @@ the quantitative cost pass (COSTS.json lockfile diff + cost contracts),
 re-baselined with ``--update-costs`` after a verified change.
 ``--cost-table ENTRY`` prints the per-group fixed-vs-per-symbol
 attribution table (the BASELINE.md size-curve decomposition).
+``--sync`` adds Layer 4's cross-module pass — the lock-order graph over
+the whole file set (static deadlock detection; still pure AST, no jax) —
+on top of the per-file sync rules that already run in the lint layer.
 """
 
 from __future__ import annotations
@@ -46,6 +49,10 @@ def main(argv=None) -> int:
                     help="fail on waivers that cover nothing (stale waivers)")
     ap.add_argument("--no-lint", action="store_true",
                     help="skip the AST lint layer")
+    ap.add_argument("--sync", action="store_true",
+                    help="also run the Layer-4 cross-module lock-order "
+                    "graph (graftsync: cycles and self-deadlocks across "
+                    "files; the per-file sync rules run in the lint layer)")
     ap.add_argument("--contracts", action="store_true",
                     help="also run the jaxpr contract pass (imports jax)")
     ap.add_argument("--no-exec", action="store_true",
@@ -151,6 +158,25 @@ def main(argv=None) -> int:
                 file=sys.stderr,
             )
         if not ok:
+            rc = 1
+
+    if args.sync:
+        from cpgisland_tpu.analysis import synccheck
+
+        report = synccheck.run_sync(args.paths or None)
+        if args.as_json:
+            payload["sync"] = report.summary()
+        else:
+            for f in report.findings:
+                print(f.format())
+            uniq = {(e.src, e.dst) for e in report.edges}
+            print(
+                f"graftsync: {report.files_checked} file(s), "
+                f"{len(report.locks)} lock(s), {len(uniq)} order edge(s), "
+                f"{len(report.findings)} violation(s)",
+                file=sys.stderr,
+            )
+        if not report.ok:
             rc = 1
 
     if args.contracts:

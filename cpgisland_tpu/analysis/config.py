@@ -70,3 +70,55 @@ def hot_functions_for(relpath: str) -> frozenset[str]:
         if rel.endswith(suffix):
             return names
     return frozenset()
+
+
+# -- Layer 4 (graftsync) registries ------------------------------------------
+#
+# ``sync-guarded-by`` infers guarded state from writes under a lock; fields
+# that are INTENTIONALLY accessed outside it are registered here with a
+# reason (the hot-path-registry pattern: central, reviewed, justified — a
+# reasonless exemption is not expressible).  Keys are module-path suffixes;
+# values map "Class.attr" (or a bare attr / module-global name) to the
+# justification.
+
+SYNC_UNGUARDED: dict[str, dict[str, str]] = {
+    "utils/native.py": {
+        "_lib": "double-checked fast path: the unlocked read is benign — a "
+        "stale None retries under _lock, a non-None CDLL is immutable once "
+        "published and never reassigned back to None",
+        "_tried": "same double-checked fast path as _lib (worst case two "
+        "threads both enter the locked slow path, which re-checks)",
+    },
+}
+
+
+def sync_unguarded_for(relpath: str) -> dict[str, str]:
+    rel = relpath.replace("\\", "/")
+    for suffix, entries in SYNC_UNGUARDED.items():
+        if rel.endswith(suffix):
+            return entries
+    return {}
+
+
+# ``sync-blocking-under-lock`` exemptions: functions whose blocking work
+# under a lock IS the design (serialization gates), keyed module-path
+# suffix -> {function name: reason}.  Anything else blocking under a lock
+# needs the code restructured (build outside, insert under lock) or an
+# inline waiver.
+
+SYNC_BLOCKING_OK: dict[str, dict[str, str]] = {
+    "utils/native.py": {
+        "load": "one-time native build gate: concurrent loaders MUST wait "
+        "for the single make/dlopen (running two builds of the same .so "
+        "would race the artifact); _lock is a leaf — no other lock is ever "
+        "taken under it, so the wait cannot deadlock",
+    },
+}
+
+
+def sync_blocking_ok_for(relpath: str) -> dict[str, str]:
+    rel = relpath.replace("\\", "/")
+    for suffix, entries in SYNC_BLOCKING_OK.items():
+        if rel.endswith(suffix):
+            return entries
+    return {}

@@ -187,6 +187,7 @@ def all_rules() -> dict[str, Rule]:
         rules_jit,
         rules_numerics,
         rules_pallas,
+        rules_sync,
     )
 
     return dict(_REGISTRY)

@@ -1,0 +1,388 @@
+"""Layer 4 — concurrency contracts (``graftsync``): the per-file AST rules.
+
+PR 8 made the repo genuinely multi-threaded (broker cv, transport writer
+threads, worker loop, prefetcher); these rules machine-check the lock
+discipline the serve subsystem now depends on.  Three per-file rules plus
+the per-file half of the lock-order check (the cross-module graph runs in
+:mod:`synccheck` via ``--sync``):
+
+- ``sync-guarded-by`` — guarded-by inference: an instance attribute (or a
+  module global) ever WRITTEN under a lock is guarded by that lock, so every
+  other access must hold it.  Intentionally unguarded state is registered
+  centrally (``config.SYNC_UNGUARDED``, reason required) or waived inline.
+- ``sync-lock-order`` — intra-file lock-order cycles and non-reentrant
+  self-acquisition (the static-deadlock check; cross-module via ``--sync``).
+- ``sync-blocking-under-lock`` — no supervised dispatch, device
+  fetch/``block_until_ready``, ``queue.Queue.put/get``, socket I/O,
+  ``Thread.join``, sleeps, or subprocesses while holding a lock.  A thread
+  wedged under a lock stalls every other thread that needs it — and on this
+  project it compounds the never-kill-mid-TPU-execution rule: a dispatch
+  stranded behind a held lock cannot be safely killed (CLAUDE.md).
+- ``sync-thread-lifecycle`` — every ``threading.Thread`` is daemonized or
+  owns a stop ``Event`` and a deterministic ``join``; thread targets that
+  drain iterators (``next(...)``) need a generator-close path (the PR 5
+  prefetcher shutdown lessons: an abandoned producer leaks the wrapped
+  FASTA handle until GC).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from cpgisland_tpu.analysis import astutil, synccheck
+from cpgisland_tpu.analysis.config import (
+    sync_blocking_ok_for,
+    sync_unguarded_for,
+)
+from cpgisland_tpu.analysis.core import FileContext, Finding, register
+
+
+def _model(ctx: FileContext) -> synccheck.FileSyncModel:
+    # One model per FileContext (the four rules share the lock discovery).
+    cached = getattr(ctx, "_sync_model", None)
+    if cached is None:
+        cached = synccheck.FileSyncModel(ctx)
+        ctx._sync_model = cached  # type: ignore[attr-defined]
+    return cached
+
+
+# ---------------------------------------------------------------------------
+# sync-guarded-by
+
+
+@register(
+    "sync-guarded-by",
+    "state written under a lock must be read/written under that lock "
+    "everywhere (guarded-by inference; register intentional exceptions in "
+    "config.SYNC_UNGUARDED with a reason)",
+    origin="PR 8 serve subsystem: broker/tenant counters are mutated by the "
+    "transport thread (submit) AND the worker loop (flush); a half-guarded "
+    "field is a lost-update bug that only shows under concurrent load",
+)
+def check_guarded_by(ctx: FileContext) -> Iterator[Finding]:
+    model = _model(ctx)
+    registered = sync_unguarded_for(ctx.relpath)
+    yield from _class_guarded(ctx, model, registered)
+    yield from _module_guarded(ctx, model, registered)
+
+
+def _class_guarded(ctx, model, registered) -> Iterator[Finding]:
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.ClassDef):
+            continue
+        locks = model.class_locks.get(node.name)
+        if not locks:
+            continue
+        groups = set(locks.values())
+        lock_attrs = set(locks)
+        accesses = []  # (method_name, attr, write?, node, held)
+        for m in node.body:
+            if not isinstance(m, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            locals_map = model.local_locks(m, f"{node.name}.{m.name}")
+            resolve = model.resolver(node.name, locals_map)
+            base = synccheck.base_held_for(m.name, groups)
+            for n, held in synccheck.walk_held(m, resolve, base):
+                if (isinstance(n, ast.Attribute)
+                        and isinstance(n.value, ast.Name)
+                        and n.value.id == "self"
+                        and n.attr not in lock_attrs):
+                    accesses.append(
+                        (m.name, n.attr, synccheck.attr_write_p(n), n, held)
+                    )
+        guards: dict[str, set] = {}
+        for method, attr, write, _n, held in accesses:
+            if write and held and method != "__init__":
+                guards.setdefault(attr, set()).update(held)
+        for method, attr, write, n, held in accesses:
+            if method == "__init__" or attr not in guards:
+                continue
+            if held & guards[attr]:
+                continue
+            reason = registered.get(f"{node.name}.{attr}") or registered.get(attr)
+            if reason is not None:
+                continue
+            lock_names = ", ".join(
+                sorted(lk.label for lk in guards[attr])
+            )
+            yield ctx.finding(
+                "sync-guarded-by", n,
+                f"{'write to' if write else 'read of'} 'self.{attr}' outside "
+                f"its guarding lock ({lock_names}): the attribute is written "
+                f"under that lock elsewhere in {node.name}; hold the lock "
+                "here, or register the field in config.SYNC_UNGUARDED with "
+                "a reason",
+            )
+
+
+def _module_guarded(ctx, model, registered) -> Iterator[Finding]:
+    if not model.module_locks:
+        return
+    mod_groups = set(model.module_locks.values())
+    lock_names = set(model.module_locks)
+    accesses = []  # (fn_name, name, write?, node, held)
+    for class_name, fn, qual in synccheck.iter_functions(model):
+        locals_map = model.local_locks(fn, qual)
+        resolve = model.resolver(class_name, locals_map)
+        base = synccheck.base_held_for(fn.name, mod_groups)
+        bound = astutil.bound_names(fn)
+        globals_here = synccheck.declared_globals(fn)
+        for n, held in synccheck.walk_held(fn, resolve, base):
+            if (isinstance(n, ast.Name) and n.id not in lock_names
+                    and (n.id in globals_here or n.id not in bound)):
+                accesses.append(
+                    (fn.name, n.id,
+                     synccheck.name_write_p(n, globals_here), n, held)
+                )
+    guards: dict[str, set] = {}
+    for _fn, name, write, _n, held in accesses:
+        if write and held:
+            guards.setdefault(name, set()).update(held & mod_groups)
+    guards = {k: v for k, v in guards.items() if v}
+    for _fn, name, write, n, held in accesses:
+        if name not in guards or held & guards[name]:
+            continue
+        if registered.get(name) is not None:
+            continue
+        lock_label = ", ".join(sorted(lk.label for lk in guards[name]))
+        yield ctx.finding(
+            "sync-guarded-by", n,
+            f"{'write to' if write else 'read of'} module global {name!r} "
+            f"outside its guarding lock ({lock_label}); hold the lock here, "
+            "or register it in config.SYNC_UNGUARDED with a reason",
+        )
+
+
+# ---------------------------------------------------------------------------
+# sync-lock-order (per-file half; cross-module graph = synccheck.run_sync)
+
+
+@register(
+    "sync-lock-order",
+    "lock acquisition order must be acyclic (static deadlock detection; "
+    "this per-file rule catches intra-file cycles — the cross-module graph "
+    "runs via `--sync`)",
+    origin="PR 8: broker cv -> session lock -> breaker lock -> prepared "
+    "cache now nest across modules; one inverted pair under load is a "
+    "daemon-freezing deadlock that also strands in-flight TPU dispatches "
+    "(the never-kill-mid-execution rule makes that unrecoverable)",
+)
+def check_lock_order(ctx: FileContext) -> Iterator[Finding]:
+    model = _model(ctx)
+    if not model.module_locks and not model.class_locks:
+        return
+    graph = synccheck.LockGraph([model])
+    yield from synccheck.graph_findings(graph)
+
+
+# ---------------------------------------------------------------------------
+# sync-blocking-under-lock
+
+_BLOCKING_CANONICAL = {
+    "jax.block_until_ready": "a blocking device fetch",
+    "jax.device_get": "a blocking device fetch",
+    "jax.device_put": "a blocking device upload",
+    "time.sleep": "a sleep",
+    "subprocess.run": "a subprocess",
+    "subprocess.check_call": "a subprocess",
+    "subprocess.check_output": "a subprocess",
+}
+_BLOCKING_METHODS = {"block_until_ready": "a blocking device fetch"}
+_SOCKET_METHODS = {"accept", "recv", "recvfrom", "sendall", "connect"}
+
+
+def _blocking_reason(ctx, model, class_name, call: ast.Call):
+    """Why this call blocks, or None.  Receiver-sensitive cases (queue
+    put/get, Thread.join) only fire on attributes the model KNOWS are
+    queues/threads, so dict.get / str.join never false-positive."""
+    canon = ctx.imports.canonical(call.func)
+    if canon in _BLOCKING_CANONICAL:
+        return f"{_BLOCKING_CANONICAL[canon]} ({canon})"
+    func = call.func
+    if not isinstance(func, ast.Attribute):
+        return None
+    if func.attr in _BLOCKING_METHODS:
+        return f"{_BLOCKING_METHODS[func.attr]} (.{func.attr}())"
+    if func.attr in _SOCKET_METHODS:
+        return f"socket I/O (.{func.attr}())"
+    if func.attr == "run" and isinstance(func.value, ast.Attribute) \
+            and func.value.attr == "supervisor":
+        return "a supervised dispatch (supervisor.run)"
+    if func.attr == "supervise":
+        return "a supervised dispatch (.supervise)"
+    recv = func.value
+    if (isinstance(recv, ast.Attribute) and isinstance(recv.value, ast.Name)
+            and recv.value.id == "self" and class_name):
+        if func.attr in ("put", "get") and recv.attr in \
+                model.queue_attrs.get(class_name, ()):
+            return f"a blocking queue op (self.{recv.attr}.{func.attr})"
+        if func.attr == "join" and recv.attr in \
+                model.thread_attrs.get(class_name, ()):
+            return f"a thread join (self.{recv.attr}.join)"
+    return None
+
+
+def _direct_blocking_in(ctx, model, class_name, fn: ast.AST):
+    """(call, reason) for blocking calls anywhere in ``fn``'s own scope —
+    the depth-1 callee expansion of the rule."""
+    out = []
+    for n in astutil.walk_scope(fn):
+        if isinstance(n, ast.Call):
+            reason = _blocking_reason(ctx, model, class_name, n)
+            if reason is not None:
+                out.append((n, reason))
+    return out
+
+
+@register(
+    "sync-blocking-under-lock",
+    "no supervised dispatch, device fetch, queue put/get, socket I/O, "
+    "thread join, sleep, or subprocess while holding a lock",
+    origin="CLAUDE.md never-kill-mid-TPU-execution + the 50-100 ms relay "
+    "RTT: a thread blocked under a lock stalls every submitter AND can "
+    "strand an in-flight dispatch behind it; blocking work happens outside "
+    "the critical section (see prepared._cached: build outside, insert "
+    "under lock)",
+)
+def check_blocking_under_lock(ctx: FileContext) -> Iterator[Finding]:
+    model = _model(ctx)
+    if not model.module_locks and not model.class_locks:
+        return
+    exempt = sync_blocking_ok_for(ctx.relpath)
+    tops = {name: fn for _c, fn, name in synccheck.iter_functions(model)
+            if "." not in name}
+    for class_name, fn, qual in synccheck.iter_functions(model):
+        if fn.name in exempt or qual in exempt:
+            continue
+        locals_map = model.local_locks(fn, qual)
+        resolve = model.resolver(class_name, locals_map)
+        groups = (
+            set(model.class_locks.get(class_name or "", {}).values())
+            | set(model.module_locks.values())
+        )
+        base = synccheck.base_held_for(fn.name, groups)
+        for n, held in synccheck.walk_held(fn, resolve, base):
+            if not held or not isinstance(n, ast.Call):
+                continue
+            locks = ", ".join(sorted(lk.label for lk in held))
+            reason = _blocking_reason(ctx, model, class_name, n)
+            if reason is not None:
+                yield ctx.finding(
+                    "sync-blocking-under-lock", n,
+                    f"{reason} while holding {locks}: move the blocking "
+                    "work outside the critical section",
+                )
+                continue
+            # Depth-1 callee expansion: a same-file helper that blocks.
+            callee = None
+            if isinstance(n.func, ast.Name) and n.func.id in tops:
+                callee = (n.func.id, None, tops[n.func.id])
+            elif (isinstance(n.func, ast.Attribute)
+                    and isinstance(n.func.value, ast.Name)
+                    and n.func.value.id == "self" and class_name):
+                key = f"{class_name}.{n.func.attr}"
+                for cn, cfn, cq in synccheck.iter_functions(model):
+                    if cq == key:
+                        callee = (n.func.attr, cn, cfn)
+                        break
+            if callee is None:
+                continue
+            cname, ccls, cfn = callee
+            inner = _direct_blocking_in(ctx, model, ccls, cfn)
+            if inner:
+                _c, why = inner[0]
+                yield ctx.finding(
+                    "sync-blocking-under-lock", n,
+                    f"call to {cname}() which performs {why} while holding "
+                    f"{locks}: move the blocking work outside the critical "
+                    "section (or register the gate in "
+                    "config.SYNC_BLOCKING_OK with a reason)",
+                )
+
+
+# ---------------------------------------------------------------------------
+# sync-thread-lifecycle
+
+
+@register(
+    "sync-thread-lifecycle",
+    "threads must be daemonized or joined with an owned stop Event; thread "
+    "targets draining iterators need a generator-close path",
+    origin="PR 5 prefetcher shutdown: a non-daemon producer with no stop "
+    "Event hangs pytest/process exit, and an abandoned producer leaks the "
+    "wrapped FASTA generator's file handle until GC (prefetch._finish / "
+    "_join_then_close are the reference pattern)",
+)
+def check_thread_lifecycle(ctx: FileContext) -> Iterator[Finding]:
+    model = _model(ctx)
+    has_event = False
+    has_join = False
+    close_calls: set[str] = set()
+    thread_calls: list[ast.Call] = []
+    for n in ast.walk(ctx.tree):
+        if isinstance(n, ast.Call):
+            canon = ctx.imports.canonical(n.func)
+            if canon == "threading.Thread":
+                thread_calls.append(n)
+            elif canon == "threading.Event":
+                has_event = True
+            if isinstance(n.func, ast.Attribute):
+                if n.func.attr == "join":
+                    has_join = True
+                if n.func.attr == "close":
+                    dn = astutil.dotted_name(n.func.value)
+                    if dn:
+                        close_calls.add(dn.rsplit(".", 1)[-1])
+            # helper-mediated close (prefetch._close_iter(self._it) pattern)
+            if isinstance(n.func, ast.Name) and "close" in n.func.id:
+                for a in n.args:
+                    dn = astutil.dotted_name(a)
+                    if dn:
+                        close_calls.add(dn.rsplit(".", 1)[-1])
+    if not thread_calls:
+        return
+    defs = {name: fn for _c, fn, name in synccheck.iter_functions(model)}
+    for call in thread_calls:
+        daemon = any(
+            kw.arg == "daemon" and isinstance(kw.value, ast.Constant)
+            and kw.value.value is True
+            for kw in call.keywords
+        )
+        if not daemon and not (has_event and has_join):
+            yield ctx.finding(
+                "sync-thread-lifecycle", call,
+                "threading.Thread is neither daemonized nor deterministically "
+                "joined: pass daemon=True, or own a stop threading.Event and "
+                "join() the thread on shutdown (prefetch/worker pattern)",
+            )
+        # Generator-close half: a target that drains an iterator must have
+        # a close path for it somewhere in this file.
+        target = next(
+            (kw.value for kw in call.keywords if kw.arg == "target"), None
+        )
+        tname = None
+        if isinstance(target, ast.Name):
+            tname = target.id
+        elif isinstance(target, ast.Attribute):
+            tname = target.attr
+        tfn = (
+            defs.get(tname)
+            or next((fn for q, fn in defs.items()
+                     if q.endswith(f".{tname}")), None)
+        )
+        if tfn is None:
+            continue
+        drains = [
+            n for n in astutil.walk_scope(tfn)
+            if isinstance(n, ast.Call) and isinstance(n.func, ast.Name)
+            and n.func.id == "next" and n.args
+        ]
+        if drains and not close_calls:
+            yield ctx.finding(
+                "sync-thread-lifecycle", call,
+                f"thread target {tname!r} drains an iterator (next(...)) "
+                "but this file never closes one: an abandoned producer "
+                "leaks the wrapped generator's resources — close it on "
+                "shutdown (see utils.prefetch._close_iter)",
+            )

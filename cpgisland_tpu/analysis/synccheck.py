@@ -1,0 +1,594 @@
+"""graftsync static model: locks, held regions, and the lock-order graph.
+
+Layer 4's shared machinery.  Everything here is plain-``ast`` analysis (no
+jax, no execution, same as the rest of the lint layer): this module models
+
+- **lock identities** — instance attributes assigned ``threading.Lock()`` /
+  ``RLock()`` / ``Condition()`` in a class, module-level lock globals, and
+  function-local locks.  ``threading.Condition(self._lock)`` aliases to the
+  SAME lock group as ``self._lock`` (one underlying mutex — ``with
+  self._cv`` and ``with self._lock`` guard the same state and must never be
+  treated as two locks);
+- **held regions** — a statement-level walk of every function tracking which
+  lock groups are held (``with <lock>:`` nesting).  Methods and module
+  functions whose name ends in ``_locked`` are analyzed as running with
+  their owner's locks already held (the ``_ready_locked`` convention);
+  lambdas inherit the current held set (they are condition-variable
+  predicates and immediately-invoked callbacks in this codebase), nested
+  ``def``s do not (they may run on any thread later);
+- **the acquires-while-holding graph** — an edge ``A -> B`` whenever B is
+  acquired (directly, or transitively through a resolvable call) while A is
+  held.  Call resolution is three-tier: exact (imported names canonicalized
+  through the file's imports to a scanned module function), same-class
+  (``self.method()``), and method-name fallback (``x.allowed()`` matches
+  every scanned method named ``allowed`` that acquires a lock — the
+  conservative tier that catches ``session lock -> breaker lock`` without
+  type inference).  A cycle in the graph is a static deadlock; a
+  non-reentrant lock reachable under itself is a self-deadlock.
+
+:func:`run_sync` builds the graph across a file set (the CLI's ``--sync``
+pass and the repo self-test); the per-file ``sync-lock-order`` rule in
+:mod:`rules_sync` runs the same machinery on one file so fixtures and
+single-file CLI runs behave like every other lint rule.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+from typing import Iterable, Iterator, Optional
+
+from cpgisland_tpu.analysis import astutil
+from cpgisland_tpu.analysis.core import FileContext, Finding, discover_files
+
+LOCK_FACTORIES = {
+    "threading.Lock": "lock",
+    "threading.RLock": "rlock",
+    "threading.Condition": "cond",
+}
+
+#: attribute method calls treated as WRITES to the receiver (container
+#: mutation: ``self._queue.append(x)`` mutates ``_queue``).
+MUTATORS = frozenset({
+    "append", "appendleft", "add", "discard", "remove", "pop", "popleft",
+    "popitem", "clear", "update", "setdefault", "extend", "insert",
+    "move_to_end",
+})
+
+
+@dataclasses.dataclass(frozen=True)
+class Lock:
+    """One lock group.  ``scope`` is the owning class name ('' for module
+    scope, 'fn:<name>' for function locals); ``kind`` is 'lock' / 'rlock' /
+    'cond' (a Condition over its own implicit lock behaves like an RLock
+    for reentrancy purposes only through its owner — we model Lock and
+    Condition as non-reentrant, RLock as reentrant)."""
+
+    module: str
+    scope: str
+    name: str
+    kind: str
+
+    @property
+    def label(self) -> str:
+        scope = f"{self.scope}." if self.scope else ""
+        return f"{self.module}::{scope}{self.name}"
+
+    @property
+    def reentrant(self) -> bool:
+        return self.kind == "rlock"
+
+
+@dataclasses.dataclass(frozen=True)
+class Edge:
+    src: Lock
+    dst: Lock
+    path: str
+    line: int
+    via: str  # '' for a direct nested `with`, else the call that carries it
+
+
+class FileSyncModel:
+    """Per-file lock model: lock identities + per-function info."""
+
+    def __init__(self, ctx: FileContext):
+        self.ctx = ctx
+        self.module = ctx.relpath
+        # class name -> {attr -> Lock}; aliases resolved to one group.
+        self.class_locks: dict[str, dict[str, Lock]] = {}
+        # module-global name -> Lock
+        self.module_locks: dict[str, Lock] = {}
+        # class name -> attrs assigned queue.Queue(...) / threading.Thread(...)
+        self.queue_attrs: dict[str, set[str]] = {}
+        self.thread_attrs: dict[str, set[str]] = {}
+        self._collect_locks()
+
+    # -- lock discovery ------------------------------------------------------
+
+    def _factory_kind(self, value: ast.AST) -> Optional[str]:
+        if not isinstance(value, ast.Call):
+            return None
+        canon = self.ctx.imports.canonical(value.func)
+        return LOCK_FACTORIES.get(canon or "")
+
+    def _collect_locks(self) -> None:
+        for node in ast.walk(self.ctx.tree):
+            if isinstance(node, ast.ClassDef):
+                self._collect_class(node)
+        # Module-level lock globals (two passes: Condition(lock) aliasing).
+        for _pass in (0, 1):
+            for node in self.ctx.tree.body:
+                if not (isinstance(node, ast.Assign) and len(node.targets) == 1
+                        and isinstance(node.targets[0], ast.Name)):
+                    continue
+                kind = self._factory_kind(node.value)
+                if kind is None:
+                    continue
+                name = node.targets[0].id
+                alias = self._cond_alias(node.value, kind, self.module_locks)
+                self.module_locks[name] = alias if alias is not None else Lock(
+                    self.module, "", name, kind
+                )
+
+    def _cond_alias(self, call: ast.Call, kind: str,
+                    known: dict[str, Lock]) -> Optional[Lock]:
+        """``Condition(<known lock>)`` shares the underlying mutex: alias it
+        to the existing group instead of minting a second identity."""
+        if kind != "cond" or not call.args:
+            return None
+        arg = call.args[0]
+        if isinstance(arg, ast.Name):
+            return known.get(arg.id)
+        if (isinstance(arg, ast.Attribute) and isinstance(arg.value, ast.Name)
+                and arg.value.id == "self"):
+            return known.get(arg.attr)
+        return None
+
+    def _collect_class(self, cls: ast.ClassDef) -> None:
+        locks: dict[str, Lock] = {}
+        queues: set[str] = set()
+        threads: set[str] = set()
+        methods = [
+            n for n in cls.body
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+        ]
+        for _pass in (0, 1):  # second pass resolves Condition(self._lock)
+            for m in methods:
+                for node in astutil.walk_scope(m):
+                    if not (isinstance(node, ast.Assign)
+                            and len(node.targets) == 1):
+                        continue
+                    t = node.targets[0]
+                    if not (isinstance(t, ast.Attribute)
+                            and isinstance(t.value, ast.Name)
+                            and t.value.id == "self"):
+                        continue
+                    kind = self._factory_kind(node.value)
+                    if kind is not None:
+                        alias = self._cond_alias(node.value, kind, locks)
+                        locks[t.attr] = alias if alias is not None else Lock(
+                            self.module, cls.name, t.attr, kind
+                        )
+                        continue
+                    canon = (
+                        self.ctx.imports.canonical(node.value.func)
+                        if isinstance(node.value, ast.Call) else None
+                    )
+                    if canon == "queue.Queue":
+                        queues.add(t.attr)
+                    elif canon == "threading.Thread":
+                        threads.add(t.attr)
+        if locks:
+            self.class_locks[cls.name] = locks
+        if queues:
+            self.queue_attrs[cls.name] = queues
+        if threads:
+            self.thread_attrs[cls.name] = threads
+
+    # -- lock-expression resolution -----------------------------------------
+
+    def local_locks(self, fn: ast.AST, fn_label: str) -> dict[str, Lock]:
+        out: dict[str, Lock] = {}
+        for node in astutil.walk_scope(fn):
+            if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)):
+                kind = self._factory_kind(node.value)
+                if kind is not None:
+                    alias = self._cond_alias(node.value, kind, out)
+                    name = node.targets[0].id
+                    out[name] = alias if alias is not None else Lock(
+                        self.module, f"fn:{fn_label}", name, kind
+                    )
+        return out
+
+    def resolver(self, class_name: Optional[str],
+                 locals_map: dict[str, Lock]):
+        """A ``resolve(expr) -> Lock | None`` closure for one function."""
+        class_map = self.class_locks.get(class_name or "", {})
+
+        def resolve(expr: ast.AST) -> Optional[Lock]:
+            if (isinstance(expr, ast.Attribute)
+                    and isinstance(expr.value, ast.Name)
+                    and expr.value.id == "self"):
+                return class_map.get(expr.attr)
+            if isinstance(expr, ast.Name):
+                return locals_map.get(expr.id) or self.module_locks.get(expr.id)
+            return None
+
+        return resolve
+
+
+# -- held-region walking -----------------------------------------------------
+
+
+def walk_held(
+    fn: ast.AST, resolve, base_held: frozenset
+) -> Iterator[tuple[ast.AST, frozenset]]:
+    """Yield ``(node, held_locks)`` over ``fn``'s own scope.
+
+    ``with <lock>:`` bodies extend the held set.  Nested ``def`` bodies are
+    walked with an EMPTY held set (they may execute later, on any thread);
+    lambdas inherit the current held set (cv predicates, inline callbacks).
+    """
+
+    def walk(node: ast.AST, held: frozenset) -> Iterator:
+        yield node, held
+        if isinstance(node, ast.With):
+            body_held = set(held)
+            for item in node.items:
+                yield from walk(item.context_expr, held)
+                if item.optional_vars is not None:
+                    yield from walk(item.optional_vars, held)
+                lk = resolve(item.context_expr)
+                if lk is not None:
+                    body_held.add(lk)
+            frozen = frozenset(body_held)
+            for child in node.body:
+                yield from walk(child, frozen)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for child in ast.iter_child_nodes(node):
+                yield from walk(child, frozenset())
+        elif isinstance(node, ast.Lambda):
+            for child in ast.iter_child_nodes(node):
+                yield from walk(child, held)
+        else:
+            for child in ast.iter_child_nodes(node):
+                yield from walk(child, held)
+
+    for child in ast.iter_child_nodes(fn):
+        yield from walk(child, base_held)
+
+
+def base_held_for(name: str, lock_groups: Iterable[Lock]) -> frozenset:
+    """The ``_locked`` suffix convention: such a function runs with its
+    owner's locks already held (callers acquire; see broker._ready_locked)."""
+    if name.endswith("_locked"):
+        return frozenset(lock_groups)
+    return frozenset()
+
+
+def iter_functions(model: FileSyncModel):
+    """Yield ``(class_name_or_None, fn_node, qualname)`` for every function
+    in the file (module functions and direct class methods; nested defs are
+    visited through their parents' walks, not as entries)."""
+    tree = model.ctx.tree
+    for node in tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield None, node, node.name
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef):
+            for m in node.body:
+                if isinstance(m, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    yield node.name, m, f"{node.name}.{m.name}"
+
+
+def attr_write_p(node: ast.Attribute) -> bool:
+    """Is this ``self.x`` attribute node a WRITE (assignment, deletion,
+    subscript store, augmented assignment, or container mutator call)?"""
+    if isinstance(node.ctx, (ast.Store, ast.Del)):
+        return True
+    parent = getattr(node, "parent", None)
+    if (isinstance(parent, ast.Subscript) and parent.value is node
+            and isinstance(parent.ctx, (ast.Store, ast.Del))):
+        return True
+    if (isinstance(parent, ast.Attribute) and parent.value is node
+            and parent.attr in MUTATORS):
+        gp = getattr(parent, "parent", None)
+        if isinstance(gp, ast.Call) and gp.func is parent:
+            return True
+    return False
+
+
+def name_write_p(node: ast.Name, global_names: set[str]) -> bool:
+    """Module-global write: a ``global``-declared rebind, a subscript store,
+    or a container mutator call on a module-level name."""
+    if isinstance(node.ctx, (ast.Store, ast.Del)):
+        return node.id in global_names
+    parent = getattr(node, "parent", None)
+    if (isinstance(parent, ast.Subscript) and parent.value is node
+            and isinstance(parent.ctx, (ast.Store, ast.Del))):
+        return True
+    if (isinstance(parent, ast.Attribute) and parent.value is node
+            and parent.attr in MUTATORS):
+        gp = getattr(parent, "parent", None)
+        if isinstance(gp, ast.Call) and gp.func is parent:
+            return True
+    return False
+
+
+def declared_globals(fn: ast.AST) -> set[str]:
+    out: set[str] = set()
+    for node in astutil.walk_scope(fn):
+        if isinstance(node, ast.Global):
+            out.update(node.names)
+    return out
+
+
+# -- the cross-file lock-order graph -----------------------------------------
+
+
+@dataclasses.dataclass
+class _FnInfo:
+    model: FileSyncModel
+    class_name: Optional[str]
+    node: ast.AST
+    qualname: str
+    direct: set  # locks acquired via `with` anywhere in the body
+    calls: list  # (call node, held-at-call)
+
+
+class LockGraph:
+    """Acquires-while-holding edges across a set of file models."""
+
+    def __init__(self, models: list[FileSyncModel]):
+        self.models = models
+        self.fns: dict[tuple[str, str], _FnInfo] = {}
+        # method-name fallback index: bare name -> [(module, qualname)]
+        self.by_method: dict[str, list[tuple[str, str]]] = {}
+        self.edges: list[Edge] = []
+        self.self_deadlocks: list[tuple[Lock, str, int, str]] = []
+        self._collect()
+        self._trans = self._transitive_acquires()
+        self._build_edges()
+
+    # -- phase 1: per-function direct acquires + call sites ------------------
+
+    def _collect(self) -> None:
+        for model in self.models:
+            for class_name, fn, qual in iter_functions(model):
+                locals_map = model.local_locks(fn, qual)
+                resolve = model.resolver(class_name, locals_map)
+                # Owner's locks for the `_locked` convention: class locks
+                # AND module locks — a module-level `_sweep_dead_locked`
+                # runs with the module lock held, and modeling it with an
+                # empty held set would drop its acquires-while-holding
+                # edges from the deadlock graph.
+                groups = (
+                    set(model.class_locks.get(class_name or "", {}).values())
+                    | set(model.module_locks.values())
+                )
+                base = base_held_for(fn.name, groups)
+                direct: set = set()
+                calls: list = []
+                for node, held in walk_held(fn, resolve, base):
+                    if isinstance(node, ast.With):
+                        for item in node.items:
+                            lk = resolve(item.context_expr)
+                            if lk is not None:
+                                direct.add(lk)
+                                self._note_acquire(lk, held, model, node, "")
+                    elif isinstance(node, ast.Call):
+                        calls.append((node, held))
+                info = _FnInfo(model, class_name, fn, qual, direct, calls)
+                self.fns[(model.module, qual)] = info
+                bare = fn.name
+                self.by_method.setdefault(bare, []).append(
+                    (model.module, qual)
+                )
+
+    def _note_acquire(
+        self, lk: Lock, held: frozenset, model: FileSyncModel,
+        node: ast.AST, via: str,
+    ) -> None:
+        for h in held:
+            if h == lk:
+                if not lk.reentrant:
+                    self.self_deadlocks.append(
+                        (lk, model.module, node.lineno, via)
+                    )
+                continue
+            self.edges.append(Edge(
+                src=h, dst=lk, path=model.module,
+                line=getattr(node, "lineno", 1), via=via,
+            ))
+
+    # -- phase 2: call resolution + transitive acquire sets ------------------
+
+    def _resolve_call(self, info: _FnInfo, call: ast.Call) -> list:
+        """Scanned functions a call may enter (exact > self-method >
+        method-name fallback; the fallback only matches methods that acquire
+        locks, bounding its noise to lock-relevant call sites)."""
+        func = call.func
+        model = info.model
+        out: list[tuple[str, str]] = []
+        canon = model.ctx.imports.canonical(func)
+        if canon and canon.startswith("cpgisland_tpu."):
+            rel = canon[len("cpgisland_tpu."):]
+            mod_path, _, fname = rel.rpartition(".")
+            suffix = mod_path.replace(".", "/") + ".py"
+            for m in self.models:
+                if m.module.endswith(suffix) and (m.module, fname) in self.fns:
+                    out.append((m.module, fname))
+        if isinstance(func, ast.Name):
+            key = (model.module, func.id)
+            if key in self.fns:
+                out.append(key)
+        if isinstance(func, ast.Attribute):
+            if (isinstance(func.value, ast.Name) and func.value.id == "self"
+                    and info.class_name):
+                key = (model.module, f"{info.class_name}.{func.attr}")
+                if key in self.fns:
+                    out.append(key)
+            if not out:
+                for mod, qual in self.by_method.get(func.attr, ()):
+                    if "." in qual:  # methods only — the conservative tier
+                        out.append((mod, qual))
+        return out
+
+    def _transitive_acquires(self) -> dict:
+        trans = {k: set(v.direct) for k, v in self.fns.items()}
+        for _ in range(8):  # fixpoint (call-chain depth bound)
+            changed = False
+            for key, info in self.fns.items():
+                acc = trans[key]
+                before = len(acc)
+                for call, _held in info.calls:
+                    for callee in self._resolve_call(info, call):
+                        acc |= trans.get(callee, set())
+                if len(acc) != before:
+                    changed = True
+            if not changed:
+                break
+        return trans
+
+    def _build_edges(self) -> None:
+        for info in self.fns.values():
+            for call, held in info.calls:
+                if not held:
+                    continue
+                acquired: set = set()
+                via_names: dict = {}
+                for callee in self._resolve_call(info, call):
+                    for lk in self._trans.get(callee, ()):  # noqa: B020
+                        acquired.add(lk)
+                        via_names.setdefault(lk, callee[1])
+                for lk in acquired:
+                    self._note_acquire(
+                        lk, held, info.model, call, via_names.get(lk, "?")
+                    )
+
+    # -- cycles --------------------------------------------------------------
+
+    def unique_edges(self) -> dict:
+        """(src, dst) -> representative Edge (first site seen)."""
+        out: dict = {}
+        for e in self.edges:
+            out.setdefault((e.src, e.dst), e)
+        return out
+
+    def cycles(self) -> list[list[Edge]]:
+        """Elementary cycles in the order graph (DFS; each reported once)."""
+        uniq = self.unique_edges()
+        adj: dict = {}
+        for (src, dst), e in uniq.items():
+            adj.setdefault(src, []).append((dst, e))
+        seen_cycles: set = set()
+        out: list[list[Edge]] = []
+
+        def dfs(start: Lock, cur: Lock, path: list[Edge], on_path: set):
+            for nxt, e in adj.get(cur, ()):
+                if nxt == start:
+                    cyc = path + [e]
+                    key = frozenset((x.src, x.dst) for x in cyc)
+                    if key not in seen_cycles:
+                        seen_cycles.add(key)
+                        out.append(cyc)
+                elif nxt not in on_path:
+                    dfs(start, nxt, path + [e], on_path | {nxt})
+
+        for node in adj:
+            dfs(node, node, [], {node})
+        return out
+
+
+# -- the public pass ---------------------------------------------------------
+
+
+@dataclasses.dataclass
+class SyncReport:
+    files_checked: int
+    locks: list[Lock]
+    edges: list[Edge]
+    findings: list[Finding]
+
+    @property
+    def ok(self) -> bool:
+        return not self.findings
+
+    def summary(self) -> dict:
+        return {
+            "files_checked": self.files_checked,
+            "locks": sorted(lk.label for lk in self.locks),
+            "edges": sorted(
+                f"{e.src.label} -> {e.dst.label}"
+                for e in {(e.src, e.dst): e for e in self.edges}.values()
+            ),
+            "violations": [f.format() for f in self.findings],
+        }
+
+
+def build_models(paths: Iterable[str], base: Optional[str] = None):
+    base = base or os.getcwd()
+    models: list[FileSyncModel] = []
+    for path in discover_files(paths):
+        rel = os.path.relpath(path, base)
+        if rel.startswith(".."):
+            rel = path
+        try:
+            with open(path, "r", encoding="utf-8") as fh:
+                ctx = FileContext(path, fh.read(),
+                                  relpath=rel.replace(os.sep, "/"))
+        except (OSError, SyntaxError):
+            continue  # parse errors are the lint layer's finding, not ours
+        models.append(FileSyncModel(ctx))
+    return models
+
+
+def graph_findings(graph: LockGraph) -> list[Finding]:
+    findings: list[Finding] = []
+    for cyc in graph.cycles():
+        locks = " -> ".join([e.src.label for e in cyc] + [cyc[0].src.label])
+        sites = "; ".join(
+            f"{e.path}:{e.line}"
+            + (f" (via {e.via})" if e.via else "") for e in cyc
+        )
+        findings.append(Finding(
+            "sync-lock-order", cyc[0].path, cyc[0].line, 1,
+            f"lock-order cycle (static deadlock): {locks} — acquisition "
+            f"sites: {sites}; pick one global order and stick to it",
+        ))
+    for lk, path, line, via in graph.self_deadlocks:
+        findings.append(Finding(
+            "sync-lock-order", path, line, 1,
+            f"non-reentrant lock {lk.label} may be re-acquired while "
+            f"already held"
+            + (f" (through a call into {via})" if via else "")
+            + " — a plain Lock/Condition self-deadlocks here; restructure "
+            "or use the _locked-suffix convention for the inner helper",
+        ))
+    return findings
+
+
+def run_sync(
+    paths: Optional[Iterable[str]] = None, base: Optional[str] = None
+) -> SyncReport:
+    """Build the cross-module lock-order graph over ``paths`` (default: the
+    installed package) and report cycles/self-deadlocks."""
+    if paths is None:
+        pkg = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        paths = [pkg]
+        base = base or os.path.dirname(pkg)
+    models = build_models(paths, base=base)
+    graph = LockGraph(models)
+    locks: set = set()
+    for m in models:
+        locks.update(m.module_locks.values())
+        for d in m.class_locks.values():
+            locks.update(d.values())
+    return SyncReport(
+        files_checked=len(models),
+        locks=sorted(locks, key=lambda lk: lk.label),
+        edges=graph.edges,
+        findings=graph_findings(graph),
+    )

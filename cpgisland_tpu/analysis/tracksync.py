@@ -1,0 +1,476 @@
+"""graftsync runtime tracker: a mini-TSan for the serve paths.
+
+The static rules (:mod:`rules_sync`) prove lock discipline on the AST; this
+module validates the same model under a REAL concurrent load.  An installed
+:class:`LockTracker` patches the ``threading.Lock`` / ``RLock`` /
+``Condition`` factories so every lock created inside the install window is
+wrapped with bookkeeping (locks created before install are untouched):
+
+- **lock-order recording** — each acquire of B while holding A records an
+  ``A -> B`` edge with the acquiring site; :meth:`LockTracker.cycles`
+  reports cycles in the observed order graph (the dynamic twin of
+  ``synccheck``'s static graph — an inversion that only manifests under a
+  particular interleaving still shows up here, because BOTH orders were
+  observed even if they never overlapped in time).
+- **guarded-access recording** — :meth:`LockTracker.watch_attrs` installs
+  checking descriptors for chosen attributes of a watched instance: every
+  get/set on a watched object asserts the guarding lock is held by the
+  current thread and records a violation otherwise (reads and writes that
+  the static rule waived or missed surface here).
+
+Opt-in only: nothing is patched at import.  Tests install around the code
+under test (the serve-mux stress test), or set ``CPGISLAND_TRACKSYNC=1``
+to have ``tests/conftest.py`` install a session-wide tracker.  Like the
+rest of the analysis package, this module imports no jax.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import sys
+import threading
+import weakref
+from typing import Optional
+
+# Real primitives captured BEFORE any patching: the tracker's own state is
+# guarded by an unwrapped lock (a tracked internal lock would recurse).
+_REAL_LOCK = threading.Lock
+_REAL_RLOCK = threading.RLock
+_REAL_CONDITION = threading.Condition
+
+_TRACKER_FILES = (os.path.abspath(__file__), threading.__file__)
+
+
+def _call_site() -> str:
+    """file:line of the nearest frame outside this module and threading."""
+    f = sys._getframe(1)
+    while f is not None:
+        fn = f.f_code.co_filename
+        if os.path.abspath(fn) not in _TRACKER_FILES and "threading" not in fn:
+            return f"{os.path.basename(fn)}:{f.f_lineno}"
+        f = f.f_back
+    return "<unknown>"
+
+
+@dataclasses.dataclass
+class Violation:
+    kind: str  # "lock-order-cycle" | "guarded-access"
+    message: str
+
+
+class _Tracked:
+    """Shared bookkeeping half of the wrappers."""
+
+    def __init__(self, tracker: "LockTracker", kind: str):
+        self.tracker = tracker
+        self.kind = kind
+        self.name = f"{kind}@{_call_site()}"
+        tracker._register(self)
+
+    # identity used in held lists / edges: the wrapper object itself.
+
+
+class TrackedLock(_Tracked):
+    def __init__(self, tracker, kind="Lock", inner=None):
+        super().__init__(tracker, kind)
+        self._inner = inner if inner is not None else (
+            _REAL_RLOCK() if kind == "RLock" else _REAL_LOCK()
+        )
+
+    def acquire(self, blocking=True, timeout=-1):
+        got = self._inner.acquire(blocking, timeout)
+        if got:
+            self.tracker._note_acquire(self)
+        return got
+
+    def release(self):
+        self.tracker._note_release(self)
+        self._inner.release()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    def locked(self):
+        return self._inner.locked()
+
+    # RLock protocol bits some library code touches (real Condition over a
+    # tracked RLock); delegate so semantics stay exact.
+    def _is_owned(self):
+        inner = self._inner
+        if hasattr(inner, "_is_owned"):
+            return inner._is_owned()
+        if inner.acquire(False):
+            inner.release()
+            return False
+        return True
+
+    def _release_save(self):
+        self.tracker._note_release(self)
+        if hasattr(self._inner, "_release_save"):
+            return self._inner._release_save()
+        self._inner.release()
+        return None
+
+    def _acquire_restore(self, state):
+        if hasattr(self._inner, "_acquire_restore"):
+            self._inner._acquire_restore(state)
+        else:
+            self._inner.acquire()
+        self.tracker._note_acquire(self)
+
+
+class TrackedCondition(_Tracked):
+    """Condition wrapper.  Built over a :class:`TrackedLock`, the condition
+    IS that lock for ordering purposes (one mutex); built bare, it owns a
+    fresh tracked RLock — exactly threading.Condition's semantics."""
+
+    def __init__(self, tracker, lock=None):
+        if isinstance(lock, TrackedLock):
+            self._lockid = lock
+            inner_lock = lock._inner
+        elif lock is not None:  # an untracked caller-supplied lock
+            self._lockid = None
+            inner_lock = lock
+        else:
+            self._lockid = TrackedLock(tracker, "RLock")
+            inner_lock = self._lockid._inner
+        super().__init__(tracker, "Condition")
+        if self._lockid is not None:
+            # Ordering identity is the underlying mutex, not the cv object.
+            self.name = self._lockid.name
+        self._inner = _REAL_CONDITION(inner_lock)
+
+    def _ident(self):
+        return self._lockid if self._lockid is not None else self
+
+    def acquire(self, *a, **k):
+        got = self._inner.acquire(*a, **k)
+        if got:
+            self.tracker._note_acquire(self._ident())
+        return got
+
+    def release(self):
+        self.tracker._note_release(self._ident())
+        self._inner.release()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    def wait(self, timeout=None):
+        # wait releases the mutex and re-acquires before returning: mirror
+        # that in the held bookkeeping (a re-acquire while holding OTHER
+        # locks is a real ordering event and is recorded as such).
+        self.tracker._note_release(self._ident())
+        try:
+            return self._inner.wait(timeout)
+        finally:
+            self.tracker._note_acquire(self._ident())
+
+    def wait_for(self, predicate, timeout=None):
+        self.tracker._note_release(self._ident())
+        try:
+            return self._inner.wait_for(predicate, timeout)
+        finally:
+            self.tracker._note_acquire(self._ident())
+
+    def notify(self, n=1):
+        self._inner.notify(n)
+
+    def notify_all(self):
+        self._inner.notify_all()
+
+
+# Sentinel distinguishing "class had no attribute" from a genuine None
+# class-level default (both must round-trip through uninstall correctly).
+_MISSING = object()
+
+
+class _GuardedDescriptor:
+    """Class-level data descriptor checking lock ownership on watched
+    instances; unwatched instances of the same class pass through.
+    Installed by :meth:`LockTracker.watch_attrs` and REMOVED (prior class
+    attribute restored) by the tracker's uninstall."""
+
+    def __init__(self, attr: str, prior):
+        self.attr = attr
+        self.prior = prior  # _MISSING or the shadowed class attribute
+
+    def __get__(self, obj, objtype=None):
+        if obj is None:
+            return self
+        reg = _WATCHED.get(id(obj))
+        if reg is not None:
+            reg.check(obj, self.attr, "read")
+        try:
+            return obj.__dict__[self.attr]
+        except KeyError:
+            if self.prior is not _MISSING:  # pre-existing class-level default
+                return self.prior
+            raise AttributeError(self.attr) from None
+
+    def __set__(self, obj, value):
+        reg = _WATCHED.get(id(obj))
+        if reg is not None:
+            reg.check(obj, self.attr, "write")
+        obj.__dict__[self.attr] = value
+
+    def __delete__(self, obj):
+        reg = _WATCHED.get(id(obj))
+        if reg is not None:
+            reg.check(obj, self.attr, "write")
+        try:
+            del obj.__dict__[self.attr]
+        except KeyError:
+            raise AttributeError(self.attr) from None
+
+
+# id(instance) -> _WatchEntry; module-level so descriptors can reach it
+# without holding a reference cycle through the tracker.
+_WATCHED: dict[int, "_WatchEntry"] = {}
+
+
+class _WatchEntry:
+    def __init__(self, tracker: "LockTracker", lock, label: str):
+        self.tracker = tracker
+        self.lock = lock
+        self.label = label
+
+    def check(self, obj, attr: str, op: str) -> None:
+        self.tracker._check_guarded(self, obj, attr, op)
+
+
+class LockTracker:
+    """See module docstring.  One instance per install window."""
+
+    def __init__(self):
+        self._mu = _REAL_LOCK()
+        self._tls = threading.local()
+        self.locks: list = []
+        # (src name, dst name) -> first site observed
+        self.edges: dict[tuple[str, str], str] = {}
+        self.acquires = 0
+        self.guarded_checks = 0
+        self._violations: list[Violation] = []
+        self._watch_refs: list = []
+        # (cls, attr) of every descriptor THIS tracker installed, so
+        # uninstall can restore the shadowed class attributes — a leaked
+        # descriptor would keep routing every later instance of the class
+        # through a dead tracker's checks for the rest of the process.
+        self._installed_descriptors: list = []
+
+    # -- lock bookkeeping ----------------------------------------------------
+
+    def _register(self, lk) -> None:
+        with self._mu:
+            self.locks.append(weakref.ref(lk))
+
+    def _held(self) -> list:
+        held = getattr(self._tls, "held", None)
+        if held is None:
+            held = self._tls.held = []
+        return held
+
+    def _note_acquire(self, lk) -> None:
+        held = self._held()
+        site = _call_site()
+        if lk not in held:
+            with self._mu:
+                self.acquires += 1
+                for h in held:
+                    if h is not lk:
+                        self.edges.setdefault((h.name, lk.name), site)
+        held.append(lk)
+
+    def _note_release(self, lk) -> None:
+        held = self._held()
+        for i in range(len(held) - 1, -1, -1):
+            if held[i] is lk:
+                del held[i]
+                return
+
+    def held_by_me(self, lk) -> bool:
+        if isinstance(lk, TrackedCondition):
+            lk = lk._ident()
+        return lk in self._held()
+
+    # -- guarded access ------------------------------------------------------
+
+    def watch_attrs(self, obj, lock, attrs, label: Optional[str] = None):
+        """Install guarded-access checking for ``attrs`` of ``obj`` (which
+        must be guarded by ``lock`` — a tracked Lock/Condition created
+        inside the install window)."""
+        if isinstance(lock, TrackedCondition):
+            lock = lock._ident()
+        if not isinstance(lock, TrackedLock):
+            raise TypeError(
+                "watch_attrs needs a tracked lock (create the watched "
+                "object while the tracker is installed)"
+            )
+        cls = type(obj)
+        for attr in attrs:
+            cur = cls.__dict__.get(attr, _MISSING)
+            if not isinstance(cur, _GuardedDescriptor):
+                setattr(cls, attr, _GuardedDescriptor(attr, cur))
+                self._installed_descriptors.append((cls, attr))
+        entry = _WatchEntry(self, lock, label or cls.__name__)
+        _WATCHED[id(obj)] = entry
+        self._watch_refs.append((weakref.ref(obj, self._unwatch(id(obj))),
+                                 cls, tuple(attrs)))
+        return entry
+
+    @staticmethod
+    def _unwatch(key: int):
+        def cb(_ref):
+            _WATCHED.pop(key, None)
+
+        return cb
+
+    def unwatch_all(self) -> None:
+        """Remove every guarded-access descriptor this tracker installed,
+        restoring the shadowed class attributes (called by uninstall)."""
+        for cls, attr in self._installed_descriptors:
+            desc = cls.__dict__.get(attr)
+            if not isinstance(desc, _GuardedDescriptor):
+                continue  # someone else already replaced it
+            if desc.prior is _MISSING:
+                delattr(cls, attr)
+            else:
+                setattr(cls, attr, desc.prior)
+        self._installed_descriptors.clear()
+        for ref, _cls, _attrs in self._watch_refs:
+            obj = ref()
+            if obj is not None:
+                _WATCHED.pop(id(obj), None)
+        self._watch_refs.clear()
+
+    def _check_guarded(self, entry: _WatchEntry, obj, attr, op) -> None:
+        with self._mu:
+            self.guarded_checks += 1
+        if not self.held_by_me(entry.lock):
+            site = _call_site()
+            with self._mu:
+                self._violations.append(Violation(
+                    "guarded-access",
+                    f"{op} of {entry.label}.{attr} at {site} on thread "
+                    f"{threading.current_thread().name!r} without holding "
+                    f"{entry.lock.name}",
+                ))
+
+    # -- reporting -----------------------------------------------------------
+
+    def cycles(self) -> list[list[str]]:
+        with self._mu:
+            edges = dict(self.edges)
+        adj: dict[str, list[str]] = {}
+        for (src, dst) in edges:
+            adj.setdefault(src, []).append(dst)
+        seen: set = set()
+        out: list[list[str]] = []
+
+        def dfs(start, cur, path, on_path):
+            for nxt in adj.get(cur, ()):
+                if nxt == start:
+                    key = frozenset(path + [nxt])
+                    if key not in seen:
+                        seen.add(key)
+                        out.append(path + [nxt, start])
+                elif nxt not in on_path:
+                    dfs(start, nxt, path + [nxt], on_path | {nxt})
+
+        for node in adj:
+            dfs(node, node, [node], {node})
+        return out
+
+    def violations(self) -> list[Violation]:
+        with self._mu:
+            out = list(self._violations)
+        for cyc in self.cycles():
+            sites = {
+                f"{a}->{b}: {self.edges.get((a, b), '?')}"
+                for a, b in zip(cyc, cyc[1:])
+            }
+            out.append(Violation(
+                "lock-order-cycle",
+                "observed lock-order cycle " + " -> ".join(cyc)
+                + " (" + "; ".join(sorted(sites)) + ")",
+            ))
+        return out
+
+    def assert_clean(self) -> None:
+        bad = self.violations()
+        if bad:
+            raise AssertionError(
+                "graftsync runtime tracker found violations:\n"
+                + "\n".join(f"  [{v.kind}] {v.message}" for v in bad)
+            )
+
+    def summary(self) -> dict:
+        n_cycles = len(self.cycles())  # takes _mu itself: compute first
+        with self._mu:
+            return {
+                "locks": sum(1 for r in self.locks if r() is not None),
+                "acquires": self.acquires,
+                "edges": sorted(f"{a} -> {b}" for (a, b) in self.edges),
+                "guarded_checks": self.guarded_checks,
+                "violations": len(self._violations) + n_cycles,
+            }
+
+
+_INSTALLED: Optional[LockTracker] = None
+
+
+def current() -> Optional[LockTracker]:
+    return _INSTALLED
+
+
+def install(tracker: Optional[LockTracker] = None):
+    """Patch the threading lock factories to produce tracked locks feeding
+    ``tracker``; returns ``(tracker, uninstall)``.  One install at a time."""
+    global _INSTALLED
+    if _INSTALLED is not None:
+        raise RuntimeError("a LockTracker is already installed")
+    tracker = tracker if tracker is not None else LockTracker()
+
+    def make_lock():
+        return TrackedLock(tracker, "Lock")
+
+    def make_rlock():
+        return TrackedLock(tracker, "RLock")
+
+    def make_condition(lock=None):
+        return TrackedCondition(tracker, lock)
+
+    threading.Lock = make_lock
+    threading.RLock = make_rlock
+    threading.Condition = make_condition
+    _INSTALLED = tracker
+
+    def uninstall() -> None:
+        global _INSTALLED
+        threading.Lock = _REAL_LOCK
+        threading.RLock = _REAL_RLOCK
+        threading.Condition = _REAL_CONDITION
+        tracker.unwatch_all()
+        _INSTALLED = None
+
+    return tracker, uninstall
+
+
+def ensure_installed():
+    """The active tracker (env/fixture mode) or a fresh install.  Returns
+    ``(tracker, uninstall)`` where ``uninstall`` is a no-op when reusing an
+    already-installed tracker (its owner uninstalls)."""
+    if _INSTALLED is not None:
+        return _INSTALLED, lambda: None
+    return install()

@@ -43,6 +43,7 @@ from __future__ import annotations
 import contextlib
 import logging
 import os
+import threading
 from typing import Iterator, Optional
 
 from cpgisland_tpu.obs.ledger import (  # noqa: F401  (public re-exports)
@@ -94,6 +95,12 @@ class Observer:
         self.ledger = Ledger()
         self.tracer = Tracer(ledger=self.ledger, on_end=self._on_span_end)
         self.watchdog = Watchdog(mode=watchdog)
+        # Event state behind one lock: serve's transport threads emit
+        # rejection events while the worker loop emits serve_flush and
+        # Session.close emits prepared_evict — the same multi-writer reality
+        # the Ledger lock covers one layer down.  Each critical section is a
+        # few dict/list ops; metrics I/O stays outside it.
+        self._events_lock = threading.Lock()
         self.events: list[dict] = []
         self._event_counts: dict = {}
         self._dropped_events = 0
@@ -162,19 +169,21 @@ class Observer:
         """
         if dedupe:
             key = (name, tuple(sorted(fields.items())))
-            n = self._event_counts.get(key)
-            if n is None and len(self._event_counts) >= self.MAX_DISTINCT_DECISIONS:
-                self._dropped_events += 1
-                return
-            self._event_counts[key] = (n or 0) + 1
+            with self._events_lock:
+                n = self._event_counts.get(key)
+                if n is None and len(self._event_counts) >= self.MAX_DISTINCT_DECISIONS:
+                    self._dropped_events += 1
+                    return
+                self._event_counts[key] = (n or 0) + 1
             if n:
                 return
         cur = self.tracer.current
         rec = {"span": cur.name if cur else None, **fields}
-        if len(self.events) < self.MAX_EVENTS:
-            self.events.append({"event": name, **rec})
-        else:
-            self._dropped_events += 1
+        with self._events_lock:
+            if len(self.events) < self.MAX_EVENTS:
+                self.events.append({"event": name, **rec})
+            else:
+                self._dropped_events += 1
         self.metrics.log(name, **rec)
 
     # -- summary / report ---------------------------------------------------
@@ -207,18 +216,22 @@ class Observer:
         return agg
 
     def _decision_counts(self) -> dict:
+        with self._events_lock:
+            counts = dict(self._event_counts)
         out: dict = {}
-        for (name, fields), n in self._event_counts.items():
+        for (name, fields), n in counts.items():
             label = name + "{" + ", ".join(f"{k}={v}" for k, v in fields) + "}"
             out[label] = n
         return out
 
     def summary(self) -> dict:
+        with self._events_lock:
+            dropped_events = self._dropped_events
         out = {
             "process_index": process_index(),
             "spans": self._span_aggregate(),
             "dropped_spans": self.tracer.dropped,
-            "dropped_events": self._dropped_events,
+            "dropped_events": dropped_events,
             "ledger": self.ledger.totals(),
             "decisions": self._decision_counts(),
             "watchdog_violations": self.watchdog.violations,

@@ -31,6 +31,7 @@ Everything installs/uninstalls explicitly; nothing is patched at import.
 from __future__ import annotations
 
 import contextlib
+import threading
 import time
 from typing import Iterator
 
@@ -46,10 +47,19 @@ class RecompileError(RuntimeError):
 
 
 class Ledger:
-    """Plain host-side counters (no locks: host pipeline code is
-    single-threaded; compile callbacks run on the dispatching thread)."""
+    """Host-side counters behind one ledger lock.
+
+    The serve subsystem made the host side multi-threaded (PR 8: the worker
+    loop dispatches flushes while transport threads encode, submit, and
+    fetch) — compile callbacks, ``note_fetch`` piggybacks, and span snapshot
+    deltas now race without a mutex, and a torn ``+=`` silently undercounts
+    the exact quantities the relay gotchas make load-bearing.  Every
+    mutation and multi-field read takes ``_lock``; each is a few field ops,
+    so ``no_new_compiles``/``note_fetch`` stay cheap on the hot path (one
+    uncontended acquire, no allocation, no device work)."""
 
     def __init__(self) -> None:
+        self._lock = threading.Lock()
         self.compiles = 0
         self.compile_s = 0.0
         self.cache_hits = 0  # persistent compilation-cache hits
@@ -61,55 +71,67 @@ class Ledger:
     # -- recording ----------------------------------------------------------
 
     def record_compile(self, name: str, arg_types: list, secs: float) -> None:
-        self.compiles += 1
-        self.compile_s += secs
-        if len(self.compile_records) < _MAX_COMPILE_RECORDS:
-            self.compile_records.append(
-                {"name": name, "arg_types": arg_types, "secs": round(secs, 4)}
-            )
+        with self._lock:
+            self.compiles += 1
+            self.compile_s += secs
+            if len(self.compile_records) < _MAX_COMPILE_RECORDS:
+                self.compile_records.append(
+                    {"name": name, "arg_types": arg_types,
+                     "secs": round(secs, 4)}
+                )
+
+    def count_cache_hit(self) -> None:
+        with self._lock:
+            self.cache_hits += 1
 
     def count_dispatch(self) -> None:
-        self.dispatches += 1
+        with self._lock:
+            self.dispatches += 1
 
     def count_fetch(self, nbytes: int) -> None:
-        self.dispatches += 1
-        self.fetch_bytes += int(nbytes)
+        with self._lock:
+            self.dispatches += 1
+            self.fetch_bytes += int(nbytes)
 
     def count_upload(self, nbytes: int) -> None:
         # An upload IS a round trip on the relay (and the docstring promises
         # device_put is a counted sync point) — count it as a dispatch too.
-        self.dispatches += 1
-        self.upload_bytes += int(nbytes)
+        with self._lock:
+            self.dispatches += 1
+            self.upload_bytes += int(nbytes)
 
     # -- span attribution ---------------------------------------------------
 
     def snapshot(self) -> tuple:
-        return (
-            self.compiles,
-            self.compile_s,
-            self.dispatches,
-            self.fetch_bytes,
-            self.upload_bytes,
-        )
+        with self._lock:
+            return (
+                self.compiles,
+                self.compile_s,
+                self.dispatches,
+                self.fetch_bytes,
+                self.upload_bytes,
+            )
 
     def delta(self, snap: tuple) -> dict:
-        return {
-            "compiles": self.compiles - snap[0],
-            "compile_s": round(self.compile_s - snap[1], 4),
-            "dispatches": self.dispatches - snap[2],
-            "fetch_bytes": self.fetch_bytes - snap[3],
-            "upload_bytes": self.upload_bytes - snap[4],
-        }
+        with self._lock:
+            return {
+                "compiles": self.compiles - snap[0],
+                "compile_s": round(self.compile_s - snap[1], 4),
+                "dispatches": self.dispatches - snap[2],
+                "fetch_bytes": self.fetch_bytes - snap[3],
+                "upload_bytes": self.upload_bytes - snap[4],
+            }
 
     def totals(self) -> dict:
-        return {
-            "compiles": self.compiles,
-            "compile_s": round(self.compile_s, 4),
-            "cache_hits": self.cache_hits,
-            "dispatches": self.dispatches,
-            "fetch_bytes": self.fetch_bytes,
-            "upload_bytes": self.upload_bytes,
-        }
+        with self._lock:
+            return {
+                "compiles": self.compiles,
+                "compile_s": round(self.compile_s, 4),
+                "cache_hits": self.cache_hits,
+                "dispatches": self.dispatches,
+                "fetch_bytes": self.fetch_bytes,
+                "upload_bytes": self.upload_bytes,
+            }
 
 
 def _tree_nbytes(x) -> int:
@@ -185,7 +207,7 @@ def install(ledger: Ledger, compile_only: bool = False):
 
     def _on_event(event: str, **kw) -> None:
         if state["live"] and event == "/jax/compilation_cache/cache_hits":
-            ledger.cache_hits += 1
+            ledger.count_cache_hit()
 
     jax.monitoring.register_event_listener(_on_event)
 

@@ -44,6 +44,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import threading
 import time
 import weakref
 from collections import OrderedDict
@@ -345,8 +346,18 @@ def check_seq(
 # recycled id() can never alias a dead entry, and content never needs
 # hashing.  Bounded FIFO — each entry pins its prep arrays (comparable in
 # size to the input) on device, so the bound is deliberately small.
+#
+# Thread contract: the cache is process-global and the serve daemon reaches
+# it from several threads (the worker loop's flushes, transport threads
+# calling broker.stats(), Session.close() dropping a tenant), so every
+# _cache/_stats access holds _CACHE_LOCK.  Prep BUILDS run OUTSIDE the lock
+# (a jitted build dispatches device work — holding the lock would serialize
+# every concurrent session behind one tenant's compile); a build raced by
+# another thread keeps the first-published entry, so handed-out preps never
+# silently diverge in identity.
 
 _CACHE_MAX = 8
+_CACHE_LOCK = threading.RLock()
 # key -> (weakrefs of keyed arrays, prep tree, resident bytes of the prep)
 _cache: "OrderedDict[tuple, tuple]" = OrderedDict()
 _stats = {
@@ -367,16 +378,18 @@ def cache_stats() -> dict:
     plus current occupancy: ``entries`` and ``resident_bytes`` (the summed
     size of all cached prep trees — comparable to the inputs they were
     built from, so a serving daemon watches this through the obs report)."""
-    out = dict(_stats)
-    out["entries"] = len(_cache)
-    out["resident_bytes"] = sum(ent[2] for ent in _cache.values())
+    with _CACHE_LOCK:
+        out = dict(_stats)
+        out["entries"] = len(_cache)
+        out["resident_bytes"] = sum(ent[2] for ent in _cache.values())
     return out
 
 
 def clear_cache() -> None:
-    _cache.clear()
-    for k in _stats:
-        _stats[k] = 0
+    with _CACHE_LOCK:
+        _cache.clear()
+        for k in _stats:
+            _stats[k] = 0
 
 
 def evict(*arrays) -> int:
@@ -388,46 +401,59 @@ def evict(*arrays) -> int:
     NOW, not at the next unrelated miss.  Returns the number of entries
     evicted; emits one ``prepared_evict`` obs event when anything dropped.
     """
-    # Entries whose keyed inputs already died can't be matched by id (a
-    # dropped tenant's arrays are usually GC'd BEFORE Session.close()
-    # calls here) — sweep them now rather than at the next unrelated
-    # miss, or a quiet daemon would hold their prep trees indefinitely.
-    _sweep_dead()
     ids = {id(a) for a in arrays}
-    dead = [k for k in _cache if ids.intersection(k[2])]
-    nbytes = 0
-    for k in dead:
-        nbytes += _cache[k][2]
-        del _cache[k]
+    with _CACHE_LOCK:
+        # Entries whose keyed inputs already died can't be matched by id (a
+        # dropped tenant's arrays are usually GC'd BEFORE Session.close()
+        # calls here) — sweep them now rather than at the next unrelated
+        # miss, or a quiet daemon would hold their prep trees indefinitely.
+        _sweep_dead_locked()
+        dead = [k for k in _cache if ids.intersection(k[2])]
+        nbytes = 0
+        for k in dead:
+            nbytes += _cache[k][2]
+            del _cache[k]
+        if dead:
+            _stats["evictions_explicit"] += len(dead)
     if dead:
-        _stats["evictions_explicit"] += len(dead)
         obs_mod.event(
             "prepared_evict", entries=len(dead), bytes_released=nbytes
         )
     return len(dead)
 
 
-def _sweep_dead() -> None:
+def _sweep_dead_locked() -> None:
     """Drop entries whose keyed input arrays died: their prep trees (often
-    input-sized, device-resident) must not wait for capacity eviction."""
+    input-sized, device-resident) must not wait for capacity eviction.
+    Caller holds _CACHE_LOCK (the ``_locked`` suffix convention)."""
     dead = [k for k, ent in _cache.items() if any(r() is None for r in ent[0])]
     for k in dead:
         del _cache[k]
     _stats["evictions_dead"] += len(dead)
 
 
+def _entry_live(ent, arrays) -> bool:
+    return ent is not None and all(r() is a for r, a in zip(ent[0], arrays))
+
+
 def _cached(kind: str, arrays: tuple, skey: tuple, build):
     key = (kind, skey, tuple(id(a) for a in arrays))
-    ent = _cache.get(key)
-    if ent is not None and all(r() is a for r, a in zip(ent[0], arrays)):
-        _cache.move_to_end(key)
-        _stats["hits"] += 1
+    with _CACHE_LOCK:
+        ent = _cache.get(key)
+        if _entry_live(ent, arrays):
+            _cache.move_to_end(key)
+            _stats["hits"] += 1
+            hit = ent[1]
+        else:
+            if ent is not None:  # id recycled onto a new array — stale entry
+                del _cache[key]
+                _stats["evictions_dead"] += 1
+            _sweep_dead_locked()
+            hit = None
+    if hit is not None:
         obs_mod.event("prepared_streams", kind=kind, hit=True)
-        return ent[1]
-    if ent is not None:  # id recycled onto a new array — stale entry
-        del _cache[key]
-        _stats["evictions_dead"] += 1
-    _sweep_dead()
+        return hit
+    # Build OUTSIDE the lock (see the thread-contract note above).
     t0 = time.perf_counter()
     prep = build()
     prep_ms = (time.perf_counter() - t0) * 1e3
@@ -435,15 +461,26 @@ def _cached(kind: str, arrays: tuple, skey: tuple, build):
         int(getattr(leaf, "nbytes", 0))
         for leaf in jax.tree_util.tree_leaves(prep)
     )
-    _stats["misses"] += 1
+    with _CACHE_LOCK:
+        _stats["misses"] += 1
+        cur = _cache.get(key)
+        if _entry_live(cur, arrays):
+            # Another session built this entry while we did: keep the
+            # FIRST-published prep (it may already be in use downstream) and
+            # drop ours — no lost entries, no double insert.
+            _cache.move_to_end(key)
+            prep = cur[1]
+        else:
+            _cache[key] = (
+                tuple(weakref.ref(a) for a in arrays), prep, nbytes
+            )
+            while len(_cache) > _CACHE_MAX:
+                _cache.popitem(last=False)
+                _stats["evictions_capacity"] += 1
     obs_mod.event(
         "prepared_streams", kind=kind, hit=False,
         bytes_resident=nbytes, prep_ms=round(prep_ms, 2), key=repr(skey),
     )
-    _cache[key] = (tuple(weakref.ref(a) for a in arrays), prep, nbytes)
-    while len(_cache) > _CACHE_MAX:
-        _cache.popitem(last=False)
-        _stats["evictions_capacity"] += 1
     return prep
 
 

@@ -69,14 +69,15 @@ class EngineBreaker:
         # record dispatches; keep the tiny state transitions atomic.
         self._lock = threading.Lock()
 
-    def _st(self, engine: str) -> _EngineState:
+    def _st_locked(self, engine: str) -> _EngineState:
+        # _locked suffix: callers hold self._lock (the graftsync convention).
         return self._state.setdefault(engine, _EngineState())
 
     # -- accounting (fed by the dispatch supervisor) -------------------------
 
     def record_fault(self, engine: str, error: Optional[BaseException] = None) -> None:
         with self._lock:
-            st = self._st(engine)
+            st = self._st_locked(engine)
             st.consecutive_faults += 1
             if st.tripped_at is not None:
                 if st.half_open:

@@ -24,8 +24,17 @@ This package composes the ingredients earlier PRs built for exactly this:
   overlaps device compute of flush n (the RecordPrefetcher pattern, with
   the admission caps as the bounded queue).
 - :mod:`~cpgisland_tpu.serve.transport` — the thin **wire layer**
-  (stdin/stdout or local-socket JSONL), kept separate from the broker so
-  tests (and the graftcheck contract) drive the broker in-process.
+  (stdin/stdout JSONL, or the multi-connection AF_UNIX socket mux:
+  concurrent client connections, one reader thread each, results routed
+  back to the owning connection by request id), kept separate from the
+  broker so tests (and the graftcheck contract) drive the broker
+  in-process.
+
+Thread contract (machine-checked by graftsync, LINT.md Layer 4): any
+thread may submit; ONE worker loop executes flushes; every shared field
+is guarded by its owner's lock, lock nesting follows the global order
+(router -> connection; session -> breaker), and nothing blocks while
+holding a registered lock.
 
 Import note: this package pulls in jax via the pipeline — the CLI imports
 it lazily inside the ``serve`` subcommand, after platform selection.

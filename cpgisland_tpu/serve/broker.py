@@ -217,10 +217,9 @@ class RequestBroker:
         name: str = "",
     ) -> None:
         """Admit one request (raises :class:`Backpressure` on queue caps,
-        ValueError on malformed requests).  Results are delivered by the
-        flush-executing consumer (:meth:`flush_once` / the worker loop)."""
-        if self._closed:
-            raise RuntimeError("broker is closed")
+        RuntimeError once closed, ValueError on malformed requests).
+        Results are delivered by the flush-executing consumer
+        (:meth:`flush_once` / the worker loop)."""
         if kind not in KINDS:
             raise ValueError(f"kind must be one of {KINDS}, got {kind!r}")
         symbols = np.ascontiguousarray(symbols, dtype=np.uint8)
@@ -236,6 +235,11 @@ class RequestBroker:
             symbols=symbols, t_submit=time.monotonic(),
         )
         with self._cv:
+            # Closed-check under the cv: _closed is written under it in
+            # close(), and an unlocked read could admit a request into a
+            # queue nothing will ever drain again.
+            if self._closed:
+                raise RuntimeError("broker is closed")
             t = self._tenants.setdefault(req.tenant, _Tenant())
             if self.manifest is not None:
                 if req.id in self._seen_ids:
@@ -311,7 +315,8 @@ class RequestBroker:
         admitted-but-unserved symbols are waiting.  The transport mirrors
         this to clients so well-behaved ones slow down BEFORE hitting the
         hard tenant caps."""
-        return self._queued_symbols > 2 * self.config.flush_symbols
+        with self._lock:
+            return self._queued_symbols > 2 * self.config.flush_symbols
 
     # -- flush policy --------------------------------------------------------
 
@@ -424,14 +429,16 @@ class RequestBroker:
                     if not r.ok:
                         self._seen_ids.discard(r.id)
         with self._lock:
+            # Tenant accounting under the broker lock: submit (a transport
+            # thread) mutates the same _Tenant rows concurrently with this
+            # consumer-side tally — unlocked, the read-modify-writes tear.
             for r in results:
                 self._inflight_ids.discard(r.id)
-        for r in results:
-            t = self._tenants.setdefault(r.tenant, _Tenant())
-            t.results += 1
-            if not r.replayed:
-                t.symbols += r.n_symbols
-                t.wall_s += r.serve_s
+                t = self._tenants.setdefault(r.tenant, _Tenant())
+                t.results += 1
+                if not r.replayed:
+                    t.symbols += r.n_symbols
+                    t.wall_s += r.serve_s
         return results
 
     @staticmethod
@@ -553,8 +560,9 @@ class RequestBroker:
                 except Exception as e:
                     fail(req, e)
         wall = time.perf_counter() - t0
-        self.flushes += 1
-        self.flushed_symbols += int(total)
+        with self._lock:
+            self.flushes += 1
+            self.flushed_symbols += int(total)
         obs.event(
             "serve_flush", n_requests=len(batch), n_flat=len(flat),
             n_singles=len(singles), n_posterior=len(posts),
@@ -714,9 +722,11 @@ class RequestBroker:
         with self._lock:
             queued = len(self._queue)
             qsym = self._queued_symbols
+            flushes = self.flushes
+            flushed_symbols = self.flushed_symbols
         return {
-            "flushes": self.flushes,
-            "flushed_symbols": self.flushed_symbols,
+            "flushes": flushes,
+            "flushed_symbols": flushed_symbols,
             "queued_requests": queued,
             "queued_symbols": qsym,
             "backpressure": self.backpressure(),
@@ -736,4 +746,5 @@ class RequestBroker:
 
     @property
     def closed(self) -> bool:
-        return self._closed
+        with self._lock:
+            return self._closed

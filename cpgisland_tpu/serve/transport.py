@@ -1,10 +1,23 @@
-"""Thin wire layer: JSONL over stdin/stdout or a local (Unix) socket.
+"""Thin wire layer: JSONL over stdin/stdout or a local (Unix) socket mux.
 
 Kept deliberately separate from the broker so tests and the graftcheck
 contract drive the broker in-process; this module only parses lines,
 encodes sequence text to symbols (on the transport thread — that host
 work is exactly what overlaps the worker's device compute), and writes
 result lines.
+
+Socket mode is a **multi-connection mux** (the ROADMAP response-muxing
+item): each client connection gets its own reader thread (parse + encode +
+admission), all feeding the ONE broker whose single worker loop executes
+flushes — the single-dispatcher rule is preserved because only the
+:class:`ResponseRouter` sits between the worker and the sockets.  Every
+result is routed back to the connection that submitted its request id;
+request ids therefore share one daemon-wide space, and concurrent clients
+must use disjoint id ranges (a colliding id is rejected at admission like
+any duplicate).  Per-connection drain-on-death is preserved by routing: a
+dead client's admitted requests still complete (keeping the shared queue
+clean) and their results are dropped with a log line — never flushed into
+another client's stream.
 
 ## Protocol (one JSON object per line)
 
@@ -46,6 +59,7 @@ from __future__ import annotations
 import json
 import logging
 import threading
+import time
 from typing import IO
 
 import numpy as np
@@ -55,7 +69,13 @@ from cpgisland_tpu.serve.worker import ServeLoop
 
 log = logging.getLogger(__name__)
 
-__all__ = ["result_to_wire", "serve_stream", "serve_main"]
+__all__ = [
+    "result_to_wire",
+    "serve_stream",
+    "serve_socket",
+    "serve_main",
+    "ResponseRouter",
+]
 
 
 def result_to_wire(r: ServeResult, *, backpressure: bool = False,
@@ -99,6 +119,59 @@ def _parse_request(line: str) -> dict:
     return req
 
 
+def _admit_request(
+    req: dict,
+    broker: RequestBroker,
+    *,
+    invalid_symbols: str,
+    write,
+    claim,
+    unclaim,
+) -> None:
+    """The shared parse -> encode -> claim -> submit core of both the stdio
+    stream and the socket mux (ONE copy, so the two transports cannot
+    drift).  ``claim(rid, req)`` registers delivery state (the stream's
+    want_conf flag / the mux route) BEFORE submit — the worker may deliver
+    the result immediately after submit returns — and may raise ValueError
+    to reject the request itself; ``unclaim(rid)`` rolls that registration
+    back when submit rejects, so a refused request can't leak state onto a
+    later reuse of its id.  Rejections (including a RuntimeError from a
+    broker another client already shut down) become machine-readable error
+    lines; the echoed id is the parsed rid when one exists, else the raw
+    field."""
+    from cpgisland_tpu.utils import codec
+
+    rid = None
+    try:
+        rid = int(req["id"])
+        kind = req["kind"]
+        symbols = codec.encode(req["seq"], invalid=invalid_symbols)
+        claim(rid, req)
+        try:
+            broker.submit(
+                request_id=rid,
+                tenant=str(req.get("tenant", "default")),
+                kind=kind,
+                symbols=symbols,
+                name=str(req.get("name", f"req{rid}")),
+            )
+        except BaseException:
+            unclaim(rid)
+            raise
+    except Backpressure as e:
+        write({
+            "id": rid if rid is not None else req.get("id"), "ok": False,
+            "error": f"Backpressure: {e}", "reason": e.reason,
+            "backpressure": True,
+        })
+    except (KeyError, ValueError, TypeError, RuntimeError) as e:
+        write({
+            "id": rid if rid is not None else req.get("id"), "ok": False,
+            "error": f"{type(e).__name__}: {e}",
+            "backpressure": broker.backpressure(),
+        })
+
+
 def serve_stream(
     inp: IO[str],
     out: IO[str],
@@ -116,16 +189,27 @@ def serve_stream(
     reports ready, and the stream drains at EOF.  Returns the number of
     requests served.
     """
-    from cpgisland_tpu.utils import codec
-
     wlock = threading.Lock()
     served = [0]
     want_conf: dict[int, bool] = {}
+    # Single-slot rollback state: claim/unclaim run back-to-back on THIS
+    # thread inside one _admit_request call (never concurrently).
+    pending_new_flag = [False]
 
     def write(obj: dict) -> None:
         with wlock:
             out.write(json.dumps(obj) + "\n")
             out.flush()
+
+    def flag_claim(rid: int, req: dict) -> None:
+        wants = bool(req.get("want_conf"))
+        pending_new_flag[0] = wants and not want_conf.get(rid, False)
+        if wants:
+            want_conf[rid] = True
+
+    def flag_unclaim(rid: int) -> None:
+        if pending_new_flag[0]:
+            want_conf.pop(rid, None)
 
     def on_result(r: ServeResult) -> None:
         served[0] += 1
@@ -155,47 +239,15 @@ def serve_stream(
             if op == "stats":
                 write({"ok": True, "stats": broker.stats()})
                 continue
-            try:
-                rid = int(req["id"])
-                kind = req["kind"]
-                seq = req["seq"]
-                # Host-side encode on THIS thread — the work that overlaps
-                # the worker loop's device compute.
-                symbols = codec.encode(seq, invalid=invalid_symbols)
-                # Flag BEFORE submit (the worker thread may deliver the
-                # result immediately after submit returns), but roll back
-                # on rejection so a refused id can't leak the flag onto a
-                # later reuse of that id.  Only THIS request's flag is
-                # rolled back: a rejected duplicate id must not clobber
-                # the flag an earlier still-queued request set.
-                this_wants = bool(req.get("want_conf"))
-                had_flag = want_conf.get(rid, False)
-                if this_wants:
-                    want_conf[rid] = True
-                try:
-                    broker.submit(
-                        request_id=rid,
-                        tenant=str(req.get("tenant", "default")),
-                        kind=kind,
-                        symbols=symbols,
-                        name=str(req.get("name", f"req{rid}")),
-                    )
-                except BaseException:
-                    if this_wants and not had_flag:
-                        want_conf.pop(rid, None)
-                    raise
-            except Backpressure as e:
-                write({
-                    "id": req.get("id"), "ok": False,
-                    "error": f"Backpressure: {e}", "reason": e.reason,
-                    "backpressure": True,
-                })
-            except (KeyError, ValueError, TypeError) as e:
-                write({
-                    "id": req.get("id"), "ok": False,
-                    "error": f"{type(e).__name__}: {e}",
-                    "backpressure": broker.backpressure(),
-                })
+            # Host-side encode + submit on THIS thread (the work that
+            # overlaps the worker loop's device compute) via the shared
+            # core.  claim sets the want_conf flag; unclaim rolls back
+            # only the flag THIS request set, so a rejected duplicate id
+            # can't clobber the flag an earlier still-queued request set.
+            _admit_request(
+                req, broker, invalid_symbols=invalid_symbols, write=write,
+                claim=flag_claim, unclaim=flag_unclaim,
+            )
             if loop is None:
                 while broker.flush_ready():
                     for r in broker.flush_once():
@@ -252,8 +304,9 @@ def _build_broker(args, params) -> RequestBroker:
 
 def serve_main(args, params) -> int:
     """The ``cpgisland serve`` entry: stdio JSONL by default, a local
-    AF_UNIX socket server with ``--socket PATH`` (one JSONL connection at
-    a time per client thread, all feeding the one broker)."""
+    AF_UNIX multi-connection socket mux with ``--socket PATH`` (concurrent
+    client connections, all feeding the one broker; responses routed back
+    to the owning connection by request id)."""
     import sys
 
     broker = _build_broker(args, params)
@@ -265,51 +318,380 @@ def serve_main(args, params) -> int:
             )
             log.info("serve: %d request(s) served", n)
             return 0
-        return _serve_socket(args, broker)
+        return serve_socket(
+            args.socket, broker, invalid_symbols=args.invalid_symbols
+        )
     finally:
         broker.close()
 
 
-def _serve_socket(args, broker: RequestBroker) -> int:
-    """Sequential AF_UNIX JSONL server: one client connection at a time,
-    each served by :func:`serve_stream` against the ONE warm broker — the
-    broker's flush-executing consumer must stay single (same rule as the
-    pipeline supervisor), and serial connections keep that invariant
-    without a response-routing mux.  The daemon stays warm across
-    connections; ``{"op": "shutdown"}`` from any client stops the server
-    after its stream drains."""
+# ---------------------------------------------------------------------------
+# Multi-connection socket mux
+
+
+class _MuxClient:
+    """One connection's write side: a JSONL stream serialized by its own
+    condition (reader-thread error/stats lines interleave with worker-thread
+    results), an outstanding-request count for drain-on-death, and an alive
+    flag flipped when the socket breaks.  All three fields are guarded by
+    ``_cond``; socket writes happen under it too — that lock exists to
+    serialize this connection's writes, and nothing else is ever acquired
+    under it (a leaf in the lock-order graph)."""
+
+    def __init__(self, cid: int, wf) -> None:
+        self.cid = cid
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._wf = wf
+        self._alive = True
+        self._outstanding = 0
+        self._served = 0
+
+    def add_pending(self) -> None:
+        with self._cond:
+            self._outstanding += 1
+
+    def fail_pending(self) -> None:
+        """Retire a pending slot whose submit was rejected (no result will
+        ever be delivered for it)."""
+        with self._cond:
+            self._outstanding -= 1
+            self._cond.notify_all()
+
+    def write_payload(self, obj: dict) -> bool:
+        """Write one non-result line (errors, stats); False once dead."""
+        with self._cond:
+            return self._write_locked(obj)
+
+    def write_result(self, obj: dict) -> bool:
+        """Write one routed result line and retire its pending slot.  The
+        slot retires even when the write fails — a dead client must not
+        wedge its reader thread's drain wait."""
+        with self._cond:
+            ok = self._write_locked(obj)
+            if ok:
+                self._served += 1
+            self._outstanding -= 1
+            self._cond.notify_all()
+            return ok
+
+    def _write_locked(self, obj: dict) -> bool:
+        if not self._alive:
+            return False
+        try:
+            self._wf.write(json.dumps(obj) + "\n")
+            self._wf.flush()
+            return True
+        except (OSError, ValueError):
+            # Broken pipe / closed makefile: the connection is gone.  Keep
+            # serving (results for it are dropped by callers with a log).
+            self._alive = False
+            self._cond.notify_all()
+            return False
+
+    def mark_dead(self) -> None:
+        with self._cond:
+            self._alive = False
+            self._cond.notify_all()
+
+    @property
+    def alive(self) -> bool:
+        with self._cond:
+            return self._alive
+
+    @property
+    def served(self) -> int:
+        with self._cond:
+            return self._served
+
+    def wait_drained(self, timeout_s: float) -> bool:
+        """Block until every routed request of this connection has been
+        delivered (or the connection died); the reader thread's last act
+        before closing the socket, so a client that EOFs its write side
+        still receives all of its results."""
+        deadline = time.monotonic() + timeout_s
+        with self._cond:
+            while self._outstanding > 0 and self._alive:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return False
+                self._cond.wait(remaining)
+            return self._outstanding <= 0
+
+
+class ResponseRouter:
+    """Request-id -> connection routing (the mux core).
+
+    Reader threads :meth:`route` an id to their connection BEFORE
+    submitting it to the broker (results can arrive immediately after
+    ``submit`` returns); the worker loop delivers every flush result
+    through :meth:`deliver`, which looks up and retires the route.  Routes
+    for a dead connection deliver into a log line instead of a socket —
+    requests are never re-queued into another client's stream.
+    """
+
+    def __init__(self, broker: RequestBroker) -> None:
+        self.broker = broker
+        self._lock = threading.Lock()
+        self._routes: dict[int, tuple] = {}  # rid -> (client, want_conf)
+        self.dropped = 0
+
+    def route(self, rid: int, client: _MuxClient, want_conf: bool) -> bool:
+        """Claim ``rid`` for ``client``; False when the id is already in
+        flight (the existing route — and its want_conf flag — is left
+        untouched, mirroring the broker's duplicate-id rejection)."""
+        with self._lock:
+            if rid in self._routes:
+                return False
+            self._routes[rid] = (client, want_conf)
+        client.add_pending()
+        return True
+
+    def unroute(self, rid: int, client: _MuxClient) -> None:
+        """Roll back a claim whose submit was rejected; only the claiming
+        client's route is removed (a racing re-claim keeps its own)."""
+        with self._lock:
+            ent = self._routes.get(rid)
+            if ent is None or ent[0] is not client:
+                return
+            del self._routes[rid]
+        client.fail_pending()
+
+    def deliver(self, r: ServeResult) -> None:
+        """ServeLoop's on_result: route one result to its connection.
+        Never raises — an undeliverable result is logged and dropped, not
+        allowed to kill the worker loop or starve the rest of the flush."""
+        with self._lock:
+            ent = self._routes.pop(r.id, None)
+        if ent is None:
+            with self._lock:
+                self.dropped += 1
+            log.warning(
+                "serve mux: dropping result for request %s (no live route "
+                "— connection closed before submit completed?)", r.id,
+            )
+            return
+        client, want_conf = ent
+        try:
+            wire = result_to_wire(
+                r, backpressure=self.broker.backpressure(),
+                want_conf=want_conf,
+            )
+        except Exception:
+            log.exception("serve mux: encoding result %s failed", r.id)
+            wire = {"id": r.id, "ok": False,
+                    "error": "InternalError: result encoding failed"}
+        if not client.write_result(wire):
+            log.warning(
+                "serve mux: dropping result for request %s (connection %d "
+                "closed)", r.id, client.cid,
+            )
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"in_flight": len(self._routes), "dropped": self.dropped}
+
+
+def _mux_read_loop(
+    client: _MuxClient,
+    rf,
+    broker: RequestBroker,
+    router: ResponseRouter,
+    invalid_symbols: str,
+) -> None:
+    """One connection's reader: parse + encode + route + submit (the
+    shared ``_admit_request`` core with the router as the claim).  Pure
+    host work on this thread (the overlap with the worker loop's device
+    compute, same as stdio mode)."""
+
+    def route_claim(rid: int, req: dict) -> None:
+        if not router.route(rid, client, bool(req.get("want_conf"))):
+            raise ValueError(
+                f"request id {rid} is already in flight on this daemon "
+                "— concurrent connections share one id space; use "
+                "disjoint id ranges per client"
+            )
+
+    def route_unclaim(rid: int) -> None:
+        router.unroute(rid, client)
+
+    for line in rf:
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            req = _parse_request(line)
+        except (ValueError, json.JSONDecodeError) as e:
+            client.write_payload({"ok": False, "error": f"bad request line: {e}"})
+            continue
+        op = req.get("op")
+        if op == "shutdown":
+            # Stop admission daemon-wide; everything already admitted is
+            # still served (the accept loop watches broker.closed).
+            broker.close()
+            return
+        if op == "stats":
+            stats = broker.stats()
+            stats["mux"] = router.stats()
+            client.write_payload({"ok": True, "stats": stats})
+            continue
+        _admit_request(
+            req, broker, invalid_symbols=invalid_symbols,
+            write=client.write_payload,
+            claim=route_claim, unclaim=route_unclaim,
+        )
+
+
+def _mux_client_thread(
+    client: _MuxClient,
+    conn,
+    rf,
+    broker: RequestBroker,
+    router: ResponseRouter,
+    invalid_symbols: str,
+    drain_timeout_s: float,
+) -> None:
+    try:
+        _mux_read_loop(client, rf, broker, router, invalid_symbols)
+    except OSError:
+        log.info("serve mux: connection %d dropped mid-read", client.cid)
+    except Exception:
+        log.exception("serve mux: connection %d reader failed", client.cid)
+    finally:
+        # Drain-on-death, per connection: everything this client submitted
+        # still completes and flows back here before the socket closes (a
+        # client that EOF'd its write side is still reading).
+        if not client.wait_drained(drain_timeout_s):
+            log.warning(
+                "serve mux: connection %d closed with undelivered results "
+                "(drain timeout %.0f s)", client.cid, drain_timeout_s,
+            )
+        client.mark_dead()
+        # Close the write-side makefile too: an unclosed wf holds a socket
+        # io-ref, so conn.close() would defer the real close and the fd
+        # would live until the accept loop reaps this connection.
+        for closer in (rf, client._wf, conn):
+            try:
+                closer.close()
+            except (OSError, ValueError):
+                pass
+
+
+def _set_send_timeout(conn, seconds: float) -> None:
+    """Bound every send on an accepted connection (``SO_SNDTIMEO``): the
+    ONE worker thread writes results under the owning connection's lock,
+    so a client that stops reading must FAIL its write (and be marked
+    dead, its later results dropped) instead of wedging result delivery
+    for every other connection — the mux twin of the blocking-under-lock
+    rule, below the layer the AST can see.  Send-side only: the reader
+    thread's blocking recv on an idle-but-healthy client must NOT time
+    out."""
+    import socket
+    import struct
+
+    sec = int(seconds)
+    try:
+        conn.setsockopt(
+            socket.SOL_SOCKET, socket.SO_SNDTIMEO,
+            struct.pack("ll", sec, int((seconds - sec) * 1e6)),
+        )
+    except (OSError, AttributeError):
+        log.warning(
+            "serve mux: could not set a send timeout on this platform; a "
+            "client that stops reading may stall result delivery"
+        )
+
+
+def serve_socket(
+    path: str,
+    broker: RequestBroker,
+    *,
+    invalid_symbols: str = "skip",
+    backlog: int = 8,
+    accept_poll_s: float = 0.5,
+    drain_timeout_s: float = 600.0,
+    write_timeout_s: float = 60.0,
+) -> int:
+    """Concurrent AF_UNIX JSONL server (see the module docstring's mux
+    notes): one reader thread per client connection, ONE worker loop
+    executing flushes against the shared broker, results routed back by
+    request id.  ``{"op": "shutdown"}`` from any client stops the server
+    after everything admitted has been served.  ``write_timeout_s`` bounds
+    each result write (a non-reading client is marked dead rather than
+    allowed to stall the worker)."""
     import os
     import socket
 
-    path = args.socket
+    router = ResponseRouter(broker)
+    loop = ServeLoop(broker, router.deliver).start()
+    conns: list[tuple] = []  # LIVE (thread, client, conn); dead are reaped
+    n_served = 0
     if os.path.exists(path):
         os.unlink(path)
     srv = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
     srv.bind(path)
-    srv.listen(8)
-    srv.settimeout(0.5)
-    log.info("serve: listening on %s (JSONL; send {\"op\": \"shutdown\"} "
-             "to stop)", path)
+    srv.listen(backlog)
+    srv.settimeout(accept_poll_s)
+    log.info(
+        "serve: listening on %s (JSONL mux, concurrent connections; send "
+        "{\"op\": \"shutdown\"} to stop)", path,
+    )
+    n_conns = 0
     try:
         while not broker.closed:
             try:
                 conn, _ = srv.accept()
             except socket.timeout:
                 continue
-            with conn:
-                rf = conn.makefile("r", encoding="utf-8")
-                wf = conn.makefile("w", encoding="utf-8")
-                try:
-                    serve_stream(
-                        rf, wf, broker, use_worker=True,
-                        invalid_symbols=args.invalid_symbols,
-                    )
-                except Exception:
-                    log.exception("serve: client connection failed")
+            # Reap finished connections (their own finally closed the
+            # sockets) so a long-lived daemon doesn't accumulate dead
+            # thread/socket objects per served client.
+            live = []
+            for ent in conns:
+                if ent[0].is_alive():
+                    live.append(ent)
+                else:
+                    n_served += ent[1].served
+            conns = live
+            n_conns += 1
+            _set_send_timeout(conn, write_timeout_s)
+            client = _MuxClient(n_conns, conn.makefile("w", encoding="utf-8"))
+            rf = conn.makefile("r", encoding="utf-8")
+            t = threading.Thread(
+                target=_mux_client_thread,
+                args=(client, conn, rf, broker, router, invalid_symbols,
+                      drain_timeout_s),
+                name=f"cpgisland-serve-conn{n_conns}",
+                daemon=True,
+            )
+            conns.append((t, client, conn))
+            t.start()
     except KeyboardInterrupt:
         pass
     finally:
+        broker.close()
+        loop.stop()
+        # Serve everything already admitted; routed results reach their
+        # (still-reading) owners, dead routes are dropped with a log.
+        for r in broker.drain():
+            router.deliver(r)
+        for t, client, conn in conns:
+            client.mark_dead()
+            try:
+                conn.shutdown(socket.SHUT_RDWR)  # unblock a parked reader
+            except OSError:
+                pass
+            t.join(timeout=10.0)
+            try:
+                conn.close()
+            except OSError:
+                pass
         srv.close()
         if os.path.exists(path):
             os.unlink(path)
+        n_served += sum(c.served for _t, c, _conn in conns)
+        log.info(
+            "serve: socket mux served %d connection(s), %d result(s) "
+            "delivered", n_conns, n_served,
+        )
     return 0

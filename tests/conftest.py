@@ -110,6 +110,7 @@ _TPU_SKIP_ALLOWED = tuple(re.compile(p) for p in (
     r"host-callback probe failed",            # jax host-callback capability
     r"no driver BENCH_r\*\.json present",     # artifact presence
     r"capture r\d+ is newer than the driver record",
+    r"session-wide LockTracker active",   # CPGISLAND_TRACKSYNC=1 runs
 ))
 
 
@@ -135,6 +136,25 @@ def pytest_runtest_makereport(item, call):
                 "artifact-presence); add the new class there with a "
                 "justification or unskip the test"
             )
+
+
+@pytest.fixture(scope="session", autouse=True)
+def _tracksync_session_tracker():
+    """``CPGISLAND_TRACKSYNC=1``: run the whole suite under the graftsync
+    runtime lock tracker (analysis/tracksync.py) — every lock created
+    during the session is order-recorded, and the session FAILS at teardown
+    on any observed lock-order cycle or guarded-access violation.  Opt-in:
+    the wrappers cost a few percent of suite wall, and the per-test mux
+    stress installs its own tracker when this one is absent."""
+    if os.environ.get("CPGISLAND_TRACKSYNC") != "1":
+        yield
+        return
+    from cpgisland_tpu.analysis import tracksync
+
+    tracker, uninstall = tracksync.install()
+    yield
+    uninstall()
+    tracker.assert_clean()
 
 
 @pytest.fixture

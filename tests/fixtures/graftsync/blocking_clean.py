@@ -1,0 +1,45 @@
+"""sync-blocking-under-lock clean twin: the prepared-cache discipline —
+blocking work runs OUTSIDE the critical section, the lock only publishes
+the result."""
+
+import queue
+import socket
+import threading
+import time
+
+import jax
+
+
+class Fetcher:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._q = queue.Queue()
+        self._sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        self._last = None
+
+    def fetch(self, x):
+        got = jax.block_until_ready(x)  # fetch outside the lock...
+        with self._lock:
+            self._last = got  # ...publish under it
+            return self._last
+
+    def push(self, item) -> None:
+        self._q.put(item)
+
+    def read_wire(self) -> bytes:
+        data = self._sock.recv(4096)
+        with self._lock:
+            self._last = data
+        return data
+
+    def nap(self) -> None:
+        time.sleep(0.1)
+
+    def indirect(self, x):
+        got = self._fetch_unlocked(x)
+        with self._lock:
+            self._last = got
+        return got
+
+    def _fetch_unlocked(self, x):
+        return jax.device_get(x)

@@ -1,0 +1,42 @@
+"""sync-blocking-under-lock trigger: device fetches, queue ops, socket
+I/O, sleeps, and a blocking helper call — all inside held critical
+sections."""
+
+import queue
+import socket
+import threading
+import time
+
+import jax
+
+
+class Fetcher:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._q = queue.Queue()
+        self._sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        self._last = None
+
+    def fetch(self, x):
+        with self._lock:
+            self._last = jax.block_until_ready(x)  # device fetch under lock
+            return self._last
+
+    def push(self, item) -> None:
+        with self._lock:
+            self._q.put(item)  # blocking queue op under lock
+
+    def read_wire(self) -> bytes:
+        with self._lock:
+            return self._sock.recv(4096)  # socket I/O under lock
+
+    def nap(self) -> None:
+        with self._lock:
+            time.sleep(0.1)  # sleep under lock
+
+    def indirect(self, x):
+        with self._lock:
+            return self._fetch_unlocked(x)  # helper that blocks, under lock
+
+    def _fetch_unlocked(self, x):
+        return jax.device_get(x)

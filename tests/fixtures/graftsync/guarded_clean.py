@@ -1,0 +1,44 @@
+"""sync-guarded-by clean twin: every access to guarded state holds the
+lock (or returns a copy taken under it)."""
+
+import threading
+
+_stats_lock = threading.Lock()
+_totals = {"n": 0}
+
+
+def bump_total(k: int) -> None:
+    with _stats_lock:
+        _totals["n"] = _totals["n"] + k
+
+
+def read_total() -> int:
+    with _stats_lock:
+        return _totals["n"]
+
+
+class Counter:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._count = 0
+        self._events: list = []
+
+    def bump(self) -> None:
+        with self._lock:
+            self._count += 1
+            self._events.append("bump")
+
+    def peek(self) -> int:
+        with self._lock:
+            return self._count
+
+    def reset(self) -> None:
+        with self._lock:
+            self._count = 0
+            self._events.clear()
+
+    def _drain_locked(self) -> list:
+        # The _locked-suffix convention: callers hold self._lock.
+        out = list(self._events)
+        self._events.clear()
+        return out

@@ -1,0 +1,35 @@
+"""sync-guarded-by trigger: attributes written under a lock, then read or
+written elsewhere without it — the half-guarded-field lost-update shape."""
+
+import threading
+
+_stats_lock = threading.Lock()
+_totals = {"n": 0}
+
+
+def bump_total(k: int) -> None:
+    with _stats_lock:
+        _totals["n"] = _totals["n"] + k
+
+
+def read_total() -> int:
+    return _totals["n"]  # unguarded read of a module global written under lock
+
+
+class Counter:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._count = 0
+        self._events: list = []
+
+    def bump(self) -> None:
+        with self._lock:
+            self._count += 1
+            self._events.append("bump")
+
+    def peek(self) -> int:
+        return self._count  # unguarded read
+
+    def reset(self) -> None:
+        self._count = 0  # unguarded write
+        self._events.clear()  # unguarded container mutation

@@ -1,0 +1,34 @@
+"""sync-lock-order clean twin: one global order (A before B) everywhere,
+and the inner helper uses the _locked-suffix convention instead of
+re-acquiring."""
+
+import threading
+
+
+class Pair:
+    def __init__(self) -> None:
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+
+    def forward(self) -> None:
+        with self._a:
+            with self._b:
+                pass
+
+    def backward(self) -> None:
+        with self._a:  # same order as forward(): A -> B
+            with self._b:
+                pass
+
+
+class Recurse:
+    def __init__(self) -> None:
+        self._mu = threading.Lock()
+
+    def outer(self) -> None:
+        with self._mu:
+            self._inner_locked()
+
+    def _inner_locked(self) -> None:
+        # Runs with self._mu held by the caller; takes nothing itself.
+        pass

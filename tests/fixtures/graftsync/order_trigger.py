@@ -1,0 +1,34 @@
+"""sync-lock-order trigger: two locks acquired in opposite orders (the
+classic AB/BA static deadlock) plus a non-reentrant self-acquisition
+through a helper call."""
+
+import threading
+
+
+class Pair:
+    def __init__(self) -> None:
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+
+    def forward(self) -> None:
+        with self._a:
+            with self._b:
+                pass
+
+    def backward(self) -> None:
+        with self._b:
+            with self._a:  # inverted: B -> A while forward() takes A -> B
+                pass
+
+
+class Recurse:
+    def __init__(self) -> None:
+        self._mu = threading.Lock()
+
+    def outer(self) -> None:
+        with self._mu:
+            self.inner()  # re-acquires the plain Lock it already holds
+
+    def inner(self) -> None:
+        with self._mu:
+            pass

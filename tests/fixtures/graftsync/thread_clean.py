@@ -1,0 +1,37 @@
+"""sync-thread-lifecycle clean twin: daemonized thread with an owned stop
+Event, a deterministic join, and a generator-close path on shutdown (the
+prefetch._finish pattern)."""
+
+import threading
+
+
+def _close_iter(it) -> None:
+    close = getattr(it, "close", None)
+    if close is not None:
+        close()
+
+
+class Runner:
+    def __init__(self) -> None:
+        self._sink: list = []
+        self._stop = threading.Event()
+        self._it = None
+        self._t = None
+
+    def start(self, it) -> None:
+        self._it = it
+        self._t = threading.Thread(target=self._run, daemon=True)
+        self._t.start()
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self._sink.append(next(self._it))
+            except StopIteration:
+                return
+
+    def shutdown(self) -> None:
+        self._stop.set()
+        if self._t is not None:
+            self._t.join()
+        _close_iter(self._it)

@@ -1,0 +1,23 @@
+"""sync-thread-lifecycle trigger: a non-daemon thread with no stop Event
+and no join, whose target drains an iterator this file never closes (the
+PR 5 prefetcher leak shape)."""
+
+import threading
+
+
+def _producer(it, sink) -> None:
+    while True:
+        try:
+            sink.append(next(it))  # drains a generator forever
+        except StopIteration:
+            return
+
+
+class Runner:
+    def __init__(self) -> None:
+        self._sink: list = []
+        self._t = None
+
+    def start(self, it) -> None:
+        self._t = threading.Thread(target=_producer, args=(it, self._sink))
+        self._t.start()

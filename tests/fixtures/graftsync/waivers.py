@@ -1,0 +1,25 @@
+"""graftsync waiver fixture: one properly waived unguarded read (inline
+form), one waiver missing its reason (does NOT waive), one stale waiver
+covering nothing."""
+
+import threading
+
+
+class Metrics:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._n = 0
+
+    def bump(self) -> None:
+        with self._lock:
+            self._n += 1
+
+    def peek_waived(self) -> int:
+        return self._n  # graftcheck: allow(sync-guarded-by) -- approximate display read: a torn int is impossible on CPython and the value is advisory
+
+    def peek_unwaived(self) -> int:
+        return self._n  # graftcheck: allow(sync-guarded-by)
+
+    def stale(self) -> int:
+        # graftcheck: allow(sync-lock-order) -- nothing here acquires two locks
+        return 1

@@ -1,0 +1,597 @@
+"""Layer-4 (graftsync) unit tests: one triggering and one clean fixture
+per concurrency rule, waiver forms, the unguarded/blocking registries, the
+``--sync`` CLI exit-code contract, the runtime lock tracker (lock-order
+recording, guarded-access descriptors, condition aliasing), and the two
+real concurrency fixes this layer certified in-code — the multi-threaded
+obs ledger and the locked prepared-stream cache — each hammered by real
+threads.
+
+The lint-layer and tracker tests touch no jax; the prepared-cache hammer
+uses numpy-backed preps (the cache is content-agnostic).
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+
+import numpy as np
+import pytest
+
+from cpgisland_tpu.analysis import all_rules, lint_file, synccheck, tracksync
+from cpgisland_tpu.analysis.config import (
+    sync_blocking_ok_for,
+    sync_unguarded_for,
+)
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "fixtures", "graftsync")
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+RULES = [
+    ("sync-guarded-by", "guarded"),
+    ("sync-lock-order", "order"),
+    ("sync-blocking-under-lock", "blocking"),
+    ("sync-thread-lifecycle", "thread"),
+]
+
+
+def _lint(name: str):
+    path = os.path.join(FIXTURES, f"{name}.py")
+    return lint_file(path, relpath=os.path.relpath(path, REPO))
+
+
+@pytest.mark.parametrize("rule,stem", RULES, ids=[r for r, _ in RULES])
+def test_rule_fires_on_trigger(rule, stem):
+    findings, _ = _lint(f"{stem}_trigger")
+    hits = [f for f in findings if f.rule == rule and not f.waived]
+    assert hits, f"{rule} did not fire on its trigger fixture"
+
+
+@pytest.mark.parametrize("rule,stem", RULES, ids=[r for r, _ in RULES])
+def test_rule_quiet_on_clean(rule, stem):
+    findings, _ = _lint(f"{stem}_clean")
+    hits = [f for f in findings if f.rule == rule]
+    assert hits == [], [f.format() for f in hits]
+
+
+def test_guarded_by_names_attr_and_lock():
+    findings, _ = _lint("guarded_trigger")
+    msgs = "\n".join(
+        f.message for f in findings if f.rule == "sync-guarded-by"
+    )
+    # The findings name the offending attribute AND its guarding lock.
+    assert "self._count" in msgs and "Counter._lock" in msgs
+    assert "_totals" in msgs and "_stats_lock" in msgs
+    # Reads, writes, and container mutations are all distinguished.
+    assert "read of 'self._count'" in msgs
+    assert "write to 'self._count'" in msgs
+    assert "write to 'self._events'" in msgs
+
+
+def test_lock_order_names_cycle_and_self_deadlock():
+    findings, _ = _lint("order_trigger")
+    msgs = [f.message for f in findings if f.rule == "sync-lock-order"]
+    cyc = [m for m in msgs if "lock-order cycle" in m]
+    assert cyc and "Pair._a" in cyc[0] and "Pair._b" in cyc[0]
+    assert "acquisition sites" in cyc[0]
+    slf = [m for m in msgs if "non-reentrant" in m]
+    assert slf and "Recurse._mu" in slf[0] and "Recurse.inner" in slf[0]
+
+
+def test_blocking_flags_every_banned_class():
+    findings, _ = _lint("blocking_trigger")
+    msgs = "\n".join(
+        f.message for f in findings if f.rule == "sync-blocking-under-lock"
+    )
+    for spelling in (
+        "jax.block_until_ready", "self._q.put", ".recv()", "time.sleep",
+        "_fetch_unlocked",  # the depth-1 callee expansion
+    ):
+        assert spelling in msgs, f"missing {spelling} in:\n{msgs}"
+    assert "Fetcher._lock" in msgs  # the held lock is named
+
+
+def test_thread_lifecycle_flags_both_halves():
+    findings, _ = _lint("thread_trigger")
+    msgs = [
+        f.message for f in findings if f.rule == "sync-thread-lifecycle"
+    ]
+    assert any("neither daemonized nor deterministically joined" in m
+               for m in msgs)
+    assert any("drains an iterator" in m for m in msgs)
+
+
+def test_queue_and_str_methods_do_not_false_positive():
+    # dict.get / str.join / list "put-like" names on attributes the model
+    # does NOT know to be queues/threads must not fire the blocking rule.
+    import textwrap
+
+    from cpgisland_tpu.analysis.core import FileContext
+
+    src = textwrap.dedent(
+        """
+        import threading
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._d = {}
+                self._parts = []
+
+            def ok(self, k):
+                with self._lock:
+                    v = self._d.get(k)
+                    s = ",".join(str(p) for p in self._parts)
+                    return v, s
+        """
+    )
+    ctx = FileContext("<mem>", src, relpath="mem.py")
+    rule = all_rules()["sync-blocking-under-lock"]
+    assert list(rule.check(ctx)) == []
+
+
+# -- waivers -----------------------------------------------------------------
+
+
+def test_sync_waiver_forms():
+    findings, waivers = _lint("waivers")
+    gb = [f for f in findings if f.rule == "sync-guarded-by"]
+    waived = [f for f in gb if f.waived]
+    unwaived = [f for f in gb if not f.waived]
+    assert len(waived) == 1 and waived[0].waiver_reason
+    assert len(unwaived) == 1  # the missing-reason waiver does NOT waive
+    assert any(f.rule == "waiver-syntax" for f in findings)
+    stale = [w for w in waivers if not w.used]
+    assert any("sync-lock-order" in w.rules for w in stale)
+
+
+# -- registries --------------------------------------------------------------
+
+
+def test_unguarded_registry_matches_repo_layout():
+    ent = sync_unguarded_for("cpgisland_tpu/utils/native.py")
+    assert "_lib" in ent and "double-checked" in ent["_lib"]
+    assert sync_unguarded_for("cpgisland_tpu/models/hmm.py") == {}
+
+
+def test_blocking_ok_registry_matches_repo_layout():
+    ent = sync_blocking_ok_for("cpgisland_tpu/utils/native.py")
+    assert "load" in ent and "leaf" in ent["load"]
+    assert sync_blocking_ok_for("cpgisland_tpu/serve/broker.py") == {}
+
+
+def test_all_four_sync_rules_registered():
+    names = set(all_rules())
+    for rule, _ in RULES:
+        assert rule in names, rule
+
+
+# -- the cross-module graph on fixture inputs --------------------------------
+
+
+def test_run_sync_reports_fixture_cycle():
+    rep = synccheck.run_sync(
+        [os.path.join(FIXTURES, "order_trigger.py")], base=REPO
+    )
+    assert not rep.ok
+    kinds = [f.message for f in rep.findings]
+    assert any("lock-order cycle" in m for m in kinds)
+    assert any("non-reentrant" in m for m in kinds)
+    # The summary payload carries the locks and edges for the report.
+    s = rep.summary()
+    assert any("Pair._a" in lk for lk in s["locks"])
+    assert any("->" in e for e in s["edges"])
+
+
+def test_run_sync_module_locked_convention_carries_held_set(tmp_path):
+    """A module-level ``_locked`` function runs with the module lock(s)
+    held (prepared._sweep_dead_locked's convention) — its acquires must
+    enter the graph as acquires-while-holding edges, or a cycle through a
+    module-level helper is invisible to the deadlock check."""
+    mod = tmp_path / "mod.py"
+    mod.write_text(
+        "import threading\n"
+        "_A = threading.Lock()\n"
+        "_B = threading.Lock()\n"
+        "def takes_b_then_a():\n"
+        "    with _B:\n"
+        "        with _A:\n"
+        "            pass\n"
+        "def helper_locked():\n"
+        "    with _B:\n"
+        "        pass\n"
+    )
+    rep = synccheck.run_sync([str(mod)], base=str(tmp_path))
+    assert not rep.ok
+    assert any("lock-order cycle" in f.message for f in rep.findings), [
+        f.format() for f in rep.findings
+    ]
+    edges = {(e.src.label, e.dst.label) for e in rep.edges}
+    assert ("mod.py::_A", "mod.py::_B") in edges, sorted(edges)
+
+
+def test_run_sync_clean_on_clean_fixtures():
+    rep = synccheck.run_sync(
+        [os.path.join(FIXTURES, "order_clean.py"),
+         os.path.join(FIXTURES, "guarded_clean.py")], base=REPO,
+    )
+    assert rep.ok, [f.format() for f in rep.findings]
+    assert rep.files_checked == 2
+
+
+# -- CLI ---------------------------------------------------------------------
+
+
+def _run_cli(*args):
+    return subprocess.run(
+        [sys.executable, "-m", "cpgisland_tpu.analysis", *args],
+        capture_output=True, text=True, cwd=REPO,
+    )
+
+
+def test_cli_exits_nonzero_on_each_sync_trigger():
+    for _, stem in RULES:
+        proc = _run_cli(os.path.join(FIXTURES, f"{stem}_trigger.py"))
+        assert proc.returncode == 1, (stem, proc.stdout, proc.stderr)
+
+
+def test_cli_sync_pass_fails_on_cycle_naming_locks():
+    proc = _run_cli(
+        "--no-lint", "--sync", "--json",
+        os.path.join(FIXTURES, "order_trigger.py"),
+    )
+    assert proc.returncode == 1, (proc.stdout, proc.stderr)
+    payload = json.loads(proc.stdout)
+    assert payload["ok"] is False
+    viol = "\n".join(payload["sync"]["violations"])
+    assert "Pair._a" in viol and "Pair._b" in viol
+
+
+def test_cli_sync_pass_clean_fixture_exits_zero():
+    proc = _run_cli(
+        "--no-lint", "--sync", os.path.join(FIXTURES, "order_clean.py")
+    )
+    assert proc.returncode == 0, (proc.stdout, proc.stderr)
+
+
+def test_cli_list_rules_includes_sync_rules():
+    proc = _run_cli("--list-rules")
+    assert proc.returncode == 0
+    for rule, _ in RULES:
+        assert rule in proc.stdout
+
+
+# -- the runtime tracker -----------------------------------------------------
+
+
+@pytest.fixture()
+def tracker():
+    # These unit tests assert exact edge/violation counts on a private
+    # tracker; under CPGISLAND_TRACKSYNC=1 the session-wide one owns the
+    # factories instead.
+    if tracksync.current() is not None:
+        pytest.skip("session-wide LockTracker active (CPGISLAND_TRACKSYNC=1)")
+    tr, uninstall = tracksync.install()
+    try:
+        yield tr
+    finally:
+        uninstall()
+
+
+def test_tracker_records_order_and_cycle(tracker):
+    a = threading.Lock()
+    b = threading.Lock()
+    assert isinstance(a, tracksync.TrackedLock)
+    with a:
+        with b:
+            pass
+    with b:
+        with a:
+            pass
+    assert tracker.acquires == 4
+    cycles = tracker.cycles()
+    assert cycles, "AB/BA order was observed but no cycle reported"
+    with pytest.raises(AssertionError, match="lock-order-cycle"):
+        tracker.assert_clean()
+
+
+def test_tracker_clean_on_consistent_order(tracker):
+    a = threading.Lock()
+    b = threading.Lock()
+    for _ in range(3):
+        with a:
+            with b:
+                pass
+    tracker.assert_clean()
+    s = tracker.summary()
+    assert s["violations"] == 0 and len(s["edges"]) == 1
+
+
+def test_tracker_condition_aliases_to_its_lock(tracker):
+    # Condition(lock) shares the mutex: `with cv` then `with other` must
+    # record the edge FROM THE LOCK, not from a distinct cv identity.
+    lk = threading.Lock()
+    cv = threading.Condition(lk)
+    other = threading.Lock()
+    with cv:
+        with other:
+            pass
+    with lk:
+        pass  # same identity: no self-edge, no second node
+    edges = list(tracker.edges)
+    assert len(edges) == 1
+    src, dst = edges[0]
+    assert src == lk.name and dst == other.name
+    assert cv.name == lk.name
+
+
+def test_tracker_cv_wait_releases_in_bookkeeping(tracker):
+    lk = threading.Lock()
+    cv = threading.Condition(lk)
+    hits = []
+
+    def waiter():
+        with cv:
+            cv.wait_for(lambda: bool(hits), timeout=5.0)
+
+    t = threading.Thread(target=waiter, daemon=True)
+    t.start()
+    import time
+
+    time.sleep(0.05)
+    with cv:  # acquirable because wait released the mutex
+        hits.append(1)
+        cv.notify_all()
+    t.join(timeout=5.0)
+    assert not t.is_alive()
+    tracker.assert_clean()
+
+
+def test_tracker_guarded_access_descriptor(tracker):
+    class Obj:
+        pass
+
+    o = Obj()
+    lk = threading.Lock()
+    o.x = 0  # pre-watch write is untracked
+    tracker.watch_attrs(o, lk, ["x"], label="Obj")
+    with lk:
+        o.x = 1
+        assert o.x == 1
+    assert tracker.violations() == []
+    o.x = 2  # unguarded write
+    _ = o.x  # unguarded read
+    bad = tracker.violations()
+    assert len(bad) == 2
+    assert all(v.kind == "guarded-access" for v in bad)
+    assert "Obj.x" in bad[0].message
+    with pytest.raises(AssertionError, match="guarded-access"):
+        tracker.assert_clean()
+
+
+def test_tracker_guarded_access_other_thread_violates(tracker):
+    class Obj:
+        pass
+
+    o = Obj()
+    lk = threading.Lock()
+    tracker.watch_attrs(o, lk, ["y"], label="Obj")
+
+    def writer():
+        o.y = 7  # no lock held ON THIS THREAD
+
+    t = threading.Thread(target=writer, daemon=True)
+    with lk:  # holding it HERE does not cover the other thread
+        t.start()
+        t.join(5.0)
+    bad = [v for v in tracker.violations() if v.kind == "guarded-access"]
+    assert bad and "thread" in bad[0].message
+
+
+def test_tracker_install_uninstall_restores_factories():
+    if tracksync.current() is not None:
+        pytest.skip("session-wide LockTracker active (CPGISLAND_TRACKSYNC=1)")
+    real = threading.Lock
+    tr, uninstall = tracksync.install()
+    assert threading.Lock is not real
+    assert tracksync.current() is tr
+    with pytest.raises(RuntimeError):
+        tracksync.install()
+    uninstall()
+    assert threading.Lock is real
+    assert tracksync.current() is None
+
+
+def test_tracker_uninstall_removes_guarded_descriptors():
+    """watch_attrs rewires CLASS attributes; uninstall must restore them —
+    a leaked descriptor would route every later instance of the class
+    through a dead tracker for the rest of the process — including a
+    genuine ``None`` class default, which must survive the round trip."""
+    if tracksync.current() is not None:
+        pytest.skip("session-wide LockTracker active (CPGISLAND_TRACKSYNC=1)")
+
+    class Obj:
+        y = None  # genuine None default, not "missing"
+
+    tr, uninstall = tracksync.install()
+    try:
+        o = Obj()
+        lk = threading.Lock()
+        tr.watch_attrs(o, lk, ["x", "y"], label="Obj")
+        assert isinstance(Obj.__dict__["x"], tracksync._GuardedDescriptor)
+        o2 = Obj()
+        assert o2.y is None  # default readable through the descriptor
+        with lk:
+            o.x = 1
+            del o.x  # __delete__ path works while watched
+            o.x = 2
+    finally:
+        uninstall()
+    assert "x" not in Obj.__dict__  # missing attr removed outright
+    assert Obj.__dict__["y"] is None  # None default restored, not dropped
+    assert o.x == 2  # instance state written during the window survives
+
+
+# -- the two in-code fixes, hammered -----------------------------------------
+
+
+def test_ledger_counters_exact_under_threads():
+    """The obs ledger fix: concurrent count_* / record_compile / snapshot
+    callers must never tear a read-modify-write (ledger.py used to document
+    single-threaded hosts; the serve daemon broke that)."""
+    from cpgisland_tpu.obs.ledger import Ledger
+
+    led = Ledger()
+    N_THREADS, N_ITER = 8, 2000
+    start = threading.Barrier(N_THREADS)
+
+    def worker(i):
+        start.wait()
+        for k in range(N_ITER):
+            led.count_dispatch()
+            led.count_fetch(3)
+            led.count_upload(5)
+            if k % 100 == 0:
+                led.record_compile(f"w{i}", [], 0.001)
+            led.delta(led.snapshot())  # multi-field reads interleave
+
+    ts = [threading.Thread(target=worker, args=(i,)) for i in range(N_THREADS)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    tot = led.totals()
+    per = N_ITER * N_THREADS
+    assert tot["dispatches"] == 3 * per  # dispatch + fetch + upload each count
+    assert tot["fetch_bytes"] == 3 * per
+    assert tot["upload_bytes"] == 5 * per
+    assert tot["compiles"] == N_THREADS * (N_ITER // 100)
+
+
+def test_observer_events_exact_under_threads():
+    """The Observer event-state fix: serve's transport threads emit
+    rejection events while the worker loop emits serve_flush — deduped
+    counts, retained events, and the drop counter must stay exact (the
+    same multi-writer reality the Ledger lock covers one layer down)."""
+    from cpgisland_tpu import obs
+
+    N_THREADS, N_ITER = 8, 500
+    start = threading.Barrier(N_THREADS)
+
+    def worker(i):
+        start.wait()
+        for k in range(N_ITER):
+            obs.event("hammer_plain", thread=i, k=k)
+            obs.event("hammer_dedupe", _dedupe=True, bucket=k % 4)
+
+    with obs.observe() as o:
+        ts = [threading.Thread(target=worker, args=(i,))
+              for i in range(N_THREADS)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        retained = sum(
+            1 for e in o.events if e["event"] == "hammer_plain"
+        )
+        deduped = sum(
+            1 for e in o.events if e["event"] == "hammer_dedupe"
+        )
+        summary = o.summary()
+    total_plain = N_THREADS * N_ITER
+    # Dedupe counts are exact per bucket and only the FIRST occurrence of
+    # each payload was retained as an event line.
+    decisions = {
+        k: v for k, v in summary["decisions"].items()
+        if k.startswith("hammer_dedupe")
+    }
+    assert len(decisions) == 4 and deduped == 4
+    assert sum(decisions.values()) == total_plain
+    # Nothing tore: every plain emit was retained (well under MAX_EVENTS)
+    # and no drop was phantom-counted.
+    assert retained == total_plain
+    assert summary["dropped_events"] == 0
+
+
+def test_prepared_cache_concurrent_sessions_no_lost_entries():
+    """The prepared-cache fix: concurrent sessions hammering get/insert/
+    evict/cache_stats must lose no entries, double-count no evictions, and
+    publish ONE prep per key (first build wins; racers adopt it)."""
+    from cpgisland_tpu.ops import prepared
+
+    prepared.clear_cache()
+    N_SESS = 6
+    N_ITER = 40
+    arrays = [np.arange(16, dtype=np.float32) + i for i in range(N_SESS)]
+    builds = [0] * N_SESS
+    got: list = [[] for _ in range(N_SESS)]
+    start = threading.Barrier(N_SESS)
+
+    def session(i):
+        start.wait()
+        arr = arrays[i]
+        for k in range(N_ITER):
+            def build():
+                builds[i] += 1
+                return [np.full(8, i, np.float32)]
+
+            prep = prepared._cached("fixture", (arr,), ("s", i), build)
+            got[i].append(prep)
+            if k % 10 == 9:
+                prepared.cache_stats()  # stats reader interleaves
+            if k % 17 == 16:
+                prepared.evict(arr)  # explicit eviction interleaves
+
+    ts = [threading.Thread(target=session, args=(i,)) for i in range(N_SESS)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    stats = prepared.cache_stats()
+    # Every get returned a prep carrying the right content (no cross-key
+    # leakage, no half-built entries).
+    for i in range(N_SESS):
+        for prep in got[i]:
+            assert prep[0][0] == i
+    # Accounting adds up exactly: every call was a hit or a miss.
+    assert stats["hits"] + stats["misses"] == N_SESS * N_ITER
+    # Explicit evictions each dropped at most one live entry and were
+    # counted once (no double-evict of the same key).
+    assert stats["evictions_explicit"] <= N_SESS * (N_ITER // 17)
+    assert stats["entries"] <= prepared._CACHE_MAX
+    prepared.clear_cache()
+
+
+def test_prepared_cache_single_publish_per_key():
+    """Racing builders on the SAME key: exactly one prep object is ever
+    handed out once published (the first-published entry wins)."""
+    from cpgisland_tpu.ops import prepared
+
+    prepared.clear_cache()
+    arr = np.arange(32, dtype=np.float32)
+    N = 8
+    start = threading.Barrier(N)
+    out: list = [None] * N
+
+    def racer(i):
+        def build():
+            return [np.full(4, 42, np.float32)]
+
+        start.wait()
+        out[i] = prepared._cached("fixture", (arr,), ("same",), build)
+
+    ts = [threading.Thread(target=racer, args=(i,)) for i in range(N)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    stats = prepared.cache_stats()
+    assert stats["entries"] == 1
+    # All racers that found the published entry share ONE object identity.
+    published = [p for p in out if p is not None]
+    cached = prepared._cached(
+        "fixture", (arr,), ("same",), lambda: pytest.fail("must hit")
+    )
+    assert sum(1 for p in published if p is cached) >= 1
+    prepared.clear_cache()

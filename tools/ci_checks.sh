@@ -20,6 +20,14 @@ echo "== graftcost: quantitative cost contracts + COSTS.json diff (CPU trace) ==
 # graph change.
 python -m cpgisland_tpu.analysis --no-lint --costs
 
+echo "== graftsync: Layer-4 cross-module lock-order graph =="
+# The per-file concurrency rules (sync-guarded-by / sync-lock-order /
+# sync-blocking-under-lock / sync-thread-lifecycle) already ran inside the
+# lint gate above; --sync adds the cross-module acquires-while-holding
+# graph — a cycle is a static deadlock that would freeze the serve daemon
+# AND strand in-flight TPU dispatches behind held locks.
+python -m cpgisland_tpu.analysis --no-lint --sync
+
 echo "== syntax gate =="
 python -m compileall -q cpgisland_tpu tools tests bench.py __graft_entry__.py
 
@@ -73,5 +81,14 @@ echo "== serve smoke (broker vs batch pipelines, transport, restart) =="
 # manifest restart, and the JSONL transport.  (The contract pass above
 # already pins serve.flush.dispatch-stable.)
 python -m pytest tests/test_serve.py -q
+
+echo "== graftsync slice: rule fixtures, tracker, threaded serve-mux stress =="
+# Layer 4's own tests (planted deadlock/unguarded-access fixtures must each
+# FAIL naming the offending locks/attributes; repo self-scan + lock graph
+# stay pinned), then the multi-connection socket mux under the runtime
+# tracker: 4 concurrent clients, mixed decode+posterior, bit-identical per
+# client, zero observed lock-order or guarded-access violations.
+python -m pytest tests/test_graftsync.py tests/test_graftsync_self.py \
+  tests/test_serve_mux.py -q
 
 echo "ci_checks: all gates green"

@@ -71,13 +71,17 @@ def main() -> int:
                     help="spawn mode: forwarded to the daemon (-P)")
     ap.add_argument("--stats", action="store_true",
                     help="also request and print broker stats at the end")
+    ap.add_argument("--id-base", type=int, default=0,
+                    help="first request id (the mux daemon's id space is "
+                    "daemon-wide: concurrent clients must use disjoint "
+                    "ranges, e.g. --id-base 1000 / 2000)")
     args = ap.parse_args()
 
     kind = "posterior" if args.posterior else "decode"
     requests = [
         json.dumps({
-            "id": i, "kind": kind, "tenant": args.tenant,
-            "name": name or f"rec{i}", "seq": seq,
+            "id": args.id_base + i, "kind": kind, "tenant": args.tenant,
+            "name": name or f"rec{args.id_base + i}", "seq": seq,
         })
         for i, (name, seq) in enumerate(iter_fasta_text(args.fasta))
     ]
