@@ -489,6 +489,91 @@ def _em_seq_contract(onehot: bool, **kw) -> Contract:
     )
 
 
+def _pair_obs(n: int, seeds=(0, 1)):
+    """Pair-recoded observation pair for the order-2 family entries (prev
+    threaded so the first position is real — the reduced engines' entry
+    contract)."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from cpgisland_tpu.utils import codec
+
+    out = []
+    for s in seeds:
+        r = np.random.default_rng(s)
+        base = r.integers(0, 4, size=n + 1).astype(np.uint8)
+        out.append(jnp.asarray(
+            codec.recode_pairs(base[1:], prev=int(base[0])).astype(np.int32)
+        ))
+    return tuple(out)
+
+
+def _decode_family_contract() -> Contract:
+    """decode.family.dinuc_cpg: the order-2 dinucleotide member through the
+    REDUCED engine — the family layer's generalization claim as a traced
+    contract (16 blocks of 2; the same pass triple as decode.onehot, off-TPU
+    it must trace to the XLA twins)."""
+
+    def make(scale: int = 1):
+        from cpgisland_tpu.models import presets
+        from cpgisland_tpu.ops.viterbi_parallel import viterbi_parallel
+
+        params = presets.dinuc_cpg()
+        o1, o2 = _pair_obs(2048 * scale)
+        fn = lambda o: viterbi_parallel(
+            params, o, block_size=256, return_score=True, engine="onehot"
+        )
+        return fn, (o1,), (o2,)
+
+    return Contract(
+        name="decode.family.dinuc_cpg", make=make,
+        expect_pallas_on_tpu=True, base_symbols=2048,
+    )
+
+
+def _fb_family_contract() -> Contract:
+    """fb.family.dinuc_cpg: the dinucleotide member's forward-backward
+    (posterior marginals) — K=32 exceeds the fused kernels' state envelope,
+    so this entry pins the DENSE XLA route it takes on every backend
+    (no pallas anywhere, f64/callback-free, dispatch-stable)."""
+
+    def make(scale: int = 1):
+        import jax.numpy as jnp
+
+        from cpgisland_tpu.models import presets
+        from cpgisland_tpu.ops.forward_backward import posterior_marginals
+
+        params = presets.dinuc_cpg()
+        o1, o2 = _pair_obs(2048 * scale)
+        fn = lambda o: posterior_marginals(params, o)[0]
+        return fn, (o1,), (o2,)
+
+    return Contract(
+        name="fb.family.dinuc_cpg", make=make, base_symbols=2048,
+        stability=True,
+    )
+
+
+def _compare_loglik_contract() -> Contract:
+    """compare.loglik: the comparison workload's scoring pass
+    (forward_backward.sequence_loglik) — per-model log-odds are differences
+    of this program's outputs, so it must stay f64/callback-free and
+    dispatch-stable across same-shape records."""
+
+    def make(scale: int = 1):
+        from cpgisland_tpu.models import presets
+        from cpgisland_tpu.ops.forward_backward import sequence_loglik
+
+        params = presets.durbin_cpg8()
+        o1, o2 = _obs_pair(2048 * scale, "int32")
+        fn = lambda o: sequence_loglik(params, o)
+        return fn, (o1,), (o2,)
+
+    return Contract(
+        name="compare.loglik", make=make, base_symbols=2048, stability=True,
+    )
+
+
 def _mstep_contract() -> Contract:
     def make(scale: int = 1):
         import jax.numpy as jnp
@@ -533,6 +618,12 @@ def default_contracts() -> list[Contract]:
         _em_chunked_contract("onehot", expect_pallas_on_tpu=True),
         _em_seq_contract(True, expect_pallas_on_tpu=True),
         _mstep_contract(),
+        # Model-family entries: the order-2 dinucleotide member through the
+        # reduced decode engine + its dense FB route, and the comparison
+        # workload's scoring pass (family.compare).
+        _decode_family_contract(),
+        _fb_family_contract(),
+        _compare_loglik_contract(),
     ]
 
 

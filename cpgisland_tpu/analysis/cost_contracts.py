@@ -80,6 +80,10 @@ REFERENCE_T = 16 * 2**20  # the size-curve's 16 Mi knee (BASELINE.md)
 EXPECTED_PASSES = {
     "decode.xla": 3,
     "decode.onehot": 3,
+    # The family generalization: the order-2 dinucleotide member keeps the
+    # flagship's 3-pass reduced decode structure (same pass triple, bigger
+    # pair table — family.partition_of).
+    "decode.family.dinuc_cpg": 3,
     "decode.batch_flat.onehot": 3,
     "decode.batch_flat.scores.onehot": 3,
     "posterior.onehot": 2,

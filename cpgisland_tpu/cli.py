@@ -29,7 +29,7 @@ from typing import Optional, Sequence
 
 log = logging.getLogger(__name__)
 
-_SUBCOMMANDS = ("train", "decode", "posterior", "run", "serve")
+_SUBCOMMANDS = ("train", "decode", "posterior", "compare", "run", "serve")
 
 
 def _select_platform(argv: list) -> list:
@@ -224,6 +224,47 @@ def build_parser() -> argparse.ArgumentParser:
     _add_symbol_cache_flag(po)
     po.add_argument("-v", "--verbose", action="store_true")
 
+    cp = sub.add_parser(
+        "compare",
+        help="multi-model posterior comparison: N family members over one "
+        "FASTA stream — per-model log-odds vs a baseline, per-model "
+        "islands, and a per-position winning-model track in the reference "
+        "island format (clean/FASTA semantics)",
+    )
+    cp.add_argument("test_file")
+    cp.add_argument(
+        "--models",
+        default="durbin8,two_state,null",
+        help="comma-separated family members: built-in names "
+        "(durbin8,two_state,dinuc_cpg,null,null16) and/or NAME=MODEL.txt "
+        "entries (loaded model text; island states inferred for 2M-state "
+        "layouts).  Default: the 3-model cast durbin8,two_state,null",
+    )
+    cp.add_argument("--out", required=True, help="comparison report path")
+    cp.add_argument(
+        "--baseline",
+        help="member name for the log-odds denominator (default: the one "
+        "null member when present, else the first member)",
+    )
+    cp.add_argument("--min-len", type=int, default=None,
+                    help="minimum island length for the emitted tracks")
+    cp.add_argument(
+        "--threshold", type=float, default=None,
+        help="winner-track confidence threshold (default 0.5): a position "
+        "below it on every member falls back to background",
+    )
+    cp.add_argument(
+        "--engine", choices=("auto", "xla", "pallas", "onehot"),
+        default="auto",
+        help="kernel lowering request applied to every member (auto "
+        "resolves per member's family eligibility)",
+    )
+    _add_invalid_symbols_flag(cp)
+    _add_obs_flags(cp)
+    _add_symbol_cache_flag(cp)
+    cp.add_argument("--trace-dir", help="capture a jax.profiler device trace")
+    cp.add_argument("-v", "--verbose", action="store_true")
+
     sv = sub.add_parser(
         "serve",
         help="persistent serving daemon: JSONL requests over stdin/stdout "
@@ -266,6 +307,14 @@ def build_parser() -> argparse.ArgumentParser:
     sv.add_argument(
         "--tenant-max-symbols", type=_positive_int, default=512 << 20,
         help="per-tenant queued-symbol cap",
+    )
+    sv.add_argument(
+        "--family", metavar="NAMES", default="",
+        help="comma-separated family member names "
+        "(durbin8,two_state,dinuc_cpg,null,null16) to register alongside "
+        "the default model: requests may then carry model=NAME routing "
+        "and kind=compare with models=[...] — each member gets its own "
+        "session with a private breaker (per-model fault isolation)",
     )
     sv.add_argument(
         "--socket", metavar="PATH",
@@ -582,6 +631,54 @@ def _run_command(args, compat, pipeline, presets, load_text, observer=None) -> i
         print(
             f"posterior: {res.n_symbols} symbols in {res.n_records} records; "
             f"mean island confidence {res.mean_island_confidence:.4f}{extra}"
+        )
+        return 0
+
+    if args.cmd == "compare":
+        from cpgisland_tpu import family
+
+        members = []
+        seen = set()
+        for tok in args.models.split(","):
+            tok = tok.strip()
+            if not tok:
+                continue
+            if "=" in tok:
+                name, path = tok.split("=", 1)
+                m = family.member_from_params(name, load_text(path))
+            else:
+                m = family.builtin_member(tok)
+            if m.name in seen:
+                build_parser().error(f"duplicate member name {m.name!r}")
+            seen.add(m.name)
+            members.append(m)
+        if not members:
+            build_parser().error("--models named no members")
+        # Pre-flight argument validation only — runtime data errors from
+        # the pipeline itself must surface as real tracebacks, not usage
+        # errors (the decode/posterior subcommands' convention).
+        try:
+            family.resolve_baseline(members, args.baseline)
+        except ValueError as e:
+            build_parser().error(str(e))
+        res = pipeline.compare_file(
+            args.test_file,
+            members,
+            out=args.out,
+            engine=args.engine,
+            baseline=args.baseline,
+            min_len=args.min_len,
+            threshold=args.threshold,
+            symbol_cache=args.symbol_cache,
+            invalid_symbols=args.invalid_symbols,
+            metrics=metrics,
+        )
+        n_winner = sum(len(rc.winner_calls) for rc in res.records)
+        print(
+            f"compared {len(res.member_names)} models over "
+            f"{res.n_symbols} symbols in {res.n_records} records; "
+            f"baseline {res.baseline}; {n_winner} winner-track islands "
+            f"-> {args.out}"
         )
         return 0
 

@@ -1,0 +1,60 @@
+"""Model-family layer: emission-support partition analysis, named family
+members, and the multi-model posterior-comparison workload.
+
+- :mod:`cpgisland_tpu.family.partition` — ``partition_of(params)``, THE
+  eligibility oracle behind the reduced (onehot) engines and all four
+  engine routers; block-structure + entry-group threading metadata.
+- :mod:`cpgisland_tpu.family.members` — first-class named models
+  (flagship, two-state, order-2 dinucleotide over the pair alphabet,
+  null background) routing through the existing engine registry.
+- :mod:`cpgisland_tpu.family.compare` — N members over one prepared
+  stream: per-model log-odds, per-model islands, winner track.
+"""
+
+from cpgisland_tpu.family.compare import (
+    DEFAULT_WINNER_THRESHOLD,
+    MemberResult,
+    RecordComparison,
+    compare_record,
+    resolve_baseline,
+    winner_track,
+)
+from cpgisland_tpu.family.members import (
+    MEMBER_NAMES,
+    Member,
+    builtin_member,
+    default_members,
+    member_from_params,
+    members_from_names,
+)
+from cpgisland_tpu.family.partition import (
+    REDUCED_GROUP,
+    EmissionPartition,
+    partition_concrete,
+    partition_of,
+    reduced_eligible,
+    reduced_eligible_concrete,
+    reduced_stats_eligible,
+)
+
+__all__ = [
+    "REDUCED_GROUP",
+    "EmissionPartition",
+    "partition_concrete",
+    "partition_of",
+    "reduced_eligible",
+    "reduced_eligible_concrete",
+    "reduced_stats_eligible",
+    "Member",
+    "MEMBER_NAMES",
+    "builtin_member",
+    "member_from_params",
+    "members_from_names",
+    "default_members",
+    "MemberResult",
+    "RecordComparison",
+    "compare_record",
+    "resolve_baseline",
+    "winner_track",
+    "DEFAULT_WINNER_THRESHOLD",
+]

@@ -1,0 +1,285 @@
+"""Multi-model posterior comparison: N family members over one record.
+
+The cross-model workload ROADMAP item 2 calls for: score CpG+/-, the
+2-state model, the dinucleotide model, and the null background over the
+SAME symbol stream and report, per member, the record log-likelihood,
+the log-odds against a baseline member, the posterior island-confidence
+track, and the member's island calls — plus a per-position WINNER track
+(which member is most confident of an island at each position) emitted in
+the reference island text format.
+
+Exactness contract: each member's confidence/path comes from the SAME
+shared record unit the posterior pipeline runs
+(``pipeline._posterior_record_unit`` — pow2-padded geometry, supervised
+dispatch, breaker-gated engine resolution), so a comparison is
+BIT-IDENTICAL to N independent posterior runs of the same records; the
+comparison layer only adds the scoring pass
+(``ops.forward_backward.sequence_loglik``) and host-side track algebra.
+Members of the same order share ONE host stream (the pair recode is
+computed once, not per member); device placement is currently per member
+unit — fusing members onto one placed stream/launch is the occupancy
+half of ROADMAP item 2, still open.  Order-2 members consume the
+pair-recoded stream (codec.recode_pairs), which is position-aligned with
+the base stream, so every track below lives on base-stream coordinates.
+
+Null members (empty ``island_states``) are scoring-only: their
+confidence is identically zero by construction (no island states), so no
+posterior dispatch is paid for them — they enter the log-odds
+denominators and the winner track's background fallback.
+
+Comparability note: members of equal ``order`` score the same number of
+emissions and their log-odds are directly interpretable; an order-2
+member scores T-1 pair emissions vs an order-1 member's T, so cross-order
+odds carry that structural offset — compare like with like (pair members
+against ``null16``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import numpy as np
+
+from cpgisland_tpu.family.members import Member
+
+__all__ = [
+    "MemberResult", "RecordComparison", "compare_record", "winner_calls",
+]
+
+#: A winner-track position must beat this island confidence to be claimed
+#: by a member; everything else falls back to the background (-1).
+DEFAULT_WINNER_THRESHOLD = 0.5
+
+
+@dataclasses.dataclass
+class MemberResult:
+    """One member's result over one record (base-stream coordinates)."""
+
+    name: str
+    loglik: float
+    log_odds: float  # loglik - baseline member's loglik (natural log)
+    conf: np.ndarray  # [T] float32 P(position in island | record)
+    calls: object  # IslandCalls from the member's own MPM path
+
+
+@dataclasses.dataclass
+class RecordComparison:
+    record: str
+    n_symbols: int
+    baseline: str
+    members: list  # [MemberResult] in input member order
+    winner: np.ndarray  # [T] int8 member index, -1 = background/no island
+    winner_calls: object  # IslandCalls, names = winning member names
+
+    def member(self, name: str) -> MemberResult:
+        for m in self.members:
+            if m.name == name:
+                return m
+        raise KeyError(name)
+
+
+def resolve_baseline(members, baseline: Optional[str]) -> int:
+    """Index of the log-odds baseline member: an explicit name, else the
+    single null member when exactly one exists, else the first member."""
+    if baseline is not None:
+        for i, m in enumerate(members):
+            if m.name == baseline:
+                return i
+        raise ValueError(
+            f"baseline {baseline!r} is not one of "
+            f"{[m.name for m in members]}"
+        )
+    nulls = [i for i, m in enumerate(members) if m.is_null]
+    return nulls[0] if len(nulls) == 1 else 0
+
+
+def _member_context(member: Member, sessions, engine: str, supervisor):
+    """(engine request, supervisor) for one member — a serve
+    :class:`~cpgisland_tpu.serve.session.Session` when the caller maps one
+    to this member's name (per-model fault domains: that session's breaker
+    gates the dispatches), else the call-level defaults."""
+    sess = None if sessions is None else sessions.get(member.name)
+    if sess is None:
+        from cpgisland_tpu import resilience
+
+        sup = (
+            supervisor if supervisor is not None
+            else resilience.default_supervisor()
+        )
+        return engine, sup
+    if sess.params is not member.params:
+        raise ValueError(
+            f"session for member {member.name!r} is bound to different "
+            "params — one Session serves ONE model"
+        )
+    return sess.engine, sess.supervisor
+
+
+def _pad_pow2(stream: np.ndarray, pad_sym: int, floor: int = 1 << 14):
+    """Pow2-pad a stream for the scoring pass — the same bucket discipline
+    as the posterior record unit, so repeat geometries share compiles."""
+    from cpgisland_tpu.pipeline import _round_pow2
+
+    T = stream.shape[0]
+    Tp = _round_pow2(max(T, 1), floor=floor)
+    if Tp == T:
+        return stream
+    return np.concatenate(
+        [stream, np.full(Tp - T, pad_sym, dtype=stream.dtype)]
+    )
+
+
+def winner_track(
+    confs: np.ndarray, threshold: float = DEFAULT_WINNER_THRESHOLD
+) -> np.ndarray:
+    """[N, T] member confidences -> [T] int8 winner index.
+
+    winner[t] = the member with the highest island confidence at t when
+    that confidence exceeds ``threshold``; -1 (background) otherwise.
+    Ties break to the lower member index (input order)."""
+    if confs.shape[0] > 127:
+        raise ValueError("winner track is int8: at most 127 members")
+    if not threshold >= 0.0:
+        # A negative threshold would claim every position for the argmax
+        # member — including null members' exact-zero columns, which
+        # winner_calls (correctly) never emits; fail fast instead of
+        # producing a winner array inconsistent with the emitted track.
+        raise ValueError(
+            f"winner threshold must be >= 0 (confidences are "
+            f"probabilities), got {threshold}"
+        )
+    best = np.argmax(confs, axis=0).astype(np.int8)
+    return np.where(
+        confs[best, np.arange(confs.shape[1])] > threshold, best,
+        np.int8(-1),
+    )
+
+
+def _sorted_calls(calls):
+    from cpgisland_tpu.ops.islands import IslandCalls
+
+    order = np.argsort(calls.beg, kind="stable")
+    return IslandCalls(
+        beg=calls.beg[order], end=calls.end[order],
+        length=calls.length[order], gc_content=calls.gc_content[order],
+        oe_ratio=calls.oe_ratio[order],
+        names=None if calls.names is None else calls.names[order],
+    )
+
+
+def winner_calls(
+    members, winner: np.ndarray, symbols: np.ndarray,
+    min_len: Optional[int] = None,
+):
+    """The winner track as reference-format island records: runs where
+    member m wins become intervals (1-based, base-stream coordinates)
+    with GC/obs-exp composition from the BASE observations and the
+    winning member's name in the name column — one merged,
+    position-sorted list."""
+    from cpgisland_tpu.ops import islands as islands_mod
+    from cpgisland_tpu.ops.islands import IslandCalls
+
+    parts = []
+    for idx, m in enumerate(members):
+        if m.is_null:
+            continue  # confidence 0 never exceeds the threshold
+        c = islands_mod.call_islands_obs(
+            winner, symbols, island_states=(idx,), min_len=min_len
+        )
+        parts.append(c.with_names(m.name))
+    return _sorted_calls(IslandCalls.concatenate(parts))
+
+
+def compare_record(
+    members,
+    symbols: np.ndarray,
+    *,
+    record: str = "",
+    engine: str = "auto",
+    baseline: Optional[str] = None,
+    min_len: Optional[int] = None,
+    threshold: float = DEFAULT_WINNER_THRESHOLD,
+    prev: Optional[int] = None,
+    sessions=None,
+    supervisor=None,
+) -> RecordComparison:
+    """Compare ``members`` over one base-alphabet record (see module
+    docstring).
+
+    ``sessions``: optional mapping member-name -> serve Session; a mapped
+    member's dispatches run under that session's supervisor/breaker (the
+    daemon's per-model fault domains).  ``prev`` threads the base before
+    the record into order-2 recodes (stream continuations).
+    """
+    import jax.numpy as jnp
+
+    from cpgisland_tpu import obs as obs_mod
+    from cpgisland_tpu import pipeline
+    from cpgisland_tpu.ops import islands as islands_mod
+    from cpgisland_tpu.ops.forward_backward import sequence_loglik
+    from cpgisland_tpu.parallel.posterior import resolve_fb_engine
+
+    if not members:
+        raise ValueError("compare needs at least one member")
+    names = [m.name for m in members]
+    if len(set(names)) != len(names):
+        raise ValueError(f"duplicate member names: {names}")
+    symbols = np.ascontiguousarray(symbols, dtype=np.uint8)
+    T = symbols.shape[0]
+    b_idx = resolve_baseline(members, baseline)
+
+    logliks: list = []
+    confs = np.zeros((len(members), T), np.float32)
+    calls: list = []
+    # Per-ORDER stream cache: every same-order member consumes identical
+    # bytes (base stream / one pair recode), so encode + pow2-pad once.
+    streams: dict = {}
+    for i, m in enumerate(members):
+        eng, sup = _member_context(m, sessions, engine, supervisor)
+        if m.order not in streams:
+            st = m.encode(symbols, prev=prev)
+            streams[m.order] = (st, _pad_pow2(st, m.params.n_symbols))
+        stream, padded = streams[m.order]
+
+        def ll_unit(padded=padded, m=m, L=stream.shape[0]):
+            return float(obs_mod.note_fetch(np.asarray(
+                sequence_loglik(m.params, jnp.asarray(padded), L)
+            )))
+
+        logliks.append(sup.run(
+            ll_unit, what="compare.loglik", engine="fb.xla",
+            items=float(T),
+        ))
+        if m.is_null or T == 0:
+            calls.append(islands_mod._empty_calls().with_names(m.name))
+            continue
+        fb_eng = resolve_fb_engine(eng, m.params, breaker=sup.breaker)
+        conf, path = pipeline._posterior_record_unit(
+            m.params, stream, m.island_states, engine=eng, fb_eng=fb_eng,
+            want_path=True, return_device=False, sup=sup,
+        )
+        confs[i] = np.asarray(conf)
+        # Membership from the member's own MPM path, composition from the
+        # BASE observations (position-aligned for order-2 members too).
+        calls.append(
+            islands_mod.call_islands_obs(
+                np.asarray(path), symbols,
+                island_states=m.island_states, min_len=min_len,
+            ).with_names(m.name)
+        )
+
+    winner = winner_track(confs, threshold) if T else np.zeros(0, np.int8)
+    results = [
+        MemberResult(
+            name=m.name, loglik=logliks[i],
+            log_odds=logliks[i] - logliks[b_idx],
+            conf=confs[i], calls=calls[i],
+        )
+        for i, m in enumerate(members)
+    ]
+    return RecordComparison(
+        record=record, n_symbols=T, baseline=members[b_idx].name,
+        members=results, winner=winner,
+        winner_calls=winner_calls(members, winner, symbols, min_len=min_len),
+    )

@@ -1,0 +1,201 @@
+"""Emission-support partition analysis — THE eligibility oracle for the
+reduced engines.
+
+The one-hot reduction (ops.viterbi_onehot / ops.fb_onehot — the repo's
+single biggest perf lever) collapses the K-state DP to a G-state
+block-conditioned chain.  What actually makes that factorization valid is
+not "the flagship 8-state model" but a property of the EMISSION SUPPORT:
+whenever the per-symbol supports {s : B[s, o] > 0} partition the states
+into disjoint blocks, the score vector at time t is exactly zero (LOG_ZERO
+in max-plus) outside block(o_t), so the recurrence is exactly a
+block-to-block recurrence whose per-step matrix is the [G, G] slice of A
+between block(o_{t-1}) and block(o_t).
+
+This module computes that structure ONCE — :func:`partition_of` — and
+every routing/eligibility decision derives from it:
+
+- ``viterbi_onehot.supports`` / ``fb_onehot.supports`` are thin wrappers
+  over :func:`reduced_eligible` (the engines' current domain: one-hot
+  states, uniform blocks of exactly :data:`REDUCED_GROUP`);
+- the four engine routers (parallel.decode.resolve_engine,
+  parallel.posterior.resolve_fb_engine, train.backends.resolve_fb_engine,
+  train.backends._seq_onehot) all consult the same functions instead of
+  carrying four copies of the check;
+- the chunked-EM stats kernel's extra power-of-two-alphabet constraint
+  lives in :func:`reduced_stats_eligible` (one copy, previously inlined in
+  train.backends).
+
+The analysis itself is MORE general than the engines' current domain: it
+reports block structure for any partitioned emission matrix (arbitrary
+block count and size, states supporting several symbols of one block).
+:class:`EmissionPartition` carries the entry-group / prev-sym threading
+metadata — ``group_table[sym]`` is the block a segment entered on symbol
+``sym``, which is exactly what the reduced engines' ``prev0`` /
+``device_entry_sym`` threading conditions on.
+
+Tri-state convention (shared with the old ``supports_concrete``): the
+analysis needs CONCRETE params — under tracing it returns None
+("undecidable"); validation sites treat None as "trust the caller",
+auto-selection sites as "don't upgrade".
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Union
+
+import numpy as np
+
+from cpgisland_tpu.models.hmm import LOG_ZERO, HmmParams
+
+__all__ = [
+    "REDUCED_GROUP",
+    "EmissionPartition",
+    "partition_concrete",
+    "partition_of",
+    "reduced_eligible",
+    "reduced_eligible_concrete",
+    "reduced_stats_eligible",
+]
+
+# Block size the reduced kernels implement (2 states per chain step, 2-bit
+# backpointers).  ops.viterbi_onehot.GROUP re-exports this value.
+REDUCED_GROUP = 2
+
+
+@dataclasses.dataclass(frozen=True)
+class EmissionPartition:
+    """Block structure of a partitioned emission matrix.
+
+    ``blocks[b]`` is the ascending tuple of state ids in block b;
+    ``block_of_symbol[o]`` / ``block_of_state[k]`` map symbols and states to
+    their block id; ``group_table[o]`` is the ascending state ids supporting
+    symbol o (``-1``-padded to the largest block) — for uniform-size
+    partitions this is exactly the [S, G] group table the reduced kernels
+    build per step (``ops.viterbi_onehot._groups``), and ``group_table[
+    prev_sym]`` is the entry group the prev-sym threading conditions a
+    segment/span on.
+    """
+
+    n_states: int
+    n_symbols: int
+    blocks: tuple  # tuple[tuple[int, ...], ...]
+    block_of_symbol: np.ndarray  # [S] int32
+    block_of_state: np.ndarray  # [K] int32
+    onehot: bool  # every state supports exactly ONE symbol
+    uniform: Optional[int]  # the common block size, or None if ragged
+
+    @property
+    def n_blocks(self) -> int:
+        return len(self.blocks)
+
+    @property
+    def group_table(self) -> np.ndarray:
+        """[S, max_block] int32 ascending supporting-state ids, -1 pad."""
+        width = max(len(b) for b in self.blocks)
+        out = np.full((self.n_symbols, width), -1, np.int32)
+        for o in range(self.n_symbols):
+            states = self.blocks[int(self.block_of_symbol[o])]
+            out[o, : len(states)] = states
+        return out
+
+    @property
+    def reduced(self) -> bool:
+        """Inside the reduced engines' implemented domain: one-hot states
+        (each state emits exactly one symbol — so each symbol owns its
+        block) in uniform blocks of exactly REDUCED_GROUP states."""
+        return self.onehot and self.uniform == REDUCED_GROUP
+
+    def entry_group(self, sym: int) -> tuple:
+        """States a segment can occupy when its entering symbol is ``sym``
+        — the prev-sym threading metadata."""
+        return self.blocks[int(self.block_of_symbol[sym])]
+
+
+def partition_concrete(
+    params: HmmParams,
+) -> Union[EmissionPartition, bool, None]:
+    """Tri-state partition analysis: an :class:`EmissionPartition` when the
+    emission supports partition the states, ``False`` when concrete params
+    do not partition, ``None`` when the params are traced (undecidable at
+    trace time)."""
+    try:
+        logB = np.asarray(params.log_B)
+    except Exception:
+        return None  # traced params — a host decision cannot be made
+    if logB.ndim != 2:
+        return False
+    K, S = logB.shape
+    # Entries must be real probabilities or structural zeros — anything in
+    # between (nan/inf garbage) disqualifies the structure outright.
+    if not np.all(np.isfinite(logB) | (logB <= LOG_ZERO / 2)):
+        return False
+    supp = logB > LOG_ZERO / 2  # [K, S]
+    if not supp.any(axis=0).all():
+        return False  # a symbol no state emits
+    if not supp.any(axis=1).all():
+        return False  # a silent state belongs to no block
+    # Partition condition: per-symbol supports pairwise EQUAL or DISJOINT.
+    # Group symbols by support signature; disjointness then reduces to "no
+    # state appears in two distinct signatures".
+    sig_to_block: dict = {}
+    block_states: list = []
+    block_of_symbol = np.empty(S, np.int32)
+    for o in range(S):
+        key = tuple(np.nonzero(supp[:, o])[0].tolist())
+        b = sig_to_block.get(key)
+        if b is None:
+            b = len(block_states)
+            sig_to_block[key] = b
+            block_states.append(key)
+        block_of_symbol[o] = b
+    block_of_state = np.full(K, -1, np.int32)
+    for b, states in enumerate(block_states):
+        for k in states:
+            if block_of_state[k] >= 0:
+                return False  # overlapping, non-equal supports
+            block_of_state[k] = b
+    sizes = {len(b) for b in block_states}
+    return EmissionPartition(
+        n_states=K,
+        n_symbols=S,
+        blocks=tuple(block_states),
+        block_of_symbol=block_of_symbol,
+        block_of_state=block_of_state,
+        onehot=bool(np.all(supp.sum(axis=1) == 1)),
+        uniform=sizes.pop() if len(sizes) == 1 else None,
+    )
+
+
+def partition_of(params: HmmParams) -> Optional[EmissionPartition]:
+    """The partition, or None (traced params OR non-partitioned emissions).
+    Callers that must distinguish the two use :func:`partition_concrete`."""
+    p = partition_concrete(params)
+    return p if isinstance(p, EmissionPartition) else None
+
+
+def reduced_eligible_concrete(params: HmmParams) -> Optional[bool]:
+    """Tri-state reduced-engine eligibility (the old
+    ``viterbi_onehot.supports_concrete`` contract): True/False on concrete
+    params, None when traced."""
+    p = partition_concrete(params)
+    if p is None:
+        return None
+    return bool(p is not False and p.reduced)
+
+
+def reduced_eligible(params: HmmParams) -> bool:
+    """Host-side reduced-engine eligibility: the emission supports
+    partition the states into uniform one-hot blocks of REDUCED_GROUP.
+    False under tracing — engine selection is a host decision."""
+    return reduced_eligible_concrete(params) is True
+
+
+def reduced_stats_eligible(params: HmmParams) -> bool:
+    """Eligibility for the reduced-stream chunked-EM stats kernel
+    (fb_onehot._oh_stats_kernel): reduced_eligible AND power-of-two
+    n_symbols — the kernel's in-register scatter lowers only for pow2
+    alphabets, which 2-states-per-symbol alone does not guarantee
+    (previously inlined in train.backends.resolve_fb_engine)."""
+    S = params.n_symbols
+    return reduced_eligible(params) and S & (S - 1) == 0

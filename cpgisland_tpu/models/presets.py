@@ -81,11 +81,134 @@ def two_state_cpg(p_stay_island: float = 0.999, p_stay_bg: float = 0.9995, dtype
     return HmmParams.from_probs(pi, A, B, dtype=dtype)
 
 
-def random_hmm(key: jax.Array, n_states: int, n_symbols: int, dtype=jnp.float32) -> HmmParams:
+#: Island (first-half) state ids of the dinucleotide model, the pair-alphabet
+#: analogue of the flagship's states 0..3.
+DINUC_ISLAND_STATES = tuple(range(16))
+
+#: Pair-symbol index of the CpG dinucleotide ("CG" = prev C, cur G) in the
+#: recoded alphabet (codec.recode_pairs) — the event the Gardiner-Garden/
+#: Frommer obs/exp filter counts.
+CPG_PAIR = 1 * 4 + 2
+
+
+def dinuc_cpg(dtype=jnp.float32) -> HmmParams:
+    """Order-2 (dinucleotide-emission) CpG model over the PAIR alphabet.
+
+    The biology the reference's Gardiner-Garden/Frommer filters chase
+    (CpGIslandFinder.java:290-339: GC content + CpG obs/exp over called
+    runs) lives in DINUCLEOTIDES — the GGF obs/exp statistic literally
+    counts the CG pair.  This member makes that signal a first-class
+    observation: the codec recodes the stream to the 16-symbol pair
+    alphabet (:func:`cpgisland_tpu.utils.codec.recode_pairs`, ``pair =
+    prev * 4 + cur``; :data:`CPG_PAIR` is the CpG event itself) and the
+    model's 32 states are (pair, +/-) — state ``sign * 16 + pair`` emits
+    exactly its own pair, so the emission support partitions the states
+    into 16 blocks of 2 and the model routes through the reduced
+    block-conditioned engines (family.partition_of) like the flagship.
+
+    Transitions chain pairs: (a, b, s) -> (b, c, s') with within-sign
+    probability equal to the Durbin table ``P_s[b, c]`` and the flagship's
+    0.0025 cross-sign leakage per reachable target; transitions to
+    non-chaining pairs (prev of the next pair != cur of this one) are
+    structural zeros.  Rows sum to exactly 1.0 (4 within-sign entries
+    summing 1 - 4*LEAK + 4 leak entries), and one-hot emissions are EM
+    fixed points, so training preserves the family structure — exactly
+    like the flagship.
+
+    The first pair of a record has no left context and recodes to the
+    SELF-CONTEXT pair ``(c0, c0)`` (chain-consistent and in-alphabet —
+    codec.recode_pairs documents why an out-of-alphabet marker would dead-
+    end the structural transition zeros); spans/continuations thread
+    ``prev`` through recode_pairs instead.  The lift is exact: every
+    complete-path probability equals the flagship's times the constant
+    1/4 prior split of the opening pair state, so log-likelihoods differ
+    by exactly -log 4 and posteriors are identical (pinned in tests).
+    """
+    A = np.zeros((32, 32))
+    for sign, tab in ((0, _DURBIN_PLUS), (1, _DURBIN_MINUS)):
+        for a in range(4):
+            for b in range(4):
+                row = sign * 16 + a * 4 + b
+                for c in range(4):
+                    A[row, sign * 16 + b * 4 + c] = tab[b, c]
+                    A[row, (1 - sign) * 16 + b * 4 + c] = _LEAK
+    # Same island/background prior mass split as the flagship (0.2 / 0.8),
+    # uniform within each sign's 16 pairs.
+    pi = np.concatenate([np.full(16, 0.2 / 16), np.full(16, 0.8 / 16)])
+    B = np.zeros((32, 16))
+    B[np.arange(32), np.arange(32) % 16] = 1.0
+    return HmmParams.from_probs(pi, A, B, dtype=dtype)
+
+
+def _background_stationary() -> np.ndarray:
+    """Stationary distribution of the (leak-free, row-renormalized) Durbin
+    background chain — the GGF-style expected base composition outside
+    islands."""
+    P = _DURBIN_MINUS / _DURBIN_MINUS.sum(axis=1, keepdims=True)
+    w, v = np.linalg.eig(P.T)
+    i = int(np.argmin(np.abs(w - 1.0)))
+    pi = np.real(v[:, i])
+    pi = np.abs(pi)
+    return pi / pi.sum()
+
+
+def null_background(n_symbols: int = 4, dtype=jnp.float32) -> HmmParams:
+    """Single-state null/background scoring model — the log-odds
+    denominator of the multi-model comparison workload (family.compare).
+
+    The Gardiner-Garden/Frommer criteria are threshold tests against
+    EXPECTED background composition; this member is that expectation as a
+    scoreable model: one state, self-transition 1, emitting the stationary
+    composition of the Durbin background chain.  ``n_symbols=4`` emits
+    base frequencies; ``n_symbols=16`` emits the stationary dinucleotide
+    joint ``pi(a) * P-(b|a)`` over the pair alphabet (the order-2 members'
+    comparison partner).  No island states — a comparison's winner track
+    falls back to it exactly where no island model beats background.
+    """
+    statv = _background_stationary()
+    if n_symbols == 4:
+        B = statv[None, :]
+    elif n_symbols == 16:
+        P = _DURBIN_MINUS / _DURBIN_MINUS.sum(axis=1, keepdims=True)
+        B = (statv[:, None] * P).reshape(1, 16)
+    else:
+        raise ValueError(
+            f"null_background supports the base (4) and pair (16) "
+            f"alphabets, got n_symbols={n_symbols}"
+        )
+    return HmmParams.from_probs(
+        np.ones(1), np.ones((1, 1)), B / B.sum(), dtype=dtype
+    )
+
+
+def random_hmm(
+    key: jax.Array, n_states: int, n_symbols: int, dtype=jnp.float32,
+    partition: "int | None" = None,
+) -> HmmParams:
     """Random row-stochastic model (the reference's commented-out
-    ``buildRandomModel`` alternative, CpGIslandFinder.java:153)."""
+    ``buildRandomModel`` alternative, CpGIslandFinder.java:153).
+
+    ``partition``: emission-support group size G — instead of random
+    emissions, build ONE-HOT emissions with exactly G states per symbol
+    (state k emits symbol ``k % n_symbols``; requires ``n_states == G *
+    n_symbols``), so tests can generate family-eligible models of
+    arbitrary (power-of-two or otherwise) block count ``n_symbols``.
+    ``partition=2`` models are reduced-engine eligible
+    (family.partition_of -> .reduced); transitions and initials stay
+    random either way.
+    """
     k_pi, k_a, k_b = jax.random.split(key, 3)
     pi = jax.random.dirichlet(k_pi, jnp.ones(n_states))
     A = jax.random.dirichlet(k_a, jnp.ones(n_states), shape=(n_states,))
-    B = jax.random.dirichlet(k_b, jnp.ones(n_symbols), shape=(n_states,))
+    if partition is not None:
+        if n_states != partition * n_symbols:
+            raise ValueError(
+                f"partition={partition} needs n_states == partition * "
+                f"n_symbols, got {n_states} != {partition} * {n_symbols}"
+            )
+        B = np.zeros((n_states, n_symbols))
+        B[np.arange(n_states), np.arange(n_states) % n_symbols] = 1.0
+        B = jnp.asarray(B)
+    else:
+        B = jax.random.dirichlet(k_b, jnp.ones(n_symbols), shape=(n_states,))
     return HmmParams.from_probs(pi, A, B, dtype=dtype)

@@ -312,6 +312,58 @@ def posterior_marginals(params: HmmParams, obs: jnp.ndarray, length=None):
     return jnp.where(valid[:, None], gamma, 0.0), loglik
 
 
+@jax.jit
+def sequence_loglik(params: HmmParams, obs: jnp.ndarray, length=None):
+    """Total log-likelihood log P(obs | params) of one sequence — the
+    forward pass alone (rescaled Rabiner numerics, HIGHEST-precision
+    matmuls, exactly the E-step's recurrence).
+
+    This is the scoring entry of the multi-model comparison workload
+    (family.compare): per-model log-odds are differences of these values.
+    Unlike chunk_stats' tail-only convention, PAD is positional here —
+    any symbol >= n_symbols (or at/past ``length``) is an identity step
+    contributing no transition and no emission, including a PAD FIRST
+    position (the state prior carries through unscored).  This matches
+    the engines' PAD semantics, so the score pairs consistently with
+    their paths/posteriors.  (Note the order-2 pair streams do NOT open
+    with PAD: codec.recode_pairs maps an unknown left context to the
+    in-alphabet SELF-CONTEXT pair, which is scored normally — the
+    dinuc_cpg exact-lift constant depends on that first pair being
+    scored.)
+    """
+    T = obs.shape[0]
+    if length is None:
+        length = T
+    obs32 = obs.astype(jnp.int32)
+    valid = (jnp.arange(T) < length) & (obs32 < params.n_symbols)
+    obs_c = jnp.where(valid, obs32, 0)
+    A = jnp.exp(params.log_A)
+    B_t = jnp.exp(params.log_B).T  # [M, K]
+    pi = jnp.exp(params.log_pi)
+
+    a0_raw = jnp.where(valid[0], pi * B_t[obs_c[0]], pi)
+    c0 = jnp.sum(a0_raw)
+    # Same zero-normalizer guard as fstep below: an impossible first
+    # observation scores -inf via log(c0), never nan via 0/0.
+    alpha0 = jnp.where(c0 > 0, a0_raw / jnp.where(c0 > 0, c0, 1.0), pi)
+
+    def fstep(alpha, inp):
+        o_t, v_t = inp
+        raw = jnp.matmul(alpha, A, precision=jax.lax.Precision.HIGHEST) * B_t[o_t]
+        c = jnp.sum(raw)
+        # A structurally impossible observation (c == 0: zero emission
+        # probability over every reachable state) must score -inf, not
+        # nan: guard the renormalizing division (alpha carries through
+        # arbitrarily — the total is already -inf) and let log(0) = -inf
+        # flow into the sum.
+        new = jnp.where(v_t & (c > 0), raw / jnp.where(c > 0, c, 1.0), alpha)
+        return new, jnp.where(v_t, c, 1.0)
+
+    _, cs_tail = jax.lax.scan(fstep, alpha0, (obs_c[1:], valid[1:]))
+    ll0 = jnp.where(valid[0], jnp.log(c0), 0.0)
+    return ll0 + jnp.sum(jnp.where(valid[1:], jnp.log(cs_tail), 0.0))
+
+
 def posterior_decode(params: HmmParams, obs: jnp.ndarray, length=None) -> jnp.ndarray:
     """Max-posterior-marginal state path: argmax_k gamma[t, k] per position."""
     gamma, _ = posterior_marginals(params, obs, length)

@@ -66,10 +66,14 @@ except Exception:  # pragma: no cover
 from cpgisland_tpu.models.hmm import LOG_ZERO, HmmParams
 from cpgisland_tpu.ops.viterbi_parallel import scan_block_products
 
+from cpgisland_tpu.family.partition import REDUCED_GROUP
+
 LANE_TILE = 128
 ROW_TILE = 8  # steps per packed backpointer word
 OUTER_TILE = 64  # steps per aligned packed-row store (8 words of 8 steps)
-GROUP = 2  # reduced state dimension; 2 bits of backpointer per step
+# Reduced state dimension (2 bits of backpointer per step) — the family
+# partition oracle's block size (one definition, family.partition).
+GROUP = REDUCED_GROUP
 
 
 def _vspec(block_shape=None, index_map=None):
@@ -83,43 +87,43 @@ def _interpret() -> bool:
 
 
 # ---------------------------------------------------------------------------
-# Structure detection
+# Structure detection — thin wrappers over the family partition oracle
+# (cpgisland_tpu.family.partition, the ONE copy of the eligibility logic
+# the engine routers also consult).
 
 
 def supports_concrete(params: HmmParams):
     """Tri-state eligibility: True/False on concrete params, None when the
     params are traced (undecidable at trace time — validation sites treat
-    None as "trust the caller", auto-selection sites as "don't upgrade")."""
-    try:
-        logB = np.asarray(params.log_B)
-    except Exception:
-        return None
-    if not np.all(np.isfinite(logB) | (logB <= LOG_ZERO / 2)):
-        return False
-    support = logB > LOG_ZERO / 2
-    if not np.all(support.sum(axis=1) == 1):
-        return False
-    sym = np.argmax(support, axis=1)
-    counts = np.bincount(sym, minlength=params.n_symbols)
-    return bool(np.all(counts == GROUP))
+    None as "trust the caller", auto-selection sites as "don't upgrade").
+    Thin wrapper over family.partition.reduced_eligible_concrete."""
+    from cpgisland_tpu.family.partition import reduced_eligible_concrete
+
+    return reduced_eligible_concrete(params)
 
 
 def supports(params: HmmParams) -> bool:
-    """Host-side eligibility: emissions one-hot with exactly GROUP states per
-    symbol.  Requires concrete params (False under tracing — engine
-    selection is a host decision; see parallel.decode.resolve_engine)."""
-    return supports_concrete(params) is True
+    """Host-side eligibility: the emission support partitions the states
+    into one-hot blocks of exactly GROUP states per symbol
+    (family.partition.reduced_eligible).  False under tracing — engine
+    selection is a host decision; see parallel.decode.resolve_engine."""
+    from cpgisland_tpu.family.partition import reduced_eligible
+
+    return reduced_eligible(params)
 
 
 def _groups(params: HmmParams) -> jnp.ndarray:
     """[S, GROUP] int32 group table (traced-params safe): gt[s] = the two
-    state ids whose emission supports symbol s, ascending — the order that
-    reproduces the generic engines' first-max tie-breaking."""
+    state ids whose emission SUPPORT covers symbol s, ascending — the order
+    that reproduces the generic engines' first-max tie-breaking.  The
+    traced twin of family.partition's ``group_table`` metadata, derived
+    from the support structure directly (not per-state argmax), so it is
+    valid for any partition the oracle admits."""
     K, S = params.n_states, params.n_symbols
-    sym = jnp.argmax(params.log_B, axis=1)  # [K]
+    supp = params.log_B > LOG_ZERO / 2  # [K, S]
     ar = jnp.arange(K, dtype=jnp.int32)
-    low = jnp.min(jnp.where(sym[None, :] == jnp.arange(S)[:, None], ar[None, :], K), axis=1)
-    high = jnp.max(jnp.where(sym[None, :] == jnp.arange(S)[:, None], ar[None, :], -1), axis=1)
+    low = jnp.min(jnp.where(supp.T, ar[None, :], K), axis=1)
+    high = jnp.max(jnp.where(supp.T, ar[None, :], -1), axis=1)
     return jnp.stack([low, high], axis=1).astype(jnp.int32)
 
 

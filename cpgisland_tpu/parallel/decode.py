@@ -29,6 +29,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from cpgisland_tpu import obs as obs_mod
 from cpgisland_tpu import resilience
+from cpgisland_tpu.family import partition as family_partition
 from cpgisland_tpu.models.hmm import HmmParams
 from cpgisland_tpu.ops import viterbi_onehot, viterbi_pallas
 from cpgisland_tpu.ops.viterbi_parallel import (
@@ -73,7 +74,11 @@ def resolve_engine(engine: str, params: HmmParams, *, breaker=None) -> str:
     if engine == "auto":
         resolved = "xla"
         if jax.default_backend() == "tpu":
-            if viterbi_onehot.supports(params):
+            # The ONE eligibility oracle (family.partition): the reduced
+            # block-conditioned engines serve any member whose emission
+            # support partitions the states into one-hot pairs — flagship,
+            # dinuc_cpg, random partition=2 families alike.
+            if family_partition.reduced_eligible(params):
                 resolved = "onehot"
             elif viterbi_pallas.supports(params):
                 resolved = "pallas"
@@ -89,10 +94,10 @@ def resolve_engine(engine: str, params: HmmParams, *, breaker=None) -> str:
         raise ValueError(f"unknown engine {engine!r}; expected auto|xla|pallas|onehot")
     if engine == "pallas" and not viterbi_pallas.supports(params):
         raise ValueError(f"pallas engine needs n_states <= 8, got {params.n_states}")
-    if engine == "onehot" and not viterbi_onehot.supports(params):
+    if engine == "onehot" and not family_partition.reduced_eligible(params):
         raise ValueError(
-            "onehot engine needs one-hot emissions with 2 states per symbol "
-            "(concrete params)"
+            "onehot engine needs a one-hot emission-support partition with "
+            "2 states per symbol (family.partition_of; concrete params)"
         )
     obs_mod.engine_decision(
         site="decode.resolve_engine", choice=engine, requested=engine

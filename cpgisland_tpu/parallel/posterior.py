@@ -69,12 +69,17 @@ def resolve_fb_engine(engine: str, params: HmmParams, *, breaker=None) -> str:
     EngineBreaker gating the demotion (a serve Session passes its own;
     default the process-global one)."""
     from cpgisland_tpu import resilience
-    from cpgisland_tpu.ops import fb_onehot
+    from cpgisland_tpu.family import partition as family_partition
 
     if engine == "auto":
         resolved = "xla"
         if jax.default_backend() == "tpu" and fb_pallas.supports(params):
-            resolved = "onehot" if fb_onehot.supports(params) else "pallas"
+            # family.partition_of — the one eligibility oracle shared with
+            # the decode/train routers.
+            resolved = (
+                "onehot" if family_partition.reduced_eligible(params)
+                else "pallas"
+            )
         obs_module.engine_decision(
             site="posterior.resolve_fb_engine", choice=resolved, requested=engine
         )
@@ -92,11 +97,13 @@ def resolve_fb_engine(engine: str, params: HmmParams, *, breaker=None) -> str:
             f"pallas FB kernels need n_states <= 8, got {params.n_states}"
         )
     if engine == "onehot" and not (
-        fb_pallas.supports(params) and fb_onehot.supports(params)
+        fb_pallas.supports(params)
+        and family_partition.reduced_eligible(params)
     ):
         raise ValueError(
-            "onehot FB kernels need one-hot emissions with 2 states per "
-            "symbol (concrete params)"
+            "onehot FB kernels need a one-hot emission-support partition "
+            "with 2 states per symbol (family.partition_of; concrete "
+            "params) and the fused kernels' state envelope (n_states <= 8)"
         )
     obs_module.engine_decision(
         site="posterior.resolve_fb_engine", choice=engine, requested=engine

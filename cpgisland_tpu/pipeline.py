@@ -1939,6 +1939,120 @@ def posterior_file(
     )
 
 
+@dataclass
+class CompareResult:
+    n_symbols: int
+    n_records: int
+    member_names: list
+    baseline: str
+    records: list  # [family.RecordComparison] in file order
+
+
+def compare_file(
+    test_path: str,
+    members=None,
+    *,
+    out: Optional[Union[str, IO[str]]] = None,
+    engine: str = "auto",
+    baseline: Optional[str] = None,
+    min_len: Optional[int] = None,
+    threshold: Optional[float] = None,
+    symbol_cache: Optional[str] = None,
+    invalid_symbols: str = "skip",
+    metrics: Optional[profiling.MetricsLogger] = None,
+    timer: Optional[profiling.PhaseTimer] = None,
+    sessions=None,
+) -> CompareResult:
+    """Multi-model posterior comparison over a FASTA file (clean
+    semantics, per record) — ``cpgisland compare``.
+
+    Every family member is evaluated over the same record stream
+    (order-2 members over the position-aligned pair recode) through the
+    SAME shared record unit the posterior pipeline runs, so the per-member
+    confidence tracks and island calls are bit-identical to independent
+    ``posterior_file`` runs of each model; the comparison adds the
+    scoring pass (record log-likelihood -> log-odds against ``baseline``)
+    and the per-position winner track (family.compare_record).
+
+    ``out`` (path or open file) writes the report: per record, one
+    ``# model`` header line per member (loglik, log-odds, island count),
+    followed by the winner track as reference-format island lines whose
+    name column is ``<record>|<member>`` (bare ``<member>`` for
+    single-record files, mirroring decode_file's name-column rule).
+
+    ``members`` defaults to the 3-model cast (durbin8, two_state, null);
+    ``sessions`` maps member names to serve Sessions (the daemon's
+    per-model fault domains).
+    """
+    from cpgisland_tpu import family
+
+    if members is None:
+        members = family.default_members()
+    names = [m.name for m in members]
+    kw = {} if threshold is None else {"threshold": threshold}
+    # Validate the baseline name once, up front (not per record).
+    b_idx = family.resolve_baseline(members, baseline)
+    _check_invalid_symbols(invalid_symbols, compat=False)
+    timer = timer if timer is not None else profiling.PhaseTimer()
+    records: list = []
+    n_sym = 0
+    for rec_name, symbols in codec.iter_fasta_records_cached(
+        test_path, symbol_cache, invalid=invalid_symbols
+    ):
+        n_sym += symbols.size
+        with timer.phase("compare", items=float(symbols.size), unit="sym"):
+            records.append(
+                family.compare_record(
+                    members, symbols, record=rec_name or ".",
+                    engine=engine, baseline=members[b_idx].name,
+                    min_len=min_len, sessions=sessions, **kw,
+                )
+            )
+    if out is not None:
+        _write_compare(records, names, members[b_idx].name, out)
+    log.info("compare phases:\n%s", timer.report())
+    if metrics is not None:
+        metrics.log(
+            "compare", n_symbols=n_sym, n_records=len(records),
+            members=names, **timer.as_dict(),
+        )
+    return CompareResult(
+        n_symbols=n_sym, n_records=len(records), member_names=names,
+        baseline=members[b_idx].name, records=records,
+    )
+
+
+def _write_compare(records, names, baseline: str, out) -> None:
+    """The compare report writer (see compare_file's format contract)."""
+    own = isinstance(out, str)
+    f = open(out, "w") if own else out
+    try:
+        f.write(
+            f"# cpgisland compare models={','.join(names)} "
+            f"baseline={baseline}\n"
+        )
+        multi = len(records) > 1
+        for rc in records:
+            f.write(f"# record {rc.record} symbols {rc.n_symbols}\n")
+            for m in rc.members:
+                f.write(
+                    f"# model {m.name} loglik {m.loglik:.6f} "
+                    f"log_odds {m.log_odds:.6f} islands {len(m.calls)}\n"
+                )
+            wc = rc.winner_calls
+            if multi and wc.names is not None:
+                wc = dataclasses.replace(
+                    wc,
+                    names=np.array(
+                        [f"{rc.record}|{n}" for n in wc.names], dtype=object
+                    ),
+                )
+            f.write(wc.format_lines())
+    finally:
+        if own:
+            f.close()
+
+
 def _write_calls(calls: IslandCalls, islands_out: Union[str, IO[str]]) -> None:
     """Write island records (reference line format) to a path or open file —
     the ONE copy of the str-vs-IO ownership rule (decode + posterior)."""

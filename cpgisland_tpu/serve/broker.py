@@ -47,12 +47,12 @@ from cpgisland_tpu import obs
 from cpgisland_tpu import pipeline
 from cpgisland_tpu.ops import islands as islands_mod
 from cpgisland_tpu.ops.islands import IslandCalls
-from cpgisland_tpu.serve.session import Session
+from cpgisland_tpu.serve.session import ModelRegistry, Session
 from cpgisland_tpu.utils import profiling
 
 log = logging.getLogger(__name__)
 
-KINDS = ("decode", "posterior")
+KINDS = ("decode", "posterior", "compare")
 
 
 class Backpressure(RuntimeError):
@@ -101,10 +101,14 @@ class BrokerConfig:
 class ServeRequest:
     id: int
     tenant: str
-    kind: str  # "decode" | "posterior"
+    kind: str  # "decode" | "posterior" | "compare"
     name: str
     symbols: np.ndarray  # uint8 encoded symbols (codec.encode output)
     t_submit: float = 0.0
+    # Named-model routing (ModelRegistry): "" = the daemon's default model.
+    model: str = ""
+    # compare only: the member names to evaluate (validated at admission).
+    models: tuple = ()
 
 
 @dataclasses.dataclass
@@ -116,6 +120,9 @@ class ServeResult:
     calls: Optional[IslandCalls] = None
     conf: Optional[np.ndarray] = None  # posterior only (float32 per symbol)
     conf_sum: Optional[float] = None  # exact f64 sum of conf
+    # compare only: {"baseline": ..., "models": {name: {"loglik", "log_odds",
+    # "islands"}}} — the winner track rides in ``calls``.
+    compare: Optional[dict] = None
     n_symbols: int = 0
     queue_s: float = 0.0  # submit -> taken into a flush
     serve_s: float = 0.0  # the flush's wall (shared by its requests)
@@ -151,10 +158,20 @@ class RequestBroker:
         session: Session,
         config: Optional[BrokerConfig] = None,
         *,
+        registry: Optional[ModelRegistry] = None,
         manifest_path: Optional[str] = None,
         resume: bool = False,
     ) -> None:
         self.session = session
+        # Named-model routing: requests carrying model= resolve their
+        # session here; the bare default registry serves the single-model
+        # daemon byte-identically.
+        self.registry = registry if registry is not None else ModelRegistry(session)
+        if self.registry.default is not session:
+            raise ValueError(
+                "registry.default must be the broker's session (the "
+                "model='' route)"
+            )
         self.config = config if config is not None else BrokerConfig()
         params = session.params
         if self.config.island_states is None:
@@ -203,9 +220,19 @@ class RequestBroker:
     # -- admission -----------------------------------------------------------
 
     def _manifest_key(self, req: ServeRequest) -> str:
-        # Tenant + kind are part of the identity: a decode completion must
-        # never replay for another tenant's (or a posterior) request.
-        return f"{req.kind}:{req.tenant}:{req.name}"
+        # Tenant + kind + MODEL are part of the identity: a decode
+        # completion must never replay for another tenant's, a posterior,
+        # or another MODEL's request.  The model segment is length-prefixed
+        # so arbitrary client-chosen names (which may contain ':') cannot
+        # craft a default-model key that collides with a named-model one —
+        # a collision would replay island calls computed under a different
+        # model's params.  (This format supersedes the pre-registry
+        # 3-field keys: manifests written before the registry don't
+        # replay, they just re-execute.)
+        return (
+            f"{req.kind}:{req.tenant}:{len(req.model)}:{req.model}:"
+            f"{req.name}"
+        )
 
     def submit(
         self,
@@ -215,11 +242,15 @@ class RequestBroker:
         kind: str,
         symbols: np.ndarray,
         name: str = "",
+        model: str = "",
+        models=None,
     ) -> None:
         """Admit one request (raises :class:`Backpressure` on queue caps,
-        RuntimeError once closed, ValueError on malformed requests).
-        Results are delivered by the flush-executing consumer
-        (:meth:`flush_once` / the worker loop)."""
+        RuntimeError once closed, ValueError on malformed requests —
+        including an unknown ``model``/``models`` name, which is
+        admission-rejected against the registry).  Results are delivered
+        by the flush-executing consumer (:meth:`flush_once` / the worker
+        loop)."""
         if kind not in KINDS:
             raise ValueError(f"kind must be one of {KINDS}, got {kind!r}")
         symbols = np.ascontiguousarray(symbols, dtype=np.uint8)
@@ -230,9 +261,74 @@ class RequestBroker:
                 "threaded soft decoding is a batch workload — use the "
                 "posterior CLI"
             )
+        model = str(model or "")
+        try:
+            self.registry.session(model)  # admission: unknown model rejects
+            if model and kind != "compare":
+                member = self.registry.member(model)
+                if member.order != 1:
+                    raise ValueError(
+                        f"model {model!r} consumes the pair alphabet "
+                        "(order-2) — serve it through compare requests, "
+                        "which keep the base stream for composition"
+                    )
+                if member.is_null:
+                    raise ValueError(
+                        f"model {model!r} is a scoring-only member (no "
+                        "island states) — decode/posterior requests have "
+                        "no product for it; use it in compare requests'"
+                        " models=[...] as a log-odds baseline"
+                    )
+        except KeyError as e:
+            raise ValueError(
+                f"{e.args[0]}; registered: "
+                f"{', '.join(self.registry.names()) or '<none>'}"
+            ) from None
+        models_t: tuple = ()
+        if kind == "compare":
+            if model:
+                raise ValueError(
+                    "compare requests take models=[...] (the member set), "
+                    "not model="
+                )
+            if models is not None and not isinstance(models, (list, tuple)):
+                # A JSON string would iterate char-wise into baffling
+                # "unknown model 'd'" rejects — demand an array.
+                raise ValueError(
+                    f"models must be a list of member names, got "
+                    f"{type(models).__name__}"
+                )
+            models_t = tuple(str(m) for m in (models or ()))
+            if not models_t:
+                raise ValueError("compare requests need models=[...]")
+            if len(set(models_t)) != len(models_t):
+                raise ValueError(f"duplicate names in models={list(models_t)}")
+            for m in models_t:
+                try:
+                    self.registry.member(m)  # needs full member metadata
+                except KeyError as e:
+                    raise ValueError(
+                        f"{e.args[0]}; registered: "
+                        f"{', '.join(self.registry.names()) or '<none>'}"
+                    ) from None
+            if symbols.size > self.config.posterior_span:
+                raise ValueError(
+                    f"compare request of {symbols.size} symbols exceeds "
+                    f"the posterior span ({self.config.posterior_span}) — "
+                    "use the compare CLI for span-scale records"
+                )
+            if self.manifest is not None:
+                raise ValueError(
+                    "compare requests are not manifest-replayable (the "
+                    "manifest journals calls + conf_sum only) — run the "
+                    "daemon without --manifest for compare traffic"
+                )
+        elif models:
+            raise ValueError("models=[...] is compare-only; use model=")
         req = ServeRequest(
             id=int(request_id), tenant=str(tenant), kind=kind, name=name,
             symbols=symbols, t_submit=time.monotonic(),
+            model=model, models=models_t,
         )
         with self._cv:
             # Closed-check under the cv: _closed is written under it in
@@ -405,10 +501,14 @@ class RequestBroker:
             for r in results:
                 if r.ok and not r.replayed:
                     try:
+                        req = self._req_of(batch, r.id)
+                        # Non-replayed results are keyed from batch ids by
+                        # construction — a miss would record a wrong
+                        # (shared) key and replay another request's result
+                        # on resume, so fail loudly instead.
+                        assert req is not None, r.id
                         self.manifest.record_done(
-                            r.id,
-                            f"{r.kind}:{r.tenant}:"
-                            + self._name_of(batch, r.id),
+                            r.id, self._manifest_key(req),
                             r.n_symbols, calls=r.calls, conf_sum=r.conf_sum,
                         )
                     except Exception:
@@ -442,11 +542,11 @@ class RequestBroker:
         return results
 
     @staticmethod
-    def _name_of(batch: list, rid: int) -> str:
+    def _req_of(batch: list, rid: int):
         for req in batch:
             if req.id == rid:
-                return req.name
-        return ""
+                return req
+        return None
 
     def drain(self) -> list:
         """Flush until the queue is empty (in-process driver for tests,
@@ -458,45 +558,19 @@ class RequestBroker:
 
     # graftcheck: hot-path
     def _run_flush(self, batch: list, t_taken: float) -> list:
-        """Execute one coalesced flush: batch-eligible decode records run
-        as ONE flat reset-step stream through the shared pipeline helper;
-        everything else runs its per-record shared unit.  All supervised,
-        all against the session's breaker."""
-        sess = self.session
-        cfg = self.config
+        """Execute one coalesced flush: requests group by MODEL (the
+        registry's per-model sessions — one model's faults stay in its
+        own breaker domain), batch-eligible decode records of each model
+        run as ONE flat reset-step stream through the shared pipeline
+        helper, everything else runs its per-record shared unit, and
+        compare requests fan over their member sessions.  All supervised,
+        all against the owning session's breaker."""
         total = float(sum(r.symbols.size for r in batch))
         t0 = time.perf_counter()
         results: dict[int, ServeResult] = {}
+        n_flat = n_singles = n_posts = 0
+        compares: list = []
         with obs.span("serve.flush", items=total, unit="sym"):
-            eng = sess.decode_engine()
-            use_dev, cap_box = sess.island_policy(
-                device_eligible=True,
-                ineligible_msg="unreachable: serve requests no path dumps",
-            )
-            flat: list = []  # batch-eligible decode requests
-            singles: list = []  # decode requests for the per-record path
-            posts: list = []
-            S = sess.params.n_symbols
-            for req in batch:
-                if req.kind == "posterior":
-                    posts.append(req)
-                elif (
-                    0 < req.symbols.size <= pipeline.SMALL_RECORD_MAX
-                    and req.symbols.size <= cfg.flush_symbols
-                    # Pad-FIRST records fall outside the reduced flat
-                    # stream's exactness domain — demote to the per-record
-                    # path, whose _engine_for_record applies the existing
-                    # host-entry dense-demotion rule.
-                    and not (eng == "onehot" and int(req.symbols[0]) >= S)
-                ):
-                    flat.append(req)
-                else:
-                    singles.append(req)
-            if len(flat) == 1:
-                # Mirror decode_file's flush_small: a single record skips
-                # the batch layout and decodes through the record path.
-                singles.extend(flat)
-                flat = []
             def fail(req, e: BaseException) -> None:
                 # The daemon outlives any one request: a unit whose
                 # supervisor gave up (or a malformed record) fails THAT
@@ -509,54 +583,33 @@ class RequestBroker:
                     n_symbols=int(req.symbols.size),
                 )
 
-            if flat:
+            by_model: dict = {}
+            for req in batch:
+                if req.kind == "compare":
+                    compares.append(req)
+                else:
+                    by_model.setdefault(req.model, []).append(req)
+            for model in sorted(by_model):
+                if model:
+                    # A registered member carries its own island labeling;
+                    # composition comes from the observations (the
+                    # pipelines' island_states contract).
+                    isl = tuple(self.registry.member(model).island_states)
+                    post_states, obs_based = isl, True
+                else:
+                    isl = self.config.island_states
+                    post_states, obs_based = self._post_states, self._obs_based
+                f, s, p = self._flush_group(
+                    self.registry.session(model), by_model[model], results,
+                    fail, island_states=isl, post_states=post_states,
+                    obs_based=obs_based,
+                )
+                n_flat += f
+                n_singles += s
+                n_posts += p
+            for req in compares:
                 try:
-                    _nsp, parts, _paths = pipeline._decode_small_batch(
-                        sess.params,
-                        [(r.name or ".", r.symbols) for r in flat],
-                        batch_decode=sess.batch_decode_fn(eng),
-                        min_len=cfg.min_len,
-                        island_states=cfg.island_states,
-                        use_device_islands=use_dev,
-                        cap_box=cap_box,
-                        want_paths=False,
-                        timer=self._timer,
-                        defer=False,
-                        supervisor=sess.supervisor,
-                        engine_label=eng,
-                    )
-                    for req, calls in zip(flat, parts):
-                        results[req.id] = ServeResult(
-                            id=req.id, tenant=req.tenant, kind=req.kind,
-                            calls=calls, n_symbols=int(req.symbols.size),
-                            route="flat",
-                        )
-                except Exception as e:
-                    for req in flat:
-                        fail(req, e)
-            for req in singles:
-                try:
-                    calls, route = self._decode_record(
-                        req, eng, use_dev, cap_box
-                    )
-                    results[req.id] = ServeResult(
-                        id=req.id, tenant=req.tenant, kind=req.kind,
-                        calls=calls, n_symbols=int(req.symbols.size),
-                        route=route,
-                    )
-                except Exception as e:
-                    fail(req, e)
-            fb_eng = sess.fb_engine() if posts else None
-            for req in posts:
-                try:
-                    conf, conf_sum, calls = self._posterior_record(
-                        req, fb_eng, use_dev, cap_box
-                    )
-                    results[req.id] = ServeResult(
-                        id=req.id, tenant=req.tenant, kind=req.kind,
-                        calls=calls, conf=conf, conf_sum=conf_sum,
-                        n_symbols=int(req.symbols.size), route="posterior",
-                    )
+                    results[req.id] = self._compare_record(req)
                 except Exception as e:
                     fail(req, e)
         wall = time.perf_counter() - t0
@@ -564,8 +617,9 @@ class RequestBroker:
             self.flushes += 1
             self.flushed_symbols += int(total)
         obs.event(
-            "serve_flush", n_requests=len(batch), n_flat=len(flat),
-            n_singles=len(singles), n_posterior=len(posts),
+            "serve_flush", n_requests=len(batch), n_flat=n_flat,
+            n_singles=n_singles, n_posterior=n_posts,
+            n_compare=len(compares), n_models=len(by_model),
             symbols=int(total), wall_s=round(wall, 4),
         )
         out = []
@@ -577,14 +631,136 @@ class RequestBroker:
         return out
 
     # graftcheck: hot-path
-    def _decode_record(self, req: ServeRequest, eng: str, use_dev: bool,
-                       cap_box: list):
+    def _flush_group(self, sess: Session, batch: list, results: dict,
+                     fail, *, island_states, post_states,
+                     obs_based: bool) -> tuple:
+        """One model's slice of a flush (the pre-registry flush body, with
+        the owning session and ITS island labeling threaded through).
+        Returns (n_flat, n_singles, n_posterior) for the flush event."""
+        cfg = self.config
+        eng = sess.decode_engine()
+        use_dev, cap_box = sess.island_policy(
+            device_eligible=True,
+            ineligible_msg="unreachable: serve requests no path dumps",
+        )
+        flat: list = []  # batch-eligible decode requests
+        singles: list = []  # decode requests for the per-record path
+        posts: list = []
+        S = sess.params.n_symbols
+        for req in batch:
+            if req.kind == "posterior":
+                posts.append(req)
+            elif (
+                0 < req.symbols.size <= pipeline.SMALL_RECORD_MAX
+                and req.symbols.size <= cfg.flush_symbols
+                # Pad-FIRST records fall outside the reduced flat
+                # stream's exactness domain — demote to the per-record
+                # path, whose _engine_for_record applies the existing
+                # host-entry dense-demotion rule.
+                and not (eng == "onehot" and int(req.symbols[0]) >= S)
+            ):
+                flat.append(req)
+            else:
+                singles.append(req)
+        if len(flat) == 1:
+            # Mirror decode_file's flush_small: a single record skips
+            # the batch layout and decodes through the record path.
+            singles.extend(flat)
+            flat = []
+
+        if flat:
+            try:
+                _nsp, parts, _paths = pipeline._decode_small_batch(
+                    sess.params,
+                    [(r.name or ".", r.symbols) for r in flat],
+                    batch_decode=sess.batch_decode_fn(eng),
+                    min_len=cfg.min_len,
+                    island_states=island_states,
+                    use_device_islands=use_dev,
+                    cap_box=cap_box,
+                    want_paths=False,
+                    timer=self._timer,
+                    defer=False,
+                    supervisor=sess.supervisor,
+                    engine_label=eng,
+                )
+                for req, calls in zip(flat, parts):
+                    results[req.id] = ServeResult(
+                        id=req.id, tenant=req.tenant, kind=req.kind,
+                        calls=calls, n_symbols=int(req.symbols.size),
+                        route="flat",
+                    )
+            except Exception as e:
+                for req in flat:
+                    fail(req, e)
+        for req in singles:
+            try:
+                calls, route = self._decode_record(
+                    sess, req, eng, use_dev, cap_box, island_states
+                )
+                results[req.id] = ServeResult(
+                    id=req.id, tenant=req.tenant, kind=req.kind,
+                    calls=calls, n_symbols=int(req.symbols.size),
+                    route=route,
+                )
+            except Exception as e:
+                fail(req, e)
+        fb_eng = sess.fb_engine() if posts else None
+        for req in posts:
+            try:
+                conf, conf_sum, calls = self._posterior_record(
+                    sess, req, fb_eng, use_dev, cap_box, post_states,
+                    obs_based,
+                )
+                results[req.id] = ServeResult(
+                    id=req.id, tenant=req.tenant, kind=req.kind,
+                    calls=calls, conf=conf, conf_sum=conf_sum,
+                    n_symbols=int(req.symbols.size), route="posterior",
+                )
+            except Exception as e:
+                fail(req, e)
+        return len(flat), len(singles), len(posts)
+
+    # graftcheck: hot-path
+    def _compare_record(self, req: ServeRequest) -> ServeResult:
+        """One compare request: the family comparison over the registry's
+        member sessions (family.compare_record — the same record units the
+        posterior path runs, each member under ITS model's session, so
+        per-model breaker domains hold).  The winner track rides in the
+        standard ``calls`` field; per-model log-odds in ``compare``."""
+        from cpgisland_tpu import family
+
+        members = [self.registry.member(n) for n in req.models]
+        rc = family.compare_record(
+            members, req.symbols, record=req.name or ".",
+            min_len=self.config.min_len,
+            sessions=self.registry.sessions_for(req.models),
+        )
+        return ServeResult(
+            id=req.id, tenant=req.tenant, kind=req.kind,
+            calls=rc.winner_calls,
+            compare={
+                "baseline": rc.baseline,
+                "models": {
+                    m.name: {
+                        "loglik": m.loglik,
+                        "log_odds": m.log_odds,
+                        "islands": len(m.calls),
+                    }
+                    for m in rc.members
+                },
+            },
+            n_symbols=int(req.symbols.size), route="compare",
+        )
+
+    # graftcheck: hot-path
+    def _decode_record(self, sess: Session, req: ServeRequest, eng: str,
+                       use_dev: bool, cap_box: list, island_states):
         """One decode request outside the flat batch: the per-record shared
         path (viterbi_sharded, span-threaded beyond the decode span) —
         the same units decode_file's decode_one drives."""
         from cpgisland_tpu.parallel import decode as par_decode
 
-        sess = self.session
         symbols = req.symbols
         span = self.config.decode_span
         route = "span" if symbols.size > span else "record"
@@ -627,27 +803,27 @@ class RequestBroker:
                 engine=f"decode.{eng}", items=float(symbols.size),
             )
             calls = self._device_calls(
-                full, symbols, self.config.island_states, cap_box
+                sess, full, symbols, island_states, cap_box
             )
         else:
             pieces = dispatch()
             full = pieces[0] if len(pieces) == 1 else np.concatenate(pieces)
-            calls = self._host_calls(full, symbols, self.config.island_states)
+            calls = self._host_calls(full, symbols, island_states)
         return calls.with_names(req.name or "."), route
 
     # graftcheck: hot-path
-    def _posterior_record(self, req: ServeRequest, fb_eng: str,
-                          use_dev: bool, cap_box: list):
+    def _posterior_record(self, sess: Session, req: ServeRequest,
+                          fb_eng: str, use_dev: bool, cap_box: list,
+                          post_states, obs_based: bool):
         """One posterior request: the SAME shared record unit
         posterior_file's single-record path runs, then island calls from
         the MPM path — (conf host array, exact f64 conf sum, calls)."""
-        sess = self.session
         symbols = req.symbols
         # engine = the raw session request (re-resolves per dispatch
         # against the session breaker, like posterior_file); fb_eng = the
         # flush-resolved name, labels only.
         conf, path = pipeline._posterior_record_unit(
-            sess.params, symbols, self._post_states, engine=sess.engine,
+            sess.params, symbols, post_states, engine=sess.engine,
             fb_eng=fb_eng, want_path=True, return_device=use_dev,
             sup=sess.supervisor,
         )
@@ -658,13 +834,13 @@ class RequestBroker:
                 fetch_sharded_prefix(conf, conf.shape[0], False)
             )
             calls = self._device_calls(
-                path, symbols,
-                self._post_states if self._obs_based else None, cap_box,
+                sess, path, symbols,
+                post_states if obs_based else None, cap_box,
             )
         else:
             calls = self._host_calls(
                 path, symbols,
-                self._post_states if self._obs_based else None,
+                post_states if obs_based else None,
             )
         # graftcheck: allow(hot-path-host-sync) -- conf is host on both branches (the device branch fetched it through obs.note_fetch above; the host branch's posterior_sharded fetched internally); coercion only
         conf = np.asarray(conf)
@@ -686,7 +862,7 @@ class RequestBroker:
             min_len=self.config.min_len,
         )
 
-    def _device_calls(self, path, symbols, island_states,
+    def _device_calls(self, sess: Session, path, symbols, island_states,
                       cap_box: list) -> IslandCalls:
         """Device island calling with the learned-cap overflow retry — the
         pipelines' serial device branch."""
@@ -697,7 +873,6 @@ class RequestBroker:
             call_islands_device_obs,
         )
 
-        sess = self.session
         if island_states is not None:
             return pipeline._device_calls_retry(
                 call_islands_device_obs, path, jnp.asarray(symbols),

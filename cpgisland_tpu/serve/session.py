@@ -39,7 +39,7 @@ from typing import Optional
 from cpgisland_tpu import resilience
 from cpgisland_tpu.models.hmm import HmmParams
 
-__all__ = ["Session"]
+__all__ = ["Session", "ModelRegistry"]
 
 
 class Session:
@@ -190,3 +190,96 @@ class Session:
         """Release session-held prepared-stream cache entries promptly
         (the daemon's drop-a-tenant hook; see ops.prepared.evict)."""
         self.streams.clear_session()
+
+
+class ModelRegistry:
+    """Named-model registry: one daemon serving a model FAMILY.
+
+    Maps a model name to its (family Member metadata, Session) pair.  The
+    DEFAULT session serves requests that carry no ``model=`` field —
+    byte-identical single-model behavior; every registered member gets its
+    OWN Session with a PRIVATE breaker, so one model's kernel-shaped
+    faults demote engines for that model only (the same isolation rule as
+    per-tenant sessions, applied per model).  Duplicate names are rejected
+    at registration; unknown names are rejected at broker ADMISSION
+    (``RequestBroker.submit`` looks sessions up here).
+
+    Thread contract: ``register`` and the lookups are lock-guarded (a
+    transport thread admits while the worker flushes); Sessions keep
+    their own locking.
+    """
+
+    def __init__(self, default: Session) -> None:
+        self._lock = threading.Lock()
+        self._default = default
+        self._entries: dict = {}  # name -> (Member | None, Session)
+
+    @property
+    def default(self) -> Session:
+        return self._default
+
+    def register(
+        self,
+        member,
+        *,
+        engine: str = "auto",
+        island_engine: str = "auto",
+        session: "Optional[Session]" = None,
+        **session_kw,
+    ) -> Session:
+        """Register one family member (``family.Member``).  Builds a
+        private-breaker Session for it unless ``session`` is given.
+        Raises ValueError on a duplicate name."""
+        name = member.name
+        if session is None:
+            session = Session(
+                member.params, engine=engine, island_engine=island_engine,
+                name=f"model:{name}", private_breaker=True, **session_kw,
+            )
+        with self._lock:
+            if name in self._entries:
+                raise ValueError(
+                    f"duplicate model name {name!r} in the registry"
+                )
+            self._entries[name] = (member, session)
+        return session
+
+    def session(self, name: str = "") -> Session:
+        """The session serving ``name`` ('' = the default model).
+        KeyError on unknown names — admission surfaces it as a reject."""
+        if not name:
+            return self._default
+        with self._lock:
+            try:
+                return self._entries[name][1]
+            except KeyError:
+                raise KeyError(f"unknown model {name!r}") from None
+
+    def member(self, name: str):
+        """The family Member registered under ``name`` (KeyError when
+        unknown — the default session has no member metadata unless it
+        was also registered by name)."""
+        with self._lock:
+            try:
+                m = self._entries[name][0]
+            except KeyError:
+                raise KeyError(f"unknown model {name!r}") from None
+        if m is None:
+            raise KeyError(f"model {name!r} has no member metadata")
+        return m
+
+    def names(self) -> tuple:
+        with self._lock:
+            return tuple(self._entries)
+
+    def sessions_for(self, names) -> dict:
+        """name -> Session map for a compare request's member set."""
+        return {n: self.session(n) for n in names}
+
+    def close(self) -> None:
+        """Release every registered session's prepared-stream entries
+        (the default session belongs to the caller)."""
+        with self._lock:
+            entries = list(self._entries.values())
+        for _, sess in entries:
+            sess.close()

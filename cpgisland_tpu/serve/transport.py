@@ -26,8 +26,16 @@ Requests::
     {"id": 1, "kind": "decode",    "seq": "ACGT...", "tenant": "t0",
      "name": "chr1", "want_conf": false}
     {"id": 2, "kind": "posterior", "seq": "..."}
+    {"id": 3, "kind": "decode",    "seq": "...", "model": "two_state"}
+    {"id": 4, "kind": "compare",   "seq": "...",
+     "models": ["durbin8", "two_state", "null"]}
     {"op": "stats"}
     {"op": "shutdown"}
+
+``model`` routes a decode/posterior request to a named family member
+registered at daemon startup (``--family``; unknown names are rejected at
+admission); ``compare`` evaluates the named members over one stream and
+responds with per-model log-odds plus the winner track as island records.
 
 ``id`` must be a client-unique integer (it keys the resume manifest).
 ``tenant`` defaults to ``"default"``; ``name`` defaults to ``req<id>``.
@@ -95,6 +103,11 @@ def result_to_wire(r: ServeResult, *, backpressure: bool = False,
     if r.calls is not None:
         out["islands"] = calls_to_wire(r.calls)
         out["islands_text"] = r.calls.format_lines()
+    if r.compare is not None:
+        # compare: per-model loglik/log-odds; the winner track already
+        # rides in islands/islands_text above (member names in the name
+        # column).
+        out["compare"] = r.compare
     if r.kind == "posterior":
         if r.conf_sum is not None:
             out["conf_sum"] = float(r.conf_sum).hex()
@@ -154,6 +167,8 @@ def _admit_request(
                 kind=kind,
                 symbols=symbols,
                 name=str(req.get("name", f"req{rid}")),
+                model=str(req.get("model", "")),
+                models=req.get("models"),
             )
         except BaseException:
             unclaim(rid)
@@ -274,10 +289,10 @@ def serve_stream(
 
 
 def _build_broker(args, params) -> RequestBroker:
-    """CLI args -> Session + RequestBroker (the ONE construction shared by
-    the stdio and socket servers)."""
+    """CLI args -> Session (+ family ModelRegistry) + RequestBroker (the
+    ONE construction shared by the stdio and socket servers)."""
     from cpgisland_tpu.serve.broker import BrokerConfig
-    from cpgisland_tpu.serve.session import Session
+    from cpgisland_tpu.serve.session import ModelRegistry, Session
 
     session = Session(
         params,
@@ -288,6 +303,24 @@ def _build_broker(args, params) -> RequestBroker:
         name="serve",
         private_breaker=True,
     )
+    registry = ModelRegistry(session)
+    family_names = [
+        t.strip() for t in (getattr(args, "family", "") or "").split(",")
+        if t.strip()
+    ]
+    if family_names:
+        from cpgisland_tpu import family as family_mod
+
+        for member in family_mod.members_from_names(family_names):
+            # One Session per member, private breaker: one model's faults
+            # demote engines for that model only.
+            registry.register(
+                member,
+                engine=args.engine,
+                island_engine=args.island_engine,
+                island_cap=args.island_cap,
+                integrity_check=args.integrity_check,
+            )
     config = BrokerConfig(
         flush_symbols=args.flush_symbols,
         flush_deadline_s=args.flush_deadline_ms / 1e3,
@@ -297,7 +330,7 @@ def _build_broker(args, params) -> RequestBroker:
         island_states=args.island_states,
     )
     return RequestBroker(
-        session, config,
+        session, config, registry=registry,
         manifest_path=args.manifest, resume=args.resume,
     )
 
@@ -323,6 +356,7 @@ def serve_main(args, params) -> int:
         )
     finally:
         broker.close()
+        broker.registry.close()
 
 
 # ---------------------------------------------------------------------------

@@ -71,7 +71,7 @@ def resolve_fb_engine(engine: str, params: HmmParams, mode: str) -> str:
             and mode == "rescaled"
             and fb_pallas.supports(params)
         ):
-            from cpgisland_tpu.ops import fb_onehot
+            from cpgisland_tpu.family import partition as family_partition
 
             # The reduced one-hot path needed its own stats kernel to win
             # here: with the dense stats pass (streams scattered back to
@@ -79,12 +79,10 @@ def resolve_fb_engine(engine: str, params: HmmParams, mode: str) -> str:
             # reduced-stream stats kernel (fb_onehot._oh_stats_kernel,
             # 16 B/symbol read, in-register scatter) it measured
             # 977 -> 1340.  That kernel lowers only for power-of-two
-            # n_symbols, which the one-hot eligibility (2 states/symbol,
-            # K <= 8 => S <= 4) does not itself guarantee — gate on both.
-            if (
-                fb_onehot.supports(params)
-                and params.n_symbols & (params.n_symbols - 1) == 0
-            ):
+            # n_symbols, which the one-hot eligibility alone does not
+            # guarantee — family.reduced_stats_eligible gates on both
+            # (the one copy of this check, shared with the other routers).
+            if family_partition.reduced_stats_eligible(params):
                 resolved = "onehot"
             else:
                 resolved = "pallas"
@@ -100,17 +98,17 @@ def resolve_fb_engine(engine: str, params: HmmParams, mode: str) -> str:
     if engine in ("pallas", "onehot") and mode != "rescaled":
         raise ValueError(f"{engine} E-step implements rescaled numerics only")
     if engine == "onehot":
-        from cpgisland_tpu.ops import fb_onehot
+        from cpgisland_tpu.family import partition as family_partition
 
         if not fb_pallas.supports(params):
             raise ValueError(
                 f"onehot E-step kernels need n_states <= 8, got "
                 f"{params.n_states}"
             )
-        if fb_onehot.supports_concrete(params) is False:
+        if family_partition.reduced_eligible_concrete(params) is False:
             raise ValueError(
-                "engine='onehot' needs one-hot emissions with 2 states per "
-                "symbol"
+                "engine='onehot' needs a one-hot emission-support "
+                "partition with 2 states per symbol (family.partition_of)"
             )
     return engine
 
@@ -543,13 +541,14 @@ def _use_fused_seq(engine: str, params: HmmParams, shard_len: int) -> bool:
                 f"{params.n_states} states"
             )
         if engine == "onehot":
-            from cpgisland_tpu.ops import fb_onehot
+            from cpgisland_tpu.family import partition as family_partition
 
             # None = traced params (undecidable): trust the explicit choice.
-            if fb_onehot.supports_concrete(params) is False:
+            if family_partition.reduced_eligible_concrete(params) is False:
                 raise ValueError(
-                    "engine='onehot' needs one-hot emissions with 2 states "
-                    "per symbol"
+                    "engine='onehot' needs a one-hot emission-support "
+                    "partition with 2 states per symbol "
+                    "(family.partition_of)"
                 )
         return True
     return (
@@ -566,9 +565,9 @@ def _seq_onehot(engine: str, params: HmmParams) -> bool:
     if engine == "onehot":
         return True
     if engine == "auto":
-        from cpgisland_tpu.ops import fb_onehot
+        from cpgisland_tpu.family import partition as family_partition
 
-        return fb_onehot.supports(params)
+        return family_partition.reduced_eligible(params)
     return False
 
 

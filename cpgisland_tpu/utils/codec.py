@@ -648,3 +648,60 @@ def encode_byte_range_cached(
 def decode_symbols(symbols: np.ndarray) -> str:
     """Inverse mapping (0..3 -> 'acgt') for debugging and test fixtures."""
     return _BASE_CHARS[np.asarray(symbols, dtype=np.uint8)].tobytes().decode("ascii")
+
+
+def recode_pairs(
+    symbols: np.ndarray, n_symbols: int = N_SYMBOLS,
+    prev: Optional[int] = None,
+) -> np.ndarray:
+    """Recode a base-alphabet stream to the PAIR (dinucleotide) alphabet.
+
+    ``out[t] = symbols[t-1] * n_symbols + symbols[t]`` — S^2 pair symbols,
+    position-aligned with the input so island coordinates and prev-sym
+    threading carry over unchanged.  This is the codec-layer half of the
+    order-2 family members (family.members.dinuc): the model stays a plain
+    first-order HMM, the OBSERVATION carries the left context.
+
+    Positions with no real left context — the stream's first position
+    unless ``prev`` supplies the symbol before it (span/stream
+    continuation threading, the engines' ``prev_sym`` contract), and any
+    real position directly after a PAD/masked input symbol — recode to
+    the SELF-CONTEXT pair ``(cur, cur)``.  Self-context keeps the stream
+    fully in-alphabet and CHAIN-CONSISTENT (the only property consecutive
+    pairs must satisfy is prev-of-next == cur-of-this, which any pair
+    ending in ``cur`` provides): pair-chained models like
+    ``presets.dinuc_cpg`` carry structural transition zeros between
+    non-chaining pairs, and the forward-backward machinery scores
+    in-length PAD sentinels as clamped observations (its PAD handling is
+    positional/tail-based), so an out-of-alphabet "no context" marker
+    would zero the chain outright rather than skip the position.  The
+    cost is one fabricated left context per segment opening — position 0
+    only, under the default skip-policy encode.  A PAD input symbol
+    itself stays PAD (order-2 members reject such streams at encode —
+    see family.members.Member.encode).
+
+    uint8 output (n_symbols <= 15; the DNA alphabet's pair space is 16
+    symbols + PAD 16).
+    """
+    if n_symbols * n_symbols >= 255:
+        raise ValueError(
+            f"pair alphabet {n_symbols}^2 does not fit the uint8 symbol "
+            "stream"
+        )
+    s = np.asarray(symbols)
+    pad = np.uint8(n_symbols * n_symbols)
+    out = np.full(s.shape, pad, dtype=np.uint8)
+    if s.size == 0:
+        return out
+    cur = s.astype(np.int32)
+    prv = np.empty_like(cur)
+    prv[1:] = cur[:-1]
+    prv[0] = (
+        int(prev) if prev is not None and 0 <= int(prev) < n_symbols
+        else n_symbols
+    )
+    real = cur < n_symbols
+    # Unknown left context -> self-context (see docstring).
+    prv = np.where(real & (prv >= n_symbols), cur, prv)
+    out[real] = (prv[real] * n_symbols + cur[real]).astype(np.uint8)
+    return out

@@ -1,0 +1,529 @@
+"""Model-family layer tests: the partition oracle, router agreement,
+dense-vs-reduced parity for the new members, and the compare workload's
+acceptance contract (bit-identity + zero fresh compiles)."""
+
+import io
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cpgisland_tpu import family
+from cpgisland_tpu.family import partition as fam
+from cpgisland_tpu.models import presets
+from cpgisland_tpu.models.hmm import HmmParams, sample_sequence
+from cpgisland_tpu.utils import codec
+
+
+def _members_matrix():
+    """(name, params) for every preset/family shape the routers must agree
+    on."""
+    key = jax.random.PRNGKey(0)
+    return [
+        ("durbin8", presets.durbin_cpg8()),
+        ("two_state", presets.two_state_cpg()),
+        ("dinuc_cpg", presets.dinuc_cpg()),
+        ("null4", presets.null_background(4)),
+        ("null16", presets.null_background(16)),
+        ("rand_g2_s4", presets.random_hmm(key, 8, 4, partition=2)),
+        ("rand_g2_s8", presets.random_hmm(key, 16, 8, partition=2)),
+        ("rand_g2_s16", presets.random_hmm(key, 32, 16, partition=2)),
+        ("rand_g3", presets.random_hmm(key, 12, 4, partition=3)),
+        ("rand_dense", presets.random_hmm(key, 8, 4)),
+    ]
+
+
+# ---------------------------------------------------------------------------
+# partition oracle
+
+
+def test_partition_flagship_structure():
+    p = fam.partition_of(presets.durbin_cpg8())
+    assert p is not None
+    assert p.n_blocks == 4 and p.uniform == 2 and p.onehot and p.reduced
+    # Group table = the reference labeling: symbol x <- states (x, x+4).
+    np.testing.assert_array_equal(
+        p.group_table, np.stack([np.arange(4), np.arange(4) + 4], axis=1)
+    )
+    assert p.entry_group(2) == (2, 6)
+
+
+def test_partition_dinuc_structure():
+    p = fam.partition_of(presets.dinuc_cpg())
+    assert p is not None
+    assert p.n_blocks == 16 and p.uniform == 2 and p.reduced
+    # Pair symbol o <- states (o, o+16): the +/- pair states.
+    np.testing.assert_array_equal(
+        p.group_table, np.stack([np.arange(16), np.arange(16) + 16], axis=1)
+    )
+
+
+def test_partition_single_block_not_reduced():
+    # Strictly positive emissions partition trivially into ONE block —
+    # a partition, but never reduced (not one-hot).
+    p = fam.partition_of(presets.two_state_cpg())
+    assert p is not None and p.n_blocks == 1 and not p.onehot
+    assert not p.reduced
+    assert not fam.reduced_eligible(presets.two_state_cpg())
+
+
+def test_partition_rejects_overlapping_supports():
+    # sym0 <- {0,1}, sym1 <- {1,2}: overlapping, non-equal supports.
+    B = np.array([[0.5, 0.0], [0.5, 0.5], [0.0, 0.5]])
+    A = np.full((3, 3), 1.0 / 3)
+    params = HmmParams.from_probs(np.full(3, 1 / 3), A, B)
+    assert fam.partition_concrete(params) is False
+    assert fam.partition_of(params) is None
+    assert fam.reduced_eligible_concrete(params) is False
+
+
+def test_partition_traced_params_undecidable():
+    params = presets.durbin_cpg8()
+    seen = []
+
+    def f(log_B):
+        traced = HmmParams(
+            log_pi=params.log_pi, log_A=params.log_A, log_B=log_B
+        )
+        seen.append((
+            fam.partition_concrete(traced),
+            fam.reduced_eligible_concrete(traced),
+            fam.reduced_eligible(traced),
+        ))
+        return log_B
+
+    jax.make_jaxpr(f)(params.log_B)
+    assert seen == [(None, None, False)]
+
+
+def test_reduced_stats_eligibility_pow2_gate():
+    key = jax.random.PRNGKey(1)
+    assert fam.reduced_stats_eligible(presets.durbin_cpg8())
+    assert fam.reduced_stats_eligible(presets.dinuc_cpg())
+    # 2 states/symbol but a non-pow2 alphabet: reduced yes, stats no.
+    odd = presets.random_hmm(key, 6, 3, partition=2)
+    assert fam.reduced_eligible(odd)
+    assert not fam.reduced_stats_eligible(odd)
+
+
+def test_random_hmm_partition_kwarg_validates():
+    key = jax.random.PRNGKey(2)
+    with pytest.raises(ValueError, match="partition"):
+        presets.random_hmm(key, 9, 4, partition=2)
+    for g, s in ((2, 2), (2, 8), (4, 4)):
+        p = presets.random_hmm(key, g * s, s, partition=g)
+        p.validate()
+        part = fam.partition_of(p)
+        assert part is not None and part.uniform == g and part.n_blocks == s
+        assert part.reduced == (g == 2)
+
+
+# ---------------------------------------------------------------------------
+# router agreement (the four collapsed routing sites)
+
+
+def test_all_routers_agree_on_eligibility_every_preset():
+    """Satellite regression: explicit-engine validation at every router
+    accepts/rejects consistently with the ONE family oracle."""
+    from cpgisland_tpu.ops import fb_pallas
+    from cpgisland_tpu.parallel.decode import resolve_engine
+    from cpgisland_tpu.parallel.posterior import resolve_fb_engine as post_res
+    from cpgisland_tpu.train.backends import (
+        _seq_onehot,
+        resolve_fb_engine as train_res,
+    )
+
+    for name, params in _members_matrix():
+        eligible = fam.reduced_eligible(params)
+
+        def raises(fn) -> bool:
+            try:
+                fn()
+                return False
+            except ValueError:
+                return True
+
+        # decode: eligibility is exactly the family oracle.
+        assert raises(
+            lambda: resolve_engine("onehot", params)
+        ) == (not eligible), name
+        # posterior/onehot additionally needs the fused kernels' K<=8
+        # envelope; train/onehot the same.
+        fb_ok = eligible and fb_pallas.supports(params)
+        assert raises(
+            lambda: post_res("onehot", params)
+        ) == (not fb_ok), name
+        assert raises(
+            lambda: train_res("onehot", params, "rescaled")
+        ) == (not fb_pallas.supports(params) or not eligible), name
+        # the whole-sequence router's auto gate IS the family oracle.
+        assert _seq_onehot("auto", params) == eligible, name
+
+
+def test_auto_routing_agrees_under_tpu(monkeypatch):
+    """Under a (faked) TPU backend, every 'auto' router upgrades to the
+    reduced engines exactly per the family oracle."""
+    from cpgisland_tpu.ops import fb_pallas
+    from cpgisland_tpu.parallel import decode as dec_mod
+    from cpgisland_tpu.parallel import posterior as post_mod
+    from cpgisland_tpu.train import backends as train_mod
+
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    for name, params in _members_matrix():
+        eligible = fam.reduced_eligible(params)
+        d = dec_mod.resolve_engine("auto", params)
+        assert (d == "onehot") == eligible, name
+        p = post_mod.resolve_fb_engine("auto", params)
+        assert (p == "onehot") == (
+            eligible and fb_pallas.supports(params)
+        ), name
+        t = train_mod.resolve_fb_engine("auto", params, "rescaled")
+        assert (t == "onehot") == (
+            fam.reduced_stats_eligible(params) and fb_pallas.supports(params)
+        ), name
+
+
+def test_supports_wrappers_are_family_thin():
+    from cpgisland_tpu.ops import fb_onehot, viterbi_onehot
+
+    for name, params in _members_matrix():
+        assert viterbi_onehot.supports(params) == fam.reduced_eligible(
+            params
+        ), name
+        assert fb_onehot.supports_concrete(
+            params
+        ) == fam.reduced_eligible_concrete(params), name
+
+
+# ---------------------------------------------------------------------------
+# codec pair recode
+
+
+def test_recode_pairs_basic_and_prev():
+    s = np.array([0, 1, 2, 3], np.uint8)
+    out = codec.recode_pairs(s)
+    assert out[0] == 0 * 4 + 0  # no left context -> self-context pair
+    np.testing.assert_array_equal(out[1:], [0 * 4 + 1, 1 * 4 + 2, 2 * 4 + 3])
+    out2 = codec.recode_pairs(s, prev=3)
+    assert out2[0] == 3 * 4 + 0
+    # CpG event is pair index 6.
+    cg = codec.recode_pairs(np.array([1, 2], np.uint8), prev=0)
+    assert cg[1] == presets.CPG_PAIR == 6
+
+
+def test_recode_pairs_pad_propagation():
+    s = np.array([0, 4, 2, 1], np.uint8)  # mid-stream PAD (mask policy)
+    out = codec.recode_pairs(s)
+    # PAD stays PAD; real positions after it get the self-context pair
+    # (chain-consistent — see the recode_pairs docstring).
+    np.testing.assert_array_equal(out, [0, 16, 2 * 4 + 2, 2 * 4 + 1])
+    assert codec.recode_pairs(np.zeros(0, np.uint8)).size == 0
+    # ...but order-2 MEMBERS reject PAD-containing base streams outright.
+    with pytest.raises(ValueError, match="PAD-free"):
+        family.builtin_member("dinuc_cpg").encode(s)
+
+
+# ---------------------------------------------------------------------------
+# dense-vs-reduced parity for the new family members (off-TPU: the reduced
+# engines' XLA scan twins — the TPU kernels are certified by bench.py's
+# parity phase on the capturing silicon)
+
+
+def _pair_record(n, seed=0):
+    rng = np.random.default_rng(seed)
+    base = rng.integers(0, 4, size=n + 1).astype(np.uint8)
+    return codec.recode_pairs(base[1:], prev=int(base[0]))
+
+
+@pytest.mark.parametrize("member", ["dinuc", "rand_s8"])
+def test_decode_parity_family_members(member):
+    from cpgisland_tpu.ops.viterbi_parallel import viterbi_parallel
+
+    if member == "dinuc":
+        params, obs = presets.dinuc_cpg(), _pair_record(4096, 3)
+    else:
+        params = presets.random_hmm(jax.random.PRNGKey(9), 16, 8, partition=2)
+        obs = np.random.default_rng(4).integers(0, 8, size=4096).astype(np.uint8)
+    o = jnp.asarray(obs.astype(np.int32))
+    p_x, s_x = viterbi_parallel(params, o, engine="xla")
+    p_o, s_o = viterbi_parallel(params, o, engine="onehot")
+    np.testing.assert_array_equal(np.asarray(p_x), np.asarray(p_o))
+    assert abs(float(s_x) - float(s_o)) <= 2e-6 * max(abs(float(s_x)), 1.0)
+
+
+def test_decode_batch_parity_dinuc_ragged():
+    """Ragged batch geometries through the flat reset-step stream vs the
+    dense vmap route — the engines' batched contract for the new member."""
+    from cpgisland_tpu.ops.viterbi_parallel import viterbi_parallel_batch
+
+    params = presets.dinuc_cpg()
+    rng = np.random.default_rng(5)
+    lens = np.array([1000, 777, 512, 64], np.int32)
+    chunks = np.full((4, 1024), 16, np.uint8)
+    for i, ln in enumerate(lens):
+        chunks[i, :ln] = _pair_record(ln, seed=100 + i)
+    px, sx = viterbi_parallel_batch(
+        params, jnp.asarray(chunks), jnp.asarray(lens), engine="xla"
+    )
+    po, so = viterbi_parallel_batch(
+        params, jnp.asarray(chunks), jnp.asarray(lens), engine="onehot"
+    )
+    for i, ln in enumerate(lens):
+        np.testing.assert_array_equal(
+            np.asarray(px)[i, :ln], np.asarray(po)[i, :ln], err_msg=f"rec {i}"
+        )
+        # Flat scores quantize at stream magnitude (documented caveat).
+        assert abs(float(sx[i]) - float(so[i])) <= 1e-4 * abs(float(sx[i]))
+
+
+def test_posterior_and_em_parity_random_partition():
+    from cpgisland_tpu.parallel.posterior import posterior_sharded
+    from cpgisland_tpu.train.backends import LocalBackend
+
+    params = presets.random_hmm(jax.random.PRNGKey(11), 8, 4, partition=2)
+    obs = np.random.default_rng(6).integers(0, 4, size=8192).astype(np.uint8)
+    cx, px = posterior_sharded(params, obs, (0, 1), engine="xla", want_path=True)
+    co, po = posterior_sharded(
+        params, obs, (0, 1), engine="onehot", want_path=True
+    )
+    assert float(np.abs(np.asarray(cx) - np.asarray(co)).max()) < 5e-5
+    np.testing.assert_array_equal(np.asarray(px), np.asarray(po))
+
+    chunks = jnp.asarray(obs.reshape(8, 1024))
+    lens = jnp.full(8, 1024, jnp.int32)
+    sx = LocalBackend(mode="rescaled", engine="xla")(params, chunks, lens)
+    so = LocalBackend(mode="rescaled", engine="onehot")(params, chunks, lens)
+    for nm in ("init", "trans", "emit"):
+        a, b = np.asarray(getattr(sx, nm)), np.asarray(getattr(so, nm))
+        assert float(np.abs(a - b).max()) < 2e-3, nm
+    assert abs(float(sx.loglik) - float(so.loglik)) < 1e-2
+
+
+def test_dinuc_pair_lift_equals_flagship():
+    """The order-2 dinucleotide member over the pair stream is the exact
+    pair-state lifting of the flagship chain: same record log-likelihood
+    and the same island-confidence track (up to f32 roundoff) — the
+    strongest cross-check of the whole order-2 path."""
+    from cpgisland_tpu.ops.forward_backward import sequence_loglik
+    from cpgisland_tpu.parallel.posterior import posterior_sharded
+
+    _, obs = sample_sequence(
+        presets.durbin_cpg8(), jax.random.PRNGKey(7), 16384
+    )
+    obs = np.asarray(obs)
+    flag, dinuc = presets.durbin_cpg8(), presets.dinuc_cpg()
+    pair = codec.recode_pairs(obs)
+    ll_f = float(sequence_loglik(flag, jnp.asarray(obs.astype(np.int32))))
+    ll_d = float(sequence_loglik(dinuc, jnp.asarray(pair.astype(np.int32))))
+    # EXACT lift: every complete-path probability equals the flagship's
+    # times the 1/4 prior split of the opening (self-context) pair state,
+    # so the logliks differ by exactly -log 4 (to f32 accumulation).
+    assert abs((ll_f - np.log(4.0)) - ll_d) <= 1e-4 * abs(ll_f)
+
+    cf, _ = posterior_sharded(flag, obs, tuple(range(4)), engine="xla")
+    cd, _ = posterior_sharded(dinuc, pair, tuple(range(16)), engine="xla")
+    # The constant prior factor cancels in posteriors: identical tracks.
+    assert float(np.abs(np.asarray(cf) - np.asarray(cd)).max()) < 1e-3
+
+
+def test_sequence_loglik_matches_posterior_marginals():
+    from cpgisland_tpu.ops.forward_backward import (
+        posterior_marginals,
+        sequence_loglik,
+    )
+
+    params = presets.two_state_cpg()
+    obs = np.random.default_rng(8).integers(0, 4, size=2048).astype(np.int32)
+    _, ll_ref = posterior_marginals(params, jnp.asarray(obs))
+    ll = sequence_loglik(params, jnp.asarray(obs))
+    assert abs(float(ll) - float(ll_ref)) < 1e-3
+
+
+def test_sequence_loglik_pad_positions_unscored():
+    from cpgisland_tpu.ops.forward_backward import sequence_loglik
+
+    params = presets.durbin_cpg8()
+    obs = np.random.default_rng(9).integers(0, 4, size=256).astype(np.int32)
+    ll = float(sequence_loglik(params, jnp.asarray(obs)))
+    # Tail PAD via symbol sentinel == tail PAD via length: identical.
+    padded = np.concatenate([obs, np.full(64, 4, np.int32)])
+    assert float(sequence_loglik(params, jnp.asarray(padded))) == pytest.approx(ll, abs=1e-4)
+    assert float(
+        sequence_loglik(params, jnp.asarray(padded), 256)
+    ) == pytest.approx(ll, abs=1e-4)
+
+
+# ---------------------------------------------------------------------------
+# members + compare workload
+
+
+def test_member_registry_and_validation():
+    assert set(family.MEMBER_NAMES) == {
+        "durbin8", "two_state", "dinuc_cpg", "null", "null16"
+    }
+    with pytest.raises(ValueError, match="unknown family member"):
+        family.builtin_member("nope")
+    with pytest.raises(ValueError, match="duplicate"):
+        family.members_from_names(("null", "null"))
+    m = family.member_from_params("x", presets.durbin_cpg8())
+    assert m.island_states == (0, 1, 2, 3)
+    assert family.member_from_params("y", presets.null_background(4)).island_states == ()
+    with pytest.raises(ValueError, match="island states"):
+        family.Member("bad", presets.two_state_cpg(), (5,))
+    # Stream order is inferred from (and validated against) the alphabet:
+    # a loaded pair-alphabet model must consume the pair recode, never the
+    # base stream (it would nan-collapse on its structural zeros).
+    m16 = family.member_from_params("d", presets.dinuc_cpg())
+    assert m16.order == 2 and m16.island_states == tuple(range(16))
+    with pytest.raises(ValueError, match="4-symbol"):
+        family.Member("bad16", presets.dinuc_cpg(), (), order=1)
+    with pytest.raises(ValueError, match="16-symbol"):
+        family.Member("bad4", presets.two_state_cpg(), (0,), order=2)
+    with pytest.raises(ValueError, match="infer stream order"):
+        key = jax.random.PRNGKey(3)
+        family.member_from_params(
+            "odd", presets.random_hmm(key, 16, 8, partition=2)
+        )
+
+
+def test_winner_track_rejects_negative_threshold():
+    with pytest.raises(ValueError, match="threshold"):
+        family.winner_track(np.zeros((2, 8), np.float32), threshold=-1.0)
+
+
+def test_sequence_loglik_impossible_observation_is_neg_inf():
+    """A structurally impossible observation scores -inf, never nan (the
+    nan would poison every member's log-odds through the baseline)."""
+    from cpgisland_tpu.ops.forward_backward import sequence_loglik
+
+    dinuc = presets.dinuc_cpg()
+    # A non-chain-consistent pair stream: (a,c) followed by (g,t) — the
+    # second pair's prev 'g' != the first pair's cur 'c'.
+    bad = jnp.asarray(np.array([0 * 4 + 1, 2 * 4 + 3], np.int32))
+    ll = float(sequence_loglik(dinuc, bad))
+    assert ll == float("-inf")
+
+
+def test_compare_bit_identical_to_independent_posterior_runs():
+    """Acceptance: the 3-model comparison's per-member conf tracks and
+    island calls are BIT-IDENTICAL to independent posterior runs of the
+    same records through the shared record unit."""
+    from cpgisland_tpu import pipeline
+    from cpgisland_tpu import resilience
+    from cpgisland_tpu.ops import islands as islands_mod
+    from cpgisland_tpu.parallel.posterior import resolve_fb_engine
+
+    _, obs = sample_sequence(
+        presets.durbin_cpg8(), jax.random.PRNGKey(21), 12000
+    )
+    obs = np.asarray(obs)
+    members = family.default_members()
+    rc = family.compare_record(members, obs, record="r")
+
+    sup = resilience.default_supervisor()
+    for m in members:
+        if m.is_null:
+            assert not np.any(rc.member(m.name).conf)
+            continue
+        fb_eng = resolve_fb_engine("auto", m.params)
+        conf, path = pipeline._posterior_record_unit(
+            m.params, m.encode(obs), m.island_states, engine="auto",
+            fb_eng=fb_eng, want_path=True, return_device=False, sup=sup,
+        )
+        np.testing.assert_array_equal(
+            rc.member(m.name).conf, np.asarray(conf), err_msg=m.name
+        )
+        ref_calls = islands_mod.call_islands_obs(
+            np.asarray(path), obs, island_states=m.island_states
+        )
+        got = rc.member(m.name).calls
+        np.testing.assert_array_equal(got.beg, ref_calls.beg)
+        np.testing.assert_array_equal(got.end, ref_calls.end)
+        np.testing.assert_array_equal(got.gc_content, ref_calls.gc_content)
+
+    # log-odds: baseline resolves to the null member, whose odds are 0.
+    assert rc.baseline == "null"
+    assert rc.member("null").log_odds == 0.0
+    assert rc.member("durbin8").log_odds > 0  # data sampled from durbin8
+    # winner track: every winning index names a non-null member, and the
+    # winner's confidence beats the threshold at each claimed position.
+    w = rc.winner
+    assert w.shape == (12000,)
+    for idx in np.unique(w[w >= 0]):
+        assert not members[idx].is_null
+    confs = np.stack([m.conf for m in rc.members])
+    claimed = w >= 0
+    assert np.all(
+        confs[w[claimed], np.nonzero(claimed)[0]]
+        > family.DEFAULT_WINNER_THRESHOLD
+    )
+
+
+def test_compare_zero_fresh_compiles_on_second_stream():
+    from cpgisland_tpu import obs as obs_mod
+
+    members = family.default_members()
+    rng = np.random.default_rng(31)
+    rec1 = rng.integers(0, 4, size=5000).astype(np.uint8)
+    rec2 = rng.integers(0, 4, size=6000).astype(np.uint8)  # same pow2 bucket
+    family.compare_record(members, rec1, record="warm")
+    with obs_mod.no_new_compiles(tag="compare.second-stream"):
+        family.compare_record(members, rec2, record="steady")
+
+
+def test_compare_file_report(tmp_path):
+    from cpgisland_tpu import pipeline
+
+    _, obs = sample_sequence(
+        presets.durbin_cpg8(), jax.random.PRNGKey(13), 9000
+    )
+    obs = np.asarray(obs)
+    fa = tmp_path / "cmp.fa"
+    fa.write_text(
+        ">recA\n" + codec.decode_symbols(obs[:5000]) + "\n>recB\n"
+        + codec.decode_symbols(obs[5000:]) + "\n"
+    )
+    out = io.StringIO()
+    res = pipeline.compare_file(str(fa), out=out)
+    assert res.n_records == 2 and res.n_symbols == 9000
+    assert res.member_names == ["durbin8", "two_state", "null"]
+    text = out.getvalue().splitlines()
+    assert text[0].startswith("# cpgisland compare models=durbin8,")
+    assert "baseline=null" in text[0]
+    headers = [ln for ln in text if ln.startswith("# model ")]
+    assert len(headers) == 6  # 3 members x 2 records
+    assert all("log_odds" in h and "loglik" in h for h in headers)
+    # Winner-track lines carry record|member name columns (multi-record).
+    body = [ln for ln in text if not ln.startswith("#")]
+    assert body and all(
+        ln.split(" ", 1)[0].split("|")[0] in ("recA", "recB") for ln in body
+    )
+    names = {ln.split(" ", 1)[0].split("|")[1] for ln in body}
+    assert names <= {"durbin8", "two_state"}
+    # Unknown baseline rejected up front.
+    with pytest.raises(ValueError, match="baseline"):
+        pipeline.compare_file(str(fa), baseline="zzz")
+
+
+def test_compare_includes_order2_member():
+    """dinuc_cpg participates through its pair recode and (being the exact
+    pair lift) matches the flagship's log-odds to f32 accumulation."""
+    _, obs = sample_sequence(
+        presets.durbin_cpg8(), jax.random.PRNGKey(17), 8000
+    )
+    obs = np.asarray(obs)
+    members = family.members_from_names(("durbin8", "dinuc_cpg", "null"))
+    rc = family.compare_record(members, obs)
+    lo_f = rc.member("durbin8").log_odds
+    lo_d = rc.member("dinuc_cpg").log_odds
+    # The exact pair lift: log-odds differ by the lift's -log 4 prior
+    # constant and nothing else.
+    assert abs((lo_f - np.log(4.0)) - lo_d) <= 1e-3 * max(abs(lo_f), 1.0)
+    # Tracks live on base coordinates: dinuc islands MATCH the flagship's
+    # (identical conf tracks -> identical MPM island membership).
+    f_calls = rc.member("durbin8").calls
+    d_calls = rc.member("dinuc_cpg").calls
+    assert len(d_calls) == len(f_calls) > 0
+    np.testing.assert_array_equal(d_calls.beg, f_calls.beg)
+    np.testing.assert_array_equal(d_calls.end, f_calls.end)

@@ -1,0 +1,280 @@
+"""Family serving tests: the ModelRegistry (named models, per-model
+breaker isolation), model= request routing, and the compare request type
+— the daemon side of the model-family layer."""
+
+import numpy as np
+import pytest
+
+from cpgisland_tpu import family, resilience
+from cpgisland_tpu.models import presets
+from cpgisland_tpu.serve.broker import Backpressure, RequestBroker, BrokerConfig
+from cpgisland_tpu.serve.session import ModelRegistry, Session
+
+
+def _registry(names=("durbin8", "two_state", "null")):
+    sess = Session(presets.durbin_cpg8(), name="t", private_breaker=True)
+    reg = ModelRegistry(sess)
+    for m in family.members_from_names(names):
+        reg.register(m)
+    return sess, reg
+
+
+def _broker(reg, sess, **cfg):
+    defaults = dict(flush_symbols=1 << 15, flush_deadline_s=0.0)
+    defaults.update(cfg)
+    return RequestBroker(sess, BrokerConfig(**defaults), registry=reg)
+
+
+def _syms(n=3000, seed=0):
+    return np.random.default_rng(seed).integers(0, 4, size=n).astype(np.uint8)
+
+
+# ---------------------------------------------------------------------------
+# registry
+
+
+def test_registry_duplicate_name_rejected():
+    sess, reg = _registry(("durbin8",))
+    with pytest.raises(ValueError, match="duplicate model name"):
+        reg.register(family.builtin_member("durbin8"))
+    # ...even with a caller-supplied session.
+    with pytest.raises(ValueError, match="duplicate model name"):
+        reg.register(
+            family.builtin_member("durbin8"),
+            session=Session(presets.durbin_cpg8(), name="x"),
+        )
+
+
+def test_registry_lookup_and_default():
+    sess, reg = _registry()
+    assert reg.session("") is sess and reg.default is sess
+    assert reg.session("two_state").params is reg.member("two_state").params
+    assert set(reg.names()) == {"durbin8", "two_state", "null"}
+    with pytest.raises(KeyError, match="unknown model"):
+        reg.session("zzz")
+    with pytest.raises(KeyError, match="unknown model"):
+        reg.member("zzz")
+
+
+def test_registry_per_model_breaker_isolation():
+    """One model's faults must trip ITS session's breaker only — not the
+    default session's, not another member's, not the process-global one."""
+    sess, reg = _registry()
+    a = reg.session("durbin8")
+    b = reg.session("two_state")
+    assert a is not b and a.breaker is not b.breaker
+    assert a.breaker is not sess.breaker
+    for _ in range(8):
+        a.breaker.record_fault("decode.xla")
+    assert a.breaker.tripped("decode.xla")
+    assert not b.breaker.tripped("decode.xla")
+    assert not sess.breaker.tripped("decode.xla")
+    assert not resilience.get_breaker().tripped("decode.xla")
+
+
+# ---------------------------------------------------------------------------
+# admission
+
+
+def test_unknown_model_admission_rejected():
+    sess, reg = _registry()
+    broker = _broker(reg, sess)
+    with pytest.raises(ValueError, match="unknown model 'nope'"):
+        broker.submit(
+            request_id=1, tenant="a", kind="decode", symbols=_syms(),
+            model="nope",
+        )
+    with pytest.raises(ValueError, match="unknown model"):
+        broker.submit(
+            request_id=2, tenant="a", kind="compare", symbols=_syms(),
+            models=["durbin8", "zzz"],
+        )
+    # Nothing was admitted.
+    assert broker.pending() == 0
+
+
+def test_compare_request_validation():
+    sess, reg = _registry()
+    broker = _broker(reg, sess)
+    with pytest.raises(ValueError, match="models"):
+        broker.submit(
+            request_id=1, tenant="a", kind="compare", symbols=_syms()
+        )
+    with pytest.raises(ValueError, match="duplicate"):
+        broker.submit(
+            request_id=2, tenant="a", kind="compare", symbols=_syms(),
+            models=["null", "null"],
+        )
+    with pytest.raises(ValueError, match="compare-only"):
+        broker.submit(
+            request_id=3, tenant="a", kind="decode", symbols=_syms(),
+            models=["durbin8"],
+        )
+    with pytest.raises(ValueError, match="not model="):
+        broker.submit(
+            request_id=4, tenant="a", kind="compare", symbols=_syms(),
+            model="durbin8", models=["durbin8"],
+        )
+    # A JSON-string models field must produce an actionable error, not a
+    # char-wise "unknown model 'd'".
+    with pytest.raises(ValueError, match="list of member names"):
+        broker.submit(
+            request_id=5, tenant="a", kind="compare", symbols=_syms(),
+            models="durbin8,null",
+        )
+
+
+def test_scoring_only_member_rejected_for_direct_routing():
+    """A null member has no decode/posterior product — admission rejects
+    it with advice instead of serving meaningless empty results."""
+    sess, reg = _registry()
+    broker = _broker(reg, sess)
+    for kind in ("decode", "posterior"):
+        with pytest.raises(ValueError, match="scoring-only"):
+            broker.submit(
+                request_id=1, tenant="a", kind=kind, symbols=_syms(),
+                model="null",
+            )
+
+
+def test_order2_member_rejected_for_direct_routing():
+    sess, reg = _registry(("durbin8", "dinuc_cpg", "null16"))
+    broker = _broker(reg, sess)
+    for kind in ("decode", "posterior"):
+        with pytest.raises(ValueError, match="pair alphabet"):
+            broker.submit(
+                request_id=1, tenant="a", kind=kind, symbols=_syms(),
+                model="dinuc_cpg",
+            )
+    # ...but compare serves it fine (base stream kept for composition).
+    broker.submit(
+        request_id=5, tenant="a", kind="compare", symbols=_syms(),
+        models=["dinuc_cpg", "null16"],
+    )
+    (r,) = broker.drain()
+    assert r.ok and set(r.compare["models"]) == {"dinuc_cpg", "null16"}
+
+
+def test_compare_rejected_in_manifest_mode(tmp_path):
+    sess, reg = _registry()
+    broker = RequestBroker(
+        sess, BrokerConfig(flush_symbols=1 << 15, flush_deadline_s=0.0),
+        registry=reg, manifest_path=str(tmp_path / "m.jsonl"),
+    )
+    with pytest.raises(ValueError, match="manifest"):
+        broker.submit(
+            request_id=1, tenant="a", kind="compare", symbols=_syms(),
+            models=["durbin8", "null"],
+        )
+    broker.close()
+
+
+# ---------------------------------------------------------------------------
+# routing + results
+
+
+def test_model_routing_matches_direct_pipeline():
+    """model= routed results must equal the same units run directly
+    against that member's params (the shared-record-unit contract)."""
+    from cpgisland_tpu import pipeline
+    from cpgisland_tpu.parallel.posterior import resolve_fb_engine
+
+    sess, reg = _registry()
+    broker = _broker(reg, sess)
+    syms = _syms(4000, seed=3)
+    broker.submit(
+        request_id=1, tenant="a", kind="posterior", symbols=syms,
+        model="two_state", name="r1",
+    )
+    broker.submit(
+        request_id=2, tenant="a", kind="posterior", symbols=syms, name="r2"
+    )
+    res = {r.id: r for r in broker.drain()}
+    assert res[1].ok and res[2].ok
+
+    two = reg.member("two_state")
+    conf_ref, _ = pipeline._posterior_record_unit(
+        two.params, syms, two.island_states, engine="auto",
+        fb_eng=resolve_fb_engine("auto", two.params), want_path=True,
+        return_device=False, sup=resilience.default_supervisor(),
+    )
+    np.testing.assert_array_equal(res[1].conf, np.asarray(conf_ref))
+    # The default model (flagship) produced a different answer — the
+    # routing genuinely switched models.
+    assert not np.array_equal(res[1].conf, res[2].conf)
+
+
+def test_compare_request_matches_family_compare():
+    sess, reg = _registry()
+    broker = _broker(reg, sess)
+    syms = _syms(5000, seed=4)
+    broker.submit(
+        request_id=7, tenant="a", kind="compare", symbols=syms, name="rc",
+        models=["durbin8", "two_state", "null"],
+    )
+    (r,) = broker.drain()
+    assert r.ok and r.route == "compare"
+    rc = family.compare_record(
+        [reg.member(n) for n in ("durbin8", "two_state", "null")],
+        syms, record="rc",
+        sessions=reg.sessions_for(("durbin8", "two_state", "null")),
+    )
+    assert r.compare["baseline"] == "null"
+    for m in rc.members:
+        wire = r.compare["models"][m.name]
+        assert wire["loglik"] == pytest.approx(m.loglik)
+        assert wire["log_odds"] == pytest.approx(m.log_odds)
+        assert wire["islands"] == len(m.calls)
+    # The winner track rides in the standard calls field.
+    assert r.calls.format_lines() == rc.winner_calls.format_lines()
+
+
+def test_transport_wire_carries_model_and_compare(tmp_path):
+    """JSONL round trip: model= routing and compare responses through
+    serve_stream (the stdio transport)."""
+    import io
+    import json
+
+    from cpgisland_tpu.serve import transport
+
+    sess, reg = _registry()
+    broker = _broker(reg, sess)
+    seq = "".join("acgt"[i % 4] for i in range(2000))
+    lines = [
+        json.dumps({"id": 1, "kind": "decode", "seq": seq,
+                    "model": "two_state"}),
+        json.dumps({"id": 2, "kind": "compare", "seq": seq,
+                    "models": ["durbin8", "two_state", "null"]}),
+        json.dumps({"id": 3, "kind": "decode", "seq": seq,
+                    "model": "zzz"}),
+        json.dumps({"op": "shutdown"}),
+    ]
+    out = io.StringIO()
+    transport.serve_stream(
+        io.StringIO("\n".join(lines) + "\n"), out, broker,
+        invalid_symbols="skip", use_worker=False,
+    )
+    got = {j["id"]: j for j in map(json.loads, out.getvalue().splitlines())}
+    assert got[1]["ok"] and got[1]["kind"] == "decode"
+    assert got[2]["ok"] and set(got[2]["compare"]["models"]) == {
+        "durbin8", "two_state", "null"
+    }
+    assert "islands_text" in got[2]  # the winner track
+    assert not got[3]["ok"] and "unknown model" in got[3]["error"]
+
+
+def test_default_registry_keeps_single_model_behavior():
+    """A broker built WITHOUT a registry serves exactly as before (the
+    implicit default registry) and rejects any named model."""
+    sess = Session(presets.durbin_cpg8(), name="t", private_breaker=True)
+    broker = RequestBroker(
+        sess, BrokerConfig(flush_symbols=1 << 15, flush_deadline_s=0.0)
+    )
+    broker.submit(request_id=1, tenant="a", kind="decode", symbols=_syms())
+    (r,) = broker.drain()
+    assert r.ok
+    with pytest.raises(ValueError, match="unknown model"):
+        broker.submit(
+            request_id=2, tenant="a", kind="decode", symbols=_syms(),
+            model="durbin8",
+        )

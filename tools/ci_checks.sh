@@ -82,6 +82,16 @@ echo "== serve smoke (broker vs batch pipelines, transport, restart) =="
 # already pins serve.flush.dispatch-stable.)
 python -m pytest tests/test_serve.py -q
 
+echo "== model-family & compare smoke (partition oracle, member parity, compare workload) =="
+# The family layer's acceptance surface: family.partition_of as the single
+# eligibility oracle (all four routers agree on every preset), dense-vs-
+# reduced parity for the new members (dinuc/pair alphabet, random
+# partition families), the 3-model compare workload bit-identical to
+# independent posterior runs with zero fresh compiles on the second
+# stream, and the serve registry (model= routing, compare requests,
+# per-model breaker isolation).
+python -m pytest tests/test_family.py tests/test_serve_family.py -q
+
 echo "== graftsync slice: rule fixtures, tracker, threaded serve-mux stress =="
 # Layer 4's own tests (planted deadlock/unguarded-access fixtures must each
 # FAIL naming the offending locks/attributes; repo self-scan + lock graph
