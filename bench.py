@@ -1332,18 +1332,34 @@ def bench_compare(engine: str = "auto") -> dict:
 
     state = {}
 
-    def run_members(ms, seed: int, tag: str):
+    def run_members(ms, seed: int, tag: str, stacked: bool = True):
         rec = base.copy()
         rec[seed % n] = (rec[seed % n] + 1) % 4  # distinct request per rep
         state[tag] = family.compare_record(
-            ms, rec, record=f"bench{seed}", engine=engine
+            ms, rec, record=f"bench{seed}", engine=engine, stacked=stacked
         )
 
     def run(seed: int):
         run_members(members, seed, "rc")
 
     run(0)  # warmup: compiles per member geometry
+    # De-stacked arm warmup doubles as the bit-identity gate (same seed-0
+    # record as the stacked warmup): stacking must never change results.
+    run_members(members, 0, "rc_seq", stacked=False)
+    for a, b in zip(state["rc"].members, state["rc_seq"].members):
+        if a.loglik != b.loglik or not np.array_equal(a.conf, b.conf):
+            raise RuntimeError(
+                f"compare: stacked vs sequential diverged for {a.name} — "
+                "the bit-identity contract broke; do not publish"
+            )
     best = _best_wall(run)
+    # De-stacked wall on the SAME member set — the launch-level A/B behind
+    # the `stacked` default's on-chip decision rule (BASELINE.md
+    # "Multi-model occupancy"); identical machinery and uploads, so the
+    # wall ratio isolates the stacked launch set.
+    seq_wall = _best_wall(
+        lambda s: run_members(members, s, "rc_seq", stacked=False)
+    )
     # Same-path baseline: the SAME member set as N separate single-member
     # runs through the identical machinery (same uploads, same dispatch
     # shapes) — the acceptance framing "bit-identical to N independent
@@ -1367,6 +1383,18 @@ def bench_compare(engine: str = "auto") -> dict:
             "posterior) — phantom relay result; re-run this phase in a "
             "fresh process"
         )
+    # How many members actually grouped into a stacked dispatch under this
+    # engine/backend (0 off-TPU under auto — the CPU resolver picks xla).
+    from cpgisland_tpu.family import stacked as stacked_mod
+    from cpgisland_tpu.parallel.posterior import resolve_fb_engine
+
+    fb_engs = [
+        None if m.is_null else resolve_fb_engine(engine, m.params)
+        for m in members
+    ]
+    n_stacked = sum(
+        len(v) for v in stacked_mod.stack_groups(members, fb_engs).values()
+    )
     rc = state["rc"]
     out = {
         "compare_msym_per_s": round(tput / 1e6, 1),
@@ -1374,8 +1402,15 @@ def bench_compare(engine: str = "auto") -> dict:
         # Wall of the N separate single-member runs over the N-member
         # comparison's wall: ~1.0 = the comparison layer costs the same
         # as running each member independently (its exactness contract);
-        # > 1.0 = the shared stream/prep makes comparison cheaper.
+        # > 1.0 = the shared stream/prep/stacked launches make comparison
+        # cheaper (toward N/1 fixed-cost share once the stacked dispatch
+        # engages — r12).
         "compare_vs_separate_runs": round(sep_wall / best, 2),
+        # The launch-level A/B on the SAME member set: de-stacked wall /
+        # stacked wall (>1 = stacking wins; the on-chip decision rule for
+        # the `stacked` default, same pattern as `fused`).
+        "compare_stacked_vs_sequential": round(seq_wall / best, 2),
+        "compare_stacked_members": n_stacked,
         "compare_winner_islands": len(rc.winner_calls),
         "compare_log_odds": {
             m.name: round(m.log_odds, 2) for m in rc.members
@@ -1389,6 +1424,121 @@ def bench_compare(engine: str = "auto") -> dict:
         f"winner track {out['compare_winner_islands']} islands; "
         "fresh-input user path — upload-bound on the relayed dev setup, "
         "compare via compare_vs_separate_runs, not the absolute"
+    )
+    return out
+
+
+def bench_em_family(engine: str = "auto", n_members: int = 3) -> dict:
+    """Stacked multi-model EM iteration (fb_pallas.batch_stats_pallas_stacked
+    + per-member M-steps — train.backends.FamilyEStep's program) vs the
+    SAME members as N sequential chunked EM passes.
+
+    The family-scan training lever of ROADMAP item 2, benched per the
+    CLAUDE.md rules: chained iterations inside one jit, params-side seed
+    folds (the shared symbol batch stays byte-identical), every rep
+    fetches a small output, and a BIT-IDENTITY gate per member before any
+    timing.  Rates are model-symbols/s/iter; the plausibility gate bounds
+    the per-iteration STREAM rate by the em ceiling (a stacked launch
+    cannot outrun one ideal single-model E-step on stream symbols).
+    """
+    import dataclasses as _dc
+
+    import jax
+    import jax.numpy as jnp
+
+    from cpgisland_tpu.models import presets
+    from cpgisland_tpu.ops import fb_pallas
+    from cpgisland_tpu.train.baum_welch import em_update
+
+    if engine not in ("auto", "onehot"):
+        # The stacked E-step IS the reduced machinery — an explicit dense
+        # engine request has no stacked arm; emitting onehot figures under
+        # an xla/pallas label would misattribute the A/B.
+        log(f"em-family: skipped under --engine {engine} (reduced-only)")
+        return {}
+    on_tpu = jax.default_backend() == "tpu"
+    chunk = (1 << 16) if on_tpu else (1 << 13)
+    n_chunks = 64 if on_tpu else 8
+    chain = 4 if on_tpu else 2
+    members = tuple(
+        [presets.durbin_cpg8()]
+        + [
+            presets.random_hmm(jax.random.PRNGKey(i), 8, 4, partition=2)
+            for i in range(1, n_members)
+        ]
+    )
+    rng = np.random.default_rng(29)
+    chunks = jnp.asarray(
+        rng.integers(0, 4, size=(n_chunks, chunk)).astype(np.uint8)
+    )
+    lengths = jnp.full(n_chunks, chunk, jnp.int32)
+    total = n_chunks * chunk
+
+    st = fb_pallas.batch_stats_pallas_stacked(members, chunks, lengths)
+    for m, p in enumerate(members):
+        ref = fb_pallas.batch_stats_pallas(p, chunks, lengths, onehot=True)
+        for f in ("init", "trans", "emit", "loglik"):
+            if not bool(jnp.all(getattr(st[m], f) == getattr(ref, f))):
+                raise RuntimeError(
+                    f"em-family member {m}: stacked != sequential {f} — "
+                    "the bit-identity contract broke; do not publish"
+                )
+
+    def make(stacked: bool):
+        # Data arrives as ARGUMENTS, never closed over (the remote-compile
+        # rule: a baked constant ships with the program bytes).
+        @jax.jit
+        def chained(ps, chunks, lengths, s):
+            ps = tuple(
+                _dc.replace(
+                    p, log_pi=p.log_pi - s.astype(jnp.float32) * 1e-7
+                )
+                for p in ps
+            )
+
+            def body(ps, _):
+                if stacked:
+                    stats = fb_pallas.batch_stats_pallas_stacked(
+                        ps, chunks, lengths
+                    )
+                else:
+                    stats = tuple(
+                        fb_pallas.batch_stats_pallas(
+                            p, chunks, lengths, onehot=True
+                        )
+                        for p in ps
+                    )
+                return tuple(
+                    em_update(p, stx)[0] for p, stx in zip(ps, stats)
+                ), None
+
+            ps, _ = jax.lax.scan(body, ps, None, length=chain)
+            return ps[0].log_pi
+
+        return chained
+
+    out = {"em_family_members": n_members, "em_family_mi": total >> 20}
+    walls = {}
+    for arm in ("sequential", "stacked"):
+        fn = make(arm == "stacked")
+        jax.block_until_ready(fn(members, chunks, lengths, jnp.int32(0)))
+        best = _best_wall(
+            lambda s, fn=fn: np.asarray(
+                jax.device_get(fn(members, chunks, lengths, jnp.int32(s)))
+            ).sum()
+        ) / chain
+        _check_plausible(total / best, "em")
+        walls[arm] = best
+        out[f"em_family_{arm}_msym_per_s"] = round(
+            total * n_members / best / 1e6, 1
+        )
+        log(
+            f"em-family [{arm}]: "
+            f"{total * n_members / best / 1e6:8.1f} Msym/s/iter "
+            f"model-symbols ({best * 1e3:.2f} ms/iter)"
+        )
+    out["em_family_stacked_vs_sequential"] = round(
+        walls["sequential"] / walls["stacked"], 2
     )
     return out
 
@@ -1631,6 +1781,9 @@ def _run_phase(args, on_tpu: bool) -> int:
 
     if args.phase == "compare":
         out = bench_compare(engine=args.engine)
+        # The stacked-EM config rides the compare phase (same fresh
+        # subprocess budget; both are the multi-model occupancy surface).
+        out.update(bench_em_family(engine=args.engine))
         print(json.dumps(
             {"compare": out, "armed_ceilings": armed_ceilings_record()}
         ))

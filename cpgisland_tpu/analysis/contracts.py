@@ -533,9 +533,10 @@ def _decode_family_contract() -> Contract:
 
 def _fb_family_contract() -> Contract:
     """fb.family.dinuc_cpg: the dinucleotide member's forward-backward
-    (posterior marginals) — K=32 exceeds the fused kernels' state envelope,
-    so this entry pins the DENSE XLA route it takes on every backend
-    (no pallas anywhere, f64/callback-free, dispatch-stable)."""
+    (posterior marginals) through the plain dense XLA route — the reduced
+    engines' parity TWIN for the K=32 member (which, since the K<=8 lift,
+    also routes reduced through resolve_fb_engine); this entry pins the
+    twin itself (no pallas anywhere, f64/callback-free, dispatch-stable)."""
 
     def make(scale: int = 1):
         import jax.numpy as jnp
@@ -571,6 +572,99 @@ def _compare_loglik_contract() -> Contract:
 
     return Contract(
         name="compare.loglik", make=make, base_symbols=2048, stability=True,
+    )
+
+
+def _family_trio():
+    """Three same-alphabet reduced members — the stacked contracts' cast
+    (flagship + two random one-hot-partitioned families)."""
+    import jax
+
+    from cpgisland_tpu.models import presets
+
+    return (
+        presets.durbin_cpg8(),
+        presets.random_hmm(jax.random.PRNGKey(1), 8, 4, partition=2),
+        presets.random_hmm(jax.random.PRNGKey(2), 8, 4, partition=2),
+    )
+
+
+def _posterior_stacked_contract() -> Contract:
+    """posterior.onehot.stacked3: THREE members' reduced chains in one
+    stacked launch set — the pass pin asserts the multi-model posterior
+    costs ONE pass set (2 T-scaling passes), not 3x (the de-stacking
+    regression graftcost exists to catch)."""
+
+    def make(scale: int = 1):
+        import jax.numpy as jnp
+        import numpy as np
+
+        from cpgisland_tpu.ops import fb_pallas
+
+        params_list = _family_trio()
+        o1, o2 = _obs_pair(4096 * scale, "uint8")
+        masks = tuple(
+            jnp.asarray((np.arange(8) < 4).astype(np.float32))
+            for _ in params_list
+        )
+        fn = lambda o: fb_pallas._seq_posterior_core_stacked(
+            params_list, o, o.shape[0], masks, 512, 256, axis=None
+        )[0]
+        return fn, (o1,), (o2,)
+
+    return Contract(
+        name="posterior.onehot.stacked3", make=make, base_symbols=4096,
+        cost_scales=(16, 32), expect_pallas_on_tpu=True,
+    )
+
+
+def _em_chunked_stacked_contract() -> Contract:
+    """em.chunked.onehot.stacked3: the stacked multi-model E-step
+    (train.backends.FamilyEStep) — ONE co-scheduled chain pass for all
+    three members."""
+
+    def make(scale: int = 1):
+        import jax.numpy as jnp
+
+        from cpgisland_tpu.train.backends import FamilyEStep
+
+        params_list = _family_trio()
+        n = 8 * scale
+        o1, o2 = _obs_pair(n * 1024, "uint8")
+        lengths = jnp.full(n, 1024, jnp.int32)
+        estep = FamilyEStep()
+        fn = lambda c: estep(params_list, c.reshape(n, 1024), lengths)
+        return fn, (o1,), (o2,)
+
+    return Contract(
+        name="em.chunked.onehot.stacked3", make=make, base_symbols=8 * 1024,
+        cost_scales=(16, 32), expect_pallas_on_tpu=True,
+    )
+
+
+def _decode_batch_flat_stacked_contract() -> Contract:
+    """decode.batch_flat.onehot.stacked3: three members' flat batched
+    decode in one stacked pass triple (shared reset-step stream)."""
+
+    def make(scale: int = 1):
+        import jax.numpy as jnp
+
+        from cpgisland_tpu.ops.viterbi_onehot import (
+            decode_batch_flat_stacked_jit,
+        )
+
+        params_list = _family_trio()
+        T = 512 * scale
+        o1, o2 = _obs_pair(4 * T, "int32")
+        lengths = jnp.full(4, T, jnp.int32)
+        fn = lambda c: decode_batch_flat_stacked_jit(
+            params_list, c.reshape(4, T), lengths, block_size=256
+        )
+        return fn, (o1,), (o2,)
+
+    return Contract(
+        name="decode.batch_flat.onehot.stacked3", make=make,
+        expect_pallas_on_tpu=True, base_symbols=4 * 512,
     )
 
 
@@ -624,6 +718,13 @@ def default_contracts() -> list[Contract]:
         _decode_family_contract(),
         _fb_family_contract(),
         _compare_loglik_contract(),
+        # Multi-model kernel occupancy (ROADMAP item 2): N members' chains
+        # in ONE launch set — the pass pins assert constant T-scaling pass
+        # counts in N (a de-stacked member re-growing its own pass set is
+        # a red build naming the regrown scans).
+        _posterior_stacked_contract(),
+        _em_chunked_stacked_contract(),
+        _decode_batch_flat_stacked_contract(),
     ]
 
 

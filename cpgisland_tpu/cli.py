@@ -259,6 +259,12 @@ def build_parser() -> argparse.ArgumentParser:
         help="kernel lowering request applied to every member (auto "
         "resolves per member's family eligibility)",
     )
+    cp.add_argument(
+        "--no-stacked", action="store_true",
+        help="disable the stacked multi-model dispatch (same-order reduced "
+        "members in ONE launch set; results are bit-identical either way "
+        "— this is the launch-level A/B escape hatch)",
+    )
     _add_invalid_symbols_flag(cp)
     _add_obs_flags(cp)
     _add_symbol_cache_flag(cp)
@@ -307,6 +313,12 @@ def build_parser() -> argparse.ArgumentParser:
     sv.add_argument(
         "--tenant-max-symbols", type=_positive_int, default=512 << 20,
         help="per-tenant queued-symbol cap",
+    )
+    sv.add_argument(
+        "--no-stacked", action="store_true", dest="no_stacked",
+        help="disable multi-model kernel stacking (compare flushes + "
+        "mixed-model decode flushes run the sequential per-model arm; "
+        "results identical modulo the flat decoder's pinned tie contract)",
     )
     sv.add_argument(
         "--family", metavar="NAMES", default="",
@@ -672,6 +684,7 @@ def _run_command(args, compat, pipeline, presets, load_text, observer=None) -> i
             symbol_cache=args.symbol_cache,
             invalid_symbols=args.invalid_symbols,
             metrics=metrics,
+            stacked=not args.no_stacked,
         )
         n_winner = sum(len(rc.winner_calls) for rc in res.records)
         print(

@@ -9,8 +9,12 @@ members, and the multi-model posterior-comparison workload.
   null background) routing through the existing engine registry.
 - :mod:`cpgisland_tpu.family.compare` — N members over one prepared
   stream: per-model log-odds, per-model islands, winner track.
+- :mod:`cpgisland_tpu.family.stacked` — multi-model kernel occupancy:
+  same-order reduced members grouped into ONE stacked launch set
+  (ops.fb_onehot's stacked kernels), bit-identical to the sequential arm.
 """
 
+from cpgisland_tpu.family import stacked  # noqa: F401  (public submodule)
 from cpgisland_tpu.family.compare import (
     DEFAULT_WINNER_THRESHOLD,
     MemberResult,

@@ -37,11 +37,14 @@ against ``null16``).
 from __future__ import annotations
 
 import dataclasses
+import logging
 from typing import Optional
 
 import numpy as np
 
 from cpgisland_tpu.family.members import Member
+
+log = logging.getLogger(__name__)
 
 __all__ = [
     "MemberResult", "RecordComparison", "compare_record", "winner_calls",
@@ -203,6 +206,8 @@ def compare_record(
     prev: Optional[int] = None,
     sessions=None,
     supervisor=None,
+    stacked: bool = True,
+    streams_handle=None,
 ) -> RecordComparison:
     """Compare ``members`` over one base-alphabet record (see module
     docstring).
@@ -211,14 +216,29 @@ def compare_record(
     member's dispatches run under that session's supervisor/breaker (the
     daemon's per-model fault domains).  ``prev`` threads the base before
     the record into order-2 recodes (stream continuations).
+
+    Each order's stream is encoded, pow2-padded AND device-placed ONCE,
+    shared by every member of that order (scoring pass + posterior units
+    — zero duplicate uploads on the second member).  ``stacked`` (default)
+    additionally groups same-order members whose resolved FB engine is
+    the reduced ``'onehot'`` into ONE stacked launch set
+    (family.stacked) — per-member results stay bit-identical to the
+    sequential arm; a failing stacked unit falls back to it.
+    ``streams_handle``: an ops.prepared.PreparedStreams owning the stacked
+    group's symbol-only prep (the serve registry passes its shared one).
     """
     import jax.numpy as jnp
 
     from cpgisland_tpu import obs as obs_mod
     from cpgisland_tpu import pipeline
+    from cpgisland_tpu.family import stacked as stacked_mod
     from cpgisland_tpu.ops import islands as islands_mod
     from cpgisland_tpu.ops.forward_backward import sequence_loglik
-    from cpgisland_tpu.parallel.posterior import resolve_fb_engine
+    from cpgisland_tpu.parallel.posterior import (
+        place_record_span,
+        prepare_record_span,
+        resolve_fb_engine,
+    )
 
     if not members:
         raise ValueError("compare needs at least one member")
@@ -229,42 +249,119 @@ def compare_record(
     T = symbols.shape[0]
     b_idx = resolve_baseline(members, baseline)
 
-    logliks: list = []
-    confs = np.zeros((len(members), T), np.float32)
-    calls: list = []
+    ctxs = [_member_context(m, sessions, engine, supervisor) for m in members]
     # Per-ORDER stream cache: every same-order member consumes identical
-    # bytes (base stream / one pair recode), so encode + pow2-pad once.
+    # bytes (base stream / one pair recode), so encode + pow2-pad once AND
+    # device-place once — the scoring pass shares one uploaded buffer and
+    # the posterior units one placed span (zero re-preps / duplicate
+    # uploads on the second member of an order; ledger-asserted in tests).
     streams: dict = {}
-    for i, m in enumerate(members):
-        eng, sup = _member_context(m, sessions, engine, supervisor)
-        if m.order not in streams:
-            st = m.encode(symbols, prev=prev)
-            streams[m.order] = (st, _pad_pow2(st, m.params.n_symbols))
-        stream, padded = streams[m.order]
+    for m in members:
+        if m.order in streams:
+            continue
+        st = m.encode(symbols, prev=prev)
+        padded = _pad_pow2(st, m.params.n_symbols)
+        streams[m.order] = {
+            "stream": st,
+            "padded_dev": jnp.asarray(obs_mod.note_upload(padded)),
+            "placed": None,  # posterior span placement, built on demand
+        }
 
-        def ll_unit(padded=padded, m=m, L=stream.shape[0]):
+    def order_placed(m):
+        """The order's ONE posterior placement (same pow2 bucket as
+        _posterior_record_unit, so sharing it is bit-identical)."""
+        ent = streams[m.order]
+        if ent["placed"] is None:
+            ent["placed"] = place_record_span(
+                m.params, ent["stream"],
+                pad_to=pipeline._round_pow2(
+                    ent["stream"].shape[0], floor=1 << 14
+                ),
+            )
+        return ent["placed"]
+
+    logliks: list = []
+    for i, m in enumerate(members):
+        _eng, sup = ctxs[i]
+        ent = streams[m.order]
+
+        def ll_unit(pd=ent["padded_dev"], m=m, L=ent["stream"].shape[0]):
             return float(obs_mod.note_fetch(np.asarray(
-                sequence_loglik(m.params, jnp.asarray(padded), L)
+                sequence_loglik(m.params, pd, L)
             )))
 
         logliks.append(sup.run(
             ll_unit, what="compare.loglik", engine="fb.xla",
             items=float(T),
         ))
+
+    fb_engs: list = []
+    for i, m in enumerate(members):
+        eng, sup = ctxs[i]
+        fb_engs.append(
+            None if (m.is_null or T == 0)
+            else resolve_fb_engine(eng, m.params, breaker=sup.breaker)
+        )
+
+    confs = np.zeros((len(members), T), np.float32)
+    paths: dict = {}
+    for _order, idxs in stacked_mod.stack_groups(
+        members, fb_engs, enabled=stacked
+    ).items():
+        group = [members[i] for i in idxs]
+        ent = streams[group[0].order]
+        placed = order_placed(group[0])
+        # streams_handle: a PreparedStreams (used when its alphabet
+        # matches this group's) or a provider n_symbols -> PreparedStreams
+        # (the serve registry's per-alphabet shared handles).
+        sh = streams_handle
+        if callable(sh):
+            sh = sh(group[0].params.n_symbols)
+        elif sh is not None and sh.S != group[0].params.n_symbols:
+            sh = None
+        prep = (
+            None if sh is None
+            else prepare_record_span(
+                group[0].params, placed, ent["stream"].shape[0],
+                engine="onehot", want_path=True, streams=sh,
+            )
+        )
+        try:
+            g_confs, g_paths = stacked_mod.stacked_posterior_records(
+                group, ent["stream"], placed=placed, prepared=prep,
+                sup=ctxs[idxs[0]][1],
+            )
+        except Exception as e:
+            # The group re-runs member-by-member below, each under its own
+            # session — the per-model fault domains as the degraded path.
+            log.error(
+                "stacked compare dispatch failed (%s: %s); falling back to "
+                "sequential member units", type(e).__name__, e,
+            )
+        else:
+            for k, i in enumerate(idxs):
+                confs[i] = g_confs[k]
+                paths[i] = np.asarray(g_paths[k])
+
+    calls: list = []
+    for i, m in enumerate(members):
         if m.is_null or T == 0:
             calls.append(islands_mod._empty_calls().with_names(m.name))
             continue
-        fb_eng = resolve_fb_engine(eng, m.params, breaker=sup.breaker)
-        conf, path = pipeline._posterior_record_unit(
-            m.params, stream, m.island_states, engine=eng, fb_eng=fb_eng,
-            want_path=True, return_device=False, sup=sup,
-        )
-        confs[i] = np.asarray(conf)
+        if i not in paths:
+            eng, sup = ctxs[i]
+            conf, path = pipeline._posterior_record_unit(
+                m.params, streams[m.order]["stream"], m.island_states,
+                engine=eng, fb_eng=fb_engs[i], want_path=True,
+                return_device=False, sup=sup, placed=order_placed(m),
+            )
+            confs[i] = np.asarray(conf)
+            paths[i] = np.asarray(path)
         # Membership from the member's own MPM path, composition from the
         # BASE observations (position-aligned for order-2 members too).
         calls.append(
             islands_mod.call_islands_obs(
-                np.asarray(path), symbols,
+                paths[i], symbols,
                 island_states=m.island_states, min_len=min_len,
             ).with_names(m.name)
         )

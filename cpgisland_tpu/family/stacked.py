@@ -1,0 +1,86 @@
+"""Stacked multi-model dispatch: same-order reduced members in ONE launch.
+
+The occupancy half of ROADMAP item 2: ``family.compare`` (and the serve
+broker's compare flushes) evaluate N members over the SAME symbol stream,
+and until now paid N sequential launch sets — N x the per-pass fixed cost
+the r8 attribution showed dominates.  Different members' reduced chains
+over one pair stream are exactly as independent as the r9 fused kernel's
+fwd/bwd pair, so members that (a) share a stream order (hence an
+alphabet) and (b) resolve to the reduced ``onehot`` FB engine group into
+ONE stacked dispatch (parallel.posterior.posterior_sharded_stacked →
+ops.fb_onehot's stacked kernels).
+
+Exactness contract: the stacked unit's per-member confidence/path is
+BIT-IDENTICAL to the member's own sequential record unit on the same
+placed stream/geometry (the stacked kernels run the single-model
+arithmetic per member, op for op) — so grouping changes scheduling, never
+results.  Members outside the domain (dense engines, null scorers, traced
+breaker demotions) stay on the sequential arm; a stacked unit whose
+supervised dispatch ultimately fails falls back to the sequential arm
+too, restoring the per-model fault domains as the degraded path.
+"""
+
+from __future__ import annotations
+
+import logging
+
+log = logging.getLogger(__name__)
+
+__all__ = ["stack_groups", "stacked_posterior_records"]
+
+
+def stack_groups(members, fb_engines, enabled: bool = True) -> dict:
+    """order -> member-index list for same-order members whose RESOLVED FB
+    engine is ``'onehot'`` (the stacked kernels' domain).  Groups need at
+    least 2 members — a singleton gains nothing from stacking.  ``fb_engines``
+    aligns with ``members`` (None for members that run no posterior)."""
+    if not enabled:
+        return {}
+    by_order: dict = {}
+    for i, m in enumerate(members):
+        if m.is_null or fb_engines[i] != "onehot":
+            continue
+        by_order.setdefault(m.order, []).append(i)
+    return {o: ix for o, ix in by_order.items() if len(ix) >= 2}
+
+
+def stacked_posterior_records(
+    members,
+    symbols,
+    *,
+    placed=None,
+    pad_to=None,
+    prepared=None,
+    sup=None,
+    what: str = "compare.stacked",
+):
+    """ONE stacked dispatch for a group: per-member (conf [T], path [T])
+    host arrays over one record (supervised as one unit — the group's
+    caller chooses the supervising session; on give-up the caller falls
+    back to sequential per-member units under their own supervisors)."""
+    from cpgisland_tpu import obs as obs_mod
+    from cpgisland_tpu import resilience
+    from cpgisland_tpu.parallel.posterior import posterior_sharded_stacked
+
+    params_list = tuple(m.params for m in members)
+    island_states = [m.island_states for m in members]
+    sup = sup if sup is not None else resilience.default_supervisor()
+
+    def unit():
+        # Host-fetching inside the unit blocks it, so a device fault
+        # surfaces where the supervisor's retry re-dispatches (the shared
+        # record-unit discipline of pipeline._posterior_record_unit).
+        confs, paths = posterior_sharded_stacked(
+            params_list, symbols, island_states, want_path=True,
+            pad_to=pad_to, placed=placed, prepared=prepared,
+        )
+        return confs, paths
+
+    obs_mod.event(
+        "stacked_dispatch", _dedupe=True, kind="compare",
+        n_members=len(members), order=int(members[0].order),
+    )
+    return sup.run(
+        unit, what=what, engine="fb.onehot.stacked",
+        items=float(symbols.size) * len(members),
+    )

@@ -30,6 +30,11 @@ Shared with the decode engine: group detection (`viterbi_onehot._groups`),
 the pair stream with two-level forward-fill (`viterbi_onehot._pair_stream`),
 and the lane-broadcast table trick (`_bcast_tab` — Mosaic supports [1, LT]
 sublane broadcasts but not [1, 1] scalar broadcasts).
+
+r12 adds the STACKED multi-model variants (the "Stacked multi-model
+kernels" section below): M family members' chains over one shared pair
+stream in ONE launch set, per-member arithmetic identical to the
+single-model kernels — see BASELINE.md "Multi-model occupancy".
 """
 
 from __future__ import annotations
@@ -85,12 +90,13 @@ def prob_pair_table(params: HmmParams, gt: jnp.ndarray):
 PROB_IDENT = (1.0, 0.0, 0.0, 1.0)  # the (+, x) identity matrix entries
 
 
-def _select4_prob(tile, tab_ref, nreal):
+def _select4_prob(tile, tab_ref, nreal, base=0):
     """Pair select with probability identity defaults (shared select tree —
-    viterbi_onehot._select4 parametrized by the semiring identity)."""
+    viterbi_onehot._select4 parametrized by the semiring identity; ``base``
+    keys a member's slice of a stacked multi-model table)."""
     from cpgisland_tpu.ops.viterbi_onehot import _select4
 
-    return _select4(tile, tab_ref, nreal, ident=PROB_IDENT)
+    return _select4(tile, tab_ref, nreal, ident=PROB_IDENT, base=base)
 
 
 def _oh_prod_kernel(pair_ref, tab_ref, out_ref, C_scr, *, nreal, bk):
@@ -417,10 +423,11 @@ def _oh_bwd_conf_kernel(pairnext_ref, pair_ref, lens_ref, tab_ref, csnext_ref,
     beta_scr[1:2, :] = bn1
 
 
-def _sel_sym_tables(tile, brtab_ref, gttab_ref, S):
+def _sel_sym_tables(tile, brtab_ref, gttab_ref, S, base=0):
     """(b0, b1, glow, ghigh) [8, lt] tiles keyed on the pair tile's exit
     symbol (tile & (S-1); pow2 S only — the ONE copy shared by both stats
-    kernels, whose parity-twin relationship must not drift)."""
+    kernels, whose parity-twin relationship must not drift).  ``base``:
+    static row offset of a member's slice in a stacked table."""
     key = tile & (S - 1)
     b0 = jnp.zeros(tile.shape, jnp.float32)
     b1 = jnp.zeros(tile.shape, jnp.float32)
@@ -428,10 +435,11 @@ def _sel_sym_tables(tile, brtab_ref, gttab_ref, S):
     gh = jnp.zeros(tile.shape, jnp.int32)
     for k in range(S):
         cmp = key == k
-        b0 = jnp.where(cmp, brtab_ref[2 * k : 2 * k + 1, :], b0)
-        b1 = jnp.where(cmp, brtab_ref[2 * k + 1 : 2 * k + 2, :], b1)
-        gl = jnp.where(cmp, gttab_ref[2 * k : 2 * k + 1, :], gl)
-        gh = jnp.where(cmp, gttab_ref[2 * k + 1 : 2 * k + 2, :], gh)
+        r = base + 2 * k
+        b0 = jnp.where(cmp, brtab_ref[r : r + 1, :], b0)
+        b1 = jnp.where(cmp, brtab_ref[r + 1 : r + 2, :], b1)
+        gl = jnp.where(cmp, gttab_ref[r : r + 1, :], gl)
+        gh = jnp.where(cmp, gttab_ref[r + 1 : r + 2, :], gh)
     return b0, b1, gl, gh
 
 
@@ -1227,6 +1235,918 @@ def run_fb_kernels_onehot(
         scratch_shapes=[pltpu.VMEM((GROUP, lt), jnp.float32)],
     )(pair_next, lens2, tabb, cs_next, beta0_red.T)
     return alphas2, cs, betas2, esym2
+
+
+# ---------------------------------------------------------------------------
+# Stacked multi-model kernels: M members' reduced chains in ONE launch.
+#
+# Same design as the max-plus stacked passes (ops.viterbi_onehot): the pair
+# stream is symbol-only and SHARED, each member's [NL, 2] chain state rides
+# two extra carry rows, and each step selects member m's 2x2 matrix from
+# rows [m*4*nreal, (m+1)*4*nreal) of a row-stacked lane-broadcast table.
+# Per-member arithmetic is the single-model kernel's op for op (the r9
+# fused kernel already proved independent chains interleave and fill VPU
+# issue slots) — so member m's streams are BIT-IDENTICAL to a single-model
+# launch, and N members pay ONE chain drain of fixed cost instead of N.
+# Off-TPU the twins are the single-model one-scan XLA twins over
+# lane-concatenated streams (exact: the one-hot table contraction adds
+# only exact zeros; every chain op is elementwise across lanes).
+
+# Reduced-engine state envelope: the chains themselves are K-free (2
+# components), but the stats kernels accumulate [K*K] rows per member in
+# VMEM and the boundary glue scatters [K]-vectors — 32 covers the order-2
+# dinucleotide member (ROADMAP item 2's K<=8 lift) with bounded scratch.
+ONEHOT_MAX_STATES = 32
+
+
+def check_stacked_members(params_list) -> int:
+    """Validate a stacked member set (shared alphabet, envelope) and return
+    S.  Callers group members by (order, S) before reaching here."""
+    if not params_list:
+        raise ValueError("stacked launch needs at least one member")
+    S = params_list[0].n_symbols
+    for p in params_list:
+        if p.n_symbols != S:
+            raise ValueError(
+                "stacked members must share one alphabet, got n_symbols "
+                f"{[int(q.n_symbols) for q in params_list]}"
+            )
+        if p.n_states > ONEHOT_MAX_STATES:
+            raise ValueError(
+                f"member with {p.n_states} states exceeds the reduced-"
+                f"engine envelope ({ONEHOT_MAX_STATES})"
+            )
+    return S
+
+
+def _stacked_prob_tables(params_list):
+    """Per-member (gt, tab) lists for the stacked probability-space passes."""
+    gts = [_groups(p) for p in params_list]
+    tabs = [prob_pair_table(p, gt) for p, gt in zip(params_list, gts)]
+    return gts, tabs
+
+
+def _oh_prod_stacked_kernel(pair_ref, tab_ref, out_ref, C_scr, *, nreal, bk,
+                            M):
+    """Stacked (+,x) products: member m's running 2x2 at C_scr/out rows
+    [4m, 4m+4) — one pair-tile read feeds every member's select."""
+    j = pl.program_id(1)
+    n_t = pl.num_programs(1)
+    lt = pair_ref.shape[1]
+
+    @pl.when(j == 0)
+    def _init():
+        for m in range(M):
+            C_scr[4 * m + 0 : 4 * m + 1, :] = jnp.ones((1, lt), jnp.float32)
+            C_scr[4 * m + 1 : 4 * m + 2, :] = jnp.zeros((1, lt), jnp.float32)
+            C_scr[4 * m + 2 : 4 * m + 3, :] = jnp.zeros((1, lt), jnp.float32)
+            C_scr[4 * m + 3 : 4 * m + 4, :] = jnp.ones((1, lt), jnp.float32)
+
+    C0 = tuple(
+        tuple(C_scr[4 * m + i : 4 * m + i + 1, :] for i in range(4))
+        for m in range(M)
+    )
+
+    def body(c, Cs):
+        tile = pair_ref[pl.ds(c * ROW_TILE, ROW_TILE), :]
+        sels = [
+            _select4_prob(tile, tab_ref, nreal, base=m * 4 * nreal)
+            for m in range(M)
+        ]
+        out = []
+        for m in range(M):
+            c00, c01, c10, c11 = Cs[m]
+            t00, t01, t10, t11 = sels[m]
+            for r in range(ROW_TILE):
+                a00 = t00[r : r + 1, :]
+                a01 = t01[r : r + 1, :]
+                a10 = t10[r : r + 1, :]
+                a11 = t11[r : r + 1, :]
+                n00 = c00 * a00 + c01 * a10
+                n01 = c00 * a01 + c01 * a11
+                n10 = c10 * a00 + c11 * a10
+                n11 = c10 * a01 + c11 * a11
+                c00, c01, c10, c11 = n00, n01, n10, n11
+            tot = c00 + c01 + c10 + c11
+            inv = 1.0 / jnp.maximum(tot, 1e-30)
+            out.append((c00 * inv, c01 * inv, c10 * inv, c11 * inv))
+        return tuple(out)
+
+    Cs = jax.lax.fori_loop(0, bk // ROW_TILE, body, C0)
+    for m in range(M):
+        for i in range(4):
+            C_scr[4 * m + i : 4 * m + i + 1, :] = Cs[m][i]
+
+    @pl.when(j == n_t - 1)
+    def _flush():
+        for i in range(4 * M):
+            out_ref[i : i + 1, :] = C_scr[i : i + 1, :]
+
+
+def products_reduced_stacked(params_list, pair2: jnp.ndarray, Tt: int) -> list:
+    """Stacked :func:`products_reduced`: every member's [NL, 2, 2] lane
+    products in ONE launch over the shared pair stream (per-member results
+    bit-identical to the single-model pass)."""
+    M = len(params_list)
+    S = check_stacked_members(params_list)
+    gts, tabs = _stacked_prob_tables(params_list)
+    del gts
+    NL = pair2.shape[1]
+    if _interpret():
+        return _xla_products_prob_stacked(tabs, pair2)
+    tabb = _bcast_tab(jnp.concatenate(tabs, axis=0))
+    (red_flat,) = pl.pallas_call(
+        functools.partial(
+            _oh_prod_stacked_kernel, nreal=S * S, bk=Tt, M=M
+        ),
+        grid=(NL // LANE_TILE, pair2.shape[0] // Tt),
+        in_specs=[
+            _vspec((Tt, LANE_TILE), lambda i, j: (j, i)),
+            _vspec(tabb.shape, lambda i, j: (0, 0)),
+        ],
+        out_specs=[_vspec((4 * M, LANE_TILE), lambda i, j: (0, i))],
+        out_shape=[jax.ShapeDtypeStruct((4 * M, NL), jnp.float32)],
+        scratch_shapes=[pltpu.VMEM((4 * M, LANE_TILE), jnp.float32)],
+    )(pair2, tabb)
+    return [
+        red_flat[4 * m : 4 * m + 4].T.reshape(NL, GROUP, GROUP)
+        for m in range(M)
+    ]
+
+
+def _xla_products_prob_stacked(tabs, pair2: jnp.ndarray) -> list:
+    """ONE scan over M members' reduced (+,x) lane products — per-member
+    arithmetic = :func:`_xla_products_prob` (the shared one-hot row select
+    adds only exact zeros, so member m's product is bit-identical)."""
+    M = len(tabs)
+    nP = tabs[0].shape[0]
+    NL = pair2.shape[1]
+    ident = jnp.asarray([1.0, 0.0, 0.0, 1.0], jnp.float32)
+    tab_exts = [
+        jnp.concatenate([t, jnp.broadcast_to(ident, (1, 4))], axis=0)
+        for t in tabs
+    ]
+    C0 = tuple(
+        jnp.broadcast_to(ident, (NL, 4))
+        + (pair2[0, :, None] * 0).astype(jnp.float32)
+        for _ in range(M)
+    )
+
+    def step(Cs, pk):
+        oh = jax.nn.one_hot(jnp.minimum(pk, nP), nP + 1, dtype=tabs[0].dtype)
+        new = []
+        for m in range(M):
+            T = jnp.matmul(
+                oh, tab_exts[m], precision=jax.lax.Precision.HIGHEST
+            )
+            C = Cs[m]
+            n00 = C[:, 0] * T[:, 0] + C[:, 1] * T[:, 2]
+            n01 = C[:, 0] * T[:, 1] + C[:, 1] * T[:, 3]
+            n10 = C[:, 2] * T[:, 0] + C[:, 3] * T[:, 2]
+            n11 = C[:, 2] * T[:, 1] + C[:, 3] * T[:, 3]
+            Cn = jnp.stack([n00, n01, n10, n11], axis=1)
+            new.append(
+                Cn / jnp.maximum(jnp.sum(Cn, axis=1, keepdims=True), 1e-30)
+            )
+        return tuple(new), None
+
+    Cs, _ = jax.lax.scan(step, C0, pair2)
+    return [C.reshape(NL, GROUP, GROUP) for C in Cs]
+
+
+def _oh_fwd_stacked_kernel(pair_ref, lens_ref, a0raw_ref, tab_ref,
+                           alphas_ref, carry_ref, *, nreal, Tt, M):
+    """Stacked reduced forward: member m's chain at rows [2m, 2m+2) of the
+    carries/init/outputs — _oh_fwd_kernel arithmetic per member."""
+    j = pl.program_id(1)
+    lens = lens_ref[0, :]
+    vs = []
+    for m in range(M):
+        vs.append((
+            jnp.where(j == 0, a0raw_ref[2 * m : 2 * m + 1, :],
+                      carry_ref[2 * m : 2 * m + 1, :]),
+            jnp.where(j == 0, a0raw_ref[2 * m + 1 : 2 * m + 2, :],
+                      carry_ref[2 * m + 1 : 2 * m + 2, :]),
+        ))
+
+    def body(tile_i, carry):
+        base = tile_i * ROW_TILE
+        tile = pair_ref[pl.ds(base, ROW_TILE), :]
+        sels = [
+            _select4_prob(tile, tab_ref, nreal, base=m * 4 * nreal)
+            for m in range(M)
+        ]
+        carry = list(carry)
+        for r in range(ROW_TILE):
+            t = j * Tt + base + r
+            v_t = (t < lens)[None, :]
+            rows = []
+            for m in range(M):
+                v0, v1 = carry[m]
+                t00, t01, t10, t11 = sels[m]
+                inv = 1.0 / (v0 + v1)
+                raw0 = v0 * t00[r : r + 1, :] + v1 * t10[r : r + 1, :]
+                raw1 = v0 * t01[r : r + 1, :] + v1 * t11[r : r + 1, :]
+                n0 = jnp.where(v_t, raw0 * inv, v0)
+                n1 = jnp.where(v_t, raw1 * inv, v1)
+                n0 = jnp.where(t == 0, a0raw_ref[2 * m : 2 * m + 1, :], n0)
+                n1 = jnp.where(t == 0, a0raw_ref[2 * m + 1 : 2 * m + 2, :], n1)
+                rows.extend((n0, n1))
+                carry[m] = (n0, n1)
+            alphas_ref[base + r, :, :] = jnp.concatenate(rows, axis=0)
+        return tuple(carry)
+
+    vs = jax.lax.fori_loop(0, Tt // ROW_TILE, body, tuple(vs))
+    for m in range(M):
+        carry_ref[2 * m : 2 * m + 1, :] = vs[m][0]
+        carry_ref[2 * m + 1 : 2 * m + 2, :] = vs[m][1]
+
+
+def _oh_bwd_stacked_kernel(pairnext_ref, lens_ref, tab_ref, csnext_ref,
+                           beta0_ref, betas_ref, beta_scr, *, nreal, Tt, T,
+                           M):
+    """Stacked split backward: member m's cs-scaled chain at rows [2m, 2m+2)
+    (csnext_ref [Tt, M, lt] — each member's own Rabiner scales)."""
+    j = pl.program_id(1)
+    n_t = pl.num_programs(1)
+    lens = lens_ref[0, :]
+    t0 = (n_t - 1 - j) * Tt
+
+    @pl.when(j == 0)
+    def _init():
+        beta_scr[:, :] = beta0_ref[:, :]
+
+    def body(tile_rev, carry):
+        base = (Tt // ROW_TILE - 1 - tile_rev) * ROW_TILE
+        tile = pairnext_ref[pl.ds(base, ROW_TILE), :]
+        sels = [
+            _select4_prob(tile, tab_ref, nreal, base=m * 4 * nreal)
+            for m in range(M)
+        ]
+        scaled = []
+        for m in range(M):
+            cn = csnext_ref[pl.ds(base, ROW_TILE), m, :]
+            inv_cn = 1.0 / cn
+            t00, t01, t10, t11 = sels[m]
+            scaled.append(
+                (t00 * inv_cn, t01 * inv_cn, t10 * inv_cn, t11 * inv_cn)
+            )
+        carry = list(carry)
+        for rr in range(ROW_TILE):
+            r = ROW_TILE - 1 - rr
+            t = t0 + base + r
+            active = t <= T - 2
+            v_next = (t + 1) < lens
+            keep = (active & v_next)[None, :]
+            rows = []
+            for m in range(M):
+                bn0, bn1 = carry[m]
+                s00, s01, s10, s11 = scaled[m]
+                b0 = s00[r : r + 1, :] * bn0 + s01[r : r + 1, :] * bn1
+                b1 = s10[r : r + 1, :] * bn0 + s11[r : r + 1, :] * bn1
+                b0 = jnp.where(keep, b0, bn0)
+                b1 = jnp.where(keep, b1, bn1)
+                rows.extend((b0, b1))
+                carry[m] = (b0, b1)
+            betas_ref[base + r, :, :] = jnp.concatenate(rows, axis=0)
+        return tuple(carry)
+
+    carry0 = tuple(
+        (beta_scr[2 * m : 2 * m + 1, :], beta_scr[2 * m + 1 : 2 * m + 2, :])
+        for m in range(M)
+    )
+    carry = jax.lax.fori_loop(0, Tt // ROW_TILE, body, carry0)
+    for m in range(M):
+        beta_scr[2 * m : 2 * m + 1, :] = carry[m][0]
+        beta_scr[2 * m + 1 : 2 * m + 2, :] = carry[m][1]
+
+
+def _oh_fwdbwd_stacked_kernel(pair_ref, pairn_ref, lens_ref, a0raw_ref,
+                              beta0_ref, tab_ref, alphas_ref, betas_ref,
+                              fcarry, bcarry, *, nreal, Tt, T, M):
+    """CO-SCHEDULED stacked fwd/bwd: 2M independent chains in ONE launch.
+
+    The model-axis generalization of :func:`_oh_fwdbwd_kernel` — the r9
+    kernel's two interleaved chains become 2M (M forward + M self-
+    normalized backward), all filling VPU issue slots while any one
+    stalls.  Member m's rows sit at [2m, 2m+2) of every stacked operand;
+    per-member arithmetic (and so every output) is the single-model fused
+    kernel's, bit for bit.
+    """
+    j = pl.program_id(1)
+    n_t = pl.num_programs(1)
+    lens = lens_ref[0, :]
+    state = []
+    for m in range(M):
+        state.append((
+            jnp.where(j == 0, a0raw_ref[2 * m : 2 * m + 1, :],
+                      fcarry[2 * m : 2 * m + 1, :]),
+            jnp.where(j == 0, a0raw_ref[2 * m + 1 : 2 * m + 2, :],
+                      fcarry[2 * m + 1 : 2 * m + 2, :]),
+            jnp.where(j == 0, beta0_ref[2 * m : 2 * m + 1, :],
+                      bcarry[2 * m : 2 * m + 1, :]),
+            jnp.where(j == 0, beta0_ref[2 * m + 1 : 2 * m + 2, :],
+                      bcarry[2 * m + 1 : 2 * m + 2, :]),
+        ))
+    bt0 = (n_t - 1 - j) * Tt
+
+    def body(tile_i, carry):
+        fbase = tile_i * ROW_TILE
+        bbase = (Tt // ROW_TILE - 1 - tile_i) * ROW_TILE
+        ftile = pair_ref[pl.ds(fbase, ROW_TILE), :]
+        btile = pairn_ref[pl.ds(bbase, ROW_TILE), :]
+        fsels = [
+            _select4_prob(ftile, tab_ref, nreal, base=m * 4 * nreal)
+            for m in range(M)
+        ]
+        bsels = [
+            _select4_prob(btile, tab_ref, nreal, base=m * 4 * nreal)
+            for m in range(M)
+        ]
+        carry = list(carry)
+        for r in range(ROW_TILE):
+            t = j * Tt + fbase + r
+            v_t = (t < lens)[None, :]
+            rr = ROW_TILE - 1 - r
+            tb = bt0 + bbase + rr
+            active = tb <= T - 2
+            v_next = (tb + 1) < lens
+            keep = (active & v_next)[None, :]
+            arows, brows = [], []
+            for m in range(M):
+                v0, v1, bn0, bn1 = carry[m]
+                f00, f01, f10, f11 = fsels[m]
+                g00, g01, g10, g11 = bsels[m]
+                inv = 1.0 / (v0 + v1)
+                raw0 = v0 * f00[r : r + 1, :] + v1 * f10[r : r + 1, :]
+                raw1 = v0 * f01[r : r + 1, :] + v1 * f11[r : r + 1, :]
+                n0 = jnp.where(v_t, raw0 * inv, v0)
+                n1 = jnp.where(v_t, raw1 * inv, v1)
+                n0 = jnp.where(t == 0, a0raw_ref[2 * m : 2 * m + 1, :], n0)
+                n1 = jnp.where(
+                    t == 0, a0raw_ref[2 * m + 1 : 2 * m + 2, :], n1
+                )
+                arows.extend((n0, n1))
+                binv = 1.0 / (bn0 + bn1)
+                b0 = (
+                    g00[rr : rr + 1, :] * bn0 + g01[rr : rr + 1, :] * bn1
+                ) * binv
+                b1 = (
+                    g10[rr : rr + 1, :] * bn0 + g11[rr : rr + 1, :] * bn1
+                ) * binv
+                b0 = jnp.where(keep, b0, bn0)
+                b1 = jnp.where(keep, b1, bn1)
+                brows.extend((b0, b1))
+                carry[m] = (n0, n1, b0, b1)
+            alphas_ref[fbase + r, :, :] = jnp.concatenate(arows, axis=0)
+            betas_ref[bbase + rr, :, :] = jnp.concatenate(brows, axis=0)
+        return tuple(carry)
+
+    state = jax.lax.fori_loop(0, Tt // ROW_TILE, body, tuple(state))
+    for m in range(M):
+        v0, v1, bn0, bn1 = state[m]
+        fcarry[2 * m : 2 * m + 1, :] = v0
+        fcarry[2 * m + 1 : 2 * m + 2, :] = v1
+        bcarry[2 * m : 2 * m + 1, :] = bn0
+        bcarry[2 * m + 1 : 2 * m + 2, :] = bn1
+
+
+def run_fb_kernels_onehot_stacked(
+    params_list,
+    sel_t: jnp.ndarray,
+    prev_dev,
+    lens2: jnp.ndarray,
+    a0_raws,
+    beta0s,
+    Tt: int,
+    T: int,
+    conf_masks=None,
+    pair_esym=None,
+    fused: bool = True,
+):
+    """Stacked :func:`run_fb_kernels_onehot`: M members' forward/backward
+    chains over ONE shared pair stream in one launch (fused) or one launch
+    per direction (split).  ``a0_raws``/``beta0s``: per-member [K_m, NL]
+    lists; ``conf_masks``: per-member [K_m] island masks — the confidence
+    epilogue is the scale-free :func:`conf_from_reduced` on BOTH arms
+    (exact for self-normalized fused betas AND the split arm's cs-scaled
+    betas; bit-identical to the fused sequential arm, which uses the same
+    epilogue — the split sequential arm's in-backward conf kernel differs
+    only in the final divide's rounding).  Returns (alphas2 list, cs list,
+    betas2-or-conf2 list, esym2).
+    """
+    M = len(params_list)
+    S = check_stacked_members(params_list)
+    gts, tabs = _stacked_prob_tables(params_list)
+    pairn_pre = None
+    if pair_esym is None:
+        pair2, _, _ = _pair_stream(
+            params_list[0], sel_t, jnp.asarray(prev_dev, jnp.int32)
+        )
+        esym2 = decode_esym(pair2, S)
+    else:
+        pair2, esym2 = pair_esym[0], pair_esym[1]
+        pairn_pre = pair_esym[2] if len(pair_esym) > 2 else None
+        if esym2 is None:
+            esym2 = decode_esym(pair2, S)
+    Tp, NL = pair2.shape
+
+    a0_reds = [
+        jnp.take_along_axis(a0_raws[m].T, gts[m][esym2[0]], axis=1)
+        for m in range(M)
+    ]
+    beta0_reds = [
+        jnp.take_along_axis(beta0s[m].T, gts[m][esym2[-1]], axis=1)
+        for m in range(M)
+    ]
+    pair_next = (
+        pairn_pre
+        if pairn_pre is not None
+        else jnp.concatenate(
+            [pair2[1:], jnp.full((1, NL), S * S, jnp.int32)], axis=0
+        )
+    )
+    ident = jnp.asarray([PROB_IDENT], jnp.float32)
+    tab_exts = [jnp.concatenate([t, ident], axis=0) for t in tabs]
+    pair_c = jnp.minimum(pair2, S * S)
+    pairn_c = jnp.minimum(pair_next, S * S)
+
+    def _epilogue(alphas_list, betas_list):
+        cs_list = [jnp.sum(a, axis=1) for a in alphas_list]
+        if conf_masks is None:
+            return alphas_list, cs_list, betas_list, esym2
+        confs = [
+            conf_from_reduced(
+                alphas_list[m], betas_list[m], esym2, lens2, conf_masks[m],
+                gts[m],
+            )
+            for m in range(M)
+        ]
+        return alphas_list, cs_list, confs, esym2
+
+    if _interpret():
+        # ONE-scan stacked twins: one lax.scan carries every member's
+        # chain state, each member selecting from ITS tab_ext with the
+        # single-model arithmetic — bit-identical per member, and the
+        # per-step select stays O(M * nreal) (a lane-concatenated one-hot
+        # would grow O(M^2) and trip cost.reduced-no-dense-pair).
+        if fused:
+            al_bt = _xla_fwdbwd_onehot_stacked(
+                tab_exts, pair_c, pairn_c, lens2, a0_reds, beta0_reds, T
+            )
+            alphas_list = [a for a, _ in al_bt]
+            betas_list = [b for _, b in al_bt]
+        else:
+            alphas_list = _xla_fwd_onehot_stacked(
+                tab_exts, pair_c, lens2, a0_reds
+            )
+            cs_nexts = [
+                jnp.concatenate(
+                    [jnp.sum(a, axis=1)[1:], jnp.ones((1, NL), jnp.float32)],
+                    axis=0,
+                )
+                for a in alphas_list
+            ]
+            betas_list = _xla_bwd_onehot_stacked(
+                tab_exts, pairn_c, lens2, cs_nexts, beta0_reds, T
+            )
+        return _epilogue(alphas_list, betas_list)
+
+    from cpgisland_tpu.ops.fb_pallas import _fb_lane_tile
+
+    lt = _fb_lane_tile(NL)
+    n_t = Tp // Tt
+    grid = (NL // lt, n_t)
+    lane_spec = _vspec((1, lt), lambda i, j: (0, i))
+    mg_spec = _vspec((M * GROUP, lt), lambda i, j: (0, i))
+    step_spec = _vspec((Tt, lt), lambda i, j: (j, i))
+    tabb = _bcast_tab(jnp.concatenate(tabs, axis=0), lt)
+    a0_st = jnp.concatenate([a.T for a in a0_reds], axis=0)  # [M*G, NL]
+    b0_st = jnp.concatenate([b.T for b in beta0_reds], axis=0)
+    if fused:
+        rev_spec = _vspec((Tt, lt), lambda i, j: (n_t - 1 - j, i))
+        alphas_st, betas_st = pl.pallas_call(
+            functools.partial(
+                _oh_fwdbwd_stacked_kernel, nreal=S * S, Tt=Tt, T=T, M=M
+            ),
+            grid=grid,
+            in_specs=[
+                step_spec,
+                rev_spec,
+                lane_spec,
+                mg_spec,
+                mg_spec,
+                _vspec(tabb.shape, lambda i, j: (0, 0)),
+            ],
+            out_specs=[
+                _vspec((Tt, M * GROUP, lt), lambda i, j: (j, 0, i)),
+                _vspec((Tt, M * GROUP, lt), lambda i, j: (n_t - 1 - j, 0, i)),
+            ],
+            out_shape=[
+                jax.ShapeDtypeStruct((Tp, M * GROUP, NL), jnp.float32),
+                jax.ShapeDtypeStruct((Tp, M * GROUP, NL), jnp.float32),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((M * GROUP, lt), jnp.float32),
+                pltpu.VMEM((M * GROUP, lt), jnp.float32),
+            ],
+        )(pair2, pair_next, lens2, a0_st, b0_st, tabb)
+        alphas_list = [
+            alphas_st[:, 2 * m : 2 * m + 2, :] for m in range(M)
+        ]
+        betas_list = [betas_st[:, 2 * m : 2 * m + 2, :] for m in range(M)]
+        return _epilogue(alphas_list, betas_list)
+    (alphas_st,) = pl.pallas_call(
+        functools.partial(
+            _oh_fwd_stacked_kernel, nreal=S * S, Tt=Tt, M=M
+        ),
+        grid=grid,
+        in_specs=[
+            step_spec, lane_spec, mg_spec,
+            _vspec(tabb.shape, lambda i, j: (0, 0)),
+        ],
+        out_specs=[_vspec((Tt, M * GROUP, lt), lambda i, j: (j, 0, i))],
+        out_shape=[jax.ShapeDtypeStruct((Tp, M * GROUP, NL), jnp.float32)],
+        scratch_shapes=[pltpu.VMEM((M * GROUP, lt), jnp.float32)],
+    )(pair2, lens2, a0_st, tabb)
+    alphas_list = [alphas_st[:, 2 * m : 2 * m + 2, :] for m in range(M)]
+    cs_st = jnp.stack(
+        [jnp.sum(a, axis=1) for a in alphas_list], axis=1
+    )  # [Tp, M, NL]
+    cs_next_st = jnp.concatenate(
+        [cs_st[1:], jnp.ones((1, M, NL), cs_st.dtype)], axis=0
+    )
+    rev_step_spec = _vspec((Tt, lt), lambda i, j: (n_t - 1 - j, i))
+    (betas_st,) = pl.pallas_call(
+        functools.partial(
+            _oh_bwd_stacked_kernel, nreal=S * S, Tt=Tt, T=T, M=M
+        ),
+        grid=grid,
+        in_specs=[
+            rev_step_spec,
+            lane_spec,
+            _vspec(tabb.shape, lambda i, j: (0, 0)),
+            _vspec((Tt, M, lt), lambda i, j: (n_t - 1 - j, 0, i)),
+            mg_spec,
+        ],
+        out_specs=[_vspec((Tt, M * GROUP, lt), lambda i, j: (n_t - 1 - j, 0, i))],
+        out_shape=[jax.ShapeDtypeStruct((Tp, M * GROUP, NL), jnp.float32)],
+        scratch_shapes=[pltpu.VMEM((M * GROUP, lt), jnp.float32)],
+    )(pair_next, lens2, tabb, cs_next_st, b0_st)
+    betas_list = [betas_st[:, 2 * m : 2 * m + 2, :] for m in range(M)]
+    return _epilogue(alphas_list, betas_list)
+
+
+def _xla_fwd_onehot_stacked(tab_exts, pair2, lens2, a0_reds):
+    """ONE scan over M members' reduced forward chains (each member's
+    per-step arithmetic = :func:`_xla_fwd_onehot`, bit for bit).  Returns
+    the per-member alphas2 [Tp, 2, NL] list."""
+    M = len(tab_exts)
+    Tp = pair2.shape[0]
+    lens = lens2[0]
+
+    def step(carry, x):
+        pk, t = x
+        new, ys = [], []
+        for m in range(M):
+            v0, v1 = carry[m]
+            T4 = _tab_sel_nl(tab_exts[m], pk)
+            inv = 1.0 / (v0 + v1)
+            raw0 = v0 * T4[:, 0] + v1 * T4[:, 2]
+            raw1 = v0 * T4[:, 1] + v1 * T4[:, 3]
+            v_t = t < lens
+            n0 = jnp.where(v_t, raw0 * inv, v0)
+            n1 = jnp.where(v_t, raw1 * inv, v1)
+            n0 = jnp.where(t == 0, a0_reds[m][:, 0], n0)
+            n1 = jnp.where(t == 0, a0_reds[m][:, 1], n1)
+            new.append((n0, n1))
+            ys.append(jnp.stack([n0, n1], axis=0))
+        return tuple(new), tuple(ys)
+
+    _, ys = jax.lax.scan(
+        step,
+        tuple((a[:, 0], a[:, 1]) for a in a0_reds),
+        (pair2, jnp.arange(Tp, dtype=jnp.int32)),
+    )
+    return list(ys)
+
+
+def _xla_bwd_onehot_stacked(tab_exts, pair_next, lens2, cs_nexts,
+                            beta0_reds, T):
+    """ONE scan over M members' split (cs-scaled) backward chains —
+    per-member arithmetic = :func:`_xla_bwd_onehot`."""
+    M = len(tab_exts)
+    Tp = pair_next.shape[0]
+    lens = lens2[0]
+
+    def step(carry, x):
+        pk, cns, t = x
+        new, ys = [], []
+        for m in range(M):
+            bn0, bn1 = carry[m]
+            T4 = _tab_sel_nl(tab_exts[m], pk)
+            inv_cn = 1.0 / cns[m]
+            b0 = (T4[:, 0] * bn0 + T4[:, 1] * bn1) * inv_cn
+            b1 = (T4[:, 2] * bn0 + T4[:, 3] * bn1) * inv_cn
+            keep = (t <= T - 2) & ((t + 1) < lens)
+            b0 = jnp.where(keep, b0, bn0)
+            b1 = jnp.where(keep, b1, bn1)
+            new.append((b0, b1))
+            ys.append(jnp.stack([b0, b1], axis=0))
+        return tuple(new), tuple(ys)
+
+    _, ys = jax.lax.scan(
+        step,
+        tuple((b[:, 0], b[:, 1]) for b in beta0_reds),
+        (pair_next, tuple(cs_nexts), jnp.arange(Tp, dtype=jnp.int32)),
+        reverse=True,
+    )
+    return list(ys)
+
+
+def _xla_fwdbwd_onehot_stacked(tab_exts, pair2, pair_next, lens2, a0_reds,
+                               beta0_reds, T):
+    """ONE scan computing M members' CO-SCHEDULED fwd + self-normalized
+    bwd chains — the stacked twin of :func:`_xla_fwdbwd_onehot` (per-member
+    arithmetic identical, so member m's streams are bit-identical to its
+    own single-model fused scan).  Returns per-member (alphas2, betas2)."""
+    M = len(tab_exts)
+    Tp = pair2.shape[0]
+    lens = lens2[0]
+    pairn_rev = jnp.flip(pair_next, axis=0)
+
+    def step(carry, x):
+        pk, qk, t = x
+        tb = Tp - 1 - t
+        new, ys = [], []
+        for m in range(M):
+            v0, v1, bn0, bn1 = carry[m]
+            T4 = _tab_sel_nl(tab_exts[m], pk)
+            G4 = _tab_sel_nl(tab_exts[m], qk)
+            inv = 1.0 / (v0 + v1)
+            raw0 = v0 * T4[:, 0] + v1 * T4[:, 2]
+            raw1 = v0 * T4[:, 1] + v1 * T4[:, 3]
+            v_t = t < lens
+            n0 = jnp.where(v_t, raw0 * inv, v0)
+            n1 = jnp.where(v_t, raw1 * inv, v1)
+            n0 = jnp.where(t == 0, a0_reds[m][:, 0], n0)
+            n1 = jnp.where(t == 0, a0_reds[m][:, 1], n1)
+            binv = 1.0 / (bn0 + bn1)
+            b0 = (G4[:, 0] * bn0 + G4[:, 1] * bn1) * binv
+            b1 = (G4[:, 2] * bn0 + G4[:, 3] * bn1) * binv
+            keep = (tb <= T - 2) & ((tb + 1) < lens)
+            b0 = jnp.where(keep, b0, bn0)
+            b1 = jnp.where(keep, b1, bn1)
+            new.append((n0, n1, b0, b1))
+            ys.append((
+                jnp.stack([n0, n1], axis=0), jnp.stack([b0, b1], axis=0)
+            ))
+        return tuple(new), tuple(ys)
+
+    _, ys = jax.lax.scan(
+        step,
+        tuple(
+            (a[:, 0], a[:, 1], b[:, 0], b[:, 1])
+            for a, b in zip(a0_reds, beta0_reds)
+        ),
+        (pair2, pairn_rev, jnp.arange(Tp, dtype=jnp.int32)),
+    )
+    return [(al, jnp.flip(bt, axis=0)) for al, bt in ys]
+
+
+def _oh_seq_stats_stacked_kernel(alphas_ref, betas_ref, pair_ref, lens_ref,
+                                 tab_ref, brtab_ref, gttab_ref,
+                                 enters_full_ref, enters_red_ref, pair0m_ref,
+                                 macc_ref, emit_ref, ll_ref, macc_scr,
+                                 emit_scr, ll_scr, aprev_scr, aprev2_scr,
+                                 *, K, S, nreal, Tt, M):
+    """Stacked z-normalized stats: M same-K members' count reductions in
+    ONE pass over the shared pair stream (member m's macc rows at
+    [m*K*K, (m+1)*K*K), emit at [m*S*GROUP, ...), ll row m; per-member
+    arithmetic = _oh_seq_stats_kernel).  The stats pass is throughput-
+    bound (no serial chain), so stacking shares the pair-stream read and
+    the launch, not a chain drain."""
+    j = pl.program_id(1)
+    n_t = pl.num_programs(1)
+    lt = pair_ref.shape[1]
+    lens = lens_ref[0, :]
+    pair0m = pair0m_ref[:, :]
+
+    @pl.when(j == 0)
+    def _init():
+        macc_scr[:, :] = jnp.zeros((M * K * K, lt), jnp.float32)
+        emit_scr[:, :] = jnp.zeros((M * S * GROUP, lt), jnp.float32)
+        ll_scr[:, :] = jnp.zeros((M, lt), jnp.float32)
+        aprev_scr[:, :] = jnp.zeros((M * K, lt), jnp.float32)
+        aprev2_scr[:, :] = jnp.zeros((M * GROUP, lt), jnp.float32)
+
+    iK = jax.lax.broadcasted_iota(jnp.int32, (K, lt), 0)
+
+    def body(tile_i, carry):
+        base = tile_i * ROW_TILE
+        p_tile = pair_ref[pl.ds(base, ROW_TILE), :]
+        esym = p_tile & (S - 1)
+        carry = [list(c) for c in carry]
+        sels = [
+            _select4_prob(p_tile, tab_ref, nreal, base=m * 4 * nreal)
+            for m in range(M)
+        ]
+        syms = [
+            _sel_sym_tables(
+                p_tile, brtab_ref, gttab_ref, S, base=m * 2 * S
+            )
+            for m in range(M)
+        ]
+        for r in range(ROW_TILE):
+            t = j * Tt + base + r
+            valid = (t < lens)[None, :]
+            is0 = t == 0
+            pairm = jnp.where(
+                is0, valid * pair0m, valid.astype(jnp.float32)
+            )
+            sym_r = esym[r : r + 1, :]
+            for m in range(M):
+                aprev, ap2_0, ap2_1, macc, emit, ll = carry[m]
+                t00, t01, t10, t11 = sels[m]
+                b0t, b1t, glt, ght = syms[m]
+                a_row = alphas_ref[base + r, 2 * m : 2 * m + 2, :]
+                b_row = betas_ref[base + r, 2 * m : 2 * m + 2, :]
+                a0 = a_row[0:1, :]
+                a1 = a_row[1:2, :]
+                be0 = b_row[0:1, :]
+                be1 = b_row[1:2, :]
+                cs = a0 + a1
+                inv_cs = 1.0 / jnp.maximum(cs, 1e-30)
+                g0 = a0 * be0
+                g1 = a1 * be1
+                inv_g = 1.0 / jnp.maximum(g0 + g1, 1e-30)
+                gm0 = jnp.where(valid, g0 * inv_g, 0.0)
+                gm1 = jnp.where(valid, g1 * inv_g, 0.0)
+                emit = list(emit)
+                for s in range(S):
+                    msk = sym_r == s
+                    emit[2 * s] = emit[2 * s] + jnp.where(msk, gm0, 0.0)
+                    emit[2 * s + 1] = emit[2 * s + 1] + jnp.where(
+                        msk, gm1, 0.0
+                    )
+                ll = ll + jnp.where(
+                    valid, jnp.log(jnp.maximum(cs, 1e-30)), 0.0
+                )
+                apf = jnp.where(
+                    is0,
+                    enters_full_ref[m * K : (m + 1) * K, :],
+                    aprev,
+                )
+                ap0 = jnp.where(
+                    is0, enters_red_ref[2 * m : 2 * m + 1, :], ap2_0
+                )
+                ap1 = jnp.where(
+                    is0, enters_red_ref[2 * m + 1 : 2 * m + 2, :], ap2_1
+                )
+                z = ap0 * (t00[r : r + 1, :] * be0 + t01[r : r + 1, :] * be1) + \
+                    ap1 * (t10[r : r + 1, :] * be0 + t11[r : r + 1, :] * be1)
+                inv_z = pairm * (1.0 / jnp.maximum(z, 1e-30))
+                glow = glt[r : r + 1, :]
+                ghigh = ght[r : r + 1, :]
+                w_full = jnp.where(iK == glow, b0t[r : r + 1, :] * be0, 0.0) + \
+                    jnp.where(iK == ghigh, b1t[r : r + 1, :] * be1, 0.0)
+                wz = w_full * inv_z
+                macc = list(macc)
+                for jj in range(K):
+                    macc[jj] = macc[jj] + apf[jj : jj + 1, :] * wz
+                ah0 = a0 * inv_cs
+                ah1 = a1 * inv_cs
+                aprev = jnp.where(iK == glow, ah0, 0.0) + jnp.where(
+                    iK == ghigh, ah1, 0.0
+                )
+                carry[m] = [aprev, ah0, ah1, tuple(macc), tuple(emit), ll]
+        return tuple(tuple(c) for c in carry)
+
+    zeroK = jnp.zeros((K, lt), jnp.float32)
+    zero1 = jnp.zeros((1, lt), jnp.float32)
+    carry0 = tuple(
+        (
+            aprev_scr[m * K : (m + 1) * K, :],
+            aprev2_scr[2 * m : 2 * m + 1, :],
+            aprev2_scr[2 * m + 1 : 2 * m + 2, :],
+            tuple(zeroK for _ in range(K)),
+            tuple(zero1 for _ in range(S * GROUP)),
+            jnp.zeros((1, lt), jnp.float32),
+        )
+        for m in range(M)
+    )
+    out = jax.lax.fori_loop(0, Tt // ROW_TILE, body, carry0)
+    for m in range(M):
+        aprev, ap2_0, ap2_1, macc, emit, ll = out[m]
+        aprev_scr[m * K : (m + 1) * K, :] = aprev
+        aprev2_scr[2 * m : 2 * m + 1, :] = ap2_0
+        aprev2_scr[2 * m + 1 : 2 * m + 2, :] = ap2_1
+        for jj in range(K):
+            sl = slice(m * K * K + jj * K, m * K * K + (jj + 1) * K)
+            macc_scr[sl, :] = macc_scr[sl, :] + macc[jj]
+        for i in range(S * GROUP):
+            r0 = m * S * GROUP + i
+            emit_scr[r0 : r0 + 1, :] = emit_scr[r0 : r0 + 1, :] + emit[i]
+        ll_scr[m : m + 1, :] = ll_scr[m : m + 1, :] + ll
+
+    @pl.when(j == n_t - 1)
+    def _flush():
+        macc_ref[:, :] = macc_scr[:, :]
+        emit_ref[:, :] = emit_scr[:, :]
+        ll_ref[:, :] = ll_scr[:, :]
+
+
+def run_seq_stats_onehot_stacked(params_list, alphas2_list, betas2_list,
+                                 pair2, lens2, gts, enters_red_list,
+                                 enters_full_list, pair0_mask, Tt):
+    """Stacked :func:`run_seq_stats_onehot`: M members' z-normalized count
+    reductions in ONE launch (pow2 S; per-member results bit-identical to
+    the single-model pass).  The TPU kernel additionally requires one
+    common K across members (per-member VMEM accumulator rows are sliced
+    statically); the off-TPU twin loops :func:`_xla_znorm_stats` per
+    member inside the same program — contractions, not serial passes.
+    Returns per-member (macc, emit_red, ll) tuples."""
+    M = len(params_list)
+    S = check_stacked_members(params_list)
+    if S & (S - 1):
+        raise ValueError("run_seq_stats_onehot_stacked: power-of-two S only")
+    if _interpret():
+        return [
+            _xla_znorm_stats(
+                params_list[m], alphas2_list[m], betas2_list[m], pair2,
+                lens2, gts[m], enters_red_list[m], enters_full_list[m],
+                pair0_mask,
+            )
+            for m in range(M)
+        ]
+    K = params_list[0].n_states
+    for p in params_list[1:]:
+        if p.n_states != K:
+            raise ValueError(
+                "the stacked stats kernel needs one common n_states; got "
+                f"{[int(q.n_states) for q in params_list]} — run mixed-K "
+                "members through per-member run_seq_stats_onehot"
+            )
+    Tp, _, NL = alphas2_list[0].shape
+    tabs, brtabs, gttabs = [], [], []
+    for m, p in enumerate(params_list):
+        tabs.append(prob_pair_table(p, gts[m]))
+        B = jnp.exp(p.log_B).astype(jnp.float32)
+        brtabs.append(B[gts[m], jnp.arange(S)[:, None]])
+        gttabs.append(gts[m].astype(jnp.int32))
+    lt = LANE_TILE
+    grid = (NL // lt, Tp // Tt)
+    tabb = _bcast_tab(jnp.concatenate(tabs, axis=0), lt)
+    brtabb = _bcast_tab(jnp.concatenate(brtabs, axis=0), lt)
+    gttabb = _bcast_tab(jnp.concatenate(gttabs, axis=0), lt)
+    alphas_st = jnp.concatenate(alphas2_list, axis=1)  # [Tp, M*G, NL]
+    betas_st = jnp.concatenate(betas2_list, axis=1)
+    enters_full_st = jnp.concatenate(enters_full_list, axis=0)  # [M*K, NL]
+    enters_red_st = jnp.concatenate(enters_red_list, axis=0)  # [M*G, NL]
+    macc, emit, ll = pl.pallas_call(
+        functools.partial(
+            _oh_seq_stats_stacked_kernel, K=K, S=S, nreal=S * S, Tt=Tt, M=M
+        ),
+        grid=grid,
+        in_specs=[
+            _vspec((Tt, M * GROUP, lt), lambda i, j: (j, 0, i)),
+            _vspec((Tt, M * GROUP, lt), lambda i, j: (j, 0, i)),
+            _vspec((Tt, lt), lambda i, j: (j, i)),
+            _vspec((1, lt), lambda i, j: (0, i)),
+            _vspec(tabb.shape, lambda i, j: (0, 0)),
+            _vspec(brtabb.shape, lambda i, j: (0, 0)),
+            _vspec(gttabb.shape, lambda i, j: (0, 0)),
+            _vspec((M * K, lt), lambda i, j: (0, i)),
+            _vspec((M * GROUP, lt), lambda i, j: (0, i)),
+            _vspec((1, lt), lambda i, j: (0, i)),
+        ],
+        out_specs=[
+            _vspec((M * K * K, lt), lambda i, j: (0, i)),
+            _vspec((M * S * GROUP, lt), lambda i, j: (0, i)),
+            _vspec((M, lt), lambda i, j: (0, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((M * K * K, NL), jnp.float32),
+            jax.ShapeDtypeStruct((M * S * GROUP, NL), jnp.float32),
+            jax.ShapeDtypeStruct((M, NL), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((M * K * K, lt), jnp.float32),
+            pltpu.VMEM((M * S * GROUP, lt), jnp.float32),
+            pltpu.VMEM((M, lt), jnp.float32),
+            pltpu.VMEM((M * K, lt), jnp.float32),
+            pltpu.VMEM((M * GROUP, lt), jnp.float32),
+        ],
+    )(alphas_st, betas_st, pair2, lens2, tabb, brtabb, gttabb,
+      enters_full_st, enters_red_st, pair0_mask)
+    return [
+        (
+            macc[m * K * K : (m + 1) * K * K],
+            emit[m * S * GROUP : (m + 1) * S * GROUP],
+            ll[m : m + 1],
+        )
+        for m in range(M)
+    ]
 
 
 def products_reduced(params: HmmParams, pair2: jnp.ndarray, Tt: int) -> jnp.ndarray:

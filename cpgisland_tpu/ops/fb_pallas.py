@@ -1516,3 +1516,385 @@ def seq_transfer_total_pallas(
     B = jnp.exp(params.log_B).astype(jnp.float32)
     P = _run_products_kernel(A, B, sel_l, lane_T, Tt, K, S)
     return jax.lax.associative_scan(_lane_combine, P, axis=0)[-1]
+
+
+# ---------------------------------------------------------------------------
+# Stacked multi-model drivers: M members' reduced chains over ONE stream in
+# one launch set (ops.fb_onehot's stacked kernels).  Per-member numerics
+# mirror the single-model paths op for op, so every member's outputs are
+# bit-identical to its own sequential dispatch over the same input — the
+# exactness contract family.compare / the stacked E-step pin in tests.
+
+
+def _lane_streams_stacked(
+    params_list,
+    obs: jnp.ndarray,
+    length,
+    lane_T: int,
+    t_tile: int,
+    axis,
+    exit_dirs=None,
+    conf_masks=None,
+    prepared=None,
+    fused: bool = True,
+):
+    """Stacked whole-sequence lane setup (one-hot members, first spans).
+
+    The model-axis twin of :func:`_lane_streams`' onehot branch: the lane
+    layout and pair stream are symbol-only and built ONCE; the per-member
+    boundary glue (reduced products from one stacked launch, prefix/suffix
+    combines, entering directions) loops members over model-sized arrays;
+    the T-scaling forward/backward chains run STACKED
+    (fb_onehot.run_fb_kernels_onehot_stacked).  ``exit_dirs``: per-member
+    [K_m] exiting-beta directions (None = free end).  Returns
+    (per-member [(alphas, cs, third)], steps2, lens2, Tt) where ``third``
+    is conf2 [Tp, NL] with ``conf_masks`` else the dense scattered betas.
+    """
+    from cpgisland_tpu.ops import fb_onehot, viterbi_onehot
+
+    M = len(params_list)
+    S = fb_onehot.check_stacked_members(params_list)
+    if prepared is not None and axis is not None:
+        raise ValueError(
+            "prepared seq streams serve single-device spans (axis=None)"
+        )
+    d = jax.lax.axis_index(axis) if axis is not None else 0
+    is_first = d == 0
+
+    if prepared is not None:
+        from cpgisland_tpu.ops import prepared as prep_mod
+
+        prep_mod.check_seq(
+            prepared, S, obs.shape[0], lane_T, t_tile, True, True,
+        )
+        obs_l, sel_l, lane_lens = (
+            prepared.obs_l, prepared.sel_l, prepared.lane_lens
+        )
+        o0, Tt, NL = prepared.o0, prepared.Tt, prepared.obs_l.shape[0]
+        obs_flat = None
+        prev_dev = prepared.prev_dev
+        pair2, e_in_l, e_out_l = (
+            prepared.pair2, prepared.e_in, prepared.e_out
+        )
+        pairn_pre = prepared.pairn2
+    else:
+        obs_l, sel_l, lane_lens, obs_flat, Tt, NL = _lane_layout(
+            obs, length, S, lane_T, t_tile, is_first
+        )
+        o0 = obs_flat[0]
+        prev_seg = jnp.asarray(o0, jnp.int32)
+        if axis is not None:
+            T_in = obs.shape[0]
+            seed_syms = jnp.where(
+                jnp.arange(T_in) < jnp.asarray(length, jnp.int32), obs_flat, S
+            )
+            prev_dev = viterbi_onehot.device_entry_sym(
+                seed_syms, S, axis, prev_seg
+            )
+        else:
+            prev_dev = prev_seg
+        pair2, e_in_l, e_out_l = viterbi_onehot.pair_stream(
+            S, sel_l.T, prev_dev
+        )
+        pairn_pre = None
+    length = jnp.asarray(length, jnp.int32)
+
+    gts = [fb_onehot._groups(p) for p in params_list]
+    reds = fb_onehot.products_reduced_stacked(params_list, pair2, Tt)
+
+    steps2 = obs_l.T
+    lens2 = lane_lens[None, :]
+    o_first = obs_l[:, 0]  # [NL]
+    v0s, beta_exits_list = [], []
+    for m, params in enumerate(params_list):
+        K = params.n_states
+        A = jnp.exp(params.log_A).astype(jnp.float32)
+        B = jnp.exp(params.log_B).astype(jnp.float32)
+        pi = jnp.exp(params.log_pi).astype(jnp.float32)
+        gt, red = gts[m], reds[m]
+        gin = gt[e_in_l]
+        gout = gt[e_out_l]
+        incl_red = jax.lax.associative_scan(_lane_combine, red, axis=0)
+        a0_dir = _norm_rows(pi * B[:, o0])
+        exit_dir = None if exit_dirs is None else exit_dirs[m]
+        if axis is not None:
+            from cpgisland_tpu.parallel.fb_sharded import (
+                device_boundary_messages,
+            )
+
+            total_dev = fb_onehot._scatter_products_prob(
+                incl_red[-1:], gt, e_in_l[:1], e_out_l[-1:], K
+            )[0]
+            _, base_dir, anchor = device_boundary_messages(
+                a0_dir, total_dev, d, axis, start_dir=None, end_dir=exit_dir
+            )
+        else:
+            base_dir = a0_dir
+            anchor = (
+                jnp.full((K,), 1.0 / K, jnp.float32)
+                if exit_dir is None
+                else _norm_rows(exit_dir)
+            )
+        iK = jnp.arange(K, dtype=jnp.int32)
+        eye2 = jnp.broadcast_to(
+            jnp.eye(fb_onehot.GROUP, dtype=jnp.float32),
+            (1, fb_onehot.GROUP, fb_onehot.GROUP),
+        )
+        excl_red = jnp.concatenate([eye2, incl_red[:-1]], axis=0)
+        base_red = jnp.take(base_dir, gin[0])
+        enters_red = _norm_rows(jnp.einsum("k,nkj->nj", base_red, excl_red))
+        enters_red = enters_red.at[0].set(base_red)
+        enters = (
+            jnp.where(iK[None, :] == gin[:, 0:1], enters_red[:, 0:1], 0.0)
+            + jnp.where(iK[None, :] == gin[:, 1:2], enters_red[:, 1:2], 0.0)
+        )
+        enters = enters.at[0].set(base_dir)
+        Rsuf_red = jax.lax.associative_scan(
+            lambda a, b: _lane_combine(b, a), red, axis=0, reverse=True
+        )
+        anchor_red = jnp.take(anchor, gout[-1])
+        beta_exits_red = jnp.concatenate(
+            [_norm_rows(jnp.einsum("nij,j->ni", Rsuf_red[1:], anchor_red)),
+             anchor_red[None]],
+            axis=0,
+        )
+        beta_exits = (
+            jnp.where(iK[None, :] == gout[:, 0:1], beta_exits_red[:, 0:1], 0.0)
+            + jnp.where(iK[None, :] == gout[:, 1:2], beta_exits_red[:, 1:2], 0.0)
+        )
+        Bf = B[:, o_first].T
+        v0_cont = jnp.einsum(
+            "nk,kj->nj", enters, A, precision=jax.lax.Precision.HIGHEST
+        ) * Bf
+        lane0_is_init = (jnp.arange(NL)[:, None] == 0) & is_first
+        v0 = jnp.where(
+            (lane_lens > 0)[:, None],
+            jnp.where(lane0_is_init, (pi * B[:, o0])[None, :], v0_cont),
+            jnp.ones((NL, K)) / K,
+        )
+        v0s.append(v0.T)
+        beta_exits_list.append(beta_exits.T)
+
+    al_list, cs_list, third_list, esym2 = (
+        fb_onehot.run_fb_kernels_onehot_stacked(
+            params_list, sel_l.T, prev_dev, lens2, v0s, beta_exits_list,
+            Tt, lane_T, conf_masks=conf_masks,
+            pair_esym=(pair2, None, pairn_pre), fused=fused,
+        )
+    )
+    out = []
+    for m, params in enumerate(params_list):
+        K = params.n_states
+        alphas = fb_onehot.scatter_streams(al_list[m], gts[m], esym2, K)
+        third = (
+            third_list[m]
+            if conf_masks is not None
+            else fb_onehot.scatter_streams(third_list[m], gts[m], esym2, K)
+        )
+        out.append((alphas, cs_list[m], third))
+    return out, steps2, lens2, Tt
+
+
+def _seq_posterior_core_stacked(
+    params_list,
+    obs: jnp.ndarray,
+    length,
+    island_masks,
+    lane_T: int,
+    t_tile: int,
+    axis,
+    want_path: bool = False,
+    prepared=None,
+    fused: bool = True,
+):
+    """Stacked :func:`_seq_posterior_core`: M members' island-confidence
+    (and MPM path) tracks over ONE record in one stacked launch set.
+    Per-member numerics are the single-model core's — bit-identical to M
+    sequential calls over the same placed input.  Returns (conf [M, T],
+    path [M, T] — zeros unless want_path)."""
+    T = obs.shape[0]
+    M = len(params_list)
+    exit_dirs = [
+        jnp.full((p.n_states,), 1.0 / p.n_states, jnp.float32)
+        for p in params_list
+    ]
+    if not want_path:
+        streams, _, _, _ = _lane_streams_stacked(
+            params_list, obs, length, lane_T, t_tile, axis,
+            exit_dirs=exit_dirs, conf_masks=island_masks,
+            prepared=prepared, fused=fused,
+        )
+        conf = jnp.stack(
+            [conf2.T.reshape(-1)[:T] for _, _, conf2 in streams]
+        )
+        return conf, jnp.zeros((M, T), jnp.int32)
+    streams, _, lens2, _ = _lane_streams_stacked(
+        params_list, obs, length, lane_T, t_tile, axis,
+        exit_dirs=exit_dirs, prepared=prepared, fused=fused,
+    )
+    confs, paths = [], []
+    for m, (alphas, _cs, betas) in enumerate(streams):
+        conf2, path2 = _conf_path_from_streams(
+            alphas, betas, lens2, island_masks[m]
+        )
+        confs.append(conf2.T.reshape(-1)[:T])
+        paths.append(path2.T.reshape(-1)[:T])
+    return jnp.stack(confs), jnp.stack(paths)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("lane_T", "t_tile", "want_path", "fused"),
+)
+def seq_posterior_pallas_stacked(
+    params_list,
+    obs: jnp.ndarray,
+    length,
+    island_masks,
+    want_path: bool = False,
+    lane_T: int = DEFAULT_LANE_T,
+    t_tile: int = DEFAULT_T_TILE,
+    prepared=None,
+    fused: bool = True,
+):
+    """Single-device stacked posterior: M members' (conf, path) tracks off
+    one record in one stacked launch set (first spans; the comparison
+    workload's record unit)."""
+    return _seq_posterior_core_stacked(
+        tuple(params_list), obs, length, tuple(island_masks), lane_T,
+        t_tile, axis=None, want_path=want_path, prepared=prepared,
+        fused=fused,
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("t_tile", "fused"))
+def batch_stats_pallas_stacked(
+    params_list,
+    chunks: jnp.ndarray,
+    lengths: jnp.ndarray,
+    t_tile: int = DEFAULT_T_TILE,
+    prepared=None,
+    fused: bool = True,
+) -> tuple:
+    """Stacked multi-model chunked E-step: M members' batch-summed
+    SuffStats from ONE stacked launch set over a shared [N, T] batch.
+
+    The model-family training lever of ROADMAP item 2: the symbol-only
+    lane layout + pair stream build once (``prepared`` shares them across
+    EM iterations like the single-model path), the M fused fwd/bwd chains
+    co-schedule in ONE kernel launch, and the count reductions run through
+    the stacked z-normalized stats kernel — so a K-member family scan pays
+    ~one member's T-scaling passes.  Per-member results are BIT-IDENTICAL
+    to ``batch_stats_pallas(params_list[m], ..., onehot=True, fused=...)``
+    (pinned in tests/test_multimodel.py).  Members must share a
+    power-of-two alphabet and be reduced-eligible (callers gate via
+    family.reduced_stats_eligible).  Returns a tuple of SuffStats.
+    """
+    from cpgisland_tpu.ops import fb_onehot
+    from cpgisland_tpu.ops import prepared as prep_mod
+
+    params_list = tuple(params_list)
+    M = len(params_list)
+    S = fb_onehot.check_stacked_members(params_list)
+    if S & (S - 1):
+        raise ValueError(
+            "stacked E-step needs a power-of-two alphabet (the z-normalized "
+            "stats lowering; family.reduced_stats_eligible gates this)"
+        )
+    N, T = chunks.shape
+    if prepared is None:
+        prep = prep_mod.prepare_chunked(
+            S, chunks, lengths, t_tile=t_tile, onehot=True
+        )
+    else:
+        prep_mod.check_chunked(prepared, S, N, T, t_tile, True)
+        prep = prepared
+    steps2, lens2, Tt = prep.steps2, prep.lens2, prep.Tt
+    valid0 = lens2[0] > 0
+    NL = steps2.shape[1]
+
+    As, gts, a0_raws, beta0s = [], [], [], []
+    for params in params_list:
+        K = params.n_states
+        A = jnp.exp(params.log_A).astype(jnp.float32)
+        B = jnp.exp(params.log_B).astype(jnp.float32)
+        pi = jnp.exp(params.log_pi).astype(jnp.float32)
+        B0 = _emit_sel(B, steps2[0, :], K, S)
+        a0_raws.append(
+            jnp.where(valid0[None, :], pi[:, None] * B0, jnp.ones((K, NL)) / K)
+        )
+        beta0s.append(jnp.ones((K, NL), jnp.float32))
+        As.append(A)
+        gts.append(fb_onehot._groups(params))
+
+    al_list, _cs_list, b_list, esym2 = (
+        fb_onehot.run_fb_kernels_onehot_stacked(
+            params_list, prep.sel2, jnp.int32(0), lens2, a0_raws, beta0s,
+            Tt, T, pair_esym=(prep.pair2, prep.esym2, prep.pairn2),
+            fused=fused,
+        )
+    )
+    if fused:
+        # Z-normalized stats over the fused self-normalized streams; zero
+        # enters + an all-zero pair0 mask = independent records per lane
+        # (the single-model fused chunked convention).
+        same_K = len({p.n_states for p in params_list}) == 1
+        if same_K or jax.default_backend() != "tpu":
+            stats_l = fb_onehot.run_seq_stats_onehot_stacked(
+                params_list, al_list, b_list, prep.pair2, lens2, gts,
+                [jnp.zeros((fb_onehot.GROUP, NL), jnp.float32)] * M,
+                [
+                    jnp.zeros((p.n_states, NL), jnp.float32)
+                    for p in params_list
+                ],
+                jnp.zeros((1, NL), jnp.float32),
+                Tt,
+            )
+        else:
+            # Mixed-K member sets on chip: the stacked stats kernel slices
+            # per-member VMEM rows statically, so fall back to per-member
+            # stats passes (throughput contractions — the stacked chain
+            # launches above still carry the fixed-cost win).
+            stats_l = [
+                fb_onehot.run_seq_stats_onehot(
+                    params_list[m], al_list[m], b_list[m], prep.pair2,
+                    lens2, gts[m],
+                    jnp.zeros((fb_onehot.GROUP, NL), jnp.float32),
+                    jnp.zeros((params_list[m].n_states, NL), jnp.float32),
+                    jnp.zeros((1, NL), jnp.float32),
+                    Tt,
+                )
+                for m in range(M)
+            ]
+    else:
+        # The split arm's cs-scaled betas pair with the chunked reduced
+        # stats kernel, exactly like the single-model fused=False route.
+        stats_l = [
+            fb_onehot.run_stats_onehot(
+                params_list[m], al_list[m], b_list[m], prep.pair2, lens2,
+                gts[m], Tt,
+            )
+            for m in range(M)
+        ]
+    out = []
+    for m, params in enumerate(params_list):
+        macc, emit_red, ll = stats_l[m]
+        trans, emit, loglik = _assemble_reduced_stats(
+            params, As[m], gts[m], macc, emit_red, ll
+        )
+        init_l = jnp.where(
+            valid0[None, :],
+            _gamma0_full(al_list[m], b_list[m], gts[m], esym2,
+                         params.n_states),
+            0.0,
+        )
+        out.append(
+            SuffStats(
+                init=jnp.sum(init_l, axis=1),
+                trans=trans,
+                emit=emit,
+                loglik=loglik,
+                n_seqs=jnp.sum(valid0.astype(jnp.int32)),
+            )
+        )
+    return tuple(out)

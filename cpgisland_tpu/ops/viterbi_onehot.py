@@ -284,7 +284,8 @@ def _pad_pair_rows(pair2: jnp.ndarray, e_out: jnp.ndarray, ident_base: int):
     return jnp.concatenate([pair2, tail], axis=0), bk_pad
 
 
-def _select4(tile, tab_ref, nreal, ident=(0.0, LOG_ZERO, LOG_ZERO, 0.0)):
+def _select4(tile, tab_ref, nreal, ident=(0.0, LOG_ZERO, LOG_ZERO, 0.0),
+             base=0):
     """In-kernel select tree: pair tile [8, LT] -> the 4 matrix-entry tiles.
 
     ``tab_ref`` is the lane-broadcast table [(nreal)*4, LANE_TILE] (row
@@ -293,7 +294,11 @@ def _select4(tile, tab_ref, nreal, ident=(0.0, LOG_ZERO, LOG_ZERO, 0.0)):
     One compare per table row shared by all four selects; PAD pairs
     (p >= S*S) all carry the identity, so they fold into the ``ident``
     defaults — the max-plus identity here, the (+, x) identity (1, 0, 0, 1)
-    for the probability-space twin (ops.fb_onehot).
+    for the probability-space twin (ops.fb_onehot).  ``base`` (static row
+    offset) keys a MODEL's slice of a stacked multi-model table — member m
+    of a stacked launch reads rows [base, base + 4*nreal) where
+    base = m * 4 * nreal; the per-model arithmetic is unchanged, so stacked
+    launches are bit-identical to the single-model kernels.
     """
     t00 = jnp.full(tile.shape, ident[0], jnp.float32)
     t01 = jnp.full(tile.shape, ident[1], jnp.float32)
@@ -301,10 +306,11 @@ def _select4(tile, tab_ref, nreal, ident=(0.0, LOG_ZERO, LOG_ZERO, 0.0)):
     t11 = jnp.full(tile.shape, ident[3], jnp.float32)
     for p in range(nreal):
         cmp = tile == p
-        t00 = jnp.where(cmp, tab_ref[4 * p : 4 * p + 1, :], t00)
-        t01 = jnp.where(cmp, tab_ref[4 * p + 1 : 4 * p + 2, :], t01)
-        t10 = jnp.where(cmp, tab_ref[4 * p + 2 : 4 * p + 3, :], t10)
-        t11 = jnp.where(cmp, tab_ref[4 * p + 3 : 4 * p + 4, :], t11)
+        r = base + 4 * p
+        t00 = jnp.where(cmp, tab_ref[r : r + 1, :], t00)
+        t01 = jnp.where(cmp, tab_ref[r + 1 : r + 2, :], t01)
+        t10 = jnp.where(cmp, tab_ref[r + 2 : r + 3, :], t10)
+        t11 = jnp.where(cmp, tab_ref[r + 3 : r + 4, :], t11)
     return t00, t01, t10, t11
 
 
@@ -628,15 +634,25 @@ def _xla_backpointers_scores(tab: jnp.ndarray, v_red: jnp.ndarray, pair2: jnp.nd
     return jnp.stack([d0, d1], axis=1), E, bp2, dmax2
 
 
-def _xla_backtrace(bp2, pair2, idtab, exit_bits):
-    """Walk the 2-bit rows from the exit bits, emitting state ids [bk, nb]."""
-    glow2 = jnp.take(idtab[:, 0], pair2)
-    ghigh2 = jnp.take(idtab[:, 1], pair2)
+def _xla_backtrace_bits(bp2, exit_bits):
+    """The bit walk of the reduced backtrace (ONE reverse scan): 2-bit rows
+    [bk, nb] + exit bits [nb] -> per-position entry bits [bk, nb].  Shared
+    by the single-model twin and the stacked lane-concatenated twin (the
+    walk is elementwise across lanes, so concatenating members along the
+    lane axis changes no member's arithmetic)."""
 
     def back(bit, row):
         return jnp.right_shift(row, bit) & 1, bit
 
     _, bits = jax.lax.scan(back, exit_bits, bp2, reverse=True)
+    return bits
+
+
+def _xla_backtrace(bp2, pair2, idtab, exit_bits):
+    """Walk the 2-bit rows from the exit bits, emitting state ids [bk, nb]."""
+    glow2 = jnp.take(idtab[:, 0], pair2)
+    ghigh2 = jnp.take(idtab[:, 1], pair2)
+    bits = _xla_backtrace_bits(bp2, exit_bits)
     return jnp.where(bits == 0, glow2, ghigh2)
 
 
@@ -999,3 +1015,651 @@ def decode_batch_flat(
     M = dec.dmax2[e - b * bk, b] + dec.enter_offs[b]
     scores = jnp.concatenate([M[:1], M[1:] - M[:-1]])
     return full.reshape(N, T), scores
+
+
+# ---------------------------------------------------------------------------
+# Stacked multi-model passes: N members' reduced chains in ONE kernel launch.
+#
+# The r8 cost attribution proved the fixed per-pass cost is chain-drain
+# LATENCY, not arithmetic, and the r9 fused fwd/bwd kernel proved two
+# independent 2x2 chains interleave in one kernel with both filling VPU
+# issue slots while either stalls.  Different MEMBERS' chains over the SAME
+# pair stream are exactly as independent: the stacked kernels below carry M
+# members' state (2 rows each) through one grid walk, selecting each
+# member's step matrix from its slice of a stacked lane-broadcast table
+# (``_select4``'s ``base`` offset — tables stacked as extra rows, broadcast
+# OUTSIDE the kernel per the Mosaic rule).  The per-member arithmetic is
+# the single-model kernel's, op for op, so member m's outputs are
+# BIT-IDENTICAL to a single-model launch over the same stream.
+#
+# Off-TPU the twins reuse the single-model one-scan XLA twins over
+# LANE-CONCATENATED streams: member m's pair indices offset by m * n_rows
+# into a row-concatenated table, members side by side on the lane axis —
+# one scan for all members, and exact (the one-hot table contraction adds
+# only exact zeros; every chain op is elementwise across lanes).
+
+
+def stacked_prepared(params_list, steps2, prev0, resets=None, pre=None):
+    """The stacked twin of :func:`_prepared`: ONE shared symbol-only pair
+    stream + per-member tables.  Returns (S, gts, tabs, idtabs, pair2,
+    e_in, e_out, nreal) where gts/tabs/idtabs are per-member lists (reset
+    rows spliced per member when ``resets`` is given — every member shares
+    the reset MASK, each restarts into its own pi/emission scores)."""
+    S = params_list[0].n_symbols
+    for p in params_list[1:]:
+        if p.n_symbols != S:
+            raise ValueError(
+                "stacked members must share one alphabet (pair stream); got "
+                f"n_symbols {[int(q.n_symbols) for q in params_list]}"
+            )
+    if pre is None:
+        pre = prepare_pairs(S, steps2, prev0, resets)
+    pair2, e_in, e_out, nreal = pre
+    want = S * S + (S if resets is not None else 0)
+    if nreal != want:
+        raise ValueError(
+            "prepared pair stream's reset renumbering does not match this "
+            f"call (nreal {nreal} != {want})"
+        )
+    gts, tabs, idtabs = [], [], []
+    for p in params_list:
+        gt = _groups(p)
+        tab, idtab = _pair_table(p, gt)
+        if resets is not None:
+            rrows, rgt = _reset_rows(p, gt)
+            tab = jnp.concatenate([tab[: S * S], rrows, tab[S * S :]], axis=0)
+            idtab = jnp.concatenate(
+                [idtab[: S * S], rgt, idtab[S * S :]], axis=0
+            )
+        gts.append(gt)
+        tabs.append(tab)
+        idtabs.append(idtab)
+    return S, gts, tabs, idtabs, pair2, e_in, e_out, nreal
+
+
+def _xla_products_stacked(tabs, pair2: jnp.ndarray) -> list:
+    """ONE scan over M members' reduced max-plus block products —
+    per-member arithmetic = :func:`_xla_products` (the shared one-hot row
+    select contributes only exact zeros)."""
+    M = len(tabs)
+    nb = pair2.shape[1]
+    C0 = tuple(
+        jnp.broadcast_to(
+            jnp.asarray([0.0, LOG_ZERO, LOG_ZERO, 0.0], jnp.float32), (nb, 4)
+        )
+        + (pair2[0, :, None] * 0).astype(jnp.float32)
+        for _ in range(M)
+    )
+
+    def step(Cs, pk):
+        new = []
+        for m in range(M):
+            T = _sel_rows(tabs[m], pk)
+            C = Cs[m]
+            n00 = jnp.maximum(C[:, 0] + T[:, 0], C[:, 1] + T[:, 2])
+            n01 = jnp.maximum(C[:, 0] + T[:, 1], C[:, 1] + T[:, 3])
+            n10 = jnp.maximum(C[:, 2] + T[:, 0], C[:, 3] + T[:, 2])
+            n11 = jnp.maximum(C[:, 2] + T[:, 1], C[:, 3] + T[:, 3])
+            new.append(jnp.stack([n00, n01, n10, n11], axis=1))
+        return tuple(new), None
+
+    Cs, _ = jax.lax.scan(step, C0, pair2)
+    return [C.reshape(nb, GROUP, GROUP) for C in Cs]
+
+
+def _xla_backpointers_stacked(tabs, v_reds, pair2, want_scores: bool):
+    """ONE scan over M members' reduced delta recursions — per-member
+    arithmetic = :func:`_xla_backpointers`(_scores).  Returns per-member
+    (dexit [nb, 2], ebits [nb], bp2 [bk, nb], dmax2-or-None) tuples."""
+    M = len(tabs)
+    nb = pair2.shape[1]
+    E0 = jnp.full((nb,), 0b10, jnp.int32)
+
+    def step(carry, pk):
+        new, ys = [], []
+        for m in range(M):
+            d0, d1, E = carry[m]
+            T = _sel_rows(tabs[m], pk)
+            a0 = d0 + T[:, 0]
+            a1 = d1 + T[:, 2]
+            b0 = d0 + T[:, 1]
+            b1 = d1 + T[:, 3]
+            bp0 = (a1 > a0).astype(jnp.int32)
+            bp1 = (b1 > b0).astype(jnp.int32)
+            E = (jnp.right_shift(E, bp0) & 1) | (
+                (jnp.right_shift(E, bp1) & 1) << 1
+            )
+            d0n = jnp.maximum(a0, a1)
+            d1n = jnp.maximum(b0, b1)
+            new.append((d0n, d1n, E))
+            bp = bp0 | (bp1 << 1)
+            ys.append(
+                (bp, jnp.maximum(d0n, d1n)) if want_scores else bp
+            )
+        return tuple(new), tuple(ys)
+
+    carries, ys = jax.lax.scan(
+        step,
+        tuple((v[:, 0], v[:, 1], E0) for v in v_reds),
+        pair2,
+    )
+    out = []
+    for m in range(M):
+        d0, d1, E = carries[m]
+        if want_scores:
+            bp2, dmax2 = ys[m]
+        else:
+            bp2, dmax2 = ys[m], None
+        out.append((jnp.stack([d0, d1], axis=1), E, bp2, dmax2))
+    return out
+
+
+def _xla_backtrace_bits_stacked(bp2_list, exit_bits_list) -> list:
+    """ONE reverse scan walking M members' 2-bit rows — each member's walk
+    is :func:`_xla_backtrace_bits`, bit for bit."""
+    M = len(bp2_list)
+
+    def back(bits, rows):
+        return (
+            tuple(jnp.right_shift(rows[m], bits[m]) & 1 for m in range(M)),
+            bits,
+        )
+
+    _, bits_seq = jax.lax.scan(
+        back, tuple(exit_bits_list), tuple(bp2_list), reverse=True
+    )
+    return list(bits_seq)
+
+
+def _oh_products_stacked_kernel(pair_ref, tab_ref, out_ref, *, nreal, bk, M):
+    """Stacked pass A: M members' reduced max-plus products -> [M*4, LT]
+    (member m's C00, C01, C10, C11 at rows 4m..4m+3).  One pair-tile read
+    feeds every member's select; the M 2x2 recurrences interleave per step."""
+    lt = pair_ref.shape[1]
+    z = jnp.zeros((1, lt), jnp.float32)
+    lz = jnp.full((1, lt), LOG_ZERO, jnp.float32)
+    C0 = tuple((z, lz, lz, z) for _ in range(M))
+
+    def body(c, Cs):
+        tile = pair_ref[pl.ds(c * ROW_TILE, ROW_TILE), :]
+        sels = [
+            _select4(tile, tab_ref, nreal, base=m * 4 * nreal)
+            for m in range(M)
+        ]
+        out = []
+        for m in range(M):
+            c00, c01, c10, c11 = Cs[m]
+            t00, t01, t10, t11 = sels[m]
+            for r in range(ROW_TILE):
+                a00 = t00[r : r + 1, :]
+                a01 = t01[r : r + 1, :]
+                a10 = t10[r : r + 1, :]
+                a11 = t11[r : r + 1, :]
+                n00 = jnp.maximum(c00 + a00, c01 + a10)
+                n01 = jnp.maximum(c00 + a01, c01 + a11)
+                n10 = jnp.maximum(c10 + a00, c11 + a10)
+                n11 = jnp.maximum(c10 + a01, c11 + a11)
+                c00, c01, c10, c11 = n00, n01, n10, n11
+            out.append((c00, c01, c10, c11))
+        return tuple(out)
+
+    Cs = jax.lax.fori_loop(0, bk // ROW_TILE, body, C0)
+    for m in range(M):
+        for i in range(4):
+            out_ref[4 * m + i : 4 * m + i + 1, :] = Cs[m][i]
+
+
+def pass_products_stacked(params_list, steps2, prev0=None, resets=None,
+                          pre=None):
+    """Stacked :func:`pass_products`: ONE launch computes every member's
+    block products over the shared pair stream.  Returns a per-member list
+    of (incl, offs, total) — each bit-identical to the member's own
+    single-model pass over the same ``steps2``."""
+    M = len(params_list)
+    S, gts, tabs, _, pair2, e_in, e_out, nreal = stacked_prepared(
+        params_list, steps2, prev0, resets, pre
+    )
+    nb = steps2.shape[1]
+    if _interpret():
+        reds = _xla_products_stacked(tabs, pair2)
+    else:
+        nb_pad = -(-nb // LANE_TILE) * LANE_TILE
+        pair2p = _pad_lanes(pair2, nb_pad, jnp.int32(nreal))
+        pair2p, bk = _pad_pair_rows(
+            pair2p, _pad_lanes(e_out, nb_pad, 0), nreal
+        )
+        tabb = _bcast_tab(jnp.concatenate([t[:nreal] for t in tabs], axis=0))
+        red_flat = pl.pallas_call(
+            functools.partial(
+                _oh_products_stacked_kernel, nreal=nreal, bk=bk, M=M
+            ),
+            grid=(nb_pad // LANE_TILE,),
+            in_specs=[
+                _vspec((bk, LANE_TILE), lambda i: (0, i)),
+                _vspec(tabb.shape, lambda i: (0, 0)),
+            ],
+            out_specs=_vspec((4 * M, LANE_TILE), lambda i: (0, i)),
+            out_shape=jax.ShapeDtypeStruct((4 * M, nb_pad), jnp.float32),
+        )(pair2p, tabb)
+        reds = [
+            red_flat[4 * m : 4 * m + 4].T.reshape(nb_pad, GROUP, GROUP)[:nb]
+            for m in range(M)
+        ]
+    out = []
+    for m in range(M):
+        P = _scatter_products(
+            reds[m], gts[m], e_in, e_out, params_list[m].n_states
+        )
+        incl, offs = scan_block_products(P)
+        out.append((incl, offs, incl[-1]))
+    return out
+
+
+def _oh_backpointers_stacked_kernel(
+    pair_ref, venter_ref, tab_ref, bp_ref, dexit_ref, ebits_ref, *rest,
+    nreal, bk, M, want_scores
+):
+    """Stacked pass B: M members' delta recursions in one launch.
+
+    venter_ref rows 2m..2m+1 = member m's entering vector; bp_ref rows
+    [m*bk/8, (m+1)*bk/8) = member m's packed words; dexit rows 2m..2m+1,
+    ebits row m.  ``want_scores`` adds dmax_ref (member m's per-step chain
+    max at rows [m*bk, (m+1)*bk)) — the stacked flat-batch score feed.
+    Per-member arithmetic = _oh_backpointers(_score)_kernel, op for op.
+    """
+    dmax_ref = rest[0] if want_scores else None
+    lt = pair_ref.shape[1]
+    state0 = tuple(
+        (
+            venter_ref[2 * m : 2 * m + 1, :],
+            venter_ref[2 * m + 1 : 2 * m + 2, :],
+            jnp.full((1, lt), 0b10, jnp.int32),
+        )
+        for m in range(M)
+    )
+
+    def body(c, states):
+        out = []
+        tiles = [
+            pair_ref[pl.ds(c * OUTER_TILE + t8 * ROW_TILE, ROW_TILE), :]
+            for t8 in range(OUTER_TILE // ROW_TILE)
+        ]
+        for m in range(M):
+            d0, d1, E = states[m]
+            words = []
+            for t8 in range(OUTER_TILE // ROW_TILE):
+                t00, t01, t10, t11 = _select4(
+                    tiles[t8], tab_ref, nreal, base=m * 4 * nreal
+                )
+                word = jnp.zeros((1, lt), jnp.int32)
+                drows = [None] * ROW_TILE
+                for r in range(ROW_TILE):
+                    a0 = d0 + t00[r : r + 1, :]
+                    a1 = d1 + t10[r : r + 1, :]
+                    b0 = d0 + t01[r : r + 1, :]
+                    b1 = d1 + t11[r : r + 1, :]
+                    bp0 = (a1 > a0).astype(jnp.int32)
+                    bp1 = (b1 > b0).astype(jnp.int32)
+                    d0 = jnp.maximum(a0, a1)
+                    d1 = jnp.maximum(b0, b1)
+                    word = word | ((bp0 | (bp1 << 1)) << (2 * r))
+                    E = (jnp.right_shift(E, bp0) & 1) | (
+                        ((jnp.right_shift(E, bp1) & 1)) << 1
+                    )
+                    if want_scores:
+                        drows[r] = jnp.maximum(d0, d1)
+                words.append(word)
+                if want_scores:
+                    # Offsets written as (...) * ROW_TILE so Mosaic's
+                    # 8-aligned fast path is provable (m/bk/t8 are
+                    # Python-static; c is the fori counter).
+                    dmax_ref[
+                        pl.ds(
+                            (m * (bk // ROW_TILE)
+                             + c * (OUTER_TILE // ROW_TILE) + t8) * ROW_TILE,
+                            ROW_TILE,
+                        ),
+                        :,
+                    ] = jnp.concatenate(drows, axis=0)
+            bp_ref[
+                pl.ds(
+                    (m * (bk // OUTER_TILE) + c) * (OUTER_TILE // ROW_TILE),
+                    OUTER_TILE // ROW_TILE,
+                ),
+                :,
+            ] = jnp.concatenate(words, axis=0)
+            out.append((d0, d1, E))
+        return tuple(out)
+
+    states = jax.lax.fori_loop(0, bk // OUTER_TILE, body, state0)
+    for m in range(M):
+        d0, d1, E = states[m]
+        dexit_ref[2 * m : 2 * m + 1, :] = d0
+        dexit_ref[2 * m + 1 : 2 * m + 2, :] = d1
+        ebits_ref[m : m + 1, :] = E
+
+
+def pass_backpointers_stacked(params_list, v_enters, steps2, prev0=None,
+                              resets=None, pre=None,
+                              want_scores: bool = False):
+    """Stacked :func:`pass_backpointers` / ``_scores``: M members' delta
+    recursions in ONE launch over the shared pair stream.  ``v_enters`` is
+    the per-member [nb, K_m] entering-vector list; returns per-member
+    (delta_exit, F, dmax2-or-None) lists plus ONE stacked blob for
+    :func:`pass_backtrace_stacked`."""
+    M = len(params_list)
+    S, gts, tabs, idtabs, pair2, e_in, e_out, nreal = stacked_prepared(
+        params_list, steps2, prev0, resets, pre
+    )
+    bk_real, nb = steps2.shape
+    v_reds = [
+        jnp.take_along_axis(v_enters[m], gts[m][e_in], axis=1)
+        for m in range(M)
+    ]
+    ghigh_ends = [gts[m][e_out, 1] for m in range(M)]
+    if _interpret():
+        res = _xla_backpointers_stacked(
+            tabs, [v.astype(jnp.float32) for v in v_reds], pair2,
+            want_scores,
+        )
+        outs = []
+        bp_list = []
+        for m, (dexit_red, ebits_nb, bp2, dmax2) in enumerate(res):
+            delta_exit = _scatter_vec(
+                dexit_red, gts[m], e_out, params_list[m].n_states
+            )
+            F = _scatter_ftab(
+                ebits_nb, gts[m], e_in, e_out, params_list[m].n_states
+            )
+            outs.append((delta_exit, F, dmax2))
+            bp_list.append(bp2)
+        blob = ("xla", tuple(bp_list), pair2, idtabs, ghigh_ends, bk_real, nb)
+        return outs, blob
+    nb_pad = -(-nb // LANE_TILE) * LANE_TILE
+    pair2p = _pad_lanes(pair2, nb_pad, jnp.int32(nreal))
+    pair2p, bk = _pad_pair_rows(pair2p, _pad_lanes(e_out, nb_pad, 0), nreal)
+    v_red2 = jnp.concatenate(
+        [
+            _pad_lanes(v.T.astype(jnp.float32), nb_pad, 0.0)
+            for v in v_reds
+        ],
+        axis=0,
+    )  # [M*GROUP, nb_pad]
+    tabb = _bcast_tab(jnp.concatenate([t[:nreal] for t in tabs], axis=0))
+    out_specs = [
+        _vspec((M * (bk // ROW_TILE), LANE_TILE), lambda i: (0, i)),
+        _vspec((M * GROUP, LANE_TILE), lambda i: (0, i)),
+        _vspec((M, LANE_TILE), lambda i: (0, i)),
+    ]
+    out_shape = [
+        jax.ShapeDtypeStruct((M * (bk // ROW_TILE), nb_pad), jnp.int32),
+        jax.ShapeDtypeStruct((M * GROUP, nb_pad), jnp.float32),
+        jax.ShapeDtypeStruct((M, nb_pad), jnp.int32),
+    ]
+    if want_scores:
+        out_specs.append(_vspec((M * bk, LANE_TILE), lambda i: (0, i)))
+        out_shape.append(
+            jax.ShapeDtypeStruct((M * bk, nb_pad), jnp.float32)
+        )
+    kouts = pl.pallas_call(
+        functools.partial(
+            _oh_backpointers_stacked_kernel, nreal=nreal, bk=bk, M=M,
+            want_scores=want_scores,
+        ),
+        grid=(nb_pad // LANE_TILE,),
+        in_specs=[
+            _vspec((bk, LANE_TILE), lambda i: (0, i)),
+            _vspec((M * GROUP, LANE_TILE), lambda i: (0, i)),
+            _vspec(tabb.shape, lambda i: (0, 0)),
+        ],
+        out_specs=out_specs,
+        out_shape=out_shape,
+    )(pair2p, v_red2, tabb)
+    bp_packed, dexit_red, ebits = kouts[:3]
+    outs = []
+    for m in range(M):
+        delta_exit = _scatter_vec(
+            dexit_red[2 * m : 2 * m + 2].T[:nb], gts[m], e_out,
+            params_list[m].n_states,
+        )
+        F = _scatter_ftab(
+            ebits[m, :nb], gts[m], e_in, e_out, params_list[m].n_states
+        )
+        dmax2 = (
+            kouts[3][m * bk : m * bk + bk_real, :nb] if want_scores else None
+        )
+        outs.append((delta_exit, F, dmax2))
+    blob = ("pallas", bp_packed, pair2p, idtabs, ghigh_ends, bk_real, nb)
+    return outs, blob
+
+
+def _oh_backtrace_stacked_kernel(bp_ref, pair_ref, idtab_ref, exit_ref,
+                                 path_ref, *, nP, bk, M):
+    """Stacked pass C: M members' bit walks from their anchored exit bits,
+    one pair-tile read per step feeding every member's id select (member
+    m's ids at idtab rows [m*2*nP, (m+1)*2*nP), path rows [m*bk, (m+1)*bk))."""
+    nc = bk // OUTER_TILE
+
+    def body(i, bits):
+        c = nc - 1 - i
+        out = []
+        for m in range(M):
+            bit = bits[m]
+            words = bp_ref[
+                pl.ds(
+                    (m * (bk // OUTER_TILE) + c) * (OUTER_TILE // ROW_TILE),
+                    OUTER_TILE // ROW_TILE,
+                ),
+                :,
+            ]
+            for t8 in range(OUTER_TILE // ROW_TILE - 1, -1, -1):
+                tile = pair_ref[
+                    pl.ds(c * OUTER_TILE + t8 * ROW_TILE, ROW_TILE), :
+                ]
+                glow = jnp.zeros(tile.shape, jnp.int32)
+                ghigh = jnp.zeros(tile.shape, jnp.int32)
+                for p in range(nP):
+                    cmp = tile == p
+                    r0 = m * 2 * nP + 2 * p
+                    glow = jnp.where(cmp, idtab_ref[r0 : r0 + 1, :], glow)
+                    ghigh = jnp.where(
+                        cmp, idtab_ref[r0 + 1 : r0 + 2, :], ghigh
+                    )
+                word = words[t8 : t8 + 1, :]
+                rows = [None] * ROW_TILE
+                for r in range(ROW_TILE - 1, -1, -1):
+                    rows[r] = jnp.where(
+                        bit == 0, glow[r : r + 1, :], ghigh[r : r + 1, :]
+                    )
+                    bit = jnp.right_shift(word, 2 * r + bit) & 1
+                path_ref[
+                    pl.ds(
+                        (m * (bk // ROW_TILE)
+                         + c * (OUTER_TILE // ROW_TILE) + t8) * ROW_TILE,
+                        ROW_TILE,
+                    ),
+                    :,
+                ] = jnp.concatenate(rows, axis=0)
+            out.append(bit)
+        return tuple(out)
+
+    jax.lax.fori_loop(
+        0, nc, body,
+        tuple(exit_ref[m : m + 1, :] for m in range(M)),
+    )
+
+
+def pass_backtrace_stacked(blob, exits_list) -> list:
+    """Stacked :func:`pass_backtrace`: every member's path off the shared
+    packed pointers in ONE launch.  ``exits_list``: per-member exit-state
+    anchors [nb].  Returns per-member [bk*nb] state-id paths."""
+    kind, bp, pair2, idtabs, ghigh_ends, bk_real, nb = blob
+    M = len(idtabs)
+    exit_bits = [
+        (exits_list[m] == ghigh_ends[m]).astype(jnp.int32) for m in range(M)
+    ]
+    if kind == "xla":
+        # One reverse scan walks every member's bit rows; the pair->id
+        # mapping differs per member, so ids resolve per member after.
+        bits_list = _xla_backtrace_bits_stacked(list(bp), exit_bits)
+        out = []
+        for m in range(M):
+            glow2 = jnp.take(idtabs[m][:, 0], pair2)
+            ghigh2 = jnp.take(idtabs[m][:, 1], pair2)
+            out.append(
+                jnp.where(bits_list[m] == 0, glow2, ghigh2).T.reshape(-1)
+            )
+        return out
+    bk = pair2.shape[0]
+    nb_pad = pair2.shape[1]
+    nP = idtabs[0].shape[0]
+    exits2 = jnp.concatenate(
+        [_pad_lanes(b[None, :], nb_pad, 0) for b in exit_bits], axis=0
+    )
+    idtabb = _bcast_tab(jnp.concatenate(idtabs, axis=0))
+    path2 = pl.pallas_call(
+        functools.partial(_oh_backtrace_stacked_kernel, nP=nP, bk=bk, M=M),
+        grid=(nb_pad // LANE_TILE,),
+        in_specs=[
+            _vspec((M * (bk // ROW_TILE), LANE_TILE), lambda i: (0, i)),
+            _vspec((bk, LANE_TILE), lambda i: (0, i)),
+            _vspec(idtabb.shape, lambda i: (0, 0)),
+            _vspec((M, LANE_TILE), lambda i: (0, i)),
+        ],
+        out_specs=_vspec((M * bk, LANE_TILE), lambda i: (0, i)),
+        out_shape=jax.ShapeDtypeStruct((M * bk, nb_pad), jnp.int32),
+    )(bp, pair2, idtabb, exits2)
+    return [
+        path2[m * bk : m * bk + bk_real, :nb].T.reshape(-1)
+        for m in range(M)
+    ]
+
+
+def _block_passes_stacked(params_list, v0s, padded, bk, resets, pre,
+                          want_scores: bool = False):
+    """The stacked twin of viterbi_parallel._block_passes (onehot engine):
+    ONE launch per T-scaling pass for every member; the model-sized
+    stitching (prefix scans, enter vectors, suffix compositions) loops
+    members in XLA.  Returns a per-member list of BlockDecode."""
+    from cpgisland_tpu.ops.viterbi_parallel import (
+        BlockDecode,
+        _enter_vectors,
+        _suffix_compositions,
+    )
+
+    nb = padded.shape[0] // bk
+    steps2 = padded.reshape(nb, bk).T
+    prods = pass_products_stacked(
+        params_list, steps2, None, resets=resets, pre=pre
+    )
+    v_enters, enter_offs = [], []
+    for m, (incl, offs, _total) in enumerate(prods):
+        v, off = _enter_vectors(v0s[m], incl, offs)
+        v_enters.append(v)
+        enter_offs.append(off)
+    bps, blob = pass_backpointers_stacked(
+        params_list, v_enters, steps2, None, resets=resets, pre=pre,
+        want_scores=want_scores,
+    )
+    exits_list, Gsufs = [], []
+    for m, (delta_blocks, F, _dmax2) in enumerate(bps):
+        s_exit = jnp.argmax(delta_blocks[-1]).astype(jnp.int32)
+        Gsuf = _suffix_compositions(F)
+        exits_list.append(
+            jnp.concatenate([Gsuf[1:, :][:, s_exit], s_exit[None]])
+        )
+        Gsufs.append(Gsuf)
+    paths = pass_backtrace_stacked(blob, exits_list)
+    out = []
+    for m, (delta_blocks, _F, dmax2) in enumerate(bps):
+        _incl, _offs, total = prods[m]
+        out.append(
+            BlockDecode(
+                path=paths[m], delta_exit=delta_blocks[-1], total=total,
+                ftable=Gsufs[m][0], score_offset=enter_offs[m][-1],
+                enter_offs=enter_offs[m] if want_scores else None,
+                dmax2=dmax2,
+            )
+        )
+    return out
+
+
+def decode_batch_flat_stacked(
+    params_list,
+    chunks: jnp.ndarray,
+    lengths: jnp.ndarray,
+    block_size: int = 4096,
+    prepared=None,
+    return_score: bool = False,
+):
+    """Decode ONE [N, T] batch under M models in ONE stacked launch set.
+
+    The multi-model twin of :func:`decode_batch_flat`: the flat reset-step
+    stream is symbol-only, so every member shares it (and its prep), and
+    the three T-scaling passes run stacked — M members' chains pay ONE
+    pass set of fixed cost instead of M.  Member m's paths (and scores,
+    with ``return_score``) are BIT-IDENTICAL to
+    ``decode_batch_flat(params_list[m], chunks, lengths, block_size)`` —
+    same stream, same constants, same rounding (the stacked kernels run
+    the single-model arithmetic per member).  Same exactness domain as the
+    flat decoder (records' first positions must be real symbols; callers
+    demote pad-FIRST records).  VMEM note: the score variant's per-member
+    dmax rows scale the kernel working set by M — on-chip, large M wants a
+    smaller ``block_size`` (knob to re-sweep at capture, BASELINE.md).
+
+    Returns paths [M, N, T] (or (paths, scores [M, N])).
+    """
+    S = params_list[0].n_symbols
+    N, T = chunks.shape
+    if T < 2:
+        raise ValueError(
+            "decode_batch_flat_stacked needs records of at least 2 symbols"
+        )
+    if prepared is None:
+        prepared = prepare_decode_flat(S, chunks, lengths, block_size)
+    concat, padded, resets, bk, pre = prepared
+    Np = N * T
+    n_steps = Np - 1
+    want_bk = min(block_size, max(8, n_steps))
+    if concat.shape[0] != Np or bk != want_bk:
+        raise ValueError(
+            f"prepared decode stream was built for {concat.shape[0]} "
+            f"symbols / bk={bk}; this call needs {Np} symbols / "
+            f"bk={want_bk} — rebuild it with prepare_decode_flat"
+        )
+    from cpgisland_tpu.ops.viterbi_parallel import _step_tables
+
+    v0s = []
+    for p in params_list:
+        _, emit_ext = _step_tables(p)
+        v0s.append(p.log_pi + emit_ext[concat[0]])
+    decs = _block_passes_stacked(
+        params_list, v0s, padded, bk, resets, pre, want_scores=return_score
+    )
+    paths, scores = [], []
+    for dec in decs:
+        s0 = dec.ftable[jnp.argmax(dec.delta_exit)]
+        full = jnp.concatenate([s0[None], dec.path[:n_steps]])
+        paths.append(full.reshape(N, T))
+        if return_score:
+            e = (jnp.arange(N, dtype=jnp.int32) + 1) * T - 2
+            b = e // bk
+            Mx = dec.dmax2[e - b * bk, b] + dec.enter_offs[b]
+            scores.append(jnp.concatenate([Mx[:1], Mx[1:] - Mx[:-1]]))
+    if not return_score:
+        return jnp.stack(paths)
+    return jnp.stack(paths), jnp.stack(scores)
+
+
+@functools.partial(jax.jit, static_argnames=("block_size", "return_score"))
+def decode_batch_flat_stacked_jit(
+    params_list, chunks, lengths, block_size: int = 4096,
+    return_score: bool = False,
+):
+    """One-dispatch entry for :func:`decode_batch_flat_stacked` (the serve
+    broker's mixed-model flush unit; prep builds in-graph — per-flush
+    record sets never repeat, so there is nothing to amortize)."""
+    return decode_batch_flat_stacked(
+        tuple(params_list), chunks, lengths, block_size=block_size,
+        return_score=return_score,
+    )

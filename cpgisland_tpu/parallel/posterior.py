@@ -58,6 +58,15 @@ def fb_engine_twin(engine: str, params: HmmParams) -> Optional[str]:
     )(engine)
 
 
+def _onehot_fb_ok(params: HmmParams) -> bool:
+    """The reduced FB engine's state envelope: the chains are K-free, but
+    the boundary glue/stats accumulators scatter [K] rows — bounded by
+    fb_onehot.ONEHOT_MAX_STATES (32, the dinuc member's K)."""
+    from cpgisland_tpu.ops.fb_onehot import ONEHOT_MAX_STATES
+
+    return params.n_states <= ONEHOT_MAX_STATES
+
+
 def resolve_fb_engine(engine: str, params: HmmParams, *, breaker=None) -> str:
     """'auto' picks the reduced one-hot FB kernels on TPU when the model's
     emission structure supports them (ops.fb_onehot — the flagship 8-state
@@ -73,13 +82,19 @@ def resolve_fb_engine(engine: str, params: HmmParams, *, breaker=None) -> str:
 
     if engine == "auto":
         resolved = "xla"
-        if jax.default_backend() == "tpu" and fb_pallas.supports(params):
+        if jax.default_backend() == "tpu":
             # family.partition_of — the one eligibility oracle shared with
-            # the decode/train routers.
-            resolved = (
-                "onehot" if family_partition.reduced_eligible(params)
-                else "pallas"
-            )
+            # the decode/train routers.  The reduced engine's chains are
+            # K-free (2 components), so its envelope is the reduced one
+            # (fb_onehot.ONEHOT_MAX_STATES — admits the 32-state dinuc
+            # member, ROADMAP item 2's K<=8 lift), while the dense fused
+            # kernels keep their n_states <= 8 lane packing.
+            if family_partition.reduced_eligible(params) and _onehot_fb_ok(
+                params
+            ):
+                resolved = "onehot"
+            elif fb_pallas.supports(params):
+                resolved = "pallas"
         obs_module.engine_decision(
             site="posterior.resolve_fb_engine", choice=resolved, requested=engine
         )
@@ -97,13 +112,14 @@ def resolve_fb_engine(engine: str, params: HmmParams, *, breaker=None) -> str:
             f"pallas FB kernels need n_states <= 8, got {params.n_states}"
         )
     if engine == "onehot" and not (
-        fb_pallas.supports(params)
+        _onehot_fb_ok(params)
         and family_partition.reduced_eligible(params)
     ):
         raise ValueError(
             "onehot FB kernels need a one-hot emission-support partition "
             "with 2 states per symbol (family.partition_of; concrete "
-            "params) and the fused kernels' state envelope (n_states <= 8)"
+            "params) inside the reduced state envelope (n_states <= "
+            "fb_onehot.ONEHOT_MAX_STATES)"
         )
     obs_module.engine_decision(
         site="posterior.resolve_fb_engine", choice=engine, requested=engine
@@ -432,6 +448,121 @@ def posterior_sharded(
     conf = fetch_sharded_prefix(conf, T, return_device)
     path = fetch_sharded_prefix(path, T, return_device) if want_path else None
     return conf, path
+
+
+@functools.lru_cache(maxsize=32)
+def _posterior_fn_stacked(
+    mesh: Mesh,
+    block_size: int,
+    n_members: int,
+    want_path: bool,
+    lane_T: int,
+    t_tile: int,
+    fused: bool = True,
+):
+    """Compiled stacked sharded posterior: fn(params_tuple, obs, lens,
+    masks_tuple) -> (conf [M, T] P(None, axis), path [M, T]) — the
+    multi-model twin of :func:`_posterior_fn` (first spans only; the
+    comparison workload's record units are whole records)."""
+    axis = mesh.axis_names[0]
+    del block_size, n_members  # part of the cache key, not the body
+
+    def body(params_list, obs_shard, len_shard, masks):
+        return fb_pallas._seq_posterior_core_stacked(
+            params_list, obs_shard, len_shard[0], masks, lane_T, t_tile,
+            axis=axis, want_path=want_path, fused=fused,
+        )
+
+    return jax.jit(
+        jax.shard_map(
+            body,
+            mesh=mesh,
+            in_specs=(P(), P(axis), P(axis), P()),
+            out_specs=(P(None, axis), P(None, axis)),
+            check_vma=False,
+        )
+    )
+
+
+def posterior_sharded_stacked(
+    params_list,
+    obs,
+    island_states_list,
+    *,
+    mesh: Optional[Mesh] = None,
+    block_size: int = DEFAULT_BLOCK,
+    lane_T: Optional[int] = None,
+    t_tile: Optional[int] = None,
+    want_path: bool = False,
+    return_device: bool = False,
+    pad_to: Optional[int] = None,
+    placed=None,
+    prepared=None,
+    fused: bool = True,
+):
+    """STACKED island confidence (and optional MPM paths) for M reduced
+    members over ONE record: every member's chains run in one stacked
+    launch set over one shared placed stream (the occupancy half of
+    ROADMAP item 2).  Per-member outputs are bit-identical to M
+    :func:`posterior_sharded` calls with ``engine='onehot'`` on the same
+    input/geometry — callers gate membership on the resolved engine being
+    'onehot' (family.stacked).  ``placed``: the order's ONE uploaded
+    (arr, lens) pair, shared with the sequential arm and the scoring pass
+    (zero duplicate uploads).  Returns (conf [M, T], path [M, T] or None).
+    """
+    if mesh is None:
+        mesh = make_mesh(axis=SEQ_AXIS)
+    params_list = tuple(params_list)
+    tt = t_tile if t_tile is not None else fb_pallas.DEFAULT_T_TILE
+    T = int(np.asarray(obs).shape[0]) if placed is None else int(obs.shape[0])
+    arr, lens = (
+        placed
+        if placed is not None
+        else _place(
+            mesh, np.asarray(obs), block_size,
+            params_list[0].n_symbols, pad_to=pad_to,
+        )
+    )
+    lt = (
+        lane_T
+        if lane_T is not None
+        else fb_pallas.pick_lane_T(
+            arr.shape[0] // mesh.shape[mesh.axis_names[0]], onehot=True,
+            long_lanes=not want_path,
+        )
+    )
+    masks = tuple(
+        jnp.asarray(island_mask(p, s))
+        for p, s in zip(params_list, island_states_list)
+    )
+    if (
+        prepared is not None
+        and mesh.shape[mesh.axis_names[0]] == 1
+    ):
+        conf, path = fb_pallas.seq_posterior_pallas_stacked(
+            params_list, arr, T, masks, want_path=want_path,
+            lane_T=prepared.lane_T, t_tile=tt, prepared=prepared,
+            fused=fused,
+        )
+    else:
+        fn = _posterior_fn_stacked(
+            mesh, block_size, len(params_list), want_path, lt, tt, fused
+        )
+        conf, path = fn(params_list, arr, lens, masks)
+    def rows(x):
+        # Per-member prefix fetch through the one multi-host-safe helper
+        # (each row is sharded along the time axis like the single-model
+        # outputs); M is small, so M tiny fetches beat a bespoke gather.
+        got = [
+            fetch_sharded_prefix(x[m], T, return_device)
+            for m in range(len(params_list))
+        ]
+        return jnp.stack(got) if return_device else np.stack(
+            [np.asarray(g) for g in got]
+        )
+
+    confs = rows(conf)
+    return confs, rows(path) if want_path else None
 
 
 def transfer_total_sharded(

@@ -1212,6 +1212,130 @@ def _decode_small_batch(
     return B, parts, paths_out
 
 
+def _decode_small_batch_stacked(
+    params_list,
+    batch: list,
+    owners: list,
+    *,
+    min_len,
+    island_states_list,
+    use_device_list,
+    cap_boxes,
+    timer: profiling.PhaseTimer,
+    supervisor=None,
+):
+    """Decode ONE small-record batch under M models in a STACKED flat
+    launch set — the serve broker's mixed-model decode flush unit.
+
+    All records (across models) ride ONE reset-step stream; every model's
+    reduced chains run stacked (viterbi_onehot.decode_batch_flat_stacked),
+    and record i's island calls come from its OWNING model's path
+    (``owners[i]`` indexes ``params_list``).  Exactness: record i's path
+    is bit-identical to ``owners[i]``'s own flat decode of this same
+    padded batch; vs the per-model sequential flush (whose flat streams
+    contain only that model's records) paths agree modulo the flat
+    decoder's pinned rounding-tie contract (PARITY.md C10) — the reset
+    entry constant differs, argmax paths only move on exact ties.
+
+    Island calling runs per model on its records (device islands via the
+    shared batched reduction, host islands via the pipelines' exact host
+    callers).  Returns (B, [IslandCalls per record] in batch order).
+    """
+    from cpgisland_tpu.ops.viterbi_onehot import decode_batch_flat_stacked_jit
+
+    B = len(batch)
+    sizes = [s.size for _, s in batch]
+    Tpad = _round_pow2(max(sizes + [1]))
+    Bp = _round_pow2(B, floor=8)
+    rows = np.full((Bp, Tpad), chunking.PAD_SYMBOL, np.uint8)
+    for i, (_, s) in enumerate(batch):
+        rows[i, : s.size] = s
+    lengths = np.zeros(Bp, np.int32)
+    lengths[:B] = sizes
+    sup = supervisor if supervisor is not None else resilience.default_supervisor()
+    any_dev = any(use_device_list)
+
+    def decode_unit(block: bool):
+        paths = decode_batch_flat_stacked_jit(
+            tuple(params_list), jnp.asarray(obs.note_upload(rows)),
+            jnp.asarray(lengths),
+        )
+        if block:
+            # Phase-attribution + fault-surfacing block, the
+            # _decode_small_batch contract (the obs ledger counts it via
+            # its block_until_ready hook).
+            jax.block_until_ready(paths)
+        return paths
+
+    total = float(sum(sizes))
+    with timer.phase("decode", items=total, unit="sym"):
+        if any_dev:
+            paths = sup.run(
+                lambda: decode_unit(True), what="decode.batch.stacked",
+                engine="decode.onehot.stacked", items=total,
+            )
+        else:
+            paths = sup.run(
+                lambda: obs.note_fetch(np.asarray(decode_unit(False))),
+                what="decode.batch.stacked",
+                engine="decode.onehot.stacked", items=total,
+            )
+
+    parts: list = [None] * B
+    with timer.phase("islands", items=total, unit="sym"):
+        for m in range(len(params_list)):
+            idx = [i for i in range(B) if owners[i] == m]
+            if not idx:
+                continue
+            batch_m = [batch[i] for i in idx]
+            if use_device_list[m]:
+                # Pow2-pad the per-model sub-batch rows (zero-length pad
+                # rows emit no calls) so varying per-flush model mixes
+                # share island-reduction compiles — the same bucket
+                # discipline as the whole-batch layout above.
+                Bmp = _round_pow2(len(idx), floor=8)
+                sel_np = np.asarray(
+                    idx + [idx[0]] * (Bmp - len(idx)), np.int32
+                )
+                lens_m = lengths[sel_np].copy()
+                lens_m[len(idx):] = 0
+                calls_m = _batched_device_calls(
+                    params_list[m], paths[m][jnp.asarray(sel_np)],
+                    rows[sel_np], lens_m, batch_m,
+                    island_states=island_states_list[m], min_len=min_len,
+                    cap_box=cap_boxes[m], supervisor=sup,
+                )
+            else:
+                pm = paths[m]
+                if any_dev:
+                    # ONE batched, ledger-counted fetch per model (the
+                    # relay pays per round trip; per-record row fetches
+                    # would be unbatched AND uncounted).
+                    pm = obs.note_fetch(
+                        np.asarray(pm[jnp.asarray(np.asarray(idx, np.int32))])
+                    )
+                else:
+                    pm = np.asarray(pm)[np.asarray(idx)]
+                calls_m = []
+                for k, i in enumerate(idx):
+                    name, symbols = batch[i]
+                    row = np.asarray(pm[k][: symbols.size])
+                    if island_states_list[m] is not None:
+                        c = islands_mod.call_islands_obs(
+                            row, symbols,
+                            island_states=island_states_list[m],
+                            min_len=min_len,
+                        )
+                    else:
+                        c = islands_mod.call_islands(
+                            row, chunk=0, compat=False, min_len=min_len
+                        )
+                    calls_m.append(c.with_names(name or "."))
+            for k, i in enumerate(idx):
+                parts[i] = calls_m[k]
+    return B, parts
+
+
 # One posterior pass materializes the alpha/beta kernel streams on device
 # (~72 B/symbol at K=8), so 64 Mi spans keep the working set under ~5 GB of
 # HBM.  Longer records process span-wise with boundary-message threading
@@ -1246,6 +1370,7 @@ def _posterior_record_unit(
     return_device: bool,
     sup,
     supervised: bool = True,
+    placed=None,
 ):
     """ONE record's posterior dispatch+fetch — the shared core of
     posterior_file's single-record path AND the serve broker's posterior
@@ -1254,7 +1379,11 @@ def _posterior_record_unit(
     bucket (floor 16 Ki) so varied record sizes share compiled shapes.
     ``supervised=False`` returns the raw unsupervised unit result (the
     recompute-fallback closures re-derive through it without nesting a
-    second retry loop)."""
+    second retry loop).  ``placed`` (parallel.posterior.place_record_span
+    with the same pow2 bucket): an already-uploaded (arr, lens) pair —
+    the compare workload places each order's stream ONCE and shares it
+    across that order's members (bit-identical: _place with identical
+    arguments produces identical arrays)."""
     from cpgisland_tpu.parallel.posterior import posterior_sharded
 
     def record_unit():
@@ -1265,6 +1394,7 @@ def _posterior_record_unit(
             # Power-of-two buckets: scaffold-heavy files must not
             # compile once per distinct record size.
             pad_to=_round_pow2(symbols.size, floor=1 << 14),
+            placed=placed,
             breaker=sup.breaker,
         )
         if return_device:
@@ -1962,6 +2092,7 @@ def compare_file(
     metrics: Optional[profiling.MetricsLogger] = None,
     timer: Optional[profiling.PhaseTimer] = None,
     sessions=None,
+    stacked: bool = True,
 ) -> CompareResult:
     """Multi-model posterior comparison over a FASTA file (clean
     semantics, per record) — ``cpgisland compare``.
@@ -1982,7 +2113,10 @@ def compare_file(
 
     ``members`` defaults to the 3-model cast (durbin8, two_state, null);
     ``sessions`` maps member names to serve Sessions (the daemon's
-    per-model fault domains).
+    per-model fault domains).  ``stacked`` (default) groups same-order
+    reduced members into ONE stacked launch set per record
+    (family.stacked — bit-identical results either way; False is the
+    launch-level A/B arm, `cpgisland compare --no-stacked`).
     """
     from cpgisland_tpu import family
 
@@ -2005,7 +2139,8 @@ def compare_file(
                 family.compare_record(
                     members, symbols, record=rec_name or ".",
                     engine=engine, baseline=members[b_idx].name,
-                    min_len=min_len, sessions=sessions, **kw,
+                    min_len=min_len, sessions=sessions, stacked=stacked,
+                    **kw,
                 )
             )
     if out is not None:

@@ -85,6 +85,16 @@ class BrokerConfig:
 
     ``min_len`` / ``island_states``: island-calling config, broker-wide
     (the same knobs the decode/posterior CLIs take per run).
+
+    ``stacked``: multi-model kernel occupancy (ROADMAP item 2) — compare
+    flushes group reduced members into ONE stacked launch set
+    (family.stacked; per-member results bit-identical to the sequential
+    arm), and batch-eligible decode requests of DIFFERENT onehot models
+    coalesce into one stacked flat stream (one launch set instead of one
+    per model; per-record paths equal the sequential flush modulo the flat
+    decoder's pinned rounding-tie contract).  False = the sequential
+    per-model arm everywhere — the A/B escape hatch, same pattern as the
+    kernel-level ``fused``/``stacked`` flags.
     """
 
     flush_symbols: int = 8 << 20
@@ -95,6 +105,7 @@ class BrokerConfig:
     posterior_span: int = pipeline.POSTERIOR_SPAN
     min_len: Optional[int] = None
     island_states: Optional[tuple] = None
+    stacked: bool = True
 
 
 @dataclasses.dataclass
@@ -589,6 +600,15 @@ class RequestBroker:
                     compares.append(req)
                 else:
                     by_model.setdefault(req.model, []).append(req)
+            # Snapshot BEFORE the stacked path prunes fully-handled model
+            # groups — the flush event reports the models SERVED.
+            n_models = len(by_model)
+            n_stacked = (
+                self._flush_decode_stacked(by_model, results)
+                if self.config.stacked and len(by_model) >= 2
+                else 0
+            )
+            n_flat += n_stacked
             for model in sorted(by_model):
                 if model:
                     # A registered member carries its own island labeling;
@@ -619,7 +639,7 @@ class RequestBroker:
         obs.event(
             "serve_flush", n_requests=len(batch), n_flat=n_flat,
             n_singles=n_singles, n_posterior=n_posts,
-            n_compare=len(compares), n_models=len(by_model),
+            n_compare=len(compares), n_models=n_models,
             symbols=int(total), wall_s=round(wall, 4),
         )
         out = []
@@ -722,6 +742,95 @@ class RequestBroker:
         return len(flat), len(singles), len(posts)
 
     # graftcheck: hot-path
+    def _flush_decode_stacked(self, by_model: dict, results: dict) -> int:
+        """Mixed-model decode stacking: batch-eligible decode requests of
+        >= 2 onehot models (one shared alphabet) coalesce into ONE stacked
+        flat launch set; each record's calls come from its owning model's
+        chains.  Mutates ``by_model`` (handled requests removed) and fills
+        ``results``; returns the number of stacked requests.  A failing
+        stacked unit handles NOTHING — the per-model sequential groups
+        then serve every request under their own sessions (the fault-
+        domain fallback, like family.compare's stacked arm)."""
+        cfg = self.config
+        cand = []
+        for model in sorted(by_model):
+            sess = self.registry.session(model)
+            try:
+                eng = sess.decode_engine()
+            except Exception:
+                # A session whose explicit engine no longer validates fails
+                # ITS requests in its own flush group, not the stacked scan.
+                continue
+            if eng != "onehot":
+                continue
+            S = sess.params.n_symbols
+            flat = [
+                r for r in by_model[model]
+                if r.kind == "decode"
+                and 0 < r.symbols.size <= pipeline.SMALL_RECORD_MAX
+                and r.symbols.size <= cfg.flush_symbols
+                and int(r.symbols[0]) < S
+            ]
+            if flat:
+                cand.append((model, sess, flat))
+        if len(cand) < 2:
+            return 0
+        if len({c[1].params.n_symbols for c in cand}) != 1:
+            return 0
+        params_list, batch, owners, isl_list, use_list, caps, reqs = (
+            [], [], [], [], [], [], []
+        )
+        for m, (model, sess, flat) in enumerate(cand):
+            params_list.append(sess.params)
+            if model:
+                isl = tuple(self.registry.member(model).island_states)
+            else:
+                isl = cfg.island_states
+            use_dev, cap_box = sess.island_policy(
+                device_eligible=True,
+                ineligible_msg="unreachable: serve requests no path dumps",
+            )
+            isl_list.append(isl)
+            use_list.append(use_dev)
+            caps.append(cap_box)
+            for r in flat:
+                batch.append((r.name or ".", r.symbols))
+                owners.append(m)
+                reqs.append(r)
+        try:
+            _B, parts = pipeline._decode_small_batch_stacked(
+                params_list, batch, owners,
+                min_len=cfg.min_len, island_states_list=isl_list,
+                use_device_list=use_list, cap_boxes=caps,
+                timer=self._timer,
+                supervisor=self.registry.default.supervisor,
+            )
+        except Exception as e:
+            log.error(
+                "serve: stacked decode flush failed (%s: %s); falling back "
+                "to per-model groups", type(e).__name__, e,
+            )
+            return 0
+        handled = set()
+        for req, calls in zip(reqs, parts):
+            results[req.id] = ServeResult(
+                id=req.id, tenant=req.tenant, kind=req.kind, calls=calls,
+                n_symbols=int(req.symbols.size), route="flat-stacked",
+            )
+            handled.add(req.id)
+        for model in list(by_model):
+            rest = [r for r in by_model[model] if r.id not in handled]
+            if rest:
+                by_model[model] = rest
+            else:
+                del by_model[model]
+        obs.event(
+            "stacked_dispatch", _dedupe=True, kind="decode",
+            n_members=len(cand), n_requests=len(handled),
+        )
+        return len(handled)
+
+    # graftcheck: hot-path
     def _compare_record(self, req: ServeRequest) -> ServeResult:
         """One compare request: the family comparison over the registry's
         member sessions (family.compare_record — the same record units the
@@ -735,6 +844,11 @@ class RequestBroker:
             members, req.symbols, record=req.name or ".",
             min_len=self.config.min_len,
             sessions=self.registry.sessions_for(req.models),
+            stacked=self.config.stacked,
+            # ONE PreparedStreams handle per alphabet, shared across the
+            # members of a stream — the stacked group's symbol-only prep
+            # books against the registry, not any single member session.
+            streams_handle=self.registry.compare_streams,
         )
         return ServeResult(
             id=req.id, tenant=req.tenant, kind=req.kind,

@@ -213,6 +213,12 @@ class ModelRegistry:
         self._lock = threading.Lock()
         self._default = default
         self._entries: dict = {}  # name -> (Member | None, Session)
+        # ONE PreparedStreams handle per alphabet for the STACKED compare
+        # dispatch: members of one stream share a symbol-only prep (the
+        # pair stream reads nothing from any member's params), so the
+        # artifact belongs to the registry — one handle across members —
+        # not to any single member session.  close() releases them.
+        self._compare_streams: dict = {}  # n_symbols -> PreparedStreams
 
     @property
     def default(self) -> Session:
@@ -276,10 +282,29 @@ class ModelRegistry:
         """name -> Session map for a compare request's member set."""
         return {n: self.session(n) for n in names}
 
+    def compare_streams(self, n_symbols: int):
+        """The registry's shared PreparedStreams handle for ``n_symbols``
+        (created on first use) — family.compare_record's
+        ``streams_handle`` provider: one handle per stream alphabet,
+        shared across every member of a stacked group."""
+        from cpgisland_tpu.ops.prepared import PreparedStreams
+
+        with self._lock:
+            handle = self._compare_streams.get(int(n_symbols))
+            if handle is None:
+                handle = PreparedStreams(int(n_symbols))
+                self._compare_streams[int(n_symbols)] = handle
+            return handle
+
     def close(self) -> None:
-        """Release every registered session's prepared-stream entries
-        (the default session belongs to the caller)."""
+        """Release every registered session's prepared-stream entries and
+        the registry-owned compare handles (the default session belongs to
+        the caller)."""
         with self._lock:
             entries = list(self._entries.values())
+            shared = list(self._compare_streams.values())
+            self._compare_streams.clear()
+        for handle in shared:
+            handle.clear_session()
         for _, sess in entries:
             sess.close()

@@ -328,6 +328,7 @@ def _build_broker(args, params) -> RequestBroker:
         tenant_max_symbols=args.tenant_max_symbols,
         min_len=args.min_len,
         island_states=args.island_states,
+        stacked=not getattr(args, "no_stacked", False),
     )
     return RequestBroker(
         session, config, registry=registry,

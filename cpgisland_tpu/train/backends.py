@@ -36,6 +36,15 @@ from cpgisland_tpu.parallel.mesh import make_mesh
 from cpgisland_tpu.utils import chunking
 
 
+def _onehot_envelope_ok(params: HmmParams) -> bool:
+    """The reduced engines' state envelope (fb_onehot.ONEHOT_MAX_STATES) —
+    the chains are K-free; [K*K] stats accumulators bound K at 32 (the
+    dinucleotide member's size, ROADMAP item 2's K<=8 lift)."""
+    from cpgisland_tpu.ops.fb_onehot import ONEHOT_MAX_STATES
+
+    return params.n_states <= ONEHOT_MAX_STATES
+
+
 def _em_engine_twin(engine: str, params: HmmParams) -> "Optional[str]":
     """Parity-twin ladder for the E-step engines (the resilience breaker's
     fallback map, keyed ``em.<engine>`` — the shared
@@ -66,11 +75,7 @@ def resolve_fb_engine(engine: str, params: HmmParams, mode: str) -> str:
 
     if engine == "auto":
         resolved = "xla"
-        if (
-            jax.default_backend() == "tpu"
-            and mode == "rescaled"
-            and fb_pallas.supports(params)
-        ):
+        if jax.default_backend() == "tpu" and mode == "rescaled":
             from cpgisland_tpu.family import partition as family_partition
 
             # The reduced one-hot path needed its own stats kernel to win
@@ -82,9 +87,17 @@ def resolve_fb_engine(engine: str, params: HmmParams, mode: str) -> str:
             # n_symbols, which the one-hot eligibility alone does not
             # guarantee — family.reduced_stats_eligible gates on both
             # (the one copy of this check, shared with the other routers).
-            if family_partition.reduced_stats_eligible(params):
+            # The reduced chains are K-free, so the envelope here is the
+            # reduced one (fb_onehot.ONEHOT_MAX_STATES — the K<=8 lift of
+            # ROADMAP item 2: the 32-state dinuc member now trains through
+            # the reduced stats path); the dense fused kernels keep their
+            # n_states <= 8 lane packing.
+            if (
+                family_partition.reduced_stats_eligible(params)
+                and _onehot_envelope_ok(params)
+            ):
                 resolved = "onehot"
-            else:
+            elif fb_pallas.supports(params):
                 resolved = "pallas"
         obs.engine_decision(
             site="train.resolve_fb_engine", choice=resolved,
@@ -100,10 +113,12 @@ def resolve_fb_engine(engine: str, params: HmmParams, mode: str) -> str:
     if engine == "onehot":
         from cpgisland_tpu.family import partition as family_partition
 
-        if not fb_pallas.supports(params):
+        if not _onehot_envelope_ok(params):
+            from cpgisland_tpu.ops.fb_onehot import ONEHOT_MAX_STATES
+
             raise ValueError(
-                f"onehot E-step kernels need n_states <= 8, got "
-                f"{params.n_states}"
+                f"onehot E-step kernels need n_states <= "
+                f"{ONEHOT_MAX_STATES}, got {params.n_states}"
             )
         if family_partition.reduced_eligible_concrete(params) is False:
             raise ValueError(
@@ -535,14 +550,21 @@ def _use_fused_seq(engine: str, params: HmmParams, shard_len: int) -> bool:
     if engine == "xla":
         return False
     if engine in ("pallas", "onehot"):
-        if not fb_pallas.supports(params):
+        if engine == "pallas" and not fb_pallas.supports(params):
             raise ValueError(
-                f"engine={engine!r} but the fused kernels do not support "
+                f"engine='pallas' but the fused kernels do not support "
                 f"{params.n_states} states"
             )
         if engine == "onehot":
             from cpgisland_tpu.family import partition as family_partition
 
+            # The reduced route's envelope, not the dense lane packing
+            # (the K<=8 lift: K=32 dinuc trains reduced).
+            if not _onehot_envelope_ok(params):
+                raise ValueError(
+                    f"engine='onehot' but {params.n_states} states exceed "
+                    "the reduced envelope (fb_onehot.ONEHOT_MAX_STATES)"
+                )
             # None = traced params (undecidable): trust the explicit choice.
             if family_partition.reduced_eligible_concrete(params) is False:
                 raise ValueError(
@@ -551,10 +573,18 @@ def _use_fused_seq(engine: str, params: HmmParams, shard_len: int) -> bool:
                     "(family.partition_of)"
                 )
         return True
+    if shard_len < (1 << 20) or jax.default_backend() != "tpu":
+        return False
+    if fb_pallas.supports(params):
+        return True
+    # Dense kernels can't take it, but the reduced route can: auto admits
+    # big one-hot members (the dinuc pair-lift) when _seq_onehot will
+    # route them reduced end to end.
+    from cpgisland_tpu.family import partition as family_partition
+
     return (
-        shard_len >= (1 << 20)
-        and jax.default_backend() == "tpu"
-        and fb_pallas.supports(params)
+        family_partition.reduced_eligible(params)
+        and _onehot_envelope_ok(params)
     )
 
 
@@ -567,7 +597,9 @@ def _seq_onehot(engine: str, params: HmmParams) -> bool:
     if engine == "auto":
         from cpgisland_tpu.family import partition as family_partition
 
-        return family_partition.reduced_eligible(params)
+        return family_partition.reduced_eligible(params) and _onehot_envelope_ok(
+            params
+        )
     return False
 
 
@@ -1027,6 +1059,111 @@ class Seq2DBackend(EStepBackend):
         return fb_sharded.sharded_stats2d_rows_fn(
             self.mesh, eng, meta[3], prep_meta=meta
         ), prep
+
+
+class FamilyEStep:
+    """Stacked multi-model chunked E-step: M members' statistics from ONE
+    stacked launch set over a shared [N, T] batch.
+
+    ROADMAP item 2's training lever: a model-family scan (several
+    same-alphabet reduced members over one corpus — restarts, perturbed
+    inits, alternative priors) previously paid M sequential E-steps per
+    iteration; the stacked kernels (ops.fb_onehot) carry all M members'
+    chains through ONE pass set, so the per-iteration fixed cost is ~one
+    member's.  Per-member statistics are BIT-IDENTICAL to
+    ``LocalBackend(engine='onehot')`` on the same placed batch (pinned in
+    tests/test_multimodel.py).
+
+    Domain: every member reduced-stats-eligible
+    (family.reduced_stats_eligible — one-hot partition, pow2 alphabet)
+    with a shared alphabet, inside the reduced state envelope.
+    ``fuse_fb=False`` keeps the split (r4-shaped) chain structure per
+    member — the A/B arm, same knob as LocalBackend.
+    """
+
+    def __init__(self, t_tile: Optional[int] = None, fuse_fb: bool = True):
+        self.t_tile = (
+            t_tile if t_tile is not None else fb_pallas.DEFAULT_T_TILE
+        )
+        self.fuse_fb = bool(fuse_fb)
+
+    def validate(self, params_list) -> None:
+        from cpgisland_tpu.family import partition as family_partition
+        from cpgisland_tpu.ops import fb_onehot
+
+        fb_onehot.check_stacked_members(params_list)
+        for p in params_list:
+            if not family_partition.reduced_stats_eligible(p):
+                raise ValueError(
+                    "FamilyEStep members must be reduced-stats-eligible "
+                    "(one-hot emission-support partition, power-of-two "
+                    "alphabet — family.reduced_stats_eligible)"
+                )
+
+    def place(self, chunks, lengths):
+        return jnp.asarray(chunks), jnp.asarray(lengths)
+
+    def prepare_streams(self, params_list, chunks, lengths):
+        """ONE shared symbol-only prep for every member (the pair stream
+        depends only on the symbols/alphabet, so members share it —
+        identity-cached like the single-model layouts)."""
+        if isinstance(chunks, jax.core.Tracer):
+            return None
+        from cpgisland_tpu.ops import prepared as prep_mod
+
+        return prep_mod.for_chunked(
+            params_list[0].n_symbols, jnp.asarray(chunks),
+            jnp.asarray(lengths), t_tile=self.t_tile, onehot=True,
+        )
+
+    def __call__(self, params_list, chunks, lengths) -> tuple:
+        params_list = tuple(params_list)
+        self.validate(params_list)
+        chunks, lengths = jnp.asarray(chunks), jnp.asarray(lengths)
+        prep = self.prepare_streams(params_list, chunks, lengths)
+        obs.engine_decision(
+            site="family_estep", choice="onehot.stacked",
+            n_members=len(params_list),
+        )
+        return fb_pallas.batch_stats_pallas_stacked(
+            params_list, chunks, lengths, t_tile=self.t_tile,
+            prepared=prep, fused=self.fuse_fb,
+        )
+
+
+def fit_family(
+    params_list,
+    chunks,
+    lengths,
+    *,
+    n_iter: int = 10,
+    estep: Optional[FamilyEStep] = None,
+):
+    """Train M family members in LOCKSTEP over one chunk batch: each
+    iteration runs ONE stacked E-step (all members' chains in one launch
+    set) and M model-sized M-steps.  Per-member trajectories are
+    bit-identical to M independent ``baum_welch.fit`` host-loop runs with
+    the chunked onehot backend on the same placed batch.  Returns
+    (trained params list, logliks [n_iter, M])."""
+    from cpgisland_tpu.train.baum_welch import mstep
+
+    estep = estep if estep is not None else FamilyEStep()
+    params_list = [p.astype(jnp.float32) for p in params_list]
+    chunks, lengths = estep.place(chunks, lengths)
+    hist_dev = []
+    for _ in range(int(n_iter)):
+        stats = estep(tuple(params_list), chunks, lengths)
+        # Device scalars only — NO per-iteration host sync (each blocking
+        # fetch is a ~50-100 ms relay round trip, more than the fixed cost
+        # the stacked E-step saves); one fetch after the loop.
+        hist_dev.append(jnp.stack([st.loglik for st in stats]))
+        params_list = [
+            mstep(p, st) for p, st in zip(params_list, stats)
+        ]
+    hist = obs.note_fetch(
+        np.asarray(jnp.stack(hist_dev)).astype(np.float64)
+    ) if hist_dev else np.zeros((0, len(params_list)), np.float64)
+    return params_list, hist
 
 
 def get_backend(

@@ -125,8 +125,10 @@ def test_random_hmm_partition_kwarg_validates():
 
 def test_all_routers_agree_on_eligibility_every_preset():
     """Satellite regression: explicit-engine validation at every router
-    accepts/rejects consistently with the ONE family oracle."""
-    from cpgisland_tpu.ops import fb_pallas
+    accepts/rejects consistently with the ONE family oracle.  Since the
+    K<=8 lift (ROADMAP item 2) the FB/train envelope is the REDUCED one
+    (fb_onehot.ONEHOT_MAX_STATES — the 32-state dinuc member is in)."""
+    from cpgisland_tpu.ops.fb_onehot import ONEHOT_MAX_STATES
     from cpgisland_tpu.parallel.decode import resolve_engine
     from cpgisland_tpu.parallel.posterior import resolve_fb_engine as post_res
     from cpgisland_tpu.train.backends import (
@@ -136,6 +138,7 @@ def test_all_routers_agree_on_eligibility_every_preset():
 
     for name, params in _members_matrix():
         eligible = fam.reduced_eligible(params)
+        env_ok = params.n_states <= ONEHOT_MAX_STATES
 
         def raises(fn) -> bool:
             try:
@@ -148,23 +151,25 @@ def test_all_routers_agree_on_eligibility_every_preset():
         assert raises(
             lambda: resolve_engine("onehot", params)
         ) == (not eligible), name
-        # posterior/onehot additionally needs the fused kernels' K<=8
-        # envelope; train/onehot the same.
-        fb_ok = eligible and fb_pallas.supports(params)
+        # posterior/train onehot additionally need the reduced state
+        # envelope (boundary glue / stats accumulators scatter [K] rows).
+        fb_ok = eligible and env_ok
         assert raises(
             lambda: post_res("onehot", params)
         ) == (not fb_ok), name
         assert raises(
             lambda: train_res("onehot", params, "rescaled")
-        ) == (not fb_pallas.supports(params) or not eligible), name
-        # the whole-sequence router's auto gate IS the family oracle.
-        assert _seq_onehot("auto", params) == eligible, name
+        ) == (not fb_ok), name
+        # the whole-sequence router's auto gate IS the family oracle
+        # (inside the envelope).
+        assert _seq_onehot("auto", params) == (eligible and env_ok), name
 
 
 def test_auto_routing_agrees_under_tpu(monkeypatch):
     """Under a (faked) TPU backend, every 'auto' router upgrades to the
-    reduced engines exactly per the family oracle."""
-    from cpgisland_tpu.ops import fb_pallas
+    reduced engines exactly per the family oracle (inside the reduced
+    state envelope — K<=8 lifted to fb_onehot.ONEHOT_MAX_STATES)."""
+    from cpgisland_tpu.ops.fb_onehot import ONEHOT_MAX_STATES
     from cpgisland_tpu.parallel import decode as dec_mod
     from cpgisland_tpu.parallel import posterior as post_mod
     from cpgisland_tpu.train import backends as train_mod
@@ -172,15 +177,14 @@ def test_auto_routing_agrees_under_tpu(monkeypatch):
     monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
     for name, params in _members_matrix():
         eligible = fam.reduced_eligible(params)
+        env_ok = params.n_states <= ONEHOT_MAX_STATES
         d = dec_mod.resolve_engine("auto", params)
         assert (d == "onehot") == eligible, name
         p = post_mod.resolve_fb_engine("auto", params)
-        assert (p == "onehot") == (
-            eligible and fb_pallas.supports(params)
-        ), name
+        assert (p == "onehot") == (eligible and env_ok), name
         t = train_mod.resolve_fb_engine("auto", params, "rescaled")
         assert (t == "onehot") == (
-            fam.reduced_stats_eligible(params) and fb_pallas.supports(params)
+            fam.reduced_stats_eligible(params) and env_ok
         ), name
 
 

@@ -1,0 +1,526 @@
+"""Multi-model kernel occupancy tests (r12): N members' reduced chains in
+ONE stacked launch set.
+
+The acceptance surface of ROADMAP item 2's stacking half:
+
+- stacked-vs-sequential BIT-IDENTITY per member — decode paths (+scores),
+  posterior conf tracks + MPM paths, compare loglik/calls/winner, and EM
+  sufficient statistics — for 2/3/5-member sets including the order-2
+  dinucleotide pair-lift and random one-hot-partitioned families;
+- mixed eligible+dense member sets stack PARTIALLY (dense members stay on
+  the sequential arm, results unchanged);
+- N=1 degenerates exactly to the single-model path;
+- the shared per-order stream placement (encode/pad/place ONCE, zero
+  duplicate uploads and zero prepared-cache re-preps on later members —
+  ledger-asserted);
+- the K<=8 envelope lift: the 32-state dinuc member trains through the
+  reduced stats path, dense-twin parity pinned;
+- serve: compare flushes and mixed-model decode flushes through the
+  stacked dispatch (runs under the session-wide LockTracker when
+  CPGISLAND_TRACKSYNC=1, like the rest of the suite);
+- graftcost: a planted DE-stacked program (per-member sequential scans)
+  must fail the pass pin naming the regrown passes.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from cpgisland_tpu import family
+from cpgisland_tpu.models import presets
+from cpgisland_tpu.ops import fb_pallas
+from cpgisland_tpu.ops import viterbi_onehot as vo
+from cpgisland_tpu.parallel import posterior as par_post
+
+
+def _rand_member(i: int, K: int = 8, S: int = 4):
+    return presets.random_hmm(jax.random.PRNGKey(i), K, S, partition=2)
+
+
+def _cast(n: int):
+    """n same-alphabet reduced members: flagship + random g2 families."""
+    return tuple(
+        [presets.durbin_cpg8()] + [_rand_member(i) for i in range(1, n)]
+    )
+
+
+def _suffstats_equal(a, b):
+    for f in ("init", "trans", "emit", "loglik", "n_seqs"):
+        if not np.array_equal(np.asarray(getattr(a, f)), np.asarray(getattr(b, f))):
+            return False
+    return True
+
+
+# ---------------------------------------------------------------------------
+# kernel-level bit-identity (both lowerings: XLA twins here, kernels on TPU)
+
+
+@pytest.mark.parametrize("n_members", [1, 2, 3, 5])
+def test_stacked_decode_bit_identity(n_members):
+    members = _cast(n_members)
+    rng = np.random.default_rng(7)
+    N, T = 5, 700
+    chunks = jnp.asarray(rng.integers(0, 4, size=(N, T)).astype(np.int32))
+    lengths = jnp.asarray(np.array([T, 650, T, 20, T], np.int32))
+    paths, scores = vo.decode_batch_flat_stacked(
+        members, chunks, lengths, block_size=256, return_score=True
+    )
+    for m, p in enumerate(members):
+        rp, rs = vo.decode_batch_flat(
+            p, chunks, lengths, block_size=256, return_score=True
+        )
+        np.testing.assert_array_equal(np.asarray(paths[m]), np.asarray(rp))
+        np.testing.assert_array_equal(np.asarray(scores[m]), np.asarray(rs))
+
+
+@pytest.mark.parametrize(
+    "n_members", [2, pytest.param(3, marks=pytest.mark.slow)]
+)
+@pytest.mark.parametrize("want_path", [False, True])
+def test_stacked_posterior_bit_identity(n_members, want_path):
+    members = _cast(n_members)
+    rng = np.random.default_rng(5)
+    obs = rng.integers(0, 4, size=9000).astype(np.uint8)
+    isl = [(0, 1, 2, 3)] * n_members
+    confs, paths = par_post.posterior_sharded_stacked(
+        members, obs, isl, want_path=want_path, pad_to=1 << 14
+    )
+    for m, p in enumerate(members):
+        c, pa = par_post.posterior_sharded(
+            p, obs, isl[m], engine="onehot", want_path=want_path,
+            pad_to=1 << 14,
+        )
+        np.testing.assert_array_equal(confs[m], np.asarray(c))
+        if want_path:
+            np.testing.assert_array_equal(paths[m], np.asarray(pa))
+
+
+@pytest.mark.parametrize("fused", [True, False])
+def test_stacked_em_stats_bit_identity(fused):
+    members = _cast(3)
+    rng = np.random.default_rng(3)
+    n, T = 8, 1024
+    chunks = jnp.asarray(rng.integers(0, 4, size=(n, T)).astype(np.uint8))
+    lengths = jnp.asarray(np.array([T] * 6 + [300, 0], np.int32))
+    st = fb_pallas.batch_stats_pallas_stacked(
+        members, chunks, lengths, fused=fused
+    )
+    for m, p in enumerate(members):
+        ref = fb_pallas.batch_stats_pallas(
+            p, chunks, lengths, onehot=True, fused=fused
+        )
+        assert _suffstats_equal(st[m], ref), m
+
+
+@pytest.mark.slow  # K=32 compiles; the class is also pinned by the dinuc parity test
+def test_stacked_em_pair_alphabet_members():
+    """Order-2 (16-symbol) stacked EM: the dinuc pair-lift class — two
+    random 32-state pair-alphabet members through the stacked stats path."""
+    members = (
+        _rand_member(11, 32, 16),
+        _rand_member(12, 32, 16),
+    )
+    rng = np.random.default_rng(13)
+    n, T = 8, 512
+    chunks = jnp.asarray(rng.integers(0, 16, size=(n, T)).astype(np.uint8))
+    lengths = jnp.asarray(np.full(n, T, np.int32))
+    st = fb_pallas.batch_stats_pallas_stacked(members, chunks, lengths)
+    for m, p in enumerate(members):
+        ref = fb_pallas.batch_stats_pallas(p, chunks, lengths, onehot=True)
+        assert _suffstats_equal(st[m], ref), m
+
+
+def test_family_estep_and_lockstep_fit():
+    from cpgisland_tpu.train import backends
+    from cpgisland_tpu.train.baum_welch import mstep
+
+    members = _cast(2)
+    rng = np.random.default_rng(3)
+    chunks = rng.integers(0, 4, size=(8, 512)).astype(np.uint8)
+    lengths = np.full(8, 512, np.int32)
+    out, hist = backends.fit_family(list(members), chunks, lengths, n_iter=3)
+    assert hist.shape == (3, 2)
+    lb = backends.LocalBackend(mode="rescaled", engine="onehot")
+    for m, p in enumerate(members):
+        q = p.astype(jnp.float32)
+        for _ in range(3):
+            q = mstep(q, lb(q, chunks, lengths))
+        np.testing.assert_array_equal(
+            np.asarray(out[m].log_A), np.asarray(q.log_A)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(out[m].log_B), np.asarray(q.log_B)
+        )
+
+
+def test_family_estep_rejects_ineligible_members():
+    from cpgisland_tpu.train.backends import FamilyEStep
+
+    estep = FamilyEStep()
+    with pytest.raises(ValueError, match="reduced-stats-eligible"):
+        estep.validate((presets.durbin_cpg8(), presets.two_state_cpg()))
+    with pytest.raises(ValueError, match="share one alphabet"):
+        estep.validate((presets.durbin_cpg8(), presets.dinuc_cpg()))
+
+
+# ---------------------------------------------------------------------------
+# compare workload
+
+
+def _member_objs(n):
+    ms = [family.Member("durbin8", presets.durbin_cpg8(), tuple(range(4)), 1)]
+    for i in range(1, n):
+        ms.append(family.Member(f"rand{i}", _rand_member(i), (0, 2), 1))
+    return ms
+
+
+@pytest.mark.parametrize(
+    "n_members", [2, 3, pytest.param(5, marks=pytest.mark.slow)]
+)
+def test_compare_stacked_vs_sequential(n_members):
+    members = _member_objs(n_members)
+    rng = np.random.default_rng(11)
+    obs = rng.integers(0, 4, size=9000).astype(np.uint8)
+    rc_s = family.compare_record(members, obs, engine="onehot", stacked=True)
+    rc_q = family.compare_record(members, obs, engine="onehot", stacked=False)
+    for a, b in zip(rc_s.members, rc_q.members):
+        assert a.loglik == b.loglik and a.log_odds == b.log_odds, a.name
+        np.testing.assert_array_equal(a.conf, b.conf)
+        np.testing.assert_array_equal(a.calls.beg, b.calls.beg)
+        np.testing.assert_array_equal(a.calls.end, b.calls.end)
+    np.testing.assert_array_equal(rc_s.winner, rc_q.winner)
+    np.testing.assert_array_equal(
+        rc_s.winner_calls.beg, rc_q.winner_calls.beg
+    )
+
+
+@pytest.mark.slow  # K=32 pair-alphabet compiles dominate; ci_checks runs it
+def test_compare_dinuc_pair_lift_stacked():
+    """Order-2 group: dinuc + a random 32-state pair member + null16 — the
+    K<=8 lift lets the pair alphabet stack (posterior resolver admits
+    K=32 'onehot' since fb_onehot.ONEHOT_MAX_STATES)."""
+    members = [
+        family.builtin_member("dinuc_cpg"),
+        family.Member("rand16", _rand_member(4, 32, 16), (0, 5), 2),
+        family.builtin_member("null16"),
+    ]
+    rng = np.random.default_rng(17)
+    obs = rng.integers(0, 4, size=8000).astype(np.uint8)
+    rc_s = family.compare_record(members, obs, engine="onehot", stacked=True)
+    rc_q = family.compare_record(members, obs, engine="onehot", stacked=False)
+    for a, b in zip(rc_s.members, rc_q.members):
+        assert a.loglik == b.loglik, a.name
+        np.testing.assert_array_equal(a.conf, b.conf)
+
+
+def test_compare_mixed_partial_stacking():
+    """Eligible members stack; dense members ride the sequential arm —
+    per-member engine choice through per-member sessions, results
+    unchanged either way."""
+    from cpgisland_tpu.serve.session import Session
+
+    m_list = _member_objs(2) + [
+        family.builtin_member("two_state"),
+        family.builtin_member("null"),
+    ]
+    sessions = {
+        "durbin8": Session(
+            m_list[0].params, engine="onehot", name="s0", private_breaker=True
+        ),
+        "rand1": Session(
+            m_list[1].params, engine="onehot", name="s1", private_breaker=True
+        ),
+        "two_state": Session(
+            m_list[2].params, engine="auto", name="s2", private_breaker=True
+        ),
+    }
+    # The grouping itself: only the two onehot-resolved members group.
+    from cpgisland_tpu.family import stacked as stacked_mod
+
+    groups = stacked_mod.stack_groups(
+        m_list, ["onehot", "onehot", "xla", None]
+    )
+    assert groups == {1: [0, 1]}
+    rng = np.random.default_rng(19)
+    obs = rng.integers(0, 4, size=9000).astype(np.uint8)
+    rc_s = family.compare_record(m_list, obs, sessions=sessions, stacked=True)
+    rc_q = family.compare_record(m_list, obs, sessions=sessions, stacked=False)
+    for a, b in zip(rc_s.members, rc_q.members):
+        assert a.loglik == b.loglik, a.name
+        np.testing.assert_array_equal(a.conf, b.conf)
+
+
+def test_stack_groups_singleton_not_grouped():
+    from cpgisland_tpu.family import stacked as stacked_mod
+
+    m_list = _member_objs(1)
+    assert stacked_mod.stack_groups(m_list, ["onehot"]) == {}
+    assert stacked_mod.stack_groups(m_list, ["onehot"], enabled=False) == {}
+
+
+def test_compare_shared_placement_zero_duplicate_uploads():
+    """Satellite: each order's stream is encoded/padded AND device-placed
+    ONCE — the second same-order member adds ZERO upload bytes and ZERO
+    prepared-cache misses (the per-member placement half left open in
+    PR 10's hardening notes)."""
+    from cpgisland_tpu import obs as obs_mod
+    from cpgisland_tpu.ops import prepared as prep_mod
+
+    rng = np.random.default_rng(23)
+    obs = rng.integers(0, 4, size=9000).astype(np.uint8)
+    one = _member_objs(1)
+    two = _member_objs(2)
+
+    def upload_bytes(members):
+        # Fresh jit caches don't matter for upload accounting (placement
+        # goes through device_put / note_upload either way), but warm the
+        # programs first so compile-time placements don't differ.
+        family.compare_record(members, obs, engine="onehot")
+        prep_mod.clear_cache()
+        with obs_mod.observe() as ob:
+            family.compare_record(members, obs, engine="onehot")
+            tot = ob.ledger.totals()
+        return tot["upload_bytes"], prep_mod.cache_stats()["misses"]
+
+    up1, _ = upload_bytes(one)
+    up2, _ = upload_bytes(two)
+    # The 2-member compare uploads the SAME stream bytes as the 1-member
+    # set: one padded scoring buffer + one placed posterior span per
+    # ORDER.  The only per-member uploads allowed are MODEL-sized (the
+    # [K] island-mask vectors, 32 B each) — never stream-sized.
+    assert up2 - up1 <= 64 * len(two), (up1, up2)
+    assert up2 < up1 + obs.size  # no second copy of the stream went up
+
+
+def test_dinuc_trains_reduced_stats_dense_twin_parity():
+    """The K<=8 stats-envelope lift: the 32-state dinuc member's reduced
+    (onehot) E-step agrees with the dense XLA twin — the same dense-twin
+    parity pin the flagship's reduced stats carry."""
+    from cpgisland_tpu.ops.forward_backward import batch_stats
+    from cpgisland_tpu.train.backends import resolve_fb_engine
+
+    from cpgisland_tpu.utils import codec
+
+    params = presets.dinuc_cpg()
+    assert resolve_fb_engine("onehot", params, "rescaled") == "onehot"
+    rng = np.random.default_rng(29)
+    # CHAIN-CONSISTENT pair records (a random pair stream is impossible
+    # under the dinuc model's structural zeros and nan-collapses).
+    rows = []
+    for i in range(6):
+        base = rng.integers(0, 4, size=513).astype(np.uint8)
+        rows.append(codec.recode_pairs(base[1:], prev=int(base[0])))
+    chunks = jnp.asarray(np.stack(rows))
+    lengths = jnp.asarray(np.full(6, 512, np.int32))
+    red = fb_pallas.batch_stats_pallas(params, chunks, lengths, onehot=True)
+    dense = batch_stats(params, chunks, lengths, mode="rescaled")
+    np.testing.assert_allclose(
+        np.asarray(red.trans), np.asarray(dense.trans), rtol=2e-4, atol=1e-5
+    )
+    np.testing.assert_allclose(
+        np.asarray(red.emit), np.asarray(dense.emit), rtol=2e-4, atol=1e-5
+    )
+    np.testing.assert_allclose(
+        float(red.loglik), float(dense.loglik), rtol=1e-5
+    )
+
+
+# ---------------------------------------------------------------------------
+# serve: stacked compare + mixed-model decode flushes
+
+
+def _registry_with(names_and_members):
+    from cpgisland_tpu.serve.session import ModelRegistry, Session
+
+    sess = Session(presets.durbin_cpg8(), name="t", private_breaker=True)
+    reg = ModelRegistry(sess)
+    for m in names_and_members:
+        reg.register(m, engine="onehot")
+    return sess, reg
+
+
+def _broker(reg, sess, **cfg):
+    from cpgisland_tpu.serve.broker import BrokerConfig, RequestBroker
+
+    defaults = dict(flush_symbols=1 << 15, flush_deadline_s=0.0)
+    defaults.update(cfg)
+    return RequestBroker(sess, BrokerConfig(**defaults), registry=reg)
+
+
+def test_serve_compare_flush_stacked_parity():
+    """A compare flush through the stacked dispatch returns the same
+    loglik/odds/winner calls as the sequential arm (a stacked=False
+    broker) AND as a direct compare_record — the serve-side bit-identity
+    pin (runs under the graftsync LockTracker when CPGISLAND_TRACKSYNC=1)."""
+    members = _member_objs(2)
+    obs = np.random.default_rng(31).integers(0, 4, size=8000).astype(np.uint8)
+
+    results = {}
+    for stacked in (True, False):
+        sess, reg = _registry_with(members)
+        broker = _broker(reg, sess, stacked=stacked)
+        broker.submit(
+            request_id=1, tenant="t0", kind="compare", symbols=obs,
+            name="r1", models=("durbin8", "rand1"),
+        )
+        (res,) = broker.drain()
+        assert res.ok, res.error
+        results[stacked] = res
+    a, b = results[True], results[False]
+    assert a.compare == b.compare
+    np.testing.assert_array_equal(a.calls.beg, b.calls.beg)
+    np.testing.assert_array_equal(a.calls.end, b.calls.end)
+    direct = family.compare_record(
+        members, obs, record="r1", engine="onehot", stacked=False
+    )
+    assert a.compare["models"]["durbin8"]["loglik"] == direct.member(
+        "durbin8"
+    ).loglik
+
+
+def test_serve_mixed_model_decode_flush_stacked():
+    """Mixed-model decode flush: batch-eligible decode requests of two
+    onehot models coalesce into ONE stacked flat stream (route
+    'flat-stacked'); island calls equal the sequential per-model flush on
+    the same requests (tie-free seeds — the flat decoder's pinned
+    rounding-tie contract, PARITY.md C10)."""
+    members = _member_objs(2)
+    rng = np.random.default_rng(37)
+    recs = {
+        "durbin8": [rng.integers(0, 4, size=n).astype(np.uint8)
+                    for n in (900, 1500)],
+        "rand1": [rng.integers(0, 4, size=n).astype(np.uint8)
+                  for n in (1100, 700)],
+    }
+
+    def run(stacked):
+        sess, reg = _registry_with(members)
+        broker = _broker(reg, sess, stacked=stacked)
+        rid = 0
+        for model, rows in recs.items():
+            for r in rows:
+                rid += 1
+                broker.submit(
+                    request_id=rid, tenant="t0", kind="decode", symbols=r,
+                    name=f"{model}:{rid}", model=model,
+                )
+        out = {r.id: r for r in broker.drain()}
+        assert all(r.ok for r in out.values())
+        return out
+
+    st = run(True)
+    sq = run(False)
+    assert {r.route for r in st.values()} == {"flat-stacked"}
+    assert "flat-stacked" not in {r.route for r in sq.values()}
+    for rid in st:
+        np.testing.assert_array_equal(st[rid].calls.beg, sq[rid].calls.beg)
+        np.testing.assert_array_equal(st[rid].calls.end, sq[rid].calls.end)
+        np.testing.assert_array_equal(
+            st[rid].calls.gc_content, sq[rid].calls.gc_content
+        )
+
+
+def test_serve_stacked_decode_needs_two_models():
+    """A flush where only ONE model contributes batch-eligible decode
+    requests never stacks (nothing to share a launch with) — requests
+    take the normal per-model routes.  (Cross-alphabet stacking is
+    unreachable by construction: order-2 members are compare-only at
+    admission, so decode flushes only ever see the 4-symbol base
+    alphabet — the `_flush_decode_stacked` alphabet guard is defensive.)"""
+    members = _member_objs(2)
+    sess, reg = _registry_with(members)
+    broker = _broker(reg, sess, stacked=True)
+    rng = np.random.default_rng(41)
+    for rid, n in ((1, 900), (2, 1300)):
+        broker.submit(
+            request_id=rid, tenant="t0", kind="decode",
+            symbols=rng.integers(0, 4, size=n).astype(np.uint8),
+            name=f"a{rid}", model="durbin8",
+        )
+    out = {r.id: r for r in broker.drain()}
+    assert all(r.ok for r in out.values())
+    assert "flat-stacked" not in {r.route for r in out.values()}
+
+
+# ---------------------------------------------------------------------------
+# graftcost: the de-stacking regression is a red build
+
+
+def test_destacked_fixture_fails_pass_pin(tmp_path):
+    """A planted DE-stacked multi-model posterior (per-member sequential
+    scans instead of the one stacked scan) must fail the cost lockfile
+    naming the regrown T-scaling passes — the r12 anti-regression, same
+    shape as r9's cost_regrown_pass fixture."""
+    from cpgisland_tpu.analysis import contracts, cost_contracts, costmodel
+
+    members = _cast(3)
+    mask = jnp.asarray((np.arange(8) < 4).astype(np.float32))
+    masks = (mask,) * 3
+
+    def make_stacked(scale: int = 1):
+        import numpy as _np
+
+        o = jnp.asarray(
+            _np.random.default_rng(0).integers(
+                0, 4, size=4096 * scale
+            ).astype(_np.uint8)
+        )
+        fn = lambda o: fb_pallas._seq_posterior_core_stacked(
+            members, o, o.shape[0], masks, 512, 256, axis=None
+        )[0]
+        return fn, (o,), None
+
+    def make_destacked(scale: int = 1):
+        import numpy as _np
+
+        o = jnp.asarray(
+            _np.random.default_rng(0).integers(
+                0, 4, size=4096 * scale
+            ).astype(_np.uint8)
+        )
+
+        def fn(o):
+            outs = []
+            for p in members:
+                outs.append(
+                    fb_pallas._seq_posterior_core(
+                        p, o, o.shape[0], mask, 512, 256, axis=None,
+                        onehot=True,
+                    )[0]
+                )
+            return jnp.stack(outs)
+
+        return fn, (o,), None
+
+    # Scales must clear the 128-lane padding plateau (the registry's own
+    # posterior scales) or no scan's cost grows between geometries.
+    stacked_entry = costmodel.trace_entry(
+        contracts.Contract(
+            name="fixture.stacked", make=make_stacked, base_symbols=4096,
+            cost_scales=(16, 32),
+        )
+    )
+    destacked_entry = costmodel.trace_entry(
+        contracts.Contract(
+            name="fixture.stacked", make=make_destacked, base_symbols=4096,
+            cost_scales=(16, 32),
+        )
+    )
+    # The structural quantity EXPECTED_PASSES pins: stacking keeps the
+    # T-scaling pass count CONSTANT in N (2: products + fused fwd/bwd);
+    # de-stacking regrows one pass set per member.
+    assert stacked_entry.passes() == 2
+    assert destacked_entry.passes() == 3 * 2
+    fp = {"fixture.stacked": cost_contracts.fingerprint(stacked_entry)}
+    lock_path = str(tmp_path / "COSTS.json")
+    cost_contracts.write_lockfile(fp, lock_path, platform="cpu")
+    live = {"fixture.stacked": cost_contracts.fingerprint(destacked_entry)}
+    diff = cost_contracts.diff_costs(
+        live, cost_contracts.load_lockfile(lock_path), "cpu"
+    )
+    assert not diff.ok
+    assert any(
+        "pass count 2 -> 6" in v and "drifting prims" in v
+        for v in diff.violations
+    ), diff.violations

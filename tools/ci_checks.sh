@@ -82,6 +82,18 @@ echo "== serve smoke (broker vs batch pipelines, transport, restart) =="
 # already pins serve.flush.dispatch-stable.)
 python -m pytest tests/test_serve.py -q
 
+echo "== multi-model stacking smoke (stacked-vs-sequential bit-identity + A/B harness) =="
+# r12: N members' reduced chains in ONE stacked launch set.  The tests pin
+# per-member BIT-identity against the sequential arm (decode paths+scores,
+# conf tracks, compare loglik/winner, EM stats; 2/3/5-member sets incl.
+# the dinuc pair-lift), the serve stacked flush routes, the shared
+# per-order placement ledger, and the planted DE-stacked fixture failing
+# the graftcost pass pin.  The harness then runs its bit-identity gates +
+# one CPU timing rep per arm (--smoke; chip ratios come from running it
+# WITHOUT --smoke on the capturing TPU).
+python -m pytest tests/test_multimodel.py -q
+python tools/bench_multimodel.py --platform cpu --smoke > /dev/null
+
 echo "== model-family & compare smoke (partition oracle, member parity, compare workload) =="
 # The family layer's acceptance surface: family.partition_of as the single
 # eligibility oracle (all four routers agree on every preset), dense-vs-
