@@ -1168,6 +1168,26 @@ def bench_parity(n_mib: int = 4) -> dict:
         "notes": creport["diff"]["notes"],
     }
 
+    # --- graftmem layer 5 on the capturing backend: the closed-form VMEM
+    # contracts (shipped-knob budget incl. stacked M=3, derived seq-shard
+    # cap, stacked envelope) are pure arithmetic and run everywhere; the
+    # liveness traces are skipped on TPU (they pin CPU XLA-twin structure
+    # — the committed MEMORY.json carries the cpu section) and run in
+    # full off-TPU.
+    from cpgisland_tpu.analysis import mem_contracts as graft_mem
+
+    mreport = graft_mem.run_mem_pass(trace=not on_tpu)
+    if not mreport["ok"]:
+        raise AssertionError(
+            "parity-gate mem: " + graft_mem.format_failure(mreport)
+        )
+    out["mem"] = {
+        "entries_diffed": mreport["diff"]["checked"],
+        "kernels_diffed": mreport["diff"]["kernels_checked"],
+        "mem_contracts": len(mreport["contracts"]),
+        "notes": mreport["diff"]["notes"],
+    }
+
     log(
         "parity-gate: OK — dense and reduced lowerings agree on this "
         f"backend ({jax.default_backend()}): " + json.dumps(out)
@@ -1960,6 +1980,9 @@ def _orchestrate(args) -> int:
         ]["checked"],
         "costs_checked_on_capture_backend": results["parity"]["parity"][
             "costs"
+        ],
+        "mem_checked_on_capture_backend": results["parity"]["parity"][
+            "mem"
         ],
         # Sustained serving-broker throughput + queue->result latency on the
         # capturing backend (the serve phase's in-process daemon run).

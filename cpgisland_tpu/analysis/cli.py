@@ -12,6 +12,9 @@ attribution table (the BASELINE.md size-curve decomposition).
 ``--sync`` adds Layer 4's cross-module pass — the lock-order graph over
 the whole file set (static deadlock detection; still pure AST, no jax) —
 on top of the per-file sync rules that already run in the lint layer.
+``--mem`` adds Layer 5 — the memory pass (MEMORY.json lockfile diff +
+VMEM/HBM contracts), re-baselined with ``--update-mem``; ``--mem-table
+KERNEL`` prints one modeled kernel's VMEM buffer breakdown.
 """
 
 from __future__ import annotations
@@ -70,6 +73,19 @@ def main(argv=None) -> int:
     ap.add_argument("--cost-table", default=None, metavar="ENTRY",
                     help="print the fixed-vs-per-symbol attribution table "
                     "for one cost entry (e.g. em.seq.onehot) and exit")
+    ap.add_argument("--mem", action="store_true",
+                    help="run the Layer-5 memory pass: diff live HBM "
+                    "liveness fingerprints + shipped-knob VMEM footprints "
+                    "against MEMORY.json and check the memory contracts "
+                    "(imports jax)")
+    ap.add_argument("--update-mem", action="store_true",
+                    help="re-baseline MEMORY.json from the live traces "
+                    "and print a diff summary (implies --mem)")
+    ap.add_argument("--mem-file", default=None,
+                    help="mem lockfile path (default: <repo>/MEMORY.json)")
+    ap.add_argument("--mem-table", default=None, metavar="KERNEL",
+                    help="print the VMEM buffer breakdown for one modeled "
+                    "kernel (e.g. fb.fwdbwd.onehot) and exit")
     ap.add_argument("--platform", default="cpu",
                     help="contracts backend: cpu (default — the pass is "
                     "designed to certify without a TPU) | tpu | auto "
@@ -92,6 +108,15 @@ def main(argv=None) -> int:
             print("    origin: BASELINE.md size curve — ~8-11 ms fixed "
                   "in-graph cost/iter bounds em-seq2d; cost regressions "
                   "must fail statically, not on relay-TPU")
+        # Layer 5 (memory contracts) — same static metadata path.
+        from cpgisland_tpu.analysis import mem_contracts
+
+        for name, desc in mem_contracts.quantitative_rules():
+            print(f"{name}: {desc}")
+            print("    origin: every memory cliff here was found "
+                  "empirically on chip — 131072-lane assembly compile "
+                  "failure, bk>=8192 scoped-VMEM, the 128 Mi shard, the "
+                  "~15 GB island OOM; graftmem makes them static")
         return 0
 
     rc = 0
@@ -110,6 +135,19 @@ def main(argv=None) -> int:
             return 2
         traced = costmodel.trace_entry(entries[args.cost_table])
         print(costmodel.attribution_table(traced))
+        return 0
+
+    if args.mem_table:
+        from cpgisland_tpu.analysis import mem_contracts, memmodel
+
+        known = set(memmodel.kernels()) | set(mem_contracts.shipped_knobs())
+        if args.mem_table not in known:
+            print(
+                f"error: unknown kernel {args.mem_table!r} "
+                f"(have: {sorted(known)})", file=sys.stderr,
+            )
+            return 2
+        print(mem_contracts.mem_table(args.mem_table))
         return 0
 
     if not args.no_lint:
@@ -229,6 +267,40 @@ def main(argv=None) -> int:
             print(
                 f"graftcost: {report['diff']['checked']} entry point(s) "
                 f"diffed, {len(report['contracts'])} cost contract(s), "
+                f"{'ok' if report['ok'] else 'VIOLATIONS'}",
+                file=sys.stderr,
+            )
+        if not report["ok"]:
+            rc = 1
+
+    if args.mem or args.update_mem:
+        _pin_platform(args.platform)
+        from cpgisland_tpu.analysis import mem_contracts
+
+        report = mem_contracts.run_mem_pass(
+            lockfile_path=args.mem_file, update=args.update_mem
+        )
+        if args.as_json:
+            payload["mem"] = report
+        else:
+            if report["updated"]:
+                summary = report.get("summary") or ["(no changes)"]
+                print(f"mem: re-baselined {report['path']}", file=sys.stderr)
+                for line in summary:
+                    print(f"    {line}", file=sys.stderr)
+            for v in report["diff"]["violations"]:
+                print(f"mem drift: {v}")
+            for n in report["diff"]["notes"]:
+                print(f"note: {n}", file=sys.stderr)
+            for r in report["contracts"]:
+                status = "ok" if r["ok"] else "VIOLATION"
+                print(f"mem contract {r['name']}: {status}", file=sys.stderr)
+                for v in r["violations"]:
+                    print(f"    {v}")
+            print(
+                f"graftmem: {report['diff']['checked']} entry point(s) + "
+                f"{report['diff']['kernels_checked']} kernel row(s) "
+                f"diffed, {len(report['contracts'])} mem contract(s), "
                 f"{'ok' if report['ok'] else 'VIOLATIONS'}",
                 file=sys.stderr,
             )

@@ -1,0 +1,739 @@
+"""graftcheck Layer 5 — memory contracts + the MEMORY.json lockfile.
+
+Built on :mod:`~cpgisland_tpu.analysis.memmodel`.  Two halves, the
+COSTS.json workflow verbatim (``analysis/cost_contracts.py``):
+
+**The lockfile** (``MEMORY.json``, committed): per contract-registry
+entry, the HBM liveness fingerprint (peak live bytes at >=2 geometries,
+per-symbol/fixed fits, materialized-allocation totals, the named O(T)
+allocation groups, fused-EM while-body peak) plus the modeled VMEM
+footprint of every registered kernel at its SHIPPED knobs — captured per
+platform with per-metric tolerances.  ``python -m cpgisland_tpu.analysis
+--mem`` re-traces/re-models and diffs; a drift fails CI NAMING the
+drifting buffers (the allocation-group diff / the kernel buffer
+breakdown), so "a whole-record temp re-entered the island reduction" or
+"a stacked kernel quietly grew a per-member slab" is a red build on CPU
+in seconds instead of a device OOM minutes into a relay-TPU run.
+``--update-mem`` re-baselines after a verified change; stale entries are
+reported like stale waivers.
+
+**The quantitative contracts** — memory assertions the cost layer cannot
+express:
+
+- ``mem.vmem-budget`` — every registered kernel at its shipped knobs
+  (including the stacked M=3 launches) fits the 16 MiB v5e VMEM model
+  with the stated reserve headroom; violations name the offending
+  buffers.
+- ``mem.no-linear-temps`` — the blocked island reduction materializes NO
+  allocation group scaling O(T) (the r4 whole-record formulation OOMed
+  ~15 GB of s32[T] temps), and the fused-EM while-body peak stays within
+  its per-symbol stream budget.
+- ``mem.seq-shard-budget`` — the 112 Mi whole-sequence shard budget and
+  the 128 Mi remote-compile failure BOTH fall out of the HBM model for a
+  16 GB chip, and train.backends.SEQ_SHARD_BUDGET equals the derived
+  cap.
+- ``mem.stacked-envelope`` — the max feasible member count M per stacked
+  kernel family at current knobs matches the pinned envelope (PR 12's
+  kernels scale VMEM with M; the envelope is the static guard).
+
+The liveness fingerprints trace on the current backend (CPU XLA twins in
+CI — identical arithmetic to the chip kernels); the closed-form VMEM
+contracts are platform-independent arithmetic and run everywhere,
+including bench.py's on-TPU parity phase.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Optional
+
+from cpgisland_tpu.analysis import memmodel
+from cpgisland_tpu.analysis.costmodel import fit_linear
+from cpgisland_tpu.analysis.contracts import Contract, ContractResult
+
+LOCKFILE_VERSION = 1
+LOCKFILE_NAME = "MEMORY.json"
+
+# Allocation-group slope (bytes/symbol) above which a group counts as an
+# O(T) temporary.  2.0 sits above the island path's one legitimate
+# linear allocation (the 1 B/sym int8 pad-concatenate of its own input)
+# and below the OOM class it exists to catch (a whole-record s32 temp is
+# >= 4 B/sym; the r4 formulation paid ~40 — memmodel.ISLAND_BLOCK_BPS).
+LINEAR_TEMP_BPS = 2.0
+
+# Fused-EM while-body peak-live ceiling, bytes per symbol.  Measured on
+# the CPU twin trace: ~246 B/sym (the one-pass chunked reduced E-step
+# holds pair streams + both 2-component chains + scattered stat
+# workspaces live at once).  The pin carries ~1.5x headroom — a dense
+# xi re-pairing (K^2 rows, +hundreds of B/sym) or a de-blocked temp
+# trips it; model-sized drift is the lockfile's job.
+EM_BODY_BPS_MAX = 384.0
+
+# The pinned stacked envelope: max feasible members per stacked kernel
+# family at the shipped knobs (decode families at the M=3 block cap the
+# flat-decode guard enforces; fb families at the 512x256 lane tiles).
+# M=3 — the shipped stacked3 contracts' geometry — must be feasible for
+# every family; fb.fwdbwd sits EXACTLY at its envelope, which is the
+# re-sweep obligation BASELINE.md records against PR 12.
+STACKED_ENVELOPE = {
+    "decode.products.onehot": 64,          # search ceiling — not binding
+    "decode.backpointers.onehot": 22,
+    "decode.backpointers.onehot.scores": 2,   # at bk=4096
+    "decode.backtrace.onehot": 2,             # at bk=4096
+    "fb.fwdbwd.onehot": 3,
+    "fb.stats.onehot": 6,
+}
+_STACKED_SEARCH_CEILING = 64
+
+_QUANT_RULES = (
+    ("mem.lockfile", "live HBM-liveness fingerprints and shipped-knob "
+     "VMEM footprints match MEMORY.json within tolerances; drifts name "
+     "the drifting buffers/groups"),
+    ("mem.vmem-budget", "every registered kernel at its shipped knobs "
+     "(incl. stacked M=3) fits the 16 MiB v5e VMEM model with the "
+     "stated reserve"),
+    ("mem.no-linear-temps", "the blocked island reduction materializes "
+     "no O(T) allocation group; the fused-EM while-body peak stays "
+     f"under {EM_BODY_BPS_MAX:.0f} B/symbol"),
+    ("mem.seq-shard-budget", "the 112 Mi whole-seq shard budget and the "
+     "128 Mi failure both fall out of the HBM model; SEQ_SHARD_BUDGET "
+     "== the derived cap"),
+    ("mem.stacked-envelope", "max feasible stacked member count per "
+     "kernel family matches the pinned envelope (M=3 feasible "
+     "everywhere)"),
+)
+
+
+def quantitative_rules() -> list:
+    return list(_QUANT_RULES)
+
+
+DEFAULT_TOLERANCES = {
+    # Relative, on peak/alloc fits and raw per-geometry totals.  Tight for
+    # the same reason as COSTS.json: a trace is deterministic, drift means
+    # the GRAPH changed — re-baseline with --update-mem after verifying.
+    "peak_bytes": 0.02,
+    "alloc_bytes": 0.02,
+    "while_body_peak": 0.02,
+    # The kernel VMEM section is closed-form arithmetic: exact.
+    "kernel_vmem": 0,
+    # O(T) allocation groups: the NAME set must match exactly, and each
+    # surviving group's recorded slope (3-decimal-rounded B/sym in the
+    # fingerprint) is compared at this relative tolerance (0 = exact).
+    "linear_groups": 0,
+}
+
+
+def default_lockfile_path() -> str:
+    pkg = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    return os.path.join(os.path.dirname(pkg), LOCKFILE_NAME)
+
+
+# -- the registry ------------------------------------------------------------
+
+
+def _islands_entry() -> Contract:
+    """The blocked on-device island-calling reduction — the entry whose
+    whole-record ancestor OOMed ~15 GB of s32[T] temps (CLAUDE.md r4).
+    Block width is pinned SMALL relative to the traced geometries so an
+    O(T) temp cannot hide inside 'one block'."""
+
+    def make(scale: int = 1):
+        import numpy as np
+
+        import jax.numpy as jnp
+
+        from cpgisland_tpu.ops import islands_device
+
+        T = 32768 * scale
+        rng = np.random.default_rng(0)
+        path = jnp.asarray(
+            rng.integers(0, 8, size=T).astype(np.int8)
+        )
+
+        def fn(p):
+            return islands_device._device_calls(
+                p, cap=256, min_len=200, gc_threshold=0.5,
+                oe_threshold=0.6, block_w=4096,
+            )
+
+        return fn, (path,), None
+
+    return Contract(
+        name="islands.device.blocked", make=make, base_symbols=32768,
+        cost_scales=(1, 2),
+    )
+
+
+def mem_entries() -> list:
+    """The liveness registry: every Layer-2/3 contract entry (same cast,
+    same geometries — the graftcost methodology) + the fused-EM loop +
+    the blocked island reduction."""
+    from cpgisland_tpu.analysis.cost_contracts import cost_entries
+
+    return cost_entries() + [_islands_entry()]
+
+
+# Shipped knob tuples per registered kernel — what mem.vmem-budget checks
+# and what the MEMORY.json `kernels` section pins.  Decode kernels run the
+# flat default bk=4096 x 128 lanes; the fb lane kernels run DEFAULT_T_TILE
+# =512 x the 256-lane fast tile (fb_pallas._fb_lane_tile); the stacked
+# @M3 rows run the M=3 block cap the flat-decode guard enforces.
+def shipped_knobs() -> dict:
+    fb = memmodel.Knobs(lane_tile=256)
+    bk3 = memmodel.stacked_block_cap(3, scores=True)
+    out = {}
+    for name in memmodel.kernels():
+        if name.startswith(("fb.", "assembly.")):
+            out[name] = fb
+        else:
+            out[name] = memmodel.Knobs()
+    out["assembly.seqstats.onehot"] = fb.replace(lane_T=65536)
+    for name in memmodel.STACKED_KERNELS:
+        base = fb if name.startswith("fb.") else memmodel.Knobs(
+            block_size=bk3
+        )
+        out[name + "@M3"] = base.replace(stacked_m=3)
+    return out
+
+
+def _kernel_for(name: str) -> str:
+    return name.split("@", 1)[0]
+
+
+def kernel_fingerprints() -> dict:
+    """{name: footprint dict} for every shipped-knob kernel row."""
+    return {
+        name: memmodel.footprint(_kernel_for(name), knobs).as_dict()
+        for name, knobs in shipped_knobs().items()
+    }
+
+
+# -- liveness fingerprints ---------------------------------------------------
+
+
+@dataclasses.dataclass
+class MemEntry:
+    """One registry entry traced at each geometry."""
+
+    name: str
+    geometries: list
+    metrics: list              # memmodel.LiveMetrics per geometry
+
+    def fits(self) -> dict:
+        pts = list(zip(self.geometries, self.metrics))
+        return {
+            "peak_bytes": fit_linear([(n, m.peak_bytes) for n, m in pts]),
+            "alloc_bytes": fit_linear(
+                [(n, m.alloc_bytes) for n, m in pts]
+            ),
+            "while_body_peak": fit_linear(
+                [(n, m.while_body_peak) for n, m in pts]
+            ),
+        }
+
+    def linear_groups(self) -> list:
+        if len(self.metrics) < 2:
+            return []
+        return memmodel.linear_alloc_groups(
+            self.metrics[0], self.metrics[-1],
+            self.geometries[0], self.geometries[-1],
+            min_bps=LINEAR_TEMP_BPS,
+        )
+
+
+def trace_mem_entry(contract) -> MemEntry:
+    import jax
+
+    # Source-group attribution must not depend on what THIS PROCESS traced
+    # earlier: a jit-cache hit reuses a jaxpr whose source frames point at
+    # the ORIGINAL trace site, so a shared helper first traced under a
+    # different entry would smear that entry's groups into this one.  A
+    # fresh trace cache per entry makes the fingerprint a function of the
+    # entry alone (the same reason tests/conftest.py clears caches per
+    # module).
+    jax.clear_caches()
+    scales = getattr(contract, "cost_scales", (1, 2))
+    if not getattr(contract, "scalable", True):
+        scales = (1,)
+    geometries, metrics = [], []
+    for s in scales:
+        fn, args, *_rest = contract.make(s)
+        closed = jax.make_jaxpr(fn)(*args)
+        geometries.append(max(contract.base_symbols, 1) * s)
+        metrics.append(memmodel.live_metrics(closed))
+    return MemEntry(name=contract.name, geometries=geometries,
+                    metrics=metrics)
+
+
+def trace_mem_all() -> dict:
+    return {c.name: trace_mem_entry(c) for c in mem_entries()}
+
+
+def fingerprint(entry: MemEntry) -> dict:
+    return {
+        "geometries": list(entry.geometries),
+        "metrics": [
+            {k: v for k, v in m.as_dict().items() if k != "groups"}
+            for m in entry.metrics
+        ],
+        "fits": {k: f.as_dict() for k, f in entry.fits().items()},
+        "linear_groups": [
+            [g, round(bps, 3)] for g, bps in entry.linear_groups()
+        ],
+    }
+
+
+def live_fingerprints(traced: Optional[dict] = None) -> dict:
+    if traced is None:
+        traced = trace_mem_all()
+    return {name: fingerprint(e) for name, e in traced.items()}
+
+
+# -- the lockfile ------------------------------------------------------------
+
+
+def load_lockfile(path: Optional[str] = None) -> Optional[dict]:
+    path = path or default_lockfile_path()
+    if not os.path.exists(path):
+        return None
+    with open(path, "r", encoding="utf-8") as fh:
+        return json.load(fh)
+
+
+def write_lockfile(
+    fingerprints: dict, path: Optional[str] = None,
+    platform: Optional[str] = None, kernels: Optional[dict] = None,
+) -> str:
+    import jax
+
+    path = path or default_lockfile_path()
+    platform = platform or jax.default_backend()
+    data = load_lockfile(path) or {
+        "version": LOCKFILE_VERSION,
+        "tolerances": dict(DEFAULT_TOLERANCES),
+        "platforms": {},
+    }
+    data["platforms"][platform] = {
+        "jax": jax.__version__,
+        "entries": fingerprints,
+        "kernels": kernels if kernels is not None else kernel_fingerprints(),
+    }
+    tmp = path + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as fh:
+        json.dump(data, fh, indent=1, sort_keys=True)
+        fh.write("\n")
+    os.replace(tmp, path)
+    return path
+
+
+@dataclasses.dataclass
+class MemDiff:
+    violations: list
+    notes: list
+    stale: list
+    checked: int = 0           # liveness registry entries diffed
+    kernels_checked: int = 0   # shipped-knob kernel VMEM rows diffed
+
+    @property
+    def ok(self) -> bool:
+        return not self.violations
+
+    def as_dict(self) -> dict:
+        return dataclasses.asdict(self) | {"ok": self.ok}
+
+
+def _rel_drift(live: float, locked: float) -> float:
+    denom = max(abs(locked), 1.0)
+    return abs(live - locked) / denom
+
+
+def _buffer_drift(live_k: dict, locked_k: dict) -> str:
+    """The 'named drifting buffers' of one kernel row."""
+    lb, kb = live_k.get("buffers", {}), locked_k.get("buffers", {})
+    deltas = []
+    for b in sorted(set(lb) | set(kb)):
+        a, c = kb.get(b, 0), lb.get(b, 0)
+        if a != c:
+            deltas.append(f"{b} {a}->{c}B")
+    return ", ".join(deltas[:6]) if deltas else "(buffers unchanged)"
+
+
+def diff_mem(live: dict, lock: Optional[dict], platform: str,
+             kernels: Optional[dict] = None) -> MemDiff:
+    """Diff live fingerprints (+ shipped-knob kernel footprints) against
+    the lockfile's platform section."""
+    diff = MemDiff(violations=[], notes=[], stale=[])
+    if lock is None:
+        diff.violations.append(
+            f"no {LOCKFILE_NAME} lockfile — run --update-mem to baseline"
+        )
+        return diff
+    section = lock.get("platforms", {}).get(platform)
+    if section is None:
+        diff.notes.append(
+            f"lockfile has no '{platform}' section (captured platforms: "
+            f"{sorted(lock.get('platforms', {}))}) — mem diff skipped; "
+            "run --update-mem on this platform to baseline it"
+        )
+        return diff
+    tol = {**DEFAULT_TOLERANCES, **lock.get("tolerances", {})}
+    locked_entries = section.get("entries", {})
+    diff.stale = sorted(set(locked_entries) - set(live))
+    for name in diff.stale:
+        diff.notes.append(
+            f"stale lockfile entry '{name}': no longer in the mem "
+            "registry (remove via --update-mem)"
+        )
+    for name in sorted(live):
+        if name not in locked_entries:
+            diff.violations.append(
+                f"{name}: not in the lockfile — new entries must be "
+                "baselined via --update-mem"
+            )
+            continue
+        diff.checked += 1
+        lv, lk = live[name], locked_entries[name]
+        if lv["geometries"] != lk["geometries"]:
+            diff.violations.append(
+                f"{name}: traced geometries {lv['geometries']} != "
+                f"lockfile {lk['geometries']} (--update-mem)"
+            )
+            continue
+        lg_l = dict((g, b) for g, b in lv["linear_groups"])
+        lg_k = dict((g, b) for g, b in lk["linear_groups"])
+        if set(lg_l) != set(lg_k):
+            grew = sorted(set(lg_l) - set(lg_k))
+            gone = sorted(set(lg_k) - set(lg_l))
+            diff.violations.append(
+                f"{name}: O(T) allocation groups drifted — new: "
+                f"{grew or '[]'}, vanished: {gone or '[]'} (a temporary "
+                "whose live size scales with T entered or left this "
+                "entry)"
+            )
+        for g in sorted(set(lg_l) & set(lg_k)):
+            if _rel_drift(lg_l[g], lg_k[g]) > tol["linear_groups"]:
+                diff.violations.append(
+                    f"{name}: O(T) group {g} slope {lg_k[g]:.3f} -> "
+                    f"{lg_l[g]:.3f} B/symbol (> tol "
+                    f"{tol['linear_groups']:.0%}) — the temporary's "
+                    "per-symbol footprint changed"
+                )
+        for metric in ("peak_bytes", "alloc_bytes", "while_body_peak"):
+            for term in ("per_symbol", "fixed"):
+                a = lk["fits"][metric][term]
+                b = lv["fits"][metric][term]
+                d = _rel_drift(b, a)
+                if d > tol[metric]:
+                    diff.violations.append(
+                        f"{name}: {metric}.{term} {a:.6g} -> {b:.6g} "
+                        f"({d:+.1%} > tol {tol[metric]:.0%})"
+                    )
+    _diff_kernel_section(diff, kernels, section, tol)
+    return diff
+
+
+def _diff_kernel_section(diff: MemDiff, kernels: Optional[dict],
+                         section: dict, tol: dict) -> None:
+    """Diff the shipped-knob kernel VMEM rows (closed-form arithmetic —
+    runs on any platform, including the trace-free on-TPU parity mode)."""
+    live_k = kernels if kernels is not None else kernel_fingerprints()
+    locked_k = section.get("kernels", {})
+    for name in sorted(set(live_k) - set(locked_k)):
+        diff.violations.append(
+            f"kernel {name}: not in the lockfile — baseline via "
+            "--update-mem"
+        )
+    for name in sorted(set(locked_k) - set(live_k)):
+        diff.notes.append(
+            f"stale lockfile kernel '{name}' (remove via --update-mem)"
+        )
+        diff.stale.append(f"kernel:{name}")
+    for name in sorted(set(live_k) & set(locked_k)):
+        diff.kernels_checked += 1
+        if abs(live_k[name]["total"] - locked_k[name]["total"]) > \
+                tol["kernel_vmem"]:
+            diff.violations.append(
+                f"kernel {name}: modeled VMEM {locked_k[name]['total']} "
+                f"-> {live_k[name]['total']} B; drifting buffers: "
+                f"{_buffer_drift(live_k[name], locked_k[name])}"
+            )
+
+
+def diff_kernels_only(lock: Optional[dict], platform: str,
+                      kernels: Optional[dict] = None) -> MemDiff:
+    """The trace-free diff: only the kernel VMEM section, against any
+    platform section that carries one (kernel rows are closed-form and
+    platform-independent, so a cpu-captured section is authoritative on
+    TPU too — bench's parity phase uses this)."""
+    diff = MemDiff(violations=[], notes=["liveness traces skipped "
+                                         "(kernel-section diff only)"],
+                   stale=[])
+    if lock is None:
+        diff.violations.append(
+            f"no {LOCKFILE_NAME} lockfile — run --update-mem to baseline"
+        )
+        return diff
+    platforms = lock.get("platforms", {})
+    section = platforms.get(platform)
+    if section is None and platforms:
+        # Fall back to any captured section: the kernel rows don't trace.
+        fallback = sorted(platforms)[0]
+        section = platforms[fallback]
+        diff.notes.append(
+            f"no '{platform}' section; kernel rows diffed against "
+            f"'{fallback}' (closed-form — platform-independent)"
+        )
+    if section is None:
+        diff.notes.append(
+            "lockfile has no captured platform sections — kernel diff "
+            "skipped; run --update-mem to baseline"
+        )
+        return diff
+    tol = {**DEFAULT_TOLERANCES, **lock.get("tolerances", {})}
+    _diff_kernel_section(diff, kernels, section, tol)
+    return diff
+
+
+def update_summary(live: dict, lock: Optional[dict], platform: str) -> list:
+    out = []
+    old = ((lock or {}).get("platforms", {}).get(platform, {})
+           .get("entries", {}))
+    for name in sorted(set(live) | set(old)):
+        if name not in old:
+            out.append(f"+ {name} (new entry)")
+        elif name not in live:
+            out.append(f"- {name} (stale entry removed)")
+        elif old[name] != live[name]:
+            a = old[name]["fits"]["peak_bytes"]
+            b = live[name]["fits"]["peak_bytes"]
+            out.append(
+                f"~ {name}: peak B/sym {a['per_symbol']:.4g} -> "
+                f"{b['per_symbol']:.4g}, fixed {a['fixed']:.4g} -> "
+                f"{b['fixed']:.4g}"
+            )
+    return out
+
+
+# -- the quantitative contracts ----------------------------------------------
+
+
+def _vmem_budget_contract(kernels: Optional[dict] = None) -> ContractResult:
+    violations, notes = [], {}
+    rows = kernels if kernels is not None else kernel_fingerprints()
+    knobs = shipped_knobs()
+    worst = None
+    for name in sorted(rows):
+        f = memmodel.feasible(_kernel_for(name), knobs[name])
+        if not f.ok:
+            violations.append(f.reason)
+        head = 1.0 - f.total / f.limit
+        if worst is None or head < worst[1]:
+            worst = (name, head)
+    notes["kernels_checked"] = len(rows)
+    if worst is not None:
+        notes["tightest"] = {
+            "kernel": worst[0], "headroom": round(worst[1], 4),
+        }
+    notes["vmem_limit"] = memmodel.vmem_limit()
+    return ContractResult(
+        name="mem.vmem-budget", ok=not violations, violations=violations,
+        notes=notes,
+    )
+
+
+def _linear_temps_contract(traced: dict) -> ContractResult:
+    violations, notes = [], {}
+    isl = traced.get("islands.device.blocked")
+    if isl is None:
+        violations.append(
+            "islands.device.blocked missing from the mem registry"
+        )
+    else:
+        bad = isl.linear_groups()
+        notes["island_linear_groups"] = [
+            [g, round(b, 2)] for g, b in bad
+        ]
+        for g, bps in bad[:4]:
+            violations.append(
+                f"islands.device.blocked: allocation group {g} grows "
+                f"{bps:.1f} B/symbol — an O(T) temporary in the BLOCKED "
+                "island reduction (the whole-record formulation's ~15 GB "
+                "s32[T] OOM class; temps must be O(block_w))"
+            )
+    em = traced.get("em.fused")
+    if em is None:
+        violations.append("em.fused missing from the mem registry")
+    elif len(em.geometries) >= 2:
+        slope = em.fits()["while_body_peak"].per_symbol
+        notes["em_body_peak_bps"] = round(slope, 3)
+        if slope > EM_BODY_BPS_MAX:
+            top = memmodel.linear_alloc_groups(
+                em.metrics[0], em.metrics[-1],
+                em.geometries[0], em.geometries[-1], min_bps=4.0,
+            )[:4]
+            violations.append(
+                f"em.fused: while-body peak live grows {slope:.1f} "
+                f"B/symbol > {EM_BODY_BPS_MAX:.0f} — the fused EM "
+                "iteration's working set outgrew its stream budget; "
+                "fattest O(T) groups: "
+                + ", ".join(f"{g}({b:.0f}B/sym)" for g, b in top)
+            )
+    return ContractResult(
+        name="mem.no-linear-temps", ok=not violations,
+        violations=violations, notes=notes,
+    )
+
+
+def _seq_shard_contract() -> ContractResult:
+    from cpgisland_tpu.train import backends
+
+    violations, notes = [], {}
+    derived = memmodel.max_seq_shard()
+    notes["derived_cap_symbols"] = derived
+    notes["bytes_per_symbol"] = memmodel.seq_shard_bytes_per_symbol()
+    notes["streams"] = dict(memmodel.SEQ_STREAM_BYTES)
+    if backends.SEQ_SHARD_BUDGET != derived:
+        violations.append(
+            f"SEQ_SHARD_BUDGET {backends.SEQ_SHARD_BUDGET} != the model's "
+            f"derived cap {derived} — the budget and the model diverged "
+            "(retune memmodel.SEQ_STREAM_BYTES or re-measure the budget)"
+        )
+    if memmodel.seq_shard_bytes(112 << 20) > memmodel.hbm_limit():
+        violations.append(
+            "the model rejects the measured-good 112 Mi shard"
+        )
+    if memmodel.seq_shard_bytes(128 << 20) <= memmodel.hbm_limit():
+        violations.append(
+            "the model admits the measured-failing 128 Mi shard"
+        )
+    return ContractResult(
+        name="mem.seq-shard-budget", ok=not violations,
+        violations=violations, notes=notes,
+    )
+
+
+def _stacked_envelope_contract() -> ContractResult:
+    violations, notes = [], {}
+    knobs = shipped_knobs()
+    for kernel, pinned in STACKED_ENVELOPE.items():
+        # The envelope pins M at the CURRENT shipped knobs (decode at the
+        # flat default bk=4096; fb at the 512x256 lane tiles) — the @M3
+        # rows' reduced block is the guard's consequence, not the pin.
+        base = knobs[kernel]
+        got = min(
+            memmodel.max_stacked_m(kernel, base), _STACKED_SEARCH_CEILING
+        )
+        notes[kernel] = got
+        if got != pinned:
+            violations.append(
+                f"{kernel}: max feasible stacked M is {got}, pinned "
+                f"envelope is {pinned} — a per-member VMEM slab grew or "
+                "shrank (update STACKED_ENVELOPE only after verifying, "
+                "and re-sweep the stacked knobs at the next capture)"
+            )
+        if not memmodel.feasible(kernel, knobs[kernel + "@M3"]).ok:
+            violations.append(
+                f"{kernel}: the shipped stacked M=3 geometry (the "
+                "stacked-block-cap guard's knobs) no longer fits the "
+                "VMEM model"
+            )
+    return ContractResult(
+        name="mem.stacked-envelope", ok=not violations,
+        violations=violations, notes=notes,
+    )
+
+
+def run_mem_contracts(traced: Optional[dict] = None) -> list:
+    if traced is None:
+        traced = trace_mem_all()
+    return [
+        _vmem_budget_contract(),
+        _linear_temps_contract(traced),
+        _seq_shard_contract(),
+        _stacked_envelope_contract(),
+    ]
+
+
+# -- the full pass (CLI / CI / bench / driver entry) -------------------------
+
+
+def run_mem_pass(
+    lockfile_path: Optional[str] = None, update: bool = False,
+    trace: bool = True,
+) -> dict:
+    """Model, trace, diff against the lockfile, run the contracts.
+
+    Returns {"ok", "diff", "contracts", "updated", "summary"} — the same
+    shape as cost_contracts.run_cost_pass, consumed by the CLI,
+    ci_checks.sh, __graft_entry__ and bench.py.  ``trace=False`` skips
+    the liveness traces (closed-form contracts + kernel-section diff
+    only — the cheap on-TPU parity mode; the liveness fingerprints pin
+    the CPU XLA-twin structure)."""
+    import jax
+
+    if update and not trace:
+        raise ValueError(
+            "run_mem_pass(update=True, trace=False) would baseline an "
+            "EMPTY entries section, erasing this platform's liveness "
+            "fingerprints — re-baselining requires the traces"
+        )
+    platform = jax.default_backend()
+    kernels = kernel_fingerprints()
+    traced = trace_mem_all() if trace else {}
+    live = live_fingerprints(traced) if trace else {}
+    lock = load_lockfile(lockfile_path)
+    out: dict = {"platform": platform, "updated": False}
+    if update:
+        out["summary"] = update_summary(live, lock, platform)
+        path = write_lockfile(live, lockfile_path, platform, kernels)
+        out["updated"] = True
+        out["path"] = path
+        lock = load_lockfile(lockfile_path)
+    if trace:
+        diff = diff_mem(live, lock, platform, kernels)
+        contracts = run_mem_contracts(traced)
+    else:
+        diff = diff_kernels_only(lock, platform, kernels)
+        contracts = [
+            _vmem_budget_contract(kernels),
+            _seq_shard_contract(),
+            _stacked_envelope_contract(),
+        ]
+    out["diff"] = diff.as_dict()
+    out["contracts"] = [r.as_dict() for r in contracts]
+    out["ok"] = diff.ok and all(r.ok for r in contracts)
+    return out
+
+
+def format_failure(report: dict) -> str:
+    """One-line JSON summary of a failing run_mem_pass report (shared by
+    the bench parity gate and __graft_entry__'s self-check)."""
+    return json.dumps({
+        "diff": report["diff"]["violations"],
+        "contracts": {
+            r["name"]: r["violations"]
+            for r in report["contracts"] if not r["ok"]
+        },
+    })
+
+
+def mem_table(kernel: str, knobs: Optional[memmodel.Knobs] = None) -> str:
+    """Markdown buffer-breakdown table for one kernel (--mem-table)."""
+    fp = memmodel.footprint(_kernel_for(kernel),
+                            knobs or shipped_knobs().get(
+                                kernel, memmodel.Knobs()))
+    lines = [
+        f"| buffer ({kernel}) | shape | kind | bytes (buffered) |",
+        "|---|---|---|---|",
+    ]
+    for b in sorted(fp.buffers, key=lambda b: b.cost, reverse=True):
+        shape = "x".join(str(d) for d in b.shape)
+        lines.append(f"| `{b.name}` | {shape} | {b.kind} | {b.cost} |")
+    lines.append(
+        f"| **total** | | | {fp.total} / limit {memmodel.vmem_limit()} "
+        f"(headroom {fp.headroom():.1%}) |"
+    )
+    return "\n".join(lines)

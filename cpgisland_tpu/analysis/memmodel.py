@@ -1,0 +1,904 @@
+"""graftcheck Layer 5 — the static memory model (graftmem).
+
+Layer 3 (graftcost) measures what a traced graph *costs*; this layer
+models what it *allocates*.  Two halves, both deliberately approximate
+but deterministic and stable — fingerprints, not a profiler:
+
+**The per-kernel VMEM footprint model.**  Every Pallas kernel family in
+ops/ is registered here as a buffer-list builder parameterized over the
+knob tuple (:class:`Knobs`: lane_T, t_tile, lane_tile, block_size, S, K,
+stacked M) — block-spec tiles x dtype bytes, VMEM scratch, fori-carried
+chain state, and the lane-broadcast tables, with HBM-streamed blocks
+paying a x2 double-buffering factor (the Mosaic pipeline keeps the next
+grid block in flight).  The same builders serve two callers: the CI
+contracts check the SHIPPED knobs fit the 16 MiB v5e VMEM model with
+headroom, and the knob autotuner (ROADMAP#1) calls :func:`feasible` to
+prune a sweep before paying a relay-TPU compile.  This repo's history is
+the motivation: every one of these cliffs was found empirically on chip
+— the exact-EM assembly failing to compile at 131072 lanes, ``bk >=
+8192`` failing scoped-VMEM compile on the batched decode route, the
+whole-record island formulation OOMing ~15 GB of ``s32[T]`` temps, and
+PR 12's stacked kernels scaling VMEM with member count M with no static
+guard.
+
+**The jaxpr HBM liveness pass.**  :func:`peak_live_bytes` runs an
+interval analysis over a traced graph's equations (a var is live from
+its defining eqn to its last use; loop bodies contribute their own inner
+peak on top of the carried state) giving peak live bytes, and
+:func:`alloc_groups` attributes materialized allocations to their
+``file:function`` source groups so a diff can NAME the buffer that grew.
+Run at two abstract geometries (the graftcost methodology) the per-group
+allocation slopes expose the island-OOM bug class statically: a
+temporary whose live size scales O(T) where the blocked formulation
+keeps it O(T/blocks).
+
+Calibration honesty: the hardware constants (16 MiB VMEM, a 10%
+compiler-slack reserve, 16 GiB HBM with a 2 GiB runtime reserve) and the
+per-symbol stream table of the whole-sequence E-step are MODELS fitted
+to the measured cliffs recorded in CLAUDE.md/BASELINE.md, not ab-initio
+derivations; tests/test_graftmem.py pins each predicted limit to bracket
+its measured counterpart, and a documented discrepancy there is a pinned
+note, not a silent pass.
+
+No jax at module level: the closed-form half is pure arithmetic (ops
+routing consults it at import time); the liveness half imports jax
+lazily inside functions.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+# -- the hardware model ------------------------------------------------------
+
+# v5e per-core VMEM.  The model budgets against vmem_limit(): a reserve
+# slice is held back for compiler spills, semaphores and metadata — a
+# kernel modeled within a few percent of the full 16 MiB fails on chip in
+# practice (the bk>=8192 scoped-VMEM class).
+VMEM_BYTES = 16 << 20
+VMEM_RESERVE = 0.10
+
+# v5e chip HBM and the runtime/program/weights reserve the whole-sequence
+# shard model budgets against (CLAUDE.md: 120 Mi compiled, 128 Mi failed).
+HBM_BYTES = 16 << 30
+HBM_RESERVE = 2 << 30
+
+LANE_TILE = 128
+ROW_TILE = 8
+GROUP = 2          # reduced chain components (the r4 one-hot reduction)
+# HBM<->VMEM pipelining factor on RESULT streams: a written block pays a
+# write+drain revision buffer while the next block fills; input streams
+# prefetch in place.  CALIBRATED: the one assignment consistent with both
+# the shipped configs (dense stats at t_tile=512 x 256 lanes compiles; 512
+# lanes "blows VMEM" — fb_pallas._fb_lane_tile) and the measured cliffs
+# (bk>=8192 scoped-VMEM failure, the 131072-lane assembly compile failure).
+DOUBLE = 2
+
+
+def vmem_limit() -> int:
+    """The modeled per-kernel VMEM budget (16 MiB minus the reserve)."""
+    return int(VMEM_BYTES * (1.0 - VMEM_RESERVE))
+
+
+def hbm_limit() -> int:
+    """The modeled per-chip HBM budget for one program's live streams."""
+    return HBM_BYTES - HBM_RESERVE
+
+
+# -- knobs -------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class Knobs:
+    """The tunable geometry tuple every footprint builder is a function of.
+
+    Defaults are the shipped production settings (fb_pallas/viterbi_onehot
+    module constants); the autotuner enumerates replacements via
+    :meth:`replace`."""
+
+    lane_T: int = 8192         # serial chain length per lane (pick_lane_T)
+    t_tile: int = 512          # time-tile of the lane kernels' grid
+    lane_tile: int = LANE_TILE  # lanes per kernel instance (128 or 256)
+    block_size: int = 4096     # decode step-block bk (decode_batch_flat)
+    n_states: int = 8          # K (flagship 8; dinuc member 32)
+    n_symbols: int = 4         # S
+    stacked_m: int = 1         # stacked members per launch (PR 12)
+    itemsize: int = 4          # f32/i32 stream element
+
+    def replace(self, **kw) -> "Knobs":
+        return dataclasses.replace(self, **kw)
+
+
+@dataclasses.dataclass(frozen=True)
+class Buffer:
+    """One modeled VMEM allocation of a kernel instance.
+
+    kind: ``stream`` (HBM-read grid block, prefetched in place), ``out``
+    (streamed result block — pays the x``DOUBLE`` write-pipelining
+    factor), ``resident`` (lane-broadcast table / operand held for the
+    whole launch) or ``scratch`` (pltpu.VMEM scratch + fori-carried chain
+    state)."""
+
+    name: str
+    shape: tuple
+    itemsize: int = 4
+    kind: str = "stream"
+
+    @property
+    def nbytes(self) -> int:
+        n = self.itemsize
+        for d in self.shape:
+            n *= int(d)
+        return n
+
+    @property
+    def cost(self) -> int:
+        if self.kind == "out":
+            return self.nbytes * DOUBLE
+        return self.nbytes
+
+    def describe(self) -> str:
+        shape = "x".join(str(d) for d in self.shape)
+        return f"{self.name}[{shape}]({self.kind})={self.cost}B"
+
+
+@dataclasses.dataclass
+class Footprint:
+    """A kernel's modeled VMEM working set at one knob tuple."""
+
+    kernel: str
+    knobs: Knobs
+    buffers: list
+
+    @property
+    def total(self) -> int:
+        return sum(b.cost for b in self.buffers)
+
+    def headroom(self, limit: Optional[int] = None) -> float:
+        limit = vmem_limit() if limit is None else limit
+        return 1.0 - self.total / limit
+
+    def top(self, n: int = 4) -> list:
+        return sorted(self.buffers, key=lambda b: b.cost, reverse=True)[:n]
+
+    def as_dict(self) -> dict:
+        return {
+            "total": self.total,
+            "limit": vmem_limit(),
+            "headroom": round(self.headroom(), 4),
+            "buffers": {b.name: b.cost for b in self.buffers},
+        }
+
+
+@dataclasses.dataclass
+class Feasibility:
+    """:func:`feasible`'s verdict — the autotuner's pruning unit."""
+
+    ok: bool
+    kernel: str
+    total: int
+    limit: int
+    offenders: list        # Buffer list, largest first, when not ok
+    reason: str = ""
+
+    def as_dict(self) -> dict:
+        return {
+            "ok": self.ok, "kernel": self.kernel, "total": self.total,
+            "limit": self.limit, "reason": self.reason,
+            "offenders": [b.describe() for b in self.offenders],
+        }
+
+
+# -- the kernel registry -----------------------------------------------------
+#
+# One builder per Pallas kernel family; each returns the instance's buffer
+# list from the knob tuple, mirroring the pallas_call block specs in ops/
+# (viterbi_pallas/viterbi_onehot: [bk, lane_tile] step-stream blocks over a
+# lane-tile grid; fb_pallas/fb_onehot: [t_tile, K|GROUP, lane_tile] stream
+# blocks over a (lane tile, t tile) grid).  nreal pair-table rows follow
+# viterbi_onehot.prepare_pairs: S*S real pairs + S PAD carriers + identity.
+
+
+def _pair_rows(S: int) -> int:
+    return S * S + S + 1
+
+
+def _k_decode_products_dense(k: Knobs) -> list:
+    K, S = k.n_states, k.n_symbols
+    return [
+        Buffer("steps", (k.block_size, k.lane_tile)),
+        Buffer("A", (K, K), kind="resident"),
+        Buffer("emit", (K, S), kind="resident"),
+        Buffer("P_out", (K * K, k.lane_tile), kind="out"),
+        Buffer("C_carry", (K * K, k.lane_tile), kind="scratch"),
+    ]
+
+
+def _k_decode_backpointers_dense(k: Knobs) -> list:
+    K, S = k.n_states, k.n_symbols
+    return [
+        Buffer("steps", (k.block_size, k.lane_tile)),
+        Buffer("venter", (K, k.lane_tile), kind="resident"),
+        Buffer("A", (K, K), kind="resident"),
+        Buffer("emit", (K, S), kind="resident"),
+        Buffer("bp_out", (k.block_size, k.lane_tile), kind="out"),
+        Buffer("dexit_out", (K, k.lane_tile), kind="out"),
+        Buffer("ftab_out", (1, k.lane_tile), kind="out"),
+        Buffer("delta_carry", (K, k.lane_tile), kind="scratch"),
+    ]
+
+
+def _k_decode_backtrace_dense(k: Knobs) -> list:
+    return [
+        Buffer("bp", (k.block_size, k.lane_tile)),
+        Buffer("exit", (1, k.lane_tile), kind="resident"),
+        Buffer("path_out", (k.block_size, k.lane_tile), kind="out"),
+    ]
+
+
+def _k_decode_products_onehot(k: Knobs) -> list:
+    M = k.stacked_m
+    return [
+        Buffer("pair", (k.block_size, k.lane_tile)),
+        Buffer("tab", (4 * M * _pair_rows(k.n_symbols), k.lane_tile),
+               kind="resident"),
+        Buffer("C_out", (4 * M, k.lane_tile), kind="out"),
+        Buffer("C_scr", (4 * M, k.lane_tile), kind="scratch"),
+    ]
+
+
+def _k_decode_backpointers_onehot(k: Knobs, scores: bool) -> list:
+    M = k.stacked_m
+    bufs = [
+        Buffer("pair", (k.block_size, k.lane_tile)),
+        Buffer("venter", (GROUP * M, k.lane_tile), kind="resident"),
+        Buffer("tab", (4 * M * _pair_rows(k.n_symbols), k.lane_tile),
+               kind="resident"),
+        Buffer("bp_out", (M * k.block_size // ROW_TILE, k.lane_tile),
+               kind="out"),
+        Buffer("dexit_out", (GROUP * M, k.lane_tile), kind="out"),
+        Buffer("ebits_out", (M, k.lane_tile), kind="out"),
+        Buffer("chain_carry", (GROUP * M, k.lane_tile), kind="scratch"),
+    ]
+    if scores:
+        bufs.append(
+            Buffer("dmax_out", (M * k.block_size, k.lane_tile), kind="out")
+        )
+    return bufs
+
+
+def _k_decode_backtrace_onehot(k: Knobs) -> list:
+    M = k.stacked_m
+    return [
+        Buffer("bp", (M * k.block_size // ROW_TILE, k.lane_tile)),
+        Buffer("pair", (k.block_size, k.lane_tile)),
+        Buffer("idtab", (M * GROUP * _pair_rows(k.n_symbols), k.lane_tile),
+               kind="resident"),
+        Buffer("exit", (M, k.lane_tile), kind="resident"),
+        Buffer("path_out", (M * k.block_size, k.lane_tile), kind="out"),
+    ]
+
+
+def _k_fb_fwd_dense(k: Knobs) -> list:
+    K, S = k.n_states, k.n_symbols
+    return [
+        Buffer("steps", (k.t_tile, k.lane_tile)),
+        Buffer("lens", (1, k.lane_tile), kind="resident"),
+        Buffer("a0", (K, k.lane_tile), kind="resident"),
+        Buffer("A", (K, K), kind="resident"),
+        Buffer("emit", (K, S), kind="resident"),
+        Buffer("alphas_out", (k.t_tile, K, k.lane_tile), kind="out"),
+        Buffer("v_carry", (K, k.lane_tile), kind="scratch"),
+    ]
+
+
+def _k_fb_bwd_dense(k: Knobs) -> list:
+    K, S = k.n_states, k.n_symbols
+    return [
+        Buffer("steps_next", (k.t_tile, k.lane_tile)),
+        Buffer("cs_next", (k.t_tile, k.lane_tile)),
+        Buffer("lens", (1, k.lane_tile), kind="resident"),
+        Buffer("A", (K, K), kind="resident"),
+        Buffer("emit", (K, S), kind="resident"),
+        Buffer("beta0", (K, k.lane_tile), kind="resident"),
+        Buffer("betas_out", (k.t_tile, K, k.lane_tile), kind="out"),
+        Buffer("beta_carry", (K, k.lane_tile), kind="scratch"),
+    ]
+
+
+def _k_fb_conf_dense(k: Knobs) -> list:
+    K = k.n_states
+    return _k_fb_bwd_dense(k)[:-2] + [
+        Buffer("alphas", (k.t_tile, K, k.lane_tile)),
+        Buffer("conf_mask", (K, 1), kind="resident"),
+        Buffer("conf_out", (k.t_tile, k.lane_tile), kind="out"),
+        Buffer("beta_carry", (K, k.lane_tile), kind="scratch"),
+    ]
+
+
+def _k_fb_stats_dense(k: Knobs) -> list:
+    K, S = k.n_states, k.n_symbols
+    return [
+        Buffer("alphas", (k.t_tile, K, k.lane_tile)),
+        Buffer("betas", (k.t_tile, K, k.lane_tile)),
+        Buffer("steps", (k.t_tile, k.lane_tile)),
+        Buffer("lens", (1, k.lane_tile), kind="resident"),
+        Buffer("A", (K, K), kind="resident"),
+        Buffer("emit", (K, S), kind="resident"),
+        Buffer("macc_out", (K * K, k.lane_tile), kind="out"),
+        Buffer("emit_out", (K * S, k.lane_tile), kind="out"),
+        Buffer("ll_out", (1, k.lane_tile), kind="out"),
+        Buffer("macc_scr", (K * K, k.lane_tile), kind="scratch"),
+        Buffer("emit_scr", (K * S, k.lane_tile), kind="scratch"),
+        Buffer("aprev_scr", (K, k.lane_tile), kind="scratch"),
+    ]
+
+
+def _oh_chain_bufs(k: Knobs, fused: bool) -> list:
+    """Shared stream/table set of the reduced chain kernels (fwd/bwd and
+    the r9 co-scheduled fwd+bwd): per-member [t_tile, GROUP, lane_tile]
+    stream outputs riding a shared symbol-only pair stream."""
+    M = k.stacked_m
+    bufs = [
+        Buffer("pair", (k.t_tile, k.lane_tile)),
+        Buffer("lens", (1, k.lane_tile), kind="resident"),
+        Buffer("tab", (4 * M * _pair_rows(k.n_symbols), k.lane_tile),
+               kind="resident"),
+        Buffer("a0", (GROUP * M, k.lane_tile), kind="resident"),
+        Buffer("alphas_out", (k.t_tile, GROUP * M, k.lane_tile), kind="out"),
+        Buffer("chain_carry", (2 * GROUP * M, k.lane_tile), kind="scratch"),
+    ]
+    if fused:
+        bufs += [
+            Buffer("pair_next", (k.t_tile, k.lane_tile)),
+            Buffer("beta0", (GROUP * M, k.lane_tile), kind="resident"),
+            Buffer("betas_out", (k.t_tile, GROUP * M, k.lane_tile),
+                   kind="out"),
+        ]
+    return bufs
+
+
+def _k_fb_fwd_onehot(k: Knobs) -> list:
+    return _oh_chain_bufs(k, fused=False)
+
+
+def _k_fb_fwdbwd_onehot(k: Knobs) -> list:
+    return _oh_chain_bufs(k, fused=True)
+
+
+def _k_fb_conf_onehot(k: Knobs) -> list:
+    return _oh_chain_bufs(k, fused=False) + [
+        Buffer("cs_next", (k.t_tile, k.lane_tile)),
+        Buffer("conf_out", (k.t_tile, k.lane_tile), kind="out"),
+    ]
+
+
+def _oh_stats_bufs(k: Knobs, seq: bool) -> list:
+    """The reduced-stream stats kernels (_oh_stats_kernel and the
+    z-normalized _oh_seq_stats_kernel): the K^2 count rows appear THREE
+    ways per member — the fori-carried accumulator, the VMEM scratch it
+    flushes into, and the streamed output block — which is what makes K
+    the envelope knob (fb_onehot.ONEHOT_MAX_STATES) and M the stacked
+    one."""
+    K, S = k.n_states, k.n_symbols
+    M = k.stacked_m
+    bufs = [
+        Buffer("alphas2", (k.t_tile, GROUP * M, k.lane_tile)),
+        Buffer("betas2", (k.t_tile, GROUP * M, k.lane_tile)),
+        Buffer("pair", (k.t_tile, k.lane_tile)),
+        Buffer("lens", (1, k.lane_tile), kind="resident"),
+        Buffer("brtab", (S * GROUP * M, k.lane_tile), kind="resident"),
+        Buffer("gttab", (S * GROUP * M, k.lane_tile), kind="resident"),
+        Buffer("macc_out", (M * K * K, k.lane_tile), kind="out"),
+        Buffer("emit_out", (M * S * GROUP, k.lane_tile), kind="out"),
+        Buffer("ll_out", (M, k.lane_tile), kind="out"),
+        Buffer("macc_scr", (M * K * K, k.lane_tile), kind="scratch"),
+        Buffer("macc_carry", (M * K * K, k.lane_tile), kind="scratch"),
+        Buffer("emit_scr", (M * S * GROUP, k.lane_tile), kind="scratch"),
+        Buffer("aprev_scr", (M * K, k.lane_tile), kind="scratch"),
+    ]
+    if seq:
+        bufs += [
+            Buffer("tab", (4 * M * _pair_rows(S), k.lane_tile),
+                   kind="resident"),
+            Buffer("enters_full", (M * K, k.lane_tile), kind="resident"),
+            Buffer("enters_red", (GROUP * M, k.lane_tile), kind="resident"),
+            Buffer("pair0_mask", (1, k.lane_tile), kind="resident"),
+        ]
+    return bufs
+
+
+def _k_fb_stats_onehot(k: Knobs) -> list:
+    return _oh_stats_bufs(k, seq=False)
+
+
+def _k_fb_seqstats_onehot(k: Knobs) -> list:
+    return _oh_stats_bufs(k, seq=True)
+
+
+def _k_decode_vmap_onehot(k: Knobs) -> list:
+    """The vmap-of-pallas batched decode route (viterbi_parallel_batch's
+    ``vmap_records=True`` opt-in): batching materializes every stream as a
+    batch-wide VMEM slab (CLAUDE.md r5), so the operand pays write-class
+    revision buffering too — the factor that puts the predicted block cap
+    in the measured bracket (bk=4096 ran 16 records, bk>=8192 failed
+    scoped-VMEM compile outright), independent of the record count."""
+    bufs = _k_decode_backpointers_onehot(k, scores=True)
+    return [
+        dataclasses.replace(b, kind="out") if b.name == "pair" else b
+        for b in bufs
+    ]
+
+
+def _k_assembly_seqstats_onehot(k: Knobs) -> list:
+    """Scoped-VMEM model of the EXACT-seq XLA stats assembly — the
+    non-pow2-S route on TPU and the pre-r4 path whose remote compile
+    FAILED at 131072 lanes (CLAUDE.md).  The fused contraction
+    MATERIALIZES, per lane column, the full serial chain of its einsum
+    partial rows (full-K a-prev and w rows) plus the beta directions and
+    the z normalizer — written streams, so they pay the write-pipelining
+    factor.  CALIBRATED: the operand set is chosen from
+    fb_onehot._xla_znorm_stats's live streams so the predicted cap lands
+    in the measured [65536 compiles, 131072 fails) bracket — pinned by
+    tests/test_graftmem.py, revisit at the next capture."""
+    K = k.n_states
+    return [
+        Buffer("aprev_full", (k.lane_T, K), kind="out"),
+        Buffer("wz_full", (k.lane_T, K), kind="out"),
+        Buffer("betas_dir", (k.lane_T, GROUP), kind="out"),
+        Buffer("z_norm", (k.lane_T, 1), kind="out"),
+    ]
+
+
+_BUILDERS: dict = {
+    "decode.products.dense": _k_decode_products_dense,
+    "decode.backpointers.dense": _k_decode_backpointers_dense,
+    "decode.backtrace.dense": _k_decode_backtrace_dense,
+    "decode.products.onehot": _k_decode_products_onehot,
+    "decode.backpointers.onehot":
+        lambda k: _k_decode_backpointers_onehot(k, scores=False),
+    "decode.backpointers.onehot.scores":
+        lambda k: _k_decode_backpointers_onehot(k, scores=True),
+    "decode.backtrace.onehot": _k_decode_backtrace_onehot,
+    "decode.vmap.onehot": _k_decode_vmap_onehot,
+    "fb.fwd.dense": _k_fb_fwd_dense,
+    "fb.bwd.dense": _k_fb_bwd_dense,
+    "fb.conf.dense": _k_fb_conf_dense,
+    "fb.stats.dense": _k_fb_stats_dense,
+    "fb.fwd.onehot": _k_fb_fwd_onehot,
+    "fb.fwdbwd.onehot": _k_fb_fwdbwd_onehot,
+    "fb.conf.onehot": _k_fb_conf_onehot,
+    "fb.stats.onehot": _k_fb_stats_onehot,
+    "fb.seqstats.onehot": _k_fb_seqstats_onehot,
+    "assembly.seqstats.onehot": _k_assembly_seqstats_onehot,
+}
+
+# Kernel families whose launches stack M members (PR 12); the envelope
+# contract enumerates M over these.
+STACKED_KERNELS = (
+    "decode.products.onehot",
+    "decode.backpointers.onehot",
+    "decode.backpointers.onehot.scores",
+    "decode.backtrace.onehot",
+    "fb.fwdbwd.onehot",
+    "fb.stats.onehot",
+)
+
+
+def kernels() -> list:
+    return sorted(_BUILDERS)
+
+
+def footprint(kernel: str, knobs: Optional[Knobs] = None) -> Footprint:
+    if kernel not in _BUILDERS:
+        raise KeyError(
+            f"unknown kernel {kernel!r} (have: {kernels()})"
+        )
+    k = knobs or Knobs()
+    return Footprint(kernel=kernel, knobs=k, buffers=_BUILDERS[kernel](k))
+
+
+def feasible(
+    kernel: str, knobs: Optional[Knobs] = None, **overrides
+) -> Feasibility:
+    """Does ``kernel`` at ``knobs`` fit the modeled VMEM budget?
+
+    THE autotuner pruning API (ROADMAP#1): a knob tuple rejected here
+    need not pay a relay-TPU compile to be ruled out.  Overrides are
+    Knobs fields (``feasible("fb.fwdbwd.onehot", t_tile=512)``)."""
+    k = (knobs or Knobs()).replace(**overrides) if overrides else (
+        knobs or Knobs()
+    )
+    fp = footprint(kernel, k)
+    limit = vmem_limit()
+    ok = fp.total <= limit
+    return Feasibility(
+        ok=ok, kernel=kernel, total=fp.total, limit=limit,
+        offenders=[] if ok else fp.top(3),
+        reason="" if ok else (
+            f"{kernel}: modeled VMEM {fp.total} B > limit {limit} B "
+            f"({VMEM_BYTES >> 20} MiB - {VMEM_RESERVE:.0%} reserve); "
+            "largest: " + ", ".join(b.describe() for b in fp.top(3))
+        ),
+    )
+
+
+# -- derived caps (the shipped routing consults these) -----------------------
+
+
+def lane_feasible(
+    lane_T: int, onehot: bool = False, long_lanes: bool = False,
+    knobs: Optional[Knobs] = None,
+) -> bool:
+    """Is a ``lane_T``-long lane memory-feasible for this path?
+
+    Dense lanes and the kernelized (``long_lanes``) reduced path check
+    their t-tiled chain kernels — lane_T never enters a block spec there,
+    so the model admits every rate-table entry (the dense 32768 knee is a
+    PERF knee, not memory).  The plain reduced path must also run the
+    exact-seq XLA stats assembly (the non-kernelized consumer), whose
+    scoped-VMEM model is what bans 131072 — the same cap
+    fb_pallas.pick_lane_T shipped as a hard-coded filter before graftmem.
+    """
+    k = (knobs or Knobs()).replace(lane_T=lane_T)
+    if not onehot:
+        return feasible("fb.stats.dense", k).ok
+    if long_lanes:
+        return feasible("fb.seqstats.onehot", k).ok
+    return feasible("assembly.seqstats.onehot", k).ok
+
+
+def max_flat_block(
+    scores: bool = True, stacked_m: int = 1, knobs: Optional[Knobs] = None,
+) -> int:
+    """Largest power-of-two decode block ``bk`` that fits the flat-decode
+    kernel set (the score variant's dmax rows are the fat ones).  The
+    measured anchor: bk >= 8192 failed scoped-VMEM compile on the batched
+    decode route (CLAUDE.md r5)."""
+    base = knobs or Knobs()
+    kernel = (
+        "decode.backpointers.onehot.scores" if scores
+        else "decode.backtrace.onehot"
+    )
+    bk = 8
+    while True:
+        k = base.replace(block_size=bk * 2, stacked_m=stacked_m)
+        if not feasible(kernel, k).ok or bk >= (1 << 24):
+            return bk
+        bk *= 2
+
+
+def flat_block_feasibility(
+    bk: int, scores: bool = True, stacked_m: int = 1,
+) -> Feasibility:
+    """Feasibility of one flat-decode block size across its pass kernels —
+    the gate decode_batch_flat consults on TPU (worst kernel reported)."""
+    worst: Optional[Feasibility] = None
+    ks = ["decode.products.onehot", "decode.backpointers.onehot",
+          "decode.backtrace.onehot"]
+    if scores:
+        ks.append("decode.backpointers.onehot.scores")
+    knobs = Knobs(block_size=bk, stacked_m=stacked_m)
+    for kernel in ks:
+        f = feasible(kernel, knobs)
+        if worst is None or f.total > worst.total:
+            worst = f
+        if not f.ok:
+            return f
+    return worst
+
+
+def max_vmap_block(knobs: Optional[Knobs] = None) -> int:
+    """Largest power-of-two block the vmap batched-decode route fits —
+    the route whose bk >= 8192 scoped-VMEM compile failure is the
+    measured anchor (the flat route's own cap sits one notch higher and
+    is chip-unmeasured; tests pin the distinction)."""
+    base = knobs or Knobs()
+    bk = 8
+    while feasible(
+        "decode.vmap.onehot", base.replace(block_size=bk * 2)
+    ).ok and bk < (1 << 24):
+        bk *= 2
+    return bk
+
+
+def max_stacked_m(kernel: str, knobs: Optional[Knobs] = None) -> int:
+    """Largest member count M for one stacked kernel family at the given
+    knobs (default: shipped) — the mem.stacked-envelope pin."""
+    base = knobs or Knobs()
+    m = 0
+    while m < 256 and feasible(kernel, base.replace(stacked_m=m + 1)).ok:
+        m += 1
+    return m
+
+
+def stacked_block_cap(
+    stacked_m: int, scores: bool = False, knobs: Optional[Knobs] = None,
+) -> int:
+    """Largest power-of-two decode block feasible for an M-member stacked
+    flat decode — the static guard PR 12 shipped without ('on-chip, large
+    M wants a smaller block_size', viterbi_onehot)."""
+    return max_flat_block(scores=scores, stacked_m=stacked_m, knobs=knobs)
+
+
+def max_onehot_states(knobs: Optional[Knobs] = None) -> int:
+    """Largest power-of-two state count K the reduced stats kernels fit at
+    the production 256-lane tile — the model's twin of
+    fb_onehot.ONEHOT_MAX_STATES (the K*K count rows appear three ways in
+    VMEM: carry, scratch, out block)."""
+    base = (knobs or Knobs()).replace(lane_tile=256)
+    K = 2
+    while feasible("fb.seqstats.onehot", base.replace(n_states=K * 2)).ok:
+        K *= 2
+        if K >= 1 << 12:
+            break
+    return K
+
+
+# -- whole-sequence shard HBM model (SEQ_SHARD_BUDGET's oracle) --------------
+
+# Modeled peak live HBM per whole-sequence E-step symbol, by named stream
+# (K=8 f32 streams unless noted).  CALIBRATED to the measured bracket: a
+# 120 Mi shard compiled and ran on one 16 GB v5e, 128 Mi failed remote
+# compile (CLAUDE.md r4) — the xi partial-row term is sized so
+# max_seq_shard() lands at the shipped 112 Mi with that bracket intact;
+# the liveness pass cross-checks the total against the traced em.seq
+# slope (mem.seq-shard-budget notes).
+SEQ_STREAM_BYTES = {
+    "symbols_u8": 1,
+    "steps_next_i32": 4,
+    "alphas_f32xK": 32,
+    "cs_f32": 4,
+    "cs_next_f32": 4,
+    "betas_f32xK": 32,
+    "gamma_f32xK": 32,
+    "xi_partial_rows": 11,
+}
+SEQ_SHARD_GRANULE = 16 << 20   # shards quantize to 16 Mi (lane-grid pow2s)
+
+
+def seq_shard_bytes_per_symbol() -> int:
+    return sum(SEQ_STREAM_BYTES.values())
+
+
+def seq_shard_bytes(n_symbols: int) -> int:
+    """Modeled peak live HBM of an ``n``-symbol whole-sequence E-step."""
+    return n_symbols * seq_shard_bytes_per_symbol()
+
+
+def max_seq_shard() -> int:
+    """Largest whole-sequence per-shard symbol count the HBM model admits
+    on one chip, floored to the 16 Mi granule — train.backends derives
+    SEQ_SHARD_BUDGET from this (pinned == 112 Mi by routing-parity test).
+    """
+    raw = hbm_limit() // seq_shard_bytes_per_symbol()
+    return (raw // SEQ_SHARD_GRANULE) * SEQ_SHARD_GRANULE
+
+
+def seq_shard_report(n_symbols: int) -> dict:
+    """The actionable numbers a SEQ_SHARD_BUDGET rejection carries
+    (mem_reject event): predicted footprint, budget, max fit, streams."""
+    return {
+        "predicted_bytes": seq_shard_bytes(n_symbols),
+        "hbm_limit_bytes": hbm_limit(),
+        "bytes_per_symbol": seq_shard_bytes_per_symbol(),
+        "max_fit_symbols": max_seq_shard(),
+        "streams": dict(SEQ_STREAM_BYTES),
+    }
+
+
+# -- island-calling device memory model --------------------------------------
+
+# The blocked island reduction's device temp is ~ISLAND_BLOCK_BPS x
+# BLOCK_W regardless of T (ops/islands_device.py: "~40 B x BLOCK_W");
+# the whole-record formulation the r4 OOM killed paid the same rate times
+# T (~15 GB of s32[T] temps at 320 Mi).  Compact output columns are
+# ISLAND_COLS int32 rows of the cap.
+ISLAND_BLOCK_BPS = 40
+ISLAND_COLS = 8
+
+
+def island_block_bytes(block_w: int) -> int:
+    return ISLAND_BLOCK_BPS * block_w
+
+
+def island_columns_bytes(cap: int) -> int:
+    return ISLAND_COLS * 4 * cap
+
+
+def island_cap_report(n_calls: int, ceiling: int) -> dict:
+    """Actionable numbers for an island cap-overflow mem_reject: the
+    column footprint the true call count would need vs the ceiling's."""
+    return {
+        "n_calls": int(n_calls),
+        "cap_ceiling": int(ceiling),
+        "predicted_bytes": island_columns_bytes(int(n_calls)),
+        "ceiling_bytes": island_columns_bytes(int(ceiling)),
+        "max_fit_calls": int(ceiling),
+    }
+
+
+# -- jaxpr HBM liveness ------------------------------------------------------
+
+# Prims whose results XLA materializes as fresh buffers; everything here
+# is attribution policy, not physics.  Pure-layout prims alias or fuse
+# away and would mis-flag O(T) "allocations" on reshape-heavy entries.
+_NONMATERIAL_PRIMS = frozenset({
+    "reshape", "transpose", "squeeze", "broadcast_in_dim", "slice",
+    "rev", "bitcast_convert_type", "copy", "split", "iota",
+    "stop_gradient", "device_put",
+})
+
+_LOOP_SUB_KEYS = ("jaxpr", "body_jaxpr", "cond_jaxpr", "branches")
+
+
+def _aval_bytes(aval) -> int:
+    dt = getattr(aval, "dtype", None)
+    itemsize = getattr(dt, "itemsize", 4)
+    n = int(itemsize)
+    for d in getattr(aval, "shape", ()) or ():
+        n *= int(d)
+    return n
+
+
+def _sub_jaxprs_of(eqn):
+    from cpgisland_tpu.analysis.costmodel import _closed_of
+
+    for v in eqn.params.values():
+        yield from _closed_of(v)
+
+
+def peak_live_bytes(closed, include_args: bool = True) -> int:
+    """Interval-analysis peak of live buffer bytes over a (Closed)Jaxpr.
+
+    A var is live from its defining equation until its last use (args and
+    consts from the start); at each equation the inner peak of any loop
+    body rides on top of the state live at that point — scan/while body
+    temps are per-iteration (they do NOT scale by trip count; the stacked
+    ys outputs already carry their full [trips, ...] avals at this
+    level).  Deterministic, allocator-free: a stable fingerprint for the
+    lockfile, not a simulator."""
+    import jax
+
+    jaxpr = getattr(closed, "jaxpr", closed)
+    last_use: dict = {}
+    for i, eqn in enumerate(jaxpr.eqns):
+        for v in eqn.invars:
+            if not isinstance(v, jax.core.Literal):
+                last_use[id(v)] = i
+    for v in jaxpr.outvars:
+        if not isinstance(v, jax.core.Literal):
+            last_use[id(v)] = len(jaxpr.eqns)
+
+    live: dict = {}
+    if include_args:
+        for v in list(jaxpr.constvars) + list(jaxpr.invars):
+            live[id(v)] = _aval_bytes(v.aval)
+    peak = sum(live.values())
+    for i, eqn in enumerate(jaxpr.eqns):
+        inner = 0
+        if eqn.primitive.name in ("scan", "while", "cond"):
+            inner = max(
+                (peak_live_bytes(s, include_args=False)
+                 for s in _sub_jaxprs_of(eqn)),
+                default=0,
+            )
+        elif eqn.primitive.name != "pallas_call":
+            subs = list(_sub_jaxprs_of(eqn))
+            if subs:
+                inner = max(
+                    peak_live_bytes(s, include_args=False) for s in subs
+                )
+        for v in eqn.outvars:
+            live[id(v)] = _aval_bytes(v.aval)
+        peak = max(peak, sum(live.values()) + inner)
+        for v in list(eqn.invars) + list(eqn.outvars):
+            if not isinstance(v, jax.core.Literal) and \
+                    last_use.get(id(v), -1) <= i:
+                live.pop(id(v), None)
+    return peak
+
+
+def alloc_groups(closed) -> dict:
+    """Materialized allocation bytes per ``file:function`` source group.
+
+    Loop bodies count ONE iteration (per-iteration working set — trip
+    scaling is the liveness pass's job via the stacked outvar avals);
+    layout-only prims are excluded so a reshape of an argument does not
+    read as an allocation.  The group names are what a violation prints:
+    'the s32[T] temp grew in islands_device.py:_scan_calls'."""
+    from cpgisland_tpu.analysis.costmodel import _eqn_group
+
+    out: dict = {}
+
+    def walk(j):
+        jaxpr = getattr(j, "jaxpr", j)
+        for eqn in jaxpr.eqns:
+            if eqn.primitive.name not in _NONMATERIAL_PRIMS:
+                g = _eqn_group(eqn)
+                out[g] = out.get(g, 0) + sum(
+                    _aval_bytes(v.aval) for v in eqn.outvars
+                )
+            for s in _sub_jaxprs_of(eqn):
+                walk(s)
+
+    walk(closed)
+    return out
+
+
+def while_body_peaks(closed) -> list:
+    """Peak live bytes inside each while-loop body (one iteration) — the
+    fused-EM body's working set, the mem twin of
+    costmodel.while_body_costs."""
+    jaxpr = getattr(closed, "jaxpr", closed)
+    out: list = []
+
+    def walk(j):
+        for eqn in getattr(j, "jaxpr", j).eqns:
+            if eqn.primitive.name == "while":
+                from cpgisland_tpu.analysis.costmodel import _closed_of
+
+                for s in _closed_of(eqn.params["body_jaxpr"]):
+                    out.append(peak_live_bytes(s, include_args=True))
+            for s in _sub_jaxprs_of(eqn):
+                walk(s)
+
+    walk(jaxpr)
+    return out
+
+
+@dataclasses.dataclass
+class LiveMetrics:
+    """One traced geometry's liveness fingerprint."""
+
+    peak_bytes: int
+    arg_bytes: int
+    out_bytes: int
+    alloc_bytes: int           # total materialized allocations
+    groups: dict               # file:function -> alloc bytes
+    while_body_peak: int       # 0 when the entry has no while loop
+
+    def as_dict(self) -> dict:
+        return {
+            "peak_bytes": self.peak_bytes,
+            "arg_bytes": self.arg_bytes,
+            "out_bytes": self.out_bytes,
+            "alloc_bytes": self.alloc_bytes,
+            "groups": dict(sorted(self.groups.items())),
+            "while_body_peak": self.while_body_peak,
+        }
+
+
+def live_metrics(closed) -> LiveMetrics:
+    jaxpr = getattr(closed, "jaxpr", closed)
+    groups = alloc_groups(closed)
+    bodies = while_body_peaks(closed)
+    return LiveMetrics(
+        peak_bytes=peak_live_bytes(closed),
+        arg_bytes=sum(
+            _aval_bytes(v.aval)
+            for v in list(jaxpr.constvars) + list(jaxpr.invars)
+        ),
+        out_bytes=sum(_aval_bytes(v.aval) for v in jaxpr.outvars),
+        alloc_bytes=sum(groups.values()),
+        groups=groups,
+        while_body_peak=max(bodies, default=0),
+    )
+
+
+def linear_alloc_groups(
+    lo: LiveMetrics, hi: LiveMetrics, n_lo: int, n_hi: int,
+    min_bps: float = 1.0,
+) -> list:
+    """Groups whose materialized allocation grows >= ``min_bps`` bytes per
+    symbol between the two geometries — the named O(T) temporaries.
+    Sorted by slope, descending: [(group, bytes_per_symbol)]."""
+    dn = max(n_hi - n_lo, 1)
+    out = []
+    for g in set(lo.groups) | set(hi.groups):
+        slope = (hi.groups.get(g, 0) - lo.groups.get(g, 0)) / dn
+        if slope >= min_bps:
+            out.append((g, slope))
+    out.sort(key=lambda kv: (-kv[1], kv[0]))
+    return out

@@ -78,6 +78,25 @@ _LANE_RATE_ONEHOT = {
 }
 
 
+@functools.lru_cache(maxsize=None)
+def _feasible_lane_rates(onehot: bool, long_lanes: bool) -> dict:
+    """Rate-table candidates filtered by graftmem's static memory model
+    (Layer 5).  The filter depends only on the flag pair — never on the
+    input size — so it computes once per (onehot, long_lanes): the
+    t-tiled chain kernels are lane_T-free, but the plain reduced path
+    must also run the exact-seq XLA stats assembly, whose scoped-VMEM
+    model bans 131072 — the same cap this table shipped as a hard-coded
+    `k <= 65536` filter before graftmem (routing parity pinned by
+    tests/test_graftmem.py)."""
+    from cpgisland_tpu.analysis import memmodel
+
+    rates = _LANE_RATE_ONEHOT if onehot else _LANE_RATE
+    return {
+        k: v for k, v in rates.items()
+        if memmodel.lane_feasible(k, onehot=onehot, long_lanes=long_lanes)
+    }
+
+
 def pick_lane_T(n: int, onehot: bool = False, long_lanes: bool = False) -> int:
     """Lane length for an ``n``-symbol (per-shard) input.
 
@@ -94,9 +113,7 @@ def pick_lane_T(n: int, onehot: bool = False, long_lanes: bool = False) -> int:
     to remote-compile at that lane length, so callers opt in exactly where
     the kernelized path is guaranteed.
     """
-    rates = _LANE_RATE_ONEHOT if onehot else _LANE_RATE
-    if onehot and not long_lanes:
-        rates = {k: v for k, v in rates.items() if k <= 65536}
+    rates = _feasible_lane_rates(onehot, long_lanes)
 
     def est_cost(lt: int) -> float:
         n_lanes = -(-max(n, 1) // lt)

@@ -86,6 +86,66 @@ def _interpret() -> bool:
     return jax.default_backend() != "tpu"
 
 
+def _stacked_block_for(stacked_m: int, block_size: int, scores: bool) -> int:
+    """Clamp a stacked flat decode's block size to the VMEM model's cap.
+
+    The per-member score/path rows scale the kernel working set by M
+    (viterbi_onehot VMEM note), so the shipped default bk=4096 does not
+    fit M>=3 on chip — without this clamp every >=3-model stacked flush
+    would trip the guard and permanently degrade to sequential dispatch,
+    losing exactly the occupancy win PR 12 shipped.  TPU-only (the
+    off-TPU XLA twins have no VMEM bound, and the bit-identity tests
+    compare stacked vs single-model at the SAME block size there)."""
+    if _interpret():
+        return block_size
+    from cpgisland_tpu.analysis import memmodel
+
+    cap = memmodel.stacked_block_cap(stacked_m, scores=scores)
+    if cap < block_size:
+        from cpgisland_tpu import obs
+
+        obs.event(
+            "mem_clamp", _dedupe=True, site="decode_flat_stacked",
+            requested=block_size, clamped=cap, stacked_m=stacked_m,
+            scores=scores,
+        )
+        return cap
+    return block_size
+
+
+def _check_flat_block(bk: int, scores: bool, stacked_m: int = 1) -> None:
+    """Static VMEM guard on the flat-decode block size (graftmem Layer 5).
+
+    A too-large ``bk`` historically surfaced as an opaque scoped-VMEM
+    compile failure minutes into a relay round trip (CLAUDE.md r5:
+    bk >= 8192 on the batched route); the footprint model rejects it up
+    front with the offending buffers named and a max-fit suggestion.
+    TPU-only: the off-TPU XLA twins have no VMEM bound (and tests
+    exercise large blocks there)."""
+    if _interpret():
+        return
+    from cpgisland_tpu.analysis import memmodel
+
+    f = memmodel.flat_block_feasibility(bk, scores=scores,
+                                        stacked_m=stacked_m)
+    if not f.ok:
+        cap = memmodel.stacked_block_cap(stacked_m, scores=scores)
+        from cpgisland_tpu import obs
+
+        obs.event(
+            "mem_reject", site="decode_flat_block", block_size=bk,
+            stacked_m=stacked_m, predicted_bytes=f.total,
+            vmem_limit_bytes=f.limit, max_fit_block=cap,
+        )
+        raise ValueError(
+            f"decode_batch_flat: block_size={bk}"
+            + (f" with {stacked_m} stacked members" if stacked_m > 1
+               else "")
+            + f" does not fit the VMEM model — {f.reason}; largest "
+            f"feasible block here is {cap}"
+        )
+
+
 # ---------------------------------------------------------------------------
 # Structure detection — thin wrappers over the family partition oracle
 # (cpgisland_tpu.family.partition, the ONE copy of the eligibility logic
@@ -995,6 +1055,7 @@ def decode_batch_flat(
             f"bk={want_bk} — rebuild it with prepare_decode_flat for this "
             "batch and block_size"
         )
+    _check_flat_block(bk, scores=return_score)
     _, emit_ext = _step_tables(params)
     v0 = params.log_pi + emit_ext[concat[0]]
 
@@ -1603,9 +1664,12 @@ def decode_batch_flat_stacked(
     same stream, same constants, same rounding (the stacked kernels run
     the single-model arithmetic per member).  Same exactness domain as the
     flat decoder (records' first positions must be real symbols; callers
-    demote pad-FIRST records).  VMEM note: the score variant's per-member
-    dmax rows scale the kernel working set by M — on-chip, large M wants a
-    smaller ``block_size`` (knob to re-sweep at capture, BASELINE.md).
+    demote pad-FIRST records).  VMEM note: the per-member score/path rows
+    scale the kernel working set by M — on TPU ``block_size`` CLAMPS to
+    graftmem's ``memmodel.stacked_block_cap(M)`` (``mem_clamp`` obs
+    event; knob to re-sweep at capture, BASELINE.md), so the M-member
+    bit-identity contract vs ``decode_batch_flat(..., block_size)`` holds
+    at the CLAMPED block there.
 
     Returns paths [M, N, T] (or (paths, scores [M, N])).
     """
@@ -1615,6 +1679,13 @@ def decode_batch_flat_stacked(
         raise ValueError(
             "decode_batch_flat_stacked needs records of at least 2 symbols"
         )
+    # On TPU the block clamps to the stacked VMEM cap BEFORE prep builds
+    # (graftmem: M>=3 at the flat default bk=4096 does not fit; a caller-
+    # supplied `prepared` built at an unclamped block fails the stale-prep
+    # gate below with rebuild advice rather than tripping the guard).
+    block_size = _stacked_block_for(
+        len(params_list), block_size, scores=return_score
+    )
     if prepared is None:
         prepared = prepare_decode_flat(S, chunks, lengths, block_size)
     concat, padded, resets, bk, pre = prepared
@@ -1627,6 +1698,7 @@ def decode_batch_flat_stacked(
             f"symbols / bk={bk}; this call needs {Np} symbols / "
             f"bk={want_bk} — rebuild it with prepare_decode_flat"
         )
+    _check_flat_block(bk, scores=return_score, stacked_m=len(params_list))
     from cpgisland_tpu.ops.viterbi_parallel import _step_tables
 
     v0s = []

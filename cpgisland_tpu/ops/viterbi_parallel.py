@@ -443,6 +443,32 @@ def viterbi_parallel_batch(
             params, chunks, lengths, block_size=block_size,
             return_score=return_score,
         )
+    if (
+        engine == "onehot" and vmap_records
+        and jax.default_backend() == "tpu"
+    ):
+        # The vmap-of-pallas opt-in loads batch-wide VMEM slabs; graftmem's
+        # model rejects the block sizes that failed scoped-VMEM compile on
+        # chip (bk >= 8192, CLAUDE.md r5) with actionable numbers instead.
+        # Onehot-only: the dense engines' vmap working set is a different
+        # kernel family the model does not claim to describe.
+        from cpgisland_tpu import obs
+        from cpgisland_tpu.analysis import memmodel
+
+        f = memmodel.feasible("decode.vmap.onehot", block_size=block_size)
+        if not f.ok:
+            obs.event(
+                "mem_reject", site="decode_vmap_block",
+                block_size=block_size, predicted_bytes=f.total,
+                vmem_limit_bytes=f.limit,
+                max_fit_block=memmodel.max_vmap_block(),
+            )
+            raise ValueError(
+                f"viterbi_parallel_batch(vmap_records=True): "
+                f"block_size={block_size} does not fit the vmap route's "
+                f"VMEM model — {f.reason}; largest feasible block is "
+                f"{memmodel.max_vmap_block()} (or use the flat route)"
+            )
     chunks = jnp.where(
         jnp.arange(T)[None, :] >= lengths[:, None],
         params.n_symbols,

@@ -903,9 +903,17 @@ def _grow_cap_or_raise(e, cap_box: list) -> None:
     """The ONE overflow-cap policy (shared by the blocking retry and the
     deferred-fetch retry): grow cap_box to the next sufficient pow2, or
     re-raise when the true count exceeds the ceiling."""
+    from cpgisland_tpu.analysis import memmodel
     from cpgisland_tpu.ops.islands_device import IslandCapOverflow
 
     if e.n > ISLAND_CAP_CEILING:
+        # Terminal rejection: the true call count exceeds the ceiling —
+        # report the model's predicted column footprint and the max-fit
+        # cap so the failure carries actionable numbers (graftmem).
+        obs.event(
+            "mem_reject", site="island_cap",
+            **memmodel.island_cap_report(e.n, ISLAND_CAP_CEILING),
+        )
         raise IslandCapOverflow(e.n, cap_box[0]) from None
     # Clamp at the ceiling: n == ceiling exactly fits cap == n
     # slots, and the retry must not outgrow the bound the user
@@ -916,6 +924,7 @@ def _grow_cap_or_raise(e, cap_box: list) -> None:
     obs.event(
         "island_cap_retry", n_calls=int(e.n), old_cap=cap_box[0],
         new_cap=new_cap,
+        predicted_bytes=memmodel.island_columns_bytes(new_cap),
     )
     log.warning(
         "island calls (%d) overflowed cap=%d; retrying the on-device "
@@ -1232,10 +1241,14 @@ def _decode_small_batch_stacked(
     and record i's island calls come from its OWNING model's path
     (``owners[i]`` indexes ``params_list``).  Exactness: record i's path
     is bit-identical to ``owners[i]``'s own flat decode of this same
-    padded batch; vs the per-model sequential flush (whose flat streams
-    contain only that model's records) paths agree modulo the flat
-    decoder's pinned rounding-tie contract (PARITY.md C10) — the reset
-    entry constant differs, argmax paths only move on exact ties.
+    padded batch AT THE SAME BLOCK SIZE — on TPU with M>=3 the stacked
+    decoder clamps its block to graftmem's ``stacked_block_cap`` (VMEM),
+    so vs a default-block single-model decode the comparison is modulo
+    the flat decoder's pinned rounding-tie contract, like the sequential-
+    flush comparison below; vs the per-model sequential flush (whose flat
+    streams contain only that model's records) paths agree modulo that
+    same contract (PARITY.md C10) — the reset entry constant differs,
+    argmax paths only move on exact ties.
 
     Island calling runs per model on its records (device islands via the
     shared batched reduction, host islands via the pipelines' exact host

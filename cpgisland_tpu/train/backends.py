@@ -28,6 +28,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from cpgisland_tpu import obs
+from cpgisland_tpu.analysis import memmodel
 from cpgisland_tpu.models.hmm import HmmParams
 from cpgisland_tpu.ops import fb_pallas
 from cpgisland_tpu.ops.forward_backward import SuffStats, batch_stats
@@ -505,13 +506,17 @@ def _check_seq_engine(engine: str) -> None:
 
 
 # Largest per-shard whole-sequence E-step a single 16 GB v5e chip can
-# compile and run (the fused path streams ~36 B/symbol of alpha/products
-# state): measured r4 — 120 Mi compiled and ran, 128 Mi failed remote
-# compile, and the XLA lane path at 128 Mi did not finish compiling in
-# 10 min.  112 Mi keeps a safety margin.  This is PER SHARD: a v5e-8 mesh
-# trains an 8x longer sequence, and seq2d's per-record rows shard each
-# record's time axis the same way.
-SEQ_SHARD_BUDGET = 112 << 20
+# compile and run: measured r4 — 120 Mi compiled and ran, 128 Mi failed
+# remote compile, and the XLA lane path at 128 Mi did not finish
+# compiling in 10 min.  Since graftmem (Layer 5) the budget is DERIVED
+# from the static HBM model (memmodel.SEQ_STREAM_BYTES x symbols against
+# the 16 GB chip minus the runtime reserve, floored to the 16 Mi
+# granule); the derivation lands on the same 112 Mi the measurements
+# pinned — routing parity enforced by tests/test_graftmem.py and the
+# mem.seq-shard-budget contract.  This is PER SHARD: a v5e-8 mesh trains
+# an 8x longer sequence, and seq2d's per-record rows shard each record's
+# time axis the same way.
+SEQ_SHARD_BUDGET = memmodel.max_seq_shard()
 
 # Largest record class the 2-D backend routes to the whole-record-per-lane
 # chunked fast path (sharded_stats2d_rows_fn): 64 Ki is the chunked
@@ -528,15 +533,28 @@ def _check_seq_shard(shard_len: int, what: str) -> None:
             if what == "Seq2DBackend"
             else "a bigger mesh, or per-record rows with backend='seq2d'"
         )
+        report = memmodel.seq_shard_report(shard_len)
         obs.event(
             "seq_shard_budget_reject", shard_len=shard_len, backend=what,
             budget=SEQ_SHARD_BUDGET,
         )
+        obs.event(
+            "mem_reject", site="seq_shard", backend=what,
+            shard_len=shard_len,
+            predicted_bytes=report["predicted_bytes"],
+            hbm_limit_bytes=report["hbm_limit_bytes"],
+            max_fit_symbols=report["max_fit_symbols"],
+        )
         raise ValueError(
             f"{what}: per-device shard of {shard_len} symbols exceeds the "
             f"~{SEQ_SHARD_BUDGET >> 20} Mi single-chip whole-sequence "
-            f"E-step budget — shard time across more devices ({alt}), or "
-            "use the chunked 'spmd' backend (the reference's own framing)"
+            f"E-step budget (modeled footprint "
+            f"~{report['predicted_bytes'] >> 30} GiB at "
+            f"{report['bytes_per_symbol']} B/symbol vs "
+            f"~{report['hbm_limit_bytes'] >> 30} GiB usable HBM; max fit "
+            f"{report['max_fit_symbols'] >> 20} Mi symbols/shard) — shard "
+            f"time across more devices ({alt}), or use the chunked "
+            "'spmd' backend (the reference's own framing)"
         )
 
 

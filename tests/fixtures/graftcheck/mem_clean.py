@@ -1,0 +1,53 @@
+"""Planted-regression twin set for the Layer-5 memory lockfile (graftmem).
+
+``mem_clean`` is the baseline: a miniature BLOCKED reduction in the shape
+of the on-device island caller (ops/islands_device.py) — the [T] input
+reshapes to [nB, W] blocks and ONE ``lax.scan`` threads fixed-size carry
+state across them, so every materialized temporary is O(W), never O(T).
+``mem_linear_temp`` is the regression twin: the same accounting computed
+WHOLE-RECORD, materializing s32[T] temps — the formulation whose real
+ancestor OOMed ~15 GB at 320 Mi symbols (CLAUDE.md r4).
+tests/test_graftcheck_self.py baselines the clean twin and asserts the
+planted twin fails the liveness diff with the offending allocation group
+NAMED.
+
+Fixture contract: ``make(scale)`` returns ``(fn, (args,))`` with the time
+geometry multiplied by ``scale``; ``BASE_SYMBOLS`` is the scale-1 symbol
+count (the same shape ``analysis.contracts.Contract.make`` has).
+"""
+
+BASE_SYMBOLS = 32768
+BLOCK_W = 4096
+
+
+def _path(scale: int):
+    import jax.numpy as jnp
+    import numpy as np
+
+    return jnp.asarray(
+        (np.arange(BASE_SYMBOLS * scale, dtype=np.int64) % 7).astype(np.int8)
+    )
+
+
+def make(scale: int = 1):
+    import jax
+    import jax.numpy as jnp
+
+    path = _path(scale)
+    T = path.shape[0]
+    nB = T // BLOCK_W
+
+    def fn(p):
+        blocks = p.reshape(nB, BLOCK_W)
+
+        def body(carry, blk):
+            b = blk.astype(jnp.int32)          # O(W) temp, per block
+            in_mask = b < 3
+            runs = jnp.cumsum(in_mask.astype(jnp.int32))  # O(W)
+            carry = carry + runs[-1]
+            return carry, jnp.max(runs)
+
+        total, per_block = jax.lax.scan(body, jnp.int32(0), blocks)
+        return total, per_block.sum()
+
+    return fn, (path,)

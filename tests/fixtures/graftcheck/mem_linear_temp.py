@@ -1,0 +1,28 @@
+"""Planted regression: a WHOLE-RECORD O(T) island temp.
+
+The same run accounting as ``mem_clean``, computed without blocking: the
+int8 path upcasts to a full s32[T] stream and a record-length cumsum
+materializes beside it — live allocations that scale with T instead of
+the block width (the ~15 GB s32[T] OOM class the blocked island
+reduction was built to kill).  Must be caught by (a) the lockfile diff
+(the O(T) allocation-group list drifts, new group NAMED) and (b) the
+liveness detector directly (linear_alloc_groups slope >= the s32
+4 B/symbol class).
+"""
+
+from mem_clean import BASE_SYMBOLS, _path  # noqa: F401
+
+
+def make(scale: int = 1):
+    import jax.numpy as jnp
+
+    path = _path(scale)
+
+    def fn(p):
+        b = p.astype(jnp.int32)                    # s32[T] temp
+        in_mask = b < 3
+        runs = jnp.cumsum(in_mask.astype(jnp.int32))   # another s32[T]
+        anchored = jnp.maximum(runs, b)            # and a third
+        return anchored[-1], jnp.max(runs)
+
+    return fn, (path,)

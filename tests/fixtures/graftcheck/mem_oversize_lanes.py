@@ -1,0 +1,14 @@
+"""Planted regression: an OVERSIZE lane count.
+
+The knob tuple that killed the r4 capture attempt: lane_T=131072 on the
+plain reduced path, whose exact-seq XLA stats assembly failed remote
+compile there (CLAUDE.md — the reason pick_lane_T filtered the rate
+table at 65536 before graftmem derived the same cap).  The test asserts
+memmodel.feasible rejects it NAMING the chain-stream buffers that
+overflow the scoped-VMEM model.
+"""
+
+from cpgisland_tpu.analysis import memmodel
+
+KERNEL = "assembly.seqstats.onehot"
+KNOBS = memmodel.Knobs(lane_T=131072, lane_tile=256)

@@ -1,0 +1,14 @@
+"""Planted regression: a stacked-M VMEM overflow.
+
+PR 12's stacked kernels scale VMEM with member count M ('the score
+variant's per-member dmax rows scale the kernel working set by M',
+viterbi_onehot) and shipped with no static guard: three members' score
+rows at the flat default bk=4096 overflow the 16 MiB model.  The test
+asserts memmodel.feasible rejects the tuple NAMING the per-member dmax
+buffer, and that the guard's derived block cap restores feasibility.
+"""
+
+from cpgisland_tpu.analysis import memmodel
+
+KERNEL = "decode.backpointers.onehot.scores"
+KNOBS = memmodel.Knobs(block_size=4096, stacked_m=3)

@@ -18,6 +18,8 @@ from cpgisland_tpu.analysis import (
     contracts,
     cost_contracts,
     costmodel,
+    mem_contracts,
+    memmodel,
     run_lint,
 )
 
@@ -320,6 +322,152 @@ def test_missing_platform_section_is_note_not_violation(clean_lock):
     diff = cost_contracts.diff_costs({}, lock, "tpu")
     assert diff.ok
     assert any("no 'tpu' section" in n for n in diff.notes)
+
+
+# -- Layer 5: the mem pass on the tree ---------------------------------------
+
+
+@pytest.fixture(scope="module")
+def mem_report():
+    return mem_contracts.run_mem_pass()
+
+
+# The tree-wide mem pass re-traces the whole registry (~30 s) — slow-
+# marked like the graftcost CLI round trip; it still gates every
+# ci_checks.sh run (`--no-lint --mem`), plain `pytest tests/`, and
+# __graft_entry__'s self-check.  The planted-fixture detector proofs
+# below stay in tier-1 (small traces).
+@pytest.mark.slow
+def test_mem_pass_green_on_tree(mem_report):
+    assert mem_report["ok"], {
+        "diff": mem_report["diff"]["violations"],
+        "contracts": [
+            (r["name"], r["violations"])
+            for r in mem_report["contracts"] if not r["ok"]
+        ],
+    }
+    # The committed lockfile covers the whole registry (the cost cast +
+    # the fused-EM loop + the blocked island reduction) — no stale
+    # entries, nothing unbaselined.
+    assert mem_report["diff"]["stale"] == []
+    assert mem_report["diff"]["checked"] >= 19
+
+
+@pytest.mark.slow
+def test_mem_contracts_all_present(mem_report):
+    names = {r["name"] for r in mem_report["contracts"]}
+    assert names == {
+        "mem.vmem-budget", "mem.no-linear-temps", "mem.seq-shard-budget",
+        "mem.stacked-envelope",
+    }
+
+
+@pytest.mark.slow
+def test_mem_island_entry_has_no_linear_temps(mem_report):
+    byname = {r["name"]: r for r in mem_report["contracts"]}
+    notes = byname["mem.no-linear-temps"]["notes"]
+    assert notes["island_linear_groups"] == []
+    # The fused-EM body's per-symbol working set sits well under the pin.
+    assert 0 < notes["em_body_peak_bps"] < mem_contracts.EM_BODY_BPS_MAX
+
+
+# -- Layer 5: planted-regression fixtures ------------------------------------
+
+
+def _mem_fixture_entry(stem: str, name: str = "fixture.mem"):
+    sys.path.insert(0, COST_FIXTURES)
+    try:
+        mod = __import__(stem)
+    finally:
+        sys.path.pop(0)
+    return contracts.Contract(
+        name=name, make=mod.make, base_symbols=mod.BASE_SYMBOLS,
+        cost_scales=(1, 2),
+    )
+
+
+@pytest.fixture(scope="module")
+def clean_mem_lock(tmp_path_factory):
+    """A MEMORY.json baselined from the CLEAN blocked-reduction twin."""
+    entry = mem_contracts.trace_mem_entry(_mem_fixture_entry("mem_clean"))
+    fp = {"fixture.mem": mem_contracts.fingerprint(entry)}
+    path = str(tmp_path_factory.mktemp("mem") / "MEMORY.json")
+    mem_contracts.write_lockfile(fp, path)
+    return path
+
+
+def _mem_diff_fixture(stem: str, clean_lock: str):
+    entry = mem_contracts.trace_mem_entry(_mem_fixture_entry(stem))
+    live = {"fixture.mem": mem_contracts.fingerprint(entry)}
+    lock = mem_contracts.load_lockfile(clean_lock)
+    return entry, mem_contracts.diff_mem(live, lock, "cpu")
+
+
+def test_clean_mem_fixture_round_trips(clean_mem_lock):
+    entry, diff = _mem_diff_fixture("mem_clean", clean_mem_lock)
+    assert diff.ok, diff.violations
+    # The blocked twin materializes nothing that scales with T.
+    assert entry.linear_groups() == []
+
+
+def test_planted_whole_record_island_temp_caught(clean_mem_lock):
+    """The r4 island-OOM class: the whole-record twin's s32[T] temps must
+    fail the lockfile diff NAMING the offending allocation group, and the
+    liveness detector must see the s32 4 B/symbol slope directly."""
+    entry, diff = _mem_diff_fixture("mem_linear_temp", clean_mem_lock)
+    assert not diff.ok
+    assert any(
+        "O(T) allocation groups drifted" in v and "mem_linear_temp.py" in v
+        for v in diff.violations
+    ), diff.violations
+    bad = entry.linear_groups()
+    assert bad, "liveness detector missed the planted s32[T] temps"
+    assert all("mem_linear_temp.py" in g for g, _ in bad)
+    # s32 whole-record temps: at least the 4 B/symbol class, several of
+    # them — the clean twin's blocked scan keeps all of this O(block_w).
+    assert max(bps for _, bps in bad) >= 4.0
+    # And the peak-liveness slope grew accordingly vs the blocked twin.
+    clean = mem_contracts.trace_mem_entry(_mem_fixture_entry("mem_clean"))
+    assert (
+        entry.fits()["peak_bytes"].per_symbol
+        > clean.fits()["peak_bytes"].per_symbol + 4.0
+    )
+
+
+def test_planted_oversize_lanes_fails_naming_buffers():
+    sys.path.insert(0, COST_FIXTURES)
+    try:
+        import importlib
+
+        fx = importlib.import_module("mem_oversize_lanes")
+    finally:
+        sys.path.pop(0)
+    f = memmodel.feasible(fx.KERNEL, fx.KNOBS)
+    assert not f.ok
+    names = {b.name for b in f.offenders}
+    assert {"aprev_full", "wz_full"} & names, f.offenders
+    assert "aprev_full" in f.reason or "wz_full" in f.reason
+    # One lane notch down is feasible — the pick_lane_T cap.
+    assert memmodel.feasible(fx.KERNEL, fx.KNOBS.replace(lane_T=65536)).ok
+
+
+def test_planted_stacked_overflow_fails_naming_buffers():
+    sys.path.insert(0, COST_FIXTURES)
+    try:
+        import importlib
+
+        fx = importlib.import_module("mem_stacked_overflow")
+    finally:
+        sys.path.pop(0)
+    f = memmodel.feasible(fx.KERNEL, fx.KNOBS)
+    assert not f.ok
+    assert "dmax_out" in {b.name for b in f.offenders}, f.offenders
+    assert "dmax_out" in f.reason
+    # The guard's derived block cap restores feasibility at M=3.
+    cap = memmodel.stacked_block_cap(3, scores=True)
+    assert memmodel.feasible(
+        fx.KERNEL, fx.KNOBS.replace(block_size=cap)
+    ).ok
 
 
 def test_attribution_table_names_fixed_cost_groups():

@@ -20,6 +20,16 @@ echo "== graftcost: quantitative cost contracts + COSTS.json diff (CPU trace) ==
 # graph change.
 python -m cpgisland_tpu.analysis --no-lint --costs
 
+echo "== graftmem: Layer-5 memory contracts + MEMORY.json diff (CPU trace) =="
+# Layer 5: HBM liveness fingerprints (peak live bytes at >=2 geometries,
+# named O(T) allocation groups) + the shipped-knob VMEM footprint of every
+# modeled kernel must match the committed lockfile; the memory contracts
+# pin the VMEM budget (incl. stacked M=3), the blocked island reduction's
+# O(block)-not-O(T) temps, the derived 112 Mi seq-shard cap, and the
+# stacked-M envelope.  Re-baseline with --update-mem after a VERIFIED
+# change.
+python -m cpgisland_tpu.analysis --no-lint --mem
+
 echo "== graftsync: Layer-4 cross-module lock-order graph =="
 # The per-file concurrency rules (sync-guarded-by / sync-lock-order /
 # sync-blocking-under-lock / sync-thread-lifecycle) already ran inside the
