@@ -1851,6 +1851,25 @@ def _print_northstar(decode_tput: float, em_tput: float) -> None:
     )
 
 
+def _tuning_census(results: dict) -> dict:
+    """Fresh-vs-stale graftune winner counts for the capture platform
+    (the extras' ``tuning_table_fresh`` row) — platform comes from the
+    parity phase's recorded backend, so the parent process never has to
+    initialize one."""
+    from cpgisland_tpu.tune import table as tune_table
+
+    platform = (
+        results.get("parity", {}).get("parity", {}).get("backend", "cpu")
+    )
+    rep = tune_table.table_report(platform=platform)
+    return {
+        "platform": rep["platform"],
+        "fresh": rep["fresh"],
+        "stale": rep["stale"],
+        "stale_keys": [r["key"] for r in rep["stale_entries"]][:8],
+    }
+
+
 def _orchestrate(args) -> int:
     """--extended parent: run each capture phase in a FRESH process.
 
@@ -2009,6 +2028,13 @@ def _orchestrate(args) -> int:
             or "degraded-to-global"
         ),
         "ceilings_degraded_phases": degraded_phases,
+        # graftune winner-table census on the capturing backend: how many
+        # swept knob winners the routers actually honored during this
+        # capture vs how many had gone stale (COSTS.json fingerprint
+        # drift = a kernel reshape since the last sweep — the
+        # self-invalidation working as designed; re-sweep with
+        # tools/graftune.py --all before trusting stale-knob figures).
+        "tuning_table_fresh": _tuning_census(results),
     }
     log("extended: " + json.dumps(extras))
     _print_northstar(decode_tput, em_tput)

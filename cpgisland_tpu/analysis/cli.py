@@ -86,6 +86,14 @@ def main(argv=None) -> int:
     ap.add_argument("--mem-table", default=None, metavar="KERNEL",
                     help="print the VMEM buffer breakdown for one modeled "
                     "kernel (e.g. fb.fwdbwd.onehot) and exit")
+    ap.add_argument("--tune", action="store_true",
+                    help="report the graftune winner table (TUNING.json): "
+                    "fresh vs stale winners for this platform, stale rows "
+                    "NAMED with their COSTS.json fingerprint-drift reason "
+                    "(stale-waiver UX — advisory, staleness is the design "
+                    "working; re-sweep with tools/graftune.py)")
+    ap.add_argument("--tune-file", default=None,
+                    help="winner-table path (default: <repo>/TUNING.json)")
     ap.add_argument("--platform", default="cpu",
                     help="contracts backend: cpu (default — the pass is "
                     "designed to certify without a TPU) | tpu | auto "
@@ -306,6 +314,32 @@ def main(argv=None) -> int:
             )
         if not report["ok"]:
             rc = 1
+
+    if args.tune:
+        _pin_platform(args.platform)
+        from cpgisland_tpu.tune import table as tune_table
+
+        report = tune_table.table_report(path=args.tune_file)
+        if args.as_json:
+            payload["tune"] = report
+        else:
+            for row in report["stale_entries"]:
+                # Advisory, the stale-waiver UX: a stale winner means the
+                # router already fell back to the hard-coded default —
+                # the self-invalidation IS the feature, the note is the
+                # re-sweep reminder.
+                print(
+                    f"note: tune stale: {row['key']}: {row['reason']}",
+                    file=sys.stderr,
+                )
+            if "note" in report:
+                print(f"note: {report['note']}", file=sys.stderr)
+            print(
+                f"graftune: {report['entries']} winner(s) for "
+                f"'{report['platform']}' — {report['fresh']} fresh, "
+                f"{report['stale']} stale ({report['path']})",
+                file=sys.stderr,
+            )
 
     if args.as_json:
         payload["ok"] = rc == 0

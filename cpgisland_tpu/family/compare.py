@@ -206,7 +206,7 @@ def compare_record(
     prev: Optional[int] = None,
     sessions=None,
     supervisor=None,
-    stacked: bool = True,
+    stacked: Optional[bool] = None,
     streams_handle=None,
 ) -> RecordComparison:
     """Compare ``members`` over one base-alphabet record (see module
@@ -219,11 +219,14 @@ def compare_record(
 
     Each order's stream is encoded, pow2-padded AND device-placed ONCE,
     shared by every member of that order (scoring pass + posterior units
-    — zero duplicate uploads on the second member).  ``stacked`` (default)
+    — zero duplicate uploads on the second member).  ``stacked``
     additionally groups same-order members whose resolved FB engine is
     the reduced ``'onehot'`` into ONE stacked launch set
     (family.stacked) — per-member results stay bit-identical to the
-    sequential arm; a failing stacked unit falls back to it.
+    sequential arm; a failing stacked unit falls back to it.  The
+    ``None`` default consults the graftune winner table
+    (``stacked.compare``) and falls back to the shipped True; an
+    explicit bool always wins.
     ``streams_handle``: an ops.prepared.PreparedStreams owning the stacked
     group's symbol-only prep (the serve registry passes its shared one).
     """
@@ -242,6 +245,10 @@ def compare_record(
 
     if not members:
         raise ValueError("compare needs at least one member")
+    if stacked is None:
+        from cpgisland_tpu import tune
+
+        stacked = tune.default_stacked("compare")
     names = [m.name for m in members]
     if len(set(names)) != len(names):
         raise ValueError(f"duplicate member names: {names}")

@@ -1258,6 +1258,19 @@ def run_fb_kernels_onehot(
 # dinucleotide member (ROADMAP item 2's K<=8 lift) with bounded scratch.
 ONEHOT_MAX_STATES = 32
 
+# graftmem kernel family behind each reduced-path tuning knob — the ONE
+# mapping the graftune sweep prunes knob tuples through (tune.tasks) and
+# the lane-tile note: the chunked/seq stats kernels run the wide 256-lane
+# tile via fb_pallas._fb_lane_tile when the lane count divides, 128
+# otherwise, so feasibility checks evaluate at lane_tile=256 (the
+# envelope case).  A new reduced kernel family registers here AND in
+# memmodel._BUILDERS, or its knobs silently escape the sweep's prune.
+TUNE_KERNELS = {
+    "posterior": "fb.fwdbwd.onehot",
+    "em_seq": "fb.seqstats.onehot",
+    "em_chunked": "fb.stats.onehot",
+}
+
 
 def check_stacked_members(params_list) -> int:
     """Validate a stacked member set (shared alphabet, envelope) and return

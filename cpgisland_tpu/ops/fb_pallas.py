@@ -38,6 +38,7 @@ The reference equivalent is Mahout's Hadoop Baum-Welch mapper
 from __future__ import annotations
 
 import functools
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
@@ -79,7 +80,9 @@ _LANE_RATE_ONEHOT = {
 
 
 @functools.lru_cache(maxsize=None)
-def _feasible_lane_rates(onehot: bool, long_lanes: bool) -> dict:
+def _feasible_lane_rates(
+    onehot: bool, long_lanes: bool, _table_gen: int = 0
+) -> dict:
     """Rate-table candidates filtered by graftmem's static memory model
     (Layer 5).  The filter depends only on the flag pair — never on the
     input size — so it computes once per (onehot, long_lanes): the
@@ -87,7 +90,13 @@ def _feasible_lane_rates(onehot: bool, long_lanes: bool) -> dict:
     must also run the exact-seq XLA stats assembly, whose scoped-VMEM
     model bans 131072 — the same cap this table shipped as a hard-coded
     `k <= 65536` filter before graftmem (routing parity pinned by
-    tests/test_graftmem.py)."""
+    tests/test_graftmem.py).  ``_table_gen`` folds the graftune tuning-
+    table generation into the cache key: the filter's OUTPUT does not
+    depend on the table today (winner consultation happens per call in
+    pick_lane_T, uncached), but any future table-derived candidate set
+    (e.g. a sweep-updated rate table) inherits correct in-process
+    ``--update-tune`` invalidation from this key instead of silently
+    serving pre-sweep results for the rest of the session."""
     from cpgisland_tpu.analysis import memmodel
 
     rates = _LANE_RATE_ONEHOT if onehot else _LANE_RATE
@@ -97,23 +106,24 @@ def _feasible_lane_rates(onehot: bool, long_lanes: bool) -> dict:
     }
 
 
-def pick_lane_T(n: int, onehot: bool = False, long_lanes: bool = False) -> int:
-    """Lane length for an ``n``-symbol (per-shard) input.
+def legacy_lane_T(
+    n: int, onehot: bool = False, long_lanes: bool = False,
+    rates: Optional[dict] = None,
+) -> int:
+    """The hard-coded lane choice (rate-table cost minimization) — what
+    :func:`pick_lane_T` returns whenever no fresh tuned winner matches,
+    and the sweep driver's baseline arm.
 
     Minimizes estimated pass time = padded work / measured lane rate: the
     input pads to a full 128-lane grid of ``lane_T``-long lanes
     (_lane_layout), so a long lane just past a grid boundary can cost more
     in padding than its faster rate buys — gating on raw size alone made
     inputs just above each boundary ~20% slower than the short-lane
-    default.  Ties prefer the longer lane.  ``onehot`` selects the reduced
-    kernels' rate table (different knee — see _LANE_RATE_ONEHOT);
-    ``long_lanes`` additionally admits the 131072 entry, which is safe ONLY
-    for paths that stay on reduced kernels end to end (the seq-stats kernel
-    / the conf kernel) — the XLA assemblies over [Tp, K, NL] streams fail
-    to remote-compile at that lane length, so callers opt in exactly where
-    the kernelized path is guaranteed.
-    """
-    rates = _feasible_lane_rates(onehot, long_lanes)
+    default.  Ties prefer the longer lane."""
+    if rates is None:
+        from cpgisland_tpu import tune
+
+        rates = _feasible_lane_rates(onehot, long_lanes, tune.generation())
 
     def est_cost(lt: int) -> float:
         n_lanes = -(-max(n, 1) // lt)
@@ -122,7 +132,34 @@ def pick_lane_T(n: int, onehot: bool = False, long_lanes: bool = False) -> int:
 
     # Candidates ARE the rate table (one source of truth for the next
     # re-sweep); sorted longest-first so cost ties prefer the longer lane.
-    lane_T = min(sorted(rates, reverse=True), key=est_cost)
+    return min(sorted(rates, reverse=True), key=est_cost)
+
+
+def pick_lane_T(n: int, onehot: bool = False, long_lanes: bool = False) -> int:
+    """Lane length for an ``n``-symbol (per-shard) input.
+
+    Consults the graftune winner table first: a FRESH applied winner for
+    this (path, platform, pow2 bucket) — fingerprint-current against
+    COSTS.json and inside the feasible rate table — wins; anything else
+    (absent, stale, fingerprint-drifted, out-of-domain) falls back
+    BIT-FOR-BIT to :func:`legacy_lane_T`'s rate-table minimization
+    (routing parity pinned by tests/test_graftune.py).  ``onehot``
+    selects the reduced kernels' rate table (different knee — see
+    _LANE_RATE_ONEHOT); ``long_lanes`` additionally admits the 131072
+    entry, which is safe ONLY for paths that stay on reduced kernels end
+    to end (the seq-stats kernel / the conf kernel) — the XLA assemblies
+    over [Tp, K, NL] streams fail to remote-compile at that lane length,
+    so callers opt in exactly where the kernelized path is guaranteed.
+    """
+    from cpgisland_tpu import tune
+
+    rates = _feasible_lane_rates(onehot, long_lanes, tune.generation())
+    tuned = tune.tuned_lane_T(
+        n, onehot=onehot, long_lanes=long_lanes, candidates=tuple(rates)
+    )
+    lane_T = tuned if tuned is not None else legacy_lane_T(
+        n, onehot, long_lanes, rates=rates
+    )
     from cpgisland_tpu import obs
 
     # n is bucketed to its power-of-two class for the dedupe key: raw record
@@ -131,7 +168,7 @@ def pick_lane_T(n: int, onehot: bool = False, long_lanes: bool = False) -> int:
     obs.event(
         "lane_geometry", _dedupe=True,
         n_pow2=1 << max(int(n) - 1, 0).bit_length(), lane_T=lane_T,
-        onehot=onehot, long_lanes=long_lanes,
+        onehot=onehot, long_lanes=long_lanes, tuned=tuned is not None,
     )
     return lane_T
 

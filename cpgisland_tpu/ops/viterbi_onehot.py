@@ -86,6 +86,19 @@ def _interpret() -> bool:
     return jax.default_backend() != "tpu"
 
 
+def _default_block_size(block_size, scores: bool, stacked_m: int = 1) -> int:
+    """Resolve a ``block_size=None`` default through the graftune winner
+    table (fresh applied ``flat.block`` winner -> table value; absent /
+    stale / fingerprint-drifted -> the hard-coded 4096, bit for bit).
+    Host-side only — explicit caller values pass through untouched, and
+    the jit entries receive a concrete int."""
+    if block_size is not None:
+        return int(block_size)
+    from cpgisland_tpu import tune
+
+    return tune.default_block_size(scores=scores, stacked_m=stacked_m)
+
+
 def _stacked_block_for(stacked_m: int, block_size: int, scores: bool) -> int:
     """Clamp a stacked flat decode's block size to the VMEM model's cap.
 
@@ -941,7 +954,7 @@ def pass_backtrace(blob, exits: jnp.ndarray) -> jnp.ndarray:
 
 
 def prepare_decode_flat(
-    S: int, chunks: jnp.ndarray, lengths: jnp.ndarray, block_size: int = 4096
+    S: int, chunks: jnp.ndarray, lengths: jnp.ndarray, block_size=None
 ):
     """Symbol-only prep of the flat batched decode.
 
@@ -951,6 +964,7 @@ def prepare_decode_flat(
     :func:`decode_batch_flat` unpacks.  Mirrors its own derivation (it
     delegates here), so prepared-vs-inline decodes are bit-identical."""
     N, T = chunks.shape
+    block_size = _default_block_size(block_size, scores=False)
     obs_c = jnp.where(
         jnp.arange(T)[None, :] >= lengths[:, None],
         S,
@@ -981,7 +995,7 @@ def prepare_decode_flat(
 
 def decode_batch_flat(
     params: HmmParams, chunks: jnp.ndarray, lengths: jnp.ndarray,
-    block_size: int = 4096,
+    block_size=None,
     prepared=None,
     return_score: bool = False,
 ):
@@ -1039,6 +1053,14 @@ def decode_batch_flat(
     N, T = chunks.shape
     if T < 2:
         raise ValueError("decode_batch_flat needs records of at least 2 symbols")
+    if block_size is None and prepared is not None:
+        # A caller-built prep pins the geometry: adopt ITS block rather
+        # than re-consulting the tuned default (the flat.block and
+        # flat.block.scores winners are separate swept tasks and may
+        # legitimately diverge — an all-defaults prepared call must not
+        # trip the stale-prep gate over that).
+        block_size = prepared[3]
+    block_size = _default_block_size(block_size, scores=return_score)
     if prepared is None:
         prepared = prepare_decode_flat(S, chunks, lengths, block_size)
     concat, padded, resets, bk, pre = prepared
@@ -1649,7 +1671,7 @@ def decode_batch_flat_stacked(
     params_list,
     chunks: jnp.ndarray,
     lengths: jnp.ndarray,
-    block_size: int = 4096,
+    block_size=None,
     prepared=None,
     return_score: bool = False,
 ):
@@ -1679,6 +1701,14 @@ def decode_batch_flat_stacked(
         raise ValueError(
             "decode_batch_flat_stacked needs records of at least 2 symbols"
         )
+    if block_size is None and prepared is not None:
+        # Same rule as decode_batch_flat: a caller-built prep pins the
+        # block (the stacked clamp below still applies; an unclamped prep
+        # fails the stale-prep gate with rebuild advice, as before).
+        block_size = prepared[3]
+    block_size = _default_block_size(
+        block_size, scores=return_score, stacked_m=len(params_list)
+    )
     # On TPU the block clamps to the stacked VMEM cap BEFORE prep builds
     # (graftmem: M>=3 at the flat default bk=4096 does not fit; a caller-
     # supplied `prepared` built at an unclamped block fails the stale-prep
@@ -1724,14 +1754,30 @@ def decode_batch_flat_stacked(
 
 
 @functools.partial(jax.jit, static_argnames=("block_size", "return_score"))
-def decode_batch_flat_stacked_jit(
+def _decode_batch_flat_stacked_traced(
     params_list, chunks, lengths, block_size: int = 4096,
+    return_score: bool = False,
+):
+    return decode_batch_flat_stacked(
+        tuple(params_list), chunks, lengths, block_size=block_size,
+        return_score=return_score,
+    )
+
+
+def decode_batch_flat_stacked_jit(
+    params_list, chunks, lengths, block_size=None,
     return_score: bool = False,
 ):
     """One-dispatch entry for :func:`decode_batch_flat_stacked` (the serve
     broker's mixed-model flush unit; prep builds in-graph — per-flush
-    record sets never repeat, so there is nothing to amortize)."""
-    return decode_batch_flat_stacked(
+    record sets never repeat, so there is nothing to amortize).  The
+    ``block_size=None`` default resolves through the graftune table HERE,
+    host-side, so the tuned value is a concrete static arg (never a
+    trace-time consultation frozen into a jit cache)."""
+    block_size = _default_block_size(
+        block_size, scores=return_score, stacked_m=len(params_list)
+    )
+    return _decode_batch_flat_stacked_traced(
         tuple(params_list), chunks, lengths, block_size=block_size,
         return_score=return_score,
     )

@@ -399,11 +399,46 @@ def viterbi_parallel(
     return path, jnp.max(dec.delta_exit) + dec.score_offset
 
 
+def viterbi_parallel_batch(
+    params: HmmParams,
+    chunks: jnp.ndarray,
+    lengths: jnp.ndarray,
+    block_size=None,
+    return_score: bool = True,
+    engine: str = "xla",
+    vmap_records: bool = False,
+):
+    """Batched decode of a [N, T] batch of padded chunks.
+
+    ``block_size=None`` resolves host-side HERE, before the jit boundary:
+    the flat onehot route consults the graftune winner table (fresh
+    applied ``flat.block`` winner -> table value, else the hard-coded
+    DEFAULT_BLOCK bit for bit); every other route keeps DEFAULT_BLOCK.
+    Explicit values pass through untouched, and the traced twin below
+    only ever sees a concrete static int (a trace-time table lookup
+    would freeze pre-sweep knobs into the jit cache).
+    """
+    if block_size is None:
+        if engine == "onehot" and not vmap_records:
+            from cpgisland_tpu import tune
+
+            block_size = tune.default_block_size(
+                scores=return_score, legacy=DEFAULT_BLOCK
+            )
+        else:
+            block_size = DEFAULT_BLOCK
+    return _viterbi_parallel_batch_traced(
+        params, chunks, lengths, block_size=int(block_size),
+        return_score=return_score, engine=engine,
+        vmap_records=vmap_records,
+    )
+
+
 @partial(
     jax.jit,
     static_argnames=("block_size", "return_score", "engine", "vmap_records"),
 )
-def viterbi_parallel_batch(
+def _viterbi_parallel_batch_traced(
     params: HmmParams,
     chunks: jnp.ndarray,
     lengths: jnp.ndarray,
@@ -412,7 +447,7 @@ def viterbi_parallel_batch(
     engine: str = "xla",
     vmap_records: bool = False,
 ):
-    """Batched decode of a [N, T] batch of padded chunks.
+    """The compiled body of :func:`viterbi_parallel_batch`.
 
     Keeps viterbi_batch's masking contract: positions >= lengths[i] are
     force-masked to the PAD sentinel, so arbitrary tail content (zero-filled

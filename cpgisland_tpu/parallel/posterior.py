@@ -359,7 +359,7 @@ def posterior_sharded(
     placed=None,
     prev_sym: Optional[int] = None,
     prepared=None,
-    fused: bool = True,
+    fused: Optional[bool] = None,
     breaker=None,
 ):
     """Island confidence (and optional MPM path) for one sequence, sharded
@@ -369,7 +369,10 @@ def posterior_sharded(
     demotion (a serve Session passes its own; default process-global).
 
     ``fused`` (kernel engines): the r9 co-scheduled fwd/bwd pass; False
-    keeps the split 3-pass structure (the pass-fusion A/B arm).
+    keeps the split 3-pass structure (the pass-fusion A/B arm).  The
+    ``None`` default consults the graftune winner table
+    (``fused.posterior``) and falls back to the shipped True — explicit
+    values always win.
 
     ``prepared`` (from :func:`prepare_record_span`; single-device fused
     engines only): the span's symbol-only prep — the pass then runs the
@@ -387,6 +390,10 @@ def posterior_sharded(
     """
     if mesh is None:
         mesh = make_mesh(axis=SEQ_AXIS)
+    if fused is None:
+        from cpgisland_tpu import tune
+
+        fused = tune.default_fused("posterior")
     eng = resolve_fb_engine(engine, params, breaker=breaker)
     tt = t_tile if t_tile is not None else fb_pallas.DEFAULT_T_TILE
     T = int(np.asarray(obs).shape[0]) if placed is None else int(obs.shape[0])
@@ -498,7 +505,7 @@ def posterior_sharded_stacked(
     pad_to: Optional[int] = None,
     placed=None,
     prepared=None,
-    fused: bool = True,
+    fused: Optional[bool] = None,
 ):
     """STACKED island confidence (and optional MPM paths) for M reduced
     members over ONE record: every member's chains run in one stacked
@@ -512,6 +519,10 @@ def posterior_sharded_stacked(
     """
     if mesh is None:
         mesh = make_mesh(axis=SEQ_AXIS)
+    if fused is None:
+        from cpgisland_tpu import tune
+
+        fused = tune.default_fused("posterior")
     params_list = tuple(params_list)
     tt = t_tile if t_tile is not None else fb_pallas.DEFAULT_T_TILE
     T = int(np.asarray(obs).shape[0]) if placed is None else int(obs.shape[0])

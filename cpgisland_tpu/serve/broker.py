@@ -94,7 +94,10 @@ class BrokerConfig:
     per model; per-record paths equal the sequential flush modulo the flat
     decoder's pinned rounding-tie contract).  False = the sequential
     per-model arm everywhere — the A/B escape hatch, same pattern as the
-    kernel-level ``fused``/``stacked`` flags.
+    kernel-level ``fused``/``stacked`` flags.  The ``None`` default
+    consults the graftune winner table (``stacked.serve_decode``) at
+    config construction and falls back to the shipped True; an explicit
+    bool always wins.
     """
 
     flush_symbols: int = 8 << 20
@@ -105,7 +108,16 @@ class BrokerConfig:
     posterior_span: int = pipeline.POSTERIOR_SPAN
     min_len: Optional[int] = None
     island_states: Optional[tuple] = None
-    stacked: bool = True
+    stacked: Optional[bool] = None
+
+    def __post_init__(self):
+        if self.stacked is None:
+            from cpgisland_tpu import tune
+
+            # frozen dataclass: resolve the consulted default in place.
+            object.__setattr__(
+                self, "stacked", tune.default_stacked("serve_decode")
+            )
 
 
 @dataclasses.dataclass

@@ -100,6 +100,14 @@ def resolve_fb_engine(engine: str, params: HmmParams, mode: str) -> str:
                 resolved = "onehot"
             elif fb_pallas.supports(params):
                 resolved = "pallas"
+        # Tuned engine choice (graftune): a fresh applied winner inside
+        # the currently-eligible ladder overrides auto's hard-coded pick;
+        # absent/stale keeps it bit for bit.  Eligibility is never
+        # relaxed — a winner the model cannot run is refused in-domain.
+        from cpgisland_tpu import tune
+
+        eligible = {"xla", resolved}
+        resolved = tune.default_engine("fb_chunked", resolved, eligible)
         obs.engine_decision(
             site="train.resolve_fb_engine", choice=resolved,
             requested=engine, mode=mode,
@@ -223,14 +231,20 @@ class LocalBackend(EStepBackend):
     """Single-device vmap mapper + sum reducer.
 
     ``fuse_fb=False`` keeps the split (r4) fwd/bwd kernel structure on the
-    onehot routing — the pass-fusion A/B arm; everything else is the r9
-    co-scheduled default."""
+    onehot routing — the pass-fusion A/B arm; ``None`` (default) consults
+    the graftune winner table (``fused.em_chunked``) and falls back to
+    the shipped co-scheduled True; an explicit bool always wins."""
 
     def __init__(self, mode: str = "rescaled", engine: str = "auto",
-                 fuse_fb: bool = True):
+                 fuse_fb: Optional[bool] = None):
+        from cpgisland_tpu import tune
+
         self.mode = mode
         self.engine = engine
-        self.fuse_fb = bool(fuse_fb)
+        self.fuse_fb = (
+            tune.default_fused("em_chunked") if fuse_fb is None
+            else bool(fuse_fb)
+        )
 
     def prepare_streams(self, params, chunks, lengths):
         if isinstance(chunks, jax.core.Tracer):
@@ -658,10 +672,15 @@ class SeqBackend(EStepBackend):
         engine: str = "auto",
         lane_T: Optional[int] = None,
         t_tile: Optional[int] = None,
-        fuse_fb: bool = True,
+        fuse_fb: Optional[bool] = None,
     ):
+        from cpgisland_tpu import tune
+
         _check_seq_engine(engine)
-        self.fuse_fb = bool(fuse_fb)
+        self.fuse_fb = (
+            tune.default_fused("em_seq") if fuse_fb is None
+            else bool(fuse_fb)
+        )
         self.mesh = mesh if mesh is not None else make_mesh(axis=axis)
         self.block_size = block_size if block_size is not None else fb_sharded.DEFAULT_BLOCK
         self.axis = self.mesh.axis_names[0]
@@ -671,11 +690,15 @@ class SeqBackend(EStepBackend):
         self.pad_value = pad_value
         # auto: fused kernels on big-enough TPU shards, XLA lanes otherwise;
         # xla / pallas force one lowering.  lane_T / t_tile tune the fused
-        # kernels (default: fb_pallas.pick_lane_T by shard size /
-        # DEFAULT_T_TILE).
+        # kernels (default: fb_pallas.pick_lane_T by shard size / the
+        # graftune ``t_tile.em_seq`` winner falling back to
+        # DEFAULT_T_TILE); explicit values always win.
         self.engine = engine
         self.lane_T = lane_T
-        self.t_tile = t_tile if t_tile is not None else fb_pallas.DEFAULT_T_TILE
+        self.t_tile = (
+            t_tile if t_tile is not None
+            else tune.default_t_tile("em_seq", fb_pallas.DEFAULT_T_TILE)
+        )
 
     def prepare(self, chunked: chunking.Chunked) -> chunking.Chunked:
         """Re-frame any chunk batch as one stream sharded across the mesh."""
@@ -1096,14 +1119,31 @@ class FamilyEStep:
     (family.reduced_stats_eligible — one-hot partition, pow2 alphabet)
     with a shared alphabet, inside the reduced state envelope.
     ``fuse_fb=False`` keeps the split (r4-shaped) chain structure per
-    member — the A/B arm, same knob as LocalBackend.
+    member — the A/B arm, same knob as LocalBackend.  ``stacked=False``
+    runs M sequential single-model E-steps instead of the one stacked
+    launch set (bit-identical per member — the pinned contract — just
+    M passes' fixed cost): the multi-model A/B escape hatch.  Both
+    ``None`` defaults consult the graftune winner table
+    (``fused.em_family`` / ``stacked.em_family``) and fall back to the
+    shipped True; explicit bools always win.
     """
 
-    def __init__(self, t_tile: Optional[int] = None, fuse_fb: bool = True):
+    def __init__(self, t_tile: Optional[int] = None,
+                 fuse_fb: Optional[bool] = None,
+                 stacked: Optional[bool] = None):
+        from cpgisland_tpu import tune
+
         self.t_tile = (
             t_tile if t_tile is not None else fb_pallas.DEFAULT_T_TILE
         )
-        self.fuse_fb = bool(fuse_fb)
+        self.fuse_fb = (
+            tune.default_fused("em_family") if fuse_fb is None
+            else bool(fuse_fb)
+        )
+        self.stacked = (
+            tune.default_stacked("em_family") if stacked is None
+            else bool(stacked)
+        )
 
     def validate(self, params_list) -> None:
         from cpgisland_tpu.family import partition as family_partition
@@ -1138,6 +1178,22 @@ class FamilyEStep:
         params_list = tuple(params_list)
         self.validate(params_list)
         chunks, lengths = jnp.asarray(chunks), jnp.asarray(lengths)
+        if not self.stacked:
+            # The sequential A/B arm: M single-model reduced E-steps over
+            # the same placed batch — per-member statistics BIT-IDENTICAL
+            # to the stacked launch (the tests' pinned contract), at M
+            # pass sets' fixed cost.
+            obs.engine_decision(
+                site="family_estep", choice="onehot.sequential",
+                n_members=len(params_list),
+            )
+            return tuple(
+                fb_pallas.batch_stats_pallas(
+                    p, chunks, lengths, t_tile=self.t_tile, onehot=True,
+                    fused=self.fuse_fb,
+                )
+                for p in params_list
+            )
         prep = self.prepare_streams(params_list, chunks, lengths)
         obs.engine_decision(
             site="family_estep", choice="onehot.stacked",

@@ -1,0 +1,172 @@
+"""graftune — the fingerprint-keyed knob autotuner (ROADMAP item 1).
+
+Three layers:
+
+- :mod:`~cpgisland_tpu.tune.table` — the versioned winner table
+  (``TUNING.json``): per-platform sections, winners keyed by (task,
+  platform, pow2 geometry bucket, S, stacked M) and stamped with the
+  COSTS.json kernel-structure fingerprint of the entries they were swept
+  through.  A kernel reshape drifts the fingerprint and every dependent
+  winner goes STALE automatically.
+- :mod:`~cpgisland_tpu.tune.sweep` + :mod:`~cpgisland_tpu.tune.tasks` —
+  the sweep driver (``tools/graftune.py``): enumerate knob tuples per
+  kernel family, prune through ``memmodel.feasible`` BEFORE any compile
+  (ledger-asserted), parity-gate every survivor against the current
+  default arm, time with the full bench discipline, persist winners.
+- this module — **router consultation**.  Every helper here takes the
+  routing site's LEGACY default and returns it bit-for-bit unless a
+  fresh, applied, in-domain winner matches; explicit caller kwargs never
+  reach these helpers at all (explicit always wins).  Fresh hits emit
+  ``tune_pick``; matching-but-stale entries emit ``tune_stale`` with the
+  drift reason; absent stays silent (the hot-path default).
+
+Consulting sites: ``fb_pallas.pick_lane_T`` (lane_T, + the
+generation-keyed feasibility-filter cache), the per-path ``fused``
+defaults (train backends, parallel posterior), the per-path ``stacked``
+defaults (family.compare, serve broker, FamilyEStep), SeqBackend's
+``t_tile``, ``decode_batch_flat``'s block_size, and
+``resolve_fb_engine``'s auto branch.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+from cpgisland_tpu.tune import table
+from cpgisland_tpu.tune.table import (  # noqa: F401  (re-exported API)
+    TuneDecision,
+    costs_fingerprint,
+    default_table_path,
+    entry_key,
+    generation,
+    load_table,
+    lookup,
+    pow2_bucket,
+    set_table_path,
+    table_report,
+    write_entries,
+)
+
+
+def _emit(decision: table.TuneDecision, task: str, **fields) -> None:
+    from cpgisland_tpu import obs
+
+    if decision.fresh:
+        obs.event(
+            "tune_pick", _dedupe=True, task=task, key=decision.key,
+            value=decision.value, **fields,
+        )
+    elif decision.status == "stale":
+        obs.event(
+            "tune_stale", _dedupe=True, task=task, key=decision.key,
+            reason=decision.reason, **fields,
+        )
+
+
+@functools.lru_cache(maxsize=256)
+def _sweepable_cached(task: str, value, _table_gen: int) -> bool:
+    try:
+        from cpgisland_tpu.tune import sweep
+
+        sweep.validate_entry(task, value)
+        return True
+    except Exception:
+        return False
+
+
+def _sweepable(task: str, value) -> bool:
+    """Is ``value`` something the sweep could have legitimately written
+    for ``task``?  Membership in the task's candidate domain + the
+    graftmem feasibility oracle — the same gate ``--apply`` runs
+    (sweep.validate_entry), reused router-side so a hand-corrupted table
+    row can never route.  lru-cached per table generation: consultation
+    sits on per-record routing paths (decode_batch_flat's default), and
+    rebuilding the task registry + footprint model per call is the exact
+    per-call cost the pick_lane_T cache exists to avoid."""
+    try:
+        hash(value)
+    except TypeError:
+        return False
+    return _sweepable_cached(task, value, table.generation())
+
+
+def _consult(
+    task: str, legacy, *, domain=None, validator=None, n=None, S=None, M=1
+):
+    """The one fallback rule: fresh + in-domain -> winner, else legacy."""
+    d = table.lookup(task, n=n, S=S, M=M)
+    if d.fresh and (
+        (domain is not None and d.value not in domain)
+        or (validator is not None and not validator(d.value))
+    ):
+        # A winner outside the router's legal domain (a planted lane_T=8,
+        # a corrupt block size, an engine the model is not eligible for)
+        # must never route — the sweep's parity gate rejects these at
+        # apply time, and the router refuses them defensively too.
+        d = table.TuneDecision(
+            status="stale", key=d.key, entry=d.entry,
+            reason=f"winner {d.value!r} outside the router domain",
+        )
+    _emit(d, task)
+    if d.fresh:
+        return d.value
+    return legacy
+
+
+def tuned_lane_T(
+    n: int, onehot: bool, long_lanes: bool, candidates
+) -> Optional[int]:
+    """Winner lane length for this input's pow2 bucket, or None for the
+    legacy rate-table minimization.  ``candidates`` is the feasible rate
+    table — a winner outside it (absurd, or newly infeasible after a
+    memmodel recalibration) is refused."""
+    task = "lane." + ("onehot" if onehot else "dense") + (
+        ".long" if long_lanes else ""
+    )
+    got = _consult(task, None, domain=set(candidates), n=n)
+    return got
+
+
+def default_fused(path: str, legacy: bool = True) -> bool:
+    """Per-path r9 pass-fusion default: ``posterior`` | ``em_seq`` |
+    ``em_chunked`` | ``em_family``."""
+    return bool(_consult(f"fused.{path}", legacy, domain=(True, False)))
+
+
+def default_stacked(site: str, legacy: bool = True) -> bool:
+    """Per-site multi-model stacking default: ``compare`` |
+    ``serve_decode`` | ``em_family`` | ``posterior``."""
+    return bool(_consult(f"stacked.{site}", legacy, domain=(True, False)))
+
+
+def default_block_size(
+    scores: bool = False, stacked_m: int = 1, legacy: int = 4096
+) -> int:
+    """Flat-decode step-block default (decode_batch_flat's bk).
+
+    The sweep writes ONE winner per variant at M=1 (the single-model flat
+    stream is the swept geometry), so stacked launches adopt that same
+    winner — ``viterbi_onehot._stacked_block_for`` then clamps it to the
+    M-member VMEM cap on TPU exactly as it clamps the hard-coded default
+    (``stacked_m`` stays a parameter for the obs trail and future
+    M-keyed sweeps)."""
+    del stacked_m
+    task = "flat.block" + (".scores" if scores else "")
+    return int(_consult(
+        task, legacy, validator=lambda v: _sweepable(task, v),
+    ))
+
+
+def default_t_tile(path: str, legacy: int) -> int:
+    """Per-path lane-kernel time tile (the fb grid's t_tile knob)."""
+    task = f"t_tile.{path}"
+    return int(_consult(
+        task, legacy, validator=lambda v: _sweepable(task, v),
+    ))
+
+
+def default_engine(path: str, legacy: str, eligible) -> str:
+    """Tuned engine choice for an ``auto`` resolution, constrained to the
+    currently-eligible ladder (a winner the model cannot run is refused)."""
+    return str(_consult(f"engine.{path}", legacy, domain=set(eligible)))

@@ -1,0 +1,406 @@
+"""graftune — the versioned knob-winner table (``TUNING.json``).
+
+The autotuner's persistence half, the COSTS.json/MEMORY.json workflow
+verbatim: a committed lockfile with per-platform sections, re-baselined
+by ``tools/graftune.py --update-tune`` after a verified sweep, stale
+entries reported like stale waivers (``python -m cpgisland_tpu.analysis
+--tune``).
+
+**What a winner is.**  One swept knob decision — a lane length, a time
+tile, a flat-decode block size, a per-path ``fused``/``stacked`` boolean,
+an engine choice — keyed by (task, platform, pow2 geometry bucket, S,
+stacked M) and stamped with the **kernel-structure fingerprint** of the
+COSTS.json entries the sweep timed through.  That stamp is the whole
+point: the "re-sweep tile knobs after kernel-structure changes;
+swept-once conclusions rot" lesson has bitten three times (r3->r4 lanes,
+the r9 fused kernel, the seq2d caps), so a kernel reshape that drifts
+COSTS.json automatically flips every dependent winner to STALE — the
+routers fall back to the hard-coded defaults bit-for-bit and the next
+``graftune --all`` re-earns the knobs, instead of a human remembering to.
+
+**Applied vs recorded.**  Every winner row carries ``applied``: routers
+honor only applied rows.  A sweep on the capturing TPU applies its
+winners; a CPU sweep records rates as *projections* (``projection:
+true``) and applies only values equal to the legacy default — a serial
+machine's timings must never flip a chip knob (the BASELINE.md decision
+rule, now enforced in code instead of prose).
+
+No jax at module level (routers consult this at runtime from ops/);
+platform detection imports jax lazily.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+from dataclasses import dataclass, field
+from typing import Optional
+
+TUNING_VERSION = 1
+LOCKFILE_NAME = "TUNING.json"
+# Test/process-isolation hook: point the whole consultation machinery at a
+# different table (or at a nonexistent path for the legacy-defaults arm).
+ENV_PATH = "CPGISLAND_TUNING_FILE"
+
+# Relative throughput advantage a measured winner needs before a flip is
+# applied over the legacy default — ties and noise keep the shipped knob
+# (re-measure before trusting a regression; CLAUDE.md relay notes).
+FLIP_MARGIN = 0.03
+
+
+def _repo_root() -> str:
+    pkg = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    return os.path.dirname(pkg)
+
+
+def default_table_path() -> str:
+    env = os.environ.get(ENV_PATH)
+    if env:
+        return env
+    return os.path.join(_repo_root(), LOCKFILE_NAME)
+
+
+def default_costs_path() -> str:
+    from cpgisland_tpu.analysis import cost_contracts
+
+    return cost_contracts.default_lockfile_path()
+
+
+# -- the in-process cache + generation counter --------------------------------
+#
+# The table is consulted on hot routing paths (pick_lane_T runs per placed
+# shard), so loads are cached by (path, mtime).  The GENERATION bumps on
+# every cache refresh — including an in-process --update-tune write — and
+# pick_lane_T's lru-cached feasibility filter keys on it, so a sweep that
+# lands mid-session invalidates every cached pre-sweep lane choice instead
+# of serving them for the rest of the process.
+
+_override_path: Optional[str] = None
+_cache: dict = {"path": None, "mtime": None, "data": None, "gen": 0}
+
+
+def set_table_path(path: Optional[str]) -> None:
+    """Process-local override of the table location (tests; None resets)."""
+    global _override_path
+    _override_path = path
+
+
+def _table_path(path: Optional[str] = None) -> str:
+    if path is not None:
+        return path
+    if _override_path is not None:
+        return _override_path
+    return default_table_path()
+
+
+def _mtime(path: str) -> int:
+    try:
+        return os.stat(path).st_mtime_ns
+    except OSError:
+        return -1
+
+
+def load_table(path: Optional[str] = None) -> Optional[dict]:
+    """The cached table dict, or None when the file does not exist."""
+    p = _table_path(path)
+    m = _mtime(p)
+    if _cache["path"] != p or _cache["mtime"] != m:
+        data = None
+        if m >= 0:
+            try:
+                with open(p, "r", encoding="utf-8") as fh:
+                    data = json.load(fh)
+            except (OSError, ValueError):
+                data = None
+        _cache.update(path=p, mtime=m, data=data, gen=_cache["gen"] + 1)
+    return _cache["data"]
+
+
+def generation() -> int:
+    """Monotone counter that moves whenever the consulted table changes
+    (path switch, on-disk edit, in-process write) — the cache key the
+    routing-side lru caches fold in."""
+    load_table()
+    return _cache["gen"]
+
+
+# -- the kernel-structure fingerprint -----------------------------------------
+
+
+_fp_cache: dict = {}
+
+
+def costs_fingerprint(
+    entry_names, costs_path: Optional[str] = None
+) -> str:
+    """Stable digest of the named COSTS.json entries — the staleness key.
+
+    The cpu section is the canonical structure (the CPU XLA twins are
+    arithmetic-identical to the chip kernels and always captured); a
+    missing entry digests as ``missing`` so removing or renaming a cost
+    entry stales its dependents exactly like reshaping it would."""
+    cp = costs_path or default_costs_path()
+    names = tuple(entry_names)
+    key = (cp, _mtime(cp), names)
+    hit = _fp_cache.get(key)
+    if hit is not None:
+        return hit
+    try:
+        with open(cp, "r", encoding="utf-8") as fh:
+            lock = json.load(fh)
+    except (OSError, ValueError):
+        lock = {}
+    platforms = lock.get("platforms", {})
+    section = platforms.get("cpu")
+    if section is None and platforms:
+        section = platforms[sorted(platforms)[0]]
+    entries = (section or {}).get("entries", {})
+    h = hashlib.sha256()
+    for name in names:
+        e = entries.get(name)
+        canon = "missing" if e is None else json.dumps(e, sort_keys=True)
+        h.update(name.encode())
+        h.update(b"\0")
+        h.update(canon.encode())
+        h.update(b"\1")
+    fp = "sha256:" + h.hexdigest()[:16]
+    if len(_fp_cache) > 256:
+        _fp_cache.clear()
+    _fp_cache[key] = fp
+    return fp
+
+
+# -- keys and entries ---------------------------------------------------------
+
+
+def pow2_bucket(n: int) -> int:
+    """The geometry bucket of an ``n``-symbol input — the same pow2 class
+    the ``lane_geometry`` obs event dedupes on."""
+    return 1 << max(int(n) - 1, 0).bit_length()
+
+
+def entry_key(
+    task: str,
+    n_pow2: Optional[int] = None,
+    S: Optional[int] = None,
+    M: int = 1,
+) -> str:
+    """Canonical winner key.  ``None`` fields are wildcards: a boolean
+    fused/stacked verdict applies across geometries, a lane winner binds
+    to its swept pow2 bucket."""
+    return (
+        f"{task}|n={n_pow2 if n_pow2 else '*'}"
+        f"|S={S if S else '*'}|M={M}"
+    )
+
+
+def make_entry(
+    task: str,
+    value,
+    *,
+    legacy,
+    costs_entries,
+    applied: bool,
+    projection: bool,
+    rate_msym_s: Optional[float] = None,
+    baseline_msym_s: Optional[float] = None,
+    ratio: Optional[float] = None,
+    parity: Optional[dict] = None,
+    verdict: Optional[dict] = None,
+    swept: Optional[list] = None,
+    pruned: Optional[list] = None,
+    costs_path: Optional[str] = None,
+) -> dict:
+    """One winner row, fingerprint-stamped against the CURRENT COSTS.json."""
+    return {
+        "task": task,
+        "value": value,
+        "legacy": legacy,
+        "applied": bool(applied),
+        "projection": bool(projection),
+        "rate_msym_s": rate_msym_s,
+        "baseline_msym_s": baseline_msym_s,
+        "ratio": ratio,
+        "parity": parity,
+        "verdict": verdict,
+        "swept": swept or [],
+        "pruned": pruned or [],
+        "costs_entries": sorted(costs_entries),
+        "costs_fingerprint": costs_fingerprint(
+            sorted(costs_entries), costs_path
+        ),
+    }
+
+
+def write_entries(
+    entries: dict,
+    platform: Optional[str] = None,
+    path: Optional[str] = None,
+) -> str:
+    """Merge winner rows into the platform section (atomic, the lockfile
+    write shape of cost_contracts/mem_contracts) and bump the generation."""
+    if platform is None:
+        import jax
+
+        platform = jax.default_backend()
+    p = _table_path(path)
+    data = load_table(p) or {
+        "version": TUNING_VERSION,
+        "flip_margin": FLIP_MARGIN,
+        "platforms": {},
+    }
+    section = data["platforms"].setdefault(platform, {"entries": {}})
+    try:
+        import jax
+
+        section["jax"] = jax.__version__
+    except Exception:  # pragma: no cover - jax is always importable here
+        pass
+    section.setdefault("entries", {}).update(entries)
+    tmp = p + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as fh:
+        json.dump(data, fh, indent=1, sort_keys=True)
+        fh.write("\n")
+    os.replace(tmp, p)
+    load_table(p)  # refresh the cache (and bump the generation) now
+    return p
+
+
+# -- lookup -------------------------------------------------------------------
+
+
+@dataclass
+class TuneDecision:
+    """One consultation's verdict: ``fresh`` (applied winner, fingerprint
+    current), ``stale`` (winner exists but its kernel structure drifted,
+    it is unapplied, or its value is out of domain), or ``absent``."""
+
+    status: str                # "fresh" | "stale" | "absent"
+    value: object = None
+    key: str = ""
+    reason: str = ""
+    entry: Optional[dict] = field(default=None, repr=False)
+
+    @property
+    def fresh(self) -> bool:
+        return self.status == "fresh"
+
+
+def _platform(platform: Optional[str]) -> str:
+    if platform is not None:
+        return platform
+    import jax
+
+    return jax.default_backend()
+
+
+def _check_entry(
+    entry: dict, key: str, costs_path: Optional[str]
+) -> TuneDecision:
+    fp_now = costs_fingerprint(
+        entry.get("costs_entries", []), costs_path
+    )
+    if entry.get("costs_fingerprint") != fp_now:
+        return TuneDecision(
+            status="stale", key=key, entry=entry,
+            reason=(
+                f"kernel-structure fingerprint drifted "
+                f"({entry.get('costs_fingerprint')} -> {fp_now}; "
+                f"dependent cost entries: "
+                f"{entry.get('costs_entries', [])}) — re-sweep with "
+                "tools/graftune.py"
+            ),
+        )
+    if not entry.get("applied", False):
+        return TuneDecision(
+            status="stale", key=key, entry=entry,
+            reason="recorded but not applied (projection sweep — the "
+            "winner waits for a capture-platform run)",
+        )
+    return TuneDecision(
+        status="fresh", key=key, entry=entry, value=entry.get("value"),
+    )
+
+
+def lookup(
+    task: str,
+    *,
+    platform: Optional[str] = None,
+    n: Optional[int] = None,
+    S: Optional[int] = None,
+    M: int = 1,
+    path: Optional[str] = None,
+    costs_path: Optional[str] = None,
+) -> TuneDecision:
+    """Find the winner for a routing site.  Tries the exact pow2 bucket of
+    ``n`` first, then the wildcard-geometry key; absent/stale results
+    carry the reason the caller's obs event reports."""
+    data = load_table(path)
+    if data is None:
+        return TuneDecision(status="absent", reason="no tuning table")
+    section = data.get("platforms", {}).get(_platform(platform))
+    if section is None:
+        return TuneDecision(
+            status="absent", reason="no section for this platform"
+        )
+    entries = section.get("entries", {})
+    keys = []
+    if n is not None:
+        keys.append(entry_key(task, pow2_bucket(n), S, M))
+    keys.append(entry_key(task, None, S, M))
+    stale: Optional[TuneDecision] = None
+    for key in keys:
+        e = entries.get(key)
+        if e is None:
+            continue
+        d = _check_entry(e, key, costs_path)
+        if d.fresh:
+            return d
+        stale = stale or d
+    if stale is not None:
+        return stale
+    return TuneDecision(status="absent", reason="no matching winner")
+
+
+# -- reporting (analysis --tune / bench extras) -------------------------------
+
+
+def table_report(
+    platform: Optional[str] = None,
+    path: Optional[str] = None,
+    costs_path: Optional[str] = None,
+) -> dict:
+    """Fresh/stale census of one platform section — the ``--tune`` diff
+    and bench --extended's ``tuning_table_fresh`` extra.  Stale rows are
+    named with their drift reason, the stale-waiver UX."""
+    data = load_table(path)
+    plat = _platform(platform)
+    out: dict = {
+        "platform": plat, "fresh": 0, "stale": 0, "entries": 0,
+        "stale_entries": [], "path": _table_path(path),
+    }
+    if data is None:
+        out["note"] = (
+            f"no {LOCKFILE_NAME} — routers run the hard-coded defaults; "
+            "baseline with tools/graftune.py --update-tune"
+        )
+        return out
+    section = data.get("platforms", {}).get(plat)
+    if section is None:
+        out["note"] = (
+            f"no '{plat}' section (captured: "
+            f"{sorted(data.get('platforms', {}))}) — routers run the "
+            "hard-coded defaults on this platform"
+        )
+        return out
+    for key in sorted(section.get("entries", {})):
+        e = section["entries"][key]
+        out["entries"] += 1
+        d = _check_entry(e, key, costs_path)
+        if d.fresh:
+            out["fresh"] += 1
+        else:
+            out["stale"] += 1
+            out["stale_entries"].append({"key": key, "reason": d.reason})
+    return out

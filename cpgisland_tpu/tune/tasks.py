@@ -1,0 +1,845 @@
+"""graftune tasks — one sweep definition per kernel-family knob.
+
+Each :class:`Task` names the knob, its legal candidate domain, the
+``memmodel`` feasibility check that prunes candidates BEFORE any compile,
+the parity gate that compares every survivor against the current default
+arm BEFORE any timing, and the chained-timing program (the bench.py relay
+discipline: R data-dependent reps inside one ``lax.scan``, a distinct
+seed folded into every rep's params/input, every rep fetching a small
+output).
+
+The task set subsumes the hand-driven chip-window harnesses: the
+``fused.*`` booleans are tools/bench_passfusion.py's A/B decisions, the
+``stacked.*`` booleans are tools/bench_multimodel.py's, and the lane /
+t_tile / block_size sweeps are the "re-sweep tile knobs after kernel
+reshapes" obligation — one ``tools/graftune.py --all`` run per TPU
+window instead of three harnesses plus hand-edited defaults.
+
+Everything imports jax lazily: task construction is metadata-only (the
+CLI lists tasks without a backend).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Optional
+
+# Parity tolerances per output class (the test-suite's own gates).
+CONF_TOL = 2e-5          # posterior confidence tracks
+STATS_REL_TOL = 1e-4     # EM sufficient statistics, relative
+SCORE_REL_TOL = 1e-4     # per-record Viterbi scores, relative
+PATH_MISMATCH_MAX = 1e-3  # path positions allowed to differ (tie class)
+
+
+@dataclasses.dataclass
+class SweepConfig:
+    """One sweep invocation's geometry/discipline knobs."""
+
+    n: int = 2 << 20          # symbols per timed input
+    chain: int = 2            # data-dependent reps inside one lax.scan
+    reps: int = 2             # wall repetitions (min taken)
+    members: int = 3          # stacked-arm member count
+    smoke: bool = False
+
+
+@dataclasses.dataclass
+class Task:
+    """One sweep task.  ``candidates`` includes the legacy value; the
+    driver prunes via ``feasibility``, parity-gates survivors against the
+    ``legacy`` arm's output, times them, and derives the verdict."""
+
+    name: str
+    family: str                       # "fb.reduced" | "decode.flat" | ...
+    costs_entries: tuple              # COSTS.json staleness dependencies
+    legacy: Callable                  # cfg -> legacy value
+    candidates: Callable              # cfg -> [value, ...]
+    feasibility: Callable             # (value, cfg) -> Feasibility | None
+    build: Callable                   # cfg -> env dict (params, inputs)
+    run_once: Callable                # (env, value) -> comparable output
+    parity_err: Callable              # (ref_out, out) -> float
+    parity_tol: float
+    make_chained: Callable            # (env, value, cfg) -> fn(seed)->float
+    ceiling_key: str                  # obs.watchdog path ceiling name
+    bucketed: bool = False            # key on the pow2 geometry bucket
+    n_states: Optional[int] = None    # S key field (None = wildcard)
+
+
+def _params():
+    from cpgisland_tpu.models import presets
+
+    return presets.durbin_cpg8()
+
+
+def _member_params(m: int):
+    """M reduced-eligible members over one alphabet: the flagship preset
+    with per-member prior perturbations (emission structure — the
+    routing key — is untouched)."""
+    import dataclasses as dc
+
+    import jax.numpy as jnp
+
+    base = _params()
+    return tuple(
+        dc.replace(base, log_pi=base.log_pi - jnp.float32(i) * 1e-4)
+        for i in range(m)
+    )
+
+
+def _jitter(p, s):
+    """Params-side distinct-seed fold (full seed, no modulus — a wrapped
+    jitter hands the relay a byte-identical repeat; bench_passfusion)."""
+    import dataclasses as dc
+
+    import jax.numpy as jnp
+
+    return dc.replace(p, log_pi=p.log_pi - s.astype(jnp.float32) * 1e-7)
+
+
+def _obs_stream(n: int, seed: int = 1):
+    import numpy as np
+
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(seed)
+    return jnp.asarray(
+        rng.integers(0, 4, size=n, dtype=np.int32).astype(np.uint8)
+    )
+
+
+def _island_mask8():
+    import numpy as np
+
+    import jax.numpy as jnp
+
+    return jnp.asarray(np.r_[np.ones(4), np.zeros(4)].astype(np.float32))
+
+
+def _stats_rel_err(a, b) -> float:
+    import jax.numpy as jnp
+
+    return float(
+        jnp.max(
+            jnp.abs(a.trans - b.trans)
+            / jnp.maximum(jnp.abs(a.trans), 1e-3)
+        )
+    )
+
+
+# -- lane_T (reduced FB family) ----------------------------------------------
+
+
+def _lane_task() -> Task:
+    def legacy(cfg):
+        from cpgisland_tpu.ops import fb_pallas
+
+        return fb_pallas.legacy_lane_T(cfg.n, onehot=True, long_lanes=True)
+
+    def candidates(cfg):
+        from cpgisland_tpu.ops import fb_pallas
+
+        return [k for k in sorted(fb_pallas._LANE_RATE_ONEHOT)]
+
+    def feas(value, cfg):
+        from cpgisland_tpu.analysis import memmodel
+        from cpgisland_tpu.ops.fb_onehot import TUNE_KERNELS
+
+        k = memmodel.Knobs(lane_tile=256, lane_T=int(value))
+        return memmodel.feasible(TUNE_KERNELS["em_seq"], k)
+
+    def build(cfg):
+        return {
+            "params": _params(),
+            "obs": _obs_stream(cfg.n),
+            "mask": _island_mask8(),
+        }
+
+    def run_once(env, value):
+        from cpgisland_tpu.ops import fb_pallas
+
+        conf, _ = fb_pallas.seq_posterior_pallas(
+            env["params"], env["obs"], env["obs"].shape[0], env["mask"],
+            lane_T=int(value), onehot=True,
+        )
+        return conf
+
+    def parity_err(ref, out):
+        import jax.numpy as jnp
+
+        return float(jnp.max(jnp.abs(ref - out)))
+
+    def make_chained(env, value, cfg):
+        import jax
+        import jax.numpy as jnp
+
+        from cpgisland_tpu.ops import fb_pallas
+
+        n = env["obs"].shape[0]
+
+        @jax.jit
+        def chained(p, obs, s):
+            p = _jitter(p, s)
+
+            def body(c, _):
+                conf, _ = fb_pallas.seq_posterior_pallas(
+                    p, obs, n, env["mask"] + c * 0.0,
+                    lane_T=int(value), onehot=True,
+                )
+                return jnp.sum(conf[:8]) * 1e-9, None
+
+            c, _ = jax.lax.scan(
+                body, jnp.float32(0), None, length=cfg.chain
+            )
+            return c
+
+        return lambda s: float(
+            jax.device_get(chained(env["params"], env["obs"], jnp.int32(s)))
+        )
+
+    return Task(
+        name="lane.onehot.long", family="fb.reduced",
+        costs_entries=("posterior.onehot", "em.seq.onehot"),
+        legacy=legacy, candidates=candidates, feasibility=feas,
+        build=build, run_once=run_once, parity_err=parity_err,
+        parity_tol=CONF_TOL, make_chained=make_chained,
+        ceiling_key="posterior", bucketed=True,
+    )
+
+
+# -- t_tile (reduced FB exact-seq family) ------------------------------------
+
+
+def _t_tile_seq_task() -> Task:
+    def legacy(cfg):
+        from cpgisland_tpu.ops import fb_pallas
+
+        return fb_pallas.DEFAULT_T_TILE
+
+    def candidates(cfg):
+        # 4096 exists to be PRUNED: the seq-stats alphas2/betas2 stream
+        # blocks alone outgrow the VMEM model there (the ledger's proof
+        # that rejected tuples never reach compile).
+        return [256, 512, 1024, 4096]
+
+    def feas(value, cfg):
+        from cpgisland_tpu.analysis import memmodel
+        from cpgisland_tpu.ops.fb_onehot import TUNE_KERNELS
+
+        k = memmodel.Knobs(lane_tile=256, t_tile=int(value))
+        return memmodel.feasible(TUNE_KERNELS["em_seq"], k)
+
+    def _lane(cfg):
+        from cpgisland_tpu.ops import fb_pallas
+
+        return fb_pallas.legacy_lane_T(cfg.n, onehot=True, long_lanes=True)
+
+    def build(cfg):
+        return {
+            "params": _params(),
+            "obs": _obs_stream(cfg.n, seed=2),
+            "lane_T": _lane(cfg),
+        }
+
+    def run_once(env, value):
+        from cpgisland_tpu.ops import fb_pallas
+
+        return fb_pallas.seq_stats_pallas(
+            env["params"], env["obs"], env["obs"].shape[0],
+            lane_T=env["lane_T"], t_tile=int(value), onehot=True,
+        )
+
+    def make_chained(env, value, cfg):
+        import jax
+        import jax.numpy as jnp
+
+        from cpgisland_tpu.ops import fb_pallas
+
+        n = env["obs"].shape[0]
+
+        @jax.jit
+        def chained(p, obs, s):
+            p = _jitter(p, s)
+
+            def body(c, _):
+                st = fb_pallas.seq_stats_pallas(
+                    p, obs, n, lane_T=env["lane_T"], t_tile=int(value),
+                    onehot=True,
+                )
+                return c + st.loglik * 1e-9, None
+
+            c, _ = jax.lax.scan(
+                body, jnp.float32(0), None, length=cfg.chain
+            )
+            return c
+
+        return lambda s: float(
+            jax.device_get(chained(env["params"], env["obs"], jnp.int32(s)))
+        )
+
+    return Task(
+        name="t_tile.em_seq", family="fb.reduced",
+        costs_entries=("em.seq.onehot",),
+        legacy=legacy, candidates=candidates, feasibility=feas,
+        build=build, run_once=run_once, parity_err=_stats_rel_err,
+        parity_tol=STATS_REL_TOL, make_chained=make_chained,
+        ceiling_key="em-seq",
+    )
+
+
+# -- flat-decode block size ---------------------------------------------------
+
+
+def _flat_geometry(cfg):
+    import numpy as np
+
+    import jax.numpy as jnp
+
+    T = 4096 if cfg.smoke else 16384
+    N = max(4, cfg.n // T)
+    rng = np.random.default_rng(4)
+    chunks = jnp.asarray(
+        rng.integers(0, 4, size=(N, T), dtype=np.int32).astype(np.uint8)
+    )
+    lengths = jnp.full(N, T, jnp.int32)
+    return chunks, lengths
+
+
+def _flat_block_task(scores: bool) -> Task:
+    def legacy(cfg):
+        return 4096
+
+    def candidates(cfg):
+        # 16384 exists to be pruned: the score rows (dmax) and the
+        # backtrace path_out both outgrow the VMEM model there, while the
+        # flat route's own modeled cap sits at 8192 — one notch above the
+        # vmap route's measured bk>=8192 failure (test_graftmem pins the
+        # distinction).
+        return [1024, 2048, 4096, 8192, 16384]
+
+    def feas(value, cfg):
+        from cpgisland_tpu.analysis import memmodel
+
+        return memmodel.flat_block_feasibility(int(value), scores=scores)
+
+    def build(cfg):
+        chunks, lengths = _flat_geometry(cfg)
+        return {"params": _params(), "chunks": chunks, "lengths": lengths}
+
+    def run_once(env, value):
+        from cpgisland_tpu.ops import viterbi_onehot as OH
+
+        return OH.decode_batch_flat(
+            env["params"], env["chunks"], env["lengths"],
+            block_size=int(value), return_score=scores,
+        )
+
+    def parity_err(ref, out):
+        import numpy as np
+
+        if scores:
+            p_ref, s_ref = ref
+            p_out, s_out = out
+            rel = float(
+                np.max(
+                    np.abs(np.asarray(s_ref) - np.asarray(s_out))
+                    / np.maximum(np.abs(np.asarray(s_ref)), 1.0)
+                )
+            )
+        else:
+            p_ref, p_out, rel = ref, out, 0.0
+        mism = float(
+            np.mean(np.asarray(p_ref) != np.asarray(p_out))
+        )
+        # Path positions may move only on exact max-plus ties (the flat
+        # decoder's pinned rounding-tie contract); scores must agree.
+        # Both gates normalize to the task's shared tolerance: the result
+        # crosses parity_tol iff either crosses its own bound.
+        tol = min(SCORE_REL_TOL, PATH_MISMATCH_MAX)
+        return max(rel / SCORE_REL_TOL, mism / PATH_MISMATCH_MAX) * tol
+
+    def make_chained(env, value, cfg):
+        import jax
+        import jax.numpy as jnp
+
+        from cpgisland_tpu.ops import viterbi_onehot as OH
+
+        chunks, lengths = env["chunks"], env["lengths"]
+        T = chunks.shape[1]
+        P = min(8191, T - 2)
+
+        @jax.jit
+        def chained(ch, s):
+            pos = 1 + (s * 7) % P
+            ch = ch.at[0, pos].set(
+                ((ch[0, pos].astype(jnp.int32) + 1 + s // P) % 4)
+                .astype(ch.dtype)
+            )
+
+            def body(c, _):
+                got = OH.decode_batch_flat(
+                    env["params"], ch, lengths,
+                    block_size=int(value), return_score=scores,
+                )
+                paths = got[0] if scores else got
+                return c + jnp.sum(paths[:, :8]).astype(jnp.float32) * 1e-9, None
+
+            c, _ = jax.lax.scan(
+                body, jnp.float32(0), None, length=cfg.chain
+            )
+            return c
+
+        return lambda s: float(jax.device_get(chained(chunks, jnp.int32(s))))
+
+    return Task(
+        name="flat.block" + (".scores" if scores else ""),
+        family="decode.flat",
+        costs_entries=(
+            ("decode.batch_flat.scores.onehot",) if scores
+            else ("decode.batch_flat.onehot",)
+        ),
+        legacy=legacy, candidates=candidates, feasibility=feas,
+        build=build, run_once=run_once, parity_err=parity_err,
+        parity_tol=min(SCORE_REL_TOL, PATH_MISMATCH_MAX),
+        make_chained=make_chained, ceiling_key="decode",
+    )
+
+
+# -- per-path fused booleans (the bench_passfusion decisions) ----------------
+
+
+def _fused_task(path: str) -> Task:
+    costs = {
+        "posterior": ("posterior.onehot",),
+        "em_seq": ("em.seq.onehot",),
+        "em_chunked": ("em.chunked.onehot",),
+    }[path]
+    ceiling = {"posterior": "posterior", "em_seq": "em-seq",
+               "em_chunked": "em"}[path]
+
+    def build(cfg):
+        from cpgisland_tpu.ops import fb_pallas
+
+        env = {"params": _params()}
+        if path == "em_chunked":
+            import numpy as np
+
+            import jax.numpy as jnp
+
+            chunk = (1 << 14) if cfg.smoke else (1 << 16)
+            n_chunks = max(1, cfg.n // chunk)
+            rng = np.random.default_rng(3)
+            env["chunks"] = jnp.asarray(
+                rng.integers(
+                    0, 4, size=(n_chunks, chunk), dtype=np.int32
+                ).astype(np.uint8)
+            )
+            env["lengths"] = jnp.full(n_chunks, chunk, jnp.int32)
+            env["n"] = n_chunks * chunk
+        else:
+            env["obs"] = _obs_stream(cfg.n, seed=5)
+            env["n"] = cfg.n
+            env["lane_T"] = fb_pallas.legacy_lane_T(
+                cfg.n, onehot=True, long_lanes=True
+            )
+            env["mask"] = _island_mask8()
+        return env
+
+    def run_once(env, value):
+        from cpgisland_tpu.ops import fb_pallas
+
+        if path == "posterior":
+            conf, _ = fb_pallas.seq_posterior_pallas(
+                env["params"], env["obs"], env["n"], env["mask"],
+                lane_T=env["lane_T"], onehot=True, fused=bool(value),
+            )
+            return conf
+        if path == "em_seq":
+            return fb_pallas.seq_stats_pallas(
+                env["params"], env["obs"], env["n"],
+                lane_T=env["lane_T"], onehot=True, fused=bool(value),
+            )
+        return fb_pallas.batch_stats_pallas(
+            env["params"], env["chunks"], env["lengths"], onehot=True,
+            fused=bool(value),
+        )
+
+    def parity_err(ref, out):
+        if path == "posterior":
+            import jax.numpy as jnp
+
+            return float(jnp.max(jnp.abs(ref - out)))
+        return _stats_rel_err(ref, out)
+
+    def make_chained(env, value, cfg):
+        import jax
+        import jax.numpy as jnp
+
+        # The symbol stream rides as an ARGUMENT, never a closed-over
+        # constant: remote compile ships program bytes over HTTP and a
+        # baked 64+ MiB array is an HTTP 413 on the relay (CLAUDE.md).
+        data_key = "chunks" if path == "em_chunked" else "obs"
+
+        @jax.jit
+        def chained(p, data, s):
+            p = _jitter(p, s)
+
+            def body(c, _):
+                got = run_once({**env, "params": p, data_key: data}, value)
+                small = got[:8] if path == "posterior" else got.loglik
+                return c + jnp.sum(small) * 1e-9, None
+
+            c, _ = jax.lax.scan(
+                body, jnp.float32(0), None, length=cfg.chain
+            )
+            return c
+
+        return lambda s: float(
+            jax.device_get(chained(env["params"], env[data_key], jnp.int32(s)))
+        )
+
+    return Task(
+        name=f"fused.{path}", family="fb.reduced", costs_entries=costs,
+        legacy=lambda cfg: True,
+        candidates=lambda cfg: [True, False],
+        feasibility=lambda value, cfg: None,
+        build=build, run_once=run_once, parity_err=parity_err,
+        parity_tol=CONF_TOL if path == "posterior" else STATS_REL_TOL,
+        make_chained=make_chained, ceiling_key=ceiling,
+    )
+
+
+# -- per-site stacked booleans (the bench_multimodel decisions) --------------
+
+
+def _stacked_task(site: str) -> Task:
+    costs = {
+        "em_family": ("em.chunked.onehot.stacked3",),
+        # The compare site's stacked unit IS the stacked posterior pass
+        # (family.stacked groups compare members into
+        # posterior_sharded_stacked units) — the task times that unit and
+        # the winner routes compare_record's ``stacked`` default.
+        "compare": ("posterior.onehot.stacked3",),
+        "serve_decode": ("decode.batch_flat.onehot.stacked3",),
+    }[site]
+    ceiling = {"em_family": "em", "compare": "posterior",
+               "serve_decode": "decode"}[site]
+
+    def build(cfg):
+        from cpgisland_tpu.ops import fb_pallas
+
+        env = {"members": _member_params(cfg.members)}
+        if site == "em_family":
+            import numpy as np
+
+            import jax.numpy as jnp
+
+            chunk = (1 << 14) if cfg.smoke else (1 << 16)
+            n_chunks = max(1, cfg.n // chunk)
+            rng = np.random.default_rng(6)
+            env["chunks"] = jnp.asarray(
+                rng.integers(
+                    0, 4, size=(n_chunks, chunk), dtype=np.int32
+                ).astype(np.uint8)
+            )
+            env["lengths"] = jnp.full(n_chunks, chunk, jnp.int32)
+            env["n"] = n_chunks * chunk
+        elif site == "compare":
+            env["obs"] = _obs_stream(cfg.n, seed=7)
+            env["n"] = cfg.n
+            env["lane_T"] = fb_pallas.legacy_lane_T(
+                cfg.n, onehot=True, long_lanes=True
+            )
+            env["masks"] = tuple(_island_mask8() for _ in env["members"])
+        else:
+            from cpgisland_tpu.analysis import memmodel
+
+            chunks, lengths = _flat_geometry(cfg)
+            env["chunks"], env["lengths"] = chunks, lengths
+            env["n"] = int(chunks.shape[0] * chunks.shape[1])
+            # ONE explicit block for BOTH arms, already inside the
+            # stacked M-member VMEM cap so the on-TPU clamp never fires
+            # and the A/B compares identical geometries.
+            env["block"] = min(
+                4096, memmodel.stacked_block_cap(cfg.members, scores=False)
+            )
+        return env
+
+    def run_once(env, value):
+        from cpgisland_tpu.ops import fb_pallas
+        from cpgisland_tpu.ops import viterbi_onehot as OH
+
+        members = env["members"]
+        if site == "em_family":
+            if value:
+                return fb_pallas.batch_stats_pallas_stacked(
+                    members, env["chunks"], env["lengths"]
+                )
+            return tuple(
+                fb_pallas.batch_stats_pallas(
+                    p, env["chunks"], env["lengths"], onehot=True
+                )
+                for p in members
+            )
+        if site == "compare":
+            if value:
+                conf, _ = fb_pallas.seq_posterior_pallas_stacked(
+                    members, env["obs"], env["n"], env["masks"],
+                    lane_T=env["lane_T"],
+                )
+                return conf
+            import jax.numpy as jnp
+
+            return jnp.stack([
+                fb_pallas.seq_posterior_pallas(
+                    p, env["obs"], env["n"], m,
+                    lane_T=env["lane_T"], onehot=True,
+                )[0]
+                for p, m in zip(members, env["masks"])
+            ])
+        # Both arms at ONE explicit block (env["block"], stacked-feasible
+        # so the TPU clamp never fires): block_size=None would consult
+        # the tuning table per arm (different M keys -> potentially
+        # different blocks, and a trace-time lookup inside the chained
+        # jit), contaminating the A/B with mismatched geometries.
+        if value:
+            return OH.decode_batch_flat_stacked(
+                members, env["chunks"], env["lengths"],
+                block_size=env["block"],
+            )
+        import jax.numpy as jnp
+
+        return jnp.stack([
+            OH.decode_batch_flat(
+                p, env["chunks"], env["lengths"], block_size=env["block"]
+            )
+            for p in members
+        ])
+
+    def parity_err(ref, out):
+        import numpy as np
+
+        if site == "em_family":
+            return max(
+                _stats_rel_err(a, b) for a, b in zip(ref, out)
+            )
+        if site == "compare":
+            import jax.numpy as jnp
+
+            return float(jnp.max(jnp.abs(ref - out)))
+        # Stacked decode is bit-identical per member off-TPU (same block)
+        # and tie-class on chip: the err is the path-mismatch fraction.
+        return float(np.mean(np.asarray(ref) != np.asarray(out)))
+
+    def make_chained(env, value, cfg):
+        import jax
+        import jax.numpy as jnp
+
+        if site == "serve_decode":
+            chunks = env["chunks"]
+            T = chunks.shape[1]
+            P = min(8191, T - 2)
+
+            @jax.jit
+            def chained(ch, s):
+                pos = 1 + (s * 7) % P
+                ch = ch.at[0, pos].set(
+                    ((ch[0, pos].astype(jnp.int32) + 1 + s // P) % 4)
+                    .astype(ch.dtype)
+                )
+
+                def body(c, _):
+                    got = run_once({**env, "chunks": ch}, value)
+                    return (
+                        c + jnp.sum(got[0][:, :8]).astype(jnp.float32) * 1e-9,
+                        None,
+                    )
+
+                c, _ = jax.lax.scan(
+                    body, jnp.float32(0), None, length=cfg.chain
+                )
+                return c
+
+            return lambda s: float(
+                jax.device_get(chained(chunks, jnp.int32(s)))
+            )
+
+        # Stream-as-argument, same HTTP-413 rule as the fused tasks.
+        data_key = "chunks" if site == "em_family" else "obs"
+
+        @jax.jit
+        def chained(p0, data, s):
+            p0 = _jitter(p0, s)
+
+            def body(c, _):
+                members = (p0,) + tuple(env["members"][1:])
+                got = run_once(
+                    {**env, "members": members, data_key: data}, value
+                )
+                if site == "em_family":
+                    small = sum(st.loglik for st in got)
+                else:
+                    small = jnp.sum(got[0][:8])
+                return c + small * 1e-9, None
+
+            c, _ = jax.lax.scan(
+                body, jnp.float32(0), None, length=cfg.chain
+            )
+            return c
+
+        return lambda s: float(
+            jax.device_get(
+                chained(env["members"][0], env[data_key], jnp.int32(s))
+            )
+        )
+
+    def feas(value, cfg):
+        if not value:
+            return None
+        from cpgisland_tpu.analysis import memmodel
+        from cpgisland_tpu.ops.fb_onehot import TUNE_KERNELS
+
+        kernel = {
+            "em_family": TUNE_KERNELS["em_chunked"],
+            "compare": TUNE_KERNELS["posterior"],
+            "serve_decode": "decode.backpointers.onehot",
+        }[site]
+        return memmodel.feasible(
+            kernel,
+            memmodel.Knobs(
+                lane_tile=256 if site != "serve_decode" else 128,
+                stacked_m=cfg.members,
+            ),
+        )
+
+    return Task(
+        name=f"stacked.{site}", family="stacked", costs_entries=costs,
+        legacy=lambda cfg: True,
+        candidates=lambda cfg: [True, False],
+        feasibility=feas,
+        build=build, run_once=run_once, parity_err=parity_err,
+        parity_tol=(
+            STATS_REL_TOL if site == "em_family"
+            else CONF_TOL if site == "compare" else PATH_MISMATCH_MAX
+        ),
+        make_chained=make_chained, ceiling_key=ceiling,
+    )
+
+
+# -- engine choice (auto's dense-vs-reduced pick) ----------------------------
+
+
+def _engine_task() -> Task:
+    def legacy(cfg):
+        import jax
+
+        return "onehot" if jax.default_backend() == "tpu" else "xla"
+
+    def build(cfg):
+        import numpy as np
+
+        import jax.numpy as jnp
+
+        chunk = (1 << 14) if cfg.smoke else (1 << 16)
+        n_chunks = max(1, cfg.n // chunk)
+        rng = np.random.default_rng(8)
+        return {
+            "params": _params(),
+            "chunks": jnp.asarray(
+                rng.integers(
+                    0, 4, size=(n_chunks, chunk), dtype=np.int32
+                ).astype(np.uint8)
+            ),
+            "lengths": jnp.full(n_chunks, chunk, jnp.int32),
+            "n": n_chunks * chunk,
+        }
+
+    def run_once(env, value):
+        from cpgisland_tpu.ops import fb_pallas
+        from cpgisland_tpu.ops.forward_backward import batch_stats
+
+        if value == "onehot":
+            return fb_pallas.batch_stats_pallas(
+                env["params"], env["chunks"], env["lengths"], onehot=True
+            )
+        return batch_stats(
+            env["params"], env["chunks"], env["lengths"], mode="rescaled"
+        )
+
+    def make_chained(env, value, cfg):
+        import jax
+        import jax.numpy as jnp
+
+        # Stream-as-argument, same HTTP-413 rule as the fused tasks.
+        @jax.jit
+        def chained(p, chunks, s):
+            p = _jitter(p, s)
+
+            def body(c, _):
+                st = run_once({**env, "params": p, "chunks": chunks}, value)
+                return c + st.loglik * 1e-9, None
+
+            c, _ = jax.lax.scan(
+                body, jnp.float32(0), None, length=cfg.chain
+            )
+            return c
+
+        return lambda s: float(
+            jax.device_get(chained(env["params"], env["chunks"], jnp.int32(s)))
+        )
+
+    return Task(
+        name="engine.fb_chunked", family="fb.reduced",
+        costs_entries=("em.chunked.onehot", "em.chunked.xla"),
+        legacy=legacy,
+        candidates=lambda cfg: ["onehot", "xla"],
+        feasibility=lambda value, cfg: None,
+        build=build, run_once=run_once, parity_err=_stats_rel_err,
+        parity_tol=STATS_REL_TOL, make_chained=make_chained,
+        ceiling_key="em",
+    )
+
+
+# -- the registry -------------------------------------------------------------
+
+
+def all_tasks() -> list:
+    return [
+        _lane_task(),
+        _t_tile_seq_task(),
+        _flat_block_task(scores=False),
+        _flat_block_task(scores=True),
+        _fused_task("posterior"),
+        _fused_task("em_seq"),
+        _fused_task("em_chunked"),
+        _stacked_task("em_family"),
+        _stacked_task("compare"),
+        _stacked_task("serve_decode"),
+        _engine_task(),
+    ]
+
+
+# The --smoke slice: one kernel family per engine — reduced FB (lane sweep
+# + a fused verdict), stacked, and flat decode — each completing the full
+# prune -> parity-gate -> time -> persist cycle on CPU.
+SMOKE_TASKS = (
+    "lane.onehot.long",
+    "t_tile.em_seq",
+    "flat.block.scores",
+    "fused.em_chunked",
+    "stacked.em_family",
+)
+
+
+def tasks_by_name(names=None, prefix: Optional[str] = None) -> list:
+    tasks = all_tasks()
+    if names is not None:
+        want = set(names)
+        missing = want - {t.name for t in tasks}
+        if missing:
+            raise KeyError(
+                f"unknown tune task(s) {sorted(missing)} "
+                f"(have: {sorted(t.name for t in tasks)})"
+            )
+        tasks = [t for t in tasks if t.name in want]
+    if prefix:
+        tasks = [t for t in tasks if t.name.startswith(prefix)]
+    return tasks

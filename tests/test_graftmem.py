@@ -110,9 +110,13 @@ def test_pick_lane_T_candidate_parity_with_legacy_filter():
     } == oh
 
 
-def test_pick_lane_T_values_unchanged():
+def test_pick_lane_T_values_unchanged(tmp_path):
     """End-to-end routing parity on a sweep of input sizes: the shipped
-    picks (the legacy filter's) must be reproduced exactly."""
+    picks (the legacy filter's) must be reproduced exactly.  Pinned with
+    the graftune winner table ABSENT — this is the fallback arm every
+    consulting router must reproduce bit-for-bit (tuned winners are
+    test_graftune's subject)."""
+    from cpgisland_tpu import tune
     from cpgisland_tpu.ops import fb_pallas
 
     def legacy(n, onehot, long_lanes):
@@ -130,12 +134,17 @@ def test_pick_lane_T_values_unchanged():
         return min(sorted(rates, reverse=True), key=est)
 
     sizes = [1, 4096, 1 << 20, 16 << 20, 64 << 20, 100 << 20, 320 << 20]
-    for n in sizes:
-        for onehot in (False, True):
-            for long_lanes in ((False, True) if onehot else (False,)):
-                assert fb_pallas.pick_lane_T(
-                    n, onehot=onehot, long_lanes=long_lanes
-                ) == legacy(n, onehot, long_lanes), (n, onehot, long_lanes)
+    tune.set_table_path(str(tmp_path / "absent-TUNING.json"))
+    try:
+        for n in sizes:
+            for onehot in (False, True):
+                for long_lanes in ((False, True) if onehot else (False,)):
+                    assert fb_pallas.pick_lane_T(
+                        n, onehot=onehot, long_lanes=long_lanes
+                    ) == legacy(n, onehot, long_lanes), (n, onehot, long_lanes)
+    finally:
+        tune.set_table_path(None)
+        tune.generation()
 
 
 def test_seq_shard_budget_is_model_derived_and_unchanged():

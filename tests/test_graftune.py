@@ -1,0 +1,416 @@
+"""graftune (PR 14): the fingerprint-keyed knob autotuner.
+
+Pins, per the acceptance criteria:
+
+- every consulting router falls back BIT-FOR-BIT to the hard-coded
+  defaults when the winner table is absent, stale, or fingerprint-drifted
+  — and follows a fresh applied winner when one matches;
+- a tampered COSTS.json entry flips the dependent winners to stale
+  (named in the ``--tune`` diff, the stale-waiver UX) while unrelated
+  winners stay fresh;
+- a planted absurd winner (lane_T=8) is refused by the router's domain
+  check AND rejected by the sweep's apply-time parity gate before it can
+  be written;
+- the sweep driver completes a real prune -> parity-gate -> time ->
+  persist cycle with the ledger asserting that zero memmodel-rejected
+  tuples ever reached compile (slow-marked: the cycle compiles real
+  programs);
+- ``pick_lane_T``'s lru-cached feasibility filter keys on the table
+  generation, so an in-process ``--update-tune`` takes effect
+  immediately (the PR-13 staleness fix).
+"""
+
+import json
+import shutil
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from cpgisland_tpu import tune
+from cpgisland_tpu.tune import sweep as tune_sweep
+from cpgisland_tpu.tune import table as tune_table
+from cpgisland_tpu.tune import tasks as tune_tasks
+
+
+@pytest.fixture
+def tmp_table(tmp_path):
+    """Point the consultation machinery at a per-test table; restore the
+    committed default afterwards."""
+    path = str(tmp_path / "TUNING.json")
+    tune.set_table_path(path)
+    try:
+        yield path
+    finally:
+        tune.set_table_path(None)
+        tune.generation()  # refresh the cache back onto the default
+
+
+@pytest.fixture
+def absent_table(tmp_path):
+    path = str(tmp_path / "no-such-TUNING.json")
+    tune.set_table_path(path)
+    try:
+        yield path
+    finally:
+        tune.set_table_path(None)
+        tune.generation()
+
+
+def _plant(task, value, *, n_pow2=None, S=None, M=1, costs_entries,
+           applied=True, fingerprint=None, platform="cpu"):
+    key = tune_table.entry_key(task, n_pow2, S, M)
+    entry = tune_table.make_entry(
+        task, value, legacy=None, costs_entries=costs_entries,
+        applied=applied, projection=True,
+    )
+    if fingerprint is not None:
+        entry["costs_fingerprint"] = fingerprint
+    tune_table.write_entries({key: entry}, platform=platform)
+    return key
+
+
+# -- fallback parity ----------------------------------------------------------
+
+
+def test_absent_table_is_legacy_bit_for_bit(absent_table):
+    from cpgisland_tpu.ops import fb_pallas
+
+    for n in (1, 4096, 1 << 20, 16 << 20, 100 << 20):
+        for onehot in (False, True):
+            for long_lanes in (False, True) if onehot else ((False,)):
+                assert fb_pallas.pick_lane_T(
+                    n, onehot=onehot, long_lanes=long_lanes
+                ) == fb_pallas.legacy_lane_T(
+                    n, onehot=onehot, long_lanes=long_lanes
+                )
+    assert tune.default_fused("em_chunked") is True
+    assert tune.default_stacked("compare") is True
+    assert tune.default_block_size() == 4096
+    assert tune.default_t_tile("em_seq", 512) == 512
+    assert tune.default_engine("fb_chunked", "xla", {"xla", "onehot"}) \
+        == "xla"
+
+
+def test_fresh_lane_winner_consulted_per_bucket(tmp_table):
+    from cpgisland_tpu.ops import fb_pallas
+
+    n = 4 << 20
+    _plant(
+        "lane.onehot.long", 16384,
+        n_pow2=tune_table.pow2_bucket(n),
+        costs_entries=["posterior.onehot", "em.seq.onehot"],
+    )
+    assert fb_pallas.pick_lane_T(n, onehot=True, long_lanes=True) == 16384
+    # A different geometry bucket has no winner: legacy, bit for bit.
+    other = 1 << 20
+    assert fb_pallas.pick_lane_T(other, onehot=True, long_lanes=True) == \
+        fb_pallas.legacy_lane_T(other, onehot=True, long_lanes=True)
+
+
+def test_update_tune_in_process_takes_effect_immediately(tmp_table):
+    """The satellite fix: pick_lane_T's lru-cached feasibility filter
+    keys on the table generation, so a winner written mid-session routes
+    on the very next call (no stale pre-sweep cache)."""
+    from cpgisland_tpu.ops import fb_pallas
+
+    n = 8 << 20
+    legacy = fb_pallas.legacy_lane_T(n, onehot=True, long_lanes=True)
+    assert fb_pallas.pick_lane_T(n, onehot=True, long_lanes=True) == legacy
+    _plant(
+        "lane.onehot.long", 8192,
+        n_pow2=tune_table.pow2_bucket(n),
+        costs_entries=["posterior.onehot"],
+    )
+    assert fb_pallas.pick_lane_T(n, onehot=True, long_lanes=True) == 8192
+
+
+def test_fingerprint_drift_falls_back_and_is_named(tmp_table):
+    from cpgisland_tpu.ops import fb_pallas
+
+    n = 4 << 20
+    key = _plant(
+        "lane.onehot.long", 16384,
+        n_pow2=tune_table.pow2_bucket(n),
+        costs_entries=["posterior.onehot"],
+        fingerprint="sha256:deadbeefdeadbeef",
+    )
+    assert fb_pallas.pick_lane_T(n, onehot=True, long_lanes=True) == \
+        fb_pallas.legacy_lane_T(n, onehot=True, long_lanes=True)
+    rep = tune_table.table_report(platform="cpu")
+    assert rep["stale"] == 1 and rep["fresh"] == 0
+    assert rep["stale_entries"][0]["key"] == key
+    assert "fingerprint drifted" in rep["stale_entries"][0]["reason"]
+
+
+def test_tampered_costs_entry_flips_dependent_winners(tmp_table, tmp_path):
+    """The whole point of fingerprint keying: a kernel reshape that moves
+    the dependent COSTS.json entry stales exactly the winners swept
+    through it; unrelated winners stay fresh."""
+    _plant(
+        "fused.em_chunked", True,
+        costs_entries=["em.chunked.onehot"],
+    )
+    _plant(
+        "fused.posterior", True,
+        costs_entries=["posterior.onehot"],
+    )
+    clean = tune_table.table_report(platform="cpu")
+    assert clean["fresh"] == 2 and clean["stale"] == 0
+
+    tampered = tmp_path / "COSTS.json"
+    shutil.copy(tune_table.default_costs_path(), tampered)
+    lock = json.loads(tampered.read_text())
+    entry = lock["platforms"]["cpu"]["entries"]["em.chunked.onehot"]
+    entry["passes"] = entry["passes"] + 1  # the kernel "reshaped"
+    tampered.write_text(json.dumps(lock))
+
+    rep = tune_table.table_report(platform="cpu", costs_path=str(tampered))
+    assert rep["stale"] == 1 and rep["fresh"] == 1
+    assert "fused.em_chunked" in rep["stale_entries"][0]["key"]
+    d = tune_table.lookup(
+        "fused.em_chunked", platform="cpu", costs_path=str(tampered)
+    )
+    assert d.status == "stale" and "em.chunked.onehot" in d.reason
+
+
+def test_absurd_winner_refused_by_router_and_apply_gate(tmp_table):
+    """A planted lane_T=8 (outside the sweepable rate table) must never
+    route — and the apply-time parity gate refuses to write it."""
+    from cpgisland_tpu.ops import fb_pallas
+
+    n = 4 << 20
+    _plant(
+        "lane.onehot.long", 8,
+        n_pow2=tune_table.pow2_bucket(n),
+        costs_entries=["posterior.onehot"],
+    )
+    assert fb_pallas.pick_lane_T(n, onehot=True, long_lanes=True) == \
+        fb_pallas.legacy_lane_T(n, onehot=True, long_lanes=True)
+    with pytest.raises(ValueError, match="parity gate"):
+        tune_sweep.validate_entry("lane.onehot.long", 8)
+
+
+def test_apply_gate_rejects_infeasible_values():
+    # In-domain but memmodel-rejected: the feasibility oracle is part of
+    # the apply gate too (a winner that stopped fitting after a model
+    # recalibration cannot be re-applied).
+    with pytest.raises(ValueError, match="feasibility"):
+        tune_sweep.validate_entry("t_tile.em_seq", 4096)
+    with pytest.raises(ValueError, match="feasibility"):
+        tune_sweep.validate_entry("flat.block.scores", 16384)
+    with pytest.raises(ValueError, match="parity gate"):
+        tune_sweep.validate_entry("fused.em_chunked", "sideways")
+    # Legacy values always pass.
+    tune_sweep.validate_entry("t_tile.em_seq", 512)
+    tune_sweep.validate_entry("flat.block.scores", 4096)
+    tune_sweep.validate_entry("fused.em_chunked", True)
+
+
+# -- per-path fused / stacked / block / engine consultation ------------------
+
+
+def test_fused_default_consultation(tmp_table):
+    from cpgisland_tpu.train.backends import LocalBackend
+
+    assert LocalBackend().fuse_fb is True
+    _plant("fused.em_chunked", False, costs_entries=["em.chunked.onehot"])
+    assert LocalBackend().fuse_fb is False
+    # Explicit always wins.
+    assert LocalBackend(fuse_fb=True).fuse_fb is True
+
+
+def test_seq_backend_fused_and_t_tile_consultation(tmp_table):
+    from cpgisland_tpu.train.backends import SeqBackend
+
+    b = SeqBackend()
+    assert b.fuse_fb is True and b.t_tile == 512
+    _plant("fused.em_seq", False, costs_entries=["em.seq.onehot"])
+    _plant("t_tile.em_seq", 256, costs_entries=["em.seq.onehot"])
+    b2 = SeqBackend()
+    assert b2.fuse_fb is False and b2.t_tile == 256
+    assert SeqBackend(fuse_fb=True, t_tile=1024).t_tile == 1024
+
+
+def test_stacked_default_consultation(tmp_table):
+    from cpgisland_tpu.serve.broker import BrokerConfig
+    from cpgisland_tpu.train.backends import FamilyEStep
+
+    assert FamilyEStep().stacked is True
+    assert BrokerConfig().stacked is True
+    _plant(
+        "stacked.em_family", False,
+        costs_entries=["em.chunked.onehot.stacked3"],
+    )
+    _plant(
+        "stacked.serve_decode", False,
+        costs_entries=["decode.batch_flat.onehot.stacked3"],
+    )
+    assert FamilyEStep().stacked is False
+    assert BrokerConfig().stacked is False
+    assert FamilyEStep(stacked=True).stacked is True
+    assert BrokerConfig(stacked=True).stacked is True
+
+
+def test_family_estep_sequential_arm_bit_identical(tmp_table):
+    """FamilyEStep(stacked=False) — the tuned fallback arm — must match
+    the stacked launch per member bit for bit (the pinned contract the
+    router relies on when a stacked winner goes stale)."""
+    from cpgisland_tpu.train.backends import FamilyEStep
+
+    members = tune_tasks._member_params(3)
+    rng = np.random.default_rng(0)
+    chunks = jnp.asarray(
+        rng.integers(0, 4, size=(4, 512), dtype=np.int32).astype(np.uint8)
+    )
+    lengths = jnp.full(4, 512, jnp.int32)
+    stacked = FamilyEStep(stacked=True)(members, chunks, lengths)
+    seq = FamilyEStep(stacked=False)(members, chunks, lengths)
+    for a, b in zip(stacked, seq):
+        np.testing.assert_array_equal(np.asarray(a.trans), np.asarray(b.trans))
+        np.testing.assert_array_equal(np.asarray(a.emit), np.asarray(b.emit))
+
+
+def test_flat_block_consultation(tmp_table):
+    from cpgisland_tpu.ops import viterbi_onehot as OH
+
+    assert tune.default_block_size() == 4096
+    _plant(
+        "flat.block", 2048,
+        costs_entries=["decode.batch_flat.onehot"],
+    )
+    assert tune.default_block_size() == 2048
+    # The prep derivation consults the same default: bk lands at the
+    # tuned block for a big-enough stream.
+    rng = np.random.default_rng(1)
+    chunks = jnp.asarray(
+        rng.integers(0, 4, size=(2, 4096), dtype=np.int32).astype(np.uint8)
+    )
+    lengths = jnp.full(2, 4096, jnp.int32)
+    _, _, _, bk, _ = OH.prepare_decode_flat(4, chunks, lengths)
+    assert bk == 2048
+    # Explicit block sizes pass through untouched.
+    _, _, _, bk, _ = OH.prepare_decode_flat(4, chunks, lengths, 1024)
+    assert bk == 1024
+
+
+def test_engine_winner_respects_eligibility(tmp_table):
+    """A tuned engine outside the currently-eligible ladder is refused:
+    on CPU auto resolves to xla and 'onehot' is not in the ladder, so a
+    planted onehot winner must NOT route (eligibility is never relaxed
+    by the tuner)."""
+    from cpgisland_tpu.models import presets
+    from cpgisland_tpu.train.backends import resolve_fb_engine
+
+    params = presets.durbin_cpg8()
+    assert resolve_fb_engine("auto", params, "rescaled") == "xla"
+    _plant(
+        "engine.fb_chunked", "onehot",
+        costs_entries=["em.chunked.onehot", "em.chunked.xla"],
+    )
+    assert resolve_fb_engine("auto", params, "rescaled") == "xla"
+
+
+# -- the committed table ------------------------------------------------------
+
+
+def test_committed_table_is_fresh_and_legacy_valued():
+    """The committed TUNING.json's cpu section must stay fresh against
+    the committed COSTS.json (a kernel reshape that re-baselines costs
+    must re-sweep: tools/graftune.py --all --update-tune --apply), and —
+    being a CPU projection sweep — every applied winner must equal its
+    recorded legacy default, so the committed artifact changes NO routing
+    (the chip knobs are earned on the capture platform only)."""
+    data = tune_table.load_table(tune_table.default_table_path())
+    assert data is not None, "TUNING.json missing from the repo"
+    section = data["platforms"]["cpu"]
+    assert section["entries"], "committed table has no cpu winners"
+    rep = tune_table.table_report(
+        platform="cpu", path=tune_table.default_table_path()
+    )
+    assert rep["stale"] == 0, rep["stale_entries"]
+    for key, e in section["entries"].items():
+        assert e["projection"] is True, key
+        assert e["value"] == e["legacy"], (
+            f"{key}: committed cpu winner {e['value']!r} != legacy "
+            f"{e['legacy']!r} — projection sweeps must not move routing"
+        )
+
+
+def test_tune_report_cli_names_stale(tmp_table, capsys):
+    from cpgisland_tpu.analysis import cli
+
+    _plant(
+        "fused.em_seq", True,
+        costs_entries=["em.seq.onehot"],
+        fingerprint="sha256:0000000000000000",
+    )
+    rc = cli.main(["--no-lint", "--tune", "--tune-file", tmp_table])
+    err = capsys.readouterr().err
+    assert rc == 0  # staleness is advisory (the stale-waiver UX)
+    assert "tune stale" in err and "fused.em_seq" in err
+    assert "graftune:" in err and "1 stale" in err
+
+
+# -- the sweep round trip (slow: compiles real programs) ---------------------
+
+
+@pytest.mark.slow
+def test_sweep_cycle_prune_parity_time_persist(tmp_table):
+    cfg = tune_tasks.SweepConfig(n=64 << 10, chain=2, reps=1, smoke=True)
+    report = tune_sweep.run_sweep(
+        names=["t_tile.em_seq", "fused.em_chunked"], cfg=cfg
+    )
+    ledger = report["ledger"]
+    assert ledger["clean"]
+    # The prune was real: the planted-infeasible t_tile=4096 candidate
+    # was rejected by the memmodel BEFORE compile.
+    pruned = {(r["task"], r["value"]) for r in ledger["pruned"]}
+    assert ("t_tile.em_seq", "4096") in pruned
+    timed = {(r["task"], r["value"]) for r in ledger["timed"]}
+    assert not (pruned & timed)
+    # Persist and re-consult: rows land fresh; CPU knob winners apply
+    # only at the legacy value (projection rule).
+    path = tune_sweep.persist(report, update_tune=True, apply_verdicts=True)
+    assert path == tmp_table
+    rep = tune_table.table_report(platform="cpu")
+    assert rep["fresh"] == 2 and rep["stale"] == 0
+    for t in report["tasks"]:
+        assert t["applied_value"] == t["legacy"]
+        assert t["decision"] == "keep"
+
+
+@pytest.mark.slow
+def test_sweep_ledger_raises_if_pruned_tuple_reaches_compile():
+    ledger = tune_sweep.SweepLedger()
+    ledger.prune("t_tile.em_seq", 4096, "too big")
+    with pytest.raises(tune_sweep.PrunedTupleCompiled):
+        ledger.check_compile("t_tile.em_seq", 4096)
+
+
+@pytest.mark.slow
+def test_graftune_cli_single_task_round_trip(tmp_path):
+    """tools/graftune.py end to end on one cheap task: one JSON line on
+    stdout, ledger clean, winners persisted to the given table."""
+    import subprocess
+    import sys
+    from pathlib import Path
+
+    repo = Path(__file__).resolve().parent.parent
+    table_path = tmp_path / "TUNING.json"
+    proc = subprocess.run(
+        [
+            sys.executable, str(repo / "tools" / "graftune.py"),
+            "--platform", "cpu", "--smoke", "--kernel", "fused.em_chunked",
+            "--update-tune", "--apply", "--tune-file", str(table_path),
+        ],
+        capture_output=True, text=True, cwd=str(repo), timeout=600,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    out = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert out["ledger"]["clean"]
+    assert out["persisted"] == str(table_path)
+    written = json.loads(table_path.read_text())
+    keys = list(written["platforms"]["cpu"]["entries"])
+    assert keys and all(k.startswith("fused.em_chunked") for k in keys)

@@ -82,6 +82,22 @@ echo "== pass-fusion smoke (co-scheduled fwd/bwd parity + A/B harness) =="
 python -m pytest tests/test_passfusion.py -q
 python tools/bench_passfusion.py --platform cpu --smoke > /dev/null
 
+echo "== graftune smoke (prune -> parity-gate -> time -> persist cycle) =="
+# The knob autotuner's CI slice: one task per kernel family/engine
+# (reduced FB lane + t_tile, flat decode block, stacked EM, a fused
+# verdict) runs the full cycle on CPU against a THROWAWAY table (the
+# committed TUNING.json stays untouched), with the ledger asserting zero
+# memmodel-rejected tuples ever reached compile.  Then the table tests:
+# fresh-winner consultation, bit-for-bit legacy fallback on
+# absent/stale/fingerprint-drifted entries, the absurd-winner parity
+# gate, and the committed-table freshness pin.
+python -m pytest tests/test_graftune.py -q
+_tune_tmp="$(mktemp -d)"
+python tools/graftune.py --platform cpu --smoke --update-tune --apply \
+  --tune-file "$_tune_tmp/TUNING.json" > /dev/null
+rm -rf "$_tune_tmp"
+python -m cpgisland_tpu.analysis --no-lint --tune
+
 echo "== serve smoke (broker vs batch pipelines, transport, restart) =="
 # The serving daemon's acceptance surface: an in-process broker streaming
 # mixed decode+posterior requests across two tenants, results BIT-IDENTICAL

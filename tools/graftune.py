@@ -1,0 +1,145 @@
+"""graftune CLI — the knob-autotuner sweep driver (ROADMAP item 1).
+
+One command replaces the three hand-driven chip-window harnesses: the
+lane/t_tile/block sweeps, tools/bench_passfusion.py's per-path fused
+A/B decisions, and tools/bench_multimodel.py's per-site stacked
+decisions all run as tune tasks — feasibility-pruned through graftmem
+BEFORE any compile, parity-gated against the current default arm BEFORE
+any timing, timed with the full bench relay discipline, and persisted
+into the fingerprint-keyed TUNING.json winner table the routers consult.
+
+Usage:
+  python tools/graftune.py --all                      # TPU capture window
+  python tools/graftune.py --all --update-tune --apply    # ... and persist
+  python tools/graftune.py --kernel lane              # task-name prefix
+  python tools/graftune.py --platform cpu --smoke     # CI slice (one task
+        # per kernel family/engine: reduced FB, stacked, flat decode)
+
+Persistence flags (without them the sweep only reports):
+  --update-tune   write the geometry-knob winner rows (lane/t_tile/
+                  block/engine) into TUNING.json
+  --apply         write the fused/stacked verdict rows (keep-or-flip; the
+                  BASELINE.md decision rule runs in code — flips apply
+                  only on the capturing TPU past the margin, CPU sweeps
+                  record projections and keep the shipped defaults)
+
+Stdout is ONE JSON line (the report incl. per-task verdict blocks and
+the prune/compile ledger); progress goes to stderr.  Exit 1 when any
+task failed or a pruned tuple reached compile (ledger-asserted).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--platform", default="auto",
+                    help="cpu | tpu | auto (whatever jax picks)")
+    ap.add_argument("--all", action="store_true", dest="run_all",
+                    help="run every tune task")
+    ap.add_argument("--kernel", default=None,
+                    help="task-name prefix filter (e.g. lane, fused, "
+                    "flat.block, stacked)")
+    ap.add_argument("--smoke", action="store_true",
+                    help="tiny CPU sizes, the one-task-per-kernel-family "
+                    "slice (CI; rates are projections)")
+    ap.add_argument("--update-tune", action="store_true",
+                    help="persist geometry-knob winners to TUNING.json")
+    ap.add_argument("--apply", action="store_true",
+                    help="persist fused/stacked verdict rows to TUNING.json")
+    ap.add_argument("--tune-file", default=None,
+                    help="winner-table path (default: <repo>/TUNING.json)")
+    ap.add_argument("--mib", type=int, default=None,
+                    help="symbols (MiB) per timed input (default: 64 on "
+                    "TPU, 2 on CPU, 0.25 under --smoke)")
+    ap.add_argument("--chain", type=int, default=None,
+                    help="data-dependent reps inside one lax.scan")
+    ap.add_argument("--reps", type=int, default=None,
+                    help="wall repetitions per arm (min taken)")
+    ap.add_argument("--members", type=int, default=3,
+                    help="stacked-arm member count")
+    ap.add_argument("--list", action="store_true", dest="list_tasks",
+                    help="list tune tasks and exit (no backend)")
+    args = ap.parse_args()
+
+    from cpgisland_tpu.tune import tasks as tune_tasks
+
+    if args.list_tasks:
+        for t in tune_tasks.all_tasks():
+            smoke = " [smoke]" if t.name in tune_tasks.SMOKE_TASKS else ""
+            print(f"{t.name}  ({t.family}; costs: "
+                  f"{', '.join(t.costs_entries)}){smoke}")
+        return 0
+
+    if not (args.run_all or args.kernel or args.smoke):
+        ap.error("pick --all, --kernel PREFIX, or --smoke")
+
+    import jax
+
+    if args.platform != "auto":
+        # Pin via jax.config BEFORE backend init: this dev box's site
+        # plugin ignores the JAX_PLATFORMS env var (CLAUDE.md).
+        jax.config.update("jax_platforms", args.platform)
+
+    from cpgisland_tpu.tune import sweep, table
+
+    if args.tune_file:
+        table.set_table_path(args.tune_file)
+
+    on_tpu = jax.default_backend() == "tpu"
+    if args.smoke:
+        n = (args.mib << 20) if args.mib else (256 << 10)
+        chain, reps = args.chain or 2, args.reps or 1
+    elif on_tpu:
+        n = (args.mib or 64) << 20
+        chain, reps = args.chain or 6, args.reps or 3
+    else:
+        # CPU projection sizes: the machinery cycle is real, the rates are
+        # not the chip answer (winners stay recorded-not-applied for
+        # geometry knobs; verdicts keep the shipped defaults).
+        n = (args.mib or 2) << 20
+        chain, reps = args.chain or 2, args.reps or 2
+    cfg = tune_tasks.SweepConfig(
+        n=n, chain=chain, reps=reps, members=args.members,
+        smoke=args.smoke,
+    )
+    names = list(tune_tasks.SMOKE_TASKS) if args.smoke else None
+    if not tune_tasks.tasks_by_name(names, args.kernel):
+        ap.error(
+            f"no tune task matches --kernel {args.kernel!r}"
+            + (" within the --smoke slice "
+               f"{list(tune_tasks.SMOKE_TASKS)} (drop --smoke to reach "
+               "the full registry)" if args.smoke else
+               " (see --list)")
+        )
+    report = sweep.run_sweep(
+        names=names, prefix=args.kernel, cfg=cfg, smoke=args.smoke,
+        log=log,
+    )
+    path = None
+    if args.update_tune or args.apply:
+        path = sweep.persist(
+            report, update_tune=args.update_tune,
+            apply_verdicts=args.apply, path=args.tune_file,
+        )
+        if path:
+            log(f"graftune: winners persisted to {path}")
+    report.pop("_reports", None)
+    report["persisted"] = path
+    report["table"] = table.table_report(path=args.tune_file)
+    print(json.dumps(report))
+    return 0 if report["ledger"]["clean"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
