@@ -54,6 +54,9 @@ HOT_PATHS: dict[str, frozenset[str]] = {
     # host loop in a long-lived process.
     "serve/broker.py": frozenset({
         "flush_once",
+        "take_flush",
+        "run_batch",
+        "finish_flush",
         "_run_flush",
         "_decode_record",
         "_posterior_record",
@@ -61,6 +64,10 @@ HOT_PATHS: dict[str, frozenset[str]] = {
         "_device_calls",
     }),
     "serve/worker.py": frozenset({"_run"}),
+    # The fleet's per-device flush workers: N copies of the worker loop's
+    # cadence, each one a flush-rate-multiplied host loop like the broker's
+    # drivers above.
+    "serve/fleet.py": frozenset({"_run", "_execute"}),
 }
 
 
@@ -88,6 +95,13 @@ SYNC_UNGUARDED: dict[str, dict[str, str]] = {
         "published and never reassigned back to None",
         "_tried": "same double-checked fast path as _lib (worst case two "
         "threads both enter the locked slow path, which re-checks)",
+    },
+    "resilience/faultplan.py": {
+        "_ACTIVE": "the graftfault disarmed fast path: check()/wall_pad() "
+        "run on EVERY supervised dispatch and must cost one module-global "
+        "read when no plan is armed; arm/disarm serialize under _LOCK, and "
+        "a stale read merely shifts one injection boundary — plans are "
+        "armed before their workload starts",
     },
 }
 

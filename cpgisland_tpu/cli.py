@@ -334,6 +334,14 @@ def build_parser() -> argparse.ArgumentParser:
         "(JSONL, one client connection at a time; the broker stays warm "
         "across connections)",
     )
+    sv.add_argument(
+        "--fleet", type=int, default=0, metavar="N",
+        help="device pool: one session set + flush worker per local "
+        "device (first N devices; 0 = the single worker loop).  Faulting "
+        "devices are health-probed and quarantined, their flushes "
+        "requeued intact onto healthy devices — see "
+        "cpgisland_tpu/serve/fleet.py",
+    )
     _add_island_cap_flag(sv)
     _add_island_states_flag(sv)
     _add_invalid_symbols_flag(sv)

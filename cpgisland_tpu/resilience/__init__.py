@@ -33,7 +33,17 @@ serving-side counterpart, four subsystems:
   per-record JSONL manifest written by ``decode_file``/``posterior_file``
   (``--resume``) so a killed or faulted run skips completed records and
   produces byte-identical final output — the serving-side analogue of
-  training checkpoints.
+  training checkpoints.  For the serve daemon it is additionally a
+  **two-phase admission journal** (admitted -> completed): a daemon killed
+  mid-flush replays completed requests bit-identically AND re-executes
+  admitted-but-incomplete ones on restart, so no accepted request is ever
+  silently dropped.
+- :mod:`~cpgisland_tpu.resilience.faultplan` — **graftfault**: a
+  deterministic, seeded fault-injection harness (declarative plans armed
+  around a workload; injection points pre-placed in the supervisor,
+  sentinel, journal phase boundaries, and the transport reader) so every
+  failover path above is exercised by CI on the virtual mesh instead of
+  only by a misbehaving relay in production.
 
 No jax import at module level (the CLI imports this before platform
 selection); device work is only touched lazily inside supervised thunks.
@@ -45,6 +55,12 @@ from cpgisland_tpu.resilience.breaker import (  # noqa: F401
     EngineBreaker,
     get_breaker,
     set_breaker,
+)
+from cpgisland_tpu.resilience.faultplan import (  # noqa: F401
+    Fault,
+    FaultPlan,
+    ManualClock,
+    SimulatedKill,
 )
 from cpgisland_tpu.resilience.manifest import RunManifest  # noqa: F401
 from cpgisland_tpu.resilience.policy import (  # noqa: F401
@@ -61,9 +77,11 @@ from cpgisland_tpu.resilience.sentinel import (  # noqa: F401
 
 def reset() -> None:
     """Reset process-global resilience state (tests): the default
-    supervisor and the global engine breaker."""
+    supervisor, the global engine breaker, and any armed graftfault plan."""
     from cpgisland_tpu.resilience import breaker as breaker_mod
+    from cpgisland_tpu.resilience import faultplan as faultplan_mod
     from cpgisland_tpu.resilience import policy as policy_mod
 
     policy_mod._DEFAULT = None
     breaker_mod._BREAKER = None
+    faultplan_mod.disarm()

@@ -49,7 +49,11 @@ class EngineBreaker:
     """Consecutive-fault circuit breaker over namespaced engine keys.
 
     ``clock`` is injectable (monotonic seconds) so cooldown expiry is
-    testable without sleeping.
+    testable without sleeping; ``now_fn`` is an alias for it (the name the
+    fleet health machinery and graftfault's :class:`~cpgisland_tpu.
+    resilience.faultplan.ManualClock` use — a given ``now_fn`` wins), so
+    one deterministic clock can drive the breaker AND the device health
+    cooldowns in lockstep.
     """
 
     def __init__(
@@ -58,12 +62,13 @@ class EngineBreaker:
         threshold: int = DEFAULT_THRESHOLD,
         cooldown_s: float = DEFAULT_COOLDOWN_S,
         clock: Callable[[], float] = time.monotonic,
+        now_fn: Optional[Callable[[], float]] = None,
     ) -> None:
         if threshold < 1:
             raise ValueError(f"threshold must be >= 1, got {threshold}")
         self.threshold = threshold
         self.cooldown_s = cooldown_s
-        self.clock = clock
+        self.clock = now_fn if now_fn is not None else clock
         self._state: Dict[str, _EngineState] = {}
         # The supervisor may be driven from a deferred thunk while another
         # record dispatches; keep the tiny state transitions atomic.

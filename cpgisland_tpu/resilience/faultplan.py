@@ -1,0 +1,288 @@
+"""graftfault: deterministic, seeded fault-injection plans for the serve fleet.
+
+The reference program inherited chaos-for-free from Hadoop: every cluster
+ran task failures daily, so MAHOUT-627's re-execution path was exercised by
+production itself.  This stack's failover machinery (fleet quarantine,
+flush requeue, the admission journal) would otherwise only ever run when
+the relay actually misbehaves — which is exactly when nobody is watching a
+test.  graftfault closes that gap: declarative fault PLANS ("the 3rd
+supervised dispatch faults past the retry budget", "phantom result on
+device dev0", "SIGKILL between the journal admit and the flush") armed
+around a workload, with every injection ledgered as a
+``graftfault_injected`` obs event so tests can assert the chaos actually
+happened.
+
+Injection points are pre-placed in production code and cost ONE module
+global read when no plan is armed (the common case — production never pays
+for the harness):
+
+- ``dispatch`` / ``dispatch.wall`` — the dispatch supervisor's attempt
+  body (``resilience/policy.py``): ``fault`` raises a retry-shaped
+  RuntimeError, ``phantom`` raises :class:`~cpgisland_tpu.resilience.
+  sentinel.PhantomResult`, ``slow`` pads the measured attempt wall so the
+  ``dispatch_slow`` escalation fires without sleeping.
+- ``sentinel`` — :meth:`IntegritySentinel.verify` entry.
+- ``journal.pre_admit`` / ``journal.post_admit`` / ``flush.enter`` /
+  ``journal.pre_complete`` / ``journal.post_complete`` — the serve
+  broker's write-ahead journal phase boundaries; ``kill`` raises
+  :class:`SimulatedKill` (a BaseException: nothing between the injection
+  point and the test harness may catch it, modelling SIGKILL's
+  nothing-else-runs semantics — what survives is exactly what was already
+  flushed to disk, which is the crash-consistency contract under test).
+- ``transport.read`` — the socket mux reader loop; ``disconnect`` raises
+  OSError, modelling a connection dying mid-stream.
+
+Determinism: each Fault matches arrivals at its point by a per-plan
+ORDINAL counter (``nth``/``times``), optionally filtered by a ``match``
+substring of the site tag (tags carry the supervisor/session name, which
+for fleet sessions embeds the device label — ``match="@dev0"`` targets one
+device, whose supervised dispatches are serialized on its worker thread,
+making per-device ordinals fully deterministic).  Across concurrent
+workers the global interleaving may vary; plans are written so the
+asserted outcome (bit-identity with the fault-free run, zero dropped
+admitted requests) is interleaving-invariant.
+
+No jax import, ever — the CLI pulls :mod:`cpgisland_tpu.resilience` in
+before platform selection.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import logging
+import random
+import threading
+from typing import Optional
+
+from cpgisland_tpu import obs
+from cpgisland_tpu.resilience.sentinel import PhantomResult
+
+log = logging.getLogger(__name__)
+
+__all__ = [
+    "Fault",
+    "FaultPlan",
+    "ManualClock",
+    "SimulatedKill",
+    "active",
+    "arm",
+    "check",
+    "disarm",
+    "matrix",
+    "wall_pad",
+]
+
+KINDS = ("fault", "phantom", "slow", "kill", "disconnect")
+
+
+class SimulatedKill(BaseException):
+    """graftfault's SIGKILL stand-in.  BaseException on purpose: the broad
+    ``except Exception`` fault isolation in the serve stack must NOT catch
+    it — a real SIGKILL runs no handlers, and the journal tests exist to
+    prove that what was flushed to disk alone reconstructs the run."""
+
+
+@dataclasses.dataclass(frozen=True)
+class Fault:
+    """One declarative fault: at injection point ``point``, on matching
+    arrivals ``nth .. nth+times-1`` (1-based, per plan), perform ``kind``.
+
+    ``match`` filters by substring of the site tag ('' = every arrival at
+    the point counts).  ``pad_s`` is the wall padding for ``slow`` faults
+    (must exceed the retry policy's ``slow_attempt_s`` to escalate).
+    """
+
+    point: str
+    kind: str = "fault"
+    nth: int = 1
+    times: int = 1
+    match: str = ""
+    pad_s: float = 600.0
+
+    def __post_init__(self):
+        if self.kind not in KINDS:
+            raise ValueError(f"kind must be one of {KINDS}, got {self.kind!r}")
+        if self.nth < 1 or self.times < 1:
+            raise ValueError(f"nth/times are 1-based counts ({self})")
+
+
+class FaultPlan:
+    """A set of :class:`Fault` directives plus per-point arrival counters.
+
+    Arm with :func:`arm`/:func:`active`; every performed injection is
+    appended to ``self.injected`` (and emitted as a ``graftfault_injected``
+    obs event) so a test can assert the chaos it scheduled actually ran.
+    """
+
+    def __init__(self, faults, *, name: str = "plan",
+                 seed: Optional[int] = None) -> None:
+        self.faults = tuple(faults)
+        self.name = name
+        self.seed = seed
+        self.injected: list[dict] = []
+        self._arrivals: dict[int, int] = {}
+        self._lock = threading.Lock()
+
+    def _consult_locked(self, point: str, tag: str):
+        """(action Fault or None, slow pad seconds) for one arrival."""
+        pad = 0.0
+        action: Optional[Fault] = None
+        for i, f in enumerate(self.faults):
+            if f.point != point or (f.match and f.match not in tag):
+                continue
+            n = self._arrivals[i] = self._arrivals.get(i, 0) + 1
+            if not (f.nth <= n < f.nth + f.times):
+                continue
+            rec = {
+                "plan": self.name, "point": point, "kind": f.kind,
+                "tag": tag, "arrival": n,
+            }
+            self.injected.append(rec)
+            if f.kind == "slow":
+                pad += f.pad_s
+            elif action is None:
+                action = f
+        return action, pad
+
+    def check(self, point: str, tag: str) -> None:
+        with self._lock:
+            action, _pad = self._consult_locked(point, tag)
+        if action is None:
+            return
+        # Ledger OUTSIDE the plan lock (obs has its own locking).
+        obs.event(
+            "graftfault_injected", plan=self.name, point=point,
+            kind=action.kind, tag=tag,
+        )
+        log.warning(
+            "graftfault[%s]: injecting %s at %s [%s]",
+            self.name, action.kind, point, tag,
+        )
+        if action.kind == "kill":
+            raise SimulatedKill(f"graftfault: simulated SIGKILL at {point}")
+        if action.kind == "phantom":
+            raise PhantomResult(
+                f"graftfault: injected phantom result at {point} [{tag}]"
+            )
+        if action.kind == "disconnect":
+            raise OSError(
+                f"graftfault: injected connection death at {point} [{tag}]"
+            )
+        raise RuntimeError(
+            f"graftfault: injected device fault at {point} [{tag}]"
+        )
+
+    def wall_pad(self, point: str, tag: str) -> float:
+        with self._lock:
+            _action, pad = self._consult_locked(point, tag)
+        if pad > 0.0:
+            obs.event(
+                "graftfault_injected", plan=self.name, point=point,
+                kind="slow", tag=tag, pad_s=pad,
+            )
+        return pad
+
+
+# The armed plan.  Written under _LOCK; READ unlocked on every supervised
+# dispatch (the zero-cost-when-disarmed contract) — registered in
+# analysis.config.SYNC_UNGUARDED with the justification.
+_LOCK = threading.Lock()
+_ACTIVE: Optional[FaultPlan] = None
+
+
+def arm(plan: FaultPlan) -> FaultPlan:
+    """Install ``plan`` as the process-wide active plan."""
+    global _ACTIVE
+    with _LOCK:
+        if _ACTIVE is not None:
+            raise RuntimeError(
+                f"a graftfault plan ({_ACTIVE.name!r}) is already armed"
+            )
+        _ACTIVE = plan
+    log.info("graftfault: armed plan %r (%d fault(s))", plan.name,
+             len(plan.faults))
+    return plan
+
+
+def disarm() -> None:
+    global _ACTIVE
+    with _LOCK:
+        _ACTIVE = None
+
+
+@contextlib.contextmanager
+def active(plan: FaultPlan):
+    """``with faultplan.active(plan): <workload>`` — arm around a region."""
+    arm(plan)
+    try:
+        yield plan
+    finally:
+        disarm()
+
+
+def check(point: str, tag: str = "") -> None:
+    """Production-side injection point: no-op unless a plan is armed and a
+    fault matches this arrival (then it raises the mapped exception)."""
+    plan = _ACTIVE
+    if plan is None:
+        return
+    plan.check(point, tag)
+
+
+def wall_pad(point: str, tag: str = "") -> float:
+    """Seconds to ADD to a measured wall at this point (``slow`` faults);
+    0.0 unless a plan is armed."""
+    plan = _ACTIVE
+    if plan is None:
+        return 0.0
+    return plan.wall_pad(point, tag)
+
+
+class ManualClock:
+    """Deterministic ``now_fn`` for breaker/health cooldown tests: time
+    advances only when the test says so (no sleeps, no flakes)."""
+
+    def __init__(self, t: float = 0.0) -> None:
+        self._lock = threading.Lock()
+        self._t = float(t)
+
+    def __call__(self) -> float:
+        with self._lock:
+            return self._t
+
+    def advance(self, dt: float) -> float:
+        with self._lock:
+            self._t += float(dt)
+            return self._t
+
+
+def matrix(seed: int, *, attempts: int = 4) -> list:
+    """The CI chaos matrix for one seed: dispatch-level plans whose
+    ordinals vary with the seed.  ``attempts`` should be the retry
+    policy's ``max_retries + 1`` so 'past the budget' plans really exhaust
+    it.  Kill/disconnect plans are phase-targeted and parameterized
+    directly by the tests (they need a journal/socket around them)."""
+    rng = random.Random(seed)
+    return [
+        FaultPlan(
+            [Fault("dispatch", kind="fault", nth=rng.randint(1, 3),
+                   times=attempts)],
+            name=f"s{seed}-device-fault", seed=seed,
+        ),
+        FaultPlan(
+            [Fault("dispatch", kind="phantom", nth=rng.randint(1, 3),
+                   times=attempts)],
+            name=f"s{seed}-phantom", seed=seed,
+        ),
+        FaultPlan(
+            [Fault("dispatch", kind="fault", nth=rng.randint(1, 4),
+                   times=1)],
+            name=f"s{seed}-transient", seed=seed,
+        ),
+        FaultPlan(
+            [Fault("dispatch.wall", kind="slow", nth=rng.randint(1, 2),
+                   times=2)],
+            name=f"s{seed}-slow", seed=seed,
+        ),
+    ]

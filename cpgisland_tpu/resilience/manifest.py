@@ -27,6 +27,23 @@ semantic change would be corruption, recomputing is merely slower.
 Per-symbol streams (``state_path_out``, ``confidence_out``,
 ``mpm_path_out``) are NOT resumable — the pipeline rejects manifests for
 runs that request them.
+
+Two-phase admission journal (r15, the serve daemon's write-ahead log):
+completion-only records replay finished work, but a daemon killed
+MID-FLUSH used to silently drop every request it had ACCEPTED and not yet
+completed — the client got an ack, the work evaporated.
+:meth:`RunManifest.record_admitted` writes an ``admit`` line (with the
+request payload) BEFORE a request becomes visible to any flush consumer;
+:meth:`RunManifest.record_done` is the matching completion.  On resume,
+:meth:`admitted_incomplete` returns every admitted-but-incomplete entry so
+the serve broker can re-execute them (``journal_replay``), while completed
+entries keep replaying bit-identically with zero device work.  Loaders
+older than this phase ignore ``admit`` lines (they only read
+``kind == "record"``), so the file format is forward-compatible both ways.
+
+Thread contract: the fleet's device workers append completions
+concurrently; every mutator runs under ``RunManifest._lock`` (a leaf —
+nothing else is ever acquired under it).
 """
 
 from __future__ import annotations
@@ -35,6 +52,7 @@ import hashlib
 import json
 import logging
 import os
+import threading
 from typing import Optional
 
 import numpy as np
@@ -108,8 +126,11 @@ class RunManifest:
         self.path = path
         self.header = {"kind": "run", "version": MANIFEST_VERSION, **header}
         self._completed: dict[int, dict] = {}
+        self._admitted: dict[int, dict] = {}  # admit lines (two-phase journal)
         self._valid_bytes = 0  # prefix of intact newline-terminated lines
         self.skipped = 0  # records served from the manifest this run
+        # Leaf lock: fleet workers journal completions concurrently.
+        self._lock = threading.Lock()
         loaded = bool(resume) and self._load()
         d = os.path.dirname(os.path.abspath(path))
         os.makedirs(d, exist_ok=True)
@@ -125,9 +146,11 @@ class RunManifest:
             except OSError:
                 loaded = False
                 self._completed.clear()
+                self._admitted.clear()
         self._f = open(path, "a" if loaded else "w", encoding="utf-8")
         if not loaded:
-            self._append(self.header)
+            with self._lock:
+                self._append_locked(self.header)
         else:
             obs.event(
                 "manifest_resume", path=path,
@@ -170,8 +193,18 @@ class RunManifest:
                 "corrupt the output", self.path, sorted(diff),
             )
             return False
-        self._valid_bytes = len(lines[0].encode("utf-8"))
-        for ln in lines[1:]:
+        self._load_lines(lines)
+        return True
+
+    def _load_lines(self, lines: list) -> None:
+        # Construction-time only, but the maps are lock-guarded state
+        # everywhere else — hold the lock here too (uncontended).
+        with self._lock:
+            self._valid_bytes = len(lines[0].encode("utf-8"))
+            self._load_lines_locked(lines[1:])
+
+    def _load_lines_locked(self, lines: list) -> None:
+        for ln in lines:
             if not ln.endswith("\n"):
                 # Killed mid-append: everything before this line is intact,
                 # which is the resume contract (the partial tail — even a
@@ -193,28 +226,121 @@ class RunManifest:
             self._valid_bytes += len(ln.encode("utf-8"))
             if rec.get("kind") == "record":
                 self._completed[int(rec["index"])] = rec
-        return True
+                # Resolved: the admit payload need not stay resident.
+                self._admitted.pop(int(rec["index"]), None)
+            elif rec.get("kind") == "admit":
+                if int(rec["index"]) in self._completed:
+                    # An admit AFTER a completion means the id was reused
+                    # for a NEW request (the broker discards a completion
+                    # only on identity mismatch before re-admitting) — the
+                    # old record must not shadow the newer admit, or the
+                    # reused request silently vanishes from restart
+                    # re-execution.
+                    self._completed.pop(int(rec["index"]))
+                self._admitted[int(rec["index"])] = rec
+            elif rec.get("kind") == "fail":
+                # Terminal failure: the admit is RESOLVED (delivered as an
+                # error) — not replayable, not re-executed on restart, and
+                # the id is free for a fresh admit.
+                self._admitted.pop(int(rec["index"]), None)
 
     # -- progress ------------------------------------------------------------
 
-    def completed(self, index: int, name: str, n_symbols: int) -> Optional[dict]:
+    def completed(self, index: int, name: str, n_symbols: int,
+                  *, discard_mismatch: bool = True) -> Optional[dict]:
         """The completion record for this (index, name, size) — or None if
         it must be (re)computed.  Identity mismatches (same index, different
-        record) discard the stale entry loudly."""
-        rec = self._completed.get(index)
-        if rec is None:
-            return None
-        if rec.get("name") != name or int(rec.get("n_symbols", -1)) != n_symbols:
-            log.warning(
-                "manifest %s: record %d is %r (%d symbols) on disk but %r "
-                "(%d symbols) in the input; recomputing it",
-                self.path, index, rec.get("name"), rec.get("n_symbols"),
-                name, n_symbols,
+        record) discard the stale entry loudly — unless
+        ``discard_mismatch=False`` (the serve broker's in-life duplicate
+        probe: a colliding id from ANOTHER client must not destroy the
+        legitimate owner's replay entry)."""
+        with self._lock:
+            rec = self._completed.get(index)
+            if rec is None:
+                return None
+            if rec.get("name") != name or int(rec.get("n_symbols", -1)) != n_symbols:
+                if discard_mismatch:
+                    log.warning(
+                        "manifest %s: record %d is %r (%d symbols) on disk "
+                        "but %r (%d symbols) in the input; recomputing it",
+                        self.path, index, rec.get("name"),
+                        rec.get("n_symbols"), name, n_symbols,
+                    )
+                    del self._completed[index]
+                return None
+            self.skipped += 1
+            return rec
+
+    def record_admitted(
+        self,
+        index: int,
+        name: str,
+        n_symbols: int,
+        *,
+        payload: Optional[dict] = None,
+    ) -> None:
+        """Phase 1 of the two-phase journal: journal an ACCEPTED request
+        BEFORE it becomes visible to any flush consumer (write-ahead
+        ordering — the caller must hold the request back until this
+        returns).  ``payload`` must carry everything needed to re-execute
+        the request after a crash (the serve broker journals tenant / kind
+        / name / model + the encoded symbols).  Idempotent per index: a
+        resumed run's re-queue of a journaled request does not re-admit."""
+        with self._lock:
+            if index in self._completed or index in self._admitted:
+                return
+            rec = {
+                "kind": "admit",
+                "index": int(index),
+                "name": name,
+                "n_symbols": int(n_symbols),
+                "payload": payload,
+            }
+            # In-memory: a payload-FREE stub.  Nothing reads payloads
+            # in-life (only the resume loader consumes them, from disk),
+            # and keeping them resident would cost ~1.33x every queued
+            # request's symbol bytes in dead base64.
+            self._admitted[index] = {k: v for k, v in rec.items()
+                                     if k != "payload"}
+            self._append_locked(rec)
+
+    def has_completion(self, index: int, name: str, n_symbols: int) -> bool:
+        """Side-effect-free peek: does a matching completion exist?  (No
+        ``skipped`` count, no mismatch discard — the broker's pre-lock
+        check for skipping the journal-payload encode on replay-bound
+        re-submissions.)"""
+        with self._lock:
+            rec = self._completed.get(index)
+            return (
+                rec is not None
+                and rec.get("name") == name
+                and int(rec.get("n_symbols", -1)) == n_symbols
             )
-            del self._completed[index]
-            return None
-        self.skipped += 1
-        return rec
+
+    def record_failed(self, index: int) -> None:
+        """Terminal resolution of an admit whose request FAILED (the error
+        was delivered to the client): the entry leaves the re-execution
+        set — a nightly-restarted daemon must not re-run its historical
+        bad requests — and the id becomes admittable again, so a client
+        retrying the id (or reusing it for a new record) gets a FRESH
+        write-ahead admit line with the new payload."""
+        with self._lock:
+            if index in self._admitted:
+                self._admitted.pop(index)
+                self._append_locked({"kind": "fail", "index": int(index)})
+
+    def n_completed(self) -> int:
+        with self._lock:
+            return len(self._completed)
+
+    def admitted_incomplete(self) -> list:
+        """Admit records with no matching completion, in index order — the
+        restart re-execution set (phase 2 never happened for these)."""
+        with self._lock:
+            return [
+                rec for idx, rec in sorted(self._admitted.items())
+                if idx not in self._completed
+            ]
 
     def record_done(
         self,
@@ -227,26 +353,35 @@ class RunManifest:
         n_spans: int = 1,
     ) -> None:
         """Mark one record complete (idempotent for resumed entries)."""
-        if index in self._completed:
-            return
-        rec = {
-            "kind": "record",
-            "index": int(index),
-            "name": name,
-            "n_symbols": int(n_symbols),
-            "n_spans": int(n_spans),
-            "calls": calls_to_wire(calls),
-            "conf_sum": None if conf_sum is None else float(conf_sum).hex(),
-        }
-        self._completed[index] = rec
-        self._append(rec)
+        with self._lock:
+            if index in self._completed:
+                return
+            rec = {
+                "kind": "record",
+                "index": int(index),
+                "name": name,
+                "n_symbols": int(n_symbols),
+                "n_spans": int(n_spans),
+                "calls": calls_to_wire(calls),
+                "conf_sum": None if conf_sum is None else float(conf_sum).hex(),
+            }
+            self._completed[index] = rec
+            # The admit entry (and its base64 payload — ~1.33x the symbol
+            # bytes) is resolved: drop it, or a long-lived daemon retains
+            # every request's input in memory forever.
+            self._admitted.pop(index, None)
+            self._append_locked(rec)
 
     def span_done(self, index: int, span: int) -> None:
         """Progress line for one span of a multi-span record (diagnostics
         for killed runs; resume granularity stays the record)."""
-        self._append({"kind": "span", "index": int(index), "span": int(span)})
+        with self._lock:
+            self._append_locked(
+                {"kind": "span", "index": int(index), "span": int(span)}
+            )
 
-    def _append(self, rec: dict) -> None:
+    def _append_locked(self, rec: dict) -> None:
+        # _locked suffix: callers hold self._lock (the graftsync convention).
         self._f.write(json.dumps(rec) + "\n")
         # Flush per line: a crash loses at most the line being written (the
         # loader drops a truncated tail).  No fsync — per-record durability
@@ -257,6 +392,9 @@ class RunManifest:
     # -- lifecycle -----------------------------------------------------------
 
     def close(self) -> None:
+        # No lock: lifecycle belongs to the owning thread (the broker's
+        # close path, after every flush consumer has stopped); file close
+        # is idempotent.
         if not self._f.closed:
             self._f.close()
 

@@ -43,6 +43,7 @@ from typing import Callable, Optional
 
 from cpgisland_tpu import obs
 from cpgisland_tpu.obs.ledger import RecompileError
+from cpgisland_tpu.resilience import faultplan
 
 log = logging.getLogger(__name__)
 
@@ -100,6 +101,7 @@ class DispatchSupervisor:
         name: str = "serve",
         sentinel=None,
         breaker=None,
+        monitor=None,
     ) -> None:
         from cpgisland_tpu.resilience import breaker as breaker_mod
 
@@ -107,6 +109,11 @@ class DispatchSupervisor:
         self.name = name
         self.sentinel = sentinel
         self.breaker = breaker if breaker is not None else breaker_mod.get_breaker()
+        # Optional health listener (the fleet's per-device state machine):
+        # record_fault(error) / record_slow(wall_s) / record_success() are
+        # called alongside the breaker accounting, so the device-level view
+        # sees exactly the signals the engine-level view does.
+        self.monitor = monitor
         self.retries = 0  # total retries performed (tests / telemetry)
         # Deterministic per-supervisor jitter stream: reproducible runs,
         # still decorrelated across workers (seeded by object identity).
@@ -134,13 +141,21 @@ class DispatchSupervisor:
         supervisor adds no sync of its own.
         """
         pol = self.policy
+        tag = f"{self.name}:{what}"
         attempt = 0
         while True:
             fn = thunk if attempt == 0 or fallback is None else fallback
             t0 = time.perf_counter()
             try:
+                # graftfault injection point: an injected fault/phantom is
+                # raised HERE, inside the try, so it flows through the real
+                # retry/breaker/monitor machinery like a relay fault would.
+                faultplan.check("dispatch", tag=tag)
                 out = fn()
-                dt = time.perf_counter() - t0
+                # graftfault "slow" plans pad the measured wall so the
+                # dispatch_slow escalation fires without sleeping.
+                dt = (time.perf_counter() - t0
+                      + faultplan.wall_pad("dispatch.wall", tag=tag))
                 if self.sentinel is not None:
                     # Raises PhantomResult (retryable) on a stale/phantom
                     # or implausibly fast result.
@@ -157,6 +172,14 @@ class DispatchSupervisor:
                         "threshold %.0f s) — transient relay slowdown?",
                         self.name, what, dt, pol.slow_attempt_s,
                     )
+                    # record_slow IS the slow dispatch's success
+                    # notification (not success-then-slow): the monitor
+                    # counts CONSECUTIVE slow dispatches, which a
+                    # record_success here would reset.
+                    if self.monitor is not None:
+                        self.monitor.record_slow(dt)
+                elif self.monitor is not None:
+                    self.monitor.record_success()
                 return out
             except pol.nonretryable:
                 raise
@@ -164,6 +187,8 @@ class DispatchSupervisor:
                 dt = time.perf_counter() - t0
                 if self.breaker is not None and engine is not None:
                     self.breaker.record_fault(engine, error=e)
+                if self.monitor is not None:
+                    self.monitor.record_fault(e)
                 attempt += 1
                 will_retry = attempt <= pol.max_retries
                 obs.event(

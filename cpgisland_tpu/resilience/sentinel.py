@@ -130,6 +130,11 @@ class IntegritySentinel:
         """Check one supervised unit's result; raises :class:`PhantomResult`
         on violation, returns None otherwise."""
         self.checks += 1
+        # graftfault injection point: a planted "phantom" here models the
+        # relay serving a stale result that the canary catches.
+        from cpgisland_tpu.resilience import faultplan
+
+        faultplan.check("sentinel", tag=what)
         path = _WHAT_PATH.get(what.split(".", 1)[0])
         rec = self.watchdog.check(what, items, seconds, path=path)
         if rec is not None:

@@ -23,6 +23,13 @@ This package composes the ingredients earlier PRs built for exactly this:
   thread draining the broker so transport-side parse/encode of flush n+1
   overlaps device compute of flush n (the RecordPrefetcher pattern, with
   the admission caps as the bounded queue).
+- :mod:`~cpgisland_tpu.serve.fleet` — the **device pool** (``--fleet``):
+  one cloned session set + flush worker per local device under the one
+  broker, with per-device health state machines (healthy -> suspect ->
+  quarantined -> half-open probe -> restored), flush failover (a flush
+  whose device faults past the retry budget requeues intact onto a
+  healthy device), and the never-kill slow-dispatch quarantine.  The
+  single fault domain of PRs 8-9 (one worker, one device) becomes N.
 - :mod:`~cpgisland_tpu.serve.transport` — the thin **wire layer**
   (stdin/stdout JSONL, or the multi-connection AF_UNIX socket mux:
   concurrent client connections, one reader thread each, results routed
@@ -48,6 +55,11 @@ from cpgisland_tpu.serve.broker import (  # noqa: F401
     RequestBroker,
     ServeRequest,
     ServeResult,
+)
+from cpgisland_tpu.serve.fleet import (  # noqa: F401
+    DeviceHealth,
+    DevicePool,
+    FleetConfig,
 )
 from cpgisland_tpu.serve.session import Session  # noqa: F401
 from cpgisland_tpu.serve.worker import ServeLoop  # noqa: F401

@@ -24,16 +24,31 @@ queue — it is one flush entry like any other.
 
 Fault domain: every blocking unit runs under the session's PR 5 dispatch
 supervisor, and faults feed the SESSION's breaker — one tenant session's
-kernel faults demote engines for that session only.
+kernel faults demote engines for that session only.  Under a device pool
+(``serve/fleet.py``) the flush cycle splits into :meth:`RequestBroker.
+take_flush` / :meth:`run_batch` / :meth:`finish_flush` so a flush whose
+DEVICE faults past the retry budget can be requeued intact onto a healthy
+device before completion is journaled or accounting runs;
+:meth:`flush_once` remains the single-consumer composition of the three.
 
-Restart story: with ``manifest_path``, every completed request appends a
-PR 5 manifest line keyed by request id; a restarted daemon (``resume=True``)
-fed the same request stream replays completed results bit-identically
-without touching the device.
+Restart story: with ``manifest_path`` the manifest is a TWO-PHASE
+admission journal.  Phase 1: ``submit`` journals every accepted request
+(an ``admit`` line with the re-executable payload) BEFORE it becomes
+visible to any flush consumer — write-ahead, so "submit returned" implies
+"journaled".  Phase 2: ``finish_flush`` journals the completion.  A
+restarted daemon (``resume=True``) replays completed requests
+bit-identically without touching the device AND re-queues every
+admitted-but-incomplete request for re-execution (``journal_replay``
+event) — no accepted request is ever silently dropped.  A re-executed
+request's id is released back to replay-eligibility on completion, so a
+reconnecting client that re-submits it gets the manifest replay (while it
+is still executing it gets the duplicate-id rejection and backs off —
+see ``tools/serve_client.py``).
 """
 
 from __future__ import annotations
 
+import base64
 import collections
 import dataclasses
 import logging
@@ -47,6 +62,7 @@ from cpgisland_tpu import obs
 from cpgisland_tpu import pipeline
 from cpgisland_tpu.ops import islands as islands_mod
 from cpgisland_tpu.ops.islands import IslandCalls
+from cpgisland_tpu.resilience import faultplan
 from cpgisland_tpu.serve.session import ModelRegistry, Session
 from cpgisland_tpu.utils import profiling
 
@@ -54,14 +70,27 @@ log = logging.getLogger(__name__)
 
 KINDS = ("decode", "posterior", "compare")
 
+# Device-shaped error classes: mirrors RetryPolicy.retryable's defaults
+# (RuntimeError covers jaxlib's XlaRuntimeError and PhantomResult) — the
+# ONE copy both failure-classification sites consult, so the fleet's
+# requeue trigger and the supervisor's retry set cannot drift casually.
+# (A custom RetryPolicy.retryable is not consulted here: exotic retryable
+# types simply don't trigger failover, which is the safe direction.)
+FAULT_SHAPED = (RuntimeError, TimeoutError)
+
 
 class Backpressure(RuntimeError):
     """Admission rejected a request (queue caps).  ``reason`` is the
-    machine-readable cause the transport surfaces to the client."""
+    machine-readable cause the transport surfaces to the client;
+    ``retry_after_s`` is a queue-depth-derived backoff hint (how long the
+    currently queued symbols should take to drain) so a reconnecting
+    client can back off instead of hot-looping on a saturated fleet."""
 
-    def __init__(self, msg: str, reason: str) -> None:
+    def __init__(self, msg: str, reason: str,
+                 retry_after_s: Optional[float] = None) -> None:
         super().__init__(msg)
         self.reason = reason
+        self.retry_after_s = retry_after_s
 
 
 @dataclasses.dataclass(frozen=True)
@@ -152,6 +181,11 @@ class ServeResult:
     route: str = ""  # flat | record | span | posterior | replay
     error: Optional[str] = None
     replayed: bool = False
+    # Failed with a DEVICE-shaped error (the supervisor's retryable set,
+    # past its budget) — the fleet's requeue trigger.  A request-shaped
+    # failure (ValueError/TypeError: malformed record, bad model) keeps
+    # fault=False and fails alone wherever it runs.
+    fault: bool = False
 
 
 @dataclasses.dataclass
@@ -219,6 +253,10 @@ class RequestBroker:
         self._closed = False
         self.manifest = None
         self._seen_ids: set = set()
+        # Ids re-queued from the admission journal on restart: released
+        # from _seen_ids on completion so a reconnecting client's
+        # re-submission gets the manifest replay.
+        self._journal_requeued: set = set()
         if manifest_path is not None:
             from cpgisland_tpu.resilience import manifest as manifest_mod
 
@@ -239,8 +277,76 @@ class RequestBroker:
                 },
                 resume=resume,
             )
+            if resume:
+                self._requeue_admitted()
+
+    def _requeue_admitted(self) -> None:
+        """Restart recovery, phase-1 side: re-queue every admitted-but-
+        incomplete journal entry for re-execution (no client is attached —
+        results are recomputed into the manifest, where a reconnecting
+        client's re-submission finds them).  Requests re-enter the queue
+        directly (they already passed admission in their first life; the
+        tenant caps were paid then)."""
+        pending = self.manifest.admitted_incomplete()
+        requeued = 0
+        with self._cv:
+            for rec in pending:
+                pay = rec.get("payload")
+                if not pay:
+                    log.warning(
+                        "serve journal: admit record %s has no payload; "
+                        "cannot re-execute it", rec.get("index"),
+                    )
+                    continue
+                symbols = np.frombuffer(
+                    base64.b64decode(pay["symbols"]), dtype=np.uint8
+                ).copy()
+                req = ServeRequest(
+                    id=int(rec["index"]), tenant=str(pay["tenant"]),
+                    kind=str(pay["kind"]), name=str(pay["name"]),
+                    symbols=symbols, t_submit=time.monotonic(),
+                    model=str(pay.get("model", "")),
+                )
+                if self._manifest_key(req) != rec.get("name"):
+                    log.warning(
+                        "serve journal: admit record %s no longer matches "
+                        "its key (%r vs %r); skipping re-execution",
+                        req.id, self._manifest_key(req), rec.get("name"),
+                    )
+                    continue
+                t = self._tenants.setdefault(req.tenant, _Tenant())
+                t.queued_requests += 1
+                t.queued_symbols += symbols.size
+                t.requests += 1
+                self._queue.append(req)
+                self._queued_ids.add(req.id)
+                self._queued_symbols += symbols.size
+                self._seen_ids.add(req.id)
+                self._journal_requeued.add(req.id)
+                requeued += 1
+            self._cv.notify_all()
+        if requeued or pending:
+            obs.event(
+                "journal_replay",
+                n_reexecuted=requeued,
+                n_completed=self.manifest.n_completed(),
+            )
+            log.info(
+                "serve journal: re-queued %d admitted-but-incomplete "
+                "request(s) for re-execution (%d completed request(s) "
+                "replay from the manifest)",
+                requeued, self.manifest.n_completed(),
+            )
 
     # -- admission -----------------------------------------------------------
+
+    def _retry_after_locked(self) -> float:
+        """Queue-depth-derived backoff hint: roughly how long the queued
+        symbols take to drain at one flush per deadline window, floored so
+        a client never busy-loops and capped so it never parks forever."""
+        depth = self._queued_symbols / float(max(1, self.config.flush_symbols))
+        per_flush = max(self.config.flush_deadline_s, 0.01)
+        return round(min(5.0, max(0.05, depth * per_flush)), 3)
 
     def _manifest_key(self, req: ServeRequest) -> str:
         # Tenant + kind + MODEL are part of the identity: a decode
@@ -353,6 +459,22 @@ class RequestBroker:
             symbols=symbols, t_submit=time.monotonic(),
             model=model, models=models_t,
         )
+        # Journal payload built OUTSIDE the cv: the base64 encode is pure
+        # CPU over the symbols, and holding the broker lock for it would
+        # stall every flush consumer and concurrent submitter for the
+        # duration (wasted for a rejected request, but rejection is the
+        # rare path).  Replay-bound re-submissions (a reconnect storm's
+        # common case) skip the encode via a side-effect-free peek — the
+        # admit branch can't be reached for them.
+        payload = None
+        if self.manifest is not None and not self.manifest.has_completion(
+            req.id, self._manifest_key(req), int(symbols.size)
+        ):
+            payload = {
+                "tenant": req.tenant, "kind": req.kind,
+                "name": req.name, "model": req.model,
+                "symbols": base64.b64encode(symbols.tobytes()).decode("ascii"),
+            }
         with self._cv:
             # Closed-check under the cv: _closed is written under it in
             # close(), and an unlocked read could admit a request into a
@@ -361,14 +483,28 @@ class RequestBroker:
                 raise RuntimeError("broker is closed")
             t = self._tenants.setdefault(req.tenant, _Tenant())
             if self.manifest is not None:
-                if req.id in self._seen_ids:
+                # Replay lookup FIRST: a reconnecting client re-submits an
+                # id whose first life COMPLETED (its response was lost with
+                # the connection) — that must replay from the manifest, not
+                # hit the duplicate rejection below, or the client's
+                # retry-on-duplicate loop never terminates.  The duplicate
+                # rejection then guards ids that are journaled but NOT yet
+                # completed (queued/executing/crash-requeued) — the states
+                # where a second live copy would collide.  For an id seen
+                # THIS life the lookup is a non-destructive peek: a
+                # colliding submit with a DIFFERENT identity must be
+                # rejected without destroying the legitimate owner's
+                # replay entry (discard-on-mismatch stays for fresh-life
+                # re-submissions, where changed content means recompute).
+                hit = self.manifest.completed(
+                    req.id, self._manifest_key(req), int(symbols.size),
+                    discard_mismatch=req.id not in self._seen_ids,
+                )
+                if hit is None and req.id in self._seen_ids:
                     raise ValueError(
                         f"duplicate request id {req.id} (manifest mode needs "
                         "unique ids — they key the completion log)"
                     )
-                hit = self.manifest.completed(
-                    req.id, self._manifest_key(req), int(symbols.size)
-                )
                 if hit is not None:
                     from cpgisland_tpu.resilience.manifest import calls_from_wire
 
@@ -408,6 +544,7 @@ class RequestBroker:
                 raise Backpressure(
                     f"tenant {req.tenant!r} queue is full "
                     f"({t.queued_requests} requests)", "tenant_requests",
+                    retry_after_s=self._retry_after_locked(),
                 )
             if t.queued_symbols + symbols.size > self.config.tenant_max_symbols:
                 t.rejected += 1
@@ -418,8 +555,22 @@ class RequestBroker:
                 raise Backpressure(
                     f"tenant {req.tenant!r} queued symbols would exceed "
                     f"{self.config.tenant_max_symbols}", "tenant_symbols",
+                    retry_after_s=self._retry_after_locked(),
                 )
             if self.manifest is not None:
+                # Two-phase journal, phase 1 (write-ahead): the admit line
+                # lands BEFORE the request is visible to any flush consumer
+                # (we still hold the cv), so "submit returned" implies
+                # "journaled" — a crash after this point re-executes the
+                # request on restart instead of dropping it.  The line is a
+                # buffered file write + flush, not in the graftsync
+                # blocking set; the manifest lock is a leaf.
+                faultplan.check("journal.pre_admit", tag=f"req{req.id}")
+                self.manifest.record_admitted(
+                    req.id, self._manifest_key(req), int(symbols.size),
+                    payload=payload,
+                )
+                faultplan.check("journal.post_admit", tag=f"req{req.id}")
                 self._seen_ids.add(req.id)
             t.queued_requests += 1
             t.queued_symbols += symbols.size
@@ -477,6 +628,26 @@ class RequestBroker:
             self._cv.wait(timeout)
             return self._ready_locked()
 
+    def poll_flush(self, idle_wait_s: float) -> bool:
+        """One worker-loop wait step: park on the flush condition (bounded
+        by the oldest request's deadline and ``idle_wait_s``) and report
+        whether a flush should run now.  THE shared cadence of the
+        single-loop worker (``serve/worker.py``) and every fleet device
+        worker (``serve/fleet.py``) — one copy, so the two drivers cannot
+        drift on deadline semantics."""
+        deadline = self.next_deadline_s()
+        timeout = (
+            idle_wait_s if deadline is None
+            else max(0.0, min(deadline, idle_wait_s))
+        )
+        if self.wait_ready(timeout):
+            return True
+        # Deadline may have just expired with work queued — let the
+        # broker decide; an empty queue is a no-op flush.
+        if self.next_deadline_s() is None:
+            return False
+        return self.flush_ready()
+
     def _take(self) -> tuple:
         """Pop (replayed results, flush batch) under the flush budget, in
         arrival order.  Always pops at least one queued request when any is
@@ -515,11 +686,59 @@ class RequestBroker:
     # graftcheck: hot-path
     def flush_once(self) -> list:
         """Take and execute ONE flush; returns its results (possibly empty
-        — a deadline firing on an empty queue is a no-op, not an error)."""
+        — a deadline firing on an empty queue is a no-op, not an error).
+        The single-consumer composition of :meth:`take_flush` /
+        :meth:`run_batch` / :meth:`finish_flush` (the fleet drives the
+        three separately so a faulted flush can be requeued onto another
+        device between run and finish)."""
         replayed, batch, t_taken = self._take()
         results = list(replayed)
         if batch:
             results.extend(self._run_flush(batch, t_taken))
+        return self.finish_flush(results, batch)
+
+    # graftcheck: hot-path
+    def take_flush(self) -> tuple:
+        """Pop (replayed results, batch, t_taken) under the flush budget —
+        the fleet worker's take step (popped requests stay in-flight until
+        :meth:`finish_flush` returns them)."""
+        return self._take()
+
+    # graftcheck: hot-path
+    def run_batch(self, batch: list, t_taken: float, *, registry=None,
+                  timer=None) -> list:
+        """Execute one taken batch WITHOUT completing it (no journal
+        completion, no tenant accounting): the fleet inspects the results
+        for device-shaped faults and either requeues the batch intact on
+        another device or hands everything to :meth:`finish_flush`.
+        ``registry`` routes execution through a per-device session set
+        (default: the broker's own); ``timer`` keeps per-worker phase
+        accounting off the shared PhaseTimer."""
+        return self._run_flush(batch, t_taken, registry=registry, timer=timer)
+
+    def fail_batch(self, batch: list, t_taken: float,
+                   error: BaseException) -> list:
+        """Synthesize failed results for a flush whose execution failed at
+        the FLUSH level (a fleet requeue budget exhausted, broker
+        internals) — admitted requests are never silently dropped, they
+        fail loudly."""
+        fault = isinstance(error, FAULT_SHAPED)
+        return [
+            ServeResult(
+                id=req.id, tenant=req.tenant, kind=req.kind, ok=False,
+                error=f"{type(error).__name__}: {error}",
+                n_symbols=int(req.symbols.size),
+                queue_s=t_taken - req.t_submit, fault=fault,
+            )
+            for req in batch
+        ]
+
+    # graftcheck: hot-path
+    def finish_flush(self, results: list, batch: list) -> list:
+        """Complete one flush: journal completions (two-phase journal,
+        phase 2), release failed/journal-requeued ids, tenant accounting.
+        Must be called exactly once per taken batch, with the full result
+        list (replayed results may ride along; they skip the journal)."""
         if self.manifest is not None:
             for r in results:
                 if r.ok and not r.replayed:
@@ -530,9 +749,15 @@ class RequestBroker:
                         # (shared) key and replay another request's result
                         # on resume, so fail loudly instead.
                         assert req is not None, r.id
+                        faultplan.check(
+                            "journal.pre_complete", tag=f"req{r.id}"
+                        )
                         self.manifest.record_done(
                             r.id, self._manifest_key(req),
                             r.n_symbols, calls=r.calls, conf_sum=r.conf_sum,
+                        )
+                        faultplan.check(
+                            "journal.post_complete", tag=f"req{r.id}"
                         )
                     except Exception:
                         # Journaling must never eat computed results: the
@@ -544,14 +769,42 @@ class RequestBroker:
                             "will re-execute it)", r.id,
                         )
                         break
-            # A FAILED request recorded nothing — free its id so the
-            # client can retry with the same id (the manifest keys replay
-            # by id, so minting a new one would break restart identity).
+            # A FAILED request resolves its admit with a terminal "fail"
+            # line (a restarted daemon must not re-execute known-failing
+            # requests forever) and frees its id so the client can retry
+            # with the same id — the retry writes a FRESH admit with the
+            # new payload (the manifest keys replay by id, so minting a
+            # new one would break restart identity).  A completed
+            # journal-requeued id is ALSO released: its result now lives
+            # in the manifest, and the reconnecting client's re-submission
+            # must find the replay, not a duplicate reject.
+            for r in results:
+                if not r.ok:
+                    try:
+                        self.manifest.record_failed(r.id)
+                    except Exception:
+                        log.exception(
+                            "serve: journaling the failure of request %d "
+                            "failed (a restarted daemon may re-execute "
+                            "it once)", r.id,
+                        )
             with self._lock:
                 for r in results:
                     if not r.ok:
+                        self._journal_requeued.discard(r.id)
+                        self._seen_ids.discard(r.id)
+                    elif r.id in self._journal_requeued:
+                        self._journal_requeued.discard(r.id)
                         self._seen_ids.discard(r.id)
         with self._lock:
+            # Flush counters HERE, not in _run_flush: a fleet-requeued
+            # flush executes more than once but completes exactly once —
+            # counting per execution would inflate the serve stats.
+            if batch:
+                self.flushes += 1
+                self.flushed_symbols += int(
+                    sum(req.symbols.size for req in batch)
+                )
             # Tenant accounting under the broker lock: submit (a transport
             # thread) mutates the same _Tenant rows concurrently with this
             # consumer-side tally — unlocked, the read-modify-writes tear.
@@ -580,30 +833,43 @@ class RequestBroker:
         return out
 
     # graftcheck: hot-path
-    def _run_flush(self, batch: list, t_taken: float) -> list:
+    def _run_flush(self, batch: list, t_taken: float, *, registry=None,
+                   timer=None) -> list:
         """Execute one coalesced flush: requests group by MODEL (the
         registry's per-model sessions — one model's faults stay in its
         own breaker domain), batch-eligible decode records of each model
         run as ONE flat reset-step stream through the shared pipeline
         helper, everything else runs its per-record shared unit, and
         compare requests fan over their member sessions.  All supervised,
-        all against the owning session's breaker."""
+        all against the owning session's breaker.  ``registry``/``timer``
+        default to the broker's own; the fleet passes its per-device
+        clones (sessions, breakers, prep handles all device-scoped)."""
+        reg = registry if registry is not None else self.registry
+        timer = timer if timer is not None else self._timer
         total = float(sum(r.symbols.size for r in batch))
         t0 = time.perf_counter()
         results: dict[int, ServeResult] = {}
         n_flat = n_singles = n_posts = 0
         compares: list = []
         with obs.span("serve.flush", items=total, unit="sym"):
+            # graftfault kill point: "mid-flush" — after every admit line,
+            # before any completion line.
+            faultplan.check("flush.enter", tag=f"n{len(batch)}")
+
             def fail(req, e: BaseException) -> None:
                 # The daemon outlives any one request: a unit whose
                 # supervisor gave up (or a malformed record) fails THAT
-                # request, loudly, and the flush continues.
+                # request, loudly, and the flush continues.  fault= marks
+                # device-shaped give-ups (the supervisor's retryable set)
+                # so the fleet can move the flush; request-shaped errors
+                # stay fault=False and fail alone wherever they run.
                 log.error("serve: request %d (%s) failed: %s",
                           req.id, req.kind, e)
                 results[req.id] = ServeResult(
                     id=req.id, tenant=req.tenant, kind=req.kind,
                     ok=False, error=f"{type(e).__name__}: {e}",
                     n_symbols=int(req.symbols.size),
+                    fault=isinstance(e, FAULT_SHAPED),
                 )
 
             by_model: dict = {}
@@ -616,7 +882,7 @@ class RequestBroker:
             # groups — the flush event reports the models SERVED.
             n_models = len(by_model)
             n_stacked = (
-                self._flush_decode_stacked(by_model, results)
+                self._flush_decode_stacked(by_model, results, reg, timer)
                 if self.config.stacked and len(by_model) >= 2
                 else 0
             )
@@ -626,28 +892,25 @@ class RequestBroker:
                     # A registered member carries its own island labeling;
                     # composition comes from the observations (the
                     # pipelines' island_states contract).
-                    isl = tuple(self.registry.member(model).island_states)
+                    isl = tuple(reg.member(model).island_states)
                     post_states, obs_based = isl, True
                 else:
                     isl = self.config.island_states
                     post_states, obs_based = self._post_states, self._obs_based
                 f, s, p = self._flush_group(
-                    self.registry.session(model), by_model[model], results,
+                    reg.session(model), by_model[model], results,
                     fail, island_states=isl, post_states=post_states,
-                    obs_based=obs_based,
+                    obs_based=obs_based, timer=timer,
                 )
                 n_flat += f
                 n_singles += s
                 n_posts += p
             for req in compares:
                 try:
-                    results[req.id] = self._compare_record(req)
+                    results[req.id] = self._compare_record(req, reg)
                 except Exception as e:
                     fail(req, e)
         wall = time.perf_counter() - t0
-        with self._lock:
-            self.flushes += 1
-            self.flushed_symbols += int(total)
         obs.event(
             "serve_flush", n_requests=len(batch), n_flat=n_flat,
             n_singles=n_singles, n_posterior=n_posts,
@@ -665,7 +928,7 @@ class RequestBroker:
     # graftcheck: hot-path
     def _flush_group(self, sess: Session, batch: list, results: dict,
                      fail, *, island_states, post_states,
-                     obs_based: bool) -> tuple:
+                     obs_based: bool, timer=None) -> tuple:
         """One model's slice of a flush (the pre-registry flush body, with
         the owning session and ITS island labeling threaded through).
         Returns (n_flat, n_singles, n_posterior) for the flush event."""
@@ -711,7 +974,7 @@ class RequestBroker:
                     use_device_islands=use_dev,
                     cap_box=cap_box,
                     want_paths=False,
-                    timer=self._timer,
+                    timer=timer if timer is not None else self._timer,
                     defer=False,
                     supervisor=sess.supervisor,
                     engine_label=eng,
@@ -754,7 +1017,8 @@ class RequestBroker:
         return len(flat), len(singles), len(posts)
 
     # graftcheck: hot-path
-    def _flush_decode_stacked(self, by_model: dict, results: dict) -> int:
+    def _flush_decode_stacked(self, by_model: dict, results: dict,
+                              reg, timer) -> int:
         """Mixed-model decode stacking: batch-eligible decode requests of
         >= 2 onehot models (one shared alphabet) coalesce into ONE stacked
         flat launch set; each record's calls come from its owning model's
@@ -766,7 +1030,7 @@ class RequestBroker:
         cfg = self.config
         cand = []
         for model in sorted(by_model):
-            sess = self.registry.session(model)
+            sess = reg.session(model)
             try:
                 eng = sess.decode_engine()
             except Exception:
@@ -795,7 +1059,7 @@ class RequestBroker:
         for m, (model, sess, flat) in enumerate(cand):
             params_list.append(sess.params)
             if model:
-                isl = tuple(self.registry.member(model).island_states)
+                isl = tuple(reg.member(model).island_states)
             else:
                 isl = cfg.island_states
             use_dev, cap_box = sess.island_policy(
@@ -814,8 +1078,8 @@ class RequestBroker:
                 params_list, batch, owners,
                 min_len=cfg.min_len, island_states_list=isl_list,
                 use_device_list=use_list, cap_boxes=caps,
-                timer=self._timer,
-                supervisor=self.registry.default.supervisor,
+                timer=timer,
+                supervisor=reg.default.supervisor,
             )
         except Exception as e:
             log.error(
@@ -843,7 +1107,7 @@ class RequestBroker:
         return len(handled)
 
     # graftcheck: hot-path
-    def _compare_record(self, req: ServeRequest) -> ServeResult:
+    def _compare_record(self, req: ServeRequest, reg=None) -> ServeResult:
         """One compare request: the family comparison over the registry's
         member sessions (family.compare_record — the same record units the
         posterior path runs, each member under ITS model's session, so
@@ -851,16 +1115,17 @@ class RequestBroker:
         standard ``calls`` field; per-model log-odds in ``compare``."""
         from cpgisland_tpu import family
 
-        members = [self.registry.member(n) for n in req.models]
+        reg = reg if reg is not None else self.registry
+        members = [reg.member(n) for n in req.models]
         rc = family.compare_record(
             members, req.symbols, record=req.name or ".",
             min_len=self.config.min_len,
-            sessions=self.registry.sessions_for(req.models),
+            sessions=reg.sessions_for(req.models),
             stacked=self.config.stacked,
             # ONE PreparedStreams handle per alphabet, shared across the
             # members of a stream — the stacked group's symbol-only prep
             # books against the registry, not any single member session.
-            streams_handle=self.registry.compare_streams,
+            streams_handle=reg.compare_streams,
         )
         return ServeResult(
             id=req.id, tenant=req.tenant, kind=req.kind,
@@ -1036,12 +1301,20 @@ class RequestBroker:
         }
 
     def close(self) -> None:
-        """Stop admitting; release the manifest.  (The session is the
-        caller's — a daemon dropping a tenant also calls session.close()
-        to evict its prepared-stream entries.)"""
+        """Stop admitting.  The manifest stays OPEN: the transports drain
+        everything already admitted AFTER close (shutdown-op semantics),
+        and those completions must still reach the journal — closing it
+        here silently dropped every post-shutdown completion line, so a
+        restarted daemon re-executed work it had in fact finished.  Call
+        :meth:`release` once the final drain is done.  (The session is
+        the caller's — a daemon dropping a tenant also calls
+        session.close() to evict its prepared-stream entries.)"""
         with self._cv:
             self._closed = True
             self._cv.notify_all()
+
+    def release(self) -> None:
+        """Release the manifest (idempotent) — after the LAST drain."""
         if self.manifest is not None:
             self.manifest.close()
 

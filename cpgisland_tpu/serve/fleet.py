@@ -1,0 +1,626 @@
+"""Fleet fault domains: a multi-device serve pool with health-probed failover.
+
+The reference's whole fault-tolerance story came from Hadoop MapReduce: a
+failed task transparently re-executed on another node, so one bad machine
+never killed a run (SURVEY.md §0).  The daemon built in PRs 8-9 had the
+OPPOSITE shape — strong dispatch-level resilience (supervisor retries,
+breakers, sentinel, manifests) but exactly ONE worker loop driving ONE
+device: a single fault domain where a wedged device stalls every tenant.
+This module is Hadoop's node-level story rebuilt at device granularity:
+
+- :class:`DevicePool` — one cloned session set (``Session.for_device``:
+  private breaker, own supervisor, own prepared-stream handle, own island
+  cap) + one flush worker thread per local device, all draining the ONE
+  existing :class:`~cpgisland_tpu.serve.broker.RequestBroker`.  Each
+  worker pins its dispatches with ``jax.default_device``; the flat
+  reset-step stream is geometry-independent (ROADMAP:93), so any device
+  can take any flush with bit-identical results and ZERO new kernels.
+  (Span-scale records still run the shared whole-mesh programs — the
+  worker is the fault domain being isolated, not mesh membership.)
+- :class:`DeviceHealth` — a per-device state machine (healthy -> suspect
+  -> quarantined -> half-open probe -> restored) fed by the supervisor
+  ``monitor`` hook, i.e. by the signals that already exist:
+  ``dispatch_fault`` attempts, sentinel
+  :class:`~cpgisland_tpu.resilience.sentinel.PhantomResult` detections,
+  and the ``dispatch_slow`` escalation.  A slow device is QUARANTINED,
+  never killed — the never-kill rule (CLAUDE.md: killing a JAX process
+  mid-TPU-execution wedges the relay's tunnel claim) is load-bearing: the
+  slow attempt always runs to completion and its results are delivered;
+  only FUTURE flushes route away.
+- **Flush failover** — a flush whose device faults past the supervisor's
+  retry budget (device-shaped errors: the retryable RuntimeError/
+  TimeoutError set) is requeued INTACT onto another device before any
+  completion is journaled or accounting runs (the broker's
+  take/run/finish split).  Requeues are ledger-counted
+  (``flush_requeued`` obs events), bounded (``max_requeues``), and
+  exclusion-tracked so the faulting device does not immediately take its
+  own flush back; the target re-preps any prepared streams against ITS
+  handles (per-device by construction — counted by the prepared cache,
+  never silent).  Per-request isolation is preserved: a poisoned REQUEST
+  (ValueError/TypeError) fails alone on whatever device runs it; a
+  poisoned DEVICE moves its whole flush.
+
+Thread contract (graftsync Layer 4): any thread submits to the broker; N
+device workers are each a single dispatcher for THEIR session set.  Pool
+state (the requeue deque, counters) lives under ``DevicePool._lock``;
+each DeviceHealth has its own leaf lock; neither is ever held across
+broker calls or dispatches.  Lock order: pool -> health (stats snapshot),
+health -> obs (event emission, the breaker's existing shape).
+
+graftfault (``resilience/faultplan.py``) drives all of the above
+deterministically in CI: plans target devices through the supervisor tag
+(session names embed the device label), and the chaos matrix asserts
+bit-identity against the fault-free run with zero dropped admitted
+requests.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import logging
+import threading
+import time
+from typing import Callable, Optional
+
+from cpgisland_tpu import obs
+from cpgisland_tpu.resilience.sentinel import PhantomResult
+from cpgisland_tpu.serve.broker import RequestBroker
+from cpgisland_tpu.serve.session import ModelRegistry
+from cpgisland_tpu.utils import profiling
+
+log = logging.getLogger(__name__)
+
+__all__ = ["DeviceHealth", "DevicePool", "FleetConfig"]
+
+HEALTHY = "healthy"
+SUSPECT = "suspect"
+QUARANTINED = "quarantined"
+PROBING = "probing"
+
+
+@dataclasses.dataclass(frozen=True)
+class FleetConfig:
+    """Health/failover policy for one :class:`DevicePool`.
+
+    ``fault_threshold``: consecutive device-shaped faults that quarantine
+    (the supervisor's per-attempt ``record_fault`` feeds this, so one unit
+    exhausting its retry budget is enough at the default).
+    ``phantom_threshold``: sentinel phantom detections quarantine sooner —
+    a device serving stale results is worse than one failing loudly.
+    ``slow_threshold``: ``dispatch_slow`` escalations (attempts past the
+    retry policy's ``slow_attempt_s``) that quarantine; the attempts
+    themselves always run to completion (never-kill).
+    ``cooldown_s``: quarantine length before a half-open probe flush is
+    admitted; ``now_fn`` makes the cooldown deterministic in tests (and is
+    forwarded to the per-device sessions' private breakers).
+    ``max_requeues``: failover budget per flush — past it the flush's
+    failures are DELIVERED (loudly) instead of bouncing forever.  The
+    budget also bounds the cost of a DETERMINISTIC request-shaped
+    RuntimeError that masquerades as a device fault (e.g. a record that
+    OOMs on every device): at most ``max_requeues`` extra flush
+    executions, then its failure is delivered and its co-batched
+    successes stand — the same attempt-budget shape Hadoop used for the
+    identical ambiguity.  ``requeue_horizon_s``: a flush is only requeued
+    if some non-excluded device could serve within this window (otherwise
+    failures are delivered rather than parking behind, say, an operator
+    drain with an effectively-infinite cooldown).
+
+    All strike thresholds count CONSECUTIVE evidence: any fast healthy
+    dispatch resets fault, phantom, and slow strikes alike — isolated
+    transients days apart can never accumulate into a quarantine.
+    """
+
+    fault_threshold: int = 3
+    phantom_threshold: int = 2
+    slow_threshold: int = 2
+    cooldown_s: float = 30.0
+    max_requeues: int = 2
+    requeue_horizon_s: float = 300.0
+    idle_wait_s: float = 0.05
+    quarantine_poll_s: float = 0.05
+    now_fn: Callable[[], float] = time.monotonic
+
+
+class DeviceHealth:
+    """Per-device health state machine (see module docstring).
+
+    Implements the supervisor ``monitor`` contract (``record_fault`` /
+    ``record_slow`` / ``record_success``), so a session cloned with this
+    as its monitor feeds it from every supervised dispatch.  All state is
+    guarded by ``_lock`` (a leaf except for obs event emission — the same
+    shape as the engine breaker's).  ``can_serve`` is consulted only by
+    the owning device's worker thread, so the half-open probe admission
+    (one flush) needs no cross-thread token.
+    """
+
+    def __init__(
+        self,
+        label: str,
+        *,
+        fault_threshold: int = 3,
+        phantom_threshold: int = 2,
+        slow_threshold: int = 2,
+        cooldown_s: float = 30.0,
+        now_fn: Callable[[], float] = time.monotonic,
+    ) -> None:
+        self.label = label
+        self.fault_threshold = int(fault_threshold)
+        self.phantom_threshold = int(phantom_threshold)
+        self.slow_threshold = int(slow_threshold)
+        self.cooldown_s = float(cooldown_s)
+        self.now_fn = now_fn
+        self._lock = threading.Lock()
+        self._state = HEALTHY
+        self._consecutive_faults = 0
+        self._phantom_strikes = 0
+        self._slow_strikes = 0
+        self._quarantined_at: Optional[float] = None
+        self.quarantines = 0
+        self.restores = 0
+
+    # -- supervisor monitor contract ----------------------------------------
+
+    def record_fault(self, error: Optional[BaseException] = None) -> None:
+        with self._lock:
+            self._consecutive_faults += 1
+            phantom = isinstance(error, PhantomResult)
+            if phantom:
+                self._phantom_strikes += 1
+            if self._state == PROBING:
+                self._quarantine_locked("probe_failed", error)
+            elif self._state == QUARANTINED:
+                pass  # already out of rotation; nothing escalates further
+            elif self._consecutive_faults >= self.fault_threshold:
+                self._quarantine_locked("faults", error)
+            elif phantom and self._phantom_strikes >= self.phantom_threshold:
+                self._quarantine_locked("phantom", error)
+            else:
+                self._state = SUSPECT
+
+    def record_slow(self, wall_s: float) -> None:
+        """A dispatch that SUCCEEDED but blew past the slow-attempt wall
+        (the supervisor calls this INSTEAD of record_success for slow
+        attempts, so slow strikes count CONSECUTIVE slow dispatches — a
+        fast success in between resets them, and CLAUDE.md's occasional
+        transient slowdown can never accumulate across days into a
+        quarantine)."""
+        with self._lock:
+            self._consecutive_faults = 0  # the dispatch did succeed
+            if self._state == QUARANTINED:
+                return
+            if self._state == PROBING:
+                # A probe that crawls home is not a recovery: re-quarantine
+                # for a fresh cooldown rather than restoring a device that
+                # is still degraded.
+                self._quarantine_locked("slow", None, wall_s=wall_s)
+                return
+            self._slow_strikes += 1
+            if self._slow_strikes >= self.slow_threshold:
+                # QUARANTINE instead of killing: the slow attempt already
+                # ran to completion (never-kill rule) and its results are
+                # delivered — only future flushes route away.
+                self._quarantine_locked("slow", None, wall_s=wall_s)
+
+    def record_success(self) -> None:
+        with self._lock:
+            self._consecutive_faults = 0
+            # Every strike family is consecutive-evidence, not a lifetime
+            # counter: a healthy fast dispatch clears them all.
+            self._phantom_strikes = 0
+            self._slow_strikes = 0
+            if self._state == PROBING:
+                self._state = HEALTHY
+                self._quarantined_at = None
+                self.restores += 1
+                obs.event(
+                    "device_restored", device=self.label,
+                    quarantines=self.quarantines,
+                )
+                log.info(
+                    "fleet: device %s restored (half-open probe flush "
+                    "succeeded)", self.label,
+                )
+            elif self._state == SUSPECT:
+                self._state = HEALTHY
+
+    # -- worker-side gating ---------------------------------------------------
+
+    def can_serve(self) -> bool:
+        """May the owning worker take a flush now?  After the cooldown the
+        state flips quarantined -> probing and the NEXT flush the worker
+        takes is the probe (whose success/fault then restores or
+        re-quarantines).  PROBING keeps answering True: only the owning
+        thread consults its own health, and it runs one flush at a time,
+        so a single probe is structural — returning False here instead
+        would park a probing worker forever when the queue happened to be
+        empty at flip time."""
+        with self._lock:
+            if self._state in (HEALTHY, SUSPECT, PROBING):
+                return True
+            if (
+                self._quarantined_at is not None
+                and self.now_fn() - self._quarantined_at >= self.cooldown_s
+            ):
+                self._state = PROBING
+                log.info(
+                    "fleet: device %s cooldown elapsed; admitting a "
+                    "half-open probe flush", self.label,
+                )
+                return True
+            return False
+
+    def force_quarantine(self, reason: str = "operator") -> None:
+        """Pull a device out of rotation directly (ops drain hook; tests
+        use it to stage deterministic failover scenarios)."""
+        with self._lock:
+            if self._state != QUARANTINED:
+                self._quarantine_locked(reason, None)
+
+    def _quarantine_locked(self, reason: str, error, *,
+                           wall_s: Optional[float] = None) -> None:
+        # _locked suffix: callers hold self._lock (the graftsync convention).
+        self._state = QUARANTINED
+        self._quarantined_at = self.now_fn()
+        self.quarantines += 1
+        faults = self._consecutive_faults
+        self._consecutive_faults = 0
+        self._phantom_strikes = 0
+        self._slow_strikes = 0
+        obs.event(
+            "device_quarantined",
+            device=self.label,
+            reason=reason,
+            consecutive_faults=faults,
+            cooldown_s=self.cooldown_s,
+            wall_s=None if wall_s is None else round(wall_s, 3),
+            error=(f"{type(error).__name__}: {error}"[:200] if error else None),
+        )
+        log.warning(
+            "fleet: device %s QUARANTINED (%s) for %.0f s; its flushes "
+            "requeue onto healthy devices, a half-open probe follows the "
+            "cooldown", self.label, reason, self.cooldown_s,
+        )
+
+    def eta_s(self) -> float:
+        """Seconds until this device could plausibly serve again: 0 while
+        healthy/suspect/probing, the remaining cooldown while quarantined.
+        The pool's requeue eligibility check — a flush must never be
+        parked behind a device that is effectively gone (an operator
+        drain with a huge cooldown)."""
+        with self._lock:
+            if self._state != QUARANTINED or self._quarantined_at is None:
+                return 0.0
+            return max(
+                0.0,
+                self.cooldown_s - (self.now_fn() - self._quarantined_at),
+            )
+
+    def state(self) -> str:
+        with self._lock:
+            return self._state
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "state": self._state,
+                "consecutive_faults": self._consecutive_faults,
+                "phantom_strikes": self._phantom_strikes,
+                "slow_strikes": self._slow_strikes,
+                "quarantines": self.quarantines,
+                "restores": self.restores,
+            }
+
+
+@dataclasses.dataclass
+class _PendingFlush:
+    """A taken-but-unfinished flush riding the failover queue."""
+
+    batch: list
+    t_taken: float
+    excluded: set = dataclasses.field(default_factory=set)
+    requeues: int = 0
+
+
+class _DeviceWorker:
+    """One device's flush loop: a clone of the ServeLoop cadence with
+    health gating in front and the requeue queue ahead of the broker."""
+
+    def __init__(self, pool: "DevicePool", idx: int, device, label: str,
+                 registry: ModelRegistry, health: DeviceHealth) -> None:
+        self.pool = pool
+        self.idx = idx
+        self.device = device
+        self.label = label
+        self.registry = registry
+        self.health = health
+        self.flushes = 0  # this device's finished flushes (stats; own thread)
+        self._timer = profiling.PhaseTimer()  # per-worker: no shared-timer race
+        self._thread = threading.Thread(
+            target=self._run, name=f"cpgisland-fleet-{label}", daemon=True
+        )
+
+    def start(self) -> None:
+        self._thread.start()
+
+    def join(self, timeout: Optional[float] = None) -> None:
+        if self._thread.is_alive():
+            self._thread.join(timeout)
+
+    # graftcheck: hot-path
+    def _run(self) -> None:
+        pool = self.pool
+        broker = pool.broker
+        cfg = pool.config
+        while not pool._stop.is_set() and not broker.closed:
+            if not self.health.can_serve():
+                # Parked out of rotation: bounded wait so cooldown expiry
+                # (possibly on an injected clock) is picked up promptly.
+                with pool._cv:
+                    pool._cv.wait(cfg.quarantine_poll_s)
+                continue
+            pf = pool._take_requeued(self)
+            if pf is None:
+                if not broker.poll_flush(cfg.idle_wait_s):
+                    continue
+                replayed, batch, t_taken = broker.take_flush()
+                if replayed:
+                    # Manifest replays carry no device work — finish and
+                    # deliver them immediately, whatever happens to the
+                    # batch next.
+                    for r in broker.finish_flush(list(replayed), []):
+                        pool._deliver(r)
+                if not batch:
+                    continue
+                pf = _PendingFlush(batch, t_taken)
+            self._execute(pf)
+        log.debug("fleet: worker %s exiting", self.label)
+
+    # graftcheck: hot-path
+    def _execute(self, pf: _PendingFlush) -> None:
+        import jax
+
+        pool = self.pool
+        broker = pool.broker
+        was_probing = self.health.state() == PROBING
+        try:
+            # Pin this worker's dispatches to ITS device (thread-local
+            # config: concurrent workers don't interfere).  The flat
+            # stream is geometry-independent — any device, same bits.
+            with jax.default_device(self.device):
+                results = broker.run_batch(
+                    pf.batch, pf.t_taken,
+                    registry=self.registry, timer=self._timer,
+                )
+        except Exception as e:
+            # Flush-LEVEL failure (broker internals — per-request units
+            # are isolated inside run_batch).  Treat like a device fault:
+            # try another device, else fail the requests loudly (admitted
+            # requests are never dropped).
+            log.exception(
+                "fleet: flush-level failure on %s", self.label
+            )
+            self.health.record_fault(e)
+            results = broker.fail_batch(pf.batch, pf.t_taken, e)
+        faulted = [r for r in results if r.fault]
+        if faulted and pool._offer_requeue(pf, self):
+            obs.event(
+                "flush_requeued",
+                device=self.label,
+                n_requests=len(pf.batch),
+                n_faulted=len(faulted),
+                symbols=int(sum(r.symbols.size for r in pf.batch)),
+                requeue=pf.requeues,
+                error=(faulted[0].error or "")[:200],
+            )
+            log.warning(
+                "fleet: requeueing flush of %d request(s) off %s "
+                "(%d device-shaped failure(s); requeue %d/%d) — the "
+                "target device re-preps against its own stream handles",
+                len(pf.batch), self.label, len(faulted), pf.requeues,
+                pool.config.max_requeues,
+            )
+            return
+        if was_probing and not faulted:
+            # A probe flush with no supervised unit (e.g. all-empty
+            # records) would otherwise leave the state machine parked in
+            # PROBING; a fault-free probe is a success by definition.
+            self.health.record_success()
+        for r in broker.finish_flush(results, pf.batch):
+            pool._deliver(r)
+        self.flushes += 1
+
+
+class DevicePool:
+    """One Session set + flush worker per local device under ONE broker
+    (see module docstring).  ``start(on_result)``/``stop()`` mirror the
+    single-loop :class:`~cpgisland_tpu.serve.worker.ServeLoop` so the
+    transport layer swaps one for the other."""
+
+    def __init__(self, broker: RequestBroker, devices,
+                 config: Optional[FleetConfig] = None) -> None:
+        if not devices:
+            raise ValueError("DevicePool needs at least one device")
+        self.broker = broker
+        self.config = config if config is not None else FleetConfig()
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self._requeued: collections.deque = collections.deque()
+        self._stop = threading.Event()
+        self.on_result: Optional[Callable] = None
+        self.requeues = 0  # guarded by _lock
+        self.failed_over = 0  # flushes delivered after >=1 requeue (guarded)
+        cfg = self.config
+        self.workers: list = []
+        for i, dev in enumerate(devices):
+            label = f"dev{i}"
+            health = DeviceHealth(
+                label,
+                fault_threshold=cfg.fault_threshold,
+                phantom_threshold=cfg.phantom_threshold,
+                slow_threshold=cfg.slow_threshold,
+                cooldown_s=cfg.cooldown_s,
+                now_fn=cfg.now_fn,
+            )
+            registry = self._registry_for(broker.registry, label, health)
+            self.workers.append(
+                _DeviceWorker(self, i, dev, label, registry, health)
+            )
+
+    @classmethod
+    def build(cls, broker: RequestBroker, n_devices: Optional[int] = None,
+              config: Optional[FleetConfig] = None) -> "DevicePool":
+        """Pool over the first ``n_devices`` local devices (None = all)."""
+        import jax
+
+        devs = jax.local_devices()
+        if n_devices is not None:
+            if n_devices < 1 or n_devices > len(devs):
+                raise ValueError(
+                    f"--fleet {n_devices}: have {len(devs)} local device(s)"
+                )
+            devs = devs[:n_devices]
+        return cls(broker, devs, config=config)
+
+    def _registry_for(self, registry: ModelRegistry, label: str,
+                      health: DeviceHealth) -> ModelRegistry:
+        """Clone the broker's registry for one device: every session gets
+        a device-scoped twin whose supervisor feeds this device's health."""
+        cfg = self.config
+        default = registry.default.for_device(
+            label, monitor=health, now_fn=cfg.now_fn
+        )
+        reg = ModelRegistry(default)
+        for name, member, sess in registry.entries():
+            reg.register(
+                member,
+                session=sess.for_device(
+                    label, monitor=health, now_fn=cfg.now_fn
+                ),
+            )
+        return reg
+
+    # -- lifecycle ------------------------------------------------------------
+
+    def start(self, on_result: Callable) -> "DevicePool":
+        self.on_result = on_result
+        for w in self.workers:
+            w.start()
+        log.info(
+            "fleet: device pool started (%d device(s): %s)",
+            len(self.workers), ", ".join(w.label for w in self.workers),
+        )
+        return self
+
+    def stop(self, join: bool = True) -> None:
+        """Stop the workers; any flush still riding the failover queue is
+        finished INLINE on this thread (single consumer again) so no
+        admitted request is dropped at shutdown."""
+        self._stop.set()
+        with self._cv:
+            self._cv.notify_all()
+        # Wake workers parked on the broker's flush condition.
+        with self.broker._cv:
+            self.broker._cv.notify_all()
+        if join:
+            for w in self.workers:
+                w.join()
+        while True:
+            with self._lock:
+                pf = self._requeued.popleft() if self._requeued else None
+            if pf is None:
+                break
+            try:
+                results = self.broker.run_batch(pf.batch, pf.t_taken)
+            except Exception as e:
+                log.exception("fleet: shutdown drain of a requeued flush "
+                              "failed")
+                results = self.broker.fail_batch(pf.batch, pf.t_taken, e)
+            for r in self.broker.finish_flush(results, pf.batch):
+                self._deliver(r)
+
+    def close(self) -> None:
+        """Release every per-device session's prepared-stream entries
+        (the pool owns its clones; the broker's own registry belongs to
+        the caller)."""
+        for w in self.workers:
+            w.registry.close()
+            w.registry.default.close()
+
+    # -- failover plumbing ----------------------------------------------------
+
+    def _offer_requeue(self, pf: _PendingFlush, worker: _DeviceWorker) -> bool:
+        """Requeue ``pf`` off ``worker`` if the failover budget allows AND
+        some other device could plausibly take it within one cooldown
+        window; False = deliver the failures instead (loudly — a flush
+        must never park behind a fleet with no coming-back device, e.g.
+        an operator drain with an effectively-infinite cooldown)."""
+        # Eligibility computed OUTSIDE the pool lock (health locks are
+        # their own leaves): ``eligible`` = any OTHER device that could
+        # serve within the horizon; ``takers`` = the not-yet-excluded
+        # subset.
+        excluded = pf.excluded | {worker.idx}
+        horizon = self.config.requeue_horizon_s
+        eligible = [
+            w for w in self.workers
+            if w.idx != worker.idx and w.health.eta_s() <= horizon
+        ]
+        takers = [w for w in eligible if w.idx not in excluded]
+        with self._cv:
+            if len(self.workers) < 2 or not eligible:
+                return False
+            if pf.requeues >= self.config.max_requeues:
+                return False
+            if takers:
+                pf.excluded = excluded
+            else:
+                # Every eligible device has had (and fumbled) this flush —
+                # the fault may be transient; keep only the freshest
+                # faulter excluded so the bounded budget, not the
+                # exclusion set, decides when to stop.
+                pf.excluded = {worker.idx}
+            pf.requeues += 1
+            self._requeued.append(pf)
+            self.requeues += 1
+            if pf.requeues == 1:
+                self.failed_over += 1  # distinct flushes that failed over
+            self._cv.notify_all()
+        # Wake workers parked on the broker condition so the requeued
+        # flush is picked up without waiting out an idle poll.
+        with self.broker._cv:
+            self.broker._cv.notify_all()
+        return True
+
+    def _take_requeued(self, worker: _DeviceWorker):
+        with self._lock:
+            for i, pf in enumerate(self._requeued):
+                if worker.idx not in pf.excluded:
+                    del self._requeued[i]
+                    return pf
+        return None
+
+    def _deliver(self, r) -> None:
+        cb = self.on_result
+        if cb is None:
+            return
+        try:
+            cb(r)
+        except Exception:
+            log.exception("fleet: on_result failed for request %s", r.id)
+
+    # -- introspection --------------------------------------------------------
+
+    def stats(self) -> dict:
+        with self._lock:
+            requeues = self.requeues
+            failed_over = self.failed_over
+            pending_requeued = len(self._requeued)
+        return {
+            "devices": {
+                w.label: dict(w.health.snapshot(), flushes=w.flushes)
+                for w in self.workers
+            },
+            "requeues": requeues,
+            "failed_over": failed_over,
+            "pending_requeued": pending_requeued,
+        }

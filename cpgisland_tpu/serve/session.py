@@ -63,6 +63,8 @@ class Session:
         private_breaker: bool = False,
         breaker=None,
         retry_policy=None,
+        monitor=None,
+        now_fn=None,
     ) -> None:
         self.params = params
         self.engine = engine
@@ -70,10 +72,14 @@ class Session:
         self.name = name
         if breaker is None:
             breaker = (
-                resilience.EngineBreaker() if private_breaker
+                resilience.EngineBreaker(now_fn=now_fn) if private_breaker
                 else resilience.get_breaker()
             )
         self.breaker = breaker
+        # monitor: the fleet's per-device health listener, threaded into
+        # every supervised dispatch of this session (see policy.
+        # DispatchSupervisor); now_fn: deterministic clock for the private
+        # breaker's cooldown (and remembered for for_device clones).
         self.supervisor = resilience.DispatchSupervisor(
             retry_policy,
             name=name,
@@ -81,14 +87,37 @@ class Session:
                 resilience.IntegritySentinel() if integrity_check else None
             ),
             breaker=breaker,
+            monitor=monitor,
         )
         self._integrity_check = bool(integrity_check)
+        self._retry_policy = retry_policy
+        self._now_fn = now_fn
         self._island_cap = island_cap
         self._cap_box: Optional[list] = None
         self._lock = threading.Lock()
         from cpgisland_tpu.ops.prepared import PreparedStreams
 
         self.streams = PreparedStreams(params.n_symbols)
+
+    def for_device(self, label: str, *, monitor=None, now_fn=None) -> "Session":
+        """A per-device clone for the fleet (``serve/fleet.py``): the SAME
+        model and routing config, but its OWN private breaker, supervisor
+        (the single-dispatcher rule holds per device worker), prepared-
+        stream handle, and island cap box — one device's faults demote
+        engines and grow caps for that device only.  ``monitor`` is the
+        device's health state machine; the clone's supervisor feeds it."""
+        return Session(
+            self.params,
+            engine=self.engine,
+            island_engine=self.island_engine,
+            island_cap=self._island_cap,
+            integrity_check=self._integrity_check,
+            name=f"{self.name}@{label}",
+            private_breaker=True,
+            retry_policy=self._retry_policy,
+            monitor=monitor,
+            now_fn=now_fn if now_fn is not None else self._now_fn,
+        )
 
     # -- pipeline integration -----------------------------------------------
 
@@ -277,6 +306,14 @@ class ModelRegistry:
     def names(self) -> tuple:
         with self._lock:
             return tuple(self._entries)
+
+    def entries(self) -> tuple:
+        """(name, member, session) snapshot — the fleet's clone source
+        (``serve/fleet.py`` builds one registry per device from it)."""
+        with self._lock:
+            return tuple(
+                (name, m, s) for name, (m, s) in self._entries.items()
+            )
 
     def sessions_for(self, names) -> dict:
         """name -> Session map for a compare request's member set."""

@@ -72,6 +72,7 @@ from typing import IO
 
 import numpy as np
 
+from cpgisland_tpu.resilience import faultplan as faultplan_mod
 from cpgisland_tpu.serve.broker import Backpressure, RequestBroker, ServeResult
 from cpgisland_tpu.serve.worker import ServeLoop
 
@@ -178,6 +179,10 @@ def _admit_request(
             "id": rid if rid is not None else req.get("id"), "ok": False,
             "error": f"Backpressure: {e}", "reason": e.reason,
             "backpressure": True,
+            # Queue-depth-derived backoff hint: a reconnecting client
+            # sleeps this long instead of hot-looping on a saturated
+            # fleet (tools/serve_client.py honors it).
+            "retry_after_s": e.retry_after_s,
         })
     except (KeyError, ValueError, TypeError, RuntimeError) as e:
         write({
@@ -194,6 +199,7 @@ def serve_stream(
     *,
     use_worker: bool = True,
     invalid_symbols: str = "skip",
+    pool=None,
 ) -> int:
     """Serve a line stream until EOF or ``{"op": "shutdown"}``.
 
@@ -201,7 +207,9 @@ def serve_stream(
     (the daemon cadence: this thread's parse/encode overlaps the worker's
     device compute).  ``use_worker=False`` is the deterministic in-process
     mode (tests): flushes run inline on this thread whenever the broker
-    reports ready, and the stream drains at EOF.  Returns the number of
+    reports ready, and the stream drains at EOF.  ``pool`` (a started-able
+    :class:`~cpgisland_tpu.serve.fleet.DevicePool`) replaces the single
+    ServeLoop with one flush worker per device.  Returns the number of
     requests served.
     """
     wlock = threading.Lock()
@@ -233,7 +241,10 @@ def serve_stream(
             want_conf=want_conf.pop(r.id, False),
         ))
 
-    loop = ServeLoop(broker, on_result).start() if use_worker else None
+    if pool is not None:
+        loop = pool.start(on_result)
+    else:
+        loop = ServeLoop(broker, on_result).start() if use_worker else None
     try:
         for line in inp:
             line = line.strip()
@@ -340,24 +351,38 @@ def serve_main(args, params) -> int:
     """The ``cpgisland serve`` entry: stdio JSONL by default, a local
     AF_UNIX multi-connection socket mux with ``--socket PATH`` (concurrent
     client connections, all feeding the one broker; responses routed back
-    to the owning connection by request id)."""
+    to the owning connection by request id).  ``--fleet N`` drives the
+    broker with a :class:`~cpgisland_tpu.serve.fleet.DevicePool` over N
+    local devices instead of the single worker loop."""
     import sys
 
     broker = _build_broker(args, params)
+    pool = None
+    if getattr(args, "fleet", 0):
+        from cpgisland_tpu.serve.fleet import DevicePool
+
+        pool = DevicePool.build(broker, n_devices=args.fleet)
     try:
         if not args.socket:
             n = serve_stream(
                 sys.stdin, sys.stdout, broker,
-                invalid_symbols=args.invalid_symbols,
+                invalid_symbols=args.invalid_symbols, pool=pool,
             )
             log.info("serve: %d request(s) served", n)
             return 0
         return serve_socket(
-            args.socket, broker, invalid_symbols=args.invalid_symbols
+            args.socket, broker, invalid_symbols=args.invalid_symbols,
+            pool=pool,
         )
     finally:
         broker.close()
+        # The transports have drained by the time they return — NOW the
+        # journal may close (closing it inside broker.close() would lose
+        # the shutdown drain's completion lines).
+        broker.release()
         broker.registry.close()
+        if pool is not None:
+            pool.close()
 
 
 # ---------------------------------------------------------------------------
@@ -551,6 +576,11 @@ def _mux_read_loop(
         router.unroute(rid, client)
 
     for line in rf:
+        # graftfault injection point: a "disconnect" here models the
+        # connection dying mid-stream — the OSError takes the same
+        # drain-on-death path a real broken socket does.  (Placed OUTSIDE
+        # any lock: _MuxClient's write lock is a documented leaf.)
+        faultplan_mod.check("transport.read", tag=f"conn{client.cid}")
         line = line.strip()
         if not line:
             continue
@@ -646,19 +676,25 @@ def serve_socket(
     accept_poll_s: float = 0.5,
     drain_timeout_s: float = 600.0,
     write_timeout_s: float = 60.0,
+    pool=None,
 ) -> int:
     """Concurrent AF_UNIX JSONL server (see the module docstring's mux
     notes): one reader thread per client connection, ONE worker loop
-    executing flushes against the shared broker, results routed back by
-    request id.  ``{"op": "shutdown"}`` from any client stops the server
-    after everything admitted has been served.  ``write_timeout_s`` bounds
+    executing flushes against the shared broker (or a fleet
+    :class:`~cpgisland_tpu.serve.fleet.DevicePool` — one flush worker per
+    device — when ``pool`` is given), results routed back by request id.
+    ``{"op": "shutdown"}`` from any client stops the server after
+    everything admitted has been served.  ``write_timeout_s`` bounds
     each result write (a non-reading client is marked dead rather than
     allowed to stall the worker)."""
     import os
     import socket
 
     router = ResponseRouter(broker)
-    loop = ServeLoop(broker, router.deliver).start()
+    if pool is not None:
+        loop = pool.start(router.deliver)
+    else:
+        loop = ServeLoop(broker, router.deliver).start()
     conns: list[tuple] = []  # LIVE (thread, client, conn); dead are reaped
     n_served = 0
     if os.path.exists(path):

@@ -65,18 +65,11 @@ class ServeLoop:
     def _run(self) -> None:
         broker = self.broker
         while not self._stop.is_set() and not broker.closed:
-            deadline = broker.next_deadline_s()
-            timeout = (
-                self.IDLE_WAIT_S if deadline is None
-                else max(0.0, min(deadline, self.IDLE_WAIT_S))
-            )
-            if not broker.wait_ready(timeout):
-                # Deadline may have just expired with work queued — let the
-                # broker decide; an empty queue is a no-op flush.
-                if broker.next_deadline_s() is None:
-                    continue
-                if not broker.flush_ready():
-                    continue
+            # The shared wait cadence (broker.poll_flush): budget fill or
+            # oldest-request deadline, whichever first — the same step the
+            # fleet's device workers run.
+            if not broker.poll_flush(self.IDLE_WAIT_S):
+                continue
             try:
                 for result in broker.flush_once():
                     self.on_result(result)

@@ -1,0 +1,830 @@
+"""graftfault chaos harness (PR 15): every fleet failover path exercised by
+seeded, deterministic fault plans on the 8-virtual-device mesh, with output
+BIT-IDENTICAL to the fault-free run, zero dropped admitted requests, and
+the requeue/replay paths ledger-asserted.
+
+Layers:
+
+- unit: the DeviceHealth state machine (healthy -> suspect -> quarantined
+  -> half-open probe -> restored) on an injected clock; FaultPlan ordinal/
+  match semantics; the two-phase manifest journal; the breaker's ``now_fn``.
+- pool: a staged deterministic failover scenario (device faults past the
+  retry budget mid-flush -> quarantine -> requeue onto the only other
+  device -> probe -> restore), phantom-result quarantine, and the
+  never-kill slow-dispatch quarantine (the slow flush's results are
+  DELIVERED; only future flushes route away).
+- crash: SIGKILL (simulated — BaseException, nothing between the injection
+  point and this harness may catch it) planted at each journal phase
+  boundary; a restarted broker replays completed requests bit-identically
+  with zero duplicate device work and re-executes admitted-but-incomplete
+  ones.
+- wire: a connection dying mid-stream under the socket mux, recovered by
+  the client's reconnect-with-replay.
+- matrix: the seeded plan matrix (``faultplan.matrix``) swept over several
+  seeds — interleaving-invariant assertions only (bit-identity, no drops,
+  every injection ledgered).
+"""
+
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from cpgisland_tpu import obs, pipeline, resilience
+from cpgisland_tpu.models import presets
+from cpgisland_tpu.resilience import RetryPolicy, faultplan
+from cpgisland_tpu.resilience.faultplan import Fault, FaultPlan, ManualClock
+from cpgisland_tpu.serve import (
+    BrokerConfig,
+    DevicePool,
+    FleetConfig,
+    RequestBroker,
+    Session,
+)
+from cpgisland_tpu.serve.fleet import DeviceHealth
+
+FAST = RetryPolicy(backoff_base_s=0.0)  # max_retries=3 -> 4 attempts/unit
+ATTEMPTS = FAST.max_retries + 1
+
+
+@pytest.fixture(autouse=True)
+def _fresh_resilience_state():
+    resilience.reset()  # also disarms any leaked graftfault plan
+    yield
+    resilience.reset()
+
+
+def _gen_symbols(rng, n: int) -> np.ndarray:
+    bg = rng.choice(4, size=n, p=[0.3, 0.2, 0.2, 0.3])
+    k = max(1, n // 4)
+    bg[:k] = rng.choice(4, size=k, p=[0.1, 0.4, 0.4, 0.1])
+    return bg.astype(np.uint8)
+
+
+def _requests(seed=7, n=8):
+    rng = np.random.default_rng(seed)
+    return [
+        (
+            i,
+            f"rec{i}",
+            "decode" if i % 3 else "posterior",
+            _gen_symbols(rng, 600 + 137 * i),
+        )
+        for i in range(n)
+    ]
+
+
+def _calls_key(calls) -> list:
+    if calls is None:
+        return []
+    return [
+        (int(calls.beg[i]), int(calls.end[i]), int(calls.length[i]),
+         float(calls.gc_content[i]), float(calls.oe_ratio[i]))
+        for i in range(len(calls))
+    ]
+
+
+def _result_key(r) -> tuple:
+    return (r.kind, _calls_key(r.calls),
+            None if r.conf_sum is None else float(r.conf_sum).hex())
+
+
+def _assert_results_identical(got: dict, want: dict) -> None:
+    assert set(got) == set(want)
+    for rid in want:
+        assert got[rid].ok, (rid, got[rid].error)
+        assert _result_key(got[rid]) == _result_key(want[rid]), rid
+
+
+# ---------------------------------------------------------------------------
+# Unit: DeviceHealth state machine on an injected clock
+
+
+def test_device_health_full_cycle_on_manual_clock():
+    clock = ManualClock()
+    h = DeviceHealth("devX", fault_threshold=3, cooldown_s=30.0,
+                     now_fn=clock)
+    assert h.state() == "healthy" and h.can_serve()
+    h.record_fault(RuntimeError("f1"))
+    assert h.state() == "suspect" and h.can_serve()
+    h.record_success()
+    assert h.state() == "healthy"  # suspicion clears on success
+    for i in range(3):
+        h.record_fault(RuntimeError(f"f{i}"))
+    assert h.state() == "quarantined"
+    assert not h.can_serve()  # cooldown not elapsed
+    clock.advance(29.0)
+    assert not h.can_serve()
+    clock.advance(1.5)
+    assert h.can_serve()  # flips to the half-open probe
+    assert h.state() == "probing"
+    assert h.can_serve()  # idempotent: the owner thread's next flush IS
+    assert h.state() == "probing"  # the probe; no second thread exists
+    h.record_success()
+    assert h.state() == "healthy" and h.can_serve()
+    assert h.snapshot()["restores"] == 1
+
+
+def test_device_health_probe_failure_requarantines():
+    clock = ManualClock()
+    h = DeviceHealth("devX", fault_threshold=1, cooldown_s=10.0,
+                     now_fn=clock)
+    h.record_fault(RuntimeError("boom"))
+    assert h.state() == "quarantined"
+    clock.advance(11.0)
+    assert h.can_serve()  # probe admitted
+    h.record_fault(RuntimeError("probe boom"))
+    assert h.state() == "quarantined"  # fresh cooldown
+    assert not h.can_serve()
+    clock.advance(11.0)
+    assert h.can_serve()
+    assert h.snapshot()["quarantines"] == 2
+
+
+def test_device_health_phantom_and_slow_thresholds():
+    from cpgisland_tpu.resilience.sentinel import PhantomResult
+
+    h = DeviceHealth("devP", fault_threshold=10, phantom_threshold=2,
+                     now_fn=ManualClock())
+    h.record_fault(PhantomResult("stale"))
+    assert h.state() == "suspect"
+    h.record_fault(PhantomResult("stale again"))
+    assert h.state() == "quarantined"  # phantoms trip sooner than faults
+
+    h2 = DeviceHealth("devS", slow_threshold=2, now_fn=ManualClock())
+    h2.record_slow(400.0)
+    assert h2.state() == "healthy"  # slow alone never fails the attempt
+    h2.record_slow(500.0)
+    assert h2.state() == "quarantined"  # quarantined, never killed
+
+
+def test_device_health_strikes_reset_on_fast_success():
+    """Slow/phantom strikes count CONSECUTIVE evidence: a fast healthy
+    dispatch in between resets them, so isolated transients days apart
+    (CLAUDE.md's occasional ~20x slowdowns) can never accumulate into a
+    quarantine."""
+    from cpgisland_tpu.resilience.sentinel import PhantomResult
+
+    h = DeviceHealth("devR", slow_threshold=2, phantom_threshold=2,
+                     fault_threshold=10, now_fn=ManualClock())
+    h.record_slow(400.0)
+    h.record_success()  # fast success between the two slow dispatches
+    h.record_slow(400.0)
+    assert h.state() == "healthy"
+    h.record_fault(PhantomResult("stale"))
+    h.record_success()
+    h.record_fault(PhantomResult("stale"))
+    assert h.state() == "suspect"  # never two CONSECUTIVE phantoms
+
+
+def test_breaker_takes_now_fn_alias():
+    clock = ManualClock()
+    br = resilience.EngineBreaker(threshold=1, cooldown_s=20.0, now_fn=clock)
+    br.record_fault("decode.onehot")
+    assert br.tripped("decode.onehot")
+    clock.advance(21.0)
+    assert br.allowed("decode.onehot")  # half-open probe, no sleeping
+
+
+# ---------------------------------------------------------------------------
+# Unit: FaultPlan semantics
+
+
+def test_faultplan_ordinals_match_and_ledger():
+    plan = FaultPlan(
+        [Fault("p", kind="fault", nth=2, times=2, match="devA")],
+        name="unit",
+    )
+    with faultplan.active(plan):
+        faultplan.check("p", tag="devB:x")  # match filter: not counted
+        faultplan.check("p", tag="devA:x")  # arrival 1: below nth
+        for _ in range(2):  # arrivals 2, 3: fire
+            with pytest.raises(RuntimeError, match="graftfault"):
+                faultplan.check("p", tag="devA:x")
+        faultplan.check("p", tag="devA:x")  # arrival 4: window passed
+    assert [f["arrival"] for f in plan.injected] == [2, 3]
+    # Disarmed: zero-cost no-op.
+    faultplan.check("p", tag="devA:x")
+
+
+def test_faultplan_slow_pads_and_kill_is_baseexception():
+    plan = FaultPlan([
+        Fault("w.wall", kind="slow", nth=1, pad_s=123.0),
+        Fault("k", kind="kill", nth=1),
+    ])
+    with faultplan.active(plan):
+        assert faultplan.wall_pad("w.wall", tag="t") == 123.0
+        assert faultplan.wall_pad("w.wall", tag="t") == 0.0
+        with pytest.raises(faultplan.SimulatedKill):
+            try:
+                faultplan.check("k")
+            except Exception:  # noqa: BLE001 - the point: Exception misses it
+                pytest.fail("SimulatedKill must not be caught by Exception")
+
+
+def test_double_arm_rejected():
+    plan = FaultPlan([Fault("p")])
+    with faultplan.active(plan):
+        with pytest.raises(RuntimeError, match="already armed"):
+            faultplan.arm(FaultPlan([Fault("q")]))
+
+
+# ---------------------------------------------------------------------------
+# Unit: the two-phase admission journal
+
+
+def test_manifest_two_phase_journal_roundtrip(tmp_path):
+    from cpgisland_tpu.resilience.manifest import RunManifest
+
+    path = str(tmp_path / "j.jsonl")
+    header = {"mode": "serve", "params": "x"}
+    m = RunManifest(path, header=header, resume=False)
+    m.record_admitted(1, "k1", 100, payload={"tenant": "a", "kind": "decode",
+                                             "name": "r1", "model": "",
+                                             "symbols": ""})
+    m.record_admitted(2, "k2", 200, payload={"tenant": "a", "kind": "decode",
+                                             "name": "r2", "model": "",
+                                             "symbols": ""})
+    m.record_done(1, "k1", 100)
+    m.close()  # the admit for 2 has no completion: re-execution due
+
+    m2 = RunManifest(path, header=header, resume=True)
+    pend = m2.admitted_incomplete()
+    assert [rec["index"] for rec in pend] == [2]
+    assert m2.completed(1, "k1", 100) is not None
+    assert m2.n_completed() == 1
+    # Completion resolves the admit: the payload leaves memory (a
+    # long-lived daemon must not retain every request's input forever).
+    assert 1 not in m2._admitted
+    # A mismatched probe with discard_mismatch=False must NOT destroy the
+    # stored completion (the in-life duplicate-collision path).
+    assert m2.completed(1, "OTHER", 999, discard_mismatch=False) is None
+    assert m2.completed(1, "k1", 100) is not None
+    # Idempotent re-admit of a journaled id is a no-op (no duplicate line).
+    m2.record_admitted(2, "k2", 200, payload={})
+    m2.close()
+    lines = [json.loads(ln) for ln in open(path)]
+    assert sum(1 for ln in lines if ln.get("kind") == "admit"
+               and ln["index"] == 2) == 1
+
+
+def test_reused_id_admit_supersedes_stale_completion(tmp_path):
+    """An id completed as A in life 1, then discarded-and-re-admitted as B
+    in life 2 (identity mismatch), then crashed: life 3 must re-execute B
+    — the stale on-disk completion of A must not shadow B's admit out of
+    the restart set (and must not replay as A either)."""
+    from cpgisland_tpu.resilience.manifest import RunManifest
+
+    path = str(tmp_path / "r.jsonl")
+    header = {"mode": "serve", "params": "x"}
+    m1 = RunManifest(path, header=header, resume=False)
+    m1.record_admitted(7, "A", 100, payload={"v": "a"})
+    m1.record_done(7, "A", 100)
+    m1.close()
+
+    m2 = RunManifest(path, header=header, resume=True)
+    assert m2.completed(7, "B", 200) is None  # mismatch: discards A
+    m2.record_admitted(7, "B", 200, payload={"v": "b"})
+    m2.close()  # crash before B completes (nothing else written)
+
+    m3 = RunManifest(path, header=header, resume=True)
+    pend = m3.admitted_incomplete()
+    assert [(r["index"], r["name"]) for r in pend] == [(7, "B")]
+    assert m3.completed(7, "A", 100) is None  # A's record is superseded
+
+
+def test_completed_id_resubmission_replays_not_duplicate(tmp_path):
+    """A reconnecting client re-submits an id whose first life COMPLETED
+    (the response died with the connection): that must REPLAY from the
+    manifest — hitting the duplicate-id rejection instead would livelock
+    the client's retry loop forever."""
+    params = presets.durbin_cpg8()
+    sess = Session(params, name="t", private_breaker=True)
+    broker = RequestBroker(
+        sess, BrokerConfig(flush_symbols=1 << 20, flush_deadline_s=0.0),
+        manifest_path=str(tmp_path / "m.jsonl"),
+    )
+    syms = _gen_symbols(np.random.default_rng(3), 700)
+    broker.submit(request_id=9, tenant="a", kind="decode", symbols=syms,
+                  name="r9")
+    (first,) = broker.drain()
+    assert first.ok and not first.replayed
+    # Same process life, same id, after completion: replay, not reject.
+    broker.submit(request_id=9, tenant="a", kind="decode", symbols=syms,
+                  name="r9")
+    (again,) = broker.drain()
+    assert again.replayed and again.route == "replay"
+    assert _result_key(again)[1] == _result_key(first)[1]
+    # A duplicate of a QUEUED (not completed) id still rejects.
+    broker.submit(request_id=10, tenant="a", kind="decode", symbols=syms,
+                  name="r10")
+    with pytest.raises(ValueError, match="duplicate request id"):
+        broker.submit(request_id=10, tenant="a", kind="decode",
+                      symbols=syms, name="r10")
+    broker.drain()
+    broker.close()
+
+
+def test_failed_request_resolves_admit_and_rejournals_on_reuse(
+    tmp_path, monkeypatch
+):
+    """A FAILED request writes a terminal 'fail' journal line: restarts do
+    not re-execute known-failing requests, and a reused id journals a
+    FRESH admit with the NEW payload (which a crash then re-executes)."""
+    params = presets.durbin_cpg8()
+    mpath = str(tmp_path / "m.jsonl")
+    cfg = BrokerConfig(flush_symbols=1 << 20, flush_deadline_s=0.0)
+    sess = Session(params, name="t", retry_policy=FAST,
+                   private_breaker=True)
+    b1 = RequestBroker(sess, cfg, manifest_path=mpath)
+    state = {"fail": True}
+    orig_run = sess.supervisor.run
+
+    def run(thunk, **kw):
+        if state["fail"]:
+            raise RuntimeError("persistent injected fault")
+        return orig_run(thunk, **kw)
+
+    monkeypatch.setattr(sess.supervisor, "run", run)
+    rng = np.random.default_rng(5)
+    syms_a = _gen_symbols(rng, 600)
+    b1.submit(request_id=4, tenant="a", kind="decode", symbols=syms_a,
+              name="A")
+    (failed,) = b1.drain()
+    assert not failed.ok
+    # The admit is RESOLVED: nothing left for a restart to re-execute.
+    assert b1.manifest.admitted_incomplete() == []
+    # Reuse the id for a DIFFERENT record; crash before it flushes.
+    state["fail"] = False
+    syms_b = _gen_symbols(rng, 900)
+    b1.submit(request_id=4, tenant="a", kind="decode", symbols=syms_b,
+              name="B")
+    # (abandon b1 without drain/close: the crash)
+
+    sess2 = Session(params, name="t2", private_breaker=True)
+    b2 = RequestBroker(sess2, cfg, manifest_path=mpath, resume=True)
+    reexec = {r.id: r for r in b2.drain()}
+    # The restart re-executes B's payload (the fresh admit), not A's.
+    assert sorted(reexec) == [4]
+    assert reexec[4].ok and reexec[4].n_symbols == syms_b.size
+    b2.close()
+
+
+# ---------------------------------------------------------------------------
+# Pool scenarios (staged for determinism: the only healthy device is the
+# one the plan targets, so WHICH worker takes the flush is pinned)
+
+
+def _run_pool(recs, *, plan=None, n_devices=2, stage=None,
+              timeout_s=300.0):
+    """Run ``recs`` through a DevicePool; returns ({id: result}, pool,
+    observed events).  ``stage(pool, clock)`` runs after construction but
+    before traffic (force-quarantines etc.); the pool is stopped+closed
+    before returning.  Health cooldowns run on a ManualClock the wait
+    loop advances, so parked workers probe without real waiting."""
+    params = presets.durbin_cpg8()
+    sess = Session(params, name="chaos", private_breaker=True,
+                   retry_policy=FAST)
+    broker = RequestBroker(
+        sess, BrokerConfig(flush_symbols=1500, flush_deadline_s=0.01)
+    )
+    clock = ManualClock()
+    cfg = FleetConfig(cooldown_s=30.0, now_fn=clock)
+    pool = DevicePool.build(broker, n_devices=n_devices, config=cfg)
+    results: dict = {}
+    done = threading.Event()
+
+    def on_result(r):
+        results[r.id] = r
+        if len(results) >= len(recs):
+            done.set()
+
+    if stage is not None:
+        stage(pool, clock)
+    ctx = faultplan.active(plan) if plan is not None else None
+    if ctx is not None:
+        ctx.__enter__()
+    try:
+        with obs.observe() as ob:
+            pool.start(on_result)
+            for rid, nm, kind, syms in recs:
+                broker.submit(request_id=rid, tenant="a", kind=kind,
+                              symbols=syms, name=nm)
+            # Requeued flushes may be parked behind a quarantine cooldown:
+            # keep advancing the injected clock until everything lands.
+            deadline = time.monotonic() + timeout_s
+            while not done.wait(timeout=0.25):
+                assert time.monotonic() < deadline, (
+                    f"undelivered: {sorted(set(r[0] for r in recs) - set(results))}, "
+                    f"stats={pool.stats()}"
+                )
+                clock.advance(5.0)
+    finally:
+        pool.stop()
+        pool.close()
+        broker.close()
+        if ctx is not None:
+            ctx.__exit__(None, None, None)
+    return results, pool, list(ob.events)
+
+
+@pytest.mark.slow
+def test_device_fault_mid_flush_fails_over_bit_identical():
+    """The headline failover: dev0 (the only initially healthy device)
+    faults past the supervisor's retry budget mid-flush -> quarantined;
+    the flush requeues INTACT onto dev1 (admitted after its cooldown via
+    the half-open probe) -> probe succeeds -> dev1 restored; final results
+    bit-identical to the fault-free run, every re-dispatch and requeue
+    ledgered, zero dropped requests."""
+    recs = _requests()
+    clean, _pool0, _ev0 = _run_pool(recs)
+    assert all(r.ok for r in clean.values())
+
+    def stage(pool, clock):
+        # dev1 starts quarantined -> dev0 MUST take the first flush.
+        pool.workers[1].health.force_quarantine("staged")
+
+    plan = FaultPlan(
+        [Fault("dispatch", kind="fault", match="@dev0", nth=1,
+               times=ATTEMPTS)],
+        name="dev0-faults",
+    )
+    chaos, pool, events = _run_pool(recs, plan=plan, stage=stage)
+    _assert_results_identical(chaos, clean)
+
+    # The chaos actually happened and was fully ledgered.
+    injected = [e for e in events if e["event"] == "graftfault_injected"]
+    assert len(injected) == ATTEMPTS
+    faults = [e for e in events if e["event"] == "dispatch_fault"]
+    assert len(faults) >= ATTEMPTS  # every injected attempt ledgered
+    quar = [e for e in events if e["event"] == "device_quarantined"]
+    assert any(e["device"] == "dev0" and e["reason"] == "faults"
+               for e in quar)
+    requeued = [e for e in events if e["event"] == "flush_requeued"]
+    assert len(requeued) >= 1 and requeued[0]["device"] == "dev0"
+    restored = [e for e in events if e["event"] == "device_restored"]
+    assert any(e["device"] == "dev1" for e in restored)  # probe succeeded
+    st = pool.stats()
+    assert st["requeues"] >= 1 and st["failed_over"] >= 1
+    assert st["pending_requeued"] == 0
+    assert st["devices"]["dev0"]["quarantines"] >= 1
+
+
+@pytest.mark.slow
+def test_phantom_results_quarantine_and_fail_over():
+    recs = _requests(seed=11, n=6)
+    clean, _p, _e = _run_pool(recs)
+
+    def stage(pool, clock):
+        pool.workers[1].health.force_quarantine("staged")
+
+    plan = FaultPlan(
+        [Fault("dispatch", kind="phantom", match="@dev0", nth=1,
+               times=ATTEMPTS)],
+        name="dev0-phantoms",
+    )
+    chaos, _pool, events = _run_pool(recs, plan=plan, stage=stage)
+    _assert_results_identical(chaos, clean)
+    quar = [e for e in events if e["event"] == "device_quarantined"]
+    # Phantoms trip at phantom_threshold (2) — before the plain-fault
+    # threshold (3) would have.
+    assert any(e["device"] == "dev0" and e["reason"] == "phantom"
+               for e in quar)
+
+
+@pytest.mark.slow
+def test_slow_dispatch_quarantines_but_never_kills():
+    """The never-kill rule as fleet policy: injected 600 s walls (no real
+    sleeping — graftfault pads the measured wall) escalate dispatch_slow,
+    the device is QUARANTINED for future flushes, but the slow flush's
+    own results are delivered intact."""
+    recs = _requests(seed=13, n=6)
+    clean, _p, _e = _run_pool(recs)
+
+    def stage(pool, clock):
+        pool.workers[1].health.force_quarantine("staged")
+
+    plan = FaultPlan(
+        [Fault("dispatch.wall", kind="slow", match="@dev0", nth=1, times=2,
+               pad_s=600.0)],
+        name="dev0-slow",
+    )
+    chaos, _pool, events = _run_pool(recs, plan=plan, stage=stage)
+    _assert_results_identical(chaos, clean)  # slow results still delivered
+    slow = [e for e in events if e["event"] == "dispatch_slow"]
+    assert len(slow) >= 2
+    quar = [e for e in events if e["event"] == "device_quarantined"]
+    assert any(e["device"] == "dev0" and e["reason"] == "slow"
+               for e in quar)
+    # No requeue: the slow flushes SUCCEEDED (nothing was killed).
+    assert not any(e["event"] == "flush_requeued" for e in events)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_seeded_chaos_matrix_converges_bit_identical(seed):
+    """The CI chaos matrix: seeded dispatch-level plans (fault past
+    budget, phantom, single transient, slow) against a 2-device pool with
+    no staging — interleaving-invariant assertions only: bit-identity,
+    zero dropped admitted requests, every injection ledgered."""
+    recs = _requests(seed=17, n=8)
+    clean, _p, _e = _run_pool(recs)
+    for plan in faultplan.matrix(seed, attempts=ATTEMPTS):
+        chaos, _pool, events = _run_pool(recs, plan=plan)
+        _assert_results_identical(chaos, clean)
+        injected = [e for e in events
+                    if e["event"] == "graftfault_injected"]
+        assert len(injected) == len(plan.injected)
+
+
+@pytest.mark.slow
+def test_requeue_refused_without_a_plausible_taker_fails_loudly():
+    """When no non-excluded device could serve within the requeue horizon
+    (here: the other device is drained with an effectively-infinite
+    cooldown), a faulted flush is NOT parked on the failover queue — its
+    failures are delivered loudly and nothing hangs."""
+    params = presets.durbin_cpg8()
+    sess = Session(params, name="notaker", private_breaker=True,
+                   retry_policy=FAST)
+    # One flush holds the whole workload: after it fails over nowhere,
+    # nothing else is queued behind two quarantined devices.
+    broker = RequestBroker(
+        sess, BrokerConfig(flush_symbols=1 << 20, flush_deadline_s=0.01)
+    )
+    clock = ManualClock()
+    pool = DevicePool.build(
+        broker, n_devices=2,
+        config=FleetConfig(cooldown_s=1e9, now_fn=clock),
+    )
+    recs = _requests(seed=41, n=3)
+    results: dict = {}
+    done = threading.Event()
+
+    def on_result(r):
+        results[r.id] = r
+        if len(results) >= len(recs):
+            done.set()
+
+    pool.workers[1].health.force_quarantine("drained")
+    plan = FaultPlan(
+        [Fault("dispatch", kind="fault", match="@dev0", nth=1,
+               times=10 * ATTEMPTS)],
+        name="dev0-poisoned-no-taker",
+    )
+    with obs.observe() as ob:
+        with faultplan.active(plan):
+            try:
+                pool.start(on_result)
+                for rid, nm, kind, syms in recs:
+                    broker.submit(request_id=rid, tenant="a", kind=kind,
+                                  symbols=syms, name=nm)
+                assert done.wait(timeout=120.0), (
+                    f"hung: {sorted(results)}, {pool.stats()}"
+                )
+            finally:
+                pool.stop()
+                pool.close()
+                broker.close()
+    assert sorted(results) == [r[0] for r in recs]
+    assert any(not r.ok for r in results.values())
+    for r in results.values():
+        if not r.ok:
+            assert "graftfault" in (r.error or "")
+    # Refused, not parked: no requeue event, nothing left on the queue.
+    assert not any(e["event"] == "flush_requeued" for e in ob.events)
+    assert pool.stats()["pending_requeued"] == 0
+
+
+# ---------------------------------------------------------------------------
+# Crash consistency: SIGKILL at each journal phase boundary
+
+# (phase point, arrival ordinal, admits expected on disk after the kill,
+# completions expected on disk after the kill) for a 4-request
+# single-flush workload submitted in rid order 0..3.
+_KILL_PHASES = [
+    ("journal.pre_admit", 3, 2, 0),   # killed before accepting request #3
+    ("journal.post_admit", 3, 3, 0),  # killed between journal and flush
+    ("flush.enter", 1, 4, 0),         # killed mid-flush, pre-completion
+    ("journal.pre_complete", 2, 4, 1),   # killed mid-completion loop
+    ("journal.post_complete", 4, 4, 4),  # killed after the last completion
+]
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("point,nth,n_admitted,n_completed", _KILL_PHASES)
+def test_sigkill_at_journal_phase_restart_replays_bit_identical(
+    tmp_path, point, nth, n_admitted, n_completed
+):
+    """SIGKILL (simulated) planted at each journal phase boundary: the
+    restarted daemon re-executes admitted-but-incomplete requests itself
+    (journal_replay), replays completed ones bit-identically with ZERO
+    duplicate device work, and a client re-submitting every id converges
+    to the fault-free output."""
+    params = presets.durbin_cpg8()
+    recs = _requests(seed=23, n=4)
+    sizes = {rid: int(s.size) for rid, _nm, _k, s in recs}
+    cfg = BrokerConfig(flush_symbols=1 << 20, flush_deadline_s=0.0)
+
+    # Fault-free ground truth (no manifest).
+    s0 = Session(params, name="clean", private_breaker=True)
+    b0 = RequestBroker(s0, cfg)
+    for rid, nm, kind, syms in recs:
+        b0.submit(request_id=rid, tenant="a", kind=kind, symbols=syms,
+                  name=nm)
+    clean = {r.id: r for r in b0.drain()}
+    assert all(r.ok for r in clean.values())
+
+    # Life 1: killed at the phase boundary.  NOTHING is closed afterwards
+    # (SIGKILL semantics) — what survives is what was flushed per line.
+    mpath = str(tmp_path / "serve.journal.jsonl")
+    s1 = Session(params, name="life1", private_breaker=True)
+    b1 = RequestBroker(s1, cfg, manifest_path=mpath, resume=False)
+    plan = FaultPlan([Fault(point, kind="kill", nth=nth)],
+                     name=f"kill@{point}")
+    killed = False
+    with faultplan.active(plan):
+        try:
+            for rid, nm, kind, syms in recs:
+                b1.submit(request_id=rid, tenant="a", kind=kind,
+                          symbols=syms, name=nm)
+            for r in b1.drain():
+                pass
+        except faultplan.SimulatedKill:
+            killed = True
+    assert killed, "the kill plan never fired"
+
+    # Life 2: restart over the same journal.  Submissions are in rid
+    # order, flush results complete in rid order, so the journal holds
+    # the first n_admitted admits and the first n_completed completions.
+    admitted_ids = {rid for rid, _nm, _k, _s in recs[:n_admitted]}
+    incomplete = sorted(admitted_ids)[n_completed:]
+    s2 = Session(params, name="life2", private_breaker=True)
+    with obs.observe() as ob:
+        b2 = RequestBroker(s2, cfg, manifest_path=mpath, resume=True)
+        reexec = {r.id: r for r in b2.drain()}  # the journal re-queue
+    replay_ev = [e for e in ob.events if e["event"] == "journal_replay"]
+    if incomplete:
+        assert replay_ev and replay_ev[0]["n_reexecuted"] == len(incomplete)
+        assert replay_ev[0]["n_completed"] == n_completed
+    assert sorted(reexec) == incomplete
+    assert all(r.ok and not r.replayed for r in reexec.values())
+    # Zero duplicate device work for completed records: only the
+    # incomplete ones touched the device on restart.
+    assert b2.flushed_symbols == sum(sizes[rid] for rid in incomplete)
+
+    # The reconnecting client re-submits EVERY id: journaled ones replay
+    # from the manifest (still zero device work), never-admitted ones
+    # (pre-admit kill) execute fresh.
+    for rid, nm, kind, syms in recs:
+        b2.submit(request_id=rid, tenant="a", kind=kind, symbols=syms,
+                  name=nm)
+    final = {r.id: r for r in b2.drain()}
+    for rid in admitted_ids:
+        assert final[rid].replayed and final[rid].route == "replay", rid
+    _assert_results_identical(final, clean)
+    # Device work across life 2 = incomplete re-executions + fresh
+    # never-admitted submissions; completed records cost zero.
+    fresh = sorted(set(sizes) - admitted_ids)
+    assert b2.flushed_symbols == sum(
+        sizes[rid] for rid in list(incomplete) + fresh
+    )
+    b2.close()
+
+
+@pytest.mark.slow
+def test_shutdown_drain_completions_reach_journal(tmp_path):
+    """The shutdown op stops ADMISSION (broker.close) but the transports
+    drain admitted work afterwards — those completions must still land in
+    the journal (the manifest closes at release(), after the drain), or a
+    restarted daemon re-executes work it finished."""
+    import io
+
+    from cpgisland_tpu.serve import transport
+
+    params = presets.durbin_cpg8()
+    rng = np.random.default_rng(37)
+    syms = _gen_symbols(rng, 800)
+    lines = [
+        json.dumps({"id": 1, "kind": "decode",
+                    "seq": "".join(np.array(list("acgt"))[syms])}),
+        json.dumps({"op": "shutdown"}),  # admitted work drains after this
+    ]
+    mpath = str(tmp_path / "m.jsonl")
+    sess = Session(params, name="t", private_breaker=True)
+    # Huge budget + deadline: the request is still QUEUED at shutdown, so
+    # only the post-close drain can serve (and journal) it.
+    broker = RequestBroker(
+        sess, BrokerConfig(flush_symbols=1 << 22, flush_deadline_s=60.0),
+        manifest_path=mpath,
+    )
+    out = io.StringIO()
+    transport.serve_stream(
+        io.StringIO("\n".join(lines) + "\n"), out, broker, use_worker=False
+    )
+    broker.release()
+    resp = [json.loads(ln) for ln in out.getvalue().splitlines()]
+    assert resp and resp[0]["ok"] and not resp[0]["replayed"]
+    kinds = [json.loads(ln).get("kind") for ln in open(mpath)]
+    assert kinds.count("admit") == 1 and kinds.count("record") == 1, kinds
+
+    # Restart: the completed request replays with zero device work.
+    sess2 = Session(params, name="t2", private_breaker=True)
+    b2 = RequestBroker(
+        sess2, BrokerConfig(flush_symbols=1 << 22, flush_deadline_s=0.0),
+        manifest_path=mpath, resume=True,
+    )
+    b2.submit(request_id=1, tenant="default", kind="decode", symbols=syms,
+              name="req1")
+    (r2,) = b2.drain()
+    assert r2.replayed and b2.flushes == 0
+    b2.close()
+    b2.release()
+
+
+# ---------------------------------------------------------------------------
+# Wire: connection death mid-stream + client reconnect-with-replay
+
+
+@pytest.mark.slow
+def test_connection_death_mid_stream_client_replays(tmp_path):
+    """graftfault kills the mux connection mid-stream (transport.read
+    disconnect); tools/serve_client's reconnect-with-replay re-submits its
+    incomplete ids and converges to the batch-pipeline output."""
+    import os
+    import socket as socket_mod
+    import sys
+
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+    import serve_client
+
+    from cpgisland_tpu.serve.transport import serve_socket
+
+    params = presets.durbin_cpg8()
+    rng = np.random.default_rng(29)
+    names_syms = [(f"w{k}", _gen_symbols(rng, 700 + 120 * k))
+                  for k in range(4)]
+    # Batch-pipeline ground truth.
+    bases = np.array(list("acgt"))
+    fa = tmp_path / "w.fa"
+    with open(fa, "w") as f:
+        for nm, syms in names_syms:
+            f.write(f">{nm}\n" + "".join(bases[syms]) + "\n")
+    want = pipeline.decode_file(str(fa), params, compat=False)
+    want_text: dict = {}
+    for line in want.calls.format_lines().splitlines(keepends=True):
+        want_text.setdefault(line.split(" ", 1)[0], []).append(line)
+
+    sess = Session(params, name="wire", private_breaker=True)
+    broker = RequestBroker(
+        sess, BrokerConfig(flush_symbols=1 << 20, flush_deadline_s=0.05)
+    )
+    sock_path = str(tmp_path / "w.sock")
+    server = threading.Thread(
+        target=serve_socket, args=(sock_path, broker), daemon=True
+    )
+    server.start()
+    deadline = time.monotonic() + 30.0
+    while not os.path.exists(sock_path):
+        assert time.monotonic() < deadline
+        time.sleep(0.01)
+    while True:
+        try:
+            s = socket_mod.socket(socket_mod.AF_UNIX, socket_mod.SOCK_STREAM)
+            s.connect(sock_path)
+            s.close()
+            break
+        except OSError:
+            assert time.monotonic() < deadline
+            time.sleep(0.05)
+
+    requests = [
+        {"id": 100 + k, "kind": "decode", "seq": "".join(bases[syms]),
+         "name": nm}
+        for k, (nm, syms) in enumerate(names_syms)
+    ]
+    # The connection serving these dies before its 2nd request line is
+    # even parsed; the client must reconnect and re-submit.
+    plan = FaultPlan([Fault("transport.read", kind="disconnect", nth=2)],
+                     name="conn-death")
+    with faultplan.active(plan):
+        responses = serve_client.run_socket_session(
+            sock_path, requests, reconnects=5,
+        )
+    assert len(plan.injected) == 1  # the disconnect really fired
+    assert set(responses) == {100, 101, 102, 103}
+    for k, (nm, _syms) in enumerate(names_syms):
+        resp = responses[100 + k]
+        assert resp["ok"], resp.get("error")
+        assert resp.get("islands_text", "") == "".join(
+            want_text.get(nm, [])
+        ), nm
+
+    # Orderly shutdown.
+    s = socket_mod.socket(socket_mod.AF_UNIX, socket_mod.SOCK_STREAM)
+    s.connect(sock_path)
+    s.sendall(b'{"op": "shutdown"}\n')
+    s.close()
+    server.join(timeout=60.0)
+    assert not server.is_alive()

@@ -69,6 +69,14 @@ def test_known_locks_all_discovered():
         "cpgisland_tpu/serve/transport.py::ResponseRouter._lock",
         "cpgisland_tpu/serve/transport.py::_MuxClient._lock",
         "cpgisland_tpu/resilience/breaker.py::EngineBreaker._lock",
+        # The PR 15 fleet fault-domain locks: the pool's failover queue,
+        # the per-device health machines, the two-phase journal, and the
+        # graftfault plan state — all must stay inside the model.
+        "cpgisland_tpu/serve/fleet.py::DevicePool._lock",
+        "cpgisland_tpu/serve/fleet.py::DeviceHealth._lock",
+        "cpgisland_tpu/resilience/manifest.py::RunManifest._lock",
+        "cpgisland_tpu/resilience/faultplan.py::_LOCK",
+        "cpgisland_tpu/resilience/faultplan.py::FaultPlan._lock",
         # The pre-existing findings fixed in-code by this layer:
         "cpgisland_tpu/obs/ledger.py::Ledger._lock",
         "cpgisland_tpu/obs/__init__.py::Observer._events_lock",
@@ -88,6 +96,14 @@ def test_documented_lock_order_edges_observed():
         "cpgisland_tpu/serve/session.py::Session._lock",
         "cpgisland_tpu/resilience/breaker.py::EngineBreaker._lock",
     ) in edges, sorted(edges)
+    # PR 15: the write-ahead journal order (broker admission holds the cv
+    # while the admit line lands) — broker -> journal, never the reverse.
+    assert (
+        "cpgisland_tpu/serve/broker.py::RequestBroker._lock",
+        "cpgisland_tpu/resilience/manifest.py::RunManifest._lock",
+    ) in edges, sorted(edges)
+    for src, _dst in edges:
+        assert "RunManifest" not in src, "the journal lock must stay a leaf"
     # And no edge ever leaves a _MuxClient write lock (documented leaf).
     for src, dst in edges:
         assert "_MuxClient" not in src, (src, dst)

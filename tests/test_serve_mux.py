@@ -345,6 +345,135 @@ def test_mux_stalled_client_does_not_wedge_other_clients(tmp_path, tracker):
     tracker.assert_clean()
 
 
+def test_mux_fleet_two_devices_one_quarantined_mid_stream(tmp_path, tracker):
+    """PR 15 fleet under the mux + runtime tracker: a 2-device DevicePool
+    serves concurrent clients; ONE device is quarantined mid-stream
+    (health signal, no probe during the test) and the remaining traffic
+    fails over to the healthy device — per-client results stay
+    BIT-IDENTICAL to the batch pipelines and the tracker observes zero
+    lock-order or guarded-access violations across the pool/health/
+    journal locks."""
+    from cpgisland_tpu.serve import DevicePool, FleetConfig
+
+    params = presets.durbin_cpg8()
+    rng = np.random.default_rng(31)
+    lengths = [450, 1000, 1600, 2100]
+    clients: list = []
+    all_decode: list = []
+    all_post: list = []
+    for c in range(N_CLIENTS):
+        reqs = []
+        for k in range(4):
+            name = f"f{c}r{k}"
+            syms = _gen_symbols(rng, lengths[k] + 13 * c)
+            kind = "decode" if (c + k) % 2 == 0 else "posterior"
+            (all_decode if kind == "decode" else all_post).append(
+                (name, syms)
+            )
+            reqs.append({
+                "id": c * 1000 + k, "kind": kind, "seq": _seq_text(syms),
+                "tenant": f"t{c % 2}", "name": name,
+            })
+        clients.append(reqs)
+
+    dres = pipeline.decode_file(
+        _write_fasta(tmp_path / "fd.fa", all_decode), params, compat=False
+    )
+    pres = pipeline.posterior_file(
+        _write_fasta(tmp_path / "fp.fa", all_post), params,
+        islands_out=str(tmp_path / "fpi.txt"),
+    )
+    want_decode = _islands_by_name(dres.calls)
+    want_post = _islands_by_name(pres.calls)
+
+    # Built INSIDE the tracker window: pool + health + journal locks are
+    # all wrapped and recorded.
+    sess = Session(params, name="mux-fleet", private_breaker=True)
+    broker = RequestBroker(
+        sess, BrokerConfig(flush_symbols=6_000, flush_deadline_s=0.05)
+    )
+    # Huge cooldown: the quarantined device stays OUT for the whole test
+    # (no half-open probe muddying the "one quarantined" invariant).
+    pool = DevicePool.build(
+        broker, n_devices=2, config=FleetConfig(cooldown_s=1e9)
+    )
+    tracker.watch_attrs(
+        broker, broker._lock,
+        ["_queued_symbols", "flushes", "flushed_symbols"],
+        label="RequestBroker",
+    )
+    tracker.watch_attrs(
+        pool, pool._lock, ["requeues", "failed_over"], label="DevicePool",
+    )
+    sock_path = str(tmp_path / "fleet.sock")
+    server = _start_server(broker, sock_path, pool=pool)
+
+    # Round A: first half of each client's stream on both devices.
+    results_a: list = [None] * N_CLIENTS
+    results_b: list = [None] * N_CLIENTS
+    errors: list = []
+
+    def client_round(c, reqs, out):
+        try:
+            out[c] = _client_session(sock_path, reqs)
+        except Exception as e:
+            errors.append((c, repr(e)))
+
+    threads = [
+        threading.Thread(target=client_round,
+                         args=(c, clients[c][:2], results_a))
+        for c in range(N_CLIENTS)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=300.0)
+    assert errors == [], errors
+
+    # Mid-stream: pull dev0 out of rotation (the health-signal path the
+    # supervisor monitor drives; graftfault covers the injected-fault
+    # route deterministically in test_graftfault.py).
+    pool.workers[0].health.force_quarantine("mid-stream")
+
+    # Round B: the rest of the stream — served entirely by dev1.
+    threads = [
+        threading.Thread(target=client_round,
+                         args=(c, clients[c][2:], results_b))
+        for c in range(N_CLIENTS)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=300.0)
+    assert errors == [], errors
+
+    _send_shutdown(sock_path)
+    server.join(timeout=60.0)
+    assert not server.is_alive()
+    pool.close()
+
+    for c in range(N_CLIENTS):
+        got = dict(results_a[c] or {})
+        got.update(results_b[c] or {})
+        assert set(got) == {r["id"] for r in clients[c]}
+        for req in clients[c]:
+            r = got[req["id"]]
+            assert r["ok"], r.get("error")
+            name = req["name"]
+            want = (
+                want_decode if req["kind"] == "decode" else want_post
+            ).get(name, "")
+            assert r.get("islands_text", "") == want, name
+
+    tracker.assert_clean()
+    st = pool.stats()
+    assert st["devices"]["dev0"]["state"] == "quarantined"
+    assert st["devices"]["dev0"]["quarantines"] == 1
+    # The fleet really served: every round-B flush ran on dev1.
+    assert st["devices"]["dev1"]["flushes"] >= 1
+    assert broker.stats()["flushes"] >= 2
+
+
 def test_mux_duplicate_id_across_connections_rejected(tmp_path, tracker):
     params = presets.durbin_cpg8()
     rng = np.random.default_rng(5)

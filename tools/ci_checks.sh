@@ -133,10 +133,23 @@ python -m pytest tests/test_family.py tests/test_serve_family.py -q
 echo "== graftsync slice: rule fixtures, tracker, threaded serve-mux stress =="
 # Layer 4's own tests (planted deadlock/unguarded-access fixtures must each
 # FAIL naming the offending locks/attributes; repo self-scan + lock graph
-# stay pinned), then the multi-connection socket mux under the runtime
-# tracker: 4 concurrent clients, mixed decode+posterior, bit-identical per
-# client, zero observed lock-order or guarded-access violations.
+# stay pinned — the r15 fleet/journal/faultplan locks included), then the
+# multi-connection socket mux under the runtime tracker: 4 concurrent
+# clients, mixed decode+posterior, bit-identical per client, zero observed
+# lock-order or guarded-access violations — including the 2-device
+# DevicePool run with one device quarantined mid-stream.
 python -m pytest tests/test_graftsync.py tests/test_graftsync_self.py \
   tests/test_serve_mux.py -q
+
+echo "== graftfault chaos slice (seeded plan matrix on the virtual mesh) =="
+# r15: every fleet failover path driven by deterministic fault plans —
+# device fault past the retry budget mid-flush (quarantine -> requeue ->
+# half-open probe -> restore), phantom-result quarantine, never-kill
+# slow-dispatch quarantine, connection death mid-stream recovered by the
+# client's reconnect-with-replay, and SIGKILL planted at each journal
+# phase boundary (write-ahead admit -> completion) with restart replay.
+# Every plan must converge BIT-IDENTICAL to the fault-free run with zero
+# dropped admitted requests and a fully-ledgered requeue/replay trail.
+python -m pytest tests/test_graftfault.py -q
 
 echo "ci_checks: all gates green"

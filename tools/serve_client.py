@@ -10,6 +10,25 @@ Transport: --socket PATH connects to a running daemon's AF_UNIX socket;
 without it, the client SPAWNS `python -m cpgisland_tpu serve` as a
 subprocess and talks over its stdin/stdout — the zero-setup smoke path.
 
+## Reconnect-with-replay (socket mode)
+
+On socket death the client reconnects (up to --reconnects times, with
+backoff) and re-submits exactly its INCOMPLETE ids.  This is safe against
+every daemon state because the daemon side already arbitrates:
+
+- an id still EXECUTING (or queued) is rejected with a duplicate-id error
+  — the client backs off and retries it later (duplicate-id rejection of
+  executing requests protects the daemon from double work);
+- a `Backpressure` rejection carries a queue-depth-derived
+  ``retry_after_s`` hint — the client sleeps that long instead of
+  hot-looping on a saturated fleet;
+- with the daemon's admission journal (`--manifest`), a re-submitted id
+  whose first life COMPLETED replays bit-identically from the manifest
+  (zero device work), and one that was admitted-but-incomplete at a crash
+  is re-executed by the restarted daemon itself — the client's re-submit
+  then simply waits out the duplicate rejection until the journal replay
+  is ready.  No accepted request is ever served twice or dropped.
+
 Examples:
 
     # one-shot: spawn a daemon, decode a file through it
@@ -26,11 +45,19 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import socket
 import subprocess
 import sys
+import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
+
+# A rejection whose error matches one of these is RETRYABLE-LATER: the id
+# is alive on the daemon side (queued/executing/just-restarted) and will
+# become replayable or reusable — never a hard failure.
+_RETRY_MARKERS = ("already queued", "already in flight", "duplicate request id")
+_DEFAULT_RETRY_S = 0.25
 
 
 def iter_fasta_text(path: str):
@@ -55,6 +82,144 @@ def iter_fasta_text(path: str):
         yield name or "", "".join(parts)
 
 
+def _connect(sock_path: str):
+    conn = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    conn.connect(sock_path)
+    return conn
+
+
+def run_socket_session(
+    sock_path: str,
+    requests: list,
+    *,
+    reconnects: int = 3,
+    reconnect_wait_s: float = 0.5,
+    max_id_retries: int = 40,
+    log=None,
+) -> dict:
+    """Submit ``requests`` (JSON dicts with unique ``id``) over the daemon
+    socket with reconnect-with-replay (see module docstring); returns
+    {id: final response dict}.  Raises OSError once the reconnect budget
+    is exhausted with ids still incomplete.  Each id's retryable
+    rejections (duplicate-id / backpressure) are bounded by
+    ``max_id_retries`` — past it the last rejection becomes the final
+    response instead of spinning forever (e.g. against a colliding id
+    from another client that never completes)."""
+    log = log if log is not None else (lambda msg: None)
+    pending = {int(r["id"]): r for r in requests}
+    responses: dict = {}
+    attempts = 0
+    id_retries: dict = {}
+    while pending:
+        retry_at: dict = {}  # id -> monotonic time of next re-submit
+        try:
+            conn = _connect(sock_path)
+        except OSError:
+            attempts += 1
+            if attempts > reconnects:
+                raise
+            log(f"# serve_client: connect failed; retrying "
+                f"({attempts}/{reconnects})\n")
+            time.sleep(reconnect_wait_s * attempts)
+            continue
+        try:
+            wf = conn.makefile("w", encoding="utf-8")
+            rf = conn.makefile("r", encoding="utf-8")
+            outstanding: set = set()
+            for rid, req in sorted(pending.items()):
+                wf.write(json.dumps(req) + "\n")
+                outstanding.add(rid)
+            wf.flush()
+            while outstanding or retry_at:
+                # Re-submit ids whose backoff elapsed (duplicate-id /
+                # backpressure rejections) on THIS connection.
+                now = time.monotonic()
+                due = [rid for rid, t in retry_at.items() if t <= now]
+                if not outstanding and retry_at and not due:
+                    time.sleep(min(retry_at.values()) - now)
+                    due = [rid for rid, t in retry_at.items()
+                           if t <= time.monotonic()]
+                for rid in due:
+                    del retry_at[rid]
+                    wf.write(json.dumps(pending[rid]) + "\n")
+                    outstanding.add(rid)
+                if due:
+                    wf.flush()
+                line = rf.readline()
+                if not line:
+                    raise OSError("daemon closed the connection")
+                resp = json.loads(line)
+                rid = resp.get("id")
+                if rid not in outstanding:
+                    continue  # stats line / stale duplicate
+                if resp.get("ok"):
+                    outstanding.discard(rid)
+                    responses[rid] = resp
+                    del pending[rid]
+                    continue
+                err = str(resp.get("error", ""))
+                retryable = (
+                    resp.get("backpressure")
+                    or any(m in err for m in _RETRY_MARKERS)
+                )
+                if retryable:
+                    outstanding.discard(rid)
+                    id_retries[rid] = id_retries.get(rid, 0) + 1
+                    if id_retries[rid] > max_id_retries:
+                        log(f"# serve_client: request {rid} still "
+                            f"rejected after {max_id_retries} retries; "
+                            "giving up on it\n")
+                        responses[rid] = resp
+                        del pending[rid]
+                        continue
+                    delay = resp.get("retry_after_s") or _DEFAULT_RETRY_S
+                    retry_at[rid] = time.monotonic() + float(delay)
+                    log(f"# serve_client: request {rid} deferred "
+                        f"({err.split(':', 1)[0]}); retrying in "
+                        f"{delay}s\n")
+                else:
+                    outstanding.discard(rid)
+                    responses[rid] = resp  # hard rejection: final
+                    del pending[rid]
+        except OSError:
+            attempts += 1
+            if attempts > reconnects:
+                raise
+            log(f"# serve_client: connection died with "
+                f"{len(pending)} request(s) incomplete; reconnecting "
+                f"and re-submitting ({attempts}/{reconnects})\n")
+            time.sleep(reconnect_wait_s * attempts)
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+    return responses
+
+
+def _socket_epilogue(sock_path: str, *, want_stats: bool,
+                     shutdown: bool) -> list:
+    """Optional stats fetch + shutdown on a short final connection."""
+    out = []
+    if not (want_stats or shutdown):
+        return out
+    try:
+        conn = _connect(sock_path)
+        wf = conn.makefile("w", encoding="utf-8")
+        rf = conn.makefile("r", encoding="utf-8")
+        if want_stats:
+            wf.write(json.dumps({"op": "stats"}) + "\n")
+        if shutdown:
+            wf.write(json.dumps({"op": "shutdown"}) + "\n")
+        wf.flush()
+        conn.shutdown(socket.SHUT_WR)
+        out = [json.loads(ln) for ln in rf if ln.strip()]
+        conn.close()
+    except OSError:
+        pass
+    return out
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("fasta")
@@ -75,55 +240,53 @@ def main() -> int:
                     help="first request id (the mux daemon's id space is "
                     "daemon-wide: concurrent clients must use disjoint "
                     "ranges, e.g. --id-base 1000 / 2000)")
+    ap.add_argument("--reconnects", type=int, default=3,
+                    help="socket mode: reconnect budget — on socket death "
+                    "the client reconnects and re-submits its incomplete "
+                    "ids (see the module docstring for the journal "
+                    "interaction)")
     args = ap.parse_args()
 
     kind = "posterior" if args.posterior else "decode"
     requests = [
-        json.dumps({
+        {
             "id": args.id_base + i, "kind": kind, "tenant": args.tenant,
             "name": name or f"rec{args.id_base + i}", "seq": seq,
-        })
+        }
         for i, (name, seq) in enumerate(iter_fasta_text(args.fasta))
     ]
-    if args.stats:
-        requests.append(json.dumps({"op": "stats"}))
 
     if args.socket:
-        import socket
-
-        conn = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
-        conn.connect(args.socket)
-        wf = conn.makefile("w", encoding="utf-8")
-        rf = conn.makefile("r", encoding="utf-8")
-        for line in requests:
-            wf.write(line + "\n")
-        if args.shutdown:
-            wf.write(json.dumps({"op": "shutdown"}) + "\n")
-        wf.flush()
-        conn.shutdown(socket.SHUT_WR)
-        out_lines = list(rf)
-        conn.close()
+        responses = run_socket_session(
+            args.socket, requests, reconnects=args.reconnects,
+            log=sys.stderr.write,
+        )
+        resp_list = [responses[rid] for rid in sorted(responses)]
+        resp_list += _socket_epilogue(
+            args.socket, want_stats=args.stats, shutdown=args.shutdown
+        )
     else:
+        lines = [json.dumps(r) for r in requests]
+        if args.stats:
+            lines.append(json.dumps({"op": "stats"}))
         cmd = [sys.executable, "-m", "cpgisland_tpu", "serve"]
         if args.platform:
             cmd += ["--platform", args.platform]
         proc = subprocess.run(
-            cmd, input="\n".join(requests) + "\n",
+            cmd, input="\n".join(lines) + "\n",
             capture_output=True, text=True, cwd=REPO,
         )
         if proc.returncode != 0:
             sys.stderr.write(proc.stderr)
             return proc.returncode
-        out_lines = proc.stdout.splitlines()
+        resp_list = [
+            json.loads(ln) for ln in proc.stdout.splitlines() if ln.strip()
+        ]
 
     n_ok = 0
     out = sys.stdout if args.islands_out == "-" else open(args.islands_out, "w")
     try:
-        for line in out_lines:
-            line = line.strip()
-            if not line:
-                continue
-            resp = json.loads(line)
+        for resp in resp_list:
             if "stats" in resp:
                 sys.stderr.write(json.dumps(resp["stats"]) + "\n")
                 continue
@@ -141,7 +304,7 @@ def main() -> int:
     finally:
         if out is not sys.stdout:
             out.close()
-    sys.stderr.write(f"# {n_ok}/{len([r for r in requests if 'op' not in json.loads(r)])} requests ok\n")
+    sys.stderr.write(f"# {n_ok}/{len(requests)} requests ok\n")
     return 0
 
 
