@@ -1258,6 +1258,9 @@ def bench_serve(engine: str = "auto", n_decode: int = 16,
             )
         return out
 
+    from cpgisland_tpu import obs
+    from cpgisland_tpu.obs.metrics import Histogram
+
     def run(base: int):
         reqs = make_requests(base)
         t_submit = {}
@@ -1268,20 +1271,25 @@ def bench_serve(engine: str = "auto", n_decode: int = 16,
                 symbols=syms, name=f"r{rid}",
             )
             t_submit[rid] = time.perf_counter()
-        lats = []
+        # Latency percentiles via the graftscope histogram machinery — the
+        # SAME log-binned estimator the serve daemon's kind=stats and
+        # --metrics-interval snapshots report, so bench figures and live
+        # SLO figures are one estimator (quarter-octave bins: <=~9%
+        # relative quantile error, exact count/min/max).
+        lat = Histogram()
         while broker.pending():
             for r in broker.flush_once():
                 if not r.ok:
                     raise RuntimeError(
                         f"serve bench request {r.id} failed: {r.error}"
                     )
-                lats.append(time.perf_counter() - t_submit[r.id])
+                lat.observe(time.perf_counter() - t_submit[r.id])
         wall = time.perf_counter() - t0
-        return float(sum(s.size for _, _, s in reqs)), wall, sorted(lats)
+        return float(sum(s.size for _, _, s in reqs)), wall, lat
 
     run(0)  # warmup: one compile per geometry
     warm_flushes = broker.flushes
-    total, wall, lats = run(1000)
+    total, wall, lat = run(1000)
     tput = _check_plausible(total / wall, "serve")
     # No 'serve' marker exists in BASELINE.md until the first chip capture,
     # so the per-path net above degrades to the global 20 Gsym/s ceiling —
@@ -1299,23 +1307,24 @@ def bench_serve(engine: str = "auto", n_decode: int = 16,
             "process"
         )
 
-    def pct(p: float) -> float:
-        return lats[min(len(lats) - 1, int(p * len(lats)))]
-
+    snap = lat.snapshot()
+    # Full histogram into the --metrics-out sidecar (stdout stays ONE JSON
+    # line — this rides the obs JSONL only when an observer is active).
+    obs.event("serve_slo", latency_s=lat.to_wire(), snapshot=snap)
     out = {
         "serve_msym_per_s": round(tput / 1e6, 1),
-        "serve_p50_ms": round(pct(0.50) * 1e3, 2),
-        "serve_p99_ms": round(pct(0.99) * 1e3, 2),
-        "serve_requests": len(lats),
+        "serve_p50_ms": round(snap["p50"] * 1e3, 2),
+        "serve_p99_ms": round(snap["p99"] * 1e3, 2),
+        "serve_requests": snap["count"],
         "serve_flushes": broker.flushes - warm_flushes,
     }
     log(
         f"serve: {tput/1e6:.1f} Msym/s sustained over "
         f"{out['serve_flushes']} flushes; queue->result p50 "
         f"{out['serve_p50_ms']} ms / p99 {out['serve_p99_ms']} ms "
-        f"({len(lats)} requests); fresh-input user path — upload-bound "
-        f"on the relayed dev setup, compare via serve_vs_batched_decode, "
-        f"not this absolute"
+        f"({snap['count']} requests, histogram-estimated percentiles); "
+        f"fresh-input user path — upload-bound on the relayed dev setup, "
+        f"compare via serve_vs_batched_decode, not this absolute"
     )
     return out
 

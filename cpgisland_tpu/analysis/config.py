@@ -103,6 +103,13 @@ SYNC_UNGUARDED: dict[str, dict[str, str]] = {
         "a stale read merely shifts one injection boundary — plans are "
         "armed before their workload starts",
     },
+    "obs/scope.py": {
+        "_ACTIVE": "the graftscope telemetry-off fast path: hop()/record()/"
+        "complete() sit on every serve hot path and must cost one "
+        "module-global read when no scope is installed; install/uninstall "
+        "serialize under _HANDLE_LOCK, and a stale read degrades to one "
+        "dropped telemetry hop — never a wrong serve result",
+    },
 }
 
 

@@ -342,6 +342,12 @@ def build_parser() -> argparse.ArgumentParser:
         "requeued intact onto healthy devices — see "
         "cpgisland_tpu/serve/fleet.py",
     )
+    sv.add_argument(
+        "--metrics-interval", type=float, default=0.0, metavar="SECONDS",
+        help="emit a periodic slo_snapshot record (graftscope latency/flush "
+        "histograms + queue depth + fleet health) into the --metrics JSONL "
+        "every SECONDS; also enables request-lineage telemetry (0 = off)",
+    )
     _add_island_cap_flag(sv)
     _add_island_states_flag(sv)
     _add_invalid_symbols_flag(sv)

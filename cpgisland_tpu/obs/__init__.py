@@ -46,9 +46,11 @@ import os
 import threading
 from typing import Iterator, Optional
 
+from cpgisland_tpu.obs import ledger as ledger_mod
 from cpgisland_tpu.obs.ledger import (  # noqa: F401  (public re-exports)
     Ledger,
     RecompileError,
+    device_scope,
     no_new_compiles,
 )
 from cpgisland_tpu.obs.trace import SpanRecord, Tracer, process_index
@@ -167,6 +169,11 @@ class Observer:
         counts surface in ``obs_summary``.  Call sites must key deduped
         payloads on BOUNDED values (e.g. pow2 buckets, not raw lengths).
         """
+        # Fleet attribution: events emitted on a device worker's thread carry
+        # the originating device label (bounded set — dedupe keys stay safe).
+        dev = ledger_mod.current_device()
+        if dev and "device" not in fields:
+            fields["device"] = dev
         if dedupe:
             key = (name, tuple(sorted(fields.items())))
             with self._events_lock:

@@ -37,6 +37,27 @@ from typing import Iterator
 
 _MAX_COMPILE_RECORDS = 4096
 
+# Fleet attribution: which device's worker thread is currently executing.
+# Thread-local by construction (each _DeviceWorker pins one label for its
+# own thread), so reads need no lock; "" = unattributed (single-device /
+# non-fleet paths, whose counters keep their exact legacy meaning).
+_DEVICE = threading.local()
+
+
+def current_device() -> str:
+    return getattr(_DEVICE, "label", "")
+
+
+@contextlib.contextmanager
+def device_scope(label: str) -> Iterator[None]:
+    """Attribute ledger counts + obs events on this thread to ``label``."""
+    prev = getattr(_DEVICE, "label", "")
+    _DEVICE.label = str(label)
+    try:
+        yield
+    finally:
+        _DEVICE.label = prev
+
 
 class RecompileError(RuntimeError):
     """A region asserted compile-free saw fresh XLA compiles."""
@@ -67,6 +88,24 @@ class Ledger:
         self.fetch_bytes = 0  # device -> host
         self.upload_bytes = 0  # host -> device
         self.compile_records: list[dict] = []
+        # Per-device attribution (fleet): label -> counter dict.  Bumped
+        # ALONGSIDE the global fields under the same lock — the globals keep
+        # their exact legacy totals, devices are a partition of the tagged
+        # subset.  "" (no device_scope active) is never stored.
+        self.per_device: dict[str, dict] = {}
+
+    def _device_ent_locked(self) -> dict | None:
+        # Caller holds self._lock.
+        label = current_device()
+        if not label:
+            return None
+        ent = self.per_device.get(label)
+        if ent is None:
+            ent = self.per_device[label] = {
+                "compiles": 0, "dispatches": 0,
+                "fetch_bytes": 0, "upload_bytes": 0,
+            }
+        return ent
 
     # -- recording ----------------------------------------------------------
 
@@ -74,6 +113,9 @@ class Ledger:
         with self._lock:
             self.compiles += 1
             self.compile_s += secs
+            ent = self._device_ent_locked()
+            if ent is not None:
+                ent["compiles"] += 1
             if len(self.compile_records) < _MAX_COMPILE_RECORDS:
                 self.compile_records.append(
                     {"name": name, "arg_types": arg_types,
@@ -87,11 +129,18 @@ class Ledger:
     def count_dispatch(self) -> None:
         with self._lock:
             self.dispatches += 1
+            ent = self._device_ent_locked()
+            if ent is not None:
+                ent["dispatches"] += 1
 
     def count_fetch(self, nbytes: int) -> None:
         with self._lock:
             self.dispatches += 1
             self.fetch_bytes += int(nbytes)
+            ent = self._device_ent_locked()
+            if ent is not None:
+                ent["dispatches"] += 1
+                ent["fetch_bytes"] += int(nbytes)
 
     def count_upload(self, nbytes: int) -> None:
         # An upload IS a round trip on the relay (and the docstring promises
@@ -99,6 +148,10 @@ class Ledger:
         with self._lock:
             self.dispatches += 1
             self.upload_bytes += int(nbytes)
+            ent = self._device_ent_locked()
+            if ent is not None:
+                ent["dispatches"] += 1
+                ent["upload_bytes"] += int(nbytes)
 
     # -- span attribution ---------------------------------------------------
 
@@ -124,7 +177,7 @@ class Ledger:
 
     def totals(self) -> dict:
         with self._lock:
-            return {
+            out = {
                 "compiles": self.compiles,
                 "compile_s": round(self.compile_s, 4),
                 "cache_hits": self.cache_hits,
@@ -132,6 +185,15 @@ class Ledger:
                 "fetch_bytes": self.fetch_bytes,
                 "upload_bytes": self.upload_bytes,
             }
+            if self.per_device:
+                out["per_device"] = {
+                    k: dict(v) for k, v in sorted(self.per_device.items())
+                }
+            return out
+
+    def device_totals(self) -> dict:
+        with self._lock:
+            return {k: dict(v) for k, v in sorted(self.per_device.items())}
 
 
 def _tree_nbytes(x) -> int:

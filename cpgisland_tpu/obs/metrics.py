@@ -1,0 +1,261 @@
+"""Streaming SLO metrics: fixed-layout log-binned histograms + serve rollups.
+
+``Histogram`` is the single primitive: a fixed log-spaced bin layout shared
+by every instance (so any two histograms merge exactly — integer bin-count
+addition, associative and lossless), plus exact count/sum/min/max tracked
+alongside the bins.  Quantiles are bin estimates (geometric bin midpoint,
+clamped to the observed [min, max]); the layout's quarter-octave growth
+bounds the relative error of any quantile at ~9%.
+
+``ServeMetrics`` is the serve-layer rollup: queue->result latency, flush
+size/occupancy/wall histograms, and per-tenant/per-model/per-device request
++ symbol throughput counters.  Everything here is lock-disciplined for the
+Layer-4 rules: each histogram owns one leaf lock, ``ServeMetrics`` owns one
+leaf lock for the throughput table, and no I/O or foreign-lock acquisition
+ever happens under either (merge copies the source under its own lock
+FIRST, then folds into the destination — sequential, never nested, so the
+lock graph stays edge-free).
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+from typing import Dict, List, Tuple
+
+# One shared layout so all histograms are merge-compatible.  Bin i covers
+# [LO * 2**(i*LOG2_GROWTH), LO * 2**((i+1)*LOG2_GROWTH)); quarter-octave
+# bins (~19% wide) over 72 octaves span 1e-9 .. ~4.7e12 — microsecond
+# latencies and multi-Gi symbol counts both land in-range.
+LO = 1e-9
+LOG2_GROWTH = 0.25
+N_BINS = 288
+
+_INV_LOG2_GROWTH = 1.0 / LOG2_GROWTH
+_LOG2_LO = math.log2(LO)
+
+
+def bin_index(value: float) -> int:
+    """Bin for ``value`` under the shared layout (clamped at both ends)."""
+    if not value > LO:  # catches <=LO, 0, negatives and NaN
+        return 0
+    i = int((math.log2(value) - _LOG2_LO) * _INV_LOG2_GROWTH)
+    return min(max(i, 0), N_BINS - 1)
+
+
+def bin_edges(i: int) -> Tuple[float, float]:
+    lo = LO * 2.0 ** (i * LOG2_GROWTH)
+    return lo, LO * 2.0 ** ((i + 1) * LOG2_GROWTH)
+
+
+class Histogram:
+    """Fixed-layout log-binned histogram; exact merge, estimated quantiles."""
+
+    __slots__ = ("_lock", "_counts", "count", "sum", "min", "max")
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        # Sparse: bin index -> count.  Serve latency distributions touch a
+        # handful of the 288 bins; a dict keeps wire forms small.
+        self._counts: Dict[int, int] = {}
+        self.count = 0
+        self.sum = 0.0
+        self.min = math.inf
+        self.max = -math.inf
+
+    # -- writers -------------------------------------------------------------
+
+    def observe(self, value: float) -> None:
+        v = float(value)
+        i = bin_index(v)
+        with self._lock:  # graftsync: leaf lock, no I/O below
+            self._counts[i] = self._counts.get(i, 0) + 1
+            self.count += 1
+            self.sum += v
+            if v < self.min:
+                self.min = v
+            if v > self.max:
+                self.max = v
+
+    def merge(self, other: "Histogram") -> "Histogram":
+        """Fold ``other`` into self.  Exact: integer bin adds.
+
+        Locks are taken sequentially (copy other, then update self), never
+        nested — no lock-order edge between histogram instances.
+        """
+        counts, count, total, mn, mx = other._copy()
+        with self._lock:
+            for i, c in counts.items():
+                self._counts[i] = self._counts.get(i, 0) + c
+            self.count += count
+            self.sum += total
+            if mn < self.min:
+                self.min = mn
+            if mx > self.max:
+                self.max = mx
+        return self
+
+    # -- readers -------------------------------------------------------------
+
+    def _copy(self) -> Tuple[Dict[int, int], int, float, float, float]:
+        with self._lock:
+            return dict(self._counts), self.count, self.sum, self.min, self.max
+
+    def quantile(self, q: float) -> float:
+        """Estimated q-quantile: geometric midpoint of the holding bin,
+        clamped to the exact observed [min, max]."""
+        counts, count, _, mn, mx = self._copy()
+        if count == 0:
+            return 0.0
+        target = max(1, math.ceil(q * count))
+        cum = 0
+        for i in sorted(counts):
+            cum += counts[i]
+            if cum >= target:
+                lo, hi = bin_edges(i)
+                mid = math.sqrt(lo * hi)
+                return min(max(mid, mn), mx)
+        return mx
+
+    def snapshot(self) -> dict:
+        counts, count, total, mn, mx = self._copy()
+        if count == 0:
+            return {"count": 0, "sum": 0.0, "mean": 0.0, "min": 0.0,
+                    "max": 0.0, "p50": 0.0, "p95": 0.0, "p99": 0.0}
+        return {
+            "count": count,
+            "sum": total,
+            "mean": total / count,
+            "min": mn,
+            "max": mx,
+            "p50": self.quantile(0.50),
+            "p95": self.quantile(0.95),
+            "p99": self.quantile(0.99),
+        }
+
+    # -- wire form (kind=stats responses, sidecar snapshots, merges) ---------
+
+    def to_wire(self) -> dict:
+        counts, count, total, mn, mx = self._copy()
+        return {
+            "layout": {"lo": LO, "log2_growth": LOG2_GROWTH, "n_bins": N_BINS},
+            "bins": {str(i): c for i, c in sorted(counts.items())},
+            "count": count,
+            "sum": total,
+            "min": None if count == 0 else mn,
+            "max": None if count == 0 else mx,
+        }
+
+    @classmethod
+    def from_wire(cls, wire: dict) -> "Histogram":
+        lay = wire.get("layout", {})
+        if (lay.get("lo"), lay.get("log2_growth"), lay.get("n_bins")) != (
+            LO, LOG2_GROWTH, N_BINS,
+        ):
+            raise ValueError(f"incompatible histogram layout: {lay!r}")
+        h = cls()
+        h._counts = {int(i): int(c) for i, c in wire.get("bins", {}).items()}
+        h.count = int(wire.get("count", 0))
+        h.sum = float(wire.get("sum", 0.0))
+        mn, mx = wire.get("min"), wire.get("max")
+        h.min = math.inf if mn is None else float(mn)
+        h.max = -math.inf if mx is None else float(mx)
+        return h
+
+
+class ServeMetrics:
+    """Serve-layer SLO rollup: latency/flush histograms + throughput table.
+
+    The histograms carry their own leaf locks; ``_lock`` guards only the
+    per-(scope, key) throughput counters.  No I/O under any of them.
+    """
+
+    def __init__(self) -> None:
+        self.latency_s = Histogram()       # queue->result wall per request
+        self.flush_symbols = Histogram()   # symbols per flush
+        self.flush_requests = Histogram()  # occupancy: requests per flush
+        self.flush_wall_s = Histogram()    # device wall per flush
+        self._lock = threading.Lock()
+        # (scope, key) -> [requests, symbols]; scope in tenant/model/device.
+        self._through: Dict[Tuple[str, str], List[int]] = {}
+
+    def note_result(self, *, tenant: str, model: str, device: str,
+                    n_symbols: int, latency_s: float) -> None:
+        self.latency_s.observe(latency_s)
+        keys = (("tenant", tenant or "-"), ("model", model or "-"),
+                ("device", device or "-"))
+        with self._lock:  # graftsync: leaf lock, no I/O below
+            for key in keys:
+                ent = self._through.get(key)
+                if ent is None:
+                    ent = self._through[key] = [0, 0]
+                ent[0] += 1
+                ent[1] += int(n_symbols)
+
+    def note_flush(self, *, n_requests: int, symbols: int,
+                   wall_s: float) -> None:
+        self.flush_requests.observe(float(n_requests))
+        self.flush_symbols.observe(float(symbols))
+        self.flush_wall_s.observe(wall_s)
+
+    def merge(self, other: "ServeMetrics") -> "ServeMetrics":
+        self.latency_s.merge(other.latency_s)
+        self.flush_symbols.merge(other.flush_symbols)
+        self.flush_requests.merge(other.flush_requests)
+        self.flush_wall_s.merge(other.flush_wall_s)
+        with other._lock:
+            src = {k: list(v) for k, v in other._through.items()}
+        with self._lock:
+            for key, (nreq, nsym) in src.items():
+                ent = self._through.get(key)
+                if ent is None:
+                    ent = self._through[key] = [0, 0]
+                ent[0] += nreq
+                ent[1] += nsym
+        return self
+
+    def throughput(self) -> dict:
+        with self._lock:
+            items = sorted(self._through.items())
+        out: Dict[str, dict] = {}
+        for (scope, key), (nreq, nsym) in items:
+            out.setdefault(scope, {})[key] = {"requests": nreq, "symbols": nsym}
+        return out
+
+    def snapshot(self) -> dict:
+        return {
+            "latency_s": self.latency_s.snapshot(),
+            "flush_symbols": self.flush_symbols.snapshot(),
+            "flush_requests": self.flush_requests.snapshot(),
+            "flush_wall_s": self.flush_wall_s.snapshot(),
+            "throughput": self.throughput(),
+        }
+
+    def to_wire(self) -> dict:
+        return {
+            "latency_s": self.latency_s.to_wire(),
+            "flush_symbols": self.flush_symbols.to_wire(),
+            "flush_requests": self.flush_requests.to_wire(),
+            "flush_wall_s": self.flush_wall_s.to_wire(),
+            "throughput": self.throughput(),
+        }
+
+    @classmethod
+    def from_wire(cls, wire: dict) -> "ServeMetrics":
+        m = cls()
+        m.latency_s = Histogram.from_wire(wire["latency_s"])
+        m.flush_symbols = Histogram.from_wire(wire["flush_symbols"])
+        m.flush_requests = Histogram.from_wire(wire["flush_requests"])
+        m.flush_wall_s = Histogram.from_wire(wire["flush_wall_s"])
+        with m._lock:
+            for scope, table in wire.get("throughput", {}).items():
+                for key, ent in table.items():
+                    m._through[(scope, key)] = [
+                        int(ent["requests"]), int(ent["symbols"])]
+        return m
+
+
+__all__ = [
+    "LO", "LOG2_GROWTH", "N_BINS", "bin_index", "bin_edges",
+    "Histogram", "ServeMetrics",
+]

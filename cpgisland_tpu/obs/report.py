@@ -14,6 +14,7 @@ import json
 from typing import IO, Iterable, Union
 
 _LEDGER_KEYS = ("compiles", "compile_s", "dispatches", "fetch_bytes", "upload_bytes")
+_MAX_REPORT_TRACES = 10_000
 
 
 def summarize_jsonl(source: Union[str, IO[str], Iterable[str]]) -> dict:
@@ -29,6 +30,8 @@ def summarize_jsonl(source: Union[str, IO[str], Iterable[str]]) -> dict:
     engine_by_span: dict = {}
     ledger: dict = {}
     violations: list = []
+    traces: list = []
+    slo = None
     summary_rec = None
     try:
         for line in f:
@@ -67,6 +70,11 @@ def summarize_jsonl(source: Union[str, IO[str], Iterable[str]]) -> dict:
                     engine_by_span.setdefault(rec["span"], set()).add(
                         f"{rec.get('site')}->{rec.get('choice')}"
                     )
+            elif ev == "request_trace":
+                if len(traces) < _MAX_REPORT_TRACES:
+                    traces.append(rec)
+            elif ev == "slo_snapshot":
+                slo = rec  # last one wins: the freshest rollup
             elif ev == "obs_summary":
                 summary_rec = rec
     finally:
@@ -84,6 +92,8 @@ def summarize_jsonl(source: Union[str, IO[str], Iterable[str]]) -> dict:
         "decisions": decisions,
         "watchdog_violations": violations,
         "engine_by_span": {k: sorted(v) for k, v in engine_by_span.items()},
+        "request_traces": traces,
+        "slo": slo,
     }
     if summary_rec is not None:
         out["process_index"] = summary_rec.get("process_index", 0)
@@ -163,5 +173,120 @@ def render_summary(summary: dict) -> str:
     return "\n".join(lines)
 
 
-def render_file(path: str) -> str:
-    return render_summary(summarize_jsonl(path))
+def render_lineage(traces: list, request_id: int | None = None) -> str:
+    """graftscope lineage: per-request hop tables (relative wall per hop)
+    followed by per-flush composition (which requests rode which flush on
+    which device).  ``request_id`` filters to one request's trace."""
+    lines: list = []
+    flushes: dict = {}
+    shown = 0
+    for tr in traces:
+        hops = tr.get("hops") or []
+        for h in hops:
+            if h.get("hop") == "flush.enter" and h.get("flush") is not None:
+                ent = flushes.setdefault(
+                    h["flush"],
+                    {"device": h.get("device", ""), "ids": [], "routes": {}},
+                )
+                ent["ids"].append(tr.get("id"))
+                r = tr.get("route", "")
+                ent["routes"][r] = ent["routes"].get(r, 0) + 1
+        if request_id is not None and tr.get("id") != request_id:
+            continue
+        shown += 1
+        head = (
+            f"request {tr.get('id')} tenant={tr.get('tenant')} "
+            f"kind={tr.get('kind')} model={tr.get('model') or '-'} "
+            f"route={tr.get('route')} device={tr.get('device') or '-'} "
+            f"ok={tr.get('ok')} n_symbols={tr.get('n_symbols')} "
+            f"latency={1e3 * (tr.get('latency_s') or 0.0):.2f} ms"
+        )
+        lines.append(head)
+        t0 = hops[0].get("t") if hops else None
+        for h in hops:
+            dt = 0.0 if t0 is None else (h.get("t", t0) - t0)
+            extra = ", ".join(
+                f"{k}={v}" for k, v in h.items()
+                if k not in ("hop", "t") and v not in (None, "")
+            )
+            lines.append(f"  +{1e3 * dt:>9.3f} ms  {h.get('hop'):<16} {extra}")
+    if request_id is not None and shown == 0:
+        lines.append(f"request {request_id}: no trace in this stream")
+    if request_id is None and flushes:
+        lines.append("")
+        lines.append("flush composition:")
+        for fid in sorted(flushes):
+            ent = flushes[fid]
+            routes = ", ".join(
+                f"{r or '?'}x{n}" for r, n in sorted(ent["routes"].items())
+            )
+            lines.append(
+                f"  flush {fid} device={ent['device'] or '-'} "
+                f"requests={len(ent['ids'])} [{routes}] "
+                f"ids={sorted(i for i in ent['ids'] if i is not None)}"
+            )
+    return "\n".join(lines)
+
+
+def render_slo(slo: dict) -> str:
+    """One block per histogram from an slo_snapshot record (or a live
+    Scope.snapshot()['metrics'])."""
+    m = slo.get("slo", slo)  # accept the raw JSONL record or the rollup
+    if "latency_s" not in m:
+        m = m.get("metrics", {})
+    lines = ["slo snapshot:"]
+    for key, unit, scale in (
+        ("latency_s", "ms", 1e3), ("flush_wall_s", "ms", 1e3),
+        ("flush_symbols", "sym", 1), ("flush_requests", "req", 1),
+    ):
+        s = m.get(key)
+        if not s or not s.get("count"):
+            continue
+        lines.append(
+            f"  {key:<16} n={s['count']:<7} p50={scale * s['p50']:.2f} {unit}"
+            f"  p95={scale * s['p95']:.2f} {unit}"
+            f"  p99={scale * s['p99']:.2f} {unit}"
+            f"  max={scale * s['max']:.2f} {unit}"
+        )
+    thr = m.get("throughput") or {}
+    for scope_name, table in sorted(thr.items()):
+        row = ", ".join(
+            f"{k}: {v['requests']} req / {v['symbols']} sym"
+            for k, v in sorted(table.items())
+        )
+        lines.append(f"  by {scope_name}: {row}")
+    return "\n".join(lines)
+
+
+def render_flight(dump: Union[str, dict]) -> str:
+    """Render a flight-recorder artifact (the ``*.flight.json`` a dying or
+    shutting-down daemon persists) as a readable event timeline."""
+    if isinstance(dump, str):
+        with open(dump) as f:
+            dump = json.load(f)
+    events = dump.get("events", [])
+    lines = [
+        f"flight recorder: reason={dump.get('reason')} pid={dump.get('pid')} "
+        f"{len(events)} event(s) (of {dump.get('events_seen')} seen, "
+        f"ring capacity {dump.get('capacity')})"
+    ]
+    t0 = events[0].get("t") if events else None
+    for ev in events:
+        dt = 0.0 if t0 is None else ev.get("t", t0) - t0
+        extra = ", ".join(
+            f"{k}={v}" for k, v in ev.items()
+            if k not in ("kind", "t") and v not in (None, "")
+        )
+        lines.append(f"  +{dt:>9.3f} s  {ev.get('kind'):<20} {extra}")
+    return "\n".join(lines)
+
+
+def render_file(path: str, request_id: int | None = None) -> str:
+    summary = summarize_jsonl(path)
+    parts = [render_summary(summary)]
+    if summary.get("slo"):
+        parts.append(render_slo(summary["slo"]))
+    if summary.get("request_traces"):
+        parts.append("request lineage:")
+        parts.append(render_lineage(summary["request_traces"], request_id))
+    return "\n\n".join(parts)

@@ -1,0 +1,377 @@
+"""graftscope: request-scoped serve telemetry.
+
+Three pieces, all off-by-default and dispatch-free:
+
+- **Request lineage** (``Scope`` + module ``hop``/``complete``): a trace is
+  minted at admission (keyed by the broker-assigned request id) and every
+  serve-layer station appends a hop — ``admit``, ``journal.admit``,
+  ``taken`` (queue residency), ``flush.enter`` (flush id + device + route
+  group), ``executed`` (route + device wall), ``requeue`` (failover),
+  ``journal.complete``, ``respond``.  Hops are plain dicts with a
+  ``time.monotonic()`` stamp taken under the scope lock, so append order is
+  timestamp order.  On completion the closed trace is emitted as ONE
+  ``request_trace`` obs event (it lands in the existing ``--metrics``
+  JSONL sink) and folded into the streaming SLO histograms.
+- **Streaming SLO metrics**: ``Scope.metrics`` is an
+  :class:`~cpgisland_tpu.obs.metrics.ServeMetrics` — mergeable log-binned
+  histograms for queue->result latency and flush size/occupancy/wall plus
+  per-tenant/per-model/per-device throughput.  Snapshots are served by the
+  ``kind=stats`` wire request and the ``--metrics-interval`` emitter.
+- **Flight recorder** (``FlightRecorder``): a bounded ring of the last N
+  lineage/health/fault events, persisted atomically (tmp + ``os.replace``)
+  next to the journal on shutdown, on ``SimulatedKill`` (graftfault tees
+  into :func:`on_kill` before raising), and on unhandled worker death.
+
+Lock discipline (Layer 4): ``Scope._lock`` and ``FlightRecorder._lock``
+are leaves — nothing is acquired and no I/O happens while holding them
+(persist snapshots under the lock, writes outside).  Broker/fleet/health
+code calls in while holding their own locks, which only adds
+``<owner> -> scope`` leaf edges to the cross-module graph.  The module
+``_ACTIVE`` handle is read unlocked by design (same pattern as
+``obs._ACTIVE`` / ``faultplan._ACTIVE``): installs happen at daemon/test
+setup, and a stale read degrades to a dropped telemetry hop, never a
+wrong serve result.
+"""
+
+from __future__ import annotations
+
+import collections
+import contextlib
+import json
+import os
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from cpgisland_tpu import obs as _obs
+from cpgisland_tpu.obs.metrics import ServeMetrics
+
+DEFAULT_RING = 2048
+DEFAULT_MAX_TRACES = 10_000
+
+
+class FlightRecorder:
+    """Bounded in-memory ring of telemetry events + atomic persistence."""
+
+    def __init__(self, capacity: int = DEFAULT_RING,
+                 path: Optional[str] = None) -> None:
+        self.capacity = int(capacity)
+        self.path = path
+        self._lock = threading.Lock()
+        self._ring: collections.deque = collections.deque(maxlen=self.capacity)
+        self._seen = 0
+        self._persists = 0
+
+    def record(self, kind: str, **fields: Any) -> None:
+        ev = {"t": time.time(), "kind": kind}
+        ev.update(fields)
+        with self._lock:  # graftsync: leaf lock, no I/O below
+            self._ring.append(ev)
+            self._seen += 1
+
+    def snapshot(self) -> List[dict]:
+        with self._lock:
+            return list(self._ring)
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"events": len(self._ring), "seen": self._seen,
+                    "capacity": self.capacity, "persists": self._persists}
+
+    def persist(self, reason: str, path: Optional[str] = None) -> Optional[str]:
+        """Atomically write the ring next to the journal.  Best-effort: a
+        postmortem writer must never turn a crash into a different crash."""
+        dst = path or self.path
+        if dst is None:
+            return None
+        with self._lock:
+            events = list(self._ring)
+            seen = self._seen
+            self._persists += 1
+        payload = {
+            "reason": reason,
+            "ts": time.time(),
+            "pid": os.getpid(),
+            "capacity": self.capacity,
+            "events_seen": seen,
+            "events": events,
+        }
+        tmp = f"{dst}.tmp.{os.getpid()}"
+        try:
+            with open(tmp, "w", encoding="utf-8") as f:
+                json.dump(payload, f)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, dst)
+            return dst
+        except OSError:
+            with contextlib.suppress(OSError):
+                os.remove(tmp)
+            return None
+
+
+class Scope:
+    """Per-request lineage + SLO rollup + flight recorder for one daemon."""
+
+    def __init__(self, *, flight_path: Optional[str] = None,
+                 ring_capacity: int = DEFAULT_RING,
+                 max_traces: int = DEFAULT_MAX_TRACES) -> None:
+        self._lock = threading.Lock()
+        self._traces: Dict[int, dict] = {}      # live rid -> trace
+        self.traces: List[dict] = []            # closed traces (bounded)
+        self.max_traces = int(max_traces)
+        self.dropped_traces = 0
+        self._flush_seq = 0
+        self.metrics = ServeMetrics()
+        self.recorder = FlightRecorder(ring_capacity, flight_path)
+
+    # -- lineage -------------------------------------------------------------
+
+    def hop(self, rid: int, name: str, **fields: Any) -> None:
+        h = {"hop": name}
+        h.update(fields)
+        with self._lock:  # graftsync: leaf lock, no I/O below
+            tr = self._traces.get(rid)
+            if tr is None:
+                tr = self._traces[rid] = {"id": rid, "t0": time.monotonic(),
+                                          "ts0": time.time(), "hops": []}
+            if name == "admit":
+                for k in ("tenant", "kind", "model", "n_symbols"):
+                    if k in fields:
+                        tr[k] = fields[k]
+            h["t"] = time.monotonic()  # stamped under the lock: append
+            tr["hops"].append(h)       # order IS timestamp order
+
+    def next_flush_id(self) -> int:
+        with self._lock:
+            self._flush_seq += 1
+            return self._flush_seq
+
+    def complete(self, rid: int, *, ok: bool, route: str, fault: bool = False,
+                 replayed: bool = False, n_symbols: int = 0,
+                 device: str = "") -> None:
+        now = time.monotonic()
+        with self._lock:
+            tr = self._traces.pop(rid, None)
+            if tr is None:
+                return
+            latency = now - tr["t0"]
+            tr["hops"].append({"hop": "respond", "ok": ok, "route": route,
+                               "fault": fault, "replayed": replayed, "t": now})
+            tr.update(ok=ok, route=route, fault=fault, replayed=replayed,
+                      latency_s=latency)
+            dev = device or tr.get("device", "")
+            if not dev:
+                # Last device-carrying hop wins: a requeued request is
+                # attributed to the device that actually served it, not
+                # the one that faulted it away.
+                for h in reversed(tr["hops"]):
+                    if h.get("device"):
+                        dev = h["device"]
+                        break
+            tr["device"] = dev
+            if len(self.traces) < self.max_traces:
+                self.traces.append(tr)
+            else:
+                self.dropped_traces += 1
+        # Below: metrics + event emission OUTSIDE the scope lock (the obs
+        # event path takes the observer's own lock and may write JSONL).
+        self.metrics.note_result(
+            tenant=str(tr.get("tenant", "")), model=str(tr.get("model", "")),
+            device=dev, n_symbols=int(tr.get("n_symbols", n_symbols) or 0),
+            latency_s=latency)
+        self.recorder.record(
+            "request", id=rid, tenant=tr.get("tenant"), route=route, ok=ok,
+            fault=fault, replayed=replayed, device=dev,
+            latency_ms=round(latency * 1e3, 3))
+        _obs.event("request_trace", id=rid,
+                   tenant=tr.get("tenant"), kind=tr.get("kind"),
+                   model=tr.get("model"), n_symbols=tr.get("n_symbols"),
+                   route=route, ok=ok, fault=fault, replayed=replayed,
+                   device=dev, latency_s=round(latency, 6), hops=tr["hops"])
+
+    def flush_done(self, fid: int, *, device: str, n_requests: int,
+                   symbols: int, wall_s: float) -> None:
+        self.metrics.note_flush(n_requests=n_requests, symbols=symbols,
+                                wall_s=wall_s)
+        self.recorder.record("flush", flush=fid, device=device,
+                             n_requests=n_requests, symbols=symbols,
+                             wall_ms=round(wall_s * 1e3, 3))
+
+    # -- recorder hooks ------------------------------------------------------
+
+    def record(self, kind: str, **fields: Any) -> None:
+        self.recorder.record(kind, **fields)
+
+    def on_kill(self, point: str, tag: str) -> Optional[str]:
+        self.recorder.record("kill", point=point, tag=tag)
+        return self.recorder.persist(f"kill:{point}")
+
+    def on_worker_death(self, label: str, exc: BaseException) -> Optional[str]:
+        self.recorder.record("worker_death", device=label, error=repr(exc))
+        return self.recorder.persist(f"worker_death:{label}")
+
+    # -- snapshots -----------------------------------------------------------
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            open_reqs = len(self._traces)
+            closed = len(self.traces)
+            dropped = self.dropped_traces
+        return {
+            "metrics": self.metrics.snapshot(),
+            "open_requests": open_reqs,
+            "completed_requests": closed,
+            "dropped_traces": dropped,
+            "flight": self.recorder.stats(),
+        }
+
+
+# The live handle.  Read UNLOCKED on serve hot paths (one global load when
+# telemetry is off); mutated only via install()/uninstall() under _HANDLE_LOCK.
+# Registered in analysis.config.SYNC_UNGUARDED with this justification.
+_ACTIVE: Optional[Scope] = None
+_HANDLE_LOCK = threading.Lock()
+
+
+def active() -> Optional[Scope]:
+    return _ACTIVE
+
+
+def enabled() -> bool:
+    return _ACTIVE is not None
+
+
+def install(scope: Scope) -> Scope:
+    global _ACTIVE
+    with _HANDLE_LOCK:
+        _ACTIVE = scope
+    return scope
+
+
+def uninstall(scope: Optional[Scope] = None) -> None:
+    global _ACTIVE
+    with _HANDLE_LOCK:
+        if scope is None or _ACTIVE is scope:
+            _ACTIVE = None
+
+
+@contextlib.contextmanager
+def scoped(*, flight_path: Optional[str] = None,
+           ring_capacity: int = DEFAULT_RING,
+           max_traces: int = DEFAULT_MAX_TRACES):
+    """Install a fresh Scope for the block; persist the recorder on exit."""
+    sc = Scope(flight_path=flight_path, ring_capacity=ring_capacity,
+               max_traces=max_traces)
+    install(sc)
+    try:
+        yield sc
+    finally:
+        uninstall(sc)
+        sc.recorder.persist("shutdown")
+
+
+# -- module-level helpers: one unlocked global read when telemetry is off ----
+
+
+def hop(rid: int, name: str, **fields: Any) -> None:
+    s = _ACTIVE
+    if s is not None:
+        s.hop(rid, name, **fields)
+
+
+def complete(rid: int, **kw: Any) -> None:
+    s = _ACTIVE
+    if s is not None:
+        s.complete(rid, **kw)
+
+
+def next_flush_id() -> Optional[int]:
+    s = _ACTIVE
+    if s is not None:
+        return s.next_flush_id()
+    return None
+
+
+def flush_done(fid: int, **kw: Any) -> None:
+    s = _ACTIVE
+    if s is not None:
+        s.flush_done(fid, **kw)
+
+
+def record(kind: str, **fields: Any) -> None:
+    s = _ACTIVE
+    if s is not None:
+        s.recorder.record(kind, **fields)
+
+
+def on_kill(point: str, tag: str) -> None:
+    s = _ACTIVE
+    if s is not None:
+        s.on_kill(point, tag)
+
+
+def on_worker_death(label: str, exc: BaseException) -> None:
+    s = _ACTIVE
+    if s is not None:
+        s.on_worker_death(label, exc)
+
+
+class SnapshotEmitter:
+    """Periodic ``slo_snapshot`` JSONL emission for ``--metrics-interval``.
+
+    One daemon thread; each tick emits the scope's SLO snapshot (plus any
+    caller-supplied live payload — queue depth, fleet health) through the
+    active observer's metrics sink, and drops a compact ``snapshot`` event
+    into the flight recorder so postmortems carry a metric timeline.
+    ``stop()`` joins the thread (graftsync thread-lifecycle rule).
+    """
+
+    def __init__(self, scope: Scope, interval_s: float,
+                 extra_fn: Optional[Callable[[], dict]] = None) -> None:
+        self.scope = scope
+        self.interval_s = float(interval_s)
+        self.extra_fn = extra_fn
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "SnapshotEmitter":
+        t = threading.Thread(target=self._run, name="graftscope-emitter",
+                             daemon=True)
+        self._thread = t
+        t.start()
+        return self
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            self.emit_once()
+
+    def emit_once(self) -> None:
+        payload: dict = {"slo": self.scope.metrics.snapshot()}
+        if self.extra_fn is not None:
+            try:
+                extra = self.extra_fn()
+            except Exception:  # live stats must not kill the emitter
+                extra = None
+            if extra:
+                payload.update(extra)
+        _obs.event("slo_snapshot", **payload)
+        lat = payload["slo"]["latency_s"]
+        self.scope.recorder.record(
+            "snapshot", requests=lat["count"],
+            p50_ms=round(lat["p50"] * 1e3, 3),
+            p99_ms=round(lat["p99"] * 1e3, 3),
+            queued_requests=payload.get("stats", {}).get("queued_requests"))
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10.0)
+            self._thread = None
+
+
+__all__ = [
+    "Scope", "FlightRecorder", "SnapshotEmitter", "active", "enabled",
+    "install", "uninstall", "scoped", "hop", "complete", "next_flush_id",
+    "flush_done", "record", "on_kill", "on_worker_death",
+]

@@ -155,6 +155,24 @@ class FaultPlan:
             "graftfault_injected", plan=self.name, point=point,
             kind=action.kind, tag=tag,
         )
+        # graftscope flight recorder: the injection must be attributable in
+        # a postmortem.  Lazy import (obs.scope imports obs; keeping the
+        # resilience layer import-light at module load) and best-effort —
+        # telemetry must never change what the chaos harness injects.
+        try:
+            from cpgisland_tpu.obs import scope as scope_mod
+
+            scope_mod.record(
+                "graftfault_injected", plan=self.name, point=point,
+                fault_kind=action.kind, tag=tag,
+            )
+            if action.kind == "kill":
+                # Persist the ring BEFORE raising: a SimulatedKill
+                # propagates uncaught by contract, so this is the last
+                # instant the postmortem artifact can be written.
+                scope_mod.on_kill(point, tag)
+        except Exception:
+            pass
         log.warning(
             "graftfault[%s]: injecting %s at %s [%s]",
             self.name, action.kind, point, tag,
@@ -181,6 +199,15 @@ class FaultPlan:
                 "graftfault_injected", plan=self.name, point=point,
                 kind="slow", tag=tag, pad_s=pad,
             )
+            try:
+                from cpgisland_tpu.obs import scope as scope_mod
+
+                scope_mod.record(
+                    "graftfault_injected", plan=self.name, point=point,
+                    fault_kind="slow", tag=tag, pad_s=pad,
+                )
+            except Exception:
+                pass
         return pad
 
 

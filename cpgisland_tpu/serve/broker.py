@@ -60,6 +60,7 @@ import numpy as np
 
 from cpgisland_tpu import obs
 from cpgisland_tpu import pipeline
+from cpgisland_tpu.obs import scope as scope_mod
 from cpgisland_tpu.ops import islands as islands_mod
 from cpgisland_tpu.ops.islands import IslandCalls
 from cpgisland_tpu.resilience import faultplan
@@ -323,6 +324,11 @@ class RequestBroker:
                 self._queued_symbols += symbols.size
                 self._seen_ids.add(req.id)
                 self._journal_requeued.add(req.id)
+                scope_mod.hop(
+                    req.id, "admit", tenant=req.tenant, kind=req.kind,
+                    model=req.model, n_symbols=int(symbols.size),
+                    journal_requeued=True,
+                )
                 requeued += 1
             self._cv.notify_all()
         if requeued or pending:
@@ -521,6 +527,14 @@ class RequestBroker:
                         n_symbols=int(symbols.size),
                         route="replay", replayed=True,
                     ))
+                    # graftscope lineage: replayed requests get a closed
+                    # trace too (admit here, respond in finish_flush).  The
+                    # scope lock is a leaf — safe under the cv.
+                    scope_mod.hop(
+                        req.id, "admit", tenant=req.tenant, kind=req.kind,
+                        model=req.model, n_symbols=int(symbols.size),
+                        replay=True,
+                    )
                     self._cv.notify_all()
                     return
             if req.id in self._queued_ids or req.id in self._inflight_ids:
@@ -578,6 +592,15 @@ class RequestBroker:
             self._queue.append(req)
             self._queued_ids.add(req.id)
             self._queued_symbols += symbols.size
+            # graftscope lineage: mint the trace INSIDE the cv, right after
+            # the request becomes visible — hop order matches queue order.
+            # Scope lock is a leaf (cv -> scope edge only, no cycle).
+            scope_mod.hop(
+                req.id, "admit", tenant=req.tenant, kind=req.kind,
+                model=req.model, n_symbols=int(symbols.size),
+            )
+            if self.manifest is not None:
+                scope_mod.hop(req.id, "journal.admit")
             self._cv.notify_all()
 
     def backpressure(self) -> bool:
@@ -678,6 +701,10 @@ class RequestBroker:
                 t.queued_requests -= 1
                 t.queued_symbols -= nxt.symbols.size
                 t.queue_s += now - nxt.t_submit
+                # graftscope lineage: queue residency ends here.
+                scope_mod.hop(
+                    nxt.id, "taken", queue_s=round(now - nxt.t_submit, 6)
+                )
             self._queued_symbols -= total
             return replayed, batch, now
 
@@ -706,15 +733,18 @@ class RequestBroker:
 
     # graftcheck: hot-path
     def run_batch(self, batch: list, t_taken: float, *, registry=None,
-                  timer=None) -> list:
+                  timer=None, device: str = "") -> list:
         """Execute one taken batch WITHOUT completing it (no journal
         completion, no tenant accounting): the fleet inspects the results
         for device-shaped faults and either requeues the batch intact on
         another device or hands everything to :meth:`finish_flush`.
         ``registry`` routes execution through a per-device session set
         (default: the broker's own); ``timer`` keeps per-worker phase
-        accounting off the shared PhaseTimer."""
-        return self._run_flush(batch, t_taken, registry=registry, timer=timer)
+        accounting off the shared PhaseTimer; ``device`` labels the
+        executing device in the lineage trace (fleet workers pass their
+        pool label)."""
+        return self._run_flush(batch, t_taken, registry=registry,
+                               timer=timer, device=device)
 
     def fail_batch(self, batch: list, t_taken: float,
                    error: BaseException) -> list:
@@ -759,6 +789,7 @@ class RequestBroker:
                         faultplan.check(
                             "journal.post_complete", tag=f"req{r.id}"
                         )
+                        scope_mod.hop(r.id, "journal.complete")
                     except Exception:
                         # Journaling must never eat computed results: the
                         # clients still get their responses; the cost of a
@@ -815,6 +846,15 @@ class RequestBroker:
                 if not r.replayed:
                     t.symbols += r.n_symbols
                     t.wall_s += r.serve_s
+        # graftscope lineage: close every trace OUTSIDE the broker lock
+        # (completion folds histograms + emits the request_trace event,
+        # which takes the observer's own lock and may write JSONL).
+        if scope_mod.enabled():
+            for r in results:
+                scope_mod.complete(
+                    r.id, ok=r.ok, route=r.route, fault=r.fault,
+                    replayed=r.replayed, n_symbols=r.n_symbols,
+                )
         return results
 
     @staticmethod
@@ -834,7 +874,7 @@ class RequestBroker:
 
     # graftcheck: hot-path
     def _run_flush(self, batch: list, t_taken: float, *, registry=None,
-                   timer=None) -> list:
+                   timer=None, device: str = "") -> list:
         """Execute one coalesced flush: requests group by MODEL (the
         registry's per-model sessions — one model's faults stay in its
         own breaker domain), batch-eligible decode records of each model
@@ -851,6 +891,13 @@ class RequestBroker:
         results: dict[int, ServeResult] = {}
         n_flat = n_singles = n_posts = 0
         compares: list = []
+        # graftscope lineage: one flush id per EXECUTION (a requeued flush
+        # gets a fresh id — the trace shows both memberships).
+        fid = scope_mod.next_flush_id()
+        if fid is not None:
+            for req in batch:
+                scope_mod.hop(req.id, "flush.enter", flush=fid,
+                              device=device, n_requests=len(batch))
         with obs.span("serve.flush", items=total, unit="sym"):
             # graftfault kill point: "mid-flush" — after every admit line,
             # before any completion line.
@@ -923,6 +970,15 @@ class RequestBroker:
             r.queue_s = t_taken - req.t_submit
             r.serve_s = wall
             out.append(r)
+        if fid is not None:
+            scope_mod.flush_done(
+                fid, device=device, n_requests=len(batch),
+                symbols=int(total), wall_s=wall,
+            )
+            for r in out:
+                scope_mod.hop(r.id, "executed", flush=fid, device=device,
+                              route=r.route, ok=r.ok,
+                              wall_s=round(wall, 6))
         return out
 
     # graftcheck: hot-path

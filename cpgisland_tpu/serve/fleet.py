@@ -64,6 +64,8 @@ import time
 from typing import Callable, Optional
 
 from cpgisland_tpu import obs
+from cpgisland_tpu.obs import ledger as ledger_mod
+from cpgisland_tpu.obs import scope as scope_mod
 from cpgisland_tpu.resilience.sentinel import PhantomResult
 from cpgisland_tpu.serve.broker import RequestBroker
 from cpgisland_tpu.serve.session import ModelRegistry
@@ -217,6 +219,11 @@ class DeviceHealth:
                     "device_restored", device=self.label,
                     quarantines=self.quarantines,
                 )
+                # graftscope flight recorder: health transitions are
+                # postmortem-load-bearing.  Recorder lock is a leaf —
+                # same health -> telemetry order as the obs event above.
+                scope_mod.record("device_restored", device=self.label,
+                                 quarantines=self.quarantines)
                 log.info(
                     "fleet: device %s restored (half-open probe flush "
                     "succeeded)", self.label,
@@ -275,6 +282,10 @@ class DeviceHealth:
             cooldown_s=self.cooldown_s,
             wall_s=None if wall_s is None else round(wall_s, 3),
             error=(f"{type(error).__name__}: {error}"[:200] if error else None),
+        )
+        scope_mod.record(
+            "device_quarantined", device=self.label, reason=reason,
+            consecutive_faults=faults, cooldown_s=self.cooldown_s,
         )
         log.warning(
             "fleet: device %s QUARANTINED (%s) for %.0f s; its flushes "
@@ -337,8 +348,19 @@ class _DeviceWorker:
         self.flushes = 0  # this device's finished flushes (stats; own thread)
         self._timer = profiling.PhaseTimer()  # per-worker: no shared-timer race
         self._thread = threading.Thread(
-            target=self._run, name=f"cpgisland-fleet-{label}", daemon=True
+            target=self._run_guarded, name=f"cpgisland-fleet-{label}",
+            daemon=True,
         )
+
+    def _run_guarded(self) -> None:
+        # Unhandled worker death is a postmortem event: persist the flight
+        # recorder before the thread dies (daemon threads leave no
+        # traceback artifact otherwise), then re-raise.
+        try:
+            self._run()
+        except BaseException as e:
+            scope_mod.on_worker_death(self.label, e)
+            raise
 
     def start(self) -> None:
         self._thread.start()
@@ -387,10 +409,14 @@ class _DeviceWorker:
             # Pin this worker's dispatches to ITS device (thread-local
             # config: concurrent workers don't interfere).  The flat
             # stream is geometry-independent — any device, same bits.
-            with jax.default_device(self.device):
+            # device_scope attributes this thread's ledger counts + obs
+            # events to this device (fleet attribution, thread-local).
+            with ledger_mod.device_scope(self.label), \
+                    jax.default_device(self.device):
                 results = broker.run_batch(
                     pf.batch, pf.t_taken,
                     registry=self.registry, timer=self._timer,
+                    device=self.label,
                 )
         except Exception as e:
             # Flush-LEVEL failure (broker internals — per-request units
@@ -411,6 +437,19 @@ class _DeviceWorker:
                 n_faulted=len(faulted),
                 symbols=int(sum(r.symbols.size for r in pf.batch)),
                 requeue=pf.requeues,
+                error=(faulted[0].error or "")[:200],
+            )
+            # graftscope: the failover decision, per affected request (the
+            # lineage hop) and as one recorder event naming the ids.
+            for req in pf.batch:
+                scope_mod.hop(
+                    req.id, "requeue", device=self.label,
+                    requeue=pf.requeues, n_faulted=len(faulted),
+                )
+            scope_mod.record(
+                "flush_requeued", device=self.label,
+                requeue=pf.requeues, n_faulted=len(faulted),
+                request_ids=[req.id for req in pf.batch[:64]],
                 error=(faulted[0].error or "")[:200],
             )
             log.warning(

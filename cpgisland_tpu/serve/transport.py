@@ -192,6 +192,26 @@ def _admit_request(
         })
 
 
+def _stats_wire(req: dict, broker: RequestBroker, pool=None,
+                router=None) -> dict:
+    """Response for a ``kind=stats`` request: broker queue stats + the
+    graftscope SLO snapshot (latency/flush histograms, per-tenant/-model/
+    -device throughput) + fleet health when a DevicePool drives the broker.
+    Pure host-side reads — a stats request never enters the flush queue."""
+    from cpgisland_tpu.obs import scope as scope_mod
+
+    out: dict = {"ok": True, "kind": "stats", "stats": broker.stats()}
+    if req.get("id") is not None:
+        out["id"] = req["id"]
+    sc = scope_mod.active()
+    out["slo"] = None if sc is None else sc.snapshot()
+    if pool is not None:
+        out["fleet"] = pool.stats()
+    if router is not None:
+        out["mux"] = router.stats()
+    return out
+
+
 def serve_stream(
     inp: IO[str],
     out: IO[str],
@@ -264,6 +284,12 @@ def serve_stream(
                 break
             if op == "stats":
                 write({"ok": True, "stats": broker.stats()})
+                continue
+            if req.get("kind") == "stats":
+                # graftscope SLO snapshot: answered inline on this thread
+                # (never queued — a monitoring poll must not ride the
+                # flush path or pay its latency).
+                write(_stats_wire(req, broker, pool))
                 continue
             # Host-side encode + submit on THIS thread (the work that
             # overlaps the worker loop's device compute) via the shared
@@ -356,12 +382,35 @@ def serve_main(args, params) -> int:
     local devices instead of the single worker loop."""
     import sys
 
+    from cpgisland_tpu import obs as obs_mod
+    from cpgisland_tpu.obs import scope as scope_mod
+
     broker = _build_broker(args, params)
     pool = None
     if getattr(args, "fleet", 0):
         from cpgisland_tpu.serve.fleet import DevicePool
 
         pool = DevicePool.build(broker, n_devices=args.fleet)
+    # graftscope: request lineage + SLO histograms + flight recorder ride
+    # along whenever the obs layer is on OR periodic emission was asked
+    # for; the recorder persists next to the journal (<manifest>.flight.json)
+    # on shutdown/SimulatedKill/worker death.  Off-by-default otherwise.
+    interval = float(getattr(args, "metrics_interval", 0.0) or 0.0)
+    scope = None
+    emitter = None
+    if obs_mod.enabled() or interval > 0:
+        flight = f"{args.manifest}.flight.json" if args.manifest else None
+        scope = scope_mod.install(scope_mod.Scope(flight_path=flight))
+        if interval > 0:
+            def _live_stats() -> dict:
+                extra = {"stats": broker.stats()}
+                if pool is not None:
+                    extra["fleet"] = pool.stats()
+                return extra
+
+            emitter = scope_mod.SnapshotEmitter(
+                scope, interval, extra_fn=_live_stats
+            ).start()
     try:
         if not args.socket:
             n = serve_stream(
@@ -383,6 +432,11 @@ def serve_main(args, params) -> int:
         broker.registry.close()
         if pool is not None:
             pool.close()
+        if emitter is not None:
+            emitter.stop()
+        if scope is not None:
+            scope_mod.uninstall(scope)
+            scope.recorder.persist("shutdown")
 
 
 # ---------------------------------------------------------------------------
@@ -558,6 +612,7 @@ def _mux_read_loop(
     broker: RequestBroker,
     router: ResponseRouter,
     invalid_symbols: str,
+    pool=None,
 ) -> None:
     """One connection's reader: parse + encode + route + submit (the
     shared ``_admit_request`` core with the router as the claim).  Pure
@@ -600,6 +655,10 @@ def _mux_read_loop(
             stats["mux"] = router.stats()
             client.write_payload({"ok": True, "stats": stats})
             continue
+        if req.get("kind") == "stats":
+            # graftscope SLO snapshot (see serve_stream): inline, unqueued.
+            client.write_payload(_stats_wire(req, broker, pool, router))
+            continue
         _admit_request(
             req, broker, invalid_symbols=invalid_symbols,
             write=client.write_payload,
@@ -615,9 +674,10 @@ def _mux_client_thread(
     router: ResponseRouter,
     invalid_symbols: str,
     drain_timeout_s: float,
+    pool=None,
 ) -> None:
     try:
-        _mux_read_loop(client, rf, broker, router, invalid_symbols)
+        _mux_read_loop(client, rf, broker, router, invalid_symbols, pool)
     except OSError:
         log.info("serve mux: connection %d dropped mid-read", client.cid)
     except Exception:
@@ -731,7 +791,7 @@ def serve_socket(
             t = threading.Thread(
                 target=_mux_client_thread,
                 args=(client, conn, rf, broker, router, invalid_symbols,
-                      drain_timeout_s),
+                      drain_timeout_s, pool),
                 name=f"cpgisland-serve-conn{n_conns}",
                 daemon=True,
             )

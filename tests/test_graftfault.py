@@ -828,3 +828,124 @@ def test_connection_death_mid_stream_client_replays(tmp_path):
     s.close()
     server.join(timeout=60.0)
     assert not server.is_alive()
+
+
+# ---------------------------------------------------------------------------
+# graftscope: chaos-certified fault visibility (PR 16).  Every injected
+# fault must be ATTRIBUTABLE in telemetry — site, affected request ids,
+# the requeue/failover decision — and the flight recorder must survive a
+# SIGKILL at every journal phase.
+
+
+@pytest.mark.slow
+def test_injected_fault_attributable_in_recorder_and_lineage(tmp_path):
+    """The headline failover with a graftscope Scope installed: the flight
+    recorder names the injection site + plan, the quarantine, the requeue
+    decision WITH the affected request ids, and the restore; the affected
+    requests' traces show requeued-after-quarantine lineage (requeue hop
+    on dev0, second flush membership on dev1, closed ok)."""
+    from cpgisland_tpu.obs import scope as scope_mod
+
+    recs = _requests()
+
+    def stage(pool, clock):
+        pool.workers[1].health.force_quarantine("staged")
+
+    plan = FaultPlan(
+        [Fault("dispatch", kind="fault", match="@dev0", nth=1,
+               times=ATTEMPTS)],
+        name="dev0-faults",
+    )
+    sc = scope_mod.install(
+        scope_mod.Scope(flight_path=str(tmp_path / "f.flight.json"))
+    )
+    try:
+        chaos, pool, _events = _run_pool(recs, plan=plan, stage=stage)
+    finally:
+        scope_mod.uninstall(sc)
+    assert all(r.ok for r in chaos.values())
+
+    ring = sc.recorder.snapshot()
+    inj = [e for e in ring if e["kind"] == "graftfault_injected"]
+    assert len(inj) == ATTEMPTS
+    assert all(e["point"] == "dispatch" and e["plan"] == "dev0-faults"
+               and e["fault_kind"] == "fault" for e in inj)
+    quar = [e for e in ring if e["kind"] == "device_quarantined"]
+    assert any(e["device"] == "dev0" and e["reason"] == "faults"
+               for e in quar)
+    rq = [e for e in ring if e["kind"] == "flush_requeued"]
+    assert rq and rq[0]["device"] == "dev0"
+    affected = set(rq[0]["request_ids"])
+    assert affected and affected <= set(chaos)
+    assert "graftfault" in rq[0]["error"]
+    assert any(e["kind"] == "device_restored" and e["device"] == "dev1"
+               for e in ring)
+
+    # Requeued-after-quarantine lineage: the trace shows BOTH flush
+    # memberships and attributes the request to the device that served it.
+    traces = {tr["id"]: tr for tr in sc.traces}
+    assert sorted(traces) == sorted(r[0] for r in recs)  # no drops
+    for rid in affected:
+        tr = traces[rid]
+        hops = [h["hop"] for h in tr["hops"]]
+        assert "requeue" in hops, (rid, hops)
+        fe = [h for h in tr["hops"] if h["hop"] == "flush.enter"]
+        assert len(fe) >= 2 and fe[0]["device"] == "dev0"
+        assert fe[-1]["device"] == "dev1"
+        assert hops[-1] == "respond" and tr["ok"]
+        assert tr["device"] == "dev1"  # served-by, not faulted-by
+        stamps = [h["t"] for h in tr["hops"]]
+        assert stamps == sorted(stamps)
+
+    # The postmortem artifact persists and renders.
+    from cpgisland_tpu.obs import report
+
+    path = sc.recorder.persist("test-shutdown")
+    assert path is not None
+    text = report.render_flight(path)
+    assert "flush_requeued" in text and "device_quarantined" in text
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("point,nth", [(p, n) for p, n, _a, _c in _KILL_PHASES])
+def test_flight_recorder_survives_sigkill_at_each_journal_phase(
+    tmp_path, point, nth
+):
+    """SIGKILL planted at each journal phase boundary: the flight artifact
+    is on disk BEFORE the kill propagates, names the kill site, and
+    carries the injection event (site + per-request tag attribution)."""
+    from cpgisland_tpu.obs import scope as scope_mod
+
+    params = presets.durbin_cpg8()
+    recs = _requests(seed=23, n=4)
+    mpath = str(tmp_path / "serve.journal.jsonl")
+    fpath = f"{mpath}.flight.json"
+    sess = Session(params, name="killvis", private_breaker=True)
+    broker = RequestBroker(
+        sess, BrokerConfig(flush_symbols=1 << 20, flush_deadline_s=0.0),
+        manifest_path=mpath, resume=False,
+    )
+    plan = FaultPlan([Fault(point, kind="kill", nth=nth)],
+                     name=f"kill@{point}")
+    sc = scope_mod.install(scope_mod.Scope(flight_path=fpath))
+    killed = False
+    try:
+        with faultplan.active(plan):
+            try:
+                for rid, nm, kind, syms in recs:
+                    broker.submit(request_id=rid, tenant="a", kind=kind,
+                                  symbols=syms, name=nm)
+                for _ in broker.drain():
+                    pass
+            except faultplan.SimulatedKill:
+                killed = True
+    finally:
+        scope_mod.uninstall(sc)
+    assert killed, "the kill plan never fired"
+    dump = json.load(open(fpath))
+    assert dump["reason"] == f"kill:{point}"
+    kills = [e for e in dump["events"] if e["kind"] == "kill"]
+    assert kills and kills[-1]["point"] == point
+    inj = [e for e in dump["events"] if e["kind"] == "graftfault_injected"]
+    assert inj and inj[-1]["fault_kind"] == "kill"
+    assert inj[-1]["point"] == point and inj[-1]["tag"]

@@ -1,0 +1,561 @@
+"""graftscope (PR 16): request-scoped serve telemetry.
+
+- metrics: the fixed-layout log-binned histogram — bin math, quantile
+  error bound, EXACT merge (associative integer bin adds) under 8
+  concurrent writers, wire roundtrip through JSON, layout rejection.
+- flight recorder: ring bounds, atomic persistence, kill-path artifact
+  (the SimulatedKill postmortem file is written BEFORE the kill
+  propagates — nothing downstream may catch it).
+- lineage: every request admitted into a mixed multi-tenant broker
+  stream ends with a closed trace whose hops are monotone in time and
+  cover admit -> journal.admit -> taken -> flush.enter -> executed ->
+  journal.complete -> respond, emitted as ONE request_trace event.
+- zero-overhead-off: the ledger proves a telemetry-off serve stream and
+  a telemetry-on one issue IDENTICAL device work (same dispatches, zero
+  fresh compiles) over same-shape streams.
+- wire: ``kind=stats`` answered inline (never queued) with the SLO
+  snapshot, on both the stdio stream and the socket mux.
+"""
+
+import io
+import json
+import math
+import os
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from cpgisland_tpu import obs, resilience
+from cpgisland_tpu.analysis import tracksync
+from cpgisland_tpu.models import presets
+from cpgisland_tpu.obs import scope as scope_mod
+from cpgisland_tpu.obs.metrics import (
+    LO,
+    N_BINS,
+    Histogram,
+    ServeMetrics,
+    bin_edges,
+    bin_index,
+)
+from cpgisland_tpu.resilience import faultplan
+from cpgisland_tpu.resilience.faultplan import Fault, FaultPlan
+from cpgisland_tpu.serve import BrokerConfig, RequestBroker, Session
+from cpgisland_tpu.serve import transport
+
+BASES = np.array(list("acgt"))
+
+
+@pytest.fixture(autouse=True)
+def _fresh_state():
+    resilience.reset()
+    assert scope_mod.active() is None, "a previous test leaked a Scope"
+    yield
+    scope_mod.uninstall()
+    resilience.reset()
+
+
+@pytest.fixture()
+def tracker():
+    # Exact-count lock assertions on a private tracker; under
+    # CPGISLAND_TRACKSYNC=1 the session-wide tracker owns the factories.
+    if tracksync.current() is not None:
+        pytest.skip("session-wide LockTracker active (CPGISLAND_TRACKSYNC=1)")
+    tr, uninstall = tracksync.install()
+    try:
+        yield tr
+    finally:
+        uninstall()
+
+
+def _gen_symbols(rng, n: int) -> np.ndarray:
+    bg = rng.choice(4, size=n, p=[0.3, 0.2, 0.2, 0.3])
+    k = max(1, n // 4)
+    bg[:k] = rng.choice(4, size=k, p=[0.1, 0.4, 0.4, 0.1])
+    return bg.astype(np.uint8)
+
+
+def _mixed_recs(n=12, seed=11):
+    """Mixed lengths, decode + posterior, two tenants."""
+    rng = np.random.default_rng(seed)
+    return [
+        (
+            i,
+            f"rec{i}",
+            "decode" if i % 3 != 1 else "posterior",
+            f"t{i % 2}",
+            _gen_symbols(rng, 400 + 97 * i),
+        )
+        for i in range(n)
+    ]
+
+
+# ---------------------------------------------------------------------------
+# Histograms
+
+
+def test_bin_index_layout_and_edges():
+    assert bin_index(0.0) == 0
+    assert bin_index(-5.0) == 0
+    assert bin_index(float("nan")) == 0
+    assert bin_index(LO) == 0
+    assert bin_index(1e99) == N_BINS - 1
+    for v in (1e-6, 3.7e-3, 0.25, 1.0, 512.0, 9.9e6):
+        i = bin_index(v)
+        lo, hi = bin_edges(i)
+        assert lo <= v < hi, (v, i, lo, hi)
+
+
+def test_histogram_quantile_within_bin_error_bound():
+    """Quarter-octave bins: any quantile's relative error is bounded by
+    the half-bin ratio 2**0.125 - 1 (~9.05%); min/max are exact."""
+    h = Histogram()
+    for i in range(1, 1000):  # 1..999 ms
+        h.observe(i * 1e-3)
+    s = h.snapshot()
+    assert s["count"] == 999
+    assert s["min"] == 1e-3 and s["max"] == 999e-3
+    assert abs(s["sum"] - sum(i * 1e-3 for i in range(1, 1000))) < 1e-9
+    for q, true in ((0.50, 0.500), (0.95, 0.950), (0.99, 0.990)):
+        est = h.quantile(q)
+        assert abs(est - true) / true < 0.095, (q, est)
+
+
+def test_histogram_merge_exact_and_associative_under_threads(tracker):
+    """8 concurrent writers into one shared histogram AND one private
+    histogram each: the shared result equals the merge of the privates
+    BIN-FOR-BIN (integer adds — exact), and merging in two different
+    association orders yields identical wire forms."""
+    N_THREADS, N_VALS = 8, 2000
+    shared = Histogram()
+    parts = [Histogram() for _ in range(N_THREADS)]
+    # Deterministic per-thread values spanning ~8 octaves.
+    vals = [
+        [1e-6 * (1.17 ** ((i * N_VALS + j) % 97)) for j in range(N_VALS)]
+        for i in range(N_THREADS)
+    ]
+    start = threading.Barrier(N_THREADS)
+
+    def worker(i):
+        start.wait()
+        for v in vals[i]:
+            shared.observe(v)
+            parts[i].observe(v)
+
+    ts = [threading.Thread(target=worker, args=(i,)) for i in range(N_THREADS)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+
+    total = N_THREADS * N_VALS
+    assert shared.count == total
+
+    left = Histogram()
+    for p in parts:  # left fold
+        left.merge(p)
+    right = Histogram()
+    for p in reversed(parts):  # different association order
+        right.merge(p)
+
+    for merged in (left, right):
+        mw, sw = merged.to_wire(), shared.to_wire()
+        assert mw["bins"] == sw["bins"]  # exact: integer bin adds
+        assert mw["count"] == sw["count"] == total
+        assert mw["min"] == sw["min"] and mw["max"] == sw["max"]
+        # Sums differ only by float addition order.
+        assert math.isclose(mw["sum"], sw["sum"], rel_tol=1e-9)
+    assert left.to_wire()["bins"] == right.to_wire()["bins"]
+
+
+def test_histogram_wire_roundtrip_through_json():
+    h = Histogram()
+    for v in (1e-4, 3e-4, 0.02, 0.02, 7.5):
+        h.observe(v)
+    back = Histogram.from_wire(json.loads(json.dumps(h.to_wire())))
+    assert back.snapshot() == h.snapshot()
+    assert back.to_wire() == h.to_wire()
+    # A wire histogram merges exactly like a local one.
+    acc = Histogram()
+    acc.merge(back)
+    acc.merge(back)
+    assert acc.count == 2 * h.count
+    # Layout drift is rejected, never silently misbinned.
+    bad = h.to_wire()
+    bad["layout"] = dict(bad["layout"], log2_growth=0.5)
+    with pytest.raises(ValueError, match="layout"):
+        Histogram.from_wire(bad)
+    # Empty histograms roundtrip too (min/max are None on the wire).
+    assert Histogram.from_wire(Histogram().to_wire()).snapshot()["count"] == 0
+
+
+def test_servemetrics_merge_and_wire_roundtrip():
+    a, b = ServeMetrics(), ServeMetrics()
+    a.note_result(tenant="t0", model="", device="dev0", n_symbols=100,
+                  latency_s=0.010)
+    b.note_result(tenant="t0", model="m1", device="dev1", n_symbols=50,
+                  latency_s=0.020)
+    b.note_flush(n_requests=2, symbols=150, wall_s=0.005)
+    a.merge(ServeMetrics.from_wire(json.loads(json.dumps(b.to_wire()))))
+    snap = a.snapshot()
+    assert snap["latency_s"]["count"] == 2
+    assert snap["flush_requests"]["count"] == 1
+    thr = snap["throughput"]
+    assert thr["tenant"]["t0"] == {"requests": 2, "symbols": 150}
+    assert thr["device"]["dev0"]["requests"] == 1
+    assert thr["device"]["dev1"]["requests"] == 1
+    assert thr["model"]["-"]["requests"] == 1  # unmodeled bucket
+    assert thr["model"]["m1"]["requests"] == 1
+
+
+# ---------------------------------------------------------------------------
+# Flight recorder
+
+
+def test_flight_recorder_ring_bounds_and_atomic_persist(tmp_path):
+    cap = 32
+    path = str(tmp_path / "serve.flight.json")
+    rec = scope_mod.FlightRecorder(capacity=cap, path=path)
+    for i in range(3 * cap):
+        rec.record("tick", n=i)
+    st = rec.stats()
+    assert st["events"] == cap and st["seen"] == 3 * cap
+    ring = rec.snapshot()
+    assert len(ring) == cap
+    assert [e["n"] for e in ring] == list(range(2 * cap, 3 * cap))  # last N
+    assert rec.persist("unit") == path
+    dump = json.load(open(path))
+    assert dump["reason"] == "unit" and dump["pid"] == os.getpid()
+    assert dump["events_seen"] == 3 * cap and dump["capacity"] == cap
+    assert [e["n"] for e in dump["events"]] == list(range(2 * cap, 3 * cap))
+    # No tmp litter (tmp + fsync + os.replace).
+    assert os.listdir(tmp_path) == ["serve.flight.json"]
+    # Pathless recorders are inert, and an unwritable path is best-effort.
+    assert scope_mod.FlightRecorder(capacity=4).persist("x") is None
+    assert rec.persist("x", path=str(tmp_path / "no/such/dir/f.json")) is None
+
+
+def test_scope_kill_persists_flight_artifact_before_raise(tmp_path):
+    """graftfault SimulatedKill at flush.enter: the postmortem artifact is
+    written BEFORE the kill propagates (nothing between the injection
+    point and the harness may catch it), and it names the kill site."""
+    params = presets.durbin_cpg8()
+    sess = Session(params, name="killscope", private_breaker=True)
+    broker = RequestBroker(
+        sess, BrokerConfig(flush_symbols=1 << 20, flush_deadline_s=0.0)
+    )
+    fpath = str(tmp_path / "serve.flight.json")
+    sc = scope_mod.install(scope_mod.Scope(flight_path=fpath))
+    plan = FaultPlan([Fault("flush.enter", kind="kill", nth=1)],
+                     name="kill-mid-flush")
+    rng = np.random.default_rng(5)
+    killed = False
+    try:
+        with faultplan.active(plan):
+            try:
+                for rid in range(3):
+                    broker.submit(request_id=rid, tenant="a", kind="decode",
+                                  symbols=_gen_symbols(rng, 500 + 70 * rid),
+                                  name=f"r{rid}")
+                for _ in broker.drain():
+                    pass
+            except faultplan.SimulatedKill:
+                killed = True
+    finally:
+        scope_mod.uninstall(sc)
+    assert killed, "the kill plan never fired"
+    dump = json.load(open(fpath))
+    assert dump["reason"] == "kill:flush.enter"
+    kinds = [e["kind"] for e in dump["events"]]
+    assert kinds[-1] == "kill"
+    assert dump["events"][-1]["point"] == "flush.enter"
+    inj = [e for e in dump["events"] if e["kind"] == "graftfault_injected"]
+    assert inj and inj[-1]["fault_kind"] == "kill"
+    assert inj[-1]["plan"] == "kill-mid-flush"
+
+
+# ---------------------------------------------------------------------------
+# Lineage completeness
+
+
+def test_lineage_complete_over_mixed_multi_tenant_stream(tmp_path):
+    """Every request admitted into a mixed multi-tenant journaled stream
+    ends with exactly one closed trace: hops monotone in time, first hop
+    admit, last respond, journal/queue/flush stations all present; one
+    request_trace event per request lands in the metrics stream; the SLO
+    rollup covers the whole stream exactly."""
+    params = presets.durbin_cpg8()
+    sess = Session(params, name="lineage", private_breaker=True)
+    broker = RequestBroker(
+        sess, BrokerConfig(flush_symbols=3000, flush_deadline_s=0.0),
+        manifest_path=str(tmp_path / "j.jsonl"),
+    )
+    recs = _mixed_recs(12)
+    sc = scope_mod.install(scope_mod.Scope())
+    try:
+        with obs.observe() as ob:
+            for rid, nm, kind, ten, syms in recs:
+                broker.submit(request_id=rid, tenant=ten, kind=kind,
+                              symbols=syms, name=nm)
+            results = {r.id: r for r in broker.drain()}
+    finally:
+        scope_mod.uninstall(sc)
+    broker.close()
+    assert all(r.ok for r in results.values())
+    assert broker.flushes >= 2  # the stream really coalesced into flushes
+
+    snap = sc.snapshot()
+    assert snap["open_requests"] == 0
+    assert snap["completed_requests"] == len(recs)
+    assert snap["dropped_traces"] == 0
+    traces = {tr["id"]: tr for tr in sc.traces}
+    assert sorted(traces) == [rid for rid, *_ in recs]
+    for rid, nm, kind, ten, syms in recs:
+        tr = traces[rid]
+        hops = [h["hop"] for h in tr["hops"]]
+        assert hops[0] == "admit" and hops[-1] == "respond", hops
+        for must in ("journal.admit", "taken", "flush.enter", "executed",
+                     "journal.complete"):
+            assert must in hops, (rid, hops)
+        assert hops.count("flush.enter") == 1  # no requeues here
+        stamps = [h["t"] for h in tr["hops"]]
+        assert stamps == sorted(stamps)  # append order IS timestamp order
+        assert tr["tenant"] == ten and tr["kind"] == kind
+        assert tr["n_symbols"] == syms.size
+        assert tr["ok"] and tr["route"]
+        assert tr["latency_s"] > 0.0
+        # flush membership is consistent between the two flush hops.
+        fe = next(h for h in tr["hops"] if h["hop"] == "flush.enter")
+        ex = next(h for h in tr["hops"] if h["hop"] == "executed")
+        assert fe["flush"] == ex["flush"]
+
+    # Exactly ONE request_trace event per request reached the obs stream.
+    evs = [e for e in ob.events if e["event"] == "request_trace"]
+    assert sorted(e["id"] for e in evs) == sorted(traces)
+    assert all(e["hops"] for e in evs)
+
+    # SLO rollup: exact stream coverage.
+    m = sc.metrics.snapshot()
+    assert m["latency_s"]["count"] == len(recs)
+    assert m["flush_requests"]["count"] == broker.flushes
+    total = sum(s.size for *_, s in recs)
+    thr = m["throughput"]
+    assert set(thr["tenant"]) == {"t0", "t1"}
+    assert sum(v["symbols"] for v in thr["tenant"].values()) == total
+    assert sum(v["requests"] for v in thr["tenant"].values()) == len(recs)
+
+    # The report renderer walks these traces (smoke: every id shows up).
+    from cpgisland_tpu.obs import report
+
+    text = report.render_lineage(sc.traces)
+    for rid, *_ in recs:
+        assert f"request {rid} " in text
+    assert "flush composition:" in text
+    assert "request 999: no trace in this stream" in report.render_lineage(
+        sc.traces, 999
+    )
+
+
+def test_telemetry_off_serve_path_is_dispatch_identical():
+    """The acceptance gate: with telemetry OFF the serve path must issue
+    ZERO additional blocking dispatches or compiles versus telemetry ON —
+    ledger-asserted over same-shape streams (warm first, then compare)."""
+    params = presets.durbin_cpg8()
+    sess = Session(params, name="zcost", private_breaker=True)
+    broker = RequestBroker(
+        sess, BrokerConfig(flush_symbols=4000, flush_deadline_s=0.0)
+    )
+    rng = np.random.default_rng(2)
+    streams = [_gen_symbols(rng, 500 + 37 * i) for i in range(6)]
+
+    def run(base):
+        with obs.observe() as ob:
+            for i, s in enumerate(streams):
+                broker.submit(request_id=base + i, tenant="a", kind="decode",
+                              symbols=s, name=f"r{i}")
+            res = broker.drain()
+        assert all(r.ok for r in res) and len(res) == len(streams)
+        return ob.ledger.totals()
+
+    run(0)  # warm: compiles happen here
+    assert not scope_mod.enabled()
+    off = run(100)  # telemetry OFF
+    sc = scope_mod.install(scope_mod.Scope())
+    try:
+        on = run(200)  # telemetry ON, same geometries
+    finally:
+        scope_mod.uninstall(sc)
+    assert off["compiles"] == 0 and on["compiles"] == 0
+    assert on["dispatches"] == off["dispatches"]
+    assert on["upload_bytes"] == off["upload_bytes"]
+    # ... and the ON run really captured the stream.
+    assert sc.snapshot()["completed_requests"] == len(streams)
+    broker.close()
+
+
+# ---------------------------------------------------------------------------
+# kind=stats wire
+
+
+def test_stats_wire_request_answers_inline_with_slo():
+    params = presets.durbin_cpg8()
+    sess = Session(params, name="statw", private_breaker=True)
+    broker = RequestBroker(
+        sess, BrokerConfig(flush_symbols=100, flush_deadline_s=0.0)
+    )
+    rng = np.random.default_rng(3)
+    syms = _gen_symbols(rng, 700)
+    lines = [
+        json.dumps({"id": 1, "kind": "decode",
+                    "seq": "".join(BASES[syms]), "tenant": "t0"}),
+        json.dumps({"id": 2, "kind": "stats"}),
+        json.dumps({"op": "shutdown"}),
+    ]
+    sc = scope_mod.install(scope_mod.Scope())
+    try:
+        out = io.StringIO()
+        served = transport.serve_stream(
+            io.StringIO("\n".join(lines) + "\n"), out, broker,
+            use_worker=False,
+        )
+    finally:
+        scope_mod.uninstall(sc)
+    resp = {o.get("id"): o for o in map(json.loads,
+                                        out.getvalue().splitlines())}
+    assert resp[1]["ok"]
+    st = resp[2]
+    assert st["ok"] and st["kind"] == "stats"
+    # The decode flushed before the stats line was read (tiny budget,
+    # inline worker): the SLO snapshot already covers it.
+    lat = st["slo"]["metrics"]["latency_s"]
+    assert lat["count"] == 1 and lat["p50"] > 0.0
+    assert st["slo"]["open_requests"] == 0
+    assert st["slo"]["metrics"]["throughput"]["tenant"]["t0"]["requests"] == 1
+    assert st["stats"]["flushes"] >= 1
+    # A stats poll never enters the flush queue — it is not "served".
+    assert served == 1
+    # The whole response is JSON-clean by construction (it round-tripped
+    # through the StringIO wire above); scope-off answers slo=None.
+    off = transport._stats_wire({"id": 9}, broker)
+    assert off["slo"] is None and off["ok"] and off["id"] == 9
+
+
+@pytest.mark.slow
+def test_mux_stream_lineage_and_stats_roundtrip(tmp_path):
+    """Socket mux: a mixed multi-tenant stream over one connection closes
+    every trace; a second connection's kind=stats poll sees the rollup
+    plus mux routing stats."""
+    from cpgisland_tpu.serve.transport import serve_socket
+
+    params = presets.durbin_cpg8()
+    sess = Session(params, name="muxscope", private_breaker=True)
+    broker = RequestBroker(
+        sess, BrokerConfig(flush_symbols=2500, flush_deadline_s=0.05)
+    )
+    sock_path = str(tmp_path / "s.sock")
+    recs = _mixed_recs(6, seed=19)
+    requests = [
+        {"id": 100 + rid, "kind": kind, "seq": "".join(BASES[syms]),
+         "name": nm, "tenant": ten}
+        for rid, nm, kind, ten, syms in recs
+    ]
+
+    def client(reqs):
+        s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        s.connect(sock_path)
+        rf = s.makefile("r", encoding="utf-8")
+        wf = s.makefile("w", encoding="utf-8")
+        want = set()
+        for req in reqs:
+            wf.write(json.dumps(req) + "\n")
+            want.add(req["id"])
+        wf.flush()
+        got = {}
+        for line in rf:
+            o = json.loads(line)
+            if o.get("id") in want:
+                got[o["id"]] = o
+            if set(got) == want:
+                break
+        s.close()
+        return got
+
+    sc = scope_mod.install(scope_mod.Scope())
+    try:
+        server = threading.Thread(target=serve_socket,
+                                  args=(sock_path, broker), daemon=True)
+        server.start()
+        deadline = time.monotonic() + 30.0
+        while not os.path.exists(sock_path):
+            assert time.monotonic() < deadline
+            time.sleep(0.01)
+        while True:
+            try:
+                probe = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+                probe.connect(sock_path)
+                probe.close()
+                break
+            except OSError:
+                assert time.monotonic() < deadline
+                time.sleep(0.05)
+        responses = client(requests)
+        st = client([{"id": 999, "kind": "stats"}])[999]
+        s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        s.connect(sock_path)
+        s.sendall(b'{"op": "shutdown"}\n')
+        s.close()
+        server.join(timeout=60.0)
+        assert not server.is_alive()
+    finally:
+        scope_mod.uninstall(sc)
+
+    assert all(r["ok"] for r in responses.values())
+    traces = {tr["id"]: tr for tr in sc.traces}
+    assert sorted(traces) == sorted(r["id"] for r in requests)
+    for req in requests:
+        tr = traces[req["id"]]
+        hops = [h["hop"] for h in tr["hops"]]
+        assert hops[0] == "admit" and hops[-1] == "respond"
+        assert "taken" in hops and "flush.enter" in hops
+        stamps = [h["t"] for h in tr["hops"]]
+        assert stamps == sorted(stamps)
+        assert tr["tenant"] == req["tenant"]
+    assert st["ok"] and st["kind"] == "stats"
+    assert st["slo"]["metrics"]["latency_s"]["count"] == len(requests)
+    assert set(st["slo"]["metrics"]["throughput"]["tenant"]) == {"t0", "t1"}
+    assert "mux" in st  # the router's routing stats ride along
+
+
+# ---------------------------------------------------------------------------
+# Snapshot emitter (--metrics-interval)
+
+
+def test_snapshot_emitter_emits_slo_records_and_stops():
+    sc = scope_mod.Scope()
+    sc.metrics.note_result(tenant="a", model="", device="dev0",
+                           n_symbols=10, latency_s=0.001)
+    seen = []
+    em = scope_mod.SnapshotEmitter(
+        sc, interval_s=3600.0, extra_fn=lambda: {"stats": {
+            "queued_requests": 7}})
+    with obs.observe() as ob:
+        em.emit_once()  # deterministic: no timer dependence
+    em.stop()  # never started: stop() is a no-op join
+    seen = [e for e in ob.events if e["event"] == "slo_snapshot"]
+    assert len(seen) == 1
+    assert seen[0]["slo"]["latency_s"]["count"] == 1
+    assert seen[0]["stats"]["queued_requests"] == 7
+    ring = sc.recorder.snapshot()
+    assert ring[-1]["kind"] == "snapshot"
+    assert ring[-1]["requests"] == 1 and ring[-1]["queued_requests"] == 7
+
+    # The threaded path: a short interval emits at least once, then joins.
+    em2 = scope_mod.SnapshotEmitter(sc, interval_s=0.01).start()
+    deadline = time.monotonic() + 10.0
+    while sc.recorder.stats()["seen"] < 3:
+        assert time.monotonic() < deadline
+        time.sleep(0.01)
+    em2.stop()
+    assert em2._thread is None

@@ -469,6 +469,54 @@ def test_ledger_counters_exact_under_threads():
     assert tot["compiles"] == N_THREADS * (N_ITER // 100)
 
 
+def test_ledger_device_attribution_exact_under_threads():
+    """Fleet attribution (graftscope): 8 concurrent writers, half tagged
+    dev0 and half dev1 via ledger.device_scope — the per-device partition
+    and the global totals must BOTH stay exact (the device maps are bumped
+    under the same ledger lock; untagged legacy callers land in neither
+    partition but always in the globals)."""
+    from cpgisland_tpu.obs import ledger as ledger_mod
+    from cpgisland_tpu.obs.ledger import Ledger
+
+    led = Ledger()
+    N_THREADS, N_ITER = 8, 2000
+    start = threading.Barrier(N_THREADS)
+
+    def worker(i):
+        label = f"dev{i % 2}"
+        start.wait()
+        with ledger_mod.device_scope(label):
+            assert ledger_mod.current_device() == label
+            for _ in range(N_ITER):
+                led.count_dispatch()
+                led.count_fetch(3)
+                led.count_upload(5)
+        assert ledger_mod.current_device() == ""  # scope restored
+        for _ in range(N_ITER):
+            led.count_dispatch()  # untagged tail: globals only
+
+    ts = [threading.Thread(target=worker, args=(i,)) for i in range(N_THREADS)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    tot = led.totals()
+    per = N_ITER * N_THREADS
+    assert tot["dispatches"] == 4 * per  # 3 tagged + 1 untagged per iter
+    assert tot["fetch_bytes"] == 3 * per
+    assert tot["upload_bytes"] == 5 * per
+    dev = led.device_totals()
+    assert set(dev) == {"dev0", "dev1"}
+    for label in ("dev0", "dev1"):
+        d = dev[label]
+        half = N_ITER * (N_THREADS // 2)
+        assert d["dispatches"] == 3 * half
+        assert d["fetch_bytes"] == 3 * half
+        assert d["upload_bytes"] == 5 * half
+    # The tagged partition sums to exactly the tagged share of the globals.
+    assert sum(d["dispatches"] for d in dev.values()) == 3 * per
+
+
 def test_observer_events_exact_under_threads():
     """The Observer event-state fix: serve's transport threads emit
     rejection events while the worker loop emits serve_flush — deduped

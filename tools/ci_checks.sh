@@ -141,6 +141,17 @@ echo "== graftsync slice: rule fixtures, tracker, threaded serve-mux stress =="
 python -m pytest tests/test_graftsync.py tests/test_graftsync_self.py \
   tests/test_serve_mux.py -q
 
+echo "== graftscope slice (lineage, SLO histograms, flight recorder, stats wire) =="
+# PR 16: request-scoped serve telemetry.  Trace lineage closes every
+# admitted request across broker/journal/queue/flush stations (stdio AND
+# socket mux), the log-binned histograms merge exactly under 8 concurrent
+# writers, the flight recorder's ring stays bounded and its postmortem
+# artifact survives a SimulatedKill (persisted BEFORE the kill
+# propagates), kind=stats answers inline with the SLO snapshot, and the
+# ledger proves the telemetry-off serve path issues IDENTICAL device
+# work to telemetry-on (the zero-overhead-off acceptance gate).
+python -m pytest tests/test_graftscope.py -q
+
 echo "== graftfault chaos slice (seeded plan matrix on the virtual mesh) =="
 # r15: every fleet failover path driven by deterministic fault plans —
 # device fault past the retry budget mid-flush (quarantine -> requeue ->

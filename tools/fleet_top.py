@@ -1,0 +1,152 @@
+"""fleet_top: render a serve daemon's fleet health + SLO state from its
+metrics JSONL.
+
+    python tools/fleet_top.py metrics.jsonl            # one-shot render
+    python tools/fleet_top.py metrics.jsonl --watch 2  # re-render every 2 s
+
+Input is the JSONL the daemon writes under ``--metrics`` (with
+``--metrics-interval`` supplying periodic ``slo_snapshot`` records).  The
+renderer shows, per device: the health-state timeline reconstructed from
+``device_quarantined`` / ``device_restored`` transitions, requeues off the
+device, and its tagged throughput share — followed by the latest queue
+depth / backpressure / latency percentiles from the newest snapshot.  Pure
+file reading: no live process, no sockets (use ``kind=stats`` on the wire
+for a live probe).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def collect(path: str) -> dict:
+    devices: dict = {}
+    snapshot = None
+    t0 = None
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue  # clipped tail line
+            ev = rec.get("event")
+            ts = rec.get("ts")
+            if t0 is None and ts is not None:
+                t0 = ts
+            if ev in ("device_quarantined", "device_restored"):
+                dev = devices.setdefault(
+                    rec.get("device", "?"), {"timeline": [], "requeues": 0}
+                )
+                state = (
+                    "QUARANTINED" if ev == "device_quarantined" else "HEALTHY"
+                )
+                dev["timeline"].append({
+                    "t": (ts - t0) if (ts is not None and t0 is not None) else None,
+                    "state": state,
+                    "reason": rec.get("reason"),
+                    "cooldown_s": rec.get("cooldown_s"),
+                })
+            elif ev == "flush_requeued":
+                dev = devices.setdefault(
+                    rec.get("device", "?"), {"timeline": [], "requeues": 0}
+                )
+                dev["requeues"] += 1
+            elif ev == "slo_snapshot":
+                snapshot = rec  # last one wins
+    return {"devices": devices, "snapshot": snapshot, "t0": t0}
+
+
+def render(state: dict) -> str:
+    lines = []
+    snap = state["snapshot"]
+    devices = dict(state["devices"])
+    # Fold per-device throughput + fleet health from the newest snapshot.
+    fleet = (snap or {}).get("fleet") or {}
+    for label, dstat in (fleet.get("devices") or {}).items():
+        devices.setdefault(label, {"timeline": [], "requeues": 0})[
+            "health"] = dstat
+    thr = (((snap or {}).get("slo") or {}).get("throughput") or {})
+    for label, share in (thr.get("device") or {}).items():
+        if label == "-":
+            continue
+        devices.setdefault(label, {"timeline": [], "requeues": 0})[
+            "throughput"] = share
+    if devices:
+        lines.append("devices:")
+        for label in sorted(devices):
+            d = devices[label]
+            h = d.get("health") or {}
+            cur = h.get("state", "healthy" if not d["timeline"]
+                        else d["timeline"][-1]["state"].lower())
+            tp = d.get("throughput") or {}
+            lines.append(
+                f"  {label:<8} {cur:<12} quarantines={h.get('quarantines', 0)} "
+                f"restores={h.get('restores', 0)} requeues_off={d['requeues']} "
+                f"served={tp.get('requests', 0)} req / {tp.get('symbols', 0)} sym"
+            )
+            for tr in d["timeline"]:
+                at = "" if tr["t"] is None else f"+{tr['t']:.1f}s "
+                why = f" ({tr['reason']})" if tr.get("reason") else ""
+                lines.append(f"    {at}-> {tr['state']}{why}")
+    else:
+        lines.append("devices: none seen (single-worker daemon, or no "
+                     "health transitions yet)")
+    if snap is not None:
+        stats = snap.get("stats") or {}
+        slo = snap.get("slo") or {}
+        lat = slo.get("latency_s") or {}
+        lines.append("")
+        lines.append(
+            f"queue: {stats.get('queued_requests', '?')} request(s) / "
+            f"{stats.get('queued_symbols', '?')} symbol(s) queued, "
+            f"backpressure={stats.get('backpressure', '?')}, "
+            f"flushes={stats.get('flushes', '?')}"
+        )
+        if lat.get("count"):
+            lines.append(
+                f"latency: n={lat['count']} p50={1e3 * lat['p50']:.2f} ms "
+                f"p95={1e3 * lat['p95']:.2f} ms p99={1e3 * lat['p99']:.2f} ms "
+                f"max={1e3 * lat['max']:.2f} ms"
+            )
+        pend = fleet.get("pending_requeued")
+        if pend is not None:
+            lines.append(
+                f"fleet: requeues={fleet.get('requeues', 0)} "
+                f"failed_over={fleet.get('failed_over', 0)} "
+                f"pending_requeued={pend}"
+            )
+    else:
+        lines.append("")
+        lines.append("no slo_snapshot yet (run the daemon with "
+                     "--metrics-interval to emit them)")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("metrics_jsonl",
+                    help="the daemon's --metrics JSONL file")
+    ap.add_argument(
+        "--watch", type=float, default=0.0, metavar="SECONDS",
+        help="re-render every SECONDS (0 = render once and exit)",
+    )
+    args = ap.parse_args(argv)
+    while True:
+        print(render(collect(args.metrics_jsonl)))
+        if args.watch <= 0:
+            return 0
+        time.sleep(args.watch)
+        print("\n" + "=" * 72 + "\n")
+
+
+if __name__ == "__main__":
+    sys.exit(main())

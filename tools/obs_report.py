@@ -23,9 +23,36 @@ from cpgisland_tpu.obs import report  # noqa: E402
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
-    ap.add_argument("metrics_jsonl", help="JSONL written by --metrics / --metrics-out")
+    ap.add_argument(
+        "metrics_jsonl", nargs="?", default=None,
+        help="JSONL written by --metrics / --metrics-out (optional when "
+        "only rendering a --flight dump — after a crash the flight "
+        "artifact may be all that survived)",
+    )
+    ap.add_argument(
+        "--request", type=int, default=None, metavar="ID",
+        help="render only request ID's graftscope lineage (hop table)",
+    )
+    ap.add_argument(
+        "--flight", metavar="PATH",
+        help="also render a flight-recorder dump (*.flight.json) as an "
+        "event timeline",
+    )
     args = ap.parse_args(argv)
-    print(report.render_file(args.metrics_jsonl))
+    if args.metrics_jsonl is None and not args.flight:
+        ap.error("need a metrics JSONL and/or --flight PATH")
+    if args.metrics_jsonl is None:
+        pass
+    elif args.request is not None:
+        summary = report.summarize_jsonl(args.metrics_jsonl)
+        print(report.render_lineage(
+            summary.get("request_traces") or [], args.request
+        ))
+    else:
+        print(report.render_file(args.metrics_jsonl))
+    if args.flight:
+        print()
+        print(report.render_flight(args.flight))
     return 0
 
 
