@@ -1879,6 +1879,31 @@ def _tuning_census(results: dict) -> dict:
     }
 
 
+def _pass_structure_census(results: dict) -> dict:
+    """Per-path pass-structure row for the extras line: ``one_pass_armed``
+    = the graftune winner the routers consult (False unless a fresh chip
+    sweep flipped it — the ISSUE 17 shipped default), ``pass_structure``
+    = the EXPECTED_PASSES pin of that arm (1 for the matrix-carried
+    one-pass kernel, 2 for the r9 fused fwd/bwd + products)."""
+    from cpgisland_tpu.analysis.cost_contracts import EXPECTED_PASSES
+    from cpgisland_tpu.tune import table as tune_table
+
+    platform = (
+        results.get("parity", {}).get("parity", {}).get("backend", "cpu")
+    )
+    out = {}
+    for path, tag in (
+        ("posterior", "posterior.onehot"), ("em_seq", "em.seq.onehot")
+    ):
+        d = tune_table.lookup(f"one_pass.{path}", platform=platform)
+        armed = bool(d.value) if (d.fresh and d.value in (True, False)) else False
+        out[f"{path}_one_pass_armed"] = armed
+        out[f"{path}_pass_structure"] = EXPECTED_PASSES[
+            f"{tag}.onepass" if armed else tag
+        ]
+    return out
+
+
 def _orchestrate(args) -> int:
     """--extended parent: run each capture phase in a FRESH process.
 
@@ -2044,6 +2069,12 @@ def _orchestrate(args) -> int:
         # self-invalidation working as designed; re-sweep with
         # tools/graftune.py --all before trusting stale-knob figures).
         "tuning_table_fresh": _tuning_census(results),
+        # ISSUE 17 observability: which FB arm the posterior/em-seq phases
+        # were ARMED with on the capture platform (host-side graftune
+        # consult, same fallback rule as the routers) and the pinned
+        # T-scaling pass count of that arm — the artifact records which
+        # pass structure produced the numbers.
+        **_pass_structure_census(results),
     }
     log("extended: " + json.dumps(extras))
     _print_northstar(decode_tput, em_tput)

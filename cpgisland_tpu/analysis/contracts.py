@@ -427,7 +427,7 @@ def _decode_batch_flat_contract(return_score: bool = False) -> Contract:
     )
 
 
-def _posterior_contract(onehot: bool, **kw) -> Contract:
+def _posterior_contract(onehot: bool, one_pass: bool = False, **kw) -> Contract:
     def make(scale: int = 1):
         import jax.numpy as jnp
         import numpy as np
@@ -438,11 +438,14 @@ def _posterior_contract(onehot: bool, **kw) -> Contract:
         o1, o2 = _obs_pair(4096 * scale, "uint8")
         mask = jnp.asarray((np.arange(8) < 4).astype(np.float32))
         fn = lambda o: fb_pallas._seq_posterior_core(
-            params, o, o.shape[0], mask, 512, 256, axis=None, onehot=onehot
+            params, o, o.shape[0], mask, 512, 256, axis=None, onehot=onehot,
+            one_pass=one_pass,
         )[0]
         return fn, (o1,), (o2,)
 
     tag = "onehot" if onehot else "dense"
+    if one_pass:
+        tag += ".onepass"
     return Contract(
         name=f"posterior.{tag}", make=make, base_symbols=4096,
         cost_scales=(16, 32), **kw
@@ -471,18 +474,21 @@ def _em_chunked_contract(engine: str, **kw) -> Contract:
     )
 
 
-def _em_seq_contract(onehot: bool, **kw) -> Contract:
+def _em_seq_contract(onehot: bool, one_pass: bool = False, **kw) -> Contract:
     def make(scale: int = 1):
         from cpgisland_tpu.ops import fb_pallas
 
         params = _flagship()
         o1, o2 = _obs_pair(8192 * scale, "uint8")
         fn = lambda o: fb_pallas.seq_stats_pallas(
-            params, o, o.shape[0], lane_T=512, t_tile=256, onehot=onehot
+            params, o, o.shape[0], lane_T=512, t_tile=256, onehot=onehot,
+            one_pass=one_pass,
         )
         return fn, (o1,), (o2,)
 
     tag = "onehot" if onehot else "dense"
+    if one_pass:
+        tag += ".onepass"
     return Contract(
         name=f"em.seq.{tag}", make=make, base_symbols=8192,
         cost_scales=(16, 32), **kw
@@ -708,9 +714,14 @@ def default_contracts() -> list[Contract]:
         _posterior_contract(False, allow_pallas_off_tpu=True,
                             expect_pallas_on_tpu=True),
         _posterior_contract(True, expect_pallas_on_tpu=True),
+        # The true-one-pass matrix arm (ISSUE 17): the products pass folded
+        # into the co-scheduled launch — ONE T-scaling pass, pinned in
+        # EXPECTED_PASSES next to the retained 2-pass entries above.
+        _posterior_contract(True, one_pass=True, expect_pallas_on_tpu=True),
         _em_chunked_contract("xla", stability=True),
         _em_chunked_contract("onehot", expect_pallas_on_tpu=True),
         _em_seq_contract(True, expect_pallas_on_tpu=True),
+        _em_seq_contract(True, one_pass=True, expect_pallas_on_tpu=True),
         _mstep_contract(),
         # Model-family entries: the order-2 dinucleotide member through the
         # reduced decode engine + its dense FB route, and the comparison

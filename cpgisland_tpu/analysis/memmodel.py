@@ -367,6 +367,29 @@ def _k_fb_fwdbwd_onehot(k: Knobs) -> list:
     return _oh_chain_bufs(k, fused=True)
 
 
+def _k_fb_fwdbwdmat_onehot(k: Knobs) -> list:
+    """The true-one-pass matrix-carried co-scheduled kernel
+    (fb_onehot._oh_fwdbwd_mat_kernel): both directions carry the [2,2]
+    transfer-matrix form — 4 rows per direction per member instead of 2 —
+    and stream [t_tile, 4*M, lane_tile] matrix blocks both ways, which is
+    what buys folding the products pass in.  The doubled out-streams are
+    exactly the VMEM trade: M=1 fits at every shipped tile; M=3 stacked
+    does NOT at the production 256-lane reduced tile (max_stacked_m pins
+    the verdict at 1 there, so stacked stays on the 2-pass arm —
+    deliberately NOT in STACKED_KERNELS/STACKED_ENVELOPE)."""
+    M = k.stacked_m
+    return [
+        Buffer("pair", (k.t_tile, k.lane_tile)),
+        Buffer("pair_next", (k.t_tile, k.lane_tile)),
+        Buffer("lens", (1, k.lane_tile), kind="resident"),
+        Buffer("tab", (4 * M * _pair_rows(k.n_symbols), k.lane_tile),
+               kind="resident"),
+        Buffer("va_out", (k.t_tile, 4 * M, k.lane_tile), kind="out"),
+        Buffer("wb_out", (k.t_tile, 4 * M, k.lane_tile), kind="out"),
+        Buffer("mat_carry", (2 * 4 * M, k.lane_tile), kind="scratch"),
+    ]
+
+
 def _k_fb_conf_onehot(k: Knobs) -> list:
     return _oh_chain_bufs(k, fused=False) + [
         Buffer("cs_next", (k.t_tile, k.lane_tile)),
@@ -468,6 +491,7 @@ _BUILDERS: dict = {
     "fb.stats.dense": _k_fb_stats_dense,
     "fb.fwd.onehot": _k_fb_fwd_onehot,
     "fb.fwdbwd.onehot": _k_fb_fwdbwd_onehot,
+    "fb.fwdbwdmat.onehot": _k_fb_fwdbwdmat_onehot,
     "fb.conf.onehot": _k_fb_conf_onehot,
     "fb.stats.onehot": _k_fb_stats_onehot,
     "fb.seqstats.onehot": _k_fb_seqstats_onehot,

@@ -344,6 +344,132 @@ def _oh_fwdbwd_kernel(pair_ref, pairn_ref, lens_ref, a0raw_ref, beta0_ref,
     bcarry[1:2, :] = bn1
 
 
+def _oh_fwdbwd_mat_kernel(pair_ref, pairn_ref, lens_ref, tab_ref,
+                          va_ref, wb_ref, fcarry, bcarry, *, nreal, Tt, T):
+    """TRUE one-pass co-scheduled chains: ENTRY-FREE matrix carries.
+
+    The reduced 2-state chains are LINEAR in their entry direction, so
+    instead of seeding a0/beta0 (which the products pass had to compute
+    first), this kernel carries the [2,2] transfer-matrix form of each
+    chain — 4 carry rows per direction instead of 2 — seeded IDENTITY.
+    The stored streams are then per-lane operators:
+
+      Va[t] = M_1 . M_2 ... M_t   (renormalized by its own running sum —
+                                   deferred, like the vector forward; the
+                                   within-lane t == 0 row stores I, since
+                                   M_0 belongs to the entry direction v_0)
+      Wb[t] = M_{t+1} ... M_{l-1} (self-normalized like the r9 fused
+                                   backward; the last valid row stores I)
+
+    so alphas2[t] = a0_red^T . Va[t] and betas2[t] = Wb[t] . beta0_red are
+    recovered by an ELEMENTWISE epilogue contraction once the boundary
+    messages exist — and the per-lane transfer total itself is
+    M_0 . Va[last], which replaces the standalone products pass: the r7
+    reduced [NL, 2, 2] boundary combine becomes an O(NL) epilogue of THIS
+    kernel's outputs, and posterior/em-seq drop to ONE T-scaling pass.
+
+    The trade (ISSUE 17): 4 carry rows, 32 B/sym of stored stream instead
+    of 16, wider VMEM footprint (graftmem family ``fb.fwdbwdmat.onehot``)
+    — only decidable on silicon, so the 2-pass arm stays routable
+    (``one_pass`` static arg everywhere).  Scale contract: Va rows are
+    renormalized by the MATRIX total (sum of 4 entries), not the vector
+    sum — contracted alphas2 carry a different (still deferred) scale
+    than the 2-pass stream, exact for every scale-free consumer and for
+    the telescoped loglik (fb_pallas._seq_stats_core one-pass arm), and
+    NOT a Rabiner cs source.  XLA twin: :func:`_xla_fwdbwd_mat_onehot`.
+    """
+    j = pl.program_id(1)
+    n_t = pl.num_programs(1)
+    lt = pair_ref.shape[1]
+    lens = lens_ref[0, :]
+    one = jnp.ones((1, lt), jnp.float32)
+    zero = jnp.zeros((1, lt), jnp.float32)
+    v00 = jnp.where(j == 0, one, fcarry[0:1, :])
+    v01 = jnp.where(j == 0, zero, fcarry[1:2, :])
+    v10 = jnp.where(j == 0, zero, fcarry[2:3, :])
+    v11 = jnp.where(j == 0, one, fcarry[3:4, :])
+    w00 = jnp.where(j == 0, one, bcarry[0:1, :])
+    w01 = jnp.where(j == 0, zero, bcarry[1:2, :])
+    w10 = jnp.where(j == 0, zero, bcarry[2:3, :])
+    w11 = jnp.where(j == 0, one, bcarry[3:4, :])
+    bt0 = (n_t - 1 - j) * Tt  # global base of this cell's backward tile
+
+    def body(tile_i, carry):
+        v00, v01, v10, v11, w00, w01, w10, w11 = carry
+        fbase = tile_i * ROW_TILE
+        bbase = (Tt // ROW_TILE - 1 - tile_i) * ROW_TILE
+        ftile = pair_ref[pl.ds(fbase, ROW_TILE), :]
+        btile = pairn_ref[pl.ds(bbase, ROW_TILE), :]
+        f00, f01, f10, f11 = _select4_prob(ftile, tab_ref, nreal)
+        g00, g01, g10, g11 = _select4_prob(btile, tab_ref, nreal)
+        for r in range(ROW_TILE):
+            # -- forward row r (ascending): V <- V . M_t, each entry row
+            # the _oh_fwd_kernel update; ONE deferred renorm scalar (the
+            # matrix total) serves both rows.
+            t = j * Tt + fbase + r
+            v_t = (t < lens)[None, :]
+            inv = 1.0 / (v00 + v01 + v10 + v11)
+            a00 = f00[r : r + 1, :]
+            a01 = f01[r : r + 1, :]
+            a10 = f10[r : r + 1, :]
+            a11 = f11[r : r + 1, :]
+            r00 = v00 * a00 + v01 * a10
+            r01 = v00 * a01 + v01 * a11
+            r10 = v10 * a00 + v11 * a10
+            r11 = v10 * a01 + v11 * a11
+            n00 = jnp.where(v_t, r00 * inv, v00)
+            n01 = jnp.where(v_t, r01 * inv, v01)
+            n10 = jnp.where(v_t, r10 * inv, v10)
+            n11 = jnp.where(v_t, r11 * inv, v11)
+            n00 = jnp.where(t == 0, one, n00)
+            n01 = jnp.where(t == 0, zero, n01)
+            n10 = jnp.where(t == 0, zero, n10)
+            n11 = jnp.where(t == 0, one, n11)
+            va_ref[fbase + r, :, :] = jnp.concatenate(
+                [n00, n01, n10, n11], axis=0
+            )
+            v00, v01, v10, v11 = n00, n01, n10, n11
+            # -- backward row (descending): W <- M_{t+1} . W, independent
+            # chain interleaved into the same VPU issue slots; self-
+            # normalized by its own previous matrix total.
+            rr = ROW_TILE - 1 - r
+            tb = bt0 + bbase + rr
+            active = tb <= T - 2
+            v_next = (tb + 1) < lens
+            binv = 1.0 / (w00 + w01 + w10 + w11)
+            c00 = g00[rr : rr + 1, :]
+            c01 = g01[rr : rr + 1, :]
+            c10 = g10[rr : rr + 1, :]
+            c11 = g11[rr : rr + 1, :]
+            b00 = (c00 * w00 + c01 * w10) * binv
+            b01 = (c00 * w01 + c01 * w11) * binv
+            b10 = (c10 * w00 + c11 * w10) * binv
+            b11 = (c10 * w01 + c11 * w11) * binv
+            keep = (active & v_next)[None, :]
+            b00 = jnp.where(keep, b00, w00)
+            b01 = jnp.where(keep, b01, w01)
+            b10 = jnp.where(keep, b10, w10)
+            b11 = jnp.where(keep, b11, w11)
+            wb_ref[bbase + rr, :, :] = jnp.concatenate(
+                [b00, b01, b10, b11], axis=0
+            )
+            w00, w01, w10, w11 = b00, b01, b10, b11
+        return v00, v01, v10, v11, w00, w01, w10, w11
+
+    v00, v01, v10, v11, w00, w01, w10, w11 = jax.lax.fori_loop(
+        0, Tt // ROW_TILE, body,
+        (v00, v01, v10, v11, w00, w01, w10, w11),
+    )
+    fcarry[0:1, :] = v00
+    fcarry[1:2, :] = v01
+    fcarry[2:3, :] = v10
+    fcarry[3:4, :] = v11
+    bcarry[0:1, :] = w00
+    bcarry[1:2, :] = w01
+    bcarry[2:3, :] = w10
+    bcarry[3:4, :] = w11
+
+
 def _sel_mask2(tile, mtab_ref, n, by_sym, S):
     """Per-position island-mask components from the lane-broadcast mask
     table (rows 2k / 2k+1 = mask of the exit group's low/high state).
@@ -935,6 +1061,62 @@ def _xla_fwdbwd_onehot(tab_ext, pair2, pair_next, lens2, a0_red, beta0_red, T):
     return alphas2, jnp.flip(betas_rev, axis=0)
 
 
+def _xla_fwdbwd_mat_onehot(tab_ext, pair2, pair_next, lens2, T):
+    """XLA twin of :func:`_oh_fwdbwd_mat_kernel`: ONE scan carrying BOTH
+    matrix chains (8 components) — the single T-scaling pass the one-pass
+    cost contracts count.  Entry-free; same arithmetic in the same order
+    as the chip kernel.  Returns (Va [Tp, 4, NL], Wb [Tp, 4, NL])."""
+    Tp, NL = pair2.shape
+    lens = lens2[0]
+    pairn_rev = jnp.flip(pair_next, axis=0)
+    one = jnp.ones((NL,), jnp.float32)
+    zero = jnp.zeros((NL,), jnp.float32)
+
+    def step(carry, x):
+        v00, v01, v10, v11, w00, w01, w10, w11 = carry
+        pk, qk, t = x
+        T4 = _tab_sel_nl(tab_ext, pk)
+        G4 = _tab_sel_nl(tab_ext, qk)
+        # forward: V <- V . M_t, matrix-total deferred renorm.
+        inv = 1.0 / (v00 + v01 + v10 + v11)
+        r00 = v00 * T4[:, 0] + v01 * T4[:, 2]
+        r01 = v00 * T4[:, 1] + v01 * T4[:, 3]
+        r10 = v10 * T4[:, 0] + v11 * T4[:, 2]
+        r11 = v10 * T4[:, 1] + v11 * T4[:, 3]
+        v_t = t < lens
+        n00 = jnp.where(v_t, r00 * inv, v00)
+        n01 = jnp.where(v_t, r01 * inv, v01)
+        n10 = jnp.where(v_t, r10 * inv, v10)
+        n11 = jnp.where(v_t, r11 * inv, v11)
+        n00 = jnp.where(t == 0, one, n00)
+        n01 = jnp.where(t == 0, zero, n01)
+        n10 = jnp.where(t == 0, zero, n10)
+        n11 = jnp.where(t == 0, one, n11)
+        # backward at tb = Tp-1-t: W <- M_{tb+1} . W, self-normalized.
+        tb = Tp - 1 - t
+        binv = 1.0 / (w00 + w01 + w10 + w11)
+        b00 = (G4[:, 0] * w00 + G4[:, 1] * w10) * binv
+        b01 = (G4[:, 0] * w01 + G4[:, 1] * w11) * binv
+        b10 = (G4[:, 2] * w00 + G4[:, 3] * w10) * binv
+        b11 = (G4[:, 2] * w01 + G4[:, 3] * w11) * binv
+        keep = (tb <= T - 2) & ((tb + 1) < lens)
+        b00 = jnp.where(keep, b00, w00)
+        b01 = jnp.where(keep, b01, w01)
+        b10 = jnp.where(keep, b10, w10)
+        b11 = jnp.where(keep, b11, w11)
+        return (n00, n01, n10, n11, b00, b01, b10, b11), (
+            jnp.stack([n00, n01, n10, n11], axis=0),
+            jnp.stack([b00, b01, b10, b11], axis=0),
+        )
+
+    _, (va, wb_rev) = jax.lax.scan(
+        step,
+        (one, zero, zero, one, one, zero, zero, one),
+        (pair2, pairn_rev, jnp.arange(Tp, dtype=jnp.int32)),
+    )
+    return va, jnp.flip(wb_rev, axis=0)
+
+
 def conf_from_reduced(alphas2, betas2, esym2, lens2, conf_mask, gt):
     """Per-position island confidence from the reduced streams (elementwise
     — no serial chain, so it is NOT a pass in the cost-contract sense; the
@@ -1237,6 +1419,149 @@ def run_fb_kernels_onehot(
     return alphas2, cs, betas2, esym2
 
 
+def run_fb_mat_onehot(params: HmmParams, lens2: jnp.ndarray, Tt: int, T: int,
+                      pair_esym):
+    """ENTRY-FREE matrix-carried chains over the [Tp, NL] lane layout —
+    the ONE T-scaling pass of the one-pass posterior/em-seq arm.
+
+    Unlike :func:`run_fb_kernels_onehot` this needs NO boundary messages
+    (no a0/beta0 inputs): the kernel carries the [2,2] transfer-matrix
+    form of both chains, and the per-lane transfer total that the
+    standalone products pass used to compute is recovered here as the
+    O(NL) epilogue ``red[n] = M_0(n) . Va[last, n]`` (bit-compatible
+    directions with products_reduced — only the internal renorm scalar
+    differs, exactly the products-kernel-vs-XLA-twin relationship).
+
+    ``pair_esym``: (pair2, esym2-or-None, pairn2-or-None) — the pair
+    stream is REQUIRED (every one-pass caller already built it for the
+    boundary epilogue).  Returns (va [Tp, 4, NL], wb [Tp, 4, NL],
+    esym2 [Tp, NL], red [NL, 2, 2]); contract the streams with
+    :func:`contract_mat_streams` once boundary messages exist.
+    """
+    S = params.n_symbols
+    gt = _groups(params)
+    tab = prob_pair_table(params, gt)
+    pair2, esym2 = pair_esym[0], pair_esym[1]
+    pairn_pre = pair_esym[2] if len(pair_esym) > 2 else None
+    if esym2 is None:
+        esym2 = decode_esym(pair2, S)
+    Tp, NL = pair2.shape
+    pair_next = (
+        pairn_pre
+        if pairn_pre is not None
+        else jnp.concatenate(
+            [pair2[1:], jnp.full((1, NL), S * S, jnp.int32)], axis=0
+        )
+    )
+    ident = jnp.asarray([PROB_IDENT], jnp.float32)
+    tab_ext = jnp.concatenate([tab, ident], axis=0)
+    pair_c = jnp.minimum(pair2, S * S)
+    pairn_c = jnp.minimum(pair_next, S * S)
+
+    if _interpret():
+        va, wb = _xla_fwdbwd_mat_onehot(tab_ext, pair_c, pairn_c, lens2, T)
+    else:
+        from cpgisland_tpu.ops.fb_pallas import _fb_lane_tile
+
+        lt = _fb_lane_tile(NL)
+        n_t = Tp // Tt
+        grid = (NL // lt, n_t)
+        G2 = GROUP * GROUP
+        tabb = _bcast_tab(tab, lt)
+        va, wb = pl.pallas_call(
+            functools.partial(_oh_fwdbwd_mat_kernel, nreal=S * S, Tt=Tt, T=T),
+            grid=grid,
+            in_specs=[
+                _vspec((Tt, lt), lambda i, j: (j, i)),
+                _vspec((Tt, lt), lambda i, j: (n_t - 1 - j, i)),
+                _vspec((1, lt), lambda i, j: (0, i)),
+                _vspec(tabb.shape, lambda i, j: (0, 0)),
+            ],
+            out_specs=[
+                _vspec((Tt, G2, lt), lambda i, j: (j, 0, i)),
+                _vspec((Tt, G2, lt), lambda i, j: (n_t - 1 - j, 0, i)),
+            ],
+            out_shape=[
+                jax.ShapeDtypeStruct((Tp, G2, NL), jnp.float32),
+                jax.ShapeDtypeStruct((Tp, G2, NL), jnp.float32),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((G2, lt), jnp.float32),
+                pltpu.VMEM((G2, lt), jnp.float32),
+            ],
+        )(pair2, pair_next, lens2, tabb)
+
+    # Per-lane transfer totals: products_reduced's value as an O(NL)
+    # epilogue.  Va[last] = M_1 ... M_{l-1} (pass-through fills the pad
+    # tail), so prepending position 0's step matrix — identity for the
+    # mask_first'd global init and for empty lanes — rebuilds the full
+    # lane product; renormalized to products-kernel magnitudes.
+    M0 = _tab_sel_nl(tab_ext, pair_c[0]).reshape(NL, GROUP, GROUP)
+    Vend = va[-1].T.reshape(NL, GROUP, GROUP)
+    red = jnp.einsum(
+        "nik,nkj->nij", M0, Vend, precision=jax.lax.Precision.HIGHEST
+    )
+    red = red / jnp.maximum(
+        jnp.sum(red, axis=(-2, -1), keepdims=True), 1e-30
+    )
+    return va, wb, esym2, red
+
+
+def contract_mat_streams(va, wb, a0_raw, beta0, gt, esym2):
+    """alphas2/betas2 from the matrix streams + boundary entries — the
+    elementwise epilogue applying the true entry directions per position
+    (no serial chain: NOT a pass in the cost-contract sense).
+
+    a0_raw/beta0 arrive FULL-K [K, NL] like run_fb_kernels_onehot's and
+    are projected onto each lane's entry/exit group here (exact: one-hot
+    emissions zero the out-of-group components of both).  Returns
+    (alphas2 [Tp, 2, NL], betas2 [Tp, 2, NL]).  SCALE CONTRACT: both
+    streams carry matrix-total deferred scales — directions match the
+    fused 2-pass streams to ~ulp, but sum(alphas2, axis=1) is NOT the
+    Rabiner cs (no one-pass consumer reads it; the em-seq loglik comes
+    from the telescoped :func:`mat_loglik_lanes` instead)."""
+    Tp, G2, NL = va.shape
+    a0_red = jnp.take_along_axis(a0_raw.T, gt[esym2[0]], axis=1)  # [NL, 2]
+    beta0_red = jnp.take_along_axis(beta0.T, gt[esym2[-1]], axis=1)
+    va4 = va.reshape(Tp, GROUP, GROUP, NL)
+    wb4 = wb.reshape(Tp, GROUP, GROUP, NL)
+    alphas2 = jnp.einsum(
+        "ne,tecn->tcn", a0_red, va4, precision=jax.lax.Precision.HIGHEST
+    )
+    betas2 = jnp.einsum(
+        "taen,ne->tan", wb4, beta0_red, precision=jax.lax.Precision.HIGHEST
+    )
+    return alphas2, betas2
+
+
+def mat_loglik_lanes(va, alphas2, lens2):
+    """EXACT per-lane log-likelihood from the matrix stream — the one-pass
+    replacement for the znorm stats kernel's sum-of-log-cs (whose cs the
+    matrix arm does not produce).  The forward renorms telescope:
+
+      sum(alphas2[l-1]) = sum(a0^T M_1 ... M_{l-1}) / prod_{t<=l-2} sig_t
+
+    with sig_t = sum4(Va[t]) the stored matrix totals (sig_0 = sum4(I) =
+    2 — self-consistent), so
+
+      ll_n = log sum_c alphas2[last, c, n] + sum_{t+1 < l_n} log sig_t,n
+
+    pass-through fills the pad tail, so row Tp-1 IS row l-1.  Lanes with
+    l_n == 0 are masked OUT entirely (the 2-pass arm's per-position
+    valid mask contributes nothing there; the unmasked first term would
+    leak log sum(a0_red) garbage).  Returns ll [1, NL]."""
+    Tp = va.shape[0]
+    sig = jnp.sum(va, axis=1)  # [Tp, NL]
+    smask = (jnp.arange(Tp)[:, None] + 1) < lens2
+    ll = (
+        jnp.log(jnp.maximum(jnp.sum(alphas2[-1], axis=0), 1e-30))[None, :]
+        + jnp.sum(
+            jnp.where(smask, jnp.log(jnp.maximum(sig, 1e-30)), 0.0), axis=0
+        )[None, :]
+    )
+    return jnp.where(lens2 > 0, ll, 0.0)
+
+
 # ---------------------------------------------------------------------------
 # Stacked multi-model kernels: M members' reduced chains in ONE launch.
 #
@@ -1269,6 +1594,11 @@ TUNE_KERNELS = {
     "posterior": "fb.fwdbwd.onehot",
     "em_seq": "fb.seqstats.onehot",
     "em_chunked": "fb.stats.onehot",
+    # One-pass arm (r17): flipping one_pass=True routes these paths onto
+    # the matrix-carried kernel — the family the one_pass graftune tasks
+    # prune their True candidate through before compiling it.
+    "posterior_onepass": "fb.fwdbwdmat.onehot",
+    "em_seq_onepass": "fb.fwdbwdmat.onehot",
 }
 
 

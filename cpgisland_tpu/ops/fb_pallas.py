@@ -876,7 +876,7 @@ def _norm_rows(v):
 
 
 @functools.partial(
-    jax.jit, static_argnames=("lane_T", "t_tile", "onehot", "fused")
+    jax.jit, static_argnames=("lane_T", "t_tile", "onehot", "fused", "one_pass")
 )
 def seq_stats_pallas(
     params: HmmParams,
@@ -887,6 +887,7 @@ def seq_stats_pallas(
     onehot: bool = False,
     prepared=None,
     fused: bool = True,
+    one_pass: bool = False,
 ) -> SuffStats:
     """EXACT whole-sequence statistics on one device via the fused kernels.
 
@@ -904,11 +905,13 @@ def seq_stats_pallas(
     chromosome shards on a pod; longer single-device inputs should use the
     chunked path or a mesh.  ``prepared`` (ops.prepared.PreparedSeq): the
     symbol-only lane layout + pair stream, amortized across EM iterations;
-    inline prep (same code) otherwise.
+    inline prep (same code) otherwise.  ``one_pass`` (static): the r17
+    matrix-carried arm — products + fwd/bwd collapse to ONE T-scaling
+    pass (pow2-S reduced-stats geometries; others keep the fused arm).
     """
     return _seq_stats_core(
         params, obs, length, lane_T, t_tile, axis=None, onehot=onehot,
-        prepared=prepared, fused=fused,
+        prepared=prepared, fused=fused, one_pass=one_pass,
     )
 
 
@@ -992,6 +995,7 @@ def _lane_streams(
     return_reduced: bool = False,
     prepared=None,
     fused: bool = True,
+    one_pass: bool = False,
 ):
     """Shared lane setup for the fused whole-sequence paths: lane transfer
     products -> boundary messages -> forward/backward kernel streams.
@@ -1003,6 +1007,18 @@ def _lane_streams(
     stats, the scale-free xi assembly, MPM argmax).  fused=False keeps the
     split fwd/bwd passes — the A/B arm (tools/bench_passfusion.py) and the
     r4-shaped 3-pass structure.
+
+    ``one_pass`` (one-hot engines only; no-op otherwise, mirroring
+    ``fused``): TRUE one-pass — the ENTRY-FREE matrix-carried kernel
+    (fb_onehot._oh_fwdbwd_mat_kernel) runs FIRST, its epilogue rebuilds
+    the per-lane transfer totals the standalone products pass used to
+    compute, the unchanged O(NL) boundary combine below derives the
+    entry directions, and an elementwise contraction applies them per
+    position — ONE T-scaling pass instead of two.  Takes precedence
+    over ``fused`` (there is no separate backward launch to split).
+    Contracted streams carry matrix-total scales: exact for every
+    scale-free consumer; the cs slot is NOT a Rabiner cs source (the
+    em-seq loglik telescopes via fb_onehot.mat_loglik_lanes instead).
 
     With ``conf_mask`` ([K] island indicator) the backward kernel emits the
     per-position island confidence in the betas slot of the return tuple
@@ -1107,7 +1123,18 @@ def _lane_streams(
         gt = fb_onehot._groups(params)
         gin = gt[e_in_l]  # [NL, 2]
         gout = gt[e_out_l]
-        red = fb_onehot.products_reduced(params, pair2, Tt)  # [NL, 2, 2]
+        pairn_pre = prepared.pairn2 if prepared is not None else None
+        if one_pass:
+            # r17 TRUE one-pass: the matrix-carried kernel is entry-free,
+            # so it runs BEFORE any boundary message exists; red (the
+            # products pass's output) falls out of its O(NL) epilogue and
+            # the boundary combine below is unchanged.
+            va_m, wb_m, esym2_m, red = fb_onehot.run_fb_mat_onehot(
+                params, lane_lens[None, :], Tt, lane_T,
+                (pair2, None, pairn_pre),
+            )
+        else:
+            red = fb_onehot.products_reduced(params, pair2, Tt)  # [NL, 2, 2]
         incl_red = jax.lax.associative_scan(_lane_combine, red, axis=0)
     else:
         P = _run_products_kernel(A, B, sel_l, lane_T, Tt, K, S)  # P[lane, i, m]
@@ -1216,17 +1243,35 @@ def _lane_streams(
         # zeros wherever they are ever multiplied in); the conf fast path
         # consumes the reduced streams directly and the scatters are
         # dead-code-eliminated.
-        pairn_pre = prepared.pairn2 if prepared is not None else None
-        al2, cs, third2, esym2 = fb_onehot.run_fb_kernels_onehot(
-            params, sel_l.T, prev_dev, lens2, v0.T, beta_exits.T, Tt,
-            lane_T, conf_mask=conf_mask, pair_esym=(pair2, None, pairn_pre),
-            fused=fused,
-        )
+        ll_lane = None
+        if one_pass:
+            # Elementwise entry application — the matrix streams already
+            # exist; only the boundary directions were pending.
+            al2, third2 = fb_onehot.contract_mat_streams(
+                va_m, wb_m, v0.T, beta_exits.T, gt, esym2_m
+            )
+            esym2 = esym2_m
+            cs = jnp.sum(al2, axis=1)  # matrix-scaled — NOT Rabiner cs
+            if conf_mask is not None:
+                third2 = fb_onehot.conf_from_reduced(
+                    al2, third2, esym2, lens2, conf_mask, gt
+                )
+            elif return_reduced:
+                ll_lane = fb_onehot.mat_loglik_lanes(va_m, al2, lens2)
+        else:
+            al2, cs, third2, esym2 = fb_onehot.run_fb_kernels_onehot(
+                params, sel_l.T, prev_dev, lens2, v0.T, beta_exits.T, Tt,
+                lane_T, conf_mask=conf_mask,
+                pair_esym=(pair2, None, pairn_pre), fused=fused,
+            )
         if return_reduced and conf_mask is None:
             # Raw reduced streams for the seq-stats kernel consumer — the
             # pair stream and entering directions pass through ONCE (no
-            # recompute, no re-gather).
-            reduced = (al2, third2, esym2, pair2, e_in_l, gt, enters_red)
+            # recompute, no re-gather).  ll_lane: the one-pass arm's
+            # telescoped exact loglik (None on the cs-carrying arms).
+            reduced = (
+                al2, third2, esym2, pair2, e_in_l, gt, enters_red, ll_lane
+            )
             return reduced, cs, None, steps2, lens2, enters, is_first, Tt
         alphas = fb_onehot.scatter_streams(al2, gt, esym2, K)
         third = (
@@ -1252,6 +1297,7 @@ def _seq_stats_core(
     onehot: bool = False,
     prepared=None,
     fused: bool = True,
+    one_pass: bool = False,
 ) -> SuffStats:
     """The fused whole-sequence E-step over THIS device's time shard.
 
@@ -1275,9 +1321,15 @@ def _seq_stats_core(
     # keeps the scatter + dense scale-free assembly below — itself
     # invariant to the fused path's self-normalized betas.)
     use_kernel_stats = onehot and S & (S - 1) == 0
+    # One-pass rides the reduced-stream stats kernel only: the non-pow2-S
+    # dense assembly below derives its loglik from Rabiner cs, which the
+    # matrix arm does not produce — those geometries silently keep the
+    # fused 2-pass arm (routing bit-for-bit unchanged).
+    one_pass = one_pass and use_kernel_stats
     alphas, cs, betas, steps2, lens2, enters, is_first, Tt_used = _lane_streams(
         params, obs, length, lane_T, t_tile, axis, onehot=onehot,
         return_reduced=use_kernel_stats, prepared=prepared, fused=fused,
+        one_pass=one_pass,
     )
     NL = steps2.shape[1]
     if use_kernel_stats:
@@ -1285,7 +1337,7 @@ def _seq_stats_core(
         # scatter + XLA assembly below is its off-TPU twin).
         from cpgisland_tpu.ops import fb_onehot
 
-        al2, b2, esym2, pair2, e_in_l, gt, enters_red = alphas
+        al2, b2, esym2, pair2, e_in_l, gt, enters_red, ll_lane = alphas
         ent_full = fb_onehot.scatter_streams(
             enters_red.T[None], gt, e_in_l[None, :], K
         )[0]  # [K, NL]
@@ -1296,6 +1348,12 @@ def _seq_stats_core(
             params, al2, b2, pair2, lens2, gt, enters_red.T, ent_full,
             pair0_mask, Tt_used,
         )
+        if one_pass:
+            # The stats kernel's sum-of-log-cs read the matrix-scaled
+            # alphas (macc/emit are per-pair/-position normalized, so
+            # they are exact regardless) — the loglik is the telescoped
+            # per-lane reduction instead (fb_onehot.mat_loglik_lanes).
+            ll = ll_lane
         trans, emit, loglik = _assemble_reduced_stats(
             params, A, gt, macc, emit_red, ll
         )
@@ -1365,6 +1423,7 @@ def _seq_posterior_core(
     prev_sym=None,
     prepared=None,
     fused: bool = True,
+    one_pass: bool = False,
 ):
     """Per-position island confidence over THIS device's time shard, fused.
 
@@ -1391,7 +1450,7 @@ def _seq_posterior_core(
             params, obs, length, lane_T, t_tile, axis,
             enter_dir=enter_dir, exit_dir=exit_dir, first=first,
             conf_mask=island_mask, onehot=onehot, prev_sym=prev_sym,
-            prepared=prepared, fused=fused,
+            prepared=prepared, fused=fused, one_pass=one_pass,
         )
         # Lane n covers global positions [n*lane_T, (n+1)*lane_T): transpose
         # the [lane_T, NL] lane layout back to global order, slice the pad.
@@ -1400,6 +1459,7 @@ def _seq_posterior_core(
         params, obs, length, lane_T, t_tile, axis,
         enter_dir=enter_dir, exit_dir=exit_dir, first=first,
         onehot=onehot, prev_sym=prev_sym, prepared=prepared, fused=fused,
+        one_pass=one_pass,
     )
     # With the fused backward the betas are per-position directions; the
     # gamma normalize/argmax below is scale-free, so the branch is shared.
@@ -1409,7 +1469,10 @@ def _seq_posterior_core(
 
 @functools.partial(
     jax.jit,
-    static_argnames=("lane_T", "t_tile", "first", "want_path", "onehot", "fused"),
+    static_argnames=(
+        "lane_T", "t_tile", "first", "want_path", "onehot", "fused",
+        "one_pass",
+    ),
 )
 def seq_posterior_pallas(
     params: HmmParams,
@@ -1426,6 +1489,7 @@ def seq_posterior_pallas(
     prev_sym=None,
     prepared=None,
     fused: bool = True,
+    one_pass: bool = False,
 ):
     """Single-device fused posterior: (conf [T], mpm path [T]).
 
@@ -1434,12 +1498,14 @@ def seq_posterior_pallas(
     longer records thread enter_dir/exit_dir (see _seq_posterior_core).
     ``prepared``: the same PreparedSeq the span's other sweeps use — one
     symbol-only prep per placed span instead of one per sweep.
+    ``one_pass`` (static): the r17 matrix-carried arm — ONE T-scaling
+    pass for any one-hot engine (conf/gamma/MPM are scale-free).
     """
     return _seq_posterior_core(
         params, obs, length, island_mask, lane_T, t_tile, axis=None,
         enter_dir=enter_dir, exit_dir=exit_dir, first=first,
         want_path=want_path, onehot=onehot, prev_sym=prev_sym,
-        prepared=prepared, fused=fused,
+        prepared=prepared, fused=fused, one_pass=one_pass,
     )
 
 
@@ -1467,6 +1533,10 @@ def batch_posterior_pallas(
     path [N, T] int32 — zeros unless want_path).  ``prepared``: same
     contract as batch_stats_pallas — one PreparedChunked serves both
     entries on the same batch (the pipeline's posterior -> EM reuse).
+    NOTE: there is no ``one_pass`` knob here — independent records have
+    trivial boundary messages (pi-init / free end), so the chunked layout
+    never ran a products pass and is ALREADY one T-scaling pass when
+    fused (the r17 arm targets the lane-coupled whole-sequence paths).
     """
     K, S = params.n_states, params.n_symbols
     N, T = chunks.shape

@@ -115,7 +115,9 @@ class PreparedSeq:
     first (clamped) symbol; prev_dev [] the symbol entering the span's
     reduced chain and pair2/e_in/e_out/pairn2 its pair stream (pairn2 =
     time-shifted next-step pairs for the backward/fused kernels; one-hot
-    only)."""
+    only).  The one-pass matrix kernel (fb_onehot.run_fb_mat_onehot)
+    consumes the SAME pair2/pairn2 fields — no extra prepared stream, so
+    prepared-vs-inline stays bit-identical on the one-pass arm too."""
 
     obs_l: jnp.ndarray
     sel_l: jnp.ndarray

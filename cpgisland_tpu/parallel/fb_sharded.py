@@ -436,6 +436,7 @@ def _shard_stats2d_body(
     engine: str = "xla",
     lane_T: int | None = None,
     t_tile: int | None = None,
+    one_pass: bool = False,
 ):
     """2-D per-device E-step body: sequences over ``data``, time over ``seq``.
 
@@ -473,6 +474,7 @@ def _shard_stats2d_body(
                 return fb_pallas._seq_stats_core(
                     params, obs_row, length, lt, tt,
                     axis=seq_axis, reduce=False, onehot=engine == "onehot",
+                    one_pass=one_pass,
                 )
         else:
             def one_seq(obs_row, length):
@@ -526,6 +528,7 @@ def sharded_stats2d_fn(
     engine: str = "xla",
     lane_T: int | None = None,
     t_tile: int | None = None,
+    one_pass: bool = False,
 ):
     """Compiled 2-D entry point: fn(params, obs [N, T], lengths [N, sp]).
 
@@ -534,9 +537,13 @@ def sharded_stats2d_fn(
     in seq-shard s, placed with P(data, seq).  ``engine="pallas"`` lowers
     each per-row shard through the fused kernels (TPU; interpreted
     elsewhere), with ``lane_T``/``t_tile`` overriding the kernel defaults.
+    ``one_pass`` arms the matrix-carried one-pass onehot arm per row
+    (no-op off the onehot kernel-stats route — fb_pallas gates it).
     """
     data_axis, seq_axis = mesh.axis_names
-    body = _shard_stats2d_body(block_size, data_axis, seq_axis, engine, lane_T, t_tile)
+    body = _shard_stats2d_body(
+        block_size, data_axis, seq_axis, engine, lane_T, t_tile, one_pass
+    )
     return jax.jit(
         jax.shard_map(
             body,
@@ -624,7 +631,8 @@ def sharded_stats2d_rows_fn(mesh: Mesh, engine: str, t_tile: int = 512,
 
 @functools.lru_cache(maxsize=32)
 def sharded_stats_pallas_fn(mesh: Mesh, lane_T: int, t_tile: int,
-                            onehot: bool = False, fused: bool = True):
+                            onehot: bool = False, fused: bool = True,
+                            one_pass: bool = False):
     """Fused-kernel twin of :func:`sharded_stats_fn` (same placed-array
     contract): per-device lane products + boundary-message exchange run the
     chunked Pallas forward/backward kernels on each shard — exact
@@ -632,7 +640,9 @@ def sharded_stats_pallas_fn(mesh: Mesh, lane_T: int, t_tile: int,
     routes the reduced kernels for one-hot-emission models; ``fused``
     co-schedules their fwd/bwd chains (False = the split r9 A/B arm —
     SeqBackend threads its ``fuse_fb`` here so the chip A/B works on
-    multi-device meshes too)."""
+    multi-device meshes too); ``one_pass`` arms the matrix-carried arm
+    that also folds the products pass in (SeqBackend threads its
+    ``one_pass``; gated to the onehot kernel-stats route in fb_pallas)."""
     from cpgisland_tpu.ops import fb_pallas
 
     axis = mesh.axis_names[0]
@@ -640,7 +650,7 @@ def sharded_stats_pallas_fn(mesh: Mesh, lane_T: int, t_tile: int,
     def body(params, obs_shard, len_shard):
         return fb_pallas._seq_stats_core(
             params, obs_shard, len_shard[0], lane_T, t_tile, axis=axis,
-            onehot=onehot, fused=fused,
+            onehot=onehot, fused=fused, one_pass=one_pass,
         )
 
     return jax.jit(

@@ -137,13 +137,15 @@ def _posterior_fn(
     lane_T: int,
     t_tile: int,
     fused: bool = True,
+    one_pass: bool = False,
 ):
     """Compiled sharded posterior: fn(params, obs, lens, mask, enter, exit)
     -> (conf P(axis), path P(axis)).  enter/exit are always arrays — the
     uniform direction IS the free-end anchor, and enter is ignored when
     ``first`` — so one cache entry serves every span of a record.
     ``fused``: the r9 co-scheduled fwd/bwd pass (False = the split 3-pass
-    A/B arm, kernel-engine paths only)."""
+    A/B arm, kernel-engine paths only).  ``one_pass``: the r17
+    matrix-carried true one-pass arm (onehot engine only)."""
     axis = mesh.axis_names[0]
 
     def body(params, obs_shard, len_shard, island_mask, enter_dir, exit_dir,
@@ -154,6 +156,7 @@ def _posterior_fn(
                 axis=axis, enter_dir=enter_dir, exit_dir=exit_dir,
                 first=first, want_path=want_path,
                 onehot=engine == "onehot", prev_sym=prev_sym, fused=fused,
+                one_pass=one_pass,
             )
         return _one_seq_local_posterior(
             params, obs_shard, len_shard[0], island_mask,
@@ -360,6 +363,7 @@ def posterior_sharded(
     prev_sym: Optional[int] = None,
     prepared=None,
     fused: Optional[bool] = None,
+    one_pass: Optional[bool] = None,
     breaker=None,
 ):
     """Island confidence (and optional MPM path) for one sequence, sharded
@@ -373,6 +377,12 @@ def posterior_sharded(
     ``None`` default consults the graftune winner table
     (``fused.posterior``) and falls back to the shipped True — explicit
     values always win.
+
+    ``one_pass`` (onehot engine): the r17 matrix-carried TRUE one-pass
+    arm — products + fwd/bwd in ONE T-scaling launch.  ``None`` consults
+    ``one_pass.posterior`` and falls back to the shipped False (the
+    2-pass arm stays the default until a chip capture flips it);
+    explicit values always win.  Takes precedence over ``fused``.
 
     ``prepared`` (from :func:`prepare_record_span`; single-device fused
     engines only): the span's symbol-only prep — the pass then runs the
@@ -394,7 +404,12 @@ def posterior_sharded(
         from cpgisland_tpu import tune
 
         fused = tune.default_fused("posterior")
+    if one_pass is None:
+        from cpgisland_tpu import tune
+
+        one_pass = tune.default_one_pass("posterior")
     eng = resolve_fb_engine(engine, params, breaker=breaker)
+    one_pass = one_pass and eng == "onehot"
     tt = t_tile if t_tile is not None else fb_pallas.DEFAULT_T_TILE
     T = int(np.asarray(obs).shape[0]) if placed is None else int(obs.shape[0])
     K = params.n_states
@@ -442,11 +457,11 @@ def posterior_sharded(
             first=first, want_path=want_path,
             lane_T=prepared.lane_T, t_tile=tt, onehot=eng == "onehot",
             prev_sym=_prev_sym_arg(eng, first, prev_sym),
-            prepared=prepared, fused=fused,
+            prepared=prepared, fused=fused, one_pass=one_pass,
         )
     else:
         fn = _posterior_fn(
-            mesh, block_size, eng, first, want_path, lt, tt, fused
+            mesh, block_size, eng, first, want_path, lt, tt, fused, one_pass
         )
         conf, path = fn(
             params, arr, lens, mask, enter, exit_,

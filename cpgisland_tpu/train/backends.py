@@ -233,7 +233,11 @@ class LocalBackend(EStepBackend):
     ``fuse_fb=False`` keeps the split (r4) fwd/bwd kernel structure on the
     onehot routing — the pass-fusion A/B arm; ``None`` (default) consults
     the graftune winner table (``fused.em_chunked``) and falls back to
-    the shipped co-scheduled True; an explicit bool always wins."""
+    the shipped co-scheduled True; an explicit bool always wins.
+
+    There is no ``one_pass`` knob here: the chunked layout never ran a
+    standalone products pass, so the fused chunked route is already ONE
+    T-scaling pass (see fb_pallas.batch_posterior_pallas)."""
 
     def __init__(self, mode: str = "rescaled", engine: str = "auto",
                  fuse_fb: Optional[bool] = None):
@@ -293,6 +297,9 @@ class SpmdBackend(EStepBackend):
     be a multiple of the axis size — use :meth:`prepare`, which pads with
     zero-length chunks contributing exactly-zero statistics).  The model is
     replicated, mirroring the reference's distributed-cache broadcast.
+
+    Like LocalBackend there is no ``one_pass`` knob — the chunked layout
+    is already one T-scaling pass when fused.
     """
 
     def __init__(
@@ -637,14 +644,14 @@ def _seq_onehot(engine: str, params: HmmParams) -> bool:
 
 @functools.lru_cache(maxsize=32)
 def _seq_single_stats_fn(lane_T: int, t_tile: int, onehot: bool,
-                         fuse_fb: bool = True):
+                         fuse_fb: bool = True, one_pass: bool = False):
     """Stable single-device whole-sequence stats fn (fused-EM cacheable)."""
 
     def fn(params, obs_flat, lengths, prepared=None):
         return fb_pallas.seq_stats_pallas(
             params, obs_flat, jnp.sum(lengths),
             lane_T=lane_T, t_tile=t_tile, onehot=onehot, prepared=prepared,
-            fused=fuse_fb,
+            fused=fuse_fb, one_pass=one_pass,
         )
 
     return fn
@@ -673,6 +680,7 @@ class SeqBackend(EStepBackend):
         lane_T: Optional[int] = None,
         t_tile: Optional[int] = None,
         fuse_fb: Optional[bool] = None,
+        one_pass: Optional[bool] = None,
     ):
         from cpgisland_tpu import tune
 
@@ -680,6 +688,15 @@ class SeqBackend(EStepBackend):
         self.fuse_fb = (
             tune.default_fused("em_seq") if fuse_fb is None
             else bool(fuse_fb)
+        )
+        # True one-pass reduced arm (matrix-carried fwd/bwd, the products
+        # pass folded in).  None consults the graftune ``one_pass.em_seq``
+        # winner (shipped default False); explicit always wins.  Only the
+        # kernel-stats one-hot route honors it — elsewhere it silently
+        # falls back to the fused 2-pass arm bit-for-bit (fb_pallas gate).
+        self.one_pass = (
+            tune.default_one_pass("em_seq") if one_pass is None
+            else bool(one_pass)
         )
         self.mesh = mesh if mesh is not None else make_mesh(axis=axis)
         self.block_size = block_size if block_size is not None else fb_sharded.DEFAULT_BLOCK
@@ -759,9 +776,12 @@ class SeqBackend(EStepBackend):
                 requested=self.engine, n_dev=n_dev,
             )
             if n_dev == 1:
-                return _seq_single_stats_fn(lane_T, self.t_tile, oh, self.fuse_fb)
+                return _seq_single_stats_fn(
+                    lane_T, self.t_tile, oh, self.fuse_fb, self.one_pass
+                )
             return fb_sharded.sharded_stats_pallas_fn(
-                self.mesh, lane_T, self.t_tile, oh, self.fuse_fb
+                self.mesh, lane_T, self.t_tile, oh, self.fuse_fb,
+                self.one_pass,
             )
         obs.engine_decision(
             site="seq_backend", choice="xla", requested=self.engine, n_dev=n_dev
@@ -850,7 +870,10 @@ class Seq2DBackend(EStepBackend):
         engine: str = "auto",
         lane_T: Optional[int] = None,
         t_tile: Optional[int] = None,
+        one_pass: Optional[bool] = None,
     ):
+        from cpgisland_tpu import tune
+
         if mesh is not None and len(mesh.axis_names) != 2:
             raise ValueError(f"Seq2DBackend needs a 2-D mesh, got axes {mesh.axis_names}")
         _check_seq_engine(engine)
@@ -862,6 +885,13 @@ class Seq2DBackend(EStepBackend):
         self.engine = engine
         self.lane_T = lane_T
         self.t_tile = t_tile
+        # One-pass matrix arm for the onehot whole-seq route (same consult
+        # as SeqBackend; the rows-chunked route is already 1-pass and
+        # ignores it).
+        self.one_pass = (
+            tune.default_one_pass("em_seq") if one_pass is None
+            else bool(one_pass)
+        )
 
     @property
     def data_axis(self) -> str:
@@ -979,7 +1009,7 @@ class Seq2DBackend(EStepBackend):
             else (None, None)
         )
         return fb_sharded.sharded_stats2d_fn(
-            mesh, self.block_size, engine, lane_T, t_tile
+            mesh, self.block_size, engine, lane_T, t_tile, self.one_pass
         )
 
     def _group_stats(self, params, mesh, chunks, lengths):

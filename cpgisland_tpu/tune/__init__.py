@@ -22,10 +22,12 @@ Three layers:
 
 Consulting sites: ``fb_pallas.pick_lane_T`` (lane_T, + the
 generation-keyed feasibility-filter cache), the per-path ``fused``
-defaults (train backends, parallel posterior), the per-path ``stacked``
-defaults (family.compare, serve broker, FamilyEStep), SeqBackend's
-``t_tile``, ``decode_batch_flat``'s block_size, and
-``resolve_fb_engine``'s auto branch.
+defaults (train backends, parallel posterior), the per-path ``one_pass``
+defaults (posterior_sharded, Seq/Seq2D backends — the matrix-carried
+true-one-pass arm, shipped False), the per-path ``stacked`` defaults
+(family.compare, serve broker, FamilyEStep), SeqBackend's ``t_tile``,
+``decode_batch_flat``'s block_size, and ``resolve_fb_engine``'s auto
+branch.
 """
 
 from __future__ import annotations
@@ -132,6 +134,15 @@ def default_fused(path: str, legacy: bool = True) -> bool:
     """Per-path r9 pass-fusion default: ``posterior`` | ``em_seq`` |
     ``em_chunked`` | ``em_family``."""
     return bool(_consult(f"fused.{path}", legacy, domain=(True, False)))
+
+
+def default_one_pass(path: str, legacy: bool = False) -> bool:
+    """Per-path true-one-pass default (matrix-carried reduced FB, the
+    products pass folded into the co-scheduled launch): ``posterior`` |
+    ``em_seq``.  Shipped legacy is False — the one-pass trade (4 carry
+    rows, wider VMEM) is only decidable on silicon; the chip sweep flips
+    the winner past the 3% margin like every other task."""
+    return bool(_consult(f"one_pass.{path}", legacy, domain=(True, False)))
 
 
 def default_stacked(site: str, legacy: bool = True) -> bool:

@@ -507,6 +507,96 @@ def _fused_task(path: str) -> Task:
     )
 
 
+def _one_pass_task(path: str) -> Task:
+    """The true-one-pass A/B (ISSUE 17): False = the shipped fused 2-pass
+    arm, True = the matrix-carried kernel with the products pass folded
+    in.  Same harness shape as ``fused.*``; the True arm's knob point is
+    pruned through the matrix kernel's graftmem row before any compile."""
+    costs = {
+        "posterior": ("posterior.onehot.onepass",),
+        "em_seq": ("em.seq.onehot.onepass",),
+    }[path]
+    ceiling = {"posterior": "posterior", "em_seq": "em-seq"}[path]
+
+    def build(cfg):
+        from cpgisland_tpu.ops import fb_pallas
+
+        env = {"params": _params()}
+        env["obs"] = _obs_stream(cfg.n, seed=9)
+        env["n"] = cfg.n
+        env["lane_T"] = fb_pallas.legacy_lane_T(
+            cfg.n, onehot=True, long_lanes=True
+        )
+        env["mask"] = _island_mask8()
+        return env
+
+    def run_once(env, value):
+        from cpgisland_tpu.ops import fb_pallas
+
+        if path == "posterior":
+            conf, _ = fb_pallas.seq_posterior_pallas(
+                env["params"], env["obs"], env["n"], env["mask"],
+                lane_T=env["lane_T"], onehot=True, one_pass=bool(value),
+            )
+            return conf
+        return fb_pallas.seq_stats_pallas(
+            env["params"], env["obs"], env["n"],
+            lane_T=env["lane_T"], onehot=True, one_pass=bool(value),
+        )
+
+    def feas(value, cfg):
+        if not value:
+            return None
+        from cpgisland_tpu.analysis import memmodel
+
+        # The matrix kernel streams DOUBLED [t_tile, 4, lane_tile] blocks
+        # both ways — prune its production 256-lane point statically.
+        return memmodel.feasible(
+            "fb.fwdbwdmat.onehot", memmodel.Knobs(lane_tile=256)
+        )
+
+    def parity_err(ref, out):
+        if path == "posterior":
+            import jax.numpy as jnp
+
+            return float(jnp.max(jnp.abs(ref - out)))
+        return _stats_rel_err(ref, out)
+
+    def make_chained(env, value, cfg):
+        import jax
+        import jax.numpy as jnp
+
+        # Params-side seed fold (relay anti-phantom); the symbol stream
+        # rides as an argument, never a baked constant (HTTP 413).
+        @jax.jit
+        def chained(p, data, s):
+            p = _jitter(p, s)
+
+            def body(c, _):
+                got = run_once({**env, "params": p, "obs": data}, value)
+                small = got[:8] if path == "posterior" else got.loglik
+                return c + jnp.sum(small) * 1e-9, None
+
+            c, _ = jax.lax.scan(
+                body, jnp.float32(0), None, length=cfg.chain
+            )
+            return c
+
+        return lambda s: float(
+            jax.device_get(chained(env["params"], env["obs"], jnp.int32(s)))
+        )
+
+    return Task(
+        name=f"one_pass.{path}", family="fb.reduced", costs_entries=costs,
+        legacy=lambda cfg: False,
+        candidates=lambda cfg: [False, True],
+        feasibility=feas,
+        build=build, run_once=run_once, parity_err=parity_err,
+        parity_tol=CONF_TOL if path == "posterior" else STATS_REL_TOL,
+        make_chained=make_chained, ceiling_key=ceiling,
+    )
+
+
 # -- per-site stacked booleans (the bench_multimodel decisions) --------------
 
 
@@ -810,6 +900,8 @@ def all_tasks() -> list:
         _fused_task("posterior"),
         _fused_task("em_seq"),
         _fused_task("em_chunked"),
+        _one_pass_task("posterior"),
+        _one_pass_task("em_seq"),
         _stacked_task("em_family"),
         _stacked_task("compare"),
         _stacked_task("serve_decode"),
@@ -825,6 +917,7 @@ SMOKE_TASKS = (
     "t_tile.em_seq",
     "flat.block.scores",
     "fused.em_chunked",
+    "one_pass.posterior",
     "stacked.em_family",
 )
 

@@ -1,0 +1,43 @@
+"""Planted regression: a REGROWN standalone PRODUCTS pass.
+
+The ISSUE 17 one-pass collapse folds the reduced paths' [2,2]
+transfer-matrix products into the co-scheduled fwd/bwd launch (the
+matrix-carried kernel emits per-lane transfer totals itself), so the
+standalone products/boundary pass disappears (posterior/em-seq dropped
+2 -> 1 T-scaling passes).  This twin models the regression the fold
+exists to prevent: the same work as ``cost_clean`` (one max-plus chain +
+epilogue) plus a SECOND independent forward T-trip scan COMPOSING the
+per-step [2,2] matrices — the de-folded products pass re-materializing
+as its own launch.  Must be caught by (a) the lockfile diff (scan eqn
+count + serial depth, scan named) and (b) the pass-structure pin
+(passes 1 -> 2 vs the clean baseline).
+"""
+
+from cost_clean import BASE_SYMBOLS, _chain, _epilogue, _steps  # noqa: F401
+
+
+def make(scale: int = 1):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    obs = jnp.asarray(np.arange(BASE_SYMBOLS * scale, dtype=np.int32) % 4)
+
+    def fn(o):
+        steps = _steps(o)
+        carry, ys = _chain(steps)
+
+        # The regrown pass: an INDEPENDENT forward products scan over the
+        # same steps — per-step [2, 2] matrix composition with deferred
+        # renorm, exactly the standalone boundary-products shape the
+        # matrix-carried kernel absorbed.  Its own scan eqn, its own
+        # T-scaling serial chain.
+        def products(m, step):
+            new = step @ m
+            new = new / jnp.maximum(jnp.max(new), 1e-30)
+            return new, new[0, 0]
+
+        m2, ys2 = jax.lax.scan(products, jnp.eye(2, dtype=jnp.float32), steps)
+        return carry.sum() + ys.sum() + m2.sum() + ys2.sum() + _epilogue()
+
+    return fn, (obs,)

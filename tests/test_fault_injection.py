@@ -225,9 +225,10 @@ def _patch_posterior_engine(monkeypatch, poke) -> None:
     real_fn = posterior_mod._posterior_fn
 
     def patched(mesh, block_size, engine, first, want_path, lane_T, t_tile,
-                fused=True):
+                fused=True, one_pass=False):
         fn = real_fn(
-            mesh, block_size, engine, first, want_path, lane_T, t_tile, fused
+            mesh, block_size, engine, first, want_path, lane_T, t_tile, fused,
+            one_pass,
         )
 
         def wrapped(params, arr, lens, mask, enter, exit_, prev):

@@ -165,6 +165,12 @@ def test_pass_structure_matches_documented(cost_report):
     assert notes["em.seq.onehot"] == 2
     assert notes["em.chunked.onehot"] == 1
     assert notes["em.chunked.xla"] == 2
+    # ISSUE 17: the matrix-carried one-pass arm folds the products pass
+    # into the co-scheduled launch — ONE T-scaling pass on both reduced
+    # paths (the 2-pass entries above stay pinned as the shipped default
+    # and A/B baseline).
+    assert notes["posterior.onehot.onepass"] == 1
+    assert notes["em.seq.onehot.onepass"] == 1
 
 
 # -- Layer 3: planted-regression fixtures ------------------------------------
@@ -265,6 +271,22 @@ def test_planted_regrown_pass_caught(clean_lock):
     ), diff.violations
     # And the pass counter itself sees 2 T-scaling passes where the clean
     # (fused) baseline has 1 — the quantity EXPECTED_PASSES pins.
+    clean_entry = costmodel.trace_entry(_fixture_entry("cost_clean"))
+    assert clean_entry.passes() == 1
+    assert entry.passes() == 2
+
+
+def test_planted_regrown_products_caught(clean_lock):
+    """The ISSUE 17 anti-regression: a de-folded standalone PRODUCTS pass
+    (per-step [2,2] matrix composition as its own launch) re-appearing next
+    to the co-scheduled chain must fail CI with the regrown scan named —
+    the same double gate as the r9 twin."""
+    entry, diff = _diff_fixture("cost_regrown_products", clean_lock)
+    assert not diff.ok
+    assert any(
+        "pass count 1 -> 2" in v and "drifting prims" in v
+        for v in diff.violations
+    ), diff.violations
     clean_entry = costmodel.trace_entry(_fixture_entry("cost_clean"))
     assert clean_entry.passes() == 1
     assert entry.passes() == 2

@@ -1,0 +1,392 @@
+"""ISSUE 17 true one-pass reduced FB: the products pass folded in.
+
+The matrix-carried kernel (fb_onehot._oh_fwdbwd_mat_kernel / its one-scan
+XLA twin) runs the reduced forward AND backward chains in [2,2]
+transfer-matrix form — 4 carry rows per direction — and emits per-lane
+transfer totals itself, so the standalone products/boundary pass
+disappears: posterior and exact-seq EM drop 2 -> 1 T-scaling passes.  The
+true entry directions are applied per-position in scale-free elementwise
+epilogues (contract_mat_streams) and the r7 reduced [NL,2,2] boundary
+combine runs as an O(NL) epilogue over the kernel's own totals.
+
+Pinned here: parity of the one-pass arm against the r9 fused arm, the r4
+split arm, and the dense engine (conf, MPM paths, znorm stats, fused-EM
+trajectories); span/continuation threading; ragged lane geometries; the
+order-2 dinucleotide member (K=32 one-hot over the 16-symbol pair
+alphabet); prepared-vs-inline bit-identity; zero fresh compiles at steady
+state; the graftune consultation sites with bit-for-bit stale/absent
+fallback; and the memmodel verdict that keeps the STACKED decoder on the
+2-pass arm (the matrix kernel is M=3-infeasible at the 256-lane tile).
+
+Off-TPU these run the arithmetic-identical XLA twins; the TPU suite run
+(CPGISLAND_TEST_PLATFORM=axon) exercises the Pallas kernels against the
+same assertions.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cpgisland_tpu import tune
+from cpgisland_tpu.models import presets
+from cpgisland_tpu.models.hmm import sample_sequence
+from cpgisland_tpu.ops import fb_pallas, prepared
+from cpgisland_tpu.parallel.posterior import posterior_sharded
+from cpgisland_tpu.tune import table as tune_table
+from cpgisland_tpu.utils import codec
+
+MASK8 = jnp.asarray(np.r_[np.ones(4), np.zeros(4)].astype(np.float32))
+
+
+def _obs(rng, n):
+    params = presets.durbin_cpg8()
+    _, obs = sample_sequence(
+        params, jax.random.PRNGKey(int(rng.integers(1 << 30))), n
+    )
+    return params, obs
+
+
+def _pair_record(n, seed=0):
+    rng = np.random.default_rng(seed)
+    base = rng.integers(0, 4, size=n + 1).astype(np.uint8)
+    return codec.recode_pairs(base[1:], prev=int(base[0]))
+
+
+def _assert_stats_close(a, b, rtol=5e-5, atol=1e-3):
+    np.testing.assert_allclose(np.asarray(a.init), np.asarray(b.init), atol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(a.trans), np.asarray(b.trans), rtol=rtol, atol=atol
+    )
+    np.testing.assert_allclose(
+        np.asarray(a.emit), np.asarray(b.emit), rtol=rtol, atol=atol
+    )
+    assert float(a.loglik) == pytest.approx(float(b.loglik), rel=1e-5)
+    assert int(a.n_seqs) == int(b.n_seqs)
+
+
+# --- posterior: one-pass vs fused vs split vs dense --------------------------
+
+
+def test_posterior_conf_one_pass_parity(rng):
+    params, obs = _obs(rng, 12001)  # ragged vs the lane geometry
+    kw = dict(lane_T=2048, t_tile=512, onehot=True)
+    c_split, _ = fb_pallas.seq_posterior_pallas(
+        params, obs, obs.shape[0], MASK8, fused=False, **kw
+    )
+    c_fused, _ = fb_pallas.seq_posterior_pallas(
+        params, obs, obs.shape[0], MASK8, fused=True, **kw
+    )
+    c_one, _ = fb_pallas.seq_posterior_pallas(
+        params, obs, obs.shape[0], MASK8, one_pass=True, **kw
+    )
+    c_dense, _ = fb_pallas.seq_posterior_pallas(
+        params, obs, obs.shape[0], MASK8, lane_T=2048, t_tile=512
+    )
+    np.testing.assert_allclose(np.asarray(c_one), np.asarray(c_split), atol=2e-5)
+    np.testing.assert_allclose(np.asarray(c_one), np.asarray(c_fused), atol=2e-5)
+    np.testing.assert_allclose(np.asarray(c_one), np.asarray(c_dense), atol=2e-5)
+
+
+def test_posterior_one_pass_want_path(rng):
+    """The MPM argmax is scale-free in the matrix-carried directions: paths
+    must match the split arm exactly (same argmax inputs modulo per-position
+    positive scales)."""
+    params, obs = _obs(rng, 10000)
+    kw = dict(lane_T=2048, t_tile=512, onehot=True, want_path=True)
+    c_s, p_s = fb_pallas.seq_posterior_pallas(
+        params, obs, obs.shape[0], MASK8, fused=False, **kw
+    )
+    c_o, p_o = fb_pallas.seq_posterior_pallas(
+        params, obs, obs.shape[0], MASK8, one_pass=True, **kw
+    )
+    np.testing.assert_allclose(np.asarray(c_o), np.asarray(c_s), atol=2e-5)
+    assert np.array_equal(np.asarray(p_o), np.asarray(p_s))
+
+
+def test_posterior_one_pass_span_continuation(rng):
+    """Span-threaded continuation (enter/exit dirs + prev_sym) through the
+    one-pass arm matches the split arm — the entry direction enters only
+    through the elementwise contraction epilogue, never the kernel."""
+    params, obs = _obs(rng, 12000)
+    span = 6000
+    piece = obs[span:]
+    enter = np.abs(np.random.default_rng(1).normal(size=8)).astype(np.float32)
+    enter /= enter.sum()
+    kw = dict(
+        enter_dir=jnp.asarray(enter), exit_dir=None, first=False,
+        lane_T=2048, t_tile=512, onehot=True,
+        prev_sym=jnp.int32(int(obs[span - 1])),
+    )
+    c_s, _ = fb_pallas.seq_posterior_pallas(
+        params, piece, piece.shape[0], MASK8, fused=False, **kw
+    )
+    c_o, _ = fb_pallas.seq_posterior_pallas(
+        params, piece, piece.shape[0], MASK8, one_pass=True, **kw
+    )
+    np.testing.assert_allclose(np.asarray(c_o), np.asarray(c_s), atol=2e-5)
+
+
+def test_posterior_sharded_one_pass_parity(rng):
+    """The driver entry over the full device mesh: one_pass=True vs False,
+    plus the dense-engine cross-check."""
+    params, obs = _obs(rng, 8 * 1024 + 77)
+    isl = (0, 1, 2, 3)
+    c_f, _ = posterior_sharded(
+        params, np.asarray(obs), isl, engine="onehot", one_pass=False
+    )
+    c_o, _ = posterior_sharded(
+        params, np.asarray(obs), isl, engine="onehot", one_pass=True
+    )
+    c_x, _ = posterior_sharded(
+        params, np.asarray(obs), isl, engine="xla", block_size=256
+    )
+    np.testing.assert_allclose(np.asarray(c_o), np.asarray(c_f), atol=2e-5)
+    np.testing.assert_allclose(np.asarray(c_o), np.asarray(c_x), atol=2e-5)
+
+
+# --- EM: one-pass znorm stats vs the 2-pass arms -----------------------------
+
+
+def test_seq_stats_one_pass_parity(rng):
+    params, obs = _obs(rng, 12001)
+    kw = dict(lane_T=2048, onehot=True)
+    s_split = fb_pallas.seq_stats_pallas(
+        params, obs, obs.shape[0], fused=False, **kw
+    )
+    s_fused = fb_pallas.seq_stats_pallas(
+        params, obs, obs.shape[0], fused=True, **kw
+    )
+    s_one = fb_pallas.seq_stats_pallas(
+        params, obs, obs.shape[0], one_pass=True, **kw
+    )
+    s_dense = fb_pallas.seq_stats_pallas(params, obs, obs.shape[0], lane_T=2048)
+    _assert_stats_close(s_one, s_split)
+    _assert_stats_close(s_one, s_fused)
+    _assert_stats_close(s_one, s_dense)
+
+
+def test_seq_stats_one_pass_dinuc32(rng):
+    """The order-2 family member: K=32 one-hot over the 16-symbol pair
+    alphabet rides the same matrix-carried kernel (pow2-S reduced stats)."""
+    params = presets.dinuc_cpg()
+    obs = jnp.asarray(_pair_record(8000, seed=11).astype(np.int32))
+    kw = dict(lane_T=1024, onehot=True)
+    s_split = fb_pallas.seq_stats_pallas(
+        params, obs, obs.shape[0], fused=False, **kw
+    )
+    s_one = fb_pallas.seq_stats_pallas(
+        params, obs, obs.shape[0], one_pass=True, **kw
+    )
+    _assert_stats_close(s_one, s_split)
+
+
+def test_seq_backend_one_pass_fit_trajectory(rng):
+    """End-to-end: a Baum-Welch fit through SeqBackend(one_pass=True)
+    reproduces the 2-pass trajectory (the training-path acceptance for the
+    products fold)."""
+    from cpgisland_tpu.train import baum_welch
+    from cpgisland_tpu.train.backends import SeqBackend
+    from cpgisland_tpu.utils import chunking
+
+    params, obs = _obs(rng, 8 * 1024)
+    chunked = chunking.Chunked(
+        chunks=np.asarray(obs)[None, :],
+        lengths=np.asarray([obs.shape[0]], np.int32),
+        total=obs.shape[0],
+    )
+    res = {}
+    for one_pass in (False, True):
+        backend = SeqBackend(
+            engine="onehot", lane_T=512, t_tile=256, one_pass=one_pass
+        )
+        res[one_pass] = baum_welch.fit(
+            params, chunked, num_iters=2, convergence=0.0, backend=backend
+        )
+    np.testing.assert_allclose(
+        np.asarray(res[True].logliks), np.asarray(res[False].logliks),
+        rtol=1e-5,
+    )
+
+
+@pytest.mark.slow
+def test_seq2d_backend_one_pass_parity(rng):
+    """The 2-D (records x time) whole-sequence layout threads one_pass
+    through sharded_stats2d_fn — ragged two-record group."""
+    from cpgisland_tpu.train import backends
+    from cpgisland_tpu.utils import chunking
+
+    params = presets.durbin_cpg8()
+    r = np.random.default_rng(5)
+    obs2 = r.integers(0, 4, size=(2, 1 << 12), dtype=np.uint8)
+    lens2 = np.asarray([1 << 12, (1 << 12) - 77], np.int32)
+    stats = {}
+    for one_pass in (False, True):
+        be = backends.Seq2DBackend(engine="onehot", one_pass=one_pass)
+        ch = be.prepare(chunking.Chunked(
+            chunks=obs2, lengths=lens2, total=int(lens2.sum())
+        ))
+        o, l = be.place(ch.chunks, ch.lengths)
+        stats[one_pass] = be(params, o, l)
+    _assert_stats_close(stats[True], stats[False])
+
+
+# --- prepared streams + dispatch surface -------------------------------------
+
+
+def test_one_pass_prepared_vs_inline_bit_identical(rng):
+    """The matrix kernel consumes the SAME pair2/pairn2 prepared fields as
+    the 2-pass arm — no new prepared stream, so prepared-vs-inline stays
+    bit-identical on the one-pass arm too."""
+    params, obs = _obs(rng, 6000)
+    kw = dict(lane_T=512, t_tile=256, onehot=True)
+    prep = prepared.for_seq(4, obs, 6000, **kw)
+    s_inline = fb_pallas.seq_stats_pallas(
+        params, obs, 6000, one_pass=True, **kw
+    )
+    s_prep = fb_pallas.seq_stats_pallas(
+        params, obs, 6000, one_pass=True, prepared=prep, **kw
+    )
+    for f in ("init", "trans", "emit"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(s_inline, f)), np.asarray(getattr(s_prep, f))
+        )
+    assert float(s_inline.loglik) == float(s_prep.loglik)
+
+    c_inline, _ = fb_pallas.seq_posterior_pallas(
+        params, obs, 6000, MASK8, one_pass=True, **kw
+    )
+    c_prep, _ = fb_pallas.seq_posterior_pallas(
+        params, obs, 6000, MASK8, one_pass=True, prepared=prep, **kw
+    )
+    np.testing.assert_array_equal(np.asarray(c_inline), np.asarray(c_prep))
+
+
+def test_one_pass_zero_fresh_compiles_steady_state(rng):
+    """Steady state on the one-pass arm: new params (an M-step away), same
+    shapes and prep — zero fresh compiles on both entries."""
+    import dataclasses
+
+    from cpgisland_tpu import obs as obs_mod
+
+    params, obs = _obs(rng, 6000)
+    kw = dict(lane_T=512, t_tile=256, onehot=True)
+    prep = prepared.for_seq(4, obs, 6000, **kw)
+    jax.block_until_ready(fb_pallas.seq_stats_pallas(
+        params, obs, 6000, one_pass=True, prepared=prep, **kw
+    ).trans)
+    jax.block_until_ready(fb_pallas.seq_posterior_pallas(
+        params, obs, 6000, MASK8, one_pass=True, prepared=prep, **kw
+    )[0])
+    params2 = dataclasses.replace(params, log_pi=params.log_pi - 1e-6)
+    with obs_mod.no_new_compiles("one-pass-steady-state"):
+        jax.block_until_ready(fb_pallas.seq_stats_pallas(
+            params2, obs, 6000, one_pass=True, prepared=prep, **kw
+        ).trans)
+        jax.block_until_ready(fb_pallas.seq_posterior_pallas(
+            params2, obs, 6000, MASK8, one_pass=True, prepared=prep, **kw
+        )[0])
+
+
+# --- graftune consultation + bit-for-bit fallback ----------------------------
+
+
+@pytest.fixture
+def tmp_table(tmp_path):
+    path = str(tmp_path / "TUNING.json")
+    tune.set_table_path(path)
+    try:
+        yield path
+    finally:
+        tune.set_table_path(None)
+        tune.generation()
+
+
+def _plant(task, value, *, costs_entries, fingerprint=None):
+    key = tune_table.entry_key(task, None, None, 1)
+    entry = tune_table.make_entry(
+        task, value, legacy=None, costs_entries=costs_entries,
+        applied=True, projection=True,
+    )
+    if fingerprint is not None:
+        entry["costs_fingerprint"] = fingerprint
+    tune_table.write_entries({key: entry}, platform="cpu")
+    return key
+
+
+def test_one_pass_default_consultation(tmp_table):
+    from cpgisland_tpu.train.backends import Seq2DBackend, SeqBackend
+
+    # Shipped legacy: the 2-pass fused arm (the one-pass trade is only
+    # decidable on silicon).
+    assert tune.default_one_pass("posterior") is False
+    assert tune.default_one_pass("em_seq") is False
+    assert SeqBackend().one_pass is False
+    assert Seq2DBackend().one_pass is False
+    _plant("one_pass.em_seq", True, costs_entries=["em.seq.onehot.onepass"])
+    assert tune.default_one_pass("em_seq") is True
+    assert SeqBackend().one_pass is True
+    assert Seq2DBackend().one_pass is True
+    # Explicit always wins.
+    assert SeqBackend(one_pass=False).one_pass is False
+
+
+def test_one_pass_stale_fingerprint_falls_back_bitwise(tmp_table, rng):
+    """A fingerprint-drifted one_pass winner must NOT route: the default
+    arm stays bit-for-bit the legacy 2-pass fused arm."""
+    params, obs = _obs(rng, 8 * 1024)
+    isl = (0, 1, 2, 3)
+    kw = dict(engine="onehot", lane_T=512, t_tile=256)
+    c_false, _ = posterior_sharded(
+        params, np.asarray(obs), isl, one_pass=False, **kw
+    )
+    _plant(
+        "one_pass.posterior", True,
+        costs_entries=["posterior.onehot.onepass"],
+        fingerprint="sha256:deadbeefdeadbeef",
+    )
+    assert tune.default_one_pass("posterior") is False
+    c_default, _ = posterior_sharded(params, np.asarray(obs), isl, **kw)
+    np.testing.assert_array_equal(np.asarray(c_default), np.asarray(c_false))
+    rep = tune_table.table_report(platform="cpu")
+    assert rep["stale"] == 1
+    assert "fingerprint drifted" in rep["stale_entries"][0]["reason"]
+
+
+def test_one_pass_fresh_winner_routes(tmp_table, rng):
+    """A FRESH applied winner flips the default arm to the one-pass kernel:
+    the default output becomes bit-identical to explicit one_pass=True."""
+    params, obs = _obs(rng, 8 * 1024)
+    isl = (0, 1, 2, 3)
+    kw = dict(engine="onehot", lane_T=512, t_tile=256)
+    c_true, _ = posterior_sharded(
+        params, np.asarray(obs), isl, one_pass=True, **kw
+    )
+    _plant(
+        "one_pass.posterior", True,
+        costs_entries=["posterior.onehot.onepass"],
+    )
+    assert tune.default_one_pass("posterior") is True
+    c_default, _ = posterior_sharded(params, np.asarray(obs), isl, **kw)
+    np.testing.assert_array_equal(np.asarray(c_default), np.asarray(c_true))
+
+
+# --- memmodel: the stacked verdict -------------------------------------------
+
+
+def test_matrix_kernel_memmodel_verdicts():
+    """The matrix kernel's VMEM row: feasible at M=1/256-lane tiles,
+    INFEASIBLE at the stacked M=3 — the reason posterior_sharded_stacked
+    and the stacked decoder stay on the 2-pass arm."""
+    from cpgisland_tpu.analysis import memmodel
+
+    k1 = memmodel.Knobs(lane_tile=256)
+    v1 = memmodel.feasible("fb.fwdbwdmat.onehot", k1)
+    assert v1.ok, (v1.total, v1.limit)
+    k3 = memmodel.Knobs(lane_tile=256, stacked_m=3)
+    v3 = memmodel.feasible("fb.fwdbwdmat.onehot", k3)
+    assert not v3.ok
+    assert v3.total > v1.total
+    assert memmodel.max_stacked_m("fb.fwdbwdmat.onehot", k1) == 1
+    # Not a stacked-routing kernel: the stacked drivers never consult it.
+    assert "fb.fwdbwdmat.onehot" not in memmodel.STACKED_KERNELS

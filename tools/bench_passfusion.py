@@ -9,10 +9,14 @@ discipline): identical inputs, correctness-gated both arms, chained
 timing, per-path plausibility ceilings — run it on the capturing TPU
 before trusting any committed number.
 
-Phases (each fused-vs-split on the SAME input):
-  posterior   — seq_posterior_pallas conf path (3 -> 2 passes)
-  em-seq      — seq_stats_pallas whole-sequence E-step (3 -> 2 passes)
-  em-chunked  — batch_stats_pallas reference-framing E-step (2 -> 1 pass)
+Phases (each split/fused[/one_pass] on the SAME input):
+  posterior   — seq_posterior_pallas conf path (3 -> 2 -> 1 passes; the
+                one_pass arm is the ISSUE 17 matrix-carried kernel with
+                the products pass folded in)
+  em-seq      — seq_stats_pallas whole-sequence E-step (3 -> 2 -> 1)
+  em-chunked  — batch_stats_pallas reference-framing E-step (2 -> 1 pass;
+                no one_pass arm — the chunked layout never ran a
+                standalone products pass)
   decode      — per-PASS wall decomposition of the 3-pass max-plus decode
                 (products / +backpointers / +backtrace): the accounting
                 that says what fraction each pass contributes; decode's
@@ -98,7 +102,12 @@ def bench_posterior(params, n, *, chain, reps, ceiling, lane_T, t_tile):
     obs = jnp.asarray(rng.integers(0, 4, size=n, dtype=np.int32).astype(np.uint8))
     mask = jnp.asarray(np.r_[np.ones(4), np.zeros(4)].astype(np.float32))
 
-    def make(fused):
+    ARMS = {"split": dict(fused=False), "fused": dict(fused=True),
+            "one_pass": dict(one_pass=True)}
+
+    def make(arm):
+        kw = ARMS[arm]
+
         @jax.jit
         def chained(p, obs, s):
             p = _jitter(p, s)
@@ -106,7 +115,7 @@ def bench_posterior(params, n, *, chain, reps, ceiling, lane_T, t_tile):
             def body(c, _):
                 conf, _ = fb_pallas.seq_posterior_pallas(
                     p, obs, n, mask + c * 0.0, lane_T=lane_T, t_tile=t_tile,
-                    onehot=True, fused=fused,
+                    onehot=True, **kw,
                 )
                 return jnp.sum(conf[:8]) * 1e-9, None
 
@@ -115,21 +124,21 @@ def bench_posterior(params, n, *, chain, reps, ceiling, lane_T, t_tile):
 
         return chained
 
-    out = {}
-    # Correctness gate before timing: both arms on the same input.
-    c_s, _ = fb_pallas.seq_posterior_pallas(
-        params, obs, n, mask, lane_T=lane_T, t_tile=t_tile, onehot=True,
-        fused=False,
-    )
-    c_f, _ = fb_pallas.seq_posterior_pallas(
-        params, obs, n, mask, lane_T=lane_T, t_tile=t_tile, onehot=True,
-        fused=True,
-    )
-    err = float(jnp.max(jnp.abs(c_s - c_f)))
-    assert err < 2e-5, f"posterior fused vs split diverged: {err}"
-    log(f"posterior parity gate: max|conf diff| = {err:.2e}")
-    for fused in (False, True):
-        fn = make(fused)
+    out, raw = {}, {}
+    # Correctness gate before timing: every arm on the same input.
+    confs = {
+        arm: fb_pallas.seq_posterior_pallas(
+            params, obs, n, mask, lane_T=lane_T, t_tile=t_tile, onehot=True,
+            **kw,
+        )[0]
+        for arm, kw in ARMS.items()
+    }
+    for arm in ("fused", "one_pass"):
+        err = float(jnp.max(jnp.abs(confs["split"] - confs[arm])))
+        assert err < 2e-5, f"posterior {arm} vs split diverged: {err}"
+        log(f"posterior parity gate [{arm} vs split]: max|conf diff| = {err:.2e}")
+    for arm in ARMS:
+        fn = make(arm)
         jax.block_until_ready(fn(params, obs, jnp.int32(0)))
         best = _best_wall(
             lambda s, fn=fn: float(
@@ -139,10 +148,13 @@ def bench_posterior(params, n, *, chain, reps, ceiling, lane_T, t_tile):
         ) / chain
         tput = n / best
         _check_ceiling(tput, ceiling, "posterior")
-        arm = "fused" if fused else "split"
+        raw[arm] = tput
         out[arm] = round(tput / 1e6, 1)
         log(f"posterior [{arm}]: {tput / 1e6:8.1f} Msym/s ({best * 1e3:.2f} ms)")
-    out["ratio"] = round(out["fused"] / out["split"], 3)
+    out["ratio"] = round(raw["fused"] / raw["split"], 3)
+    # The decision number: flip one_pass.posterior only if this measures
+    # > 1.03 on the capturing TPU (graftune margin rule).
+    out["one_pass_ratio"] = round(raw["one_pass"] / raw["fused"], 3)
     return out
 
 
@@ -157,7 +169,12 @@ def bench_em_seq(params, n, *, chain, reps, ceiling, t_tile):
     obs = jnp.asarray(rng.integers(0, 4, size=n, dtype=np.int32).astype(np.uint8))
     lane_T = fb_pallas.pick_lane_T(n, onehot=True, long_lanes=True)
 
-    def make(fused):
+    ARMS = {"split": dict(fused=False), "fused": dict(fused=True),
+            "one_pass": dict(one_pass=True)}
+
+    def make(arm):
+        kw = ARMS[arm]
+
         @jax.jit
         def chained(p, obs, s):
             p = _jitter(p, s)
@@ -165,7 +182,7 @@ def bench_em_seq(params, n, *, chain, reps, ceiling, t_tile):
             def body(p, _):
                 st = fb_pallas.seq_stats_pallas(
                     p, obs, n, lane_T=lane_T, t_tile=t_tile, onehot=True,
-                    fused=fused,
+                    **kw,
                 )
                 p2, _ = em_update(p, st)
                 return p2, None
@@ -175,21 +192,22 @@ def bench_em_seq(params, n, *, chain, reps, ceiling, t_tile):
 
         return chained
 
-    s_s = fb_pallas.seq_stats_pallas(
-        params, obs, n, lane_T=lane_T, t_tile=t_tile, onehot=True, fused=False
-    )
-    s_f = fb_pallas.seq_stats_pallas(
-        params, obs, n, lane_T=lane_T, t_tile=t_tile, onehot=True, fused=True
-    )
-    err = float(
-        jnp.max(jnp.abs(s_s.trans - s_f.trans)
-                / jnp.maximum(jnp.abs(s_s.trans), 1e-3))
-    )
-    assert err < 1e-4, f"em-seq fused vs split diverged: {err}"
-    log(f"em-seq parity gate: max rel trans diff = {err:.2e}")
-    out = {"lane_T": lane_T}
-    for fused in (False, True):
-        fn = make(fused)
+    stats = {
+        arm: fb_pallas.seq_stats_pallas(
+            params, obs, n, lane_T=lane_T, t_tile=t_tile, onehot=True, **kw
+        )
+        for arm, kw in ARMS.items()
+    }
+    for arm in ("fused", "one_pass"):
+        err = float(
+            jnp.max(jnp.abs(stats["split"].trans - stats[arm].trans)
+                    / jnp.maximum(jnp.abs(stats["split"].trans), 1e-3))
+        )
+        assert err < 1e-4, f"em-seq {arm} vs split diverged: {err}"
+        log(f"em-seq parity gate [{arm} vs split]: max rel trans diff = {err:.2e}")
+    out, raw = {"lane_T": lane_T}, {}
+    for arm in ARMS:
+        fn = make(arm)
         jax.block_until_ready(fn(params, obs, jnp.int32(0)))
         best = _best_wall(
             lambda s, fn=fn: np.asarray(
@@ -199,10 +217,12 @@ def bench_em_seq(params, n, *, chain, reps, ceiling, t_tile):
         ) / chain
         tput = n / best
         _check_ceiling(tput, ceiling, "em-seq")
-        arm = "fused" if fused else "split"
+        raw[arm] = tput
         out[arm] = round(tput / 1e6, 1)
         log(f"em-seq [{arm}]: {tput / 1e6:8.1f} Msym/s/iter ({best * 1e3:.2f} ms)")
-    out["ratio"] = round(out["fused"] / out["split"], 3)
+    out["ratio"] = round(raw["fused"] / raw["split"], 3)
+    # Flip one_pass.em_seq on TPU only past the 3% graftune margin.
+    out["one_pass_ratio"] = round(raw["one_pass"] / raw["fused"], 3)
     return out
 
 
@@ -246,7 +266,7 @@ def bench_em_chunked(params, n, *, chain, reps, ceiling, chunk=1 << 16):
     )
     assert err < 1e-4, f"em-chunked fused vs split diverged: {err}"
     log(f"em-chunked parity gate: max rel trans diff = {err:.2e}")
-    out = {"n_chunks": n_chunks}
+    out, raw = {"n_chunks": n_chunks}, {}
     for fused in (False, True):
         fn = make(fused)
         jax.block_until_ready(fn(params, chunks, lengths, jnp.int32(0)))
@@ -259,9 +279,10 @@ def bench_em_chunked(params, n, *, chain, reps, ceiling, chunk=1 << 16):
         tput = total / best
         _check_ceiling(tput, ceiling, "em-chunked")
         arm = "fused" if fused else "split"
+        raw[arm] = tput
         out[arm] = round(tput / 1e6, 1)
         log(f"em-chunked [{arm}]: {tput / 1e6:8.1f} Msym/s/iter ({best * 1e3:.2f} ms)")
-    out["ratio"] = round(out["fused"] / out["split"], 3)
+    out["ratio"] = round(raw["fused"] / raw["split"], 3)
     return out
 
 
