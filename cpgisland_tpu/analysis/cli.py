@@ -15,6 +15,9 @@ on top of the per-file sync rules that already run in the lint layer.
 ``--mem`` adds Layer 5 — the memory pass (MEMORY.json lockfile diff +
 VMEM/HBM contracts), re-baselined with ``--update-mem``; ``--mem-table
 KERNEL`` prints one modeled kernel's VMEM buffer breakdown.
+``--scale`` adds Layer 6 — the scale pass (jaxpr homogeneity dataflow
+over the fused/one-pass direction consumers + the SCALE.json lockfile
+diff), re-baselined with ``--update-scale``.
 """
 
 from __future__ import annotations
@@ -86,6 +89,16 @@ def main(argv=None) -> int:
     ap.add_argument("--mem-table", default=None, metavar="KERNEL",
                     help="print the VMEM buffer breakdown for one modeled "
                     "kernel (e.g. fb.fwdbwd.onehot) and exit")
+    ap.add_argument("--scale", action="store_true",
+                    help="run the Layer-6 scale pass: derive homogeneity "
+                    "signatures for every registered fused/one-pass "
+                    "direction consumer, check the declared expectations, "
+                    "and diff against SCALE.json (imports jax)")
+    ap.add_argument("--update-scale", action="store_true",
+                    help="re-baseline SCALE.json from the live derivations "
+                    "and print a diff summary (implies --scale)")
+    ap.add_argument("--scale-file", default=None,
+                    help="scale lockfile path (default: <repo>/SCALE.json)")
     ap.add_argument("--tune", action="store_true",
                     help="report the graftune winner table (TUNING.json): "
                     "fresh vs stale winners for this platform, stale rows "
@@ -125,6 +138,12 @@ def main(argv=None) -> int:
                   "empirically on chip — 131072-lane assembly compile "
                   "failure, bk>=8192 scoped-VMEM, the 128 Mi shard, the "
                   "~15 GB island OOM; graftmem makes them static")
+        # Layer 6 (scale contracts) — same static metadata path.
+        from cpgisland_tpu.analysis import scale_contracts
+
+        for rule in scale_contracts.quantitative_rules():
+            print(f"{rule['name']}: {rule['description']}")
+            print(f"    origin: {rule['origin']}")
         return 0
 
     rc = 0
@@ -309,6 +328,37 @@ def main(argv=None) -> int:
                 f"graftmem: {report['diff']['checked']} entry point(s) + "
                 f"{report['diff']['kernels_checked']} kernel row(s) "
                 f"diffed, {len(report['contracts'])} mem contract(s), "
+                f"{'ok' if report['ok'] else 'VIOLATIONS'}",
+                file=sys.stderr,
+            )
+        if not report["ok"]:
+            rc = 1
+
+    if args.scale or args.update_scale:
+        _pin_platform(args.platform)
+        from cpgisland_tpu.analysis import scale_contracts
+
+        report = scale_contracts.run_scale_pass(
+            lockfile_path=args.scale_file, update=args.update_scale
+        )
+        if args.as_json:
+            payload["scale"] = report
+        else:
+            if report["updated"]:
+                summary = report.get("summary") or ["(no changes)"]
+                print(f"scale: re-baselined {report['path']}",
+                      file=sys.stderr)
+                for line in summary:
+                    print(f"    {line}", file=sys.stderr)
+            for v in report["violations"]:
+                print(f"scale violation: {v}")
+            for v in report["diff"]["violations"]:
+                print(f"scale drift: {v}")
+            for n in report["diff"]["notes"]:
+                print(f"note: {n}", file=sys.stderr)
+            print(
+                f"graftscale: {report['diff']['checked']} entry point(s) "
+                f"diffed, {len(report['diff']['stale'])} stale, "
                 f"{'ok' if report['ok'] else 'VIOLATIONS'}",
                 file=sys.stderr,
             )

@@ -76,9 +76,23 @@ GROUP = 2          # reduced chain components (the r4 one-hot reduction)
 DOUBLE = 2
 
 
+# Remote compile ships program bytes over HTTP: a jit-baked 256 MiB
+# constant = HTTP 413 at the relay (CLAUDE.md).  The budget holds a wide
+# margin under the cliff — closures should carry tables, never data;
+# big arrays are ARGUMENTS.
+REMOTE_CONST_CLIFF = 256 << 20
+REMOTE_CONST_MARGIN = 8
+
+
 def vmem_limit() -> int:
     """The modeled per-kernel VMEM budget (16 MiB minus the reserve)."""
     return int(VMEM_BYTES * (1.0 - VMEM_RESERVE))
+
+
+def remote_const_budget() -> int:
+    """Max total baked-constant bytes a traced program may carry before
+    the remote-compile HTTP 413 cliff is a risk (cliff / margin)."""
+    return REMOTE_CONST_CLIFF // REMOTE_CONST_MARGIN
 
 
 def hbm_limit() -> int:

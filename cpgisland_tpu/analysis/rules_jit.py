@@ -14,6 +14,23 @@ value (and a shape-varying arg recompiles per shape).  Any parameter that
 is int/bool/str-annotated or int/bool/str-defaulted must appear in
 ``static_argnums``/``static_argnames`` — or the call site must bucket it
 (pow2 record bucketing, chunking.bucket_records).
+
+R7 — ``jit-const-capture``: a **host** numpy array constructed INSIDE a
+traced body (``np.zeros((1<<20, 64))`` in a jit/pallas target) is not an
+op — it becomes a jaxpr constvar baked into the compiled module, the same
+HTTP 413 axis as R1 but invisible to R1's closure analysis.  Flagged when
+the element count is statically estimable and the byte size reaches
+memmodel's remote-compile constant budget (the 256 MiB cliff / margin);
+``jnp.*`` constructors are traced ops and exempt.  The jaxpr half of the
+same check runs in Layer 6 (scale_contracts' per-entry const_bytes).
+
+R8 — ``trace-time-consult``: graftune's "consultation is HOST-side only"
+rule.  A ``tune.lookup``/``pick_lane_T``-style call reachable from inside
+a traced body freezes the pre-sweep winner into the jit cache — the
+program never retraces when TUNING.json updates, so an applied sweep
+silently doesn't apply.  Consult host-side and pass the resolved knob as
+an explicit (static) argument; in-trace fallbacks use the PURE heuristics
+(``legacy_lane_T``) only.
 """
 
 from __future__ import annotations
@@ -273,3 +290,226 @@ def _default_for(fn: ast.AST, index: int, n_params: int) -> Optional[ast.AST]:
     if k_index < len(a.kwonlyargs):
         return a.kw_defaults[k_index]
     return None
+
+
+# -- R7: jit-const-capture ---------------------------------------------------
+
+# Host-numpy prefixes whose constructor results are CONSTANTS under trace
+# (jnp.* constructors are traced ops and exempt).
+HOST_ARRAY_MODULES = ("np.", "numpy.")
+
+_DTYPE_BYTES = {
+    "float64": 8, "double": 8, "float32": 4, "single": 4, "float16": 2,
+    "half": 2, "bfloat16": 2, "int64": 8, "int32": 4, "int16": 2,
+    "int8": 1, "uint64": 8, "uint32": 4, "uint16": 2, "uint8": 1,
+    "bool": 1, "bool_": 1, "complex64": 8, "complex128": 16,
+}
+_NUMPY_DEFAULT_BYTES = 8  # host numpy defaults to float64/int64
+
+
+def _const_int(node: ast.AST) -> Optional[int]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, int) \
+            and not isinstance(node.value, bool):
+        return node.value
+    if isinstance(node, ast.BinOp) and isinstance(node.op, ast.LShift):
+        lo, hi = _const_int(node.left), _const_int(node.right)
+        return lo << hi if lo is not None and hi is not None else None
+    if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Mult):
+        lo, hi = _const_int(node.left), _const_int(node.right)
+        return lo * hi if lo is not None and hi is not None else None
+    return None
+
+
+def _shape_elems(node: ast.AST) -> Optional[int]:
+    """Element count of a statically-written shape (int or tuple of ints)."""
+    n = _const_int(node)
+    if n is not None:
+        return n
+    if isinstance(node, (ast.Tuple, ast.List)):
+        total = 1
+        for el in node.elts:
+            d = _const_int(el)
+            if d is None:
+                return None
+            total *= d
+        return total
+    return None
+
+
+def _dtype_bytes(call: ast.Call) -> int:
+    for kw in call.keywords:
+        if kw.arg != "dtype":
+            continue
+        v = kw.value
+        name = None
+        if isinstance(v, ast.Attribute):
+            name = v.attr
+        elif isinstance(v, ast.Name):
+            name = v.id
+        elif isinstance(v, ast.Constant) and isinstance(v.value, str):
+            name = v.value
+        if name in _DTYPE_BYTES:
+            return _DTYPE_BYTES[name]
+    return _NUMPY_DEFAULT_BYTES
+
+
+def _host_const_bytes(ctx: FileContext, node: ast.AST) -> Optional[int]:
+    """Statically-estimable byte size of a host-numpy constructor call
+    inside a traced body, else None (unestimable stays quiet)."""
+    if not isinstance(node, ast.Call):
+        return None
+    name = ctx.call_name(node)
+    if name is None or not name.startswith(HOST_ARRAY_MODULES):
+        return None
+    tail = name.rsplit(".", 1)[-1]
+    if tail not in ARRAY_MAKERS:
+        return None
+    elems: Optional[int] = None
+    if tail in ("zeros", "ones", "empty", "full", "broadcast_to") and node.args:
+        elems = _shape_elems(node.args[0])
+    elif tail in ("arange", "linspace") and node.args:
+        if len(node.args) == 1:
+            elems = _const_int(node.args[0])
+        elif len(node.args) >= 2:
+            lo, hi = _const_int(node.args[0]), _const_int(node.args[1])
+            if lo is not None and hi is not None:
+                elems = max(hi - lo, 0)
+    elif tail in ("eye", "identity") and node.args:
+        n = _const_int(node.args[0])
+        if n is not None:
+            m = _const_int(node.args[1]) if len(node.args) > 1 else n
+            elems = n * m if m is not None else None
+    elif tail in ("array", "asarray") and node.args:
+        elems = _literal_size(node.args[0])
+    if elems is None:
+        return None
+    return elems * _dtype_bytes(node)
+
+
+def _traced_targets(ctx: FileContext):
+    """Every (reason, def/Lambda node) the tracer reaches in this file:
+    jit/pjit targets, pallas_call kernels, and defs handed to lax control
+    flow / transparent combinators (scan bodies, shard_map'd fns — the
+    fb_sharded pattern where the jit wrapper lives in another function)."""
+    for report, target, _names, _nums in _jit_sites(ctx):
+        if target is not None:
+            yield "jit target", target
+    combinators = TRACE_COMBINATORS | TRANSPARENT | PALLAS_CALL_NAMES
+    passed_names: set[str] = set()
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = ctx.call_name(node)
+        if not astutil.matches(name, combinators):
+            continue
+        short = (name or "?").rsplit(".", 1)[-1]
+        for arg in node.args:
+            resolved = _unwrap_target(ctx, arg)
+            if resolved is not None:
+                yield f"passed to {short}", resolved
+            elif isinstance(arg, ast.Name):
+                passed_names.add(arg.id)
+    if passed_names:
+        # Fall back to name matching for targets _unwrap_target can't
+        # resolve across function boundaries (`body = _make_body(...)`
+        # then `shard_map(body, ...)` in a sibling function): any def
+        # sharing a passed name is conservatively traced.
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and node.name in passed_names:
+                yield "passed by name to a traced combinator", node
+
+
+TRACE_COMBINATORS = frozenset({
+    "jax.lax.scan", "lax.scan", "jax.lax.while_loop", "lax.while_loop",
+    "jax.lax.cond", "lax.cond", "jax.lax.fori_loop", "lax.fori_loop",
+    "jax.lax.switch", "lax.switch", "jax.lax.map", "lax.map",
+    "jax.lax.associative_scan", "lax.associative_scan",
+})
+
+
+@register(
+    "jit-const-capture",
+    "host-numpy arrays built INSIDE traced bodies become jaxpr constvars "
+    "baked into the compiled module; estimable constructions at/above the "
+    "memmodel remote-const budget must move out (traced argument or jnp)",
+    origin="CLAUDE.md: remote compile ships program bytes over HTTP; a "
+    "256 MiB baked constant = HTTP 413 — R1 catches closures, this "
+    "catches in-body np.* construction (Layer 6 checks the jaxpr side)",
+)
+def check_jit_const_capture(ctx: FileContext) -> Iterator[Finding]:
+    from cpgisland_tpu.analysis import memmodel
+
+    budget = memmodel.remote_const_budget()
+    seen: set[int] = set()
+    for reason, target in _traced_targets(ctx):
+        for node in ast.walk(target):
+            size = _host_const_bytes(ctx, node)
+            if size is None or size < budget:
+                continue
+            if node.lineno in seen:
+                continue
+            seen.add(node.lineno)
+            yield ctx.finding(
+                "jit-const-capture",
+                node,
+                f"host-numpy constant of ~{size >> 20} MiB built inside a "
+                f"traced body ({reason}): it bakes into the compiled "
+                f"module as a constvar (budget {budget >> 20} MiB, the "
+                "HTTP 413 cliff) — build with jnp.* or pass it as a "
+                "traced argument",
+            )
+
+
+# -- R8: trace-time-consult --------------------------------------------------
+
+# Knob-consultation calls that freeze their answer into the jit cache when
+# reached from a traced body.  Matched on the canonical dotted name's tail
+# two components (module-alias-proof); bare-name calls match the tail.
+CONSULT_NAMES = frozenset({
+    "tune.lookup", "tune.tuned_lane_T", "tune.generation",
+    "tune.default_fused", "tune.default_one_pass", "tune.default_stacked",
+    "tune.default_block_size", "tune.default_t_tile", "tune.default_engine",
+})
+CONSULT_TAILS = frozenset({"pick_lane_T"})
+
+
+def _is_consult(name: Optional[str]) -> bool:
+    if name is None:
+        return False
+    parts = name.split(".")
+    if parts[-1] in CONSULT_TAILS:
+        return True
+    return ".".join(parts[-2:]) in CONSULT_NAMES or name in CONSULT_NAMES
+
+
+@register(
+    "trace-time-consult",
+    "graftune consultation (tune.lookup/tuned_lane_T/default_*/"
+    "pick_lane_T) must stay HOST-side: a consult reachable from a traced "
+    "body freezes the pre-sweep winner into the jit cache",
+    origin="CLAUDE.md graftune RULES: a trace-time lookup freezes "
+    "pre-sweep knobs into the jit cache — an applied sweep silently "
+    "never applies; resolve host-side, pass the knob as a static arg "
+    "(in-trace fallbacks use the pure legacy heuristics)",
+)
+def check_trace_time_consult(ctx: FileContext) -> Iterator[Finding]:
+    seen: set[int] = set()
+    for reason, target in _traced_targets(ctx):
+        for node in ast.walk(target):
+            if not isinstance(node, ast.Call):
+                continue
+            name = ctx.call_name(node)
+            if not _is_consult(name):
+                continue
+            if node.lineno in seen:
+                continue
+            seen.add(node.lineno)
+            yield ctx.finding(
+                "trace-time-consult",
+                node,
+                f"tuning consultation {name!r} inside a traced body "
+                f"({reason}): the winner freezes into the jit cache at "
+                "trace time and TUNING.json updates never apply — "
+                "consult host-side and pass the knob explicitly",
+            )

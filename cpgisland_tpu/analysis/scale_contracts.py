@@ -1,0 +1,700 @@
+"""graftcheck Layer 6 — scale-signature contracts + the SCALE.json lockfile.
+
+:mod:`~cpgisland_tpu.analysis.scalemodel` is the engine; this module is
+the registry + lockfile: every Layer-2 entry that consumes the fused /
+one-pass self-normalized beta directions gets a consumer-level trace
+with the beta stream as an EXPLICIT tagged argument, the dataflow derives
+its scale signature, and the signature is checked against the DECLARED
+expectation (the ops modules' ``SCALE_TAGS`` tables) and against the
+committed ``SCALE.json``.
+
+Why consumer-level traces rather than marker primitives: graftcost pins
+``n_eqns`` with tolerance 0 on every shipped entry — a tagging primitive
+inside the shipped graphs would drift every cost fingerprint.  The
+consumers here take their beta streams as arguments, so tagging is free,
+and engine parity (XLA twin == Pallas kernel, both platforms) is already
+pinned by Layer 2/tests — certifying the twins certifies the contract
+arithmetic of the kernels.
+
+The two contract families:
+
+- ``scale.free-consumers`` — entries consuming self-normalized directions
+  (posterior fused/one-pass conf+MPM, the em-seq/em-chunked znorm stats,
+  the one-pass matrix epilogues) must derive scale-FREE outputs in the
+  tagged betas.  The r9 chunked pairing bug (cs-scaled stats kernel fed
+  self-normalized betas) derives ``deg:1`` here and is a finding.
+- ``scale.exact-arms`` — the exact arms declare their INTENDED nonzero
+  signature and the dataflow must confirm it: the split-pass cs-scaled
+  stats kernel's ``macc`` is degree 1 in its cs-scaled betas, the flat
+  decode's true-score return is degree 1 in a ``log_pi`` offset (max-plus
+  mode) while its path stays free, and ``mat_loglik_lanes`` is pinned
+  log-domain (``mixed`` — its exactness is the telescoping identity,
+  runtime-parity-tested, not a homogeneity fact).
+
+The lockfile follows the COSTS.json conventions (per-platform sections,
+atomic replace, drift names the entry); staleness follows TUNING.json:
+every entry is stamped with the :func:`tune.table.costs_fingerprint` of
+the COSTS.json entries its kernels live under, so a kernel reshape that
+re-baselines graftcost automatically STALES the scale signature — a
+stale entry degrades to a report-only note (routing is never touched;
+re-derive with ``--update-scale``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Callable, Optional
+
+LOCKFILE_VERSION = 1
+LOCKFILE_NAME = "SCALE.json"
+
+
+def default_lockfile_path() -> str:
+    here = os.path.dirname(os.path.abspath(__file__))
+    return os.path.join(os.path.dirname(os.path.dirname(here)), LOCKFILE_NAME)
+
+
+# ---------------------------------------------------------------------------
+# Rule metadata (for --list-rules; must not import jax).
+
+_QUANT_RULES = (
+    ("scale.free-consumers",
+     "every registered consumer of self-normalized beta directions "
+     "(posterior fused/one-pass conf+MPM, em-seq/em-chunked znorm stats, "
+     "the one-pass matrix epilogues) derives scale-FREE outputs in the "
+     "tagged beta stream",
+     "r9: the co-scheduled backward self-normalizes, so fused betas are "
+     "per-position directions; pairing them with the cs-scaled chunked "
+     "stats kernel was a documented-but-unchecked bug class"),
+    ("scale.exact-arms",
+     "exact arms declare and verify their intended nonzero scale degree: "
+     "split-pass cs-scaled macc = deg 1 in betas, flat-decode true scores "
+     "= deg 1 in a log_pi offset (paths free), mat_loglik_lanes pinned "
+     "log-domain",
+     "true-score returns and cs-scaled stats are EXACT by scale "
+     "bookkeeping — a signature drift means the bookkeeping moved"),
+    ("scale.lockfile",
+     "per-entry scale signatures match the committed SCALE.json; entries "
+     "whose dependent COSTS.json fingerprint drifted degrade to "
+     "report-only staleness notes (the TUNING.json freshness rule)",
+     "kernel reshapes must re-derive, not silently re-certify"),
+    ("scale.const-bytes",
+     "no registered entry bakes constvars above memmodel's remote-compile "
+     "constant budget into its traced graph",
+     "a 256 MiB baked constant = HTTP 413 at the remote-compile relay "
+     "(CLAUDE.md)"),
+)
+
+
+def quantitative_rules() -> list:
+    """Static rule metadata for --list-rules (no jax import)."""
+    return [
+        {"name": n, "description": d, "origin": o} for n, d, o in _QUANT_RULES
+    ]
+
+
+# ---------------------------------------------------------------------------
+# The entry registry.
+
+
+@dataclasses.dataclass(frozen=True)
+class ScaleEntry:
+    """One certified consumer: a traceable fn with explicit tagged args."""
+
+    name: str                 # keyed like the Layer-2/COSTS.json entries
+    tagged: str               # human label of the tagged input
+    mode: str                 # "linear" (prob space) | "maxplus" (log space)
+    outputs: tuple            # output names, aligned with the fn's returns
+    expect: dict              # output name -> "free" | "deg:k" | "mixed"
+    costs_entries: tuple      # COSTS.json entries whose fingerprint keys staleness
+    make: Callable            # () -> (fn, args, tagged_argnums)
+    note: str = ""
+    tags_key: str = ""        # "<ops module>:<SCALE_TAGS key>" cross-check
+
+
+def _declared_tags(tags_key: str) -> dict:
+    """Resolve an ops module's SCALE_TAGS declaration for cross-checking
+    (the registration hook: the expectation lives NEXT TO the kernel)."""
+    mod_name, _, key = tags_key.partition(":")
+    import importlib
+
+    mod = importlib.import_module(f"cpgisland_tpu.ops.{mod_name}")
+    return mod.SCALE_TAGS[key]
+
+
+def check_declarations(entries=None) -> list:
+    """Every entry with a tags_key must agree with the ops module's
+    SCALE_TAGS declaration (tagged input, mode, per-output expectation) —
+    a mismatch means the registry and the kernel-side contract drifted
+    apart.  Pure metadata: no tracing, no devices."""
+    if entries is None:
+        entries = default_entries()
+    problems = []
+    for e in entries:
+        if not e.tags_key:
+            continue
+        try:
+            decl = _declared_tags(e.tags_key)
+        except (ImportError, KeyError, AttributeError) as exc:
+            problems.append(
+                f"{e.name}: tags_key '{e.tags_key}' unresolvable: {exc!r}")
+            continue
+        if decl.get("mode", "linear") != e.mode:
+            problems.append(
+                f"{e.name}: mode {e.mode!r} != declared "
+                f"{decl.get('mode')!r} at {e.tags_key}")
+        if decl.get("tagged") != e.tagged:
+            problems.append(
+                f"{e.name}: tagged {e.tagged!r} != declared "
+                f"{decl.get('tagged')!r} at {e.tags_key}")
+        if decl.get("outputs") != e.expect:
+            problems.append(
+                f"{e.name}: expectation {e.expect} != declared "
+                f"{decl.get('outputs')} at {e.tags_key}")
+    return problems
+
+
+def _flagship():
+    from cpgisland_tpu.models import presets
+
+    return presets.durbin_cpg8()
+
+
+def _reduced_streams(Tp=16, NL=4, seed=0):
+    """Small positive reduced streams + pair/length plumbing for the
+    consumer traces (values are irrelevant to the dataflow — only shapes
+    and the graph structure matter)."""
+    import numpy as np
+    import jax.numpy as jnp
+
+    from cpgisland_tpu.ops import fb_onehot
+
+    params = _flagship()
+    S, K = params.n_symbols, params.n_states
+    gt = fb_onehot._groups(params)
+    rng = np.random.default_rng(seed)
+
+    def pos(shape):
+        return jnp.asarray(rng.uniform(0.1, 1.0, shape).astype(np.float32))
+
+    pair2 = jnp.asarray(rng.integers(0, S * S, size=(Tp, NL)).astype(np.int32))
+    return dict(
+        params=params, S=S, K=K, gt=gt, Tp=Tp, NL=NL,
+        pair2=pair2,
+        esym2=fb_onehot.decode_esym(pair2, S),
+        lens2=jnp.full((1, NL), Tp, jnp.int32),
+        al2=pos((Tp, 2, NL)), b2=pos((Tp, 2, NL)),
+        alK=pos((Tp, K, NL)), bK=pos((Tp, K, NL)),
+        va=pos((Tp, fb_onehot.GROUP * fb_onehot.GROUP, NL)),
+        a0=pos((K, NL)), b0=pos((K, NL)),
+        enters_red=pos((fb_onehot.GROUP, NL)),
+        enters_full=pos((K, NL)),
+        pair0_mask=jnp.ones((1, NL), jnp.float32),
+        conf_mask=jnp.asarray(
+            rng.integers(0, 2, K).astype(np.float32)),
+    )
+
+
+def _mk_posterior_fused():
+    from cpgisland_tpu.ops import fb_pallas
+
+    s = _reduced_streams()
+
+    def fn(alphas, betas):
+        return fb_pallas._conf_path_from_streams(
+            alphas, betas, s["lens2"], s["conf_mask"])
+
+    return fn, (s["alK"], s["bK"]), (1,)
+
+
+def _mk_conf_reduced():
+    from cpgisland_tpu.ops import fb_onehot
+
+    s = _reduced_streams()
+
+    def fn(al2, b2):
+        return fb_onehot.conf_from_reduced(
+            al2, b2, s["esym2"], s["lens2"], s["conf_mask"], s["gt"])
+
+    return fn, (s["al2"], s["b2"]), (1,)
+
+
+def _mk_znorm_stats(chunked: bool):
+    import jax.numpy as jnp
+
+    from cpgisland_tpu.ops import fb_onehot
+
+    s = _reduced_streams()
+    if chunked:
+        # The fused/one-pass CHUNKED routing: zero enters, all-zero
+        # pair0_mask (the only znorm configuration the route may build).
+        enters_red = jnp.zeros_like(s["enters_red"])
+        enters_full = jnp.zeros_like(s["enters_full"])
+        pair0_mask = jnp.zeros_like(s["pair0_mask"])
+    else:
+        enters_red, enters_full, pair0_mask = (
+            s["enters_red"], s["enters_full"], s["pair0_mask"])
+
+    def fn(al2, b2):
+        return fb_onehot.run_seq_stats_onehot(
+            s["params"], al2, b2, s["pair2"], s["lens2"], s["gt"],
+            enters_red, enters_full, pair0_mask, s["Tp"])
+
+    return fn, (s["al2"], s["b2"]), (1,)
+
+
+def _mk_cs_stats():
+    from cpgisland_tpu.ops import fb_onehot
+
+    s = _reduced_streams()
+
+    def fn(al2, b2):
+        return fb_onehot.run_stats_onehot(
+            s["params"], al2, b2, s["pair2"], s["lens2"], s["gt"], s["Tp"])
+
+    return fn, (s["al2"], s["b2"]), (1,)
+
+
+def _mk_onepass_em():
+    import jax.numpy as jnp
+
+    from cpgisland_tpu.ops import fb_onehot
+
+    s = _reduced_streams()
+    zr = jnp.zeros_like(s["enters_red"])
+    zf = jnp.zeros_like(s["enters_full"])
+    zm = jnp.zeros_like(s["pair0_mask"])
+
+    def fn(al2, b2):
+        macc, emit_red, _ll = fb_onehot.run_seq_stats_onehot(
+            s["params"], al2, b2, s["pair2"], s["lens2"], s["gt"],
+            zr, zf, zm, s["Tp"])
+        ll = fb_onehot.mat_loglik_lanes(s["va"], al2, s["lens2"])
+        return macc, emit_red, ll
+
+    return fn, (s["al2"], s["b2"]), (1,)
+
+
+def _mk_onepass_posterior():
+    from cpgisland_tpu.ops import fb_onehot, fb_pallas
+
+    s = _reduced_streams()
+    wb = s["va"]  # same geometry; values are irrelevant to the dataflow
+
+    def fn(a0, b0):
+        al2, b2 = fb_onehot.contract_mat_streams(
+            s["va"], wb, a0, b0, s["gt"], s["esym2"])
+        alphas = fb_onehot.scatter_streams(al2, s["gt"], s["esym2"], s["K"])
+        betas = fb_onehot.scatter_streams(b2, s["gt"], s["esym2"], s["K"])
+        return fb_pallas._conf_path_from_streams(
+            alphas, betas, s["lens2"], s["conf_mask"])
+
+    return fn, (s["a0"], s["b0"]), (1,)
+
+
+def _mk_mat_epilogue():
+    from cpgisland_tpu.ops import fb_onehot
+
+    s = _reduced_streams()
+    wb = s["va"]
+
+    def fn(a0, b0):
+        return fb_onehot.contract_mat_streams(
+            s["va"], wb, a0, b0, s["gt"], s["esym2"])
+
+    return fn, (s["a0"], s["b0"]), (1,)
+
+
+def _mk_mat_loglik():
+    from cpgisland_tpu.ops import fb_onehot
+
+    s = _reduced_streams()
+
+    def fn(va, al2):
+        return fb_onehot.mat_loglik_lanes(va, al2, s["lens2"])
+
+    return fn, (s["va"], s["al2"]), (0,)
+
+
+def _mk_decode_score():
+    import dataclasses as dc
+
+    import numpy as np
+    import jax.numpy as jnp
+
+    from cpgisland_tpu.ops import viterbi_parallel as vp
+
+    params = _flagship()
+    rng = np.random.default_rng(0)
+    obs = jnp.asarray(rng.integers(0, params.n_symbols, 64).astype(np.int32))
+
+    def fn(dv):
+        p = dc.replace(params, log_pi=params.log_pi + dv)
+        return vp.viterbi_parallel(
+            p, obs, block_size=32, return_score=True, engine="onehot")
+
+    return fn, (jnp.float32(0.0),), (0,)
+
+
+def default_entries() -> list:
+    """The shipped registry: every fused/one-pass direction consumer plus
+    the declared exact arms (names align with COSTS.json where a 1:1
+    entry exists)."""
+    return [
+        ScaleEntry(
+            name="posterior.onehot", tags_key="fb_pallas:_conf_path_from_streams", tagged="betas", mode="linear",
+            outputs=("conf", "path"),
+            expect={"conf": "free", "path": "free"},
+            costs_entries=("posterior.onehot",),
+            make=_mk_posterior_fused,
+            note="fused want_path branch: gamma normalize + MPM argmax over "
+                 "self-normalized beta directions"),
+        ScaleEntry(
+            name="posterior.conf.onehot", tags_key="fb_onehot:conf_from_reduced", tagged="betas2", mode="linear",
+            outputs=("conf",),
+            expect={"conf": "free"},
+            costs_entries=("posterior.onehot",),
+            make=_mk_conf_reduced,
+            note="reduced conf ratio (the _bwd_conf_kernel contract)"),
+        ScaleEntry(
+            name="posterior.onehot.onepass", tagged="beta0", mode="linear",
+            outputs=("conf", "path"),
+            expect={"conf": "free", "path": "free"},
+            costs_entries=("posterior.onehot.onepass",),
+            make=_mk_onepass_posterior,
+            note="matrix epilogue -> scatter -> conf+MPM; free in the "
+                 "backward boundary direction"),
+        ScaleEntry(
+            name="em.seq.onehot", tags_key="fb_onehot:run_seq_stats_onehot", tagged="betas2", mode="linear",
+            outputs=("macc", "emit_red", "ll"),
+            expect={"macc": "free", "emit_red": "free", "ll": "free"},
+            costs_entries=("em.seq.onehot",),
+            make=lambda: _mk_znorm_stats(chunked=False),
+            note="znorm stats with real enters: per-pair xi normalization "
+                 "cancels any per-position beta scale"),
+        ScaleEntry(
+            name="em.chunked.onehot", tags_key="fb_onehot:run_seq_stats_onehot", tagged="betas2", mode="linear",
+            outputs=("macc", "emit_red", "ll"),
+            expect={"macc": "free", "emit_red": "free", "ll": "free"},
+            costs_entries=("em.chunked.onehot",),
+            make=lambda: _mk_znorm_stats(chunked=True),
+            note="the ONLY legal fused/one-pass chunked stats routing: "
+                 "znorm kernel with zero enters + all-zero pair0_mask"),
+        ScaleEntry(
+            name="em.seq.onehot.onepass", tagged="betas2", mode="linear",
+            outputs=("macc", "emit_red", "ll"),
+            expect={"macc": "free", "emit_red": "free", "ll": "free"},
+            costs_entries=("em.seq.onehot.onepass",),
+            make=_mk_onepass_em,
+            note="one-pass stats composite: znorm stats are free in the "
+                 "contracted betas; the lane loglik never reads them"),
+        ScaleEntry(
+            name="fb.mat.epilogue", tags_key="fb_onehot:contract_mat_streams", tagged="beta0", mode="linear",
+            outputs=("alphas2", "betas2"),
+            expect={"alphas2": "free", "betas2": "deg:1"},
+            costs_entries=(
+                "posterior.onehot.onepass", "em.seq.onehot.onepass"),
+            make=_mk_mat_epilogue,
+            note="contract_mat_streams: betas2 is LINEAR in the backward "
+                 "boundary direction (consumers must erase it; alphas2 "
+                 "never sees it)"),
+        ScaleEntry(
+            name="em.chunked.onehot.split", tags_key="fb_onehot:run_stats_onehot", tagged="betas2", mode="linear",
+            outputs=("macc", "emit_red", "ll"),
+            expect={"macc": "deg:1", "emit_red": "free", "ll": "free"},
+            costs_entries=("em.chunked.onehot",),
+            make=_mk_cs_stats,
+            note="EXACT split-pass arm: macc is degree 1 in the cs-scaled "
+                 "betas by construction (inv_cs carries the scale) — the "
+                 "pairing guard (fb_onehot.run_stats_onehot betas_scale) "
+                 "keeps self-normalized directions out at runtime"),
+        ScaleEntry(
+            name="em.seq.onepass.loglik", tags_key="fb_onehot:mat_loglik_lanes", tagged="va", mode="linear",
+            outputs=("ll",),
+            expect={"ll": "mixed"},
+            costs_entries=("em.seq.onehot.onepass",),
+            make=_mk_mat_loglik,
+            note="pinned log-domain: exactness is the telescoping identity "
+                 "(runtime-parity-tested), NOT a homogeneity fact — a "
+                 "'free' derivation here would mean the loglik stopped "
+                 "reading the matrix totals"),
+        ScaleEntry(
+            name="decode.score.onehot",
+            tags_key="viterbi_onehot:viterbi_parallel.onehot",
+            tagged="log_pi offset",
+            mode="maxplus",
+            outputs=("path", "score"),
+            expect={"path": "free", "score": "deg:1"},
+            costs_entries=("decode.onehot",),
+            make=_mk_decode_score,
+            note="true-score contract: scores shift by exactly the log_pi "
+                 "offset (max-plus degree 1), paths are offset-invariant"),
+    ]
+
+
+# ---------------------------------------------------------------------------
+# Derivation + the declared-expectation contracts.
+
+
+def check_function(fn, args, tagged_argnums, expect, outputs,
+                   mode: str = "linear", name: str = "<fn>") -> list:
+    """Trace + analyze one consumer; return expectation-violation strings
+    (with equation provenance).  The public harness the tests and planted
+    fixtures use."""
+    from cpgisland_tpu.analysis import scalemodel
+
+    report, closed = scalemodel.trace_scales(
+        fn, args, tagged_argnums, mode=mode)
+    prov = scalemodel.out_provenance(closed)
+    sig = report.signature()
+    if len(sig) != len(outputs):
+        return [
+            f"{name}: output arity mismatch — {len(outputs)} declared, "
+            f"{len(sig)} traced"
+        ]
+    violations = []
+    for i, (out_name, got) in enumerate(zip(outputs, sig)):
+        want = expect[out_name]
+        if not _matches(want, got):
+            scale = report.out_scales[i]
+            where = (scale.why if scale.kind == "mixed"
+                     else prov[i] if i < len(prov) else "<unknown>")
+            violations.append(
+                f"{name}: output '{out_name}' expected {want}, derived "
+                f"{got} in tagged input — {where}")
+    return violations
+
+
+def _matches(want: str, got: str) -> bool:
+    if want == "free":
+        return got in ("free", "any")
+    return got == want
+
+
+def derive_entry(entry: ScaleEntry) -> dict:
+    """Trace one entry; returns its live record (signature + const bytes +
+    expectation violations)."""
+    from cpgisland_tpu.analysis import scalemodel
+
+    fn, args, tagged = entry.make()
+    report, closed = scalemodel.trace_scales(
+        fn, args, tagged, mode=entry.mode)
+    prov = scalemodel.out_provenance(closed)
+    sig = report.signature()
+    record = {
+        "tagged": entry.tagged,
+        "mode": entry.mode,
+        "signature": dict(zip(entry.outputs, sig)),
+        "costs_entries": list(entry.costs_entries),
+    }
+    violations = []
+    if len(sig) != len(entry.outputs):
+        violations.append(
+            f"{entry.name}: output arity mismatch — "
+            f"{len(entry.outputs)} declared, {len(sig)} traced")
+    else:
+        for i, (out_name, got) in enumerate(zip(entry.outputs, sig)):
+            want = entry.expect[out_name]
+            if not _matches(want, got):
+                scale = report.out_scales[i]
+                where = (scale.why if scale.kind == "mixed"
+                         else prov[i] if i < len(prov) else "<unknown>")
+                rule = ("scale.free-consumers" if want == "free"
+                        else "scale.exact-arms")
+                violations.append(
+                    f"[{rule}] {entry.name}: output '{out_name}' expected "
+                    f"{want}, derived {got} in tagged {entry.tagged} — "
+                    f"{where}")
+    cb = scalemodel.const_bytes(closed)
+    record["const_bytes"] = cb
+    from cpgisland_tpu.analysis import memmodel
+
+    budget = memmodel.remote_const_budget()
+    if cb > budget:
+        violations.append(
+            f"[scale.const-bytes] {entry.name}: {cb} baked constant bytes "
+            f"> remote-compile budget {budget} (the HTTP 413 cliff)")
+    return record, violations
+
+
+def live_entries(entries=None):
+    """(records, violations) over the registry — traced on the current
+    (CPU) backend."""
+    if entries is None:
+        entries = default_entries()
+    records, violations = {}, []
+    for e in entries:
+        rec, viol = derive_entry(e)
+        records[e.name] = rec
+        violations.extend(viol)
+    return records, violations
+
+
+# ---------------------------------------------------------------------------
+# Lockfile (COSTS.json conventions + TUNING.json staleness).
+
+
+def _fingerprint(costs_entries) -> str:
+    from cpgisland_tpu.tune import table
+
+    return table.costs_fingerprint(tuple(costs_entries))
+
+
+def load_lockfile(path: Optional[str] = None) -> Optional[dict]:
+    path = path or default_lockfile_path()
+    if not os.path.exists(path):
+        return None
+    with open(path) as f:
+        return json.load(f)
+
+
+def write_lockfile(records: dict, path: Optional[str] = None,
+                   platform: str = "cpu") -> str:
+    import jax
+
+    path = path or default_lockfile_path()
+    lock = load_lockfile(path) or {
+        "version": LOCKFILE_VERSION, "platforms": {}}
+    stamped = {}
+    for name, rec in sorted(records.items()):
+        stamped[name] = dict(
+            rec, costs_fingerprint=_fingerprint(rec["costs_entries"]))
+    lock["platforms"][platform] = {
+        "jax": jax.__version__, "entries": stamped}
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(lock, f, indent=1, sort_keys=True)
+        f.write("\n")
+    os.replace(tmp, path)
+    return path
+
+
+@dataclasses.dataclass
+class ScaleDiff:
+    violations: list
+    notes: list
+    stale: list
+    checked: int = 0
+
+    @property
+    def ok(self) -> bool:
+        return not self.violations
+
+    def as_dict(self) -> dict:
+        return {
+            "violations": self.violations, "notes": self.notes,
+            "stale": self.stale, "checked": self.checked, "ok": self.ok,
+        }
+
+
+def diff_scales(live: dict, lock: Optional[dict],
+                platform: str = "cpu") -> ScaleDiff:
+    """Compare live signatures against the lockfile.  Fingerprint-drifted
+    entries degrade to report-only staleness notes (the TUNING.json rule:
+    a kernel reshape re-derives, it does not silently re-certify)."""
+    d = ScaleDiff([], [], [])
+    if lock is None:
+        d.violations.append(
+            f"no {LOCKFILE_NAME} lockfile — run "
+            "`python -m cpgisland_tpu.analysis --update-scale` and commit")
+        return d
+    plats = lock.get("platforms", {})
+    if platform not in plats:
+        d.notes.append(
+            f"{LOCKFILE_NAME} has no '{platform}' section — skipped "
+            "(derive with --update-scale on this platform)")
+        return d
+    locked = plats[platform].get("entries", {})
+    for name, rec in sorted(live.items()):
+        if name not in locked:
+            d.violations.append(
+                f"scale entry '{name}' missing from {LOCKFILE_NAME} — "
+                "re-baseline with --update-scale")
+            continue
+        lrec = locked[name]
+        want_fp = _fingerprint(rec["costs_entries"])
+        have_fp = lrec.get("costs_fingerprint")
+        if have_fp != want_fp:
+            d.stale.append(name)
+            d.notes.append(
+                f"scale stale '{name}': dependent COSTS.json fingerprint "
+                f"drifted ({have_fp} -> {want_fp}) — signature is "
+                "report-only until --update-scale re-derives it "
+                f"(live: {rec['signature']})")
+            continue
+        d.checked += 1
+        if lrec.get("signature") != rec["signature"]:
+            d.violations.append(
+                f"[scale.lockfile] '{name}' signature drifted: locked "
+                f"{lrec.get('signature')} vs live {rec['signature']} — "
+                "verify the consumer change, then --update-scale")
+    for name in sorted(set(locked) - set(live)):
+        d.notes.append(
+            f"locked scale entry '{name}' no longer registered — "
+            "--update-scale will drop it")
+    return d
+
+
+def update_summary(live: dict, lock: Optional[dict],
+                   platform: str = "cpu") -> list:
+    out = []
+    locked = ((lock or {}).get("platforms", {})
+              .get(platform, {}).get("entries", {}))
+    for name, rec in sorted(live.items()):
+        if name not in locked:
+            out.append(f"new scale entry {name}: {rec['signature']}")
+        elif locked[name].get("signature") != rec["signature"]:
+            out.append(
+                f"scale {name}: {locked[name].get('signature')} -> "
+                f"{rec['signature']}")
+    return out
+
+
+def run_scale_pass(lockfile_path: Optional[str] = None,
+                   update: bool = False, entries=None) -> dict:
+    """Derive, check declared expectations, diff against SCALE.json.
+
+    Returns {"ok", "diff", "entries", "violations", "updated", "summary",
+    "path", "platform"} — the same consumption shape as the cost/mem
+    passes.  On a TPU backend the pass SKIPS (the signatures certify the
+    CPU XLA twins; pallas bodies are opaque to the dataflow) with a note.
+    """
+    import jax
+
+    platform = jax.default_backend()
+    out: dict = {"platform": platform, "updated": False}
+    if platform == "tpu":
+        out["diff"] = ScaleDiff(
+            [], [f"scale pass skipped on '{platform}' — the dataflow "
+                 "certifies the CPU XLA twins (engine parity is pinned by "
+                 "Layer 2); run on CPU"], []).as_dict()
+        out["entries"] = {}
+        out["violations"] = []
+        out["ok"] = True
+        return out
+    violations = check_declarations(entries)
+    records, derive_viol = live_entries(entries)
+    violations.extend(derive_viol)
+    lock = load_lockfile(lockfile_path)
+    if update:
+        out["summary"] = update_summary(records, lock, "cpu")
+        path = write_lockfile(records, lockfile_path, "cpu")
+        out["updated"] = True
+        out["path"] = path
+        lock = load_lockfile(lockfile_path)
+    diff = diff_scales(records, lock, "cpu")
+    out["diff"] = diff.as_dict()
+    out["entries"] = records
+    out["violations"] = violations
+    out["ok"] = diff.ok and not violations
+    return out
+
+
+def format_failure(report: dict) -> str:
+    """One-line JSON summary of a failing run_scale_pass report."""
+    return json.dumps({
+        "violations": report.get("violations", []),
+        "diff": report.get("diff", {}).get("violations", []),
+    })

@@ -1,0 +1,730 @@
+"""graftcheck Layer 6 — the scale-invariance dataflow model (graftscale).
+
+The r9/r17 pass collapse rests on one invariant no earlier layer can see:
+the co-scheduled backward SELF-NORMALIZES (it divides by its own previous
+sum, not the forward's cs), so fused/one-pass betas are per-position
+*directions* and every consumer downstream must be scale-free — the znorm
+stats kernel, the conf ratio, the MPM argmax.  The one known violation
+class (pairing the cs-scaled chunked stats kernel with self-normalized
+betas) lived only as a CLAUDE.md comment ("that pairing is a bug").  This
+module turns the comment into dataflow: an abstract interpretation over
+jaxprs that assigns every intermediate a *scale type* with respect to a
+tagged input and certifies the declared signature of each consumer.
+
+The abstract domain (positive homogeneity degrees):
+
+- ``Deg(k)`` — positively homogeneous of degree ``k``: scaling the tagged
+  input by ``c > 0`` scales this value by ``c**k``.  ``Deg(0)`` is
+  scale-FREE (constants, and anything whose tagged scale collapsed
+  through a ratio / normalize / argmax).
+- ``ANY`` — degree-polymorphic: exact zeros and tiny guard literals
+  (``jnp.maximum(z, 1e-30)``); joins with every ``Deg(k)`` as that
+  ``Deg(k)``.  Without this element every guarded normalizer would
+  poison to MIXED.
+- ``MIXED`` — not positively homogeneous (e.g. ``x + 1`` of a tagged
+  ``x``, ``log`` of a degree-1 value, a scan carry with no fixed-point
+  degree).  Carries the provenance of the equation that broke it.
+
+Propagation is the closed primitive set the FB/decode graphs actually
+use: mul/div/dot add/subtract degrees, sums and maxima preserve them,
+same-degree add/select joins, exp/log admit only degree 0, comparisons
+and argmax of uniform-degree operands collapse to degree 0, and loop
+carries (scan/while) must reach a degree FIXED POINT — a carry whose
+degree grows per iteration is reported MIXED with the loop named.
+
+Two rule modes share the engine:
+
+- ``mode="linear"`` — probability space.  The tag is a multiplicative
+  scaling of the tagged tensor (the reduced beta streams).
+- ``mode="maxplus"`` — log space for the decode chains.  The tag is an
+  additive OFFSET (a shift of ``log_pi``); ``add``/``sub`` take the
+  mul/div roles (degree add/subtract), ``max``/argmax take the
+  join/collapse roles, and true-score returns certify degree 1 (scores
+  shift by exactly the offset) while paths certify degree 0.
+
+No jax at module level: :func:`analyze` imports it lazily, so the lint
+layer and ``--list-rules`` never pay a backend init.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from fractions import Fraction
+from typing import Optional
+
+# Literals with magnitude at or below this are numerical guards
+# (LOG_ZERO-adjacent epsilons, the 1e-30 normalizer floors), classified
+# degree-polymorphic rather than degree-0 so ``maximum(z, eps)`` keeps
+# z's degree instead of poisoning to MIXED.
+GUARD_EPS = 1e-20
+
+_ANY = "any"
+_DEG = "deg"
+_MIXED = "mixed"
+
+
+@dataclasses.dataclass(frozen=True)
+class Scale:
+    """Abstract scale of one value w.r.t. the tagged input."""
+
+    kind: str                      # "any" | "deg" | "mixed"
+    deg: Optional[Fraction] = None  # set iff kind == "deg"
+    why: Optional[str] = None       # provenance iff kind == "mixed"
+
+    def describe(self) -> str:
+        if self.kind == _ANY:
+            return "any"
+        if self.kind == _MIXED:
+            return "mixed"
+        if self.deg == 0:
+            return "free"
+        d = self.deg
+        return f"deg:{d.numerator}" if d.denominator == 1 else f"deg:{d}"
+
+    @property
+    def is_free(self) -> bool:
+        """Scale-free: invariant under tagged-input scaling."""
+        return self.kind == _ANY or (self.kind == _DEG and self.deg == 0)
+
+    @property
+    def tagged(self) -> bool:
+        """Carries a nonzero tagged degree (or worse)."""
+        return not self.is_free
+
+
+ANY = Scale(_ANY)
+FREE = Scale(_DEG, Fraction(0))
+
+
+def DEG(k) -> Scale:
+    k = Fraction(k)
+    return FREE if k == 0 else Scale(_DEG, k)
+
+
+def MIXED(why: str) -> Scale:
+    return Scale(_MIXED, why=why)
+
+
+def join(a: Scale, b: Scale, why: str = "join of differing degrees") -> Scale:
+    """Least upper bound: the scale of a value that may be either input
+    (select branches, concatenated operands, add of same-degree terms)."""
+    if a.kind == _MIXED:
+        return a
+    if b.kind == _MIXED:
+        return b
+    if a.kind == _ANY:
+        return b
+    if b.kind == _ANY:
+        return a
+    if a.deg == b.deg:
+        return a
+    return MIXED(why)
+
+
+def join_all(scales, why: str = "join of differing degrees") -> Scale:
+    out = ANY
+    for s in scales:
+        out = join(out, s, why)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Equation provenance (the costmodel convention: file:function of the
+# user-frame that emitted the primitive).
+
+
+def _user_frame(eqn) -> str:
+    """'file:line:function' of the user frame that emitted this equation
+    (the costmodel attribution convention, plus the line)."""
+    try:
+        from jax._src import source_info_util
+
+        frame = source_info_util.user_frame(eqn.source_info)
+        if frame is None:
+            return "<jax>"
+        fname = frame.file_name.rsplit("/", 1)[-1]
+        return f"{fname}:{frame.start_line}:{frame.function_name}"
+    except Exception:
+        return "<unknown>"
+
+
+# ---------------------------------------------------------------------------
+# The rule table.  Handlers get (state, eqn, in_scales) and return a list of
+# output scales.  A missing entry falls back to the soundness default:
+# untagged inputs -> FREE outputs for ANY primitive (a computation that
+# never touches the tagged value is constant under the tag), tagged inputs
+# through an unmodeled primitive -> MIXED naming it.
+
+
+class _State:
+    def __init__(self, mode: str):
+        self.mode = mode
+        self.findings: list = []
+
+
+def _why(eqn, reason: str) -> str:
+    return f"{reason} in '{eqn.primitive.name}' @ {_user_frame(eqn)}"
+
+
+def _inherit_mixed(ins):
+    for s in ins:
+        if s.kind == _MIXED:
+            return s
+    return None
+
+
+def _r_degree_add(st, eqn, ins):
+    """mul / dot_general (linear), add / sub-as-add (maxplus): degrees add."""
+    m = _inherit_mixed(ins)
+    if m:
+        return [m]
+    if any(s.kind == _ANY for s in ins):
+        return [ANY]
+    return [DEG(sum((s.deg for s in ins), Fraction(0)))]
+
+
+def _r_degree_sub(st, eqn, ins):
+    """div (linear), sub (maxplus): degree difference.  The ratio collapse:
+    Deg(1)/Deg(1) -> FREE is how normalizers erase the tagged scale."""
+    m = _inherit_mixed(ins)
+    if m:
+        return [m]
+    a, b = ins
+    if a.kind == _ANY:
+        return [ANY]
+    if b.kind == _ANY:
+        # Dividing BY an exact zero/guard literal: the guard is a stand-in
+        # for a same-degree quantity only when it appears under max(); a
+        # bare guarded denominator is degree-0 in practice (eps literal).
+        return [a]
+    return [DEG(a.deg - b.deg)]
+
+
+def _r_linear(st, eqn, ins):
+    """Degree-preserving joins: add/sub/max/min (linear), reduce_sum/max,
+    cumsum/cummax, concatenate, pad, clamp — same degree in, same out."""
+    m = _inherit_mixed(ins)
+    if m:
+        return [m]
+    return [join_all(ins, _why(eqn, "operands of differing degree"))]
+
+
+def _r_collapse(st, eqn, ins):
+    """argmax/argmin/sign/is_finite and comparisons: uniform-degree inputs
+    collapse to FREE (the decision is invariant under c > 0 scaling)."""
+    m = _inherit_mixed(ins)
+    if m:
+        return [m]
+    j = join_all(ins, "")
+    if j.kind == _MIXED:
+        return [MIXED(_why(eqn, "comparison across differing degrees"))]
+    return [FREE]
+
+
+def _r_select(st, eqn, ins):
+    """select_n(pred, *cases): pred must be scale-safe; result joins cases."""
+    pred, cases = ins[0], ins[1:]
+    if pred.kind == _MIXED:
+        return [pred]
+    m = _inherit_mixed(cases)
+    if m:
+        return [m]
+    return [join_all(cases, _why(eqn, "select branches of differing degree"))]
+
+
+def _r_exp_like(st, eqn, ins):
+    """exp/log/tanh/...: transcendental — only degree-0 passes through."""
+    m = _inherit_mixed(ins)
+    if m:
+        return [m]
+    if all(s.is_free for s in ins):
+        return [FREE]
+    return [MIXED(_why(eqn, "transcendental of a tagged value"))]
+
+
+def _r_preserve(st, eqn, ins):
+    """Shape/layout ops: the (single data) operand's scale passes through."""
+    return [ins[0]]
+
+
+def _r_free(st, eqn, ins):
+    m = _inherit_mixed(ins)
+    if m:
+        return [m]
+    return [FREE]
+
+
+def _r_neg(st, eqn, ins):
+    if st.mode == "maxplus":
+        s = ins[0]
+        if s.kind == _DEG:
+            return [DEG(-s.deg)]
+        return [s]
+    return [ins[0]]
+
+
+def _r_integer_pow(st, eqn, ins):
+    s = ins[0]
+    if s.kind != _DEG:
+        return [s]
+    y = eqn.params.get("y", 1)
+    if st.mode == "maxplus" and s.deg != 0:
+        return [MIXED(_why(eqn, "power of a tagged log-space value"))]
+    return [DEG(s.deg * y)]
+
+
+def _r_sqrt(st, eqn, ins):
+    s = ins[0]
+    if s.kind != _DEG:
+        return [s]
+    if st.mode == "maxplus" and s.deg != 0:
+        return [MIXED(_why(eqn, "sqrt of a tagged log-space value"))]
+    return [DEG(s.deg / 2)]
+
+
+def _r_rsqrt(st, eqn, ins):
+    s = ins[0]
+    if s.kind != _DEG:
+        return [s]
+    if st.mode == "maxplus" and s.deg != 0:
+        return [MIXED(_why(eqn, "rsqrt of a tagged log-space value"))]
+    return [DEG(-s.deg / 2)]
+
+
+def _r_convert(st, eqn, ins):
+    s = ins[0]
+    try:
+        import numpy as np
+
+        to_float = np.issubdtype(eqn.params["new_dtype"], np.floating)
+    except Exception:
+        to_float = True
+    if to_float:
+        return [s]
+    # float -> int/bool truncation is only scale-safe for untagged values.
+    if s.is_free or s.kind == _ANY:
+        return [FREE]
+    if s.kind == _MIXED:
+        return [s]
+    return [MIXED(_why(eqn, "integer cast of a tagged value"))]
+
+
+def _r_round_like(st, eqn, ins):
+    m = _inherit_mixed(ins)
+    if m:
+        return [m]
+    if all(s.is_free for s in ins):
+        return [FREE]
+    return [MIXED(_why(eqn, "rounding/remainder of a tagged value"))]
+
+
+def _r_gather(st, eqn, ins):
+    operand, idx = ins[0], ins[1:]
+    if any(s.tagged for s in idx):
+        m = _inherit_mixed(idx)
+        return [m if m else MIXED(_why(eqn, "tagged value used as gather index"))]
+    return [operand]
+
+
+def _r_scatter(st, eqn, ins):
+    # scatter(operand, indices, updates): join operand/updates degrees.
+    operand, idx, upd = ins[0], ins[1], ins[2]
+    if idx.tagged:
+        m = _inherit_mixed([idx])
+        return [m if m else MIXED(_why(eqn, "tagged value used as scatter index"))]
+    m = _inherit_mixed([operand, upd])
+    if m:
+        return [m]
+    return [join(operand, upd, _why(eqn, "scatter operand/updates degree mismatch"))]
+
+
+def _r_sort(st, eqn, ins):
+    m = _inherit_mixed(ins)
+    if m:
+        return [m for _ in ins]
+    return list(ins)
+
+
+def _r_dus(st, eqn, ins):
+    # dynamic_update_slice(operand, update, *starts)
+    operand, upd, starts = ins[0], ins[1], ins[2:]
+    if any(s.tagged for s in starts):
+        return [MIXED(_why(eqn, "tagged value used as slice index"))]
+    m = _inherit_mixed([operand, upd])
+    if m:
+        return [m]
+    return [join(operand, upd, _why(eqn, "update slice of differing degree"))]
+
+
+def _r_ds(st, eqn, ins):
+    operand, starts = ins[0], ins[1:]
+    if any(s.tagged for s in starts):
+        return [MIXED(_why(eqn, "tagged value used as slice index"))]
+    return [operand]
+
+
+_LINEAR_JOIN = (
+    "add", "sub", "max", "min", "reduce_sum", "reduce_max", "reduce_min",
+    "cumsum", "cummax", "cummin", "concatenate", "pad", "clamp",
+    "add_any",
+)
+_COLLAPSE = (
+    "argmax", "argmin", "sign", "is_finite", "eq", "ne", "lt", "le", "gt",
+    "ge", "reduce_and", "reduce_or",
+)
+_EXP_LIKE = (
+    "exp", "exp2", "log", "log2", "log1p", "expm1", "tanh", "logistic",
+    "erf", "erfc", "erf_inv", "sin", "cos", "atan2", "pow", "cbrt",
+    "reduce_prod", "cumprod", "cumlogsumexp", "digamma", "lgamma",
+)
+_PRESERVE = (
+    "broadcast_in_dim", "reshape", "transpose", "squeeze", "rev", "slice",
+    "copy", "reduce_precision", "stop_gradient", "device_put", "real",
+    "expand_dims", "split", "optimization_barrier",
+)
+
+_RULES_LINEAR = {}
+_RULES_MAXPLUS = {}
+
+for _n in ("mul", "dot_general"):
+    _RULES_LINEAR[_n] = _r_degree_add
+_RULES_LINEAR["div"] = _r_degree_sub
+for _n in _LINEAR_JOIN:
+    _RULES_LINEAR[_n] = _r_linear
+for _n in _COLLAPSE:
+    _RULES_LINEAR[_n] = _r_collapse
+for _n in _EXP_LIKE:
+    _RULES_LINEAR[_n] = _r_exp_like
+for _n in _PRESERVE:
+    _RULES_LINEAR[_n] = _r_preserve
+_RULES_LINEAR.update({
+    "select_n": _r_select, "neg": _r_neg, "abs": _r_preserve,
+    "integer_pow": _r_integer_pow, "sqrt": _r_sqrt, "rsqrt": _r_rsqrt,
+    "convert_element_type": _r_convert, "iota": _r_free,
+    "floor": _r_round_like, "ceil": _r_round_like, "round": _r_round_like,
+    "rem": _r_round_like, "nextafter": _r_round_like,
+    "gather": _r_gather, "scatter": _r_scatter, "scatter-add": _r_scatter,
+    "scatter_add": _r_scatter, "sort": _r_sort,
+    "dynamic_update_slice": _r_dus, "dynamic_slice": _r_ds,
+    "and": _r_collapse, "or": _r_collapse, "xor": _r_collapse,
+    "not": _r_collapse,
+})
+
+
+def _r_square(st, eqn, ins):
+    s = ins[0]
+    if s.kind != _DEG:
+        return [s]
+    if st.mode == "maxplus" and s.deg != 0:
+        return [MIXED(_why(eqn, "square of a tagged log-space value"))]
+    return [DEG(s.deg * 2)]
+
+
+_RULES_LINEAR["square"] = _r_square
+
+def _r_mul_maxplus(st, eqn, ins):
+    """max-plus mul/div: an offset-tagged value times a constant scales
+    the OFFSET — not homogeneous — except multiplication by an exact zero
+    (the ``v * 0.0`` shape-broadcast idiom), which erases the value."""
+    m = _inherit_mixed(ins)
+    if m:
+        return [m]
+    if any(s.kind == _ANY for s in ins):
+        return [ANY]
+    if all(s.is_free for s in ins):
+        return [FREE]
+    return [MIXED(_why(eqn, "product of a tagged log-space value"))]
+
+
+# max-plus: add/sub take the mul/div roles; mul/dot of tagged values are
+# no longer homogeneous (c * x scales the OFFSET, which only a constant
+# could absorb); exp/log stay transcendental barriers.
+_RULES_MAXPLUS = dict(_RULES_LINEAR)
+_RULES_MAXPLUS.update({
+    "add": _r_degree_add, "add_any": _r_degree_add,
+    "sub": _r_degree_sub,
+    "mul": _r_mul_maxplus, "dot_general": _r_mul_maxplus,
+    "div": _r_mul_maxplus,
+    "square": _r_exp_like, "integer_pow": _r_exp_like,
+    "sqrt": _r_exp_like, "rsqrt": _r_exp_like,
+    "reduce_sum": _r_exp_like, "cumsum": _r_exp_like,
+    "exp": _r_exp_like, "log": _r_exp_like,
+})
+# max/min joins and comparisons keep their linear behavior (inherited).
+
+_SCAN_MAX_ITERS = 8
+
+
+# ---------------------------------------------------------------------------
+# The interpreter.
+
+
+class ScaleReport:
+    """Result of one :func:`analyze` run."""
+
+    def __init__(self, out_scales, mode):
+        self.out_scales: list[Scale] = out_scales
+        self.mode = mode
+
+    def signature(self) -> list[str]:
+        return [s.describe() for s in self.out_scales]
+
+
+def _classify_const(val) -> Scale:
+    import numpy as np
+
+    try:
+        arr = np.asarray(val)
+    except Exception:
+        return FREE
+    if arr.dtype == object:
+        return FREE
+    if arr.size == 0:
+        return ANY
+    if np.issubdtype(arr.dtype, np.floating) or np.issubdtype(
+            arr.dtype, np.complexfloating):
+        a = np.abs(arr)
+        if bool((a <= GUARD_EPS).all()):
+            return ANY
+    elif bool((arr == 0).all()):
+        return ANY
+    return FREE
+
+
+def _sub_closed(params, key):
+    j = params.get(key)
+    return j
+
+
+def _analyze_jaxpr(jaxpr, in_scales, const_scales, st: _State) -> list[Scale]:
+    """Propagate scales through one (open) jaxpr; returns outvar scales."""
+    import jax
+
+    env: dict[int, Scale] = {}
+
+    def read(atom) -> Scale:
+        if isinstance(atom, jax.core.Literal):
+            return _classify_const(atom.val)
+        return env.get(id(atom), FREE)
+
+    def write(var, s: Scale) -> None:
+        env[id(var)] = s
+
+    for v, s in zip(jaxpr.constvars, const_scales):
+        write(v, s)
+    for v, s in zip(jaxpr.invars, in_scales):
+        write(v, s)
+
+    rules = _RULES_MAXPLUS if st.mode == "maxplus" else _RULES_LINEAR
+
+    for eqn in jaxpr.eqns:
+        ins = [read(a) for a in eqn.invars]
+        name = eqn.primitive.name
+        outs: Optional[list[Scale]] = None
+
+        if name in ("pjit", "closed_call", "core_call", "remat_call",
+                    "custom_jvp_call", "custom_vjp_call", "checkpoint",
+                    "remat", "custom_vjp_call_jaxpr", "xla_call"):
+            sub = (_sub_closed(eqn.params, "jaxpr")
+                   or _sub_closed(eqn.params, "call_jaxpr")
+                   or _sub_closed(eqn.params, "fun_jaxpr"))
+            if sub is not None:
+                inner = getattr(sub, "jaxpr", sub)
+                consts = [_classify_const(c)
+                          for c in getattr(sub, "consts", [])]
+                n_in = len(inner.invars)
+                # custom_* calls may pass extra leading residuals; align
+                # from the END (the data operands are trailing).
+                use = ins[-n_in:] if len(ins) >= n_in else (
+                    [FREE] * (n_in - len(ins)) + ins)
+                outs = _analyze_jaxpr(inner, use, consts, st)
+        elif name == "scan":
+            outs = _analyze_scan(eqn, ins, st)
+        elif name == "while":
+            outs = _analyze_while(eqn, ins, st)
+        elif name == "cond":
+            outs = _analyze_cond(eqn, ins, st)
+        elif name in rules:
+            handler = rules[name]
+            outs = handler(st, eqn, ins)
+        if outs is None:
+            # Soundness default: a primitive that never sees a tagged value
+            # is constant under the tag; a tagged value through an
+            # unmodeled primitive is MIXED, naming the primitive.
+            m = _inherit_mixed(ins)
+            if m is not None:
+                outs = [m] * len(eqn.outvars)
+            elif all(s.is_free for s in ins):
+                outs = [FREE] * len(eqn.outvars)
+            else:
+                outs = [MIXED(_why(eqn, "unmodeled primitive"))] * len(
+                    eqn.outvars)
+        if len(outs) < len(eqn.outvars):
+            outs = list(outs) + [outs[-1]] * (len(eqn.outvars) - len(outs))
+        for v, s in zip(eqn.outvars, outs):
+            write(v, s)
+
+    return [read(v) for v in jaxpr.outvars]
+
+
+def _loop_sub(params, key):
+    sub = params[key]
+    inner = getattr(sub, "jaxpr", sub)
+    consts = [_classify_const(c) for c in getattr(sub, "consts", [])]
+    return inner, consts
+
+
+def _analyze_scan(eqn, ins, st: _State) -> list[Scale]:
+    inner, consts = _loop_sub(eqn.params, "jaxpr")
+    n_consts = eqn.params["num_consts"]
+    n_carry = eqn.params["num_carry"]
+    body_consts = ins[:n_consts]
+    carry = list(ins[n_consts:n_consts + n_carry])
+    xs = ins[n_consts + n_carry:]
+    ys_out: list[Scale] = []
+    for _ in range(_SCAN_MAX_ITERS):
+        outs = _analyze_jaxpr(inner, body_consts + carry + xs, consts, st)
+        new_carry = [join(c, o, "scan carry degree not a fixed point")
+                     for c, o in zip(carry, outs[:n_carry])]
+        ys_out = outs[n_carry:]
+        if new_carry == carry:
+            break
+        carry = new_carry
+    else:
+        carry = [MIXED(_why(eqn, "scan carry degree not a fixed point"))
+                 for _ in carry]
+        outs = _analyze_jaxpr(inner, body_consts + carry + xs, consts, st)
+        ys_out = outs[n_carry:]
+    return carry + ys_out
+
+
+def _analyze_while(eqn, ins, st: _State) -> list[Scale]:
+    body, body_consts_s = _loop_sub(eqn.params, "body_jaxpr")
+    cond, cond_consts_s = _loop_sub(eqn.params, "cond_jaxpr")
+    cn = eqn.params["cond_nconsts"]
+    bn = eqn.params["body_nconsts"]
+    cond_consts = ins[:cn]
+    body_consts = ins[cn:cn + bn]
+    carry = list(ins[cn + bn:])
+    for _ in range(_SCAN_MAX_ITERS):
+        outs = _analyze_jaxpr(body, body_consts + carry, body_consts_s, st)
+        new_carry = [join(c, o, "while carry degree not a fixed point")
+                     for c, o in zip(carry, outs)]
+        if new_carry == carry:
+            break
+        carry = new_carry
+    else:
+        carry = [MIXED(_why(eqn, "while carry degree not a fixed point"))
+                 for _ in carry]
+    # The cond must be scale-safe too: a tagged predicate changes the trip
+    # count under scaling.
+    pred = _analyze_jaxpr(cond, cond_consts + carry, cond_consts_s, st)
+    if pred and pred[0].tagged:
+        why = (pred[0].why if pred[0].kind == _MIXED
+               else _why(eqn, "while predicate depends on tagged scale"))
+        return [MIXED(why) for _ in carry]
+    return carry
+
+
+def _analyze_cond(eqn, ins, st: _State) -> list[Scale]:
+    branches = eqn.params["branches"]
+    idx, ops = ins[0], ins[1:]
+    if idx.tagged:
+        return [MIXED(_why(eqn, "cond index depends on tagged scale"))]
+    branch_outs = []
+    for br in branches:
+        inner = getattr(br, "jaxpr", br)
+        consts = [_classify_const(c) for c in getattr(br, "consts", [])]
+        branch_outs.append(_analyze_jaxpr(inner, ops, consts, st))
+    n_out = max(len(b) for b in branch_outs)
+    out = []
+    for i in range(n_out):
+        out.append(join_all(
+            (b[i] for b in branch_outs if i < len(b)),
+            _why(eqn, "cond branches of differing degree")))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Public API.
+
+
+def analyze(closed, tagged, mode: str = "linear") -> ScaleReport:
+    """Run the scale dataflow over a ClosedJaxpr.
+
+    ``tagged``: iterable of flat invar indices carrying degree 1 (the beta
+    stream in linear mode; the log-space offset in maxplus mode).  Returns
+    a :class:`ScaleReport` whose ``out_scales`` align with the jaxpr's
+    outvars.
+    """
+    st = _State(mode)
+    jaxpr = closed.jaxpr
+    tagged = frozenset(tagged)
+    in_scales = [DEG(1) if i in tagged else FREE
+                 for i in range(len(jaxpr.invars))]
+    const_scales = [_classify_const(c) for c in closed.consts]
+    outs = _analyze_jaxpr(jaxpr, in_scales, const_scales, st)
+    return ScaleReport(outs, mode)
+
+
+def trace_scales(fn, args, tagged_argnums, mode: str = "linear"):
+    """Trace ``fn(*args)`` and analyze; returns (ScaleReport, ClosedJaxpr).
+
+    ``tagged_argnums`` are POSITIONAL argument indices; arguments must be
+    single arrays (the consumer-level entries pass flat streams, so the
+    flat invar index equals the arg index).
+    """
+    import jax
+
+    closed = jax.make_jaxpr(fn)(*args)
+    n_args = len(args)
+    flat_per_arg = []
+    offset = 0
+    for a in args:
+        leaves = len(jax.tree_util.tree_leaves(a))
+        flat_per_arg.append(range(offset, offset + leaves))
+        offset += leaves
+    if offset != len(closed.jaxpr.invars):
+        raise ValueError(
+            f"flat invar mismatch: {offset} leaves vs "
+            f"{len(closed.jaxpr.invars)} invars")
+    tagged = set()
+    for i in tagged_argnums:
+        if i >= n_args:
+            raise ValueError(f"tagged argnum {i} out of range")
+        tagged.update(flat_per_arg[i])
+    return analyze(closed, tagged, mode=mode), closed
+
+
+def out_provenance(closed) -> list[str]:
+    """Per-outvar 'file:line:function' of the defining top-level equation
+    (the finding's provenance anchor when a declared-free output derives a
+    nonzero degree)."""
+    import jax
+
+    defined = {}
+    for eqn in closed.jaxpr.eqns:
+        frame = _user_frame(eqn)
+        for v in eqn.outvars:
+            defined[id(v)] = f"{eqn.primitive.name} @ {frame}"
+    out = []
+    for v in closed.jaxpr.outvars:
+        if isinstance(v, jax.core.Literal):
+            out.append("<literal>")
+        else:
+            out.append(defined.get(id(v), "<input>"))
+    return out
+
+
+def const_bytes(closed) -> int:
+    """Total baked-constant bytes of a ClosedJaxpr (the HTTP 413 axis:
+    remote compile ships constvars inside the program bytes)."""
+    import numpy as np
+
+    total = 0
+    for c in getattr(closed, "consts", []):
+        try:
+            total += int(np.asarray(c).nbytes)
+        except Exception:
+            pass
+    return total

@@ -679,13 +679,44 @@ def _oh_stats_kernel(alphas_ref, betas_ref, pair_ref, lens_ref, brtab_ref,
         ll_ref[:, :] = ll_scr[:, :]
 
 
-def run_stats_onehot(params, alphas2, betas2, pair2, lens2, gt, Tt):
+def beta_scale_of(fused, one_pass=False):
+    """The beta-stream scale convention a given FB launch produced:
+    ``"cs"`` (split arm — true Rabiner cs-scaled), ``"selfnorm"`` (fused
+    co-scheduled backward — per-position directions), or ``"matrix"``
+    (one-pass transfer-matrix epilogue — also directions).  Route points
+    pass this to :func:`run_stats_onehot`'s ``betas_scale`` so the r9
+    bad pairing (cs-scaled stats over self-normalized betas) is
+    unrepresentable, not merely documented."""
+    if one_pass:
+        return "matrix"
+    return "selfnorm" if fused else "cs"
+
+
+def run_stats_onehot(params, alphas2, betas2, pair2, lens2, gt, Tt, *,
+                     betas_scale="cs"):
     """Per-lane count reductions from REDUCED streams — (macc [K*K, NL],
     emit_red [S*GROUP, NL], ll [1, NL]).  emit_red buckets are
     (symbol, group member): emit_full[gt[s, c], s] = emit_red[2s + c].
     Lowers to the kernel only for power-of-two S (the flagship S=4);
     other S raise on TPU — callers fall back to the dense stats pass
-    (the XLA twin for non-TPU backends is S-generic)."""
+    (the XLA twin for non-TPU backends is S-generic).
+
+    ``betas_scale`` is the routing guard (graftcheck Layer 6's runtime
+    half): this kernel's macc is DEGREE 1 in its betas — the per-pair xi
+    terms are resolved against the split backward's true cs scaling, so
+    only ``"cs"`` betas are legal.  Fused ("selfnorm") and one-pass
+    ("matrix") betas are per-position directions; pairing them here is
+    the r9 chunked-stats bug and raises.  Those arms must route
+    :func:`run_seq_stats_onehot` (z-normalized; scale-free in betas)
+    with zero enters and an all-zero pair0 mask."""
+    if betas_scale != "cs":
+        raise ValueError(
+            f"run_stats_onehot is cs-scaled (macc is degree 1 in betas) "
+            f"but was routed {betas_scale!r} betas — self-normalized "
+            f"directions must pair with the z-normalized "
+            f"run_seq_stats_onehot (zero enters, all-zero pair0_mask); "
+            f"'that pairing is a bug' (r9, CLAUDE.md)"
+        )
     K, S = params.n_states, params.n_symbols
     Tp, _, NL = alphas2.shape
     by_sym = S & (S - 1) == 0
@@ -1599,6 +1630,38 @@ TUNE_KERNELS = {
     # prune their True candidate through before compiling it.
     "posterior_onepass": "fb.fwdbwdmat.onehot",
     "em_seq_onepass": "fb.fwdbwdmat.onehot",
+}
+
+# graftscale (Layer 6) declarations: per consumer, the homogeneity degree
+# of each output in its tagged beta-stream input ("free" = scale-free,
+# "deg:1" = positively homogeneous degree 1, "mixed" = pinned log-domain
+# — exactness there is a runtime-parity fact, not a homogeneity fact).
+# scale_contracts derives these signatures from the jaxpr dataflow and
+# CROSS-CHECKS them against this table, so the contract lives next to
+# the kernels it certifies.  The runtime half of the same invariant is
+# run_stats_onehot's betas_scale guard (beta_scale_of at route points).
+SCALE_TAGS = {
+    "run_seq_stats_onehot": {
+        "tagged": "betas2", "mode": "linear",
+        "outputs": {"macc": "free", "emit_red": "free", "ll": "free"},
+    },
+    "run_stats_onehot": {
+        # The EXACT split arm: macc carries the cs scale by construction.
+        "tagged": "betas2", "mode": "linear",
+        "outputs": {"macc": "deg:1", "emit_red": "free", "ll": "free"},
+    },
+    "conf_from_reduced": {
+        "tagged": "betas2", "mode": "linear",
+        "outputs": {"conf": "free"},
+    },
+    "contract_mat_streams": {
+        "tagged": "beta0", "mode": "linear",
+        "outputs": {"alphas2": "free", "betas2": "deg:1"},
+    },
+    "mat_loglik_lanes": {
+        "tagged": "va", "mode": "linear",
+        "outputs": {"ll": "mixed"},
+    },
 }
 
 

@@ -801,9 +801,11 @@ def batch_stats_pallas(
             else:
                 # Reduced-stream stats: 16 B/symbol read instead of 64,
                 # dense rows rebuilt in registers — no HBM scatter
-                # anywhere.  Needs the split backward's cs-scaled betas.
+                # anywhere.  Needs the split backward's cs-scaled betas;
+                # the betas_scale guard makes the fused pairing raise.
                 macc, emit_red, ll = fb_onehot.run_stats_onehot(
-                    params, al2, b2, prep.pair2, lens2, gt, Tt
+                    params, al2, b2, prep.pair2, lens2, gt, Tt,
+                    betas_scale=fb_onehot.beta_scale_of(fused=use_fused),
                 )
             trans, emit, loglik = _assemble_reduced_stats(
                 params, A, gt, macc, emit_red, ll
@@ -1997,6 +1999,7 @@ def batch_stats_pallas_stacked(
             fb_onehot.run_stats_onehot(
                 params_list[m], al_list[m], b_list[m], prep.pair2, lens2,
                 gts[m], Tt,
+                betas_scale=fb_onehot.beta_scale_of(fused=fused),
             )
             for m in range(M)
         ]
@@ -2022,3 +2025,14 @@ def batch_stats_pallas_stacked(
             )
         )
     return tuple(out)
+
+
+# graftscale (Layer 6) declarations — see fb_onehot.SCALE_TAGS for the
+# convention.  The fused posterior's gamma-normalize + MPM argmax must
+# erase any per-position beta scale (the r9 self-normalized backward).
+SCALE_TAGS = {
+    "_conf_path_from_streams": {
+        "tagged": "betas", "mode": "linear",
+        "outputs": {"conf": "free", "path": "free"},
+    },
+}

@@ -1781,3 +1781,20 @@ def decode_batch_flat_stacked_jit(
         tuple(params_list), chunks, lengths, block_size=block_size,
         return_score=return_score,
     )
+
+
+# graftscale (Layer 6) declarations — see fb_onehot.SCALE_TAGS for the
+# convention.  The true-score contract runs in MAX-PLUS mode: an additive
+# log_pi offset is the max-plus analogue of a multiplicative scale —
+# scores shift by exactly the offset (degree 1), decoded paths are
+# offset-invariant (argmax collapse).  Derived through the single-record
+# viterbi_parallel onehot route; the flat batched decoder accumulates
+# reset constants per record (genuinely position-dependent — its exact
+# per-record scores telescope at runtime, pinned by parity tests, not by
+# a homogeneity signature).
+SCALE_TAGS = {
+    "viterbi_parallel.onehot": {
+        "tagged": "log_pi offset", "mode": "maxplus",
+        "outputs": {"path": "free", "score": "deg:1"},
+    },
+}

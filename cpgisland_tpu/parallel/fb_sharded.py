@@ -455,10 +455,20 @@ def _shard_stats2d_body(
         if engine in ("pallas", "onehot"):
             from cpgisland_tpu.ops import fb_pallas
 
+            # Trace-time knob discipline (graftune's "consultation is
+            # HOST-side only"): this body is traced under shard_map/jit,
+            # so a pick_lane_T call here would freeze the tuned winner
+            # into the compiled program (no retrace when TUNING.json
+            # updates).  Callers that want the tuned winner resolve it
+            # host-side and pass ``lane_T`` explicitly (Seq2DBackend
+            # does); the in-trace fallback is the PURE rate-table
+            # heuristic — a deterministic function of the static shard
+            # shape, identical to pick_lane_T wherever no fresh tuned
+            # winner applies.
             lt = (
                 lane_T
                 if lane_T is not None
-                else fb_pallas.pick_lane_T(
+                else fb_pallas.legacy_lane_T(
                     obs_tile.shape[1], onehot=engine == "onehot",
                     # NO long lanes in the 2-D body: 131072 measured 800
                     # vs 864 (65536) / 867 (16384) Msym/s on the 32 Mi

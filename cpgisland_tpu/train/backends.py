@@ -1008,6 +1008,16 @@ class Seq2DBackend(EStepBackend):
             if engine in ("pallas", "onehot")
             else (None, None)
         )
+        if engine in ("pallas", "onehot") and lane_T is None:
+            # Resolve the tuned lane winner HOST-side (per-shard length is
+            # static here) and pass it explicitly: consultation inside the
+            # shard_map'd body would freeze the winner into the jit cache
+            # (the R8 trace-time-consult bug class) — the body's own
+            # fallback is the pure legacy heuristic only.
+            lane_T = fb_pallas.pick_lane_T(
+                chunks.shape[1] // sp, onehot=engine == "onehot",
+                long_lanes=False,
+            )
         return fb_sharded.sharded_stats2d_fn(
             mesh, self.block_size, engine, lane_T, t_tile, self.one_pass
         )

@@ -1,0 +1,23 @@
+"""jit-const-capture trigger: a big host-numpy constant built INSIDE a
+traced body becomes a jaxpr constvar baked into the compiled module (the
+HTTP 413 remote-compile cliff) — R1 can't see it, it isn't a closure."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def score(obs):
+    # 64 Mi float64 = 512 MiB baked constant, way past the budget.
+    table = np.zeros((8192, 8192))
+    return jnp.asarray(table)[obs]
+
+
+def make_body():
+    def body(carry, x):
+        # Estimable via the 1<<k shift form too.
+        offsets = np.arange(1 << 26)
+        return carry, jnp.asarray(offsets)[x]
+
+    return jax.jit(lambda c, x: jax.lax.scan(body, c, x))

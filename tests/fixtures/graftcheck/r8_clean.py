@@ -1,0 +1,25 @@
+"""trace-time-consult clean: consultation resolved HOST-side, the knob
+passed explicitly; in-trace fallbacks use the pure legacy heuristic."""
+
+import jax
+
+from cpgisland_tpu.ops import fb_pallas
+
+
+def make_stats_fn(lane_T):
+    def body(params, obs_tile):
+        # The knob arrives resolved; the in-trace fallback is the PURE
+        # rate-table heuristic (no winner-table lookup, no freeze).
+        lt = lane_T if lane_T is not None else fb_pallas.legacy_lane_T(
+            obs_tile.shape[1], onehot=True)
+        return obs_tile.reshape(lt, -1).sum()
+
+    return body
+
+
+def run(mesh, params, obs):
+    # Consult where it belongs: on the host, before the trace.
+    lane_T = fb_pallas.pick_lane_T(obs.shape[1], onehot=True)
+    body = make_stats_fn(lane_T)
+    return jax.jit(jax.shard_map(
+        body, mesh, in_specs=None, out_specs=None))(params, obs)

@@ -1,0 +1,39 @@
+"""trace-time-consult trigger: graftune consultation reachable from
+traced bodies — the winner freezes into the jit cache at trace time, so
+an applied sweep never takes effect for already-compiled programs."""
+
+import jax
+
+from cpgisland_tpu import tune
+from cpgisland_tpu.ops import fb_pallas
+
+
+@jax.jit
+def stats(obs):
+    # Direct consult inside a jit target.
+    lt = fb_pallas.pick_lane_T(obs.shape[0], onehot=True)
+    return obs.reshape(lt, -1).sum(axis=0)
+
+
+def make_stats_fn(mesh):
+    def body(params, obs_tile):
+        # The fb_sharded pattern: the def is returned and jitted by a
+        # SIBLING function — only name-based matching sees it.
+        lane = tune.tuned_lane_T(obs_tile.shape[1], onehot=True)
+        return obs_tile.reshape(lane or 8192, -1).sum()
+
+    return body
+
+
+def run(mesh, params, obs):
+    body = make_stats_fn(mesh)
+    return jax.jit(jax.shard_map(
+        body, mesh, in_specs=None, out_specs=None))(params, obs)
+
+
+def scan_driver(xs):
+    def step(carry, x):
+        bs = tune.default_block_size("decode.flat", 4096)
+        return carry + x[:bs].sum(), None
+
+    return jax.lax.scan(step, 0.0, xs)

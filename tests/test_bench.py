@@ -127,6 +127,7 @@ def test_parse_lines_covers_every_pattern():
     assert vals["span_decode_msym"] == 14.7
 
 
+@pytest.mark.slow  # tier-1 budget rebalance: >7 s CPU call (full suite + ci_checks slices still run it)
 def test_bench_decode_and_em_smoke():
     """Tiny CPU smoke of the two configs the DRIVER runs every round."""
     d = bench.bench_decode(1 << 17, engine="auto", chain=2)
@@ -135,6 +136,7 @@ def test_bench_decode_and_em_smoke():
     assert 0 < e < bench.PLAUSIBLE_MAX_SYM_PER_S
 
 
+@pytest.mark.slow  # tier-1 budget rebalance: >7 s CPU call (full suite + ci_checks slices still run it)
 def test_span_bench_asserts_continuity(monkeypatch):
     """The span config is a correctness gate, not just a timer: a path with
     NO island crossing the boundary must fail its assertion."""

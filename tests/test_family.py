@@ -281,6 +281,7 @@ def test_decode_batch_parity_dinuc_ragged():
         assert abs(float(sx[i]) - float(so[i])) <= 1e-4 * abs(float(sx[i]))
 
 
+@pytest.mark.slow  # tier-1 budget rebalance: >7 s CPU call (full suite + ci_checks slices still run it)
 def test_posterior_and_em_parity_random_partition():
     from cpgisland_tpu.parallel.posterior import posterior_sharded
     from cpgisland_tpu.train.backends import LocalBackend

@@ -256,6 +256,7 @@ def _write_fasta(path, rng, n_records=5):
     return str(path)
 
 
+@pytest.mark.slow  # tier-1 budget rebalance: >7 s CPU call (full suite + ci_checks slices still run it)
 @pytest.mark.parametrize("island_engine", ["host", "device"])
 @pytest.mark.parametrize("prefetch", [0, 2])
 def test_decode_file_recovers_from_injit_fault(
@@ -285,6 +286,7 @@ def test_decode_file_recovers_from_injit_fault(
     assert state["execs"] >= 2  # the fault really fired and was re-run
 
 
+@pytest.mark.slow  # tier-1 budget rebalance: >7 s CPU call (full suite + ci_checks slices still run it)
 @pytest.mark.parametrize("island_engine", ["host", "device"])
 @pytest.mark.parametrize("prefetch", [0, 2])
 def test_posterior_file_recovers_from_injit_fault(
@@ -311,6 +313,7 @@ def test_posterior_file_recovers_from_injit_fault(
     assert state["execs"] >= 2
 
 
+@pytest.mark.slow  # tier-1 budget rebalance: >7 s CPU call (full suite + ci_checks slices still run it)
 def test_decode_file_persistent_fault_raises(tmp_path, rng, monkeypatch):
     """A fault that never clears exhausts the bounded retries and
     propagates (no infinite loop, no silent wrong output)."""

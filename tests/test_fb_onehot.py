@@ -45,6 +45,7 @@ def test_supports():
     assert not fb_onehot.supports(dense)
 
 
+@pytest.mark.slow  # tier-1 budget rebalance: >7 s CPU call (full suite + ci_checks slices still run it)
 def test_posterior_conf_parity(rng):
     params, obs = _obs(rng, 30000)
     c_d, _ = fb_pallas.seq_posterior_pallas(
@@ -56,6 +57,7 @@ def test_posterior_conf_parity(rng):
     np.testing.assert_allclose(np.asarray(c_d), np.asarray(c_o), atol=2e-5)
 
 
+@pytest.mark.slow  # tier-1 budget rebalance: >7 s CPU call (full suite + ci_checks slices still run it)
 def test_posterior_want_path_parity(rng):
     params, obs = _obs(rng, 20000)
     c_d, p_d = fb_pallas.seq_posterior_pallas(
@@ -69,6 +71,7 @@ def test_posterior_want_path_parity(rng):
     assert np.array_equal(np.asarray(p_d), np.asarray(p_o))
 
 
+@pytest.mark.slow  # tier-1 budget rebalance: >7 s CPU call (full suite + ci_checks slices still run it)
 def test_continuation_span_parity(rng):
     """first=False spans with threaded enter/exit directions and prev_sym."""
     params, obs = _obs(rng, 24000)
@@ -90,6 +93,7 @@ def test_continuation_span_parity(rng):
     np.testing.assert_allclose(np.asarray(c_d), np.asarray(c_o), atol=2e-5)
 
 
+@pytest.mark.slow  # tier-1 budget rebalance: >7 s CPU call (full suite + ci_checks slices still run it)
 def test_transfer_total_consumed_direction(rng):
     """Raw operators differ in never-consumed rows; the consumed direction
     (in-group entering dir @ total) must agree — first AND continuation."""
@@ -118,6 +122,7 @@ def test_transfer_total_consumed_direction(rng):
         )
 
 
+@pytest.mark.slow  # tier-1 budget rebalance: >7 s CPU call (full suite + ci_checks slices still run it)
 def test_seq_stats_parity(rng):
     params, obs = _obs(rng, 40000)
     s_d = fb_pallas.seq_stats_pallas(params, obs, obs.shape[0], lane_T=4096)
@@ -173,6 +178,7 @@ def test_posterior_sharded_onehot(rng):
     np.testing.assert_allclose(np.asarray(c_x), np.asarray(c_o), atol=2e-5)
 
 
+@pytest.mark.slow  # tier-1 budget rebalance: >7 s CPU call (full suite + ci_checks slices still run it)
 def test_posterior_file_span_onehot(tmp_path, rng):
     """End-to-end: posterior_file's span threading (prev_sym included) with
     the onehot engine matches the dense engine and the unspanned run."""
@@ -248,6 +254,7 @@ def test_pick_lane_T_onehot_cost_model():
             assert cost(picked) <= cost(best) * (1 + 1e-9), (n, picked, best)
 
 
+@pytest.mark.slow  # tier-1 budget rebalance: >7 s CPU call (full suite + ci_checks slices still run it)
 def test_batch_stats_parity(rng):
     """Chunked-path batch_stats_pallas(onehot=True) vs dense.
 
@@ -279,6 +286,7 @@ def test_batch_stats_parity(rng):
     assert int(s_d.n_seqs) == int(s_o.n_seqs)
 
 
+@pytest.mark.slow  # tier-1 budget rebalance: >7 s CPU call (full suite + ci_checks slices still run it)
 def test_batch_posterior_parity(rng):
     """Batched small-record posterior, onehot vs dense, conf AND path."""
     params = presets.durbin_cpg8()

@@ -28,6 +28,7 @@ def _assert_stats_close(a, b, atol=2e-3):
     assert int(a.n_seqs) == int(b.n_seqs)
 
 
+@pytest.mark.slow  # tier-1 budget rebalance: >7 s CPU call (full suite + ci_checks slices still run it)
 def test_matches_xla_rescaled_full_chunks(rng):
     params = _random_model(rng)
     chunks = jnp.asarray(rng.integers(0, 4, size=(5, 256)))
@@ -46,6 +47,7 @@ def test_matches_xla_padded_and_empty(rng):
     _assert_stats_close(a, b)
 
 
+@pytest.mark.slow  # tier-1 budget rebalance: >7 s CPU call (full suite + ci_checks slices still run it)
 def test_durbin_preset_structural_zeros(rng):
     params = presets.durbin_cpg8()
     chunks = jnp.asarray(rng.integers(0, 4, size=(3, 192)))
@@ -66,6 +68,7 @@ def test_uneven_t_tiling(rng):
     _assert_stats_close(a, b)
 
 
+@pytest.mark.slow  # tier-1 budget rebalance: >7 s CPU call (full suite + ci_checks slices still run it)
 def test_local_backend_pallas_engine_trains(rng):
     syms = rng.integers(0, 4, size=2048).astype(np.uint8)
     ck = chunking.frame(syms, 256)
@@ -82,6 +85,7 @@ def test_local_backend_pallas_engine_trains(rng):
     )
 
 
+@pytest.mark.slow  # tier-1 budget rebalance: >7 s CPU call (full suite + ci_checks slices still run it)
 def test_spmd_backend_pallas_engine(rng):
     params = _random_model(rng)
     chunks = rng.integers(0, 4, size=(16, 128)).astype(np.uint8)
@@ -104,6 +108,7 @@ def test_engine_validation():
         backends.resolve_fb_engine("bogus", params, "rescaled")
 
 
+@pytest.mark.slow  # tier-1 budget rebalance: >7 s CPU call (full suite + ci_checks slices still run it)
 def test_t_not_multiple_of_row_tile(rng):
     """T below the t-tile and not a multiple of 8: the row-tiled forward must
     cover every position (a truncating tile loop once dropped T % 8 rows)."""
@@ -132,6 +137,7 @@ def _oracle_seq_stats(pi, A, B, obs):
     return gamma[0], xi_sum, emit, ll
 
 
+@pytest.mark.slow  # tier-1 budget rebalance: >7 s CPU call (full suite + ci_checks slices still run it)
 def test_seq_stats_pallas_matches_oracle(rng):
     """Exact whole-sequence stats with lane-boundary messages == float64
     oracle on the UNDIVIDED sequence (pairs crossing every lane counted)."""
@@ -153,6 +159,7 @@ def test_seq_stats_pallas_matches_oracle(rng):
         assert int(st.n_seqs) == 1
 
 
+@pytest.mark.slow  # tier-1 budget rebalance: >7 s CPU call (full suite + ci_checks slices still run it)
 def test_seq_stats_pallas_durbin_em_step(rng):
     """One EM step through the fused whole-sequence path == chunk-free oracle."""
     import oracle
@@ -175,6 +182,7 @@ def test_seq_stats_pallas_durbin_em_step(rng):
     np.testing.assert_allclose(np.asarray(got.B), B_o, rtol=1e-4, atol=1e-4)
 
 
+@pytest.mark.slow  # tier-1 budget rebalance: >7 s CPU call (full suite + ci_checks slices still run it)
 def test_seq_stats_pallas_padded_and_empty(rng):
     from cpgisland_tpu.ops.fb_pallas import seq_stats_pallas
 
@@ -195,6 +203,7 @@ def test_seq_stats_pallas_padded_and_empty(rng):
     np.testing.assert_array_equal(np.asarray(st0.trans), 0.0)
 
 
+@pytest.mark.slow  # tier-1 budget rebalance: >7 s CPU call (full suite + ci_checks slices still run it)
 def test_seq_stats_pallas_slow_mixing_boundary_exactness(rng):
     """Adversarial slow-mixing model: lane-boundary messages must be EXACT —
     an off-by-one in the lane-0 transfer product once cost 0.08 absolute
@@ -227,6 +236,7 @@ def test_seq_stats_pallas_rejects_misaligned_lane_T():
         seq_stats_pallas(params, obs, 960, lane_T=100, t_tile=64)
 
 
+@pytest.mark.slow  # tier-1 budget rebalance: >7 s CPU call (full suite + ci_checks slices still run it)
 def test_seq_stats_pallas_sharded_mesh_matches_oracle(rng):
     """The fused whole-sequence E-step across an 8-device mesh: per-device
     lane products + gathered boundary messages == float64 oracle on the
@@ -263,6 +273,7 @@ def test_seq_stats_pallas_sharded_mesh_matches_oracle(rng):
     assert int(st.n_seqs) == 1
 
 
+@pytest.mark.slow  # tier-1 budget rebalance: >7 s CPU call (full suite + ci_checks slices still run it)
 def test_seq_stats_pallas_sharded_sticky_boundaries(rng):
     """Device AND lane boundary messages on the adversarial slow-mixing
     model — the cross-shard pairs must be exact."""

@@ -68,6 +68,7 @@ def test_counts_boundary_pairs_chunked_path_drops(rng, mesh):
     assert float(np.asarray(stats.emit).sum()) == pytest.approx(T, rel=1e-4)
 
 
+@pytest.mark.slow  # tier-1 budget rebalance: >7 s CPU call (full suite + ci_checks slices still run it)
 def test_durbin_preset_and_block_size_invariance(rng, mesh):
     params = presets.durbin_cpg8()
     obs = rng.integers(0, 4, size=2048 + 131).astype(np.uint8)
@@ -206,6 +207,7 @@ def test_em_loglik_monotone_seq_backend(rng, mesh):
     assert all(b >= a - 1e-2 for a, b in zip(lls, lls[1:])), lls
 
 
+@pytest.mark.slow  # tier-1 budget rebalance: >7 s CPU call (full suite + ci_checks slices still run it)
 @pytest.mark.parametrize("dp,sp", [(2, 4), (4, 2)])
 def test_batch_2d_pallas_engine_matches_xla(rng, dp, sp):
     """The fused-kernel lowering of the 2-D body == the XLA lanes body
@@ -231,6 +233,7 @@ def test_batch_2d_pallas_engine_matches_xla(rng, dp, sp):
     assert int(st_pal.n_seqs) == int(st_xla.n_seqs) == 3
 
 
+@pytest.mark.slow  # tier-1 budget rebalance: >7 s CPU call (full suite + ci_checks slices still run it)
 def test_seq2d_backend_explicit_pallas_engine_parity(rng):
     """Seq2DBackend(engine='pallas') — the knob, not just the underlying fn —
     matches engine='xla' through a full fit() on the 2-D mesh."""
@@ -262,6 +265,7 @@ def test_seq2d_backend_explicit_pallas_engine_parity(rng):
     np.testing.assert_allclose(np.asarray(res_p.params.pi), np.asarray(res_x.params.pi), atol=1e-4)
 
 
+@pytest.mark.slow  # tier-1 budget rebalance: >7 s CPU call (full suite + ci_checks slices still run it)
 def test_seq_backend_explicit_engines(rng):
     """SeqBackend's new engine knob: explicit pallas == explicit xla, and an
     unsupported model errors instead of silently falling back."""
@@ -290,6 +294,7 @@ def test_seq_backend_explicit_engines(rng):
         )
 
 
+@pytest.mark.slow  # tier-1 budget rebalance: >7 s CPU call (full suite + ci_checks slices still run it)
 def test_seq2d_bucketed_matches_dense(rng):
     """Bucketed (host-memory-bounded) seq2d input produces the same
     statistics / fit trajectory as the dense [n_records, max_len] layout —
@@ -325,6 +330,7 @@ def test_seq2d_bucketed_matches_dense(rng):
     )
 
 
+@pytest.mark.slow  # tier-1 budget rebalance: >7 s CPU call (full suite + ci_checks slices still run it)
 def test_seq2d_small_record_rows_fast_path(rng):
     """Records that fit one kernel lane route to the whole-record-per-lane
     chunked fast path (fb_sharded.sharded_stats2d_rows_fn) on sp == 1

@@ -13,6 +13,8 @@ configurations the driver can invoke it from:
 """
 
 import os
+
+import pytest
 import subprocess
 import sys
 import textwrap
@@ -37,11 +39,13 @@ def test_entry_compiles_and_runs():
     fn.lower(*args).compile()
 
 
+@pytest.mark.slow  # tier-1 budget rebalance: >7 s CPU call (full suite + ci_checks slices still run it)
 def test_dryrun_inprocess_on_virtual_mesh():
     require_devices(8)
     ge.dryrun_multichip(8)
 
 
+@pytest.mark.slow  # tier-1 budget rebalance: >7 s CPU call (full suite + ci_checks slices still run it)
 def test_dryrun_self_provisions_from_foreign_platform():
     """Run dryrun_multichip(8) from a parent whose JAX has only 1 CPU device
     (no host_platform_device_count), mimicking the driver process with JAX
@@ -90,6 +94,7 @@ def test_dryrun_subprocess_failure_propagates(monkeypatch):
         raise AssertionError("expected RuntimeError from failed subprocess")
 
 
+@pytest.mark.slow  # tier-1 budget rebalance: >7 s CPU call (full suite + ci_checks slices still run it)
 def test_main_dryrun_cli_form():
     """The subprocess re-exec invokes `__graft_entry__.py --dryrun N`; check
     that exact command line works end to end with the provisioning env."""

@@ -29,6 +29,8 @@ RULES = [
     ("maxplus-normalize", os.path.join("parallel", "r4")),
     ("no-stats-in-bwd-chain", "r5"),
     ("retrace-hazard", "r6"),
+    ("jit-const-capture", "r7"),
+    ("trace-time-consult", "r8"),
 ]
 
 
@@ -160,6 +162,7 @@ def _run_cli(*args):
     )
 
 
+@pytest.mark.slow  # tier-1 budget rebalance: >7 s CPU call (full suite + ci_checks slices still run it)
 def test_cli_exits_nonzero_on_each_trigger():
     for _, stem in RULES:
         proc = _run_cli(os.path.join(FIXTURES, f"{stem}_trigger.py"))

@@ -30,6 +30,7 @@ COST_FIXTURES = os.path.join(
 )
 
 
+@pytest.mark.slow  # tier-1 budget rebalance: >7 s CPU call (full suite + ci_checks slices still run it)
 def test_self_scan_clean():
     result = run_lint([PKG], base=REPO)
     assert result.files_checked > 40
@@ -37,6 +38,7 @@ def test_self_scan_clean():
     assert bad == [], "\n".join(bad)
 
 
+@pytest.mark.slow  # tier-1 budget rebalance: >7 s CPU call (full suite + ci_checks slices still run it)
 def test_self_scan_waivers_all_used_and_justified():
     result = run_lint([PKG], base=REPO)
     # Every waiver in the tree covers a live finding (no stale exemptions)
@@ -48,6 +50,7 @@ def test_self_scan_waivers_all_used_and_justified():
         assert f.waiver_reason
 
 
+@pytest.mark.slow  # tier-1 budget rebalance: >7 s CPU call (full suite + ci_checks slices still run it)
 def test_contracts_all_hold_on_cpu():
     results = contracts.run_contracts(execute=True)
     assert len(results) >= 10

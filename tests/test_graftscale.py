@@ -1,0 +1,291 @@
+"""graftcheck Layer 6 (graftscale): scale-invariance dataflow + contracts.
+
+Covers the satellite matrix from ISSUE 18: the abstract domain's rules
+(degree arithmetic, guard literals, collapse, scan fixpoints, provenance),
+the planted r9 cs-scaled/self-normalized pairing (flagged by the dataflow
+AND refused at the runtime route guard), every shipped registry entry
+certifying clean against the committed SCALE.json, and lockfile staleness
+degrading to report-only exactly like test_graftune's freshness pins.
+"""
+
+import copy
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+from cpgisland_tpu.analysis import scale_contracts, scalemodel  # noqa: E402
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _sig(fn, args, tagged, mode="linear"):
+    report, _ = scalemodel.trace_scales(fn, args, tagged, mode=mode)
+    return report.signature(), report.out_scales
+
+
+# -- the abstract domain -----------------------------------------------------
+
+
+class TestScalemodel:
+    x = jnp.asarray(np.linspace(0.5, 2.0, 8).astype(np.float32))
+
+    def test_products_add_degrees(self):
+        sig, _ = _sig(lambda a: a * a, (self.x,), (0,))
+        assert sig == ["deg:2"]
+        sig, _ = _sig(lambda a: a * a * a, (self.x,), (0,))
+        assert sig == ["deg:3"]
+
+    def test_ratio_collapses_to_free(self):
+        sig, _ = _sig(lambda a: a / jnp.sum(a), (self.x,), (0,))
+        assert sig == ["free"]
+
+    def test_reductions_preserve_degree(self):
+        sig, _ = _sig(lambda a: (jnp.sum(a), jnp.max(a * a)), (self.x,), (0,))
+        assert sig == ["deg:1", "deg:2"]
+
+    def test_argmax_collapses(self):
+        sig, _ = _sig(lambda a: jnp.argmax(a), (self.x,), (0,))
+        assert sig == ["free"]
+
+    def test_untagged_inputs_stay_free(self):
+        sig, _ = _sig(lambda a, b: a * b, (self.x, self.x), ())
+        assert sig == ["free"]
+
+    def test_guard_zero_literal_is_any(self):
+        # a * 0.0 is exactly zero at any scale of a: degree-polymorphic,
+        # so adding an untagged term keeps the result free (the
+        # _enter_vectors v0*0.0 idiom must not poison maxplus decode).
+        sig, _ = _sig(lambda a, b: a * 0.0 + b, (self.x, self.x), (0,))
+        assert sig == ["free"]
+
+    def test_mixed_sum_carries_provenance(self):
+        sig, scales = _sig(lambda a, b: a + b, (self.x, self.x), (0,))
+        assert sig == ["mixed"]
+        assert "add" in scales[0].why and "test_graftscale" in scales[0].why
+
+    def test_transcendental_of_tagged_is_mixed(self):
+        sig, scales = _sig(lambda a: jnp.exp(a), (self.x,), (0,))
+        assert sig == ["mixed"]
+        assert "exp" in scales[0].why
+
+    def test_scan_carry_fixed_point(self):
+        def cumsum(a):
+            return jax.lax.scan(
+                lambda c, v: (c + v, c), jnp.zeros(()), a)[0]
+
+        sig, _ = _sig(cumsum, (self.x,), (0,))
+        assert sig == ["deg:1"]
+
+    def test_scan_carry_growing_degree_is_mixed(self):
+        def cumprod(a):
+            return jax.lax.scan(
+                lambda c, v: (c * v, c), jnp.ones(()), a)[0]
+
+        sig, scales = _sig(cumprod, (self.x,), (0,))
+        assert sig == ["mixed"]
+        assert "fixed point" in scales[0].why
+
+    def test_maxplus_offset_roles(self):
+        # Log space: + takes the "scale" role, max preserves, argmax
+        # erases — the true-score decode contract in miniature.
+        def fn(a, dv):
+            shifted = a + dv
+            return jnp.argmax(shifted), jnp.max(shifted)
+
+        sig, _ = _sig(fn, (self.x, jnp.float32(0.0)), (1,), mode="maxplus")
+        assert sig == ["free", "deg:1"]
+
+    def test_signature_is_stable_under_value_change(self):
+        # The analysis reads graph structure, not values.
+        a = jnp.asarray(np.random.default_rng(3).uniform(0.1, 1, 8)
+                        .astype(np.float32))
+        fn = lambda v: v / jnp.sum(v)  # noqa: E731
+        assert _sig(fn, (a,), (0,))[0] == _sig(fn, (self.x,), (0,))[0]
+
+
+# -- the planted r9 pairing: flagged by Layer 6, refused at runtime ----------
+
+
+class TestPlantedPairing:
+    def test_cs_stats_derives_degree_one_macc(self):
+        # The EXACT arm's declared truth, derived from the dataflow.
+        rec, viol = scale_contracts.derive_entry(
+            _entry_by_name("em.chunked.onehot.split"))
+        assert viol == []
+        assert rec["signature"]["macc"] == "deg:1"
+
+    def test_planted_pairing_is_exactly_one_finding_with_provenance(self):
+        # Plant the bug: declare the cs-scaled stats consumer as if it
+        # were a legal self-normalized-direction consumer (expect free).
+        import dataclasses
+
+        legal = _entry_by_name("em.chunked.onehot.split")
+        planted = dataclasses.replace(
+            legal, name="planted.cs.pairing",
+            expect={"macc": "free", "emit_red": "free", "ll": "free"},
+            tags_key="",
+        )
+        _rec, viol = scale_contracts.derive_entry(planted)
+        assert len(viol) == 1
+        msg = viol[0]
+        assert "scale.free-consumers" in msg
+        assert "macc" in msg and "deg:1" in msg
+        # Equation provenance points into the kernel module.
+        assert "fb_onehot.py" in msg
+
+    def test_runtime_guard_refuses_selfnorm_betas(self):
+        from cpgisland_tpu.ops import fb_onehot
+
+        fn, args, _ = scale_contracts._mk_cs_stats()
+        # The same streams routed with a self-normalized scale label must
+        # raise at the route point, before any kernel runs.
+        s = scale_contracts._reduced_streams()
+        for bad in ("selfnorm", "matrix"):
+            with pytest.raises(ValueError, match="pairing is a bug"):
+                fb_onehot.run_stats_onehot(
+                    s["params"], s["al2"], s["b2"], s["pair2"], s["lens2"],
+                    s["gt"], s["Tp"], betas_scale=bad)
+        # The legal routing still runs.
+        macc, emit_red, ll = fn(*args)
+        assert macc.shape[0] == s["K"] * s["K"]
+
+    def test_beta_scale_of_route_labels(self):
+        from cpgisland_tpu.ops import fb_onehot
+
+        assert fb_onehot.beta_scale_of(fused=False) == "cs"
+        assert fb_onehot.beta_scale_of(fused=True) == "selfnorm"
+        assert fb_onehot.beta_scale_of(fused=True, one_pass=True) == "matrix"
+
+
+def _entry_by_name(name):
+    entries = {e.name: e for e in scale_contracts.default_entries()}
+    return entries[name]
+
+
+# -- the shipped registry against the committed lockfile ---------------------
+
+
+@pytest.fixture(scope="module")
+def live():
+    records, violations = scale_contracts.live_entries()
+    assert violations == [], violations
+    return records
+
+
+class TestRegistry:
+    def test_declarations_match_ops_scale_tags(self):
+        assert scale_contracts.check_declarations() == []
+
+    def test_every_direction_consumer_is_free(self, live):
+        for name in ("posterior.onehot", "posterior.conf.onehot",
+                     "posterior.onehot.onepass", "em.seq.onehot",
+                     "em.chunked.onehot", "em.seq.onehot.onepass"):
+            assert set(live[name]["signature"].values()) <= {"free", "any"}, (
+                name, live[name]["signature"])
+
+    def test_exact_arms_pin_their_degrees(self, live):
+        assert live["em.chunked.onehot.split"]["signature"]["macc"] == "deg:1"
+        assert live["fb.mat.epilogue"]["signature"]["betas2"] == "deg:1"
+        assert live["decode.score.onehot"]["signature"] == {
+            "path": "free", "score": "deg:1"}
+        assert live["em.seq.onepass.loglik"]["signature"]["ll"] == "mixed"
+
+    def test_committed_lockfile_is_fresh_and_matching(self, live):
+        lock = scale_contracts.load_lockfile()
+        assert lock is not None, "SCALE.json must be committed"
+        diff = scale_contracts.diff_scales(live, lock)
+        assert diff.ok, diff.violations
+        assert diff.stale == [], (
+            "committed SCALE.json fingerprints drifted — re-derive with "
+            "--update-scale", diff.notes)
+        assert diff.checked == len(live)
+
+    def test_const_bytes_far_under_remote_budget(self, live):
+        from cpgisland_tpu.analysis import memmodel
+
+        for name, rec in live.items():
+            assert rec["const_bytes"] < memmodel.remote_const_budget(), name
+
+
+# -- lockfile lifecycle: missing / stale / drifted ---------------------------
+
+
+class TestLockfile:
+    def test_missing_lockfile_is_violation(self, live):
+        diff = scale_contracts.diff_scales(live, None)
+        assert not diff.ok
+        assert "no SCALE.json" in diff.violations[0]
+
+    def test_missing_platform_is_note_only(self, live):
+        diff = scale_contracts.diff_scales(
+            live, {"platforms": {}}, platform="cpu")
+        assert diff.ok
+        assert "no 'cpu' section" in diff.notes[0]
+
+    def test_missing_entry_is_violation(self, live):
+        lock = copy.deepcopy(scale_contracts.load_lockfile())
+        entries = lock["platforms"]["cpu"]["entries"]
+        entries.pop("posterior.onehot")
+        diff = scale_contracts.diff_scales(live, lock)
+        assert any("posterior.onehot" in v and "missing" in v
+                   for v in diff.violations)
+
+    def test_fingerprint_drift_degrades_to_report_only(self, live):
+        # The test_graftune freshness pin, Layer-6 edition: a synthetic
+        # fingerprint bump STALES the entry — note, not violation; the
+        # signature check is skipped for exactly that entry.
+        lock = copy.deepcopy(scale_contracts.load_lockfile())
+        entry = lock["platforms"]["cpu"]["entries"]["em.chunked.onehot"]
+        entry["costs_fingerprint"] = "sha256:deadbeefdeadbeef"
+        # Make the locked signature WRONG too: stale must win over drift.
+        entry["signature"] = {"macc": "deg:7", "emit_red": "free",
+                              "ll": "free"}
+        diff = scale_contracts.diff_scales(live, lock)
+        assert diff.ok, diff.violations
+        assert diff.stale == ["em.chunked.onehot"]
+        assert any("fingerprint" in n and "drifted" in n
+                   for n in diff.notes)
+        assert diff.checked == len(live) - 1
+
+    def test_signature_drift_is_violation_when_fresh(self, live):
+        lock = copy.deepcopy(scale_contracts.load_lockfile())
+        entry = lock["platforms"]["cpu"]["entries"]["posterior.onehot"]
+        entry["signature"] = {"conf": "deg:1", "path": "free"}
+        diff = scale_contracts.diff_scales(live, lock)
+        assert any("posterior.onehot" in v and "drifted" in v
+                   for v in diff.violations)
+
+    def test_write_round_trip(self, live, tmp_path):
+        path = str(tmp_path / "SCALE.json")
+        scale_contracts.write_lockfile(live, path)
+        with open(path) as f:
+            lock = json.load(f)
+        diff = scale_contracts.diff_scales(live, lock)
+        assert diff.ok and diff.checked == len(live)
+        # Stamped with the real COSTS.json fingerprints.
+        for rec in lock["platforms"]["cpu"]["entries"].values():
+            assert rec["costs_fingerprint"].startswith("sha256:")
+
+
+# -- CLI ---------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_cli_scale_pass_is_green():
+    proc = subprocess.run(
+        [sys.executable, "-m", "cpgisland_tpu.analysis",
+         "--scale", "--no-lint", "--json"],
+        capture_output=True, text=True, cwd=REPO,
+    )
+    assert proc.returncode == 0, (proc.stdout, proc.stderr)
+    payload = json.loads(proc.stdout)
+    assert payload["scale"]["ok"]
+    assert payload["scale"]["diff"]["checked"] == len(
+        scale_contracts.default_entries())

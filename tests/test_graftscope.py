@@ -280,6 +280,7 @@ def test_scope_kill_persists_flight_artifact_before_raise(tmp_path):
 # Lineage completeness
 
 
+@pytest.mark.slow  # tier-1 budget rebalance: >7 s CPU call (full suite + ci_checks slices still run it)
 def test_lineage_complete_over_mixed_multi_tenant_stream(tmp_path):
     """Every request admitted into a mixed multi-tenant journaled stream
     ends with exactly one closed trace: hops monotone in time, first hop

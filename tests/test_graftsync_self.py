@@ -10,6 +10,8 @@ the model.
 
 import os
 
+import pytest
+
 from cpgisland_tpu.analysis import run_lint, synccheck
 from cpgisland_tpu.analysis.config import SYNC_BLOCKING_OK, SYNC_UNGUARDED
 
@@ -31,6 +33,7 @@ def test_sync_self_scan_clean():
     assert bad == [], "\n".join(bad)
 
 
+@pytest.mark.slow  # tier-1 budget rebalance: >7 s CPU call (full suite + ci_checks slices still run it)
 def test_sync_waivers_none_stale_and_all_justified():
     result = run_lint([PKG], base=REPO)
     stale_sync = [

@@ -253,6 +253,7 @@ def test_stacked_default_consultation(tmp_table):
     assert BrokerConfig(stacked=True).stacked is True
 
 
+@pytest.mark.slow  # tier-1 budget rebalance: >7 s CPU call (full suite + ci_checks slices still run it)
 def test_family_estep_sequential_arm_bit_identical(tmp_table):
     """FamilyEStep(stacked=False) — the tuned fallback arm — must match
     the stacked launch per member bit for bit (the pinned contract the

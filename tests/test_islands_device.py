@@ -79,6 +79,7 @@ def test_cap_overflow_raises(rng):
     _assert_same(call_islands_device(path, cap=ei.value.n), _host(path))
 
 
+@pytest.mark.slow  # tier-1 budget rebalance: >7 s CPU call (full suite + ci_checks slices still run it)
 def test_decode_file_survives_cap_overflow(tmp_path, rng, caplog, monkeypatch):
     """An island-saturated input must complete through decode_file with a
     tiny island_cap — the pipeline auto-raises the cap and re-runs only the

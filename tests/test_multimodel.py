@@ -56,6 +56,7 @@ def _suffstats_equal(a, b):
 # kernel-level bit-identity (both lowerings: XLA twins here, kernels on TPU)
 
 
+@pytest.mark.slow  # tier-1 budget rebalance: >7 s CPU call (full suite + ci_checks slices still run it)
 @pytest.mark.parametrize("n_members", [1, 2, 3, 5])
 def test_stacked_decode_bit_identity(n_members):
     members = _cast(n_members)
@@ -77,6 +78,7 @@ def test_stacked_decode_bit_identity(n_members):
 @pytest.mark.parametrize(
     "n_members", [2, pytest.param(3, marks=pytest.mark.slow)]
 )
+@pytest.mark.slow  # tier-1 budget rebalance: >7 s CPU call (full suite + ci_checks slices still run it)
 @pytest.mark.parametrize("want_path", [False, True])
 def test_stacked_posterior_bit_identity(n_members, want_path):
     members = _cast(n_members)
@@ -178,6 +180,7 @@ def _member_objs(n):
 @pytest.mark.parametrize(
     "n_members", [2, 3, pytest.param(5, marks=pytest.mark.slow)]
 )
+@pytest.mark.slow  # tier-1 budget rebalance: >7 s CPU call (full suite + ci_checks slices still run it)
 def test_compare_stacked_vs_sequential(n_members):
     members = _member_objs(n_members)
     rng = np.random.default_rng(11)
@@ -214,6 +217,7 @@ def test_compare_dinuc_pair_lift_stacked():
         np.testing.assert_array_equal(a.conf, b.conf)
 
 
+@pytest.mark.slow  # tier-1 budget rebalance: >7 s CPU call (full suite + ci_checks slices still run it)
 def test_compare_mixed_partial_stacking():
     """Eligible members stack; dense members ride the sequential arm —
     per-member engine choice through per-member sessions, results
@@ -259,6 +263,7 @@ def test_stack_groups_singleton_not_grouped():
     assert stacked_mod.stack_groups(m_list, ["onehot"], enabled=False) == {}
 
 
+@pytest.mark.slow  # tier-1 budget rebalance: >7 s CPU call (full suite + ci_checks slices still run it)
 def test_compare_shared_placement_zero_duplicate_uploads():
     """Satellite: each order's stream is encoded/padded AND device-placed
     ONCE — the second same-order member adds ZERO upload bytes and ZERO
@@ -348,6 +353,7 @@ def _broker(reg, sess, **cfg):
     return RequestBroker(sess, BrokerConfig(**defaults), registry=reg)
 
 
+@pytest.mark.slow  # tier-1 budget rebalance: >7 s CPU call (full suite + ci_checks slices still run it)
 def test_serve_compare_flush_stacked_parity():
     """A compare flush through the stacked dispatch returns the same
     loglik/odds/winner calls as the sequential arm (a stacked=False

@@ -322,6 +322,7 @@ def test_cli_obs_flags(tmp_path, rng):
     assert recs[-1]["event"] == "obs_summary"
 
 
+@pytest.mark.slow  # tier-1 budget rebalance: >7 s CPU call (full suite + ci_checks slices still run it)
 def test_bench_metrics_sidecar_smoke(tmp_path):
     """bench.py --metrics-out writes the telemetry sidecar while stdout stays
     ONE JSON line (tiny CPU config; tier-1-safe)."""

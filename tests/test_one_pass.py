@@ -68,6 +68,7 @@ def _assert_stats_close(a, b, rtol=5e-5, atol=1e-3):
 # --- posterior: one-pass vs fused vs split vs dense --------------------------
 
 
+@pytest.mark.slow  # tier-1 budget rebalance: >7 s CPU call (full suite + ci_checks slices still run it)
 def test_posterior_conf_one_pass_parity(rng):
     params, obs = _obs(rng, 12001)  # ragged vs the lane geometry
     kw = dict(lane_T=2048, t_tile=512, onehot=True)
@@ -127,6 +128,7 @@ def test_posterior_one_pass_span_continuation(rng):
     np.testing.assert_allclose(np.asarray(c_o), np.asarray(c_s), atol=2e-5)
 
 
+@pytest.mark.slow  # tier-1 budget rebalance: >7 s CPU call (full suite + ci_checks slices still run it)
 def test_posterior_sharded_one_pass_parity(rng):
     """The driver entry over the full device mesh: one_pass=True vs False,
     plus the dense-engine cross-check."""
@@ -148,6 +150,7 @@ def test_posterior_sharded_one_pass_parity(rng):
 # --- EM: one-pass znorm stats vs the 2-pass arms -----------------------------
 
 
+@pytest.mark.slow  # tier-1 budget rebalance: >7 s CPU call (full suite + ci_checks slices still run it)
 def test_seq_stats_one_pass_parity(rng):
     params, obs = _obs(rng, 12001)
     kw = dict(lane_T=2048, onehot=True)
@@ -166,6 +169,7 @@ def test_seq_stats_one_pass_parity(rng):
     _assert_stats_close(s_one, s_dense)
 
 
+@pytest.mark.slow  # tier-1 budget rebalance: >7 s CPU call (full suite + ci_checks slices still run it)
 def test_seq_stats_one_pass_dinuc32(rng):
     """The order-2 family member: K=32 one-hot over the 16-symbol pair
     alphabet rides the same matrix-carried kernel (pow2-S reduced stats)."""
@@ -181,6 +185,7 @@ def test_seq_stats_one_pass_dinuc32(rng):
     _assert_stats_close(s_one, s_split)
 
 
+@pytest.mark.slow  # tier-1 budget rebalance: >7 s CPU call (full suite + ci_checks slices still run it)
 def test_seq_backend_one_pass_fit_trajectory(rng):
     """End-to-end: a Baum-Welch fit through SeqBackend(one_pass=True)
     reproduces the 2-pass trajectory (the training-path acceptance for the
@@ -234,6 +239,7 @@ def test_seq2d_backend_one_pass_parity(rng):
 # --- prepared streams + dispatch surface -------------------------------------
 
 
+@pytest.mark.slow  # tier-1 budget rebalance: >7 s CPU call (full suite + ci_checks slices still run it)
 def test_one_pass_prepared_vs_inline_bit_identical(rng):
     """The matrix kernel consumes the SAME pair2/pairn2 prepared fields as
     the 2-pass arm — no new prepared stream, so prepared-vs-inline stays

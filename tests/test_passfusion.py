@@ -86,6 +86,7 @@ def _assert_paths_equivalent(params, masked_obs, got, want, ctx):
 # --- posterior: fused vs split vs dense -------------------------------------
 
 
+@pytest.mark.slow  # tier-1 budget rebalance: >7 s CPU call (full suite + ci_checks slices still run it)
 def test_posterior_conf_fused_vs_split(rng):
     params, obs = _obs(rng, 30000)
     kw = dict(lane_T=4096, t_tile=512, onehot=True)
@@ -153,6 +154,7 @@ def _assert_stats_close(a, b, rtol=5e-5, atol=1e-3):
     assert int(a.n_seqs) == int(b.n_seqs)
 
 
+@pytest.mark.slow  # tier-1 budget rebalance: >7 s CPU call (full suite + ci_checks slices still run it)
 def test_seq_stats_fused_vs_split(rng):
     params, obs = _obs(rng, 40000)
     s_split = fb_pallas.seq_stats_pallas(
@@ -166,6 +168,7 @@ def test_seq_stats_fused_vs_split(rng):
     _assert_stats_close(s_fused, s_dense)
 
 
+@pytest.mark.slow  # tier-1 budget rebalance: >7 s CPU call (full suite + ci_checks slices still run it)
 def test_chunked_stats_fused_vs_split(rng):
     """Chunked E-step: the fused single-drain pass + z-normalized stats vs
     the split fwd/bwd + cs-scaled stats kernel vs the dense engine — all
@@ -186,6 +189,7 @@ def test_chunked_stats_fused_vs_split(rng):
     _assert_stats_close(s_fused, s_dense)
 
 
+@pytest.mark.slow  # tier-1 budget rebalance: >7 s CPU call (full suite + ci_checks slices still run it)
 def test_batch_posterior_fused(rng):
     params = presets.durbin_cpg8()
     N, T = 4, 2000
@@ -279,6 +283,7 @@ def test_batch_flat_scores_parity(rng, seed):
     )
 
 
+@pytest.mark.slow  # tier-1 budget rebalance: >7 s CPU call (full suite + ci_checks slices still run it)
 def test_batch_flat_score_arm_paths_identical(rng):
     """The score arm must not perturb the decoded paths (same passes, the
     dmax emission hangs off the recursion)."""
@@ -296,6 +301,7 @@ def test_batch_flat_score_arm_paths_identical(rng):
     assert np.array_equal(np.asarray(p_only), np.asarray(p_sc))
 
 
+@pytest.mark.slow  # tier-1 budget rebalance: >7 s CPU call (full suite + ci_checks slices still run it)
 def test_batch_flat_geometry_fuzz(rng):
     """Bounded flat-batch geometry fuzz (sizes small enough for the TPU
     suite run — r5's edge coverage must not stay CPU-only): random N/T/

@@ -155,6 +155,7 @@ def test_posterior_sharded_matches_oracle(rng):
     np.testing.assert_array_equal(path, np.asarray(jnp.argmax(gamma, axis=1)))
 
 
+@pytest.mark.slow  # tier-1 budget rebalance: >7 s CPU call (full suite + ci_checks slices still run it)
 def test_posterior_pallas_engine_matches_oracle(rng):
     """The fused-kernel posterior core (interpret mode off-TPU) vs oracle —
     BOTH branches: want_path=True (alphas*betas assembly) and the production

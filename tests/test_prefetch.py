@@ -146,6 +146,7 @@ def test_decode_overlapped_bit_identical(tmp_path, rng, island_engine):
     assert not _prefetch_threads()
 
 
+@pytest.mark.slow  # tier-1 budget rebalance: >7 s CPU call (full suite + ci_checks slices still run it)
 @pytest.mark.parametrize("island_engine", ["host", "device"])
 def test_posterior_overlapped_bit_identical(tmp_path, rng, island_engine):
     import io

@@ -48,6 +48,7 @@ def _assert_tree_bitwise(a, b):
         np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
 
 
+@pytest.mark.slow  # tier-1 budget rebalance: >7 s CPU call (full suite + ci_checks slices still run it)
 @pytest.mark.parametrize("onehot", [False, True])
 def test_chunked_prepared_vs_inline_bit_identity(rng, params, onehot):
     chunks, lengths = _chunks(rng)
@@ -61,6 +62,7 @@ def test_chunked_prepared_vs_inline_bit_identity(rng, params, onehot):
     _assert_tree_bitwise(inline, with_prep)
 
 
+@pytest.mark.slow  # tier-1 budget rebalance: >7 s CPU call (full suite + ci_checks slices still run it)
 @pytest.mark.parametrize("onehot", [False, True])
 def test_seq_prepared_vs_inline_bit_identity(rng, params, onehot):
     obs = jnp.asarray(rng.integers(0, 4, size=6000).astype(np.uint8))
@@ -113,6 +115,7 @@ def test_transfer_total_prepared_vs_inline(rng, params):
     np.testing.assert_array_equal(np.asarray(t0), np.asarray(t1))
 
 
+@pytest.mark.slow  # tier-1 budget rebalance: >7 s CPU call (full suite + ci_checks slices still run it)
 def test_decode_flat_prepared_vs_inline(rng, params):
     chunks = jnp.asarray(rng.integers(0, 4, size=(4, 512)).astype(np.uint8))
     lengths = jnp.full(4, 512, jnp.int32)

@@ -140,6 +140,7 @@ def _send_shutdown(sock_path):
 N_CLIENTS = 4
 
 
+@pytest.mark.slow  # tier-1 budget rebalance: >7 s CPU call (full suite + ci_checks slices still run it)
 def test_mux_concurrent_clients_bit_identical_under_tracker(
     tmp_path, tracker
 ):
@@ -345,6 +346,7 @@ def test_mux_stalled_client_does_not_wedge_other_clients(tmp_path, tracker):
     tracker.assert_clean()
 
 
+@pytest.mark.slow  # tier-1 budget rebalance: >7 s CPU call (full suite + ci_checks slices still run it)
 def test_mux_fleet_two_devices_one_quarantined_mid_stream(tmp_path, tracker):
     """PR 15 fleet under the mux + runtime tracker: a 2-device DevicePool
     serves concurrent clients; ONE device is quarantined mid-stream

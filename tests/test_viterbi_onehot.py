@@ -162,6 +162,7 @@ def test_sharded_parity(rng):
     assert np.array_equal(np.asarray(p_x), np.asarray(p_o))
 
 
+@pytest.mark.slow  # tier-1 budget rebalance: >7 s CPU call (full suite + ci_checks slices still run it)
 def test_span_parity(rng):
     """Span-threaded decode (multiple spans, boundary messages) matches the
     one-shot decode with the onehot engine on both sides."""
@@ -214,6 +215,7 @@ def test_prev0_required():
         OH.pass_products(params, steps2, None)
 
 
+@pytest.mark.slow  # tier-1 budget rebalance: >7 s CPU call (full suite + ci_checks slices still run it)
 def test_batch_flat_parity(rng):
     """decode_batch_flat (reset-step concatenation) vs per-record decode:
     paths identical on a tie-free model, ragged lengths, mid-record PADs,
@@ -275,6 +277,7 @@ def test_batch_flat_block_aligned_boundaries(rng):
         assert np.array_equal(np.asarray(flat)[i], np.asarray(ref)), i
 
 
+@pytest.mark.slow  # tier-1 budget rebalance: >7 s CPU call (full suite + ci_checks slices still run it)
 def test_batch_flat_fuzz_geometries(rng):
     """Randomized geometries / raggedness: every record's path must equal
     its standalone decode (achieved-score equality would also hold, but the

@@ -30,6 +30,7 @@ def _tie_free_params(rng, K=8, S=4):
     return HmmParams.from_probs(pi, A, B)
 
 
+@pytest.mark.slow  # tier-1 budget rebalance: >7 s CPU call (full suite + ci_checks slices still run it)
 def test_matches_oracle_small(rng):
     params = _tie_free_params(rng)
     obs = rng.integers(0, 4, size=301)
@@ -60,6 +61,7 @@ def test_matches_oracle_small(rng):
         assert (path == o_path).mean() > 0.9
 
 
+@pytest.mark.slow  # tier-1 budget rebalance: >7 s CPU call (full suite + ci_checks slices still run it)
 def test_matches_xla_parallel_exactly(rng):
     params = _tie_free_params(rng)
     obs = jnp.asarray(rng.integers(0, 4, size=8192))
@@ -69,6 +71,7 @@ def test_matches_xla_parallel_exactly(rng):
     np.testing.assert_array_equal(np.asarray(p1), np.asarray(p2))
 
 
+@pytest.mark.slow  # tier-1 budget rebalance: >7 s CPU call (full suite + ci_checks slices still run it)
 def test_durbin_preset_score_parity(rng):
     # The flagship one-hot-emission model: exact ties are possible, so compare
     # achieved path scores (both must be optimal) and island-relevant strand.
@@ -81,6 +84,7 @@ def test_durbin_preset_score_parity(rng):
     np.testing.assert_array_equal(np.asarray(p_pal) % 4, np.asarray(obs) % 4)
 
 
+@pytest.mark.slow  # tier-1 budget rebalance: >7 s CPU call (full suite + ci_checks slices still run it)
 def test_pad_symbols_are_identity_steps(rng):
     params = _tie_free_params(rng)
     base = rng.integers(0, 4, size=500)
@@ -90,6 +94,7 @@ def test_pad_symbols_are_identity_steps(rng):
     np.testing.assert_array_equal(np.asarray(p_pad)[:500], np.asarray(p_base))
 
 
+@pytest.mark.slow  # tier-1 budget rebalance: >7 s CPU call (full suite + ci_checks slices still run it)
 def test_batch_matches_xla_batch(rng):
     params = _tie_free_params(rng)
     chunks = jnp.asarray(rng.integers(0, 4, size=(3, 1024)))
@@ -100,6 +105,7 @@ def test_batch_matches_xla_batch(rng):
         np.testing.assert_array_equal(np.asarray(p1)[i, :n], np.asarray(p2)[i, :n])
 
 
+@pytest.mark.slow  # tier-1 budget rebalance: >7 s CPU call (full suite + ci_checks slices still run it)
 def test_non_multiple_block_sizes(rng):
     params = _tie_free_params(rng)
     obs = jnp.asarray(rng.integers(0, 4, size=997))  # prime length
@@ -115,6 +121,7 @@ def test_rejects_large_state_spaces(rng):
         viterbi_pallas(params, jnp.zeros(16, jnp.int32))
 
 
+@pytest.mark.slow  # tier-1 budget rebalance: >7 s CPU call (full suite + ci_checks slices still run it)
 def test_sharded_decode_pallas_engine(rng):
     """Pallas passes under shard_map on the 8-device mesh == XLA engine."""
     from conftest import require_devices

@@ -30,6 +30,17 @@ echo "== graftmem: Layer-5 memory contracts + MEMORY.json diff (CPU trace) =="
 # change.
 python -m cpgisland_tpu.analysis --no-lint --mem
 
+echo "== graftscale: Layer-6 scale contracts + SCALE.json freshness (CPU trace) =="
+# Layer 6: the jaxpr homogeneity dataflow derives each registered
+# fused/one-pass direction consumer's scale signature and checks it
+# against BOTH the ops modules' SCALE_TAGS declarations and the
+# committed SCALE.json (fingerprint-keyed on COSTS.json: a kernel
+# reshape STALES the signature to a report-only note — re-derive with
+# --update-scale).  The runtime half is fb_onehot.run_stats_onehot's
+# betas_scale route guard; the r9 "that pairing is a bug" class fails
+# HERE, statically, before any chip time is spent.
+python -m cpgisland_tpu.analysis --no-lint --scale
+
 echo "== graftsync: Layer-4 cross-module lock-order graph =="
 # The per-file concurrency rules (sync-guarded-by / sync-lock-order /
 # sync-blocking-under-lock / sync-thread-lifecycle) already ran inside the
@@ -61,7 +72,7 @@ fi
 
 echo "== tier-1 smoke =="
 python -m pytest tests/test_graftcheck.py tests/test_graftcheck_self.py \
-  tests/test_hmm.py tests/test_viterbi.py -q
+  tests/test_graftscale.py tests/test_hmm.py tests/test_viterbi.py -q
 
 echo "== fault-injection & resilience slice =="
 # The recovery machinery is only trustworthy while its injected-fault tests
