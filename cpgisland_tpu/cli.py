@@ -335,6 +335,14 @@ def build_parser() -> argparse.ArgumentParser:
         "across connections)",
     )
     sv.add_argument(
+        "--tcp", metavar="HOST:PORT", default="",
+        help="also (or instead) listen on a TCP socket — the "
+        "cross-machine door for a multi-host routing tier "
+        "(serve/router.py) or remote serve_client consumers; with "
+        "--socket both listeners feed one mux (shared request-id "
+        "space)",
+    )
+    sv.add_argument(
         "--fleet", type=int, default=0, metavar="N",
         help="device pool: one session set + flush worker per local "
         "device (first N devices; 0 = the single worker loop).  Faulting "

@@ -59,6 +59,30 @@ def device_scope(label: str) -> Iterator[None]:
         _DEVICE.label = prev
 
 
+# Pod attribution: which routed HOST's work is executing on this thread —
+# the routing tier (serve/router.py) wraps each host's flush execution in
+# host_scope, one fault-domain level above device_scope.  Same thread-local
+# construction, same "" = unattributed legacy meaning.
+_HOST = threading.local()
+
+
+def current_host() -> str:
+    return getattr(_HOST, "label", "")
+
+
+@contextlib.contextmanager
+def host_scope(label: str) -> Iterator[None]:
+    """Attribute ledger counts on this thread to host ``label`` (composes
+    with :func:`device_scope`: a fleet worker under a router carries
+    both).  ``host_scope("")`` is a no-op wrapper."""
+    prev = getattr(_HOST, "label", "")
+    _HOST.label = str(label)
+    try:
+        yield
+    finally:
+        _HOST.label = prev
+
+
 class RecompileError(RuntimeError):
     """A region asserted compile-free saw fresh XLA compiles."""
 
@@ -93,6 +117,23 @@ class Ledger:
         # their exact legacy totals, devices are a partition of the tagged
         # subset.  "" (no device_scope active) is never stored.
         self.per_device: dict[str, dict] = {}
+        # Per-HOST attribution (routing tier): same partition contract one
+        # fault-domain level up — hosts partition the host_scope-tagged
+        # subset; the globals stay the exact totals.
+        self.per_host: dict[str, dict] = {}
+
+    def _host_ent_locked(self) -> dict | None:
+        # Caller holds self._lock.
+        label = current_host()
+        if not label:
+            return None
+        ent = self.per_host.get(label)
+        if ent is None:
+            ent = self.per_host[label] = {
+                "compiles": 0, "dispatches": 0,
+                "fetch_bytes": 0, "upload_bytes": 0,
+            }
+        return ent
 
     def _device_ent_locked(self) -> dict | None:
         # Caller holds self._lock.
@@ -116,6 +157,9 @@ class Ledger:
             ent = self._device_ent_locked()
             if ent is not None:
                 ent["compiles"] += 1
+            hent = self._host_ent_locked()
+            if hent is not None:
+                hent["compiles"] += 1
             if len(self.compile_records) < _MAX_COMPILE_RECORDS:
                 self.compile_records.append(
                     {"name": name, "arg_types": arg_types,
@@ -132,6 +176,9 @@ class Ledger:
             ent = self._device_ent_locked()
             if ent is not None:
                 ent["dispatches"] += 1
+            hent = self._host_ent_locked()
+            if hent is not None:
+                hent["dispatches"] += 1
 
     def count_fetch(self, nbytes: int) -> None:
         with self._lock:
@@ -141,6 +188,10 @@ class Ledger:
             if ent is not None:
                 ent["dispatches"] += 1
                 ent["fetch_bytes"] += int(nbytes)
+            hent = self._host_ent_locked()
+            if hent is not None:
+                hent["dispatches"] += 1
+                hent["fetch_bytes"] += int(nbytes)
 
     def count_upload(self, nbytes: int) -> None:
         # An upload IS a round trip on the relay (and the docstring promises
@@ -152,6 +203,10 @@ class Ledger:
             if ent is not None:
                 ent["dispatches"] += 1
                 ent["upload_bytes"] += int(nbytes)
+            hent = self._host_ent_locked()
+            if hent is not None:
+                hent["dispatches"] += 1
+                hent["upload_bytes"] += int(nbytes)
 
     # -- span attribution ---------------------------------------------------
 
@@ -189,11 +244,19 @@ class Ledger:
                 out["per_device"] = {
                     k: dict(v) for k, v in sorted(self.per_device.items())
                 }
+            if self.per_host:
+                out["per_host"] = {
+                    k: dict(v) for k, v in sorted(self.per_host.items())
+                }
             return out
 
     def device_totals(self) -> dict:
         with self._lock:
             return {k: dict(v) for k, v in sorted(self.per_device.items())}
+
+    def host_totals(self) -> dict:
+        with self._lock:
+            return {k: dict(v) for k, v in sorted(self.per_host.items())}
 
 
 def _tree_nbytes(x) -> int:

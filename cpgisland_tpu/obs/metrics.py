@@ -180,10 +180,16 @@ class ServeMetrics:
         self._through: Dict[Tuple[str, str], List[int]] = {}
 
     def note_result(self, *, tenant: str, model: str, device: str,
-                    n_symbols: int, latency_s: float) -> None:
+                    n_symbols: int, latency_s: float,
+                    host: str = "") -> None:
         self.latency_s.observe(latency_s)
         keys = (("tenant", tenant or "-"), ("model", model or "-"),
                 ("device", device or "-"))
+        if host:
+            # Host scope only under a routing tier — single-broker daemons
+            # keep their exact legacy wire shape (snapshots/merges handle
+            # arbitrary scopes, so the conditional key merges fine).
+            keys += (("host", host),)
         with self._lock:  # graftsync: leaf lock, no I/O below
             for key in keys:
                 ent = self._through.get(key)

@@ -170,6 +170,17 @@ class Scope:
                         dev = h["device"]
                         break
             tr["device"] = dev
+            # Host memberships (routing tier): every "host" hop in order,
+            # consecutive repeats collapsed — a failed-over request shows
+            # BOTH hosts; the LAST one is the serving attribution.
+            hosts: List[str] = []
+            for h in tr["hops"]:
+                if h.get("hop") == "host" and h.get("host"):
+                    if not hosts or hosts[-1] != h["host"]:
+                        hosts.append(str(h["host"]))
+            if hosts:
+                tr["hosts"] = hosts
+            host = hosts[-1] if hosts else ""
             if len(self.traces) < self.max_traces:
                 self.traces.append(tr)
             else:
@@ -178,17 +189,20 @@ class Scope:
         # event path takes the observer's own lock and may write JSONL).
         self.metrics.note_result(
             tenant=str(tr.get("tenant", "")), model=str(tr.get("model", "")),
-            device=dev, n_symbols=int(tr.get("n_symbols", n_symbols) or 0),
+            device=dev, host=host,
+            n_symbols=int(tr.get("n_symbols", n_symbols) or 0),
             latency_s=latency)
         self.recorder.record(
             "request", id=rid, tenant=tr.get("tenant"), route=route, ok=ok,
             fault=fault, replayed=replayed, device=dev,
+            **({"host": host} if host else {}),
             latency_ms=round(latency * 1e3, 3))
         _obs.event("request_trace", id=rid,
                    tenant=tr.get("tenant"), kind=tr.get("kind"),
                    model=tr.get("model"), n_symbols=tr.get("n_symbols"),
                    route=route, ok=ok, fault=fault, replayed=replayed,
-                   device=dev, latency_s=round(latency, 6), hops=tr["hops"])
+                   device=dev, **({"hosts": hosts} if hosts else {}),
+                   latency_s=round(latency, 6), hops=tr["hops"])
 
     def flush_done(self, fid: int, *, device: str, n_requests: int,
                    symbols: int, wall_s: float) -> None:

@@ -31,6 +31,19 @@ for the harness):
   flushed to disk, which is the crash-consistency contract under test).
 - ``transport.read`` — the socket mux reader loop; ``disconnect`` raises
   OSError, modelling a connection dying mid-stream.
+- ``host.submit`` / ``host.flush`` — the routing tier's host-level
+  injection points (``serve/router.py``).  ``host.submit`` sits between
+  the router and one host's broker: ``disconnect`` models a transport
+  partition (the router records a connection fault and routes around the
+  host).  ``host.flush`` sits in the host worker's flush loop:
+  ``kill`` models host-granularity SIGKILL (the worker thread dies, the
+  router marks the host dead and fails its journaled admissions over to
+  a survivor).  Two composites complete the host catalogue without new
+  points: a ``flush.enter`` kill with ``match="@<host>"`` (the broker's
+  tag carries its ``host_label``) is a host death MID-FLUSH — admits
+  journaled, no completions; a ``journal.post_admit`` kill is a host
+  dying with an admit journal-visible but never acknowledged to the
+  queue.
 
 Determinism: each Fault matches arrivals at its point by a per-plan
 ORDINAL counter (``nth``/``times``), optionally filtered by a ``match``
@@ -69,6 +82,7 @@ __all__ = [
     "arm",
     "check",
     "disarm",
+    "host_matrix",
     "matrix",
     "wall_pad",
 ]
@@ -311,5 +325,39 @@ def matrix(seed: int, *, attempts: int = 4) -> list:
             [Fault("dispatch.wall", kind="slow", nth=rng.randint(1, 2),
                    times=2)],
             name=f"s{seed}-slow", seed=seed,
+        ),
+    ]
+
+
+def host_matrix(seed: int, *, hosts=("host0", "host1")) -> list:
+    """The host-chaos matrix for one seed: each plan kills/partitions ONE
+    host (seed-chosen victim) at a different phase of its life.  The
+    asserted outcome is plan-invariant: the surviving host completes
+    every journaled admission bit-identically, zero drops, zero double
+    executions.  ``journal.post_admit`` has no host in its tag — the
+    test kills the victim itself after the submit raises (the plan just
+    plants the crash at the phase boundary)."""
+    rng = random.Random(seed)
+    victim = hosts[rng.randrange(len(hosts))]
+    return [
+        FaultPlan(
+            [Fault("flush.enter", kind="kill", nth=rng.randint(1, 2),
+                   match=f"@{victim}")],
+            name=f"s{seed}-host-midflush-kill", seed=seed,
+        ),
+        FaultPlan(
+            [Fault("host.flush", kind="kill", nth=rng.randint(1, 3),
+                   match=victim)],
+            name=f"s{seed}-host-kill", seed=seed,
+        ),
+        FaultPlan(
+            [Fault("host.submit", kind="disconnect", nth=1, times=2,
+                   match=victim)],
+            name=f"s{seed}-host-partition", seed=seed,
+        ),
+        FaultPlan(
+            [Fault("journal.post_admit", kind="kill",
+                   nth=rng.randint(1, 3))],
+            name=f"s{seed}-host-admit-unacked", seed=seed,
         ),
     ]

@@ -113,6 +113,59 @@ def calls_from_wire(wire: Optional[dict]):
     )
 
 
+def _fold_journal_lines(lines: list, completed: dict, admitted: dict,
+                        *, path: str = "") -> int:
+    """Replay journal lines (header excluded) into the (completed,
+    admitted) maps — the ONE copy of the line-kind state machine, shared
+    by the live resume loader and the read-only :meth:`RunManifest.
+    scan_incomplete` scan so the two views of a journal can never drift.
+    ``admit`` entries keep their FULL record (payload included — both
+    callers read from disk, where payloads persist).  Tolerates a
+    truncated/unparseable tail (kill mid-append): folding stops there.
+    Returns the byte length of the intact prefix consumed."""
+    valid = 0
+    for ln in lines:
+        if not ln.endswith("\n"):
+            # Killed mid-append: everything before this line is intact,
+            # which is the resume contract (the partial tail — even a
+            # complete JSON object missing only its newline — is
+            # dropped and recomputed).
+            log.warning(
+                "manifest %s: discarding a truncated trailing line "
+                "(killed mid-append)", path,
+            )
+            break
+        try:
+            rec = json.loads(ln)
+        except json.JSONDecodeError:
+            log.warning(
+                "manifest %s: discarding an unparseable trailing line "
+                "(killed mid-append)", path,
+            )
+            break
+        valid += len(ln.encode("utf-8"))
+        if rec.get("kind") == "record":
+            completed[int(rec["index"])] = rec
+            # Resolved: the admit payload need not stay resident.
+            admitted.pop(int(rec["index"]), None)
+        elif rec.get("kind") == "admit":
+            if int(rec["index"]) in completed:
+                # An admit AFTER a completion means the id was reused
+                # for a NEW request (the broker discards a completion
+                # only on identity mismatch before re-admitting) — the
+                # old record must not shadow the newer admit, or the
+                # reused request silently vanishes from restart
+                # re-execution.
+                completed.pop(int(rec["index"]))
+            admitted[int(rec["index"])] = rec
+        elif rec.get("kind") == "fail":
+            # Terminal failure: the admit is RESOLVED (delivered as an
+            # error) — not replayable, not re-executed on restart, and
+            # the id is free for a fresh admit.
+            admitted.pop(int(rec["index"]), None)
+    return valid
+
+
 class RunManifest:
     """Append-only per-record completion log for one serving run.
 
@@ -204,45 +257,36 @@ class RunManifest:
             self._load_lines_locked(lines[1:])
 
     def _load_lines_locked(self, lines: list) -> None:
-        for ln in lines:
-            if not ln.endswith("\n"):
-                # Killed mid-append: everything before this line is intact,
-                # which is the resume contract (the partial tail — even a
-                # complete JSON object missing only its newline — is
-                # dropped and recomputed).
-                log.warning(
-                    "manifest %s: discarding a truncated trailing line "
-                    "(killed mid-append)", self.path,
-                )
-                break
-            try:
-                rec = json.loads(ln)
-            except json.JSONDecodeError:
-                log.warning(
-                    "manifest %s: discarding an unparseable trailing line "
-                    "(killed mid-append)", self.path,
-                )
-                break
-            self._valid_bytes += len(ln.encode("utf-8"))
-            if rec.get("kind") == "record":
-                self._completed[int(rec["index"])] = rec
-                # Resolved: the admit payload need not stay resident.
-                self._admitted.pop(int(rec["index"]), None)
-            elif rec.get("kind") == "admit":
-                if int(rec["index"]) in self._completed:
-                    # An admit AFTER a completion means the id was reused
-                    # for a NEW request (the broker discards a completion
-                    # only on identity mismatch before re-admitting) — the
-                    # old record must not shadow the newer admit, or the
-                    # reused request silently vanishes from restart
-                    # re-execution.
-                    self._completed.pop(int(rec["index"]))
-                self._admitted[int(rec["index"])] = rec
-            elif rec.get("kind") == "fail":
-                # Terminal failure: the admit is RESOLVED (delivered as an
-                # error) — not replayable, not re-executed on restart, and
-                # the id is free for a fresh admit.
-                self._admitted.pop(int(rec["index"]), None)
+        self._valid_bytes += _fold_journal_lines(
+            lines, self._completed, self._admitted, path=self.path
+        )
+
+    @classmethod
+    def scan_incomplete(cls, path: str) -> list:
+        """Read-only journal scan: admit records (WITH their re-execution
+        payloads) lacking a completion, in index order.  This is the
+        cross-host failover's view of a DEAD host's journal: the live
+        object's :meth:`admitted_incomplete` holds payload-free stubs
+        (nothing in-life reads payloads), so a surviving host adopting a
+        dead peer's admissions must come back to DISK, where
+        :meth:`record_admitted` persisted the full payload (flushed per
+        line).  No header validation (there is no run to validate
+        against — the adopter checks each record's key itself) and no
+        file mutation; an absent/unreadable journal scans as empty."""
+        try:
+            with open(path, encoding="utf-8") as f:
+                lines = f.read().splitlines(True)
+        except OSError:
+            return []
+        if not lines or not lines[0].endswith("\n"):
+            return []
+        completed: dict = {}
+        admitted: dict = {}
+        _fold_journal_lines(lines[1:], completed, admitted, path=path)
+        return [
+            rec for idx, rec in sorted(admitted.items())
+            if idx not in completed
+        ]
 
     # -- progress ------------------------------------------------------------
 

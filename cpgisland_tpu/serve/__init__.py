@@ -31,17 +31,25 @@ This package composes the ingredients earlier PRs built for exactly this:
   healthy device), and the never-kill slow-dispatch quarantine.  The
   single fault domain of PRs 8-9 (one worker, one device) becomes N.
 - :mod:`~cpgisland_tpu.serve.transport` — the thin **wire layer**
-  (stdin/stdout JSONL, or the multi-connection AF_UNIX socket mux:
-  concurrent client connections, one reader thread each, results routed
-  back to the owning connection by request id), kept separate from the
-  broker so tests (and the graftcheck contract) drive the broker
-  in-process.
+  (stdin/stdout JSONL, or the multi-connection socket mux — AF_UNIX
+  and/or a TCP side door, one shared accept loop: concurrent client
+  connections, one reader thread each, results routed back to the
+  owning connection by request id), kept separate from the broker so
+  tests (and the graftcheck contract) drive the broker in-process.
+- :mod:`~cpgisland_tpu.serve.router` — the **pod-scale routing tier**
+  (ROADMAP item 2): N per-host brokers behind one front that duck-types
+  as broker+pool for the transport, with per-host health state machines
+  (the fleet's model one fault-domain level up, plus terminal DEAD),
+  least-loaded elastic load shedding driven by measured flush walls
+  (``Backpressure.retry_after_s`` becomes a real contract), and
+  cross-host flush failover off a dead host's write-ahead journal.
 
 Thread contract (machine-checked by graftsync, LINT.md Layer 4): any
-thread may submit; ONE worker loop executes flushes; every shared field
-is guarded by its owner's lock, lock nesting follows the global order
-(router -> connection; session -> breaker), and nothing blocks while
-holding a registered lock.
+thread may submit; ONE worker loop executes flushes per broker; every
+shared field is guarded by its owner's lock, lock nesting follows the
+global order (router -> connection; session -> breaker; the request
+router's and each host health's locks are leaves), and nothing blocks
+while holding a registered lock.
 
 Import note: this package pulls in jax via the pipeline — the CLI imports
 it lazily inside the ``serve`` subcommand, after platform selection.
@@ -60,6 +68,12 @@ from cpgisland_tpu.serve.fleet import (  # noqa: F401
     DeviceHealth,
     DevicePool,
     FleetConfig,
+)
+from cpgisland_tpu.serve.router import (  # noqa: F401
+    HostHealth,
+    RequestRouter,
+    RouterConfig,
+    RouterHost,
 )
 from cpgisland_tpu.serve.session import Session  # noqa: F401
 from cpgisland_tpu.serve.worker import ServeLoop  # noqa: F401

@@ -61,6 +61,7 @@ import numpy as np
 from cpgisland_tpu import obs
 from cpgisland_tpu import pipeline
 from cpgisland_tpu.obs import scope as scope_mod
+from cpgisland_tpu.obs.metrics import Histogram
 from cpgisland_tpu.ops import islands as islands_mod
 from cpgisland_tpu.ops.islands import IslandCalls
 from cpgisland_tpu.resilience import faultplan
@@ -252,6 +253,14 @@ class RequestBroker:
         self.flushes = 0
         self.flushed_symbols = 0
         self._closed = False
+        # Host identity under a routing tier (serve/router.py): stamps the
+        # flush.enter fault tag (so chaos plans can target one host's
+        # flushes by match="@<label>") and the per-host ledger scope.
+        self.host_label = ""
+        # Measured flush wall (histogram, own leaf lock): feeds the
+        # retry_after_s load-shedding hint so the backoff tracks what a
+        # flush ACTUALLY costs on this host, not the static deadline.
+        self._flush_wall = Histogram()
         self.manifest = None
         self._seen_ids: set = set()
         # Ids re-queued from the admission journal on restart: released
@@ -347,12 +356,28 @@ class RequestBroker:
     # -- admission -----------------------------------------------------------
 
     def _retry_after_locked(self) -> float:
-        """Queue-depth-derived backoff hint: roughly how long the queued
-        symbols take to drain at one flush per deadline window, floored so
-        a client never busy-loops and capped so it never parks forever."""
+        """Load-derived backoff hint: roughly how long the queued symbols
+        take to drain at one flush per window, floored so a client never
+        busy-loops and capped so it never parks forever.  The per-flush
+        window is the MEASURED median flush wall once flushes have run
+        (the deadline only sets when a flush OPENS; the wall is what the
+        device actually pays to drain one) and falls back to the static
+        deadline heuristic while the histogram is empty.  Monotone in
+        queue depth for a fixed histogram state — pinned in
+        tests/test_serve_router.py."""
         depth = self._queued_symbols / float(max(1, self.config.flush_symbols))
         per_flush = max(self.config.flush_deadline_s, 0.01)
+        # Histogram.quantile returns 0.0 when empty — max() keeps the
+        # static floor until a measured wall exists.
+        per_flush = max(per_flush, self._flush_wall.quantile(0.5))
         return round(min(5.0, max(0.05, depth * per_flush)), 3)
+
+    def queue_depth(self) -> tuple:
+        """(queued requests, queued symbols) — the router's least-loaded
+        ordering key.  Replay-pending results are excluded: they cost no
+        device time."""
+        with self._lock:
+            return len(self._queue), self._queued_symbols
 
     def _manifest_key(self, req: ServeRequest) -> str:
         # Tenant + kind + MODEL are part of the identity: a decode
@@ -900,8 +925,13 @@ class RequestBroker:
                               device=device, n_requests=len(batch))
         with obs.span("serve.flush", items=total, unit="sym"):
             # graftfault kill point: "mid-flush" — after every admit line,
-            # before any completion line.
-            faultplan.check("flush.enter", tag=f"n{len(batch)}")
+            # before any completion line.  Under a router the tag carries
+            # the host label so host-granularity plans (match="@host0")
+            # kill exactly one host's flushes.
+            _tag = f"n{len(batch)}"
+            if self.host_label:
+                _tag += f"@{self.host_label}"
+            faultplan.check("flush.enter", tag=_tag)
 
             def fail(req, e: BaseException) -> None:
                 # The daemon outlives any one request: a unit whose
@@ -958,6 +988,7 @@ class RequestBroker:
                 except Exception as e:
                     fail(req, e)
         wall = time.perf_counter() - t0
+        self._flush_wall.observe(wall)
         obs.event(
             "serve_flush", n_requests=len(batch), n_flat=n_flat,
             n_singles=n_singles, n_posterior=n_posts,

@@ -410,8 +410,11 @@ class _DeviceWorker:
             # config: concurrent workers don't interfere).  The flat
             # stream is geometry-independent — any device, same bits.
             # device_scope attributes this thread's ledger counts + obs
-            # events to this device (fleet attribution, thread-local).
-            with ledger_mod.device_scope(self.label), \
+            # events to this device (fleet attribution, thread-local);
+            # host_scope adds the routing-tier host one level up (a no-op
+            # outside a router, where pool.host_label stays "").
+            with ledger_mod.host_scope(pool.host_label), \
+                    ledger_mod.device_scope(self.label), \
                     jax.default_device(self.device):
                 results = broker.run_batch(
                     pf.batch, pf.t_taken,
@@ -487,6 +490,10 @@ class DevicePool:
         self._requeued: collections.deque = collections.deque()
         self._stop = threading.Event()
         self.on_result: Optional[Callable] = None
+        # Host identity under a routing tier (serve/router.py): stamps the
+        # per-host ledger scope around every worker's flush execution.
+        # "" (no router) = legacy attribution, host_scope no-ops.
+        self.host_label = ""
         self.requeues = 0  # guarded by _lock
         self.failed_over = 0  # flushes delivered after >=1 requeue (guarded)
         cfg = self.config

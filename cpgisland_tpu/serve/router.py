@@ -1,0 +1,935 @@
+"""Pod-scale serve: a routing tier over N per-host brokers.
+
+PR 15 built the fault domain INSIDE one host (per-device health, intact
+flush requeue, the two-phase admission journal); ROADMAP item 2 left the
+level above as residue — a dead HOST still stranded every request its
+broker had admitted, and ``Backpressure.retry_after_s`` was a wire hint
+nothing enforced.  This module is the reference's Hadoop story rebuilt
+one more level up: where MAHOUT-627 re-executed a failed node's tasks
+from the JobTracker's ledger, the router re-executes a dead host's
+journaled admissions on a survivor — and because the flat reset-step
+decode stream is geometry-independent (CLAUDE.md r5), the failed-over
+work runs bit-identically on any surviving host's device count with
+ZERO new kernels.
+
+Topology: one :class:`RequestRouter` fronts N :class:`RouterHost`\\ s.
+Each host is one existing broker (plus optionally its
+:class:`~cpgisland_tpu.serve.fleet.DevicePool`); in-process hosts get a
+:class:`_HostWorker` flush thread, so the router composes with the
+transport exactly like a broker+pool pair:
+``serve_socket(path, router, pool=router)``.
+
+Three contracts:
+
+- **Host health** (:class:`HostHealth`): the DeviceHealth state machine
+  (healthy -> suspect -> quarantined -> half-open probe -> restored)
+  mirrored at host granularity, fed by the signals that exist one level
+  up: connection faults (``record_fault``), journal-replay divergence
+  (``record_divergence`` — an adopted admit whose recomputed identity
+  key no longer matches its journal line), and sustained backpressure
+  (``record_backpressure``).  Plus one terminal state devices don't
+  have: DEAD (``mark_dead`` — a host process is gone; only an operator
+  builds a new RouterHost for its replacement).
+- **Elastic load shedding**: admission routes to the least-loaded
+  serveable host (``queue_depth()`` ordering, sticky per request id so
+  duplicates/replays arbitrate on one host).  A host that refuses with
+  :class:`~cpgisland_tpu.serve.broker.Backpressure` takes a strike and
+  the next host is tried; when ALL refuse, the router raises
+  Backpressure whose ``retry_after_s`` is the MINIMUM of the hosts'
+  measured-flush-wall hints — a machine-readable shed the client obeys
+  (``tools/serve_client.py``).  A quarantined host keeps DRAINING its
+  queue (its worker has no health gate — quarantine gates routing, not
+  completion), which is the drain-via-quarantine-hooks semantics.
+- **Cross-host flush failover**: when a host dies mid-flush, its
+  write-ahead journal already holds an admit line (with the re-
+  executable payload) for every accepted-but-incomplete request.  The
+  router scans that journal from DISK (:meth:`RunManifest.
+  scan_incomplete` — the live object's stubs are payload-free by
+  design), re-routes each admission to a survivor, and when the result
+  lands appends the completion line to the DEAD host's journal — so the
+  dead host's restart finds zero incomplete admits (the superseding
+  rule) and nothing ever re-executes twice.
+
+Thread contract (graftsync Layer 4): any thread submits; each in-process
+host has ONE worker thread (the broker's single-consumer rule holds per
+host); host death spawns one tracked failover thread (joined in
+``stop``).  ``RequestRouter._lock`` guards the owner/adopted maps and
+counters and is a LEAF: it is never held across broker, manifest,
+health, or faultplan calls.  Each ``HostHealth._lock`` is a leaf except
+for obs/scope emission (the DeviceHealth shape).  The dead-journal
+completion write in ``_finish`` happens OUTSIDE every router lock (the
+manifest lock stays a global leaf).  ``hosts``/``_host_by_label`` are
+immutable after construction — read without a lock.
+"""
+
+from __future__ import annotations
+
+import base64
+import dataclasses
+import logging
+import threading
+import time
+from typing import Callable, Optional
+
+import numpy as np
+
+from cpgisland_tpu import obs
+from cpgisland_tpu.obs import ledger as ledger_mod
+from cpgisland_tpu.obs import scope as scope_mod
+from cpgisland_tpu.resilience import faultplan
+from cpgisland_tpu.resilience.manifest import RunManifest
+from cpgisland_tpu.serve.broker import Backpressure, RequestBroker
+from cpgisland_tpu.serve.fleet import (
+    HEALTHY,
+    PROBING,
+    QUARANTINED,
+    SUSPECT,
+)
+
+log = logging.getLogger(__name__)
+
+__all__ = ["HostHealth", "RequestRouter", "RouterConfig", "RouterHost",
+           "DEAD"]
+
+DEAD = "dead"
+
+
+@dataclasses.dataclass(frozen=True)
+class RouterConfig:
+    """Health/elasticity/failover policy for one :class:`RequestRouter`.
+
+    ``fault_threshold``: consecutive connection faults that quarantine a
+    host.  ``divergence_threshold``: journal-replay divergences that
+    quarantine (default 1 — a journal whose lines stop matching their
+    recomputed identity keys is corruption evidence, not a transient).
+    ``backpressure_threshold``: consecutive admission refusals that
+    quarantine (routing then drains the host via the quarantine hooks
+    until its cooldown probe).  ``cooldown_s``/``now_fn``: the half-open
+    probe clock, deterministic in tests.  ``idle_wait_s``: the host
+    worker's poll cadence.  ``failover_attempts``/``failover_retry_s``:
+    the bounded resubmission loop for a dead host's adopted admissions —
+    past the budget an admission is left for the dead host's own restart
+    re-execution (zero drops either way; the budget only bounds how long
+    the failover thread shops it around a saturated pod).
+    """
+
+    fault_threshold: int = 3
+    divergence_threshold: int = 1
+    backpressure_threshold: int = 3
+    cooldown_s: float = 30.0
+    idle_wait_s: float = 0.05
+    failover_attempts: int = 40
+    failover_retry_s: float = 0.05
+    now_fn: Callable[[], float] = time.monotonic
+
+
+class HostHealth:
+    """Per-host health state machine — :class:`~cpgisland_tpu.serve.
+    fleet.DeviceHealth` mirrored one fault-domain level up, plus the
+    terminal DEAD state.  All state is guarded by ``_lock`` (a leaf
+    except for obs/scope emission, the DeviceHealth shape).  Unlike a
+    device's, ``can_serve`` is consulted by ANY submitting thread, so
+    the half-open admission is best-effort: a few concurrent submits may
+    all land on a probing host — each is an independent success/fault
+    sample, which only speeds the verdict."""
+
+    def __init__(
+        self,
+        label: str,
+        *,
+        fault_threshold: int = 3,
+        divergence_threshold: int = 1,
+        backpressure_threshold: int = 3,
+        cooldown_s: float = 30.0,
+        now_fn: Callable[[], float] = time.monotonic,
+    ) -> None:
+        self.label = label
+        self.fault_threshold = int(fault_threshold)
+        self.divergence_threshold = int(divergence_threshold)
+        self.backpressure_threshold = int(backpressure_threshold)
+        self.cooldown_s = float(cooldown_s)
+        self.now_fn = now_fn
+        self._lock = threading.Lock()
+        self._state = HEALTHY
+        self._consecutive_faults = 0
+        self._divergences = 0
+        self._backpressure_strikes = 0
+        self._quarantined_at: Optional[float] = None
+        self.quarantines = 0
+        self.restores = 0
+        self.dead_reason: Optional[str] = None
+
+    # -- signals --------------------------------------------------------------
+
+    def record_fault(self, error: Optional[BaseException] = None) -> None:
+        """A connection-shaped failure reaching this host (submit raised
+        OSError, a flush failed at the transport boundary)."""
+        with self._lock:
+            if self._state == DEAD:
+                return
+            self._consecutive_faults += 1
+            if self._state == PROBING:
+                self._quarantine_locked("probe_failed", error)
+            elif self._state == QUARANTINED:
+                pass  # already out of rotation; nothing escalates further
+            elif self._consecutive_faults >= self.fault_threshold:
+                self._quarantine_locked("faults", error)
+            else:
+                self._state = SUSPECT
+
+    def record_divergence(self, detail: str = "") -> None:
+        """An adopted journal entry whose recomputed identity key no
+        longer matches its admit line — replay-divergence evidence."""
+        with self._lock:
+            if self._state in (DEAD, QUARANTINED):
+                self._divergences += 1
+                return
+            self._divergences += 1
+            if self._divergences >= self.divergence_threshold:
+                self._quarantine_locked(
+                    "journal_divergence",
+                    RuntimeError(detail) if detail else None,
+                )
+            else:
+                self._state = SUSPECT
+
+    def record_backpressure(self) -> None:
+        """This host refused an admission (queue caps).  Consecutive
+        refusals quarantine it out of the routing rotation — its worker
+        keeps draining (quarantine gates routing, not completion), and
+        the cooldown probe readmits it once a submit succeeds."""
+        with self._lock:
+            if self._state in (DEAD, QUARANTINED):
+                return
+            if self._state == PROBING:
+                # A probe submit that bounced is not a recovery.
+                self._quarantine_locked("backpressure", None)
+                return
+            self._backpressure_strikes += 1
+            if self._backpressure_strikes >= self.backpressure_threshold:
+                self._quarantine_locked("backpressure", None)
+            else:
+                self._state = SUSPECT
+
+    def record_success(self) -> None:
+        """A submit this host accepted — the connection-level healthy
+        signal.  Every strike family is consecutive-evidence (the
+        DeviceHealth contract): one accepted admission clears them."""
+        with self._lock:
+            if self._state in (DEAD, QUARANTINED):
+                return
+            self._consecutive_faults = 0
+            self._backpressure_strikes = 0
+            self._divergences = 0
+            if self._state == PROBING:
+                self._state = HEALTHY
+                self._quarantined_at = None
+                self.restores += 1
+                obs.event(
+                    "host_restored", host=self.label,
+                    quarantines=self.quarantines,
+                )
+                scope_mod.record("host_restored", host=self.label,
+                                 quarantines=self.quarantines)
+                log.info(
+                    "router: host %s restored (half-open probe admission "
+                    "accepted)", self.label,
+                )
+            elif self._state == SUSPECT:
+                self._state = HEALTHY
+
+    def mark_dead(self, reason: str = "") -> None:
+        """Terminal: the host process is gone.  Idempotent; only a new
+        RouterHost (operator action) replaces a dead host."""
+        with self._lock:
+            if self._state == DEAD:
+                return
+            self._state = DEAD
+            self.dead_reason = str(reason)[:200] or None
+            obs.event("host_died", host=self.label, reason=self.dead_reason)
+            scope_mod.record("host_died", host=self.label,
+                             reason=self.dead_reason)
+            log.warning(
+                "router: host %s DEAD (%s); failing its journaled "
+                "admissions over to the survivors", self.label,
+                self.dead_reason,
+            )
+
+    # -- router-side gating ---------------------------------------------------
+
+    def can_serve(self) -> bool:
+        """May the router route a fresh admission here now?  DEAD never;
+        after the cooldown a quarantined host flips to PROBING and the
+        next submit is its probe."""
+        with self._lock:
+            if self._state == DEAD:
+                return False
+            if self._state in (HEALTHY, SUSPECT, PROBING):
+                return True
+            if (
+                self._quarantined_at is not None
+                and self.now_fn() - self._quarantined_at >= self.cooldown_s
+            ):
+                self._state = PROBING
+                log.info(
+                    "router: host %s cooldown elapsed; admitting a "
+                    "half-open probe submission", self.label,
+                )
+                return True
+            return False
+
+    def force_quarantine(self, reason: str = "operator") -> None:
+        """Pull a host out of the routing rotation directly (ops drain
+        hook; its worker keeps draining the already-admitted queue)."""
+        with self._lock:
+            if self._state not in (QUARANTINED, DEAD):
+                self._quarantine_locked(reason, None)
+
+    def _quarantine_locked(self, reason: str, error) -> None:
+        # _locked suffix: callers hold self._lock (the graftsync convention).
+        self._state = QUARANTINED
+        self._quarantined_at = self.now_fn()
+        self.quarantines += 1
+        faults = self._consecutive_faults
+        self._consecutive_faults = 0
+        self._backpressure_strikes = 0
+        obs.event(
+            "host_quarantined",
+            host=self.label,
+            reason=reason,
+            consecutive_faults=faults,
+            cooldown_s=self.cooldown_s,
+            error=(f"{type(error).__name__}: {error}"[:200] if error else None),
+        )
+        scope_mod.record(
+            "host_quarantined", host=self.label, reason=reason,
+            consecutive_faults=faults, cooldown_s=self.cooldown_s,
+        )
+        log.warning(
+            "router: host %s QUARANTINED (%s) for %.0f s; routing around "
+            "it while its worker drains, a half-open probe follows the "
+            "cooldown", self.label, reason, self.cooldown_s,
+        )
+
+    def eta_s(self) -> float:
+        """Seconds until this host could plausibly serve again: 0 while
+        serveable, the remaining cooldown while quarantined, +inf when
+        dead — the all-hosts-down retry-after hint's input."""
+        with self._lock:
+            if self._state == DEAD:
+                return float("inf")
+            if self._state != QUARANTINED or self._quarantined_at is None:
+                return 0.0
+            return max(
+                0.0,
+                self.cooldown_s - (self.now_fn() - self._quarantined_at),
+            )
+
+    def state(self) -> str:
+        with self._lock:
+            return self._state
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "state": self._state,
+                "consecutive_faults": self._consecutive_faults,
+                "divergences": self._divergences,
+                "backpressure_strikes": self._backpressure_strikes,
+                "quarantines": self.quarantines,
+                "restores": self.restores,
+                "dead_reason": self.dead_reason,
+            }
+
+
+class RouterHost:
+    """One routed host: a broker (+ optional DevicePool) under a label.
+
+    Construction stamps ``host_label`` on the broker (its flush.enter
+    fault tags gain the ``@label`` suffix — what host-granularity chaos
+    plans match) and the pool (per-host ledger attribution).  Hosts with
+    a pool run the pool's own workers; hosts without one get a
+    :class:`_HostWorker` when the router starts.  Host-death
+    auto-detection (worker thread killed -> failover) is the
+    _HostWorker path; pool-backed hosts fail over via
+    :meth:`RequestRouter.fail_host` (the pool's per-device failover
+    already absorbs intra-host deaths)."""
+
+    def __init__(self, label: str, broker: RequestBroker, *,
+                 pool=None, health: Optional[HostHealth] = None) -> None:
+        self.label = str(label)
+        self.broker = broker
+        self.pool = pool
+        self.health = health  # None -> the router builds one from its config
+        self.worker: Optional[_HostWorker] = None
+        broker.host_label = self.label
+        if pool is not None:
+            pool.host_label = self.label
+
+
+class _HostWorker:
+    """One in-process host's flush loop.  Deliberately WITHOUT a
+    quarantine gate: quarantine sheds new admissions at routing time
+    while this loop keeps draining what was already admitted (the
+    drain-via-quarantine contract).  DEAD is different — it means the
+    host process is gone, so the loop exits at the next boundary (the
+    failover joins it before scanning the journal).  A SimulatedKill
+    (or any other unhandled death) escapes through ``_run_guarded``,
+    which marks the host dead and hands its journal to the router's
+    failover."""
+
+    def __init__(self, router: "RequestRouter", host: RouterHost) -> None:
+        self.router = router
+        self.host = host
+        self.flushes = 0  # this host's finished flushes (stats; own thread)
+        self._thread = threading.Thread(
+            target=self._run_guarded,
+            name=f"cpgisland-router-{host.label}", daemon=True,
+        )
+
+    def start(self) -> None:
+        self._thread.start()
+
+    def join(self, timeout: Optional[float] = None) -> None:
+        if self._thread.is_alive():
+            self._thread.join(timeout)
+
+    def _run_guarded(self) -> None:
+        # Unhandled worker death IS host death at this tier: persist the
+        # flight recorder, mark the host dead, fail its journal over to a
+        # survivor, then re-raise (daemon thread; nothing else may run
+        # here — SIGKILL semantics).
+        try:
+            self._run()
+        except BaseException as e:
+            scope_mod.on_worker_death(self.host.label, e)
+            self.router._on_host_death(self.host, e)
+            raise
+
+    # graftcheck: hot-path
+    def _run(self) -> None:
+        router = self.router
+        host = self.host
+        broker = host.broker
+        cfg = router.config
+        while (
+            not router._stop.is_set()
+            and not broker.closed
+            and host.health.state() != DEAD
+        ):
+            if not broker.poll_flush(cfg.idle_wait_s):
+                continue
+            # graftfault host kill point: host-granularity SIGKILL before
+            # the flush is taken (the journal holds admits only).
+            faultplan.check("host.flush", tag=host.label)
+            with ledger_mod.host_scope(host.label):
+                for r in broker.flush_once():
+                    router._deliver(host, r)
+            self.flushes += 1
+        log.debug("router: host worker %s exiting", host.label)
+
+
+class RequestRouter:
+    """See module docstring.  Duck-types as BOTH the broker and the pool
+    of the transport layer's contract
+    (``serve_socket(path, router, pool=router)``): any thread calls
+    :meth:`submit`/:meth:`backpressure`/:meth:`stats`; :meth:`start`
+    spins the per-host workers and :meth:`stop` joins every thread it
+    started (workers + failover threads)."""
+
+    def __init__(self, hosts, config: Optional[RouterConfig] = None) -> None:
+        if not hosts:
+            raise ValueError("RequestRouter needs at least one host")
+        self.config = config if config is not None else RouterConfig()
+        cfg = self.config
+        self.hosts: list = list(hosts)
+        for h in self.hosts:
+            if h.health is None:
+                h.health = HostHealth(
+                    h.label,
+                    fault_threshold=cfg.fault_threshold,
+                    divergence_threshold=cfg.divergence_threshold,
+                    backpressure_threshold=cfg.backpressure_threshold,
+                    cooldown_s=cfg.cooldown_s,
+                    now_fn=cfg.now_fn,
+                )
+        labels = [h.label for h in self.hosts]
+        if len(set(labels)) != len(labels):
+            raise ValueError(f"duplicate host labels: {labels}")
+        # Immutable after construction (read lock-free everywhere).
+        self._host_by_label = {h.label: h for h in self.hosts}
+        self._lock = threading.Lock()
+        # request id -> owning host label, while queued/executing there
+        # (sticky routing: duplicates and replays arbitrate on ONE host).
+        self._owner: dict[int, str] = {}
+        # request id -> (dead RouterHost, identity key) for admissions
+        # adopted off a dead host's journal: the completion is appended
+        # to the DEAD host's journal when the survivor's result lands.
+        self._adopted: dict[int, tuple] = {}
+        self._failover_threads: list = []
+        self._closed = False
+        self._stop = threading.Event()
+        self.on_result: Optional[Callable] = None
+        self.failovers = 0  # dead hosts failed over (guarded by _lock)
+        self.failed_over_requests = 0  # admissions adopted (guarded)
+
+    # -- admission (any thread) ----------------------------------------------
+
+    def submit(
+        self,
+        *,
+        request_id: int,
+        tenant: str,
+        kind: str,
+        symbols: np.ndarray,
+        name: str = "",
+        model: str = "",
+        models=None,
+    ) -> None:
+        """Route one admission (the broker ``submit`` contract: raises
+        :class:`Backpressure` when every serveable host refuses — with
+        the minimum measured-wall retry hint — RuntimeError once closed,
+        ValueError on malformed/duplicate requests, surfaced from the
+        arbitrating host)."""
+        self._route(
+            request_id=int(request_id), tenant=str(tenant), kind=str(kind),
+            symbols=symbols, name=name, model=str(model or ""), models=models,
+        )
+
+    # graftcheck: hot-path
+    def _route(self, *, request_id: int, tenant: str, kind: str, symbols,
+               name: str, model: str, models=None, exclude=(),
+               failover: bool = False) -> None:
+        rid = int(request_id)
+        with self._lock:
+            if self._closed:
+                raise RuntimeError("router is closed")
+            owner = self._owner.get(rid)
+        symbols = np.ascontiguousarray(symbols, dtype=np.uint8)
+        # The broker's manifest identity key, recomputed here for replay
+        # affinity (same format string as RequestBroker._manifest_key).
+        key = f"{kind}:{tenant}:{len(model)}:{model}:{name}"
+        targets = None
+        if owner is not None:
+            h = self._host_by_label.get(owner)
+            if h is not None and h.health.state() != DEAD:
+                # Sticky: the id is queued/executing there — re-routing it
+                # would put two live copies in flight.
+                targets = [h]
+        if targets is None:
+            # Replay affinity: a host whose journal completed this exact
+            # request serves it with zero device work.
+            for h in self.hosts:
+                if h in exclude or h.health.state() == DEAD:
+                    continue
+                m = h.broker.manifest
+                if m is not None and m.has_completion(
+                    rid, key, int(symbols.size)
+                ):
+                    targets = [h]
+                    break
+        if targets is None:
+            targets = self._targets(exclude)
+        if not targets:
+            eta = min(
+                (h.health.eta_s() for h in self.hosts
+                 if h.health.state() != DEAD),
+                default=float("inf"),
+            )
+            raise Backpressure(
+                "no healthy host (every host dead or cooling down)",
+                "no_healthy_host",
+                retry_after_s=round(min(5.0, max(0.05, eta)), 3),
+            )
+        hints: list = []
+        conn_errors = 0
+        for h in targets:
+            # Lineage BEFORE the attempt: a failed-over request's trace
+            # shows BOTH host memberships even when the submit dies here.
+            if failover:
+                scope_mod.hop(rid, "host", host=h.label, failover=True)
+            else:
+                scope_mod.hop(rid, "host", host=h.label)
+            try:
+                # graftfault host partition point: the router -> host
+                # transport boundary.
+                faultplan.check("host.submit", tag=h.label)
+                h.broker.submit(
+                    request_id=rid, tenant=tenant, kind=kind,
+                    symbols=symbols, name=name, model=model, models=models,
+                )
+            except Backpressure as e:
+                h.health.record_backpressure()
+                hints.append(
+                    e.retry_after_s if e.retry_after_s else 0.05
+                )
+                scope_mod.hop(rid, "host.reject", host=h.label,
+                              reason=e.reason)
+                continue
+            except OSError as e:
+                # Transport partition: strike the host, shed to the next.
+                h.health.record_fault(e)
+                conn_errors += 1
+                scope_mod.hop(rid, "host.reject", host=h.label,
+                              reason="connection")
+                continue
+            # ValueError (duplicate/malformed) propagates: the owning
+            # host's arbitration must stay visible to the client.
+            h.health.record_success()
+            with self._lock:
+                self._owner[rid] = h.label
+            return
+        if hints:
+            raise Backpressure(
+                f"all {len(targets)} serveable host(s) refused admission",
+                "all_hosts_saturated",
+                retry_after_s=round(min(hints), 3),
+            )
+        raise Backpressure(
+            f"no reachable host ({conn_errors} connection failure(s))",
+            "no_reachable_host", retry_after_s=0.25,
+        )
+
+    def _targets(self, exclude=()) -> list:
+        """Serveable hosts, least-loaded first (queued symbols, then
+        label for a stable total order)."""
+        avail = [
+            h for h in self.hosts
+            if h not in exclude and h.health.can_serve()
+        ]
+        return sorted(
+            avail, key=lambda h: (h.broker.queue_depth()[1], h.label)
+        )
+
+    def backpressure(self) -> bool:
+        """The pod-level soft signal the transport mirrors to clients:
+        True only when every serveable host is backpressured (or none is
+        serveable)."""
+        live = [h for h in self.hosts if h.health.can_serve()]
+        if not live:
+            return True
+        return all(h.broker.backpressure() for h in live)
+
+    def pending(self) -> int:
+        return sum(
+            h.broker.pending() for h in self.hosts
+            if h.health.state() != DEAD
+        )
+
+    # -- results --------------------------------------------------------------
+
+    # graftcheck: hot-path
+    def _deliver(self, host: RouterHost, r) -> None:
+        self._finish(host, r)
+        cb = self.on_result
+        if cb is None:
+            return
+        try:
+            cb(r)
+        except Exception:
+            log.exception("router: on_result failed for request %s", r.id)
+
+    def _finish(self, host: RouterHost, r) -> None:
+        """Routing bookkeeping for one finished result: release the
+        sticky owner, and if this id was adopted off a dead host's
+        journal, append the completion to the DEAD journal (outside
+        every router lock — the manifest lock stays a leaf) so the dead
+        host's restart finds zero incomplete admits."""
+        with self._lock:
+            self._owner.pop(r.id, None)
+            adopted = self._adopted.pop(r.id, None)
+        if adopted is None:
+            return
+        dead_host, key = adopted
+        m = dead_host.broker.manifest
+        if m is None:
+            return
+        try:
+            if r.ok:
+                m.record_done(
+                    r.id, key, int(r.n_symbols),
+                    calls=r.calls, conf_sum=r.conf_sum,
+                )
+            else:
+                m.record_failed(r.id)
+            # Flight-recorder event, NOT a hop: the trace was already
+            # completed by the serving broker's finish_flush — a hop here
+            # would open a stray live trace for a finished id.
+            scope_mod.record("journal_adopted", id=r.id,
+                             host=dead_host.label)
+        except Exception:
+            # The dead journal may be gone with its host; the result is
+            # already correct and delivered — at worst the dead host's
+            # restart re-executes (idempotent via ITS manifest replay).
+            log.exception(
+                "router: could not journal adopted completion %s into "
+                "dead host %s", r.id, dead_host.label,
+            )
+
+    # -- host death + cross-host failover -------------------------------------
+
+    def _on_host_death(self, host: RouterHost, exc: BaseException) -> None:
+        """Called from the dying worker thread (must not raise): mark the
+        host dead and hand its journal to a tracked failover thread —
+        the dying thread itself may not touch surviving brokers
+        (SIGKILL semantics: nothing else runs on the dead host)."""
+        try:
+            host.health.mark_dead(repr(exc))
+            with self._lock:
+                if self._closed or self._stop.is_set():
+                    return
+                t = threading.Thread(
+                    target=self._failover_guarded, args=(host,),
+                    name=f"cpgisland-router-failover-{host.label}",
+                    daemon=True,
+                )
+                self._failover_threads.append(t)
+            t.start()
+        except Exception:
+            log.exception(
+                "router: host-death handling for %s failed", host.label
+            )
+
+    def _failover_guarded(self, host: RouterHost) -> None:
+        try:
+            self._failover(host)
+        except Exception:
+            log.exception("router: failover off host %s failed", host.label)
+
+    def fail_host(self, label: str, reason: str = "operator") -> None:
+        """Declare a host dead and fail its journal over synchronously
+        (ops hook; tests use it for pool-backed hosts and for deaths the
+        worker guard cannot see, e.g. a kill between journal.admit and
+        queue visibility)."""
+        host = self._host_by_label[label]
+        host.health.mark_dead(reason)
+        self._failover(host)
+
+    def failover(self, label: str) -> None:
+        """Synchronous failover of an already-dead host (tests join on
+        the outcome instead of polling the background thread)."""
+        self._failover(self._host_by_label[label])
+
+    def _failover(self, host: RouterHost) -> None:
+        """Adopt every admitted-but-incomplete id from ``host``'s journal
+        onto survivors.  Reads the journal from DISK: the live manifest
+        keeps payload-free admit stubs, only the file has the
+        re-executable payloads (flushed per line — the write-ahead
+        contract is exactly what makes this scan sufficient)."""
+        m = host.broker.manifest
+        if m is None:
+            log.warning(
+                "router: dead host %s has no journal — its in-flight "
+                "admissions are not recoverable (run hosts with "
+                "manifest_path for failover)", host.label,
+            )
+            return
+        # Quiesce before scanning: a fail_host on a still-running worker
+        # must let its in-progress flush finish journaling (mark_dead
+        # already stopped the loop at its next boundary) — otherwise the
+        # disk snapshot could adopt an id that is completing concurrently
+        # and double-execute it.  When called FROM the dying worker's own
+        # failover thread the join just waits out its final raise.
+        w = host.worker
+        if w is not None and threading.current_thread() is not w._thread:
+            w.join(timeout=60.0)
+        pending = RunManifest.scan_incomplete(m.path)
+        adopted = 0
+        for rec in pending:
+            rid = int(rec["index"])
+            pay = rec.get("payload")
+            if not pay:
+                log.warning(
+                    "router: dead host %s admit %s has no payload; its "
+                    "own restart must re-execute it", host.label, rid,
+                )
+                continue
+            symbols = np.frombuffer(
+                base64.b64decode(pay["symbols"]), dtype=np.uint8
+            ).copy()
+            tenant = str(pay["tenant"])
+            kind = str(pay["kind"])
+            name = str(pay["name"])
+            model = str(pay.get("model", ""))
+            key = f"{kind}:{tenant}:{len(model)}:{model}:{name}"
+            if key != rec.get("name"):
+                host.health.record_divergence(
+                    f"admit {rid}: key {key!r} vs journal {rec.get('name')!r}"
+                )
+                log.warning(
+                    "router: dead host %s admit %s diverged from its "
+                    "journal line; skipping adoption", host.label, rid,
+                )
+                continue
+            # Register the adoption BEFORE the submit: whichever live
+            # copy completes (ours, or a client's own retry racing us)
+            # resolves the dead admit through _finish.
+            with self._lock:
+                self._adopted[rid] = (host, key)
+                self._owner.pop(rid, None)
+            if self._failover_submit(
+                rid, tenant=tenant, kind=kind, name=name, model=model,
+                symbols=symbols, dead=host,
+            ):
+                adopted += 1
+            else:
+                with self._lock:
+                    self._adopted.pop(rid, None)
+                log.error(
+                    "router: could not fail admission %s over off dead "
+                    "host %s; its restart will re-execute it (zero "
+                    "drops — delivery just waits for the restart)",
+                    rid, host.label,
+                )
+        with self._lock:
+            self.failovers += 1
+            self.failed_over_requests += adopted
+        obs.event(
+            "host_failover", host=host.label,
+            n_pending=len(pending), n_adopted=adopted,
+        )
+        scope_mod.record(
+            "host_failover", host=host.label,
+            n_pending=len(pending), n_adopted=adopted,
+        )
+        log.warning(
+            "router: host %s failed over — %d/%d journaled admission(s) "
+            "adopted by survivors", host.label, adopted, len(pending),
+        )
+
+    def _failover_submit(self, rid: int, *, tenant: str, kind: str,
+                         name: str, model: str, symbols, dead: RouterHost,
+                         ) -> bool:
+        """Bounded resubmission of one adopted admission.  Backpressure
+        waits out the shed window; a duplicate ValueError means a live
+        copy of the id exists on a survivor — drop the adoption for this
+        attempt (its completion must not be journaled under the dead
+        admit's key unless identities match) and retry: an
+        identical-identity copy completes and the next attempt replays
+        it (then _finish journals the dead admit with the correct
+        bytes); a persistently colliding DIFFERENT identity gives up and
+        leaves the admit for the dead host's own restart."""
+        cfg = self.config
+        for attempt in range(cfg.failover_attempts):
+            if attempt:
+                time.sleep(cfg.failover_retry_s)
+            with self._lock:
+                if self._closed:
+                    return False
+                if rid not in self._adopted:
+                    self._adopted[rid] = (
+                        dead, f"{kind}:{tenant}:{len(model)}:{model}:{name}"
+                    )
+            try:
+                self._route(
+                    request_id=rid, tenant=tenant, kind=kind,
+                    symbols=symbols, name=name, model=model,
+                    exclude=(dead,), failover=True,
+                )
+                return True
+            except Backpressure:
+                continue
+            except ValueError:
+                with self._lock:
+                    self._adopted.pop(rid, None)
+                continue
+            except RuntimeError:
+                return False  # router/hosts closed mid-failover
+        return False
+
+    # -- lifecycle (transport pool contract) ----------------------------------
+
+    def start(self, on_result: Callable) -> "RequestRouter":
+        self.on_result = on_result
+        for h in self.hosts:
+            if h.pool is not None:
+                h.pool.start(self._pool_sink(h))
+            else:
+                h.worker = _HostWorker(self, h)
+                h.worker.start()
+        log.info(
+            "router: started over %d host(s): %s",
+            len(self.hosts), ", ".join(h.label for h in self.hosts),
+        )
+        return self
+
+    def _pool_sink(self, host: RouterHost) -> Callable:
+        def sink(r) -> None:
+            self._deliver(host, r)
+        return sink
+
+    def stop(self, join: bool = True) -> None:
+        self._stop.set()
+        for h in self.hosts:
+            # Wake workers parked on their broker's flush condition.
+            with h.broker._cv:
+                h.broker._cv.notify_all()
+        for h in self.hosts:
+            if h.pool is not None:
+                h.pool.stop(join=join)
+            elif h.worker is not None and join:
+                h.worker.join()
+        if join:
+            with self._lock:
+                threads = list(self._failover_threads)
+            for t in threads:
+                t.join(timeout=60.0)
+
+    @property
+    def closed(self) -> bool:
+        with self._lock:
+            return self._closed
+
+    def close(self) -> None:
+        with self._lock:
+            self._closed = True
+        for h in self.hosts:
+            h.broker.close()
+
+    def release(self) -> None:
+        for h in self.hosts:
+            h.broker.release()
+            if h.pool is not None:
+                h.pool.close()
+
+    def drain(self) -> list:
+        """Drain every surviving host's queue inline (the transport's
+        shutdown path); each result still runs the routing bookkeeping
+        (_finish) so adopted completions land in their dead journals."""
+        out: list = []
+        for h in self.hosts:
+            if h.health.state() == DEAD:
+                continue
+            for r in h.broker.drain():
+                self._finish(h, r)
+                out.append(r)
+        return out
+
+    # -- introspection --------------------------------------------------------
+
+    def stats(self) -> dict:
+        with self._lock:
+            failovers = self.failovers
+            failed_over = self.failed_over_requests
+            adopted_pending = len(self._adopted)
+            routed = len(self._owner)
+        hosts: dict = {}
+        for h in self.hosts:
+            n_req, n_sym = h.broker.queue_depth()
+            ent = {
+                "health": h.health.snapshot(),
+                "queued_requests": n_req,
+                "queued_symbols": n_sym,
+            }
+            if h.worker is not None:
+                ent["flushes"] = h.worker.flushes
+            if h.pool is not None:
+                ent["fleet"] = h.pool.stats()
+            hosts[h.label] = ent
+        return {
+            "hosts": hosts,
+            "failovers": failovers,
+            "failed_over_requests": failed_over,
+            "adopted_pending": adopted_pending,
+            "routed_inflight": routed,
+        }

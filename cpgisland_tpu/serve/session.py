@@ -119,6 +119,15 @@ class Session:
             now_fn=now_fn if now_fn is not None else self._now_fn,
         )
 
+    def for_host(self, label: str, *, monitor=None, now_fn=None) -> "Session":
+        """A per-HOST clone for the routing tier (``serve/router.py``):
+        identical isolation contract one fault-domain level up — each
+        routed host gets its own breaker/supervisor/prep state, and the
+        ``@label`` session-name suffix is what graftfault host plans
+        target (``match="@host0"`` matches this clone's supervised
+        dispatch tags).  ``monitor`` is the host's health machine."""
+        return self.for_device(label, monitor=monitor, now_fn=now_fn)
+
     # -- pipeline integration -----------------------------------------------
 
     def check_call(

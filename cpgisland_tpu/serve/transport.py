@@ -82,6 +82,7 @@ __all__ = [
     "result_to_wire",
     "serve_stream",
     "serve_socket",
+    "serve_tcp",
     "serve_main",
     "ResponseRouter",
 ]
@@ -411,17 +412,32 @@ def serve_main(args, params) -> int:
             emitter = scope_mod.SnapshotEmitter(
                 scope, interval, extra_fn=_live_stats
             ).start()
+    tcp_spec = str(getattr(args, "tcp", "") or "")
     try:
-        if not args.socket:
+        if not args.socket and not tcp_spec:
             n = serve_stream(
                 sys.stdin, sys.stdout, broker,
                 invalid_symbols=args.invalid_symbols, pool=pool,
             )
             log.info("serve: %d request(s) served", n)
             return 0
+        extra: list = []
+        if tcp_spec:
+            host, port = tcp_spec.rsplit(":", 1)
+            if args.socket:
+                # Both doors, ONE mux: the AF_UNIX path for local
+                # consumers plus the TCP side door for cross-machine
+                # ones (a routing tier on another box).
+                srv = _bind_tcp(host, int(port))
+                extra.append((srv, f"tcp:{host}:{srv.getsockname()[1]}"))
+            else:
+                return serve_tcp(
+                    host, int(port), broker,
+                    invalid_symbols=args.invalid_symbols, pool=pool,
+                )
         return serve_socket(
             args.socket, broker, invalid_symbols=args.invalid_symbols,
-            pool=pool,
+            pool=pool, extra_servers=tuple(extra),
         )
     finally:
         broker.close()
@@ -727,27 +743,27 @@ def _set_send_timeout(conn, seconds: float) -> None:
         )
 
 
-def serve_socket(
-    path: str,
+def _serve_mux(
+    servers: list,
     broker: RequestBroker,
     *,
     invalid_symbols: str = "skip",
-    backlog: int = 8,
     accept_poll_s: float = 0.5,
     drain_timeout_s: float = 600.0,
     write_timeout_s: float = 60.0,
     pool=None,
 ) -> int:
-    """Concurrent AF_UNIX JSONL server (see the module docstring's mux
-    notes): one reader thread per client connection, ONE worker loop
-    executing flushes against the shared broker (or a fleet
-    :class:`~cpgisland_tpu.serve.fleet.DevicePool` — one flush worker per
-    device — when ``pool`` is given), results routed back by request id.
-    ``{"op": "shutdown"}`` from any client stops the server after
-    everything admitted has been served.  ``write_timeout_s`` bounds
-    each result write (a non-reading client is marked dead rather than
-    allowed to stall the worker)."""
-    import os
+    """The shared accept loop over a LIST of bound, listening sockets
+    (``(socket, description)`` pairs) — ONE copy of the mux regardless of
+    how many listeners feed it, so an AF_UNIX daemon and its TCP side
+    door cannot drift.  One reader thread per accepted connection, ONE
+    worker loop (or ``pool`` — a DevicePool, or a routing-tier
+    :class:`~cpgisland_tpu.serve.router.RequestRouter`) executing
+    flushes against the shared broker, one :class:`ResponseRouter`
+    delivering results back to owning connections; all listeners share
+    the daemon-wide request-id space.  ``accept_poll_s`` is the TOTAL
+    shutdown-check cadence, split across listeners.  Closes the server
+    sockets on exit (callers own path unlinking)."""
     import socket
 
     router = ResponseRouter(broker)
@@ -757,46 +773,48 @@ def serve_socket(
         loop = ServeLoop(broker, router.deliver).start()
     conns: list[tuple] = []  # LIVE (thread, client, conn); dead are reaped
     n_served = 0
-    if os.path.exists(path):
-        os.unlink(path)
-    srv = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
-    srv.bind(path)
-    srv.listen(backlog)
-    srv.settimeout(accept_poll_s)
-    log.info(
-        "serve: listening on %s (JSONL mux, concurrent connections; send "
-        "{\"op\": \"shutdown\"} to stop)", path,
-    )
+    per_poll = max(0.02, accept_poll_s / max(1, len(servers)))
+    for srv, desc in servers:
+        srv.settimeout(per_poll)
+        log.info(
+            "serve: listening on %s (JSONL mux, concurrent connections; "
+            "send {\"op\": \"shutdown\"} to stop)", desc,
+        )
     n_conns = 0
     try:
         while not broker.closed:
-            try:
-                conn, _ = srv.accept()
-            except socket.timeout:
-                continue
-            # Reap finished connections (their own finally closed the
-            # sockets) so a long-lived daemon doesn't accumulate dead
-            # thread/socket objects per served client.
-            live = []
-            for ent in conns:
-                if ent[0].is_alive():
-                    live.append(ent)
-                else:
-                    n_served += ent[1].served
-            conns = live
-            n_conns += 1
-            _set_send_timeout(conn, write_timeout_s)
-            client = _MuxClient(n_conns, conn.makefile("w", encoding="utf-8"))
-            rf = conn.makefile("r", encoding="utf-8")
-            t = threading.Thread(
-                target=_mux_client_thread,
-                args=(client, conn, rf, broker, router, invalid_symbols,
-                      drain_timeout_s, pool),
-                name=f"cpgisland-serve-conn{n_conns}",
-                daemon=True,
-            )
-            conns.append((t, client, conn))
-            t.start()
+            for srv, _desc in servers:
+                if broker.closed:
+                    break
+                try:
+                    conn, _ = srv.accept()
+                except socket.timeout:
+                    continue
+                # Reap finished connections (their own finally closed the
+                # sockets) so a long-lived daemon doesn't accumulate dead
+                # thread/socket objects per served client.
+                live = []
+                for ent in conns:
+                    if ent[0].is_alive():
+                        live.append(ent)
+                    else:
+                        n_served += ent[1].served
+                conns = live
+                n_conns += 1
+                _set_send_timeout(conn, write_timeout_s)
+                client = _MuxClient(
+                    n_conns, conn.makefile("w", encoding="utf-8")
+                )
+                rf = conn.makefile("r", encoding="utf-8")
+                t = threading.Thread(
+                    target=_mux_client_thread,
+                    args=(client, conn, rf, broker, router, invalid_symbols,
+                          drain_timeout_s, pool),
+                    name=f"cpgisland-serve-conn{n_conns}",
+                    daemon=True,
+                )
+                conns.append((t, client, conn))
+                t.start()
     except KeyboardInterrupt:
         pass
     finally:
@@ -817,12 +835,94 @@ def serve_socket(
                 conn.close()
             except OSError:
                 pass
-        srv.close()
-        if os.path.exists(path):
-            os.unlink(path)
+        for srv, _desc in servers:
+            srv.close()
         n_served += sum(c.served for _t, c, _conn in conns)
         log.info(
             "serve: socket mux served %d connection(s), %d result(s) "
             "delivered", n_conns, n_served,
         )
     return 0
+
+
+def _bind_tcp(host: str, port: int, backlog: int = 8):
+    """A bound, listening AF_INET socket (SO_REUSEADDR — daemon restarts
+    must not wait out TIME_WAIT).  Port 0 binds an ephemeral port; read
+    it back with ``getsockname()[1]``."""
+    import socket
+
+    srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    srv.bind((host, int(port)))
+    srv.listen(backlog)
+    return srv
+
+
+def serve_socket(
+    path: str,
+    broker: RequestBroker,
+    *,
+    invalid_symbols: str = "skip",
+    backlog: int = 8,
+    accept_poll_s: float = 0.5,
+    drain_timeout_s: float = 600.0,
+    write_timeout_s: float = 60.0,
+    pool=None,
+    extra_servers: tuple = (),
+) -> int:
+    """Concurrent AF_UNIX JSONL server (see the module docstring's mux
+    notes): one reader thread per client connection, ONE worker loop
+    executing flushes against the shared broker (or a fleet
+    :class:`~cpgisland_tpu.serve.fleet.DevicePool` — one flush worker per
+    device — when ``pool`` is given), results routed back by request id.
+    ``{"op": "shutdown"}`` from any client stops the server after
+    everything admitted has been served.  ``write_timeout_s`` bounds
+    each result write (a non-reading client is marked dead rather than
+    allowed to stall the worker).  ``extra_servers``: additional bound
+    ``(socket, description)`` listeners (e.g. a :func:`_bind_tcp` side
+    door) muxed into the same accept loop."""
+    import os
+
+    if os.path.exists(path):
+        os.unlink(path)
+    import socket
+
+    srv = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    srv.bind(path)
+    srv.listen(backlog)
+    try:
+        return _serve_mux(
+            [(srv, path)] + list(extra_servers), broker,
+            invalid_symbols=invalid_symbols, accept_poll_s=accept_poll_s,
+            drain_timeout_s=drain_timeout_s, write_timeout_s=write_timeout_s,
+            pool=pool,
+        )
+    finally:
+        if os.path.exists(path):
+            os.unlink(path)
+
+
+def serve_tcp(
+    host: str,
+    port: int,
+    broker: RequestBroker,
+    *,
+    invalid_symbols: str = "skip",
+    backlog: int = 8,
+    accept_poll_s: float = 0.5,
+    drain_timeout_s: float = 600.0,
+    write_timeout_s: float = 60.0,
+    pool=None,
+) -> int:
+    """The mux on a TCP listener — the cross-machine consumer's door
+    (clients on other hosts reach this broker with
+    ``tools/serve_client.py --connect tcp:HOST:PORT``).  Same protocol,
+    same shared accept loop, same id space as the AF_UNIX mux."""
+    srv = _bind_tcp(host, port, backlog)
+    bound = srv.getsockname()[1]
+    return _serve_mux(
+        [(srv, f"tcp:{host}:{bound}")], broker,
+        invalid_symbols=invalid_symbols, accept_poll_s=accept_poll_s,
+        drain_timeout_s=drain_timeout_s, write_timeout_s=write_timeout_s,
+        pool=pool,
+    )

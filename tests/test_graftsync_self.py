@@ -77,6 +77,11 @@ def test_known_locks_all_discovered():
         # graftfault plan state — all must stay inside the model.
         "cpgisland_tpu/serve/fleet.py::DevicePool._lock",
         "cpgisland_tpu/serve/fleet.py::DeviceHealth._lock",
+        # The PR 20 routing-tier locks: the router's owner/adopted maps
+        # and the per-host health machines (DeviceHealth one fault-domain
+        # level up) — both documented leaves.
+        "cpgisland_tpu/serve/router.py::RequestRouter._lock",
+        "cpgisland_tpu/serve/router.py::HostHealth._lock",
         "cpgisland_tpu/resilience/manifest.py::RunManifest._lock",
         "cpgisland_tpu/resilience/faultplan.py::_LOCK",
         "cpgisland_tpu/resilience/faultplan.py::FaultPlan._lock",

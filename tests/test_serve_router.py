@@ -1,0 +1,880 @@
+"""Pod-scale routing tier (PR 20): N per-host brokers behind one
+RequestRouter, certified the way the fleet was in PR 15 — seeded,
+deterministic host-level chaos with output BIT-IDENTICAL to the
+fault-free run, zero dropped admitted requests, and zero duplicate
+executions (journal-verified).
+
+Layers:
+
+- unit: the HostHealth state machine (DeviceHealth one fault-domain
+  level up, plus terminal DEAD) on an injected clock; the broker's
+  measured-flush-wall ``retry_after_s`` hint (monotone in queue depth,
+  capped, floored — the load-shedding contract).
+- routing: least-loaded placement across two in-process hosts,
+  bit-identical to the single-broker batch run; all-hosts-saturated
+  shedding with the minimum machine-readable retry hint; quarantined
+  hosts DRAIN their admitted queue while routing sheds around them, and
+  the half-open probe restores them.
+- chaos: the acceptance scenario — one host SIGKILLed mid-flush during
+  a mixed multi-tenant run; the survivor adopts every journaled
+  admission off the dead host's write-ahead journal, results
+  bit-identical, the dead host's restart finds ZERO incomplete admits
+  (the superseding rule), and graftscope lineage shows both host
+  memberships for every failed-over request.  Plus the seeded
+  ``faultplan.host_matrix`` swept over seeds, and the
+  admit-without-queue-visibility edge (host dies between journal.admit
+  and queue visibility).
+- wire: the mux+router stress under the graftsync LockTracker, and
+  tools/serve_client rotating to an alternate ``--connect`` endpoint
+  (AF_UNIX -> TCP side door) across a mid-stream connection death.
+"""
+
+import json
+import os
+import socket as socket_mod
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from cpgisland_tpu import obs, pipeline, resilience
+from cpgisland_tpu.analysis import tracksync
+from cpgisland_tpu.models import presets
+from cpgisland_tpu.obs import scope as scope_mod
+from cpgisland_tpu.resilience import RetryPolicy, faultplan
+from cpgisland_tpu.resilience.faultplan import Fault, FaultPlan, ManualClock
+from cpgisland_tpu.resilience.manifest import RunManifest
+from cpgisland_tpu.serve import (
+    Backpressure,
+    BrokerConfig,
+    RequestBroker,
+    Session,
+)
+from cpgisland_tpu.serve.router import (
+    DEAD,
+    HostHealth,
+    RequestRouter,
+    RouterConfig,
+    RouterHost,
+)
+
+FAST = RetryPolicy(backoff_base_s=0.0)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_resilience_state():
+    resilience.reset()  # also disarms any leaked graftfault plan
+    yield
+    resilience.reset()
+
+
+@pytest.fixture()
+def tracker():
+    # Composes with CPGISLAND_TRACKSYNC=1 (the ci_checks router slice
+    # runs this file under the session-wide tracker; uninstall is a
+    # no-op there), else installs one for the test's duration.
+    tr, uninstall = tracksync.ensure_installed()
+    try:
+        yield tr
+    finally:
+        uninstall()
+
+
+def _gen_symbols(rng, n: int) -> np.ndarray:
+    bg = rng.choice(4, size=n, p=[0.3, 0.2, 0.2, 0.3])
+    k = max(1, n // 4)
+    bg[:k] = rng.choice(4, size=k, p=[0.1, 0.4, 0.4, 0.1])
+    return bg.astype(np.uint8)
+
+
+def _requests(seed=7, n=8):
+    """Mixed multi-tenant workload: decode + posterior, two tenants."""
+    rng = np.random.default_rng(seed)
+    return [
+        (
+            i,
+            f"rec{i}",
+            "decode" if i % 3 else "posterior",
+            "a" if i % 2 else "b",
+            _gen_symbols(rng, 600 + 137 * i),
+        )
+        for i in range(n)
+    ]
+
+
+def _calls_key(calls) -> list:
+    if calls is None:
+        return []
+    return [
+        (int(calls.beg[i]), int(calls.end[i]), int(calls.length[i]),
+         float(calls.gc_content[i]), float(calls.oe_ratio[i]))
+        for i in range(len(calls))
+    ]
+
+
+def _result_key(r) -> tuple:
+    return (r.kind, _calls_key(r.calls),
+            None if r.conf_sum is None else float(r.conf_sum).hex())
+
+
+def _assert_results_identical(got: dict, want: dict) -> None:
+    assert set(got) == set(want)
+    for rid in want:
+        assert got[rid].ok, (rid, got[rid].error)
+        assert _result_key(got[rid]) == _result_key(want[rid]), rid
+
+
+def _batch_truth(recs) -> dict:
+    """Single-broker single-flush ground truth (no router geometry)."""
+    params = presets.durbin_cpg8()
+    sess = Session(params, name="truth", private_breaker=True)
+    b = RequestBroker(
+        sess, BrokerConfig(flush_symbols=1 << 20, flush_deadline_s=0.0)
+    )
+    for rid, nm, kind, tenant, syms in recs:
+        b.submit(request_id=rid, tenant=tenant, kind=kind, symbols=syms,
+                 name=nm)
+    out = {r.id: r for r in b.drain()}
+    b.close()
+    assert all(r.ok for r in out.values())
+    return out
+
+
+def _mk_hosts(tmp=None, *, manifest=True, flush_symbols=1500,
+              broker_cfg=None) -> list:
+    params = presets.durbin_cpg8()
+    hosts = []
+    for label in ("host0", "host1"):
+        sess = Session(params, name=label, private_breaker=True,
+                       retry_policy=FAST)
+        cfg = broker_cfg or BrokerConfig(
+            flush_symbols=flush_symbols, flush_deadline_s=0.01
+        )
+        kw = {}
+        if manifest:
+            tmp.mkdir(parents=True, exist_ok=True)
+            kw["manifest_path"] = str(tmp / f"{label}.journal.jsonl")
+        hosts.append(RouterHost(label, RequestBroker(sess, cfg, **kw)))
+    return hosts
+
+
+def _run_router(recs, *, plan=None, tmp=None, manifest=True,
+                config=None, timeout_s=300.0):
+    """Run ``recs`` through a 2-host router; returns ({id: result},
+    router, observed events, [ids whose submit was SIGKILLed]).
+
+    Every request is submitted BEFORE the workers start, so the
+    least-loaded placement is deterministic.  A kill escaping ``submit``
+    (the admitted-but-never-queued edge) is caught, the victim host is
+    identified by which journal holds the unacked admit, and
+    ``fail_host`` runs the synchronous failover — delivery of EVERY
+    admitted id is still required.  Exactly-once delivery is asserted.
+    """
+    hosts = _mk_hosts(tmp, manifest=manifest)
+    clock = ManualClock()
+    cfg = config or RouterConfig(
+        cooldown_s=30.0, idle_wait_s=0.01, failover_retry_s=0.01,
+        now_fn=clock,
+    )
+    router = RequestRouter(hosts, cfg)
+    results: dict = {}
+    delivered: list = []
+    done = threading.Event()
+
+    def on_result(r):
+        delivered.append(r.id)
+        results[r.id] = r
+        if len(results) >= len(recs):
+            done.set()
+
+    killed: list = []
+    ctx = faultplan.active(plan) if plan is not None else None
+    if ctx is not None:
+        ctx.__enter__()
+    try:
+        with obs.observe() as ob:
+            for rid, nm, kind, tenant, syms in recs:
+                try:
+                    router.submit(request_id=rid, tenant=tenant, kind=kind,
+                                  symbols=syms, name=nm)
+                except faultplan.SimulatedKill:
+                    killed.append(rid)
+            router.start(on_result)
+            if killed:
+                victim = None
+                for h in hosts:
+                    if h.broker.manifest is None:
+                        continue
+                    pend = {
+                        int(rec["index"]) for rec in
+                        RunManifest.scan_incomplete(h.broker.manifest.path)
+                    }
+                    if pend & set(killed):
+                        victim = h.label
+                        break
+                assert victim is not None, "unacked admit in no journal"
+                router.fail_host(victim, "admit-kill")
+            deadline = time.monotonic() + timeout_s
+            while not done.wait(timeout=0.25):
+                assert time.monotonic() < deadline, (
+                    f"undelivered: "
+                    f"{sorted(set(r[0] for r in recs) - set(results))}, "
+                    f"stats={router.stats()}"
+                )
+                clock.advance(5.0)
+    finally:
+        router.stop()
+        router.close()
+        router.release()
+        if ctx is not None:
+            ctx.__exit__(None, None, None)
+    assert len(delivered) == len(set(delivered)), (
+        f"duplicate deliveries: {sorted(delivered)}"
+    )
+    return results, router, list(ob.events), killed
+
+
+def _journal_lines(path: str) -> list:
+    return [json.loads(ln) for ln in open(path)]
+
+
+# ---------------------------------------------------------------------------
+# Unit: HostHealth state machine on an injected clock
+
+
+def test_host_health_full_cycle_on_manual_clock():
+    clock = ManualClock()
+    h = HostHealth("hX", fault_threshold=3, cooldown_s=30.0, now_fn=clock)
+    assert h.state() == "healthy" and h.can_serve()
+    h.record_fault(OSError("conn reset"))
+    assert h.state() == "suspect" and h.can_serve()
+    h.record_success()
+    assert h.state() == "healthy"  # consecutive-evidence: success clears
+    for i in range(3):
+        h.record_fault(OSError(f"f{i}"))
+    assert h.state() == "quarantined"
+    assert not h.can_serve() and h.eta_s() == pytest.approx(30.0)
+    clock.advance(29.0)
+    assert not h.can_serve()
+    clock.advance(1.5)
+    assert h.can_serve()  # flips to the half-open probe
+    assert h.state() == "probing"
+    h.record_fault(OSError("probe bounce"))
+    assert h.state() == "quarantined"  # probe failure re-quarantines
+    assert h.snapshot()["quarantines"] == 2
+    clock.advance(31.0)
+    assert h.can_serve() and h.state() == "probing"
+    h.record_success()
+    assert h.state() == "healthy"
+    assert h.snapshot()["restores"] == 1
+
+
+def test_host_health_divergence_backpressure_and_dead():
+    clock = ManualClock()
+    # Journal divergence is corruption evidence: default threshold 1.
+    hd = HostHealth("hd", divergence_threshold=1, now_fn=clock)
+    hd.record_divergence("key mismatch")
+    assert hd.state() == "quarantined"
+    assert hd.snapshot()["divergences"] == 1
+
+    # Backpressure strikes quarantine out of the ROUTING rotation only.
+    hb = HostHealth("hb", backpressure_threshold=2, now_fn=clock)
+    hb.record_backpressure()
+    assert hb.state() == "suspect"
+    hb.record_backpressure()
+    assert hb.state() == "quarantined"
+
+    # DEAD is terminal: nothing serves, eta is infinite, idempotent.
+    h = HostHealth("hx", now_fn=clock)
+    h.mark_dead("worker raised SimulatedKill")
+    assert h.state() == DEAD and not h.can_serve()
+    assert h.eta_s() == float("inf")
+    h.record_fault(OSError("late"))
+    h.record_backpressure()
+    h.record_success()
+    h.mark_dead("again")
+    snap = h.snapshot()
+    assert snap["state"] == DEAD
+    assert snap["dead_reason"] == "worker raised SimulatedKill"
+
+    # The operator drain hook.
+    hq = HostHealth("hq", now_fn=clock)
+    hq.force_quarantine("drain")
+    assert hq.state() == "quarantined"
+
+
+# ---------------------------------------------------------------------------
+# Unit: the measured-flush-wall retry_after_s load-shedding hint
+
+
+def test_retry_after_monotone_in_depth_and_tracks_measured_wall():
+    params = presets.durbin_cpg8()
+    sess = Session(params, name="hint", private_breaker=True)
+    b = RequestBroker(
+        sess, BrokerConfig(flush_symbols=1000, flush_deadline_s=0.02)
+    )
+    try:
+        # Empty histogram: the static deadline heuristic, floored/capped.
+        hints = []
+        for q in (0, 500, 1000, 5000, 50_000, 10**7):
+            b._queued_symbols = q
+            hints.append(b._retry_after_locked())
+        assert hints == sorted(hints)  # monotone in queue depth
+        assert hints[0] == 0.05  # floor: clients never busy-loop
+        assert hints[-1] == 5.0  # cap: clients never park forever
+        b._queued_symbols = 5000
+        static = b._retry_after_locked()
+        assert static == pytest.approx(5 * 0.02)
+
+        # A measured wall wider than the deadline must widen the hint:
+        # the deadline only sets when a flush OPENS, the wall is what a
+        # flush actually costs to drain.
+        for _ in range(4):
+            b._flush_wall.observe(0.8)
+        measured = b._retry_after_locked()
+        assert measured > static
+        assert measured == pytest.approx(5 * 0.8)
+        hints2 = []
+        for q in (0, 1000, 5000, 50_000):
+            b._queued_symbols = q
+            hints2.append(b._retry_after_locked())
+        assert hints2 == sorted(hints2)  # still monotone, measured arm
+        b._queued_symbols = 0
+
+        # The real admission path carries the hint on the wire exception.
+        small = RequestBroker(
+            sess, BrokerConfig(flush_symbols=1 << 20,
+                               flush_deadline_s=0.01,
+                               tenant_max_requests=1),
+        )
+        syms = _gen_symbols(np.random.default_rng(2), 300)
+        small.submit(request_id=1, tenant="a", kind="decode",
+                     symbols=syms, name="r1")
+        with pytest.raises(Backpressure) as ei:
+            small.submit(request_id=2, tenant="a", kind="decode",
+                         symbols=syms, name="r2")
+        assert ei.value.reason == "tenant_requests"
+        assert ei.value.retry_after_s >= 0.05
+        small.drain()
+        small.close()
+    finally:
+        b.close()
+
+
+# ---------------------------------------------------------------------------
+# Routing: least-loaded placement, elastic shedding, quarantine drain
+
+
+@pytest.mark.slow
+def test_least_loaded_two_host_routing_bit_identical(tmp_path):
+    recs = _requests()
+    want = _batch_truth(recs)
+    got, router, _events, killed = _run_router(recs, manifest=False)
+    assert killed == []
+    _assert_results_identical(got, want)
+    st = router.stats()
+    # Least-loaded placement really spread the pre-start submissions.
+    assert st["hosts"]["host0"]["flushes"] >= 1
+    assert st["hosts"]["host1"]["flushes"] >= 1
+    for label in ("host0", "host1"):
+        ent = st["hosts"][label]
+        assert ent["queued_requests"] == 0 and ent["queued_symbols"] == 0
+        assert ent["health"]["state"] == "healthy"
+    assert st["failovers"] == 0 and st["failed_over_requests"] == 0
+    assert st["adopted_pending"] == 0 and st["routed_inflight"] == 0
+
+
+@pytest.mark.slow
+def test_all_hosts_saturated_sheds_then_quarantine_drains_and_probes():
+    clock = ManualClock()
+    hosts = _mk_hosts(manifest=False, broker_cfg=BrokerConfig(
+        flush_symbols=1 << 20, flush_deadline_s=0.01,
+        tenant_max_requests=2,
+    ))
+    router = RequestRouter(hosts, RouterConfig(
+        backpressure_threshold=1, cooldown_s=30.0, idle_wait_s=0.01,
+        now_fn=clock,
+    ))
+    rng = np.random.default_rng(19)
+    recs = [(i, f"s{i}", "decode", "a", _gen_symbols(rng, 400 + 90 * i))
+            for i in range(5)]
+    results: dict = {}
+
+    def on_result(r):
+        results[r.id] = r
+
+    def wait_for(n, timeout_s=180.0):
+        deadline = time.monotonic() + timeout_s
+        while len(results) < n:
+            assert time.monotonic() < deadline, (
+                sorted(results), router.stats()
+            )
+            time.sleep(0.05)
+
+    try:
+        with obs.observe() as ob:
+            for rid, nm, kind, tenant, syms in recs[:4]:
+                router.submit(request_id=rid, tenant=tenant, kind=kind,
+                              symbols=syms, name=nm)
+            # Both hosts at their tenant cap: the shed is machine-readable
+            # (reason + the MINIMUM of the per-host measured-wall hints).
+            with pytest.raises(Backpressure) as ei:
+                router.submit(request_id=4, tenant="a", kind="decode",
+                              symbols=recs[4][4], name="s4")
+            assert ei.value.reason == "all_hosts_saturated"
+            assert ei.value.retry_after_s == pytest.approx(0.05)
+            # One strike each at threshold 1: both hosts quarantined; a
+            # fresh submit now finds NO serveable host and the hint is
+            # the remaining cooldown (capped).
+            for h in hosts:
+                assert h.health.state() == "quarantined"
+            with pytest.raises(Backpressure) as ei2:
+                router.submit(request_id=4, tenant="a", kind="decode",
+                              symbols=recs[4][4], name="s4")
+            assert ei2.value.reason == "no_healthy_host"
+            assert ei2.value.retry_after_s == pytest.approx(5.0)
+            assert router.backpressure()
+
+            # Drain-via-quarantine: the workers complete every admitted
+            # request while routing sheds around both hosts.
+            router.start(on_result)
+            wait_for(4)
+            assert all(results[r[0]].ok for r in recs[:4])
+
+            # Cooldown elapses -> half-open probe admission -> restore.
+            clock.advance(31.0)
+            router.submit(request_id=4, tenant="a", kind="decode",
+                          symbols=recs[4][4], name="s4")
+            wait_for(5)
+            assert results[4].ok
+    finally:
+        router.stop()
+        router.close()
+        router.release()
+    assert sum(h.health.snapshot()["restores"] for h in hosts) == 1
+    quar = [e for e in ob.events if e["event"] == "host_quarantined"]
+    assert len(quar) == 2
+    assert all(e["reason"] == "backpressure" for e in quar)
+    assert any(e["event"] == "host_restored" for e in ob.events)
+
+
+# ---------------------------------------------------------------------------
+# Reused ids across hosts: replay affinity + visible duplicate arbitration
+
+
+def test_reused_id_replays_on_owner_and_collision_stays_visible(tmp_path):
+    hosts = _mk_hosts(tmp_path, broker_cfg=BrokerConfig(
+        flush_symbols=1 << 20, flush_deadline_s=0.0
+    ))
+    router = RequestRouter(hosts, RouterConfig(idle_wait_s=0.01))
+    b0, b1 = hosts[0].broker, hosts[1].broker
+    syms = _gen_symbols(np.random.default_rng(23), 500)
+    try:
+        router.submit(request_id=9, tenant="a", kind="decode",
+                      symbols=syms, name="A")
+        (first,) = router.drain()
+        assert first.id == 9 and first.ok and not first.replayed
+
+        # Identical identity: replay AFFINITY routes it back to the host
+        # whose journal completed it — zero device work pod-wide.
+        before = (b0.flushed_symbols, b1.flushed_symbols)
+        router.submit(request_id=9, tenant="a", kind="decode",
+                      symbols=syms, name="A")
+        (again,) = router.drain()
+        assert again.replayed and again.route == "replay"
+        assert _result_key(again) == _result_key(first)
+        assert (b0.flushed_symbols, b1.flushed_symbols) == before
+
+        # A reused id with a DIFFERENT identity lands on the owning
+        # host's arbitration and the rejection stays visible through the
+        # router (never silently re-executed as a second copy).
+        with pytest.raises(ValueError, match="duplicate request id"):
+            router.submit(request_id=9, tenant="a", kind="decode",
+                          symbols=syms, name="B")
+    finally:
+        router.close()
+        router.release()
+
+
+# ---------------------------------------------------------------------------
+# Chaos: the acceptance scenario — host SIGKILL mid-flush
+
+
+@pytest.mark.slow
+# The worker thread re-raises SimulatedKill by contract (SIGKILL: nothing
+# else may run on the dead host) — pytest's thread-exception warning is
+# the expected trace of that, not a leak.
+@pytest.mark.filterwarnings(
+    "ignore::pytest.PytestUnhandledThreadExceptionWarning"
+)
+def test_host_sigkill_mid_flush_fails_over_bit_identical(tmp_path):
+    """One host SIGKILLed mid-flush during a mixed multi-tenant run: the
+    surviving host completes every admitted request bit-identically to
+    the fault-free run, zero drops, zero duplicate executions
+    (journal-verified), and each failed-over request's lineage shows
+    BOTH host memberships."""
+    recs = _requests()
+    sizes = {r[0]: int(r[4].size) for r in recs}
+    clean, _r0, _e0, _k0 = _run_router(recs, tmp=tmp_path / "clean")
+
+    plan = FaultPlan(
+        [Fault("flush.enter", kind="kill", nth=1, match="@host0")],
+        name="host0-midflush-kill",
+    )
+    sc = scope_mod.install(
+        scope_mod.Scope(flight_path=str(tmp_path / "router.flight.json"))
+    )
+    try:
+        chaos, router, events, killed = _run_router(
+            recs, plan=plan, tmp=tmp_path / "chaos"
+        )
+    finally:
+        scope_mod.uninstall(sc)
+    assert killed == []  # the kill fired in host0's worker, not submit
+    _assert_results_identical(chaos, clean)
+
+    # Zero duplicate executions, ledger-side: host0 finished NOTHING (a
+    # killed flush never reaches finish_flush), the survivor executed
+    # every symbol exactly once.
+    b0 = router._host_by_label["host0"].broker
+    b1 = router._host_by_label["host1"].broker
+    assert b0.flushed_symbols == 0
+    assert b1.flushed_symbols == sum(sizes.values())
+
+    # Lineage: every request closed ok; the failed-over ones crossed
+    # host0 -> host1 with the failover marker on the second membership.
+    traces = {tr["id"]: tr for tr in sc.traces}
+    assert sorted(traces) == sorted(sizes)  # zero drops
+    adopted = {rid for rid, tr in traces.items()
+               if tr.get("hosts") == ["host0", "host1"]}
+    assert adopted
+    for rid, tr in traces.items():
+        assert tr["ok"], rid
+        if rid in adopted:
+            hh = [h for h in tr["hops"] if h["hop"] == "host"]
+            assert [h["host"] for h in hh] == ["host0", "host1"]
+            assert hh[0].get("failover") is None
+            assert hh[1].get("failover") is True
+        else:
+            assert tr.get("hosts") == ["host1"]
+    # The killed flush's members carry BOTH flush memberships (the
+    # flush.enter hop lands before the kill point by contract).
+    assert any(
+        len([h for h in traces[rid]["hops"] if h["hop"] == "flush.enter"])
+        >= 2
+        for rid in adopted
+    )
+
+    # Events + flight recorder: death, failover, adoption all visible.
+    assert len([e for e in events
+                if e["event"] == "graftfault_injected"]) == 1
+    died = [e for e in events if e["event"] == "host_died"]
+    assert died and died[0]["host"] == "host0"
+    fo = [e for e in events if e["event"] == "host_failover"]
+    assert len(fo) == 1 and fo[0]["host"] == "host0"
+    assert fo[0]["n_adopted"] == fo[0]["n_pending"] == len(adopted)
+    ring = sc.recorder.snapshot()
+    kinds = {e["kind"] for e in ring}
+    assert {"host_died", "host_failover", "journal_adopted"} <= kinds
+    assert {e["id"] for e in ring
+            if e["kind"] == "journal_adopted"} == adopted
+    st = router.stats()
+    assert st["failovers"] == 1
+    assert st["failed_over_requests"] == len(adopted)
+    assert st["adopted_pending"] == 0
+    assert st["hosts"]["host0"]["health"]["state"] == DEAD
+
+    # The superseding rule on disk: the adopted completions landed in
+    # the DEAD host's journal, so its restart finds zero incomplete
+    # admits and a reconnecting client's re-submission REPLAYS with
+    # zero device work.
+    p0 = str(tmp_path / "chaos" / "host0.journal.jsonl")
+    assert RunManifest.scan_incomplete(p0) == []
+    lines = _journal_lines(p0)
+    for rid in adopted:
+        assert sum(1 for ln in lines if ln.get("kind") == "admit"
+                   and ln.get("index") == rid) == 1
+        assert sum(1 for ln in lines if ln.get("kind") == "record"
+                   and ln.get("index") == rid) == 1
+    params = presets.durbin_cpg8()
+    sess = Session(params, name="host0-restart", private_breaker=True)
+    b_r = RequestBroker(
+        sess, BrokerConfig(flush_symbols=1500, flush_deadline_s=0.01),
+        manifest_path=p0, resume=True,
+    )
+    assert b_r.drain() == []  # nothing re-executes on restart
+    rid = min(adopted)
+    _i, nm, kind, tenant, syms = recs[rid]
+    b_r.submit(request_id=rid, tenant=tenant, kind=kind, symbols=syms,
+               name=nm)
+    (rr,) = b_r.drain()
+    assert rr.replayed and rr.route == "replay"
+    assert b_r.flushed_symbols == 0
+    assert _result_key(rr) == _result_key(clean[rid])
+    b_r.close()
+    b_r.release()
+
+
+@pytest.mark.slow
+@pytest.mark.filterwarnings(
+    "ignore::pytest.PytestUnhandledThreadExceptionWarning"
+)
+@pytest.mark.parametrize("seed", [0, 1])
+def test_seeded_host_chaos_matrix_converges_bit_identical(seed, tmp_path):
+    """The seeded host-chaos matrix: mid-flush kill, pre-flush host kill,
+    transport partition, and the admit-unacked kill — interleaving-
+    invariant assertions only: bit-identity, zero drops, exactly-once
+    delivery (asserted inside the harness), every injection ledgered."""
+    recs = _requests(seed=17, n=8)
+    want = _batch_truth(recs)
+    for plan in faultplan.host_matrix(seed):
+        d = tmp_path / plan.name
+        chaos, _router, events, _killed = _run_router(
+            recs, plan=plan, tmp=d
+        )
+        _assert_results_identical(chaos, want)
+        injected = [e for e in events
+                    if e["event"] == "graftfault_injected"]
+        assert len(injected) == len(plan.injected)
+
+
+@pytest.mark.slow
+def test_host_death_between_admit_and_queue_visibility(tmp_path):
+    """The sharpest journal edge: the host dies AFTER the admit line
+    lands but BEFORE the request is visible to any flush consumer.  No
+    worker will ever execute it — only the cross-host failover can.
+    Zero drops, zero double executions."""
+    recs = _requests(seed=31, n=3)
+    want = _batch_truth(recs)
+    hosts = _mk_hosts(tmp_path)
+    router = RequestRouter(
+        hosts, RouterConfig(idle_wait_s=0.01, failover_retry_s=0.01)
+    )
+    plan = FaultPlan(
+        [Fault("journal.post_admit", kind="kill", nth=1, match="req2")],
+        name="admit-unacked-kill",
+    )
+    try:
+        with faultplan.active(plan):
+            for rid, nm, kind, tenant, syms in recs[:2]:
+                router.submit(request_id=rid, tenant=tenant, kind=kind,
+                              symbols=syms, name=nm)
+            # rid 2 routes least-loaded to host0; the kill fires between
+            # its journal line and queue visibility.
+            with pytest.raises(faultplan.SimulatedKill):
+                router.submit(request_id=2, tenant=recs[2][3],
+                              kind=recs[2][2], symbols=recs[2][4],
+                              name=recs[2][1])
+        assert len(plan.injected) == 1
+        p0 = hosts[0].broker.manifest.path
+        pend = {int(r["index"])
+                for r in RunManifest.scan_incomplete(p0)}
+        assert pend == {0, 2}  # rid0 queued-incomplete + rid2 unacked
+
+        router.fail_host("host0", "admit-kill")
+        out = {r.id: r for r in router.drain()}
+    finally:
+        router.close()
+        router.release()
+    _assert_results_identical(out, want)
+    # The dead host executed nothing; its journal is fully superseded.
+    assert hosts[0].broker.flushed_symbols == 0
+    assert RunManifest.scan_incomplete(p0) == []
+    lines = _journal_lines(p0)
+    for rid in (0, 2):
+        assert sum(1 for ln in lines if ln.get("kind") == "admit"
+                   and ln.get("index") == rid) == 1
+        assert sum(1 for ln in lines if ln.get("kind") == "record"
+                   and ln.get("index") == rid) == 1
+    assert hosts[0].health.snapshot()["dead_reason"] == "admit-kill"
+
+
+# ---------------------------------------------------------------------------
+# Wire: mux+router under the LockTracker; client endpoint rotation
+
+
+def _start_server(target_args, sock_path, kwargs=None):
+    from cpgisland_tpu.serve.transport import serve_socket
+
+    t = threading.Thread(
+        target=serve_socket, args=target_args, kwargs=kwargs or {},
+        name="router-server", daemon=True,
+    )
+    t.start()
+    deadline = time.monotonic() + 30.0
+    while not os.path.exists(sock_path):
+        assert time.monotonic() < deadline, "server socket never appeared"
+        time.sleep(0.01)
+    while True:
+        try:
+            s = socket_mod.socket(socket_mod.AF_UNIX,
+                                  socket_mod.SOCK_STREAM)
+            s.connect(sock_path)
+            s.close()
+            break
+        except OSError:
+            assert time.monotonic() < deadline
+            time.sleep(0.05)
+    return t
+
+
+def _send_shutdown(sock_path):
+    s = socket_mod.socket(socket_mod.AF_UNIX, socket_mod.SOCK_STREAM)
+    s.connect(sock_path)
+    s.sendall(b'{"op": "shutdown"}\n')
+    s.close()
+
+
+def _client_session(sock_path, requests):
+    s = socket_mod.socket(socket_mod.AF_UNIX, socket_mod.SOCK_STREAM)
+    s.connect(sock_path)
+    rf = s.makefile("r", encoding="utf-8")
+    wf = s.makefile("w", encoding="utf-8")
+    want = set()
+    for req in requests:
+        wf.write(json.dumps(req) + "\n")
+        want.add(req["id"])
+    wf.flush()
+    got: dict = {}
+    for line in rf:
+        obj = json.loads(line)
+        if obj.get("id") in want:
+            got[obj["id"]] = obj
+        if set(got) == want:
+            break
+    rf.close()
+    wf.close()
+    s.close()
+    return got
+
+
+BASES = np.array(list("acgt"))
+
+
+@pytest.mark.slow
+def test_mux_over_router_stress_under_tracker(tmp_path, tracker):
+    """The mux accept loop fronting a 2-host ROUTER (router duck-types as
+    broker AND pool), concurrent clients, under the graftsync runtime
+    tracker: zero lock-order or guarded-access violations."""
+    hosts = _mk_hosts(manifest=False, flush_symbols=3000)
+    router = RequestRouter(hosts, RouterConfig(idle_wait_s=0.02))
+    for h in hosts:
+        tracker.watch_attrs(
+            h.broker, h.broker._lock,
+            ["_queued_symbols", "flushes", "flushed_symbols"],
+            label=f"RequestBroker[{h.label}]",
+        )
+    sock_path = str(tmp_path / "router.sock")
+    server = _start_server((sock_path, router), sock_path,
+                           kwargs={"pool": router})
+
+    rng = np.random.default_rng(43)
+    clients = []
+    for c in range(2):
+        reqs = []
+        for k in range(3):
+            syms = _gen_symbols(rng, 400 + 170 * k + 60 * c)
+            reqs.append({
+                "id": c * 100 + k,
+                "kind": "decode" if (c + k) % 2 else "posterior",
+                "seq": "".join(BASES[syms]),
+                "tenant": f"t{c}", "name": f"c{c}r{k}",
+            })
+        clients.append(reqs)
+    results: list = [None, None]
+    errors: list = []
+
+    def run_client(c):
+        try:
+            results[c] = _client_session(sock_path, clients[c])
+        except Exception as e:  # surface in the main thread's assert
+            errors.append((c, repr(e)))
+
+    threads = [threading.Thread(target=run_client, args=(c,))
+               for c in range(2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=300.0)
+    assert errors == [], errors
+    _send_shutdown(sock_path)
+    server.join(timeout=60.0)
+    assert not server.is_alive()
+
+    for c, reqs in enumerate(clients):
+        got = results[c]
+        assert got is not None and set(got) == {r["id"] for r in reqs}
+        for req in reqs:
+            assert got[req["id"]]["ok"], got[req["id"]].get("error")
+    tracker.assert_clean()
+    assert tracker.summary()["acquires"] > 50
+    st = router.stats()
+    assert sum(st["hosts"][h]["flushes"] for h in st["hosts"]) >= 2
+
+
+@pytest.mark.slow
+def test_client_rotates_to_alternate_endpoint_across_disconnect(tmp_path):
+    """tools/serve_client against a router behind an AF_UNIX door plus a
+    TCP side door: a dead first endpoint rotates the client onto the
+    alternate at connect time, a mid-stream connection death rotates it
+    again, and the re-submission converges to the batch-pipeline
+    output."""
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                    "tools"))
+    import serve_client
+
+    from cpgisland_tpu.serve import transport
+
+    params = presets.durbin_cpg8()
+    rng = np.random.default_rng(29)
+    names_syms = [(f"w{k}", _gen_symbols(rng, 700 + 120 * k))
+                  for k in range(4)]
+    fa = tmp_path / "w.fa"
+    with open(fa, "w") as f:
+        for nm, syms in names_syms:
+            f.write(f">{nm}\n" + "".join(BASES[syms]) + "\n")
+    want = pipeline.decode_file(str(fa), params, compat=False)
+    want_text: dict = {}
+    for line in want.calls.format_lines().splitlines(keepends=True):
+        want_text.setdefault(line.split(" ", 1)[0], []).append(line)
+
+    hosts = _mk_hosts(manifest=False, flush_symbols=1 << 20)
+    router = RequestRouter(hosts, RouterConfig(idle_wait_s=0.02))
+    tcp_srv = transport._bind_tcp("127.0.0.1", 0)
+    port = tcp_srv.getsockname()[1]
+    sock_path = str(tmp_path / "r.sock")
+    server = _start_server(
+        (sock_path, router), sock_path,
+        kwargs={"pool": router,
+                "extra_servers": [(tcp_srv, f"tcp:127.0.0.1:{port}")]},
+    )
+
+    requests = [
+        {"id": 100 + k, "kind": "decode", "seq": "".join(BASES[syms]),
+         "name": nm}
+        for k, (nm, syms) in enumerate(names_syms)
+    ]
+    # Endpoint 0 never existed (the router front's unix door "died");
+    # the TCP side door serves, then ALSO drops the connection
+    # mid-stream — the client rotates through the list both times.
+    endpoints = [str(tmp_path / "gone.sock"), f"tcp:127.0.0.1:{port}"]
+    plan = FaultPlan([Fault("transport.read", kind="disconnect", nth=2)],
+                     name="conn-death")
+    with faultplan.active(plan):
+        responses = serve_client.run_socket_session(
+            endpoints, requests, reconnects=6, reconnect_wait_s=0.05,
+        )
+    assert len(plan.injected) == 1  # the mid-stream disconnect fired
+    assert set(responses) == {100, 101, 102, 103}
+    for k, (nm, _syms) in enumerate(names_syms):
+        resp = responses[100 + k]
+        assert resp["ok"], resp.get("error")
+        assert resp.get("islands_text", "") == "".join(
+            want_text.get(nm, [])
+        ), nm
+
+    _send_shutdown(sock_path)
+    server.join(timeout=60.0)
+    assert not server.is_alive()

@@ -174,4 +174,17 @@ echo "== graftfault chaos slice (seeded plan matrix on the virtual mesh) =="
 # dropped admitted requests and a fully-ledgered requeue/replay trail.
 python -m pytest tests/test_graftfault.py -q
 
+echo "== serve router + host-chaos slice (pod-scale tier under the tracker) =="
+# PR 20: the multi-host routing tier.  Per-host health machines (terminal
+# DEAD included), least-loaded routing bit-identical to the single-broker
+# batch run, the measured-flush-wall retry_after_s load-shedding contract,
+# all-hosts-saturated shedding + drain-via-quarantine + half-open restore,
+# and the host-death chaos matrix: a host SIGKILLed mid-flush (plus the
+# seeded faultplan.host_matrix) must fail its journaled admissions over to
+# the survivor BIT-IDENTICALLY — zero drops, zero double executions
+# (journal-audited), both host memberships in the graftscope lineage.
+# Runs under the graftsync runtime tracker (CPGISLAND_TRACKSYNC=1): the
+# router/health locks join the watched set across the whole file.
+CPGISLAND_TRACKSYNC=1 python -m pytest tests/test_serve_router.py -q
+
 echo "ci_checks: all gates green"

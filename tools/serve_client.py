@@ -6,15 +6,25 @@ Reads FASTA records, submits each as a JSONL request (decode by default,
 the reference's `beg end len gc oe` line format (with a record-name
 column, like the batch CLI's multi-record output).
 
-Transport: --socket PATH connects to a running daemon's AF_UNIX socket;
-without it, the client SPAWNS `python -m cpgisland_tpu serve` as a
-subprocess and talks over its stdin/stdout — the zero-setup smoke path.
+Transport: --connect ENDPOINT (repeatable; an AF_UNIX path or a
+`tcp:HOST:PORT` spec — `--socket PATH` stays as the single-endpoint
+alias) connects to a running daemon; without either, the client SPAWNS
+`python -m cpgisland_tpu serve` as a subprocess and talks over its
+stdin/stdout — the zero-setup smoke path.
 
 ## Reconnect-with-replay (socket mode)
 
 On socket death the client reconnects (up to --reconnects times, with
-backoff) and re-submits exactly its INCOMPLETE ids.  This is safe against
-every daemon state because the daemon side already arbitrates:
+backoff) and re-submits exactly its INCOMPLETE ids.  With several
+--connect endpoints the client ROTATES to the next on every connection
+failure — the router-tier failover story: when one host (or the routing
+front's unix door) dies, the alternates keep serving, and the journal
+arbitration below makes the re-submission safe wherever it lands.  The
+reconnect backoff honors the daemon's last load-shed hint: a rejection's
+``retry_after_s`` is remembered and the next reconnect wait is at least
+that long (shed clients must not stampede a saturated pod).  This is
+safe against every daemon state because the daemon side already
+arbitrates:
 
 - an id still EXECUTING (or queued) is rejected with a duplicate-id error
   — the client backs off and retries it later (duplicate-id rejection of
@@ -82,14 +92,20 @@ def iter_fasta_text(path: str):
         yield name or "", "".join(parts)
 
 
-def _connect(sock_path: str):
+def _connect(endpoint: str):
+    """Connect one endpoint: a `tcp:HOST:PORT` spec or an AF_UNIX path."""
+    if endpoint.startswith("tcp:"):
+        host, port = endpoint[4:].rsplit(":", 1)
+        conn = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        conn.connect((host, int(port)))
+        return conn
     conn = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
-    conn.connect(sock_path)
+    conn.connect(endpoint)
     return conn
 
 
 def run_socket_session(
-    sock_path: str,
+    endpoints,
     requests: list,
     *,
     reconnects: int = 3,
@@ -99,28 +115,47 @@ def run_socket_session(
 ) -> dict:
     """Submit ``requests`` (JSON dicts with unique ``id``) over the daemon
     socket with reconnect-with-replay (see module docstring); returns
-    {id: final response dict}.  Raises OSError once the reconnect budget
-    is exhausted with ids still incomplete.  Each id's retryable
+    {id: final response dict}.  ``endpoints`` is one endpoint or a list —
+    each connection failure rotates to the next (alternate-endpoint
+    failover against a routing tier).  Raises OSError once the reconnect
+    budget is exhausted with ids still incomplete.  Each id's retryable
     rejections (duplicate-id / backpressure) are bounded by
     ``max_id_retries`` — past it the last rejection becomes the final
     response instead of spinning forever (e.g. against a colliding id
-    from another client that never completes)."""
+    from another client that never completes).  Reconnect waits honor the
+    daemon's last ``retry_after_s`` load-shed hint."""
     log = log if log is not None else (lambda msg: None)
+    if isinstance(endpoints, str):
+        endpoints = [endpoints]
+    endpoints = list(endpoints)
+    ep_i = 0
     pending = {int(r["id"]): r for r in requests}
     responses: dict = {}
     attempts = 0
     id_retries: dict = {}
+    last_hint = [0.0]  # most recent retry_after_s seen from the daemon
+
+    def _reconnect_sleep() -> None:
+        # Load-shed contract: never reconnect faster than the daemon's
+        # last machine-readable hint asked us to.
+        wait = max(reconnect_wait_s * attempts, last_hint[0])
+        last_hint[0] = 0.0
+        time.sleep(wait)
+
     while pending:
         retry_at: dict = {}  # id -> monotonic time of next re-submit
+        endpoint = endpoints[ep_i % len(endpoints)]
         try:
-            conn = _connect(sock_path)
+            conn = _connect(endpoint)
         except OSError:
             attempts += 1
+            ep_i += 1  # rotate: try the next endpoint first
             if attempts > reconnects:
                 raise
-            log(f"# serve_client: connect failed; retrying "
+            log(f"# serve_client: connect to {endpoint} failed; retrying "
+                f"on {endpoints[ep_i % len(endpoints)]} "
                 f"({attempts}/{reconnects})\n")
-            time.sleep(reconnect_wait_s * attempts)
+            _reconnect_sleep()
             continue
         try:
             wf = conn.makefile("w", encoding="utf-8")
@@ -173,6 +208,8 @@ def run_socket_session(
                         del pending[rid]
                         continue
                     delay = resp.get("retry_after_s") or _DEFAULT_RETRY_S
+                    if resp.get("retry_after_s"):
+                        last_hint[0] = max(last_hint[0], float(delay))
                     retry_at[rid] = time.monotonic() + float(delay)
                     log(f"# serve_client: request {rid} deferred "
                         f"({err.split(':', 1)[0]}); retrying in "
@@ -183,12 +220,14 @@ def run_socket_session(
                     del pending[rid]
         except OSError:
             attempts += 1
+            ep_i += 1  # rotate: the next attempt tries an alternate
             if attempts > reconnects:
                 raise
-            log(f"# serve_client: connection died with "
-                f"{len(pending)} request(s) incomplete; reconnecting "
-                f"and re-submitting ({attempts}/{reconnects})\n")
-            time.sleep(reconnect_wait_s * attempts)
+            log(f"# serve_client: connection to {endpoint} died with "
+                f"{len(pending)} request(s) incomplete; reconnecting on "
+                f"{endpoints[ep_i % len(endpoints)]} and re-submitting "
+                f"({attempts}/{reconnects})\n")
+            _reconnect_sleep()
         finally:
             try:
                 conn.close()
@@ -197,14 +236,25 @@ def run_socket_session(
     return responses
 
 
-def _socket_epilogue(sock_path: str, *, want_stats: bool,
+def _socket_epilogue(endpoints, *, want_stats: bool,
                      shutdown: bool) -> list:
-    """Optional stats fetch + shutdown on a short final connection."""
+    """Optional stats fetch + shutdown on a short final connection (the
+    first reachable endpoint)."""
     out = []
     if not (want_stats or shutdown):
         return out
+    if isinstance(endpoints, str):
+        endpoints = [endpoints]
+    conn = None
+    for ep in endpoints:
+        try:
+            conn = _connect(ep)
+            break
+        except OSError:
+            continue
+    if conn is None:
+        return out
     try:
-        conn = _connect(sock_path)
         wf = conn.makefile("w", encoding="utf-8")
         rf = conn.makefile("r", encoding="utf-8")
         if want_stats:
@@ -228,7 +278,13 @@ def main() -> int:
     ap.add_argument("--posterior", action="store_true",
                     help="soft decoding (MPM-path islands + mean confidence)")
     ap.add_argument("--tenant", default="default")
-    ap.add_argument("--socket", help="connect to a running daemon's socket")
+    ap.add_argument("--socket", help="connect to a running daemon's "
+                    "AF_UNIX socket (single-endpoint alias of --connect)")
+    ap.add_argument("--connect", action="append", default=[],
+                    metavar="ENDPOINT",
+                    help="daemon endpoint: an AF_UNIX path or tcp:HOST:PORT; "
+                    "repeat for alternates — each connection failure "
+                    "rotates to the next (router-tier failover)")
     ap.add_argument("--shutdown", action="store_true",
                     help="send {'op': 'shutdown'} after the last request "
                     "(socket mode; spawned daemons always shut down)")
@@ -256,14 +312,15 @@ def main() -> int:
         for i, (name, seq) in enumerate(iter_fasta_text(args.fasta))
     ]
 
-    if args.socket:
+    endpoints = ([args.socket] if args.socket else []) + list(args.connect)
+    if endpoints:
         responses = run_socket_session(
-            args.socket, requests, reconnects=args.reconnects,
+            endpoints, requests, reconnects=args.reconnects,
             log=sys.stderr.write,
         )
         resp_list = [responses[rid] for rid in sorted(responses)]
         resp_list += _socket_epilogue(
-            args.socket, want_stats=args.stats, shutdown=args.shutdown
+            endpoints, want_stats=args.stats, shutdown=args.shutdown
         )
     else:
         lines = [json.dumps(r) for r in requests]
